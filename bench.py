@@ -1,0 +1,59 @@
+"""Benchmark: batched Filter+Score throughput at 10k-node scale.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The measured kernel is the replacement for the reference scheduler's
+Filter+Score hot loop (upstream parallel per-node plugin calls;
+SURVEY.md section 3.1). Baseline for vs_baseline is the north-star target from
+BASELINE.json: 50k pods over 10k nodes in <200 ms p99 => 250k pods/sec.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+N_NODES = 10_240
+N_PODS = 512
+BASELINE_PODS_PER_SEC = 250_000.0
+
+
+def main() -> None:
+    from __graft_entry__ import _build_problem
+    from koordinator_tpu.ops.assignment import score_pods
+
+    state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
+    fn = jax.jit(score_pods)
+
+    # Compile + warmup.
+    scores, feasible = fn(state, pods, cfg)
+    scores.block_until_ready()
+
+    # Timed runs: full batched Filter+Score of N_PODS pods against N_NODES nodes.
+    times = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        scores, feasible = fn(state, pods, cfg)
+        scores.block_until_ready()
+        feasible.block_until_ready()
+        times.append(time.perf_counter() - t0)
+
+    p50 = float(np.median(times))
+    pods_per_sec = N_PODS / p50
+    print(
+        json.dumps(
+            {
+                "metric": f"filter_score_pods_per_sec_{N_NODES}_nodes",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""koordinator-tpu: a TPU-native cluster co-location scheduling framework.
+
+A ground-up rebuild of the capabilities of koordinator (QoS-based co-location
+scheduling for Kubernetes) with the scheduling hot path redesigned TPU-first:
+cluster state lives as device-resident tensors and every Filter/Score/quota/
+gang/rebalance decision is a batched JAX solve over a
+(pods x nodes x resource-dims) tensor, sharded across a TPU mesh.
+
+Layer map (mirrors SURVEY.md section 1, rebuilt TPU-native):
+
+- ``koordinator_tpu.api``        -- L1 protocol types (QoS, priority, resources, CRDs)
+- ``koordinator_tpu.state``      -- device-resident cluster-state tensors
+- ``koordinator_tpu.ops``        -- batched solver kernels (filter/score/assign/quota/gang)
+- ``koordinator_tpu.parallel``   -- mesh construction + sharded solves (ICI/DCN)
+- ``koordinator_tpu.scheduler``  -- L5/L6 framework shell + plugins
+- ``koordinator_tpu.manager``    -- L4 central controllers (colocation math, NodeSLO)
+- ``koordinator_tpu.descheduler``-- L7 rebalancing + migration
+- ``koordinator_tpu.koordlet``   -- L3 node agent (informers, metrics, QoS enforcement)
+- ``koordinator_tpu.utils``      -- shared utilities (cpuset, histogram, features...)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,24 @@
+"""L1 protocol types: the QoS/priority/resource model shared by every component.
+
+Mirrors the reference's ``apis/extension`` annotation protocol (SURVEY.md section 2.2)
+as first-class enums and tensor-friendly integer codes instead of string labels.
+"""
+
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.api.priority import PriorityClass, priority_class_of
+from koordinator_tpu.api.resources import (
+    ResourceDim,
+    NUM_RESOURCE_DIMS,
+    ResourceVector,
+    resource_vector,
+)
+
+__all__ = [
+    "QoSClass",
+    "PriorityClass",
+    "priority_class_of",
+    "ResourceDim",
+    "NUM_RESOURCE_DIMS",
+    "ResourceVector",
+    "resource_vector",
+]

@@ -1,0 +1,62 @@
+"""Koordinator priority bands.
+
+Reference: ``apis/extension/priority.go:29-48`` — pod priority values are
+partitioned into four bands; the band determines which extended-resource pool
+(prod / mid / batch / free) the pod's requests are accounted against:
+
+    koord-prod  [9000, 9999]
+    koord-mid   [7000, 7999]
+    koord-batch [5000, 5999]
+    koord-free  [3000, 3999]
+
+Band classification over a ``(P,)`` priority tensor is plain integer
+arithmetic (see :func:`priority_band_tensor`), so the scheduler can split a pod
+batch into per-band resource accounting without host round-trips.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+
+
+class PriorityClass(enum.IntEnum):
+    """Priority band codes (tensor-stable)."""
+
+    NONE = 0
+    FREE = 1
+    BATCH = 2
+    MID = 3
+    PROD = 4
+
+
+# Band boundaries, inclusive. Mirrors apis/extension/priority.go:29-48.
+PRIORITY_PROD_MIN, PRIORITY_PROD_MAX = 9000, 9999
+PRIORITY_MID_MIN, PRIORITY_MID_MAX = 7000, 7999
+PRIORITY_BATCH_MIN, PRIORITY_BATCH_MAX = 5000, 5999
+PRIORITY_FREE_MIN, PRIORITY_FREE_MAX = 3000, 3999
+
+_BANDS = (
+    (PriorityClass.PROD, PRIORITY_PROD_MIN, PRIORITY_PROD_MAX),
+    (PriorityClass.MID, PRIORITY_MID_MIN, PRIORITY_MID_MAX),
+    (PriorityClass.BATCH, PRIORITY_BATCH_MIN, PRIORITY_BATCH_MAX),
+    (PriorityClass.FREE, PRIORITY_FREE_MIN, PRIORITY_FREE_MAX),
+)
+
+
+def priority_class_of(priority: int) -> PriorityClass:
+    """Band of a single scalar priority value."""
+    for band, lo, hi in _BANDS:
+        if lo <= priority <= hi:
+            return band
+    return PriorityClass.NONE
+
+
+def priority_band_tensor(priority):
+    """Vectorized band classification: (P,) int32 priorities -> (P,) int8 bands."""
+    band = jnp.zeros(priority.shape, dtype=jnp.int8)
+    for cls, lo, hi in _BANDS:
+        in_band = (priority >= lo) & (priority <= hi)
+        band = jnp.where(in_band, jnp.int8(int(cls)), band)
+    return band

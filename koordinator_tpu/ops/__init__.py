@@ -1,0 +1,12 @@
+"""Batched solver kernels: the TPU replacement for the reference's hot loops.
+
+Where the reference runs per-pod x per-node Go plugin callbacks
+(``frameworkext/framework_extender.go`` RunFilterPlugins/RunScorePlugins), every
+kernel here consumes the whole (pods x nodes x dims) problem at once:
+
+- ``filtering``  -- feasibility masks (NodeResourcesFit + loadaware thresholds)
+- ``scoring``    -- loadaware / fitplus / scarce-resource scorers
+- ``assignment`` -- greedy sequential assignment with capacity feedback
+- ``quota``      -- hierarchical elastic-quota water-filling (Hamilton method)
+- ``gang``       -- gang all-or-nothing grouped assignment
+"""

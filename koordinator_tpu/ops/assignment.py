@@ -1,0 +1,224 @@
+"""Batched scheduling: score the whole (pods x nodes) problem, then assign.
+
+Two entry points:
+
+- :func:`score_pods` — the fully-parallel Score()/Filter() replacement: one
+  shot over the (P, N) matrix, no capacity feedback between pods. This is the
+  kernel the Go/py scheduler shell calls for single-pod cycles (P=1..k) and the
+  benchmark target (BASELINE.md: batched Score at 1k-10k nodes).
+
+- :func:`greedy_assign` — sequential greedy assignment with capacity feedback
+  via ``lax.scan`` in priority order: the tensor equivalent of running the
+  reference's scheduleOne loop over a whole pending queue. Each step re-filters
+  and re-scores against the updated free capacity, exactly as the reference's
+  snapshot would after each binding.
+
+The scoring pipeline composes the koordinator scheduler profile's score
+plugins with their weights (cmd/koord-scheduler/main.go:47-58 registry;
+weights from the scheduler profile):
+  final = la_w * LoadAware + fp_w * NodeResourcesFitPlus + sc_w * ScarceResourceAvoidance
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+from koordinator_tpu.ops import filtering, scoring
+from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
+
+
+@struct.dataclass
+class ScoringConfig:
+    """Traced pytree of plugin weights/args (scheduler-profile equivalent)."""
+
+    # LoadAwareScheduling args (apis/config/types.go LoadAwareSchedulingArgs)
+    loadaware_resource_weights: jax.Array  # (R,) int32
+    loadaware_dominant_weight: jax.Array   # () int32
+    loadaware_plugin_weight: jax.Array     # () int32
+    usage_thresholds: jax.Array            # (R,) int32 pct, 0 = unchecked
+    agg_usage_thresholds: jax.Array        # (R,) int32 pct, 0 = unchecked
+    estimator_factors: jax.Array           # (R,) int32 pct
+    estimator_defaults: jax.Array          # (R,) int32
+
+    # NodeResourcesFitPlus args
+    fitplus_resource_weights: jax.Array    # (R,) int32
+    fitplus_most_allocated: jax.Array      # (R,) bool
+    fitplus_plugin_weight: jax.Array       # () int32
+
+    # ScarceResourceAvoidance args
+    scarce_dims: jax.Array                 # (R,) bool
+    scarce_plugin_weight: jax.Array        # () int32
+
+    @classmethod
+    def default(cls) -> "ScoringConfig":
+        r = NUM_RESOURCE_DIMS
+        la_w = jnp.zeros(r, jnp.int32).at[ResourceDim.CPU].set(1).at[ResourceDim.MEMORY].set(1)
+        factors = (
+            jnp.full(r, 100, jnp.int32)
+            .at[ResourceDim.CPU].set(85)      # DefaultEstimatedScalingFactors
+            .at[ResourceDim.MEMORY].set(70)
+        )
+        defaults = (
+            jnp.zeros(r, jnp.int32)
+            .at[ResourceDim.CPU].set(250)     # DefaultMilliCPURequest
+            .at[ResourceDim.MEMORY].set(200)  # DefaultMemoryRequest (MiB units)
+        )
+        fp_w = jnp.zeros(r, jnp.int32).at[ResourceDim.CPU].set(1).at[ResourceDim.MEMORY].set(1)
+        return cls(
+            loadaware_resource_weights=la_w,
+            loadaware_dominant_weight=jnp.int32(0),
+            loadaware_plugin_weight=jnp.int32(1),
+            usage_thresholds=jnp.zeros(r, jnp.int32)
+            .at[ResourceDim.CPU].set(65)      # defaultNodeCPUUsageThreshold
+            .at[ResourceDim.MEMORY].set(95),
+            agg_usage_thresholds=jnp.zeros(r, jnp.int32),
+            estimator_factors=factors,
+            estimator_defaults=defaults,
+            fitplus_resource_weights=fp_w,
+            fitplus_most_allocated=jnp.zeros(r, bool),
+            fitplus_plugin_weight=jnp.int32(1),
+            scarce_dims=jnp.zeros(r, bool).at[ResourceDim.GPU].set(True),
+            scarce_plugin_weight=jnp.int32(0),
+        )
+
+
+def _composite_score(
+    cfg: ScoringConfig,
+    allocatable: jnp.ndarray,   # (N, R)
+    requested: jnp.ndarray,     # (N, R)
+    est_usage: jnp.ndarray,     # (N, R) node usage + in-flight estimates
+    pod_requests: jnp.ndarray,  # (P, R)
+    pod_estimated: jnp.ndarray, # (P, R)
+) -> jnp.ndarray:
+    """(P, N) weighted sum of score plugins."""
+    la = scoring.loadaware_score(
+        est_usage[None, :, :] + pod_estimated[:, None, :],
+        allocatable[None, :, :],
+        cfg.loadaware_resource_weights,
+        cfg.loadaware_dominant_weight,
+    )
+    fp = scoring.fitplus_score(
+        requested, allocatable, pod_requests,
+        cfg.fitplus_resource_weights, cfg.fitplus_most_allocated,
+    )
+    sc = scoring.scarce_resource_score(pod_requests, allocatable, cfg.scarce_dims)
+    return (
+        la * cfg.loadaware_plugin_weight
+        + fp * cfg.fitplus_plugin_weight
+        + sc * cfg.scarce_plugin_weight
+    )
+
+
+def _threshold_mask(cfg, usage, agg_usage, allocatable, pod_est):
+    """LoadAware Filter threshold selection: the aggregated-percentile policy,
+    when configured, REPLACES the instantaneous thresholds (load_aware.go:150
+    checks one or the other, never both)."""
+    inst = filtering.usage_threshold_mask(
+        usage, allocatable, cfg.usage_thresholds, pod_est
+    )
+    agg = filtering.usage_threshold_mask(
+        agg_usage, allocatable, cfg.agg_usage_thresholds, pod_est
+    )
+    agg_enabled = jnp.any(cfg.agg_usage_thresholds > 0)
+    return jnp.where(agg_enabled, agg, inst)
+
+
+def score_pods(
+    state: ClusterState, pods: PodBatch, cfg: ScoringConfig
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One-shot batched Filter+Score (no capacity feedback).
+
+    Returns (scores, feasible): (P, N) int32 and (P, N) bool.
+    """
+    pod_est = scoring.estimate_pod_usage_by_band(
+        pods.requests, cfg.estimator_factors, cfg.estimator_defaults
+    )
+    free = state.free
+    feasible = filtering.combine_masks(
+        filtering.fit_mask(free, pods.requests),
+        _threshold_mask(cfg, state.node_usage, state.node_agg_usage,
+                        state.node_allocatable, pod_est),
+        pods.feasible,
+        state.node_valid[None, :],
+        pods.valid[:, None],
+    )
+    scores = _composite_score(
+        cfg,
+        state.node_allocatable,
+        state.node_requested,
+        state.node_usage,
+        pods.requests,
+        pod_est,
+    )
+    return scores, feasible
+
+
+def greedy_assign(
+    state: ClusterState, pods: PodBatch, cfg: ScoringConfig
+) -> tuple[jnp.ndarray, ClusterState]:
+    """Assign a whole pending batch sequentially in priority order.
+
+    Returns (assignments, new_state): assignments is (P,) int32 node index per
+    pod (original batch order), -1 = unschedulable; new_state carries the
+    updated node_requested accounting (Reserve semantics).
+
+    Determinism: ties break toward the lowest node index (the reference's
+    selectHost randomizes among maxima; we fix the choice for reproducibility).
+    """
+    order = jnp.lexsort((jnp.arange(pods.capacity), -pods.priority))
+
+    pod_est_all = scoring.estimate_pod_usage_by_band(
+        pods.requests, cfg.estimator_factors, cfg.estimator_defaults
+    )
+
+    def step(carry, idx):
+        # est_added accumulates in-flight pods' estimated usage (the
+        # reference's pod-assign cache) on top of whichever usage base the
+        # threshold policy selects.
+        requested, est_added = carry
+        req = pods.requests[idx]          # (R,)
+        pod_est = pod_est_all[idx]        # (R,)
+        valid = pods.valid[idx]
+
+        free = jnp.where(
+            state.node_valid[:, None], state.node_allocatable - requested, 0
+        )
+        fits = jnp.all((req[None, :] <= free) | (req[None, :] == 0), axis=-1)
+        feasible = (
+            fits
+            & _threshold_mask(
+                cfg,
+                state.node_usage + est_added,
+                state.node_agg_usage + est_added,
+                state.node_allocatable,
+                pod_est[None, :],
+            )[0]
+            & pods.feasible[idx]
+            & state.node_valid
+            & valid
+        )
+
+        scores = _composite_score(
+            cfg, state.node_allocatable, requested,
+            state.node_usage + est_added,
+            req[None, :], pod_est[None, :],
+        )[0]
+        masked = jnp.where(feasible, scores, -1)
+        best = jnp.argmax(masked)
+        assigned = masked[best] >= 0
+        node = jnp.where(assigned, best, -1)
+
+        add = jnp.where(assigned, req, 0)
+        add_est = jnp.where(assigned, pod_est, 0)
+        requested = requested.at[best].add(add)
+        est_added = est_added.at[best].add(add_est)
+        return (requested, est_added), node
+
+    (requested, _), nodes_in_order = jax.lax.scan(
+        step, (state.node_requested, jnp.zeros_like(state.node_usage)), order
+    )
+    assignments = jnp.full(pods.capacity, -1, jnp.int32).at[order].set(nodes_in_order)
+    return assignments, state.replace(node_requested=requested)

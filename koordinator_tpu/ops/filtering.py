@@ -1,0 +1,80 @@
+"""Feasibility masks: the Filter phase as boolean tensor algebra.
+
+Replaces the reference's per-node Filter loop (parallelized over node chunks in
+the upstream scheduler) with whole-matrix boolean ops:
+
+- :func:`fit_mask` — NodeResourcesFit: every requested dimension fits into the
+  node's request-free capacity. (Upstream plugin configured by koordinator's
+  profiles; semantics from k8s noderesources.Fit.)
+- :func:`usage_threshold_mask` — LoadAwareScheduling Filter
+  (``pkg/scheduler/plugins/loadaware/load_aware.go:150``): node is
+  unschedulable when round(estimatedUsage / allocatable * 100) exceeds the
+  per-resource threshold; supports both instantaneous and aggregated-percentile
+  usage inputs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MAX_SCALE = 100  # percentage scale; MaxNodeScore upstream
+
+
+def fit_mask(free: jnp.ndarray, requests: jnp.ndarray) -> jnp.ndarray:
+    """(N, R) free x (P, R) requests -> (P, N) bool: request fits entirely.
+
+    Dimensions the pod does not request (req == 0) never exclude a node.
+    """
+    # req == 0 dims must not exclude a node even when free is negative there
+    # (batch allocatable can shrink below what is already scheduled).
+    fits = (requests[:, None, :] <= free[None, :, :]) | (requests[:, None, :] == 0)
+    return jnp.all(fits, axis=-1)
+
+
+def usage_threshold_mask(
+    usage: jnp.ndarray,
+    allocatable: jnp.ndarray,
+    thresholds: jnp.ndarray,
+    pod_estimated: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """LoadAware usage-threshold filter.
+
+    Args:
+      usage: (N, R) int32 estimated node usage (already includes assign-cache
+        estimates of in-flight pods, per load_aware.go:150's estimatedUsed).
+      allocatable: (N, R) int32.
+      thresholds: (R,) int32 percentage thresholds; 0 = no threshold for dim
+        (the reference only checks resources present in the threshold map).
+      pod_estimated: optional (P, R) estimated usage of the pods being placed;
+        when given the result is per-pod (P, N), else (N,).
+
+    Returns (P, N) or (N,) bool — True = node passes.
+
+    Parity note: usage percentage is round(est*100/total) compared with `>`
+    (load_aware.go:326 ``usage := int64(math.Round(...)); if usage <= value``).
+    Rounding is matched via (200*est + total) // (2*total).
+    """
+    total = allocatable  # (N, R)
+    if pod_estimated is not None:
+        est = usage[None, :, :] + pod_estimated[:, None, :]  # (P, N, R)
+        total = total[None, :, :]
+    else:
+        est = usage
+
+    # round(est*100/total) with integer math; guard total==0 (dim skipped).
+    # floor((100e + floor(t/2))/t) == round-half-up for either parity of t,
+    # and keeps the intermediate below 100*est (int32-safe for est < 2^31/100,
+    # the documented per-dim bound — see api/resources.py).
+    pct = jnp.where(
+        total > 0, (MAX_SCALE * est + total // 2) // jnp.maximum(total, 1), 0
+    )
+    exceeded = (thresholds > 0) & (total > 0) & (pct > thresholds)
+    return ~jnp.any(exceeded, axis=-1)
+
+
+def combine_masks(*masks: jnp.ndarray) -> jnp.ndarray:
+    """AND together broadcastable feasibility masks."""
+    out = masks[0]
+    for m in masks[1:]:
+        out = out & m
+    return out

@@ -1,0 +1,210 @@
+"""Score kernels with reference-parity integer semantics.
+
+Each scorer is written over the full (pods x nodes) problem; the per-node Go
+functions they replace are cited inline. MaxNodeScore = 100 as upstream.
+
+All division is integer floor division on int32, matching the reference's
+int64 ``/`` on non-negative operands.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MAX_NODE_SCORE = 100
+
+
+def least_used_score(used: jnp.ndarray, capacity: jnp.ndarray) -> jnp.ndarray:
+    """(capacity-used)*100/capacity; 0 when capacity==0 or used>capacity.
+
+    Parity: pkg/scheduler/plugins/loadaware/load_aware.go:368 leastUsedScore.
+    """
+    ok = (capacity > 0) & (used <= capacity)
+    safe_cap = jnp.maximum(capacity, 1)
+    return jnp.where(ok, (capacity - used) * MAX_NODE_SCORE // safe_cap, 0)
+
+
+def most_requested_score(requested: jnp.ndarray, capacity: jnp.ndarray) -> jnp.ndarray:
+    """min(requested, capacity)*100/capacity; 0 when capacity==0.
+
+    Parity: noderesourcefitplus/node_resource_fit_plus_utils.go:36 — requested
+    beyond capacity is clamped (an overcommitted dim scores the full 100).
+    """
+    clamped = jnp.minimum(requested, capacity)
+    safe_cap = jnp.maximum(capacity, 1)
+    return jnp.where(capacity > 0, clamped * MAX_NODE_SCORE // safe_cap, 0)
+
+
+def least_requested_score(requested: jnp.ndarray, capacity: jnp.ndarray) -> jnp.ndarray:
+    """(capacity-requested)*100/capacity; 0 when capacity==0 or requested>capacity.
+
+    Parity: noderesourcefitplus/node_resource_fit_plus_utils.go:47.
+    """
+    return least_used_score(requested, capacity)
+
+
+def loadaware_score(
+    used: jnp.ndarray,
+    allocatable: jnp.ndarray,
+    weights: jnp.ndarray,
+    dominant_weight: int = 0,
+) -> jnp.ndarray:
+    """LoadAwareScheduling scorer: weighted least-used + dominant-resource term.
+
+    Parity: load_aware.go:347 loadAwareSchedulingScorer —
+      nodeScore = sum_i w_i * leastUsed_i  +  dw * min_i leastUsed_i
+      score     = nodeScore / (sum_i w_i + dw)
+    The min runs over configured resources (w_i > 0 here); with dw != 0 the
+    dominant score starts at MaxNodeScore (so no configured resources -> 100).
+
+    Args:
+      used: (..., N, R) estimated used (node usage + estimated pod usage).
+      allocatable: (N, R) or broadcastable.
+      weights: (R,) int32; 0 = resource not configured.
+      dominant_weight: scalar int.
+
+    Returns (..., N) int32 scores in [0, 100].
+    """
+    per_res = least_used_score(used, allocatable)  # (..., N, R)
+    w = weights.astype(jnp.int32)
+    dw = jnp.asarray(dominant_weight, dtype=jnp.int32)
+    configured = w > 0
+    dominant = jnp.min(jnp.where(configured, per_res, MAX_NODE_SCORE), axis=-1)
+    # dw == 0 contributes nothing to either term, so the "only if dominant
+    # weight set" branch of the reference folds into one expression.
+    node_score = jnp.sum(per_res * w, axis=-1) + dominant * dw
+    weight_sum = jnp.sum(w) + dw
+    return jnp.where(weight_sum > 0, node_score // jnp.maximum(weight_sum, 1), 0)
+
+
+def fitplus_score(
+    requested: jnp.ndarray,
+    allocatable: jnp.ndarray,
+    pod_requests: jnp.ndarray,
+    weights: jnp.ndarray,
+    most_allocated: jnp.ndarray,
+) -> jnp.ndarray:
+    """NodeResourcesFitPlus: per-resource least/most-allocated strategy weights.
+
+    Parity: noderesourcefitplus/node_resource_fit_plus_utils.go:58
+    resourceScorer — for each resource the POD requests (req > 0):
+      score_r = strategy_r(nodeRequested_r + podRequest_r, allocatable_r) * w_r
+      final   = sum_r score_r / sum_r w_r      (only over requested resources)
+
+    Args:
+      requested: (N, R) node requested (without the pod).
+      allocatable: (N, R).
+      pod_requests: (P, R).
+      weights: (R,) int32 per-resource strategy weight.
+      most_allocated: (R,) bool — True = MostAllocated strategy, else Least.
+
+    Returns (P, N) int32.
+    """
+    combined = requested[None, :, :] + pod_requests[:, None, :]  # (P, N, R)
+    least = least_requested_score(combined, allocatable[None])
+    most = most_requested_score(combined, allocatable[None])
+    per_res = jnp.where(most_allocated, most, least)  # (P, N, R)
+
+    req_mask = pod_requests[:, None, :] > 0  # (P, 1, R)
+    w = jnp.where(req_mask, weights.astype(jnp.int32), 0)  # (P, 1, R)
+    num = jnp.sum(per_res * w, axis=-1)  # (P, N)
+    den = jnp.sum(w, axis=-1)  # (P, 1)
+    # No weighted requested resources -> MaxNodeScore, per
+    # node_resource_fit_plus_utils.go resourceScorer's weightSum==0 branch.
+    return jnp.where(den > 0, num // jnp.maximum(den, 1), MAX_NODE_SCORE)
+
+
+def scarce_resource_score(
+    pod_requests: jnp.ndarray,
+    node_allocatable: jnp.ndarray,
+    scarce_dims: jnp.ndarray,
+) -> jnp.ndarray:
+    """ScarceResourceAvoidance: penalize nodes whose scarce resources go unused.
+
+    Parity: scarceresourceavoidance/scarce_resource_avoidance.go:89,158 —
+      diff      = node resource types NOT requested by the pod
+      intersect = diff ∩ configured scarce types
+      score     = (|diff| - |intersect|) * 100 / |diff|, or 100 if either empty.
+
+    Args:
+      pod_requests: (P, R).
+      node_allocatable: (N, R).
+      scarce_dims: (R,) bool — configured scarce resource types.
+
+    Returns (P, N) int32.
+    """
+    node_has = node_allocatable > 0  # (N, R)
+    pod_wants = pod_requests > 0  # (P, R)
+    diff = node_has[None, :, :] & ~pod_wants[:, None, :]  # (P, N, R)
+    inter = diff & scarce_dims
+    n_diff = jnp.sum(diff, axis=-1).astype(jnp.int32)
+    n_inter = jnp.sum(inter, axis=-1).astype(jnp.int32)
+    score = (n_diff - n_inter) * MAX_NODE_SCORE // jnp.maximum(n_diff, 1)
+    return jnp.where((n_diff == 0) | (n_inter == 0), MAX_NODE_SCORE, score)
+
+
+def estimate_pod_usage(
+    pod_requests: jnp.ndarray,
+    scaling_factors_pct: jnp.ndarray,
+    default_request: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """LoadAware DefaultEstimator: estimated usage = round(request * factor/100).
+
+    Parity: loadaware/estimator/default_estimator.go:74-121 — requests are
+    scaled by per-resource percentage factors; pods with zero cpu/memory
+    requests estimate at defaults (250 mcore / 200 MiB).
+
+    Args:
+      pod_requests: (P, R) int32.
+      scaling_factors_pct: (R,) int32 percent factors (e.g. cpu 85, memory 70).
+      default_request: optional (R,) int32 used where request == 0.
+
+    Returns (P, R) int32.
+    """
+    # round(req*f/100) = (100*req*f/100 + 50)/100; keep the intermediate at
+    # req*f (int32-safe for req < 2^31/100 with pct factors <= 100).
+    scaled = (pod_requests * scaling_factors_pct + 50) // 100
+    if default_request is not None:
+        # zero-request dims estimate at the (unscaled) default, per
+        # default_estimator.go:97-102.
+        scaled = jnp.where((pod_requests == 0) & (default_request > 0),
+                           default_request, scaled)
+    return scaled
+
+
+def estimate_pod_usage_by_band(
+    pod_requests: jnp.ndarray,
+    scaling_factors_pct: jnp.ndarray,
+    default_request: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Band-translated usage estimate: batch/mid requests count as physical use.
+
+    Parity: default_estimator.go:74-83 — the estimator translates cpu/memory by
+    the pod's priority class (``TranslateResourceNameByPriorityClass``), so a
+    batch pod's ``batch-cpu`` request estimates *physical* CPU usage. A pod
+    requests cpu in exactly one band's dims, so summing the bands recovers the
+    translated request; the estimate lands in the physical CPU/MEMORY dims
+    (usage thresholds and loadaware scoring compare against physical usage).
+    """
+    from koordinator_tpu.api.resources import (
+        BATCH_DIMS, MID_DIMS, ResourceDim,
+    )
+
+    cpu_eff = (
+        pod_requests[..., ResourceDim.CPU]
+        + pod_requests[..., ResourceDim.BATCH_CPU]
+        + pod_requests[..., ResourceDim.MID_CPU]
+    )
+    mem_eff = (
+        pod_requests[..., ResourceDim.MEMORY]
+        + pod_requests[..., ResourceDim.BATCH_MEMORY]
+        + pod_requests[..., ResourceDim.MID_MEMORY]
+    )
+    translated = (
+        pod_requests
+        .at[..., ResourceDim.CPU].set(cpu_eff)
+        .at[..., ResourceDim.MEMORY].set(mem_eff)
+    )
+    for d in (*BATCH_DIMS, *MID_DIMS):
+        translated = translated.at[..., d].set(0)
+    return estimate_pod_usage(translated, scaling_factors_pct, default_request)

@@ -1,0 +1,12 @@
+"""Mesh construction and sharded solves over ICI/DCN.
+
+The scale axis of a cluster scheduler is (pods x nodes), not model weights: the
+node axis shards across TPU devices (each chip scores/filters its node shard),
+and cross-device reductions (global argmax for assignment, sums for quota) ride
+ICI collectives inserted by GSPMD. See SURVEY.md section 2.11 / 5 for the mapping
+from the reference's parallelize/informer model.
+"""
+
+from koordinator_tpu.parallel.mesh import solver_mesh, shard_cluster_state, NODES_AXIS, PODS_AXIS
+
+__all__ = ["solver_mesh", "shard_cluster_state", "NODES_AXIS", "PODS_AXIS"]

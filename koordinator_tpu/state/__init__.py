@@ -1,0 +1,10 @@
+"""Device-resident cluster state: the tensors every solve reads.
+
+Replaces the reference's per-node Go object caches (scheduler NodeInfo snapshot,
+loadaware pod-assign cache, deviceshare nodeDevice cache) with fixed-capacity
+padded tensors that live on the TPU and are updated by delta scatter.
+"""
+
+from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
+
+__all__ = ["ClusterState", "PodBatch"]

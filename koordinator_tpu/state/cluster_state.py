@@ -1,0 +1,206 @@
+"""Cluster state as fixed-capacity padded tensors.
+
+Design notes (SURVEY.md section 7 "hard parts"):
+
+- **Fixed capacity + masks.** Nodes and pods come and go; XLA wants static
+  shapes. State tensors are allocated at a capacity (a power-of-two bucket) and
+  carry validity masks. Growing past capacity re-allocates at the next bucket —
+  a recompile, amortized to O(log N) recompiles over cluster life.
+- **Delta scatter updates.** The host keeps an index map (name -> row); informer
+  deltas become ``tensor.at[rows].set(values)`` scatters of only changed rows,
+  not full-state uploads. This is the double-buffer-friendly update path that
+  keeps host->device traffic proportional to churn.
+- **Integer exactness.** Resource math is int32 in canonical units
+  (see api/resources.py) to match the reference's int64 milli-unit math.
+
+Reference-parity mapping:
+  node_allocatable  <- Node.status.allocatable (scheduler NodeInfo snapshot)
+  node_requested    <- sum of scheduled pods' requests (NodeInfo.Requested)
+  node_usage        <- NodeMetric.status.nodeMetric.nodeUsage (slo/v1alpha1, nodemetric_types.go:131)
+  node_agg_usage    <- NodeMetric AggregatedUsage percentile (nodemetric_types.go:50)
+  node_prod_usage   <- prod-pool usage (loadaware prod-usage mode)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+
+
+#: Per-dimension quantity bound: integer score/percentage math multiplies by
+#: 100, so quantities must stay below 2^31/100 to avoid int32 overflow
+#: (api/resources.py documents the unit scaling that keeps real nodes within
+#: this: 21.4M mcores / 21.4M MiB ~ 20 TiB memory per node).
+MAX_QUANTITY = (2**31 - 1) // 100
+
+
+def _check_bounds(a: np.ndarray | None, what: str) -> None:
+    if a is not None and np.asarray(a).size and np.asarray(a).max() > MAX_QUANTITY:
+        raise ValueError(
+            f"{what} exceeds MAX_QUANTITY={MAX_QUANTITY}; rescale units "
+            "(see api/resources.py)"
+        )
+
+
+def _bucket(n: int, minimum: int = 64) -> int:
+    """Smallest power-of-two capacity >= n (recompile bucketing)."""
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@struct.dataclass
+class ClusterState:
+    """Per-node tensors, shape (N, R) / (N,). N is the padded node capacity."""
+
+    node_allocatable: jax.Array  # (N, R) int32
+    node_requested: jax.Array    # (N, R) int32 — requests of pods bound to the node
+    node_usage: jax.Array        # (N, R) int32 — latest real usage (NodeMetric)
+    node_agg_usage: jax.Array    # (N, R) int32 — aggregated percentile usage (e.g. p95)
+    node_prod_usage: jax.Array   # (N, R) int32 — usage by prod-band pods only
+    node_valid: jax.Array        # (N,)  bool
+
+    @property
+    def capacity(self) -> int:
+        return self.node_allocatable.shape[0]
+
+    @property
+    def free(self) -> jax.Array:
+        """(N, R) request-free capacity; 0 for invalid nodes."""
+        free = self.node_allocatable - self.node_requested
+        return jnp.where(self.node_valid[:, None], free, 0)
+
+    @classmethod
+    def zeros(cls, capacity: int, dims: int = NUM_RESOURCE_DIMS) -> "ClusterState":
+        z = jnp.zeros((capacity, dims), dtype=jnp.int32)
+        return cls(
+            node_allocatable=z,
+            node_requested=z,
+            node_usage=z,
+            node_agg_usage=z,
+            node_prod_usage=z,
+            node_valid=jnp.zeros((capacity,), dtype=bool),
+        )
+
+    @classmethod
+    def from_arrays(
+        cls,
+        allocatable: np.ndarray,
+        requested: np.ndarray | None = None,
+        usage: np.ndarray | None = None,
+        agg_usage: np.ndarray | None = None,
+        prod_usage: np.ndarray | None = None,
+        capacity: int | None = None,
+    ) -> "ClusterState":
+        """Build padded device state from (n, R) host arrays of n real nodes."""
+        n, dims = allocatable.shape
+        cap = capacity if capacity is not None else _bucket(n)
+        _check_bounds(allocatable, "node allocatable")
+
+        def pad(a):
+            out = np.zeros((cap, dims), dtype=np.int32)
+            if a is not None:
+                out[:n] = a
+            return jnp.asarray(out)
+
+        valid = np.zeros(cap, dtype=bool)
+        valid[:n] = True
+        return cls(
+            node_allocatable=pad(allocatable),
+            node_requested=pad(requested),
+            node_usage=pad(usage),
+            node_agg_usage=pad(agg_usage if agg_usage is not None else usage),
+            node_prod_usage=pad(prod_usage if prod_usage is not None else usage),
+            node_valid=jnp.asarray(valid),
+        )
+
+    def scatter_update(self, rows: jax.Array, **updates: jax.Array) -> "ClusterState":
+        """Apply a delta: replace the given rows of the named tensors.
+
+        ``rows`` is (K,) int32; each update value is (K, R) (or (K,) for masks).
+        Only the changed rows travel host->device.
+        """
+        new = {}
+        for name, value in updates.items():
+            cur = getattr(self, name)
+            new[name] = cur.at[rows].set(value)
+        return self.replace(**new)
+
+    def add_pod(self, node_idx: jax.Array, request: jax.Array) -> "ClusterState":
+        """Account a pod's request onto a node (Reserve semantics)."""
+        return self.replace(
+            node_requested=self.node_requested.at[node_idx].add(request)
+        )
+
+    def remove_pod(self, node_idx: jax.Array, request: jax.Array) -> "ClusterState":
+        """Unreserve (scheduling failure / pod deletion)."""
+        return self.replace(
+            node_requested=self.node_requested.at[node_idx].add(-request)
+        )
+
+
+@struct.dataclass
+class PodBatch:
+    """A batch of pending pods, shape (P, R) / (P,). P is padded pod capacity."""
+
+    requests: jax.Array    # (P, R) int32
+    priority: jax.Array    # (P,) int32 — koordinator priority value
+    qos: jax.Array         # (P,) int8  — QoSClass codes
+    gang_id: jax.Array     # (P,) int32 — gang index, -1 = not in a gang
+    quota_id: jax.Array    # (P,) int32 — elastic-quota index, -1 = none
+    valid: jax.Array       # (P,) bool
+    feasible: jax.Array    # (P, N) bool — host-computed placement mask
+                           # (node/pod affinity, taints/tolerations, nodeSelector)
+
+    @property
+    def capacity(self) -> int:
+        return self.requests.shape[0]
+
+    @classmethod
+    def build(
+        cls,
+        requests: np.ndarray,
+        priority: np.ndarray | None = None,
+        qos: np.ndarray | None = None,
+        gang_id: np.ndarray | None = None,
+        quota_id: np.ndarray | None = None,
+        feasible: np.ndarray | None = None,
+        node_capacity: int = 64,
+        capacity: int | None = None,
+    ) -> "PodBatch":
+        p, dims = requests.shape
+        cap = capacity if capacity is not None else _bucket(p)
+        _check_bounds(requests, "pod requests")
+
+        req = np.zeros((cap, dims), dtype=np.int32)
+        req[:p] = requests
+
+        def pad1(a, fill, dtype):
+            out = np.full(cap, fill, dtype=dtype)
+            if a is not None:
+                out[:p] = a
+            return jnp.asarray(out)
+
+        feas = np.zeros((cap, node_capacity), dtype=bool)
+        if feasible is not None:
+            feas[:p, : feasible.shape[1]] = feasible
+        else:
+            feas[:p] = True
+
+        valid = np.zeros(cap, dtype=bool)
+        valid[:p] = True
+
+        return cls(
+            requests=jnp.asarray(req),
+            priority=pad1(priority, 0, np.int32),
+            qos=pad1(qos, 0, np.int8),
+            gang_id=pad1(gang_id, -1, np.int32),
+            quota_id=pad1(quota_id, -1, np.int32),
+            valid=jnp.asarray(valid),
+            feasible=jnp.asarray(feas),
+        )

@@ -1,0 +1,21 @@
+"""Test env: force an 8-device virtual CPU platform before JAX initializes.
+
+Multi-chip sharding logic is tested on this virtual mesh (the real TPU tunnel
+exposes a single chip); the driver's dryrun_multichip does the same.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Force the CPU platform (the ambient sitecustomize pins the TPU tunnel
+# backend via jax.config, so the env var alone is not enough); set
+# KOORD_TEST_TPU=1 to run the suite against real hardware instead.
+if not os.environ.get("KOORD_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,108 @@
+"""Pure-Python integer oracles mirroring the reference's Go scorer semantics.
+
+Each function is a direct reimplementation (from observed semantics, not code)
+of the cited Go function using plain Python ints, used to check the JAX kernels
+for exact integer parity on random fixtures.
+"""
+
+from __future__ import annotations
+
+MAX_NODE_SCORE = 100
+
+
+def least_used_score(used: int, capacity: int) -> int:
+    # loadaware/load_aware.go:368
+    if capacity == 0 or used > capacity:
+        return 0
+    return (capacity - used) * MAX_NODE_SCORE // capacity
+
+
+def most_requested_score(requested: int, capacity: int) -> int:
+    # noderesourcefitplus utils mostRequestedScore: clamps over-capacity to 100
+    if capacity == 0:
+        return 0
+    if requested > capacity:
+        requested = capacity
+    return requested * MAX_NODE_SCORE // capacity
+
+
+def loadaware_score(used, allocatable, weights, dominant_weight) -> int:
+    # loadaware/load_aware.go:347 loadAwareSchedulingScorer
+    node_score = 0
+    weight_sum = 0
+    dominant = MAX_NODE_SCORE if dominant_weight != 0 else 0
+    if dominant_weight != 0:
+        weight_sum += dominant_weight
+    for i, w in enumerate(weights):
+        if w <= 0:
+            continue
+        s = least_used_score(used[i], allocatable[i])
+        node_score += s * w
+        weight_sum += w
+        if dominant > s:
+            dominant = s
+    node_score += dominant * dominant_weight
+    if weight_sum <= 0:
+        return 0
+    return node_score // weight_sum
+
+
+def fitplus_score(node_requested, allocatable, pod_request, weights, most_allocated) -> int:
+    # noderesourcefitplus resourceScorer: only resources the pod requests count
+    num = 0
+    den = 0
+    for i, w in enumerate(weights):
+        if pod_request[i] <= 0 or w <= 0:
+            continue
+        combined = node_requested[i] + pod_request[i]
+        if most_allocated[i]:
+            s = most_requested_score(combined, allocatable[i])
+        else:
+            s = least_used_score(combined, allocatable[i])
+        num += s * w
+        den += w
+    if den <= 0:
+        return MAX_NODE_SCORE  # weightSum==0 branch returns MaxNodeScore
+    return num // den
+
+
+def scarce_resource_score(pod_request, node_allocatable, scarce) -> int:
+    # scarceresourceavoidance scarce_resource_avoidance.go:89,158
+    diff = [
+        i
+        for i in range(len(pod_request))
+        if node_allocatable[i] > 0 and pod_request[i] <= 0
+    ]
+    inter = [i for i in diff if scarce[i]]
+    if not diff or not inter:
+        return MAX_NODE_SCORE
+    return (len(diff) - len(inter)) * MAX_NODE_SCORE // len(diff)
+
+
+def _round_half_up(x: float) -> int:
+    # Go math.Round = half away from zero; operands here are non-negative.
+    import math
+
+    return math.floor(x + 0.5)
+
+
+def usage_threshold_ok(est_used, total, thresholds) -> bool:
+    # loadaware/load_aware.go:150,320-345: round(est*100/total) > threshold -> reject
+    for i, value in enumerate(thresholds):
+        if value <= 0 or total[i] == 0:
+            continue
+        usage = _round_half_up(est_used[i] / total[i] * 100)
+        if usage > value:
+            return False
+    return True
+
+
+def estimate_pod_usage(request, factors, defaults) -> list[int]:
+    # loadaware/estimator/default_estimator.go:74-121
+    out = []
+    for i, r in enumerate(request):
+        if r == 0 and defaults[i] > 0:
+            out.append(defaults[i])
+        else:
+            out.append(_round_half_up(r * factors[i] / 100))
+    return out

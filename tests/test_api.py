@@ -1,0 +1,59 @@
+import numpy as np
+
+from koordinator_tpu.api.priority import (
+    PriorityClass,
+    priority_band_tensor,
+    priority_class_of,
+)
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.api.resources import (
+    NUM_RESOURCE_DIMS,
+    ResourceDim,
+    resource_vector,
+    stack_vectors,
+)
+
+
+def test_qos_parse():
+    assert QoSClass.parse("LS") is QoSClass.LS
+    assert QoSClass.parse("lse") is QoSClass.LSE
+    assert QoSClass.parse("") is QoSClass.NONE
+    assert QoSClass.parse("bogus") is QoSClass.NONE
+    assert QoSClass.BE.is_best_effort
+    assert QoSClass.LSR.is_latency_sensitive
+    assert not QoSClass.BE.is_latency_sensitive
+
+
+def test_priority_bands_scalar():
+    assert priority_class_of(9500) is PriorityClass.PROD
+    assert priority_class_of(9000) is PriorityClass.PROD
+    assert priority_class_of(9999) is PriorityClass.PROD
+    assert priority_class_of(7500) is PriorityClass.MID
+    assert priority_class_of(5500) is PriorityClass.BATCH
+    assert priority_class_of(3000) is PriorityClass.FREE
+    assert priority_class_of(0) is PriorityClass.NONE
+    assert priority_class_of(8000) is PriorityClass.NONE
+
+
+def test_priority_bands_tensor_matches_scalar():
+    import jax.numpy as jnp
+
+    vals = np.array([9500, 7000, 5999, 3500, 123, 8000, 9999], dtype=np.int32)
+    bands = priority_band_tensor(jnp.asarray(vals))
+    expect = [int(priority_class_of(int(v))) for v in vals]
+    assert list(np.asarray(bands)) == expect
+
+
+def test_resource_vector():
+    v = resource_vector({"cpu": 4000, "memory": 8192})
+    assert v[ResourceDim.CPU] == 4000
+    assert v[ResourceDim.MEMORY] == 8192
+    assert v.sum() == 12192
+
+    v2 = resource_vector(cpu=1000, gpu=2000)
+    assert v2[ResourceDim.GPU] == 2000
+
+    m = stack_vectors([v, v2], capacity=8)
+    assert m.shape == (8, NUM_RESOURCE_DIMS)
+    assert m[1, ResourceDim.CPU] == 1000
+    assert (m[2:] == 0).all()

@@ -1,0 +1,76 @@
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+from koordinator_tpu.ops import filtering
+from tests import oracle
+
+R = NUM_RESOURCE_DIMS
+RNG = np.random.default_rng(1)
+
+
+def test_fit_mask_basic():
+    free = jnp.asarray(np.array([[1000, 2048] + [0] * (R - 2),
+                                 [500, 4096] + [0] * (R - 2)], np.int32))
+    req = jnp.asarray(np.array([[600, 1024] + [0] * (R - 2),
+                                [600, 3000] + [0] * (R - 2),
+                                [0, 0] + [0] * (R - 2)], np.int32))
+    m = np.asarray(filtering.fit_mask(free, req))
+    assert m.tolist() == [
+        [True, False],   # cpu fits node0 only
+        [False, False],  # cpu too big for node1, mem too big for node0
+        [True, True],    # zero request fits everywhere
+    ]
+
+
+def test_fit_mask_ignores_unrequested_negative_free():
+    # batch allocatable can shrink below already-scheduled requests -> negative
+    # free on a dim the pod doesn't request must NOT exclude the node.
+    free = np.zeros((1, R), np.int32)
+    free[0, 0] = 1000
+    free[0, 6] = -500  # batch-cpu overdrawn
+    req = np.zeros((1, R), np.int32)
+    req[0, 0] = 500
+    m = np.asarray(filtering.fit_mask(jnp.asarray(free), jnp.asarray(req)))
+    assert m[0, 0]
+
+
+def test_usage_threshold_rounding_parity():
+    # The reference compares round(est*100/total) > threshold; check the exact
+    # rounding boundary: 655/1000 -> 66 > 65 rejected, 654/1000 -> 65 passes.
+    total = jnp.asarray(np.array([[1000] + [0] * (R - 1)], np.int32))
+    thresholds = jnp.asarray(np.array([65] + [0] * (R - 1), np.int32))
+    for est, want in ((640, True), (654, True), (655, False), (651, True), (700, False)):
+        usage = jnp.asarray(np.array([[est] + [0] * (R - 1)], np.int32))
+        got = bool(np.asarray(filtering.usage_threshold_mask(usage, total, thresholds))[0])
+        assert got == want, (est, got)
+
+
+def test_usage_threshold_random_parity():
+    n = 300
+    total = RNG.integers(0, 100_000, size=(n, R)).astype(np.int32)
+    total[RNG.random((n, R)) < 0.15] = 0
+    usage = (total * RNG.random((n, R)) * 1.2).astype(np.int32)
+    thresholds = np.array([65, 95, 0, 80, 0, 0, 50, 0, 0, 0], np.int32)[:R]
+    got = np.asarray(
+        filtering.usage_threshold_mask(
+            jnp.asarray(usage), jnp.asarray(total), jnp.asarray(thresholds)
+        )
+    )
+    for i in range(n):
+        assert got[i] == oracle.usage_threshold_ok(
+            usage[i].tolist(), total[i].tolist(), thresholds.tolist()
+        ), i
+
+
+def test_usage_threshold_with_pod_estimates():
+    total = jnp.asarray(np.array([[1000] + [0] * (R - 1)], np.int32))
+    usage = jnp.asarray(np.array([[500] + [0] * (R - 1)], np.int32))
+    thresholds = jnp.asarray(np.array([65] + [0] * (R - 1), np.int32))
+    pod_est = jnp.asarray(np.array([[100] + [0] * (R - 1),
+                                    [200] + [0] * (R - 1)], np.int32))
+    got = np.asarray(
+        filtering.usage_threshold_mask(usage, total, thresholds, pod_est)
+    )
+    # 600/1000 = 60 <= 65 ok; 700/1000 = 70 > 65 reject
+    assert got[:, 0].tolist() == [True, False]

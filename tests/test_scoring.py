@@ -1,0 +1,145 @@
+"""Integer-parity tests: JAX kernels vs pure-Python oracles on random fixtures."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+from koordinator_tpu.ops import scoring
+from tests import oracle
+
+R = NUM_RESOURCE_DIMS
+RNG = np.random.default_rng(0)
+
+
+def rand_alloc(n):
+    a = RNG.integers(0, 100_000, size=(n, R)).astype(np.int32)
+    a[RNG.random((n, R)) < 0.2] = 0  # some zero-capacity dims
+    return a
+
+
+def test_least_used_score_parity():
+    cap = rand_alloc(200)
+    used = (cap * RNG.random((200, R))).astype(np.int32)
+    used[RNG.random((200, R)) < 0.1] += 1_000_000  # some over-capacity
+    got = np.asarray(scoring.least_used_score(jnp.asarray(used), jnp.asarray(cap)))
+    for i in range(200):
+        for j in range(R):
+            assert got[i, j] == oracle.least_used_score(int(used[i, j]), int(cap[i, j]))
+
+
+def test_loadaware_score_parity():
+    n = 100
+    cap = rand_alloc(n)
+    used = (cap * RNG.random((n, R))).astype(np.int32)
+    weights = np.zeros(R, np.int32)
+    weights[0], weights[1], weights[3] = 1, 2, 3
+    for dw in (0, 1, 4):
+        got = np.asarray(
+            scoring.loadaware_score(
+                jnp.asarray(used), jnp.asarray(cap), jnp.asarray(weights), dw
+            )
+        )
+        for i in range(n):
+            assert got[i] == oracle.loadaware_score(
+                used[i].tolist(), cap[i].tolist(), weights.tolist(), dw
+            ), (i, dw)
+
+
+def test_fitplus_score_parity():
+    n, p = 50, 20
+    cap = rand_alloc(n)
+    # some over-requested nodes exercise mostRequestedScore's clamp branch
+    req_node = (cap * RNG.random((n, R)) * 1.4).astype(np.int32)
+    pod_req = RNG.integers(0, 30_000, size=(p, R)).astype(np.int32)
+    pod_req[RNG.random((p, R)) < 0.5] = 0
+    pod_req[0] = 0          # all-zero request -> weightSum==0 -> MaxNodeScore
+    pod_req[1, :2] = 0
+    pod_req[1, 4] = 5_000   # only a zero-weight dim requested -> MaxNodeScore
+    weights = np.array([1, 1, 2, 3, 0, 1, 0, 0, 0, 0], np.int32)[:R]
+    most = np.zeros(R, bool)
+    most[3] = True
+    got = np.asarray(
+        scoring.fitplus_score(
+            jnp.asarray(req_node), jnp.asarray(cap), jnp.asarray(pod_req),
+            jnp.asarray(weights), jnp.asarray(most),
+        )
+    )
+    for i in range(p):
+        for j in range(n):
+            assert got[i, j] == oracle.fitplus_score(
+                req_node[j].tolist(), cap[j].tolist(), pod_req[i].tolist(),
+                weights.tolist(), most.tolist(),
+            ), (i, j)
+
+
+def test_scarce_resource_score_parity():
+    n, p = 40, 15
+    cap = rand_alloc(n)
+    pod_req = RNG.integers(0, 10_000, size=(p, R)).astype(np.int32)
+    pod_req[RNG.random((p, R)) < 0.6] = 0
+    scarce = np.zeros(R, bool)
+    scarce[3], scarce[5] = True, True
+    got = np.asarray(
+        scoring.scarce_resource_score(
+            jnp.asarray(pod_req), jnp.asarray(cap), jnp.asarray(scarce)
+        )
+    )
+    for i in range(p):
+        for j in range(n):
+            assert got[i, j] == oracle.scarce_resource_score(
+                pod_req[i].tolist(), cap[j].tolist(), scarce.tolist()
+            ), (i, j)
+
+
+def test_most_requested_score_clamps_overcommit():
+    got = scoring.most_requested_score(
+        jnp.asarray(np.array([1500, 500, 0], np.int32)),
+        jnp.asarray(np.array([1000, 1000, 0], np.int32)),
+    )
+    assert np.asarray(got).tolist() == [100, 50, 0]
+
+
+def test_estimate_by_band_translates_batch_requests():
+    # A batch pod requesting batch-cpu/batch-memory must estimate PHYSICAL
+    # cpu/memory usage (TranslateResourceNameByPriorityClass semantics).
+    from koordinator_tpu.api.resources import ResourceDim
+
+    req = np.zeros((2, R), np.int32)
+    req[0, ResourceDim.BATCH_CPU] = 1000
+    req[0, ResourceDim.BATCH_MEMORY] = 2048
+    # pod 1 requests nothing -> defaults apply to physical dims only
+    factors = np.full(R, 100, np.int32)
+    factors[ResourceDim.CPU] = 85
+    factors[ResourceDim.MEMORY] = 70
+    defaults = np.zeros(R, np.int32)
+    defaults[ResourceDim.CPU] = 250
+    defaults[ResourceDim.MEMORY] = 200
+    got = np.asarray(
+        scoring.estimate_pod_usage_by_band(
+            jnp.asarray(req), jnp.asarray(factors), jnp.asarray(defaults)
+        )
+    )
+    assert got[0, ResourceDim.CPU] == 850        # round(1000*85/100)
+    assert got[0, ResourceDim.MEMORY] == 1434    # round(2048*70/100) = 1433.6
+    assert got[0, ResourceDim.BATCH_CPU] == 0    # no double count in batch dims
+    assert got[1, ResourceDim.CPU] == 250        # defaults
+    assert got[1, ResourceDim.MEMORY] == 200
+
+
+def test_estimate_pod_usage_parity():
+    p = 100
+    req = RNG.integers(0, 50_000, size=(p, R)).astype(np.int32)
+    req[RNG.random((p, R)) < 0.4] = 0
+    factors = np.full(R, 100, np.int32)
+    factors[0], factors[1] = 85, 70
+    defaults = np.zeros(R, np.int32)
+    defaults[0], defaults[1] = 250, 200
+    got = np.asarray(
+        scoring.estimate_pod_usage(
+            jnp.asarray(req), jnp.asarray(factors), jnp.asarray(defaults)
+        )
+    )
+    for i in range(p):
+        assert got[i].tolist() == oracle.estimate_pod_usage(
+            req[i].tolist(), factors.tolist(), defaults.tolist()
+        ), i
