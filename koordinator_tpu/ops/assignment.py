@@ -27,6 +27,7 @@ from flax import struct
 
 from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
 from koordinator_tpu.ops import filtering, scoring
+from koordinator_tpu.quota.admission import charge_quota, quota_admission_mask
 from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
 
 
@@ -157,13 +158,21 @@ def score_pods(
 
 
 def greedy_assign(
-    state: ClusterState, pods: PodBatch, cfg: ScoringConfig
-) -> tuple[jnp.ndarray, ClusterState]:
+    state: ClusterState,
+    pods: PodBatch,
+    cfg: ScoringConfig,
+    quota=None,
+):
     """Assign a whole pending batch sequentially in priority order.
 
-    Returns (assignments, new_state): assignments is (P,) int32 node index per
-    pod (original batch order), -1 = unschedulable; new_state carries the
-    updated node_requested accounting (Reserve semantics).
+    Returns (assignments, new_state) — or (assignments, new_state, new_quota)
+    when a :class:`~koordinator_tpu.quota.QuotaDeviceState` is given, in which
+    case each pod must also pass the elastic-quota admission check and
+    Reserve-time quota accounting feeds back within the batch.
+
+    assignments is (P,) int32 node index per pod (original batch order),
+    -1 = unschedulable; new_state carries the updated node_requested
+    accounting (Reserve semantics).
 
     Determinism: ties break toward the lowest node index (the reference's
     selectHost randomizes among maxima; we fix the choice for reproducibility).
@@ -178,7 +187,7 @@ def greedy_assign(
         # est_added accumulates in-flight pods' estimated usage (the
         # reference's pod-assign cache) on top of whichever usage base the
         # threshold policy selects.
-        requested, est_added = carry
+        requested, est_added, qstate = carry
         req = pods.requests[idx]          # (R,)
         pod_est = pod_est_all[idx]        # (R,)
         valid = pods.valid[idx]
@@ -200,6 +209,12 @@ def greedy_assign(
             & state.node_valid
             & valid
         )
+        if qstate is not None:
+            admitted = quota_admission_mask(
+                qstate, req[None, :], pods.quota_id[idx][None],
+                pods.non_preemptible[idx][None],
+            )[0]
+            feasible = feasible & admitted
 
         scores = _composite_score(
             cfg, state.node_allocatable, requested,
@@ -215,10 +230,20 @@ def greedy_assign(
         add_est = jnp.where(assigned, pod_est, 0)
         requested = requested.at[best].add(add)
         est_added = est_added.at[best].add(add_est)
-        return (requested, est_added), node
+        if qstate is not None:
+            qstate = charge_quota(
+                qstate, add, jnp.where(assigned, pods.quota_id[idx], -1),
+                non_preemptible=pods.non_preemptible[idx],
+            )
+        return (requested, est_added, qstate), node
 
-    (requested, _), nodes_in_order = jax.lax.scan(
-        step, (state.node_requested, jnp.zeros_like(state.node_usage)), order
+    (requested, _, new_quota), nodes_in_order = jax.lax.scan(
+        step,
+        (state.node_requested, jnp.zeros_like(state.node_usage), quota),
+        order,
     )
     assignments = jnp.full(pods.capacity, -1, jnp.int32).at[order].set(nodes_in_order)
-    return assignments, state.replace(node_requested=requested)
+    new_state = state.replace(node_requested=requested)
+    if quota is None:
+        return assignments, new_state
+    return assignments, new_state, new_quota

@@ -72,6 +72,7 @@ def shard_pod_batch(pods, mesh: Mesh):
         qos=jax.device_put(pods.qos, ps),
         gang_id=jax.device_put(pods.gang_id, ps),
         quota_id=jax.device_put(pods.quota_id, ps),
+        non_preemptible=jax.device_put(pods.non_preemptible, ps),
         valid=jax.device_put(pods.valid, ps),
         feasible=jax.device_put(pods.feasible, ms),
     )

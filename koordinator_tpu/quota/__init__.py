@@ -1,0 +1,20 @@
+"""Hierarchical elastic quota: min/max tree, fair-share runtime, admission.
+
+Mirrors the reference's elasticquota core (SURVEY.md section 2.4):
+
+- ``tree``      -- the quota tree + runtime redistribution (water-filling with
+                   Hamilton largest-remainder apportionment), exact integer
+                   math on the host (control-plane cadence, like the
+                   reference's GroupQuotaManager).
+- ``admission`` -- the scheduling-hot-path admission check as a device kernel
+                   over precomputed ancestor-chain headroom tensors.
+"""
+
+from koordinator_tpu.quota.tree import QuotaTree
+from koordinator_tpu.quota.admission import (
+    QuotaDeviceState,
+    quota_admission_mask,
+    charge_quota,
+)
+
+__all__ = ["QuotaTree", "QuotaDeviceState", "quota_admission_mask", "charge_quota"]

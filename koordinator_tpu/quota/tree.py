@@ -1,0 +1,247 @@
+"""The quota tree and its fair-share runtime calculation (host side, exact).
+
+Semantics ported from the reference's
+``pkg/scheduler/plugins/elasticquota/core/runtime_quota_calculator.go``:
+
+- ``redistribution`` (:119): each child's runtime starts at
+  autoScaleMin = max(min, guarantee) if it requests more than that, else at its
+  request (or autoScaleMin when the group refuses to lend, allowLentResource
+  false). The remaining parent resource is then water-filled over the
+  still-hungry children proportionally to sharedWeight, iterating as children
+  saturate at their request.
+- ``computeHamiltonDeltas`` (:194): each round's pool splits by the largest-
+  remainder (Hamilton) method — base_i = floor(w_i * pool / W), then +1 to the
+  largest remainders (ties by quota name ascending) until the residual is gone,
+  so every round conserves the pool exactly.
+
+The reference does this in int64 with 128-bit intermediates (bits.Mul64);
+Python integers are arbitrary-precision, so the math here is exactly
+equivalent. This runs at control-plane cadence (quota/request changes), not in
+the scheduling hot path — matching the reference, where GroupQuotaManager
+caches runtimeQuota between updates. The hot-path admission check runs on
+device via :mod:`koordinator_tpu.quota.admission`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+
+#: "no limit" sentinel for max (reference: resource absent from Max means
+#: unbounded and unchecked at admission).
+UNBOUNDED = -1
+
+ROOT = "root"
+
+
+@dataclasses.dataclass
+class QuotaNode:
+    name: str
+    parent: str
+    min: np.ndarray            # (R,) int64
+    max: np.ndarray            # (R,) int64, UNBOUNDED = no cap
+    shared_weight: np.ndarray  # (R,) int64; defaults to max (reference default)
+    guarantee: np.ndarray      # (R,) int64
+    allow_lent: bool = True
+    # computed:
+    request: np.ndarray = None         # (R,) raw request (pods or children)
+    limited_request: np.ndarray = None # (R,) min(request, max)
+    runtime: np.ndarray = None         # (R,)
+    used: np.ndarray = None            # (R,)
+    non_preemptible_used: np.ndarray = None
+
+    def __post_init__(self):
+        z = np.zeros(NUM_RESOURCE_DIMS, dtype=np.int64)
+        for f in ("request", "limited_request", "runtime", "used",
+                  "non_preemptible_used"):
+            if getattr(self, f) is None:
+                setattr(self, f, z.copy())
+
+
+def hamilton_deltas(
+    pool: int, total_weight: int, weights: list[int], names: list[str]
+) -> list[int]:
+    """Largest-remainder split of ``pool`` proportional to ``weights``.
+
+    Exact parity with computeHamiltonDeltas (:194): zero-weight entries get
+    nothing; residual +1s go to the largest remainders, ties by name asc.
+    """
+    n = len(weights)
+    deltas = [0] * n
+    if total_weight <= 0 or pool <= 0 or n == 0:
+        return deltas
+    remainders = []
+    distributed = 0
+    for i, w in enumerate(weights):
+        if w <= 0:
+            continue
+        prod = w * pool  # arbitrary precision == the reference's 128-bit path
+        base, rem = divmod(prod, total_weight)
+        deltas[i] = base
+        distributed += base
+        remainders.append((i, rem, names[i]))
+    residual = pool - distributed
+    if residual <= 0 or not remainders:
+        return deltas
+    remainders.sort(key=lambda e: (-e[1], e[2]))
+    for i in range(min(residual, len(remainders))):
+        deltas[remainders[i][0]] += 1
+    return deltas
+
+
+class QuotaTree:
+    """Hierarchical quota tree with koordinator's runtime semantics."""
+
+    def __init__(self, total_resource: np.ndarray):
+        self.total_resource = np.asarray(total_resource, dtype=np.int64)
+        self.nodes: dict[str, QuotaNode] = {}
+        self.children: dict[str, list[str]] = {ROOT: []}
+
+    def add(
+        self,
+        name: str,
+        min: np.ndarray,
+        max: np.ndarray,
+        parent: str = ROOT,
+        shared_weight: np.ndarray | None = None,
+        guarantee: np.ndarray | None = None,
+        allow_lent: bool = True,
+    ) -> None:
+        if name in self.nodes or name == ROOT:
+            raise ValueError(f"quota {name!r} already exists")
+        if parent != ROOT and parent not in self.nodes:
+            raise ValueError(f"parent quota {parent!r} not found")
+        mn = np.asarray(min, dtype=np.int64)
+        mx = np.asarray(max, dtype=np.int64)
+        # sharedWeight defaults to max (reference: GetSharedWeight falls back
+        # to Max when the annotation is absent); UNBOUNDED dims weigh as the
+        # cluster total.
+        if shared_weight is None:
+            sw = np.where(mx == UNBOUNDED, self.total_resource, mx)
+        else:
+            sw = np.asarray(shared_weight, dtype=np.int64)
+        g = (np.zeros(NUM_RESOURCE_DIMS, np.int64) if guarantee is None
+             else np.asarray(guarantee, dtype=np.int64))
+        self.nodes[name] = QuotaNode(
+            name=name, parent=parent, min=mn, max=mx,
+            shared_weight=sw, guarantee=g, allow_lent=allow_lent,
+        )
+        self.children.setdefault(name, [])
+        self.children[parent].append(name)
+
+    def set_request(self, name: str, request: np.ndarray) -> None:
+        """Set a leaf quota's raw pod-request sum."""
+        self.nodes[name].request = np.asarray(request, dtype=np.int64)
+
+    def set_used(self, name: str, used: np.ndarray,
+                 non_preemptible: np.ndarray | None = None) -> None:
+        self.nodes[name].used = np.asarray(used, dtype=np.int64)
+        if non_preemptible is not None:
+            self.nodes[name].non_preemptible_used = np.asarray(
+                non_preemptible, dtype=np.int64
+            )
+
+    # -- request aggregation ------------------------------------------------
+
+    def aggregate_requests(self) -> None:
+        """limitedRequest = min(request, max) per node; parents' request =
+        sum of children's limitedRequest (reference groupReqLimit model)."""
+        for name in self._topo_order(reverse=True):
+            node = self.nodes[name]
+            kids = self.children[name]
+            if kids:
+                node.request = np.sum(
+                    [self.nodes[k].limited_request for k in kids], axis=0,
+                    dtype=np.int64,
+                )
+            node.limited_request = np.where(
+                node.max == UNBOUNDED, node.request,
+                np.minimum(node.request, node.max),
+            )
+
+    # -- runtime ------------------------------------------------------------
+
+    def refresh_runtime(self) -> None:
+        """Recompute every node's runtime, top-down."""
+        self.aggregate_requests()
+        self._redistribute(self.children[ROOT], self.total_resource)
+        for name in self._topo_order():
+            kids = self.children[name]
+            if kids:
+                self._redistribute(kids, self.nodes[name].runtime)
+
+    def _redistribute(self, names: list[str], total: np.ndarray) -> None:
+        """redistribution() (:119) independently per resource dimension."""
+        # deterministic order = name asc (map iteration in Go is unordered but
+        # Hamilton ties are name-broken; we sort for reproducibility)
+        names = sorted(names)
+        for node in (self.nodes[n] for n in names):
+            node.runtime = np.zeros(NUM_RESOURCE_DIMS, dtype=np.int64)
+        for dim in range(NUM_RESOURCE_DIMS):
+            self._redistribute_dim(names, int(total[dim]), dim)
+
+    def _redistribute_dim(self, names: list[str], total: int, dim: int) -> None:
+        to_partition = total
+        hungry: list[QuotaNode] = []
+        total_weight = 0
+        for node in (self.nodes[n] for n in names):
+            auto_min = max(int(node.min[dim]), int(node.guarantee[dim]))
+            request = int(node.limited_request[dim])
+            if request > auto_min:
+                hungry.append(node)
+                total_weight += int(node.shared_weight[dim])
+                node.runtime[dim] = auto_min
+            else:
+                node.runtime[dim] = request if node.allow_lent else auto_min
+            to_partition -= int(node.runtime[dim])
+        if to_partition > 0:
+            self._iterate_dim(to_partition, total_weight, hungry, dim)
+
+    def _iterate_dim(
+        self, pool: int, total_weight: int, nodes: list[QuotaNode], dim: int
+    ) -> None:
+        while pool > 0 and total_weight > 0 and nodes:
+            deltas = hamilton_deltas(
+                pool, total_weight,
+                [int(n.shared_weight[dim]) for n in nodes],
+                [n.name for n in nodes],
+            )
+            still_hungry: list[QuotaNode] = []
+            next_weight = 0
+            returned = 0
+            for node, delta in zip(nodes, deltas):
+                node.runtime[dim] += delta
+                request = int(node.limited_request[dim])
+                if node.runtime[dim] < request:
+                    still_hungry.append(node)
+                    next_weight += int(node.shared_weight[dim])
+                else:
+                    returned += int(node.runtime[dim]) - request
+                    node.runtime[dim] = request
+            pool, total_weight, nodes = returned, next_weight, still_hungry
+
+    # -- traversal ----------------------------------------------------------
+
+    def _topo_order(self, reverse: bool = False) -> Iterable[str]:
+        order: list[str] = []
+        stack = list(self.children[ROOT])
+        while stack:
+            name = stack.pop()
+            order.append(name)
+            stack.extend(self.children[name])
+        return reversed(order) if reverse else order
+
+    def ancestors(self, name: str, include_self: bool = True) -> list[str]:
+        chain = [name] if include_self else []
+        cur = self.nodes[name].parent
+        while cur != ROOT:
+            chain.append(cur)
+            cur = self.nodes[cur].parent
+        return chain
+
+    def runtime_of(self, name: str) -> np.ndarray:
+        return self.nodes[name].runtime

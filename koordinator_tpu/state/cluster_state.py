@@ -153,6 +153,7 @@ class PodBatch:
     qos: jax.Array         # (P,) int8  — QoSClass codes
     gang_id: jax.Array     # (P,) int32 — gang index, -1 = not in a gang
     quota_id: jax.Array    # (P,) int32 — elastic-quota index, -1 = none
+    non_preemptible: jax.Array  # (P,) bool — checks/consumes quota min
     valid: jax.Array       # (P,) bool
     feasible: jax.Array    # (P, N) bool — host-computed placement mask
                            # (node/pod affinity, taints/tolerations, nodeSelector)
@@ -169,6 +170,7 @@ class PodBatch:
         qos: np.ndarray | None = None,
         gang_id: np.ndarray | None = None,
         quota_id: np.ndarray | None = None,
+        non_preemptible: np.ndarray | None = None,
         feasible: np.ndarray | None = None,
         node_capacity: int = 64,
         capacity: int | None = None,
@@ -201,6 +203,7 @@ class PodBatch:
             qos=pad1(qos, 0, np.int8),
             gang_id=pad1(gang_id, -1, np.int32),
             quota_id=pad1(quota_id, -1, np.int32),
+            non_preemptible=pad1(non_preemptible, False, bool),
             valid=jnp.asarray(valid),
             feasible=jnp.asarray(feas),
         )
