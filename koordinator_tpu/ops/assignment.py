@@ -165,10 +165,10 @@ def greedy_assign(
 ):
     """Assign a whole pending batch sequentially in priority order.
 
-    Returns (assignments, new_state) — or (assignments, new_state, new_quota)
-    when a :class:`~koordinator_tpu.quota.QuotaDeviceState` is given, in which
-    case each pod must also pass the elastic-quota admission check and
-    Reserve-time quota accounting feeds back within the batch.
+    Returns (assignments, new_state, new_quota). new_quota is None unless a
+    :class:`~koordinator_tpu.quota.QuotaDeviceState` is given, in which case
+    each pod must also pass the elastic-quota admission check and Reserve-time
+    quota accounting feeds back within the batch.
 
     assignments is (P,) int32 node index per pod (original batch order),
     -1 = unschedulable; new_state carries the updated node_requested
@@ -244,6 +244,4 @@ def greedy_assign(
     )
     assignments = jnp.full(pods.capacity, -1, jnp.int32).at[order].set(nodes_in_order)
     new_state = state.replace(node_requested=requested)
-    if quota is None:
-        return assignments, new_state
     return assignments, new_state, new_quota

@@ -15,6 +15,13 @@ from koordinator_tpu.quota.admission import (
     QuotaDeviceState,
     quota_admission_mask,
     charge_quota,
+    charge_quota_batch,
 )
 
-__all__ = ["QuotaTree", "QuotaDeviceState", "quota_admission_mask", "charge_quota"]
+__all__ = [
+    "QuotaTree",
+    "QuotaDeviceState",
+    "quota_admission_mask",
+    "charge_quota",
+    "charge_quota_batch",
+]

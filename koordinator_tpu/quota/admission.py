@@ -51,6 +51,8 @@ class QuotaDeviceState:
         names = sorted(tree.nodes)
         q = len(names)
         cap = capacity if capacity is not None else max(8, 1 << (q - 1).bit_length() if q else 3)
+        if cap < q:
+            raise ValueError(f"capacity {cap} < {q} quotas in tree")
         index = {n: i for i, n in enumerate(names)}
 
         headroom = np.zeros((cap, NUM_RESOURCE_DIMS), np.int32)
@@ -126,6 +128,43 @@ def quota_admission_mask(
     return ok | (pod_quota_id < 0)
 
 
+def charge_quota_batch(
+    quota: QuotaDeviceState,
+    requests: jnp.ndarray,        # (P, R) int32
+    quota_ids: jnp.ndarray,       # (P,) int32, -1 = no-op
+    mask: jnp.ndarray,            # (P,) bool — which pods actually charge
+    non_preemptible: jnp.ndarray, # (P,) bool
+    sign: int = 1,
+) -> QuotaDeviceState:
+    """Reserve/Unreserve accounting for a pod batch in one scatter.
+
+    Subtracts (sign=1) or returns (sign=-1) each masked pod's request from
+    every ancestor's headroom; non-preemptible pods additionally consume their
+    own quota's min headroom (the reference updates NonPreemptibleUsed
+    alongside Used)."""
+    qid = jnp.maximum(quota_ids, 0)
+    chain = quota.chain[qid]                  # (P, D)
+    active = (
+        (chain >= 0)
+        & (quota_ids >= 0)[:, None]
+        & mask[:, None]
+        & quota.valid[qid][:, None]
+    )
+    safe = jnp.maximum(chain, 0)              # (P, D)
+    delta = jnp.where(
+        active[:, :, None], -sign * requests[:, None, :], 0
+    )  # (P, D, R)
+    headroom = quota.headroom.at[safe.reshape(-1)].add(
+        delta.reshape(-1, requests.shape[-1])
+    )
+    np_active = (
+        mask & (quota_ids >= 0) & non_preemptible & quota.valid[qid]
+    )
+    min_delta = jnp.where(np_active[:, None], -sign * requests, 0)
+    min_headroom = quota.min_headroom.at[qid].add(min_delta)
+    return quota.replace(headroom=headroom, min_headroom=min_headroom)
+
+
 def charge_quota(
     quota: QuotaDeviceState,
     request: jnp.ndarray,    # (R,) int32
@@ -133,19 +172,12 @@ def charge_quota(
     sign: int = 1,
     non_preemptible: jnp.ndarray | bool = False,
 ) -> QuotaDeviceState:
-    """Reserve/Unreserve accounting: subtract (sign=1) or return (sign=-1) the
-    request from every ancestor's headroom; non-preemptible pods additionally
-    consume the pod's own quota's min headroom (the reference updates
-    NonPreemptibleUsed alongside Used)."""
-    qid = jnp.maximum(quota_id, 0)
-    chain = quota.chain[qid]                       # (D,)
-    active = (chain >= 0) & (quota_id >= 0) & quota.valid[qid]
-    safe = jnp.maximum(chain, 0)
-    delta = jnp.where(active[:, None], -sign * request[None, :], 0)  # (D, R)
-    min_delta = jnp.where(
-        active[0] & jnp.asarray(non_preemptible), -sign * request, 0
-    )
-    return quota.replace(
-        headroom=quota.headroom.at[safe].add(delta),
-        min_headroom=quota.min_headroom.at[qid].add(min_delta),
+    """Single-pod convenience wrapper over :func:`charge_quota_batch`."""
+    return charge_quota_batch(
+        quota,
+        request[None, :],
+        quota_id[None],
+        jnp.ones((1,), bool),
+        jnp.asarray(non_preemptible)[None],
+        sign=sign,
     )

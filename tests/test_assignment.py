@@ -71,7 +71,7 @@ def test_greedy_assign_capacity_feedback():
     state = ClusterState.from_arrays(alloc)
     req, _ = mk_pods((700, 1_024), (700, 1_024))
     pods = PodBatch.build(req, node_capacity=state.capacity)
-    assignments, new_state = jax.jit(greedy_assign)(state, pods, plain_config())
+    assignments, new_state, _ = jax.jit(greedy_assign)(state, pods, plain_config())
     a = np.asarray(assignments)[:2]
     assert set(a.tolist()) == {0, 1}
     assert np.asarray(new_state.node_requested)[:2, CPU].tolist() == [700, 700]
@@ -86,7 +86,7 @@ def test_greedy_assign_priority_order():
     state = ClusterState.from_arrays(alloc, usage=usage)
     req, prio = mk_pods((9_000, 1_024), (9_000, 1_024), priority=[5500, 9500])
     pods = PodBatch.build(req, priority=prio, node_capacity=state.capacity)
-    assignments, _ = greedy_assign(state, pods, plain_config())
+    assignments, _, _ = greedy_assign(state, pods, plain_config())
     a = np.asarray(assignments)
     assert a[1] == 1  # prod pod got the emptier node
     assert a[0] == 0
@@ -97,7 +97,7 @@ def test_greedy_assign_unschedulable():
     state = ClusterState.from_arrays(alloc)
     req, _ = mk_pods((2_000, 512), (500, 512))
     pods = PodBatch.build(req, node_capacity=state.capacity)
-    assignments, _ = greedy_assign(state, pods, plain_config())
+    assignments, _, _ = greedy_assign(state, pods, plain_config())
     a = np.asarray(assignments)
     assert a[0] == -1
     assert a[1] == 0
@@ -111,7 +111,7 @@ def test_greedy_assign_respects_feasibility_mask():
     feasible = np.zeros((1, state.capacity), bool)
     feasible[0, 1] = True  # only node 1 allowed (e.g. nodeSelector)
     pods = PodBatch.build(req, feasible=feasible, node_capacity=state.capacity)
-    assignments, _ = greedy_assign(state, pods, plain_config())
+    assignments, _, _ = greedy_assign(state, pods, plain_config())
     assert int(assignments[0]) == 1
 
 
@@ -127,7 +127,7 @@ def test_greedy_assign_threshold_feedback():
     )
     req, _ = mk_pods((200, 16), (200, 16))
     pods = PodBatch.build(req, node_capacity=state.capacity)
-    assignments, _ = greedy_assign(state, pods, cfg)
+    assignments, _, _ = greedy_assign(state, pods, cfg)
     a = np.asarray(assignments)[:2]
     # First pod: 600/1000 = 60 <= 65 ok. Second: 800/1000 = 80 > 65 rejected.
     assert a[0] == 0
@@ -170,6 +170,6 @@ def test_greedy_assign_deterministic():
     prio = rng.integers(3000, 9999, 32).astype(np.int32)
     pods = PodBatch.build(req, priority=prio, node_capacity=state.capacity)
     cfg = plain_config()
-    a1, _ = greedy_assign(state, pods, cfg)
-    a2, _ = greedy_assign(state, pods, cfg)
+    a1, _, _ = greedy_assign(state, pods, cfg)
+    a2, _, _ = greedy_assign(state, pods, cfg)
     assert np.array_equal(np.asarray(a1), np.asarray(a2))

@@ -48,11 +48,11 @@ def test_sharded_score_matches_unsharded():
 def test_sharded_greedy_assign_matches_unsharded():
     state, pods = build_problem()
     cfg = ScoringConfig.default()
-    a_ref, st_ref = jax.jit(greedy_assign)(state, pods, cfg)
+    a_ref, st_ref, _ = jax.jit(greedy_assign)(state, pods, cfg)
 
     mesh = pmesh.solver_mesh()  # all devices on the nodes axis
     sstate = pmesh.shard_cluster_state(state, mesh)
-    a_sh, st_sh = jax.jit(greedy_assign)(sstate, pods, cfg)
+    a_sh, st_sh, _ = jax.jit(greedy_assign)(sstate, pods, cfg)
 
     assert np.array_equal(np.asarray(a_ref), np.asarray(a_sh))
     assert np.array_equal(
