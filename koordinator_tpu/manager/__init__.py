@@ -1,0 +1,24 @@
+"""Central-controller math (the koord-manager equivalents).
+
+The reference's slo-controller reconcilers compute per-node results one node
+per Reconcile call; here the same formulas are tensor ops over every node at
+once, feeding the device-resident cluster state directly (and still exportable
+per node for protocol compatibility).
+
+- ``noderesource`` -- the colocation formulas: Batch/Mid allocatable,
+  safety margins, CPU normalization and node resource amplification.
+"""
+
+from koordinator_tpu.manager.noderesource import (
+    ColocationStrategy,
+    batch_allocatable,
+    mid_allocatable,
+    node_safety_margin,
+)
+
+__all__ = [
+    "ColocationStrategy",
+    "batch_allocatable",
+    "mid_allocatable",
+    "node_safety_margin",
+]

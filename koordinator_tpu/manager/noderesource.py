@@ -1,0 +1,200 @@
+"""Colocation resource math: Batch/Mid allocatable over the whole cluster.
+
+Semantics from the reference slo-controller
+(``pkg/slo-controller/noderesource/plugins/util/util.go``):
+
+- CalculateBatchResourceByPolicy (:56):
+    byUsage:           cap - margin - max(systemUsed, reserved) - hpUsed
+    byRequest:         cap - margin - reserved - hpRequest
+    byMaxUsageRequest: cap - margin - max(systemUsed, reserved) - hpMaxUsedReq
+  each clamped at 0, then optionally capped at cap * batchThresholdPercent.
+  CPU supports usage/maxUsageRequest; memory supports all three policies.
+- GetNodeSafetyMargin (:368): margin = cap * (100 - reclaimThresholdPercent)/100.
+- CalculateMidResourceByPolicy (:190):
+    mid = min( min(prodReclaimable, nodeUnused) + unallocated * midUnallocatedPercent,
+               cap * midThresholdPercent )
+  with negative reclaimable clamped to 0.
+
+All integer math; percent products stay within int32 because quantities are
+bounded by MAX_QUANTITY = 2^31/100 (state/cluster_state.py). Go multiplies in
+float64 and truncates — for operands this small the float64 product is exact,
+so integer ``(a*pct)//100`` is bit-identical.
+
+Every function takes (..., N) leading batch shapes, so per-NUMA-zone
+calculation (the reference's zone-aware batch resource) is the same call with
+a (N, Z, R)-shaped input.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+
+# CalculatePolicy codes (configuration.CalculatePolicy)
+POLICY_USAGE = 0
+POLICY_REQUEST = 1
+POLICY_MAX_USAGE_REQUEST = 2
+
+
+@struct.dataclass
+class ColocationStrategy:
+    """The slo-controller-config colocation strategy, tensor form.
+
+    Mirrors configuration.ColocationStrategy fields used by the resource
+    plugins; percentages are int32 scalars, 0-100 (a threshold of 100 = no
+    effective cap, matching nil semantics where noted).
+    """
+
+    cpu_reclaim_threshold_pct: jax.Array      # default 60
+    memory_reclaim_threshold_pct: jax.Array   # default 65
+    cpu_calculate_policy: jax.Array           # POLICY_USAGE | POLICY_MAX_USAGE_REQUEST
+    memory_calculate_policy: jax.Array        # any of the three
+    batch_cpu_threshold_pct: jax.Array        # 100 = nil (no cap)
+    batch_memory_threshold_pct: jax.Array     # 100 = nil (no cap)
+    mid_cpu_threshold_pct: jax.Array          # default 10
+    mid_memory_threshold_pct: jax.Array       # default 10
+    mid_unallocated_pct: jax.Array            # default 0
+
+    @classmethod
+    def default(cls) -> "ColocationStrategy":
+        i32 = lambda v: jnp.int32(v)
+        return cls(
+            cpu_reclaim_threshold_pct=i32(60),
+            memory_reclaim_threshold_pct=i32(65),
+            cpu_calculate_policy=i32(POLICY_USAGE),
+            memory_calculate_policy=i32(POLICY_USAGE),
+            batch_cpu_threshold_pct=i32(100),
+            batch_memory_threshold_pct=i32(100),
+            mid_cpu_threshold_pct=i32(10),
+            mid_memory_threshold_pct=i32(10),
+            mid_unallocated_pct=i32(0),
+        )
+
+
+def _pct(value: jnp.ndarray, pct: jnp.ndarray) -> jnp.ndarray:
+    """value * pct / 100 with exact integer truncation (see module docstring)."""
+    return value * pct // 100
+
+
+def node_safety_margin(
+    capacity_cpu: jnp.ndarray,
+    capacity_mem: jnp.ndarray,
+    strategy: ColocationStrategy,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(N,) safety margins: cap * (100 - reclaimThresholdPercent) / 100."""
+    return (
+        _pct(capacity_cpu, 100 - strategy.cpu_reclaim_threshold_pct),
+        _pct(capacity_mem, 100 - strategy.memory_reclaim_threshold_pct),
+    )
+
+
+def _batch_one_dim(
+    capacity, margin, reserved, system_used, hp_used, hp_req, hp_max_used_req,
+    policy, threshold_pct, allow_request_policy,
+):
+    """The three-policy batch formula for one resource dimension, (N,)."""
+    sys_or_reserved = jnp.maximum(system_used, reserved)
+    by_usage = jnp.maximum(capacity - margin - sys_or_reserved - hp_used, 0)
+    by_request = jnp.maximum(capacity - margin - reserved - hp_req, 0)
+    by_max = jnp.maximum(capacity - margin - sys_or_reserved - hp_max_used_req, 0)
+
+    alloc = by_usage
+    alloc = jnp.where(policy == POLICY_MAX_USAGE_REQUEST, by_max, alloc)
+    if allow_request_policy:
+        alloc = jnp.where(policy == POLICY_REQUEST, by_request, alloc)
+    return jnp.minimum(alloc, _pct(capacity, threshold_pct))
+
+
+def batch_allocatable(
+    capacity_cpu: jnp.ndarray,     # (..., N) node cpu capacity (mcores)
+    capacity_mem: jnp.ndarray,     # (..., N) node memory capacity (MiB)
+    system_used_cpu: jnp.ndarray,
+    system_used_mem: jnp.ndarray,
+    reserved_cpu: jnp.ndarray,     # max(node annotation, kubelet reserved)
+    reserved_mem: jnp.ndarray,
+    hp_used_cpu: jnp.ndarray,      # sum of Prod/Mid pods' usage
+    hp_used_mem: jnp.ndarray,
+    hp_req_cpu: jnp.ndarray,       # sum of Prod/Mid pods' requests
+    hp_req_mem: jnp.ndarray,
+    hp_max_used_req_cpu: jnp.ndarray,  # sum of per-pod max(request, usage)
+    hp_max_used_req_mem: jnp.ndarray,
+    strategy: ColocationStrategy,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(batch_cpu, batch_mem) allocatable, each (..., N).
+
+    Parity: CalculateBatchResourceByPolicy — cpu ignores the byRequest policy
+    (only usage/maxUsageRequest supported), memory supports all three.
+    """
+    margin_cpu, margin_mem = node_safety_margin(
+        capacity_cpu, capacity_mem, strategy
+    )
+    batch_cpu = _batch_one_dim(
+        capacity_cpu, margin_cpu, reserved_cpu, system_used_cpu,
+        hp_used_cpu, hp_req_cpu, hp_max_used_req_cpu,
+        strategy.cpu_calculate_policy, strategy.batch_cpu_threshold_pct,
+        allow_request_policy=False,
+    )
+    batch_mem = _batch_one_dim(
+        capacity_mem, margin_mem, reserved_mem, system_used_mem,
+        hp_used_mem, hp_req_mem, hp_max_used_req_mem,
+        strategy.memory_calculate_policy, strategy.batch_memory_threshold_pct,
+        allow_request_policy=True,
+    )
+    return batch_cpu, batch_mem
+
+
+def mid_allocatable(
+    capacity_cpu: jnp.ndarray,
+    capacity_mem: jnp.ndarray,
+    prod_reclaimable_cpu: jnp.ndarray,  # from the usage forecaster
+    prod_reclaimable_mem: jnp.ndarray,
+    node_unused_cpu: jnp.ndarray,
+    node_unused_mem: jnp.ndarray,
+    unallocated_cpu: jnp.ndarray,
+    unallocated_mem: jnp.ndarray,
+    strategy: ColocationStrategy,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(mid_cpu, mid_mem) allocatable, each (..., N).
+
+    Parity: CalculateMidResourceByPolicy —
+      min( clamp0(min(prodReclaimable, nodeUnused)) + unallocated * midUnallocatedPct,
+           cap * midThresholdPct ).
+    """
+    def one(reclaimable, unused, unallocated, cap, threshold_pct):
+        base = jnp.maximum(jnp.minimum(reclaimable, unused), 0)
+        base = base + _pct(unallocated, strategy.mid_unallocated_pct)
+        return jnp.minimum(base, _pct(cap, threshold_pct))
+
+    return (
+        one(prod_reclaimable_cpu, node_unused_cpu, unallocated_cpu,
+            capacity_cpu, strategy.mid_cpu_threshold_pct),
+        one(prod_reclaimable_mem, node_unused_mem, unallocated_mem,
+            capacity_mem, strategy.mid_memory_threshold_pct),
+    )
+
+
+def cpu_normalization(capacity_cpu: jnp.ndarray, ratio_pct: jnp.ndarray) -> jnp.ndarray:
+    """CPU normalization: scale node CPU capacity by a per-model benchmark
+    ratio (pkg/slo-controller/noderesource/plugins/cpunormalization).
+    ratio_pct is (N,) int32 percent (100 = 1.0)."""
+    return _pct(capacity_cpu, ratio_pct)
+
+
+def amplify_capacity(capacity: jnp.ndarray, amplification_pct: jnp.ndarray) -> jnp.ndarray:
+    """Node resource amplification (apis/extension/node_resource_amplification):
+    raw capacity scaled by an amplification ratio >= 100%."""
+    return _pct(capacity, amplification_pct)
+
+
+def update_batch_mid_in_state(state, batch_cpu, batch_mem, mid_cpu, mid_mem):
+    """Write computed Batch/Mid allocatable into the cluster-state tensors
+    (the NodeSync step that patches node.status.allocatable upstream)."""
+    alloc = state.node_allocatable
+    alloc = alloc.at[:, ResourceDim.BATCH_CPU].set(batch_cpu)
+    alloc = alloc.at[:, ResourceDim.BATCH_MEMORY].set(batch_mem)
+    alloc = alloc.at[:, ResourceDim.MID_CPU].set(mid_cpu)
+    alloc = alloc.at[:, ResourceDim.MID_MEMORY].set(mid_mem)
+    return state.replace(node_allocatable=alloc)

@@ -145,6 +145,8 @@ def gang_assign(
     is None when not given). ``passes`` > 1 re-solves leftover pods after
     failed-gang rollback so freed capacity is reclaimed within the batch.
     """
+    from koordinator_tpu.ops import scoring
+
     pre_ok = pre_enqueue_mask(pods, gangs)
     active_pods = pods.replace(valid=pods.valid & pre_ok)
 
@@ -152,12 +154,27 @@ def gang_assign(
     kept_so_far = jnp.zeros(pods.capacity, bool)
     cur_state = state
     cur_quota = quota
+    # Estimated usage of pods kept in earlier passes (the reference's
+    # pod-assign cache): later passes must filter/score against it, else they
+    # overcommit past the load thresholds a single-pass solve would enforce.
+    pod_est_all = scoring.estimate_pod_usage_by_band(
+        pods.requests, cfg.estimator_factors, cfg.estimator_defaults
+    )
+    est_accum = jnp.zeros_like(state.node_usage)
 
     for _ in range(passes):
-        a, _, _ = greedy_assign(cur_state, active_pods, cfg, cur_quota)
+        solve_state = cur_state.replace(
+            node_usage=cur_state.node_usage + est_accum,
+            node_agg_usage=cur_state.node_agg_usage + est_accum,
+        )
+        a, _, _ = greedy_assign(solve_state, active_pods, cfg, cur_quota)
 
         final, cur_state, keep, failed = rollback_failed_gangs(
             a, cur_state, active_pods, gangs, prior_kept=kept_so_far
+        )
+        node = jnp.where(keep, final, 0)
+        est_accum = est_accum.at[node].add(
+            jnp.where(keep[:, None], pod_est_all, 0)
         )
         if cur_quota is not None:
             cur_quota = charge_quota_batch(

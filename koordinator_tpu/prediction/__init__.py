@@ -1,0 +1,27 @@
+"""Usage prediction: decaying-histogram peak forecasting.
+
+Mirrors ``pkg/koordlet/prediction`` + ``pkg/util/histogram`` (SURVEY.md
+section 2.5), rebuilt as a *bank*: instead of one Go histogram object per UID, all
+models live in one (models x buckets) weight matrix so sample ingestion is a
+scatter-add and percentile queries answer every model at once.
+"""
+
+from koordinator_tpu.prediction.histogram import (
+    ExponentialBuckets,
+    HistogramBank,
+    default_cpu_buckets,
+    default_memory_buckets,
+)
+from koordinator_tpu.prediction.predictor import (
+    pod_reclaimable,
+    priority_reclaimable,
+)
+
+__all__ = [
+    "ExponentialBuckets",
+    "HistogramBank",
+    "default_cpu_buckets",
+    "default_memory_buckets",
+    "pod_reclaimable",
+    "priority_reclaimable",
+]

@@ -1,0 +1,192 @@
+"""Vectorized decaying exponential histograms.
+
+Semantics from the reference's ``pkg/util/histogram`` (a VPA-style histogram):
+
+- exponential bucket starts: s_0 = 0, s_i = first * (ratio^i - 1) / (ratio - 1)
+  (``exponential_histogram_options.go``); FindBucket is the log inverse.
+- ``Percentile(p)`` walks buckets from the first whose weight >= epsilon,
+  accumulating until partialSum >= p * totalWeight, and returns the *next*
+  bucket's start (upper bound of the matched bucket); the last bucket returns
+  its own start (``histogram.go:158``).
+- decaying histograms weight a sample at time t by 2^((t - ref) / halfLife)
+  (``decaying_histogram.go:34``); shifting ref rescales all weights, done here
+  whenever the multiplier grows past 2^32 to keep float32 in range.
+
+The bank holds U models as one (U, B) float32 weight matrix; adds are
+scatter-adds and percentile queries answer all models in one pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+DEFAULT_BUCKET_GROWTH = 0.05  # DefaultHistogramBucketSizeGrowth
+EPSILON = 1e-10               # epsilon in predict_server.go
+
+
+@dataclasses.dataclass(frozen=True)
+class ExponentialBuckets:
+    """Static bucket layout (hashable; safe as a jit static arg)."""
+
+    first_bucket_size: float
+    ratio: float
+    num_buckets: int
+
+    @classmethod
+    def for_range(cls, max_value: float, first_bucket_size: float,
+                  ratio: float) -> "ExponentialBuckets":
+        """NewExponentialHistogramOptions: enough buckets to cover max_value."""
+        # s_n >= max_value  <=>  n >= log(1 + max*(r-1)/first) / log(r)
+        n = int(math.ceil(
+            math.log1p(max_value * (ratio - 1.0) / first_bucket_size)
+            / math.log(ratio)
+        )) + 1
+        return cls(first_bucket_size, ratio, n)
+
+    def starts(self) -> np.ndarray:
+        i = np.arange(self.num_buckets, dtype=np.float64)
+        return (self.first_bucket_size * (self.ratio**i - 1.0)
+                / (self.ratio - 1.0)).astype(np.float32)
+
+    def find_bucket(self, values: jnp.ndarray) -> jnp.ndarray:
+        """Vectorized FindBucket: index of the bucket containing each value."""
+        v = jnp.maximum(values.astype(jnp.float32), 0.0)
+        idx = jnp.floor(
+            jnp.log1p(v * (self.ratio - 1.0) / self.first_bucket_size)
+            / math.log(self.ratio)
+        ).astype(jnp.int32)
+        return jnp.clip(idx, 0, self.num_buckets - 1)
+
+
+def default_cpu_buckets() -> ExponentialBuckets:
+    """predict_server.go:207 — 0.025 to 1024 cores at 5% growth (values in
+    milli-cores here: first bucket 25 mcores, max 1024000)."""
+    return ExponentialBuckets.for_range(1024_000.0, 25.0, 1.0 + DEFAULT_BUCKET_GROWTH)
+
+
+def default_memory_buckets() -> ExponentialBuckets:
+    """predict_server.go:216 — 5 MiB to 2 TiB at 5% growth (values in MiB:
+    first bucket 5, max 2^21)."""
+    return ExponentialBuckets.for_range(float(1 << 21), 5.0, 1.0 + DEFAULT_BUCKET_GROWTH)
+
+
+@struct.dataclass
+class HistogramBank:
+    """U decaying histograms over one shared bucket layout."""
+
+    weights: jax.Array        # (U, B) float32 decayed bucket weights
+    total: jax.Array          # (U,) float32 decayed total weight
+    ref_time: jax.Array       # () float32 — decay reference timestamp (sec)
+    half_life: jax.Array      # () float32 — seconds
+
+    @classmethod
+    def zeros(cls, num_models: int, buckets: ExponentialBuckets,
+              half_life_sec: float) -> "HistogramBank":
+        return cls(
+            weights=jnp.zeros((num_models, buckets.num_buckets), jnp.float32),
+            total=jnp.zeros((num_models,), jnp.float32),
+            ref_time=jnp.float32(0.0),
+            half_life=jnp.float32(half_life_sec),
+        )
+
+    @property
+    def capacity(self) -> int:
+        return self.weights.shape[0]
+
+
+def _decay_factor(bank: HistogramBank, t: jnp.ndarray) -> jnp.ndarray:
+    return jnp.exp2((t - bank.ref_time) / bank.half_life)
+
+
+def add_samples(
+    bank: HistogramBank,
+    buckets: ExponentialBuckets,
+    uids: jnp.ndarray,     # (K,) int32 model rows
+    values: jnp.ndarray,   # (K,) float32 sample values
+    t: jnp.ndarray,        # () float32 sample timestamp (sec)
+    weight: float = 1.0,
+    mask: jnp.ndarray | None = None,  # (K,) bool — which samples count
+) -> HistogramBank:
+    """Scatter a batch of samples into their models with decay weighting."""
+    # Renormalize FIRST when the decay multiplier would get large: shift
+    # ref_time forward by whole half-lives and scale existing weights down
+    # 2^-k (the reference's shiftReferenceTimestamp, applied bank-wide) so the
+    # per-sample factor below stays within float32.
+    shift = jnp.floor(jnp.maximum(t - bank.ref_time, 0.0) / bank.half_life)
+    need = shift >= 32.0
+    scale = jnp.where(need, jnp.exp2(-shift), 1.0)
+    bank = bank.replace(
+        weights=bank.weights * scale,
+        total=bank.total * scale,
+        ref_time=jnp.where(need, bank.ref_time + shift * bank.half_life,
+                           bank.ref_time),
+    )
+
+    w = _decay_factor(bank, t) * weight
+    k = uids.shape[0]
+    sample_w = jnp.full((k,), 1.0, jnp.float32) * w
+    if mask is not None:
+        sample_w = jnp.where(mask, sample_w, 0.0)
+    b = buckets.find_bucket(values)
+    weights = bank.weights.at[uids, b].add(sample_w)
+    total = bank.total.at[uids].add(sample_w)
+    return bank.replace(weights=weights, total=total)
+
+
+def percentile(
+    bank: HistogramBank, buckets: ExponentialBuckets, p: float
+) -> jnp.ndarray:
+    """(U,) float32: the p-percentile of every model (histogram.go:158).
+
+    Empty histograms return 0.
+    """
+    starts = jnp.asarray(buckets.starts())          # (B,)
+    w = bank.weights                                # (U, B)
+    nb = buckets.num_buckets
+
+    significant = w >= EPSILON
+    any_sig = jnp.any(significant, axis=1)
+    min_bucket = jnp.argmax(significant, axis=1)    # first >= eps (0 if none)
+    # last significant bucket; 0 if none
+    rev = jnp.argmax(significant[:, ::-1], axis=1)
+    max_bucket = jnp.where(any_sig, nb - 1 - rev, 0)
+
+    idx = jnp.arange(nb)[None, :]
+    in_range = idx >= min_bucket[:, None]
+    partial = jnp.cumsum(jnp.where(in_range, w, 0.0), axis=1)  # (U, B)
+    threshold = p * bank.total                      # (U,)
+
+    # first bucket (>= min) where partial >= threshold, else max_bucket
+    hit = in_range & (partial >= threshold[:, None]) & (idx <= max_bucket[:, None])
+    bucket = jnp.where(jnp.any(hit, axis=1), jnp.argmax(hit, axis=1), max_bucket)
+    # return the next bucket's start (upper bound), last bucket its own start
+    out = jnp.where(bucket < nb - 1, starts[jnp.minimum(bucket + 1, nb - 1)],
+                    starts[bucket])
+    return jnp.where(any_sig, out, 0.0)
+
+
+def save_bank(bank: HistogramBank, path: str) -> None:
+    """Checkpoint (prediction/checkpoint.go equivalent)."""
+    np.savez_compressed(
+        path,
+        weights=np.asarray(bank.weights),
+        total=np.asarray(bank.total),
+        ref_time=np.asarray(bank.ref_time),
+        half_life=np.asarray(bank.half_life),
+    )
+
+
+def load_bank(path: str) -> HistogramBank:
+    z = np.load(path)
+    return HistogramBank(
+        weights=jnp.asarray(z["weights"]),
+        total=jnp.asarray(z["total"]),
+        ref_time=jnp.asarray(z["ref_time"]),
+        half_life=jnp.asarray(z["half_life"]),
+    )

@@ -1,0 +1,90 @@
+"""Peak predictors: reclaimable-resource forecasts from the histogram bank.
+
+Semantics from ``pkg/koordlet/prediction/peak_predictor.go``:
+
+- podReclaimablePredictor (:154): per reclaimable prod pod,
+    peak_cpu = p95(cpu) * (100 + safetyMargin)/100
+    peak_mem = p98(mem) * (100 + safetyMargin)/100
+    reclaimable += max(request - peak, 0);  unReclaimable += peak
+  cold-start pods (younger than coldStartDuration) contribute 0;
+  result = min(nodeAllocatable - unReclaimable (clamped >= 0), reclaimable).
+- priorityReclaimablePredictor (:274): band-level histograms — peak of the
+  priority tier plus system, reclaimable = tierRequest - peak.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from koordinator_tpu.prediction.histogram import (
+    ExponentialBuckets,
+    HistogramBank,
+    percentile,
+)
+
+
+def _apply_safety_margin(peak: jnp.ndarray, safety_margin_pct) -> jnp.ndarray:
+    return peak * (100.0 + safety_margin_pct) / 100.0
+
+
+def pod_reclaimable(
+    cpu_bank: HistogramBank,
+    mem_bank: HistogramBank,
+    cpu_buckets: ExponentialBuckets,
+    mem_buckets: ExponentialBuckets,
+    pod_request_cpu: jnp.ndarray,   # (U,) float32 mcores
+    pod_request_mem: jnp.ndarray,   # (U,) float32 MiB
+    reclaimable_mask: jnp.ndarray,  # (U,) bool: prod, past cold start, running
+    node_allocatable_cpu: jnp.ndarray,  # () float32
+    node_allocatable_mem: jnp.ndarray,  # () float32
+    safety_margin_pct: float = 10.0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Node-level prod reclaimable (cpu, mem) from per-pod models.
+
+    Returns two () float32 scalars (what NodeMetric reports as
+    ProdReclaimableMetric, feeding mid_allocatable).
+    """
+    peak_cpu = _apply_safety_margin(
+        percentile(cpu_bank, cpu_buckets, 0.95), safety_margin_pct
+    )
+    peak_mem = _apply_safety_margin(
+        percentile(mem_bank, mem_buckets, 0.98), safety_margin_pct
+    )
+    m = reclaimable_mask
+    reclaim_cpu = jnp.sum(
+        jnp.where(m, jnp.maximum(pod_request_cpu - peak_cpu, 0.0), 0.0)
+    )
+    reclaim_mem = jnp.sum(
+        jnp.where(m, jnp.maximum(pod_request_mem - peak_mem, 0.0), 0.0)
+    )
+    unreclaim_cpu = jnp.sum(jnp.where(m, peak_cpu, 0.0))
+    unreclaim_mem = jnp.sum(jnp.where(m, peak_mem, 0.0))
+
+    fix_cpu = jnp.maximum(node_allocatable_cpu - unreclaim_cpu, 0.0)
+    fix_mem = jnp.maximum(node_allocatable_mem - unreclaim_mem, 0.0)
+    return jnp.minimum(fix_cpu, reclaim_cpu), jnp.minimum(fix_mem, reclaim_mem)
+
+
+def priority_reclaimable(
+    cpu_bank: HistogramBank,
+    mem_bank: HistogramBank,
+    cpu_buckets: ExponentialBuckets,
+    mem_buckets: ExponentialBuckets,
+    tier_rows: jnp.ndarray,        # (K,) int32 rows of the tier + system models
+    tier_request_cpu: jnp.ndarray, # () float32 sum of tier requests
+    tier_request_mem: jnp.ndarray,
+    safety_margin_pct: float = 10.0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Band-level reclaimable: tierRequest - (p95/p98 peak of tier+system)."""
+    peak_cpu = _apply_safety_margin(
+        jnp.sum(percentile(cpu_bank, cpu_buckets, 0.95)[tier_rows]),
+        safety_margin_pct,
+    )
+    peak_mem = _apply_safety_margin(
+        jnp.sum(percentile(mem_bank, mem_buckets, 0.98)[tier_rows]),
+        safety_margin_pct,
+    )
+    return (
+        jnp.maximum(tier_request_cpu - peak_cpu, 0.0),
+        jnp.maximum(tier_request_mem - peak_mem, 0.0),
+    )

@@ -138,6 +138,25 @@ def test_surplus_member_of_satisfied_gang_binds_in_later_pass():
     assert int(st.node_requested[0, CPU]) == 6_000
 
 
+def test_multi_pass_respects_usage_threshold_feedback():
+    # Regression: pass 2 must see pass-1 keeps' estimated usage. One node,
+    # usage 5000/10000, threshold 65% (limit 6500), two 1000m pods: single
+    # pass rejects the second (7000 > 6500); multi-pass must agree.
+    alloc = np.zeros((1, R), np.int32)
+    alloc[0, CPU], alloc[0, MEM] = 10_000, 100_000
+    usage = np.zeros((1, R), np.int32)
+    usage[0, CPU] = 5_000
+    state = ClusterState.from_arrays(alloc, usage=usage)
+    pods = mk_pods([1_000, 1_000], [-1, -1], state, mem=16)
+    gangs = GangInfo.build(np.array([], dtype=np.int64).reshape(0))
+    c = cfg().replace(usage_thresholds=jnp.zeros(R, jnp.int32).at[CPU].set(65))
+    a, _, _ = gang_assign(state, pods, c, gangs, passes=2)
+    from koordinator_tpu.ops.assignment import greedy_assign
+
+    a1, _, _ = greedy_assign(state, pods, c)
+    assert np.asarray(a)[:2].tolist() == np.asarray(a1)[:2].tolist() == [0, -1]
+
+
 def test_gang_with_quota_rollback_restores_headroom():
     from koordinator_tpu.quota import QuotaDeviceState, QuotaTree
     from koordinator_tpu.quota.tree import UNBOUNDED

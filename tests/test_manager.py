@@ -1,0 +1,125 @@
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.manager.noderesource import (
+    POLICY_MAX_USAGE_REQUEST,
+    POLICY_REQUEST,
+    POLICY_USAGE,
+    ColocationStrategy,
+    batch_allocatable,
+    cpu_normalization,
+    mid_allocatable,
+    node_safety_margin,
+)
+
+
+def arr(*v):
+    return jnp.asarray(np.array(v, np.int32))
+
+
+def test_safety_margin():
+    s = ColocationStrategy.default()  # cpu reclaim 60 -> margin 40%
+    mc, mm = node_safety_margin(arr(10_000), arr(65_536), s)
+    assert int(mc[0]) == 4_000
+    assert int(mm[0]) == 65_536 * 35 // 100
+
+
+def test_batch_by_usage_formula():
+    # batch = cap - margin - max(sysUsed, reserved) - hpUsed
+    s = ColocationStrategy.default()
+    bc, bm = batch_allocatable(
+        capacity_cpu=arr(10_000), capacity_mem=arr(100_000),
+        system_used_cpu=arr(500), system_used_mem=arr(2_000),
+        reserved_cpu=arr(300), reserved_mem=arr(3_000),
+        hp_used_cpu=arr(2_000), hp_used_mem=arr(20_000),
+        hp_req_cpu=arr(4_000), hp_req_mem=arr(40_000),
+        hp_max_used_req_cpu=arr(4_500), hp_max_used_req_mem=arr(45_000),
+        strategy=s,
+    )
+    # cpu: 10000 - 4000 - max(500,300) - 2000 = 3500
+    assert int(bc[0]) == 3_500
+    # mem: 100000 - 35000 - max(2000,3000) - 20000 = 42000
+    assert int(bm[0]) == 42_000
+
+
+def test_batch_policies_and_threshold():
+    s = ColocationStrategy.default().replace(
+        cpu_calculate_policy=jnp.int32(POLICY_MAX_USAGE_REQUEST),
+        memory_calculate_policy=jnp.int32(POLICY_REQUEST),
+        batch_cpu_threshold_pct=jnp.int32(20),
+    )
+    bc, bm = batch_allocatable(
+        capacity_cpu=arr(10_000), capacity_mem=arr(100_000),
+        system_used_cpu=arr(500), system_used_mem=arr(2_000),
+        reserved_cpu=arr(300), reserved_mem=arr(3_000),
+        hp_used_cpu=arr(2_000), hp_used_mem=arr(20_000),
+        hp_req_cpu=arr(4_000), hp_req_mem=arr(40_000),
+        hp_max_used_req_cpu=arr(4_500), hp_max_used_req_mem=arr(45_000),
+        strategy=s,
+    )
+    # cpu byMaxUsageRequest: 10000-4000-500-4500 = 1000, threshold cap 2000
+    assert int(bc[0]) == 1_000
+    # mem byRequest: 100000-35000-3000-40000 = 22000
+    assert int(bm[0]) == 22_000
+
+    s2 = s.replace(batch_cpu_threshold_pct=jnp.int32(5))
+    bc2, _ = batch_allocatable(
+        capacity_cpu=arr(10_000), capacity_mem=arr(100_000),
+        system_used_cpu=arr(500), system_used_mem=arr(2_000),
+        reserved_cpu=arr(300), reserved_mem=arr(3_000),
+        hp_used_cpu=arr(2_000), hp_used_mem=arr(20_000),
+        hp_req_cpu=arr(4_000), hp_req_mem=arr(40_000),
+        hp_max_used_req_cpu=arr(4_500), hp_max_used_req_mem=arr(45_000),
+        strategy=s2,
+    )
+    assert int(bc2[0]) == 500  # capped at 5% of capacity
+
+
+def test_batch_clamps_negative_to_zero():
+    s = ColocationStrategy.default()
+    bc, _ = batch_allocatable(
+        capacity_cpu=arr(1_000), capacity_mem=arr(1_000),
+        system_used_cpu=arr(900), system_used_mem=arr(0),
+        reserved_cpu=arr(0), reserved_mem=arr(0),
+        hp_used_cpu=arr(900), hp_used_mem=arr(0),
+        hp_req_cpu=arr(0), hp_req_mem=arr(0),
+        hp_max_used_req_cpu=arr(0), hp_max_used_req_mem=arr(0),
+        strategy=s,
+    )
+    assert int(bc[0]) == 0
+
+
+def test_mid_allocatable():
+    s = ColocationStrategy.default().replace(
+        mid_cpu_threshold_pct=jnp.int32(10),
+        mid_unallocated_pct=jnp.int32(50),
+    )
+    mc, mm = mid_allocatable(
+        capacity_cpu=arr(10_000), capacity_mem=arr(100_000),
+        prod_reclaimable_cpu=arr(800), prod_reclaimable_mem=arr(5_000),
+        node_unused_cpu=arr(600), node_unused_mem=arr(50_000),
+        unallocated_cpu=arr(400), unallocated_mem=arr(10_000),
+        strategy=s,
+    )
+    # cpu: min(min(800, 600) + 400*50%, 10000*10%) = min(800, 1000) = 800
+    assert int(mc[0]) == 800
+    # mem: min(min(5000,50000) + 10000*50%, 100000*10%) = min(10000,10000)
+    assert int(mm[0]) == 10_000
+
+
+def test_mid_negative_reclaimable_clamped():
+    s = ColocationStrategy.default()
+    mc, _ = mid_allocatable(
+        capacity_cpu=arr(10_000), capacity_mem=arr(100_000),
+        prod_reclaimable_cpu=arr(-500), prod_reclaimable_mem=arr(0),
+        node_unused_cpu=arr(600), node_unused_mem=arr(0),
+        unallocated_cpu=arr(0), unallocated_mem=arr(0),
+        strategy=s,
+    )
+    assert int(mc[0]) == 0
+
+
+def test_cpu_normalization_and_vectorization():
+    ratio = arr(120, 80, 100)
+    out = cpu_normalization(arr(10_000, 10_000, 10_000), ratio)
+    assert np.asarray(out).tolist() == [12_000, 8_000, 10_000]

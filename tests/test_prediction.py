@@ -1,0 +1,136 @@
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.prediction.histogram import (
+    ExponentialBuckets,
+    HistogramBank,
+    add_samples,
+    default_cpu_buckets,
+    load_bank,
+    percentile,
+    save_bank,
+)
+from koordinator_tpu.prediction.predictor import pod_reclaimable
+
+
+def oracle_percentile(weights, starts, p, eps=1e-10):
+    """Direct port of histogram.go:158 Percentile in plain Python."""
+    total = sum(weights)
+    sig = [i for i, w in enumerate(weights) if w >= eps]
+    if not sig:
+        return 0.0
+    min_b, max_b = sig[0], sig[-1]
+    partial = 0.0
+    bucket = min_b
+    while bucket < max_b:
+        partial += weights[bucket]
+        if partial >= p * total:
+            break
+        bucket += 1
+    if bucket < len(weights) - 1:
+        return starts[bucket + 1]
+    return starts[bucket]
+
+
+def test_bucket_layout_monotone_and_inverse():
+    b = default_cpu_buckets()
+    starts = b.starts()
+    assert starts[0] == 0.0
+    assert (np.diff(starts) > 0).all()
+    # find_bucket is the inverse of starts: a value inside bucket i maps to i
+    vals = (starts[:-1] + starts[1:]) / 2
+    idx = np.asarray(b.find_bucket(jnp.asarray(vals)))
+    assert (idx == np.arange(len(vals))).all()
+
+
+def test_percentile_matches_oracle():
+    rng = np.random.default_rng(5)
+    b = ExponentialBuckets.for_range(1000.0, 1.0, 1.05)
+    bank = HistogramBank.zeros(4, b, half_life_sec=86_400.0)
+    t = jnp.float32(0.0)
+    for _ in range(50):
+        uids = jnp.asarray(rng.integers(0, 4, 8).astype(np.int32))
+        vals = jnp.asarray((rng.random(8) * 900).astype(np.float32))
+        bank = add_samples(bank, b, uids, vals, t)
+    starts = b.starts()
+    for p in (0.5, 0.9, 0.95, 0.99):
+        got = np.asarray(percentile(bank, b, p))
+        for u in range(4):
+            want = oracle_percentile(np.asarray(bank.weights)[u].tolist(),
+                                     starts.tolist(), p)
+            assert math.isclose(got[u], want, rel_tol=1e-5), (u, p, got[u], want)
+
+
+def test_percentile_empty_is_zero():
+    b = ExponentialBuckets.for_range(100.0, 1.0, 1.05)
+    bank = HistogramBank.zeros(2, b, half_life_sec=3600.0)
+    assert np.asarray(percentile(bank, b, 0.95)).tolist() == [0.0, 0.0]
+
+
+def test_decay_halves_old_samples():
+    b = ExponentialBuckets.for_range(1000.0, 1.0, 1.05)
+    bank = HistogramBank.zeros(1, b, half_life_sec=100.0)
+    u = jnp.asarray(np.array([0], np.int32))
+    # old sample at value ~10, new sample at ~500 one half-life later with
+    # the same nominal weight -> new sample weighs 2x the old
+    bank = add_samples(bank, b, u, jnp.asarray(np.array([10.0], np.float32)),
+                       jnp.float32(0.0))
+    bank = add_samples(bank, b, u, jnp.asarray(np.array([500.0], np.float32)),
+                       jnp.float32(100.0))
+    # p50 * total: total = 1 + 2 = 3; threshold 1.5 -> falls in the 500 bucket
+    p50 = float(percentile(bank, b, 0.5)[0])
+    assert p50 > 400.0
+
+
+def test_decay_renormalizes_far_future():
+    b = ExponentialBuckets.for_range(1000.0, 1.0, 1.05)
+    bank = HistogramBank.zeros(1, b, half_life_sec=3600.0)
+    u = jnp.asarray(np.array([0], np.int32))
+    bank = add_samples(bank, b, u, jnp.asarray(np.array([100.0], np.float32)),
+                       jnp.float32(0.0))
+    # 100 half-lives later: would be 2^100 without renormalization
+    bank = add_samples(bank, b, u, jnp.asarray(np.array([100.0], np.float32)),
+                       jnp.float32(360_000.0))
+    assert np.isfinite(np.asarray(bank.weights)).all()
+    assert float(bank.total[0]) > 0
+
+
+def test_pod_reclaimable():
+    b = ExponentialBuckets.for_range(10_000.0, 10.0, 1.05)
+    cpu_bank = HistogramBank.zeros(3, b, half_life_sec=86_400.0)
+    mem_bank = HistogramBank.zeros(3, b, half_life_sec=86_400.0)
+    u = jnp.asarray(np.array([0, 1, 2], np.int32))
+    t = jnp.float32(0.0)
+    # pods use ~1000 mcpu / ~1000 MiB steadily
+    for _ in range(20):
+        cpu_bank = add_samples(cpu_bank, b, u,
+                               jnp.asarray(np.array([1000.0, 1000.0, 1000.0],
+                                                    np.float32)), t)
+        mem_bank = add_samples(mem_bank, b, u,
+                               jnp.asarray(np.array([1000.0] * 3, np.float32)), t)
+    req_cpu = jnp.asarray(np.array([4000.0, 4000.0, 4000.0], np.float32))
+    req_mem = jnp.asarray(np.array([2000.0] * 3, np.float32))
+    mask = jnp.asarray(np.array([True, True, False]))  # pod 2 in cold start
+    rc, rm = pod_reclaimable(
+        cpu_bank, mem_bank, b, b, req_cpu, req_mem, mask,
+        node_allocatable_cpu=jnp.float32(16_000.0),
+        node_allocatable_mem=jnp.float32(65_536.0),
+        safety_margin_pct=10.0,
+    )
+    # peak ~= 1000*1.1 = ~1100 (bucket upper bound), reclaimable ~2900 x2 pods
+    assert 5_000 < float(rc) < 6_200, float(rc)
+    assert 1_500 < float(rm) < 2_000, float(rm)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    b = ExponentialBuckets.for_range(100.0, 1.0, 1.05)
+    bank = HistogramBank.zeros(2, b, half_life_sec=3600.0)
+    bank = add_samples(bank, b, jnp.asarray(np.array([0], np.int32)),
+                       jnp.asarray(np.array([42.0], np.float32)), jnp.float32(5.0))
+    path = str(tmp_path / "bank.npz")
+    save_bank(bank, path)
+    restored = load_bank(path)
+    assert np.array_equal(np.asarray(bank.weights), np.asarray(restored.weights))
+    assert float(bank.ref_time) == float(restored.ref_time)
