@@ -1,0 +1,30 @@
+"""Descheduling: load rebalancing and arbitrated pod migration.
+
+Mirrors ``pkg/descheduler`` (SURVEY.md section 2.7):
+
+- ``lownodeload`` -- the LowNodeLoad balance plugin as tensor kernels over the
+  device-resident cluster state: threshold/deviation classification, victim
+  selection bounded by target-node headroom.
+- ``migration``   -- the PodMigrationJob controller + arbitrator state machine
+  (sort, group limits) on the host, since it is API-protocol-bound.
+"""
+
+from koordinator_tpu.descheduler.lownodeload import (
+    LowNodeLoadArgs,
+    classify_nodes,
+    select_victims,
+)
+from koordinator_tpu.descheduler.migration import (
+    MigrationJob,
+    MigrationJobPhase,
+    MigrationController,
+)
+
+__all__ = [
+    "LowNodeLoadArgs",
+    "classify_nodes",
+    "select_victims",
+    "MigrationJob",
+    "MigrationJobPhase",
+    "MigrationController",
+]

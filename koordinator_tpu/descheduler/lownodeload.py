@@ -1,0 +1,172 @@
+"""LowNodeLoad: classify nodes by real utilization, pick eviction victims.
+
+Semantics from ``pkg/descheduler/framework/plugins/loadaware``:
+
+- classifyNodes (utilization_util.go:239): a node is *underutilized* when
+  every configured resource sits below its low threshold, *overutilized* when
+  any resource exceeds its high threshold (thresholds are percentages of node
+  capacity; NodeMetric usage, not requests).
+- deviation thresholds (low_node_load.go:314 newThresholds with
+  UseDeviationThresholds): low/high become mean(usage%) -/+ the configured
+  deviation, clamped to [0, 100].
+- victim selection (utilization_util.go:308 evictPodsFromSourceNodes): the
+  budget is the sum over underutilized nodes of (high-threshold capacity -
+  usage); pods move off overutilized nodes — sorted cheapest-first — only
+  while their node stays above the high threshold and budget remains.
+- anomaly gating (low_node_load.go:286 filterRealAbnormalNodes): a node must
+  be observed overutilized in several consecutive rounds before eviction;
+  tracked here as a per-node counter tensor.
+
+All kernels take the (N, R) usage/capacity tensors already resident for
+scheduling — the descheduler reads the same cluster state (BASELINE.json north
+star).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+
+
+@struct.dataclass
+class LowNodeLoadArgs:
+    """LowNodeLoadArgs (descheduler apis/config): thresholds are int32
+    percentages; -1 = resource not configured."""
+
+    low_thresholds: jax.Array   # (R,) int32
+    high_thresholds: jax.Array  # (R,) int32
+    use_deviation: jax.Array    # () bool
+    anomaly_rounds: jax.Array   # () int32 — consecutive rounds before evicting
+
+    @classmethod
+    def default(cls) -> "LowNodeLoadArgs":
+        from koordinator_tpu.api.resources import ResourceDim
+
+        low = jnp.full(NUM_RESOURCE_DIMS, -1, jnp.int32)
+        high = jnp.full(NUM_RESOURCE_DIMS, -1, jnp.int32)
+        low = low.at[ResourceDim.CPU].set(45).at[ResourceDim.MEMORY].set(60)
+        high = high.at[ResourceDim.CPU].set(65).at[ResourceDim.MEMORY].set(80)
+        return cls(
+            low_thresholds=low,
+            high_thresholds=high,
+            use_deviation=jnp.asarray(False),
+            anomaly_rounds=jnp.int32(3),
+        )
+
+
+def usage_percent(usage: jnp.ndarray, capacity: jnp.ndarray) -> jnp.ndarray:
+    """(N, R) usage percentage of capacity; 0 where capacity is 0."""
+    return jnp.where(capacity > 0, usage * 100 // jnp.maximum(capacity, 1), 0)
+
+
+def effective_thresholds(
+    args: LowNodeLoadArgs,
+    usage_pct: jnp.ndarray,   # (N, R)
+    node_valid: jnp.ndarray,  # (N,)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(low, high) per resource; deviation mode recenters on the pool mean."""
+    configured = args.low_thresholds >= 0
+    n = jnp.maximum(jnp.sum(node_valid), 1)
+    mean = jnp.sum(jnp.where(node_valid[:, None], usage_pct, 0), axis=0) // n
+    dev_low = jnp.clip(mean - jnp.maximum(args.low_thresholds, 0), 0, 100)
+    dev_high = jnp.clip(mean + jnp.maximum(args.high_thresholds, 0), 0, 100)
+    low = jnp.where(args.use_deviation, dev_low, args.low_thresholds)
+    high = jnp.where(args.use_deviation, dev_high, args.high_thresholds)
+    return (
+        jnp.where(configured, low, -1),
+        jnp.where(configured, high, -1),
+    )
+
+
+def classify_nodes(
+    usage: jnp.ndarray,      # (N, R)
+    capacity: jnp.ndarray,   # (N, R)
+    node_valid: jnp.ndarray, # (N,)
+    args: LowNodeLoadArgs,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(underutilized, overutilized) boolean masks, each (N,)."""
+    pct = usage_percent(usage, capacity)
+    low, high = effective_thresholds(args, pct, node_valid)
+    configured = low >= 0
+    under = jnp.all((pct < low) | ~configured, axis=-1) & node_valid
+    over = jnp.any(configured & (pct > high), axis=-1) & node_valid
+    return under, over
+
+
+def update_anomaly_counters(
+    counters: jnp.ndarray,  # (N,) int32 consecutive-overutilized rounds
+    over: jnp.ndarray,      # (N,) bool this round
+) -> jnp.ndarray:
+    """filterRealAbnormalNodes counter: increment while over, reset when not."""
+    return jnp.where(over, counters + 1, 0)
+
+
+def eviction_budget(
+    usage: jnp.ndarray,
+    capacity: jnp.ndarray,
+    under: jnp.ndarray,
+    high: jnp.ndarray,
+) -> jnp.ndarray:
+    """(R,) total head-room on underutilized nodes:
+    sum(high% * capacity - usage), clamped at 0 per node
+    (targetAvailableUsage, utilization_util.go:468)."""
+    high_quant = jnp.where(high >= 0, capacity * jnp.maximum(high, 0) // 100, 0)
+    room = jnp.maximum(high_quant - usage, 0)
+    return jnp.sum(jnp.where(under[:, None] & (high >= 0), room, 0), axis=0)
+
+
+def select_victims(
+    usage: jnp.ndarray,        # (N, R) node usage
+    capacity: jnp.ndarray,     # (N, R)
+    node_valid: jnp.ndarray,   # (N,)
+    pod_node: jnp.ndarray,     # (P,) int32 — node each pod runs on, -1 none
+    pod_usage: jnp.ndarray,    # (P, R) — per-pod usage
+    pod_priority: jnp.ndarray, # (P,) int32
+    pod_evictable: jnp.ndarray,# (P,) bool — passed the eviction filters (PDB,
+                               #   owner kind, QoS policy...) computed host-side
+    anomaly_counters: jnp.ndarray,  # (N,) int32
+    args: LowNodeLoadArgs,
+) -> jnp.ndarray:
+    """(P,) bool victim mask.
+
+    Evicts lowest-priority pods first from anomalous overutilized nodes, while
+    (a) the node remains above its high threshold and (b) the underutilized
+    pool still has head-room for the pod (balancePods/evictPods semantics).
+    """
+    pct = usage_percent(usage, capacity)
+    low, high = effective_thresholds(args, pct, node_valid)
+    under, over = classify_nodes(usage, capacity, node_valid, args)
+    abnormal = over & (anomaly_counters >= args.anomaly_rounds)
+    budget = eviction_budget(usage, capacity, under, high)
+
+    high_quant = jnp.where(high >= 0, capacity * jnp.maximum(high, 0) // 100,
+                           jnp.int32(2**30))
+
+    # cheapest (lowest priority, then smallest cpu usage) pods first
+    p = pod_node.shape[0]
+    order = jnp.lexsort((pod_usage[:, 0], pod_priority))
+
+    def step(carry, idx):
+        node_usage, budget = carry
+        node = pod_node[idx]
+        safe = jnp.maximum(node, 0)
+        candidate = (
+            (node >= 0)
+            & pod_evictable[idx]
+            & abnormal[safe]
+            # node still above high threshold on some configured dim
+            & jnp.any((high >= 0) & (node_usage[safe] > high_quant[safe]))
+            # pool head-room covers this pod on every configured dim
+            & jnp.all((high < 0) | (pod_usage[idx] <= budget))
+        )
+        delta = jnp.where(candidate, pod_usage[idx], 0)
+        node_usage = node_usage.at[safe].add(-delta)
+        budget = budget - delta
+        return (node_usage, budget), candidate
+
+    (_, _), victims_in_order = jax.lax.scan(step, (usage, budget), order)
+    victims = jnp.zeros(p, bool).at[order].set(victims_in_order)
+    return victims

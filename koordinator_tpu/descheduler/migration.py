@@ -1,0 +1,176 @@
+"""PodMigrationJob controller: arbitrated, reservation-backed migration.
+
+Semantics from ``pkg/descheduler/controllers/migration``:
+
+- Jobs are arbitrated before running (arbitrator/arbitrator.go:51): candidates
+  are *sorted* (earlier creation first, lower-priority pods first) then
+  *filtered* by stability group limits — max concurrent migrations per node /
+  namespace / owning workload, and the workload's max-unavailable budget
+  (arbitrator/filter.go).
+- A reservation for the replacement pod can be requested before eviction
+  (migration/reservation/): the job only proceeds to eviction once capacity
+  is reserved, so the migrated pod cannot be left homeless.
+- Eviction runs through a pluggable evictor (eviction API / delete / soft
+  label, migration/evictor/*.go); the job tracks phase + conditions and
+  times out.
+
+This is control-plane protocol machinery, so it stays host-side Python; the
+expensive part — choosing where replacements go — is delegated to the TPU
+solver through the ``reserve_fn`` callback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from collections import Counter
+from typing import Callable, Iterable
+
+
+class MigrationJobPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class MigrationJob:
+    """PodMigrationJob (apis/scheduling/v1alpha1/pod_migration_job_types.go)."""
+
+    name: str
+    pod: str
+    node: str
+    namespace: str = "default"
+    workload: str = ""
+    priority: int = 0
+    create_time: float = dataclasses.field(default_factory=time.monotonic)
+    timeout_sec: float = 600.0
+    phase: MigrationJobPhase = MigrationJobPhase.PENDING
+    reason: str = ""
+    reservation: str | None = None
+    start_time: float | None = None
+
+
+@dataclasses.dataclass
+class ArbitrationLimits:
+    """Group limits (arbitrator/filter.go defaults)."""
+
+    max_migrating_per_node: int = 2
+    max_migrating_per_namespace: int = 10
+    max_migrating_per_workload: int = 2
+    max_unavailable_per_workload: int = 2
+
+
+class MigrationController:
+    """Reconciles MigrationJobs with arbitration and reservation-first flow."""
+
+    def __init__(
+        self,
+        limits: ArbitrationLimits | None = None,
+        reserve_fn: Callable[[MigrationJob], str | None] | None = None,
+        evict_fn: Callable[[MigrationJob], bool] | None = None,
+        workload_unavailable_fn: Callable[[str], int] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.limits = limits or ArbitrationLimits()
+        self.reserve_fn = reserve_fn
+        self.evict_fn = evict_fn
+        self.workload_unavailable_fn = workload_unavailable_fn
+        self.clock = clock
+        self.jobs: dict[str, MigrationJob] = {}
+
+    # -- API ---------------------------------------------------------------
+
+    def submit(self, job: MigrationJob) -> None:
+        if job.name in self.jobs:
+            raise ValueError(f"migration job {job.name!r} already exists")
+        self.jobs[job.name] = job
+
+    def running(self) -> list[MigrationJob]:
+        return [j for j in self.jobs.values()
+                if j.phase is MigrationJobPhase.RUNNING]
+
+    def pending(self) -> list[MigrationJob]:
+        return [j for j in self.jobs.values()
+                if j.phase is MigrationJobPhase.PENDING]
+
+    # -- arbitration (sort + filter) ---------------------------------------
+
+    def _sorted_candidates(self) -> list[MigrationJob]:
+        """arbitrator/sort.go: stable order — older jobs first, lower pod
+        priority migrates first (cheaper disruption)."""
+        return sorted(self.pending(), key=lambda j: (j.priority, j.create_time))
+
+    def _group_counts(self, jobs: Iterable[MigrationJob]) -> tuple[Counter, Counter, Counter]:
+        node, ns, workload = Counter(), Counter(), Counter()
+        for j in jobs:
+            node[j.node] += 1
+            ns[j.namespace] += 1
+            if j.workload:
+                workload[j.workload] += 1
+        return node, ns, workload
+
+    def arbitrate(self) -> list[MigrationJob]:
+        """Pick pending jobs allowed to run this round (sort then filter)."""
+        node, ns, workload = self._group_counts(self.running())
+        allowed: list[MigrationJob] = []
+        for job in self._sorted_candidates():
+            lim = self.limits
+            if node[job.node] >= lim.max_migrating_per_node:
+                continue
+            if ns[job.namespace] >= lim.max_migrating_per_namespace:
+                continue
+            if job.workload:
+                if workload[job.workload] >= lim.max_migrating_per_workload:
+                    continue
+                if self.workload_unavailable_fn is not None:
+                    unavailable = (self.workload_unavailable_fn(job.workload)
+                                   + workload[job.workload])
+                    if unavailable >= lim.max_unavailable_per_workload:
+                        continue
+            allowed.append(job)
+            node[job.node] += 1
+            ns[job.namespace] += 1
+            if job.workload:
+                workload[job.workload] += 1
+        return allowed
+
+    # -- reconcile ---------------------------------------------------------
+
+    def reconcile(self) -> None:
+        """One controller round: arbitrate, reserve, evict, expire."""
+        now = self.clock()
+
+        for job in self.arbitrate():
+            # reservation-first: secure replacement capacity before evicting
+            if self.reserve_fn is not None:
+                reservation = self.reserve_fn(job)
+                if reservation is None:
+                    job.phase = MigrationJobPhase.FAILED
+                    job.reason = "ReservationFailed"
+                    continue
+                job.reservation = reservation
+            job.phase = MigrationJobPhase.RUNNING
+            job.start_time = now
+
+        for job in self.running():
+            if self.evict_fn is not None:
+                if self.evict_fn(job):
+                    job.phase = MigrationJobPhase.SUCCEEDED
+                    job.reason = "Complete"
+                    continue
+            if job.start_time is not None and now - job.start_time > job.timeout_sec:
+                job.phase = MigrationJobPhase.FAILED
+                job.reason = "Timeout"
+
+    def gc(self, keep: int = 256) -> None:
+        """Drop oldest finished jobs beyond the retention limit."""
+        finished = sorted(
+            (j for j in self.jobs.values()
+             if j.phase in (MigrationJobPhase.SUCCEEDED, MigrationJobPhase.FAILED)),
+            key=lambda j: j.create_time,
+        )
+        for j in finished[:-keep] if len(finished) > keep else []:
+            del self.jobs[j.name]

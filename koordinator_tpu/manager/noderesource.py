@@ -176,17 +176,24 @@ def mid_allocatable(
     )
 
 
+def _pct_wide(value: jnp.ndarray, pct: jnp.ndarray) -> jnp.ndarray:
+    """value * pct / 100 for pct that may exceed 100: split into whole
+    multiples plus a <100 remainder so each int32 product stays in range
+    (value <= MAX_QUANTITY guarantees value*99 < 2^31)."""
+    return value * (pct // 100) + value * (pct % 100) // 100
+
+
 def cpu_normalization(capacity_cpu: jnp.ndarray, ratio_pct: jnp.ndarray) -> jnp.ndarray:
     """CPU normalization: scale node CPU capacity by a per-model benchmark
     ratio (pkg/slo-controller/noderesource/plugins/cpunormalization).
-    ratio_pct is (N,) int32 percent (100 = 1.0)."""
-    return _pct(capacity_cpu, ratio_pct)
+    ratio_pct is (N,) int32 percent (100 = 1.0; may exceed 100)."""
+    return _pct_wide(capacity_cpu, ratio_pct)
 
 
 def amplify_capacity(capacity: jnp.ndarray, amplification_pct: jnp.ndarray) -> jnp.ndarray:
     """Node resource amplification (apis/extension/node_resource_amplification):
     raw capacity scaled by an amplification ratio >= 100%."""
-    return _pct(capacity, amplification_pct)
+    return _pct_wide(capacity, amplification_pct)
 
 
 def update_batch_mid_in_state(state, batch_cpu, batch_mem, mid_cpu, mid_mem):

@@ -73,9 +73,14 @@ def priority_reclaimable(
     tier_rows: jnp.ndarray,        # (K,) int32 rows of the tier + system models
     tier_request_cpu: jnp.ndarray, # () float32 sum of tier requests
     tier_request_mem: jnp.ndarray,
+    node_allocatable_cpu: jnp.ndarray,  # () float32
+    node_allocatable_mem: jnp.ndarray,  # () float32
     safety_margin_pct: float = 10.0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Band-level reclaimable: tierRequest - (p95/p98 peak of tier+system)."""
+    """Band-level reclaimable: tierRequest - (p95/p98 peak of tier+system),
+    clamped by what the node can physically free —
+    min(max(nodeAllocatable - peak, 0), reclaimable), peak_predictor.go:337-347.
+    """
     peak_cpu = _apply_safety_margin(
         jnp.sum(percentile(cpu_bank, cpu_buckets, 0.95)[tier_rows]),
         safety_margin_pct,
@@ -84,7 +89,8 @@ def priority_reclaimable(
         jnp.sum(percentile(mem_bank, mem_buckets, 0.98)[tier_rows]),
         safety_margin_pct,
     )
-    return (
-        jnp.maximum(tier_request_cpu - peak_cpu, 0.0),
-        jnp.maximum(tier_request_mem - peak_mem, 0.0),
-    )
+    reclaim_cpu = jnp.maximum(tier_request_cpu - peak_cpu, 0.0)
+    reclaim_mem = jnp.maximum(tier_request_mem - peak_mem, 0.0)
+    fix_cpu = jnp.maximum(node_allocatable_cpu - peak_cpu, 0.0)
+    fix_mem = jnp.maximum(node_allocatable_mem - peak_mem, 0.0)
+    return jnp.minimum(fix_cpu, reclaim_cpu), jnp.minimum(fix_mem, reclaim_mem)

@@ -123,3 +123,14 @@ def test_cpu_normalization_and_vectorization():
     ratio = arr(120, 80, 100)
     out = cpu_normalization(arr(10_000, 10_000, 10_000), ratio)
     assert np.asarray(out).tolist() == [12_000, 8_000, 10_000]
+
+
+def test_amplification_no_int32_overflow_above_100pct():
+    from koordinator_tpu.manager.noderesource import amplify_capacity
+    from koordinator_tpu.state.cluster_state import MAX_QUANTITY
+
+    cap = arr(20_000_000)  # near the MAX_QUANTITY bound
+    out = amplify_capacity(cap, arr(150))
+    assert int(out[0]) == 30_000_000  # would wrap negative with naive *150
+    assert int(amplify_capacity(arr(MAX_QUANTITY), arr(101))[0]) == \
+        MAX_QUANTITY + MAX_QUANTITY // 100

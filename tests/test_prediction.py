@@ -124,6 +124,29 @@ def test_pod_reclaimable():
     assert 1_500 < float(rm) < 2_000, float(rm)
 
 
+def test_priority_reclaimable_clamped_by_allocatable():
+    from koordinator_tpu.prediction.predictor import priority_reclaimable
+
+    b = ExponentialBuckets.for_range(10_000.0, 10.0, 1.05)
+    cpu_bank = HistogramBank.zeros(1, b, 86_400.0)
+    mem_bank = HistogramBank.zeros(1, b, 86_400.0)
+    u = jnp.asarray(np.array([0], np.int32))
+    cpu_bank = add_samples(cpu_bank, b, u,
+                           jnp.asarray(np.array([1000.0], np.float32)),
+                           jnp.float32(0.0))
+    mem_bank = add_samples(mem_bank, b, u,
+                           jnp.asarray(np.array([1000.0], np.float32)),
+                           jnp.float32(0.0))
+    # tier requests 100k but the node only has 5k allocatable: result must be
+    # min(alloc - peak, request - peak), not the inflated request-based figure
+    rc, _ = priority_reclaimable(
+        cpu_bank, mem_bank, b, b, u,
+        jnp.float32(100_000.0), jnp.float32(100_000.0),
+        jnp.float32(5_000.0), jnp.float32(5_000.0),
+    )
+    assert float(rc) < 5_000.0
+
+
 def test_checkpoint_roundtrip(tmp_path):
     b = ExponentialBuckets.for_range(100.0, 1.0, 1.05)
     bank = HistogramBank.zeros(2, b, half_life_sec=3600.0)
