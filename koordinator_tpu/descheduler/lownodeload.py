@@ -81,6 +81,19 @@ def effective_thresholds(
     )
 
 
+def _classify(pct, low, high, node_valid):
+    configured = low >= 0
+    under = jnp.all((pct < low) | ~configured, axis=-1) & node_valid
+    over = jnp.any(configured & (pct > high), axis=-1) & node_valid
+    return under, over
+
+
+def _high_quantity(capacity, high, unconfigured_fill):
+    """capacity * high% for configured dims; fill elsewhere."""
+    return jnp.where(high >= 0, capacity * jnp.maximum(high, 0) // 100,
+                     unconfigured_fill)
+
+
 def classify_nodes(
     usage: jnp.ndarray,      # (N, R)
     capacity: jnp.ndarray,   # (N, R)
@@ -90,10 +103,7 @@ def classify_nodes(
     """(underutilized, overutilized) boolean masks, each (N,)."""
     pct = usage_percent(usage, capacity)
     low, high = effective_thresholds(args, pct, node_valid)
-    configured = low >= 0
-    under = jnp.all((pct < low) | ~configured, axis=-1) & node_valid
-    over = jnp.any(configured & (pct > high), axis=-1) & node_valid
-    return under, over
+    return _classify(pct, low, high, node_valid)
 
 
 def update_anomaly_counters(
@@ -113,7 +123,7 @@ def eviction_budget(
     """(R,) total head-room on underutilized nodes:
     sum(high% * capacity - usage), clamped at 0 per node
     (targetAvailableUsage, utilization_util.go:468)."""
-    high_quant = jnp.where(high >= 0, capacity * jnp.maximum(high, 0) // 100, 0)
+    high_quant = _high_quantity(capacity, high, 0)
     room = jnp.maximum(high_quant - usage, 0)
     return jnp.sum(jnp.where(under[:, None] & (high >= 0), room, 0), axis=0)
 
@@ -138,12 +148,11 @@ def select_victims(
     """
     pct = usage_percent(usage, capacity)
     low, high = effective_thresholds(args, pct, node_valid)
-    under, over = classify_nodes(usage, capacity, node_valid, args)
+    under, over = _classify(pct, low, high, node_valid)
     abnormal = over & (anomaly_counters >= args.anomaly_rounds)
     budget = eviction_budget(usage, capacity, under, high)
 
-    high_quant = jnp.where(high >= 0, capacity * jnp.maximum(high, 0) // 100,
-                           jnp.int32(2**30))
+    high_quant = _high_quantity(capacity, high, jnp.int32(2**30))
 
     # cheapest (lowest priority, then smallest cpu usage) pods first
     p = pod_node.shape[0]

@@ -179,8 +179,13 @@ def mid_allocatable(
 def _pct_wide(value: jnp.ndarray, pct: jnp.ndarray) -> jnp.ndarray:
     """value * pct / 100 for pct that may exceed 100: split into whole
     multiples plus a <100 remainder so each int32 product stays in range
-    (value <= MAX_QUANTITY guarantees value*99 < 2^31)."""
-    return value * (pct // 100) + value * (pct % 100) // 100
+    (value <= MAX_QUANTITY guarantees value*99 < 2^31). The result is clamped
+    at MAX_QUANTITY so amplified capacities keep the int32 invariant every
+    downstream percent/score kernel relies on."""
+    from koordinator_tpu.state.cluster_state import MAX_QUANTITY
+
+    out = value * (pct // 100) + value * (pct % 100) // 100
+    return jnp.minimum(out, MAX_QUANTITY)
 
 
 def cpu_normalization(capacity_cpu: jnp.ndarray, ratio_pct: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,29 @@
+"""The scheduler shell: queue, snapshot sync, phase pipeline, diagnosis.
+
+The reference wraps the upstream k8s scheduler framework with frameworkext
+(SURVEY.md section 2.3); here the "framework" is the host-side orchestration around
+the batched TPU solve:
+
+- ``snapshot``  -- incremental host->device cluster-state sync (name->row
+                   maps, delta scatter updates, capacity bucketing)
+- ``scheduler`` -- the scheduling loop: priority queue, gang manager, batched
+                   solve rounds, Reserve accounting, bind callbacks
+- ``diagnosis`` -- structured "why unschedulable" explanations
+                   (schedule_diagnosis.go equivalent)
+- ``monitor``   -- per-round phase timing watchdog (scheduler_monitor.go)
+"""
+
+from koordinator_tpu.scheduler.snapshot import ClusterSnapshot, NodeSpec, PodSpec
+from koordinator_tpu.scheduler.scheduler import Scheduler, SchedulingResult
+from koordinator_tpu.scheduler.diagnosis import explain_pod
+from koordinator_tpu.scheduler.monitor import SchedulerMonitor
+
+__all__ = [
+    "ClusterSnapshot",
+    "NodeSpec",
+    "PodSpec",
+    "Scheduler",
+    "SchedulingResult",
+    "explain_pod",
+    "SchedulerMonitor",
+]

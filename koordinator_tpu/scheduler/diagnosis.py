@@ -1,0 +1,93 @@
+"""Schedule diagnosis: structured "why unschedulable" explanations.
+
+Equivalent of ``frameworkext/schedule_diagnosis.go:44-108`` — when a pod fails
+to place, report how many nodes each filter stage eliminated, so operators see
+"0/128 nodes available: 96 insufficient cpu, 30 usage over threshold, 2
+affinity mismatch" instead of a bare failure.
+
+The stage masks are recomputed per failed pod (failures are rare relative to
+the hot path, and the per-stage breakdown is exactly what score_pods fuses
+away for speed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.ops import filtering, scoring
+from koordinator_tpu.ops.assignment import ScoringConfig
+from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
+
+
+@dataclasses.dataclass
+class PodDiagnosis:
+    """Counts of nodes eliminated per stage (a node counts once, first-fail)."""
+
+    total_nodes: int
+    feasible_nodes: int
+    insufficient_resources: int
+    usage_over_threshold: int
+    affinity_mismatch: int
+    quota_rejected: bool
+    invalid: int
+
+    def message(self) -> str:
+        if self.quota_rejected:
+            return "pod rejected by elastic quota admission"
+        parts = []
+        if self.insufficient_resources:
+            parts.append(f"{self.insufficient_resources} insufficient resources")
+        if self.usage_over_threshold:
+            parts.append(f"{self.usage_over_threshold} usage over threshold")
+        if self.affinity_mismatch:
+            parts.append(f"{self.affinity_mismatch} didn't match node selector")
+        detail = ", ".join(parts) if parts else "no failure recorded"
+        return (f"{self.feasible_nodes}/{self.total_nodes} nodes available: "
+                f"{detail}")
+
+
+def explain_pod(
+    state: ClusterState,
+    pods: PodBatch,
+    cfg: ScoringConfig,
+    pod_idx: int,
+    quota_admitted: bool = True,
+) -> PodDiagnosis:
+    """Stage-by-stage elimination breakdown for one pod of the batch."""
+    req = pods.requests[pod_idx][None, :]
+    pod_est = scoring.estimate_pod_usage_by_band(
+        req, cfg.estimator_factors, cfg.estimator_defaults
+    )
+    valid = np.asarray(state.node_valid)
+    total = int(valid.sum())
+
+    fit = np.asarray(filtering.fit_mask(state.free, req)[0]) & valid
+    inst = filtering.usage_threshold_mask(
+        state.node_usage, state.node_allocatable, cfg.usage_thresholds, pod_est
+    )
+    agg = filtering.usage_threshold_mask(
+        state.node_agg_usage, state.node_allocatable,
+        cfg.agg_usage_thresholds, pod_est,
+    )
+    agg_enabled = bool(jnp.any(cfg.agg_usage_thresholds > 0))
+    thr = np.asarray((agg if agg_enabled else inst)[0]) & valid
+    aff = np.asarray(pods.feasible[pod_idx]) & valid
+
+    feasible = fit & thr & aff
+    # first-fail attribution, in filter order: fit -> thresholds -> affinity
+    fail_fit = valid & ~fit
+    fail_thr = valid & fit & ~thr
+    fail_aff = valid & fit & thr & ~aff
+
+    return PodDiagnosis(
+        total_nodes=total,
+        feasible_nodes=int(feasible.sum()) if quota_admitted else 0,
+        insufficient_resources=int(fail_fit.sum()),
+        usage_over_threshold=int(fail_thr.sum()),
+        affinity_mismatch=int(fail_aff.sum()),
+        quota_rejected=not quota_admitted,
+        invalid=int((~valid).sum()),
+    )

@@ -1,0 +1,56 @@
+"""Scheduler monitor: per-round phase timing with slow-round logging.
+
+Equivalent of ``frameworkext/scheduler_monitor.go:44-100`` — records how long
+each scheduling phase takes, keeps a rolling history, and flags rounds that
+exceed the configured timeout (the reference logs pods stuck in a phase).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict, deque
+
+logger = logging.getLogger("koordinator_tpu.scheduler")
+
+
+class SchedulerMonitor:
+    def __init__(self, timeout_sec: float = 1.0, history: int = 256,
+                 clock=time.perf_counter):
+        self.timeout_sec = timeout_sec
+        self.clock = clock
+        self.phase_history: dict[str, deque[float]] = defaultdict(
+            lambda: deque(maxlen=history)
+        )
+        self.slow_rounds = 0
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        start = self.clock()
+        try:
+            yield
+        finally:
+            elapsed = self.clock() - start
+            self.phase_history[name].append(elapsed)
+            if elapsed > self.timeout_sec:
+                self.slow_rounds += 1
+                logger.warning(
+                    "scheduling phase %s took %.3fs (timeout %.3fs)",
+                    name, elapsed, self.timeout_sec,
+                )
+
+    def stats(self) -> dict[str, dict[str, float]]:
+        out = {}
+        for name, hist in self.phase_history.items():
+            if not hist:
+                continue
+            s = sorted(hist)
+            out[name] = {
+                "count": float(len(s)),
+                "mean": sum(s) / len(s),
+                "p50": s[len(s) // 2],
+                "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
+                "max": s[-1],
+            }
+        return out

@@ -1,0 +1,255 @@
+"""The scheduling loop: batched solve rounds over the pending queue.
+
+Where the reference's scheduleOne loop (SURVEY.md section 3.1) takes one pod per
+cycle through PreFilter->Filter->Score->Reserve->Permit->PreBind->Bind, this
+scheduler drains the whole pending queue through one batched TPU solve per
+round:
+
+  round():
+    PreEnqueue   gang readiness + backoff gating (host)
+    BatchBuild   pad pods to a power-of-two bucket, host affinity masks
+    Solve        gang_assign (filter+score+assign+quota+gang) on device
+    Reserve      adopt the solver's node accounting, charge quotas
+    Bind         callback per placed pod
+    Diagnose     structured reasons for every unplaced pod
+
+Gang Permit semantics map to solve-and-rollback (ops/gang.py); the WaitTime
+state machine survives here: a gang that keeps failing past its wait_time is
+rejected and its pods surface failures (coscheduling core/gang.go WaitTime).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import numpy as np
+
+from koordinator_tpu.ops.assignment import ScoringConfig
+from koordinator_tpu.ops.gang import GangInfo, gang_assign
+from koordinator_tpu.quota.admission import QuotaDeviceState
+from koordinator_tpu.quota.tree import QuotaTree
+from koordinator_tpu.scheduler.diagnosis import PodDiagnosis, explain_pod
+from koordinator_tpu.scheduler.monitor import SchedulerMonitor
+from koordinator_tpu.scheduler.snapshot import ClusterSnapshot, PodSpec
+from koordinator_tpu.state.cluster_state import PodBatch, _bucket
+
+
+@dataclasses.dataclass
+class GangRecord:
+    """Host-side gang state (PodGroup + gang annotations)."""
+
+    name: str
+    min_member: int
+    group: str | None = None
+    wait_time_sec: float = 600.0
+    first_failure: float | None = None
+    rejected: bool = False
+
+
+@dataclasses.dataclass
+class SchedulingResult:
+    assignments: dict[str, str]              # pod -> node
+    failures: dict[str, PodDiagnosis]        # pod -> why
+    round_pods: int = 0
+
+
+class Scheduler:
+    """Batched scheduler over a ClusterSnapshot."""
+
+    def __init__(
+        self,
+        snapshot: ClusterSnapshot,
+        config: ScoringConfig | None = None,
+        quota_tree: QuotaTree | None = None,
+        bind_fn=None,
+        monitor: SchedulerMonitor | None = None,
+        gang_passes: int = 2,
+        clock=time.monotonic,
+    ):
+        self.snapshot = snapshot
+        self.config = config if config is not None else ScoringConfig.default()
+        self.quota_tree = quota_tree
+        self.bind_fn = bind_fn
+        self.monitor = monitor or SchedulerMonitor()
+        self.gang_passes = gang_passes
+        self.clock = clock
+
+        self.pending: dict[str, PodSpec] = {}
+        self.gangs: dict[str, GangRecord] = {}
+        self._solve = jax.jit(gang_assign, static_argnames=("passes",))
+
+    # -- registration -------------------------------------------------------
+
+    def register_gang(self, record: GangRecord) -> None:
+        self.gangs[record.name] = record
+
+    def enqueue(self, pod: PodSpec) -> None:
+        self.pending[pod.name] = pod
+
+    def dequeue(self, pod_name: str) -> None:
+        self.pending.pop(pod_name, None)
+
+    # -- the scheduling round ----------------------------------------------
+
+    def _active_pods(self) -> list[PodSpec]:
+        """PreEnqueue: skip pods of rejected gangs."""
+        out = []
+        for pod in self.pending.values():
+            if pod.gang is not None:
+                gang = self.gangs.get(pod.gang)
+                if gang is not None and gang.rejected:
+                    continue
+            out.append(pod)
+        out.sort(key=lambda p: (-p.priority, p.creation, p.name))
+        return out
+
+    def _build_batch(self, pods: list[PodSpec], gang_index: dict[str, int],
+                     quota_index: dict[str, int]) -> PodBatch:
+        p = len(pods)
+        cap = _bucket(max(p, 1), minimum=16)
+        n_cap = self.snapshot.capacity
+        requests = np.zeros((p, self.snapshot.dims), np.int32)
+        priority = np.zeros(p, np.int32)
+        qos = np.zeros(p, np.int8)
+        gang_id = np.full(p, -1, np.int32)
+        quota_id = np.full(p, -1, np.int32)
+        non_preempt = np.zeros(p, bool)
+        feasible = np.zeros((p, n_cap), bool)
+        for i, pod in enumerate(pods):
+            requests[i] = pod.requests
+            priority[i] = pod.priority
+            qos[i] = pod.qos
+            if pod.gang is not None and pod.gang in gang_index:
+                gang_id[i] = gang_index[pod.gang]
+            if pod.quota is not None and pod.quota in quota_index:
+                quota_id[i] = quota_index[pod.quota]
+            non_preempt[i] = pod.non_preemptible
+            feasible[i] = self.snapshot.feasibility_row(pod)
+        return PodBatch.build(
+            requests, priority=priority, qos=qos, gang_id=gang_id,
+            quota_id=quota_id, non_preemptible=non_preempt,
+            feasible=feasible, node_capacity=n_cap, capacity=cap,
+        )
+
+    def _build_gang_info(self, pods: list[PodSpec]) -> tuple[GangInfo, dict[str, int]]:
+        names = sorted({p.gang for p in pods if p.gang is not None})
+        index = {n: i for i, n in enumerate(names)}
+        groups: dict[str, int] = {}
+        min_member = np.zeros(max(len(names), 1), np.int32)
+        group_id = np.arange(max(len(names), 1), dtype=np.int32)
+        for name, i in index.items():
+            gang = self.gangs.get(name)
+            min_member[i] = gang.min_member if gang else 0
+            if gang and gang.group:
+                group_id[i] = groups.setdefault(gang.group, i)
+        return (
+            GangInfo.build(min_member[: len(names)], group_id[: len(names)])
+            if names else GangInfo.build(np.zeros(0, np.int32)),
+            index,
+        )
+
+    def _build_quota(self) -> tuple[QuotaDeviceState | None, dict[str, int]]:
+        if self.quota_tree is None:
+            return None, {}
+        # GroupQuotaManager duty: a leaf quota's request is what its pods ask
+        # for — already-admitted usage plus this round's pending requests.
+        pending: dict[str, np.ndarray] = {}
+        for pod in self.pending.values():
+            if pod.quota is not None and pod.quota in self.quota_tree.nodes:
+                cur = pending.setdefault(
+                    pod.quota, np.zeros(self.snapshot.dims, np.int64)
+                )
+                cur += pod.requests.astype(np.int64)
+        for name, qnode in self.quota_tree.nodes.items():
+            if self.quota_tree.children[name]:
+                continue  # parents aggregate from children
+            self.quota_tree.set_request(
+                name, qnode.used + pending.get(
+                    name, np.zeros(self.snapshot.dims, np.int64))
+            )
+        self.quota_tree.refresh_runtime()
+        return QuotaDeviceState.from_tree(self.quota_tree)
+
+    def schedule_round(self) -> SchedulingResult:
+        """Solve the current pending queue; reserve, bind, diagnose."""
+        now = self.clock()
+        with self.monitor.phase("PreEnqueue"):
+            pods = self._active_pods()
+        if not pods:
+            return SchedulingResult({}, {}, 0)
+
+        with self.monitor.phase("BatchBuild"):
+            self.snapshot.flush()
+            gangs, gang_index = self._build_gang_info(pods)
+            quota, quota_index = self._build_quota()
+            batch = self._build_batch(pods, gang_index, quota_index)
+
+        with self.monitor.phase("Solve"):
+            assignments, new_state, new_quota = self._solve(
+                self.snapshot.state, batch, self.config, gangs, quota,
+                passes=self.gang_passes,
+            )
+            a = np.asarray(assignments)
+
+        result = SchedulingResult({}, {}, round_pods=len(pods))
+        with self.monitor.phase("Reserve"):
+            self.snapshot.adopt_state(new_state)
+
+        with self.monitor.phase("Bind"):
+            placed_gangs: set[str] = set()
+            for i, pod in enumerate(pods):
+                node_row = int(a[i])
+                if node_row >= 0:
+                    node = self.snapshot.node_name(node_row)
+                    result.assignments[pod.name] = node
+                    del self.pending[pod.name]
+                    if pod.gang:
+                        placed_gangs.add(pod.gang)
+                    if (pod.quota and self.quota_tree is not None
+                            and pod.quota in self.quota_tree.nodes):
+                        q = self.quota_tree.nodes[pod.quota]
+                        q.used = q.used + pod.requests.astype(np.int64)
+                        if pod.non_preemptible:
+                            q.non_preemptible_used = (
+                                q.non_preemptible_used
+                                + pod.requests.astype(np.int64)
+                            )
+                    if self.bind_fn is not None:
+                        self.bind_fn(pod.name, node)
+
+        with self.monitor.phase("Diagnose"):
+            admitted = None
+            if quota is not None:
+                from koordinator_tpu.quota.admission import quota_admission_mask
+
+                admitted = np.asarray(quota_admission_mask(
+                    quota, batch.requests, batch.quota_id, batch.non_preemptible
+                ))
+            failed_gangs: set[str] = set()
+            for i, pod in enumerate(pods):
+                if int(a[i]) >= 0:
+                    continue
+                result.failures[pod.name] = explain_pod(
+                    self.snapshot.state, batch, self.config, i,
+                    quota_admitted=bool(admitted[i]) if admitted is not None else True,
+                )
+                if pod.gang:
+                    failed_gangs.add(pod.gang)
+
+            # gang WaitTime state machine (Permit timeout semantics)
+            for name in failed_gangs - placed_gangs:
+                gang = self.gangs.get(name)
+                if gang is None:
+                    continue
+                if gang.first_failure is None:
+                    gang.first_failure = now
+                elif now - gang.first_failure > gang.wait_time_sec:
+                    gang.rejected = True
+            for name in placed_gangs:
+                gang = self.gangs.get(name)
+                if gang is not None:
+                    gang.first_failure = None
+
+        return result

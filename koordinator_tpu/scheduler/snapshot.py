@@ -1,0 +1,190 @@
+"""Incremental cluster snapshot: informer deltas -> device tensors.
+
+The reference scheduler snapshots its node cache every cycle (upstream
+snapshotting model, SURVEY.md section 5 "race detection"); the TPU rebuild keeps the
+cluster resident on device and applies *deltas*: the host maintains
+name -> row maps and dirty-row buffers, and ``flush()`` ships only changed rows
+(``ClusterState.scatter_update``). Capacity grows by power-of-two buckets so
+recompilation is O(log N) over cluster life (SURVEY.md section 7 hard part (a)/(b)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+from koordinator_tpu.state.cluster_state import ClusterState, _bucket
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class NodeSpec:
+    """Host-side node record (what the Node informer + NodeMetric deliver)."""
+
+    name: str
+    allocatable: np.ndarray                 # (R,) int32
+    usage: np.ndarray | None = None         # (R,) int32
+    agg_usage: np.ndarray | None = None     # (R,) int32
+    prod_usage: np.ndarray | None = None    # (R,) int32
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class PodSpec:
+    """Host-side pending pod (what the webhook-mutated Pod object carries)."""
+
+    name: str
+    requests: np.ndarray                    # (R,) int32
+    priority: int = 0
+    qos: int = 0
+    gang: str | None = None
+    quota: str | None = None
+    non_preemptible: bool = False
+    node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    creation: float = 0.0
+
+
+class ClusterSnapshot:
+    """Name-indexed view over the device-resident ClusterState."""
+
+    def __init__(self, capacity: int = 64, dims: int = NUM_RESOURCE_DIMS):
+        self.dims = dims
+        self.state = ClusterState.zeros(capacity, dims)
+        self.node_index: dict[str, int] = {}
+        self._row_to_name: dict[int, str] = {}
+        self.node_specs: dict[str, NodeSpec] = {}
+        self._free_rows: list[int] = list(range(capacity - 1, -1, -1))
+        self._dirty: set[int] = set()
+        # rows whose solver-accumulated node_requested must be zeroed at next
+        # flush (freed by remove_node; a reused row must not inherit the dead
+        # node's accounting)
+        self._reset_requested: set[int] = set()
+
+    @property
+    def capacity(self) -> int:
+        return self.state.capacity
+
+    # -- node lifecycle -----------------------------------------------------
+
+    def upsert_node(self, spec: NodeSpec) -> int:
+        row = self.node_index.get(spec.name)
+        if row is None:
+            if not self._free_rows:
+                self._grow()
+            row = self._free_rows.pop()
+            self.node_index[spec.name] = row
+            self._row_to_name[row] = spec.name
+        self.node_specs[spec.name] = spec
+        self._dirty.add(row)
+        return row
+
+    def remove_node(self, name: str) -> None:
+        row = self.node_index.pop(name, None)
+        if row is None:
+            return
+        del self.node_specs[name]
+        del self._row_to_name[row]
+        self._free_rows.append(row)
+        self._dirty.add(row)
+        self._reset_requested.add(row)
+
+    def _grow(self) -> None:
+        old_cap = self.capacity
+        new_cap = _bucket(old_cap + 1)
+        old = self.state
+
+        def pad(a):
+            out = np.zeros((new_cap,) + a.shape[1:], a.dtype)
+            out[:old_cap] = np.asarray(a)
+            return jnp.asarray(out)
+
+        self.state = ClusterState(
+            node_allocatable=pad(old.node_allocatable),
+            node_requested=pad(old.node_requested),
+            node_usage=pad(old.node_usage),
+            node_agg_usage=pad(old.node_agg_usage),
+            node_prod_usage=pad(old.node_prod_usage),
+            node_valid=pad(old.node_valid),
+        )
+        self._free_rows = list(range(new_cap - 1, old_cap - 1, -1)) + self._free_rows
+
+    # -- delta flush ---------------------------------------------------------
+
+    def flush(self) -> int:
+        """Ship dirty rows to device in one scatter. Returns rows shipped."""
+        if not self._dirty:
+            return 0
+        rows = sorted(self._dirty)
+        self._dirty.clear()
+        if self._reset_requested:
+            reset = jnp.asarray(sorted(self._reset_requested), dtype=jnp.int32)
+            self._reset_requested.clear()
+            self.state = self.state.replace(
+                node_requested=self.state.node_requested.at[reset].set(0)
+            )
+        k = len(rows)
+        alloc = np.zeros((k, self.dims), np.int32)
+        usage = np.zeros((k, self.dims), np.int32)
+        agg = np.zeros((k, self.dims), np.int32)
+        prod = np.zeros((k, self.dims), np.int32)
+        valid = np.zeros(k, bool)
+        for i, r in enumerate(rows):
+            name = self._row_to_name.get(r)
+            if name is None:
+                continue  # removed node: stays zero/invalid
+            spec = self.node_specs[name]
+            alloc[i] = spec.allocatable
+            if spec.usage is not None:
+                usage[i] = spec.usage
+            agg[i] = spec.agg_usage if spec.agg_usage is not None else usage[i]
+            prod[i] = spec.prod_usage if spec.prod_usage is not None else usage[i]
+            valid[i] = True
+        idx = jnp.asarray(np.asarray(rows, np.int32))
+        self.state = self.state.scatter_update(
+            idx,
+            node_allocatable=jnp.asarray(alloc),
+            node_usage=jnp.asarray(usage),
+            node_agg_usage=jnp.asarray(agg),
+            node_prod_usage=jnp.asarray(prod),
+            node_valid=jnp.asarray(valid),
+        )
+        return k
+
+    # -- accounting ---------------------------------------------------------
+
+    def reserve(self, node: str, requests: np.ndarray) -> None:
+        """Account a binding onto a node (Reserve)."""
+        row = self.node_index[node]
+        self.state = self.state.add_pod(
+            jnp.asarray(np.int32(row)), jnp.asarray(requests.astype(np.int32))
+        )
+
+    def unreserve(self, node: str, requests: np.ndarray) -> None:
+        row = self.node_index[node]
+        self.state = self.state.remove_pod(
+            jnp.asarray(np.int32(row)), jnp.asarray(requests.astype(np.int32))
+        )
+
+    def adopt_state(self, state: ClusterState) -> None:
+        """Adopt solver-updated accounting (post gang/greedy assign)."""
+        if state.capacity != self.capacity:
+            raise ValueError("state capacity mismatch")
+        self.state = state
+
+    # -- queries ------------------------------------------------------------
+
+    def node_name(self, row: int) -> str | None:
+        return self._row_to_name.get(row)
+
+    def feasibility_row(self, pod: PodSpec) -> np.ndarray:
+        """(N,) bool host-computed label-selector mask for one pod."""
+        mask = np.zeros(self.capacity, bool)
+        for name, row in self.node_index.items():
+            labels = self.node_specs[name].labels
+            mask[row] = all(
+                labels.get(k) == v for k, v in pod.node_selector.items()
+            )
+        return mask

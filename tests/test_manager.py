@@ -129,8 +129,8 @@ def test_amplification_no_int32_overflow_above_100pct():
     from koordinator_tpu.manager.noderesource import amplify_capacity
     from koordinator_tpu.state.cluster_state import MAX_QUANTITY
 
-    cap = arr(20_000_000)  # near the MAX_QUANTITY bound
-    out = amplify_capacity(cap, arr(150))
-    assert int(out[0]) == 30_000_000  # would wrap negative with naive *150
-    assert int(amplify_capacity(arr(MAX_QUANTITY), arr(101))[0]) == \
-        MAX_QUANTITY + MAX_QUANTITY // 100
+    out = amplify_capacity(arr(10_000_000), arr(150))
+    assert int(out[0]) == 15_000_000  # would wrap negative with naive *150
+    # results are clamped at MAX_QUANTITY to preserve the int32 invariant
+    assert int(amplify_capacity(arr(20_000_000), arr(150))[0]) == MAX_QUANTITY
+    assert int(amplify_capacity(arr(MAX_QUANTITY), arr(101))[0]) == MAX_QUANTITY

@@ -1,0 +1,187 @@
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim, resource_vector
+from koordinator_tpu.ops.assignment import ScoringConfig
+from koordinator_tpu.quota.tree import UNBOUNDED, QuotaTree
+from koordinator_tpu.scheduler import ClusterSnapshot, NodeSpec, PodSpec, Scheduler
+from koordinator_tpu.scheduler.scheduler import GangRecord
+
+R = NUM_RESOURCE_DIMS
+CPU, MEM = ResourceDim.CPU, ResourceDim.MEMORY
+
+
+def plain_cfg():
+    return ScoringConfig.default().replace(
+        usage_thresholds=jnp.zeros(R, jnp.int32),
+        estimator_defaults=jnp.zeros(R, jnp.int32),
+    )
+
+
+def node(name, cpu=16_000, mem=65_536, usage_cpu=0, labels=None):
+    usage = np.zeros(R, np.int32)
+    usage[CPU] = usage_cpu
+    return NodeSpec(
+        name=name,
+        allocatable=resource_vector(cpu=cpu, memory=mem),
+        usage=usage,
+        labels=labels or {},
+    )
+
+
+def pod(name, cpu=1_000, mem=1_024, **kw):
+    return PodSpec(name=name, requests=resource_vector(cpu=cpu, memory=mem), **kw)
+
+
+def mk_scheduler(nodes, **kw):
+    snap = ClusterSnapshot(capacity=16)
+    for n in nodes:
+        snap.upsert_node(n)
+    binds = []
+    sched = Scheduler(
+        snap, config=kw.pop("config", plain_cfg()),
+        bind_fn=lambda p, n: binds.append((p, n)), **kw,
+    )
+    return sched, binds
+
+
+def test_basic_round_binds_pods():
+    sched, binds = mk_scheduler([node("n1"), node("n2")])
+    sched.enqueue(pod("p1", cpu=4_000))
+    sched.enqueue(pod("p2", cpu=4_000))
+    res = sched.schedule_round()
+    assert set(res.assignments) == {"p1", "p2"}
+    assert not res.failures
+    assert len(binds) == 2
+    assert not sched.pending
+    # accounting persists: a third round sees the reserved capacity
+    sched.enqueue(pod("p3", cpu=14_000))
+    res2 = sched.schedule_round()
+    assert "p3" in res2.failures  # 12k free per node at most
+    msg = res2.failures["p3"].message()
+    assert "insufficient resources" in msg
+
+
+def test_node_selector_routes_pod():
+    sched, _ = mk_scheduler([
+        node("gpu-node", labels={"pool": "gpu"}),
+        node("cpu-node", labels={"pool": "cpu"}),
+    ])
+    sched.enqueue(pod("p1", node_selector={"pool": "gpu"}))
+    res = sched.schedule_round()
+    assert res.assignments["p1"] == "gpu-node"
+
+
+def test_node_remove_and_delta_flush():
+    sched, _ = mk_scheduler([node("n1"), node("n2")])
+    sched.snapshot.remove_node("n2")
+    sched.enqueue(pod("p1", node_selector={}))
+    res = sched.schedule_round()
+    assert res.assignments["p1"] == "n1"
+    # re-add with new capacity; delta flush picks it up
+    sched.snapshot.upsert_node(node("n2", cpu=32_000))
+    sched.enqueue(pod("p2", cpu=20_000))
+    res2 = sched.schedule_round()
+    assert res2.assignments["p2"] == "n2"
+
+
+def test_snapshot_grows_past_capacity():
+    snap = ClusterSnapshot(capacity=4)
+    for i in range(10):
+        snap.upsert_node(node(f"n{i}"))
+    snap.flush()
+    assert snap.capacity >= 10
+    assert int(np.asarray(snap.state.node_valid).sum()) == 10
+
+
+def test_gang_wait_time_rejection():
+    t = [0.0]
+    sched, _ = mk_scheduler([node("n1", cpu=4_000)], clock=lambda: t[0])
+    sched.register_gang(GangRecord(name="g", min_member=2, wait_time_sec=100))
+    sched.enqueue(pod("g1", cpu=3_000, gang="g"))
+    sched.enqueue(pod("g2", cpu=3_000, gang="g"))
+    res = sched.schedule_round()
+    assert not res.assignments  # gang can't fit together
+    t[0] = 50.0
+    sched.schedule_round()
+    assert not sched.gangs["g"].rejected
+    t[0] = 200.0
+    sched.schedule_round()  # past wait time -> rejected
+    assert sched.gangs["g"].rejected
+    # rejected gang pods no longer enter rounds
+    res4 = sched.schedule_round()
+    assert res4.round_pods == 0
+
+
+def test_gang_schedules_when_feasible():
+    sched, binds = mk_scheduler([node("n1"), node("n2")])
+    sched.register_gang(GangRecord(name="g", min_member=3))
+    for i in range(3):
+        sched.enqueue(pod(f"g{i}", cpu=6_000, gang="g"))
+    res = sched.schedule_round()
+    assert len(res.assignments) == 3
+
+
+def test_quota_accounting_across_rounds():
+    mx = np.full(R, UNBOUNDED, np.int64)
+    mx[CPU], mx[MEM] = 5_000, 131_072
+    tree = QuotaTree(resource_vector(cpu=32_000, memory=131_072).astype(np.int64))
+    tree.add("team", min=np.zeros(R, np.int64), max=mx)
+    sched, _ = mk_scheduler([node("n1"), node("n2")], quota_tree=tree)
+
+    sched.enqueue(pod("p1", cpu=3_000, quota="team"))
+    res1 = sched.schedule_round()
+    assert "p1" in res1.assignments
+    # round 2: only 2000m quota left
+    sched.enqueue(pod("p2", cpu=3_000, quota="team"))
+    res2 = sched.schedule_round()
+    assert "p2" in res2.failures
+    assert res2.failures["p2"].quota_rejected or res2.failures["p2"].feasible_nodes == 0
+    sched.enqueue(pod("p3", cpu=1_500, quota="team"))
+    res3 = sched.schedule_round()
+    assert "p3" in res3.assignments
+
+
+def test_row_reuse_does_not_inherit_requested():
+    # bind onto n2, remove it, add n3 (reuses the row): n3 must start clean
+    sched, _ = mk_scheduler([node("n1", cpu=1_000), node("n2")])
+    sched.enqueue(pod("p1", cpu=15_000))
+    res = sched.schedule_round()
+    assert res.assignments["p1"] == "n2"
+    sched.snapshot.remove_node("n2")
+    sched.snapshot.upsert_node(node("n3"))
+    sched.enqueue(pod("p2", cpu=15_000))  # only fits a clean 16k node
+    res2 = sched.schedule_round()
+    assert res2.assignments.get("p2") == "n3"
+
+
+def test_unknown_quota_name_does_not_crash_bind():
+    tree = QuotaTree(resource_vector(cpu=32_000, memory=131_072).astype(np.int64))
+    mx = np.full(R, UNBOUNDED, np.int64)
+    mx[CPU] = 32_000
+    tree.add("real", min=np.zeros(R, np.int64), max=mx)
+    sched, binds = mk_scheduler([node("n1")], quota_tree=tree)
+    sched.enqueue(pod("p1", quota="typo-not-a-quota"))
+    res = sched.schedule_round()
+    assert "p1" in res.assignments  # quota_id -1: schedules unconstrained
+    assert binds
+
+
+def test_monitor_collects_phase_stats():
+    sched, _ = mk_scheduler([node("n1")])
+    sched.enqueue(pod("p1"))
+    sched.schedule_round()
+    stats = sched.monitor.stats()
+    for phase in ("PreEnqueue", "BatchBuild", "Solve", "Bind"):
+        assert phase in stats
+        assert stats[phase]["count"] >= 1
+
+
+def test_diagnosis_message_shape():
+    sched, _ = mk_scheduler([node("n1", cpu=1_000)])
+    sched.enqueue(pod("big", cpu=50_000))
+    res = sched.schedule_round()
+    d = res.failures["big"]
+    assert d.total_nodes == 1
+    assert d.insufficient_resources == 1
+    assert "1 insufficient resources" in d.message()
