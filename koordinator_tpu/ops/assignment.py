@@ -157,26 +157,28 @@ def score_pods(
     return scores, feasible
 
 
-def greedy_assign(
+def _greedy_scan(
     state: ClusterState,
     pods: PodBatch,
     cfg: ScoringConfig,
     quota=None,
+    rsv=None,
+    match=None,
+    rsv_boost: int = 10_000,
 ):
-    """Assign a whole pending batch sequentially in priority order.
+    """Shared sequential-assignment scan (the single source of truth for both
+    plain and reservation-aware greedy assignment).
 
-    Returns (assignments, new_state, new_quota). new_quota is None unless a
-    :class:`~koordinator_tpu.quota.QuotaDeviceState` is given, in which case
-    each pod must also pass the elastic-quota admission check and Reserve-time
-    quota accounting feeds back within the batch.
-
-    assignments is (P,) int32 node index per pod (original batch order),
-    -1 = unschedulable; new_state carries the updated node_requested
-    accounting (Reserve semantics).
-
-    Determinism: ties break toward the lowest node index (the reference's
-    selectHost randomizes among maxima; we fix the choice for reproducibility).
+    Returns (assignments, rsv_choice, new_state, new_rsv, new_quota); the
+    reservation outputs are None when ``rsv`` is None.
     """
+    from koordinator_tpu.ops.reservation import (
+        allocate_from_reservation,
+        nominate_reservation,
+        reservation_fit,
+        reservation_node_mask,
+    )
+
     order = jnp.lexsort((jnp.arange(pods.capacity), -pods.priority))
 
     pod_est_all = scoring.estimate_pod_usage_by_band(
@@ -187,7 +189,7 @@ def greedy_assign(
         # est_added accumulates in-flight pods' estimated usage (the
         # reference's pod-assign cache) on top of whichever usage base the
         # threshold policy selects.
-        requested, est_added, qstate = carry
+        requested, est_added, cur_rsv, qstate = carry
         req = pods.requests[idx]          # (R,)
         pod_est = pod_est_all[idx]        # (R,)
         valid = pods.valid[idx]
@@ -196,6 +198,10 @@ def greedy_assign(
             state.node_valid[:, None], state.node_allocatable - requested, 0
         )
         fits = jnp.all((req[None, :] <= free) | (req[None, :] == 0), axis=-1)
+        if cur_rsv is not None:
+            fits_v = reservation_fit(cur_rsv, free, req[None, :], match[idx][None])[0]
+            via_rsv = reservation_node_mask(fits_v[None], cur_rsv, state.capacity)[0]
+            fits = fits | via_rsv
         feasible = (
             fits
             & _threshold_mask(
@@ -221,27 +227,68 @@ def greedy_assign(
             state.node_usage + est_added,
             req[None, :], pod_est[None, :],
         )[0]
+        if cur_rsv is not None:
+            scores = scores + jnp.where(via_rsv, rsv_boost, 0)
         masked = jnp.where(feasible, scores, -1)
         best = jnp.argmax(masked)
         assigned = masked[best] >= 0
         node = jnp.where(assigned, best, -1)
 
-        add = jnp.where(assigned, req, 0)
+        if cur_rsv is not None:
+            r_idx = nominate_reservation(fits_v[None], cur_rsv, node[None])[0]
+            r_idx = jnp.where(assigned, r_idx, -1)
+            cur_rsv, spill = allocate_from_reservation(cur_rsv, r_idx, req)
+            add = jnp.where(assigned, spill, 0)
+        else:
+            r_idx = jnp.int32(-1)
+            add = jnp.where(assigned, req, 0)
         add_est = jnp.where(assigned, pod_est, 0)
         requested = requested.at[best].add(add)
         est_added = est_added.at[best].add(add_est)
         if qstate is not None:
             qstate = charge_quota(
-                qstate, add, jnp.where(assigned, pods.quota_id[idx], -1),
+                qstate, jnp.where(assigned, req, 0),
+                jnp.where(assigned, pods.quota_id[idx], -1),
                 non_preemptible=pods.non_preemptible[idx],
             )
-        return (requested, est_added, qstate), node
+        return (requested, est_added, cur_rsv, qstate), (node, r_idx)
 
-    (requested, _, new_quota), nodes_in_order = jax.lax.scan(
+    (requested, _, new_rsv, new_quota), (nodes_in_order, rsv_in_order) = jax.lax.scan(
         step,
-        (state.node_requested, jnp.zeros_like(state.node_usage), quota),
+        (state.node_requested, jnp.zeros_like(state.node_usage), rsv, quota),
         order,
     )
     assignments = jnp.full(pods.capacity, -1, jnp.int32).at[order].set(nodes_in_order)
+    rsv_choice = (
+        jnp.full(pods.capacity, -1, jnp.int32).at[order].set(rsv_in_order)
+        if rsv is not None
+        else None
+    )
     new_state = state.replace(node_requested=requested)
+    return assignments, rsv_choice, new_state, new_rsv, new_quota
+
+
+def greedy_assign(
+    state: ClusterState,
+    pods: PodBatch,
+    cfg: ScoringConfig,
+    quota=None,
+):
+    """Assign a whole pending batch sequentially in priority order.
+
+    Returns (assignments, new_state, new_quota). new_quota is None unless a
+    :class:`~koordinator_tpu.quota.QuotaDeviceState` is given, in which case
+    each pod must also pass the elastic-quota admission check and Reserve-time
+    quota accounting feeds back within the batch.
+
+    assignments is (P,) int32 node index per pod (original batch order),
+    -1 = unschedulable; new_state carries the updated node_requested
+    accounting (Reserve semantics).
+
+    Determinism: ties break toward the lowest node index (the reference's
+    selectHost randomizes among maxima; we fix the choice for reproducibility).
+    """
+    assignments, _, new_state, _, new_quota = _greedy_scan(
+        state, pods, cfg, quota=quota
+    )
     return assignments, new_state, new_quota

@@ -1,0 +1,194 @@
+"""Host-side reservation lifecycle: phases, owner matching, expiration.
+
+Mirrors the reference's reservation cache + controller
+(pkg/scheduler/plugins/reservation/cache.go, controller/, and the phase
+machine in apis/scheduling/v1alpha1/reservation_types.go: Pending ->
+Available -> Succeeded | Failed/Expired). The branchy lifecycle stays on the
+host (SURVEY.md section 7 hard part (e)); only the Available set is shipped to
+the device as a :class:`~koordinator_tpu.ops.reservation.ReservationSet`.
+
+Owner matching (reservation_types.go OwnerMatchers: label selector and/or
+controller reference) is evaluated host-side into a dense (pods x
+reservations) boolean matrix consumed by the fit kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import numpy as np
+
+from koordinator_tpu.ops.reservation import ReservationSet
+from koordinator_tpu.scheduler.snapshot import ClusterSnapshot, PodSpec
+
+
+class ReservationPhase(enum.Enum):
+    PENDING = "Pending"        # created, not yet placed on a node
+    AVAILABLE = "Available"    # placed; owners may allocate
+    SUCCEEDED = "Succeeded"    # allocate-once consumed / all owners bound
+    FAILED = "Failed"
+    EXPIRED = "Expired"
+
+
+@dataclasses.dataclass
+class OwnerMatcher:
+    """One OwnerMatchers entry: pod matches if all selector kv-pairs match
+    its labels AND (if set) its controller key equals ``controller``."""
+
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    controller: str | None = None
+
+    def matches(self, pod: PodSpec) -> bool:
+        pod_labels = getattr(pod, "labels", {}) or {}
+        if any(pod_labels.get(k) != v for k, v in self.labels.items()):
+            return False
+        if self.controller is not None:
+            if getattr(pod, "owner", None) != self.controller:
+                return False
+        return True
+
+
+@dataclasses.dataclass
+class ReservationSpec:
+    name: str
+    requests: np.ndarray                    # (R,) reserved vector
+    owners: list[OwnerMatcher] = dataclasses.field(default_factory=list)
+    allocate_once: bool = False
+    restricted: bool = False                # AllocatePolicy Restricted vs Aligned
+    ttl_sec: float | None = None            # spec.ttl; None = never expires
+    node: str | None = None                 # pre-pinned node (spec.template nodeName)
+
+    # status
+    phase: ReservationPhase = ReservationPhase.PENDING
+    allocated: np.ndarray | None = None     # (R,)
+    owner_pods: list[str] = dataclasses.field(default_factory=list)
+    available_at: float = 0.0
+
+
+class ReservationCache:
+    """Name-keyed reservation store + device-tensor builder."""
+
+    def __init__(self) -> None:
+        self._specs: dict[str, ReservationSpec] = {}
+
+    def __len__(self) -> int:
+        return len(self._specs)
+
+    def get(self, name: str) -> ReservationSpec | None:
+        return self._specs.get(name)
+
+    def upsert(self, spec: ReservationSpec) -> None:
+        self._specs[spec.name] = spec
+
+    def remove(self, name: str, snapshot: ClusterSnapshot | None = None) -> None:
+        spec = self._specs.pop(name, None)
+        if spec is None:
+            return
+        if snapshot is not None and spec.phase is ReservationPhase.AVAILABLE:
+            self._return_remainder(spec, snapshot)
+
+    def make_available(
+        self, name: str, node: str, snapshot: ClusterSnapshot, now: float = 0.0
+    ) -> None:
+        """The reserve-pod got 'bound': charge the full reserved vector to the
+        node (so ordinary pods can't see it) and open the reservation."""
+        spec = self._specs[name]
+        spec.node = node
+        spec.phase = ReservationPhase.AVAILABLE
+        spec.available_at = now
+        spec.allocated = np.zeros_like(spec.requests)
+        snapshot.reserve(node, spec.requests)
+
+    def expire_tick(self, now: float, snapshot: ClusterSnapshot) -> list[str]:
+        """Expire Available reservations past their TTL; the unallocated
+        remainder returns to node free capacity (controller/ expiration)."""
+        expired = []
+        for spec in self._specs.values():
+            if (
+                spec.phase is ReservationPhase.AVAILABLE
+                and spec.ttl_sec is not None
+                and now - spec.available_at >= spec.ttl_sec
+            ):
+                spec.phase = ReservationPhase.EXPIRED
+                self._return_remainder(spec, snapshot)
+                expired.append(spec.name)
+        return expired
+
+    def _return_remainder(self, spec: ReservationSpec, snapshot: ClusterSnapshot) -> None:
+        remainder = spec.requests - (
+            spec.allocated if spec.allocated is not None else 0
+        )
+        # The node may have been deleted since the reservation became
+        # Available; its accounting died with the node row — nothing to return.
+        if spec.node is not None and spec.node in snapshot.node_index:
+            snapshot.unreserve(spec.node, np.maximum(remainder, 0))
+
+    # -- device tensor builders ------------------------------------------------
+
+    def available(self) -> list[ReservationSpec]:
+        return [
+            s for s in self._specs.values() if s.phase is ReservationPhase.AVAILABLE
+        ]
+
+    def build_set(
+        self, snapshot: ClusterSnapshot, capacity: int | None = None
+    ) -> tuple[ReservationSet, list[str]]:
+        """(device set, row->name map) over Available reservations."""
+        avail = self.available()
+        names = [s.name for s in avail]
+        if not avail:
+            return ReservationSet.zeros(capacity or 16), names
+        reserved = np.stack([s.requests for s in avail]).astype(np.int32)
+        allocated = np.stack(
+            [s.allocated if s.allocated is not None else np.zeros_like(s.requests)
+             for s in avail]
+        ).astype(np.int32)
+        node_idx = np.array(
+            [snapshot.node_index.get(s.node, -1) if s.node else -1 for s in avail],
+            np.int32,
+        )
+        return (
+            ReservationSet.build(
+                reserved,
+                node_idx,
+                allocated=allocated,
+                allocate_once=np.array([s.allocate_once for s in avail]),
+                restricted=np.array([s.restricted for s in avail]),
+                capacity=capacity,
+            ),
+            names,
+        )
+
+    def match_matrix(self, pods: list[PodSpec], pod_capacity: int,
+                     rsv_capacity: int) -> np.ndarray:
+        """(P, V) bool owner-match matrix for the Available set."""
+        avail = self.available()
+        out = np.zeros((pod_capacity, rsv_capacity), bool)
+        for j, spec in enumerate(avail[:rsv_capacity]):
+            for i, pod in enumerate(pods[:pod_capacity]):
+                out[i, j] = any(m.matches(pod) for m in spec.owners)
+        return out
+
+    def commit_allocations(
+        self,
+        names: list[str],
+        pods: list[PodSpec],
+        assignments: np.ndarray,     # (P,) node rows
+        rsv_choice: np.ndarray,      # (P,) reservation rows, -1 = none
+    ) -> None:
+        """Mirror the device-side allocation back into host specs (Reserve)."""
+        for i, pod in enumerate(pods):
+            r = int(rsv_choice[i])
+            if r < 0 or r >= len(names) or int(assignments[i]) < 0:
+                continue
+            spec = self._specs.get(names[r])
+            if spec is None or spec.allocated is None:
+                continue
+            remainder = np.maximum(spec.requests - spec.allocated, 0)
+            take = np.minimum(pod.requests.astype(np.int64), remainder)
+            spec.allocated = spec.allocated + take.astype(spec.allocated.dtype)
+            spec.owner_pods.append(pod.name)
+            if spec.allocate_once:
+                spec.allocated = spec.requests.copy()
+                spec.phase = ReservationPhase.SUCCEEDED

@@ -45,6 +45,8 @@ class PodSpec:
     non_preemptible: bool = False
     node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
     creation: float = 0.0
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    owner: str | None = None               # controller key for reservation owner match
 
 
 class ClusterSnapshot:
