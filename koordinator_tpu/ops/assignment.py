@@ -179,6 +179,9 @@ def _greedy_scan(
         reservation_node_mask,
     )
 
+    if match is not None:
+        match = jnp.asarray(match)  # host producers hand over np.ndarray
+
     order = jnp.lexsort((jnp.arange(pods.capacity), -pods.priority))
 
     pod_est_all = scoring.estimate_pod_usage_by_band(

@@ -117,10 +117,12 @@ def reservation_fit(
     Mirrors plugin.go's per-reservation fit during Filter with the restore
     transformer applied (transformer.go), per allocate policy.
     """
-    active = rsv.valid & (rsv.node_idx >= 0)
     rows = jnp.clip(rsv.node_idx, 0)
     free_at = node_free[rows]                       # (V, R)
     rem = rsv.remaining                             # (V, R)
+    # Exhausted rows (e.g. consumed allocate-once) are no longer a reservation
+    # anyone can allocate through — without this they'd keep the score boost.
+    active = rsv.valid & (rsv.node_idx >= 0) & jnp.any(rem > 0, axis=-1)
     req = requests[:, None, :]                      # (P, 1, R)
 
     # req == 0 dims must not exclude (allocatable can shrink below what is

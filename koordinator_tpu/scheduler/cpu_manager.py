@@ -1,0 +1,135 @@
+"""Host-side CPU allocation manager: per-node topology registry + commits.
+
+The Reserve-phase counterpart of the reference's nodenumaresource
+resource_manager (pkg/scheduler/plugins/nodenumaresource/resource_manager.go:
+allocateCPUSet, Update/Release) — tracks per-node per-cpu reference counts and
+exclusivity, calls the :mod:`koordinator_tpu.ops.numa` take kernel, and
+produces the cpuset annotation payload (apis/extension/numa_aware.go
+resource-status) that the node agent's cpuset hook applies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.ops.numa import (
+    BIND_DEFAULT,
+    EXCLUSIVE_NONE,
+    EXCLUSIVE_NUMA_LEVEL,
+    EXCLUSIVE_PCPU_LEVEL,
+    STRATEGY_MOST_ALLOCATED,
+    CPUTopology,
+    take_cpus,
+)
+
+
+@dataclasses.dataclass
+class CPUAllocation:
+    pod: str
+    cpus: list[int]
+    exclusive_policy: int = EXCLUSIVE_NONE
+
+
+@dataclasses.dataclass
+class NodeCPUState:
+    topology: CPUTopology
+    ref_count: np.ndarray                    # (C,) int32
+    max_ref: int = 1
+    allocations: dict[str, CPUAllocation] = dataclasses.field(default_factory=dict)
+
+
+class CPUManager:
+    """Registry of node CPU topologies + allocation bookkeeping."""
+
+    def __init__(self) -> None:
+        self._nodes: dict[str, NodeCPUState] = {}
+
+    def register_node(
+        self, name: str, topology: CPUTopology, max_ref: int = 1
+    ) -> None:
+        self._nodes[name] = NodeCPUState(
+            topology=topology,
+            ref_count=np.zeros(topology.capacity, np.int32),
+            max_ref=max_ref,
+        )
+
+    def node(self, name: str) -> NodeCPUState | None:
+        return self._nodes.get(name)
+
+    def _banned_mask(self, st: NodeCPUState, pod_policy: int) -> np.ndarray:
+        """CPUs excluded by other pods' exclusivity or by this pod's own
+        exclusivity requirement (isCPUExclusivePCPULevel/NUMANodeLevel)."""
+        topo = st.topology
+        core_of = np.asarray(topo.core_of)
+        numa_of = np.asarray(topo.numa_of)
+        banned = np.zeros(topo.capacity, bool)
+        for alloc in st.allocations.values():
+            if alloc.exclusive_policy == EXCLUSIVE_PCPU_LEVEL:
+                banned |= np.isin(core_of, core_of[alloc.cpus])
+            elif alloc.exclusive_policy == EXCLUSIVE_NUMA_LEVEL:
+                banned |= np.isin(numa_of, numa_of[alloc.cpus])
+            elif pod_policy == EXCLUSIVE_PCPU_LEVEL and alloc.cpus:
+                # This pod wants whole cores: cores already referenced by
+                # anyone are off limits.
+                banned |= np.isin(core_of, core_of[alloc.cpus])
+            elif pod_policy == EXCLUSIVE_NUMA_LEVEL and alloc.cpus:
+                # This pod wants whole NUMA nodes to itself.
+                banned |= np.isin(numa_of, numa_of[alloc.cpus])
+        return banned
+
+    def allocate(
+        self,
+        node: str,
+        pod: str,
+        n_cpus: int,
+        bind_policy: int = BIND_DEFAULT,
+        strategy: int = STRATEGY_MOST_ALLOCATED,
+        exclusive_policy: int = EXCLUSIVE_NONE,
+    ) -> list[int] | None:
+        """Pick and commit a cpuset; returns sorted cpu ids or None."""
+        st = self._nodes.get(node)
+        if st is None:
+            return None
+        if pod in st.allocations:  # re-allocate: drop the old cpuset first
+            self.release(node, pod)
+        banned = self._banned_mask(st, exclusive_policy)
+        selected, ok = take_cpus(
+            st.topology,
+            jnp.asarray(st.ref_count),
+            jnp.int32(st.max_ref),
+            jnp.int32(n_cpus),
+            bind_policy=bind_policy,
+            strategy=strategy,
+            banned=jnp.asarray(banned),
+        )
+        if not bool(ok):
+            return None
+        cpus = sorted(int(i) for i in np.flatnonzero(np.asarray(selected)))
+        st.ref_count[cpus] += 1
+        st.allocations[pod] = CPUAllocation(pod, cpus, exclusive_policy)
+        return cpus
+
+    def release(self, node: str, pod: str) -> None:
+        st = self._nodes.get(node)
+        if st is None:
+            return
+        alloc = st.allocations.pop(pod, None)
+        if alloc is not None:
+            st.ref_count[alloc.cpus] -= 1
+
+    def resource_status(self, node: str, pod: str) -> dict | None:
+        """The scheduling.koordinator.sh/resource-status annotation payload."""
+        st = self._nodes.get(node)
+        if st is None or pod not in st.allocations:
+            return None
+        alloc = st.allocations[pod]
+        numa_of = np.asarray(st.topology.numa_of)
+        return {
+            "cpuset": ",".join(str(c) for c in alloc.cpus),
+            "numaNodeResources": sorted(
+                {int(numa_of[c]) for c in alloc.cpus}
+            ),
+        }

@@ -183,7 +183,12 @@ class ReservationCache:
             if r < 0 or r >= len(names) or int(assignments[i]) < 0:
                 continue
             spec = self._specs.get(names[r])
-            if spec is None or spec.allocated is None:
+            if (
+                spec is None
+                or spec.allocated is None
+                or spec.phase is not ReservationPhase.AVAILABLE
+                or not np.any(spec.requests > spec.allocated)
+            ):
                 continue
             remainder = np.maximum(spec.requests - spec.allocated, 0)
             take = np.minimum(pod.requests.astype(np.int64), remainder)

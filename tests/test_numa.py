@@ -1,0 +1,202 @@
+"""NUMA/cpuset semantics: take-by-topology, hints, topology-manager merge.
+
+Behavior mirrors pkg/scheduler/plugins/nodenumaresource/cpu_accumulator_test.go
+scenarios and frameworkext/topologymanager policy tests.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.ops.numa import (
+    BIND_FULL_PCPUS,
+    BIND_SPREAD_BY_PCPUS,
+    EXCLUSIVE_PCPU_LEVEL,
+    MAX_NUMA,
+    POLICY_BEST_EFFORT,
+    POLICY_NONE,
+    POLICY_RESTRICTED,
+    POLICY_SINGLE_NUMA_NODE,
+    STRATEGY_LEAST_ALLOCATED,
+    STRATEGY_MOST_ALLOCATED,
+    CPUTopology,
+    cpuset_fit,
+    cpuset_fit_batched,
+    merge_hints,
+    numa_hints,
+    numa_score,
+    preferred_mask,
+    take_cpus,
+)
+from koordinator_tpu.scheduler.cpu_manager import CPUManager
+
+import jax
+
+
+def topo_2numa():
+    # 1 socket, 2 NUMA nodes, 4 cores each, 2 threads/core = 16 cpus.
+    return CPUTopology.uniform(sockets=1, numa_per_socket=2, cores_per_numa=4)
+
+
+def free_all(topo):
+    return jnp.zeros(topo.capacity, jnp.int32)
+
+
+def taken(topo, rc, n, **kw):
+    sel, ok = take_cpus(topo, rc, jnp.int32(1), jnp.int32(n), **kw)
+    assert bool(ok)
+    return sorted(np.flatnonzero(np.asarray(sel)).tolist())
+
+
+def test_full_pcpus_takes_whole_cores():
+    topo = topo_2numa()
+    cpus = taken(topo, free_all(topo), 4, bind_policy=BIND_FULL_PCPUS)
+    cores = np.asarray(topo.core_of)[cpus]
+    # 4 cpus = exactly 2 whole cores, each core fully taken.
+    assert len(set(cores)) == 2
+    for c in set(cores):
+        assert (cores == c).sum() == 2
+
+
+def test_spread_takes_one_sibling_per_core():
+    topo = topo_2numa()
+    cpus = taken(topo, free_all(topo), 4, bind_policy=BIND_SPREAD_BY_PCPUS)
+    cores = np.asarray(topo.core_of)[cpus]
+    assert len(set(cores)) == 4  # one cpu from four different cores
+
+
+def test_single_numa_preferred():
+    topo = topo_2numa()
+    # 8 cpus fit exactly in one NUMA node (4 cores x 2).
+    cpus = taken(topo, free_all(topo), 8)
+    numas = set(np.asarray(topo.numa_of)[cpus].tolist())
+    assert len(numas) == 1
+
+
+def test_most_allocated_packs_fullest_numa():
+    topo = topo_2numa()
+    rc = np.zeros(topo.capacity, np.int32)
+    rc[0:2] = 1  # one core of NUMA 0 busy => NUMA0 has 6 free, NUMA1 has 8
+    cpus = taken(topo, jnp.asarray(rc), 4, strategy=STRATEGY_MOST_ALLOCATED)
+    assert set(np.asarray(topo.numa_of)[cpus].tolist()) == {0}
+
+
+def test_least_allocated_prefers_emptiest_numa():
+    topo = topo_2numa()
+    rc = np.zeros(topo.capacity, np.int32)
+    rc[0:2] = 1
+    cpus = taken(topo, jnp.asarray(rc), 4, strategy=STRATEGY_LEAST_ALLOCATED)
+    assert set(np.asarray(topo.numa_of)[cpus].tolist()) == {1}
+
+
+def test_fit_and_batched_fit():
+    topo = topo_2numa()
+    assert bool(cpuset_fit(topo, free_all(topo), jnp.int32(1), jnp.int32(16)))
+    assert not bool(cpuset_fit(topo, free_all(topo), jnp.int32(1), jnp.int32(17)))
+    # Full-pcpus counts only fully-free cores.
+    rc = np.zeros(topo.capacity, np.int32)
+    rc[::2] = 1  # one sibling of every core busy
+    assert not bool(
+        cpuset_fit(topo, jnp.asarray(rc), jnp.int32(1), jnp.int32(2), full_pcpus=True)
+    )
+
+    topos = jax.tree.map(lambda a: jnp.stack([a, a]), topo)
+    rcs = jnp.stack([jnp.asarray(rc), free_all(topo)])
+    fits = cpuset_fit_batched(topos, rcs, jnp.ones(2, jnp.int32), jnp.int32(10))
+    assert not bool(fits[0]) and bool(fits[1])
+
+
+def test_hints_and_preferred_mask():
+    free = jnp.zeros(MAX_NUMA, jnp.int32).at[0].set(4).at[1].set(4)
+    feasible = numa_hints(free, jnp.int32(6))
+    # mask {0} infeasible (4 < 6), {0,1} feasible (8 >= 6)
+    assert not bool(feasible[0b01])
+    assert bool(feasible[0b11])
+    assert int(preferred_mask(feasible)) == 0b11
+    feasible1 = numa_hints(free, jnp.int32(3))
+    assert int(preferred_mask(feasible1)) == 0b01  # single node, lowest index
+
+
+def test_merge_policies():
+    free = jnp.zeros(MAX_NUMA, jnp.int32).at[0].set(4).at[1].set(4)
+    cpu_hints = numa_hints(free, jnp.int32(6))       # needs both nodes
+    dev_hints = numa_hints(free, jnp.int32(2))       # any single node
+    stack = jnp.stack([cpu_hints, dev_hints])
+
+    admit, mask = merge_hints(stack, policy=POLICY_RESTRICTED)
+    assert bool(admit) and int(mask) == 0b11
+
+    admit, mask = merge_hints(stack, policy=POLICY_SINGLE_NUMA_NODE)
+    assert not bool(admit)  # no single-node mask satisfies the cpu request
+
+    admit, _ = merge_hints(stack, policy=POLICY_NONE)
+    assert bool(admit)
+
+    # Disjoint providers: restricted rejects, best-effort still admits.
+    none = jnp.zeros_like(cpu_hints)
+    admit, mask = merge_hints(jnp.stack([cpu_hints, none]), policy=POLICY_RESTRICTED)
+    assert not bool(admit)
+    admit, mask = merge_hints(jnp.stack([cpu_hints, none]), policy=POLICY_BEST_EFFORT)
+    assert bool(admit) and int(mask) == -1
+
+
+def test_numa_score_strategies():
+    total = jnp.full(MAX_NUMA, 8, jnp.int32)
+    emptyish = jnp.zeros(MAX_NUMA, jnp.int32).at[0].set(8)
+    fullish = jnp.zeros(MAX_NUMA, jnp.int32).at[0].set(2)
+    s_pack_full = int(numa_score(fullish, total, jnp.int32(2), STRATEGY_MOST_ALLOCATED))
+    s_pack_empty = int(numa_score(emptyish, total, jnp.int32(2), STRATEGY_MOST_ALLOCATED))
+    assert s_pack_full > s_pack_empty
+    s_spread_empty = int(numa_score(emptyish, total, jnp.int32(2), STRATEGY_LEAST_ALLOCATED))
+    assert s_spread_empty > int(numa_score(fullish, total, jnp.int32(2), STRATEGY_LEAST_ALLOCATED))
+
+
+def test_cpu_manager_allocate_release_and_exclusive():
+    mgr = CPUManager()
+    mgr.register_node("n0", topo_2numa())
+
+    a = mgr.allocate("n0", "pod-a", 4, bind_policy=BIND_FULL_PCPUS,
+                     exclusive_policy=EXCLUSIVE_PCPU_LEVEL)
+    assert a is not None and len(a) == 4
+    status = mgr.resource_status("n0", "pod-a")
+    assert status["cpuset"] == ",".join(str(c) for c in a)
+
+    # A second exclusive pod must avoid pod-a's cores.
+    b = mgr.allocate("n0", "pod-b", 4, bind_policy=BIND_FULL_PCPUS,
+                     exclusive_policy=EXCLUSIVE_PCPU_LEVEL)
+    assert b is not None and not (set(a) & set(b))
+
+    # Node is 16 cpus; 8 are exclusively held; a 10-cpu ask fails.
+    assert mgr.allocate("n0", "pod-c", 10) is None
+    mgr.release("n0", "pod-a")
+    c = mgr.allocate("n0", "pod-c", 10)
+    assert c is not None and len(c) == 10
+
+
+def test_numa_exclusive_pod_avoids_shared_numa():
+    mgr = CPUManager()
+    mgr.register_node("n0", topo_2numa())
+    from koordinator_tpu.ops.numa import EXCLUSIVE_NUMA_LEVEL
+    a = mgr.allocate("n0", "pod-a", 2)  # lands somewhere (NUMA 0 packing)
+    b = mgr.allocate("n0", "pod-b", 4, exclusive_policy=EXCLUSIVE_NUMA_LEVEL)
+    topo = mgr.node("n0").topology
+    numa_of = np.asarray(topo.numa_of)
+    assert b is not None
+    assert not set(numa_of[a].tolist()) & set(numa_of[b].tolist())
+
+
+def test_reallocate_same_pod_does_not_leak_refs():
+    mgr = CPUManager()
+    mgr.register_node("n0", topo_2numa())
+    mgr.allocate("n0", "pod-a", 2)
+    mgr.allocate("n0", "pod-a", 2)   # re-allocate, must drop old refs
+    mgr.release("n0", "pod-a")
+    assert (mgr.node("n0").ref_count == 0).all()
+
+
+def test_max_ref_count_sharing():
+    mgr = CPUManager()
+    mgr.register_node("n0", topo_2numa(), max_ref=2)
+    a = mgr.allocate("n0", "pod-a", 16)
+    b = mgr.allocate("n0", "pod-b", 16)
+    assert a is not None and b is not None
+    assert mgr.allocate("n0", "pod-c", 1) is None

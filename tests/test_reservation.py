@@ -212,6 +212,31 @@ def test_expire_after_node_deleted_does_not_crash():
     assert cache.get("rsv-x").phase is ReservationPhase.EXPIRED
 
 
+def test_exhausted_reservation_gets_no_boost():
+    # Node 0 empty; node 1 carries a consumed allocate-once reservation.
+    # Two owner pods: the first consumes it; the second must NOT be steered
+    # to node 1 by a stale boost.
+    state = mk_state([10_000, 10_000], requested_cpus=[0, 4_000])
+    pods = mk_pods([2_000, 2_000], state)
+    rsv = one_reservation(node=1, cpu=4_000, allocate_once=np.array([True]))
+    match = jnp.ones((pods.capacity, rsv.capacity), bool)
+    a, rc, _, _, _ = jax.jit(reservation_greedy_assign)(
+        state, pods, quiet_cfg(), rsv, match
+    )
+    a, rc = np.asarray(a), np.asarray(rc)
+    assert int(a[0]) == 1 and int(rc[0]) == 0      # first pod consumes it
+    assert int(a[1]) == 0 and int(rc[1]) == -1     # second goes elsewhere
+
+
+def test_greedy_assign_accepts_numpy_match():
+    state = mk_state([10_000])
+    pods = mk_pods([2_000], state)
+    rsv = one_reservation(node=0, cpu=4_000)
+    match = np.ones((pods.capacity, rsv.capacity), bool)  # numpy, not jnp
+    a, rc, _, _, _ = reservation_greedy_assign(state, pods, quiet_cfg(), rsv, match)
+    assert int(a[0]) == 0
+
+
 def test_cache_lifecycle_and_expiration():
     snap = ClusterSnapshot()
     snap.upsert_node(NodeSpec("n0", vec(10_000, 65_536)))
