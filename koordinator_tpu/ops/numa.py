@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from koordinator_tpu.ops.select import take_by_rank
 from koordinator_tpu.state.cluster_state import _bucket
 
 #: Hint enumeration bound: masks are enumerated statically as 2^MAX_NUMA
@@ -111,6 +112,14 @@ class CPUTopology:
         return cls.build(core, numa, sock, capacity=capacity)
 
 
+def _round_up_to_cores(topo: CPUTopology, n_cpus: jnp.ndarray) -> jnp.ndarray:
+    """Round a cpu count up to a multiple of threads-per-core."""
+    c = topo.capacity
+    core_size = jax.ops.segment_sum(topo.valid.astype(jnp.int32), topo.core_of, c)
+    tpc = jnp.maximum(jnp.max(core_size), 1)
+    return ((n_cpus + tpc - 1) // tpc) * tpc
+
+
 def _counts(topo: CPUTopology, free: jnp.ndarray):
     """Shared count tensors: per-core/NUMA free + full-core stats."""
     c = topo.capacity
@@ -139,7 +148,10 @@ def cpuset_fit(
         free = free & ~banned
     cpu_full, _, _ = _counts(topo, free)
     if full_pcpus:
-        return jnp.sum(cpu_full.astype(jnp.int32)) >= n_cpus
+        # Whole-core policy: a non-multiple request rounds up to whole cores
+        # (a partially-taken core would reintroduce SMT interference).
+        n_eff = _round_up_to_cores(topo, n_cpus)
+        return jnp.sum(cpu_full.astype(jnp.int32)) >= n_eff
     return jnp.sum(free.astype(jnp.int32)) >= n_cpus
 
 
@@ -185,6 +197,8 @@ def take_cpus(
     full = bind_policy == BIND_FULL_PCPUS
     eligible = cpu_full if full else free
     pool = numa_full if full else numa_free
+    if full:
+        n_cpus = _round_up_to_cores(topo, n_cpus)  # whole cores only
 
     # (2) does this cpu's NUMA node alone satisfy the request?
     numa_satisfies = (pool >= n_cpus)[topo.numa_of] & eligible
@@ -206,19 +220,17 @@ def take_cpus(
     else:
         intra = topo.core_of * c + sibling_rank    # whole cores together
 
-    order = jnp.lexsort(
+    return take_by_rank(
         (
             jnp.arange(c),                     # (5)
             intra,                             # (4)
             numa_order,                        # (3)
             ~numa_satisfies,                   # (2)
             ~eligible,                         # (1) — primary
-        )
+        ),
+        eligible,
+        n_cpus,
     )
-    take_rank = jnp.empty(c, jnp.int32).at[order].set(jnp.arange(c, dtype=jnp.int32))
-    selected = (take_rank < n_cpus) & eligible
-    ok = jnp.sum(selected.astype(jnp.int32)) >= n_cpus
-    return selected & ok, ok
 
 
 # -- NUMA topology hints + topology manager (frameworkext/topologymanager) ----

@@ -1,0 +1,26 @@
+"""Shared top-k selection idiom for allocation kernels.
+
+Both the cpuset accumulator (ops/numa.take_cpus) and the device allocator
+(ops/deviceshare.allocate_on_node) reduce to: order candidates by a
+lexicographic priority, take the first k eligible. One helper so the idiom
+has a single definition.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def take_by_rank(
+    keys: tuple,            # lexsort keys, LAST key is the primary
+    eligible: jnp.ndarray,  # (C,) bool
+    k: jnp.ndarray,         # () int32 — how many to take
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns ((C,) bool selection, ok). Selection is empty unless at least
+    k eligible candidates exist."""
+    c = eligible.shape[0]
+    order = jnp.lexsort(keys)
+    rank = jnp.empty(c, jnp.int32).at[order].set(jnp.arange(c, dtype=jnp.int32))
+    selected = (rank < k) & eligible
+    ok = jnp.sum(selected.astype(jnp.int32)) >= k
+    return selected & ok, ok

@@ -67,15 +67,19 @@ class CPUManager:
         numa_of = np.asarray(topo.numa_of)
         banned = np.zeros(topo.capacity, bool)
         for alloc in st.allocations.values():
+            if not alloc.cpus:
+                continue
+            # Other pods' exclusivity claims...
             if alloc.exclusive_policy == EXCLUSIVE_PCPU_LEVEL:
                 banned |= np.isin(core_of, core_of[alloc.cpus])
             elif alloc.exclusive_policy == EXCLUSIVE_NUMA_LEVEL:
                 banned |= np.isin(numa_of, numa_of[alloc.cpus])
-            elif pod_policy == EXCLUSIVE_PCPU_LEVEL and alloc.cpus:
+            # ...AND this pod's own requirement apply independently.
+            if pod_policy == EXCLUSIVE_PCPU_LEVEL:
                 # This pod wants whole cores: cores already referenced by
                 # anyone are off limits.
                 banned |= np.isin(core_of, core_of[alloc.cpus])
-            elif pod_policy == EXCLUSIVE_NUMA_LEVEL and alloc.cpus:
+            elif pod_policy == EXCLUSIVE_NUMA_LEVEL:
                 # This pod wants whole NUMA nodes to itself.
                 banned |= np.isin(numa_of, numa_of[alloc.cpus])
         return banned
@@ -93,7 +97,10 @@ class CPUManager:
         st = self._nodes.get(node)
         if st is None:
             return None
-        if pod in st.allocations:  # re-allocate: drop the old cpuset first
+        # Re-allocate: free the old cpuset for the attempt, but restore it if
+        # the new selection fails (the pod keeps running on its old cpus).
+        old = st.allocations.get(pod)
+        if old is not None:
             self.release(node, pod)
         banned = self._banned_mask(st, exclusive_policy)
         selected, ok = take_cpus(
@@ -106,6 +113,9 @@ class CPUManager:
             banned=jnp.asarray(banned),
         )
         if not bool(ok):
+            if old is not None:
+                st.ref_count[old.cpus] += 1
+                st.allocations[pod] = old
             return None
         cpus = sorted(int(i) for i in np.flatnonzero(np.asarray(selected)))
         st.ref_count[cpus] += 1

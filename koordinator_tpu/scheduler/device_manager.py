@@ -1,0 +1,125 @@
+"""Host-side device allocation bookkeeping (deviceshare Reserve/Unreserve).
+
+Counterpart of the reference's nodeDevice cache updates
+(pkg/scheduler/plugins/deviceshare/device_cache.go) and the
+``scheduling.koordinator.sh/device-allocated`` annotation emitted at PreBind
+(apis/extension/device_share.go:32): tracks which device minors each pod
+holds, mirrors commits into the device tensors, and renders the annotation
+payload for the node agent's GPU env-inject hook.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.ops.deviceshare import (
+    DEV_BINPACK,
+    DeviceState,
+    allocate_on_node,
+    commit_allocation,
+    release_allocation,
+    split_request,
+)
+
+
+@dataclasses.dataclass
+class DeviceAllocation:
+    pod: str
+    node: str
+    device_type: str
+    minors: list[int]
+    core: int         # per-device core charged
+    memory: int       # per-device memory charged
+
+
+class DeviceManager:
+    """Per-type device tensors + pod allocation records."""
+
+    def __init__(self) -> None:
+        self._state: dict[str, DeviceState] = {}
+        self._node_rows: dict[str, dict[str, int]] = {}  # per device type
+        self._allocs: dict[tuple[str, str], list[DeviceAllocation]] = {}
+
+    def register(
+        self, device_type: str, node_names: list[str], per_node_devices: list[list[dict]]
+    ) -> None:
+        self._state[device_type] = DeviceState.build(per_node_devices)
+        self._node_rows[device_type] = {n: i for i, n in enumerate(node_names)}
+
+    def state(self, device_type: str) -> DeviceState | None:
+        return self._state.get(device_type)
+
+    def allocate(
+        self,
+        device_type: str,
+        node: str,
+        pod: str,
+        core: int,
+        memory: int = 0,
+        strategy: int = DEV_BINPACK,
+    ) -> list[int] | None:
+        """Pick + commit devices for a pod; returns device minors or None."""
+        dev = self._state.get(device_type)
+        row = self._node_rows.get(device_type, {}).get(node)
+        if dev is None or row is None:
+            return None
+        # Re-allocate for the same pod/type replaces the old grant (a retried
+        # bind cycle must not double-charge); restore it if the retry fails.
+        old_records = self._allocs.get((pod, node), [])
+        old_same_type = [a for a in old_records if a.device_type == device_type]
+        if old_same_type:
+            old_state = dev
+            for a in old_same_type:
+                self._release_one(node, a)
+                old_records.remove(a)
+            dev = self._state[device_type]
+        n_whole, per_core, per_mem = split_request(core, memory)
+        sel, ok = allocate_on_node(
+            dev, jnp.int32(row), jnp.int32(n_whole),
+            jnp.int32(per_core), jnp.int32(per_mem), strategy=strategy,
+        )
+        if not bool(ok):
+            if old_same_type:
+                self._state[device_type] = old_state
+                self._allocs.setdefault((pod, node), []).extend(old_same_type)
+            return None
+        self._state[device_type] = commit_allocation(
+            dev, jnp.int32(row), sel, jnp.int32(per_core), jnp.int32(per_mem)
+        )
+        minors = sorted(int(i) for i in np.flatnonzero(np.asarray(sel)))
+        self._allocs.setdefault((pod, node), []).append(
+            DeviceAllocation(pod, node, device_type, minors, per_core, per_mem)
+        )
+        return minors
+
+    def _release_one(self, node: str, alloc: DeviceAllocation) -> None:
+        dev = self._state.get(alloc.device_type)
+        row = self._node_rows.get(alloc.device_type, {}).get(node)
+        if dev is None or row is None:
+            return
+        sel = np.zeros(dev.shape[1], bool)
+        sel[alloc.minors] = True
+        self._state[alloc.device_type] = release_allocation(
+            dev, jnp.int32(row), jnp.asarray(sel),
+            jnp.int32(alloc.core), jnp.int32(alloc.memory),
+        )
+
+    def release(self, node: str, pod: str) -> None:
+        for alloc in self._allocs.pop((pod, node), []):
+            self._release_one(node, alloc)
+
+    def device_allocated_annotation(self, node: str, pod: str) -> dict | None:
+        """The device-allocated annotation payload (device_share.go:32)."""
+        allocs = self._allocs.get((pod, node))
+        if not allocs:
+            return None
+        return {
+            a.device_type: [
+                {"minor": m, "resources": {"core": a.core, "memory": a.memory}}
+                for m in a.minors
+            ]
+            for a in allocs
+        }

@@ -1,0 +1,216 @@
+"""Device-share semantics: shared/whole fit, scoring, joint + partition alloc.
+
+Scenarios mirror pkg/scheduler/plugins/deviceshare tests (plugin_test.go fit
+cases, device_allocator_test.go joint allocation, benchmark shape 1024 nodes
+x 8 GPUs from plugin_benchmark_test.go:143-145).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.ops.deviceshare import (
+    DEV_BINPACK,
+    DEV_CORE,
+    DEV_SPREAD,
+    DeviceState,
+    allocate_on_node,
+    commit_allocation,
+    device_fit,
+    device_score,
+    joint_allocate,
+    partition_allocate,
+    split_request,
+)
+from koordinator_tpu.scheduler.device_manager import DeviceManager
+
+
+def gpu_node(n_gpus=8, mem=81_920, group_size=4):
+    return [
+        {"core": 100, "memory": mem, "group": j // group_size}
+        for j in range(n_gpus)
+    ]
+
+
+def test_split_request():
+    assert split_request(50, 1000) == (0, 50, 1000)
+    assert split_request(100, 1000) == (0, 100, 1000)
+    assert split_request(200, 2000) == (2, 100, 1000)
+    assert split_request(350, 0) == (4, 100, 0)  # rounded up to whole
+
+
+def test_shared_fit_and_whole_fit():
+    dev = DeviceState.build([gpu_node(2), []])
+    # shared 50% fits node 0 only
+    fit = device_fit(dev, jnp.int32(0), jnp.int32(50), jnp.int32(1000))
+    assert bool(fit[0]) and not bool(fit[1])
+    # 2 whole fits, 3 whole doesn't
+    assert bool(device_fit(dev, jnp.int32(2), jnp.int32(100), jnp.int32(0))[0])
+    assert not bool(device_fit(dev, jnp.int32(3), jnp.int32(100), jnp.int32(0))[0])
+
+
+def test_unhealthy_device_excluded():
+    devs = gpu_node(2)
+    devs[1]["healthy"] = False
+    dev = DeviceState.build([devs])
+    assert not bool(device_fit(dev, jnp.int32(2), jnp.int32(100), jnp.int32(0))[0])
+    assert bool(device_fit(dev, jnp.int32(1), jnp.int32(100), jnp.int32(0))[0])
+
+
+def test_partial_device_blocks_whole_allocation():
+    dev = DeviceState.build([gpu_node(2)])
+    sel, ok = allocate_on_node(
+        dev, jnp.int32(0), jnp.int32(0), jnp.int32(30), jnp.int32(100)
+    )
+    dev2 = commit_allocation(dev, jnp.int32(0), sel, jnp.int32(30), jnp.int32(100))
+    # one device now partial: only 1 whole device left
+    assert bool(device_fit(dev2, jnp.int32(1), jnp.int32(100), jnp.int32(0))[0])
+    assert not bool(device_fit(dev2, jnp.int32(2), jnp.int32(100), jnp.int32(0))[0])
+
+
+def test_binpack_picks_most_allocated_device():
+    dev = DeviceState.build([gpu_node(2)])
+    sel0 = jnp.zeros(dev.shape[1], bool).at[0].set(True)
+    dev = commit_allocation(dev, jnp.int32(0), sel0, jnp.int32(40), jnp.int32(0))
+    sel, ok = allocate_on_node(
+        dev, jnp.int32(0), jnp.int32(0), jnp.int32(30), jnp.int32(0),
+        strategy=DEV_BINPACK,
+    )
+    assert bool(ok) and bool(sel[0])  # goes to the already-busy device 0
+    sel_spread, _ = allocate_on_node(
+        dev, jnp.int32(0), jnp.int32(0), jnp.int32(30), jnp.int32(0),
+        strategy=DEV_SPREAD,
+    )
+    assert bool(sel_spread[1])
+
+
+def test_score_strategies_orient_correctly():
+    dev = DeviceState.build([gpu_node(4), gpu_node(4)])
+    sel = jnp.zeros(dev.shape[1], bool).at[0].set(True).at[1].set(True)
+    dev = commit_allocation(dev, jnp.int32(0), sel, jnp.int32(100), jnp.int32(81_920))
+    s_bin = device_score(dev, jnp.int32(1), jnp.int32(100), jnp.int32(0), DEV_BINPACK)
+    s_spr = device_score(dev, jnp.int32(1), jnp.int32(100), jnp.int32(0), DEV_SPREAD)
+    assert int(s_bin[0]) > int(s_bin[1])   # binpack prefers busier node 0
+    assert int(s_spr[1]) > int(s_spr[0])   # spread prefers empty node 1
+
+
+def test_whole_allocation_prefers_one_group():
+    # 8 gpus in two groups of 4; ask 4 whole => all from one group.
+    dev = DeviceState.build([gpu_node(8, group_size=4)])
+    sel, ok = allocate_on_node(
+        dev, jnp.int32(0), jnp.int32(4), jnp.int32(100), jnp.int32(0)
+    )
+    assert bool(ok)
+    groups = np.asarray(dev.group[0])[np.asarray(sel)]
+    assert len(set(groups.tolist())) == 1
+
+
+def test_joint_allocate_same_group_nic():
+    gpu = DeviceState.build([gpu_node(8, group_size=4)])
+    nic = DeviceState.build(
+        [[{"core": 100, "memory": 0, "group": 0}, {"core": 100, "memory": 0, "group": 1}]]
+    )
+    gsel, nsel, ok = joint_allocate(
+        gpu, nic, jnp.int32(0), jnp.int32(4), jnp.int32(100), jnp.int32(0),
+        jnp.int32(50), jnp.int32(0),
+    )
+    assert bool(ok)
+    gpu_group = int(np.asarray(gpu.group[0])[np.asarray(gsel)][0])
+    nic_group = int(np.asarray(nic.group[0])[np.asarray(nsel)][0])
+    assert gpu_group == nic_group
+
+
+def test_joint_allocate_required_fails_without_same_group_nic():
+    gpu = DeviceState.build([gpu_node(4, group_size=4)])  # all group 0
+    nic = DeviceState.build([[{"core": 100, "memory": 0, "group": 7}]])
+    _, _, ok = joint_allocate(
+        gpu, nic, jnp.int32(0), jnp.int32(2), jnp.int32(100), jnp.int32(0),
+        jnp.int32(50), jnp.int32(0), nic_required=True,
+    )
+    assert not bool(ok)
+    _, _, ok2 = joint_allocate(
+        gpu, nic, jnp.int32(0), jnp.int32(2), jnp.int32(100), jnp.int32(0),
+        jnp.int32(50), jnp.int32(0), nic_required=False,
+    )
+    assert bool(ok2)
+
+
+def test_partition_templates():
+    dev = DeviceState.build([gpu_node(8, group_size=4)])
+    d = dev.shape[1]
+    t = np.zeros((3, d), bool)
+    t[0, 0:4] = True   # partition A: gpus 0-3
+    t[1, 4:8] = True   # partition B: gpus 4-7
+    t[2, 0:8] = True   # partition C: all 8
+    templates = jnp.asarray(t)
+    sel, ok = partition_allocate(dev, jnp.int32(0), templates, jnp.int32(4))
+    assert bool(ok)
+    np.testing.assert_array_equal(np.asarray(sel), t[0])
+    # occupy gpu 1 => partition A infeasible, falls to B
+    busy = jnp.zeros(d, bool).at[1].set(True)
+    dev2 = commit_allocation(dev, jnp.int32(0), busy, jnp.int32(10), jnp.int32(0))
+    sel2, ok2 = partition_allocate(dev2, jnp.int32(0), templates, jnp.int32(4))
+    assert bool(ok2)
+    np.testing.assert_array_equal(np.asarray(sel2), t[1])
+    # no 3-device template exists
+    _, ok3 = partition_allocate(dev, jnp.int32(0), templates, jnp.int32(3))
+    assert not bool(ok3)
+
+
+def test_device_manager_allocate_release_annotation():
+    mgr = DeviceManager()
+    mgr.register("gpu", ["n0", "n1"], [gpu_node(4), gpu_node(4)])
+    minors = mgr.allocate("gpu", "n0", "pod-a", core=200, memory=16_384)
+    assert minors is not None and len(minors) == 2
+    ann = mgr.device_allocated_annotation("n0", "pod-a")
+    assert ann["gpu"][0]["resources"]["core"] == 100
+    # 2 whole left; a 3-whole ask fails until release
+    assert mgr.allocate("gpu", "n0", "pod-b", core=300) is None
+    mgr.release("n0", "pod-a")
+    assert mgr.allocate("gpu", "n0", "pod-b", core=300) is not None
+
+
+def test_joint_required_rejects_multi_group_gpu_spread():
+    # 8 GPUs wanted from two groups of 4 => GPUs span groups; required-scope
+    # joint allocation must fail even though a NIC exists in group 0.
+    gpu = DeviceState.build([gpu_node(8, group_size=4)])
+    nic = DeviceState.build([[{"core": 100, "memory": 0, "group": 0}]])
+    _, _, ok = joint_allocate(
+        gpu, nic, jnp.int32(0), jnp.int32(8), jnp.int32(100), jnp.int32(0),
+        jnp.int32(50), jnp.int32(0), nic_required=True,
+    )
+    assert not bool(ok)
+
+
+def test_two_device_types_with_different_node_orders():
+    mgr = DeviceManager()
+    mgr.register("gpu", ["n0", "n1"], [gpu_node(4), []])
+    mgr.register("rdma", ["n1", "n0"],
+                 [[{"core": 100}], [{"core": 100}]])
+    assert mgr.allocate("gpu", "n0", "pod-a", core=100) is not None
+    assert mgr.allocate("rdma", "n0", "pod-a", core=50) is not None
+    assert mgr.allocate("gpu", "n1", "pod-b", core=100) is None  # no gpus on n1
+
+
+def test_device_reallocate_replaces_not_double_charges():
+    mgr = DeviceManager()
+    mgr.register("gpu", ["n0"], [gpu_node(4)])
+    mgr.allocate("gpu", "n0", "pod-a", core=200)
+    mgr.allocate("gpu", "n0", "pod-a", core=200)  # retried bind cycle
+    ann = mgr.device_allocated_annotation("n0", "pod-a")
+    assert len(ann["gpu"]) == 2                    # not 4
+    mgr.release("n0", "pod-a")
+    assert mgr.allocate("gpu", "n0", "pod-b", core=400) is not None
+    # failed re-allocate restores the old grant
+    mgr2 = DeviceManager()
+    mgr2.register("gpu", ["n0"], [gpu_node(4)])
+    a = mgr2.allocate("gpu", "n0", "pod-a", core=200)
+    assert mgr2.allocate("gpu", "n0", "pod-a", core=800) is None
+    assert mgr2.device_allocated_annotation("n0", "pod-a")["gpu"][0]["minor"] == a[0]
+
+
+def test_large_cluster_filter_shape():
+    # The reference benchmark shape: 1024 nodes x 8 GPUs.
+    dev = DeviceState.build([gpu_node(8)] * 1024)
+    fit = jax.jit(device_fit)(dev, jnp.int32(8), jnp.int32(100), jnp.int32(0))
+    assert fit.shape[0] >= 1024 and bool(fit[:1024].all())

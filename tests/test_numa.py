@@ -193,6 +193,46 @@ def test_reallocate_same_pod_does_not_leak_refs():
     assert (mgr.node("n0").ref_count == 0).all()
 
 
+def test_numa_exclusive_vs_pcpu_exclusive_pod():
+    # pod-a holds cores with PCPU exclusivity; a NUMA-exclusive pod-b must
+    # avoid pod-a's whole NUMA node (independent of pod-a's own policy).
+    mgr = CPUManager()
+    mgr.register_node("n0", topo_2numa())
+    from koordinator_tpu.ops.numa import EXCLUSIVE_NUMA_LEVEL
+    a = mgr.allocate("n0", "pod-a", 2, exclusive_policy=EXCLUSIVE_PCPU_LEVEL)
+    b = mgr.allocate("n0", "pod-b", 4, exclusive_policy=EXCLUSIVE_NUMA_LEVEL)
+    numa_of = np.asarray(mgr.node("n0").topology.numa_of)
+    assert b is not None
+    assert not set(numa_of[a].tolist()) & set(numa_of[b].tolist())
+
+
+def test_failed_reallocate_keeps_old_cpuset():
+    mgr = CPUManager()
+    mgr.register_node("n0", topo_2numa())
+    a = mgr.allocate("n0", "pod-a", 4)
+    assert mgr.allocate("n0", "pod-a", 100) is None  # impossible ask
+    st = mgr.node("n0")
+    assert st.allocations["pod-a"].cpus == a          # old grant intact
+    assert st.ref_count[a].sum() == 4
+
+
+def test_full_pcpus_odd_request_rounds_up_to_whole_cores():
+    topo = topo_2numa()
+    sel, ok = take_cpus(topo, free_all(topo), jnp.int32(1), jnp.int32(3),
+                        bind_policy=BIND_FULL_PCPUS)
+    assert bool(ok)
+    cpus = np.flatnonzero(np.asarray(sel))
+    cores = np.asarray(topo.core_of)[cpus]
+    assert len(cpus) == 4  # rounded up: no half-taken core
+    for c in set(cores):
+        assert (cores == c).sum() == 2
+    # fit agrees: 15 full-core cpus don't exist once one sibling is busy
+    rc = np.zeros(topo.capacity, np.int32)
+    rc[0] = 1
+    assert not bool(cpuset_fit(topo, jnp.asarray(rc), jnp.int32(1),
+                               jnp.int32(15), full_pcpus=True))
+
+
 def test_max_ref_count_sharing():
     mgr = CPUManager()
     mgr.register_node("n0", topo_2numa(), max_ref=2)
