@@ -133,7 +133,14 @@ def device_fit(
         & (dev.free[..., DEV_MEM] >= memory)
     )
     shared_ok = jnp.any(fits_each, axis=-1)
-    whole_ok = jnp.sum(_whole_free(dev).astype(jnp.int32), axis=-1) >= n_whole
+    # whole devices must also cover the per-device ask (a fully-free device
+    # with less memory than asked is not a fit)
+    whole_capable = (
+        _whole_free(dev)
+        & (dev.total[..., DEV_CORE] >= core)
+        & (dev.total[..., DEV_MEM] >= memory)
+    )
+    whole_ok = jnp.sum(whole_capable.astype(jnp.int32), axis=-1) >= n_whole
     return jnp.where(n_whole > 0, whole_ok, shared_ok)
 
 
@@ -181,23 +188,26 @@ def allocate_on_node(
     usable = dev.valid[node] & dev.healthy[node]
     groups = dev.group[node]
 
-    # -- shared single-device path
-    fits = usable & (free[:, DEV_CORE] >= core) & (free[:, DEV_MEM] >= memory)
-    if strategy == DEV_BINPACK:
-        key = jnp.where(fits, free[:, DEV_CORE], jnp.iinfo(jnp.int32).max)
-        pick = jnp.argmin(key)
-    else:
-        key = jnp.where(fits, free[:, DEV_CORE], -1)
-        pick = jnp.argmax(key)
-    shared_sel = jax.nn.one_hot(pick, d, dtype=bool) & fits[pick]
-    shared_ok = jnp.any(fits)
-
-    # -- whole-devices path
-    wfree = usable & jnp.all(free == total, axis=-1)
     in_group = (
         (groups == prefer_group) & (prefer_group >= 0)
         if prefer_group is not None
-        else jnp.zeros_like(wfree)
+        else jnp.zeros(d, bool)
+    )
+
+    # -- shared single-device path: best-fit within the preferred topology
+    # group first, then any group (same-group-then-fallback, tryJointAllocate)
+    fits = usable & (free[:, DEV_CORE] >= core) & (free[:, DEV_MEM] >= memory)
+    fit_key = free[:, DEV_CORE] if strategy == DEV_BINPACK else -free[:, DEV_CORE]
+    shared_sel, shared_ok = take_by_rank(
+        (jnp.arange(d), fit_key, ~in_group, ~fits), fits, jnp.int32(1)
+    )
+
+    # -- whole-devices path (per-device capacity must cover the ask)
+    wfree = (
+        usable
+        & jnp.all(free == total, axis=-1)
+        & (total[:, DEV_CORE] >= core)
+        & (total[:, DEV_MEM] >= memory)
     )
     # group crowding: how many whole-free devices share my group (take from
     # the group that can satisfy the request with least leftover)

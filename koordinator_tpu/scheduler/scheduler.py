@@ -24,10 +24,16 @@ import dataclasses
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.ops.assignment import ScoringConfig
 from koordinator_tpu.ops.gang import GangInfo, gang_assign
+from koordinator_tpu.ops.network_topology import (
+    TopologyArrays,
+    TopologyRequirements,
+    plan_gang_placement,
+)
 from koordinator_tpu.quota.admission import QuotaDeviceState
 from koordinator_tpu.quota.tree import QuotaTree
 from koordinator_tpu.scheduler.diagnosis import PodDiagnosis, explain_pod
@@ -46,6 +52,8 @@ class GangRecord:
     wait_time_sec: float = 600.0
     first_failure: float | None = None
     rejected: bool = False
+    #: network-topology gather requirements; needs Scheduler.topology_tree
+    topology: TopologyRequirements | None = None
 
 
 @dataclasses.dataclass
@@ -67,6 +75,7 @@ class Scheduler:
         monitor: SchedulerMonitor | None = None,
         gang_passes: int = 2,
         clock=time.monotonic,
+        topology_tree: TopologyArrays | None = None,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -75,6 +84,7 @@ class Scheduler:
         self.monitor = monitor or SchedulerMonitor()
         self.gang_passes = gang_passes
         self.clock = clock
+        self.topology_tree = topology_tree
 
         self.pending: dict[str, PodSpec] = {}
         self.gangs: dict[str, GangRecord] = {}
@@ -172,6 +182,48 @@ class Scheduler:
         self.quota_tree.refresh_runtime()
         return QuotaDeviceState.from_tree(self.quota_tree)
 
+    def _apply_topology_plans(
+        self, batch: PodBatch, gang_index: dict[str, int]
+    ) -> PodBatch:
+        """FindOneNode parity (``frameworkext/interface.go:120``,
+        ``coscheduling.go:137-144``): a gang with network-topology
+        requirements gets a placement plan up front; each member's feasible
+        set is pinned to its planned node. A gang whose plan fails is masked
+        out of the round entirely (all-or-nothing at plan level)."""
+        if self.topology_tree is None:
+            return batch
+        gang_ids = np.asarray(batch.gang_id)
+        feasible = np.array(batch.feasible)
+        valid = np.array(batch.valid)
+        changed = False
+        for name, gi in gang_index.items():
+            gang = self.gangs.get(name)
+            if gang is None or gang.topology is None:
+                continue
+            mask = (gang_ids == gi) & valid
+            if not mask.any():
+                continue
+            plan = plan_gang_placement(
+                self.snapshot.state, batch, mask, self.topology_tree,
+                gang.topology, cfg=self.config,
+            )
+            changed = True
+            desired = gang.topology.desired_slots or int(mask.sum())
+            planned = np.flatnonzero(mask & (plan >= 0))
+            if len(planned) < min(desired, int(mask.sum())):
+                # no gather plan at all -> the whole gang backs off
+                valid[mask] = False
+                continue
+            # pin planned members; surplus members (pending > desired_slots)
+            # stay unpinned and schedule freely once the gang is permitted
+            feasible[planned] = False
+            feasible[planned, plan[planned]] = True
+        if not changed:
+            return batch
+        return batch.replace(
+            feasible=jnp.asarray(feasible), valid=jnp.asarray(valid)
+        )
+
     def schedule_round(self) -> SchedulingResult:
         """Solve the current pending queue; reserve, bind, diagnose."""
         now = self.clock()
@@ -185,6 +237,7 @@ class Scheduler:
             gangs, gang_index = self._build_gang_info(pods)
             quota, quota_index = self._build_quota()
             batch = self._build_batch(pods, gang_index, quota_index)
+            batch = self._apply_topology_plans(batch, gang_index)
 
         with self.monitor.phase("Solve"):
             assignments, new_state, new_quota = self._solve(

@@ -214,3 +214,39 @@ def test_large_cluster_filter_shape():
     dev = DeviceState.build([gpu_node(8)] * 1024)
     fit = jax.jit(device_fit)(dev, jnp.int32(8), jnp.int32(100), jnp.int32(0))
     assert fit.shape[0] >= 1024 and bool(fit[:1024].all())
+
+
+def test_whole_fit_respects_per_device_capacity():
+    # 1000-MiB devices: 2 whole devices at 5000 MiB each must NOT fit
+    dev = DeviceState.build([gpu_node(2, mem=1_000)])
+    fit = device_fit(dev, jnp.int32(2), jnp.int32(100), jnp.int32(5_000))
+    assert not bool(fit[0])
+    sel, ok = allocate_on_node(
+        dev, jnp.int32(0), jnp.int32(2), jnp.int32(100), jnp.int32(5_000)
+    )
+    assert not bool(ok)
+    # and the same ask within capacity fits
+    fit = device_fit(dev, jnp.int32(2), jnp.int32(100), jnp.int32(1_000))
+    assert bool(fit[0])
+
+
+def test_shared_alloc_prefers_topology_group():
+    # groups {0,1}; group-0 GPU busy so whole GPUs come from group 1;
+    # NICs free in both groups -> joint alloc must pick the group-1 NIC
+    gpu = DeviceState.build([gpu_node(8, group_size=4)])
+    gpu = commit_allocation(
+        gpu, jnp.int32(0),
+        jnp.asarray([True] + [False] * (gpu.shape[1] - 1)),
+        jnp.int32(10), jnp.int32(0),
+    )
+    nic = DeviceState.build(
+        [[{"core": 100, "memory": 0, "group": 0},
+          {"core": 100, "memory": 0, "group": 1}]]
+    )
+    gpu_sel, nic_sel, ok = joint_allocate(
+        gpu, nic, jnp.int32(0), jnp.int32(4), jnp.int32(100), jnp.int32(81_920),
+        jnp.int32(25), jnp.int32(0), nic_required=True,
+    )
+    assert bool(ok)
+    assert np.flatnonzero(np.asarray(nic_sel)).tolist() == [1]
+    assert (np.flatnonzero(np.asarray(gpu_sel)) >= 4).all()

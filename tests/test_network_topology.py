@@ -1,0 +1,152 @@
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+from koordinator_tpu.ops.network_topology import (
+    TopologyRequirements,
+    TopologyTree,
+    aggregate_tree,
+    constrain_multiples,
+    eligible_candidates,
+    gang_offer_slots,
+    plan_gang_placement,
+)
+from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
+
+R = NUM_RESOURCE_DIMS
+CPU, MEM = ResourceDim.CPU, ResourceDim.MEMORY
+
+
+def mk_tree(spines=2, blocks=2, nodes=2):
+    """spines x blocks x nodes tree; node i path = (s{a}, b{a}.{b}, n{i})."""
+    tree = TopologyTree(["spine", "block", "node"])
+    idx = 0
+    for s in range(spines):
+        for b in range(blocks):
+            for _ in range(nodes):
+                tree.add_node([f"s{s}", f"b{s}.{b}", f"n{idx}"])
+                idx += 1
+    return tree.build(), idx
+
+
+def mk_state(node_cpus, mem=65_536):
+    alloc = np.zeros((len(node_cpus), R), np.int32)
+    alloc[:, CPU] = node_cpus
+    alloc[:, MEM] = mem
+    return ClusterState.from_arrays(alloc)
+
+
+def mk_gang_pods(n, cpu, state, total=None):
+    total = total or n
+    req = np.zeros((total, R), np.int32)
+    req[:n, CPU] = cpu
+    req[:n, MEM] = 1_024
+    pods = PodBatch.build(req, node_capacity=state.capacity)
+    mask = np.zeros(pods.capacity, bool)
+    mask[:n] = True
+    return pods, mask
+
+
+def test_offer_slots_prefix_fit():
+    state = mk_state([10_000, 5_000, 1_000])
+    req = np.zeros((4, R), np.int32)
+    req[:, CPU] = 3_000
+    req[:, MEM] = 1_024
+    slots = gang_offer_slots(state, jnp.asarray(req), state.node_valid)
+    assert slots[:3].tolist() == [3, 1, 0]
+
+
+def test_aggregate_and_layers():
+    topo, n = mk_tree()  # 8 nodes, 2 spines, 4 blocks
+    slots = jnp.ones(n, jnp.int32)
+    t_slots, _, _ = aggregate_tree(topo, slots, slots * 0, slots * 0)
+    layer = np.asarray(topo.topo_layer)
+    s = np.asarray(t_slots)
+    assert s[layer == 0].tolist() == [8]          # cluster root
+    assert sorted(s[layer == 1].tolist()) == [4, 4]    # spines
+    assert sorted(s[layer == 2].tolist()) == [2, 2, 2, 2]  # blocks
+
+
+def test_constrain_multiples_rounds_down_bottom_up():
+    topo, n = mk_tree(spines=1, blocks=2, nodes=2)  # 4 nodes
+    slots = jnp.asarray([3, 3, 3, 3], jnp.int32)
+    t_slots, _, _ = aggregate_tree(topo, slots, slots * 0, slots * 0)
+    # node-layer multiple of 2: each node 3 -> 2; blocks 4; root 8
+    mults = jnp.asarray([1, 1, 1, 2], jnp.int32)
+    out = np.asarray(constrain_multiples(topo, t_slots, mults))
+    layer = np.asarray(topo.topo_layer)
+    assert (out[layer == 3] == 2).all()
+    assert (out[layer == 2] == 4).all()
+    assert out[layer == 0] == 8
+
+
+def test_eligible_picks_deepest_layer():
+    topo, n = mk_tree()  # 2 slots per node
+    slots = jnp.full(n, 2, jnp.int32)
+    t_slots, _, _ = aggregate_tree(topo, slots, slots * 0, slots * 0)
+    # desired=4 fits in a block (4 slots) -> deepest layer is block (2)
+    cand, deepest = eligible_candidates(topo, t_slots, jnp.int32(4), jnp.int32(-1))
+    assert int(deepest) == 2
+    assert int(cand.sum()) == 4  # every block qualifies
+    # desired=6 needs a spine (8 slots)
+    cand, deepest = eligible_candidates(topo, t_slots, jnp.int32(6), jnp.int32(-1))
+    assert int(deepest) == 1
+    assert int(cand.sum()) == 2
+
+
+def test_plan_packs_gang_into_one_block():
+    topo, n = mk_tree()
+    state = mk_state([10_000] * n)
+    pods, mask = mk_gang_pods(4, 4_000, state)  # 2 fit per node -> one block fits 4
+    plan = plan_gang_placement(
+        state, pods, mask, topo, TopologyRequirements(desired_slots=4)
+    )
+    chosen = plan[:4]
+    assert (chosen >= 0).all()
+    # all 4 pods land inside a single block (nodes 2k, 2k+1)
+    blocks = set(chosen // 2)
+    assert len(blocks) == 1
+
+
+def test_plan_prefers_block_with_existing_peers():
+    topo, n = mk_tree()
+    state = mk_state([10_000] * n)
+    pods, mask = mk_gang_pods(2, 4_000, state)
+    existing = jnp.zeros(n, jnp.int32).at[5].set(3)  # peers on node 5 (block 2)
+    plan = plan_gang_placement(
+        state, pods, mask, topo, TopologyRequirements(desired_slots=2),
+        node_existing=existing,
+    )
+    assert set(plan[:2] // 2) == {2}
+
+
+def test_plan_respects_must_gather_infeasible():
+    topo, n = mk_tree()
+    state = mk_state([10_000] * n)
+    # 6 pods cannot gather in any single block (4 slots max)
+    pods, mask = mk_gang_pods(6, 4_000, state)
+    plan = plan_gang_placement(
+        state, pods, mask, topo,
+        TopologyRequirements(desired_slots=6, must_gather_layer=2),
+    )
+    assert (plan == -1).all()
+    # but a spine (8 slots) gathers them
+    plan = plan_gang_placement(
+        state, pods, mask, topo,
+        TopologyRequirements(desired_slots=6, must_gather_layer=1),
+    )
+    assert (plan[:6] >= 0).all()
+    assert len(set(plan[:6] // 4)) == 1  # one spine
+
+
+def test_plan_pod_count_multiple():
+    topo, n = mk_tree()
+    state = mk_state([10_000] * n)
+    pods, mask = mk_gang_pods(4, 4_000, state)
+    # node-layer multiple 2: nodes offering 2 stay 2; plan still fills a block
+    plan = plan_gang_placement(
+        state, pods, mask, topo,
+        TopologyRequirements(desired_slots=4, layer_multiples=(1, 1, 1, 2)),
+    )
+    counts = np.bincount(plan[:4][plan[:4] >= 0], minlength=n)
+    assert set(counts[counts > 0]) == {2}

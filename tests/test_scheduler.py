@@ -185,3 +185,77 @@ def test_diagnosis_message_shape():
     assert d.total_nodes == 1
     assert d.insufficient_resources == 1
     assert "1 insufficient resources" in d.message()
+
+
+def test_topology_gang_gathers_in_one_block():
+    from koordinator_tpu.ops.network_topology import (
+        TopologyRequirements,
+        TopologyTree,
+    )
+
+    # 2 blocks x 2 nodes; rows in the snapshot match tree add order
+    tree = TopologyTree(["block", "node"])
+    nodes = []
+    for i in range(4):
+        name = f"n{i}"
+        tree.add_node([f"b{i // 2}", name])
+        nodes.append(node(name, cpu=8_000))
+    sched, _ = mk_scheduler(nodes, topology_tree=tree.build(capacity=16))
+    # 2 pods of 8000 must gather at the block layer (one per node of a block)
+    sched.register_gang(GangRecord(
+        name="g", min_member=2,
+        topology=TopologyRequirements(desired_slots=2, must_gather_layer=1),
+    ))
+    for i in range(2):
+        sched.enqueue(pod(f"g{i}", cpu=8_000, gang="g"))
+    res = sched.schedule_round()
+    assert len(res.assignments) == 2
+    placed = sorted(res.assignments.values())
+    assert placed in (["n0", "n1"], ["n2", "n3"])  # same block
+
+
+def test_topology_gang_infeasible_backs_off():
+    from koordinator_tpu.ops.network_topology import (
+        TopologyRequirements,
+        TopologyTree,
+    )
+
+    tree = TopologyTree(["block", "node"])
+    nodes = []
+    for i in range(4):
+        tree.add_node([f"b{i // 2}", f"n{i}"])
+        nodes.append(node(f"n{i}", cpu=8_000))
+    sched, _ = mk_scheduler(nodes, topology_tree=tree.build(capacity=16))
+    # 3 full-node pods cannot gather within any 2-node block
+    sched.register_gang(GangRecord(
+        name="g", min_member=3,
+        topology=TopologyRequirements(desired_slots=3, must_gather_layer=1),
+    ))
+    for i in range(3):
+        sched.enqueue(pod(f"g{i}", cpu=8_000, gang="g"))
+    res = sched.schedule_round()
+    assert not res.assignments
+
+
+def test_topology_gang_surplus_members_not_invalidated():
+    from koordinator_tpu.ops.network_topology import (
+        TopologyRequirements,
+        TopologyTree,
+    )
+
+    tree = TopologyTree(["block", "node"])
+    nodes = []
+    for i in range(4):
+        tree.add_node([f"b{i // 2}", f"n{i}"])
+        nodes.append(node(f"n{i}", cpu=8_000))
+    sched, _ = mk_scheduler(nodes, topology_tree=tree.build(capacity=16))
+    # 3 members pending, plan covers desired_slots=2 -> the third member
+    # schedules freely instead of killing the gang
+    sched.register_gang(GangRecord(
+        name="g", min_member=2,
+        topology=TopologyRequirements(desired_slots=2, must_gather_layer=1),
+    ))
+    for i in range(3):
+        sched.enqueue(pod(f"g{i}", cpu=4_000, gang="g"))
+    res = sched.schedule_round()
+    assert len(res.assignments) == 3
