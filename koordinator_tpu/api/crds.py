@@ -1,0 +1,408 @@
+"""The CRD object model (reference: ``apis/`` — 13 CRDs, SURVEY.md §2.2).
+
+These dataclasses are the host-side protocol objects the components exchange
+(the reference exchanges them through the kube-apiserver; here they cross the
+Go/Python bridge or in-process queues). Tensor-side equivalents live in
+``state/`` and the per-subsystem kernels — these types are the boundary
+encoding, so they stay plain frozen dataclasses with explicit defaults.
+
+Parity map:
+- NodeMetric         <- apis/slo/v1alpha1/nodemetric_types.go:131
+- NodeSLO strategies <- apis/slo/v1alpha1/nodeslo_types.go:29-451
+- Device             <- apis/scheduling/v1alpha1/device_types.go:112
+- Reservation        <- apis/scheduling/v1alpha1/reservation_types.go:250
+- PodMigrationJob    <- apis/scheduling/v1alpha1/pod_migration_job_types.go:214
+- ClusterNetworkTopology <- cluster_network_topology_types.go:75
+- PodGroup / ElasticQuota <- apis/thirdparty/.../types.go:32,123
+- ClusterColocationProfile <- apis/configuration/
+- Recommendation     <- apis/analysis/v1alpha1/recommendation_types.go:96
+- ScheduleExplanation <- scheduling.koordinator.sh_scheduleexplanations.yaml
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# slo.koordinator.sh: NodeMetric
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceUsage:
+    cpu_milli: int = 0
+    memory_bytes: int = 0
+    extras: Mapping[str, int] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregatedUsage:
+    """Percentile-aggregated node usage (AggregatedUsage, nodemetric_types.go:50)."""
+
+    cpu_milli_p: Mapping[float, int] = dataclasses.field(default_factory=dict)
+    memory_bytes_p: Mapping[float, int] = dataclasses.field(default_factory=dict)
+    duration_seconds: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class PodMetricInfo:
+    namespace: str
+    name: str
+    uid: str
+    usage: ResourceUsage = ResourceUsage()
+    priority: int = 0
+    qos_class: str = "NONE"
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeMetricStatus:
+    update_time: float = 0.0
+    node_usage: ResourceUsage = ResourceUsage()
+    system_usage: ResourceUsage = ResourceUsage()
+    aggregated_node_usage: Optional[AggregatedUsage] = None
+    pods_metrics: Tuple[PodMetricInfo, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeMetricSpec:
+    """Collect policy pushed by the manager (NodeMetricCollectPolicy)."""
+
+    aggregate_duration_seconds: int = 300
+    report_interval_seconds: int = 60
+    node_memory_collect_policy: str = "usageWithoutPageCache"
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeMetric:
+    name: str
+    spec: NodeMetricSpec = NodeMetricSpec()
+    status: NodeMetricStatus = NodeMetricStatus()
+
+
+# ---------------------------------------------------------------------------
+# slo.koordinator.sh: NodeSLO (the per-node QoS strategy bundle)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceThresholdStrategy:
+    """Suppression/eviction thresholds (ResourceThresholdStrategy)."""
+
+    enable: bool = False
+    cpu_suppress_threshold_percent: int = 65
+    cpu_suppress_policy: str = "cpuset"       # cpuset | cfsQuota
+    cpu_evict_be_usage_threshold_percent: int = 90
+    cpu_evict_be_satisfaction_lower_percent: int = 0
+    cpu_evict_be_satisfaction_upper_percent: int = 0
+    cpu_evict_time_window_seconds: int = 60
+    memory_evict_threshold_percent: int = 70
+    memory_evict_lower_percent: int = 0       # 0 => threshold - 2
+
+
+@dataclasses.dataclass(frozen=True)
+class CPUQoS:
+    group_identity: int = 0                   # bvt_warp_ns: -1 BE, 0 none, 2 LS
+    core_sched: bool = False
+    sched_idle: int = 0                       # cpu.idle for BE on v2
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryQoS:
+    enable: bool = False
+    min_limit_percent: int = 0                # memory.min = request * pct
+    low_limit_percent: int = 0                # memory.low
+    throttling_percent: int = 0               # memory.high = limit * pct
+    wmark_ratio: int = 95
+    wmark_scale_permill: int = 20
+    wmark_min_adj: int = 0
+    priority: int = 0
+    priority_enable: int = 0
+    oom_kill_group: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ResctrlQoS:
+    cat_range_start_percent: int = 0
+    cat_range_end_percent: int = 100
+    mba_percent: int = 100
+
+
+@dataclasses.dataclass(frozen=True)
+class BlkIOQoS:
+    enable: bool = False
+    weight: int = 100
+    read_bps: int = 0                         # 0 = unlimited
+    write_bps: int = 0
+    read_iops: int = 0
+    write_iops: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkQoS:
+    enable: bool = False
+    ingress_request_mbps: int = 0
+    ingress_limit_mbps: int = 0
+    egress_request_mbps: int = 0
+    egress_limit_mbps: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class QoSStrategy:
+    """Per-QoS-class knobs (ResourceQOSStrategy has lse/lsr/ls/be branches)."""
+
+    cpu: CPUQoS = CPUQoS()
+    memory: MemoryQoS = MemoryQoS()
+    resctrl: ResctrlQoS = ResctrlQoS()
+    blkio: BlkIOQoS = BlkIOQoS()
+    network: NetworkQoS = NetworkQoS()
+
+
+@dataclasses.dataclass(frozen=True)
+class CPUBurstStrategy:
+    policy: str = "none"                      # none | cpuBurstOnly | cfsQuotaBurstOnly | auto
+    cpu_burst_percent: int = 1000             # burst buffer = limit * pct
+    cfs_quota_burst_percent: int = 300
+    cfs_quota_burst_period_seconds: int = -1  # -1 = forever
+    share_pool_threshold_percent: int = 50    # node-level guard
+
+
+@dataclasses.dataclass(frozen=True)
+class SystemStrategy:
+    min_free_kbytes_factor: int = 100
+    watermark_scale_factor: int = 150
+    memcg_reap_enabled: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeSLO:
+    """The rendered per-node strategy (NodeSLOSpec)."""
+
+    name: str = ""
+    resource_used_threshold_with_be: ResourceThresholdStrategy = (
+        ResourceThresholdStrategy()
+    )
+    resource_qos_ls: QoSStrategy = QoSStrategy(cpu=CPUQoS(group_identity=2))
+    resource_qos_lsr: QoSStrategy = QoSStrategy(cpu=CPUQoS(group_identity=2))
+    resource_qos_be: QoSStrategy = QoSStrategy(cpu=CPUQoS(group_identity=-1))
+    cpu_burst_strategy: CPUBurstStrategy = CPUBurstStrategy()
+    system_strategy: SystemStrategy = SystemStrategy()
+    extensions: Mapping[str, object] = dataclasses.field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# scheduling.koordinator.sh: Device
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceInfo:
+    """One device unit (DeviceInfo, device_types.go)."""
+
+    type: str                                  # gpu | rdma | xpu
+    uuid: str = ""
+    minor: int = 0
+    health: bool = True
+    resources: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    numa_node: int = -1
+    pcie_id: str = ""
+    busid: str = ""
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    vf_groups: Tuple[str, ...] = ()            # rdma virtual functions
+
+
+@dataclasses.dataclass(frozen=True)
+class Device:
+    """Per-node device CR: topology + health of all accelerators."""
+
+    node_name: str
+    devices: Tuple[DeviceInfo, ...] = ()
+    annotations: Mapping[str, str] = dataclasses.field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# scheduling.koordinator.sh: Reservation (protocol form)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ReservationSpec:
+    owners_selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    requests: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    ttl_seconds: int = 0                       # 0 = never expire
+    pre_allocation: bool = False
+    allocate_once: bool = True
+    allocate_policy: str = "Aligned"           # Default | Aligned | Restricted
+    unschedulable: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ReservationStatus:
+    phase: str = "Pending"                     # Pending|Available|Succeeded|Failed
+    node_name: str = ""
+    allocatable: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    allocated: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    current_owners: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Reservation:
+    name: str
+    uid: str = ""
+    spec: ReservationSpec = ReservationSpec()
+    status: ReservationStatus = ReservationStatus()
+
+
+# ---------------------------------------------------------------------------
+# scheduling.koordinator.sh: PodMigrationJob
+# ---------------------------------------------------------------------------
+
+MIGRATION_PHASE_PENDING = "Pending"
+MIGRATION_PHASE_RUNNING = "Running"
+MIGRATION_PHASE_SUCCEEDED = "Succeed"
+MIGRATION_PHASE_FAILED = "Failed"
+
+
+@dataclasses.dataclass(frozen=True)
+class PodMigrationJobSpec:
+    pod_uid: str = ""
+    pod_namespace: str = ""
+    pod_name: str = ""
+    mode: str = "ReservationFirst"             # ReservationFirst | EvictDirectly
+    ttl_seconds: int = 300
+    delete_options: Mapping[str, object] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class PodMigrationJobStatus:
+    phase: str = MIGRATION_PHASE_PENDING
+    reason: str = ""
+    message: str = ""
+    reservation_name: str = ""
+    node_name: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class PodMigrationJob:
+    name: str
+    spec: PodMigrationJobSpec = PodMigrationJobSpec()
+    status: PodMigrationJobStatus = PodMigrationJobStatus()
+    creation_time: float = 0.0
+
+
+# ---------------------------------------------------------------------------
+# scheduling.koordinator.sh: ClusterNetworkTopology (protocol form)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkTopologyLayer:
+    name: str                                  # e.g. "spine", "block"
+    parent: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkTopologyNodeInfo:
+    node_name: str
+    path: Tuple[str, ...] = ()                 # labels from root to leaf
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterNetworkTopology:
+    layers: Tuple[NetworkTopologyLayer, ...] = ()
+    nodes: Tuple[NetworkTopologyNodeInfo, ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# scheduling.sigs.k8s.io (thirdparty): PodGroup + ElasticQuota
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PodGroup:
+    name: str
+    namespace: str = "default"
+    min_member: int = 1
+    schedule_timeout_seconds: int = 600
+    mode: str = "Strict"                       # Strict | NonStrict
+    gang_group: Tuple[str, ...] = ()           # cross-gang group ids
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticQuota:
+    name: str
+    namespace: str = "default"
+    parent: str = "root"
+    min: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    max: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    shared_weight: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    is_parent: bool = False
+    allow_lent_resource: bool = True
+    guarantee_usage: bool = False
+    tree_id: str = ""
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticQuotaProfile:
+    """quota.koordinator.sh ElasticQuotaProfile: generates a quota tree from a
+    node selector (elastic_quota_profile_types.go:50)."""
+
+    name: str
+    quota_name: str = ""
+    node_selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    quota_labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    resource_ratio_percent: int = 100
+
+
+# ---------------------------------------------------------------------------
+# config.koordinator.sh: ClusterColocationProfile
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterColocationProfile:
+    """Webhook templating: inject QoS/priority/scheduler into matching pods."""
+
+    name: str
+    namespace_selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    pod_selector: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    qos_class: str = ""                        # inject koordinator.sh/qosClass
+    priority_class_name: str = ""
+    koordinator_priority: Optional[int] = None
+    scheduler_name: str = ""
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    patch_probability: float = 1.0             # canary percent
+
+
+# ---------------------------------------------------------------------------
+# analysis.koordinator.sh: Recommendation
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Recommendation:
+    """VPA-ish resource recommendation per workload (recommendation_types.go:96)."""
+
+    name: str
+    namespace: str = "default"
+    workload_ref: str = ""                     # kind/name
+    target_cpu_milli: int = 0
+    target_memory_bytes: int = 0
+    update_time: float = 0.0
+
+
+# ---------------------------------------------------------------------------
+# ScheduleExplanation (persisted diagnosis)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleExplanation:
+    pod_uid: str
+    pod_namespace: str = ""
+    pod_name: str = ""
+    reasons: Tuple[str, ...] = ()              # per-node or per-plugin failures
+    node_offers: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    update_time: float = 0.0

@@ -1,0 +1,187 @@
+"""The annotation/label protocol (reference: ``apis/extension/`` 3.4k LoC —
+this IS the wire format between all components).
+
+Accessors parse/render the ``koordinator.sh/*`` labels and annotations carried
+on pods and nodes. JSON payload schemas follow the reference field names so a
+reference-cluster pod annotation round-trips.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Mapping, Optional
+
+from koordinator_tpu.api.priority import PriorityClass
+from koordinator_tpu.api.qos import QoSClass
+
+DOMAIN = "koordinator.sh"
+SCHEDULING_DOMAIN = "scheduling.koordinator.sh"
+NODE_DOMAIN = "node.koordinator.sh"
+
+# Labels (apis/extension/constants.go)
+LABEL_POD_QOS = f"{DOMAIN}/qosClass"
+LABEL_POD_PRIORITY = f"{DOMAIN}/priority"
+LABEL_POD_PRIORITY_CLASS = f"{DOMAIN}/priority-class"
+LABEL_POD_MUTATING_UPDATE = f"{DOMAIN}/mutating-update"
+
+# Gang / coscheduling (apis/extension/coscheduling.go)
+LABEL_GANG_NAME = "pod-group.scheduling.sigs.k8s.io/name"
+LABEL_GANG_MIN_NUM = "pod-group.scheduling.sigs.k8s.io/min-available"
+ANNOTATION_GANG_GROUPS = f"{SCHEDULING_DOMAIN}/gang-groups"
+
+# Fine-grained CPU (apis/extension/numa_aware.go:34-37)
+ANNOTATION_RESOURCE_SPEC = f"{SCHEDULING_DOMAIN}/resource-spec"
+ANNOTATION_RESOURCE_STATUS = f"{SCHEDULING_DOMAIN}/resource-status"
+
+# Device allocation (apis/extension/device_share.go:32)
+ANNOTATION_DEVICE_ALLOCATED = f"{SCHEDULING_DOMAIN}/device-allocated"
+
+# Reservation (apis/extension/reservation.go)
+ANNOTATION_RESERVATION_ALLOCATED = f"{SCHEDULING_DOMAIN}/reservation-allocated"
+ANNOTATION_RESERVATION_AFFINITY = f"{SCHEDULING_DOMAIN}/reservation-affinity"
+LABEL_RESERVATION_IGNORED = f"{SCHEDULING_DOMAIN}/reservation-ignored"
+
+# Node-level (apis/extension/node_resource_amplification.go, cpu_normalization.go)
+ANNOTATION_NODE_AMPLIFICATION = f"{NODE_DOMAIN}/resource-amplification-ratio"
+ANNOTATION_CPU_NORMALIZATION = f"{NODE_DOMAIN}/cpu-normalization-ratio"
+ANNOTATION_NODE_RESERVATION = f"{NODE_DOMAIN}/reservation"
+LABEL_CPU_BIND_POLICY = f"{NODE_DOMAIN}/cpu-bind-policy"
+
+# Schedule explanation (apis/extension/schedule_explanation.go)
+ANNOTATION_SCHEDULE_EXPLANATION = f"{SCHEDULING_DOMAIN}/schedule-explanation"
+
+# Eviction / descheduling
+LABEL_SOFT_EVICTION = f"{SCHEDULING_DOMAIN}/soft-eviction"
+ANNOTATION_EVICTION_COST = f"{DOMAIN}/eviction-cost"
+
+# Extended resource names (apis/extension/resource.go:27-30)
+RESOURCE_BATCH_CPU = "kubernetes.io/batch-cpu"
+RESOURCE_BATCH_MEMORY = "kubernetes.io/batch-memory"
+RESOURCE_MID_CPU = "kubernetes.io/mid-cpu"
+RESOURCE_MID_MEMORY = "kubernetes.io/mid-memory"
+RESOURCE_GPU = "kubernetes.io/gpu"
+RESOURCE_GPU_CORE = "kubernetes.io/gpu-core"
+RESOURCE_GPU_MEMORY = "kubernetes.io/gpu-memory"
+RESOURCE_GPU_MEMORY_RATIO = "kubernetes.io/gpu-memory-ratio"
+RESOURCE_RDMA = "koordinator.sh/rdma"
+
+
+def get_pod_qos(labels: Mapping[str, str]) -> QoSClass:
+    return QoSClass.parse(labels.get(LABEL_POD_QOS, ""))
+
+
+def set_pod_qos(labels: dict, qos: QoSClass) -> dict:
+    labels[LABEL_POD_QOS] = qos.name
+    return labels
+
+
+def get_pod_priority_class(priority: Optional[int]) -> PriorityClass:
+    from koordinator_tpu.api.priority import priority_class_of
+
+    return priority_class_of(priority or 0)
+
+
+# ---- JSON annotation payloads ----------------------------------------------
+
+
+def get_resource_spec(annotations: Mapping[str, str]) -> dict:
+    """CPU orchestration request: {preferredCPUBindPolicy, preferredCPUExclusivePolicy,
+    requiredCPUBindPolicy, numaAllocateStrategy}."""
+    raw = annotations.get(ANNOTATION_RESOURCE_SPEC, "")
+    if not raw:
+        return {}
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        return {}
+
+
+def set_resource_status(annotations: dict, cpuset: str,
+                        numa_node_resources: list[dict] | None = None) -> dict:
+    """Scheduler -> agent cpuset result (the resource-status annotation the
+    cpuset runtime hook consumes)."""
+    annotations[ANNOTATION_RESOURCE_STATUS] = json.dumps(
+        {"cpuset": cpuset, "numaNodeResources": numa_node_resources or []},
+        sort_keys=True,
+    )
+    return annotations
+
+
+def get_resource_status(annotations: Mapping[str, str]) -> dict:
+    raw = annotations.get(ANNOTATION_RESOURCE_STATUS, "")
+    if not raw:
+        return {}
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        return {}
+
+
+def set_device_allocations(annotations: dict, allocations: dict) -> dict:
+    """{"gpu": [{"minor": 0, "resources": {...}}], "rdma": [...]}"""
+    annotations[ANNOTATION_DEVICE_ALLOCATED] = json.dumps(allocations, sort_keys=True)
+    return annotations
+
+
+def get_device_allocations(annotations: Mapping[str, str]) -> dict:
+    raw = annotations.get(ANNOTATION_DEVICE_ALLOCATED, "")
+    if not raw:
+        return {}
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        return {}
+
+
+def set_reservation_allocated(annotations: dict, name: str, uid: str) -> dict:
+    annotations[ANNOTATION_RESERVATION_ALLOCATED] = json.dumps(
+        {"name": name, "uid": uid}, sort_keys=True
+    )
+    return annotations
+
+
+def get_reservation_allocated(annotations: Mapping[str, str]) -> Optional[dict]:
+    raw = annotations.get(ANNOTATION_RESERVATION_ALLOCATED, "")
+    if not raw:
+        return None
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        return None
+
+
+def get_node_amplification_ratios(annotations: Mapping[str, str]) -> dict[str, int]:
+    """resource -> ratio percent (>=100). Encoded as {"cpu": 1.5} floats in the
+    reference; normalized here to integer percents."""
+    raw = annotations.get(ANNOTATION_NODE_AMPLIFICATION, "")
+    if not raw:
+        return {}
+    try:
+        parsed = json.loads(raw)
+        return {k: int(round(float(v) * 100)) for k, v in parsed.items()}
+    except (json.JSONDecodeError, TypeError, ValueError):
+        return {}
+
+
+def get_cpu_normalization_ratio_pct(annotations: Mapping[str, str]) -> int:
+    raw = annotations.get(ANNOTATION_CPU_NORMALIZATION, "")
+    try:
+        return int(round(float(raw) * 100)) if raw else 100
+    except ValueError:
+        return 100
+
+
+def get_node_reservation(annotations: Mapping[str, str]) -> dict[str, int]:
+    """Node-level reserved resources ({"resources": {"cpu": "2"}} form);
+    values normalized to milli-cpu / bytes by the caller's convention."""
+    raw = annotations.get(ANNOTATION_NODE_RESERVATION, "")
+    if not raw:
+        return {}
+    try:
+        parsed = json.loads(raw)
+    except json.JSONDecodeError:
+        return {}
+    if not isinstance(parsed, dict):
+        return {}
+    resources = parsed.get("resources", {})
+    return resources if isinstance(resources, dict) else {}

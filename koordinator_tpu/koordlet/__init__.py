@@ -1,0 +1,21 @@
+"""koordlet: the per-node agent.
+
+TPU-native rebuild of the reference's ``pkg/koordlet/`` (46.5k LoC Go).
+The agent is I/O-bound kernel programming — cgroups, procfs, resctrl — so it
+stays host-side Python + a C++ fast path (``native/``), while all the math it
+feeds (suppression levels, percentile aggregation, batch allocatable) reuses
+the same tensor kernels as the central solver.
+
+Module map (reference parity):
+
+- ``system``          <- pkg/koordlet/util/system (L0 cgroup/resctrl/PSI layer)
+- ``resourceexecutor``<- pkg/koordlet/resourceexecutor (cached, audited writer)
+- ``metriccache``     <- pkg/koordlet/metriccache (TSDB)
+- ``metricsadvisor``  <- pkg/koordlet/metricsadvisor (collectors)
+- ``statesinformer``  <- pkg/koordlet/statesinformer (state registry + fan-out)
+- ``qosmanager``      <- pkg/koordlet/qosmanager (strategy loops)
+- ``runtimehooks``    <- pkg/koordlet/runtimehooks (container lifecycle hooks)
+- ``pleg``            <- pkg/koordlet/pleg
+- ``audit``           <- pkg/koordlet/audit
+- ``daemon``          <- pkg/koordlet/koordlet.go (assembly)
+"""

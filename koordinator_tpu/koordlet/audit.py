@@ -1,0 +1,86 @@
+"""Node-local audit trail of every resource mutation (reference:
+``pkg/koordlet/audit/auditor.go:53`` — rotating log files + HTTP query).
+
+Events are JSON lines in size-rotated files under the agent's var-run dir;
+:meth:`Auditor.query` serves the reader path (newest first), which the debug
+HTTP endpoint exposes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Iterator
+
+
+class Auditor:
+    def __init__(self, log_dir: str, max_file_bytes: int = 1 << 20,
+                 max_files: int = 8, clock=time.time):
+        self.log_dir = log_dir
+        self.max_file_bytes = max_file_bytes
+        self.max_files = max_files
+        self._clock = clock
+        self._lock = threading.Lock()
+        os.makedirs(log_dir, exist_ok=True)
+
+    @property
+    def _active(self) -> str:
+        return os.path.join(self.log_dir, "audit.log")
+
+    def _rotated(self, i: int) -> str:
+        return os.path.join(self.log_dir, f"audit.log.{i}")
+
+    def log(self, group: str, operation: str, target: str, detail: dict | None = None):
+        """Append one event; rotates when the active file passes the cap."""
+        # detail first: canonical fields always win on key collision.
+        event = {
+            **(detail or {}),
+            "time": self._clock(),
+            "group": group,          # e.g. "cgroup", "resctrl", "eviction"
+            "operation": operation,  # e.g. "update", "evict"
+            "target": target,        # e.g. cgroup path or pod uid
+        }
+        line = json.dumps(event, sort_keys=True) + "\n"
+        with self._lock:
+            try:
+                size = os.path.getsize(self._active)
+            except OSError:
+                size = 0
+            if size + len(line) > self.max_file_bytes and size > 0:
+                self._rotate()
+            with open(self._active, "a") as f:
+                f.write(line)
+
+    def _rotate(self) -> None:
+        for i in range(self.max_files - 1, 0, -1):
+            src = self._rotated(i - 1) if i > 1 else self._active
+            if os.path.exists(src):
+                os.replace(src, self._rotated(i))
+
+    def _iter_lines(self) -> Iterator[str]:
+        files = [self._active] + [
+            self._rotated(i) for i in range(1, self.max_files)
+        ]
+        for path in files:
+            if not os.path.exists(path):
+                continue
+            with open(path) as f:
+                for line in reversed(f.readlines()):
+                    yield line
+
+    def query(self, limit: int = 100, group: str | None = None) -> list[dict]:
+        """Newest-first events, optionally filtered by group."""
+        out: list[dict] = []
+        for line in self._iter_lines():
+            try:
+                event = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if group is not None and event.get("group") != group:
+                continue
+            out.append(event)
+            if len(out) >= limit:
+                break
+        return out

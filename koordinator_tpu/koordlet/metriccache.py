@@ -1,0 +1,182 @@
+"""Node-local metric store (reference: ``pkg/koordlet/metriccache/`` — an
+embedded Prometheus TSDB ``tsdb_storage.go:29`` plus an in-memory KV
+``metric_cache.go:58-76``).
+
+TPU-native redesign: instead of a general TSDB, each (metric, label-set)
+series is a fixed-capacity numpy ring buffer of (ts, value). Windowed queries
+return contiguous views, and the aggregators (avg/latest/count/percentiles)
+are vectorized — the NodeMetric reporter's p50/p90/p95/p99 aggregation
+(``statesinformer/impl/states_nodemetric.go``) is one ``np.quantile`` call.
+The same buffers feed the prediction histograms without copies.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable, Mapping, Optional
+
+import numpy as np
+
+# Canonical metric names (metric_resources.go equivalents).
+NODE_CPU_USAGE = "node_cpu_usage"            # cores (float)
+NODE_MEMORY_USAGE = "node_memory_usage"      # bytes
+POD_CPU_USAGE = "pod_cpu_usage"              # labels: pod_uid
+POD_MEMORY_USAGE = "pod_memory_usage"
+CONTAINER_CPU_USAGE = "container_cpu_usage"  # labels: pod_uid, container_id
+CONTAINER_MEMORY_USAGE = "container_memory_usage"
+CONTAINER_CPU_THROTTLED = "container_cpu_throttled_ratio"
+BE_CPU_USAGE = "be_cpu_usage"
+SYS_CPU_USAGE = "sys_cpu_usage"
+SYS_MEMORY_USAGE = "sys_memory_usage"
+NODE_CPI_FIELD = "node_cpi"
+CONTAINER_CPI = "container_cpi"              # labels: pod_uid, container_id
+PSI_CPU_SOME_AVG10 = "psi_cpu_some_avg10"
+PSI_MEM_FULL_AVG10 = "psi_mem_full_avg10"
+PSI_IO_FULL_AVG10 = "psi_io_full_avg10"
+COLD_PAGE_BYTES = "cold_page_bytes"
+PAGE_CACHE_BYTES = "page_cache_bytes"
+HOST_APP_CPU_USAGE = "host_app_cpu_usage"    # labels: app
+HOST_APP_MEMORY_USAGE = "host_app_memory_usage"
+
+
+def _series_key(metric: str, labels: Mapping[str, str] | None) -> tuple:
+    return (metric, tuple(sorted((labels or {}).items())))
+
+
+class _Ring:
+    __slots__ = ("ts", "values", "head", "count")
+
+    def __init__(self, capacity: int):
+        self.ts = np.zeros(capacity, np.float64)
+        self.values = np.zeros(capacity, np.float64)
+        self.head = 0
+        self.count = 0
+
+    def append(self, ts: float, value: float) -> None:
+        cap = len(self.ts)
+        self.ts[self.head] = ts
+        self.values[self.head] = value
+        self.head = (self.head + 1) % cap
+        self.count = min(self.count + 1, cap)
+
+    def window(self, start: float, end: float) -> tuple[np.ndarray, np.ndarray]:
+        cap = len(self.ts)
+        if self.count < cap:
+            ts = self.ts[: self.count]
+            vals = self.values[: self.count]
+        else:
+            idx = np.arange(self.head, self.head + cap) % cap
+            ts = self.ts[idx]
+            vals = self.values[idx]
+        mask = (ts >= start) & (ts <= end)
+        return ts[mask], vals[mask]
+
+
+class AggregateResult:
+    """Windowed aggregation over one series."""
+
+    def __init__(self, ts: np.ndarray, values: np.ndarray):
+        self.ts = ts
+        self.values = values
+
+    @property
+    def count(self) -> int:
+        return len(self.values)
+
+    @property
+    def empty(self) -> bool:
+        return self.count == 0
+
+    def avg(self) -> float:
+        return float(self.values.mean()) if self.count else 0.0
+
+    def latest(self) -> float:
+        return float(self.values[np.argmax(self.ts)]) if self.count else 0.0
+
+    def max(self) -> float:
+        return float(self.values.max()) if self.count else 0.0
+
+    def percentile(self, q: float) -> float:
+        """q in (0,1]; lower-interpolation to match Go's sample quantiles."""
+        if not self.count:
+            return 0.0
+        return float(np.quantile(self.values, q, method="lower"))
+
+    def percentiles(self, qs: Iterable[float]) -> dict[float, float]:
+        return {q: self.percentile(q) for q in qs}
+
+    def duration_seconds(self) -> float:
+        return float(self.ts.max() - self.ts.min()) if self.count > 1 else 0.0
+
+
+class MetricCache:
+    """Thread-safe store of ring-buffered series + an immutable KV side table."""
+
+    def __init__(self, capacity_per_series: int = 4096, clock=time.time):
+        self.capacity = capacity_per_series
+        self._series: dict[tuple, _Ring] = {}
+        self._kv: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._clock = clock
+
+    # -- samples --
+
+    def append(self, metric: str, value: float,
+               labels: Mapping[str, str] | None = None,
+               ts: Optional[float] = None) -> None:
+        key = _series_key(metric, labels)
+        with self._lock:
+            ring = self._series.get(key)
+            if ring is None:
+                ring = self._series[key] = _Ring(self.capacity)
+            ring.append(self._clock() if ts is None else ts, value)
+
+    def append_many(self, samples: list[tuple[str, float, Mapping[str, str] | None]],
+                    ts: Optional[float] = None) -> None:
+        now = self._clock() if ts is None else ts
+        for metric, value, labels in samples:
+            self.append(metric, value, labels, ts=now)
+
+    def query(self, metric: str, labels: Mapping[str, str] | None = None,
+              start: float = 0.0, end: Optional[float] = None) -> AggregateResult:
+        key = _series_key(metric, labels)
+        end = self._clock() if end is None else end
+        with self._lock:
+            ring = self._series.get(key)
+            if ring is None:
+                return AggregateResult(np.empty(0), np.empty(0))
+            ts, vals = ring.window(start, end)
+        return AggregateResult(ts, vals)
+
+    def series_labels(self, metric: str) -> list[dict[str, str]]:
+        """All label-sets present for a metric (e.g. every pod_uid)."""
+        with self._lock:
+            return [
+                dict(lbl) for m, lbl in self._series.keys() if m == metric
+            ]
+
+    def delete_series(self, metric: str, labels: Mapping[str, str]) -> None:
+        with self._lock:
+            self._series.pop(_series_key(metric, labels), None)
+
+    def gc(self, keep_pod_uids: set[str]) -> int:
+        """Drop series of pods that no longer exist; returns dropped count."""
+        with self._lock:
+            stale = [
+                key for key in self._series
+                if any(k == "pod_uid" and v not in keep_pod_uids for k, v in key[1])
+            ]
+            for key in stale:
+                del self._series[key]
+        return len(stale)
+
+    # -- KV (device info, NUMA topology, etc.) --
+
+    def set_kv(self, key: str, value: object) -> None:
+        with self._lock:
+            self._kv[key] = value
+
+    def get_kv(self, key: str, default=None):
+        with self._lock:
+            return self._kv.get(key, default)

@@ -1,0 +1,359 @@
+"""Metrics advisor: the collector framework + collectors (reference:
+``pkg/koordlet/metricsadvisor/`` — registry ``plugins_profile.go:41-63``,
+collectors under ``collectors/`` and ``devices/``).
+
+Each collector implements :class:`Collector` and is driven by the framework's
+``collect_once`` (tests) or the periodic runner in ``daemon``. Rate-style
+metrics (CPU usage cores) keep per-target last-sample state inside the
+collector, mirroring the reference's tick-delta approach.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Optional, Protocol
+
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.koordlet.system import cgroup as cg
+from koordinator_tpu.koordlet.system import procfs, psi
+from koordinator_tpu.koordlet.system.config import SystemConfig, get_config
+
+
+class Collector(Protocol):
+    name: str
+
+    def enabled(self) -> bool: ...
+
+    def collect(self) -> None: ...
+
+
+@dataclasses.dataclass
+class _CPUTick:
+    ts: float
+    value: int  # cumulative jiffies or cumulative ns
+
+
+class _Deps:
+    def __init__(self, states: StatesInformer, cache: mc.MetricCache,
+                 cfg: Optional[SystemConfig], clock):
+        self.states = states
+        self.cache = cache
+        self.cfg = cfg or get_config()
+        self.clock = clock
+
+
+class NodeResourceCollector:
+    """Node CPU (cores) + memory (bytes) usage (collectors/noderesource)."""
+
+    name = "noderesource"
+
+    def __init__(self, deps: _Deps):
+        self.d = deps
+        self._last: Optional[_CPUTick] = None
+
+    def enabled(self) -> bool:
+        return os.path.exists(self.d.cfg.proc_path("stat"))
+
+    def collect(self) -> None:
+        now = self.d.clock()
+        stat = procfs.read_cpu_stat(self.d.cfg)
+        if self._last is not None and now > self._last.ts:
+            dt = now - self._last.ts
+            cores = (stat.used_jiffies - self._last.value) / (
+                procfs.JIFFIES_PER_SEC * dt
+            )
+            self.d.cache.append(mc.NODE_CPU_USAGE, max(0.0, cores), ts=now)
+        self._last = _CPUTick(now, stat.used_jiffies)
+
+        mem = procfs.read_meminfo(self.d.cfg)
+        self.d.cache.append(mc.NODE_MEMORY_USAGE, float(mem.used_no_cache), ts=now)
+        self.d.cache.append(
+            mc.PAGE_CACHE_BYTES, float(mem.cached), ts=now
+        )
+
+
+class _CgroupCPUTracker:
+    """Shared tick-delta logic over cpuacct.usage (v1, ns) / cpu.stat (v2, us)."""
+
+    def __init__(self, cfg: SystemConfig):
+        self.cfg = cfg
+        self._last: dict[str, _CPUTick] = {}
+
+    def usage_cores(self, key: str, rel_dir: str, now: float) -> Optional[float]:
+        try:
+            if self.cfg.use_cgroup_v2:
+                stat = cg.parse_stat(cg.cgroup_read(cg.CPU_STAT, rel_dir, self.cfg))
+                cum_ns = stat.get("usage_usec", 0) * 1000
+            else:
+                cum_ns = int(cg.cgroup_read(cg.CPUACCT_USAGE, rel_dir, self.cfg))
+        except (OSError, ValueError):
+            return None
+        last = self._last.get(key)
+        self._last[key] = _CPUTick(now, cum_ns)
+        if last is None or now <= last.ts:
+            return None
+        return max(0.0, (cum_ns - last.value) / 1e9 / (now - last.ts))
+
+    def forget_missing(self, live_keys: set[str]) -> None:
+        for key in [k for k in self._last if k not in live_keys]:
+            del self._last[key]
+
+
+class PodResourceCollector:
+    """Per-pod/container CPU + memory from pod cgroup dirs
+    (collectors/podresource)."""
+
+    name = "podresource"
+
+    def __init__(self, deps: _Deps):
+        self.d = deps
+        self._cpu = _CgroupCPUTracker(deps.cfg)
+
+    def enabled(self) -> bool:
+        return True
+
+    def _mem_bytes(self, rel_dir: str) -> Optional[float]:
+        try:
+            return float(cg.cgroup_read(cg.MEMORY_USAGE, rel_dir, self.d.cfg))
+        except (OSError, ValueError):
+            return None
+
+    def collect(self) -> None:
+        now = self.d.clock()
+        live: set[str] = set()
+        for pod in self.d.states.get_all_pods():
+            if not pod.is_running:
+                continue
+            rel = pod.cgroup_dir(self.d.cfg)
+            live.add(pod.uid)
+            cores = self._cpu.usage_cores(pod.uid, rel, now)
+            labels = {"pod_uid": pod.uid}
+            if cores is not None:
+                self.d.cache.append(mc.POD_CPU_USAGE, cores, labels, ts=now)
+            mem = self._mem_bytes(rel)
+            if mem is not None:
+                self.d.cache.append(mc.POD_MEMORY_USAGE, mem, labels, ts=now)
+            for container in pod.containers:
+                ckey = f"{pod.uid}/{container.container_id}"
+                live.add(ckey)
+                crel = container.cgroup_dir or self.d.cfg.container_cgroup_dir(
+                    pod.kube_qos, pod.uid, container.container_id
+                )
+                ccores = self._cpu.usage_cores(ckey, crel, now)
+                clabels = {"pod_uid": pod.uid, "container_id": container.container_id}
+                if ccores is not None:
+                    self.d.cache.append(mc.CONTAINER_CPU_USAGE, ccores, clabels, ts=now)
+                cmem = self._mem_bytes(crel)
+                if cmem is not None:
+                    self.d.cache.append(
+                        mc.CONTAINER_MEMORY_USAGE, cmem, clabels, ts=now
+                    )
+        self._cpu.forget_missing(live)
+
+
+class BEResourceCollector:
+    """Aggregate BestEffort-tier usage (collectors/beresource) — feeds the
+    cpusuppress/cpuevict loops."""
+
+    name = "beresource"
+
+    def __init__(self, deps: _Deps):
+        self.d = deps
+        self._cpu = _CgroupCPUTracker(deps.cfg)
+
+    def enabled(self) -> bool:
+        return True
+
+    def collect(self) -> None:
+        now = self.d.clock()
+        rel = self.d.cfg.kube_qos_dir("besteffort")
+        cores = self._cpu.usage_cores("besteffort", rel, now)
+        if cores is not None:
+            self.d.cache.append(mc.BE_CPU_USAGE, cores, ts=now)
+
+
+class SysResourceCollector:
+    """system usage = node usage - sum(pod usage) (collectors/sysresource)."""
+
+    name = "sysresource"
+
+    def __init__(self, deps: _Deps):
+        self.d = deps
+
+    def enabled(self) -> bool:
+        return True
+
+    def collect(self) -> None:
+        now = self.d.clock()
+        window = 60.0
+        node_cpu = self.d.cache.query(mc.NODE_CPU_USAGE, None, now - window, now)
+        node_mem = self.d.cache.query(mc.NODE_MEMORY_USAGE, None, now - window, now)
+        if node_cpu.empty and node_mem.empty:
+            return
+        pods_cpu = pods_mem = 0.0
+        for pod in self.d.states.get_all_pods():
+            labels = {"pod_uid": pod.uid}
+            pods_cpu += self.d.cache.query(
+                mc.POD_CPU_USAGE, labels, now - window, now
+            ).latest()
+            pods_mem += self.d.cache.query(
+                mc.POD_MEMORY_USAGE, labels, now - window, now
+            ).latest()
+        self.d.cache.append(
+            mc.SYS_CPU_USAGE, max(0.0, node_cpu.latest() - pods_cpu), ts=now
+        )
+        self.d.cache.append(
+            mc.SYS_MEMORY_USAGE, max(0.0, node_mem.latest() - pods_mem), ts=now
+        )
+
+
+class PodThrottledCollector:
+    """Per-container CFS throttle ratio from cpu.stat (collectors/podthrottled)."""
+
+    name = "podthrottled"
+
+    def __init__(self, deps: _Deps):
+        self.d = deps
+        self._last: dict[str, tuple[int, int]] = {}  # key -> (periods, throttled)
+
+    def enabled(self) -> bool:
+        return True
+
+    def collect(self) -> None:
+        now = self.d.clock()
+        live: set[str] = set()
+        for pod in self.d.states.get_all_pods():
+            if not pod.is_running:
+                continue
+            rel = pod.cgroup_dir(self.d.cfg)
+            try:
+                stat = cg.parse_stat(cg.cgroup_read(cg.CPU_STAT, rel, self.d.cfg))
+            except OSError:
+                continue
+            live.add(pod.uid)
+            periods = stat.get("nr_periods", 0)
+            throttled = stat.get("nr_throttled", 0)
+            last = self._last.get(pod.uid)
+            self._last[pod.uid] = (periods, throttled)
+            if last is None:
+                continue
+            dp, dth = periods - last[0], throttled - last[1]
+            if dp > 0:
+                self.d.cache.append(
+                    mc.CONTAINER_CPU_THROTTLED, dth / dp,
+                    {"pod_uid": pod.uid}, ts=now,
+                )
+        for key in [k for k in self._last if k not in live]:
+            del self._last[key]
+
+
+class PSICollector:
+    """Node + per-pod pressure stall averages (collectors/performance PSI)."""
+
+    name = "psi"
+
+    def __init__(self, deps: _Deps):
+        self.d = deps
+
+    def enabled(self) -> bool:
+        return os.path.exists(cg.resource_path(cg.CPU_PRESSURE, "", self.d.cfg))
+
+    def collect(self) -> None:
+        now = self.d.clock()
+        stats = psi.read_psi("", self.d.cfg)
+        self.d.cache.append(mc.PSI_CPU_SOME_AVG10, stats.cpu.some.avg10, ts=now)
+        self.d.cache.append(mc.PSI_MEM_FULL_AVG10, stats.mem.full.avg10, ts=now)
+        self.d.cache.append(mc.PSI_IO_FULL_AVG10, stats.io.full.avg10, ts=now)
+
+
+class ColdMemoryCollector:
+    """kidled cold-page bytes per pod + node (collectors/coldmemoryresource)."""
+
+    name = "coldmemory"
+
+    def __init__(self, deps: _Deps):
+        self.d = deps
+
+    def enabled(self) -> bool:
+        return procfs.kidled_supported(self.d.cfg)
+
+    def collect(self) -> None:
+        now = self.d.clock()
+        total = 0
+        for pod in self.d.states.get_all_pods():
+            rel = pod.cgroup_dir(self.d.cfg)
+            try:
+                raw = cg.cgroup_read(cg.MEMORY_IDLE_PAGE_STATS, rel, self.d.cfg)
+            except OSError:
+                continue
+            cold = procfs.parse_idle_page_stats(raw).get("cold", 0) * 4096
+            total += cold
+            self.d.cache.append(
+                mc.COLD_PAGE_BYTES, float(cold), {"pod_uid": pod.uid}, ts=now
+            )
+        self.d.cache.append(mc.COLD_PAGE_BYTES, float(total), ts=now)
+
+
+class HostApplicationCollector:
+    """Usage of declared host applications (out-of-k8s daemons) by their
+    cgroup dirs (collectors/hostapplication)."""
+
+    name = "hostapplication"
+
+    def __init__(self, deps: _Deps, host_apps: dict[str, str] | None = None):
+        self.d = deps
+        #: app name -> cgroup rel dir
+        self.host_apps = host_apps or {}
+        self._cpu = _CgroupCPUTracker(deps.cfg)
+
+    def enabled(self) -> bool:
+        return bool(self.host_apps)
+
+    def collect(self) -> None:
+        now = self.d.clock()
+        for app, rel in self.host_apps.items():
+            cores = self._cpu.usage_cores(app, rel, now)
+            labels = {"app": app}
+            if cores is not None:
+                self.d.cache.append(mc.HOST_APP_CPU_USAGE, cores, labels, ts=now)
+            try:
+                mem = float(cg.cgroup_read(cg.MEMORY_USAGE, rel, self.d.cfg))
+                self.d.cache.append(mc.HOST_APP_MEMORY_USAGE, mem, labels, ts=now)
+            except (OSError, ValueError):
+                pass
+
+
+class MetricsAdvisor:
+    """The collector registry + driver (metricsadvisor/framework)."""
+
+    def __init__(self, states: StatesInformer, cache: mc.MetricCache,
+                 cfg: Optional[SystemConfig] = None, clock=time.time,
+                 host_apps: dict[str, str] | None = None):
+        deps = _Deps(states, cache, cfg, clock)
+        self.deps = deps
+        self.collectors: list[Collector] = [
+            NodeResourceCollector(deps),
+            PodResourceCollector(deps),
+            BEResourceCollector(deps),
+            SysResourceCollector(deps),
+            PodThrottledCollector(deps),
+            PSICollector(deps),
+            ColdMemoryCollector(deps),
+            HostApplicationCollector(deps, host_apps),
+        ]
+
+    def collect_once(self) -> list[str]:
+        """One tick of every enabled collector; returns the names that ran."""
+        ran = []
+        for collector in self.collectors:
+            try:
+                if collector.enabled():
+                    collector.collect()
+                    ran.append(collector.name)
+            except (OSError, ValueError):
+                # One garbled kernel file must not kill the whole tick.
+                continue
+        return ran

@@ -1,0 +1,196 @@
+"""States informer: the agent's view of node/pods/NodeSLO + callback fan-out
+(reference: ``pkg/koordlet/statesinformer/api.go:117-131`` interface,
+``impl/states_*.go`` per-state plugins, NodeMetric reporter
+``impl/states_nodemetric.go:206``).
+
+The reference watches the kube-apiserver and the kubelet; here sources are
+pluggable feeders (the control-plane bridge, a kubelet stub, or tests calling
+``set_pods``/``set_node`` directly) and consumers register typed callbacks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Mapping, Optional
+
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.system.config import SystemConfig, get_config
+
+# Callback registration types (statesinformer.RegisterType).
+TYPE_NODE = "node"
+TYPE_ALL_PODS = "all-pods"
+TYPE_NODE_SLO = "node-slo"
+TYPE_NODE_METRIC = "node-metric"
+TYPE_DEVICE = "device"
+
+
+@dataclasses.dataclass(frozen=True)
+class ContainerMeta:
+    name: str
+    container_id: str
+    cgroup_dir: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class PodMeta:
+    """Node-side pod model: what the agent needs from a v1.Pod."""
+
+    uid: str
+    name: str
+    namespace: str
+    qos_class: QoSClass
+    kube_qos: str                        # guaranteed|burstable|besteffort
+    priority: int = 0
+    phase: str = "Running"
+    requests: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    limits: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    containers: tuple[ContainerMeta, ...] = ()
+    annotations: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    host_network: bool = False
+
+    def cgroup_dir(self, cfg: SystemConfig | None = None) -> str:
+        cfg = cfg or get_config()
+        return cfg.pod_cgroup_dir(self.kube_qos, self.uid)
+
+    @property
+    def is_running(self) -> bool:
+        return self.phase == "Running"
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeInfo:
+    name: str
+    allocatable: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    capacity: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Mapping[str, str] = dataclasses.field(default_factory=dict)
+
+
+class StatesInformer:
+    """Holds current state, fans out change callbacks, reports NodeMetric."""
+
+    def __init__(self, metric_cache: Optional[mc.MetricCache] = None,
+                 clock=time.time):
+        self._lock = threading.Lock()
+        self._node: Optional[NodeInfo] = None
+        self._pods: dict[str, PodMeta] = {}
+        self._node_slo: Optional[object] = None
+        self._device: Optional[object] = None
+        self._callbacks: dict[str, list[Callable]] = {}
+        self.metric_cache = metric_cache
+        self._clock = clock
+
+    # -- registration ---------------------------------------------------------
+
+    def register_callback(self, state_type: str, fn: Callable) -> None:
+        with self._lock:
+            self._callbacks.setdefault(state_type, []).append(fn)
+
+    def _fire(self, state_type: str, payload) -> None:
+        with self._lock:
+            fns = list(self._callbacks.get(state_type, []))
+        for fn in fns:
+            fn(payload)
+
+    # -- writers (fed by sources) --------------------------------------------
+
+    def set_node(self, node: NodeInfo) -> None:
+        with self._lock:
+            self._node = node
+        self._fire(TYPE_NODE, node)
+
+    def set_pods(self, pods: list[PodMeta]) -> None:
+        with self._lock:
+            self._pods = {p.uid: p for p in pods}
+        self._fire(TYPE_ALL_PODS, pods)
+
+    def set_node_slo(self, node_slo) -> None:
+        with self._lock:
+            self._node_slo = node_slo
+        self._fire(TYPE_NODE_SLO, node_slo)
+
+    def set_device(self, device) -> None:
+        with self._lock:
+            self._device = device
+        self._fire(TYPE_DEVICE, device)
+
+    # -- readers --------------------------------------------------------------
+
+    def get_node(self) -> Optional[NodeInfo]:
+        with self._lock:
+            return self._node
+
+    def get_all_pods(self) -> list[PodMeta]:
+        with self._lock:
+            return list(self._pods.values())
+
+    def get_pod(self, uid: str) -> Optional[PodMeta]:
+        with self._lock:
+            return self._pods.get(uid)
+
+    def get_node_slo(self):
+        with self._lock:
+            return self._node_slo
+
+    # -- NodeMetric reporting -------------------------------------------------
+
+    def build_node_metric(self, window_seconds: float = 300.0,
+                          report_percentiles: bool = True):
+        """Aggregate the metric cache into a NodeMetric status
+        (states_nodemetric.go sync loop). Returns api.crds.NodeMetricStatus.
+        """
+        from koordinator_tpu.api.crds import (
+            AggregatedUsage, NodeMetricStatus, PodMetricInfo, ResourceUsage,
+        )
+
+        assert self.metric_cache is not None, "metric cache required"
+        now = self._clock()
+        start = now - window_seconds
+
+        def usage_of(metric_cpu, metric_mem, labels=None) -> ResourceUsage:
+            cpu = self.metric_cache.query(metric_cpu, labels, start, now)
+            mem = self.metric_cache.query(metric_mem, labels, start, now)
+            return ResourceUsage(cpu_milli=int(cpu.avg() * 1000),
+                                 memory_bytes=int(mem.avg()))
+
+        node_usage = usage_of(mc.NODE_CPU_USAGE, mc.NODE_MEMORY_USAGE)
+        sys_usage = usage_of(mc.SYS_CPU_USAGE, mc.SYS_MEMORY_USAGE)
+
+        aggregated = None
+        if report_percentiles:
+            cpu_q = self.metric_cache.query(mc.NODE_CPU_USAGE, None, start, now)
+            mem_q = self.metric_cache.query(mc.NODE_MEMORY_USAGE, None, start, now)
+            aggregated = AggregatedUsage(
+                cpu_milli_p={
+                    q: int(cpu_q.percentile(q) * 1000)
+                    for q in (0.5, 0.9, 0.95, 0.99)
+                },
+                memory_bytes_p={
+                    q: int(mem_q.percentile(q)) for q in (0.5, 0.9, 0.95, 0.99)
+                },
+                duration_seconds=cpu_q.duration_seconds(),
+            )
+
+        pods_metrics = []
+        for pod in self.get_all_pods():
+            labels = {"pod_uid": pod.uid}
+            pods_metrics.append(
+                PodMetricInfo(
+                    namespace=pod.namespace, name=pod.name, uid=pod.uid,
+                    usage=usage_of(mc.POD_CPU_USAGE, mc.POD_MEMORY_USAGE, labels),
+                    priority=pod.priority,
+                    qos_class=pod.qos_class.name,
+                )
+            )
+
+        return NodeMetricStatus(
+            update_time=now,
+            node_usage=node_usage,
+            system_usage=sys_usage,
+            aggregated_node_usage=aggregated,
+            pods_metrics=tuple(pods_metrics),
+        )

@@ -1,0 +1,15 @@
+"""L0 host/kernel abstraction (reference: ``pkg/koordlet/util/system/``).
+
+Everything here is path-relocatable: all kernel filesystems (cgroupfs, procfs,
+sysfs, resctrl) are resolved through :class:`~.config.SystemConfig`, so tests
+point the whole layer at a tempdir exactly like the reference's
+``util_test_tool.go NewFileTestUtil``.
+"""
+
+from koordinator_tpu.koordlet.system.config import SystemConfig, set_config, get_config
+from koordinator_tpu.koordlet.system.cgroup import (
+    CgroupResource,
+    CgroupVersion,
+    known_resources,
+    resource_by_name,
+)

@@ -1,0 +1,319 @@
+"""Cgroup file registry with v1<->v2 mapping (reference:
+``util/system/cgroup_resource.go`` — the table of every known cgroup knob —
+plus ``cgroup.go`` read/write helpers).
+
+A :class:`CgroupResource` names one kernel knob once; the active
+:class:`~.config.SystemConfig` decides which filename/encoding it maps to.
+Values cross the API as strings exactly as they'd be written to the kernel
+file; converters translate between v1 and v2 encodings (e.g. cpu shares <->
+cpu.weight, cfs quota/period <-> "max 100000").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+from typing import Callable, Optional
+
+from koordinator_tpu.koordlet.system.config import SystemConfig, get_config
+
+CGROUP_MAX = "max"
+#: v1 "unlimited" encodings
+V1_UNLIMITED = {"-1", "9223372036854771712", "9223372036854775807"}
+
+
+class CgroupVersion(enum.IntEnum):
+    V1 = 1
+    V2 = 2
+
+
+def shares_to_weight(shares: int) -> int:
+    """Kernel mapping cpu.shares (v1, 2..262144) -> cpu.weight (v2, 1..10000)."""
+    return 1 + ((shares - 2) * 9999) // 262142
+
+
+def weight_to_shares(weight: int) -> int:
+    return 2 + ((weight - 1) * 262142) // 9999
+
+
+def _range_validator(
+    lo: int, hi: int, allow_unlimited: bool = False
+) -> Callable[[str], bool]:
+    """Accept integers in [lo, hi]; the 'max'/-1 unlimited sentinels only for
+    limit-style knobs that declare them (weight/ratio knobs must reject -1,
+    or the v1->v2 conversion would emit out-of-range kernel values)."""
+
+    def validate(value: str) -> bool:
+        if allow_unlimited and (value == CGROUP_MAX or value in V1_UNLIMITED):
+            return True
+        try:
+            return lo <= int(value) <= hi
+        except ValueError:
+            return False
+
+    return validate
+
+
+def _any(value: str) -> bool:
+    return True
+
+
+@dataclasses.dataclass(frozen=True)
+class CgroupResource:
+    """One kernel knob, version-agnostic."""
+
+    name: str                 # canonical resource name, e.g. "cpu.cfs_quota"
+    subsystem: str            # v1 subsystem dir ("cpu", "memory", "cpuset", "blkio")
+    v1_file: str
+    v2_file: str              # "" = not available on v2
+    validator: Callable[[str], bool] = _any
+    read_only: bool = False
+    #: translate a canonical (v1-shaped) value into the v2 file encoding.
+    to_v2: Optional[Callable[[str], str]] = None
+    #: translate a v2 file content back to the canonical encoding.
+    from_v2: Optional[Callable[[str], str]] = None
+
+    def filename(self, version: CgroupVersion) -> str:
+        return self.v1_file if version == CgroupVersion.V1 else self.v2_file
+
+    def supported(self, version: CgroupVersion) -> bool:
+        return bool(self.filename(version))
+
+
+def _quota_to_v2(quota: str) -> str:
+    # v2 cpu.max holds "QUOTA PERIOD"; we keep period untouched by writing the
+    # first field only when the file is round-tripped through read-modify-write
+    # in cgroup_update below. Canonical value here is the quota alone.
+    if quota in V1_UNLIMITED or quota == CGROUP_MAX:
+        return CGROUP_MAX
+    return quota
+
+
+def _quota_from_v2(content: str) -> str:
+    field = content.split()[0] if content.split() else CGROUP_MAX
+    return "-1" if field == CGROUP_MAX else field
+
+
+def _shares_to_v2(shares: str) -> str:
+    return str(shares_to_weight(int(shares)))
+
+
+def _weight_from_v2(weight: str) -> str:
+    return str(weight_to_shares(int(weight)))
+
+
+def _memlimit_to_v2(limit: str) -> str:
+    return CGROUP_MAX if limit in V1_UNLIMITED else limit
+
+
+def _memlimit_from_v2(content: str) -> str:
+    return "-1" if content == CGROUP_MAX else content
+
+
+# ---- the registry (cgroup_resource.go DefaultRegistry) ----------------------
+
+CPU_CFS_QUOTA = CgroupResource(
+    "cpu.cfs_quota", "cpu", "cpu.cfs_quota_us", "cpu.max",
+    _range_validator(-1, 10**9, allow_unlimited=True), to_v2=_quota_to_v2, from_v2=_quota_from_v2,
+)
+CPU_CFS_PERIOD = CgroupResource(
+    "cpu.cfs_period", "cpu", "cpu.cfs_period_us", "",
+    _range_validator(1000, 10**6),
+)
+CPU_CFS_BURST = CgroupResource(
+    "cpu.cfs_burst", "cpu", "cpu.cfs_burst_us", "cpu.max.burst",
+    _range_validator(0, 10**9),
+)
+CPU_SHARES = CgroupResource(
+    "cpu.shares", "cpu", "cpu.shares", "cpu.weight",
+    _range_validator(2, 262144), to_v2=_shares_to_v2, from_v2=_weight_from_v2,
+)
+CPU_BVT_WARP_NS = CgroupResource(  # group identity (Anolis kernel)
+    "cpu.bvt_warp_ns", "cpu", "cpu.bvt_warp_ns", "cpu.bvt_warp_ns",
+    _range_validator(-1, 2),
+)
+CPU_IDLE = CgroupResource(
+    "cpu.idle", "cpu", "cpu.idle", "cpu.idle", _range_validator(0, 1),
+)
+CPU_STAT = CgroupResource("cpu.stat", "cpu", "cpu.stat", "cpu.stat", read_only=True)
+CPUACCT_USAGE = CgroupResource(
+    "cpuacct.usage", "cpuacct", "cpuacct.usage", "", read_only=True,
+)
+CPUSET_CPUS = CgroupResource(
+    "cpuset.cpus", "cpuset", "cpuset.cpus", "cpuset.cpus",
+)
+CPUSET_CPUS_EFFECTIVE = CgroupResource(
+    "cpuset.cpus.effective", "cpuset", "cpuset.effective_cpus",
+    "cpuset.cpus.effective", read_only=True,
+)
+CPUSET_MEMS = CgroupResource("cpuset.mems", "cpuset", "cpuset.mems", "cpuset.mems")
+MEMORY_LIMIT = CgroupResource(
+    "memory.limit", "memory", "memory.limit_in_bytes", "memory.max",
+    _range_validator(-1, 1 << 62, allow_unlimited=True), to_v2=_memlimit_to_v2, from_v2=_memlimit_from_v2,
+)
+MEMORY_SOFT_LIMIT = CgroupResource(
+    "memory.soft_limit", "memory", "memory.soft_limit_in_bytes", "memory.high",
+    _range_validator(-1, 1 << 62, allow_unlimited=True), to_v2=_memlimit_to_v2, from_v2=_memlimit_from_v2,
+)
+MEMORY_MIN = CgroupResource(
+    "memory.min", "memory", "memory.min", "memory.min",
+    _range_validator(0, 1 << 62, allow_unlimited=True),
+)
+MEMORY_LOW = CgroupResource(
+    "memory.low", "memory", "memory.low", "memory.low",
+    _range_validator(0, 1 << 62, allow_unlimited=True),
+)
+MEMORY_HIGH = CgroupResource(
+    "memory.high", "memory", "memory.high", "memory.high",
+    _range_validator(0, 1 << 62, allow_unlimited=True), to_v2=_memlimit_to_v2, from_v2=_memlimit_from_v2,
+)
+MEMORY_WMARK_RATIO = CgroupResource(  # async reclaim watermark (Anolis)
+    "memory.wmark_ratio", "memory", "memory.wmark_ratio", "memory.wmark_ratio",
+    _range_validator(0, 100),
+)
+MEMORY_WMARK_SCALE_FACTOR = CgroupResource(
+    "memory.wmark_scale_factor", "memory", "memory.wmark_scale_factor",
+    "memory.wmark_scale_factor", _range_validator(1, 1000),
+)
+MEMORY_WMARK_MIN_ADJ = CgroupResource(
+    "memory.wmark_min_adj", "memory", "memory.wmark_min_adj",
+    "memory.wmark_min_adj", _range_validator(-25, 50),
+)
+MEMORY_PRIORITY = CgroupResource(
+    "memory.priority", "memory", "memory.priority", "memory.priority",
+    _range_validator(0, 12),
+)
+MEMORY_USE_PRIORITY_OOM = CgroupResource(
+    "memory.use_priority_oom", "memory", "memory.use_priority_oom",
+    "memory.use_priority_oom", _range_validator(0, 1),
+)
+MEMORY_OOM_GROUP = CgroupResource(
+    "memory.oom.group", "memory", "", "memory.oom.group", _range_validator(0, 1),
+)
+MEMORY_STAT = CgroupResource(
+    "memory.stat", "memory", "memory.stat", "memory.stat", read_only=True,
+)
+MEMORY_USAGE = CgroupResource(
+    "memory.usage", "memory", "memory.usage_in_bytes", "memory.current",
+    read_only=True,
+)
+BLKIO_WEIGHT = CgroupResource(
+    "blkio.weight", "blkio", "blkio.bfq.weight", "io.bfq.weight",
+    _range_validator(1, 1000),
+)
+BLKIO_READ_BPS = CgroupResource(
+    "blkio.throttle.read_bps", "blkio", "blkio.throttle.read_bps_device", "io.max",
+)
+BLKIO_WRITE_BPS = CgroupResource(
+    "blkio.throttle.write_bps", "blkio", "blkio.throttle.write_bps_device", "io.max",
+)
+BLKIO_READ_IOPS = CgroupResource(
+    "blkio.throttle.read_iops", "blkio", "blkio.throttle.read_iops_device", "io.max",
+)
+BLKIO_WRITE_IOPS = CgroupResource(
+    "blkio.throttle.write_iops", "blkio", "blkio.throttle.write_iops_device", "io.max",
+)
+CPU_PRESSURE = CgroupResource(
+    "cpu.pressure", "cpuacct", "cpu.pressure", "cpu.pressure", read_only=True,
+)
+MEMORY_PRESSURE = CgroupResource(
+    "memory.pressure", "cpuacct", "memory.pressure", "memory.pressure",
+    read_only=True,
+)
+IO_PRESSURE = CgroupResource(
+    "io.pressure", "cpuacct", "io.pressure", "io.pressure", read_only=True,
+)
+MEMORY_IDLE_PAGE_STATS = CgroupResource(  # kidled cold-page accounting
+    "memory.idle_page_stats", "memory", "memory.idle_page_stats",
+    "memory.idle_page_stats", read_only=True,
+)
+
+_REGISTRY: dict[str, CgroupResource] = {
+    r.name: r
+    for r in [
+        CPU_CFS_QUOTA, CPU_CFS_PERIOD, CPU_CFS_BURST, CPU_SHARES, CPU_BVT_WARP_NS,
+        CPU_IDLE, CPU_STAT, CPUACCT_USAGE, CPUSET_CPUS, CPUSET_CPUS_EFFECTIVE,
+        CPUSET_MEMS, MEMORY_LIMIT, MEMORY_SOFT_LIMIT, MEMORY_MIN, MEMORY_LOW,
+        MEMORY_HIGH, MEMORY_WMARK_RATIO, MEMORY_WMARK_SCALE_FACTOR,
+        MEMORY_WMARK_MIN_ADJ, MEMORY_PRIORITY, MEMORY_USE_PRIORITY_OOM,
+        MEMORY_OOM_GROUP, MEMORY_STAT, MEMORY_USAGE, BLKIO_WEIGHT, BLKIO_READ_BPS,
+        BLKIO_WRITE_BPS, BLKIO_READ_IOPS, BLKIO_WRITE_IOPS, CPU_PRESSURE,
+        MEMORY_PRESSURE, IO_PRESSURE, MEMORY_IDLE_PAGE_STATS,
+    ]
+}
+
+
+def known_resources() -> list[CgroupResource]:
+    return list(_REGISTRY.values())
+
+
+def resource_by_name(name: str) -> CgroupResource:
+    return _REGISTRY[name]
+
+
+# ---- read / write -----------------------------------------------------------
+
+
+def _version(cfg: SystemConfig) -> CgroupVersion:
+    return CgroupVersion.V2 if cfg.use_cgroup_v2 else CgroupVersion.V1
+
+
+def resource_path(res: CgroupResource, rel_dir: str, cfg: SystemConfig | None = None) -> str:
+    cfg = cfg or get_config()
+    return cfg.cgroup_abs_path(res.subsystem, rel_dir, res.filename(_version(cfg)))
+
+
+def cgroup_read(res: CgroupResource, rel_dir: str, cfg: SystemConfig | None = None) -> str:
+    """Read a knob, returning the canonical (v1-shaped) encoding."""
+    cfg = cfg or get_config()
+    with open(resource_path(res, rel_dir, cfg)) as f:
+        raw = f.read().strip()
+    if _version(cfg) == CgroupVersion.V2 and res.from_v2:
+        return res.from_v2(raw)
+    return raw
+
+
+def cgroup_write(res: CgroupResource, rel_dir: str, value: str,
+                 cfg: SystemConfig | None = None) -> bool:
+    """Write a canonical value to a knob; returns False if unsupported here.
+
+    Raises ValueError on a value the validator rejects (the reference logs and
+    skips; we surface it — resourceexecutor turns it into an audit record).
+    """
+    cfg = cfg or get_config()
+    if res.read_only:
+        raise ValueError(f"{res.name} is read-only")
+    if not res.supported(_version(cfg)):
+        return False
+    if not res.validator(value):
+        raise ValueError(f"invalid value {value!r} for {res.name}")
+    out = value
+    if _version(cfg) == CgroupVersion.V2 and res.to_v2:
+        out = res.to_v2(value)
+        if res is CPU_CFS_QUOTA:
+            # v2 cpu.max is "QUOTA PERIOD" — preserve the existing period.
+            path = resource_path(res, rel_dir, cfg)
+            period = "100000"
+            if os.path.exists(path):
+                fields = open(path).read().split()
+                if len(fields) == 2:
+                    period = fields[1]
+            out = f"{out} {period}"
+    path = resource_path(res, rel_dir, cfg)
+    with open(path, "w") as f:
+        f.write(out)
+    return True
+
+
+def parse_stat(content: str) -> dict[str, int]:
+    """Parse flat 'key value' files (cpu.stat, memory.stat)."""
+    out: dict[str, int] = {}
+    for line in content.splitlines():
+        parts = line.split()
+        if len(parts) == 2:
+            try:
+                out[parts[0]] = int(parts[1])
+            except ValueError:
+                pass
+    return out

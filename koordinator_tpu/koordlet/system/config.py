@@ -1,0 +1,118 @@
+"""Relocatable host-filesystem roots (reference: ``util/system/config.go``,
+``common_linux.go`` path helpers, and ``util_test_tool.go`` test redirection).
+
+A single process-global :class:`SystemConfig` holds the mount points of every
+kernel interface the agent touches. Production uses the real roots; tests
+install a config rooted in a tempdir and write fake kernel files.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+
+#: kubepods cgroup sub-trees per QoS class, cgroup-v1 layout names.
+KUBE_ROOT_NAME = "kubepods"
+KUBE_BURSTABLE_NAME = "burstable"
+KUBE_BESTEFFORT_NAME = "besteffort"
+
+
+@dataclasses.dataclass
+class SystemConfig:
+    """Mount points + layout knobs for the host kernel interfaces."""
+
+    cgroup_root: str = "/sys/fs/cgroup"
+    proc_root: str = "/proc"
+    sys_root: str = "/sys"
+    resctrl_root: str = "/sys/fs/resctrl"
+    var_run_root: str = "/var/run/koordinator"
+    use_cgroup_v2: bool = False
+    #: systemd-style slice names (kubepods.slice) vs cgroupfs (kubepods)
+    cgroup_driver_systemd: bool = False
+
+    # ---- cgroup path layout -------------------------------------------------
+
+    def _kube_component(self, name: str) -> str:
+        if not self.cgroup_driver_systemd:
+            return name
+        if name == KUBE_ROOT_NAME:
+            return "kubepods.slice"
+        return f"kubepods-{name}.slice"
+
+    def kube_qos_dir(self, qos: str) -> str:
+        """Relative cgroup dir for a kubelet QoS tier.
+
+        qos in {"guaranteed", "burstable", "besteffort"}; guaranteed pods live
+        directly under the kubepods root (kubelet convention).
+        """
+        root = self._kube_component(KUBE_ROOT_NAME)
+        if qos == "guaranteed":
+            return root
+        return os.path.join(root, self._kube_component(qos))
+
+    def pod_cgroup_dir(self, qos: str, pod_uid: str) -> str:
+        """Relative cgroup dir of one pod sandbox."""
+        if self.cgroup_driver_systemd:
+            prefix = {
+                "guaranteed": "kubepods",
+                "burstable": "kubepods-burstable",
+                "besteffort": "kubepods-besteffort",
+            }[qos]
+            leaf = f"{prefix}-pod{pod_uid.replace('-', '_')}.slice"
+        else:
+            leaf = f"pod{pod_uid}"
+        return os.path.join(self.kube_qos_dir(qos), leaf)
+
+    def container_cgroup_dir(self, qos: str, pod_uid: str, container_id: str) -> str:
+        """Relative cgroup dir of one container (containerd cri layout)."""
+        pod_dir = self.pod_cgroup_dir(qos, pod_uid)
+        if self.cgroup_driver_systemd:
+            return os.path.join(pod_dir, f"cri-containerd-{container_id}.scope")
+        return os.path.join(pod_dir, container_id)
+
+    def cgroup_abs_path(self, subsystem: str, rel_dir: str, filename: str = "") -> str:
+        """Absolute path of a cgroup file. On v2 the subsystem level vanishes
+        (unified hierarchy); on v1 it is the first path component."""
+        if self.use_cgroup_v2:
+            parts = [self.cgroup_root, rel_dir]
+        else:
+            parts = [self.cgroup_root, subsystem, rel_dir]
+        if filename:
+            parts.append(filename)
+        return os.path.join(*parts)
+
+    # ---- procfs / sysfs -----------------------------------------------------
+
+    def proc_path(self, *parts: str) -> str:
+        return os.path.join(self.proc_root, *parts)
+
+    def sys_path(self, *parts: str) -> str:
+        return os.path.join(self.sys_root, *parts)
+
+
+_CONFIG = SystemConfig()
+
+
+def get_config() -> SystemConfig:
+    return _CONFIG
+
+
+def set_config(cfg: SystemConfig) -> SystemConfig:
+    """Install a new process-global config; returns the previous one."""
+    global _CONFIG
+    prev, _CONFIG = _CONFIG, cfg
+    return prev
+
+
+def test_config(root: str | Path, use_cgroup_v2: bool = False) -> SystemConfig:
+    """A config fully rooted under ``root`` (the FileTestUtil equivalent)."""
+    root = str(root)
+    return SystemConfig(
+        cgroup_root=os.path.join(root, "cgroup"),
+        proc_root=os.path.join(root, "proc"),
+        sys_root=os.path.join(root, "sys"),
+        resctrl_root=os.path.join(root, "resctrl"),
+        var_run_root=os.path.join(root, "var-run"),
+        use_cgroup_v2=use_cgroup_v2,
+    )

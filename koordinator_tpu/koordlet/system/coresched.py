@@ -1,0 +1,170 @@
+"""Core scheduling (SMT sibling isolation) via prctl (reference:
+``util/system/core_sched_linux.go`` — PR_SCHED_CORE operations).
+
+Pods in the same group share a core-sched cookie so they may share SMT
+siblings; different cookies never co-run on a physical core — the CoreSched
+runtime hook uses this to stop BE pods from stealing LS siblings.
+
+The prctl path needs a 5.14+ kernel; everything is gated on
+:func:`supported` and degrades to a no-op recorder usable in tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import os
+
+PR_SCHED_CORE = 62
+PR_SCHED_CORE_GET = 0
+PR_SCHED_CORE_CREATE = 1
+PR_SCHED_CORE_SHARE_TO = 2
+PR_SCHED_CORE_SHARE_FROM = 3
+
+PIDTYPE_PID = 0
+PIDTYPE_TGID = 1
+PIDTYPE_PGID = 2
+
+
+class CoreSched:
+    """Thin prctl wrapper; inject a fake ``prctl`` callable for tests."""
+
+    def __init__(self, prctl=None):
+        if prctl is None:
+            prctl = self._load_prctl()
+        self._prctl = prctl
+
+    @staticmethod
+    def _load_prctl():
+        try:
+            libc = ctypes.CDLL(ctypes.util.find_library("c"), use_errno=True)
+
+            def prctl(option, arg2, arg3, arg4, arg5):
+                res = libc.prctl(
+                    ctypes.c_int(option),
+                    ctypes.c_ulong(arg2),
+                    ctypes.c_ulong(arg3),
+                    ctypes.c_ulong(arg4),
+                    ctypes.c_ulong(arg5),
+                )
+                if res != 0:
+                    raise OSError(ctypes.get_errno(), os.strerror(ctypes.get_errno()))
+                return res
+
+            return prctl
+        except Exception:  # pragma: no cover - no libc
+            return None
+
+    def supported(self) -> bool:
+        """Probe PR_SCHED_CORE_GET on self (EINVAL => kernel too old)."""
+        if self._prctl is None:
+            return False
+        try:
+            cookie = ctypes.c_ulonglong(0)
+            self._prctl(
+                PR_SCHED_CORE, PR_SCHED_CORE_GET, os.getpid(), PIDTYPE_PID,
+                ctypes.addressof(cookie),
+            )
+            return True
+        except OSError:
+            return False
+        except Exception:
+            return False
+
+    def get(self, pid: int) -> int:
+        cookie = ctypes.c_ulonglong(0)
+        self._prctl(
+            PR_SCHED_CORE, PR_SCHED_CORE_GET, pid, PIDTYPE_PID,
+            ctypes.addressof(cookie),
+        )
+        return cookie.value
+
+    def create(self, pid: int, pid_type: int = PIDTYPE_TGID) -> None:
+        """Assign a fresh cookie to pid (and its thread group)."""
+        self._prctl(PR_SCHED_CORE, PR_SCHED_CORE_CREATE, pid, pid_type, 0)
+
+    def share_to(self, pid: int) -> None:
+        """Push the calling task's cookie onto pid."""
+        self._prctl(PR_SCHED_CORE, PR_SCHED_CORE_SHARE_TO, pid, PIDTYPE_PID, 0)
+
+    def share_from(self, pid: int) -> None:
+        """Pull pid's cookie onto the calling task."""
+        self._prctl(PR_SCHED_CORE, PR_SCHED_CORE_SHARE_FROM, pid, PIDTYPE_PID, 0)
+
+    def assign_group(self, leader_pid: int, member_pids: list[int]) -> list[int]:
+        """Give leader a fresh cookie, then propagate it to members.
+        Returns pids that failed.
+
+        The share_from/share_to dance necessarily adopts the group's cookie
+        on the calling task and there is no prctl to restore a zero cookie,
+        so the dance runs in a short-lived forked child — the agent's own
+        cookie (and its SMT co-runnability) is never touched.
+        """
+        if not hasattr(os, "fork"):  # pragma: no cover - non-POSIX
+            return self._assign_group_inline(leader_pid, member_pids)
+        read_fd, write_fd = os.pipe()
+        pid = os.fork()
+        if pid == 0:  # child
+            os.close(read_fd)
+            try:
+                failed = self._assign_group_inline(leader_pid, member_pids)
+                os.write(write_fd, (",".join(map(str, failed))).encode())
+            finally:
+                os._exit(0)
+        os.close(write_fd)
+        try:
+            data = os.read(read_fd, 65536).decode()
+        finally:
+            os.close(read_fd)
+            os.waitpid(pid, 0)
+        return [int(x) for x in data.split(",") if x]
+
+    def _assign_group_inline(self, leader_pid: int, member_pids: list[int]) -> list[int]:
+        failed: list[int] = []
+        try:
+            self.create(leader_pid)
+            self.share_from(leader_pid)
+        except OSError:
+            return [leader_pid, *member_pids]
+        for pid in member_pids:
+            try:
+                self.share_to(pid)
+            except OSError:
+                failed.append(pid)
+        return failed
+
+
+class FakeCoreSched(CoreSched):
+    """Records cookies in-memory; used by tests and non-Linux dev hosts."""
+
+    def __init__(self):
+        super().__init__(prctl=lambda *a: 0)
+        self.cookies: dict[int, int] = {}
+        self._next = 1
+
+    def supported(self) -> bool:
+        return True
+
+    def get(self, pid: int) -> int:
+        return self.cookies.get(pid, 0)
+
+    def create(self, pid: int, pid_type: int = PIDTYPE_TGID) -> None:
+        self.cookies[pid] = self._next
+        self._next += 1
+
+    def share_from(self, pid: int) -> None:
+        self.cookies[os.getpid()] = self.cookies.get(pid, 0)
+
+    def share_to(self, pid: int) -> None:
+        self.cookies[pid] = self.cookies.get(os.getpid(), 0)
+
+    def assign_group(self, leader_pid: int, member_pids: list[int]) -> list[int]:
+        # Model the forked-child semantics: group gets cookies, the agent's
+        # own entry is untouched.
+        saved = self.cookies.get(os.getpid())
+        failed = self._assign_group_inline(leader_pid, member_pids)
+        if saved is None:
+            self.cookies.pop(os.getpid(), None)
+        else:
+            self.cookies[os.getpid()] = saved
+        return failed

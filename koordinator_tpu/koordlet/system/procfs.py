@@ -1,0 +1,220 @@
+"""procfs/sysfs readers (reference: ``util/system/common_linux.go``,
+``lscpu.go``, ``meminfo.go``, ``stat.go``): node CPU/memory usage and the
+CPU/NUMA topology the NUMA-aware scheduler consumes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from koordinator_tpu.koordlet.system.config import SystemConfig, get_config
+
+JIFFIES_PER_SEC = 100  # USER_HZ
+
+
+@dataclasses.dataclass(frozen=True)
+class CPUStat:
+    """Aggregate jiffies from the first line of /proc/stat."""
+
+    user: int = 0
+    nice: int = 0
+    system: int = 0
+    idle: int = 0
+    iowait: int = 0
+    irq: int = 0
+    softirq: int = 0
+    steal: int = 0
+
+    @property
+    def used_jiffies(self) -> int:
+        # usage = everything but idle/iowait (reference GetCPUStatUsageTicks).
+        return (
+            self.user + self.nice + self.system + self.irq + self.softirq + self.steal
+        )
+
+    @property
+    def total_jiffies(self) -> int:
+        return self.used_jiffies + self.idle + self.iowait
+
+
+def parse_proc_stat(content: str) -> CPUStat:
+    for line in content.splitlines():
+        parts = line.split()
+        if parts and parts[0] == "cpu":
+            vals = [int(x) for x in parts[1:9]] + [0] * 8
+            return CPUStat(*vals[:8])
+    return CPUStat()
+
+
+def read_cpu_stat(cfg: SystemConfig | None = None) -> CPUStat:
+    cfg = cfg or get_config()
+    with open(cfg.proc_path("stat")) as f:
+        return parse_proc_stat(f.read())
+
+
+@dataclasses.dataclass(frozen=True)
+class MemInfo:
+    """Bytes, from /proc/meminfo (kB fields scaled)."""
+
+    total: int = 0
+    free: int = 0
+    available: int = 0
+    buffers: int = 0
+    cached: int = 0
+
+    @property
+    def used_no_cache(self) -> int:
+        """MemTotal - MemAvailable: the reference's node memory usage."""
+        return max(0, self.total - self.available)
+
+
+def parse_meminfo(content: str) -> MemInfo:
+    kv: dict[str, int] = {}
+    for line in content.splitlines():
+        parts = line.replace(":", " ").split()
+        if len(parts) >= 2 and parts[1].isdigit():
+            kv[parts[0]] = int(parts[1]) * 1024
+    return MemInfo(
+        total=kv.get("MemTotal", 0),
+        free=kv.get("MemFree", 0),
+        available=kv.get("MemAvailable", kv.get("MemFree", 0)),
+        buffers=kv.get("Buffers", 0),
+        cached=kv.get("Cached", 0),
+    )
+
+
+def read_meminfo(cfg: SystemConfig | None = None) -> MemInfo:
+    cfg = cfg or get_config()
+    with open(cfg.proc_path("meminfo")) as f:
+        return parse_meminfo(f.read())
+
+
+# ---- cpuset list format -----------------------------------------------------
+
+
+def parse_cpu_list(spec: str) -> list[int]:
+    """'0-3,8,10-11' -> [0,1,2,3,8,10,11] (util/cpuset parity)."""
+    cpus: list[int] = []
+    for part in spec.strip().split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            cpus.extend(range(int(lo), int(hi) + 1))
+        else:
+            cpus.append(int(part))
+    return sorted(set(cpus))
+
+
+def format_cpu_list(cpus: list[int]) -> str:
+    """Inverse of :func:`parse_cpu_list`, producing compact ranges."""
+    cpus = sorted(set(cpus))
+    if not cpus:
+        return ""
+    runs: list[tuple[int, int]] = []
+    start = prev = cpus[0]
+    for c in cpus[1:]:
+        if c == prev + 1:
+            prev = c
+            continue
+        runs.append((start, prev))
+        start = prev = c
+    runs.append((start, prev))
+    return ",".join(f"{a}-{b}" if a != b else f"{a}" for a, b in runs)
+
+
+# ---- CPU/NUMA topology ------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CPUInfo:
+    cpu: int
+    core: int
+    socket: int
+    node: int  # NUMA node
+
+
+@dataclasses.dataclass(frozen=True)
+class CPUTopology:
+    cpus: tuple[CPUInfo, ...]
+
+    @property
+    def num_cpus(self) -> int:
+        return len(self.cpus)
+
+    def numa_nodes(self) -> list[int]:
+        return sorted({c.node for c in self.cpus})
+
+    def cpus_in_node(self, node: int) -> list[int]:
+        return [c.cpu for c in self.cpus if c.node == node]
+
+    def siblings(self, cpu: int) -> list[int]:
+        info = next(c for c in self.cpus if c.cpu == cpu)
+        return [
+            c.cpu
+            for c in self.cpus
+            if c.core == info.core and c.socket == info.socket
+        ]
+
+
+def read_cpu_topology(cfg: SystemConfig | None = None) -> CPUTopology:
+    """Build topology from /sys/devices/system/cpu (lscpu.go equivalent)."""
+    cfg = cfg or get_config()
+    base = cfg.sys_path("devices", "system", "cpu")
+    with open(os.path.join(base, "online")) as f:
+        online = parse_cpu_list(f.read())
+
+    def read_int(path: str, default: int = 0) -> int:
+        try:
+            with open(path) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return default
+
+    infos = []
+    for cpu in online:
+        topo = os.path.join(base, f"cpu{cpu}", "topology")
+        core = read_int(os.path.join(topo, "core_id"), cpu)
+        socket = read_int(os.path.join(topo, "physical_package_id"), 0)
+        node = 0
+        cpu_dir = os.path.join(base, f"cpu{cpu}")
+        try:
+            for entry in os.listdir(cpu_dir):
+                if entry.startswith("node") and entry[4:].isdigit():
+                    node = int(entry[4:])
+                    break
+        except OSError:
+            pass
+        infos.append(CPUInfo(cpu=cpu, core=core, socket=socket, node=node))
+    return CPUTopology(cpus=tuple(infos))
+
+
+# ---- kidled cold pages ------------------------------------------------------
+
+
+def parse_idle_page_stats(content: str) -> dict[str, int]:
+    """Parse memory.idle_page_stats (kidled_util.go): returns the csei/dsei...
+    bucket sums plus 'cold' = pages idle beyond the highest tracked age."""
+    out: dict[str, int] = {}
+    cold = 0
+    for line in content.splitlines():
+        parts = line.split()
+        if not parts or parts[0].startswith("#"):
+            continue
+        key = parts[0]
+        try:
+            vals = [int(x) for x in parts[1:]]
+        except ValueError:
+            continue
+        out[key] = sum(vals)
+        if vals and not key.startswith("scan"):
+            cold += vals[-1]  # oldest idle-age bucket
+    out["cold"] = cold
+    return out
+
+
+def kidled_supported(cfg: SystemConfig | None = None) -> bool:
+    cfg = cfg or get_config()
+    return os.path.exists(cfg.sys_path("kernel", "mm", "kidled", "scan_period_in_seconds"))

@@ -1,0 +1,78 @@
+"""PSI (pressure stall information) parsing (reference: ``util/system/psi.go``).
+
+PSI files look like::
+
+    some avg10=0.00 avg60=0.00 avg300=0.00 total=123456
+    full avg10=0.00 avg60=0.00 avg300=0.00 total=12345
+
+cpu.pressure has no ``full`` line on older kernels; parsing tolerates that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from koordinator_tpu.koordlet.system import cgroup
+from koordinator_tpu.koordlet.system.config import SystemConfig, get_config
+
+
+@dataclasses.dataclass(frozen=True)
+class PSILine:
+    avg10: float = 0.0
+    avg60: float = 0.0
+    avg300: float = 0.0
+    total_us: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PSIStats:
+    some: PSILine = PSILine()
+    full: PSILine = PSILine()
+    full_supported: bool = False
+
+
+def parse_psi(content: str) -> PSIStats:
+    some, full, has_full = PSILine(), PSILine(), False
+    for line in content.splitlines():
+        parts = line.split()
+        if not parts:
+            continue
+        kv = dict(p.split("=", 1) for p in parts[1:] if "=" in p)
+        try:
+            parsed = PSILine(
+                avg10=float(kv.get("avg10", 0)),
+                avg60=float(kv.get("avg60", 0)),
+                avg300=float(kv.get("avg300", 0)),
+                total_us=int(kv.get("total", 0)),
+            )
+        except ValueError:
+            continue
+        if parts[0] == "some":
+            some = parsed
+        elif parts[0] == "full":
+            full, has_full = parsed, True
+    return PSIStats(some=some, full=full, full_supported=has_full)
+
+
+@dataclasses.dataclass(frozen=True)
+class PSIByResource:
+    cpu: PSIStats
+    mem: PSIStats
+    io: PSIStats
+
+
+def read_psi(rel_dir: str, cfg: SystemConfig | None = None) -> PSIByResource:
+    """Read all three pressure files of one cgroup dir."""
+    cfg = cfg or get_config()
+
+    def one(res) -> PSIStats:
+        try:
+            return parse_psi(cgroup.cgroup_read(res, rel_dir, cfg))
+        except OSError:
+            return PSIStats()
+
+    return PSIByResource(
+        cpu=one(cgroup.CPU_PRESSURE),
+        mem=one(cgroup.MEMORY_PRESSURE),
+        io=one(cgroup.IO_PRESSURE),
+    )

@@ -1,0 +1,154 @@
+"""resctrl (Intel RDT / AMD QoS) filesystem layer (reference:
+``util/system/resctrl_linux.go``): LLC cache-way masks (CAT) and memory
+bandwidth percentages (MBA) per control group, plus task binding.
+
+Layout under the resctrl root::
+
+    /sys/fs/resctrl/
+        schemata                # root group
+        tasks
+        LS/ schemata tasks      # koordinator QoS groups: LS / LSR / BE
+        BE/ schemata tasks
+        info/L3/cbm_mask        # e.g. "fffff" => 20 cache ways
+        info/MB/min_bandwidth
+
+Schemata lines look like ``L3:0=fffff;1=fffff`` / ``MB:0=100;1=100``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from koordinator_tpu.koordlet.system.config import SystemConfig, get_config
+
+#: resctrl group names used by the QoS manager (resctrl qos plugin).
+GROUP_LS = "LS"
+GROUP_LSR = "LSR"
+GROUP_BE = "BE"
+ALL_GROUPS = (GROUP_LS, GROUP_LSR, GROUP_BE)
+
+
+@dataclasses.dataclass(frozen=True)
+class Schemata:
+    """Per-cache-domain L3 masks and MB percents."""
+
+    l3: dict[int, int] = dataclasses.field(default_factory=dict)   # domain -> way bitmask
+    mb: dict[int, int] = dataclasses.field(default_factory=dict)   # domain -> percent
+
+    def render(self) -> str:
+        lines = []
+        if self.l3:
+            lines.append(
+                "L3:" + ";".join(f"{d}={m:x}" for d, m in sorted(self.l3.items()))
+            )
+        if self.mb:
+            lines.append(
+                "MB:" + ";".join(f"{d}={p}" for d, p in sorted(self.mb.items()))
+            )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def parse_schemata(content: str) -> Schemata:
+    l3: dict[int, int] = {}
+    mb: dict[int, int] = {}
+    for line in content.splitlines():
+        line = line.strip()
+        if ":" not in line:
+            continue
+        kind, rest = line.split(":", 1)
+        for entry in rest.split(";"):
+            if "=" not in entry:
+                continue
+            dom, val = entry.split("=", 1)
+            try:
+                if kind.strip() == "L3":
+                    l3[int(dom)] = int(val, 16)
+                elif kind.strip() == "MB":
+                    mb[int(dom)] = int(val)
+            except ValueError:
+                continue
+    return Schemata(l3=l3, mb=mb)
+
+
+def percent_to_way_mask(percent: int, num_ways: int) -> int:
+    """A contiguous low mask covering >= percent of the cache ways (>=1 way).
+
+    Mirrors CalculateCatL3MaskValue: ways = ceil(num_ways * percent / 100).
+    """
+    ways = max(1, -(-num_ways * max(0, min(100, percent)) // 100))
+    return (1 << ways) - 1
+
+
+class ResctrlFS:
+    """Handle over the resctrl mount."""
+
+    def __init__(self, cfg: SystemConfig | None = None):
+        self.cfg = cfg or get_config()
+        self.root = self.cfg.resctrl_root
+
+    def available(self) -> bool:
+        return os.path.isfile(os.path.join(self.root, "schemata"))
+
+    def cbm_mask(self) -> int:
+        """Full L3 way mask from info/L3/cbm_mask (e.g. 0xfffff)."""
+        with open(os.path.join(self.root, "info", "L3", "cbm_mask")) as f:
+            return int(f.read().strip(), 16)
+
+    def num_cache_ways(self) -> int:
+        return self.cbm_mask().bit_count()
+
+    def cache_domains(self) -> list[int]:
+        """Domains present in the root schemata's L3 line."""
+        return sorted(self.read_schemata("").l3.keys())
+
+    def group_dir(self, group: str) -> str:
+        return os.path.join(self.root, group) if group else self.root
+
+    def ensure_group(self, group: str) -> None:
+        os.makedirs(self.group_dir(group), exist_ok=True)
+
+    def read_schemata(self, group: str) -> Schemata:
+        with open(os.path.join(self.group_dir(group), "schemata")) as f:
+            return parse_schemata(f.read())
+
+    def write_schemata(self, group: str, schemata: Schemata) -> None:
+        self.ensure_group(group)
+        with open(os.path.join(self.group_dir(group), "schemata"), "w") as f:
+            f.write(schemata.render())
+
+    def read_tasks(self, group: str) -> list[int]:
+        path = os.path.join(self.group_dir(group), "tasks")
+        if not os.path.exists(path):
+            return []
+        with open(path) as f:
+            return [int(x) for x in f.read().split() if x.strip().isdigit()]
+
+    def add_tasks(self, group: str, pids: list[int]) -> list[int]:
+        """Bind pids to a group; returns pids that failed (exited races are
+        expected and non-fatal, mirroring the reference's tolerance)."""
+        self.ensure_group(group)
+        failed = []
+        path = os.path.join(self.group_dir(group), "tasks")
+        for pid in pids:
+            try:
+                with open(path, "a") as f:
+                    f.write(f"{pid}\n")
+            except OSError:
+                failed.append(pid)
+        return failed
+
+    def apply_qos_policy(
+        self, group: str, l3_percent: int, mb_percent: int
+    ) -> Schemata:
+        """Program one QoS group from percentage policy (resctrl qos plugin
+        semantics): L3 percent -> way mask per domain, MB percent verbatim."""
+        ways = self.num_cache_ways()
+        mask = percent_to_way_mask(l3_percent, ways)
+        domains = self.cache_domains()
+        schemata = Schemata(
+            l3={d: mask for d in domains},
+            mb={d: max(1, min(100, mb_percent)) for d in domains},
+        )
+        self.write_schemata(group, schemata)
+        return schemata

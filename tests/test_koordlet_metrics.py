@@ -1,0 +1,244 @@
+"""metriccache + metricsadvisor + statesinformer tests against a fake kernel fs."""
+
+import os
+
+import pytest
+
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet import metricsadvisor as ma
+from koordinator_tpu.koordlet.statesinformer import (
+    ContainerMeta, NodeInfo, PodMeta, StatesInformer,
+)
+from koordinator_tpu.koordlet.system import cgroup as cg
+from koordinator_tpu.koordlet.system.config import test_config as make_test_config
+from tests.test_koordlet_system import write_cgroup_file
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def cfg(tmp_path):
+    return make_test_config(tmp_path)
+
+
+def make_pod(uid="pod-1", qos=QoSClass.LS, kube_qos="burstable", **kw):
+    return PodMeta(
+        uid=uid, name=uid, namespace="default", qos_class=qos,
+        kube_qos=kube_qos, **kw,
+    )
+
+
+class TestMetricCache:
+    def test_ring_window_and_aggregates(self, clock):
+        cache = mc.MetricCache(capacity_per_series=8, clock=clock)
+        for i in range(12):  # wraps: only last 8 retained
+            cache.append(mc.NODE_CPU_USAGE, float(i), ts=1000.0 + i)
+        result = cache.query(mc.NODE_CPU_USAGE, start=0, end=2000)
+        assert result.count == 8
+        assert result.latest() == 11.0
+        assert result.max() == 11.0
+        assert result.avg() == pytest.approx(sum(range(4, 12)) / 8)
+        # windowed subset
+        sub = cache.query(mc.NODE_CPU_USAGE, start=1008, end=1010)
+        assert sub.count == 3
+
+    def test_percentiles_lower_interpolation(self, clock):
+        cache = mc.MetricCache(clock=clock)
+        for i in range(1, 101):
+            cache.append(mc.NODE_CPU_USAGE, float(i), ts=1000.0 + i)
+        result = cache.query(mc.NODE_CPU_USAGE, start=0, end=2000)
+        ps = result.percentiles([0.5, 0.9, 0.95, 0.99])
+        assert ps[0.5] == 50.0
+        assert ps[0.99] == 99.0
+
+    def test_labels_and_gc(self, clock):
+        cache = mc.MetricCache(clock=clock)
+        cache.append(mc.POD_CPU_USAGE, 1.0, {"pod_uid": "a"})
+        cache.append(mc.POD_CPU_USAGE, 2.0, {"pod_uid": "b"})
+        assert len(cache.series_labels(mc.POD_CPU_USAGE)) == 2
+        dropped = cache.gc(keep_pod_uids={"a"})
+        assert dropped == 1
+        assert cache.query(mc.POD_CPU_USAGE, {"pod_uid": "b"}).empty
+
+    def test_kv(self):
+        cache = mc.MetricCache()
+        cache.set_kv("numa", {"nodes": 2})
+        assert cache.get_kv("numa") == {"nodes": 2}
+
+
+def write_proc(cfg, used_jiffies, mem_used_kb=400, mem_total_kb=1000):
+    os.makedirs(cfg.proc_root, exist_ok=True)
+    with open(cfg.proc_path("stat"), "w") as f:
+        f.write(f"cpu  {used_jiffies} 0 0 800 0 0 0 0 0 0\n")
+    with open(cfg.proc_path("meminfo"), "w") as f:
+        f.write(
+            f"MemTotal: {mem_total_kb} kB\n"
+            f"MemAvailable: {mem_total_kb - mem_used_kb} kB\nCached: 100 kB\n"
+        )
+
+
+class TestCollectors:
+    def test_node_cpu_rate(self, cfg, clock):
+        states = StatesInformer(clock=clock)
+        cache = mc.MetricCache(clock=clock)
+        advisor = ma.MetricsAdvisor(states, cache, cfg, clock)
+        write_proc(cfg, used_jiffies=1000)
+        advisor.collect_once()
+        clock.tick(10)
+        write_proc(cfg, used_jiffies=1000 + 2000)  # 2000 jiffies = 2 cores * 10s
+        advisor.collect_once()
+        result = cache.query(mc.NODE_CPU_USAGE, start=0, end=clock.t + 1)
+        assert result.latest() == pytest.approx(2.0)
+        mem = cache.query(mc.NODE_MEMORY_USAGE, start=0, end=clock.t + 1)
+        assert mem.latest() == 400 * 1024
+
+    def test_pod_and_container_usage(self, cfg, clock):
+        pod = make_pod(containers=(ContainerMeta("c1", "cid-1"),))
+        states = StatesInformer(clock=clock)
+        states.set_pods([pod])
+        cache = mc.MetricCache(clock=clock)
+        advisor = ma.MetricsAdvisor(states, cache, cfg, clock)
+        rel = pod.cgroup_dir(cfg)
+        crel = cfg.container_cgroup_dir("burstable", pod.uid, "cid-1")
+        write_proc(cfg, 100)
+        write_cgroup_file(cfg, cg.CPUACCT_USAGE, rel, "0")
+        write_cgroup_file(cfg, cg.MEMORY_USAGE, rel, "1048576")
+        write_cgroup_file(cfg, cg.CPUACCT_USAGE, crel, "0")
+        write_cgroup_file(cfg, cg.MEMORY_USAGE, crel, "524288")
+        advisor.collect_once()
+        clock.tick(10)
+        write_cgroup_file(cfg, cg.CPUACCT_USAGE, rel, str(15 * 10**9))
+        write_cgroup_file(cfg, cg.CPUACCT_USAGE, crel, str(5 * 10**9))
+        advisor.collect_once()
+        pod_cpu = cache.query(mc.POD_CPU_USAGE, {"pod_uid": pod.uid}, 0, clock.t + 1)
+        assert pod_cpu.latest() == pytest.approx(1.5)
+        c_cpu = cache.query(
+            mc.CONTAINER_CPU_USAGE,
+            {"pod_uid": pod.uid, "container_id": "cid-1"}, 0, clock.t + 1,
+        )
+        assert c_cpu.latest() == pytest.approx(0.5)
+        pod_mem = cache.query(mc.POD_MEMORY_USAGE, {"pod_uid": pod.uid}, 0, clock.t + 1)
+        assert pod_mem.latest() == 1048576
+
+    def test_be_usage_v2(self, tmp_path, clock):
+        cfg = make_test_config(tmp_path, use_cgroup_v2=True)
+        states = StatesInformer(clock=clock)
+        cache = mc.MetricCache(clock=clock)
+        advisor = ma.MetricsAdvisor(states, cache, cfg, clock)
+        rel = cfg.kube_qos_dir("besteffort")
+        write_proc(cfg, 100)
+        write_cgroup_file(cfg, cg.CPU_STAT, rel, "usage_usec 0\n")
+        advisor.collect_once()
+        clock.tick(5)
+        write_cgroup_file(cfg, cg.CPU_STAT, rel, f"usage_usec {4 * 10**6 * 5}\n")
+        advisor.collect_once()
+        be = cache.query(mc.BE_CPU_USAGE, start=0, end=clock.t + 1)
+        assert be.latest() == pytest.approx(4.0)
+
+    def test_throttled_ratio(self, cfg, clock):
+        pod = make_pod()
+        states = StatesInformer(clock=clock)
+        states.set_pods([pod])
+        cache = mc.MetricCache(clock=clock)
+        advisor = ma.MetricsAdvisor(states, cache, cfg, clock)
+        rel = pod.cgroup_dir(cfg)
+        write_proc(cfg, 100)
+        write_cgroup_file(cfg, cg.CPU_STAT, rel, "nr_periods 100\nnr_throttled 10\n")
+        advisor.collect_once()
+        clock.tick(10)
+        write_cgroup_file(cfg, cg.CPU_STAT, rel, "nr_periods 200\nnr_throttled 60\n")
+        advisor.collect_once()
+        thr = cache.query(mc.CONTAINER_CPU_THROTTLED, {"pod_uid": pod.uid}, 0, clock.t + 1)
+        assert thr.latest() == pytest.approx(0.5)
+
+    def test_sys_resource(self, cfg, clock):
+        pod = make_pod()
+        states = StatesInformer(clock=clock)
+        states.set_pods([pod])
+        cache = mc.MetricCache(clock=clock)
+        cache.append(mc.NODE_CPU_USAGE, 4.0)
+        cache.append(mc.POD_CPU_USAGE, 1.5, {"pod_uid": pod.uid})
+        cache.append(mc.NODE_MEMORY_USAGE, 1000.0)
+        cache.append(mc.POD_MEMORY_USAGE, 400.0, {"pod_uid": pod.uid})
+        advisor = ma.MetricsAdvisor(states, cache, cfg, clock)
+        ma.SysResourceCollector(advisor.deps).collect()
+        assert cache.query(mc.SYS_CPU_USAGE, start=0, end=clock.t + 1).latest() == 2.5
+        assert cache.query(mc.SYS_MEMORY_USAGE, start=0, end=clock.t + 1).latest() == 600.0
+
+
+class TestStatesInformer:
+    def test_callbacks_fire(self, clock):
+        states = StatesInformer(clock=clock)
+        seen = []
+        states.register_callback("all-pods", lambda pods: seen.append(len(pods)))
+        states.set_pods([make_pod(), make_pod(uid="pod-2")])
+        assert seen == [2]
+
+    def test_node_metric_aggregation(self, clock):
+        cache = mc.MetricCache(clock=clock)
+        states = StatesInformer(metric_cache=cache, clock=clock)
+        pod = make_pod(priority=9500)
+        states.set_pods([pod])
+        states.set_node(NodeInfo(name="n1"))
+        for i in range(10):
+            cache.append(mc.NODE_CPU_USAGE, 1.0 + i * 0.1, ts=clock.t - 100 + i)
+            cache.append(mc.NODE_MEMORY_USAGE, 1e9, ts=clock.t - 100 + i)
+            cache.append(mc.POD_CPU_USAGE, 0.5, {"pod_uid": pod.uid},
+                         ts=clock.t - 100 + i)
+        status = states.build_node_metric(window_seconds=300)
+        assert status.node_usage.cpu_milli == pytest.approx(1450, abs=1)
+        assert status.aggregated_node_usage is not None
+        assert status.aggregated_node_usage.cpu_milli_p[0.5] == 1400
+        assert len(status.pods_metrics) == 1
+        assert status.pods_metrics[0].usage.cpu_milli == 500
+        assert status.pods_metrics[0].qos_class == "LS"
+
+
+class TestExtensionProtocol:
+    def test_qos_label_roundtrip(self):
+        from koordinator_tpu.api import extension as ext
+
+        labels = {}
+        ext.set_pod_qos(labels, QoSClass.BE)
+        assert labels[ext.LABEL_POD_QOS] == "BE"
+        assert ext.get_pod_qos(labels) == QoSClass.BE
+        assert ext.get_pod_qos({}) == QoSClass.NONE
+
+    def test_resource_status_roundtrip(self):
+        from koordinator_tpu.api import extension as ext
+
+        ann = {}
+        ext.set_resource_status(ann, "0-3,8")
+        assert ext.get_resource_status(ann)["cpuset"] == "0-3,8"
+
+    def test_device_allocation_roundtrip(self):
+        from koordinator_tpu.api import extension as ext
+
+        ann = {}
+        allocs = {"gpu": [{"minor": 0, "resources": {"kubernetes.io/gpu-core": 50}}]}
+        ext.set_device_allocations(ann, allocs)
+        assert ext.get_device_allocations(ann) == allocs
+
+    def test_amplification_and_normalization(self):
+        from koordinator_tpu.api import extension as ext
+
+        ann = {ext.ANNOTATION_NODE_AMPLIFICATION: '{"cpu": 1.5}',
+               ext.ANNOTATION_CPU_NORMALIZATION: "1.2"}
+        assert ext.get_node_amplification_ratios(ann) == {"cpu": 150}
+        assert ext.get_cpu_normalization_ratio_pct(ann) == 120
+        assert ext.get_cpu_normalization_ratio_pct({}) == 100

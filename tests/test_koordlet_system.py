@@ -1,0 +1,247 @@
+"""L0 system layer + resourceexecutor tests, run against a fake kernel fs
+rooted in a tempdir (the reference's NewFileTestUtil pattern)."""
+
+import os
+
+import pytest
+
+from koordinator_tpu.koordlet import resourceexecutor as rex
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.system import cgroup as cg
+from koordinator_tpu.koordlet.system import coresched, procfs, psi, resctrl
+from koordinator_tpu.koordlet.system.config import test_config as make_test_config
+
+
+@pytest.fixture
+def cfg(tmp_path):
+    return make_test_config(tmp_path)
+
+
+@pytest.fixture
+def cfg_v2(tmp_path):
+    return make_test_config(tmp_path, use_cgroup_v2=True)
+
+
+def write_cgroup_file(cfg, res, rel_dir, content):
+    version = cg.CgroupVersion.V2 if cfg.use_cgroup_v2 else cg.CgroupVersion.V1
+    path = cfg.cgroup_abs_path(res.subsystem, rel_dir, res.filename(version))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(content)
+    return path
+
+
+class TestCgroupLayer:
+    def test_v1_read_write_roundtrip(self, cfg):
+        write_cgroup_file(cfg, cg.CPU_CFS_QUOTA, "kubepods", "-1")
+        assert cg.cgroup_read(cg.CPU_CFS_QUOTA, "kubepods", cfg) == "-1"
+        cg.cgroup_write(cg.CPU_CFS_QUOTA, "kubepods", "50000", cfg)
+        assert cg.cgroup_read(cg.CPU_CFS_QUOTA, "kubepods", cfg) == "50000"
+
+    def test_v2_quota_translation_preserves_period(self, cfg_v2):
+        path = write_cgroup_file(cfg_v2, cg.CPU_CFS_QUOTA, "kubepods", "max 50000")
+        cg.cgroup_write(cg.CPU_CFS_QUOTA, "kubepods", "25000", cfg_v2)
+        assert open(path).read() == "25000 50000"
+        # canonical read translates back; unlimited maps to -1
+        assert cg.cgroup_read(cg.CPU_CFS_QUOTA, "kubepods", cfg_v2) == "25000"
+        cg.cgroup_write(cg.CPU_CFS_QUOTA, "kubepods", "-1", cfg_v2)
+        assert cg.cgroup_read(cg.CPU_CFS_QUOTA, "kubepods", cfg_v2) == "-1"
+
+    def test_shares_weight_mapping(self, cfg_v2):
+        write_cgroup_file(cfg_v2, cg.CPU_SHARES, "kubepods", "100")
+        cg.cgroup_write(cg.CPU_SHARES, "kubepods", "1024", cfg_v2)
+        weight = int(open(cfg_v2.cgroup_abs_path("cpu", "kubepods", "cpu.weight")).read())
+        assert weight == cg.shares_to_weight(1024) == 39
+        # kernel mapping endpoints
+        assert cg.shares_to_weight(2) == 1
+        assert cg.shares_to_weight(262144) == 10000
+
+    def test_validator_rejects(self, cfg):
+        write_cgroup_file(cfg, cg.MEMORY_WMARK_RATIO, "kubepods", "0")
+        with pytest.raises(ValueError):
+            cg.cgroup_write(cg.MEMORY_WMARK_RATIO, "kubepods", "150", cfg)
+
+    def test_unsupported_on_version_returns_false(self, cfg):
+        # memory.oom.group is v2-only
+        assert not cg.cgroup_write(cg.MEMORY_OOM_GROUP, "kubepods", "1", cfg)
+
+    def test_pod_container_paths(self, cfg):
+        rel = cfg.pod_cgroup_dir("besteffort", "uid-1")
+        assert rel == "kubepods/besteffort/poduid-1"
+        crel = cfg.container_cgroup_dir("burstable", "uid-2", "abc")
+        assert crel == "kubepods/burstable/poduid-2/abc"
+
+    def test_systemd_driver_paths(self, tmp_path):
+        c = make_test_config(tmp_path)
+        c.cgroup_driver_systemd = True
+        assert c.kube_qos_dir("besteffort") == os.path.join(
+            "kubepods.slice", "kubepods-besteffort.slice"
+        )
+        assert "kubepods-besteffort-poduid_1.slice" in c.pod_cgroup_dir(
+            "besteffort", "uid-1"
+        )
+
+
+class TestPSI:
+    def test_parse(self):
+        content = (
+            "some avg10=1.50 avg60=0.75 avg300=0.10 total=12345\n"
+            "full avg10=0.50 avg60=0.25 avg300=0.05 total=678\n"
+        )
+        stats = psi.parse_psi(content)
+        assert stats.some.avg10 == 1.50
+        assert stats.full.total_us == 678
+        assert stats.full_supported
+
+    def test_cpu_without_full(self):
+        stats = psi.parse_psi("some avg10=0.00 avg60=0.00 avg300=0.00 total=0\n")
+        assert not stats.full_supported
+
+
+class TestResctrl:
+    def make_fs(self, cfg, ways=20, domains=(0, 1)):
+        root = cfg.resctrl_root
+        os.makedirs(os.path.join(root, "info", "L3"), exist_ok=True)
+        with open(os.path.join(root, "info", "L3", "cbm_mask"), "w") as f:
+            f.write(format((1 << ways) - 1, "x"))
+        sch = resctrl.Schemata(
+            l3={d: (1 << ways) - 1 for d in domains}, mb={d: 100 for d in domains}
+        )
+        with open(os.path.join(root, "schemata"), "w") as f:
+            f.write(sch.render())
+        return resctrl.ResctrlFS(cfg)
+
+    def test_schemata_roundtrip(self, cfg):
+        fs = self.make_fs(cfg)
+        assert fs.available()
+        assert fs.num_cache_ways() == 20
+        assert fs.cache_domains() == [0, 1]
+
+    def test_percent_to_mask(self):
+        assert resctrl.percent_to_way_mask(100, 20) == (1 << 20) - 1
+        assert resctrl.percent_to_way_mask(50, 20) == (1 << 10) - 1
+        assert resctrl.percent_to_way_mask(0, 20) == 1  # at least one way
+        assert resctrl.percent_to_way_mask(30, 10) == 0b111
+
+    def test_apply_qos_policy(self, cfg):
+        fs = self.make_fs(cfg)
+        fs.apply_qos_policy(resctrl.GROUP_BE, l3_percent=30, mb_percent=40)
+        sch = fs.read_schemata(resctrl.GROUP_BE)
+        assert sch.l3 == {0: 0b111111, 1: 0b111111}  # ceil(20*0.3)=6 ways
+        assert sch.mb == {0: 40, 1: 40}
+
+    def test_tasks(self, cfg):
+        fs = self.make_fs(cfg)
+        assert fs.add_tasks(resctrl.GROUP_LS, [101, 102]) == []
+        assert fs.read_tasks(resctrl.GROUP_LS) == [101, 102]
+
+
+class TestCoreSched:
+    def test_fake_group_assignment(self):
+        cs = coresched.FakeCoreSched()
+        assert cs.supported()
+        failed = cs.assign_group(100, [101, 102])
+        assert failed == []
+        assert cs.get(101) == cs.get(100) != 0
+        assert cs.get(102) == cs.get(100)
+
+
+class TestProcfs:
+    def test_cpu_list_roundtrip(self):
+        assert procfs.parse_cpu_list("0-3,8,10-11") == [0, 1, 2, 3, 8, 10, 11]
+        assert procfs.format_cpu_list([0, 1, 2, 3, 8, 10, 11]) == "0-3,8,10-11"
+        assert procfs.parse_cpu_list("") == []
+        assert procfs.format_cpu_list([5]) == "5"
+
+    def test_proc_stat(self, cfg):
+        os.makedirs(cfg.proc_root, exist_ok=True)
+        with open(cfg.proc_path("stat"), "w") as f:
+            f.write("cpu  100 0 50 800 20 5 5 0 0 0\ncpu0 50 0 25 400 10 2 2 0 0 0\n")
+        stat = procfs.read_cpu_stat(cfg)
+        assert stat.used_jiffies == 100 + 50 + 5 + 5
+        assert stat.total_jiffies == 160 + 800 + 20
+
+    def test_meminfo(self, cfg):
+        os.makedirs(cfg.proc_root, exist_ok=True)
+        with open(cfg.proc_path("meminfo"), "w") as f:
+            f.write("MemTotal: 1000 kB\nMemFree: 300 kB\nMemAvailable: 600 kB\n"
+                    "Cached: 200 kB\n")
+        mem = procfs.read_meminfo(cfg)
+        assert mem.total == 1000 * 1024
+        assert mem.used_no_cache == 400 * 1024
+
+    def test_idle_page_stats(self):
+        content = (
+            "# version: 1.0\n"
+            "csei 0 0 4096 8192\n"
+            "dsei 0 0 0 1024\n"
+            "scan_period_in_seconds 120\n"
+        )
+        stats = procfs.parse_idle_page_stats(content)
+        assert stats["csei"] == 4096 + 8192
+        assert stats["cold"] == 8192 + 1024
+
+
+class TestResourceExecutor:
+    def test_cache_suppresses_redundant_writes(self, cfg, tmp_path):
+        write_cgroup_file(cfg, cg.CPU_CFS_QUOTA, "kubepods/pod1", "-1")
+        auditor = Auditor(str(tmp_path / "audit"))
+        ex = rex.ResourceUpdateExecutor(cfg, auditor)
+        up = rex.ResourceUpdate(cg.CPU_CFS_QUOTA, "kubepods/pod1", "20000")
+        assert ex.update(up).updated
+        assert not ex.update(up).updated  # suppressed
+        events = auditor.query(group="cgroup")
+        assert len(events) == 1
+        assert events[0]["value"] == "20000"
+
+    def test_cache_miss_reads_kernel_value(self, cfg):
+        write_cgroup_file(cfg, cg.CPU_SHARES, "kubepods", "1024")
+        ex = rex.ResourceUpdateExecutor(cfg)
+        up = rex.ResourceUpdate(cg.CPU_SHARES, "kubepods", "1024")
+        assert not ex.update(up).updated  # kernel already has it
+
+    def test_leveled_ordering(self, cfg):
+        for rel in ("kubepods", "kubepods/pod1"):
+            write_cgroup_file(cfg, cg.MEMORY_LIMIT, rel, "1000")
+        ex = rex.ResourceUpdateExecutor(cfg)
+        order: list[str] = []
+        orig = ex.update
+
+        def tracking_update(u):
+            order.append(u.rel_dir)
+            return orig(u)
+
+        ex.update = tracking_update
+        # increase: parent first even though child listed first
+        ex.leveled_update_batch([
+            rex.ResourceUpdate(cg.MEMORY_LIMIT, "kubepods/pod1", "2000"),
+            rex.ResourceUpdate(cg.MEMORY_LIMIT, "kubepods", "3000"),
+        ])
+        assert order == ["kubepods", "kubepods/pod1"]
+        order.clear()
+        # decrease: child first
+        ex.leveled_update_batch([
+            rex.ResourceUpdate(cg.MEMORY_LIMIT, "kubepods", "500"),
+            rex.ResourceUpdate(cg.MEMORY_LIMIT, "kubepods/pod1", "400"),
+        ])
+        assert order == ["kubepods/pod1", "kubepods"]
+
+    def test_invalid_value_audited_not_raised(self, cfg, tmp_path):
+        write_cgroup_file(cfg, cg.MEMORY_WMARK_RATIO, "kubepods", "0")
+        auditor = Auditor(str(tmp_path / "audit"))
+        ex = rex.ResourceUpdateExecutor(cfg, auditor)
+        res = ex.update(rex.ResourceUpdate(cg.MEMORY_WMARK_RATIO, "kubepods", "400"))
+        assert not res.updated and res.error
+        assert auditor.query()[0]["operation"] == "update-failed"
+
+
+class TestAuditor:
+    def test_rotation_and_query(self, tmp_path):
+        auditor = Auditor(str(tmp_path), max_file_bytes=2048, max_files=3)
+        for i in range(50):
+            auditor.log("cgroup", "update", f"dir{i}", {"value": str(i)})
+        events = auditor.query(limit=10)
+        assert len(events) == 10
+        assert events[0]["target"] == "dir49"  # newest first
+        files = os.listdir(tmp_path)
+        assert len(files) <= 3
