@@ -1,0 +1,102 @@
+"""Feature gates (reference: ``pkg/features/`` — k8s component-base
+featuregate wrapper; per-module gates in koordlet/runtimehooks).
+
+One process-global :class:`FeatureGates` registry with per-gate defaults;
+``--feature-gates=Name=true,...``-style overrides via :meth:`set_from_spec`.
+Gate names mirror the reference inventory (SURVEY.md §2.10).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class FeatureGates:
+    def __init__(self, defaults: dict[str, bool]):
+        self._defaults = dict(defaults)
+        self._overrides: dict[str, bool] = {}
+        self._lock = threading.Lock()
+
+    def enabled(self, name: str) -> bool:
+        with self._lock:
+            if name in self._overrides:
+                return self._overrides[name]
+            if name not in self._defaults:
+                raise KeyError(f"unknown feature gate {name!r}")
+            return self._defaults[name]
+
+    def set(self, name: str, value: bool) -> None:
+        with self._lock:
+            if name not in self._defaults:
+                raise KeyError(f"unknown feature gate {name!r}")
+            self._overrides[name] = value
+
+    def set_from_spec(self, spec: str) -> None:
+        """Parse 'A=true,B=false' (the --feature-gates flag format)."""
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, value = part.partition("=")
+            self.set(name.strip(), value.strip().lower() in ("true", "1", "yes"))
+
+    def known(self) -> dict[str, bool]:
+        with self._lock:
+            out = dict(self._defaults)
+            out.update(self._overrides)
+            return out
+
+
+# koordlet gates (pkg/features/koordlet_features.go)
+KOORDLET_GATES = FeatureGates({
+    "AuditEvents": True,
+    "AuditEventsHTTPHandler": False,
+    "BECPUSuppress": True,
+    "BECPUManager": False,
+    "BECPUEvict": False,
+    "BEMemoryEvict": False,
+    "CPUEvict": False,
+    "MemoryEvict": False,
+    "CPUBurst": True,
+    "SystemConfig": False,
+    "RdtResctrl": True,
+    "CgroupReconcile": False,
+    "NodeTopologyReport": True,
+    "Accelerators": False,
+    "RDMADevices": False,
+    "CPICollector": False,
+    "PSICollector": True,
+    "BlkIOReconcile": False,
+    "ColdPageCollector": False,
+    "HugePageReport": False,
+    "PodResourcesProxy": False,
+    "PerCPUMetric": False,
+})
+
+# runtimehooks gates (pkg/koordlet/runtimehooks/config.go)
+RUNTIMEHOOK_GATES = FeatureGates({
+    "GroupIdentity": True,
+    "CPUSetAllocator": True,
+    "GPUEnvInject": False,
+    "RDMADeviceInject": False,
+    "BatchResource": True,
+    "CoreSched": False,
+    "CPUNormalization": False,
+    "Resctrl": False,
+    "TCNetworkQoS": False,
+    "TerwayQoS": False,
+})
+
+# manager/scheduler gates (pkg/features/features.go, scheduler_features.go)
+SCHEDULER_GATES = FeatureGates({
+    "MultiQuotaTree": False,
+    "ElasticQuotaGuaranteeUsage": False,
+    "ResizePod": False,
+    "LazyReservationRestore": False,
+    "DevicePluginAdaption": False,
+    "CrossSchedulerNomination": False,
+    "SyncBarrier": True,
+    "GangPendingPodsConditionPatch": False,
+    "ColocationProfileSkipMutatingHandler": False,
+    "WebhookFramework": True,
+})

@@ -1,0 +1,108 @@
+"""The koordlet daemon assembly (reference: ``pkg/koordlet/koordlet.go:60``
+``Daemon``, ``:76 NewDaemon``, ``:146 Run``).
+
+Wires the modules into one agent: states informer + metric cache feed the
+metrics advisor; the QoS manager and runtime-hook reconciler act through the
+shared resource executor; the PLEG nudges reconciliation on pod churn.
+``tick`` advances everything one step (tests and the run loop share it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.metricsadvisor import MetricsAdvisor
+from koordinator_tpu.koordlet.pleg import PLEG
+from koordinator_tpu.koordlet.qosmanager.cpuburst import CPUBurst
+from koordinator_tpu.koordlet.qosmanager.cpusuppress import CPUSuppress
+from koordinator_tpu.koordlet.qosmanager.evict import CPUEvict, MemoryEvict
+from koordinator_tpu.koordlet.qosmanager.framework import (
+    Evictor, QOSManager, StrategyContext,
+)
+from koordinator_tpu.koordlet.qosmanager.reconcile import (
+    BlkIOQOS, CgroupReconcile, ResctrlQOS, SysReconcile,
+)
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.runtimehooks.hooks import HookRegistry
+from koordinator_tpu.koordlet.runtimehooks.plugins import register_default_hooks
+from koordinator_tpu.koordlet.runtimehooks.reconciler import Reconciler
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.koordlet.system.config import SystemConfig, get_config
+
+
+class Daemon:
+    def __init__(
+        self,
+        cfg: Optional[SystemConfig] = None,
+        audit_dir: Optional[str] = None,
+        clock=time.time,
+        kill_handler: Optional[Callable] = None,
+    ):
+        self.cfg = cfg or get_config()
+        self.clock = clock
+        self.auditor = Auditor(audit_dir) if audit_dir else None
+        self.metric_cache = mc.MetricCache(clock=clock)
+        self.states = StatesInformer(metric_cache=self.metric_cache, clock=clock)
+        self.executor = ResourceUpdateExecutor(self.cfg, self.auditor)
+        self.advisor = MetricsAdvisor(
+            self.states, self.metric_cache, self.cfg, clock
+        )
+        ctx = StrategyContext(
+            self.states, self.metric_cache, self.executor, self.cfg,
+            auditor=self.auditor, clock=clock,
+        )
+        self.strategy_ctx = ctx
+        self.evictor = Evictor(ctx, kill_handler)
+        suppress = CPUSuppress(ctx)
+        self.qos_manager = QOSManager(ctx, [
+            suppress,
+            CPUEvict(ctx, self.evictor, suppress.be_real_limit_milli),
+            MemoryEvict(ctx, self.evictor),
+            CPUBurst(ctx),
+            CgroupReconcile(ctx),
+            ResctrlQOS(ctx),
+            BlkIOQOS(ctx),
+            SysReconcile(ctx),
+        ])
+        self.hook_registry = HookRegistry()
+        self.hooks = register_default_hooks(
+            self.hook_registry,
+            node_slo=ctx.node_slo,
+        )
+        self.hook_reconciler = Reconciler(
+            self.states, self.hook_registry, self.executor, self.cfg
+        )
+        self.pleg = PLEG(self.cfg)
+        self.pleg.add_handler(lambda event: self._on_pleg_event(event))
+        self._pleg_dirty = False
+        self._stop = threading.Event()
+
+    def _on_pleg_event(self, event) -> None:
+        self._pleg_dirty = True
+
+    def tick(self) -> dict:
+        """One agent step: collect -> enforce -> reconcile-on-churn."""
+        collected = self.advisor.collect_once()
+        strategies = self.qos_manager.tick()
+        self.pleg.poll()
+        writes = 0
+        if self._pleg_dirty:
+            writes = self.hook_reconciler.reconcile_once()
+            self._pleg_dirty = False
+        return {
+            "collected": collected,
+            "strategies": strategies,
+            "hook_writes": writes,
+        }
+
+    def run(self, interval_seconds: float = 1.0) -> None:  # pragma: no cover
+        while not self._stop.is_set():
+            self.tick()
+            self._stop.wait(interval_seconds)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -259,7 +259,11 @@ class PSICollector:
         self.d = deps
 
     def enabled(self) -> bool:
-        return os.path.exists(cg.resource_path(cg.CPU_PRESSURE, "", self.d.cfg))
+        from koordinator_tpu.features import KOORDLET_GATES
+
+        return KOORDLET_GATES.enabled("PSICollector") and os.path.exists(
+            cg.resource_path(cg.CPU_PRESSURE, "", self.d.cfg)
+        )
 
     def collect(self) -> None:
         now = self.d.clock()
@@ -278,7 +282,11 @@ class ColdMemoryCollector:
         self.d = deps
 
     def enabled(self) -> bool:
-        return procfs.kidled_supported(self.d.cfg)
+        from koordinator_tpu.features import KOORDLET_GATES
+
+        return KOORDLET_GATES.enabled("ColdPageCollector") and procfs.kidled_supported(
+            self.d.cfg
+        )
 
     def collect(self) -> None:
         now = self.d.clock()

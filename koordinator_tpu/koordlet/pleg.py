@@ -1,0 +1,93 @@
+"""Pod lifecycle event generator (reference: ``pkg/koordlet/pleg/pleg.go:81``
+— inotify watches on the per-QoS cgroup dirs; a pod dir appearing/vanishing
+IS the lifecycle signal, independent of the apiserver).
+
+The kernel-portable rebuild scans the three kube-QoS cgroup trees per tick
+and diffs against the previous scan (inotify is an optimization the fake-fs
+test layer can't exercise; the scan path is the behavior contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Callable
+
+from koordinator_tpu.koordlet.system.config import SystemConfig
+
+#: cgroupfs 'pod<uid>' and systemd 'kubepods[-tier]-pod<uid>.slice' layouts
+POD_DIR_RE = re.compile(r"(?:kubepods(?:-[a-z]+)?-)?pod([0-9a-zA-Z_-]+?)(?:\.slice)?")
+
+
+def _normalize_uid(raw: str) -> str:
+    """systemd escapes '-' as '_' in pod slice names; undo it."""
+    return raw.replace("_", "-")
+
+EVENT_POD_ADDED = "PodAdded"
+EVENT_POD_DELETED = "PodDeleted"
+EVENT_CONTAINER_ADDED = "ContainerAdded"
+EVENT_CONTAINER_DELETED = "ContainerDeleted"
+
+
+@dataclasses.dataclass(frozen=True)
+class PodLifecycleEvent:
+    type: str
+    pod_uid: str
+    container_id: str = ""
+
+
+class PLEG:
+    def __init__(self, cfg: SystemConfig, subsystem: str = "cpu"):
+        self.cfg = cfg
+        self.subsystem = subsystem
+        self._known: dict[str, set[str]] = {}  # pod uid -> container ids
+        self._handlers: list[Callable[[PodLifecycleEvent], None]] = []
+
+    def add_handler(self, fn: Callable[[PodLifecycleEvent], None]) -> None:
+        self._handlers.append(fn)
+
+    def _scan(self) -> dict[str, set[str]]:
+        found: dict[str, set[str]] = {}
+        for qos in ("guaranteed", "burstable", "besteffort"):
+            base = self.cfg.cgroup_abs_path(
+                self.subsystem, self.cfg.kube_qos_dir(qos)
+            )
+            try:
+                entries = os.listdir(base)
+            except OSError:
+                continue
+            for entry in entries:
+                m = POD_DIR_RE.fullmatch(entry)
+                if not m or not os.path.isdir(os.path.join(base, entry)):
+                    continue
+                uid = _normalize_uid(m.group(1))
+                containers = {
+                    c for c in os.listdir(os.path.join(base, entry))
+                    if os.path.isdir(os.path.join(base, entry, c))
+                }
+                found[uid] = containers
+        return found
+
+    def poll(self) -> list[PodLifecycleEvent]:
+        """Diff the cgroup tree against the last poll; fire + return events."""
+        current = self._scan()
+        events: list[PodLifecycleEvent] = []
+        for uid, containers in current.items():
+            if uid not in self._known:
+                events.append(PodLifecycleEvent(EVENT_POD_ADDED, uid))
+                for cid in sorted(containers):
+                    events.append(PodLifecycleEvent(EVENT_CONTAINER_ADDED, uid, cid))
+            else:
+                prev = self._known[uid]
+                for cid in sorted(containers - prev):
+                    events.append(PodLifecycleEvent(EVENT_CONTAINER_ADDED, uid, cid))
+                for cid in sorted(prev - containers):
+                    events.append(PodLifecycleEvent(EVENT_CONTAINER_DELETED, uid, cid))
+        for uid in self._known.keys() - current.keys():
+            events.append(PodLifecycleEvent(EVENT_POD_DELETED, uid))
+        self._known = current
+        for event in events:
+            for fn in self._handlers:
+                fn(event)
+        return events

@@ -1,0 +1,12 @@
+"""QoS manager: the periodic strategy loops ("slo-agent") that enforce
+node-side QoS (reference: ``pkg/koordlet/qosmanager/`` — plugin registry
+``plugins/register.go:32-40``).
+
+Plugins: cpusuppress, cpuevict, memoryevict, cpuburst, cgreconcile, blkio,
+resctrl, sysreconcile — each a :class:`~.framework.QOSStrategy` driven by the
+manager's tick.
+"""
+
+from koordinator_tpu.koordlet.qosmanager.framework import (
+    Evictor, QOSManager, QOSStrategy, StrategyContext,
+)

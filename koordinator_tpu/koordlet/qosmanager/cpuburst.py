@@ -1,0 +1,129 @@
+"""CPU burst (reference: ``qosmanager/plugins/cpuburst/cpu_burst.go``).
+
+Two mechanisms per the CPUBurstStrategy policy:
+
+- **cpu.cfs_burst_us** (kernel CFS burst): burstable slack =
+  ``limit * cpu_burst_percent% * period``; lets a container briefly exceed
+  quota using banked idle time.
+- **cfs quota burst**: when a container is being throttled and the node share
+  pool is calm (usage below ``share_pool_threshold_percent``), scale its cfs
+  quota up (x1.2 per tick, capped at ``limit * cfs_quota_burst_percent%``);
+  scale back toward the base quota once the node heats up or the burst period
+  expires.
+
+Policies: none | cpuBurstOnly | cfsQuotaBurstOnly | auto (both).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.qosmanager.framework import StrategyContext
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdate
+from koordinator_tpu.koordlet.statesinformer import PodMeta
+from koordinator_tpu.koordlet.system import cgroup as cg
+
+CFS_PERIOD_US = 100_000
+QUOTA_SCALE_UP_RATIO = 1.2
+
+
+@dataclasses.dataclass
+class _BurstState:
+    base_quota_us: int
+    current_quota_us: int
+    burst_since: Optional[float] = None
+
+
+class CPUBurst:
+    name = "cpuburst"
+    interval_seconds = 1.0
+    feature_gate = "CPUBurst"
+
+    def __init__(self, ctx: StrategyContext):
+        self.ctx = ctx
+        self._state: dict[str, _BurstState] = {}
+
+    def enabled(self) -> bool:
+        return self.ctx.node_slo().cpu_burst_strategy.policy != "none"
+
+    def _pod_cpu_limit_milli(self, pod: PodMeta) -> int:
+        return int(pod.limits.get("cpu", 0))
+
+    def _node_calm(self, threshold_pct: int) -> bool:
+        capacity = self.ctx.node_cpu_capacity_milli()
+        if capacity <= 0:
+            return False
+        now = self.ctx.clock()
+        used = int(
+            self.ctx.cache.query(mc.NODE_CPU_USAGE, None, now - 60, now).latest()
+            * 1000
+        )
+        return used * 100 // capacity < threshold_pct
+
+    def update(self) -> None:
+        strategy = self.ctx.node_slo().cpu_burst_strategy
+        do_burst = strategy.policy in ("cpuBurstOnly", "auto")
+        do_quota = strategy.policy in ("cfsQuotaBurstOnly", "auto")
+        now = self.ctx.clock()
+        calm = self._node_calm(strategy.share_pool_threshold_percent)
+        live: set[str] = set()
+
+        for pod in self.ctx.states.get_all_pods():
+            if pod.qos_class.is_best_effort or not pod.is_running:
+                continue  # burst is for LS/LSR pods with CPU limits
+            limit_milli = self._pod_cpu_limit_milli(pod)
+            if limit_milli <= 0:
+                continue
+            live.add(pod.uid)
+            rel = pod.cgroup_dir(self.ctx.cfg)
+            if do_burst:
+                burst_us = (
+                    limit_milli * strategy.cpu_burst_percent // 100
+                    * CFS_PERIOD_US // 1000
+                )
+                self.ctx.executor.update(
+                    ResourceUpdate(cg.CPU_CFS_BURST, rel, str(burst_us))
+                )
+            if do_quota:
+                self._reconcile_quota(pod, rel, limit_milli, strategy, calm, now)
+
+        for uid in [u for u in self._state if u not in live]:
+            del self._state[uid]
+
+    def _reconcile_quota(self, pod: PodMeta, rel: str, limit_milli: int,
+                         strategy, calm: bool, now: float) -> None:
+        base_quota = limit_milli * CFS_PERIOD_US // 1000
+        max_quota = base_quota * strategy.cfs_quota_burst_percent // 100
+        state = self._state.get(pod.uid)
+        if state is None:
+            state = self._state[pod.uid] = _BurstState(base_quota, base_quota)
+
+        throttled = self.ctx.cache.query(
+            mc.CONTAINER_CPU_THROTTLED, {"pod_uid": pod.uid}, now - 60, now
+        ).latest()
+
+        expired = (
+            strategy.cfs_quota_burst_period_seconds >= 0
+            and state.burst_since is not None
+            and now - state.burst_since > strategy.cfs_quota_burst_period_seconds
+        )
+        if throttled > 0 and calm and not expired:
+            new_quota = min(int(state.current_quota_us * QUOTA_SCALE_UP_RATIO),
+                            max_quota)
+            if state.burst_since is None:
+                state.burst_since = now
+        elif not calm or expired:
+            # scale back down toward base once the node heats up
+            new_quota = max(int(state.current_quota_us / QUOTA_SCALE_UP_RATIO),
+                            base_quota)
+            if new_quota == base_quota:
+                state.burst_since = None
+        else:
+            new_quota = state.current_quota_us
+        if new_quota != state.current_quota_us:
+            state.current_quota_us = new_quota
+            self.ctx.executor.update(
+                ResourceUpdate(cg.CPU_CFS_QUOTA, rel, str(new_quota))
+            )

@@ -1,0 +1,146 @@
+"""BE CPU suppression (reference: ``qosmanager/plugins/cpusuppress/
+cpu_suppress.go`` — ``calculateBESuppressCPU`` :136, ``suppressBECPU`` :246).
+
+Every tick, the CPU room left for BestEffort is::
+
+    be_allowable = capacity * suppress_threshold% - (node_used - be_used)
+
+(everything in milli-cores; ``node_used - be_used`` is the LS+system share).
+The result is applied either as a **cpuset** (shrink the number of CPUs the
+BE tier may run on, NUMA-spread, avoiding LSR/LSE exclusive CPUs) or as a
+**cfs quota** on the besteffort tier cgroup. Growth back up is rate-limited
+(``max_increase_pct`` per tick) so a quiet moment doesn't instantly hand all
+CPUs back — matching the reference's chattiness guard.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.qosmanager.framework import StrategyContext
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdate
+from koordinator_tpu.koordlet.system import cgroup as cg
+from koordinator_tpu.koordlet.system import procfs
+
+#: the BE tier always keeps at least this many CPUs runnable
+BE_MIN_CPUS = 2
+CFS_PERIOD_US = 100_000
+
+
+def calculate_be_suppress_milli(
+    capacity_milli: int,
+    node_used_milli: int,
+    be_used_milli: int,
+    threshold_pct: int,
+    max_increase_pct: int = 5,
+    prev_allowable_milli: Optional[int] = None,
+) -> int:
+    """The suppress formula with rate-limited growth, all int milli-cores."""
+    allowable = capacity_milli * threshold_pct // 100 - (
+        node_used_milli - be_used_milli
+    )
+    allowable = max(allowable, BE_MIN_CPUS * 1000)
+    allowable = min(allowable, capacity_milli)
+    if prev_allowable_milli is not None and allowable > prev_allowable_milli:
+        step = capacity_milli * max_increase_pct // 100
+        allowable = min(allowable, prev_allowable_milli + max(step, 1000))
+    return allowable
+
+
+def select_be_cpuset(
+    topology: procfs.CPUTopology,
+    n_cpus: int,
+    exclusive_cpus: frozenset[int] = frozenset(),
+) -> list[int]:
+    """Pick which CPUs the BE tier runs on: spread across NUMA nodes
+    round-robin (suppress policy keeps BE pressure even), skipping
+    LSR/LSE-exclusive CPUs unless nothing else is left."""
+    nodes = topology.numa_nodes()
+    per_node = {
+        n: [c for c in topology.cpus_in_node(n) if c not in exclusive_cpus]
+        for n in nodes
+    }
+    picked: list[int] = []
+    while len(picked) < n_cpus and any(per_node.values()):
+        for n in nodes:
+            if per_node[n] and len(picked) < n_cpus:
+                picked.append(per_node[n].pop(0))
+    if len(picked) < n_cpus:  # fall back onto exclusive CPUs if we must
+        rest = [c.cpu for c in topology.cpus if c.cpu not in picked]
+        picked.extend(rest[: n_cpus - len(picked)])
+    return sorted(picked)
+
+
+class CPUSuppress:
+    name = "cpusuppress"
+    interval_seconds = 1.0
+    feature_gate = "BECPUSuppress"
+
+    def __init__(self, ctx: StrategyContext,
+                 topology: Optional[procfs.CPUTopology] = None,
+                 exclusive_cpus: frozenset[int] = frozenset()):
+        self.ctx = ctx
+        self._topology = topology
+        self.exclusive_cpus = exclusive_cpus
+        self._prev_allowable: Optional[int] = None
+
+    def enabled(self) -> bool:
+        return self.ctx.node_slo().resource_used_threshold_with_be.enable
+
+    @property
+    def topology(self) -> procfs.CPUTopology:
+        if self._topology is None:
+            self._topology = procfs.read_cpu_topology(self.ctx.cfg)
+        return self._topology
+
+    def _usages_milli(self) -> tuple[int, int]:
+        now = self.ctx.clock()
+        node = self.ctx.cache.query(mc.NODE_CPU_USAGE, None, now - 60, now)
+        be = self.ctx.cache.query(mc.BE_CPU_USAGE, None, now - 60, now)
+        return int(node.latest() * 1000), int(be.latest() * 1000)
+
+    def update(self) -> None:
+        strategy = self.ctx.node_slo().resource_used_threshold_with_be
+        capacity = self.ctx.node_cpu_capacity_milli()
+        if capacity <= 0:
+            return
+        node_used, be_used = self._usages_milli()
+        allowable = calculate_be_suppress_milli(
+            capacity, node_used, be_used,
+            strategy.cpu_suppress_threshold_percent,
+            prev_allowable_milli=self._prev_allowable,
+        )
+        self._prev_allowable = allowable
+        be_dir = self.ctx.cfg.kube_qos_dir("besteffort")
+        if strategy.cpu_suppress_policy == "cfsQuota":
+            quota = allowable * CFS_PERIOD_US // 1000
+            self.ctx.executor.update(
+                ResourceUpdate(cg.CPU_CFS_QUOTA, be_dir, str(quota))
+            )
+        else:  # cpuset policy
+            n_cpus = max(BE_MIN_CPUS, math.ceil(allowable / 1000))
+            n_cpus = min(n_cpus, self.topology.num_cpus)
+            cpus = select_be_cpuset(self.topology, n_cpus, self.exclusive_cpus)
+            value = procfs.format_cpu_list(cpus)
+            # BE tier dir + every BE pod AND container dir (the kernel
+            # rejects a pod-level shrink while container cpusets still hold
+            # the wider set; leveled batch orders depth per direction).
+            updates = [ResourceUpdate(cg.CPUSET_CPUS, be_dir, value)]
+            for pod in self.ctx.be_pods():
+                pod_dir = pod.cgroup_dir(self.ctx.cfg)
+                updates.append(ResourceUpdate(cg.CPUSET_CPUS, pod_dir, value))
+                for container in pod.containers:
+                    crel = container.cgroup_dir or self.ctx.cfg.container_cgroup_dir(
+                        pod.kube_qos, pod.uid, container.container_id
+                    )
+                    updates.append(ResourceUpdate(cg.CPUSET_CPUS, crel, value))
+            self.ctx.executor.leveled_update_batch(updates)
+        self.current_allowable_milli = allowable
+
+    def be_real_limit_milli(self) -> int:
+        """What BE may actually use right now (for cpuevict satisfaction)."""
+        if self._prev_allowable is not None:
+            return self._prev_allowable
+        return self.ctx.node_cpu_capacity_milli()

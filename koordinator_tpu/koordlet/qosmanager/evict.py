@@ -1,0 +1,128 @@
+"""BE eviction strategies (reference: ``qosmanager/plugins/cpuevict/`` and
+``memoryevict/``).
+
+- :class:`CPUEvict`: when BE *satisfaction* (real limit / request) stays under
+  the lower bound for a full window AND BE is actually CPU-hungry
+  (usage/limit above the usage threshold), evict BE pods — lowest priority,
+  biggest consumer first — until enough request is released to bring
+  satisfaction back to the upper bound.
+- :class:`MemoryEvict`: when node memory utilization crosses the threshold,
+  evict BE pods until projected utilization reaches the lower target
+  (default threshold - 2, matching the reference's fallback).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.qosmanager.framework import Evictor, StrategyContext
+
+CPU_EVICT_USAGE_THRESHOLD_PCT = 90
+
+
+class CPUEvict:
+    name = "cpuevict"
+    interval_seconds = 1.0
+    feature_gate = "BECPUEvict"
+
+    def __init__(self, ctx: StrategyContext, evictor: Evictor,
+                 be_real_limit_milli: Callable[[], int]):
+        self.ctx = ctx
+        self.evictor = evictor
+        self.be_real_limit_milli = be_real_limit_milli
+        self._low_since: Optional[float] = None
+
+    def enabled(self) -> bool:
+        s = self.ctx.node_slo().resource_used_threshold_with_be
+        return s.enable and s.cpu_evict_be_satisfaction_lower_percent > 0
+
+    def _be_request_milli(self) -> int:
+        return sum(
+            int(p.requests.get("kubernetes.io/batch-cpu", p.requests.get("cpu", 0)))
+            for p in self.ctx.be_pods()
+        )
+
+    def update(self) -> None:
+        s = self.ctx.node_slo().resource_used_threshold_with_be
+        now = self.ctx.clock()
+        be_request = self._be_request_milli()
+        if be_request <= 0:
+            self._low_since = None
+            return
+        real_limit = self.be_real_limit_milli()
+        satisfaction_pct = real_limit * 100 // be_request
+        be_usage = int(
+            self.ctx.cache.query(mc.BE_CPU_USAGE, None, now - 60, now).latest() * 1000
+        )
+        hungry = real_limit > 0 and be_usage * 100 // real_limit >= (
+            s.cpu_evict_be_usage_threshold_percent or CPU_EVICT_USAGE_THRESHOLD_PCT
+        )
+        if satisfaction_pct >= s.cpu_evict_be_satisfaction_lower_percent or not hungry:
+            self._low_since = None
+            return
+        if self._low_since is None:
+            self._low_since = now
+            return
+        if now - self._low_since < s.cpu_evict_time_window_seconds:
+            return
+        # Release enough request to reach the upper satisfaction bound:
+        # (real_limit / (be_request - released)) >= upper%
+        upper = max(
+            s.cpu_evict_be_satisfaction_upper_percent,
+            s.cpu_evict_be_satisfaction_lower_percent,
+        )
+        target_request = real_limit * 100 // max(upper, 1)
+        to_release = be_request - target_request
+        released = 0
+        for pod in self.ctx.be_pods(sort_for_eviction=True):
+            if released >= to_release:
+                break
+            req = int(
+                pod.requests.get("kubernetes.io/batch-cpu", pod.requests.get("cpu", 0))
+            )
+            if self.evictor.evict(pod, "evictPodCPUPressure"):
+                released += req
+        self._low_since = None
+
+
+class MemoryEvict:
+    name = "memoryevict"
+    interval_seconds = 1.0
+    feature_gate = "BEMemoryEvict"
+
+    def __init__(self, ctx: StrategyContext, evictor: Evictor):
+        self.ctx = ctx
+        self.evictor = evictor
+
+    def enabled(self) -> bool:
+        s = self.ctx.node_slo().resource_used_threshold_with_be
+        return s.enable and s.memory_evict_threshold_percent > 0
+
+    def update(self) -> None:
+        s = self.ctx.node_slo().resource_used_threshold_with_be
+        capacity = self.ctx.node_memory_capacity()
+        if capacity <= 0:
+            return
+        now = self.ctx.clock()
+        node_used = int(
+            self.ctx.cache.query(mc.NODE_MEMORY_USAGE, None, now - 60, now).latest()
+        )
+        usage_pct = node_used * 100 // capacity
+        if usage_pct < s.memory_evict_threshold_percent:
+            return
+        lower_pct = s.memory_evict_lower_percent or max(
+            s.memory_evict_threshold_percent - 2, 0
+        )
+        to_release = node_used - capacity * lower_pct // 100
+        released = 0
+        for pod in self.ctx.be_pods(sort_for_eviction=True):
+            if released >= to_release:
+                break
+            pod_mem = int(
+                self.ctx.cache.query(
+                    mc.POD_MEMORY_USAGE, {"pod_uid": pod.uid}, now - 60, now
+                ).latest()
+            )
+            if self.evictor.evict(pod, "evictPodMemoryPressure"):
+                released += pod_mem

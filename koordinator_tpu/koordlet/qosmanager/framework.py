@@ -1,0 +1,163 @@
+"""QoS strategy framework (reference: ``qosmanager/framework/strategy.go:21``
+QOSStrategy interface, ``helpers/`` Evictor).
+
+A :class:`QOSStrategy` exposes ``enabled()`` + ``update()``; the
+:class:`QOSManager` ticks every enabled strategy at its own interval.
+:class:`Evictor` centralizes BE pod eviction with an injected kill handler
+(the reference POSTs an eviction to the apiserver; the bridge provides that)
+and audit logging.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Protocol
+
+from koordinator_tpu.features import KOORDLET_GATES
+
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.statesinformer import PodMeta, StatesInformer
+from koordinator_tpu.koordlet.system.config import SystemConfig, get_config
+
+
+class StrategyContext:
+    """Shared dependencies handed to every strategy."""
+
+    def __init__(
+        self,
+        states: StatesInformer,
+        cache: mc.MetricCache,
+        executor: ResourceUpdateExecutor,
+        cfg: Optional[SystemConfig] = None,
+        auditor: Optional[Auditor] = None,
+        clock=time.time,
+    ):
+        self.states = states
+        self.cache = cache
+        self.executor = executor
+        self.cfg = cfg or get_config()
+        self.auditor = auditor
+        self.clock = clock
+
+    def node_slo(self):
+        """Current NodeSLO (api.crds.NodeSLO) or defaults."""
+        from koordinator_tpu.api.crds import NodeSLO
+
+        return self.states.get_node_slo() or NodeSLO()
+
+    def node_cpu_capacity_milli(self) -> int:
+        node = self.states.get_node()
+        if node is None:
+            return 0
+        return int(node.allocatable.get("cpu", 0))
+
+    def node_memory_capacity(self) -> int:
+        node = self.states.get_node()
+        if node is None:
+            return 0
+        return int(node.allocatable.get("memory", 0))
+
+    def be_pods(self, sort_for_eviction: bool = False) -> list[PodMeta]:
+        """Running BE pods; eviction order = (priority asc, usage desc) —
+        the reference's sorter picks lowest priority, then biggest consumer."""
+        pods = [
+            p for p in self.states.get_all_pods()
+            if p.qos_class.is_best_effort and p.is_running
+        ]
+        if sort_for_eviction:
+            now = self.clock()
+
+            def usage(p: PodMeta) -> float:
+                return self.cache.query(
+                    mc.POD_CPU_USAGE, {"pod_uid": p.uid}, now - 60, now
+                ).latest()
+
+            pods.sort(key=lambda p: (p.priority, -usage(p)))
+        return pods
+
+
+class QOSStrategy(Protocol):
+    name: str
+    interval_seconds: float
+    #: KOORDLET_GATES gate controlling the strategy ("" = ungated)
+    feature_gate: str
+
+    def enabled(self) -> bool: ...
+
+    def update(self) -> None: ...
+
+
+class Evictor:
+    """BE pod eviction helper (qosmanager/helpers/evictor).
+
+    An eviction is asynchronous — the pod stays in the informer state until
+    the control plane deletes it — so a cooldown suppresses re-evicting the
+    same pod every tick while the first eviction is in flight.
+    """
+
+    def __init__(self, ctx: StrategyContext,
+                 kill_handler: Optional[Callable[[PodMeta, str], bool]] = None,
+                 cooldown_seconds: float = 300.0):
+        self.ctx = ctx
+        self.kill_handler = kill_handler
+        self.cooldown_seconds = cooldown_seconds
+        self.evicted: list[tuple[str, str]] = []  # (pod uid, reason)
+        self._in_flight: dict[str, float] = {}    # pod uid -> evict time
+
+    def _prune(self, now: float) -> None:
+        horizon = now - 2 * self.cooldown_seconds
+        for uid in [u for u, t in self._in_flight.items() if t < horizon]:
+            del self._in_flight[uid]
+        if len(self.evicted) > 1000:
+            del self.evicted[:-1000]
+
+    def evict(self, pod: PodMeta, reason: str) -> bool:
+        now = self.ctx.clock()
+        self._prune(now)
+        since = self._in_flight.get(pod.uid)
+        if since is not None and now - since < self.cooldown_seconds:
+            return False
+        ok = True
+        if self.kill_handler is not None:
+            ok = self.kill_handler(pod, reason)
+        if ok:
+            self._in_flight[pod.uid] = now
+            self.evicted.append((pod.uid, reason))
+            if self.ctx.auditor:
+                self.ctx.auditor.log(
+                    "eviction", "evict", pod.uid,
+                    {"pod": f"{pod.namespace}/{pod.name}", "reason": reason},
+                )
+        return ok
+
+
+class QOSManager:
+    """Ticks every enabled strategy at its interval (qosmanager/qos_manager.go)."""
+
+    def __init__(self, ctx: StrategyContext, strategies: list[QOSStrategy]):
+        self.ctx = ctx
+        self.strategies = strategies
+        self._last_run: dict[str, float] = {}
+
+    def tick(self) -> list[str]:
+        """Run strategies whose interval elapsed; returns names that ran."""
+        now = self.ctx.clock()
+        ran = []
+        for strategy in self.strategies:
+            last = self._last_run.get(strategy.name, 0.0)
+            if now - last < strategy.interval_seconds:
+                continue
+            gate = getattr(strategy, "feature_gate", "")
+            if gate and not KOORDLET_GATES.enabled(gate):
+                continue
+            try:
+                if strategy.enabled():
+                    strategy.update()
+                    ran.append(strategy.name)
+            except (OSError, ValueError):
+                continue
+            finally:
+                self._last_run[strategy.name] = now
+        return ran

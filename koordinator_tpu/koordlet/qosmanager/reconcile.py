@@ -1,0 +1,208 @@
+"""Tier-level reconcilers (reference: ``qosmanager/plugins/cgreconcile/``,
+``resctrl/``, ``blkio/``, ``sysreconcile/``).
+
+- :class:`CgroupReconcile`: program kube-QoS-tier cgroup knobs (memory QoS
+  watermarks/protection, priority) from the NodeSLO per-class strategies.
+- :class:`ResctrlQOS`: LLC way masks + MBA percents for the LS/LSR/BE resctrl
+  groups, and task binding of each tier's pids.
+- :class:`BlkIOQOS`: per-tier IO weight / throttles.
+- :class:`SysReconcile`: node sysctl knobs (min_free_kbytes factor,
+  watermark_scale_factor).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from koordinator_tpu.api.crds import QoSStrategy
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.koordlet.qosmanager.framework import StrategyContext
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdate
+from koordinator_tpu.koordlet.system import cgroup as cg
+from koordinator_tpu.koordlet.system import resctrl as rfs
+
+#: kube QoS tier -> koord QoS strategies that apply to it
+TIER_OF_CLASS = {
+    QoSClass.LSE: "guaranteed",
+    QoSClass.LSR: "guaranteed",
+    QoSClass.LS: "burstable",
+    QoSClass.BE: "besteffort",
+}
+
+
+class CgroupReconcile:
+    name = "cgreconcile"
+    interval_seconds = 10.0
+    feature_gate = "CgroupReconcile"
+
+    def __init__(self, ctx: StrategyContext):
+        self.ctx = ctx
+
+    def enabled(self) -> bool:
+        slo = self.ctx.node_slo()
+        return any(
+            s.memory.enable
+            for s in (slo.resource_qos_ls, slo.resource_qos_lsr, slo.resource_qos_be)
+        )
+
+    def _apply_memory_qos(self, rel: str, strategy: QoSStrategy,
+                          request_bytes: int, limit_bytes: int) -> None:
+        memory = strategy.memory
+        if not memory.enable:
+            return
+        updates = []
+        if memory.min_limit_percent > 0 and request_bytes > 0:
+            updates.append(ResourceUpdate(
+                cg.MEMORY_MIN, rel, str(request_bytes * memory.min_limit_percent // 100)
+            ))
+        if memory.low_limit_percent > 0 and request_bytes > 0:
+            updates.append(ResourceUpdate(
+                cg.MEMORY_LOW, rel, str(request_bytes * memory.low_limit_percent // 100)
+            ))
+        if memory.throttling_percent > 0 and limit_bytes > 0:
+            updates.append(ResourceUpdate(
+                cg.MEMORY_HIGH, rel, str(limit_bytes * memory.throttling_percent // 100)
+            ))
+        updates.append(ResourceUpdate(cg.MEMORY_WMARK_RATIO, rel, str(memory.wmark_ratio)))
+        updates.append(ResourceUpdate(
+            cg.MEMORY_WMARK_SCALE_FACTOR, rel, str(memory.wmark_scale_permill)
+        ))
+        updates.append(ResourceUpdate(
+            cg.MEMORY_WMARK_MIN_ADJ, rel, str(memory.wmark_min_adj)
+        ))
+        if memory.priority_enable:
+            updates.append(ResourceUpdate(cg.MEMORY_PRIORITY, rel, str(memory.priority)))
+            updates.append(ResourceUpdate(
+                cg.MEMORY_USE_PRIORITY_OOM, rel, str(memory.priority_enable)
+            ))
+        self.ctx.executor.update_batch(updates)
+
+    def update(self) -> None:
+        slo = self.ctx.node_slo()
+        strategy_of = {
+            QoSClass.LSE: slo.resource_qos_lsr,
+            QoSClass.LSR: slo.resource_qos_lsr,
+            QoSClass.LS: slo.resource_qos_ls,
+            QoSClass.BE: slo.resource_qos_be,
+        }
+        for pod in self.ctx.states.get_all_pods():
+            if not pod.is_running:
+                continue
+            strategy = strategy_of.get(pod.qos_class)
+            if strategy is None:
+                continue
+            self._apply_memory_qos(
+                pod.cgroup_dir(self.ctx.cfg), strategy,
+                int(pod.requests.get("memory", 0)),
+                int(pod.limits.get("memory", 0)),
+            )
+
+
+class ResctrlQOS:
+    name = "resctrl"
+    interval_seconds = 10.0
+    feature_gate = "RdtResctrl"
+
+    def __init__(self, ctx: StrategyContext,
+                 fs: Optional[rfs.ResctrlFS] = None,
+                 tier_pids: Optional[Callable[[str], list[int]]] = None):
+        self.ctx = ctx
+        self.fs = fs or rfs.ResctrlFS(ctx.cfg)
+        #: group name -> pids, injected (reads cgroup.procs of the tier in prod)
+        self.tier_pids = tier_pids
+
+    def enabled(self) -> bool:
+        return self.fs.available()
+
+    def update(self) -> None:
+        slo = self.ctx.node_slo()
+        per_group = {
+            rfs.GROUP_LS: slo.resource_qos_ls.resctrl,
+            rfs.GROUP_LSR: slo.resource_qos_lsr.resctrl,
+            rfs.GROUP_BE: slo.resource_qos_be.resctrl,
+        }
+        for group, strategy in per_group.items():
+            # CAT range [start, end] percent of ways -> positioned mask, so
+            # disjoint ranges give disjoint way sets (real LLC isolation).
+            span = max(1, strategy.cat_range_end_percent - strategy.cat_range_start_percent)
+            self.fs.apply_qos_policy(
+                group, span, strategy.mba_percent,
+                l3_start_percent=strategy.cat_range_start_percent,
+            )
+            if self.tier_pids is not None:
+                self.fs.add_tasks(group, self.tier_pids(group))
+
+
+class BlkIOQOS:
+    name = "blkio"
+    interval_seconds = 10.0
+    feature_gate = "BlkIOReconcile"
+
+    def __init__(self, ctx: StrategyContext):
+        self.ctx = ctx
+
+    def enabled(self) -> bool:
+        slo = self.ctx.node_slo()
+        return any(
+            s.blkio.enable
+            for s in (slo.resource_qos_ls, slo.resource_qos_lsr, slo.resource_qos_be)
+        )
+
+    def update(self) -> None:
+        slo = self.ctx.node_slo()
+        tiers = {
+            "burstable": slo.resource_qos_ls.blkio,
+            "besteffort": slo.resource_qos_be.blkio,
+        }
+        for tier, blkio in tiers.items():
+            if not blkio.enable:
+                continue
+            rel = self.ctx.cfg.kube_qos_dir(tier)
+            self.ctx.executor.update(
+                ResourceUpdate(cg.BLKIO_WEIGHT, rel, str(blkio.weight))
+            )
+
+
+class SysReconcile:
+    name = "sysreconcile"
+    interval_seconds = 30.0
+    feature_gate = "SystemConfig"
+
+    def __init__(self, ctx: StrategyContext):
+        self.ctx = ctx
+        self._baseline_min_free: Optional[int] = None
+
+    def enabled(self) -> bool:
+        return True
+
+    def update(self) -> None:
+        strategy = self.ctx.node_slo().system_strategy
+        vm = self.ctx.cfg.proc_path("sys", "vm")
+        targets = {
+            "watermark_scale_factor": strategy.watermark_scale_factor,
+        }
+        if strategy.min_free_kbytes_factor != 100:
+            try:
+                if self._baseline_min_free is None:
+                    # scale from the boot-time value once, not compounding
+                    # the already-scaled knob on every tick
+                    with open(os.path.join(vm, "min_free_kbytes")) as f:
+                        self._baseline_min_free = int(f.read().strip())
+                targets["min_free_kbytes"] = (
+                    self._baseline_min_free * strategy.min_free_kbytes_factor // 100
+                )
+            except (OSError, ValueError):
+                pass
+        for knob, value in targets.items():
+            path = os.path.join(vm, knob)
+            try:
+                with open(path) as f:
+                    if f.read().strip() == str(value):
+                        continue
+                with open(path, "w") as f:
+                    f.write(str(value))
+                if self.ctx.auditor:
+                    self.ctx.auditor.log("sysctl", "update", knob, {"value": str(value)})
+            except OSError:
+                continue

@@ -105,31 +105,43 @@ class ResourceUpdateExecutor:
         first) and decreases (child first) against the current kernel value,
         then apply shallow->deep for increases and deep->shallow otherwise.
 
-        Non-numeric knobs (cpuset strings) are treated as decreases so
-        children release before parents shrink — matching the reference's
-        merge-then-shrink cpuset discipline.
+        Direction rules: numeric values compare directly ('-1'/'max' raise a
+        limit, so they're increases); cpuset strings compare as sets (a
+        growing cpuset must widen the parent before the child, a shrinking
+        one must release children first — kernel validate_change rejects
+        either done in the wrong order).
         """
-        def magnitude(u: ResourceUpdate) -> Optional[int]:
+        UNLIMITED = {"-1", "max", "9223372036854771712", "9223372036854775807"}
+
+        def is_increase(u: ResourceUpdate) -> bool:
+            cur_raw = self._read_current(u)
+            if u.value in UNLIMITED:
+                return True
             try:
-                return int(u.value)
+                new = int(u.value)
             except ValueError:
-                return None
+                # cpuset-style list: growing set = increase
+                try:
+                    from koordinator_tpu.koordlet.system.procfs import parse_cpu_list
+
+                    new_set = set(parse_cpu_list(u.value))
+                    cur_set = (
+                        set(parse_cpu_list(cur_raw)) if cur_raw is not None else set()
+                    )
+                    return new_set >= cur_set
+                except ValueError:
+                    return False
+            if cur_raw is None or cur_raw in UNLIMITED:
+                return cur_raw is None
+            try:
+                return new >= int(cur_raw)
+            except ValueError:
+                return True
 
         increases: list[ResourceUpdate] = []
         decreases: list[ResourceUpdate] = []
         for u in updates:
-            new = magnitude(u)
-            cur_raw = self._read_current(u)
-            cur = None
-            if cur_raw is not None:
-                try:
-                    cur = int(cur_raw)
-                except ValueError:
-                    cur = None
-            if new is not None and (cur is None or new >= cur):
-                increases.append(u)
-            else:
-                decreases.append(u)
+            (increases if is_increase(u) else decreases).append(u)
 
         ordered = sorted(increases, key=lambda u: u.depth) + sorted(
             decreases, key=lambda u: -u.depth

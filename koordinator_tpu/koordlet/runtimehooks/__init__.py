@@ -1,0 +1,19 @@
+"""Runtime hooks: container-lifecycle QoS injection (reference:
+``pkg/koordlet/runtimehooks/`` — NRI server ``nri/server.go:34``, hook
+registry ``hooks/hooks.go:53``, cgroup reconciler ``reconciler/reconciler.go``,
+plugins under ``hooks/*``).
+
+Flow: the container runtime (NRI/proxy) raises lifecycle events; each event
+builds a :class:`~.protocol.PodContext`/:class:`~.protocol.ContainerContext`;
+registered hook plugins mutate the context's *response* (cgroup values, env
+vars, cpuset); the server turns the response into an NRI adjustment or direct
+cgroup writes through the resource executor. The :class:`~.reconciler.Reconciler`
+re-applies the same rules periodically from informer state as a safety net.
+"""
+
+from koordinator_tpu.koordlet.runtimehooks.hooks import (
+    HookRegistry, Stage,
+)
+from koordinator_tpu.koordlet.runtimehooks.protocol import (
+    ContainerContext, PodContext,
+)

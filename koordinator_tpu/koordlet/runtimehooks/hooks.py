@@ -1,0 +1,44 @@
+"""Hook registry + stages (reference: ``runtimehooks/hooks/hooks.go`` —
+``Register`` :53, ``RunHooks`` :92).
+
+Plugins register (stage, name, fn); the server/reconciler runs every hook of
+a stage over a context. Hook errors are collected, not fatal — a broken
+plugin must not block container creation (the reference logs and continues).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Iterable
+
+
+class Stage(enum.Enum):
+    PRE_RUN_POD_SANDBOX = "PreRunPodSandbox"
+    PRE_CREATE_CONTAINER = "PreCreateContainer"
+    PRE_START_CONTAINER = "PreStartContainer"
+    POST_START_CONTAINER = "PostStartContainer"
+    PRE_UPDATE_CONTAINER = "PreUpdateContainerResources"
+    POST_STOP_POD_SANDBOX = "PostStopPodSandbox"
+
+
+class HookRegistry:
+    def __init__(self):
+        self._hooks: dict[Stage, list[tuple[str, Callable]]] = {
+            stage: [] for stage in Stage
+        }
+
+    def register(self, stage: Stage, name: str, fn: Callable) -> None:
+        self._hooks[stage].append((name, fn))
+
+    def hooks_of(self, stage: Stage) -> Iterable[tuple[str, Callable]]:
+        return tuple(self._hooks[stage])
+
+    def run(self, stage: Stage, ctx) -> list[tuple[str, Exception]]:
+        """Run all hooks of a stage; returns (hook name, error) failures."""
+        failures = []
+        for name, fn in self._hooks[stage]:
+            try:
+                fn(ctx)
+            except Exception as e:  # noqa: BLE001 - isolate plugin faults
+                failures.append((name, e))
+        return failures

@@ -1,0 +1,229 @@
+"""The hook plugins (reference: ``runtimehooks/hooks/*`` — one dir per hook;
+gated by the RUNTIMEHOOK_GATES feature switches).
+
+Each plugin is a callable over a Pod/ContainerContext that fills in the
+response. Registration wires them into the registry at the stages the
+reference uses (groupidentity at sandbox + container, cpuset/batchresource at
+container create/update, gpu/rdma env at container create, coresched at
+container start).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.crds import NodeSLO
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.features import RUNTIMEHOOK_GATES
+from koordinator_tpu.koordlet.runtimehooks.hooks import HookRegistry, Stage
+from koordinator_tpu.koordlet.runtimehooks.protocol import (
+    ContainerContext, PodContext,
+)
+from koordinator_tpu.koordlet.system import cgroup as cg
+from koordinator_tpu.koordlet.system.coresched import CoreSched
+
+CFS_PERIOD_US = 100_000
+
+
+class GroupIdentity:
+    """bvt_warp_ns by QoS class (hooks/groupidentity/bvt.go:29): the Anolis
+    group-identity scheduler gives LS groups wakeup preemption over BE."""
+
+    name = "GroupIdentity"
+
+    def __init__(self, node_slo: Callable[[], NodeSLO]):
+        self.node_slo = node_slo
+
+    def bvt_of(self, qos: QoSClass) -> int:
+        slo = self.node_slo()
+        if qos.is_best_effort:
+            return slo.resource_qos_be.cpu.group_identity
+        if qos.is_latency_sensitive:
+            return slo.resource_qos_ls.cpu.group_identity
+        return 0
+
+    def __call__(self, ctx: PodContext | ContainerContext) -> None:
+        if not RUNTIMEHOOK_GATES.enabled(self.name):
+            return
+        ctx.response.set_cgroup(cg.CPU_BVT_WARP_NS, str(self.bvt_of(ctx.pod.qos_class)))
+
+
+class CPUSetAllocator:
+    """Apply the scheduler's cpuset decision from the resource-status
+    annotation (hooks/cpuset/) — LSR/LSE pods get their exclusive CPUs,
+    LS pods get the share pool."""
+
+    name = "CPUSetAllocator"
+
+    def __init__(self, share_pool: Optional[Callable[[], str]] = None):
+        #: cpus for LS pods (the non-exclusive share pool), injected
+        self.share_pool = share_pool
+
+    def __call__(self, ctx: PodContext | ContainerContext) -> None:
+        if not RUNTIMEHOOK_GATES.enabled(self.name):
+            return
+        status = ext.get_resource_status(ctx.pod.annotations)
+        cpuset = status.get("cpuset", "")
+        if cpuset:
+            ctx.response.cpuset_cpus = cpuset
+        elif (
+            ctx.pod.qos_class is QoSClass.LS
+            and self.share_pool is not None
+        ):
+            pool = self.share_pool()
+            if pool:
+                ctx.response.cpuset_cpus = pool
+
+
+class BatchResource:
+    """cfs quota + memory limit from batch-cpu/batch-memory requests
+    (hooks/batchresource/): BE pods request extended batch resources; the
+    kernel limits must be derived from them since kubelet sees only
+    zero-valued native requests."""
+
+    name = "BatchResource"
+
+    def __call__(self, ctx: PodContext | ContainerContext) -> None:
+        if not RUNTIMEHOOK_GATES.enabled(self.name):
+            return
+        if not ctx.pod.qos_class.is_best_effort:
+            return
+        batch_cpu = int(ctx.pod.requests.get(ext.RESOURCE_BATCH_CPU, 0))
+        batch_mem = int(ctx.pod.requests.get(ext.RESOURCE_BATCH_MEMORY, 0))
+        if batch_cpu > 0:
+            quota = batch_cpu * CFS_PERIOD_US // 1000
+            ctx.response.set_cgroup(cg.CPU_CFS_QUOTA, str(quota))
+            ctx.response.set_cgroup(
+                cg.CPU_SHARES, str(max(2, batch_cpu * 1024 // 1000))
+            )
+        if batch_mem > 0:
+            ctx.response.set_cgroup(cg.MEMORY_LIMIT, str(batch_mem))
+
+
+class GPUEnvInject:
+    """NVIDIA/HAMi-style env injection from the device-allocated annotation
+    (hooks/gpu/): the scheduler's device minors become the container's
+    visible-devices env."""
+
+    name = "GPUEnvInject"
+
+    def __call__(self, ctx: ContainerContext) -> None:
+        if not RUNTIMEHOOK_GATES.enabled(self.name):
+            return
+        allocations = ext.get_device_allocations(ctx.pod.annotations)
+        gpus = allocations.get("gpu", [])
+        if not gpus:
+            return
+        minors = ",".join(str(g.get("minor", 0)) for g in gpus)
+        ctx.response.env["NVIDIA_VISIBLE_DEVICES"] = minors
+        first = gpus[0].get("resources", {})
+        ratio = first.get(ext.RESOURCE_GPU_MEMORY_RATIO, 100)
+        if ratio < 100:  # shared GPU: expose the memory cap
+            mem = first.get(ext.RESOURCE_GPU_MEMORY, 0)
+            if mem:
+                ctx.response.env["CUDA_MEM_LIMIT"] = str(mem)
+
+
+class RDMADeviceInject:
+    """RDMA VF device env/mount inject (hooks/rdma/)."""
+
+    name = "RDMADeviceInject"
+
+    def __call__(self, ctx: ContainerContext) -> None:
+        if not RUNTIMEHOOK_GATES.enabled(self.name):
+            return
+        allocations = ext.get_device_allocations(ctx.pod.annotations)
+        rdma = allocations.get("rdma", [])
+        if rdma:
+            ctx.response.env["RDMA_DEVICES"] = ",".join(
+                str(r.get("minor", 0)) for r in rdma
+            )
+
+
+class CoreSchedHook:
+    """Core-scheduling cookies per pod group (hooks/coresched/): pods of the
+    same group share SMT siblings; BE pods never share with LS."""
+
+    name = "CoreSched"
+
+    def __init__(self, node_slo: Callable[[], NodeSLO],
+                 core_sched: Optional[CoreSched] = None):
+        self.node_slo = node_slo
+        self.core_sched = core_sched
+
+    def __call__(self, ctx: PodContext | ContainerContext) -> None:
+        if not RUNTIMEHOOK_GATES.enabled(self.name):
+            return
+        slo = self.node_slo()
+        qos = ctx.pod.qos_class
+        enable = (
+            slo.resource_qos_be.cpu.core_sched
+            if qos.is_best_effort
+            else slo.resource_qos_ls.cpu.core_sched
+        )
+        if enable:
+            # group id: QoS class + pod uid — each pod is its own core-sched
+            # group (the reference's default pod-level policy)
+            ctx.response.core_sched_group = f"{qos.name}/{ctx.pod.uid}"
+
+
+class CPUNormalization:
+    """Scale LS cfs quota by the node's CPU-model normalization ratio
+    (hooks/cpunormalization/): on fast CPU models a pod's quota shrinks so a
+    'core' means the same work everywhere."""
+
+    name = "CPUNormalization"
+
+    def __init__(self, ratio_pct: Callable[[], int]):
+        self.ratio_pct = ratio_pct
+
+    def __call__(self, ctx: ContainerContext) -> None:
+        if not RUNTIMEHOOK_GATES.enabled(self.name):
+            return
+        if ctx.pod.qos_class is not QoSClass.LS:
+            return
+        ratio = self.ratio_pct()
+        if ratio == 100:
+            return
+        limit_milli = int(ctx.pod.limits.get("cpu", 0))
+        if limit_milli <= 0:
+            return
+        quota = limit_milli * CFS_PERIOD_US // 1000 * 100 // ratio
+        ctx.response.set_cgroup(cg.CPU_CFS_QUOTA, str(quota))
+
+
+def register_default_hooks(
+    registry: HookRegistry,
+    node_slo: Callable[[], NodeSLO],
+    share_pool: Optional[Callable[[], str]] = None,
+    cpu_normalization_ratio: Optional[Callable[[], int]] = None,
+    core_sched: Optional[CoreSched] = None,
+) -> dict[str, object]:
+    """Wire the default plugin set at the reference's stages."""
+    group_identity = GroupIdentity(node_slo)
+    cpuset = CPUSetAllocator(share_pool)
+    batch = BatchResource()
+    gpu = GPUEnvInject()
+    rdma = RDMADeviceInject()
+    coresched = CoreSchedHook(node_slo, core_sched)
+    cpunorm = CPUNormalization(cpu_normalization_ratio or (lambda: 100))
+
+    registry.register(Stage.PRE_RUN_POD_SANDBOX, group_identity.name, group_identity)
+    for stage in (Stage.PRE_CREATE_CONTAINER, Stage.PRE_UPDATE_CONTAINER):
+        registry.register(stage, group_identity.name, group_identity)
+        registry.register(stage, cpuset.name, cpuset)
+        registry.register(stage, batch.name, batch)
+        registry.register(stage, cpunorm.name, cpunorm)
+    registry.register(Stage.PRE_CREATE_CONTAINER, gpu.name, gpu)
+    registry.register(Stage.PRE_CREATE_CONTAINER, rdma.name, rdma)
+    registry.register(Stage.PRE_START_CONTAINER, coresched.name, coresched)
+    return {
+        "groupidentity": group_identity,
+        "cpuset": cpuset,
+        "batchresource": batch,
+        "gpu": gpu,
+        "rdma": rdma,
+        "coresched": coresched,
+        "cpunormalization": cpunorm,
+    }

@@ -1,0 +1,81 @@
+"""Hook protocol contexts (reference: ``runtimehooks/protocol/`` —
+pod/container/kubeQOS context objects).
+
+A context carries the *target* (what the runtime is about to create/update)
+and accumulates the *response* (what koordinator wants changed). ``apply``
+pushes the response to the kernel through the resource executor — the same
+code path serves NRI adjustments and reconciler re-application.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from koordinator_tpu.koordlet.resourceexecutor import (
+    ResourceUpdate, ResourceUpdateExecutor,
+)
+from koordinator_tpu.koordlet.statesinformer import ContainerMeta, PodMeta
+from koordinator_tpu.koordlet.system import cgroup as cg
+from koordinator_tpu.koordlet.system.config import SystemConfig
+
+
+@dataclasses.dataclass
+class Response:
+    """Accumulated desired changes."""
+
+    cgroup_values: dict[str, str] = dataclasses.field(default_factory=dict)
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    cpuset_cpus: Optional[str] = None
+    cpuset_mems: Optional[str] = None
+    core_sched_group: Optional[str] = None  # group id; "" = opt out
+
+    def set_cgroup(self, resource: cg.CgroupResource, value: str) -> None:
+        self.cgroup_values[resource.name] = value
+
+
+@dataclasses.dataclass
+class PodContext:
+    pod: PodMeta
+    cgroup_dir: str
+    response: Response = dataclasses.field(default_factory=Response)
+
+    @classmethod
+    def from_pod(cls, pod: PodMeta, cfg: SystemConfig) -> "PodContext":
+        return cls(pod=pod, cgroup_dir=pod.cgroup_dir(cfg))
+
+    def apply(self, executor: ResourceUpdateExecutor) -> int:
+        """Write the response's cgroup part; returns number of kernel writes."""
+        return _apply_response(self.response, self.cgroup_dir, executor)
+
+
+@dataclasses.dataclass
+class ContainerContext:
+    pod: PodMeta
+    container: ContainerMeta
+    cgroup_dir: str
+    response: Response = dataclasses.field(default_factory=Response)
+
+    @classmethod
+    def from_container(cls, pod: PodMeta, container: ContainerMeta,
+                       cfg: SystemConfig) -> "ContainerContext":
+        rel = container.cgroup_dir or cfg.container_cgroup_dir(
+            pod.kube_qos, pod.uid, container.container_id
+        )
+        return cls(pod=pod, container=container, cgroup_dir=rel)
+
+    def apply(self, executor: ResourceUpdateExecutor) -> int:
+        return _apply_response(self.response, self.cgroup_dir, executor)
+
+
+def _apply_response(response: Response, rel_dir: str,
+                    executor: ResourceUpdateExecutor) -> int:
+    updates = []
+    for name, value in response.cgroup_values.items():
+        updates.append(ResourceUpdate(cg.resource_by_name(name), rel_dir, value))
+    if response.cpuset_cpus is not None:
+        updates.append(ResourceUpdate(cg.CPUSET_CPUS, rel_dir, response.cpuset_cpus))
+    if response.cpuset_mems is not None:
+        updates.append(ResourceUpdate(cg.CPUSET_MEMS, rel_dir, response.cpuset_mems))
+    results = executor.leveled_update_batch(updates)
+    return sum(1 for r in results if r.updated)

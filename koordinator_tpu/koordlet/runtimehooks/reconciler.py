@@ -1,0 +1,44 @@
+"""Cgroup reconciler: the async safety net (reference:
+``runtimehooks/reconciler/reconciler.go`` — ``reconcilePodCgroup`` :433,
+``doKubeQOSCgroup`` :407).
+
+Runtime events can be missed (agent restart, NRI race); the reconciler
+periodically rebuilds hook contexts from informer state and re-applies them.
+The executor's last-value cache makes this idempotent and cheap.
+"""
+
+from __future__ import annotations
+
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.runtimehooks.hooks import HookRegistry, Stage
+from koordinator_tpu.koordlet.runtimehooks.protocol import (
+    ContainerContext, PodContext,
+)
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.koordlet.system.config import SystemConfig
+
+
+class Reconciler:
+    def __init__(self, states: StatesInformer, registry: HookRegistry,
+                 executor: ResourceUpdateExecutor, cfg: SystemConfig):
+        self.states = states
+        self.registry = registry
+        self.executor = executor
+        self.cfg = cfg
+
+    def reconcile_once(self) -> int:
+        """Re-apply pod + container rules from current state; returns the
+        number of kernel writes actually performed."""
+        writes = 0
+        for pod in self.states.get_all_pods():
+            if not pod.is_running:
+                continue
+            pod_ctx = PodContext.from_pod(pod, self.cfg)
+            self.registry.run(Stage.PRE_RUN_POD_SANDBOX, pod_ctx)
+            self.registry.run(Stage.PRE_UPDATE_CONTAINER, pod_ctx)
+            writes += pod_ctx.apply(self.executor)
+            for container in pod.containers:
+                ctx = ContainerContext.from_container(pod, container, self.cfg)
+                self.registry.run(Stage.PRE_CREATE_CONTAINER, ctx)
+                writes += ctx.apply(self.executor)
+        return writes

@@ -100,6 +100,8 @@ class CoreSched:
         so the dance runs in a short-lived forked child — the agent's own
         cookie (and its SMT co-runnability) is never touched.
         """
+        if self._prctl is None:
+            return [leader_pid, *member_pids]
         if not hasattr(os, "fork"):  # pragma: no cover - non-POSIX
             return self._assign_group_inline(leader_pid, member_pids)
         read_fd, write_fd = os.pipe()
@@ -108,7 +110,9 @@ class CoreSched:
             os.close(read_fd)
             try:
                 failed = self._assign_group_inline(leader_pid, member_pids)
-                os.write(write_fd, (",".join(map(str, failed))).encode())
+                # "ok:" sentinel distinguishes an empty failure list from a
+                # child that died before reporting.
+                os.write(write_fd, ("ok:" + ",".join(map(str, failed))).encode())
             finally:
                 os._exit(0)
         os.close(write_fd)
@@ -117,7 +121,9 @@ class CoreSched:
         finally:
             os.close(read_fd)
             os.waitpid(pid, 0)
-        return [int(x) for x in data.split(",") if x]
+        if not data.startswith("ok:"):
+            return [leader_pid, *member_pids]
+        return [int(x) for x in data[3:].split(",") if x]
 
     def _assign_group_inline(self, leader_pid: int, member_pids: list[int]) -> list[int]:
         failed: list[int] = []

@@ -80,6 +80,21 @@ def percent_to_way_mask(percent: int, num_ways: int) -> int:
     return (1 << ways) - 1
 
 
+def range_to_way_mask(start_pct: int, end_pct: int, num_ways: int) -> int:
+    """Positioned contiguous mask for a CAT percent range [start, end]:
+    ways floor(start%) .. ceil(end%)-1. Disjoint ranges (BE [0,30],
+    LS [30,100]) yield non-overlapping masks — the point of the range model.
+    """
+    start_pct = max(0, min(100, start_pct))
+    end_pct = max(start_pct, min(100, end_pct))
+    lo = num_ways * start_pct // 100
+    hi = -(-num_ways * end_pct // 100)
+    if hi <= lo:  # always at least one way
+        hi = min(num_ways, lo + 1)
+        lo = hi - 1
+    return ((1 << (hi - lo)) - 1) << lo
+
+
 class ResctrlFS:
     """Handle over the resctrl mount."""
 
@@ -139,12 +154,16 @@ class ResctrlFS:
         return failed
 
     def apply_qos_policy(
-        self, group: str, l3_percent: int, mb_percent: int
+        self, group: str, l3_percent: int, mb_percent: int,
+        l3_start_percent: int = 0,
     ) -> Schemata:
         """Program one QoS group from percentage policy (resctrl qos plugin
-        semantics): L3 percent -> way mask per domain, MB percent verbatim."""
+        semantics): L3 range [start, start+percent] -> positioned way mask
+        per domain, MB percent verbatim."""
         ways = self.num_cache_ways()
-        mask = percent_to_way_mask(l3_percent, ways)
+        mask = range_to_way_mask(
+            l3_start_percent, l3_start_percent + l3_percent, ways
+        )
         domains = self.cache_domains()
         schemata = Schemata(
             l3={d: mask for d in domains},

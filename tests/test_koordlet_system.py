@@ -245,3 +245,57 @@ class TestAuditor:
         assert events[0]["target"] == "dir49"  # newest first
         files = os.listdir(tmp_path)
         assert len(files) <= 3
+
+
+class TestLeveledCpusetOrdering:
+    def test_growing_cpuset_parent_first(self, cfg):
+        for rel in ("kubepods", "kubepods/pod1"):
+            write_cgroup_file(cfg, cg.CPUSET_CPUS, rel, "0-1")
+        ex = rex.ResourceUpdateExecutor(cfg)
+        order = []
+        orig = ex.update
+        ex.update = lambda u: (order.append(u.rel_dir), orig(u))[1]
+        ex.leveled_update_batch([
+            rex.ResourceUpdate(cg.CPUSET_CPUS, "kubepods/pod1", "0-3"),
+            rex.ResourceUpdate(cg.CPUSET_CPUS, "kubepods", "0-3"),
+        ])
+        assert order == ["kubepods", "kubepods/pod1"]
+
+    def test_shrinking_cpuset_child_first(self, cfg):
+        for rel in ("kubepods", "kubepods/pod1"):
+            write_cgroup_file(cfg, cg.CPUSET_CPUS, rel, "0-3")
+        ex = rex.ResourceUpdateExecutor(cfg)
+        order = []
+        orig = ex.update
+        ex.update = lambda u: (order.append(u.rel_dir), orig(u))[1]
+        ex.leveled_update_batch([
+            rex.ResourceUpdate(cg.CPUSET_CPUS, "kubepods", "0-1"),
+            rex.ResourceUpdate(cg.CPUSET_CPUS, "kubepods/pod1", "0-1"),
+        ])
+        assert order == ["kubepods/pod1", "kubepods"]
+
+    def test_unlimited_is_increase(self, cfg):
+        write_cgroup_file(cfg, cg.MEMORY_LIMIT, "kubepods", "1000")
+        ex = rex.ResourceUpdateExecutor(cfg)
+        order = []
+        orig = ex.update
+        ex.update = lambda u: (order.append(u.rel_dir), orig(u))[1]
+        ex.leveled_update_batch([
+            rex.ResourceUpdate(cg.MEMORY_LIMIT, "kubepods", "-1"),
+        ])
+        assert order == ["kubepods"]
+        assert cg.cgroup_read(cg.MEMORY_LIMIT, "kubepods", cfg) == "-1"
+
+
+class TestResctrlRangeMask:
+    def test_disjoint_ranges_disjoint_masks(self):
+        be = resctrl.range_to_way_mask(0, 30, 20)
+        ls = resctrl.range_to_way_mask(30, 100, 20)
+        assert be & ls == 0
+        assert be | ls == (1 << 20) - 1
+
+    def test_minimum_one_way(self):
+        assert resctrl.range_to_way_mask(50, 50, 10).bit_count() == 1
+
+    def test_full_range(self):
+        assert resctrl.range_to_way_mask(0, 100, 12) == (1 << 12) - 1

@@ -1,0 +1,397 @@
+"""QoS manager strategy tests: suppress math, eviction windows, burst, tier
+reconcilers — all against the fake kernel fs."""
+
+import os
+
+import pytest
+
+from koordinator_tpu.api import crds
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.qosmanager import (
+    Evictor, QOSManager, StrategyContext,
+)
+from koordinator_tpu.koordlet.qosmanager import cpusuppress as cs
+from koordinator_tpu.koordlet.qosmanager.cpuburst import CPUBurst
+from koordinator_tpu.koordlet.qosmanager.evict import CPUEvict, MemoryEvict
+from koordinator_tpu.koordlet.qosmanager.reconcile import (
+    BlkIOQOS, CgroupReconcile, ResctrlQOS, SysReconcile,
+)
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.statesinformer import NodeInfo, PodMeta, StatesInformer
+from koordinator_tpu.koordlet.system import cgroup as cg
+from koordinator_tpu.koordlet.system import procfs, resctrl
+from koordinator_tpu.koordlet.system.config import test_config as make_test_config
+from tests.test_koordlet_metrics import FakeClock
+from tests.test_koordlet_system import write_cgroup_file
+
+
+def make_topology(n_cpus=8, n_numa=2):
+    infos = [
+        procfs.CPUInfo(cpu=i, core=i // 2, socket=0, node=i % n_numa)
+        for i in range(n_cpus)
+    ]
+    return procfs.CPUTopology(cpus=tuple(infos))
+
+
+def make_ctx(tmp_path, clock, pods=(), cpu_capacity_milli=8000,
+             mem_capacity=8 << 30, slo=None):
+    cfg = make_test_config(tmp_path)
+    states = StatesInformer(clock=clock)
+    states.set_node(NodeInfo(
+        name="n1",
+        allocatable={"cpu": cpu_capacity_milli, "memory": mem_capacity},
+    ))
+    states.set_pods(list(pods))
+    if slo is not None:
+        states.set_node_slo(slo)
+    cache = mc.MetricCache(clock=clock)
+    executor = ResourceUpdateExecutor(cfg)
+    return StrategyContext(states, cache, executor, cfg, clock=clock)
+
+
+def be_pod(uid, cpu_req=2000, priority=5500):
+    return PodMeta(
+        uid=uid, name=uid, namespace="default", qos_class=QoSClass.BE,
+        kube_qos="besteffort", priority=priority,
+        requests={"kubernetes.io/batch-cpu": cpu_req},
+    )
+
+
+def enabled_slo(**threshold_kwargs):
+    defaults = dict(enable=True)
+    defaults.update(threshold_kwargs)
+    return crds.NodeSLO(
+        resource_used_threshold_with_be=crds.ResourceThresholdStrategy(**defaults)
+    )
+
+
+class TestSuppressMath:
+    def test_formula(self):
+        # 16 cores, threshold 65%, LS+sys using 6 cores => BE gets 10.4 - 6 = 4.4
+        out = cs.calculate_be_suppress_milli(
+            16000, node_used_milli=7000, be_used_milli=1000, threshold_pct=65
+        )
+        assert out == 16000 * 65 // 100 - 6000
+
+    def test_min_floor_and_cap(self):
+        assert cs.calculate_be_suppress_milli(16000, 16000, 0, 65) == cs.BE_MIN_CPUS * 1000
+        assert cs.calculate_be_suppress_milli(4000, 0, 0, 200) == 4000
+
+    def test_rate_limited_growth(self):
+        out = cs.calculate_be_suppress_milli(
+            100_000, 0, 0, 65, max_increase_pct=5, prev_allowable_milli=10_000
+        )
+        assert out == 15_000  # +5% of capacity per tick
+
+    def test_cpuset_selection_numa_spread(self):
+        topo = make_topology(8, 2)
+        picked = cs.select_be_cpuset(topo, 4)
+        # round-robin across numa nodes: 2 from each
+        assert len(picked) == 4
+        assert sum(1 for c in picked if c % 2 == 0) == 2
+
+    def test_cpuset_avoids_exclusive(self):
+        topo = make_topology(8, 2)
+        picked = cs.select_be_cpuset(topo, 3, exclusive_cpus=frozenset({0, 1}))
+        assert not set(picked) & {0, 1}
+
+    def test_exclusive_fallback_when_starved(self):
+        topo = make_topology(4, 1)
+        picked = cs.select_be_cpuset(topo, 4, exclusive_cpus=frozenset({0, 1, 2}))
+        assert len(picked) == 4
+
+
+class TestCPUSuppress:
+    def test_cpuset_policy_writes_tier_and_pods(self, tmp_path):
+        clock = FakeClock()
+        pod = be_pod("be-1")
+        ctx = make_ctx(tmp_path, clock, pods=[pod], slo=enabled_slo())
+        ctx.cache.append(mc.NODE_CPU_USAGE, 5.0)
+        ctx.cache.append(mc.BE_CPU_USAGE, 1.0)
+        be_dir = ctx.cfg.kube_qos_dir("besteffort")
+        write_cgroup_file(ctx.cfg, cg.CPUSET_CPUS, be_dir, "0-7")
+        write_cgroup_file(ctx.cfg, cg.CPUSET_CPUS, pod.cgroup_dir(ctx.cfg), "0-7")
+        plugin = cs.CPUSuppress(ctx, topology=make_topology())
+        assert plugin.enabled()
+        plugin.update()
+        # 8 cores * 65% - 4 LS cores = 1.2 => floor 2 cpus
+        value = cg.cgroup_read(cg.CPUSET_CPUS, be_dir, ctx.cfg)
+        assert len(procfs.parse_cpu_list(value)) == 2
+        pod_value = cg.cgroup_read(cg.CPUSET_CPUS, pod.cgroup_dir(ctx.cfg), ctx.cfg)
+        assert pod_value == value
+
+    def test_cfs_quota_policy(self, tmp_path):
+        clock = FakeClock()
+        ctx = make_ctx(
+            tmp_path, clock,
+            slo=enabled_slo(cpu_suppress_policy="cfsQuota"),
+        )
+        ctx.cache.append(mc.NODE_CPU_USAGE, 2.0)
+        ctx.cache.append(mc.BE_CPU_USAGE, 1.0)
+        be_dir = ctx.cfg.kube_qos_dir("besteffort")
+        write_cgroup_file(ctx.cfg, cg.CPU_CFS_QUOTA, be_dir, "-1")
+        plugin = cs.CPUSuppress(ctx, topology=make_topology())
+        plugin.update()
+        # 8*0.65 - 1 = 4.2 cores => quota 420000us
+        assert cg.cgroup_read(cg.CPU_CFS_QUOTA, be_dir, ctx.cfg) == "420000"
+        assert plugin.be_real_limit_milli() == 4200
+
+
+class TestCPUEvict:
+    def make(self, tmp_path, clock, pods, real_limit):
+        ctx = make_ctx(
+            tmp_path, clock, pods=pods,
+            slo=enabled_slo(
+                cpu_evict_be_satisfaction_lower_percent=60,
+                cpu_evict_be_satisfaction_upper_percent=80,
+                cpu_evict_time_window_seconds=60,
+            ),
+        )
+        evictor = Evictor(ctx)
+        plugin = CPUEvict(ctx, evictor, be_real_limit_milli=lambda: real_limit)
+        return ctx, evictor, plugin
+
+    def test_no_evict_when_satisfied(self, tmp_path):
+        clock = FakeClock()
+        ctx, evictor, plugin = self.make(
+            tmp_path, clock, [be_pod("a", 2000)], real_limit=2000
+        )
+        ctx.cache.append(mc.BE_CPU_USAGE, 1.9)
+        plugin.update()
+        clock.tick(120)
+        plugin.update()
+        assert evictor.evicted == []
+
+    def test_evicts_after_window(self, tmp_path):
+        clock = FakeClock()
+        pods = [be_pod("a", 4000, priority=5100), be_pod("b", 4000, priority=5900)]
+        ctx, evictor, plugin = self.make(tmp_path, clock, pods, real_limit=2000)
+        # satisfaction = 2000/8000 = 25% < 60%; BE hungry (usage ~ limit)
+        ctx.cache.append(mc.BE_CPU_USAGE, 2.0)
+        plugin.update()          # starts the window
+        assert evictor.evicted == []
+        clock.tick(30)
+        ctx.cache.append(mc.BE_CPU_USAGE, 2.0)
+        plugin.update()          # within window: no evict yet
+        assert evictor.evicted == []
+        clock.tick(40)
+        ctx.cache.append(mc.BE_CPU_USAGE, 2.0)
+        plugin.update()          # window passed
+        # to reach 80%: target request = 2000/0.8 = 2500 => release 5500
+        # evicts lowest priority first ("a"), then "b"
+        assert [uid for uid, _ in evictor.evicted] == ["a", "b"]
+
+    def test_not_hungry_no_evict(self, tmp_path):
+        clock = FakeClock()
+        ctx, evictor, plugin = self.make(
+            tmp_path, clock, [be_pod("a", 8000)], real_limit=2000
+        )
+        ctx.cache.append(mc.BE_CPU_USAGE, 0.1)  # barely using its limit
+        plugin.update()
+        clock.tick(120)
+        ctx.cache.append(mc.BE_CPU_USAGE, 0.1)
+        plugin.update()
+        assert evictor.evicted == []
+
+
+class TestMemoryEvict:
+    def test_evicts_until_lower(self, tmp_path):
+        clock = FakeClock()
+        pods = [be_pod("a", priority=5100), be_pod("b", priority=5900)]
+        ctx = make_ctx(
+            tmp_path, clock, pods=pods, mem_capacity=100,
+            slo=enabled_slo(memory_evict_threshold_percent=70),
+        )
+        ctx.cache.append(mc.NODE_MEMORY_USAGE, 80.0)
+        ctx.cache.append(mc.POD_MEMORY_USAGE, 20.0, {"pod_uid": "a"})
+        ctx.cache.append(mc.POD_MEMORY_USAGE, 20.0, {"pod_uid": "b"})
+        evictor = Evictor(ctx)
+        MemoryEvict(ctx, evictor).update()
+        # need to release 80 - 68 = 12 bytes; first pod (20) is enough
+        assert [uid for uid, _ in evictor.evicted] == ["a"]
+
+    def test_below_threshold_noop(self, tmp_path):
+        clock = FakeClock()
+        ctx = make_ctx(
+            tmp_path, clock, pods=[be_pod("a")], mem_capacity=100,
+            slo=enabled_slo(memory_evict_threshold_percent=70),
+        )
+        ctx.cache.append(mc.NODE_MEMORY_USAGE, 50.0)
+        evictor = Evictor(ctx)
+        MemoryEvict(ctx, evictor).update()
+        assert evictor.evicted == []
+
+
+def ls_pod(uid, cpu_limit=2000, mem_req=0, mem_limit=0, priority=9500):
+    return PodMeta(
+        uid=uid, name=uid, namespace="default", qos_class=QoSClass.LS,
+        kube_qos="burstable", priority=priority,
+        requests={"memory": mem_req}, limits={"cpu": cpu_limit, "memory": mem_limit},
+    )
+
+
+class TestCPUBurst:
+    def make(self, tmp_path, clock, policy="auto"):
+        pod = ls_pod("ls-1")
+        slo = crds.NodeSLO(cpu_burst_strategy=crds.CPUBurstStrategy(policy=policy))
+        ctx = make_ctx(tmp_path, clock, pods=[pod], slo=slo)
+        rel = pod.cgroup_dir(ctx.cfg)
+        write_cgroup_file(ctx.cfg, cg.CPU_CFS_BURST, rel, "0")
+        write_cgroup_file(ctx.cfg, cg.CPU_CFS_QUOTA, rel, "200000")
+        return ctx, pod, rel
+
+    def test_cfs_burst_written(self, tmp_path):
+        clock = FakeClock()
+        ctx, pod, rel = self.make(tmp_path, clock, policy="cpuBurstOnly")
+        CPUBurst(ctx).update()
+        # limit 2000m * 1000% => 20 cores of burst * 100ms period = 2_000_000us
+        assert cg.cgroup_read(cg.CPU_CFS_BURST, rel, ctx.cfg) == "2000000"
+
+    def test_quota_burst_up_then_down(self, tmp_path):
+        clock = FakeClock()
+        ctx, pod, rel = self.make(tmp_path, clock, policy="cfsQuotaBurstOnly")
+        plugin = CPUBurst(ctx)
+        # throttled + calm node => scale up 1.2x
+        ctx.cache.append(mc.NODE_CPU_USAGE, 1.0)
+        ctx.cache.append(mc.CONTAINER_CPU_THROTTLED, 0.4, {"pod_uid": pod.uid})
+        plugin.update()
+        assert cg.cgroup_read(cg.CPU_CFS_QUOTA, rel, ctx.cfg) == "240000"
+        # node heats up => scale back toward base
+        clock.tick(2)
+        ctx.cache.append(mc.NODE_CPU_USAGE, 7.5)
+        ctx.cache.append(mc.CONTAINER_CPU_THROTTLED, 0.4, {"pod_uid": pod.uid})
+        plugin.update()
+        assert cg.cgroup_read(cg.CPU_CFS_QUOTA, rel, ctx.cfg) == "200000"
+
+    def test_quota_burst_capped(self, tmp_path):
+        clock = FakeClock()
+        ctx, pod, rel = self.make(tmp_path, clock, policy="cfsQuotaBurstOnly")
+        plugin = CPUBurst(ctx)
+        ctx.cache.append(mc.NODE_CPU_USAGE, 1.0)
+        ctx.cache.append(mc.CONTAINER_CPU_THROTTLED, 0.4, {"pod_uid": pod.uid})
+        for _ in range(20):
+            plugin.update()
+            clock.tick(1)
+            ctx.cache.append(mc.NODE_CPU_USAGE, 1.0)
+            ctx.cache.append(mc.CONTAINER_CPU_THROTTLED, 0.4, {"pod_uid": pod.uid})
+        # cap: base 200000 * 300% = 600000
+        assert cg.cgroup_read(cg.CPU_CFS_QUOTA, rel, ctx.cfg) == "600000"
+
+
+class TestReconcilers:
+    def test_cgroup_memory_qos(self, tmp_path):
+        clock = FakeClock()
+        pod = ls_pod("ls-1", mem_req=1000, mem_limit=2000)
+        slo = crds.NodeSLO(
+            resource_qos_ls=crds.QoSStrategy(
+                memory=crds.MemoryQoS(enable=True, min_limit_percent=50,
+                                      throttling_percent=80),
+            )
+        )
+        ctx = make_ctx(tmp_path, clock, pods=[pod], slo=slo)
+        rel = pod.cgroup_dir(ctx.cfg)
+        for res in (cg.MEMORY_MIN, cg.MEMORY_HIGH, cg.MEMORY_WMARK_RATIO,
+                    cg.MEMORY_WMARK_SCALE_FACTOR, cg.MEMORY_WMARK_MIN_ADJ):
+            write_cgroup_file(ctx.cfg, res, rel, "0")
+        plugin = CgroupReconcile(ctx)
+        assert plugin.enabled()
+        plugin.update()
+        assert cg.cgroup_read(cg.MEMORY_MIN, rel, ctx.cfg) == "500"
+        assert cg.cgroup_read(cg.MEMORY_HIGH, rel, ctx.cfg) == "1600"
+        assert cg.cgroup_read(cg.MEMORY_WMARK_RATIO, rel, ctx.cfg) == "95"
+
+    def test_resctrl_groups(self, tmp_path):
+        clock = FakeClock()
+        slo = crds.NodeSLO(
+            resource_qos_be=crds.QoSStrategy(
+                resctrl=crds.ResctrlQoS(cat_range_start_percent=0,
+                                        cat_range_end_percent=30, mba_percent=50),
+            )
+        )
+        ctx = make_ctx(tmp_path, clock, slo=slo)
+        from tests.test_koordlet_system import TestResctrl
+
+        fs = TestResctrl().make_fs(ctx.cfg, ways=10, domains=(0,))
+        plugin = ResctrlQOS(ctx, fs=fs, tier_pids=lambda g: [42] if g == "BE" else [])
+        plugin.update()
+        be = fs.read_schemata(resctrl.GROUP_BE)
+        assert be.l3 == {0: 0b111}  # 30% of 10 ways
+        assert be.mb == {0: 50}
+        assert fs.read_tasks(resctrl.GROUP_BE) == [42]
+
+    def test_blkio_weight(self, tmp_path):
+        clock = FakeClock()
+        slo = crds.NodeSLO(
+            resource_qos_be=crds.QoSStrategy(
+                blkio=crds.BlkIOQoS(enable=True, weight=50),
+            )
+        )
+        ctx = make_ctx(tmp_path, clock, slo=slo)
+        rel = ctx.cfg.kube_qos_dir("besteffort")
+        write_cgroup_file(ctx.cfg, cg.BLKIO_WEIGHT, rel, "100")
+        BlkIOQOS(ctx).update()
+        assert cg.cgroup_read(cg.BLKIO_WEIGHT, rel, ctx.cfg) == "50"
+
+    def test_sysreconcile_no_compounding(self, tmp_path):
+        clock = FakeClock()
+        slo = crds.NodeSLO(
+            system_strategy=crds.SystemStrategy(min_free_kbytes_factor=200,
+                                                watermark_scale_factor=150)
+        )
+        ctx = make_ctx(tmp_path, clock, slo=slo)
+        vm = ctx.cfg.proc_path("sys", "vm")
+        os.makedirs(vm, exist_ok=True)
+        with open(os.path.join(vm, "min_free_kbytes"), "w") as f:
+            f.write("1000")
+        with open(os.path.join(vm, "watermark_scale_factor"), "w") as f:
+            f.write("10")
+        plugin = SysReconcile(ctx)
+        plugin.update()
+        plugin.update()  # second tick must not re-scale
+        assert open(os.path.join(vm, "min_free_kbytes")).read() == "2000"
+        assert open(os.path.join(vm, "watermark_scale_factor")).read() == "150"
+
+
+class TestQOSManagerTick:
+    def test_interval_gating(self, tmp_path):
+        clock = FakeClock()
+        ctx = make_ctx(tmp_path, clock)
+
+        class Fast:
+            name = "fast"
+            interval_seconds = 1.0
+            runs = 0
+
+            def enabled(self):
+                return True
+
+            def update(self):
+                Fast.runs += 1
+
+        class Slow(Fast):
+            name = "slow"
+            interval_seconds = 10.0
+            runs = 0
+
+            def update(self):
+                Slow.runs += 1
+
+        manager = QOSManager(ctx, [Fast(), Slow()])
+        for _ in range(10):
+            manager.tick()
+            clock.tick(1.0)
+        assert Fast.runs == 10
+        assert Slow.runs == 1
+
+
+class TestEvictorCooldown:
+    def test_no_reevict_within_cooldown(self, tmp_path):
+        clock = FakeClock()
+        ctx = make_ctx(tmp_path, clock)
+        evictor = Evictor(ctx, cooldown_seconds=300)
+        p = be_pod("a")
+        assert evictor.evict(p, "r")
+        assert not evictor.evict(p, "r")
+        clock.tick(301)
+        assert evictor.evict(p, "r")

@@ -1,0 +1,245 @@
+"""Runtime hooks, PLEG and daemon-assembly tests."""
+
+import os
+
+import pytest
+
+from koordinator_tpu.api import crds, extension as ext
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.features import RUNTIMEHOOK_GATES
+from koordinator_tpu.koordlet.pleg import (
+    EVENT_CONTAINER_ADDED, EVENT_POD_ADDED, EVENT_POD_DELETED, PLEG,
+)
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.runtimehooks.hooks import HookRegistry, Stage
+from koordinator_tpu.koordlet.runtimehooks.plugins import register_default_hooks
+from koordinator_tpu.koordlet.runtimehooks.protocol import (
+    ContainerContext, PodContext,
+)
+from koordinator_tpu.koordlet.runtimehooks.reconciler import Reconciler
+from koordinator_tpu.koordlet.statesinformer import (
+    ContainerMeta, PodMeta, StatesInformer,
+)
+from koordinator_tpu.koordlet.system import cgroup as cg
+from koordinator_tpu.koordlet.system.config import test_config as make_test_config
+from tests.test_koordlet_system import write_cgroup_file
+
+
+@pytest.fixture
+def cfg(tmp_path):
+    return make_test_config(tmp_path)
+
+
+@pytest.fixture
+def gates():
+    """Enable the optional hook gates for the test, restore after."""
+    names = ["GPUEnvInject", "RDMADeviceInject", "CoreSched", "CPUNormalization"]
+    for n in names:
+        RUNTIMEHOOK_GATES.set(n, True)
+    yield RUNTIMEHOOK_GATES
+    for n in names:
+        RUNTIMEHOOK_GATES.set(n, False)
+
+
+def pod(qos=QoSClass.BE, kube_qos="besteffort", annotations=None, **kw):
+    return PodMeta(
+        uid="pod-1", name="pod-1", namespace="default", qos_class=qos,
+        kube_qos=kube_qos, annotations=annotations or {}, **kw,
+    )
+
+
+def setup_registry(node_slo=None, **kwargs):
+    registry = HookRegistry()
+    slo = node_slo or crds.NodeSLO()
+    register_default_hooks(registry, node_slo=lambda: slo, **kwargs)
+    return registry
+
+
+class TestHookPlugins:
+    def test_group_identity_bvt(self, cfg):
+        registry = setup_registry()
+        be_ctx = PodContext.from_pod(pod(), cfg)
+        registry.run(Stage.PRE_RUN_POD_SANDBOX, be_ctx)
+        assert be_ctx.response.cgroup_values["cpu.bvt_warp_ns"] == "-1"
+        ls_ctx = PodContext.from_pod(pod(qos=QoSClass.LS, kube_qos="burstable"), cfg)
+        registry.run(Stage.PRE_RUN_POD_SANDBOX, ls_ctx)
+        assert ls_ctx.response.cgroup_values["cpu.bvt_warp_ns"] == "2"
+
+    def test_cpuset_from_annotation(self, cfg):
+        ann = {}
+        ext.set_resource_status(ann, "4-7")
+        p = pod(qos=QoSClass.LSR, kube_qos="guaranteed", annotations=ann)
+        registry = setup_registry()
+        ctx = ContainerContext.from_container(p, ContainerMeta("c", "cid"), cfg)
+        registry.run(Stage.PRE_CREATE_CONTAINER, ctx)
+        assert ctx.response.cpuset_cpus == "4-7"
+
+    def test_ls_share_pool(self, cfg):
+        p = pod(qos=QoSClass.LS, kube_qos="burstable")
+        registry = setup_registry(share_pool=lambda: "0-3")
+        ctx = ContainerContext.from_container(p, ContainerMeta("c", "cid"), cfg)
+        registry.run(Stage.PRE_CREATE_CONTAINER, ctx)
+        assert ctx.response.cpuset_cpus == "0-3"
+
+    def test_batch_resource_limits(self, cfg):
+        p = pod(requests={
+            ext.RESOURCE_BATCH_CPU: 1500, ext.RESOURCE_BATCH_MEMORY: 1 << 30,
+        })
+        registry = setup_registry()
+        ctx = PodContext.from_pod(p, cfg)
+        registry.run(Stage.PRE_UPDATE_CONTAINER, ctx)
+        assert ctx.response.cgroup_values["cpu.cfs_quota"] == "150000"
+        assert ctx.response.cgroup_values["memory.limit"] == str(1 << 30)
+        assert ctx.response.cgroup_values["cpu.shares"] == str(1500 * 1024 // 1000)
+
+    def test_batch_resource_skips_non_be(self, cfg):
+        p = pod(qos=QoSClass.LS, kube_qos="burstable",
+                requests={ext.RESOURCE_BATCH_CPU: 1500})
+        registry = setup_registry()
+        ctx = PodContext.from_pod(p, cfg)
+        registry.run(Stage.PRE_UPDATE_CONTAINER, ctx)
+        assert "cpu.cfs_quota" not in ctx.response.cgroup_values
+
+    def test_gpu_env_inject(self, cfg, gates):
+        ann = {}
+        ext.set_device_allocations(ann, {"gpu": [
+            {"minor": 1, "resources": {ext.RESOURCE_GPU_MEMORY_RATIO: 50,
+                                       ext.RESOURCE_GPU_MEMORY: 8192}},
+            {"minor": 3, "resources": {}},
+        ]})
+        p = pod(qos=QoSClass.LS, kube_qos="burstable", annotations=ann)
+        registry = setup_registry()
+        ctx = ContainerContext.from_container(p, ContainerMeta("c", "cid"), cfg)
+        registry.run(Stage.PRE_CREATE_CONTAINER, ctx)
+        assert ctx.response.env["NVIDIA_VISIBLE_DEVICES"] == "1,3"
+        assert ctx.response.env["CUDA_MEM_LIMIT"] == "8192"
+
+    def test_coresched_group(self, cfg, gates):
+        slo = crds.NodeSLO(
+            resource_qos_be=crds.QoSStrategy(
+                cpu=crds.CPUQoS(group_identity=-1, core_sched=True))
+        )
+        registry = setup_registry(node_slo=slo)
+        ctx = ContainerContext.from_container(pod(), ContainerMeta("c", "cid"), cfg)
+        registry.run(Stage.PRE_START_CONTAINER, ctx)
+        assert ctx.response.core_sched_group == "BE/pod-1"
+
+    def test_cpu_normalization_quota(self, cfg, gates):
+        p = pod(qos=QoSClass.LS, kube_qos="burstable", limits={"cpu": 2000})
+        registry = setup_registry(cpu_normalization_ratio=lambda: 125)
+        ctx = ContainerContext.from_container(p, ContainerMeta("c", "cid"), cfg)
+        registry.run(Stage.PRE_CREATE_CONTAINER, ctx)
+        # 2 cores => 200000us quota scaled down by 1.25 => 160000
+        assert ctx.response.cgroup_values["cpu.cfs_quota"] == "160000"
+
+    def test_hook_error_isolated(self, cfg):
+        registry = HookRegistry()
+
+        def broken(ctx):
+            raise RuntimeError("boom")
+
+        seen = []
+        registry.register(Stage.PRE_CREATE_CONTAINER, "broken", broken)
+        registry.register(Stage.PRE_CREATE_CONTAINER, "ok", lambda c: seen.append(1))
+        failures = registry.run(Stage.PRE_CREATE_CONTAINER, None)
+        assert len(failures) == 1 and failures[0][0] == "broken"
+        assert seen == [1]
+
+
+class TestApplyAndReconcile:
+    def test_context_apply_writes_kernel(self, cfg):
+        p = pod(requests={ext.RESOURCE_BATCH_CPU: 1000})
+        rel = p.cgroup_dir(cfg)
+        for res in (cg.CPU_BVT_WARP_NS, cg.CPU_CFS_QUOTA, cg.CPU_SHARES):
+            write_cgroup_file(cfg, res, rel, "0")
+        registry = setup_registry()
+        executor = ResourceUpdateExecutor(cfg)
+        ctx = PodContext.from_pod(p, cfg)
+        registry.run(Stage.PRE_RUN_POD_SANDBOX, ctx)
+        registry.run(Stage.PRE_UPDATE_CONTAINER, ctx)
+        wrote = ctx.apply(executor)
+        assert wrote >= 2
+        assert cg.cgroup_read(cg.CPU_BVT_WARP_NS, rel, cfg) == "-1"
+        assert cg.cgroup_read(cg.CPU_CFS_QUOTA, rel, cfg) == "100000"
+
+    def test_reconciler_idempotent(self, cfg):
+        p = pod(requests={ext.RESOURCE_BATCH_CPU: 1000})
+        rel = p.cgroup_dir(cfg)
+        for res in (cg.CPU_BVT_WARP_NS, cg.CPU_CFS_QUOTA, cg.CPU_SHARES):
+            write_cgroup_file(cfg, res, rel, "0")
+        states = StatesInformer()
+        states.set_pods([p])
+        registry = setup_registry()
+        executor = ResourceUpdateExecutor(cfg)
+        reconciler = Reconciler(states, registry, executor, cfg)
+        first = reconciler.reconcile_once()
+        second = reconciler.reconcile_once()
+        assert first >= 2
+        assert second == 0  # cache suppressed: nothing changed
+
+
+class TestPLEG:
+    def make_pod_dir(self, cfg, qos, uid, containers=()):
+        base = cfg.cgroup_abs_path("cpu", cfg.pod_cgroup_dir(qos, uid))
+        os.makedirs(base, exist_ok=True)
+        for cid in containers:
+            os.makedirs(os.path.join(base, cid), exist_ok=True)
+        return base
+
+    def test_add_and_delete_events(self, cfg):
+        pleg = PLEG(cfg)
+        assert pleg.poll() == []
+        self.make_pod_dir(cfg, "besteffort", "abc-123", ["c1"])
+        events = pleg.poll()
+        assert [e.type for e in events] == [EVENT_POD_ADDED, EVENT_CONTAINER_ADDED]
+        assert events[0].pod_uid == "abc-123"
+        import shutil
+
+        shutil.rmtree(self.make_pod_dir(cfg, "besteffort", "abc-123"))
+        events = pleg.poll()
+        assert [e.type for e in events] == [EVENT_POD_DELETED]
+
+    def test_handler_fires(self, cfg):
+        pleg = PLEG(cfg)
+        seen = []
+        pleg.add_handler(lambda e: seen.append(e.type))
+        self.make_pod_dir(cfg, "burstable", "def-456")
+        pleg.poll()
+        assert seen == [EVENT_POD_ADDED]
+
+
+class TestDaemonAssembly:
+    def test_daemon_tick(self, tmp_path):
+        from tests.test_koordlet_metrics import FakeClock, write_proc
+        from koordinator_tpu.koordlet.daemon import Daemon
+        from koordinator_tpu.koordlet.statesinformer import NodeInfo
+
+        cfg = make_test_config(tmp_path)
+        clock = FakeClock()
+        daemon = Daemon(cfg=cfg, audit_dir=str(tmp_path / "audit"), clock=clock)
+        daemon.states.set_node(NodeInfo(name="n1", allocatable={"cpu": 8000}))
+        p = pod(requests={ext.RESOURCE_BATCH_CPU: 1000})
+        daemon.states.set_pods([p])
+        write_proc(cfg, 100)
+        rel = p.cgroup_dir(cfg)
+        for res in (cg.CPU_BVT_WARP_NS, cg.CPU_CFS_QUOTA, cg.CPU_SHARES):
+            write_cgroup_file(cfg, res, rel, "0")
+        out = daemon.tick()
+        assert "noderesource" in out["collected"]
+        # pod dir exists in fake cgroupfs -> PLEG add -> hooks reconciled
+        assert out["hook_writes"] >= 2
+        assert cg.cgroup_read(cg.CPU_BVT_WARP_NS, rel, cfg) == "-1"
+        out2 = daemon.tick()
+        assert out2["hook_writes"] == 0  # no churn, no writes
+
+
+class TestPLEGSystemd:
+    def test_systemd_slice_layout(self, tmp_path):
+        cfg = make_test_config(tmp_path)
+        cfg.cgroup_driver_systemd = True
+        pleg = PLEG(cfg)
+        base = cfg.cgroup_abs_path("cpu", cfg.pod_cgroup_dir("besteffort", "ab-12"))
+        os.makedirs(base, exist_ok=True)
+        events = pleg.poll()
+        assert [e.type for e in events] == [EVENT_POD_ADDED]
+        assert events[0].pod_uid == "ab-12"  # systemd '_' unescaped to '-'
