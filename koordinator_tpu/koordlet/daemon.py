@@ -79,20 +79,36 @@ class Daemon:
         self.pleg = PLEG(self.cfg)
         self.pleg.add_handler(lambda event: self._on_pleg_event(event))
         self._pleg_dirty = False
+        self._last_hook_reconcile = 0.0
+        #: periodic safety-net interval even without churn (NodeSLO changes,
+        #: missed events); the executor cache keeps quiet passes write-free
+        self.hook_reconcile_interval_seconds = 60.0
+        self.states.register_callback(
+            "node-slo", lambda slo: self._mark_dirty()
+        )
         self._stop = threading.Event()
 
     def _on_pleg_event(self, event) -> None:
+        self._mark_dirty()
+
+    def _mark_dirty(self) -> None:
         self._pleg_dirty = True
 
     def tick(self) -> dict:
-        """One agent step: collect -> enforce -> reconcile-on-churn."""
+        """One agent step: collect -> enforce -> reconcile on churn/SLO
+        change/interval."""
         collected = self.advisor.collect_once()
         strategies = self.qos_manager.tick()
         self.pleg.poll()
         writes = 0
-        if self._pleg_dirty:
+        now = self.clock()
+        due = (
+            now - self._last_hook_reconcile >= self.hook_reconcile_interval_seconds
+        )
+        if self._pleg_dirty or due:
             writes = self.hook_reconciler.reconcile_once()
             self._pleg_dirty = False
+            self._last_hook_reconcile = now
         return {
             "collected": collected,
             "strategies": strategies,

@@ -62,10 +62,13 @@ class PLEG:
                 if not m or not os.path.isdir(os.path.join(base, entry)):
                     continue
                 uid = _normalize_uid(m.group(1))
-                containers = {
-                    c for c in os.listdir(os.path.join(base, entry))
-                    if os.path.isdir(os.path.join(base, entry, c))
-                }
+                try:
+                    containers = {
+                        c for c in os.listdir(os.path.join(base, entry))
+                        if os.path.isdir(os.path.join(base, entry, c))
+                    }
+                except OSError:
+                    continue  # pod dir vanished between listdir and scan
                 found[uid] = containers
         return found
 

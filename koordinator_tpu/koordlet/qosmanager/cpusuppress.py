@@ -137,7 +137,6 @@ class CPUSuppress:
                     )
                     updates.append(ResourceUpdate(cg.CPUSET_CPUS, crel, value))
             self.ctx.executor.leveled_update_batch(updates)
-        self.current_allowable_milli = allowable
 
     def be_real_limit_milli(self) -> int:
         """What BE may actually use right now (for cpuevict satisfaction)."""

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from koordinator_tpu.api import extension as ext
 from koordinator_tpu.koordlet import metriccache as mc
 from koordinator_tpu.koordlet.qosmanager.framework import Evictor, StrategyContext
 
@@ -39,7 +40,7 @@ class CPUEvict:
 
     def _be_request_milli(self) -> int:
         return sum(
-            int(p.requests.get("kubernetes.io/batch-cpu", p.requests.get("cpu", 0)))
+            int(p.requests.get(ext.RESOURCE_BATCH_CPU, p.requests.get("cpu", 0)))
             for p in self.ctx.be_pods()
         )
 
@@ -79,7 +80,7 @@ class CPUEvict:
             if released >= to_release:
                 break
             req = int(
-                pod.requests.get("kubernetes.io/batch-cpu", pod.requests.get("cpu", 0))
+                pod.requests.get(ext.RESOURCE_BATCH_CPU, pod.requests.get("cpu", 0))
             )
             if self.evictor.evict(pod, "evictPodCPUPressure"):
                 released += req
@@ -116,7 +117,7 @@ class MemoryEvict:
         )
         to_release = node_used - capacity * lower_pct // 100
         released = 0
-        for pod in self.ctx.be_pods(sort_for_eviction=True):
+        for pod in self.ctx.be_pods(sort_for_eviction=True, sort_by="memory"):
             if released >= to_release:
                 break
             pod_mem = int(
@@ -124,5 +125,9 @@ class MemoryEvict:
                     mc.POD_MEMORY_USAGE, {"pod_uid": pod.uid}, now - 60, now
                 ).latest()
             )
+            if pod_mem <= 0:
+                # no sample yet: credit the declared request so a missing
+                # metric can't turn one needed eviction into evict-everything
+                pod_mem = int(pod.requests.get(ext.RESOURCE_BATCH_MEMORY, 0))
             if self.evictor.evict(pod, "evictPodMemoryPressure"):
                 released += pod_mem

@@ -59,19 +59,22 @@ class StrategyContext:
             return 0
         return int(node.allocatable.get("memory", 0))
 
-    def be_pods(self, sort_for_eviction: bool = False) -> list[PodMeta]:
+    def be_pods(self, sort_for_eviction: bool = False,
+                sort_by: str = "cpu") -> list[PodMeta]:
         """Running BE pods; eviction order = (priority asc, usage desc) —
-        the reference's sorter picks lowest priority, then biggest consumer."""
+        lowest priority first, then the biggest consumer of the pressured
+        resource (sort_by: "cpu" | "memory")."""
         pods = [
             p for p in self.states.get_all_pods()
             if p.qos_class.is_best_effort and p.is_running
         ]
         if sort_for_eviction:
             now = self.clock()
+            metric = mc.POD_MEMORY_USAGE if sort_by == "memory" else mc.POD_CPU_USAGE
 
             def usage(p: PodMeta) -> float:
                 return self.cache.query(
-                    mc.POD_CPU_USAGE, {"pod_uid": p.uid}, now - 60, now
+                    metric, {"pod_uid": p.uid}, now - 60, now
                 ).latest()
 
             pods.sort(key=lambda p: (p.priority, -usage(p)))

@@ -140,13 +140,39 @@ class ResourceUpdateExecutor:
 
         increases: list[ResourceUpdate] = []
         decreases: list[ResourceUpdate] = []
+        merges: list[ResourceUpdate] = []
         for u in updates:
+            if u.resource.name == "cpuset.cpus":
+                # Sideways cpuset moves (e.g. '0-3' -> '4-7') fail in both
+                # orders; write the union first parent-first (merge), then
+                # the final value child-first (shrink) — the reference's
+                # merge-then-shrink discipline.
+                try:
+                    from koordinator_tpu.koordlet.system.procfs import (
+                        format_cpu_list, parse_cpu_list,
+                    )
+
+                    cur_raw = self._read_current(u)
+                    new_set = set(parse_cpu_list(u.value))
+                    cur_set = set(parse_cpu_list(cur_raw)) if cur_raw else set()
+                    if not (new_set >= cur_set or new_set <= cur_set):
+                        merges.append(dataclasses.replace(
+                            u, value=format_cpu_list(sorted(new_set | cur_set))
+                        ))
+                    (increases if new_set >= cur_set else decreases).append(u)
+                    continue
+                except ValueError:
+                    pass
             (increases if is_increase(u) else decreases).append(u)
 
-        ordered = sorted(increases, key=lambda u: u.depth) + sorted(
-            decreases, key=lambda u: -u.depth
+        ordered = (
+            sorted(merges, key=lambda u: u.depth)
+            + sorted(increases, key=lambda u: u.depth)
+            + sorted(decreases, key=lambda u: -u.depth)
         )
-        results = {id(u): self.update(u) for u in ordered}
+        results: dict[int, UpdateResult] = {}
+        for u in ordered:
+            results[id(u)] = self.update(u)
         return [results[id(u)] for u in updates]
 
     def forget(self, rel_dir_prefix: str) -> None:

@@ -87,8 +87,11 @@ def range_to_way_mask(start_pct: int, end_pct: int, num_ways: int) -> int:
     """
     start_pct = max(0, min(100, start_pct))
     end_pct = max(start_pct, min(100, end_pct))
-    lo = num_ways * start_pct // 100
-    hi = -(-num_ways * end_pct // 100)
+    # round-half-up both bounds so adjacent ranges meet exactly at the same
+    # way boundary (floor/ceil mixing would overlap by one way).
+    lo = (num_ways * start_pct + 50) // 100
+    hi = (num_ways * end_pct + 50) // 100
+    hi = min(hi, num_ways)
     if hi <= lo:  # always at least one way
         hi = min(num_ways, lo + 1)
         lo = hi - 1

@@ -1,0 +1,59 @@
+"""NodeMetric controller (reference: ``pkg/slo-controller/nodemetric/
+nodemetric_controller.go:58`` Reconcile): ensure every node has a NodeMetric
+CR carrying the collect policy, and track report staleness.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from koordinator_tpu.api import crds
+from koordinator_tpu.manager.sloconfig import ColocationConfig
+
+
+class NodeMetricController:
+    def __init__(self, config: Optional[ColocationConfig] = None, clock=time.time):
+        self.config = config or ColocationConfig()
+        self.clock = clock
+        self._metrics: dict[str, crds.NodeMetric] = {}
+
+    def _spec(self) -> crds.NodeMetricSpec:
+        return crds.NodeMetricSpec(
+            aggregate_duration_seconds=self.config.metric_aggregate_duration_seconds,
+            report_interval_seconds=self.config.metric_report_interval_seconds,
+        )
+
+    def upsert_node(self, name: str) -> crds.NodeMetric:
+        """Node exists -> ensure its NodeMetric exists with current spec."""
+        current = self._metrics.get(name)
+        spec = self._spec()
+        if current is None:
+            current = crds.NodeMetric(name=name, spec=spec)
+        elif current.spec != spec:
+            current = crds.NodeMetric(name=name, spec=spec, status=current.status)
+        self._metrics[name] = current
+        return current
+
+    def delete_node(self, name: str) -> None:
+        self._metrics.pop(name, None)
+
+    def report_status(self, name: str, status: crds.NodeMetricStatus) -> None:
+        """The agent's periodic status update."""
+        metric = self._metrics.get(name) or crds.NodeMetric(name=name, spec=self._spec())
+        self._metrics[name] = crds.NodeMetric(
+            name=name, spec=metric.spec, status=status
+        )
+
+    def get(self, name: str) -> Optional[crds.NodeMetric]:
+        return self._metrics.get(name)
+
+    def is_expired(self, name: str) -> bool:
+        """Stale beyond the update threshold (feeds degrade decisions)."""
+        metric = self._metrics.get(name)
+        if metric is None or metric.status.update_time == 0:
+            return True
+        return (
+            self.clock() - metric.status.update_time
+            > self.config.update_time_threshold_seconds
+        )

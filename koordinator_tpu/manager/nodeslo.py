@@ -1,0 +1,68 @@
+"""NodeSLO controller (reference: ``pkg/slo-controller/nodeslo/
+nodeslo_controller.go:127`` Reconcile): render the cluster ConfigMap
+strategies into one NodeSLO per node, honoring node-selector overrides.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from koordinator_tpu.api import crds
+from koordinator_tpu.manager import sloconfig
+
+
+def render_node_slo(
+    node_name: str,
+    node_labels: Mapping[str, str],
+    config_data: Mapping[str, str],
+) -> crds.NodeSLO:
+    """One node's NodeSLO from the slo-controller-config data."""
+    threshold = sloconfig.parse_threshold_strategy(config_data, node_labels)
+    burst = sloconfig.parse_cpu_burst_strategy(config_data, node_labels)
+    return crds.NodeSLO(
+        name=node_name,
+        resource_used_threshold_with_be=threshold,
+        cpu_burst_strategy=burst,
+    )
+
+
+class NodeSLOController:
+    """Keeps the rendered NodeSLO set in sync with nodes + config changes."""
+
+    def __init__(self, config_data: Mapping[str, str] | None = None):
+        self._config_data = dict(config_data or {})
+        self._nodes: dict[str, Mapping[str, str]] = {}  # name -> labels
+        self._rendered: dict[str, crds.NodeSLO] = {}
+
+    def update_config(self, config_data: Mapping[str, str]) -> list[str]:
+        """New ConfigMap content; re-renders everything. Returns the names of
+        NodeSLOs whose content changed."""
+        errors = sloconfig.validate_config_data(config_data)
+        if errors:
+            # invalid config is rejected wholesale (webhook admission path);
+            # keep serving the last good config — reference behavior.
+            return []
+        self._config_data = dict(config_data)
+        return self._reconcile_all()
+
+    def upsert_node(self, name: str, labels: Mapping[str, str]) -> bool:
+        """Node added/labels changed; returns True if its NodeSLO changed."""
+        self._nodes[name] = dict(labels)
+        new = render_node_slo(name, labels, self._config_data)
+        changed = self._rendered.get(name) != new
+        self._rendered[name] = new
+        return changed
+
+    def delete_node(self, name: str) -> None:
+        self._nodes.pop(name, None)
+        self._rendered.pop(name, None)
+
+    def _reconcile_all(self) -> list[str]:
+        changed = []
+        for name, labels in self._nodes.items():
+            if self.upsert_node(name, labels):
+                changed.append(name)
+        return changed
+
+    def get(self, name: str) -> crds.NodeSLO | None:
+        return self._rendered.get(name)

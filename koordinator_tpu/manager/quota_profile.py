@@ -1,0 +1,61 @@
+"""ElasticQuotaProfile controller (reference: ``pkg/quota-controller/profile/``):
+generate per-tree root ElasticQuotas from node-selector profiles — the
+multi-quota-tree feature. A profile selects a set of nodes; the generated
+quota's min/max track the selected nodes' total allocatable (scaled by the
+profile ratio).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Mapping
+
+from koordinator_tpu.api import crds
+
+
+def _tree_id(profile_name: str) -> str:
+    return hashlib.sha256(profile_name.encode()).hexdigest()[:12]
+
+
+class QuotaProfileController:
+    def __init__(self):
+        self.profiles: dict[str, crds.ElasticQuotaProfile] = {}
+        #: node name -> (labels, allocatable)
+        self.nodes: dict[str, tuple[Mapping[str, str], Mapping[str, int]]] = {}
+
+    def upsert_profile(self, profile: crds.ElasticQuotaProfile) -> None:
+        self.profiles[profile.name] = profile
+
+    def delete_profile(self, name: str) -> None:
+        self.profiles.pop(name, None)
+
+    def upsert_node(self, name: str, labels: Mapping[str, str],
+                    allocatable: Mapping[str, int]) -> None:
+        self.nodes[name] = (dict(labels), dict(allocatable))
+
+    def delete_node(self, name: str) -> None:
+        self.nodes.pop(name, None)
+
+    def reconcile(self) -> list[crds.ElasticQuota]:
+        """Regenerate the root ElasticQuota of every profile's tree."""
+        out = []
+        for profile in self.profiles.values():
+            total: dict[str, int] = {}
+            for labels, allocatable in self.nodes.values():
+                if not all(labels.get(k) == v
+                           for k, v in profile.node_selector.items()):
+                    continue
+                for resource, amount in allocatable.items():
+                    total[resource] = total.get(resource, 0) + amount
+            ratio = profile.resource_ratio_percent
+            scaled = {k: v * ratio // 100 for k, v in total.items()}
+            out.append(crds.ElasticQuota(
+                name=profile.quota_name or profile.name,
+                parent="root",
+                min=dict(scaled),
+                max=dict(scaled),
+                is_parent=True,
+                tree_id=_tree_id(profile.name),
+                labels=dict(profile.quota_labels),
+            ))
+        return out

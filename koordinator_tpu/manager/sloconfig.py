@@ -1,0 +1,185 @@
+"""The cluster-level SLO config model (reference: ``pkg/util/sloconfig/`` —
+the ``slo-controller-config`` ConfigMap schema: per-cluster strategies with
+per-node-selector overrides, defaults, validation).
+
+The config arrives as JSON dicts (the ConfigMap data values); ``parse_*``
+merge cluster defaults with the first matching node-selector override —
+exactly the reference's GetNodeXxxStrategy merge order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Mapping, Optional
+
+from koordinator_tpu.api import crds
+
+# ConfigMap keys (sloconfig/config.go)
+KEY_COLOCATION = "colocation-config"
+KEY_RESOURCE_THRESHOLD = "resource-threshold-config"
+KEY_RESOURCE_QOS = "resource-qos-config"
+KEY_CPU_BURST = "cpu-burst-config"
+KEY_SYSTEM = "system-config"
+
+
+@dataclasses.dataclass(frozen=True)
+class ColocationConfig:
+    """colocation-config entry (sloconfig/colocation_config.go)."""
+
+    enable: bool = False
+    metric_aggregate_duration_seconds: int = 300
+    metric_report_interval_seconds: int = 60
+    cpu_reclaim_threshold_percent: int = 60
+    memory_reclaim_threshold_percent: int = 65
+    memory_calculate_policy: str = "usage"      # usage | request | maxUsageRequest
+    cpu_calculate_policy: str = "usage"
+    degrade_time_minutes: int = 15
+    update_time_threshold_seconds: int = 300
+    resource_diff_threshold: float = 0.1
+    mid_cpu_threshold_percent: int = 10
+    mid_memory_threshold_percent: int = 10
+    mid_unallocated_percent: int = 0
+
+
+def _matches(selector: Mapping[str, str], labels: Mapping[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def _merged(cluster: dict, overrides: list[dict],
+            node_labels: Mapping[str, str]) -> dict:
+    """Cluster strategy + first matching nodeStrategies entry (field-level
+    merge, override wins)."""
+    out = dict(cluster)
+    for entry in overrides:
+        selector = entry.get("nodeSelector", {}).get("matchLabels", {})
+        if _matches(selector, node_labels):
+            out.update({k: v for k, v in entry.items() if k != "nodeSelector"})
+            break
+    return out
+
+
+def _load(config_data: Mapping[str, str], key: str) -> tuple[dict, list[dict]]:
+    raw = config_data.get(key, "")
+    if not raw:
+        return {}, []
+    try:
+        parsed = json.loads(raw)
+    except json.JSONDecodeError:
+        return {}, []
+    if not isinstance(parsed, dict):
+        return {}, []
+    overrides = parsed.pop("nodeStrategies", [])
+    return parsed, overrides if isinstance(overrides, list) else []
+
+
+def parse_colocation_config(
+    config_data: Mapping[str, str],
+    node_labels: Mapping[str, str] | None = None,
+) -> ColocationConfig:
+    cluster, overrides = _load(config_data, KEY_COLOCATION)
+    merged = _merged(cluster, overrides, node_labels or {})
+    fields = {f.name: f for f in dataclasses.fields(ColocationConfig)}
+    camel = {
+        "enable": "enable",
+        "metricAggregateDurationSeconds": "metric_aggregate_duration_seconds",
+        "metricReportIntervalSeconds": "metric_report_interval_seconds",
+        "cpuReclaimThresholdPercent": "cpu_reclaim_threshold_percent",
+        "memoryReclaimThresholdPercent": "memory_reclaim_threshold_percent",
+        "memoryCalculatePolicy": "memory_calculate_policy",
+        "cpuCalculatePolicy": "cpu_calculate_policy",
+        "degradeTimeMinutes": "degrade_time_minutes",
+        "updateTimeThresholdSeconds": "update_time_threshold_seconds",
+        "resourceDiffThreshold": "resource_diff_threshold",
+        "midCPUThresholdPercent": "mid_cpu_threshold_percent",
+        "midMemoryThresholdPercent": "mid_memory_threshold_percent",
+        "midUnallocatedPercent": "mid_unallocated_percent",
+    }
+    kwargs = {}
+    for camel_key, snake in camel.items():
+        if camel_key in merged and snake in fields:
+            kwargs[snake] = merged[camel_key]
+    return ColocationConfig(**kwargs)
+
+
+def parse_threshold_strategy(
+    config_data: Mapping[str, str],
+    node_labels: Mapping[str, str] | None = None,
+) -> crds.ResourceThresholdStrategy:
+    cluster, overrides = _load(config_data, KEY_RESOURCE_THRESHOLD)
+    merged = _merged(cluster, overrides, node_labels or {})
+    return crds.ResourceThresholdStrategy(
+        enable=merged.get("enable", False),
+        cpu_suppress_threshold_percent=merged.get("cpuSuppressThresholdPercent", 65),
+        cpu_suppress_policy=merged.get("cpuSuppressPolicy", "cpuset"),
+        cpu_evict_be_usage_threshold_percent=merged.get(
+            "cpuEvictBEUsageThresholdPercent", 90
+        ),
+        cpu_evict_be_satisfaction_lower_percent=merged.get(
+            "cpuEvictBESatisfactionLowerPercent", 0
+        ),
+        cpu_evict_be_satisfaction_upper_percent=merged.get(
+            "cpuEvictBESatisfactionUpperPercent", 0
+        ),
+        cpu_evict_time_window_seconds=merged.get("cpuEvictTimeWindowSeconds", 60),
+        memory_evict_threshold_percent=merged.get("memoryEvictThresholdPercent", 70),
+        memory_evict_lower_percent=merged.get("memoryEvictLowerPercent", 0),
+    )
+
+
+def parse_cpu_burst_strategy(
+    config_data: Mapping[str, str],
+    node_labels: Mapping[str, str] | None = None,
+) -> crds.CPUBurstStrategy:
+    cluster, overrides = _load(config_data, KEY_CPU_BURST)
+    merged = _merged(cluster, overrides, node_labels or {})
+    inner = merged.get("cpuBurstConfig", merged)
+    return crds.CPUBurstStrategy(
+        policy=inner.get("policy", "none"),
+        cpu_burst_percent=inner.get("cpuBurstPercent", 1000),
+        cfs_quota_burst_percent=inner.get("cfsQuotaBurstPercent", 300),
+        cfs_quota_burst_period_seconds=inner.get("cfsQuotaBurstPeriodSeconds", -1),
+        share_pool_threshold_percent=merged.get("sharePoolThresholdPercent", 50),
+    )
+
+
+def validate_config_data(config_data: Mapping[str, str]) -> list[str]:
+    """ConfigMap admission validation (sloconfig/validator.go): JSON
+    well-formedness + percent ranges. Returns error strings (empty = valid)."""
+    errors: list[str] = []
+    for key in (KEY_COLOCATION, KEY_RESOURCE_THRESHOLD, KEY_RESOURCE_QOS,
+                KEY_CPU_BURST, KEY_SYSTEM):
+        raw = config_data.get(key, "")
+        if not raw:
+            continue
+        try:
+            parsed = json.loads(raw)
+        except json.JSONDecodeError as e:
+            errors.append(f"{key}: invalid JSON: {e}")
+            continue
+        if not isinstance(parsed, dict):
+            errors.append(f"{key}: must be a JSON object")
+            continue
+        for name, value in _iter_percents(parsed):
+            if not 0 <= value <= 100 and "Burst" not in name:
+                errors.append(f"{key}.{name}: percent {value} out of [0,100]")
+    cc = config_data.get(KEY_COLOCATION)
+    if cc:
+        try:
+            parsed = json.loads(cc)
+            if isinstance(parsed, dict):
+                cpu_r = parsed.get("cpuReclaimThresholdPercent")
+                if cpu_r is not None and not 0 <= cpu_r <= 100:
+                    errors.append("colocation cpuReclaimThresholdPercent out of range")
+        except json.JSONDecodeError:
+            pass
+    return errors
+
+
+def _iter_percents(obj: dict, prefix: str = ""):
+    for k, v in obj.items():
+        name = f"{prefix}{k}"
+        if isinstance(v, dict):
+            yield from _iter_percents(v, name + ".")
+        elif isinstance(v, (int, float)) and k.endswith("Percent"):
+            yield name, v

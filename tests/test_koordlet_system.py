@@ -299,3 +299,27 @@ class TestResctrlRangeMask:
 
     def test_full_range(self):
         assert resctrl.range_to_way_mask(0, 100, 12) == (1 << 12) - 1
+
+    def test_sideways_cpuset_merge_then_shrink(self, cfg):
+        # '0-3' -> '4-7': union written parent-first, final child-first
+        for rel in ("kubepods", "kubepods/pod1"):
+            write_cgroup_file(cfg, cg.CPUSET_CPUS, rel, "0-3")
+        ex = rex.ResourceUpdateExecutor(cfg)
+        writes = []
+        orig = ex.update
+        ex.update = lambda u: (writes.append((u.rel_dir, u.value)), orig(u))[1]
+        ex.leveled_update_batch([
+            rex.ResourceUpdate(cg.CPUSET_CPUS, "kubepods/pod1", "4-7"),
+            rex.ResourceUpdate(cg.CPUSET_CPUS, "kubepods", "4-7"),
+        ])
+        assert writes[0] == ("kubepods", "0-7")          # merge parent first
+        assert writes[1] == ("kubepods/pod1", "0-7")
+        assert writes[2] == ("kubepods/pod1", "4-7")     # shrink child first
+        assert writes[3] == ("kubepods", "4-7")
+        assert cg.cgroup_read(cg.CPUSET_CPUS, "kubepods", cfg) == "4-7"
+
+    def test_adjacent_ranges_no_overlap_8_ways(self):
+        be = resctrl.range_to_way_mask(0, 30, 8)
+        ls = resctrl.range_to_way_mask(30, 100, 8)
+        assert be & ls == 0
+        assert (be | ls).bit_count() == 8

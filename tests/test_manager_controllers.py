@@ -1,0 +1,350 @@
+"""Manager-layer tests: sloconfig parsing/validation, NodeSLO rendering,
+NodeMetric lifecycle, the batched noderesource controller, webhooks, quota
+profiles."""
+
+import json
+
+import pytest
+
+from koordinator_tpu.api import crds, extension as ext
+from koordinator_tpu.manager import sloconfig
+from koordinator_tpu.manager.nodemetric import NodeMetricController
+from koordinator_tpu.manager.nodeslo import NodeSLOController, render_node_slo
+from koordinator_tpu.manager.noderesource_controller import (
+    MIB, NodePatch, NodeRecord, NodeResourceController,
+)
+from koordinator_tpu.manager.quota_profile import QuotaProfileController
+from koordinator_tpu.manager.webhook import (
+    PodMutatingWebhook, PodValidatingWebhook, QuotaEvaluator,
+)
+from tests.test_koordlet_metrics import FakeClock
+
+
+class TestSloConfig:
+    def test_colocation_defaults_and_override(self):
+        data = {
+            sloconfig.KEY_COLOCATION: json.dumps({
+                "enable": True,
+                "cpuReclaimThresholdPercent": 70,
+                "nodeStrategies": [
+                    {"nodeSelector": {"matchLabels": {"pool": "batch"}},
+                     "cpuReclaimThresholdPercent": 80},
+                ],
+            })
+        }
+        base = sloconfig.parse_colocation_config(data, {})
+        assert base.enable and base.cpu_reclaim_threshold_percent == 70
+        override = sloconfig.parse_colocation_config(data, {"pool": "batch"})
+        assert override.cpu_reclaim_threshold_percent == 80
+        # untouched field keeps default
+        assert override.memory_reclaim_threshold_percent == 65
+
+    def test_threshold_strategy(self):
+        data = {
+            sloconfig.KEY_RESOURCE_THRESHOLD: json.dumps({
+                "enable": True, "cpuSuppressThresholdPercent": 55,
+            })
+        }
+        s = sloconfig.parse_threshold_strategy(data)
+        assert s.enable and s.cpu_suppress_threshold_percent == 55
+
+    def test_validation(self):
+        bad = {sloconfig.KEY_COLOCATION: "{not json"}
+        assert sloconfig.validate_config_data(bad)
+        out_of_range = {
+            sloconfig.KEY_RESOURCE_THRESHOLD: json.dumps(
+                {"cpuSuppressThresholdPercent": 150}
+            )
+        }
+        assert sloconfig.validate_config_data(out_of_range)
+        ok = {sloconfig.KEY_RESOURCE_THRESHOLD: json.dumps(
+            {"cpuSuppressThresholdPercent": 65})}
+        assert sloconfig.validate_config_data(ok) == []
+
+
+class TestNodeSLO:
+    def test_render_and_reconcile(self):
+        controller = NodeSLOController()
+        controller.upsert_node("n1", {"pool": "batch"})
+        controller.upsert_node("n2", {})
+        changed = controller.update_config({
+            sloconfig.KEY_RESOURCE_THRESHOLD: json.dumps({
+                "enable": True,
+                "nodeStrategies": [
+                    {"nodeSelector": {"matchLabels": {"pool": "batch"}},
+                     "cpuSuppressThresholdPercent": 50},
+                ],
+            })
+        })
+        assert set(changed) == {"n1", "n2"}
+        assert controller.get("n1").resource_used_threshold_with_be \
+            .cpu_suppress_threshold_percent == 50
+        assert controller.get("n2").resource_used_threshold_with_be \
+            .cpu_suppress_threshold_percent == 65
+
+    def test_invalid_config_keeps_last_good(self):
+        controller = NodeSLOController()
+        controller.upsert_node("n1", {})
+        controller.update_config({
+            sloconfig.KEY_RESOURCE_THRESHOLD: json.dumps({"enable": True})
+        })
+        assert controller.get("n1").resource_used_threshold_with_be.enable
+        controller.update_config({sloconfig.KEY_RESOURCE_THRESHOLD: "broken{"})
+        assert controller.get("n1").resource_used_threshold_with_be.enable
+
+
+class TestNodeMetricController:
+    def test_spec_push_and_expiry(self):
+        clock = FakeClock()
+        config = sloconfig.ColocationConfig(update_time_threshold_seconds=300)
+        controller = NodeMetricController(config, clock=clock)
+        controller.upsert_node("n1")
+        assert controller.get("n1").spec.aggregate_duration_seconds == 300
+        assert controller.is_expired("n1")  # never reported
+        controller.report_status("n1", crds.NodeMetricStatus(update_time=clock.t))
+        assert not controller.is_expired("n1")
+        clock.tick(301)
+        assert controller.is_expired("n1")
+
+
+def make_record(name="n1", metric_age=0.0, now=1000.0, **kw):
+    defaults = dict(
+        cpu_capacity_milli=16000, mem_capacity_mib=32768,
+        metric=crds.NodeMetricStatus(
+            update_time=now - metric_age,
+            node_usage=crds.ResourceUsage(cpu_milli=7000,
+                                          memory_bytes=8192 * MIB),
+            system_usage=crds.ResourceUsage(cpu_milli=1000,
+                                            memory_bytes=2048 * MIB),
+        ),
+    )
+    defaults.update(kw)
+    return NodeRecord(name=name, **defaults)
+
+
+class TestNodeResourceController:
+    def test_batch_formula_by_usage(self):
+        clock = FakeClock()
+        controller = NodeResourceController(
+            sloconfig.ColocationConfig(enable=True), clock=clock
+        )
+        record = make_record(now=clock.t, hp_request_cpu_milli=4000)
+        # hp usage 0 (no pods_metrics) => batch = 16000*0.6 - max(1000,0) - 0
+        patches = controller.reconcile([record])
+        assert len(patches) == 1
+        assert patches[0].batch_cpu_milli == 16000 * 60 // 100 - 1000
+        assert not patches[0].degraded
+
+    def test_degrade_on_stale_metric(self):
+        clock = FakeClock()
+        config = sloconfig.ColocationConfig(enable=True, degrade_time_minutes=15)
+        controller = NodeResourceController(config, clock=clock)
+        record = make_record(now=clock.t, metric_age=16 * 60)
+        patches = controller.reconcile([record])
+        assert patches[0].degraded and patches[0].batch_cpu_milli == 0
+
+    def test_diff_threshold_suppression(self):
+        clock = FakeClock()
+        controller = NodeResourceController(
+            sloconfig.ColocationConfig(enable=True, resource_diff_threshold=0.1),
+            clock=clock,
+        )
+        record = make_record(now=clock.t)
+        assert len(controller.reconcile([record])) == 1
+        # tiny usage change -> relative diff below 10% -> suppressed
+        record.metric = crds.NodeMetricStatus(
+            update_time=clock.t,
+            node_usage=crds.ResourceUsage(cpu_milli=7100, memory_bytes=8192 * MIB),
+            system_usage=crds.ResourceUsage(cpu_milli=1100, memory_bytes=2048 * MIB),
+        )
+        assert controller.reconcile([record]) == []
+
+    def test_cpu_normalization_and_amplification(self):
+        clock = FakeClock()
+        controller = NodeResourceController(
+            sloconfig.ColocationConfig(enable=True), clock=clock
+        )
+        record = make_record(
+            now=clock.t,
+            annotations={
+                ext.ANNOTATION_CPU_NORMALIZATION: "1.5",
+                ext.ANNOTATION_NODE_AMPLIFICATION: '{"cpu": 2.0}',
+            },
+        )
+        patches = controller.reconcile([record])
+        # capacity 16000 * 1.5 * 2.0 = 48000 => batch = 48000*0.6 - 1000
+        assert patches[0].batch_cpu_milli == 48000 * 60 // 100 - 1000
+
+    def test_device_resources_synced(self):
+        clock = FakeClock()
+        controller = NodeResourceController(
+            sloconfig.ColocationConfig(enable=True), clock=clock
+        )
+        record = make_record(
+            now=clock.t,
+            device=crds.Device(node_name="n1", devices=(
+                crds.DeviceInfo(type="gpu", minor=0,
+                                resources={ext.RESOURCE_GPU_MEMORY: 16384}),
+                crds.DeviceInfo(type="gpu", minor=1, health=False,
+                                resources={ext.RESOURCE_GPU_MEMORY: 16384}),
+                crds.DeviceInfo(type="rdma", minor=0),
+            )),
+        )
+        patches = controller.reconcile([record])
+        devres = patches[0].device_resources
+        assert devres[ext.RESOURCE_GPU] == 100          # unhealthy gpu excluded
+        assert devres[ext.RESOURCE_GPU_MEMORY] == 16384
+        assert devres[ext.RESOURCE_RDMA] == 100
+
+    def test_batched_many_nodes(self):
+        clock = FakeClock()
+        controller = NodeResourceController(
+            sloconfig.ColocationConfig(enable=True), clock=clock
+        )
+        records = [make_record(name=f"n{i}", now=clock.t) for i in range(64)]
+        patches = controller.reconcile(records)
+        assert len(patches) == 64
+        assert len({p.batch_cpu_milli for p in patches}) == 1
+
+
+def be_pod_dict(cpu="2", memory="4Gi"):
+    return {
+        "metadata": {"name": "p1", "namespace": "default",
+                     "labels": {ext.LABEL_POD_QOS: "BE"}},
+        "spec": {
+            "priority": 5500,
+            "containers": [
+                {"name": "main", "resources": {
+                    "requests": {"cpu": cpu, "memory": memory},
+                    "limits": {"cpu": cpu, "memory": memory},
+                }},
+            ],
+        },
+    }
+
+
+class TestMutatingWebhook:
+    def test_profile_injection(self):
+        profile = crds.ClusterColocationProfile(
+            name="colo", pod_selector={"app": "batch"},
+            qos_class="BE", koordinator_priority=5500,
+            scheduler_name="koord-scheduler",
+            labels={"injected": "yes"},
+        )
+        hook = PodMutatingWebhook([profile])
+        pod = {"metadata": {"labels": {"app": "batch"}},
+               "spec": {"containers": []}}
+        hook.mutate(pod)
+        assert pod["metadata"]["labels"][ext.LABEL_POD_QOS] == "BE"
+        assert pod["spec"]["priority"] == 5500
+        assert pod["spec"]["schedulerName"] == "koord-scheduler"
+        assert pod["metadata"]["labels"]["injected"] == "yes"
+
+    def test_no_match_no_change(self):
+        profile = crds.ClusterColocationProfile(
+            name="colo", pod_selector={"app": "batch"}, qos_class="BE",
+        )
+        hook = PodMutatingWebhook([profile])
+        pod = {"metadata": {"labels": {"app": "web"}}, "spec": {"containers": []}}
+        hook.mutate(pod)
+        assert ext.LABEL_POD_QOS not in pod["metadata"]["labels"]
+
+    def test_batch_resource_translation(self):
+        hook = PodMutatingWebhook()
+        pod = be_pod_dict(cpu="500m", memory="1Gi")
+        hook.mutate(pod)
+        resources = pod["spec"]["containers"][0]["resources"]
+        assert resources["requests"][ext.RESOURCE_BATCH_CPU] == 500
+        assert resources["requests"][ext.RESOURCE_BATCH_MEMORY] == 1 << 30
+        assert "cpu" not in resources["requests"]
+
+    def test_non_be_untranslated(self):
+        hook = PodMutatingWebhook()
+        pod = be_pod_dict()
+        pod["metadata"]["labels"][ext.LABEL_POD_QOS] = "LS"
+        pod["spec"]["priority"] = 9500
+        hook.mutate(pod)
+        assert "cpu" in pod["spec"]["containers"][0]["resources"]["requests"]
+
+
+class TestValidatingWebhook:
+    def test_qos_priority_compat(self):
+        hook = PodValidatingWebhook()
+        bad = {"metadata": {"labels": {ext.LABEL_POD_QOS: "LSR"}},
+               "spec": {"priority": 5500, "containers": []}}
+        assert hook.validate(bad)
+        good = {"metadata": {"labels": {ext.LABEL_POD_QOS: "LSR"}},
+                "spec": {"priority": 9500, "containers": []}}
+        assert hook.validate(good) == []
+
+    def test_mixed_batch_native_rejected(self):
+        hook = PodValidatingWebhook()
+        pod = {
+            "metadata": {"labels": {ext.LABEL_POD_QOS: "BE"}},
+            "spec": {"priority": 5500, "containers": [
+                {"name": "c", "resources": {"requests": {
+                    "cpu": "1", ext.RESOURCE_BATCH_CPU: 1000,
+                }}},
+            ]},
+        }
+        assert any("mixed" in e for e in hook.validate(pod))
+
+    def test_batch_request_limit_mismatch(self):
+        hook = PodValidatingWebhook()
+        pod = {
+            "metadata": {"labels": {ext.LABEL_POD_QOS: "BE"}},
+            "spec": {"priority": 5500, "containers": [
+                {"name": "c", "resources": {
+                    "requests": {ext.RESOURCE_BATCH_CPU: 1000},
+                    "limits": {ext.RESOURCE_BATCH_CPU: 2000},
+                }},
+            ]},
+        }
+        assert any("request must equal limit" in e for e in hook.validate(pod))
+
+
+class TestQuotaEvaluator:
+    def make(self):
+        ev = QuotaEvaluator()
+        ev.set_quota(crds.ElasticQuota(name="org", parent="root",
+                                       max={"cpu": 10000}))
+        ev.set_quota(crds.ElasticQuota(name="team", parent="org",
+                                       max={"cpu": 4000}))
+        return ev
+
+    def test_admit_and_reject(self):
+        ev = self.make()
+        assert ev.admit("team", {"cpu": 3000}) is None
+        reason = ev.admit("team", {"cpu": 2000})
+        assert reason is not None and "team" in reason
+        assert ev.admit("team", {"cpu": 1000}) is None
+
+    def test_parent_limit_enforced(self):
+        ev = self.make()
+        ev.set_quota(crds.ElasticQuota(name="team2", parent="org",
+                                       max={"cpu": 8000}))
+        assert ev.admit("team", {"cpu": 4000}) is None
+        assert ev.admit("team2", {"cpu": 7000}) is not None  # org cap 10000
+
+    def test_release(self):
+        ev = self.make()
+        assert ev.admit("team", {"cpu": 4000}) is None
+        ev.release("team", {"cpu": 4000})
+        assert ev.admit("team", {"cpu": 4000}) is None
+
+
+class TestQuotaProfile:
+    def test_tree_generation(self):
+        controller = QuotaProfileController()
+        controller.upsert_profile(crds.ElasticQuotaProfile(
+            name="batch-pool", quota_name="batch-root",
+            node_selector={"pool": "batch"}, resource_ratio_percent=90,
+        ))
+        controller.upsert_node("n1", {"pool": "batch"}, {"cpu": 16000})
+        controller.upsert_node("n2", {"pool": "batch"}, {"cpu": 16000})
+        controller.upsert_node("n3", {"pool": "web"}, {"cpu": 16000})
+        quotas = controller.reconcile()
+        assert len(quotas) == 1
+        assert quotas[0].name == "batch-root"
+        assert quotas[0].min == {"cpu": 32000 * 90 // 100}
+        assert quotas[0].tree_id
