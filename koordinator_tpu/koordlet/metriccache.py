@@ -30,6 +30,7 @@ BE_CPU_USAGE = "be_cpu_usage"
 SYS_CPU_USAGE = "sys_cpu_usage"
 SYS_MEMORY_USAGE = "sys_memory_usage"
 NODE_CPI_FIELD = "node_cpi"
+POD_CPI = "pod_cpi"                          # labels: pod_uid
 CONTAINER_CPI = "container_cpi"              # labels: pod_uid, container_id
 PSI_CPU_SOME_AVG10 = "psi_cpu_some_avg10"
 PSI_MEM_FULL_AVG10 = "psi_mem_full_avg10"

@@ -85,11 +85,24 @@ class _CgroupCPUTracker:
     def usage_cores(self, key: str, rel_dir: str, now: float) -> Optional[float]:
         try:
             if self.cfg.use_cgroup_v2:
-                stat = cg.parse_stat(cg.cgroup_read(cg.CPU_STAT, rel_dir, self.cfg))
-                cum_ns = stat.get("usage_usec", 0) * 1000
+                raw = cg.cgroup_read(cg.CPU_STAT, rel_dir, self.cfg)
             else:
-                cum_ns = int(cg.cgroup_read(cg.CPUACCT_USAGE, rel_dir, self.cfg))
-        except (OSError, ValueError):
+                raw = cg.cgroup_read(cg.CPUACCT_USAGE, rel_dir, self.cfg)
+        except OSError:
+            return None
+        return self.usage_cores_from_raw(key, raw, now)
+
+    def usage_cores_from_raw(self, key: str, raw: Optional[str],
+                             now: float) -> Optional[float]:
+        """Delta step over already-read file content (native batch path)."""
+        if raw is None:
+            return None
+        try:
+            if self.cfg.use_cgroup_v2:
+                cum_ns = cg.parse_stat(raw).get("usage_usec", 0) * 1000
+            else:
+                cum_ns = int(raw.strip())
+        except ValueError:
             return None
         last = self._last.get(key)
         self._last[key] = _CPUTick(now, cum_ns)
@@ -111,46 +124,71 @@ class PodResourceCollector:
     def __init__(self, deps: _Deps):
         self.d = deps
         self._cpu = _CgroupCPUTracker(deps.cfg)
+        #: (targets tuple) -> native.BatchReader, rebuilt on pod churn
+        self._reader_key: tuple = ()
+        self._reader = None
 
     def enabled(self) -> bool:
         return True
 
-    def _mem_bytes(self, rel_dir: str) -> Optional[float]:
-        try:
-            return float(cg.cgroup_read(cg.MEMORY_USAGE, rel_dir, self.d.cfg))
-        except (OSError, ValueError):
-            return None
-
-    def collect(self) -> None:
-        now = self.d.clock()
-        live: set[str] = set()
+    def _targets(self) -> list[tuple[str, dict, str, str]]:
+        """(key, labels, kind, abs path) for every file of every pod tick."""
+        cfg = self.d.cfg
+        cpu_res = cg.CPU_STAT if cfg.use_cgroup_v2 else cg.CPUACCT_USAGE
+        rows = []
         for pod in self.d.states.get_all_pods():
             if not pod.is_running:
                 continue
-            rel = pod.cgroup_dir(self.d.cfg)
-            live.add(pod.uid)
-            cores = self._cpu.usage_cores(pod.uid, rel, now)
+            rel = pod.cgroup_dir(cfg)
             labels = {"pod_uid": pod.uid}
-            if cores is not None:
-                self.d.cache.append(mc.POD_CPU_USAGE, cores, labels, ts=now)
-            mem = self._mem_bytes(rel)
-            if mem is not None:
-                self.d.cache.append(mc.POD_MEMORY_USAGE, mem, labels, ts=now)
+            rows.append((pod.uid, labels, "cpu", cg.resource_path(cpu_res, rel, cfg)))
+            rows.append((pod.uid, labels, "mem",
+                         cg.resource_path(cg.MEMORY_USAGE, rel, cfg)))
             for container in pod.containers:
                 ckey = f"{pod.uid}/{container.container_id}"
-                live.add(ckey)
-                crel = container.cgroup_dir or self.d.cfg.container_cgroup_dir(
+                crel = container.cgroup_dir or cfg.container_cgroup_dir(
                     pod.kube_qos, pod.uid, container.container_id
                 )
-                ccores = self._cpu.usage_cores(ckey, crel, now)
-                clabels = {"pod_uid": pod.uid, "container_id": container.container_id}
-                if ccores is not None:
-                    self.d.cache.append(mc.CONTAINER_CPU_USAGE, ccores, clabels, ts=now)
-                cmem = self._mem_bytes(crel)
-                if cmem is not None:
-                    self.d.cache.append(
-                        mc.CONTAINER_MEMORY_USAGE, cmem, clabels, ts=now
+                clabels = {"pod_uid": pod.uid,
+                           "container_id": container.container_id}
+                rows.append((ckey, clabels, "cpu",
+                             cg.resource_path(cpu_res, crel, cfg)))
+                rows.append((ckey, clabels, "mem",
+                             cg.resource_path(cg.MEMORY_USAGE, crel, cfg)))
+        return rows
+
+    def collect(self) -> None:
+        from koordinator_tpu import native
+
+        now = self.d.clock()
+        targets = self._targets()
+        key = tuple(t[3] for t in targets)
+        if key != self._reader_key:
+            self._reader = native.BatchReader(list(key))
+            self._reader_key = key
+        contents = self._reader.read() if targets else []
+
+        live: set[str] = set()
+        for (tkey, labels, kind, _), raw in zip(targets, contents):
+            live.add(tkey)
+            is_container = "container_id" in labels
+            if kind == "cpu":
+                cores = self._cpu.usage_cores_from_raw(tkey, raw, now)
+                if cores is not None:
+                    metric = (
+                        mc.CONTAINER_CPU_USAGE if is_container else mc.POD_CPU_USAGE
                     )
+                    self.d.cache.append(metric, cores, labels, ts=now)
+            elif raw is not None:
+                try:
+                    mem = float(raw.strip())
+                except ValueError:
+                    continue
+                metric = (
+                    mc.CONTAINER_MEMORY_USAGE if is_container
+                    else mc.POD_MEMORY_USAGE
+                )
+                self.d.cache.append(metric, mem, labels, ts=now)
         self._cpu.forget_missing(live)
 
 
@@ -305,6 +343,82 @@ class ColdMemoryCollector:
         self.d.cache.append(mc.COLD_PAGE_BYTES, float(total), ts=now)
 
 
+class CPICollector:
+    """Cycles-per-instruction per pod via the native perf shim
+    (collectors/performance — the libpfm perf-group path,
+    ``performance_collector_linux.go:101-110``). Gated on CPICollector and
+    on the native library + kernel perf actually working here."""
+
+    name = "cpi"
+
+    def __init__(self, deps: _Deps, n_cpus: int = 0):
+        self.d = deps
+        self.n_cpus = n_cpus or (os.cpu_count() or 1)
+        self._counters: dict[str, object] = {}
+        self._last: dict[str, tuple[int, int]] = {}
+
+    def enabled(self) -> bool:
+        from koordinator_tpu import native
+        from koordinator_tpu.features import KOORDLET_GATES
+
+        return KOORDLET_GATES.enabled("CPICollector") and native.available()
+
+    def _counter_for(self, key: str, rel: str) -> Optional[object]:
+        from koordinator_tpu import native
+
+        counter = self._counters.get(key)
+        if counter is None:
+            path = self.d.cfg.cgroup_abs_path("perf_event", rel)
+            counter = native.CPICounter(path, self.n_cpus)
+            if not counter.open():
+                counter = False  # mark unusable, don't retry every tick
+            self._counters[key] = counter
+        return counter or None
+
+    def _sample(self, key: str, rel: str, metric: str, labels: dict,
+                now: float) -> None:
+        counter = self._counter_for(key, rel)
+        if counter is None:
+            return
+        sample = counter.read()
+        if sample is None:
+            return
+        cycles, instructions = sample
+        last = self._last.get(key)
+        self._last[key] = (cycles, instructions)
+        if last is None:
+            return
+        d_cycles, d_instructions = cycles - last[0], instructions - last[1]
+        if d_instructions > 0:
+            self.d.cache.append(metric, d_cycles / d_instructions, labels, ts=now)
+
+    def collect(self) -> None:
+        now = self.d.clock()
+        live = set()
+        for pod in self.d.states.get_all_pods():
+            if not pod.is_running:
+                continue
+            live.add(pod.uid)
+            self._sample(pod.uid, pod.cgroup_dir(self.d.cfg), mc.POD_CPI,
+                         {"pod_uid": pod.uid}, now)
+            for container in pod.containers:
+                key = f"{pod.uid}/{container.container_id}"
+                live.add(key)
+                crel = container.cgroup_dir or self.d.cfg.container_cgroup_dir(
+                    pod.kube_qos, pod.uid, container.container_id
+                )
+                self._sample(
+                    key, crel, mc.CONTAINER_CPI,
+                    {"pod_uid": pod.uid, "container_id": container.container_id},
+                    now,
+                )
+        for key in [k for k in self._counters if k not in live]:
+            counter = self._counters.pop(key)
+            if counter:
+                counter.close()
+            self._last.pop(key, None)
+
+
 class HostApplicationCollector:
     """Usage of declared host applications (out-of-k8s daemons) by their
     cgroup dirs (collectors/hostapplication)."""
@@ -350,6 +464,7 @@ class MetricsAdvisor:
             PodThrottledCollector(deps),
             PSICollector(deps),
             ColdMemoryCollector(deps),
+            CPICollector(deps),
             HostApplicationCollector(deps, host_apps),
         ]
 

@@ -128,6 +128,8 @@ class MemoryEvict:
             if pod_mem <= 0:
                 # no sample yet: credit the declared request so a missing
                 # metric can't turn one needed eviction into evict-everything
-                pod_mem = int(pod.requests.get(ext.RESOURCE_BATCH_MEMORY, 0))
+                pod_mem = int(pod.requests.get(
+                    ext.RESOURCE_BATCH_MEMORY, pod.requests.get("memory", 0)
+                ))
             if self.evictor.evict(pod, "evictPodMemoryPressure"):
                 released += pod_mem

@@ -49,9 +49,13 @@ class NodeRecord:
     #: prod reclaimable from the usage forecaster (mid-resource input)
     prod_reclaimable_cpu_milli: int = 0
     prod_reclaimable_mem_mib: int = 0
-    #: last synced batch/mid allocatable (for diff-threshold suppression)
+    #: last synced values (for diff-threshold / no-op patch suppression)
     last_batch_cpu: int = -1
     last_batch_mem: int = -1
+    last_mid_cpu: int = -1
+    last_mid_mem: int = -1
+    last_device_resources: Optional[Mapping[str, int]] = None
+    last_degraded: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -195,14 +199,22 @@ class NodeResourceController:
             b_mem = 0 if degraded else int(batch_mem[i])
             m_cpu = 0 if degraded else int(mid_cpu[i])
             m_mem = 0 if degraded else int(mid_mem[i])
-            if not degraded and not self._needs_sync(record, b_cpu, b_mem):
+            devres = self._device_resources(record)
+            if degraded and record.last_degraded:
+                continue  # already zeroed; don't re-patch every tick
+            if not degraded and not self._needs_sync(
+                record, b_cpu, b_mem, m_cpu, m_mem, devres
+            ):
                 continue
             record.last_batch_cpu, record.last_batch_mem = b_cpu, b_mem
+            record.last_mid_cpu, record.last_mid_mem = m_cpu, m_mem
+            record.last_device_resources = dict(devres)
+            record.last_degraded = degraded
             patches.append(NodePatch(
                 name=record.name,
                 batch_cpu_milli=b_cpu, batch_mem_mib=b_mem,
                 mid_cpu_milli=m_cpu, mid_mem_mib=m_mem,
-                device_resources=self._device_resources(record),
+                device_resources=devres,
                 degraded=degraded,
             ))
         return patches
@@ -233,10 +245,16 @@ class NodeResourceController:
         age = now - record.metric.update_time
         return age > self.config.degrade_time_minutes * 60
 
-    def _needs_sync(self, record: NodeRecord, b_cpu: int, b_mem: int) -> bool:
+    def _needs_sync(self, record: NodeRecord, b_cpu: int, b_mem: int,
+                    m_cpu: int, m_mem: int,
+                    devres: Mapping[str, int]) -> bool:
         """diff-threshold suppression (isResourceDiff): skip the patch when
-        the relative change of every dimension is below the threshold."""
-        if record.last_batch_cpu < 0:
+        the relative change of every dimension is below the threshold and
+        mid/device resources are unchanged. A node recovering from degrade
+        always syncs."""
+        if record.last_batch_cpu < 0 or record.last_degraded:
+            return True
+        if record.last_device_resources != devres:
             return True
         threshold = self.config.resource_diff_threshold
 
@@ -246,8 +264,11 @@ class NodeResourceController:
             base = max(old, 1)
             return abs(new - old) / base > threshold
 
-        return differs(record.last_batch_cpu, b_cpu) or differs(
-            record.last_batch_mem, b_mem
+        return (
+            differs(record.last_batch_cpu, b_cpu)
+            or differs(record.last_batch_mem, b_mem)
+            or differs(record.last_mid_cpu, m_cpu)
+            or differs(record.last_mid_mem, m_mem)
         )
 
     def _device_resources(self, record: NodeRecord) -> dict[str, int]:

@@ -195,13 +195,15 @@ class PodValidatingWebhook:
                     f"container {container.get('name', '?')}: batch and native "
                     "resources must not be mixed"
                 )
-            req_b = requests.get(ext.RESOURCE_BATCH_CPU)
-            lim_b = limits.get(ext.RESOURCE_BATCH_CPU)
-            if req_b is not None and lim_b is not None and req_b != lim_b:
-                errors.append(
-                    f"container {container.get('name', '?')}: batch-cpu "
-                    "request must equal limit"
-                )
+            for resource, label in ((ext.RESOURCE_BATCH_CPU, "batch-cpu"),
+                                    (ext.RESOURCE_BATCH_MEMORY, "batch-memory")):
+                req_b = requests.get(resource)
+                lim_b = limits.get(resource)
+                if req_b is not None and lim_b is not None and req_b != lim_b:
+                    errors.append(
+                        f"container {container.get('name', '?')}: {label} "
+                        "request must equal limit"
+                    )
         return errors
 
 

@@ -1,0 +1,206 @@
+"""ctypes binding to libkoordsys (see ``native/koordsys.cpp``) — the native
+fast path for batched cgroup reads and perf-counter CPI, mirroring the
+reference's cgo touchpoints (libpfm perf groups, NVML).
+
+Loading order: prebuilt ``native/build/libkoordsys.so`` -> on-demand g++
+build into that location -> pure-Python fallback (``available() == False``;
+every caller has one). The build happens at most once per process.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO_ROOT, "native", "koordsys.cpp")
+_LIB_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LIB = os.path.join(_LIB_DIR, "libkoordsys.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+_build_thread: Optional[threading.Thread] = None
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-Wall", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Non-blocking: returns the lib if already loadable; if the .so is
+    missing, kicks the g++ build in a background thread and returns None —
+    callers use their Python fallback until the build lands. Use
+    :func:`ensure_built` to wait (tests, daemon init)."""
+    global _build_thread
+    if _lib is not None or _load_attempted:
+        return _lib
+    if os.path.exists(_LIB):
+        return _load_blocking()
+    with _lock:
+        if _build_thread is None:
+            _build_thread = threading.Thread(
+                target=_load_blocking, name="koordsys-build", daemon=True
+            )
+            _build_thread.start()
+    return None
+
+
+def ensure_built() -> bool:
+    """Blocking build+load; True when the native path is usable."""
+    return _load_blocking() is not None
+
+
+def _load_blocking() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    with _lock:
+        if _load_attempted:
+            return _lib
+        if not os.path.exists(_LIB) and not _build():
+            _load_attempted = True
+            return None
+        _load_attempted = True
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.ks_version.restype = ctypes.c_int
+        if lib.ks_version() != 1:
+            return None
+        lib.ks_batch_read.restype = ctypes.c_int
+        lib.ks_batch_read.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.ks_cpi_open.restype = ctypes.c_int
+        lib.ks_cpi_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.ks_cpi_read.restype = ctypes.c_int
+        lib.ks_cpi_read.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.POINTER(ctypes.c_ulonglong),
+        ]
+        lib.ks_cpi_close.restype = None
+        lib.ks_cpi_close.argtypes = [ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class BatchReader:
+    """Reads a fixed set of small files in one native pass per tick.
+
+    Collectors read the same cgroup files every tick, so the path array and
+    the result buffer are built once and reused — the per-call cost is one C
+    loop of open/read/close. (A naive per-call binding is slower than Python
+    IO: the ctypes marshalling dominates.)
+    """
+
+    def __init__(self, paths: Sequence[str], max_bytes: int = 4096):
+        self.paths = list(paths)
+        self.max_bytes = max_bytes
+        self._lib = _load()
+        n = len(self.paths)
+        if self._lib is not None and n:
+            self._c_paths = (ctypes.c_char_p * n)(
+                *[p.encode() for p in self.paths]
+            )
+            self._buf = ctypes.create_string_buffer(n * max_bytes)
+            self._sizes = (ctypes.c_long * n)()
+
+    def _read_python(self) -> list[Optional[str]]:
+        out: list[Optional[str]] = []
+        for path in self.paths:
+            try:
+                with open(path) as f:
+                    out.append(f.read(self.max_bytes))
+            except OSError:
+                out.append(None)
+        return out
+
+    def read(self) -> list[Optional[str]]:
+        """Current content of every file; None where unreadable."""
+        n = len(self.paths)
+        if n == 0:
+            return []
+        if self._lib is None:
+            return self._read_python()
+        rc = self._lib.ks_batch_read(
+            ctypes.cast(self._c_paths, ctypes.POINTER(ctypes.c_char_p)), n,
+            self._buf, self.max_bytes, self._sizes,
+        )
+        if rc < 0:  # non-Linux stub: sizes are not populated
+            self._lib = None
+            return self._read_python()
+        raw = self._buf.raw
+        out = []
+        for i in range(n):
+            size = self._sizes[i]
+            if size < 0:
+                out.append(None)
+            else:
+                start = i * self.max_bytes
+                out.append(raw[start: start + size].decode(errors="replace"))
+        return out
+
+
+def batch_read(paths: Sequence[str], max_bytes: int = 4096) -> list[Optional[str]]:
+    """One-shot convenience over :class:`BatchReader`."""
+    return BatchReader(paths, max_bytes).read()
+
+
+class CPICounter:
+    """Cycles/instructions counters for one cgroup (CPI collector source).
+
+    ``open()`` returns False where perf is unavailable (permissions,
+    container, non-Linux) — the CPI collector then disables itself, matching
+    the reference's Libpfm4 feature-gate behavior.
+    """
+
+    def __init__(self, cgroup_dir: str, n_cpus: int):
+        self.cgroup_dir = cgroup_dir
+        self.n_cpus = n_cpus
+        self._handle: Optional[int] = None
+
+    def open(self) -> bool:
+        lib = _load()
+        if lib is None:
+            return False
+        handle = lib.ks_cpi_open(self.cgroup_dir.encode(), self.n_cpus)
+        if handle < 0:
+            return False
+        self._handle = handle
+        return True
+
+    def read(self) -> Optional[tuple[int, int]]:
+        """(cycles, instructions) cumulative, or None."""
+        lib = _load()
+        if lib is None or self._handle is None:
+            return None
+        cycles = ctypes.c_ulonglong()
+        instructions = ctypes.c_ulonglong()
+        if lib.ks_cpi_read(self._handle, ctypes.byref(cycles),
+                           ctypes.byref(instructions)) != 0:
+            return None
+        return cycles.value, instructions.value
+
+    def close(self) -> None:
+        lib = _load()
+        if lib is not None and self._handle is not None:
+            lib.ks_cpi_close(self._handle)
+        self._handle = None

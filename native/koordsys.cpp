@@ -1,0 +1,211 @@
+// libkoordsys: the agent's native fast path.
+//
+// The reference's only native code is cgo bindings — NVML for GPU metrics and
+// libpfm4 for perf counters (pkg/koordlet/util/perf_group/
+// perf_group_linux.go:39-40, collector_gpu_linux.go). This library provides
+// the TPU-rebuild equivalents:
+//
+//   * ks_batch_read: one C pass reading hundreds of small cgroup/procfs files
+//     (the per-pod collector hot loop; Python open/read per file costs ~10x).
+//   * ks_cpi_*: perf_event_open cycles+instructions counters per cgroup, the
+//     CPI collector's data source (libpfm's role in the reference). Uses the
+//     raw syscall — no libpfm dependency.
+//
+// Everything degrades gracefully: callers treat any negative return as
+// "unsupported here" and fall back to the Python path.
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#ifdef __linux__
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/ioctl.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+#include <linux/perf_event.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Batched small-file read.
+//
+// paths:   n NUL-terminated file paths
+// buf:     n rows of stride bytes each; row i receives file i's content,
+//          NUL-terminated and truncated to stride-1
+// sizes:   out, per-file byte count or -errno
+// returns: number of files read successfully
+// ---------------------------------------------------------------------------
+int ks_batch_read(const char **paths, int n, char *buf, int stride,
+                  long *sizes) {
+#ifndef __linux__
+    (void)paths; (void)n; (void)buf; (void)stride; (void)sizes;
+    return -1;
+#else
+    int ok = 0;
+    for (int i = 0; i < n; i++) {
+        char *row = buf + (size_t)i * stride;
+        row[0] = '\0';
+        int fd = open(paths[i], O_RDONLY | O_CLOEXEC);
+        if (fd < 0) {
+            sizes[i] = -errno;
+            continue;
+        }
+        ssize_t total = 0;
+        for (;;) {
+            ssize_t got = read(fd, row + total, stride - 1 - total);
+            if (got < 0) {
+                if (errno == EINTR) continue;
+                total = -errno;
+                break;
+            }
+            if (got == 0 || total + got >= stride - 1) {
+                total += got;
+                break;
+            }
+            total += got;
+        }
+        close(fd);
+        if (total >= 0) {
+            row[total < stride - 1 ? total : stride - 1] = '\0';
+            sizes[i] = total;
+            ok++;
+        } else {
+            sizes[i] = total;
+        }
+    }
+    return ok;
+#endif
+}
+
+// ---------------------------------------------------------------------------
+// Cgroup CPI counters via perf_event_open.
+//
+// A handle owns, per online CPU, a cycles counter with an instructions
+// counter in the same event group (PERF_FLAG_PID_CGROUP scoping). Reads
+// return the summed deltas since open.
+// ---------------------------------------------------------------------------
+
+#define KS_MAX_HANDLES 256
+#define KS_MAX_CPUS 512
+
+struct ks_cpi_handle {
+    int used;
+    int n_cpus;
+    int cycles_fd[KS_MAX_CPUS];
+    int instructions_fd[KS_MAX_CPUS];
+};
+
+static ks_cpi_handle g_handles[KS_MAX_HANDLES];
+
+#ifdef __linux__
+static long perf_open(struct perf_event_attr *attr, int pid, int cpu,
+                      int group_fd, unsigned long flags) {
+    return syscall(SYS_perf_event_open, attr, pid, cpu, group_fd, flags);
+}
+#endif
+
+// Open counters for a cgroup (perf_cgroup path under the perf_event mount,
+// e.g. "/sys/fs/cgroup/perf_event/kubepods/pod1"). Returns handle id >= 0 or
+// -errno. n_cpus = number of online CPUs to instrument.
+int ks_cpi_open(const char *cgroup_dir, int n_cpus) {
+#ifndef __linux__
+    (void)cgroup_dir; (void)n_cpus;
+    return -38;  // -ENOSYS
+#else
+    if (n_cpus <= 0 || n_cpus > KS_MAX_CPUS) return -EINVAL;
+    int slot = -1;
+    for (int i = 0; i < KS_MAX_HANDLES; i++) {
+        if (!g_handles[i].used) { slot = i; break; }
+    }
+    if (slot < 0) return -EMFILE;
+
+    int cgroup_fd = open(cgroup_dir, O_RDONLY | O_DIRECTORY | O_CLOEXEC);
+    if (cgroup_fd < 0) return -errno;
+
+    ks_cpi_handle *h = &g_handles[slot];
+    memset(h, 0, sizeof(*h));
+    h->n_cpus = n_cpus;
+
+    struct perf_event_attr attr;
+    int opened = 0;
+    for (int cpu = 0; cpu < n_cpus; cpu++) {
+        memset(&attr, 0, sizeof(attr));
+        attr.size = sizeof(attr);
+        attr.type = PERF_TYPE_HARDWARE;
+        attr.config = PERF_COUNT_HW_CPU_CYCLES;
+        attr.disabled = 1;
+        attr.inherit = 1;
+        attr.exclude_kernel = 0;
+        long cfd = perf_open(&attr, cgroup_fd, cpu, -1, PERF_FLAG_PID_CGROUP);
+        if (cfd < 0) { h->cycles_fd[cpu] = -1; h->instructions_fd[cpu] = -1; continue; }
+
+        memset(&attr, 0, sizeof(attr));
+        attr.size = sizeof(attr);
+        attr.type = PERF_TYPE_HARDWARE;
+        attr.config = PERF_COUNT_HW_INSTRUCTIONS;
+        attr.disabled = 0;
+        attr.inherit = 1;
+        long ifd = perf_open(&attr, cgroup_fd, cpu, (int)cfd, PERF_FLAG_PID_CGROUP);
+        if (ifd < 0) { close((int)cfd); h->cycles_fd[cpu] = -1; h->instructions_fd[cpu] = -1; continue; }
+
+        h->cycles_fd[cpu] = (int)cfd;
+        h->instructions_fd[cpu] = (int)ifd;
+        ioctl((int)cfd, PERF_EVENT_IOC_ENABLE, 0);
+        opened++;
+    }
+    close(cgroup_fd);
+    if (opened == 0) return -EACCES;  // perf unavailable (permissions/kernel)
+    h->used = 1;
+    return slot;
+#endif
+}
+
+// Sum counters across CPUs. Returns 0 or -errno.
+int ks_cpi_read(int handle, unsigned long long *cycles,
+                unsigned long long *instructions) {
+#ifndef __linux__
+    (void)handle; (void)cycles; (void)instructions;
+    return -38;
+#else
+    if (handle < 0 || handle >= KS_MAX_HANDLES || !g_handles[handle].used)
+        return -EBADF;
+    ks_cpi_handle *h = &g_handles[handle];
+    unsigned long long c_total = 0, i_total = 0;
+    for (int cpu = 0; cpu < h->n_cpus; cpu++) {
+        unsigned long long v;
+        if (h->cycles_fd[cpu] >= 0 &&
+            read(h->cycles_fd[cpu], &v, sizeof(v)) == sizeof(v))
+            c_total += v;
+        if (h->instructions_fd[cpu] >= 0 &&
+            read(h->instructions_fd[cpu], &v, sizeof(v)) == sizeof(v))
+            i_total += v;
+    }
+    *cycles = c_total;
+    *instructions = i_total;
+    return 0;
+#endif
+}
+
+void ks_cpi_close(int handle) {
+#ifdef __linux__
+    if (handle < 0 || handle >= KS_MAX_HANDLES || !g_handles[handle].used)
+        return;
+    ks_cpi_handle *h = &g_handles[handle];
+    for (int cpu = 0; cpu < h->n_cpus; cpu++) {
+        if (h->cycles_fd[cpu] >= 0) close(h->cycles_fd[cpu]);
+        if (h->instructions_fd[cpu] >= 0) close(h->instructions_fd[cpu]);
+    }
+    h->used = 0;
+#else
+    (void)handle;
+#endif
+}
+
+// Library self-check (Python binding probes this at load).
+int ks_version(void) { return 1; }
+
+}  // extern "C"

@@ -19,3 +19,9 @@ if not os.environ.get("KOORD_TEST_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+# Build the native shim once up front so collector tests exercise the C path
+# (lazy loading would otherwise race the background build).
+from koordinator_tpu import native as _native  # noqa: E402
+
+_native.ensure_built()

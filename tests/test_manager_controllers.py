@@ -348,3 +348,35 @@ class TestQuotaProfile:
         assert quotas[0].name == "batch-root"
         assert quotas[0].min == {"cpu": 32000 * 90 // 100}
         assert quotas[0].tree_id
+
+
+class TestSyncSuppressionExtended:
+    def test_device_change_triggers_sync(self):
+        clock = FakeClock()
+        controller = NodeResourceController(
+            sloconfig.ColocationConfig(enable=True), clock=clock
+        )
+        record = make_record(now=clock.t)
+        assert len(controller.reconcile([record])) == 1
+        assert controller.reconcile([record]) == []  # stable
+        record.device = crds.Device(node_name="n1", devices=(
+            crds.DeviceInfo(type="gpu", minor=0),
+        ))
+        patches = controller.reconcile([record])
+        assert len(patches) == 1 and patches[0].device_resources
+
+    def test_degraded_patched_once(self):
+        clock = FakeClock()
+        config = sloconfig.ColocationConfig(enable=True, degrade_time_minutes=15)
+        controller = NodeResourceController(config, clock=clock)
+        record = make_record(now=clock.t, metric_age=16 * 60)
+        assert len(controller.reconcile([record])) == 1
+        assert controller.reconcile([record]) == []  # no re-patch churn
+        # recovery: fresh metric -> syncs again
+        record.metric = crds.NodeMetricStatus(
+            update_time=clock.t,
+            node_usage=crds.ResourceUsage(cpu_milli=7000, memory_bytes=8192 * MIB),
+            system_usage=crds.ResourceUsage(cpu_milli=1000, memory_bytes=2048 * MIB),
+        )
+        patches = controller.reconcile([record])
+        assert len(patches) == 1 and not patches[0].degraded
