@@ -1,0 +1,132 @@
+"""koord-device-daemon: heterogeneous device reporter (reference:
+``cmd/koord-device-daemon``, ``pkg/device-daemon/`` — produces per-node
+Device info: GPU partitions, NUMA topology, health).
+
+Probers are pluggable (the reference uses NVML/ghw; this environment probes
+sysfs and supports TPU chips as first-class accelerators). The daemon merges
+prober outputs into one :class:`~koordinator_tpu.api.crds.Device` CR and the
+GPU partition templates consumed by the deviceshare scheduler plugin.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Optional, Protocol
+
+from koordinator_tpu.api import crds, extension as ext
+
+
+class DeviceProber(Protocol):
+    def probe(self) -> list[crds.DeviceInfo]: ...
+
+
+class SysfsGPUProber:
+    """NVIDIA device discovery from sysfs (the NVML-less fallback path);
+    real deployments swap in an NVML-backed prober."""
+
+    def __init__(self, sys_root: str = "/sys"):
+        self.sys_root = sys_root
+
+    def probe(self) -> list[crds.DeviceInfo]:
+        out = []
+        pattern = os.path.join(
+            self.sys_root, "bus", "pci", "drivers", "nvidia", "0000:*"
+        )
+        for i, pci_dir in enumerate(sorted(glob.glob(pattern))):
+            busid = os.path.basename(pci_dir)
+            numa = -1
+            try:
+                with open(os.path.join(pci_dir, "numa_node")) as f:
+                    numa = int(f.read().strip())
+            except (OSError, ValueError):
+                pass
+            out.append(crds.DeviceInfo(
+                type="gpu", minor=i, busid=busid, numa_node=numa,
+                resources={ext.RESOURCE_GPU_CORE: 100,
+                           ext.RESOURCE_GPU_MEMORY_RATIO: 100},
+            ))
+        return out
+
+
+class TPUProber:
+    """TPU chips as schedulable accelerators (accel sysfs class)."""
+
+    def __init__(self, sys_root: str = "/sys"):
+        self.sys_root = sys_root
+
+    def probe(self) -> list[crds.DeviceInfo]:
+        out = []
+        for i, dev in enumerate(sorted(
+            glob.glob(os.path.join(self.sys_root, "class", "accel", "accel*"))
+        )):
+            out.append(crds.DeviceInfo(
+                type="xpu", minor=i, uuid=os.path.basename(dev),
+                labels={"xpu.vendor": "tpu"},
+            ))
+        return out
+
+
+class RDMAProber:
+    """RDMA NICs from sysfs infiniband class."""
+
+    def __init__(self, sys_root: str = "/sys"):
+        self.sys_root = sys_root
+
+    def probe(self) -> list[crds.DeviceInfo]:
+        out = []
+        for i, dev in enumerate(sorted(
+            glob.glob(os.path.join(self.sys_root, "class", "infiniband", "*"))
+        )):
+            name = os.path.basename(dev)
+            numa = -1
+            try:
+                with open(os.path.join(dev, "device", "numa_node")) as f:
+                    numa = int(f.read().strip())
+            except (OSError, ValueError):
+                pass
+            out.append(crds.DeviceInfo(
+                type="rdma", minor=i, uuid=name, numa_node=numa,
+            ))
+        return out
+
+
+#: GPU partition templates (gpu_shared_resource_templates): the allowed
+#: fractional slices of one physical GPU, keyed by template name.
+DEFAULT_GPU_PARTITION_TEMPLATES: dict[str, dict[str, int]] = {
+    "1/8": {ext.RESOURCE_GPU_CORE: 12, ext.RESOURCE_GPU_MEMORY_RATIO: 12},
+    "1/4": {ext.RESOURCE_GPU_CORE: 25, ext.RESOURCE_GPU_MEMORY_RATIO: 25},
+    "1/2": {ext.RESOURCE_GPU_CORE: 50, ext.RESOURCE_GPU_MEMORY_RATIO: 50},
+    "full": {ext.RESOURCE_GPU_CORE: 100, ext.RESOURCE_GPU_MEMORY_RATIO: 100},
+}
+
+
+class DeviceDaemon:
+    def __init__(self, node_name: str,
+                 probers: Optional[list[DeviceProber]] = None,
+                 sys_root: str = "/sys"):
+        self.node_name = node_name
+        self.probers = probers if probers is not None else [
+            SysfsGPUProber(sys_root), TPUProber(sys_root), RDMAProber(sys_root),
+        ]
+
+    def collect(self) -> crds.Device:
+        """One reporting pass: merge all probers into the Device CR."""
+        devices: list[crds.DeviceInfo] = []
+        for prober in self.probers:
+            try:
+                devices.extend(prober.probe())
+            except OSError:
+                continue
+        import json
+
+        annotations = {}
+        if any(d.type == "gpu" for d in devices):
+            annotations["scheduling.koordinator.sh/gpu-partitions"] = json.dumps(
+                DEFAULT_GPU_PARTITION_TEMPLATES, sort_keys=True
+            )
+        return crds.Device(
+            node_name=self.node_name,
+            devices=tuple(devices),
+            annotations=annotations,
+        )

@@ -9,6 +9,7 @@ shared resource executor; the PLEG nudges reconciliation on pod churn.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Optional
@@ -76,6 +77,17 @@ class Daemon:
         self.hook_reconciler = Reconciler(
             self.states, self.hook_registry, self.executor, self.cfg
         )
+        from koordinator_tpu.koordlet.prediction_server import PredictServer
+
+        self.predict_server = PredictServer(
+            self.states, self.metric_cache,
+            checkpoint_dir=(
+                os.path.join(self.cfg.var_run_root, "prediction-checkpoints")
+            ),
+            clock=clock,
+        )
+        self._last_train = 0.0
+        self.train_interval_seconds = 60.0
         self.pleg = PLEG(self.cfg)
         self.pleg.add_handler(lambda event: self._on_pleg_event(event))
         self._pleg_dirty = False
@@ -109,6 +121,10 @@ class Daemon:
             writes = self.hook_reconciler.reconcile_once()
             self._pleg_dirty = False
             self._last_hook_reconcile = now
+        if now - self._last_train >= self.train_interval_seconds:
+            self.predict_server.gc()
+            self.predict_server.train_once()
+            self._last_train = now
         return {
             "collected": collected,
             "strategies": strategies,

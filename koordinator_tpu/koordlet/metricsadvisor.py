@@ -350,12 +350,19 @@ class CPICollector:
     on the native library + kernel perf actually working here."""
 
     name = "cpi"
+    #: cap on perf fds this collector may hold (each counter costs
+    #: 2*n_cpus fds; unbounded growth would exhaust RLIMIT_NOFILE and take
+    #: the whole agent's file IO down with it)
+    FD_BUDGET = 512
 
     def __init__(self, deps: _Deps, n_cpus: int = 0):
         self.d = deps
         self.n_cpus = n_cpus or (os.cpu_count() or 1)
         self._counters: dict[str, object] = {}
         self._last: dict[str, tuple[int, int]] = {}
+
+    def _open_counters(self) -> int:
+        return sum(1 for c in self._counters.values() if c)
 
     def enabled(self) -> bool:
         from koordinator_tpu import native
@@ -368,6 +375,10 @@ class CPICollector:
 
         counter = self._counters.get(key)
         if counter is None:
+            fds_needed = 2 * self.n_cpus
+            if (self._open_counters() + 1) * fds_needed > self.FD_BUDGET:
+                return None  # over budget: skip WITHOUT caching, so a freed
+                             # slot (pod deletion) lets this pod in later
             path = self.d.cfg.cgroup_abs_path("perf_event", rel)
             counter = native.CPICounter(path, self.n_cpus)
             if not counter.open():

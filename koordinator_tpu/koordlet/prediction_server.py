@@ -1,0 +1,252 @@
+"""PredictServer: the agent's usage forecaster (reference:
+``pkg/koordlet/prediction/predict_server.go`` — ``PredictServer`` :65,
+``training()`` :139, ``doCheckpoint`` :307, ``restoreModels`` :358;
+``peak_predictor.go`` cold-start + safety margin).
+
+TPU-native redesign: instead of one Go histogram object per UID, ALL models
+live in two :class:`~koordinator_tpu.prediction.histogram.HistogramBank`
+matrices (cpu milli-cores, memory MiB). A training tick gathers the latest
+samples for every tracked UID from the metric cache and scatter-adds them in
+one jitted call; p95/p98 queries answer every model at once. Checkpointing
+writes the banks + the uid->row map; restore reloads both.
+
+Tracked UIDs: ``node``, ``sys``, every pod uid, and the four priority-band
+aggregates (prod/mid/batch/free) the mid-resource plugin consumes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.priority import PriorityClass, priority_class_of
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.prediction import histogram as hist
+
+UID_NODE = "node"
+UID_SYS = "sys"
+BAND_UIDS = {
+    PriorityClass.PROD: "band/prod",
+    PriorityClass.MID: "band/mid",
+    PriorityClass.BATCH: "band/batch",
+    PriorityClass.FREE: "band/free",
+}
+MIB = 1 << 20
+
+#: cold start: models need this much observation time before being trusted
+COLD_START_SECONDS = 15 * 60
+#: safety margin applied to peaks (peak_predictor.go DefaultSafetyMarginPercent)
+SAFETY_MARGIN_PCT = 10
+
+
+class PredictServer:
+    def __init__(
+        self,
+        states: StatesInformer,
+        cache: mc.MetricCache,
+        checkpoint_dir: Optional[str] = None,
+        capacity: int = 512,
+        half_life_sec: float = 24 * 3600.0,
+        checkpoint_interval_sec: float = 600.0,
+        clock=time.time,
+    ):
+        self.states = states
+        self.cache = cache
+        self.checkpoint_dir = checkpoint_dir
+        self.clock = clock
+        self.capacity = capacity
+        self.checkpoint_interval_sec = checkpoint_interval_sec
+        self.cpu_buckets = hist.default_cpu_buckets()
+        self.mem_buckets = hist.default_memory_buckets()
+        self.cpu_bank = hist.HistogramBank.zeros(capacity, self.cpu_buckets,
+                                                 half_life_sec)
+        self.mem_bank = hist.HistogramBank.zeros(capacity, self.mem_buckets,
+                                                 half_life_sec)
+        self._rows: dict[str, int] = {}
+        self._first_seen: dict[str, float] = {}
+        self._free_rows: list[int] = list(range(capacity - 1, -1, -1))
+        self._last_checkpoint = 0.0
+        if checkpoint_dir:
+            self.restore()
+
+    # -- row management ------------------------------------------------------
+
+    def _row_of(self, uid: str, now: float) -> Optional[int]:
+        row = self._rows.get(uid)
+        if row is not None:
+            return row
+        if not self._free_rows:
+            return None  # bank full: drop new models (reference logs + skips)
+        row = self._free_rows.pop()
+        self._rows[uid] = row
+        self._first_seen[uid] = now
+        # clear any stale weights left by a previous occupant of this row
+        self.cpu_bank = self.cpu_bank.replace(
+            weights=self.cpu_bank.weights.at[row].set(0.0),
+            total=self.cpu_bank.total.at[row].set(0.0),
+        )
+        self.mem_bank = self.mem_bank.replace(
+            weights=self.mem_bank.weights.at[row].set(0.0),
+            total=self.mem_bank.total.at[row].set(0.0),
+        )
+        return row
+
+    def gc(self) -> int:
+        """Release rows of pods that no longer exist."""
+        live = {p.uid for p in self.states.get_all_pods()}
+        keep = {UID_NODE, UID_SYS, *BAND_UIDS.values()}
+        stale = [u for u in self._rows if u not in live and u not in keep]
+        for uid in stale:
+            self._free_rows.append(self._rows.pop(uid))
+            self._first_seen.pop(uid, None)
+        return len(stale)
+
+    # -- training ------------------------------------------------------------
+
+    def train_once(self) -> int:
+        """One training tick: feed the latest sample of every tracked UID.
+        Returns the number of samples ingested."""
+        now = self.clock()
+        window = 120.0
+        uids: list[int] = []
+        cpu_vals: list[float] = []
+        mem_vals: list[float] = []
+        band_cpu: dict[str, float] = {u: 0.0 for u in BAND_UIDS.values()}
+        band_mem: dict[str, float] = {u: 0.0 for u in BAND_UIDS.values()}
+
+        def push(uid: str, cpu_milli: float, mem_mib: float):
+            row = self._row_of(uid, now)
+            if row is None:
+                return
+            uids.append(row)
+            cpu_vals.append(cpu_milli)
+            mem_vals.append(mem_mib)
+
+        node_cpu = self.cache.query(mc.NODE_CPU_USAGE, None, now - window, now)
+        node_mem = self.cache.query(mc.NODE_MEMORY_USAGE, None, now - window, now)
+        if not node_cpu.empty:
+            push(UID_NODE, node_cpu.latest() * 1000.0, node_mem.latest() / MIB)
+        sys_cpu = self.cache.query(mc.SYS_CPU_USAGE, None, now - window, now)
+        sys_mem = self.cache.query(mc.SYS_MEMORY_USAGE, None, now - window, now)
+        if not sys_cpu.empty:
+            push(UID_SYS, sys_cpu.latest() * 1000.0, sys_mem.latest() / MIB)
+
+        for pod in self.states.get_all_pods():
+            if not pod.is_running:
+                continue
+            labels = {"pod_uid": pod.uid}
+            cpu = self.cache.query(mc.POD_CPU_USAGE, labels, now - window, now)
+            mem = self.cache.query(mc.POD_MEMORY_USAGE, labels, now - window, now)
+            if cpu.empty and mem.empty:
+                continue
+            cpu_milli = cpu.latest() * 1000.0
+            mem_mib = mem.latest() / MIB
+            push(pod.uid, cpu_milli, mem_mib)
+            band = BAND_UIDS.get(priority_class_of(pod.priority))
+            if band:
+                band_cpu[band] += cpu_milli
+                band_mem[band] += mem_mib
+
+        for band_uid in BAND_UIDS.values():
+            if band_cpu[band_uid] > 0 or band_mem[band_uid] > 0:
+                push(band_uid, band_cpu[band_uid], band_mem[band_uid])
+
+        if not uids:
+            return 0
+        rows = jnp.asarray(np.asarray(uids, np.int32))
+        t = jnp.float32(now)
+        self.cpu_bank = hist.add_samples(
+            self.cpu_bank, self.cpu_buckets, rows,
+            jnp.asarray(np.asarray(cpu_vals, np.float32)), t,
+        )
+        self.mem_bank = hist.add_samples(
+            self.mem_bank, self.mem_buckets, rows,
+            jnp.asarray(np.asarray(mem_vals, np.float32)), t,
+        )
+        if (self.checkpoint_dir
+                and now - self._last_checkpoint >= self.checkpoint_interval_sec):
+            self.checkpoint()
+            self._last_checkpoint = now
+        return len(uids)
+
+    # -- prediction ----------------------------------------------------------
+
+    def peak(self, uid: str, p: float = 0.95,
+             safety_margin_pct: int = SAFETY_MARGIN_PCT
+             ) -> Optional[tuple[int, int]]:
+        """(cpu milli, mem MiB) predicted peak, or None (unknown/cold)."""
+        row = self._rows.get(uid)
+        if row is None:
+            return None
+        if self.clock() - self._first_seen.get(uid, 0.0) < COLD_START_SECONDS:
+            return None
+        cpu = float(hist.percentile(self.cpu_bank, self.cpu_buckets, p)[row])
+        mem = float(hist.percentile(self.mem_bank, self.mem_buckets, p)[row])
+        scale = 1.0 + safety_margin_pct / 100.0
+        return int(cpu * scale), int(mem * scale)
+
+    def prod_reclaimable(self) -> tuple[int, int]:
+        """The mid-resource input: prod band peak p95 vs current usage —
+        what prod pods are very unlikely to take back (midresource plugin)."""
+        peak = self.peak(BAND_UIDS[PriorityClass.PROD], p=0.98)
+        if peak is None:
+            return 0, 0
+        now = self.clock()
+        used_cpu = used_mem = 0.0
+        for pod in self.states.get_all_pods():
+            if priority_class_of(pod.priority) is not PriorityClass.PROD:
+                continue
+            labels = {"pod_uid": pod.uid}
+            used_cpu += self.cache.query(
+                mc.POD_CPU_USAGE, labels, now - 120, now).latest() * 1000.0
+            used_mem += self.cache.query(
+                mc.POD_MEMORY_USAGE, labels, now - 120, now).latest() / MIB
+        # reclaimable = current allocation beyond the predicted peak; callers
+        # combine with requests. Negative clamps to 0.
+        return (max(0, int(used_cpu - peak[0])), max(0, int(used_mem - peak[1])))
+
+    # -- checkpoint / restore -------------------------------------------------
+
+    def checkpoint(self) -> None:
+        assert self.checkpoint_dir
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        hist.save_bank(self.cpu_bank, os.path.join(self.checkpoint_dir, "cpu.npz"))
+        hist.save_bank(self.mem_bank, os.path.join(self.checkpoint_dir, "mem.npz"))
+        meta = {
+            "rows": self._rows,
+            "first_seen": self._first_seen,
+        }
+        tmp = os.path.join(self.checkpoint_dir, "meta.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(self.checkpoint_dir, "meta.json"))
+
+    def restore(self) -> bool:
+        try:
+            cpu_path = os.path.join(self.checkpoint_dir, "cpu.npz")
+            meta_path = os.path.join(self.checkpoint_dir, "meta.json")
+            if not (os.path.exists(cpu_path) and os.path.exists(meta_path)):
+                return False
+            self.cpu_bank = hist.load_bank(cpu_path)
+            self.mem_bank = hist.load_bank(
+                os.path.join(self.checkpoint_dir, "mem.npz")
+            )
+            with open(meta_path) as f:
+                meta = json.load(f)
+            self._rows = {k: int(v) for k, v in meta["rows"].items()}
+            self._first_seen = {
+                k: float(v) for k, v in meta.get("first_seen", {}).items()
+            }
+            used = set(self._rows.values())
+            self._free_rows = [
+                r for r in range(self.capacity - 1, -1, -1) if r not in used
+            ]
+            return True
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            return False

@@ -201,8 +201,11 @@ class NodeResourceController:
             m_mem = 0 if degraded else int(mid_mem[i])
             devres = self._device_resources(record)
             if degraded and record.last_degraded:
-                continue  # already zeroed; don't re-patch every tick
-            if not degraded and not self._needs_sync(
+                # already zeroed — but device info comes from the Device CR,
+                # independent of metric freshness, so device changes still sync
+                if record.last_device_resources == devres:
+                    continue
+            elif not degraded and not self._needs_sync(
                 record, b_cpu, b_mem, m_cpu, m_mem, devres
             ):
                 continue

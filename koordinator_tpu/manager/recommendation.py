@@ -1,0 +1,93 @@
+"""Recommendation controller (reference: ``apis/analysis/v1alpha1/
+recommendation_types.go:96`` + the koord-manager recommender): VPA-style
+per-workload resource recommendations from decaying usage histograms.
+
+One HistogramBank row per workload; samples arrive as (workload, cpu, mem)
+observations (fed from NodeMetric pod metrics); the recommendation is
+p90 * (1 + margin) — all workloads answered in one tensor query.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api import crds
+from koordinator_tpu.prediction import histogram as hist
+
+MIB = 1 << 20
+
+
+class RecommendationController:
+    def __init__(self, capacity: int = 1024, half_life_sec: float = 24 * 3600.0,
+                 percentile: float = 0.9, margin_pct: int = 15, clock=time.time):
+        self.cpu_buckets = hist.default_cpu_buckets()
+        self.mem_buckets = hist.default_memory_buckets()
+        self.cpu_bank = hist.HistogramBank.zeros(capacity, self.cpu_buckets,
+                                                 half_life_sec)
+        self.mem_bank = hist.HistogramBank.zeros(capacity, self.mem_buckets,
+                                                 half_life_sec)
+        self.percentile = percentile
+        self.margin_pct = margin_pct
+        self.clock = clock
+        self._rows: dict[str, int] = {}
+        self._free = list(range(capacity - 1, -1, -1))
+
+    def _row(self, workload: str) -> Optional[int]:
+        row = self._rows.get(workload)
+        if row is None and self._free:
+            row = self._free.pop()
+            self._rows[workload] = row
+        return row
+
+    def observe(self, samples: list[tuple[str, float, float]],
+                ts: Optional[float] = None) -> None:
+        """samples: (workload_ref, cpu_milli, mem_mib) per pod observation."""
+        rows, cpus, mems = [], [], []
+        for workload, cpu, mem in samples:
+            row = self._row(workload)
+            if row is None:
+                continue
+            rows.append(row)
+            cpus.append(cpu)
+            mems.append(mem)
+        if not rows:
+            return
+        t = jnp.float32(self.clock() if ts is None else ts)
+        r = jnp.asarray(np.asarray(rows, np.int32))
+        self.cpu_bank = hist.add_samples(
+            self.cpu_bank, self.cpu_buckets, r,
+            jnp.asarray(np.asarray(cpus, np.float32)), t,
+        )
+        self.mem_bank = hist.add_samples(
+            self.mem_bank, self.mem_buckets, r,
+            jnp.asarray(np.asarray(mems, np.float32)), t,
+        )
+
+    def recommend_all(self) -> list[crds.Recommendation]:
+        """One tensor pass over every workload's histograms."""
+        if not self._rows:
+            return []
+        cpu_p = np.asarray(
+            hist.percentile(self.cpu_bank, self.cpu_buckets, self.percentile)
+        )
+        mem_p = np.asarray(
+            hist.percentile(self.mem_bank, self.mem_buckets, self.percentile)
+        )
+        scale = 1.0 + self.margin_pct / 100.0
+        now = self.clock()
+        out = []
+        for workload, row in sorted(self._rows.items()):
+            if cpu_p[row] <= 0 and mem_p[row] <= 0:
+                continue
+            out.append(crds.Recommendation(
+                name=workload.replace("/", "-"),
+                workload_ref=workload,
+                target_cpu_milli=int(cpu_p[row] * scale),
+                target_memory_bytes=int(mem_p[row] * scale) * MIB,
+                update_time=now,
+            ))
+        return out

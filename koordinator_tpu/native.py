@@ -43,8 +43,9 @@ def _build() -> bool:
 def _load() -> Optional[ctypes.CDLL]:
     """Non-blocking: returns the lib if already loadable; if the .so is
     missing, kicks the g++ build in a background thread and returns None —
-    callers use their Python fallback until the build lands. Use
-    :func:`ensure_built` to wait (tests, daemon init)."""
+    callers use their Python fallback until the build lands. Never waits on
+    a running build (the lock is only held for the quick dlopen, not the
+    compile). Use :func:`ensure_built` to wait (tests, daemon init)."""
     global _build_thread
     if _lib is not None or _load_attempted:
         return _lib
@@ -66,12 +67,21 @@ def ensure_built() -> bool:
 
 def _load_blocking() -> Optional[ctypes.CDLL]:
     global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    # Compile OUTSIDE the lock: concurrent _load() calls must keep returning
+    # their fallback instantly instead of queueing behind a 2-minute g++ run.
+    if not os.path.exists(_LIB):
+        built = _build()
+        with _lock:
+            if _load_attempted:
+                return _lib
+            if not built:
+                _load_attempted = True
+                return None
     with _lock:
         if _load_attempted:
             return _lib
-        if not os.path.exists(_LIB) and not _build():
-            _load_attempted = True
-            return None
         _load_attempted = True
         try:
             lib = ctypes.CDLL(_LIB)
@@ -115,12 +125,17 @@ class BatchReader:
         self.paths = list(paths)
         self.max_bytes = max_bytes
         self._lib = _load()
+        self._native_dead = False  # set when the lib stub rejects reads
+        if self._lib is not None:
+            self._marshal()
+
+    def _marshal(self) -> None:
         n = len(self.paths)
-        if self._lib is not None and n:
+        if n:
             self._c_paths = (ctypes.c_char_p * n)(
                 *[p.encode() for p in self.paths]
             )
-            self._buf = ctypes.create_string_buffer(n * max_bytes)
+            self._buf = ctypes.create_string_buffer(n * self.max_bytes)
             self._sizes = (ctypes.c_long * n)()
 
     def _read_python(self) -> list[Optional[str]]:
@@ -139,13 +154,21 @@ class BatchReader:
         if n == 0:
             return []
         if self._lib is None:
-            return self._read_python()
+            if self._native_dead:
+                return self._read_python()
+            # the background build may have landed since construction —
+            # re-probe so a long-lived reader upgrades to the native path
+            self._lib = _load()
+            if self._lib is None:
+                return self._read_python()
+            self._marshal()
         rc = self._lib.ks_batch_read(
             ctypes.cast(self._c_paths, ctypes.POINTER(ctypes.c_char_p)), n,
             self._buf, self.max_bytes, self._sizes,
         )
         if rc < 0:  # non-Linux stub: sizes are not populated
             self._lib = None
+            self._native_dead = True
             return self._read_python()
         raw = self._buf.raw
         out = []

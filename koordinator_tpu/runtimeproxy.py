@@ -1,0 +1,172 @@
+"""koord-runtime-proxy: CRI-interposing proxy (reference:
+``pkg/runtimeproxy/`` — gRPC service ``apis/runtime/v1alpha1/api.proto:148``
+PreRunPodSandboxHook/PreCreateContainerHook/..., dispatcher
+``dispatcher/dispatcher.go``, failover store ``store/``).
+
+The legacy path for runtimes without NRI: kubelet's CRI calls pass through
+this proxy, which consults registered hook servers before/after forwarding to
+the real runtime. Transport here is in-process callables (the gRPC framing is
+a deployment detail); semantics preserved:
+
+- **fail-open dispatch**: a hook server error never blocks the CRI call —
+  the request passes through unmodified (dispatcher.go behavior).
+- **hook response merging**: hook servers return partial updates (labels,
+  annotations, cgroup parent, resources, envs) merged into the CRI request.
+- **failover store**: pod/container metadata recorded at creation so hooks
+  can rebuild context after proxy restart.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import Callable, Optional, Protocol
+
+
+class HookType(enum.Enum):
+    PRE_RUN_POD_SANDBOX = "PreRunPodSandbox"
+    PRE_CREATE_CONTAINER = "PreCreateContainer"
+    PRE_START_CONTAINER = "PreStartContainer"
+    POST_START_CONTAINER = "PostStartContainer"
+    PRE_UPDATE_CONTAINER_RESOURCES = "PreUpdateContainerResources"
+    POST_STOP_POD_SANDBOX = "PostStopPodSandbox"
+
+
+@dataclasses.dataclass
+class HookRequest:
+    """The CRI-call context handed to hook servers (api.proto shapes)."""
+
+    pod_meta: dict = dataclasses.field(default_factory=dict)
+    container_meta: dict = dataclasses.field(default_factory=dict)
+    labels: dict = dataclasses.field(default_factory=dict)
+    annotations: dict = dataclasses.field(default_factory=dict)
+    cgroup_parent: str = ""
+    resources: dict = dataclasses.field(default_factory=dict)
+    envs: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class HookResponse:
+    """Partial updates to merge back into the CRI request."""
+
+    labels: dict = dataclasses.field(default_factory=dict)
+    annotations: dict = dataclasses.field(default_factory=dict)
+    cgroup_parent: str = ""
+    resources: dict = dataclasses.field(default_factory=dict)
+    envs: dict = dataclasses.field(default_factory=dict)
+
+
+class HookServer(Protocol):
+    def handle(self, hook: HookType, request: HookRequest) -> Optional[HookResponse]: ...
+
+
+class Dispatcher:
+    """Routes hooks to registered servers, fail-open (dispatcher.go)."""
+
+    def __init__(self):
+        self._servers: dict[HookType, list[HookServer]] = {t: [] for t in HookType}
+        self._lock = threading.Lock()
+
+    def register(self, server: HookServer, hooks: list[HookType]) -> None:
+        with self._lock:
+            for hook in hooks:
+                self._servers[hook].append(server)
+
+    def dispatch(self, hook: HookType, request: HookRequest) -> HookRequest:
+        with self._lock:
+            servers = list(self._servers[hook])
+        for server in servers:
+            try:
+                response = server.handle(hook, request)
+            except Exception:  # noqa: BLE001 — fail-open by contract
+                continue
+            if response is None:
+                continue
+            request.labels.update(response.labels)
+            request.annotations.update(response.annotations)
+            if response.cgroup_parent:
+                request.cgroup_parent = response.cgroup_parent
+            request.resources.update(response.resources)
+            request.envs.update(response.envs)
+        return request
+
+
+class FailoverStore:
+    """Pod/container metadata persisted across proxy restarts (store/)."""
+
+    def __init__(self):
+        self._pods: dict[str, HookRequest] = {}
+        self._containers: dict[str, HookRequest] = {}
+        self._lock = threading.Lock()
+
+    def save_pod(self, pod_id: str, request: HookRequest) -> None:
+        with self._lock:
+            self._pods[pod_id] = request
+
+    def save_container(self, container_id: str, request: HookRequest) -> None:
+        with self._lock:
+            self._containers[container_id] = request
+
+    def get_pod(self, pod_id: str) -> Optional[HookRequest]:
+        with self._lock:
+            return self._pods.get(pod_id)
+
+    def get_container(self, container_id: str) -> Optional[HookRequest]:
+        with self._lock:
+            return self._containers.get(container_id)
+
+    def delete_pod(self, pod_id: str) -> None:
+        with self._lock:
+            self._pods.pop(pod_id, None)
+
+    def delete_container(self, container_id: str) -> None:
+        with self._lock:
+            self._containers.pop(container_id, None)
+
+
+class CRIProxy:
+    """The interposer: hook -> forward -> hook for each CRI call
+    (server/cri/criserver.go). ``backend`` is the real runtime's method table:
+    a dict of callables keyed by CRI method name."""
+
+    def __init__(self, dispatcher: Dispatcher, store: FailoverStore,
+                 backend: dict[str, Callable]):
+        self.dispatcher = dispatcher
+        self.store = store
+        self.backend = backend
+
+    def _forward(self, method: str, request: HookRequest):
+        fn = self.backend.get(method)
+        return fn(request) if fn else None
+
+    def run_pod_sandbox(self, pod_id: str, request: HookRequest):
+        request = self.dispatcher.dispatch(HookType.PRE_RUN_POD_SANDBOX, request)
+        self.store.save_pod(pod_id, request)
+        return self._forward("RunPodSandbox", request)
+
+    def create_container(self, container_id: str, request: HookRequest):
+        request = self.dispatcher.dispatch(HookType.PRE_CREATE_CONTAINER, request)
+        self.store.save_container(container_id, request)
+        return self._forward("CreateContainer", request)
+
+    def start_container(self, container_id: str):
+        request = self.store.get_container(container_id) or HookRequest()
+        request = self.dispatcher.dispatch(HookType.PRE_START_CONTAINER, request)
+        result = self._forward("StartContainer", request)
+        self.dispatcher.dispatch(HookType.POST_START_CONTAINER, request)
+        return result
+
+    def update_container_resources(self, container_id: str, request: HookRequest):
+        request = self.dispatcher.dispatch(
+            HookType.PRE_UPDATE_CONTAINER_RESOURCES, request
+        )
+        self.store.save_container(container_id, request)
+        return self._forward("UpdateContainerResources", request)
+
+    def stop_pod_sandbox(self, pod_id: str):
+        request = self.store.get_pod(pod_id) or HookRequest()
+        result = self._forward("StopPodSandbox", request)
+        self.dispatcher.dispatch(HookType.POST_STOP_POD_SANDBOX, request)
+        self.store.delete_pod(pod_id)
+        return result

@@ -380,3 +380,17 @@ class TestSyncSuppressionExtended:
         )
         patches = controller.reconcile([record])
         assert len(patches) == 1 and not patches[0].degraded
+
+    def test_device_change_synced_while_degraded(self):
+        clock = FakeClock()
+        config = sloconfig.ColocationConfig(enable=True, degrade_time_minutes=15)
+        controller = NodeResourceController(config, clock=clock)
+        record = make_record(now=clock.t, metric_age=16 * 60)
+        assert len(controller.reconcile([record])) == 1  # zeroing patch
+        assert controller.reconcile([record]) == []
+        record.device = crds.Device(node_name="n1", devices=(
+            crds.DeviceInfo(type="gpu", minor=0),
+        ))
+        patches = controller.reconcile([record])
+        assert len(patches) == 1 and patches[0].degraded
+        assert patches[0].device_resources[ext.RESOURCE_GPU] == 100

@@ -1,0 +1,230 @@
+"""PredictServer, RecommendationController, runtime proxy, device daemon."""
+
+import os
+
+import pytest
+
+from koordinator_tpu.api import crds, extension as ext
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.prediction_server import (
+    BAND_UIDS, MIB, PredictServer, UID_NODE,
+)
+from koordinator_tpu.koordlet.statesinformer import PodMeta, StatesInformer
+from koordinator_tpu.manager.recommendation import RecommendationController
+from tests.test_koordlet_metrics import FakeClock
+
+from koordinator_tpu.api.priority import PriorityClass
+
+
+def prod_pod(uid, priority=9500):
+    return PodMeta(uid=uid, name=uid, namespace="d", qos_class=QoSClass.LS,
+                   kube_qos="burstable", priority=priority)
+
+
+class TestPredictServer:
+    def make(self, clock, tmp_path=None):
+        cache = mc.MetricCache(clock=clock)
+        states = StatesInformer(metric_cache=cache, clock=clock)
+        server = PredictServer(
+            states, cache,
+            checkpoint_dir=str(tmp_path) if tmp_path else None,
+            capacity=16, clock=clock,
+        )
+        return server, states, cache
+
+    def feed(self, server, states, cache, clock, steps=30, cpu_cores=2.0):
+        pod = prod_pod("p1")
+        states.set_pods([pod])
+        for _ in range(steps):
+            cache.append(mc.NODE_CPU_USAGE, cpu_cores * 2)
+            cache.append(mc.NODE_MEMORY_USAGE, 4096 * MIB)
+            cache.append(mc.POD_CPU_USAGE, cpu_cores, {"pod_uid": "p1"})
+            cache.append(mc.POD_MEMORY_USAGE, 1024 * MIB, {"pod_uid": "p1"})
+            server.train_once()
+            clock.tick(60)
+
+    def test_training_and_peak(self, clock=None):
+        clock = FakeClock()
+        server, states, cache = self.make(clock)
+        self.feed(server, states, cache, clock)
+        # cold start passed (30 min simulated)
+        peak = server.peak("p1")
+        assert peak is not None
+        cpu_peak, mem_peak = peak
+        # ~2000 mcores with 10% margin, bucket granularity 5%
+        assert 2000 <= cpu_peak <= 2600
+        assert 1024 <= mem_peak <= 1350
+        node_peak = server.peak(UID_NODE)
+        assert node_peak[0] >= 4000
+
+    def test_cold_start_returns_none(self):
+        clock = FakeClock()
+        server, states, cache = self.make(clock)
+        pod = prod_pod("p1")
+        states.set_pods([pod])
+        cache.append(mc.POD_CPU_USAGE, 1.0, {"pod_uid": "p1"})
+        server.train_once()
+        assert server.peak("p1") is None
+
+    def test_band_aggregation(self):
+        clock = FakeClock()
+        server, states, cache = self.make(clock)
+        states.set_pods([prod_pod("p1"), prod_pod("p2"),
+                         prod_pod("b1", priority=5500)])
+        for _ in range(30):
+            for uid, cores in (("p1", 1.0), ("p2", 2.0), ("b1", 4.0)):
+                cache.append(mc.POD_CPU_USAGE, cores, {"pod_uid": uid})
+                cache.append(mc.POD_MEMORY_USAGE, 100 * MIB, {"pod_uid": uid})
+            server.train_once()
+            clock.tick(60)
+        prod_peak = server.peak(BAND_UIDS[PriorityClass.PROD])
+        batch_peak = server.peak(BAND_UIDS[PriorityClass.BATCH])
+        assert 3000 <= prod_peak[0] <= 3700   # 1+2 cores
+        assert 4000 <= batch_peak[0] <= 4900  # 4 cores
+
+    def test_gc_frees_rows(self):
+        clock = FakeClock()
+        server, states, cache = self.make(clock)
+        states.set_pods([prod_pod(f"p{i}") for i in range(5)])
+        for i in range(5):
+            cache.append(mc.POD_CPU_USAGE, 1.0, {"pod_uid": f"p{i}"})
+        server.train_once()
+        free_before = len(server._free_rows)
+        states.set_pods([prod_pod("p0")])
+        assert server.gc() == 4
+        assert len(server._free_rows) == free_before + 4
+
+    def test_checkpoint_restore(self, tmp_path):
+        clock = FakeClock()
+        server, states, cache = self.make(clock, tmp_path)
+        self.feed(server, states, cache, clock)
+        server.checkpoint()
+        peak_before = server.peak("p1")
+
+        clock2 = FakeClock(t=clock.t)
+        cache2 = mc.MetricCache(clock=clock2)
+        states2 = StatesInformer(metric_cache=cache2, clock=clock2)
+        restored = PredictServer(states2, cache2, checkpoint_dir=str(tmp_path),
+                                 capacity=16, clock=clock2)
+        assert restored._rows == server._rows
+        assert restored.peak("p1") == peak_before
+
+    def test_capacity_exhaustion_drops_new(self):
+        clock = FakeClock()
+        server, states, cache = self.make(clock)
+        pods = [prod_pod(f"p{i}") for i in range(20)]  # capacity 16
+        states.set_pods(pods)
+        for p in pods:
+            cache.append(mc.POD_CPU_USAGE, 1.0, {"pod_uid": p.uid})
+        ingested = server.train_once()
+        assert ingested <= 16
+        assert len(server._free_rows) == 0
+
+
+class TestRecommendation:
+    def test_recommend_from_observations(self):
+        clock = FakeClock()
+        controller = RecommendationController(clock=clock, margin_pct=15)
+        for _ in range(50):
+            controller.observe([
+                ("Deployment/web", 500.0, 256.0),
+                ("Deployment/api", 2000.0, 1024.0),
+            ])
+            clock.tick(60)
+        recs = {r.workload_ref: r for r in controller.recommend_all()}
+        assert 500 <= recs["Deployment/web"].target_cpu_milli <= 650
+        assert 2000 <= recs["Deployment/api"].target_cpu_milli <= 2600
+        assert recs["Deployment/api"].target_memory_bytes >= 1024 * MIB
+
+
+class TestRuntimeProxy:
+    def make(self):
+        from koordinator_tpu.runtimeproxy import (
+            CRIProxy, Dispatcher, FailoverStore, HookRequest, HookResponse,
+            HookType,
+        )
+
+        calls = []
+
+        class Hook:
+            def handle(self, hook, request):
+                calls.append(hook)
+                return HookResponse(
+                    annotations={"hooked": hook.value},
+                    envs={"BVT": "-1"},
+                )
+
+        dispatcher = Dispatcher()
+        dispatcher.register(Hook(), list(HookType))
+        forwarded = []
+        backend = {
+            name: (lambda req, n=name: forwarded.append(n) or "ok")
+            for name in ("RunPodSandbox", "CreateContainer", "StartContainer",
+                         "UpdateContainerResources", "StopPodSandbox")
+        }
+        proxy = CRIProxy(dispatcher, FailoverStore(), backend)
+        return proxy, calls, forwarded, HookRequest, HookType
+
+    def test_hook_then_forward(self):
+        proxy, calls, forwarded, HookRequest, HookType = self.make()
+        request = HookRequest(pod_meta={"name": "p1"})
+        assert proxy.run_pod_sandbox("pod1", request) == "ok"
+        assert request.annotations["hooked"] == "PreRunPodSandbox"
+        assert forwarded == ["RunPodSandbox"]
+        proxy.create_container("c1", HookRequest())
+        proxy.start_container("c1")
+        assert HookType.POST_START_CONTAINER in calls
+        # failover store kept the container request for start
+        assert forwarded == ["RunPodSandbox", "CreateContainer", "StartContainer"]
+
+    def test_fail_open(self):
+        from koordinator_tpu.runtimeproxy import (
+            CRIProxy, Dispatcher, FailoverStore, HookRequest, HookType,
+        )
+
+        class Broken:
+            def handle(self, hook, request):
+                raise RuntimeError("hook server down")
+
+        dispatcher = Dispatcher()
+        dispatcher.register(Broken(), list(HookType))
+        proxy = CRIProxy(dispatcher, FailoverStore(),
+                         {"RunPodSandbox": lambda r: "ok"})
+        assert proxy.run_pod_sandbox("p", HookRequest()) == "ok"
+
+    def test_stop_cleans_store(self):
+        proxy, calls, forwarded, HookRequest, HookType = self.make()
+        proxy.run_pod_sandbox("pod1", HookRequest())
+        assert proxy.store.get_pod("pod1") is not None
+        proxy.stop_pod_sandbox("pod1")
+        assert proxy.store.get_pod("pod1") is None
+
+
+class TestDeviceDaemon:
+    def test_sysfs_probing(self, tmp_path):
+        from koordinator_tpu.device_daemon import DeviceDaemon
+
+        gpu_dir = tmp_path / "bus" / "pci" / "drivers" / "nvidia" / "0000:3b:00.0"
+        gpu_dir.mkdir(parents=True)
+        (gpu_dir / "numa_node").write_text("1")
+        accel = tmp_path / "class" / "accel" / "accel0"
+        accel.mkdir(parents=True)
+        ib = tmp_path / "class" / "infiniband" / "mlx5_0" / "device"
+        ib.mkdir(parents=True)
+        (ib / "numa_node").write_text("0")
+
+        daemon = DeviceDaemon("n1", sys_root=str(tmp_path))
+        device = daemon.collect()
+        kinds = sorted(d.type for d in device.devices)
+        assert kinds == ["gpu", "rdma", "xpu"]
+        gpu = next(d for d in device.devices if d.type == "gpu")
+        assert gpu.numa_node == 1 and gpu.busid == "0000:3b:00.0"
+        assert "scheduling.koordinator.sh/gpu-partitions" in device.annotations
+
+    def test_empty_host(self, tmp_path):
+        from koordinator_tpu.device_daemon import DeviceDaemon
+
+        device = DeviceDaemon("n1", sys_root=str(tmp_path)).collect()
+        assert device.devices == ()
+        assert device.annotations == {}
