@@ -1,0 +1,223 @@
+"""Descheduler plugin framework (reference: ``pkg/descheduler/framework/
+types.go:78-98`` — DeschedulePlugin / BalancePlugin / EvictPlugin /
+FilterPlugin; profiles ``profile/``; runtime registry ``framework/runtime/``;
+eviction plumbing with PDB respect ``evictions/``; evictor modes
+``controllers/migration/evictor/``).
+
+A profile bundles plugins; the descheduler loop runs every profile's
+Deschedule then Balance plugins each interval. Evictions flow through the
+:class:`EvictorFilter` (PDB budgets, priority threshold, owner-kind guards)
+and then one of the evictor modes (eviction API / delete / soft label —
+represented by pluggable sinks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional, Protocol
+
+from koordinator_tpu.api import extension as ext
+
+
+@dataclasses.dataclass(frozen=True)
+class PodInfo:
+    """Descheduler-side pod view."""
+
+    uid: str
+    name: str
+    namespace: str
+    node: str
+    priority: int = 0
+    qos_class: str = "NONE"
+    owner: str = ""                  # workload ref "Kind/name"
+    labels: dict = dataclasses.field(default_factory=dict)
+    annotations: dict = dataclasses.field(default_factory=dict)
+    is_daemonset: bool = False
+    has_local_storage: bool = False
+
+
+@dataclasses.dataclass
+class PDB:
+    """PodDisruptionBudget relevant state."""
+
+    selector: dict
+    disruptions_allowed: int
+
+
+class Handle(Protocol):
+    """What plugins get (framework/types.go Handle): state + evictor."""
+
+    def pods(self) -> list[PodInfo]: ...
+
+    def evict(self, pod: PodInfo, reason: str) -> bool: ...
+
+
+class DeschedulePlugin(Protocol):
+    name: str
+
+    def deschedule(self, handle: Handle) -> int: ...
+
+
+class BalancePlugin(Protocol):
+    name: str
+
+    def balance(self, handle: Handle) -> int: ...
+
+
+class EvictorFilter:
+    """defaultevictor semantics: which pods may be evicted at all."""
+
+    def __init__(
+        self,
+        evict_system_critical: bool = False,
+        evict_local_storage: bool = False,
+        evict_daemonsets: bool = False,
+        priority_threshold: Optional[int] = None,
+        pdbs: Optional[list[PDB]] = None,
+        extra_filters: Optional[list[Callable[[PodInfo], bool]]] = None,
+    ):
+        self.evict_system_critical = evict_system_critical
+        self.evict_local_storage = evict_local_storage
+        self.evict_daemonsets = evict_daemonsets
+        self.priority_threshold = priority_threshold
+        self.pdbs = list(pdbs or [])
+        self.extra_filters = list(extra_filters or [])
+
+    def _pdb_for(self, pod: PodInfo) -> Optional[PDB]:
+        for pdb in self.pdbs:
+            if all(pod.labels.get(k) == v for k, v in pdb.selector.items()):
+                return pdb
+        return None
+
+    def filter(self, pod: PodInfo) -> tuple[bool, str]:
+        """(evictable, reason-if-not)."""
+        if pod.is_daemonset and not self.evict_daemonsets:
+            return False, "daemonset pod"
+        if pod.has_local_storage and not self.evict_local_storage:
+            return False, "pod has local storage"
+        if (not self.evict_system_critical
+                and pod.priority >= 2_000_000_000):
+            return False, "system critical priority"
+        if (self.priority_threshold is not None
+                and pod.priority >= self.priority_threshold):
+            return False, "priority above threshold"
+        if pod.annotations.get(ext.ANNOTATION_EVICTION_COST, "") == "-2147483648":
+            return False, "eviction cost forbids"
+        pdb = self._pdb_for(pod)
+        if pdb is not None and pdb.disruptions_allowed <= 0:
+            return False, "PDB exhausted"
+        for fn in self.extra_filters:
+            if not fn(pod):
+                return False, "plugin filter"
+        return True, ""
+
+    def consume_budget(self, pod: PodInfo) -> None:
+        pdb = self._pdb_for(pod)
+        if pdb is not None:
+            pdb.disruptions_allowed -= 1
+
+
+# ---- evictor modes (migration/evictor/*.go) --------------------------------
+
+MODE_EVICT = "Eviction"        # eviction API (PDB-checked server-side too)
+MODE_DELETE = "Delete"         # direct delete
+MODE_SOFT = "SoftMigrate"      # annotate only; an external system drains
+
+
+class Evictor:
+    """Eviction executor with pluggable transport per mode."""
+
+    def __init__(self, mode: str = MODE_EVICT,
+                 evict_fn: Optional[Callable[[PodInfo], bool]] = None,
+                 delete_fn: Optional[Callable[[PodInfo], bool]] = None,
+                 label_fn: Optional[Callable[[PodInfo, dict], bool]] = None):
+        self.mode = mode
+        self.evict_fn = evict_fn
+        self.delete_fn = delete_fn
+        self.label_fn = label_fn
+        self.evicted: list[tuple[str, str]] = []
+
+    def evict(self, pod: PodInfo, reason: str) -> bool:
+        ok = False
+        if self.mode == MODE_EVICT:
+            ok = self.evict_fn(pod) if self.evict_fn else True
+        elif self.mode == MODE_DELETE:
+            ok = self.delete_fn(pod) if self.delete_fn else True
+        elif self.mode == MODE_SOFT:
+            labels = {ext.LABEL_SOFT_EVICTION: reason}
+            ok = self.label_fn(pod, labels) if self.label_fn else True
+        if ok:
+            from koordinator_tpu.metrics import descheduler_evictions_total
+
+            descheduler_evictions_total.inc(labels={"reason": reason})
+            self.evicted.append((pod.uid, reason))
+        return ok
+
+
+@dataclasses.dataclass
+class Profile:
+    """One descheduling profile (profile/profile.go)."""
+
+    name: str
+    deschedule_plugins: list = dataclasses.field(default_factory=list)
+    balance_plugins: list = dataclasses.field(default_factory=list)
+    evictor_filter: EvictorFilter = dataclasses.field(default_factory=EvictorFilter)
+    evictor: Evictor = dataclasses.field(default_factory=Evictor)
+    max_evictions_per_round: int = 0   # 0 = unlimited
+
+
+class _ProfileHandle:
+    def __init__(self, profile: Profile, pods_fn: Callable[[], list[PodInfo]]):
+        self.profile = profile
+        self._pods_fn = pods_fn
+        self.evictions = 0
+
+    def pods(self) -> list[PodInfo]:
+        return self._pods_fn()
+
+    def evict(self, pod: PodInfo, reason: str) -> bool:
+        limit = self.profile.max_evictions_per_round
+        if limit and self.evictions >= limit:
+            return False
+        ok, _ = self.profile.evictor_filter.filter(pod)
+        if not ok:
+            return False
+        if not self.profile.evictor.evict(pod, reason):
+            return False
+        self.profile.evictor_filter.consume_budget(pod)
+        self.evictions += 1
+        return True
+
+
+class Descheduler:
+    """The loop (pkg/descheduler/descheduler.go): every interval, run each
+    profile's Deschedule plugins then Balance plugins."""
+
+    def __init__(self, profiles: list[Profile],
+                 pods_fn: Callable[[], list[PodInfo]],
+                 interval_seconds: float = 120.0, clock=time.time):
+        self.profiles = profiles
+        self.pods_fn = pods_fn
+        self.interval_seconds = interval_seconds
+        self.clock = clock
+        self._last_run = 0.0
+
+    def run_once(self) -> dict[str, int]:
+        """One descheduling round; returns evictions per profile."""
+        out = {}
+        for profile in self.profiles:
+            handle = _ProfileHandle(profile, self.pods_fn)
+            for plugin in profile.deschedule_plugins:
+                plugin.deschedule(handle)
+            for plugin in profile.balance_plugins:
+                plugin.balance(handle)
+            out[profile.name] = handle.evictions
+        return out
+
+    def tick(self) -> Optional[dict[str, int]]:
+        now = self.clock()
+        if now - self._last_run < self.interval_seconds:
+            return None
+        self._last_run = now
+        return self.run_once()

@@ -1,0 +1,128 @@
+"""Descheduler plugins over the framework (reference:
+``pkg/descheduler/framework/plugins/``): LowNodeLoad balance bridging the
+tensor kernels, custom-priority deschedule, and the migration-controller
+evict sink.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.descheduler import lownodeload as lnl
+from koordinator_tpu.descheduler.framework import Handle, PodInfo
+from koordinator_tpu.descheduler.migration import MigrationController, MigrationJob
+
+
+class LowNodeLoadPlugin:
+    """Balance plugin: classify by real utilization, evict from anomalous hot
+    nodes into the cold pool's head-room — all selection math on-device
+    (lownodeload kernels), eviction through the profile's filter+evictor.
+
+    ``state_fn`` returns (usage(N,R), capacity(N,R), node_valid(N,),
+    node_names[N]); ``pod_usage_fn(pod)`` a (R,) usage vector.
+    """
+
+    name = "LowNodeLoad"
+
+    def __init__(
+        self,
+        state_fn: Callable[[], tuple[np.ndarray, np.ndarray, np.ndarray, list[str]]],
+        pod_usage_fn: Callable[[PodInfo], np.ndarray],
+        args: Optional[lnl.LowNodeLoadArgs] = None,
+    ):
+        self.state_fn = state_fn
+        self.pod_usage_fn = pod_usage_fn
+        self.args = args or lnl.LowNodeLoadArgs.default()
+        self._anomaly = None  # (N,) counters, lazily sized
+
+    def balance(self, handle: Handle) -> int:
+        usage, capacity, node_valid, node_names = self.state_fn()
+        n = usage.shape[0]
+        if self._anomaly is None or self._anomaly.shape[0] != n:
+            self._anomaly = jnp.zeros(n, jnp.int32)
+        node_index = {name: i for i, name in enumerate(node_names)}
+
+        pods = [p for p in handle.pods() if p.node in node_index]
+        pod_node = np.asarray(
+            [node_index[p.node] for p in pods] or [0], np.int32
+        )
+        pod_usage = np.stack(
+            [self.pod_usage_fn(p) for p in pods]
+        ) if pods else np.zeros((1, usage.shape[1]), np.int32)
+        pod_priority = np.asarray([p.priority for p in pods] or [0], np.int32)
+        # host-side eviction filters feed the kernel's evictable mask
+        from koordinator_tpu.descheduler.framework import _ProfileHandle
+
+        if isinstance(handle, _ProfileHandle):
+            evictable = np.asarray(
+                [handle.profile.evictor_filter.filter(p)[0] for p in pods]
+                or [False]
+            )
+        else:
+            evictable = np.ones(max(len(pods), 1), bool)
+
+        _, over = lnl.classify_nodes(
+            jnp.asarray(usage), jnp.asarray(capacity), jnp.asarray(node_valid),
+            self.args,
+        )
+        self._anomaly = lnl.update_anomaly_counters(self._anomaly, over)
+        victims = np.asarray(lnl.select_victims(
+            jnp.asarray(usage), jnp.asarray(capacity), jnp.asarray(node_valid),
+            jnp.asarray(pod_node), jnp.asarray(pod_usage),
+            jnp.asarray(pod_priority), jnp.asarray(evictable),
+            self._anomaly, self.args,
+        ))
+        evicted = 0
+        for pod, is_victim in zip(pods, victims):
+            if is_victim and handle.evict(pod, "LowNodeLoad"):
+                evicted += 1
+        return evicted
+
+
+class CustomPriorityPlugin:
+    """Deschedule plugin (plugins/custompriority): evict pods below a
+    priority floor from matching nodes (cleanup of stale low-priority work)."""
+
+    name = "CustomPriority"
+
+    def __init__(self, priority_floor: int,
+                 node_filter: Optional[Callable[[str], bool]] = None):
+        self.priority_floor = priority_floor
+        self.node_filter = node_filter
+
+    def deschedule(self, handle: Handle) -> int:
+        evicted = 0
+        for pod in handle.pods():
+            if pod.priority >= self.priority_floor:
+                continue
+            if self.node_filter and not self.node_filter(pod.node):
+                continue
+            if handle.evict(pod, "CustomPriority"):
+                evicted += 1
+        return evicted
+
+
+def migration_evict_fn(controller: MigrationController,
+                       clock=None) -> Callable[[PodInfo], bool]:
+    """Evict sink that creates PodMigrationJobs instead of direct eviction —
+    the reference's 'evictor plugin = migration controller' wiring
+    (SURVEY.md 3.4)."""
+    counter = [0]
+
+    def evict(pod: PodInfo) -> bool:
+        counter[0] += 1
+        job = MigrationJob(
+            name=f"migrate-{pod.uid}-{counter[0]}",
+            pod=pod.uid, node=pod.node, namespace=pod.namespace,
+            workload=pod.owner, priority=pod.priority,
+        )
+        try:
+            controller.submit(job)
+        except ValueError:
+            return False
+        return True
+
+    return evict

@@ -376,7 +376,10 @@ class CPICollector:
         counter = self._counters.get(key)
         if counter is None:
             fds_needed = 2 * self.n_cpus
-            if (self._open_counters() + 1) * fds_needed > self.FD_BUDGET:
+            # at least one counter is always allowed, however many CPUs —
+            # otherwise big hosts would silently get no CPI at all
+            max_counters = max(1, self.FD_BUDGET // fds_needed)
+            if self._open_counters() >= max_counters:
                 return None  # over budget: skip WITHOUT caching, so a freed
                              # slot (pod deletion) lets this pod in later
             path = self.d.cfg.cgroup_abs_path("perf_event", rel)

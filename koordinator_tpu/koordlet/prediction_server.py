@@ -117,25 +117,35 @@ class PredictServer:
         uids: list[int] = []
         cpu_vals: list[float] = []
         mem_vals: list[float] = []
+        cpu_mask: list[bool] = []
+        mem_mask: list[bool] = []
         band_cpu: dict[str, float] = {u: 0.0 for u in BAND_UIDS.values()}
         band_mem: dict[str, float] = {u: 0.0 for u in BAND_UIDS.values()}
 
-        def push(uid: str, cpu_milli: float, mem_mib: float):
+        def push(uid: str, cpu_milli, mem_mib):
+            """None marks a missing half: the sample is masked out of that
+            bank instead of polluting the histogram with a 0."""
             row = self._row_of(uid, now)
             if row is None:
                 return
             uids.append(row)
-            cpu_vals.append(cpu_milli)
-            mem_vals.append(mem_mib)
+            cpu_vals.append(0.0 if cpu_milli is None else cpu_milli)
+            mem_vals.append(0.0 if mem_mib is None else mem_mib)
+            cpu_mask.append(cpu_milli is not None)
+            mem_mask.append(mem_mib is not None)
 
         node_cpu = self.cache.query(mc.NODE_CPU_USAGE, None, now - window, now)
         node_mem = self.cache.query(mc.NODE_MEMORY_USAGE, None, now - window, now)
-        if not node_cpu.empty:
-            push(UID_NODE, node_cpu.latest() * 1000.0, node_mem.latest() / MIB)
+        if not (node_cpu.empty and node_mem.empty):
+            push(UID_NODE,
+                 None if node_cpu.empty else node_cpu.latest() * 1000.0,
+                 None if node_mem.empty else node_mem.latest() / MIB)
         sys_cpu = self.cache.query(mc.SYS_CPU_USAGE, None, now - window, now)
         sys_mem = self.cache.query(mc.SYS_MEMORY_USAGE, None, now - window, now)
-        if not sys_cpu.empty:
-            push(UID_SYS, sys_cpu.latest() * 1000.0, sys_mem.latest() / MIB)
+        if not (sys_cpu.empty and sys_mem.empty):
+            push(UID_SYS,
+                 None if sys_cpu.empty else sys_cpu.latest() * 1000.0,
+                 None if sys_mem.empty else sys_mem.latest() / MIB)
 
         for pod in self.states.get_all_pods():
             if not pod.is_running:
@@ -145,13 +155,13 @@ class PredictServer:
             mem = self.cache.query(mc.POD_MEMORY_USAGE, labels, now - window, now)
             if cpu.empty and mem.empty:
                 continue
-            cpu_milli = cpu.latest() * 1000.0
-            mem_mib = mem.latest() / MIB
+            cpu_milli = None if cpu.empty else cpu.latest() * 1000.0
+            mem_mib = None if mem.empty else mem.latest() / MIB
             push(pod.uid, cpu_milli, mem_mib)
             band = BAND_UIDS.get(priority_class_of(pod.priority))
             if band:
-                band_cpu[band] += cpu_milli
-                band_mem[band] += mem_mib
+                band_cpu[band] += cpu_milli or 0.0
+                band_mem[band] += mem_mib or 0.0
 
         for band_uid in BAND_UIDS.values():
             if band_cpu[band_uid] > 0 or band_mem[band_uid] > 0:
@@ -164,10 +174,12 @@ class PredictServer:
         self.cpu_bank = hist.add_samples(
             self.cpu_bank, self.cpu_buckets, rows,
             jnp.asarray(np.asarray(cpu_vals, np.float32)), t,
+            mask=jnp.asarray(np.asarray(cpu_mask, bool)),
         )
         self.mem_bank = hist.add_samples(
             self.mem_bank, self.mem_buckets, rows,
             jnp.asarray(np.asarray(mem_vals, np.float32)), t,
+            mask=jnp.asarray(np.asarray(mem_mask, bool)),
         )
         if (self.checkpoint_dir
                 and now - self._last_checkpoint >= self.checkpoint_interval_sec):
@@ -192,24 +204,19 @@ class PredictServer:
         return int(cpu * scale), int(mem * scale)
 
     def prod_reclaimable(self) -> tuple[int, int]:
-        """The mid-resource input: prod band peak p95 vs current usage —
-        what prod pods are very unlikely to take back (midresource plugin)."""
+        """The mid-resource input (midresource plugin): what prod pods have
+        *requested* but are very unlikely to use — sum(prod requests) minus
+        the predicted prod-band peak (p98 + margin), clamped at 0."""
         peak = self.peak(BAND_UIDS[PriorityClass.PROD], p=0.98)
         if peak is None:
             return 0, 0
-        now = self.clock()
-        used_cpu = used_mem = 0.0
+        req_cpu = req_mem = 0
         for pod in self.states.get_all_pods():
             if priority_class_of(pod.priority) is not PriorityClass.PROD:
                 continue
-            labels = {"pod_uid": pod.uid}
-            used_cpu += self.cache.query(
-                mc.POD_CPU_USAGE, labels, now - 120, now).latest() * 1000.0
-            used_mem += self.cache.query(
-                mc.POD_MEMORY_USAGE, labels, now - 120, now).latest() / MIB
-        # reclaimable = current allocation beyond the predicted peak; callers
-        # combine with requests. Negative clamps to 0.
-        return (max(0, int(used_cpu - peak[0])), max(0, int(used_mem - peak[1])))
+            req_cpu += int(pod.requests.get("cpu", 0))
+            req_mem += int(pod.requests.get("memory", 0)) // MIB
+        return (max(0, req_cpu - peak[0]), max(0, req_mem - peak[1]))
 
     # -- checkpoint / restore -------------------------------------------------
 
@@ -248,5 +255,16 @@ class PredictServer:
                 r for r in range(self.capacity - 1, -1, -1) if r not in used
             ]
             return True
-        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        except Exception:  # noqa: BLE001 — a corrupt checkpoint (truncated
+            # npz raises BadZipFile/EOFError) must never brick agent startup;
+            # start fresh instead.
+            self.cpu_bank = hist.HistogramBank.zeros(
+                self.capacity, self.cpu_buckets, float(self.cpu_bank.half_life)
+            )
+            self.mem_bank = hist.HistogramBank.zeros(
+                self.capacity, self.mem_buckets, float(self.mem_bank.half_life)
+            )
+            self._rows = {}
+            self._first_seen = {}
+            self._free_rows = list(range(self.capacity - 1, -1, -1))
             return False

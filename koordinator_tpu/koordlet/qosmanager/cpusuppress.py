@@ -113,6 +113,9 @@ class CPUSuppress:
             prev_allowable_milli=self._prev_allowable,
         )
         self._prev_allowable = allowable
+        from koordinator_tpu.metrics import be_suppress_cpu_cores
+
+        be_suppress_cpu_cores.set(allowable / 1000.0)
         be_dir = self.ctx.cfg.kube_qos_dir("besteffort")
         if strategy.cpu_suppress_policy == "cfsQuota":
             quota = allowable * CFS_PERIOD_US // 1000

@@ -126,6 +126,9 @@ class Evictor:
         if self.kill_handler is not None:
             ok = self.kill_handler(pod, reason)
         if ok:
+            from koordinator_tpu.metrics import pod_eviction_total
+
+            pod_eviction_total.inc(labels={"reason": reason})
             self._in_flight[pod.uid] = now
             self.evicted.append((pod.uid, reason))
             if self.ctx.auditor:

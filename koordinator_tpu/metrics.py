@@ -1,0 +1,209 @@
+"""Prometheus-style metrics (reference: ``pkg/scheduler/metrics/``,
+``pkg/koordlet/metrics/`` external+internal registries,
+``pkg/util/metrics/``, ``pkg/descheduler/metrics/``).
+
+A minimal dependency-free implementation: Counter / Gauge / Histogram with
+labels, per-component registries, and the text exposition format, so the
+same scrape endpoints and metric names exist for dashboards
+(``dashboards/scheduling.json`` equivalents).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Mapping, Optional, Sequence
+
+
+def _label_key(labels: Mapping[str, str] | None) -> tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _render_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._lock = threading.Lock()
+
+    def expose(self) -> str:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0,
+            labels: Mapping[str, str] | None = None) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, labels: Mapping[str, str] | None = None) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            for key, value in sorted(self._values.items()):
+                lines.append(f"{self.name}{_render_labels(key)} {value:g}")
+        return "\n".join(lines)
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, value: float, labels: Mapping[str, str] | None = None) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = value
+
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                   2.5, 5.0, 10.0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_text: str = "",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help_text)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float,
+                labels: Mapping[str, str] | None = None) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def quantile(self, q: float,
+                 labels: Mapping[str, str] | None = None) -> float:
+        """Bucket-upper-bound quantile estimate (for monitor thresholds)."""
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.get(key)
+            total = self._totals.get(key, 0)
+            if not counts or total == 0:
+                return 0.0
+            target = q * total
+            for i, c in enumerate(counts):
+                if c >= target:
+                    return self.buckets[i]
+            return self.buckets[-1]
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            for key in sorted(self._counts):
+                counts = self._counts[key]
+                for bound, count in zip(self.buckets, counts):
+                    bucket_key = key + (("le", f"{bound:g}"),)
+                    lines.append(
+                        f"{self.name}_bucket{_render_labels(bucket_key)} {count}"
+                    )
+                inf_key = key + (("le", "+Inf"),)
+                lines.append(
+                    f"{self.name}_bucket{_render_labels(inf_key)} "
+                    f"{self._totals[key]}"
+                )
+                lines.append(
+                    f"{self.name}_sum{_render_labels(key)} {self._sums[key]:g}"
+                )
+                lines.append(
+                    f"{self.name}_count{_render_labels(key)} {self._totals[key]}"
+                )
+        return "\n".join(lines)
+
+
+class Registry:
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _full(self, name: str) -> str:
+        return f"{self.prefix}_{name}" if self.prefix else name
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._get_or_create(name, lambda n: Counter(n, help_text), Counter)
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._get_or_create(name, lambda n: Gauge(n, help_text), Gauge)
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(
+            name, lambda n: Histogram(n, help_text, buckets), Histogram
+        )
+
+    def _get_or_create(self, name: str, factory, expected_type):
+        full = self._full(name)
+        with self._lock:
+            metric = self._metrics.get(full)
+            if metric is None:
+                metric = self._metrics[full] = factory(full)
+            elif not isinstance(metric, expected_type):
+                raise ValueError(f"metric {full} already registered as "
+                                 f"{type(metric).__name__}")
+            return metric
+
+    def expose(self) -> str:
+        """The /metrics scrape body."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "\n".join(m.expose() for m in metrics) + "\n"
+
+
+# Component registries (the reference's per-component metric packages).
+SCHEDULER = Registry("koord_scheduler")
+KOORDLET = Registry("koordlet")
+MANAGER = Registry("koord_manager")
+DESCHEDULER = Registry("koord_descheduler")
+
+# Canonical instruments (names mirror the reference's).
+scheduling_latency = SCHEDULER.histogram(
+    "scheduling_duration_seconds", "End-to-end pod scheduling latency")
+solver_batch_latency = SCHEDULER.histogram(
+    "solver_batch_duration_seconds", "Batched filter/score/assign solve latency")
+pending_pods = SCHEDULER.gauge("pending_pods", "Pods waiting to be scheduled")
+
+be_suppress_cpu_cores = KOORDLET.gauge(
+    "be_suppress_cpu_cores", "CPU cores currently allowed for BE")
+pod_eviction_total = KOORDLET.counter(
+    "pod_eviction_total", "Node-side evictions by reason")
+cpu_burst_total = KOORDLET.counter(
+    "cpu_burst_total", "CPU burst quota adjustments")
+container_cpi = KOORDLET.gauge("container_cpi", "Cycles per instruction")
+psi_cpu_some_avg10 = KOORDLET.gauge("psi_cpu_some_avg10", "CPU PSI some avg10")
+
+batch_resource_allocatable = MANAGER.gauge(
+    "batch_resource_allocatable", "Batch allocatable per node/resource")
+node_metric_expired = MANAGER.gauge(
+    "node_metric_expired", "1 when a node's metric report is stale")
+
+descheduler_evictions_total = DESCHEDULER.counter(
+    "pod_evictions_total", "Descheduler evictions by profile/reason")
+migration_jobs = DESCHEDULER.gauge(
+    "migration_jobs", "PodMigrationJobs by phase")

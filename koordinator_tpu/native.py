@@ -21,6 +21,9 @@ _LIB_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _LIB = os.path.join(_LIB_DIR, "libkoordsys.so")
 
 _lock = threading.Lock()
+#: serializes the g++ compile + dlopen; separate from _lock so fast-path
+#: _load() calls never queue behind a running build
+_build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 _build_thread: Optional[threading.Thread] = None
@@ -69,16 +72,15 @@ def _load_blocking() -> Optional[ctypes.CDLL]:
     global _lib, _load_attempted
     if _load_attempted:
         return _lib
-    # Compile OUTSIDE the lock: concurrent _load() calls must keep returning
-    # their fallback instantly instead of queueing behind a 2-minute g++ run.
-    if not os.path.exists(_LIB):
-        built = _build()
-        with _lock:
-            if _load_attempted:
-                return _lib
-            if not built:
-                _load_attempted = True
-                return None
+    # The build runs under its own lock: concurrent ensure_built()/background
+    # threads serialize here (two g++ runs on one .so corrupt it), while
+    # fast-path _load() calls never touch this lock and keep falling back.
+    with _build_lock:
+        if not _load_attempted and not os.path.exists(_LIB):
+            if not _build():
+                with _lock:
+                    _load_attempted = True
+                    return None
     with _lock:
         if _load_attempted:
             return _lib

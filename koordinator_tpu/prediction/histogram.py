@@ -172,14 +172,19 @@ def percentile(
 
 
 def save_bank(bank: HistogramBank, path: str) -> None:
-    """Checkpoint (prediction/checkpoint.go equivalent)."""
+    """Checkpoint (prediction/checkpoint.go equivalent). Atomic: a crash
+    mid-write must never leave a truncated archive at ``path``."""
+    import os
+
+    tmp = path + ".tmp.npz"
     np.savez_compressed(
-        path,
+        tmp,
         weights=np.asarray(bank.weights),
         total=np.asarray(bank.total),
         ref_time=np.asarray(bank.ref_time),
         half_life=np.asarray(bank.half_life),
     )
+    os.replace(tmp, path)
 
 
 def load_bank(path: str) -> HistogramBank:

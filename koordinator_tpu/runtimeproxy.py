@@ -98,15 +98,19 @@ class FailoverStore:
     def __init__(self):
         self._pods: dict[str, HookRequest] = {}
         self._containers: dict[str, HookRequest] = {}
+        self._pod_containers: dict[str, set[str]] = {}
         self._lock = threading.Lock()
 
     def save_pod(self, pod_id: str, request: HookRequest) -> None:
         with self._lock:
             self._pods[pod_id] = request
 
-    def save_container(self, container_id: str, request: HookRequest) -> None:
+    def save_container(self, container_id: str, request: HookRequest,
+                       pod_id: str = "") -> None:
         with self._lock:
             self._containers[container_id] = request
+            if pod_id:
+                self._pod_containers.setdefault(pod_id, set()).add(container_id)
 
     def get_pod(self, pod_id: str) -> Optional[HookRequest]:
         with self._lock:
@@ -119,10 +123,14 @@ class FailoverStore:
     def delete_pod(self, pod_id: str) -> None:
         with self._lock:
             self._pods.pop(pod_id, None)
+            for cid in self._pod_containers.pop(pod_id, set()):
+                self._containers.pop(cid, None)
 
     def delete_container(self, container_id: str) -> None:
         with self._lock:
             self._containers.pop(container_id, None)
+            for containers in self._pod_containers.values():
+                containers.discard(container_id)
 
 
 class CRIProxy:
@@ -145,9 +153,11 @@ class CRIProxy:
         self.store.save_pod(pod_id, request)
         return self._forward("RunPodSandbox", request)
 
-    def create_container(self, container_id: str, request: HookRequest):
+    def create_container(self, container_id: str, request: HookRequest,
+                         pod_id: str = ""):
         request = self.dispatcher.dispatch(HookType.PRE_CREATE_CONTAINER, request)
-        self.store.save_container(container_id, request)
+        self.store.save_container(container_id, request,
+                                  pod_id or request.pod_meta.get("uid", ""))
         return self._forward("CreateContainer", request)
 
     def start_container(self, container_id: str):
@@ -163,6 +173,12 @@ class CRIProxy:
         )
         self.store.save_container(container_id, request)
         return self._forward("UpdateContainerResources", request)
+
+    def remove_container(self, container_id: str):
+        request = self.store.get_container(container_id) or HookRequest()
+        result = self._forward("RemoveContainer", request)
+        self.store.delete_container(container_id)
+        return result
 
     def stop_pod_sandbox(self, pod_id: str):
         request = self.store.get_pod(pod_id) or HookRequest()

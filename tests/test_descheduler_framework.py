@@ -1,0 +1,145 @@
+"""Descheduler framework: profiles, evictor filter (PDB), evictor modes,
+LowNodeLoad bridge, migration-backed eviction."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+from koordinator_tpu.descheduler.framework import (
+    Descheduler, Evictor, EvictorFilter, MODE_DELETE, MODE_SOFT, PDB, PodInfo,
+    Profile,
+)
+from koordinator_tpu.descheduler.migration import MigrationController
+from koordinator_tpu.descheduler.plugins import (
+    CustomPriorityPlugin, LowNodeLoadPlugin, migration_evict_fn,
+)
+
+
+def pod(uid, node="n0", priority=5500, **kw):
+    return PodInfo(uid=uid, name=uid, namespace="default", node=node,
+                   priority=priority, **kw)
+
+
+class TestEvictorFilter:
+    def test_daemonset_and_storage_guards(self):
+        f = EvictorFilter()
+        assert not f.filter(pod("a", is_daemonset=True))[0]
+        assert not f.filter(pod("b", has_local_storage=True))[0]
+        assert f.filter(pod("c"))[0]
+
+    def test_priority_threshold(self):
+        f = EvictorFilter(priority_threshold=9000)
+        assert f.filter(pod("a", priority=5000))[0]
+        assert not f.filter(pod("b", priority=9500))[0]
+
+    def test_pdb_budget(self):
+        f = EvictorFilter(pdbs=[PDB(selector={"app": "web"},
+                                    disruptions_allowed=1)])
+        p1 = pod("a", labels={"app": "web"})
+        p2 = pod("b", labels={"app": "web"})
+        assert f.filter(p1)[0]
+        f.consume_budget(p1)
+        ok, reason = f.filter(p2)
+        assert not ok and "PDB" in reason
+
+    def test_eviction_cost_annotation(self):
+        f = EvictorFilter()
+        p = pod("a", annotations={ext.ANNOTATION_EVICTION_COST: "-2147483648"})
+        assert not f.filter(p)[0]
+
+
+class TestEvictorModes:
+    def test_delete_mode(self):
+        deleted = []
+        ev = Evictor(mode=MODE_DELETE, delete_fn=lambda p: deleted.append(p.uid) or True)
+        assert ev.evict(pod("a"), "r")
+        assert deleted == ["a"]
+
+    def test_soft_mode_labels(self):
+        labeled = {}
+        ev = Evictor(mode=MODE_SOFT,
+                     label_fn=lambda p, ls: labeled.update({p.uid: ls}) or True)
+        ev.evict(pod("a"), "LowNodeLoad")
+        assert labeled["a"][ext.LABEL_SOFT_EVICTION] == "LowNodeLoad"
+
+
+class TestProfileRound:
+    def test_round_limit_and_filters(self):
+        pods = [pod(f"p{i}", priority=3500) for i in range(5)]
+        plugin = CustomPriorityPlugin(priority_floor=5000)
+        profile = Profile(
+            name="default",
+            deschedule_plugins=[plugin],
+            max_evictions_per_round=2,
+        )
+        d = Descheduler([profile], pods_fn=lambda: pods)
+        out = d.run_once()
+        assert out["default"] == 2
+        assert len(profile.evictor.evicted) == 2
+
+    def test_tick_interval(self):
+        from tests.test_koordlet_metrics import FakeClock
+
+        clock = FakeClock()
+        profile = Profile(name="p")
+        d = Descheduler([profile], pods_fn=list, interval_seconds=120,
+                        clock=clock)
+        assert d.tick() is not None
+        assert d.tick() is None
+        clock.tick(121)
+        assert d.tick() is not None
+
+
+def make_state(n=4, hot_node=0):
+    r = NUM_RESOURCE_DIMS
+    capacity = np.zeros((n, r), np.int32)
+    capacity[:, ResourceDim.CPU] = 10_000
+    capacity[:, ResourceDim.MEMORY] = 10_000
+    usage = np.zeros((n, r), np.int32)
+    usage[:, ResourceDim.CPU] = 2_000          # cold nodes: 20%
+    usage[hot_node, ResourceDim.CPU] = 9_000   # hot: 90% > high 65%
+    valid = np.ones(n, bool)
+    names = [f"n{i}" for i in range(n)]
+    return usage, capacity, valid, names
+
+
+class TestLowNodeLoadPlugin:
+    def run_rounds(self, rounds=3):
+        pods = [pod("victim", node="n0", priority=3500),
+                pod("keeper", node="n1", priority=9500)]
+
+        def pod_usage(p):
+            u = np.zeros(NUM_RESOURCE_DIMS, np.int32)
+            u[ResourceDim.CPU] = 3000 if p.uid == "victim" else 500
+            return u
+
+        plugin = LowNodeLoadPlugin(state_fn=make_state, pod_usage_fn=pod_usage)
+        profile = Profile(name="ln", balance_plugins=[plugin])
+        d = Descheduler([profile], pods_fn=lambda: pods)
+        results = [d.run_once() for _ in range(rounds)]
+        return results, profile
+
+    def test_anomaly_gating_then_evict(self):
+        results, profile = self.run_rounds(3)
+        # rounds 1-2: anomaly counter below threshold (3) -> no eviction
+        assert results[0]["ln"] == 0
+        assert results[1]["ln"] == 0
+        assert results[2]["ln"] == 1
+        assert profile.evictor.evicted == [("victim", "LowNodeLoad")]
+
+
+class TestMigrationSink:
+    def test_eviction_creates_jobs(self):
+        controller = MigrationController()
+        ev = Evictor(evict_fn=migration_evict_fn(controller))
+        profile = Profile(
+            name="p",
+            deschedule_plugins=[CustomPriorityPlugin(priority_floor=5000)],
+            evictor=ev,
+        )
+        pods = [pod("a", priority=3500, owner="Deployment/web")]
+        Descheduler([profile], pods_fn=lambda: pods).run_once()
+        assert len(controller.jobs) == 1
+        job = next(iter(controller.jobs.values()))
+        assert job.pod == "a" and job.workload == "Deployment/web"

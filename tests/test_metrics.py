@@ -1,0 +1,62 @@
+"""Metrics registry: instruments, exposition format, wiring."""
+
+import pytest
+
+from koordinator_tpu.metrics import (
+    Counter, Gauge, Histogram, Registry,
+)
+
+
+class TestInstruments:
+    def test_counter_labels(self):
+        c = Counter("requests_total")
+        c.inc()
+        c.inc(2, {"code": "200"})
+        assert c.value() == 1
+        assert c.value({"code": "200"}) == 2
+
+    def test_gauge_set(self):
+        g = Gauge("temp")
+        g.set(3.5)
+        g.set(1.0, {"node": "n1"})
+        assert g.value() == 3.5
+        assert g.value({"node": "n1"}) == 1.0
+
+    def test_histogram_quantile(self):
+        h = Histogram("lat", buckets=(0.1, 0.5, 1.0))
+        for v in (0.05, 0.05, 0.2, 0.8):
+            h.observe(v)
+        assert h.quantile(0.5) == 0.1
+        assert h.quantile(0.99) == 1.0
+
+    def test_exposition_format(self):
+        r = Registry("test")
+        c = r.counter("hits", "hit count")
+        c.inc(3, {"path": "/x"})
+        h = r.histogram("lat", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        text = r.expose()
+        assert '# TYPE test_hits counter' in text
+        assert 'test_hits{path="/x"} 3' in text
+        assert 'test_lat_bucket{le="0.1"} 1' in text
+        assert 'test_lat_bucket{le="+Inf"} 1' in text
+        assert 'test_lat_count 1' in text
+
+    def test_type_conflict_raises(self):
+        r = Registry()
+        r.counter("x")
+        with pytest.raises(ValueError):
+            r.gauge("x")
+
+
+class TestWiring:
+    def test_qos_eviction_counts(self, tmp_path):
+        from koordinator_tpu.koordlet.qosmanager.framework import Evictor
+        from koordinator_tpu.metrics import pod_eviction_total
+        from tests.test_koordlet_metrics import FakeClock
+        from tests.test_qosmanager import be_pod, make_ctx
+
+        before = pod_eviction_total.value({"reason": "test-reason"})
+        ctx = make_ctx(tmp_path, FakeClock())
+        Evictor(ctx).evict(be_pod("a"), "test-reason")
+        assert pod_eviction_total.value({"reason": "test-reason"}) == before + 1
