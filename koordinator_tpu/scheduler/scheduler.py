@@ -76,6 +76,8 @@ class Scheduler:
         gang_passes: int = 2,
         clock=time.monotonic,
         topology_tree: TopologyArrays | None = None,
+        barrier=None,
+        debug_service=None,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -86,6 +88,12 @@ class Scheduler:
         self.clock = clock
         self.topology_tree = topology_tree
 
+        #: startup sync barrier (barrier.SyncBarrier) — rounds no-op until
+        #: the informer replays past it
+        self.barrier = barrier
+        #: debug service for top-N score dumps (services.DebugService)
+        self.debug_service = debug_service
+        self.last_result = SchedulingResult({}, {}, 0)
         self.pending: dict[str, PodSpec] = {}
         self.gangs: dict[str, GangRecord] = {}
         self._solve = jax.jit(gang_assign, static_argnames=("passes",))
@@ -226,11 +234,16 @@ class Scheduler:
 
     def schedule_round(self) -> SchedulingResult:
         """Solve the current pending queue; reserve, bind, diagnose."""
+        if self.barrier is not None and not self.barrier.check():
+            # stale cache after restart: refuse to decide until the informer
+            # replays past the barrier (sync_barrier.go semantics)
+            return SchedulingResult({}, {}, 0)
         now = self.clock()
         with self.monitor.phase("PreEnqueue"):
             pods = self._active_pods()
         if not pods:
-            return SchedulingResult({}, {}, 0)
+            self.last_result = SchedulingResult({}, {}, 0)
+            return self.last_result
 
         with self.monitor.phase("BatchBuild"):
             self.snapshot.flush()
@@ -245,8 +258,20 @@ class Scheduler:
                 passes=self.gang_passes,
             )
             a = np.asarray(assignments)
+        if (self.debug_service is not None
+                and self.debug_service.dump_top_n_scores > 0):
+            # debug-only extra solve: dump per-pod node scores
+            from koordinator_tpu.ops.assignment import score_pods
+
+            scores, _ = score_pods(self.snapshot.state, batch, self.config)
+            self.debug_service.record_scores(
+                pods, np.asarray(scores),
+                [self.snapshot.node_name(r) or str(r)
+                 for r in range(self.snapshot.state.capacity)],
+            )
 
         result = SchedulingResult({}, {}, round_pods=len(pods))
+        self.last_result = result  # debug-API diagnosis surface
         with self.monitor.phase("Reserve"):
             self.snapshot.adopt_state(new_state)
 

@@ -1,0 +1,176 @@
+"""Scheduler debug/services API (reference: ``frameworkext/services/
+services.go:32-51`` — a gin HTTP server where every plugin mounts endpoints
+under ``/apis/v1/plugins/<name>``; plus ``frameworkext/debug.go`` runtime
+flag toggles).
+
+Transport-agnostic core: a route registry mapping paths to callables that
+return JSON-able objects; ``serve_forever`` optionally exposes it over the
+stdlib HTTP server. Built-in routes cover the reference's debug surface:
+nodes, pending pods, gangs, quotas, last-round diagnosis, metrics scrape,
+and the runtime-togglable top-N score dump.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+
+class DebugService:
+    def __init__(self, scheduler=None):
+        self.scheduler = scheduler
+        self._routes: dict[str, Callable[[dict], object]] = {}
+        self._lock = threading.Lock()
+        #: debug.go: runtime-togglable top-N score dumping (0 = off)
+        self.dump_top_n_scores = 0
+        self.last_scores: Optional[dict] = None
+        if scheduler is not None:
+            self._register_builtin()
+
+    # -- registry (plugins mount under /apis/v1/plugins/<name>/...) ----------
+
+    def register(self, path: str, handler: Callable[[dict], object]) -> None:
+        with self._lock:
+            self._routes[path.rstrip("/")] = handler
+
+    def register_plugin(self, plugin_name: str, sub_path: str,
+                        handler: Callable[[dict], object]) -> None:
+        self.register(f"/apis/v1/plugins/{plugin_name}/{sub_path.lstrip('/')}",
+                      handler)
+
+    def handle(self, path: str, params: dict | None = None) -> tuple[int, object]:
+        """(status, body) — the transport-agnostic request entry."""
+        with self._lock:
+            handler = self._routes.get(path.rstrip("/"))
+        if handler is None:
+            return 404, {"error": f"no route {path}"}
+        try:
+            return 200, handler(params or {})
+        except Exception as e:  # noqa: BLE001 — debug API must not crash
+            return 500, {"error": str(e)}
+
+    # -- built-in routes ------------------------------------------------------
+
+    def _register_builtin(self) -> None:
+        self.register("/apis/v1/nodes", self._nodes)
+        self.register("/apis/v1/pods", self._pods)
+        self.register("/apis/v1/gangs", self._gangs)
+        self.register("/apis/v1/quotas", self._quotas)
+        self.register("/apis/v1/diagnosis", self._diagnosis)
+        self.register("/apis/v1/__debug/scores", self._scores)
+        self.register("/apis/v1/__debug/set-top-n", self._set_top_n)
+        self.register("/metrics", self._metrics)
+
+    def _nodes(self, params: dict) -> object:
+        snapshot = self.scheduler.snapshot
+        out = []
+        for name, row in snapshot.node_index.items():
+            spec = snapshot.node_specs.get(name)
+            out.append({
+                "name": name, "row": row,
+                "allocatable": (
+                    np.asarray(spec.allocatable).tolist() if spec else None
+                ),
+            })
+        return out
+
+    def _pods(self, params: dict) -> object:
+        return [
+            {"name": p.name, "priority": p.priority, "gang": p.gang,
+             "quota": p.quota, "requests": np.asarray(p.requests).tolist()}
+            for p in self.scheduler.pending.values()
+        ]
+
+    def _gangs(self, params: dict) -> object:
+        return [
+            {"name": g.name, "min_member": g.min_member,
+             "rejected": g.rejected,
+             "first_failure": g.first_failure}
+            for g in self.scheduler.gangs.values()
+        ]
+
+    def _quotas(self, params: dict) -> object:
+        tree = self.scheduler.quota_tree
+        if tree is None:
+            return []
+        return [
+            {"name": name,
+             "min": np.asarray(node.min).tolist(),
+             "max": np.asarray(node.max).tolist(),
+             "used": np.asarray(node.used).tolist(),
+             "runtime": np.asarray(tree.runtime_of(name)).tolist()}
+            for name, node in tree.nodes.items()
+        ]
+
+    def _diagnosis(self, params: dict) -> object:
+        import dataclasses as _dc
+
+        result = getattr(self.scheduler, "last_result", None)
+        if result is None:
+            return {}
+        return {
+            pod: _dc.asdict(d) if _dc.is_dataclass(d) else str(d)
+            for pod, d in result.failures.items()
+        }
+
+    def _scores(self, params: dict) -> object:
+        return self.last_scores or {}
+
+    def _set_top_n(self, params: dict) -> object:
+        self.dump_top_n_scores = int(params.get("n", 0))
+        return {"dump_top_n_scores": self.dump_top_n_scores}
+
+    def _metrics(self, params: dict) -> object:
+        from koordinator_tpu.metrics import SCHEDULER
+
+        return SCHEDULER.expose()
+
+    def record_scores(self, pods: list, scores: np.ndarray,
+                      node_names: list[str]) -> None:
+        """Called by the scheduler after a solve when dumping is on."""
+        n = self.dump_top_n_scores
+        if n <= 0:
+            return
+        top = {}
+        for i, pod in enumerate(pods):
+            row = np.asarray(scores[i])
+            order = np.argsort(row)[::-1][:n]
+            top[getattr(pod, "name", str(i))] = [
+                {"node": node_names[j] if j < len(node_names) else str(j),
+                 "score": float(row[j])}
+                for j in order
+            ]
+        self.last_scores = top
+
+    # -- optional stdlib HTTP transport ---------------------------------------
+
+    def serve_forever(self, port: int = 10251):  # pragma: no cover - manual
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+        from urllib.parse import parse_qsl, urlparse
+
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                status, body = service.handle(
+                    parsed.path, dict(parse_qsl(parsed.query))
+                )
+                if isinstance(body, str):
+                    payload = body.encode()
+                    ctype = "text/plain"
+                else:
+                    payload = json.dumps(body, default=str).encode()
+                    ctype = "application/json"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):
+                pass
+
+        HTTPServer(("127.0.0.1", port), Handler).serve_forever()

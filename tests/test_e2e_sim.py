@@ -1,0 +1,277 @@
+"""End-to-end cluster simulation — the kind-e2e analog (SURVEY.md §4):
+
+admission webhook -> quota evaluation -> batched TPU scheduling ->
+node agent enforcement on a fake kernel fs -> NodeMetric reporting ->
+manager colocation math -> batch capacity appears -> BE pods schedule ->
+hot node -> descheduler eviction. One test class per flow stage plus a
+whole-loop scenario.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import crds, extension as ext
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim, resource_vector
+from koordinator_tpu.koordlet.daemon import Daemon
+from koordinator_tpu.koordlet.statesinformer import NodeInfo, PodMeta
+from koordinator_tpu.koordlet.system.config import test_config as make_test_config
+from koordinator_tpu.manager import sloconfig
+from koordinator_tpu.manager.nodemetric import NodeMetricController
+from koordinator_tpu.manager.noderesource_controller import (
+    MIB, NodeRecord, NodeResourceController,
+)
+from koordinator_tpu.manager.webhook import (
+    PodMutatingWebhook, PodValidatingWebhook, QuotaEvaluator,
+)
+from koordinator_tpu.scheduler.barrier import SyncBarrier
+from koordinator_tpu.scheduler.scheduler import Scheduler
+from koordinator_tpu.scheduler.services import DebugService
+from koordinator_tpu.scheduler.snapshot import ClusterSnapshot, NodeSpec, PodSpec
+from tests.test_koordlet_metrics import FakeClock
+
+
+def make_cluster(n_nodes=4, cpu=16000, mem=32768):
+    snapshot = ClusterSnapshot(capacity=16)
+    for i in range(n_nodes):
+        snapshot.upsert_node(NodeSpec(
+            name=f"n{i}",
+            allocatable=resource_vector({"cpu": cpu, "memory": mem}),
+        ))
+    return snapshot
+
+
+def be_pod_dict(name, cpu="2", memory="4Gi"):
+    return {
+        "metadata": {"name": name, "namespace": "default",
+                     "labels": {"app": "spark"}},
+        "spec": {"containers": [{"name": "m", "resources": {
+            "requests": {"cpu": cpu, "memory": memory},
+            "limits": {"cpu": cpu, "memory": memory}}}]},
+    }
+
+
+class TestFullColocationLoop:
+    """The SURVEY 3.1 + 3.2 loops stitched together."""
+
+    def test_admission_to_enforcement_to_capacity(self, tmp_path):
+        clock = FakeClock()
+        # --- control plane setup
+        profile = crds.ClusterColocationProfile(
+            name="colo", pod_selector={"app": "spark"}, qos_class="BE",
+            koordinator_priority=5500, scheduler_name="koord-scheduler")
+        mutating = PodMutatingWebhook([profile])
+        validating = PodValidatingWebhook()
+        snapshot = make_cluster()
+        scheduler = Scheduler(snapshot)
+        service = DebugService(scheduler)
+
+        # --- 1. admission: BE pod arrives, gets QoS + batch translation
+        pod = be_pod_dict("spark-1")
+        mutating.mutate(pod)
+        assert validating.validate(pod) == []
+        requests = pod["spec"]["containers"][0]["resources"]["requests"]
+        assert requests[ext.RESOURCE_BATCH_CPU] == 2000
+
+        # --- 2. no batch capacity yet: pod must NOT schedule
+        batch_req = resource_vector({
+            ext.RESOURCE_BATCH_CPU: requests[ext.RESOURCE_BATCH_CPU],
+            ext.RESOURCE_BATCH_MEMORY: requests[ext.RESOURCE_BATCH_MEMORY] // MIB,
+        })
+        scheduler.enqueue(PodSpec(name="spark-1", requests=batch_req,
+                                  priority=5500, qos=int(QoSClass.BE)))
+        result = scheduler.schedule_round()
+        assert "spark-1" in result.failures
+        status, diag = service.handle("/apis/v1/diagnosis")
+        assert status == 200 and "spark-1" in diag
+
+        # --- 3. node agent reports usage; manager computes batch capacity
+        cfg = make_test_config(tmp_path)
+        daemon = Daemon(cfg=cfg, clock=clock)
+        daemon.states.set_node(NodeInfo(name="n0",
+                                        allocatable={"cpu": 16000,
+                                                     "memory": 32768 * MIB}))
+        os.makedirs(cfg.proc_root, exist_ok=True)
+        for i in range(6):
+            open(cfg.proc_path("stat"), "w").write(
+                f"cpu  {int(4.0 * (clock.t - 900) * 100)} 0 0 800 0 0 0 0 0 0\n")
+            open(cfg.proc_path("meminfo"), "w").write(
+                "MemTotal: 33554432 kB\nMemAvailable: 25165824 kB\nCached: 0\n")
+            daemon.tick()
+            clock.tick(30)
+        status_report = daemon.states.build_node_metric()
+
+        nm = NodeMetricController(clock=clock)
+        nm.upsert_node("n0")
+        nm.report_status("n0", status_report)
+        nrc = NodeResourceController(
+            sloconfig.ColocationConfig(enable=True), clock=clock)
+        records = [NodeRecord(name=f"n{i}", cpu_capacity_milli=16000,
+                              mem_capacity_mib=32768,
+                              metric=nm.get("n0").status) for i in range(4)]
+        patches = {p.name: p for p in nrc.reconcile(records)}
+        assert patches["n0"].batch_cpu_milli > 2000
+
+        # --- 4. patch batch capacity onto nodes -> pod schedules
+        for name, patch in patches.items():
+            alloc = resource_vector({
+                "cpu": 16000, "memory": 32768,
+                ext.RESOURCE_BATCH_CPU: patch.batch_cpu_milli,
+                ext.RESOURCE_BATCH_MEMORY: patch.batch_mem_mib,
+            })
+            snapshot.upsert_node(NodeSpec(name=name, allocatable=alloc))
+        result = scheduler.schedule_round()
+        assert result.assignments.get("spark-1") in {"n0", "n1", "n2", "n3"}
+
+        # --- 5. the agent enforces the scheduled pod's batch limits
+        node = result.assignments["spark-1"]
+        agent_pod = PodMeta(
+            uid="spark-1", name="spark-1", namespace="default",
+            qos_class=QoSClass.BE, kube_qos="besteffort", priority=5500,
+            requests={ext.RESOURCE_BATCH_CPU: 2000,
+                      ext.RESOURCE_BATCH_MEMORY: 4 << 30},
+        )
+        daemon.states.set_pods([agent_pod])
+        from koordinator_tpu.koordlet.system import cgroup as cg
+        from tests.test_koordlet_system import write_cgroup_file
+
+        rel = agent_pod.cgroup_dir(cfg)
+        for res in (cg.CPU_CFS_QUOTA, cg.CPU_SHARES, cg.MEMORY_LIMIT,
+                    cg.CPU_BVT_WARP_NS):
+            write_cgroup_file(cfg, res, rel, "0")
+        daemon.tick()
+        assert cg.cgroup_read(cg.CPU_CFS_QUOTA, rel, cfg) == "200000"
+        assert cg.cgroup_read(cg.CPU_BVT_WARP_NS, rel, cfg) == "-1"
+
+    def test_quota_gate_in_admission(self):
+        evaluator = QuotaEvaluator()
+        evaluator.set_quota(crds.ElasticQuota(
+            name="spark", parent="root",
+            max={ext.RESOURCE_BATCH_CPU: 3000}))
+        assert evaluator.admit("spark", {ext.RESOURCE_BATCH_CPU: 2000}) is None
+        assert evaluator.admit("spark", {ext.RESOURCE_BATCH_CPU: 2000}) is not None
+
+
+class TestSyncBarrier:
+    def test_gates_until_observed(self):
+        clock = FakeClock()
+        source_version = [10]
+        observed = [5]
+        barrier = SyncBarrier(
+            mark=lambda: source_version[0],
+            observed_version=lambda: observed[0],
+            timeout_seconds=5.0, clock=clock, sleep=lambda s: clock.tick(s),
+        )
+        barrier.start()
+        assert not barrier.check()
+        observed[0] = 10
+        assert barrier.check()
+
+    def test_timeout_opens_anyway(self):
+        clock = FakeClock()
+        barrier = SyncBarrier(
+            mark=lambda: 100, observed_version=lambda: 1,
+            timeout_seconds=1.0, clock=clock, sleep=lambda s: clock.tick(s),
+        )
+        barrier.start()
+        assert barrier.wait_until_synced() is False
+        assert barrier.synced  # open but reported
+
+    def test_fresh_process_not_gated(self):
+        barrier = SyncBarrier(mark=lambda: 1, observed_version=lambda: 0)
+        assert barrier.check()
+
+
+class TestDebugService:
+    def make(self):
+        snapshot = make_cluster(2)
+        scheduler = Scheduler(snapshot)
+        return DebugService(scheduler), scheduler
+
+    def test_nodes_and_pods_routes(self):
+        service, scheduler = self.make()
+        scheduler.enqueue(PodSpec(name="p1",
+                                  requests=resource_vector({"cpu": 1000}),
+                                  priority=9500))
+        status, nodes = service.handle("/apis/v1/nodes")
+        assert status == 200 and len(nodes) == 2
+        status, pods = service.handle("/apis/v1/pods")
+        assert pods[0]["name"] == "p1"
+
+    def test_unknown_route_404(self):
+        service, _ = self.make()
+        status, body = service.handle("/nope")
+        assert status == 404
+
+    def test_plugin_mount(self):
+        service, _ = self.make()
+        service.register_plugin("loadaware", "status", lambda p: {"ok": True})
+        status, body = service.handle("/apis/v1/plugins/loadaware/status")
+        assert status == 200 and body == {"ok": True}
+
+    def test_metrics_scrape(self):
+        service, _ = self.make()
+        status, body = service.handle("/metrics")
+        assert status == 200 and "koord_scheduler" in body
+
+    def test_top_n_scores_toggle(self):
+        service, scheduler = self.make()
+        status, body = service.handle("/apis/v1/__debug/set-top-n", {"n": 2})
+        assert body["dump_top_n_scores"] == 2
+        scores = np.asarray([[10.0, 20.0]])
+        service.record_scores(
+            [PodSpec(name="p", requests=resource_vector({}))], scores,
+            ["n0", "n1"],
+        )
+        status, dumped = service.handle("/apis/v1/__debug/scores")
+        assert dumped["p"][0]["node"] == "n1"
+
+
+class TestBarrierGatesScheduler:
+    def test_round_noop_until_synced(self):
+        observed = [0]
+        barrier = SyncBarrier(mark=lambda: 7, observed_version=lambda: observed[0])
+        barrier.start()
+        snapshot = make_cluster(2)
+        scheduler = Scheduler(snapshot, barrier=barrier)
+        scheduler.enqueue(PodSpec(name="p1",
+                                  requests=resource_vector({"cpu": 1000}),
+                                  priority=9500))
+        result = scheduler.schedule_round()
+        assert result.round_pods == 0 and "p1" in scheduler.pending
+        observed[0] = 7
+        result = scheduler.schedule_round()
+        assert result.assignments.get("p1")
+
+
+class TestScoreDumpWired:
+    def test_solve_records_scores(self):
+        snapshot = make_cluster(2)
+        scheduler = Scheduler(snapshot)
+        service = DebugService(scheduler)
+        scheduler.debug_service = service
+        service.dump_top_n_scores = 2
+        scheduler.enqueue(PodSpec(name="p1",
+                                  requests=resource_vector({"cpu": 1000}),
+                                  priority=9500))
+        scheduler.schedule_round()
+        status, dumped = service.handle("/apis/v1/__debug/scores")
+        assert "p1" in dumped and len(dumped["p1"]) == 2
+
+    def test_diagnosis_structured(self):
+        snapshot = make_cluster(1, cpu=100)
+        scheduler = Scheduler(snapshot)
+        service = DebugService(scheduler)
+        scheduler.enqueue(PodSpec(name="big",
+                                  requests=resource_vector({"cpu": 999000}),
+                                  priority=9500))
+        scheduler.schedule_round()
+        status, diag = service.handle("/apis/v1/diagnosis")
+        assert isinstance(diag["big"], dict)  # structured, not a repr string
+        # stale diagnosis cleared once the queue drains
+        scheduler.dequeue("big")
+        scheduler.schedule_round()
+        status, diag = service.handle("/apis/v1/diagnosis")
+        assert diag == {}
