@@ -1,0 +1,110 @@
+"""Kubelet read-only client stub (reference: ``statesinformer/impl/
+kubelet_stub.go:40`` — fetches /pods and /configz over the kubelet's HTTPS
+endpoint; the pods informer falls back to it when the apiserver watch lags).
+
+``fetch_fn`` abstracts the transport (HTTPS client in production, fixture
+JSON in tests); parsing converts the kubelet PodList payload into the agent's
+:class:`~koordinator_tpu.koordlet.statesinformer.PodMeta` model.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Optional
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.koordlet.statesinformer import ContainerMeta, PodMeta
+
+_KUBE_QOS = {
+    "Guaranteed": "guaranteed",
+    "Burstable": "burstable",
+    "BestEffort": "besteffort",
+}
+
+
+def _parse_quantity(value) -> int:
+    """cpu -> milli, memory -> bytes (k8s quantity strings)."""
+    if isinstance(value, (int, float)):
+        return int(value)
+    s = str(value)
+    try:
+        if s.endswith("m"):
+            return int(s[:-1])
+        for suffix, mult in (("Ki", 1 << 10), ("Mi", 1 << 20), ("Gi", 1 << 30),
+                             ("Ti", 1 << 40), ("k", 10**3), ("M", 10**6),
+                             ("G", 10**9)):
+            if s.endswith(suffix):
+                return int(float(s[: -len(suffix)]) * mult)
+        return int(float(s))
+    except ValueError:
+        return 0
+
+
+def parse_pod_list(payload: dict) -> list[PodMeta]:
+    """kubelet /pods PodList JSON -> PodMeta list."""
+    out = []
+    for item in payload.get("items", []):
+        meta = item.get("metadata", {})
+        spec = item.get("spec", {})
+        status = item.get("status", {})
+        labels = meta.get("labels", {}) or {}
+        requests: dict[str, int] = {}
+        limits: dict[str, int] = {}
+        def quantity(name: str, value) -> int:
+            # cpu quantities normalize to milli-cores: "2" -> 2000, "500m" -> 500
+            if name == "cpu" and not str(value).endswith("m"):
+                try:
+                    return int(float(value) * 1000)
+                except (TypeError, ValueError):
+                    return 0
+            return _parse_quantity(value)
+
+        for container in spec.get("containers", []):
+            resources = container.get("resources", {})
+            for name, value in (resources.get("requests") or {}).items():
+                requests[name] = requests.get(name, 0) + quantity(name, value)
+            for name, value in (resources.get("limits") or {}).items():
+                limits[name] = limits.get(name, 0) + quantity(name, value)
+        containers = []
+        for cs in status.get("containerStatuses", []):
+            cid = cs.get("containerID", "")
+            containers.append(ContainerMeta(
+                name=cs.get("name", ""),
+                container_id=cid.split("//")[-1] if cid else "",
+            ))
+        out.append(PodMeta(
+            uid=meta.get("uid", ""),
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            qos_class=QoSClass.parse(labels.get(ext.LABEL_POD_QOS, "")),
+            kube_qos=_KUBE_QOS.get(status.get("qosClass", ""), "besteffort"),
+            priority=spec.get("priority", 0) or 0,
+            phase=status.get("phase", "Pending"),
+            requests=requests,
+            limits=limits,
+            containers=tuple(containers),
+            annotations=meta.get("annotations", {}) or {},
+            labels=labels,
+            host_network=bool(spec.get("hostNetwork", False)),
+        ))
+    return out
+
+
+class KubeletStub:
+    def __init__(self, fetch_fn: Callable[[str], str]):
+        """fetch_fn(path) -> response body ('/pods', '/configz')."""
+        self.fetch_fn = fetch_fn
+
+    def get_all_pods(self) -> list[PodMeta]:
+        body = self.fetch_fn("/pods")
+        return parse_pod_list(json.loads(body))
+
+    def get_kubelet_configz(self) -> dict:
+        """kubelet config (cpuManagerPolicy, reservedCPUs...)."""
+        try:
+            return json.loads(self.fetch_fn("/configz")).get(
+                "kubeletconfig", {}
+            )
+        except (json.JSONDecodeError, OSError):
+            return {}

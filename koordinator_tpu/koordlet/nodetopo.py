@@ -1,0 +1,98 @@
+"""Node resource topology reporting (reference: koordlet's NodeTopologyReport
+feature — builds the NodeResourceTopology CRD (topology.node.k8s.io) the
+NUMA-aware scheduler consumes, from lscpu/sysfs + kubelet cpu-manager state).
+
+Produces per-NUMA-zone capacities plus the detailed CPU topology map
+(cpu -> core/socket/node) and the kubelet-reserved/system-QoS CPU sets the
+scheduler must avoid when allocating exclusive CPUs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Mapping, Optional
+
+from koordinator_tpu.koordlet.system import procfs
+from koordinator_tpu.koordlet.system.config import SystemConfig, get_config
+
+
+@dataclasses.dataclass(frozen=True)
+class NUMAZone:
+    name: str                  # "node0"
+    cpu_milli: int
+    memory_bytes: int
+    cpus: tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeTopology:
+    """The NRT payload + koordinator's topology annotations."""
+
+    zones: tuple[NUMAZone, ...]
+    cpu_topology: tuple[procfs.CPUInfo, ...]
+    kubelet_reserved_cpus: tuple[int, ...] = ()
+    system_qos_cpus: tuple[int, ...] = ()
+    cpu_manager_policy: str = "none"
+
+    def to_annotations(self) -> dict[str, str]:
+        """The node-side annotations the scheduler's topology options read."""
+        return {
+            "node.koordinator.sh/cpu-topology": json.dumps({
+                "detail": [
+                    {"cpu": c.cpu, "core": c.core, "socket": c.socket,
+                     "node": c.node}
+                    for c in self.cpu_topology
+                ],
+            }, sort_keys=True),
+            "node.koordinator.sh/reserved-cpus": procfs.format_cpu_list(
+                list(self.kubelet_reserved_cpus)
+            ),
+            "kubelet.koordinator.sh/cpu-manager-policy": json.dumps(
+                {"policy": self.cpu_manager_policy}, sort_keys=True
+            ),
+        }
+
+
+class NodeTopologyReporter:
+    def __init__(self, cfg: Optional[SystemConfig] = None,
+                 memory_per_zone: Optional[Mapping[int, int]] = None,
+                 kubelet_reserved_cpus: tuple[int, ...] = (),
+                 cpu_manager_policy: str = "none"):
+        self.cfg = cfg or get_config()
+        self.memory_per_zone = dict(memory_per_zone or {})
+        self.kubelet_reserved_cpus = kubelet_reserved_cpus
+        self.cpu_manager_policy = cpu_manager_policy
+
+    def _zone_memory(self, node: int) -> int:
+        if node in self.memory_per_zone:
+            return self.memory_per_zone[node]
+        # /sys/devices/system/node/nodeN/meminfo: "Node N MemTotal: X kB"
+        path = self.cfg.sys_path("devices", "system", "node", f"node{node}",
+                                 "meminfo")
+        try:
+            with open(path) as f:
+                for line in f:
+                    if "MemTotal" in line:
+                        return int(line.split()[-2]) * 1024
+        except (OSError, ValueError, IndexError):
+            pass
+        return 0
+
+    def report(self) -> NodeTopology:
+        topology = procfs.read_cpu_topology(self.cfg)
+        zones = []
+        for node in topology.numa_nodes():
+            cpus = tuple(topology.cpus_in_node(node))
+            zones.append(NUMAZone(
+                name=f"node{node}",
+                cpu_milli=len(cpus) * 1000,
+                memory_bytes=self._zone_memory(node),
+                cpus=cpus,
+            ))
+        return NodeTopology(
+            zones=tuple(zones),
+            cpu_topology=topology.cpus,
+            kubelet_reserved_cpus=self.kubelet_reserved_cpus,
+            cpu_manager_policy=self.cpu_manager_policy,
+        )

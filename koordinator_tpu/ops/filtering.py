@@ -61,14 +61,14 @@ def usage_threshold_mask(
     else:
         est = usage
 
-    # round(est*100/total) with integer math; guard total==0 (dim skipped).
-    # floor((100e + floor(t/2))/t) == round-half-up for either parity of t,
-    # and keeps the intermediate below 100*est (int32-safe for est < 2^31/100,
-    # the documented per-dim bound — see api/resources.py).
-    pct = jnp.where(
-        total > 0, (MAX_SCALE * est + total // 2) // jnp.maximum(total, 1), 0
-    )
-    exceeded = (thresholds > 0) & (total > 0) & (pct > thresholds)
+    # round(est*100/total) > thr, with round-half-up = floor((100e + t//2)/t).
+    # The quotient itself is never needed — cross-multiplying gives the exact
+    # same predicate with no division (the hot-loop win: this runs per
+    # (pod, node, dim)):  floor(A/t) > thr  <=>  A >= (thr+1)*t.
+    # int32-safe: A <= 100*est + t/2 < 2^31 and (thr+1)*t <= 101*MAX_QUANTITY
+    # < 2^31 for the documented quantity bound (api/resources.py).
+    a = MAX_SCALE * est + total // 2
+    exceeded = (thresholds > 0) & (total > 0) & (a >= (thresholds + 1) * total)
     return ~jnp.any(exceeded, axis=-1)
 
 

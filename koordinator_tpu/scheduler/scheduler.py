@@ -78,6 +78,7 @@ class Scheduler:
         topology_tree: TopologyArrays | None = None,
         barrier=None,
         debug_service=None,
+        hints=None,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -93,6 +94,8 @@ class Scheduler:
         self.barrier = barrier
         #: debug service for top-N score dumps (services.DebugService)
         self.debug_service = debug_service
+        #: scheduling hints (hints.SchedulingHints) — mask edits per pod
+        self.hints = hints
         self.last_result = SchedulingResult({}, {}, 0)
         self.pending: dict[str, PodSpec] = {}
         self.gangs: dict[str, GangRecord] = {}
@@ -144,7 +147,10 @@ class Scheduler:
             if pod.quota is not None and pod.quota in quota_index:
                 quota_id[i] = quota_index[pod.quota]
             non_preempt[i] = pod.non_preemptible
-            feasible[i] = self.snapshot.feasibility_row(pod)
+            row = self.snapshot.feasibility_row(pod)
+            if self.hints is not None:
+                row = self.hints.apply_to_mask(pod.name, row)
+            feasible[i] = row
         return PodBatch.build(
             requests, priority=priority, qos=qos, gang_id=gang_id,
             quota_id=quota_id, non_preemptible=non_preempt,

@@ -1,0 +1,108 @@
+"""Scheduling hints, cross-scheduler nomination, in-place resize."""
+
+import numpy as np
+
+from koordinator_tpu.api.resources import resource_vector
+from koordinator_tpu.scheduler.hints import (
+    CrossSchedulerNominator, PodHint, SchedulingHints, resize_pod,
+)
+from koordinator_tpu.scheduler.scheduler import Scheduler
+from koordinator_tpu.scheduler.snapshot import PodSpec
+from tests.test_e2e_sim import make_cluster
+
+
+class TestSchedulingHints:
+    def test_excluded_node_skipped(self):
+        snapshot = make_cluster(3)
+        hints = SchedulingHints(snapshot)
+        scheduler = Scheduler(snapshot, hints=hints)
+        hints.set_hint("p1", PodHint(excluded_nodes={"n0", "n1"}))
+        scheduler.enqueue(PodSpec(name="p1",
+                                  requests=resource_vector({"cpu": 1000}),
+                                  priority=9500))
+        result = scheduler.schedule_round()
+        assert result.assignments["p1"] == "n2"
+
+    def test_preferred_restricts(self):
+        snapshot = make_cluster(3)
+        hints = SchedulingHints(snapshot)
+        scheduler = Scheduler(snapshot, hints=hints)
+        hints.set_hint("p1", PodHint(preferred_nodes={"n1"}))
+        scheduler.enqueue(PodSpec(name="p1",
+                                  requests=resource_vector({"cpu": 1000}),
+                                  priority=9500))
+        result = scheduler.schedule_round()
+        assert result.assignments["p1"] == "n1"
+
+    def test_infeasible_preference_ignored(self):
+        snapshot = make_cluster(2)
+        hints = SchedulingHints(snapshot)
+        hints.set_hint("p1", PodHint(preferred_nodes={"ghost"}))
+        mask = hints.apply_to_mask("p1", np.array([True, True]))
+        assert mask.all()  # no feasible preferred node -> unrestricted
+
+    def test_record_failure_excludes(self):
+        snapshot = make_cluster(2)
+        hints = SchedulingHints(snapshot)
+        hints.record_failure("p1", "n0")
+        mask = hints.apply_to_mask("p1", np.array([True, True]))
+        assert not mask[0] and mask[1]
+
+
+class TestCrossSchedulerNominator:
+    def test_nomination_charges_capacity(self):
+        snapshot = make_cluster(1, cpu=4000)
+        nominator = CrossSchedulerNominator(snapshot)
+        assert nominator.nominate("other-pod", "n0",
+                                  resource_vector({"cpu": 3000}))
+        scheduler = Scheduler(snapshot)
+        scheduler.enqueue(PodSpec(name="mine",
+                                  requests=resource_vector({"cpu": 2000}),
+                                  priority=9500))
+        result = scheduler.schedule_round()
+        assert "mine" in result.failures  # 3000 claimed, only 1000 free
+        nominator.release("other-pod")
+        scheduler.enqueue(PodSpec(name="mine",
+                                  requests=resource_vector({"cpu": 2000}),
+                                  priority=9500))
+        result = scheduler.schedule_round()
+        assert result.assignments.get("mine") == "n0"
+
+    def test_double_nomination_rejected(self):
+        snapshot = make_cluster(1)
+        nominator = CrossSchedulerNominator(snapshot)
+        assert nominator.nominate("p", "n0", resource_vector({"cpu": 100}))
+        assert not nominator.nominate("p", "n0", resource_vector({"cpu": 100}))
+        assert nominator.nominated_node("p") == "n0"
+
+
+class TestResizePod:
+    def test_grow_within_free(self):
+        snapshot = make_cluster(1, cpu=4000)
+        snapshot.reserve("n0", resource_vector({"cpu": 1000}))
+        ok, reason = resize_pod(
+            snapshot, "n0",
+            resource_vector({"cpu": 1000}), resource_vector({"cpu": 2000}))
+        assert ok, reason
+        snapshot.flush()
+        free = np.asarray(snapshot.state.free)[snapshot.node_index["n0"]]
+        assert free[0] == 4000 - 2000
+
+    def test_grow_beyond_free_rejected(self):
+        snapshot = make_cluster(1, cpu=4000)
+        snapshot.reserve("n0", resource_vector({"cpu": 3500}))
+        ok, reason = resize_pod(
+            snapshot, "n0",
+            resource_vector({"cpu": 3500}), resource_vector({"cpu": 4500}))
+        assert not ok and "insufficient" in reason
+
+    def test_shrink_releases(self):
+        snapshot = make_cluster(1, cpu=4000)
+        snapshot.reserve("n0", resource_vector({"cpu": 3000}))
+        ok, _ = resize_pod(
+            snapshot, "n0",
+            resource_vector({"cpu": 3000}), resource_vector({"cpu": 1000}))
+        assert ok
+        snapshot.flush()
+        free = np.asarray(snapshot.state.free)[snapshot.node_index["n0"]]
+        assert free[0] == 3000

@@ -1,0 +1,90 @@
+"""NodeTopology reporter + kubelet stub parsing."""
+
+import json
+import os
+
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.koordlet.kubelet_stub import KubeletStub, parse_pod_list
+from koordinator_tpu.koordlet.nodetopo import NodeTopologyReporter
+from koordinator_tpu.koordlet.system.config import test_config as make_test_config
+
+
+def make_sysfs_topology(cfg, n_cpus=4, n_numa=2, mem_kb_per_node=1000000):
+    base = os.path.join(cfg.sys_root, "devices", "system", "cpu")
+    os.makedirs(base, exist_ok=True)
+    with open(os.path.join(base, "online"), "w") as f:
+        f.write(f"0-{n_cpus - 1}")
+    for cpu in range(n_cpus):
+        topo = os.path.join(base, f"cpu{cpu}", "topology")
+        os.makedirs(topo, exist_ok=True)
+        with open(os.path.join(topo, "core_id"), "w") as f:
+            f.write(str(cpu // 2))
+        with open(os.path.join(topo, "physical_package_id"), "w") as f:
+            f.write("0")
+        node = cpu % n_numa
+        os.makedirs(os.path.join(base, f"cpu{cpu}", f"node{node}"), exist_ok=True)
+    for node in range(n_numa):
+        nd = os.path.join(cfg.sys_root, "devices", "system", "node", f"node{node}")
+        os.makedirs(nd, exist_ok=True)
+        with open(os.path.join(nd, "meminfo"), "w") as f:
+            f.write(f"Node {node} MemTotal: {mem_kb_per_node} kB\n")
+
+
+class TestNodeTopologyReporter:
+    def test_zones_and_annotations(self, tmp_path):
+        cfg = make_test_config(tmp_path)
+        make_sysfs_topology(cfg)
+        reporter = NodeTopologyReporter(
+            cfg, kubelet_reserved_cpus=(0,), cpu_manager_policy="static")
+        topo = reporter.report()
+        assert len(topo.zones) == 2
+        assert topo.zones[0].cpu_milli == 2000
+        assert topo.zones[0].memory_bytes == 1000000 * 1024
+        ann = topo.to_annotations()
+        detail = json.loads(ann["node.koordinator.sh/cpu-topology"])["detail"]
+        assert len(detail) == 4
+        assert ann["node.koordinator.sh/reserved-cpus"] == "0"
+        assert "static" in ann["kubelet.koordinator.sh/cpu-manager-policy"]
+
+
+KUBELET_PODS = {
+    "items": [
+        {
+            "metadata": {"uid": "u1", "name": "web", "namespace": "prod",
+                         "labels": {ext.LABEL_POD_QOS: "LS"}},
+            "spec": {
+                "priority": 9500,
+                "containers": [{"name": "c1", "resources": {
+                    "requests": {"cpu": "2", "memory": "4Gi"},
+                    "limits": {"cpu": "2500m", "memory": "4Gi"}}}],
+            },
+            "status": {"phase": "Running", "qosClass": "Burstable",
+                       "containerStatuses": [
+                           {"name": "c1",
+                            "containerID": "containerd://abc123"}]},
+        },
+    ]
+}
+
+
+class TestKubeletStub:
+    def test_parse_pods(self):
+        pods = parse_pod_list(KUBELET_PODS)
+        assert len(pods) == 1
+        pod = pods[0]
+        assert pod.uid == "u1" and pod.qos_class == QoSClass.LS
+        assert pod.kube_qos == "burstable"
+        assert pod.requests["cpu"] == 2000       # "2" cores -> milli
+        assert pod.limits["cpu"] == 2500         # "2500m" stays milli
+        assert pod.requests["memory"] == 4 << 30
+        assert pod.containers[0].container_id == "abc123"
+
+    def test_stub_fetch(self):
+        stub = KubeletStub(lambda path: json.dumps(
+            KUBELET_PODS if path == "/pods"
+            else {"kubeletconfig": {"cpuManagerPolicy": "static"}}))
+        assert len(stub.get_all_pods()) == 1
+        assert stub.get_kubelet_configz()["cpuManagerPolicy"] == "static"
