@@ -1,0 +1,354 @@
+"""Batched preemption: victim-subset selection as a tensor solve.
+
+The reference implements preemption in three cooperating places:
+
+- elastic-quota victim selection (`pkg/scheduler/plugins/elasticquota/preempt.go:111`
+  ``SelectVictimsOnNode``): remove every lower-priority same-quota pod from the
+  node, check the preemptor fits, then *reprieve* victims most-important-first
+  (PDB-violating candidates get the first chance to come back), keeping a pod
+  evicted only when adding it back would break the node fit or push the quota
+  past its used limit; ``canPreempt`` (`preempt.go:289`) restricts candidates to
+  lower-priority, preemptible pods of the same quota.
+- gang/job-level preemption (`pkg/scheduler/plugins/coscheduling/core/preemption.go:206`
+  ``Preempt``): a whole gang's pending pods preempt together, all-or-nothing;
+  victims are lower-priority pods (`:405 isPreemptionAllowed`), reprieve order
+  is priority-descending (`:819 sortVictims`).
+- reservation PostFilter (`pkg/scheduler/plugins/reservation/plugin.go:1058`):
+  a reservation's reserve-pod preempts like an ordinary pod.
+
+The TPU redesign collapses the per-node dry-run loops into ONE scan over the
+globally-sorted candidate list: each scan step touches only its candidate's
+node row, so per-node reprieve order is preserved while every node's dry run
+advances in the same pass.  Node selection afterwards is the upstream
+``pickOneNodeForPreemption`` lexicographic rule as a sequence of masked
+reductions.
+
+PDB semantics (`preempt.go:224 filterPodsWithPDBViolation`): a candidate is
+"violating" when evicting it would exceed its PDB's remaining disruption
+budget, counted per (node, pdb) in importance order — violating candidates are
+reprieved first so the chosen victim set violates as few budgets as possible,
+and the winning node minimizes violations lexicographically first.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+from koordinator_tpu.state.cluster_state import ClusterState
+
+#: sentinel priority placed below any real koordinator priority band
+NEG_PRI = jnp.int32(-(2**31) + 1)
+
+
+@struct.dataclass
+class ScheduledPods:
+    """Bound (running) pods — the victim-candidate universe. Shape (V, ...)."""
+
+    requests: jax.Array        # (V, R) int32
+    node: jax.Array            # (V,) int32 — node row the pod is bound to
+    priority: jax.Array        # (V,) int32
+    quota_id: jax.Array        # (V,) int32, -1 = none
+    non_preemptible: jax.Array # (V,) bool — extension.IsPodNonPreemptible
+    pdb_id: jax.Array          # (V,) int32, -1 = no PDB matches
+    valid: jax.Array           # (V,) bool
+
+    @property
+    def capacity(self) -> int:
+        return self.requests.shape[0]
+
+    @classmethod
+    def build(
+        cls,
+        requests: np.ndarray,          # (v, R)
+        node: np.ndarray,              # (v,)
+        priority: np.ndarray | None = None,
+        quota_id: np.ndarray | None = None,
+        non_preemptible: np.ndarray | None = None,
+        pdb_id: np.ndarray | None = None,
+        capacity: int | None = None,
+    ) -> "ScheduledPods":
+        v = len(requests)
+        cap = capacity if capacity is not None else max(8, 1 << max(v - 1, 0).bit_length())
+        req = np.zeros((cap, requests.shape[1] if v else NUM_RESOURCE_DIMS), np.int32)
+        req[:v] = requests
+
+        def pad1(a, fill, dtype):
+            out = np.full(cap, fill, dtype=dtype)
+            if a is not None:
+                out[:v] = a
+            return jnp.asarray(out)
+
+        valid = np.zeros(cap, bool)
+        valid[:v] = True
+        return cls(
+            requests=jnp.asarray(req),
+            node=pad1(node, -1, np.int32),
+            priority=pad1(priority, 0, np.int32),
+            quota_id=pad1(quota_id, -1, np.int32),
+            non_preemptible=pad1(non_preemptible, False, bool),
+            pdb_id=pad1(pdb_id, -1, np.int32),
+            valid=jnp.asarray(valid),
+        )
+
+
+def _fits(req: jnp.ndarray, free: jnp.ndarray) -> jnp.ndarray:
+    """(..., R) fit check with the fit_mask convention (req==0 never blocks)."""
+    return jnp.all((req <= free) | (req == 0), axis=-1)
+
+
+def _pdb_violating(
+    cand: jnp.ndarray,        # (V,) bool
+    order: jnp.ndarray,       # (V,) candidate indices, importance-descending
+    node: jnp.ndarray,        # (V,) int32
+    pdb_id: jnp.ndarray,      # (V,) int32
+    pdb_allowed: jnp.ndarray, # (B,) int32 disruptionsAllowed
+    node_capacity: int,
+) -> jnp.ndarray:
+    """(V,) bool: per-(node, pdb) rank in importance order >= remaining budget.
+
+    Mirrors filterPodsWithPDBViolation: walking a node's candidates
+    most-important-first, each PDB match decrements that budget; a candidate
+    whose decrement takes the budget negative is "violating".
+    """
+    b = pdb_allowed.shape[0]
+    # segment id per candidate: node * B + pdb (only meaningful when pdb >= 0)
+    has_pdb = cand & (pdb_id >= 0)
+    seg = jnp.where(has_pdb, node * b + jnp.maximum(pdb_id, 0), node_capacity * b)
+    seg_in_order = seg[order]
+    # rank within segment, respecting the importance order: stable-sort the
+    # ordered list by segment, cumsum inside runs of equal segment.
+    pos = jnp.argsort(seg_in_order, stable=True)
+    seg_sorted = seg_in_order[pos]
+    ones = jnp.ones_like(seg_sorted)
+    csum = jnp.cumsum(ones) - 1                       # 0..V-1 over sorted list
+    is_start = jnp.concatenate(
+        [jnp.array([True]), seg_sorted[1:] != seg_sorted[:-1]]
+    )
+    start_of_seg = jnp.where(is_start, csum, 0)
+    start = jax.lax.associative_scan(jnp.maximum, start_of_seg)
+    rank_sorted = csum - start                        # 0-based rank in segment
+    # scatter ranks back: first to order positions, then to candidate rows
+    rank_in_order = jnp.zeros_like(rank_sorted).at[pos].set(rank_sorted)
+    rank = jnp.zeros(node.shape[0], rank_in_order.dtype).at[order].set(rank_in_order)
+    allowed = pdb_allowed[jnp.maximum(pdb_id, 0)]
+    return has_pdb & (rank >= allowed)
+
+
+@struct.dataclass
+class VictimSolve:
+    """Per-node dry-run result for one preemptor."""
+
+    eligible: jax.Array       # (N,) bool — preemptor fits after preemption
+    victim: jax.Array         # (V,) bool — victims (across all nodes)
+    violating: jax.Array      # (V,) bool — PDB-violating candidates
+    num_victims: jax.Array    # (N,) int32
+    num_violating: jax.Array  # (N,) int32
+    max_victim_pri: jax.Array # (N,) int32 (NEG_PRI when none)
+    sum_victim_pri: jax.Array # (N,) int64
+
+
+def select_victims(
+    state: ClusterState,
+    sched: ScheduledPods,
+    preemptor_req: jnp.ndarray,      # (R,) int32
+    preemptor_pri: jnp.ndarray,      # () int32
+    preemptor_quota: jnp.ndarray,    # () int32, -1 = none
+    pod_feasible: jnp.ndarray,       # (N,) bool — affinity/selector mask
+    pdb_allowed: jnp.ndarray,        # (B,) int32
+    quota_headroom: jnp.ndarray | None = None,  # (R,) int32: limit - used
+    same_quota_only: bool = False,
+) -> VictimSolve:
+    """Dry-run victim selection on every node at once.
+
+    ``same_quota_only=True`` gives elastic-quota semantics (canPreempt,
+    preempt.go:289): only lower-priority pods of the preemptor's quota are
+    candidates, and ``quota_headroom`` gates the reprieve the way
+    postFilterState.usedLimit does.  ``False`` gives the job-preemption rule
+    (isPreemptionAllowed, coscheduling preemption.go:405): any lower-priority
+    preemptible pod.
+    """
+    n_cap = state.capacity
+
+    cand = (
+        sched.valid
+        & (sched.priority < preemptor_pri)
+        & ~sched.non_preemptible
+        & (sched.node >= 0)
+    )
+    if same_quota_only:
+        cand = cand & (sched.quota_id == preemptor_quota)
+
+    # importance-descending candidate order (sortVictims: priority desc, then
+    # a stable tiebreak — we use row index)
+    pri_key = jnp.where(cand, sched.priority, NEG_PRI)
+    imp_order = jnp.lexsort((jnp.arange(sched.capacity), -pri_key))
+
+    violating = _pdb_violating(
+        cand, imp_order, sched.node, sched.pdb_id, pdb_allowed, n_cap
+    )
+
+    # reprieve order: violating first, then non-violating, importance-desc in
+    # each group; non-candidates last
+    group = jnp.where(cand, jnp.where(violating, 0, 1), 2)
+    order = jnp.lexsort((jnp.arange(sched.capacity), -pri_key, group))
+
+    # start state: every candidate removed from its node
+    safe_node = jnp.maximum(sched.node, 0)
+    freed = jax.ops.segment_sum(
+        jnp.where(cand[:, None], sched.requests, 0), safe_node,
+        num_segments=n_cap,
+    )
+    free_all = state.free + freed
+    has_cand = (
+        jax.ops.segment_sum(cand.astype(jnp.int32), safe_node, num_segments=n_cap)
+        > 0
+    )
+
+    if quota_headroom is not None:
+        # per-node quota dry run: each node's cycle state starts with its own
+        # candidates' requests returned to the quota
+        quota_free0 = quota_headroom[None, :] + freed
+    else:
+        quota_free0 = None
+
+    def step(carry, j):
+        free_all, quota_free = carry
+        nd = safe_node[j]
+        is_cand = cand[j]
+        req = sched.requests[j]
+        after_node = free_all[nd] - req
+        ok = _fits(preemptor_req, after_node)
+        if quota_free is not None:
+            ok = ok & _fits(preemptor_req, quota_free[nd] - req)
+        reprieve = is_cand & ok
+        dec = jnp.where(reprieve, req, 0)
+        free_all = free_all.at[nd].add(-dec)
+        if quota_free is not None:
+            quota_free = quota_free.at[nd].add(-dec)
+        return (free_all, quota_free), is_cand & ~ok
+
+    (free_final, quota_final), victim_in_order = jax.lax.scan(
+        step, (free_all, quota_free0), order
+    )
+    victim = jnp.zeros(sched.capacity, bool).at[order].set(victim_in_order)
+
+    eligible = (
+        _fits(preemptor_req, free_final)
+        & pod_feasible
+        & state.node_valid
+        & has_cand
+    )
+    if quota_final is not None:
+        eligible = eligible & _fits(preemptor_req, quota_final)
+
+    v_pri = jnp.where(victim, sched.priority, NEG_PRI)
+    num_victims = jax.ops.segment_sum(
+        victim.astype(jnp.int32), safe_node, num_segments=n_cap
+    )
+    num_violating = jax.ops.segment_sum(
+        (victim & violating).astype(jnp.int32), safe_node, num_segments=n_cap
+    )
+    max_victim_pri = jax.ops.segment_max(v_pri, safe_node, num_segments=n_cap)
+    max_victim_pri = jnp.where(num_victims > 0, max_victim_pri, NEG_PRI)
+    sum_victim_pri = jax.ops.segment_sum(
+        jnp.where(victim, sched.priority.astype(jnp.int64), 0),
+        safe_node, num_segments=n_cap,
+    )
+    return VictimSolve(
+        eligible=eligible,
+        victim=victim,
+        violating=violating,
+        num_victims=num_victims,
+        num_violating=num_violating,
+        max_victim_pri=max_victim_pri,
+        sum_victim_pri=sum_victim_pri,
+    )
+
+
+def pick_node(solve: VictimSolve) -> jnp.ndarray:
+    """Upstream pickOneNodeForPreemption lexicographic rule:
+
+    1. fewest PDB violations, 2. lowest highest-victim priority, 3. lowest
+    priority sum, 4. fewest victims, 5. (no start-times here) lowest node row.
+    Returns () int32 node row, -1 when no node is eligible.
+    """
+    mask = solve.eligible
+
+    def refine(mask, key):
+        # sentinel must dominate any real key value in the key's own dtype
+        # (int64 victim-priority sums can exceed int32 max)
+        big = jnp.iinfo(key.dtype).max
+        key_m = jnp.where(mask, key, big)
+        return mask & (key == jnp.min(key_m))
+
+    mask = refine(mask, solve.num_violating)
+    mask = refine(mask, solve.max_victim_pri)
+    mask = refine(mask, solve.sum_victim_pri)
+    mask = refine(mask, solve.num_victims)
+    idx = jnp.argmax(mask)  # lowest eligible row
+    return jnp.where(jnp.any(solve.eligible), idx.astype(jnp.int32), -1)
+
+
+@struct.dataclass
+class PreemptionOutcome:
+    node: jax.Array          # () int32, -1 = preemption does not help
+    victims: jax.Array       # (V,) bool — victims on the chosen node only
+    state: ClusterState      # node_requested with victims removed + preemptor nominated
+    sched: ScheduledPods     # victims invalidated
+    pdb_allowed: jax.Array   # (B,) decremented for evicted PDB members
+
+
+def preempt_one(
+    state: ClusterState,
+    sched: ScheduledPods,
+    preemptor_req: jnp.ndarray,
+    preemptor_pri: jnp.ndarray,
+    preemptor_quota: jnp.ndarray,
+    pod_feasible: jnp.ndarray,
+    pdb_allowed: jnp.ndarray,
+    quota_headroom: jnp.ndarray | None = None,
+    same_quota_only: bool = False,
+    nominate: bool = True,
+) -> PreemptionOutcome:
+    """Full PostFilter for one preemptor: dry-run, pick node, commit.
+
+    Commit removes the victims' requests from node accounting, invalidates
+    them in ``sched``, charges their PDBs, and (``nominate=True``) reserves the
+    preemptor's request on the chosen node so subsequent preemptors see it —
+    the nominated-pod semantics of the upstream preemption cycle.
+    """
+    solve = select_victims(
+        state, sched, preemptor_req, preemptor_pri, preemptor_quota,
+        pod_feasible, pdb_allowed, quota_headroom=quota_headroom,
+        same_quota_only=same_quota_only,
+    )
+    node = pick_node(solve)
+    chosen = solve.victim & (sched.node == node) & (node >= 0)
+
+    # remove victims from node accounting in one scatter
+    delta = jnp.where(chosen[:, None], sched.requests, 0)
+    removed = jax.ops.segment_sum(
+        delta, jnp.maximum(sched.node, 0), num_segments=state.capacity
+    )
+    requested = state.node_requested - removed
+    if nominate:
+        nom = jnp.where(node >= 0, preemptor_req, 0)
+        requested = requested.at[jnp.maximum(node, 0)].add(nom)
+    new_state = state.replace(node_requested=requested)
+
+    new_sched = sched.replace(valid=sched.valid & ~chosen)
+
+    pdb_hit = jax.ops.segment_sum(
+        (chosen & (sched.pdb_id >= 0)).astype(jnp.int32),
+        jnp.maximum(sched.pdb_id, 0),
+        num_segments=pdb_allowed.shape[0],
+    )
+    new_pdb = pdb_allowed - pdb_hit
+    return PreemptionOutcome(
+        node=node, victims=chosen, state=new_state, sched=new_sched,
+        pdb_allowed=new_pdb,
+    )

@@ -245,3 +245,29 @@ class QuotaTree:
 
     def runtime_of(self, name: str) -> np.ndarray:
         return self.nodes[name].runtime
+
+    def admits(
+        self,
+        name: str,
+        request: np.ndarray,
+        non_preemptible: bool = False,
+        check_parents: bool = True,
+    ) -> bool:
+        """Host-side mirror of admission.quota_admission_mask for one pod
+        (checkQuotaRecursive, elasticquota/plugin.go:256-304): used + request
+        <= runtime on the pod's quota's declared max dims, up the chain."""
+        node = self.nodes.get(name)
+        if node is None:
+            return True  # no quota: always admitted
+        req = np.asarray(request, dtype=np.int64)
+        checked = (node.max != UNBOUNDED) & (req > 0)
+        chain = self.ancestors(name) if check_parents else [name]
+        for anc in chain:
+            a = self.nodes[anc]
+            if np.any(checked & (a.used + req > a.runtime)):
+                return False
+        if non_preemptible and np.any(
+            checked & (node.non_preemptible_used + req > node.min)
+        ):
+            return False
+        return True

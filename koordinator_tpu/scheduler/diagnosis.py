@@ -33,8 +33,20 @@ class PodDiagnosis:
     affinity_mismatch: int
     quota_rejected: bool
     invalid: int
+    #: PostFilter outcome: nominated node + victims when preemption helps
+    #: (schedule_diagnosis.go records the same on the explanation)
+    preempt_node: str | None = None
+    preempt_victims: list[str] = dataclasses.field(default_factory=list)
 
     def message(self) -> str:
+        msg = self._base_message()
+        if self.preempt_node is not None:
+            victims = ", ".join(self.preempt_victims)
+            msg += (f"; fits on {self.preempt_node} after preempting "
+                    f"[{victims}]")
+        return msg
+
+    def _base_message(self) -> str:
         if self.quota_rejected:
             return "pod rejected by elastic quota admission"
         parts = []

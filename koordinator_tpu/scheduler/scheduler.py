@@ -43,6 +43,36 @@ from koordinator_tpu.state.cluster_state import PodBatch, _bucket
 
 
 @dataclasses.dataclass
+class PdbRecord:
+    """PodDisruptionBudget: selector + remaining disruption budget."""
+
+    name: str
+    selector: dict[str, str]
+    allowed: int  # status.disruptionsAllowed
+
+    def matches(self, labels: dict[str, str]) -> bool:
+        # a PDB with an empty selector matches nothing; a pod with no labels
+        # matches no PDB (filterPodsWithPDBViolation, preempt.go:224)
+        if not self.selector or not labels:
+            return False
+        return all(labels.get(k) == v for k, v in self.selector.items())
+
+
+@dataclasses.dataclass
+class BoundPod:
+    """Host record of a bound pod — the victim-candidate universe."""
+
+    name: str
+    node: str
+    requests: np.ndarray
+    priority: int = 0
+    quota: str | None = None
+    non_preemptible: bool = False
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    gang: str | None = None
+
+
+@dataclasses.dataclass
 class GangRecord:
     """Host-side gang state (PodGroup + gang annotations)."""
 
@@ -61,6 +91,10 @@ class SchedulingResult:
     assignments: dict[str, str]              # pod -> node
     failures: dict[str, PodDiagnosis]        # pod -> why
     round_pods: int = 0
+    #: PostFilter outcomes: preemptor pod -> (nominated node, victim names)
+    nominations: dict[str, tuple[str, list[str]]] = dataclasses.field(
+        default_factory=dict
+    )
 
 
 class Scheduler:
@@ -79,6 +113,8 @@ class Scheduler:
         barrier=None,
         debug_service=None,
         hints=None,
+        enable_preemption: bool | None = None,
+        preempt_fn=None,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -101,16 +137,63 @@ class Scheduler:
         self.gangs: dict[str, GangRecord] = {}
         self._solve = jax.jit(gang_assign, static_argnames=("passes",))
 
+        # -- preemption (PostFilter) state --
+        # default: only preempt when someone is wired to actually evict the
+        # victim (otherwise the scheduler would free accounting for pods that
+        # keep running, double-booking nodes)
+        self.enable_preemption = (
+            enable_preemption if enable_preemption is not None
+            else preempt_fn is not None
+        )
+        #: called as preempt_fn(victim_name, preemptor_name) on each eviction
+        self.preempt_fn = preempt_fn
+        self.bound: dict[str, BoundPod] = {}
+        self.pdbs: dict[str, PdbRecord] = {}
+        #: preemptor pod -> nominated node name (nominatedNodeName semantics)
+        self.nominations: dict[str, str] = {}
+        from koordinator_tpu.ops.preemption import preempt_one
+
+        self._preempt = jax.jit(
+            preempt_one, static_argnames=("same_quota_only", "nominate")
+        )
+
     # -- registration -------------------------------------------------------
 
     def register_gang(self, record: GangRecord) -> None:
         self.gangs[record.name] = record
 
+    def register_pdb(self, record: PdbRecord) -> None:
+        self.pdbs[record.name] = record
+
+    def add_bound_pod(self, pod: BoundPod) -> None:
+        """Seed a pre-existing bound pod (informer replay at startup).
+
+        Owns the accounting: the pod's request is reserved on its node here,
+        and released by :meth:`remove_bound_pod` — callers never touch the
+        snapshot directly, so a pod the scheduler already evicted (popped
+        from ``bound``) cannot be double-freed by a late informer delete.
+        """
+        self.bound[pod.name] = pod
+        if pod.node in self.snapshot.node_index:
+            self.snapshot.reserve(pod.node, pod.requests)
+
+    def remove_bound_pod(self, name: str) -> None:
+        """Informer pod-delete: release accounting iff still tracked."""
+        pod = self.bound.pop(name, None)
+        if pod is not None and pod.node in self.snapshot.node_index:
+            self.snapshot.unreserve(pod.node, pod.requests)
+
     def enqueue(self, pod: PodSpec) -> None:
         self.pending[pod.name] = pod
 
     def dequeue(self, pod_name: str) -> None:
-        self.pending.pop(pod_name, None)
+        # a deleted nominated preemptor must release its assumed reservation
+        # and quota charge, and must not pin a future same-named pod
+        pod = self.pending.pop(pod_name, None)
+        if pod_name in self.nominations and pod is not None:
+            self._nomination_release(pod)
+        else:
+            self.nominations.pop(pod_name, None)
 
     # -- the scheduling round ----------------------------------------------
 
@@ -245,11 +328,16 @@ class Scheduler:
             # replays past the barrier (sync_barrier.go semantics)
             return SchedulingResult({}, {}, 0)
         now = self.clock()
+        result = SchedulingResult({}, {}, 0)
+        self.last_result = result  # debug-API diagnosis surface
+        if self.nominations:
+            with self.monitor.phase("Nominated"):
+                self.snapshot.flush()
+                self._resolve_nominations(result)
         with self.monitor.phase("PreEnqueue"):
             pods = self._active_pods()
         if not pods:
-            self.last_result = SchedulingResult({}, {}, 0)
-            return self.last_result
+            return result
 
         with self.monitor.phase("BatchBuild"):
             self.snapshot.flush()
@@ -276,8 +364,7 @@ class Scheduler:
                  for r in range(self.snapshot.state.capacity)],
             )
 
-        result = SchedulingResult({}, {}, round_pods=len(pods))
-        self.last_result = result  # debug-API diagnosis surface
+        result.round_pods = len(pods)
         with self.monitor.phase("Reserve"):
             self.snapshot.adopt_state(new_state)
 
@@ -287,21 +374,9 @@ class Scheduler:
                 node_row = int(a[i])
                 if node_row >= 0:
                     node = self.snapshot.node_name(node_row)
-                    result.assignments[pod.name] = node
-                    del self.pending[pod.name]
+                    self._commit_bind(pod, node, result)
                     if pod.gang:
                         placed_gangs.add(pod.gang)
-                    if (pod.quota and self.quota_tree is not None
-                            and pod.quota in self.quota_tree.nodes):
-                        q = self.quota_tree.nodes[pod.quota]
-                        q.used = q.used + pod.requests.astype(np.int64)
-                        if pod.non_preemptible:
-                            q.non_preemptible_used = (
-                                q.non_preemptible_used
-                                + pod.requests.astype(np.int64)
-                            )
-                    if self.bind_fn is not None:
-                        self.bind_fn(pod.name, node)
 
         with self.monitor.phase("Diagnose"):
             admitted = None
@@ -336,4 +411,335 @@ class Scheduler:
                 if gang is not None:
                     gang.first_failure = None
 
+        if self.enable_preemption and result.failures:
+            with self.monitor.phase("PostFilter"):
+                self._run_preemption(pods, batch, result)
+
         return result
+
+    def _commit_bind(
+        self, pod: PodSpec, node: str, result: SchedulingResult,
+        charge_quota: bool = True,
+    ) -> None:
+        """Shared bind bookkeeping: assignments, bound registry, quota used.
+
+        ``charge_quota=False`` converts a nomination whose quota charge is
+        already on the tree (``_nomination_assume``)."""
+        result.assignments[pod.name] = node
+        self.pending.pop(pod.name, None)
+        self.nominations.pop(pod.name, None)
+        self.bound[pod.name] = BoundPod(
+            name=pod.name, node=node, requests=pod.requests,
+            priority=pod.priority, quota=pod.quota,
+            non_preemptible=pod.non_preemptible,
+            labels=pod.labels, gang=pod.gang,
+        )
+        if charge_quota:
+            self._charge_quota_used(pod, sign=1)
+        if self.bind_fn is not None:
+            self.bind_fn(pod.name, node)
+
+    def _charge_quota_used(self, pod: PodSpec, sign: int) -> None:
+        if (pod.quota and self.quota_tree is not None
+                and pod.quota in self.quota_tree.nodes):
+            q = self.quota_tree.nodes[pod.quota]
+            q.used = q.used + sign * pod.requests.astype(np.int64)
+            if pod.non_preemptible:
+                q.non_preemptible_used = (
+                    q.non_preemptible_used + sign * pod.requests.astype(np.int64)
+                )
+
+    # -- nominated pods (nominatedNodeName semantics) -----------------------
+
+    def _nomination_assume(self, pod: PodSpec, node: str) -> None:
+        """Account a nomination: reserve the node AND charge the quota, so
+        neither the victims' freed capacity nor the quota headroom can be
+        double-spent before the preemptor binds."""
+        self.snapshot.reserve(node, pod.requests)
+        self._charge_quota_used(pod, sign=1)
+        self.nominations[pod.name] = node
+
+    def _nomination_release(self, pod: PodSpec) -> None:
+        """Undo :meth:`_nomination_assume` (stale nomination / pod deleted)."""
+        node = self.nominations.pop(pod.name, None)
+        if node is None:
+            return
+        if node in self.snapshot.node_index:
+            self.snapshot.unreserve(node, pod.requests)
+        self._charge_quota_used(pod, sign=-1)
+
+    def _nominated_fit(self, pod: PodSpec, row: int) -> bool:
+        """Re-run Filter for a nominated pod on its nominated node (with the
+        pod's own assumed accounting already released by the caller)."""
+        from koordinator_tpu.ops.assignment import score_pods
+
+        batch = PodBatch.build(
+            pod.requests[None].astype(np.int32),
+            priority=np.array([pod.priority], np.int32),
+            feasible=self.snapshot.feasibility_row(pod)[None],
+            node_capacity=self.snapshot.capacity, capacity=16,
+        )
+        _, feasible = score_pods(self.snapshot.state, batch, self.config)
+        if not bool(feasible[0, row]):
+            return False
+        if pod.quota is not None and self.quota_tree is not None:
+            return self.quota_tree.admits(
+                pod.quota, pod.requests, pod.non_preemptible
+            )
+        return True
+
+    def _resolve_nominations(self, result: SchedulingResult) -> None:
+        """Fast-path for preemptors nominated in an earlier round.
+
+        A nominated pod's resources were assumed (node reservation + quota
+        charge) at preemption time, so nothing else could take the victims'
+        freed capacity.  Here each pod's own assumption is briefly released,
+        Filter re-runs on the nominated node, and the pod either binds there
+        or loses the nomination and rejoins the batch with its full feasible
+        set.  Gang members resolve all-or-nothing: if any member's nominated
+        node stopped being viable, the whole gang's nominations are released
+        (partial gang binds below minMember are never produced)."""
+        groups: dict[str, list[PodSpec]] = {}
+        for name in list(self.nominations):
+            pod = self.pending.get(name)
+            if pod is None:
+                self.nominations.pop(name, None)  # pod gone; nothing assumed
+                continue
+            groups.setdefault(pod.gang or f"\0solo:{name}", []).append(pod)
+
+        for members in groups.values():
+            assumed: list[tuple[PodSpec, str]] = []  # re-assumed, not yet bound
+            ok = True
+            for pod in members:
+                node_name = self.nominations[pod.name]
+                row = self.snapshot.node_index.get(node_name)
+                # release own assumption, re-check with peers' still held
+                self._nomination_release(pod)
+                if row is None or not self._nominated_fit(pod, row):
+                    ok = False
+                    break
+                self._nomination_assume(pod, node_name)
+                assumed.append((pod, node_name))
+            if ok:
+                for pod, node_name in assumed:
+                    # assumption becomes the bind accounting (no re-reserve,
+                    # no re-charge)
+                    self._commit_bind(pod, node_name, result,
+                                      charge_quota=False)
+            else:
+                # release every member still holding an assumption (the
+                # failed member already released; release() no-ops for it)
+                for pod in members:
+                    self._nomination_release(pod)
+
+    # -- preemption (PostFilter) --------------------------------------------
+
+    def _pdb_arrays(self) -> tuple[list[str], np.ndarray]:
+        names = sorted(self.pdbs)
+        allowed = np.array(
+            [self.pdbs[n].allowed for n in names], np.int32
+        ).reshape(-1)
+        if not names:
+            allowed = np.zeros(1, np.int32)  # padded budget row, never matched
+        return names, allowed
+
+    def _build_scheduled(self, quota_index: dict[str, int]):
+        """Flatten self.bound into a ScheduledPods tensor (+ name order)."""
+        from koordinator_tpu.ops.preemption import ScheduledPods
+
+        pdb_names, _ = self._pdb_arrays()
+        pdb_index = {n: i for i, n in enumerate(pdb_names)}
+        names = sorted(self.bound)
+        v = len(names)
+        req = np.zeros((max(v, 1), self.snapshot.dims), np.int32)
+        node = np.full(max(v, 1), -1, np.int32)
+        pri = np.zeros(max(v, 1), np.int32)
+        qid = np.full(max(v, 1), -1, np.int32)
+        nonp = np.zeros(max(v, 1), bool)
+        pdb = np.full(max(v, 1), -1, np.int32)
+        for i, name in enumerate(names):
+            bp = self.bound[name]
+            row = self.snapshot.node_index.get(bp.node)
+            req[i] = bp.requests
+            node[i] = row if row is not None else -1
+            pri[i] = bp.priority
+            if bp.quota is not None and bp.quota in quota_index:
+                qid[i] = quota_index[bp.quota]
+            nonp[i] = bp.non_preemptible
+            # a pod matching several PDBs carries its most-constraining one
+            # (smallest remaining budget) for the violating classification;
+            # eviction decrements every matching budget (commit path)
+            matches = [
+                pi for pn, pi in pdb_index.items()
+                if self.pdbs[pn].matches(bp.labels)
+            ]
+            if matches:
+                pdb[i] = min(
+                    matches, key=lambda pi: self.pdbs[pdb_names[pi]].allowed
+                )
+        return ScheduledPods.build(
+            req[:v] if v else req[:0], node[:v] if v else node[:0],
+            priority=pri[:v] if v else None, quota_id=qid[:v] if v else None,
+            non_preemptible=nonp[:v] if v else None,
+            pdb_id=pdb[:v] if v else None,
+        ), names
+
+    def _quota_headroom(self, quota_name: str | None) -> np.ndarray | None:
+        """(R,) runtime - used for the pod's quota (postFilterState.usedLimit
+        semantics) — victims must bring used back under it."""
+        if quota_name is None or self.quota_tree is None:
+            return None
+        qnode = self.quota_tree.nodes.get(quota_name)
+        if qnode is None:
+            return None
+        from koordinator_tpu.quota.admission import HEADROOM_CLAMP
+        from koordinator_tpu.quota.tree import UNBOUNDED
+
+        # dims outside the quota's declared max are unchecked (quotav1.Mask
+        # semantics): give them unbounded headroom so a fair-share deficit on
+        # an undeclared dim cannot block preemption that admission allows
+        hr = np.where(
+            qnode.max != UNBOUNDED, qnode.runtime - qnode.used, HEADROOM_CLAMP
+        )
+        return np.clip(hr, -HEADROOM_CLAMP, HEADROOM_CLAMP).astype(np.int32)
+
+    def _run_preemption(self, pods, batch, result: SchedulingResult) -> None:
+        """PostFilter: for each still-unschedulable pod, find a min-cost
+        victim set, evict, and nominate.  Gang members preempt all-or-nothing
+        (job-level preemption, coscheduling preemption.go:206); quota-rejected
+        pods preempt within their quota (elasticquota preempt.go:111)."""
+        failed = [p for p in pods if p.name in result.failures]
+        if not failed:
+            return
+        quota_index = (
+            {} if self.quota_tree is None
+            else {n: i for i, n in enumerate(sorted(self.quota_tree.nodes))}
+        )
+        sched, bound_names = self._build_scheduled(quota_index)
+        if not bound_names:
+            return
+        pdb_names, pdb_allowed = self._pdb_arrays()
+        pdb_allowed = jnp.asarray(pdb_allowed)
+        state = self.snapshot.state
+
+        # group failed pods: gangs preempt as a job, others individually,
+        # highest-priority first
+        failed.sort(key=lambda p: (-p.priority, p.creation, p.name))
+        jobs: list[list[PodSpec]] = []
+        seen_gangs: set[str] = set()
+        for p in failed:
+            if p.gang is not None:
+                if p.gang in seen_gangs:
+                    continue
+                seen_gangs.add(p.gang)
+                jobs.append([q for q in failed if q.gang == p.gang])
+            else:
+                jobs.append([p])
+
+        pod_row = {p.name: i for i, p in enumerate(pods)}
+        feasible_np = np.asarray(batch.feasible)
+        # preemption cannot lower measured usage, so nodes over the loadaware
+        # threshold stay infeasible (the dry-run re-runs Filter in the
+        # reference, which includes the usage-threshold check)
+        from koordinator_tpu.ops import scoring
+        from koordinator_tpu.ops.assignment import _threshold_mask
+
+        pod_est = scoring.estimate_pod_usage_by_band(
+            batch.requests, self.config.estimator_factors,
+            self.config.estimator_defaults,
+        )
+        thr_np = np.asarray(_threshold_mask(
+            self.config, state.node_usage, state.node_agg_usage,
+            state.node_allocatable, pod_est,
+        ))
+
+        from koordinator_tpu.quota.admission import HEADROOM_CLAMP
+
+        for job in jobs:
+            if any(p.preemption_policy == "Never" for p in job):
+                continue
+            cur_state, cur_sched, cur_pdb = state, sched, pdb_allowed
+            outcomes = []
+            # quota consumed/freed by this job's earlier members (nominated
+            # requests minus same-quota victims): the tree is only charged at
+            # commit, so the dry run must not double-spend headroom
+            job_assumed: dict[str, np.ndarray] = {}
+            ok = True
+            for p in job:
+                quota_hr = self._quota_headroom(p.quota)
+                same_quota = quota_hr is not None
+                if same_quota and p.quota in job_assumed:
+                    quota_hr = np.clip(
+                        quota_hr.astype(np.int64) - job_assumed[p.quota],
+                        -HEADROOM_CLAMP, HEADROOM_CLAMP,
+                    ).astype(np.int32)
+                qid = quota_index.get(p.quota, -1) if p.quota else -1
+                # feasibility row from the solve batch (affinity/selector)
+                # ANDed with the usage-threshold filter; preemption fixes
+                # neither affinity nor measured-load failures
+                row = feasible_np[pod_row[p.name]] & thr_np[pod_row[p.name]]
+                out = self._preempt(
+                    cur_state, cur_sched,
+                    jnp.asarray(p.requests.astype(np.int32)),
+                    jnp.int32(p.priority), jnp.int32(qid),
+                    jnp.asarray(row), cur_pdb,
+                    quota_headroom=(
+                        jnp.asarray(quota_hr) if same_quota else None
+                    ),
+                    same_quota_only=same_quota,
+                )
+                node_row = int(out.node)
+                if node_row < 0:
+                    ok = False
+                    break
+                victim_names = [
+                    bound_names[v]
+                    for v in np.flatnonzero(np.asarray(out.victims))
+                ]
+                outcomes.append((p, out, victim_names))
+                if p.quota is not None:
+                    delta = p.requests.astype(np.int64)
+                    for vname in victim_names:
+                        bp = self.bound[vname]
+                        if bp.quota == p.quota:
+                            delta = delta - bp.requests.astype(np.int64)
+                    job_assumed[p.quota] = (
+                        job_assumed.get(p.quota, 0) + delta
+                    )
+                cur_state, cur_sched, cur_pdb = out.state, out.sched, out.pdb_allowed
+            if not ok:
+                continue  # all-or-nothing: drop the job's tentative evictions
+
+            # commit: evict victims, record nominations, update diagnosis
+            for p, out, victim_names in outcomes:
+                node_name = self.snapshot.node_name(int(out.node))
+                for vname in victim_names:
+                    bp = self.bound.pop(vname)
+                    self.snapshot.unreserve(bp.node, bp.requests)
+                    if bp.quota and self.quota_tree is not None \
+                            and bp.quota in self.quota_tree.nodes:
+                        q = self.quota_tree.nodes[bp.quota]
+                        q.used = q.used - bp.requests.astype(np.int64)
+                        if bp.non_preemptible:
+                            q.non_preemptible_used = (
+                                q.non_preemptible_used
+                                - bp.requests.astype(np.int64)
+                            )
+                    # every matching PDB pays for the disruption
+                    for pn in pdb_names:
+                        if self.pdbs[pn].matches(bp.labels):
+                            self.pdbs[pn].allowed -= 1
+                    if self.preempt_fn is not None:
+                        self.preempt_fn(vname, p.name)
+                # assume the preemptor's resources (node reservation + quota
+                # charge): nothing may claim the freed capacity or headroom
+                # before the preemptor binds or the nomination is cleared
+                self._nomination_assume(p, node_name)
+                result.nominations[p.name] = (node_name, victim_names)
+                diag = result.failures.get(p.name)
+                if diag is not None:
+                    diag.preempt_node = node_name
+                    diag.preempt_victims = victim_names
+            # later jobs see this job's evictions + nominations; bound_names
+            # order is unchanged (evicted rows are invalid in sched)
+            state, sched, pdb_allowed = cur_state, cur_sched, cur_pdb

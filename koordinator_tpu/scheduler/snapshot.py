@@ -47,6 +47,9 @@ class PodSpec:
     creation: float = 0.0
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
     owner: str | None = None               # controller key for reservation owner match
+    #: pod.spec.preemptionPolicy — "Never" opts out of preempting others
+    #: (PodEligibleToPreemptOthers, elasticquota/preempt.go:62)
+    preemption_policy: str = "PreemptLowerPriority"
 
 
 class ClusterSnapshot:
