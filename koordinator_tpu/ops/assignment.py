@@ -142,7 +142,7 @@ def score_pods(
         filtering.fit_mask(free, pods.requests),
         _threshold_mask(cfg, state.node_usage, state.node_agg_usage,
                         state.node_allocatable, pod_est),
-        pods.feasible,
+        pods.feasible_rows(state),
         state.node_valid[None, :],
         pods.valid[:, None],
     )
@@ -214,7 +214,7 @@ def _greedy_scan(
                 state.node_allocatable,
                 pod_est[None, :],
             )[0]
-            & pods.feasible[idx]
+            & pods.feasible_row(state, idx)
             & state.node_valid
             & valid
         )

@@ -1,0 +1,224 @@
+"""Batch-parallel assignment: propose/accept rounds instead of an O(P) scan.
+
+``greedy_assign`` (ops/assignment.py) is the exact sequential solver — one
+``lax.scan`` step per pod, 50k dependent steps at the north-star shape.  This
+module is the throughput path: the whole pending queue lands in a handful of
+data-parallel rounds.
+
+    1. ONE fused Filter+Score pass over the (P, N) problem (same kernels as
+       ``score_pods``), with a per-pod rotated tie-break so identical pods
+       spread over equal-scored nodes instead of stampeding one argmax;
+    2. ``lax.top_k`` -> each pod's k best candidate nodes, (P, k);
+    3. K propose/accept rounds on the small (P, k) tensors: every active pod
+       proposes its best candidate that still fits, conflicts are resolved by
+       a segmented prefix-sum over requests in priority order (higher-priority
+       pods win a contended node, exactly one device-wide sort per round), and
+       elastic-quota headroom is enforced by the same prefix trick per
+       ancestor level of the quota chain.
+
+Semantics vs the reference / greedy_assign:
+- priority order in conflicts matches the scheduler queue order
+  (priority desc, stable) — the prefix acceptance is the tensor analog of
+  higher-priority pods going through scheduleOne first;
+- capacity and quota feedback happen per round (snapshot granularity) rather
+  than per pod: scores are not recomputed between two pods of the same round,
+  like the upstream parallel Filter/Score over one snapshot;
+- a pod only ever considers its top-k candidates; under extreme contention a
+  pod can go unassigned in this solve even though some node below its top-k
+  would fit (it retries next scheduler round).  k and the round count bound
+  the approximation.
+
+Reference parity anchors: scoring pipeline per cmd/koord-scheduler/main.go
+plugin registry; quota admission per elasticquota/plugin.go:256-304; the
+conflict rule mirrors upstream queue ordering (priority, then FIFO).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from koordinator_tpu.ops.assignment import ScoringConfig, score_pods
+from koordinator_tpu.quota.admission import (
+    QuotaDeviceState,
+    charge_quota_batch,
+    quota_admission_mask,
+)
+from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
+
+#: tie-break field width: node index occupies the low bits of the ranking key
+_TB_BITS = 15  # supports node capacities up to 32768
+_SCORE_CLIP = (1 << 30 - _TB_BITS) - 1
+
+
+def _ranked_scores(scores: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:
+    """(P, N) int32 ranking key: score in the high bits, a per-pod rotated
+    node index in the low bits.  Equal-scored nodes order differently for
+    every pod, so homogeneous pods fan out instead of all picking node 0
+    (selectHost randomizes among maxima upstream; rotation is the
+    deterministic equivalent)."""
+    p, n = scores.shape
+    rot = (jnp.arange(p, dtype=jnp.int32) * 7919)[:, None]  # per-pod offset
+    tb = (jnp.arange(n, dtype=jnp.int32)[None, :] - rot) % n
+    # invert so the SMALLEST rotated distance ranks highest among ties
+    tb = (n - 1) - tb
+    key = (jnp.clip(scores, 0, _SCORE_CLIP) << _TB_BITS) | tb
+    return jnp.where(feasible, key, -1)
+
+
+def _prefix_accept(
+    choice: jnp.ndarray,     # (P,) int32 proposed segment (node/quota row)
+    requests: jnp.ndarray,   # (P, R) int32
+    free: jnp.ndarray,       # (S, R) int32 segment headroom
+    order: jnp.ndarray,      # (P,) priority-descending pod order
+    active: jnp.ndarray,     # (P,) bool — proposers this round
+) -> jnp.ndarray:
+    """(P,) bool: cumulative request per segment (taken in ``order`` among
+    active proposers) fits the segment's headroom, counting the pod itself.
+
+    This is the round's conflict resolution: the tensor equivalent of
+    higher-priority pods passing through the scheduling cycle first.
+    """
+    p, r = requests.shape
+    s = free.shape[0]
+    seg = jnp.where(active, choice, s)            # inactive -> overflow row
+    seg_o = seg[order]
+    req_o = jnp.where(active[order][:, None], requests[order], 0)
+    pos = jnp.argsort(seg_o, stable=True)         # group segments, keep order
+    seg_s = seg_o[pos]
+    req_s = req_o[pos]
+    cum = jnp.cumsum(req_s, axis=0)
+    excl = cum - req_s
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), seg_s[1:] != seg_s[:-1]]
+    )
+    # propagate each segment's starting cumulative value (cum is
+    # non-decreasing, so a running max of start markers yields the most
+    # recent segment start)
+    base = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_start[:, None], excl, -1), axis=0
+    )
+    prefix = cum - base                           # within-segment incl. self
+    free_pad = jnp.concatenate([free, jnp.zeros((1, r), free.dtype)])
+    fits = jnp.all((prefix <= free_pad[seg_s]) | (req_s == 0), axis=-1)
+    out = jnp.zeros(p, bool).at[order[pos]].set(fits)
+    return out & active
+
+
+def _quota_prefix_accept(
+    quota: QuotaDeviceState,
+    requests: jnp.ndarray,
+    pods: PodBatch,
+    order: jnp.ndarray,
+    active: jnp.ndarray,
+) -> jnp.ndarray:
+    """(P,) bool: within-round quota headroom conflict resolution.
+
+    For every ancestor level of the quota chain, the cumulative masked
+    request of this round's proposers must fit the ancestor's headroom
+    (admission checks a static headroom; this prevents one round from
+    collectively overshooting it).  Non-preemptible pods additionally
+    prefix-check min headroom at their own quota.
+    """
+    qid = jnp.maximum(pods.quota_id, 0)
+    has_quota = pods.quota_id >= 0
+    checked = quota.checked[qid]                       # (P, R)
+    req_m = jnp.where(checked, requests, 0)
+    ok = jnp.ones(pods.capacity, bool)
+    depth = quota.chain.shape[1]
+    for d in range(depth):
+        anc = quota.chain[qid, d]                      # (P,)
+        act_d = active & has_quota & (anc >= 0)
+        acc = _prefix_accept(
+            jnp.maximum(anc, 0), req_m, quota.headroom, order, act_d
+        )
+        ok = ok & (acc | ~act_d)
+    np_act = active & has_quota & pods.non_preemptible
+    np_acc = _prefix_accept(qid, req_m, quota.min_headroom, order, np_act)
+    ok = ok & (np_acc | ~np_act)
+    return ok | ~has_quota
+
+
+@struct.dataclass
+class _RoundCarry:
+    requested: jax.Array      # (N, R)
+    assignments: jax.Array    # (P,)
+    active: jax.Array         # (P,)
+    quota: QuotaDeviceState | None
+
+
+def batch_assign(
+    state: ClusterState,
+    pods: PodBatch,
+    cfg: ScoringConfig,
+    quota: QuotaDeviceState | None = None,
+    k: int = 32,
+    rounds: int = 12,
+):
+    """Assign a pending batch in data-parallel propose/accept rounds.
+
+    Same signature/returns as ``greedy_assign``: (assignments, new_state,
+    new_quota).  assignments is (P,) int32, -1 = unassigned.
+    """
+    scores, feasible = score_pods(state, pods, cfg)
+    key = _ranked_scores(scores, feasible)
+    k = min(k, key.shape[1])
+    cand_key, cand_node = jax.lax.top_k(key, k)        # (P, k)
+    cand_valid = cand_key >= 0
+
+    order = jnp.lexsort((jnp.arange(pods.capacity), -pods.priority))
+    active0 = pods.valid & jnp.any(cand_valid, axis=1)
+
+    carry = _RoundCarry(
+        requested=state.node_requested,
+        assignments=jnp.full(pods.capacity, -1, jnp.int32),
+        active=active0,
+        quota=quota,
+    )
+
+    def round_body(_, c: _RoundCarry) -> _RoundCarry:
+        free = jnp.where(
+            state.node_valid[:, None], state.node_allocatable - c.requested, 0
+        )
+        # each pod's best candidate whose node still fits its request
+        cand_free = free[cand_node]                    # (P, k, R)
+        fits = jnp.all(
+            (pods.requests[:, None, :] <= cand_free)
+            | (pods.requests[:, None, :] == 0),
+            axis=-1,
+        ) & cand_valid
+        best = jnp.argmax(jnp.where(fits, cand_key, -1), axis=1)
+        has = jnp.take_along_axis(fits, best[:, None], axis=1)[:, 0]
+        choice = jnp.take_along_axis(cand_node, best[:, None], axis=1)[:, 0]
+
+        act = c.active & has
+        if c.quota is not None:
+            act = act & quota_admission_mask(
+                c.quota, pods.requests, pods.quota_id, pods.non_preemptible
+            )
+        accept = _prefix_accept(choice, pods.requests, free, order, act)
+        if c.quota is not None:
+            accept = accept & _quota_prefix_accept(
+                c.quota, pods.requests, pods, order, act
+            )
+
+        safe = jnp.where(accept, choice, 0)
+        add = jnp.where(accept[:, None], pods.requests, 0)
+        requested = c.requested.at[safe].add(add)
+        new_quota = c.quota
+        if new_quota is not None:
+            new_quota = charge_quota_batch(
+                new_quota, pods.requests, pods.quota_id, accept,
+                pods.non_preemptible,
+            )
+        return _RoundCarry(
+            requested=requested,
+            assignments=jnp.where(accept, choice, c.assignments),
+            active=c.active & ~accept,
+            quota=new_quota,
+        )
+
+    carry = jax.lax.fori_loop(0, rounds, round_body, carry)
+    new_state = state.replace(node_requested=carry.requested)
+    return carry.assignments, new_state, carry.quota

@@ -358,7 +358,7 @@ def plan_gang_placement(
     # any member cannot use offers no slots to the gather plan.
     if member_idx.size:
         node_valid = node_valid & jnp.all(
-            pods.feasible[jnp.asarray(member_idx)], axis=0
+            pods.feasible_rows(state)[jnp.asarray(member_idx)], axis=0
         )
     desired = req.desired_slots if req.desired_slots > 0 else len(member_idx)
     gang_requests = jnp.where(

@@ -227,7 +227,7 @@ def score_pods_with_reservations(
         via_rsv
         & _threshold_mask(cfg, state.node_usage, state.node_agg_usage,
                           state.node_allocatable, pod_est)
-        & pods.feasible
+        & pods.feasible_rows(state)
         & state.node_valid[None, :]
         & pods.valid[:, None]
     )

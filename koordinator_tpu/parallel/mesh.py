@@ -62,8 +62,9 @@ def shard_cluster_state(state, mesh: Mesh):
 
 
 def shard_pod_batch(pods, mesh: Mesh):
-    """Place PodBatch tensors pod-axis-sharded; the (P, N) feasibility matrix
-    shards over both axes."""
+    """Place PodBatch tensors pod-axis-sharded; a dense (P, N) feasibility
+    matrix shards over both axes, the factored (P, C) selector mask over the
+    pod axis only (C is small and replicating it is the point)."""
     ps = pod_sharding(mesh)
     ms = matrix_sharding(mesh)
     return pods.replace(
@@ -74,5 +75,12 @@ def shard_pod_batch(pods, mesh: Mesh):
         quota_id=jax.device_put(pods.quota_id, ps),
         non_preemptible=jax.device_put(pods.non_preemptible, ps),
         valid=jax.device_put(pods.valid, ps),
-        feasible=jax.device_put(pods.feasible, ms),
+        feasible=(
+            jax.device_put(pods.feasible, ms)
+            if pods.feasible is not None else None
+        ),
+        selector_mask=(
+            jax.device_put(pods.selector_mask, ps)
+            if pods.selector_mask is not None else None
+        ),
     )

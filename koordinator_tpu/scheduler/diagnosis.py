@@ -86,7 +86,7 @@ def explain_pod(
     )
     agg_enabled = bool(jnp.any(cfg.agg_usage_thresholds > 0))
     thr = np.asarray((agg if agg_enabled else inst)[0]) & valid
-    aff = np.asarray(pods.feasible[pod_idx]) & valid
+    aff = np.asarray(pods.feasible_row(state, pod_idx)) & valid
 
     feasible = fit & thr & aff
     # first-fail attribution, in filter order: fit -> thresholds -> affinity

@@ -47,6 +47,9 @@ class SchedulingHints:
     def clear(self, pod_name: str) -> None:
         self._hints.pop(pod_name, None)
 
+    def has_hint(self, pod_name: str) -> bool:
+        return pod_name in self._hints
+
     def apply_to_mask(self, pod_name: str, feasible: np.ndarray) -> np.ndarray:
         """Edit one pod's (N,) feasibility row: drop excluded nodes; if any
         preferred node is feasible, restrict to the preferred set (the

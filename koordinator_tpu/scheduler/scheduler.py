@@ -220,7 +220,6 @@ class Scheduler:
         gang_id = np.full(p, -1, np.int32)
         quota_id = np.full(p, -1, np.int32)
         non_preempt = np.zeros(p, bool)
-        feasible = np.zeros((p, n_cap), bool)
         for i, pod in enumerate(pods):
             requests[i] = pod.requests
             priority[i] = pod.priority
@@ -230,14 +229,37 @@ class Scheduler:
             if pod.quota is not None and pod.quota in quota_index:
                 quota_id[i] = quota_index[pod.quota]
             non_preempt[i] = pod.non_preemptible
-            row = self.snapshot.feasibility_row(pod)
-            if self.hints is not None:
-                row = self.hints.apply_to_mask(pod.name, row)
-            feasible[i] = row
+        # placement constraints: factored O(P·C) equivalence-class masks by
+        # default; the dense O(P·N) path only when a pod carries per-node
+        # hint edits (rare — skip/prefer hints from the hinter)
+        hinted = self.hints is not None and any(
+            self.hints.has_hint(pod.name) for pod in pods
+        )
+        if hinted:
+            feasible = np.zeros((p, n_cap), bool)
+            for i, pod in enumerate(pods):
+                row = self.snapshot.feasibility_row(pod)
+                feasible[i] = self.hints.apply_to_mask(pod.name, row)
+            mask_kw = dict(feasible=feasible)
+        else:
+            c_cap = self.snapshot.class_capacity
+            sel = np.zeros((p, c_cap), bool)
+            memo: dict[tuple, np.ndarray] = {}
+            for i, pod in enumerate(pods):
+                key = (
+                    tuple(sorted(pod.node_selector.items())),
+                    tuple(sorted(pod.tolerations.items())),
+                )
+                row = memo.get(key)
+                if row is None:
+                    row = self.snapshot.selector_row_for(pod)
+                    memo[key] = row
+                sel[i] = row
+            mask_kw = dict(selector_mask=sel, class_capacity=c_cap)
         return PodBatch.build(
             requests, priority=priority, qos=qos, gang_id=gang_id,
             quota_id=quota_id, non_preemptible=non_preempt,
-            feasible=feasible, node_capacity=n_cap, capacity=cap,
+            node_capacity=n_cap, capacity=cap, **mask_kw,
         )
 
     def _build_gang_info(self, pods: list[PodSpec]) -> tuple[GangInfo, dict[str, int]]:
@@ -289,8 +311,16 @@ class Scheduler:
         out of the round entirely (all-or-nothing at plan level)."""
         if self.topology_tree is None:
             return batch
+        # densifying the factored mask is O(P·N): skip it entirely unless
+        # some gang in this round actually carries topology requirements
+        if not any(
+            self.gangs.get(name) is not None
+            and self.gangs[name].topology is not None
+            for name in gang_index
+        ):
+            return batch
         gang_ids = np.asarray(batch.gang_id)
-        feasible = np.array(batch.feasible)
+        feasible = np.array(batch.feasible_rows(self.snapshot.state))
         valid = np.array(batch.valid)
         changed = False
         for name, gi in gang_index.items():
@@ -317,8 +347,10 @@ class Scheduler:
             feasible[planned, plan[planned]] = True
         if not changed:
             return batch
+        # topology pinning needs per-(pod, node) edits: densify the mask
         return batch.replace(
-            feasible=jnp.asarray(feasible), valid=jnp.asarray(valid)
+            feasible=jnp.asarray(feasible), valid=jnp.asarray(valid),
+            selector_mask=None,
         )
 
     def schedule_round(self) -> SchedulingResult:
@@ -637,21 +669,28 @@ class Scheduler:
                 jobs.append([p])
 
         pod_row = {p.name: i for i, p in enumerate(pods)}
-        feasible_np = np.asarray(batch.feasible)
-        # preemption cannot lower measured usage, so nodes over the loadaware
-        # threshold stay infeasible (the dry-run re-runs Filter in the
-        # reference, which includes the usage-threshold check)
+        # expand feasibility + threshold masks only for the failed pods
+        # (O(F·N), not O(P·N) — preemption is the rare path)
         from koordinator_tpu.ops import scoring
         from koordinator_tpu.ops.assignment import _threshold_mask
 
+        fail_rows = np.array([pod_row[p.name] for p in failed], np.int32)
+        feasible_np = {
+            r: np.asarray(batch.feasible_row(state, int(r)))
+            for r in fail_rows
+        }
+        # preemption cannot lower measured usage, so nodes over the loadaware
+        # threshold stay infeasible (the dry-run re-runs Filter in the
+        # reference, which includes the usage-threshold check)
         pod_est = scoring.estimate_pod_usage_by_band(
-            batch.requests, self.config.estimator_factors,
-            self.config.estimator_defaults,
+            batch.requests[jnp.asarray(fail_rows)],
+            self.config.estimator_factors, self.config.estimator_defaults,
         )
-        thr_np = np.asarray(_threshold_mask(
+        thr = np.asarray(_threshold_mask(
             self.config, state.node_usage, state.node_agg_usage,
             state.node_allocatable, pod_est,
         ))
+        thr_np = {int(r): thr[i] for i, r in enumerate(fail_rows)}
 
         from koordinator_tpu.quota.admission import HEADROOM_CLAMP
 

@@ -30,6 +30,16 @@ class NodeSpec:
     agg_usage: np.ndarray | None = None     # (R,) int32
     prod_usage: np.ndarray | None = None    # (R,) int32
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    #: NoSchedule taints as key -> value (a pod needs a matching toleration)
+    taints: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def signature(self) -> tuple:
+        """Label/taint equivalence-class signature: nodes with equal
+        signatures are interchangeable for selector/toleration filtering."""
+        return (
+            tuple(sorted(self.labels.items())),
+            tuple(sorted(self.taints.items())),
+        )
 
 
 @dataclasses.dataclass
@@ -44,6 +54,8 @@ class PodSpec:
     quota: str | None = None
     non_preemptible: bool = False
     node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    #: tolerated NoSchedule taints (key -> value)
+    tolerations: dict[str, str] = dataclasses.field(default_factory=dict)
     creation: float = 0.0
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
     owner: str | None = None               # controller key for reservation owner match
@@ -67,6 +79,41 @@ class ClusterSnapshot:
         # flush (freed by remove_node; a reused row must not inherit the dead
         # node's accounting)
         self._reset_requested: set[int] = set()
+        # label/taint equivalence classes: signature -> class id. Ids are
+        # never recycled (bounded by distinct signatures ever seen); the
+        # (P, C) selector masks index them via ClusterState.node_class.
+        self._class_index: dict[tuple, int] = {}
+        self._class_sigs: list[tuple] = []
+
+    @property
+    def class_capacity(self) -> int:
+        """Padded equivalence-class count for (P, C) selector masks."""
+        return _bucket(max(len(self._class_sigs), 1), minimum=8)
+
+    def _class_of(self, spec: NodeSpec) -> int:
+        sig = spec.signature()
+        cid = self._class_index.get(sig)
+        if cid is None:
+            cid = len(self._class_sigs)
+            self._class_index[sig] = cid
+            self._class_sigs.append(sig)
+        return cid
+
+    @staticmethod
+    def _pod_allows(pod: PodSpec, labels: tuple, taints: tuple) -> bool:
+        lbl = dict(labels)
+        if any(lbl.get(k) != v for k, v in pod.node_selector.items()):
+            return False
+        return all(pod.tolerations.get(k) == v for k, v in taints)
+
+    def selector_row_for(self, pod: PodSpec) -> np.ndarray:
+        """(class_capacity,) bool: which node equivalence classes the pod's
+        nodeSelector + tolerations admit. O(C) per pod — the factored
+        replacement for the O(N) feasibility_row walk."""
+        row = np.zeros(self.class_capacity, bool)
+        for cid, (labels, taints) in enumerate(self._class_sigs):
+            row[cid] = self._pod_allows(pod, labels, taints)
+        return row
 
     @property
     def capacity(self) -> int:
@@ -83,6 +130,7 @@ class ClusterSnapshot:
             self.node_index[spec.name] = row
             self._row_to_name[row] = spec.name
         self.node_specs[spec.name] = spec
+        self._class_of(spec)  # register the equivalence class up front
         self._dirty.add(row)
         return row
 
@@ -113,6 +161,7 @@ class ClusterSnapshot:
             node_agg_usage=pad(old.node_agg_usage),
             node_prod_usage=pad(old.node_prod_usage),
             node_valid=pad(old.node_valid),
+            node_class=pad(old.node_class),
         )
         self._free_rows = list(range(new_cap - 1, old_cap - 1, -1)) + self._free_rows
 
@@ -136,6 +185,7 @@ class ClusterSnapshot:
         agg = np.zeros((k, self.dims), np.int32)
         prod = np.zeros((k, self.dims), np.int32)
         valid = np.zeros(k, bool)
+        nclass = np.zeros(k, np.int32)
         for i, r in enumerate(rows):
             name = self._row_to_name.get(r)
             if name is None:
@@ -147,6 +197,7 @@ class ClusterSnapshot:
             agg[i] = spec.agg_usage if spec.agg_usage is not None else usage[i]
             prod[i] = spec.prod_usage if spec.prod_usage is not None else usage[i]
             valid[i] = True
+            nclass[i] = self._class_of(spec)
         idx = jnp.asarray(np.asarray(rows, np.int32))
         self.state = self.state.scatter_update(
             idx,
@@ -155,6 +206,7 @@ class ClusterSnapshot:
             node_agg_usage=jnp.asarray(agg),
             node_prod_usage=jnp.asarray(prod),
             node_valid=jnp.asarray(valid),
+            node_class=jnp.asarray(nclass),
         )
         return k
 
@@ -185,11 +237,16 @@ class ClusterSnapshot:
         return self._row_to_name.get(row)
 
     def feasibility_row(self, pod: PodSpec) -> np.ndarray:
-        """(N,) bool host-computed label-selector mask for one pod."""
+        """(N,) bool host-computed selector/toleration mask for one pod.
+
+        The dense path — used where per-(pod, node) edits are needed
+        (scheduling hints, topology pins); the hot path uses
+        :meth:`selector_row_for` + ``ClusterState.node_class`` instead.
+        """
         mask = np.zeros(self.capacity, bool)
         for name, row in self.node_index.items():
-            labels = self.node_specs[name].labels
-            mask[row] = all(
-                labels.get(k) == v for k, v in pod.node_selector.items()
+            spec = self.node_specs[name]
+            mask[row] = self._pod_allows(
+                pod, tuple(spec.labels.items()), tuple(spec.taints.items())
             )
         return mask

@@ -64,6 +64,12 @@ class ClusterState:
     node_agg_usage: jax.Array    # (N, R) int32 — aggregated percentile usage (e.g. p95)
     node_prod_usage: jax.Array   # (N, R) int32 — usage by prod-band pods only
     node_valid: jax.Array        # (N,)  bool
+    #: (N,) int32 label/taint equivalence-class id per node: nodes with the
+    #: same scheduling-relevant labels+taints share a class, so pod
+    #: feasibility factors into a (P, C) selector mask + this map instead of
+    #: a dense (P, N) tensor (C ≪ N; the reference walks nodeSelector/taints
+    #: per (pod, node) — the class map is the vectorized equivalent).
+    node_class: jax.Array
 
     @property
     def capacity(self) -> int:
@@ -85,6 +91,7 @@ class ClusterState:
             node_agg_usage=z,
             node_prod_usage=z,
             node_valid=jnp.zeros((capacity,), dtype=bool),
+            node_class=jnp.zeros((capacity,), dtype=jnp.int32),
         )
 
     @classmethod
@@ -96,6 +103,7 @@ class ClusterState:
         agg_usage: np.ndarray | None = None,
         prod_usage: np.ndarray | None = None,
         capacity: int | None = None,
+        node_class: np.ndarray | None = None,
     ) -> "ClusterState":
         """Build padded device state from (n, R) host arrays of n real nodes."""
         n, dims = allocatable.shape
@@ -110,6 +118,9 @@ class ClusterState:
 
         valid = np.zeros(cap, dtype=bool)
         valid[:n] = True
+        nclass = np.zeros(cap, dtype=np.int32)
+        if node_class is not None:
+            nclass[:n] = node_class
         return cls(
             node_allocatable=pad(allocatable),
             node_requested=pad(requested),
@@ -117,6 +128,7 @@ class ClusterState:
             node_agg_usage=pad(agg_usage if agg_usage is not None else usage),
             node_prod_usage=pad(prod_usage if prod_usage is not None else usage),
             node_valid=jnp.asarray(valid),
+            node_class=jnp.asarray(nclass),
         )
 
     def scatter_update(self, rows: jax.Array, **updates: jax.Array) -> "ClusterState":
@@ -146,7 +158,23 @@ class ClusterState:
 
 @struct.dataclass
 class PodBatch:
-    """A batch of pending pods, shape (P, R) / (P,). P is padded pod capacity."""
+    """A batch of pending pods, shape (P, R) / (P,). P is padded pod capacity.
+
+    Placement constraints (nodeSelector / affinity / taints+tolerations) come
+    in one of two representations:
+
+    - **factored** (the default, the scale path): ``selector_mask`` is a
+      (P, C) bool over node equivalence classes and the node→class map lives
+      in ``ClusterState.node_class``; feasibility expands lazily on device as
+      ``selector_mask[:, node_class]``, so host work and transfer are
+      O(P·C + N), never O(P·N).
+    - **dense**: an explicit host-computed (P, N) ``feasible`` mask for
+      callers that need per-(pod, node) edits (scheduling hints, topology
+      pinning, tests).
+
+    Exactly one of the two is set; use :meth:`feasible_rows` /
+    :meth:`feasible_row` instead of touching either field.
+    """
 
     requests: jax.Array    # (P, R) int32
     priority: jax.Array    # (P,) int32 — koordinator priority value
@@ -155,12 +183,36 @@ class PodBatch:
     quota_id: jax.Array    # (P,) int32 — elastic-quota index, -1 = none
     non_preemptible: jax.Array  # (P,) bool — checks/consumes quota min
     valid: jax.Array       # (P,) bool
-    feasible: jax.Array    # (P, N) bool — host-computed placement mask
-                           # (node/pod affinity, taints/tolerations, nodeSelector)
+    feasible: jax.Array | None       # (P, N) bool dense mask, or None
+    selector_mask: jax.Array | None  # (P, C) bool class mask, or None
 
     @property
     def capacity(self) -> int:
         return self.requests.shape[0]
+
+    def feasible_rows(self, state: "ClusterState") -> jax.Array:
+        """(P, N) feasibility, expanding the factored form on device.
+
+        A node whose class id is outside this batch's selector-mask width
+        (a class registered after the batch was built) is INFEASIBLE for
+        every pod — failing safe (the pod retries next round against a
+        rebuilt batch) rather than silently inheriting another class's mask.
+        """
+        if self.feasible is not None:
+            return self.feasible
+        c = self.selector_mask.shape[1]
+        in_range = state.node_class < c
+        nc = jnp.minimum(state.node_class, c - 1)
+        return self.selector_mask[:, nc] & in_range[None, :]
+
+    def feasible_row(self, state: "ClusterState", idx) -> jax.Array:
+        """(N,) feasibility for one pod (cheap in the factored form)."""
+        if self.feasible is not None:
+            return self.feasible[idx]
+        c = self.selector_mask.shape[1]
+        in_range = state.node_class < c
+        nc = jnp.minimum(state.node_class, c - 1)
+        return self.selector_mask[idx][nc] & in_range
 
     @classmethod
     def build(
@@ -172,7 +224,9 @@ class PodBatch:
         quota_id: np.ndarray | None = None,
         non_preemptible: np.ndarray | None = None,
         feasible: np.ndarray | None = None,
+        selector_mask: np.ndarray | None = None,
         node_capacity: int = 64,
+        class_capacity: int = 1,
         capacity: int | None = None,
     ) -> "PodBatch":
         p, dims = requests.shape
@@ -188,11 +242,18 @@ class PodBatch:
                 out[:p] = a
             return jnp.asarray(out)
 
-        feas = np.zeros((cap, node_capacity), dtype=bool)
         if feasible is not None:
+            feas = np.zeros((cap, node_capacity), dtype=bool)
             feas[:p, : feasible.shape[1]] = feasible
+            feas_arr, sel_arr = jnp.asarray(feas), None
         else:
-            feas[:p] = True
+            c_cap = class_capacity
+            sel = np.zeros((cap, c_cap), dtype=bool)
+            if selector_mask is not None:
+                sel[:p, : selector_mask.shape[1]] = selector_mask
+            else:
+                sel[:p] = True  # unconstrained pods allow every class
+            feas_arr, sel_arr = None, jnp.asarray(sel)
 
         valid = np.zeros(cap, dtype=bool)
         valid[:p] = True
@@ -205,5 +266,6 @@ class PodBatch:
             quota_id=pad1(quota_id, -1, np.int32),
             non_preemptible=pad1(non_preemptible, False, bool),
             valid=jnp.asarray(valid),
-            feasible=jnp.asarray(feas),
+            feasible=feas_arr,
+            selector_mask=sel_arr,
         )

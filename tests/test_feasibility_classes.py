@@ -1,0 +1,124 @@
+"""Factored feasibility: label/taint equivalence classes.
+
+The dense (P, N) mask is replaced by a (P, C) selector mask over node
+equivalence classes plus a node→class map (ClusterState.node_class); these
+tests pin the factored path to the dense oracle.
+"""
+
+import numpy as np
+
+from koordinator_tpu.scheduler import ClusterSnapshot, NodeSpec, PodSpec
+
+from tests.test_scheduler import mk_scheduler, node, pod
+
+
+def node_l(name, labels=None, taints=None, cpu=16_000):
+    n = node(name, cpu=cpu, labels=labels)
+    n.taints = taints or {}
+    return n
+
+
+class TestClassRegistry:
+    def test_nodes_share_classes(self):
+        snap = ClusterSnapshot(capacity=16)
+        for i in range(6):
+            snap.upsert_node(node_l(f"a{i}", labels={"pool": "a"}))
+        for i in range(6):
+            snap.upsert_node(node_l(f"b{i}", labels={"pool": "b"}))
+        snap.flush()
+        assert len(snap._class_sigs) == 2
+        nc = np.asarray(snap.state.node_class)
+        rows_a = [snap.node_index[f"a{i}"] for i in range(6)]
+        rows_b = [snap.node_index[f"b{i}"] for i in range(6)]
+        assert len({nc[r] for r in rows_a}) == 1
+        assert len({nc[r] for r in rows_b}) == 1
+        assert nc[rows_a[0]] != nc[rows_b[0]]
+
+    def test_selector_row_matches_dense_oracle(self):
+        snap = ClusterSnapshot(capacity=16)
+        snap.upsert_node(node_l("plain"))
+        snap.upsert_node(node_l("gpu", labels={"accel": "gpu"}))
+        snap.upsert_node(node_l("tainted", taints={"dedicated": "batch"}))
+        snap.flush()
+        cases = [
+            PodSpec("any", requests=pod("x").requests),
+            PodSpec("want-gpu", requests=pod("x").requests,
+                    node_selector={"accel": "gpu"}),
+            PodSpec("tolerates", requests=pod("x").requests,
+                    tolerations={"dedicated": "batch"}),
+        ]
+        nc = np.asarray(snap.state.node_class)
+        for p in cases:
+            dense = snap.feasibility_row(p)
+            sel = snap.selector_row_for(p)
+            factored = sel[nc] & np.asarray(snap.state.node_valid)
+            assert (factored == dense).all(), p.name
+
+    def test_taint_blocks_untolerating_pod(self):
+        snap = ClusterSnapshot(capacity=16)
+        snap.upsert_node(node_l("t", taints={"dedicated": "batch"}))
+        snap.flush()
+        p = PodSpec("p", requests=pod("x").requests)
+        assert not snap.selector_row_for(p).any()
+        tol = PodSpec("q", requests=pod("x").requests,
+                      tolerations={"dedicated": "batch"})
+        row = snap.selector_row_for(tol)
+        assert row[np.asarray(snap.state.node_class)[snap.node_index["t"]]]
+
+
+class TestSchedulerFactoredPath:
+    def test_selector_routing(self):
+        sched, _ = mk_scheduler([
+            node_l("cpu-1", labels={"pool": "cpu"}),
+            node_l("gpu-1", labels={"pool": "gpu"}),
+        ])
+        sched.enqueue(pod("wants-gpu", node_selector={"pool": "gpu"}))
+        sched.enqueue(pod("wants-cpu", node_selector={"pool": "cpu"}))
+        res = sched.schedule_round()
+        assert res.assignments == {
+            "wants-gpu": "gpu-1", "wants-cpu": "cpu-1",
+        }
+        # factored batch: no dense mask was built
+        assert sched.last_result is res
+
+    def test_unmatched_selector_diagnosed(self):
+        sched, _ = mk_scheduler([node_l("n1", labels={"pool": "a"})])
+        sched.enqueue(pod("p", node_selector={"pool": "zzz"}))
+        res = sched.schedule_round()
+        assert res.failures["p"].affinity_mismatch == 1
+
+    def test_taint_respected_via_scheduler(self):
+        sched, _ = mk_scheduler([
+            node_l("general"),
+            node_l("batch-only", taints={"dedicated": "batch"}),
+        ])
+        sched.enqueue(pod("plain"))
+        sched.enqueue(pod("batchy", tolerations={"dedicated": "batch"},
+                          node_selector={}))
+        res = sched.schedule_round()
+        assert res.assignments["plain"] == "general"
+        assert res.assignments["batchy"] in {"general", "batch-only"}
+
+    def test_hinted_pod_falls_back_dense(self):
+        from koordinator_tpu.scheduler.hints import PodHint, SchedulingHints
+
+        sched, _ = mk_scheduler([node_l("n1"), node_l("n2")])
+        hints = SchedulingHints(sched.snapshot)
+        sched.hints = hints
+        hints.set_hint("p", PodHint(excluded_nodes={"n1"}))
+        sched.enqueue(pod("p"))
+        res = sched.schedule_round()
+        assert res.assignments == {"p": "n2"}
+
+    def test_class_added_after_batch_is_safe(self):
+        # a node class registered between rounds grows class_capacity;
+        # earlier batches' masks stay consistent (clip + re-build per round)
+        sched, _ = mk_scheduler([node_l("n1", labels={"pool": "a"})])
+        sched.enqueue(pod("p1", node_selector={"pool": "a"}))
+        assert sched.schedule_round().assignments == {"p1": "n1"}
+        for i in range(12):  # force class growth past the initial capacity
+            sched.snapshot.upsert_node(
+                node_l(f"x{i}", labels={"pool": f"x{i}"})
+            )
+        sched.enqueue(pod("p2", node_selector={"pool": "x5"}))
+        assert sched.schedule_round().assignments == {"p2": "x5"}

@@ -58,3 +58,70 @@ def test_sharded_greedy_assign_matches_unsharded():
     assert np.array_equal(
         np.asarray(st_ref.node_requested), np.asarray(st_sh.node_requested)
     )
+
+
+def test_sharded_batch_assign_matches_unsharded():
+    state, pods = build_problem()
+    cfg = ScoringConfig.default()
+    from koordinator_tpu.ops.batch_assign import batch_assign
+
+    f = jax.jit(batch_assign, static_argnames=("k", "rounds"))
+    a_ref, st_ref, _ = f(state, pods, cfg, k=8, rounds=4)
+
+    mesh = pmesh.solver_mesh(pods_axis=2)
+    sstate = pmesh.shard_cluster_state(state, mesh)
+    spods = pmesh.shard_pod_batch(pods, mesh)
+    a_sh, st_sh, _ = f(sstate, spods, cfg, k=8, rounds=4)
+
+    assert np.array_equal(np.asarray(a_ref), np.asarray(a_sh))
+    assert np.array_equal(
+        np.asarray(st_ref.node_requested), np.asarray(st_sh.node_requested)
+    )
+
+
+def test_sharded_gang_quota_assign_matches_unsharded():
+    """Gang all-or-nothing + elastic-quota admission on the mesh equals the
+    single-device solve (VERDICT r1 item 7: multi-device gang+quota parity)."""
+    from koordinator_tpu.ops.gang import GangInfo, gang_assign
+    from koordinator_tpu.quota.admission import QuotaDeviceState
+    from koordinator_tpu.quota.tree import UNBOUNDED, QuotaTree
+
+    state, pods = build_problem(n_pods=32)
+    gang_id = np.full(pods.capacity, -1, np.int32)
+    gang_id[:8] = 0
+    gang_id[8:12] = 1
+    quota_id = np.full(pods.capacity, -1, np.int32)
+
+    total = np.zeros(R, np.int64)
+    total[CPU] = 60_000
+    tree = QuotaTree(total)
+    mx = np.full(R, UNBOUNDED, np.int64)
+    mx[CPU] = 24_000
+    mn = np.zeros(R, np.int64)
+    tree.add("q", min=mn, max=mx)
+    tree.set_request("q", total)
+    tree.refresh_runtime()
+    quota, index = QuotaDeviceState.from_tree(tree)
+    quota_id[12:24] = index["q"]
+
+    pods = pods.replace(
+        gang_id=np.asarray(gang_id), quota_id=np.asarray(quota_id)
+    )
+    gangs = GangInfo.build(np.array([6, 4], np.int32))
+    cfg = ScoringConfig.default()
+
+    f = jax.jit(gang_assign, static_argnames=("passes",))
+    a_ref, st_ref, q_ref = f(state, pods, cfg, gangs, quota, passes=2)
+
+    mesh = pmesh.solver_mesh(pods_axis=2)
+    sstate = pmesh.shard_cluster_state(state, mesh)
+    spods = pmesh.shard_pod_batch(pods, mesh)
+    a_sh, st_sh, q_sh = f(sstate, spods, cfg, gangs, quota, passes=2)
+
+    assert np.array_equal(np.asarray(a_ref), np.asarray(a_sh))
+    assert np.array_equal(
+        np.asarray(st_ref.node_requested), np.asarray(st_sh.node_requested)
+    )
+    assert np.array_equal(
+        np.asarray(q_ref.headroom), np.asarray(q_sh.headroom)
+    )
