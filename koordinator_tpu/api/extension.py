@@ -25,6 +25,10 @@ LABEL_POD_PRIORITY_CLASS = f"{DOMAIN}/priority-class"
 LABEL_POD_MUTATING_UPDATE = f"{DOMAIN}/mutating-update"
 
 # Gang / coscheduling (apis/extension/coscheduling.go)
+#: quota.scheduling.koordinator.sh/name — the pod's elastic quota
+#: (apis/extension/elastic_quota.go:38)
+LABEL_QUOTA_NAME = "quota.scheduling.koordinator.sh/name"
+
 LABEL_GANG_NAME = "pod-group.scheduling.sigs.k8s.io/name"
 LABEL_GANG_MIN_NUM = "pod-group.scheduling.sigs.k8s.io/min-available"
 ANNOTATION_GANG_GROUPS = f"{SCHEDULING_DOMAIN}/gang-groups"
@@ -53,6 +57,12 @@ ANNOTATION_SCHEDULE_EXPLANATION = f"{SCHEDULING_DOMAIN}/schedule-explanation"
 # Eviction / descheduling
 LABEL_SOFT_EVICTION = f"{SCHEDULING_DOMAIN}/soft-eviction"
 ANNOTATION_EVICTION_COST = f"{DOMAIN}/eviction-cost"
+#: per-pod resctrl request: JSON {"l3": percent, "mb": percent}
+#: (apis/extension AnnotationResctrl)
+ANNOTATION_RESCTRL = f"{NODE_DOMAIN}/resctrl"
+#: per-pod network QoS: JSON {"ingressBps": n, "egressBps": n}
+#: (apis/extension/constants.go:48 AnnotationNetworkQOS)
+ANNOTATION_NETWORK_QOS = f"{DOMAIN}/networkQOS"
 
 # Extended resource names (apis/extension/resource.go:27-30)
 RESOURCE_BATCH_CPU = "kubernetes.io/batch-cpu"

@@ -65,6 +65,7 @@ KOORDLET_GATES = FeatureGates({
     "Accelerators": False,
     "RDMADevices": False,
     "CPICollector": False,
+    "ResctrlCollector": False,
     "PSICollector": True,
     "BlkIOReconcile": False,
     "ColdPageCollector": False,

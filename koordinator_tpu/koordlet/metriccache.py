@@ -39,6 +39,16 @@ COLD_PAGE_BYTES = "cold_page_bytes"
 PAGE_CACHE_BYTES = "page_cache_bytes"
 HOST_APP_CPU_USAGE = "host_app_cpu_usage"    # labels: app
 HOST_APP_MEMORY_USAGE = "host_app_memory_usage"
+NODE_DISK_READ_RATE = "node_disk_read_bytes_rate"    # labels: device
+NODE_DISK_WRITE_RATE = "node_disk_write_bytes_rate"  # labels: device
+NODE_DISK_IO_UTIL = "node_disk_io_util_pct"          # labels: device
+RESCTRL_LLC_OCCUPANCY = "resctrl_llc_occupancy"      # labels: group
+RESCTRL_MBM_TOTAL_RATE = "resctrl_mbm_total_bytes_rate"  # labels: group
+ACCEL_CORE_USAGE = "accel_core_usage_pct"    # labels: minor, uuid, type
+ACCEL_MEM_USED = "accel_mem_used_bytes"      # labels: minor, uuid, type
+#: KV keys (metric_cache KV store)
+KV_NODE_CPU_INFO = "node_cpu_info"
+KV_NODE_NUMA_INFO = "node_numa_info"
 
 
 def _series_key(metric: str, labels: Mapping[str, str] | None) -> tuple:

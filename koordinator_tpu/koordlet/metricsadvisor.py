@@ -462,6 +462,170 @@ class HostApplicationCollector:
                 pass
 
 
+class NodeInfoCollector:
+    """Node CPU model/topology snapshot (collectors/nodeinfo): lscpu-style
+    topology + NUMA layout stored in the metric cache's KV side, consumed by
+    the NodeResourceTopology reporter and the cpu-normalization plugin."""
+
+    name = "nodeinfo"
+
+    def __init__(self, deps: _Deps):
+        self.d = deps
+
+    def enabled(self) -> bool:
+        return os.path.exists(
+            os.path.join(self.d.cfg.sys_root, "devices", "system", "cpu")
+        ) or os.path.exists(self.d.cfg.proc_path("cpuinfo"))
+
+    def collect(self) -> None:
+        topo = procfs.read_cpu_topology(self.d.cfg)
+        self.d.cache.set_kv(mc.KV_NODE_CPU_INFO, topo)
+        numa: dict[int, list[int]] = {}
+        for cpu in topo.cpus:
+            numa.setdefault(cpu.node, []).append(cpu.cpu)
+        self.d.cache.set_kv(mc.KV_NODE_NUMA_INFO, numa)
+
+
+class NodeStorageInfoCollector:
+    """Disk IO rates + utilization (collectors/nodestorageinfo): per
+    whole-disk read/write bytes per second and io-ticks utilization derived
+    from consecutive /proc/diskstats samples."""
+
+    name = "nodestorageinfo"
+
+    def __init__(self, deps: _Deps):
+        self.d = deps
+        self._last: dict[str, tuple[float, procfs.DiskStat]] = {}
+
+    def enabled(self) -> bool:
+        return os.path.exists(self.d.cfg.proc_path("diskstats"))
+
+    def collect(self) -> None:
+        now = self.d.clock()
+        stats = procfs.read_diskstats(self.d.cfg)
+        for dev, cur in stats.items():
+            prev = self._last.get(dev)
+            self._last[dev] = (now, cur)
+            if prev is None:
+                continue
+            t0, p = prev
+            dt = max(now - t0, 1e-9)
+            labels = {"device": dev}
+            self.d.cache.append(
+                mc.NODE_DISK_READ_RATE,
+                max(cur.read_bytes - p.read_bytes, 0) / dt, labels, ts=now,
+            )
+            self.d.cache.append(
+                mc.NODE_DISK_WRITE_RATE,
+                max(cur.written_bytes - p.written_bytes, 0) / dt,
+                labels, ts=now,
+            )
+            util = max(cur.io_ticks_ms - p.io_ticks_ms, 0) / (dt * 1000.0)
+            self.d.cache.append(
+                mc.NODE_DISK_IO_UTIL, min(util * 100.0, 100.0), labels, ts=now
+            )
+
+
+class PageCacheCollector:
+    """Node + per-pod page cache (collectors/pagecache): node Cached from
+    /proc/meminfo, pod cache from memory.stat total_cache (v1) / file (v2)."""
+
+    name = "pagecache"
+
+    def __init__(self, deps: _Deps):
+        self.d = deps
+
+    def enabled(self) -> bool:
+        return os.path.exists(self.d.cfg.proc_path("meminfo"))
+
+    def collect(self) -> None:
+        now = self.d.clock()
+        mem = procfs.read_meminfo(self.d.cfg)
+        self.d.cache.append(mc.PAGE_CACHE_BYTES, float(mem.cached), ts=now)
+        for pod in self.d.states.get_all_pods():
+            rel = pod.cgroup_dir(self.d.cfg)
+            try:
+                raw = cg.cgroup_read(cg.MEMORY_STAT, rel, self.d.cfg)
+            except OSError:
+                continue
+            cache = 0
+            for line in raw.splitlines():
+                parts = line.split()
+                if len(parts) == 2 and parts[0] in ("total_cache", "file"):
+                    cache = int(parts[1])
+                    break
+            self.d.cache.append(
+                mc.PAGE_CACHE_BYTES, float(cache), {"pod_uid": pod.uid}, ts=now
+            )
+
+
+class ResctrlCollector:
+    """Per-QoS-group LLC occupancy + memory-bandwidth rate
+    (collectors/resctrl): reads resctrl mon_data of the LS/LSR/BE groups the
+    resctrl hook/qos plugin maintains."""
+
+    name = "resctrl"
+
+    def __init__(self, deps: _Deps):
+        self.d = deps
+        self._last_mbm: dict[str, tuple[float, int]] = {}
+
+    def enabled(self) -> bool:
+        from koordinator_tpu.features import KOORDLET_GATES
+        from koordinator_tpu.koordlet.system.resctrl import ResctrlFS
+
+        return (
+            KOORDLET_GATES.enabled("ResctrlCollector")
+            and ResctrlFS(self.d.cfg).available()
+        )
+
+    def _mon_value(self, group: str, filename: str) -> int:
+        from koordinator_tpu.koordlet.system.resctrl import ResctrlFS
+
+        fs = ResctrlFS(self.d.cfg)
+        base = os.path.join(fs.group_dir(group), "mon_data")
+        total = 0
+        found = False
+        if not os.path.isdir(base):
+            raise OSError(f"no mon_data for {group}")
+        for domain in sorted(os.listdir(base)):
+            path = os.path.join(base, domain, filename)
+            if os.path.isfile(path):
+                with open(path) as f:
+                    total += int(f.read().strip())
+                found = True
+        if not found:
+            raise OSError(f"no {filename} under {base}")
+        return total
+
+    def collect(self) -> None:
+        from koordinator_tpu.koordlet.system import resctrl as rc
+
+        now = self.d.clock()
+        for group in rc.ALL_GROUPS:
+            try:
+                occ = self._mon_value(group, "llc_occupancy")
+                self.d.cache.append(
+                    mc.RESCTRL_LLC_OCCUPANCY, float(occ),
+                    {"group": group}, ts=now,
+                )
+            except OSError:
+                pass
+            try:
+                total = self._mon_value(group, "mbm_total_bytes")
+            except OSError:
+                continue
+            prev = self._last_mbm.get(group)
+            self._last_mbm[group] = (now, total)
+            if prev is None:
+                continue
+            t0, v0 = prev
+            rate = max(total - v0, 0) / max(now - t0, 1e-9)
+            self.d.cache.append(
+                mc.RESCTRL_MBM_TOTAL_RATE, rate, {"group": group}, ts=now
+            )
+
+
 class MetricsAdvisor:
     """The collector registry + driver (metricsadvisor/framework)."""
 
@@ -470,6 +634,12 @@ class MetricsAdvisor:
                  host_apps: dict[str, str] | None = None):
         deps = _Deps(states, cache, cfg, clock)
         self.deps = deps
+        from koordinator_tpu.koordlet.devices import (
+            AcceleratorCollector,
+            RdmaCollector,
+            XpuCollector,
+        )
+
         self.collectors: list[Collector] = [
             NodeResourceCollector(deps),
             PodResourceCollector(deps),
@@ -480,6 +650,13 @@ class MetricsAdvisor:
             ColdMemoryCollector(deps),
             CPICollector(deps),
             HostApplicationCollector(deps, host_apps),
+            NodeInfoCollector(deps),
+            NodeStorageInfoCollector(deps),
+            PageCacheCollector(deps),
+            ResctrlCollector(deps),
+            AcceleratorCollector(deps),
+            RdmaCollector(deps),
+            XpuCollector(deps),
         ]
 
     def collect_once(self) -> list[str]:

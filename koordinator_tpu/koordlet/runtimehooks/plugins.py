@@ -193,6 +193,185 @@ class CPUNormalization:
         ctx.response.set_cgroup(cg.CPU_CFS_QUOTA, str(quota))
 
 
+class ResctrlHook:
+    """Per-pod resctrl placement (hooks/resctrl/): a pod carrying the
+    resctrl annotation ({"l3": pct, "mb": pct}) gets its own ctrl group with
+    the requested L3 way mask / MBA throttle; pods without it fall into the
+    per-QoS groups the qosmanager resctrl plugin maintains.  The response's
+    resctrl fields are applied by :class:`ResctrlUpdater` (updater.go
+    equivalent) — resctrl is not a cgroup, so it bypasses the executor."""
+
+    name = "Resctrl"
+
+    def __init__(self, num_ways: int = 20):
+        self.num_ways = num_ways
+
+    def __call__(self, ctx: PodContext | ContainerContext) -> None:
+        if not RUNTIMEHOOK_GATES.enabled(self.name):
+            return
+        import json
+
+        from koordinator_tpu.koordlet.system import resctrl as rc
+
+        raw = ctx.pod.annotations.get(ext.ANNOTATION_RESCTRL, "")
+        if raw:
+            try:
+                spec = json.loads(raw)
+            except ValueError:
+                return
+            ctx.response.resctrl_group = f"koord-pod-{ctx.pod.uid}"
+            lines = []
+            l3 = int(spec.get("l3", 100))
+            mask = rc.percent_to_way_mask(l3, self.num_ways)
+            lines.append(f"L3:0={mask:x}")
+            if "mb" in spec:
+                lines.append(f"MB:0={int(spec['mb'])}")
+            ctx.response.resctrl_schemata = "\n".join(lines) + "\n"
+        else:
+            # QoS-class group membership (LSE/LSR -> LSR, LS -> LS, BE -> BE)
+            qos = ctx.pod.qos_class
+            group = (
+                rc.GROUP_BE if qos.is_best_effort
+                else rc.GROUP_LSR if qos.name in ("LSE", "LSR")
+                else rc.GROUP_LS
+            )
+            ctx.response.resctrl_group = group
+
+
+class ResctrlUpdater:
+    """Applies a hook response's resctrl fields to the resctrl fs: ensures
+    the group, programs schemata, binds the pod's tasks."""
+
+    def __init__(self, cfg=None):
+        from koordinator_tpu.koordlet.system.resctrl import ResctrlFS
+
+        self.fs = ResctrlFS(cfg)
+
+    def apply(self, response, pids: list[int]) -> None:
+        if response.resctrl_group is None:
+            return
+        self.fs.ensure_group(response.resctrl_group)
+        if response.resctrl_schemata is not None:
+            import os
+
+            path = os.path.join(
+                self.fs.group_dir(response.resctrl_group), "schemata"
+            )
+            with open(path, "w") as f:
+                f.write(response.resctrl_schemata)
+        if pids:
+            self.fs.add_tasks(response.resctrl_group, pids)
+
+    def remove_group(self, pod_uid: str) -> None:
+        """Pod removal: drop the per-pod ctrl group (RemovePodResctrlResources)."""
+        import os
+        import shutil
+
+        path = self.fs.group_dir(f"koord-pod-{pod_uid}")
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+
+
+#: tc class handles per QoS tier (netqos_tc.go scheme: one htb class per
+#: tier under the root qdisc; high = prod, mid = mid, low = BE)
+TC_CLASSID_HIGH = 0x1_0002
+TC_CLASSID_MID = 0x1_0003
+TC_CLASSID_LOW = 0x1_0004
+
+
+class TCNetworkQoS:
+    """tc network QoS (hooks/tc/): classify each pod's traffic into the
+    per-tier htb class via net_cls.classid; the qdisc/class setup itself is
+    rendered by :func:`tc_setup_commands` for the node agent to install."""
+
+    name = "TCNetworkQoS"
+
+    def __call__(self, ctx: PodContext | ContainerContext) -> None:
+        if not RUNTIMEHOOK_GATES.enabled(self.name):
+            return
+        qos = ctx.pod.qos_class
+        classid = (
+            TC_CLASSID_LOW if qos.is_best_effort
+            else TC_CLASSID_HIGH if qos.is_latency_sensitive
+            else TC_CLASSID_MID
+        )
+        ctx.response.set_cgroup(cg.NET_CLS_CLASSID, str(classid))
+
+
+def tc_setup_commands(
+    iface: str, total_mbps: int,
+    high_pct: int = 40, mid_pct: int = 30, low_pct: int = 30,
+) -> list[list[str]]:
+    """The tc qdisc/class plan (helper.go): an htb root with one class per
+    tier — guaranteed rate by percentage, ceil at line rate so idle bandwidth
+    is borrowable.  Returned as argv lists for the agent to execute."""
+    def rate(pct: int) -> str:
+        return f"{total_mbps * pct // 100}mbit"
+
+    line = f"{total_mbps}mbit"
+    return [
+        ["tc", "qdisc", "add", "dev", iface, "root", "handle", "1:", "htb",
+         "default", "2"],
+        ["tc", "class", "add", "dev", iface, "parent", "1:", "classid", "1:2",
+         "htb", "rate", rate(high_pct), "ceil", line],
+        ["tc", "class", "add", "dev", iface, "parent", "1:", "classid", "1:3",
+         "htb", "rate", rate(mid_pct), "ceil", line],
+        ["tc", "class", "add", "dev", iface, "parent", "1:", "classid", "1:4",
+         "htb", "rate", rate(low_pct), "ceil", line],
+    ]
+
+
+class TerwayQoS:
+    """terway dataplane bandwidth limits (hooks/terwayqos/): each pod's
+    ingress/egress bps from the networkQOS annotation is written as a JSON
+    file the terway daemon watches (``<var_run_root>/terway-qos/<uid>.json``);
+    removal deletes the file."""
+
+    name = "TerwayQoS"
+
+    def __init__(self, cfg=None):
+        from koordinator_tpu.koordlet.system.config import get_config
+
+        self.cfg = cfg or get_config()
+
+    @property
+    def root(self) -> str:
+        import os
+
+        return os.path.join(self.cfg.var_run_root, "terway-qos")
+
+    def __call__(self, ctx: PodContext | ContainerContext) -> None:
+        if not RUNTIMEHOOK_GATES.enabled(self.name):
+            return
+        import json
+        import os
+
+        raw = ctx.pod.annotations.get(ext.ANNOTATION_NETWORK_QOS, "")
+        if not raw:
+            return
+        try:
+            spec = json.loads(raw)
+        except ValueError:
+            return
+        os.makedirs(self.root, exist_ok=True)
+        out = {
+            "podUID": ctx.pod.uid,
+            "ingressBps": int(spec.get("ingressBps", 0)),
+            "egressBps": int(spec.get("egressBps", 0)),
+            "prio": 2 if ctx.pod.qos_class.is_best_effort else 0,
+        }
+        with open(os.path.join(self.root, f"{ctx.pod.uid}.json"), "w") as f:
+            json.dump(out, f)
+
+    def remove(self, pod_uid: str) -> None:
+        import os
+
+        try:
+            os.unlink(os.path.join(self.root, f"{pod_uid}.json"))
+        except OSError:
+            pass
+
+
 def register_default_hooks(
     registry: HookRegistry,
     node_slo: Callable[[], NodeSLO],
@@ -208,8 +387,14 @@ def register_default_hooks(
     rdma = RDMADeviceInject()
     coresched = CoreSchedHook(node_slo, core_sched)
     cpunorm = CPUNormalization(cpu_normalization_ratio or (lambda: 100))
+    resctrl = ResctrlHook()
+    tc = TCNetworkQoS()
+    terway = TerwayQoS()
 
     registry.register(Stage.PRE_RUN_POD_SANDBOX, group_identity.name, group_identity)
+    registry.register(Stage.PRE_RUN_POD_SANDBOX, resctrl.name, resctrl)
+    registry.register(Stage.PRE_RUN_POD_SANDBOX, tc.name, tc)
+    registry.register(Stage.PRE_RUN_POD_SANDBOX, terway.name, terway)
     for stage in (Stage.PRE_CREATE_CONTAINER, Stage.PRE_UPDATE_CONTAINER):
         registry.register(stage, group_identity.name, group_identity)
         registry.register(stage, cpuset.name, cpuset)
@@ -217,6 +402,8 @@ def register_default_hooks(
         registry.register(stage, cpunorm.name, cpunorm)
     registry.register(Stage.PRE_CREATE_CONTAINER, gpu.name, gpu)
     registry.register(Stage.PRE_CREATE_CONTAINER, rdma.name, rdma)
+    registry.register(Stage.PRE_CREATE_CONTAINER, resctrl.name, resctrl)
+    registry.register(Stage.PRE_CREATE_CONTAINER, tc.name, tc)
     registry.register(Stage.PRE_START_CONTAINER, coresched.name, coresched)
     return {
         "groupidentity": group_identity,
@@ -226,4 +413,7 @@ def register_default_hooks(
         "rdma": rdma,
         "coresched": coresched,
         "cpunormalization": cpunorm,
+        "resctrl": resctrl,
+        "tc": tc,
+        "terwayqos": terway,
     }

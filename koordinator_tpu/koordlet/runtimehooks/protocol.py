@@ -29,6 +29,10 @@ class Response:
     cpuset_cpus: Optional[str] = None
     cpuset_mems: Optional[str] = None
     core_sched_group: Optional[str] = None  # group id; "" = opt out
+    #: resctrl placement: ctrl-group name + optional schemata to program
+    #: (applied by ResctrlUpdater, not the cgroup executor)
+    resctrl_group: Optional[str] = None
+    resctrl_schemata: Optional[str] = None
 
     def set_cgroup(self, resource: cg.CgroupResource, value: str) -> None:
         self.cgroup_values[resource.name] = value

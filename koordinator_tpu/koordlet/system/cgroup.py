@@ -133,6 +133,10 @@ CPU_BVT_WARP_NS = CgroupResource(  # group identity (Anolis kernel)
     "cpu.bvt_warp_ns", "cpu", "cpu.bvt_warp_ns", "cpu.bvt_warp_ns",
     _range_validator(-1, 2),
 )
+NET_CLS_CLASSID = CgroupResource(  # tc classful shaping handle (v1 only)
+    "net_cls.classid", "net_cls", "net_cls.classid", "",
+    _range_validator(0, 2**32 - 1),
+)
 CPU_IDLE = CgroupResource(
     "cpu.idle", "cpu", "cpu.idle", "cpu.idle", _range_validator(0, 1),
 )
@@ -239,7 +243,7 @@ _REGISTRY: dict[str, CgroupResource] = {
         MEMORY_WMARK_MIN_ADJ, MEMORY_PRIORITY, MEMORY_USE_PRIORITY_OOM,
         MEMORY_OOM_GROUP, MEMORY_STAT, MEMORY_USAGE, BLKIO_WEIGHT, BLKIO_READ_BPS,
         BLKIO_WRITE_BPS, BLKIO_READ_IOPS, BLKIO_WRITE_IOPS, CPU_PRESSURE,
-        MEMORY_PRESSURE, IO_PRESSURE, MEMORY_IDLE_PAGE_STATS,
+        MEMORY_PRESSURE, IO_PRESSURE, MEMORY_IDLE_PAGE_STATS, NET_CLS_CLASSID,
     ]
 }
 

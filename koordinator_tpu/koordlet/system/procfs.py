@@ -90,6 +90,59 @@ def read_meminfo(cfg: SystemConfig | None = None) -> MemInfo:
         return parse_meminfo(f.read())
 
 
+@dataclasses.dataclass
+class DiskStat:
+    """One device line of /proc/diskstats (sectors are 512-byte units)."""
+
+    device: str
+    reads_completed: int
+    sectors_read: int
+    writes_completed: int
+    sectors_written: int
+    io_in_progress: int
+    io_ticks_ms: int
+
+    @property
+    def read_bytes(self) -> int:
+        return self.sectors_read * 512
+
+    @property
+    def written_bytes(self) -> int:
+        return self.sectors_written * 512
+
+
+def parse_diskstats(content: str) -> dict[str, DiskStat]:
+    """Whole-disk rows of /proc/diskstats (partitions like sda1 are skipped
+    with the usual heuristic: trailing digit after a letter-name, except
+    nvme0n1-style whole disks)."""
+    out: dict[str, DiskStat] = {}
+    for line in content.splitlines():
+        parts = line.split()
+        if len(parts) < 14:
+            continue
+        name = parts[2]
+        if name[-1].isdigit() and not name.startswith(("nvme", "loop", "md")):
+            continue  # partition (sda1); nvme whole disks end in digits
+        if name.startswith("nvme") and "p" in name[4:]:
+            continue  # nvme0n1p1 partition
+        out[name] = DiskStat(
+            device=name,
+            reads_completed=int(parts[3]),
+            sectors_read=int(parts[5]),
+            writes_completed=int(parts[7]),
+            sectors_written=int(parts[9]),
+            io_in_progress=int(parts[11]),
+            io_ticks_ms=int(parts[12]),
+        )
+    return out
+
+
+def read_diskstats(cfg: SystemConfig | None = None) -> dict[str, DiskStat]:
+    cfg = cfg or get_config()
+    with open(cfg.proc_path("diskstats")) as f:
+        return parse_diskstats(f.read())
+
+
 # ---- cpuset list format -----------------------------------------------------
 
 

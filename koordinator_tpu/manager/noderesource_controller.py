@@ -292,4 +292,9 @@ class NodeResourceController:
                 )
             elif dev.type == "rdma":
                 out[ext.RESOURCE_RDMA] = out.get(ext.RESOURCE_RDMA, 0) + 100
+            else:
+                # xpu / tpu / vendor devices: publish their declared resource
+                # quantities as-is (xpudeviceresource parity)
+                for res, amount in dev.resources.items():
+                    out[res] = out.get(res, 0) + int(amount)
         return out

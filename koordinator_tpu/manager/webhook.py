@@ -207,6 +207,59 @@ class PodValidatingWebhook:
         return errors
 
 
+class MultiQuotaTreeAffinity:
+    """Multi-quota-tree node affinity injection.
+
+    Reference: ``pkg/webhook/pod/mutating/multi_quota_tree_affinity.go`` — at
+    pod CREATE, if the pod's quota (label, else namespace) belongs to a quota
+    tree generated from an ElasticQuotaProfile, the profile's node selector is
+    ANDed into the pod's scheduling constraints so the pod can only land on
+    the tree's nodes.
+
+    We merge into ``spec.nodeSelector`` (our feasibility model's affinity
+    input).  A key the pod already pins to a DIFFERENT value stays — the AND
+    of conflicting requirements is unsatisfiable either way, and keeping the
+    pod's own term surfaces the conflict in diagnosis rather than silently
+    rewriting user intent.
+    """
+
+    def __init__(self):
+        self.quota_tree: dict[str, str] = {}          # quota name -> tree id
+        self.tree_selector: dict[str, dict[str, str]] = {}
+
+    def set_quota(self, quota: crds.ElasticQuota) -> None:
+        if quota.tree_id:
+            self.quota_tree[quota.name] = quota.tree_id
+
+    def set_profile_selector(
+        self, tree_id: str, node_selector: Mapping[str, str]
+    ) -> None:
+        self.tree_selector[tree_id] = dict(node_selector)
+
+    def mutate(self, pod: dict, operation: str = "CREATE") -> bool:
+        """Returns True when the pod was mutated."""
+        if operation != "CREATE":
+            return False
+        labels = _labels(pod)
+        quota = labels.get(ext.LABEL_QUOTA_NAME) or pod.get(
+            "metadata", {}
+        ).get("namespace", "")
+        tree = self.quota_tree.get(quota)
+        if tree is None:
+            return False
+        selector = self.tree_selector.get(tree)
+        if not selector:
+            return False
+        spec = pod.setdefault("spec", {})
+        node_selector = spec.setdefault("nodeSelector", {})
+        changed = False
+        for k, v in selector.items():
+            if k not in node_selector:
+                node_selector[k] = v
+                changed = True
+        return changed
+
+
 class QuotaEvaluator:
     """Admission-time quota charge (webhook/quotaevaluate): check the pod's
     request against its ElasticQuota's remaining runtime up the tree."""

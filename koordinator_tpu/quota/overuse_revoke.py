@@ -1,0 +1,171 @@
+"""Quota overuse revoke: evict pods of quotas whose used exceeds runtime.
+
+Reference: ``pkg/scheduler/plugins/elasticquota/quota_overuse_revoke.go`` —
+a per-quota monitor flags quotas whose used has exceeded runtime continuously
+for ``delay_evict_sec`` (the runtime shrinks when other quotas' requests rise,
+so previously-admitted pods can overshoot); victim selection then walks the
+quota's pods least-important-first, removing until used <= runtime, and
+finally tries to assign back most-important-first (getToRevokePodList).
+
+TPU redesign: both walks become ONE pair of segmented ``lax.scan`` passes over
+the globally-sorted bound-pod list, so every over-used quota's victim set is
+solved in the same kernel call — the per-quota Go loops are the batch axis
+here.  The host controller keeps only the timers.
+
+Divergence note: the reference compares used vs runtime on every resource
+name present; we compare on the quota's declared-max (checked) dims, matching
+the admission convention in :mod:`koordinator_tpu.quota.admission` (an
+undeclared dim has no meaningful runtime).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.ops.preemption import ScheduledPods
+
+
+def select_overuse_victims(
+    sched: ScheduledPods,
+    used: jnp.ndarray,      # (Q, R) int32 per-quota used
+    runtime: jnp.ndarray,   # (Q, R) int32 per-quota runtime
+    checked: jnp.ndarray,   # (Q, R) bool — dims declared in the quota's max
+) -> jnp.ndarray:
+    """(V,) bool revoke mask across every quota at once.
+
+    Phase 1 (ascending importance): while the pod's quota is still over on
+    any checked dim, tentatively remove the pod.  Phase 2 (descending
+    importance): reprieve tentative victims that fit back under runtime —
+    unless the quota is over even with everything removed, in which case all
+    tentative victims go (the reference's "should evict all" branch).
+    """
+    cand = sched.valid & ~sched.non_preemptible & (sched.quota_id >= 0)
+    qid = jnp.maximum(sched.quota_id, 0)
+    # ascending importance: lowest priority first, stable by row index
+    pri_key = jnp.where(cand, sched.priority, jnp.int32(2**31 - 1))
+    asc = jnp.lexsort((jnp.arange(sched.capacity), pri_key))
+
+    def phase1(u, j):
+        q = qid[j]
+        over = jnp.any((u[q] > runtime[q]) & checked[q])
+        do = cand[j] & over
+        u = u.at[q].add(jnp.where(do, -sched.requests[j], 0))
+        return u, do
+
+    u1, tent_asc = jax.lax.scan(phase1, used, asc)
+    tentative = jnp.zeros(sched.capacity, bool).at[asc].set(tent_asc)
+
+    # quotas over even after removing every candidate: no reprieve at all
+    hopeless = jnp.any((u1 > runtime) & checked, axis=-1)  # (Q,)
+
+    def phase2(u, j):
+        q = qid[j]
+        req = sched.requests[j]
+        fits = jnp.all((u[q] + req <= runtime[q]) | (req == 0))
+        back = tentative[j] & fits & ~hopeless[q]
+        u = u.at[q].add(jnp.where(back, req, 0))
+        return u, tentative[j] & ~back
+
+    desc = asc[::-1]
+    _, revoke_desc = jax.lax.scan(phase2, u1, desc)
+    return jnp.zeros(sched.capacity, bool).at[desc].set(revoke_desc)
+
+
+class QuotaOveruseRevokeController:
+    """Host loop: timers + eviction callback around the batched kernel.
+
+    ``scheduler`` supplies the bound-pod registry and quota tree; victims are
+    evicted via ``revoke_fn(pod_name, quota_name)`` and released through the
+    scheduler's own accounting (remove_bound_pod + quota used).
+    """
+
+    def __init__(
+        self,
+        scheduler,
+        revoke_fn=None,
+        delay_evict_sec: float = 5.0,
+        clock=time.monotonic,
+    ):
+        self.scheduler = scheduler
+        self.revoke_fn = revoke_fn
+        self.delay_evict_sec = delay_evict_sec
+        self.clock = clock
+        self._last_under: dict[str, float] = {}
+        self._kernel = jax.jit(select_overuse_victims)
+
+    def _over_used(self, qnode) -> bool:
+        from koordinator_tpu.quota.tree import UNBOUNDED
+
+        checked = qnode.max != UNBOUNDED
+        return bool(np.any((qnode.used > qnode.runtime) & checked))
+
+    def monitor(self) -> list[str]:
+        """Quotas over-used continuously past the delay (monitor())."""
+        tree = self.scheduler.quota_tree
+        if tree is None:
+            return []
+        now = self.clock()
+        triggered = []
+        for name, qnode in tree.nodes.items():
+            if self._over_used(qnode):
+                since = self._last_under.setdefault(name, now)
+                if now - since > self.delay_evict_sec:
+                    triggered.append(name)
+                    self._last_under[name] = now  # re-arm after trigger
+            else:
+                self._last_under[name] = now
+        return triggered
+
+    def revoke_once(self) -> list[str]:
+        """One controller cycle: returns the evicted pod names."""
+        triggered = set(self.monitor())
+        if not triggered:
+            return []
+        tree = self.scheduler.quota_tree
+        quota_index = {n: i for i, n in enumerate(sorted(tree.nodes))}
+        sched, bound_names = self.scheduler._build_scheduled(quota_index)
+        if not bound_names:
+            return []
+
+        from koordinator_tpu.quota.admission import HEADROOM_CLAMP
+        from koordinator_tpu.quota.tree import UNBOUNDED
+
+        q = len(quota_index)
+        used = np.zeros((max(q, 1), sched.requests.shape[1]), np.int32)
+        runtime = np.zeros_like(used)
+        checked = np.zeros(used.shape, bool)
+        for name, i in quota_index.items():
+            qnode = tree.nodes[name]
+            used[i] = np.clip(qnode.used, 0, HEADROOM_CLAMP)
+            runtime[i] = np.clip(qnode.runtime, 0, HEADROOM_CLAMP)
+            # only triggered quotas participate; others are "never over"
+            if name in triggered:
+                checked[i] = qnode.max != UNBOUNDED
+
+        revoke = np.asarray(self._kernel(
+            sched, jnp.asarray(used), jnp.asarray(runtime),
+            jnp.asarray(checked),
+        ))
+        evicted = []
+        for v in np.flatnonzero(revoke):
+            name = bound_names[v]
+            bp = self.scheduler.bound.get(name)
+            if bp is None:
+                continue
+            quota = bp.quota
+            self.scheduler.remove_bound_pod(name)
+            if quota and quota in tree.nodes:
+                qn = tree.nodes[quota]
+                qn.used = qn.used - bp.requests.astype(np.int64)
+                if bp.non_preemptible:
+                    qn.non_preemptible_used = (
+                        qn.non_preemptible_used - bp.requests.astype(np.int64)
+                    )
+            if self.revoke_fn is not None:
+                self.revoke_fn(name, quota)
+            evicted.append(name)
+        return evicted

@@ -47,6 +47,10 @@ class QuotaNode:
     shared_weight: np.ndarray  # (R,) int64; defaults to max (reference default)
     guarantee: np.ndarray      # (R,) int64
     allow_lent: bool = True
+    #: opt-in to proportional min shrinking when the parent's resource can no
+    #: longer cover the children's min sum (scale_minquota_when_over_root_res
+    #: semantics; annotation-driven in the reference)
+    enable_scale_min: bool = False
     # computed:
     request: np.ndarray = None         # (R,) raw request (pods or children)
     limited_request: np.ndarray = None # (R,) min(request, max)
@@ -96,10 +100,15 @@ def hamilton_deltas(
 class QuotaTree:
     """Hierarchical quota tree with koordinator's runtime semantics."""
 
-    def __init__(self, total_resource: np.ndarray):
+    def __init__(self, total_resource: np.ndarray,
+                 scale_min_enabled: bool = False):
         self.total_resource = np.asarray(total_resource, dtype=np.int64)
         self.nodes: dict[str, QuotaNode] = {}
         self.children: dict[str, list[str]] = {ROOT: []}
+        #: EnableScaleMinQuota feature gate (GroupQuotaManager
+        #: scaleMinQuotaEnabled): shrink enable_scale_min children's min
+        #: proportionally when a parent's resource drops below the min sum
+        self.scale_min_enabled = scale_min_enabled
 
     def add(
         self,
@@ -110,6 +119,7 @@ class QuotaTree:
         shared_weight: np.ndarray | None = None,
         guarantee: np.ndarray | None = None,
         allow_lent: bool = True,
+        enable_scale_min: bool = False,
     ) -> None:
         if name in self.nodes or name == ROOT:
             raise ValueError(f"quota {name!r} already exists")
@@ -129,6 +139,7 @@ class QuotaTree:
         self.nodes[name] = QuotaNode(
             name=name, parent=parent, min=mn, max=mx,
             shared_weight=sw, guarantee=g, allow_lent=allow_lent,
+            enable_scale_min=enable_scale_min,
         )
         self.children.setdefault(name, [])
         self.children[parent].append(name)
@@ -174,6 +185,41 @@ class QuotaTree:
             if kids:
                 self._redistribute(kids, self.nodes[name].runtime)
 
+    def _scaled_mins(
+        self, names: list[str], total: np.ndarray
+    ) -> dict[str, np.ndarray]:
+        """Effective per-child min after scale-min-when-over-root-res.
+
+        Per dimension where the children's min sum exceeds the group's total:
+        non-scaling children keep their full min; the remainder (total minus
+        their sum, floored at 0) is split over scaling children proportional
+        to their original min (getScaledMinQuota semantics, floor division).
+        """
+        mins = {n: self.nodes[n].min.copy() for n in names}
+        if not self.scale_min_enabled:
+            return mins
+        enable = [n for n in names if self.nodes[n].enable_scale_min]
+        if not enable:
+            return mins
+        disable_sum = np.zeros(NUM_RESOURCE_DIMS, np.int64)
+        enable_sum = np.zeros(NUM_RESOURCE_DIMS, np.int64)
+        for n in names:
+            if self.nodes[n].enable_scale_min:
+                enable_sum += self.nodes[n].min
+            else:
+                disable_sum += self.nodes[n].min
+        need_scale = (disable_sum + enable_sum) > total
+        if not need_scale.any():
+            return mins
+        avail = np.maximum(total - disable_sum, 0)
+        for n in enable:
+            orig = self.nodes[n].min
+            scaled = np.where(
+                enable_sum > 0, avail * orig // np.maximum(enable_sum, 1), 0
+            )
+            mins[n] = np.where(need_scale, scaled, orig).astype(np.int64)
+        return mins
+
     def _redistribute(self, names: list[str], total: np.ndarray) -> None:
         """redistribution() (:119) independently per resource dimension."""
         # deterministic order = name asc (map iteration in Go is unordered but
@@ -181,15 +227,23 @@ class QuotaTree:
         names = sorted(names)
         for node in (self.nodes[n] for n in names):
             node.runtime = np.zeros(NUM_RESOURCE_DIMS, dtype=np.int64)
+        eff_min = self._scaled_mins(names, np.asarray(total, np.int64))
         for dim in range(NUM_RESOURCE_DIMS):
-            self._redistribute_dim(names, int(total[dim]), dim)
+            self._redistribute_dim(names, int(total[dim]), dim, eff_min)
 
-    def _redistribute_dim(self, names: list[str], total: int, dim: int) -> None:
+    def _redistribute_dim(
+        self, names: list[str], total: int, dim: int,
+        eff_min: dict[str, np.ndarray] | None = None,
+    ) -> None:
         to_partition = total
         hungry: list[QuotaNode] = []
         total_weight = 0
         for node in (self.nodes[n] for n in names):
-            auto_min = max(int(node.min[dim]), int(node.guarantee[dim]))
+            base_min = (
+                int(eff_min[node.name][dim]) if eff_min is not None
+                else int(node.min[dim])
+            )
+            auto_min = max(base_min, int(node.guarantee[dim]))
             request = int(node.limited_request[dim])
             if request > auto_min:
                 hungry.append(node)
