@@ -1,0 +1,117 @@
+"""FragmentationAware: rebalance nodes whose resource dimensions are
+unevenly consumed.
+
+Semantics from ``pkg/descheduler/framework/plugins/fragmentationaware/
+scoring.go``:
+
+- ``scoreNodeImbalance`` (scoring.go:63): per node, the *population* standard
+  deviation of the requested/allocatable fractions across the configured
+  resource dimensions; dimensions with zero allocatable are skipped
+  (scoring.go:33 — divide-by-zero guard).
+- ``scorePodRemovalGain`` (scoring.go:80): stddev(before) - stddev(after
+  removing the pod); a large positive gain means the pod is what skews the
+  node.
+
+The reference computes these per (node, pod) in Go loops; here both are
+batched tensor kernels over the same (N, R)/(P, R) milli-unit request
+tensors the scheduler already holds, and victim selection is a scan that
+replays evictions so later gains see earlier removals.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+
+
+def default_resource_mask() -> jnp.ndarray:
+    """(R,) bool — which dimensions participate (reference default:
+    cpu + memory; custom resources opt in via config)."""
+    mask = jnp.zeros(NUM_RESOURCE_DIMS, bool)
+    return mask.at[ResourceDim.CPU].set(True).at[ResourceDim.MEMORY].set(True)
+
+
+def node_imbalance(
+    requested: jnp.ndarray,      # (N, R) int32 milli-units requested
+    allocatable: jnp.ndarray,    # (N, R) int32 milli-units allocatable
+    resource_mask: jnp.ndarray,  # (R,) bool configured dimensions
+) -> jnp.ndarray:
+    """(N,) float32 — population stddev of allocation fractions
+    (scoring.go:63 scoreNodeImbalance)."""
+    valid = resource_mask[None, :] & (allocatable > 0)            # (N, R)
+    frac = jnp.where(
+        valid, requested.astype(jnp.float32) / jnp.maximum(allocatable, 1), 0.0
+    )
+    count = jnp.sum(valid, axis=-1)                               # (N,)
+    safe = jnp.maximum(count, 1)
+    mean = jnp.sum(frac, axis=-1) / safe
+    var = jnp.sum(jnp.where(valid, (frac - mean[:, None]) ** 2, 0.0), axis=-1)
+    return jnp.where(count > 0, jnp.sqrt(var / safe), 0.0)
+
+
+def removal_gains(
+    requested: jnp.ndarray,      # (N, R)
+    allocatable: jnp.ndarray,    # (N, R)
+    pod_node: jnp.ndarray,       # (P,) int32; -1 = unbound
+    pod_requests: jnp.ndarray,   # (P, R)
+    resource_mask: jnp.ndarray,  # (R,)
+) -> jnp.ndarray:
+    """(P,) float32 — stddev gain from removing each pod from its node,
+    all pods at once (scoring.go:80 scorePodRemovalGain)."""
+    node = jnp.maximum(pod_node, 0)
+    before = node_imbalance(requested, allocatable, resource_mask)  # (N,)
+    after_req = jnp.maximum(requested[node] - pod_requests, 0)      # (P, R)
+    after = node_imbalance(after_req, allocatable[node], resource_mask)
+    return jnp.where(pod_node >= 0, before[node] - after, 0.0)
+
+
+def select_victims(
+    requested: jnp.ndarray,       # (N, R)
+    allocatable: jnp.ndarray,     # (N, R)
+    node_valid: jnp.ndarray,      # (N,) bool
+    pod_node: jnp.ndarray,        # (P,) int32
+    pod_requests: jnp.ndarray,    # (P, R)
+    pod_evictable: jnp.ndarray,   # (P,) bool — host-side evictor filter result
+    resource_mask: jnp.ndarray,   # (R,)
+    imbalance_threshold: float = 0.2,
+    min_gain: float = 0.05,
+    max_victims: int = 16,
+) -> jnp.ndarray:
+    """(P,) bool victim mask.
+
+    Greedy highest-gain-first: each accepted eviction updates its node's
+    requested tensor, so subsequent gains are measured against the
+    already-rebalanced node (the reference recomputes scoreNodeImbalance
+    per candidate the same way). A pod is a victim only while its node's
+    imbalance still exceeds ``imbalance_threshold`` and its own gain
+    exceeds ``min_gain``.
+    """
+    p = pod_node.shape[0]
+    gains = removal_gains(requested, allocatable, pod_node, pod_requests,
+                          resource_mask)
+    order = jnp.argsort(-gains)   # best gains first
+
+    def step(carry, idx):
+        req, taken = carry
+        node = pod_node[idx]
+        safe = jnp.maximum(node, 0)
+        imb_before = node_imbalance(req[safe][None], allocatable[safe][None],
+                                    resource_mask)[0]
+        after_req = jnp.maximum(req[safe] - pod_requests[idx], 0)
+        imb_after = node_imbalance(after_req[None], allocatable[safe][None],
+                                   resource_mask)[0]
+        accept = (
+            (node >= 0)
+            & node_valid[safe]
+            & pod_evictable[idx]
+            & (taken < max_victims)
+            & (imb_before > imbalance_threshold)
+            & (imb_before - imb_after > min_gain)
+        )
+        req = req.at[safe].set(jnp.where(accept, after_req, req[safe]))
+        return (req, taken + accept.astype(jnp.int32)), accept
+
+    (_, _), accepted = jax.lax.scan(step, (requested, jnp.int32(0)), order)
+    return jnp.zeros(p, bool).at[order].set(accepted)

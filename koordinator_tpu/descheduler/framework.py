@@ -35,6 +35,24 @@ class PodInfo:
     annotations: dict = dataclasses.field(default_factory=dict)
     is_daemonset: bool = False
     has_local_storage: bool = False
+    # fields consumed by the upstream-port plugins (descheduler/upstream.py)
+    created: float = 0.0                 # creation timestamp (epoch seconds)
+    phase: str = "Running"               # Pending/Running/Succeeded/Failed
+    reason: str = ""                     # status.reason (e.g. OOMKilled)
+    restart_count: int = 0
+    images: tuple = ()                   # container image names
+    node_selector: dict = dataclasses.field(default_factory=dict)
+    # required node affinity: list of terms; a term is a tuple of
+    # (key, op, values) expressions, op in {In, NotIn, Exists, DoesNotExist};
+    # the pod fits a node if ANY term has ALL expressions matching
+    required_affinity: tuple = ()
+    # tolerations: (key, operator, value, effect); operator Equal/Exists,
+    # empty key + Exists tolerates everything, empty effect matches all
+    tolerations: tuple = ()
+    # anti-affinity terms owned by THIS pod: (selector dict, topology_key)
+    anti_affinity: tuple = ()
+    # topology spread constraints: (topology_key, max_skew, selector dict)
+    spread_constraints: tuple = ()
 
 
 @dataclasses.dataclass

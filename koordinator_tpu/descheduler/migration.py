@@ -55,12 +55,75 @@ class MigrationJob:
 
 @dataclasses.dataclass
 class ArbitrationLimits:
-    """Group limits (arbitrator/filter.go defaults)."""
+    """Group limits (arbitrator/filter.go defaults). The per-workload specs
+    are int-or-percent (e.g. 2 or "10%") resolved against the workload's
+    expected replicas via :func:`get_max_unavailable`; None means "use the
+    replica-count-dependent default"."""
 
     max_migrating_per_node: int = 2
     max_migrating_per_namespace: int = 10
-    max_migrating_per_workload: int = 2
-    max_unavailable_per_workload: int = 2
+    max_migrating_per_workload: int | str | None = None
+    max_unavailable_per_workload: int | str | None = None
+
+
+def scaled_int_or_percent(spec: int | str, replicas: int) -> int:
+    """intstr.GetScaledValueFromIntOrPercent, round-down."""
+    if isinstance(spec, str):
+        if not spec.endswith("%"):
+            raise ValueError(f"invalid int-or-percent {spec!r}")
+        return replicas * int(spec[:-1]) // 100
+    return int(spec)
+
+
+def get_max_unavailable(replicas: int, spec: int | str | None) -> int:
+    """migration/util/util.go:81 GetMaxUnavailable: resolve the spec against
+    replicas (a percent that floors to 0 becomes 1); an absent/zero spec
+    defaults to 10% above 10 replicas, 2 for 4-10, else 1; capped at
+    replicas."""
+    max_unavailable = 0
+    if spec is not None:
+        max_unavailable = scaled_int_or_percent(spec, replicas)
+        if max_unavailable == 0:
+            max_unavailable = 1  # a percent flooring to 0 still allows one
+    if max_unavailable == 0:
+        if replicas > 10:
+            max_unavailable = replicas * 10 // 100
+        elif 4 <= replicas <= 10:
+            max_unavailable = 2
+        else:
+            max_unavailable = 1
+    return min(max_unavailable, replicas)
+
+
+def get_max_migrating(replicas: int, spec: int | str | None) -> int:
+    """migration/util/util.go:116 — same resolution as max-unavailable."""
+    return get_max_unavailable(replicas, spec)
+
+
+@dataclasses.dataclass
+class Workload:
+    """What the controllerfinder resolves for an owner ref
+    (pkg/util/controllerfinder: GetPodsForRef → expected replicas; the
+    workload's own rollout maxUnavailable when it declares one)."""
+
+    ref: str                               # "Kind/name"
+    expected_replicas: int
+    max_unavailable: int | str | None = None   # workload spec override
+    unavailable: int = 0                   # currently not-ready pods
+
+
+class ControllerFinder:
+    """Resolves a pod's owning workload to (replicas, budgets) — the
+    reference's controllerfinder seam, fed by the states informer here."""
+
+    def __init__(self) -> None:
+        self._workloads: dict[str, Workload] = {}
+
+    def register(self, workload: Workload) -> None:
+        self._workloads[workload.ref] = workload
+
+    def get(self, ref: str) -> Workload | None:
+        return self._workloads.get(ref)
 
 
 class MigrationController:
@@ -72,14 +135,43 @@ class MigrationController:
         reserve_fn: Callable[[MigrationJob], str | None] | None = None,
         evict_fn: Callable[[MigrationJob], bool] | None = None,
         workload_unavailable_fn: Callable[[str], int] | None = None,
+        controller_finder: ControllerFinder | None = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.limits = limits or ArbitrationLimits()
         self.reserve_fn = reserve_fn
         self.evict_fn = evict_fn
         self.workload_unavailable_fn = workload_unavailable_fn
+        self.controller_finder = controller_finder
         self.clock = clock
         self.jobs: dict[str, MigrationJob] = {}
+
+    def _workload_budgets(self, ref: str) -> tuple[int, int, int]:
+        """(max_migrating, max_unavailable, already_unavailable) for the
+        owning workload — replica-scaled when the controllerfinder knows it
+        (filter.go:409 filterMaxMigratingOrUnavailablePerWorkload), flat
+        config values otherwise."""
+        lim = self.limits
+        workload = (self.controller_finder.get(ref)
+                    if self.controller_finder else None)
+        if workload is not None:
+            replicas = workload.expected_replicas
+            max_migrating = get_max_migrating(
+                replicas, lim.max_migrating_per_workload)
+            spec = (workload.max_unavailable
+                    if workload.max_unavailable is not None
+                    else lim.max_unavailable_per_workload)
+            max_unavailable = get_max_unavailable(replicas, spec)
+            unavailable = workload.unavailable
+        else:
+            def flat(spec, default=2):
+                return spec if isinstance(spec, int) and spec > 0 else default
+            max_migrating = flat(lim.max_migrating_per_workload)
+            max_unavailable = flat(lim.max_unavailable_per_workload)
+            unavailable = 0
+        if self.workload_unavailable_fn is not None:
+            unavailable = self.workload_unavailable_fn(ref)
+        return max_migrating, max_unavailable, unavailable
 
     # -- API ---------------------------------------------------------------
 
@@ -123,13 +215,15 @@ class MigrationController:
             if ns[job.namespace] >= lim.max_migrating_per_namespace:
                 continue
             if job.workload:
-                if workload[job.workload] >= lim.max_migrating_per_workload:
+                max_migrating, max_unavailable, already_unavailable = (
+                    self._workload_budgets(job.workload))
+                if workload[job.workload] >= max_migrating:
                     continue
-                if self.workload_unavailable_fn is not None:
-                    unavailable = (self.workload_unavailable_fn(job.workload)
-                                   + workload[job.workload])
-                    if unavailable >= lim.max_unavailable_per_workload:
-                        continue
+                # migrating pods count as unavailable (filter.go:484
+                # mergeUnavailableAndMigratingPods)
+                if (already_unavailable + workload[job.workload]
+                        >= max_unavailable):
+                    continue
             allowed.append(job)
             node[job.node] += 1
             ns[job.namespace] += 1

@@ -82,6 +82,68 @@ class LowNodeLoadPlugin:
         return evicted
 
 
+class FragmentationAwarePlugin:
+    """Balance plugin (plugins/fragmentationaware): evict the pods whose
+    removal most reduces per-node resource-fraction stddev. Scoring and
+    greedy selection run on-device (fragmentationaware kernels).
+
+    ``state_fn`` returns (requested(N,R), allocatable(N,R), node_valid(N,),
+    node_names[N]); ``pod_requests_fn(pod)`` a (R,) milli-unit vector.
+    """
+
+    name = "FragmentationAware"
+
+    def __init__(
+        self,
+        state_fn: Callable[[], tuple[np.ndarray, np.ndarray, np.ndarray, list[str]]],
+        pod_requests_fn: Callable[[PodInfo], np.ndarray],
+        resource_mask: Optional[np.ndarray] = None,
+        imbalance_threshold: float = 0.2,
+        min_gain: float = 0.05,
+        max_victims: int = 16,
+    ):
+        self.state_fn = state_fn
+        self.pod_requests_fn = pod_requests_fn
+        self.resource_mask = resource_mask
+        self.imbalance_threshold = imbalance_threshold
+        self.min_gain = min_gain
+        self.max_victims = max_victims
+
+    def balance(self, handle: Handle) -> int:
+        from koordinator_tpu.descheduler import fragmentationaware as frag
+        from koordinator_tpu.descheduler.framework import _ProfileHandle
+
+        requested, allocatable, node_valid, node_names = self.state_fn()
+        node_index = {name: i for i, name in enumerate(node_names)}
+        pods = [p for p in handle.pods() if p.node in node_index]
+        if not pods:
+            return 0
+        pod_node = np.asarray([node_index[p.node] for p in pods], np.int32)
+        pod_requests = np.stack([self.pod_requests_fn(p) for p in pods])
+        if isinstance(handle, _ProfileHandle):
+            evictable = np.asarray(
+                [handle.profile.evictor_filter.filter(p)[0] for p in pods]
+            )
+        else:
+            evictable = np.ones(len(pods), bool)
+        mask = (jnp.asarray(self.resource_mask)
+                if self.resource_mask is not None
+                else frag.default_resource_mask())
+
+        victims = np.asarray(frag.select_victims(
+            jnp.asarray(requested), jnp.asarray(allocatable),
+            jnp.asarray(node_valid), jnp.asarray(pod_node),
+            jnp.asarray(pod_requests), jnp.asarray(evictable), mask,
+            imbalance_threshold=self.imbalance_threshold,
+            min_gain=self.min_gain, max_victims=self.max_victims,
+        ))
+        evicted = 0
+        for pod, is_victim in zip(pods, victims):
+            if is_victim and handle.evict(pod, "FragmentationAware"):
+                evicted += 1
+        return evicted
+
+
 class CustomPriorityPlugin:
     """Deschedule plugin (plugins/custompriority): evict pods below a
     priority floor from matching nodes (cleanup of stale low-priority work)."""

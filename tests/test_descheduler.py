@@ -222,3 +222,61 @@ def test_migration_timeout():
     ctl.reconcile()
     assert ctl.jobs["j1"].phase is MigrationJobPhase.FAILED
     assert ctl.jobs["j1"].reason == "Timeout"
+
+
+# ---- controllerfinder: workload-derived budgets (migration/util/util.go:81,
+# arbitrator/filter.go:409) --------------------------------------------------
+
+def test_get_max_unavailable_defaults_and_scaling():
+    from koordinator_tpu.descheduler.migration import get_max_unavailable
+
+    # replica-count-dependent defaults when unspecified
+    assert get_max_unavailable(1, None) == 1
+    assert get_max_unavailable(3, None) == 1
+    assert get_max_unavailable(4, None) == 2
+    assert get_max_unavailable(10, None) == 2
+    assert get_max_unavailable(50, None) == 5      # 10%
+    # explicit int and percent specs (round-down, 0 floors to 1)
+    assert get_max_unavailable(20, 3) == 3
+    assert get_max_unavailable(20, "25%") == 5
+    assert get_max_unavailable(5, "10%") == 1       # 0.5 -> 0 -> floor 1
+    # capped at replicas
+    assert get_max_unavailable(2, 10) == 2
+
+
+def test_migration_workload_derived_budgets():
+    from koordinator_tpu.descheduler.migration import (
+        ControllerFinder, Workload)
+
+    finder = ControllerFinder()
+    # 20-replica deployment declaring maxUnavailable 10% -> budget 2
+    finder.register(Workload(ref="Deployment/web", expected_replicas=20,
+                             max_unavailable="10%", unavailable=1))
+    ctl = MigrationController(
+        controller_finder=finder,
+        evict_fn=lambda j: False,  # keep jobs running to occupy budget
+    )
+    for i in range(3):
+        ctl.submit(MigrationJob(name=f"j{i}", pod=f"p{i}", node=f"n{i}",
+                                workload="Deployment/web", create_time=i))
+    ctl.reconcile()
+    phases = [ctl.jobs[f"j{i}"].phase for i in range(3)]
+    # budget 2, one pod already unavailable -> only one migration admitted
+    assert phases == [MigrationJobPhase.RUNNING, MigrationJobPhase.PENDING,
+                      MigrationJobPhase.PENDING]
+
+
+def test_migration_unknown_workload_uses_flat_limits():
+    from koordinator_tpu.descheduler.migration import ControllerFinder
+
+    ctl = MigrationController(
+        controller_finder=ControllerFinder(),   # knows nothing
+        evict_fn=lambda j: False,
+    )
+    for i in range(3):
+        ctl.submit(MigrationJob(name=f"j{i}", pod=f"p{i}", node=f"n{i}",
+                                workload="Deployment/mystery", create_time=i))
+    ctl.reconcile()
+    running = sum(j.phase is MigrationJobPhase.RUNNING
+                  for j in ctl.jobs.values())
+    assert running == 2   # flat default budget

@@ -1,0 +1,152 @@
+"""FragmentationAware kernels vs the reference's scoring_test.go scenarios
+(pkg/descheduler/framework/plugins/fragmentationaware/scoring_test.go)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+from koordinator_tpu.descheduler.fragmentationaware import (
+    default_resource_mask,
+    node_imbalance,
+    removal_gains,
+    select_victims,
+)
+from koordinator_tpu.descheduler.framework import (
+    Descheduler,
+    EvictorFilter,
+    Evictor,
+    PodInfo,
+    Profile,
+)
+from koordinator_tpu.descheduler.plugins import FragmentationAwarePlugin
+
+R = NUM_RESOURCE_DIMS
+CPU, MEM, GPU = ResourceDim.CPU, ResourceDim.MEMORY, ResourceDim.GPU
+
+
+def node(cpu, mem, gpu=0):
+    a = np.zeros((1, R), np.int32)
+    a[0, CPU], a[0, MEM], a[0, GPU] = cpu, mem, gpu
+    return a
+
+
+def req(cpu, mem, gpu=0):
+    r = np.zeros((1, R), np.int32)
+    r[0, CPU], r[0, MEM], r[0, GPU] = cpu, mem, gpu
+    return r
+
+
+def imb(requested, allocatable, mask=None):
+    mask = default_resource_mask() if mask is None else mask
+    return float(node_imbalance(
+        jnp.asarray(requested), jnp.asarray(allocatable), mask)[0])
+
+
+def test_no_scored_resources_returns_zero():
+    # scoring_test.go "no scored resources returns zero"
+    mask = jnp.zeros(R, bool)
+    assert imb(req(500, 512), node(1000, 1024), mask) == 0.0
+
+
+def test_balanced_node_low_stddev():
+    # "balanced CPU/memory node gives low stddev": 500/1000 vs 512/1024
+    assert imb(req(500, 512), node(1000, 1024)) < 0.01
+
+
+def test_cpu_heavy_node_high_stddev():
+    # "CPU-heavy node gives high stddev": 900/1000 vs 100/1024
+    assert imb(req(900, 100), node(1000, 1024)) > 0.1
+
+
+def test_zero_allocatable_dim_skipped():
+    # "zero allocatable resource is skipped": only CPU counts, 1-elem var = 0
+    assert imb(req(500, 512), node(1000, 0)) == 0.0
+
+
+def test_custom_resource_exact():
+    # "custom resource works if configured": GPU 1/2, CPU 0/1000
+    # mean 0.25, population std = 0.25
+    mask = jnp.zeros(R, bool).at[CPU].set(True).at[GPU].set(True)
+    assert abs(imb(req(0, 0, gpu=1), node(1000, 1024, gpu=2), mask) - 0.25) < 1e-6
+
+
+def test_removal_gain_positive_for_imbalanced_pod():
+    # TestScorePodRemovalGain "removing CPU-heavy pod improves stddev"
+    alloc = node(1000, 1024)
+    requested = req(900, 200)  # cpu-heavy(800,100) + balanced(100,100)
+    pod_requests = np.concatenate([req(800, 100), req(100, 100)])
+    gains = np.asarray(removal_gains(
+        jnp.asarray(requested), jnp.asarray(alloc),
+        jnp.asarray([0, 0], np.int32), jnp.asarray(pod_requests),
+        default_resource_mask()))
+    assert gains[0] > 0
+
+
+def test_removal_gain_negative_for_balancing_pod():
+    # "removing wrong pod gives low/negative gain": podA(200,800)+podB(600,100)
+    alloc = node(1000, 1024)
+    requested = req(800, 900)
+    pod_requests = np.concatenate([req(200, 800), req(600, 100)])
+    gains = np.asarray(removal_gains(
+        jnp.asarray(requested), jnp.asarray(alloc),
+        jnp.asarray([0, 0], np.int32), jnp.asarray(pod_requests),
+        default_resource_mask()))
+    assert gains[1] < 0
+
+
+def test_unbound_pod_gain_zero():
+    gains = np.asarray(removal_gains(
+        jnp.asarray(req(500, 500)), jnp.asarray(node(1000, 1000)),
+        jnp.asarray([-1], np.int32), jnp.asarray(req(100, 100)),
+        default_resource_mask()))
+    assert gains[0] == 0.0
+
+
+def test_select_victims_greedy_updates_node_state():
+    # Node skewed by two cpu-heavy pods; after evicting one the node is
+    # balanced enough that the second is NOT taken.
+    alloc = node(1000, 1000)
+    requested = req(900, 300)
+    pod_requests = np.concatenate([req(350, 50), req(350, 50), req(200, 200)])
+    victims = np.asarray(select_victims(
+        jnp.asarray(requested), jnp.asarray(alloc),
+        jnp.ones(1, bool), jnp.asarray([0, 0, 0], np.int32),
+        jnp.asarray(pod_requests), jnp.ones(3, bool),
+        default_resource_mask(),
+        imbalance_threshold=0.2, min_gain=0.05))
+    # first cpu-heavy pod taken (imbalance 0.3 -> ~0.1); after that the
+    # node imbalance falls below the 0.2 threshold so nothing else goes
+    assert victims.tolist() == [True, False, False]
+
+
+def test_select_victims_respects_evictable_and_cap():
+    alloc = node(1000, 1000)
+    requested = req(950, 100)
+    pod_requests = np.concatenate([req(450, 50), req(450, 50)])
+    victims = np.asarray(select_victims(
+        jnp.asarray(requested), jnp.asarray(alloc),
+        jnp.ones(1, bool), jnp.asarray([0, 0], np.int32),
+        jnp.asarray(pod_requests), jnp.asarray([False, True]),
+        default_resource_mask(), max_victims=1))
+    assert victims.tolist() == [False, True]
+
+
+def test_plugin_end_to_end():
+    names = ["n0", "n1"]
+    allocatable = np.concatenate([node(1000, 1000), node(1000, 1000)])
+    requested = np.concatenate([req(900, 100), req(400, 400)])
+    pods = [
+        PodInfo(uid="skew", name="skew", namespace="d", node="n0"),
+        PodInfo(uid="ok", name="ok", namespace="d", node="n1"),
+    ]
+    reqs = {"skew": req(800, 50)[0], "ok": req(100, 100)[0]}
+    plugin = FragmentationAwarePlugin(
+        state_fn=lambda: (requested, allocatable, np.ones(2, bool), names),
+        pod_requests_fn=lambda p: reqs[p.uid],
+    )
+    profile = Profile(name="frag", balance_plugins=[plugin],
+                      evictor_filter=EvictorFilter(), evictor=Evictor())
+    d = Descheduler([profile], pods_fn=lambda: pods, interval_seconds=0)
+    out = d.run_once()
+    assert out["frag"] == 1
+    assert profile.evictor.evicted == [("skew", "FragmentationAware")]

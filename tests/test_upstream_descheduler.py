@@ -1,0 +1,185 @@
+"""Upstream-port descheduler plugins (descheduler/upstream.py) vs the
+sigs.k8s.io/descheduler semantics the reference registers
+(pkg/descheduler/framework/plugins/kubernetes/plugin.go:60-132)."""
+
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+from koordinator_tpu.descheduler.framework import (
+    Descheduler,
+    Evictor,
+    EvictorFilter,
+    PodInfo,
+    Profile,
+)
+from koordinator_tpu.descheduler.upstream import (
+    HighNodeUtilization,
+    NodeInfo,
+    PodLifeTime,
+    RemoveDuplicates,
+    RemoveFailedPods,
+    RemovePodsHavingTooManyRestarts,
+    RemovePodsViolatingInterPodAntiAffinity,
+    RemovePodsViolatingNodeAffinity,
+    RemovePodsViolatingNodeTaints,
+    RemovePodsViolatingTopologySpreadConstraint,
+    pod_fits_node_affinity,
+    tolerates,
+)
+
+R = NUM_RESOURCE_DIMS
+CPU, MEM = ResourceDim.CPU, ResourceDim.MEMORY
+
+
+def run(plugins, pods, balance=False):
+    profile = Profile(
+        name="t",
+        deschedule_plugins=[] if balance else plugins,
+        balance_plugins=plugins if balance else [],
+        evictor_filter=EvictorFilter(),
+        evictor=Evictor(),
+    )
+    d = Descheduler([profile], pods_fn=lambda: pods, interval_seconds=0)
+    d.run_once()
+    return [uid for uid, _ in profile.evictor.evicted]
+
+
+def test_pod_lifetime():
+    pods = [
+        PodInfo(uid="old", name="o", namespace="d", node="n0", created=0.0),
+        PodInfo(uid="new", name="n", namespace="d", node="n0", created=900.0),
+        PodInfo(uid="done", name="s", namespace="d", node="n0", created=0.0,
+                phase="Succeeded"),
+    ]
+    plugin = PodLifeTime(max_seconds=600, states=["Running"],
+                         clock=lambda: 1000.0)
+    assert run([plugin], pods) == ["old"]
+
+
+def test_remove_failed_pods_reasons_and_lifetime():
+    pods = [
+        PodInfo(uid="oom", name="a", namespace="d", node="n0", phase="Failed",
+                reason="OOMKilled", created=0.0),
+        PodInfo(uid="young", name="b", namespace="d", node="n0",
+                phase="Failed", reason="OOMKilled", created=990.0),
+        PodInfo(uid="other", name="c", namespace="d", node="n0",
+                phase="Failed", reason="Evicted", created=0.0),
+        PodInfo(uid="live", name="d", namespace="d", node="n0",
+                phase="Running", created=0.0),
+    ]
+    plugin = RemoveFailedPods(reasons=["OOMKilled"],
+                              min_pod_lifetime_seconds=60,
+                              clock=lambda: 1000.0)
+    assert run([plugin], pods) == ["oom"]
+
+
+def test_too_many_restarts():
+    pods = [
+        PodInfo(uid="flappy", name="a", namespace="d", node="n0",
+                restart_count=12),
+        PodInfo(uid="stable", name="b", namespace="d", node="n0",
+                restart_count=1),
+    ]
+    assert run([RemovePodsHavingTooManyRestarts(10)], pods) == ["flappy"]
+
+
+def test_remove_duplicates_keeps_oldest_per_node():
+    pods = [
+        PodInfo(uid="a1", name="a1", namespace="d", node="n0",
+                owner="ReplicaSet/web", images=("img",), created=1.0),
+        PodInfo(uid="a2", name="a2", namespace="d", node="n0",
+                owner="ReplicaSet/web", images=("img",), created=2.0),
+        PodInfo(uid="a3", name="a3", namespace="d", node="n1",
+                owner="ReplicaSet/web", images=("img",), created=3.0),
+        PodInfo(uid="ds", name="ds", namespace="d", node="n0",
+                owner="DaemonSet/logs", images=("img",), created=0.0),
+    ]
+    plugin = RemoveDuplicates(exclude_owner_kinds=["DaemonSet"])
+    assert run([plugin], pods, balance=True) == ["a2"]
+
+
+def test_node_affinity_matching_and_plugin():
+    node_gpu = NodeInfo("gpu", labels={"pool": "gpu"})
+    node_cpu = NodeInfo("cpu", labels={"pool": "cpu"})
+    pod = PodInfo(uid="p", name="p", namespace="d", node="cpu",
+                  required_affinity=((("pool", "In", ("gpu",)),),))
+    assert pod_fits_node_affinity(pod, node_gpu)
+    assert not pod_fits_node_affinity(pod, node_cpu)
+    plugin = RemovePodsViolatingNodeAffinity(
+        nodes_fn=lambda: [node_gpu, node_cpu])
+    ok = PodInfo(uid="ok", name="ok", namespace="d", node="gpu",
+                 required_affinity=((("pool", "In", ("gpu",)),),))
+    assert run([plugin], [pod, ok]) == ["p"]
+
+
+def test_node_taints_and_tolerations():
+    taint = ("dedicated", "ml", "NoSchedule")
+    assert tolerates(
+        PodInfo(uid="x", name="x", namespace="d", node="n",
+                tolerations=(("dedicated", "Equal", "ml", "NoSchedule"),)),
+        taint)
+    assert tolerates(
+        PodInfo(uid="x", name="x", namespace="d", node="n",
+                tolerations=(("", "Exists", "", ""),)),
+        taint)
+    nodes = [NodeInfo("n0", taints=(taint,)), NodeInfo("n1")]
+    pods = [
+        PodInfo(uid="intoler", name="a", namespace="d", node="n0"),
+        PodInfo(uid="toler", name="b", namespace="d", node="n0",
+                tolerations=(("dedicated", "Exists", "", "NoSchedule"),)),
+        PodInfo(uid="elsewhere", name="c", namespace="d", node="n1"),
+    ]
+    plugin = RemovePodsViolatingNodeTaints(nodes_fn=lambda: nodes)
+    assert run([plugin], pods) == ["intoler"]
+
+
+def test_inter_pod_anti_affinity():
+    pods = [
+        PodInfo(uid="guard", name="g", namespace="d", node="n0",
+                labels={"app": "guard"},
+                anti_affinity=(({"app": "noisy"}, "hostname"),)),
+        PodInfo(uid="noisy", name="n", namespace="d", node="n0",
+                labels={"app": "noisy"}),
+        PodInfo(uid="far", name="f", namespace="d", node="n1",
+                labels={"app": "noisy"}),
+    ]
+    plugin = RemovePodsViolatingInterPodAntiAffinity()
+    assert run([plugin], pods) == ["noisy"]
+
+
+def test_topology_spread_constraint():
+    nodes = [NodeInfo("n0", labels={"zone": "a"}),
+             NodeInfo("n1", labels={"zone": "b"})]
+    constraint = (("zone", 1, {"app": "web"}),)
+    pods = (
+        [PodInfo(uid=f"a{i}", name=f"a{i}", namespace="d", node="n0",
+                 labels={"app": "web"}, spread_constraints=constraint,
+                 created=float(i)) for i in range(4)]
+        + [PodInfo(uid="b0", name="b0", namespace="d", node="n1",
+                   labels={"app": "web"}, spread_constraints=constraint,
+                   created=0.0)]
+    )
+    plugin = RemovePodsViolatingTopologySpreadConstraint(
+        nodes_fn=lambda: nodes)
+    # zone a has 4, zone b has 1, maxSkew 1 -> shed 2 newest from zone a
+    assert sorted(run([plugin], pods, balance=True)) == ["a2", "a3"]
+
+
+def test_high_node_utilization_compacts_cold_nodes():
+    alloc = np.zeros((2, R), np.int32)
+    alloc[:, CPU], alloc[:, MEM] = 10_000, 100_000
+    requested = np.zeros_like(alloc)
+    requested[0, CPU], requested[0, MEM] = 1_000, 5_000     # 10% / 5%
+    requested[1, CPU], requested[1, MEM] = 8_000, 70_000    # 80% / 70%
+    thresholds = np.full(R, -1, np.int32)
+    thresholds[CPU], thresholds[MEM] = 20, 20
+    plugin = HighNodeUtilization(
+        state_fn=lambda: (requested, alloc, np.ones(2, bool), ["n0", "n1"]),
+        thresholds=thresholds,
+    )
+    assert plugin.underutilized_nodes() == ["n0"]
+    pods = [
+        PodInfo(uid="cold", name="a", namespace="d", node="n0"),
+        PodInfo(uid="hot", name="b", namespace="d", node="n1"),
+    ]
+    assert run([plugin], pods, balance=True) == ["cold"]
