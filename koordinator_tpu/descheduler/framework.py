@@ -214,11 +214,15 @@ class Descheduler:
 
     def __init__(self, profiles: list[Profile],
                  pods_fn: Callable[[], list[PodInfo]],
-                 interval_seconds: float = 120.0, clock=time.time):
+                 interval_seconds: float = 120.0, clock=time.time,
+                 elector=None):
         self.profiles = profiles
         self.pods_fn = pods_fn
         self.interval_seconds = interval_seconds
         self.clock = clock
+        #: optional ha.LeaderElector — the reference leader-elects the
+        #: descheduler binary; a non-leader replica ticks but never evicts
+        self.elector = elector
         self._last_run = 0.0
 
     def run_once(self) -> dict[str, int]:
@@ -234,6 +238,8 @@ class Descheduler:
         return out
 
     def tick(self) -> Optional[dict[str, int]]:
+        if self.elector is not None and not self.elector.tick():
+            return None
         now = self.clock()
         if now - self._last_run < self.interval_seconds:
             return None

@@ -1,0 +1,174 @@
+"""ScheduleExplanation persistence + workload audit trail.
+
+The reference turns per-cycle Diagnosis state into durable artifacts two
+ways: an async diagnosis dump queue that renders ScheduleExplanation CRs
+(``frameworkext/schedule_diagnosis.go:44-108`` — DumpDiagnosis enqueues to
+``diagnosisQueue`` with worker fan-out, blocking mode for tests), and the
+workload auditor ring that records every scheduling attempt per pod/gang
+(``frameworkext/workloadauditor/workload_auditor.go``). Here the queue
+feeds an :class:`ExplanationStore` (the CR registry stand-in) and
+:class:`WorkloadAuditor` keeps bounded per-workload event rings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Optional
+
+from koordinator_tpu.api.crds import ScheduleExplanation
+from koordinator_tpu.scheduler.diagnosis import PodDiagnosis
+
+
+class ExplanationStore:
+    """Persists diagnosis results as ScheduleExplanation objects.
+
+    ``blocking=False`` mirrors the reference's default async dump: record()
+    enqueues and a drain (the worker) writes CRs; ``blocking=True`` writes
+    through immediately (dumpDiagnosisBlocking). Capacity-bounded both in
+    queue depth (diagnosisQueueSize=1000) and retained CRs.
+    """
+
+    def __init__(self, capacity: int = 1024, queue_size: int = 1000,
+                 blocking: bool = False, clock=time.time):
+        self.capacity = capacity
+        self.queue_size = queue_size
+        self.blocking = blocking
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._queue: deque[ScheduleExplanation] = deque()
+        self._store: OrderedDict[str, ScheduleExplanation] = OrderedDict()
+        self.dropped = 0
+
+    # -- producer side (scheduler Diagnose phase) ---------------------------
+
+    def record(self, pod_name: str, diagnosis: PodDiagnosis,
+               namespace: str = "default", uid: str = "") -> None:
+        offers = {}
+        if diagnosis.preempt_node is not None:
+            offers[diagnosis.preempt_node] = (
+                "fits after preempting ["
+                + ", ".join(diagnosis.preempt_victims) + "]")
+        explanation = ScheduleExplanation(
+            pod_uid=uid or pod_name,
+            pod_namespace=namespace,
+            pod_name=pod_name,
+            reasons=(diagnosis.message(),),
+            node_offers=offers,
+            update_time=self.clock(),
+        )
+        with self._lock:
+            if self.blocking:
+                self._write(explanation)
+                return
+            if len(self._queue) >= self.queue_size:
+                self.dropped += 1  # queue full: drop, never block scheduling
+                return
+            self._queue.append(explanation)
+
+    def delete(self, pod_name: str) -> None:
+        """Pod scheduled (or removed): its explanation is stale."""
+        with self._lock:
+            self._store.pop(pod_name, None)
+
+    # -- worker side --------------------------------------------------------
+
+    def drain(self, max_items: int | None = None) -> int:
+        """Apply queued explanations to the store (the async worker)."""
+        n = 0
+        with self._lock:
+            while self._queue and (max_items is None or n < max_items):
+                self._write(self._queue.popleft())
+                n += 1
+        return n
+
+    def _write(self, explanation: ScheduleExplanation) -> None:
+        self._store.pop(explanation.pod_name, None)
+        self._store[explanation.pod_name] = explanation
+        while len(self._store) > self.capacity:
+            self._store.popitem(last=False)
+
+    # -- query side ---------------------------------------------------------
+
+    def get(self, pod_name: str) -> Optional[ScheduleExplanation]:
+        with self._lock:
+            return self._store.get(pod_name)
+
+    def list(self) -> list[ScheduleExplanation]:
+        with self._lock:
+            return list(self._store.values())
+
+
+# ---- workload auditor ------------------------------------------------------
+
+RECORD_SCHEDULE_FAILED = "ScheduleFailed"
+RECORD_SCHEDULE_SUCCESS = "ScheduleSuccess"
+RECORD_GATED = "Gated"
+RECORD_ATTEMPT = "Attempt"
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditEvent:
+    timestamp: float
+    record_type: str
+    message: str = ""
+
+
+class WorkloadAuditor:
+    """Bounded per-workload (pod or gang group) scheduling-lifecycle rings
+    (workloadauditor.workloadAuditorImpl: per-record locking, attempts
+    counter, gating transitions)."""
+
+    def __init__(self, enabled: bool = True, ring_size: int = 32,
+                 clock=time.time):
+        self.enabled = enabled
+        self.ring_size = ring_size
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._records: dict[str, deque[AuditEvent]] = {}
+        self._attempts: dict[str, int] = {}
+        self._gated: dict[str, bool] = {}
+
+    def _ring(self, key: str) -> deque[AuditEvent]:
+        return self._records.setdefault(key, deque(maxlen=self.ring_size))
+
+    def record(self, key: str, record_type: str, message: str = "") -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ring(key).append(
+                AuditEvent(self.clock(), record_type, message))
+
+    def record_attempt(self, key: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._attempts[key] = self._attempts.get(key, 0) + 1
+            self._ring(key).append(AuditEvent(self.clock(), RECORD_ATTEMPT))
+
+    def record_gating(self, key: str, gated: bool) -> None:
+        """Only gating *transitions* are recorded (RecordPodGating)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._gated.get(key) == gated:
+                return
+            self._gated[key] = gated
+            self._ring(key).append(AuditEvent(
+                self.clock(), RECORD_GATED, "gated" if gated else "ungated"))
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._records.pop(key, None)
+            self._attempts.pop(key, None)
+            self._gated.pop(key, None)
+
+    def attempts(self, key: str) -> int:
+        with self._lock:
+            return self._attempts.get(key, 0)
+
+    def events(self, key: str) -> list[AuditEvent]:
+        with self._lock:
+            return list(self._records.get(key, ()))

@@ -115,6 +115,8 @@ class Scheduler:
         hints=None,
         enable_preemption: bool | None = None,
         preempt_fn=None,
+        explanations=None,
+        auditor=None,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -132,6 +134,11 @@ class Scheduler:
         self.debug_service = debug_service
         #: scheduling hints (hints.SchedulingHints) — mask edits per pod
         self.hints = hints
+        #: explanation.ExplanationStore — failures persist as
+        #: ScheduleExplanation CRs (schedule_diagnosis.go DumpDiagnosis)
+        self.explanations = explanations
+        #: explanation.WorkloadAuditor — per-pod/gang lifecycle records
+        self.auditor = auditor
         self.last_result = SchedulingResult({}, {}, 0)
         self.pending: dict[str, PodSpec] = {}
         self.gangs: dict[str, GangRecord] = {}
@@ -428,6 +435,9 @@ class Scheduler:
                 )
                 if pod.gang:
                     failed_gangs.add(pod.gang)
+            if self.auditor is not None:
+                for pod in pods:
+                    self.auditor.record_attempt(pod.gang or pod.name)
 
             # gang WaitTime state machine (Permit timeout semantics)
             for name in failed_gangs - placed_gangs:
@@ -446,6 +456,23 @@ class Scheduler:
         if self.enable_preemption and result.failures:
             with self.monitor.phase("PostFilter"):
                 self._run_preemption(pods, batch, result)
+
+        if self.explanations is not None:
+            # persist AFTER PostFilter so nominations land on the CR; a
+            # successful bind clears any stale explanation
+            for pod in pods:
+                diag = result.failures.get(pod.name)
+                if diag is not None:
+                    self.explanations.record(pod.name, diag)
+                    if self.auditor is not None:
+                        self.auditor.record(pod.gang or pod.name,
+                                            "ScheduleFailed", diag.message())
+                elif pod.name in result.assignments:
+                    self.explanations.delete(pod.name)
+                    if self.auditor is not None:
+                        self.auditor.record(
+                            pod.gang or pod.name, "ScheduleSuccess",
+                            result.assignments[pod.name])
 
         return result
 

@@ -1,0 +1,123 @@
+"""ScheduleExplanation persistence + workload auditor
+(scheduler/explanation.py) vs frameworkext/schedule_diagnosis.go:44-108 and
+frameworkext/workloadauditor/workload_auditor.go."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, resource_vector
+from koordinator_tpu.ops.assignment import ScoringConfig
+from koordinator_tpu.scheduler import ClusterSnapshot, NodeSpec, PodSpec, Scheduler
+from koordinator_tpu.scheduler.diagnosis import PodDiagnosis
+from koordinator_tpu.scheduler.explanation import (
+    ExplanationStore,
+    WorkloadAuditor,
+)
+
+R = NUM_RESOURCE_DIMS
+
+
+def diag(**kw):
+    defaults = dict(total_nodes=4, feasible_nodes=0,
+                    insufficient_resources=4, usage_over_threshold=0,
+                    affinity_mismatch=0, quota_rejected=False, invalid=0)
+    defaults.update(kw)
+    return PodDiagnosis(**defaults)
+
+
+def test_async_record_drain_and_delete():
+    store = ExplanationStore(clock=lambda: 42.0)
+    store.record("p1", diag())
+    assert store.get("p1") is None          # queued, not yet written
+    assert store.drain() == 1
+    exp = store.get("p1")
+    assert exp.pod_name == "p1" and exp.update_time == 42.0
+    assert "4 insufficient resources" in exp.reasons[0]
+    store.delete("p1")
+    assert store.get("p1") is None
+
+
+def test_blocking_mode_writes_through():
+    store = ExplanationStore(blocking=True)
+    store.record("p1", diag())
+    assert store.get("p1") is not None
+
+
+def test_queue_bound_drops_instead_of_blocking():
+    store = ExplanationStore(queue_size=2)
+    for i in range(5):
+        store.record(f"p{i}", diag())
+    assert store.dropped == 3
+    assert store.drain() == 2
+
+
+def test_capacity_evicts_oldest():
+    store = ExplanationStore(capacity=2, blocking=True)
+    for i in range(3):
+        store.record(f"p{i}", diag())
+    assert store.get("p0") is None
+    assert store.get("p1") is not None and store.get("p2") is not None
+
+
+def test_preemption_nomination_lands_on_cr():
+    store = ExplanationStore(blocking=True)
+    store.record("p1", diag(preempt_node="n3", preempt_victims=["v1", "v2"]))
+    exp = store.get("p1")
+    assert "n3" in exp.node_offers
+    assert "preempting [v1, v2]" in exp.node_offers["n3"]
+
+
+def test_auditor_rings_and_transitions():
+    t = [0.0]
+    a = WorkloadAuditor(ring_size=4, clock=lambda: t[0])
+    a.record_attempt("gang-a")
+    a.record_attempt("gang-a")
+    assert a.attempts("gang-a") == 2
+    a.record_gating("p", True)
+    a.record_gating("p", True)    # no transition -> no event
+    a.record_gating("p", False)
+    assert [e.record_type for e in a.events("p")] == ["Gated", "Gated"]
+    assert [e.message for e in a.events("p")] == ["gated", "ungated"]
+    for i in range(10):
+        a.record("gang-a", "ScheduleFailed", f"m{i}")
+    assert len(a.events("gang-a")) == 4   # ring bound
+    a.delete("gang-a")
+    assert a.attempts("gang-a") == 0 and a.events("gang-a") == []
+
+
+def test_disabled_auditor_records_nothing():
+    a = WorkloadAuditor(enabled=False)
+    a.record_attempt("x")
+    a.record("x", "ScheduleFailed")
+    assert a.attempts("x") == 0 and a.events("x") == []
+
+
+def test_scheduler_persists_and_clears_explanations():
+    snap = ClusterSnapshot(capacity=16)
+    snap.upsert_node(NodeSpec(
+        name="n1", allocatable=resource_vector(cpu=4_000, memory=8_192),
+        usage=np.zeros(R, np.int32)))
+    cfg = ScoringConfig.default().replace(
+        usage_thresholds=jnp.zeros(R, jnp.int32),
+        estimator_defaults=jnp.zeros(R, jnp.int32))
+    store = ExplanationStore(blocking=True)
+    auditor = WorkloadAuditor()
+    sched = Scheduler(snap, config=cfg, explanations=store, auditor=auditor)
+
+    sched.enqueue(PodSpec(name="big",
+                          requests=resource_vector(cpu=99_000, memory=1_024)))
+    res = sched.schedule_round()
+    assert "big" in res.failures
+    exp = store.get("big")
+    assert exp is not None and "available" in exp.reasons[0]
+    assert auditor.attempts("big") == 1
+    assert auditor.events("big")[-1].record_type == "ScheduleFailed"
+
+    # shrink the pod and reschedule: explanation clears, success recorded
+    sched.pending.pop("big")
+    sched.enqueue(PodSpec(name="big",
+                          requests=resource_vector(cpu=1_000, memory=1_024)))
+    res = sched.schedule_round()
+    assert res.assignments == {"big": "n1"}
+    assert store.get("big") is None
+    assert auditor.events("big")[-1].record_type == "ScheduleSuccess"

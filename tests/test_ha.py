@@ -1,0 +1,170 @@
+"""Leader election / HA (koordinator_tpu/ha.py) vs the reference's
+Lease-based election (cmd/koord-manager/main.go --enable-leader-election;
+same mechanism for scheduler and descheduler), plus the failover-restart
+story: new leader rebuilds state through the startup sync barrier
+(cmd/koord-scheduler/app/sync_barrier.go)."""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, resource_vector
+from koordinator_tpu.descheduler.framework import Descheduler, Profile
+from koordinator_tpu.ha import (
+    InMemoryLeaseStore,
+    LeaderElector,
+    LeaseRecord,
+    leader_gated,
+)
+from koordinator_tpu.ops.assignment import ScoringConfig
+from koordinator_tpu.scheduler import ClusterSnapshot, NodeSpec, PodSpec, Scheduler
+from koordinator_tpu.scheduler.barrier import SyncBarrier
+
+R = NUM_RESOURCE_DIMS
+
+
+def electors(n, store=None, clock=None, **kw):
+    store = store or InMemoryLeaseStore()
+    return store, [
+        LeaderElector(store, "koord-manager", f"replica-{i}",
+                      clock=clock or (lambda: 0.0), **kw)
+        for i in range(n)
+    ]
+
+
+def test_first_candidate_acquires_and_renews():
+    t = [0.0]
+    _, (a, b) = electors(2, clock=lambda: t[0], lease_duration=15)
+    assert a.tick() is True
+    assert b.tick() is False
+    t[0] = 10.0            # inside the lease
+    assert a.tick() is True
+    assert b.tick() is False
+
+
+def test_failover_after_lease_expiry():
+    t = [0.0]
+    events = []
+    store = InMemoryLeaseStore()
+    a = LeaderElector(store, "L", "a", lease_duration=15,
+                      clock=lambda: t[0],
+                      on_stopped_leading=lambda: events.append("a-stop"))
+    b = LeaderElector(store, "L", "b", lease_duration=15,
+                      clock=lambda: t[0],
+                      on_started_leading=lambda: events.append("b-start"),
+                      on_new_leader=lambda who: events.append(f"new:{who}"))
+    assert a.tick() and not b.tick()
+    # leader a stops renewing (crash); b takes over only after expiry
+    t[0] = 10.0
+    assert not b.tick()
+    t[0] = 20.0
+    assert b.tick()
+    assert "b-start" in events and "new:b" in events
+    # stale ex-leader comes back: sees b's live lease, demotes itself
+    assert not a.tick()
+    assert "a-stop" in events
+    lease = store.get("L")
+    assert lease.holder == "b" and lease.transitions == 2
+
+
+def test_release_hands_off_immediately():
+    t = [0.0]
+    _, (a, b) = electors(2, clock=lambda: t[0], lease_duration=1000)
+    assert a.tick()
+    a.release()
+    assert b.tick()          # no need to wait out the 1000s lease
+    assert not a.tick()      # released elector stays stopped
+
+
+def test_cas_update_rejects_stale_holder():
+    store = InMemoryLeaseStore()
+    store.update("L", "", LeaseRecord(holder="x", renew_time=0))
+    assert not store.update("L", "y", LeaseRecord(holder="y"))
+    assert store.get("L").holder == "x"
+
+
+def test_leader_gated_controller_step():
+    t = [0.0]
+    _, (a, b) = electors(2, clock=lambda: t[0])
+    runs = []
+    assert leader_gated(a, lambda: runs.append("a") or 1) == 1
+    assert leader_gated(b, lambda: runs.append("b") or 1) is None
+    assert runs == ["a"]
+    assert leader_gated(None, lambda: 2) == 2  # election disabled
+
+
+def test_descheduler_replica_only_evicts_as_leader():
+    t = [0.0]
+    store, (a, b) = electors(2, clock=lambda: t[0], lease_duration=15)
+    mk = lambda el: Descheduler([Profile(name="p")], pods_fn=lambda: [],
+                                interval_seconds=0, clock=lambda: t[0],
+                                elector=el)
+    d_a, d_b = mk(a), mk(b)
+    assert d_a.tick() == {"p": 0}
+    assert d_b.tick() is None          # follower never runs plugins
+    t[0] = 30.0                         # a's lease expires silently
+    assert d_b.tick() == {"p": 0}      # b took over
+
+
+def test_run_loop_thread_releases_on_stop():
+    store = InMemoryLeaseStore()
+    a = LeaderElector(store, "L", "a", retry_period=0.001)
+    stop = threading.Event()
+    th = threading.Thread(target=a.run, args=(stop,))
+    th.start()
+    for _ in range(1000):
+        if a.is_leader():
+            break
+    assert a.is_leader()
+    stop.set()
+    th.join(timeout=5)
+    assert not th.is_alive()
+    assert store.get("L").holder == ""   # released
+
+
+def test_failover_scheduler_restart_through_sync_barrier():
+    """The HA restart story end to end: the standby wins the lease, builds a
+    FRESH scheduler, and its first rounds no-op until the informer stream
+    replays past the barrier mark — then it schedules correctly from the
+    rebuilt snapshot."""
+    t = [0.0]
+    store = InMemoryLeaseStore()
+    old = LeaderElector(store, "sched", "sched-0", lease_duration=15,
+                        clock=lambda: t[0])
+    assert old.tick()
+    t[0] = 60.0   # sched-0 crashed; lease expired
+    new = LeaderElector(store, "sched", "sched-1", lease_duration=15,
+                        clock=lambda: t[0])
+    assert new.tick()
+
+    # the "apiserver": barrier marks bump its version; the informer lags
+    apiserver = {"version": 7}
+    informer = {"version": 5}
+
+    def mark():
+        apiserver["version"] += 1
+        return apiserver["version"]
+
+    snap = ClusterSnapshot(capacity=16)
+    snap.upsert_node(NodeSpec(
+        name="n1", allocatable=resource_vector(cpu=16_000, memory=65_536),
+        usage=np.zeros(R, np.int32)))
+    binds = []
+    cfg = ScoringConfig.default().replace(
+        usage_thresholds=jnp.zeros(R, jnp.int32),
+        estimator_defaults=jnp.zeros(R, jnp.int32))
+    barrier = SyncBarrier(mark=mark,
+                          observed_version=lambda: informer["version"])
+    barrier.start()
+    sched = Scheduler(snap, config=cfg,
+                      bind_fn=lambda p, n: binds.append((p, n)),
+                      barrier=barrier)
+    sched.enqueue(PodSpec(name="p1",
+                          requests=resource_vector(cpu=1_000, memory=1_024)))
+    res = sched.schedule_round()
+    assert not res.assignments and not binds     # gated: cache still stale
+    informer["version"] = apiserver["version"]   # replay caught up
+    res = sched.schedule_round()
+    assert res.assignments == {"p1": "n1"}
+    assert binds == [("p1", "n1")]
