@@ -109,6 +109,12 @@ class QuotaTree:
         #: scaleMinQuotaEnabled): shrink enable_scale_min children's min
         #: proportionally when a parent's resource drops below the min sum
         self.scale_min_enabled = scale_min_enabled
+        # runtime cache: the reference recomputes runtimeQuota only when
+        # quota specs or requests change (core/group_quota_manager.go keeps
+        # runtime between updates); we fingerprint every input of the
+        # water-filling and skip refresh_runtime when nothing moved
+        self._runtime_key: tuple | None = None
+        self.runtime_refreshes = 0
 
     def add(
         self,
@@ -176,14 +182,34 @@ class QuotaTree:
 
     # -- runtime ------------------------------------------------------------
 
-    def refresh_runtime(self) -> None:
-        """Recompute every node's runtime, top-down."""
+    def _fingerprint(self) -> tuple:
+        """Every input of the runtime computation, cheap to compare."""
+        rows = tuple(
+            (name, n.parent,
+             # parents' request is derived by aggregation — only leaf
+             # requests are true inputs
+             n.request.tobytes() if not self.children[name] else b"",
+             n.min.tobytes(), n.max.tobytes(), n.shared_weight.tobytes(),
+             n.guarantee.tobytes(), n.allow_lent, n.enable_scale_min)
+            for name, n in sorted(self.nodes.items())
+        )
+        return (self.total_resource.tobytes(), self.scale_min_enabled, rows)
+
+    def refresh_runtime(self, force: bool = False) -> bool:
+        """Recompute every node's runtime, top-down. No-ops (returns False)
+        when no spec/request input changed since the last refresh."""
+        key = self._fingerprint()
+        if not force and key == self._runtime_key:
+            return False
         self.aggregate_requests()
         self._redistribute(self.children[ROOT], self.total_resource)
         for name in self._topo_order():
             kids = self.children[name]
             if kids:
                 self._redistribute(kids, self.nodes[name].runtime)
+        self._runtime_key = key
+        self.runtime_refreshes += 1
+        return True
 
     def _scaled_mins(
         self, names: list[str], total: np.ndarray

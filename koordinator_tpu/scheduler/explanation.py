@@ -69,9 +69,14 @@ class ExplanationStore:
             self._queue.append(explanation)
 
     def delete(self, pod_name: str) -> None:
-        """Pod scheduled (or removed): its explanation is stale."""
+        """Pod scheduled (or removed): its explanation is stale — purge the
+        store AND any queued-but-undrained entry, or a later drain would
+        resurrect a failure explanation for a bound pod."""
         with self._lock:
             self._store.pop(pod_name, None)
+            if any(e.pod_name == pod_name for e in self._queue):
+                self._queue = deque(
+                    e for e in self._queue if e.pod_name != pod_name)
 
     # -- worker side --------------------------------------------------------
 

@@ -142,6 +142,12 @@ class Scheduler:
         self.last_result = SchedulingResult({}, {}, 0)
         self.pending: dict[str, PodSpec] = {}
         self.gangs: dict[str, GangRecord] = {}
+        # PodBatch cache: repeated rounds over an unchanged pending queue
+        # (pods parked on gangs/quota, failing pods awaiting capacity) reuse
+        # the previous device batch instead of rebuilding host-side
+        self._pending_rev = 0
+        self._batch_cache: tuple[tuple, PodBatch] | None = None
+        self.batch_rebuilds = 0
         self._solve = jax.jit(gang_assign, static_argnames=("passes",))
 
         # -- preemption (PostFilter) state --
@@ -192,11 +198,14 @@ class Scheduler:
 
     def enqueue(self, pod: PodSpec) -> None:
         self.pending[pod.name] = pod
+        self._pending_rev += 1
 
     def dequeue(self, pod_name: str) -> None:
         # a deleted nominated preemptor must release its assumed reservation
         # and quota charge, and must not pin a future same-named pod
         pod = self.pending.pop(pod_name, None)
+        if pod is not None:
+            self._pending_rev += 1
         if pod_name in self.nominations and pod is not None:
             self._nomination_release(pod)
         else:
@@ -218,6 +227,26 @@ class Scheduler:
 
     def _build_batch(self, pods: list[PodSpec], gang_index: dict[str, int],
                      quota_index: dict[str, int]) -> PodBatch:
+        hinted = self.hints is not None and any(
+            self.hints.has_hint(pod.name) for pod in pods
+        )
+        # cache key: anything that feeds the batch tensors. pending_rev
+        # covers pod contents (mutations go through enqueue/dequeue), the
+        # name tuple covers active-set changes (gang parking/rejection),
+        # capacity covers node-array growth, class_count covers new label/
+        # taint equivalence classes (node->class reassignment to an existing
+        # class flows through ClusterState.node_class, not the batch)
+        key = (
+            self._pending_rev,
+            tuple(pod.name for pod in pods),
+            tuple(sorted(gang_index.items())),
+            tuple(sorted(quota_index.items())),
+            self.snapshot.capacity,
+            self.snapshot.class_count,
+        )
+        if (not hinted and self._batch_cache is not None
+                and self._batch_cache[0] == key):
+            return self._batch_cache[1]
         p = len(pods)
         cap = _bucket(max(p, 1), minimum=16)
         n_cap = self.snapshot.capacity
@@ -239,9 +268,6 @@ class Scheduler:
         # placement constraints: factored O(P·C) equivalence-class masks by
         # default; the dense O(P·N) path only when a pod carries per-node
         # hint edits (rare — skip/prefer hints from the hinter)
-        hinted = self.hints is not None and any(
-            self.hints.has_hint(pod.name) for pod in pods
-        )
         if hinted:
             feasible = np.zeros((p, n_cap), bool)
             for i, pod in enumerate(pods):
@@ -253,21 +279,25 @@ class Scheduler:
             sel = np.zeros((p, c_cap), bool)
             memo: dict[tuple, np.ndarray] = {}
             for i, pod in enumerate(pods):
-                key = (
+                sel_key = (
                     tuple(sorted(pod.node_selector.items())),
                     tuple(sorted(pod.tolerations.items())),
                 )
-                row = memo.get(key)
+                row = memo.get(sel_key)
                 if row is None:
                     row = self.snapshot.selector_row_for(pod)
-                    memo[key] = row
+                    memo[sel_key] = row
                 sel[i] = row
             mask_kw = dict(selector_mask=sel, class_capacity=c_cap)
-        return PodBatch.build(
+        batch = PodBatch.build(
             requests, priority=priority, qos=qos, gang_id=gang_id,
             quota_id=quota_id, non_preemptible=non_preempt,
             node_capacity=n_cap, capacity=cap, **mask_kw,
         )
+        if not hinted:
+            self._batch_cache = (key, batch)
+        self.batch_rebuilds += 1
+        return batch
 
     def _build_gang_info(self, pods: list[PodSpec]) -> tuple[GangInfo, dict[str, int]]:
         names = sorted({p.gang for p in pods if p.gang is not None})
@@ -436,8 +466,10 @@ class Scheduler:
                 if pod.gang:
                     failed_gangs.add(pod.gang)
             if self.auditor is not None:
-                for pod in pods:
-                    self.auditor.record_attempt(pod.gang or pod.name)
+                # one attempt per workload key per round — a gang is one
+                # scheduling attempt, not len(members) attempts
+                for key in {pod.gang or pod.name for pod in pods}:
+                    self.auditor.record_attempt(key)
 
             # gang WaitTime state machine (Permit timeout semantics)
             for name in failed_gangs - placed_gangs:
@@ -485,7 +517,8 @@ class Scheduler:
         ``charge_quota=False`` converts a nomination whose quota charge is
         already on the tree (``_nomination_assume``)."""
         result.assignments[pod.name] = node
-        self.pending.pop(pod.name, None)
+        if self.pending.pop(pod.name, None) is not None:
+            self._pending_rev += 1
         self.nominations.pop(pod.name, None)
         self.bound[pod.name] = BoundPod(
             name=pod.name, node=node, requests=pod.requests,

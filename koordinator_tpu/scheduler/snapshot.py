@@ -90,6 +90,13 @@ class ClusterSnapshot:
         """Padded equivalence-class count for (P, C) selector masks."""
         return _bucket(max(len(self._class_sigs), 1), minimum=8)
 
+    @property
+    def class_count(self) -> int:
+        """Registered equivalence classes (monotonic — ids never recycle);
+        cache keys use this, not class_capacity, so a new class within the
+        same padding bucket still invalidates."""
+        return len(self._class_sigs)
+
     def _class_of(self, spec: NodeSpec) -> int:
         sig = spec.signature()
         cid = self._class_index.get(sig)

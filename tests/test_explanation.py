@@ -121,3 +121,12 @@ def test_scheduler_persists_and_clears_explanations():
     assert res.assignments == {"big": "n1"}
     assert store.get("big") is None
     assert auditor.events("big")[-1].record_type == "ScheduleSuccess"
+
+
+def test_delete_purges_queued_entry_too():
+    # a bind between record() and drain() must not resurrect the failure
+    store = ExplanationStore()
+    store.record("p1", diag())
+    store.delete("p1")       # bound before the worker drained
+    assert store.drain() == 0
+    assert store.get("p1") is None

@@ -5,6 +5,7 @@ story: new leader rebuilds state through the startup sync barrier
 (cmd/koord-scheduler/app/sync_barrier.go)."""
 
 import threading
+import time
 
 import jax.numpy as jnp
 import numpy as np
@@ -113,9 +114,9 @@ def test_run_loop_thread_releases_on_stop():
     stop = threading.Event()
     th = threading.Thread(target=a.run, args=(stop,))
     th.start()
-    for _ in range(1000):
-        if a.is_leader():
-            break
+    deadline = time.monotonic() + 5.0
+    while not a.is_leader() and time.monotonic() < deadline:
+        time.sleep(0.001)
     assert a.is_leader()
     stop.set()
     th.join(timeout=5)

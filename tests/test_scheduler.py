@@ -259,3 +259,60 @@ def test_topology_gang_surplus_members_not_invalidated():
         sched.enqueue(pod(f"g{i}", cpu=4_000, gang="g"))
     res = sched.schedule_round()
     assert len(res.assignments) == 3
+
+
+# ---- hot-path caching (VERDICT weak #5: no per-round host rework) ----------
+
+def test_quota_runtime_cached_between_unchanged_rounds():
+    t = QuotaTree(total_resource=resource_vector(cpu=10_000).astype(np.int64))
+    t.add("a", min=resource_vector(cpu=2_000).astype(np.int64),
+          max=resource_vector(cpu=8_000).astype(np.int64))
+    t.set_request("a", resource_vector(cpu=4_000).astype(np.int64))
+    assert t.refresh_runtime() is True
+    n = t.runtime_refreshes
+    assert t.refresh_runtime() is False          # nothing changed: skipped
+    assert t.runtime_refreshes == n
+    t.set_request("a", resource_vector(cpu=5_000).astype(np.int64))
+    assert t.refresh_runtime() is True           # request moved: recompute
+    assert t.refresh_runtime(force=True) is True # force always recomputes
+
+
+def test_batch_reused_across_unchanged_rounds():
+    sched, _ = mk_scheduler([node("n1")])
+    sched.enqueue(pod("big", cpu=99_000))        # never schedulable
+    sched.schedule_round()
+    assert sched.batch_rebuilds == 1
+    sched.schedule_round()                       # same pending queue
+    assert sched.batch_rebuilds == 1             # cache hit
+    sched.enqueue(pod("tiny", cpu=100))
+    res = sched.schedule_round()                 # queue changed: rebuild
+    assert sched.batch_rebuilds == 2
+    assert res.assignments == {"tiny": "n1"}
+    sched.schedule_round()                       # tiny bound: queue changed
+    assert sched.batch_rebuilds == 3
+
+
+def test_batch_cache_invalidated_by_node_change():
+    sched, _ = mk_scheduler([node("n1", cpu=1_000)])
+    sched.enqueue(pod("p", cpu=4_000))
+    res = sched.schedule_round()
+    assert "p" in res.failures
+    # capacity arrives: same pending queue, but snapshot grew a class/row
+    for i in range(20):                          # force capacity growth
+        sched.snapshot.upsert_node(node(f"x{i}", cpu=16_000))
+    res = sched.schedule_round()
+    assert "p" in res.assignments
+
+
+def test_batch_cache_invalidated_by_new_class_within_bucket():
+    # a new label equivalence class must invalidate even when neither the
+    # row capacity nor the class padding bucket grows
+    sched, _ = mk_scheduler([node("n1")])
+    sched.enqueue(PodSpec(name="gpu-pod",
+                          requests=resource_vector(cpu=1_000, memory=1_024),
+                          node_selector={"gpu": "true"}))
+    res = sched.schedule_round()
+    assert "gpu-pod" in res.failures
+    sched.snapshot.upsert_node(node("g1", labels={"gpu": "true"}))
+    res = sched.schedule_round()
+    assert res.assignments == {"gpu-pod": "g1"}
