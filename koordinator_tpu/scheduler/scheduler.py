@@ -21,6 +21,7 @@ rejected and its pods surface failures (coscheduling core/gang.go WaitTime).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 
 import jax
@@ -140,6 +141,11 @@ class Scheduler:
         #: explanation.WorkloadAuditor — per-pod/gang lifecycle records
         self.auditor = auditor
         self.last_result = SchedulingResult({}, {}, 0)
+        #: serializes rounds against informer-driven mutations — the
+        #: transport layer applies watch pushes from a reader thread while
+        #: solve RPCs run rounds (the reference relies on the upstream
+        #: single-scheduling-goroutine + informer snapshot model)
+        self.lock = threading.RLock()
         self.pending: dict[str, PodSpec] = {}
         self.gangs: dict[str, GangRecord] = {}
         # PodBatch cache: repeated rounds over an unchanged pending queue
@@ -173,10 +179,12 @@ class Scheduler:
     # -- registration -------------------------------------------------------
 
     def register_gang(self, record: GangRecord) -> None:
-        self.gangs[record.name] = record
+        with self.lock:
+            self.gangs[record.name] = record
 
     def register_pdb(self, record: PdbRecord) -> None:
-        self.pdbs[record.name] = record
+        with self.lock:
+            self.pdbs[record.name] = record
 
     def add_bound_pod(self, pod: BoundPod) -> None:
         """Seed a pre-existing bound pod (informer replay at startup).
@@ -186,30 +194,47 @@ class Scheduler:
         snapshot directly, so a pod the scheduler already evicted (popped
         from ``bound``) cannot be double-freed by a late informer delete.
         """
-        self.bound[pod.name] = pod
-        if pod.node in self.snapshot.node_index:
-            self.snapshot.reserve(pod.node, pod.requests)
+        with self.lock:
+            self.bound[pod.name] = pod
+            if pod.node in self.snapshot.node_index:
+                self.snapshot.reserve(pod.node, pod.requests)
 
     def remove_bound_pod(self, name: str) -> None:
-        """Informer pod-delete: release accounting iff still tracked."""
-        pod = self.bound.pop(name, None)
-        if pod is not None and pod.node in self.snapshot.node_index:
-            self.snapshot.unreserve(pod.node, pod.requests)
+        """Release a bound pod's node reservation iff still tracked (quota
+        stays with the caller: eviction paths release it themselves)."""
+        with self.lock:
+            pod = self.bound.pop(name, None)
+            if pod is not None and pod.node in self.snapshot.node_index:
+                self.snapshot.unreserve(pod.node, pod.requests)
+
+    def delete_pod(self, name: str) -> None:
+        """Informer pod delete, whatever state the pod is in: a pending or
+        nominated pod is dequeued; a bound pod releases BOTH its node
+        reservation and its quota charge (the _commit_bind mirror)."""
+        with self.lock:
+            if name in self.pending or name in self.nominations:
+                self.dequeue(name)
+            bound = self.bound.get(name)
+            if bound is not None:
+                self.remove_bound_pod(name)
+                self._charge_quota_used(bound, sign=-1)
 
     def enqueue(self, pod: PodSpec) -> None:
-        self.pending[pod.name] = pod
-        self._pending_rev += 1
+        with self.lock:
+            self.pending[pod.name] = pod
+            self._pending_rev += 1
 
     def dequeue(self, pod_name: str) -> None:
         # a deleted nominated preemptor must release its assumed reservation
         # and quota charge, and must not pin a future same-named pod
-        pod = self.pending.pop(pod_name, None)
-        if pod is not None:
-            self._pending_rev += 1
-        if pod_name in self.nominations and pod is not None:
-            self._nomination_release(pod)
-        else:
-            self.nominations.pop(pod_name, None)
+        with self.lock:
+            pod = self.pending.pop(pod_name, None)
+            if pod is not None:
+                self._pending_rev += 1
+            if pod_name in self.nominations and pod is not None:
+                self._nomination_release(pod)
+            else:
+                self.nominations.pop(pod_name, None)
 
     # -- the scheduling round ----------------------------------------------
 
@@ -392,6 +417,10 @@ class Scheduler:
 
     def schedule_round(self) -> SchedulingResult:
         """Solve the current pending queue; reserve, bind, diagnose."""
+        with self.lock:
+            return self._schedule_round()
+
+    def _schedule_round(self) -> SchedulingResult:
         if self.barrier is not None and not self.barrier.check():
             # stale cache after restart: refuse to decide until the informer
             # replays past the barrier (sync_barrier.go semantics)
@@ -407,6 +436,11 @@ class Scheduler:
             pods = self._active_pods()
         if not pods:
             return result
+        if self.auditor is not None:
+            # one attempt per workload key per round — a gang is one
+            # scheduling attempt, not len(members) attempts
+            for key in {pod.gang or pod.name for pod in pods}:
+                self.auditor.record_attempt(key)
 
         with self.monitor.phase("BatchBuild"):
             self.snapshot.flush()
@@ -465,11 +499,6 @@ class Scheduler:
                 )
                 if pod.gang:
                     failed_gangs.add(pod.gang)
-            if self.auditor is not None:
-                # one attempt per workload key per round — a gang is one
-                # scheduling attempt, not len(members) attempts
-                for key in {pod.gang or pod.name for pod in pods}:
-                    self.auditor.record_attempt(key)
 
             # gang WaitTime state machine (Permit timeout semantics)
             for name in failed_gangs - placed_gangs:
@@ -490,8 +519,8 @@ class Scheduler:
                 self._run_preemption(pods, batch, result)
 
         if self.explanations is not None:
-            # persist AFTER PostFilter so nominations land on the CR; a
-            # successful bind clears any stale explanation
+            # persist AFTER PostFilter so nominations land on the CR
+            # (successful binds already cleared theirs in _commit_bind)
             for pod in pods:
                 diag = result.failures.get(pod.name)
                 if diag is not None:
@@ -499,12 +528,6 @@ class Scheduler:
                     if self.auditor is not None:
                         self.auditor.record(pod.gang or pod.name,
                                             "ScheduleFailed", diag.message())
-                elif pod.name in result.assignments:
-                    self.explanations.delete(pod.name)
-                    if self.auditor is not None:
-                        self.auditor.record(
-                            pod.gang or pod.name, "ScheduleSuccess",
-                            result.assignments[pod.name])
 
         return result
 
@@ -530,6 +553,13 @@ class Scheduler:
             self._charge_quota_used(pod, sign=1)
         if self.bind_fn is not None:
             self.bind_fn(pod.name, node)
+        # success side of ScheduleExplanation/auditor lifecycle lives here so
+        # nominated binds (Nominated phase, before _active_pods) clear their
+        # stale failure explanations too
+        if self.explanations is not None:
+            self.explanations.delete(pod.name)
+        if self.auditor is not None:
+            self.auditor.record(pod.gang or pod.name, "ScheduleSuccess", node)
 
     def _charge_quota_used(self, pod: PodSpec, sign: int) -> None:
         if (pod.quota and self.quota_tree is not None

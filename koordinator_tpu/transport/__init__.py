@@ -1,0 +1,30 @@
+"""The wire-protocol layer: framed RPC + incremental cluster-state sync.
+
+The reference's deployment seams are all remote-procedure boundaries:
+CRI/NRI hook gRPC (``apis/runtime/v1alpha1/api.proto:148``, ``pkg/koordlet/
+runtimehooks/nri/server.go``), the kubelet HTTPS stub, and apiserver watch
+streams feeding informers (SURVEY.md §5 "distributed communication
+backend"). The TPU rebuild's equivalent (SURVEY.md §7 step 4) is the
+sidecar bridge between the protocol shell and the device-resident solver:
+a snapshot + resource-version'd delta stream so the solver's device
+buffers are updated by scatter, never rebuilt, plus solve/hook RPCs over
+the same framed transport.
+"""
+
+from koordinator_tpu.transport.wire import (  # noqa: F401
+    Frame,
+    FrameType,
+    decode_payload,
+    encode_payload,
+)
+from koordinator_tpu.transport.channel import (  # noqa: F401
+    RpcClient,
+    RpcError,
+    RpcServer,
+)
+from koordinator_tpu.transport.deltasync import (  # noqa: F401
+    DeltaLog,
+    ResyncRequired,
+    StateSyncClient,
+    StateSyncService,
+)

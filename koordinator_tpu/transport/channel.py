@@ -1,0 +1,316 @@
+"""Socket RPC: a threaded server with per-type handlers and a blocking
+client with request correlation and reconnect-with-resync.
+
+Shape mirrors the reference's hook server plumbing
+(``runtimeproxy/dispatcher`` + ``nri/server.go``): the server is a
+registry of handlers keyed by call type; every handler gets the decoded
+(doc, arrays) and returns (doc, arrays) — errors travel as ERROR frames
+and surface client-side as :class:`RpcError` (fail-open decisions belong
+to the caller, matching the proxy's fail-open dispatch).
+
+Every connection writes through a bounded outbound queue drained by a
+dedicated sender thread, so a stalled peer can never block a handler or a
+broadcaster — it just starts dropping (and is reaped when its socket
+dies), the same backpressure posture as an apiserver watch that a slow
+client falls off of.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import socketserver
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from koordinator_tpu.transport.wire import (
+    Frame,
+    FrameType,
+    decode_payload,
+    encode_payload,
+    read_frame,
+)
+
+Handler = Callable[[dict, dict[str, np.ndarray]],
+                   tuple[dict, dict[str, np.ndarray] | None]]
+
+SEND_QUEUE_DEPTH = 256
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+def _recv_exact(sock: socket.socket):
+    def recv(n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf.extend(chunk)
+        return bytes(buf)
+    return recv
+
+
+class _Conn:
+    """One server-side connection: bounded outbound queue + sender thread."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.queue: "queue.Queue[Optional[Frame]]" = queue.Queue(
+            SEND_QUEUE_DEPTH)
+        self.alive = True
+        self.dropped = 0
+        self._sender = threading.Thread(target=self._drain, daemon=True)
+        self._sender.start()
+
+    def send(self, frame: Frame) -> None:
+        """Enqueue; never blocks the caller. A full queue (stalled peer)
+        drops the frame and poisons the connection so the peer resyncs on
+        reconnect instead of silently missing one event."""
+        if not self.alive:
+            return
+        try:
+            self.queue.put_nowait(frame)
+        except queue.Full:
+            self.dropped += 1
+            self.alive = False
+            try:
+                self.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.queue.put_nowait(None)
+        except queue.Full:
+            pass  # sender will exit on the next send error
+
+    def _drain(self) -> None:
+        while True:
+            frame = self.queue.get()
+            if frame is None:
+                return
+            try:
+                self.sock.sendall(frame.encode())
+            except OSError:
+                self.alive = False
+                return
+
+
+class _ConnHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server: RpcServer = self.server.rpc  # type: ignore[attr-defined]
+        recv = _recv_exact(self.request)
+        conn = _Conn(self.request)
+        server._on_connect(conn)
+        try:
+            while True:
+                try:
+                    frame = read_frame(recv)
+                except (ConnectionError, OSError):
+                    return
+                if frame.type is FrameType.PING:
+                    conn.send(Frame(FrameType.ACK, frame.request_id,
+                                    encode_payload({})))
+                    continue
+                handler = server.handlers.get(frame.type)
+                if handler is None:
+                    conn.send(Frame(FrameType.ERROR, frame.request_id,
+                                    encode_payload(
+                                        {"message":
+                                         f"no handler for {frame.type}"})))
+                    continue
+                try:
+                    doc, arrays = decode_payload(frame.payload)
+                    out_doc, out_arrays = handler(doc, arrays)
+                    rtype = FrameType(out_doc.pop(
+                        "__type__", int(_RESPONSE_TYPE.get(
+                            frame.type, FrameType.ACK))))
+                    conn.send(Frame(rtype, frame.request_id,
+                                    encode_payload(out_doc, out_arrays)))
+                except Exception as e:  # handler bug: fail the call, not conn
+                    conn.send(Frame(FrameType.ERROR, frame.request_id,
+                                    encode_payload({"message": repr(e)})))
+        finally:
+            server._on_disconnect(conn)
+            conn.close()
+
+
+_RESPONSE_TYPE = {
+    FrameType.HELLO: FrameType.SNAPSHOT,
+    FrameType.SOLVE_REQUEST: FrameType.SOLVE_RESPONSE,
+    FrameType.HOOK_REQUEST: FrameType.HOOK_RESPONSE,
+}
+
+
+class _Server(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class RpcServer:
+    """Unix-socket RPC server; one receive thread + one send thread per
+    connection."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.handlers: dict[FrameType, Handler] = {}
+        self._conns: list[_Conn] = []
+        self._conn_lock = threading.Lock()
+        if os.path.exists(path):
+            os.unlink(path)
+        self._server = _Server(path, _ConnHandler)
+        self._server.rpc = self  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, ftype: FrameType, handler: Handler) -> None:
+        self.handlers[ftype] = handler
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        with self._conn_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            conn.close()
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
+    # -- server push (watch-stream analog) ----------------------------------
+
+    def _on_connect(self, conn: _Conn) -> None:
+        with self._conn_lock:
+            self._conns.append(conn)
+
+    def _on_disconnect(self, conn: _Conn) -> None:
+        with self._conn_lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+
+    def broadcast(self, ftype: FrameType, doc: dict,
+                  arrays: dict[str, np.ndarray] | None = None) -> int:
+        """Push a frame (request_id 0 = unsolicited) to all live
+        connections — the informer watch-event fan-out. Never blocks:
+        frames go through each connection's bounded queue."""
+        frame = Frame(ftype, 0, encode_payload(doc, arrays))
+        with self._conn_lock:
+            conns = list(self._conns)
+        sent = 0
+        for conn in conns:
+            if conn.alive:
+                conn.send(frame)
+                sent += 1
+        return sent
+
+
+class RpcClient:
+    """Blocking request/response client. Unsolicited (request_id 0) frames
+    are delivered to ``on_push`` — the watch stream."""
+
+    def __init__(self, path: str, on_push=None, timeout: float = 10.0):
+        self.path = path
+        self.on_push = on_push
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._pending: dict[int, "_Waiter"] = {}
+        self._pending_lock = threading.Lock()
+        self._next_id = 1
+        self._reader: Optional[threading.Thread] = None
+        self.connected = False
+        self.push_errors = 0
+
+    def connect(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.connect(self.path)
+        self._sock = sock
+        self.connected = True
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def close(self) -> None:
+        self.connected = False
+        if self._sock is not None:
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._sock.close()
+            self._sock = None
+
+    def _read_loop(self) -> None:
+        assert self._sock is not None
+        recv = _recv_exact(self._sock)
+        try:
+            while True:
+                frame = read_frame(recv)
+                if frame.request_id == 0:
+                    if self.on_push is not None:
+                        try:
+                            self.on_push(frame)
+                        except Exception:
+                            # a bad push must not kill the stream: later
+                            # frames still correlate calls and pushes
+                            self.push_errors += 1
+                    continue
+                with self._pending_lock:
+                    waiter = self._pending.pop(frame.request_id, None)
+                if waiter is not None:
+                    waiter.frame = frame
+                    waiter.event.set()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.connected = False
+            with self._pending_lock:
+                waiters = list(self._pending.values())
+                self._pending.clear()
+            for w in waiters:
+                w.event.set()  # fail fast with frame=None
+
+    def call(self, ftype: FrameType, doc: dict,
+             arrays: dict[str, np.ndarray] | None = None
+             ) -> tuple[FrameType, dict, dict[str, np.ndarray]]:
+        if self._sock is None:
+            raise RpcError("not connected")
+        waiter = _Waiter()
+        with self._pending_lock:
+            req_id = self._next_id
+            self._next_id += 1
+            self._pending[req_id] = waiter
+        frame = Frame(ftype, req_id, encode_payload(doc, arrays))
+        try:
+            with self._send_lock:
+                self._sock.sendall(frame.encode())
+        except OSError as e:
+            self.connected = False
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise RpcError(f"connection lost: {e}") from e
+        if not waiter.event.wait(self.timeout):
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise RpcError("rpc timeout")
+        if waiter.frame is None:
+            raise RpcError("connection lost")
+        rdoc, rarrays = decode_payload(waiter.frame.payload)
+        if waiter.frame.type is FrameType.ERROR:
+            raise RpcError(rdoc.get("message", "remote error"))
+        return waiter.frame.type, rdoc, rarrays
+
+
+class _Waiter:
+    def __init__(self):
+        self.event = threading.Event()
+        self.frame: Optional[Frame] = None

@@ -1,0 +1,355 @@
+"""Incremental cluster-state sync: snapshot + resource-version'd deltas.
+
+The reference keeps the solver-visible world current through apiserver
+watch streams: informers replay a LIST (snapshot at a resourceVersion)
+then stream WATCH events; a client that falls behind the retained event
+window gets HTTP 410 Gone and must re-LIST. This module is that protocol
+over the framed RPC layer, feeding the solver's device-resident tensors:
+
+- :class:`StateSyncService` is the informer side: it owns the object
+  cache (nodes/pods), stamps every mutation with a monotonically
+  increasing resource version, retains a bounded delta log, serves HELLO
+  as ACK (caught up) / DELTA (replay window) / SNAPSHOT (fell behind),
+  and pushes DELTA frames to connected solvers (the WATCH stream).
+- :class:`StateSyncClient` is the solver side: applies frames
+  idempotently (events at or below its rv are skipped, so replays and
+  reconnect overlaps are harmless), requests resync when told, and hands
+  decoded objects to the snapshot/scheduler through a binding.
+
+Deltas carry their resource vectors as raw (K, R) int32 blocks — the
+host->device path stays a scatter of K rows, never a rebuild
+(SURVEY.md §7 "hard parts (a)").
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from koordinator_tpu.transport.wire import FrameType
+
+NODE_UPSERT = "node_upsert"
+NODE_REMOVE = "node_remove"
+POD_ADD = "pod_add"
+POD_REMOVE = "pod_remove"
+
+
+class ResyncRequired(Exception):
+    """Client fell behind the retained window (HTTP 410 Gone analog)."""
+
+
+class DeltaLog:
+    """Bounded ordered log of (rv, event, arrays)."""
+
+    def __init__(self, retention: int = 4096):
+        self.retention = retention
+        self._events: deque[tuple[int, dict, dict[str, np.ndarray]]] = deque()
+
+    def append(self, rv: int, event: dict,
+               arrays: dict[str, np.ndarray]) -> None:
+        self._events.append((rv, event, arrays))
+        while len(self._events) > self.retention:
+            self._events.popleft()
+
+    def oldest_rv(self) -> Optional[int]:
+        return self._events[0][0] if self._events else None
+
+    def since(self, rv: int) -> list[tuple[int, dict, dict[str, np.ndarray]]]:
+        """All events with rv' > rv. Raises ResyncRequired when rv is
+        before the retained window."""
+        oldest = self.oldest_rv()
+        if oldest is not None and rv < oldest - 1:
+            raise ResyncRequired(f"rv {rv} < retained window start {oldest}")
+        return [(v, e, a) for v, e, a in self._events if v > rv]
+
+
+def _pack_events(
+    events: list[tuple[int, dict, dict[str, np.ndarray]]]
+) -> tuple[dict, dict[str, np.ndarray]]:
+    """Stack per-event arrays into (K, R) blocks referenced by row index."""
+    docs = []
+    stacked: dict[str, list[np.ndarray]] = {}
+    for rv, event, arrays in events:
+        entry = dict(event, rv=rv)
+        for key, arr in arrays.items():
+            rows = stacked.setdefault(key, [])
+            entry[f"__row_{key}__"] = len(rows)
+            rows.append(np.asarray(arr))
+        docs.append(entry)
+    return ({"events": docs},
+            {k: np.stack(v) for k, v in stacked.items()})
+
+
+def _unpack_event_arrays(entry: dict,
+                         arrays: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    out = {}
+    for key in list(entry):
+        if key.startswith("__row_") and key.endswith("__"):
+            name = key[len("__row_"):-len("__")]
+            out[name] = arrays[name][entry[key]]
+    return out
+
+
+class StateSyncService:
+    """Informer-side state authority + wire handlers.
+
+    Attach to an RpcServer:
+
+        service = StateSyncService()
+        service.attach(server)
+
+    then mutate via upsert_node/remove_node/add_pod/remove_pod; every
+    mutation bumps the rv, logs a delta, and pushes it to subscribers.
+    """
+
+    def __init__(self, retention: int = 4096):
+        self._lock = threading.RLock()
+        self.rv = 0
+        self.log = DeltaLog(retention)
+        self.nodes: dict[str, dict] = {}      # name -> {doc, arrays}
+        self.pods: dict[str, dict] = {}       # name -> {doc, arrays}
+        self._server = None
+
+    # -- mutations (informer event handlers) --------------------------------
+
+    def _commit(self, event: dict, arrays: dict[str, np.ndarray]) -> int:
+        """Append + broadcast under the lock so rv order and wire order
+        agree (the client's idempotency guard drops any rv it has already
+        passed, so reordered broadcasts would lose events). Safe to hold:
+        broadcast only enqueues to bounded per-connection queues — a
+        stalled peer drops frames and gets poisoned, it cannot wedge the
+        service (channel._Conn.send)."""
+        with self._lock:
+            self.rv += 1
+            rv = self.rv
+            self.log.append(rv, event, arrays)
+            if self._server is not None:
+                doc, stacked = _pack_events([(rv, event, arrays)])
+                self._server.broadcast(FrameType.DELTA, doc, stacked)
+            return rv
+
+    def upsert_node(self, name: str, allocatable: np.ndarray,
+                    usage: np.ndarray | None = None,
+                    labels: dict | None = None,
+                    taints: dict | None = None) -> int:
+        arrays = {
+            "allocatable": np.asarray(allocatable, np.int32),
+            "usage": (np.asarray(usage, np.int32) if usage is not None
+                      else np.zeros_like(allocatable, np.int32)),
+        }
+        doc = {"kind": NODE_UPSERT, "name": name,
+               "labels": labels or {}, "taints": taints or {}}
+        with self._lock:
+            self.nodes[name] = {"doc": doc, "arrays": arrays}
+        return self._commit(doc, arrays)
+
+    def remove_node(self, name: str) -> int:
+        with self._lock:
+            self.nodes.pop(name, None)
+        return self._commit({"kind": NODE_REMOVE, "name": name}, {})
+
+    def add_pod(self, name: str, requests: np.ndarray,
+                priority: int = 0, quota: str | None = None,
+                gang: str | None = None,
+                node_selector: dict | None = None) -> int:
+        arrays = {"requests": np.asarray(requests, np.int32)}
+        doc = {"kind": POD_ADD, "name": name, "priority": priority,
+               "quota": quota, "gang": gang,
+               "node_selector": node_selector or {}}
+        with self._lock:
+            self.pods[name] = {"doc": doc, "arrays": arrays}
+        return self._commit(doc, arrays)
+
+    def remove_pod(self, name: str) -> int:
+        with self._lock:
+            self.pods.pop(name, None)
+        return self._commit({"kind": POD_REMOVE, "name": name}, {})
+
+    # -- wire handlers -------------------------------------------------------
+
+    def attach(self, server) -> None:
+        self._server = server
+        server.register(FrameType.HELLO, self._handle_hello)
+
+    def _snapshot(self) -> tuple[dict, dict[str, np.ndarray]]:
+        events = []
+        for entry in list(self.nodes.values()) + list(self.pods.values()):
+            events.append((self.rv, entry["doc"], entry["arrays"]))
+        doc, arrays = _pack_events(events)
+        doc["rv"] = self.rv
+        doc["snapshot"] = True
+        return doc, arrays
+
+    def _handle_hello(self, doc: dict, arrays):
+        last_rv = int(doc.get("last_rv", -1))
+        with self._lock:
+            if last_rv == self.rv:
+                return {"__type__": int(FrameType.ACK), "rv": self.rv}, None
+            if 0 <= last_rv < self.rv:
+                try:
+                    events = self.log.since(last_rv)
+                except ResyncRequired:
+                    events = None
+                if events is not None:
+                    out, stacked = _pack_events(events)
+                    out["__type__"] = int(FrameType.DELTA)
+                    out["rv"] = self.rv
+                    return out, stacked
+            # last_rv < 0 (fresh client), ahead of us (the service
+            # restarted and its rv counter reset), or behind the retained
+            # window: full snapshot, client resets
+            out, stacked = self._snapshot()
+            return out, stacked
+
+
+class StateSyncClient:
+    """Solver-side applier. Wire with an RpcClient:
+
+        binding = SchedulerBinding(scheduler)
+        sync = StateSyncClient(binding)
+        client = RpcClient(path, on_push=sync.on_push)
+        client.connect(); sync.bootstrap(client)
+
+    Reconnect: call bootstrap() again — HELLO carries last_rv, overlap
+    replays are dropped by the rv guard, and a ResyncRequired from the
+    server falls back to a fresh snapshot apply.
+    """
+
+    def __init__(self, binding):
+        self.binding = binding
+        self.rv = -1
+        self._lock = threading.RLock()
+        self._bootstrapping = False
+        self._buffer: list[tuple[dict, dict]] = []
+        self.applied = 0
+        self.skipped = 0
+
+    def bootstrap(self, client) -> int:
+        """HELLO + apply. Pushes that race the HELLO response on the wire
+        (a DELTA committed after the snapshot was built can be enqueued to
+        this connection first) are buffered and replayed after the
+        snapshot, where the rv guard keeps exactly the newer ones."""
+        with self._lock:
+            self._bootstrapping = True
+            self._buffer = []
+        try:
+            ftype, doc, arrays = client.call(
+                FrameType.HELLO, {"last_rv": self.rv})
+            with self._lock:
+                n = 0
+                if ftype is not FrameType.ACK:
+                    n = self._apply(doc, arrays)
+                # drain and exit buffering atomically — a push landing
+                # after this block goes straight to _apply
+                for bdoc, barrays in self._buffer:
+                    n += self._apply(bdoc, barrays)
+                self._bootstrapping = False
+                self._buffer = []
+                return n
+        finally:
+            with self._lock:  # exception path (call failed): stop buffering
+                self._bootstrapping = False
+                self._buffer = []
+
+    def on_push(self, frame) -> None:
+        from koordinator_tpu.transport.wire import decode_payload
+
+        if frame.type is not FrameType.DELTA:
+            return
+        doc, arrays = decode_payload(frame.payload)
+        with self._lock:
+            if self._bootstrapping:
+                self._buffer.append((doc, arrays))
+                return
+        self._apply(doc, arrays)
+
+    def _apply(self, doc: dict, arrays: dict[str, np.ndarray]) -> int:
+        n = 0
+        with self._lock:
+            if doc.get("snapshot"):
+                self.binding.reset()
+                self.rv = -1  # snapshot events all carry the snapshot rv
+            high = self.rv
+            for entry in doc.get("events", []):
+                rv = int(entry.get("rv", doc.get("rv", 0)))
+                if not doc.get("snapshot") and rv <= self.rv:
+                    self.skipped += 1  # replay overlap: idempotent skip
+                    continue
+                self._dispatch(entry, _unpack_event_arrays(entry, arrays))
+                high = max(high, rv)
+                n += 1
+            self.rv = max(high, int(doc.get("rv", high)))
+            self.applied += n
+        return n
+
+    def _dispatch(self, entry: dict, arrs: dict[str, np.ndarray]) -> None:
+        kind = entry["kind"]
+        if kind == NODE_UPSERT:
+            self.binding.node_upsert(entry, arrs)
+        elif kind == NODE_REMOVE:
+            self.binding.node_remove(entry["name"])
+        elif kind == POD_ADD:
+            self.binding.pod_add(entry, arrs)
+        elif kind == POD_REMOVE:
+            self.binding.pod_remove(entry["name"])
+
+
+class SchedulerBinding:
+    """Applies sync events onto a Scheduler + its ClusterSnapshot.
+
+    Every apply holds ``scheduler.lock`` — the sync client runs on the
+    RpcClient reader thread while SolveService runs rounds on server
+    connection threads; the lock is the single-scheduling-goroutine
+    equivalent."""
+
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+
+    def reset(self) -> None:
+        """Snapshot resync = restart semantics: release EVERYTHING (bound
+        pods free their reservations + quota charges before their nodes
+        go) and rebuild from the replayed snapshot."""
+        with self.scheduler.lock:
+            for name in list(self.scheduler.bound):
+                self.scheduler.delete_pod(name)
+            for name in list(self.scheduler.pending):
+                self.scheduler.dequeue(name)
+            snap = self.scheduler.snapshot
+            for name in list(snap.node_index):
+                snap.remove_node(name)
+
+    def node_upsert(self, entry: dict, arrs: dict[str, np.ndarray]) -> None:
+        from koordinator_tpu.scheduler.snapshot import NodeSpec
+
+        with self.scheduler.lock:
+            self.scheduler.snapshot.upsert_node(NodeSpec(
+                name=entry["name"],
+                allocatable=np.asarray(arrs["allocatable"], np.int32),
+                usage=np.asarray(arrs["usage"], np.int32),
+                labels=dict(entry.get("labels", {})),
+                taints=dict(entry.get("taints", {})),
+            ))
+
+    def node_remove(self, name: str) -> None:
+        with self.scheduler.lock:
+            self.scheduler.snapshot.remove_node(name)
+
+    def pod_add(self, entry: dict, arrs: dict[str, np.ndarray]) -> None:
+        from koordinator_tpu.scheduler.snapshot import PodSpec
+
+        self.scheduler.enqueue(PodSpec(
+            name=entry["name"],
+            requests=np.asarray(arrs["requests"], np.int32),
+            priority=int(entry.get("priority", 0)),
+            quota=entry.get("quota"),
+            gang=entry.get("gang"),
+            node_selector=dict(entry.get("node_selector", {})),
+        ))
+
+    def pod_remove(self, name: str) -> None:
+        # pending, nominated, or bound — a bound delete releases its node
+        # reservation and quota charge
+        self.scheduler.delete_pod(name)

@@ -1,0 +1,104 @@
+"""RPC services over the framed transport.
+
+- :class:`SolveService` is the ``framework.Plugin`` extension seam
+  (SURVEY.md §2.11 / §7 step 4): the protocol shell asks the solver
+  sidecar for a scheduling round and gets assignments + failure reasons
+  back. In the reference this boundary is the upstream scheduler calling
+  plugin Filter/Score/Reserve in-process; here the whole batched round is
+  one RPC, so the wire crossing is per-round, not per-pod-per-node.
+- :class:`HookService` carries the runtime-hook dispatch
+  (``apis/runtime/v1alpha1/api.proto:148`` PreRunPodSandboxHook et al)
+  over the same frames, fail-open like the runtime proxy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from koordinator_tpu.transport.wire import FrameType
+
+
+class SolveService:
+    """Server side: schedule_round over the wire."""
+
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+
+    def attach(self, server) -> None:
+        server.register(FrameType.SOLVE_REQUEST, self._handle)
+
+    def _handle(self, doc: dict, arrays):
+        result = self.scheduler.schedule_round()
+        return {
+            "assignments": dict(result.assignments),
+            "failures": {name: diag.message()
+                         for name, diag in result.failures.items()},
+            "nominations": {p: [n, v] for p, (n, v)
+                            in result.nominations.items()},
+            "round_pods": result.round_pods,
+        }, None
+
+
+def solve_remote(client) -> dict:
+    """Client side: one scheduling round on the remote solver."""
+    _, doc, _ = client.call(FrameType.SOLVE_REQUEST, {})
+    return doc
+
+
+class HookService:
+    """Server side: runtime-hook dispatch (NRI/proxy seam)."""
+
+    def __init__(self, dispatcher):
+        self.dispatcher = dispatcher
+
+    def attach(self, server) -> None:
+        server.register(FrameType.HOOK_REQUEST, self._handle)
+
+    def _handle(self, doc: dict, arrays):
+        from koordinator_tpu.runtimeproxy import HookRequest, HookType
+
+        hook = HookType(doc["hook"])
+        request = HookRequest(
+            pod_meta=doc.get("pod_meta", {}),
+            container_meta=doc.get("container_meta", {}),
+            labels=doc.get("labels", {}),
+            annotations=doc.get("annotations", {}),
+            cgroup_parent=doc.get("cgroup_parent", ""),
+            resources=doc.get("resources", {}),
+            envs=doc.get("envs", {}),
+        )
+        merged = self.dispatcher.dispatch(hook, request)
+        return {
+            "labels": merged.labels,
+            "annotations": merged.annotations,
+            "cgroup_parent": merged.cgroup_parent,
+            "resources": merged.resources,
+            "envs": merged.envs,
+        }, None
+
+
+def hook_remote(client, hook, request, fail_open: bool = True) -> Optional[dict]:
+    """Client side: dispatch one hook remotely. Fail-open returns None on
+    transport errors (the proxy must never wedge the CRI path —
+    dispatcher.go fail-open semantics)."""
+    from koordinator_tpu.transport.channel import RpcError
+
+    doc = {
+        "hook": hook.value,
+        "pod_meta": request.pod_meta,
+        "container_meta": request.container_meta,
+        "labels": request.labels,
+        "annotations": request.annotations,
+        "cgroup_parent": request.cgroup_parent,
+        "resources": request.resources,
+        "envs": request.envs,
+    }
+    try:
+        _, out, _ = client.call(FrameType.HOOK_REQUEST, doc)
+        return out
+    except RpcError:
+        if fail_open:
+            return None
+        raise

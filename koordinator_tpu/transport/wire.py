@@ -1,0 +1,112 @@
+"""Frame + payload encoding.
+
+One frame on the wire:
+
+    magic   u16  0x4B54 ("KT")
+    version u8   wire version (1)
+    type    u8   FrameType
+    req_id  u32  request/response correlation id
+    length  u32  payload byte length
+    payload
+
+The payload is a control document plus an array blob:
+
+    json_len u32 | json utf-8 | raw array section
+
+The json document carries small structured fields; numpy arrays ride in
+the raw section, referenced from ``doc["__arrays__"]`` manifest entries
+``{key, dtype, shape, offset, nbytes}`` — so the hot path (node/pod
+resource tensors) moves as raw little-endian bytes, not text. This is the
+same split gRPC+proto gives the reference: tiny schema-ed control data,
+binary tensors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import struct
+
+import numpy as np
+
+MAGIC = 0x4B54
+VERSION = 1
+_HEADER = struct.Struct("<HBBII")
+MAX_PAYLOAD = 256 << 20  # 256 MiB guard against corrupt length words
+
+
+class FrameType(enum.IntEnum):
+    HELLO = 1           # client: {last_rv}; server replies SNAPSHOT or ACK
+    SNAPSHOT = 2        # full state dump @ rv
+    DELTA = 3           # incremental changes (rv-ordered)
+    ACK = 4             # generic ok, {rv} for sync acks
+    ERROR = 5           # {message, resync: bool}
+    SOLVE_REQUEST = 6   # run a scheduling round
+    SOLVE_RESPONSE = 7  # assignments/failures
+    HOOK_REQUEST = 8    # runtime hook dispatch (api.proto:148 shapes)
+    HOOK_RESPONSE = 9
+    PING = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class Frame:
+    type: FrameType
+    request_id: int
+    payload: bytes
+
+    def encode(self) -> bytes:
+        return _HEADER.pack(MAGIC, VERSION, int(self.type),
+                            self.request_id, len(self.payload)) + self.payload
+
+
+def encode_payload(doc: dict, arrays: dict[str, np.ndarray] | None = None) -> bytes:
+    """Pack a json-able doc + named numpy arrays into one payload."""
+    blobs = []
+    manifest = []
+    offset = 0
+    for key, arr in (arrays or {}).items():
+        a = np.ascontiguousarray(arr)
+        raw = a.tobytes()
+        manifest.append({
+            "key": key, "dtype": a.dtype.str, "shape": list(a.shape),
+            "offset": offset, "nbytes": len(raw),
+        })
+        blobs.append(raw)
+        offset += len(raw)
+    out = dict(doc)
+    if manifest:
+        out["__arrays__"] = manifest
+    j = json.dumps(out, separators=(",", ":")).encode()
+    return struct.pack("<I", len(j)) + j + b"".join(blobs)
+
+
+def decode_payload(payload: bytes) -> tuple[dict, dict[str, np.ndarray]]:
+    (json_len,) = struct.unpack_from("<I", payload, 0)
+    doc = json.loads(payload[4:4 + json_len].decode())
+    arrays: dict[str, np.ndarray] = {}
+    base = 4 + json_len
+    for entry in doc.pop("__arrays__", []):
+        start = base + entry["offset"]
+        arr = np.frombuffer(
+            payload, dtype=np.dtype(entry["dtype"]),
+            count=int(np.prod(entry["shape"], dtype=np.int64)) if entry["shape"] else 1,
+            offset=start,
+        ).reshape(entry["shape"])
+        arrays[entry["key"]] = arr
+    return doc, arrays
+
+
+def read_frame(recv_exact) -> Frame:
+    """Read one frame via a recv_exact(n)->bytes callable. Raises
+    ConnectionError on short reads / bad magic."""
+    header = recv_exact(_HEADER.size)
+    magic, version, ftype, req_id, length = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise ConnectionError(f"bad frame magic {magic:#x}")
+    if version != VERSION:
+        raise ConnectionError(f"unsupported wire version {version}")
+    if length > MAX_PAYLOAD:
+        raise ConnectionError(f"oversized frame ({length} bytes)")
+    payload = recv_exact(length) if length else b""
+    return Frame(FrameType(ftype), req_id, payload)
