@@ -46,7 +46,7 @@ def _median_readback_seconds(fn, args, n: int = 5) -> float:
     return float(np.median(times))
 
 
-def _chained_loop(state, assign_fn, iters: int = K_ITERS):
+def _chained_loop(assign_fn, iters: int = K_ITERS):
     """The shared chained-iteration scaffold: re-run ``assign_fn(st)``
     ``iters`` times with a data dependency through node_usage so XLA cannot
     dedupe or elide iterations."""
@@ -69,7 +69,7 @@ def _chained_loop(state, assign_fn, iters: int = K_ITERS):
 def _time_assign(state, assign_fn, rtt: float, n: int = 3,
                  iters: int = K_ITERS) -> float:
     total = _median_readback_seconds(
-        jax.jit(_chained_loop(state, assign_fn, iters)), (state,), n=n)
+        jax.jit(_chained_loop(assign_fn, iters)), (state,), n=n)
     return max((total - rtt) / iters, 1e-9)
 
 
@@ -183,8 +183,9 @@ def main() -> None:
 
     def score_fn(st):
         scores, feasible = score_pods(st, pods, cfg)
-        # reuse the chained scaffold: (assignments-like sum, state-like)
-        return (scores[0] + feasible.sum(),
+        # the FULL (P, N) score tensor must stay live (scores.sum()) or XLA
+        # may legally slice scoring down to the one row the chain consumes
+        return (scores.sum() + feasible.sum(),
                 st.replace(node_requested=st.node_requested
                            + (scores[0, :, None] & 1)))
 
@@ -200,12 +201,24 @@ def main() -> None:
         ),
         "solve_ms_per_round": round(solve_per_iter * 1e3, 2),
     }
-    # a failing extra config must never cost the already-measured headline
-    for bench in (_bench_quota, _bench_gang, _bench_lownodeload):
+    # extras run in CHILD processes: even a device OOM abort or backend
+    # SIGABRT in a config cannot cost the already-measured headline
+    import subprocess
+    import sys
+
+    for name in ("quota", "gang", "lownodeload"):
         try:
-            extra.update(bench(rtt))
+            proc = subprocess.run(
+                [sys.executable, __file__, "--extra", name],
+                capture_output=True, text=True, timeout=900)
+            if proc.returncode == 0 and proc.stdout.strip():
+                extra.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+            else:
+                tail = (proc.stderr or proc.stdout or "").strip()[-200:]
+                extra[f"bench_{name}_error"] = (
+                    f"rc={proc.returncode}: {tail}")
         except Exception as e:
-            extra[bench.__name__ + "_error"] = repr(e)[:200]
+            extra[f"bench_{name}_error"] = repr(e)[:200]
 
     print(
         json.dumps(
@@ -222,5 +235,31 @@ def main() -> None:
     )
 
 
+def _extra_main(name: str) -> None:
+    """Child-process entry: run one extra config, print its dict as JSON."""
+    state, _, _ = __import__("__graft_entry__")._build_problem(64, 64)
+
+    def rtt_floor(state):
+        return state.node_allocatable.sum()
+
+    rtt = _median_readback_seconds(jax.jit(rtt_floor), (state,), n=3)
+    fn = {"quota": _bench_quota, "gang": _bench_gang,
+          "lownodeload": _bench_lownodeload}[name]
+    print(json.dumps(fn(rtt)))
+
+
 if __name__ == "__main__":
-    main()
+    import os
+    import sys
+
+    # honor an explicit platform request even under the ambient
+    # sitecustomize, which pins the tunnel backend via jax.config (so the
+    # env var alone is ignored); lets the extras' child processes — and CPU
+    # smoke runs — follow the parent's platform
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    if len(sys.argv) == 3 and sys.argv[1] == "--extra":
+        _extra_main(sys.argv[2])
+    else:
+        main()

@@ -1,0 +1,270 @@
+"""The six binaries' parsers + assembly (reference cmd/ tree).
+
+Each ``main_<binary>(argv)`` parses flags, applies feature gates, and
+returns the assembled component graph as a small namespace object —
+callers (tests, the driver, a real deployment shim) wire transports and
+call ``run()`` themselves. Flags mirror the reference commands:
+
+- koordlet            (cmd/koordlet/main.go)
+- koord-scheduler     (cmd/koord-scheduler/app/server.go)
+- koord-manager       (cmd/koord-manager/main.go)
+- koord-descheduler   (cmd/koord-descheduler)
+- koord-runtime-proxy (cmd/koord-runtime-proxy/main.go)
+- koord-device-daemon (cmd/koord-device-daemon/main.go)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Any, Optional
+
+from koordinator_tpu.cmd import (
+    add_common_flags,
+    add_leader_election_flags,
+    apply_feature_gates,
+    build_elector,
+)
+
+
+@dataclasses.dataclass
+class Assembled:
+    """What a binary main() hands back: the component graph + metadata."""
+
+    name: str
+    args: argparse.Namespace
+    component: Any
+    elector: Optional[Any] = None
+    server: Optional[Any] = None   # transport RpcServer when one was opened
+
+
+# ---- koordlet --------------------------------------------------------------
+
+def build_koordlet_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="koordlet")
+    add_common_flags(parser)
+    parser.add_argument("--cgroup-root-dir", default="/sys/fs/cgroup")
+    parser.add_argument("--proc-root-dir", default="/proc")
+    parser.add_argument("--sys-root-dir", default="/sys")
+    parser.add_argument("--cgroup-driver-systemd", action="store_true")
+    parser.add_argument("--cgroup-v2", action="store_true")
+    parser.add_argument("--audit-log-dir", default="")
+    parser.add_argument("--collect-interval-seconds", type=float, default=1.0)
+    return parser
+
+
+def main_koordlet(argv: list[str]) -> Assembled:
+    from koordinator_tpu.features import KOORDLET_GATES
+    from koordinator_tpu.koordlet.daemon import Daemon
+    from koordinator_tpu.koordlet.system.config import SystemConfig
+
+    args = build_koordlet_parser().parse_args(argv)
+    apply_feature_gates(args.feature_gates, KOORDLET_GATES)
+    cfg = SystemConfig(
+        cgroup_root=args.cgroup_root_dir,
+        proc_root=args.proc_root_dir,
+        sys_root=args.sys_root_dir,
+        use_cgroup_v2=args.cgroup_v2,
+        cgroup_driver_systemd=args.cgroup_driver_systemd,
+    )
+    daemon = Daemon(cfg=cfg, audit_dir=args.audit_log_dir or None)
+    return Assembled(name="koordlet", args=args, component=daemon)
+
+
+# ---- koord-scheduler -------------------------------------------------------
+
+def build_scheduler_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="koord-scheduler")
+    add_common_flags(parser)
+    add_leader_election_flags(parser, default_lease="koord-scheduler")
+    parser.add_argument("--node-capacity", type=int, default=1024,
+                        help="initial padded node-state capacity")
+    parser.add_argument("--gang-passes", type=int, default=2)
+    parser.add_argument("--enable-preemption", action="store_true")
+    parser.add_argument("--sync-barrier-timeout", type=float, default=30.0,
+                        help="app/sync_barrier.go wait budget")
+    parser.add_argument("--listen-socket", default="",
+                        help="unix socket for the solve/state-sync RPC "
+                             "services (empty = in-process only)")
+    return parser
+
+
+def main_koord_scheduler(argv: list[str],
+                         lease_store=None) -> Assembled:
+    from koordinator_tpu.features import SCHEDULER_GATES
+    from koordinator_tpu.scheduler import ClusterSnapshot, Scheduler
+    from koordinator_tpu.scheduler.explanation import (
+        ExplanationStore,
+        WorkloadAuditor,
+    )
+
+    args = build_scheduler_parser().parse_args(argv)
+    apply_feature_gates(args.feature_gates, SCHEDULER_GATES)
+    snapshot = ClusterSnapshot(capacity=args.node_capacity)
+    scheduler = Scheduler(
+        snapshot,
+        gang_passes=args.gang_passes,
+        enable_preemption=args.enable_preemption or None,
+        explanations=ExplanationStore(),
+        auditor=WorkloadAuditor(),
+    )
+    elector = build_elector(args, lease_store)
+    server = None
+    if args.listen_socket:
+        from koordinator_tpu.transport import RpcServer
+        from koordinator_tpu.transport.services import SolveService
+
+        server = RpcServer(args.listen_socket)
+        SolveService(scheduler).attach(server)
+        server.start()
+    return Assembled(name="koord-scheduler", args=args,
+                     component=scheduler, elector=elector, server=server)
+
+
+# ---- koord-manager ---------------------------------------------------------
+
+def build_manager_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="koord-manager")
+    add_common_flags(parser)
+    add_leader_election_flags(parser, default_lease="koord-manager")
+    parser.add_argument("--sync-period", type=float, default=0.0)
+    parser.add_argument("--config-namespace", default="koordinator-system")
+    parser.add_argument("--slo-config-name", default="slo-controller-config")
+    return parser
+
+
+def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
+    import types
+
+    from koordinator_tpu.features import SCHEDULER_GATES  # manager+scheduler
+    from koordinator_tpu.manager.nodemetric import NodeMetricController
+    from koordinator_tpu.manager.nodeslo import NodeSLOController
+    from koordinator_tpu.manager.noderesource_controller import (
+        NodeResourceController,
+    )
+    from koordinator_tpu.manager.webhook import (
+        PodMutatingWebhook,
+        PodValidatingWebhook,
+    )
+
+    args = build_manager_parser().parse_args(argv)
+    apply_feature_gates(args.feature_gates, SCHEDULER_GATES)
+    component = types.SimpleNamespace(
+        nodemetric=NodeMetricController(),
+        nodeslo=NodeSLOController(),
+        noderesource=NodeResourceController(),
+        pod_mutating=PodMutatingWebhook(),
+        pod_validating=PodValidatingWebhook(),
+    )
+    return Assembled(name="koord-manager", args=args, component=component,
+                     elector=build_elector(args, lease_store))
+
+
+# ---- koord-descheduler -----------------------------------------------------
+
+def build_descheduler_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="koord-descheduler")
+    add_common_flags(parser)
+    add_leader_election_flags(parser, default_lease="koord-descheduler")
+    parser.add_argument("--descheduling-interval-seconds", type=float,
+                        default=120.0)
+    parser.add_argument("--max-evictions-per-round", type=int, default=0)
+    parser.add_argument("--evict-system-critical", action="store_true")
+    parser.add_argument("--evict-local-storage-pods", action="store_true")
+    parser.add_argument("--priority-threshold", type=int, default=None)
+    return parser
+
+
+def main_koord_descheduler(argv: list[str], pods_fn=None,
+                           lease_store=None) -> Assembled:
+    from koordinator_tpu.descheduler.framework import (
+        Descheduler,
+        Evictor,
+        EvictorFilter,
+        Profile,
+    )
+
+    args = build_descheduler_parser().parse_args(argv)
+    evictor_filter = EvictorFilter(
+        evict_system_critical=args.evict_system_critical,
+        evict_local_storage=args.evict_local_storage_pods,
+        priority_threshold=args.priority_threshold,
+    )
+    profile = Profile(
+        name="default",
+        evictor_filter=evictor_filter,
+        evictor=Evictor(),
+        max_evictions_per_round=args.max_evictions_per_round,
+    )
+    elector = build_elector(args, lease_store)
+    descheduler = Descheduler(
+        [profile], pods_fn=pods_fn or (lambda: []),
+        interval_seconds=args.descheduling_interval_seconds,
+        elector=elector,
+    )
+    return Assembled(name="koord-descheduler", args=args,
+                     component=descheduler, elector=elector)
+
+
+# ---- koord-runtime-proxy ---------------------------------------------------
+
+def build_runtime_proxy_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="koord-runtime-proxy")
+    add_common_flags(parser)
+    parser.add_argument("--remote-runtime-service-endpoint",
+                        default="/var/run/containerd/containerd.sock")
+    parser.add_argument("--koord-runtime-proxy-endpoint",
+                        default="/var/run/koord-runtimeproxy/runtimeproxy.sock")
+    parser.add_argument("--hook-server-socket", default="",
+                        help="serve the hook dispatch over this unix socket")
+    return parser
+
+
+def main_koord_runtime_proxy(argv: list[str],
+                             backend: dict | None = None) -> Assembled:
+    from koordinator_tpu.runtimeproxy import CRIProxy, Dispatcher, FailoverStore
+
+    args = build_runtime_proxy_parser().parse_args(argv)
+    dispatcher = Dispatcher()
+    store = FailoverStore()
+    proxy = CRIProxy(dispatcher, store, backend or {})
+    server = None
+    if args.hook_server_socket:
+        from koordinator_tpu.transport import RpcServer
+        from koordinator_tpu.transport.services import HookService
+
+        server = RpcServer(args.hook_server_socket)
+        HookService(dispatcher).attach(server)
+        server.start()
+    return Assembled(name="koord-runtime-proxy", args=args, component=proxy,
+                     server=server)
+
+
+# ---- koord-device-daemon ---------------------------------------------------
+
+def build_device_daemon_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="koord-device-daemon")
+    add_common_flags(parser)
+    parser.add_argument("--node-name", required=True)
+    parser.add_argument("--sys-root-dir", default="/sys")
+    parser.add_argument("--report-interval-seconds", type=float, default=30.0)
+    return parser
+
+
+def main_koord_device_daemon(argv: list[str]) -> Assembled:
+    from koordinator_tpu.device_daemon import DeviceDaemon
+
+    args = build_device_daemon_parser().parse_args(argv)
+    daemon = DeviceDaemon(node_name=args.node_name,
+                          sys_root=args.sys_root_dir)
+    return Assembled(name="koord-device-daemon", args=args, component=daemon)
+
+
+MAINS = {
+    "koordlet": main_koordlet,
+    "koord-scheduler": main_koord_scheduler,
+    "koord-manager": main_koord_manager,
+    "koord-descheduler": main_koord_descheduler,
+    "koord-runtime-proxy": main_koord_runtime_proxy,
+    "koord-device-daemon": main_koord_device_daemon,
+}
