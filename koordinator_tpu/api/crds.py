@@ -62,6 +62,9 @@ class NodeMetricStatus:
     system_usage: ResourceUsage = ResourceUsage()
     aggregated_node_usage: Optional[AggregatedUsage] = None
     pods_metrics: Tuple[PodMetricInfo, ...] = ()
+    #: collectors went silent past the expiration budget — consumers must
+    #: treat usage as unknown (nodemetric "expired" condition)
+    degraded: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -139,16 +139,19 @@ class StatesInformer:
     # -- NodeMetric reporting -------------------------------------------------
 
     def build_node_metric(self, window_seconds: float = 300.0,
-                          report_percentiles: bool = True):
+                          report_percentiles: bool = True,
+                          now: float | None = None):
         """Aggregate the metric cache into a NodeMetric status
         (states_nodemetric.go sync loop). Returns api.crds.NodeMetricStatus.
-        """
+        ``now`` lets a caller with its own clock (the reporter) keep the
+        window and the freshness check on one timeline."""
         from koordinator_tpu.api.crds import (
             AggregatedUsage, NodeMetricStatus, PodMetricInfo, ResourceUsage,
         )
 
         assert self.metric_cache is not None, "metric cache required"
-        now = self._clock()
+        if now is None:
+            now = self._clock()
         start = now - window_seconds
 
         def usage_of(metric_cpu, metric_mem, labels=None) -> ResourceUsage:
@@ -194,3 +197,162 @@ class StatesInformer:
             aggregated_node_usage=aggregated,
             pods_metrics=tuple(pods_metrics),
         )
+
+
+# ---- pluggable informer registry (impl/states_informer.go) -----------------
+
+class InformerPlugin:
+    """One state source (impl/states_*.go shape): ``sync`` pulls its state
+    into the shared StatesInformer; ``depends`` names plugins whose first
+    sync must land earlier (the reference starts informers in dependency
+    order — e.g. the pods informer needs the node first for filtering)."""
+
+    name = "informer"
+    depends: tuple[str, ...] = ()
+
+    def sync(self, states: "StatesInformer") -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class InformerRegistry:
+    """Owns plugins, topologically orders them, drives sync rounds."""
+
+    def __init__(self) -> None:
+        self._plugins: dict[str, InformerPlugin] = {}
+        self.sync_errors: dict[str, str] = {}
+
+    def register(self, plugin: InformerPlugin) -> None:
+        if plugin.name in self._plugins:
+            raise ValueError(f"informer {plugin.name!r} already registered")
+        self._plugins[plugin.name] = plugin
+
+    def ordered(self) -> list[InformerPlugin]:
+        """Dependency order (states_informer.go starts in listed order with
+        HasSynced gates; this is the same constraint as a topo sort)."""
+        seen: dict[str, int] = {}   # 0 = visiting, 1 = done
+        out: list[InformerPlugin] = []
+
+        def visit(name: str) -> None:
+            mark = seen.get(name)
+            if mark == 1:
+                return
+            if mark == 0:
+                raise ValueError(f"informer dependency cycle at {name!r}")
+            seen[name] = 0
+            plugin = self._plugins.get(name)
+            if plugin is None:
+                raise ValueError(f"unknown informer dependency {name!r}")
+            for dep in plugin.depends:
+                visit(dep)
+            seen[name] = 1
+            out.append(plugin)
+
+        for name in sorted(self._plugins):
+            visit(name)
+        return out
+
+    def sync_all(self, states: "StatesInformer") -> int:
+        """One sync round over every plugin in dependency order; a failing
+        plugin records its error and does not block the others (informer
+        callbacks are isolated in the reference too). Returns successes."""
+        try:
+            plugins = self.ordered()
+        except ValueError:
+            # a broken dependency declaration must not silence every other
+            # informer: drop plugins whose dep chains don't resolve, record
+            # their error, order the rest
+            plugins, resolved = [], set()
+            progressed = True
+            names = set(self._plugins)
+            while progressed:
+                progressed = False
+                for name in sorted(names - resolved):
+                    plugin = self._plugins[name]
+                    if all(d in resolved for d in plugin.depends
+                           if d in names) and all(
+                               d in names for d in plugin.depends):
+                        plugins.append(plugin)
+                        resolved.add(name)
+                        progressed = True
+            for name in sorted(names - resolved):
+                self.sync_errors[name] = "unresolved informer dependencies"
+        ok = 0
+        for plugin in plugins:
+            try:
+                plugin.sync(states)
+                self.sync_errors.pop(plugin.name, None)
+                ok += 1
+            except Exception as e:
+                self.sync_errors[plugin.name] = repr(e)
+        return ok
+
+
+class KubeletPodsInformer(InformerPlugin):
+    """impl/states_pods.go: pods come from the kubelet, not the apiserver."""
+
+    name = "pods"
+    depends = ("node",)
+
+    def __init__(self, stub) -> None:
+        self.stub = stub
+
+    def sync(self, states: "StatesInformer") -> None:
+        states.set_pods(self.stub.get_all_pods())
+
+
+class NodeMetricReporter:
+    """impl/states_nodemetric.go:206 — the sync worker: every
+    ``report_interval`` (pushed by the manager through the NodeMetric
+    spec), aggregate the window and report; when the metric cache has gone
+    silent past the expiration budget, report a DEGRADED status instead of
+    stale numbers (nodeMetric expired handling)."""
+
+    def __init__(self, states: StatesInformer,
+                 report_fn: Callable[[object], None],
+                 report_interval_seconds: float = 60.0,
+                 aggregate_window_seconds: float = 300.0,
+                 expire_seconds: float = 180.0,
+                 clock=time.time):
+        if states.metric_cache is None:
+            raise ValueError("NodeMetricReporter requires a StatesInformer "
+                             "with a metric cache")
+        self.states = states
+        self.report_fn = report_fn
+        self.report_interval_seconds = report_interval_seconds
+        self.aggregate_window_seconds = aggregate_window_seconds
+        self.expire_seconds = expire_seconds
+        self.clock = clock
+        self._last_report = float("-inf")   # first tick reports immediately
+        self.reports = 0
+        self.degraded_reports = 0
+
+    def update_spec(self, report_interval_seconds: float,
+                    aggregate_window_seconds: float) -> None:
+        """Manager pushed a new NodeMetric spec (collect policy)."""
+        self.report_interval_seconds = report_interval_seconds
+        self.aggregate_window_seconds = aggregate_window_seconds
+
+    def _fresh(self, now: float) -> bool:
+        cache = self.states.metric_cache
+        res = cache.query(mc.NODE_CPU_USAGE, None,
+                          now - self.expire_seconds, now)
+        return not res.empty
+
+    def tick(self) -> Optional[object]:
+        """Report when due; returns the reported status (or None)."""
+        now = self.clock()
+        if now - self._last_report < self.report_interval_seconds:
+            return None
+        self._last_report = now
+        if not self._fresh(now):
+            from koordinator_tpu.api.crds import NodeMetricStatus
+
+            status = NodeMetricStatus(update_time=now, degraded=True)
+            self.degraded_reports += 1
+        else:
+            status = self.states.build_node_metric(
+                window_seconds=self.aggregate_window_seconds, now=now)
+            self.reports += 1
+        self.report_fn(status)
+        self.states._fire(TYPE_NODE_METRIC, status)
+        return status

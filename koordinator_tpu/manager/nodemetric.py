@@ -51,7 +51,8 @@ class NodeMetricController:
     def is_expired(self, name: str) -> bool:
         """Stale beyond the update threshold (feeds degrade decisions)."""
         metric = self._metrics.get(name)
-        if metric is None or metric.status.update_time == 0:
+        if metric is None or metric.status.update_time == 0 \
+                or getattr(metric.status, "degraded", False):
             return True
         return (
             self.clock() - metric.status.update_time

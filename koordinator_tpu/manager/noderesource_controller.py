@@ -245,6 +245,8 @@ class NodeResourceController:
         resources (the reference's degrade mode)."""
         if record.metric is None:
             return True
+        if getattr(record.metric, "degraded", False):
+            return True  # koordlet reported collectors-silent explicitly
         age = now - record.metric.update_time
         return age > self.config.degrade_time_minutes * 60
 

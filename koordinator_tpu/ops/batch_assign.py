@@ -164,7 +164,23 @@ def batch_assign(
     scores, feasible = score_pods(state, pods, cfg)
     key = _ranked_scores(scores, feasible)
     k = min(k, key.shape[1])
-    cand_key, cand_node = jax.lax.top_k(key, k)        # (P, k)
+    if jax.default_backend() == "tpu" and k < key.shape[1]:
+        # TPU-optimized partial reduction. approx_max_k needs a float key
+        # exact within float32's 24-bit mantissa, so candidates are chosen
+        # by score (15 bits) + a 9-bit slice of the rotated tie-break; the
+        # exact 30-bit int keys are then gathered for in-round ordering.
+        # Candidate RECALL is approximate (~recall_target); acceptance
+        # still enforces fit and quota exactly. CPU keeps exact top_k so
+        # tests stay deterministic.
+        fkey = jnp.where(
+            key >= 0, ((key >> _TB_BITS) << 9 | (key & 511)).astype(
+                jnp.float32), -1.0)
+        _, cand_node = jax.lax.approx_max_k(
+            fkey, k, recall_target=0.95, aggregate_to_topk=True)
+        cand_node = cand_node.astype(jnp.int32)
+        cand_key = jnp.take_along_axis(key, cand_node, axis=1)
+    else:
+        cand_key, cand_node = jax.lax.top_k(key, k)    # (P, k)
     cand_valid = cand_key >= 0
 
     order = jnp.lexsort((jnp.arange(pods.capacity), -pods.priority))
@@ -215,10 +231,25 @@ def batch_assign(
         return _RoundCarry(
             requested=requested,
             assignments=jnp.where(accept, choice, c.assignments),
-            active=c.active & ~accept,
+            # free capacity and quota headroom only shrink within a solve,
+            # so a pod with no fitting admitted candidate now (act=False)
+            # can never gain one: drop it from active so the early-exit
+            # condition actually converges
+            active=act & ~accept,
             quota=new_quota,
         )
 
-    carry = jax.lax.fori_loop(0, rounds, round_body, carry)
+    # early-exit loop: most rounds converge long before the bound (pods
+    # either accept or run out of fitting candidates); the tail rounds are
+    # pure waste at the north-star shape
+    def cond(loop_carry):
+        i, c = loop_carry
+        return (i < rounds) & jnp.any(c.active)
+
+    def body(loop_carry):
+        i, c = loop_carry
+        return i + 1, round_body(i, c)
+
+    _, carry = jax.lax.while_loop(cond, body, (jnp.int32(0), carry))
     new_state = state.replace(node_requested=carry.requested)
     return carry.assignments, new_state, carry.quota

@@ -1,0 +1,130 @@
+"""Informer plugin registry + NodeMetric reporter loop
+(koordlet/statesinformer.py additions) vs impl/states_informer.go
+(dependency-ordered startup) and impl/states_nodemetric.go:206 (sync
+worker, spec-driven interval, expired handling)."""
+
+import pytest
+
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.statesinformer import (
+    InformerPlugin,
+    InformerRegistry,
+    KubeletPodsInformer,
+    NodeInfo,
+    NodeMetricReporter,
+    PodMeta,
+    StatesInformer,
+    TYPE_NODE_METRIC,
+)
+
+
+class Recorder(InformerPlugin):
+    def __init__(self, name, depends=(), log=None, fail=False):
+        self.name = name
+        self.depends = depends
+        self.log = log if log is not None else []
+        self.fail = fail
+
+    def sync(self, states):
+        if self.fail:
+            raise RuntimeError("informer broke")
+        self.log.append(self.name)
+
+
+def test_registry_orders_by_dependencies():
+    log = []
+    reg = InformerRegistry()
+    reg.register(Recorder("pods", depends=("node",), log=log))
+    reg.register(Recorder("nodemetric", depends=("pods",), log=log))
+    reg.register(Recorder("node", log=log))
+    reg.register(Recorder("device", log=log))
+    assert reg.sync_all(StatesInformer()) == 4
+    assert log.index("node") < log.index("pods") < log.index("nodemetric")
+
+
+def test_registry_rejects_cycles_and_unknown_deps():
+    reg = InformerRegistry()
+    reg.register(Recorder("a", depends=("b",)))
+    reg.register(Recorder("b", depends=("a",)))
+    with pytest.raises(ValueError, match="cycle"):
+        reg.ordered()
+    reg2 = InformerRegistry()
+    reg2.register(Recorder("a", depends=("ghost",)))
+    with pytest.raises(ValueError, match="unknown"):
+        reg2.ordered()
+
+
+def test_failing_informer_isolated():
+    log = []
+    reg = InformerRegistry()
+    reg.register(Recorder("node", log=log))
+    reg.register(Recorder("pods", depends=("node",), log=log, fail=True))
+    reg.register(Recorder("device", log=log))
+    assert reg.sync_all(StatesInformer()) == 2
+    assert "pods" in reg.sync_errors
+    assert log == ["device", "node"]   # alphabetical roots, pods failed
+    # recovery clears the error
+    reg._plugins["pods"].fail = False
+    reg.sync_all(StatesInformer())
+    assert "pods" not in reg.sync_errors
+
+
+def test_kubelet_pods_informer():
+    class Stub:
+        def get_all_pods(self):
+            return [PodMeta(uid="u1", name="p", namespace="d",
+                            qos_class=QoSClass.LS, kube_qos="burstable")]
+
+    states = StatesInformer()
+    states.set_node(NodeInfo(name="n1"))
+    informer = KubeletPodsInformer(Stub())
+    assert informer.depends == ("node",)
+    informer.sync(states)
+    assert states.get_pod("u1").name == "p"
+
+
+def mk_states(clock):
+    cache = mc.MetricCache(clock=clock)
+    states = StatesInformer(metric_cache=cache, clock=clock)
+    return states, cache
+
+
+def test_reporter_interval_and_spec_update():
+    t = [0.0]
+    states, cache = mk_states(lambda: t[0])
+    cache.append(mc.NODE_CPU_USAGE, 2.0, ts=0.0)
+    cache.append(mc.NODE_MEMORY_USAGE, 1 << 30, ts=0.0)
+    reports = []
+    rep = NodeMetricReporter(states, reports.append,
+                             report_interval_seconds=60, clock=lambda: t[0])
+    t[0] = 1.0
+    assert rep.tick() is not None        # first report
+    t[0] = 30.0
+    assert rep.tick() is None            # not due
+    rep.update_spec(report_interval_seconds=10,
+                    aggregate_window_seconds=120)
+    t[0] = 31.0
+    cache.append(mc.NODE_CPU_USAGE, 4.0, ts=31.0)
+    assert rep.tick() is not None        # manager shortened the interval
+    assert rep.reports == 2 and rep.degraded_reports == 0
+    assert reports[-1].node_usage.cpu_milli > 0
+
+
+def test_reporter_degrades_when_collectors_silent():
+    t = [0.0]
+    states, cache = mk_states(lambda: t[0])
+    cache.append(mc.NODE_CPU_USAGE, 2.0, ts=0.0)
+    cache.append(mc.NODE_MEMORY_USAGE, 1.0, ts=0.0)
+    fired = []
+    states.register_callback(TYPE_NODE_METRIC, fired.append)
+    rep = NodeMetricReporter(states, lambda s: None,
+                             report_interval_seconds=60,
+                             expire_seconds=180, clock=lambda: t[0])
+    t[0] = 10.0
+    assert rep.tick().degraded is False
+    t[0] = 500.0     # collectors silent for 490s > 180s budget
+    status = rep.tick()
+    assert status.degraded is True
+    assert rep.degraded_reports == 1
+    assert fired[-1] is status           # TYPE_NODE_METRIC callback fan-out
