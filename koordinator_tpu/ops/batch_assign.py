@@ -155,12 +155,32 @@ def batch_assign(
     quota: QuotaDeviceState | None = None,
     k: int = 32,
     rounds: int = 12,
+    fused_topk: bool = False,
 ):
     """Assign a pending batch in data-parallel propose/accept rounds.
 
     Same signature/returns as ``greedy_assign``: (assignments, new_state,
     new_quota).  assignments is (P,) int32, -1 = unassigned.
+
+    ``fused_topk=True`` computes the candidate stage with the Pallas
+    streaming kernel (ops/pallas_score.py — no (P, N) HBM materialization);
+    bit-exact with the exact-top_k path, factored batches only (dense
+    batches raise). Off-TPU the flag falls back to the XLA path — interpret
+    mode exists for parity tests (fused_score_topk(interpret=True)), not
+    for serving.
     """
+    if fused_topk:
+        if pods.selector_mask is None:
+            raise ValueError("fused_topk needs a factored batch "
+                             "(selector_mask); dense/hinted batches use "
+                             "the XLA path")
+        if jax.default_backend() == "tpu":
+            from koordinator_tpu.ops.pallas_score import fused_score_topk
+
+            k = min(k, state.capacity)
+            cand_key, cand_node = fused_score_topk(state, pods, cfg, k=k)
+            return _assign_rounds(state, pods, quota, cand_key, cand_node,
+                                  rounds)
     scores, feasible = score_pods(state, pods, cfg)
     key = _ranked_scores(scores, feasible)
     k = min(k, key.shape[1])
@@ -181,6 +201,11 @@ def batch_assign(
         cand_key = jnp.take_along_axis(key, cand_node, axis=1)
     else:
         cand_key, cand_node = jax.lax.top_k(key, k)    # (P, k)
+    return _assign_rounds(state, pods, quota, cand_key, cand_node, rounds)
+
+
+def _assign_rounds(state, pods, quota, cand_key, cand_node, rounds):
+    """The shared propose/accept stage over (P, k) candidates."""
     cand_valid = cand_key >= 0
 
     order = jnp.lexsort((jnp.arange(pods.capacity), -pods.priority))

@@ -1,0 +1,317 @@
+"""Fused Filter+Score+top-k as a Pallas TPU kernel.
+
+``batch_assign`` currently runs three XLA stages: ``score_pods`` (which
+materializes the (P, N) int32 score tensor to HBM — 2 GB at the north-star
+shape), ``_ranked_scores`` (another (P, N)), and ``lax.top_k``.  This kernel
+streams instead: each program owns a tile of pods, walks the node axis in
+VMEM-sized chunks, computes the ranked key for the chunk in registers, and
+folds it into a running per-pod top-k — the (P, N) intermediates never
+touch HBM, only the (P, k) winners do.
+
+Semantics are IDENTICAL to ``lax.top_k(_ranked_scores(*score_pods(...)), k)``
+(same scorer formulas, same integer floor-division trick, same rotated
+tie-break, same lowest-index-wins tie order) and are asserted bit-exact
+against that reference in tests/test_pallas_score.py via interpret mode.
+
+Layouts are transposed (R leading) so pods/nodes ride the 128-lane axis;
+R (=10) unrolls as python loops.  The selector-class feasibility gather
+``selector_mask[:, node_class]`` becomes a one-hot matmul on the MXU.
+
+Reference parity anchors are the same as ops/scoring.py (load_aware.go:347,
+node_resource_fit_plus_utils.go:58, scarce_resource_avoidance.go:89,
+load_aware.go:326 thresholds).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from koordinator_tpu.ops.assignment import ScoringConfig
+from koordinator_tpu.ops.batch_assign import _TB_BITS, _SCORE_CLIP
+from koordinator_tpu.ops.scoring import MAX_NODE_SCORE, exact_floordiv
+from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
+
+from koordinator_tpu.ops.filtering import MAX_SCALE
+
+def _floordiv(num, den, den_pos):
+    """exact_floordiv guarded for den<=0 rows (returns 0 there)."""
+    safe = jnp.maximum(den, 1)
+    return jnp.where(den_pos, exact_floordiv(jnp.maximum(num, 0), safe), 0)
+
+
+def _score_topk_kernel(
+    # pod tile refs (blocked over P)
+    podreq_ref,      # (R, TP) int32
+    podest_ref,      # (R, TP) int32
+    podvalid_ref,    # (1, TP) int32
+    sel_ref,         # (TP, C) int32 0/1
+    # full node refs
+    alloc_ref,       # (R, N) int32
+    reqd_ref,        # (R, N) int32
+    usage_ref,       # (R, N) int32
+    agg_ref,         # (R, N) int32
+    nvalid_ref,      # (1, N) int32
+    nclass_ref,      # (1, N) int32
+    # cfg refs
+    la_w_ref,        # (1, R) int32 loadaware weights
+    fp_w_ref,        # (1, R) int32 fitplus weights
+    fp_most_ref,     # (1, R) int32 bool
+    scarce_ref,      # (1, R) int32 bool
+    thr_ref,         # (1, R) int32 usage thresholds
+    agg_thr_ref,     # (1, R) int32 aggregated thresholds
+    scalars_ref,     # (1, 4) int32: [dominant_w, la_plugin_w, fp_plugin_w,
+                     #               scarce_plugin_w]
+    # outputs
+    out_val_ref,     # (TP, K) int32
+    out_idx_ref,     # (TP, K) int32
+    *,
+    n_chunk: int,
+    k: int,
+    r_dims: int,
+):
+    tp = podreq_ref.shape[1]
+    n = alloc_ref.shape[1]
+    tile = pl.program_id(0)
+
+    dom_w = scalars_ref[0, 0]
+    la_pw = scalars_ref[0, 1]
+    fp_pw = scalars_ref[0, 2]
+    sc_pw = scalars_ref[0, 3]
+    agg_enabled = jnp.any(agg_thr_ref[0, :] > 0)
+
+    pod_valid = podvalid_ref[0, :] > 0                    # (TP,)
+    # fitplus per-pod weight sum over requested dims (den), (TP,)
+    fp_den = jnp.zeros((tp,), jnp.int32)
+    la_wsum = jnp.int32(0)
+    for r in range(r_dims):
+        fp_den = fp_den + jnp.where(podreq_ref[r, :] > 0, fp_w_ref[0, r], 0)
+        la_wsum = la_wsum + la_w_ref[0, r]
+    la_den = la_wsum + dom_w                              # scalar
+    sel = sel_ref[:, :].astype(jnp.float32)               # (TP, C)
+    c_cap = sel.shape[1]
+
+    # rotated tie-break offsets for this tile's global pod rows
+    pod_ids = tile * tp + jax.lax.broadcasted_iota(jnp.int32, (tp, 1), 0)
+    rot = pod_ids * 7919                                  # (TP, 1)
+
+    run_val = jnp.full((tp, k), -1, jnp.int32)
+    # sentinel indices are UNIQUE negatives: the extract-max fold removes
+    # exactly one column per pass (equal (val, idx) pairs would be wiped
+    # together, collapsing the pool into -2s); sanitized to 0 on output
+    run_idx = -1 - jax.lax.broadcasted_iota(jnp.int32, (tp, k), 1)
+
+    for c0 in range(0, n, n_chunk):
+        cols = slice(c0, c0 + n_chunk)
+        nvalid = nvalid_ref[0, cols] > 0                  # (NC,)
+
+        la_num = jnp.zeros((tp, n_chunk), jnp.int32)
+        dominant = jnp.full((tp, n_chunk), MAX_NODE_SCORE, jnp.int32)
+        fp_num = jnp.zeros((tp, n_chunk), jnp.int32)
+        n_diff = jnp.zeros((tp, n_chunk), jnp.int32)
+        n_inter = jnp.zeros((tp, n_chunk), jnp.int32)
+        fits = jnp.ones((tp, n_chunk), bool)
+        inst_exceeded = jnp.zeros((tp, n_chunk), bool)
+        agg_exceeded = jnp.zeros((tp, n_chunk), bool)
+
+        for r in range(r_dims):
+            alloc = alloc_ref[r, cols][None, :]           # (1, NC)
+            reqd = reqd_ref[r, cols][None, :]
+            usage = usage_ref[r, cols][None, :]
+            agg = agg_ref[r, cols][None, :]
+            podreq = podreq_ref[r, :][:, None]            # (TP, 1)
+            podest = podest_ref[r, :][:, None]
+            alloc_pos = alloc > 0
+
+            # -- loadaware (load_aware.go:347) ---------------------------
+            used = usage + podest                         # (TP, NC)
+            ls_ok = alloc_pos & (used <= alloc)
+            ls = jnp.where(
+                ls_ok,
+                _floordiv((alloc - used) * MAX_NODE_SCORE, alloc, alloc_pos),
+                0)
+            la_num = la_num + ls * la_w_ref[0, r]
+            configured = la_w_ref[0, r] > 0
+            dominant = jnp.where(
+                configured, jnp.minimum(dominant, ls), dominant)
+
+            # -- fitplus (node_resource_fit_plus_utils.go:58) ------------
+            combined = reqd + podreq
+            least = jnp.where(
+                alloc_pos & (combined <= alloc),
+                _floordiv((alloc - combined) * MAX_NODE_SCORE, alloc,
+                          alloc_pos),
+                0)
+            most = _floordiv(jnp.minimum(combined, alloc) * MAX_NODE_SCORE,
+                             alloc, alloc_pos)
+            per_res = jnp.where(fp_most_ref[0, r] > 0, most, least)
+            w_eff = jnp.where(podreq > 0, fp_w_ref[0, r], 0)   # (TP, 1)
+            fp_num = fp_num + per_res * w_eff
+
+            # -- scarce (scarce_resource_avoidance.go:89) ----------------
+            diff = alloc_pos & (podreq == 0)
+            n_diff = n_diff + diff
+            n_inter = n_inter + (diff & (scarce_ref[0, r] > 0))
+
+            # -- fit filter ----------------------------------------------
+            free = jnp.where(nvalid[None, :], alloc - reqd, 0)
+            fits = fits & ((podreq <= free) | (podreq == 0))
+
+            # -- usage thresholds (load_aware.go:326 round-half-up) ------
+            a_inst = MAX_SCALE * used + alloc // 2
+            inst_exceeded = inst_exceeded | (
+                (thr_ref[0, r] > 0) & alloc_pos
+                & (a_inst >= (thr_ref[0, r] + 1) * alloc))
+            a_agg = MAX_SCALE * (agg + podest) + alloc // 2
+            agg_exceeded = agg_exceeded | (
+                (agg_thr_ref[0, r] > 0) & alloc_pos
+                & (a_agg >= (agg_thr_ref[0, r] + 1) * alloc))
+
+        la = _floordiv(la_num + dominant * dom_w, la_den, la_den > 0)
+        fp = jnp.where(
+            fp_den[:, None] > 0,
+            _floordiv(fp_num, fp_den[:, None], fp_den[:, None] > 0),
+            MAX_NODE_SCORE)
+        sc = jnp.where(
+            (n_diff == 0) | (n_inter == 0),
+            MAX_NODE_SCORE,
+            _floordiv((n_diff - n_inter) * MAX_NODE_SCORE, n_diff,
+                      n_diff > 0))
+        scores = la * la_pw + fp * fp_pw + sc * sc_pw
+
+        # selector-class feasibility: sel (TP, C) x one-hot(class) (C, NC)
+        cls = nclass_ref[0, cols]                         # (NC,)
+        in_range = cls < c_cap
+        cls_safe = jnp.minimum(cls, c_cap - 1)
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, (c_cap, n_chunk), 0)
+                  == cls_safe[None, :]).astype(jnp.float32)
+        sel_ok = (jax.lax.dot_general(
+            sel, onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) > 0.5)    # (TP, NC)
+        sel_ok = sel_ok & in_range[None, :]
+
+        thr_ok = jnp.where(agg_enabled, ~agg_exceeded, ~inst_exceeded)
+        feasible = (fits & thr_ok & sel_ok & nvalid[None, :]
+                    & pod_valid[:, None])
+
+        # ranked key (_ranked_scores): score high bits | rotated tie-break
+        node_idx = c0 + jax.lax.broadcasted_iota(
+            jnp.int32, (tp, n_chunk), 1)                  # (TP, NC)
+        tb = (n - 1) - ((node_idx - rot) % n)
+        key = (jnp.clip(scores, 0, _SCORE_CLIP) << _TB_BITS) | tb
+        key = jnp.where(feasible, key, -1)
+
+        # fold the chunk into the running top-k: k extract-max passes over
+        # the (TP, K + NC) concat; ties resolve to the lowest node index,
+        # matching lax.top_k
+        cat_val = jnp.concatenate([run_val, key], axis=1)
+        cat_idx = jnp.concatenate([run_idx, node_idx], axis=1)
+        new_val = []
+        new_idx = []
+        for _ in range(k):
+            m = jnp.max(cat_val, axis=1)                  # (TP,)
+            is_m = cat_val == m[:, None]
+            # lowest node index among maxima (for -1 sentinels index is
+            # irrelevant)
+            pick_idx = jnp.min(
+                jnp.where(is_m, cat_idx, 1 << 30), axis=1)
+            new_val.append(m)
+            new_idx.append(pick_idx)   # may be a negative sentinel
+            taken = is_m & (cat_idx == pick_idx[:, None])
+            cat_val = jnp.where(taken, -2, cat_val)
+        run_val = jnp.stack(new_val, axis=1)
+        run_idx = jnp.stack(new_idx, axis=1)
+
+    out_val_ref[:, :] = run_val
+    out_idx_ref[:, :] = jnp.where(run_val < 0, 0, run_idx)
+
+
+def fused_score_topk(
+    state: ClusterState,
+    pods: PodBatch,
+    cfg: ScoringConfig,
+    k: int = 32,
+    tile_pods: int = 128,
+    n_chunk: int = 512,
+    interpret: bool = False,
+):
+    """(cand_key, cand_node) — bit-exact equivalent of
+    ``lax.top_k(_ranked_scores(*score_pods(state, pods, cfg)), k)`` without
+    the (P, N) HBM round-trips.  Factored (selector_mask) batches only."""
+    from koordinator_tpu.ops import scoring
+
+    if pods.selector_mask is None:
+        raise ValueError("fused_score_topk needs a factored batch "
+                         "(selector_mask); dense/hinted batches use the "
+                         "XLA path")
+    p = pods.capacity
+    n = state.capacity
+    r = pods.requests.shape[1]
+    tp = min(tile_pods, p)
+    nc = min(n_chunk, n)
+    if p % tp or n % nc:
+        raise ValueError(f"capacities ({p}, {n}) must tile by ({tp}, {nc})")
+
+    pod_est = scoring.estimate_pod_usage_by_band(
+        pods.requests, cfg.estimator_factors, cfg.estimator_defaults)
+
+    scalars = jnp.stack([
+        jnp.asarray(cfg.loadaware_dominant_weight, jnp.int32),
+        jnp.asarray(cfg.loadaware_plugin_weight, jnp.int32),
+        jnp.asarray(cfg.fitplus_plugin_weight, jnp.int32),
+        jnp.asarray(cfg.scarce_plugin_weight, jnp.int32),
+    ])[None, :]
+
+    grid = (p // tp,)
+    pod_spec = pl.BlockSpec((r, tp), lambda i: (0, i),
+                            memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, tp), lambda i: (0, i),
+                            memory_space=pltpu.VMEM)
+    sel_spec = pl.BlockSpec((tp, pods.selector_mask.shape[1]),
+                            lambda i: (i, 0), memory_space=pltpu.VMEM)
+    full = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0),
+                                      memory_space=pltpu.VMEM)
+
+    kernel = functools.partial(
+        _score_topk_kernel, n_chunk=nc, k=k, r_dims=r)
+    out_val, out_idx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pod_spec, pod_spec, row_spec, sel_spec,
+            full((r, n)), full((r, n)), full((r, n)), full((r, n)),
+            full((1, n)), full((1, n)),
+            full((1, r)), full((1, r)), full((1, r)), full((1, r)),
+            full((1, r)), full((1, r)), full((1, 4)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tp, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tp, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((p, k), jnp.int32),
+            jax.ShapeDtypeStruct((p, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        pods.requests.T, pod_est.T, pods.valid[None, :].astype(jnp.int32),
+        pods.selector_mask.astype(jnp.int32),
+        state.node_allocatable.T, state.node_requested.T,
+        state.node_usage.T, state.node_agg_usage.T,
+        state.node_valid[None, :].astype(jnp.int32),
+        state.node_class[None, :],
+        cfg.loadaware_resource_weights[None, :],
+        cfg.fitplus_resource_weights[None, :],
+        cfg.fitplus_most_allocated[None, :].astype(jnp.int32),
+        cfg.scarce_dims[None, :].astype(jnp.int32),
+        cfg.usage_thresholds[None, :],
+        cfg.agg_usage_thresholds[None, :],
+        scalars,
+    )
+    return out_val, out_idx

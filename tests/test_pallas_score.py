@@ -1,0 +1,164 @@
+"""Pallas fused Filter+Score+top-k (ops/pallas_score.py) vs the XLA
+reference path — bit-exact value parity with
+lax.top_k(_ranked_scores(*score_pods(...)), k) in interpret mode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+from koordinator_tpu.ops.assignment import ScoringConfig, score_pods
+from koordinator_tpu.ops.batch_assign import _ranked_scores
+from koordinator_tpu.ops.pallas_score import fused_score_topk
+from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
+
+R = NUM_RESOURCE_DIMS
+CPU, MEM, GPU = ResourceDim.CPU, ResourceDim.MEMORY, ResourceDim.GPU
+
+
+def reference_topk(state, pods, cfg, k):
+    scores, feasible = score_pods(state, pods, cfg)
+    return jax.lax.top_k(_ranked_scores(scores, feasible), k)
+
+
+def build_problem(n_nodes=64, n_pods=128, seed=0, classes=3,
+                  invalid_tail=0):
+    rng = np.random.default_rng(seed)
+    alloc = np.zeros((n_nodes, R), np.int32)
+    alloc[:, CPU] = rng.integers(8_000, 64_000, n_nodes)
+    alloc[:, MEM] = rng.integers(16_384, 262_144, n_nodes)
+    alloc[:, GPU] = rng.integers(0, 2, n_nodes) * 8_000
+    usage = (alloc * rng.random((n_nodes, R)) * 0.6).astype(np.int32)
+    requested = (alloc * rng.random((n_nodes, R)) * 0.5).astype(np.int32)
+    node_class = rng.integers(0, classes, n_nodes).astype(np.int32)
+    if invalid_tail:
+        alloc[-invalid_tail:] = 0
+    state = ClusterState.from_arrays(
+        alloc, requested=requested, usage=usage, capacity=n_nodes,
+        node_class=node_class)
+    if invalid_tail:
+        valid = np.ones(n_nodes, bool)
+        valid[-invalid_tail:] = False
+        state = state.replace(node_valid=jnp.asarray(valid))
+
+    req = np.zeros((n_pods, R), np.int32)
+    req[:, CPU] = rng.integers(100, 4_000, n_pods)
+    req[:, MEM] = rng.integers(128, 8_192, n_pods)
+    req[rng.random(n_pods) < 0.2, GPU] = 1_000
+    sel = rng.random((n_pods, 8)) < 0.7          # (P, C) selector classes
+    sel[:, :classes] |= rng.random((n_pods, classes)) < 0.5
+    cap = 1 << (n_pods - 1).bit_length()     # power-of-two padding
+    pods = PodBatch.build(
+        req, priority=rng.integers(3000, 9999, n_pods).astype(np.int32),
+        node_capacity=n_nodes, capacity=cap,
+        selector_mask=sel, class_capacity=8)
+    return state, pods
+
+
+def assert_parity(state, pods, cfg, k=16, tp=32, nc=32):
+    got_val, got_idx = fused_score_topk(
+        state, pods, cfg, k=k, tile_pods=tp, n_chunk=nc, interpret=True)
+    want_val, want_idx = reference_topk(state, pods, cfg, k)
+    np.testing.assert_array_equal(np.asarray(got_val), np.asarray(want_val))
+    valid = np.asarray(want_val) >= 0
+    np.testing.assert_array_equal(np.asarray(got_idx)[valid],
+                                  np.asarray(want_idx)[valid])
+
+
+def test_parity_default_config():
+    state, pods = build_problem(seed=1)
+    assert_parity(state, pods, ScoringConfig.default())
+
+
+def test_parity_with_thresholds_and_invalid_nodes():
+    state, pods = build_problem(seed=2, invalid_tail=8)
+    cfg = ScoringConfig.default().replace(
+        usage_thresholds=jnp.zeros(R, jnp.int32).at[CPU].set(65)
+        .at[MEM].set(80))
+    assert_parity(state, pods, cfg)
+
+
+def test_parity_aggregated_thresholds_replace_instantaneous():
+    state, pods = build_problem(seed=3)
+    cfg = ScoringConfig.default().replace(
+        usage_thresholds=jnp.zeros(R, jnp.int32).at[CPU].set(10),  # strict
+        agg_usage_thresholds=jnp.zeros(R, jnp.int32).at[CPU].set(90))
+    assert_parity(state, pods, cfg)
+
+
+def test_parity_fitplus_most_allocated_and_scarce():
+    state, pods = build_problem(seed=4)
+    cfg = ScoringConfig.default().replace(
+        fitplus_most_allocated=jnp.zeros(R, bool).at[CPU].set(True),
+        scarce_dims=jnp.zeros(R, bool).at[GPU].set(True),
+        scarce_plugin_weight=jnp.int32(2),
+        loadaware_dominant_weight=jnp.int32(1),
+    )
+    assert_parity(state, pods, cfg)
+
+
+def test_parity_uneven_tiling_and_k():
+    state, pods = build_problem(n_nodes=128, n_pods=64, seed=5)
+    assert_parity(state, pods, ScoringConfig.default(), k=32, tp=16, nc=64)
+
+
+def test_parity_invalid_pods_padding():
+    # PodBatch.build pads capacity; padded rows are invalid and must come
+    # back all -1
+    state, pods = build_problem(n_pods=100, seed=6)  # padded to 128
+    got_val, _ = fused_score_topk(
+        state, pods, ScoringConfig.default(), k=8, tile_pods=32,
+        n_chunk=32, interpret=True)
+    assert np.all(np.asarray(got_val)[100:] == -1)
+    assert_parity(state, pods, ScoringConfig.default(), k=8, tp=32, nc=32)
+
+
+def test_rejects_dense_batches():
+    state, pods = build_problem(seed=7)
+    dense = pods.replace(
+        feasible=jnp.ones((pods.capacity, state.capacity), bool),
+        selector_mask=None)
+    with pytest.raises(ValueError, match="factored"):
+        fused_score_topk(state, dense, ScoringConfig.default(),
+                         interpret=True)
+
+
+def test_assign_rounds_on_fused_candidates_matches_default():
+    # end-to-end: the pallas candidates (interpret mode off-TPU) drive the
+    # shared propose/accept stage to the same assignments as the XLA path
+    from koordinator_tpu.ops.batch_assign import _assign_rounds, batch_assign
+
+    state, pods = build_problem(n_nodes=64, n_pods=64, seed=8)
+    cfg = ScoringConfig.default()
+    a0, s0, _ = batch_assign(state, pods, cfg, k=16)
+    ck, cn = fused_score_topk(state, pods, cfg, k=16, tile_pods=32,
+                              n_chunk=32, interpret=True)
+    a1, s1, _ = _assign_rounds(state, pods, None, ck, cn, rounds=12)
+    np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
+    np.testing.assert_array_equal(np.asarray(s0.node_requested),
+                                  np.asarray(s1.node_requested))
+
+
+def test_sentinel_pool_survives_large_k_over_small_chunks():
+    # k bigger than the chunk width with an all-infeasible first chunk:
+    # the unique-sentinel fold must still emit -1 fills, never -2
+    state, pods = build_problem(n_nodes=64, n_pods=32, seed=9)
+    none_sel = pods.replace(
+        selector_mask=jnp.zeros_like(pods.selector_mask))  # nothing feasible
+    val, idx = fused_score_topk(state, none_sel, ScoringConfig.default(),
+                                k=48, tile_pods=32, n_chunk=16,
+                                interpret=True)
+    assert np.all(np.asarray(val) == -1)
+    assert np.all(np.asarray(idx) == 0)
+
+
+def test_batch_assign_fused_topk_rejects_dense():
+    from koordinator_tpu.ops.batch_assign import batch_assign
+
+    state, pods = build_problem(seed=10)
+    dense = pods.replace(
+        feasible=jnp.ones((pods.capacity, state.capacity), bool),
+        selector_mask=None)
+    with pytest.raises(ValueError, match="factored"):
+        batch_assign(state, dense, ScoringConfig.default(), fused_topk=True)
