@@ -275,3 +275,68 @@ class TestScoreDumpWired:
         scheduler.schedule_round()
         status, diag = service.handle("/apis/v1/diagnosis")
         assert diag == {}
+
+
+class TestSidecarDeployment:
+    """The deployment shape end to end: both binaries assembled from CLI
+    flags, state flowing over the wire (snapshot + deltas), rounds driven
+    by solve RPCs — the full SURVEY §7 step 4 composition."""
+
+    def test_colocation_over_the_wire(self, tmp_path):
+        from tests.test_transport import wait_until
+
+        from koordinator_tpu.cmd.binaries import main_koord_scheduler
+        from koordinator_tpu.transport import (
+            RpcClient, StateSyncClient, StateSyncService)
+        from koordinator_tpu.transport.deltasync import SchedulerBinding
+        from koordinator_tpu.transport.services import solve_remote
+
+        # scheduler binary: socket + solve service from flags
+        out = main_koord_scheduler([
+            "--node-capacity", "16",
+            "--listen-socket", str(tmp_path / "sched.sock"),
+            "--disable-leader-election",
+        ])
+        try:
+            scheduler = out.component
+            # the shell side: informer state authority on the same server
+            service = StateSyncService()
+            service.attach(out.server)
+
+            sync = StateSyncClient(SchedulerBinding(scheduler))
+            client = RpcClient(out.server.path, on_push=sync.on_push)
+            client.connect()
+            sync.bootstrap(client)
+
+            # manager computed batch capacity -> node carries batch dims
+            service.upsert_node("n0", resource_vector({
+                "cpu": 16_000, "memory": 32_768,
+                ext.RESOURCE_BATCH_CPU: 9_000,
+                ext.RESOURCE_BATCH_MEMORY: 20_000,
+            }))
+            # webhook-translated BE pod requests batch resources
+            service.add_pod("spark-1", resource_vector({
+                ext.RESOURCE_BATCH_CPU: 2_000,
+                ext.RESOURCE_BATCH_MEMORY: 4_000,
+            }), priority=5_500)
+
+            wait_until(lambda: sync.rv == service.rv)
+            result = solve_remote(client)
+            assert result["assignments"] == {"spark-1": "n0"}
+
+            # batch capacity revoked (load rose): next BE pod fails with a
+            # structured reason served over the same wire
+            service.upsert_node("n0", resource_vector({
+                "cpu": 16_000, "memory": 32_768,
+                ext.RESOURCE_BATCH_CPU: 0,
+            }))
+            service.add_pod("spark-2", resource_vector({
+                ext.RESOURCE_BATCH_CPU: 2_000,
+            }), priority=5_500)
+            wait_until(lambda: sync.rv == service.rv)
+            result = solve_remote(client)
+            assert "spark-2" in result["failures"]
+            assert "insufficient" in result["failures"]["spark-2"]
+        finally:
+            client.close()
+            out.server.stop()
