@@ -297,6 +297,7 @@ class TestSidecarDeployment:
             "--listen-socket", str(tmp_path / "sched.sock"),
             "--disable-leader-election",
         ])
+        client = None
         try:
             scheduler = out.component
             # the shell side: informer state authority on the same server
@@ -338,5 +339,6 @@ class TestSidecarDeployment:
             assert "spark-2" in result["failures"]
             assert "insufficient" in result["failures"]["spark-2"]
         finally:
-            client.close()
+            if client is not None:
+                client.close()
             out.server.stop()
