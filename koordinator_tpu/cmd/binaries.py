@@ -80,6 +80,10 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
     parser.add_argument("--node-capacity", type=int, default=1024,
                         help="initial padded node-state capacity")
     parser.add_argument("--gang-passes", type=int, default=2)
+    parser.add_argument("--batch-solver-threshold", type=int, default=1024,
+                        help="queue size at which rounds switch from the "
+                             "exact greedy scan to the data-parallel "
+                             "propose/accept engine")
     parser.add_argument("--enable-preemption", action="store_true")
     parser.add_argument("--sync-barrier-timeout", type=float, default=30.0,
                         help="app/sync_barrier.go wait budget")
@@ -104,6 +108,7 @@ def main_koord_scheduler(argv: list[str],
     scheduler = Scheduler(
         snapshot,
         gang_passes=args.gang_passes,
+        batch_solver_threshold=args.batch_solver_threshold,
         enable_preemption=args.enable_preemption or None,
         explanations=ExplanationStore(),
         auditor=WorkloadAuditor(),

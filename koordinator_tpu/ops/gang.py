@@ -138,14 +138,27 @@ def gang_assign(
     gangs: GangInfo,
     quota=None,
     passes: int = 2,
+    solver: str = "greedy",
 ):
     """Batch assignment with gang all-or-nothing semantics.
 
     Returns (assignments, state, quota) as :func:`greedy_assign` does (quota
     is None when not given). ``passes`` > 1 re-solves leftover pods after
     failed-gang rollback so freed capacity is reclaimed within the batch.
+
+    ``solver`` picks the per-pass assignment engine: ``"greedy"`` is the
+    exact sequential scan (per-pod capacity feedback, strict priority
+    order); ``"batch"`` is the data-parallel propose/accept solve
+    (ops/batch_assign.py) — the throughput path for large queues, with
+    round-granular feedback and top-k candidate restriction. Gang
+    rollback/all-or-nothing semantics are identical either way (they act
+    on the assignment vector).
     """
     from koordinator_tpu.ops import scoring
+    from koordinator_tpu.ops.batch_assign import batch_assign
+
+    if solver not in ("greedy", "batch"):
+        raise ValueError(f"unknown solver {solver!r}")
 
     pre_ok = pre_enqueue_mask(pods, gangs)
     active_pods = pods.replace(valid=pods.valid & pre_ok)
@@ -167,7 +180,10 @@ def gang_assign(
             node_usage=cur_state.node_usage + est_accum,
             node_agg_usage=cur_state.node_agg_usage + est_accum,
         )
-        a, _, _ = greedy_assign(solve_state, active_pods, cfg, cur_quota)
+        if solver == "batch":
+            a, _, _ = batch_assign(solve_state, active_pods, cfg, cur_quota)
+        else:
+            a, _, _ = greedy_assign(solve_state, active_pods, cfg, cur_quota)
 
         final, cur_state, keep, failed = rollback_failed_gangs(
             a, cur_state, active_pods, gangs, prior_kept=kept_so_far

@@ -109,6 +109,7 @@ class Scheduler:
         bind_fn=None,
         monitor: SchedulerMonitor | None = None,
         gang_passes: int = 2,
+        batch_solver_threshold: int = 1024,
         clock=time.monotonic,
         topology_tree: TopologyArrays | None = None,
         barrier=None,
@@ -125,6 +126,11 @@ class Scheduler:
         self.bind_fn = bind_fn
         self.monitor = monitor or SchedulerMonitor()
         self.gang_passes = gang_passes
+        #: queues at or above this size solve with the data-parallel
+        #: propose/accept engine instead of the exact sequential scan
+        #: (ops/gang.py solver param) — exact for interactive queue sizes,
+        #: batch-parallel at scale
+        self.batch_solver_threshold = batch_solver_threshold
         self.clock = clock
         self.topology_tree = topology_tree
 
@@ -141,6 +147,8 @@ class Scheduler:
         #: explanation.WorkloadAuditor — per-pod/gang lifecycle records
         self.auditor = auditor
         self.last_result = SchedulingResult({}, {}, 0)
+        #: which solve engine the last round used ("greedy"/"batch")
+        self.last_solver = "greedy"
         #: serializes rounds against informer-driven mutations — the
         #: transport layer applies watch pushes from a reader thread while
         #: solve RPCs run rounds (the reference relies on the upstream
@@ -154,7 +162,8 @@ class Scheduler:
         self._pending_rev = 0
         self._batch_cache: tuple[tuple, PodBatch] | None = None
         self.batch_rebuilds = 0
-        self._solve = jax.jit(gang_assign, static_argnames=("passes",))
+        self._solve = jax.jit(gang_assign,
+                              static_argnames=("passes", "solver"))
 
         # -- preemption (PostFilter) state --
         # default: only preempt when someone is wired to actually evict the
@@ -450,11 +459,30 @@ class Scheduler:
             batch = self._apply_topology_plans(batch, gang_index)
 
         with self.monitor.phase("Solve"):
+            solver = ("batch" if len(pods) >= self.batch_solver_threshold
+                      else "greedy")
+            self.last_solver = solver
             assignments, new_state, new_quota = self._solve(
                 self.snapshot.state, batch, self.config, gangs, quota,
-                passes=self.gang_passes,
+                passes=self.gang_passes, solver=solver,
             )
             a = np.asarray(assignments)
+            if solver == "batch" and bool((a[: len(pods)] < 0).any()):
+                # exact rescue pass over the leftovers: the batch engine's
+                # top-k/round approximation may fail pods a greedy scan
+                # would place, and a solver-approximation failure must
+                # never feed preemption, the gang WaitTime machine, or a
+                # persisted ScheduleFailed explanation. Gangs roll back
+                # atomically, so the leftover set contains whole gangs.
+                rescue_batch = batch.replace(
+                    valid=batch.valid & (assignments < 0))
+                r_assign, new_state, new_quota = self._solve(
+                    new_state, rescue_batch, self.config, gangs, new_quota,
+                    passes=self.gang_passes, solver="greedy",
+                )
+                assignments = jnp.where(
+                    assignments >= 0, assignments, r_assign)
+                a = np.asarray(assignments)
         if (self.debug_service is not None
                 and self.debug_service.dump_top_n_scores > 0):
             # debug-only extra solve: dump per-pod node scores

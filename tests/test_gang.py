@@ -191,3 +191,39 @@ def test_gang_with_quota_rollback_restores_headroom():
     a, _, qs2 = gang_assign(state, pods, cfg(), gangs, quota=qs)
     assert (np.asarray(a)[:4] == -1).all()
     assert int(qs2.headroom[idx["q"], CPU]) == before
+
+
+# ---- batch-parallel solver engine (gang_assign solver="batch") -------------
+
+def test_gang_all_or_nothing_with_batch_solver():
+    # 4-member gang, capacity for only 3: the batch engine must roll the
+    # whole gang back exactly like the greedy engine
+    state = mk_state([4_000, 4_000, 4_000])
+    pods = mk_pods([3_000] * 4, [0, 0, 0, 0], state)
+    gangs = GangInfo.build(np.array([4]))
+    for solver in ("greedy", "batch"):
+        a, new_state, _ = gang_assign(state, pods, cfg(), gangs,
+                                      solver=solver)
+        assert np.asarray(a)[:4].tolist() == [-1, -1, -1, -1], solver
+        np.testing.assert_array_equal(
+            np.asarray(new_state.node_requested),
+            np.asarray(state.node_requested), err_msg=solver)
+
+
+def test_gang_satisfied_with_batch_solver():
+    state = mk_state([8_000] * 4)
+    pods = mk_pods([2_000] * 3, [0, 0, 0], state)
+    gangs = GangInfo.build(np.array([3]))
+    a, _, _ = gang_assign(state, pods, cfg(), gangs, solver="batch")
+    a = np.asarray(a)
+    assert (a[:3] >= 0).all()
+
+
+def test_gang_assign_rejects_unknown_solver():
+    import pytest
+
+    state = mk_state([8_000])
+    pods = mk_pods([100], [0], state)
+    with pytest.raises(ValueError, match="solver"):
+        gang_assign(state, pods, cfg(), GangInfo.build(np.array([1])),
+                    solver="annealing")

@@ -316,3 +316,38 @@ def test_batch_cache_invalidated_by_new_class_within_bucket():
     sched.snapshot.upsert_node(node("g1", labels={"gpu": "true"}))
     res = sched.schedule_round()
     assert res.assignments == {"gpu-pod": "g1"}
+
+
+def test_scheduler_switches_to_batch_solver_at_scale():
+    # below the threshold: exact greedy; at/above: the data-parallel engine.
+    # last_solver records which engine actually ran.
+    sched, binds = mk_scheduler(
+        [node(f"n{i}", cpu=64_000) for i in range(8)],
+        batch_solver_threshold=4)
+    for i in range(3):
+        sched.enqueue(pod(f"small-{i}", cpu=1_000))
+    res = sched.schedule_round()           # 3 pods < 4: greedy
+    assert sched.last_solver == "greedy"
+    assert len(res.assignments) == 3
+    for i in range(6):
+        sched.enqueue(pod(f"big-{i}", cpu=1_000))
+    res = sched.schedule_round()           # 6 pods >= 4: batch engine
+    assert sched.last_solver == "batch"
+    assert len(res.assignments) == 6
+    assert len(binds) == 9
+
+
+def test_batch_solver_failures_get_exact_rescue():
+    # a genuinely unschedulable pod must fail with REAL diagnosis even
+    # through the batch engine (the rescue pass re-solves leftovers
+    # exactly, so approximation failures never masquerade as capacity
+    # failures); schedulable leftovers get placed by the rescue
+    sched, _ = mk_scheduler(
+        [node("n1", cpu=4_000)], batch_solver_threshold=2)
+    sched.enqueue(pod("fits", cpu=1_000))
+    sched.enqueue(pod("too-big", cpu=50_000))
+    res = sched.schedule_round()
+    assert sched.last_solver == "batch"
+    assert res.assignments == {"fits": "n1"}
+    assert "too-big" in res.failures
+    assert res.failures["too-big"].insufficient_resources == 1
