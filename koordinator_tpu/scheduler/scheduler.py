@@ -467,15 +467,28 @@ class Scheduler:
                 passes=self.gang_passes, solver=solver,
             )
             a = np.asarray(assignments)
-            if solver == "batch" and bool((a[: len(pods)] < 0).any()):
+            leftover = np.asarray(batch.valid) & (a < 0)
+            if solver == "batch" and bool(leftover[: len(pods)].any()):
                 # exact rescue pass over the leftovers: the batch engine's
                 # top-k/round approximation may fail pods a greedy scan
                 # would place, and a solver-approximation failure must
                 # never feed preemption, the gang WaitTime machine, or a
-                # persisted ScheduleFailed explanation. Gangs roll back
-                # atomically, so the leftover set contains whole gangs.
+                # persisted ScheduleFailed explanation. Rolled-back gangs
+                # come back whole; SURPLUS members of a gang already
+                # satisfied this round rescue as gangless pods (min_member
+                # is met — extras bind individually) so pre_enqueue/rollback
+                # inside the rescue solve can't strand them.
+                ga = np.asarray(batch.gang_id)
+                placed = np.bincount(
+                    ga[(ga >= 0) & (a >= 0)], minlength=gangs.capacity)
+                satisfied = placed >= np.asarray(gangs.min_member)
+                gid = batch.gang_id
+                rescue_gid = jnp.where(
+                    (gid >= 0) & jnp.asarray(satisfied)[jnp.maximum(gid, 0)],
+                    -1, gid)
                 rescue_batch = batch.replace(
-                    valid=batch.valid & (assignments < 0))
+                    valid=batch.valid & (assignments < 0),
+                    gang_id=rescue_gid)
                 r_assign, new_state, new_quota = self._solve(
                     new_state, rescue_batch, self.config, gangs, new_quota,
                     passes=self.gang_passes, solver="greedy",
@@ -514,17 +527,32 @@ class Scheduler:
             if quota is not None:
                 from koordinator_tpu.quota.admission import quota_admission_mask
 
+                # attribute against the POST-solve quota: a pod that lost
+                # the headroom to this round's placements failed BECAUSE of
+                # quota, even though pre-solve admission would have passed.
+                # (Blame is applied per pod below only when nodes were
+                # otherwise feasible — a pod that failed on capacity or
+                # affinity keeps its real reason.)
+                diag_quota = new_quota if new_quota is not None else quota
                 admitted = np.asarray(quota_admission_mask(
-                    quota, batch.requests, batch.quota_id, batch.non_preemptible
+                    diag_quota, batch.requests, batch.quota_id,
+                    batch.non_preemptible
                 ))
             failed_gangs: set[str] = set()
             for i, pod in enumerate(pods):
                 if int(a[i]) >= 0:
                     continue
-                result.failures[pod.name] = explain_pod(
+                diag = explain_pod(
                     self.snapshot.state, batch, self.config, i,
-                    quota_admitted=bool(admitted[i]) if admitted is not None else True,
+                    quota_admitted=True,
                 )
+                if (admitted is not None and not admitted[i]
+                        and diag.feasible_nodes > 0):
+                    # nodes were available but the quota (as of this
+                    # round's placements) says no: quota is the cause
+                    diag = dataclasses.replace(
+                        diag, quota_rejected=True, feasible_nodes=0)
+                result.failures[pod.name] = diag
                 if pod.gang:
                     failed_gangs.add(pod.gang)
 

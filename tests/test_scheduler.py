@@ -351,3 +351,43 @@ def test_batch_solver_failures_get_exact_rescue():
     assert res.assignments == {"fits": "n1"}
     assert "too-big" in res.failures
     assert res.failures["too-big"].insufficient_resources == 1
+
+
+def test_batch_engine_with_gangs_and_quota_contention():
+    # the full stack through the batch engine: gang all-or-nothing + quota
+    # caps + rescue, at a queue size over the threshold
+    mx = np.full(R, UNBOUNDED, np.int64)
+    mx[CPU] = 8_000
+    tree = QuotaTree(resource_vector(cpu=64_000, memory=262_144).astype(np.int64))
+    tree.add("team", min=np.zeros(R, np.int64), max=mx)
+    sched, _ = mk_scheduler(
+        [node(f"n{i}", cpu=16_000) for i in range(4)],
+        quota_tree=tree, batch_solver_threshold=4)
+    sched.register_gang(GangRecord(name="g", min_member=3))
+    for i in range(3):
+        sched.enqueue(pod(f"g{i}", cpu=4_000, gang="g"))       # gang fits
+    for i in range(4):
+        sched.enqueue(pod(f"q{i}", cpu=3_000, quota="team"))   # cap 8000: 2 fit
+    res = sched.schedule_round()
+    assert sched.last_solver == "batch"
+    assert all(f"g{i}" in res.assignments for i in range(3))
+    placed_q = [f"q{i}" for i in range(4) if f"q{i}" in res.assignments]
+    assert len(placed_q) == 2              # quota admits floor(8000/3000)
+    failed_q = [f"q{i}" for i in range(4) if f"q{i}" in res.failures]
+    assert len(failed_q) == 2
+    for name in failed_q:
+        assert res.failures[name].quota_rejected   # real reason, not approx
+
+
+def test_rescue_places_surplus_members_of_satisfied_gang():
+    # 5 members, min_member=3: even if the batch engine strands surplus
+    # members, the rescue must bind them individually (min is already met)
+    sched, _ = mk_scheduler(
+        [node(f"n{i}", cpu=16_000) for i in range(8)],
+        batch_solver_threshold=2)
+    sched.register_gang(GangRecord(name="g", min_member=3))
+    for i in range(5):
+        sched.enqueue(pod(f"g{i}", cpu=2_000, gang="g"))
+    res = sched.schedule_round()
+    assert sched.last_solver == "batch"
+    assert len(res.assignments) == 5 and not res.failures
