@@ -73,6 +73,7 @@ def _score_topk_kernel(
     n_chunk: int,
     k: int,
     r_dims: int,
+    spread_bits: int,
 ):
     tp = podreq_ref.shape[1]
     n = alloc_ref.shape[1]
@@ -202,7 +203,8 @@ def _score_topk_kernel(
         node_idx = c0 + jax.lax.broadcasted_iota(
             jnp.int32, (tp, n_chunk), 1)                  # (TP, NC)
         tb = (n - 1) - ((node_idx - rot) % n)
-        key = (jnp.clip(scores, 0, _SCORE_CLIP) << _TB_BITS) | tb
+        q = jnp.clip(scores, 0, _SCORE_CLIP) >> spread_bits
+        key = (q << _TB_BITS) | tb
         key = jnp.where(feasible, key, -1)
 
         # fold the chunk into the running top-k: k extract-max passes over
@@ -238,6 +240,7 @@ def fused_score_topk(
     tile_pods: int = 128,
     n_chunk: int = 512,
     interpret: bool = False,
+    spread_bits: int = 0,
 ):
     """(cand_key, cand_node) — bit-exact equivalent of
     ``lax.top_k(_ranked_scores(*score_pods(state, pods, cfg)), k)`` without
@@ -253,11 +256,22 @@ def fused_score_topk(
     r = pods.requests.shape[1]
     tp = min(tile_pods, p)
     nc = min(n_chunk, n)
-    if p % tp or n % nc:
-        raise ValueError(f"capacities ({p}, {n}) must tile by ({tp}, {nc})")
-
+    if n % nc:
+        raise ValueError(f"node capacity {n} must tile by {nc}")
+    # pad the pod axis up to a tile multiple: padded rows are invalid
+    # (pod_valid=0 => key -1 everywhere) and sliced off the outputs
+    p_pad = -(-p // tp) * tp
+    pod_req = pods.requests
+    pod_valid = pods.valid
+    sel_mask = pods.selector_mask
     pod_est = scoring.estimate_pod_usage_by_band(
         pods.requests, cfg.estimator_factors, cfg.estimator_defaults)
+    if p_pad != p:
+        pad = ((0, p_pad - p), (0, 0))
+        pod_req = jnp.pad(pod_req, pad)
+        pod_est = jnp.pad(pod_est, pad)
+        sel_mask = jnp.pad(sel_mask, pad)
+        pod_valid = jnp.pad(pod_valid, ((0, p_pad - p),))
 
     scalars = jnp.stack([
         jnp.asarray(cfg.loadaware_dominant_weight, jnp.int32),
@@ -266,18 +280,19 @@ def fused_score_topk(
         jnp.asarray(cfg.scarce_plugin_weight, jnp.int32),
     ])[None, :]
 
-    grid = (p // tp,)
+    grid = (p_pad // tp,)
     pod_spec = pl.BlockSpec((r, tp), lambda i: (0, i),
                             memory_space=pltpu.VMEM)
     row_spec = pl.BlockSpec((1, tp), lambda i: (0, i),
                             memory_space=pltpu.VMEM)
-    sel_spec = pl.BlockSpec((tp, pods.selector_mask.shape[1]),
+    sel_spec = pl.BlockSpec((tp, sel_mask.shape[1]),
                             lambda i: (i, 0), memory_space=pltpu.VMEM)
     full = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0),
                                       memory_space=pltpu.VMEM)
 
     kernel = functools.partial(
-        _score_topk_kernel, n_chunk=nc, k=k, r_dims=r)
+        _score_topk_kernel, n_chunk=nc, k=k, r_dims=r,
+        spread_bits=spread_bits)
     out_val, out_idx = pl.pallas_call(
         kernel,
         grid=grid,
@@ -295,13 +310,13 @@ def fused_score_topk(
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((p, k), jnp.int32),
-            jax.ShapeDtypeStruct((p, k), jnp.int32),
+            jax.ShapeDtypeStruct((p_pad, k), jnp.int32),
+            jax.ShapeDtypeStruct((p_pad, k), jnp.int32),
         ],
         interpret=interpret,
     )(
-        pods.requests.T, pod_est.T, pods.valid[None, :].astype(jnp.int32),
-        pods.selector_mask.astype(jnp.int32),
+        pod_req.T, pod_est.T, pod_valid[None, :].astype(jnp.int32),
+        sel_mask.astype(jnp.int32),
         state.node_allocatable.T, state.node_requested.T,
         state.node_usage.T, state.node_agg_usage.T,
         state.node_valid[None, :].astype(jnp.int32),
@@ -314,4 +329,4 @@ def fused_score_topk(
         cfg.agg_usage_thresholds[None, :],
         scalars,
     )
-    return out_val, out_idx
+    return out_val[:p], out_idx[:p]
