@@ -489,12 +489,18 @@ class Scheduler:
                 rescue_batch = batch.replace(
                     valid=batch.valid & (assignments < 0),
                     gang_id=rescue_gid)
-                r_assign, new_state, new_quota = self._solve(
-                    new_state, rescue_batch, self.config, gangs, new_quota,
+                # compact the leftovers first: the exact greedy solve is a
+                # sequential scan over the POD AXIS, so rescuing 50 pods
+                # must cost a 64-row scan, not the full 50k-row batch
+                small, idx = rescue_batch.compact(leftover)
+                r_small, new_state, new_quota = self._solve(
+                    new_state, small, self.config, gangs, new_quota,
                     passes=self.gang_passes, solver="greedy",
                 )
+                r_full = np.full(batch.capacity, -1, np.int32)
+                r_full[idx] = np.asarray(r_small)[: len(idx)]
                 assignments = jnp.where(
-                    assignments >= 0, assignments, r_assign)
+                    assignments >= 0, assignments, jnp.asarray(r_full))
                 a = np.asarray(assignments)
         if (self.debug_service is not None
                 and self.debug_service.dump_top_n_scores > 0):

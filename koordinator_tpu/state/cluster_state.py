@@ -214,6 +214,30 @@ class PodBatch:
         nc = jnp.minimum(state.node_class, c - 1)
         return self.selector_mask[idx][nc] & in_range
 
+    def compact(
+        self, keep: np.ndarray, min_capacity: int = 32
+    ) -> tuple["PodBatch", np.ndarray]:
+        """(small_batch, kept_indices): gather the ``keep`` rows into a new
+        batch padded to a power-of-two capacity (power-of-two bucketing keeps
+        the jit cache bounded).  Padded rows are invalid.
+
+        The scale rationale: a follow-up solve over a handful of leftover
+        pods (the scheduler's exact rescue pass) must not pay the full
+        O(capacity) scan of the original 50k-row batch.
+        """
+        idx = np.flatnonzero(np.asarray(keep))
+        cap = max(min_capacity, 1 << (max(len(idx), 1) - 1).bit_length())
+        pad = np.zeros(cap, np.int32)
+        pad[: len(idx)] = idx
+        gidx = jnp.asarray(pad)
+        valid_pad = np.zeros(cap, bool)
+        valid_pad[: len(idx)] = True
+
+        # every PodBatch field is per-pod along axis 0, so gather the whole
+        # pytree (None constraint fields drop out of the map)
+        small = jax.tree.map(lambda a: jnp.take(a, gidx, axis=0), self)
+        return small.replace(valid=small.valid & jnp.asarray(valid_pad)), idx
+
     @classmethod
     def build(
         cls,

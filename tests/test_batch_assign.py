@@ -111,6 +111,33 @@ def test_matches_greedy_on_assignment_count():
     assert nb >= ng - 1, (nb, ng)
 
 
+def test_compact_gathers_rows_and_pads_pow2():
+    pods = mk_pods([100, 200, 300, 400, 500], priority=[1, 2, 3, 4, 5])
+    keep = np.zeros(pods.capacity, bool)
+    keep[[1, 3, 4]] = True
+    small, idx = pods.compact(keep, min_capacity=4)
+    assert list(idx) == [1, 3, 4]
+    assert small.capacity == 4
+    np.testing.assert_array_equal(
+        np.asarray(small.requests)[:3, CPU], [200, 400, 500])
+    np.testing.assert_array_equal(np.asarray(small.priority)[:3], [2, 4, 5])
+    assert not bool(small.valid[3])          # pad row invalid
+    # solving the compact batch matches solving the masked original
+    state = mk_state([8_000] * 4)
+    a_small, _, _ = batch_assign(state, small, cfg())
+    a_full, _, _ = batch_assign(
+        state, pods.replace(valid=pods.valid & jnp.asarray(keep)), cfg())
+    np.testing.assert_array_equal(
+        np.asarray(a_small)[:3], np.asarray(a_full)[idx])
+
+
+def test_compact_empty_keep():
+    pods = mk_pods([100, 200])
+    small, idx = pods.compact(np.zeros(pods.capacity, bool))
+    assert len(idx) == 0
+    assert not np.asarray(small.valid).any()
+
+
 def test_no_candidate_collapse_at_scale():
     # regression: with exact-score ranking every pod's top-k collapsed onto
     # the same few nodes and >75% of a fully schedulable queue stranded
