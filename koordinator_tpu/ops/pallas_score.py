@@ -106,8 +106,13 @@ def _score_topk_kernel(
     # together, collapsing the pool into -2s); sanitized to 0 on output
     run_idx = -1 - jax.lax.broadcasted_iota(jnp.int32, (tp, k), 1)
 
-    for c0 in range(0, n, n_chunk):
-        cols = slice(c0, c0 + n_chunk)
+    # the node walk is a fori_loop, not a python unroll: at the north-star
+    # shape (20 chunks x k extract-max passes x R dims) unrolling blew the
+    # TPU compile up beyond usability
+    def chunk_body(ci, carry):
+        run_val, run_idx = carry
+        c0 = ci * n_chunk
+        cols = pl.ds(c0, n_chunk)
         nvalid = nvalid_ref[0, cols] > 0                  # (NC,)
 
         la_num = jnp.zeros((tp, n_chunk), jnp.int32)
@@ -225,9 +230,10 @@ def _score_topk_kernel(
             new_idx.append(pick_idx)   # may be a negative sentinel
             taken = is_m & (cat_idx == pick_idx[:, None])
             cat_val = jnp.where(taken, -2, cat_val)
-        run_val = jnp.stack(new_val, axis=1)
-        run_idx = jnp.stack(new_idx, axis=1)
+        return jnp.stack(new_val, axis=1), jnp.stack(new_idx, axis=1)
 
+    run_val, run_idx = jax.lax.fori_loop(
+        0, n // n_chunk, chunk_body, (run_val, run_idx))
     out_val_ref[:, :] = run_val
     out_idx_ref[:, :] = jnp.where(run_val < 0, 0, run_idx)
 

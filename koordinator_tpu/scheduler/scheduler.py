@@ -486,13 +486,14 @@ class Scheduler:
                 rescue_gid = jnp.where(
                     (gid >= 0) & jnp.asarray(satisfied)[jnp.maximum(gid, 0)],
                     -1, gid)
-                rescue_batch = batch.replace(
-                    valid=batch.valid & (assignments < 0),
-                    gang_id=rescue_gid)
                 # compact the leftovers first: the exact greedy solve is a
                 # sequential scan over the POD AXIS, so rescuing 50 pods
-                # must cost a 64-row scan, not the full 50k-row batch
-                small, idx = rescue_batch.compact(leftover)
+                # must cost a 64-row scan, not the full 50k-row batch.
+                # ``leftover`` is the single source of truth for which rows
+                # rescue (compact keeps exactly those and marks the rest of
+                # the padded capacity invalid).
+                small, idx = batch.replace(gang_id=rescue_gid).compact(
+                    leftover)
                 r_small, new_state, new_quota = self._solve(
                     new_state, small, self.config, gangs, new_quota,
                     passes=self.gang_passes, solver="greedy",
