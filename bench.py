@@ -176,6 +176,79 @@ def _bench_lownodeload(rtt: float) -> dict:
         max((total - rtt) / iters, 1e-9) * 1e3, 2)}
 
 
+def _bench_colocation(rtt: float) -> dict:
+    """Spark colocation e2e @ 3 nodes (BASELINE.json's kind-demo config):
+    webhook admission (BE translation to batch resources) -> scheduler
+    round over batch capacity -> bind, repeated over a pod stream.  Host
+    control-loop throughput, not a device kernel — ``rtt`` is unused."""
+    from koordinator_tpu.api import crds, extension as ext
+    from koordinator_tpu.api.qos import QoSClass
+    from koordinator_tpu.api.resources import resource_vector
+    from koordinator_tpu.manager.webhook import (
+        PodMutatingWebhook,
+        PodValidatingWebhook,
+    )
+    from koordinator_tpu.scheduler.scheduler import Scheduler
+    from koordinator_tpu.scheduler.snapshot import (
+        ClusterSnapshot,
+        NodeSpec,
+        PodSpec,
+    )
+
+    profile = crds.ClusterColocationProfile(
+        name="colo", pod_selector={"app": "spark"}, qos_class="BE",
+        koordinator_priority=5500, scheduler_name="koord-scheduler")
+    mutating = PodMutatingWebhook([profile])
+    validating = PodValidatingWebhook()
+    snapshot = ClusterSnapshot(capacity=4)
+    for i in range(3):
+        snapshot.upsert_node(NodeSpec(
+            name=f"n{i}",
+            allocatable=resource_vector({
+                "cpu": 16_000, "memory": 32_768,
+                ext.RESOURCE_BATCH_CPU: 12_000,
+                ext.RESOURCE_BATCH_MEMORY: 24_576,
+            })))
+    scheduler = Scheduler(snapshot)
+
+    pods_per_round, rounds = 60, 6
+    n_scheduled = 0
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        if r == 1:  # round 0 is the jit warm-up; time the steady state
+            n_scheduled, t0 = 0, time.perf_counter()
+        for i in range(pods_per_round):
+            pod = {
+                "metadata": {"name": f"spark-{r}-{i}",
+                             "namespace": "default",
+                             "labels": {"app": "spark"}},
+                "spec": {"containers": [{"name": "m", "resources": {
+                    "requests": {"cpu": "500m", "memory": "1Gi"},
+                    "limits": {"cpu": "500m", "memory": "1Gi"}}}]},
+            }
+            mutating.mutate(pod)
+            assert validating.validate(pod) == []
+            req = pod["spec"]["containers"][0]["resources"]["requests"]
+            scheduler.enqueue(PodSpec(
+                name=pod["metadata"]["name"],
+                requests=resource_vector({
+                    ext.RESOURCE_BATCH_CPU: req[ext.RESOURCE_BATCH_CPU],
+                    ext.RESOURCE_BATCH_MEMORY:
+                        req[ext.RESOURCE_BATCH_MEMORY] // (1 << 20),
+                }),
+                priority=5500, qos=int(QoSClass.BE)))
+        result = scheduler.schedule_round()
+        n_scheduled += len(result.assignments)
+        for name in result.assignments:
+            scheduler.delete_pod(name)  # job completes: free for next wave
+    dt = time.perf_counter() - t0
+    timed = pods_per_round * (rounds - 1)      # round 0 is untimed warm-up
+    if n_scheduled < timed * 0.9:
+        return {"bench_colocation_error":
+                f"only {n_scheduled}/{timed} scheduled"}
+    return {"spark_colocation_e2e_pods_per_sec_3n": round(n_scheduled / dt, 1)}
+
+
 def main() -> None:
     from __graft_entry__ import _build_problem
     from koordinator_tpu.ops.assignment import score_pods
@@ -220,7 +293,7 @@ def main() -> None:
     import subprocess
     import sys
 
-    for name in ("quota", "gang", "lownodeload"):
+    for name in ("quota", "gang", "lownodeload", "colocation"):
         try:
             proc = subprocess.run(
                 [sys.executable, __file__, "--extra", name],
@@ -258,7 +331,8 @@ def _extra_main(name: str) -> None:
 
     rtt, _ = _median_readback_seconds(jax.jit(rtt_floor), (state,), n=3)
     fn = {"quota": _bench_quota, "gang": _bench_gang,
-          "lownodeload": _bench_lownodeload}[name]
+          "lownodeload": _bench_lownodeload,
+          "colocation": _bench_colocation}[name]
     print(json.dumps(fn(rtt)))
 
 

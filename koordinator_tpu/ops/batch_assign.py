@@ -190,6 +190,23 @@ def batch_assign(
     mode exists for parity tests (fused_score_topk(interpret=True)), not
     for serving.
     """
+    cand_key, cand_node = select_candidates(
+        state, pods, cfg, k=k, fused_topk=fused_topk,
+        spread_bits=spread_bits)
+    return _assign_rounds(state, pods, quota, cand_key, cand_node, rounds)
+
+
+def select_candidates(
+    state: ClusterState,
+    pods: PodBatch,
+    cfg: ScoringConfig,
+    k: int = 32,
+    fused_topk: bool = False,
+    spread_bits: int = 5,
+):
+    """(cand_key, cand_node), each (P, k): the candidate-selection stage of
+    ``batch_assign``, exposed separately so profiling can time it apart
+    from the propose/accept rounds."""
     if fused_topk:
         if pods.selector_mask is None:
             raise ValueError("fused_topk needs a factored batch "
@@ -199,10 +216,8 @@ def batch_assign(
             from koordinator_tpu.ops.pallas_score import fused_score_topk
 
             k = min(k, state.capacity)
-            cand_key, cand_node = fused_score_topk(
+            return fused_score_topk(
                 state, pods, cfg, k=k, spread_bits=spread_bits)
-            return _assign_rounds(state, pods, quota, cand_key, cand_node,
-                                  rounds)
     scores, feasible = score_pods(state, pods, cfg)
     key = _ranked_scores(scores, feasible, spread_bits)
     k = min(k, key.shape[1])
@@ -230,7 +245,7 @@ def batch_assign(
         cand_key = jnp.take_along_axis(key, cand_node, axis=1)
     else:
         cand_key, cand_node = jax.lax.top_k(key, k)    # (P, k)
-    return _assign_rounds(state, pods, quota, cand_key, cand_node, rounds)
+    return cand_key, cand_node
 
 
 def _assign_rounds(state, pods, quota, cand_key, cand_node, rounds):
