@@ -184,7 +184,9 @@ DESCHEDULER = Registry("koord_descheduler")
 
 # Canonical instruments (names mirror the reference's).
 scheduling_latency = SCHEDULER.histogram(
-    "scheduling_duration_seconds", "End-to-end pod scheduling latency")
+    "scheduling_duration_seconds",
+    "Scheduling-cycle latency per phase (label: phase); aggregate by (le, "
+    "phase)")
 solver_batch_latency = SCHEDULER.histogram(
     "solver_batch_duration_seconds", "Batched filter/score/assign solve latency")
 pending_pods = SCHEDULER.gauge("pending_pods", "Pods waiting to be scheduled")

@@ -33,6 +33,14 @@ class SchedulerMonitor:
         finally:
             elapsed = self.clock() - start
             self.phase_history[name].append(elapsed)
+            # feed the prometheus surface too (the reference exports
+            # scheduling-cycle latency per phase from the same hook)
+            from koordinator_tpu import metrics
+
+            metrics.scheduling_latency.observe(
+                elapsed, labels={"phase": name})
+            if name == "Solve":
+                metrics.solver_batch_latency.observe(elapsed)
             if elapsed > self.timeout_sec:
                 self.slow_rounds += 1
                 logger.warning(

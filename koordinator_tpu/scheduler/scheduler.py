@@ -437,6 +437,11 @@ class Scheduler:
         now = self.clock()
         result = SchedulingResult({}, {}, 0)
         self.last_result = result  # debug-API diagnosis surface
+        # set at round START so the gauge tracks an emptied queue even when
+        # the round early-returns before solving
+        from koordinator_tpu import metrics
+
+        metrics.pending_pods.set(float(len(self.pending)))
         if self.nominations:
             with self.monitor.phase("Nominated"):
                 self.snapshot.flush()
@@ -592,6 +597,9 @@ class Scheduler:
                         self.auditor.record(pod.gang or pod.name,
                                             "ScheduleFailed", diag.message())
 
+        from koordinator_tpu import metrics
+
+        metrics.pending_pods.set(float(len(self.pending)))  # post-bind queue
         return result
 
     def _commit_bind(

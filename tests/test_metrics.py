@@ -60,3 +60,55 @@ class TestWiring:
         ctx = make_ctx(tmp_path, FakeClock())
         Evictor(ctx).evict(be_pod("a"), "test-reason")
         assert pod_eviction_total.value({"reason": "test-reason"}) == before + 1
+
+
+class TestDashboards:
+    """Shipped Grafana dashboards (dashboards/*.json) must reference only
+    metric series that the registries actually register (reference ships
+    dashboards/scheduling.json + descheduling.json)."""
+
+    def _series_names(self):
+        from koordinator_tpu import metrics as m
+
+        names = set()
+        for reg in (m.SCHEDULER, m.KOORDLET, m.MANAGER, m.DESCHEDULER):
+            for full, metric in reg._metrics.items():
+                names.add(full)
+                if isinstance(metric, m.Histogram):
+                    names.update({f"{full}_bucket", f"{full}_sum",
+                                  f"{full}_count"})
+        return names
+
+    def test_dashboard_exprs_use_registered_metrics(self):
+        import glob
+        import json
+        import os
+        import re
+
+        root = os.path.join(os.path.dirname(__file__), "..", "dashboards")
+        files = sorted(glob.glob(os.path.join(root, "*.json")))
+        assert len(files) >= 2, "scheduling + descheduling dashboards"
+        known = self._series_names()
+        checked = 0
+        for path in files:
+            doc = json.load(open(path))
+            for panel in doc.get("panels", []):
+                for target in panel.get("targets", []):
+                    for name in re.findall(
+                            r"(koord_[a-z0-9_]+|koordlet_[a-z0-9_]+)",
+                            target["expr"]):
+                        assert name in known, (path, name)
+                        checked += 1
+        assert checked > 10
+
+    def test_monitor_feeds_prometheus_histograms(self):
+        from koordinator_tpu import metrics as m
+        from koordinator_tpu.scheduler.monitor import SchedulerMonitor
+
+        before = m.scheduling_latency._totals.get((("phase", "Solve"),), 0)
+        solve_before = m.solver_batch_latency._totals.get((), 0)
+        mon = SchedulerMonitor()
+        with mon.phase("Solve"):
+            pass
+        assert m.scheduling_latency._totals[(("phase", "Solve"),)] == before + 1
+        assert m.solver_batch_latency._totals[()] == solve_before + 1
