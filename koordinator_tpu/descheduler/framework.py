@@ -155,6 +155,7 @@ class Evictor:
         self.delete_fn = delete_fn
         self.label_fn = label_fn
         self.evicted: list[tuple[str, str]] = []
+        self.profile = ""   # stamped by ProfileRunner for metric attribution
 
     def evict(self, pod: PodInfo, reason: str) -> bool:
         ok = False
@@ -168,7 +169,8 @@ class Evictor:
         if ok:
             from koordinator_tpu.metrics import descheduler_evictions_total
 
-            descheduler_evictions_total.inc(labels={"reason": reason})
+            descheduler_evictions_total.inc(
+                labels={"profile": self.profile, "reason": reason})
             self.evicted.append((pod.uid, reason))
         return ok
 
@@ -188,6 +190,7 @@ class Profile:
 class _ProfileHandle:
     def __init__(self, profile: Profile, pods_fn: Callable[[], list[PodInfo]]):
         self.profile = profile
+        profile.evictor.profile = profile.name
         self._pods_fn = pods_fn
         self.evictions = 0
 

@@ -259,6 +259,15 @@ class MigrationController:
                 job.phase = MigrationJobPhase.FAILED
                 job.reason = "Timeout"
 
+        from koordinator_tpu import metrics
+
+        counts = {phase: 0 for phase in MigrationJobPhase}
+        for job in self.jobs.values():
+            counts[job.phase] += 1
+        for phase, n in counts.items():
+            metrics.migration_jobs.set(
+                float(n), labels={"phase": phase.value})
+
     def gc(self, keep: int = 256) -> None:
         """Drop oldest finished jobs beyond the retention limit."""
         finished = sorted(

@@ -304,8 +304,11 @@ class PSICollector:
         )
 
     def collect(self) -> None:
+        from koordinator_tpu import metrics
+
         now = self.d.clock()
         stats = psi.read_psi("", self.d.cfg)
+        metrics.psi_cpu_some_avg10.set(stats.cpu.some.avg10)
         self.d.cache.append(mc.PSI_CPU_SOME_AVG10, stats.cpu.some.avg10, ts=now)
         self.d.cache.append(mc.PSI_MEM_FULL_AVG10, stats.mem.full.avg10, ts=now)
         self.d.cache.append(mc.PSI_IO_FULL_AVG10, stats.io.full.avg10, ts=now)
@@ -404,7 +407,12 @@ class CPICollector:
             return
         d_cycles, d_instructions = cycles - last[0], instructions - last[1]
         if d_instructions > 0:
-            self.d.cache.append(metric, d_cycles / d_instructions, labels, ts=now)
+            cpi = d_cycles / d_instructions
+            self.d.cache.append(metric, cpi, labels, ts=now)
+            if metric == mc.CONTAINER_CPI:
+                from koordinator_tpu import metrics
+
+                metrics.container_cpi.set(cpi, labels=labels)
 
     def collect(self) -> None:
         now = self.d.clock()

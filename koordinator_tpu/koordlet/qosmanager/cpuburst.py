@@ -123,6 +123,11 @@ class CPUBurst:
         else:
             new_quota = state.current_quota_us
         if new_quota != state.current_quota_us:
+            from koordinator_tpu import metrics
+
+            metrics.cpu_burst_total.inc(labels={
+                "direction": "up" if new_quota > state.current_quota_us
+                else "down"})
             state.current_quota_us = new_quota
             self.ctx.executor.update(
                 ResourceUpdate(cg.CPU_CFS_QUOTA, rel, str(new_quota))

@@ -192,6 +192,8 @@ class NodeResourceController:
             np.asarray, self._batched(inputs, self._strategy())
         )
 
+        from koordinator_tpu import metrics
+
         patches: list[NodePatch] = []
         for i, record in enumerate(nodes):
             degraded = self._degraded(record, now)
@@ -200,6 +202,16 @@ class NodeResourceController:
             m_cpu = 0 if degraded else int(mid_cpu[i])
             m_mem = 0 if degraded else int(mid_mem[i])
             devres = self._device_resources(record)
+            # observability: every tick refreshes the gauges, even for nodes
+            # below the diff threshold that emit no patch
+            metrics.batch_resource_allocatable.set(
+                float(b_cpu), labels={"node": record.name,
+                                      "resource": "batch-cpu"})
+            metrics.batch_resource_allocatable.set(
+                float(b_mem), labels={"node": record.name,
+                                      "resource": "batch-memory"})
+            metrics.node_metric_expired.set(
+                1.0 if degraded else 0.0, labels={"node": record.name})
             if degraded and record.last_degraded:
                 # already zeroed — but device info comes from the Device CR,
                 # independent of metric freshness, so device changes still sync

@@ -12,6 +12,8 @@ import logging
 import time
 from collections import defaultdict, deque
 
+from koordinator_tpu import metrics
+
 logger = logging.getLogger("koordinator_tpu.scheduler")
 
 
@@ -35,8 +37,6 @@ class SchedulerMonitor:
             self.phase_history[name].append(elapsed)
             # feed the prometheus surface too (the reference exports
             # scheduling-cycle latency per phase from the same hook)
-            from koordinator_tpu import metrics
-
             metrics.scheduling_latency.observe(
                 elapsed, labels={"phase": name})
             if name == "Solve":

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from koordinator_tpu import metrics
 from koordinator_tpu.ops.assignment import ScoringConfig
 from koordinator_tpu.ops.gang import GangInfo, gang_assign
 from koordinator_tpu.ops.network_topology import (
@@ -430,6 +431,9 @@ class Scheduler:
             return self._schedule_round()
 
     def _schedule_round(self) -> SchedulingResult:
+        # set at round START — before any early return, including the
+        # barrier gate, so a backlog building behind the barrier is visible
+        metrics.pending_pods.set(float(len(self.pending)))
         if self.barrier is not None and not self.barrier.check():
             # stale cache after restart: refuse to decide until the informer
             # replays past the barrier (sync_barrier.go semantics)
@@ -437,11 +441,6 @@ class Scheduler:
         now = self.clock()
         result = SchedulingResult({}, {}, 0)
         self.last_result = result  # debug-API diagnosis surface
-        # set at round START so the gauge tracks an emptied queue even when
-        # the round early-returns before solving
-        from koordinator_tpu import metrics
-
-        metrics.pending_pods.set(float(len(self.pending)))
         if self.nominations:
             with self.monitor.phase("Nominated"):
                 self.snapshot.flush()
@@ -596,8 +595,6 @@ class Scheduler:
                     if self.auditor is not None:
                         self.auditor.record(pod.gang or pod.name,
                                             "ScheduleFailed", diag.message())
-
-        from koordinator_tpu import metrics
 
         metrics.pending_pods.set(float(len(self.pending)))  # post-bind queue
         return result

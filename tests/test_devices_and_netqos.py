@@ -1,0 +1,237 @@
+"""Device collectors (gpu/rdma/xpu parity) and the resctrl/tc/terwayqos
+runtime hooks — the r1-VERDICT koordlet matrix tail.
+
+Reference anchors: pkg/koordlet/metricsadvisor/devices/{gpu,rdma,xpu},
+pkg/koordlet/runtimehooks/hooks/{resctrl,tc,terwayqos}.
+"""
+
+import json
+import os
+
+import pytest
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.features import KOORDLET_GATES, RUNTIMEHOOK_GATES
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.devices import (
+    AcceleratorCollector,
+    RdmaCollector,
+    XpuCollector,
+)
+from koordinator_tpu.koordlet.metricsadvisor import _Deps
+from koordinator_tpu.koordlet.runtimehooks.plugins import (
+    TC_CLASSID_HIGH,
+    TC_CLASSID_LOW,
+    TC_CLASSID_MID,
+    ResctrlHook,
+    ResctrlUpdater,
+    TCNetworkQoS,
+    TerwayQoS,
+    tc_setup_commands,
+)
+from koordinator_tpu.koordlet.runtimehooks.protocol import PodContext
+from koordinator_tpu.koordlet.statesinformer import PodMeta, StatesInformer
+from koordinator_tpu.koordlet.system import cgroup as cg
+from koordinator_tpu.koordlet.system.config import test_config as make_test_config
+
+
+@pytest.fixture
+def cfg(tmp_path):
+    return make_test_config(tmp_path)
+
+
+def make_deps(cfg):
+    return _Deps(StatesInformer(), mc.MetricCache(), cfg, lambda: 100.0)
+
+
+def pod(qos=QoSClass.BE, annotations=None):
+    return PodMeta(
+        uid="pod-1", name="pod-1", namespace="default", qos_class=qos,
+        kube_qos="besteffort" if qos.is_best_effort else "burstable",
+        annotations=annotations or {},
+    )
+
+
+def run_hook(hook, p):
+    ctx = PodContext(pod=p, cgroup_dir="kubepods/pod-1")
+    hook(ctx)
+    return ctx.response
+
+
+class TestAcceleratorCollector:
+    def _fake_device(self, cfg, name="accel0", **fields):
+        root = os.path.join(cfg.sys_root, "class", "accel", name)
+        os.makedirs(root, exist_ok=True)
+        defaults = dict(uuid=f"GPU-{name}", minor="0", type="gpu",
+                        usage_pct="37.5", mem_used="1024", mem_total="8192",
+                        numa_node="1", busid="0000:3b:00.0", health="1")
+        defaults.update(fields)
+        for fn, val in defaults.items():
+            with open(os.path.join(root, fn), "w") as f:
+                f.write(str(val))
+
+    def test_samples_and_device_infos(self, cfg):
+        self._fake_device(cfg, "accel0", minor="0")
+        self._fake_device(cfg, "accel1", minor="1", health="0",
+                          usage_pct="80")
+        deps = make_deps(cfg)
+        col = AcceleratorCollector(deps)
+        KOORDLET_GATES.set("Accelerators", True)
+        try:
+            assert col.enabled()
+            col.collect()
+        finally:
+            KOORDLET_GATES.set("Accelerators", False)
+        res = deps.cache.query(mc.ACCEL_CORE_USAGE,
+                               {"minor": "0", "uuid": "GPU-accel0",
+                                "type": "gpu"}, end=200.0)
+        assert list(res.values) == [37.5]
+        infos = col.device_infos()
+        assert [d.uuid for d in infos] == ["GPU-accel0", "GPU-accel1"]
+        assert infos[0].health and not infos[1].health
+        assert infos[0].numa_node == 1
+        assert infos[0].resources["gpu-memory"] == 8192
+
+    def test_gate_and_missing_sysfs_disable(self, cfg):
+        col = AcceleratorCollector(make_deps(cfg))
+        KOORDLET_GATES.set("Accelerators", True)
+        try:
+            assert not col.enabled()      # no sysfs dir
+        finally:
+            KOORDLET_GATES.set("Accelerators", False)
+        self._fake_device(cfg, "accel0")
+        assert not col.enabled()          # gate off
+
+
+class TestRdmaCollector:
+    def test_inventory_with_port_state(self, cfg):
+        base = os.path.join(cfg.sys_root, "class", "infiniband", "mlx5_0")
+        os.makedirs(os.path.join(base, "ports", "1"), exist_ok=True)
+        with open(os.path.join(base, "node_guid"), "w") as f:
+            f.write("0c42:a103:0065:2b8a")
+        with open(os.path.join(base, "ports", "1", "state"), "w") as f:
+            f.write("4: ACTIVE")
+        down = os.path.join(cfg.sys_root, "class", "infiniband", "mlx5_1")
+        os.makedirs(os.path.join(down, "ports", "1"), exist_ok=True)
+        with open(os.path.join(down, "ports", "1", "state"), "w") as f:
+            f.write("1: DOWN")
+
+        infos = RdmaCollector(make_deps(cfg)).device_infos()
+        by_uuid = {d.uuid: d for d in infos}
+        assert by_uuid["0c42:a103:0065:2b8a"].health
+        assert not by_uuid["mlx5_1"].health
+        assert all(d.type == "rdma" for d in infos)
+
+
+class TestXpuCollector:
+    def test_vendor_json_inventory(self, cfg):
+        root = os.path.join(cfg.var_run_root, "xpu-device-infos")
+        os.makedirs(root, exist_ok=True)
+        with open(os.path.join(root, "dev0.json"), "w") as f:
+            json.dump({"uuid": "XPU-0", "minor": 0, "healthy": True,
+                       "vendor": "acme", "model": "x100",
+                       "numaNode": 0, "busID": "0000:17:00.0",
+                       "resources": {"xpu-core": 100,
+                                     "xpu-memory": 65536}}, f)
+        with open(os.path.join(root, "broken.json"), "w") as f:
+            f.write("{not json")
+
+        infos = XpuCollector(make_deps(cfg)).device_infos()
+        assert len(infos) == 1            # broken file skipped, not fatal
+        d = infos[0]
+        assert d.uuid == "XPU-0" and d.labels["vendor"] == "acme"
+        assert d.resources["xpu-memory"] == 65536
+
+
+class TestResctrlHook:
+    @pytest.fixture(autouse=True)
+    def gate(self):
+        RUNTIMEHOOK_GATES.set("Resctrl", True)
+        yield
+        RUNTIMEHOOK_GATES.set("Resctrl", False)
+
+    def test_annotated_pod_gets_private_group(self):
+        p = pod(qos=QoSClass.LS, annotations={
+            ext.ANNOTATION_RESCTRL: json.dumps({"l3": 50, "mb": 40})})
+        resp = run_hook(ResctrlHook(num_ways=20), p)
+        assert resp.resctrl_group == "koord-pod-pod-1"
+        # 50% of 20 ways = 10 low bits set
+        assert resp.resctrl_schemata == f"L3:0={(1 << 10) - 1:x}\nMB:0=40\n"
+
+    def test_unannotated_pod_joins_qos_group(self):
+        assert run_hook(ResctrlHook(), pod(QoSClass.BE)).resctrl_group == "BE"
+        assert run_hook(ResctrlHook(), pod(QoSClass.LSR)).resctrl_group == "LSR"
+        assert run_hook(ResctrlHook(), pod(QoSClass.LS)).resctrl_group == "LS"
+
+    def test_updater_programs_fake_resctrl_fs(self, cfg):
+        p = pod(annotations={
+            ext.ANNOTATION_RESCTRL: json.dumps({"l3": 100})})
+        resp = run_hook(ResctrlHook(num_ways=4), p)
+        updater = ResctrlUpdater(cfg)
+        updater.apply(resp, pids=[1234])
+        gdir = updater.fs.group_dir("koord-pod-pod-1")
+        assert open(os.path.join(gdir, "schemata")).read() == "L3:0=f\n"
+        assert "1234" in open(os.path.join(gdir, "tasks")).read()
+        updater.remove_group("pod-1")
+        assert not os.path.isdir(gdir)
+
+
+class TestTCNetworkQoS:
+    @pytest.fixture(autouse=True)
+    def gate(self):
+        RUNTIMEHOOK_GATES.set("TCNetworkQoS", True)
+        yield
+        RUNTIMEHOOK_GATES.set("TCNetworkQoS", False)
+
+    def test_classid_per_tier(self):
+        hook = TCNetworkQoS()
+        key = cg.NET_CLS_CLASSID.name
+        assert run_hook(hook, pod(QoSClass.BE)).cgroup_values[
+            key] == str(TC_CLASSID_LOW)
+        assert run_hook(hook, pod(QoSClass.LSR)).cgroup_values[
+            key] == str(TC_CLASSID_HIGH)
+        assert run_hook(hook, pod(QoSClass.LS)).cgroup_values[
+            key] == str(TC_CLASSID_HIGH)
+        assert run_hook(hook, pod(QoSClass.NONE)).cgroup_values[
+            key] == str(TC_CLASSID_MID)
+
+    def test_setup_commands_htb_plan(self):
+        cmds = tc_setup_commands("eth0", 10_000)
+        assert cmds[0][:4] == ["tc", "qdisc", "add", "dev"]
+        assert "htb" in cmds[0]
+        # guaranteed rates split the line rate, ceils borrow up to it
+        assert "4000mbit" in cmds[1] and "10000mbit" in cmds[1]
+        assert "3000mbit" in cmds[2] and "3000mbit" in cmds[3]
+
+    def test_gate_off_is_noop(self):
+        RUNTIMEHOOK_GATES.set("TCNetworkQoS", False)
+        assert cg.NET_CLS_CLASSID.name not in run_hook(
+            TCNetworkQoS(), pod(QoSClass.BE)).cgroup_values
+
+
+class TestTerwayQoS:
+    @pytest.fixture(autouse=True)
+    def gate(self):
+        RUNTIMEHOOK_GATES.set("TerwayQoS", True)
+        yield
+        RUNTIMEHOOK_GATES.set("TerwayQoS", False)
+
+    def test_writes_and_removes_bandwidth_file(self, cfg):
+        hook = TerwayQoS(cfg)
+        p = pod(qos=QoSClass.BE, annotations={
+            ext.ANNOTATION_NETWORK_QOS: json.dumps(
+                {"ingressBps": 1_000_000, "egressBps": 2_000_000})})
+        run_hook(hook, p)
+        path = os.path.join(cfg.var_run_root, "terway-qos", "pod-1.json")
+        data = json.load(open(path))
+        assert data == {"podUID": "pod-1", "ingressBps": 1_000_000,
+                        "egressBps": 2_000_000, "prio": 2}
+        hook.remove("pod-1")
+        assert not os.path.exists(path)
+
+    def test_no_annotation_no_file(self, cfg):
+        hook = TerwayQoS(cfg)
+        run_hook(hook, pod(QoSClass.LS))
+        assert not os.path.exists(os.path.join(
+            cfg.var_run_root, "terway-qos", "pod-1.json"))

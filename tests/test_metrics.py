@@ -112,3 +112,43 @@ class TestDashboards:
             pass
         assert m.scheduling_latency._totals[(("phase", "Solve"),)] == before + 1
         assert m.solver_batch_latency._totals[()] == solve_before + 1
+
+    def test_manager_and_descheduler_gauges_emit(self):
+        """The dashboard's manager/descheduler series are fed by their
+        controllers (registration alone isn't enough — panels need data)."""
+        from koordinator_tpu import metrics as m
+        from koordinator_tpu.descheduler.framework import (
+            MODE_DELETE, Evictor,
+        )
+        from koordinator_tpu.descheduler.migration import (
+            MigrationController, MigrationJob,
+        )
+        from koordinator_tpu.manager import sloconfig
+        from koordinator_tpu.manager.noderesource_controller import (
+            NodeRecord, NodeResourceController,
+        )
+
+        nrc = NodeResourceController(
+            sloconfig.ColocationConfig(enable=True), clock=lambda: 1000.0)
+        nrc.reconcile([NodeRecord(name="m1", cpu_capacity_milli=16_000,
+                                  mem_capacity_mib=32_768)])
+        assert m.batch_resource_allocatable.value(
+            labels={"node": "m1", "resource": "batch-cpu"}) == 0.0
+        # no metric report ever -> degraded -> expired gauge raised
+        assert m.node_metric_expired.value(labels={"node": "m1"}) == 1.0
+
+        ctl = MigrationController(clock=lambda: 0.0)
+        ctl.submit(MigrationJob(name="j1", pod="p1", node="n0"))
+        ctl.reconcile()
+        assert m.migration_jobs.value(labels={"phase": "Running"}) >= 1.0
+
+        ev = Evictor(mode=MODE_DELETE, delete_fn=lambda p: True)
+        ev.profile = "lownodeload"
+        before = m.descheduler_evictions_total.value(
+            labels={"profile": "lownodeload", "reason": "hot"})
+
+        class P:
+            uid = "p1"
+        ev.evict(P(), "hot")
+        assert m.descheduler_evictions_total.value(
+            labels={"profile": "lownodeload", "reason": "hot"}) == before + 1
