@@ -64,6 +64,7 @@ class ReservationSpec:
     allocated: np.ndarray | None = None     # (R,)
     owner_pods: list[str] = dataclasses.field(default_factory=list)
     available_at: float = 0.0
+    created_at: float = 0.0                 # for Pending-phase TTL expiry
 
 
 class ReservationCache:
@@ -101,19 +102,51 @@ class ReservationCache:
         snapshot.reserve(node, spec.requests)
 
     def expire_tick(self, now: float, snapshot: ClusterSnapshot) -> list[str]:
-        """Expire Available reservations past their TTL; the unallocated
-        remainder returns to node free capacity (controller/ expiration)."""
+        """Expire reservations past their TTL: an Available one returns its
+        unallocated remainder to node free capacity (controller/ expiration);
+        a still-Pending one (reserve-pod never placed) simply expires —
+        nothing was ever charged."""
         expired = []
         for spec in self._specs.values():
+            if spec.ttl_sec is None:
+                continue
             if (
                 spec.phase is ReservationPhase.AVAILABLE
-                and spec.ttl_sec is not None
                 and now - spec.available_at >= spec.ttl_sec
             ):
                 spec.phase = ReservationPhase.EXPIRED
                 self._return_remainder(spec, snapshot)
                 expired.append(spec.name)
+            elif (
+                spec.phase is ReservationPhase.PENDING
+                and now - spec.created_at >= spec.ttl_sec
+            ):
+                spec.phase = ReservationPhase.EXPIRED
+                expired.append(spec.name)
         return expired
+
+    def pending(self) -> list[ReservationSpec]:
+        return [
+            s for s in self._specs.values()
+            if s.phase is ReservationPhase.PENDING
+        ]
+
+    def return_allocation(self, name: str, drawn: np.ndarray) -> bool:
+        """An owner pod freed: give its drawn vector back to the reservation
+        remainder.  Returns True when the reservation still holds the node
+        charge (caller then unreserves only the pod's spill); False when the
+        reservation is gone/consumed (caller frees the pod's full requests)."""
+        spec = self._specs.get(name)
+        if (
+            spec is None
+            or spec.allocated is None
+            or spec.phase is not ReservationPhase.AVAILABLE
+        ):
+            return False
+        spec.allocated = np.maximum(
+            spec.allocated - drawn.astype(spec.allocated.dtype), 0
+        )
+        return True
 
     def _return_remainder(self, spec: ReservationSpec, snapshot: ClusterSnapshot) -> None:
         remainder = spec.requests - (
@@ -176,8 +209,13 @@ class ReservationCache:
         pods: list[PodSpec],
         assignments: np.ndarray,     # (P,) node rows
         rsv_choice: np.ndarray,      # (P,) reservation rows, -1 = none
-    ) -> None:
-        """Mirror the device-side allocation back into host specs (Reserve)."""
+    ) -> list[np.ndarray | None]:
+        """Mirror the device-side allocation back into host specs (Reserve).
+
+        Returns the per-pod vector drawn from its reservation (None for pods
+        that didn't allocate through one) so bind records can return it when
+        the pod is later freed."""
+        drawn: list[np.ndarray | None] = [None] * len(pods)
         for i, pod in enumerate(pods):
             r = int(rsv_choice[i])
             if r < 0 or r >= len(names) or int(assignments[i]) < 0:
@@ -194,6 +232,11 @@ class ReservationCache:
             take = np.minimum(pod.requests.astype(np.int64), remainder)
             spec.allocated = spec.allocated + take.astype(spec.allocated.dtype)
             spec.owner_pods.append(pod.name)
+            drawn[i] = take
             if spec.allocate_once:
+                # the whole remainder is consumed on the pod's behalf; it
+                # must free with the pod, not leak when the pod dies
+                drawn[i] = remainder
                 spec.allocated = spec.requests.copy()
                 spec.phase = ReservationPhase.SUCCEEDED
+        return drawn

@@ -43,6 +43,10 @@ from koordinator_tpu.scheduler.monitor import SchedulerMonitor
 from koordinator_tpu.scheduler.snapshot import ClusterSnapshot, PodSpec
 from koordinator_tpu.state.cluster_state import PodBatch, _bucket
 
+#: pending-queue key prefix for synthetic reserve-pods (the reference models
+#: a Reservation as a pod the scheduler places; reservation_types.go)
+RSV_POD_PREFIX = "rsv::"
+
 
 @dataclasses.dataclass
 class PdbRecord:
@@ -72,6 +76,12 @@ class BoundPod:
     non_preemptible: bool = False
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
     gang: str | None = None
+    #: reservation this pod allocated from, and how much it drew — freeing
+    #: the pod returns the drawn part to the reservation remainder (the node
+    #: keeps the reservation's original charge), and unreserves only the
+    #: spill that was charged to the node at bind time
+    reservation: str | None = None
+    rsv_drawn: np.ndarray | None = None
 
 
 @dataclasses.dataclass
@@ -165,6 +175,19 @@ class Scheduler:
         self.batch_rebuilds = 0
         self._solve = jax.jit(gang_assign,
                               static_argnames=("passes", "solver"))
+        #: reservation lifecycle (plugins/reservation parity): reserve-pods
+        #: schedule through the normal rounds, Available sets get a
+        #: reservation-first exact solve pre-pass
+        from koordinator_tpu.ops.reservation import reservation_greedy_assign
+        from koordinator_tpu.scheduler.reservations import ReservationCache
+
+        self.reservations = ReservationCache()
+        self._rsv_solve = jax.jit(reservation_greedy_assign)
+        #: bound on pods routed through the sequential reservation pre-pass
+        #: per round — a popular owner selector must not drag a 50k-pod
+        #: round onto the O(P) exact scan (extras solve normally and can
+        #: draw reservations next round)
+        self.rsv_prepass_cap = 2048
 
         # -- preemption (PostFilter) state --
         # default: only preempt when someone is wired to actually evict the
@@ -211,11 +234,28 @@ class Scheduler:
 
     def remove_bound_pod(self, name: str) -> None:
         """Release a bound pod's node reservation iff still tracked (quota
-        stays with the caller: eviction paths release it themselves)."""
+        stays with the caller: eviction paths release it themselves).
+
+        A pod that allocated through a reservation gives its drawn vector
+        back to the reservation remainder (the reserved capacity stays
+        charged to the node, hidden from non-owners) and frees only its
+        spill; once the reservation is gone/consumed, the drawn backing
+        charge frees with the pod."""
         with self.lock:
             pod = self.bound.pop(name, None)
-            if pod is not None and pod.node in self.snapshot.node_index:
-                self.snapshot.unreserve(pod.node, pod.requests)
+            if pod is None or pod.node not in self.snapshot.node_index:
+                return
+            free_vec = pod.requests
+            if pod.reservation is not None and pod.rsv_drawn is not None:
+                drawn = pod.rsv_drawn.astype(np.int64)
+                if self.reservations.return_allocation(pod.reservation,
+                                                       drawn):
+                    free_vec = np.maximum(
+                        pod.requests.astype(np.int64) - drawn, 0)
+                else:
+                    free_vec = np.maximum(
+                        pod.requests.astype(np.int64), drawn)
+            self.snapshot.unreserve(pod.node, free_vec.astype(np.int32))
 
     def delete_pod(self, name: str) -> None:
         """Informer pod delete, whatever state the pod is in: a pending or
@@ -228,6 +268,151 @@ class Scheduler:
             if bound is not None:
                 self.remove_bound_pod(name)
                 self._charge_quota_used(bound, sign=-1)
+
+    def add_reservation(self, spec) -> None:
+        """Accept a Reservation CR: placement happens next round (a pinned
+        node goes Available directly; otherwise a synthetic reserve-pod
+        schedules through the normal solve).
+
+        Re-applying an existing name is an update: if the placed charge is
+        unchanged (same requests, same pin) only the mutable spec fields
+        move; otherwise the old reservation is removed first (returning its
+        remainder) so the new one can't double-charge the node."""
+        from koordinator_tpu.scheduler.reservations import ReservationPhase
+
+        with self.lock:
+            spec.created_at = self.clock()
+            old = self.reservations.get(spec.name)
+            if old is not None and old.phase in (
+                ReservationPhase.AVAILABLE, ReservationPhase.SUCCEEDED
+            ):
+                if (np.array_equal(old.requests, spec.requests)
+                        and spec.node in (None, old.node)):
+                    old.owners = spec.owners
+                    old.ttl_sec = spec.ttl_sec
+                    old.restricted = spec.restricted
+                    return
+                self.remove_reservation(spec.name)
+            self.reservations.upsert(spec)
+
+    def remove_reservation(self, name: str) -> None:
+        """Reservation CR deleted: return the unallocated remainder and drop
+        any in-flight reserve-pod."""
+        with self.lock:
+            self.reservations.remove(name, self.snapshot)
+            if self.pending.pop(RSV_POD_PREFIX + name, None) is not None:
+                self._pending_rev += 1
+
+    def _reservation_tick(self, now: float) -> None:
+        """Expire reservations; move Pending ones toward Available (pinned
+        node: direct, with a fit check; else enqueue a reserve-pod)."""
+        for name in self.reservations.expire_tick(now, self.snapshot):
+            # a Pending reservation that expired drops its reserve-pod too
+            if self.pending.pop(RSV_POD_PREFIX + name, None) is not None:
+                self._pending_rev += 1
+            if self.auditor is not None:
+                self.auditor.record(name, "ReservationExpired", "")
+        for spec in self.reservations.pending():
+            if spec.node is not None:
+                # pre-pinned: goes Available only if it actually fits —
+                # make_available charges the node, and an over-committed
+                # charge would block the node for everyone (the un-pinned
+                # path gets this fit check from the reserve-pod solve)
+                row = self.snapshot.node_index.get(spec.node)
+                if row is None:
+                    continue
+                free = (
+                    np.asarray(self.snapshot.state.node_allocatable[row])
+                    - np.asarray(self.snapshot.state.node_requested[row])
+                )
+                if np.all(spec.requests <= free):
+                    self.reservations.make_available(
+                        spec.name, spec.node, self.snapshot, now)
+                continue
+            key = RSV_POD_PREFIX + spec.name
+            if key not in self.pending:
+                self.pending[key] = PodSpec(
+                    name=key, requests=spec.requests.astype(np.int32),
+                    priority=9000)
+                self._pending_rev += 1
+
+    def _reservation_prepass(self, pods, batch, quota, result):
+        """Reservation-first exact solve over owner-matched pods (plugin.go
+        Reserve + nominator semantics): matched pods allocate from their
+        reservation's remainder before the general solve sees them.  Returns
+        the (possibly shrunk) batch and quota."""
+        avail = self.reservations.available()
+        if not avail:
+            return batch, quota
+        rsv_set, names = self.reservations.build_set(self.snapshot)
+        match = self.reservations.match_matrix(
+            pods, batch.capacity, rsv_set.capacity)
+        # reserve-pods can't consume reservations; gang members keep
+        # all-or-nothing semantics in the main solve
+        for i, pod in enumerate(pods):
+            if pod.name.startswith(RSV_POD_PREFIX) or pod.gang:
+                match[i] = False
+        matched = np.asarray(batch.valid) & match.any(axis=1)
+        if not matched.any():
+            return batch, quota
+        if int(matched.sum()) > self.rsv_prepass_cap:
+            prio = np.asarray(batch.priority)
+            rows = np.flatnonzero(matched)
+            keep = rows[np.argsort(-prio[rows], kind="stable")
+                        [: self.rsv_prepass_cap]]
+            matched = np.zeros_like(matched)
+            matched[keep] = True
+        small, idx = batch.compact(matched)
+        m_small = np.zeros((small.capacity, rsv_set.capacity), bool)
+        m_small[: len(idx)] = match[idx]
+        a_r, rc, new_state, _, new_quota = self._rsv_solve(
+            self.snapshot.state, small, self.config, rsv_set,
+            jnp.asarray(m_small), quota)
+        a_r, rc = np.asarray(a_r), np.asarray(rc)
+        self.snapshot.adopt_state(new_state)
+        sub_pods = [pods[i] for i in idx]
+        drawn = self.reservations.commit_allocations(names, sub_pods, a_r, rc)
+        bound_rows = [int(idx[j]) for j in range(len(sub_pods))
+                      if int(a_r[j]) >= 0]
+        for j, pod in enumerate(sub_pods):
+            if int(a_r[j]) >= 0:
+                r = int(rc[j])
+                self._commit_bind(
+                    pod, self.snapshot.node_name(int(a_r[j])), result,
+                    reservation=(names[r] if 0 <= r < len(names)
+                                 and drawn[j] is not None else None),
+                    rsv_drawn=drawn[j])
+        if bound_rows:
+            mask = np.zeros(batch.capacity, bool)
+            mask[bound_rows] = True
+            batch = batch.replace(valid=batch.valid & ~jnp.asarray(mask))
+        return batch, (new_quota if new_quota is not None else quota)
+
+    def _commit_reserve_pod(self, pod: PodSpec, node: str,
+                            result: SchedulingResult, now: float) -> None:
+        """The reserve-pod 'bound': its Reservation becomes Available.  The
+        solve already charged the reserved vector to node_requested, so no
+        further snapshot accounting (make_available charges only on the
+        pinned-node path, which bypasses the solve)."""
+        from koordinator_tpu.scheduler.reservations import ReservationPhase
+
+        rname = pod.name[len(RSV_POD_PREFIX):]
+        if self.pending.pop(pod.name, None) is not None:
+            self._pending_rev += 1
+        spec = self.reservations.get(rname)
+        if spec is None:
+            # CR deleted mid-round: release the solve's charge
+            self.snapshot.unreserve(node, pod.requests)
+            return
+        spec.node = node
+        spec.phase = ReservationPhase.AVAILABLE
+        spec.available_at = now
+        spec.allocated = np.zeros_like(spec.requests)
+        result.assignments[pod.name] = node
+        if self.explanations is not None:
+            self.explanations.delete(pod.name)
+        if self.auditor is not None:
+            self.auditor.record(pod.name, "ReservationAvailable", node)
 
     def enqueue(self, pod: PodSpec) -> None:
         with self.lock:
@@ -441,6 +626,10 @@ class Scheduler:
         now = self.clock()
         result = SchedulingResult({}, {}, 0)
         self.last_result = result  # debug-API diagnosis surface
+        if len(self.reservations):
+            with self.monitor.phase("Reservations"):
+                self.snapshot.flush()   # pinned-fit check reads device rows
+                self._reservation_tick(now)
         if self.nominations:
             with self.monitor.phase("Nominated"):
                 self.snapshot.flush()
@@ -463,6 +652,9 @@ class Scheduler:
             batch = self._apply_topology_plans(batch, gang_index)
 
         with self.monitor.phase("Solve"):
+            if len(self.reservations):
+                batch, quota = self._reservation_prepass(
+                    pods, batch, quota, result)
             solver = ("batch" if len(pods) >= self.batch_solver_threshold
                       else "greedy")
             self.last_solver = solver
@@ -529,6 +721,9 @@ class Scheduler:
                 node_row = int(a[i])
                 if node_row >= 0:
                     node = self.snapshot.node_name(node_row)
+                    if pod.name.startswith(RSV_POD_PREFIX):
+                        self._commit_reserve_pod(pod, node, result, now)
+                        continue
                     self._commit_bind(pod, node, result)
                     if pod.gang:
                         placed_gangs.add(pod.gang)
@@ -552,6 +747,10 @@ class Scheduler:
             failed_gangs: set[str] = set()
             for i, pod in enumerate(pods):
                 if int(a[i]) >= 0:
+                    continue
+                if pod.name in result.assignments:
+                    # bound by the reservation pre-pass (batch row was
+                    # invalidated before the main solve)
                     continue
                 diag = explain_pod(
                     self.snapshot.state, batch, self.config, i,
@@ -602,6 +801,8 @@ class Scheduler:
     def _commit_bind(
         self, pod: PodSpec, node: str, result: SchedulingResult,
         charge_quota: bool = True,
+        reservation: str | None = None,
+        rsv_drawn: np.ndarray | None = None,
     ) -> None:
         """Shared bind bookkeeping: assignments, bound registry, quota used.
 
@@ -616,6 +817,7 @@ class Scheduler:
             priority=pod.priority, quota=pod.quota,
             non_preemptible=pod.non_preemptible,
             labels=pod.labels, gang=pod.gang,
+            reservation=reservation, rsv_drawn=rsv_drawn,
         )
         if charge_quota:
             self._charge_quota_used(pod, sign=1)
@@ -798,7 +1000,10 @@ class Scheduler:
         victim set, evict, and nominate.  Gang members preempt all-or-nothing
         (job-level preemption, coscheduling preemption.go:206); quota-rejected
         pods preempt within their quota (elasticquota preempt.go:111)."""
-        failed = [p for p in pods if p.name in result.failures]
+        # reserve-pods don't preempt here: their nominate/bind flow is the
+        # reservation lifecycle, not the pod nomination machine
+        failed = [p for p in pods if p.name in result.failures
+                  and not p.name.startswith(RSV_POD_PREFIX)]
         if not failed:
             return
         quota_index = (

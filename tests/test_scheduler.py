@@ -391,3 +391,164 @@ def test_rescue_places_surplus_members_of_satisfied_gang():
     res = sched.schedule_round()
     assert sched.last_solver == "batch"
     assert len(res.assignments) == 5 and not res.failures
+
+
+class TestReservationRounds:
+    """Reservation lifecycle through the round loop (plugins/reservation:
+    reserve-pod placement, owner allocation, expiration)."""
+
+    def _spec(self, name="rsv-a", cpu=8_000, node=None, ttl=None,
+              labels=None, allocate_once=False):
+        from koordinator_tpu.scheduler.reservations import (
+            OwnerMatcher, ReservationSpec,
+        )
+
+        return ReservationSpec(
+            name=name, requests=resource_vector(cpu=cpu, memory=8_192),
+            owners=[OwnerMatcher(labels=labels or {"app": "web"})],
+            node=node, ttl_sec=ttl, allocate_once=allocate_once,
+        )
+
+    def test_reserve_pod_places_and_hides_capacity(self):
+        sched, _ = mk_scheduler([node("n1", cpu=10_000)])
+        sched.add_reservation(self._spec(cpu=8_000))
+        res = sched.schedule_round()
+        assert res.assignments.get("rsv::rsv-a") == "n1"
+        avail = sched.reservations.available()
+        assert [s.name for s in avail] == ["rsv-a"]
+        # the reserved capacity is invisible to non-owner pods
+        sched.enqueue(pod("other", cpu=4_000))
+        res = sched.schedule_round()
+        assert "other" in res.failures
+
+    def test_owner_pod_allocates_from_reservation(self):
+        sched, binds = mk_scheduler([node("n1", cpu=10_000),
+                                     node("n2", cpu=10_000)])
+        sched.add_reservation(self._spec(cpu=8_000))
+        sched.schedule_round()
+        rnode = sched.reservations.get("rsv-a").node
+        owner = pod("web-1", cpu=6_000, labels={"app": "web"})
+        sched.enqueue(owner)
+        res = sched.schedule_round()
+        # owner lands on the reserved node and charges the reservation
+        assert res.assignments["web-1"] == rnode
+        spec = sched.reservations.get("rsv-a")
+        assert spec.allocated[CPU] == 6_000
+        assert spec.owner_pods == ["web-1"]
+        # non-owner still can't use the remaining reserved 2k on that node
+
+    def test_pinned_reservation_available_without_solve(self):
+        sched, _ = mk_scheduler([node("n1", cpu=10_000)])
+        sched.add_reservation(self._spec(node="n1", cpu=8_000))
+        sched.enqueue(pod("other", cpu=4_000))
+        res = sched.schedule_round()
+        assert "other" in res.failures      # capacity charged by pin
+        assert sched.reservations.get("rsv-a").node == "n1"
+
+    def test_allocate_once_consumes_reservation(self):
+        sched, _ = mk_scheduler([node("n1", cpu=10_000)])
+        sched.add_reservation(self._spec(cpu=8_000, allocate_once=True))
+        sched.schedule_round()
+        sched.enqueue(pod("web-1", cpu=2_000, labels={"app": "web"}))
+        res = sched.schedule_round()
+        from koordinator_tpu.scheduler.reservations import ReservationPhase
+
+        assert res.assignments["web-1"] == "n1"
+        spec = sched.reservations.get("rsv-a")
+        assert spec.phase is ReservationPhase.SUCCEEDED
+        # consumed: next owner pod schedules on free capacity only
+        assert not sched.reservations.available()
+
+    def test_expiration_returns_remainder(self):
+        t = [0.0]
+        sched, _ = mk_scheduler([node("n1", cpu=10_000)])
+        sched.clock = lambda: t[0]
+        sched.add_reservation(self._spec(cpu=8_000, ttl=60.0))
+        sched.schedule_round()
+        assert sched.reservations.available()
+        t[0] = 120.0
+        sched.enqueue(pod("other", cpu=6_000))
+        res = sched.schedule_round()
+        # expired: remainder returned, non-owner fits again
+        assert res.assignments.get("other") == "n1"
+
+    def test_remove_reservation_frees_capacity(self):
+        sched, _ = mk_scheduler([node("n1", cpu=10_000)])
+        sched.add_reservation(self._spec(cpu=8_000))
+        sched.schedule_round()
+        sched.remove_reservation("rsv-a")
+        sched.enqueue(pod("other", cpu=6_000))
+        res = sched.schedule_round()
+        assert res.assignments.get("other") == "n1"
+
+    def test_owner_pod_delete_returns_allocation_not_node_capacity(self):
+        # regression: freeing an owner pod must return its drawn vector to
+        # the reservation remainder, NOT uncover reserved capacity
+        sched, _ = mk_scheduler([node("n1", cpu=10_000)])
+        sched.add_reservation(self._spec(cpu=8_000))
+        sched.schedule_round()
+        sched.enqueue(pod("web-1", cpu=6_000, labels={"app": "web"}))
+        sched.schedule_round()
+        sched.delete_pod("web-1")
+        spec = sched.reservations.get("rsv-a")
+        assert spec.allocated[CPU] == 0          # drawn part returned
+        # reserved capacity still hidden from non-owners
+        sched.enqueue(pod("other", cpu=4_000))
+        res = sched.schedule_round()
+        assert "other" in res.failures
+        # ...but a new owner can draw the full 8k again
+        sched.enqueue(pod("web-2", cpu=8_000, labels={"app": "web"}))
+        res = sched.schedule_round()
+        assert res.assignments.get("web-2") == "n1"
+
+    def test_reapply_available_reservation_is_idempotent(self):
+        # regression: upsert over an Available reservation must not
+        # double-charge the node via a second reserve-pod
+        sched, _ = mk_scheduler([node("n1", cpu=10_000)])
+        sched.add_reservation(self._spec(cpu=6_000))
+        sched.schedule_round()
+        sched.add_reservation(self._spec(cpu=6_000))   # controller resync
+        sched.schedule_round()
+        avail = sched.reservations.available()
+        assert len(avail) == 1 and avail[0].node == "n1"
+        # 4k remains genuinely free: exactly one 6k charge on the node
+        sched.enqueue(pod("other", cpu=4_000))
+        res = sched.schedule_round()
+        assert res.assignments.get("other") == "n1"
+
+    def test_pending_reservation_expires_by_ttl(self):
+        t = [0.0]
+        sched, _ = mk_scheduler([node("n1", cpu=2_000)])
+        sched.clock = lambda: t[0]
+        sched.add_reservation(self._spec(cpu=50_000, ttl=60.0))  # never fits
+        sched.schedule_round()
+        t[0] = 120.0
+        sched.schedule_round()
+        from koordinator_tpu.scheduler.reservations import ReservationPhase
+
+        assert (sched.reservations.get("rsv-a").phase
+                is ReservationPhase.EXPIRED)
+        assert "rsv::rsv-a" not in sched.pending
+
+    def test_pinned_reservation_waits_for_fit(self):
+        # a pinned reservation larger than the node's free capacity must
+        # stay Pending instead of over-committing the node
+        sched, _ = mk_scheduler([node("n1", cpu=2_000)])
+        sched.add_reservation(self._spec(node="n1", cpu=8_000))
+        sched.enqueue(pod("other", cpu=1_000))
+        res = sched.schedule_round()
+        assert res.assignments.get("other") == "n1"  # node NOT blocked
+        assert not sched.reservations.available()
+
+    def test_allocate_once_frees_fully_with_owner_pod(self):
+        # allocate-once consumed by a 2k pod holds the full 8k; the whole
+        # charge must free when that pod dies
+        sched, _ = mk_scheduler([node("n1", cpu=10_000)])
+        sched.add_reservation(self._spec(cpu=8_000, allocate_once=True))
+        sched.schedule_round()
+        sched.enqueue(pod("web-1", cpu=2_000, labels={"app": "web"}))
+        sched.schedule_round()
+        sched.delete_pod("web-1")
+        sched.enqueue(pod("other", cpu=9_000))
+        res = sched.schedule_round()
+        assert res.assignments.get("other") == "n1"
