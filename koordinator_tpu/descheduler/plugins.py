@@ -188,3 +188,60 @@ def migration_evict_fn(controller: MigrationController,
         return True
 
     return evict
+
+
+def scheduler_reserve_fn(
+    scheduler, ttl_sec: float = 1800.0
+) -> Callable[[MigrationJob], str | None]:
+    """Reservation-first arbitration against the in-process scheduler
+    (migration/reservation.go: secure replacement capacity BEFORE evicting):
+    create a Reservation sized to the migrating pod and owned by its labels
+    or workload, run a round to place it, and hand the name to the job.
+    Placement back on the source node is rejected — a migration must move
+    the pod — and a failed placement cleans the reservation up.
+
+    The reservation is allocate-once (it backs exactly one replacement pod;
+    its charge then lives and dies with that pod) with a TTL so a
+    replacement that never arrives can't hide capacity forever."""
+    from koordinator_tpu.scheduler.reservations import (
+        OwnerMatcher,
+        ReservationPhase,
+        ReservationSpec,
+    )
+
+    def reserve(job: MigrationJob) -> str | None:
+        bound = scheduler.bound.get(job.pod)
+        if bound is None:
+            return None
+        owners = ([OwnerMatcher(labels=dict(bound.labels))]
+                  if bound.labels else [])
+        if not owners and job.workload:
+            owners = [OwnerMatcher(controller=job.workload)]
+        if not owners:
+            return None
+        name = f"migrate-{job.name}"
+        scheduler.add_reservation(ReservationSpec(
+            name=name, requests=np.asarray(bound.requests), owners=owners,
+            allocate_once=True, ttl_sec=ttl_sec))
+        scheduler.schedule_round()
+        spec = scheduler.reservations.get(name)
+        if (spec is not None
+                and spec.phase is ReservationPhase.AVAILABLE
+                and spec.node != bound.node):
+            return name
+        scheduler.remove_reservation(name)
+        return None
+
+    return reserve
+
+
+def scheduler_migration_evict_fn(scheduler) -> Callable[[MigrationJob], bool]:
+    """evict_fn for :class:`MigrationController` against the in-process
+    scheduler: the bound pod releases its capacity (and quota) the way an
+    informer pod-delete would."""
+
+    def evict(job: MigrationJob) -> bool:
+        scheduler.delete_pod(job.pod)
+        return True
+
+    return evict

@@ -65,6 +65,9 @@ class ReservationSpec:
     owner_pods: list[str] = dataclasses.field(default_factory=list)
     available_at: float = 0.0
     created_at: float = 0.0                 # for Pending-phase TTL expiry
+    #: instance identity: a same-named re-created reservation gets a new
+    #: generation, so stale bind records can't credit the wrong instance
+    generation: int = 0
 
 
 class ReservationCache:
@@ -72,6 +75,7 @@ class ReservationCache:
 
     def __init__(self) -> None:
         self._specs: dict[str, ReservationSpec] = {}
+        self._next_generation = 1
 
     def __len__(self) -> int:
         return len(self._specs)
@@ -80,7 +84,24 @@ class ReservationCache:
         return self._specs.get(name)
 
     def upsert(self, spec: ReservationSpec) -> None:
+        spec.generation = self._next_generation
+        self._next_generation += 1
         self._specs[spec.name] = spec
+
+    def gc(self) -> list[str]:
+        """Drop terminal specs (EXPIRED / SUCCEEDED): their accounting is
+        settled — an Expired reservation returned its remainder, a Succeeded
+        one frees with its consuming pod (return_allocation rejects both by
+        phase, so bind records of dead instances free their full vector)."""
+        dead = [
+            n for n, s in self._specs.items()
+            if s.phase in (ReservationPhase.EXPIRED,
+                           ReservationPhase.SUCCEEDED,
+                           ReservationPhase.FAILED)
+        ]
+        for n in dead:
+            del self._specs[n]
+        return dead
 
     def remove(self, name: str, snapshot: ClusterSnapshot | None = None) -> None:
         spec = self._specs.pop(name, None)
@@ -131,16 +152,19 @@ class ReservationCache:
             if s.phase is ReservationPhase.PENDING
         ]
 
-    def return_allocation(self, name: str, drawn: np.ndarray) -> bool:
+    def return_allocation(self, name: str, drawn: np.ndarray,
+                          generation: int = 0) -> bool:
         """An owner pod freed: give its drawn vector back to the reservation
-        remainder.  Returns True when the reservation still holds the node
-        charge (caller then unreserves only the pod's spill); False when the
-        reservation is gone/consumed (caller frees the pod's full requests)."""
+        remainder.  Returns True when the SAME reservation instance still
+        holds the node charge (caller then unreserves only the pod's spill);
+        False when it is gone/consumed/re-created (caller frees the pod's
+        full backing)."""
         spec = self._specs.get(name)
         if (
             spec is None
             or spec.allocated is None
             or spec.phase is not ReservationPhase.AVAILABLE
+            or (generation and spec.generation != generation)
         ):
             return False
         spec.allocated = np.maximum(

@@ -82,6 +82,7 @@ class BoundPod:
     #: spill that was charged to the node at bind time
     reservation: str | None = None
     rsv_drawn: np.ndarray | None = None
+    rsv_generation: int = 0
 
 
 @dataclasses.dataclass
@@ -188,6 +189,7 @@ class Scheduler:
         #: round onto the O(P) exact scan (extras solve normally and can
         #: draw reservations next round)
         self.rsv_prepass_cap = 2048
+        self._rsv_match_cache: tuple[tuple, np.ndarray] | None = None
 
         # -- preemption (PostFilter) state --
         # default: only preempt when someone is wired to actually evict the
@@ -248,8 +250,8 @@ class Scheduler:
             free_vec = pod.requests
             if pod.reservation is not None and pod.rsv_drawn is not None:
                 drawn = pod.rsv_drawn.astype(np.int64)
-                if self.reservations.return_allocation(pod.reservation,
-                                                       drawn):
+                if self.reservations.return_allocation(
+                        pod.reservation, drawn, pod.rsv_generation):
                     free_vec = np.maximum(
                         pod.requests.astype(np.int64) - drawn, 0)
                 else:
@@ -294,6 +296,10 @@ class Scheduler:
                     return
                 self.remove_reservation(spec.name)
             self.reservations.upsert(spec)
+            # a still-queued reserve-pod carries the OLD requests vector;
+            # drop it so the next tick re-enqueues the updated one
+            if self.pending.pop(RSV_POD_PREFIX + spec.name, None) is not None:
+                self._pending_rev += 1
 
     def remove_reservation(self, name: str) -> None:
         """Reservation CR deleted: return the unallocated remainder and drop
@@ -312,6 +318,9 @@ class Scheduler:
                 self._pending_rev += 1
             if self.auditor is not None:
                 self.auditor.record(name, "ReservationExpired", "")
+        # terminal specs are settled accounting-wise; purge so long-running
+        # schedulers don't pay an ever-growing Reservations tick
+        self.reservations.gc()
         for spec in self.reservations.pending():
             if spec.node is not None:
                 # pre-pinned: goes Available only if it actually fits —
@@ -344,14 +353,29 @@ class Scheduler:
         avail = self.reservations.available()
         if not avail:
             return batch, quota
+        # fully-consumed reservations have nothing to lend — skip the
+        # whole pre-pass (and its O(P) host-side owner matching)
+        if not any(np.any(s.requests > s.allocated) for s in avail
+                   if s.allocated is not None):
+            return batch, quota
         rsv_set, names = self.reservations.build_set(self.snapshot)
-        match = self.reservations.match_matrix(
-            pods, batch.capacity, rsv_set.capacity)
-        # reserve-pods can't consume reservations; gang members keep
-        # all-or-nothing semantics in the main solve
-        for i, pod in enumerate(pods):
-            if pod.name.startswith(RSV_POD_PREFIX) or pod.gang:
-                match[i] = False
+        # the O(pods x reservations) python owner matching is cached
+        # between rounds over an unchanged queue + reservation set (the
+        # PodBatch cache analog): steady-state rounds pay a dict lookup
+        mkey = (self._pending_rev,
+                tuple(s.generation for s in avail))
+        cached = self._rsv_match_cache
+        if cached is not None and cached[0] == mkey:
+            match = cached[1].copy()
+        else:
+            match = self.reservations.match_matrix(
+                pods, batch.capacity, rsv_set.capacity)
+            # reserve-pods can't consume reservations; gang members keep
+            # all-or-nothing semantics in the main solve
+            for i, pod in enumerate(pods):
+                if pod.name.startswith(RSV_POD_PREFIX) or pod.gang:
+                    match[i] = False
+            self._rsv_match_cache = (mkey, match.copy())
         matched = np.asarray(batch.valid) & match.any(axis=1)
         if not matched.any():
             return batch, quota
@@ -377,11 +401,14 @@ class Scheduler:
         for j, pod in enumerate(sub_pods):
             if int(a_r[j]) >= 0:
                 r = int(rc[j])
+                rname = (names[r] if 0 <= r < len(names)
+                         and drawn[j] is not None else None)
+                rspec = (self.reservations.get(rname)
+                         if rname is not None else None)
                 self._commit_bind(
                     pod, self.snapshot.node_name(int(a_r[j])), result,
-                    reservation=(names[r] if 0 <= r < len(names)
-                                 and drawn[j] is not None else None),
-                    rsv_drawn=drawn[j])
+                    reservation=rname, rsv_drawn=drawn[j],
+                    rsv_generation=(rspec.generation if rspec else 0))
         if bound_rows:
             mask = np.zeros(batch.capacity, bool)
             mask[bound_rows] = True
@@ -640,8 +667,10 @@ class Scheduler:
             return result
         if self.auditor is not None:
             # one attempt per workload key per round — a gang is one
-            # scheduling attempt, not len(members) attempts
-            for key in {pod.gang or pod.name for pod in pods}:
+            # scheduling attempt, not len(members) attempts; synthetic
+            # reserve-pods are not workloads
+            for key in {pod.gang or pod.name for pod in pods
+                        if not pod.name.startswith(RSV_POD_PREFIX)}:
                 self.auditor.record_attempt(key)
 
         with self.monitor.phase("BatchBuild"):
@@ -788,6 +817,10 @@ class Scheduler:
             # persist AFTER PostFilter so nominations land on the CR
             # (successful binds already cleared theirs in _commit_bind)
             for pod in pods:
+                if pod.name.startswith(RSV_POD_PREFIX):
+                    # an unplaced reservation retries next round; it is not
+                    # a user pod and must not persist ScheduleFailed CRs
+                    continue
                 diag = result.failures.get(pod.name)
                 if diag is not None:
                     self.explanations.record(pod.name, diag)
@@ -803,6 +836,7 @@ class Scheduler:
         charge_quota: bool = True,
         reservation: str | None = None,
         rsv_drawn: np.ndarray | None = None,
+        rsv_generation: int = 0,
     ) -> None:
         """Shared bind bookkeeping: assignments, bound registry, quota used.
 
@@ -818,6 +852,7 @@ class Scheduler:
             non_preemptible=pod.non_preemptible,
             labels=pod.labels, gang=pod.gang,
             reservation=reservation, rsv_drawn=rsv_drawn,
+            rsv_generation=rsv_generation,
         )
         if charge_quota:
             self._charge_quota_used(pod, sign=1)

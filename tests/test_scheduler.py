@@ -524,10 +524,8 @@ class TestReservationRounds:
         sched.schedule_round()
         t[0] = 120.0
         sched.schedule_round()
-        from koordinator_tpu.scheduler.reservations import ReservationPhase
-
-        assert (sched.reservations.get("rsv-a").phase
-                is ReservationPhase.EXPIRED)
+        # expired AND purged by the terminal-phase gc
+        assert sched.reservations.get("rsv-a") is None
         assert "rsv::rsv-a" not in sched.pending
 
     def test_pinned_reservation_waits_for_fit(self):
@@ -552,3 +550,85 @@ class TestReservationRounds:
         sched.enqueue(pod("other", cpu=9_000))
         res = sched.schedule_round()
         assert res.assignments.get("other") == "n1"
+
+
+class TestMigrationWithReservations:
+    _spec = TestReservationRounds._spec
+
+    def test_reservation_first_migration_end_to_end(self):
+        """SURVEY 3.4 flow against real scheduler reservations: the
+        migration controller secures replacement capacity on another node
+        BEFORE evicting, and the replacement pod lands on it."""
+        from koordinator_tpu.descheduler.migration import (
+            MigrationController, MigrationJob,
+        )
+        from koordinator_tpu.descheduler.plugins import (
+            scheduler_migration_evict_fn, scheduler_reserve_fn,
+        )
+
+        # the pod binds while only the (soon-to-be-)hot node exists; the
+        # cool node joins afterwards — the classic rebalance setup
+        sched, _ = mk_scheduler([node("hot", cpu=10_000, usage_cpu=9_000)])
+        sched.enqueue(pod("web-1", cpu=4_000, labels={"app": "web"}))
+        res = sched.schedule_round()
+        src = res.assignments["web-1"]
+        assert src == "hot"
+        sched.snapshot.upsert_node(node("cool", cpu=10_000))
+
+        ctl = MigrationController(
+            reserve_fn=scheduler_reserve_fn(sched),
+            evict_fn=scheduler_migration_evict_fn(sched),
+        )
+        ctl.submit(MigrationJob(name="j1", pod="web-1", node=src))
+        ctl.reconcile()   # arbitrate: reserve on the other node
+        job = ctl.jobs["j1"]
+        assert job.reservation == "migrate-j1"
+        spec = sched.reservations.get("migrate-j1")
+        assert spec.node is not None and spec.node != src
+        ctl.reconcile()   # running: evict
+        assert "web-1" not in sched.bound
+
+        # the replacement pod allocates from the secured reservation
+        sched.enqueue(pod("web-1", cpu=4_000, labels={"app": "web"}))
+        res = sched.schedule_round()
+        assert res.assignments["web-1"] == spec.node
+        assert sched.reservations.get("migrate-j1").allocated[CPU] == 4_000
+
+    def test_recreated_reservation_not_credited_by_old_pods(self):
+        # generation check: a pod bound through a deleted reservation must
+        # not corrupt a later same-named instance's accounting
+        sched, _ = mk_scheduler([node("n1", cpu=20_000)])
+        sched.add_reservation(self._spec(cpu=8_000))
+        sched.schedule_round()
+        sched.enqueue(pod("web-1", cpu=4_000, labels={"app": "web"}))
+        sched.schedule_round()
+        sched.remove_reservation("rsv-a")           # old instance gone
+        sched.add_reservation(self._spec(cpu=6_000))  # new instance
+        sched.schedule_round()
+        new_spec = sched.reservations.get("rsv-a")
+        assert new_spec.allocated[CPU] == 0
+        sched.delete_pod("web-1")                   # old-instance owner dies
+        # the NEW instance's remainder is untouched
+        assert sched.reservations.get("rsv-a").allocated[CPU] == 0
+        # node accounting consistent: 6k (new rsv) charged, rest free
+        sched.enqueue(pod("other", cpu=14_000))
+        res = sched.schedule_round()
+        assert res.assignments.get("other") == "n1"
+
+    def test_pending_update_refreshes_reserve_pod_requests(self):
+        # updating a still-Pending reservation must re-enqueue the reserve
+        # pod with the NEW vector, not open a 4k claim backed by a 1k charge
+        sched, _ = mk_scheduler([node("n1", cpu=10_000)])
+        sched.add_reservation(self._spec(cpu=1_000))
+        # don't run a round yet: the reserve-pod sits queued at 1k
+        sched.add_reservation(self._spec(cpu=4_000))
+        sched.schedule_round()
+        spec = sched.reservations.get("rsv-a")
+        assert spec.node == "n1"
+        # exactly 4k charged: a 7k pod must NOT fit (10k - 4k = 6k free)
+        sched.enqueue(pod("big", cpu=7_000))
+        res = sched.schedule_round()
+        assert "big" in res.failures
+        sched.enqueue(pod("ok", cpu=6_000))
+        res = sched.schedule_round()
+        assert res.assignments.get("ok") == "n1"
