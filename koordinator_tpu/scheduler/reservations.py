@@ -146,6 +146,9 @@ class ReservationCache:
                 expired.append(spec.name)
         return expired
 
+    def specs(self) -> list[ReservationSpec]:
+        return list(self._specs.values())
+
     def pending(self) -> list[ReservationSpec]:
         return [
             s for s in self._specs.values()

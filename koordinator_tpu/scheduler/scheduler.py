@@ -293,6 +293,10 @@ class Scheduler:
                     old.owners = spec.owners
                     old.ttl_sec = spec.ttl_sec
                     old.restricted = spec.restricted
+                    # owner edits change who matches: drop the cached
+                    # owner-match matrix (generation stays — bind records
+                    # against this instance remain valid)
+                    self._rsv_match_cache = None
                     return
                 self.remove_reservation(spec.name)
             self.reservations.upsert(spec)
@@ -362,7 +366,12 @@ class Scheduler:
         # the O(pods x reservations) python owner matching is cached
         # between rounds over an unchanged queue + reservation set (the
         # PodBatch cache analog): steady-state rounds pay a dict lookup
+        # the key must cover everything the matrix depends on: the active
+        # pod ROW ORDER (gang rejection shrinks _active_pods without
+        # bumping _pending_rev), and reservation identity/owners (owner
+        # edits clear the cache in add_reservation)
         mkey = (self._pending_rev,
+                tuple(p.name for p in pods),
                 tuple(s.generation for s in avail))
         cached = self._rsv_match_cache
         if cached is not None and cached[0] == mkey:

@@ -59,6 +59,7 @@ class DebugService:
         self.register("/apis/v1/pods", self._pods)
         self.register("/apis/v1/gangs", self._gangs)
         self.register("/apis/v1/quotas", self._quotas)
+        self.register("/apis/v1/reservations", self._reservations)
         self.register("/apis/v1/diagnosis", self._diagnosis)
         self.register("/apis/v1/__debug/scores", self._scores)
         self.register("/apis/v1/__debug/set-top-n", self._set_top_n)
@@ -103,6 +104,17 @@ class DebugService:
              "used": np.asarray(node.used).tolist(),
              "runtime": np.asarray(tree.runtime_of(name)).tolist()}
             for name, node in tree.nodes.items()
+        ]
+
+    def _reservations(self, params: dict) -> object:
+        return [
+            {"name": s.name, "phase": s.phase.value, "node": s.node,
+             "requests": np.asarray(s.requests).tolist(),
+             "allocated": (np.asarray(s.allocated).tolist()
+                           if s.allocated is not None else None),
+             "owner_pods": list(s.owner_pods),
+             "allocate_once": s.allocate_once}
+            for s in self.scheduler.reservations.specs()
         ]
 
     def _diagnosis(self, params: dict) -> object:

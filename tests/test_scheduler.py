@@ -632,3 +632,34 @@ class TestMigrationWithReservations:
         sched.enqueue(pod("ok", cpu=6_000))
         res = sched.schedule_round()
         assert res.assignments.get("ok") == "n1"
+
+    def test_debug_service_reservations_route(self):
+        from koordinator_tpu.scheduler.services import DebugService
+
+        sched, _ = mk_scheduler([node("n1", cpu=10_000)])
+        svc = DebugService(sched)
+        sched.add_reservation(self._spec(cpu=6_000))
+        sched.schedule_round()
+        status, body = svc.handle("/apis/v1/reservations")
+        assert status == 200
+        assert body[0]["name"] == "rsv-a"
+        assert body[0]["phase"] == "Available"
+        assert body[0]["node"] == "n1"
+
+    def test_owner_update_reaches_prepass_cache(self):
+        from koordinator_tpu.scheduler.reservations import OwnerMatcher
+
+        sched, _ = mk_scheduler([node("n1", cpu=10_000)])
+        sched.add_reservation(self._spec(cpu=8_000, labels={"app": "web"}))
+        sched.schedule_round()
+        # a db pod isn't an owner: reserved capacity hidden
+        sched.enqueue(pod("db-1", cpu=6_000, labels={"app": "db"}))
+        res = sched.schedule_round()
+        assert "db-1" in res.failures
+        # owners widened in place (same requests): db now matches
+        spec = self._spec(cpu=8_000)
+        spec.owners = [OwnerMatcher(labels={"app": "db"})]
+        sched.add_reservation(spec)
+        res = sched.schedule_round()
+        assert res.assignments.get("db-1") == "n1"
+        assert sched.reservations.get("rsv-a").allocated[CPU] == 6_000
