@@ -249,10 +249,47 @@ def _bench_colocation(rtt: float) -> dict:
     return {"spark_colocation_e2e_pods_per_sec_3n": round(n_scheduled / dt, 1)}
 
 
+def _device_alive(timeout_s: float = 180.0) -> bool:
+    """Probe the backend with a tiny kernel under a thread timeout.  Through
+    the axon tunnel a dead link HANGS readbacks rather than erroring, which
+    would wedge the whole bench run; a probe that doesn't come back in time
+    means 'record device-unreachable and exit' instead."""
+    import threading
+
+    ok: list[bool] = []
+    err: list[BaseException] = []
+
+    def probe():
+        try:
+            x = jnp.ones((8, 8))
+            float((x @ x).sum())
+            ok.append(True)
+        except Exception as e:     # errored, as opposed to hung
+            err.append(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if err:
+        raise err[0]   # real backend error: surface the traceback, rc!=0
+    return bool(ok)
+
+
 def main() -> None:
     from __graft_entry__ import _build_problem
     from koordinator_tpu.ops.assignment import score_pods
     from koordinator_tpu.ops.batch_assign import batch_assign
+
+    if not _device_alive():
+        import os
+
+        print(json.dumps({
+            "metric": f"solve_pods_per_sec_{N_PODS}p_{N_NODES}n",
+            "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
+            "extra": {"error": "device unreachable: probe kernel did not "
+                               "complete within 180s (tunnel down?)"},
+        }))
+        os._exit(0)   # a hung device thread must not block exit
 
     state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
 

@@ -375,7 +375,7 @@ class Scheduler:
                 tuple(s.generation for s in avail))
         cached = self._rsv_match_cache
         if cached is not None and cached[0] == mkey:
-            match = cached[1].copy()
+            match = cached[1]          # read-only below: no defensive copy
         else:
             match = self.reservations.match_matrix(
                 pods, batch.capacity, rsv_set.capacity)
@@ -384,7 +384,7 @@ class Scheduler:
             for i, pod in enumerate(pods):
                 if pod.name.startswith(RSV_POD_PREFIX) or pod.gang:
                     match[i] = False
-            self._rsv_match_cache = (mkey, match.copy())
+            self._rsv_match_cache = (mkey, match)
         matched = np.asarray(batch.valid) & match.any(axis=1)
         if not matched.any():
             return batch, quota
