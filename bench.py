@@ -289,7 +289,10 @@ def main() -> None:
             "extra": {"error": "device unreachable: probe kernel did not "
                                "complete within 180s (tunnel down?)"},
         }))
-        os._exit(0)   # a hung device thread must not block exit
+        import sys
+
+        sys.stdout.flush()   # os._exit skips buffered-IO teardown
+        os._exit(0)          # a hung device thread must not block exit
 
     state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
 

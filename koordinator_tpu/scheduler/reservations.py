@@ -58,6 +58,10 @@ class ReservationSpec:
     restricted: bool = False                # AllocatePolicy Restricted vs Aligned
     ttl_sec: float | None = None            # spec.ttl; None = never expires
     node: str | None = None                 # pre-pinned node (spec.template nodeName)
+    #: reserve-pod template placement constraints (spec.template
+    #: nodeSelector / tolerations) — honored by the placement solve
+    node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    tolerations: dict[str, str] = dataclasses.field(default_factory=dict)
 
     # status
     phase: ReservationPhase = ReservationPhase.PENDING

@@ -346,7 +346,8 @@ class Scheduler:
             if key not in self.pending:
                 self.pending[key] = PodSpec(
                     name=key, requests=spec.requests.astype(np.int32),
-                    priority=9000)
+                    priority=9000, node_selector=dict(spec.node_selector),
+                    tolerations=dict(spec.tolerations))
                 self._pending_rev += 1
 
     def _reservation_prepass(self, pods, batch, quota, result):

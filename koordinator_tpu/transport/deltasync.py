@@ -35,6 +35,8 @@ NODE_UPSERT = "node_upsert"
 NODE_REMOVE = "node_remove"
 POD_ADD = "pod_add"
 POD_REMOVE = "pod_remove"
+RSV_UPSERT = "rsv_upsert"
+RSV_REMOVE = "rsv_remove"
 
 
 class ResyncRequired(Exception):
@@ -111,6 +113,7 @@ class StateSyncService:
         self.log = DeltaLog(retention)
         self.nodes: dict[str, dict] = {}      # name -> {doc, arrays}
         self.pods: dict[str, dict] = {}       # name -> {doc, arrays}
+        self.reservations: dict[str, dict] = {}
         self._server = None
 
     # -- mutations (informer event handlers) --------------------------------
@@ -154,11 +157,14 @@ class StateSyncService:
     def add_pod(self, name: str, requests: np.ndarray,
                 priority: int = 0, quota: str | None = None,
                 gang: str | None = None,
-                node_selector: dict | None = None) -> int:
+                node_selector: dict | None = None,
+                labels: dict | None = None,
+                owner: str | None = None) -> int:
         arrays = {"requests": np.asarray(requests, np.int32)}
         doc = {"kind": POD_ADD, "name": name, "priority": priority,
                "quota": quota, "gang": gang,
-               "node_selector": node_selector or {}}
+               "node_selector": node_selector or {},
+               "labels": labels or {}, "owner": owner}
         with self._lock:
             self.pods[name] = {"doc": doc, "arrays": arrays}
         return self._commit(doc, arrays)
@@ -168,6 +174,32 @@ class StateSyncService:
             self.pods.pop(name, None)
         return self._commit({"kind": POD_REMOVE, "name": name}, {})
 
+    def upsert_reservation(self, name: str, requests: np.ndarray,
+                           owners: list[dict] | None = None,
+                           allocate_once: bool = False,
+                           ttl_sec: float | None = None,
+                           node: str | None = None,
+                           node_selector: dict | None = None,
+                           tolerations: dict | None = None,
+                           restricted: bool = False) -> int:
+        """Reservation CR event.  ``owners`` is a list of matcher dicts:
+        {"labels": {...}} and/or {"controller": "..."} per entry."""
+        arrays = {"requests": np.asarray(requests, np.int64)}
+        doc = {"kind": RSV_UPSERT, "name": name,
+               "owners": owners or [], "allocate_once": bool(allocate_once),
+               "ttl_sec": ttl_sec, "node": node,
+               "node_selector": node_selector or {},
+               "tolerations": tolerations or {},
+               "restricted": bool(restricted)}
+        with self._lock:
+            self.reservations[name] = {"doc": doc, "arrays": arrays}
+        return self._commit(doc, arrays)
+
+    def remove_reservation(self, name: str) -> int:
+        with self._lock:
+            self.reservations.pop(name, None)
+        return self._commit({"kind": RSV_REMOVE, "name": name}, {})
+
     # -- wire handlers -------------------------------------------------------
 
     def attach(self, server) -> None:
@@ -176,7 +208,11 @@ class StateSyncService:
 
     def _snapshot(self) -> tuple[dict, dict[str, np.ndarray]]:
         events = []
-        for entry in list(self.nodes.values()) + list(self.pods.values()):
+        # replay order matters: nodes before reservations (placement needs
+        # rows) before pods (owners need Available reservations)
+        for entry in (list(self.nodes.values())
+                      + list(self.reservations.values())
+                      + list(self.pods.values())):
             events.append((self.rv, entry["doc"], entry["arrays"]))
         doc, arrays = _pack_events(events)
         doc["rv"] = self.rv
@@ -295,6 +331,10 @@ class StateSyncClient:
             self.binding.pod_add(entry, arrs)
         elif kind == POD_REMOVE:
             self.binding.pod_remove(entry["name"])
+        elif kind == RSV_UPSERT:
+            self.binding.reservation_upsert(entry, arrs)
+        elif kind == RSV_REMOVE:
+            self.binding.reservation_remove(entry["name"])
 
 
 class SchedulerBinding:
@@ -317,6 +357,8 @@ class SchedulerBinding:
                 self.scheduler.delete_pod(name)
             for name in list(self.scheduler.pending):
                 self.scheduler.dequeue(name)
+            for spec in self.scheduler.reservations.specs():
+                self.scheduler.remove_reservation(spec.name)
             snap = self.scheduler.snapshot
             for name in list(snap.node_index):
                 snap.remove_node(name)
@@ -347,9 +389,38 @@ class SchedulerBinding:
             quota=entry.get("quota"),
             gang=entry.get("gang"),
             node_selector=dict(entry.get("node_selector", {})),
+            labels=dict(entry.get("labels", {})),
+            owner=entry.get("owner"),
         ))
 
     def pod_remove(self, name: str) -> None:
         # pending, nominated, or bound — a bound delete releases its node
         # reservation and quota charge
         self.scheduler.delete_pod(name)
+
+    def reservation_upsert(self, entry: dict,
+                           arrs: dict[str, np.ndarray]) -> None:
+        from koordinator_tpu.scheduler.reservations import (
+            OwnerMatcher,
+            ReservationSpec,
+        )
+
+        owners = [
+            OwnerMatcher(labels=dict(m.get("labels", {})),
+                         controller=m.get("controller"))
+            for m in entry.get("owners", [])
+        ]
+        self.scheduler.add_reservation(ReservationSpec(
+            name=entry["name"],
+            requests=np.asarray(arrs["requests"], np.int64),
+            owners=owners,
+            allocate_once=bool(entry.get("allocate_once", False)),
+            ttl_sec=entry.get("ttl_sec"),
+            node=entry.get("node"),
+            node_selector=dict(entry.get("node_selector", {})),
+            tolerations=dict(entry.get("tolerations", {})),
+            restricted=bool(entry.get("restricted", False)),
+        ))
+
+    def reservation_remove(self, name: str) -> None:
+        self.scheduler.remove_reservation(name)

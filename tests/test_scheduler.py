@@ -663,3 +663,14 @@ class TestMigrationWithReservations:
         res = sched.schedule_round()
         assert res.assignments.get("db-1") == "n1"
         assert sched.reservations.get("rsv-a").allocated[CPU] == 6_000
+
+    def test_reserve_pod_honors_template_node_selector(self):
+        sched, _ = mk_scheduler([
+            node("cpu-1", cpu=20_000, labels={"pool": "cpu"}),
+            node("gpu-1", cpu=10_000, labels={"pool": "gpu"}),
+        ])
+        spec = self._spec(cpu=8_000)
+        spec.node_selector = {"pool": "gpu"}
+        sched.add_reservation(spec)
+        sched.schedule_round()
+        assert sched.reservations.get("rsv-a").node == "gpu-1"

@@ -399,3 +399,68 @@ def test_snapshot_resync_releases_bound_state(rpc):
     assert not sched.bound      # bound state released with its reservation
     result = solve_remote(client2)
     assert result["assignments"] == {"p1": "n1"}   # re-placed cleanly
+
+
+def test_reservation_sync_over_the_wire(rpc):
+    """Reservation CRs ride the delta protocol: upsert places a reservation
+    (hidden capacity), an owner pod draws from it, removal frees it."""
+    server, clients = rpc
+    service = StateSyncService()
+    service.attach(server)
+    server.start()
+
+    sched = mk_scheduler()
+    SolveService(sched).attach(server)
+    sync = StateSyncClient(SchedulerBinding(sched))
+    client = connect(server, clients, on_push=sync.on_push)
+    sync.bootstrap(client)
+
+    service.upsert_node("n1", resource_vector(cpu=10_000, memory=65_536))
+    service.upsert_reservation(
+        "rsv-a", resource_vector(cpu=8_000, memory=8_192).astype("int64"),
+        owners=[{"labels": {"app": "web"}}])
+    wait_until(lambda: sync.rv == service.rv)
+    solve_remote(client)                   # round: reserve-pod places
+    assert sched.reservations.get("rsv-a").node == "n1"
+
+    # reserved capacity hidden from non-owners pushed over the wire
+    service.add_pod("other", resource_vector(cpu=4_000, memory=1_024))
+    wait_until(lambda: sync.rv == service.rv)
+    result = solve_remote(client)
+    assert "other" in result["failures"]
+
+    # ...but an owner pod (labels ride POD_ADD) draws from it
+    service.add_pod("web-1", resource_vector(cpu=6_000, memory=1_024),
+                    labels={"app": "web"})
+    wait_until(lambda: sync.rv == service.rv)
+    result = solve_remote(client)
+    assert result["assignments"].get("web-1") == "n1"
+    assert sched.reservations.get("rsv-a").allocated[0] == 6_000
+    service.remove_pod("web-1")
+    wait_until(lambda: "web-1" not in sched.bound)
+
+    # removal over the wire frees the capacity
+    service.remove_reservation("rsv-a")
+    wait_until(lambda: sync.rv == service.rv)
+    result = solve_remote(client)
+    assert result["assignments"].get("other") == "n1"
+
+
+def test_reservation_in_snapshot_resync(rpc):
+    # a fresh client bootstraps reservations from the snapshot too
+    server, clients = rpc
+    service = StateSyncService()
+    service.attach(server)
+    service.upsert_node("n1", resource_vector(cpu=10_000, memory=65_536))
+    service.upsert_reservation(
+        "rsv-a", resource_vector(cpu=6_000, memory=4_096).astype("int64"),
+        owners=[{"labels": {"app": "web"}}])
+    server.start()
+
+    sched = mk_scheduler()
+    SolveService(sched).attach(server)
+    sync = StateSyncClient(SchedulerBinding(sched))
+    client = connect(server, clients, on_push=sync.on_push)
+    sync.bootstrap(client)
+    solve_remote(client)
+    assert sched.reservations.get("rsv-a").node == "n1"
