@@ -122,6 +122,19 @@ class CPUManager:
         st.allocations[pod] = CPUAllocation(pod, cpus, exclusive_policy)
         return cpus
 
+    def restore(self, node: str, pod: str, cpus: list[int],
+                exclusive_policy: int = EXCLUSIVE_NONE) -> None:
+        """Replay a pod's existing cpuset at startup (the reference restores
+        allocations from pod resource-status annotations): commits the exact
+        cpus without running selection."""
+        st = self._nodes.get(node)
+        if st is None or not cpus:
+            return
+        self.release(node, pod)   # idempotent replay
+        st.ref_count[list(cpus)] += 1
+        st.allocations[pod] = CPUAllocation(pod, sorted(cpus),
+                                            exclusive_policy)
+
     def release(self, node: str, pod: str) -> None:
         st = self._nodes.get(node)
         if st is None:

@@ -107,6 +107,24 @@ class DeviceManager:
             jnp.int32(alloc.core), jnp.int32(alloc.memory),
         )
 
+    def restore(self, device_type: str, node: str, pod: str,
+                minors: list[int], core: int = 0, memory: int = 0) -> None:
+        """Replay a pod's existing device grant at startup (from the
+        device-allocated annotation): commits the exact minors without
+        running selection."""
+        dev = self._state.get(device_type)
+        row = self._node_rows.get(device_type, {}).get(node)
+        if dev is None or row is None or not minors:
+            return
+        sel = np.zeros(dev.shape[1], bool)
+        sel[list(minors)] = True
+        self._state[device_type] = commit_allocation(
+            dev, jnp.int32(row), jnp.asarray(sel),
+            jnp.int32(core), jnp.int32(memory),
+        )
+        self._allocs.setdefault((pod, node), []).append(DeviceAllocation(
+            pod, node, device_type, sorted(minors), core, memory))
+
     def release(self, node: str, pod: str) -> None:
         for alloc in self._allocs.pop((pod, node), []):
             self._release_one(node, alloc)

@@ -131,6 +131,8 @@ class Scheduler:
         preempt_fn=None,
         explanations=None,
         auditor=None,
+        cpu_manager=None,
+        device_manager=None,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -184,6 +186,12 @@ class Scheduler:
 
         self.reservations = ReservationCache()
         self._rsv_solve = jax.jit(reservation_greedy_assign)
+        #: fine-grained allocators (nodenumaresource / deviceshare Reserve):
+        #: LSR/LSE pods take exclusive cpusets, device requests take minors
+        #: at bind; annotation payloads surface in resource_status
+        self.cpu_manager = cpu_manager
+        self.device_manager = device_manager
+        self.resource_status: dict[str, dict] = {}
         #: bound on pods routed through the sequential reservation pre-pass
         #: per round — a popular owner selector must not drag a 50k-pod
         #: round onto the O(P) exact scan (extras solve normally and can
@@ -221,18 +229,50 @@ class Scheduler:
         with self.lock:
             self.pdbs[record.name] = record
 
-    def add_bound_pod(self, pod: BoundPod) -> None:
+    def add_bound_pod(self, pod: BoundPod,
+                      resource_status: dict | None = None) -> None:
         """Seed a pre-existing bound pod (informer replay at startup).
 
         Owns the accounting: the pod's request is reserved on its node here,
         and released by :meth:`remove_bound_pod` — callers never touch the
         snapshot directly, so a pod the scheduler already evicted (popped
         from ``bound``) cannot be double-freed by a late informer delete.
-        """
+
+        ``resource_status`` replays the pod's fine-grained annotations
+        ({"resource-status": {"cpuset": "0,1"}, "device-allocated": {...}})
+        into the CPU/device managers so restart can't re-grant pinned cores
+        or in-use device minors to new pods."""
         with self.lock:
             self.bound[pod.name] = pod
             if pod.node in self.snapshot.node_index:
                 self.snapshot.reserve(pod.node, pod.requests)
+            if resource_status:
+                self._restore_fine_grained(pod, resource_status)
+
+    def _restore_fine_grained(self, pod: BoundPod, status: dict) -> None:
+        rs = status.get("resource-status") or {}
+        cpuset = rs.get("cpuset", "")
+        if cpuset and self.cpu_manager is not None:
+            from koordinator_tpu.scheduler.cpu_manager import (
+                EXCLUSIVE_PCPU_LEVEL,
+            )
+
+            self.cpu_manager.restore(
+                pod.node, pod.name,
+                [int(c) for c in str(cpuset).split(",") if c != ""],
+                exclusive_policy=EXCLUSIVE_PCPU_LEVEL)
+            self.resource_status.setdefault(pod.name, {})[
+                "resource-status"] = rs
+        devices = status.get("device-allocated") or {}
+        if devices and self.device_manager is not None:
+            for dev_type, grants in devices.items():
+                for g in grants:
+                    self.device_manager.restore(
+                        dev_type, pod.node, pod.name, [int(g["minor"])],
+                        core=int(g.get("resources", {}).get("core", 0)),
+                        memory=int(g.get("resources", {}).get("memory", 0)))
+            self.resource_status.setdefault(pod.name, {})[
+                "device-allocated"] = devices
 
     def remove_bound_pod(self, name: str) -> None:
         """Release a bound pod's node reservation iff still tracked (quota
@@ -245,19 +285,27 @@ class Scheduler:
         charge frees with the pod."""
         with self.lock:
             pod = self.bound.pop(name, None)
-            if pod is None or pod.node not in self.snapshot.node_index:
-                return
-            free_vec = pod.requests
-            if pod.reservation is not None and pod.rsv_drawn is not None:
-                drawn = pod.rsv_drawn.astype(np.int64)
-                if self.reservations.return_allocation(
-                        pod.reservation, drawn, pod.rsv_generation):
-                    free_vec = np.maximum(
-                        pod.requests.astype(np.int64) - drawn, 0)
-                else:
-                    free_vec = np.maximum(
-                        pod.requests.astype(np.int64), drawn)
-            self.snapshot.unreserve(pod.node, free_vec.astype(np.int32))
+            if pod is not None:
+                self._release_bound_capacity(pod)
+
+    def _release_bound_capacity(self, bp: BoundPod) -> None:
+        """Shared freeing for a bound pod leaving the cluster (informer
+        delete, eviction, preemption): fine-grained allocations, then the
+        reservation-aware node unreserve."""
+        self._release_fine_grained(bp.name, bp.node)
+        if bp.node not in self.snapshot.node_index:
+            return
+        free_vec = bp.requests
+        if bp.reservation is not None and bp.rsv_drawn is not None:
+            drawn = bp.rsv_drawn.astype(np.int64)
+            if self.reservations.return_allocation(
+                    bp.reservation, drawn, bp.rsv_generation):
+                free_vec = np.maximum(
+                    bp.requests.astype(np.int64) - drawn, 0)
+            else:
+                free_vec = np.maximum(
+                    bp.requests.astype(np.int64), drawn)
+        self.snapshot.unreserve(bp.node, free_vec.astype(np.int32))
 
     def delete_pod(self, name: str) -> None:
         """Informer pod delete, whatever state the pod is in: a pending or
@@ -866,6 +914,7 @@ class Scheduler:
         )
         if charge_quota:
             self._charge_quota_used(pod, sign=1)
+        self._allocate_fine_grained(pod, node)
         if self.bind_fn is not None:
             self.bind_fn(pod.name, node)
         # success side of ScheduleExplanation/auditor lifecycle lives here so
@@ -875,6 +924,52 @@ class Scheduler:
             self.explanations.delete(pod.name)
         if self.auditor is not None:
             self.auditor.record(pod.gang or pod.name, "ScheduleSuccess", node)
+
+    def _allocate_fine_grained(self, pod: PodSpec, node: str) -> None:
+        """Reserve-phase fine-grained allocation (nodenumaresource Reserve:
+        resource_manager.go:357 allocateCPUSet; deviceshare Reserve +
+        PreBind device-allocated annotation).  An allocation that cannot be
+        satisfied degrades to the shared pool / no pinning rather than
+        failing an already-committed bind — the koordlet share-pool hook
+        still applies its per-QoS cpuset."""
+        from koordinator_tpu.api.qos import QoSClass
+        from koordinator_tpu.api.resources import ResourceDim
+
+        status: dict[str, dict] = {}
+        if (self.cpu_manager is not None
+                and int(pod.qos) in (int(QoSClass.LSR), int(QoSClass.LSE))
+                and self.cpu_manager.node(node) is not None):
+            from koordinator_tpu.scheduler.cpu_manager import (
+                EXCLUSIVE_PCPU_LEVEL,
+            )
+
+            cores = int(pod.requests[ResourceDim.CPU]) // 1000
+            if cores >= 1:
+                cpus = self.cpu_manager.allocate(
+                    node, pod.name, cores,
+                    exclusive_policy=EXCLUSIVE_PCPU_LEVEL)
+                if cpus is not None:
+                    status["resource-status"] = (
+                        self.cpu_manager.resource_status(node, pod.name))
+        if self.device_manager is not None:
+            gpu = int(pod.requests[ResourceDim.GPU])
+            gpu_mem = int(pod.requests[ResourceDim.GPU_MEMORY])
+            if gpu > 0 and self.device_manager.state("gpu") is not None:
+                minors = self.device_manager.allocate(
+                    "gpu", node, pod.name, gpu, gpu_mem)
+                if minors is not None:
+                    status["device-allocated"] = (
+                        self.device_manager.device_allocated_annotation(
+                            node, pod.name))
+        if status:
+            self.resource_status[pod.name] = status
+
+    def _release_fine_grained(self, pod_name: str, node: str) -> None:
+        if self.cpu_manager is not None:
+            self.cpu_manager.release(node, pod_name)
+        if self.device_manager is not None:
+            self.device_manager.release(node, pod_name)
+        self.resource_status.pop(pod_name, None)
 
     def _charge_quota_used(self, pod: PodSpec, sign: int) -> None:
         if (pod.quota and self.quota_tree is not None
@@ -1162,7 +1257,10 @@ class Scheduler:
                 node_name = self.snapshot.node_name(int(out.node))
                 for vname in victim_names:
                     bp = self.bound.pop(vname)
-                    self.snapshot.unreserve(bp.node, bp.requests)
+                    # shared freeing: fine-grained allocations and
+                    # reservation-aware unreserve (a reservation-backed
+                    # victim returns its drawn vector, not raw capacity)
+                    self._release_bound_capacity(bp)
                     if bp.quota and self.quota_tree is not None \
                             and bp.quota in self.quota_tree.nodes:
                         q = self.quota_tree.nodes[bp.quota]

@@ -60,6 +60,7 @@ class DebugService:
         self.register("/apis/v1/gangs", self._gangs)
         self.register("/apis/v1/quotas", self._quotas)
         self.register("/apis/v1/reservations", self._reservations)
+        self.register("/apis/v1/resource-status", self._resource_status)
         self.register("/apis/v1/diagnosis", self._diagnosis)
         self.register("/apis/v1/__debug/scores", self._scores)
         self.register("/apis/v1/__debug/set-top-n", self._set_top_n)
@@ -105,6 +106,11 @@ class DebugService:
              "runtime": np.asarray(tree.runtime_of(name)).tolist()}
             for name, node in tree.nodes.items()
         ]
+
+    def _resource_status(self, params: dict) -> object:
+        """Fine-grained allocation annotations per bound pod (cpuset
+        resource-status + device-allocated payloads)."""
+        return dict(self.scheduler.resource_status)
 
     def _reservations(self, params: dict) -> object:
         return [

@@ -674,3 +674,121 @@ class TestMigrationWithReservations:
         sched.add_reservation(spec)
         sched.schedule_round()
         assert sched.reservations.get("rsv-a").node == "gpu-1"
+
+
+class TestFineGrainedBind:
+    """CPU/device manager integration at bind (nodenumaresource Reserve
+    resource_manager.go:357 + deviceshare PreBind device-allocated)."""
+
+    def _managers(self):
+        from tests.test_deviceshare import gpu_node
+        from tests.test_numa import topo_2numa
+
+        from koordinator_tpu.scheduler.cpu_manager import CPUManager
+        from koordinator_tpu.scheduler.device_manager import DeviceManager
+
+        cm = CPUManager()
+        cm.register_node("n1", topo_2numa())
+        dm = DeviceManager()
+        dm.register("gpu", ["n1"], [gpu_node(4)])
+        return cm, dm
+
+    def test_lsr_pod_gets_exclusive_cpuset_at_bind(self):
+        from koordinator_tpu.api.qos import QoSClass
+
+        cm, dm = self._managers()
+        sched, _ = mk_scheduler([node("n1")], cpu_manager=cm,
+                                device_manager=dm)
+        sched.enqueue(pod("lsr-1", cpu=4_000, qos=int(QoSClass.LSR)))
+        sched.enqueue(pod("ls-1", cpu=4_000, qos=int(QoSClass.LS)))
+        res = sched.schedule_round()
+        assert set(res.assignments) == {"lsr-1", "ls-1"}
+        status = sched.resource_status["lsr-1"]["resource-status"]
+        assert len(status["cpuset"].split(",")) == 4
+        assert "ls-1" not in sched.resource_status   # shared-pool pod
+        # release on delete
+        sched.delete_pod("lsr-1")
+        assert "lsr-1" not in sched.resource_status
+        assert cm.node("n1").ref_count.sum() == 0
+
+    def test_gpu_pod_gets_device_allocation_at_bind(self):
+        from koordinator_tpu.api.resources import resource_vector
+
+        cm, dm = self._managers()
+        from koordinator_tpu.scheduler.snapshot import NodeSpec, PodSpec
+
+        gpu_node_spec = NodeSpec(name="n1", allocatable=resource_vector(
+            {"cpu": 16_000, "memory": 65_536, "kubernetes.io/gpu": 400,
+             "kubernetes.io/gpu-memory": 81_920 * 4}))
+        sched, _ = mk_scheduler([gpu_node_spec], cpu_manager=cm,
+                                device_manager=dm)
+        sched.enqueue(PodSpec(name="gpu-1", requests=resource_vector(
+            {"cpu": 1_000, "memory": 1_024, "kubernetes.io/gpu": 200,
+             "kubernetes.io/gpu-memory": 16_384})))
+        res = sched.schedule_round()
+        assert res.assignments["gpu-1"] == "n1"
+        ann = sched.resource_status["gpu-1"]["device-allocated"]
+        assert len(ann["gpu"]) == 2    # 200 milli-gpu = 2 whole devices
+        sched.delete_pod("gpu-1")
+        assert dm.allocate("gpu", "n1", "x", core=400) is not None
+
+    def test_debug_route_exposes_resource_status(self):
+        from koordinator_tpu.api.qos import QoSClass
+        from koordinator_tpu.scheduler.services import DebugService
+
+        cm, dm = self._managers()
+        sched, _ = mk_scheduler([node("n1")], cpu_manager=cm,
+                                device_manager=dm)
+        svc = DebugService(sched)
+        sched.enqueue(pod("lsr-1", cpu=2_000, qos=int(QoSClass.LSR)))
+        sched.schedule_round()
+        status, body = svc.handle("/apis/v1/resource-status")
+        assert status == 200 and "lsr-1" in body
+
+    def test_preemption_releases_victim_fine_grained_allocs(self):
+        from koordinator_tpu.api.qos import QoSClass
+
+        cm, dm = self._managers()
+        sched, _ = mk_scheduler(
+            [node("n1", cpu=8_000)], cpu_manager=cm, device_manager=dm,
+            enable_preemption=True, preempt_fn=lambda pod, node: True)
+        sched.enqueue(pod("lsr-low", cpu=6_000, qos=int(QoSClass.LSR),
+                          priority=3_000))
+        sched.schedule_round()
+        assert cm.node("n1").ref_count.sum() == 6
+        sched.enqueue(pod("prod-high", cpu=6_000, priority=9_500))
+        sched.schedule_round()   # PostFilter: evict lsr-low, nominate
+        assert "lsr-low" not in sched.bound
+        # victim's exclusive cpuset released with the eviction
+        assert cm.node("n1").ref_count.sum() == 0
+        assert "lsr-low" not in sched.resource_status
+
+    def test_restart_replay_restores_pinned_cpus_and_minors(self):
+        from koordinator_tpu.scheduler.scheduler import BoundPod
+
+        cm, dm = self._managers()
+        sched, _ = mk_scheduler([node("n1")], cpu_manager=cm,
+                                device_manager=dm)
+        # informer replay: an LSR pod pinned to cpus 0-3 and a GPU pod
+        # holding minors 0-1 were running before the restart
+        sched.add_bound_pod(
+            BoundPod(name="old-lsr", node="n1",
+                     requests=resource_vector(cpu=4_000, memory=1_024),
+                     priority=9_000),
+            resource_status={"resource-status": {"cpuset": "0,1,2,3"}})
+        sched.add_bound_pod(
+            BoundPod(name="old-gpu", node="n1",
+                     requests=resource_vector(cpu=1_000, memory=1_024),
+                     priority=9_000),
+            resource_status={"device-allocated": {"gpu": [
+                {"minor": 0, "resources": {"core": 100, "memory": 81_920}},
+                {"minor": 1, "resources": {"core": 100, "memory": 81_920}},
+            ]}})
+        assert cm.node("n1").ref_count[:4].sum() == 4
+        # a new exclusive allocation avoids the replayed cores
+        cpus = cm.allocate("n1", "new-lsr", 4)
+        assert cpus is not None and not set(cpus) & {0, 1, 2, 3}
+        # a 3-whole GPU ask fails while minors 0-1 are replayed as held
+        assert dm.allocate("gpu", "n1", "new-gpu", core=300) is None
+        sched.remove_bound_pod("old-gpu")
+        assert dm.allocate("gpu", "n1", "new-gpu", core=300) is not None
