@@ -102,6 +102,9 @@ def main_koord_scheduler(argv: list[str],
         WorkloadAuditor,
     )
 
+    from koordinator_tpu.scheduler.cpu_manager import CPUManager
+    from koordinator_tpu.scheduler.device_manager import DeviceManager
+
     args = build_scheduler_parser().parse_args(argv)
     apply_feature_gates(args.feature_gates, SCHEDULER_GATES)
     snapshot = ClusterSnapshot(capacity=args.node_capacity)
@@ -112,6 +115,8 @@ def main_koord_scheduler(argv: list[str],
         enable_preemption=args.enable_preemption or None,
         explanations=ExplanationStore(),
         auditor=WorkloadAuditor(),
+        cpu_manager=CPUManager(),
+        device_manager=DeviceManager(),
     )
     elector = build_elector(args, lease_store)
     server = None

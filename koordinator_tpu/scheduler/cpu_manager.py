@@ -123,17 +123,22 @@ class CPUManager:
         return cpus
 
     def restore(self, node: str, pod: str, cpus: list[int],
-                exclusive_policy: int = EXCLUSIVE_NONE) -> None:
+                exclusive_policy: int = EXCLUSIVE_NONE) -> bool:
         """Replay a pod's existing cpuset at startup (the reference restores
         allocations from pod resource-status annotations): commits the exact
-        cpus without running selection."""
+        cpus without running selection.  Annotation data is external: cpu
+        ids outside the registered topology reject the whole restore (the
+        pod falls back to unpinned) rather than corrupting ref counts."""
         st = self._nodes.get(node)
         if st is None or not cpus:
-            return
+            return False
+        cpus = sorted({int(c) for c in cpus})
+        if cpus[0] < 0 or cpus[-1] >= st.topology.capacity:
+            return False
         self.release(node, pod)   # idempotent replay
-        st.ref_count[list(cpus)] += 1
-        st.allocations[pod] = CPUAllocation(pod, sorted(cpus),
-                                            exclusive_policy)
+        st.ref_count[cpus] += 1
+        st.allocations[pod] = CPUAllocation(pod, cpus, exclusive_policy)
+        return True
 
     def release(self, node: str, pod: str) -> None:
         st = self._nodes.get(node)
@@ -156,3 +161,30 @@ class CPUManager:
                 {int(numa_of[c]) for c in alloc.cpus}
             ),
         }
+
+
+def register_node_from_annotations(
+    mgr: CPUManager, name: str, annotations: dict[str, str]
+) -> bool:
+    """NRT bridge: parse the koordlet's cpu-topology annotation
+    (nodetopo.NodeTopology.to_annotations; the reference's
+    nodenumaresource/topology_options.go reads the same payload) and
+    register the node's topology with the CPU manager."""
+    import json
+
+    raw = annotations.get("node.koordinator.sh/cpu-topology", "")
+    if not raw:
+        return False
+    try:
+        detail = json.loads(raw)["detail"]
+        if not detail:
+            return False
+        core_of = np.asarray([d["core"] for d in detail], np.int32)
+        numa_of = np.asarray([d["node"] for d in detail], np.int32)
+        socket_of = np.asarray([d["socket"] for d in detail], np.int32)
+    except (ValueError, KeyError, TypeError):
+        # annotation payloads are external data: malformed entries reject
+        # the registration instead of crashing node processing
+        return False
+    mgr.register_node(name, CPUTopology.build(core_of, numa_of, socket_of))
+    return True

@@ -107,23 +107,43 @@ class DeviceManager:
             jnp.int32(alloc.core), jnp.int32(alloc.memory),
         )
 
-    def restore(self, device_type: str, node: str, pod: str,
-                minors: list[int], core: int = 0, memory: int = 0) -> None:
-        """Replay a pod's existing device grant at startup (from the
-        device-allocated annotation): commits the exact minors without
-        running selection."""
-        dev = self._state.get(device_type)
-        row = self._node_rows.get(device_type, {}).get(node)
-        if dev is None or row is None or not minors:
-            return
-        sel = np.zeros(dev.shape[1], bool)
-        sel[list(minors)] = True
-        self._state[device_type] = commit_allocation(
-            dev, jnp.int32(row), jnp.asarray(sel),
-            jnp.int32(core), jnp.int32(memory),
-        )
-        self._allocs.setdefault((pod, node), []).append(DeviceAllocation(
-            pod, node, device_type, sorted(minors), core, memory))
+    def restore(self, node: str, pod: str, devices: dict) -> bool:
+        """Replay a pod's existing device grants at startup from the
+        device-allocated annotation payload
+        ({type: [{"minor": m, "resources": {"core": c, "memory": b}}]}).
+        Idempotent (a re-list that replays the same pod twice releases the
+        previous records first) and defensive: annotation data is external,
+        so unknown types and out-of-range minors are skipped rather than
+        corrupting device accounting.  Returns True when anything landed."""
+        self.release(node, pod)
+        restored = False
+        for device_type, grants in (devices or {}).items():
+            dev = self._state.get(device_type)
+            row = self._node_rows.get(device_type, {}).get(node)
+            if dev is None or row is None:
+                continue
+            for g in grants:
+                try:
+                    minor = int(g.get("minor", -1))
+                    res = g.get("resources", {}) or {}
+                    core = int(res.get("core", 0))
+                    memory = int(res.get("memory", 0))
+                except (TypeError, ValueError, AttributeError):
+                    continue
+                dev = self._state[device_type]
+                if not (0 <= minor < dev.shape[1]):
+                    continue
+                sel = np.zeros(dev.shape[1], bool)
+                sel[minor] = True
+                self._state[device_type] = commit_allocation(
+                    dev, jnp.int32(row), jnp.asarray(sel),
+                    jnp.int32(core), jnp.int32(memory),
+                )
+                self._allocs.setdefault((pod, node), []).append(
+                    DeviceAllocation(pod, node, device_type, [minor],
+                                     core, memory))
+                restored = True
+        return restored
 
     def release(self, node: str, pod: str) -> None:
         for alloc in self._allocs.pop((pod, node), []):

@@ -250,29 +250,31 @@ class Scheduler:
                 self._restore_fine_grained(pod, resource_status)
 
     def _restore_fine_grained(self, pod: BoundPod, status: dict) -> None:
+        """Annotations are persisted external data: a malformed or stale
+        payload (topology changed across restart) skips that pod's restore
+        instead of crashing the informer replay."""
         rs = status.get("resource-status") or {}
         cpuset = rs.get("cpuset", "")
         if cpuset and self.cpu_manager is not None:
+            from koordinator_tpu.koordlet.system.procfs import parse_cpu_list
             from koordinator_tpu.scheduler.cpu_manager import (
                 EXCLUSIVE_PCPU_LEVEL,
             )
 
-            self.cpu_manager.restore(
-                pod.node, pod.name,
-                [int(c) for c in str(cpuset).split(",") if c != ""],
-                exclusive_policy=EXCLUSIVE_PCPU_LEVEL)
-            self.resource_status.setdefault(pod.name, {})[
-                "resource-status"] = rs
+            try:
+                cpus = parse_cpu_list(str(cpuset))  # accepts "0-3,8" forms
+            except ValueError:
+                cpus = []
+            if cpus and self.cpu_manager.restore(
+                    pod.node, pod.name, cpus,
+                    exclusive_policy=EXCLUSIVE_PCPU_LEVEL):
+                self.resource_status.setdefault(pod.name, {})[
+                    "resource-status"] = rs
         devices = status.get("device-allocated") or {}
         if devices and self.device_manager is not None:
-            for dev_type, grants in devices.items():
-                for g in grants:
-                    self.device_manager.restore(
-                        dev_type, pod.node, pod.name, [int(g["minor"])],
-                        core=int(g.get("resources", {}).get("core", 0)),
-                        memory=int(g.get("resources", {}).get("memory", 0)))
-            self.resource_status.setdefault(pod.name, {})[
-                "device-allocated"] = devices
+            if self.device_manager.restore(pod.node, pod.name, devices):
+                self.resource_status.setdefault(pod.name, {})[
+                    "device-allocated"] = devices
 
     def remove_bound_pod(self, name: str) -> None:
         """Release a bound pod's node reservation iff still tracked (quota

@@ -792,3 +792,61 @@ class TestFineGrainedBind:
         assert dm.allocate("gpu", "n1", "new-gpu", core=300) is None
         sched.remove_bound_pod("old-gpu")
         assert dm.allocate("gpu", "n1", "new-gpu", core=300) is not None
+
+    def test_koordlet_nrt_annotation_registers_topology(self):
+        """koordlet NodeTopologyReporter annotations -> scheduler CPUManager
+        (the NRT CRD loop: nodetopo report to topology_options consume)."""
+        from koordinator_tpu.api.qos import QoSClass
+        from koordinator_tpu.koordlet.nodetopo import NodeTopology, NUMAZone
+        from koordinator_tpu.koordlet.system import procfs
+        from koordinator_tpu.scheduler.cpu_manager import (
+            CPUManager, register_node_from_annotations,
+        )
+
+        cpus = tuple(
+            procfs.CPUInfo(cpu=i, core=i // 2, socket=0, node=i // 4)
+            for i in range(8))
+        topo = NodeTopology(
+            zones=(NUMAZone("node0", 4_000, 1 << 30, (0, 1, 2, 3)),
+                   NUMAZone("node1", 4_000, 1 << 30, (4, 5, 6, 7))),
+            cpu_topology=cpus)
+        cm = CPUManager()
+        assert register_node_from_annotations(
+            cm, "n1", topo.to_annotations())
+        sched, _ = mk_scheduler([node("n1")], cpu_manager=cm)
+        sched.enqueue(pod("lsr-1", cpu=2_000, qos=int(QoSClass.LSR)))
+        sched.schedule_round()
+        status = sched.resource_status["lsr-1"]["resource-status"]
+        assert len(status["cpuset"].split(",")) == 2
+        assert not register_node_from_annotations(cm, "nx", {})
+
+    def test_restore_rejects_malformed_and_stale_annotations(self):
+        from koordinator_tpu.scheduler.scheduler import BoundPod
+
+        cm, dm = self._managers()
+        sched, _ = mk_scheduler([node("n1")], cpu_manager=cm,
+                                device_manager=dm)
+        # range-form cpuset parses; stale cpu ids / bad minors are skipped
+        sched.add_bound_pod(
+            BoundPod(name="ranged", node="n1",
+                     requests=resource_vector(cpu=2_000, memory=512)),
+            resource_status={"resource-status": {"cpuset": "0-1"}})
+        assert cm.node("n1").ref_count[:2].sum() == 2
+        sched.add_bound_pod(
+            BoundPod(name="stale", node="n1",
+                     requests=resource_vector(cpu=2_000, memory=512)),
+            resource_status={
+                "resource-status": {"cpuset": "500-501"},       # beyond topo
+                "device-allocated": {"gpu": [{"minor": 99}],    # beyond devs
+                                     "fpga": [{"minor": 0}]}})  # unknown type
+        assert "stale" not in sched.resource_status
+        # replaying the same GPU pod twice must not double-charge
+        grant = {"device-allocated": {"gpu": [
+            {"minor": 0, "resources": {"core": 100, "memory": 81_920}}]}}
+        for _ in range(2):
+            sched.add_bound_pod(
+                BoundPod(name="gpu-replay", node="n1",
+                         requests=resource_vector(cpu=1_000, memory=512)),
+                resource_status=grant)
+        sched.remove_bound_pod("gpu-replay")
+        assert dm.allocate("gpu", "n1", "x", core=400) is not None
