@@ -133,7 +133,11 @@ class CPUManager:
         if st is None or not cpus:
             return False
         cpus = sorted({int(c) for c in cpus})
-        if cpus[0] < 0 or cpus[-1] >= st.topology.capacity:
+        valid = np.asarray(st.topology.valid)
+        # bounds AND the valid mask: topology capacities are padded to a
+        # power of two with zeroed core/numa ids — a stale id landing in the
+        # padding would ban core 0 for every future exclusive pod
+        if cpus[0] < 0 or cpus[-1] >= len(valid) or not valid[cpus].all():
             return False
         self.release(node, pod)   # idempotent replay
         st.ref_count[cpus] += 1
@@ -161,6 +165,28 @@ class CPUManager:
                 {int(numa_of[c]) for c in alloc.cpus}
             ),
         }
+
+
+def parse_cpuset_bounded(s: str, limit: int = 1024) -> list[int]:
+    """Parse a "0-3,8" cpuset string with a hard size bound.  Annotation
+    data is external: an eager range expansion of a corrupt "0-4000000000"
+    must raise, not materialize billions of entries during replay."""
+    out: list[int] = []
+    for tok in str(s).split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "-" in tok:
+            lo_s, _, hi_s = tok.partition("-")
+            lo, hi = int(lo_s), int(hi_s)
+            if hi < lo or hi - lo + 1 > limit:
+                raise ValueError(f"cpuset range too wide: {tok}")
+            out.extend(range(lo, hi + 1))
+        else:
+            out.append(int(tok))
+        if len(out) > limit:
+            raise ValueError("cpuset too large")
+    return out
 
 
 def register_node_from_annotations(

@@ -42,12 +42,47 @@ class DeviceManager:
         self._state: dict[str, DeviceState] = {}
         self._node_rows: dict[str, dict[str, int]] = {}  # per device type
         self._allocs: dict[tuple[str, str], list[DeviceAllocation]] = {}
+        #: raw per-node inventory, kept so nodes can register incrementally
+        #: (Device CR sync delivers one node at a time)
+        self._raw: dict[str, dict[str, list[dict]]] = {}
 
     def register(
         self, device_type: str, node_names: list[str], per_node_devices: list[list[dict]]
     ) -> None:
         self._state[device_type] = DeviceState.build(per_node_devices)
         self._node_rows[device_type] = {n: i for i, n in enumerate(node_names)}
+        self._raw[device_type] = {
+            n: list(d) for n, d in zip(node_names, per_node_devices)
+        }
+
+    def register_node_devices(
+        self, device_type: str, node: str, devices: list[dict]
+    ) -> None:
+        """Incremental Device-CR sync: (re)register one node's inventory,
+        rebuilding the type tensors and re-committing live allocations so
+        an inventory update can't silently zero out held capacity."""
+        raw = self._raw.setdefault(device_type, {})
+        raw[node] = list(devices)
+        names = sorted(raw)
+        self._state[device_type] = DeviceState.build([raw[n] for n in names])
+        self._node_rows[device_type] = {n: i for i, n in enumerate(names)}
+        for (pod, pnode), allocs in self._allocs.items():
+            row = self._node_rows[device_type].get(pnode)
+            if row is None:
+                continue
+            for a in allocs:
+                if a.device_type != device_type:
+                    continue
+                dev = self._state[device_type]
+                minors = [m for m in a.minors if m < dev.shape[1]]
+                if not minors:
+                    continue
+                sel = np.zeros(dev.shape[1], bool)
+                sel[minors] = True
+                self._state[device_type] = commit_allocation(
+                    dev, jnp.int32(row), jnp.asarray(sel),
+                    jnp.int32(a.core), jnp.int32(a.memory),
+                )
 
     def state(self, device_type: str) -> DeviceState | None:
         return self._state.get(device_type)
@@ -117,10 +152,12 @@ class DeviceManager:
         corrupting device accounting.  Returns True when anything landed."""
         self.release(node, pod)
         restored = False
-        for device_type, grants in (devices or {}).items():
+        if not isinstance(devices, dict):
+            return False
+        for device_type, grants in devices.items():
             dev = self._state.get(device_type)
             row = self._node_rows.get(device_type, {}).get(node)
-            if dev is None or row is None:
+            if dev is None or row is None or not isinstance(grants, list):
                 continue
             for g in grants:
                 try:
@@ -131,7 +168,11 @@ class DeviceManager:
                 except (TypeError, ValueError, AttributeError):
                     continue
                 dev = self._state[device_type]
-                if not (0 <= minor < dev.shape[1]):
+                # bounds AND the row's valid mask: device capacities pad to
+                # a power of two; a stale minor in the padding would drive
+                # a nonexistent device's free counter negative
+                if not (0 <= minor < dev.shape[1]
+                        and bool(dev.valid[row, minor])):
                     continue
                 sel = np.zeros(dev.shape[1], bool)
                 sel[minor] = True

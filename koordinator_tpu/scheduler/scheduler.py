@@ -254,15 +254,15 @@ class Scheduler:
         payload (topology changed across restart) skips that pod's restore
         instead of crashing the informer replay."""
         rs = status.get("resource-status") or {}
-        cpuset = rs.get("cpuset", "")
+        cpuset = rs.get("cpuset", "") if isinstance(rs, dict) else ""
         if cpuset and self.cpu_manager is not None:
-            from koordinator_tpu.koordlet.system.procfs import parse_cpu_list
             from koordinator_tpu.scheduler.cpu_manager import (
                 EXCLUSIVE_PCPU_LEVEL,
+                parse_cpuset_bounded,
             )
 
             try:
-                cpus = parse_cpu_list(str(cpuset))  # accepts "0-3,8" forms
+                cpus = parse_cpuset_bounded(str(cpuset))
             except ValueError:
                 cpus = []
             if cpus and self.cpu_manager.restore(
@@ -273,8 +273,13 @@ class Scheduler:
         devices = status.get("device-allocated") or {}
         if devices and self.device_manager is not None:
             if self.device_manager.restore(pod.node, pod.name, devices):
+                # serve the RE-DERIVED truth, not the raw payload: a
+                # partially-restored annotation (unknown types, stale
+                # minors) must not be reported as tracked
                 self.resource_status.setdefault(pod.name, {})[
-                    "device-allocated"] = devices
+                    "device-allocated"] = (
+                        self.device_manager.device_allocated_annotation(
+                            pod.node, pod.name))
 
     def remove_bound_pod(self, name: str) -> None:
         """Release a bound pod's node reservation iff still tracked (quota

@@ -137,14 +137,21 @@ class StateSyncService:
     def upsert_node(self, name: str, allocatable: np.ndarray,
                     usage: np.ndarray | None = None,
                     labels: dict | None = None,
-                    taints: dict | None = None) -> int:
+                    taints: dict | None = None,
+                    annotations: dict | None = None,
+                    devices: dict | None = None) -> int:
+        """``annotations`` carries the koordlet's NRT payload (cpu-topology
+        etc.); ``devices`` carries the Device-CR inventory per type
+        ({type: [{"core": c, "memory": b, "group": g}, ...]}) — both feed
+        the scheduler's fine-grained allocators on the client side."""
         arrays = {
             "allocatable": np.asarray(allocatable, np.int32),
             "usage": (np.asarray(usage, np.int32) if usage is not None
                       else np.zeros_like(allocatable, np.int32)),
         }
         doc = {"kind": NODE_UPSERT, "name": name,
-               "labels": labels or {}, "taints": taints or {}}
+               "labels": labels or {}, "taints": taints or {},
+               "annotations": annotations or {}, "devices": devices or {}}
         with self._lock:
             self.nodes[name] = {"doc": doc, "arrays": arrays}
         return self._commit(doc, arrays)
@@ -159,12 +166,13 @@ class StateSyncService:
                 gang: str | None = None,
                 node_selector: dict | None = None,
                 labels: dict | None = None,
-                owner: str | None = None) -> int:
+                owner: str | None = None,
+                qos: int = 0) -> int:
         arrays = {"requests": np.asarray(requests, np.int32)}
         doc = {"kind": POD_ADD, "name": name, "priority": priority,
                "quota": quota, "gang": gang,
                "node_selector": node_selector or {},
-               "labels": labels or {}, "owner": owner}
+               "labels": labels or {}, "owner": owner, "qos": qos}
         with self._lock:
             self.pods[name] = {"doc": doc, "arrays": arrays}
         return self._commit(doc, arrays)
@@ -374,6 +382,23 @@ class SchedulerBinding:
                 labels=dict(entry.get("labels", {})),
                 taints=dict(entry.get("taints", {})),
             ))
+            # fine-grained registries ride the node event: NRT annotations
+            # register the CPU topology, the Device inventory registers
+            # per-type device tensors
+            annotations = entry.get("annotations") or {}
+            if annotations and self.scheduler.cpu_manager is not None:
+                from koordinator_tpu.scheduler.cpu_manager import (
+                    register_node_from_annotations,
+                )
+
+                register_node_from_annotations(
+                    self.scheduler.cpu_manager, entry["name"], annotations)
+            devices = entry.get("devices") or {}
+            if devices and self.scheduler.device_manager is not None:
+                for dev_type, inventory in devices.items():
+                    if isinstance(inventory, list):
+                        self.scheduler.device_manager.register_node_devices(
+                            dev_type, entry["name"], inventory)
 
     def node_remove(self, name: str) -> None:
         with self.scheduler.lock:
@@ -391,6 +416,7 @@ class SchedulerBinding:
             node_selector=dict(entry.get("node_selector", {})),
             labels=dict(entry.get("labels", {})),
             owner=entry.get("owner"),
+            qos=int(entry.get("qos", 0)),
         ))
 
     def pod_remove(self, name: str) -> None:

@@ -342,3 +342,61 @@ class TestSidecarDeployment:
             if client is not None:
                 client.close()
             out.server.stop()
+
+
+class TestFineGrainedLoop:
+    def test_scheduler_cpuset_applies_on_node(self, tmp_path):
+        """SURVEY 3.3 with the fine-grained path: scheduler allocates an
+        exclusive cpuset at bind (nodenumaresource Reserve), the decision
+        travels as the resource-status annotation, and the koordlet cpuset
+        hook writes it to the pod's cgroup."""
+        from koordinator_tpu.api.resources import resource_vector
+        from koordinator_tpu.features import RUNTIMEHOOK_GATES
+        from koordinator_tpu.koordlet.runtimehooks.hooks import (
+            HookRegistry, Stage,
+        )
+        from koordinator_tpu.koordlet.runtimehooks.plugins import (
+            register_default_hooks,
+        )
+        from koordinator_tpu.koordlet.runtimehooks.protocol import PodContext
+        from koordinator_tpu.koordlet.statesinformer import PodMeta
+        from koordinator_tpu.ops.numa import CPUTopology
+        from koordinator_tpu.scheduler.cpu_manager import CPUManager
+        from koordinator_tpu.scheduler.scheduler import Scheduler
+        from koordinator_tpu.scheduler.snapshot import NodeSpec, PodSpec
+
+        import numpy as _np
+
+        cm = CPUManager()
+        cm.register_node("n0", CPUTopology.build(
+            _np.arange(8, dtype=_np.int32) // 2,
+            _np.arange(8, dtype=_np.int32) // 4,
+            _np.zeros(8, _np.int32)))
+        snapshot = make_cluster(n_nodes=1)
+        sched = Scheduler(snapshot, cpu_manager=cm)
+        sched.enqueue(PodSpec(
+            name="lsr-1",
+            requests=resource_vector({"cpu": 4_000, "memory": 1_024}),
+            qos=int(QoSClass.LSR), priority=9_000))
+        res = sched.schedule_round()
+        assert res.assignments["lsr-1"] == "n0"
+        status = sched.resource_status["lsr-1"]["resource-status"]
+
+        # the annotation rides the pod object to the node agent
+        annotations = ext.set_resource_status(
+            {}, status["cpuset"], status["numaNodeResources"])
+        cfg = make_test_config(tmp_path)
+        registry = HookRegistry()
+        register_default_hooks(registry, node_slo=lambda: crds.NodeSLO())
+        prev = RUNTIMEHOOK_GATES.enabled("CPUSetAllocator")
+        RUNTIMEHOOK_GATES.set("CPUSetAllocator", True)
+        try:
+            agent_pod = PodMeta(
+                uid="lsr-1", name="lsr-1", namespace="default",
+                qos_class=QoSClass.LSR, kube_qos="guaranteed",
+                annotations=annotations)
+            ctx = PodContext.from_pod(agent_pod, cfg)
+            registry.run(Stage.PRE_CREATE_CONTAINER, ctx)
+        finally:
+            RUNTIMEHOOK_GATES.set("CPUSetAllocator", prev)
+        assert ctx.response.cpuset_cpus == status["cpuset"]

@@ -464,3 +464,62 @@ def test_reservation_in_snapshot_resync(rpc):
     sync.bootstrap(client)
     solve_remote(client)
     assert sched.reservations.get("rsv-a").node == "n1"
+
+
+def test_fine_grained_registries_ride_node_sync(rpc):
+    """NRT annotations + Device inventory on NODE_UPSERT register the
+    client scheduler's CPU/device managers, so wire-synced LSR and GPU
+    pods get real fine-grained allocations (the deployment path)."""
+    from koordinator_tpu.api.qos import QoSClass
+    from koordinator_tpu.koordlet.nodetopo import NodeTopology, NUMAZone
+    from koordinator_tpu.koordlet.system import procfs
+    from koordinator_tpu.scheduler.cpu_manager import CPUManager
+    from koordinator_tpu.scheduler.device_manager import DeviceManager
+
+    server, clients = rpc
+    service = StateSyncService()
+    service.attach(server)
+    server.start()
+
+    snap = ClusterSnapshot(capacity=16)
+    cfg = ScoringConfig.default().replace(
+        usage_thresholds=jnp.zeros(R, jnp.int32),
+        estimator_defaults=jnp.zeros(R, jnp.int32))
+    sched = Scheduler(snap, config=cfg, cpu_manager=CPUManager(),
+                      device_manager=DeviceManager())
+    SolveService(sched).attach(server)
+    sync = StateSyncClient(SchedulerBinding(sched))
+    client = connect(server, clients, on_push=sync.on_push)
+    sync.bootstrap(client)
+
+    cpus = tuple(procfs.CPUInfo(cpu=i, core=i // 2, socket=0, node=i // 4)
+                 for i in range(8))
+    topo = NodeTopology(
+        zones=(NUMAZone("node0", 4_000, 1 << 30, (0, 1, 2, 3)),
+               NUMAZone("node1", 4_000, 1 << 30, (4, 5, 6, 7))),
+        cpu_topology=cpus)
+    service.upsert_node(
+        "n1",
+        resource_vector({"cpu": 16_000, "memory": 65_536,
+                         "kubernetes.io/gpu": 400,
+                         "kubernetes.io/gpu-memory": 81_920 * 4}),
+        annotations=topo.to_annotations(),
+        devices={"gpu": [{"core": 100, "memory": 81_920, "group": 0}
+                         for _ in range(4)]})
+    wait_until(lambda: sync.rv == service.rv)
+
+    service.add_pod("lsr-1", resource_vector({"cpu": 2_000, "memory": 512}),
+                    priority=9_000, qos=int(QoSClass.LSR))
+    wait_until(lambda: sync.rv == service.rv)
+    result = solve_remote(client)
+    assert result["assignments"]["lsr-1"] == "n1"
+    assert len(sched.resource_status["lsr-1"]["resource-status"]
+               ["cpuset"].split(",")) == 2
+
+    service.add_pod("gpu-1", resource_vector(
+        {"cpu": 1_000, "memory": 512, "kubernetes.io/gpu": 100,
+         "kubernetes.io/gpu-memory": 8_192}))
+    wait_until(lambda: sync.rv == service.rv)
+    result = solve_remote(client)
+    assert result["assignments"]["gpu-1"] == "n1"
+    assert sched.resource_status["gpu-1"]["device-allocated"]["gpu"]
