@@ -253,3 +253,30 @@ class XpuCollector:
                 },
             ))
         return out
+
+
+def device_infos_to_inventory(
+    infos: list["crds.DeviceInfo"],
+) -> dict[str, list[dict]]:
+    """Convert Device-CR DeviceInfo records into the per-type inventory the
+    scheduler's DeviceManager registers ({type: [{"core", "memory",
+    "group"}]} — deviceshare's nodeDevice build format).  Minor ids index
+    the list; gaps pad with zero-capacity entries and unhealthy devices
+    contribute zero capacity (deviceshare skips unhealthy devices)."""
+    out: dict[str, list[dict]] = {}
+    for info in infos:
+        # Device CRs are external data: a negative minor would wrap the
+        # row index, a huge one would materialize that many pad entries
+        if not (0 <= int(info.minor) <= 4096):
+            continue
+        rows = out.setdefault(info.type, [])
+        while len(rows) <= info.minor:
+            rows.append({"core": 0, "memory": 0, "group": 0})
+        core = int(info.resources.get(f"{info.type}-core", 100))
+        memory = int(info.resources.get(f"{info.type}-memory", 0))
+        rows[info.minor] = {
+            "core": core if info.health else 0,
+            "memory": memory if info.health else 0,
+            "group": max(int(info.numa_node), 0),
+        }
+    return out

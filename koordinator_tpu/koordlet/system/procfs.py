@@ -146,18 +146,27 @@ def read_diskstats(cfg: SystemConfig | None = None) -> dict[str, DiskStat]:
 # ---- cpuset list format -----------------------------------------------------
 
 
-def parse_cpu_list(spec: str) -> list[int]:
-    """'0-3,8,10-11' -> [0,1,2,3,8,10,11] (util/cpuset parity)."""
+def parse_cpu_list(spec: str, limit: int | None = None) -> list[int]:
+    """'0-3,8,10-11' -> [0,1,2,3,8,10,11] (util/cpuset parity).
+
+    ``limit`` bounds the materialized size for callers parsing EXTERNAL
+    data (annotations): a corrupt '0-4000000000' raises ValueError before
+    expanding instead of exhausting memory."""
     cpus: list[int] = []
     for part in spec.strip().split(","):
         part = part.strip()
         if not part:
             continue
         if "-" in part:
-            lo, hi = part.split("-", 1)
-            cpus.extend(range(int(lo), int(hi) + 1))
+            lo_s, hi_s = part.split("-", 1)
+            lo, hi = int(lo_s), int(hi_s)
+            if limit is not None and (hi < lo or hi - lo + 1 > limit):
+                raise ValueError(f"cpu range too wide: {part}")
+            cpus.extend(range(lo, hi + 1))
         else:
             cpus.append(int(part))
+        if limit is not None and len(cpus) > limit:
+            raise ValueError("cpu list too large")
     return sorted(set(cpus))
 
 

@@ -50,11 +50,32 @@ class CPUManager:
     def register_node(
         self, name: str, topology: CPUTopology, max_ref: int = 1
     ) -> None:
-        self._nodes[name] = NodeCPUState(
+        """(Re-)register a node's topology.  Node objects re-sync on every
+        heartbeat, so a re-registration must carry live allocations over —
+        wiping ref counts would let exclusive cores be granted twice."""
+        old = self._nodes.get(name)
+        if (old is not None and old.max_ref == max_ref
+                and old.topology.capacity == topology.capacity
+                and bool(np.array_equal(np.asarray(old.topology.core_of),
+                                        np.asarray(topology.core_of)))
+                and bool(np.array_equal(np.asarray(old.topology.numa_of),
+                                        np.asarray(topology.numa_of)))):
+            return   # unchanged heartbeat: keep state as-is
+        st = NodeCPUState(
             topology=topology,
             ref_count=np.zeros(topology.capacity, np.int32),
             max_ref=max_ref,
         )
+        if old is not None:
+            valid = np.asarray(topology.valid)
+            for pod, alloc in old.allocations.items():
+                cpus = [c for c in alloc.cpus
+                        if c < len(valid) and valid[c]]
+                if cpus:
+                    st.ref_count[cpus] += 1
+                    st.allocations[pod] = CPUAllocation(
+                        pod, cpus, alloc.exclusive_policy)
+        self._nodes[name] = st
 
     def node(self, name: str) -> NodeCPUState | None:
         return self._nodes.get(name)
@@ -168,25 +189,11 @@ class CPUManager:
 
 
 def parse_cpuset_bounded(s: str, limit: int = 1024) -> list[int]:
-    """Parse a "0-3,8" cpuset string with a hard size bound.  Annotation
-    data is external: an eager range expansion of a corrupt "0-4000000000"
-    must raise, not materialize billions of entries during replay."""
-    out: list[int] = []
-    for tok in str(s).split(","):
-        tok = tok.strip()
-        if not tok:
-            continue
-        if "-" in tok:
-            lo_s, _, hi_s = tok.partition("-")
-            lo, hi = int(lo_s), int(hi_s)
-            if hi < lo or hi - lo + 1 > limit:
-                raise ValueError(f"cpuset range too wide: {tok}")
-            out.extend(range(lo, hi + 1))
-        else:
-            out.append(int(tok))
-        if len(out) > limit:
-            raise ValueError("cpuset too large")
-    return out
+    """Parse a "0-3,8" cpuset string with a hard size bound (annotation
+    data is external; the shared procfs parser enforces the limit)."""
+    from koordinator_tpu.koordlet.system.procfs import parse_cpu_list
+
+    return parse_cpu_list(str(s), limit=limit)
 
 
 def register_node_from_annotations(

@@ -62,6 +62,8 @@ class DeviceManager:
         rebuilding the type tensors and re-committing live allocations so
         an inventory update can't silently zero out held capacity."""
         raw = self._raw.setdefault(device_type, {})
+        if raw.get(node) == list(devices):
+            return   # unchanged heartbeat: skip the O(cluster) rebuild
         raw[node] = list(devices)
         names = sorted(raw)
         self._state[device_type] = DeviceState.build([raw[n] for n in names])
@@ -74,11 +76,16 @@ class DeviceManager:
                 if a.device_type != device_type:
                     continue
                 dev = self._state[device_type]
-                minors = [m for m in a.minors if m < dev.shape[1]]
-                if not minors:
+                # prune the RECORD too: a minor dropped by an inventory
+                # shrink must not resurface in annotations or crash a
+                # later release's mask indexing
+                a.minors = [m for m in a.minors
+                            if m < dev.shape[1]
+                            and bool(dev.valid[row, m])]
+                if not a.minors:
                     continue
                 sel = np.zeros(dev.shape[1], bool)
-                sel[minors] = True
+                sel[a.minors] = True
                 self._state[device_type] = commit_allocation(
                     dev, jnp.int32(row), jnp.asarray(sel),
                     jnp.int32(a.core), jnp.int32(a.memory),

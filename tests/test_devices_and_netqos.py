@@ -235,3 +235,37 @@ class TestTerwayQoS:
         run_hook(hook, pod(QoSClass.LS))
         assert not os.path.exists(os.path.join(
             cfg.var_run_root, "terway-qos", "pod-1.json"))
+
+
+class TestDeviceInventoryBridge:
+    def test_device_infos_to_inventory_round_trip(self):
+        from koordinator_tpu.api import crds
+        from koordinator_tpu.koordlet.devices import (
+            device_infos_to_inventory,
+        )
+        from koordinator_tpu.scheduler.device_manager import DeviceManager
+
+        infos = [
+            crds.DeviceInfo(type="gpu", minor=0, health=True, numa_node=0,
+                            resources={"gpu-core": 100,
+                                       "gpu-memory": 81_920}),
+            crds.DeviceInfo(type="gpu", minor=2, health=True, numa_node=1,
+                            resources={"gpu-core": 100,
+                                       "gpu-memory": 81_920}),
+            crds.DeviceInfo(type="gpu", minor=1, health=False, numa_node=0,
+                            resources={"gpu-core": 100,
+                                       "gpu-memory": 81_920}),
+            crds.DeviceInfo(type="rdma", minor=0,
+                            resources={"rdma-core": 100}),
+        ]
+        inv = device_infos_to_inventory(infos)
+        assert len(inv["gpu"]) == 3
+        assert inv["gpu"][1] == {"core": 0, "memory": 0, "group": 0}  # sick
+        assert inv["gpu"][2]["group"] == 1
+        assert inv["rdma"][0]["core"] == 100
+
+        mgr = DeviceManager()
+        mgr.register_node_devices("gpu", "n0", inv["gpu"])
+        # only the two healthy GPUs allocate
+        assert mgr.allocate("gpu", "n0", "p", core=200) is not None
+        assert mgr.allocate("gpu", "n0", "q", core=100) is None

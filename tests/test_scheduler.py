@@ -850,3 +850,38 @@ class TestFineGrainedBind:
                 resource_status=grant)
         sched.remove_bound_pod("gpu-replay")
         assert dm.allocate("gpu", "n1", "x", core=400) is not None
+
+    def test_node_resync_preserves_exclusive_cpuset(self):
+        # heartbeat re-registration of the same topology must not wipe
+        # live allocations (double-grant of exclusive cores)
+        from koordinator_tpu.ops.numa import CPUTopology
+
+        import numpy as _np
+
+        cm, dm = self._managers()
+        topo = cm.node("n1").topology
+        cpus = cm.allocate("n1", "lsr-a", 4)
+        assert cpus is not None
+        cm.register_node("n1", topo)             # identical re-sync
+        assert cm.node("n1").ref_count.sum() == 4
+        # a changed topology carries valid allocations over
+        cm.register_node("n1", CPUTopology.build(
+            _np.asarray(topo.core_of), _np.asarray(topo.numa_of),
+            _np.asarray(topo.socket_of)), max_ref=2)
+        assert cm.node("n1").allocations["lsr-a"].cpus == cpus
+        assert cm.node("n1").ref_count.sum() == 4
+
+    def test_device_inventory_shrink_prunes_held_minors(self):
+        from koordinator_tpu.scheduler.device_manager import DeviceManager
+
+        dm = DeviceManager()
+        dm.register_node_devices("gpu", "n0", [
+            {"core": 100, "memory": 0, "group": 0} for _ in range(5)])
+        assert dm.allocate("gpu", "n0", "p", core=500) is not None
+        dm.register_node_devices("gpu", "n0", [
+            {"core": 100, "memory": 0, "group": 0} for _ in range(2)])
+        # records pruned to the surviving minors; release doesn't crash
+        allocs = dm._allocs[("p", "n0")]
+        assert all(m < 2 for a in allocs for m in a.minors)
+        dm.release("n0", "p")
+        assert dm.allocate("gpu", "n0", "q", core=200) is not None
