@@ -74,8 +74,13 @@ class Daemon:
             self.hook_registry,
             node_slo=ctx.node_slo,
         )
+        from koordinator_tpu.koordlet.runtimehooks.plugins import (
+            ResctrlUpdater,
+        )
+
         self.hook_reconciler = Reconciler(
-            self.states, self.hook_registry, self.executor, self.cfg
+            self.states, self.hook_registry, self.executor, self.cfg,
+            resctrl_updater=ResctrlUpdater(self.cfg),
         )
         from koordinator_tpu.koordlet.prediction_server import PredictServer
 

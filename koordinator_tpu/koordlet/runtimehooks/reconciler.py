@@ -9,6 +9,8 @@ The executor's last-value cache makes this idempotent and cheap.
 
 from __future__ import annotations
 
+import os
+
 from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
 from koordinator_tpu.koordlet.runtimehooks.hooks import HookRegistry, Stage
 from koordinator_tpu.koordlet.runtimehooks.protocol import (
@@ -20,16 +22,26 @@ from koordinator_tpu.koordlet.system.config import SystemConfig
 
 class Reconciler:
     def __init__(self, states: StatesInformer, registry: HookRegistry,
-                 executor: ResourceUpdateExecutor, cfg: SystemConfig):
+                 executor: ResourceUpdateExecutor, cfg: SystemConfig,
+                 resctrl_updater=None):
         self.states = states
         self.registry = registry
         self.executor = executor
         self.cfg = cfg
+        #: applies hook responses' resctrl fields (ctrl group + schemata);
+        #: resctrl is not a cgroup, so it bypasses the executor.  Only
+        #: per-pod (koord-pod-*) groups are reconciled here — the per-QoS
+        #: tier groups are the qosmanager resctrl plugin's job.
+        self.resctrl_updater = resctrl_updater
+        #: last applied (schemata, pids) per pod — keeps quiet passes
+        #: write-free for resctrl too (the executor cache analog)
+        self._resctrl_applied: dict[str, tuple] = {}
 
     def reconcile_once(self) -> int:
         """Re-apply pod + container rules from current state; returns the
         number of kernel writes actually performed."""
         writes = 0
+        live: set[str] = set()
         for pod in self.states.get_all_pods():
             if not pod.is_running:
                 continue
@@ -37,8 +49,56 @@ class Reconciler:
             self.registry.run(Stage.PRE_RUN_POD_SANDBOX, pod_ctx)
             self.registry.run(Stage.PRE_UPDATE_CONTAINER, pod_ctx)
             writes += pod_ctx.apply(self.executor)
+            self._reconcile_resctrl(pod, pod_ctx, live)
             for container in pod.containers:
                 ctx = ContainerContext.from_container(pod, container, self.cfg)
                 self.registry.run(Stage.PRE_CREATE_CONTAINER, ctx)
                 writes += ctx.apply(self.executor)
+        if self.resctrl_updater is not None:
+            # RemovePodResctrlResources: enumerate on-disk koord-pod-*
+            # groups (not an in-memory set — it would leak groups of pods
+            # that left while the agent was down) and drop the dead ones
+            root = self.resctrl_updater.fs.root
+            try:
+                existing = [d for d in os.listdir(root)
+                            if d.startswith("koord-pod-")]
+            except OSError:
+                existing = []
+            for d in existing:
+                uid = d[len("koord-pod-"):]
+                if uid not in live:
+                    self.resctrl_updater.remove_group(uid)
+                    self._resctrl_applied.pop(uid, None)
         return writes
+
+    def _reconcile_resctrl(self, pod, pod_ctx, live: set[str]) -> None:
+        group = pod_ctx.response.resctrl_group
+        if (self.resctrl_updater is None or group is None
+                or not group.startswith("koord-pod-")):
+            return
+        live.add(pod.uid)
+        pids = list(pod.pids or ()) or self._pod_pids(pod)
+        key = (group, pod_ctx.response.resctrl_schemata,
+               tuple(sorted(pids)))
+        if self._resctrl_applied.get(pod.uid) == key and os.path.isdir(
+                self.resctrl_updater.fs.group_dir(group)):
+            return   # unchanged: write-free pass
+        try:
+            self.resctrl_updater.apply(pod_ctx.response, pids=pids)
+            self._resctrl_applied[pod.uid] = key
+        except OSError:
+            # hardware-rejected schemata / unmounted resctrl must not
+            # abort reconciliation of the remaining pods
+            pass
+
+    def _pod_pids(self, pod) -> list[int]:
+        """Task ids from the pod cgroup's cgroup.procs (the informer may
+        not carry pids; resctrl binding needs them node-side anyway)."""
+        path = self.cfg.cgroup_abs_path(
+            "cpu", pod.cgroup_dir(self.cfg), "cgroup.procs")
+        try:
+            with open(path) as f:
+                return [int(x) for x in f.read().split()
+                        if x.strip().isdigit()]
+        except OSError:
+            return []

@@ -51,6 +51,8 @@ class PodMeta:
     annotations: Mapping[str, str] = dataclasses.field(default_factory=dict)
     labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
     host_network: bool = False
+    #: task ids from the pod cgroup's cgroup.procs (resctrl task binding)
+    pids: tuple[int, ...] = ()
 
     def cgroup_dir(self, cfg: SystemConfig | None = None) -> str:
         cfg = cfg or get_config()

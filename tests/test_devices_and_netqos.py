@@ -269,3 +269,59 @@ class TestDeviceInventoryBridge:
         # only the two healthy GPUs allocate
         assert mgr.allocate("gpu", "n0", "p", core=200) is not None
         assert mgr.allocate("gpu", "n0", "q", core=100) is None
+
+
+class TestResctrlReconcile:
+    def test_reconciler_applies_and_removes_resctrl(self, cfg):
+        """The daemon path: annotated pod gets its ctrl group programmed at
+        reconcile; the group is removed when the pod leaves the node."""
+        from koordinator_tpu.koordlet.resourceexecutor import (
+            ResourceUpdateExecutor,
+        )
+        from koordinator_tpu.koordlet.runtimehooks.hooks import HookRegistry
+        from koordinator_tpu.koordlet.runtimehooks.plugins import (
+            ResctrlUpdater,
+            register_default_hooks,
+        )
+        from koordinator_tpu.koordlet.runtimehooks.reconciler import (
+            Reconciler,
+        )
+        from koordinator_tpu.api import crds
+
+        RUNTIMEHOOK_GATES.set("Resctrl", True)
+        try:
+            states = StatesInformer()
+            registry = HookRegistry()
+            register_default_hooks(registry, node_slo=lambda: crds.NodeSLO())
+            updater = ResctrlUpdater(cfg)
+            rec = Reconciler(states, registry,
+                             ResourceUpdateExecutor(cfg=cfg), cfg,
+                             resctrl_updater=updater)
+            p = PodMeta(
+                uid="rp-1", name="rp-1", namespace="default",
+                qos_class=QoSClass.LS, kube_qos="burstable",
+                pids=(4321,),
+                annotations={ext.ANNOTATION_RESCTRL: json.dumps(
+                    {"l3": 50, "mb": 30})})
+            states.set_pods([p])
+            rec.reconcile_once()
+            gdir = updater.fs.group_dir("koord-pod-rp-1")
+            assert os.path.isdir(gdir)
+            assert "MB:0=30" in open(os.path.join(gdir, "schemata")).read()
+            assert "4321" in open(os.path.join(gdir, "tasks")).read()
+            # quiet pass: unchanged state rewrites nothing
+            os.unlink(os.path.join(gdir, "schemata"))
+            rec.reconcile_once()
+            assert not os.path.exists(os.path.join(gdir, "schemata"))
+            # a group left on disk from BEFORE a restart is cleaned too
+            fresh = Reconciler(states, registry,
+                               ResourceUpdateExecutor(cfg=cfg), cfg,
+                               resctrl_updater=ResctrlUpdater(cfg))
+            os.makedirs(updater.fs.group_dir("koord-pod-ghost"),
+                        exist_ok=True)
+            states.set_pods([])   # pod leaves the node
+            fresh.reconcile_once()
+            assert not os.path.isdir(gdir)
+            assert not os.path.isdir(updater.fs.group_dir("koord-pod-ghost"))
+        finally:
+            RUNTIMEHOOK_GATES.set("Resctrl", False)
