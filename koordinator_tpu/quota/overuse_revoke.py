@@ -156,6 +156,17 @@ class QuotaOveruseRevokeController:
             bp = self.scheduler.bound.get(name)
             if bp is None:
                 continue
+            # PDB budgets bind here as in the preemption path: a pod whose
+            # disruption budget is exhausted survives (the quota stays
+            # armed and retries once the budget recovers)
+            matching_pdbs = [
+                rec for rec in self.scheduler.pdbs.values()
+                if rec.matches(bp.labels)
+            ]
+            if any(rec.allowed <= 0 for rec in matching_pdbs):
+                continue
+            for rec in matching_pdbs:
+                rec.allowed -= 1
             quota = bp.quota
             self.scheduler.remove_bound_pod(name)
             if quota and quota in tree.nodes:

@@ -192,6 +192,8 @@ class Scheduler:
         self.cpu_manager = cpu_manager
         self.device_manager = device_manager
         self.resource_status: dict[str, dict] = {}
+        #: quota overuse revoke controller (enable_overuse_revoke)
+        self.overuse_revoke = None
         #: bound on pods routed through the sequential reservation pre-pass
         #: per round — a popular owner selector must not drag a 50k-pod
         #: round onto the O(P) exact scan (extras solve normally and can
@@ -325,6 +327,21 @@ class Scheduler:
             if bound is not None:
                 self.remove_bound_pod(name)
                 self._charge_quota_used(bound, sign=-1)
+
+    def enable_overuse_revoke(self, revoke_fn=None,
+                              delay_evict_sec: float = 5.0) -> None:
+        """Turn on the elastic-quota overuse revoke loop
+        (quota_overuse_revoke.go): each round, quotas whose used exceeds
+        runtime continuously past the delay get their least-important pods
+        revoked until they fit.  ``revoke_fn(pod, quota)`` performs the
+        external eviction (the scheduler's own accounting releases here)."""
+        from koordinator_tpu.quota.overuse_revoke import (
+            QuotaOveruseRevokeController,
+        )
+
+        self.overuse_revoke = QuotaOveruseRevokeController(
+            self, revoke_fn=revoke_fn, delay_evict_sec=delay_evict_sec,
+            clock=self.clock)
 
     def add_reservation(self, spec) -> None:
         """Accept a Reservation CR: placement happens next round (a pinned
@@ -726,6 +743,15 @@ class Scheduler:
             with self.monitor.phase("Nominated"):
                 self.snapshot.flush()
                 self._resolve_nominations(result)
+        if self.overuse_revoke is not None and self.quota_tree is not None:
+            with self.monitor.phase("QuotaRevoke"):
+                # AFTER nominations (their released quota charges must not
+                # trigger needless evictions) and BEFORE the solve (freed
+                # headroom is visible to this round's admission); the
+                # monitor must see a FRESH runtime — a stale/zeroed one
+                # would flag healthy quotas (fingerprint-cached, cheap)
+                self._build_quota()
+                self.overuse_revoke.revoke_once()
         with self.monitor.phase("PreEnqueue"):
             pods = self._active_pods()
         if not pods:

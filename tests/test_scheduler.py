@@ -885,3 +885,71 @@ class TestFineGrainedBind:
         assert all(m < 2 for a in allocs for m in a.minors)
         dm.release("n0", "p")
         assert dm.allocate("gpu", "n0", "q", core=200) is not None
+
+
+def test_overuse_revoke_in_round_loop():
+    """quota_overuse_revoke.go through the rounds: runtime shrinks after
+    admission, the over-used quota's least-important pod is revoked past
+    the delay, and the freed headroom admits the other quota's pod."""
+    t = [0.0]
+    total = resource_vector(cpu=16_000, memory=131_072).astype(np.int64)
+    tree = QuotaTree(total)
+    mx = np.full(R, UNBOUNDED, np.int64)
+    mx[CPU] = 16_000
+    for q in ("a", "b"):
+        tree.add(q, min=np.zeros(R, np.int64), max=mx)
+    sched, _ = mk_scheduler([node("n1", cpu=16_000)], quota_tree=tree,
+                            clock=lambda: t[0])
+    revoked = []
+    sched.enable_overuse_revoke(
+        revoke_fn=lambda p, q: revoked.append((p, q)), delay_evict_sec=5.0)
+
+    # quota a takes nearly everything while b is idle
+    sched.enqueue(pod("a-low", cpu=10_000, quota="a", priority=3_000))
+    sched.enqueue(pod("a-high", cpu=4_000, quota="a", priority=9_000))
+    res = sched.schedule_round()
+    assert {"a-low", "a-high"} <= set(res.assignments)
+
+    # b starts demanding: its pod can't fit (2k node free), stays pending,
+    # and its request shrinks a's runtime share below a's used
+    sched.enqueue(pod("b-1", cpu=8_000, quota="b", priority=9_000))
+    res = sched.schedule_round()    # monitor arms (fresh runtime computed)
+    assert "b-1" in res.failures
+    assert np.any(tree.nodes["a"].used > tree.nodes["a"].runtime)
+
+    t[0] = 10.0                     # past delay_evict_sec
+    res = sched.schedule_round()
+    # least-important overshoot pod revoked; b's pod admitted
+    assert ("a-low", "a") in revoked
+    assert "a-low" not in sched.bound
+    assert res.assignments.get("b-1") == "n1"
+    assert "a-high" in sched.bound  # the important pod survives
+
+
+def test_overuse_revoke_honors_pdb_budget():
+    from koordinator_tpu.scheduler.scheduler import PdbRecord
+
+    t = [0.0]
+    total = resource_vector(cpu=16_000, memory=131_072).astype(np.int64)
+    tree = QuotaTree(total)
+    mx = np.full(R, UNBOUNDED, np.int64)
+    mx[CPU] = 16_000
+    for q in ("a", "b"):
+        tree.add(q, min=np.zeros(R, np.int64), max=mx)
+    sched, _ = mk_scheduler([node("n1", cpu=16_000)], quota_tree=tree,
+                            clock=lambda: t[0])
+    revoked = []
+    sched.enable_overuse_revoke(
+        revoke_fn=lambda p, q: revoked.append(p), delay_evict_sec=5.0)
+    sched.register_pdb(PdbRecord(name="protect-a",
+                                 selector={"app": "a"}, allowed=0))
+    sched.enqueue(pod("a-low", cpu=14_000, quota="a", priority=3_000,
+                      labels={"app": "a"}))
+    sched.schedule_round()
+    sched.enqueue(pod("b-1", cpu=8_000, quota="b", priority=9_000))
+    sched.schedule_round()
+    t[0] = 10.0
+    sched.schedule_round()
+    # PDB exhausted: the overshoot pod survives the revoke
+    assert revoked == []
+    assert "a-low" in sched.bound
