@@ -152,6 +152,10 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
     from koordinator_tpu.manager.noderesource_controller import (
         NodeResourceController,
     )
+    from koordinator_tpu.manager.quota_profile import QuotaProfileController
+    from koordinator_tpu.manager.recommendation import (
+        RecommendationController,
+    )
     from koordinator_tpu.manager.webhook import (
         PodMutatingWebhook,
         PodValidatingWebhook,
@@ -165,6 +169,8 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
         noderesource=NodeResourceController(),
         pod_mutating=PodMutatingWebhook(),
         pod_validating=PodValidatingWebhook(),
+        quota_profile=QuotaProfileController(),
+        recommendation=RecommendationController(),
     )
     return Assembled(name="koord-manager", args=args, component=component,
                      elector=build_elector(args, lease_store))

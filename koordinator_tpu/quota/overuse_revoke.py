@@ -34,6 +34,7 @@ def select_overuse_victims(
     used: jnp.ndarray,      # (Q, R) int32 per-quota used
     runtime: jnp.ndarray,   # (Q, R) int32 per-quota runtime
     checked: jnp.ndarray,   # (Q, R) bool — dims declared in the quota's max
+    pdb_allowed: jnp.ndarray | None = None,   # (P,) int32 budgets
 ) -> jnp.ndarray:
     """(V,) bool revoke mask across every quota at once.
 
@@ -44,6 +45,15 @@ def select_overuse_victims(
     tentative victims go (the reference's "should evict all" branch).
     """
     cand = sched.valid & ~sched.non_preemptible & (sched.quota_id >= 0)
+    if pdb_allowed is not None:
+        # exhausted disruption budgets exclude pods INSIDE the selection,
+        # so a protected lowest-priority pod doesn't permanently block
+        # revocation when an evictable alternative exists (note: per-PDB
+        # budgets gate counts at commit; the kernel only masks zero-budget
+        # pods, matching the preemption kernel's candidate masking)
+        blocked = (sched.pdb_id >= 0) & (
+            pdb_allowed[jnp.maximum(sched.pdb_id, 0)] <= 0)
+        cand = cand & ~blocked
     qid = jnp.maximum(sched.quota_id, 0)
     # ascending importance: lowest priority first, stable by row index
     pri_key = jnp.where(cand, sched.priority, jnp.int32(2**31 - 1))
@@ -65,7 +75,11 @@ def select_overuse_victims(
     def phase2(u, j):
         q = qid[j]
         req = sched.requests[j]
-        fits = jnp.all((u[q] + req <= runtime[q]) | (req == 0))
+        # reprieve fit only consults CHECKED dims (phase1 and the hopeless
+        # test do the same): an undeclared dim has no meaningful runtime
+        # and must not veto a reprieve
+        fits = jnp.all((u[q] + req <= runtime[q]) | (req == 0)
+                       | ~checked[q])
         back = tentative[j] & fits & ~hopeless[q]
         u = u.at[q].add(jnp.where(back, req, 0))
         return u, tentative[j] & ~back
@@ -90,6 +104,12 @@ class QuotaOveruseRevokeController:
         delay_evict_sec: float = 5.0,
         clock=time.monotonic,
     ):
+        if revoke_fn is None:
+            # mirroring the preemption guard: releasing a victim's
+            # accounting without anyone actually evicting it would
+            # oversubscribe its node against a still-running pod
+            raise ValueError("overuse revoke needs a revoke_fn that "
+                             "performs the eviction")
         self.scheduler = scheduler
         self.revoke_fn = revoke_fn
         self.delay_evict_sec = delay_evict_sec
@@ -146,9 +166,10 @@ class QuotaOveruseRevokeController:
             if name in triggered:
                 checked[i] = qnode.max != UNBOUNDED
 
+        _, pdb_allowed = self.scheduler._pdb_arrays()
         revoke = np.asarray(self._kernel(
             sched, jnp.asarray(used), jnp.asarray(runtime),
-            jnp.asarray(checked),
+            jnp.asarray(checked), jnp.asarray(pdb_allowed),
         ))
         evicted = []
         for v in np.flatnonzero(revoke):
@@ -169,14 +190,7 @@ class QuotaOveruseRevokeController:
                 rec.allowed -= 1
             quota = bp.quota
             self.scheduler.remove_bound_pod(name)
-            if quota and quota in tree.nodes:
-                qn = tree.nodes[quota]
-                qn.used = qn.used - bp.requests.astype(np.int64)
-                if bp.non_preemptible:
-                    qn.non_preemptible_used = (
-                        qn.non_preemptible_used - bp.requests.astype(np.int64)
-                    )
-            if self.revoke_fn is not None:
-                self.revoke_fn(name, quota)
+            self.scheduler._charge_quota_used(bp, sign=-1)
+            self.revoke_fn(name, quota)
             evicted.append(name)
         return evicted

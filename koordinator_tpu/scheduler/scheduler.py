@@ -645,11 +645,10 @@ class Scheduler:
             index,
         )
 
-    def _build_quota(self) -> tuple[QuotaDeviceState | None, dict[str, int]]:
-        if self.quota_tree is None:
-            return None, {}
-        # GroupQuotaManager duty: a leaf quota's request is what its pods ask
-        # for — already-admitted usage plus this round's pending requests.
+    def _refresh_quota_tree(self) -> None:
+        """GroupQuotaManager duty: a leaf quota's request is what its pods
+        ask for — already-admitted usage plus this round's pending requests
+        — then re-derive runtime (fingerprint-cached in the tree)."""
         pending: dict[str, np.ndarray] = {}
         for pod in self.pending.values():
             if pod.quota is not None and pod.quota in self.quota_tree.nodes:
@@ -665,6 +664,11 @@ class Scheduler:
                     name, np.zeros(self.snapshot.dims, np.int64))
             )
         self.quota_tree.refresh_runtime()
+
+    def _build_quota(self) -> tuple[QuotaDeviceState | None, dict[str, int]]:
+        if self.quota_tree is None:
+            return None, {}
+        self._refresh_quota_tree()
         return QuotaDeviceState.from_tree(self.quota_tree)
 
     def _apply_topology_plans(
@@ -749,8 +753,8 @@ class Scheduler:
                 # trigger needless evictions) and BEFORE the solve (freed
                 # headroom is visible to this round's admission); the
                 # monitor must see a FRESH runtime — a stale/zeroed one
-                # would flag healthy quotas (fingerprint-cached, cheap)
-                self._build_quota()
+                # would flag healthy quotas
+                self._refresh_quota_tree()
                 self.overuse_revoke.revoke_once()
         with self.monitor.phase("PreEnqueue"):
             pods = self._active_pods()

@@ -88,6 +88,10 @@ def test_manager_assembly_and_gates():
         assert out.component.noderesource is not None
         assert out.component.pod_mutating is not None
         assert out.elector.lease_name == "koordinator-system/koord-manager"
+        # the full controller set assembles (quota profiles + VPA-ish
+        # recommendation ride along with the SLO controllers)
+        assert out.component.quota_profile is not None
+        assert out.component.recommendation is not None
     finally:
         SCHEDULER_GATES.set("MultiQuotaTree", before)
 

@@ -953,3 +953,36 @@ def test_overuse_revoke_honors_pdb_budget():
     # PDB exhausted: the overshoot pod survives the revoke
     assert revoked == []
     assert "a-low" in sched.bound
+
+
+def test_overuse_revoke_selects_around_pdb_protected_pod():
+    """A PDB-protected lowest-priority pod must not permanently block
+    revocation: the kernel selects the evictable alternative instead."""
+    from koordinator_tpu.scheduler.scheduler import PdbRecord
+
+    t = [0.0]
+    total = resource_vector(cpu=16_000, memory=131_072).astype(np.int64)
+    tree = QuotaTree(total)
+    mx = np.full(R, UNBOUNDED, np.int64)
+    mx[CPU] = 16_000
+    for q in ("a", "b"):
+        tree.add(q, min=np.zeros(R, np.int64), max=mx)
+    sched, _ = mk_scheduler([node("n1", cpu=16_000)], quota_tree=tree,
+                            clock=lambda: t[0])
+    revoked = []
+    sched.enable_overuse_revoke(
+        revoke_fn=lambda p, q: revoked.append(p), delay_evict_sec=5.0)
+    sched.register_pdb(PdbRecord(name="protect-low",
+                                 selector={"tier": "low"}, allowed=0))
+    sched.enqueue(pod("a-low", cpu=7_000, quota="a", priority=3_000,
+                      labels={"tier": "low"}))
+    sched.enqueue(pod("a-mid", cpu=7_000, quota="a", priority=6_000))
+    sched.schedule_round()
+    sched.enqueue(pod("b-1", cpu=8_000, quota="b", priority=9_000))
+    sched.schedule_round()
+    t[0] = 10.0
+    res = sched.schedule_round()
+    # the unprotected pod was chosen even though a-low is less important
+    assert revoked == ["a-mid"]
+    assert "a-low" in sched.bound
+    assert res.assignments.get("b-1") == "n1"
