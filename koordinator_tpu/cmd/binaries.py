@@ -157,6 +157,7 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
         RecommendationController,
     )
     from koordinator_tpu.manager.webhook import (
+        MultiQuotaTreeAffinity,
         PodMutatingWebhook,
         PodValidatingWebhook,
     )
@@ -171,6 +172,10 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
         pod_validating=PodValidatingWebhook(),
         quota_profile=QuotaProfileController(),
         recommendation=RecommendationController(),
+        # gated like the reference's multi-quota-tree webhook registration
+        multi_tree_affinity=(MultiQuotaTreeAffinity()
+                             if SCHEDULER_GATES.enabled("MultiQuotaTree")
+                             else None),
     )
     return Assembled(name="koord-manager", args=args, component=component,
                      elector=build_elector(args, lease_store))

@@ -45,13 +45,14 @@ def select_overuse_victims(
     tentative victims go (the reference's "should evict all" branch).
     """
     cand = sched.valid & ~sched.non_preemptible & (sched.quota_id >= 0)
+    blocked = jnp.zeros(sched.capacity, bool)
     if pdb_allowed is not None:
         # exhausted disruption budgets exclude pods INSIDE the selection,
         # so a protected lowest-priority pod doesn't permanently block
         # revocation when an evictable alternative exists (note: per-PDB
         # budgets gate counts at commit; the kernel only masks zero-budget
         # pods, matching the preemption kernel's candidate masking)
-        blocked = (sched.pdb_id >= 0) & (
+        blocked = cand & (sched.pdb_id >= 0) & (
             pdb_allowed[jnp.maximum(sched.pdb_id, 0)] <= 0)
         cand = cand & ~blocked
     qid = jnp.maximum(sched.quota_id, 0)
@@ -69,8 +70,19 @@ def select_overuse_victims(
     u1, tent_asc = jax.lax.scan(phase1, used, asc)
     tentative = jnp.zeros(sched.capacity, bool).at[asc].set(tent_asc)
 
-    # quotas over even after removing every candidate: no reprieve at all
+    # quotas over even after removing every candidate ("hopeless"): with
+    # nothing PDB-blocked, every candidate goes (the reference's
+    # should-evict-all branch — the overshoot is from non-preemptible
+    # usage); with a blocked pod in the quota, eviction provably cannot
+    # cure the overuse, so SKIP the quota this cycle (it re-arms and
+    # retries once disruption budgets recover) instead of dumping pods
+    # to no effect
     hopeless = jnp.any((u1 > runtime) & checked, axis=-1)  # (Q,)
+    q_cap = used.shape[0]
+    has_blocked = (jnp.zeros(q_cap, bool)
+                   .at[jnp.where(blocked, qid, q_cap)].set(
+                       True, mode="drop"))
+    skip_quota = hopeless & has_blocked
 
     def phase2(u, j):
         q = qid[j]
@@ -80,7 +92,7 @@ def select_overuse_victims(
         # and must not veto a reprieve
         fits = jnp.all((u[q] + req <= runtime[q]) | (req == 0)
                        | ~checked[q])
-        back = tentative[j] & fits & ~hopeless[q]
+        back = tentative[j] & (fits | skip_quota[q])
         u = u.at[q].add(jnp.where(back, req, 0))
         return u, tentative[j] & ~back
 
@@ -100,7 +112,7 @@ class QuotaOveruseRevokeController:
     def __init__(
         self,
         scheduler,
-        revoke_fn=None,
+        revoke_fn,
         delay_evict_sec: float = 5.0,
         clock=time.monotonic,
     ):

@@ -328,13 +328,15 @@ class Scheduler:
                 self.remove_bound_pod(name)
                 self._charge_quota_used(bound, sign=-1)
 
-    def enable_overuse_revoke(self, revoke_fn=None,
+    def enable_overuse_revoke(self, revoke_fn,
                               delay_evict_sec: float = 5.0) -> None:
         """Turn on the elastic-quota overuse revoke loop
         (quota_overuse_revoke.go): each round, quotas whose used exceeds
         runtime continuously past the delay get their least-important pods
-        revoked until they fit.  ``revoke_fn(pod, quota)`` performs the
-        external eviction (the scheduler's own accounting releases here)."""
+        revoked until they fit.  ``revoke_fn(pod, quota)`` is REQUIRED —
+        it performs the external eviction; the scheduler's own accounting
+        releases here, and freeing capacity no one actually evicts would
+        oversubscribe the node."""
         from koordinator_tpu.quota.overuse_revoke import (
             QuotaOveruseRevokeController,
         )

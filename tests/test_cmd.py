@@ -92,6 +92,8 @@ def test_manager_assembly_and_gates():
         # recommendation ride along with the SLO controllers)
         assert out.component.quota_profile is not None
         assert out.component.recommendation is not None
+        # multi-tree affinity is gated (reference gates this webhook)
+        assert out.component.multi_tree_affinity is not None
     finally:
         SCHEDULER_GATES.set("MultiQuotaTree", before)
 

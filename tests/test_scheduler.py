@@ -986,3 +986,37 @@ def test_overuse_revoke_selects_around_pdb_protected_pod():
     assert revoked == ["a-mid"]
     assert "a-low" in sched.bound
     assert res.assignments.get("b-1") == "n1"
+
+
+def test_overuse_revoke_skips_uncurable_quota_with_blocked_pod():
+    """When the overshoot is pinned by a PDB-blocked pod (eviction cannot
+    cure the quota), no collateral eviction happens; the quota retries
+    once budgets recover."""
+    from koordinator_tpu.scheduler.scheduler import PdbRecord
+
+    t = [0.0]
+    total = resource_vector(cpu=16_000, memory=131_072).astype(np.int64)
+    tree = QuotaTree(total)
+    mx = np.full(R, UNBOUNDED, np.int64)
+    mx[CPU] = 16_000
+    for q in ("a", "b"):
+        tree.add(q, min=np.zeros(R, np.int64), max=mx)
+    sched, _ = mk_scheduler([node("n1", cpu=16_000)], quota_tree=tree,
+                            clock=lambda: t[0])
+    revoked = []
+    sched.enable_overuse_revoke(
+        revoke_fn=lambda p, q: revoked.append(p), delay_evict_sec=5.0)
+    sched.register_pdb(PdbRecord(name="protect-big",
+                                 selector={"tier": "big"}, allowed=0))
+    # the protected pod ALONE overshoots whatever runtime a will get;
+    # evicting the small pods cannot cure the quota
+    sched.enqueue(pod("a-big", cpu=12_000, quota="a", priority=3_000,
+                      labels={"tier": "big"}))
+    sched.enqueue(pod("a-small", cpu=2_000, quota="a", priority=6_000))
+    sched.schedule_round()
+    sched.enqueue(pod("b-1", cpu=8_000, quota="b", priority=9_000))
+    sched.schedule_round()
+    t[0] = 10.0
+    sched.schedule_round()
+    assert revoked == []                  # no pointless collateral eviction
+    assert {"a-big", "a-small"} <= set(sched.bound)
