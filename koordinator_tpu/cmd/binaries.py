@@ -193,6 +193,13 @@ def build_descheduler_parser() -> argparse.ArgumentParser:
     parser.add_argument("--evict-system-critical", action="store_true")
     parser.add_argument("--evict-local-storage-pods", action="store_true")
     parser.add_argument("--priority-threshold", type=int, default=None)
+    parser.add_argument(
+        "--deschedule-plugins", default="",
+        help="comma list of DESCHEDULE plugins for the default profile: "
+             "podlifetime,removefailedpods,removepodshavingtoomanyrestarts")
+    parser.add_argument("--pod-lifetime-max-seconds", type=float,
+                        default=7 * 24 * 3600.0)
+    parser.add_argument("--pod-restart-threshold", type=int, default=100)
     return parser
 
 
@@ -211,8 +218,32 @@ def main_koord_descheduler(argv: list[str], pods_fn=None,
         evict_local_storage=args.evict_local_storage_pods,
         priority_threshold=args.priority_threshold,
     )
+    # upstream-port plugin registry, selectable by name (the reference's
+    # profile pluginConfig; only self-contained plugins assemble from
+    # flags — nodes_fn-dependent ones need programmatic wiring)
+    from koordinator_tpu.descheduler.upstream import (
+        PodLifeTime,
+        RemoveFailedPods,
+        RemovePodsHavingTooManyRestarts,
+    )
+
+    available = {
+        "podlifetime": lambda: PodLifeTime(
+            max_seconds=args.pod_lifetime_max_seconds),
+        "removefailedpods": lambda: RemoveFailedPods(),
+        "removepodshavingtoomanyrestarts": lambda:
+            RemovePodsHavingTooManyRestarts(
+                pod_restart_threshold=args.pod_restart_threshold),
+    }
+    deschedule_plugins = []
+    for name in filter(None, args.deschedule_plugins.split(",")):
+        factory = available.get(name.strip().lower())
+        if factory is None:
+            raise SystemExit(f"unknown deschedule plugin: {name}")
+        deschedule_plugins.append(factory())
     profile = Profile(
         name="default",
+        deschedule_plugins=deschedule_plugins,
         evictor_filter=evictor_filter,
         evictor=Evictor(),
         max_evictions_per_round=args.max_evictions_per_round,

@@ -92,7 +92,10 @@ def select_overuse_victims(
         # and must not veto a reprieve
         fits = jnp.all((u[q] + req <= runtime[q]) | (req == 0)
                        | ~checked[q])
-        back = tentative[j] & (fits | skip_quota[q])
+        # hopeless quotas with nothing blocked keep the reference's
+        # should-evict-all: a pod requesting zero on the overshoot dim
+        # could otherwise "fit back" and dodge the branch
+        back = tentative[j] & ((fits & ~hopeless[q]) | skip_quota[q])
         u = u.at[q].add(jnp.where(back, req, 0))
         return u, tentative[j] & ~back
 

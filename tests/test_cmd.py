@@ -153,3 +153,25 @@ def test_device_daemon_requires_node_name():
         MAINS["koord-device-daemon"]([])
     out = MAINS["koord-device-daemon"](["--node-name", "n1"])
     assert out.component.node_name == "n1"
+
+
+def test_descheduler_assembles_upstream_plugins():
+    from koordinator_tpu.cmd.binaries import main_koord_descheduler
+    from koordinator_tpu.descheduler.framework import PodInfo
+
+    pods = [PodInfo(uid="old", name="old", namespace="d",
+                node="n1", phase="Failed")]
+    out = main_koord_descheduler([
+        "--deschedule-plugins", "removefailedpods,podlifetime",
+        "--disable-leader-election",
+    ], pods_fn=lambda: pods)
+    profile = out.component.profiles[0]
+    assert len(profile.deschedule_plugins) == 2
+    counts = out.component.run_once()
+    assert counts["default"] >= 1        # the failed pod was descheduled
+
+    import pytest
+
+    with pytest.raises(SystemExit):
+        main_koord_descheduler(
+            ["--deschedule-plugins", "nope", "--disable-leader-election"])
