@@ -218,32 +218,49 @@ def main_koord_descheduler(argv: list[str], pods_fn=None,
         evict_local_storage=args.evict_local_storage_pods,
         priority_threshold=args.priority_threshold,
     )
-    # upstream-port plugin registry, selectable by name (the reference's
-    # profile pluginConfig; only self-contained plugins assemble from
-    # flags — nodes_fn-dependent ones need programmatic wiring)
-    from koordinator_tpu.descheduler.upstream import (
-        PodLifeTime,
-        RemoveFailedPods,
-        RemovePodsHavingTooManyRestarts,
-    )
+    # upstream-port plugins selectable by name, derived from the single
+    # upstream.PLUGINS registry (the reference's profile pluginConfig).
+    # Plugins needing a nodes_fn can't assemble from flags alone and are
+    # excluded; per-plugin required kwargs come from the flag table.
+    from koordinator_tpu.descheduler import upstream
 
+    flag_kwargs = {
+        "PodLifeTime": lambda: {
+            "max_seconds": args.pod_lifetime_max_seconds},
+        "RemovePodsHavingTooManyRestarts": lambda: {
+            "pod_restart_threshold": args.pod_restart_threshold},
+    }
+    needs_nodes_fn = {
+        "RemovePodsViolatingNodeAffinity",
+        "RemovePodsViolatingNodeTaints",
+        "RemovePodsViolatingTopologySpreadConstraint",
+        "HighNodeUtilization",
+    }
     available = {
-        "podlifetime": lambda: PodLifeTime(
-            max_seconds=args.pod_lifetime_max_seconds),
-        "removefailedpods": lambda: RemoveFailedPods(),
-        "removepodshavingtoomanyrestarts": lambda:
-            RemovePodsHavingTooManyRestarts(
-                pod_restart_threshold=args.pod_restart_threshold),
+        name.lower(): (cls, flag_kwargs.get(name, dict))
+        for name, cls in upstream.PLUGINS.items()
+        if name not in needs_nodes_fn
     }
     deschedule_plugins = []
-    for name in filter(None, args.deschedule_plugins.split(",")):
-        factory = available.get(name.strip().lower())
-        if factory is None:
-            raise SystemExit(f"unknown deschedule plugin: {name}")
-        deschedule_plugins.append(factory())
+    balance_plugins = []
+    for raw in args.deschedule_plugins.split(","):
+        name = raw.strip().lower()
+        if not name:
+            continue
+        entry = available.get(name)
+        if entry is None:
+            raise SystemExit(f"unknown deschedule plugin: {raw.strip()}")
+        cls, kwargs = entry
+        plugin = cls(**kwargs())
+        # upstream ports come in both kinds; route by interface
+        if hasattr(plugin, "deschedule"):
+            deschedule_plugins.append(plugin)
+        else:
+            balance_plugins.append(plugin)
     profile = Profile(
         name="default",
         deschedule_plugins=deschedule_plugins,
+        balance_plugins=balance_plugins,
         evictor_filter=evictor_filter,
         evictor=Evictor(),
         max_evictions_per_round=args.max_evictions_per_round,

@@ -193,11 +193,17 @@ class _ProfileHandle:
         profile.evictor.profile = profile.name
         self._pods_fn = pods_fn
         self.evictions = 0
+        #: uids evicted this round — overlapping plugins (a Failed pod can
+        #: match RemoveFailedPods AND PodLifeTime) must not double-evict,
+        #: double-decrement PDB budgets, or double-count the round cap
+        self._evicted_uids: set[str] = set()
 
     def pods(self) -> list[PodInfo]:
         return self._pods_fn()
 
     def evict(self, pod: PodInfo, reason: str) -> bool:
+        if pod.uid in self._evicted_uids:
+            return False
         limit = self.profile.max_evictions_per_round
         if limit and self.evictions >= limit:
             return False
@@ -208,6 +214,7 @@ class _ProfileHandle:
             return False
         self.profile.evictor_filter.consume_budget(pod)
         self.evictions += 1
+        self._evicted_uids.add(pod.uid)
         return True
 
 

@@ -233,3 +233,44 @@ def test_quota_chain_parent_capped():
     a = np.asarray(a)
     assert a[0] >= 0   # higher priority child pod wins the parent headroom
     assert a[1] == -1
+
+
+class TestSolverProperties:
+    """Property-based invariants over random shapes (hypothesis)."""
+
+    def test_no_overcommit_valid_rows_deterministic(self):
+        from hypothesis import given, settings, strategies as st
+
+        @settings(max_examples=25, deadline=None)
+        @given(st.integers(2, 12), st.integers(1, 40),
+               st.integers(0, 2**32 - 1))
+        def prop(n_nodes, n_pods, seed):
+            rng = np.random.default_rng(seed)
+            state = mk_state(rng.integers(1_000, 20_000, n_nodes).tolist(),
+                             mem=int(rng.integers(1_024, 65_536)))
+            pods = mk_pods(rng.integers(100, 8_000, n_pods).tolist(),
+                           mem=int(rng.integers(64, 2_048)),
+                           priority=rng.integers(0, 10_000,
+                                                 n_pods).tolist())
+            a1, s1, _ = batch_assign(state, pods, cfg())
+            a1 = np.asarray(a1)
+            # 1. capacity never overcommitted
+            assert_no_overcommit(state, pods, a1)
+            # 2. assignments land on real rows; padding stays unassigned
+            assert ((a1 == -1) | ((a1 >= 0) & (a1 < n_nodes))).all()
+            assert (a1[n_pods:] == -1).all()
+            # 3. deterministic
+            a2, _, _ = batch_assign(state, pods, cfg())
+            np.testing.assert_array_equal(a1, np.asarray(a2))
+            # 4. accounting consistent: per-node requested delta equals the
+            # sum of its assigned pods' requests
+            delta = (np.asarray(s1.node_requested)
+                     - np.asarray(state.node_requested))
+            expect = np.zeros_like(delta)
+            req = np.asarray(pods.requests)
+            for i, nd in enumerate(a1):
+                if nd >= 0:
+                    expect[nd] += req[i]
+            np.testing.assert_array_equal(delta, expect)
+
+        prop()

@@ -162,11 +162,12 @@ def test_descheduler_assembles_upstream_plugins():
     pods = [PodInfo(uid="old", name="old", namespace="d",
                 node="n1", phase="Failed")]
     out = main_koord_descheduler([
-        "--deschedule-plugins", "removefailedpods,podlifetime",
+        "--deschedule-plugins", "removefailedpods, podlifetime ,removeduplicates",
         "--disable-leader-election",
     ], pods_fn=lambda: pods)
     profile = out.component.profiles[0]
     assert len(profile.deschedule_plugins) == 2
+    assert len(profile.balance_plugins) == 1
     counts = out.component.run_once()
     assert counts["default"] >= 1        # the failed pod was descheduled
 
