@@ -42,6 +42,8 @@ class Daemon:
         audit_dir: Optional[str] = None,
         clock=time.time,
         kill_handler: Optional[Callable] = None,
+        device_report_fn: Optional[Callable] = None,
+        device_report_interval_seconds: float = 60.0,
     ):
         self.cfg = cfg or get_config()
         self.clock = clock
@@ -93,6 +95,9 @@ class Daemon:
         )
         self._last_train = 0.0
         self.train_interval_seconds = 60.0
+        self.device_report_fn = device_report_fn
+        self.device_report_interval_seconds = device_report_interval_seconds
+        self._last_device_report = 0.0
         self.pleg = PLEG(self.cfg)
         self.pleg.add_handler(lambda event: self._on_pleg_event(event))
         self._pleg_dirty = False
@@ -130,6 +135,15 @@ class Daemon:
             self.predict_server.gc()
             self.predict_server.train_once()
             self._last_train = now
+        if (self.device_report_fn is not None
+                and now - self._last_device_report
+                >= self.device_report_interval_seconds):
+            # Device CR reporting (devices/gpu Infos() path): the shell
+            # pushes this to the apiserver / sync service
+            node = self.states.get_node()
+            self.device_report_fn(self.advisor.build_device(
+                node.name if node is not None else ""))
+            self._last_device_report = now
         return {
             "collected": collected,
             "strategies": strategies,

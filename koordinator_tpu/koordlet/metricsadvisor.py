@@ -679,3 +679,32 @@ class MetricsAdvisor:
                 # One garbled kernel file must not kill the whole tick.
                 continue
         return ran
+
+    def build_device(self, node_name: str):
+        """The koordlet-side Device CR (devices/gpu Infos() -> Device
+        reporting): aggregate every enabled device collector's inventory.
+        The standalone koord-device-daemon probes independently; this is
+        the in-agent path the reference's gpu collector uses."""
+        from koordinator_tpu.api import crds
+
+        infos = []
+        seen: set[tuple[str, int]] = set()
+        for collector in self.collectors:
+            if not hasattr(collector, "device_infos"):
+                continue
+            try:
+                if collector.enabled():
+                    for info in collector.device_infos():
+                        # two collectors can observe the same chip (sysfs
+                        # accel class AND a vendor's xpu JSON drop share
+                        # the Accelerators gate): first collector wins per
+                        # (type, minor), matching device_daemon prober
+                        # precedence
+                        key = (info.type, info.minor)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        infos.append(info)
+            except (OSError, ValueError):
+                continue
+        return crds.Device(node_name=node_name, devices=tuple(infos))

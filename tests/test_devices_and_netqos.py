@@ -59,17 +59,21 @@ def run_hook(hook, p):
     return ctx.response
 
 
+def fake_accel_device(cfg, name="accel0", **fields):
+    root = os.path.join(cfg.sys_root, "class", "accel", name)
+    os.makedirs(root, exist_ok=True)
+    defaults = dict(uuid=f"GPU-{name}", minor="0", type="gpu",
+                    usage_pct="37.5", mem_used="1024", mem_total="8192",
+                    numa_node="1", busid="0000:3b:00.0", health="1")
+    defaults.update(fields)
+    for fn, val in defaults.items():
+        with open(os.path.join(root, fn), "w") as f:
+            f.write(str(val))
+
+
 class TestAcceleratorCollector:
     def _fake_device(self, cfg, name="accel0", **fields):
-        root = os.path.join(cfg.sys_root, "class", "accel", name)
-        os.makedirs(root, exist_ok=True)
-        defaults = dict(uuid=f"GPU-{name}", minor="0", type="gpu",
-                        usage_pct="37.5", mem_used="1024", mem_total="8192",
-                        numa_node="1", busid="0000:3b:00.0", health="1")
-        defaults.update(fields)
-        for fn, val in defaults.items():
-            with open(os.path.join(root, fn), "w") as f:
-                f.write(str(val))
+        fake_accel_device(cfg, name, **fields)
 
     def test_samples_and_device_infos(self, cfg):
         self._fake_device(cfg, "accel0", minor="0")
@@ -325,3 +329,71 @@ class TestResctrlReconcile:
             assert not os.path.isdir(updater.fs.group_dir("koord-pod-ghost"))
         finally:
             RUNTIMEHOOK_GATES.set("Resctrl", False)
+
+
+class TestKoordletDeviceReporting:
+    def test_advisor_builds_device_cr(self, cfg):
+        from koordinator_tpu.koordlet import metricsadvisor as ma
+        from koordinator_tpu.koordlet.metriccache import MetricCache
+        from koordinator_tpu.koordlet.statesinformer import StatesInformer
+
+        # fake one accelerator + one rdma device on the node fs
+        fake_accel_device(cfg, "accel0", uuid="GPU-0", mem_total="81920",
+                          mem_used="0", usage_pct="0", numa_node="0")
+        ib = os.path.join(cfg.sys_root, "class", "infiniband", "mlx5_0")
+        os.makedirs(ib, exist_ok=True)
+
+        advisor = ma.MetricsAdvisor(StatesInformer(), MetricCache(), cfg)
+        KOORDLET_GATES.set("Accelerators", True)
+        KOORDLET_GATES.set("RDMADevices", True)
+        try:
+            device = advisor.build_device("n0")
+        finally:
+            KOORDLET_GATES.set("Accelerators", False)
+            KOORDLET_GATES.set("RDMADevices", False)
+        types = sorted(d.type for d in device.devices)
+        assert types == ["gpu", "rdma"]
+        assert device.node_name == "n0"
+        # feeds the scheduler inventory bridge end to end
+        from koordinator_tpu.koordlet.devices import (
+            device_infos_to_inventory,
+        )
+
+        inv = device_infos_to_inventory(list(device.devices))
+        assert inv["gpu"][0]["memory"] == 81920
+
+    def test_daemon_ticks_device_report_with_dedup(self, cfg):
+        from koordinator_tpu.koordlet.daemon import Daemon
+
+        fake_accel_device(cfg, "accel0", type="xpu", uuid="XPU-0",
+                          minor="0")
+        # vendor JSON drop claims the SAME (type, minor): first wins
+        root = os.path.join(cfg.var_run_root, "xpu-device-infos")
+        os.makedirs(root, exist_ok=True)
+        with open(os.path.join(root, "dev0.json"), "w") as f:
+            json.dump({"uuid": "XPU-DUPE", "minor": 0}, f)
+
+        os.makedirs(cfg.proc_root, exist_ok=True)
+        with open(cfg.proc_path("stat"), "w") as f:
+            f.write("cpu  0 0 0 0 0 0 0 0 0 0\n")
+        with open(cfg.proc_path("meminfo"), "w") as f:
+            f.write("MemTotal: 1024 kB\nMemAvailable: 512 kB\nCached: 0\n")
+
+        reports = []
+        t = [1000.0]
+        daemon = Daemon(cfg=cfg, clock=lambda: t[0],
+                        device_report_fn=reports.append,
+                        device_report_interval_seconds=60.0)
+        KOORDLET_GATES.set("Accelerators", True)
+        try:
+            daemon.tick()
+            assert len(reports) == 1
+            xpus = [d for d in reports[0].devices if d.type == "xpu"]
+            assert [d.uuid for d in xpus] == ["XPU-0"]  # dedup: sysfs wins
+            daemon.tick()                 # within the interval: no re-report
+            assert len(reports) == 1
+            t[0] += 61.0
+            daemon.tick()
+            assert len(reports) == 2
+        finally:
+            KOORDLET_GATES.set("Accelerators", False)
