@@ -53,7 +53,10 @@ def build_koordlet_parser() -> argparse.ArgumentParser:
     return parser
 
 
-def main_koordlet(argv: list[str]) -> Assembled:
+def main_koordlet(argv: list[str], device_report_fn=None) -> Assembled:
+    """``device_report_fn(Device)`` is the deployment shell's Device-CR
+    sink (apiserver client / StateSyncService.upsert_node devices=...);
+    None disables the in-agent reporting tick."""
     from koordinator_tpu.features import KOORDLET_GATES
     from koordinator_tpu.koordlet.daemon import Daemon
     from koordinator_tpu.koordlet.system.config import SystemConfig
@@ -67,7 +70,8 @@ def main_koordlet(argv: list[str]) -> Assembled:
         use_cgroup_v2=args.cgroup_v2,
         cgroup_driver_systemd=args.cgroup_driver_systemd,
     )
-    daemon = Daemon(cfg=cfg, audit_dir=args.audit_log_dir or None)
+    daemon = Daemon(cfg=cfg, audit_dir=args.audit_log_dir or None,
+                    device_report_fn=device_report_fn)
     return Assembled(name="koordlet", args=args, component=daemon)
 
 

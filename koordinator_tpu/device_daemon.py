@@ -111,11 +111,19 @@ class DeviceDaemon:
         ]
 
     def collect(self) -> crds.Device:
-        """One reporting pass: merge all probers into the Device CR."""
+        """One reporting pass: merge all probers into the Device CR.
+        First prober wins per (type, minor) — probers normally read
+        disjoint roots, but a double-observed chip must not duplicate."""
         devices: list[crds.DeviceInfo] = []
+        seen: set[tuple[str, int]] = set()
         for prober in self.probers:
             try:
-                devices.extend(prober.probe())
+                for info in prober.probe():
+                    key = (info.type, info.minor)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    devices.append(info)
             except OSError:
                 continue
         import json

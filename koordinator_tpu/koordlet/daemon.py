@@ -139,11 +139,15 @@ class Daemon:
                 and now - self._last_device_report
                 >= self.device_report_interval_seconds):
             # Device CR reporting (devices/gpu Infos() path): the shell
-            # pushes this to the apiserver / sync service
+            # pushes this to the apiserver / sync service.  Until the
+            # informer knows the node, hold off WITHOUT stamping the
+            # timer — the first valid report must not wait a full extra
+            # interval behind an anonymous one.
             node = self.states.get_node()
-            self.device_report_fn(self.advisor.build_device(
-                node.name if node is not None else ""))
-            self._last_device_report = now
+            if node is not None:
+                self.device_report_fn(
+                    self.advisor.build_device(node.name))
+                self._last_device_report = now
         return {
             "collected": collected,
             "strategies": strategies,

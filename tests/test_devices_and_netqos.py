@@ -379,6 +379,8 @@ class TestKoordletDeviceReporting:
         with open(cfg.proc_path("meminfo"), "w") as f:
             f.write("MemTotal: 1024 kB\nMemAvailable: 512 kB\nCached: 0\n")
 
+        from koordinator_tpu.koordlet.statesinformer import NodeInfo
+
         reports = []
         t = [1000.0]
         daemon = Daemon(cfg=cfg, clock=lambda: t[0],
@@ -386,7 +388,10 @@ class TestKoordletDeviceReporting:
                         device_report_interval_seconds=60.0)
         KOORDLET_GATES.set("Accelerators", True)
         try:
-            daemon.tick()
+            daemon.tick()            # node unknown yet: no anonymous report
+            assert reports == []
+            daemon.states.set_node(NodeInfo(name="n0", allocatable={}))
+            daemon.tick()            # ...and no extra-interval penalty
             assert len(reports) == 1
             xpus = [d for d in reports[0].devices if d.type == "xpu"]
             assert [d.uuid for d in xpus] == ["XPU-0"]  # dedup: sysfs wins

@@ -523,3 +523,83 @@ def test_fine_grained_registries_ride_node_sync(rpc):
     result = solve_remote(client)
     assert result["assignments"]["gpu-1"] == "n1"
     assert sched.resource_status["gpu-1"]["device-allocated"]["gpu"]
+
+
+def test_koordlet_device_report_feeds_scheduler_over_wire(rpc, tmp_path):
+    """The full device loop: koordlet daemon reports the Device CR, the
+    shell converts it to inventory on NODE_UPSERT, the wire-synced
+    scheduler allocates real minors to a GPU pod."""
+    import os
+
+    from koordinator_tpu.features import KOORDLET_GATES
+    from koordinator_tpu.koordlet.daemon import Daemon
+    from koordinator_tpu.koordlet.devices import device_infos_to_inventory
+    from koordinator_tpu.koordlet.system.config import (
+        test_config as make_test_config,
+    )
+    from koordinator_tpu.scheduler.cpu_manager import CPUManager
+    from koordinator_tpu.scheduler.device_manager import DeviceManager
+
+    cfg = make_test_config(tmp_path)
+    for i in range(2):
+        root = os.path.join(cfg.sys_root, "class", "accel", f"accel{i}")
+        os.makedirs(root, exist_ok=True)
+        for fn, val in (("uuid", f"GPU-{i}"), ("minor", str(i)),
+                        ("mem_total", "81920"), ("mem_used", "0"),
+                        ("usage_pct", "0"), ("numa_node", "0"),
+                        ("health", "1"), ("type", "gpu")):
+            with open(os.path.join(root, fn), "w") as f:
+                f.write(val)
+    os.makedirs(cfg.proc_root, exist_ok=True)
+    with open(cfg.proc_path("stat"), "w") as f:
+        f.write("cpu  0 0 0 0 0 0 0 0 0 0\n")
+    with open(cfg.proc_path("meminfo"), "w") as f:
+        f.write("MemTotal: 1024 kB\nMemAvailable: 512 kB\nCached: 0\n")
+
+    server, clients = rpc
+    service = StateSyncService()
+    service.attach(server)
+    server.start()
+
+    # the shell's device_report_fn: Device CR -> inventory -> NODE_UPSERT
+    def on_device_report(device):
+        service.upsert_node(
+            "n0",
+            resource_vector({"cpu": 16_000, "memory": 65_536,
+                             "kubernetes.io/gpu": 200,
+                             "kubernetes.io/gpu-memory": 81_920 * 2}),
+            devices=device_infos_to_inventory(list(device.devices)))
+
+    daemon = Daemon(cfg=cfg, clock=lambda: 1000.0,
+                    device_report_fn=on_device_report)
+    from koordinator_tpu.koordlet.statesinformer import NodeInfo
+
+    daemon.states.set_node(NodeInfo(name="n0", allocatable={}))
+
+    snap = ClusterSnapshot(capacity=16)
+    scoring = ScoringConfig.default().replace(
+        usage_thresholds=jnp.zeros(R, jnp.int32),
+        estimator_defaults=jnp.zeros(R, jnp.int32))
+    sched = Scheduler(snap, config=scoring, cpu_manager=CPUManager(),
+                      device_manager=DeviceManager())
+    SolveService(sched).attach(server)
+    sync = StateSyncClient(SchedulerBinding(sched))
+    client = connect(server, clients, on_push=sync.on_push)
+    sync.bootstrap(client)
+
+    KOORDLET_GATES.set("Accelerators", True)
+    try:
+        daemon.tick()          # reports the Device CR through the shell
+    finally:
+        KOORDLET_GATES.set("Accelerators", False)
+    wait_until(lambda: sync.rv == service.rv)
+
+    service.add_pod("gpu-1", resource_vector(
+        {"cpu": 1_000, "memory": 512, "kubernetes.io/gpu": 200,
+         "kubernetes.io/gpu-memory": 16_384}))
+    wait_until(lambda: sync.rv == service.rv)
+    result = solve_remote(client)
+    assert result["assignments"]["gpu-1"] == "n0"
+    minors = [g["minor"] for g in
+              sched.resource_status["gpu-1"]["device-allocated"]["gpu"]]
+    assert sorted(minors) == [0, 1]   # both probed GPUs allocated
