@@ -105,11 +105,12 @@ def _bench_quota(rtt: float) -> dict:
 
     from koordinator_tpu.ops.batch_assign import batch_assign
 
-    per, _ = _time_assign(
+    per, count = _time_assign(
         state,
         lambda st: batch_assign(st, qpods, cfg, quota=quota)[:2],
         rtt)
-    return {"quota_solve_pods_per_sec_5000p_1024n_64q": round(5_000 / per, 1)}
+    return {"quota_solve_pods_per_sec_5000p_1024n_64q": round(5_000 / per, 1),
+            "quota_solve_assigned_per_round": round(count, 1)}
 
 
 def _bench_gang(rtt: float) -> dict:
@@ -123,13 +124,14 @@ def _bench_gang(rtt: float) -> dict:
     gpods = pods.replace(gang_id=jnp.asarray(
         rng.integers(-1, 256, pods.capacity), jnp.int32))
 
-    per, _ = _time_assign(
+    per, count = _time_assign(
         state,
         lambda st: gang_assign(st, gpods, cfg, gangs, passes=2,
                                solver="batch")[:2],
         rtt)
     return {"gang_solve_pods_per_sec_10000p_1024n_256g_batch": round(
-        10_000 / per, 1)}
+        10_000 / per, 1),
+            "gang_solve_assigned_per_round": round(count, 1)}
 
 
 def _bench_lownodeload(rtt: float) -> dict:
