@@ -112,6 +112,7 @@ def main_koord_scheduler(argv: list[str],
     args = build_scheduler_parser().parse_args(argv)
     apply_feature_gates(args.feature_gates, SCHEDULER_GATES)
     snapshot = ClusterSnapshot(capacity=args.node_capacity)
+    elector = build_elector(args, lease_store)
     scheduler = Scheduler(
         snapshot,
         gang_passes=args.gang_passes,
@@ -121,8 +122,8 @@ def main_koord_scheduler(argv: list[str],
         auditor=WorkloadAuditor(),
         cpu_manager=CPUManager(),
         device_manager=DeviceManager(),
+        elector=elector,
     )
-    elector = build_elector(args, lease_store)
     server = None
     if args.listen_socket:
         from koordinator_tpu.transport import RpcServer

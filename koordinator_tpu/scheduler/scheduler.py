@@ -133,6 +133,7 @@ class Scheduler:
         auditor=None,
         cpu_manager=None,
         device_manager=None,
+        elector=None,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -194,6 +195,9 @@ class Scheduler:
         self.resource_status: dict[str, dict] = {}
         #: quota overuse revoke controller (enable_overuse_revoke)
         self.overuse_revoke = None
+        #: ha.LeaderElector — rounds no-op while not leading (the reference
+        #: leader-elects the whole scheduling loop, server.go)
+        self.elector = elector
         #: bound on pods routed through the sequential reservation pre-pass
         #: per round — a popular owner selector must not drag a 50k-pod
         #: round onto the O(P) exact scan (extras solve normally and can
@@ -734,6 +738,12 @@ class Scheduler:
         # set at round START — before any early return, including the
         # barrier gate, so a backlog building behind the barrier is visible
         metrics.pending_pods.set(float(len(self.pending)))
+        if self.elector is not None and not self.elector.tick():
+            # standby replica: keep syncing state, decide nothing — and
+            # surface the standby (empty) result on the debug API instead
+            # of a stale leader-era diagnosis
+            self.last_result = SchedulingResult({}, {}, 0)
+            return self.last_result
         if self.barrier is not None and not self.barrier.check():
             # stale cache after restart: refuse to decide until the informer
             # replays past the barrier (sync_barrier.go semantics)
