@@ -161,6 +161,7 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
     from koordinator_tpu.manager.recommendation import (
         RecommendationController,
     )
+    from koordinator_tpu.manager.quota_webhook import QuotaTopologyValidator
     from koordinator_tpu.manager.webhook import (
         MultiQuotaTreeAffinity,
         PodMutatingWebhook,
@@ -175,6 +176,12 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
         noderesource=NodeResourceController(),
         pod_mutating=PodMutatingWebhook(),
         pod_validating=PodValidatingWebhook(),
+        quota_validating=QuotaTopologyValidator(
+            enable_update_resource_key=SCHEDULER_GATES.enabled(
+                "ElasticQuotaEnableUpdateResourceKey"),
+            guarantee_usage=SCHEDULER_GATES.enabled(
+                "ElasticQuotaGuaranteeUsage"),
+        ),
         quota_profile=QuotaProfileController(),
         recommendation=RecommendationController(),
         # gated like the reference's multi-quota-tree webhook registration
@@ -187,6 +194,25 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
 
 
 # ---- koord-descheduler -----------------------------------------------------
+
+#: upstream ports that can't assemble from flags alone (they need a nodes_fn)
+_NEEDS_NODES_FN = {
+    "RemovePodsViolatingNodeAffinity",
+    "RemovePodsViolatingNodeTaints",
+    "RemovePodsViolatingTopologySpreadConstraint",
+    "HighNodeUtilization",
+}
+
+
+def _flag_selectable_descheduler_plugins() -> list[str]:
+    """Lower-cased names accepted by --deschedule-plugins, derived from the
+    upstream.PLUGINS registry so the help text can never drift from what the
+    selector below actually accepts (unknown names are a hard SystemExit)."""
+    from koordinator_tpu.descheduler import upstream
+
+    return [name.lower() for name in upstream.PLUGINS
+            if name not in _NEEDS_NODES_FN]
+
 
 def build_descheduler_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="koord-descheduler")
@@ -201,7 +227,7 @@ def build_descheduler_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--deschedule-plugins", default="",
         help="comma list of DESCHEDULE plugins for the default profile: "
-             "podlifetime,removefailedpods,removepodshavingtoomanyrestarts")
+             + ",".join(sorted(_flag_selectable_descheduler_plugins())))
     parser.add_argument("--pod-lifetime-max-seconds", type=float,
                         default=7 * 24 * 3600.0)
     parser.add_argument("--pod-restart-threshold", type=int, default=100)
@@ -235,16 +261,10 @@ def main_koord_descheduler(argv: list[str], pods_fn=None,
         "RemovePodsHavingTooManyRestarts": lambda: {
             "pod_restart_threshold": args.pod_restart_threshold},
     }
-    needs_nodes_fn = {
-        "RemovePodsViolatingNodeAffinity",
-        "RemovePodsViolatingNodeTaints",
-        "RemovePodsViolatingTopologySpreadConstraint",
-        "HighNodeUtilization",
-    }
     available = {
         name.lower(): (cls, flag_kwargs.get(name, dict))
         for name, cls in upstream.PLUGINS.items()
-        if name not in needs_nodes_fn
+        if name not in _NEEDS_NODES_FN
     }
     deschedule_plugins = []
     balance_plugins = []

@@ -92,6 +92,7 @@ RUNTIMEHOOK_GATES = FeatureGates({
 SCHEDULER_GATES = FeatureGates({
     "MultiQuotaTree": False,
     "ElasticQuotaGuaranteeUsage": False,
+    "ElasticQuotaEnableUpdateResourceKey": False,
     "ResizePod": False,
     "LazyReservationRestore": False,
     "DevicePluginAdaption": False,

@@ -272,7 +272,10 @@ def device_infos_to_inventory(
         rows = out.setdefault(info.type, [])
         while len(rows) <= info.minor:
             rows.append({"core": 0, "memory": 0, "group": 0})
-        core = int(info.resources.get(f"{info.type}-core", 100))
+        # absent data must not create allocatable capacity: deviceshare
+        # derives capacity only from reported resources, so a missing
+        # {type}-core defaults to 0 (like memory), not full-capacity
+        core = int(info.resources.get(f"{info.type}-core", 0))
         memory = int(info.resources.get(f"{info.type}-memory", 0))
         rows[info.minor] = {
             "core": core if info.health else 0,

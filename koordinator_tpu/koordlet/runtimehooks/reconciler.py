@@ -54,10 +54,14 @@ class Reconciler:
                 ctx = ContainerContext.from_container(pod, container, self.cfg)
                 self.registry.run(Stage.PRE_CREATE_CONTAINER, ctx)
                 writes += ctx.apply(self.executor)
-        if self.resctrl_updater is not None:
+        if self.resctrl_updater is not None and getattr(
+                self.states, "pods_synced", True):
             # RemovePodResctrlResources: enumerate on-disk koord-pod-*
             # groups (not an in-memory set — it would leak groups of pods
-            # that left while the agent was down) and drop the dead ones
+            # that left while the agent was down) and drop the dead ones.
+            # Gated on the informer having synced once: a transiently-empty
+            # pod list (first tick after restart) must not strip every
+            # running pod's L3/MB isolation.
             root = self.resctrl_updater.fs.root
             try:
                 existing = [d for d in os.listdir(root)

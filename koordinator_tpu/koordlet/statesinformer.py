@@ -80,6 +80,7 @@ class StatesInformer:
         self._lock = threading.Lock()
         self._node: Optional[NodeInfo] = None
         self._pods: dict[str, PodMeta] = {}
+        self._pods_synced = False
         self._node_slo: Optional[object] = None
         self._device: Optional[object] = None
         self._callbacks: dict[str, list[Callable]] = {}
@@ -108,6 +109,7 @@ class StatesInformer:
     def set_pods(self, pods: list[PodMeta]) -> None:
         with self._lock:
             self._pods = {p.uid: p for p in pods}
+            self._pods_synced = True
         self._fire(TYPE_ALL_PODS, pods)
 
     def set_node_slo(self, node_slo) -> None:
@@ -129,6 +131,14 @@ class StatesInformer:
     def get_all_pods(self) -> list[PodMeta]:
         with self._lock:
             return list(self._pods.values())
+
+    @property
+    def pods_synced(self) -> bool:
+        """True once the pod informer has delivered at least one (possibly
+        empty) pod list — destructive GC sweeps must wait for this, or the
+        first tick after an agent restart treats every running pod as dead."""
+        with self._lock:
+            return self._pods_synced
 
     def get_pod(self, uid: str) -> Optional[PodMeta]:
         with self._lock:

@@ -1,0 +1,370 @@
+"""ElasticQuota-CR admission webhook: topology validation + default filling.
+
+The reference validates quota *objects* at admission so a malformed tree
+never reaches the runtime calculators
+(`pkg/webhook/elasticquota/quota_topology.go:62` ``ValidAddQuota``, ``:103``
+``ValidUpdateQuota``, ``:159`` ``ValidDeleteQuota``;
+``quota_topology_check.go:39`` self-item checks, ``:92`` topology checks;
+``plugin_check_quota_meta_validate.go`` wires them into the webhook).  This
+module is the same admission gate for the repo: the scheduler's
+``quota/tree.py`` may assume every CR it sees has passed here.
+
+The validator keeps its own lightweight topology mirror (name -> quota,
+parent -> children) — the webhook is an admission-time authority, fed by
+the same informer stream as the manager, not a view of the runtime tree.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Mapping, Optional
+
+from koordinator_tpu.api import crds
+
+#: reserved quota groups (extension.RootQuotaName / SystemQuotaName /
+#: DefaultQuotaName): never deletable, root/system never modifiable
+ROOT_QUOTA = "root"
+SYSTEM_QUOTA = "koordinator-system-quota"
+DEFAULT_QUOTA = "koordinator-default-quota"
+
+
+def _neg_dims(rl: Mapping[str, int]) -> list[str]:
+    return sorted(k for k, v in rl.items() if v < 0)
+
+
+def _le_completely(a: Mapping[str, int], b: Mapping[str, int]) -> bool:
+    """util.LessThanOrEqualCompletely: every dim of a <= b (missing b dim
+    counts as 0)."""
+    return all(v <= b.get(k, 0) for k, v in a.items())
+
+
+def _add(a: Mapping[str, int], b: Mapping[str, int]) -> dict[str, int]:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+class QuotaTopologyValidator:
+    """Admission-time ElasticQuota validation + mutation (default filling).
+
+    ``validate_add`` / ``validate_update`` / ``validate_delete`` return a
+    list of error strings — empty means admit.  ``fill_defaults`` is the
+    mutating side (`quota_topology.go:216 fillQuotaDefaultInformation`):
+    parent defaults to root, tree id inherits from the parent, shared
+    weight defaults to max.
+    """
+
+    def __init__(
+        self,
+        enable_update_resource_key: bool = False,
+        guarantee_usage: bool = False,
+        has_pods_fn: Optional[Callable[[str], bool]] = None,
+    ) -> None:
+        self.quotas: dict[str, crds.ElasticQuota] = {}
+        self.children: dict[str, set[str]] = {ROOT_QUOTA: set()}
+        #: AnnotationQuotaNamespaces binding: namespace -> quota name
+        self.namespace_to_quota: dict[str, str] = {}
+        #: per-quota Status.Used (fed by the quota controller) for the
+        #: max >= used strict check
+        self.used: dict[str, dict[str, int]] = {}
+        self.enable_update_resource_key = enable_update_resource_key
+        self.guarantee_usage = guarantee_usage
+        #: answers "does any pod reference this quota" (the reference lists
+        #: pods by the quota label); None = assume no pods
+        self.has_pods_fn = has_pods_fn
+
+    # -- feed ---------------------------------------------------------------
+
+    def set_used(self, name: str, used: Mapping[str, int]) -> None:
+        self.used[name] = dict(used)
+
+    def _has_pods(self, name: str) -> bool:
+        return bool(self.has_pods_fn and self.has_pods_fn(name))
+
+    # -- mutating side ------------------------------------------------------
+
+    def fill_defaults(
+        self, quota: crds.ElasticQuota,
+        namespaces: Iterable[str] = (),
+    ) -> crds.ElasticQuota:
+        """Default-fill parent / tree id / shared weight.  Raises ValueError
+        when the declared parent is missing (fill needs its tree id)."""
+        if quota.name == ROOT_QUOTA:
+            return quota
+        parent = quota.parent or ROOT_QUOTA
+        tree_id = quota.tree_id
+        if not tree_id and parent != ROOT_QUOTA:
+            pinfo = self.quotas.get(parent)
+            if pinfo is None:
+                raise ValueError(
+                    f"fill quota {quota.name} failed, parent not exist")
+            tree_id = pinfo.tree_id
+        shared = quota.shared_weight or dict(quota.max)
+        return crds.ElasticQuota(
+            name=quota.name, namespace=quota.namespace, parent=parent,
+            min=quota.min, max=quota.max, shared_weight=shared,
+            is_parent=quota.is_parent,
+            allow_lent_resource=quota.allow_lent_resource,
+            guarantee_usage=quota.guarantee_usage, tree_id=tree_id,
+            labels=quota.labels,
+        )
+
+    # -- validating side ----------------------------------------------------
+
+    def validate_add(
+        self, quota: crds.ElasticQuota,
+        namespaces: Iterable[str] = (),
+    ) -> list[str]:
+        errors: list[str] = []
+        if quota.name in self.quotas:
+            return [f"quota already exists: {quota.name}"]
+        for ns in namespaces:
+            owner = self.namespace_to_quota.get(ns)
+            if owner is not None:
+                errors.append(
+                    f"namespace {ns} is already bound to quota {owner}")
+        errors += self._self_item(quota)
+        errors += self._topology(None, quota)
+        if errors:
+            return errors
+        self._apply(quota, namespaces)
+        return []
+
+    def validate_update(
+        self, new: crds.ElasticQuota,
+        namespaces: Iterable[str] = (),
+    ) -> list[str]:
+        old = self.quotas.get(new.name)
+        if old == new:
+            return []
+        # IsForbiddenModify (extension/elastic_quota.go:105): system/root
+        # quota groups are immutable
+        if new.name in (SYSTEM_QUOTA, ROOT_QUOTA):
+            return [f"invalid quota {new.name}"]
+        if old is None:
+            return [f"quota not found: {new.name}"]
+        errors: list[str] = []
+        for ns in namespaces:
+            owner = self.namespace_to_quota.get(ns)
+            if owner is not None and owner != new.name:
+                errors.append(
+                    f"namespace {ns} is already bound to quota {owner}")
+        errors += self._self_item(new)
+        errors += self._topology(old, new)
+        if errors:
+            return errors
+        self._unapply(old)
+        self._apply(new, namespaces)
+        return []
+
+    def validate_delete(self, name: str) -> list[str]:
+        if name in (SYSTEM_QUOTA, ROOT_QUOTA, DEFAULT_QUOTA):
+            return [f"can not delete quota group: {name}"]
+        quota = self.quotas.get(name)
+        if quota is None:
+            return [f"quota not found: {name}"]
+        kids = self.children.get(name, set())
+        if kids:
+            return [f"delete quota failed, quota {name} has "
+                    f"{len(kids)} child quotas"]
+        if self._has_pods(name):
+            return [f"delete quota failed, quota {name} has bound pods"]
+        self._unapply(quota)
+        self.children.pop(name, None)
+        self.used.pop(name, None)
+        return []
+
+    # -- checks (quota_topology_check.go) -----------------------------------
+
+    def _self_item(self, q: crds.ElasticQuota) -> list[str]:
+        """validateQuotaSelfItem (:39): non-negative min/max/sharedWeight,
+        min keys included in max, min <= max, max >= used."""
+        errors = []
+        for field, rl in (("max", q.max), ("min", q.min),
+                          ("sharedWeight", q.shared_weight)):
+            neg = _neg_dims(rl)
+            if neg:
+                errors.append(
+                    f"{q.name} quota {field} < 0 in dimensions {neg}")
+        for key, val in q.min.items():
+            if key not in q.max:
+                errors.append(
+                    f"resourceKey {key} of quota {q.name} is in min "
+                    f"but not in max")
+            elif q.max[key] < val:
+                errors.append(
+                    f"resourceKey {key} of quota {q.name} min {val} > "
+                    f"max {q.max[key]}")
+        # strict max >= used on every used dim (the reference scopes this
+        # to AnnotationMaxStrictCheckResourceKeys; used is fed by set_used)
+        for key, used_val in self.used.get(q.name, {}).items():
+            if key in q.max and q.max[key] < used_val:
+                errors.append(
+                    f"resourceKey {key} of quota {q.name} max "
+                    f"{q.max[key]} < used {used_val}")
+        return errors
+
+    def _topology(
+        self, old: Optional[crds.ElasticQuota], new: crds.ElasticQuota,
+    ) -> list[str]:
+        """validateQuotaTopology (:92): parent-change rules, tree ids,
+        parent existence, key consistency, min sums, guarantee."""
+        if new.name == ROOT_QUOTA:
+            return []
+        errors = []
+        errors += self._is_parent_change(old, new)
+        errors += self._tree_id(old, new)
+        if errors:
+            return errors
+        # leaf directly under root skips the structural checks (:107)
+        if new.parent == ROOT_QUOTA and not new.is_parent:
+            return []
+        errors += self._parent_info(new)
+        if errors:
+            return errors
+        errors += self._key_consistency(new)
+        errors += self._min_sums(old, new)
+        if self.guarantee_usage:
+            errors += self._guarantee(new)
+        return errors
+
+    def _is_parent_change(self, old, new) -> list[str]:
+        """checkIsParentChange (:162): with children, isParent cannot go
+        false; with bound pods, isParent cannot go true."""
+        if old is None or old.is_parent == new.is_parent:
+            return []
+        if self.children.get(old.name) and not new.is_parent:
+            return [f"quota {old.name} has children, isParent cannot "
+                    f"become false"]
+        if new.is_parent and self._has_pods(old.name):
+            return [f"quota {old.name} has bound pods, isParent cannot "
+                    f"become true"]
+        return []
+
+    def _tree_id(self, old, new) -> list[str]:
+        """checkTreeID (:131): immutable, and consistent with parent and
+        children."""
+        errors = []
+        if old is not None and old.tree_id != new.tree_id:
+            errors.append(f"{new.name} tree id changed "
+                          f"[{old.tree_id}] vs [{new.tree_id}]")
+        if new.parent != ROOT_QUOTA:
+            pinfo = self.quotas.get(new.parent)
+            if pinfo is not None and new.tree_id != pinfo.tree_id:
+                errors.append(
+                    f"{new.name} tree id differs from parent "
+                    f"{new.parent}: [{new.tree_id}] vs [{pinfo.tree_id}]")
+        for child in self.children.get(new.name, ()):  # update case
+            cinfo = self.quotas.get(child)
+            if cinfo is not None and cinfo.tree_id != new.tree_id:
+                errors.append(
+                    f"{new.name} tree id differs from child {child}: "
+                    f"[{new.tree_id}] vs [{cinfo.tree_id}]")
+        return errors
+
+    def _parent_info(self, new) -> list[str]:
+        """checkParentQuotaInfo (:186): parent exists and isParent."""
+        if new.parent == ROOT_QUOTA:
+            return []
+        pinfo = self.quotas.get(new.parent)
+        if pinfo is None:
+            return [f"{new.name} has parent {new.parent} which does "
+                    f"not exist"]
+        if not pinfo.is_parent:
+            return [f"{new.name} has parent {new.parent} whose isParent "
+                    f"is false"]
+        return []
+
+    def _key_consistency(self, new) -> list[str]:
+        """checkSubAndParentGroupQuotaKey (:205): max keys same as the
+        parent's (or included, with ElasticQuotaEnableUpdateResourceKey);
+        min keys always included in the parent's."""
+        errors = []
+
+        def included(parent_rl, child_rl):
+            return all(k in parent_rl for k in child_rl)
+
+        def check_pair(parent_name, parent_rl_max, parent_rl_min,
+                       child_name, child_rl_max, child_rl_min):
+            if self.enable_update_resource_key:
+                if not included(parent_rl_max, child_rl_max):
+                    errors.append(
+                        f"{child_name}'s max keys are not all included "
+                        f"in {parent_name}'s")
+            else:
+                if set(parent_rl_max) != set(child_rl_max):
+                    errors.append(
+                        f"{child_name}'s max keys are not the same as "
+                        f"{parent_name}'s")
+            if not included(parent_rl_min, child_rl_min):
+                errors.append(
+                    f"{child_name}'s min keys are not all included in "
+                    f"{parent_name}'s")
+
+        if new.parent != ROOT_QUOTA:
+            pinfo = self.quotas[new.parent]
+            check_pair(new.parent, pinfo.max, pinfo.min,
+                       new.name, new.max, new.min)
+        for child in self.children.get(new.name, ()):
+            cinfo = self.quotas.get(child)
+            if cinfo is not None:
+                check_pair(new.name, new.max, new.min,
+                           child, cinfo.max, cinfo.min)
+        return errors
+
+    def _min_sums(self, old, new) -> list[str]:
+        """checkMinQuotaValidate (:265): siblings' min sum <= parent min;
+        children's min sum <= the quota's own min."""
+        errors = []
+        if new.parent != ROOT_QUOTA:
+            sibling_sum: dict[str, int] = {}
+            for sib in self.children.get(new.parent, ()):
+                if sib == new.name:
+                    continue
+                sinfo = self.quotas.get(sib)
+                if sinfo is not None:
+                    sibling_sum = _add(sibling_sum, sinfo.min)
+            total = _add(sibling_sum, new.min)
+            if not _le_completely(total, self.quotas[new.parent].min):
+                errors.append(
+                    f"all siblings' min > parent min, parent: "
+                    f"{new.parent}")
+        child_sum: dict[str, int] = {}
+        for child in self.children.get(new.name, ()):
+            cinfo = self.quotas.get(child)
+            if cinfo is not None:
+                child_sum = _add(child_sum, cinfo.min)
+        if child_sum and not _le_completely(child_sum, new.min):
+            errors.append(
+                f"all children's min > quota min, quota: {new.name}")
+        return errors
+
+    def _guarantee(self, new) -> list[str]:
+        """checkGuaranteedForMin (ElasticQuotaGuaranteeUsage): shrinking
+        min below the quota's current used breaks the guarantee."""
+        used = self.used.get(new.name)
+        if not used:
+            return []
+        bad = sorted(k for k, v in new.min.items() if used.get(k, 0) > v)
+        if bad and new.guarantee_usage:
+            return [f"min < guaranteed used in dimensions {bad} "
+                    f"for {new.name}"]
+        return []
+
+    # -- topology bookkeeping ----------------------------------------------
+
+    def _apply(self, quota: crds.ElasticQuota,
+               namespaces: Iterable[str]) -> None:
+        self.quotas[quota.name] = quota
+        self.children.setdefault(quota.name, set())
+        self.children.setdefault(quota.parent, set()).add(quota.name)
+        for ns in namespaces:
+            self.namespace_to_quota[ns] = quota.name
+
+    def _unapply(self, quota: crds.ElasticQuota) -> None:
+        self.quotas.pop(quota.name, None)
+        self.children.get(quota.parent, set()).discard(quota.name)
+        stale = [ns for ns, q in self.namespace_to_quota.items()
+                 if q == quota.name]
+        for ns in stale:
+            del self.namespace_to_quota[ns]

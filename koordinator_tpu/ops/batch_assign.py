@@ -161,6 +161,18 @@ class _RoundCarry:
     quota: QuotaDeviceState | None
 
 
+#: candidate-selection strategies for ``select_candidates``:
+#: - "exact":  XLA score + exact ``lax.top_k`` on the int ranking key
+#: - "approx": XLA score + ``lax.approx_max_k`` on a 24-bit float key
+#:             (~0.95 recall on TPU; the CPU lowering is exact, but the
+#:             float-key quantization is exercised on every backend)
+#: - "fused":  Pallas streaming kernel (ops/pallas_score.py) — no (P, N)
+#:             HBM materialization; interpret mode off-TPU so the branch is
+#:             runnable (and testable) everywhere
+#: - "auto":   "approx" on TPU, "exact" elsewhere
+CANDIDATE_METHODS = ("auto", "exact", "approx", "fused")
+
+
 def batch_assign(
     state: ClusterState,
     pods: PodBatch,
@@ -170,6 +182,7 @@ def batch_assign(
     rounds: int = 12,
     fused_topk: bool = False,
     spread_bits: int = 5,
+    method: str = "auto",
 ):
     """Assign a pending batch in data-parallel propose/accept rounds.
 
@@ -183,16 +196,15 @@ def batch_assign(
     100% of a schedulable queue assigned vs 22% at spread_bits=0, with mean
     chosen-node score matching the exact sequential greedy.
 
-    ``fused_topk=True`` computes the candidate stage with the Pallas
-    streaming kernel (ops/pallas_score.py — no (P, N) HBM materialization);
-    bit-exact with the exact-top_k path, factored batches only (dense
-    batches raise). Off-TPU the flag falls back to the XLA path — interpret
-    mode exists for parity tests (fused_score_topk(interpret=True)), not
-    for serving.
+    ``method`` picks the candidate-selection strategy (CANDIDATE_METHODS);
+    every method is force-selectable on every backend so CI can cover the
+    TPU-serving branches on CPU.  Candidate recall is approximate for
+    "approx"/"fused"; acceptance always enforces fit and quota exactly.
+    ``fused_topk=True`` is the legacy alias for ``method="fused"``.
     """
     cand_key, cand_node = select_candidates(
         state, pods, cfg, k=k, fused_topk=fused_topk,
-        spread_bits=spread_bits)
+        spread_bits=spread_bits, method=method)
     return _assign_rounds(state, pods, quota, cand_key, cand_node, rounds)
 
 
@@ -203,34 +215,43 @@ def select_candidates(
     k: int = 32,
     fused_topk: bool = False,
     spread_bits: int = 5,
+    method: str = "auto",
 ):
     """(cand_key, cand_node), each (P, k): the candidate-selection stage of
     ``batch_assign``, exposed separately so profiling can time it apart
-    from the propose/accept rounds."""
+    from the propose/accept rounds.  See CANDIDATE_METHODS."""
+    if method not in CANDIDATE_METHODS:
+        raise ValueError(f"unknown candidate method {method!r}; "
+                         f"one of {CANDIDATE_METHODS}")
     if fused_topk:
+        method = "fused"
+    if method == "auto":
+        method = "approx" if jax.default_backend() == "tpu" else "exact"
+    if method == "fused":
         if pods.selector_mask is None:
-            raise ValueError("fused_topk needs a factored batch "
-                             "(selector_mask); dense/hinted batches use "
-                             "the XLA path")
-        if jax.default_backend() == "tpu":
-            from koordinator_tpu.ops.pallas_score import fused_score_topk
+            raise ValueError("fused candidate selection needs a factored "
+                             "batch (selector_mask); dense/hinted batches "
+                             "use the XLA path")
+        from koordinator_tpu.ops.pallas_score import fused_score_topk
 
-            k = min(k, state.capacity)
-            return fused_score_topk(
-                state, pods, cfg, k=k, spread_bits=spread_bits)
+        return fused_score_topk(
+            state, pods, cfg, k=min(k, state.capacity),
+            spread_bits=spread_bits,
+            interpret=jax.default_backend() != "tpu")
     scores, feasible = score_pods(state, pods, cfg)
     key = _ranked_scores(scores, feasible, spread_bits)
     k = min(k, key.shape[1])
-    if jax.default_backend() == "tpu" and k < key.shape[1]:
+    if method == "approx" and k < key.shape[1]:
         # TPU-optimized partial reduction. approx_max_k needs a float key
         # exact within float32's 24-bit mantissa, so candidates are chosen
         # by the quantized score plus as many HIGH bits of the rotated
         # tie-break as fit (high bits keep the closest-after-rotation
         # ordering that fans pods out; low bits would scramble it); the
         # exact int keys are then gathered for in-round ordering.
-        # Candidate RECALL is approximate (~recall_target); acceptance
-        # still enforces fit and quota exactly. CPU keeps exact top_k so
-        # tests stay deterministic.
+        # Candidate RECALL is approximate (~recall_target on TPU; the CPU
+        # lowering of approx_max_k is exact, so CPU recall loss comes only
+        # from the float-key quantization).  Acceptance still enforces fit
+        # and quota exactly.
         score_bits = (30 - _TB_BITS) - spread_bits   # quantized field width
         shift = min(_TB_BITS, 24 - score_bits)
         fkey = jnp.where(

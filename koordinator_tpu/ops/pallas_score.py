@@ -1,17 +1,35 @@
 """Fused Filter+Score+top-k as a Pallas TPU kernel.
 
-``batch_assign`` currently runs three XLA stages: ``score_pods`` (which
-materializes the (P, N) int32 score tensor to HBM — 2 GB at the north-star
-shape), ``_ranked_scores`` (another (P, N)), and ``lax.top_k``.  This kernel
-streams instead: each program owns a tile of pods, walks the node axis in
-VMEM-sized chunks, computes the ranked key for the chunk in registers, and
-folds it into a running per-pod top-k — the (P, N) intermediates never
-touch HBM, only the (P, k) winners do.
+``batch_assign``'s XLA candidate stage runs three passes: ``score_pods``
+(which materializes the (P, N) int32 score tensor to HBM — 2 GB at the
+north-star shape), ``_ranked_scores`` (another (P, N)), and the top-k
+reduction (a third full-width read).  This kernel streams instead: the grid
+is (pod tiles × node chunks); each step scores one (TP, NC) tile, computes
+the ranked key in registers, and folds it into a per-pod **bucket array**
+of running maxima — the (P, N) intermediates never touch HBM, only the
+(P, L) bucket winners do (L = ``n_bucket``, 2048 by default at scale vs
+N = 10240).  The final per-pod top-k over the small (P, L) output runs in
+plain XLA outside the kernel.
 
-Semantics are IDENTICAL to ``lax.top_k(_ranked_scores(*score_pods(...)), k)``
-(same scorer formulas, same integer floor-division trick, same rotated
-tie-break, same lowest-index-wins tie order) and are asserted bit-exact
-against that reference in tests/test_pallas_score.py via interpret mode.
+Bucketing: chunk column c of chunk j folds into bucket (j*NC + c) mod L,
+i.e. node n lands in bucket n mod L.  Per-pod ranking keys are UNIQUE
+(the rotated tie-break is a permutation of node indices), so:
+
+- when L >= N every node owns its bucket and the result is bit-exact with
+  ``lax.top_k(_ranked_scores(*score_pods(...)), k)`` — asserted in
+  tests/test_pallas_score.py via interpret mode;
+- when L < N two nodes L apart can collide and candidate RECALL becomes
+  approximate — but the rotated tie-break ranks a pod's equal-scored
+  candidates by *consecutive* node index, and consecutive indices occupy
+  distinct buckets, so the spread that matters for the solve survives.
+  Acceptance downstream enforces fit and quota exactly either way (same
+  contract as the ``approx_max_k`` path).
+
+The fold itself is elementwise (2 selects per chunk), so the Mosaic body
+stays tiny — the previous design's per-chunk k-pass extract-max unroll
+(20 chunks x k passes at the north-star shape) made TPU compiles unusable.
+Output blocks are revisited across the chunk axis of the grid (the Pallas
+accumulator pattern); the first visit initializes the buckets to -1.
 
 Layouts are transposed (R leading) so pods/nodes ride the 128-lane axis;
 R (=10) unrolls as python loops.  The selector-class feasibility gather
@@ -44,19 +62,20 @@ def _floordiv(num, den, den_pos):
     return jnp.where(den_pos, exact_floordiv(jnp.maximum(num, 0), safe), 0)
 
 
-def _score_topk_kernel(
+def _score_bucket_kernel(
     # pod tile refs (blocked over P)
     podreq_ref,      # (R, TP) int32
     podest_ref,      # (R, TP) int32
     podvalid_ref,    # (1, TP) int32
     sel_ref,         # (TP, C) int32 0/1
-    # full node refs
-    alloc_ref,       # (R, N) int32
-    reqd_ref,        # (R, N) int32
-    usage_ref,       # (R, N) int32
-    agg_ref,         # (R, N) int32
-    nvalid_ref,      # (1, N) int32
-    nclass_ref,      # (1, N) int32
+    # node chunk refs — the node axis is viewed as (S, L) with
+    # n = s*L + l (bucket l = n mod L), blocked (.., 1, NC) at (s, b)
+    alloc_ref,       # (R, 1, NC) int32
+    reqd_ref,        # (R, 1, NC) int32
+    usage_ref,       # (R, 1, NC) int32
+    agg_ref,         # (R, 1, NC) int32
+    nvalid_ref,      # (1, 1, NC) int32
+    nclass_ref,      # (1, 1, NC) int32
     # cfg refs
     la_w_ref,        # (1, R) int32 loadaware weights
     fp_w_ref,        # (1, R) int32 fitplus weights
@@ -66,18 +85,22 @@ def _score_topk_kernel(
     agg_thr_ref,     # (1, R) int32 aggregated thresholds
     scalars_ref,     # (1, 4) int32: [dominant_w, la_plugin_w, fp_plugin_w,
                      #               scarce_plugin_w]
-    # outputs
-    out_val_ref,     # (TP, K) int32
-    out_idx_ref,     # (TP, K) int32
+    # outputs — bucket accumulators; the s grid axis is innermost, so all
+    # revisits of one output block are consecutive (Pallas accumulation)
+    out_val_ref,     # (TP, NC) int32 block of the (TP, L) bucket maxima
+    out_idx_ref,     # (TP, NC) int32 block of the winning node indices
     *,
     n_chunk: int,
-    k: int,
     r_dims: int,
     spread_bits: int,
 ):
     tp = podreq_ref.shape[1]
-    n = alloc_ref.shape[1]
     tile = pl.program_id(0)
+    b = pl.program_id(1)        # bucket block
+    s = pl.program_id(2)        # sub-step within the bucket block
+    l_total = pl.num_programs(1) * n_chunk
+    n = pl.num_programs(2) * l_total
+    c0 = s * l_total + b * n_chunk   # global index of this block's node 0
 
     dom_w = scalars_ref[0, 0]
     la_pw = scalars_ref[0, 1]
@@ -100,142 +123,115 @@ def _score_topk_kernel(
     pod_ids = tile * tp + jax.lax.broadcasted_iota(jnp.int32, (tp, 1), 0)
     rot = pod_ids * 7919                                  # (TP, 1)
 
-    run_val = jnp.full((tp, k), -1, jnp.int32)
-    # sentinel indices are UNIQUE negatives: the extract-max fold removes
-    # exactly one column per pass (equal (val, idx) pairs would be wiped
-    # together, collapsing the pool into -2s); sanitized to 0 on output
-    run_idx = -1 - jax.lax.broadcasted_iota(jnp.int32, (tp, k), 1)
+    nvalid = nvalid_ref[0, 0, :] > 0                      # (NC,)
 
-    # the node walk is a fori_loop, not a python unroll: at the north-star
-    # shape (20 chunks x k extract-max passes x R dims) unrolling blew the
-    # TPU compile up beyond usability
-    def chunk_body(ci, carry):
-        run_val, run_idx = carry
-        c0 = ci * n_chunk
-        cols = pl.ds(c0, n_chunk)
-        nvalid = nvalid_ref[0, cols] > 0                  # (NC,)
+    la_num = jnp.zeros((tp, n_chunk), jnp.int32)
+    dominant = jnp.full((tp, n_chunk), MAX_NODE_SCORE, jnp.int32)
+    fp_num = jnp.zeros((tp, n_chunk), jnp.int32)
+    n_diff = jnp.zeros((tp, n_chunk), jnp.int32)
+    n_inter = jnp.zeros((tp, n_chunk), jnp.int32)
+    fits = jnp.ones((tp, n_chunk), bool)
+    inst_exceeded = jnp.zeros((tp, n_chunk), bool)
+    agg_exceeded = jnp.zeros((tp, n_chunk), bool)
 
-        la_num = jnp.zeros((tp, n_chunk), jnp.int32)
-        dominant = jnp.full((tp, n_chunk), MAX_NODE_SCORE, jnp.int32)
-        fp_num = jnp.zeros((tp, n_chunk), jnp.int32)
-        n_diff = jnp.zeros((tp, n_chunk), jnp.int32)
-        n_inter = jnp.zeros((tp, n_chunk), jnp.int32)
-        fits = jnp.ones((tp, n_chunk), bool)
-        inst_exceeded = jnp.zeros((tp, n_chunk), bool)
-        agg_exceeded = jnp.zeros((tp, n_chunk), bool)
+    for r in range(r_dims):
+        alloc = alloc_ref[r, 0, :][None, :]               # (1, NC)
+        reqd = reqd_ref[r, 0, :][None, :]
+        usage = usage_ref[r, 0, :][None, :]
+        agg = agg_ref[r, 0, :][None, :]
+        podreq = podreq_ref[r, :][:, None]                # (TP, 1)
+        podest = podest_ref[r, :][:, None]
+        alloc_pos = alloc > 0
 
-        for r in range(r_dims):
-            alloc = alloc_ref[r, cols][None, :]           # (1, NC)
-            reqd = reqd_ref[r, cols][None, :]
-            usage = usage_ref[r, cols][None, :]
-            agg = agg_ref[r, cols][None, :]
-            podreq = podreq_ref[r, :][:, None]            # (TP, 1)
-            podest = podest_ref[r, :][:, None]
-            alloc_pos = alloc > 0
+        # -- loadaware (load_aware.go:347) ---------------------------
+        used = usage + podest                             # (TP, NC)
+        ls_ok = alloc_pos & (used <= alloc)
+        ls = jnp.where(
+            ls_ok,
+            _floordiv((alloc - used) * MAX_NODE_SCORE, alloc, alloc_pos),
+            0)
+        la_num = la_num + ls * la_w_ref[0, r]
+        configured = la_w_ref[0, r] > 0
+        dominant = jnp.where(
+            configured, jnp.minimum(dominant, ls), dominant)
 
-            # -- loadaware (load_aware.go:347) ---------------------------
-            used = usage + podest                         # (TP, NC)
-            ls_ok = alloc_pos & (used <= alloc)
-            ls = jnp.where(
-                ls_ok,
-                _floordiv((alloc - used) * MAX_NODE_SCORE, alloc, alloc_pos),
-                0)
-            la_num = la_num + ls * la_w_ref[0, r]
-            configured = la_w_ref[0, r] > 0
-            dominant = jnp.where(
-                configured, jnp.minimum(dominant, ls), dominant)
+        # -- fitplus (node_resource_fit_plus_utils.go:58) ------------
+        combined = reqd + podreq
+        least = jnp.where(
+            alloc_pos & (combined <= alloc),
+            _floordiv((alloc - combined) * MAX_NODE_SCORE, alloc,
+                      alloc_pos),
+            0)
+        most = _floordiv(jnp.minimum(combined, alloc) * MAX_NODE_SCORE,
+                         alloc, alloc_pos)
+        per_res = jnp.where(fp_most_ref[0, r] > 0, most, least)
+        w_eff = jnp.where(podreq > 0, fp_w_ref[0, r], 0)   # (TP, 1)
+        fp_num = fp_num + per_res * w_eff
 
-            # -- fitplus (node_resource_fit_plus_utils.go:58) ------------
-            combined = reqd + podreq
-            least = jnp.where(
-                alloc_pos & (combined <= alloc),
-                _floordiv((alloc - combined) * MAX_NODE_SCORE, alloc,
-                          alloc_pos),
-                0)
-            most = _floordiv(jnp.minimum(combined, alloc) * MAX_NODE_SCORE,
-                             alloc, alloc_pos)
-            per_res = jnp.where(fp_most_ref[0, r] > 0, most, least)
-            w_eff = jnp.where(podreq > 0, fp_w_ref[0, r], 0)   # (TP, 1)
-            fp_num = fp_num + per_res * w_eff
+        # -- scarce (scarce_resource_avoidance.go:89) ----------------
+        diff = alloc_pos & (podreq == 0)
+        n_diff = n_diff + diff
+        n_inter = n_inter + (diff & (scarce_ref[0, r] > 0))
 
-            # -- scarce (scarce_resource_avoidance.go:89) ----------------
-            diff = alloc_pos & (podreq == 0)
-            n_diff = n_diff + diff
-            n_inter = n_inter + (diff & (scarce_ref[0, r] > 0))
+        # -- fit filter ----------------------------------------------
+        free = jnp.where(nvalid[None, :], alloc - reqd, 0)
+        fits = fits & ((podreq <= free) | (podreq == 0))
 
-            # -- fit filter ----------------------------------------------
-            free = jnp.where(nvalid[None, :], alloc - reqd, 0)
-            fits = fits & ((podreq <= free) | (podreq == 0))
+        # -- usage thresholds (load_aware.go:326 round-half-up) ------
+        a_inst = MAX_SCALE * used + alloc // 2
+        inst_exceeded = inst_exceeded | (
+            (thr_ref[0, r] > 0) & alloc_pos
+            & (a_inst >= (thr_ref[0, r] + 1) * alloc))
+        a_agg = MAX_SCALE * (agg + podest) + alloc // 2
+        agg_exceeded = agg_exceeded | (
+            (agg_thr_ref[0, r] > 0) & alloc_pos
+            & (a_agg >= (agg_thr_ref[0, r] + 1) * alloc))
 
-            # -- usage thresholds (load_aware.go:326 round-half-up) ------
-            a_inst = MAX_SCALE * used + alloc // 2
-            inst_exceeded = inst_exceeded | (
-                (thr_ref[0, r] > 0) & alloc_pos
-                & (a_inst >= (thr_ref[0, r] + 1) * alloc))
-            a_agg = MAX_SCALE * (agg + podest) + alloc // 2
-            agg_exceeded = agg_exceeded | (
-                (agg_thr_ref[0, r] > 0) & alloc_pos
-                & (a_agg >= (agg_thr_ref[0, r] + 1) * alloc))
+    la = _floordiv(la_num + dominant * dom_w, la_den, la_den > 0)
+    fp = jnp.where(
+        fp_den[:, None] > 0,
+        _floordiv(fp_num, fp_den[:, None], fp_den[:, None] > 0),
+        MAX_NODE_SCORE)
+    sc = jnp.where(
+        (n_diff == 0) | (n_inter == 0),
+        MAX_NODE_SCORE,
+        _floordiv((n_diff - n_inter) * MAX_NODE_SCORE, n_diff,
+                  n_diff > 0))
+    scores = la * la_pw + fp * fp_pw + sc * sc_pw
 
-        la = _floordiv(la_num + dominant * dom_w, la_den, la_den > 0)
-        fp = jnp.where(
-            fp_den[:, None] > 0,
-            _floordiv(fp_num, fp_den[:, None], fp_den[:, None] > 0),
-            MAX_NODE_SCORE)
-        sc = jnp.where(
-            (n_diff == 0) | (n_inter == 0),
-            MAX_NODE_SCORE,
-            _floordiv((n_diff - n_inter) * MAX_NODE_SCORE, n_diff,
-                      n_diff > 0))
-        scores = la * la_pw + fp * fp_pw + sc * sc_pw
+    # selector-class feasibility: sel (TP, C) x one-hot(class) (C, NC)
+    cls = nclass_ref[0, 0, :]                             # (NC,)
+    in_range = cls < c_cap
+    cls_safe = jnp.minimum(cls, c_cap - 1)
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, (c_cap, n_chunk), 0)
+              == cls_safe[None, :]).astype(jnp.float32)
+    sel_ok = (jax.lax.dot_general(
+        sel, onehot, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) > 0.5)        # (TP, NC)
+    sel_ok = sel_ok & in_range[None, :]
 
-        # selector-class feasibility: sel (TP, C) x one-hot(class) (C, NC)
-        cls = nclass_ref[0, cols]                         # (NC,)
-        in_range = cls < c_cap
-        cls_safe = jnp.minimum(cls, c_cap - 1)
-        onehot = (jax.lax.broadcasted_iota(jnp.int32, (c_cap, n_chunk), 0)
-                  == cls_safe[None, :]).astype(jnp.float32)
-        sel_ok = (jax.lax.dot_general(
-            sel, onehot, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) > 0.5)    # (TP, NC)
-        sel_ok = sel_ok & in_range[None, :]
+    thr_ok = jnp.where(agg_enabled, ~agg_exceeded, ~inst_exceeded)
+    feasible = (fits & thr_ok & sel_ok & nvalid[None, :]
+                & pod_valid[:, None])
 
-        thr_ok = jnp.where(agg_enabled, ~agg_exceeded, ~inst_exceeded)
-        feasible = (fits & thr_ok & sel_ok & nvalid[None, :]
-                    & pod_valid[:, None])
+    # ranked key (_ranked_scores): score high bits | rotated tie-break
+    node_idx = c0 + jax.lax.broadcasted_iota(
+        jnp.int32, (tp, n_chunk), 1)                      # (TP, NC)
+    tb = (n - 1) - ((node_idx - rot) % n)
+    q = jnp.clip(scores, 0, _SCORE_CLIP) >> spread_bits
+    key = (q << _TB_BITS) | tb
+    key = jnp.where(feasible, key, -1)
 
-        # ranked key (_ranked_scores): score high bits | rotated tie-break
-        node_idx = c0 + jax.lax.broadcasted_iota(
-            jnp.int32, (tp, n_chunk), 1)                  # (TP, NC)
-        tb = (n - 1) - ((node_idx - rot) % n)
-        q = jnp.clip(scores, 0, _SCORE_CLIP) >> spread_bits
-        key = (q << _TB_BITS) | tb
-        key = jnp.where(feasible, key, -1)
-
-        # fold the chunk into the running top-k: k extract-max passes over
-        # the (TP, K + NC) concat; ties resolve to the lowest node index,
-        # matching lax.top_k
-        cat_val = jnp.concatenate([run_val, key], axis=1)
-        cat_idx = jnp.concatenate([run_idx, node_idx], axis=1)
-        new_val = []
-        new_idx = []
-        for _ in range(k):
-            m = jnp.max(cat_val, axis=1)                  # (TP,)
-            is_m = cat_val == m[:, None]
-            # lowest node index among maxima (for -1 sentinels index is
-            # irrelevant)
-            pick_idx = jnp.min(
-                jnp.where(is_m, cat_idx, 1 << 30), axis=1)
-            new_val.append(m)
-            new_idx.append(pick_idx)   # may be a negative sentinel
-            taken = is_m & (cat_idx == pick_idx[:, None])
-            cat_val = jnp.where(taken, -2, cat_val)
-        return jnp.stack(new_val, axis=1), jnp.stack(new_idx, axis=1)
-
-    run_val, run_idx = jax.lax.fori_loop(
-        0, n // n_chunk, chunk_body, (run_val, run_idx))
-    out_val_ref[:, :] = run_val
-    out_idx_ref[:, :] = jnp.where(run_val < 0, 0, run_idx)
+    # bucket fold: strictly-greater keeps the earlier (lower-index) node —
+    # keys are unique per pod, so ties never actually occur and the result
+    # is bit-exact with lax.top_k whenever L >= N.  s == 0 is the first
+    # visit to this output block and initializes the accumulator.
+    first = s == 0
+    cur_val = jnp.where(first, -1, out_val_ref[:, :])
+    cur_idx = jnp.where(first, 0, out_idx_ref[:, :])
+    taken = key > cur_val
+    out_val_ref[:, :] = jnp.maximum(key, cur_val)
+    out_idx_ref[:, :] = jnp.where(taken, node_idx, cur_idx)
 
 
 def fused_score_topk(
@@ -245,12 +241,19 @@ def fused_score_topk(
     k: int = 32,
     tile_pods: int = 128,
     n_chunk: int = 512,
+    n_bucket: int | None = None,
     interpret: bool = False,
     spread_bits: int = 0,
 ):
-    """(cand_key, cand_node) — bit-exact equivalent of
+    """(cand_key, cand_node) — streaming equivalent of
     ``lax.top_k(_ranked_scores(*score_pods(state, pods, cfg)), k)`` without
-    the (P, N) HBM round-trips.  Factored (selector_mask) batches only."""
+    the (P, N) HBM round-trips.  Factored (selector_mask) batches only.
+
+    ``n_bucket`` (L) sizes the per-pod bucket accumulator: bit-exact when
+    L >= N, approximate-recall when L < N (see module docstring).  The
+    default clamps ``4 * n_chunk`` to [k-coverage, N] — exact for every
+    test-sized problem, 2048 buckets at the 10,240-node north star.
+    """
     from koordinator_tpu.ops import scoring
 
     if pods.selector_mask is None:
@@ -264,6 +267,17 @@ def fused_score_topk(
     nc = min(n_chunk, n)
     if n % nc:
         raise ValueError(f"node capacity {n} must tile by {nc}")
+    if n_bucket is None:
+        n_bucket = 4 * nc
+    # L must cover k, tile by the chunk width, and divide N (the node axis
+    # is viewed as (N//L, L)).  Take the smallest chunk-multiple divisor of
+    # N at or above the request — worst case L = N, which is the exact case.
+    m = n // nc
+    d_target = max(1, min(m, -(-max(n_bucket, k) // nc)))
+    d = next(dd for dd in range(d_target, m + 1) if m % dd == 0)
+    n_bucket = d * nc
+    k = min(k, n_bucket)
+
     # pad the pod axis up to a tile multiple: padded rows are invalid
     # (pod_valid=0 => key -1 everywhere) and sliced off the outputs
     p_pad = -(-p // tp) * tp
@@ -286,47 +300,57 @@ def fused_score_topk(
         jnp.asarray(cfg.scarce_plugin_weight, jnp.int32),
     ])[None, :]
 
-    grid = (p_pad // tp,)
-    pod_spec = pl.BlockSpec((r, tp), lambda i: (0, i),
+    # the node axis is viewed as (S, L): n = s*L + l, bucket = n mod L.
+    # Grid order (tile, bucket-block, s) keeps all revisits of one output
+    # block consecutive — required for Pallas output accumulation on TPU.
+    n_sub = n // n_bucket
+    grid = (p_pad // tp, n_bucket // nc, n_sub)
+    pod_spec = pl.BlockSpec((r, tp), lambda i, b, s: (0, i),
                             memory_space=pltpu.VMEM)
-    row_spec = pl.BlockSpec((1, tp), lambda i: (0, i),
+    row_spec = pl.BlockSpec((1, tp), lambda i, b, s: (0, i),
                             memory_space=pltpu.VMEM)
     sel_spec = pl.BlockSpec((tp, sel_mask.shape[1]),
-                            lambda i: (i, 0), memory_space=pltpu.VMEM)
-    full = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0),
-                                      memory_space=pltpu.VMEM)
+                            lambda i, b, s: (i, 0),
+                            memory_space=pltpu.VMEM)
+    node_spec = pl.BlockSpec((r, 1, nc), lambda i, b, s: (0, s, b),
+                             memory_space=pltpu.VMEM)
+    nrow_spec = pl.BlockSpec((1, 1, nc), lambda i, b, s: (0, s, b),
+                             memory_space=pltpu.VMEM)
+    cfg_spec = lambda shape: pl.BlockSpec(shape, lambda i, b, s: (0, 0),
+                                          memory_space=pltpu.VMEM)
+    out_spec = pl.BlockSpec((tp, nc), lambda i, b, s: (i, b),
+                            memory_space=pltpu.VMEM)
+
+    node3 = lambda a: a.T.reshape(r, n_sub, n_bucket)
+    nrow3 = lambda a: a.reshape(1, n_sub, n_bucket)
 
     kernel = functools.partial(
-        _score_topk_kernel, n_chunk=nc, k=k, r_dims=r,
+        _score_bucket_kernel, n_chunk=nc, r_dims=r,
         spread_bits=spread_bits)
-    out_val, out_idx = pl.pallas_call(
+    buck_val, buck_idx = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pod_spec, pod_spec, row_spec, sel_spec,
-            full((r, n)), full((r, n)), full((r, n)), full((r, n)),
-            full((1, n)), full((1, n)),
-            full((1, r)), full((1, r)), full((1, r)), full((1, r)),
-            full((1, r)), full((1, r)), full((1, 4)),
+            node_spec, node_spec, node_spec, node_spec,
+            nrow_spec, nrow_spec,
+            cfg_spec((1, r)), cfg_spec((1, r)), cfg_spec((1, r)),
+            cfg_spec((1, r)), cfg_spec((1, r)), cfg_spec((1, r)),
+            cfg_spec((1, 4)),
         ],
-        out_specs=[
-            pl.BlockSpec((tp, k), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tp, k), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        out_specs=[out_spec, out_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((p_pad, k), jnp.int32),
-            jax.ShapeDtypeStruct((p_pad, k), jnp.int32),
+            jax.ShapeDtypeStruct((p_pad, n_bucket), jnp.int32),
+            jax.ShapeDtypeStruct((p_pad, n_bucket), jnp.int32),
         ],
         interpret=interpret,
     )(
         pod_req.T, pod_est.T, pod_valid[None, :].astype(jnp.int32),
         sel_mask.astype(jnp.int32),
-        state.node_allocatable.T, state.node_requested.T,
-        state.node_usage.T, state.node_agg_usage.T,
-        state.node_valid[None, :].astype(jnp.int32),
-        state.node_class[None, :],
+        node3(state.node_allocatable), node3(state.node_requested),
+        node3(state.node_usage), node3(state.node_agg_usage),
+        nrow3(state.node_valid.astype(jnp.int32)),
+        nrow3(state.node_class),
         cfg.loadaware_resource_weights[None, :],
         cfg.fitplus_resource_weights[None, :],
         cfg.fitplus_most_allocated[None, :].astype(jnp.int32),
@@ -335,4 +359,10 @@ def fused_score_topk(
         cfg.agg_usage_thresholds[None, :],
         scalars,
     )
-    return out_val[:p], out_idx[:p]
+    # final per-pod top-k over the small (P, L) bucket arrays in plain XLA.
+    # Bucket maxima carry unique keys (or -1), and bucket order under
+    # lax.top_k ties only matters for -1 fills, whose idx is sanitized to 0.
+    cand_key, pos = jax.lax.top_k(buck_val[:p], k)
+    cand_node = jnp.take_along_axis(buck_idx[:p], pos, axis=1)
+    cand_node = jnp.where(cand_key < 0, 0, cand_node)
+    return cand_key, cand_node

@@ -38,10 +38,16 @@ import numpy as np
 from flax import struct
 
 from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+from koordinator_tpu.quota.admission import HEADROOM_CLAMP
 from koordinator_tpu.state.cluster_state import ClusterState
 
 #: sentinel priority placed below any real koordinator priority band
 NEG_PRI = jnp.int32(-(2**31) + 1)
+
+#: fully-open quota headroom for preemptors without a quota inside
+#: ``preempt_chain`` (same clamp bound the admission path uses, so the
+#: +freed arithmetic in select_victims cannot overflow int32)
+HEADROOM_OPEN = HEADROOM_CLAMP
 
 
 @struct.dataclass
@@ -148,7 +154,7 @@ class VictimSolve:
     num_victims: jax.Array    # (N,) int32
     num_violating: jax.Array  # (N,) int32
     max_victim_pri: jax.Array # (N,) int32 (NEG_PRI when none)
-    sum_victim_pri: jax.Array # (N,) int64
+    sum_victim_pri: jax.Array # (N,) int32 (band priorities — see solve)
 
 
 def select_victims(
@@ -169,7 +175,9 @@ def select_victims(
     candidates, and ``quota_headroom`` gates the reprieve the way
     postFilterState.usedLimit does.  ``False`` gives the job-preemption rule
     (isPreemptionAllowed, coscheduling preemption.go:405): any lower-priority
-    preemptible pod.
+    preemptible pod.  May be a traced scalar bool (``preempt_chain`` mixes
+    both kinds in one scan); a traced value requires ``quota_headroom`` to
+    be an array (pass fully-open headroom for the non-quota case).
     """
     n_cap = state.capacity
 
@@ -179,8 +187,13 @@ def select_victims(
         & ~sched.non_preemptible
         & (sched.node >= 0)
     )
-    if same_quota_only:
-        cand = cand & (sched.quota_id == preemptor_quota)
+    if isinstance(same_quota_only, bool):
+        if same_quota_only:
+            cand = cand & (sched.quota_id == preemptor_quota)
+    else:
+        cand = cand & (
+            ~same_quota_only | (sched.quota_id == preemptor_quota)
+        )
 
     # importance-descending candidate order (sortVictims: priority desc, then
     # a stable tiebreak — we use row index)
@@ -254,8 +267,12 @@ def select_victims(
     )
     max_victim_pri = jax.ops.segment_max(v_pri, safe_node, num_segments=n_cap)
     max_victim_pri = jnp.where(num_victims > 0, max_victim_pri, NEG_PRI)
+    # Deliberately int32: priorities here are koordinator bands (<= ~10k,
+    # api/priority.py), so the per-node sum is exact up to ~200k victims on
+    # one node — far beyond any real node's pod count.  (int64 would need
+    # jax x64 mode, which the rest of the solver doesn't enable.)
     sum_victim_pri = jax.ops.segment_sum(
-        jnp.where(victim, sched.priority.astype(jnp.int64), 0),
+        jnp.where(victim, sched.priority, 0),
         safe_node, num_segments=n_cap,
     )
     return VictimSolve(
@@ -280,7 +297,6 @@ def pick_node(solve: VictimSolve) -> jnp.ndarray:
 
     def refine(mask, key):
         # sentinel must dominate any real key value in the key's own dtype
-        # (int64 victim-priority sums can exceed int32 max)
         big = jnp.iinfo(key.dtype).max
         key_m = jnp.where(mask, key, big)
         return mask & (key == jnp.min(key_m))
@@ -351,4 +367,96 @@ def preempt_one(
     return PreemptionOutcome(
         node=node, victims=chosen, state=new_state, sched=new_sched,
         pdb_allowed=new_pdb,
+    )
+
+
+@struct.dataclass
+class ChainOutcome:
+    """Per-preemptor results of :func:`preempt_chain` (leading axis C)."""
+
+    node: jax.Array          # (C,) int32, -1 = failed / inactive
+    victims: jax.Array       # (C, V) bool — victims per successful preemptor
+    state: ClusterState      # final state after all successful preemptors
+    sched: ScheduledPods     # final sched
+    pdb_allowed: jax.Array   # (B,) final budgets
+
+
+def preempt_chain(
+    state: ClusterState,
+    sched: ScheduledPods,
+    reqs: jnp.ndarray,          # (C, R) int32
+    pris: jnp.ndarray,          # (C,) int32
+    qids: jnp.ndarray,          # (C,) int32, -1 = none
+    feasible: jnp.ndarray,      # (C, N) bool
+    same_quota: jnp.ndarray,    # (C,) bool — elastic-quota vs job rule
+    active: jnp.ndarray,        # (C,) bool — padding rows are inactive
+    pdb_allowed: jnp.ndarray,   # (B,) int32
+    base_headroom: jnp.ndarray, # (Q, R) int32 runtime - used per quota row
+) -> ChainOutcome:
+    """Chain C single-pod PostFilter dry-runs inside ONE device program.
+
+    Semantically identical to calling :func:`preempt_one` sequentially per
+    preemptor with the host committing each success in between (the
+    scheduler's per-pod loop), but with one jit dispatch per chunk instead
+    of per failed pod — the bounded-work answer to a quota-starved 50k
+    queue (upstream bounds preemption work per cycle the same way,
+    coscheduling preemption.go:206).
+
+    Cross-preemptor quota effects are carried in-scan: a success charges
+    the preemptor's quota row with its request and releases every victim's
+    request to the victim's own quota row, mirroring the tree commit
+    (`q.used` update + nomination assume) the host performs between
+    sequential calls.  Failed or inactive rows leave the carry untouched.
+    """
+    q_rows = base_headroom.shape[0] if base_headroom is not None else 1
+    base_hr = (
+        jnp.full((max(q_rows, 1), reqs.shape[1]), HEADROOM_OPEN, jnp.int32)
+        if base_headroom is None else base_headroom.astype(jnp.int32)
+    )
+
+    def step(carry, x):
+        requested, valid, pdb, assumed = carry
+        req, pri, qid, feas, sq, act = x
+        cur_state = state.replace(node_requested=requested)
+        cur_sched = sched.replace(valid=valid)
+        safe_q = jnp.maximum(qid, 0)
+        hr = jnp.where(
+            sq, base_hr[safe_q] - assumed[safe_q], HEADROOM_OPEN
+        )
+        hr = jnp.clip(hr, -HEADROOM_OPEN, HEADROOM_OPEN)
+        out = preempt_one(
+            cur_state, cur_sched, req, pri, qid, feas, pdb,
+            quota_headroom=hr, same_quota_only=sq,
+        )
+        ok = act & (out.node >= 0)
+        chosen = out.victims & ok
+
+        # quota commit mirror: victims release to their own quota rows,
+        # the preemptor charges its row (nomination assume)
+        vic_by_q = jax.ops.segment_sum(
+            jnp.where(chosen[:, None] & (sched.quota_id >= 0)[:, None],
+                      sched.requests, 0),
+            jnp.maximum(sched.quota_id, 0), num_segments=base_hr.shape[0],
+        )
+        add = jnp.where(ok & (qid >= 0), req, 0)
+        assumed = (assumed - vic_by_q).at[safe_q].add(add)
+
+        new_carry = (
+            jnp.where(ok, out.state.node_requested, requested),
+            jnp.where(ok, out.sched.valid, valid),
+            jnp.where(ok, out.pdb_allowed, pdb),
+            assumed,
+        )
+        return new_carry, (jnp.where(ok, out.node, -1), chosen)
+
+    assumed0 = jnp.zeros_like(base_hr)
+    carry0 = (state.node_requested, sched.valid, pdb_allowed, assumed0)
+    (requested, valid, pdb, _), (nodes, victims) = jax.lax.scan(
+        step, carry0, (reqs, pris, qids, feasible, same_quota, active)
+    )
+    return ChainOutcome(
+        node=nodes, victims=victims,
+        state=state.replace(node_requested=requested),
+        sched=sched.replace(valid=valid),
+        pdb_allowed=pdb,
     )

@@ -59,7 +59,11 @@ class CPUManager:
                 and bool(np.array_equal(np.asarray(old.topology.core_of),
                                         np.asarray(topology.core_of)))
                 and bool(np.array_equal(np.asarray(old.topology.numa_of),
-                                        np.asarray(topology.numa_of)))):
+                                        np.asarray(topology.numa_of)))
+                and bool(np.array_equal(np.asarray(old.topology.socket_of),
+                                        np.asarray(topology.socket_of)))
+                and bool(np.array_equal(np.asarray(old.topology.valid),
+                                        np.asarray(topology.valid)))):
             return   # unchanged heartbeat: keep state as-is
         st = NodeCPUState(
             topology=topology,

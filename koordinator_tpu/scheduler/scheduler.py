@@ -219,11 +219,22 @@ class Scheduler:
         self.pdbs: dict[str, PdbRecord] = {}
         #: preemptor pod -> nominated node name (nominatedNodeName semantics)
         self.nominations: dict[str, str] = {}
-        from koordinator_tpu.ops.preemption import preempt_one
+        from koordinator_tpu.ops.preemption import preempt_chain, preempt_one
 
         self._preempt = jax.jit(
             preempt_one, static_argnames=("same_quota_only", "nominate")
         )
+        self._preempt_chain = jax.jit(preempt_chain)
+        #: bound on PostFilter work per round (mirror of rsv_prepass_cap):
+        #: at most this many failed pods attempt preemption in one round —
+        #: a quota-starved 50k queue must not turn PostFilter into 50k
+        #: device calls (upstream bounds the preemption cycle's work the
+        #: same way, coscheduling preemption.go:206).  Excess pods stay
+        #: pending and retry next round.
+        self.preempt_cap = 1024
+        #: single-pod preemptors are chained in jitted scans of this size
+        #: (one dispatch per chunk, not per pod); gangs use the host loop
+        self.preempt_chunk = 256
 
     # -- registration -------------------------------------------------------
 
@@ -736,8 +747,12 @@ class Scheduler:
 
     def _schedule_round(self) -> SchedulingResult:
         # set at round START — before any early return, including the
-        # barrier gate, so a backlog building behind the barrier is visible
-        metrics.pending_pods.set(float(len(self.pending)))
+        # barrier gate, so a backlog building behind the barrier is visible.
+        # Synthetic rsv:: reserve-pods are excluded (they are placement
+        # vehicles, not user backlog — the auditor filters them the same way)
+        metrics.pending_pods.set(float(sum(
+            1 for name in self.pending
+            if not name.startswith(RSV_POD_PREFIX))))
         if self.elector is not None and not self.elector.tick():
             # standby replica: keep syncing state, decide nothing — and
             # surface the standby (empty) result on the debug API instead
@@ -1220,13 +1235,36 @@ class Scheduler:
             else:
                 jobs.append([p])
 
+        # per-round budget (mirror rsv_prepass_cap): a quota-starved 50k
+        # queue must not become 50k dry-runs in one round.  Highest-priority
+        # jobs first (already sorted); a gang that does not fit the
+        # remaining budget is skipped whole (all-or-nothing), the rest
+        # retry next round.  Applied BEFORE the O(F·N) mask expansion below
+        # so the per-round host cost is O(cap·N), not O(F·N).
+        budget = self.preempt_cap
+        capped: list[list[PodSpec]] = []
+        for job in jobs:
+            if budget <= 0:
+                break
+            if any(p.preemption_policy == "Never" for p in job):
+                continue
+            if len(job) > budget:
+                continue
+            capped.append(job)
+            budget -= len(job)
+        if not capped:
+            return
+
         pod_row = {p.name: i for i, p in enumerate(pods)}
-        # expand feasibility + threshold masks only for the failed pods
-        # (O(F·N), not O(P·N) — preemption is the rare path)
+        # expand feasibility + threshold masks only for the capped
+        # preemptors (O(cap·N), not O(P·N) — preemption is the rare path)
         from koordinator_tpu.ops import scoring
         from koordinator_tpu.ops.assignment import _threshold_mask
 
-        fail_rows = np.array([pod_row[p.name] for p in failed], np.int32)
+        fail_rows = np.array(
+            sorted({pod_row[p.name] for job in capped for p in job}),
+            np.int32,
+        )
         feasible_np = {
             r: np.asarray(batch.feasible_row(state, int(r)))
             for r in fail_rows
@@ -1244,96 +1282,179 @@ class Scheduler:
         ))
         thr_np = {int(r): thr[i] for i, r in enumerate(fail_rows)}
 
+        i = 0
+        while i < len(capped):
+            job = capped[i]
+            if len(job) == 1 and job[0].gang is None:
+                # run of consecutive single-pod preemptors: one jitted
+                # chain dispatch instead of one dispatch per pod
+                chunk: list[PodSpec] = []
+                while (i < len(capped) and len(capped[i]) == 1
+                       and capped[i][0].gang is None
+                       and len(chunk) < self.preempt_chunk):
+                    chunk.append(capped[i][0])
+                    i += 1
+                state, sched, pdb_allowed = self._run_preempt_chunk(
+                    chunk, state, sched, pdb_allowed, quota_index,
+                    bound_names, pod_row, feasible_np, thr_np, result,
+                )
+                continue
+            i += 1
+            state, sched, pdb_allowed = self._run_preempt_job(
+                job, state, sched, pdb_allowed, quota_index, bound_names,
+                pod_row, feasible_np, thr_np, result,
+            )
+
+    def _run_preempt_job(
+        self, job, state, sched, pdb_allowed, quota_index, bound_names,
+        pod_row, feasible_np, thr_np, result,
+    ):
+        """One gang (or host-path single) job: sequential dry-runs with
+        all-or-nothing commit.  Returns the evolved (state, sched, pdb)."""
         from koordinator_tpu.quota.admission import HEADROOM_CLAMP
 
-        for job in jobs:
-            if any(p.preemption_policy == "Never" for p in job):
-                continue
-            cur_state, cur_sched, cur_pdb = state, sched, pdb_allowed
-            outcomes = []
-            # quota consumed/freed by this job's earlier members (nominated
-            # requests minus same-quota victims): the tree is only charged at
-            # commit, so the dry run must not double-spend headroom
-            job_assumed: dict[str, np.ndarray] = {}
-            ok = True
-            for p in job:
-                quota_hr = self._quota_headroom(p.quota)
-                same_quota = quota_hr is not None
-                if same_quota and p.quota in job_assumed:
-                    quota_hr = np.clip(
-                        quota_hr.astype(np.int64) - job_assumed[p.quota],
-                        -HEADROOM_CLAMP, HEADROOM_CLAMP,
-                    ).astype(np.int32)
-                qid = quota_index.get(p.quota, -1) if p.quota else -1
-                # feasibility row from the solve batch (affinity/selector)
-                # ANDed with the usage-threshold filter; preemption fixes
-                # neither affinity nor measured-load failures
-                row = feasible_np[pod_row[p.name]] & thr_np[pod_row[p.name]]
-                out = self._preempt(
-                    cur_state, cur_sched,
-                    jnp.asarray(p.requests.astype(np.int32)),
-                    jnp.int32(p.priority), jnp.int32(qid),
-                    jnp.asarray(row), cur_pdb,
-                    quota_headroom=(
-                        jnp.asarray(quota_hr) if same_quota else None
-                    ),
-                    same_quota_only=same_quota,
-                )
-                node_row = int(out.node)
-                if node_row < 0:
-                    ok = False
-                    break
-                victim_names = [
-                    bound_names[v]
-                    for v in np.flatnonzero(np.asarray(out.victims))
-                ]
-                outcomes.append((p, out, victim_names))
-                if p.quota is not None:
-                    delta = p.requests.astype(np.int64)
-                    for vname in victim_names:
-                        bp = self.bound[vname]
-                        if bp.quota == p.quota:
-                            delta = delta - bp.requests.astype(np.int64)
-                    job_assumed[p.quota] = (
-                        job_assumed.get(p.quota, 0) + delta
-                    )
-                cur_state, cur_sched, cur_pdb = out.state, out.sched, out.pdb_allowed
-            if not ok:
-                continue  # all-or-nothing: drop the job's tentative evictions
-
-            # commit: evict victims, record nominations, update diagnosis
-            for p, out, victim_names in outcomes:
-                node_name = self.snapshot.node_name(int(out.node))
+        cur_state, cur_sched, cur_pdb = state, sched, pdb_allowed
+        outcomes = []
+        # quota consumed/freed by this job's earlier members (nominated
+        # requests minus same-quota victims): the tree is only charged at
+        # commit, so the dry run must not double-spend headroom
+        job_assumed: dict[str, np.ndarray] = {}
+        for p in job:
+            quota_hr = self._quota_headroom(p.quota)
+            same_quota = quota_hr is not None
+            if same_quota and p.quota in job_assumed:
+                quota_hr = np.clip(
+                    quota_hr.astype(np.int64) - job_assumed[p.quota],
+                    -HEADROOM_CLAMP, HEADROOM_CLAMP,
+                ).astype(np.int32)
+            qid = quota_index.get(p.quota, -1) if p.quota else -1
+            # feasibility row from the solve batch (affinity/selector)
+            # ANDed with the usage-threshold filter; preemption fixes
+            # neither affinity nor measured-load failures
+            row = feasible_np[pod_row[p.name]] & thr_np[pod_row[p.name]]
+            out = self._preempt(
+                cur_state, cur_sched,
+                jnp.asarray(p.requests.astype(np.int32)),
+                jnp.int32(p.priority), jnp.int32(qid),
+                jnp.asarray(row), cur_pdb,
+                quota_headroom=(
+                    jnp.asarray(quota_hr) if same_quota else None
+                ),
+                same_quota_only=same_quota,
+            )
+            node_row = int(out.node)
+            if node_row < 0:
+                # all-or-nothing: drop the job's tentative evictions
+                return state, sched, pdb_allowed
+            victim_names = [
+                bound_names[v]
+                for v in np.flatnonzero(np.asarray(out.victims))
+            ]
+            outcomes.append((p, int(out.node), victim_names))
+            if p.quota is not None:
+                delta = p.requests.astype(np.int64)
                 for vname in victim_names:
-                    bp = self.bound.pop(vname)
-                    # shared freeing: fine-grained allocations and
-                    # reservation-aware unreserve (a reservation-backed
-                    # victim returns its drawn vector, not raw capacity)
-                    self._release_bound_capacity(bp)
-                    if bp.quota and self.quota_tree is not None \
-                            and bp.quota in self.quota_tree.nodes:
-                        q = self.quota_tree.nodes[bp.quota]
-                        q.used = q.used - bp.requests.astype(np.int64)
-                        if bp.non_preemptible:
-                            q.non_preemptible_used = (
-                                q.non_preemptible_used
-                                - bp.requests.astype(np.int64)
-                            )
-                    # every matching PDB pays for the disruption
-                    for pn in pdb_names:
-                        if self.pdbs[pn].matches(bp.labels):
-                            self.pdbs[pn].allowed -= 1
-                    if self.preempt_fn is not None:
-                        self.preempt_fn(vname, p.name)
-                # assume the preemptor's resources (node reservation + quota
-                # charge): nothing may claim the freed capacity or headroom
-                # before the preemptor binds or the nomination is cleared
-                self._nomination_assume(p, node_name)
-                result.nominations[p.name] = (node_name, victim_names)
-                diag = result.failures.get(p.name)
-                if diag is not None:
-                    diag.preempt_node = node_name
-                    diag.preempt_victims = victim_names
-            # later jobs see this job's evictions + nominations; bound_names
-            # order is unchanged (evicted rows are invalid in sched)
-            state, sched, pdb_allowed = cur_state, cur_sched, cur_pdb
+                    bp = self.bound[vname]
+                    if bp.quota == p.quota:
+                        delta = delta - bp.requests.astype(np.int64)
+                job_assumed[p.quota] = (
+                    job_assumed.get(p.quota, 0) + delta
+                )
+            cur_state, cur_sched, cur_pdb = out.state, out.sched, out.pdb_allowed
+
+        # commit: evict victims, record nominations, update diagnosis.
+        # Later jobs see this job's evictions + nominations; bound_names
+        # order is unchanged (evicted rows are invalid in sched).
+        for p, node_row, victim_names in outcomes:
+            self._commit_one_preemption(p, node_row, victim_names, result)
+        return cur_state, cur_sched, cur_pdb
+
+    def _run_preempt_chunk(
+        self, chunk, state, sched, pdb_allowed, quota_index, bound_names,
+        pod_row, feasible_np, thr_np, result,
+    ):
+        """A run of single-pod preemptors in ONE jitted chain dispatch
+        (ops/preemption.preempt_chain).  Semantics match calling
+        :meth:`_run_preempt_job` per pod; the chunk is padded to
+        ``preempt_chunk`` rows so chain lengths don't retrace."""
+        from koordinator_tpu.quota.admission import HEADROOM_CLAMP
+
+        c = self.preempt_chunk
+        r = chunk[0].requests.shape[0]
+        n = self.snapshot.capacity
+        reqs = np.zeros((c, r), np.int32)
+        pris = np.zeros(c, np.int32)
+        qids = np.full(c, -1, np.int32)
+        feas = np.zeros((c, n), bool)
+        same_q = np.zeros(c, bool)
+        active = np.zeros(c, bool)
+        # (Q, R) runtime - used per quota row; rows the chunk never touches
+        # stay fully open
+        q_rows = max(len(quota_index), 1)
+        base_hr = np.full((q_rows, r), HEADROOM_CLAMP, np.int32)
+        for name, qi in quota_index.items():
+            hr = self._quota_headroom(name)
+            if hr is not None:
+                base_hr[qi] = hr
+        for j, p in enumerate(chunk):
+            reqs[j] = p.requests.astype(np.int32)
+            pris[j] = p.priority
+            qids[j] = quota_index.get(p.quota, -1) if p.quota else -1
+            feas[j] = feasible_np[pod_row[p.name]] & thr_np[pod_row[p.name]]
+            same_q[j] = self._quota_headroom(p.quota) is not None
+            active[j] = True
+
+        out = self._preempt_chain(
+            state, sched, jnp.asarray(reqs), jnp.asarray(pris),
+            jnp.asarray(qids), jnp.asarray(feas), jnp.asarray(same_q),
+            jnp.asarray(active), pdb_allowed, jnp.asarray(base_hr),
+        )
+        nodes = np.asarray(out.node)
+        victims = np.asarray(out.victims)
+        for j, p in enumerate(chunk):
+            if nodes[j] < 0:
+                continue
+            victim_names = [
+                bound_names[v] for v in np.flatnonzero(victims[j])
+            ]
+            self._commit_one_preemption(p, int(nodes[j]), victim_names,
+                                        result)
+        return out.state, out.sched, out.pdb_allowed
+
+    def _commit_one_preemption(
+        self, p, node_row: int, victim_names: list[str], result,
+    ) -> None:
+        """Host commit for one successful preemptor: evict victims (free
+        capacity, release quota, charge PDBs, call preempt_fn), assume the
+        preemptor's nomination, and record it on the round result."""
+        node_name = self.snapshot.node_name(node_row)
+        for vname in victim_names:
+            bp = self.bound.pop(vname)
+            # shared freeing: fine-grained allocations and
+            # reservation-aware unreserve (a reservation-backed
+            # victim returns its drawn vector, not raw capacity)
+            self._release_bound_capacity(bp)
+            if bp.quota and self.quota_tree is not None \
+                    and bp.quota in self.quota_tree.nodes:
+                q = self.quota_tree.nodes[bp.quota]
+                q.used = q.used - bp.requests.astype(np.int64)
+                if bp.non_preemptible:
+                    q.non_preemptible_used = (
+                        q.non_preemptible_used
+                        - bp.requests.astype(np.int64)
+                    )
+            # every matching PDB pays for the disruption
+            for pn in self.pdbs:
+                if self.pdbs[pn].matches(bp.labels):
+                    self.pdbs[pn].allowed -= 1
+            if self.preempt_fn is not None:
+                self.preempt_fn(vname, p.name)
+        # assume the preemptor's resources (node reservation + quota
+        # charge): nothing may claim the freed capacity or headroom
+        # before the preemptor binds or the nomination is cleared
+        self._nomination_assume(p, node_name)
+        result.nominations[p.name] = (node_name, victim_names)
+        diag = result.failures.get(p.name)
+        if diag is not None:
+            diag.preempt_node = node_name
+            diag.preempt_victims = victim_names

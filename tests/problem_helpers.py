@@ -1,0 +1,78 @@
+"""Shared seeded problem builders for solver test suites.
+
+One canonical builder for the (ClusterState, PodBatch) problems that the
+candidate-selection and Pallas suites both exercise, so a scoring-field
+change lands in one place.  (`__graft_entry__._build_problem` stays
+self-contained by design — the driver runs it without the test tree.)
+"""
+
+import numpy as np
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
+
+R = NUM_RESOURCE_DIMS
+CPU, MEM, GPU = ResourceDim.CPU, ResourceDim.MEMORY, ResourceDim.GPU
+
+
+def build_problem(n_nodes=64, n_pods=128, seed=0, classes=3,
+                  invalid_tail=0, with_gpu=True, factored=True,
+                  pad_pods_pow2=True):
+    """Seeded random scheduling problem.
+
+    ``factored`` attaches a selector-class mask (required by the fused
+    kernel); ``invalid_tail`` zeroes + invalidates the last nodes;
+    ``pad_pods_pow2`` pads the pod batch capacity to a power of two
+    (PodBatch.build's natural padding behavior in the suites).
+    """
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    alloc = np.zeros((n_nodes, R), np.int32)
+    alloc[:, CPU] = rng.integers(8_000, 64_000, n_nodes)
+    alloc[:, MEM] = rng.integers(16_384, 262_144, n_nodes)
+    if with_gpu:
+        alloc[:, GPU] = rng.integers(0, 2, n_nodes) * 8_000
+    usage = (alloc * rng.random((n_nodes, R)) * 0.6).astype(np.int32)
+    requested = (alloc * rng.random((n_nodes, R)) * 0.5).astype(np.int32)
+    node_class = rng.integers(0, classes, n_nodes).astype(np.int32)
+    if invalid_tail:
+        alloc[-invalid_tail:] = 0
+    state = ClusterState.from_arrays(
+        alloc, requested=requested, usage=usage, capacity=n_nodes,
+        node_class=node_class)
+    if invalid_tail:
+        valid = np.ones(n_nodes, bool)
+        valid[-invalid_tail:] = False
+        state = state.replace(node_valid=jnp.asarray(valid))
+
+    req = np.zeros((n_pods, R), np.int32)
+    req[:, CPU] = rng.integers(100, 4_000, n_pods)
+    req[:, MEM] = rng.integers(128, 8_192, n_pods)
+    if with_gpu:
+        req[rng.random(n_pods) < 0.2, GPU] = 1_000
+    kw = {}
+    if factored:
+        sel = rng.random((n_pods, 8)) < 0.7
+        sel[:, :classes] |= rng.random((n_pods, classes)) < 0.5
+        kw = dict(selector_mask=sel, class_capacity=8)
+    cap = (1 << (n_pods - 1).bit_length()) if pad_pods_pow2 else n_pods
+    pods = PodBatch.build(
+        req, priority=rng.integers(3000, 9999, n_pods).astype(np.int32),
+        node_capacity=n_nodes, capacity=cap, **kw)
+    return state, pods
+
+
+def candidate_recall(exact_nodes, exact_keys, got_nodes):
+    """Fraction of each pod's true (feasible, key >= 0) top-k candidates
+    found by a method's candidate sets."""
+    hits = total = 0
+    for p in range(exact_nodes.shape[0]):
+        want = set(np.asarray(exact_nodes)[p][
+            np.asarray(exact_keys)[p] >= 0].tolist())
+        if not want:
+            continue
+        got = set(np.asarray(got_nodes)[p].tolist())
+        hits += len(want & got)
+        total += len(want)
+    return hits / max(total, 1)

@@ -1,0 +1,110 @@
+"""Forced candidate-selection methods (ops/batch_assign.select_candidates).
+
+The TPU-serving branches — the approx_max_k float-key path and the Pallas
+fused kernel — are force-selectable via ``method=`` so CPU CI executes them
+(VERDICT r2 item 3: no code path may run only when a human watches a TPU
+tunnel).  Invariants asserted here:
+
+- "approx": candidate recall vs the exact path >= 0.9 on seeded problems
+  (on CPU the recall loss comes only from the 24-bit float-key
+  quantization; on TPU approx_max_k adds its ~0.95 recall target), and the
+  downstream acceptance stays EXACT — no node over capacity, no quota
+  overshoot — because fit/quota checks never depend on the method;
+- "fused": bit-exact with "exact" on shapes where the bucket array covers
+  the node axis (interpret mode off-TPU);
+- "auto" resolves to "exact" on CPU; unknown methods raise.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.ops.assignment import ScoringConfig
+from koordinator_tpu.ops.batch_assign import (
+    CANDIDATE_METHODS,
+    batch_assign,
+    select_candidates,
+)
+from tests.problem_helpers import build_problem as _build
+from tests.problem_helpers import candidate_recall
+
+
+def build_problem(n_nodes=256, n_pods=128, seed=0, factored=True):
+    state, pods = _build(n_nodes=n_nodes, n_pods=n_pods, seed=seed,
+                         classes=4, factored=factored)
+    return state, pods, ScoringConfig.default()
+
+
+def test_approx_method_recall_and_exact_acceptance():
+    state, pods, cfg = build_problem(seed=1)
+    ek, en = select_candidates(state, pods, cfg, k=16, method="exact")
+    ak, an = select_candidates(state, pods, cfg, k=16, method="approx")
+    rec = candidate_recall(np.asarray(en), np.asarray(ek), np.asarray(an))
+    assert rec >= 0.9, f"approx candidate recall {rec:.3f} < 0.9"
+    # gathered keys must be the exact int keys for the chosen nodes
+    ek_map = {(p, int(n)): int(v)
+              for p in range(en.shape[0])
+              for n, v in zip(np.asarray(en)[p], np.asarray(ek)[p])}
+    got = np.asarray(ak)
+    for p in range(an.shape[0]):
+        for n, v in zip(np.asarray(an)[p], got[p]):
+            if (p, int(n)) in ek_map and v >= 0:
+                assert v == ek_map[(p, int(n))]
+
+    # acceptance is exact regardless of candidate method: replay the
+    # assignment and check no node exceeds allocatable
+    a, st, _ = batch_assign(state, pods, cfg, k=16, method="approx")
+    a = np.asarray(a)
+    req = np.asarray(pods.requests)
+    used = np.asarray(state.node_requested).copy()
+    for p in np.nonzero(a >= 0)[0]:
+        used[a[p]] += req[p]
+    assert (used <= np.asarray(state.node_allocatable)).all(), \
+        "approx method let a node exceed capacity"
+    np.testing.assert_array_equal(used, np.asarray(st.node_requested))
+
+
+def test_fused_method_matches_exact_on_covered_shapes():
+    # n <= default bucket span -> the fused kernel is bit-exact, and the
+    # method is runnable on CPU (interpret picked automatically)
+    state, pods, cfg = build_problem(n_nodes=64, n_pods=64, seed=2)
+    a0, s0, _ = batch_assign(state, pods, cfg, k=8, method="exact")
+    a1, s1, _ = batch_assign(state, pods, cfg, k=8, method="fused")
+    np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
+    np.testing.assert_array_equal(np.asarray(s0.node_requested),
+                                  np.asarray(s1.node_requested))
+
+
+def test_fused_method_requires_factored_batch():
+    state, pods, cfg = build_problem(n_nodes=64, n_pods=32, seed=3,
+                                     factored=False)
+    dense = pods.replace(
+        feasible=jnp.ones((pods.capacity, state.capacity), bool),
+        selector_mask=None)
+    with pytest.raises(ValueError, match="factored"):
+        batch_assign(state, dense, cfg, method="fused")
+
+
+def test_auto_resolves_exact_on_cpu():
+    state, pods, cfg = build_problem(n_nodes=64, n_pods=32, seed=4)
+    ek, en = select_candidates(state, pods, cfg, k=8, method="exact")
+    au_k, au_n = select_candidates(state, pods, cfg, k=8, method="auto")
+    assert jax.default_backend() != "tpu"
+    np.testing.assert_array_equal(np.asarray(ek), np.asarray(au_k))
+    np.testing.assert_array_equal(np.asarray(en), np.asarray(au_n))
+
+
+def test_unknown_method_raises():
+    state, pods, cfg = build_problem(n_nodes=64, n_pods=32, seed=5)
+    with pytest.raises(ValueError, match="unknown candidate method"):
+        select_candidates(state, pods, cfg, method="fancy")
+    assert "exact" in CANDIDATE_METHODS
+
+
+def test_legacy_fused_topk_flag_is_fused_method():
+    state, pods, cfg = build_problem(n_nodes=64, n_pods=64, seed=6)
+    k0, n0 = select_candidates(state, pods, cfg, k=8, method="fused")
+    k1, n1 = select_candidates(state, pods, cfg, k=8, fused_topk=True)
+    np.testing.assert_array_equal(np.asarray(k0), np.asarray(k1))
+    np.testing.assert_array_equal(np.asarray(n0), np.asarray(n1))

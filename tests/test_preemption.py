@@ -471,3 +471,160 @@ class TestSchedulerPostFilter:
         assert "b-low" in sched.bound
         res2 = sched.schedule_round()
         assert res2.assignments == {"a-high": "n1"}
+
+
+class TestPreemptChain:
+    """preempt_chain == sequential preempt_one + host commit (VERDICT r2
+    item 4: batched PostFilter), plus the scheduler-level round budget."""
+
+    def _chain_problem(self, seed=0, n_nodes=6, n_bound=24, n_fail=8):
+        rng = np.random.default_rng(seed)
+        alloc = rng.integers(4_000, 12_000, n_nodes).astype(np.int32)
+        bound_nodes = rng.integers(0, n_nodes, n_bound)
+        bound_cpu = rng.integers(500, 3_000, n_bound).astype(np.int32)
+        requested = np.zeros(n_nodes, np.int32)
+        for nd, c in zip(bound_nodes, bound_cpu):
+            requested[nd] += c
+        requested = np.minimum(requested, alloc)
+        state = cluster(*alloc.tolist(), requested_cpu=requested.tolist())
+        sp = sched_pods(
+            bound_nodes.tolist(), bound_cpu.tolist(),
+            rng.integers(10, 90, n_bound).tolist(),
+            quota_id=rng.integers(-1, 3, n_bound).astype(np.int32),
+        )
+        reqs = np.zeros((n_fail, R), np.int32)
+        reqs[:, CPU] = rng.integers(2_000, 6_000, n_fail)
+        pris = rng.integers(5_000, 9_000, n_fail).astype(np.int32)
+        qids = rng.integers(-1, 3, n_fail).astype(np.int32)
+        same_q = qids >= 0
+        feas = rng.random((n_fail, state.capacity)) < 0.9
+        base_hr = rng.integers(-2_000, 20_000,
+                               (3, R)).astype(np.int32)
+        pdb = jnp.zeros(1, jnp.int32)
+        return state, sp, reqs, pris, qids, feas, same_q, base_hr, pdb
+
+    def test_chain_matches_sequential(self):
+        from koordinator_tpu.ops.preemption import (
+            HEADROOM_OPEN,
+            preempt_chain,
+        )
+
+        for seed in range(4):
+            (state, sp, reqs, pris, qids, feas, same_q, base_hr,
+             pdb) = self._chain_problem(seed=seed)
+            n_fail = reqs.shape[0]
+            out = preempt_chain(
+                state, sp, jnp.asarray(reqs), jnp.asarray(pris),
+                jnp.asarray(qids), jnp.asarray(feas),
+                jnp.asarray(same_q), jnp.ones(n_fail, bool), pdb,
+                jnp.asarray(base_hr),
+            )
+            # sequential reference: preempt_one per pod, with the same
+            # commit-mirror quota accounting the chain carries
+            cur_state, cur_sched, cur_pdb = state, sp, pdb
+            assumed = np.zeros_like(base_hr)
+            want_nodes = []
+            want_victims = []
+            for j in range(n_fail):
+                qid = int(qids[j])
+                if same_q[j]:
+                    hr = np.clip(base_hr[qid] - assumed[qid],
+                                 -HEADROOM_OPEN, HEADROOM_OPEN)
+                else:
+                    hr = np.full(R, HEADROOM_OPEN, np.int32)
+                o = preempt_one(
+                    cur_state, cur_sched, jnp.asarray(reqs[j]),
+                    jnp.int32(pris[j]), jnp.int32(qid),
+                    jnp.asarray(feas[j]), cur_pdb,
+                    quota_headroom=jnp.asarray(hr.astype(np.int32)),
+                    same_quota_only=bool(same_q[j]),
+                )
+                nd = int(o.node)
+                want_nodes.append(nd)
+                if nd < 0:
+                    want_victims.append(np.zeros(sp.capacity, bool))
+                    continue
+                chosen = np.asarray(o.victims)
+                want_victims.append(chosen)
+                vq = np.asarray(cur_sched.quota_id)
+                for v in np.flatnonzero(chosen):
+                    if vq[v] >= 0:
+                        assumed[vq[v]] -= np.asarray(sp.requests)[v]
+                if qid >= 0:
+                    assumed[qid] += reqs[j]
+                cur_state, cur_sched, cur_pdb = o.state, o.sched, o.pdb_allowed
+            assert np.asarray(out.node).tolist() == want_nodes, seed
+            np.testing.assert_array_equal(
+                np.asarray(out.victims), np.stack(want_victims))
+            np.testing.assert_array_equal(
+                np.asarray(out.state.node_requested),
+                np.asarray(cur_state.node_requested))
+            np.testing.assert_array_equal(
+                np.asarray(out.sched.valid), np.asarray(cur_sched.valid))
+            np.testing.assert_array_equal(
+                np.asarray(out.pdb_allowed), np.asarray(cur_pdb))
+
+    def test_inactive_rows_leave_carry_untouched(self):
+        from koordinator_tpu.ops.preemption import preempt_chain
+
+        (state, sp, reqs, pris, qids, feas, same_q, base_hr,
+         pdb) = self._chain_problem(seed=5)
+        n_fail = reqs.shape[0]
+        active = np.zeros(n_fail, bool)
+        active[0] = True
+        out = preempt_chain(
+            state, sp, jnp.asarray(reqs), jnp.asarray(pris),
+            jnp.asarray(qids), jnp.asarray(feas), jnp.asarray(same_q),
+            jnp.asarray(active), pdb, jnp.asarray(base_hr),
+        )
+        assert np.all(np.asarray(out.node)[1:] == -1)
+        assert not np.asarray(out.victims)[1:].any()
+
+
+class TestPreemptionBudget:
+    def test_round_cap_bounds_preemptors(self):
+        # 6 failed singles, cap 2: only the 2 highest-priority pods get
+        # nominations this round; the rest stay failed and retry later
+        sched, _ = mk_scheduler(
+            [node(f"n{i}", cpu=4_000) for i in range(6)],
+            enable_preemption=True,
+        )
+        sched.preempt_cap = 2
+        for i in range(6):
+            sched.enqueue(pod(f"low-{i}", cpu=4_000, priority=10))
+        res = sched.schedule_round()
+        assert not res.failures
+        for i in range(6):
+            sched.enqueue(pod(f"high-{i}", cpu=4_000,
+                              priority=9_000 + 100 * i))
+        res = sched.schedule_round()
+        assert len(res.nominations) == 2
+        # highest-priority failed pods won the budget
+        assert set(res.nominations) == {"high-5", "high-4"}
+        # next round the remaining pods get their turn
+        res2 = sched.schedule_round()
+        assert len(res2.nominations) == 2
+
+    def test_chunked_singles_one_dispatch(self, monkeypatch):
+        # consecutive single-pod preemptors ride ONE chain dispatch
+        sched, _ = mk_scheduler(
+            [node(f"n{i}", cpu=4_000) for i in range(4)],
+            enable_preemption=True,
+        )
+        for i in range(4):
+            sched.enqueue(pod(f"low-{i}", cpu=4_000, priority=10))
+        assert not sched.schedule_round().failures
+        calls = {"chain": 0, "one": 0}
+        real_chain = sched._preempt_chain
+        real_one = sched._preempt
+        sched._preempt_chain = (
+            lambda *a, **k: (calls.__setitem__("chain", calls["chain"] + 1)
+                             or real_chain(*a, **k)))
+        sched._preempt = (
+            lambda *a, **k: (calls.__setitem__("one", calls["one"] + 1)
+                             or real_one(*a, **k)))
+        for i in range(4):
+            sched.enqueue(pod(f"high-{i}", cpu=4_000, priority=9_000))
+        res = sched.schedule_round()
+        assert len(res.nominations) == 4
+        assert calls == {"chain": 1, "one": 0}

@@ -1,0 +1,222 @@
+"""ElasticQuota-CR admission webhook (manager/quota_webhook.py).
+
+Scenario coverage mirrors the reference's quota_topology_test.go: add with
+min>max / negative values, parent missing / not-a-parent, sibling min sums
+vs parent min, key-set consistency, tree-id consistency, forbidden
+modifications (root/system, tree id change, isParent flips), delete with
+children or bound pods, and default filling (parent -> root, tree id
+inherited, shared weight <- max)."""
+
+import pytest
+
+from koordinator_tpu.api.crds import ElasticQuota
+from koordinator_tpu.manager.quota_webhook import (
+    DEFAULT_QUOTA,
+    ROOT_QUOTA,
+    SYSTEM_QUOTA,
+    QuotaTopologyValidator,
+)
+
+
+def eq(name, parent=ROOT_QUOTA, min=None, max=None, is_parent=False,
+       tree_id="", **kw):
+    return ElasticQuota(
+        name=name, parent=parent, min=min or {}, max=max or {},
+        is_parent=is_parent, tree_id=tree_id, **kw)
+
+
+def admitted(v, quota, **kw):
+    errs = v.validate_add(quota, **kw)
+    assert errs == [], errs
+
+
+class TestSelfItem:
+    def test_min_greater_than_max_rejected(self):
+        v = QuotaTopologyValidator()
+        errs = v.validate_add(eq("a", min={"cpu": 10}, max={"cpu": 5}))
+        assert any("min 10 > max 5" in e for e in errs)
+
+    def test_min_key_not_in_max_rejected(self):
+        v = QuotaTopologyValidator()
+        errs = v.validate_add(eq("a", min={"cpu": 1}, max={"memory": 5}))
+        assert any("in min but not in max" in e for e in errs)
+
+    def test_negative_values_rejected(self):
+        v = QuotaTopologyValidator()
+        errs = v.validate_add(eq("a", min={"cpu": -1}, max={"cpu": -2}))
+        assert len([e for e in errs if "< 0" in e]) == 2
+
+    def test_max_below_used_rejected_on_update(self):
+        v = QuotaTopologyValidator()
+        admitted(v, eq("a", min={"cpu": 1}, max={"cpu": 10}))
+        v.set_used("a", {"cpu": 8})
+        errs = v.validate_update(eq("a", min={"cpu": 1}, max={"cpu": 5}))
+        assert any("max 5 < used 8" in e for e in errs)
+
+
+class TestTopology:
+    def test_parent_must_exist(self):
+        v = QuotaTopologyValidator()
+        errs = v.validate_add(
+            eq("child", parent="nope", max={"cpu": 1}))
+        assert any("does not exist" in e for e in errs)
+
+    def test_parent_must_be_parent_quota(self):
+        v = QuotaTopologyValidator()
+        admitted(v, eq("leafy", max={"cpu": 10}))  # is_parent=False
+        errs = v.validate_add(eq("child", parent="leafy", max={"cpu": 1}))
+        assert any("isParent is false" in e for e in errs)
+
+    def test_sibling_min_sum_capped_by_parent_min(self):
+        v = QuotaTopologyValidator()
+        admitted(v, eq("p", is_parent=True,
+                       min={"cpu": 10}, max={"cpu": 20}))
+        admitted(v, eq("c1", parent="p", min={"cpu": 6}, max={"cpu": 20}))
+        errs = v.validate_add(
+            eq("c2", parent="p", min={"cpu": 6}, max={"cpu": 20}))
+        assert any("siblings' min > parent min" in e for e in errs)
+        # a fitting sibling is admitted
+        admitted(v, eq("c3", parent="p", min={"cpu": 4}, max={"cpu": 20}))
+
+    def test_children_min_sum_caps_parent_shrink(self):
+        v = QuotaTopologyValidator()
+        admitted(v, eq("p", is_parent=True,
+                       min={"cpu": 10}, max={"cpu": 20}))
+        admitted(v, eq("c1", parent="p", min={"cpu": 8}, max={"cpu": 20}))
+        errs = v.validate_update(
+            eq("p", is_parent=True, min={"cpu": 4}, max={"cpu": 20}))
+        assert any("children's min > quota min" in e for e in errs)
+
+    def test_max_keys_must_match_parent(self):
+        v = QuotaTopologyValidator()
+        admitted(v, eq("p", is_parent=True,
+                       min={"cpu": 5}, max={"cpu": 10, "memory": 10}))
+        errs = v.validate_add(eq("c", parent="p", max={"cpu": 5}))
+        assert any("max keys are not the same" in e for e in errs)
+        # with the update-resource-key gate, included keys are enough
+        v2 = QuotaTopologyValidator(enable_update_resource_key=True)
+        admitted(v2, eq("p", is_parent=True,
+                        min={"cpu": 5}, max={"cpu": 10, "memory": 10}))
+        admitted(v2, eq("c", parent="p", max={"cpu": 5}))
+
+    def test_tree_id_must_match_parent(self):
+        v = QuotaTopologyValidator()
+        admitted(v, eq("p", is_parent=True, max={"cpu": 10},
+                       tree_id="t1"))
+        errs = v.validate_add(
+            eq("c", parent="p", max={"cpu": 10}, tree_id="t2"))
+        assert any("tree id differs from parent" in e for e in errs)
+
+    def test_leaf_under_root_skips_structural_checks(self):
+        v = QuotaTopologyValidator()
+        admitted(v, eq("solo", min={"cpu": 1}, max={"cpu": 2}))
+
+
+class TestForbiddenUpdates:
+    def test_system_and_root_immutable(self):
+        v = QuotaTopologyValidator()
+        assert v.validate_update(eq(SYSTEM_QUOTA, max={"cpu": 1}))
+        assert v.validate_update(eq(ROOT_QUOTA))
+
+    def test_tree_id_change_rejected(self):
+        v = QuotaTopologyValidator()
+        admitted(v, eq("a", max={"cpu": 1}, tree_id="t1"))
+        errs = v.validate_update(eq("a", max={"cpu": 1}, tree_id="t2"))
+        assert any("tree id changed" in e for e in errs)
+
+    def test_is_parent_false_with_children_rejected(self):
+        v = QuotaTopologyValidator()
+        admitted(v, eq("p", is_parent=True, max={"cpu": 10}))
+        admitted(v, eq("c", parent="p", max={"cpu": 10}))
+        errs = v.validate_update(eq("p", is_parent=False, max={"cpu": 10}))
+        assert any("isParent cannot become false" in e for e in errs)
+
+    def test_is_parent_true_with_pods_rejected(self):
+        v = QuotaTopologyValidator(has_pods_fn=lambda name: name == "a")
+        admitted(v, eq("a", max={"cpu": 10}))
+        errs = v.validate_update(eq("a", is_parent=True, max={"cpu": 10}))
+        assert any("isParent cannot become true" in e for e in errs)
+
+    def test_noop_update_admitted(self):
+        v = QuotaTopologyValidator()
+        q = eq("a", max={"cpu": 1})
+        admitted(v, q)
+        assert v.validate_update(q) == []
+
+
+class TestDelete:
+    def test_reserved_names_not_deletable(self):
+        v = QuotaTopologyValidator()
+        for name in (ROOT_QUOTA, SYSTEM_QUOTA, DEFAULT_QUOTA):
+            assert v.validate_delete(name)
+
+    def test_delete_with_children_rejected(self):
+        v = QuotaTopologyValidator()
+        admitted(v, eq("p", is_parent=True, max={"cpu": 10}))
+        admitted(v, eq("c", parent="p", max={"cpu": 10}))
+        errs = v.validate_delete("p")
+        assert any("child quotas" in e for e in errs)
+        assert v.validate_delete("c") == []
+        assert v.validate_delete("p") == []  # children gone now
+
+    def test_delete_with_pods_rejected(self):
+        pods = {"a"}
+        v = QuotaTopologyValidator(has_pods_fn=lambda n: n in pods)
+        admitted(v, eq("a", max={"cpu": 10}))
+        errs = v.validate_delete("a")
+        assert any("bound pods" in e for e in errs)
+        pods.clear()
+        assert v.validate_delete("a") == []
+
+
+class TestNamespaceBinding:
+    def test_namespace_conflict_rejected(self):
+        v = QuotaTopologyValidator()
+        admitted(v, eq("a", max={"cpu": 1}), namespaces=["team-a"])
+        errs = v.validate_add(
+            eq("b", max={"cpu": 1}), namespaces=["team-a"])
+        assert any("already bound to quota a" in e for e in errs)
+        # the owning quota may keep its own namespace on update
+        assert v.validate_update(
+            eq("a", max={"cpu": 2}), namespaces=["team-a"]) == []
+
+
+class TestFillDefaults:
+    def test_fills_parent_shared_weight_and_tree_id(self):
+        v = QuotaTopologyValidator()
+        admitted(v, eq("p", is_parent=True, max={"cpu": 10},
+                       tree_id="t9"))
+        raw = ElasticQuota(name="c", parent="p", max={"cpu": 5})
+        filled = v.fill_defaults(raw)
+        assert filled.tree_id == "t9"
+        assert dict(filled.shared_weight) == {"cpu": 5}
+        orphan = ElasticQuota(name="x", parent="ghost", max={})
+        with pytest.raises(ValueError, match="parent not exist"):
+            v.fill_defaults(orphan)
+
+    def test_empty_parent_defaults_to_root(self):
+        v = QuotaTopologyValidator()
+        filled = v.fill_defaults(ElasticQuota(name="c", parent="",
+                                              max={"cpu": 5}))
+        assert filled.parent == ROOT_QUOTA
+
+
+class TestGuarantee:
+    def test_min_below_guaranteed_used_rejected(self):
+        # a leaf directly under root skips structural checks (reference
+        # quota_topology_check.go:107), so guarantee only binds on nested
+        # quotas
+        v = QuotaTopologyValidator(guarantee_usage=True)
+        admitted(v, eq("p", is_parent=True,
+                       min={"cpu": 20}, max={"cpu": 40}))
+        admitted(v, eq("a", parent="p", min={"cpu": 10}, max={"cpu": 40},
+                       guarantee_usage=True))
+        v.set_used("a", {"cpu": 8})
+        errs = v.validate_update(
+            eq("a", parent="p", min={"cpu": 5}, max={"cpu": 40},
+               guarantee_usage=True))
+        assert any("guaranteed used" in e for e in errs)
+        # shrinking while staying above used is fine
+        assert v.validate_update(
+            eq("a", parent="p", min={"cpu": 9}, max={"cpu": 40},
+               guarantee_usage=True)) == []
