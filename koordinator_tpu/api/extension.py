@@ -47,6 +47,9 @@ LABEL_RESERVATION_IGNORED = f"{SCHEDULING_DOMAIN}/reservation-ignored"
 
 # Node-level (apis/extension/node_resource_amplification.go, cpu_normalization.go)
 ANNOTATION_NODE_AMPLIFICATION = f"{NODE_DOMAIN}/resource-amplification-ratio"
+#: kubelet-reported allocatable saved by the node mutating webhook before
+#: amplification overwrites it (AnnotationNodeRawAllocatable)
+ANNOTATION_NODE_RAW_ALLOCATABLE = f"{NODE_DOMAIN}/raw-allocatable"
 ANNOTATION_CPU_NORMALIZATION = f"{NODE_DOMAIN}/cpu-normalization-ratio"
 ANNOTATION_NODE_RESERVATION = f"{NODE_DOMAIN}/reservation"
 LABEL_CPU_BIND_POLICY = f"{NODE_DOMAIN}/cpu-bind-policy"

@@ -161,6 +161,10 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
     from koordinator_tpu.manager.recommendation import (
         RecommendationController,
     )
+    from koordinator_tpu.manager.node_webhook import (
+        NodeMutatingWebhook,
+        NodeValidatingWebhook,
+    )
     from koordinator_tpu.manager.quota_webhook import QuotaTopologyValidator
     from koordinator_tpu.manager.webhook import (
         MultiQuotaTreeAffinity,
@@ -176,6 +180,8 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
         noderesource=NodeResourceController(),
         pod_mutating=PodMutatingWebhook(),
         pod_validating=PodValidatingWebhook(),
+        node_mutating=NodeMutatingWebhook(),
+        node_validating=NodeValidatingWebhook(),
         quota_validating=QuotaTopologyValidator(
             enable_update_resource_key=SCHEDULER_GATES.enabled(
                 "ElasticQuotaEnableUpdateResourceKey"),

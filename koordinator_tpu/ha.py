@@ -52,6 +52,9 @@ class InMemoryLeaseStore:
     """Compare-and-swap lease store; ``expect_holder`` mismatches fail the
     update the way a stale resourceVersion fails a Lease PUT."""
 
+    #: in-process contenders share the process's monotonic clock
+    preferred_clock = staticmethod(time.monotonic)
+
     def __init__(self) -> None:
         self._leases: dict[str, LeaseRecord] = {}
         self._lock = threading.Lock()
@@ -77,6 +80,15 @@ class LeaderElector:
     Call :meth:`tick` on the component's cadence (or :meth:`run` in a
     thread): it acquires the lease when free/expired, renews while leading,
     and demotes itself if a renew fails or another holder appears.
+
+    Clock domains: lease timestamps are compared across ALL contenders, so
+    every process contending one lease must share a clock domain.  The
+    default clock is taken from the store's ``preferred_clock``
+    (``time.monotonic`` for the in-process store; ``time.time`` wall clock
+    for :class:`RemoteLeaseStore`, whose contenders live in different
+    processes where each process's monotonic epoch is meaningless).  An
+    explicit ``clock=`` argument always wins — but passing a per-process
+    monotonic clock with a cross-process store invites split-brain.
     """
 
     def __init__(
@@ -89,8 +101,10 @@ class LeaderElector:
         on_started_leading: Optional[Callable[[], None]] = None,
         on_stopped_leading: Optional[Callable[[], None]] = None,
         on_new_leader: Optional[Callable[[str], None]] = None,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Optional[Callable[[], float]] = None,
     ):
+        if clock is None:
+            clock = getattr(store, "preferred_clock", time.monotonic)
         self.store = store
         self.lease_name = lease_name
         self.identity = identity
@@ -184,3 +198,109 @@ def leader_gated(elector: Optional[LeaderElector],
     if elector is not None and not elector.tick():
         return None
     return fn(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Cross-process leases over the wire transport
+# ---------------------------------------------------------------------------
+#
+# The reference's Leases are apiserver objects precisely so that two
+# scheduler PROCESSES on different hosts can contend one lock.  Here the
+# state server plays the apiserver role: it owns the authoritative
+# InMemoryLeaseStore and serves LEASE_GET / LEASE_UPDATE frames; each
+# contender runs a LeaderElector over a RemoteLeaseStore.  CAS atomicity
+# lives server-side (one store, one lock), exactly like a Lease PUT with a
+# resourceVersion precondition.
+
+
+def _record_doc(name: str, expect_holder: str, rec: LeaseRecord) -> dict:
+    return {
+        "name": name, "expect_holder": expect_holder,
+        "holder": rec.holder,
+        "duration_seconds": float(rec.duration_seconds),
+        "acquire_time": float(rec.acquire_time),
+        "renew_time": float(rec.renew_time),
+        "transitions": int(rec.transitions),
+    }
+
+
+class LeaseService:
+    """Server side: expose a LeaseStore on the framed transport
+    (cmd/koord-manager/main.go --leader-elect-resource-lock=leases)."""
+
+    def __init__(self, store: Optional[LeaseStore] = None):
+        self.store: LeaseStore = store or InMemoryLeaseStore()
+
+    def attach(self, server) -> None:
+        from koordinator_tpu.transport.wire import FrameType
+
+        server.register(FrameType.LEASE_GET, self._get)
+        server.register(FrameType.LEASE_UPDATE, self._update)
+
+    def _get(self, doc: dict, arrays):
+        rec = self.store.get(doc["name"])
+        out = _record_doc(doc["name"], "", rec)
+        out.pop("expect_holder")
+        return out, None
+
+    def _update(self, doc: dict, arrays):
+        rec = LeaseRecord(
+            holder=doc["holder"],
+            duration_seconds=float(doc["duration_seconds"]),
+            acquire_time=float(doc["acquire_time"]),
+            renew_time=float(doc["renew_time"]),
+            transitions=int(doc["transitions"]),
+        )
+        ok = self.store.update(doc["name"], doc["expect_holder"], rec)
+        return {"ok": bool(ok)}, None
+
+
+class RemoteLeaseStore:
+    """Client-side LeaseStore over an RpcClient.
+
+    Failure posture is fail-closed for leadership: a transport error on
+    ``update`` returns False (a leader that cannot renew demotes itself —
+    client-go's renew-deadline behavior), and on ``get`` returns an empty
+    record, which is safe because acquiring still requires a successful
+    CAS against the server-side store.
+    """
+
+    #: contenders are separate PROCESSES: they must evaluate lease expiry
+    #: on a shared clock, and a per-process monotonic epoch is not one —
+    #: a host up 30 days would see every other host's renews as ancient
+    #: and steal a live lease (split-brain).  Wall clock is the same
+    #: domain the reference's apiserver Lease timestamps live in.
+    preferred_clock = staticmethod(time.time)
+
+    def __init__(self, client):
+        self.client = client
+
+    def get(self, name: str) -> LeaseRecord:
+        from koordinator_tpu.transport.channel import RpcError
+        from koordinator_tpu.transport.wire import FrameType
+
+        try:
+            _, doc, _ = self.client.call(
+                FrameType.LEASE_GET, {"name": name})
+        except RpcError:
+            return LeaseRecord()
+        return LeaseRecord(
+            holder=doc.get("holder", ""),
+            duration_seconds=float(doc.get("duration_seconds", 15.0)),
+            acquire_time=float(doc.get("acquire_time", 0.0)),
+            renew_time=float(doc.get("renew_time", 0.0)),
+            transitions=int(doc.get("transitions", 0)),
+        )
+
+    def update(self, name: str, expect_holder: str,
+               record: LeaseRecord) -> bool:
+        from koordinator_tpu.transport.channel import RpcError
+        from koordinator_tpu.transport.wire import FrameType
+
+        try:
+            _, doc, _ = self.client.call(
+                FrameType.LEASE_UPDATE,
+                _record_doc(name, expect_holder, record))
+        except RpcError:
+            return False
+        return bool(doc.get("ok"))

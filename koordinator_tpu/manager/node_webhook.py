@@ -1,0 +1,201 @@
+"""Node admission webhooks: resource amplification + slo-config conflict.
+
+The reference runs mutating and validating webhooks on Node objects
+(`pkg/webhook/node/mutating/mutating_handler.go`,
+`node/plugins/resourceamplification/resource_amplification.go`,
+`node/plugins/sloconfig/slo_plugin.go`).  The amplification plugin is the
+admission-time ENFORCEMENT point for the amplification math that the
+manager computes (manager/noderesource.py ``amplify_capacity``): kubelet's
+raw allocatable is preserved in an annotation and the amplified values are
+written into the node's allocatable at admission, so every consumer of the
+Node object sees amplified capacity without racing the controller.
+
+Node documents here are plain dicts —
+``{"name", "labels": {}, "annotations": {}, "allocatable": {"cpu": m,
+"memory": bytes}}`` — the same dialect the pod webhooks use.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Mapping, Optional
+
+from koordinator_tpu.api import extension as ext
+
+#: only cpu and memory amplify (resource_amplification.go:55)
+SUPPORTED_RESOURCES = ("cpu", "memory")
+
+
+def _annotations(node: dict) -> dict:
+    return node.setdefault("annotations", {})
+
+
+def _get_ratios(annotations: Mapping[str, str]) -> dict[str, float]:
+    """Amplification ratios as direct multipliers (>= 1; e.g. 1.5 = +50%
+    capacity, matching the reference's float ratio annotation); raises
+    ValueError on a malformed annotation (the validating side rejects
+    these)."""
+    raw = annotations.get(ext.ANNOTATION_NODE_AMPLIFICATION, "")
+    if not raw:
+        return {}
+    data = json.loads(raw)  # ValueError on bad JSON
+    if not isinstance(data, dict):
+        raise ValueError("amplification ratio must be a JSON object")
+    out = {}
+    for key, val in data.items():
+        if not isinstance(val, (int, float)) or isinstance(val, bool):
+            raise ValueError(f"amplification ratio {key} must be a number")
+        if val < 1:
+            raise ValueError(
+                f"amplification ratio {key}={val} must be >= 1")
+        out[key] = val
+    return out
+
+
+class NodeResourceAmplificationPlugin:
+    """Mutating: maintain raw allocatable + write amplified capacity
+    (resource_amplification.go:93 handleUpdate)."""
+
+    name = "NodeResourceAmplificationPlugin"
+
+    def admit(self, node: dict, old_node: Optional[dict],
+              operation: str = "UPDATE") -> None:
+        if operation == "CREATE":
+            return
+        ann = _annotations(node)
+        if not ann.get(ext.ANNOTATION_NODE_AMPLIFICATION):
+            # feature turned off: restore kubelet's raw allocatable BEFORE
+            # dropping the saved copy — in this dialect nothing else
+            # rewrites allocatable, so popping alone would leave amplified
+            # capacity on the node forever (and discard the only baseline)
+            raw_saved = ann.pop(ext.ANNOTATION_NODE_RAW_ALLOCATABLE, None)
+            if raw_saved and node.get("allocatable"):
+                try:
+                    original = json.loads(raw_saved)
+                except json.JSONDecodeError:
+                    return
+                for resource in SUPPORTED_RESOURCES:
+                    if resource in original:
+                        node["allocatable"][resource] = original[resource]
+            return
+        alloc = node.get("allocatable")
+        if not alloc:
+            return
+        ratios = _get_ratios(ann)  # propagates ValueError to the handler
+
+        # save/refresh kubelet's raw values when absent or when kubelet
+        # changed them (only kubelet overwrites native allocatable fields)
+        raw_saved = ann.get(ext.ANNOTATION_NODE_RAW_ALLOCATABLE)
+        if raw_saved is None or self._kubelet_changed(node, old_node):
+            original = {r: alloc[r] for r in SUPPORTED_RESOURCES
+                        if r in alloc}
+            if original:
+                ann[ext.ANNOTATION_NODE_RAW_ALLOCATABLE] = json.dumps(
+                    original, sort_keys=True)
+        else:
+            try:
+                original = json.loads(raw_saved)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"bad raw-allocatable annotation: {e}")
+
+        # allocatable = raw * ratio, per supported dim with ratio > 1;
+        # missing raw dims stay untouched (resource_amplification.go:145)
+        for resource in SUPPORTED_RESOURCES:
+            ratio = ratios.get(resource)
+            if ratio is None or ratio <= 1:
+                continue
+            value = original.get(resource)
+            if value is None:
+                continue
+            alloc[resource] = int(value * ratio)
+
+    @staticmethod
+    def _kubelet_changed(node: dict, old_node: Optional[dict]) -> bool:
+        if old_node is None:
+            return False
+        old_alloc = old_node.get("allocatable") or {}
+        new_alloc = node.get("allocatable") or {}
+        return any(old_alloc.get(r) != new_alloc.get(r)
+                   for r in SUPPORTED_RESOURCES)
+
+
+class NodeMutatingWebhook:
+    """Mutating handler: run the amplification plugin, return errors
+    (non-empty = deny, matching the reference's errored admission)."""
+
+    def __init__(self) -> None:
+        self.plugins = [NodeResourceAmplificationPlugin()]
+
+    def mutate(self, node: dict, old_node: Optional[dict] = None,
+               operation: str = "UPDATE") -> list[str]:
+        errors = []
+        for plugin in self.plugins:
+            try:
+                plugin.admit(node, old_node, operation)
+            except ValueError as e:
+                errors.append(f"{plugin.name}: {e}")
+        return errors
+
+
+class SLOConfigConflictPlugin:
+    """Validating: a node's labels must not select conflicting node-level
+    strategy overrides in the slo-controller ConfigMap
+    (slo_plugin.go:70 checkConflict).  Conflict = the node matches more
+    than one nodeStrategy of the same config key — merge order would then
+    be ambiguous for this node."""
+
+    name = "SLOControllerConfigConflict"
+
+    def __init__(self, config_data_fn=None):
+        #: returns the live slo-controller ConfigMap data ({} when absent);
+        #: absence skips the check (the reference logs and admits)
+        self.config_data_fn = config_data_fn or (lambda: {})
+
+    def validate(self, node: dict, old_node: Optional[dict],
+                 operation: str = "UPDATE") -> list[str]:
+        if operation == "UPDATE" and old_node is not None \
+                and node.get("labels") == old_node.get("labels"):
+            return []
+        config = self.config_data_fn() or {}
+        labels = node.get("labels") or {}
+        errors = []
+        for key, raw in config.items():
+            try:
+                parsed = json.loads(raw)
+            except (json.JSONDecodeError, TypeError):
+                continue  # CM validation rejects these elsewhere
+            if not isinstance(parsed, dict):
+                continue
+            strategies = parsed.get("nodeStrategies")
+            if not isinstance(strategies, list):
+                continue
+            matched = []
+            for i, strat in enumerate(strategies):
+                sel = (strat.get("nodeSelector") or {}).get(
+                    "matchLabels", {})
+                if sel and all(labels.get(k) == v
+                               for k, v in sel.items()):
+                    matched.append(strat.get("name", f"strategy[{i}]"))
+            if len(matched) > 1:
+                errors.append(
+                    f"{key}: node {node.get('name', '?')} matches "
+                    f"conflicting node strategies {matched}")
+        return errors
+
+
+class NodeValidatingWebhook:
+    """Validating handler: amplification annotation sanity + slo-config
+    conflicts.  Returns error strings (empty = admit)."""
+
+    def __init__(self, config_data_fn=None):
+        self.slo_plugin = SLOConfigConflictPlugin(config_data_fn)
+
+    def validate(self, node: dict, old_node: Optional[dict] = None,
+                 operation: str = "UPDATE") -> list[str]:
+        errors = []
+        try:
+            _get_ratios(node.get("annotations") or {})
+        except ValueError as e:
+            errors.append(f"amplification: {e}")
+        errors += self.slo_plugin.validate(node, old_node, operation)
+        return errors

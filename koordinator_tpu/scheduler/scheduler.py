@@ -192,6 +192,10 @@ class Scheduler:
         #: at bind; annotation payloads surface in resource_status
         self.cpu_manager = cpu_manager
         self.device_manager = device_manager
+        #: per-node vendor device-plugin lock annotations (the node-object
+        #: annotation in the reference; vendors' plugins clear it via
+        #: clear_device_node_lock when they finish a pod)
+        self._device_node_locks: dict[str, dict[str, str]] = {}
         self.resource_status: dict[str, dict] = {}
         #: quota overuse revoke controller (enable_overuse_revoke)
         self.overuse_revoke = None
@@ -1025,8 +1029,56 @@ class Scheduler:
                     status["device-allocated"] = (
                         self.device_manager.device_allocated_annotation(
                             node, pod.name))
+                    self._adapt_device_plugin(pod, node, status)
         if status:
             self.resource_status[pod.name] = status
+
+    def _adapt_device_plugin(self, pod: PodSpec, node: str,
+                             status: dict) -> None:
+        """DevicePluginAdaption gate: translate the allocation into vendor
+        device-plugin annotations (device_plugin_adapter.go:100).  The
+        reference fails PreBind on an adapt error; this seam is documented
+        degrade-not-fail (see _allocate_fine_grained), so an inexpressible
+        allocation records the error on the status instead and skips the
+        vendor dialect — operators see it, the bind proceeds unpinned."""
+        from koordinator_tpu.features import SCHEDULER_GATES
+
+        if not SCHEDULER_GATES.enabled("DevicePluginAdaption"):
+            return
+        from koordinator_tpu.scheduler import device_plugin_adapter as dpa
+
+        spec = self.snapshot.node_specs.get(node)
+        node_labels = spec.labels if spec is not None else {}
+        locks = self._device_node_locks.setdefault(node, {})
+        try:
+            # the adapter's default wall clock, NOT self.clock: the
+            # annotations are UnixNano timestamps consumed by EXTERNAL
+            # vendor plugins comparing against time.Now() — a monotonic
+            # scheduler clock would stamp the year 1970
+            res = dpa.adapt_for_device_plugin(
+                status["device-allocated"],
+                gpu_vendor=node_labels.get(dpa.LABEL_GPU_VENDOR, ""),
+                gpu_model=node_labels.get(dpa.LABEL_GPU_MODEL, ""),
+                pod_labels=pod.labels,
+                node_annotations=locks,
+            )
+        except dpa.AdaptError as e:
+            status["device-plugin"] = {"error": str(e)}
+            return
+        if dpa.LABEL_HAMI_VGPU_NODE in res.pod_labels:
+            res.pod_labels[dpa.LABEL_HAMI_VGPU_NODE] = node
+        locks.update(res.node_annotations)
+        status["device-plugin"] = {
+            "annotations": res.pod_annotations,
+            "labels": res.pod_labels,
+            "node_annotations": dict(res.node_annotations),
+        }
+
+    def clear_device_node_lock(self, node: str, key: str) -> None:
+        """The vendor device plugin finished a pod and removed its node
+        lock annotation (device_plugin_adapter.go: 'will automatically
+        remove it after allocation of a pod')."""
+        self._device_node_locks.get(node, {}).pop(key, None)
 
     def _release_fine_grained(self, pod_name: str, node: str) -> None:
         if self.cpu_manager is not None:

@@ -29,9 +29,11 @@ import numpy as np
 from koordinator_tpu.transport.wire import (
     Frame,
     FrameType,
+    WireSchemaError,
     decode_payload,
     encode_payload,
     read_frame,
+    validate_doc,
 )
 
 Handler = Callable[[dict, dict[str, np.ndarray]],
@@ -128,12 +130,20 @@ class _ConnHandler(socketserver.BaseRequestHandler):
                     continue
                 try:
                     doc, arrays = decode_payload(frame.payload)
+                    # typed request schemas: version/shape skew between
+                    # peers fails loud here, not deep inside a handler
+                    validate_doc(frame.type, doc)
                     out_doc, out_arrays = handler(doc, arrays)
                     rtype = FrameType(out_doc.pop(
                         "__type__", int(_RESPONSE_TYPE.get(
                             frame.type, FrameType.ACK))))
                     conn.send(Frame(rtype, frame.request_id,
                                     encode_payload(out_doc, out_arrays)))
+                except WireSchemaError as e:
+                    conn.send(Frame(FrameType.ERROR, frame.request_id,
+                                    encode_payload(
+                                        {"message": str(e),
+                                         "schema": True})))
                 except Exception as e:  # handler bug: fail the call, not conn
                     conn.send(Frame(FrameType.ERROR, frame.request_id,
                                     encode_payload({"message": repr(e)})))

@@ -29,6 +29,7 @@ from typing import Optional
 
 import numpy as np
 
+from koordinator_tpu.transport import wire
 from koordinator_tpu.transport.wire import FrameType
 
 NODE_UPSERT = "node_upsert"
@@ -228,6 +229,13 @@ class StateSyncService:
         return doc, arrays
 
     def _handle_hello(self, doc: dict, arrays):
+        # protocol negotiation: reject message-protocol skew loud instead
+        # of mis-decoding frames later (api.proto's versioned-contract role)
+        peer_proto = int(doc.get("proto", 1))
+        if peer_proto != wire.PROTOCOL_VERSION:
+            raise wire.WireSchemaError(
+                f"incompatible message protocol: peer {peer_proto}, "
+                f"local {wire.PROTOCOL_VERSION}")
         last_rv = int(doc.get("last_rv", -1))
         with self._lock:
             if last_rv == self.rv:
@@ -281,7 +289,8 @@ class StateSyncClient:
             self._buffer = []
         try:
             ftype, doc, arrays = client.call(
-                FrameType.HELLO, {"last_rv": self.rv})
+                FrameType.HELLO,
+                {"last_rv": self.rv, "proto": wire.PROTOCOL_VERSION})
             with self._lock:
                 n = 0
                 if ftype is not FrameType.ACK:

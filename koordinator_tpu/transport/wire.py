@@ -19,6 +19,21 @@ the raw section, referenced from ``doc["__arrays__"]`` manifest entries
 resource tensors) moves as raw little-endian bytes, not text. This is the
 same split gRPC+proto gives the reference: tiny schema-ed control data,
 binary tensors.
+
+Two version numbers govern the wire:
+
+- ``VERSION`` (header byte) is the FRAMING version — header layout +
+  payload packing.  A mismatch is unrecoverable and fails at read_frame.
+- ``PROTOCOL_VERSION`` is the MESSAGE protocol — the set of frame types
+  and their document schemas (the role ``apis/runtime/v1alpha1/api.proto``
+  plays for the reference).  It is negotiated in HELLO: a client
+  advertises its protocol and the server rejects skew with an ERROR
+  instead of silently mis-decoding (history: v1 ad-hoc docs; v2 adds
+  typed REQUEST_SCHEMAS, the ``proto`` field in HELLO, and lease frames).
+
+``REQUEST_SCHEMAS`` types each schema'd frame's json document;
+``validate_doc`` is enforced server-side on every request frame, so a
+peer built against a different protocol fails loud at the boundary.
 """
 
 from __future__ import annotations
@@ -32,12 +47,13 @@ import numpy as np
 
 MAGIC = 0x4B54
 VERSION = 1
+PROTOCOL_VERSION = 2
 _HEADER = struct.Struct("<HBBII")
 MAX_PAYLOAD = 256 << 20  # 256 MiB guard against corrupt length words
 
 
 class FrameType(enum.IntEnum):
-    HELLO = 1           # client: {last_rv}; server replies SNAPSHOT or ACK
+    HELLO = 1           # client: {last_rv, proto}; reply SNAPSHOT or ACK
     SNAPSHOT = 2        # full state dump @ rv
     DELTA = 3           # incremental changes (rv-ordered)
     ACK = 4             # generic ok, {rv} for sync acks
@@ -47,6 +63,74 @@ class FrameType(enum.IntEnum):
     HOOK_REQUEST = 8    # runtime hook dispatch (api.proto:148 shapes)
     HOOK_RESPONSE = 9
     PING = 10
+    LEASE_GET = 11      # {name} -> lease record fields
+    LEASE_UPDATE = 12   # CAS write: {name, expect_holder, <record>} -> {ok}
+
+
+class WireSchemaError(ValueError):
+    """A request document does not match its frame's schema — the loud
+    failure mode for protocol skew between peers."""
+
+
+#: REQUEST document schemas: field -> (allowed type(s), required).
+#: Unknown extra fields are allowed (minor additions stay compatible);
+#: a missing required field or a type mismatch is a WireSchemaError.
+REQUEST_SCHEMAS: dict[FrameType, dict[str, tuple]] = {
+    FrameType.HELLO: {
+        "last_rv": (int, True),
+        "proto": (int, True),
+    },
+    FrameType.SOLVE_REQUEST: {},
+    FrameType.HOOK_REQUEST: {
+        "hook": (str, True),
+        "pod_meta": (dict, False),
+        "container_meta": (dict, False),
+        "labels": (dict, False),
+        "annotations": (dict, False),
+        "cgroup_parent": (str, False),
+        "resources": (dict, False),
+        "envs": (dict, False),
+    },
+    FrameType.LEASE_GET: {
+        "name": (str, True),
+    },
+    FrameType.LEASE_UPDATE: {
+        "name": (str, True),
+        "expect_holder": (str, True),
+        "holder": (str, True),
+        "duration_seconds": ((int, float), True),
+        "acquire_time": ((int, float), True),
+        "renew_time": ((int, float), True),
+        "transitions": (int, True),
+    },
+}
+
+
+def validate_doc(ftype: FrameType, doc: dict) -> None:
+    """Check a request document against REQUEST_SCHEMAS (no-op for
+    unschema'd frame types)."""
+    schema = REQUEST_SCHEMAS.get(ftype)
+    if schema is None:
+        return
+    for field, (types, required) in schema.items():
+        if field not in doc:
+            if required:
+                raise WireSchemaError(
+                    f"{ftype.name}: missing required field {field!r} "
+                    f"(peer protocol skew? local proto="
+                    f"{PROTOCOL_VERSION})")
+            continue
+        val = doc[field]
+        # bool is an int subclass; never accept it for numeric fields
+        if isinstance(val, bool) and bool not in (
+                types if isinstance(types, tuple) else (types,)):
+            raise WireSchemaError(
+                f"{ftype.name}: field {field!r} has bool value where "
+                f"{types} expected")
+        if not isinstance(val, types):
+            raise WireSchemaError(
+                f"{ftype.name}: field {field!r} has type "
+                f"{type(val).__name__}, expected {types}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -402,3 +402,137 @@ class TestKoordletDeviceReporting:
             assert len(reports) == 2
         finally:
             KOORDLET_GATES.set("Accelerators", False)
+
+
+class TestDevicePluginAdapter:
+    """DevicePluginAdaption gate (device_plugin_adapter.go): translate the
+    repo's device-allocated payload into vendor device-plugin dialects."""
+
+    GiB_MiB = 1024  # 1 GiB in the MiB units device tensors use
+
+    def _alloc(self, minors=(0,), core=100, memory=None):
+        memory = self.GiB_MiB if memory is None else memory
+        return {"gpu": [
+            {"minor": m, "resources": {"core": core, "memory": memory}}
+            for m in minors
+        ]}
+
+    def test_general_adapter_bind_timestamp_and_minors(self):
+        from koordinator_tpu.scheduler.device_plugin_adapter import (
+            ANNOTATION_BIND_TIMESTAMP,
+            ANNOTATION_GPU_MINORS,
+            adapt_for_device_plugin,
+        )
+
+        res = adapt_for_device_plugin(
+            self._alloc(minors=(1, 3)), clock=lambda: 12.0)
+        assert res.pod_annotations[ANNOTATION_BIND_TIMESTAMP] == str(
+            int(12.0 * 1e9))
+        assert res.pod_annotations[ANNOTATION_GPU_MINORS] == "1,3"
+        assert not res.node_annotations
+
+    def test_huawei_npu_dialects(self):
+        from koordinator_tpu.scheduler.device_plugin_adapter import (
+            ANNOTATION_HUAWEI_ASCEND_310P,
+            ANNOTATION_HUAWEI_NPU_CORE,
+            ANNOTATION_PREDICATE_TIME,
+            adapt_for_device_plugin,
+        )
+
+        res = adapt_for_device_plugin(
+            self._alloc(minors=(2,)), gpu_vendor="huawei")
+        assert res.pod_annotations[ANNOTATION_HUAWEI_NPU_CORE] == "2"
+        assert ANNOTATION_PREDICATE_TIME in res.pod_annotations
+        # vNPU template
+        alloc = self._alloc(minors=(2,))
+        alloc["gpu"][0]["template"] = "vir04"
+        res = adapt_for_device_plugin(alloc, gpu_vendor="huawei")
+        assert res.pod_annotations[ANNOTATION_HUAWEI_NPU_CORE] == "2-vir04"
+        # Ascend 310P model prefixes minors
+        res = adapt_for_device_plugin(
+            self._alloc(minors=(0, 1)), gpu_vendor="huawei",
+            gpu_model="Ascend-310P3-300I-DUO")
+        assert res.pod_annotations[ANNOTATION_HUAWEI_ASCEND_310P] == \
+            "Ascend310P-0,Ascend310P-1"
+
+    def test_cambricon_profile_and_node_lock(self):
+        from koordinator_tpu.scheduler.device_plugin_adapter import (
+            ANNOTATION_CAMBRICON_ASSIGNED,
+            ANNOTATION_CAMBRICON_LOCK,
+            ANNOTATION_CAMBRICON_PROFILE,
+            AdaptError,
+            adapt_for_device_plugin,
+        )
+
+        res = adapt_for_device_plugin(
+            self._alloc(minors=(1,), core=50, memory=2 * self.GiB_MiB),
+            gpu_vendor="cambricon", clock=lambda: 100.0)
+        assert res.pod_annotations[ANNOTATION_CAMBRICON_ASSIGNED] == "false"
+        # 2 GiB / 256 MiB = 8 vmemory units
+        assert res.pod_annotations[ANNOTATION_CAMBRICON_PROFILE] == "1_50_8"
+        assert ANNOTATION_CAMBRICON_LOCK in res.node_annotations
+        # multi-device share is not expressible
+        with pytest.raises(AdaptError, match="multiple gpu share"):
+            adapt_for_device_plugin(
+                self._alloc(minors=(0, 1)), gpu_vendor="cambricon")
+        # a held, fresh node lock rejects the bind
+        with pytest.raises(AdaptError, match="lock"):
+            adapt_for_device_plugin(
+                self._alloc(minors=(1,), memory=2 * self.GiB_MiB),
+                gpu_vendor="cambricon", clock=lambda: 130.0,
+                node_annotations=dict(res.node_annotations))
+        # ...but a stale one (> 5 min) is overwritten
+        res2 = adapt_for_device_plugin(
+            self._alloc(minors=(1,), memory=2 * self.GiB_MiB),
+            gpu_vendor="cambricon", clock=lambda: 100.0 + 301.0,
+            node_annotations=dict(res.node_annotations))
+        assert ANNOTATION_CAMBRICON_LOCK in res2.node_annotations
+
+    def test_metax_json_and_units(self):
+        from koordinator_tpu.scheduler.device_plugin_adapter import (
+            ANNOTATION_HAMI_LOCK,
+            ANNOTATION_METAX_ALLOCATED,
+            adapt_for_device_plugin,
+        )
+
+        res = adapt_for_device_plugin(
+            self._alloc(minors=(0,), core=25, memory=512),
+            gpu_vendor="metax")
+        data = json.loads(res.pod_annotations[ANNOTATION_METAX_ALLOCATED])
+        assert data == [[{"uuid": "0", "compute": 25, "vRam": 512}]]
+        assert ANNOTATION_HAMI_LOCK in res.node_annotations
+
+    def test_scheduler_bind_path_behind_gate(self):
+        import numpy as np
+
+        from koordinator_tpu.features import SCHEDULER_GATES
+        from koordinator_tpu.api.resources import ResourceDim
+        from koordinator_tpu.scheduler.device_manager import DeviceManager
+        from koordinator_tpu.scheduler.device_plugin_adapter import (
+            ANNOTATION_GPU_MINORS,
+            LABEL_GPU_VENDOR,
+        )
+        from tests.test_scheduler import mk_scheduler, node, pod
+
+        dm = DeviceManager()
+        dm.register_node_devices("gpu", "n1", [
+            {"core": 100, "memory": 4 * self.GiB_MiB, "group": 0},
+        ])
+        n1 = node("n1", labels={LABEL_GPU_VENDOR: "huawei"})
+        n1.allocatable[ResourceDim.GPU] = 800
+        n1.allocatable[ResourceDim.GPU_MEMORY] = 8 * self.GiB_MiB
+        sched, binds = mk_scheduler([n1], device_manager=dm)
+        p = pod("g", cpu=1_000)
+        p.requests[ResourceDim.GPU] = 100
+        p.requests[ResourceDim.GPU_MEMORY] = self.GiB_MiB
+        old = SCHEDULER_GATES.enabled("DevicePluginAdaption")
+        try:
+            SCHEDULER_GATES.set("DevicePluginAdaption", True)
+            sched.enqueue(p)
+            res = sched.schedule_round()
+            assert res.assignments == {"g": "n1"}
+            dp = sched.resource_status["g"]["device-plugin"]
+            assert ANNOTATION_GPU_MINORS in dp["annotations"]
+            assert "huawei.com/npu-core" in dp["annotations"]
+        finally:
+            SCHEDULER_GATES.set("DevicePluginAdaption", old)

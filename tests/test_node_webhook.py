@@ -1,0 +1,123 @@
+"""Node admission webhooks (manager/node_webhook.py).
+
+Mirrors the reference's resource_amplification_test.go behaviors: raw
+allocatable saved on first amplified update, amplified capacity written at
+admission, kubelet changes refresh the raw baseline, feature-off cleans
+the annotation; plus the slo-config conflict check from slo_plugin_test.go
+and the validating-side rejection of malformed amplification annotations.
+"""
+
+import json
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.manager.node_webhook import (
+    NodeMutatingWebhook,
+    NodeValidatingWebhook,
+)
+
+AMP = ext.ANNOTATION_NODE_AMPLIFICATION
+RAW = ext.ANNOTATION_NODE_RAW_ALLOCATABLE
+
+
+def node(cpu=4000, memory=8192, ratios=None, annotations=None, labels=None):
+    ann = dict(annotations or {})
+    if ratios is not None:
+        ann[AMP] = json.dumps(ratios)
+    return {
+        "name": "n1", "labels": labels or {}, "annotations": ann,
+        "allocatable": {"cpu": cpu, "memory": memory},
+    }
+
+
+class TestAmplificationMutating:
+    def test_amplifies_and_saves_raw_on_first_update(self):
+        wh = NodeMutatingWebhook()
+        n = node(cpu=4000, memory=8192, ratios={"cpu": 2.0})
+        assert wh.mutate(n, old_node=node()) == []
+        assert n["allocatable"]["cpu"] == 8000
+        assert n["allocatable"]["memory"] == 8192  # no memory ratio
+        raw = json.loads(n["annotations"][RAW])
+        assert raw == {"cpu": 4000, "memory": 8192}
+
+    def test_reamplify_uses_saved_raw_not_amplified(self):
+        wh = NodeMutatingWebhook()
+        n = node(cpu=4000, ratios={"cpu": 2.0})
+        wh.mutate(n, old_node=node())
+        # a second admission with unchanged kubelet values must NOT
+        # compound: 4000*2, not 8000*2
+        n2 = dict(n, allocatable=dict(n["allocatable"]))
+        old = dict(n, allocatable=dict(n["allocatable"]))
+        wh.mutate(n2, old_node=old)
+        assert n2["allocatable"]["cpu"] == 8000
+
+    def test_kubelet_change_refreshes_raw(self):
+        wh = NodeMutatingWebhook()
+        n = node(cpu=4000, ratios={"cpu": 2.0})
+        wh.mutate(n, old_node=node())
+        # kubelet reduces allocatable (reserved resources changed)
+        n3 = dict(n, allocatable={"cpu": 3000, "memory": 8192})
+        wh.mutate(n3, old_node=n)
+        assert json.loads(n3["annotations"][RAW])["cpu"] == 3000
+        assert n3["allocatable"]["cpu"] == 6000
+
+    def test_feature_off_restores_raw_and_cleans_annotation(self):
+        wh = NodeMutatingWebhook()
+        n = node(cpu=4000, ratios={"cpu": 2.0})
+        wh.mutate(n, old_node=node())
+        assert RAW in n["annotations"]
+        assert n["allocatable"]["cpu"] == 8000
+        del n["annotations"][AMP]
+        wh.mutate(n, old_node=None)
+        assert RAW not in n["annotations"]
+        # kubelet's baseline comes back — amplified capacity must not
+        # outlive the feature
+        assert n["allocatable"]["cpu"] == 4000
+
+    def test_ratio_at_most_one_is_skipped(self):
+        wh = NodeMutatingWebhook()
+        n = node(cpu=4000, ratios={"cpu": 1.0})
+        assert wh.mutate(n, old_node=node()) == []
+        assert n["allocatable"]["cpu"] == 4000
+
+    def test_create_is_untouched(self):
+        wh = NodeMutatingWebhook()
+        n = node(cpu=4000, ratios={"cpu": 2.0})
+        assert wh.mutate(n, operation="CREATE") == []
+        assert n["allocatable"]["cpu"] == 4000
+
+    def test_bad_annotation_errors(self):
+        wh = NodeMutatingWebhook()
+        n = node(annotations={AMP: "not json"})
+        errs = wh.mutate(n, old_node=node())
+        assert errs and "NodeResourceAmplification" in errs[0]
+
+
+class TestValidating:
+    def test_bad_amplification_rejected(self):
+        wh = NodeValidatingWebhook()
+        for bad in ("not json", json.dumps({"cpu": 0.5}),
+                    json.dumps({"cpu": "two"}), json.dumps([2])):
+            errs = wh.validate(node(annotations={AMP: bad}))
+            assert errs, bad
+        assert wh.validate(node(ratios={"cpu": 1.5})) == []
+
+    def test_slo_config_conflict_rejected(self):
+        config = {
+            "colocation-config": json.dumps({
+                "nodeStrategies": [
+                    {"name": "a", "nodeSelector":
+                        {"matchLabels": {"pool": "x"}}},
+                    {"name": "b", "nodeSelector":
+                        {"matchLabels": {"pool": "x", "zone": "1"}}},
+                ],
+            }),
+        }
+        wh = NodeValidatingWebhook(config_data_fn=lambda: config)
+        bad = node(labels={"pool": "x", "zone": "1"})
+        errs = wh.validate(bad, old_node=node(labels={}))
+        assert errs and "conflicting node strategies" in errs[0]
+        # a node matching one strategy is fine
+        ok = node(labels={"pool": "x"})
+        assert wh.validate(ok, old_node=node(labels={})) == []
+        # unchanged labels skip the check entirely
+        assert wh.validate(bad, old_node=bad) == []

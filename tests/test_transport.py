@@ -222,7 +222,10 @@ def test_sync_replay_overlap_is_idempotent(rpc):
     rev = sched._pending_rev
     # a duplicated HELLO (e.g. overlap between push and replay) re-sends
     # everything; the rv guard must drop it without touching the queue
-    ftype, doc, arrays = client.call(FrameType.HELLO, {"last_rv": 0})
+    from koordinator_tpu.transport.wire import PROTOCOL_VERSION
+
+    ftype, doc, arrays = client.call(
+        FrameType.HELLO, {"last_rv": 0, "proto": PROTOCOL_VERSION})
     assert ftype is FrameType.DELTA
     sync._apply(doc, arrays)
     assert sync.skipped >= 1

@@ -1,0 +1,224 @@
+"""Wire protocol v2: typed request schemas + lease frames + cross-process
+leader election.
+
+- REQUEST_SCHEMAS / validate_doc make peer skew fail loud at the server
+  boundary (VERDICT r2 item 10 — the api.proto versioned-contract role);
+- LEASE_GET/LEASE_UPDATE + RemoteLeaseStore let two scheduler PROCESSES
+  contend one lease over the transport (VERDICT r2 item 6); the failover
+  test kill -9s the leading process and the standby must take over.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from koordinator_tpu.ha import (
+    InMemoryLeaseStore,
+    LeaderElector,
+    LeaseRecord,
+    LeaseService,
+    RemoteLeaseStore,
+)
+from koordinator_tpu.transport.channel import RpcClient, RpcError, RpcServer
+from koordinator_tpu.transport.wire import (
+    PROTOCOL_VERSION,
+    FrameType,
+    WireSchemaError,
+    validate_doc,
+)
+
+
+class TestSchemas:
+    def test_missing_required_field_raises(self):
+        with pytest.raises(WireSchemaError, match="last_rv"):
+            validate_doc(FrameType.HELLO, {"proto": PROTOCOL_VERSION})
+
+    def test_wrong_type_raises(self):
+        with pytest.raises(WireSchemaError, match="name"):
+            validate_doc(FrameType.LEASE_GET, {"name": 7})
+
+    def test_bool_not_accepted_as_int(self):
+        with pytest.raises(WireSchemaError, match="bool"):
+            validate_doc(
+                FrameType.HELLO, {"last_rv": True,
+                                  "proto": PROTOCOL_VERSION})
+
+    def test_extra_fields_allowed(self):
+        validate_doc(FrameType.HELLO, {
+            "last_rv": 3, "proto": PROTOCOL_VERSION, "future": "field"})
+
+    def test_unschemad_types_pass(self):
+        validate_doc(FrameType.DELTA, {"anything": object()})
+
+
+def _server(tmp_path, name="lease.sock"):
+    path = str(tmp_path / name)
+    server = RpcServer(path)
+    svc = LeaseService()
+    svc.attach(server)
+    server.start()
+    return path, server, svc
+
+
+class TestLeaseFrames:
+    def test_remote_get_update_roundtrip(self, tmp_path):
+        path, server, svc = _server(tmp_path)
+        try:
+            client = RpcClient(path)
+            client.connect()
+            store = RemoteLeaseStore(client)
+            assert store.get("sched").holder == ""
+            rec = LeaseRecord(holder="a", duration_seconds=2.0,
+                              acquire_time=1.0, renew_time=1.0,
+                              transitions=1)
+            assert store.update("sched", "", rec)
+            got = store.get("sched")
+            assert got.holder == "a" and got.transitions == 1
+            # CAS: stale expect_holder fails
+            assert not store.update(
+                "sched", "b", LeaseRecord(holder="b"))
+            client.close()
+        finally:
+            server.stop()
+
+    def test_schema_violation_surfaces_as_rpc_error(self, tmp_path):
+        path, server, svc = _server(tmp_path)
+        try:
+            client = RpcClient(path)
+            client.connect()
+            with pytest.raises(RpcError, match="missing required field"):
+                client.call(FrameType.LEASE_GET, {})
+            # connection survives a schema error: next call works
+            assert RemoteLeaseStore(client).get("x").holder == ""
+            client.close()
+        finally:
+            server.stop()
+
+    def test_old_protocol_hello_rejected(self, tmp_path):
+        from koordinator_tpu.transport.deltasync import StateSyncService
+
+        path = str(tmp_path / "sync.sock")
+        server = RpcServer(path)
+        sync = StateSyncService()
+        sync.attach(server)
+        server.start()
+        try:
+            client = RpcClient(path)
+            client.connect()
+            # a v1 peer omits "proto": the schema rejects it loudly
+            with pytest.raises(RpcError, match="proto"):
+                client.call(FrameType.HELLO, {"last_rv": -1})
+            # a mismatched advertised protocol is also rejected
+            with pytest.raises(RpcError, match="incompatible"):
+                client.call(FrameType.HELLO,
+                            {"last_rv": -1, "proto": 99})
+            client.close()
+        finally:
+            server.stop()
+
+    def test_two_electors_one_leader_in_process(self, tmp_path):
+        path, server, svc = _server(tmp_path)
+        try:
+            clients = [RpcClient(path), RpcClient(path)]
+            for c in clients:
+                c.connect()
+            now = [100.0]
+            electors = [
+                LeaderElector(RemoteLeaseStore(c), "sched", ident,
+                              lease_duration=5.0,
+                              clock=lambda: now[0])
+                for c, ident in zip(clients, ("a", "b"))
+            ]
+            leads = [e.tick() for e in electors]
+            assert leads.count(True) == 1
+            # holder crashes (no release); follower waits out the lease
+            now[0] += 6.0
+            standby = electors[leads.index(False)]
+            assert standby.tick()
+            for c in clients:
+                c.close()
+        finally:
+            server.stop()
+
+
+CONTENDER = textwrap.dedent("""
+    import sys, time
+    sock, ident, status = sys.argv[1], sys.argv[2], sys.argv[3]
+    from koordinator_tpu.ha import LeaderElector, RemoteLeaseStore
+    from koordinator_tpu.transport.channel import RpcClient
+
+    client = RpcClient(sock)
+    client.connect()
+    # wall clock: cross-process contenders must share a clock domain
+    elector = LeaderElector(RemoteLeaseStore(client), "sched", ident,
+                            lease_duration=1.0, clock=time.time)
+    rounds = 0
+    while True:
+        if elector.tick():
+            rounds += 1
+            with open(status, "a") as f:
+                f.write(f"ROUND {ident} {rounds}\\n")
+        time.sleep(0.05)
+""")
+
+
+def test_cross_process_failover_kill9(tmp_path):
+    """kill -9 the leading scheduler process; the standby must acquire the
+    lease and run rounds (cmd/koord-manager/main.go Leases semantics)."""
+    path, server, svc = _server(tmp_path, "failover.sock")
+    script = tmp_path / "contender.py"
+    script.write_text(CONTENDER)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    status = {i: tmp_path / f"status-{i}" for i in ("a", "b")}
+    for f in status.values():
+        f.write_text("")
+    procs = {}
+    try:
+        for ident in ("a", "b"):
+            procs[ident] = subprocess.Popen(
+                [sys.executable, str(script), path, ident,
+                 str(status[ident])],
+                env=env, cwd=repo_root,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+        def leader_now():
+            return svc.store.get("sched").holder
+
+        deadline = time.time() + 60
+        while time.time() < deadline and not leader_now():
+            time.sleep(0.05)
+        first = leader_now()
+        assert first in ("a", "b"), "no process acquired the lease"
+        # the leader actually runs rounds
+        deadline = time.time() + 30
+        while time.time() < deadline and not status[first].read_text():
+            time.sleep(0.05)
+        assert f"ROUND {first}" in status[first].read_text()
+
+        procs[first].kill()          # SIGKILL: no voluntary release
+        procs[first].wait(timeout=10)
+        other = "b" if first == "a" else "a"
+        # standby must wait out the 1s lease, then take over and schedule
+        deadline = time.time() + 60
+        while time.time() < deadline and leader_now() != other:
+            time.sleep(0.05)
+        assert leader_now() == other, "standby never acquired the lease"
+        before = status[other].read_text()
+        deadline = time.time() + 30
+        while (time.time() < deadline
+               and f"ROUND {other}" not in status[other].read_text()):
+            time.sleep(0.05)
+        assert f"ROUND {other}" in status[other].read_text(), \
+            "standby leads but runs no rounds"
+        del before
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        server.stop()
