@@ -181,7 +181,7 @@ def batch_assign(
     k: int = 32,
     rounds: int = 12,
     fused_topk: bool = False,
-    spread_bits: int = 5,
+    spread_bits=(5, 15),
     method: str = "auto",
 ):
     """Assign a pending batch in data-parallel propose/accept rounds.
@@ -190,11 +190,14 @@ def batch_assign(
     new_quota).  assignments is (P,) int32, -1 = unassigned.
 
     ``spread_bits`` controls the candidate-diversity/score trade-off (see
-    ``_ranked_scores``): 0 ranks by exact score (candidate sets collapse at
-    scale), the default buckets scores by 32 so the per-pod rotation fans
-    the queue over every near-best node — measured at 2k nodes x 10k pods:
-    100% of a schedulable queue assigned vs 22% at spread_bits=0, with mean
-    chosen-node score matching the exact sequential greedy.
+    ``select_candidates``): an int ranks all k candidates by one quantized
+    key; the default STRATIFIED ``(5, 15)`` splits k between a
+    score-faithful stratum (buckets of 32 — measured at or above exact
+    greedy's mean chosen score at 2k nodes x 10k pods) and a pure-rotation
+    coverage stratum, because a single sb=5 key strands 14% of a fully
+    schedulable 50k-pod queue at 10,240 nodes once the top score band
+    fills (see PERF_NOTES.md round-3 sweeps: sb=5 86.4% assigned,
+    stratified and deep-spread variants 100%).
 
     ``method`` picks the candidate-selection strategy (CANDIDATE_METHODS);
     every method is force-selectable on every backend so CI can cover the
@@ -214,12 +217,26 @@ def select_candidates(
     cfg: ScoringConfig,
     k: int = 32,
     fused_topk: bool = False,
-    spread_bits: int = 5,
+    spread_bits=(5, 15),
     method: str = "auto",
 ):
     """(cand_key, cand_node), each (P, k): the candidate-selection stage of
     ``batch_assign``, exposed separately so profiling can time it apart
-    from the propose/accept rounds.  See CANDIDATE_METHODS."""
+    from the propose/accept rounds.  See CANDIDATE_METHODS.
+
+    ``spread_bits`` may be an int (one quantization depth) or a tuple of
+    depths — STRATIFIED selection: k splits evenly across the strata, each
+    stratum picks its share by its own quantized ranking key, and the
+    first stratum's key orders all candidates inside the rounds.  The
+    default ``(5, 15)`` pairs a score-faithful stratum (buckets of 32 —
+    best placement quality; measured above exact greedy's mean chosen
+    score at 2k nodes) with a pure-rotation coverage stratum (score-free
+    consecutive-window candidates) — at the 50k x 10,240 north-star shape
+    a single sb=5 key strands 14% of a fully-schedulable queue when the
+    top score band fills, while the coverage stratum guarantees every pod
+    k/2 uniformly-spread fallbacks (measured: 100% assigned).  Duplicate
+    nodes between strata just idle a slot.  Scoring runs ONCE regardless
+    of strata count; only the cheap top-k reduction repeats."""
     if method not in CANDIDATE_METHODS:
         raise ValueError(f"unknown candidate method {method!r}; "
                          f"one of {CANDIDATE_METHODS}")
@@ -227,6 +244,8 @@ def select_candidates(
         method = "fused"
     if method == "auto":
         method = "approx" if jax.default_backend() == "tpu" else "exact"
+    strata = (spread_bits if isinstance(spread_bits, (tuple, list))
+              else (spread_bits,))
     if method == "fused":
         if pods.selector_mask is None:
             raise ValueError("fused candidate selection needs a factored "
@@ -236,37 +255,57 @@ def select_candidates(
 
         return fused_score_topk(
             state, pods, cfg, k=min(k, state.capacity),
-            spread_bits=spread_bits,
+            spread_bits=strata,
             interpret=jax.default_backend() != "tpu")
     scores, feasible = score_pods(state, pods, cfg)
-    key = _ranked_scores(scores, feasible, spread_bits)
-    k = min(k, key.shape[1])
-    if method == "approx" and k < key.shape[1]:
-        # TPU-optimized partial reduction. approx_max_k needs a float key
-        # exact within float32's 24-bit mantissa, so candidates are chosen
-        # by the quantized score plus as many HIGH bits of the rotated
-        # tie-break as fit (high bits keep the closest-after-rotation
-        # ordering that fans pods out; low bits would scramble it); the
-        # exact int keys are then gathered for in-round ordering.
-        # Candidate RECALL is approximate (~recall_target on TPU; the CPU
-        # lowering of approx_max_k is exact, so CPU recall loss comes only
-        # from the float-key quantization).  Acceptance still enforces fit
-        # and quota exactly.
-        score_bits = (30 - _TB_BITS) - spread_bits   # quantized field width
-        shift = min(_TB_BITS, 24 - score_bits)
-        fkey = jnp.where(
-            key >= 0,
-            ((key >> _TB_BITS) << shift
-             | (key & ((1 << _TB_BITS) - 1)) >> (_TB_BITS - shift)
-             ).astype(jnp.float32),
-            -1.0)
-        _, cand_node = jax.lax.approx_max_k(
-            fkey, k, recall_target=0.95, aggregate_to_topk=True)
-        cand_node = cand_node.astype(jnp.int32)
-        cand_key = jnp.take_along_axis(key, cand_node, axis=1)
-    else:
-        cand_key, cand_node = jax.lax.top_k(key, k)    # (P, k)
+    k = min(k, scores.shape[1])
+    order_key = _ranked_scores(scores, feasible, strata[0])
+    splits = _stratum_splits(k, len(strata))
+    nodes = []
+    for sb, k_i in zip(strata, splits):
+        if k_i == 0:
+            continue
+        key = (order_key if sb == strata[0]
+               else _ranked_scores(scores, feasible, sb))
+        if method == "approx" and k_i < key.shape[1]:
+            # TPU-optimized partial reduction. approx_max_k needs a float
+            # key exact within float32's 24-bit mantissa, so candidates
+            # are chosen by the quantized score plus as many HIGH bits of
+            # the rotated tie-break as fit (high bits keep the
+            # closest-after-rotation ordering that fans pods out; low
+            # bits would scramble it); the exact int keys are then
+            # gathered for in-round ordering.  Candidate RECALL is
+            # approximate (~recall_target on TPU; the CPU lowering of
+            # approx_max_k is exact, so CPU recall loss comes only from
+            # the float-key quantization).  Acceptance still enforces fit
+            # and quota exactly.
+            score_bits = (30 - _TB_BITS) - sb   # quantized field width
+            shift = min(_TB_BITS, max(24 - score_bits, 0))
+            fkey = jnp.where(
+                key >= 0,
+                ((key >> _TB_BITS) << shift
+                 | (key & ((1 << _TB_BITS) - 1)) >> (_TB_BITS - shift)
+                 ).astype(jnp.float32),
+                -1.0)
+            _, idx = jax.lax.approx_max_k(
+                fkey, k_i, recall_target=0.95, aggregate_to_topk=True)
+            nodes.append(idx.astype(jnp.int32))
+        else:
+            _, idx = jax.lax.top_k(key, k_i)
+            nodes.append(idx)
+    cand_node = jnp.concatenate(nodes, axis=1) if len(nodes) > 1 else nodes[0]
+    # the first stratum's key orders every candidate in the rounds, so a
+    # coverage-stratum node competes on the same score scale (gathering
+    # also yields -1 for infeasible slots of short candidate lists)
+    cand_key = jnp.take_along_axis(order_key, cand_node, axis=1)
     return cand_key, cand_node
+
+
+def _stratum_splits(k: int, n: int) -> list[int]:
+    """Split k as evenly as possible over n strata (first strata get the
+    remainder)."""
+    base, rem = divmod(k, n)
+    return [base + (1 if i < rem else 0) for i in range(n)]
 
 
 def _assign_rounds(state, pods, quota, cand_key, cand_node, rounds):

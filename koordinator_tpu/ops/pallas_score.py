@@ -86,13 +86,15 @@ def _score_bucket_kernel(
     scalars_ref,     # (1, 4) int32: [dominant_w, la_plugin_w, fp_plugin_w,
                      #               scarce_plugin_w]
     # outputs — bucket accumulators; the s grid axis is innermost, so all
-    # revisits of one output block are consecutive (Pallas accumulation)
-    out_val_ref,     # (TP, NC) int32 block of the (TP, L) bucket maxima
-    out_idx_ref,     # (TP, NC) int32 block of the winning node indices
-    *,
+    # revisits of one output block are consecutive (Pallas accumulation).
+    # Stratum 0 owns (val, idx); every further stratum owns
+    # (sel, ord, idx): selected by its own key, carrying the stratum-0
+    # ORDER key of the winning node so the rounds rank all candidates on
+    # one scale (*out_refs order: val0, idx0, sel1, ord1, idx1, ...)
+    *out_refs,
     n_chunk: int,
     r_dims: int,
-    spread_bits: int,
+    spread_bits: tuple,
 ):
     tp = podreq_ref.shape[1]
     tile = pl.program_id(0)
@@ -214,24 +216,37 @@ def _score_bucket_kernel(
     feasible = (fits & thr_ok & sel_ok & nvalid[None, :]
                 & pod_valid[:, None])
 
-    # ranked key (_ranked_scores): score high bits | rotated tie-break
+    # ranked keys (_ranked_scores), one per stratum: score high bits |
+    # rotated tie-break; scores are computed once above
     node_idx = c0 + jax.lax.broadcasted_iota(
         jnp.int32, (tp, n_chunk), 1)                      # (TP, NC)
     tb = (n - 1) - ((node_idx - rot) % n)
-    q = jnp.clip(scores, 0, _SCORE_CLIP) >> spread_bits
-    key = (q << _TB_BITS) | tb
-    key = jnp.where(feasible, key, -1)
+    clipped = jnp.clip(scores, 0, _SCORE_CLIP)
+    keys = []
+    for sb in spread_bits:
+        key = ((clipped >> sb) << _TB_BITS) | tb
+        keys.append(jnp.where(feasible, key, -1))
 
     # bucket fold: strictly-greater keeps the earlier (lower-index) node —
     # keys are unique per pod, so ties never actually occur and the result
     # is bit-exact with lax.top_k whenever L >= N.  s == 0 is the first
     # visit to this output block and initializes the accumulator.
     first = s == 0
-    cur_val = jnp.where(first, -1, out_val_ref[:, :])
-    cur_idx = jnp.where(first, 0, out_idx_ref[:, :])
-    taken = key > cur_val
-    out_val_ref[:, :] = jnp.maximum(key, cur_val)
-    out_idx_ref[:, :] = jnp.where(taken, node_idx, cur_idx)
+    cur_val = jnp.where(first, -1, out_refs[0][:, :])
+    cur_idx = jnp.where(first, 0, out_refs[1][:, :])
+    taken = keys[0] > cur_val
+    out_refs[0][:, :] = jnp.maximum(keys[0], cur_val)
+    out_refs[1][:, :] = jnp.where(taken, node_idx, cur_idx)
+    for i, key in enumerate(keys[1:]):
+        # strat_* names: do NOT shadow the sel_ref selector-mask input
+        strat_sel, strat_ord, strat_idx = out_refs[2 + 3 * i: 5 + 3 * i]
+        cur_sel = jnp.where(first, -1, strat_sel[:, :])
+        cur_ord = jnp.where(first, -1, strat_ord[:, :])
+        cur_idx = jnp.where(first, 0, strat_idx[:, :])
+        taken = key > cur_sel
+        strat_sel[:, :] = jnp.maximum(key, cur_sel)
+        strat_ord[:, :] = jnp.where(taken, keys[0], cur_ord)
+        strat_idx[:, :] = jnp.where(taken, node_idx, cur_idx)
 
 
 def fused_score_topk(
@@ -243,7 +258,7 @@ def fused_score_topk(
     n_chunk: int = 512,
     n_bucket: int | None = None,
     interpret: bool = False,
-    spread_bits: int = 0,
+    spread_bits=0,
 ):
     """(cand_key, cand_node) — streaming equivalent of
     ``lax.top_k(_ranked_scores(*score_pods(state, pods, cfg)), k)`` without
@@ -253,6 +268,11 @@ def fused_score_topk(
     L >= N, approximate-recall when L < N (see module docstring).  The
     default clamps ``4 * n_chunk`` to [k-coverage, N] — exact for every
     test-sized problem, 2048 buckets at the 10,240-node north star.
+
+    ``spread_bits`` may be a tuple of quantization depths — stratified
+    selection matching select_candidates: k splits across the strata,
+    each stratum folds by its own key, and all returned cand_key values
+    are on the FIRST stratum's scale.
     """
     from koordinator_tpu.ops import scoring
 
@@ -324,10 +344,14 @@ def fused_score_topk(
     node3 = lambda a: a.T.reshape(r, n_sub, n_bucket)
     nrow3 = lambda a: a.reshape(1, n_sub, n_bucket)
 
+    strata = tuple(spread_bits) if isinstance(
+        spread_bits, (tuple, list)) else (spread_bits,)
+    # stratum 0: (val, idx); each further stratum: (sel, ord, idx)
+    n_outs = 2 + 3 * (len(strata) - 1)
     kernel = functools.partial(
         _score_bucket_kernel, n_chunk=nc, r_dims=r,
-        spread_bits=spread_bits)
-    buck_val, buck_idx = pl.pallas_call(
+        spread_bits=strata)
+    outs = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -338,11 +362,9 @@ def fused_score_topk(
             cfg_spec((1, r)), cfg_spec((1, r)), cfg_spec((1, r)),
             cfg_spec((1, 4)),
         ],
-        out_specs=[out_spec, out_spec],
-        out_shape=[
-            jax.ShapeDtypeStruct((p_pad, n_bucket), jnp.int32),
-            jax.ShapeDtypeStruct((p_pad, n_bucket), jnp.int32),
-        ],
+        out_specs=[out_spec] * n_outs,
+        out_shape=[jax.ShapeDtypeStruct((p_pad, n_bucket), jnp.int32)
+                   ] * n_outs,
         interpret=interpret,
     )(
         pod_req.T, pod_est.T, pod_valid[None, :].astype(jnp.int32),
@@ -362,7 +384,26 @@ def fused_score_topk(
     # final per-pod top-k over the small (P, L) bucket arrays in plain XLA.
     # Bucket maxima carry unique keys (or -1), and bucket order under
     # lax.top_k ties only matters for -1 fills, whose idx is sanitized to 0.
-    cand_key, pos = jax.lax.top_k(buck_val[:p], k)
-    cand_node = jnp.take_along_axis(buck_idx[:p], pos, axis=1)
-    cand_node = jnp.where(cand_key < 0, 0, cand_node)
-    return cand_key, cand_node
+    from koordinator_tpu.ops.batch_assign import _stratum_splits
+
+    splits = _stratum_splits(k, len(strata))
+    keys_out, nodes_out = [], []
+    # stratum 0: val doubles as both selection and order key
+    ck, pos = jax.lax.top_k(outs[0][:p], splits[0])
+    cn = jnp.take_along_axis(outs[1][:p], pos, axis=1)
+    keys_out.append(ck)
+    nodes_out.append(jnp.where(ck < 0, 0, cn))
+    for i, k_i in enumerate(splits[1:]):
+        if k_i == 0:
+            continue
+        sel, ordk, idx = outs[2 + 3 * i: 5 + 3 * i]
+        sv, pos = jax.lax.top_k(sel[:p], k_i)
+        ck = jnp.take_along_axis(ordk[:p], pos, axis=1)
+        ck = jnp.where(sv < 0, -1, ck)
+        cn = jnp.take_along_axis(idx[:p], pos, axis=1)
+        keys_out.append(ck)
+        nodes_out.append(jnp.where(ck < 0, 0, cn))
+    if len(keys_out) == 1:
+        return keys_out[0], nodes_out[0]
+    return (jnp.concatenate(keys_out, axis=1),
+            jnp.concatenate(nodes_out, axis=1))

@@ -159,25 +159,59 @@ _RESPONSE_TYPE = {
 }
 
 
-class _Server(socketserver.ThreadingUnixStreamServer):
+class _UnixServer(socketserver.ThreadingUnixStreamServer):
     daemon_threads = True
     allow_reuse_address = True
 
 
+class _TcpServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def _parse_addr(addr: str):
+    """"tcp://host:port" -> ("tcp", (host, port)); anything else is a
+    unix-socket path.  IPv4 / hostnames only — an IPv6 literal would need
+    AF_INET6 plumbing the transport doesn't have, so reject it loudly
+    instead of failing later with an opaque gaierror."""
+    if addr.startswith("tcp://"):
+        host, _, port = addr[len("tcp://"):].rpartition(":")
+        if "[" in host or "]" in host:
+            raise ValueError(
+                f"IPv6 literals are not supported by the framed "
+                f"transport: {addr!r}")
+        return "tcp", (host or "127.0.0.1", int(port))
+    return "unix", addr
+
+
 class RpcServer:
-    """Unix-socket RPC server; one receive thread + one send thread per
-    connection."""
+    """Framed RPC server; one receive thread + one send thread per
+    connection.  ``path`` is a unix-socket path (same-host peers) or
+    ``tcp://host:port`` (cross-host control plane — the reference's
+    gRPC boundary listens on TCP the same way)."""
 
     def __init__(self, path: str):
         self.path = path
+        self.kind, target = _parse_addr(path)
         self.handlers: dict[FrameType, Handler] = {}
         self._conns: list[_Conn] = []
         self._conn_lock = threading.Lock()
-        if os.path.exists(path):
-            os.unlink(path)
-        self._server = _Server(path, _ConnHandler)
+        if self.kind == "unix":
+            if os.path.exists(target):
+                os.unlink(target)
+            self._server = _UnixServer(target, _ConnHandler)
+        else:
+            self._server = _TcpServer(target, _ConnHandler)
         self._server.rpc = self  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        """Resolved listen address (useful with tcp://host:0)."""
+        if self.kind == "unix":
+            return self.path
+        host, port = self._server.server_address[:2]
+        return f"tcp://{host}:{port}"
 
     def register(self, ftype: FrameType, handler: Handler) -> None:
         self.handlers[ftype] = handler
@@ -194,7 +228,7 @@ class RpcServer:
             conns = list(self._conns)
         for conn in conns:
             conn.close()
-        if os.path.exists(self.path):
+        if self.kind == "unix" and os.path.exists(self.path):
             os.unlink(self.path)
 
     # -- server push (watch-stream analog) ----------------------------------
@@ -242,8 +276,13 @@ class RpcClient:
         self.push_errors = 0
 
     def connect(self) -> None:
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.connect(self.path)
+        kind, target = _parse_addr(self.path)
+        if kind == "unix":
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.connect(target)
+        else:
+            sock = socket.create_connection(target)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
         self.connected = True
         self._reader = threading.Thread(target=self._read_loop, daemon=True)

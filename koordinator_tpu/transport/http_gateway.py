@@ -1,0 +1,208 @@
+"""HTTP/JSON gateway: the externally-speakable boundary of the sidecar.
+
+The north-star deployment has the reference's Go scheduler plugins calling
+into this framework as a sidecar (BASELINE.json: "the Go plugins calling
+into a Python sidecar via the existing framework.Plugin extension point").
+The framed unix/TCP transport (channel.py) is the efficient Python<->
+Python path; THIS module is the language-neutral one — plain HTTP + JSON,
+callable from Go's net/http (or curl) with no codegen and no client
+library, the interop role gRPC's JSON transcoding plays for the
+reference's api.proto surface.
+
+Routes (all JSON bodies/responses):
+
+    GET  /healthz                      -> {"ok": true}
+    GET  /version                      -> {"protocol": N}
+    POST /v1/solve                     -> one scheduling round
+    POST /v1/hooks/<HookType>          -> runtime-hook dispatch
+    GET  /v1/leases/<name>             -> lease record
+    PUT  /v1/leases/<name>             -> CAS update {ok}; 409 on conflict
+    GET  /v1/diagnosis                 -> last round's schedule diagnosis
+
+Handlers delegate to the same objects the framed services use
+(transport/services.py SolveService/HookService, ha.LeaseService's store),
+so both boundaries stay behaviorally identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from koordinator_tpu.transport.wire import PROTOCOL_VERSION
+
+
+class HttpGateway:
+    """Threaded HTTP server over the sidecar's services.
+
+    Any of ``scheduler``, ``dispatcher``, ``lease_store`` may be None —
+    the matching routes then answer 501, so a koordlet-only or
+    scheduler-only binary exposes exactly its own surface.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        scheduler=None,
+        dispatcher=None,
+        lease_store=None,
+    ):
+        self.scheduler = scheduler
+        self.dispatcher = dispatcher
+        self.lease_store = lease_store
+        gateway = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet: no stderr spam
+                pass
+
+            def _reply(self, code: int, doc: dict) -> None:
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length") or 0)
+                if not length:
+                    return {}
+                return json.loads(self.rfile.read(length).decode())
+
+            def do_GET(self):
+                try:
+                    gateway._route(self, "GET")
+                except Exception as e:  # route bug: fail the call
+                    self._reply(500, {"error": repr(e)})
+
+            def do_POST(self):
+                try:
+                    gateway._route(self, "POST")
+                except Exception as e:
+                    self._reply(500, {"error": repr(e)})
+
+            def do_PUT(self):
+                try:
+                    gateway._route(self, "PUT")
+                except Exception as e:
+                    self._reply(500, {"error": repr(e)})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- routing ------------------------------------------------------------
+
+    _LEASE = re.compile(r"^/v1/leases/([A-Za-z0-9._-]+)$")
+    _HOOK = re.compile(r"^/v1/hooks/([A-Za-z0-9._-]+)$")
+
+    def _route(self, req, method: str) -> None:
+        path = req.path.split("?", 1)[0]
+        if method == "GET" and path == "/healthz":
+            return req._reply(200, {"ok": True})
+        if method == "GET" and path == "/version":
+            return req._reply(200, {"protocol": PROTOCOL_VERSION})
+        if method == "POST" and path == "/v1/solve":
+            return self._solve(req)
+        if method == "GET" and path == "/v1/diagnosis":
+            return self._diagnosis(req)
+        m = self._HOOK.match(path)
+        if m and method == "POST":
+            return self._hook(req, m.group(1))
+        m = self._LEASE.match(path)
+        if m:
+            if method == "GET":
+                return self._lease_get(req, m.group(1))
+            if method == "PUT":
+                return self._lease_put(req, m.group(1))
+        req._reply(404, {"error": f"no route {method} {path}"})
+
+    def _solve(self, req) -> None:
+        if self.scheduler is None:
+            return req._reply(501, {"error": "no scheduler attached"})
+        result = self.scheduler.schedule_round()
+        req._reply(200, {
+            "assignments": dict(result.assignments),
+            "failures": {name: diag.message()
+                         for name, diag in result.failures.items()},
+            "nominations": {p: [n, v] for p, (n, v)
+                            in result.nominations.items()},
+            "round_pods": result.round_pods,
+        })
+
+    def _diagnosis(self, req) -> None:
+        if self.scheduler is None:
+            return req._reply(501, {"error": "no scheduler attached"})
+        result = getattr(self.scheduler, "last_result", None)
+        if result is None:
+            return req._reply(200, {"failures": {}})
+        req._reply(200, {
+            "failures": {name: diag.message()
+                         for name, diag in result.failures.items()},
+        })
+
+    def _hook(self, req, hook_name: str) -> None:
+        if self.dispatcher is None:
+            return req._reply(501, {"error": "no hook dispatcher attached"})
+        from koordinator_tpu.runtimeproxy import HookRequest, HookType
+
+        try:
+            hook = HookType(hook_name)
+        except ValueError:
+            return req._reply(400, {"error": f"unknown hook {hook_name}"})
+        doc = req._body()
+        request = HookRequest(
+            pod_meta=doc.get("pod_meta", {}),
+            container_meta=doc.get("container_meta", {}),
+            labels=doc.get("labels", {}),
+            annotations=doc.get("annotations", {}),
+            cgroup_parent=doc.get("cgroup_parent", ""),
+            resources=doc.get("resources", {}),
+            envs=doc.get("envs", {}),
+        )
+        merged = self.dispatcher.dispatch(hook, request)
+        req._reply(200, {
+            "labels": merged.labels,
+            "annotations": merged.annotations,
+            "cgroup_parent": merged.cgroup_parent,
+            "resources": merged.resources,
+            "envs": merged.envs,
+        })
+
+    def _lease_get(self, req, name: str) -> None:
+        if self.lease_store is None:
+            return req._reply(501, {"error": "no lease store attached"})
+        rec = self.lease_store.get(name)
+        req._reply(200, dataclasses.asdict(rec))
+
+    def _lease_put(self, req, name: str) -> None:
+        if self.lease_store is None:
+            return req._reply(501, {"error": "no lease store attached"})
+        from koordinator_tpu.ha import LeaseRecord
+
+        doc = req._body()
+        expect = doc.pop("expect_holder", "")
+        fields = {f.name for f in dataclasses.fields(LeaseRecord)}
+        rec = LeaseRecord(**{k: v for k, v in doc.items() if k in fields})
+        if self.lease_store.update(name, expect, rec):
+            return req._reply(200, {"ok": True})
+        req._reply(409, {"ok": False, "error": "holder mismatch"})

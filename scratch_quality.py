@@ -1,0 +1,43 @@
+"""CPU solve-quality check at the north-star shape (no TPU needed).
+
+Runs batch_assign at 50k pods x 10,240 nodes with the approx float-key
+candidate path FORCED (the TPU-serving branch; on CPU approx_max_k's
+lowering is exact, so this isolates the float-key quantization effect)
+and reports assigned counts per (k, spread_bits) variant.  Decides
+whether bench.py can flip to k=16 (measured 1.19x on hardware) without
+violating the solve_assigned_frac ~ 1.0 quality guard.
+"""
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from __graft_entry__ import _build_problem
+from koordinator_tpu.ops.batch_assign import batch_assign
+
+N_NODES, N_PODS = (10_240, 50_000) if len(sys.argv) < 2 else (
+    int(sys.argv[1]), int(sys.argv[2]))
+
+state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
+valid = int(np.asarray(pods.valid).sum())
+print(f"shape: {N_PODS} pods x {N_NODES} nodes, valid={valid}", flush=True)
+
+VARIANTS = [
+    ("k32_strat", dict(k=32, method="approx")),
+    ("k16_strat", dict(k=16, method="approx")),
+]
+for name, kw in VARIANTS:
+    t0 = time.perf_counter()
+    asn, st = jax.jit(
+        lambda s, kw=kw: batch_assign(s, pods, cfg, **kw)[:2])(state)
+    asn = np.asarray(asn)
+    n = int((asn >= 0).sum())
+    used = np.asarray(st.node_requested)
+    ok = bool((used <= np.asarray(st.node_allocatable)).all())
+    print(f"{name}: assigned {n}/{valid} ({n/valid:.4f})  "
+          f"capacity_ok={ok}  wall {time.perf_counter()-t0:.0f}s",
+          flush=True)

@@ -1,0 +1,39 @@
+"""Score-fidelity sweep: mean chosen-node score vs exact sequential greedy
+(the r2 protocol: 2,048 nodes x 10k pods, same contention ratio as the
+north star) across (k, spread_bits) — picks the quality-preserving default
+after the north-star-shape assigned-fraction sweep."""
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from __graft_entry__ import _build_problem
+from koordinator_tpu.ops.assignment import greedy_assign, score_pods
+from koordinator_tpu.ops.batch_assign import batch_assign
+
+N_NODES, N_PODS = 2_048, 10_000
+state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
+valid = int(np.asarray(pods.valid).sum())
+scores = np.asarray(jax.jit(lambda s: score_pods(s, pods, cfg)[0])(state))
+
+
+def report(name, asn):
+    asn = np.asarray(asn)
+    sel = asn >= 0
+    mean_score = float(scores[np.nonzero(sel)[0], asn[sel]].mean())
+    print(f"{name}: assigned {int(sel.sum())}/{valid} "
+          f"mean_chosen_score {mean_score:.1f}", flush=True)
+
+
+t0 = time.perf_counter()
+g_asn, _, _ = jax.jit(greedy_assign)(state, pods, cfg)
+report("greedy_exact", g_asn)
+print(f"  (greedy wall {time.perf_counter()-t0:.0f}s)", flush=True)
+
+for k, sb in [(32, (5, 15)), (16, (5, 15)), (32, 5)]:
+    asn, _ = jax.jit(lambda s, k=k, sb=sb: batch_assign(
+        s, pods, cfg, k=k, spread_bits=sb, method="approx")[:2])(state)
+    report(f"k{k}_sb{sb}", asn)

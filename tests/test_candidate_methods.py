@@ -108,3 +108,65 @@ def test_legacy_fused_topk_flag_is_fused_method():
     k1, n1 = select_candidates(state, pods, cfg, k=8, fused_topk=True)
     np.testing.assert_array_equal(np.asarray(k0), np.asarray(k1))
     np.testing.assert_array_equal(np.asarray(n0), np.asarray(n1))
+
+
+class TestStratifiedCandidates:
+    """spread_bits=(5, 15): score-faithful + coverage strata (the round-3
+    fix for candidate exhaustion at the north-star shape)."""
+
+    def test_split_math(self):
+        from koordinator_tpu.ops.batch_assign import _stratum_splits
+
+        assert _stratum_splits(32, 2) == [16, 16]
+        assert _stratum_splits(15, 2) == [8, 7]
+        assert _stratum_splits(8, 1) == [8]
+
+    def test_stratified_exact_candidate_structure(self):
+        state, pods, cfg = build_problem(n_nodes=128, n_pods=32, seed=7)
+        ck, cn = select_candidates(
+            state, pods, cfg, k=16, spread_bits=(5, 15), method="exact")
+        assert cn.shape == (pods.capacity, 16)
+        # first half = top-8 of the sb=5 key; second half = top-8 of the
+        # pure-rotation key; ALL keys reported on the sb=5 scale
+        k5, n5 = select_candidates(
+            state, pods, cfg, k=8, spread_bits=5, method="exact")
+        np.testing.assert_array_equal(np.asarray(cn)[:, :8],
+                                      np.asarray(n5))
+        np.testing.assert_array_equal(np.asarray(ck)[:, :8],
+                                      np.asarray(k5))
+        _, n15 = select_candidates(
+            state, pods, cfg, k=8, spread_bits=15, method="exact")
+        np.testing.assert_array_equal(np.asarray(cn)[:, 8:],
+                                      np.asarray(n15))
+
+    def test_stratified_fused_matches_exact_end_to_end(self):
+        state, pods, cfg = build_problem(n_nodes=64, n_pods=64, seed=8)
+        a0, s0, _ = batch_assign(state, pods, cfg, k=8, method="exact")
+        a1, s1, _ = batch_assign(state, pods, cfg, k=8, method="fused")
+        np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
+        np.testing.assert_array_equal(np.asarray(s0.node_requested),
+                                      np.asarray(s1.node_requested))
+
+    def test_coverage_stratum_rescues_exhausted_tail(self):
+        # the north-star stranding phenomenon at CI scale (3,072 nodes x
+        # 15k pods reproduces it in ~10s): diverse scores make the sb=5
+        # tie groups narrow, the whole queue's candidate sets concentrate
+        # on the top score band, and once it fills the tail's candidates
+        # are all full even though the cluster has 3.6x headroom.  The
+        # coverage stratum must assign the ENTIRE schedulable queue; the
+        # single-key run must visibly strand (the test discriminates).
+        from __graft_entry__ import _build_problem
+
+        n_nodes, n_pods = 3_072, 15_000
+        state, pods, cfg = _build_problem(n_nodes, n_pods, seed=42)
+        a_strat, _, _ = jax.jit(
+            lambda s: batch_assign(s, pods, cfg, k=16, method="approx"))(
+            state)[:3]
+        n_strat = int((np.asarray(a_strat) >= 0).sum())
+        assert n_strat == n_pods, f"stratified stranded {n_pods - n_strat}"
+        a_sb5, _, _ = jax.jit(
+            lambda s: batch_assign(s, pods, cfg, k=16, spread_bits=5,
+                                   method="approx"))(state)[:3]
+        n_sb5 = int((np.asarray(a_sb5) >= 0).sum())
+        assert n_sb5 < n_pods, "single-key run no longer strands; " \
+            "update this scenario so the coverage property stays tested"

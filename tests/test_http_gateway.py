@@ -1,0 +1,126 @@
+"""HTTP/JSON gateway + TCP framed transport (SURVEY §5 comm backend: the
+externally-speakable boundary — any language's HTTP client can drive the
+sidecar; the framed RPC also listens on TCP for cross-host control)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from koordinator_tpu.ha import InMemoryLeaseStore, LeaseService
+from koordinator_tpu.transport.channel import RpcClient, RpcServer
+from koordinator_tpu.transport.http_gateway import HttpGateway
+from koordinator_tpu.transport.wire import PROTOCOL_VERSION, FrameType
+
+from tests.test_scheduler import mk_scheduler, node, pod
+
+
+def _req(port, path, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=10) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+class TestHttpGateway:
+    def test_health_version_and_solve(self):
+        sched, binds = mk_scheduler([node("n1"), node("n2")])
+        gw = HttpGateway(scheduler=sched)
+        gw.start()
+        try:
+            assert _req(gw.port, "/healthz") == (200, {"ok": True})
+            assert _req(gw.port, "/version") == (
+                200, {"protocol": PROTOCOL_VERSION})
+            sched.enqueue(pod("p1", cpu=4_000))
+            status, doc = _req(gw.port, "/v1/solve", "POST", {})
+            assert status == 200
+            assert doc["assignments"]["p1"] in ("n1", "n2")
+            assert len(binds) == 1
+        finally:
+            gw.stop()
+
+    def test_hooks_route(self):
+        from koordinator_tpu.runtimeproxy import (
+            Dispatcher,
+            HookResponse,
+            HookType,
+        )
+
+        class Server:
+            def handle(self, hook, request):
+                return HookResponse(annotations={"seen": "yes"})
+
+        dispatcher = Dispatcher()
+        dispatcher.register(Server(), [HookType.PRE_RUN_POD_SANDBOX])
+        gw = HttpGateway(dispatcher=dispatcher)
+        gw.start()
+        try:
+            status, doc = _req(
+                gw.port, "/v1/hooks/PreRunPodSandbox", "POST",
+                {"pod_meta": {"name": "p"}, "labels": {}})
+            assert status == 200
+            assert doc["annotations"] == {"seen": "yes"}
+            try:
+                _req(gw.port, "/v1/hooks/NoSuchHook", "POST", {})
+                raise AssertionError("unknown hook must 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            gw.stop()
+
+    def test_lease_cas_over_http(self):
+        store = InMemoryLeaseStore()
+        gw = HttpGateway(lease_store=store)
+        gw.start()
+        try:
+            status, doc = _req(gw.port, "/v1/leases/sched")
+            assert status == 200 and doc["holder"] == ""
+            status, doc = _req(
+                gw.port, "/v1/leases/sched", "PUT",
+                {"expect_holder": "", "holder": "a",
+                 "duration_seconds": 5.0, "acquire_time": 1.0,
+                 "renew_time": 1.0, "transitions": 1})
+            assert status == 200 and doc["ok"]
+            # CAS conflict -> 409
+            try:
+                _req(gw.port, "/v1/leases/sched", "PUT",
+                     {"expect_holder": "x", "holder": "b"})
+                raise AssertionError("stale CAS must 409")
+            except urllib.error.HTTPError as e:
+                assert e.code == 409
+            assert store.get("sched").holder == "a"
+        finally:
+            gw.stop()
+
+    def test_unattached_routes_501(self):
+        gw = HttpGateway()
+        gw.start()
+        try:
+            try:
+                _req(gw.port, "/v1/solve", "POST", {})
+                raise AssertionError("must 501 without a scheduler")
+            except urllib.error.HTTPError as e:
+                assert e.code == 501
+        finally:
+            gw.stop()
+
+
+class TestTcpTransport:
+    def test_framed_rpc_over_tcp(self):
+        server = RpcServer("tcp://127.0.0.1:0")
+        svc = LeaseService()
+        svc.attach(server)
+        server.start()
+        try:
+            addr = server.address
+            assert addr.startswith("tcp://127.0.0.1:")
+            client = RpcClient(addr)
+            client.connect()
+            _, doc, _ = client.call(FrameType.LEASE_GET, {"name": "x"})
+            assert doc["holder"] == ""
+            client.close()
+        finally:
+            server.stop()
