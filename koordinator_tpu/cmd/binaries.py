@@ -50,6 +50,10 @@ def build_koordlet_parser() -> argparse.ArgumentParser:
     parser.add_argument("--cgroup-v2", action="store_true")
     parser.add_argument("--audit-log-dir", default="")
     parser.add_argument("--collect-interval-seconds", type=float, default=1.0)
+    parser.add_argument(
+        "--http-port", type=int, default=None,
+        help="serve the HTTP/JSON gateway (incl. /v1/podresources when "
+             "the PodResourcesProxy gate is on); omit to disable")
     return parser
 
 
@@ -72,6 +76,16 @@ def main_koordlet(argv: list[str], device_report_fn=None) -> Assembled:
     )
     daemon = Daemon(cfg=cfg, audit_dir=args.audit_log_dir or None,
                     device_report_fn=device_report_fn)
+    if args.http_port is not None:
+        from koordinator_tpu.transport.http_gateway import HttpGateway
+
+        daemon.gateway = HttpGateway(
+            port=args.http_port,
+            dispatcher=None,
+            pod_resources=(daemon.pod_resources
+                           if daemon.pod_resources.enabled() else None),
+        )
+        daemon.gateway.start()
     return Assembled(name="koordlet", args=args, component=daemon)
 
 

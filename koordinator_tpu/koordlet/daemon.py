@@ -93,6 +93,15 @@ class Daemon:
             ),
             clock=clock,
         )
+        from koordinator_tpu.koordlet.pod_resources import PodResourcesProxy
+
+        #: pod-resources reverse proxy (PodResourcesProxy gate): served on
+        #: the HTTP gateway when the binary attaches one; upstream kubelet
+        #: listing wired by the binary (kubelet stub seam)
+        self.pod_resources = PodResourcesProxy(self.states)
+        #: HTTP gateway attached by the binary (--http-port); owned by the
+        #: daemon lifecycle so stop() closes its socket and thread
+        self.gateway = None
         self._last_train = 0.0
         self.train_interval_seconds = 60.0
         self.device_report_fn = device_report_fn
@@ -161,3 +170,6 @@ class Daemon:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.gateway is not None:
+            self.gateway.stop()
+            self.gateway = None

@@ -1,0 +1,116 @@
+"""Pod-resources reverse proxy (PodResourcesProxy feature).
+
+Kubelet's pod-resources API only reports devices allocated by device
+plugins — koord-scheduler's fine-grained allocations live in the
+``device-allocated`` pod annotation and are invisible to monitoring
+agents (DCGM exporters etc.) that read that API.  The reference interposes
+a gRPC proxy at the kubelet socket and enriches List responses with the
+koordinator allocations
+(`pkg/koordlet/statesinformer/impl/states_pod_resources.go:141 List`,
+``:155 fillPodDevicesAllocatedByKoord``; the generic byte-level proxy is
+`pkg/util/httputil/reverseproxy.go`).
+
+This module is the same interposition for the repo: an informer plugin
+that wraps an upstream pod-resources listing (the kubelet stub seam) and
+merges each pod's annotation allocations into its first container's
+device list, exactly where the reference splices them.  It serves over
+the HTTP gateway (``GET /v1/podresources`` when attached) — the repo's
+language-neutral boundary — instead of re-implementing kubelet's gRPC.
+
+Response dialect (JSON-friendly mirror of podresources/v1):
+
+    {"pod_resources": [{"name", "namespace",
+                        "containers": [{"name", "devices": [
+                            {"resource_name", "device_ids": [...]}]}]}]}
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.features import KOORDLET_GATES
+
+#: DeviceType -> resource name (device_share.go:44,48)
+DEVICE_RESOURCE_NAMES = {
+    "gpu": "nvidia.com/gpu",
+    "rdma": "koordinator.sh/rdma",
+}
+
+
+class PodResourcesProxy:
+    """Enrich an upstream pod-resources listing with koord allocations.
+
+    ``upstream_list_fn`` returns the kubelet response dict (empty dict
+    when kubelet is unreachable — the proxy then still reports
+    koord-allocated devices for known pods); ``states`` is the
+    StatesInformer whose pods carry the device-allocated annotation.
+    """
+
+    def __init__(self, states,
+                 upstream_list_fn: Optional[Callable[[], dict]] = None):
+        self.states = states
+        self.upstream_list_fn = upstream_list_fn or (lambda: {})
+
+    def enabled(self) -> bool:
+        return KOORDLET_GATES.enabled("PodResourcesProxy")
+
+    def list(self) -> dict:
+        response = self.upstream_list_fn() or {}
+        # DEEP-copy the container/device structure: the upstream fn may
+        # return a cached long-lived dict (kubelet stubs do), and merging
+        # in place would duplicate koord devices on every call and race
+        # concurrent gateway requests
+        entries = [
+            {**e, "containers": [
+                {**c, "devices": [dict(d) for d in c.get("devices", [])]}
+                for c in e.get("containers", [])
+            ]}
+            for e in response.get("pod_resources", [])
+        ]
+        by_key = {(e.get("namespace", ""), e.get("name", "")): e
+                  for e in entries}
+        merged: set[tuple[str, str]] = set()
+        for pod in self.states.get_all_pods():
+            allocations = ext.get_device_allocations(pod.annotations or {})
+            if not allocations:
+                continue
+            key = (pod.namespace, pod.name)
+            if key in merged:
+                # pod recreation can briefly hold two uids under one
+                # (namespace, name); merging both would double-report the
+                # same container's devices — keep the first
+                continue
+            merged.add(key)
+            entry = by_key.get(key)
+            if entry is None:
+                # kubelet hasn't listed the pod (yet): surface the koord
+                # allocation anyway so monitoring never misses a device
+                entry = {"name": pod.name, "namespace": pod.namespace,
+                         "containers": [{"name": "", "devices": []}]}
+                by_key[key] = entry
+                entries.append(entry)
+            containers = entry.setdefault("containers", [])
+            if not containers:
+                containers.append({"name": "", "devices": []})
+            devices = containers[0].setdefault("devices", [])
+            for device_type, allocs in sorted(allocations.items()):
+                ids = []
+                for alloc in allocs:
+                    # RDMA virtual functions report bus ids, full devices
+                    # their id/minor (fillPodDevicesAllocatedByKoord)
+                    vfs = (alloc.get("extension") or {}).get(
+                        "virtual_functions") or []
+                    if vfs:
+                        ids.extend(str(vf.get("bus_id", "")) for vf in vfs)
+                    else:
+                        ids.append(str(alloc.get(
+                            "id", alloc.get("minor", ""))))
+                devices.append({
+                    "resource_name": DEVICE_RESOURCE_NAMES.get(
+                        device_type, device_type),
+                    "device_ids": ids,
+                })
+            devices.sort(key=lambda d: d["resource_name"])
+        # extra top-level upstream fields pass through untouched
+        return {**response, "pod_resources": entries}

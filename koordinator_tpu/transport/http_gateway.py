@@ -18,6 +18,8 @@ Routes (all JSON bodies/responses):
     GET  /v1/leases/<name>             -> lease record
     PUT  /v1/leases/<name>             -> CAS update {ok}; 409 on conflict
     GET  /v1/diagnosis                 -> last round's schedule diagnosis
+    GET  /v1/podresources              -> kubelet pod-resources listing
+                                          enriched with koord allocations
 
 Handlers delegate to the same objects the framed services use
 (transport/services.py SolveService/HookService, ha.LeaseService's store),
@@ -51,10 +53,12 @@ class HttpGateway:
         scheduler=None,
         dispatcher=None,
         lease_store=None,
+        pod_resources=None,
     ):
         self.scheduler = scheduler
         self.dispatcher = dispatcher
         self.lease_store = lease_store
+        self.pod_resources = pod_resources
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -125,6 +129,11 @@ class HttpGateway:
             return self._solve(req)
         if method == "GET" and path == "/v1/diagnosis":
             return self._diagnosis(req)
+        if method == "GET" and path == "/v1/podresources":
+            if self.pod_resources is None:
+                return req._reply(501,
+                                  {"error": "no pod-resources proxy"})
+            return req._reply(200, self.pod_resources.list())
         m = self._HOOK.match(path)
         if m and method == "POST":
             return self._hook(req, m.group(1))

@@ -176,3 +176,31 @@ def test_descheduler_assembles_upstream_plugins():
     with pytest.raises(SystemExit):
         main_koord_descheduler(
             ["--deschedule-plugins", "nope", "--disable-leader-election"])
+
+
+def test_koordlet_http_gateway_serves_podresources(tmp_path):
+    import json as _json
+    import urllib.request
+
+    old = KOORDLET_GATES.enabled("PodResourcesProxy")
+    KOORDLET_GATES.set("PodResourcesProxy", True)
+    try:
+        asm = main_koordlet([
+            "--cgroup-root-dir", str(tmp_path / "cg"),
+            "--proc-root-dir", str(tmp_path / "proc"),
+            "--sys-root-dir", str(tmp_path / "sys"),
+            "--http-port", "0",
+        ])
+        gw = asm.component.gateway
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{gw.port}/v1/podresources",
+                    timeout=10) as resp:
+                doc = _json.loads(resp.read().decode())
+            assert doc == {"pod_resources": []}
+        finally:
+            # daemon lifecycle owns the gateway
+            asm.component.stop()
+        assert asm.component.gateway is None
+    finally:
+        KOORDLET_GATES.set("PodResourcesProxy", old)

@@ -124,3 +124,99 @@ class TestTcpTransport:
             client.close()
         finally:
             server.stop()
+
+
+class TestPodResourcesProxy:
+    """PodResourcesProxy (states_pod_resources.go List enrichment): the
+    kubelet pod-resources listing gains the koord-allocated devices that
+    device plugins never reported."""
+
+    def _states(self, annotations):
+        from koordinator_tpu.api.qos import QoSClass
+        from koordinator_tpu.koordlet.statesinformer import (
+            PodMeta,
+            StatesInformer,
+        )
+
+        states = StatesInformer()
+        states.set_pods([PodMeta(
+            uid="u1", name="p1", namespace="default",
+            qos_class=QoSClass.LS, kube_qos="burstable",
+            annotations=annotations)])
+        return states
+
+    def test_list_merges_annotation_devices(self):
+        from koordinator_tpu.api import extension as ext
+        from koordinator_tpu.koordlet.pod_resources import PodResourcesProxy
+
+        ann = {}
+        ext.set_device_allocations(ann, {
+            "gpu": [{"minor": 0, "resources": {"core": 100}},
+                    {"minor": 2, "resources": {"core": 100}}],
+            "rdma": [{"minor": 1, "extension": {"virtual_functions": [
+                {"bus_id": "0000:3b:02.1"}]}}],
+        })
+        upstream = {"pod_resources": [{
+            "name": "p1", "namespace": "default",
+            "containers": [{"name": "main", "devices": [
+                {"resource_name": "cpu", "device_ids": []}]}],
+        }]}
+        proxy = PodResourcesProxy(self._states(ann), lambda: upstream)
+        out = proxy.list()
+        devices = out["pod_resources"][0]["containers"][0]["devices"]
+        names = [d["resource_name"] for d in devices]
+        assert names == sorted(names)
+        by_name = {d["resource_name"]: d["device_ids"] for d in devices}
+        assert by_name["nvidia.com/gpu"] == ["0", "2"]
+        # VF bus ids win over the device minor
+        assert by_name["koordinator.sh/rdma"] == ["0000:3b:02.1"]
+
+    def test_pod_missing_upstream_still_reported(self):
+        from koordinator_tpu.api import extension as ext
+        from koordinator_tpu.koordlet.pod_resources import PodResourcesProxy
+
+        ann = {}
+        ext.set_device_allocations(ann, {"gpu": [{"minor": 1}]})
+        proxy = PodResourcesProxy(self._states(ann), lambda: {})
+        out = proxy.list()
+        assert out["pod_resources"][0]["name"] == "p1"
+        devs = out["pod_resources"][0]["containers"][0]["devices"]
+        assert devs == [{"resource_name": "nvidia.com/gpu",
+                         "device_ids": ["1"]}]
+
+    def test_served_on_gateway(self):
+        from koordinator_tpu.api import extension as ext
+        from koordinator_tpu.koordlet.pod_resources import PodResourcesProxy
+
+        ann = {}
+        ext.set_device_allocations(ann, {"gpu": [{"minor": 3}]})
+        gw = HttpGateway(
+            pod_resources=PodResourcesProxy(self._states(ann), lambda: {}))
+        gw.start()
+        try:
+            status, doc = _req(gw.port, "/v1/podresources")
+            assert status == 200
+            assert doc["pod_resources"][0]["containers"][0]["devices"][0][
+                "device_ids"] == ["3"]
+        finally:
+            gw.stop()
+
+    def test_repeated_list_does_not_duplicate(self):
+        from koordinator_tpu.api import extension as ext
+        from koordinator_tpu.koordlet.pod_resources import PodResourcesProxy
+
+        ann = {}
+        ext.set_device_allocations(ann, {"gpu": [{"minor": 0}]})
+        upstream = {"pod_resources": [{
+            "name": "p1", "namespace": "default",
+            "containers": [{"name": "main", "devices": []}],
+        }], "extra_field": 7}
+        proxy = PodResourcesProxy(self._states(ann), lambda: upstream)
+        first = proxy.list()
+        second = proxy.list()
+        devs = second["pod_resources"][0]["containers"][0]["devices"]
+        assert len(devs) == 1, "cached upstream dict was mutated"
+        # the upstream's own structure is untouched
+        assert upstream["pod_resources"][0]["containers"][0]["devices"] == []
+        # extra top-level upstream fields pass through
+        assert first["extra_field"] == 7
