@@ -282,14 +282,27 @@ def main() -> None:
     from koordinator_tpu.ops.assignment import score_pods
     from koordinator_tpu.ops.batch_assign import batch_assign
 
-    if not _device_alive():
-        import os
+    # Retry window: the tunnel flaps (PERF_NOTES tunnel log) and this run
+    # may be the round's one official record — probe a few times before
+    # recording a zero.  KOORD_BENCH_PROBE_TRIES overrides (1 = old
+    # single-probe behavior); total worst-case wait = tries * 180s + waits.
+    import os
 
+    tries = int(os.environ.get("KOORD_BENCH_PROBE_TRIES", "3"))
+    alive = False
+    for attempt in range(max(tries, 1)):
+        if _device_alive():
+            alive = True
+            break
+        if attempt + 1 < tries:
+            time.sleep(60)
+    if not alive:
         print(json.dumps({
             "metric": f"solve_pods_per_sec_{N_PODS}p_{N_NODES}n",
             "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
             "extra": {"error": "device unreachable: probe kernel did not "
-                               "complete within 180s (tunnel down?)"},
+                               f"complete in {max(tries, 1)} attempts "
+                               "(tunnel down?)"},
         }))
         import sys
 
@@ -311,9 +324,15 @@ def main() -> None:
                 st.replace(node_requested=st.node_requested
                            + (scores[0, :, None] & 1)))
 
+    # k=16 with stratified (5, 15) candidates: the hardware-measured fast
+    # point (167.6 ms = 298.4k pods/s = 1.19x at k=16 in the 2026-07-30
+    # session) combined with the round-3 quality fix (stratified selection
+    # assigns 100% of this exact shape on CPU at k=16, vs 73.6% for the
+    # old single-key k=16 — PERF_NOTES.md); solve_assigned_frac below
+    # guards the claim on every run
     score_per_iter, _ = _time_assign(state, score_fn, rtt, n=5)
     solve_per_iter, solve_count = _time_assign(
-        state, lambda st: batch_assign(st, pods, cfg)[:2], rtt, n=5)
+        state, lambda st: batch_assign(st, pods, cfg, k=16)[:2], rtt, n=5)
     score_pods_per_sec = N_PODS / score_per_iter
     solve_pods_per_sec = N_PODS / solve_per_iter
     # solve QUALITY rides alongside throughput (the chained loop's

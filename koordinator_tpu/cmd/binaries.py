@@ -57,10 +57,13 @@ def build_koordlet_parser() -> argparse.ArgumentParser:
     return parser
 
 
-def main_koordlet(argv: list[str], device_report_fn=None) -> Assembled:
+def main_koordlet(argv: list[str], device_report_fn=None,
+                  pod_resources_upstream_fn=None) -> Assembled:
     """``device_report_fn(Device)`` is the deployment shell's Device-CR
     sink (apiserver client / StateSyncService.upsert_node devices=...);
-    None disables the in-agent reporting tick."""
+    None disables the in-agent reporting tick.
+    ``pod_resources_upstream_fn()`` is the kubelet pod-resources stub the
+    PodResourcesProxy enriches; None serves koord allocations only."""
     from koordinator_tpu.features import KOORDLET_GATES
     from koordinator_tpu.koordlet.daemon import Daemon
     from koordinator_tpu.koordlet.system.config import SystemConfig
@@ -75,7 +78,8 @@ def main_koordlet(argv: list[str], device_report_fn=None) -> Assembled:
         cgroup_driver_systemd=args.cgroup_driver_systemd,
     )
     daemon = Daemon(cfg=cfg, audit_dir=args.audit_log_dir or None,
-                    device_report_fn=device_report_fn)
+                    device_report_fn=device_report_fn,
+                    pod_resources_upstream_fn=pod_resources_upstream_fn)
     if args.http_port is not None:
         from koordinator_tpu.transport.http_gateway import HttpGateway
 

@@ -44,6 +44,7 @@ class Daemon:
         kill_handler: Optional[Callable] = None,
         device_report_fn: Optional[Callable] = None,
         device_report_interval_seconds: float = 60.0,
+        pod_resources_upstream_fn: Optional[Callable] = None,
     ):
         self.cfg = cfg or get_config()
         self.clock = clock
@@ -96,9 +97,12 @@ class Daemon:
         from koordinator_tpu.koordlet.pod_resources import PodResourcesProxy
 
         #: pod-resources reverse proxy (PodResourcesProxy gate): served on
-        #: the HTTP gateway when the binary attaches one; upstream kubelet
-        #: listing wired by the binary (kubelet stub seam)
-        self.pod_resources = PodResourcesProxy(self.states)
+        #: the HTTP gateway when the binary attaches one;
+        #: ``pod_resources_upstream_fn`` is the kubelet stub seam (returns
+        #: the kubelet pod-resources listing dict; None = no upstream, the
+        #: proxy reports only koord-allocated devices)
+        self.pod_resources = PodResourcesProxy(
+            self.states, upstream_list_fn=pod_resources_upstream_fn)
         #: HTTP gateway attached by the binary (--http-port); owned by the
         #: daemon lifecycle so stop() closes its socket and thread
         self.gateway = None

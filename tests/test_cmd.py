@@ -204,3 +204,34 @@ def test_koordlet_http_gateway_serves_podresources(tmp_path):
         assert asm.component.gateway is None
     finally:
         KOORDLET_GATES.set("PodResourcesProxy", old)
+
+
+def test_koordlet_pod_resources_upstream_seam(tmp_path):
+    import json as _json
+    import urllib.request
+
+    old = KOORDLET_GATES.enabled("PodResourcesProxy")
+    KOORDLET_GATES.set("PodResourcesProxy", True)
+    try:
+        upstream = {"pod_resources": [{
+            "name": "k", "namespace": "d",
+            "containers": [{"name": "c", "devices": [
+                {"resource_name": "cpu", "device_ids": ["0-3"]}]}]}]}
+        asm = main_koordlet([
+            "--cgroup-root-dir", str(tmp_path / "cg"),
+            "--proc-root-dir", str(tmp_path / "proc"),
+            "--sys-root-dir", str(tmp_path / "sys"),
+            "--http-port", "0",
+        ], pod_resources_upstream_fn=lambda: upstream)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{asm.component.gateway.port}"
+                    f"/v1/podresources", timeout=10) as resp:
+                doc = _json.loads(resp.read().decode())
+            # kubelet's own listing flows through the assembled binary
+            assert doc["pod_resources"][0]["containers"][0]["devices"] == [
+                {"resource_name": "cpu", "device_ids": ["0-3"]}]
+        finally:
+            asm.component.stop()
+    finally:
+        KOORDLET_GATES.set("PodResourcesProxy", old)
