@@ -288,6 +288,20 @@ def main() -> None:
     # single-probe behavior); total worst-case wait = tries * 180s + waits.
     import os
 
+    def emit_zero_record(extra: dict) -> None:
+        """One JSON zero-record, then hard-exit 0: the driver records
+        stdout only on rc==0, and a hung device thread must not block
+        exit (os._exit skips buffered-IO teardown, hence the flush)."""
+        import sys
+
+        print(json.dumps({
+            "metric": f"solve_pods_per_sec_{N_PODS}p_{N_NODES}n",
+            "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
+            "extra": extra,
+        }))
+        sys.stdout.flush()
+        os._exit(0)
+
     tries = int(os.environ.get("KOORD_BENCH_PROBE_TRIES", "3"))
     alive = False
     for attempt in range(max(tries, 1)):
@@ -297,17 +311,9 @@ def main() -> None:
         if attempt + 1 < tries:
             time.sleep(60)
     if not alive:
-        print(json.dumps({
-            "metric": f"solve_pods_per_sec_{N_PODS}p_{N_NODES}n",
-            "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
-            "extra": {"error": "device unreachable: probe kernel did not "
-                               f"complete in {max(tries, 1)} attempts "
-                               "(tunnel down?)"},
-        }))
-        import sys
-
-        sys.stdout.flush()   # os._exit skips buffered-IO teardown
-        os._exit(0)          # a hung device thread must not block exit
+        emit_zero_record({
+            "error": "device unreachable: probe kernel did not complete "
+                     f"in {max(tries, 1)} attempts (tunnel down?)"})
 
     state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
 
@@ -329,10 +335,37 @@ def main() -> None:
     # session) combined with the round-3 quality fix (stratified selection
     # assigns 100% of this exact shape on CPU at k=16, vs 73.6% for the
     # old single-key k=16 — PERF_NOTES.md); solve_assigned_frac below
-    # guards the claim on every run
+    # guards the claim on every run.  Both the XLA (approx_max_k) and the
+    # Pallas streaming candidate paths are timed; the headline takes the
+    # faster one and records both, so the claim is always the measured
+    # best rather than a pre-committed guess.
     score_per_iter, _ = _time_assign(state, score_fn, rtt, n=5)
-    solve_per_iter, solve_count = _time_assign(
-        state, lambda st: batch_assign(st, pods, cfg, k=16)[:2], rtt, n=5)
+    # method passed EXPLICITLY so the recorded label always matches what
+    # ran (default "auto" would silently time the exact path on CPU)
+    candidates = {
+        "approx": lambda st: batch_assign(st, pods, cfg, k=16,
+                                          method="approx")[:2],
+        "fused": lambda st: batch_assign(st, pods, cfg, k=16,
+                                         method="fused")[:2],
+    }
+    timed = {}
+    for method, fn in candidates.items():
+        try:
+            timed[method] = _time_assign(state, fn, rtt, n=5)
+        except Exception as e:  # a broken variant must not cost the run
+            timed[f"{method}_error"] = repr(e)[:200]
+    measured = {m: t for m, t in timed.items() if isinstance(t, tuple)}
+    if not measured:
+        emit_zero_record({"error": "every solve variant failed", **{
+            k: v for k, v in timed.items() if isinstance(v, str)}})
+    # quality gates speed: only variants whose assigned count is within
+    # 1% of the best may win on time — a faster solver that strands pods
+    # is not an improvement
+    best_count = max(t[1] for t in measured.values())
+    eligible = {m: t for m, t in measured.items()
+                if t[1] >= 0.99 * best_count}
+    best = min(eligible, key=lambda m: eligible[m][0])
+    solve_per_iter, solve_count = eligible[best]
     score_pods_per_sec = N_PODS / score_per_iter
     solve_pods_per_sec = N_PODS / solve_per_iter
     # solve QUALITY rides alongside throughput (the chained loop's
@@ -348,7 +381,13 @@ def main() -> None:
         ),
         "solve_ms_per_round": round(solve_per_iter * 1e3, 2),
         "solve_assigned_frac": round(assigned_frac, 4),
+        "solve_candidate_method": best,
     }
+    for method, t in timed.items():
+        if isinstance(t, tuple):
+            extra[f"solve_ms_{method}"] = round(t[0] * 1e3, 2)
+        else:
+            extra[f"solve_{method}"] = t
     # extras run in CHILD processes: even a device OOM abort or backend
     # SIGABRT in a config cannot cost the already-measured headline
     import subprocess
