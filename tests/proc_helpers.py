@@ -1,0 +1,56 @@
+"""Shared scaffolding for tests that spawn real subprocess replicas
+(cross-process HA, multihost): env setup, stderr capture, spawn, liveness
+polling, teardown — one copy instead of one per test file."""
+
+import os
+import subprocess
+import sys
+import time
+
+
+def replica_env():
+    """Subprocess env with the repo importable and no inherited XLA_FLAGS."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    return repo_root, env
+
+
+def spawn_replicas(script_path, idents_args, tmp_path):
+    """Start one subprocess per (ident, argv-tail); stderr goes to
+    ``tmp_path/stderr-<ident>`` so failures carry the real traceback.
+    Returns (procs, stderr_paths)."""
+    repo_root, env = replica_env()
+    procs, errs = {}, {}
+    for ident, args in idents_args.items():
+        errs[ident] = tmp_path / f"stderr-{ident}"
+        procs[ident] = subprocess.Popen(
+            [sys.executable, str(script_path), *args],
+            env=env, cwd=repo_root,
+            stdout=subprocess.DEVNULL,
+            stderr=open(errs[ident], "w"))
+    return procs, errs
+
+
+def wait_for(predicate, procs, errs, deadline_s, what):
+    """Poll ``predicate()`` until true; fail FAST with the dead replica's
+    stderr if any process exits first, and with ``what`` on timeout."""
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if predicate():
+            return
+        for ident, proc in procs.items():
+            if proc.poll() is not None:
+                tail = errs[ident].read_text()[-3000:]
+                raise AssertionError(
+                    f"replica {ident} exited rc={proc.returncode} while "
+                    f"waiting for {what}:\n{tail}")
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def kill_all(procs):
+    for p in procs.values():
+        if p.poll() is None:
+            p.kill()

@@ -8,9 +8,6 @@ leader election.
   test kill -9s the leading process and the standby must take over.
 """
 
-import os
-import subprocess
-import sys
 import textwrap
 import time
 
@@ -169,56 +166,38 @@ CONTENDER = textwrap.dedent("""
 def test_cross_process_failover_kill9(tmp_path):
     """kill -9 the leading scheduler process; the standby must acquire the
     lease and run rounds (cmd/koord-manager/main.go Leases semantics)."""
+    from tests.proc_helpers import kill_all, spawn_replicas, wait_for
+
     path, server, svc = _server(tmp_path, "failover.sock")
     script = tmp_path / "contender.py"
     script.write_text(CONTENDER)
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     status = {i: tmp_path / f"status-{i}" for i in ("a", "b")}
     for f in status.values():
         f.write_text("")
-    procs = {}
+    procs, errs = spawn_replicas(
+        script, {i: [path, i, str(status[i])] for i in ("a", "b")},
+        tmp_path)
     try:
-        for ident in ("a", "b"):
-            procs[ident] = subprocess.Popen(
-                [sys.executable, str(script), path, ident,
-                 str(status[ident])],
-                env=env, cwd=repo_root,
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-
         def leader_now():
             return svc.store.get("sched").holder
 
-        deadline = time.time() + 60
-        while time.time() < deadline and not leader_now():
-            time.sleep(0.05)
+        wait_for(lambda: bool(leader_now()), procs, errs, 60,
+                 "first lease acquisition")
         first = leader_now()
         assert first in ("a", "b"), "no process acquired the lease"
         # the leader actually runs rounds
-        deadline = time.time() + 30
-        while time.time() < deadline and not status[first].read_text():
-            time.sleep(0.05)
-        assert f"ROUND {first}" in status[first].read_text()
+        wait_for(lambda: f"ROUND {first}" in status[first].read_text(),
+                 procs, errs, 30, "leader rounds")
 
         procs[first].kill()          # SIGKILL: no voluntary release
         procs[first].wait(timeout=10)
         other = "b" if first == "a" else "a"
+        live = {other: procs[other]}
         # standby must wait out the 1s lease, then take over and schedule
-        deadline = time.time() + 60
-        while time.time() < deadline and leader_now() != other:
-            time.sleep(0.05)
-        assert leader_now() == other, "standby never acquired the lease"
-        before = status[other].read_text()
-        deadline = time.time() + 30
-        while (time.time() < deadline
-               and f"ROUND {other}" not in status[other].read_text()):
-            time.sleep(0.05)
-        assert f"ROUND {other}" in status[other].read_text(), \
-            "standby leads but runs no rounds"
-        del before
+        wait_for(lambda: leader_now() == other, live, errs, 60,
+                 "standby lease takeover")
+        wait_for(lambda: f"ROUND {other}" in status[other].read_text(),
+                 live, errs, 30, "standby rounds")
     finally:
-        for p in procs.values():
-            if p.poll() is None:
-                p.kill()
+        kill_all(procs)
         server.stop()
