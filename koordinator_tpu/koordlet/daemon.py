@@ -113,6 +113,9 @@ class Daemon:
         self._last_device_report = 0.0
         self.pleg = PLEG(self.cfg)
         self.pleg.add_handler(lambda event: self._on_pleg_event(event))
+        # arm the native inotify gate (quiet ticks skip the cgroup walk);
+        # retried in tick() since the QoS roots may not exist yet at boot
+        self._pleg_watch_armed = self.pleg.start_watch()
         self._pleg_dirty = False
         self._last_hook_reconcile = 0.0
         #: periodic safety-net interval even without churn (NodeSLO changes,
@@ -134,6 +137,8 @@ class Daemon:
         change/interval."""
         collected = self.advisor.collect_once()
         strategies = self.qos_manager.tick()
+        if not self._pleg_watch_armed:
+            self._pleg_watch_armed = self.pleg.start_watch()
         self.pleg.poll()
         writes = 0
         now = self.clock()
@@ -174,6 +179,7 @@ class Daemon:
 
     def stop(self) -> None:
         self._stop.set()
+        self.pleg.stop_watch()
         if self.gateway is not None:
             self.gateway.stop()
             self.gateway = None

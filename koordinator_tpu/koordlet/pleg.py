@@ -43,12 +43,92 @@ class PLEG:
         self.subsystem = subsystem
         self._known: dict[str, set[str]] = {}  # pod uid -> container ids
         self._handlers: list[Callable[[PodLifecycleEvent], None]] = []
+        #: native inotify gate (libkoordsys ks_watch_*): when armed, quiet
+        #: polls skip the tree walk entirely — the reference PLEG is
+        #: fsnotify-driven the same way.  The scan-diff below stays the
+        #: behavior contract (and the only path on fake filesystems without
+        #: churn notification or where inotify is unavailable).
+        self._watcher = None
+        self._watched_pods: dict[str, int] = {}  # pod dir path -> wd
+        #: safety net: full rescan at least every N polls even when quiet
+        #: (missed events, watch-add races)
+        self.rescan_every = 60
+        self._quiet_polls = 0
+        self.scan_count = 0  # observable in tests
 
     def add_handler(self, fn: Callable[[PodLifecycleEvent], None]) -> None:
         self._handlers.append(fn)
 
-    def _scan(self) -> dict[str, set[str]]:
+    # -- native inotify gate -------------------------------------------------
+
+    def start_watch(self) -> bool:
+        """Arm the inotify gate over the QoS roots + current pod dirs;
+        False (and scan-every-poll behavior) where unavailable."""
+        from koordinator_tpu.native import DirWatcher
+
+        watcher = DirWatcher()
+        if not watcher.open():
+            return False
+        added = 0
+        for qos in ("guaranteed", "burstable", "besteffort"):
+            base = self.cfg.cgroup_abs_path(
+                self.subsystem, self.cfg.kube_qos_dir(qos))
+            if watcher.add(base) is not None:
+                added += 1
+        if added == 0:
+            watcher.close()
+            return False
+        self._watcher = watcher
+        self._sync_pod_watches()
+        # the first poll after arming must still scan: pods that existed
+        # before the watch produce no events but must be reported as added
+        self._quiet_polls = self.rescan_every
+        return True
+
+    def stop_watch(self) -> None:
+        if self._watcher is not None:
+            self._watcher.close()
+            self._watcher = None
+            self._watched_pods.clear()
+
+    def _sync_pod_watches(self, live: set[str] | None = None) -> None:
+        """Watch every live pod dir (container churn happens inside them);
+        vanished dirs drop their watches kernel-side automatically.
+
+        ``live`` is the pod-dir path set a just-finished scan already
+        collected (avoids a second tree walk); None re-lists the roots.
+        Watches are (re-)added UNCONDITIONALLY for live dirs:
+        inotify_add_watch is idempotent, and a pod dir deleted+recreated
+        between polls keeps its path but lost its kernel watch — gating on
+        the bookkeeping dict would leave the new dir unwatched."""
+        if self._watcher is None:
+            return
+        if live is None:
+            live = set()
+            for qos in ("guaranteed", "burstable", "besteffort"):
+                base = self.cfg.cgroup_abs_path(
+                    self.subsystem, self.cfg.kube_qos_dir(qos))
+                try:
+                    entries = os.listdir(base)
+                except OSError:
+                    continue
+                for entry in entries:
+                    path = os.path.join(base, entry)
+                    if POD_DIR_RE.fullmatch(entry) and os.path.isdir(path):
+                        live.add(path)
+        for path in live:
+            wd = self._watcher.add(path)
+            if wd is not None:
+                self._watched_pods[path] = wd
+        for path in list(self._watched_pods):
+            if path not in live:
+                del self._watched_pods[path]
+
+    def _scan(self) -> tuple[dict[str, set[str]], set[str]]:
+        """(pod uid -> container ids, pod dir paths) in one walk — the
+        paths feed _sync_pod_watches without a second listdir pass."""
         found: dict[str, set[str]] = {}
+        pod_paths: set[str] = set()
         for qos in ("guaranteed", "burstable", "besteffort"):
             base = self.cfg.cgroup_abs_path(
                 self.subsystem, self.cfg.kube_qos_dir(qos)
@@ -70,11 +150,25 @@ class PLEG:
                 except OSError:
                     continue  # pod dir vanished between listdir and scan
                 found[uid] = containers
-        return found
+                pod_paths.add(os.path.join(base, entry))
+        return found, pod_paths
 
     def poll(self) -> list[PodLifecycleEvent]:
-        """Diff the cgroup tree against the last poll; fire + return events."""
-        current = self._scan()
+        """Diff the cgroup tree against the last poll; fire + return events.
+
+        With the inotify gate armed, a poll with no pending filesystem
+        events (and within the rescan interval) returns immediately
+        without walking the tree."""
+        if self._watcher is not None:
+            changed = bool(self._watcher.poll(0))
+            self._quiet_polls += 1
+            if not changed and self._quiet_polls < self.rescan_every:
+                return []
+            self._quiet_polls = 0
+        current, pod_paths = self._scan()
+        self.scan_count += 1
+        if self._watcher is not None:
+            self._sync_pod_watches(pod_paths)
         events: list[PodLifecycleEvent] = []
         for uid, containers in current.items():
             if uid not in self._known:

@@ -20,6 +20,9 @@ _SRC = os.path.join(_REPO_ROOT, "native", "koordsys.cpp")
 _LIB_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _LIB = os.path.join(_LIB_DIR, "libkoordsys.so")
 
+#: expected ks_version(); a stale prebuilt .so triggers one rebuild
+KS_VERSION = 2
+
 _lock = threading.Lock()
 #: serializes the g++ compile + dlopen; separate from _lock so fast-path
 #: _load() calls never queue behind a running build
@@ -90,8 +93,25 @@ def _load_blocking() -> Optional[ctypes.CDLL]:
         except OSError:
             return None
         lib.ks_version.restype = ctypes.c_int
-        if lib.ks_version() != 1:
-            return None
+        if lib.ks_version() != KS_VERSION:
+            # stale prebuilt .so from an older source: UNLINK before
+            # rebuilding — g++ would otherwise truncate the still-mmapped
+            # file under the live handle (UB), and dlopen dedupes by
+            # (dev, inode) so only a fresh inode yields a fresh handle
+            # (the stale handle itself is leaked, which is harmless)
+            try:
+                os.unlink(_LIB)
+            except OSError:
+                return None
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB)
+            except OSError:
+                return None
+            lib.ks_version.restype = ctypes.c_int
+            if lib.ks_version() != KS_VERSION:
+                return None
         lib.ks_batch_read.restype = ctypes.c_int
         lib.ks_batch_read.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_char_p,
@@ -106,6 +126,18 @@ def _load_blocking() -> Optional[ctypes.CDLL]:
         ]
         lib.ks_cpi_close.restype = None
         lib.ks_cpi_close.argtypes = [ctypes.c_int]
+        lib.ks_watch_open.restype = ctypes.c_int
+        lib.ks_watch_open.argtypes = []
+        lib.ks_watch_add.restype = ctypes.c_int
+        lib.ks_watch_add.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.ks_watch_rm.restype = ctypes.c_int
+        lib.ks_watch_rm.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.ks_watch_poll.restype = ctypes.c_int
+        lib.ks_watch_poll.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.ks_watch_close.restype = None
+        lib.ks_watch_close.argtypes = [ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -187,6 +219,65 @@ class BatchReader:
 def batch_read(paths: Sequence[str], max_bytes: int = 4096) -> list[Optional[str]]:
     """One-shot convenience over :class:`BatchReader`."""
     return BatchReader(paths, max_bytes).read()
+
+
+class DirWatcher:
+    """Inotify directory watcher (PLEG fast path; pleg.go's fsnotify role).
+
+    ``open()`` returns False where inotify (or the native lib) is
+    unavailable — callers keep their scan path.  ``poll`` returns a list of
+    (wd, kind, name): kind "C" = entry appeared, "D" = vanished; a
+    (-1, "C", "*") entry signals a kernel queue overflow — treat it as
+    "anything may have changed" and rescan.
+    """
+
+    def __init__(self):
+        self._fd: Optional[int] = None
+        self._buf = ctypes.create_string_buffer(16384)
+
+    def open(self) -> bool:
+        lib = _load()
+        if lib is None:
+            return False
+        fd = lib.ks_watch_open()
+        if fd < 0:
+            return False
+        self._fd = fd
+        return True
+
+    def add(self, path: str) -> Optional[int]:
+        """Watch a directory; returns the watch descriptor or None."""
+        lib = _load()
+        if lib is None or self._fd is None:
+            return None
+        wd = lib.ks_watch_add(self._fd, path.encode())
+        return wd if wd >= 0 else None
+
+    def remove(self, wd: int) -> None:
+        lib = _load()
+        if lib is not None and self._fd is not None:
+            lib.ks_watch_rm(self._fd, wd)
+
+    def poll(self, timeout_ms: int = 0) -> list[tuple[int, str, str]]:
+        lib = _load()
+        if lib is None or self._fd is None:
+            return []
+        n = lib.ks_watch_poll(self._fd, timeout_ms, self._buf,
+                              len(self._buf))
+        if n <= 0:
+            return []
+        out = []
+        for line in self._buf.raw[:n].decode(errors="replace").splitlines():
+            parts = line.split(" ", 2)
+            if len(parts) == 3:
+                out.append((int(parts[0]), parts[1], parts[2]))
+        return out
+
+    def close(self) -> None:
+        lib = _load()
+        if lib is not None and self._fd is not None:
+            lib.ks_watch_close(self._fd)
+        self._fd = None
 
 
 class CPICounter:

@@ -10,6 +10,10 @@
 //   * ks_cpi_*: perf_event_open cycles+instructions counters per cgroup, the
 //     CPI collector's data source (libpfm's role in the reference). Uses the
 //     raw syscall — no libpfm dependency.
+//   * ks_watch_*: inotify directory watching for the PLEG (the reference's
+//     pleg.go is fsnotify-driven, pkg/koordlet/pleg/pleg.go:81): pod/container
+//     cgroup dirs appearing or vanishing gate the Python scan-diff, so quiet
+//     ticks cost no tree walk.
 //
 // Everything degrades gracefully: callers treat any negative return as
 // "unsupported here" and fall back to the Python path.
@@ -22,6 +26,8 @@
 #ifdef __linux__
 #include <dirent.h>
 #include <fcntl.h>
+#include <poll.h>
+#include <sys/inotify.h>
 #include <sys/ioctl.h>
 #include <sys/syscall.h>
 #include <unistd.h>
@@ -205,7 +211,102 @@ void ks_cpi_close(int handle) {
 #endif
 }
 
+// ---------------------------------------------------------------------------
+// Inotify directory watching (PLEG fast path).
+//
+// ks_watch_open  -> inotify fd (or -errno)
+// ks_watch_add   -> watch descriptor for one directory (or -errno); watches
+//                   dir create/delete/move — the pod/container lifecycle
+//                   signals the reference's fsnotify PLEG consumes
+// ks_watch_poll  -> serialize pending events into out as lines
+//                   "<wd> <C|D> <name>\n" (C = appeared, D = vanished);
+//                   returns bytes written, 0 on timeout, or -errno
+// ks_watch_rm / ks_watch_close — cleanup
+// ---------------------------------------------------------------------------
+
+int ks_watch_open(void) {
+#ifndef __linux__
+    return -38;  // -ENOSYS
+#else
+    int fd = inotify_init1(IN_NONBLOCK | IN_CLOEXEC);
+    return fd < 0 ? -errno : fd;
+#endif
+}
+
+int ks_watch_add(int fd, const char *path) {
+#ifndef __linux__
+    (void)fd; (void)path;
+    return -38;
+#else
+    int wd = inotify_add_watch(
+        fd, path,
+        IN_CREATE | IN_DELETE | IN_MOVED_FROM | IN_MOVED_TO | IN_ONLYDIR);
+    return wd < 0 ? -errno : wd;
+#endif
+}
+
+int ks_watch_rm(int fd, int wd) {
+#ifndef __linux__
+    (void)fd; (void)wd;
+    return -38;
+#else
+    return inotify_rm_watch(fd, wd) < 0 ? -errno : 0;
+#endif
+}
+
+int ks_watch_poll(int fd, int timeout_ms, char *out, int cap) {
+#ifndef __linux__
+    (void)fd; (void)timeout_ms; (void)out; (void)cap;
+    return -38;
+#else
+    struct pollfd pfd = {fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, timeout_ms);
+    if (pr < 0) return -errno;
+    if (pr == 0) return 0;
+    char buf[16384];
+    ssize_t got = read(fd, buf, sizeof(buf));
+    if (got < 0) return errno == EAGAIN ? 0 : -errno;
+    int used = 0;
+    ssize_t off = 0;
+    while (off + (ssize_t)sizeof(struct inotify_event) <= got) {
+        struct inotify_event *ev = (struct inotify_event *)(buf + off);
+        off += sizeof(struct inotify_event) + ev->len;
+        if (ev->mask & IN_Q_OVERFLOW) {
+            // overflow: force the caller to fall back to a full scan by
+            // reporting a synthetic "everything may have changed" line
+            // (same capacity guard as below — snprintf with a size that
+            // went <= 0 would be UB, and its would-be return value must
+            // never inflate `used` past bytes actually written)
+            const char overflow_line[] = "-1 C *\n";
+            int need = (int)sizeof(overflow_line) - 1;
+            if (used + need >= cap) break;
+            memcpy(out + used, overflow_line, need);
+            used += need;
+            continue;
+        }
+        if (ev->len == 0) continue;
+        char kind = 0;
+        if (ev->mask & (IN_CREATE | IN_MOVED_TO)) kind = 'C';
+        else if (ev->mask & (IN_DELETE | IN_MOVED_FROM)) kind = 'D';
+        else continue;
+        int need = snprintf(NULL, 0, "%d %c %s\n", ev->wd, kind, ev->name);
+        if (used + need >= cap) break;   // out full: deliver what fits
+        used += snprintf(out + used, cap - used, "%d %c %s\n",
+                         ev->wd, kind, ev->name);
+    }
+    return used;
+#endif
+}
+
+void ks_watch_close(int fd) {
+#ifdef __linux__
+    if (fd >= 0) close(fd);
+#else
+    (void)fd;
+#endif
+}
+
 // Library self-check (Python binding probes this at load).
-int ks_version(void) { return 1; }
+int ks_version(void) { return 2; }
 
 }  // extern "C"

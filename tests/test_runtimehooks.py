@@ -207,6 +207,105 @@ class TestPLEG:
         pleg.poll()
         assert seen == [EVENT_POD_ADDED]
 
+    def test_inotify_gate_skips_quiet_scans(self, cfg):
+        # the native watcher gates the tree walk: quiet polls do not scan,
+        # churn (pod OR container inside a pod dir) triggers exactly one
+        from koordinator_tpu import native
+
+        if not native.ensure_built():
+            import pytest
+
+            pytest.skip("native lib unavailable")
+        # QoS roots must exist before watches can attach
+        for qos in ("guaranteed", "burstable", "besteffort"):
+            os.makedirs(cfg.cgroup_abs_path("cpu", cfg.kube_qos_dir(qos)),
+                        exist_ok=True)
+        # a pod existing BEFORE the watch is armed must still be reported
+        self.make_pod_dir(cfg, "guaranteed", "pre-existing")
+        pleg = PLEG(cfg)
+        assert pleg.start_watch()
+        try:
+            first = pleg.poll()           # first poll always scans
+            assert [e.type for e in first] == [EVENT_POD_ADDED]
+            assert first[0].pod_uid == "pre-existing"
+            base_scans = pleg.scan_count
+            assert base_scans == 1
+            for _ in range(5):
+                assert pleg.poll() == []  # quiet: no tree walks
+            assert pleg.scan_count == base_scans
+            self.make_pod_dir(cfg, "besteffort", "pod-w1", ["c1"])
+            events = pleg.poll()          # churn: gate opens, scan diffs
+            assert [e.type for e in events] == [
+                EVENT_POD_ADDED, EVENT_CONTAINER_ADDED]
+            assert pleg.scan_count == base_scans + 1
+            # container churn INSIDE the (now watched) pod dir is seen too
+            pod_dir = self.make_pod_dir(cfg, "besteffort", "pod-w1")
+            os.makedirs(os.path.join(pod_dir, "c2"))
+            events = pleg.poll()
+            assert [e.type for e in events] == [EVENT_CONTAINER_ADDED]
+            assert events[0].container_id == "c2"
+        finally:
+            pleg.stop_watch()
+
+    def test_pod_recreate_between_polls_keeps_watch(self, cfg):
+        # delete + recreate a pod dir with the same uid between two polls:
+        # the kernel dropped the old watch with the dir, so the sync must
+        # re-add unconditionally or container churn inside the NEW dir
+        # would go dark until the rescan interval
+        import shutil
+
+        from koordinator_tpu import native
+
+        if not native.ensure_built():
+            import pytest
+
+            pytest.skip("native lib unavailable")
+        for qos in ("guaranteed", "burstable", "besteffort"):
+            os.makedirs(cfg.cgroup_abs_path("cpu", cfg.kube_qos_dir(qos)),
+                        exist_ok=True)
+        pleg = PLEG(cfg)
+        assert pleg.start_watch()
+        try:
+            pod_dir = self.make_pod_dir(cfg, "besteffort", "pod-r", ["c1"])
+            pleg.poll()                       # pod-r known + watched
+            shutil.rmtree(pod_dir)
+            self.make_pod_dir(cfg, "besteffort", "pod-r", ["c1"])
+            events = pleg.poll()              # same-path recreate
+            # the diff sees no net change (same uid, same containers)...
+            assert events == []
+            # ...but container churn inside the RECREATED dir must still
+            # open the gate immediately
+            os.makedirs(os.path.join(
+                self.make_pod_dir(cfg, "besteffort", "pod-r"), "c2"))
+            events = pleg.poll()
+            assert [e.type for e in events] == [EVENT_CONTAINER_ADDED]
+        finally:
+            pleg.stop_watch()
+
+    def test_rescan_interval_safety_net(self, cfg):
+        from koordinator_tpu import native
+
+        if not native.ensure_built():
+            import pytest
+
+            pytest.skip("native lib unavailable")
+        for qos in ("guaranteed", "burstable", "besteffort"):
+            os.makedirs(cfg.cgroup_abs_path("cpu", cfg.kube_qos_dir(qos)),
+                        exist_ok=True)
+        pleg = PLEG(cfg)
+        assert pleg.start_watch()
+        try:
+            pleg.rescan_every = 3
+            pleg.poll()                   # first poll always scans
+            base = pleg.scan_count
+            pleg.poll()
+            pleg.poll()
+            assert pleg.scan_count == base       # still within interval
+            pleg.poll()                   # third quiet poll forces a rescan
+            assert pleg.scan_count == base + 1
+        finally:
+            pleg.stop_watch()
+
 
 class TestDaemonAssembly:
     def test_daemon_tick(self, tmp_path):
