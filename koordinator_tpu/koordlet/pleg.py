@@ -49,7 +49,6 @@ class PLEG:
         #: behavior contract (and the only path on fake filesystems without
         #: churn notification or where inotify is unavailable).
         self._watcher = None
-        self._watched_pods: dict[str, int] = {}  # pod dir path -> wd
         #: safety net: full rescan at least every N polls even when quiet
         #: (missed events, watch-add races)
         self.rescan_every = 60
@@ -62,26 +61,26 @@ class PLEG:
     # -- native inotify gate -------------------------------------------------
 
     def start_watch(self) -> bool:
-        """Arm the inotify gate over the QoS roots + current pod dirs;
-        False (and scan-every-poll behavior) where unavailable."""
+        """Arm the inotify gate over the QoS roots; False (and
+        scan-every-poll behavior) unless ALL roots could be watched — a
+        partially-armed gate would go dark for pods under a root created
+        later (the daemon retries arming each tick until this succeeds).
+        Pod-dir watches attach on the first poll's forced scan."""
         from koordinator_tpu.native import DirWatcher
 
         watcher = DirWatcher()
         if not watcher.open():
             return False
-        added = 0
         for qos in ("guaranteed", "burstable", "besteffort"):
             base = self.cfg.cgroup_abs_path(
                 self.subsystem, self.cfg.kube_qos_dir(qos))
-            if watcher.add(base) is not None:
-                added += 1
-        if added == 0:
-            watcher.close()
-            return False
+            if watcher.add(base) is None:
+                watcher.close()
+                return False
         self._watcher = watcher
-        self._sync_pod_watches()
         # the first poll after arming must still scan: pods that existed
         # before the watch produce no events but must be reported as added
+        # (and that scan attaches their pod-dir watches)
         self._quiet_polls = self.rescan_every
         return True
 
@@ -89,40 +88,20 @@ class PLEG:
         if self._watcher is not None:
             self._watcher.close()
             self._watcher = None
-            self._watched_pods.clear()
 
-    def _sync_pod_watches(self, live: set[str] | None = None) -> None:
-        """Watch every live pod dir (container churn happens inside them);
-        vanished dirs drop their watches kernel-side automatically.
+    def _sync_pod_watches(self, live: set[str]) -> None:
+        """Watch every live pod dir (container churn happens inside them).
 
-        ``live`` is the pod-dir path set a just-finished scan already
-        collected (avoids a second tree walk); None re-lists the roots.
-        Watches are (re-)added UNCONDITIONALLY for live dirs:
-        inotify_add_watch is idempotent, and a pod dir deleted+recreated
-        between polls keeps its path but lost its kernel watch — gating on
-        the bookkeeping dict would leave the new dir unwatched."""
+        ``live`` is the pod-dir path set the just-finished scan collected
+        (one tree walk serves both the diff and the watch set).  Watches
+        are (re-)added UNCONDITIONALLY: inotify_add_watch is idempotent,
+        and a pod dir deleted+recreated between polls keeps its path but
+        lost its kernel watch.  Vanished dirs drop their watches
+        kernel-side automatically, so no explicit removal is needed."""
         if self._watcher is None:
             return
-        if live is None:
-            live = set()
-            for qos in ("guaranteed", "burstable", "besteffort"):
-                base = self.cfg.cgroup_abs_path(
-                    self.subsystem, self.cfg.kube_qos_dir(qos))
-                try:
-                    entries = os.listdir(base)
-                except OSError:
-                    continue
-                for entry in entries:
-                    path = os.path.join(base, entry)
-                    if POD_DIR_RE.fullmatch(entry) and os.path.isdir(path):
-                        live.add(path)
         for path in live:
-            wd = self._watcher.add(path)
-            if wd is not None:
-                self._watched_pods[path] = wd
-        for path in list(self._watched_pods):
-            if path not in live:
-                del self._watched_pods[path]
+            self._watcher.add(path)
 
     def _scan(self) -> tuple[dict[str, set[str]], set[str]]:
         """(pod uid -> container ids, pod dir paths) in one walk — the

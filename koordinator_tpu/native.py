@@ -130,8 +130,6 @@ def _load_blocking() -> Optional[ctypes.CDLL]:
         lib.ks_watch_open.argtypes = []
         lib.ks_watch_add.restype = ctypes.c_int
         lib.ks_watch_add.argtypes = [ctypes.c_int, ctypes.c_char_p]
-        lib.ks_watch_rm.restype = ctypes.c_int
-        lib.ks_watch_rm.argtypes = [ctypes.c_int, ctypes.c_int]
         lib.ks_watch_poll.restype = ctypes.c_int
         lib.ks_watch_poll.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
@@ -252,11 +250,6 @@ class DirWatcher:
             return None
         wd = lib.ks_watch_add(self._fd, path.encode())
         return wd if wd >= 0 else None
-
-    def remove(self, wd: int) -> None:
-        lib = _load()
-        if lib is not None and self._fd is not None:
-            lib.ks_watch_rm(self._fd, wd)
 
     def poll(self, timeout_ms: int = 0) -> list[tuple[int, str, str]]:
         lib = _load()

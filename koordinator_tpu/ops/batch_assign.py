@@ -92,10 +92,40 @@ def _prefix_accept(
 
     This is the round's conflict resolution: the tensor equivalent of
     higher-priority pods passing through the scheduling cycle first.
+
+    Fast path: when NO segment is oversubscribed (every segment's total
+    proposed request fits its headroom — the common case from round 2 on,
+    once the first round's land grab settles), every active proposer's
+    prefix trivially fits, so the answer is ``active`` and the device-wide
+    stable sort is skipped via ``lax.cond``.  One cheap segment-sum pays
+    for the detection; the sorted path below remains the general case and
+    the single source of truth for contended rounds.
     """
     p, r = requests.shape
     s = free.shape[0]
     seg = jnp.where(active, choice, s)            # inactive -> overflow row
+    req_act = jnp.where(active[:, None], requests, 0)
+    totals = jax.ops.segment_sum(req_act, seg, num_segments=s + 1)[:s]
+    has_prop = (
+        jax.ops.segment_sum(active.astype(jnp.int32), seg,
+                            num_segments=s + 1)[:s] > 0
+    )
+    contended = jnp.any(has_prop[:, None] & (totals > free))
+
+    def fast(_):
+        # total per segment fits => every within-segment prefix fits
+        return active
+
+    def slow(_):
+        return _prefix_accept_sorted(seg, requests, free, order, active)
+
+    return jax.lax.cond(contended, slow, fast, None)
+
+
+def _prefix_accept_sorted(seg, requests, free, order, active):
+    """The general contended-round path: stable sort groups segments in
+    priority order, a segmented prefix-sum checks cumulative fit."""
+    p, r = requests.shape
     seg_o = seg[order]
     req_o = jnp.where(active[order][:, None], requests[order], 0)
     pos = jnp.argsort(seg_o, stable=True)         # group segments, keep order

@@ -220,8 +220,10 @@ void ks_cpi_close(int handle) {
 //                   signals the reference's fsnotify PLEG consumes
 // ks_watch_poll  -> serialize pending events into out as lines
 //                   "<wd> <C|D> <name>\n" (C = appeared, D = vanished);
-//                   returns bytes written, 0 on timeout, or -errno
-// ks_watch_rm / ks_watch_close — cleanup
+//                   returns bytes written, 0 on timeout, or -errno; a
+//                   "-1 C *" line means events were lost (kernel queue or
+//                   out-buffer overflow) — rescan everything
+// ks_watch_close — cleanup (per-dir watches drop with their dirs)
 // ---------------------------------------------------------------------------
 
 int ks_watch_open(void) {
@@ -245,15 +247,6 @@ int ks_watch_add(int fd, const char *path) {
 #endif
 }
 
-int ks_watch_rm(int fd, int wd) {
-#ifndef __linux__
-    (void)fd; (void)wd;
-    return -38;
-#else
-    return inotify_rm_watch(fd, wd) < 0 ? -errno : 0;
-#endif
-}
-
 int ks_watch_poll(int fd, int timeout_ms, char *out, int cap) {
 #ifndef __linux__
     (void)fd; (void)timeout_ms; (void)out; (void)cap;
@@ -266,22 +259,21 @@ int ks_watch_poll(int fd, int timeout_ms, char *out, int cap) {
     char buf[16384];
     ssize_t got = read(fd, buf, sizeof(buf));
     if (got < 0) return errno == EAGAIN ? 0 : -errno;
+    // reserve room for the synthetic overflow marker: events read() has
+    // already consumed from the fd but that don't fit in `out` must still
+    // be signaled, or callers would silently miss real create/deletes
+    const char overflow_line[] = "-1 C *\n";
+    const int marker = (int)sizeof(overflow_line) - 1;
+    if (cap <= marker) return -EINVAL;
+    const int soft_cap = cap - marker;
     int used = 0;
+    int truncated = 0;
     ssize_t off = 0;
     while (off + (ssize_t)sizeof(struct inotify_event) <= got) {
         struct inotify_event *ev = (struct inotify_event *)(buf + off);
         off += sizeof(struct inotify_event) + ev->len;
         if (ev->mask & IN_Q_OVERFLOW) {
-            // overflow: force the caller to fall back to a full scan by
-            // reporting a synthetic "everything may have changed" line
-            // (same capacity guard as below — snprintf with a size that
-            // went <= 0 would be UB, and its would-be return value must
-            // never inflate `used` past bytes actually written)
-            const char overflow_line[] = "-1 C *\n";
-            int need = (int)sizeof(overflow_line) - 1;
-            if (used + need >= cap) break;
-            memcpy(out + used, overflow_line, need);
-            used += need;
+            truncated = 1;   // kernel queue overflowed: marker below
             continue;
         }
         if (ev->len == 0) continue;
@@ -290,9 +282,16 @@ int ks_watch_poll(int fd, int timeout_ms, char *out, int cap) {
         else if (ev->mask & (IN_DELETE | IN_MOVED_FROM)) kind = 'D';
         else continue;
         int need = snprintf(NULL, 0, "%d %c %s\n", ev->wd, kind, ev->name);
-        if (used + need >= cap) break;   // out full: deliver what fits
-        used += snprintf(out + used, cap - used, "%d %c %s\n",
+        if (used + need >= soft_cap) {   // out full: marker signals the rest
+            truncated = 1;
+            break;
+        }
+        used += snprintf(out + used, soft_cap - used, "%d %c %s\n",
                          ev->wd, kind, ev->name);
+    }
+    if (truncated) {
+        memcpy(out + used, overflow_line, marker);
+        used += marker;
     }
     return used;
 #endif

@@ -274,3 +274,49 @@ class TestSolverProperties:
             np.testing.assert_array_equal(delta, expect)
 
         prop()
+
+
+class TestPrefixAcceptFastPath:
+    """The uncontended lax.cond fast path must be indistinguishable from
+    the sorted segmented-prefix path (the single source of truth)."""
+
+    def test_fast_path_matches_sorted_across_seeds(self):
+        from koordinator_tpu.ops.batch_assign import (
+            _prefix_accept,
+            _prefix_accept_sorted,
+        )
+
+        for seed in range(12):
+            rng = np.random.default_rng(seed)
+            p, s, r = 64, 8, 3
+            choice = rng.integers(0, s, p).astype(np.int32)
+            requests = rng.integers(0, 50, (p, r)).astype(np.int32)
+            # seeds alternate between roomy (uncontended) and tight
+            # (contended) headroom so BOTH cond branches are exercised
+            headroom = (rng.integers(500, 4000, (s, r)) if seed % 2 == 0
+                        else rng.integers(0, 120, (s, r))).astype(np.int32)
+            active = rng.random(p) < 0.8
+            order = np.argsort(rng.random(p)).astype(np.int32)
+            got = _prefix_accept(
+                jnp.asarray(choice), jnp.asarray(requests),
+                jnp.asarray(headroom), jnp.asarray(order),
+                jnp.asarray(active))
+            seg = jnp.where(jnp.asarray(active), jnp.asarray(choice), s)
+            want = _prefix_accept_sorted(
+                seg, jnp.asarray(requests), jnp.asarray(headroom),
+                jnp.asarray(order), jnp.asarray(active))
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want), err_msg=f"seed {seed}")
+
+    def test_uncontended_round_accepts_all_proposers(self):
+        from koordinator_tpu.ops.batch_assign import _prefix_accept
+
+        p, s, r = 16, 4, 2
+        choice = jnp.asarray(np.arange(p, dtype=np.int32) % s)
+        requests = jnp.ones((p, r), jnp.int32)
+        headroom = jnp.full((s, r), 100, jnp.int32)   # roomy everywhere
+        active = jnp.asarray(np.array([True] * 12 + [False] * 4))
+        order = jnp.asarray(np.arange(p, dtype=np.int32))
+        got = np.asarray(_prefix_accept(choice, requests, headroom,
+                                        order, active))
+        np.testing.assert_array_equal(got, np.asarray(active))
