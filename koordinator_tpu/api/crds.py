@@ -101,6 +101,13 @@ class ResourceThresholdStrategy:
     cpu_evict_time_window_seconds: int = 60
     memory_evict_threshold_percent: int = 70
     memory_evict_lower_percent: int = 0       # 0 => threshold - 2
+    # allocatable-eviction thresholds (cpu_evict.go:356): requested batch
+    # resource over batch ALLOCATABLE (the colocation model's overcommit),
+    # not physical usage; <0 disables
+    cpu_evict_by_allocatable_threshold_percent: int = -1
+    cpu_evict_by_allocatable_lower_percent: int = -1
+    memory_evict_by_allocatable_threshold_percent: int = -1
+    memory_evict_by_allocatable_lower_percent: int = -1
 
 
 @dataclasses.dataclass(frozen=True)

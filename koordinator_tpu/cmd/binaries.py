@@ -88,6 +88,9 @@ def main_koordlet(argv: list[str], device_report_fn=None,
             dispatcher=None,
             pod_resources=(daemon.pod_resources
                            if daemon.pod_resources.enabled() else None),
+            auditor=(daemon.auditor
+                     if KOORDLET_GATES.enabled("AuditEventsHTTPHandler")
+                     else None),
         )
         daemon.gateway.start()
     return Assembled(name="koordlet", args=args, component=daemon)

@@ -65,6 +65,10 @@ KOORDLET_GATES = FeatureGates({
     "Accelerators": False,
     "RDMADevices": False,
     "CPICollector": False,
+    "Libpfm4": False,
+    "CPUAllocatableEvict": False,
+    "MemoryAllocatableEvict": False,
+    "HamiCoreVGPUMonitor": False,
     "ResctrlCollector": False,
     "PSICollector": True,
     "BlkIOReconcile": False,

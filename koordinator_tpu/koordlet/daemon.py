@@ -20,7 +20,11 @@ from koordinator_tpu.koordlet.metricsadvisor import MetricsAdvisor
 from koordinator_tpu.koordlet.pleg import PLEG
 from koordinator_tpu.koordlet.qosmanager.cpuburst import CPUBurst
 from koordinator_tpu.koordlet.qosmanager.cpusuppress import CPUSuppress
-from koordinator_tpu.koordlet.qosmanager.evict import CPUEvict, MemoryEvict
+from koordinator_tpu.koordlet.qosmanager.evict import (
+    AllocatableEvict,
+    CPUEvict,
+    MemoryEvict,
+)
 from koordinator_tpu.koordlet.qosmanager.framework import (
     Evictor, QOSManager, StrategyContext,
 )
@@ -46,9 +50,18 @@ class Daemon:
         device_report_interval_seconds: float = 60.0,
         pod_resources_upstream_fn: Optional[Callable] = None,
     ):
+        from koordinator_tpu.features import KOORDLET_GATES
+
         self.cfg = cfg or get_config()
         self.clock = clock
-        self.auditor = Auditor(audit_dir) if audit_dir else None
+        # AuditEvents gates recording (the reference's audit events are
+        # no-ops unless the gate is on); the CLI's --audit-log-dir still
+        # chooses WHERE they go
+        self.auditor = (
+            Auditor(audit_dir)
+            if audit_dir and KOORDLET_GATES.enabled("AuditEvents")
+            else None
+        )
         self.metric_cache = mc.MetricCache(clock=clock)
         self.states = StatesInformer(metric_cache=self.metric_cache, clock=clock)
         self.executor = ResourceUpdateExecutor(self.cfg, self.auditor)
@@ -66,6 +79,8 @@ class Daemon:
             suppress,
             CPUEvict(ctx, self.evictor, suppress.be_real_limit_milli),
             MemoryEvict(ctx, self.evictor),
+            AllocatableEvict(ctx, self.evictor, resource="cpu"),
+            AllocatableEvict(ctx, self.evictor, resource="memory"),
             CPUBurst(ctx),
             CgroupReconcile(ctx),
             ResctrlQOS(ctx),

@@ -255,6 +255,58 @@ class XpuCollector:
         return out
 
 
+class HamiVGPUCollector:
+    """HamiCoreVGPUMonitor parity: per-pod vGPU utilization samples from
+    HAMi-core's shared-region dumps.  HAMi-core (the userspace CUDA
+    intercept layer) publishes per-process vGPU core/memory accounting in
+    a host-visible region; the reference's monitor samples it into the
+    metric cache.  The kernel-portable rebuild reads the JSON mirror
+    vendors drop under ``<var_run_root>/hami-vgpu-metrics/`` — one file
+    per (device, pod) with uuid/podUID/coreUtilPct/memoryUsedBytes."""
+
+    name = "hami-vgpu"
+
+    def __init__(self, deps):
+        self.d = deps
+
+    @property
+    def root(self) -> str:
+        return os.path.join(self.d.cfg.var_run_root, "hami-vgpu-metrics")
+
+    def enabled(self) -> bool:
+        from koordinator_tpu.features import KOORDLET_GATES
+
+        return (KOORDLET_GATES.enabled("HamiCoreVGPUMonitor")
+                and os.path.isdir(self.root))
+
+    def collect(self) -> None:
+        now = self.d.clock()
+        try:
+            files = sorted(os.listdir(self.root))
+        except OSError:
+            return
+        for fn in files:
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.root, fn)) as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                continue
+            labels = {"uuid": str(data.get("uuid", "")),
+                      "pod_uid": str(data.get("podUID", ""))}
+            self.d.cache.append(
+                mc.HAMI_VGPU_CORE_USAGE,
+                float(data.get("coreUtilPct", 0.0)), labels=labels, ts=now)
+            self.d.cache.append(
+                mc.HAMI_VGPU_MEM_USED,
+                float(data.get("memoryUsedBytes", 0.0)), labels=labels,
+                ts=now)
+
+    def device_infos(self) -> list["crds.DeviceInfo"]:
+        return []  # metrics-only: inventory comes from the GPU collector
+
+
 def device_infos_to_inventory(
     infos: list["crds.DeviceInfo"],
 ) -> dict[str, list[dict]]:

@@ -29,6 +29,7 @@ CONTAINER_CPU_THROTTLED = "container_cpu_throttled_ratio"
 BE_CPU_USAGE = "be_cpu_usage"
 SYS_CPU_USAGE = "sys_cpu_usage"
 SYS_MEMORY_USAGE = "sys_memory_usage"
+NODE_PERCPU_USAGE = "node_percpu_usage"      # cores; labels: cpu
 NODE_CPI_FIELD = "node_cpi"
 POD_CPI = "pod_cpi"                          # labels: pod_uid
 CONTAINER_CPI = "container_cpi"              # labels: pod_uid, container_id
@@ -46,6 +47,8 @@ RESCTRL_LLC_OCCUPANCY = "resctrl_llc_occupancy"      # labels: group
 RESCTRL_MBM_TOTAL_RATE = "resctrl_mbm_total_bytes_rate"  # labels: group
 ACCEL_CORE_USAGE = "accel_core_usage_pct"    # labels: minor, uuid, type
 ACCEL_MEM_USED = "accel_mem_used_bytes"      # labels: minor, uuid, type
+HAMI_VGPU_CORE_USAGE = "hami_vgpu_core_usage_pct"  # labels: uuid, pod_uid
+HAMI_VGPU_MEM_USED = "hami_vgpu_mem_used_bytes"    # labels: uuid, pod_uid
 #: KV keys (metric_cache KV store)
 KV_NODE_CPU_INFO = "node_cpu_info"
 KV_NODE_NUMA_INFO = "node_numa_info"

@@ -53,13 +53,18 @@ class NodeResourceCollector:
     def __init__(self, deps: _Deps):
         self.d = deps
         self._last: Optional[_CPUTick] = None
+        self._last_percpu: dict[int, _CPUTick] = {}
 
     def enabled(self) -> bool:
         return os.path.exists(self.d.cfg.proc_path("stat"))
 
     def collect(self) -> None:
+        from koordinator_tpu.features import KOORDLET_GATES
+
         now = self.d.clock()
-        stat = procfs.read_cpu_stat(self.d.cfg)
+        with open(self.d.cfg.proc_path("stat")) as f:
+            raw = f.read()
+        stat = procfs.parse_proc_stat(raw)
         if self._last is not None and now > self._last.ts:
             dt = now - self._last.ts
             cores = (stat.used_jiffies - self._last.value) / (
@@ -67,6 +72,21 @@ class NodeResourceCollector:
             )
             self.d.cache.append(mc.NODE_CPU_USAGE, max(0.0, cores), ts=now)
         self._last = _CPUTick(now, stat.used_jiffies)
+
+        if KOORDLET_GATES.enabled("PerCPUMetric"):
+            # per-core utilization series (PerCPUMetric): same delta step
+            # per "cpuN" row, labeled by core index
+            for cpu, row in procfs.parse_proc_stat_percpu(raw).items():
+                last = self._last_percpu.get(cpu)
+                if last is not None and now > last.ts:
+                    dt = now - last.ts
+                    cores = (row.used_jiffies - last.value) / (
+                        procfs.JIFFIES_PER_SEC * dt
+                    )
+                    self.d.cache.append(
+                        mc.NODE_PERCPU_USAGE, max(0.0, cores),
+                        labels={"cpu": str(cpu)}, ts=now)
+                self._last_percpu[cpu] = _CPUTick(now, row.used_jiffies)
 
         mem = procfs.read_meminfo(self.d.cfg)
         self.d.cache.append(mc.NODE_MEMORY_USAGE, float(mem.used_no_cache), ts=now)
@@ -371,7 +391,11 @@ class CPICollector:
         from koordinator_tpu import native
         from koordinator_tpu.features import KOORDLET_GATES
 
-        return KOORDLET_GATES.enabled("CPICollector") and native.available()
+        # Libpfm4 gates the underlying perf machinery (the reference
+        # inits libpfm only behind it); CPICollector gates the collector
+        return (KOORDLET_GATES.enabled("CPICollector")
+                and KOORDLET_GATES.enabled("Libpfm4")
+                and native.available())
 
     def _counter_for(self, key: str, rel: str) -> Optional[object]:
         from koordinator_tpu import native
@@ -644,6 +668,7 @@ class MetricsAdvisor:
         self.deps = deps
         from koordinator_tpu.koordlet.devices import (
             AcceleratorCollector,
+            HamiVGPUCollector,
             RdmaCollector,
             XpuCollector,
         )
@@ -665,6 +690,7 @@ class MetricsAdvisor:
             AcceleratorCollector(deps),
             RdmaCollector(deps),
             XpuCollector(deps),
+            HamiVGPUCollector(deps),
         ]
 
     def collect_once(self) -> list[str]:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from typing import Mapping, Optional
 
 from koordinator_tpu.koordlet.system import procfs
@@ -23,6 +24,10 @@ class NUMAZone:
     cpu_milli: int
     memory_bytes: int
     cpus: tuple[int, ...]
+    #: per-size hugepage counts ("2048kB" -> n), populated behind the
+    #: HugePageReport gate (the reference reports zone hugepages on the
+    #: NRT the same way)
+    hugepages: Mapping[str, int] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,7 +42,16 @@ class NodeTopology:
 
     def to_annotations(self) -> dict[str, str]:
         """The node-side annotations the scheduler's topology options read."""
+        hugepage_zones = {
+            z.name: dict(z.hugepages) for z in self.zones if z.hugepages
+        }
+        out_hugepages = (
+            {"node.koordinator.sh/hugepages": json.dumps(
+                hugepage_zones, sort_keys=True)}
+            if hugepage_zones else {}
+        )
         return {
+            **out_hugepages,
             "node.koordinator.sh/cpu-topology": json.dumps({
                 "detail": [
                     {"cpu": c.cpu, "core": c.core, "socket": c.socket,
@@ -79,6 +93,30 @@ class NodeTopologyReporter:
             pass
         return 0
 
+    def _zone_hugepages(self, node: int) -> dict[str, int]:
+        """Per-size nr_hugepages for one NUMA zone, behind HugePageReport
+        (sysfs: node<N>/hugepages/hugepages-<size>/nr_hugepages)."""
+        from koordinator_tpu.features import KOORDLET_GATES
+
+        if not KOORDLET_GATES.enabled("HugePageReport"):
+            return {}
+        base = self.cfg.sys_path("devices", "system", "node", f"node{node}",
+                                 "hugepages")
+        out: dict[str, int] = {}
+        try:
+            sizes = sorted(os.listdir(base))
+        except OSError:
+            return {}
+        for entry in sizes:
+            if not entry.startswith("hugepages-"):
+                continue
+            try:
+                with open(os.path.join(base, entry, "nr_hugepages")) as f:
+                    out[entry[len("hugepages-"):]] = int(f.read().strip())
+            except (OSError, ValueError):
+                continue
+        return out
+
     def report(self) -> NodeTopology:
         topology = procfs.read_cpu_topology(self.cfg)
         zones = []
@@ -89,6 +127,7 @@ class NodeTopologyReporter:
                 cpu_milli=len(cpus) * 1000,
                 memory_bytes=self._zone_memory(node),
                 cpus=cpus,
+                hugepages=self._zone_hugepages(node),
             ))
         return NodeTopology(
             zones=tuple(zones),

@@ -87,6 +87,80 @@ class CPUEvict:
         self._low_since = None
 
 
+class AllocatableEvict:
+    """Evict BE pods when their batch-resource REQUESTS outgrow the node's
+    batch ALLOCATABLE (reference cpu_evict.go:356 evictByAllocatable /
+    memory_evict.go's allocatable policy; CPUAllocatableEvict and
+    MemoryAllocatableEvict gates).
+
+    The colocation model shrinks batch allocatable as LS load rises; when
+    already-admitted batch requests exceed ``threshold%`` of the (now
+    smaller) allocatable, pods are evicted lowest-priority /
+    biggest-request first until requests fall to ``lower%``.  This is a
+    REQUEST-vs-MODEL check, not a usage check — it fires even when the
+    node is physically idle, because the promised overcommit is gone.
+    """
+
+    interval_seconds = 1.0
+
+    def __init__(self, ctx: StrategyContext, evictor: Evictor,
+                 resource: str = "cpu"):
+        assert resource in ("cpu", "memory")
+        self.ctx = ctx
+        self.evictor = evictor
+        self.resource = resource
+        self.name = f"{resource}allocatableevict"
+        self.feature_gate = ("CPUAllocatableEvict" if resource == "cpu"
+                             else "MemoryAllocatableEvict")
+        self._batch_resource = (ext.RESOURCE_BATCH_CPU if resource == "cpu"
+                                else ext.RESOURCE_BATCH_MEMORY)
+
+    def _thresholds(self) -> tuple[int, int]:
+        s = self.ctx.node_slo().resource_used_threshold_with_be
+        if self.resource == "cpu":
+            return (s.cpu_evict_by_allocatable_threshold_percent,
+                    s.cpu_evict_by_allocatable_lower_percent)
+        return (s.memory_evict_by_allocatable_threshold_percent,
+                s.memory_evict_by_allocatable_lower_percent)
+
+    def enabled(self) -> bool:
+        s = self.ctx.node_slo().resource_used_threshold_with_be
+        return s.enable and self._thresholds()[0] >= 0
+
+    def update(self) -> None:
+        threshold, lower = self._thresholds()
+        if threshold < 0:
+            return
+        if lower < 0:
+            lower = max(threshold - 2, 0)
+        node = self.ctx.states.get_node()
+        if node is None:
+            return
+        allocatable = int(node.allocatable.get(self._batch_resource, 0))
+        if allocatable <= 0:
+            return
+        requested = sum(
+            int(p.requests.get(self._batch_resource, 0))
+            for p in self.ctx.be_pods()
+        )
+        if requested * 100 <= allocatable * threshold:
+            return
+        target = allocatable * lower // 100
+        to_release = requested - target
+        released = 0
+        for pod in self.ctx.be_pods(sort_for_eviction=True,
+                                    sort_by=self.resource):
+            if released >= to_release:
+                break
+            req = int(pod.requests.get(self._batch_resource, 0))
+            if req <= 0:
+                continue
+            if self.evictor.evict(
+                    pod, f"evictPodByNode{self.resource.capitalize()}"
+                         f"Allocatable"):
+                released += req
+
+
 class MemoryEvict:
     name = "memoryevict"
     interval_seconds = 1.0

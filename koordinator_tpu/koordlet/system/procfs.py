@@ -47,6 +47,18 @@ def parse_proc_stat(content: str) -> CPUStat:
     return CPUStat()
 
 
+def parse_proc_stat_percpu(content: str) -> dict[int, CPUStat]:
+    """Per-CPU rows ("cpu0", "cpu1", ...) of /proc/stat (PerCPUMetric)."""
+    out: dict[int, CPUStat] = {}
+    for line in content.splitlines():
+        parts = line.split()
+        if (parts and parts[0].startswith("cpu")
+                and parts[0] != "cpu" and parts[0][3:].isdigit()):
+            vals = [int(x) for x in parts[1:9]] + [0] * 8
+            out[int(parts[0][3:])] = CPUStat(*vals[:8])
+    return out
+
+
 def read_cpu_stat(cfg: SystemConfig | None = None) -> CPUStat:
     cfg = cfg or get_config()
     with open(cfg.proc_path("stat")) as f:

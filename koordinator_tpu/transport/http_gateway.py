@@ -20,6 +20,8 @@ Routes (all JSON bodies/responses):
     GET  /v1/diagnosis                 -> last round's schedule diagnosis
     GET  /v1/podresources              -> kubelet pod-resources listing
                                           enriched with koord allocations
+    GET  /v1/audit?size=N&group=G      -> recent audit events, newest first
+                                          (AuditEventsHTTPHandler's role)
 
 Handlers delegate to the same objects the framed services use
 (transport/services.py SolveService/HookService, ha.LeaseService's store),
@@ -54,11 +56,13 @@ class HttpGateway:
         dispatcher=None,
         lease_store=None,
         pod_resources=None,
+        auditor=None,
     ):
         self.scheduler = scheduler
         self.dispatcher = dispatcher
         self.lease_store = lease_store
         self.pod_resources = pod_resources
+        self.auditor = auditor
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -134,6 +138,19 @@ class HttpGateway:
                 return req._reply(501,
                                   {"error": "no pod-resources proxy"})
             return req._reply(200, self.pod_resources.list())
+        if method == "GET" and path == "/v1/audit":
+            if self.auditor is None:
+                return req._reply(501, {"error": "no auditor attached"})
+            from urllib.parse import parse_qs
+
+            query = parse_qs(req.path.partition("?")[2])
+            try:
+                size = int(query.get("size", ["100"])[0])
+            except ValueError:
+                return req._reply(400, {"error": "size must be an int"})
+            group = query.get("group", [None])[0]
+            return req._reply(200, {"events": self.auditor.query(
+                limit=size, group=group)})
         m = self._HOOK.match(path)
         if m and method == "POST":
             return self._hook(req, m.group(1))
