@@ -47,9 +47,10 @@ class FeatureGates:
             return out
 
 
-# koordlet gates (pkg/features/koordlet_features.go)
+# koordlet gates — defaults mirror the reference table row for row
+# (pkg/features/koordlet_features.go:214-242)
 KOORDLET_GATES = FeatureGates({
-    "AuditEvents": True,
+    "AuditEvents": False,
     "AuditEventsHTTPHandler": False,
     "BECPUSuppress": True,
     "BECPUManager": False,
@@ -70,7 +71,7 @@ KOORDLET_GATES = FeatureGates({
     "MemoryAllocatableEvict": False,
     "HamiCoreVGPUMonitor": False,
     "ResctrlCollector": False,
-    "PSICollector": True,
+    "PSICollector": False,
     "BlkIOReconcile": False,
     "ColdPageCollector": False,
     "HugePageReport": False,
@@ -92,17 +93,67 @@ RUNTIMEHOOK_GATES = FeatureGates({
     "TerwayQoS": False,
 })
 
-# manager/scheduler gates (pkg/features/features.go, scheduler_features.go)
+# manager/scheduler gates — the union of the reference's two tables
+# (pkg/features/features.go:118-169 and scheduler_features.go:146-171;
+# overlapping names carry identical defaults in both).  The reference's
+# vendored-k8s informer-compat shims (Compatible*/Disable*Informer and
+# the GA leftovers CSIStorageCapacity/GenericEphemeralVolume/
+# PodDisruptionBudget) are included for flag-surface parity even though
+# this design has no client-go informers behind them.
 SCHEDULER_GATES = FeatureGates({
+    # webhook surface (features.go)
+    "PodMutatingWebhook": True,
+    "PodValidatingWebhook": True,
+    "ElasticQuotaMutatingWebhook": True,
+    "ElasticQuotaValidatingWebhook": True,
+    "NodeMutatingWebhook": False,
+    "NodeValidatingWebhook": False,
+    "ConfigMapValidatingWebhook": False,
+    "ReservationMutatingWebhook": False,
+    "WebhookFramework": True,
+    "ColocationProfileSkipMutatingResources": False,
+    "ColocationProfileSkipValidatingPriority": False,
+    "BindingAdmissionWebhook": False,
+    "ValidatePodDeviceResource": False,
+    "EnablePodEnhancedValidator": False,
+    "DisableExtendedResourceSpec": False,
+    "DisableDeviceResourceSpec": False,
+    # quota (features.go + scheduler_features.go)
     "MultiQuotaTree": False,
+    "ElasticQuotaIgnorePodOverhead": False,
+    "ElasticQuotaIgnoreTerminatingPod": False,
+    "ElasticQuotaImmediateIgnoreTerminatingPod": False,
     "ElasticQuotaGuaranteeUsage": False,
     "ElasticQuotaEnableUpdateResourceKey": False,
+    "ElasticQuotaEvaluationTransformPod": False,
+    "DisableDefaultQuota": False,
+    "SupportParentQuotaSubmitPod": False,
+    "EnableQuotaAdmission": False,
+    # manager controllers / transformers (features.go)
+    "EnableSyncGPUSharedResource": False,
+    "ColocationProfileController": False,
+    "DisablePVCReservation": False,
+    "PriorityTransformer": False,
+    "PreemptionPolicyTransformer": False,
+    "ReplaceResourcesTransformer": False,
+    # scheduler (scheduler_features.go)
+    "CompatibleCSIStorageCapacity": False,
+    "DisableCSIStorageCapacityInformer": False,
+    "CompatiblePodDisruptionBudget": False,
+    "DisablePodDisruptionBudgetInformer": False,
+    "DisableDynamicResourceAllocationInformer": False,
     "ResizePod": False,
     "LazyReservationRestore": False,
+    "OmitNodeLabelsForReservation": False,
+    "SkipReservationFitsNode": False,
     "DevicePluginAdaption": False,
+    "CleanExpiredReservationAllocated": False,
+    "SkipFilterWithNominatedPods": False,
+    "DynamicSchedulerCheck": True,
+    "CSIStorageCapacity": True,
+    "GenericEphemeralVolume": True,
+    "PodDisruptionBudget": True,
+    "SyncBarrier": False,
     "CrossSchedulerNomination": False,
-    "SyncBarrier": True,
-    "GangPendingPodsConditionPatch": False,
-    "ColocationProfileSkipMutatingHandler": False,
-    "WebhookFramework": True,
+    "GangPendingPodsConditionPatch": True,
 })

@@ -124,7 +124,15 @@ class Evictor:
             return False
         ok = True
         if self.kill_handler is not None:
-            ok = self.kill_handler(pod, reason)
+            # None (a fire-and-forget handler with no opinion) counts as
+            # success: the reference accounts released capacity from the
+            # pods it SELECTS (cpu_evict.go:356 calculateMilliRelease*),
+            # not from the eviction API's result, so a bare callback must
+            # not zero the released tally (which would over-evict past
+            # the lower-percent target).  Any other return is truth-
+            # tested, so False, 0, and numpy False all mean failure.
+            result = self.kill_handler(pod, reason)
+            ok = result is None or bool(result)
         if ok:
             from koordinator_tpu.metrics import pod_eviction_total
 

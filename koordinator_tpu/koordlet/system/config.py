@@ -105,7 +105,7 @@ def set_config(cfg: SystemConfig) -> SystemConfig:
     return prev
 
 
-def test_config(root: str | Path, use_cgroup_v2: bool = False) -> SystemConfig:
+def make_test_config(root: str | Path, use_cgroup_v2: bool = False) -> SystemConfig:
     """A config fully rooted under ``root`` (the FileTestUtil equivalent)."""
     root = str(root)
     return SystemConfig(

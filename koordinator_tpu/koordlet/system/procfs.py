@@ -237,8 +237,31 @@ def read_cpu_topology(cfg: SystemConfig | None = None) -> CPUTopology:
     """Build topology from /sys/devices/system/cpu (lscpu.go equivalent)."""
     cfg = cfg or get_config()
     base = cfg.sys_path("devices", "system", "cpu")
-    with open(os.path.join(base, "online")) as f:
-        online = parse_cpu_list(f.read())
+    try:
+        with open(os.path.join(base, "online")) as f:
+            online = parse_cpu_list(f.read())
+    except OSError:
+        # No global `online` file (some containers/sysfs mounts omit
+        # it): fall back to enumerating cpuN directories, honoring each
+        # cpu's own online file — absent means online (kernel semantics:
+        # cpu0 commonly has none), "0" means offlined (e.g. disabled SMT
+        # siblings) and must stay out of the topology.
+        def cpu_online(cpu: int) -> bool:
+            try:
+                with open(os.path.join(base, f"cpu{cpu}", "online")) as f:
+                    return f.read().strip() != "0"
+            except OSError:
+                return True
+
+        try:
+            online = sorted(
+                cpu for cpu in (
+                    int(e[3:]) for e in os.listdir(base)
+                    if e.startswith("cpu") and e[3:].isdigit()
+                ) if cpu_online(cpu)
+            )
+        except OSError:
+            online = []
 
     def read_int(path: str, default: int = 0) -> int:
         try:

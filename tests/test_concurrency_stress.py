@@ -80,7 +80,7 @@ def test_resource_executor_concurrent_update_same_files(tmp_path):
     from koordinator_tpu.koordlet.resourceexecutor import (
         ResourceUpdate, ResourceUpdateExecutor)
     from koordinator_tpu.koordlet.system import cgroup as cg
-    from koordinator_tpu.koordlet.system.config import test_config as make_test_config
+    from koordinator_tpu.koordlet.system.config import make_test_config
 
     cfg = make_test_config(tmp_path)
     path = cfg.cgroup_abs_path(cg.CPU_SHARES.subsystem, "kubepods",

@@ -33,7 +33,7 @@ from koordinator_tpu.koordlet.runtimehooks.plugins import (
 from koordinator_tpu.koordlet.runtimehooks.protocol import PodContext
 from koordinator_tpu.koordlet.statesinformer import PodMeta, StatesInformer
 from koordinator_tpu.koordlet.system import cgroup as cg
-from koordinator_tpu.koordlet.system.config import test_config as make_test_config
+from koordinator_tpu.koordlet.system.config import make_test_config
 
 
 @pytest.fixture

@@ -17,7 +17,7 @@ from koordinator_tpu.api.qos import QoSClass
 from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim, resource_vector
 from koordinator_tpu.koordlet.daemon import Daemon
 from koordinator_tpu.koordlet.statesinformer import NodeInfo, PodMeta
-from koordinator_tpu.koordlet.system.config import test_config as make_test_config
+from koordinator_tpu.koordlet.system.config import make_test_config
 from koordinator_tpu.manager import sloconfig
 from koordinator_tpu.manager.nodemetric import NodeMetricController
 from koordinator_tpu.manager.noderesource_controller import (

@@ -1,0 +1,89 @@
+"""Gate-default parity audit against the reference's featuregate tables.
+
+Round-3 shipped ``AuditEvents: True`` while the reference defaults it
+false (pkg/features/koordlet_features.go:215); this test makes that class
+of drift impossible by diffing EVERY default in ``features.py`` against
+the reference's three Go tables, parsed straight from the source:
+
+- pkg/features/koordlet_features.go:214-242      -> KOORDLET_GATES
+- pkg/koordlet/runtimehooks/config.go:108-117    -> RUNTIMEHOOK_GATES
+- pkg/features/features.go + scheduler_features.go -> SCHEDULER_GATES
+  (the union; overlapping names carry identical defaults in both)
+
+Skips when the reference checkout is absent (other machines/CI).
+"""
+
+import os
+import re
+
+import pytest
+
+from koordinator_tpu.features import (
+    KOORDLET_GATES,
+    RUNTIMEHOOK_GATES,
+    SCHEDULER_GATES,
+)
+
+REF = "/root/reference"
+
+GO_DEFAULT_RE = re.compile(
+    r"^\s*(\w+):\s*\{Default:\s*(true|false)\b", re.MULTILINE
+)
+
+
+def parse_go_defaults(*paths):
+    out = {}
+    for path in paths:
+        with open(path) as f:
+            src = f.read()
+        for name, default in GO_DEFAULT_RE.findall(src):
+            val = default == "true"
+            if name in out and out[name] != val:
+                raise AssertionError(
+                    f"reference tables disagree on {name}: {out[name]} vs {val}"
+                )
+            out[name] = val
+    return out
+
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(os.path.join(REF, "pkg", "features")),
+    reason="reference checkout not available",
+)
+
+
+def assert_parity(gates, expected, *, what):
+    ours = gates.known()
+    mismatched = {
+        name: (ours[name], expected[name])
+        for name in set(ours) & set(expected)
+        if ours[name] != expected[name]
+    }
+    assert not mismatched, (
+        f"{what} defaults diverge from the reference "
+        f"(ours, reference): {mismatched}"
+    )
+    missing = set(expected) - set(ours)
+    assert not missing, f"{what} gates missing from our registry: {missing}"
+
+
+def test_koordlet_gate_defaults_match_reference():
+    expected = parse_go_defaults(
+        os.path.join(REF, "pkg", "features", "koordlet_features.go")
+    )
+    assert_parity(KOORDLET_GATES, expected, what="koordlet")
+
+
+def test_runtimehook_gate_defaults_match_reference():
+    expected = parse_go_defaults(
+        os.path.join(REF, "pkg", "koordlet", "runtimehooks", "config.go")
+    )
+    assert_parity(RUNTIMEHOOK_GATES, expected, what="runtimehooks")
+
+
+def test_scheduler_manager_gate_defaults_match_reference():
+    expected = parse_go_defaults(
+        os.path.join(REF, "pkg", "features", "features.go"),
+        os.path.join(REF, "pkg", "features", "scheduler_features.go"),
+    )
+    assert_parity(SCHEDULER_GATES, expected, what="scheduler/manager")

@@ -14,12 +14,12 @@ from koordinator_tpu.api.qos import QoSClass
 from koordinator_tpu.features import KOORDLET_GATES
 from koordinator_tpu.koordlet import metriccache as mc
 from koordinator_tpu.koordlet.statesinformer import NodeInfo, PodMeta, StatesInformer
-from koordinator_tpu.koordlet.system.config import test_config
+from koordinator_tpu.koordlet.system.config import make_test_config
 
 
 @pytest.fixture
 def cfg(tmp_path):
-    return test_config(tmp_path)
+    return make_test_config(tmp_path)
 
 
 def gate(name):

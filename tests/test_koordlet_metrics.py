@@ -11,7 +11,7 @@ from koordinator_tpu.koordlet.statesinformer import (
     ContainerMeta, NodeInfo, PodMeta, StatesInformer,
 )
 from koordinator_tpu.koordlet.system import cgroup as cg
-from koordinator_tpu.koordlet.system.config import test_config as make_test_config
+from koordinator_tpu.koordlet.system.config import make_test_config
 from tests.test_koordlet_system import write_cgroup_file
 
 

@@ -9,7 +9,7 @@ from koordinator_tpu.koordlet import resourceexecutor as rex
 from koordinator_tpu.koordlet.audit import Auditor
 from koordinator_tpu.koordlet.system import cgroup as cg
 from koordinator_tpu.koordlet.system import coresched, procfs, psi, resctrl
-from koordinator_tpu.koordlet.system.config import test_config as make_test_config
+from koordinator_tpu.koordlet.system.config import make_test_config
 
 
 @pytest.fixture

@@ -66,11 +66,11 @@ class TestCollectorChurnRebuild:
         from koordinator_tpu.koordlet import metricsadvisor as ma
         from koordinator_tpu.koordlet.statesinformer import PodMeta, StatesInformer
         from koordinator_tpu.koordlet.system import cgroup as cg
-        from koordinator_tpu.koordlet.system.config import test_config
+        from koordinator_tpu.koordlet.system.config import make_test_config
         from tests.test_koordlet_metrics import FakeClock
         from tests.test_koordlet_system import write_cgroup_file
 
-        cfg = test_config(tmp_path)
+        cfg = make_test_config(tmp_path)
         clock = FakeClock()
         states = StatesInformer(clock=clock)
         cache = mc.MetricCache(clock=clock)

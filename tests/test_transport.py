@@ -538,7 +538,7 @@ def test_koordlet_device_report_feeds_scheduler_over_wire(rpc, tmp_path):
     from koordinator_tpu.koordlet.daemon import Daemon
     from koordinator_tpu.koordlet.devices import device_infos_to_inventory
     from koordinator_tpu.koordlet.system.config import (
-        test_config as make_test_config,
+        make_test_config,
     )
     from koordinator_tpu.scheduler.cpu_manager import CPUManager
     from koordinator_tpu.scheduler.device_manager import DeviceManager
