@@ -214,6 +214,104 @@ class StateSyncService:
     def attach(self, server) -> None:
         self._server = server
         server.register(FrameType.HELLO, self._handle_hello)
+        server.register(FrameType.STATE_PUSH, self._handle_state_push)
+
+    def _handle_state_push(self, doc: dict, arrays):
+        """Client-originated state event (wire v3): the direction a
+        non-Python scheduler plugin feeds its informer view into the
+        sidecar (the reference's Go plugin holds the informers; the
+        sidecar only knows what it is told — frameworkext/interface.go:70
+        passes cluster state INTO plugins the same way).  The event takes
+        the normal commit path, so every sync client — including the
+        pusher — sees it back as an rv-ordered DELTA."""
+        kind = doc.get("kind")
+        name = doc["name"]
+
+        def require_vector(key):
+            """Validate a pushed resource vector BEFORE it is committed:
+            a malformed array from a foreign client must fail ITS call,
+            not enter the replay log where it would poison every sync
+            client (including future bootstrappers) with a bad row."""
+            from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+
+            if key not in arrays:
+                raise wire.WireSchemaError(
+                    f"{kind} push requires a {key!r} array")
+            arr = np.asarray(arrays[key])
+            if arr.ndim != 1 or arr.shape[0] != NUM_RESOURCE_DIMS:
+                raise wire.WireSchemaError(
+                    f"{kind} push: {key!r} must have shape "
+                    f"({NUM_RESOURCE_DIMS},), got {arr.shape}")
+            if arr.dtype.kind not in "iu":
+                raise wire.WireSchemaError(
+                    f"{kind} push: {key!r} must be an integer vector, "
+                    f"got dtype {arr.dtype}")
+            if arr.size and (int(arr.max()) > 2**31 - 1
+                             or int(arr.min()) < -(2**31)):
+                # wider dtypes are fine as encodings, but values the
+                # int32 state tensors cannot hold would wrap silently
+                raise wire.WireSchemaError(
+                    f"{kind} push: {key!r} has values outside int32 "
+                    f"range (canonical units are milli-cores / MiB)")
+
+        def require_doc(key, types, type_name):
+            """Same poison-guard for the doc's typed fields: a string
+            where a mapping belongs would commit fine and then crash
+            every sync client's binding on replay."""
+            val = doc.get(key)
+            if val is not None and not isinstance(val, types):
+                raise wire.WireSchemaError(
+                    f"{kind} push: field {key!r} must be {type_name} "
+                    f"or absent, got {type(val).__name__}")
+
+        for mapping_field in ("labels", "taints", "annotations",
+                              "devices", "node_selector", "tolerations"):
+            require_doc(mapping_field, dict, "an object")
+        require_doc("owners", list, "a list")
+        for scalar_field in ("quota", "gang", "owner", "node"):
+            require_doc(scalar_field, str, "a string")
+        for int_field in ("priority", "qos"):
+            require_doc(int_field, int, "an integer")
+        require_doc("ttl_sec", (int, float), "a number")
+        for bool_field in ("allocate_once", "restricted"):
+            require_doc(bool_field, bool, "a boolean")
+
+        if kind == NODE_UPSERT:
+            require_vector("allocatable")
+            if "usage" in arrays:
+                require_vector("usage")
+            rv = self.upsert_node(
+                name, arrays["allocatable"], usage=arrays.get("usage"),
+                labels=doc.get("labels"), taints=doc.get("taints"),
+                annotations=doc.get("annotations"),
+                devices=doc.get("devices"))
+        elif kind == NODE_REMOVE:
+            rv = self.remove_node(name)
+        elif kind == POD_ADD:
+            require_vector("requests")
+            rv = self.add_pod(
+                name, arrays["requests"],
+                priority=int(doc.get("priority") or 0),
+                quota=doc.get("quota"), gang=doc.get("gang"),
+                node_selector=doc.get("node_selector"),
+                labels=doc.get("labels"), owner=doc.get("owner"),
+                qos=int(doc.get("qos") or 0))
+        elif kind == POD_REMOVE:
+            rv = self.remove_pod(name)
+        elif kind == RSV_UPSERT:
+            require_vector("requests")
+            rv = self.upsert_reservation(
+                name, arrays["requests"], owners=doc.get("owners"),
+                allocate_once=bool(doc.get("allocate_once", False)),
+                ttl_sec=doc.get("ttl_sec"), node=doc.get("node"),
+                node_selector=doc.get("node_selector"),
+                tolerations=doc.get("tolerations"),
+                restricted=bool(doc.get("restricted", False)))
+        elif kind == RSV_REMOVE:
+            rv = self.remove_reservation(name)
+        else:
+            raise wire.WireSchemaError(f"unknown state-push kind {kind!r}")
+        return {"rv": rv}, None
 
     def _snapshot(self) -> tuple[dict, dict[str, np.ndarray]]:
         events = []

@@ -29,7 +29,9 @@ Two version numbers govern the wire:
   plays for the reference).  It is negotiated in HELLO: a client
   advertises its protocol and the server rejects skew with an ERROR
   instead of silently mis-decoding (history: v1 ad-hoc docs; v2 adds
-  typed REQUEST_SCHEMAS, the ``proto`` field in HELLO, and lease frames).
+  typed REQUEST_SCHEMAS, the ``proto`` field in HELLO, and lease frames;
+  v3 adds STATE_PUSH — client-originated state events, the direction a
+  non-Python scheduler plugin feeds its informer view into the sidecar).
 
 ``REQUEST_SCHEMAS`` types each schema'd frame's json document;
 ``validate_doc`` is enforced server-side on every request frame, so a
@@ -47,7 +49,7 @@ import numpy as np
 
 MAGIC = 0x4B54
 VERSION = 1
-PROTOCOL_VERSION = 2
+PROTOCOL_VERSION = 3
 _HEADER = struct.Struct("<HBBII")
 MAX_PAYLOAD = 256 << 20  # 256 MiB guard against corrupt length words
 
@@ -65,6 +67,8 @@ class FrameType(enum.IntEnum):
     PING = 10
     LEASE_GET = 11      # {name} -> lease record fields
     LEASE_UPDATE = 12   # CAS write: {name, expect_holder, <record>} -> {ok}
+    STATE_PUSH = 13     # client-originated state event -> {rv}; the
+                        # Go-plugin/informer -> sidecar feed direction
 
 
 class WireSchemaError(ValueError):
@@ -102,6 +106,12 @@ REQUEST_SCHEMAS: dict[FrameType, dict[str, tuple]] = {
         "acquire_time": ((int, float), True),
         "renew_time": ((int, float), True),
         "transitions": (int, True),
+    },
+    FrameType.STATE_PUSH: {
+        "kind": (str, True),
+        "name": (str, True),
+        # event-kind-specific fields (labels, priority, quota, ...) ride
+        # as extras; resource vectors ride the raw array section
     },
 }
 

@@ -1,0 +1,404 @@
+/* Non-Python conformance client for the framed wire protocol (v3).
+ *
+ * Proves the sidecar boundary is language-neutral — the role the
+ * reference assigns to its versioned proto contract
+ * (apis/runtime/v1alpha1/api.proto:148) and the frameworkext plugin
+ * seam (pkg/scheduler/frameworkext/interface.go:70): a peer with no
+ * Python, no numpy, and no shared code completes the full protocol:
+ *
+ *   1. HELLO with a stale protocol number  -> ERROR (skew rejected)
+ *   2. HELLO {last_rv:-1, proto:3}         -> SNAPSHOT (+ array section)
+ *   3. STATE_PUSH node_upsert / pod_add    -> {rv} (arrays encoded here,
+ *      little-endian int32, manifest JSON written by hand)
+ *   4. DELTA pushes (request_id 0) observed for our own events
+ *   5. SOLVE_REQUEST                       -> SOLVE_RESPONSE assignments
+ *   6. LEASE_GET / LEASE_UPDATE CAS        -> acquire ok, bad CAS refused
+ *
+ * Output: one JSON result line on stdout; exit 0 iff every step held.
+ * The matching harness is tests/test_c_conformance.py.
+ *
+ * Wire format (transport/wire.py):
+ *   header  <u16 magic=0x4B54><u8 ver=1><u8 type><u32 req_id><u32 len>
+ *   payload <u32 json_len><json utf-8><raw array section>
+ * JSON parsing here is a deliberately small scanner (find key, read
+ * scalar / balanced object) — enough for the compact single-level
+ * documents the server emits, with no third-party dependency.
+ */
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#define MAGIC 0x4B54
+#define WIRE_VERSION 1
+#define PROTO 3
+
+enum {
+    F_HELLO = 1, F_SNAPSHOT = 2, F_DELTA = 3, F_ACK = 4, F_ERROR = 5,
+    F_SOLVE_REQUEST = 6, F_SOLVE_RESPONSE = 7, F_PING = 10,
+    F_LEASE_GET = 11, F_LEASE_UPDATE = 12, F_STATE_PUSH = 13,
+};
+
+static int R_VEC = 10; /* resource vector length; argv[3] overrides */
+
+static int die(const char *msg) {
+    fprintf(stderr, "conformance_client: FAIL: %s\n", msg);
+    exit(1);
+}
+
+/* ---- socket helpers ---------------------------------------------------- */
+
+static int g_sock = -1;
+
+static void send_all(const void *buf, size_t n) {
+    const char *p = buf;
+    while (n > 0) {
+        ssize_t w = send(g_sock, p, n, 0);
+        if (w <= 0) die("send failed");
+        p += w;
+        n -= (size_t)w;
+    }
+}
+
+static void recv_all(void *buf, size_t n) {
+    char *p = buf;
+    while (n > 0) {
+        ssize_t r = recv(g_sock, p, n, 0);
+        if (r <= 0) die("recv failed (peer closed or timeout)");
+        p += r;
+        n -= (size_t)r;
+    }
+}
+
+/* ---- frame encode/decode ---------------------------------------------- */
+
+struct frame {
+    uint8_t type;
+    uint32_t req_id;
+    uint32_t len;     /* payload length */
+    char *payload;    /* malloc'd; json starts at payload+4 */
+    uint32_t json_len;
+    char *json;       /* NUL-terminated copy of the json document */
+};
+
+/* payload = u32 json_len | json | arrays; header packed little-endian */
+static void send_frame(uint8_t type, uint32_t req_id, const char *json,
+                       const void *arrays, uint32_t arrays_len) {
+    uint32_t jlen = (uint32_t)strlen(json);
+    uint32_t plen = 4 + jlen + arrays_len;
+    unsigned char header[12];
+    header[0] = MAGIC & 0xff;
+    header[1] = MAGIC >> 8;
+    header[2] = WIRE_VERSION;
+    header[3] = type;
+    memcpy(header + 4, &req_id, 4);   /* host is little-endian (x86) */
+    memcpy(header + 8, &plen, 4);
+    send_all(header, 12);
+    send_all(&jlen, 4);
+    send_all(json, jlen);
+    if (arrays_len) send_all(arrays, arrays_len);
+}
+
+static void read_one_frame(struct frame *f) {
+    unsigned char header[12];
+    recv_all(header, 12);
+    uint16_t magic = (uint16_t)(header[0] | (header[1] << 8));
+    if (magic != MAGIC) die("bad frame magic");
+    if (header[2] != WIRE_VERSION) die("bad wire version");
+    f->type = header[3];
+    memcpy(&f->req_id, header + 4, 4);
+    memcpy(&f->len, header + 8, 4);
+    if (f->len > (64u << 20)) die("oversized frame");
+    f->payload = malloc(f->len + 1);
+    if (!f->payload) die("oom");
+    recv_all(f->payload, f->len);
+    if (f->len < 4) die("short payload");
+    memcpy(&f->json_len, f->payload, 4);
+    if (4 + f->json_len > f->len) die("json_len exceeds payload");
+    f->json = malloc(f->json_len + 1);
+    if (!f->json) die("oom");
+    memcpy(f->json, f->payload + 4, f->json_len);
+    f->json[f->json_len] = 0;
+}
+
+static void free_frame(struct frame *f) {
+    free(f->payload);
+    free(f->json);
+    f->payload = f->json = NULL;
+}
+
+/* Read frames until one answers req_id; pushes (req_id 0) are counted
+ * per-type in push_counts and their rv (if any) recorded. */
+static int g_push_counts[16];
+static long g_last_push_rv = -1;
+
+static long json_find_long(const char *doc, const char *key, long dflt);
+
+static void await_reply(uint32_t req_id, struct frame *out) {
+    for (;;) {
+        read_one_frame(out);
+        if (out->req_id == req_id) return;
+        if (out->req_id == 0) {
+            if (out->type < 16) g_push_counts[out->type]++;
+            long rv = json_find_long(out->json, "rv", -1);
+            if (rv > g_last_push_rv) g_last_push_rv = rv;
+        }
+        free_frame(out);
+    }
+}
+
+/* ---- minimal JSON scanning -------------------------------------------- */
+
+/* Find `"key":` at any nesting level (documents here never repeat key
+ * names at different depths in conflicting ways) and return a pointer
+ * just past the colon, or NULL. */
+static const char *json_value_of(const char *doc, const char *key) {
+    char pat[128];
+    snprintf(pat, sizeof pat, "\"%s\":", key);
+    const char *p = strstr(doc, pat);
+    return p ? p + strlen(pat) : NULL;
+}
+
+static long json_find_long(const char *doc, const char *key, long dflt) {
+    const char *p = json_value_of(doc, key);
+    if (!p) return dflt;
+    return strtol(p, NULL, 10);
+}
+
+static int json_find_bool(const char *doc, const char *key, int dflt) {
+    const char *p = json_value_of(doc, key);
+    if (!p) return dflt;
+    return strncmp(p, "true", 4) == 0;
+}
+
+/* Copy the balanced {...} object that starts at the value of `key`. */
+static char *json_find_object(const char *doc, const char *key) {
+    const char *p = json_value_of(doc, key);
+    if (!p || *p != '{') return NULL;
+    int depth = 0;
+    const char *q = p;
+    int in_str = 0;
+    for (; *q; q++) {
+        if (in_str) {
+            if (*q == '\\' && q[1]) q++;
+            else if (*q == '"') in_str = 0;
+            continue;
+        }
+        if (*q == '"') in_str = 1;
+        else if (*q == '{') depth++;
+        else if (*q == '}' && --depth == 0) { q++; break; }
+    }
+    size_t n = (size_t)(q - p);
+    char *out = malloc(n + 1);
+    if (!out) die("oom");
+    memcpy(out, p, n);
+    out[n] = 0;
+    return out;
+}
+
+/* Count `"kind":"..."` occurrences (events in a snapshot/delta doc). */
+static int count_occurrences(const char *doc, const char *needle) {
+    int n = 0;
+    for (const char *p = doc; (p = strstr(p, needle)); p += strlen(needle))
+        n++;
+    return n;
+}
+
+/* Validate every __arrays__ manifest entry fits the raw section. */
+static int arrays_manifest_ok(const struct frame *f) {
+    const char *doc = f->json;
+    uint32_t raw_len = f->len - 4 - f->json_len;
+    const char *p = json_value_of(doc, "__arrays__");
+    if (!p) return 1; /* no arrays: trivially consistent */
+    while ((p = strstr(p, "\"offset\":"))) {
+        long off = strtol(p + 9, NULL, 10);
+        const char *nb = strstr(p, "\"nbytes\":");
+        if (!nb) return 0;
+        long nbytes = strtol(nb + 9, NULL, 10);
+        if (off < 0 || nbytes < 0 || (uint32_t)(off + nbytes) > raw_len)
+            return 0;
+        p = nb + 9;
+    }
+    return 1;
+}
+
+/* ---- steps ------------------------------------------------------------- */
+
+static uint32_t g_req_id = 1;
+
+int main(int argc, char **argv) {
+    if (argc != 3 && argc != 4)
+        die("usage: conformance_client HOST PORT [RESOURCE_DIMS]");
+    if (argc == 4) R_VEC = atoi(argv[3]);
+    if (R_VEC < 2 || R_VEC > 64) die("bad RESOURCE_DIMS");
+
+    struct addrinfo hints = {0}, *res;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(argv[1], argv[2], &hints, &res) != 0 || !res)
+        die("resolve failed");
+    g_sock = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (g_sock < 0 || connect(g_sock, res->ai_addr, res->ai_addrlen) != 0)
+        die("connect failed");
+    freeaddrinfo(res);
+    struct timeval tv = {30, 0};
+    setsockopt(g_sock, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+
+    struct frame f;
+
+    /* 1. protocol-skew rejection: HELLO with an old protocol number */
+    send_frame(F_HELLO, g_req_id, "{\"last_rv\":-1,\"proto\":1}", NULL, 0);
+    await_reply(g_req_id++, &f);
+    int skew_rejected = (f.type == F_ERROR);
+    free_frame(&f);
+
+    /* 2. real HELLO -> SNAPSHOT (the connection survives the ERROR) */
+    char hello[64];
+    snprintf(hello, sizeof hello, "{\"last_rv\":-1,\"proto\":%d}", PROTO);
+    send_frame(F_HELLO, g_req_id, hello, NULL, 0);
+    await_reply(g_req_id++, &f);
+    if (f.type != F_SNAPSHOT) die("expected SNAPSHOT after HELLO");
+    long snapshot_rv = json_find_long(f.json, "rv", -1);
+    int snapshot_events = count_occurrences(f.json, "\"kind\":");
+    int snapshot_arrays_ok = arrays_manifest_ok(&f);
+    free_frame(&f);
+    if (snapshot_rv < 0) die("snapshot carried no rv");
+
+    /* 3. push OUR node + pod into the sidecar: the Go-plugin feed
+     * direction.  Arrays are hand-encoded little-endian int32 rows. */
+    size_t vec_bytes = (size_t)R_VEC * sizeof(int32_t);
+    int32_t *both = calloc(2 * (size_t)R_VEC, sizeof(int32_t));
+    if (!both) die("oom");
+    both[0] = 16000;  /* cpu millicores */
+    both[1] = 65536;  /* memory MiB */
+    char doc[512];
+    snprintf(doc, sizeof doc,
+             "{\"kind\":\"node_upsert\",\"name\":\"c-node\","
+             "\"labels\":{\"made-in\":\"c\"},"
+             "\"__arrays__\":["
+             "{\"key\":\"allocatable\",\"dtype\":\"<i4\",\"shape\":[%d],"
+             "\"offset\":0,\"nbytes\":%zu},"
+             "{\"key\":\"usage\",\"dtype\":\"<i4\",\"shape\":[%d],"
+             "\"offset\":%zu,\"nbytes\":%zu}]}",
+             R_VEC, vec_bytes, R_VEC, vec_bytes, vec_bytes);
+    send_frame(F_STATE_PUSH, g_req_id, doc, both, 2 * vec_bytes);
+    await_reply(g_req_id++, &f);
+    if (f.type != F_ACK) die("node state-push not acked");
+    long node_rv = json_find_long(f.json, "rv", -1);
+    free_frame(&f);
+
+    int32_t *req_vec = calloc((size_t)R_VEC, sizeof(int32_t));
+    if (!req_vec) die("oom");
+    req_vec[0] = 2000;
+    req_vec[1] = 4096;
+    snprintf(doc, sizeof doc,
+             "{\"kind\":\"pod_add\",\"name\":\"c-pod\",\"priority\":7,"
+             "\"node_selector\":{\"made-in\":\"c\"},"
+             "\"__arrays__\":[{\"key\":\"requests\",\"dtype\":\"<i4\","
+             "\"shape\":[%d],\"offset\":0,\"nbytes\":%zu}]}",
+             R_VEC, vec_bytes);
+    send_frame(F_STATE_PUSH, g_req_id, doc, req_vec, vec_bytes);
+    await_reply(g_req_id++, &f);
+    if (f.type != F_ACK) die("pod state-push not acked");
+    long pod_rv = json_find_long(f.json, "rv", -1);
+    free_frame(&f);
+    if (!(node_rv > snapshot_rv && pod_rv > node_rv))
+        die("state-push rvs not monotonic");
+
+    /* 4. our own events come back as rv-ordered DELTA pushes */
+    while (g_last_push_rv < pod_rv) {
+        read_one_frame(&f);
+        if (f.req_id == 0) {
+            if (f.type < 16) g_push_counts[f.type]++;
+            long rv = json_find_long(f.json, "rv", -1);
+            if (rv > g_last_push_rv) g_last_push_rv = rv;
+        }
+        free_frame(&f);
+    }
+    int deltas_seen = g_push_counts[F_DELTA];
+
+    /* 5. drive scheduling rounds; our pod must land on our node.
+     * Our DELTA arriving back on THIS connection does not mean the
+     * sidecar's own solver feed (a separate sync client) has applied it
+     * yet, so retry the solve until c-pod appears — the same
+     * eventual-consistency polling a real plugin does against informer
+     * lag. */
+    char *assignments = NULL;
+    char c_pod_node[64] = "";
+    long round_pods = -1;
+    for (int attempt = 0; attempt < 100 && !c_pod_node[0]; attempt++) {
+        free(assignments);
+        send_frame(F_SOLVE_REQUEST, g_req_id, "{}", NULL, 0);
+        await_reply(g_req_id++, &f);
+        if (f.type != F_SOLVE_RESPONSE) die("expected SOLVE_RESPONSE");
+        assignments = json_find_object(f.json, "assignments");
+        if (!assignments) die("solve response had no assignments object");
+        round_pods = json_find_long(f.json, "round_pods", -1);
+        free_frame(&f);
+        const char *cpod = strstr(assignments, "\"c-pod\":\"");
+        if (cpod) {
+            cpod += strlen("\"c-pod\":\"");
+            size_t i = 0;
+            while (cpod[i] && cpod[i] != '"' && i < sizeof c_pod_node - 1) {
+                c_pod_node[i] = cpod[i];
+                i++;
+            }
+            c_pod_node[i] = 0;
+        } else {
+            usleep(100 * 1000);
+        }
+    }
+
+    /* 6. lease CAS: read, acquire from empty, then a stale CAS must
+     * be refused (the leader-election safety property) */
+    send_frame(F_LEASE_GET, g_req_id, "{\"name\":\"conformance\"}", NULL, 0);
+    await_reply(g_req_id++, &f);
+    if (f.type != F_ACK) die("lease get failed");
+    free_frame(&f);
+
+    snprintf(doc, sizeof doc,
+             "{\"name\":\"conformance\",\"expect_holder\":\"\","
+             "\"holder\":\"c-client\",\"duration_seconds\":15.0,"
+             "\"acquire_time\":1.0,\"renew_time\":1.0,\"transitions\":0}");
+    send_frame(F_LEASE_UPDATE, g_req_id, doc, NULL, 0);
+    await_reply(g_req_id++, &f);
+    int lease_acquired = (f.type == F_ACK) &&
+        json_find_bool(f.json, "ok", 0);
+    free_frame(&f);
+
+    snprintf(doc, sizeof doc,
+             "{\"name\":\"conformance\",\"expect_holder\":\"someone-else\","
+             "\"holder\":\"thief\",\"duration_seconds\":15.0,"
+             "\"acquire_time\":2.0,\"renew_time\":2.0,\"transitions\":1}");
+    send_frame(F_LEASE_UPDATE, g_req_id, doc, NULL, 0);
+    await_reply(g_req_id++, &f);
+    int stale_cas_refused = (f.type == F_ACK) &&
+        !json_find_bool(f.json, "ok", 1);
+    free_frame(&f);
+
+    printf("{\"skew_rejected\":%s,\"snapshot_rv\":%ld,"
+           "\"snapshot_events\":%d,\"snapshot_arrays_ok\":%s,"
+           "\"node_rv\":%ld,\"pod_rv\":%ld,\"deltas_seen\":%d,"
+           "\"assignments\":%s,\"c_pod_node\":\"%s\",\"round_pods\":%ld,"
+           "\"lease_acquired\":%s,\"stale_cas_refused\":%s}\n",
+           skew_rejected ? "true" : "false", snapshot_rv, snapshot_events,
+           snapshot_arrays_ok ? "true" : "false", node_rv, pod_rv,
+           deltas_seen, assignments, c_pod_node, round_pods,
+           lease_acquired ? "true" : "false",
+           stale_cas_refused ? "true" : "false");
+    free(assignments);
+    close(g_sock);
+
+    if (!skew_rejected) die("old protocol was not rejected");
+    if (!snapshot_arrays_ok) die("snapshot array manifest inconsistent");
+    if (!lease_acquired) die("lease CAS acquire failed");
+    if (!stale_cas_refused) die("stale lease CAS was not refused");
+    if (!c_pod_node[0]) die("c-pod was not assigned to any node");
+    return 0;
+}

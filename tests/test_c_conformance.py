@@ -1,0 +1,112 @@
+"""Cross-language conformance: a compiled C client drives the full wire
+protocol against a live Python sidecar.
+
+Closes the round-3 gap "nothing non-Python has ever spoken any of it":
+the BASELINE north star is the reference's Go plugins calling into this
+framework as a sidecar (frameworkext/interface.go:70, the api.proto:148
+contract role), and until a peer with no Python and no numpy completes
+HELLO negotiation -> snapshot decode -> state push -> delta watch ->
+solve -> lease CAS, that seam is untested.  The client is
+native/conformance_client.c; it hand-encodes frames, the JSON documents,
+and the little-endian int32 array section.
+"""
+
+import json
+import os
+import subprocess
+
+import jax.numpy as jnp
+import pytest
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, resource_vector
+from koordinator_tpu.ha import LeaseService
+from koordinator_tpu.ops.assignment import ScoringConfig
+from koordinator_tpu.scheduler import ClusterSnapshot, Scheduler
+from koordinator_tpu.transport import (
+    RpcClient,
+    RpcServer,
+    StateSyncClient,
+    StateSyncService,
+)
+from koordinator_tpu.transport.deltasync import SchedulerBinding
+from koordinator_tpu.transport.services import SolveService
+
+R = NUM_RESOURCE_DIMS
+SRC = os.path.join(os.path.dirname(__file__), "..", "native",
+                   "conformance_client.c")
+
+
+@pytest.fixture(scope="module")
+def client_bin(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("cbin") / "conformance_client")
+    try:
+        proc = subprocess.run(
+            ["gcc", "-O2", "-Wall", "-Werror", "-o", out, SRC],
+            capture_output=True, text=True)
+    except FileNotFoundError:
+        pytest.skip("no C toolchain on this machine")
+    if proc.returncode != 0:
+        pytest.fail(f"C client failed to compile:\n{proc.stderr}")
+    return out
+
+
+def mk_scheduler():
+    cfg = ScoringConfig.default().replace(
+        usage_thresholds=jnp.zeros(R, jnp.int32),
+        estimator_defaults=jnp.zeros(R, jnp.int32))
+    return Scheduler(ClusterSnapshot(capacity=16), config=cfg)
+
+
+def test_c_client_full_protocol(client_bin):
+    server = RpcServer("tcp://127.0.0.1:0")
+    service = StateSyncService()
+    service.attach(server)
+    # state that predates the C client: it must arrive via SNAPSHOT
+    service.upsert_node("py-node", resource_vector(cpu=8_000, memory=32_768))
+    service.add_pod("py-pod", resource_vector(cpu=1_000, memory=1_024))
+
+    sched = mk_scheduler()
+    SolveService(sched).attach(server)
+    LeaseService().attach(server)
+    server.start()
+
+    # the solver's own feed: a Python sync client over the same socket,
+    # exactly the production wiring — the C client's pushed state must
+    # reach the scheduler through the commit->broadcast->binding path
+    sync = StateSyncClient(SchedulerBinding(sched))
+    feed = RpcClient(server.address, on_push=sync.on_push)
+    feed.connect()
+    try:
+        assert sync.bootstrap(feed) == 2
+
+        proc = subprocess.run(
+            [client_bin, "127.0.0.1", server.address.rsplit(":", 1)[1],
+             str(R)],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, (
+            f"C client failed (stderr):\n{proc.stderr}\n"
+            f"stdout:\n{proc.stdout}")
+        result = json.loads(proc.stdout)
+
+        # protocol negotiation: the v1 HELLO was rejected, v3 accepted
+        assert result["skew_rejected"] is True
+        # snapshot: both pre-existing events, rv consistent, arrays sane
+        assert result["snapshot_events"] == 2
+        assert result["snapshot_rv"] == 2
+        assert result["snapshot_arrays_ok"] is True
+        # state pushes committed in order and came back as DELTA pushes
+        assert result["node_rv"] == 3 and result["pod_rv"] == 4
+        assert result["deltas_seen"] >= 1
+        # the solve saw C-originated state: c-pod landed on c-node
+        # (its node_selector only matches the label the C client set)
+        assert result["c_pod_node"] == "c-node"
+        assert "py-pod" in result["assignments"]
+        # lease CAS semantics held
+        assert result["lease_acquired"] is True
+        assert result["stale_cas_refused"] is True
+
+        # and the Python-side scheduler really holds the C state
+        assert "c-pod" not in sched.pending
+    finally:
+        feed.close()
+        server.stop()

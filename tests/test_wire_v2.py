@@ -201,3 +201,48 @@ def test_cross_process_failover_kill9(tmp_path):
     finally:
         kill_all(procs)
         server.stop()
+
+
+class TestStatePushValidation:
+    """A malformed client-encoded array must fail the PUSHING call and
+    never enter the replay log (where it would poison every sync
+    client, including future bootstrappers)."""
+
+    def _server(self, tmp_path):
+        from koordinator_tpu.transport.channel import RpcServer
+        from koordinator_tpu.transport.deltasync import StateSyncService
+
+        server = RpcServer(str(tmp_path / "push.sock"))
+        service = StateSyncService()
+        service.attach(server)
+        server.start()
+        return server, service
+
+    def test_wrong_shape_and_dtype_rejected(self, tmp_path):
+        import numpy as np
+        import pytest
+
+        from koordinator_tpu.transport.channel import RpcClient, RpcError
+        from koordinator_tpu.transport.wire import FrameType
+
+        server, service = self._server(tmp_path)
+        client = RpcClient(server.path)
+        client.connect()
+        try:
+            for bad in (np.zeros(3, np.int32),            # wrong length
+                        np.zeros((2, 10), np.int32),      # wrong rank
+                        np.zeros(10, np.float32)):        # wrong dtype
+                with pytest.raises(RpcError):
+                    client.call(FrameType.STATE_PUSH,
+                                {"kind": "node_upsert", "name": "bad"},
+                                {"allocatable": bad})
+            assert service.rv == 0 and not service.nodes  # nothing logged
+
+            _, doc, _ = client.call(
+                FrameType.STATE_PUSH,
+                {"kind": "node_upsert", "name": "good"},
+                {"allocatable": np.zeros(10, np.int32)})
+            assert doc["rv"] == 1 and "good" in service.nodes
+        finally:
+            client.close()
+            server.stop()
