@@ -54,6 +54,11 @@ def build_koordlet_parser() -> argparse.ArgumentParser:
         "--http-port", type=int, default=None,
         help="serve the HTTP/JSON gateway (incl. /v1/podresources when "
              "the PodResourcesProxy gate is on); omit to disable")
+    parser.add_argument(
+        "--runtime-hook-server-addr", default="",
+        help="serve the runtimehooks plugins to a runtime proxy over this "
+             "address (unix path or tcp://host:port) — the nri/server.go "
+             "/ proxyserver seam; empty disables")
     return parser
 
 
@@ -93,6 +98,20 @@ def main_koordlet(argv: list[str], device_report_fn=None,
                      else None),
         )
         daemon.gateway.start()
+    if args.runtime_hook_server_addr:
+        from koordinator_tpu.koordlet.runtimehooks.server import (
+            RegistryHookServer,
+        )
+        from koordinator_tpu.runtimeproxy import Dispatcher, HookType
+        from koordinator_tpu.transport import RpcServer
+        from koordinator_tpu.transport.services import HookService
+
+        hook_dispatcher = Dispatcher()
+        hook_dispatcher.register(
+            RegistryHookServer(daemon.hook_registry), list(HookType))
+        daemon.hook_server = RpcServer(args.runtime_hook_server_addr)
+        HookService(hook_dispatcher).attach(daemon.hook_server)
+        daemon.hook_server.start()
     return Assembled(name="koordlet", args=args, component=daemon)
 
 

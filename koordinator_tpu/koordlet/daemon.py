@@ -121,6 +121,9 @@ class Daemon:
         #: HTTP gateway attached by the binary (--http-port); owned by the
         #: daemon lifecycle so stop() closes its socket and thread
         self.gateway = None
+        #: runtime-hook RpcServer attached by the binary
+        #: (--runtime-hook-server-addr); same ownership rule
+        self.hook_server = None
         self._last_train = 0.0
         self.train_interval_seconds = 60.0
         self.device_report_fn = device_report_fn
@@ -198,3 +201,6 @@ class Daemon:
         if self.gateway is not None:
             self.gateway.stop()
             self.gateway = None
+        if self.hook_server is not None:
+            self.hook_server.stop()
+            self.hook_server = None

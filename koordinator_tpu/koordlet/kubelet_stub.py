@@ -10,11 +10,21 @@ JSON in tests); parsing converts the kubelet PodList payload into the agent's
 from __future__ import annotations
 
 import json
+import ssl
+import time
+import urllib.error
+import urllib.request
 from typing import Callable, Optional
 
 from koordinator_tpu.api import extension as ext
 from koordinator_tpu.api.qos import QoSClass
 from koordinator_tpu.koordlet.statesinformer import ContainerMeta, PodMeta
+from koordinator_tpu.metrics import KOORDLET
+
+kubelet_request_seconds = KOORDLET.histogram(
+    "kubelet_request_duration_seconds",
+    "Kubelet HTTP(S) request latency by path/code "
+    "(metrics.RecordKubeletRequestDuration)")
 
 _KUBE_QOS = {
     "Guaranteed": "guaranteed",
@@ -91,10 +101,90 @@ def parse_pod_list(payload: dict) -> list[PodMeta]:
     return out
 
 
+def https_fetch_fn(
+    addr: str,
+    port: int,
+    scheme: str = "https",
+    token: Optional[str] = None,
+    token_file: Optional[str] = None,
+    ca_file: Optional[str] = None,
+    insecure_skip_verify: bool = False,
+    timeout: float = 10.0,
+) -> Callable[[str], str]:
+    """The production transport behind :class:`KubeletStub`: bearer-token
+    TLS GET against the kubelet's read-only-or-authenticated endpoint
+    (kubelet_stub.go:40 NewKubeletStub — rest.Config transport + token).
+
+    - ``token``/``token_file``: serviceaccount bearer token (the file is
+      re-read per request, matching client-go's rotating token source).
+    - ``ca_file``: CA bundle to verify the kubelet's serving cert;
+      ``insecure_skip_verify`` mirrors rest.Config.TLSClientConfig.Insecure
+      (kubelets commonly serve self-signed certs).
+    Non-200 responses raise ``OSError`` — the same failure the Go stub
+    returns — so callers' fallback paths engage.
+    """
+    if scheme == "https":
+        if insecure_skip_verify:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        else:
+            ctx = ssl.create_default_context(cafile=ca_file)
+    else:
+        ctx = None
+
+    def fetch(path: str) -> str:
+        url = f"{scheme}://{addr}:{port}{path}"
+        request = urllib.request.Request(url)
+        bearer = token
+        if token_file:
+            try:
+                with open(token_file) as f:
+                    bearer = f.read().strip()
+            except OSError as e:
+                # never silently downgrade to an unauthenticated (or
+                # stale-static-token) request: a rotating-token read
+                # failure must surface as ITS cause, not as the 401 the
+                # kubelet would answer with
+                raise OSError(
+                    f"kubelet token file {token_file!r} unreadable: {e}"
+                ) from e
+        if bearer:
+            request.add_header("Authorization", f"Bearer {bearer}")
+        start = time.monotonic()
+        code = "error"
+        try:
+            with urllib.request.urlopen(
+                    request, timeout=timeout, context=ctx) as resp:
+                code = str(resp.status)
+                if resp.status != 200:
+                    raise OSError(
+                        f"request {url} failed, code {resp.status}")
+                return resp.read().decode()
+        except urllib.error.HTTPError as e:
+            code = str(e.code)
+            raise OSError(f"request {url} failed, code {e.code}") from e
+        except urllib.error.URLError as e:
+            raise OSError(f"request {url} failed: {e.reason}") from e
+        finally:
+            kubelet_request_seconds.observe(
+                time.monotonic() - start,
+                labels={"path": path, "code": code})
+
+    return fetch
+
+
 class KubeletStub:
     def __init__(self, fetch_fn: Callable[[str], str]):
         """fetch_fn(path) -> response body ('/pods', '/configz')."""
         self.fetch_fn = fetch_fn
+
+    @classmethod
+    def connect(cls, addr: str = "127.0.0.1", port: int = 10250,
+                **kw) -> "KubeletStub":
+        """Stub over the real HTTPS transport (kwargs per
+        :func:`https_fetch_fn`)."""
+        return cls(https_fetch_fn(addr, port, **kw))
 
     def get_all_pods(self) -> list[PodMeta]:
         body = self.fetch_fn("/pods")

@@ -1,0 +1,143 @@
+"""Hook server: the koordlet's runtimehooks plugins served at the
+runtime-proxy boundary, across a process boundary.
+
+The reference splits this seam over two processes and a wire protocol:
+koordlet's hook server (``runtimehooks/nri/server.go:34`` for NRI,
+``runtimehooks/proxyserver/`` for the legacy proxy) answers lifecycle
+hooks raised by koord-runtime-proxy (``runtimeproxy/dispatcher/
+dispatcher.go``), which interposes the kubelet<->containerd CRI path.
+This module is the same split for this framework's transport:
+
+- :class:`RegistryHookServer` (koordlet process) adapts the plugin
+  :class:`~koordinator_tpu.koordlet.runtimehooks.hooks.HookRegistry`
+  to the proxy's ``HookServer.handle(hook, request)`` contract, so the
+  whole plugin set (GroupIdentity, BatchResource, CPUSetAllocator, ...)
+  serves remote hook dispatch.  Served over the wire by attaching a
+  ``transport.services.HookService`` wrapping a ``Dispatcher`` that has
+  this server registered.
+- :class:`RemoteHookServer` (proxy process) is the other half: a local
+  ``HookServer`` whose ``handle`` calls the koordlet's HookService over
+  an ``RpcClient`` — fail-open on transport errors, matching
+  dispatcher.go's contract that a dead hook server never blocks a CRI
+  call.
+
+Wire mapping (both directions ride HOOK_REQUEST/HOOK_RESPONSE frames,
+the api.proto:148 shapes): ``HookRequest.resources`` carries the pod's
+(extended) resource requests in canonical integer units — that is what
+BatchResource et al derive kernel limits from; plugin ``Response``
+cgroup values come back in ``resources`` keyed by cgroup file name, and
+env injections in ``envs``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from koordinator_tpu.api import extension as ext
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.koordlet.runtimehooks.hooks import HookRegistry, Stage
+from koordinator_tpu.koordlet.runtimehooks.protocol import (
+    ContainerContext,
+    PodContext,
+    Response,
+)
+from koordinator_tpu.koordlet.statesinformer import ContainerMeta, PodMeta
+from koordinator_tpu.runtimeproxy import HookRequest, HookResponse, HookType
+
+_KUBE_QOS_BY_CLASS = {
+    QoSClass.BE: "besteffort",
+    QoSClass.LS: "burstable",
+    QoSClass.LSR: "guaranteed",
+    QoSClass.LSE: "guaranteed",
+}
+
+
+def pod_meta_from_request(request: HookRequest) -> PodMeta:
+    """Rebuild the agent's pod model from the CRI-call context."""
+    labels = dict(request.labels)
+    qos = QoSClass.parse(labels.get(ext.LABEL_POD_QOS, ""))
+    meta = request.pod_meta
+    return PodMeta(
+        uid=meta.get("uid", ""),
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        qos_class=qos,
+        kube_qos=meta.get("kube_qos",
+                          _KUBE_QOS_BY_CLASS.get(qos, "besteffort")),
+        priority=int(meta.get("priority", 0) or 0),
+        requests={k: int(v) for k, v in request.resources.items()},
+        annotations=dict(request.annotations),
+        labels=labels,
+    )
+
+
+def response_to_hook_response(response: Response) -> HookResponse:
+    """Plugin Response -> proxy-mergeable partial update."""
+    resources = dict(response.cgroup_values)
+    if response.cpuset_cpus is not None:
+        resources["cpuset.cpus"] = response.cpuset_cpus
+    if response.cpuset_mems is not None:
+        resources["cpuset.mems"] = response.cpuset_mems
+    annotations = {}
+    if response.core_sched_group is not None:
+        annotations[ext.DOMAIN + "/core-sched-group"] = (
+            response.core_sched_group)
+    if response.resctrl_group is not None:
+        annotations[ext.DOMAIN + "/resctrl-group"] = (
+            response.resctrl_group)
+    return HookResponse(
+        annotations=annotations,
+        resources=resources,
+        envs=dict(response.env),
+    )
+
+
+class RegistryHookServer:
+    """koordlet-side ``HookServer``: run the registry's plugins for the
+    hook's stage and return their accumulated response."""
+
+    #: HookType.value == Stage.value for every lifecycle point, by
+    #: construction (both mirror api.proto's hook names)
+    def __init__(self, registry: HookRegistry):
+        self.registry = registry
+
+    def handle(self, hook: HookType,
+               request: HookRequest) -> Optional[HookResponse]:
+        stage = Stage(hook.value)
+        pod = pod_meta_from_request(request)
+        if request.container_meta:
+            ctx = ContainerContext(
+                pod=pod,
+                container=ContainerMeta(
+                    name=request.container_meta.get("name", ""),
+                    container_id=request.container_meta.get("id", ""),
+                ),
+                cgroup_dir=request.cgroup_parent,
+            )
+        else:
+            ctx = PodContext(pod=pod, cgroup_dir=request.cgroup_parent)
+        self.registry.run(stage, ctx)
+        return response_to_hook_response(ctx.response)
+
+
+class RemoteHookServer:
+    """Proxy-side ``HookServer`` over the framed transport: dispatch to
+    the koordlet's HookService in its own process, fail-open."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def handle(self, hook: HookType,
+               request: HookRequest) -> Optional[HookResponse]:
+        from koordinator_tpu.transport.services import hook_remote
+
+        out = hook_remote(self.client, hook, request, fail_open=True)
+        if out is None:
+            return None
+        return HookResponse(
+            labels=out.get("labels", {}),
+            annotations=out.get("annotations", {}),
+            cgroup_parent=out.get("cgroup_parent", ""),
+            resources=out.get("resources", {}),
+            envs=out.get("envs", {}),
+        )

@@ -25,11 +25,14 @@ def test_all_six_binaries_registered():
 
 def test_koordlet_flags_and_gates(tmp_path):
     before = KOORDLET_GATES.enabled("CPICollector")
+    before_audit = KOORDLET_GATES.enabled("AuditEvents")
     try:
         out = main_koordlet([
             "--cgroup-root-dir", str(tmp_path / "cg"),
             "--proc-root-dir", str(tmp_path / "proc"),
-            "--feature-gates", "CPICollector=true",
+            # AuditEvents defaults FALSE (koordlet_features.go:215):
+            # --audit-log-dir alone must not construct an auditor
+            "--feature-gates", "CPICollector=true,AuditEvents=true",
             "--audit-log-dir", str(tmp_path / "audit"),
         ])
         assert out.name == "koordlet"
@@ -38,6 +41,35 @@ def test_koordlet_flags_and_gates(tmp_path):
         assert KOORDLET_GATES.enabled("CPICollector") is True
     finally:
         KOORDLET_GATES.set("CPICollector", before)
+        KOORDLET_GATES.set("AuditEvents", before_audit)
+
+
+def test_koordlet_serves_runtime_hooks(tmp_path):
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.runtimeproxy import HookRequest, HookType
+    from koordinator_tpu.transport import RpcClient
+    from koordinator_tpu.transport.services import hook_remote
+
+    asm = main_koordlet([
+        "--cgroup-root-dir", str(tmp_path / "cg"),
+        "--proc-root-dir", str(tmp_path / "proc"),
+        "--runtime-hook-server-addr", str(tmp_path / "hooks.sock"),
+    ])
+    try:
+        client = RpcClient(asm.component.hook_server.path)
+        client.connect()
+        try:
+            res = hook_remote(client, HookType.PRE_RUN_POD_SANDBOX,
+                              HookRequest(
+                                  pod_meta={"uid": "u1", "name": "p1"},
+                                  labels={ext.LABEL_POD_QOS: "BE"}))
+            # GroupIdentity (default-on) answered from the daemon's
+            # registry: BE bvt from the default NodeSLO
+            assert res["resources"]["cpu.bvt_warp_ns"] == "-1"
+        finally:
+            client.close()
+    finally:
+        asm.component.stop()   # daemon lifecycle stops the hook server too
 
 
 def test_scheduler_assembly_with_lease_and_socket(tmp_path):

@@ -9,7 +9,7 @@ from koordinator_tpu.api import extension as ext
 from koordinator_tpu.api.qos import QoSClass
 from koordinator_tpu.koordlet.kubelet_stub import KubeletStub, parse_pod_list
 from koordinator_tpu.koordlet.nodetopo import NodeTopologyReporter
-from koordinator_tpu.koordlet.system.config import test_config as make_test_config
+from koordinator_tpu.koordlet.system.config import make_test_config
 
 
 def make_sysfs_topology(cfg, n_cpus=4, n_numa=2, mem_kb_per_node=1000000):
@@ -88,3 +88,98 @@ class TestKubeletStub:
             else {"kubeletconfig": {"cpuManagerPolicy": "static"}}))
         assert len(stub.get_all_pods()) == 1
         assert stub.get_kubelet_configz()["cpuManagerPolicy"] == "static"
+
+
+class TestHttpsKubeletClient:
+    """The HTTPS+token transport behind the stub (kubelet_stub.go:40):
+    a real TLS server fixture with a self-signed cert and bearer-token
+    auth, exactly the surface a kubelet presents."""
+
+    @pytest.fixture(scope="class")
+    def tls_server(self, tmp_path_factory):
+        import http.server
+        import ssl
+        import subprocess
+        import threading
+
+        certdir = tmp_path_factory.mktemp("kubelet-certs")
+        cert = str(certdir / "kubelet.crt")
+        key = str(certdir / "kubelet.key")
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", cert, "-days", "1", "-subj",
+             "/CN=127.0.0.1", "-addext",
+             "subjectAltName=IP:127.0.0.1"],
+            check=True, capture_output=True)
+
+        pod_list = {"items": [{
+            "metadata": {"uid": "tls-u1", "name": "tls-pod",
+                         "namespace": "default"},
+            "spec": {"containers": [{"resources": {
+                "requests": {"cpu": "500m", "memory": "1Gi"}}}]},
+            "status": {"phase": "Running", "qosClass": "Burstable"},
+        }]}
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if self.headers.get("Authorization") != "Bearer sekrit":
+                    self.send_response(401)
+                    self.end_headers()
+                    return
+                if self.path.rstrip("/") == "/pods":
+                    body = json.dumps(pod_list).encode()
+                elif self.path == "/configz":
+                    body = json.dumps({"kubeletconfig": {
+                        "cpuManagerPolicy": "static"}}).encode()
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        server.socket = ctx.wrap_socket(server.socket, server_side=True)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        yield server.server_address[1], cert
+        server.shutdown()
+        server.server_close()
+
+    def test_pods_and_configz_over_tls_with_token(self, tls_server, tmp_path):
+        port, cert = tls_server
+        token_file = tmp_path / "token"
+        token_file.write_text("sekrit\n")
+        stub = KubeletStub.connect(
+            "127.0.0.1", port, ca_file=cert,
+            token_file=str(token_file))
+        pods = stub.get_all_pods()
+        assert [p.uid for p in pods] == ["tls-u1"]
+        assert pods[0].requests == {"cpu": 500, "memory": 1 << 30}
+        assert stub.get_kubelet_configz()["cpuManagerPolicy"] == "static"
+
+    def test_bad_token_is_an_error(self, tls_server):
+        port, cert = tls_server
+        stub = KubeletStub.connect(
+            "127.0.0.1", port, ca_file=cert, token="wrong")
+        with pytest.raises(OSError, match="code 401"):
+            stub.get_all_pods()
+
+    def test_insecure_skip_verify(self, tls_server):
+        port, _ = tls_server
+        stub = KubeletStub.connect(
+            "127.0.0.1", port, insecure_skip_verify=True, token="sekrit")
+        assert [p.uid for p in stub.get_all_pods()] == ["tls-u1"]
+
+    def test_untrusted_cert_refused_when_verifying(self, tls_server):
+        port, _ = tls_server
+        stub = KubeletStub.connect("127.0.0.1", port, token="sekrit")
+        with pytest.raises(OSError):
+            stub.get_all_pods()
