@@ -251,6 +251,29 @@ def _bench_colocation(rtt: float) -> dict:
     return {"spark_colocation_e2e_pods_per_sec_3n": round(n_scheduled / dt, 1)}
 
 
+def _run_child(argv: list[str], timeout: float,
+               env: dict | None = None) -> tuple[dict | None, str]:
+    """Run a child bench process; (parsed-last-stdout-line, "") on
+    success, (None, error-tail) otherwise.  One copy of the parse/error
+    capture for both the --extra configs and the --cpu-quality sweep."""
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, *argv],
+            capture_output=True, text=True, timeout=timeout, env=env)
+    except Exception as e:
+        return None, repr(e)[:200]
+    if proc.returncode == 0 and proc.stdout.strip():
+        try:
+            return json.loads(proc.stdout.strip().splitlines()[-1]), ""
+        except json.JSONDecodeError as e:
+            return None, f"bad child json: {e}"
+    tail = (proc.stderr or proc.stdout or "").strip()[-200:]
+    return None, f"rc={proc.returncode}: {tail}"
+
+
 def _device_alive(timeout_s: float = 180.0) -> bool:
     """Probe the backend with a tiny kernel under a thread timeout.  Through
     the axon tunnel a dead link HANGS readbacks rather than erroring, which
@@ -291,8 +314,28 @@ def main() -> None:
     def emit_zero_record(extra: dict) -> None:
         """One JSON zero-record, then hard-exit 0: the driver records
         stdout only on rc==0, and a hung device thread must not block
-        exit (os._exit skips buffered-IO teardown, hence the flush)."""
+        exit (os._exit skips buffered-IO teardown, hence the flush).
+
+        Before emitting, run the at-shape CPU quality sweep in a child
+        process (JAX_PLATFORMS=cpu — the parent's backend is the hung
+        tunnel): a device-down round must still leave machine-readable
+        evidence of the solver's quality at the north-star shape
+        (VERDICT r3 item 5) instead of only a zero."""
         import sys
+
+        # Budget: the driver's own wall-clock limit is unknown but was
+        # ~3600s historically; probes may already have burned ~660s, so
+        # cap the sweep at 1500s — losing the sweep to the cap still
+        # emits the zero record below, losing the whole process to the
+        # driver's limit would lose even that.
+        child_env = dict(os.environ, JAX_PLATFORMS="cpu")
+        child_env.pop("XLA_FLAGS", None)
+        quality, err = _run_child(["--cpu-quality"], timeout=1500,
+                                  env=child_env)
+        if quality is not None:
+            extra.update(quality)
+        else:
+            extra["cpu_quality_error"] = err
 
         print(json.dumps({
             "metric": f"solve_pods_per_sec_{N_PODS}p_{N_NODES}n",
@@ -383,6 +426,24 @@ def main() -> None:
         "solve_assigned_frac": round(assigned_frac, 4),
         "solve_candidate_method": best,
     }
+    # Per-solve latency DISTRIBUTION: BASELINE's target is <200ms p99,
+    # not a chained mean (VERDICT r3 missing #4).  Each sample is one
+    # single-iteration chained readback minus the separately measured
+    # tunnel floor; rtt jitter pollutes the tail, so this is an upper
+    # bound on the solver's own p99 — record it rather than nothing.
+    try:
+        single = jax.jit(_chained_loop(candidates[best], iters=1))
+        float(single(state))  # warm/compile
+        samples = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            float(single(state))
+            samples.append(max(time.perf_counter() - t0 - rtt, 0.0) * 1e3)
+        for q in (50, 90, 99):
+            extra[f"solve_latency_ms_p{q}"] = round(
+                float(np.percentile(samples, q)), 2)
+    except Exception as e:
+        extra["solve_latency_error"] = repr(e)[:200]
     for method, t in timed.items():
         if isinstance(t, tuple):
             extra[f"solve_ms_{method}"] = round(t[0] * 1e3, 2)
@@ -390,22 +451,12 @@ def main() -> None:
             extra[f"solve_{method}"] = t
     # extras run in CHILD processes: even a device OOM abort or backend
     # SIGABRT in a config cannot cost the already-measured headline
-    import subprocess
-    import sys
-
     for name in ("quota", "gang", "lownodeload", "colocation"):
-        try:
-            proc = subprocess.run(
-                [sys.executable, __file__, "--extra", name],
-                capture_output=True, text=True, timeout=900)
-            if proc.returncode == 0 and proc.stdout.strip():
-                extra.update(json.loads(proc.stdout.strip().splitlines()[-1]))
-            else:
-                tail = (proc.stderr or proc.stdout or "").strip()[-200:]
-                extra[f"bench_{name}_error"] = (
-                    f"rc={proc.returncode}: {tail}")
-        except Exception as e:
-            extra[f"bench_{name}_error"] = repr(e)[:200]
+        result, err = _run_child(["--extra", name], timeout=900)
+        if result is not None:
+            extra.update(result)
+        else:
+            extra[f"bench_{name}_error"] = err
 
     print(
         json.dumps(
@@ -420,6 +471,32 @@ def main() -> None:
             }
         )
     )
+
+
+def _cpu_quality_main() -> None:
+    """Child-process entry (JAX_PLATFORMS=cpu): solve quality at the
+    north-star shape with the TPU-serving approx candidate path forced —
+    the machine-readable form of scratch_quality.py, captured into the
+    official record even when the device is unreachable."""
+    from __graft_entry__ import _build_problem
+    from koordinator_tpu.ops.batch_assign import batch_assign
+
+    state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
+    valid = int(np.asarray(pods.valid).sum())
+    out: dict = {"cpu_quality_shape": f"{N_PODS}p_{N_NODES}n"}
+    for k in (16, 32):
+        t0 = time.perf_counter()
+        asn, st = jax.jit(
+            lambda s, k=k: batch_assign(s, pods, cfg, k=k,
+                                        method="approx")[:2])(state)
+        asn = np.asarray(asn)
+        assigned = int((asn >= 0).sum())
+        capacity_ok = bool((np.asarray(st.node_requested)
+                            <= np.asarray(st.node_allocatable)).all())
+        out[f"cpu_assigned_frac_k{k}_approx"] = round(assigned / valid, 4)
+        out[f"cpu_capacity_ok_k{k}_approx"] = capacity_ok
+        out[f"cpu_quality_wall_s_k{k}"] = round(time.perf_counter() - t0, 1)
+    print(json.dumps(out))
 
 
 def _extra_main(name: str) -> None:
@@ -449,5 +526,7 @@ if __name__ == "__main__":
 
     if len(sys.argv) == 3 and sys.argv[1] == "--extra":
         _extra_main(sys.argv[2])
+    elif len(sys.argv) == 2 and sys.argv[1] == "--cpu-quality":
+        _cpu_quality_main()
     else:
         main()

@@ -61,6 +61,22 @@ def shard_cluster_state(state, mesh: Mesh):
     return jax.tree.map(lambda x: jax.device_put(x, ns), state)
 
 
+def shard_scheduled_pods(sched, mesh: Mesh):
+    """Place ScheduledPods (the preemption victim table) with the victim
+    axis sharded over the pods mesh axis: victim candidacy/sorting is
+    per-victim elementwise; the per-node reductions ride the mesh
+    collectives the same way score reductions do."""
+    ps = pod_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, ps), sched)
+
+
+def shard_reservation_set(rsv, mesh: Mesh):
+    """Place a ReservationSet reservation-axis-sharded over the pods mesh
+    axis (V is small; its cross terms against nodes are gathered)."""
+    ps = pod_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, ps), rsv)
+
+
 def shard_pod_batch(pods, mesh: Mesh):
     """Place PodBatch tensors pod-axis-sharded; a dense (P, N) feasibility
     matrix shards over both axes, the factored (P, C) selector mask over the

@@ -1,0 +1,55 @@
+"""Solve quality AT THE NORTH-STAR SHAPE, in CI.
+
+Round 2's spread_bits=5 fix held at a 2k-pod validation shape and
+silently stranded 14% of pods at the real 50k x 10,240 shape; round 3's
+stratified candidate selection fixed it but the at-shape check lived in
+a manual scratch script.  This test pins the real shape in CI (slow-
+marked: `pytest -m slow`) so that class of regression can never ship
+silently again (VERDICT r3 item 9).
+
+The approx float-key candidate path is FORCED — the TPU-serving branch;
+on CPU `approx_max_k`'s lowering is exact, so this isolates the
+stratified-selection + float-key quantization behavior from TPU recall.
+"""
+
+import numpy as np
+import pytest
+
+from __graft_entry__ import _build_problem
+
+pytestmark = pytest.mark.slow
+
+NORTH_STAR_NODES = 10_240
+NORTH_STAR_PODS = 50_000
+
+
+@pytest.fixture(scope="module")
+def problem():
+    # seed 42 = the scratch_quality.py shape the round-2 regression hit
+    return _build_problem(NORTH_STAR_NODES, NORTH_STAR_PODS, seed=42)
+
+
+@pytest.mark.parametrize("k", [16, 32])
+def test_stratified_candidates_assign_everything_at_shape(problem, k):
+    import jax
+
+    from koordinator_tpu.ops.batch_assign import batch_assign
+
+    state, pods, cfg = problem
+    valid = int(np.asarray(pods.valid).sum())
+    assert valid == NORTH_STAR_PODS
+
+    asn, st = jax.jit(
+        lambda s: batch_assign(s, pods, cfg, k=k, method="approx")[:2]
+    )(state)
+    asn = np.asarray(asn)
+
+    assigned = int((asn >= 0).sum())
+    # capacity must hold exactly...
+    assert (np.asarray(st.node_requested)
+            <= np.asarray(st.node_allocatable)).all()
+    # ...and the stratified default must place every valid pod (the
+    # round-2 bug left this at 0.86)
+    assert assigned == valid, (
+        f"k={k}: stranded {valid - assigned}/{valid} pods at the "
+        f"north-star shape")
