@@ -259,17 +259,38 @@ def _run_child(argv: list[str], timeout: float,
     import subprocess
     import sys
 
+    def parse_last_line(stdout: str) -> dict | None:
+        if not (stdout or "").strip():
+            return None
+        try:
+            doc = json.loads(stdout.strip().splitlines()[-1])
+        except json.JSONDecodeError:
+            return None
+        # a stray JSON-parseable line ('[]', '1.0') must not reach
+        # extra.update() — only a dict is a child result
+        return doc if isinstance(doc, dict) else None
+
     try:
         proc = subprocess.run(
             [sys.executable, __file__, *argv],
             capture_output=True, text=True, timeout=timeout, env=env)
+    except subprocess.TimeoutExpired as e:
+        # children print cumulative results incrementally, so a timeout
+        # keeps whatever had finished instead of losing everything
+        partial = parse_last_line(
+            e.stdout.decode() if isinstance(e.stdout, bytes)
+            else (e.stdout or ""))
+        if partial is not None:
+            partial.setdefault("child_timeout", f"after {timeout}s")
+            return partial, ""
+        return None, f"timeout after {timeout}s, no partial output"
     except Exception as e:
         return None, repr(e)[:200]
-    if proc.returncode == 0 and proc.stdout.strip():
-        try:
-            return json.loads(proc.stdout.strip().splitlines()[-1]), ""
-        except json.JSONDecodeError as e:
-            return None, f"bad child json: {e}"
+    if proc.returncode == 0:
+        doc = parse_last_line(proc.stdout)
+        if doc is not None:
+            return doc, ""
+        return None, "child produced no parseable dict"
     tail = (proc.stderr or proc.stdout or "").strip()[-200:]
     return None, f"rc={proc.returncode}: {tail}"
 
@@ -481,6 +502,8 @@ def _cpu_quality_main() -> None:
     from __graft_entry__ import _build_problem
     from koordinator_tpu.ops.batch_assign import batch_assign
 
+    import sys
+
     state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
     valid = int(np.asarray(pods.valid).sum())
     out: dict = {"cpu_quality_shape": f"{N_PODS}p_{N_NODES}n"}
@@ -496,7 +519,10 @@ def _cpu_quality_main() -> None:
         out[f"cpu_assigned_frac_k{k}_approx"] = round(assigned / valid, 4)
         out[f"cpu_capacity_ok_k{k}_approx"] = capacity_ok
         out[f"cpu_quality_wall_s_k{k}"] = round(time.perf_counter() - t0, 1)
-    print(json.dumps(out))
+        # cumulative line per k: if the parent's timeout kills us during
+        # a later solve, the finished evidence survives on stdout
+        print(json.dumps(out))
+        sys.stdout.flush()
 
 
 def _extra_main(name: str) -> None:
