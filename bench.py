@@ -260,15 +260,17 @@ def _run_child(argv: list[str], timeout: float,
     import sys
 
     def parse_last_line(stdout: str) -> dict | None:
-        if not (stdout or "").strip():
-            return None
-        try:
-            doc = json.loads(stdout.strip().splitlines()[-1])
-        except json.JSONDecodeError:
-            return None
-        # a stray JSON-parseable line ('[]', '1.0') must not reach
-        # extra.update() — only a dict is a child result
-        return doc if isinstance(doc, dict) else None
+        # newest complete record wins; scan in reverse because a timeout
+        # kill can truncate the final line mid-write, and a stray
+        # JSON-parseable line ('[]', '1.0') must not reach extra.update()
+        for line in reversed((stdout or "").strip().splitlines()):
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(doc, dict):
+                return doc
+        return None
 
     try:
         proc = subprocess.run(

@@ -40,7 +40,13 @@ N_NODES = 1_024
 
 
 def _time_kernel(fn, args, iters: int = K_ITERS, n: int = 3) -> float:
-    """Seconds per iteration of a scalar-returning jitted chained loop."""
+    """Seconds per iteration of a scalar-returning jitted chained loop.
+
+    The accumulator feeds back into each call as ``salt``; scenario
+    bodies must mix ``salt & 1`` (a genuinely data-dependent 0/1) into
+    their inputs — ``& 0`` would constant-fold and let XLA hoist the
+    kernel out of the loop, timing one execution instead of ``iters``.
+    """
 
     def chained(*a):
         def body(i, acc):
@@ -49,7 +55,7 @@ def _time_kernel(fn, args, iters: int = K_ITERS, n: int = 3) -> float:
         return jax.lax.fori_loop(0, iters, body, jnp.int32(0))
 
     def rtt_fn(*a):
-        return jnp.int32(0) + (a[0].sum().astype(jnp.int32) & 0)
+        return a[0].ravel()[0].astype(jnp.int32) * 0
 
     rtt, _ = _median_readback_seconds(jax.jit(rtt_fn), args, n=n)
     total, _ = _median_readback_seconds(jax.jit(chained), args, n=n)
@@ -72,7 +78,7 @@ def bench_numa_filter() -> dict:
 
     def fn(refs, salt):
         fits = cpuset_fit_batched(
-            topos, refs + (salt & 0), max_ref, jnp.int32(16),
+            topos, refs + (salt & 1), max_ref, jnp.int32(16),
             full_pcpus=True)
         return fits.sum().astype(jnp.int32)
 
@@ -86,7 +92,12 @@ def bench_numa_filter() -> dict:
 def bench_numa_take_cpus() -> dict:
     """cpuset accumulator take on one 128-cpu node (FullPCPUs,
     most-allocated — cpu_accumulator_test.go's hot case)."""
-    from koordinator_tpu.ops.numa import CPUTopology, take_cpus
+    from koordinator_tpu.ops.numa import (
+        BIND_FULL_PCPUS,
+        STRATEGY_MOST_ALLOCATED,
+        CPUTopology,
+        take_cpus,
+    )
 
     topo = CPUTopology.uniform(sockets=2, numa_per_socket=2,
                                cores_per_numa=16, threads_per_core=2)
@@ -94,8 +105,9 @@ def bench_numa_take_cpus() -> dict:
     refs = jnp.asarray(rng.integers(0, 2, topo.capacity).astype(np.int32))
 
     def fn(refs, salt):
-        sel, ok = take_cpus(topo, refs + (salt & 0), jnp.int32(1),
-                            jnp.int32(16))
+        sel, ok = take_cpus(topo, refs + (salt & 1), jnp.int32(1),
+                            jnp.int32(16), bind_policy=BIND_FULL_PCPUS,
+                            strategy=STRATEGY_MOST_ALLOCATED)
         return sel.sum().astype(jnp.int32) + ok.astype(jnp.int32)
 
     per = _time_kernel(fn, (refs,))
@@ -120,7 +132,7 @@ def bench_deviceshare_filter() -> dict:
     free = jnp.asarray(np.asarray(dev.total) - used)
 
     def fn(free, salt):
-        d = dev.replace(free=free + (salt & 0))
+        d = dev.replace(free=free + (salt & 1))
         fits = device_fit(d, jnp.int32(2), jnp.int32(100),
                           jnp.int32(40 << 10))
         score = device_score(d, jnp.int32(2), jnp.int32(100),
@@ -161,7 +173,7 @@ def bench_reservation_fit() -> dict:
     match = jnp.asarray(rng.random((n_pods, rsv.capacity)) < 0.25)
 
     def fn(node_free, salt):
-        fits = reservation_fit(rsv, node_free + (salt & 0), requests, match)
+        fits = reservation_fit(rsv, node_free + (salt & 1), requests, match)
         return fits.sum().astype(jnp.int32)
 
     per = _time_kernel(fn, (node_free,))
@@ -206,9 +218,11 @@ def bench_webhook_profile() -> dict:
              "requests": {"cpu": "500m", "memory": "1Gi"}}}]}}
         for j in range(2_000)
     ]
+    import copy
+
     from koordinator_tpu.api import extension as ext
 
-    hook.mutate(dict(pods[0]))  # warm
+    hook.mutate(copy.deepcopy(pods[0]))  # warm without touching pods[0]
     t0 = time.perf_counter()
     for p in pods:
         hook.mutate(p)
