@@ -39,9 +39,11 @@ def test_stratified_candidates_assign_everything_at_shape(problem, k):
     valid = int(np.asarray(pods.valid).sum())
     assert valid == NORTH_STAR_PODS
 
+    # pods traced, not closed over: closure capture would embed them as
+    # HLO constants and constant-fold pod-dependent work at compile time
     asn, st = jax.jit(
-        lambda s: batch_assign(s, pods, cfg, k=k, method="approx")[:2]
-    )(state)
+        lambda s, p: batch_assign(s, p, cfg, k=k, method="approx")[:2]
+    )(state, pods)
     asn = np.asarray(asn)
 
     assigned = int((asn >= 0).sum())
