@@ -253,15 +253,15 @@ def read_cpu_topology(cfg: SystemConfig | None = None) -> CPUTopology:
             except OSError:
                 return True
 
-        try:
-            online = sorted(
-                cpu for cpu in (
-                    int(e[3:]) for e in os.listdir(base)
-                    if e.startswith("cpu") and e[3:].isdigit()
-                ) if cpu_online(cpu)
-            )
-        except OSError:
-            online = []
+        # a missing BASE directory is a misconfigured sys root and must
+        # stay loud (the pre-fallback behavior) — only the per-file
+        # absence is the benign container case
+        online = sorted(
+            cpu for cpu in (
+                int(e[3:]) for e in os.listdir(base)
+                if e.startswith("cpu") and e[3:].isdigit()
+            ) if cpu_online(cpu)
+        )
 
     def read_int(path: str, default: int = 0) -> int:
         try:

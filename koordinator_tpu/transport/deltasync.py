@@ -268,6 +268,20 @@ class StateSyncService:
                               "devices", "node_selector", "tolerations"):
             require_doc(mapping_field, dict, "an object")
         require_doc("owners", list, "a list")
+        # element shapes too: a string owner or a non-dict device entry
+        # would commit fine and crash every sync client's binding on
+        # replay — the same poisoning require_vector guards against
+        for owner in doc.get("owners") or []:
+            if not isinstance(owner, dict):
+                raise wire.WireSchemaError(
+                    f"{kind} push: every 'owners' entry must be an "
+                    f"object, got {type(owner).__name__}")
+        for dev_type, inventory in (doc.get("devices") or {}).items():
+            if not isinstance(inventory, list) or any(
+                    not isinstance(entry, dict) for entry in inventory):
+                raise wire.WireSchemaError(
+                    f"{kind} push: devices[{dev_type!r}] must be a list "
+                    f"of objects")
         for scalar_field in ("quota", "gang", "owner", "node"):
             require_doc(scalar_field, str, "a string")
         for int_field in ("priority", "qos"):

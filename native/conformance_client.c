@@ -120,7 +120,8 @@ static void read_one_frame(struct frame *f) {
     recv_all(f->payload, f->len);
     if (f->len < 4) die("short payload");
     memcpy(&f->json_len, f->payload, 4);
-    if (4 + f->json_len > f->len) die("json_len exceeds payload");
+    /* f->len >= 4 here; subtract to avoid unsigned wrap in 4+json_len */
+    if (f->json_len > f->len - 4) die("json_len exceeds payload");
     f->json = malloc(f->json_len + 1);
     if (!f->json) die("oom");
     memcpy(f->json, f->payload + 4, f->json_len);

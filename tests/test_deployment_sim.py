@@ -1,0 +1,161 @@
+"""All six binaries composed into one deployment, over their real CLIs.
+
+test_e2e_sim proves the LIBRARY objects stitch into the reference's
+flows; this proves the BINARIES do — every component assembled exactly
+as `python -m ... <flags>` would, wired over the same sockets a real
+deployment uses (SURVEY §2.1): the manager's webhook admits a colocated
+pod, the scheduler binary solves it over its listen socket, the
+device-daemon's Device CR feeds the scheduler's device manager, the
+runtime-proxy binary dispatches container hooks to the koordlet
+binary's hook server across TWO RpcServers, and the descheduler binary
+runs a round over the resulting cluster view.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api import crds, extension as ext
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.api.resources import resource_vector
+from koordinator_tpu.cmd.binaries import MAINS
+from koordinator_tpu.koordlet.runtimehooks.server import RemoteHookServer
+from koordinator_tpu.koordlet.system.config import make_test_config
+from koordinator_tpu.runtimeproxy import HookRequest, HookType
+from koordinator_tpu.scheduler.snapshot import NodeSpec, PodSpec
+from koordinator_tpu.transport import RpcClient
+from koordinator_tpu.transport.services import solve_remote
+
+
+@pytest.fixture
+def deployment(tmp_path):
+    cfg = make_test_config(tmp_path)
+    # fake sysfs: one TPU accel device for the device daemon to probe
+    os.makedirs(os.path.join(cfg.sys_root, "class", "accel", "accel0"),
+                exist_ok=True)
+
+    assembled = {}
+    clients = []
+    try:
+        assembled["scheduler"] = MAINS["koord-scheduler"]([
+            "--node-capacity", "16",
+            "--listen-socket", str(tmp_path / "sched.sock"),
+        ])
+        assembled["manager"] = MAINS["koord-manager"]([])
+        assembled["koordlet"] = MAINS["koordlet"]([
+            "--cgroup-root-dir", cfg.cgroup_root,
+            "--proc-root-dir", cfg.proc_root,
+            "--sys-root-dir", cfg.sys_root,
+            "--runtime-hook-server-addr", str(tmp_path / "hooks.sock"),
+        ])
+        assembled["proxy"] = MAINS["koord-runtime-proxy"]([
+            "--hook-server-socket", str(tmp_path / "proxy-hooks.sock"),
+        ])
+        assembled["descheduler"] = MAINS["koord-descheduler"](
+            ["--deschedule-plugins", "podlifetime"],
+            pods_fn=lambda: [])
+        assembled["device-daemon"] = MAINS["koord-device-daemon"]([
+            "--node-name", "n0", "--sys-root-dir", cfg.sys_root,
+        ])
+
+        def connect(addr):
+            client = RpcClient(addr)
+            client.connect()
+            clients.append(client)
+            return client
+
+        yield assembled, connect, cfg
+    finally:
+        for client in clients:
+            client.close()
+        for asm in assembled.values():
+            if getattr(asm, "server", None) is not None:
+                asm.server.stop()
+            stop = getattr(asm.component, "stop", None)
+            if callable(stop):
+                stop()
+
+
+def test_six_binaries_one_pod_flow(deployment):
+    assembled, connect, cfg = deployment
+    scheduler = assembled["scheduler"].component
+    manager = assembled["manager"].component
+
+    # --- 1. manager webhook: colocation profile turns a plain spark pod
+    # into a BE pod with batch resources
+    manager.pod_mutating.profiles.append(crds.ClusterColocationProfile(
+        name="colo", pod_selector={"app": "spark"}, qos_class="BE",
+        koordinator_priority=5500, scheduler_name="koord-scheduler"))
+    pod = {
+        "metadata": {"name": "spark-1", "namespace": "default",
+                     "labels": {"app": "spark"}},
+        "spec": {"containers": [{"name": "m", "resources": {
+            "requests": {"cpu": "2", "memory": "4Gi"},
+            "limits": {"cpu": "2", "memory": "4Gi"}}}]},
+    }
+    manager.pod_mutating.mutate(pod)
+    assert manager.pod_validating.validate(pod) == []
+    requests = pod["spec"]["containers"][0]["resources"]["requests"]
+    assert requests[ext.RESOURCE_BATCH_CPU] == 2000
+
+    # --- 2. device daemon probes the fake sysfs into a Device CR; the
+    # scheduler's device manager ingests the converted inventory (the
+    # same path the Device-CR sync uses: devices.py -> deltasync:507)
+    from koordinator_tpu.koordlet.devices import device_infos_to_inventory
+
+    device = assembled["device-daemon"].component.collect()
+    assert [d.type for d in device.devices] == ["xpu"]
+
+    scheduler.snapshot.upsert_node(NodeSpec(
+        name="n0",
+        allocatable=resource_vector({
+            "cpu": 16_000, "memory": 32_768,
+            ext.RESOURCE_BATCH_CPU: 12_000,
+            ext.RESOURCE_BATCH_MEMORY: 24_576,
+        })))
+    for dev_type, inventory in device_infos_to_inventory(
+            list(device.devices)).items():
+        scheduler.device_manager.register_node_devices(
+            dev_type, "n0", inventory)
+    assert scheduler.device_manager.state("xpu") is not None
+
+    # --- 3. the admitted pod schedules over the scheduler binary's
+    # listen socket (the sidecar solve path)
+    scheduler.enqueue(PodSpec(
+        name="spark-1",
+        requests=resource_vector({
+            ext.RESOURCE_BATCH_CPU: 2000,
+            ext.RESOURCE_BATCH_MEMORY: 4 << 10,
+        }),
+        priority=5500, qos=int(QoSClass.BE)))
+    solve_client = connect(assembled["scheduler"].server.path)
+    result = solve_remote(solve_client)
+    assert result["assignments"] == {"spark-1": "n0"}
+
+    # --- 4. the runtime proxy dispatches the container hooks to the
+    # koordlet BINARY's hook server (proxy dispatcher -> RemoteHookServer
+    # -> koordlet RpcServer -> RegistryHookServer -> plugins)
+    proxy = assembled["proxy"].component
+    hook_client = connect(assembled["koordlet"].component.hook_server.path)
+    proxy.dispatcher.register(RemoteHookServer(hook_client), list(HookType))
+    forwarded = {}
+    proxy.backend["CreateContainer"] = (
+        lambda req: forwarded.setdefault("create", req))
+    request = HookRequest(
+        pod_meta={"uid": "spark-1", "name": "spark-1"},
+        container_meta={"name": "m", "id": "c1"},
+        labels={ext.LABEL_POD_QOS: "BE"},
+        cgroup_parent="kubepods/besteffort/podspark-1",
+        resources={ext.RESOURCE_BATCH_CPU: 2000,
+                   ext.RESOURCE_BATCH_MEMORY: 4 << 30},
+    )
+    proxy.create_container("c1", request, pod_id="spark-1")
+    merged = forwarded["create"].resources
+    assert merged["cpu.cfs_quota"] == "200000"   # 2000m over CFS_PERIOD
+    assert merged["memory.limit"] == str(4 << 30)
+    assert merged["cpu.bvt_warp_ns"] == "-1"     # BE group identity
+
+    # --- 5. the descheduler binary runs a clean round over the cluster
+    descheduler = assembled["descheduler"].component
+    assert descheduler.run_once() == {"default": 0}
