@@ -421,6 +421,8 @@ def main() -> None:
     candidates = {
         "approx": lambda st, p: batch_assign(st, p, cfg, k=16,
                                              method="approx")[:2],
+        "chunked": lambda st, p: batch_assign(st, p, cfg, k=16,
+                                              method="chunked")[:2],
         "fused": lambda st, p: batch_assign(st, p, cfg, k=16,
                                             method="fused")[:2],
     }

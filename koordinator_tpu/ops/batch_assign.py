@@ -53,7 +53,8 @@ _SCORE_CLIP = (1 << 30 - _TB_BITS) - 1
 
 
 def _ranked_scores(
-    scores: jnp.ndarray, feasible: jnp.ndarray, spread_bits: int = 0
+    scores: jnp.ndarray, feasible: jnp.ndarray, spread_bits: int = 0,
+    row_offset=0,
 ) -> jnp.ndarray:
     """(P, N) int32 ranking key: score in the high bits, a per-pod rotated
     node index in the low bits.  Equal-scored nodes order differently for
@@ -71,7 +72,9 @@ def _ranked_scores(
     interchangeable: defaultPodTopologySpread jitter, selectHost randomness).
     """
     p, n = scores.shape
-    rot = (jnp.arange(p, dtype=jnp.int32) * 7919)[:, None]  # per-pod offset
+    # per-pod offset; row_offset keeps chunked reductions rotating by the
+    # GLOBAL pod index, so chunking never changes any pod's candidates
+    rot = ((jnp.arange(p, dtype=jnp.int32) + row_offset) * 7919)[:, None]
     tb = (jnp.arange(n, dtype=jnp.int32)[None, :] - rot) % n
     # invert so the SMALLEST rotated distance ranks highest among ties
     tb = (n - 1) - tb
@@ -196,11 +199,17 @@ class _RoundCarry:
 #: - "approx": XLA score + ``lax.approx_max_k`` on a 24-bit float key
 #:             (~0.95 recall on TPU; the CPU lowering is exact, but the
 #:             float-key quantization is exercised on every backend)
+#: - "chunked": the approx reduction over pod CHUNKS via ``lax.map`` —
+#:             bit-identical rows to "approx" (global row offsets feed the
+#:             rotation), but peak memory is (chunk, N), not (P, N): at
+#:             the 50k x 10,240 shape the unchunked path materializes
+#:             ~2 GB per (P, N) tensor (scores, feasible, ranking keys),
+#:             the chunked path ~160 MB per (4096, N) block
 #: - "fused":  Pallas streaming kernel (ops/pallas_score.py) — no (P, N)
 #:             HBM materialization; interpret mode off-TPU so the branch is
 #:             runnable (and testable) everywhere
 #: - "auto":   "approx" on TPU, "exact" elsewhere
-CANDIDATE_METHODS = ("auto", "exact", "approx", "fused")
+CANDIDATE_METHODS = ("auto", "exact", "approx", "chunked", "fused")
 
 
 def batch_assign(
@@ -287,17 +296,26 @@ def select_candidates(
             state, pods, cfg, k=min(k, state.capacity),
             spread_bits=strata,
             interpret=jax.default_backend() != "tpu")
+    if method == "chunked":
+        return _chunked_candidates(state, pods, cfg, k=k, strata=strata)
     scores, feasible = score_pods(state, pods, cfg)
-    k = min(k, scores.shape[1])
-    order_key = _ranked_scores(scores, feasible, strata[0])
+    return _reduce_candidates(scores, feasible, strata,
+                              min(k, scores.shape[1]), method)
+
+
+def _reduce_candidates(scores, feasible, strata, k: int, method: str,
+                       row_offset=0):
+    """The (scores, feasible) -> (cand_key, cand_node) reduction shared by
+    the whole-batch and chunked paths."""
+    order_key = _ranked_scores(scores, feasible, strata[0], row_offset)
     splits = _stratum_splits(k, len(strata))
     nodes = []
     for sb, k_i in zip(strata, splits):
         if k_i == 0:
             continue
         key = (order_key if sb == strata[0]
-               else _ranked_scores(scores, feasible, sb))
-        if method == "approx" and k_i < key.shape[1]:
+               else _ranked_scores(scores, feasible, sb, row_offset))
+        if method in ("approx", "chunked") and k_i < key.shape[1]:
             # TPU-optimized partial reduction. approx_max_k needs a float
             # key exact within float32's 24-bit mantissa, so candidates
             # are chosen by the quantized score plus as many HIGH bits of
@@ -329,6 +347,53 @@ def select_candidates(
     # also yields -1 for infeasible slots of short candidate lists)
     cand_key = jnp.take_along_axis(order_key, cand_node, axis=1)
     return cand_key, cand_node
+
+
+#: pod-chunk width for method="chunked": peak score memory is
+#: (CANDIDATE_CHUNK, N) — 4096 x 10,240 x int32 = 160 MB at the
+#: north-star shape, vs ~2 GB per (P, N) tensor unchunked
+CANDIDATE_CHUNK = 4096
+
+
+def _chunked_candidates(state, pods, cfg, k: int, strata,
+                        chunk: int = CANDIDATE_CHUNK):
+    """The approx reduction over pod chunks: ``lax.map`` scores one
+    (chunk, N) block at a time and reduces it to (chunk, k) before the
+    next block's scores exist, so no (P, N) tensor is ever materialized.
+    Rows are bit-identical to ``method="approx"`` — scoring, ranking
+    (global row offsets) and the per-row reduction are all
+    row-independent; chunking only changes the execution schedule."""
+    p = pods.capacity
+    k = min(k, state.capacity)
+    chunk = min(chunk, p)   # a small batch must not score 4096-row pads
+    n_chunks = -(-p // chunk)
+    padded = n_chunks * chunk
+
+    def pad_rows(a):
+        # every PodBatch field is per-pod along axis 0 (the compact()
+        # invariant), so the whole pytree pads uniformly; zero/False
+        # padding means invalid rows, which reduce to key -1
+        pad_width = [(0, padded - p)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, pad_width)
+
+    stacked = jax.tree.map(pad_rows, pods)
+
+    def reshape_rows(a):
+        return (None if a is None
+                else a.reshape((n_chunks, chunk) + a.shape[1:]))
+
+    offsets = jnp.arange(n_chunks, dtype=jnp.int32) * chunk
+
+    def body(args):
+        offset, sub = args
+        scores, feasible = score_pods(state, sub, cfg)
+        return _reduce_candidates(scores, feasible, strata, k,
+                                  "chunked", row_offset=offset)
+
+    sub_batches = jax.tree.map(reshape_rows, stacked)
+    keys, nodes = jax.lax.map(body, (offsets, sub_batches))
+    return (keys.reshape(padded, -1)[:p],
+            nodes.reshape(padded, -1)[:p])
 
 
 def _stratum_splits(k: int, n: int) -> list[int]:
