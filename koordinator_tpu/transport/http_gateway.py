@@ -13,6 +13,10 @@ Routes (all JSON bodies/responses):
 
     GET  /healthz                      -> {"ok": true}
     GET  /version                      -> {"protocol": N}
+    POST /v1/state                     -> one state event (the STATE_PUSH
+                                          frame's JSON form: {"kind",
+                                          "name", resource vectors as
+                                          arrays, ...}) -> {"rv": N}
     POST /v1/solve                     -> one scheduling round
     POST /v1/hooks/<HookType>          -> runtime-hook dispatch
     GET  /v1/leases/<name>             -> lease record
@@ -57,12 +61,14 @@ class HttpGateway:
         lease_store=None,
         pod_resources=None,
         auditor=None,
+        state_sync=None,
     ):
         self.scheduler = scheduler
         self.dispatcher = dispatcher
         self.lease_store = lease_store
         self.pod_resources = pod_resources
         self.auditor = auditor
+        self.state_sync = state_sync
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -129,6 +135,8 @@ class HttpGateway:
             return req._reply(200, {"ok": True})
         if method == "GET" and path == "/version":
             return req._reply(200, {"protocol": PROTOCOL_VERSION})
+        if method == "POST" and path == "/v1/state":
+            return self._state_push(req)
         if method == "POST" and path == "/v1/solve":
             return self._solve(req)
         if method == "GET" and path == "/v1/diagnosis":
@@ -161,6 +169,47 @@ class HttpGateway:
             if method == "PUT":
                 return self._lease_put(req, m.group(1))
         req._reply(404, {"error": f"no route {method} {path}"})
+
+    def _state_push(self, req) -> None:
+        """One state event, the STATE_PUSH frame's JSON form: resource
+        vectors ride as JSON int arrays (fine for the interop path; the
+        hot path uses the framed transport's raw array section).  Rides
+        the same validated handler, so a malformed HTTP push fails with
+        400 instead of poisoning the replay log."""
+        if self.state_sync is None:
+            return req._reply(501, {"error": "no state-sync service"})
+        import numpy as np
+
+        from koordinator_tpu.transport.wire import (
+            FrameType,
+            WireSchemaError,
+            validate_doc,
+        )
+
+        doc = req._body()
+        if not isinstance(doc, dict):
+            return req._reply(400, {"error": "body must be a JSON object"})
+        arrays = {}
+        for key in ("allocatable", "usage", "requests"):
+            if key in doc:
+                value = doc.pop(key)
+                if (not isinstance(value, list)
+                        or not all(isinstance(v, int)
+                                   and not isinstance(v, bool)
+                                   for v in value)):
+                    return req._reply(400, {
+                        "error": f"{key} must be a JSON array of ints"})
+                try:
+                    arrays[key] = np.asarray(value, np.int64)
+                except OverflowError:
+                    return req._reply(400, {
+                        "error": f"{key} has values beyond int64"})
+        try:
+            validate_doc(FrameType.STATE_PUSH, doc)
+            out, _ = self.state_sync._handle_state_push(doc, arrays)
+        except WireSchemaError as e:
+            return req._reply(400, {"error": str(e)})
+        req._reply(200, out)
 
     def _solve(self, req) -> None:
         if self.scheduler is None:

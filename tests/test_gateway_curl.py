@@ -93,3 +93,71 @@ def test_curl_drives_the_full_surface(gateway):
     stale = dict(record, expect_holder="someone-else", holder="thief")
     code, doc = curl("PUT", f"{base}/v1/leases/curl-lease", body=stale)
     assert (code, doc["ok"]) == (409, False)
+
+
+def test_curl_pushes_state_and_solves(tmp_path):
+    """State enters over plain HTTP (the /v1/state route, STATE_PUSH's
+    JSON form), reaches the scheduler through the production
+    commit->broadcast->binding path, and the pushed pod schedules onto
+    the pushed node — the full plugin->sidecar feed direction with zero
+    custom client code."""
+    from koordinator_tpu.transport import (
+        RpcClient,
+        RpcServer,
+        StateSyncClient,
+        StateSyncService,
+    )
+    from koordinator_tpu.transport.deltasync import SchedulerBinding
+
+    scheduler, _ = mk_scheduler([])
+    server = RpcServer(str(tmp_path / "sync.sock"))
+    service = StateSyncService()
+    service.attach(server)
+    server.start()
+    sync = StateSyncClient(SchedulerBinding(scheduler))
+    feed = RpcClient(server.path, on_push=sync.on_push)
+    feed.connect()
+    sync.bootstrap(feed)
+
+    from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS as r
+
+    gw = HttpGateway(scheduler=scheduler, state_sync=service)
+    gw.start()
+    base = f"http://127.0.0.1:{gw.port}"
+    try:
+        alloc = [16_000, 32_768] + [0] * (r - 2)
+        code, doc = curl("POST", f"{base}/v1/state", body={
+            "kind": "node_upsert", "name": "curl-node",
+            "allocatable": alloc})
+        assert code == 200 and doc["rv"] == 1
+
+        code, doc = curl("POST", f"{base}/v1/state", body={
+            "kind": "pod_add", "name": "curl-pod-2",
+            "requests": [1_000, 1_024] + [0] * (r - 2)})
+        assert code == 200 and doc["rv"] == 2
+
+        # malformed pushes answer 400 and never reach the replay log
+        code, doc = curl("POST", f"{base}/v1/state", body={
+            "kind": "node_upsert", "name": "bad",
+            "allocatable": [1, 2, 3]})
+        assert code == 400 and "shape" in doc["error"]
+        code, doc = curl("POST", f"{base}/v1/state", body={
+            "kind": "pod_add", "name": "bad",
+            "requests": "not-an-array"})
+        assert code == 400
+        assert service.rv == 2
+
+        # the solve sees the HTTP-pushed state once the feed applies it
+        deadline = 50
+        for _ in range(deadline):
+            code, doc = curl("POST", f"{base}/v1/solve", body={})
+            assert code == 200
+            if doc["assignments"].get("curl-pod-2") == "curl-node":
+                break
+            import time
+            time.sleep(0.1)
+        assert doc["assignments"]["curl-pod-2"] == "curl-node"
+    finally:
+        gw.stop()
+        feed.close()
+        server.stop()
