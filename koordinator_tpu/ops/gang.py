@@ -139,6 +139,7 @@ def gang_assign(
     quota=None,
     passes: int = 2,
     solver: str = "greedy",
+    method: str = "auto",
 ):
     """Batch assignment with gang all-or-nothing semantics.
 
@@ -152,13 +153,24 @@ def gang_assign(
     (ops/batch_assign.py) — the throughput path for large queues, with
     round-granular feedback and top-k candidate restriction. Gang
     rollback/all-or-nothing semantics are identical either way (they act
-    on the assignment vector).
+    on the assignment vector).  ``method`` passes through to the batch
+    solver's candidate selection (batch_assign.CANDIDATE_METHODS), so
+    gang solves can force the chunked/approx/fused paths too.
     """
     from koordinator_tpu.ops import scoring
     from koordinator_tpu.ops.batch_assign import batch_assign
 
     if solver not in ("greedy", "batch"):
         raise ValueError(f"unknown solver {solver!r}")
+    from koordinator_tpu.ops.batch_assign import CANDIDATE_METHODS
+
+    if method not in CANDIDATE_METHODS:
+        raise ValueError(f"unknown candidate method {method!r}; "
+                         f"one of {CANDIDATE_METHODS}")
+    if solver == "greedy" and method != "auto":
+        # the sequential scan has no candidate stage: a forced method
+        # that silently did nothing would fake a measurement
+        raise ValueError('method applies only to solver="batch"')
 
     pre_ok = pre_enqueue_mask(pods, gangs)
     active_pods = pods.replace(valid=pods.valid & pre_ok)
@@ -181,7 +193,8 @@ def gang_assign(
             node_agg_usage=cur_state.node_agg_usage + est_accum,
         )
         if solver == "batch":
-            a, _, _ = batch_assign(solve_state, active_pods, cfg, cur_quota)
+            a, _, _ = batch_assign(solve_state, active_pods, cfg, cur_quota,
+                                   method=method)
         else:
             a, _, _ = greedy_assign(solve_state, active_pods, cfg, cur_quota)
 

@@ -210,3 +210,23 @@ class TestChunkedCandidates:
         ck_c, cn_c = run(state, pods, cfg, k=8, method="chunked")
         assert np.array_equal(np.asarray(ck_a), np.asarray(ck_c))
         assert np.array_equal(np.asarray(cn_a), np.asarray(cn_c))
+
+
+def test_gang_batch_solver_method_passthrough():
+    """gang_assign(solver="batch", method=...) reaches the candidate
+    stage: chunked and approx passes produce identical gang outcomes."""
+    from koordinator_tpu.ops.gang import GangInfo, gang_assign
+
+    state, pods, cfg = build_problem(n_nodes=256, n_pods=600, seed=6)
+    gang_id = np.full(pods.capacity, -1, np.int32)
+    gang_id[:32] = 0
+    gpods = pods.replace(gang_id=jnp.asarray(gang_id))
+    gangs = GangInfo.build(np.array([16], np.int32))
+    run = jax.jit(gang_assign,
+                  static_argnames=("passes", "solver", "method"))
+    a_approx, _, _ = run(state, gpods, cfg, gangs, passes=2,
+                         solver="batch", method="approx")
+    a_chunked, _, _ = run(state, gpods, cfg, gangs, passes=2,
+                          solver="batch", method="chunked")
+    assert np.array_equal(np.asarray(a_approx), np.asarray(a_chunked))
+    assert int((np.asarray(a_chunked) >= 0).sum()) > 0
