@@ -36,6 +36,21 @@ class Assembled:
     component: Any
     elector: Optional[Any] = None
     server: Optional[Any] = None   # transport RpcServer when one was opened
+    gateway: Optional[Any] = None  # HTTP/JSON gateway when one was opened
+
+    def stop(self) -> None:
+        """Tear down whatever this binary opened (sockets, gateway, the
+        component's own lifecycle); a leading elector releases its lease
+        so a follower acquires without waiting out the duration."""
+        if self.elector is not None:
+            self.elector.release()
+        if self.gateway is not None:
+            self.gateway.stop()
+        if self.server is not None:
+            self.server.stop()
+        stop = getattr(self.component, "stop", None)
+        if callable(stop):
+            stop()
 
 
 # ---- koordlet --------------------------------------------------------------
@@ -134,6 +149,11 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
     parser.add_argument("--listen-socket", default="",
                         help="unix socket for the solve/state-sync RPC "
                              "services (empty = in-process only)")
+    parser.add_argument(
+        "--http-port", type=int, default=None,
+        help="serve the HTTP/JSON gateway (solve, state push, leases, "
+             "diagnosis) — the zero-client-code sidecar surface; omit "
+             "to disable")
     return parser
 
 
@@ -165,15 +185,51 @@ def main_koord_scheduler(argv: list[str],
         elector=elector,
     )
     server = None
+    sync_service = None
+    if args.listen_socket or args.http_port is not None:
+        # the SIDECAR assembly: state enters over STATE_PUSH frames or
+        # POST /v1/state, lands in the sync service, and applies to the
+        # scheduler synchronously through an in-process binding — the
+        # same commit->binding path remote sync clients ride, minus the
+        # socket loop.  Remote replicas can still HELLO the same service
+        # for snapshots/deltas.
+        from koordinator_tpu.transport.deltasync import (
+            SchedulerBinding,
+            StateSyncService,
+        )
+
+        sync_service = StateSyncService()
+        sync_service.attach_binding(SchedulerBinding(scheduler))
+    # the lease surface (frames + HTTP) must share the elector's store:
+    # a private store would let a remote contender "acquire" a lease the
+    # local elector also holds in the real one — split-brain
+    shared_lease_store = (elector.store if elector is not None
+                          else lease_store)
+    if shared_lease_store is None:
+        from koordinator_tpu.ha import InMemoryLeaseStore
+
+        shared_lease_store = InMemoryLeaseStore()
     if args.listen_socket:
+        from koordinator_tpu.ha import LeaseService
         from koordinator_tpu.transport import RpcServer
         from koordinator_tpu.transport.services import SolveService
 
         server = RpcServer(args.listen_socket)
         SolveService(scheduler).attach(server)
+        sync_service.attach(server)
+        LeaseService(store=shared_lease_store).attach(server)
         server.start()
+    gateway = None
+    if args.http_port is not None:
+        from koordinator_tpu.transport.http_gateway import HttpGateway
+
+        gateway = HttpGateway(port=args.http_port, scheduler=scheduler,
+                              state_sync=sync_service,
+                              lease_store=shared_lease_store)
+        gateway.start()
     return Assembled(name="koord-scheduler", args=args,
-                     component=scheduler, elector=elector, server=server)
+                     component=scheduler, elector=elector, server=server,
+                     gateway=gateway)
 
 
 # ---- koord-manager ---------------------------------------------------------

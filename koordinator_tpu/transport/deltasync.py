@@ -116,8 +116,24 @@ class StateSyncService:
         self.pods: dict[str, dict] = {}       # name -> {doc, arrays}
         self.reservations: dict[str, dict] = {}
         self._server = None
+        self._local_bindings: list = []
+        #: committed events awaiting local-binding apply; populated under
+        #: _lock (so it carries rv order), drained under _binding_lock
+        #: only — binding applies block on scheduler.lock and must never
+        #: hold the service lock while they do
+        self._binding_queue: deque = deque()
+        self._binding_lock = threading.Lock()
 
     # -- mutations (informer event handlers) --------------------------------
+
+    def attach_binding(self, binding) -> None:
+        """Register an IN-PROCESS subscriber (e.g. a SchedulerBinding):
+        every committed event is applied to it synchronously, so a
+        sidecar binary whose solver lives in the same process as its
+        sync service sees pushed state immediately — no socket loop, no
+        eventual-consistency window.  Remote sync clients keep the
+        broadcast path."""
+        self._local_bindings.append(binding)
 
     def _commit(self, event: dict, arrays: dict[str, np.ndarray]) -> int:
         """Append + broadcast under the lock so rv order and wire order
@@ -133,7 +149,26 @@ class StateSyncService:
             if self._server is not None:
                 doc, stacked = _pack_events([(rv, event, arrays)])
                 self._server.broadcast(FrameType.DELTA, doc, stacked)
-            return rv
+            if self._local_bindings:
+                self._binding_queue.append((event, arrays))
+        # apply OUTSIDE the service lock: bindings block on the scheduler
+        # lock (a long solve), and holding _lock through that would stall
+        # every HELLO/push/broadcast behind it.  The queue was filled in
+        # rv order under _lock; draining FIFO under _binding_lock keeps
+        # that order even when two pushers race to drain.
+        if self._local_bindings:
+            self._drain_bindings()
+        return rv
+
+    def _drain_bindings(self) -> None:
+        with self._binding_lock:
+            while True:
+                try:
+                    event, arrays = self._binding_queue.popleft()
+                except IndexError:
+                    return
+                for binding in self._local_bindings:
+                    _dispatch_event(binding, event, arrays)
 
     def upsert_node(self, name: str, allocatable: np.ndarray,
                     usage: np.ndarray | None = None,
@@ -451,19 +486,26 @@ class StateSyncClient:
         return n
 
     def _dispatch(self, entry: dict, arrs: dict[str, np.ndarray]) -> None:
-        kind = entry["kind"]
-        if kind == NODE_UPSERT:
-            self.binding.node_upsert(entry, arrs)
-        elif kind == NODE_REMOVE:
-            self.binding.node_remove(entry["name"])
-        elif kind == POD_ADD:
-            self.binding.pod_add(entry, arrs)
-        elif kind == POD_REMOVE:
-            self.binding.pod_remove(entry["name"])
-        elif kind == RSV_UPSERT:
-            self.binding.reservation_upsert(entry, arrs)
-        elif kind == RSV_REMOVE:
-            self.binding.reservation_remove(entry["name"])
+        _dispatch_event(self.binding, entry, arrs)
+
+
+def _dispatch_event(binding, entry: dict,
+                    arrs: dict[str, np.ndarray]) -> None:
+    """Route one sync event to a binding (shared by the remote client's
+    watch stream and the service's in-process subscribers)."""
+    kind = entry["kind"]
+    if kind == NODE_UPSERT:
+        binding.node_upsert(entry, arrs)
+    elif kind == NODE_REMOVE:
+        binding.node_remove(entry["name"])
+    elif kind == POD_ADD:
+        binding.pod_add(entry, arrs)
+    elif kind == POD_REMOVE:
+        binding.pod_remove(entry["name"])
+    elif kind == RSV_UPSERT:
+        binding.reservation_upsert(entry, arrs)
+    elif kind == RSV_REMOVE:
+        binding.reservation_remove(entry["name"])
 
 
 class SchedulerBinding:

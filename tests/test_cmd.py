@@ -267,3 +267,67 @@ def test_koordlet_pod_resources_upstream_seam(tmp_path):
             asm.component.stop()
     finally:
         KOORDLET_GATES.set("PodResourcesProxy", old)
+
+
+def test_scheduler_binary_is_a_full_sidecar(tmp_path):
+    """koord-scheduler --listen-socket + --http-port: state enters over
+    STATE_PUSH frames or POST /v1/state, applies to the scheduler
+    SYNCHRONOUSLY through the in-process binding, and the very next
+    solve sees it — no eventual-consistency window."""
+    import json
+    import urllib.request
+
+    import numpy as np
+
+    from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+    from koordinator_tpu.transport import RpcClient
+    from koordinator_tpu.transport.services import solve_remote
+    from koordinator_tpu.transport.wire import FrameType
+
+    asm = main_koord_scheduler([
+        "--node-capacity", "16",
+        "--listen-socket", str(tmp_path / "sidecar.sock"),
+        "--http-port", "0",
+    ])
+    r = NUM_RESOURCE_DIMS
+    try:
+        # framed path: push a node, then solve over the same socket
+        client = RpcClient(asm.server.path)
+        client.connect()
+        try:
+            _, doc, _ = client.call(
+                FrameType.STATE_PUSH,
+                {"kind": "node_upsert", "name": "wire-node"},
+                {"allocatable": np.asarray(
+                    [8_000, 16_384] + [0] * (r - 2), np.int32)})
+            assert doc["rv"] == 1
+
+            # HTTP path: push a pod with curl-equivalent plumbing
+            body = json.dumps({
+                "kind": "pod_add", "name": "http-pod",
+                "requests": [1_000, 1_024] + [0] * (r - 2),
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{asm.gateway.port}/v1/state",
+                data=body, headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert json.loads(resp.read())["rv"] == 2
+
+            # the binding applied both synchronously: first solve wins
+            result = solve_remote(client)
+            assert result["assignments"] == {"http-pod": "wire-node"}
+        finally:
+            client.close()
+    finally:
+        asm.stop()
+
+
+def test_stop_releases_leadership_for_fast_failover():
+    store = InMemoryLeaseStore()
+    a = main_koord_scheduler(["--identity", "a"], lease_store=store)
+    b = main_koord_scheduler(["--identity", "b"], lease_store=store)
+    assert a.elector.tick() is True
+    assert b.elector.tick() is False
+    a.stop()   # clean shutdown releases the lease (ReleaseOnCancel)
+    assert b.elector.tick() is True, "follower should acquire immediately"

@@ -606,3 +606,56 @@ def test_koordlet_device_report_feeds_scheduler_over_wire(rpc, tmp_path):
     minors = [g["minor"] for g in
               sched.resource_status["gpu-1"]["device-allocated"]["gpu"]]
     assert sorted(minors) == [0, 1]   # both probed GPUs allocated
+
+
+class TestLocalBindings:
+    """StateSyncService.attach_binding: the in-process sidecar feed."""
+
+    def test_synchronous_apply_in_rv_order(self):
+        applied = []
+
+        class Recorder:
+            def node_upsert(self, entry, arrs):
+                applied.append(("node", entry["name"]))
+
+            def pod_add(self, entry, arrs):
+                applied.append(("pod", entry["name"]))
+
+            def pod_remove(self, name):
+                applied.append(("rm", name))
+
+        service = StateSyncService()
+        service.attach_binding(Recorder())
+        service.upsert_node("n1", resource_vector(cpu=8_000, memory=8_192))
+        service.add_pod("p1", resource_vector(cpu=500, memory=512))
+        service.remove_pod("p1")
+        # applied before each mutation returned, in commit order
+        assert applied == [("node", "n1"), ("pod", "p1"), ("rm", "p1")]
+
+    def test_service_stays_live_while_a_binding_apply_blocks(self):
+        """The liveness contract: binding applies run OUTSIDE the service
+        lock, so a push stuck behind a long solve (the binding blocks on
+        scheduler.lock) cannot stall HELLO/snapshot for other peers."""
+        gate = threading.Event()
+        entered = threading.Event()
+
+        class Stuck:
+            def node_upsert(self, entry, arrs):
+                entered.set()
+                assert gate.wait(10), "test gate never opened"
+
+        service = StateSyncService()
+        service.attach_binding(Stuck())
+        pusher = threading.Thread(
+            target=lambda: service.upsert_node(
+                "slow", resource_vector(cpu=1_000, memory=1_024)),
+            daemon=True)
+        pusher.start()
+        assert entered.wait(5), "binding apply never started"
+        # the pusher is parked inside the binding; the service must still
+        # answer a fresh HELLO (snapshot) without waiting for it
+        doc, _ = service._handle_hello({"last_rv": -1, "proto": 3}, {})
+        assert doc["rv"] == 1 and len(doc["events"]) == 1
+        gate.set()
+        pusher.join(5)
+        assert not pusher.is_alive()
