@@ -37,6 +37,7 @@ class Assembled:
     elector: Optional[Any] = None
     server: Optional[Any] = None   # transport RpcServer when one was opened
     gateway: Optional[Any] = None  # HTTP/JSON gateway when one was opened
+    state_sync: Optional[Any] = None  # StateSyncService (sidecar assembly)
 
     def stop(self) -> None:
         """Tear down whatever this binary opened (sockets, gateway, the
@@ -229,7 +230,7 @@ def main_koord_scheduler(argv: list[str],
         gateway.start()
     return Assembled(name="koord-scheduler", args=args,
                      component=scheduler, elector=elector, server=server,
-                     gateway=gateway)
+                     gateway=gateway, state_sync=sync_service)
 
 
 # ---- koord-manager ---------------------------------------------------------

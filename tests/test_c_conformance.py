@@ -15,21 +15,9 @@ import json
 import os
 import subprocess
 
-import jax.numpy as jnp
 import pytest
 
 from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, resource_vector
-from koordinator_tpu.ha import LeaseService
-from koordinator_tpu.ops.assignment import ScoringConfig
-from koordinator_tpu.scheduler import ClusterSnapshot, Scheduler
-from koordinator_tpu.transport import (
-    RpcClient,
-    RpcServer,
-    StateSyncClient,
-    StateSyncService,
-)
-from koordinator_tpu.transport.deltasync import SchedulerBinding
-from koordinator_tpu.transport.services import SolveService
 
 R = NUM_RESOURCE_DIMS
 SRC = os.path.join(os.path.dirname(__file__), "..", "native",
@@ -50,38 +38,28 @@ def client_bin(tmp_path_factory):
     return out
 
 
-def mk_scheduler():
-    cfg = ScoringConfig.default().replace(
-        usage_thresholds=jnp.zeros(R, jnp.int32),
-        estimator_defaults=jnp.zeros(R, jnp.int32))
-    return Scheduler(ClusterSnapshot(capacity=16), config=cfg)
-
-
 def test_c_client_full_protocol(client_bin):
-    server = RpcServer("tcp://127.0.0.1:0")
-    service = StateSyncService()
-    service.attach(server)
+    """The C peer drives the SHIPPED binary: ``koord-scheduler
+    --listen-socket tcp://...`` assembles the whole sidecar (solve +
+    state-sync + lease frames, in-process binding), so this is the
+    deployment artifact speaking the protocol, not a test harness."""
+    from koordinator_tpu.cmd.binaries import main_koord_scheduler
+
+    asm = main_koord_scheduler([
+        "--node-capacity", "16",
+        "--listen-socket", "tcp://127.0.0.1:0",
+        "--disable-leader-election",
+    ])
+    sched = asm.component
     # state that predates the C client: it must arrive via SNAPSHOT
-    service.upsert_node("py-node", resource_vector(cpu=8_000, memory=32_768))
-    service.add_pod("py-pod", resource_vector(cpu=1_000, memory=1_024))
+    asm.state_sync.upsert_node("py-node",
+                               resource_vector(cpu=8_000, memory=32_768))
+    asm.state_sync.add_pod("py-pod", resource_vector(cpu=1_000, memory=1_024))
 
-    sched = mk_scheduler()
-    SolveService(sched).attach(server)
-    LeaseService().attach(server)
-    server.start()
-
-    # the solver's own feed: a Python sync client over the same socket,
-    # exactly the production wiring — the C client's pushed state must
-    # reach the scheduler through the commit->broadcast->binding path
-    sync = StateSyncClient(SchedulerBinding(sched))
-    feed = RpcClient(server.address, on_push=sync.on_push)
-    feed.connect()
     try:
-        assert sync.bootstrap(feed) == 2
-
         proc = subprocess.run(
-            [client_bin, "127.0.0.1", server.address.rsplit(":", 1)[1],
-             str(R)],
+            [client_bin, "127.0.0.1",
+             asm.server.address.rsplit(":", 1)[1], str(R)],
             capture_output=True, text=True, timeout=60)
         assert proc.returncode == 0, (
             f"C client failed (stderr):\n{proc.stderr}\n"
@@ -105,8 +83,7 @@ def test_c_client_full_protocol(client_bin):
         assert result["lease_acquired"] is True
         assert result["stale_cas_refused"] is True
 
-        # and the Python-side scheduler really holds the C state
+        # and the binary's scheduler really holds the C state
         assert "c-pod" not in sched.pending
     finally:
-        feed.close()
-        server.stop()
+        asm.stop()
