@@ -259,6 +259,11 @@ class StateSyncService:
         passes cluster state INTO plugins the same way).  The event takes
         the normal commit path, so every sync client — including the
         pusher — sees it back as an rv-ordered DELTA."""
+        # the channel layer validates before dispatch, but this handler is
+        # also reachable directly (the HTTP gateway, embedders): validate
+        # here too so a missing kind/name is always a schema error, never
+        # a KeyError
+        wire.validate_doc(FrameType.STATE_PUSH, doc)
         kind = doc.get("kind")
         name = doc["name"]
 
@@ -292,9 +297,10 @@ class StateSyncService:
         def require_doc(key, types, type_name):
             """Same poison-guard for the doc's typed fields: a string
             where a mapping belongs would commit fine and then crash
-            every sync client's binding on replay."""
+            every sync client's binding on replay (bool-vs-int per
+            wire.check_field_type)."""
             val = doc.get(key)
-            if val is not None and not isinstance(val, types):
+            if val is not None and not wire.check_field_type(val, types):
                 raise wire.WireSchemaError(
                     f"{kind} push: field {key!r} must be {type_name} "
                     f"or absent, got {type(val).__name__}")
@@ -311,12 +317,30 @@ class StateSyncService:
                 raise wire.WireSchemaError(
                     f"{kind} push: every 'owners' entry must be an "
                     f"object, got {type(owner).__name__}")
+            # nested matcher fields feed dict()/string handling on
+            # replay (SchedulerBinding.reservation_upsert)
+            if not wire.check_field_type(
+                    owner.get("labels", {}), dict):
+                raise wire.WireSchemaError(
+                    f"{kind} push: owner 'labels' must be an object")
+            if not wire.check_field_type(
+                    owner.get("controller", ""), str):
+                raise wire.WireSchemaError(
+                    f"{kind} push: owner 'controller' must be a string")
         for dev_type, inventory in (doc.get("devices") or {}).items():
             if not isinstance(inventory, list) or any(
                     not isinstance(entry, dict) for entry in inventory):
                 raise wire.WireSchemaError(
                     f"{kind} push: devices[{dev_type!r}] must be a list "
                     f"of objects")
+            for entry in inventory:
+                # entries feed DeviceState.build's int tensors on replay
+                for field in ("core", "memory", "group"):
+                    if not wire.check_field_type(
+                            entry.get(field, 0), int):
+                        raise wire.WireSchemaError(
+                            f"{kind} push: devices[{dev_type!r}] entry "
+                            f"field {field!r} must be an integer")
         for scalar_field in ("quota", "gang", "owner", "node"):
             require_doc(scalar_field, str, "a string")
         for int_field in ("priority", "qos"):

@@ -180,11 +180,7 @@ class HttpGateway:
             return req._reply(501, {"error": "no state-sync service"})
         import numpy as np
 
-        from koordinator_tpu.transport.wire import (
-            FrameType,
-            WireSchemaError,
-            validate_doc,
-        )
+        from koordinator_tpu.transport.wire import WireSchemaError
 
         doc = req._body()
         if not isinstance(doc, dict):
@@ -205,7 +201,7 @@ class HttpGateway:
                     return req._reply(400, {
                         "error": f"{key} has values beyond int64"})
         try:
-            validate_doc(FrameType.STATE_PUSH, doc)
+            # the handler owns schema validation (incl. kind/name)
             out, _ = self.state_sync._handle_state_push(doc, arrays)
         except WireSchemaError as e:
             return req._reply(400, {"error": str(e)})

@@ -116,6 +116,16 @@ REQUEST_SCHEMAS: dict[FrameType, dict[str, tuple]] = {
 }
 
 
+def check_field_type(val, types) -> bool:
+    """isinstance with the wire rule that bool (an int subclass) never
+    satisfies a numeric field unless bool is listed explicitly — one
+    copy of the rule for frame validation and state-push field checks."""
+    if isinstance(val, bool) and bool not in (
+            types if isinstance(types, tuple) else (types,)):
+        return False
+    return isinstance(val, types)
+
+
 def validate_doc(ftype: FrameType, doc: dict) -> None:
     """Check a request document against REQUEST_SCHEMAS (no-op for
     unschema'd frame types)."""
@@ -131,13 +141,7 @@ def validate_doc(ftype: FrameType, doc: dict) -> None:
                     f"{PROTOCOL_VERSION})")
             continue
         val = doc[field]
-        # bool is an int subclass; never accept it for numeric fields
-        if isinstance(val, bool) and bool not in (
-                types if isinstance(types, tuple) else (types,)):
-            raise WireSchemaError(
-                f"{ftype.name}: field {field!r} has bool value where "
-                f"{types} expected")
-        if not isinstance(val, types):
+        if not check_field_type(val, types):
             raise WireSchemaError(
                 f"{ftype.name}: field {field!r} has type "
                 f"{type(val).__name__}, expected {types}")

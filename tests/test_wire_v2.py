@@ -238,6 +238,20 @@ class TestStatePushValidation:
                                 {"allocatable": bad})
             assert service.rv == 0 and not service.nodes  # nothing logged
 
+            # nested element poisoning: a string where the reservation
+            # owner matcher expects a mapping must fail the call
+            with pytest.raises(RpcError, match="labels"):
+                client.call(FrameType.STATE_PUSH,
+                            {"kind": "rsv_upsert", "name": "r1",
+                             "owners": [{"labels": "xyz"}]},
+                            {"requests": np.zeros(10, np.int32)})
+            with pytest.raises(RpcError, match="core"):
+                client.call(FrameType.STATE_PUSH,
+                            {"kind": "node_upsert", "name": "n1",
+                             "devices": {"gpu": [{"core": "many"}]}},
+                            {"allocatable": np.zeros(10, np.int32)})
+            assert service.rv == 0 and not service.nodes
+
             _, doc, _ = client.call(
                 FrameType.STATE_PUSH,
                 {"kind": "node_upsert", "name": "good"},
@@ -246,3 +260,100 @@ class TestStatePushValidation:
         finally:
             client.close()
             server.stop()
+
+
+class TestStatePushNoPartialCommit:
+    """Property: ANY state push either commits atomically (rv advances
+    by one, the event replays to fresh clients) or raises WireSchemaError
+    with the service byte-identical to before — never a partial write.
+    Random adversarial documents/arrays via hypothesis."""
+
+    def test_random_pushes_atomic(self):
+        import numpy as np
+        from hypothesis import given, settings, strategies as st
+
+        from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+        from koordinator_tpu.transport.deltasync import StateSyncService
+        from koordinator_tpu.transport.wire import WireSchemaError
+
+        r = NUM_RESOURCE_DIMS
+
+        from koordinator_tpu.scheduler import ClusterSnapshot, Scheduler
+        from koordinator_tpu.transport.deltasync import (
+            SchedulerBinding,
+            _dispatch_event,
+            _unpack_event_arrays,
+        )
+
+        # ONE real scheduler binding replays every committed event: the
+        # atomicity property includes "the committed event cannot crash a
+        # real consumer on replay" (reservation owners, device entries)
+        replay = SchedulerBinding(Scheduler(ClusterSnapshot(capacity=16)))
+
+        json_scalars = st.one_of(
+            st.none(), st.booleans(), st.integers(-2**40, 2**40),
+            st.text(max_size=8))
+        docs = st.fixed_dictionaries(
+            {"kind": st.sampled_from(
+                ["node_upsert", "pod_add", "pod_remove", "rsv_upsert",
+                 "rsv_remove", "bogus"]),
+             "name": st.text(min_size=1, max_size=8)},
+            optional={
+                "labels": json_scalars | st.dictionaries(
+                    st.text(max_size=4), st.text(max_size=4), max_size=2),
+                "owners": json_scalars | st.lists(
+                    json_scalars | st.fixed_dictionaries(
+                        {},
+                        optional={
+                            "labels": json_scalars | st.dictionaries(
+                                st.text(max_size=4), st.text(max_size=4),
+                                max_size=2),
+                            "controller": json_scalars,
+                        }),
+                    max_size=2),
+                "devices": json_scalars | st.dictionaries(
+                    st.text(max_size=4),
+                    st.lists(json_scalars | st.fixed_dictionaries(
+                        {}, optional={"core": json_scalars,
+                                      "memory": json_scalars}),
+                             max_size=2),
+                    max_size=2),
+                "priority": json_scalars,
+                "ttl_sec": json_scalars,
+            })
+        arrays = st.dictionaries(
+            st.sampled_from(["allocatable", "usage", "requests"]),
+            st.one_of(
+                st.just(np.zeros(r, np.int32)),
+                st.just(np.zeros(r - 1, np.int32)),
+                st.just(np.zeros((2, r), np.int32)),
+                st.just(np.zeros(r, np.float32)),
+                st.just(np.full(r, 2**40, np.int64)),
+            ),
+            max_size=2)
+
+        @settings(max_examples=200, deadline=None)
+        @given(doc=docs, arrs=arrays)
+        def check(doc, arrs):
+            service = StateSyncService()
+            before = (service.rv, dict(service.nodes), dict(service.pods),
+                      dict(service.reservations))
+            try:
+                out, _ = service._handle_state_push(dict(doc), dict(arrs))
+            except WireSchemaError:
+                after = (service.rv, dict(service.nodes),
+                         dict(service.pods), dict(service.reservations))
+                assert after == before, (
+                    f"rejected push mutated the service: {doc} {list(arrs)}")
+            else:
+                assert out["rv"] == before[0] + 1
+                snapshot_doc, arrays = service._snapshot()
+                assert snapshot_doc["rv"] == out["rv"]
+                # the committed event must replay cleanly into a REAL
+                # consumer — a commit that crashes SchedulerBinding on
+                # replay poisons every client and future bootstrapper
+                for entry in snapshot_doc["events"]:
+                    _dispatch_event(
+                        replay, entry, _unpack_event_arrays(entry, arrays))
+
+        check()
