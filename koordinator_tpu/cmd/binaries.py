@@ -155,11 +155,21 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
         help="serve the HTTP/JSON gateway (solve, state push, leases, "
              "diagnosis) — the zero-client-code sidecar surface; omit "
              "to disable")
+    parser.add_argument(
+        "--config", default="",
+        help="KubeSchedulerConfiguration YAML with per-plugin args "
+             "(LoadAwareScheduling, NodeResourcesFitPlus, "
+             "ScarceResourceAvoidance, Coscheduling) — the reference's "
+             "versioned component config; defaults apply where unset")
     return parser
 
 
 def main_koord_scheduler(argv: list[str],
-                         lease_store=None) -> Assembled:
+                         lease_store=None, preempt_fn=None) -> Assembled:
+    """``preempt_fn(victim, preemptor)`` is the deployment shell's
+    eviction transport; required when preemption is enabled (the flag or
+    the config file), because nominating victims without evicting them
+    frees accounting for pods that keep running."""
     from koordinator_tpu.features import SCHEDULER_GATES
     from koordinator_tpu.scheduler import ClusterSnapshot, Scheduler
     from koordinator_tpu.scheduler.explanation import (
@@ -172,13 +182,34 @@ def main_koord_scheduler(argv: list[str],
 
     args = build_scheduler_parser().parse_args(argv)
     apply_feature_gates(args.feature_gates, SCHEDULER_GATES)
+    from koordinator_tpu.cmd.component_config import (
+        SchedulerComponentConfig,
+        load_scheduler_config,
+    )
+
+    # always go through the component config so every default (gang
+    # timeout, scoring) has exactly one home — the dataclass
+    component_config = (load_scheduler_config(args.config) if args.config
+                        else SchedulerComponentConfig())
     snapshot = ClusterSnapshot(capacity=args.node_capacity)
     elector = build_elector(args, lease_store)
+    # precedence: an explicit CLI flag wins over the config file, which
+    # wins over built-in defaults (matching the reference's flag layering)
+    enable_preemption = (args.enable_preemption
+                         or component_config.enable_preemption)
+    if enable_preemption and preempt_fn is None:
+        raise SystemExit(
+            "preemption enabled (flag or config) but no eviction "
+            "transport wired: pass preempt_fn to main_koord_scheduler — "
+            "nominating victims without evicting them double-books nodes")
     scheduler = Scheduler(
         snapshot,
+        config=component_config.scoring,
         gang_passes=args.gang_passes,
+        gang_default_timeout_sec=component_config.gang_default_timeout_sec,
         batch_solver_threshold=args.batch_solver_threshold,
-        enable_preemption=args.enable_preemption or None,
+        enable_preemption=enable_preemption or None,
+        preempt_fn=preempt_fn,
         explanations=ExplanationStore(),
         auditor=WorkloadAuditor(),
         cpu_manager=CPUManager(),

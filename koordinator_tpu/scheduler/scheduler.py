@@ -92,7 +92,9 @@ class GangRecord:
     name: str
     min_member: int
     group: str | None = None
-    wait_time_sec: float = 600.0
+    #: None = inherit the scheduler's default (CoschedulingArgs
+    #: DefaultTimeout via the component config; 600s like the reference)
+    wait_time_sec: float | None = None
     first_failure: float | None = None
     rejected: bool = False
     #: network-topology gather requirements; needs Scheduler.topology_tree
@@ -121,6 +123,7 @@ class Scheduler:
         bind_fn=None,
         monitor: SchedulerMonitor | None = None,
         gang_passes: int = 2,
+        gang_default_timeout_sec: float = 600.0,
         batch_solver_threshold: int = 1024,
         clock=time.monotonic,
         topology_tree: TopologyArrays | None = None,
@@ -141,6 +144,9 @@ class Scheduler:
         self.bind_fn = bind_fn
         self.monitor = monitor or SchedulerMonitor()
         self.gang_passes = gang_passes
+        #: CoschedulingArgs.DefaultTimeout: WaitTime for gangs that don't
+        #: set their own
+        self.gang_default_timeout_sec = gang_default_timeout_sec
         #: queues at or above this size solve with the data-parallel
         #: propose/accept engine instead of the exact sequential scan
         #: (ops/gang.py solver param) — exact for interactive queue sizes,
@@ -244,6 +250,8 @@ class Scheduler:
 
     def register_gang(self, record: GangRecord) -> None:
         with self.lock:
+            if record.wait_time_sec is None:
+                record.wait_time_sec = self.gang_default_timeout_sec
             self.gangs[record.name] = record
 
     def register_pdb(self, record: PdbRecord) -> None:

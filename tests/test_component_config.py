@@ -1,0 +1,150 @@
+"""Versioned component config (cmd/component_config.py) vs the
+reference's KubeSchedulerConfiguration loading with per-plugin args,
+defaulting, and validation (apis/config/types.go:31-396, v1/ defaulting,
+validation/)."""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.resources import ResourceDim
+from koordinator_tpu.cmd.binaries import main_koord_scheduler
+from koordinator_tpu.cmd.component_config import (
+    ComponentConfigError,
+    load_scheduler_config,
+)
+
+FULL = textwrap.dedent("""
+    apiVersion: kubescheduler.config.k8s.io/v1
+    kind: KubeSchedulerConfiguration
+    profiles:
+    - schedulerName: koord-scheduler
+      pluginConfig:
+      - name: LoadAwareScheduling
+        args:
+          resourceWeights: {cpu: 2, memory: 1}
+          dominantResourceWeight: 1
+          usageThresholds: {cpu: 70, memory: 90}
+          aggregated:
+            usageThresholds: {cpu: 60}
+          estimatedScalingFactors: {cpu: 80}
+      - name: NodeResourcesFitPlus
+        args:
+          resources:
+            cpu: {weight: 3, type: MostAllocated}
+            memory: {weight: 1, type: LeastAllocated}
+      - name: ScarceResourceAvoidance
+        args: {resources: [gpu], weight: 2}
+      - name: Coscheduling
+        args: {defaultTimeout: 300s, enablePreemption: true}
+""")
+
+
+def write(tmp_path, content):
+    path = tmp_path / "sched-config.yaml"
+    path.write_text(content)
+    return str(path)
+
+
+def test_full_profile_loads_with_defaulting(tmp_path):
+    out = load_scheduler_config(write(tmp_path, FULL))
+    scoring = out.scoring
+    w = np.asarray(scoring.loadaware_resource_weights)
+    assert w[ResourceDim.CPU] == 2 and w[ResourceDim.MEMORY] == 1
+    assert int(scoring.loadaware_dominant_weight) == 1
+    thr = np.asarray(scoring.usage_thresholds)
+    assert thr[ResourceDim.CPU] == 70 and thr[ResourceDim.MEMORY] == 90
+    agg = np.asarray(scoring.agg_usage_thresholds)
+    assert agg[ResourceDim.CPU] == 60 and agg[ResourceDim.MEMORY] == 0
+    factors = np.asarray(scoring.estimator_factors)
+    # given value applies; unspecified memory keeps the reference default
+    assert factors[ResourceDim.CPU] == 80
+    assert factors[ResourceDim.MEMORY] == 70
+    fp_w = np.asarray(scoring.fitplus_resource_weights)
+    assert fp_w[ResourceDim.CPU] == 3 and fp_w[ResourceDim.MEMORY] == 1
+    most = np.asarray(scoring.fitplus_most_allocated)
+    assert bool(most[ResourceDim.CPU]) and not bool(most[ResourceDim.MEMORY])
+    scarce = np.asarray(scoring.scarce_dims)
+    assert bool(scarce[ResourceDim.GPU])
+    assert int(scoring.scarce_plugin_weight) == 2
+    assert out.gang_default_timeout_sec == 300.0
+    assert out.enable_preemption is True
+
+
+def test_empty_plugin_config_is_pure_defaults(tmp_path):
+    out = load_scheduler_config(write(tmp_path, textwrap.dedent("""
+        kind: KubeSchedulerConfiguration
+        profiles:
+        - schedulerName: koord-scheduler
+    """)))
+    from koordinator_tpu.ops.assignment import ScoringConfig
+
+    defaults = ScoringConfig.default()
+    assert np.array_equal(np.asarray(out.scoring.usage_thresholds),
+                          np.asarray(defaults.usage_thresholds))
+    assert out.gang_default_timeout_sec == 600.0
+    assert out.enable_preemption is None
+
+
+@pytest.mark.parametrize("snippet,match", [
+    ("- name: Typo\n        args: {}", "unknown pluginConfig"),
+    ("- name: LoadAwareScheduling\n        args: {usageThreshold: {}}",
+     "unknown args"),
+    ("- name: LoadAwareScheduling\n"
+     "        args: {usageThresholds: {cpu: 150}}", "outside"),
+    ("- name: LoadAwareScheduling\n"
+     "        args: {usageThresholds: {floppy: 10}}", "unknown resource"),
+    ("- name: NodeResourcesFitPlus\n"
+     "        args: {resources: {cpu: {type: BalancedAllocation}}}",
+     "unsupported scoring strategy"),
+    ("- name: Coscheduling\n"
+     "        args: {defaultTimeout: soon}", "bad duration"),
+])
+def test_validation_is_loud(tmp_path, snippet, match):
+    content = textwrap.dedent("""
+        kind: KubeSchedulerConfiguration
+        profiles:
+        - schedulerName: koord-scheduler
+          pluginConfig:
+    """) + "      " + snippet + "\n"
+    with pytest.raises(ComponentConfigError, match=match):
+        load_scheduler_config(write(tmp_path, content))
+
+
+def test_missing_profile_is_an_error(tmp_path):
+    with pytest.raises(ComponentConfigError, match="no profile"):
+        load_scheduler_config(write(tmp_path, textwrap.dedent("""
+            kind: KubeSchedulerConfiguration
+            profiles:
+            - schedulerName: other-scheduler
+        """)))
+
+
+def test_preemption_from_config_requires_an_evictor(tmp_path):
+    with pytest.raises(SystemExit, match="no eviction transport"):
+        main_koord_scheduler([
+            "--config", write(tmp_path, FULL),
+            "--disable-leader-election",
+        ])
+
+
+def test_binary_wires_config_file(tmp_path):
+    evictions = []
+    asm = main_koord_scheduler([
+        "--config", write(tmp_path, FULL),
+        "--disable-leader-election",
+    ], preempt_fn=lambda victim, preemptor: evictions.append(victim))
+    try:
+        sched = asm.component
+        thr = np.asarray(sched.config.usage_thresholds)
+        assert thr[ResourceDim.CPU] == 70
+        assert sched.gang_default_timeout_sec == 300.0
+        assert sched.enable_preemption is True
+        # a gang with no explicit WaitTime inherits the config default
+        from koordinator_tpu.scheduler.scheduler import GangRecord
+
+        sched.register_gang(GangRecord(name="g", min_member=2))
+        assert sched.gangs["g"].wait_time_sec == 300.0
+    finally:
+        asm.stop()
