@@ -38,6 +38,9 @@ class Assembled:
     server: Optional[Any] = None   # transport RpcServer when one was opened
     gateway: Optional[Any] = None  # HTTP/JSON gateway when one was opened
     state_sync: Optional[Any] = None  # StateSyncService (sidecar assembly)
+    #: parsed component config (Scheduler/DeschedulerComponentConfig) so
+    #: the embedding shell wires data-dependent plugins with file args
+    component_config: Optional[Any] = None
 
     def stop(self) -> None:
         """Tear down whatever this binary opened (sockets, gateway, the
@@ -194,9 +197,13 @@ def main_koord_scheduler(argv: list[str],
     snapshot = ClusterSnapshot(capacity=args.node_capacity)
     elector = build_elector(args, lease_store)
     # precedence: an explicit CLI flag wins over the config file, which
-    # wins over built-in defaults (matching the reference's flag layering)
-    enable_preemption = (args.enable_preemption
-                         or component_config.enable_preemption)
+    # wins over built-in defaults (matching the reference's flag
+    # layering).  Tri-state is preserved: an explicit `enablePreemption:
+    # false` in the config must reach the Scheduler as False, not
+    # collapse to None (which would auto-enable when preempt_fn is
+    # wired).
+    enable_preemption = (True if args.enable_preemption
+                         else component_config.enable_preemption)
     if enable_preemption and preempt_fn is None:
         raise SystemExit(
             "preemption enabled (flag or config) but no eviction "
@@ -208,7 +215,7 @@ def main_koord_scheduler(argv: list[str],
         gang_passes=args.gang_passes,
         gang_default_timeout_sec=component_config.gang_default_timeout_sec,
         batch_solver_threshold=args.batch_solver_threshold,
-        enable_preemption=enable_preemption or None,
+        enable_preemption=enable_preemption,
         preempt_fn=preempt_fn,
         explanations=ExplanationStore(),
         auditor=WorkloadAuditor(),
@@ -261,7 +268,8 @@ def main_koord_scheduler(argv: list[str],
         gateway.start()
     return Assembled(name="koord-scheduler", args=args,
                      component=scheduler, elector=elector, server=server,
-                     gateway=gateway, state_sync=sync_service)
+                     gateway=gateway, state_sync=sync_service,
+                     component_config=component_config)
 
 
 # ---- koord-manager ---------------------------------------------------------
@@ -354,7 +362,8 @@ def build_descheduler_parser() -> argparse.ArgumentParser:
     add_leader_election_flags(parser, default_lease="koord-descheduler")
     parser.add_argument("--descheduling-interval-seconds", type=float,
                         default=120.0)
-    parser.add_argument("--max-evictions-per-round", type=int, default=0)
+    parser.add_argument("--max-evictions-per-round", type=int, default=None,
+                        help="0 = unlimited; omit to defer to the config")
     parser.add_argument("--evict-system-critical", action="store_true")
     parser.add_argument("--evict-local-storage-pods", action="store_true")
     parser.add_argument("--priority-threshold", type=int, default=None)
@@ -363,8 +372,15 @@ def build_descheduler_parser() -> argparse.ArgumentParser:
         help="comma list of DESCHEDULE plugins for the default profile: "
              + ",".join(sorted(_flag_selectable_descheduler_plugins())))
     parser.add_argument("--pod-lifetime-max-seconds", type=float,
-                        default=7 * 24 * 3600.0)
-    parser.add_argument("--pod-restart-threshold", type=int, default=100)
+                        default=None)
+    parser.add_argument("--pod-restart-threshold", type=int, default=None)
+    parser.add_argument(
+        "--config", default="",
+        help="DeschedulerConfiguration YAML with profile plugin "
+             "enablement + per-plugin args (LowNodeLoad thresholds, "
+             "MigrationController limits, DefaultEvictor, ...) — the "
+             "reference's versioned component config; explicit CLI "
+             "flags override")
     return parser
 
 
@@ -377,11 +393,35 @@ def main_koord_descheduler(argv: list[str], pods_fn=None,
         Profile,
     )
 
+    from koordinator_tpu.cmd.descheduler_config import (
+        DeschedulerComponentConfig,
+        load_descheduler_config,
+    )
+
     args = build_descheduler_parser().parse_args(argv)
+    component = (load_descheduler_config(args.config) if args.config
+                 else DeschedulerComponentConfig())
+    # precedence: explicit CLI flag > config file > built-in default
+    # (booleans or-combine; None-defaulted flags defer to the config)
+    priority_threshold = (args.priority_threshold
+                          if args.priority_threshold is not None
+                          else component.priority_threshold)
+    max_evictions = (args.max_evictions_per_round
+                     if args.max_evictions_per_round is not None
+                     else component.max_evictions_per_round)
+    lifetime_max = (args.pod_lifetime_max_seconds
+                    if args.pod_lifetime_max_seconds is not None
+                    else component.pod_lifetime_max_seconds
+                    or 7 * 24 * 3600.0)
+    restart_threshold = (args.pod_restart_threshold
+                         if args.pod_restart_threshold is not None
+                         else component.pod_restart_threshold or 100)
     evictor_filter = EvictorFilter(
-        evict_system_critical=args.evict_system_critical,
-        evict_local_storage=args.evict_local_storage_pods,
-        priority_threshold=args.priority_threshold,
+        evict_system_critical=(args.evict_system_critical
+                               or component.evict_system_critical),
+        evict_local_storage=(args.evict_local_storage_pods
+                             or component.evict_local_storage_pods),
+        priority_threshold=priority_threshold,
     )
     # upstream-port plugins selectable by name, derived from the single
     # upstream.PLUGINS registry (the reference's profile pluginConfig).
@@ -390,10 +430,9 @@ def main_koord_descheduler(argv: list[str], pods_fn=None,
     from koordinator_tpu.descheduler import upstream
 
     flag_kwargs = {
-        "PodLifeTime": lambda: {
-            "max_seconds": args.pod_lifetime_max_seconds},
+        "PodLifeTime": lambda: {"max_seconds": lifetime_max},
         "RemovePodsHavingTooManyRestarts": lambda: {
-            "pod_restart_threshold": args.pod_restart_threshold},
+            "pod_restart_threshold": restart_threshold},
     }
     available = {
         name.lower(): (cls, flag_kwargs.get(name, dict))
@@ -402,13 +441,34 @@ def main_koord_descheduler(argv: list[str], pods_fn=None,
     }
     deschedule_plugins = []
     balance_plugins = []
-    for raw in args.deschedule_plugins.split(","):
-        name = raw.strip().lower()
-        if not name:
-            continue
+    #: args-in-the-file, data-callables-from-the-shell plugins: the
+    #: loader validates their args (exposed via Assembled.component_
+    #: config), but only the embedding shell can construct them
+    shell_wired = {"lownodeload", "fragmentationaware"} | {
+        n.lower() for n in _NEEDS_NODES_FN}
+    requested: list[tuple[str, bool]] = []   # (name, from_config)
+    seen: set[str] = set()
+    for raw, from_config in (
+            [(r.strip(), False)
+             for r in args.deschedule_plugins.split(",") if r.strip()]
+            + [(n, True) for n in (component.deschedule_enabled
+                                   + component.balance_enabled)]):
+        if raw.lower() in seen:
+            continue   # duplicates must not instantiate a plugin twice
+        seen.add(raw.lower())
+        requested.append((raw, from_config))
+    for raw, from_config in requested:
+        name = raw.lower()
+        if name in shell_wired:
+            if from_config:
+                continue   # shell reads asm.component_config and wires it
+            raise SystemExit(
+                f"plugin {raw} needs data callables the CLI cannot "
+                f"provide; the embedding shell must wire it (its config "
+                f"args load via --config)")
         entry = available.get(name)
         if entry is None:
-            raise SystemExit(f"unknown deschedule plugin: {raw.strip()}")
+            raise SystemExit(f"unknown deschedule plugin: {raw}")
         cls, kwargs = entry
         plugin = cls(**kwargs())
         # upstream ports come in both kinds; route by interface
@@ -422,7 +482,7 @@ def main_koord_descheduler(argv: list[str], pods_fn=None,
         balance_plugins=balance_plugins,
         evictor_filter=evictor_filter,
         evictor=Evictor(),
-        max_evictions_per_round=args.max_evictions_per_round,
+        max_evictions_per_round=max_evictions,
     )
     elector = build_elector(args, lease_store)
     descheduler = Descheduler(
@@ -431,7 +491,8 @@ def main_koord_descheduler(argv: list[str], pods_fn=None,
         elector=elector,
     )
     return Assembled(name="koord-descheduler", args=args,
-                     component=descheduler, elector=elector)
+                     component=descheduler, elector=elector,
+                     component_config=component)
 
 
 # ---- koord-runtime-proxy ---------------------------------------------------

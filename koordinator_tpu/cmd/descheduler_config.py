@@ -1,0 +1,263 @@
+"""Versioned component config for the descheduler.
+
+The reference's descheduler loads a DeschedulerConfiguration with
+per-profile plugin enablement and plugin args
+(``pkg/descheduler/apis/config/types.go`` + ``types_loadaware.go`` +
+``v1alpha2/`` defaulting + ``validation/``); flags cannot express
+per-resource thresholds.  ``koord-descheduler --config FILE`` loads
+
+    apiVersion: descheduler/v1alpha2
+    kind: DeschedulerConfiguration
+    profiles:
+    - name: koord-descheduler
+      plugins:
+        deschedule:
+          enabled: [PodLifeTime, RemovePodsHavingTooManyRestarts]
+      pluginConfig:
+      - name: LowNodeLoad
+        args:
+          lowThresholds: {cpu: 40, memory: 50}
+          highThresholds: {cpu: 70, memory: 85}
+          useDeviationThresholds: false
+          anomalyCondition: {consecutiveAbnormalities: 5}
+      - name: PodLifeTime
+        args: {maxPodLifeTimeSeconds: 86400}
+      - name: RemovePodsHavingTooManyRestarts
+        args: {podRestartThreshold: 50}
+      - name: MigrationController
+        args:
+          maxMigratingPerNode: 2
+          maxMigratingPerNamespace: 10
+          maxMigratingPerWorkload: "10%"
+          maxUnavailablePerWorkload: 2
+      - name: DefaultEvictor
+        args: {priorityThreshold: 8000, evictLocalStoragePods: true,
+               maxNoOfPodsToEvictPerNode: 5}
+
+with the same loud-validation posture as the scheduler's loader
+(cmd/component_config.py): unknown names/keys/resources and
+out-of-range values are startup errors.  Data-dependent plugins
+(LowNodeLoad, FragmentationAware) get their ARGS from the file; their
+state/usage callables still come from the embedding shell, like the
+reference's informer wiring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from koordinator_tpu.cmd.component_config import (
+    ComponentConfigError,
+    _check_keys,
+    _int_vector,
+)
+from koordinator_tpu.descheduler.lownodeload import LowNodeLoadArgs
+from koordinator_tpu.descheduler.migration import ArbitrationLimits
+
+
+@dataclasses.dataclass
+class DeschedulerComponentConfig:
+    #: plugin names per extension point (framework Profile lists)
+    deschedule_enabled: list[str] = dataclasses.field(default_factory=list)
+    balance_enabled: list[str] = dataclasses.field(default_factory=list)
+    lownodeload: LowNodeLoadArgs = dataclasses.field(
+        default_factory=LowNodeLoadArgs.default)
+    pod_lifetime_max_seconds: float | None = None
+    pod_restart_threshold: int | None = None
+    migration_limits: ArbitrationLimits = dataclasses.field(
+        default_factory=ArbitrationLimits)
+    # DefaultEvictor args
+    priority_threshold: int | None = None
+    evict_system_critical: bool = False
+    evict_local_storage_pods: bool = False
+    max_evictions_per_round: int = 0
+
+
+def _positive_number(value, where: str) -> float:
+    if not isinstance(value, (int, float)) or isinstance(value, bool) \
+            or value <= 0:
+        raise ComponentConfigError(
+            f"{where}: expected a positive number, got {value!r}")
+    return float(value)
+
+
+def _positive_int(value, where: str) -> int:
+    """Loud about fractional values — int() truncation would silently
+    keep a different number than the file says."""
+    if not isinstance(value, int) or isinstance(value, bool) or value <= 0:
+        raise ComponentConfigError(
+            f"{where}: expected a positive integer, got {value!r}")
+    return value
+
+
+def _int_or_percent(value, where: str):
+    if value is None:
+        return None
+    if isinstance(value, int) and not isinstance(value, bool):
+        if value < 0:
+            raise ComponentConfigError(f"{where}: negative limit {value}")
+        return value
+    if isinstance(value, str) and value.endswith("%"):
+        try:
+            pct = int(value[:-1])
+        except ValueError:
+            raise ComponentConfigError(
+                f"{where}: bad percent {value!r}") from None
+        if not 0 <= pct <= 100:
+            raise ComponentConfigError(
+                f"{where}: percent {value!r} outside [0%, 100%]")
+        return value
+    raise ComponentConfigError(
+        f"{where}: expected an int or 'N%', got {value!r}")
+
+
+def _apply_lownodeload(out: DeschedulerComponentConfig,
+                       args: dict) -> None:
+    _check_keys(args, {"lowThresholds", "highThresholds",
+                       "useDeviationThresholds", "anomalyCondition"},
+                "LowNodeLoad")
+    lnl = out.lownodeload
+    if "lowThresholds" in args:
+        lnl = lnl.replace(low_thresholds=_int_vector(
+            jnp.full_like(lnl.low_thresholds, -1), args["lowThresholds"],
+            "LowNodeLoad.lowThresholds", hi=100))
+    if "highThresholds" in args:
+        lnl = lnl.replace(high_thresholds=_int_vector(
+            jnp.full_like(lnl.high_thresholds, -1),
+            args["highThresholds"], "LowNodeLoad.highThresholds", hi=100))
+    if "useDeviationThresholds" in args:
+        if not isinstance(args["useDeviationThresholds"], bool):
+            raise ComponentConfigError(
+                "LowNodeLoad.useDeviationThresholds: expected a bool")
+        lnl = lnl.replace(
+            use_deviation=jnp.asarray(args["useDeviationThresholds"]))
+    if "anomalyCondition" in args:
+        cond = args["anomalyCondition"]
+        _check_keys(cond, {"consecutiveAbnormalities"},
+                    "LowNodeLoad.anomalyCondition")
+        rounds = cond.get("consecutiveAbnormalities", 3)
+        if not isinstance(rounds, int) or isinstance(rounds, bool) \
+                or rounds < 1:
+            raise ComponentConfigError(
+                "LowNodeLoad.anomalyCondition.consecutiveAbnormalities: "
+                f"expected a positive integer, got {rounds!r}")
+        lnl = lnl.replace(anomaly_rounds=jnp.int32(rounds))
+    out.lownodeload = lnl
+
+
+def _apply_migration(out: DeschedulerComponentConfig, args: dict) -> None:
+    _check_keys(args, {"maxMigratingPerNode", "maxMigratingPerNamespace",
+                       "maxMigratingPerWorkload",
+                       "maxUnavailablePerWorkload"}, "MigrationController")
+    limits = out.migration_limits
+    if "maxMigratingPerNode" in args:
+        limits = dataclasses.replace(
+            limits, max_migrating_per_node=_positive_int(
+                args["maxMigratingPerNode"],
+                "MigrationController.maxMigratingPerNode"))
+    if "maxMigratingPerNamespace" in args:
+        limits = dataclasses.replace(
+            limits, max_migrating_per_namespace=_positive_int(
+                args["maxMigratingPerNamespace"],
+                "MigrationController.maxMigratingPerNamespace"))
+    if "maxMigratingPerWorkload" in args:
+        limits = dataclasses.replace(
+            limits, max_migrating_per_workload=_int_or_percent(
+                args["maxMigratingPerWorkload"],
+                "MigrationController.maxMigratingPerWorkload"))
+    if "maxUnavailablePerWorkload" in args:
+        limits = dataclasses.replace(
+            limits, max_unavailable_per_workload=_int_or_percent(
+                args["maxUnavailablePerWorkload"],
+                "MigrationController.maxUnavailablePerWorkload"))
+    out.migration_limits = limits
+
+
+def _apply_evictor(out: DeschedulerComponentConfig, args: dict) -> None:
+    _check_keys(args, {"priorityThreshold", "evictSystemCriticalPods",
+                       "evictLocalStoragePods",
+                       "maxNoOfPodsToEvictPerNode"}, "DefaultEvictor")
+    if "priorityThreshold" in args:
+        value = args["priorityThreshold"]
+        if not isinstance(value, int) or isinstance(value, bool):
+            raise ComponentConfigError(
+                "DefaultEvictor.priorityThreshold: expected an integer")
+        out.priority_threshold = value
+    for key, attr in (("evictSystemCriticalPods", "evict_system_critical"),
+                      ("evictLocalStoragePods", "evict_local_storage_pods")):
+        if key in args:
+            if not isinstance(args[key], bool):
+                raise ComponentConfigError(
+                    f"DefaultEvictor.{key}: expected a bool")
+            setattr(out, attr, args[key])
+    if "maxNoOfPodsToEvictPerNode" in args:
+        out.max_evictions_per_round = _positive_int(
+            args["maxNoOfPodsToEvictPerNode"],
+            "DefaultEvictor.maxNoOfPodsToEvictPerNode")
+
+
+def load_descheduler_config(path: str,
+                            profile_name: str = "koord-descheduler",
+                            ) -> DeschedulerComponentConfig:
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    if not isinstance(doc, dict):
+        raise ComponentConfigError(f"{path}: not a config document")
+    kind = doc.get("kind", "DeschedulerConfiguration")
+    if kind != "DeschedulerConfiguration":
+        raise ComponentConfigError(f"{path}: unexpected kind {kind!r}")
+
+    profile = None
+    for p in doc.get("profiles") or []:
+        if p.get("name", "koord-descheduler") == profile_name:
+            profile = p
+            break
+    if profile is None:
+        raise ComponentConfigError(f"{path}: no profile {profile_name!r}")
+
+    out = DeschedulerComponentConfig()
+    plugins = profile.get("plugins") or {}
+    _check_keys(plugins, {"deschedule", "balance"}, "plugins")
+    for point, attr in (("deschedule", "deschedule_enabled"),
+                        ("balance", "balance_enabled")):
+        spec = plugins.get(point) or {}
+        _check_keys(spec, {"enabled"}, f"plugins.{point}")
+        names = spec.get("enabled") or []
+        if not isinstance(names, list) or not all(
+                isinstance(n, str) for n in names):
+            raise ComponentConfigError(
+                f"plugins.{point}.enabled: expected a list of names")
+        setattr(out, attr, names)
+
+    appliers = {
+        "LowNodeLoad": _apply_lownodeload,
+        "MigrationController": _apply_migration,
+        "DefaultEvictor": _apply_evictor,
+    }
+    for entry in profile.get("pluginConfig") or []:
+        name = entry.get("name")
+        args = entry.get("args") or {}
+        if name in appliers:
+            appliers[name](out, args)
+        elif name == "PodLifeTime":
+            _check_keys(args, {"maxPodLifeTimeSeconds"}, "PodLifeTime")
+            if "maxPodLifeTimeSeconds" in args:
+                out.pod_lifetime_max_seconds = _positive_number(
+                    args["maxPodLifeTimeSeconds"],
+                    "PodLifeTime.maxPodLifeTimeSeconds")
+        elif name == "RemovePodsHavingTooManyRestarts":
+            _check_keys(args, {"podRestartThreshold"},
+                        "RemovePodsHavingTooManyRestarts")
+            if "podRestartThreshold" in args:
+                out.pod_restart_threshold = _positive_int(
+                    args["podRestartThreshold"],
+                    "RemovePodsHavingTooManyRestarts.podRestartThreshold")
+        else:
+            raise ComponentConfigError(
+                f"{path}: unknown pluginConfig name {name!r} (supported: "
+                f"{sorted(appliers) + ['PodLifeTime', 'RemovePodsHavingTooManyRestarts']})")
+    return out

@@ -148,3 +148,121 @@ def test_binary_wires_config_file(tmp_path):
         assert sched.gangs["g"].wait_time_sec == 300.0
     finally:
         asm.stop()
+
+
+class TestDeschedulerConfig:
+    FULL = textwrap.dedent("""
+        kind: DeschedulerConfiguration
+        profiles:
+        - name: koord-descheduler
+          plugins:
+            deschedule:
+              enabled: [PodLifeTime, RemovePodsHavingTooManyRestarts]
+          pluginConfig:
+          - name: LowNodeLoad
+            args:
+              lowThresholds: {cpu: 40, memory: 50}
+              highThresholds: {cpu: 70, memory: 85}
+              useDeviationThresholds: true
+              anomalyCondition: {consecutiveAbnormalities: 5}
+          - name: PodLifeTime
+            args: {maxPodLifeTimeSeconds: 3600}
+          - name: RemovePodsHavingTooManyRestarts
+            args: {podRestartThreshold: 7}
+          - name: MigrationController
+            args:
+              maxMigratingPerNode: 4
+              maxMigratingPerWorkload: "10%"
+          - name: DefaultEvictor
+            args:
+              priorityThreshold: 8000
+              evictLocalStoragePods: true
+              maxNoOfPodsToEvictPerNode: 5
+    """)
+
+    def test_full_profile(self, tmp_path):
+        from koordinator_tpu.cmd.descheduler_config import (
+            load_descheduler_config,
+        )
+
+        path = tmp_path / "desched.yaml"
+        path.write_text(self.FULL)
+        out = load_descheduler_config(str(path))
+        low = np.asarray(out.lownodeload.low_thresholds)
+        high = np.asarray(out.lownodeload.high_thresholds)
+        assert low[ResourceDim.CPU] == 40 and high[ResourceDim.MEMORY] == 85
+        # unconfigured resources stay unchecked (-1), not defaulted
+        assert low[ResourceDim.GPU] == -1
+        assert bool(out.lownodeload.use_deviation)
+        assert int(out.lownodeload.anomaly_rounds) == 5
+        assert out.pod_lifetime_max_seconds == 3600
+        assert out.pod_restart_threshold == 7
+        assert out.migration_limits.max_migrating_per_node == 4
+        assert out.migration_limits.max_migrating_per_workload == "10%"
+        assert out.priority_threshold == 8000
+        assert out.evict_local_storage_pods is True
+        assert out.max_evictions_per_round == 5
+        assert out.deschedule_enabled == [
+            "PodLifeTime", "RemovePodsHavingTooManyRestarts"]
+
+    def test_binary_wires_descheduler_config(self, tmp_path):
+        from koordinator_tpu.cmd.binaries import main_koord_descheduler
+        from koordinator_tpu.descheduler.framework import PodInfo
+
+        path = tmp_path / "desched.yaml"
+        path.write_text(self.FULL)
+        pods = [PodInfo(uid="old", name="old", namespace="d", node="n1",
+                        created=0.0)]
+        asm = main_koord_descheduler(
+            ["--config", str(path), "--disable-leader-election",
+             "--descheduling-interval-seconds", "0"],
+            pods_fn=lambda: pods)
+        profile = asm.component.profiles[0]
+        # config-enabled plugins assembled with config args
+        names = {type(p).__name__ for p in profile.deschedule_plugins}
+        assert "PodLifeTime" in names
+        assert profile.evictor_filter.priority_threshold == 8000
+        assert profile.max_evictions_per_round == 5
+        # PodLifeTime got the 3600s limit: the ancient pod is descheduled
+        assert asm.component.run_once()["default"] >= 1
+
+    def test_cli_flag_overrides_config(self, tmp_path):
+        from koordinator_tpu.cmd.binaries import main_koord_descheduler
+
+        path = tmp_path / "desched.yaml"
+        path.write_text(self.FULL)
+        asm = main_koord_descheduler(
+            ["--config", str(path), "--priority-threshold", "100",
+             "--disable-leader-election"])
+        assert asm.component.profiles[0].evictor_filter \
+                  .priority_threshold == 100
+
+    def test_validation_is_loud(self, tmp_path):
+        from koordinator_tpu.cmd.component_config import (
+            ComponentConfigError,
+        )
+        from koordinator_tpu.cmd.descheduler_config import (
+            load_descheduler_config,
+        )
+
+        path = tmp_path / "bad.yaml"
+        path.write_text(textwrap.dedent("""
+            kind: DeschedulerConfiguration
+            profiles:
+            - name: koord-descheduler
+              pluginConfig:
+              - name: LowNodeLoad
+                args: {lowThresholds: {cpu: 400}}
+        """))
+        with pytest.raises(ComponentConfigError, match="outside"):
+            load_descheduler_config(str(path))
+        path.write_text(textwrap.dedent("""
+            kind: DeschedulerConfiguration
+            profiles:
+            - name: koord-descheduler
+              pluginConfig:
+              - name: MigrationController
+                args: {maxMigratingPerWorkload: "150%"}
+        """))
+        with pytest.raises(ComponentConfigError, match="outside"):
+            load_descheduler_config(str(path))
