@@ -281,6 +281,12 @@ def build_manager_parser() -> argparse.ArgumentParser:
     parser.add_argument("--sync-period", type=float, default=0.0)
     parser.add_argument("--config-namespace", default="koordinator-system")
     parser.add_argument("--slo-config-name", default="slo-controller-config")
+    parser.add_argument(
+        "--sloconfig-file", default="",
+        help="bootstrap the slo-controller-config ConfigMap DATA from a "
+             "YAML file (same keys: colocation-config, "
+             "resource-threshold-config, ...) until the watched CM "
+             "arrives; rejected loudly when invalid")
     return parser
 
 
@@ -310,10 +316,24 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
 
     args = build_manager_parser().parse_args(argv)
     apply_feature_gates(args.feature_gates, SCHEDULER_GATES)
+    from koordinator_tpu.manager import sloconfig
+
+    config_data: dict[str, str] = {}
+    colocation = None
+    if args.sloconfig_file:
+        try:
+            config_data = sloconfig.load_config_file(args.sloconfig_file)
+        except ValueError as e:
+            raise SystemExit(str(e)) from e
+        # only override the controller's enable-by-default colocation
+        # config when the file actually carries that key — bootstrapping
+        # an unrelated key must not silently disable colocation
+        if sloconfig.KEY_COLOCATION in config_data:
+            colocation = sloconfig.parse_colocation_config(config_data)
     component = types.SimpleNamespace(
         nodemetric=NodeMetricController(),
-        nodeslo=NodeSLOController(),
-        noderesource=NodeResourceController(),
+        nodeslo=NodeSLOController(config_data=config_data or None),
+        noderesource=NodeResourceController(config=colocation),
         pod_mutating=PodMutatingWebhook(),
         pod_validating=PodValidatingWebhook(),
         node_mutating=NodeMutatingWebhook(),
@@ -331,6 +351,22 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
                              if SCHEDULER_GATES.enabled("MultiQuotaTree")
                              else None),
     )
+
+    def update_sloconfig(new_data) -> list[str]:
+        """The watched-CM seam: when the live slo-controller-config CM
+        changes, the deployment shell calls this — NodeSLOs re-render
+        and the colocation math follows, so a --sloconfig-file bootstrap
+        really is only 'until the watched CM arrives'."""
+        errors = sloconfig.validate_config_data(new_data)
+        if errors:
+            return []   # the reference keeps the last good config
+        changed = component.nodeslo.update_config(new_data)
+        if sloconfig.KEY_COLOCATION in new_data:
+            component.noderesource.config = (
+                sloconfig.parse_colocation_config(new_data))
+        return changed
+
+    component.update_sloconfig = update_sloconfig
     return Assembled(name="koord-manager", args=args, component=component,
                      elector=build_elector(args, lease_store))
 

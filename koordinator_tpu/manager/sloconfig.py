@@ -183,3 +183,28 @@ def _iter_percents(obj: dict, prefix: str = ""):
             yield from _iter_percents(v, name + ".")
         elif isinstance(v, (int, float)) and k.endswith("Percent"):
             yield name, v
+
+
+def load_config_file(path: str) -> dict[str, str]:
+    """Read a YAML file shaped like the slo-controller-config ConfigMap's
+    DATA (keys: colocation-config, resource-threshold-config, ...; values
+    either JSON strings, as in a real CM, or nested YAML objects, which
+    serialize the same way) — the koord-manager --sloconfig-file
+    bootstrap seam.  Raises ValueError on a non-mapping document or any
+    validation error; the caller decides how loud to die."""
+    import yaml
+
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    if not isinstance(raw, dict):
+        raise ValueError(f"{path}: expected a mapping of ConfigMap "
+                         f"data keys")
+    config_data = {
+        key: (value if isinstance(value, str) else json.dumps(value))
+        for key, value in raw.items()
+    }
+    errors = validate_config_data(config_data)
+    if errors:
+        raise ValueError(f"{path}: invalid slo config: "
+                         + "; ".join(errors))
+    return config_data

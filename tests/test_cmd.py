@@ -331,3 +331,68 @@ def test_stop_releases_leadership_for_fast_failover():
     assert b.elector.tick() is False
     a.stop()   # clean shutdown releases the lease (ReleaseOnCancel)
     assert b.elector.tick() is True, "follower should acquire immediately"
+
+
+def test_manager_sloconfig_bootstrap_file(tmp_path):
+    import textwrap
+
+    path = tmp_path / "slo.yaml"
+    path.write_text(textwrap.dedent("""
+        colocation-config:
+          enable: true
+          cpuReclaimThresholdPercent: 55
+        resource-threshold-config:
+          enable: true
+          cpuSuppressThresholdPercent: 60
+    """))
+    out = main_koord_manager(["--sloconfig-file", str(path),
+                              "--disable-leader-election"])
+    assert out.component.noderesource.config.enable is True
+    assert out.component.noderesource.config \
+              .cpu_reclaim_threshold_percent == 55
+    # the NodeSLO controller renders the bootstrapped strategy
+    out.component.nodeslo.upsert_node("n1", {})
+    slo = out.component.nodeslo.get("n1")
+    assert slo.resource_used_threshold_with_be \
+              .cpu_suppress_threshold_percent == 60
+
+
+def test_manager_sloconfig_bootstrap_rejects_invalid(tmp_path):
+    path = tmp_path / "slo.yaml"
+    path.write_text("colocation-config:\n  cpuReclaimThresholdPercent: 300\n")
+    with pytest.raises(SystemExit, match="invalid slo config"):
+        main_koord_manager(["--sloconfig-file", str(path),
+                            "--disable-leader-election"])
+
+
+def test_manager_watched_cm_supersedes_bootstrap(tmp_path):
+    import json
+    import textwrap
+
+    path = tmp_path / "slo.yaml"
+    path.write_text(textwrap.dedent("""
+        colocation-config:
+          enable: true
+          cpuReclaimThresholdPercent: 55
+    """))
+    out = main_koord_manager(["--sloconfig-file", str(path),
+                              "--disable-leader-election"])
+    assert out.component.noderesource.config \
+              .cpu_reclaim_threshold_percent == 55
+    # live CM update: colocation math follows, bad updates keep last good
+    out.component.update_sloconfig({"colocation-config": json.dumps(
+        {"enable": True, "cpuReclaimThresholdPercent": 70})})
+    assert out.component.noderesource.config \
+              .cpu_reclaim_threshold_percent == 70
+    out.component.update_sloconfig({"colocation-config": json.dumps(
+        {"cpuReclaimThresholdPercent": 300})})
+    assert out.component.noderesource.config \
+              .cpu_reclaim_threshold_percent == 70
+
+
+def test_manager_bootstrap_without_colocation_keeps_enable_default(tmp_path):
+    path = tmp_path / "slo.yaml"
+    path.write_text("resource-threshold-config:\n  enable: true\n")
+    out = main_koord_manager(["--sloconfig-file", str(path),
+                              "--disable-leader-election"])
+    assert out.component.noderesource.config.enable is True
