@@ -231,3 +231,103 @@ def test_metrics_registry_concurrent_inc(rounds):
     hammer(worker)
     total = sum(c.value(labels={"w": str(j)}) for j in (0, 1))
     assert total == N_THREADS * N_OPS * rounds   # no lost increments
+
+
+class TestSidecarPushSolveStress:
+    """The sidecar assembly under contention: concurrent STATE_PUSH
+    writers, solve callers, and HELLO bootstrappers against one
+    scheduler-binary sidecar.  Exercises the commit->binding-queue drain
+    (rv order without holding the service lock) and the scheduler lock
+    under real thread interleaving; the end state must be exactly the
+    pushed universe."""
+
+    def test_concurrent_push_solve_hello(self, tmp_path):
+        import numpy as np
+
+        from koordinator_tpu.api.resources import resource_vector
+        from koordinator_tpu.cmd.binaries import main_koord_scheduler
+        from koordinator_tpu.transport import RpcClient
+        from koordinator_tpu.transport.services import solve_remote
+        from koordinator_tpu.transport.wire import (
+            PROTOCOL_VERSION,
+            FrameType,
+        )
+
+        asm = main_koord_scheduler([
+            "--node-capacity", "64",
+            "--listen-socket", str(tmp_path / "stress.sock"),
+            "--disable-leader-election",
+        ])
+        n_writers, nodes_per_writer = 4, 8
+        errors: list = []
+        clients: list = []
+
+        def client():
+            c = RpcClient(asm.server.path, timeout=30.0)
+            c.connect()
+            clients.append(c)
+            return c
+
+        def push_nodes(w):
+            try:
+                c = client()
+                for i in range(nodes_per_writer):
+                    c.call(FrameType.STATE_PUSH,
+                           {"kind": "node_upsert",
+                            "name": f"w{w}-n{i}"},
+                           {"allocatable": np.asarray(resource_vector(
+                               cpu=16_000, memory=32_768), np.int32)})
+                    c.call(FrameType.STATE_PUSH,
+                           {"kind": "pod_add", "name": f"w{w}-p{i}"},
+                           {"requests": np.asarray(resource_vector(
+                               cpu=1_000, memory=1_024), np.int32)})
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def solver():
+            try:
+                c = client()
+                for _ in range(6):
+                    solve_remote(c)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def hello_storm():
+            try:
+                for _ in range(10):
+                    c = RpcClient(asm.server.path, timeout=30.0)
+                    c.connect()
+                    c.call(FrameType.HELLO,
+                           {"last_rv": -1, "proto": PROTOCOL_VERSION})
+                    c.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        try:
+            threads = (
+                [threading.Thread(target=push_nodes, args=(w,))
+                 for w in range(n_writers)]
+                + [threading.Thread(target=solver) for _ in range(2)]
+                + [threading.Thread(target=hello_storm)]
+            )
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+                assert not t.is_alive(), "stress thread wedged"
+            assert not errors, errors[:3]
+
+            # the service holds exactly the pushed universe, rv exact
+            service = asm.state_sync
+            assert service.rv == n_writers * nodes_per_writer * 2
+            assert len(service.nodes) == n_writers * nodes_per_writer
+            # and the binding applied everything: a final solve places
+            # every remaining pod (capacity is ample)
+            solve_remote(client())
+            sched = asm.component
+            assert not sched.pending, (
+                f"{len(sched.pending)} pods never applied/solved")
+        finally:
+            for c in clients:
+                c.close()
+            asm.stop()
