@@ -78,16 +78,35 @@ def build_koordlet_parser() -> argparse.ArgumentParser:
         help="serve the runtimehooks plugins to a runtime proxy over this "
              "address (unix path or tcp://host:port) — the nri/server.go "
              "/ proxyserver seam; empty disables")
+    parser.add_argument(
+        "--kubelet-addr", default="",
+        help="poll this kubelet's /pods as the pod informer "
+             "(states_pods.go); empty keeps the shell-fed informer")
+    parser.add_argument("--kubelet-port", type=int, default=10250)
+    parser.add_argument("--kubelet-scheme", default="https",
+                        choices=("https", "http"))
+    parser.add_argument("--kubelet-token-file", default="")
+    parser.add_argument("--kubelet-ca-file", default="")
+    parser.add_argument("--kubelet-insecure-skip-verify",
+                        action="store_true")
+    parser.add_argument("--kubelet-timeout-seconds", type=float,
+                        default=5.0)
+    parser.add_argument("--informer-sync-interval-seconds", type=float,
+                        default=30.0)
     return parser
 
 
 def main_koordlet(argv: list[str], device_report_fn=None,
-                  pod_resources_upstream_fn=None) -> Assembled:
+                  pod_resources_upstream_fn=None,
+                  node_info_fn=None) -> Assembled:
     """``device_report_fn(Device)`` is the deployment shell's Device-CR
     sink (apiserver client / StateSyncService.upsert_node devices=...);
     None disables the in-agent reporting tick.
     ``pod_resources_upstream_fn()`` is the kubelet pod-resources stub the
-    PodResourcesProxy enriches; None serves koord allocations only."""
+    PodResourcesProxy enriches; None serves koord allocations only.
+    ``node_info_fn() -> NodeInfo`` is the shell's Node watch (the
+    states_node informer); it registers as the 'node' informer the
+    kubelet pods informer depends on."""
     from koordinator_tpu.features import KOORDLET_GATES
     from koordinator_tpu.koordlet.daemon import Daemon
     from koordinator_tpu.koordlet.system.config import SystemConfig
@@ -103,7 +122,37 @@ def main_koordlet(argv: list[str], device_report_fn=None,
     )
     daemon = Daemon(cfg=cfg, audit_dir=args.audit_log_dir or None,
                     device_report_fn=device_report_fn,
-                    pod_resources_upstream_fn=pod_resources_upstream_fn)
+                    pod_resources_upstream_fn=pod_resources_upstream_fn,
+                    informer_sync_interval_seconds=(
+                        args.informer_sync_interval_seconds))
+    if node_info_fn is not None:
+        from koordinator_tpu.koordlet.statesinformer import CallbackInformer
+
+        daemon.informers.register(CallbackInformer(
+            "node", lambda states: states.set_node(node_info_fn())))
+    if args.kubelet_addr:
+        from koordinator_tpu.koordlet.kubelet_stub import KubeletStub
+        from koordinator_tpu.koordlet.statesinformer import (
+            CallbackInformer,
+            KubeletPodsInformer,
+        )
+
+        stub = KubeletStub.connect(
+            args.kubelet_addr, args.kubelet_port,
+            scheme=args.kubelet_scheme,
+            token_file=args.kubelet_token_file or None,
+            ca_file=args.kubelet_ca_file or None,
+            insecure_skip_verify=args.kubelet_insecure_skip_verify,
+            timeout=args.kubelet_timeout_seconds,
+        )
+        if node_info_fn is None:
+            # the pods informer depends on 'node'; without a shell Node
+            # watch, a no-op placeholder satisfies the ordering (the
+            # agent's node identity then comes from set_node callers)
+            daemon.informers.register(CallbackInformer(
+                "node", lambda states: None))
+        daemon.informers.register(KubeletPodsInformer(stub))
+        daemon.kubelet_stub = stub
     if args.http_port is not None:
         from koordinator_tpu.transport.http_gateway import HttpGateway
 

@@ -49,6 +49,7 @@ class Daemon:
         device_report_fn: Optional[Callable] = None,
         device_report_interval_seconds: float = 60.0,
         pod_resources_upstream_fn: Optional[Callable] = None,
+        informer_sync_interval_seconds: float = 30.0,
     ):
         from koordinator_tpu.features import KOORDLET_GATES
 
@@ -142,6 +143,22 @@ class Daemon:
         self.states.register_callback(
             "node-slo", lambda slo: self._mark_dirty()
         )
+        #: informer plugins (states_*.go sources: kubelet pods, shell
+        #: callbacks); tick TRIGGERS a sync round on this cadence but the
+        #: round runs on its own thread — a hung kubelet fetch must never
+        #: stall the 1s QoS enforcement loop (the reference runs informer
+        #: loops off the enforcement path too).  A fully-failed round
+        #: does not stamp the cadence, so recovery retries on the next
+        #: tick (bounded by the single in-flight round + fetch timeout).
+        from koordinator_tpu.koordlet.statesinformer import InformerRegistry
+
+        self.informers = InformerRegistry()
+        self.informer_sync_interval_seconds = informer_sync_interval_seconds
+        self._last_informer_sync = float("-inf")
+        self._informer_inflight = threading.Event()
+        #: kubelet client behind the pods informer (--kubelet-addr);
+        #: None when the shell feeds pods directly
+        self.kubelet_stub = None
         self._stop = threading.Event()
 
     def _on_pleg_event(self, event) -> None:
@@ -151,8 +168,28 @@ class Daemon:
         self._pleg_dirty = True
 
     def tick(self) -> dict:
-        """One agent step: collect -> enforce -> reconcile on churn/SLO
-        change/interval."""
+        """One agent step: sync informers -> collect -> enforce ->
+        reconcile on churn/SLO change/interval."""
+        now0 = self.clock()
+        if (len(self.informers)
+                and not self._informer_inflight.is_set()
+                and now0 - self._last_informer_sync
+                >= self.informer_sync_interval_seconds):
+            self._informer_inflight.set()
+
+            def sync_round(stamp=now0):
+                try:
+                    self.informers.sync_all(self.states)
+                    # only a fully-clean round rests for the interval: a
+                    # failing plugin (kubelet briefly down) keeps
+                    # retrying every tick, bounded by the single
+                    # in-flight round + the fetch timeout
+                    if not self.informers.sync_errors:
+                        self._last_informer_sync = stamp
+                finally:
+                    self._informer_inflight.clear()
+
+            threading.Thread(target=sync_round, daemon=True).start()
         collected = self.advisor.collect_once()
         strategies = self.qos_manager.tick()
         if not self._pleg_watch_armed:

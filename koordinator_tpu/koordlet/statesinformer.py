@@ -238,6 +238,9 @@ class InformerRegistry:
             raise ValueError(f"informer {plugin.name!r} already registered")
         self._plugins[plugin.name] = plugin
 
+    def __len__(self) -> int:
+        return len(self._plugins)
+
     def ordered(self) -> list[InformerPlugin]:
         """Dependency order (states_informer.go starts in listed order with
         HasSynced gates; this is the same constraint as a topo sort)."""
@@ -297,6 +300,20 @@ class InformerRegistry:
             except Exception as e:
                 self.sync_errors[plugin.name] = repr(e)
         return ok
+
+
+class CallbackInformer(InformerPlugin):
+    """Adapter: any shell-provided fetch callable as an informer plugin
+    (the states_node/states_device informers are apiserver watches in the
+    reference; the deployment shell owns that transport here)."""
+
+    def __init__(self, name: str, sync_fn, depends: tuple[str, ...] = ()):
+        self.name = name
+        self.depends = depends
+        self._sync_fn = sync_fn
+
+    def sync(self, states: "StatesInformer") -> None:
+        self._sync_fn(states)
 
 
 class KubeletPodsInformer(InformerPlugin):
