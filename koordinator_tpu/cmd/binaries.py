@@ -93,6 +93,14 @@ def build_koordlet_parser() -> argparse.ArgumentParser:
                         default=5.0)
     parser.add_argument("--informer-sync-interval-seconds", type=float,
                         default=30.0)
+    parser.add_argument(
+        "--scheduler-sidecar-addr", default="",
+        help="push this node's NodeMetric usage to a solver sidecar "
+             "over STATE_PUSH node_usage frames (the states_nodemetric "
+             "report loop's wire form); requires --node-name")
+    parser.add_argument("--node-name", default="")
+    parser.add_argument("--nodemetric-report-interval-seconds", type=float,
+                        default=60.0)
     return parser
 
 
@@ -153,6 +161,89 @@ def main_koordlet(argv: list[str], device_report_fn=None,
                 "node", lambda states: None))
         daemon.informers.register(KubeletPodsInformer(stub))
         daemon.kubelet_stub = stub
+    if args.scheduler_sidecar_addr:
+        if not args.node_name:
+            raise SystemExit(
+                "--scheduler-sidecar-addr requires --node-name (the "
+                "node_usage event is keyed by node)")
+        import numpy as _np
+
+        from koordinator_tpu.api.resources import resource_vector
+        from koordinator_tpu.koordlet.statesinformer import (
+            NodeMetricReporter,
+        )
+        from koordinator_tpu.transport import RpcClient
+        from koordinator_tpu.transport.channel import RpcError
+        from koordinator_tpu.transport.wire import FrameType
+
+        class SidecarClient:
+            """Lazy + reconnecting: the koordlet must not impose boot
+            order on the sidecar (connect on first use, reconnect after
+            a drop); a failed call surfaces to the reporter, which
+            counts it (report_failures) and retries next interval."""
+
+            def __init__(self, addr: str):
+                self.addr = addr
+                self._client = None
+
+            def call(self, *call_args, **call_kwargs):
+                if self._client is None or not self._client.connected:
+                    self.close()
+                    client = RpcClient(self.addr, timeout=10.0)
+                    try:
+                        client.connect()
+                    except OSError as e:
+                        raise RpcError(
+                            f"sidecar unreachable: {e}") from e
+                    self._client = client
+                try:
+                    return self._client.call(*call_args, **call_kwargs)
+                except RpcError:
+                    self.close()   # next report reconnects
+                    raise
+
+            def close(self) -> None:
+                if self._client is not None:
+                    self._client.close()
+                    self._client = None
+
+        sidecar = SidecarClient(args.scheduler_sidecar_addr)
+        daemon.sidecar_client = sidecar
+
+        def push_usage(status) -> None:
+            # a degraded report (collectors silent) must not zero the
+            # sidecar's view — skip and let the last usage stand
+            if getattr(status, "degraded", False):
+                return
+            usage = resource_vector({
+                "cpu": status.node_usage.cpu_milli,
+                "memory": status.node_usage.memory_bytes >> 20,  # MiB
+            })
+            agg = None
+            aggregated = status.aggregated_node_usage
+            if aggregated is not None and aggregated.cpu_milli_p:
+                # p95 percentile feeds the aggregated-threshold filter
+                # (loadaware Aggregated args); fall back to the highest
+                # recorded percentile
+                pct = 0.95 if 0.95 in aggregated.cpu_milli_p else max(
+                    aggregated.cpu_milli_p)
+                agg = resource_vector({
+                    "cpu": aggregated.cpu_milli_p[pct],
+                    "memory": aggregated.memory_bytes_p.get(pct, 0) >> 20,
+                })
+            arrays = {"usage": _np.asarray(usage, _np.int32)}
+            if agg is not None:
+                arrays["agg_usage"] = _np.asarray(agg, _np.int32)
+            sidecar.call(FrameType.STATE_PUSH,
+                         {"kind": "node_usage", "name": args.node_name},
+                         arrays)
+
+        daemon.reporters.append(NodeMetricReporter(
+            daemon.states, push_usage,
+            report_interval_seconds=(
+                args.nodemetric_report_interval_seconds),
+            clock=daemon.clock,
+        ))
     if args.http_port is not None:
         from koordinator_tpu.transport.http_gateway import HttpGateway
 

@@ -159,6 +159,12 @@ class Daemon:
         #: kubelet client behind the pods informer (--kubelet-addr);
         #: None when the shell feeds pods directly
         self.kubelet_stub = None
+        #: tick-driven reporters (NodeMetricReporter et al) — each owns
+        #: its own cadence; tick just gives them the heartbeat
+        self.reporters: list = []
+        self._reporters_inflight = threading.Event()
+        #: RpcClient to a solver sidecar (--scheduler-sidecar-addr)
+        self.sidecar_client = None
         self._stop = threading.Event()
 
     def _on_pleg_event(self, event) -> None:
@@ -191,6 +197,24 @@ class Daemon:
 
             threading.Thread(target=sync_round, daemon=True).start()
         collected = self.advisor.collect_once()
+        # reporters AFTER collection (a due report ships this tick's
+        # samples) and OFF the enforcement thread (a wedged sidecar
+        # socket blocks its push up to the RPC timeout); failures are
+        # counted by each reporter (report_failures), never raised
+        if self.reporters and not self._reporters_inflight.is_set():
+            self._reporters_inflight.set()
+
+            def reporter_round():
+                try:
+                    for reporter in self.reporters:
+                        try:
+                            reporter.tick()
+                        except Exception:  # noqa: BLE001
+                            pass
+                finally:
+                    self._reporters_inflight.clear()
+
+            threading.Thread(target=reporter_round, daemon=True).start()
         strategies = self.qos_manager.tick()
         if not self._pleg_watch_armed:
             self._pleg_watch_armed = self.pleg.start_watch()
@@ -241,3 +265,6 @@ class Daemon:
         if self.hook_server is not None:
             self.hook_server.stop()
             self.hook_server = None
+        if self.sidecar_client is not None:
+            self.sidecar_client.close()
+            self.sidecar_client = None

@@ -354,6 +354,11 @@ class NodeMetricReporter:
         self._last_report = float("-inf")   # first tick reports immediately
         self.reports = 0
         self.degraded_reports = 0
+        #: report_fn raised (e.g. the sidecar push failed): the report
+        #: interval still rests (retry next interval, not next tick) but
+        #: the failure is COUNTED — a swallowed push error must be
+        #: visible somewhere
+        self.report_failures = 0
 
     def update_spec(self, report_interval_seconds: float,
                     aggregate_window_seconds: float) -> None:
@@ -377,11 +382,21 @@ class NodeMetricReporter:
             from koordinator_tpu.api.crds import NodeMetricStatus
 
             status = NodeMetricStatus(update_time=now, degraded=True)
-            self.degraded_reports += 1
+            degraded = True
         else:
             status = self.states.build_node_metric(
                 window_seconds=self.aggregate_window_seconds, now=now)
+            degraded = False
+        try:
+            self.report_fn(status)
+        except Exception:  # noqa: BLE001 — the transport's failure, not
+            # the reporter's; the interval rests (no hammering a down
+            # sidecar) and the next interval retries
+            self.report_failures += 1
+            return None
+        if degraded:
+            self.degraded_reports += 1
+        else:
             self.reports += 1
-        self.report_fn(status)
         self.states._fire(TYPE_NODE_METRIC, status)
         return status

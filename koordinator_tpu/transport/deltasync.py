@@ -33,6 +33,7 @@ from koordinator_tpu.transport import wire
 from koordinator_tpu.transport.wire import FrameType
 
 NODE_UPSERT = "node_upsert"
+NODE_USAGE = "node_usage"
 NODE_REMOVE = "node_remove"
 POD_ADD = "pod_add"
 POD_REMOVE = "pod_remove"
@@ -191,6 +192,31 @@ class StateSyncService:
         with self._lock:
             self.nodes[name] = {"doc": doc, "arrays": arrays}
         return self._commit(doc, arrays)
+
+    def update_node_usage(self, name: str, usage: np.ndarray,
+                          agg_usage: np.ndarray | None = None,
+                          prod_usage: np.ndarray | None = None) -> int:
+        """The NodeMetric loop's wire form (SURVEY §3.2): refresh a
+        node's USAGE without re-sending allocatable — what a koordlet's
+        reporter knows.  The stored node entry merges the new usage so a
+        later bootstrap snapshot carries it; live watchers get the
+        NODE_USAGE delta.  Unknown node -> WireSchemaError (nothing
+        enters the log: usage for a node nobody registered is a peer
+        bug, and replaying it would apply to nothing)."""
+        arrays: dict[str, np.ndarray] = {
+            "usage": np.asarray(usage, np.int32)}
+        if agg_usage is not None:
+            arrays["agg_usage"] = np.asarray(agg_usage, np.int32)
+        if prod_usage is not None:
+            arrays["prod_usage"] = np.asarray(prod_usage, np.int32)
+        with self._lock:
+            entry = self.nodes.get(name)
+            if entry is None:
+                raise wire.WireSchemaError(
+                    f"node_usage for unknown node {name!r}")
+            entry["arrays"] = dict(entry["arrays"], **arrays)
+        return self._commit(
+            {"kind": NODE_USAGE, "name": name}, arrays)
 
     def remove_node(self, name: str) -> int:
         with self._lock:
@@ -358,6 +384,15 @@ class StateSyncService:
                 labels=doc.get("labels"), taints=doc.get("taints"),
                 annotations=doc.get("annotations"),
                 devices=doc.get("devices"))
+        elif kind == NODE_USAGE:
+            require_vector("usage")
+            for optional in ("agg_usage", "prod_usage"):
+                if optional in arrays:
+                    require_vector(optional)
+            rv = self.update_node_usage(
+                name, arrays["usage"],
+                agg_usage=arrays.get("agg_usage"),
+                prod_usage=arrays.get("prod_usage"))
         elif kind == NODE_REMOVE:
             rv = self.remove_node(name)
         elif kind == POD_ADD:
@@ -520,6 +555,8 @@ def _dispatch_event(binding, entry: dict,
     kind = entry["kind"]
     if kind == NODE_UPSERT:
         binding.node_upsert(entry, arrs)
+    elif kind == NODE_USAGE:
+        binding.node_usage(entry, arrs)
     elif kind == NODE_REMOVE:
         binding.node_remove(entry["name"])
     elif kind == POD_ADD:
@@ -566,6 +603,12 @@ class SchedulerBinding:
                 name=entry["name"],
                 allocatable=np.asarray(arrs["allocatable"], np.int32),
                 usage=np.asarray(arrs["usage"], np.int32),
+                # merged node_usage refreshes ride the stored entry, so a
+                # bootstrap/resync replay must carry them too
+                agg_usage=(np.asarray(arrs["agg_usage"], np.int32)
+                           if "agg_usage" in arrs else None),
+                prod_usage=(np.asarray(arrs["prod_usage"], np.int32)
+                            if "prod_usage" in arrs else None),
                 labels=dict(entry.get("labels", {})),
                 taints=dict(entry.get("taints", {})),
             ))
@@ -586,6 +629,27 @@ class SchedulerBinding:
                     if isinstance(inventory, list):
                         self.scheduler.device_manager.register_node_devices(
                             dev_type, entry["name"], inventory)
+
+    def node_usage(self, entry: dict, arrs: dict[str, np.ndarray]) -> None:
+        """Usage-only refresh (the NodeMetric loop): keep the node's
+        allocatable/labels, swap its usage rows.  Unknown node: drop —
+        the delta may race a node_remove and usage for a gone node is
+        moot."""
+        import dataclasses as _dc
+
+        with self.scheduler.lock:
+            spec = self.scheduler.snapshot.node_specs.get(entry["name"])
+            if spec is None:
+                return
+            usage = np.asarray(arrs["usage"], np.int32)
+            self.scheduler.snapshot.upsert_node(_dc.replace(
+                spec,
+                usage=usage,
+                agg_usage=(np.asarray(arrs["agg_usage"], np.int32)
+                           if "agg_usage" in arrs else usage),
+                prod_usage=(np.asarray(arrs["prod_usage"], np.int32)
+                            if "prod_usage" in arrs else usage),
+            ))
 
     def node_remove(self, name: str) -> None:
         with self.scheduler.lock:

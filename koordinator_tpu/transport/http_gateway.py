@@ -186,7 +186,8 @@ class HttpGateway:
         if not isinstance(doc, dict):
             return req._reply(400, {"error": "body must be a JSON object"})
         arrays = {}
-        for key in ("allocatable", "usage", "requests"):
+        for key in ("allocatable", "usage", "agg_usage", "prod_usage",
+                    "requests"):
             if key in doc:
                 value = doc.pop(key)
                 if (not isinstance(value, list)

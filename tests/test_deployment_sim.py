@@ -159,3 +159,71 @@ def test_six_binaries_one_pod_flow(deployment):
     # --- 5. the descheduler binary runs a clean round over the cluster
     descheduler = assembled["descheduler"].component
     assert descheduler.run_once() == {"default": 0}
+
+
+def test_nodemetric_loop_over_the_wire(tmp_path):
+    """SURVEY §3.2's report loop in its wire form: the koordlet BINARY
+    measures the node and pushes node_usage frames to the scheduler
+    BINARY's sidecar, whose in-process binding refreshes the solver's
+    usage rows — no Python glue between the two beyond their CLIs."""
+    import os
+    import time
+
+    from koordinator_tpu.cmd.binaries import (
+        main_koord_scheduler,
+        main_koordlet,
+    )
+
+    sched_asm = main_koord_scheduler([
+        "--node-capacity", "8",
+        "--listen-socket", str(tmp_path / "sidecar.sock"),
+        "--disable-leader-election",
+    ])
+    cfg = make_test_config(tmp_path)
+    os.makedirs(cfg.proc_root, exist_ok=True)
+
+    def write_proc(total_jiffies):
+        with open(cfg.proc_path("stat"), "w") as f:
+            f.write(f"cpu  {total_jiffies} 0 0 1000 0 0 0 0 0 0\n")
+        with open(cfg.proc_path("meminfo"), "w") as f:
+            f.write("MemTotal: 16777216 kB\nMemAvailable: 8388608 kB\n"
+                    "Cached: 0 kB\nBuffers: 0 kB\nMemFree: 8388608 kB\n")
+
+    koordlet_asm = None
+    try:
+        # the sidecar must know the node before usage can attach to it
+        sched_asm.state_sync.upsert_node(
+            "n-metric", resource_vector(cpu=16_000, memory=16_384))
+
+        write_proc(0)
+        koordlet_asm = main_koordlet([
+            "--cgroup-root-dir", cfg.cgroup_root,
+            "--proc-root-dir", cfg.proc_root,
+            "--sys-root-dir", cfg.sys_root,
+            "--scheduler-sidecar-addr", str(tmp_path / "sidecar.sock"),
+            "--node-name", "n-metric",
+            "--nodemetric-report-interval-seconds", "0",
+        ])
+        daemon = koordlet_asm.component
+        daemon.tick()                      # first sample (no rate yet)
+        time.sleep(0.05)
+        write_proc(400)                    # ~cpu burn since last sample
+        # reporter rounds run off-thread; tick until the push lands
+        snapshot = sched_asm.component.snapshot
+        usage_cpu = 0
+        deadline = time.monotonic() + 20
+        while usage_cpu == 0 and time.monotonic() < deadline:
+            daemon.tick()
+            time.sleep(0.05)
+            snapshot.flush()
+            row = snapshot.node_index["n-metric"]
+            usage_cpu = int(np.asarray(
+                snapshot.state.node_usage)[row][0])
+        assert usage_cpu > 0, "pushed usage never reached the solver"
+        # and the sync service's stored node carries it for bootstrap
+        stored = sched_asm.state_sync.nodes["n-metric"]["arrays"]
+        assert int(np.asarray(stored["usage"])[0]) == usage_cpu
+    finally:
+        if koordlet_asm is not None:
+            koordlet_asm.component.stop()
+        sched_asm.stop()

@@ -295,8 +295,8 @@ class TestStatePushNoPartialCommit:
             st.text(max_size=8))
         docs = st.fixed_dictionaries(
             {"kind": st.sampled_from(
-                ["node_upsert", "pod_add", "pod_remove", "rsv_upsert",
-                 "rsv_remove", "bogus"]),
+                ["node_upsert", "node_usage", "pod_add", "pod_remove",
+                 "rsv_upsert", "rsv_remove", "bogus"]),
              "name": st.text(min_size=1, max_size=8)},
             optional={
                 "labels": json_scalars | st.dictionaries(
