@@ -183,29 +183,39 @@ def main_koordlet(argv: list[str], device_report_fn=None,
             counts it (report_failures) and retries next interval."""
 
             def __init__(self, addr: str):
+                import threading as _threading
+
                 self.addr = addr
                 self._client = None
+                #: usage and device reports push from different threads;
+                #: one connect/reconnect at a time
+                self._lock = _threading.Lock()
 
             def call(self, *call_args, **call_kwargs):
-                if self._client is None or not self._client.connected:
-                    self.close()
-                    client = RpcClient(self.addr, timeout=10.0)
+                with self._lock:
+                    if self._client is None or not self._client.connected:
+                        self._close_locked()
+                        client = RpcClient(self.addr, timeout=10.0)
+                        try:
+                            client.connect()
+                        except OSError as e:
+                            raise RpcError(
+                                f"sidecar unreachable: {e}") from e
+                        self._client = client
                     try:
-                        client.connect()
-                    except OSError as e:
-                        raise RpcError(
-                            f"sidecar unreachable: {e}") from e
-                    self._client = client
-                try:
-                    return self._client.call(*call_args, **call_kwargs)
-                except RpcError:
-                    self.close()   # next report reconnects
-                    raise
+                        return self._client.call(*call_args, **call_kwargs)
+                    except RpcError:
+                        self._close_locked()   # next report reconnects
+                        raise
 
-            def close(self) -> None:
+            def _close_locked(self) -> None:
                 if self._client is not None:
                     self._client.close()
                     self._client = None
+
+            def close(self) -> None:
+                with self._lock:
+                    self._close_locked()
 
         sidecar = SidecarClient(args.scheduler_sidecar_addr)
         daemon.sidecar_client = sidecar
@@ -244,6 +254,45 @@ def main_koordlet(argv: list[str], device_report_fn=None,
                 args.nodemetric_report_interval_seconds),
             clock=daemon.clock,
         ))
+
+        if device_report_fn is None:
+            # default Device-CR sink when a sidecar is wired: the
+            # inventory rides node_devices frames (device daemon report
+            # loop in wire form); shell-provided sinks still win
+            from koordinator_tpu.koordlet.devices import (
+                device_infos_to_inventory,
+            )
+
+            import threading as _threading
+
+            device_push_inflight = _threading.Event()
+
+            def push_devices(device) -> None:
+                inventory = device_infos_to_inventory(list(device.devices))
+                if not inventory:
+                    return
+                # one in-flight push: a wedged sidecar must not pile up
+                # threads (the next report interval retries)
+                if device_push_inflight.is_set():
+                    return
+                device_push_inflight.set()
+
+                def send() -> None:
+                    try:
+                        sidecar.call(
+                            FrameType.STATE_PUSH,
+                            {"kind": "node_devices",
+                             "name": device.node_name,
+                             "devices": inventory})
+                    except Exception:  # noqa: BLE001 — next report
+                        pass            # interval retries
+                    finally:
+                        device_push_inflight.clear()
+
+                # off the enforcement thread, like the usage reporter
+                _threading.Thread(target=send, daemon=True).start()
+
+            daemon.device_report_fn = push_devices
     if args.http_port is not None:
         from koordinator_tpu.transport.http_gateway import HttpGateway
 

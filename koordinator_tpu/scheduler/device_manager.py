@@ -91,6 +91,12 @@ class DeviceManager:
                     jnp.int32(a.core), jnp.int32(a.memory),
                 )
 
+    def registered_types_for(self, node: str) -> set[str]:
+        """Device types this node has inventory registered under — lets
+        a full-inventory refresh clear types that disappeared."""
+        return {dev_type for dev_type, raw in self._raw.items()
+                if node in raw}
+
     def state(self, device_type: str) -> DeviceState | None:
         return self._state.get(device_type)
 

@@ -279,9 +279,14 @@ class RpcClient:
         kind, target = _parse_addr(self.path)
         if kind == "unix":
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(self.timeout)
             sock.connect(target)
+            sock.settimeout(None)
         else:
-            sock = socket.create_connection(target)
+            # bound by the client timeout: a black-holed TCP target must
+            # fail in self.timeout, not the OS connect default (~2 min)
+            sock = socket.create_connection(target, timeout=self.timeout)
+            sock.settimeout(None)   # reader thread blocks indefinitely
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
         self.connected = True

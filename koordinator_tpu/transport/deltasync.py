@@ -34,6 +34,7 @@ from koordinator_tpu.transport.wire import FrameType
 
 NODE_UPSERT = "node_upsert"
 NODE_USAGE = "node_usage"
+NODE_DEVICES = "node_devices"
 NODE_REMOVE = "node_remove"
 POD_ADD = "pod_add"
 POD_REMOVE = "pod_remove"
@@ -144,14 +145,7 @@ class StateSyncService:
         stalled peer drops frames and gets poisoned, it cannot wedge the
         service (channel._Conn.send)."""
         with self._lock:
-            self.rv += 1
-            rv = self.rv
-            self.log.append(rv, event, arrays)
-            if self._server is not None:
-                doc, stacked = _pack_events([(rv, event, arrays)])
-                self._server.broadcast(FrameType.DELTA, doc, stacked)
-            if self._local_bindings:
-                self._binding_queue.append((event, arrays))
+            rv = self._commit_locked(event, arrays)
         # apply OUTSIDE the service lock: bindings block on the scheduler
         # lock (a long solve), and holding _lock through that would stall
         # every HELLO/push/broadcast behind it.  The queue was filled in
@@ -159,6 +153,23 @@ class StateSyncService:
         # that order even when two pushers race to drain.
         if self._local_bindings:
             self._drain_bindings()
+        return rv
+
+    def _commit_locked(self, event: dict,
+                       arrays: dict[str, np.ndarray]) -> int:
+        """The lock-held half of _commit, for mutations that must merge
+        stored state and log the event ATOMICALLY (update_node_usage /
+        update_node_devices: a racing pair must not leave the stored doc
+        and the delta-log tail disagreeing).  Caller holds _lock and
+        must call _drain_bindings() after releasing it."""
+        self.rv += 1
+        rv = self.rv
+        self.log.append(rv, event, arrays)
+        if self._server is not None:
+            doc, stacked = _pack_events([(rv, event, arrays)])
+            self._server.broadcast(FrameType.DELTA, doc, stacked)
+        if self._local_bindings:
+            self._binding_queue.append((event, arrays))
         return rv
 
     def _drain_bindings(self) -> None:
@@ -215,8 +226,30 @@ class StateSyncService:
                 raise wire.WireSchemaError(
                     f"node_usage for unknown node {name!r}")
             entry["arrays"] = dict(entry["arrays"], **arrays)
-        return self._commit(
-            {"kind": NODE_USAGE, "name": name}, arrays)
+            rv = self._commit_locked(
+                {"kind": NODE_USAGE, "name": name}, arrays)
+        if self._local_bindings:
+            self._drain_bindings()
+        return rv
+
+    def update_node_devices(self, name: str,
+                            devices: dict[str, list[dict]]) -> int:
+        """Device-CR refresh (the device daemon's report loop in wire
+        form): replace a node's device inventory without re-sending
+        allocatable.  Merges into the stored node doc so bootstrap
+        replay carries it; same unknown-node posture as node_usage."""
+        with self._lock:
+            entry = self.nodes.get(name)
+            if entry is None:
+                raise wire.WireSchemaError(
+                    f"node_devices for unknown node {name!r}")
+            entry["doc"] = dict(entry["doc"], devices=dict(devices))
+            rv = self._commit_locked(
+                {"kind": NODE_DEVICES, "name": name,
+                 "devices": dict(devices)}, {})
+        if self._local_bindings:
+            self._drain_bindings()
+        return rv
 
     def remove_node(self, name: str) -> int:
         with self._lock:
@@ -393,6 +426,11 @@ class StateSyncService:
                 name, arrays["usage"],
                 agg_usage=arrays.get("agg_usage"),
                 prod_usage=arrays.get("prod_usage"))
+        elif kind == NODE_DEVICES:
+            if not isinstance(doc.get("devices"), dict):
+                raise wire.WireSchemaError(
+                    "node_devices push requires a 'devices' object")
+            rv = self.update_node_devices(name, doc["devices"])
         elif kind == NODE_REMOVE:
             rv = self.remove_node(name)
         elif kind == POD_ADD:
@@ -557,6 +595,8 @@ def _dispatch_event(binding, entry: dict,
         binding.node_upsert(entry, arrs)
     elif kind == NODE_USAGE:
         binding.node_usage(entry, arrs)
+    elif kind == NODE_DEVICES:
+        binding.node_devices(entry)
     elif kind == NODE_REMOVE:
         binding.node_remove(entry["name"])
     elif kind == POD_ADD:
@@ -623,12 +663,37 @@ class SchedulerBinding:
 
                 register_node_from_annotations(
                     self.scheduler.cpu_manager, entry["name"], annotations)
-            devices = entry.get("devices") or {}
-            if devices and self.scheduler.device_manager is not None:
-                for dev_type, inventory in devices.items():
-                    if isinstance(inventory, list):
-                        self.scheduler.device_manager.register_node_devices(
-                            dev_type, entry["name"], inventory)
+            self._register_devices(entry["name"],
+                                   entry.get("devices") or {},
+                                   full_inventory=False)
+
+    def _register_devices(self, name: str, devices: dict,
+                          full_inventory: bool) -> None:
+        """Shared device registration (node_upsert + node_devices).
+        ``full_inventory=True`` (a node_devices refresh) also CLEARS
+        types previously registered for this node but absent from the
+        push — otherwise a disappeared collector leaves stale allocatable
+        tensors live while bootstrap replay has none (divergence)."""
+        manager = self.scheduler.device_manager
+        if manager is None:
+            return
+        for dev_type, inventory in (devices or {}).items():
+            if isinstance(inventory, list):
+                manager.register_node_devices(dev_type, name, inventory)
+        if full_inventory:
+            for gone in manager.registered_types_for(name) - set(devices):
+                manager.register_node_devices(gone, name, [])
+
+    def node_devices(self, entry: dict) -> None:
+        """Device-inventory refresh: re-register the node's per-type
+        device tensors (the Device-CR sync path node_upsert also rides);
+        unknown node: drop, same as node_usage."""
+        with self.scheduler.lock:
+            if entry["name"] not in self.scheduler.snapshot.node_index:
+                return
+            self._register_devices(entry["name"],
+                                   entry.get("devices") or {},
+                                   full_inventory=True)
 
     def node_usage(self, entry: dict, arrs: dict[str, np.ndarray]) -> None:
         """Usage-only refresh (the NodeMetric loop): keep the node's

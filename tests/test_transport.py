@@ -659,3 +659,86 @@ class TestLocalBindings:
         gate.set()
         pusher.join(5)
         assert not pusher.is_alive()
+
+
+def test_node_devices_push_registers_inventory(tmp_path):
+    """node_devices frames (the device daemon's report loop in wire
+    form): a pushed inventory lands in the scheduler's device manager
+    through the binding, merges into the stored node doc for bootstrap
+    replay, and an unknown node fails the call without touching the
+    log."""
+    from koordinator_tpu.cmd.binaries import main_koord_scheduler
+    from koordinator_tpu.transport.wire import FrameType
+
+    asm = main_koord_scheduler([
+        "--node-capacity", "8",
+        "--listen-socket", str(tmp_path / "dev.sock"),
+        "--disable-leader-election",
+    ])
+    try:
+        asm.state_sync.upsert_node(
+            "n-dev", resource_vector(cpu=8_000, memory=8_192))
+        client = RpcClient(asm.server.path)
+        client.connect()
+        try:
+            inventory = {"gpu": [{"core": 100, "memory": 1 << 14,
+                                  "group": 0}] * 2}
+            _, doc, _ = client.call(
+                FrameType.STATE_PUSH,
+                {"kind": "node_devices", "name": "n-dev",
+                 "devices": inventory})
+            assert doc["rv"] == 2
+            state = asm.component.device_manager.state("gpu")
+            assert state is not None
+            assert int(np.asarray(state.valid).sum()) == 2
+            # the stored node doc carries the inventory for bootstrap
+            stored = asm.state_sync.nodes["n-dev"]["doc"]["devices"]
+            assert stored == inventory
+
+            with pytest.raises(RpcError, match="unknown node"):
+                client.call(FrameType.STATE_PUSH,
+                            {"kind": "node_devices", "name": "ghost",
+                             "devices": inventory})
+            assert asm.state_sync.rv == 2
+        finally:
+            client.close()
+    finally:
+        asm.stop()
+
+
+def test_node_devices_refresh_clears_disappeared_types(tmp_path):
+    """A full-inventory refresh must clear types that vanished, or live
+    state diverges from what bootstrap replay would build."""
+    from koordinator_tpu.cmd.binaries import main_koord_scheduler
+    from koordinator_tpu.transport.wire import FrameType
+
+    asm = main_koord_scheduler([
+        "--node-capacity", "8",
+        "--listen-socket", str(tmp_path / "dev2.sock"),
+        "--disable-leader-election",
+    ])
+    try:
+        asm.state_sync.upsert_node(
+            "n-dev", resource_vector(cpu=8_000, memory=8_192))
+        client = RpcClient(asm.server.path)
+        client.connect()
+        try:
+            client.call(FrameType.STATE_PUSH,
+                        {"kind": "node_devices", "name": "n-dev",
+                         "devices": {"gpu": [{"core": 100,
+                                              "memory": 1 << 14}]}})
+            manager = asm.component.device_manager
+            assert int(np.asarray(manager.state("gpu").valid).sum()) == 1
+            # gpu collector disappears; tpu appears
+            client.call(FrameType.STATE_PUSH,
+                        {"kind": "node_devices", "name": "n-dev",
+                         "devices": {"xpu": [{"core": 100,
+                                              "memory": 1 << 14}]}})
+            assert int(np.asarray(manager.state("xpu").valid).sum()) == 1
+            gpu_state = manager.state("gpu")
+            assert gpu_state is None or int(
+                np.asarray(gpu_state.valid).sum()) == 0
+        finally:
+            client.close()
+    finally:
+        asm.stop()
