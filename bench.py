@@ -24,6 +24,8 @@ subtracted before dividing by K.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import jax
@@ -305,11 +307,15 @@ def _run_child(argv: list[str], timeout: float,
     return None, f"rc={proc.returncode}: {tail}"
 
 
-def _device_alive(timeout_s: float = 180.0) -> bool:
-    """Probe the backend with a tiny kernel under a thread timeout.  Through
-    the axon tunnel a dead link HANGS readbacks rather than erroring, which
-    would wedge the whole bench run; a probe that doesn't come back in time
-    means 'record device-unreachable and exit' instead."""
+def _device_alive(timeout_s: float = 180.0) -> tuple[bool, str]:
+    """(ok, error) — probe the backend with a tiny kernel under a thread
+    timeout.  Through the axon tunnel a dead link HANGS readbacks rather
+    than erroring, which would wedge the whole bench run; a probe that
+    doesn't come back in time means 'record device-unreachable and exit'.
+    A fast backend ERROR (e.g. Connection refused once the tunnel process
+    dies, observed 2026-07-31) counts as unreachable too — crashing with
+    rc!=0 would cost the round its record, since the driver keeps stdout
+    only on rc==0."""
     import threading
 
     ok: list[bool] = []
@@ -327,8 +333,43 @@ def _device_alive(timeout_s: float = 180.0) -> bool:
     t.start()
     t.join(timeout_s)
     if err:
-        raise err[0]   # real backend error: surface the traceback, rc!=0
-    return bool(ok)
+        return False, repr(err[0])[:300]
+    if not ok:
+        return False, f"probe kernel hung past {timeout_s:.0f}s"
+    return True, ""
+
+
+def _emit_zero_record(extra: dict) -> None:
+    """One JSON zero-record, then hard-exit 0: the driver records
+    stdout only on rc==0, and a hung device thread must not block
+    exit (os._exit skips buffered-IO teardown, hence the flush).
+
+    Before emitting, run the at-shape CPU quality sweep in a child
+    process (JAX_PLATFORMS=cpu — the parent's backend is the hung
+    tunnel): a device-down round must still leave machine-readable
+    evidence of the solver's quality at the north-star shape
+    (VERDICT r3 item 5) instead of only a zero."""
+    # Budget: the driver's own wall-clock limit is unknown but was
+    # ~3600s historically; probes may already have burned ~660s, so
+    # cap the sweep at 1500s — losing the sweep to the cap still
+    # emits the zero record below, losing the whole process to the
+    # driver's limit would lose even that.
+    child_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child_env.pop("XLA_FLAGS", None)
+    quality, err = _run_child(["--cpu-quality"], timeout=1500,
+                              env=child_env)
+    if quality is not None:
+        extra.update(quality)
+    else:
+        extra["cpu_quality_error"] = err
+
+    print(json.dumps({
+        "metric": f"solve_pods_per_sec_{N_PODS}p_{N_NODES}n",
+        "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
+        "extra": extra,
+    }))
+    sys.stdout.flush()
+    os._exit(0)
 
 
 def main() -> None:
@@ -340,54 +381,19 @@ def main() -> None:
     # may be the round's one official record — probe a few times before
     # recording a zero.  KOORD_BENCH_PROBE_TRIES overrides (1 = old
     # single-probe behavior); total worst-case wait = tries * 180s + waits.
-    import os
-
-    def emit_zero_record(extra: dict) -> None:
-        """One JSON zero-record, then hard-exit 0: the driver records
-        stdout only on rc==0, and a hung device thread must not block
-        exit (os._exit skips buffered-IO teardown, hence the flush).
-
-        Before emitting, run the at-shape CPU quality sweep in a child
-        process (JAX_PLATFORMS=cpu — the parent's backend is the hung
-        tunnel): a device-down round must still leave machine-readable
-        evidence of the solver's quality at the north-star shape
-        (VERDICT r3 item 5) instead of only a zero."""
-        import sys
-
-        # Budget: the driver's own wall-clock limit is unknown but was
-        # ~3600s historically; probes may already have burned ~660s, so
-        # cap the sweep at 1500s — losing the sweep to the cap still
-        # emits the zero record below, losing the whole process to the
-        # driver's limit would lose even that.
-        child_env = dict(os.environ, JAX_PLATFORMS="cpu")
-        child_env.pop("XLA_FLAGS", None)
-        quality, err = _run_child(["--cpu-quality"], timeout=1500,
-                                  env=child_env)
-        if quality is not None:
-            extra.update(quality)
-        else:
-            extra["cpu_quality_error"] = err
-
-        print(json.dumps({
-            "metric": f"solve_pods_per_sec_{N_PODS}p_{N_NODES}n",
-            "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
-            "extra": extra,
-        }))
-        sys.stdout.flush()
-        os._exit(0)
-
     tries = int(os.environ.get("KOORD_BENCH_PROBE_TRIES", "3"))
-    alive = False
+    alive, probe_err = False, ""
     for attempt in range(max(tries, 1)):
-        if _device_alive():
-            alive = True
+        alive, probe_err = _device_alive()
+        if alive:
             break
         if attempt + 1 < tries:
             time.sleep(60)
     if not alive:
-        emit_zero_record({
-            "error": "device unreachable: probe kernel did not complete "
-                     f"in {max(tries, 1)} attempts (tunnel down?)"})
+        _emit_zero_record({
+            "error": "device unreachable: probe did not complete in "
+                     f"{max(tries, 1)} attempts (tunnel down?): "
+                     f"{probe_err}"})
 
     state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
 
@@ -434,7 +440,7 @@ def main() -> None:
             timed[f"{method}_error"] = repr(e)[:200]
     measured = {m: t for m, t in timed.items() if isinstance(t, tuple)}
     if not measured:
-        emit_zero_record({"error": "every solve variant failed", **{
+        _emit_zero_record({"error": "every solve variant failed", **{
             k: v for k, v in timed.items() if isinstance(v, str)}})
     # quality gates speed: only variants whose assigned count is within
     # 1% of the best may win on time — a faster solver that strands pods
@@ -569,4 +575,15 @@ if __name__ == "__main__":
     elif len(sys.argv) == 2 and sys.argv[1] == "--cpu-quality":
         _cpu_quality_main()
     else:
-        main()
+        try:
+            main()
+        except Exception as e:  # NOT BaseException: a Ctrl-C must abort,
+            # not fabricate an official-looking zero record
+            # The tunnel can die MID-RUN after a successful probe
+            # (observed 2026-07-31: Connection refused inside
+            # _build_problem 38 min in, rc!=0, round record lost).
+            # Any crash downgrades to the zero record so the driver —
+            # which keeps stdout only on rc==0 — still gets the CPU
+            # quality evidence.
+            _emit_zero_record(
+                {"error": f"bench failed mid-run: {e!r}"[:500]})

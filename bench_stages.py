@@ -1,0 +1,147 @@
+"""Stage-split profiler for the north-star solve.
+
+Times the three stages of ``batch_assign`` separately at the 50k x 10,240
+shape so optimization effort lands where the milliseconds are:
+
+  score    — score_pods: the (P, N) filter+score tensor pipeline
+  select_* — select_candidates per method (approx / chunked / fused):
+             the (P, N) -> (P, k) top-k reduction INCLUDING scoring
+             (the stages overlap by design: chunked/fused never
+             materialize the full score tensor, so "selection minus
+             scoring" is not a physical quantity for them)
+  rounds   — _assign_rounds: the propose/accept conflict-resolution
+             loop given precomputed candidates (the only stage that is
+             sequential in k and rounds)
+
+Methodology matches bench.py: chained fori_loop iterations with a data
+dependency through node_usage, pods/candidates as TRACED arguments (not
+closure constants), tunnel rtt floor subtracted.  Each stage prints one
+JSON line so a timeout keeps the finished stages.
+
+Usage:  python bench_stages.py [--smoke]  (--smoke: tiny shape, any
+backend, for CI; the real capture needs the TPU tunnel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from bench import K_ITERS, _median_readback_seconds
+
+N_NODES = 10_240
+N_PODS = 50_000
+K = 16
+SPREAD = (5, 15)
+
+
+def _emit(stage: str, seconds: float, extra: dict | None = None) -> None:
+    rec = {"stage": stage, "ms_per_iter": round(seconds * 1e3, 2)}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def _time_chained(fn, args, rtt: float, iters: int = K_ITERS, n: int = 3):
+    total, value = _median_readback_seconds(jax.jit(fn), args, n=n)
+    return max((total - rtt) / iters, 1e-9), value
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv
+    if smoke:
+        # the ambient sitecustomize pins the tunnel backend via
+        # jax.config, so JAX_PLATFORMS=cpu alone is not enough (see
+        # tests/conftest.py) — and a wedged tunnel would hang the smoke
+        jax.config.update("jax_platforms", "cpu")
+    n_nodes, n_pods = (256, 1_024) if smoke else (N_NODES, N_PODS)
+    n_nodes = int(os.environ.get("KOORD_STAGES_NODES", n_nodes))
+    n_pods = int(os.environ.get("KOORD_STAGES_PODS", n_pods))
+    methods = tuple(os.environ.get("KOORD_STAGES_METHODS",
+                                   "approx,chunked,fused").split(","))
+    iters = 2 if smoke else K_ITERS
+
+    from __graft_entry__ import _build_problem
+    from koordinator_tpu.ops.assignment import score_pods
+    from koordinator_tpu.ops.batch_assign import (_assign_rounds,
+                                                  select_candidates)
+
+    state, pods, cfg = _build_problem(n_nodes, n_pods, seed=42)
+
+    def rtt_fn(st, p):
+        return st.node_allocatable.sum() + p.requests.sum()
+
+    rtt, _ = _median_readback_seconds(jax.jit(rtt_fn), (state, pods))
+    _emit("rtt_floor", rtt, {"backend": jax.default_backend(),
+                             "shape": f"{n_pods}p_{n_nodes}n", "k": K})
+
+    # -- score: keep the full (P, N) tensor live through the chain
+    def score_loop(st0, p):
+        def body(i, carry):
+            acc, usage = carry
+            scores, feasible = score_pods(st0.replace(node_usage=usage), p,
+                                          cfg)
+            return (acc + scores.sum() + feasible.sum(),
+                    usage + (scores[0, :, None] & 1))
+        acc, _ = jax.lax.fori_loop(0, iters, body,
+                                   (jnp.int32(0), st0.node_usage))
+        return acc
+
+    sec, _ = _time_chained(score_loop, (state, pods), rtt, iters)
+    _emit("score", sec)
+
+    # -- select per method: scoring + top-k reduction to (P, k)
+    def select_loop(method):
+        def fn(st0, p):
+            def body(i, carry):
+                acc, usage = carry
+                key, node = select_candidates(
+                    st0.replace(node_usage=usage), p, cfg, k=K,
+                    spread_bits=SPREAD, method=method)
+                # scalar perturbation keeps the loop-carried data
+                # dependency without caring about (N, dims) layout
+                return (acc + key.sum() + node.sum(),
+                        usage + (node.sum() & 1))
+            acc, _ = jax.lax.fori_loop(0, iters, body,
+                                       (jnp.int32(0), st0.node_usage))
+            return acc
+        return fn
+
+    for method in methods:
+        try:
+            sec, _ = _time_chained(select_loop(method), (state, pods), rtt,
+                                   iters)
+            _emit(f"select_{method}", sec)
+        except Exception as e:  # a broken variant must not cost the run
+            print(json.dumps({"stage": f"select_{method}",
+                              "error": repr(e)[:200]}), flush=True)
+
+    # -- rounds: propose/accept given precomputed candidates (traced args)
+    cand_key, cand_node = jax.jit(
+        lambda st, p: select_candidates(st, p, cfg, k=K, spread_bits=SPREAD,
+                                        method="chunked"))(state, pods)
+    cand_key.block_until_ready()
+
+    def rounds_loop(st0, p, ckey, cnode):
+        def body(i, carry):
+            acc, usage = carry
+            assignments, new_state, _ = _assign_rounds(
+                st0.replace(node_usage=usage), p, None, ckey, cnode,
+                rounds=12)
+            return (acc + (assignments >= 0).sum().astype(jnp.int32),
+                    usage + (new_state.node_requested & 1))
+        acc, _ = jax.lax.fori_loop(0, iters, body,
+                                   (jnp.int32(0), st0.node_usage))
+        return acc
+
+    sec, value = _time_chained(rounds_loop, (state, pods, cand_key,
+                                             cand_node), rtt, iters)
+    _emit("rounds", sec, {"assigned_per_iter": round(value / iters, 1)})
+
+
+if __name__ == "__main__":
+    main()

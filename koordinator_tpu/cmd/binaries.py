@@ -173,7 +173,10 @@ def main_koordlet(argv: list[str], device_report_fn=None,
             NodeMetricReporter,
         )
         from koordinator_tpu.transport import RpcClient
-        from koordinator_tpu.transport.channel import RpcError
+        from koordinator_tpu.transport.channel import (
+            RpcError,
+            RpcRemoteError,
+        )
         from koordinator_tpu.transport.wire import FrameType
 
         class SidecarClient:
@@ -192,6 +195,11 @@ def main_koordlet(argv: list[str], device_report_fn=None,
                 self._lock = _threading.Lock()
 
             def call(self, *call_args, **call_kwargs):
+                # the lock covers only connect/reconnect/close: RpcClient
+                # .call is concurrency-safe (per-request waiter map), and
+                # holding the lock across a call would serialize the
+                # usage and device report threads behind a wedged
+                # sidecar for the full 10s timeout each
                 with self._lock:
                     if self._client is None or not self._client.connected:
                         self._close_locked()
@@ -202,11 +210,23 @@ def main_koordlet(argv: list[str], device_report_fn=None,
                             raise RpcError(
                                 f"sidecar unreachable: {e}") from e
                         self._client = client
-                    try:
-                        return self._client.call(*call_args, **call_kwargs)
-                    except RpcError:
-                        self._close_locked()   # next report reconnects
-                        raise
+                    client = self._client
+                try:
+                    return client.call(*call_args, **call_kwargs)
+                except RpcRemoteError:
+                    # the peer rejected the REQUEST over a healthy
+                    # connection (e.g. unknown node before the upsert
+                    # lands): closing here would kill the other
+                    # reporter's in-flight call on the shared socket
+                    raise
+                except RpcError:
+                    with self._lock:
+                        # transport failure: drop only the client we
+                        # saw fail — a racing caller may already have
+                        # reconnected
+                        if self._client is client:
+                            self._close_locked()
+                    raise
 
             def _close_locked(self) -> None:
                 if self._client is not None:
@@ -266,11 +286,18 @@ def main_koordlet(argv: list[str], device_report_fn=None,
             import threading as _threading
 
             device_push_inflight = _threading.Event()
+            daemon.device_push_failures = 0
 
             def push_devices(device) -> None:
                 inventory = device_infos_to_inventory(list(device.devices))
-                if not inventory:
-                    return
+                # push EVERY interval, empty or not (heartbeat): the
+                # server drops unchanged pushes without log churn
+                # (update_node_devices dedups against the stored doc),
+                # the periodic re-push restores inventory a server-side
+                # re-upsert may have cleared, and the empty push clears
+                # tensors for vanished hardware EVEN ACROSS a koordlet
+                # restart (any in-process last-push cache would skip the
+                # clear when the devices disappeared while we were down)
                 # one in-flight push: a wedged sidecar must not pile up
                 # threads (the next report interval retries)
                 if device_push_inflight.is_set():
@@ -282,10 +309,13 @@ def main_koordlet(argv: list[str], device_report_fn=None,
                         sidecar.call(
                             FrameType.STATE_PUSH,
                             {"kind": "node_devices",
-                             "name": device.node_name,
+                             # the daemon's registered identity, same as
+                             # push_usage — a Device-CR node_name that
+                             # differs is an unknown node upstream
+                             "name": args.node_name,
                              "devices": inventory})
-                    except Exception:  # noqa: BLE001 — next report
-                        pass            # interval retries
+                    except Exception:  # noqa: BLE001 — COUNTED, next
+                        daemon.device_push_failures += 1  # interval retries
                     finally:
                         device_push_inflight.clear()
 

@@ -46,6 +46,12 @@ class CPUManager:
 
     def __init__(self) -> None:
         self._nodes: dict[str, NodeCPUState] = {}
+        #: allocations preserved across a topology disappearance (e.g. a
+        #: transient annotation-less node re-upsert removed the node):
+        #: restored when the topology re-registers, so exclusive cores
+        #: held by still-bound pods cannot be granted twice after the
+        #: NRT annotation returns
+        self._orphans: dict[str, dict[str, CPUAllocation]] = {}
 
     def register_node(
         self, name: str, topology: CPUTopology, max_ref: int = 1
@@ -70,19 +76,46 @@ class CPUManager:
             ref_count=np.zeros(topology.capacity, np.int32),
             max_ref=max_ref,
         )
-        if old is not None:
-            valid = np.asarray(topology.valid)
-            for pod, alloc in old.allocations.items():
-                cpus = [c for c in alloc.cpus
-                        if c < len(valid) and valid[c]]
-                if cpus:
-                    st.ref_count[cpus] += 1
-                    st.allocations[pod] = CPUAllocation(
-                        pod, cpus, alloc.exclusive_policy)
+        valid = np.asarray(topology.valid)
+        carried = dict(old.allocations) if old is not None else {}
+        # a topology that vanished and returned (remove_node stashed the
+        # allocations) restores them too — live records win over orphans
+        for pod, alloc in self._orphans.pop(name, {}).items():
+            carried.setdefault(pod, alloc)
+        for pod, alloc in carried.items():
+            cpus = [c for c in alloc.cpus
+                    if c < len(valid) and valid[c]]
+            if cpus:
+                st.ref_count[cpus] += 1
+                st.allocations[pod] = CPUAllocation(
+                    pod, cpus, alloc.exclusive_policy)
         self._nodes[name] = st
 
     def node(self, name: str) -> NodeCPUState | None:
         return self._nodes.get(name)
+
+    def clear(self) -> None:
+        """Drop all topologies and CPU allocations — snapshot-resync
+        restart semantics (SchedulerBinding.reset); the replayed
+        snapshot's NRT annotations re-register what still exists and
+        the bound-pod replay restores allocations."""
+        self._nodes.clear()
+        self._orphans.clear()
+
+    def remove_node(self, name: str) -> None:
+        """Drop one node's topology — the node's NRT annotation
+        disappeared (or the node did): fine-grained CPU placement on it
+        is no longer possible, and keeping the stale topology would
+        diverge from what a bootstrap replay builds.  Allocations are
+        STASHED, not dropped: if the disappearance was transient (an
+        annotation-less re-upsert racing the koordlet's NRT report),
+        the re-registration restores them — wiping ref counts would let
+        exclusive cores be granted twice."""
+        st = self._nodes.pop(name, None)
+        if st is not None and st.allocations:
+            stash = self._orphans.setdefault(name, {})
+            for pod, alloc in st.allocations.items():
+                stash[pod] = alloc
 
     def _banned_mask(self, st: NodeCPUState, pod_policy: int) -> np.ndarray:
         """CPUs excluded by other pods' exclusivity or by this pod's own
@@ -170,6 +203,13 @@ class CPUManager:
         return True
 
     def release(self, node: str, pod: str) -> None:
+        # purge any orphaned record too: a pod deleted while the node's
+        # topology was absent must not resurrect on re-registration
+        orphans = self._orphans.get(node)
+        if orphans is not None:
+            orphans.pop(pod, None)
+            if not orphans:
+                del self._orphans[node]
         st = self._nodes.get(node)
         if st is None:
             return

@@ -65,6 +65,31 @@ class DeviceManager:
         if raw.get(node) == list(devices):
             return   # unchanged heartbeat: skip the O(cluster) rebuild
         raw[node] = list(devices)
+        self._rebuild_type(device_type)
+
+    @staticmethod
+    def _live_minors(a: DeviceAllocation, dev, row: int) -> list[int]:
+        """The subset of a record's minors present in the CURRENT
+        inventory.  Records are never pruned destructively: a transient
+        inventory clear (a devices-omitting node re-upsert racing the
+        koordlet heartbeat that repairs it) must re-commit the grant
+        when the inventory returns; a minor that is really gone simply
+        never re-commits and is filtered from annotations/release."""
+        return [m for m in a.minors
+                if m < dev.shape[1] and bool(dev.valid[row, m])]
+
+    def _rebuild_type(self, device_type: str) -> None:
+        """Rebuild one type's tensors from raw inventory and re-commit
+        the live part of every allocation record (shared by inventory
+        updates and node removal)."""
+        raw = self._raw.get(device_type)
+        if not raw:
+            # last node of the type gone: drop the type entirely rather
+            # than keeping empty rows around
+            self._raw.pop(device_type, None)
+            self._state.pop(device_type, None)
+            self._node_rows.pop(device_type, None)
+            return
         names = sorted(raw)
         self._state[device_type] = DeviceState.build([raw[n] for n in names])
         self._node_rows[device_type] = {n: i for i, n in enumerate(names)}
@@ -76,26 +101,41 @@ class DeviceManager:
                 if a.device_type != device_type:
                     continue
                 dev = self._state[device_type]
-                # prune the RECORD too: a minor dropped by an inventory
-                # shrink must not resurface in annotations or crash a
-                # later release's mask indexing
-                a.minors = [m for m in a.minors
-                            if m < dev.shape[1]
-                            and bool(dev.valid[row, m])]
-                if not a.minors:
+                live = self._live_minors(a, dev, row)
+                if not live:
                     continue
                 sel = np.zeros(dev.shape[1], bool)
-                sel[a.minors] = True
+                sel[live] = True
                 self._state[device_type] = commit_allocation(
                     dev, jnp.int32(row), jnp.asarray(sel),
                     jnp.int32(a.core), jnp.int32(a.memory),
                 )
+
+    def remove_node(self, name: str) -> None:
+        """Drop one node's inventory rows and allocation records across
+        all types (NODE_REMOVE): registering empty lists instead would
+        leave a permanent zero row per removed node in every type tensor
+        — unbounded growth under node churn."""
+        for dev_type in list(self._raw):
+            if self._raw[dev_type].pop(name, None) is not None:
+                self._rebuild_type(dev_type)
+        for key in [k for k in self._allocs if k[1] == name]:
+            del self._allocs[key]
 
     def registered_types_for(self, node: str) -> set[str]:
         """Device types this node has inventory registered under — lets
         a full-inventory refresh clear types that disappeared."""
         return {dev_type for dev_type, raw in self._raw.items()
                 if node in raw}
+
+    def clear(self) -> None:
+        """Drop ALL inventory and allocation state — snapshot-resync
+        restart semantics (SchedulerBinding.reset): types absent from the
+        replayed snapshot must not survive as live allocatable tensors."""
+        self._state.clear()
+        self._node_rows.clear()
+        self._allocs.clear()
+        self._raw.clear()
 
     def state(self, device_type: str) -> DeviceState | None:
         return self._state.get(device_type)
@@ -148,8 +188,14 @@ class DeviceManager:
         row = self._node_rows.get(alloc.device_type, {}).get(node)
         if dev is None or row is None:
             return
+        # only the live minors were committed at the last rebuild, so
+        # only they release — a dead minor in the record must not drive
+        # a nonexistent device's free counter (or the mask index) wrong
+        live = self._live_minors(alloc, dev, row)
+        if not live:
+            return
         sel = np.zeros(dev.shape[1], bool)
-        sel[alloc.minors] = True
+        sel[live] = True
         self._state[alloc.device_type] = release_allocation(
             dev, jnp.int32(row), jnp.asarray(sel),
             jnp.int32(alloc.core), jnp.int32(alloc.memory),
@@ -204,14 +250,22 @@ class DeviceManager:
             self._release_one(node, alloc)
 
     def device_allocated_annotation(self, node: str, pod: str) -> dict | None:
-        """The device-allocated annotation payload (device_share.go:32)."""
+        """The device-allocated annotation payload (device_share.go:32).
+        Reports only minors present in the CURRENT inventory: records
+        survive transient inventory clears undamaged, but a consumer
+        (GPU env inject) must never see a device that is gone."""
         allocs = self._allocs.get((pod, node))
         if not allocs:
             return None
-        return {
-            a.device_type: [
-                {"minor": m, "resources": {"core": a.core, "memory": a.memory}}
-                for m in a.minors
-            ]
-            for a in allocs
-        }
+        out: dict = {}
+        for a in allocs:
+            dev = self._state.get(a.device_type)
+            row = self._node_rows.get(a.device_type, {}).get(node)
+            minors = (self._live_minors(a, dev, row)
+                      if dev is not None and row is not None else [])
+            if minors:
+                out.setdefault(a.device_type, []).extend(
+                    {"minor": m,
+                     "resources": {"core": a.core, "memory": a.memory}}
+                    for m in minors)
+        return out or None

@@ -46,6 +46,14 @@ class RpcError(RuntimeError):
     pass
 
 
+class RpcRemoteError(RpcError):
+    """The peer answered with an ERROR frame: the request was rejected
+    (schema error, unknown node, ...) but the CONNECTION is healthy.
+    Callers that manage connection lifecycle must not tear down a
+    shared client on it — closing would kill other threads' in-flight
+    calls on the same socket."""
+
+
 def _recv_exact(sock: socket.socket):
     def recv(n: int) -> bytes:
         buf = bytearray()
@@ -360,7 +368,7 @@ class RpcClient:
             raise RpcError("connection lost")
         rdoc, rarrays = decode_payload(waiter.frame.payload)
         if waiter.frame.type is FrameType.ERROR:
-            raise RpcError(rdoc.get("message", "remote error"))
+            raise RpcRemoteError(rdoc.get("message", "remote error"))
         return waiter.frame.type, rdoc, rarrays
 
 

@@ -98,6 +98,35 @@ def _unpack_event_arrays(entry: dict,
     return out
 
 
+def _validate_devices(devices: dict | None, context: str) -> None:
+    """Reject malformed device inventories at EVERY entry point (wire
+    push AND the direct upsert_node/update_node_devices API): a non-list
+    type value would commit to the log and then silently skip
+    registration on replay while `full_inventory` clearing sees the type
+    as present — the exact live-vs-replay divergence the clearing
+    exists to prevent."""
+    if devices is None:
+        return
+    if not wire.check_field_type(devices, dict):
+        raise wire.WireSchemaError(
+            f"{context}: 'devices' must be an object, "
+            f"got {type(devices).__name__}")
+    for dev_type, inventory in devices.items():
+        if not isinstance(inventory, list) or any(
+                not isinstance(entry, dict) for entry in inventory):
+            raise wire.WireSchemaError(
+                f"{context}: devices[{dev_type!r}] must be a list "
+                f"of objects")
+        for entry in inventory:
+            # entries feed DeviceState.build's int tensors on replay
+            for field in ("core", "memory", "group"):
+                if not wire.check_field_type(
+                        entry.get(field, 0), int):
+                    raise wire.WireSchemaError(
+                        f"{context}: devices[{dev_type!r}] entry "
+                        f"field {field!r} must be an integer")
+
+
 class StateSyncService:
     """Informer-side state authority + wire handlers.
 
@@ -137,14 +166,22 @@ class StateSyncService:
         broadcast path."""
         self._local_bindings.append(binding)
 
-    def _commit(self, event: dict, arrays: dict[str, np.ndarray]) -> int:
-        """Append + broadcast under the lock so rv order and wire order
-        agree (the client's idempotency guard drops any rv it has already
-        passed, so reordered broadcasts would lose events). Safe to hold:
+    def _store_and_commit(self, store_fn, event: dict,
+                          arrays: dict[str, np.ndarray]) -> int:
+        """Run a stored-state mutation AND append+broadcast its event
+        under ONE lock acquisition, so rv order, wire order, and stored
+        state always agree (the client's idempotency guard drops any rv
+        it has already passed, so reordered broadcasts would lose
+        events; a store released before the log append lets a racing
+        mutator interleave — e.g. upsert_node(devices={}) vs
+        update_node_devices(X) could log [devices=X, upsert={}] while
+        storing devices=X, and the stale stored doc would then eat every
+        subsequent identical heartbeat as 'unchanged').  Safe to hold:
         broadcast only enqueues to bounded per-connection queues — a
         stalled peer drops frames and gets poisoned, it cannot wedge the
         service (channel._Conn.send)."""
         with self._lock:
+            store_fn()
             rv = self._commit_locked(event, arrays)
         # apply OUTSIDE the service lock: bindings block on the scheduler
         # lock (a long solve), and holding _lock through that would stall
@@ -192,6 +229,7 @@ class StateSyncService:
         etc.); ``devices`` carries the Device-CR inventory per type
         ({type: [{"core": c, "memory": b, "group": g}, ...]}) — both feed
         the scheduler's fine-grained allocators on the client side."""
+        _validate_devices(devices, "upsert_node")
         arrays = {
             "allocatable": np.asarray(allocatable, np.int32),
             "usage": (np.asarray(usage, np.int32) if usage is not None
@@ -200,9 +238,10 @@ class StateSyncService:
         doc = {"kind": NODE_UPSERT, "name": name,
                "labels": labels or {}, "taints": taints or {},
                "annotations": annotations or {}, "devices": devices or {}}
-        with self._lock:
+        def store():
             self.nodes[name] = {"doc": doc, "arrays": arrays}
-        return self._commit(doc, arrays)
+
+        return self._store_and_commit(store, doc, arrays)
 
     def update_node_usage(self, name: str, usage: np.ndarray,
                           agg_usage: np.ndarray | None = None,
@@ -238,11 +277,19 @@ class StateSyncService:
         form): replace a node's device inventory without re-sending
         allocatable.  Merges into the stored node doc so bootstrap
         replay carries it; same unknown-node posture as node_usage."""
+        _validate_devices(devices, "update_node_devices")
         with self._lock:
             entry = self.nodes.get(name)
             if entry is None:
                 raise wire.WireSchemaError(
                     f"node_devices for unknown node {name!r}")
+            if entry["doc"].get("devices") == devices:
+                # unchanged heartbeat (the koordlet sink re-pushes every
+                # interval so a clearing re-upsert gets repaired): no
+                # log append, no watcher wakeup — an N-node cluster
+                # heartbeating would otherwise shrink the bounded
+                # delta-log retention to ~4096/N intervals
+                return self.rv
             entry["doc"] = dict(entry["doc"], devices=dict(devices))
             rv = self._commit_locked(
                 {"kind": NODE_DEVICES, "name": name,
@@ -252,9 +299,9 @@ class StateSyncService:
         return rv
 
     def remove_node(self, name: str) -> int:
-        with self._lock:
-            self.nodes.pop(name, None)
-        return self._commit({"kind": NODE_REMOVE, "name": name}, {})
+        return self._store_and_commit(
+            lambda: self.nodes.pop(name, None),
+            {"kind": NODE_REMOVE, "name": name}, {})
 
     def add_pod(self, name: str, requests: np.ndarray,
                 priority: int = 0, quota: str | None = None,
@@ -268,14 +315,15 @@ class StateSyncService:
                "quota": quota, "gang": gang,
                "node_selector": node_selector or {},
                "labels": labels or {}, "owner": owner, "qos": qos}
-        with self._lock:
+        def store():
             self.pods[name] = {"doc": doc, "arrays": arrays}
-        return self._commit(doc, arrays)
+
+        return self._store_and_commit(store, doc, arrays)
 
     def remove_pod(self, name: str) -> int:
-        with self._lock:
-            self.pods.pop(name, None)
-        return self._commit({"kind": POD_REMOVE, "name": name}, {})
+        return self._store_and_commit(
+            lambda: self.pods.pop(name, None),
+            {"kind": POD_REMOVE, "name": name}, {})
 
     def upsert_reservation(self, name: str, requests: np.ndarray,
                            owners: list[dict] | None = None,
@@ -294,14 +342,15 @@ class StateSyncService:
                "node_selector": node_selector or {},
                "tolerations": tolerations or {},
                "restricted": bool(restricted)}
-        with self._lock:
+        def store():
             self.reservations[name] = {"doc": doc, "arrays": arrays}
-        return self._commit(doc, arrays)
+
+        return self._store_and_commit(store, doc, arrays)
 
     def remove_reservation(self, name: str) -> int:
-        with self._lock:
-            self.reservations.pop(name, None)
-        return self._commit({"kind": RSV_REMOVE, "name": name}, {})
+        return self._store_and_commit(
+            lambda: self.reservations.pop(name, None),
+            {"kind": RSV_REMOVE, "name": name}, {})
 
     # -- wire handlers -------------------------------------------------------
 
@@ -386,20 +435,9 @@ class StateSyncService:
                     owner.get("controller", ""), str):
                 raise wire.WireSchemaError(
                     f"{kind} push: owner 'controller' must be a string")
-        for dev_type, inventory in (doc.get("devices") or {}).items():
-            if not isinstance(inventory, list) or any(
-                    not isinstance(entry, dict) for entry in inventory):
-                raise wire.WireSchemaError(
-                    f"{kind} push: devices[{dev_type!r}] must be a list "
-                    f"of objects")
-            for entry in inventory:
-                # entries feed DeviceState.build's int tensors on replay
-                for field in ("core", "memory", "group"):
-                    if not wire.check_field_type(
-                            entry.get(field, 0), int):
-                        raise wire.WireSchemaError(
-                            f"{kind} push: devices[{dev_type!r}] entry "
-                            f"field {field!r} must be an integer")
+        # device inventory shape is validated inside upsert_node /
+        # update_node_devices (the consuming kinds route through them,
+        # covering in-process callers too — see _validate_devices)
         for scalar_field in ("quota", "gang", "owner", "node"):
             require_doc(scalar_field, str, "a string")
         for int_field in ("priority", "qos"):
@@ -634,6 +672,13 @@ class SchedulerBinding:
             snap = self.scheduler.snapshot
             for name in list(snap.node_index):
                 snap.remove_node(name)
+            # fine-grained registries restart too: device tensors / CPU
+            # topologies not re-registered by the snapshot replay must
+            # not survive as live allocatable state
+            if self.scheduler.device_manager is not None:
+                self.scheduler.device_manager.clear()
+            if self.scheduler.cpu_manager is not None:
+                self.scheduler.cpu_manager.clear()
 
     def node_upsert(self, entry: dict, arrs: dict[str, np.ndarray]) -> None:
         from koordinator_tpu.scheduler.snapshot import NodeSpec
@@ -654,26 +699,38 @@ class SchedulerBinding:
             ))
             # fine-grained registries ride the node event: NRT annotations
             # register the CPU topology, the Device inventory registers
-            # per-type device tensors
+            # per-type device tensors.  BOTH follow the same replay-parity
+            # rule: an upsert replaces the stored doc wholesale, so a
+            # re-upsert without a (valid) NRT annotation must clear the
+            # live topology just as an omitted device type clears its
+            # tensors — otherwise this process keeps making placements a
+            # bootstrap-replay client cannot see
             annotations = entry.get("annotations") or {}
-            if annotations and self.scheduler.cpu_manager is not None:
+            if self.scheduler.cpu_manager is not None:
                 from koordinator_tpu.scheduler.cpu_manager import (
                     register_node_from_annotations,
                 )
 
-                register_node_from_annotations(
-                    self.scheduler.cpu_manager, entry["name"], annotations)
+                if not register_node_from_annotations(
+                        self.scheduler.cpu_manager, entry["name"],
+                        annotations):
+                    self.scheduler.cpu_manager.remove_node(entry["name"])
+            # full inventory: upsert_node REPLACES the stored doc's
+            # devices wholesale, so a re-upsert that omits a type must
+            # clear its live tensors too — otherwise the in-process
+            # scheduler and a bootstrap-replay client diverge
             self._register_devices(entry["name"],
                                    entry.get("devices") or {},
-                                   full_inventory=False)
+                                   full_inventory=True)
 
     def _register_devices(self, name: str, devices: dict,
                           full_inventory: bool) -> None:
         """Shared device registration (node_upsert + node_devices).
-        ``full_inventory=True`` (a node_devices refresh) also CLEARS
-        types previously registered for this node but absent from the
-        push — otherwise a disappeared collector leaves stale allocatable
-        tensors live while bootstrap replay has none (divergence)."""
+        ``full_inventory=True`` (both event kinds carry the node's whole
+        inventory) also CLEARS types previously registered for this node
+        but absent from the push — otherwise a disappeared collector
+        leaves stale allocatable tensors live while bootstrap replay has
+        none (divergence)."""
         manager = self.scheduler.device_manager
         if manager is None:
             return
@@ -719,6 +776,13 @@ class SchedulerBinding:
     def node_remove(self, name: str) -> None:
         with self.scheduler.lock:
             self.scheduler.snapshot.remove_node(name)
+            # replay parity: a removed node's fine-grained state goes
+            # with it — a bootstrap-replay client has neither its device
+            # tensors nor its CPU topology
+            if self.scheduler.device_manager is not None:
+                self.scheduler.device_manager.remove_node(name)
+            if self.scheduler.cpu_manager is not None:
+                self.scheduler.cpu_manager.remove_node(name)
 
     def pod_add(self, entry: dict, arrs: dict[str, np.ndarray]) -> None:
         from koordinator_tpu.scheduler.snapshot import PodSpec

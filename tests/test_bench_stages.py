@@ -1,0 +1,31 @@
+"""bench_stages.py (the stage-split profiler) must keep working: its
+predecessor lived in /tmp as scratch_timing.py and rotted away between
+sessions, losing the round-3 stage-split capture recipe.  Run it as a
+subprocess at a tiny shape and assert every stage emits a record —
+exactly how the prober (tools/tpu_probe.sh) invokes it on hardware."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_stage_profiler_smoke():
+    env = dict(os.environ, KOORD_STAGES_NODES="64", KOORD_STAGES_PODS="256",
+               KOORD_STAGES_METHODS="approx,chunked")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_stages.py"), "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    records = [json.loads(line) for line in proc.stdout.splitlines()]
+    stages = {r["stage"] for r in records}
+    assert stages == {"rtt_floor", "score", "select_approx",
+                      "select_chunked", "rounds"}, stages
+    by_stage = {r["stage"]: r for r in records}
+    # every timed stage produced a positive per-iteration time
+    for name in ("score", "select_approx", "select_chunked", "rounds"):
+        assert by_stage[name]["ms_per_iter"] > 0, by_stage[name]
+    # the rounds stage really assigned pods (256 pods, ample capacity)
+    assert by_stage["rounds"]["assigned_per_iter"] > 0

@@ -227,3 +227,99 @@ def test_nodemetric_loop_over_the_wire(tmp_path):
         if koordlet_asm is not None:
             koordlet_asm.component.stop()
         sched_asm.stop()
+
+
+def test_device_inventory_loop_over_the_wire(tmp_path):
+    """The Device-CR report loop in wire form, INCLUDING disappearance:
+    the koordlet binary's default sink pushes node_devices frames on
+    change, and when every device vanishes it pushes the EMPTY inventory
+    so the scheduler's live tensors clear (a skip-when-empty sink would
+    leave the node allocatable forever — live-vs-replay divergence)."""
+    import shutil
+    import time
+
+    from koordinator_tpu.cmd.binaries import (
+        main_koord_scheduler,
+        main_koordlet,
+    )
+    from koordinator_tpu.features import KOORDLET_GATES
+
+    sched_asm = main_koord_scheduler([
+        "--node-capacity", "8",
+        "--listen-socket", str(tmp_path / "devloop.sock"),
+        "--disable-leader-election",
+    ])
+    cfg = make_test_config(tmp_path)
+    accel_root = os.path.join(cfg.sys_root, "class", "accel", "accel0")
+    os.makedirs(accel_root, exist_ok=True)
+    for fn, val in (("uuid", "GPU-0"), ("minor", "0"),
+                    ("mem_total", "81920"), ("mem_used", "0"),
+                    ("usage_pct", "0"), ("numa_node", "0"),
+                    ("health", "1"), ("type", "gpu")):
+        with open(os.path.join(accel_root, fn), "w") as f:
+            f.write(val)
+    os.makedirs(cfg.proc_root, exist_ok=True)
+    with open(cfg.proc_path("stat"), "w") as f:
+        f.write("cpu  0 0 0 0 0 0 0 0 0 0\n")
+    with open(cfg.proc_path("meminfo"), "w") as f:
+        f.write("MemTotal: 1024 kB\nMemAvailable: 512 kB\nCached: 0\n")
+
+    koordlet_asm = None
+    KOORDLET_GATES.set("Accelerators", True)
+    try:
+        sched_asm.state_sync.upsert_node(
+            "n-dev", resource_vector(cpu=8_000, memory=8_192))
+        koordlet_asm = main_koordlet([
+            "--cgroup-root-dir", cfg.cgroup_root,
+            "--proc-root-dir", cfg.proc_root,
+            "--sys-root-dir", cfg.sys_root,
+            "--scheduler-sidecar-addr", str(tmp_path / "devloop.sock"),
+            "--node-name", "n-dev",
+        ])
+        daemon = koordlet_asm.component
+        from koordinator_tpu.koordlet.statesinformer import NodeInfo
+
+        daemon.states.set_node(NodeInfo(name="n-dev", allocatable={}))
+        daemon.device_report_interval_seconds = 0.0
+        manager = sched_asm.component.device_manager
+
+        def live_gpus():
+            state = manager.state("gpu")
+            return 0 if state is None else int(np.asarray(state.valid).sum())
+
+        deadline = time.monotonic() + 20
+        while live_gpus() == 0 and time.monotonic() < deadline:
+            daemon.tick()
+            time.sleep(0.05)
+        assert live_gpus() == 1, "device push never reached the solver"
+
+        # a label-only re-upsert on the server clears the node's device
+        # inventory (upsert replaces the doc wholesale); the koordlet's
+        # HEARTBEAT re-push must restore it — a pure push-on-change
+        # cache would strand the node device-less forever
+        sched_asm.state_sync.upsert_node(
+            "n-dev", resource_vector(cpu=8_000, memory=8_192),
+            labels={"zone": "b"})
+        assert live_gpus() == 0     # cleared by the re-upsert
+        deadline = time.monotonic() + 20
+        while live_gpus() == 0 and time.monotonic() < deadline:
+            daemon.tick()
+            time.sleep(0.05)
+        assert live_gpus() == 1, "heartbeat never restored the inventory"
+
+        # the whole accel class vanishes: the sink must push {} so the
+        # scheduler clears the type (and the stored doc matches replay)
+        shutil.rmtree(os.path.dirname(accel_root))
+        deadline = time.monotonic() + 20
+        while live_gpus() > 0 and time.monotonic() < deadline:
+            daemon.tick()
+            time.sleep(0.05)
+        assert live_gpus() == 0, "vanished inventory never cleared"
+        stored = sched_asm.state_sync.nodes["n-dev"]["doc"]["devices"]
+        assert stored == {}
+        assert daemon.device_push_failures == 0
+    finally:
+        KOORDLET_GATES.set("Accelerators", False)
+        if koordlet_asm is not None:
+            koordlet_asm.component.stop()
+        sched_asm.stop()

@@ -240,3 +240,32 @@ def test_max_ref_count_sharing():
     b = mgr.allocate("n0", "pod-b", 16)
     assert a is not None and b is not None
     assert mgr.allocate("n0", "pod-c", 1) is None
+
+
+def test_topology_disappearance_preserves_allocations():
+    """A transient NRT-annotation loss (annotation-less node re-upsert)
+    removes the topology but must NOT wipe live CPU allocations: when
+    the topology re-registers, exclusive cores held by still-bound pods
+    re-commit — wiping ref counts would let them be granted twice."""
+    from koordinator_tpu.ops.numa import EXCLUSIVE_PCPU_LEVEL
+    from koordinator_tpu.scheduler.cpu_manager import CPUManager
+
+    topo = CPUTopology.uniform(sockets=1, numa_per_socket=2,
+                               cores_per_numa=4)
+    cm = CPUManager()
+    cm.register_node("n0", topo)
+    cpus = cm.allocate("n0", "p", 2, exclusive_policy=EXCLUSIVE_PCPU_LEVEL)
+    assert cpus
+    cm.remove_node("n0")
+    assert cm.node("n0") is None
+    cm.register_node("n0", topo)
+    st = cm.node("n0")
+    assert st.allocations["p"].cpus == cpus
+    assert int(st.ref_count[cpus].sum()) == len(cpus)
+    assert st.allocations["p"].exclusive_policy == EXCLUSIVE_PCPU_LEVEL
+    # a pod deleted while the topology was absent must not resurrect
+    cm.remove_node("n0")
+    cm.release("n0", "p")
+    cm.register_node("n0", topo)
+    assert "p" not in cm.node("n0").allocations
+    assert int(cm.node("n0").ref_count.sum()) == 0

@@ -871,18 +871,32 @@ class TestFineGrainedBind:
         assert cm.node("n1").allocations["lsr-a"].cpus == cpus
         assert cm.node("n1").ref_count.sum() == 4
 
-    def test_device_inventory_shrink_prunes_held_minors(self):
+    def test_device_inventory_shrink_keeps_records_filters_views(self):
+        """An inventory shrink must not destroy allocation records (a
+        transient clear + heartbeat restore would otherwise free devices
+        still held by bound pods); instead the VIEWS filter to live
+        minors — annotations report only existing devices, release
+        doesn't crash, and a restored inventory re-commits the grant."""
         from koordinator_tpu.scheduler.device_manager import DeviceManager
 
         dm = DeviceManager()
-        dm.register_node_devices("gpu", "n0", [
-            {"core": 100, "memory": 0, "group": 0} for _ in range(5)])
+        full = [{"core": 100, "memory": 0, "group": 0} for _ in range(5)]
+        dm.register_node_devices("gpu", "n0", full)
         assert dm.allocate("gpu", "n0", "p", core=500) is not None
-        dm.register_node_devices("gpu", "n0", [
-            {"core": 100, "memory": 0, "group": 0} for _ in range(2)])
-        # records pruned to the surviving minors; release doesn't crash
+        dm.register_node_devices("gpu", "n0", full[:2])
+        # the RECORD keeps all five minors; the annotation view filters
         allocs = dm._allocs[("p", "n0")]
-        assert all(m < 2 for a in allocs for m in a.minors)
+        assert sorted(m for a in allocs for m in a.minors) == [0, 1, 2, 3, 4]
+        ann = dm.device_allocated_annotation("n0", "p")
+        assert sorted(g["minor"] for g in ann["gpu"]) == [0, 1]
+        # inventory returns: the held minors re-commit, so a new pod
+        # cannot be granted devices p still uses
+        dm.register_node_devices("gpu", "n0", full)
+        state = dm.state("gpu")
+        # every device's core capacity is committed again — a new pod
+        # cannot be granted what p holds
+        assert int(np.asarray(state.free)[..., 0].sum()) == 0
+        # release frees only live minors and doesn't crash
         dm.release("n0", "p")
         assert dm.allocate("gpu", "n0", "q", core=200) is not None
 

@@ -742,3 +742,175 @@ def test_node_devices_refresh_clears_disappeared_types(tmp_path):
             client.close()
     finally:
         asm.stop()
+
+
+def test_node_upsert_clears_omitted_device_types(tmp_path):
+    """upsert_node REPLACES the stored doc's devices wholesale, so the
+    live registration must clear omitted types too — otherwise the
+    in-process scheduler keeps allocating devices a bootstrap-replay
+    client cannot see (live-vs-replay divergence on the upsert kind)."""
+    from koordinator_tpu.cmd.binaries import main_koord_scheduler
+
+    asm = main_koord_scheduler([
+        "--node-capacity", "8",
+        "--listen-socket", str(tmp_path / "dev3.sock"),
+        "--disable-leader-election",
+    ])
+    try:
+        inventory = {"gpu": [{"core": 100, "memory": 1 << 14, "group": 0}]}
+        asm.state_sync.upsert_node(
+            "n-up", resource_vector(cpu=8_000, memory=8_192),
+            devices=inventory)
+        manager = asm.component.device_manager
+        assert int(np.asarray(manager.state("gpu").valid).sum()) == 1
+        # a label-only re-upsert omits devices: stored doc now has {},
+        # so live tensors must clear to match what replay would build
+        asm.state_sync.upsert_node(
+            "n-up", resource_vector(cpu=8_000, memory=8_192),
+            labels={"zone": "b"})
+        assert asm.state_sync.nodes["n-up"]["doc"]["devices"] == {}
+        gpu_state = manager.state("gpu")
+        assert gpu_state is None or int(
+            np.asarray(gpu_state.valid).sum()) == 0
+    finally:
+        asm.stop()
+
+
+def test_reset_clears_fine_grained_registries():
+    """Snapshot resync = restart semantics: device tensors and CPU
+    topologies must not survive reset(), or types absent from the
+    replayed snapshot stay live and allocatable."""
+    from koordinator_tpu.ops.numa import CPUTopology
+    from koordinator_tpu.scheduler.cpu_manager import CPUManager
+    from koordinator_tpu.scheduler.device_manager import DeviceManager
+    from koordinator_tpu.scheduler.scheduler import Scheduler
+    from koordinator_tpu.scheduler.snapshot import ClusterSnapshot, NodeSpec
+    from koordinator_tpu.transport.deltasync import SchedulerBinding
+
+    snap = ClusterSnapshot(capacity=8)
+    sched = Scheduler(snap, config=ScoringConfig.default(),
+                      cpu_manager=CPUManager(),
+                      device_manager=DeviceManager())
+    snap.upsert_node(NodeSpec(
+        name="n0",
+        allocatable=np.asarray(resource_vector(cpu=8_000, memory=8_192)),
+        usage=np.zeros(R, np.int32)))
+    sched.device_manager.register_node_devices(
+        "gpu", "n0", [{"core": 100, "memory": 1 << 14}])
+    sched.cpu_manager.register_node(
+        "n0", CPUTopology.uniform(sockets=1, numa_per_socket=1,
+                                  cores_per_numa=4))
+    SchedulerBinding(sched).reset()
+    assert sched.device_manager.state("gpu") is None
+    assert sched.device_manager.registered_types_for("n0") == set()
+    assert sched.cpu_manager.node("n0") is None
+
+
+def test_direct_api_rejects_malformed_device_inventory():
+    """upsert_node / update_node_devices validate inventory shape at the
+    DIRECT API too (the wire push validator does not cover in-process
+    callers): a non-list type value would commit to the log, skip
+    registration on replay, yet count as 'present' for full-inventory
+    clearing — silent live-vs-replay divergence."""
+    from koordinator_tpu.transport.deltasync import StateSyncService
+    from koordinator_tpu.transport.wire import WireSchemaError
+
+    service = StateSyncService()
+    with pytest.raises(WireSchemaError, match="must be a list"):
+        service.upsert_node("n0", resource_vector(cpu=1_000, memory=1_024),
+                            devices={"gpu": "bogus"})
+    service.upsert_node("n0", resource_vector(cpu=1_000, memory=1_024))
+    with pytest.raises(WireSchemaError, match="must be a list"):
+        service.update_node_devices("n0", {"gpu": "bogus"})
+    with pytest.raises(WireSchemaError, match="must be an integer"):
+        service.update_node_devices(
+            "n0", {"gpu": [{"core": "a-hundred"}]})
+    # nothing malformed entered the log: rv is still just the upsert
+    assert service.rv == 1
+
+
+def test_node_upsert_clears_stale_cpu_topology(tmp_path):
+    """The NRT twin of the device-clearing rule: a re-upsert whose
+    annotations no longer carry a cpu-topology must clear the live
+    topology — the stored doc was replaced wholesale, so a replayed
+    client has no topology either."""
+    import json as _json
+
+    from koordinator_tpu.cmd.binaries import main_koord_scheduler
+
+    asm = main_koord_scheduler([
+        "--node-capacity", "8",
+        "--listen-socket", str(tmp_path / "nrt.sock"),
+        "--disable-leader-election",
+    ])
+    try:
+        detail = [{"core": c // 2, "node": 0, "socket": 0, "id": c}
+                  for c in range(4)]
+        asm.state_sync.upsert_node(
+            "n-nrt", resource_vector(cpu=4_000, memory=4_096),
+            annotations={"node.koordinator.sh/cpu-topology":
+                         _json.dumps({"detail": detail})})
+        mgr = asm.component.cpu_manager
+        assert mgr.node("n-nrt") is not None
+        # label-only re-upsert: no NRT annotation -> topology clears
+        asm.state_sync.upsert_node(
+            "n-nrt", resource_vector(cpu=4_000, memory=4_096),
+            labels={"zone": "b"})
+        assert mgr.node("n-nrt") is None
+    finally:
+        asm.stop()
+
+
+def test_unchanged_device_heartbeat_does_not_churn_the_log():
+    """The koordlet sink re-pushes inventory every interval (heartbeat);
+    an UNCHANGED push must not append to the bounded delta log or wake
+    watchers — N nodes heartbeating would shrink retention to ~4096/N
+    intervals and force slow watchers into full resyncs."""
+    from koordinator_tpu.transport.deltasync import StateSyncService
+
+    service = StateSyncService()
+    service.upsert_node("n0", resource_vector(cpu=1_000, memory=1_024))
+    inventory = {"gpu": [{"core": 100, "memory": 1 << 14, "group": 0}]}
+    rv = service.update_node_devices("n0", inventory)
+    assert rv == 2
+    # identical heartbeat: same rv back, nothing committed
+    assert service.update_node_devices("n0", dict(inventory)) == 2
+    assert service.rv == 2
+    # a real change commits again
+    assert service.update_node_devices("n0", {}) == 3
+
+
+def test_node_remove_clears_fine_grained_registries(tmp_path):
+    """NODE_REMOVE takes the node's device tensors and CPU topology with
+    it — a bootstrap-replay client has neither, so live state keeping
+    them would re-create the divergence the upsert/refresh paths fix."""
+    import json as _json
+
+    from koordinator_tpu.cmd.binaries import main_koord_scheduler
+
+    asm = main_koord_scheduler([
+        "--node-capacity", "8",
+        "--listen-socket", str(tmp_path / "rm.sock"),
+        "--disable-leader-election",
+    ])
+    try:
+        detail = [{"core": c, "node": 0, "socket": 0, "id": c}
+                  for c in range(2)]
+        asm.state_sync.upsert_node(
+            "n-rm", resource_vector(cpu=2_000, memory=2_048),
+            annotations={"node.koordinator.sh/cpu-topology":
+                         _json.dumps({"detail": detail})},
+            devices={"gpu": [{"core": 100, "memory": 1 << 14,
+                              "group": 0}]})
+        dm = asm.component.device_manager
+        cm = asm.component.cpu_manager
+        assert int(np.asarray(dm.state("gpu").valid).sum()) == 1
+        assert cm.node("n-rm") is not None
+        asm.state_sync.remove_node("n-rm")
+        gpu_state = dm.state("gpu")
+        assert gpu_state is None or int(
+            np.asarray(gpu_state.valid).sum()) == 0
+        assert dm.registered_types_for("n-rm") in (set(), {"gpu"})
+        assert cm.node("n-rm") is None
+    finally:
+        asm.stop()
