@@ -267,7 +267,6 @@ def _run_child(argv: list[str], timeout: float,
     success, (None, error-tail) otherwise.  One copy of the parse/error
     capture for both the --extra configs and the --cpu-quality sweep."""
     import subprocess
-    import sys
 
     def parse_last_line(stdout: str) -> dict | None:
         # newest complete record wins; scan in reverse because a timeout
@@ -522,8 +521,6 @@ def _cpu_quality_main() -> None:
     from __graft_entry__ import _build_problem
     from koordinator_tpu.ops.batch_assign import batch_assign
 
-    import sys
-
     state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
     valid = int(np.asarray(pods.valid).sum())
     out: dict = {"cpu_quality_shape": f"{N_PODS}p_{N_NODES}n"}
@@ -560,9 +557,6 @@ def _extra_main(name: str) -> None:
 
 
 if __name__ == "__main__":
-    import os
-    import sys
-
     # honor an explicit platform request even under the ambient
     # sitecustomize, which pins the tunnel backend via jax.config (so the
     # env var alone is ignored); lets the extras' child processes — and CPU

@@ -101,6 +101,10 @@ def build_koordlet_parser() -> argparse.ArgumentParser:
     parser.add_argument("--node-name", default="")
     parser.add_argument("--nodemetric-report-interval-seconds", type=float,
                         default=60.0)
+    parser.add_argument(
+        "--device-report-interval-seconds", type=float, default=60.0,
+        help="Device-CR report cadence (the device heartbeat that also "
+             "repairs server-side inventory clears)")
     return parser
 
 
@@ -132,7 +136,9 @@ def main_koordlet(argv: list[str], device_report_fn=None,
                     device_report_fn=device_report_fn,
                     pod_resources_upstream_fn=pod_resources_upstream_fn,
                     informer_sync_interval_seconds=(
-                        args.informer_sync_interval_seconds))
+                        args.informer_sync_interval_seconds),
+                    device_report_interval_seconds=(
+                        args.device_report_interval_seconds))
     if node_info_fn is not None:
         from koordinator_tpu.koordlet.statesinformer import CallbackInformer
 

@@ -112,15 +112,19 @@ class DeviceManager:
                 )
 
     def remove_node(self, name: str) -> None:
-        """Drop one node's inventory rows and allocation records across
-        all types (NODE_REMOVE): registering empty lists instead would
-        leave a permanent zero row per removed node in every type tensor
-        — unbounded growth under node churn."""
+        """Drop one node's inventory rows across all types (NODE_REMOVE):
+        registering empty lists instead would leave a permanent zero row
+        per removed node in every type tensor — unbounded growth under
+        node churn.  Allocation RECORDS stay: a node flap (NODE_REMOVE
+        then re-upsert with devices, e.g. a kubelet restart while pods
+        keep running) must re-commit held devices on the rebuild, or a
+        second pod gets granted devices the first still uses — the same
+        double-grant CPUManager.remove_node stashes orphans against.
+        Records are purged when the pod itself is released (pod_remove
+        reaches release()), so they are bounded by live pods."""
         for dev_type in list(self._raw):
             if self._raw[dev_type].pop(name, None) is not None:
                 self._rebuild_type(dev_type)
-        for key in [k for k in self._allocs if k[1] == name]:
-            del self._allocs[key]
 
     def registered_types_for(self, node: str) -> set[str]:
         """Device types this node has inventory registered under — lets

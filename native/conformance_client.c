@@ -41,7 +41,8 @@
 
 enum {
     F_HELLO = 1, F_SNAPSHOT = 2, F_DELTA = 3, F_ACK = 4, F_ERROR = 5,
-    F_SOLVE_REQUEST = 6, F_SOLVE_RESPONSE = 7, F_PING = 10,
+    F_SOLVE_REQUEST = 6, F_SOLVE_RESPONSE = 7,
+    F_HOOK_REQUEST = 8, F_HOOK_RESPONSE = 9, F_PING = 10,
     F_LEASE_GET = 11, F_LEASE_UPDATE = 12, F_STATE_PUSH = 13,
 };
 
@@ -203,6 +204,24 @@ static char *json_find_object(const char *doc, const char *key) {
     return out;
 }
 
+/* Copy the string value of `key` ("key":"value"), or NULL. */
+static char *json_find_string(const char *doc, const char *key) {
+    const char *p = json_value_of(doc, key);
+    if (!p || *p != '"') return NULL;
+    p++;
+    const char *q = p;
+    while (*q && *q != '"') {
+        if (*q == '\\' && q[1]) q++;
+        q++;
+    }
+    size_t n = (size_t)(q - p);
+    char *out = malloc(n + 1);
+    if (!out) die("oom");
+    memcpy(out, p, n);
+    out[n] = 0;
+    return out;
+}
+
 /* Count `"kind":"..."` occurrences (events in a snapshot/delta doc). */
 static int count_occurrences(const char *doc, const char *needle) {
     int n = 0;
@@ -233,16 +252,84 @@ static int arrays_manifest_ok(const struct frame *f) {
 
 static uint32_t g_req_id = 1;
 
-int main(int argc, char **argv) {
-    if (argc != 3 && argc != 4)
-        die("usage: conformance_client HOST PORT [RESOURCE_DIMS]");
-    if (argc == 4) R_VEC = atoi(argv[3]);
-    if (R_VEC < 2 || R_VEC > 64) die("bad RESOURCE_DIMS");
+/* ---- runtime-hook conformance (--hooks mode) ---------------------------
+ *
+ * Drives the runtime boundary the way a non-Python CRI proxy would
+ * (docs/runtime_boundary.md; the reference's api.proto:148 hook RPCs):
+ * HOOK_REQUEST frames against the koordlet's hook server, asserting the
+ * GroupIdentity bvt resolution and BatchResource kernel-limit math, and
+ * that an unknown hook name errors WITHOUT killing the connection. */
+static int run_hooks_mode(void) {
+    struct frame f;
 
+    /* A. PreRunPodSandbox for a BE pod: GroupIdentity resolves the
+     * best-effort bvt value from the default NodeSLO */
+    const char *sandbox =
+        "{\"hook\":\"PreRunPodSandbox\","
+        "\"pod_meta\":{\"uid\":\"u-c\",\"name\":\"c-be\","
+        "\"namespace\":\"default\"},"
+        "\"labels\":{\"koordinator.sh/qosClass\":\"BE\"},"
+        "\"cgroup_parent\":\"kubepods/besteffort/podu-c\"}";
+    send_frame(F_HOOK_REQUEST, g_req_id, sandbox, NULL, 0);
+    await_reply(g_req_id++, &f);
+    if (f.type != F_HOOK_RESPONSE) die("expected HOOK_RESPONSE (sandbox)");
+    char *bvt = json_find_string(f.json, "cpu.bvt_warp_ns");
+    int bvt_ok = bvt && strcmp(bvt, "-1") == 0;
+    free(bvt);
+    free_frame(&f);
+
+    /* B. PreCreateContainer with batch requests: BatchResource derives
+     * the kernel limits (cfs quota/shares from batch-cpu milli-cores,
+     * memory.limit from batch-memory bytes) */
+    const char *create =
+        "{\"hook\":\"PreCreateContainer\","
+        "\"pod_meta\":{\"uid\":\"u-c\",\"name\":\"c-be\","
+        "\"namespace\":\"default\"},"
+        "\"container_meta\":{\"name\":\"main\",\"id\":\"cc1\"},"
+        "\"labels\":{\"koordinator.sh/qosClass\":\"BE\"},"
+        "\"cgroup_parent\":\"kubepods/besteffort/podu-c\","
+        "\"resources\":{\"kubernetes.io/batch-cpu\":2000,"
+        "\"kubernetes.io/batch-memory\":1073741824}}";
+    send_frame(F_HOOK_REQUEST, g_req_id, create, NULL, 0);
+    await_reply(g_req_id++, &f);
+    if (f.type != F_HOOK_RESPONSE) die("expected HOOK_RESPONSE (create)");
+    char *quota = json_find_string(f.json, "cpu.cfs_quota");
+    char *shares = json_find_string(f.json, "cpu.shares");
+    char *memlim = json_find_string(f.json, "memory.limit");
+    int limits_ok = quota && strcmp(quota, "200000") == 0
+        && shares && strcmp(shares, "2048") == 0
+        && memlim && strcmp(memlim, "1073741824") == 0;
+    free(quota);
+    free(shares);
+    free(memlim);
+    free_frame(&f);
+
+    /* C. unknown hook name -> ERROR frame, connection survives */
+    send_frame(F_HOOK_REQUEST, g_req_id, "{\"hook\":\"NoSuchHook\"}",
+               NULL, 0);
+    await_reply(g_req_id++, &f);
+    int unknown_rejected = (f.type == F_ERROR);
+    free_frame(&f);
+
+    /* D. the rejection did not poison the connection */
+    send_frame(F_HOOK_REQUEST, g_req_id, sandbox, NULL, 0);
+    await_reply(g_req_id++, &f);
+    int survived = (f.type == F_HOOK_RESPONSE);
+    free_frame(&f);
+
+    printf("{\"bvt_ok\":%s,\"limits_ok\":%s,\"unknown_rejected\":%s,"
+           "\"survived\":%s}\n",
+           bvt_ok ? "true" : "false", limits_ok ? "true" : "false",
+           unknown_rejected ? "true" : "false",
+           survived ? "true" : "false");
+    return (bvt_ok && limits_ok && unknown_rejected && survived) ? 0 : 1;
+}
+
+static void connect_to(const char *host, const char *port) {
     struct addrinfo hints = {0}, *res;
     hints.ai_family = AF_INET;
     hints.ai_socktype = SOCK_STREAM;
-    if (getaddrinfo(argv[1], argv[2], &hints, &res) != 0 || !res)
+    if (getaddrinfo(host, port, &hints, &res) != 0 || !res)
         die("resolve failed");
     g_sock = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
     if (g_sock < 0 || connect(g_sock, res->ai_addr, res->ai_addrlen) != 0)
@@ -250,6 +337,21 @@ int main(int argc, char **argv) {
     freeaddrinfo(res);
     struct timeval tv = {30, 0};
     setsockopt(g_sock, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+}
+
+int main(int argc, char **argv) {
+    if (argc >= 2 && strcmp(argv[1], "--hooks") == 0) {
+        if (argc != 4)
+            die("usage: conformance_client --hooks HOST PORT");
+        connect_to(argv[2], argv[3]);
+        return run_hooks_mode();
+    }
+    if (argc != 3 && argc != 4)
+        die("usage: conformance_client HOST PORT [RESOURCE_DIMS]");
+    if (argc == 4) R_VEC = atoi(argv[3]);
+    if (R_VEC < 2 || R_VEC > 64) die("bad RESOURCE_DIMS");
+
+    connect_to(argv[1], argv[2]);
 
     struct frame f;
 

@@ -87,3 +87,32 @@ def test_c_client_full_protocol(client_bin):
         assert "c-pod" not in sched.pending
     finally:
         asm.stop()
+
+
+def test_c_client_drives_runtime_hooks(client_bin, tmp_path):
+    """The runtime boundary spoken by a non-Python peer: the C client
+    plays the CRI-proxy role against the koordlet BINARY's hook server
+    (--runtime-hook-server-addr), asserting GroupIdentity's BE bvt
+    resolution, BatchResource's kernel-limit math, and that an unknown
+    hook errors without killing the connection — the other half of the
+    docs/runtime_boundary.md bespoke-frames contract."""
+    from koordinator_tpu.cmd.binaries import main_koordlet
+
+    asm = main_koordlet([
+        "--cgroup-root-dir", str(tmp_path / "cg"),
+        "--proc-root-dir", str(tmp_path / "proc"),
+        "--runtime-hook-server-addr", "tcp://127.0.0.1:0",
+    ])
+    try:
+        port = asm.component.hook_server.address.rsplit(":", 1)[1]
+        proc = subprocess.run(
+            [client_bin, "--hooks", "127.0.0.1", port],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, (
+            f"C hooks client failed (stderr):\n{proc.stderr}\n"
+            f"stdout:\n{proc.stdout}")
+        result = json.loads(proc.stdout)
+        assert result == {"bvt_ok": True, "limits_ok": True,
+                          "unknown_rejected": True, "survived": True}
+    finally:
+        asm.component.stop()

@@ -275,12 +275,12 @@ def test_device_inventory_loop_over_the_wire(tmp_path):
             "--sys-root-dir", cfg.sys_root,
             "--scheduler-sidecar-addr", str(tmp_path / "devloop.sock"),
             "--node-name", "n-dev",
+            "--device-report-interval-seconds", "0",
         ])
         daemon = koordlet_asm.component
         from koordinator_tpu.koordlet.statesinformer import NodeInfo
 
         daemon.states.set_node(NodeInfo(name="n-dev", allocatable={}))
-        daemon.device_report_interval_seconds = 0.0
         manager = sched_asm.component.device_manager
 
         def live_gpus():

@@ -1034,3 +1034,28 @@ def test_overuse_revoke_skips_uncurable_quota_with_blocked_pod():
     sched.schedule_round()
     assert revoked == []                  # no pointless collateral eviction
     assert {"a-big", "a-small"} <= set(sched.bound)
+
+
+def test_node_flap_preserves_device_grants():
+    """A node flap (NODE_REMOVE then re-upsert with the same inventory,
+    e.g. a kubelet restart while pods keep running) must not free
+    devices a bound pod still holds: records survive the removal and
+    re-commit on the rebuild, so a second pod cannot be granted them."""
+    from koordinator_tpu.scheduler.device_manager import DeviceManager
+
+    dm = DeviceManager()
+    inv = [{"core": 100, "memory": 0, "group": 0} for _ in range(2)]
+    dm.register_node_devices("gpu", "n0", inv)
+    assert dm.allocate("gpu", "n0", "p", core=200) == [0, 1]
+    dm.remove_node("n0")
+    assert dm.state("gpu") is None          # inventory rows gone
+    dm.register_node_devices("gpu", "n0", inv)
+    # held devices re-committed: the flap cannot double-grant
+    assert dm.allocate("gpu", "n0", "q", core=200) is None
+    ann = dm.device_allocated_annotation("n0", "p")
+    assert sorted(g["minor"] for g in ann["gpu"]) == [0, 1]
+    # pod release purges the record even while the node is absent
+    dm.remove_node("n0")
+    dm.release("n0", "p")
+    dm.register_node_devices("gpu", "n0", inv)
+    assert dm.allocate("gpu", "n0", "q", core=200) == [0, 1]

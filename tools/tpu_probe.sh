@@ -13,6 +13,9 @@ if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
     exit 0
 fi
 echo $$ > "$PIDFILE"
+# clean up on ANY exit: a stale pidfile whose PID gets recycled by an
+# unrelated process would silently block every future probe run
+trap 'rm -f "$PIDFILE"' EXIT
 OUT=/root/repo/probe_results
 mkdir -p "$OUT"
 [ -f "$OUT/CAPTURED" ] && exit 0
