@@ -29,3 +29,39 @@ def test_stage_profiler_smoke():
         assert by_stage[name]["ms_per_iter"] > 0, by_stage[name]
     # the rounds stage really assigned pods (256 pods, ample capacity)
     assert by_stage["rounds"]["assigned_per_iter"] > 0
+
+
+def test_latest_probe_capture_selection(tmp_path):
+    """The zero-record path promotes the prober's newest nonzero capture
+    for the CURRENT metric only — zero records, wrong shapes, and
+    garbage files are skipped."""
+    sys.path.insert(0, REPO)
+    from bench import _latest_probe_capture
+
+    d = tmp_path / "probe_results"
+    d.mkdir()
+    assert _latest_probe_capture(str(d)) is None
+    (d / "bench_1.json").write_text(
+        '{"metric": "solve_pods_per_sec_50000p_10240n", "value": 0.0}')
+    (d / "bench_2.json").write_text("not json at all")
+    (d / "bench_3.json").write_text(
+        '{"metric": "solve_pods_per_sec_10p_10n", "value": 99.0}')
+    assert _latest_probe_capture(str(d)) is None
+    (d / "bench_4.json").write_text(
+        '{"metric": "solve_pods_per_sec_50000p_10240n", "value": 250001.5,'
+        ' "unit": "pods/s", "vs_baseline": 1.0}')
+    (d / "bench_5.json").write_text(
+        '{"metric": "solve_pods_per_sec_50000p_10240n", "value": 260000.0,'
+        ' "unit": "pods/s", "vs_baseline": 1.04}')
+    doc, source = _latest_probe_capture(str(d))
+    assert source == "bench_5.json" and doc["value"] == 260000.0
+    # captures older than ~a round (12h by mtime) are from a PREVIOUS
+    # round and must not be re-reported as this round's measurement
+    import time as _time
+
+    old = _time.time() - 13 * 3600
+    os.utime(d / "bench_5.json", (old, old))
+    doc, source = _latest_probe_capture(str(d))
+    assert source == "bench_4.json"
+    os.utime(d / "bench_4.json", (old, old))
+    assert _latest_probe_capture(str(d)) is None
