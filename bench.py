@@ -360,7 +360,12 @@ def _emit_zero_record(extra: dict,
         # caller hit an error that MIGHT be the tunnel dying mid-run —
         # a fresh probe decides (60s: enough for a healthy tunnel)
         device_down = not _device_alive(60.0)[0]
-    captured = _latest_probe_capture() if device_down else None
+    # the prober's own bench runs want a FRESH measurement or a zero
+    # that keeps the hunt alive — never a promoted old capture (which
+    # would also make the prober mark the round as captured)
+    promotion_ok = not os.environ.get("KOORD_BENCH_NO_PROBE_PROMOTION")
+    captured = (_latest_probe_capture()
+                if device_down and promotion_ok else None)
     if captured is not None:
         doc, source = captured
         doc.setdefault("extra", {})["probe_capture"] = {
@@ -426,7 +431,14 @@ def _latest_probe_capture(root: str | None = None) -> tuple[dict, str] | None:
             continue
         if (isinstance(doc, dict) and doc.get("metric") == metric
                 and isinstance(doc.get("value"), (int, float))
-                and doc["value"] > 0):
+                and doc["value"] > 0
+                # a record that is ITSELF a promotion (the prober ran
+                # bench.py while the tunnel was flapping and captured a
+                # re-emitted old record) must not count as a fresh
+                # measurement: accepting it would refresh the stale
+                # capture's age window on every promotion, laundering
+                # one old measurement into every future round
+                and "probe_capture" not in (doc.get("extra") or {})):
             return doc, os.path.basename(path)
     return None
 

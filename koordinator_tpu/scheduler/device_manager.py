@@ -67,6 +67,19 @@ class DeviceManager:
         raw[node] = list(devices)
         self._rebuild_type(device_type)
 
+    def deregister_node_devices(self, device_type: str, node: str) -> None:
+        """Remove one node's row for a type entirely (the type vanished
+        from the node's full inventory).  POPPING rather than storing an
+        empty list keeps live state identical to what bootstrap replay
+        builds — a replayed doc without the type registers nothing, so
+        the live side must hold nothing (tested by the randomized
+        live-vs-replay parity suite)."""
+        raw = self._raw.get(device_type)
+        if raw is None or node not in raw:
+            return
+        raw.pop(node)
+        self._rebuild_type(device_type)
+
     @staticmethod
     def _live_minors(a: DeviceAllocation, dev, row: int) -> list[int]:
         """The subset of a record's minors present in the CURRENT

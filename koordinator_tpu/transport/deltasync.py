@@ -739,7 +739,7 @@ class SchedulerBinding:
                 manager.register_node_devices(dev_type, name, inventory)
         if full_inventory:
             for gone in manager.registered_types_for(name) - set(devices):
-                manager.register_node_devices(gone, name, [])
+                manager.deregister_node_devices(gone, name)
 
     def node_devices(self, entry: dict) -> None:
         """Device-inventory refresh: re-register the node's per-type

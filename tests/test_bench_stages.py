@@ -65,3 +65,11 @@ def test_latest_probe_capture_selection(tmp_path):
     assert source == "bench_4.json"
     os.utime(d / "bench_4.json", (old, old))
     assert _latest_probe_capture(str(d)) is None
+    # a record that is itself a promotion must never count as a fresh
+    # capture — accepting it would launder one stale measurement into
+    # every future round via its refreshed mtime
+    (d / "bench_6.json").write_text(
+        '{"metric": "solve_pods_per_sec_50000p_10240n", "value": 270000.0,'
+        ' "unit": "pods/s", "vs_baseline": 1.08,'
+        ' "extra": {"probe_capture": {"source": "bench_4.json"}}}')
+    assert _latest_probe_capture(str(d)) is None

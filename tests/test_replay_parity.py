@@ -1,0 +1,131 @@
+"""Live-vs-replay parity, randomized.
+
+Every round of review on the device-inventory wire loop found another
+way for the IN-PROCESS scheduler's fine-grained registries (device
+tensors, CPU topologies) to drift from what a bootstrap-replay client
+would build — omitted-devices upserts, NODE_REMOVE, annotation loss,
+resync.  This pins the invariant wholesale: apply a random event
+sequence live, then bootstrap a FRESH scheduler over the real wire
+path, and require identical registries and node sets.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.resources import resource_vector
+from koordinator_tpu.transport import RpcClient, RpcServer
+from koordinator_tpu.transport.deltasync import (
+    SchedulerBinding,
+    StateSyncClient,
+    StateSyncService,
+)
+
+
+def _mk_sched():
+    from koordinator_tpu.ops.assignment import ScoringConfig
+    from koordinator_tpu.scheduler.cpu_manager import CPUManager
+    from koordinator_tpu.scheduler.device_manager import DeviceManager
+    from koordinator_tpu.scheduler.scheduler import Scheduler
+    from koordinator_tpu.scheduler.snapshot import ClusterSnapshot
+
+    snap = ClusterSnapshot(capacity=16)
+    return Scheduler(snap, config=ScoringConfig.default(),
+                     cpu_manager=CPUManager(),
+                     device_manager=DeviceManager())
+
+
+def _fingerprint(sched):
+    """Registry state that must be identical live vs replayed: raw
+    device inventory per type, CPU topology presence/shape per node,
+    and the snapshot's node set."""
+    dm, cm = sched.device_manager, sched.cpu_manager
+    dev = {t: dict(sorted(raw.items()))
+           for t, raw in sorted(dm._raw.items())}
+    topo = {n: np.asarray(st.topology.core_of).tolist()
+            for n, st in sorted(cm._nodes.items())}
+    rsv = sorted((s.name, s.requests.tolist(), s.allocate_once)
+                 for s in sched.reservations.specs())
+    return dev, topo, sorted(sched.snapshot.node_index), rsv
+
+
+def _nrt(cores: int) -> dict:
+    detail = [{"core": c // 2, "node": 0, "socket": 0, "id": c}
+              for c in range(cores)]
+    return {"node.koordinator.sh/cpu-topology":
+            json.dumps({"detail": detail})}
+
+
+@pytest.mark.parametrize("seed", list(range(8)))
+def test_random_event_sequences_replay_identically(seed):
+    rng = np.random.default_rng(seed)
+    live = _mk_sched()
+    service = StateSyncService()
+    service.attach_binding(SchedulerBinding(live))
+
+    known: set[str] = set()
+    rsv_known: set[str] = set()
+    pod_seq = 0
+    for _ in range(120):
+        op = int(rng.integers(0, 12))
+        name = f"n{int(rng.integers(0, 6))}"
+        if op <= 4:
+            # upsert with randomly present/absent devices + NRT
+            # annotation — the doc replaces stored state wholesale, so
+            # omission must CLEAR live registries
+            kw = {}
+            if rng.random() < 0.5:
+                count = int(rng.integers(1, 4))
+                kw["devices"] = {"gpu": [
+                    {"core": 100, "memory": 1 << 10, "group": 0}
+                ] * count}
+            if rng.random() < 0.5:
+                kw["annotations"] = _nrt(int(rng.integers(2, 6)) * 2)
+            service.upsert_node(
+                name, resource_vector(cpu=8_000, memory=8_192), **kw)
+            known.add(name)
+        elif op <= 6 and known:
+            target = sorted(known)[int(rng.integers(0, len(known)))]
+            devices = ({} if rng.random() < 0.3 else
+                       {"xpu": [{"core": 50, "memory": 1 << 9, "group": 0}]
+                        * int(rng.integers(1, 3))})
+            service.update_node_devices(target, devices)
+        elif op <= 8 and known:
+            target = sorted(known)[int(rng.integers(0, len(known)))]
+            service.remove_node(target)
+            known.discard(target)
+        elif op == 9:
+            service.add_pod(f"p{pod_seq}",
+                            resource_vector(cpu=100, memory=64))
+            pod_seq += 1
+        elif op == 10:
+            rname = f"r{int(rng.integers(0, 4))}"
+            service.upsert_reservation(
+                rname, resource_vector(cpu=500, memory=256),
+                allocate_once=bool(rng.random() < 0.5),
+                owners=[{"labels": {"app": rname}}])
+            rsv_known.add(rname)
+        elif rsv_known:
+            target = sorted(rsv_known)[int(rng.integers(0, len(rsv_known)))]
+            service.remove_reservation(target)
+            rsv_known.discard(target)
+
+    replay = _mk_sched()
+    server = RpcServer("tcp://127.0.0.1:0")
+    service.attach(server)
+    server.start()
+    try:
+        sync = StateSyncClient(SchedulerBinding(replay))
+        client = RpcClient(server.address, on_push=sync.on_push)
+        client.connect()
+        try:
+            sync.bootstrap(client)
+            assert sync.rv == service.rv
+            assert _fingerprint(replay) == _fingerprint(live), (
+                f"seed {seed}: live and bootstrap-replay registries "
+                f"diverged")
+        finally:
+            client.close()
+    finally:
+        server.stop()

@@ -36,8 +36,12 @@ assert float(jax.jit(lambda a: (a @ a).sum())(x)) == 256.0 * 256 * 256' \
             >/dev/null 2>&1; then
         ts=$(date +%Y%m%d_%H%M%S)
         echo "$(date -Is) tunnel up, capturing" >> "$OUT/probe.log"
-        KOORD_BENCH_PROBE_TRIES=1 timeout 3600 \
-            python /root/repo/bench.py \
+        # NO_PROBE_PROMOTION: this run must produce a FRESH measurement
+        # or a zero that keeps the hunt alive — a promoted old capture
+        # here would satisfy the nonzero grep below and end the hunt
+        # without any new hardware evidence
+        KOORD_BENCH_PROBE_TRIES=1 KOORD_BENCH_NO_PROBE_PROMOTION=1 \
+            timeout 3600 python /root/repo/bench.py \
             > "$OUT/bench_$ts.json" 2> "$OUT/bench_$ts.err"
         timeout 1800 python /root/repo/bench_stages.py \
             > "$OUT/stages_$ts.jsonl" 2> "$OUT/stages_$ts.err"
