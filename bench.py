@@ -363,7 +363,8 @@ def _emit_zero_record(extra: dict,
     # the prober's own bench runs want a FRESH measurement or a zero
     # that keeps the hunt alive — never a promoted old capture (which
     # would also make the prober mark the round as captured)
-    promotion_ok = not os.environ.get("KOORD_BENCH_NO_PROBE_PROMOTION")
+    promotion_ok = os.environ.get(
+        "KOORD_BENCH_NO_PROBE_PROMOTION", "").lower() in ("", "0", "false")
     captured = (_latest_probe_capture()
                 if device_down and promotion_ok else None)
     if captured is not None:

@@ -136,8 +136,7 @@ class DeviceManager:
         Records are purged when the pod itself is released (pod_remove
         reaches release()), so they are bounded by live pods."""
         for dev_type in list(self._raw):
-            if self._raw[dev_type].pop(name, None) is not None:
-                self._rebuild_type(dev_type)
+            self.deregister_node_devices(dev_type, name)
 
     def registered_types_for(self, node: str) -> set[str]:
         """Device types this node has inventory registered under — lets
