@@ -129,3 +129,71 @@ def test_random_event_sequences_replay_identically(seed):
             client.close()
     finally:
         server.stop()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_fallen_behind_client_resyncs_to_parity(seed):
+    """The OTHER replay entry point: a client that connected early,
+    disconnected, and fell behind the bounded delta-log retention gets a
+    full snapshot on reconnect — SchedulerBinding.reset() + replay must
+    land on the same registries as the live applier, including clearing
+    everything the early events had registered (reset() wiping the
+    device/CPU registries was one of this round's fixed bugs)."""
+    rng = np.random.default_rng(1000 + seed)
+    live = _mk_sched()
+    service = StateSyncService(retention=16)
+    service.attach_binding(SchedulerBinding(live))
+
+    server = RpcServer("tcp://127.0.0.1:0")
+    service.attach(server)
+    server.start()
+    try:
+        # early client: sees the first few events, then disconnects
+        replay = _mk_sched()
+        sync = StateSyncClient(SchedulerBinding(replay))
+        # seed some state BEFORE the client joins, including registries
+        # the later walk may remove entirely
+        service.upsert_node("n0", resource_vector(cpu=8_000, memory=8_192),
+                            devices={"gpu": [{"core": 100,
+                                              "memory": 1 << 10,
+                                              "group": 0}]},
+                            annotations=_nrt(4))
+        client = RpcClient(server.address, on_push=sync.on_push)
+        client.connect()
+        sync.bootstrap(client)
+        client.close()            # misses everything from here on
+
+        known = {"n0"}
+        for _ in range(60):       # >> retention=16: forces ResyncRequired
+            op = int(rng.integers(0, 10))
+            name = f"n{int(rng.integers(0, 4))}"
+            if op <= 5:
+                kw = {}
+                if rng.random() < 0.5:
+                    kw["devices"] = {"gpu": [
+                        {"core": 100, "memory": 1 << 10, "group": 0}]}
+                if rng.random() < 0.5:
+                    kw["annotations"] = _nrt(4)
+                service.upsert_node(
+                    name, resource_vector(cpu=8_000, memory=8_192), **kw)
+                known.add(name)
+            elif op <= 7 and known:
+                target = sorted(known)[int(rng.integers(0, len(known)))]
+                service.remove_node(target)
+                known.discard(target)
+            elif name in known:
+                service.update_node_devices(
+                    name, {"xpu": [{"core": 50, "memory": 1 << 9,
+                                    "group": 0}]})
+
+        client2 = RpcClient(server.address, on_push=sync.on_push)
+        client2.connect()
+        try:
+            sync.bootstrap(client2)   # behind retention -> full snapshot
+            assert sync.rv == service.rv
+            assert _fingerprint(replay) == _fingerprint(live), (
+                f"seed {seed}: resync replay diverged from live")
+        finally:
+            client2.close()
+    finally:
+        server.stop()
