@@ -1,0 +1,96 @@
+"""Randomized invariants of the quota tree's fair-share runtime.
+
+The example tests in test_quota.py pin the reference formulas
+(runtime_quota_calculator.go water-filling + Hamilton apportionment) at
+hand-built shapes; this sweeps random hierarchical trees and asserts
+the structural invariants that must hold for ANY input where mins are
+not oversubscribed:
+
+  (bound)     runtime <= max on bounded dims
+  (floor)     runtime >= min(min, limited_request) — a quota never gets
+              less than the smaller of its guaranteed min and what it
+              asked for
+  (conserve)  sum(children runtime) <= parent pool, per dim
+  (work)      if any positive-weight child is still hungry
+              (runtime < limited_request), the parent pool is fully
+              distributed — water-filling never strands headroom while
+              someone wants it
+"""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+from koordinator_tpu.quota.tree import ROOT, UNBOUNDED, QuotaTree
+
+R = NUM_RESOURCE_DIMS
+
+
+def _random_tree(rng: np.random.Generator) -> QuotaTree:
+    total = rng.integers(10_000, 1_000_000, R).astype(np.int64)
+    tree = QuotaTree(total_resource=total)
+    n_parents = int(rng.integers(1, 4))
+    parents = []
+    # keep sum(min) under the parent pool at every level: min
+    # oversubscription is legal input (cluster shrank) but suspends the
+    # conservation invariant by design (scale-min is the opt-in fix)
+    parent_min_budget = total // (2 * max(n_parents, 1))
+    for p in range(n_parents):
+        name = f"team{p}"
+        mn = (parent_min_budget * rng.random(R)).astype(np.int64)
+        mx = np.where(rng.random(R) < 0.3, UNBOUNDED,
+                      rng.integers(1, 2_000_000, R)).astype(np.int64)
+        mx = np.where((mx != UNBOUNDED) & (mx < mn), mn, mx)
+        tree.add(name, min=mn, max=mx)
+        parents.append(name)
+        n_kids = int(rng.integers(0, 4))
+        kid_budget = mn // (2 * max(n_kids, 1) + 1)
+        for k in range(n_kids):
+            kmn = (kid_budget * rng.random(R)).astype(np.int64)
+            kmx = np.where(rng.random(R) < 0.3, UNBOUNDED,
+                           rng.integers(1, 2_000_000, R)).astype(np.int64)
+            kmx = np.where((kmx != UNBOUNDED) & (kmx < kmn), kmn, kmx)
+            tree.add(f"{name}-sub{k}", parent=name, min=kmn, max=kmx)
+    # leaves get random requests (pods); internal nodes aggregate
+    for name, node in tree.nodes.items():
+        if name != ROOT and not tree.children.get(name):
+            tree.set_request(
+                name, rng.integers(0, 500_000, R).astype(np.int64))
+    return tree
+
+
+@pytest.mark.parametrize("seed", list(range(16)))
+def test_runtime_invariants_hold_on_random_trees(seed):
+    rng = np.random.default_rng(seed)
+    tree = _random_tree(rng)
+    tree.refresh_runtime()
+
+    for parent, kids in tree.children.items():
+        if not kids:
+            continue
+        pool = (tree.total_resource if parent == ROOT
+                else tree.nodes[parent].runtime)
+        kid_sum = np.zeros(R, np.int64)
+        hungry_weight = np.zeros(R, np.int64)
+        for kid in kids:
+            node = tree.nodes[kid]
+            rt = node.runtime
+            assert (rt >= 0).all(), (seed, kid)
+            bounded = node.max != UNBOUNDED
+            assert (rt[bounded] <= node.max[bounded]).all(), (
+                f"seed {seed}: {kid} runtime exceeds max")
+            floor = np.minimum(node.min, node.limited_request)
+            assert (rt >= floor).all(), (
+                f"seed {seed}: {kid} runtime {rt} below floor {floor}")
+            kid_sum += rt
+            hungry = rt < node.limited_request
+            hungry_weight += np.where(hungry, node.shared_weight, 0)
+        assert (kid_sum <= pool).all(), (
+            f"seed {seed}: children of {parent} oversubscribe the pool")
+        # work conservation: headroom may remain only on dims where no
+        # positive-weight child is still hungry
+        headroom = pool - kid_sum
+        strandable = (headroom > 0) & (hungry_weight > 0)
+        assert not strandable.any(), (
+            f"seed {seed}: {parent} stranded headroom {headroom} with "
+            f"hungry children")

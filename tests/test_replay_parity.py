@@ -186,6 +186,15 @@ def test_fallen_behind_client_resyncs_to_parity(seed):
                     name, {"xpu": [{"core": 50, "memory": 1 << 9,
                                     "group": 0}]})
 
+        # n0 — the node the early client registered devices + topology
+        # for — must end ABSENT: a final-snapshot upsert of n0 would
+        # repair stale registries via the full-inventory path, masking
+        # a reset() that failed to clear them (mutation-verified: with
+        # the clear() calls deleted, the test only fails because of
+        # this removal)
+        if "n0" in known:
+            service.remove_node("n0")
+
         client2 = RpcClient(server.address, on_push=sync.on_push)
         client2.connect()
         try:
