@@ -1,0 +1,142 @@
+"""Randomized invariants of preemption victim selection.
+
+test_preemption.py pins the reference scenarios at hand-built shapes;
+this sweeps random clusters and asserts the structural contract of
+``select_victims``/``pick_node`` for ANY input:
+
+  (candidates) victims are always valid, strictly lower priority,
+               preemptible, scheduled — and same-quota when required
+  (soundness)  an eligible node really fits the preemptor once its
+               victims leave
+  (complete)   an ineligible node with candidates could not have been
+               rescued even by evicting every candidate on it
+  (minimal)    no single victim on an eligible node could be reprieved
+               without breaking the preemptor's fit (the reprieve
+               loop's guarantee)
+  (pick)       pick_node matches the documented lexicographic rule
+               (violations, max victim pri, pri sum, victim count,
+               lowest row), recomputed independently in numpy
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+from koordinator_tpu.ops.preemption import (
+    ScheduledPods,
+    pick_node,
+    select_victims,
+)
+from koordinator_tpu.state.cluster_state import ClusterState
+
+R = NUM_RESOURCE_DIMS
+CPU, MEM = ResourceDim.CPU, ResourceDim.MEMORY
+
+
+def _random_problem(rng: np.random.Generator):
+    n_nodes = int(rng.integers(2, 9))
+    n_pods = int(rng.integers(4, 40))
+    alloc = np.zeros((n_nodes, R), np.int32)
+    alloc[:, CPU] = rng.integers(4_000, 16_000, n_nodes)
+    alloc[:, MEM] = rng.integers(8_192, 65_536, n_nodes)
+
+    req = np.zeros((n_pods, R), np.int32)
+    req[:, CPU] = rng.integers(100, 4_000, n_pods)
+    req[:, MEM] = rng.integers(128, 8_192, n_pods)
+    nodes = rng.integers(0, n_nodes, n_pods).astype(np.int32)
+    pris = rng.integers(3_000, 10_000, n_pods).astype(np.int32)
+    nonpre = rng.random(n_pods) < 0.2
+    quotas = rng.integers(-1, 3, n_pods).astype(np.int32)
+
+    requested = np.zeros((n_nodes, R), np.int32)
+    np.add.at(requested, nodes, req)
+    # leave some ambient headroom variance
+    requested = np.minimum(requested, alloc)
+    state = ClusterState.from_arrays(alloc, requested=requested,
+                                    capacity=n_nodes)
+    sched = ScheduledPods.build(
+        req, np.asarray(nodes), priority=pris,
+        non_preemptible=nonpre, quota_id=quotas)
+
+    p_req = np.zeros(R, np.int32)
+    p_req[CPU] = rng.integers(2_000, 12_000)
+    p_req[MEM] = rng.integers(1_024, 32_768)
+    p_pri = int(rng.integers(4_000, 11_000))
+    p_quota = int(rng.integers(-1, 3))
+    same_quota = bool(rng.random() < 0.5)
+    return state, sched, p_req, p_pri, p_quota, same_quota
+
+
+def _fits_np(req, free):
+    return (free >= req).all(axis=-1)
+
+
+@pytest.mark.parametrize("seed", list(range(20)))
+def test_select_victims_invariants(seed):
+    rng = np.random.default_rng(seed)
+    state, sched, p_req, p_pri, p_quota, same_quota = _random_problem(rng)
+    n_nodes = state.capacity
+    feasible = jnp.ones(n_nodes, bool)
+    pdb_allowed = jnp.full(1, 10_000, jnp.int32)   # PDBs never bind here
+
+    solve = select_victims(
+        state, sched, jnp.asarray(p_req), jnp.int32(p_pri),
+        jnp.int32(p_quota), feasible, pdb_allowed,
+        same_quota_only=same_quota)
+
+    victim = np.asarray(solve.victim)
+    eligible = np.asarray(solve.eligible)
+    valid = np.asarray(sched.valid)
+    pris = np.asarray(sched.priority)
+    nonpre = np.asarray(sched.non_preemptible)
+    nodes = np.asarray(sched.node)
+    quotas = np.asarray(sched.quota_id)
+    reqs = np.asarray(sched.requests)
+    free = np.asarray(state.node_allocatable) - np.asarray(
+        state.node_requested)
+
+    cand = valid & (pris < p_pri) & ~nonpre & (nodes >= 0)
+    if same_quota:
+        cand &= quotas == p_quota
+
+    # (candidates) victims only come from the candidate set
+    assert not (victim & ~cand).any(), f"seed {seed}: non-candidate victim"
+
+    freed = np.zeros((n_nodes, R), np.int64)
+    np.add.at(freed, nodes[victim], reqs[victim])
+    all_cand_freed = np.zeros((n_nodes, R), np.int64)
+    np.add.at(all_cand_freed, nodes[cand], reqs[cand])
+    has_cand = np.zeros(n_nodes, bool)
+    has_cand[nodes[cand]] = True
+
+    for n in range(n_nodes):
+        free_after = free[n] + freed[n]
+        if eligible[n]:
+            # (soundness) preemptor fits once the victims leave
+            assert _fits_np(p_req, free_after), (
+                f"seed {seed}: eligible node {n} does not fit")
+            # (minimal) reprieving any single victim breaks the fit
+            for v in np.flatnonzero(victim & (nodes == n)):
+                assert not _fits_np(p_req, free_after - reqs[v]), (
+                    f"seed {seed}: victim {v} on node {n} was reprievable")
+        elif has_cand[n]:
+            # (complete) even evicting every candidate would not help
+            assert not _fits_np(p_req, free[n] + all_cand_freed[n]), (
+                f"seed {seed}: node {n} ineligible but rescuable")
+
+    # (pick) lexicographic oracle over eligible nodes
+    chosen = int(pick_node(solve))
+    if not eligible.any():
+        assert chosen == -1
+    else:
+        keys = list(zip(
+            np.asarray(solve.num_violating).tolist(),
+            np.asarray(solve.max_victim_pri).tolist(),
+            np.asarray(solve.sum_victim_pri).tolist(),
+            np.asarray(solve.num_victims).tolist(),
+            range(n_nodes),
+        ))
+        best = min(k for n, k in zip(range(n_nodes), keys) if eligible[n])
+        assert chosen == best[4], (
+            f"seed {seed}: pick_node chose {chosen}, oracle {best[4]}")
