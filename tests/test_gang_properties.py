@@ -1,0 +1,113 @@
+"""Randomized invariants of gang (all-or-nothing) assignment.
+
+test_gang.py pins the coscheduling scenarios at hand-built shapes; this
+sweeps random clusters, gang structures, and both solver engines,
+asserting the contract for ANY input:
+
+  (atomic)   a valid gang places either >= min_member pods or none
+  (group)    gangs sharing a gang-group live or die together: if any
+             valid gang in a group missed its min, every gang in that
+             group places nothing
+  (enqueue)  a gang with fewer pending members than min_member never
+             places anything (PreEnqueue parity)
+  (capacity) node_requested never exceeds allocatable
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+from koordinator_tpu.ops.assignment import ScoringConfig
+from koordinator_tpu.ops.gang import GangInfo, gang_assign
+from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
+
+R = NUM_RESOURCE_DIMS
+CPU, MEM = ResourceDim.CPU, ResourceDim.MEMORY
+
+
+def _plain_cfg():
+    return ScoringConfig.default().replace(
+        usage_thresholds=jnp.zeros(R, jnp.int32),
+        estimator_defaults=jnp.zeros(R, jnp.int32))
+
+
+def _random_problem(rng: np.random.Generator):
+    n_nodes = int(rng.integers(2, 8))
+    alloc = np.zeros((n_nodes, R), np.int32)
+    # tight-ish capacity so some gangs genuinely fail
+    alloc[:, CPU] = rng.integers(2_000, 10_000, n_nodes)
+    alloc[:, MEM] = rng.integers(4_096, 32_768, n_nodes)
+    state = ClusterState.from_arrays(alloc, capacity=n_nodes)
+
+    n_gangs = int(rng.integers(1, 5))
+    members = rng.integers(1, 6, n_gangs)
+    # min_member sometimes above the actual member count (gang can
+    # never be ready) and sometimes below (surplus members)
+    min_member = np.maximum(
+        1, members + rng.integers(-2, 3, n_gangs)).astype(np.int32)
+    group_id = rng.integers(0, max(1, n_gangs - 1),
+                            n_gangs).astype(np.int32)
+    gangs = GangInfo.build(min_member, group_id=group_id)
+
+    n_loose = int(rng.integers(0, 6))
+    n_pods = int(members.sum()) + n_loose
+    req = np.zeros((n_pods, R), np.int32)
+    req[:, CPU] = rng.integers(200, 3_000, n_pods)
+    req[:, MEM] = rng.integers(128, 4_096, n_pods)
+    gang_ids = np.full(n_pods, -1, np.int32)
+    i = 0
+    for g, m in enumerate(members):
+        gang_ids[i:i + m] = g
+        i += m
+    pris = rng.integers(3_000, 10_000, n_pods).astype(np.int32)
+    pods = PodBatch.build(req, priority=pris, gang_id=gang_ids,
+                          node_capacity=n_nodes)
+    return state, pods, gangs, members
+
+
+@pytest.mark.parametrize("seed", list(range(12)))
+@pytest.mark.parametrize("solver", ["greedy", "batch"])
+def test_gang_invariants(seed, solver):
+    rng = np.random.default_rng(seed)
+    state, pods, gangs, members = _random_problem(rng)
+
+    asn, st, _ = gang_assign(state, pods, _plain_cfg(), gangs,
+                             passes=2, solver=solver)
+    asn = np.asarray(asn)
+    valid = np.asarray(pods.valid)
+    gang_ids = np.asarray(pods.gang_id)
+    placed = (asn >= 0) & valid
+
+    # (capacity)
+    assert (np.asarray(st.node_requested)
+            <= np.asarray(st.node_allocatable)).all(), f"seed {seed}"
+
+    mm = np.asarray(gangs.min_member)
+    gvalid = np.asarray(gangs.valid)
+    groups = np.asarray(gangs.group_id)
+    pending = np.bincount(gang_ids[valid & (gang_ids >= 0)],
+                          minlength=gangs.capacity)
+    counts = np.bincount(gang_ids[placed & (gang_ids >= 0)],
+                         minlength=gangs.capacity)
+
+    for g in range(gangs.capacity):
+        if not gvalid[g]:
+            continue
+        # (atomic)
+        assert counts[g] == 0 or counts[g] >= mm[g], (
+            f"seed {seed} {solver}: gang {g} placed {counts[g]} "
+            f"< min {mm[g]}")
+        # (enqueue)
+        if pending[g] < mm[g]:
+            assert counts[g] == 0, (
+                f"seed {seed} {solver}: unready gang {g} placed pods")
+
+    # (group): any missed gang zeroes its whole group
+    satisfied = counts >= mm
+    for grp in np.unique(groups[gvalid]):
+        in_group = gvalid & (groups == grp)
+        if (~satisfied & in_group).any():
+            assert counts[in_group].sum() == 0, (
+                f"seed {seed} {solver}: group {grp} partially placed "
+                f"{counts[in_group]}")
