@@ -1,0 +1,101 @@
+"""Randomized invariants of the cpuset accumulator (take_cpus).
+
+test_numa.py pins the reference scenarios (cpu_accumulator.go policies)
+at hand-built topologies; this sweeps random topologies, ref-counts,
+and bans across every bind policy and strategy:
+
+  (count)    ok => exactly n_cpus selected (FullPCPUs: rounded up to
+             whole cores); !ok => nothing selected
+  (legal)    selected CPUs are valid, under max_ref, and never banned
+  (cores)    FullPCPUs selects only whole, fully-free physical cores
+  (honest)   !ok only when the policy really cannot be satisfied —
+             checked against an independent count of eligible CPUs
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.ops.numa import (
+    BIND_DEFAULT,
+    BIND_FULL_PCPUS,
+    BIND_SPREAD_BY_PCPUS,
+    CPUTopology,
+    STRATEGY_LEAST_ALLOCATED,
+    STRATEGY_MOST_ALLOCATED,
+    take_cpus,
+)
+
+
+def _random_topo(rng: np.random.Generator):
+    sockets = int(rng.integers(1, 3))
+    numa_per = int(rng.integers(1, 3))
+    cores_per = int(rng.integers(2, 5))
+    threads = 2
+    n = sockets * numa_per * cores_per * threads
+    core_of = np.repeat(np.arange(sockets * numa_per * cores_per), threads)
+    numa_of = core_of // cores_per
+    socket_of = numa_of // numa_per
+    return CPUTopology.build(core_of.astype(np.int32),
+                             numa_of.astype(np.int32),
+                             socket_of.astype(np.int32)), n
+
+
+@pytest.mark.parametrize("seed", list(range(20)))
+@pytest.mark.parametrize("bind", [BIND_DEFAULT, BIND_FULL_PCPUS,
+                                  BIND_SPREAD_BY_PCPUS])
+def test_take_cpus_invariants(seed, bind):
+    rng = np.random.default_rng(seed)
+    topo, n = _random_topo(rng)
+    cap = topo.capacity
+    max_ref = int(rng.integers(1, 3))
+    ref = np.zeros(cap, np.int32)
+    ref[:n] = rng.integers(0, max_ref + 1, n)
+    banned = np.zeros(cap, bool)
+    banned[:n] = rng.random(n) < 0.2
+    want = int(rng.integers(1, n + 2))
+    strategy = (STRATEGY_MOST_ALLOCATED if rng.random() < 0.5
+                else STRATEGY_LEAST_ALLOCATED)
+
+    sel, ok = take_cpus(topo, jnp.asarray(ref), jnp.int32(max_ref),
+                        jnp.int32(want), bind_policy=bind,
+                        strategy=strategy, banned=jnp.asarray(banned))
+    sel, ok = np.asarray(sel), bool(ok)
+    valid = np.asarray(topo.valid)
+    core_of = np.asarray(topo.core_of)
+
+    free = valid & (ref < max_ref) & ~banned
+    if bind == BIND_FULL_PCPUS:
+        # whole cores only: a core is takeable iff every sibling is free
+        core_free_count = np.bincount(core_of[free],
+                                      minlength=core_of.max() + 1)
+        core_size = np.bincount(core_of[valid],
+                                minlength=core_of.max() + 1)
+        takeable = np.isin(core_of, np.flatnonzero(
+            (core_size > 0) & (core_free_count == core_size))) & free
+        threads = int(core_size[core_size > 0].max())
+        need = -(-want // threads) * threads   # rounded to whole cores
+        can = takeable.sum() >= need
+    else:
+        takeable = free
+        need = want
+        can = free.sum() >= want
+
+    if ok:
+        # (count)
+        assert sel.sum() == need, (seed, bind, sel.sum(), need)
+        # (legal)
+        assert not (sel & ~free).any(), f"seed {seed}: illegal cpu taken"
+        if bind == BIND_FULL_PCPUS:
+            # (cores) selected cores are complete
+            sel_cores = np.bincount(core_of[sel],
+                                    minlength=core_of.max() + 1)
+            partial = (sel_cores > 0) & (sel_cores != core_size)
+            assert not partial.any(), f"seed {seed}: partial core taken"
+            assert not (sel & ~takeable).any()
+    else:
+        assert sel.sum() == 0, f"seed {seed}: !ok but cpus selected"
+        # (honest) failure only when genuinely unsatisfiable
+        assert not can, (
+            f"seed {seed} bind={bind}: refused a satisfiable request "
+            f"(want {want}, takeable {int(takeable.sum())})")
