@@ -1,0 +1,110 @@
+"""Randomized invariants of device allocation (allocate_on_node).
+
+test_deviceshare.py pins the reference scenarios (device_allocator.go)
+at hand-built inventories; this sweeps random device pools across both
+strategies and shared/whole requests:
+
+  (legal)    selected devices are valid AND healthy
+  (count)    whole requests take exactly n_whole devices, all fully
+             free with enough total capacity; shared requests take one
+             device with enough free core+memory
+  (fit)      allocate_on_node succeeds exactly when device_fit says the
+             node fits (the Filter and the allocator agree)
+  (ledger)   commit then release round-trips the free tensor exactly
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.ops.deviceshare import (
+    DEV_BINPACK,
+    DEV_CORE,
+    DEV_MEM,
+    DEV_SPREAD,
+    DeviceState,
+    allocate_on_node,
+    commit_allocation,
+    device_fit,
+    release_allocation,
+    split_request,
+)
+
+
+def _random_pool(rng: np.random.Generator):
+    n_nodes = int(rng.integers(1, 4))
+    per_node = []
+    for _ in range(n_nodes):
+        n_dev = int(rng.integers(1, 6))
+        per_node.append([
+            {"core": 100,
+             "memory": int(rng.integers(4, 33) * 1024),
+             "group": int(rng.integers(0, 2)),
+             "healthy": bool(rng.random() > 0.15)}
+            for _ in range(n_dev)])
+    dev = DeviceState.build(per_node)
+    # randomly pre-allocate some share of some devices
+    free = np.asarray(dev.free).copy()
+    valid = np.asarray(dev.valid)
+    for (n, d) in zip(*np.nonzero(valid)):
+        if rng.random() < 0.4:
+            frac = rng.choice([0.25, 0.5, 1.0])
+            free[n, d, DEV_CORE] = int(free[n, d, DEV_CORE] * (1 - frac))
+            free[n, d, DEV_MEM] = int(free[n, d, DEV_MEM] * (1 - frac))
+    return dev.replace(free=jnp.asarray(free)), n_nodes
+
+
+@pytest.mark.parametrize("seed", list(range(24)))
+@pytest.mark.parametrize("strategy", [DEV_BINPACK, DEV_SPREAD])
+def test_allocate_on_node_invariants(seed, strategy):
+    rng = np.random.default_rng(seed)
+    dev, n_nodes = _random_pool(rng)
+
+    core = int(rng.integers(1, 5)) * 50       # 50..200: shared or whole
+    memory = int(rng.integers(0, 16)) * 1024
+    n_whole, per_core, per_mem = split_request(core, memory)
+
+    fit = np.asarray(device_fit(
+        dev, jnp.int32(n_whole), jnp.int32(per_core), jnp.int32(per_mem)))
+
+    for node in range(n_nodes):
+        sel, ok = allocate_on_node(
+            dev, jnp.int32(node), jnp.int32(n_whole),
+            jnp.int32(per_core), jnp.int32(per_mem), strategy=strategy)
+        sel, ok = np.asarray(sel), bool(ok)
+
+        # (fit) allocator and Filter agree
+        assert ok == bool(fit[node]), (
+            f"seed {seed} node {node}: allocate ok={ok} but "
+            f"device_fit={bool(fit[node])}")
+        if not ok:
+            assert sel.sum() == 0
+            continue
+
+        usable = np.asarray(dev.valid)[node] & np.asarray(dev.healthy)[node]
+        free = np.asarray(dev.free)[node]
+        total = np.asarray(dev.total)[node]
+        # (legal)
+        assert not (sel & ~usable).any(), (
+            f"seed {seed}: unusable device selected")
+        if n_whole > 0:
+            # (count) whole: exactly n fully-free, capable devices
+            assert sel.sum() == n_whole
+            assert (free[sel] == total[sel]).all(), "non-free whole device"
+            assert (total[sel, DEV_CORE] >= per_core).all()
+            assert (total[sel, DEV_MEM] >= per_mem).all()
+        else:
+            assert sel.sum() == 1
+            assert free[sel, DEV_CORE][0] >= per_core
+            assert free[sel, DEV_MEM][0] >= per_mem
+
+        # (ledger) commit + release round-trips exactly
+        committed = commit_allocation(
+            dev, jnp.int32(node), jnp.asarray(sel),
+            jnp.int32(per_core), jnp.int32(per_mem))
+        released = release_allocation(
+            committed, jnp.int32(node), jnp.asarray(sel),
+            jnp.int32(per_core), jnp.int32(per_mem))
+        assert (np.asarray(released.free) == np.asarray(dev.free)).all()
+        # committed free never negative
+        assert (np.asarray(committed.free) >= 0).all()
