@@ -37,7 +37,10 @@ def _random_pool(rng: np.random.Generator):
     for _ in range(n_nodes):
         n_dev = int(rng.integers(1, 6))
         per_node.append([
-            {"core": 100,
+            # core varies (partitioned 50-core devices exist) so the
+            # per-device core-capacity leg of device_fit is NON-vacuous:
+            # a whole/100-core ask must reject 50-core devices
+            {"core": int(rng.choice([50, 100])),
              "memory": int(rng.integers(4, 33) * 1024),
              "group": int(rng.integers(0, 2)),
              "healthy": bool(rng.random() > 0.15)}
