@@ -1,0 +1,108 @@
+"""Randomized invariants of the network-topology gang packer.
+
+test_network_topology.py pins the PlacePods scenarios at hand-built
+trees; this sweeps random spine/block/node trees, capacities, and gang
+sizes (uniform member requests, so the prefix-fit slot rule has an
+exact closed form) asserting:
+
+  (members)  only gang members get planned nodes; a plan is all-or-
+             nothing across members
+  (capacity) per node, planned pods' cumulative request fits the free
+             capacity (the plan never oversells a node)
+  (gather)   with must_gather_layer set, every planned node lies in ONE
+             subtree at that layer
+  (complete) an empty plan only happens when no gather-layer subtree
+             has enough slots — checked with an independent numpy
+             slot count
+"""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+from koordinator_tpu.ops.network_topology import (
+    TopologyRequirements,
+    TopologyTree,
+    plan_gang_placement,
+)
+from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
+
+R = NUM_RESOURCE_DIMS
+CPU, MEM = ResourceDim.CPU, ResourceDim.MEM if hasattr(ResourceDim, "MEM") \
+    else ResourceDim.MEMORY
+
+
+def _random_problem(rng: np.random.Generator):
+    spines = int(rng.integers(1, 3))
+    blocks = int(rng.integers(1, 4))
+    per_block = int(rng.integers(1, 4))
+    tree = TopologyTree(["spine", "block", "node"])
+    node_block = []
+    idx = 0
+    for s in range(spines):
+        for b in range(blocks):
+            for _ in range(per_block):
+                tree.add_node([f"s{s}", f"b{s}.{b}", f"n{idx}"])
+                node_block.append(s * blocks + b)
+                idx += 1
+    topo = tree.build()
+    n = idx
+    cpus = rng.integers(2_000, 12_000, n)
+    alloc = np.zeros((n, R), np.int32)
+    alloc[:, CPU] = cpus
+    alloc[:, MEM] = 65_536
+    state = ClusterState.from_arrays(alloc, capacity=n)
+
+    members = int(rng.integers(1, 7))
+    per_pod = int(rng.integers(500, 5_000))
+    req = np.zeros((members, R), np.int32)
+    req[:, CPU] = per_pod
+    req[:, MEM] = 512
+    pods = PodBatch.build(req, node_capacity=n)
+    mask = np.zeros(pods.capacity, bool)
+    mask[:members] = True
+    return (state, pods, mask, topo, np.asarray(node_block),
+            cpus, members, per_pod)
+
+
+@pytest.mark.parametrize("seed", list(range(20)))
+def test_plan_invariants(seed):
+    rng = np.random.default_rng(seed)
+    (state, pods, mask, topo, node_block, cpus, members,
+     per_pod) = _random_problem(rng)
+
+    # layer indexing includes the implicit cluster root at 0, so for
+    # ["spine", "block", "node"] the block layer is 2
+    plan = plan_gang_placement(
+        state, pods, mask, topo,
+        TopologyRequirements(desired_slots=members, must_gather_layer=2))
+    plan = np.asarray(plan)
+
+    # (members) plan only covers gang members, all-or-nothing
+    assert (plan[~mask] == -1).all(), f"seed {seed}: non-member planned"
+    planned = plan[mask]
+    assert (planned >= 0).all() or (planned == -1).all(), (
+        f"seed {seed}: partial plan {planned}")
+
+    # independent slot oracle: uniform requests -> node slots =
+    # floor(cpu / per_pod), block slots = sum over its nodes
+    node_slots = cpus // per_pod
+    block_slots = np.bincount(node_block, weights=node_slots).astype(int)
+
+    if (planned == -1).all():
+        # (complete) no block could host the gang
+        assert (block_slots < members).all(), (
+            f"seed {seed}: empty plan but a block has "
+            f"{block_slots.max()} >= {members} slots")
+        return
+
+    # (capacity) per-node cumulative fit
+    counts = np.bincount(planned, minlength=state.capacity)
+    assert (counts * per_pod <= cpus[:len(counts)] if len(counts) <= len(cpus)
+            else counts[:len(cpus)] * per_pod <= cpus).all(), (
+        f"seed {seed}: plan oversells a node")
+
+    # (gather) one block hosts everything
+    blocks_used = set(node_block[p] for p in planned)
+    assert len(blocks_used) == 1, (
+        f"seed {seed}: gang spread over blocks {blocks_used}")
