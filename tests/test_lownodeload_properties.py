@@ -1,0 +1,106 @@
+"""Randomized invariants of LowNodeLoad victim selection.
+
+test_descheduler.py pins the reference scenarios; this sweeps random
+cluster load shapes asserting the balance contract for ANY input:
+
+  (source)   victims run only on abnormal nodes — overutilized for at
+             least anomaly_rounds consecutive rounds — and are
+             evictable
+  (stop)     eviction stops at the high threshold: replaying the
+             selection in order, every victim's node was still above
+             high on a configured dim at its turn
+  (headroom) the underutilized pool's budget never goes negative —
+             victims must have somewhere to land
+  (quiet)    with no overutilized or no underutilized nodes, nothing
+             is evicted
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+from koordinator_tpu.descheduler.lownodeload import (
+    LowNodeLoadArgs,
+    classify_nodes,
+    eviction_budget,
+    select_victims,
+)
+
+R = NUM_RESOURCE_DIMS
+CPU, MEM = ResourceDim.CPU, ResourceDim.MEMORY
+
+
+def _random_problem(rng: np.random.Generator):
+    n_nodes = int(rng.integers(2, 10))
+    n_pods = int(rng.integers(4, 50))
+    cap = np.zeros((n_nodes, R), np.int32)
+    cap[:, CPU] = rng.integers(8_000, 32_000, n_nodes)
+    cap[:, MEM] = rng.integers(16_384, 131_072, n_nodes)
+    usage = (cap * rng.uniform(0.05, 1.0, (n_nodes, R))).astype(np.int32)
+    pod_node = rng.integers(0, n_nodes, n_pods).astype(np.int32)
+    pod_usage = np.zeros((n_pods, R), np.int32)
+    pod_usage[:, CPU] = rng.integers(50, 3_000, n_pods)
+    pod_usage[:, MEM] = rng.integers(64, 8_192, n_pods)
+    prio = rng.integers(3_000, 10_000, n_pods).astype(np.int32)
+    evictable = rng.random(n_pods) < 0.8
+    counters = rng.integers(0, 6, n_nodes).astype(np.int32)
+    return cap, usage, pod_node, pod_usage, prio, evictable, counters
+
+
+@pytest.mark.parametrize("seed", list(range(24)))
+def test_select_victims_invariants(seed):
+    rng = np.random.default_rng(seed)
+    (cap, usage, pod_node, pod_usage, prio, evictable,
+     counters) = _random_problem(rng)
+    n_nodes = cap.shape[0]
+    valid = jnp.ones(n_nodes, bool)
+    args = LowNodeLoadArgs.default()
+
+    victims = np.asarray(select_victims(
+        jnp.asarray(usage), jnp.asarray(cap), valid,
+        jnp.asarray(pod_node), jnp.asarray(pod_usage), jnp.asarray(prio),
+        jnp.asarray(evictable), jnp.asarray(counters), args))
+
+    under, over = (np.asarray(m) for m in classify_nodes(
+        jnp.asarray(usage), jnp.asarray(cap), valid, args))
+    abnormal = over & (counters >= int(args.anomaly_rounds))
+    high = np.asarray(args.high_thresholds)
+    high_quant = np.where(high >= 0, cap.astype(np.int64)
+                          * np.maximum(high, 0) // 100, 2**30)
+    budget0 = np.asarray(eviction_budget(
+        jnp.asarray(usage), jnp.asarray(cap), jnp.asarray(under),
+        jnp.asarray(high)))
+
+    # (source)
+    for v in np.flatnonzero(victims):
+        assert evictable[v], f"seed {seed}: unevictable victim {v}"
+        assert abnormal[pod_node[v]], (
+            f"seed {seed}: victim {v} on non-abnormal node {pod_node[v]}")
+
+    # (quiet)
+    if not abnormal.any() or not under.any():
+        if not under.any():
+            # budget is zero without an underutilized pool: headroom
+            # gating must have blocked everything
+            assert (budget0 <= 0).any() or not victims.any()
+        if not abnormal.any():
+            assert not victims.any(), f"seed {seed}: evicted while calm"
+        return
+
+    # (stop) + (headroom): replay in the same cheapest-first order
+    order = np.lexsort((pod_usage[:, 0], prio))
+    node_usage = usage.astype(np.int64).copy()
+    budget = budget0.astype(np.int64).copy()
+    for idx in order:
+        if not victims[idx]:
+            continue
+        n = pod_node[idx]
+        still_hot = ((high >= 0) & (node_usage[n] > high_quant[n])).any()
+        assert still_hot, (
+            f"seed {seed}: victim {idx} evicted from node {n} already "
+            f"at/below its high threshold")
+        node_usage[n] -= pod_usage[idx]
+        budget -= pod_usage[idx]
+        assert (budget[high >= 0] >= 0).all(), (
+            f"seed {seed}: pool headroom overdrawn after victim {idx}")
