@@ -53,10 +53,17 @@ class ReservationSet:
         return self.valid.shape[0]
 
     @property
+    def active(self) -> jax.Array:
+        """(V,) bool — row holds a valid reservation PLACED on a node;
+        the one definition of usability shared by remaining /
+        reservation_fit / allocate_from_reservation."""
+        return self.valid & (self.node_idx >= 0)
+
+    @property
     def remaining(self) -> jax.Array:
         """(V, R) reserved-but-unallocated, zero for invalid/unplaced rows."""
-        active = self.valid & (self.node_idx >= 0)
-        return jnp.where(active[:, None], self.reserved - self.allocated, 0)
+        return jnp.where(self.active[:, None],
+                         self.reserved - self.allocated, 0)
 
     @classmethod
     def zeros(cls, capacity: int = 16, dims: int = NUM_RESOURCE_DIMS) -> "ReservationSet":
@@ -122,7 +129,7 @@ def reservation_fit(
     rem = rsv.remaining                             # (V, R)
     # Exhausted rows (e.g. consumed allocate-once) are no longer a reservation
     # anyone can allocate through — without this they'd keep the score boost.
-    active = rsv.valid & (rsv.node_idx >= 0) & jnp.any(rem > 0, axis=-1)
+    active = rsv.active & jnp.any(rem > 0, axis=-1)
     req = requests[:, None, :]                      # (P, 1, R)
 
     # req == 0 dims must not exclude (allocatable can shrink below what is
@@ -188,7 +195,13 @@ def allocate_from_reservation(
     rem = rsv.remaining[row]
     take = jnp.where(use, jnp.minimum(request, rem), 0)
     spill = jnp.where(use, request - take, request)
-    consume_all = use & rsv.allocate_once[row]
+    # consume-whole only applies to an ACTIVE row: an invalid or
+    # unplaced reservation has nothing to give (take is already 0 via
+    # remaining), and marking it fully allocated would mutate state a
+    # caller never drew from (found by the randomized ledger sweep —
+    # unreachable through nominate_reservation, which only returns
+    # on-node rows, but a direct caller must not trip it)
+    consume_all = use & rsv.active[row] & rsv.allocate_once[row]
     new_alloc_row = jnp.where(
         consume_all, rsv.reserved[row], rsv.allocated[row] + take
     )

@@ -1,0 +1,108 @@
+"""Randomized invariants of reservation accounting.
+
+test_reservation.py pins the reference scenarios; this sweeps random
+reservation sets and allocation sequences:
+
+  (ledger)   take + spill == request exactly; allocated never exceeds
+             reserved; remaining never negative; no-reservation charges
+             spill entirely and leave the set untouched
+  (once)     an allocate-once row is consumed whole on first use
+  (nominate) the nominated reservation fits, sits on the pod's chosen
+             node, and has the smallest total remainder among the
+             eligible rows (best-fit, recomputed independently)
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+from koordinator_tpu.ops.reservation import (
+    ReservationSet,
+    allocate_from_reservation,
+    nominate_reservation,
+)
+
+R = NUM_RESOURCE_DIMS
+
+
+def _random_set(rng: np.random.Generator, n_nodes: int):
+    v = int(rng.integers(1, 6))
+    reserved = rng.integers(0, 8_000, (v, R)).astype(np.int32)
+    allocated = (reserved * rng.uniform(0, 1, (v, R))).astype(np.int32)
+    node_idx = rng.integers(-1, n_nodes, v).astype(np.int32)
+    once = (rng.random(v) < 0.3)
+    return ReservationSet.build(reserved, node_idx, allocated=allocated,
+                                allocate_once=once)
+
+
+@pytest.mark.parametrize("seed", list(range(24)))
+def test_allocation_ledger(seed):
+    rng = np.random.default_rng(seed)
+    rsv = _random_set(rng, n_nodes=4)
+
+    for _ in range(12):
+        use_none = rng.random() < 0.2
+        r_idx = -1 if use_none else int(rng.integers(0, rsv.capacity))
+        request = rng.integers(0, 5_000, R).astype(np.int32)
+        before = np.asarray(rsv.allocated).copy()
+        rem_before = np.asarray(rsv.remaining).copy()
+
+        rsv2, spill = allocate_from_reservation(
+            rsv, jnp.int32(r_idx), jnp.asarray(request))
+        spill = np.asarray(spill)
+        after = np.asarray(rsv2.allocated)
+
+        if r_idx < 0:
+            assert (spill == request).all(), f"seed {seed}"
+            assert (after == before).all(), f"seed {seed}: set mutated"
+        else:
+            take = np.minimum(request, rem_before[r_idx])
+            # (ledger) exact split
+            assert (take + spill == request).all(), f"seed {seed}"
+            if bool(np.asarray(rsv.allocate_once)[r_idx]) and (
+                    np.asarray(rsv.valid)[r_idx]
+                    and np.asarray(rsv.node_idx)[r_idx] >= 0):
+                # (once) consumed whole
+                assert (after[r_idx]
+                        == np.asarray(rsv.reserved)[r_idx]).all(), (
+                    f"seed {seed}: allocate-once not consumed whole")
+            else:
+                assert (after[r_idx] == before[r_idx] + take).all()
+            # untouched other rows
+            mask = np.ones(rsv.capacity, bool)
+            mask[r_idx] = False
+            assert (after[mask] == before[mask]).all()
+        # (ledger) remaining never negative, zero off active rows
+        rem = np.asarray(rsv2.remaining)
+        assert (rem >= 0).all(), f"seed {seed}: negative remainder"
+        inactive = ~(np.asarray(rsv2.valid)
+                     & (np.asarray(rsv2.node_idx) >= 0))
+        assert (rem[inactive] == 0).all()
+        rsv = rsv2
+
+
+@pytest.mark.parametrize("seed", list(range(24)))
+def test_nominate_best_fit(seed):
+    rng = np.random.default_rng(100 + seed)
+    n_nodes, n_pods = 4, int(rng.integers(1, 8))
+    rsv = _random_set(rng, n_nodes)
+    fits = rng.random((n_pods, rsv.capacity)) < 0.5
+    node = rng.integers(-1, n_nodes, n_pods).astype(np.int32)
+
+    out = np.asarray(nominate_reservation(
+        jnp.asarray(fits), rsv, jnp.asarray(node)))
+
+    node_idx = np.asarray(rsv.node_idx)
+    total_rem = np.asarray(rsv.remaining).sum(axis=1)
+    for p in range(n_pods):
+        eligible = (fits[p] & (node_idx == node[p])
+                    & (node[p] >= 0))
+        if not eligible.any():
+            assert out[p] == -1, f"seed {seed}: pod {p} got {out[p]}"
+            continue
+        r = out[p]
+        assert eligible[r], f"seed {seed}: nominated ineligible row"
+        assert total_rem[r] == total_rem[eligible].min(), (
+            f"seed {seed}: not best-fit ({total_rem[r]} vs "
+            f"{total_rem[eligible].min()})")
