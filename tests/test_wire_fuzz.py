@@ -1,0 +1,108 @@
+"""Byte-level robustness of the framed transport.
+
+A sidecar's listen socket is reachable by anything on the node —
+kubelet restarts mid-write, a confused peer, a port scanner.  Feed a
+live RpcServer raw garbage at every protocol layer and require the one
+acceptable outcome: that CONNECTION dies or errors, the server thread
+survives, and a fresh well-formed client still completes a call.
+"""
+
+import socket
+import struct
+
+import pytest
+
+from koordinator_tpu.transport import RpcClient, RpcServer
+from koordinator_tpu.transport.wire import MAGIC, VERSION, FrameType
+
+
+@pytest.fixture
+def server():
+    srv = RpcServer("tcp://127.0.0.1:0")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _raw_conn(server) -> socket.socket:
+    host, port = server.address[len("tcp://"):].rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=5)
+    s.settimeout(5)
+    return s
+
+
+def _server_still_works(server) -> None:
+    client = RpcClient(server.address, timeout=10.0)
+    client.connect()
+    try:
+        ftype, doc, _ = client.call(FrameType.PING, {})
+        assert ftype is FrameType.ACK   # the built-in ping answered
+    finally:
+        client.close()
+
+
+def _header(magic=MAGIC, version=VERSION, ftype=10, req_id=1, length=0):
+    return struct.pack("<HBBII", magic, version, ftype, req_id, length)
+
+
+def _drain(sock) -> None:
+    """Read until the peer closes or times out — we only care that the
+    server's answer to garbage is an error/close, not what it says."""
+    try:
+        while sock.recv(4096):
+            pass
+    except OSError:
+        pass
+
+
+GARBAGE = [
+    b"",                                          # immediate close
+    b"\x00" * 64,                                 # zero noise
+    b"GET / HTTP/1.1\r\n\r\n",                    # wrong protocol entirely
+    _header(magic=0xDEAD),                        # bad magic
+    _header(version=99),                          # unknown framing version
+    _header(ftype=250, length=4) + b"\x00" * 4,   # unknown frame type
+    _header(length=2 ** 31 - 1),                  # absurd length word
+    _header(ftype=10, length=8) + b"\xff" * 8,    # payload json_len lies
+    # valid header, json_len exceeds payload
+    _header(ftype=10, length=6) + struct.pack("<I", 400) + b"xx",
+    # valid json, arrays manifest points past the raw section
+    (lambda body: _header(ftype=1, length=len(body)) + body)(
+        struct.pack("<I", 76)
+        + b'{"last_rv":-1,"proto":3,"__arrays__":[{"key":"a","dtype":"<i4",'
+          b'"shape":[64],"offset":9999,"nbytes":256}]}'),
+    # truncated frame: header promises more than is sent, then close
+    _header(ftype=10, length=100) + b"short",
+]
+
+
+@pytest.mark.parametrize("blob", range(len(GARBAGE)))
+def test_garbage_never_kills_the_server(server, blob):
+    s = _raw_conn(server)
+    try:
+        if GARBAGE[blob]:
+            s.sendall(GARBAGE[blob])
+        _drain(s)
+    finally:
+        s.close()
+    _server_still_works(server)
+
+
+def test_garbage_on_one_connection_leaves_others_untouched(server):
+    """A garbage peer must only cost ITS connection: a healthy client
+    connected at the same time keeps calling through the abuse."""
+    client = RpcClient(server.address, timeout=10.0)
+    client.connect()
+    try:
+        for blob in GARBAGE:
+            raw = _raw_conn(server)
+            try:
+                if blob:
+                    raw.sendall(blob)
+                _drain(raw)
+            finally:
+                raw.close()
+            ftype, doc, _ = client.call(FrameType.PING, {})
+            assert ftype is FrameType.ACK
+    finally:
+        client.close()
