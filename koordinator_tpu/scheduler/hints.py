@@ -82,7 +82,9 @@ class CrossSchedulerNominator:
 
     def __init__(self, snapshot: ClusterSnapshot):
         self.snapshot = snapshot
-        self._nominations: dict[str, tuple[str, np.ndarray]] = {}
+        #: (node, requests, node_generation) — the release must target
+        #: the node INSTANCE the charge was made against
+        self._nominations: dict[str, tuple[str, np.ndarray, int]] = {}
 
     def nominate(self, pod_uid: str, node: str, requests: np.ndarray) -> bool:
         if pod_uid in self._nominations:
@@ -90,16 +92,17 @@ class CrossSchedulerNominator:
         if node not in self.snapshot.node_index:
             return False
         self.snapshot.reserve(node, requests)
-        self._nominations[pod_uid] = (node, np.asarray(requests))
+        self._nominations[pod_uid] = (
+            node, np.asarray(requests),
+            self.snapshot.node_generation.get(node, 0))
         return True
 
     def release(self, pod_uid: str) -> None:
         entry = self._nominations.pop(pod_uid, None)
         if entry is None:
             return
-        node, requests = entry
-        if node in self.snapshot.node_index:
-            self.snapshot.unreserve(node, requests)
+        node, requests, generation = entry
+        self.snapshot.unreserve_instance(node, requests, generation)
 
     def nominated_node(self, pod_uid: str) -> Optional[str]:
         entry = self._nominations.get(pod_uid)

@@ -72,6 +72,10 @@ class ReservationSpec:
     #: instance identity: a same-named re-created reservation gets a new
     #: generation, so stale bind records can't credit the wrong instance
     generation: int = 0
+    #: snapshot.node_generation at placement: the node INSTANCE the
+    #: reserved vector was charged to — the remainder must not release
+    #: against a re-added same-name node that started clean
+    node_generation: int = 0
 
 
 class ReservationCache:
@@ -115,16 +119,47 @@ class ReservationCache:
             self._return_remainder(spec, snapshot)
 
     def make_available(
-        self, name: str, node: str, snapshot: ClusterSnapshot, now: float = 0.0
+        self, name: str, node: str, snapshot: ClusterSnapshot,
+        now: float = 0.0, charge: bool = True,
     ) -> None:
         """The reserve-pod got 'bound': charge the full reserved vector to the
-        node (so ordinary pods can't see it) and open the reservation."""
+        node (so ordinary pods can't see it) and open the reservation.
+        ``charge=False`` is the solve path (_commit_reserve_pod), where
+        the batch solve already charged the vector to node_requested —
+        the ONE transition implementation serves both paths so a new
+        field (as node_generation was) cannot be stamped in only one."""
         spec = self._specs[name]
         spec.node = node
+        spec.node_generation = snapshot.node_generation.get(node, 0)
         spec.phase = ReservationPhase.AVAILABLE
         spec.available_at = now
         spec.allocated = np.zeros_like(spec.requests)
-        snapshot.reserve(node, spec.requests)
+        if charge:
+            snapshot.reserve(node, spec.requests)
+
+    def fail_stale_instances(self, snapshot: ClusterSnapshot) -> list[str]:
+        """Fail Available reservations whose NODE INSTANCE is gone — the
+        node was removed (or removed and re-added under the same name;
+        the fresh instance started clean and was never charged).  Their
+        accounting died with the instance, so no remainder returns, and
+        the FAILED phase makes return_allocation reject stale bind
+        records (their pods then free their full vector).  Without this
+        sweep a stale Available spec would project its reserved vector
+        onto a fresh same-name node build_set resolves by NAME —
+        oversubscribing it — and a deleted owner pod would leak its
+        drawn amount into spec.allocated forever."""
+        failed = []
+        for spec in self._specs.values():
+            if spec.phase is not ReservationPhase.AVAILABLE:
+                continue
+            if spec.node is None:
+                continue
+            if (spec.node not in snapshot.node_index
+                    or snapshot.node_generation.get(spec.node, 0)
+                    != spec.node_generation):
+                spec.phase = ReservationPhase.FAILED
+                failed.append(spec.name)
+        return failed
 
     def expire_tick(self, now: float, snapshot: ClusterSnapshot) -> list[str]:
         """Expire reservations past their TTL: an Available one returns its
@@ -184,9 +219,12 @@ class ReservationCache:
             spec.allocated if spec.allocated is not None else 0
         )
         # The node may have been deleted since the reservation became
-        # Available; its accounting died with the node row — nothing to return.
-        if spec.node is not None and spec.node in snapshot.node_index:
-            snapshot.unreserve(spec.node, np.maximum(remainder, 0))
+        # Available (its accounting died with the row) or re-added under
+        # the same name (the fresh instance started clean) — the
+        # instance-checked release covers both.
+        if spec.node is not None:
+            snapshot.unreserve_instance(
+                spec.node, np.maximum(remainder, 0), spec.node_generation)
 
     # -- device tensor builders ------------------------------------------------
 
@@ -209,7 +247,14 @@ class ReservationCache:
              for s in avail]
         ).astype(np.int32)
         node_idx = np.array(
-            [snapshot.node_index.get(s.node, -1) if s.node else -1 for s in avail],
+            # resolve by INSTANCE, not just name: a re-added same-name
+            # node was never charged for this reservation (the
+            # fail_stale_instances sweep normally catches these first;
+            # this guards exotic call orders)
+            [snapshot.node_index.get(s.node, -1)
+             if s.node and snapshot.node_generation.get(s.node, 0)
+             == s.node_generation else -1
+             for s in avail],
             np.int32,
         )
         return (

@@ -83,6 +83,12 @@ class BoundPod:
     reservation: str | None = None
     rsv_drawn: np.ndarray | None = None
     rsv_generation: int = 0
+    #: snapshot.node_generation at bind time: the node INSTANCE this
+    #: pod's capacity was charged to — a release after the node was
+    #: removed and re-added under the same name must not decrement the
+    #: fresh instance (it starts clean; the churn suite drove
+    #: node_requested negative before this stamp existed)
+    node_generation: int = 0
 
 
 @dataclasses.dataclass
@@ -229,6 +235,9 @@ class Scheduler:
         self.pdbs: dict[str, PdbRecord] = {}
         #: preemptor pod -> nominated node name (nominatedNodeName semantics)
         self.nominations: dict[str, str] = {}
+        #: node INSTANCE each nomination's charge was assumed against
+        #: (snapshot.node_generation at assume time)
+        self._nomination_gen: dict[str, int] = {}
         from koordinator_tpu.ops.preemption import preempt_chain, preempt_one
 
         self._preempt = jax.jit(
@@ -331,6 +340,13 @@ class Scheduler:
         self._release_fine_grained(bp.name, bp.node)
         if bp.node not in self.snapshot.node_index:
             return
+        if (self.snapshot.node_generation.get(bp.node, 0)
+                != bp.node_generation):
+            # the node this pod was charged to is GONE; the same name now
+            # labels a fresh instance that started clean — decrementing
+            # it would drive node_requested negative (the reservation
+            # drawn/spill split below also died with the old instance)
+            return
         free_vec = bp.requests
         if bp.reservation is not None and bp.rsv_drawn is not None:
             drawn = bp.rsv_drawn.astype(np.int64)
@@ -417,6 +433,10 @@ class Scheduler:
     def _reservation_tick(self, now: float) -> None:
         """Expire reservations; move Pending ones toward Available (pinned
         node: direct, with a fit check; else enqueue a reserve-pod)."""
+        for name in self.reservations.fail_stale_instances(self.snapshot):
+            if self.auditor is not None:
+                self.auditor.record(name, "ReservationFailed",
+                                    "node instance gone")
         for name in self.reservations.expire_tick(now, self.snapshot):
             # a Pending reservation that expired drops its reserve-pod too
             if self.pending.pop(RSV_POD_PREFIX + name, None) is not None:
@@ -542,10 +562,10 @@ class Scheduler:
             # CR deleted mid-round: release the solve's charge
             self.snapshot.unreserve(node, pod.requests)
             return
-        spec.node = node
-        spec.phase = ReservationPhase.AVAILABLE
-        spec.available_at = now
-        spec.allocated = np.zeros_like(spec.requests)
+        # the solve already charged the reserved vector: open without
+        # re-charging (the shared transition keeps both paths identical)
+        self.reservations.make_available(
+            rname, node, self.snapshot, now=now, charge=False)
         result.assignments[pod.name] = node
         if self.explanations is not None:
             self.explanations.delete(pod.name)
@@ -568,6 +588,7 @@ class Scheduler:
                 self._nomination_release(pod)
             else:
                 self.nominations.pop(pod_name, None)
+                self._nomination_gen.pop(pod_name, None)
 
     # -- the scheduling round ----------------------------------------------
 
@@ -980,6 +1001,7 @@ class Scheduler:
         if self.pending.pop(pod.name, None) is not None:
             self._pending_rev += 1
         self.nominations.pop(pod.name, None)
+        self._nomination_gen.pop(pod.name, None)
         self.bound[pod.name] = BoundPod(
             name=pod.name, node=node, requests=pod.requests,
             priority=pod.priority, quota=pod.quota,
@@ -987,6 +1009,7 @@ class Scheduler:
             labels=pod.labels, gang=pod.gang,
             reservation=reservation, rsv_drawn=rsv_drawn,
             rsv_generation=rsv_generation,
+            node_generation=self.snapshot.node_generation.get(node, 0),
         )
         if charge_quota:
             self._charge_quota_used(pod, sign=1)
@@ -1114,14 +1137,16 @@ class Scheduler:
         self.snapshot.reserve(node, pod.requests)
         self._charge_quota_used(pod, sign=1)
         self.nominations[pod.name] = node
+        self._nomination_gen[pod.name] = (
+            self.snapshot.node_generation.get(node, 0))
 
     def _nomination_release(self, pod: PodSpec) -> None:
         """Undo :meth:`_nomination_assume` (stale nomination / pod deleted)."""
         node = self.nominations.pop(pod.name, None)
         if node is None:
             return
-        if node in self.snapshot.node_index:
-            self.snapshot.unreserve(node, pod.requests)
+        self.snapshot.unreserve_instance(
+            node, pod.requests, self._nomination_gen.pop(pod.name, 0))
         self._charge_quota_used(pod, sign=-1)
 
     def _nominated_fit(self, pod: PodSpec, row: int) -> bool:
@@ -1160,6 +1185,7 @@ class Scheduler:
             pod = self.pending.get(name)
             if pod is None:
                 self.nominations.pop(name, None)  # pod gone; nothing assumed
+                self._nomination_gen.pop(name, None)
                 continue
             groups.setdefault(pod.gang or f"\0solo:{name}", []).append(pod)
 

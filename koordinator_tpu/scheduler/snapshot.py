@@ -79,6 +79,11 @@ class ClusterSnapshot:
         # flush (freed by remove_node; a reused row must not inherit the dead
         # node's accounting)
         self._reset_requested: set[int] = set()
+        #: per-name INSTANCE counter, bumped each time a name (re)appears
+        #: with a fresh row: a pod bound to the previous instance of a
+        #: removed-then-readded node must not decrement the new one
+        #: (re-add starts clean — see _reset_requested above)
+        self.node_generation: dict[str, int] = {}
         # label/taint equivalence classes: signature -> class id. Ids are
         # never recycled (bounded by distinct signatures ever seen); the
         # (P, C) selector masks index them via ClusterState.node_class.
@@ -134,8 +139,21 @@ class ClusterSnapshot:
             if not self._free_rows:
                 self._grow()
             row = self._free_rows.pop()
+            if row in self._reset_requested:
+                # a freed row reused BEFORE the pending flush: zero the
+                # dead node's accumulated requested NOW — deferring to
+                # flush would also wipe any charge made against the new
+                # instance in between (e.g. a pinned reservation's
+                # make_available, a cross-scheduler nomination), whose
+                # later generation-checked release would then drive
+                # node_requested negative
+                self._reset_requested.discard(row)
+                self.state = self.state.replace(
+                    node_requested=self.state.node_requested.at[row].set(0))
             self.node_index[spec.name] = row
             self._row_to_name[row] = spec.name
+            self.node_generation[spec.name] = (
+                self.node_generation.get(spec.name, -1) + 1)
         self.node_specs[spec.name] = spec
         self._class_of(spec)  # register the equivalence class up front
         self._dirty.add(row)
@@ -231,6 +249,20 @@ class ClusterSnapshot:
         self.state = self.state.remove_pod(
             jnp.asarray(np.int32(row)), jnp.asarray(requests.astype(np.int32))
         )
+
+    def unreserve_instance(self, node: str, requests: np.ndarray,
+                           generation: int) -> None:
+        """Release a charge made against a SPECIFIC node instance: a
+        no-op when the node is gone or the name now labels a fresh
+        instance (re-add starts clean — decrementing it would drive
+        node_requested negative).  Every release whose record can
+        outlive the node (bound pods, nominations, reservation
+        remainders) must come through here."""
+        if node not in self.node_index:
+            return
+        if self.node_generation.get(node, 0) != generation:
+            return
+        self.unreserve(node, requests)
 
     def adopt_state(self, state: ClusterState) -> None:
         """Adopt solver-updated accounting (post gang/greedy assign)."""

@@ -1,0 +1,169 @@
+"""Randomized conservation invariants of the scheduler's accounting.
+
+Through arbitrary churn — enqueues, rounds, pod deletions, node
+removals and re-adds — the scheduler's device-resident bookkeeping must
+stay exactly consistent with its host-side record of bound pods:
+
+  (ledger)   node_requested[n] == sum of requests of pods bound to n,
+             for every valid node, every dim, after every step
+  (conserve) every pod handed to a round ends as exactly one of
+             assignment / failure / still-pending — none vanish
+  (capacity) node_requested <= allocatable always
+"""
+
+import numpy as np
+import pytest
+
+from tests.test_scheduler import mk_scheduler, node, pod
+
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+
+R = NUM_RESOURCE_DIMS
+
+
+def _ledger_ok(sched, bind_gen, node_gen) -> None:
+    """Recompute per-node bound usage from the host-side bind records
+    and compare against the device tensors, exactly.  A pod bound to a
+    node that was REMOVED and later re-added under the same name does
+    not count toward the new instance (row reuse starts clean — node
+    deletion implies its pods die via informer events; pinned by
+    test_row_reuse_does_not_inherit_requested), so attribution is
+    generation-stamped."""
+    snap = sched.snapshot
+    snap.flush()
+    requested = np.asarray(snap.state.node_requested)
+    alloc = np.asarray(snap.state.node_allocatable)
+    expect = np.zeros_like(requested, dtype=np.int64)
+    for name, rec in sched.bound.items():
+        row = snap.node_index.get(rec.node)
+        if row is None:
+            continue   # bound to a node that has since been removed
+        if bind_gen.get(name) != node_gen.get(rec.node):
+            continue   # bound to a PREVIOUS instance of this node name
+        expect[row] += rec.requests.astype(np.int64)
+    valid = np.asarray(snap.state.node_valid)
+    assert (requested[valid] == expect[valid]).all(), (
+        "device ledger diverged from bound records:\n"
+        f"{requested[valid]}\nvs\n{expect[valid]}")
+    assert (requested[valid] <= alloc[valid]).all()
+
+
+@pytest.mark.parametrize("seed", list(range(10)))
+def test_accounting_survives_random_churn(seed):
+    rng = np.random.default_rng(seed)
+    names = [f"n{i}" for i in range(5)]
+    sched, _ = mk_scheduler([
+        node(n, cpu=int(rng.integers(4_000, 16_000))) for n in names])
+
+    pod_seq = 0
+    live: set[str] = set()
+    node_gen = {n: 0 for n in names}
+    bind_gen: dict[str, int] = {}
+    for step in range(30):
+        op = int(rng.integers(0, 10))
+        if op <= 4:
+            for _ in range(int(rng.integers(1, 5))):
+                p = f"p{pod_seq}"
+                pod_seq += 1
+                sched.enqueue(pod(
+                    p, cpu=int(rng.integers(200, 4_000)),
+                    mem=int(rng.integers(128, 4_096))))
+                live.add(p)
+            before_pending = set(sched.pending)
+            res = sched.schedule_round()
+            for p, n in res.assignments.items():
+                bind_gen[p] = node_gen[n]
+            # (conserve) no pod vanishes: assigned pods leave the
+            # queue, everything else stays pending for the next round
+            # (failures are diagnoses, not dequeues)
+            after_pending = set(sched.pending)
+            for p in before_pending:
+                if p in res.assignments:
+                    assert p not in after_pending, (
+                        f"seed {seed} step {step}: {p} assigned but "
+                        f"still pending")
+                else:
+                    assert p in after_pending, (
+                        f"seed {seed} step {step}: {p} vanished "
+                        f"(not assigned, not pending)")
+        elif op <= 6 and sched.bound:
+            victim = sorted(sched.bound)[
+                int(rng.integers(0, len(sched.bound)))]
+            sched.delete_pod(victim)
+            live.discard(victim)
+        elif op == 7 and sched.pending:
+            waiting = sorted(sched.pending)[
+                int(rng.integers(0, len(sched.pending)))]
+            sched.dequeue(waiting)
+            live.discard(waiting)
+        elif op == 8:
+            gone = names[int(rng.integers(0, len(names)))]
+            if gone in sched.snapshot.node_index:
+                sched.snapshot.remove_node(gone)
+                node_gen[gone] += 1
+        else:
+            back = names[int(rng.integers(0, len(names)))]
+            if back not in sched.snapshot.node_index:
+                sched.snapshot.upsert_node(
+                    node(back, cpu=int(rng.integers(4_000, 16_000))))
+        _ledger_ok(sched, bind_gen, node_gen)
+
+
+def test_stale_available_reservation_fails_on_node_flap():
+    """An Available reservation whose node instance vanished (node
+    removed, or removed and re-added under the same name) must FAIL at
+    the next round rather than project its reserved vector onto the
+    fresh instance that was never charged for it — and its owner pods'
+    stale bind records must not leak drawn amounts."""
+
+    from koordinator_tpu.scheduler.reservations import (
+        OwnerMatcher,
+        ReservationPhase,
+        ReservationSpec,
+    )
+
+    sched, _ = mk_scheduler([node("n1", cpu=8_000)])
+    sched.add_reservation(ReservationSpec(
+        name="r1",
+        requests=np.asarray([4_000, 4_096] + [0] * (R - 2), np.int64),
+        owners=[OwnerMatcher(labels={"app": "a"})]))
+    sched.schedule_round()                       # places the reserve pod
+    assert sched.reservations.get("r1").phase is ReservationPhase.AVAILABLE
+
+    sched.snapshot.remove_node("n1")
+    sched.snapshot.upsert_node(node("n1", cpu=8_000))
+    sched.schedule_round()                       # the sweep runs here
+    spec = sched.reservations.get("r1")
+    assert spec is None or spec.phase is not ReservationPhase.AVAILABLE
+    # the fresh n1 carries no phantom reservation charge: a full-size
+    # pod fits
+    sched.enqueue(pod("big", cpu=7_000))
+    res = sched.schedule_round()
+    assert res.assignments.get("big") == "n1", res.failures
+
+
+def test_row_reuse_before_flush_keeps_new_charges():
+    """A freed row reused before the pending flush must zero the DEAD
+    node's accounting eagerly: a charge made against the new instance
+    in between (here a pinned reservation opening) survives the next
+    flush, and its later release balances to exactly zero."""
+    from koordinator_tpu.scheduler.reservations import ReservationSpec
+
+    sched, _ = mk_scheduler([node("n1", cpu=8_000)])
+    sched.enqueue(pod("p1", cpu=3_000))
+    sched.schedule_round()                       # row accumulates 3000
+    sched.snapshot.remove_node("n1")             # row pending reset
+    sched.snapshot.upsert_node(node("n2", cpu=8_000))  # reuses the row
+    # pinned reservation charges the NEW instance before any flush
+    sched.add_reservation(ReservationSpec(
+        name="r2", requests=np.asarray([2_000, 1_024] + [0] * (R - 2),
+                                       np.int64), node="n2"))
+    sched.schedule_round()                       # flush happens inside
+    sched.snapshot.flush()
+    row = sched.snapshot.node_index["n2"]
+    req = np.asarray(sched.snapshot.state.node_requested)[row]
+    assert req[0] == 2_000, f"reservation charge lost or polluted: {req[:2]}"
+    sched.remove_reservation("r2")
+    sched.snapshot.flush()
+    req = np.asarray(sched.snapshot.state.node_requested)[row]
+    assert (req == 0).all(), f"release unbalanced: {req[:2]}"
