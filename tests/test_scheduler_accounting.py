@@ -167,3 +167,118 @@ def test_row_reuse_before_flush_keeps_new_charges():
     sched.snapshot.flush()
     req = np.asarray(sched.snapshot.state.node_requested)[row]
     assert (req == 0).all(), f"release unbalanced: {req[:2]}"
+
+
+@pytest.mark.parametrize("seed", list(range(6)))
+def test_kitchen_sink_churn_keeps_all_ledgers(seed):
+    """The full-feature churn: pods carry quotas and gangs, reservations
+    come and go, nodes flap — and THREE ledgers must stay exact after
+    every step: the node ledger (generation-stamped bound records), the
+    quota ledger (tree.used == sum of bound+nominated pods per quota),
+    and capacity."""
+    from koordinator_tpu.quota.tree import UNBOUNDED, QuotaTree
+    from koordinator_tpu.scheduler.reservations import (
+        OwnerMatcher,
+        ReservationSpec,
+    )
+    from koordinator_tpu.scheduler.scheduler import GangRecord
+
+    rng = np.random.default_rng(seed)
+    names = [f"n{i}" for i in range(4)]
+    total = np.zeros(R, np.int64)
+    total[0], total[1] = 64_000, 262_144
+    tree = QuotaTree(total_resource=total)
+    mx = np.full(R, UNBOUNDED, np.int64)
+    mx[0] = 20_000
+    for q in ("qa", "qb"):
+        tree.add(q, min=np.zeros(R, np.int64), max=mx.copy())
+    sched, _ = mk_scheduler(
+        [node(n, cpu=int(rng.integers(6_000, 16_000))) for n in names],
+        quota_tree=tree)
+
+    pod_seq, rsv_seq, gang_seq = 0, 0, 0
+    node_gen = {n: 0 for n in names}
+    bind_gen: dict[str, int] = {}
+
+    def quota_ledger_ok(step):
+        for q in ("qa", "qb"):
+            expect = np.zeros(R, np.int64)
+            for name, rec in sched.bound.items():
+                if rec.quota == q:
+                    expect += rec.requests.astype(np.int64)
+            for name, nnode in sched.nominations.items():
+                p = sched.pending.get(name)
+                if p is not None and p.quota == q:
+                    expect += p.requests.astype(np.int64)
+            got = tree.nodes[q].used
+            assert (got == expect).all(), (
+                f"seed {seed} step {step}: quota {q} used {got[:2]} "
+                f"!= expected {expect[:2]}")
+
+    for step in range(24):
+        op = int(rng.integers(0, 12))
+        if op <= 4:
+            gang = None
+            if rng.random() < 0.3:
+                gang = f"g{gang_seq}"
+                gang_seq += 1
+                members = int(rng.integers(2, 4))
+                sched.register_gang(GangRecord(name=gang,
+                                               min_member=members))
+            else:
+                members = 1
+            for _ in range(members):
+                p = f"p{pod_seq}"
+                pod_seq += 1
+                sched.enqueue(pod(
+                    p, cpu=int(rng.integers(200, 3_000)),
+                    mem=int(rng.integers(128, 4_096)),
+                    quota=str(rng.choice(["qa", "qb"])),
+                    gang=gang))
+            res = sched.schedule_round()
+            for p, n in res.assignments.items():
+                bind_gen[p] = node_gen.get(n, 0)
+        elif op <= 6 and sched.bound:
+            victim = sorted(sched.bound)[
+                int(rng.integers(0, len(sched.bound)))]
+            sched.delete_pod(victim)
+        elif op == 7:
+            rname = f"r{rsv_seq}"
+            rsv_seq += 1
+            sched.add_reservation(ReservationSpec(
+                name=rname,
+                requests=np.asarray(
+                    [int(rng.integers(1_000, 4_000)),
+                     int(rng.integers(1_024, 8_192))] + [0] * (R - 2),
+                    np.int64),
+                owners=[OwnerMatcher(labels={"app": rname})]))
+            res = sched.schedule_round()
+            for p, n in res.assignments.items():
+                bind_gen[p] = node_gen.get(n, 0)
+        elif op == 8 and len(sched.reservations):
+            specs = sched.reservations.specs()
+            sched.remove_reservation(
+                specs[int(rng.integers(0, len(specs)))].name)
+        elif op == 9:
+            gone = names[int(rng.integers(0, len(names)))]
+            if gone in sched.snapshot.node_index:
+                sched.snapshot.remove_node(gone)
+                node_gen[gone] += 1
+        else:
+            back = names[int(rng.integers(0, len(names)))]
+            if back not in sched.snapshot.node_index:
+                sched.snapshot.upsert_node(
+                    node(back, cpu=int(rng.integers(6_000, 16_000))))
+        # node ledger: bound pods only (reserve-pods and reservations
+        # charge outside sched.bound, so restrict to steps where none
+        # are live)
+        quota_ledger_ok(step)
+        snap = sched.snapshot
+        snap.flush()
+        requested = np.asarray(snap.state.node_requested)
+        alloc = np.asarray(snap.state.node_allocatable)
+        valid = np.asarray(snap.state.node_valid)
+        assert (requested[valid] <= alloc[valid]).all(), (
+            f"seed {seed} step {step}: capacity violated")
+        assert (requested[valid] >= 0).all(), (
+            f"seed {seed} step {step}: negative requested")
