@@ -1242,6 +1242,16 @@ class Scheduler:
         for i, name in enumerate(names):
             bp = self.bound[name]
             row = self.snapshot.node_index.get(bp.node)
+            if (row is not None
+                    and self.snapshot.node_generation.get(bp.node, 0)
+                    != bp.node_generation):
+                # bound to a PREVIOUS instance of a re-added node: its
+                # capacity was never charged to the current row, so it
+                # must not be a victim candidate — "evicting" it would
+                # let the solve assume freed capacity that was never
+                # there and nominate a preemptor past allocatable
+                # (caught by the preemption churn suite)
+                row = None
             req[i] = bp.requests
             node[i] = row if row is not None else -1
             pri[i] = bp.priority

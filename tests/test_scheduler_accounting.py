@@ -282,3 +282,79 @@ def test_kitchen_sink_churn_keeps_all_ledgers(seed):
             f"seed {seed} step {step}: capacity violated")
         assert (requested[valid] >= 0).all(), (
             f"seed {seed} step {step}: negative requested")
+
+
+@pytest.mark.parametrize("seed", list(range(6)))
+def test_preemption_churn_keeps_ledgers(seed):
+    """Preemption-heavy churn: a tight cluster where high-priority pods
+    keep arriving forces PostFilter nominations and victim evictions
+    while nodes flap — the quota and node ledgers must stay exact, and
+    every evicted victim must actually leave the bound set."""
+    rng = np.random.default_rng(seed)
+    evicted: list[tuple[str, str]] = []
+    names = [f"n{i}" for i in range(3)]
+    # constructor path: preempt_fn auto-enables preemption
+    sched, _ = mk_scheduler(
+        [node(n, cpu=6_000, mem=24_576) for n in names],
+        preempt_fn=lambda victim, preemptor: evicted.append(
+            (victim, preemptor)))
+
+    pod_seq = 0
+    node_gen = {n: 0 for n in names}
+    bind_gen: dict[str, int] = {}
+    for step in range(20):
+        op = int(rng.integers(0, 10))
+        if op <= 5:
+            for _ in range(int(rng.integers(1, 4))):
+                p = f"p{pod_seq}"
+                pod_seq += 1
+                sched.enqueue(pod(
+                    p, cpu=int(rng.integers(1_500, 4_000)),
+                    mem=int(rng.integers(2_048, 8_192)),
+                    priority=int(rng.integers(3_000, 10_000))))
+            res = sched.schedule_round()
+            for p, n in res.assignments.items():
+                bind_gen[p] = node_gen[n]
+        elif op <= 7 and sched.bound:
+            victim = sorted(sched.bound)[
+                int(rng.integers(0, len(sched.bound)))]
+            sched.delete_pod(victim)
+        elif op == 8:
+            gone = names[int(rng.integers(0, len(names)))]
+            if gone in sched.snapshot.node_index:
+                sched.snapshot.remove_node(gone)
+                node_gen[gone] += 1
+        else:
+            back = names[int(rng.integers(0, len(names)))]
+            if back not in sched.snapshot.node_index:
+                sched.snapshot.upsert_node(
+                    node(back, cpu=6_000, mem=24_576))
+
+        # evicted victims are really gone from the bound set
+        for victim, _ in evicted:
+            assert victim not in sched.bound, (
+                f"seed {seed} step {step}: evicted {victim} still bound")
+        # EXACT ledger (not just bounds): nominations also charge the
+        # node, so fold the pending nominated requests in
+        snap = sched.snapshot
+        snap.flush()
+        requested = np.asarray(snap.state.node_requested)
+        expect = np.zeros_like(requested, dtype=np.int64)
+        for name, rec in sched.bound.items():
+            row = snap.node_index.get(rec.node)
+            if row is None or bind_gen.get(name) != node_gen.get(rec.node):
+                continue
+            expect[row] += rec.requests.astype(np.int64)
+        for name, nnode in sched.nominations.items():
+            p = sched.pending.get(name)
+            row = snap.node_index.get(nnode)
+            if p is not None and row is not None:
+                expect[row] += p.requests.astype(np.int64)
+        alloc = np.asarray(snap.state.node_allocatable)
+        valid = np.asarray(snap.state.node_valid)
+        assert (requested[valid] == expect[valid]).all(), (
+            f"seed {seed} step {step}: ledger diverged\n"
+            f"{requested[valid][:, :2]}\nvs\n{expect[valid][:, :2]}")
+        assert (requested[valid] <= alloc[valid]).all(), (
+            f"seed {seed} step {step}: capacity violated")
+    assert pod_seq > 0
