@@ -358,3 +358,91 @@ def test_preemption_churn_keeps_ledgers(seed):
         assert (requested[valid] <= alloc[valid]).all(), (
             f"seed {seed} step {step}: capacity violated")
     assert pod_seq > 0
+
+
+@pytest.mark.parametrize("seed", list(range(10)))
+def test_migration_arbitration_respects_every_budget(seed):
+    """Randomized arbitration: whatever the pending set looks like, the
+    newly-allowed jobs never push any group past its budget — per node,
+    per namespace, per workload migrating count, and unavailable-replica
+    headroom (migrating pods count as unavailable).  Pre-existing
+    RUNNING jobs may already exceed a budget; arbitration must then
+    admit nothing more into that group."""
+    from collections import Counter
+
+    from koordinator_tpu.descheduler.migration import (
+        ArbitrationLimits,
+        ControllerFinder,
+        MigrationController,
+        MigrationJob,
+        MigrationJobPhase,
+        Workload,
+        get_max_migrating,
+        get_max_unavailable,
+    )
+
+    rng = np.random.default_rng(seed)
+    finder = ControllerFinder()
+    workloads = {}
+    for w in range(3):
+        ref = f"wl{w}"
+        replicas = int(rng.integers(2, 12))
+        unavailable = int(rng.integers(0, 3))
+        workloads[ref] = (replicas, unavailable)
+        finder.register(Workload(ref=ref, expected_replicas=replicas,
+                                 unavailable=unavailable))
+    limits = ArbitrationLimits(
+        max_migrating_per_node=int(rng.integers(1, 3)),
+        max_migrating_per_namespace=int(rng.integers(2, 5)))
+    ctl = MigrationController(limits=limits, controller_finder=finder)
+
+    for j in range(int(rng.integers(5, 25))):
+        job = MigrationJob(
+            name=f"job{j}",
+            pod=f"pod{j}",
+            node=f"n{int(rng.integers(0, 3))}",
+            namespace=f"ns{int(rng.integers(0, 3))}",
+            workload=(f"wl{int(rng.integers(0, 3))}"
+                      if rng.random() < 0.8 else ""),
+            priority=int(rng.integers(0, 100)),
+            create_time=float(j))
+        if rng.random() < 0.25:
+            job.phase = MigrationJobPhase.RUNNING
+        ctl.submit(job)
+
+    allowed = ctl.arbitrate()
+    # count each group over running + allowed
+    node, ns, wl = Counter(), Counter(), Counter()
+    for job in ctl.running() + allowed:
+        node[job.node] += 1
+        ns[job.namespace] += 1
+        if job.workload:
+            wl[job.workload] += 1
+    run_node, run_ns, run_wl = Counter(), Counter(), Counter()
+    for job in ctl.running():
+        run_node[job.node] += 1
+        run_ns[job.namespace] += 1
+        if job.workload:
+            run_wl[job.workload] += 1
+
+    for job in allowed:
+        assert job.phase is MigrationJobPhase.PENDING
+        # a newly-admitted job's group never exceeds its budget unless
+        # the RUNNING set alone already did (then nothing was admitted
+        # into it, so the combined count equals the running count)
+        assert (node[job.node] <= limits.max_migrating_per_node
+                or node[job.node] == run_node[job.node]), (
+            f"seed {seed}: node budget exceeded for {job.node}")
+        assert (ns[job.namespace] <= limits.max_migrating_per_namespace
+                or ns[job.namespace] == run_ns[job.namespace]), (
+            f"seed {seed}: namespace budget exceeded")
+        if job.workload:
+            replicas, unavailable = workloads[job.workload]
+            max_mig = get_max_migrating(replicas, None)
+            max_unavail = get_max_unavailable(replicas, None)
+            assert (wl[job.workload] <= max_mig
+                    or wl[job.workload] == run_wl[job.workload]), (
+                f"seed {seed}: workload migrating budget exceeded")
+            assert (unavailable + wl[job.workload] <= max_unavail
+                    or wl[job.workload] == run_wl[job.workload]), (
+                f"seed {seed}: unavailable headroom exceeded")
