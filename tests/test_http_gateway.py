@@ -220,3 +220,62 @@ class TestPodResourcesProxy:
         assert upstream["pod_resources"][0]["containers"][0]["devices"] == []
         # extra top-level upstream fields pass through
         assert first["extra_field"] == 7
+
+
+def test_gateway_survives_garbage_requests():
+    """The HTTP surface is as reachable as the framed socket: raw
+    garbage, lying Content-Length, malformed JSON bodies, and unknown
+    routes must cost only that request — the server keeps answering
+    /healthz afterwards."""
+    import socket
+
+    sched, _ = mk_scheduler([node("n1")])
+    gw = HttpGateway(scheduler=sched)
+    gw.start()
+    try:
+        blobs = [
+            b"\x00" * 64,
+            b"NOT-HTTP AT ALL\r\n\r\n",
+            b"POST /v1/solve HTTP/1.1\r\nContent-Length: 10\r\n\r\nnot json!!",
+            b"POST /v1/state HTTP/1.1\r\nContent-Length: 999999\r\n\r\nshort",
+            b"GET /v1/%00%ff HTTP/1.1\r\n\r\n",
+        ]
+        for blob in blobs:
+            s = socket.create_connection(("127.0.0.1", gw.port), timeout=5)
+            s.settimeout(5)
+            try:
+                s.sendall(blob)
+                try:
+                    while s.recv(4096):
+                        pass
+                except OSError:
+                    pass
+            finally:
+                s.close()
+            assert _req(gw.port, "/healthz") == (200, {"ok": True})
+        # malformed JSON through the normal client path on a
+        # body-consuming route: an error status, not a hang or crash
+        # (/v1/solve ignores its body by design, so it is not the probe)
+        status, doc = _req_raw_body(gw.port, "/v1/state", b"{broken")
+        assert status in (400, 500), status
+        assert _req(gw.port, "/healthz") == (200, {"ok": True})
+    finally:
+        gw.stop()
+
+
+def _req_raw_body(port, path, body: bytes):
+    import http.client
+    import json as _json
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            return resp.status, _json.loads(raw)
+        except ValueError:
+            return resp.status, {}
+    finally:
+        conn.close()
