@@ -230,7 +230,9 @@ def test_gateway_survives_garbage_requests():
     import socket
 
     sched, _ = mk_scheduler([node("n1")])
-    gw = HttpGateway(scheduler=sched)
+    # a lease store attaches a body-PARSING route (PUT /v1/leases/...)
+    # for the malformed-JSON probe below
+    gw = HttpGateway(scheduler=sched, lease_store=InMemoryLeaseStore())
     gw.start()
     try:
         blobs = [
@@ -254,22 +256,23 @@ def test_gateway_survives_garbage_requests():
                 s.close()
             assert _req(gw.port, "/healthz") == (200, {"ok": True})
         # malformed JSON through the normal client path on a
-        # body-consuming route: an error status, not a hang or crash
+        # body-PARSING route: an error status, not a hang or crash
         # (/v1/solve ignores its body by design, so it is not the probe)
-        status, doc = _req_raw_body(gw.port, "/v1/state", b"{broken")
+        status, doc = _req_raw_body(gw.port, "/v1/leases/x", b"{broken",
+                                    method="PUT")
         assert status in (400, 500), status
         assert _req(gw.port, "/healthz") == (200, {"ok": True})
     finally:
         gw.stop()
 
 
-def _req_raw_body(port, path, body: bytes):
+def _req_raw_body(port, path, body: bytes, method: str = "POST"):
     import http.client
     import json as _json
 
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
     try:
-        conn.request("POST", path, body=body,
+        conn.request(method, path, body=body,
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
         raw = resp.read()
