@@ -41,12 +41,16 @@ def calculate_be_suppress_milli(
     allowable = capacity_milli * threshold_pct // 100 - (
         node_used_milli - be_used_milli
     )
-    allowable = max(allowable, BE_MIN_CPUS * 1000)
     allowable = min(allowable, capacity_milli)
     if prev_allowable_milli is not None and allowable > prev_allowable_milli:
         step = capacity_milli * max_increase_pct // 100
         allowable = min(allowable, prev_allowable_milli + max(step, 1000))
-    return allowable
+    # the BE minimum is the LAST word: a sub-floor prev (external
+    # checkpoint, config change) must not let the rate limiter hold the
+    # result under the guaranteed floor (found by the randomized sweep)
+    # — but the floor itself can never exceed the machine (a 1-CPU node
+    # cannot enforce a 2-CPU quota)
+    return max(allowable, min(BE_MIN_CPUS * 1000, capacity_milli))
 
 
 def select_be_cpuset(

@@ -395,3 +395,41 @@ class TestEvictorCooldown:
         assert not evictor.evict(p, "r")
         clock.tick(301)
         assert evictor.evict(p, "r")
+
+
+def test_suppress_formula_invariants_random():
+    """Randomized invariants of the BE suppress formula: the allowable
+    always lands in [BE_MIN floor, capacity], never grows faster than
+    the rate limit, and is non-increasing in LS usage (more
+    latency-sensitive load can only shrink the best-effort share)."""
+    import numpy as np
+
+    from koordinator_tpu.koordlet.qosmanager.cpusuppress import (
+        BE_MIN_CPUS,
+        calculate_be_suppress_milli,
+    )
+
+    rng = np.random.default_rng(0)
+    for _ in range(300):
+        # from sub-floor 1-CPU nodes up to 128 cores: the floor itself
+        # must clamp to capacity on tiny machines
+        cap = int(rng.integers(1, 129)) * 1000
+        be_used = int(rng.integers(0, cap // 2))
+        node_used = be_used + int(rng.integers(0, cap))
+        thr = int(rng.integers(10, 100))
+        prev = (int(rng.integers(0, cap))
+                if rng.random() < 0.5 else None)
+        a = calculate_be_suppress_milli(cap, node_used, be_used, thr,
+                                        prev_allowable_milli=prev)
+        floor = min(BE_MIN_CPUS * 1000, cap)
+        assert floor <= a <= cap, (cap, node_used, thr, a)
+        if prev is not None and a > prev:
+            # the BE minimum floor overrides the rate limit (a sub-floor
+            # prev must not hold the result under the guarantee)
+            step = max(cap * 5 // 100, 1000)
+            assert a <= max(prev + step, floor), (a, prev, step)
+        # monotone in LS usage
+        a_more_ls = calculate_be_suppress_milli(
+            cap, node_used + 500, be_used, thr,
+            prev_allowable_milli=prev)
+        assert a_more_ls <= a, (a_more_ls, a)
