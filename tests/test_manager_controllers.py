@@ -394,3 +394,77 @@ class TestSyncSuppressionExtended:
         patches = controller.reconcile([record])
         assert len(patches) == 1 and patches[0].degraded
         assert patches[0].device_resources[ext.RESOURCE_GPU] == 100
+
+
+def test_mutate_then_validate_consistency_random():
+    """Cross-component invariant: a pod whose cpu/memory request equals
+    its limit, mutated by a well-formed profile (QoS and priority drawn
+    from the COMPATIBLE matrix), always passes the validating webhook —
+    including the BE batch translation, whose output must satisfy the
+    extended-resource request==limit rule it feeds.  Pods with
+    MISMATCHED request/limit that get batch-translated are rejected
+    with exactly the equality errors (the reference translates
+    faithfully and lets core admission reject the mismatch —
+    cluster_colocation_profile.go mutatePodResourceSpec)."""
+    import numpy as np
+
+    from koordinator_tpu.api.priority import (
+        PRIORITY_BATCH_MIN,
+        PRIORITY_FREE_MIN,
+        PRIORITY_MID_MIN,
+        PRIORITY_PROD_MIN,
+        PriorityClass,
+    )
+    from koordinator_tpu.manager.webhook import (
+        QOS_PRIORITY_COMPAT,
+        PodMutatingWebhook,
+        PodValidatingWebhook,
+    )
+
+    band_value = {
+        PriorityClass.PROD: PRIORITY_PROD_MIN + 50,
+        PriorityClass.MID: PRIORITY_MID_MIN + 50,
+        PriorityClass.BATCH: PRIORITY_BATCH_MIN + 50,
+        PriorityClass.FREE: PRIORITY_FREE_MIN + 50,
+        PriorityClass.NONE: None,
+    }
+    rng = np.random.default_rng(0)
+    validator = PodValidatingWebhook()
+    for trial in range(200):
+        qos = list(QOS_PRIORITY_COMPAT)[int(rng.integers(
+            0, len(QOS_PRIORITY_COMPAT)))]
+        allowed = QOS_PRIORITY_COMPAT[qos]
+        band = allowed[int(rng.integers(0, len(allowed)))]
+        profile = crds.ClusterColocationProfile(
+            name="p", qos_class=qos.name if qos.name != "NONE" else "",
+            koordinator_priority=band_value[band])
+        mutator = PodMutatingWebhook([profile])
+        cpu = f"{int(rng.integers(1, 4000))}m"
+        mem = f"{int(rng.integers(1, 8))}Gi"
+        matched = bool(rng.random() < 0.7)
+        limits = ({"cpu": cpu, "memory": mem} if matched else
+                  {"cpu": f"{int(rng.integers(4000, 8000))}m",
+                   "memory": f"{int(rng.integers(8, 16))}Gi"})
+        pod = {
+            "metadata": {"name": f"pod{trial}", "labels": {}},
+            "spec": {"containers": [{
+                "name": "main",
+                "resources": {
+                    "requests": {"cpu": cpu, "memory": mem},
+                    "limits": limits,
+                }}]},
+        }
+        mutated = mutator.mutate(pod)
+        errors = validator.validate(mutated)
+        translated = any(
+            "batch" in k
+            for c in mutated["spec"]["containers"]
+            for k in c.get("resources", {}).get("requests", {}))
+        if matched or not translated:
+            assert not errors, (
+                f"trial {trial}: qos={qos.name} band={band.name}: {errors}")
+        else:
+            # faithful translation of a mismatched pod: rejected with
+            # exactly the extended-resource equality errors
+            assert errors and all("must equal limit" in e for e in errors), (
+                f"trial {trial}: {errors}")
