@@ -415,11 +415,7 @@ def test_mutate_then_validate_consistency_random():
         PRIORITY_PROD_MIN,
         PriorityClass,
     )
-    from koordinator_tpu.manager.webhook import (
-        QOS_PRIORITY_COMPAT,
-        PodMutatingWebhook,
-        PodValidatingWebhook,
-    )
+    from koordinator_tpu.manager.webhook import QOS_PRIORITY_COMPAT
 
     band_value = {
         PriorityClass.PROD: PRIORITY_PROD_MIN + 50,
