@@ -499,6 +499,11 @@ def main() -> None:
     candidates = {
         "approx": lambda st, p: batch_assign(st, p, cfg, k=16,
                                              method="approx")[:2],
+        # k=8 halves candidate-tensor work and assigns 100% at this
+        # shape on CPU (PERF_NOTES); the quality gate below keeps it
+        # from winning if TPU's approx_max_k recall strands pods
+        "approx_k8": lambda st, p: batch_assign(st, p, cfg, k=8,
+                                                method="approx")[:2],
         "chunked": lambda st, p: batch_assign(st, p, cfg, k=16,
                                               method="chunked")[:2],
         "fused": lambda st, p: batch_assign(st, p, cfg, k=16,

@@ -29,7 +29,7 @@ def problem():
     return _build_problem(NORTH_STAR_NODES, NORTH_STAR_PODS, seed=42)
 
 
-@pytest.mark.parametrize("k", [16, 32])
+@pytest.mark.parametrize("k", [8, 16, 32])
 def test_stratified_candidates_assign_everything_at_shape(problem, k):
     import jax
 
