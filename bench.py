@@ -38,6 +38,58 @@ K_ITERS = 8
 BASELINE_PODS_PER_SEC = 250_000.0
 
 
+def _git_head() -> dict:
+    """{"commit": sha, "dirty": bool} of the repo this bench lives in —
+    stamped into every record so a probe capture can be matched to the
+    code it actually measured (VERDICT r4 weak #2: a capture from commit
+    A must not be promoted as the official number of commit B with
+    solver changes in between)."""
+    import subprocess
+
+    cwd = os.path.dirname(os.path.abspath(__file__))
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            cwd=cwd, timeout=10).stdout.strip()
+        dirty = bool(subprocess.run(
+            ["git", "status", "--porcelain"], capture_output=True,
+            text=True, cwd=cwd, timeout=10).stdout.strip())
+    except Exception:
+        return {"commit": "", "dirty": False}
+    return {"commit": sha, "dirty": dirty}
+
+
+#: paths whose change between a capture's commit and HEAD invalidates the
+#: capture as a performance record (docs/tests/bench-extras churn doesn't)
+_SOLVER_PATHS = ("koordinator_tpu/", "native/", "__graft_entry__.py",
+                 "bench.py")
+
+
+def _solver_diff(old_commit: str, head: str) -> list[str] | None:
+    """Solver-relevant files changed between two commits; None when the
+    diff cannot be computed (unknown commit, git failure) — callers must
+    treat None as 'assume changed'."""
+    import subprocess
+
+    if not old_commit or not head:
+        return None
+    if old_commit == head:
+        return []
+    try:
+        proc = subprocess.run(
+            ["git", "diff", "--name-only", f"{old_commit}..{head}"],
+            capture_output=True, text=True, timeout=15,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except Exception:
+        return None
+    if proc.returncode != 0:
+        return None
+    return [line for line in proc.stdout.strip().splitlines()
+            if line.startswith(tuple(p for p in _SOLVER_PATHS
+                                     if p.endswith("/")))
+            or line in _SOLVER_PATHS]
+
+
 def _median_readback_seconds(fn, args, n: int = 5):
     """(median_seconds, value) — the warm-up call's value rides along so
     callers can read the chained loop's accumulator without recompiling."""
@@ -261,6 +313,88 @@ def _bench_colocation(rtt: float) -> dict:
     return {"spark_colocation_e2e_pods_per_sec_3n": round(n_scheduled / dt, 1)}
 
 
+def _bench_deltasync(rtt: float) -> dict:
+    """State-sync path timing (VERDICT r4 next #7): the <200ms p99 budget
+    includes host->device delta application (SURVEY §7 hard part (a)),
+    and deltasync was correctness-tested but never timed at scale.  Over
+    REAL unix sockets: a 10,240-node snapshot bootstrap
+    (StateSyncService -> wire -> StateSyncClient -> SchedulerBinding)
+    and a 1,024-row node_usage delta burst, each ending in the
+    snapshot's dirty-row device scatter (``flush``).  Host control-loop
+    path — ``rtt`` is unused (flush's device put is the measured part).
+    """
+    import tempfile
+
+    from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+    from koordinator_tpu.scheduler.scheduler import Scheduler
+    from koordinator_tpu.scheduler.snapshot import ClusterSnapshot
+    from koordinator_tpu.transport import (
+        RpcClient,
+        RpcServer,
+        StateSyncClient,
+        StateSyncService,
+    )
+    from koordinator_tpu.transport.deltasync import SchedulerBinding
+
+    n_nodes, n_burst = 10_240, 1_024
+    rng = np.random.default_rng(13)
+    alloc = np.zeros((n_nodes, NUM_RESOURCE_DIMS), np.int32)
+    alloc[:, 0] = rng.integers(8_000, 64_000, n_nodes)
+    alloc[:, 1] = rng.integers(16_384, 262_144, n_nodes)
+    usage = (alloc * 0.3).astype(np.int32)
+
+    service = StateSyncService()
+    for i in range(n_nodes):
+        service.upsert_node(f"n{i}", alloc[i], usage=usage[i])
+
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        server = RpcServer(os.path.join(tmp, "koord.sock"))
+        service.attach(server)
+        server.start()
+        sched = Scheduler(ClusterSnapshot(capacity=n_nodes))
+        sync = StateSyncClient(SchedulerBinding(sched))
+        client = RpcClient(server.path, on_push=sync.on_push)
+        client.connect()
+        try:
+            t0 = time.perf_counter()
+            applied = sync.bootstrap(client)
+            sched.snapshot.flush()
+            dt = time.perf_counter() - t0
+            out["deltasync_bootstrap_rows_per_sec_10240n"] = round(
+                n_nodes / dt, 1)
+            out["deltasync_bootstrap_wall_s"] = round(dt, 3)
+            if applied != n_nodes:
+                out["deltasync_bootstrap_error"] = (
+                    f"applied {applied}/{n_nodes}")
+
+            # usage burst: the NodeMetric refresh loop's wire shape
+            burst_usage = (alloc[:n_burst] * 0.6).astype(np.int32)
+            target_rv = service.rv + n_burst
+            t0 = time.perf_counter()
+            for i in range(n_burst):
+                service.update_node_usage(f"n{i}", burst_usage[i])
+            deadline = time.time() + 60
+            while sync.rv < target_rv and time.time() < deadline:
+                time.sleep(0.001)
+            shipped = sched.snapshot.flush()
+            dt = time.perf_counter() - t0
+            out["deltasync_burst_rows_per_sec_1024rows"] = round(
+                n_burst / dt, 1)
+            out["deltasync_burst_wall_ms"] = round(dt * 1e3, 2)
+            if sync.rv < target_rv:
+                out["deltasync_burst_error"] = (
+                    f"client rv {sync.rv} < {target_rv} after 60s")
+            if shipped != n_burst:
+                out.setdefault(
+                    "deltasync_burst_note",
+                    f"flush shipped {shipped} rows (burst {n_burst})")
+        finally:
+            client.close()
+            server.stop()
+    return out
+
+
 def _run_child(argv: list[str], timeout: float,
                env: dict | None = None) -> tuple[dict | None, str]:
     """Run a child bench process; (parsed-last-stdout-line, "") on
@@ -356,6 +490,7 @@ def _emit_zero_record(extra: dict,
     the parent's backend is the hung tunnel): a device-down round must
     still leave machine-readable evidence of the solver's quality at
     the north-star shape (VERDICT r3 item 5) instead of only a zero."""
+    extra.setdefault("provenance", _git_head())
     if device_down is None:
         # caller hit an error that MIGHT be the tunnel dying mid-run —
         # a fresh probe decides (60s: enough for a healthy tunnel)
@@ -365,21 +500,29 @@ def _emit_zero_record(extra: dict,
     # would also make the prober mark the round as captured)
     promotion_ok = os.environ.get(
         "KOORD_BENCH_NO_PROBE_PROMOTION", "").lower() in ("", "0", "false")
-    captured = (_latest_probe_capture()
+    skip_notes: list = []
+    captured = (_latest_probe_capture(notes=skip_notes)
                 if device_down and promotion_ok else None)
     if captured is not None:
         doc, source = captured
         doc.setdefault("extra", {})["probe_capture"] = {
             "source": source,
+            "capture_commit": (doc["extra"].get("provenance") or {}
+                               ).get("commit", ""),
+            "promoted_at_commit": extra["provenance"]["commit"],
+            "promoted_at_dirty": extra["provenance"]["dirty"],
             "note": "hardware record captured by tools/tpu_probe.sh "
                     "during a recent tunnel-up window (<12h, see source "
                     "timestamp); the tunnel was down at official bench "
-                    "time",
+                    "time; no solver-relevant file changed between the "
+                    "capture's commit and HEAD",
             "bench_time_error": str(extra.get("error", ""))[:300],
         }
         print(json.dumps(doc))
         sys.stdout.flush()
         os._exit(0)
+    if skip_notes:
+        extra["probe_capture_refused"] = skip_notes[:4]
     # Budget: the driver's own wall-clock limit is unknown but was
     # ~3600s historically; probes may already have burned ~660s, so
     # cap the sweep at 1500s — losing the sweep to the cap still
@@ -406,23 +549,37 @@ def _emit_zero_record(extra: dict,
 MAX_PROBE_CAPTURE_AGE_S = 12 * 3600.0
 
 
-def _latest_probe_capture(root: str | None = None) -> tuple[dict, str] | None:
+def _latest_probe_capture(
+    root: str | None = None, notes: list | None = None,
+) -> tuple[dict, str] | None:
     """Newest RECENT nonzero headline the prober captured, as (record,
     filename); None if none exists.  Only records for the SAME metric
     count — a capture from an older shape must not masquerade as the
     current headline — and only files younger than
     MAX_PROBE_CAPTURE_AGE_S (~one round of wall clock, by mtime):
     probe_results/ persists on disk, and a capture from a PREVIOUS
-    round must not be re-reported as this round's measurement."""
+    round must not be re-reported as this round's measurement.
+
+    Code provenance (VERDICT r4 weak #2): a capture is only promotable
+    when its stamped commit (``extra.provenance.commit``) is HEAD, or no
+    solver-relevant file (_SOLVER_PATHS) changed between the two —
+    doc/test churn between capture and round end is fine, a solver
+    change is not.  Unstamped captures are refused (nothing ties them to
+    any code).  Skip reasons accumulate into ``notes`` so the zero
+    record can say why a capture was not promoted."""
     import glob
 
     metric = f"solve_pods_per_sec_{N_PODS}p_{N_NODES}n"
     if root is None:
         root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "probe_results")
+    if notes is None:
+        notes = []
+    head = _git_head()["commit"]
     now = time.time()
     for path in sorted(glob.glob(os.path.join(root, "bench_*.json")),
                        reverse=True):
+        name = os.path.basename(path)
         try:
             if now - os.path.getmtime(path) > MAX_PROBE_CAPTURE_AGE_S:
                 continue
@@ -430,7 +587,7 @@ def _latest_probe_capture(root: str | None = None) -> tuple[dict, str] | None:
                 doc = json.loads(f.read().strip().splitlines()[-1])
         except (OSError, json.JSONDecodeError, IndexError):
             continue
-        if (isinstance(doc, dict) and doc.get("metric") == metric
+        if not (isinstance(doc, dict) and doc.get("metric") == metric
                 and isinstance(doc.get("value"), (int, float))
                 and doc["value"] > 0
                 # a record that is ITSELF a promotion (the prober ran
@@ -440,7 +597,31 @@ def _latest_probe_capture(root: str | None = None) -> tuple[dict, str] | None:
                 # capture's age window on every promotion, laundering
                 # one old measurement into every future round
                 and "probe_capture" not in (doc.get("extra") or {})):
-            return doc, os.path.basename(path)
+            continue
+        prov = (doc.get("extra") or {}).get("provenance") or {}
+        cap_commit = prov.get("commit", "")
+        if prov.get("dirty"):
+            # a capture from a dirty tree measured code that no commit
+            # records — the solver diff below cannot see uncommitted
+            # edits, so the stamp is unverifiable by construction
+            notes.append(
+                f"{name}: refused — captured on a dirty tree at "
+                f"{cap_commit[:12]}; uncommitted solver edits are "
+                "unverifiable")
+            continue
+        changed = _solver_diff(cap_commit, head)
+        if changed is None:
+            notes.append(
+                f"{name}: refused — capture commit "
+                f"{cap_commit[:12] or '(unstamped)'} unverifiable vs HEAD "
+                f"{head[:12]}")
+            continue
+        if changed:
+            notes.append(
+                f"{name}: refused — solver files changed since capture "
+                f"commit {cap_commit[:12]}: {sorted(changed)[:5]}")
+            continue
+        return doc, name
     return None
 
 
@@ -537,6 +718,7 @@ def main() -> None:
     assigned_frac = solve_count / float(pods.valid.sum())
 
     extra = {
+        "provenance": _git_head(),
         f"filter_score_pods_per_sec_{N_PODS}p_{N_NODES}n": round(
             score_pods_per_sec, 1
         ),
@@ -569,7 +751,8 @@ def main() -> None:
             extra[f"solve_{method}"] = t
     # extras run in CHILD processes: even a device OOM abort or backend
     # SIGABRT in a config cannot cost the already-measured headline
-    for name in ("quota", "gang", "lownodeload", "colocation"):
+    for name in ("quota", "gang", "lownodeload", "colocation",
+                 "deltasync"):
         result, err = _run_child(["--extra", name], timeout=900)
         if result is not None:
             extra.update(result)
@@ -599,9 +782,41 @@ def _cpu_quality_main() -> None:
     from __graft_entry__ import _build_problem
     from koordinator_tpu.ops.batch_assign import batch_assign
 
+    out: dict = {"cpu_quality_shape": f"{N_PODS}p_{N_NODES}n"}
+
+    # CPU wall-clock regression bound (VERDICT r4 weak #1): with the
+    # tunnel down for three straight rounds, nothing guarded solver
+    # SPEED — a slowdown would ride free until hardware returned.
+    # Median-of-3 jitted solve wall time per candidate method at a mid
+    # shape: not a hardware number, a tripwire cheap enough to repeat
+    # that still exposes an accidental O(P*N) materialization or an
+    # extra pass.  Runs FIRST so a parent timeout during the expensive
+    # at-shape sweep below cannot lose it (children print cumulatively).
+    bp, bn = 12_800, 2_560
+    bstate, bpods, bcfg = _build_problem(bn, bp, seed=42)
+    for method, k in (("exact", 16), ("approx", 16), ("approx", 8),
+                      ("chunked", 16)):
+        fn = jax.jit(lambda s, p, k=k, m=method: batch_assign(
+            s, p, bcfg, k=k, method=m)[0])
+        try:
+            asn = np.asarray(fn(bstate, bpods))  # compile + warm
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(fn(bstate, bpods))
+                times.append(time.perf_counter() - t0)
+            out[f"cpu_wall_s_med3_{method}_k{k}_{bp}p_{bn}n"] = round(
+                float(np.median(times)), 3)
+            out[f"cpu_assigned_frac_{method}_k{k}_{bp}p_{bn}n"] = round(
+                float((asn >= 0).sum())
+                / float(np.asarray(bpods.valid).sum()), 4)
+        except Exception as e:
+            out[f"cpu_wall_{method}_k{k}_error"] = repr(e)[:200]
+        print(json.dumps(out))
+        sys.stdout.flush()
+
     state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
     valid = int(np.asarray(pods.valid).sum())
-    out: dict = {"cpu_quality_shape": f"{N_PODS}p_{N_NODES}n"}
     for k in (16, 32):
         t0 = time.perf_counter()
         asn, st = jax.jit(
@@ -630,7 +845,8 @@ def _extra_main(name: str) -> None:
     rtt, _ = _median_readback_seconds(jax.jit(rtt_floor), (state,), n=3)
     fn = {"quota": _bench_quota, "gang": _bench_gang,
           "lownodeload": _bench_lownodeload,
-          "colocation": _bench_colocation}[name]
+          "colocation": _bench_colocation,
+          "deltasync": _bench_deltasync}[name]
     print(json.dumps(fn(rtt)))
 
 

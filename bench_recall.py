@@ -1,0 +1,138 @@
+"""Candidate-recall measurement: approx_max_k vs exact top_k (VERDICT r4
+next #6).
+
+``method="auto"`` serves ``approx`` (``jax.lax.approx_max_k``,
+recall_target=0.95) on TPU, but the CPU lowering of approx_max_k is exact —
+so the 100%-assignment guarantee behind the TPU default has only ever been
+validated on a backend where the reduction is NOT approximate.  This script
+produces the data that validates (or flips) the default on the backend where
+it matters:
+
+- per-pod candidate recall of ``method="approx"`` against ``method="exact"``
+  at 2,048 pods x 10,240 nodes (same k, same stratified spread_bits);
+- solve quality (assigned fraction + mean chosen node score) for both
+  methods at that shape;
+- assigned fraction at the 50k x 10,240 north-star shape for approx and
+  chunked (exact too when the backend has the memory for the (P, N)
+  materialization — guarded, skipped on OOM).
+
+Decision rule recorded alongside the data: if at-shape
+``assigned_frac_approx`` < 0.99 on TPU, flip ``batch_assign``'s
+``method="auto"`` TPU arm to "chunked"-with-exact-reduction or "exact"
+(ops/batch_assign.py:284) and re-measure.
+
+Prints ONE JSON line.  Env knobs KOORD_RECALL_NODES / KOORD_RECALL_PODS /
+KOORD_RECALL_SHAPE_PODS shrink the shapes for CI smoke (the at-shape leg is
+skipped when KOORD_RECALL_SHAPE_PODS=0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+K = 16
+
+
+def _chosen_scores(state, pods, cfg, assignments):
+    """Mean raw score of each assigned pod's chosen node (score scale of
+    ops/scoring.py, before ranking-key quantization)."""
+    from koordinator_tpu.ops.assignment import score_pods
+
+    scores, _ = jax.jit(score_pods)(state, pods, cfg)
+    scores = np.asarray(scores)
+    asn = np.asarray(assignments)
+    mask = asn >= 0
+    if not mask.any():
+        return 0.0
+    return float(scores[np.arange(len(asn))[mask], asn[mask]].mean())
+
+
+def _recall_leg(n_nodes: int, n_pods: int, out: dict) -> None:
+    from __graft_entry__ import _build_problem
+    from koordinator_tpu.ops.batch_assign import batch_assign, select_candidates
+
+    state, pods, cfg = _build_problem(n_nodes, n_pods, seed=42)
+    sel = jax.jit(select_candidates, static_argnames=("k", "method"))
+    _, exact_nodes = sel(state, pods, cfg, k=K, method="exact")
+    _, approx_nodes = sel(state, pods, cfg, k=K, method="approx")
+    exact_nodes = np.asarray(exact_nodes)
+    approx_nodes = np.asarray(approx_nodes)
+
+    # per-pod recall of the exact candidate SET (strata may duplicate a
+    # node across slots; set semantics measure what the rounds can use)
+    recalls = np.empty(n_pods, np.float64)
+    for i in range(n_pods):
+        e = set(exact_nodes[i].tolist())
+        a = set(approx_nodes[i].tolist())
+        recalls[i] = len(e & a) / max(len(e), 1)
+    out[f"candidate_recall_mean_{n_pods}p_{n_nodes}n"] = round(
+        float(recalls.mean()), 4)
+    out[f"candidate_recall_p10_{n_pods}p_{n_nodes}n"] = round(
+        float(np.percentile(recalls, 10)), 4)
+    out[f"candidate_recall_min_{n_pods}p_{n_nodes}n"] = round(
+        float(recalls.min()), 4)
+
+    solve = jax.jit(batch_assign, static_argnames=("k", "method"))
+    valid = float(np.asarray(pods.valid).sum())
+    for method in ("exact", "approx"):
+        asn, _, _ = solve(state, pods, cfg, k=K, method=method)
+        frac = float((np.asarray(asn) >= 0).sum()) / valid
+        out[f"assigned_frac_{method}_{n_pods}p_{n_nodes}n"] = round(frac, 4)
+        out[f"mean_chosen_score_{method}_{n_pods}p_{n_nodes}n"] = round(
+            _chosen_scores(state, pods, cfg, asn), 1)
+
+
+def _at_shape_leg(n_nodes: int, n_pods: int, out: dict) -> None:
+    from __graft_entry__ import _build_problem
+    from koordinator_tpu.ops.batch_assign import batch_assign
+
+    state, pods, cfg = _build_problem(n_nodes, n_pods, seed=42)
+    valid = float(np.asarray(pods.valid).sum())
+    solve = jax.jit(batch_assign, static_argnames=("k", "method"))
+    # exact last: it is the one that can OOM (full (P, N) materialization)
+    for method in ("approx", "chunked", "exact"):
+        try:
+            t0 = time.perf_counter()
+            asn, _, _ = solve(state, pods, cfg, k=K, method=method)
+            frac = float((np.asarray(asn) >= 0).sum()) / valid
+            out[f"shape_assigned_frac_{method}_{n_pods}p_{n_nodes}n"] = (
+                round(frac, 4))
+            out[f"shape_wall_s_{method}_{n_pods}p_{n_nodes}n"] = round(
+                time.perf_counter() - t0, 1)
+        except Exception as e:
+            out[f"shape_{method}_error"] = repr(e)[:200]
+
+
+def main() -> None:
+    from bench import _git_head
+
+    n_nodes = int(os.environ.get("KOORD_RECALL_NODES", "10240"))
+    n_pods = int(os.environ.get("KOORD_RECALL_PODS", "2048"))
+    shape_pods = int(os.environ.get("KOORD_RECALL_SHAPE_PODS", "50000"))
+
+    out: dict = {
+        "backend": jax.default_backend(),
+        "provenance": _git_head(),
+        "k": K,
+        "note": "approx_max_k recall vs exact top_k; CPU lowering of "
+                "approx_max_k is exact, so only a tpu backend row "
+                "validates the method='auto' TPU default",
+        "decision_rule": "flip auto's TPU arm off 'approx' if "
+                         "shape_assigned_frac_approx < 0.99 on tpu",
+    }
+    _recall_leg(n_nodes, n_pods, out)
+    if shape_pods:
+        _at_shape_leg(n_nodes, shape_pods, out)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    main()

@@ -51,6 +51,25 @@ from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
 _TB_BITS = 15  # supports node capacities up to 32768
 _SCORE_CLIP = (1 << 30 - _TB_BITS) - 1
 
+#: hard node-capacity ceiling of the int32 ranking key: the rotated node
+#: index must fit _TB_BITS low bits or it aliases into the score field and
+#: candidates silently mis-rank.  Shapes are static under jit, so this is
+#: enforced at trace time — a 40k-node problem fails loudly instead.
+MAX_NODE_CAPACITY = 1 << _TB_BITS
+
+
+def check_node_capacity(n: int) -> None:
+    """Raise if a node capacity exceeds the ranking key's ceiling."""
+    if n > MAX_NODE_CAPACITY:
+        raise ValueError(
+            f"node capacity {n} exceeds the batched solver's ranking-key "
+            f"ceiling of {MAX_NODE_CAPACITY} (= 2**{_TB_BITS}): the rotated "
+            "node index would alias into the score bits and mis-rank "
+            "candidates.  Mesh sharding does not help — shapes stay global "
+            "under GSPMD.  Partition the cluster into <=32768-node node "
+            "pools solved independently, or widen the packing to a 64-bit "
+            "key (_TB_BITS) off-TPU.")
+
 
 def _ranked_scores(
     scores: jnp.ndarray, feasible: jnp.ndarray, spread_bits: int = 0,
@@ -72,6 +91,7 @@ def _ranked_scores(
     interchangeable: defaultPodTopologySpread jitter, selectHost randomness).
     """
     p, n = scores.shape
+    check_node_capacity(n)
     # per-pod offset; row_offset keeps chunked reductions rotating by the
     # GLOBAL pod index, so chunking never changes any pod's candidates
     rot = ((jnp.arange(p, dtype=jnp.int32) + row_offset) * 7919)[:, None]

@@ -50,7 +50,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from koordinator_tpu.ops.assignment import ScoringConfig
-from koordinator_tpu.ops.batch_assign import _TB_BITS, _SCORE_CLIP
+from koordinator_tpu.ops.batch_assign import (
+    _SCORE_CLIP,
+    _TB_BITS,
+    check_node_capacity,
+)
 from koordinator_tpu.ops.scoring import MAX_NODE_SCORE, exact_floordiv
 from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
 
@@ -282,6 +286,7 @@ def fused_score_topk(
                          "XLA path")
     p = pods.capacity
     n = state.capacity
+    check_node_capacity(n)
     r = pods.requests.shape[1]
     tp = min(tile_pods, p)
     nc = min(n_chunk, n)

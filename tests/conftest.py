@@ -25,3 +25,17 @@ if not os.environ.get("KOORD_TEST_TPU"):
 from koordinator_tpu import native as _native  # noqa: E402
 
 _native.ensure_built()
+
+
+def prop_seeds(default_n: int) -> list[int]:
+    """Seed list for the randomized property suites.
+
+    CI runs the fixed ``range(default_n)``; the soak harness
+    (tools/soak.sh) sweeps FRESH seeds by setting
+    ``KOORD_PROP_SEED_BASE`` (window start) and ``KOORD_PROP_SEED_COUNT``
+    (window size, 0 = each suite's default count).  Every suite keeps its
+    own default so CI cost stays where it was tuned, while one env knob
+    re-aims all of them at an arbitrary seed window."""
+    base = int(os.environ.get("KOORD_PROP_SEED_BASE", "0"))
+    count = int(os.environ.get("KOORD_PROP_SEED_COUNT", "0") or 0)
+    return list(range(base, base + (count or default_n)))

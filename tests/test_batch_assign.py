@@ -382,3 +382,39 @@ def test_batch_quality_tracks_greedy_approx():
 
 def test_batch_quality_tracks_greedy_chunked():
     _batch_quality_tracks_greedy("chunked")
+
+
+def test_node_capacity_ceiling_raises_loud():
+    """>2**15 nodes must fail at trace time, not silently mis-rank: the
+    ranking key packs the rotated node index into _TB_BITS low bits
+    (batch_assign.py) and a 40k-node problem would alias into the score
+    field."""
+    import pytest
+
+    from koordinator_tpu.ops.batch_assign import (
+        MAX_NODE_CAPACITY,
+        check_node_capacity,
+        select_candidates,
+    )
+
+    check_node_capacity(MAX_NODE_CAPACITY)  # boundary is allowed
+    with pytest.raises(ValueError, match="ranking-key ceiling"):
+        check_node_capacity(MAX_NODE_CAPACITY + 1)
+
+    state = mk_state([16_000] * 40_960)
+    pods = mk_pods([500] * 4, node_capacity=state.capacity)
+    for method in ("exact", "approx", "chunked"):
+        with pytest.raises(ValueError, match="ranking-key ceiling"):
+            select_candidates(state, pods, cfg(), k=8, method=method)
+
+
+def test_node_capacity_at_boundary_solves():
+    """Exactly 2**15 nodes still solves correctly (the assert is not
+    off-by-one): a small pod batch assigns with no overcommit."""
+    from koordinator_tpu.ops.batch_assign import MAX_NODE_CAPACITY
+
+    state = mk_state([16_000] * MAX_NODE_CAPACITY)
+    pods = mk_pods([500] * 8, node_capacity=state.capacity)
+    asn, st, _ = batch_assign(state, pods, cfg(), k=8, method="exact")
+    assert int((np.asarray(asn) >= 0).sum()) == 8
+    assert_no_overcommit(state, pods, asn)

@@ -33,26 +33,32 @@ def test_stage_profiler_smoke():
 
 def test_latest_probe_capture_selection(tmp_path):
     """The zero-record path promotes the prober's newest nonzero capture
-    for the CURRENT metric only — zero records, wrong shapes, and
-    garbage files are skipped."""
+    for the CURRENT metric only — zero records, wrong shapes, garbage
+    files, and captures without verifiable code provenance are skipped."""
     sys.path.insert(0, REPO)
-    from bench import _latest_probe_capture
+    from bench import _git_head, _latest_probe_capture
+
+    head = _git_head()["commit"]
+    assert head, "test must run inside the git repo"
+    stamp = f', "extra": {{"provenance": {{"commit": "{head}"}}}}'
 
     d = tmp_path / "probe_results"
     d.mkdir()
     assert _latest_probe_capture(str(d)) is None
     (d / "bench_1.json").write_text(
-        '{"metric": "solve_pods_per_sec_50000p_10240n", "value": 0.0}')
+        '{"metric": "solve_pods_per_sec_50000p_10240n", "value": 0.0'
+        + stamp + '}')
     (d / "bench_2.json").write_text("not json at all")
     (d / "bench_3.json").write_text(
-        '{"metric": "solve_pods_per_sec_10p_10n", "value": 99.0}')
+        '{"metric": "solve_pods_per_sec_10p_10n", "value": 99.0'
+        + stamp + '}')
     assert _latest_probe_capture(str(d)) is None
     (d / "bench_4.json").write_text(
         '{"metric": "solve_pods_per_sec_50000p_10240n", "value": 250001.5,'
-        ' "unit": "pods/s", "vs_baseline": 1.0}')
+        ' "unit": "pods/s", "vs_baseline": 1.0' + stamp + '}')
     (d / "bench_5.json").write_text(
         '{"metric": "solve_pods_per_sec_50000p_10240n", "value": 260000.0,'
-        ' "unit": "pods/s", "vs_baseline": 1.04}')
+        ' "unit": "pods/s", "vs_baseline": 1.04' + stamp + '}')
     doc, source = _latest_probe_capture(str(d))
     assert source == "bench_5.json" and doc["value"] == 260000.0
     # captures older than ~a round (12h by mtime) are from a PREVIOUS
@@ -71,5 +77,82 @@ def test_latest_probe_capture_selection(tmp_path):
     (d / "bench_6.json").write_text(
         '{"metric": "solve_pods_per_sec_50000p_10240n", "value": 270000.0,'
         ' "unit": "pods/s", "vs_baseline": 1.08,'
-        ' "extra": {"probe_capture": {"source": "bench_4.json"}}}')
+        ' "extra": {"probe_capture": {"source": "bench_4.json"},'
+        f' "provenance": {{"commit": "{head}"}}}}')
     assert _latest_probe_capture(str(d)) is None
+
+
+def test_probe_capture_commit_provenance(tmp_path):
+    """VERDICT r4 weak #2: a capture measured on a DIFFERENT commit with
+    solver changes in between must not become the official number — and
+    an unstamped capture ties to no code at all, so it is refused with a
+    recorded reason."""
+    sys.path.insert(0, REPO)
+    import subprocess
+
+    from bench import _git_head, _latest_probe_capture, _solver_diff
+
+    head = _git_head()["commit"]
+    rec = ('{"metric": "solve_pods_per_sec_50000p_10240n",'
+           ' "value": 250001.5, "unit": "pods/s", "vs_baseline": 1.0%s}')
+
+    d = tmp_path / "probe_results"
+    d.mkdir()
+    # unstamped: refused, with a note
+    (d / "bench_1.json").write_text(rec % "")
+    notes = []
+    assert _latest_probe_capture(str(d), notes=notes) is None
+    assert notes and "unverifiable" in notes[0]
+    # stamped with a commit git does not know: refused
+    (d / "bench_1.json").write_text(
+        rec % ', "extra": {"provenance": {"commit": "f00dfeed"}}')
+    notes = []
+    assert _latest_probe_capture(str(d), notes=notes) is None
+    assert notes and "unverifiable" in notes[0]
+    # stamped with an OLD commit that differs from HEAD by solver files:
+    # refused, naming the files (koordinator_tpu/ churn is guaranteed
+    # between any two round commits; pick one where the diff is nonempty)
+    log = subprocess.run(
+        ["git", "log", "--format=%H", "-n", "200"], capture_output=True,
+        text=True, cwd=REPO).stdout.split()
+    old_commit = next(
+        (c for c in log[1:] if _solver_diff(c, head)), None)
+    if old_commit is not None:
+        (d / "bench_1.json").write_text(
+            rec % f', "extra": {{"provenance": {{"commit": "{old_commit}"}}}}')
+        notes = []
+        assert _latest_probe_capture(str(d), notes=notes) is None
+        assert notes and "solver files changed" in notes[0]
+    # HEAD-stamped but captured on a DIRTY tree: the uncommitted solver
+    # edits the capture measured are invisible to any commit diff, so it
+    # is refused even at the same commit
+    (d / "bench_1.json").write_text(
+        rec % f', "extra": {{"provenance": '
+              f'{{"commit": "{head}", "dirty": true}}}}')
+    notes = []
+    assert _latest_probe_capture(str(d), notes=notes) is None
+    assert notes and "dirty tree" in notes[0]
+    # HEAD-stamped and clean: promoted
+    (d / "bench_1.json").write_text(
+        rec % f', "extra": {{"provenance": {{"commit": "{head}"}}}}')
+    doc, source = _latest_probe_capture(str(d))
+    assert source == "bench_1.json" and doc["value"] == 250001.5
+
+
+def test_bench_recall_smoke():
+    """bench_recall.py (the prober's approx-recall capture) must keep
+    producing a parseable record: tiny shape, at-shape leg off.  On CPU
+    approx_max_k lowers exactly, so only the float-key quantization can
+    cost recall — the mean should stay high."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", KOORD_RECALL_NODES="128",
+               KOORD_RECALL_PODS="256", KOORD_RECALL_SHAPE_PODS="0")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_recall.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["backend"] == "cpu"
+    assert rec["provenance"]["commit"]
+    assert rec["candidate_recall_mean_256p_128n"] >= 0.8
+    assert rec["assigned_frac_exact_256p_128n"] >= 0.9
+    assert rec["assigned_frac_approx_256p_128n"] >= 0.9

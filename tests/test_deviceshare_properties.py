@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tests.conftest import prop_seeds
+
 from koordinator_tpu.ops.deviceshare import (
     DEV_BINPACK,
     DEV_CORE,
@@ -57,7 +59,7 @@ def _random_pool(rng: np.random.Generator):
     return dev.replace(free=jnp.asarray(free)), n_nodes
 
 
-@pytest.mark.parametrize("seed", list(range(24)))
+@pytest.mark.parametrize("seed", prop_seeds(24))
 @pytest.mark.parametrize("strategy", [DEV_BINPACK, DEV_SPREAD])
 def test_allocate_on_node_invariants(seed, strategy):
     rng = np.random.default_rng(seed)

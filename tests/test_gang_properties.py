@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tests.conftest import prop_seeds
+
 from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
 from koordinator_tpu.ops.assignment import ScoringConfig
 from koordinator_tpu.ops.gang import GangInfo, gang_assign
@@ -66,7 +68,7 @@ def _random_problem(rng: np.random.Generator):
     return state, pods, gangs, members
 
 
-@pytest.mark.parametrize("seed", list(range(12)))
+@pytest.mark.parametrize("seed", prop_seeds(12))
 @pytest.mark.parametrize("solver", ["greedy", "batch"])
 def test_gang_invariants(seed, solver):
     rng = np.random.default_rng(seed)

@@ -19,6 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tests.conftest import prop_seeds
+
 from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
 from koordinator_tpu.descheduler.lownodeload import (
     LowNodeLoadArgs,
@@ -48,7 +50,7 @@ def _random_problem(rng: np.random.Generator):
     return cap, usage, pod_node, pod_usage, prio, evictable, counters
 
 
-@pytest.mark.parametrize("seed", list(range(24)))
+@pytest.mark.parametrize("seed", prop_seeds(24))
 def test_select_victims_invariants(seed):
     rng = np.random.default_rng(seed)
     (cap, usage, pod_node, pod_usage, prio, evictable,

@@ -19,6 +19,8 @@ exact closed form) asserting:
 import numpy as np
 import pytest
 
+from tests.conftest import prop_seeds
+
 from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
 from koordinator_tpu.ops.network_topology import (
     TopologyRequirements,
@@ -65,7 +67,7 @@ def _random_problem(rng: np.random.Generator):
             cpus, members, per_pod)
 
 
-@pytest.mark.parametrize("seed", list(range(20)))
+@pytest.mark.parametrize("seed", prop_seeds(20))
 def test_plan_invariants(seed):
     rng = np.random.default_rng(seed)
     (state, pods, mask, topo, node_block, cpus, members,

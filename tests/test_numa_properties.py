@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tests.conftest import prop_seeds
+
 from koordinator_tpu.ops.numa import (
     BIND_DEFAULT,
     BIND_FULL_PCPUS,
@@ -41,7 +43,7 @@ def _random_topo(rng: np.random.Generator):
                              socket_of.astype(np.int32)), n
 
 
-@pytest.mark.parametrize("seed", list(range(20)))
+@pytest.mark.parametrize("seed", prop_seeds(20))
 @pytest.mark.parametrize("bind", [BIND_DEFAULT, BIND_FULL_PCPUS,
                                   BIND_SPREAD_BY_PCPUS])
 def test_take_cpus_invariants(seed, bind):

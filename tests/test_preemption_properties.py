@@ -22,6 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tests.conftest import prop_seeds
+
 from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
 from koordinator_tpu.ops.preemption import (
     ScheduledPods,
@@ -72,7 +74,7 @@ def _fits_np(req, free):
     return (free >= req).all(axis=-1)
 
 
-@pytest.mark.parametrize("seed", list(range(20)))
+@pytest.mark.parametrize("seed", prop_seeds(20))
 def test_select_victims_invariants(seed):
     rng = np.random.default_rng(seed)
     state, sched, p_req, p_pri, p_quota, same_quota = _random_problem(rng)

@@ -20,6 +20,8 @@ not oversubscribed:
 import numpy as np
 import pytest
 
+from tests.conftest import prop_seeds
+
 from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
 from koordinator_tpu.quota.tree import ROOT, UNBOUNDED, QuotaTree
 
@@ -59,7 +61,7 @@ def _random_tree(rng: np.random.Generator) -> QuotaTree:
     return tree
 
 
-@pytest.mark.parametrize("seed", list(range(16)))
+@pytest.mark.parametrize("seed", prop_seeds(16))
 def test_runtime_invariants_hold_on_random_trees(seed):
     rng = np.random.default_rng(seed)
     tree = _random_tree(rng)

@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tests.conftest import prop_seeds
+
 from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
 from koordinator_tpu.ops.reservation import (
     ReservationSet,
@@ -36,7 +38,7 @@ def _random_set(rng: np.random.Generator, n_nodes: int):
                                 allocate_once=once)
 
 
-@pytest.mark.parametrize("seed", list(range(24)))
+@pytest.mark.parametrize("seed", prop_seeds(24))
 def test_allocation_ledger(seed):
     rng = np.random.default_rng(seed)
     rsv = _random_set(rng, n_nodes=4)
@@ -82,7 +84,7 @@ def test_allocation_ledger(seed):
         rsv = rsv2
 
 
-@pytest.mark.parametrize("seed", list(range(24)))
+@pytest.mark.parametrize("seed", prop_seeds(24))
 def test_nominate_best_fit(seed):
     rng = np.random.default_rng(100 + seed)
     n_nodes, n_pods = 4, int(rng.integers(1, 8))

@@ -14,6 +14,8 @@ stay exactly consistent with its host-side record of bound pods:
 import numpy as np
 import pytest
 
+from tests.conftest import prop_seeds
+
 from tests.test_scheduler import mk_scheduler, node, pod
 
 from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
@@ -48,7 +50,7 @@ def _ledger_ok(sched, bind_gen, node_gen) -> None:
     assert (requested[valid] <= alloc[valid]).all()
 
 
-@pytest.mark.parametrize("seed", list(range(10)))
+@pytest.mark.parametrize("seed", prop_seeds(10))
 def test_accounting_survives_random_churn(seed):
     rng = np.random.default_rng(seed)
     names = [f"n{i}" for i in range(5)]
@@ -169,7 +171,7 @@ def test_row_reuse_before_flush_keeps_new_charges():
     assert (req == 0).all(), f"release unbalanced: {req[:2]}"
 
 
-@pytest.mark.parametrize("seed", list(range(6)))
+@pytest.mark.parametrize("seed", prop_seeds(6))
 def test_kitchen_sink_churn_keeps_all_ledgers(seed):
     """The full-feature churn: pods carry quotas and gangs, reservations
     come and go, nodes flap — and THREE ledgers must stay exact after
@@ -284,7 +286,7 @@ def test_kitchen_sink_churn_keeps_all_ledgers(seed):
             f"seed {seed} step {step}: negative requested")
 
 
-@pytest.mark.parametrize("seed", list(range(6)))
+@pytest.mark.parametrize("seed", prop_seeds(6))
 def test_preemption_churn_keeps_ledgers(seed):
     """Preemption-heavy churn: a tight cluster where high-priority pods
     keep arriving forces PostFilter nominations and victim evictions
@@ -360,7 +362,7 @@ def test_preemption_churn_keeps_ledgers(seed):
     assert pod_seq > 0
 
 
-@pytest.mark.parametrize("seed", list(range(10)))
+@pytest.mark.parametrize("seed", prop_seeds(10))
 def test_migration_arbitration_respects_every_budget(seed):
     """Randomized arbitration: whatever the pending set looks like, the
     newly-allowed jobs never push any group past its budget — per node,

@@ -47,6 +47,11 @@ assert float(jax.jit(lambda a: (a @ a).sum())(x)) == 256.0 * 256 * 256' \
             > "$OUT/stages_$ts.jsonl" 2> "$OUT/stages_$ts.err"
         timeout 1200 python /root/repo/bench_micro.py \
             > "$OUT/micro_$ts.json" 2> "$OUT/micro_$ts.err"
+        # approx_max_k recall on the backend where it is actually
+        # approximate (VERDICT r4 next #6): candidate recall + at-shape
+        # assigned_frac for approx/chunked/exact, drives method="auto"
+        timeout 1800 python /root/repo/bench_recall.py \
+            > "$OUT/recall_$ts.json" 2> "$OUT/recall_$ts.err"
         echo "$(date -Is) capture done" >> "$OUT/probe.log"
         # a nonzero headline ends the hunt; a zero record (tunnel died
         # mid-capture) keeps probing for the next window
