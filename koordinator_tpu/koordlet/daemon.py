@@ -64,6 +64,15 @@ class Daemon:
             else None
         )
         self.metric_cache = mc.MetricCache(clock=clock)
+        # metric-history persistence (tsdb_storage.go:29 role): restore
+        # the previous incarnation's ring buffers so the NodeMetric
+        # aggregation windows (p95/p99 over the collect window) survive
+        # an agent restart instead of refilling from cold
+        self.metric_snapshot_path = os.path.join(
+            self.cfg.var_run_root, "metriccache.npz")
+        self.metric_cache.restore(self.metric_snapshot_path)
+        self.metric_snapshot_interval_seconds = 60.0
+        self._last_metric_snapshot = clock()
         self.states = StatesInformer(metric_cache=self.metric_cache, clock=clock)
         self.executor = ResourceUpdateExecutor(self.cfg, self.auditor)
         self.advisor = MetricsAdvisor(
@@ -228,6 +237,13 @@ class Daemon:
             writes = self.hook_reconciler.reconcile_once()
             self._pleg_dirty = False
             self._last_hook_reconcile = now
+        if (now - self._last_metric_snapshot
+                >= self.metric_snapshot_interval_seconds):
+            try:
+                self.metric_cache.snapshot(self.metric_snapshot_path)
+            except OSError:  # full/readonly disk must not stall the loop
+                pass
+            self._last_metric_snapshot = now
         if now - self._last_train >= self.train_interval_seconds:
             self.predict_server.gc()
             self.predict_server.train_once()
@@ -258,6 +274,13 @@ class Daemon:
 
     def stop(self) -> None:
         self._stop.set()
+        # final snapshot on shutdown (SIGTERM path: the binaries call
+        # stop()) so the next incarnation restores up-to-the-second
+        # windows, matching the TSDB's on-node persistence
+        try:
+            self.metric_cache.snapshot(self.metric_snapshot_path)
+        except OSError:
+            pass
         self.pleg.stop_watch()
         if self.gateway is not None:
             self.gateway.stop()

@@ -12,6 +12,8 @@ The same buffers feed the prediction histograms without copies.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from typing import Iterable, Mapping, Optional
@@ -184,6 +186,105 @@ class MetricCache:
             for key in stale:
                 del self._series[key]
         return len(stale)
+
+    # -- persistence (tsdb_storage.go:29 role) --
+    #
+    # The reference's metriccache is an embedded Prometheus TSDB persisted
+    # on the node, so a koordlet restart keeps its aggregation windows; the
+    # ring buffers must match that or the NodeMetric reporter publishes a
+    # "p95 over the window" computed from seconds of post-restart data
+    # while claiming the window's label, and suppress/evict run on cold
+    # data until the window refills.
+
+    def snapshot(self, path: str) -> None:
+        """Atomically write every series (and JSON-serializable KV
+        entries) to ``path`` (.npz).  Same tmp+``os.replace`` pattern as
+        the prediction checkpoints (prediction_server.py)."""
+        with self._lock:
+            keys = [
+                {"metric": m, "labels": dict(lbl)}
+                for m, lbl in self._series
+            ]
+            rings = list(self._series.values())
+            arrays = {
+                "ts": (np.stack([r.ts for r in rings])
+                       if rings else np.zeros((0, self.capacity))),
+                "values": (np.stack([r.values for r in rings])
+                           if rings else np.zeros((0, self.capacity))),
+                "head": np.asarray([r.head for r in rings], np.int64),
+                "count": np.asarray([r.count for r in rings], np.int64),
+            }
+            kv = {}
+            for k, v in self._kv.items():
+                try:
+                    if json.loads(json.dumps(v)) != v:
+                        # JSON round-trip changed the shape (int dict
+                        # keys become strings, tuples become lists) — a
+                        # restored value that differs from the stored one
+                        # would break consumers until the next collect;
+                        # skip it like the opaque objects below
+                        continue
+                except (TypeError, ValueError):
+                    continue   # opaque objects (topology structs) rebuild
+                kv[k] = v      # from collection after restart
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # unique tmp per call: the interval snapshot (tick thread) and the
+        # stop() shutdown snapshot can run concurrently; a shared tmp name
+        # would interleave writers and os.replace a corrupt file
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez_compressed(
+                    f, keys=np.asarray(json.dumps(keys)),
+                    kv=np.asarray(json.dumps(kv)), **arrays)
+            os.replace(tmp, path)
+        except BaseException:
+            # a failed write (full/readonly disk) must not strand a
+            # uniquely-named tmp per incarnation in var_run_root
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def restore(self, path: str) -> bool:
+        """Load a snapshot written by :meth:`snapshot`; False (and start
+        fresh) when absent or corrupt — a bad snapshot must never brick
+        agent startup.  A capacity change across restart keeps the newest
+        ``capacity`` samples per series."""
+        try:
+            if not os.path.exists(path):
+                return False
+            with np.load(path, allow_pickle=False) as z:
+                keys = json.loads(str(z["keys"]))
+                kv = json.loads(str(z["kv"]))
+                ts, values = z["ts"], z["values"]
+                head, count = z["head"], z["count"]
+            series: dict[tuple, _Ring] = {}
+            for i, key in enumerate(keys):
+                ring = _Ring(self.capacity)
+                cnt, hd = int(count[i]), int(head[i])
+                cap_stored = ts.shape[1]
+                # chronological order: oldest sample first
+                if cnt < cap_stored:
+                    idx = np.arange(cnt)
+                else:
+                    idx = np.arange(hd, hd + cap_stored) % cap_stored
+                idx = idx[-self.capacity:]
+                n = len(idx)
+                ring.ts[:n] = ts[i, idx]
+                ring.values[:n] = values[i, idx]
+                ring.count = n
+                ring.head = n % self.capacity
+                series[_series_key(key["metric"], key["labels"])] = ring
+        except Exception:  # noqa: BLE001 — truncated/corrupt npz (zip
+            # errors, bad JSON) => start fresh
+            return False
+        with self._lock:
+            self._series = series
+            for k, v in kv.items():
+                self._kv.setdefault(k, v)
+        return True
 
     # -- KV (device info, NUMA topology, etc.) --
 

