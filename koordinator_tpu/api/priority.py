@@ -53,6 +53,20 @@ def priority_class_of(priority: int) -> PriorityClass:
     return PriorityClass.NONE
 
 
+#: HP ("high priority" = Prod+Mid) band floor for the colocation formula
+#: (slo-controller/noderesource plugins/util/util.go:55 — HP.Used counts
+#: the pods batch capacity must stay out of the way of).  One definition
+#: shared by the manager's NodeMetric sum and the koordlet's wire-report
+#: aggregation: if these diverged, batch allocatable would differ by
+#: which path a record arrived on.
+HP_PRIORITY_MIN = 6000
+
+
+def is_hp_band(qos_class: str, priority: int) -> bool:
+    """Does a pod count as HP (Prod+Mid) for the colocation formula?"""
+    return qos_class not in ("BE",) and priority >= HP_PRIORITY_MIN
+
+
 def priority_band_tensor(priority):
     """Vectorized band classification: (P,) int32 priorities -> (P,) int8 bands."""
     band = jnp.zeros(priority.shape, dtype=jnp.int8)

@@ -57,6 +57,92 @@ class Assembled:
             stop()
 
 
+class ReconnectingSidecarClient:
+    """Lazy + reconnecting RPC client for a scheduler-sidecar socket —
+    ONE error policy shared by the koordlet's reporters and the
+    manager's colocation loop (two hand-rolled copies had already
+    diverged on RpcRemoteError handling, r5 review):
+
+    - dials lazily on first use: no boot-order constraint between
+      binaries (a missing sidecar costs the call/tick, not the process);
+    - ``on_connect(client)`` runs after every (re)dial — the manager's
+      ``sync.bootstrap`` rides here so its watch view resumes from
+      last_rv after a sidecar restart; a failed hook closes the fresh
+      client (no fd/reader-thread leak) and surfaces;
+    - REMOTE errors (the peer rejecting one request over a healthy
+      connection, e.g. unknown node before an upsert lands) pass
+      through WITHOUT tearing the shared connection down — closing
+      would kill other threads' in-flight calls and, for a watch
+      client, force a needless full resync;
+    - transport errors drop only the client the caller saw fail (a
+      racing caller may already have reconnected).
+    """
+
+    def __init__(self, addr: str, on_push=None, on_connect=None,
+                 timeout: float = 10.0):
+        import threading
+
+        self.addr = addr
+        self.on_push = on_push
+        self.on_connect = on_connect
+        self.timeout = timeout
+        self._client = None
+        self._lock = threading.Lock()
+
+    def ensure(self):
+        """Connected client, (re)dialing if needed."""
+        from koordinator_tpu.transport import RpcClient
+        from koordinator_tpu.transport.channel import RpcError
+
+        with self._lock:
+            if self._client is None or not self._client.connected:
+                self._close_locked()
+                client = RpcClient(self.addr, on_push=self.on_push,
+                                   timeout=self.timeout)
+                try:
+                    client.connect()
+                except OSError as e:
+                    raise RpcError(f"sidecar unreachable: {e}") from e
+                if self.on_connect is not None:
+                    try:
+                        self.on_connect(client)
+                    except BaseException:
+                        client.close()
+                        raise
+                self._client = client
+            return self._client
+
+    def call(self, *call_args, **call_kwargs):
+        # the lock covers only connect/reconnect/close: RpcClient.call
+        # is concurrency-safe (per-request waiter map), and holding the
+        # lock across a call would serialize caller threads behind a
+        # wedged sidecar for the full timeout each
+        from koordinator_tpu.transport.channel import (
+            RpcError,
+            RpcRemoteError,
+        )
+
+        client = self.ensure()
+        try:
+            return client.call(*call_args, **call_kwargs)
+        except RpcRemoteError:
+            raise
+        except (RpcError, OSError):
+            with self._lock:
+                if self._client is client:
+                    self._close_locked()
+            raise
+
+    def _close_locked(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+
 # ---- koordlet --------------------------------------------------------------
 
 def build_koordlet_parser() -> argparse.ArgumentParser:
@@ -178,72 +264,9 @@ def main_koordlet(argv: list[str], device_report_fn=None,
         from koordinator_tpu.koordlet.statesinformer import (
             NodeMetricReporter,
         )
-        from koordinator_tpu.transport import RpcClient
-        from koordinator_tpu.transport.channel import (
-            RpcError,
-            RpcRemoteError,
-        )
         from koordinator_tpu.transport.wire import FrameType
 
-        class SidecarClient:
-            """Lazy + reconnecting: the koordlet must not impose boot
-            order on the sidecar (connect on first use, reconnect after
-            a drop); a failed call surfaces to the reporter, which
-            counts it (report_failures) and retries next interval."""
-
-            def __init__(self, addr: str):
-                import threading as _threading
-
-                self.addr = addr
-                self._client = None
-                #: usage and device reports push from different threads;
-                #: one connect/reconnect at a time
-                self._lock = _threading.Lock()
-
-            def call(self, *call_args, **call_kwargs):
-                # the lock covers only connect/reconnect/close: RpcClient
-                # .call is concurrency-safe (per-request waiter map), and
-                # holding the lock across a call would serialize the
-                # usage and device report threads behind a wedged
-                # sidecar for the full 10s timeout each
-                with self._lock:
-                    if self._client is None or not self._client.connected:
-                        self._close_locked()
-                        client = RpcClient(self.addr, timeout=10.0)
-                        try:
-                            client.connect()
-                        except OSError as e:
-                            raise RpcError(
-                                f"sidecar unreachable: {e}") from e
-                        self._client = client
-                    client = self._client
-                try:
-                    return client.call(*call_args, **call_kwargs)
-                except RpcRemoteError:
-                    # the peer rejected the REQUEST over a healthy
-                    # connection (e.g. unknown node before the upsert
-                    # lands): closing here would kill the other
-                    # reporter's in-flight call on the shared socket
-                    raise
-                except RpcError:
-                    with self._lock:
-                        # transport failure: drop only the client we
-                        # saw fail — a racing caller may already have
-                        # reconnected
-                        if self._client is client:
-                            self._close_locked()
-                    raise
-
-            def _close_locked(self) -> None:
-                if self._client is not None:
-                    self._client.close()
-                    self._client = None
-
-            def close(self) -> None:
-                with self._lock:
-                    self._close_locked()
-
-        sidecar = SidecarClient(args.scheduler_sidecar_addr)
+        sidecar = ReconnectingSidecarClient(args.scheduler_sidecar_addr)
         daemon.sidecar_client = sidecar
 
         def push_usage(status) -> None:
@@ -270,6 +293,24 @@ def main_koordlet(argv: list[str], device_report_fn=None,
             arrays = {"usage": _np.asarray(usage, _np.int32)}
             if agg is not None:
                 arrays["agg_usage"] = _np.asarray(agg, _np.int32)
+            # the colocation formula's inputs ride along (SURVEY §3.2:
+            # Batch = Total - SafetyMargin - max(System, Reserved) -
+            # HP.Used): system daemon usage, and the HP (Prod+Mid)
+            # pod-usage sum — is_hp_band is the ONE definition shared
+            # with the manager's _hp_used_cpu NodeMetric fallback
+            from koordinator_tpu.api.priority import is_hp_band
+
+            arrays["sys_usage"] = _np.asarray(resource_vector({
+                "cpu": status.system_usage.cpu_milli,
+                "memory": status.system_usage.memory_bytes >> 20,
+            }), _np.int32)
+            hp_cpu = hp_mem = 0
+            for p in status.pods_metrics:
+                if is_hp_band(p.qos_class, p.priority):
+                    hp_cpu += p.usage.cpu_milli
+                    hp_mem += p.usage.memory_bytes >> 20
+            arrays["hp_usage"] = _np.asarray(resource_vector({
+                "cpu": hp_cpu, "memory": hp_mem}), _np.int32)
             sidecar.call(FrameType.STATE_PUSH,
                          {"kind": "node_usage", "name": args.node_name},
                          arrays)
@@ -512,6 +553,13 @@ def build_manager_parser() -> argparse.ArgumentParser:
              "YAML file (same keys: colocation-config, "
              "resource-threshold-config, ...) until the watched CM "
              "arrives; rejected loudly when invalid")
+    parser.add_argument(
+        "--scheduler-sidecar-addr", default="",
+        help="scheduler sidecar socket: watch node state + koordlet "
+             "usage reports from its sync service and push the "
+             "noderesource reconcile's batch/mid allocatable back as "
+             "node_allocatable events (the §3.2 colocation loop's "
+             "manager leg in wire form)")
     return parser
 
 
@@ -592,6 +640,46 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
         return changed
 
     component.update_sloconfig = update_sloconfig
+
+    if args.scheduler_sidecar_addr:
+        import numpy as _np
+
+        from koordinator_tpu.manager.colocation_loop import (
+            ColocationLoop,
+            ManagerSyncBinding,
+        )
+        from koordinator_tpu.transport import StateSyncClient
+        from koordinator_tpu.transport.wire import FrameType
+
+        binding = ManagerSyncBinding()
+        sync = StateSyncClient(binding)
+        # lazy like the koordlet's reporters: a manager deployed before
+        # the scheduler binary must not crash at assembly — the first
+        # tick's ensure_fn dials (and re-bootstraps the watch from
+        # last_rv after any reconnect)
+        sidecar = ReconnectingSidecarClient(
+            args.scheduler_sidecar_addr, on_push=sync.on_push,
+            on_connect=sync.bootstrap)
+
+        def push_allocatable(name: str, allocatable) -> None:
+            sidecar.call(
+                FrameType.STATE_PUSH,
+                {"kind": "node_allocatable", "name": name},
+                {"allocatable": _np.asarray(allocatable, _np.int32)})
+
+        component.sync_binding = binding
+        component.sync = sync
+        component.sync_client = sidecar
+        component.colocation_loop = ColocationLoop(
+            component.noderesource, binding, push_allocatable,
+            ensure_fn=sidecar.ensure)
+
+        def stop() -> None:
+            component.colocation_loop.stop()
+            sidecar.close()
+
+        component.stop = stop
+
     return Assembled(name="koord-manager", args=args, component=component,
                      elector=build_elector(args, lease_store))
 

@@ -1,0 +1,262 @@
+"""The §3.2 colocation loop's manager leg, over the wire.
+
+Reference shape (closed binary-to-binary here the way the reference
+closes it through the apiserver):
+
+    koordlet:   NodeMetric usage  -> apiserver       (here: sidecar
+                                                      node_usage frames)
+    manager:    noderesource_controller.go:71 Reconcile
+                -> plugins/batchresource/plugin.go:188
+                -> PATCH node.status.allocatable[batch-cpu...]
+                                                     (here: a
+                                                      node_allocatable
+                                                      push)
+    scheduler:  informer picks up the new allocatable -> BE pods
+                schedule against it                  (here: the
+                                                      SchedulerBinding
+                                                      applies the delta
+                                                      to device rows)
+
+:class:`ManagerSyncBinding` is the manager's informer view: a deltasync
+binding that tracks every node's base capacity and the koordlet-reported
+usage vectors.  :class:`ColocationLoop` turns that view into
+:class:`NodeRecord` rows, runs the batched reconcile
+(manager/noderesource_controller.py), and pushes each patch back as a
+``node_allocatable`` event — the merge event that cannot clobber the
+koordlet's device inventory the way a full node_upsert would.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from koordinator_tpu.api import crds
+from koordinator_tpu.api.resources import ResourceDim
+from koordinator_tpu.manager.noderesource_controller import (
+    NodeRecord,
+    NodeResourceController,
+)
+
+MIB = 1 << 20
+
+
+class _NodeView:
+    __slots__ = ("allocatable", "labels", "annotations", "usage",
+                 "sys_usage", "hp_usage", "usage_time")
+
+    def __init__(self):
+        self.allocatable: Optional[np.ndarray] = None
+        self.labels: dict = {}
+        self.annotations: dict = {}
+        self.usage: Optional[np.ndarray] = None
+        self.sys_usage: Optional[np.ndarray] = None
+        self.hp_usage: Optional[np.ndarray] = None
+        self.usage_time: float = 0.0
+
+
+class ManagerSyncBinding:
+    """Manager-side deltasync binding (the watch half of the loop).
+
+    Only node events matter to the noderesource reconcile; pod and
+    reservation events are accepted and dropped (the binding contract
+    requires every handler).  Thread-safety: deltas apply on the
+    RpcClient reader thread while ``ColocationLoop.tick`` reads on the
+    caller's — one lock, same discipline as SchedulerBinding.
+    """
+
+    def __init__(self, clock=time.time):
+        self.clock = clock
+        self.lock = threading.Lock()
+        self.nodes: dict[str, _NodeView] = {}
+        #: NodeRecord instances persist across ticks: the controller's
+        #: diff-threshold suppression lives in last_batch_* fields
+        self.records: dict[str, NodeRecord] = {}
+
+    def reset(self) -> None:
+        with self.lock:
+            self.nodes.clear()
+            self.records.clear()
+
+    def node_upsert(self, entry: dict, arrs: dict) -> None:
+        with self.lock:
+            view = self.nodes.setdefault(entry["name"], _NodeView())
+            view.allocatable = np.asarray(arrs["allocatable"], np.int32)
+            view.labels = dict(entry.get("labels", {}))
+            view.annotations = dict(entry.get("annotations") or {})
+            # a bootstrap snapshot replays merged node_usage arrays
+            # inside the upsert — dropping them here would compute
+            # HP.Used/System as 0 after a manager restart and
+            # over-advertise batch capacity for a report interval
+            if "usage" in arrs:
+                view.usage = np.asarray(arrs["usage"], np.int32)
+                view.usage_time = self.clock()
+            for field in ("sys_usage", "hp_usage"):
+                if field in arrs:
+                    setattr(view, field,
+                            np.asarray(arrs[field], np.int32))
+            # an upsert REPLACES the stored doc wholesale, wiping batch
+            # dims from the scheduler's allocatable — the record's
+            # diff-suppression state must not survive it, or the
+            # controller would suppress the re-push (old == new) and
+            # leave batch capacity at 0 until usage drifts
+            self.records.pop(entry["name"], None)
+
+    def node_usage(self, entry: dict, arrs: dict) -> None:
+        with self.lock:
+            view = self.nodes.get(entry["name"])
+            if view is None:
+                return
+            view.usage = np.asarray(arrs["usage"], np.int32)
+            if "sys_usage" in arrs:
+                view.sys_usage = np.asarray(arrs["sys_usage"], np.int32)
+            if "hp_usage" in arrs:
+                view.hp_usage = np.asarray(arrs["hp_usage"], np.int32)
+            view.usage_time = self.clock()
+
+    def node_alloc(self, entry: dict, arrs: dict) -> None:
+        # our own patches echo back as deltas; base capacity dims
+        # (CPU/MEMORY) are untouched by the batch/mid patch, so applying
+        # the echo cannot feed back into the formula
+        with self.lock:
+            view = self.nodes.get(entry["name"])
+            if view is None:
+                return
+            view.allocatable = np.asarray(arrs["allocatable"], np.int32)
+
+    def node_remove(self, name: str) -> None:
+        with self.lock:
+            self.nodes.pop(name, None)
+            self.records.pop(name, None)
+
+    # non-node events: the reconcile does not consume them
+    def node_devices(self, entry: dict) -> None:
+        pass
+
+    def pod_add(self, entry: dict, arrs: dict) -> None:
+        pass
+
+    def pod_remove(self, name: str) -> None:
+        pass
+
+    def reservation_upsert(self, entry: dict, arrs: dict) -> None:
+        pass
+
+    def reservation_remove(self, name: str) -> None:
+        pass
+
+
+class ColocationLoop:
+    """view -> NodeRecords -> batched reconcile -> node_allocatable push.
+
+    ``push_fn(name, allocatable)`` is the transport seam: the manager
+    binary wires it to a STATE_PUSH call on its sidecar client; tests
+    can call the service directly.  Tick-driven like the koordlet's
+    Daemon — the shell owns the cadence (``run`` is the convenience
+    loop for real deployments)."""
+
+    def __init__(self, controller: NodeResourceController,
+                 binding: ManagerSyncBinding,
+                 push_fn: Callable[[str, np.ndarray], None],
+                 ensure_fn: Optional[Callable[[], object]] = None):
+        self.controller = controller
+        self.binding = binding
+        self.push_fn = push_fn
+        #: reconnect seam: called at tick start so a dead watch
+        #: connection heals even on ticks that push nothing (the push
+        #: path alone would only reconnect when a patch fires)
+        self.ensure_fn = ensure_fn
+        self.ticks = 0
+        self.push_failures = 0
+        self.connect_failures = 0
+        self._stop = threading.Event()
+
+    def _build_records(self) -> list[NodeRecord]:
+        cpu, mem = int(ResourceDim.CPU), int(ResourceDim.MEMORY)
+        records = []
+        with self.binding.lock:
+            for name, view in self.binding.nodes.items():
+                if view.allocatable is None:
+                    continue
+                record = self.binding.records.get(name)
+                if record is None:
+                    record = self.binding.records[name] = NodeRecord(
+                        name=name, cpu_capacity_milli=0,
+                        mem_capacity_mib=0)
+                record.cpu_capacity_milli = int(view.allocatable[cpu])
+                record.mem_capacity_mib = int(view.allocatable[mem])
+                record.labels = dict(view.labels)
+                record.annotations = dict(view.annotations)
+                usage = (view.usage if view.usage is not None
+                         else np.zeros_like(view.allocatable))
+                sys_u = (view.sys_usage if view.sys_usage is not None
+                         else np.zeros_like(usage))
+                record.metric = (None if view.usage is None
+                                 else crds.NodeMetricStatus(
+                                     update_time=view.usage_time,
+                                     node_usage=crds.ResourceUsage(
+                                         cpu_milli=int(usage[cpu]),
+                                         memory_bytes=int(usage[mem]) * MIB),
+                                     system_usage=crds.ResourceUsage(
+                                         cpu_milli=int(sys_u[cpu]),
+                                         memory_bytes=int(sys_u[mem]) * MIB),
+                                 ))
+                hp = view.hp_usage
+                record.hp_used_cpu_milli = (
+                    None if hp is None else int(hp[cpu]))
+                record.hp_used_mem_mib = (
+                    None if hp is None else int(hp[mem]))
+                records.append(record)
+        return records
+
+    def tick(self) -> int:
+        """One reconcile round; returns the number of patches pushed."""
+        self.ticks += 1
+        if self.ensure_fn is not None:
+            try:
+                self.ensure_fn()
+            except Exception:  # noqa: BLE001 — sidecar down: reconcile
+                # over the frozen view anyway, retry next tick
+                self.connect_failures += 1
+        records = self._build_records()
+        patches = self.controller.reconcile(records)
+        pushed = 0
+        for patch in patches:
+            with self.binding.lock:
+                view = self.binding.nodes.get(patch.name)
+                if view is None or view.allocatable is None:
+                    continue
+                allocatable = view.allocatable.copy()
+            allocatable[ResourceDim.BATCH_CPU] = patch.batch_cpu_milli
+            allocatable[ResourceDim.BATCH_MEMORY] = patch.batch_mem_mib
+            allocatable[ResourceDim.MID_CPU] = patch.mid_cpu_milli
+            allocatable[ResourceDim.MID_MEMORY] = patch.mid_mem_mib
+            try:
+                self.push_fn(patch.name, allocatable)
+                pushed += 1
+            except Exception:  # noqa: BLE001 — a wedged sidecar costs
+                # this patch, not the loop; the diff state was already
+                # stamped, so force a re-sync next tick.  last_degraded
+                # must reset too: the degraded-suppression branch in
+                # reconcile() checks it INSTEAD of last_batch_cpu, so a
+                # dropped zeroing patch would otherwise never retry and
+                # the scheduler would keep advertising batch capacity on
+                # a node with expired metrics
+                self.push_failures += 1
+                record = self.binding.records.get(patch.name)
+                if record is not None:
+                    record.last_batch_cpu = -1
+                    record.last_degraded = False
+                    record.last_device_resources = None
+        return pushed
+
+    def run(self, interval_seconds: float = 60.0) -> None:  # pragma: no cover
+        while not self._stop.is_set():
+            self.tick()
+            self._stop.wait(interval_seconds)
+
+    def stop(self) -> None:
+        self._stop.set()

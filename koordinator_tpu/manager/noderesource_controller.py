@@ -49,6 +49,12 @@ class NodeRecord:
     #: prod reclaimable from the usage forecaster (mid-resource input)
     prod_reclaimable_cpu_milli: int = 0
     prod_reclaimable_mem_mib: int = 0
+    #: pre-aggregated HP (Prod+Mid) usage — set when the record comes
+    #: from the wire (the koordlet's node_usage hp_usage array) instead
+    #: of a full NodeMetric with per-pod rows; overrides the
+    #: pods_metrics sum when not None
+    hp_used_cpu_milli: Optional[int] = None
+    hp_used_mem_mib: Optional[int] = None
     #: last synced values (for diff-threshold / no-op patch suppression)
     last_batch_cpu: int = -1
     last_batch_mem: int = -1
@@ -237,19 +243,27 @@ class NodeResourceController:
     # ---- helper stages ------------------------------------------------------
 
     def _hp_used_cpu(self, record: NodeRecord) -> int:
+        from koordinator_tpu.api.priority import is_hp_band
+
+        if record.hp_used_cpu_milli is not None:
+            return record.hp_used_cpu_milli
         if record.metric is None:
             return 0
         return sum(
             p.usage.cpu_milli for p in record.metric.pods_metrics
-            if p.qos_class not in ("BE",) and p.priority >= 6000
+            if is_hp_band(p.qos_class, p.priority)
         )
 
     def _hp_used_mem(self, record: NodeRecord) -> int:
+        from koordinator_tpu.api.priority import is_hp_band
+
+        if record.hp_used_mem_mib is not None:
+            return record.hp_used_mem_mib
         if record.metric is None:
             return 0
         return sum(
             p.usage.memory_bytes // MIB for p in record.metric.pods_metrics
-            if p.qos_class not in ("BE",) and p.priority >= 6000
+            if is_hp_band(p.qos_class, p.priority)
         )
 
     def _degraded(self, record: NodeRecord, now: float) -> bool:

@@ -39,7 +39,16 @@ from koordinator_tpu.transport.wire import (
 Handler = Callable[[dict, dict[str, np.ndarray]],
                    tuple[dict, dict[str, np.ndarray] | None]]
 
-SEND_QUEUE_DEPTH = 256
+#: Outbound frames buffered per connection before the peer is declared
+#: stalled (poison + forced resync).  Sized to the DeltaLog retention
+#: window (deltasync.DeltaLog, 4096): a burst the delta log could replay
+#: WITHOUT a full-snapshot resync must not poison the wire first — with a
+#: tight producer loop the sender thread drains in ~5ms GIL slices, and
+#: the r5 deltasync bench measured a 1,024-event NodeMetric burst
+#: overflowing the old 256-deep queue at event 256, killing the watch.
+#: Poison now triggers exactly when falling behind means a resync is
+#: unavoidable anyway.
+SEND_QUEUE_DEPTH = 4096
 
 
 class RpcError(RuntimeError):
@@ -99,12 +108,29 @@ class _Conn:
         try:
             self.queue.put_nowait(None)
         except queue.Full:
-            pass  # sender will exit on the next send error
+            # cannot signal the sender through a full queue — sever
+            # directly; queued frames are lost, but a full queue means
+            # the peer stalled (poison semantics anyway)
+            try:
+                self.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     def _drain(self) -> None:
         while True:
             frame = self.queue.get()
             if frame is None:
+                # poison AFTER the backlog: already-queued frames (e.g.
+                # a response to an in-flight call whose side effect
+                # already applied) still reach the peer, THEN the wire
+                # is severed so the peer sees EOF and its reconnect
+                # logic fires — without the shutdown a stopped server's
+                # connections stay half-open and `connected` never
+                # flips (r5 manager-reconnect test caught this)
+                try:
+                    self.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
                 return
             try:
                 self.sock.sendall(frame.encode())

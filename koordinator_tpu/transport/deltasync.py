@@ -34,6 +34,7 @@ from koordinator_tpu.transport.wire import FrameType
 
 NODE_UPSERT = "node_upsert"
 NODE_USAGE = "node_usage"
+NODE_ALLOC = "node_allocatable"
 NODE_DEVICES = "node_devices"
 NODE_REMOVE = "node_remove"
 POD_ADD = "pod_add"
@@ -245,20 +246,33 @@ class StateSyncService:
 
     def update_node_usage(self, name: str, usage: np.ndarray,
                           agg_usage: np.ndarray | None = None,
-                          prod_usage: np.ndarray | None = None) -> int:
+                          prod_usage: np.ndarray | None = None,
+                          sys_usage: np.ndarray | None = None,
+                          hp_usage: np.ndarray | None = None) -> int:
         """The NodeMetric loop's wire form (SURVEY §3.2): refresh a
         node's USAGE without re-sending allocatable — what a koordlet's
         reporter knows.  The stored node entry merges the new usage so a
         later bootstrap snapshot carries it; live watchers get the
         NODE_USAGE delta.  Unknown node -> WireSchemaError (nothing
         enters the log: usage for a node nobody registered is a peer
-        bug, and replaying it would apply to nothing)."""
+        bug, and replaying it would apply to nothing).
+
+        ``sys_usage`` (system daemons outside kube pods) and
+        ``hp_usage`` (Prod+Mid pods: non-BE, priority >= mid band) are
+        the colocation formula's inputs (slo-controller/noderesource
+        plugins/util/util.go:55: Batch = Total - SafetyMargin -
+        max(System, Reserved) - HP.Used) — a manager watch client
+        consumes them; the scheduler binding ignores them."""
         arrays: dict[str, np.ndarray] = {
             "usage": np.asarray(usage, np.int32)}
         if agg_usage is not None:
             arrays["agg_usage"] = np.asarray(agg_usage, np.int32)
         if prod_usage is not None:
             arrays["prod_usage"] = np.asarray(prod_usage, np.int32)
+        if sys_usage is not None:
+            arrays["sys_usage"] = np.asarray(sys_usage, np.int32)
+        if hp_usage is not None:
+            arrays["hp_usage"] = np.asarray(hp_usage, np.int32)
         with self._lock:
             entry = self.nodes.get(name)
             if entry is None:
@@ -267,6 +281,31 @@ class StateSyncService:
             entry["arrays"] = dict(entry["arrays"], **arrays)
             rv = self._commit_locked(
                 {"kind": NODE_USAGE, "name": name}, arrays)
+        if self._local_bindings:
+            self._drain_bindings()
+        return rv
+
+    def update_node_allocatable(self, name: str,
+                                allocatable: np.ndarray) -> int:
+        """The noderesource controller's wire form (SURVEY §3.2's
+        manager leg): replace a node's ALLOCATABLE vector without
+        touching its usage, labels, taints, or device inventory — the
+        tensor analog of the reference's node-status extended-resource
+        patch (slo-controller/noderesource/noderesource_controller.go:71
+        -> plugins/batchresource/plugin.go:188 -> PATCH node.status).  A
+        full node_upsert from the manager would clobber the koordlet's
+        device inventory (upsert replaces the stored doc wholesale);
+        this event merges.  Unknown node -> WireSchemaError, same rule
+        as node_usage."""
+        arrays = {"allocatable": np.asarray(allocatable, np.int32)}
+        with self._lock:
+            entry = self.nodes.get(name)
+            if entry is None:
+                raise wire.WireSchemaError(
+                    f"node_allocatable for unknown node {name!r}")
+            entry["arrays"] = dict(entry["arrays"], **arrays)
+            rv = self._commit_locked(
+                {"kind": NODE_ALLOC, "name": name}, arrays)
         if self._local_bindings:
             self._drain_bindings()
         return rv
@@ -457,13 +496,19 @@ class StateSyncService:
                 devices=doc.get("devices"))
         elif kind == NODE_USAGE:
             require_vector("usage")
-            for optional in ("agg_usage", "prod_usage"):
+            for optional in ("agg_usage", "prod_usage", "sys_usage",
+                             "hp_usage"):
                 if optional in arrays:
                     require_vector(optional)
             rv = self.update_node_usage(
                 name, arrays["usage"],
                 agg_usage=arrays.get("agg_usage"),
-                prod_usage=arrays.get("prod_usage"))
+                prod_usage=arrays.get("prod_usage"),
+                sys_usage=arrays.get("sys_usage"),
+                hp_usage=arrays.get("hp_usage"))
+        elif kind == NODE_ALLOC:
+            require_vector("allocatable")
+            rv = self.update_node_allocatable(name, arrays["allocatable"])
         elif kind == NODE_DEVICES:
             if not isinstance(doc.get("devices"), dict):
                 raise wire.WireSchemaError(
@@ -633,6 +678,8 @@ def _dispatch_event(binding, entry: dict,
         binding.node_upsert(entry, arrs)
     elif kind == NODE_USAGE:
         binding.node_usage(entry, arrs)
+    elif kind == NODE_ALLOC:
+        binding.node_alloc(entry, arrs)
     elif kind == NODE_DEVICES:
         binding.node_devices(entry)
     elif kind == NODE_REMOVE:
@@ -772,6 +819,20 @@ class SchedulerBinding:
                 prod_usage=(np.asarray(arrs["prod_usage"], np.int32)
                             if "prod_usage" in arrs else usage),
             ))
+
+    def node_alloc(self, entry: dict, arrs: dict[str, np.ndarray]) -> None:
+        """Allocatable-only refresh (the manager's noderesource patch):
+        keep the node's usage/labels/devices, swap its allocatable row.
+        Unknown node: drop, same as node_usage."""
+        import dataclasses as _dc
+
+        with self.scheduler.lock:
+            spec = self.scheduler.snapshot.node_specs.get(entry["name"])
+            if spec is None:
+                return
+            self.scheduler.snapshot.upsert_node(_dc.replace(
+                spec, allocatable=np.asarray(arrs["allocatable"],
+                                             np.int32)))
 
     def node_remove(self, name: str) -> None:
         with self.scheduler.lock:

@@ -323,3 +323,131 @@ def test_device_inventory_loop_over_the_wire(tmp_path):
         if koordlet_asm is not None:
             koordlet_asm.component.stop()
         sched_asm.stop()
+
+
+def test_colocation_loop_binary_to_binary(tmp_path):
+    """SURVEY §3.2 closed end to end over real sockets (VERDICT r4 next
+    #2): the koordlet BINARY reports node usage to the scheduler
+    sidecar, the manager BINARY's noderesource reconcile computes
+    batch allocatable from that usage and pushes a node_allocatable
+    event back through ITS sidecar client, and the scheduler binary's
+    next solve sees the new batch capacity — a BE pod with batch-cpu
+    requests goes from unschedulable to scheduled with no Python glue
+    between the three beyond their CLIs.  Reference shape:
+    slo-controller/noderesource/noderesource_controller.go:71 ->
+    plugins/batchresource/plugin.go:188 -> node status patch ->
+    scheduler informer."""
+    import time
+
+    import jax.numpy as jnp
+
+    from koordinator_tpu.api.resources import ResourceDim
+    from koordinator_tpu.cmd.binaries import (
+        main_koord_manager,
+        main_koord_scheduler,
+        main_koordlet,
+    )
+
+    sched_asm = main_koord_scheduler([
+        "--node-capacity", "8",
+        "--listen-socket", str(tmp_path / "colo.sock"),
+        "--disable-leader-election",
+    ])
+    cfg = make_test_config(tmp_path)
+    os.makedirs(cfg.proc_root, exist_ok=True)
+
+    def write_proc(total_jiffies):
+        with open(cfg.proc_path("stat"), "w") as f:
+            f.write(f"cpu  {total_jiffies} 0 0 1000 0 0 0 0 0 0\n")
+        with open(cfg.proc_path("meminfo"), "w") as f:
+            f.write("MemTotal: 16777216 kB\nMemAvailable: 12582912 kB\n"
+                    "Cached: 0 kB\nBuffers: 0 kB\nMemFree: 12582912 kB\n")
+
+    koordlet_asm = manager_asm = None
+    try:
+        scheduler = sched_asm.component
+        # the node registers with BASE capacity only — no batch dims yet
+        sched_asm.state_sync.upsert_node(
+            "n-colo", resource_vector(cpu=16_000, memory=16_384))
+
+        # a BE pod requesting batch resources: unschedulable while no
+        # node advertises batch capacity
+        sched_asm.state_sync.add_pod(
+            "be-1", resource_vector({
+                ext.RESOURCE_BATCH_CPU: 2_000,
+                ext.RESOURCE_BATCH_MEMORY: 1_024}),
+            priority=5500, qos=int(QoSClass.BE))
+        solve_client = RpcClient(sched_asm.server.path)
+        solve_client.connect()
+        result = solve_remote(solve_client)
+        assert "be-1" in result["failures"], result
+
+        # koordlet binary reports usage over the wire
+        write_proc(0)
+        koordlet_asm = main_koordlet([
+            "--cgroup-root-dir", cfg.cgroup_root,
+            "--proc-root-dir", cfg.proc_root,
+            "--sys-root-dir", cfg.sys_root,
+            "--scheduler-sidecar-addr", str(tmp_path / "colo.sock"),
+            "--node-name", "n-colo",
+            "--nodemetric-report-interval-seconds", "0",
+        ])
+        daemon = koordlet_asm.component
+        daemon.tick()
+        # the collector's cpu rate is jiffies-delta / wall-delta: keep
+        # the burn small and the gap large so the reported usage stays
+        # WELL under the loadaware threshold regardless of test-run
+        # timing (40 jiffies / >=0.5s <= 0.8 cores of 16) — the BE pod
+        # must be gated on BATCH CAPACITY, not on usage pressure
+        time.sleep(0.5)
+        write_proc(40)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            daemon.tick()
+            time.sleep(0.05)
+            stored = sched_asm.state_sync.nodes["n-colo"]["arrays"]
+            if int(np.asarray(stored.get(
+                    "usage", np.zeros(1)))[0]) > 0:
+                break
+        else:
+            raise AssertionError("koordlet usage never reached the sidecar")
+
+        # manager binary: watches the same sidecar, reconciles, pushes
+        manager_asm = main_koord_manager([
+            "--scheduler-sidecar-addr", str(tmp_path / "colo.sock"),
+        ])
+        manager = manager_asm.component
+        # the sidecar client dials lazily: the first tick bootstraps the
+        # watch and reconciles.  A transient cpu-rate spike (the jiffies
+        # delta over a tiny wall gap right after startup) can make the
+        # first reconcile legitimately compute batch=0 — the REAL system
+        # corrects on the next report+reconcile cadence, so the test
+        # keeps the whole loop ticking (fresh usage samples decay the
+        # rate, the manager re-pushes past the diff threshold) until the
+        # scheduler's device-resident allocatable carries the capacity.
+        row = scheduler.snapshot.node_index["n-colo"]
+        deadline = time.monotonic() + 30
+        batch_cpu = 0
+        while batch_cpu < 2_000 and time.monotonic() < deadline:
+            daemon.tick()
+            manager.colocation_loop.tick()
+            scheduler.snapshot.flush()
+            batch_cpu = int(np.asarray(
+                scheduler.snapshot.state.node_allocatable
+            )[row][int(ResourceDim.BATCH_CPU)])
+            time.sleep(0.1)
+        assert manager.colocation_loop.connect_failures == 0
+        assert batch_cpu >= 2_000, (
+            f"batch capacity {batch_cpu} too small for the BE pod "
+            f"(pushes={manager.colocation_loop.push_failures})")
+
+        # and the BE pod now schedules — over the same solve socket
+        result = solve_remote(solve_client)
+        assert result["assignments"].get("be-1") == "n-colo", result
+        solve_client.close()
+    finally:
+        if koordlet_asm is not None:
+            koordlet_asm.component.stop()
+        if manager_asm is not None:
+            manager_asm.component.stop()
+        sched_asm.stop()

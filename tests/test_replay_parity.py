@@ -14,6 +14,8 @@ import json
 import numpy as np
 import pytest
 
+from tests.conftest import prop_seeds
+
 from koordinator_tpu.api.resources import resource_vector
 from koordinator_tpu.transport import RpcClient, RpcServer
 from koordinator_tpu.transport.deltasync import (
@@ -47,7 +49,11 @@ def _fingerprint(sched):
             for n, st in sorted(cm._nodes.items())}
     rsv = sorted((s.name, s.requests.tolist(), s.allocate_once)
                  for s in sched.reservations.specs())
-    return dev, topo, sorted(sched.snapshot.node_index), rsv
+    rows = sorted(
+        (n, np.asarray(spec.allocatable).tolist(),
+         np.asarray(spec.usage).tolist())
+        for n, spec in sched.snapshot.node_specs.items())
+    return dev, topo, sorted(sched.snapshot.node_index), rsv, rows
 
 
 def _nrt(cores: int) -> dict:
@@ -57,7 +63,7 @@ def _nrt(cores: int) -> dict:
             json.dumps({"detail": detail})}
 
 
-@pytest.mark.parametrize("seed", list(range(8)))
+@pytest.mark.parametrize("seed", prop_seeds(8))
 def test_random_event_sequences_replay_identically(seed):
     rng = np.random.default_rng(seed)
     live = _mk_sched()
@@ -68,7 +74,7 @@ def test_random_event_sequences_replay_identically(seed):
     rsv_known: set[str] = set()
     pod_seq = 0
     for _ in range(120):
-        op = int(rng.integers(0, 12))
+        op = int(rng.integers(0, 14))
         name = f"n{int(rng.integers(0, 6))}"
         if op <= 4:
             # upsert with randomly present/absent devices + NRT
@@ -106,10 +112,28 @@ def test_random_event_sequences_replay_identically(seed):
                 allocate_once=bool(rng.random() < 0.5),
                 owners=[{"labels": {"app": rname}}])
             rsv_known.add(rname)
-        elif rsv_known:
+        elif op == 11 and rsv_known:
             target = sorted(rsv_known)[int(rng.integers(0, len(rsv_known)))]
             service.remove_reservation(target)
             rsv_known.discard(target)
+        elif op == 12 and known:
+            # the manager's node_allocatable patch: merged live AND into
+            # the stored doc, so replay must see the same row
+            target = sorted(known)[int(rng.integers(0, len(known)))]
+            service.update_node_allocatable(target, resource_vector({
+                "cpu": 8_000, "memory": 8_192,
+                "kubernetes.io/batch-cpu": int(rng.integers(0, 6_000)),
+                "kubernetes.io/batch-memory": int(rng.integers(0, 4_096)),
+            }))
+        elif op == 13 and known:
+            target = sorted(known)[int(rng.integers(0, len(known)))]
+            service.update_node_usage(
+                target,
+                resource_vector(cpu=int(rng.integers(0, 8_000)),
+                                memory=int(rng.integers(0, 8_192))),
+                sys_usage=resource_vector(cpu=100, memory=128),
+                hp_usage=resource_vector(
+                    cpu=int(rng.integers(0, 2_000)), memory=256))
 
     replay = _mk_sched()
     server = RpcServer("tcp://127.0.0.1:0")
@@ -131,7 +155,7 @@ def test_random_event_sequences_replay_identically(seed):
         server.stop()
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("seed", prop_seeds(4))
 def test_fallen_behind_client_resyncs_to_parity(seed):
     """The OTHER replay entry point: a client that connected early,
     disconnected, and fell behind the bounded delta-log retention gets a

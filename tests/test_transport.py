@@ -158,6 +158,35 @@ def test_sync_snapshot_deltas_and_solve_end_to_end(rpc):
     assert "p3" not in sched.pending
 
 
+def test_delta_burst_within_retention_survives_the_wire(rpc):
+    """A push burst the delta log could replay WITHOUT a full resync
+    must not poison the connection first: r5's deltasync bench caught a
+    1,024-event NodeMetric burst overflowing the old 256-deep per-conn
+    send queue at event 256 (the tight producer loop starves the sender
+    thread of GIL slices), silently killing the watch.  SEND_QUEUE_DEPTH
+    is now sized to the DeltaLog retention window."""
+    server, clients = rpc
+    service = StateSyncService()
+    service.attach(server)
+    server.start()
+    service.upsert_node("n1", resource_vector(cpu=16_000, memory=65_536))
+
+    sched = mk_scheduler()
+    sync = StateSyncClient(SchedulerBinding(sched))
+    client = connect(server, clients, on_push=sync.on_push)
+    sync.bootstrap(client)
+
+    n_burst = 1_024
+    for i in range(n_burst):
+        service.update_node_usage(
+            "n1", resource_vector(cpu=100 + i, memory=1_024))
+    wait_until(lambda: sync.rv == service.rv, timeout=30.0)
+    assert client.connected, "burst poisoned the connection"
+    assert sync.applied >= n_burst
+    spec = sched.snapshot.node_specs["n1"]
+    assert spec.usage[0] == 100 + n_burst - 1   # last update won
+
+
 def test_sync_reconnect_resumes_from_rv(rpc):
     server, clients = rpc
     service = StateSyncService()
@@ -914,3 +943,63 @@ def test_node_remove_clears_fine_grained_registries(tmp_path):
         assert cm.node("n-rm") is None
     finally:
         asm.stop()
+
+
+def test_node_allocatable_push_merges_without_clobbering(rpc):
+    """The noderesource controller's wire form: a node_allocatable push
+    replaces ONLY the allocatable vector — usage, labels, and the stored
+    doc's devices survive — and the merged value rides a later bootstrap
+    snapshot.  Unknown node fails the call without touching the log."""
+    import pytest as _pytest
+
+    from koordinator_tpu.api import extension as ext
+    from koordinator_tpu.transport.channel import RpcRemoteError
+    from koordinator_tpu.transport.wire import FrameType
+
+    server, clients = rpc
+    service = StateSyncService()
+    service.attach(server)
+    server.start()
+    service.upsert_node(
+        "n1", resource_vector(cpu=16_000, memory=65_536),
+        usage=resource_vector(cpu=4_000, memory=8_192),
+        labels={"zone": "a"},
+        devices={"gpu": [{"core": 100, "memory": 1 << 14, "group": 0}]})
+
+    sched = mk_scheduler()
+    sync = StateSyncClient(SchedulerBinding(sched))
+    client = connect(server, clients, on_push=sync.on_push)
+    sync.bootstrap(client)
+
+    new_alloc = resource_vector({
+        "cpu": 16_000, "memory": 65_536,
+        ext.RESOURCE_BATCH_CPU: 9_000, ext.RESOURCE_BATCH_MEMORY: 30_000})
+    _, doc, _ = client.call(
+        FrameType.STATE_PUSH,
+        {"kind": "node_allocatable", "name": "n1"},
+        {"allocatable": np.asarray(new_alloc, np.int32)})
+    assert doc["rv"] == service.rv
+    wait_until(lambda: sync.rv == service.rv)
+
+    spec = sched.snapshot.node_specs["n1"]
+    from koordinator_tpu.api.resources import ResourceDim
+    assert spec.allocatable[ResourceDim.BATCH_CPU] == 9_000
+    assert spec.usage[ResourceDim.CPU] == 4_000       # usage untouched
+    assert spec.labels == {"zone": "a"}
+    stored = service.nodes["n1"]
+    assert stored["doc"]["devices"]["gpu"]            # inventory survives
+    assert int(stored["arrays"]["allocatable"][ResourceDim.BATCH_CPU]) \
+        == 9_000
+
+    # a fresh bootstrapper replays the MERGED allocatable
+    sched2 = mk_scheduler()
+    sync2 = StateSyncClient(SchedulerBinding(sched2))
+    client2 = connect(server, clients, on_push=sync2.on_push)
+    sync2.bootstrap(client2)
+    assert sched2.snapshot.node_specs["n1"].allocatable[
+        ResourceDim.BATCH_CPU] == 9_000
+
+    with _pytest.raises(RpcRemoteError, match="unknown node"):
+        client.call(FrameType.STATE_PUSH,
+                    {"kind": "node_allocatable", "name": "ghost"},
+                    {"allocatable": np.asarray(new_alloc, np.int32)})
