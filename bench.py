@@ -687,6 +687,11 @@ def main() -> None:
                                                 method="approx")[:2],
         "chunked": lambda st, p: batch_assign(st, p, cfg, k=16,
                                               method="chunked")[:2],
+        # the recall-exact TPU fallback (exact top_k at chunked peak
+        # memory) — timing it alongside approx prices the flip
+        # bench_recall.py's decision rule would trigger
+        "chunked_exact": lambda st, p: batch_assign(
+            st, p, cfg, k=16, method="chunked_exact")[:2],
         "fused": lambda st, p: batch_assign(st, p, cfg, k=16,
                                             method="fused")[:2],
     }
@@ -795,7 +800,7 @@ def _cpu_quality_main() -> None:
     bp, bn = 12_800, 2_560
     bstate, bpods, bcfg = _build_problem(bn, bp, seed=42)
     for method, k in (("exact", 16), ("approx", 16), ("approx", 8),
-                      ("chunked", 16)):
+                      ("chunked", 16), ("chunked_exact", 16)):
         fn = jax.jit(lambda s, p, k=k, m=method: batch_assign(
             s, p, bcfg, k=k, method=m)[0])
         try:

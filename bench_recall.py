@@ -96,7 +96,7 @@ def _at_shape_leg(n_nodes: int, n_pods: int, out: dict) -> None:
     valid = float(np.asarray(pods.valid).sum())
     solve = jax.jit(batch_assign, static_argnames=("k", "method"))
     # exact last: it is the one that can OOM (full (P, N) materialization)
-    for method in ("approx", "chunked", "exact"):
+    for method in ("approx", "chunked", "chunked_exact", "exact"):
         try:
             t0 = time.perf_counter()
             asn, _, _ = solve(state, pods, cfg, k=K, method=method)
@@ -123,8 +123,9 @@ def main() -> None:
         "note": "approx_max_k recall vs exact top_k; CPU lowering of "
                 "approx_max_k is exact, so only a tpu backend row "
                 "validates the method='auto' TPU default",
-        "decision_rule": "flip auto's TPU arm off 'approx' if "
-                         "shape_assigned_frac_approx < 0.99 on tpu",
+        "decision_rule": "flip auto's TPU arm from 'approx' to "
+                         "'chunked_exact' if shape_assigned_frac_approx "
+                         "< 0.99 on tpu",
     }
     _recall_leg(n_nodes, n_pods, out)
     if shape_pods:

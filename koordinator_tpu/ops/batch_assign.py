@@ -225,11 +225,18 @@ class _RoundCarry:
 #:             the 50k x 10,240 shape the unchunked path materializes
 #:             ~2 GB per (P, N) tensor (scores, feasible, ranking keys),
 #:             the chunked path ~160 MB per (4096, N) block
+#: - "chunked_exact": the chunked schedule with ``lax.top_k`` on the
+#:             exact int keys instead of ``approx_max_k`` on the float
+#:             keys — bit-identical rows to "exact" at chunked peak
+#:             memory.  The TPU fallback when the measured approx_max_k
+#:             recall strands pods (bench_recall.py's decision rule):
+#:             the only other recall-exact option materializes (P, N)
 #: - "fused":  Pallas streaming kernel (ops/pallas_score.py) — no (P, N)
 #:             HBM materialization; interpret mode off-TPU so the branch is
 #:             runnable (and testable) everywhere
 #: - "auto":   "approx" on TPU, "exact" elsewhere
-CANDIDATE_METHODS = ("auto", "exact", "approx", "chunked", "fused")
+CANDIDATE_METHODS = ("auto", "exact", "approx", "chunked",
+                     "chunked_exact", "fused")
 
 
 def batch_assign(
@@ -316,8 +323,9 @@ def select_candidates(
             state, pods, cfg, k=min(k, state.capacity),
             spread_bits=strata,
             interpret=jax.default_backend() != "tpu")
-    if method == "chunked":
-        return _chunked_candidates(state, pods, cfg, k=k, strata=strata)
+    if method in ("chunked", "chunked_exact"):
+        return _chunked_candidates(state, pods, cfg, k=k, strata=strata,
+                                   method=method)
     scores, feasible = score_pods(state, pods, cfg)
     return _reduce_candidates(scores, feasible, strata,
                               min(k, scores.shape[1]), method)
@@ -376,12 +384,14 @@ CANDIDATE_CHUNK = 4096
 
 
 def _chunked_candidates(state, pods, cfg, k: int, strata,
-                        chunk: int = CANDIDATE_CHUNK):
-    """The approx reduction over pod chunks: ``lax.map`` scores one
+                        chunk: int = CANDIDATE_CHUNK,
+                        method: str = "chunked"):
+    """The chunked reduction over pods: ``lax.map`` scores one
     (chunk, N) block at a time and reduces it to (chunk, k) before the
     next block's scores exist, so no (P, N) tensor is ever materialized.
-    Rows are bit-identical to ``method="approx"`` — scoring, ranking
-    (global row offsets) and the per-row reduction are all
+    Rows are bit-identical to ``method="approx"`` (or, for
+    ``method="chunked_exact"``, to ``method="exact"``) — scoring,
+    ranking (global row offsets) and the per-row reduction are all
     row-independent; chunking only changes the execution schedule."""
     p = pods.capacity
     k = min(k, state.capacity)
@@ -408,7 +418,7 @@ def _chunked_candidates(state, pods, cfg, k: int, strata,
         offset, sub = args
         scores, feasible = score_pods(state, sub, cfg)
         return _reduce_candidates(scores, feasible, strata, k,
-                                  "chunked", row_offset=offset)
+                                  method, row_offset=offset)
 
     sub_batches = jax.tree.map(reshape_rows, stacked)
     keys, nodes = jax.lax.map(body, (offsets, sub_batches))

@@ -201,6 +201,32 @@ class TestChunkedCandidates:
         assert np.array_equal(np.asarray(st_a.node_requested),
                               np.asarray(st_c.node_requested))
 
+    @pytest.mark.parametrize("n_pods,chunk_note", [
+        (100, "single partial chunk (P < chunk)"),
+        (5000, "multiple chunks + padded tail"),
+    ])
+    def test_chunked_exact_bit_identical_to_exact(self, n_pods, chunk_note):
+        """method="chunked_exact": the TPU fallback when measured
+        approx_max_k recall strands pods (bench_recall.py decision rule)
+        — exact top_k rows at chunked peak memory.  Every row must be
+        bit-identical to method="exact"."""
+        state, pods, cfg = build_problem(n_nodes=512, n_pods=n_pods, seed=3)
+        run = jax.jit(select_candidates, static_argnames=("k", "method"))
+        ck_e, cn_e = run(state, pods, cfg, k=16, method="exact")
+        ck_c, cn_c = run(state, pods, cfg, k=16, method="chunked_exact")
+        assert np.array_equal(np.asarray(ck_e), np.asarray(ck_c)), chunk_note
+        assert np.array_equal(np.asarray(cn_e), np.asarray(cn_c)), chunk_note
+
+    def test_chunked_exact_end_to_end_assignments_match_exact(self):
+        state, pods, cfg = build_problem(n_nodes=512, n_pods=5000, seed=4)
+        run = jax.jit(batch_assign, static_argnames=("k", "rounds", "method"))
+        a_e, st_e, _ = run(state, pods, cfg, k=16, rounds=6, method="exact")
+        a_c, st_c, _ = run(state, pods, cfg, k=16, rounds=6,
+                           method="chunked_exact")
+        assert np.array_equal(np.asarray(a_e), np.asarray(a_c))
+        assert np.array_equal(np.asarray(st_e.node_requested),
+                              np.asarray(st_c.node_requested))
+
     def test_dense_feasible_batch_supported(self):
         # dense (P, N) masks chunk over the pod axis like everything else
         state, pods, cfg = build_problem(n_nodes=256, n_pods=300, seed=5,
