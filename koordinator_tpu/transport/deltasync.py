@@ -143,6 +143,15 @@ class StateSyncService:
     def __init__(self, retention: int = 4096):
         self._lock = threading.RLock()
         self.rv = 0
+        #: boot-epoch id: a restarted service resets its rv counter, and
+        #: a client whose last_rv happens to EQUAL the new service's rv
+        #: would get a bare ACK and keep a permanently stale view (the
+        #: r5 manager reconnect path depends on restart => resync).
+        #: HELLO compares instances; a mismatch forces the full snapshot
+        #: regardless of rv.
+        import uuid
+
+        self.instance = uuid.uuid4().hex
         self.log = DeltaLog(retention)
         self.nodes: dict[str, dict] = {}      # name -> {doc, arrays}
         self.pods: dict[str, dict] = {}       # name -> {doc, arrays}
@@ -564,10 +573,19 @@ class StateSyncService:
                 f"incompatible message protocol: peer {peer_proto}, "
                 f"local {wire.PROTOCOL_VERSION}")
         last_rv = int(doc.get("last_rv", -1))
+        # instance-aware resync: a peer that last synced a DIFFERENT
+        # service incarnation must take the full snapshot even when the
+        # rv counters collide (restart resets rv; equal counters say
+        # nothing about equal state).  Peers that don't send an instance
+        # (older clients, the C conformance client) keep the rv-only
+        # behavior.
+        peer_instance = doc.get("instance")
+        same_instance = peer_instance is None or peer_instance == self.instance
         with self._lock:
-            if last_rv == self.rv:
-                return {"__type__": int(FrameType.ACK), "rv": self.rv}, None
-            if 0 <= last_rv < self.rv:
+            if last_rv == self.rv and same_instance:
+                return {"__type__": int(FrameType.ACK), "rv": self.rv,
+                        "instance": self.instance}, None
+            if 0 <= last_rv < self.rv and same_instance:
                 try:
                     events = self.log.since(last_rv)
                 except ResyncRequired:
@@ -576,11 +594,13 @@ class StateSyncService:
                     out, stacked = _pack_events(events)
                     out["__type__"] = int(FrameType.DELTA)
                     out["rv"] = self.rv
+                    out["instance"] = self.instance
                     return out, stacked
-            # last_rv < 0 (fresh client), ahead of us (the service
-            # restarted and its rv counter reset), or behind the retained
+            # last_rv < 0 (fresh client), a different service incarnation,
+            # ahead of us (rv counter reset), or behind the retained
             # window: full snapshot, client resets
             out, stacked = self._snapshot()
+            out["instance"] = self.instance
             return out, stacked
 
 
@@ -600,6 +620,10 @@ class StateSyncClient:
     def __init__(self, binding):
         self.binding = binding
         self.rv = -1
+        #: service boot-epoch last synced from (HELLO echoes it); sent on
+        #: reconnect so a restarted service with a colliding rv counter
+        #: still forces the full snapshot
+        self.instance: str | None = None
         self._lock = threading.RLock()
         self._bootstrapping = False
         self._buffer: list[tuple[dict, dict]] = []
@@ -615,10 +639,13 @@ class StateSyncClient:
             self._bootstrapping = True
             self._buffer = []
         try:
-            ftype, doc, arrays = client.call(
-                FrameType.HELLO,
-                {"last_rv": self.rv, "proto": wire.PROTOCOL_VERSION})
+            hello = {"last_rv": self.rv, "proto": wire.PROTOCOL_VERSION}
+            if self.instance is not None:
+                hello["instance"] = self.instance
+            ftype, doc, arrays = client.call(FrameType.HELLO, hello)
             with self._lock:
+                if doc.get("instance"):
+                    self.instance = doc["instance"]
                 n = 0
                 if ftype is not FrameType.ACK:
                     n = self._apply(doc, arrays)

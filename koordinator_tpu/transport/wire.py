@@ -83,6 +83,9 @@ REQUEST_SCHEMAS: dict[FrameType, dict[str, tuple]] = {
     FrameType.HELLO: {
         "last_rv": (int, True),
         "proto": (int, True),
+        # service boot-epoch the client last synced from; absent on
+        # first contact and from older peers (rv-only resync semantics)
+        "instance": (str, False),
     },
     FrameType.SOLVE_REQUEST: {},
     FrameType.HOOK_REQUEST: {

@@ -213,6 +213,49 @@ def test_sync_reconnect_resumes_from_rv(rpc):
     assert "n2" in sched.snapshot.node_index
 
 
+def test_hello_detects_service_restart_despite_rv_collision(tmp_path):
+    """A restarted service resets its rv counter; if the new counter
+    happens to EQUAL the client's last_rv, an rv-only HELLO would return
+    a bare ACK and the client would keep a permanently stale view.  The
+    instance (boot-epoch) id in the handshake forces the full snapshot
+    across incarnations regardless of rv."""
+    sock = str(tmp_path / "epoch.sock")
+
+    def boot(node_name):
+        server = RpcServer(sock)
+        service = StateSyncService()
+        service.attach(server)
+        server.start()
+        service.upsert_node(node_name,
+                            resource_vector(cpu=8_000, memory=8_192))
+        return server, service
+
+    server1, service1 = boot("n-old")
+    sched = mk_scheduler()
+    sync = StateSyncClient(SchedulerBinding(sched))
+    client = RpcClient(sock, on_push=sync.on_push)
+    client.connect()
+    sync.bootstrap(client)
+    assert sync.rv == service1.rv == 1
+    assert sync.instance == service1.instance
+    client.close()
+    server1.stop()
+
+    # fresh incarnation, DIFFERENT state, same rv counter value
+    server2, service2 = boot("n-new")
+    assert service2.rv == 1 and service2.instance != service1.instance
+    client2 = RpcClient(sock, on_push=sync.on_push)
+    client2.connect()
+    applied = sync.bootstrap(client2)
+    assert applied == 1, "rv collision returned ACK instead of snapshot"
+    assert sync.instance == service2.instance
+    assert sorted(sched.snapshot.node_index) == ["n-new"]
+    # same incarnation, same rv: NOW the ACK shortcut is correct
+    assert sync.bootstrap(client2) == 0
+    client2.close()
+    server2.stop()
+
+
 def test_sync_falls_back_to_snapshot_beyond_retention(rpc):
     server, clients = rpc
     service = StateSyncService(retention=2)
@@ -950,8 +993,6 @@ def test_node_allocatable_push_merges_without_clobbering(rpc):
     replaces ONLY the allocatable vector — usage, labels, and the stored
     doc's devices survive — and the merged value rides a later bootstrap
     snapshot.  Unknown node fails the call without touching the log."""
-    import pytest as _pytest
-
     from koordinator_tpu.api import extension as ext
     from koordinator_tpu.transport.channel import RpcRemoteError
     from koordinator_tpu.transport.wire import FrameType
@@ -999,7 +1040,7 @@ def test_node_allocatable_push_merges_without_clobbering(rpc):
     assert sched2.snapshot.node_specs["n1"].allocatable[
         ResourceDim.BATCH_CPU] == 9_000
 
-    with _pytest.raises(RpcRemoteError, match="unknown node"):
+    with pytest.raises(RpcRemoteError, match="unknown node"):
         client.call(FrameType.STATE_PUSH,
                     {"kind": "node_allocatable", "name": "ghost"},
                     {"allocatable": np.asarray(new_alloc, np.int32)})
