@@ -298,19 +298,30 @@ def main_koordlet(argv: list[str], device_report_fn=None,
             # HP.Used): system daemon usage, and the HP (Prod+Mid)
             # pod-usage sum — is_hp_band is the ONE definition shared
             # with the manager's _hp_used_cpu NodeMetric fallback
-            from koordinator_tpu.api.priority import is_hp_band
+            from koordinator_tpu.api.priority import (
+                PriorityClass,
+                is_hp_band,
+                priority_class_of,
+            )
 
             arrays["sys_usage"] = _np.asarray(resource_vector({
                 "cpu": status.system_usage.cpu_milli,
                 "memory": status.system_usage.memory_bytes >> 20,
             }), _np.int32)
-            hp_cpu = hp_mem = 0
+            hp_cpu = hp_mem = prod_cpu = prod_mem = 0
             for p in status.pods_metrics:
                 if is_hp_band(p.qos_class, p.priority):
                     hp_cpu += p.usage.cpu_milli
                     hp_mem += p.usage.memory_bytes >> 20
+                # prod-band usage feeds loadaware's prod-usage mode
+                # (NodeSpec.prod_usage -> node_prod_usage rows)
+                if priority_class_of(p.priority) is PriorityClass.PROD:
+                    prod_cpu += p.usage.cpu_milli
+                    prod_mem += p.usage.memory_bytes >> 20
             arrays["hp_usage"] = _np.asarray(resource_vector({
                 "cpu": hp_cpu, "memory": hp_mem}), _np.int32)
+            arrays["prod_usage"] = _np.asarray(resource_vector({
+                "cpu": prod_cpu, "memory": prod_mem}), _np.int32)
             sidecar.call(FrameType.STATE_PUSH,
                          {"kind": "node_usage", "name": args.node_name},
                          arrays)

@@ -223,6 +223,38 @@ def test_nodemetric_loop_over_the_wire(tmp_path):
         # and the sync service's stored node carries it for bootstrap
         stored = sched_asm.state_sync.nodes["n-metric"]["arrays"]
         assert int(np.asarray(stored["usage"])[0]) == usage_cpu
+        # the colocation-formula inputs ride the same frames
+        assert "sys_usage" in stored and "hp_usage" in stored
+
+        # pod-band usage: a running Prod pod's reported usage lands in
+        # hp_usage (the colocation formula's HP term) AND prod_usage
+        # (loadaware's prod-usage mode input) on the next report
+        from koordinator_tpu.api.qos import QoSClass as QC
+        from koordinator_tpu.koordlet import metriccache as mcache
+        from koordinator_tpu.koordlet.statesinformer import PodMeta
+
+        daemon.states.set_pods([PodMeta(
+            uid="prod-1", name="prod-1", namespace="default",
+            qos_class=QC.LS, kube_qos="burstable", priority=9_500)])
+        now = daemon.clock()
+        for dt in (0, 1):
+            daemon.metric_cache.append(
+                mcache.POD_CPU_USAGE, 1.5,
+                labels={"pod_uid": "prod-1"}, ts=now + dt)
+            daemon.metric_cache.append(
+                mcache.POD_MEMORY_USAGE, 2.0 * (1 << 30),
+                labels={"pod_uid": "prod-1"}, ts=now + dt)
+        deadline = time.monotonic() + 20
+        prod_cpu = 0
+        while prod_cpu == 0 and time.monotonic() < deadline:
+            daemon.tick()
+            time.sleep(0.05)
+            stored = sched_asm.state_sync.nodes["n-metric"]["arrays"]
+            prod_cpu = int(np.asarray(
+                stored.get("prod_usage", np.zeros(1)))[0])
+        assert prod_cpu == 1_500, "prod-band usage never reached the wire"
+        assert int(np.asarray(stored["hp_usage"])[0]) == 1_500
+        assert int(np.asarray(stored["hp_usage"])[1]) == 2_048  # MiB
     finally:
         if koordlet_asm is not None:
             koordlet_asm.component.stop()

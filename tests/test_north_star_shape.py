@@ -166,3 +166,25 @@ def test_double_shape_headroom():
     assert int((asn >= 0).sum()) == valid
     assert (np.asarray(st.node_requested)
             <= np.asarray(st.node_allocatable)).all()
+
+
+def test_chunked_exact_assigns_everything_at_shape(problem):
+    """The recall-exact TPU fallback (method="chunked_exact" — exact
+    top_k rows at chunked peak memory) must hold the same
+    100%-assignment bar as the default at the real shape: it is what
+    method="auto"'s TPU arm flips to if bench_recall.py measures
+    approx_max_k stranding pods."""
+    import jax
+
+    from koordinator_tpu.ops.batch_assign import batch_assign
+
+    state, pods, cfg = problem
+    valid = int(np.asarray(pods.valid).sum())
+    asn, st = jax.jit(
+        lambda s, p: batch_assign(s, p, cfg, k=16,
+                                  method="chunked_exact")[:2]
+    )(state, pods)
+    asn = np.asarray(asn)
+    assert (np.asarray(st.node_requested)
+            <= np.asarray(st.node_allocatable)).all()
+    assert int((asn >= 0).sum()) == valid
