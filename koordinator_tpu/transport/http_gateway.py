@@ -180,14 +180,16 @@ class HttpGateway:
             return req._reply(501, {"error": "no state-sync service"})
         import numpy as np
 
-        from koordinator_tpu.transport.wire import WireSchemaError
+        from koordinator_tpu.transport.wire import (
+            STATE_PUSH_ARRAY_KEYS,
+            WireSchemaError,
+        )
 
         doc = req._body()
         if not isinstance(doc, dict):
             return req._reply(400, {"error": "body must be a JSON object"})
         arrays = {}
-        for key in ("allocatable", "usage", "agg_usage", "prod_usage",
-                    "requests"):
+        for key in STATE_PUSH_ARRAY_KEYS:
             if key in doc:
                 value = doc.pop(key)
                 if (not isinstance(value, list)

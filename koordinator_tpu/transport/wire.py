@@ -119,6 +119,16 @@ REQUEST_SCHEMAS: dict[FrameType, dict[str, tuple]] = {
 }
 
 
+#: every array key any STATE_PUSH kind accepts (deltasync
+#: _handle_state_push's require_vector calls) — ONE set shared with the
+#: HTTP gateway's JSON-to-array lift, so a new kind's array field cannot
+#: be accepted by the framed path while the HTTP path silently drops it
+#: (the sys_usage/hp_usage drift the r5 review caught)
+STATE_PUSH_ARRAY_KEYS = ("allocatable", "usage", "agg_usage",
+                         "prod_usage", "sys_usage", "hp_usage",
+                         "requests")
+
+
 def check_field_type(val, types) -> bool:
     """isinstance with the wire rule that bool (an int subclass) never
     satisfies a numeric field unless bool is listed explicitly — one

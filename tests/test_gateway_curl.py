@@ -136,6 +136,22 @@ def test_curl_pushes_state_and_solves(tmp_path):
             "requests": [1_000, 1_024] + [0] * (r - 2)})
         assert code == 200 and doc["rv"] == 2
 
+        # usage refresh with the colocation-formula arrays, and the
+        # manager's allocatable patch — both over plain HTTP
+        usage = [2_000, 4_096] + [0] * (r - 2)
+        code, doc = curl("POST", f"{base}/v1/state", body={
+            "kind": "node_usage", "name": "curl-node", "usage": usage,
+            "sys_usage": [500, 512] + [0] * (r - 2),
+            "hp_usage": [1_000, 256] + [0] * (r - 2)})
+        assert code == 200 and doc["rv"] == 3
+        stored = service.nodes["curl-node"]["arrays"]
+        assert int(stored["sys_usage"][0]) == 500
+        assert int(stored["hp_usage"][0]) == 1_000
+        code, doc = curl("POST", f"{base}/v1/state", body={
+            "kind": "node_allocatable", "name": "curl-node",
+            "allocatable": alloc})
+        assert code == 200 and doc["rv"] == 4
+
         # malformed pushes answer 400 and never reach the replay log
         code, doc = curl("POST", f"{base}/v1/state", body={
             "kind": "node_upsert", "name": "bad",
@@ -145,7 +161,7 @@ def test_curl_pushes_state_and_solves(tmp_path):
             "kind": "pod_add", "name": "bad",
             "requests": "not-an-array"})
         assert code == 400
-        assert service.rv == 2
+        assert service.rv == 4
 
         # the solve sees the HTTP-pushed state once the feed applies it
         deadline = 50
