@@ -214,6 +214,8 @@ class ColocationLoop:
 
     def tick(self) -> int:
         """One reconcile round; returns the number of patches pushed."""
+        from koordinator_tpu import metrics
+
         self.ticks += 1
         if self.ensure_fn is not None:
             try:
@@ -221,6 +223,7 @@ class ColocationLoop:
             except Exception:  # noqa: BLE001 — sidecar down: reconcile
                 # over the frozen view anyway, retry next tick
                 self.connect_failures += 1
+                metrics.colocation_connect_failures_total.inc()
         records = self._build_records()
         patches = self.controller.reconcile(records)
         pushed = 0
@@ -237,6 +240,7 @@ class ColocationLoop:
             try:
                 self.push_fn(patch.name, allocatable)
                 pushed += 1
+                metrics.colocation_patches_total.inc()
             except Exception:  # noqa: BLE001 — a wedged sidecar costs
                 # this patch, not the loop; the diff state was already
                 # stamped, so force a re-sync next tick.  last_degraded
@@ -246,6 +250,7 @@ class ColocationLoop:
                 # the scheduler would keep advertising batch capacity on
                 # a node with expired metrics
                 self.push_failures += 1
+                metrics.colocation_push_failures_total.inc()
                 record = self.binding.records.get(patch.name)
                 if record is not None:
                     record.last_batch_cpu = -1

@@ -204,6 +204,15 @@ batch_resource_allocatable = MANAGER.gauge(
     "batch_resource_allocatable", "Batch allocatable per node/resource")
 node_metric_expired = MANAGER.gauge(
     "node_metric_expired", "1 when a node's metric report is stale")
+colocation_patches_total = MANAGER.counter(
+    "colocation_patches_total",
+    "node_allocatable patches pushed by the colocation loop")
+colocation_push_failures_total = MANAGER.counter(
+    "colocation_push_failures_total",
+    "colocation-loop pushes lost to a wedged sidecar (retried next tick)")
+colocation_connect_failures_total = MANAGER.counter(
+    "colocation_connect_failures_total",
+    "colocation-loop sidecar reconnect attempts that failed")
 
 descheduler_evictions_total = DESCHEDULER.counter(
     "pod_evictions_total", "Descheduler evictions by profile/reason")

@@ -105,7 +105,11 @@ def test_reupsert_resets_diff_suppression_and_repushes():
         "n0", resource_vector(cpu=2_000, memory=4_096),
         sys_usage=resource_vector(cpu=500, memory=512),
         hp_usage=resource_vector(cpu=3_000, memory=2_048))
+    from koordinator_tpu import metrics
+
+    patches_before = metrics.colocation_patches_total.value()
     assert loop.tick() == 1
+    assert metrics.colocation_patches_total.value() == patches_before + 1
     first = pushes[-1][1]
     assert int(first[ResourceDim.BATCH_CPU]) > 0
     # steady state: same inputs, no new push
@@ -190,3 +194,45 @@ def test_manager_sidecar_reconnects_after_scheduler_restart(tmp_path):
         if manager_asm is not None:
             manager_asm.component.stop()
         sched.stop()
+
+
+def test_manager_boots_before_scheduler(tmp_path):
+    """Deploy order must not matter: a manager assembled while the
+    scheduler sidecar is still down ticks with counted failures instead
+    of crashing, then picks up the loop when the sidecar appears."""
+    import time
+
+    from koordinator_tpu.cmd.binaries import (
+        main_koord_manager,
+        main_koord_scheduler,
+    )
+
+    sock = str(tmp_path / "order.sock")
+    manager_asm = main_koord_manager(["--scheduler-sidecar-addr", sock])
+    manager = manager_asm.component
+    sched = None
+    try:
+        assert manager.colocation_loop.tick() == 0
+        assert manager.colocation_loop.connect_failures == 1
+
+        sched = main_koord_scheduler([
+            "--node-capacity", "8", "--listen-socket", sock,
+            "--disable-leader-election"])
+        sched.state_sync.upsert_node(
+            "n0", resource_vector(cpu=16_000, memory=16_384))
+        sched.state_sync.update_node_usage(
+            "n0", resource_vector(cpu=2_000, memory=4_096),
+            sys_usage=resource_vector(cpu=500, memory=512),
+            hp_usage=resource_vector(cpu=3_000, memory=2_048))
+        deadline = time.monotonic() + 10
+        pushed = 0
+        while pushed == 0 and time.monotonic() < deadline:
+            pushed = manager.colocation_loop.tick()
+            time.sleep(0.05)
+        assert pushed == 1
+        stored = sched.state_sync.nodes["n0"]["arrays"]
+        assert int(stored["allocatable"][ResourceDim.BATCH_CPU]) > 0
+    finally:
+        manager_asm.component.stop()
+        if sched is not None:
+            sched.stop()
