@@ -347,10 +347,20 @@ def test_preemption_churn_keeps_ledgers(seed):
             if row is None or bind_gen.get(name) != node_gen.get(rec.node):
                 continue
             expect[row] += rec.requests.astype(np.int64)
+        # nominations are generation-scoped exactly like binds (the
+        # scheduler stamps snapshot.node_generation at assume time): if
+        # the nominated node was removed and re-added before the next
+        # round, the assumption's charge died with the old row and is
+        # re-assumed (or dropped) by _resolve_nominations at the START
+        # of the next round, before any other pod can bind — so the
+        # mid-window ledger legitimately excludes it (soak seeds
+        # 25004/30001 caught the oracle counting it anyway)
         for name, nnode in sched.nominations.items():
             p = sched.pending.get(name)
             row = snap.node_index.get(nnode)
-            if p is not None and row is not None:
+            if (p is not None and row is not None
+                    and sched._nomination_gen.get(name)
+                    == snap.node_generation.get(nnode, 0)):
                 expect[row] += p.requests.astype(np.int64)
         alloc = np.asarray(snap.state.node_allocatable)
         valid = np.asarray(snap.state.node_valid)
