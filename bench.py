@@ -536,6 +536,16 @@ def _emit_zero_record(extra: dict,
         extra.update(quality)
     else:
         extra["cpu_quality_error"] = err
+    # the state-sync timing (VERDICT r4 next #7) is host-side — a dead
+    # tunnel must not cost the round its delta_apply record
+    # 300s cap: ~90s loaded; the whole zero path must stay inside the
+    # driver's historical ~3600s budget (probes 660s + quality 1500s)
+    sync_extra, sync_err = _run_child(["--extra", "deltasync"],
+                                      timeout=300, env=child_env)
+    if sync_extra is not None:
+        extra.update(sync_extra)
+    else:
+        extra["bench_deltasync_error"] = sync_err
 
     print(json.dumps({
         "metric": f"solve_pods_per_sec_{N_PODS}p_{N_NODES}n",

@@ -131,6 +131,41 @@ def test_reupsert_resets_diff_suppression_and_repushes():
         assert "n0" not in binding.records
 
 
+def test_stale_metrics_zero_batch_over_the_loop():
+    """Degrade mode (noderesource_controller._degraded): when a node's
+    usage report goes stale past degradeTimeMinutes, the loop must push
+    a ZEROING patch — leaving the last batch capacity advertised on a
+    node whose metrics went dark is the over-commit the degrade path
+    exists to prevent."""
+    clock = FakeClock()
+    service = _service_with_node(clock)
+    loop, binding, pushes = _loop(service, clock)
+    service.upsert_node("n0", resource_vector(cpu=16_000, memory=16_384))
+    service.update_node_usage(
+        "n0", resource_vector(cpu=2_000, memory=4_096),
+        sys_usage=resource_vector(cpu=500, memory=512),
+        hp_usage=resource_vector(cpu=3_000, memory=2_048))
+    assert loop.tick() == 1
+    assert int(pushes[-1][1][ResourceDim.BATCH_CPU]) > 0
+
+    # collectors go dark: 16 minutes pass with no usage refresh
+    clock.t += 16 * 60
+    assert loop.tick() == 1, "degrade must emit a zeroing patch"
+    degraded = pushes[-1][1]
+    assert int(degraded[ResourceDim.BATCH_CPU]) == 0
+    assert int(degraded[ResourceDim.BATCH_MEMORY]) == 0
+    assert int(degraded[ResourceDim.MID_CPU]) == 0
+    # base capacity dims are untouched
+    assert int(degraded[ResourceDim.CPU]) == 16_000
+    # a fresh report recovers the capacity
+    service.update_node_usage(
+        "n0", resource_vector(cpu=2_000, memory=4_096),
+        sys_usage=resource_vector(cpu=500, memory=512),
+        hp_usage=resource_vector(cpu=3_000, memory=2_048))
+    assert loop.tick() == 1
+    assert int(pushes[-1][1][ResourceDim.BATCH_CPU]) > 0
+
+
 def test_manager_sidecar_reconnects_after_scheduler_restart(tmp_path):
     """The colocation loop must survive a sidecar restart: the manager's
     reconnecting client re-dials + re-bootstraps on the next tick (a
