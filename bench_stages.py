@@ -4,9 +4,9 @@ Times the three stages of ``batch_assign`` separately at the 50k x 10,240
 shape so optimization effort lands where the milliseconds are:
 
   score    — score_pods: the (P, N) filter+score tensor pipeline
-  select_* — select_candidates per method (approx / chunked / fused):
+  select_* — select_candidates per method (approx / chunked / ...):
              the (P, N) -> (P, k) top-k reduction INCLUDING scoring
-             (the stages overlap by design: chunked/fused never
+             (the stages overlap by design: chunked never
              materialize the full score tensor, so "selection minus
              scoring" is not a physical quantity for them)
   rounds   — _assign_rounds: the propose/accept conflict-resolution
@@ -62,7 +62,7 @@ def main() -> None:
     n_nodes = int(os.environ.get("KOORD_STAGES_NODES", n_nodes))
     n_pods = int(os.environ.get("KOORD_STAGES_PODS", n_pods))
     methods = tuple(os.environ.get("KOORD_STAGES_METHODS",
-                                   "approx,chunked,fused").split(","))
+                                   "approx,chunked").split(","))
     iters = 2 if smoke else K_ITERS
 
     from __graft_entry__ import _build_problem

@@ -155,7 +155,7 @@ def gang_assign(
     rollback/all-or-nothing semantics are identical either way (they act
     on the assignment vector).  ``method`` passes through to the batch
     solver's candidate selection (batch_assign.CANDIDATE_METHODS), so
-    gang solves can force the chunked/approx/fused paths too.
+    gang solves can force the chunked/approx paths too.
     """
     from koordinator_tpu.ops import scoring
     from koordinator_tpu.ops.batch_assign import batch_assign

@@ -20,8 +20,8 @@ def build_problem(n_nodes=64, n_pods=128, seed=0, classes=3,
                   pad_pods_pow2=True):
     """Seeded random scheduling problem.
 
-    ``factored`` attaches a selector-class mask (required by the fused
-    kernel); ``invalid_tail`` zeroes + invalidates the last nodes;
+    ``factored`` attaches a selector-class mask (the factored feasibility
+    form); ``invalid_tail`` zeroes + invalidates the last nodes;
     ``pad_pods_pow2`` pads the pod batch capacity to a power of two
     (PodBatch.build's natural padding behavior in the suites).
     """
