@@ -787,6 +787,88 @@ def main() -> None:
     )
 
 
+def _bench_incremental(bstate, bpods, bcfg, bp: int, bn: int,
+                       dirty_frac: float = 0.01) -> dict:
+    """Median-of-3 wall time of one INCREMENTAL steady-state round —
+    dirty-node column refresh, compacted dirty-pod rescore, and the
+    propose/accept pass over the merged (P, k) candidates — at a given
+    dirty fraction, alongside the full pass's number for the ratio.
+    CPU tripwire for the delta-scaling claim (steady-state rounds must
+    scale with the delta, not the problem)."""
+    from koordinator_tpu.ops.batch_assign import (
+        CandidateCache,
+        assign_round_pass,
+        batch_assign,
+        refresh_candidates,
+        scatter_candidate_rows,
+        select_candidates,
+    )
+    from koordinator_tpu.state.cluster_state import _bucket
+
+    k = 16
+    n_dirty_nodes = max(int(bn * dirty_frac), 1)
+    n_dirty_pods = max(int(bp * dirty_frac), 1)
+
+    full = jax.jit(lambda s, p: batch_assign(s, p, bcfg, k=k,
+                                             method="exact")[0])
+    np.asarray(full(bstate, bpods))
+    t_full = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(full(bstate, bpods))
+        t_full.append(time.perf_counter() - t0)
+
+    sel = jax.jit(lambda s, p: select_candidates(
+        s, p, bcfg, k=k, method="exact", with_scores=True))
+    cache = CandidateCache(*sel(bstate, bpods))
+    dirty = np.arange(n_dirty_nodes, dtype=np.int32)
+    dpad = _bucket(n_dirty_nodes, minimum=64)
+    drows = np.zeros(dpad, np.int32)
+    drows[:n_dirty_nodes] = dirty
+    dvalid = np.zeros(dpad, bool)
+    dvalid[:n_dirty_nodes] = True
+    dirty_pods = np.zeros(bpods.capacity, bool)
+    dirty_pods[:n_dirty_pods] = True
+    small, idx = bpods.compact(dirty_pods)
+    rows_pad = np.full(small.capacity, bpods.capacity, np.int32)
+    rows_pad[: len(idx)] = idx
+
+    refresh = jax.jit(lambda s, p, c, dr, dv: refresh_candidates(
+        s, p, bcfg, c, dr, dv, k=k))
+    sel_small = jax.jit(lambda s, p: select_candidates(
+        s, p, bcfg, k=k, method="exact", with_scores=True))
+    scatter = jax.jit(scatter_candidate_rows)
+    rounds = jax.jit(lambda s, p, ck, cn: assign_round_pass(
+        s, p, None, ck, cn, bcfg)[0])
+
+    def inc_round():
+        ck, c2 = refresh(bstate, bpods, cache, drows, dvalid)
+        sk, sn, ss = sel_small(bstate, small)
+        c2 = scatter(c2, rows_pad, sk, sn, ss)
+        return np.asarray(rounds(bstate, bpods, c2.cand_key, c2.cand_node))
+
+    inc_round()  # compile + warm
+    t_inc = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        inc_round()
+        t_inc.append(time.perf_counter() - t0)
+
+    med_full, med_inc = float(np.median(t_full)), float(np.median(t_inc))
+    pct = int(dirty_frac * 100)
+    return {
+        f"cpu_wall_s_med3_incremental_{pct}pct_{bp}p_{bn}n": round(
+            med_inc, 4),
+        f"cpu_wall_s_med3_full_exact_k{k}_{bp}p_{bn}n": round(med_full, 3),
+        "incremental_dirty_frac_nodes": dirty_frac,
+        "incremental_dirty_frac_pods": dirty_frac,
+        "incremental_dirty_nodes": n_dirty_nodes,
+        "incremental_dirty_pods": n_dirty_pods,
+        "incremental_speedup_vs_full": round(
+            med_full / max(med_inc, 1e-9), 1),
+    }
+
+
 def _cpu_quality_main() -> None:
     """Child-process entry (JAX_PLATFORMS=cpu): solve quality at the
     north-star shape with the TPU-serving approx candidate path forced —
@@ -827,6 +909,17 @@ def _cpu_quality_main() -> None:
             out[f"cpu_wall_{method}_k{k}_error"] = repr(e)[:200]
         print(json.dumps(out))
         sys.stdout.flush()
+
+    # Incremental delta-scaling claim (ISSUE 1 acceptance criterion): a
+    # steady-state round with ~1% dirty nodes AND ~1% dirty pods —
+    # dirty-column refresh + compacted dirty-pod rescore + the
+    # propose/accept pass — vs the full batch_assign pass above.
+    try:
+        out.update(_bench_incremental(bstate, bpods, bcfg, bp, bn))
+    except Exception as e:
+        out["cpu_incremental_error"] = repr(e)[:200]
+    print(json.dumps(out))
+    sys.stdout.flush()
 
     state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
     valid = int(np.asarray(pods.valid).sum())

@@ -322,8 +322,39 @@ def main_koordlet(argv: list[str], device_report_fn=None,
                 "cpu": hp_cpu, "memory": hp_mem}), _np.int32)
             arrays["prod_usage"] = _np.asarray(resource_vector({
                 "cpu": prod_cpu, "memory": prod_mem}), _np.int32)
+            # request/maxUsageRequest calculate-policy inputs: the HP
+            # pods' REQUEST sum and per-pod max(request, usage) sum —
+            # one is_hp_band walk over the informer's pod requests.
+            # Without these the manager's wire-fed NodeRecords compute
+            # batch capacity as if HP pods had requested nothing and
+            # silently over-advertise under those policies.
+            usage_by_uid = {p.uid: p.usage for p in status.pods_metrics}
+            req_cpu = req_mem = max_cpu = max_mem = 0
+            for meta in daemon.states.get_all_pods():
+                if not meta.is_running:
+                    continue
+                if not is_hp_band(meta.qos_class.name, meta.priority):
+                    continue
+                r_cpu = int(meta.requests.get("cpu", 0))
+                r_mem = int(meta.requests.get("memory", 0)) >> 20
+                req_cpu += r_cpu
+                req_mem += r_mem
+                used = usage_by_uid.get(meta.uid)
+                u_cpu = used.cpu_milli if used is not None else 0
+                u_mem = (used.memory_bytes >> 20) if used is not None else 0
+                max_cpu += max(r_cpu, u_cpu)
+                max_mem += max(r_mem, u_mem)
+            arrays["hp_request"] = _np.asarray(resource_vector({
+                "cpu": req_cpu, "memory": req_mem}), _np.int32)
+            arrays["hp_max_used_req"] = _np.asarray(resource_vector({
+                "cpu": max_cpu, "memory": max_mem}), _np.int32)
             sidecar.call(FrameType.STATE_PUSH,
-                         {"kind": "node_usage", "name": args.node_name},
+                         {"kind": "node_usage", "name": args.node_name,
+                          # the report's OWN timestamp: consumers date the
+                          # usage by when the koordlet measured it, not by
+                          # when the delta applied (degrade windows must
+                          # survive manager restarts + snapshot replay)
+                          "usage_time": float(status.update_time)},
                          arrays)
 
         daemon.reporters.append(NodeMetricReporter(

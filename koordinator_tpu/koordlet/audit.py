@@ -64,9 +64,15 @@ class Auditor:
             self._rotated(i) for i in range(1, self.max_files)
         ]
         for path in files:
-            if not os.path.exists(path):
+            try:
+                # open directly instead of exists-then-open: a concurrent
+                # _rotate renames files between the two, and the resulting
+                # FileNotFoundError escaped to query callers (the file's
+                # lines are still served under their rotated name)
+                f = open(path)
+            except FileNotFoundError:
                 continue
-            with open(path) as f:
+            with f:
                 for line in reversed(f.readlines()):
                     yield line
 

@@ -46,7 +46,8 @@ MIB = 1 << 20
 
 class _NodeView:
     __slots__ = ("allocatable", "labels", "annotations", "usage",
-                 "sys_usage", "hp_usage", "usage_time")
+                 "sys_usage", "hp_usage", "hp_request", "hp_max_used_req",
+                 "usage_time")
 
     def __init__(self):
         self.allocatable: Optional[np.ndarray] = None
@@ -55,6 +56,12 @@ class _NodeView:
         self.usage: Optional[np.ndarray] = None
         self.sys_usage: Optional[np.ndarray] = None
         self.hp_usage: Optional[np.ndarray] = None
+        #: HP (Prod+Mid) pod REQUEST sum and per-pod max(request, usage)
+        #: sum — the request/maxUsageRequest calculate policies' inputs;
+        #: without them a wire-fed record computes batch capacity as if
+        #: no HP pod had requested anything
+        self.hp_request: Optional[np.ndarray] = None
+        self.hp_max_used_req: Optional[np.ndarray] = None
         self.usage_time: float = 0.0
 
 
@@ -93,8 +100,18 @@ class ManagerSyncBinding:
             # over-advertise batch capacity for a report interval
             if "usage" in arrs:
                 view.usage = np.asarray(arrs["usage"], np.int32)
-                view.usage_time = self.clock()
-            for field in ("sys_usage", "hp_usage"):
+                # date the replayed usage by the KOORDLET's report time
+                # when the merged doc carries one (bootstrap replay after
+                # a manager restart): stamping apply-time would make a
+                # stale node look fresh for a whole degrade window.
+                # Explicit None check — a report_time of 0.0 is a valid
+                # (infinitely stale) timestamp, not an absent one
+                report_time = entry.get("usage_time")
+                view.usage_time = (float(report_time)
+                                   if report_time is not None
+                                   else self.clock())
+            for field in ("sys_usage", "hp_usage", "hp_request",
+                          "hp_max_used_req"):
                 if field in arrs:
                     setattr(view, field,
                             np.asarray(arrs[field], np.int32))
@@ -111,11 +128,19 @@ class ManagerSyncBinding:
             if view is None:
                 return
             view.usage = np.asarray(arrs["usage"], np.int32)
-            if "sys_usage" in arrs:
-                view.sys_usage = np.asarray(arrs["sys_usage"], np.int32)
-            if "hp_usage" in arrs:
-                view.hp_usage = np.asarray(arrs["hp_usage"], np.int32)
-            view.usage_time = self.clock()
+            for field in ("sys_usage", "hp_usage", "hp_request",
+                          "hp_max_used_req"):
+                if field in arrs:
+                    setattr(view, field,
+                            np.asarray(arrs[field], np.int32))
+            # prefer the koordlet's report timestamp over apply time so
+            # the degrade clock measures collector silence, not delta
+            # latency (and survives replay after a manager restart);
+            # 0.0 is a valid (stale) timestamp, only None means absent
+            report_time = entry.get("usage_time")
+            view.usage_time = (float(report_time)
+                               if report_time is not None
+                               else self.clock())
 
     def node_alloc(self, entry: dict, arrs: dict) -> None:
         # our own patches echo back as deltas; base capacity dims
@@ -209,6 +234,20 @@ class ColocationLoop:
                     None if hp is None else int(hp[cpu]))
                 record.hp_used_mem_mib = (
                     None if hp is None else int(hp[mem]))
+                # request/maxUsageRequest policy inputs: wire-fed records
+                # have no per-pod NodeMetric rows, so the aggregates ride
+                # the node_usage report (0 when the koordlet predates them
+                # — the old over-advertising behavior, explicit here)
+                hp_req = view.hp_request
+                record.hp_request_cpu_milli = (
+                    0 if hp_req is None else int(hp_req[cpu]))
+                record.hp_request_mem_mib = (
+                    0 if hp_req is None else int(hp_req[mem]))
+                hp_max = view.hp_max_used_req
+                record.hp_max_used_req_cpu_milli = (
+                    0 if hp_max is None else int(hp_max[cpu]))
+                record.hp_max_used_req_mem_mib = (
+                    0 if hp_max is None else int(hp_max[mem]))
                 records.append(record)
         return records
 

@@ -190,6 +190,21 @@ scheduling_latency = SCHEDULER.histogram(
 solver_batch_latency = SCHEDULER.histogram(
     "solver_batch_duration_seconds", "Batched filter/score/assign solve latency")
 pending_pods = SCHEDULER.gauge("pending_pods", "Pods waiting to be scheduled")
+incremental_dirty_fraction = SCHEDULER.gauge(
+    "incremental_dirty_fraction",
+    "Dirty fraction the incremental solve saw this round (label: "
+    "kind=nodes|pods); drives the full-pass fallback flip")
+incremental_solve_total = SCHEDULER.counter(
+    "incremental_solve_rounds_total",
+    "Batch solve rounds by path (label: path=incremental|full_cold|"
+    "full_fallback|full_gang|full_dense|disabled) — full_fallback means "
+    "the dirty fraction crossed the threshold, full_cold that no valid "
+    "candidate cache existed, full_dense that a dense (hinted/topology) "
+    "feasibility mask forced the full path")
+incremental_dirty_pods = SCHEDULER.gauge(
+    "incremental_dirty_pods",
+    "Pods fully rescored by the last incremental round (new/changed pods "
+    "plus pods whose cached candidates touched a dirty node)")
 
 be_suppress_cpu_cores = KOORDLET.gauge(
     "be_suppress_cpu_cores", "CPU cores currently allowed for BE")

@@ -127,6 +127,15 @@ def _threshold_mask(cfg, usage, agg_usage, allocatable, pod_est):
     return jnp.where(agg_enabled, agg, inst)
 
 
+def pod_estimates(pods: PodBatch, cfg: ScoringConfig) -> jnp.ndarray:
+    """(P, R) estimated usage per pod (the LoadAware estimator) — shared
+    by gang_assign's inter-pass est accumulation and the incremental
+    solve's pass functions, so the two pass loops cannot drift."""
+    return scoring.estimate_pod_usage_by_band(
+        pods.requests, cfg.estimator_factors, cfg.estimator_defaults
+    )
+
+
 def score_pods(
     state: ClusterState, pods: PodBatch, cfg: ScoringConfig
 ) -> tuple[jnp.ndarray, jnp.ndarray]:

@@ -73,7 +73,9 @@ def check_node_capacity(n: int) -> None:
 
 def _ranked_scores(
     scores: jnp.ndarray, feasible: jnp.ndarray, spread_bits: int = 0,
-    row_offset=0,
+    rot_id: jnp.ndarray | None = None,
+    node_ids: jnp.ndarray | None = None,
+    n_total: int | None = None,
 ) -> jnp.ndarray:
     """(P, N) int32 ranking key: score in the high bits, a per-pod rotated
     node index in the low bits.  Equal-scored nodes order differently for
@@ -89,18 +91,43 @@ def _ranked_scores(
     near-best nodes; the score sacrifice is bounded by the bucket width
     (upstream's selectHost already treats equal-enough scores as
     interchangeable: defaultPodTopologySpread jitter, selectHost randomness).
+
+    ``rot_id`` is the per-pod rotation identity (``PodBatch.rot_id``;
+    defaults to the batch row index).  Keys are a pure function of
+    (rot_id, node id, score) — independent of the pod's batch ROW — which
+    is what lets chunked reductions and the incremental candidate cache
+    reproduce any single row bit-for-bit.  ``node_ids``/``n_total`` score
+    a gathered COLUMN SUBSET (the dirty-node refresh): the tie-break uses
+    the nodes' GLOBAL ids modulo the full capacity, so a subset column's
+    key equals the same node's key in a full (P, N) pass.
     """
     p, n = scores.shape
-    check_node_capacity(n)
-    # per-pod offset; row_offset keeps chunked reductions rotating by the
-    # GLOBAL pod index, so chunking never changes any pod's candidates
-    rot = ((jnp.arange(p, dtype=jnp.int32) + row_offset) * 7919)[:, None]
-    tb = (jnp.arange(n, dtype=jnp.int32)[None, :] - rot) % n
+    n_total = n if n_total is None else n_total
+    check_node_capacity(n_total)
+    if rot_id is None:
+        rot_id = jnp.arange(p, dtype=jnp.int32)
+    rot = (rot_id.astype(jnp.int32) * 7919)[:, None]
+    ids = (jnp.arange(n, dtype=jnp.int32)[None, :] if node_ids is None
+           else node_ids.astype(jnp.int32)[None, :])
+    tb = (ids - rot) % n_total
     # invert so the SMALLEST rotated distance ranks highest among ties
-    tb = (n - 1) - tb
+    tb = (n_total - 1) - tb
     q = jnp.clip(scores, 0, _SCORE_CLIP) >> spread_bits
     key = (q << _TB_BITS) | tb
     return jnp.where(feasible, key, -1)
+
+
+def _candidate_keys(score: jnp.ndarray, node: jnp.ndarray,
+                    rot_id: jnp.ndarray, spread_bits: int,
+                    n_total: int) -> jnp.ndarray:
+    """Ranking key recomputed from a CACHED candidate's raw clipped score
+    and node row — bit-identical to the :func:`_ranked_scores` key of the
+    same (pod, node) pair, so merged and freshly-selected candidates rank
+    on one scale.  ``score < 0`` marks an invalid slot."""
+    rot = (rot_id.astype(jnp.int32) * 7919)[:, None]
+    tb = (n_total - 1) - ((node - rot) % n_total)
+    key = ((score >> spread_bits) << _TB_BITS) | tb
+    return jnp.where(score >= 0, key, -1)
 
 
 def _prefix_accept(
@@ -284,6 +311,7 @@ def select_candidates(
     k: int = 32,
     spread_bits=(5, 15),
     method: str = "auto",
+    with_scores: bool = False,
 ):
     """(cand_key, cand_node), each (P, k): the candidate-selection stage of
     ``batch_assign``, exposed separately so profiling can time it apart
@@ -301,7 +329,12 @@ def select_candidates(
     top score band fills, while the coverage stratum guarantees every pod
     k/2 uniformly-spread fallbacks (measured: 100% assigned).  Duplicate
     nodes between strata just idle a slot.  Scoring runs ONCE regardless
-    of strata count; only the cheap top-k reduction repeats."""
+    of strata count; only the cheap top-k reduction repeats.
+
+    ``with_scores=True`` additionally returns the selected slots' raw
+    clipped composite scores, (P, k) int32 with -1 for invalid slots —
+    the persistent form the incremental candidate cache needs to
+    recompute any stratum's ranking key without a full rescore."""
     if method not in CANDIDATE_METHODS:
         raise ValueError(f"unknown candidate method {method!r}; "
                          f"one of {CANDIDATE_METHODS}")
@@ -311,24 +344,25 @@ def select_candidates(
               else (spread_bits,))
     if method in ("chunked", "chunked_exact"):
         return _chunked_candidates(state, pods, cfg, k=k, strata=strata,
-                                   method=method)
+                                   method=method, with_scores=with_scores)
     scores, feasible = score_pods(state, pods, cfg)
     return _reduce_candidates(scores, feasible, strata,
-                              min(k, scores.shape[1]), method)
+                              min(k, scores.shape[1]), method,
+                              pods.rot_id, with_scores=with_scores)
 
 
 def _reduce_candidates(scores, feasible, strata, k: int, method: str,
-                       row_offset=0):
+                       rot_id=None, with_scores: bool = False):
     """The (scores, feasible) -> (cand_key, cand_node) reduction shared by
     the whole-batch and chunked paths."""
-    order_key = _ranked_scores(scores, feasible, strata[0], row_offset)
+    order_key = _ranked_scores(scores, feasible, strata[0], rot_id)
     splits = _stratum_splits(k, len(strata))
     nodes = []
     for sb, k_i in zip(strata, splits):
         if k_i == 0:
             continue
         key = (order_key if sb == strata[0]
-               else _ranked_scores(scores, feasible, sb, row_offset))
+               else _ranked_scores(scores, feasible, sb, rot_id))
         if method in ("approx", "chunked") and k_i < key.shape[1]:
             # TPU-optimized partial reduction. approx_max_k needs a float
             # key exact within float32's 24-bit mantissa, so candidates
@@ -360,6 +394,10 @@ def _reduce_candidates(scores, feasible, strata, k: int, method: str,
     # coverage-stratum node competes on the same score scale (gathering
     # also yields -1 for infeasible slots of short candidate lists)
     cand_key = jnp.take_along_axis(order_key, cand_node, axis=1)
+    if with_scores:
+        raw = jnp.take_along_axis(
+            jnp.clip(scores, 0, _SCORE_CLIP), cand_node, axis=1)
+        return cand_key, cand_node, jnp.where(cand_key >= 0, raw, -1)
     return cand_key, cand_node
 
 
@@ -371,13 +409,14 @@ CANDIDATE_CHUNK = 4096
 
 def _chunked_candidates(state, pods, cfg, k: int, strata,
                         chunk: int = CANDIDATE_CHUNK,
-                        method: str = "chunked"):
+                        method: str = "chunked",
+                        with_scores: bool = False):
     """The chunked reduction over pods: ``lax.map`` scores one
     (chunk, N) block at a time and reduces it to (chunk, k) before the
     next block's scores exist, so no (P, N) tensor is ever materialized.
     Rows are bit-identical to ``method="approx"`` (or, for
     ``method="chunked_exact"``, to ``method="exact"``) — scoring,
-    ranking (global row offsets) and the per-row reduction are all
+    ranking (per-pod rot_id) and the per-row reduction are all
     row-independent; chunking only changes the execution schedule."""
     p = pods.capacity
     k = min(k, state.capacity)
@@ -398,18 +437,15 @@ def _chunked_candidates(state, pods, cfg, k: int, strata,
         return (None if a is None
                 else a.reshape((n_chunks, chunk) + a.shape[1:]))
 
-    offsets = jnp.arange(n_chunks, dtype=jnp.int32) * chunk
-
-    def body(args):
-        offset, sub = args
+    def body(sub):
         scores, feasible = score_pods(state, sub, cfg)
         return _reduce_candidates(scores, feasible, strata, k,
-                                  method, row_offset=offset)
+                                  method, sub.rot_id,
+                                  with_scores=with_scores)
 
     sub_batches = jax.tree.map(reshape_rows, stacked)
-    keys, nodes = jax.lax.map(body, (offsets, sub_batches))
-    return (keys.reshape(padded, -1)[:p],
-            nodes.reshape(padded, -1)[:p])
+    out = jax.lax.map(body, sub_batches)
+    return tuple(a.reshape(padded, -1)[:p] for a in out)
 
 
 def _stratum_splits(k: int, n: int) -> list[int]:
@@ -493,3 +529,234 @@ def _assign_rounds(state, pods, quota, cand_key, cand_node, rounds):
     _, carry = jax.lax.while_loop(cond, body, (jnp.int32(0), carry))
     new_state = state.replace(node_requested=carry.requested)
     return carry.assignments, new_state, carry.quota
+
+
+# ---------------------------------------------------------------------------
+# Incremental delta-driven solve: persistent device-resident candidate cache
+# ---------------------------------------------------------------------------
+#
+# Steady-state scheduler rounds arrive as small deltas (a few node upserts,
+# a few pod arrivals) yet the full solve pays O(P·N) candidate selection
+# every round.  The cache keeps the (P, k) candidate set resident across
+# rounds and refreshes it in O(P·D + Pd·N) for D dirty nodes and Pd dirty
+# pods:
+#
+#   1. pods whose cached candidates touch NO dirty node keep them — their
+#      cached top-k over clean nodes IS the clean-column top-k (removing
+#      entries ranked below the k-th never changes a top-k), so merging in
+#      a fresh top-k over the dirty COLUMNS reproduces the full pass's
+#      top-k exactly, per stratum;
+#   2. pods that are new/changed, or whose cached candidates touch a dirty
+#      node (their clean-column top-k is NOT recoverable from the cache),
+#      are fully rescored — the scheduler compacts them into a small batch
+#      and scatters the fresh rows over the merge's output.
+#
+# Exactness holds for the exact top_k methods; under "approx"/"chunked"
+# the full pass is itself recall-approximate and the refresh (which always
+# merges with exact top_k) is just another recall-approximate candidate
+# source.  Either way a stale candidate can only cost RECALL, never
+# correctness: acceptance (_assign_rounds) re-checks fit and quota exactly
+# every round.
+
+
+@struct.dataclass
+class CandidateCache:
+    """Device-resident candidate state carried across scheduler rounds."""
+
+    cand_key: jax.Array    # (P, k) int32 stratum-0 ranking key, -1 invalid
+    cand_node: jax.Array   # (P, k) int32 node rows
+    cand_score: jax.Array  # (P, k) int32 raw clipped score, -1 invalid
+
+    @classmethod
+    def build(cls, cand_key, cand_node, cand_score) -> "CandidateCache":
+        return cls(cand_key=cand_key, cand_node=cand_node,
+                   cand_score=cand_score)
+
+
+def align_candidate_cache(
+    cache: CandidateCache,
+    map_rows: jnp.ndarray,   # (P,) int32 cached row per current batch row
+    map_ok: jnp.ndarray,     # (P,) bool — current row present in the cache
+    dirty_mask: jnp.ndarray,  # (N,) bool — nodes whose state changed
+) -> tuple[CandidateCache, jnp.ndarray]:
+    """Gather cached rows into the CURRENT batch's row order and flag pods
+    whose cached candidates touch a dirty node.  Keys/scores are functions
+    of (rot_id, node, score) only — row-independent — so a gathered row is
+    exactly the pod's cached candidate set regardless of queue churn.
+
+    Returns (aligned cache, touch): ``touch[i]`` means row i's cached
+    candidates intersect the dirty nodes, so the merge alone cannot
+    reproduce its full top-k and the pod must rescore fully."""
+    node = cache.cand_node[map_rows]
+    score = jnp.where(map_ok[:, None], cache.cand_score[map_rows], -1)
+    key = jnp.where(map_ok[:, None], cache.cand_key[map_rows], -1)
+    touch = jnp.any(dirty_mask[node] & (score >= 0), axis=1)
+    return CandidateCache(key, node, score), touch
+
+
+def refresh_candidates(
+    state: ClusterState,
+    pods: PodBatch,
+    cfg: ScoringConfig,
+    cache: CandidateCache,
+    dirty_rows: jnp.ndarray,   # (D,) int32, padded; global node rows
+    dirty_valid: jnp.ndarray,  # (D,) bool — real (non-pad) entries
+    k: int = 32,
+    spread_bits=(5, 15),
+) -> tuple[jnp.ndarray, CandidateCache]:
+    """Segmented per-stratum top-k merge of fresh dirty-COLUMN candidates
+    into an (aligned) candidate cache.
+
+    Scores only the (P, D) dirty sub-problem, invalidates cached slots
+    that point at dirty nodes, recomputes each stratum's ranking keys from
+    the cached raw scores, and keeps the best k_i per stratum of
+    (cached ∪ fresh-dirty).  For a pod whose cached candidates touch no
+    dirty node this equals the full pass's selection exactly (see module
+    section comment); rows the scheduler rescores fully are scattered
+    over this function's output afterwards.
+
+    Returns (cand_key, new_cache); cand_node rides the cache.
+    """
+    strata = (tuple(spread_bits) if isinstance(spread_bits, (tuple, list))
+              else (spread_bits,))
+    n = state.capacity
+    k = min(k, n)
+    d = dirty_rows.shape[0]
+    rot = pods.rot_id
+
+    sub = state.gather_rows(dirty_rows, dirty_valid)
+    scores, feasible = score_pods(sub, pods, cfg)        # (P, D)
+    clipped = jnp.clip(scores, 0, _SCORE_CLIP)
+    # .max (OR), not .set: padded dirty_rows entries default to row 0
+    # with valid=False, and a duplicate-index .set scatter is
+    # order-undefined — it could erase row 0's genuine dirty bit
+    dirty_mask = jnp.zeros(n, bool).at[dirty_rows].max(dirty_valid)
+    stale_score = jnp.where(dirty_mask[cache.cand_node], -1,
+                            cache.cand_score)
+
+    splits = _stratum_splits(k, len(strata))
+    nodes_out, scores_out = [], []
+    off = 0
+    for sb, k_i in zip(strata, splits):
+        if k_i == 0:
+            continue
+        seg_node = cache.cand_node[:, off:off + k_i]
+        seg_score = stale_score[:, off:off + k_i]
+        off += k_i
+        dkey = _ranked_scores(scores, feasible, sb, rot,
+                              node_ids=dirty_rows, n_total=n)
+        if k_i < d:
+            dval, idx = jax.lax.top_k(dkey, k_i)
+            d_node = dirty_rows[idx]
+            d_score = jnp.where(
+                dval >= 0, jnp.take_along_axis(clipped, idx, axis=1), -1)
+        else:
+            dval = dkey
+            d_node = jnp.broadcast_to(dirty_rows[None, :], dkey.shape)
+            d_score = jnp.where(dval >= 0, clipped, -1)
+        c_key = _candidate_keys(seg_score, seg_node, rot, sb, n)
+        m_key = jnp.concatenate([c_key, dval], axis=1)
+        m_node = jnp.concatenate([seg_node, d_node], axis=1)
+        m_score = jnp.concatenate([seg_score, d_score], axis=1)
+        mval, midx = jax.lax.top_k(m_key, k_i)
+        nodes_out.append(jnp.take_along_axis(m_node, midx, axis=1))
+        scores_out.append(jnp.where(
+            mval >= 0, jnp.take_along_axis(m_score, midx, axis=1), -1))
+
+    cand_node = (jnp.concatenate(nodes_out, axis=1)
+                 if len(nodes_out) > 1 else nodes_out[0])
+    cand_score = (jnp.concatenate(scores_out, axis=1)
+                  if len(scores_out) > 1 else scores_out[0])
+    cand_key = _candidate_keys(cand_score, cand_node, rot, strata[0], n)
+    return cand_key, CandidateCache(cand_key, cand_node, cand_score)
+
+
+def scatter_candidate_rows(
+    cache: CandidateCache,
+    rows: jnp.ndarray,        # (S,) int32; out-of-range padding drops
+    src_key: jnp.ndarray,     # (S, k)
+    src_node: jnp.ndarray,
+    src_score: jnp.ndarray,
+) -> CandidateCache:
+    """Overwrite the fully-rescored (dirty-pod) rows into the cache —
+    the compacted select's output scattered back to global batch rows."""
+    return CandidateCache(
+        cand_key=cache.cand_key.at[rows].set(src_key, mode="drop"),
+        cand_node=cache.cand_node.at[rows].set(src_node, mode="drop"),
+        cand_score=cache.cand_score.at[rows].set(src_score, mode="drop"),
+    )
+
+
+def assign_round_pass(
+    state: ClusterState,
+    pods: PodBatch,
+    quota: QuotaDeviceState | None,
+    cand_key: jnp.ndarray,
+    cand_node: jnp.ndarray,
+    cfg: ScoringConfig,
+    rounds: int = 12,
+):
+    """First solve pass over precomputed candidates, with the est-usage
+    accumulation and quota recharge :func:`~koordinator_tpu.ops.gang.
+    gang_assign` applies between passes — bit-identical to gang_assign's
+    first pass over a GANGLESS batch (the incremental scheduler path only
+    runs when the round has no gang pods).
+
+    Returns (assignments, new_state, new_quota, est_accum)."""
+    from koordinator_tpu.ops.assignment import pod_estimates
+
+    a, new_state, _ = _assign_rounds(state, pods, quota, cand_key,
+                                     cand_node, rounds)
+    keep = a >= 0
+    est = pod_estimates(pods, cfg)
+    node = jnp.where(keep, a, 0)
+    est_accum = jnp.zeros_like(state.node_usage).at[node].add(
+        jnp.where(keep[:, None], est, 0))
+    new_quota = quota
+    if quota is not None:
+        # the in-rounds quota feedback is discarded and recharged whole,
+        # exactly as gang_assign does after rollback
+        new_quota = charge_quota_batch(
+            quota, pods.requests, pods.quota_id, keep, pods.non_preemptible)
+    return a, new_state, new_quota, est_accum
+
+
+def assign_followup_pass(
+    state: ClusterState,
+    est_accum: jnp.ndarray,
+    pods: PodBatch,
+    quota: QuotaDeviceState | None,
+    cfg: ScoringConfig,
+    k: int = 32,
+    rounds: int = 12,
+    spread_bits=(5, 15),
+    method: str = "auto",
+):
+    """A later gang_assign pass over the (compacted) leftover pods:
+    candidates re-selected against the est-augmented state, assignments
+    committed into the UN-augmented accounting (gang_assign's rollback
+    rebuild).  Candidate selection is row-independent and rot_id rides
+    the compacted batch, so solving the compacted leftovers equals
+    solving the full batch with everyone else masked invalid.
+
+    Returns (assignments, new_state, new_quota, est_accum')."""
+    from koordinator_tpu.ops.assignment import pod_estimates
+
+    solve_state = state.replace(
+        node_usage=state.node_usage + est_accum,
+        node_agg_usage=state.node_agg_usage + est_accum)
+    a, _, _ = batch_assign(solve_state, pods, cfg, quota, k=k,
+                           rounds=rounds, spread_bits=spread_bits,
+                           method=method)
+    keep = (a >= 0) & pods.valid
+    node = jnp.where(keep, a, 0)
+    add = jnp.where(keep[:, None], pods.requests, 0)
+    new_state = state.replace(
+        node_requested=state.node_requested.at[node].add(add))
+    est = pod_estimates(pods, cfg)
+    est_accum = est_accum.at[node].add(jnp.where(keep[:, None], est, 0))
+    new_quota = quota
+    if quota is not None:
+        new_quota = charge_quota_batch(
+            quota, pods.requests, pods.quota_id, keep, pods.non_preemptible)
+    return a, new_state, new_quota, est_accum

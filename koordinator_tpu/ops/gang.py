@@ -157,7 +157,7 @@ def gang_assign(
     solver's candidate selection (batch_assign.CANDIDATE_METHODS), so
     gang solves can force the chunked/approx paths too.
     """
-    from koordinator_tpu.ops import scoring
+    from koordinator_tpu.ops.assignment import pod_estimates
     from koordinator_tpu.ops.batch_assign import batch_assign
 
     if solver not in ("greedy", "batch"):
@@ -182,9 +182,7 @@ def gang_assign(
     # Estimated usage of pods kept in earlier passes (the reference's
     # pod-assign cache): later passes must filter/score against it, else they
     # overcommit past the load thresholds a single-pass solve would enforce.
-    pod_est_all = scoring.estimate_pod_usage_by_band(
-        pods.requests, cfg.estimator_factors, cfg.estimator_defaults
-    )
+    pod_est_all = pod_estimates(pods, cfg)
     est_accum = jnp.zeros_like(state.node_usage)
 
     for _ in range(passes):

@@ -91,6 +91,7 @@ def shard_pod_batch(pods, mesh: Mesh):
         quota_id=jax.device_put(pods.quota_id, ps),
         non_preemptible=jax.device_put(pods.non_preemptible, ps),
         valid=jax.device_put(pods.valid, ps),
+        rot_id=jax.device_put(pods.rot_id, ps),
         feasible=(
             jax.device_put(pods.feasible, ms)
             if pods.feasible is not None else None

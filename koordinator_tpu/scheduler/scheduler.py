@@ -143,6 +143,7 @@ class Scheduler:
         cpu_manager=None,
         device_manager=None,
         elector=None,
+        incremental_solve: bool = True,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -189,8 +190,57 @@ class Scheduler:
         self._pending_rev = 0
         self._batch_cache: tuple[tuple, PodBatch] | None = None
         self.batch_rebuilds = 0
+        #: host-side arrays of the last batch build, for row-level reuse
+        #: when the queue changes incrementally (see _build_batch)
+        self._batch_host: dict | None = None
+        # solve-state donation: the caller's self.snapshot.state is dead
+        # the moment the call starts (XLA updates the (N, R) accounting
+        # in place) and is replaced wholesale by adopt_state right after
         self._solve = jax.jit(gang_assign,
-                              static_argnames=("passes", "solver"))
+                              static_argnames=("passes", "solver"),
+                              donate_argnums=(0,))
+
+        # -- incremental delta-driven solve (no-gang batch rounds) --
+        from koordinator_tpu.ops import batch_assign as _ba
+
+        #: steady-state rounds refresh a device-resident (P, k) candidate
+        #: cache against the dirty-node/pod delta instead of re-selecting
+        #: over the whole (P, N) problem; falls back to the full pass when
+        #: the dirty fraction crosses incremental_dirty_threshold
+        self.incremental_solve = incremental_solve
+        self.incremental_dirty_threshold = 0.25
+        #: candidate-selection knobs — MUST mirror batch_assign's defaults
+        #: (gang_assign's full path uses them), or the incremental and
+        #: full rounds would solve different problems
+        self.cand_k = 32
+        self.cand_spread = (5, 15)
+        self.cand_method = "auto"
+        self.solve_rounds = 12
+        self._cand_cache: dict | None = None
+        #: which candidate path the last batch round took
+        #: (incremental | full_cold | full_fallback | full_gang |
+        #: full_dense | disabled)
+        self.last_solve_path = "none"
+        #: stable per-pod-name rotation ids (PodBatch.rot_id): a pod keeps
+        #: its candidate tie-break rotation when the queue shifts around it
+        self._rot_ids: dict[str, int] = {}
+        self._rot_counter = 0
+        self._select_scored = jax.jit(
+            _ba.select_candidates,
+            static_argnames=("k", "spread_bits", "method", "with_scores"))
+        self._align_cands = jax.jit(_ba.align_candidate_cache)
+        self._refresh_cands = jax.jit(
+            _ba.refresh_candidates, static_argnames=("k", "spread_bits"),
+            donate_argnums=(3,))
+        self._scatter_cands = jax.jit(_ba.scatter_candidate_rows,
+                                      donate_argnums=(0,))
+        self._pass1 = jax.jit(_ba.assign_round_pass,
+                              static_argnames=("rounds",),
+                              donate_argnums=(0,))
+        self._pass2 = jax.jit(
+            _ba.assign_followup_pass,
+            static_argnames=("k", "rounds", "spread_bits", "method"),
+            donate_argnums=(0, 1))
         #: reservation lifecycle (plugins/reservation parity): reserve-pods
         #: schedule through the normal rounds, Available sets get a
         #: reservation-first exact solve pre-pass
@@ -198,7 +248,8 @@ class Scheduler:
         from koordinator_tpu.scheduler.reservations import ReservationCache
 
         self.reservations = ReservationCache()
-        self._rsv_solve = jax.jit(reservation_greedy_assign)
+        self._rsv_solve = jax.jit(reservation_greedy_assign,
+                                  donate_argnums=(0,))
         #: fine-grained allocators (nodenumaresource / deviceshare Reserve):
         #: LSR/LSE pods take exclusive cpusets, device requests take minors
         #: at bind; annotation payloads surface in resource_status
@@ -524,7 +575,8 @@ class Scheduler:
             self.snapshot.state, small, self.config, rsv_set,
             jnp.asarray(m_small), quota)
         a_r, rc = np.asarray(a_r), np.asarray(rc)
-        self.snapshot.adopt_state(new_state)
+        self.snapshot.adopt_state(new_state,
+                                  changed_rows=np.unique(a_r[a_r >= 0]))
         sub_pods = [pods[i] for i in idx]
         drawn = self.reservations.commit_allocations(names, sub_pods, a_r, rc)
         bound_rows = [int(idx[j]) for j in range(len(sub_pods))
@@ -635,7 +687,73 @@ class Scheduler:
         gang_id = np.full(p, -1, np.int32)
         quota_id = np.full(p, -1, np.int32)
         non_preempt = np.zeros(p, bool)
+        rot = np.zeros(p, np.int32)
+
+        # stable rotation ids: a pod keeps its candidate tie-break when
+        # the queue shifts around it (the incremental candidate cache's
+        # row-independence depends on this).  The registry is pruned
+        # against the live queue so a years-long scheduler doesn't leak.
+        if len(self._rot_ids) > 4 * max(len(self.pending), 64):
+            live = set(self.pending)
+            self._rot_ids = {name: rid for name, rid in
+                             self._rot_ids.items() if name in live}
         for i, pod in enumerate(pods):
+            rid = self._rot_ids.get(pod.name)
+            if rid is None:
+                rid = self._rot_ids[pod.name] = self._rot_counter
+                # 31-bit wrap: the id is a tie-break rotation identity
+                # (modular by construction), and an unbounded counter
+                # would overflow the int32 rot tensor after ~2.1e9
+                # distinct pod names in one process lifetime
+                self._rot_counter = (self._rot_counter + 1) & 0x7FFFFFFF
+            rot[i] = rid
+
+        # row-level reuse from the previous build: an incremental queue
+        # change (the steady-state delta) re-fills only the rows whose
+        # pod is new or re-specced; unchanged rows gather from the last
+        # build's host arrays in one vectorized copy.  Only valid when
+        # the id mappings and selector-mask width are unchanged — they
+        # parameterize row CONTENT.
+        c_cap = self.snapshot.class_capacity
+        prev = self._batch_host if not hinted else None
+        reuse_ok = (
+            prev is not None
+            and prev["gang_index"] == gang_index
+            and prev["quota_index"] == quota_index
+            and prev["class_cap"] == c_cap
+            # class COUNT, not just the padded width: a new equivalence
+            # class within the same bucket changes every pod's selector
+            # row content (the new class's column)
+            and prev["class_count"] == self.snapshot.class_count
+            and prev["dims"] == self.snapshot.dims
+        )
+        sel = np.zeros((p, c_cap), bool) if not hinted else None
+        fill_rows: list[int] = []
+        if reuse_ok:
+            src, dst = [], []
+            prev_row, prev_spec = prev["row_of"], prev["specs"]
+            for i, pod in enumerate(pods):
+                j = prev_row.get(pod.name)
+                if j is not None and prev_spec.get(pod.name) is pod:
+                    src.append(j)
+                    dst.append(i)
+                else:
+                    fill_rows.append(i)
+            if dst:
+                src_a, dst_a = np.asarray(src), np.asarray(dst)
+                requests[dst_a] = prev["requests"][src_a]
+                priority[dst_a] = prev["priority"][src_a]
+                qos[dst_a] = prev["qos"][src_a]
+                gang_id[dst_a] = prev["gang_id"][src_a]
+                quota_id[dst_a] = prev["quota_id"][src_a]
+                non_preempt[dst_a] = prev["non_preempt"][src_a]
+                sel[dst_a] = prev["sel"][src_a]
+        else:
+            fill_rows = list(range(p))
+
+        memo: dict[tuple, np.ndarray] = {}
+        for i in fill_rows:
+            pod = pods[i]
             requests[i] = pod.requests
             priority[i] = pod.priority
             qos[i] = pod.qos
@@ -644,6 +762,17 @@ class Scheduler:
             if pod.quota is not None and pod.quota in quota_index:
                 quota_id[i] = quota_index[pod.quota]
             non_preempt[i] = pod.non_preemptible
+            if sel is not None:
+                sel_key = (
+                    tuple(sorted(pod.node_selector.items())),
+                    tuple(sorted(pod.tolerations.items())),
+                )
+                row = memo.get(sel_key)
+                if row is None:
+                    row = self.snapshot.selector_row_for(pod)
+                    memo[sel_key] = row
+                sel[i] = row
+
         # placement constraints: factored O(P·C) equivalence-class masks by
         # default; the dense O(P·N) path only when a pod carries per-node
         # hint edits (rare — skip/prefer hints from the hinter)
@@ -654,27 +783,26 @@ class Scheduler:
                 feasible[i] = self.hints.apply_to_mask(pod.name, row)
             mask_kw = dict(feasible=feasible)
         else:
-            c_cap = self.snapshot.class_capacity
-            sel = np.zeros((p, c_cap), bool)
-            memo: dict[tuple, np.ndarray] = {}
-            for i, pod in enumerate(pods):
-                sel_key = (
-                    tuple(sorted(pod.node_selector.items())),
-                    tuple(sorted(pod.tolerations.items())),
-                )
-                row = memo.get(sel_key)
-                if row is None:
-                    row = self.snapshot.selector_row_for(pod)
-                    memo[sel_key] = row
-                sel[i] = row
             mask_kw = dict(selector_mask=sel, class_capacity=c_cap)
         batch = PodBatch.build(
             requests, priority=priority, qos=qos, gang_id=gang_id,
             quota_id=quota_id, non_preemptible=non_preempt,
-            node_capacity=n_cap, capacity=cap, **mask_kw,
+            node_capacity=n_cap, capacity=cap, rot_id=rot, **mask_kw,
         )
         if not hinted:
             self._batch_cache = (key, batch)
+            self._batch_host = {
+                "row_of": {pod.name: i for i, pod in enumerate(pods)},
+                "specs": {pod.name: pod for pod in pods},
+                "requests": requests, "priority": priority, "qos": qos,
+                "gang_id": gang_id, "quota_id": quota_id,
+                "non_preempt": non_preempt, "sel": sel,
+                "gang_index": dict(gang_index),
+                "quota_index": dict(quota_index),
+                "class_cap": c_cap,
+                "class_count": self.snapshot.class_count,
+                "dims": self.snapshot.dims,
+            }
         self.batch_rebuilds += 1
         return batch
 
@@ -835,57 +963,15 @@ class Scheduler:
             batch = self._build_batch(pods, gang_index, quota_index)
             batch = self._apply_topology_plans(batch, gang_index)
 
-        with self.monitor.phase("Solve"):
-            if len(self.reservations):
-                batch, quota = self._reservation_prepass(
-                    pods, batch, quota, result)
-            solver = ("batch" if len(pods) >= self.batch_solver_threshold
-                      else "greedy")
-            self.last_solver = solver
-            assignments, new_state, new_quota = self._solve(
-                self.snapshot.state, batch, self.config, gangs, quota,
-                passes=self.gang_passes, solver=solver,
-            )
-            a = np.asarray(assignments)
-            leftover = np.asarray(batch.valid) & (a < 0)
-            if solver == "batch" and bool(leftover[: len(pods)].any()):
-                # exact rescue pass over the leftovers: the batch engine's
-                # top-k/round approximation may fail pods a greedy scan
-                # would place, and a solver-approximation failure must
-                # never feed preemption, the gang WaitTime machine, or a
-                # persisted ScheduleFailed explanation. Rolled-back gangs
-                # come back whole; SURPLUS members of a gang already
-                # satisfied this round rescue as gangless pods (min_member
-                # is met — extras bind individually) so pre_enqueue/rollback
-                # inside the rescue solve can't strand them.
-                ga = np.asarray(batch.gang_id)
-                placed = np.bincount(
-                    ga[(ga >= 0) & (a >= 0)], minlength=gangs.capacity)
-                satisfied = placed >= np.asarray(gangs.min_member)
-                gid = batch.gang_id
-                rescue_gid = jnp.where(
-                    (gid >= 0) & jnp.asarray(satisfied)[jnp.maximum(gid, 0)],
-                    -1, gid)
-                # compact the leftovers first: the exact greedy solve is a
-                # sequential scan over the POD AXIS, so rescuing 50 pods
-                # must cost a 64-row scan, not the full 50k-row batch.
-                # ``leftover`` is the single source of truth for which rows
-                # rescue (compact keeps exactly those and marks the rest of
-                # the padded capacity invalid).
-                small, idx = batch.replace(gang_id=rescue_gid).compact(
-                    leftover)
-                r_small, new_state, new_quota = self._solve(
-                    new_state, small, self.config, gangs, new_quota,
-                    passes=self.gang_passes, solver="greedy",
-                )
-                r_full = np.full(batch.capacity, -1, np.int32)
-                r_full[idx] = np.asarray(r_small)[: len(idx)]
-                assignments = jnp.where(
-                    assignments >= 0, assignments, jnp.asarray(r_full))
-                a = np.asarray(assignments)
         if (self.debug_service is not None
                 and self.debug_service.dump_top_n_scores > 0):
-            # debug-only extra solve: dump per-pod node scores
+            # debug-only extra solve: dump per-pod node scores.  BEFORE
+            # the solve phase: the jitted solves donate the state
+            # buffers, so pre-solve state is unreadable once they run.
+            # The dump records scores against the PRE-ROUND accounting —
+            # on reservation rounds that is now before the reservation
+            # prepass adopts its bindings (previously the dump ran after
+            # it), so it shows the state the round STARTED from
             from koordinator_tpu.ops.assignment import score_pods
 
             scores, _ = score_pods(self.snapshot.state, batch, self.config)
@@ -895,9 +981,96 @@ class Scheduler:
                  for r in range(self.snapshot.state.capacity)],
             )
 
+        try:
+            with self.monitor.phase("Solve"):
+                if len(self.reservations):
+                    batch, quota = self._reservation_prepass(
+                        pods, batch, quota, result)
+                solver = ("batch" if len(pods) >= self.batch_solver_threshold
+                          else "greedy")
+                self.last_solver = solver
+                # incremental fast path: a gangless batch round re-scores only
+                # the delta against the persistent candidate cache; gang
+                # rounds, hinted (dense-mask) rounds and the exact greedy
+                # solver keep the one-call full path
+                use_inc = (solver == "batch" and self.incremental_solve
+                           and not gang_index
+                           and batch.selector_mask is not None)
+                if use_inc:
+                    assignments, new_state, new_quota = (
+                        self._solve_batch_incremental(pods, batch, quota))
+                else:
+                    if solver == "batch":
+                        self.last_solve_path = (
+                            "full_gang" if gang_index
+                            else "full_dense" if batch.selector_mask is None
+                            else "disabled")
+                        metrics.incremental_solve_total.inc(labels={
+                            "path": self.last_solve_path})
+                    assignments, new_state, new_quota = self._solve(
+                        self.snapshot.state, batch, self.config, gangs, quota,
+                        passes=self.gang_passes, solver=solver,
+                    )
+                    # the jitted solve donated the old state buffers; keep the
+                    # snapshot on live ones until Reserve's bookkeeping adopt
+                    self.snapshot.state = new_state
+                a = np.asarray(assignments)
+                leftover = np.asarray(batch.valid) & (a < 0)
+                if solver == "batch" and bool(leftover[: len(pods)].any()):
+                    # exact rescue pass over the leftovers: the batch engine's
+                    # top-k/round approximation may fail pods a greedy scan
+                    # would place, and a solver-approximation failure must
+                    # never feed preemption, the gang WaitTime machine, or a
+                    # persisted ScheduleFailed explanation. Rolled-back gangs
+                    # come back whole; SURPLUS members of a gang already
+                    # satisfied this round rescue as gangless pods (min_member
+                    # is met — extras bind individually) so pre_enqueue/rollback
+                    # inside the rescue solve can't strand them.
+                    ga = np.asarray(batch.gang_id)
+                    placed = np.bincount(
+                        ga[(ga >= 0) & (a >= 0)], minlength=gangs.capacity)
+                    satisfied = placed >= np.asarray(gangs.min_member)
+                    gid = batch.gang_id
+                    rescue_gid = jnp.where(
+                        (gid >= 0) & jnp.asarray(satisfied)[jnp.maximum(gid, 0)],
+                        -1, gid)
+                    # compact the leftovers first: the exact greedy solve is a
+                    # sequential scan over the POD AXIS, so rescuing 50 pods
+                    # must cost a 64-row scan, not the full 50k-row batch.
+                    # ``leftover`` is the single source of truth for which rows
+                    # rescue (compact keeps exactly those and marks the rest of
+                    # the padded capacity invalid).
+                    small, idx = batch.replace(gang_id=rescue_gid).compact(
+                        leftover)
+                    r_small, new_state, new_quota = self._solve(
+                        new_state, small, self.config, gangs, new_quota,
+                        passes=self.gang_passes, solver="greedy",
+                    )
+                    self.snapshot.state = new_state
+                    r_full = np.full(batch.capacity, -1, np.int32)
+                    r_full[idx] = np.asarray(r_small)[: len(idx)]
+                    assignments = jnp.where(
+                        assignments >= 0, assignments, jnp.asarray(r_full))
+                    a = np.asarray(assignments)
+        except Exception:
+            # the jitted solves DONATE the state buffers: an
+            # execution-time failure mid-round has already consumed
+            # them, and without recovery every later round would die
+            # on "Array has been deleted".  (Trace/compile errors —
+            # the common failure class — raise before any donation
+            # executes, so the buffers are still live and nothing is
+            # rebuilt.)  The conservative rebuild keeps the scheduler
+            # alive and never-overcommitting; a sync resync restores
+            # exact accounting.
+            if any(getattr(leaf, "is_deleted", lambda: False)()
+                   for leaf in jax.tree.leaves(self.snapshot.state)):
+                self.snapshot.rebuild_conservative()
+            self._cand_cache = None
+            raise
         result.round_pods = len(pods)
         with self.monitor.phase("Reserve"):
-            self.snapshot.adopt_state(new_state)
+            self.snapshot.adopt_state(new_state,
+                                      changed_rows=np.unique(a[a >= 0]))
 
         with self.monitor.phase("Bind"):
             placed_gangs: set[str] = set()
@@ -985,6 +1158,158 @@ class Scheduler:
 
         metrics.pending_pods.set(float(len(self.pending)))  # post-bind queue
         return result
+
+    # -- incremental delta-driven solve -------------------------------------
+
+    def _solve_batch_incremental(self, pods, batch: PodBatch, quota):
+        """The no-gang batch solve with the persistent device-resident
+        candidate cache (ops/batch_assign incremental section).
+
+        Steady state: the round re-scores only dirty rows — pods newly
+        arrived/re-specced or whose cached candidates touch a dirty node —
+        against a dirty-node column mask accumulated by the snapshot from
+        the deltas applied under this scheduler's round lock, then merges
+        them into the cached (P, k) tensor.  When the dirty fraction
+        crosses ``incremental_dirty_threshold`` (or no valid cache
+        exists) the full selection runs instead and re-warms the cache.
+        Either way the propose/accept passes afterwards mirror
+        gang_assign's gangless pass loop bit for bit, so flipping paths
+        never changes acceptance decisions — staleness in the cache can
+        only cost candidate recall, and acceptance re-checks fit and
+        quota exactly.
+
+        Returns (assignments, new_state, new_quota) like gang_assign.
+        """
+        from koordinator_tpu.ops import batch_assign as ba
+
+        snap = self.snapshot
+        n = snap.capacity
+        k = min(self.cand_k, n)
+        method = self.cand_method
+        if method == "auto":
+            method = "approx" if jax.default_backend() == "tpu" else "exact"
+        meta = self._cand_cache
+        cache_ok = (
+            meta is not None
+            and meta["n"] == n
+            and meta["k"] == k
+            and meta["spread"] == self.cand_spread
+            and meta["method"] == method
+            # identity via the OBJECT, not id(): a freed config's address
+            # can be reused by its replacement (CPython free lists)
+            and meta["cfg"] is self.config
+        )
+        # consumed exactly once per cache rebuild/refresh — both branches
+        # below leave a cache that reflects post-consume state
+        dirty_rows = [r for r in snap.consume_candidate_dirty() if r < n]
+
+        path = "full_cold"
+        cache = None
+        if cache_ok:
+            node_frac = len(dirty_rows) / max(len(snap.node_index), 1)
+            row_of, specs = meta["row_of"], meta["specs"]
+            map_rows = np.zeros(batch.capacity, np.int32)
+            map_ok = np.zeros(batch.capacity, bool)
+            changed = np.zeros(batch.capacity, bool)
+            for i, pod in enumerate(pods):
+                j = row_of.get(pod.name)
+                if j is not None and specs.get(pod.name) is pod:
+                    map_rows[i] = j
+                    map_ok[i] = True
+                else:
+                    changed[i] = True
+            dirty_np = np.zeros(n, bool)
+            dirty_np[dirty_rows] = True
+            dpad = _bucket(max(len(dirty_rows), 1), minimum=64)
+            drows = np.zeros(dpad, np.int32)
+            drows[: len(dirty_rows)] = dirty_rows
+            dvalid = np.zeros(dpad, bool)
+            dvalid[: len(dirty_rows)] = True
+            aligned, touch = self._align_cands(
+                meta["cache"], jnp.asarray(map_rows), jnp.asarray(map_ok),
+                jnp.asarray(dirty_np))
+            dirty_pods = changed | np.asarray(touch)
+            pod_frac = float(dirty_pods.sum()) / max(len(pods), 1)
+            metrics.incremental_dirty_fraction.set(
+                node_frac, labels={"kind": "nodes"})
+            metrics.incremental_dirty_fraction.set(
+                pod_frac, labels={"kind": "pods"})
+            metrics.incremental_dirty_pods.set(float(dirty_pods.sum()))
+            if max(node_frac, pod_frac) <= self.incremental_dirty_threshold:
+                path = "incremental"
+                cand_key, cache = self._refresh_cands(
+                    snap.state, batch, self.config, aligned,
+                    jnp.asarray(drows), jnp.asarray(dvalid),
+                    k=k, spread_bits=self.cand_spread)
+                if dirty_pods.any():
+                    small, idx = batch.compact(dirty_pods)
+                    sk, sn, ss = self._select_scored(
+                        snap.state, small, self.config, k=k,
+                        spread_bits=self.cand_spread, method=method,
+                        with_scores=True)
+                    rows_pad = np.full(small.capacity, batch.capacity,
+                                       np.int32)
+                    rows_pad[: len(idx)] = idx
+                    cache = self._scatter_cands(
+                        cache, jnp.asarray(rows_pad), sk, sn, ss)
+            else:
+                path = "full_fallback"
+        if cache is None:
+            ck, cn, cs = self._select_scored(
+                snap.state, batch, self.config, k=k,
+                spread_bits=self.cand_spread, method=method,
+                with_scores=True)
+            cache = ba.CandidateCache(ck, cn, cs)
+        metrics.incremental_solve_total.inc(labels={"path": path})
+        # the batch build already computed this round's name→row / spec
+        # maps for its own row reuse — share them instead of a third O(P)
+        # walk (the driver only runs on non-hinted batches, which always
+        # populate _batch_host)
+        host = self._batch_host
+        self._cand_cache = {
+            "cache": cache,
+            "row_of": host["row_of"],
+            "specs": host["specs"],
+            "n": n, "k": k, "spread": self.cand_spread,
+            "method": method, "cfg": self.config,
+        }
+        self.last_solve_path = path
+
+        # gangless gang_assign pass loop: pass 1 over the cached/refreshed
+        # candidates, later passes full-select over the COMPACTED leftovers
+        # (small × N, not P × N) against the est-usage-augmented state.
+        # The passes donate the state they consume; re-pointing
+        # snapshot.state at each returned state keeps the snapshot on
+        # LIVE buffers (trace/compile errors — the realistic failure
+        # class — raise before any donation executes; an execution-time
+        # failure mid-chain is unrecoverable without a sync resync
+        # either way).  On any failure the cache is dropped so the next
+        # round re-warms instead of trusting un-bookkept state.
+        try:
+            a, state, quota, est_accum = self._pass1(
+                snap.state, batch, quota, cache.cand_key, cache.cand_node,
+                self.config, rounds=self.solve_rounds)
+            snap.state = state
+            a_np = np.asarray(a)
+            for _ in range(1, self.gang_passes):
+                leftover = np.asarray(batch.valid) & (a_np < 0)
+                if not leftover.any():
+                    break
+                small, idx = batch.compact(leftover)
+                a2, state, quota, est_accum = self._pass2(
+                    state, est_accum, small, quota, self.config, k=k,
+                    rounds=self.solve_rounds, spread_bits=self.cand_spread,
+                    method=method)
+                snap.state = state
+                a2_np = np.asarray(a2)[: len(idx)]
+                placed = a2_np >= 0
+                if not placed.any():
+                    break
+                a_np[idx[placed]] = a2_np[placed]
+        except Exception:
+            self._cand_cache = None
+            raise
+        return jnp.asarray(a_np), state, quota
 
     def _commit_bind(
         self, pod: PodSpec, node: str, result: SchedulingResult,

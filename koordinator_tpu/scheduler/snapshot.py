@@ -75,6 +75,13 @@ class ClusterSnapshot:
         self.node_specs: dict[str, NodeSpec] = {}
         self._free_rows: list[int] = list(range(capacity - 1, -1, -1))
         self._dirty: set[int] = set()
+        #: rows whose solver-visible state changed since the incremental
+        #: candidate cache last consumed them (superset of _dirty: spec
+        #: upserts AND accounting changes — reserve/unreserve/solve
+        #: adoption — land here; _dirty only tracks host-spec rows
+        #: pending a device flush).  The scheduler's candidate cache
+        #: derives its dirty-node column mask from this set.
+        self._cand_dirty: set[int] = set()
         # rows whose solver-accumulated node_requested must be zeroed at next
         # flush (freed by remove_node; a reused row must not inherit the dead
         # node's accounting)
@@ -157,6 +164,7 @@ class ClusterSnapshot:
         self.node_specs[spec.name] = spec
         self._class_of(spec)  # register the equivalence class up front
         self._dirty.add(row)
+        self._cand_dirty.add(row)
         return row
 
     def remove_node(self, name: str) -> None:
@@ -167,6 +175,7 @@ class ClusterSnapshot:
         del self._row_to_name[row]
         self._free_rows.append(row)
         self._dirty.add(row)
+        self._cand_dirty.add(row)
         self._reset_requested.add(row)
 
     def _grow(self) -> None:
@@ -224,8 +233,11 @@ class ClusterSnapshot:
             valid[i] = True
             nclass[i] = self._class_of(spec)
         idx = jnp.asarray(np.asarray(rows, np.int32))
+        # donate=True: the snapshot owns its state exclusively, so the
+        # (N, R) tensors update in place instead of reallocating per flush
         self.state = self.state.scatter_update(
             idx,
+            donate=True,
             node_allocatable=jnp.asarray(alloc),
             node_usage=jnp.asarray(usage),
             node_agg_usage=jnp.asarray(agg),
@@ -240,12 +252,14 @@ class ClusterSnapshot:
     def reserve(self, node: str, requests: np.ndarray) -> None:
         """Account a binding onto a node (Reserve)."""
         row = self.node_index[node]
+        self._cand_dirty.add(row)
         self.state = self.state.add_pod(
             jnp.asarray(np.int32(row)), jnp.asarray(requests.astype(np.int32))
         )
 
     def unreserve(self, node: str, requests: np.ndarray) -> None:
         row = self.node_index[node]
+        self._cand_dirty.add(row)
         self.state = self.state.remove_pod(
             jnp.asarray(np.int32(row)), jnp.asarray(requests.astype(np.int32))
         )
@@ -264,11 +278,50 @@ class ClusterSnapshot:
             return
         self.unreserve(node, requests)
 
-    def adopt_state(self, state: ClusterState) -> None:
-        """Adopt solver-updated accounting (post gang/greedy assign)."""
+    def adopt_state(self, state: ClusterState,
+                    changed_rows=None) -> None:
+        """Adopt solver-updated accounting (post gang/greedy assign).
+
+        ``changed_rows`` names the node rows whose ``node_requested`` the
+        solver touched (the assigned rows) so the candidate cache only
+        invalidates those; None is the conservative default — every
+        valid row is treated as dirty."""
         if state.capacity != self.capacity:
             raise ValueError("state capacity mismatch")
+        if changed_rows is None:
+            self._cand_dirty.update(self.node_index.values())
+        else:
+            self._cand_dirty.update(int(r) for r in changed_rows)
         self.state = state
+
+    def rebuild_conservative(self) -> None:
+        """Disaster recovery for a DONATED-then-failed device state: a
+        jitted solve that fails at execution time has already consumed
+        the old buffers, so the accounting tensor (node_requested) is
+        unrecoverable host-side.  Rebuild the spec-side tensors from
+        ``node_specs`` and mark every valid node FULLY BOOKED
+        (requested = allocatable): the scheduler keeps running and never
+        overcommits, but places nothing new on existing nodes until a
+        sync resync (SchedulerBinding.reset + bootstrap) or node churn
+        restores exact accounting.  Releases stay safe: true bookings
+        are always <= allocatable, so subtracting a released pod keeps
+        the conservative row >= the true remaining bookings."""
+        self.state = ClusterState.zeros(self.capacity, self.dims)
+        self._reset_requested.clear()
+        self._dirty.update(self.node_index.values())
+        self._cand_dirty.update(self.node_index.values())
+        self.flush()
+        self.state = self.state.replace(
+            node_requested=jnp.where(self.state.node_valid[:, None],
+                                     self.state.node_allocatable,
+                                     0))
+
+    def consume_candidate_dirty(self) -> list[int]:
+        """Rows dirtied since the last consume (sorted), clearing the set
+        — called exactly when the candidate cache is rebuilt/refreshed."""
+        rows = sorted(self._cand_dirty)
+        self._cand_dirty.clear()
+        return rows
 
     # -- queries ------------------------------------------------------------
 

@@ -54,6 +54,12 @@ def _bucket(n: int, minimum: int = 64) -> int:
     return cap
 
 
+#: donating row scatter: XLA aliases the output to the input buffer, so a
+#: flush-sized (N, R) tensor is updated in place instead of reallocated
+_row_set_donating = jax.jit(
+    lambda cur, rows, value: cur.at[rows].set(value), donate_argnums=(0,))
+
+
 @struct.dataclass
 class ClusterState:
     """Per-node tensors, shape (N, R) / (N,). N is the padded node capacity."""
@@ -83,13 +89,17 @@ class ClusterState:
 
     @classmethod
     def zeros(cls, capacity: int, dims: int = NUM_RESOURCE_DIMS) -> "ClusterState":
-        z = jnp.zeros((capacity, dims), dtype=jnp.int32)
+        # one DISTINCT buffer per field: the donating flush consumes
+        # fields independently, so aliased zeros would die together
+        def z():
+            return jnp.zeros((capacity, dims), dtype=jnp.int32)
+
         return cls(
-            node_allocatable=z,
-            node_requested=z,
-            node_usage=z,
-            node_agg_usage=z,
-            node_prod_usage=z,
+            node_allocatable=z(),
+            node_requested=z(),
+            node_usage=z(),
+            node_agg_usage=z(),
+            node_prod_usage=z(),
             node_valid=jnp.zeros((capacity,), dtype=bool),
             node_class=jnp.zeros((capacity,), dtype=jnp.int32),
         )
@@ -131,17 +141,45 @@ class ClusterState:
             node_class=jnp.asarray(nclass),
         )
 
-    def scatter_update(self, rows: jax.Array, **updates: jax.Array) -> "ClusterState":
+    def scatter_update(self, rows: jax.Array, donate: bool = False,
+                       **updates: jax.Array) -> "ClusterState":
         """Apply a delta: replace the given rows of the named tensors.
 
         ``rows`` is (K,) int32; each update value is (K, R) (or (K,) for masks).
         Only the changed rows travel host->device.
+
+        ``donate=True`` routes each row-set through a donating jit so the
+        (N, R) tensor is updated in place instead of reallocated — for
+        callers that OWN the state exclusively (the snapshot's flush):
+        the pre-update buffers are dead after the call and any stale
+        reference to them errors loudly.
         """
         new = {}
+        setter = _row_set_donating if donate else (
+            lambda cur, r, v: cur.at[r].set(v))
         for name, value in updates.items():
             cur = getattr(self, name)
-            new[name] = cur.at[rows].set(value)
+            new[name] = setter(cur, rows, value)
         return self.replace(**new)
+
+    def gather_rows(self, rows: jax.Array,
+                    row_valid: jax.Array | None = None) -> "ClusterState":
+        """Sub-state of the given node rows (shape (K, R) / (K,)): the
+        dirty-column view the incremental candidate refresh scores
+        against.  ``row_valid`` additionally masks padded entries of a
+        bucketed ``rows`` vector so they score as invalid nodes."""
+        valid = self.node_valid[rows]
+        if row_valid is not None:
+            valid = valid & row_valid
+        return ClusterState(
+            node_allocatable=self.node_allocatable[rows],
+            node_requested=self.node_requested[rows],
+            node_usage=self.node_usage[rows],
+            node_agg_usage=self.node_agg_usage[rows],
+            node_prod_usage=self.node_prod_usage[rows],
+            node_valid=valid,
+            node_class=self.node_class[rows],
+        )
 
     def add_pod(self, node_idx: jax.Array, request: jax.Array) -> "ClusterState":
         """Account a pod's request onto a node (Reserve semantics)."""
@@ -183,6 +221,13 @@ class PodBatch:
     quota_id: jax.Array    # (P,) int32 — elastic-quota index, -1 = none
     non_preemptible: jax.Array  # (P,) bool — checks/consumes quota min
     valid: jax.Array       # (P,) bool
+    #: (P,) int32 tie-break rotation identity: the candidate ranking's
+    #: per-pod rotation (ops/batch_assign._ranked_scores) derives from
+    #: this, NOT from the pod's batch row, so a pod keeps its candidate
+    #: set when the queue around it churns (the incremental candidate
+    #: cache depends on that stability).  Defaults to the batch row
+    #: index; the scheduler assigns a stable id per pod name.
+    rot_id: jax.Array
     feasible: jax.Array | None       # (P, N) bool dense mask, or None
     selector_mask: jax.Array | None  # (P, C) bool class mask, or None
 
@@ -252,6 +297,7 @@ class PodBatch:
         node_capacity: int = 64,
         class_capacity: int = 1,
         capacity: int | None = None,
+        rot_id: np.ndarray | None = None,
     ) -> "PodBatch":
         p, dims = requests.shape
         cap = capacity if capacity is not None else _bucket(p)
@@ -282,6 +328,12 @@ class PodBatch:
         valid = np.zeros(cap, dtype=bool)
         valid[:p] = True
 
+        # rotation identity defaults to the batch row (the pre-cache
+        # behavior); padded rows keep their row index (inert: invalid)
+        rot = np.arange(cap, dtype=np.int32)
+        if rot_id is not None:
+            rot[:p] = rot_id
+
         return cls(
             requests=jnp.asarray(req),
             priority=pad1(priority, 0, np.int32),
@@ -290,6 +342,7 @@ class PodBatch:
             quota_id=pad1(quota_id, -1, np.int32),
             non_preemptible=pad1(non_preemptible, False, bool),
             valid=jnp.asarray(valid),
+            rot_id=jnp.asarray(rot),
             feasible=feas_arr,
             selector_mask=sel_arr,
         )

@@ -115,6 +115,17 @@ class _Conn:
                 self.sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
+            # the sender may have drained the whole backlog between our
+            # Full and the shutdown, in which case it is blocked on
+            # queue.get() with no poison coming — a permanently leaked
+            # thread.  Retry once: either the poison lands now (queue
+            # has room) and the sender exits on it, or the queue is
+            # still full, meaning frames remain and the sender will hit
+            # the shut-down socket's OSError on its next send and exit.
+            try:
+                self.queue.put_nowait(None)
+            except queue.Full:
+                pass
 
     def _drain(self) -> None:
         while True:

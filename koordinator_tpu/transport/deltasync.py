@@ -257,7 +257,10 @@ class StateSyncService:
                           agg_usage: np.ndarray | None = None,
                           prod_usage: np.ndarray | None = None,
                           sys_usage: np.ndarray | None = None,
-                          hp_usage: np.ndarray | None = None) -> int:
+                          hp_usage: np.ndarray | None = None,
+                          hp_request: np.ndarray | None = None,
+                          hp_max_used_req: np.ndarray | None = None,
+                          report_time: float | None = None) -> int:
         """The NodeMetric loop's wire form (SURVEY §3.2): refresh a
         node's USAGE without re-sending allocatable — what a koordlet's
         reporter knows.  The stored node entry merges the new usage so a
@@ -271,7 +274,15 @@ class StateSyncService:
         the colocation formula's inputs (slo-controller/noderesource
         plugins/util/util.go:55: Batch = Total - SafetyMargin -
         max(System, Reserved) - HP.Used) — a manager watch client
-        consumes them; the scheduler binding ignores them."""
+        consumes them; the scheduler binding ignores them.
+        ``hp_request`` (sum of HP pods' REQUESTS) and ``hp_max_used_req``
+        (per-pod max(request, usage) summed over HP pods) feed the
+        ``request``/``maxUsageRequest`` calculate policies — without
+        them a wire-fed manager silently over-advertises batch capacity
+        under those policies.  ``report_time`` is the KOORDLET's report
+        timestamp (NodeMetric update_time): consumers date the usage by
+        it, not by their apply-time clock, so degrade windows survive a
+        manager restart + bootstrap replay."""
         arrays: dict[str, np.ndarray] = {
             "usage": np.asarray(usage, np.int32)}
         if agg_usage is not None:
@@ -282,14 +293,26 @@ class StateSyncService:
             arrays["sys_usage"] = np.asarray(sys_usage, np.int32)
         if hp_usage is not None:
             arrays["hp_usage"] = np.asarray(hp_usage, np.int32)
+        if hp_request is not None:
+            arrays["hp_request"] = np.asarray(hp_request, np.int32)
+        if hp_max_used_req is not None:
+            arrays["hp_max_used_req"] = np.asarray(hp_max_used_req,
+                                                   np.int32)
+        event: dict = {"kind": NODE_USAGE, "name": name}
+        if report_time is not None:
+            event["usage_time"] = float(report_time)
         with self._lock:
             entry = self.nodes.get(name)
             if entry is None:
                 raise wire.WireSchemaError(
                     f"node_usage for unknown node {name!r}")
             entry["arrays"] = dict(entry["arrays"], **arrays)
-            rv = self._commit_locked(
-                {"kind": NODE_USAGE, "name": name}, arrays)
+            if report_time is not None:
+                # merge into the stored doc so a bootstrap snapshot
+                # replays the ORIGINAL report time, not the apply time
+                entry["doc"] = dict(entry["doc"],
+                                    usage_time=float(report_time))
+            rv = self._commit_locked(event, arrays)
         if self._local_bindings:
             self._drain_bindings()
         return rv
@@ -491,6 +514,7 @@ class StateSyncService:
         for int_field in ("priority", "qos"):
             require_doc(int_field, int, "an integer")
         require_doc("ttl_sec", (int, float), "a number")
+        require_doc("usage_time", (int, float), "a number")
         for bool_field in ("allocate_once", "restricted"):
             require_doc(bool_field, bool, "a boolean")
 
@@ -506,7 +530,7 @@ class StateSyncService:
         elif kind == NODE_USAGE:
             require_vector("usage")
             for optional in ("agg_usage", "prod_usage", "sys_usage",
-                             "hp_usage"):
+                             "hp_usage", "hp_request", "hp_max_used_req"):
                 if optional in arrays:
                     require_vector(optional)
             rv = self.update_node_usage(
@@ -514,7 +538,10 @@ class StateSyncService:
                 agg_usage=arrays.get("agg_usage"),
                 prod_usage=arrays.get("prod_usage"),
                 sys_usage=arrays.get("sys_usage"),
-                hp_usage=arrays.get("hp_usage"))
+                hp_usage=arrays.get("hp_usage"),
+                hp_request=arrays.get("hp_request"),
+                hp_max_used_req=arrays.get("hp_max_used_req"),
+                report_time=doc.get("usage_time"))
         elif kind == NODE_ALLOC:
             require_vector("allocatable")
             rv = self.update_node_allocatable(name, arrays["allocatable"])

@@ -126,6 +126,7 @@ REQUEST_SCHEMAS: dict[FrameType, dict[str, tuple]] = {
 #: (the sys_usage/hp_usage drift the r5 review caught)
 STATE_PUSH_ARRAY_KEYS = ("allocatable", "usage", "agg_usage",
                          "prod_usage", "sys_usage", "hp_usage",
+                         "hp_request", "hp_max_used_req",
                          "requests")
 
 

@@ -22,10 +22,12 @@ def test_stage_profiler_smoke():
     records = [json.loads(line) for line in proc.stdout.splitlines()]
     stages = {r["stage"] for r in records}
     assert stages == {"rtt_floor", "score", "select_approx",
-                      "select_chunked", "rounds"}, stages
+                      "select_chunked", "rounds",
+                      "refresh_incremental_1pct"}, stages
     by_stage = {r["stage"]: r for r in records}
     # every timed stage produced a positive per-iteration time
-    for name in ("score", "select_approx", "select_chunked", "rounds"):
+    for name in ("score", "select_approx", "select_chunked", "rounds",
+                 "refresh_incremental_1pct"):
         assert by_stage[name]["ms_per_iter"] > 0, by_stage[name]
     # the rounds stage really assigned pods (256 pods, ample capacity)
     assert by_stage["rounds"]["assigned_per_iter"] > 0

@@ -271,3 +271,106 @@ def test_manager_boots_before_scheduler(tmp_path):
         manager_asm.component.stop()
         if sched is not None:
             sched.stop()
+
+
+def test_wire_fed_hp_request_aggregates_feed_calculate_policies():
+    """maxUsageRequest/request policies on wire-fed records: without the
+    hp_request/hp_max_used_req aggregates on the node_usage report the
+    policy inputs were silently 0 and batch capacity over-advertised by
+    the whole HP request footprint."""
+    from koordinator_tpu.manager.sloconfig import ColocationConfig
+
+    clock = FakeClock()
+    config = ColocationConfig(enable=True,
+                              cpu_calculate_policy="maxUsageRequest",
+                              memory_calculate_policy="request")
+
+    def run(with_aggregates: bool):
+        service = StateSyncService()
+        service.upsert_node("n0", resource_vector(cpu=16_000, memory=16_384))
+        kw = {}
+        if with_aggregates:
+            kw = dict(
+                hp_request=resource_vector(cpu=8_000, memory=9_000),
+                hp_max_used_req=resource_vector(cpu=9_000, memory=10_000))
+        service.update_node_usage(
+            "n0", resource_vector(cpu=2_000, memory=4_096),
+            sys_usage=resource_vector(cpu=500, memory=512),
+            hp_usage=resource_vector(cpu=3_000, memory=2_048), **kw)
+        binding = ManagerSyncBinding(clock=clock)
+        service.attach_binding(binding)
+        # re-send live (attach_binding has no retroactive replay)
+        service.update_node_usage(
+            "n0", resource_vector(cpu=2_000, memory=4_096),
+            sys_usage=resource_vector(cpu=500, memory=512),
+            hp_usage=resource_vector(cpu=3_000, memory=2_048), **kw)
+        pushes = []
+        loop = ColocationLoop(NodeResourceController(config, clock=clock),
+                              binding,
+                              lambda name, alloc: pushes.append(alloc))
+        # the node view needs allocatable: replay the upsert live too
+        service.upsert_node("n0", resource_vector(cpu=16_000, memory=16_384))
+        service.update_node_usage(
+            "n0", resource_vector(cpu=2_000, memory=4_096),
+            sys_usage=resource_vector(cpu=500, memory=512),
+            hp_usage=resource_vector(cpu=3_000, memory=2_048), **kw)
+        assert loop.tick() == 1
+        return pushes[-1]
+
+    with_agg = run(True)
+    without = run(False)
+    # maxUsageRequest (cpu): 9,000m of per-pod max(request, usage) must be
+    # carved out instead of 0 — the with-aggregates push advertises less
+    assert (int(without[ResourceDim.BATCH_CPU])
+            - int(with_agg[ResourceDim.BATCH_CPU])) >= 8_000
+    # request (memory): the 9,000 MiB HP request footprint likewise
+    assert (int(without[ResourceDim.BATCH_MEMORY])
+            - int(with_agg[ResourceDim.BATCH_MEMORY])) >= 8_000
+
+
+def test_bootstrap_replay_preserves_report_time_for_degrade():
+    """A manager that bootstraps AFTER the koordlet's last report must
+    date the usage by the REPORT timestamp riding the merged doc, not by
+    apply time: a stale node is then zeroed on the first reconcile
+    instead of getting a fresh degrade window per restart."""
+    clock = FakeClock(t=1_000.0)
+    service = StateSyncService()
+    service.upsert_node("n0", resource_vector(cpu=16_000, memory=16_384))
+    service.update_node_usage(
+        "n0", resource_vector(cpu=2_000, memory=4_096),
+        sys_usage=resource_vector(cpu=500, memory=512),
+        hp_usage=resource_vector(cpu=3_000, memory=2_048),
+        report_time=1_000.0)
+
+    # 20 minutes later (past degradeTimeMinutes=15) a fresh manager
+    # attaches and replays the bootstrap snapshot
+    clock.t = 1_000.0 + 20 * 60
+    binding = ManagerSyncBinding(clock=clock)
+    doc, arrays = service._snapshot()
+    from koordinator_tpu.transport.deltasync import (
+        _dispatch_event,
+        _unpack_event_arrays,
+    )
+
+    for entry in doc["events"]:
+        _dispatch_event(binding, entry, _unpack_event_arrays(entry, arrays))
+    with binding.lock:
+        assert binding.nodes["n0"].usage_time == 1_000.0
+
+    pushes = []
+    loop = ColocationLoop(NodeResourceController(clock=clock), binding,
+                          lambda name, alloc: pushes.append(alloc))
+    assert loop.tick() == 1, "stale node must push a zeroing patch"
+    zeroed = pushes[-1]
+    assert int(zeroed[ResourceDim.BATCH_CPU]) == 0
+    assert int(zeroed[ResourceDim.BATCH_MEMORY]) == 0
+
+    # a FRESH report (new report_time) recovers capacity
+    service.attach_binding(binding)
+    service.update_node_usage(
+        "n0", resource_vector(cpu=2_000, memory=4_096),
+        sys_usage=resource_vector(cpu=500, memory=512),
+        hp_usage=resource_vector(cpu=3_000, memory=2_048),
+        report_time=clock.t)
+    assert loop.tick() == 1
+    assert int(pushes[-1][ResourceDim.BATCH_CPU]) > 0

@@ -1044,3 +1044,70 @@ def test_node_allocatable_push_merges_without_clobbering(rpc):
         client.call(FrameType.STATE_PUSH,
                     {"kind": "node_allocatable", "name": "ghost"},
                     {"allocatable": np.asarray(new_alloc, np.int32)})
+
+
+def test_conn_close_with_full_queue_does_not_leak_sender_thread():
+    """_Conn.close vs a momentarily-full queue: the sender can drain the
+    whole backlog between close()'s failed poison put and its direct
+    socket shutdown, then block forever on queue.get() with no poison
+    coming.  close() must retry the poison after the shutdown so the
+    sender thread always exits."""
+    import queue as _queue
+
+    from koordinator_tpu.transport.channel import _Conn
+    from koordinator_tpu.transport.wire import (
+        Frame,
+        FrameType,
+        encode_payload,
+    )
+
+    drained = threading.Event()
+    in_send = threading.Event()
+
+    class FakeSock:
+        """sendall blocks until released; shutdown (called from close's
+        Full branch) WAITS for the sender to drain the backlog — the
+        exact interleaving that leaked the thread."""
+
+        def __init__(self):
+            self.release = threading.Event()
+
+        def sendall(self, data):
+            in_send.set()
+            self.release.wait(5)
+
+        def shutdown(self, how):
+            # simulate the race window: by the time the shutdown lands,
+            # the sender has drained everything and is parked in get()
+            self.release.set()
+            assert drained.wait(5), "sender never drained the backlog"
+
+    conn = _Conn.__new__(_Conn)
+    conn.sock = FakeSock()
+    conn.queue = _queue.Queue(4)
+    conn.alive = True
+    conn.dropped = 0
+
+    orig_get = conn.queue.get
+
+    def tracking_get(*a, **kw):
+        if conn.queue.empty():
+            drained.set()
+        return orig_get(*a, **kw)
+
+    conn.queue.get = tracking_get
+    frame = Frame(FrameType.DELTA, 0, encode_payload({"x": 1}))
+    # sender holds one frame inside the blocked sendall...
+    conn.queue.put_nowait(frame)
+    sender = threading.Thread(target=conn._drain, daemon=True)
+    conn._sender = sender
+    sender.start()
+    assert in_send.wait(5)
+    # ...while the queue refills to capacity: close() sees Full
+    for _ in range(4):
+        conn.queue.put_nowait(frame)
+
+    conn.close()          # Full -> shutdown (sender drains) -> poison retry
+    sender.join(5)
+    assert not sender.is_alive(), \
+        "sender thread leaked: blocked on queue.get() with no poison"

@@ -29,6 +29,7 @@ log="$OUT/soak_$ts.log"
 
 SUITES="tests/test_deviceshare_properties.py \
 tests/test_gang_properties.py \
+tests/test_incremental_solve.py \
 tests/test_lownodeload_properties.py \
 tests/test_network_topology_properties.py \
 tests/test_numa_properties.py \
