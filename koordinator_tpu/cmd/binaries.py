@@ -65,50 +65,93 @@ class ReconnectingSidecarClient:
 
     - dials lazily on first use: no boot-order constraint between
       binaries (a missing sidecar costs the call/tick, not the process);
+    - dial failures drive a circuit breaker (transport.retry): a dead
+      sidecar gets backoff+jitter-paced probes — O(log) dials over an
+      outage, not one per caller tick — and callers inside an open
+      window fail fast with ``RpcError`` instead of re-dialing;
     - ``on_connect(client)`` runs after every (re)dial — the manager's
       ``sync.bootstrap`` rides here so its watch view resumes from
       last_rv after a sidecar restart; a failed hook closes the fresh
-      client (no fd/reader-thread leak) and surfaces;
+      client (no fd/reader-thread leak), counts as a dial failure for
+      the breaker, and surfaces;
     - REMOTE errors (the peer rejecting one request over a healthy
       connection, e.g. unknown node before an upsert lands) pass
       through WITHOUT tearing the shared connection down — closing
       would kill other threads' in-flight calls and, for a watch
-      client, force a needless full resync;
+      client, force a needless full resync.  Exception: an ERROR with
+      ``resync: true`` re-runs ``on_connect`` first (the server says
+      the WATCH VIEW is stale — re-HELLO now, then let the caller's
+      next tick retry its push against the fresh view);
     - transport errors drop only the client the caller saw fail (a
       racing caller may already have reconnected).
     """
 
     def __init__(self, addr: str, on_push=None, on_connect=None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, breaker=None, retry_policy=None,
+                 faults=None):
         import threading
+
+        from koordinator_tpu.transport.retry import CircuitBreaker
 
         self.addr = addr
         self.on_push = on_push
         self.on_connect = on_connect
         self.timeout = timeout
+        self.faults = faults
+        #: pass breaker=False to disable pacing entirely (tests that
+        #: want a dial per call); None builds the shared default
+        self.breaker = (None if breaker is False
+                        else breaker if breaker is not None
+                        else CircuitBreaker(target=addr,
+                                            policy=retry_policy))
+        self.resyncs = 0
         self._client = None
         self._lock = threading.Lock()
 
     def ensure(self):
-        """Connected client, (re)dialing if needed."""
+        """Connected client, (re)dialing if needed (breaker-paced)."""
+        from koordinator_tpu import metrics
         from koordinator_tpu.transport import RpcClient
         from koordinator_tpu.transport.channel import RpcError
 
         with self._lock:
             if self._client is None or not self._client.connected:
+                if self.breaker is not None and not self.breaker.allow():
+                    metrics.dial_attempts_total.inc(
+                        labels={"outcome": "open"})
+                    raise RpcError(
+                        f"sidecar circuit open ({self.breaker.describe()})")
                 self._close_locked()
                 client = RpcClient(self.addr, on_push=self.on_push,
-                                   timeout=self.timeout)
+                                   timeout=self.timeout,
+                                   faults=self.faults)
                 try:
                     client.connect()
                 except OSError as e:
+                    if self.breaker is not None:
+                        self.breaker.record_failure()
+                    metrics.dial_attempts_total.inc(
+                        labels={"outcome": "refused"})
                     raise RpcError(f"sidecar unreachable: {e}") from e
                 if self.on_connect is not None:
                     try:
                         self.on_connect(client)
                     except BaseException:
+                        # the sidecar ACCEPTED the dial but the bootstrap
+                        # (HELLO/resync hook) failed: a reachable-but-
+                        # unhealthy peer.  Same breaker pacing, but a
+                        # distinct outcome — an operator paging on
+                        # 'refused' would investigate networking/process
+                        # liveness when the process is up fine
                         client.close()
+                        if self.breaker is not None:
+                            self.breaker.record_failure()
+                        metrics.dial_attempts_total.inc(
+                            labels={"outcome": "bootstrap_failed"})
                         raise
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                metrics.dial_attempts_total.inc(labels={"outcome": "ok"})
                 self._client = client
             return self._client
 
@@ -117,6 +160,7 @@ class ReconnectingSidecarClient:
         # is concurrency-safe (per-request waiter map), and holding the
         # lock across a call would serialize caller threads behind a
         # wedged sidecar for the full timeout each
+        from koordinator_tpu import metrics
         from koordinator_tpu.transport.channel import (
             RpcError,
             RpcRemoteError,
@@ -125,7 +169,20 @@ class ReconnectingSidecarClient:
         client = self.ensure()
         try:
             return client.call(*call_args, **call_kwargs)
-        except RpcRemoteError:
+        except RpcRemoteError as e:
+            if e.resync and self.on_connect is not None:
+                # server-directed resync: our watch view is stale (e.g.
+                # it restarted and lost the node this push named).
+                # Re-HELLO on the still-healthy connection; the failed
+                # call still surfaces (its state may be gone for real)
+                # and the caller's next tick runs against the new view.
+                self.resyncs += 1
+                metrics.sync_resyncs_total.inc()
+                try:
+                    if client.connected:
+                        self.on_connect(client)
+                except Exception:
+                    pass  # resync is best effort; reconnect path remains
             raise
         except (RpcError, OSError):
             with self._lock:
@@ -458,6 +515,12 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
     parser.add_argument("--enable-preemption", action="store_true")
     parser.add_argument("--sync-barrier-timeout", type=float, default=30.0,
                         help="app/sync_barrier.go wait budget")
+    parser.add_argument(
+        "--staleness-threshold-seconds", type=float, default=0.0,
+        help="sync-feed silence (seconds) after which rounds flip into "
+             "stale-state degraded mode: BE/batch-dim admission suspends "
+             "and solves go full-pass until a resync re-warms the feed; "
+             "0 disables the watchdog")
     parser.add_argument("--listen-socket", default="",
                         help="unix socket for the solve/state-sync RPC "
                              "services (empty = in-process only)")
@@ -530,6 +593,9 @@ def main_koord_scheduler(argv: list[str],
         cpu_manager=CPUManager(),
         device_manager=DeviceManager(),
         elector=elector,
+        staleness_threshold_sec=(args.staleness_threshold_seconds
+                                 if args.staleness_threshold_seconds > 0
+                                 else None),
     )
     server = None
     sync_service = None
@@ -695,13 +761,21 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
 
         binding = ManagerSyncBinding()
         sync = StateSyncClient(binding)
+
+        def bootstrap_watch(client):
+            # bind_client first: a detected rv gap on THIS stream can
+            # then self-heal by severing it (the next tick's ensure
+            # re-dials and lands back here to re-HELLO from last_rv)
+            sync.bind_client(client)
+            sync.bootstrap(client)
+
         # lazy like the koordlet's reporters: a manager deployed before
         # the scheduler binary must not crash at assembly — the first
         # tick's ensure_fn dials (and re-bootstraps the watch from
         # last_rv after any reconnect)
         sidecar = ReconnectingSidecarClient(
             args.scheduler_sidecar_addr, on_push=sync.on_push,
-            on_connect=sync.bootstrap)
+            on_connect=bootstrap_watch)
 
         def push_allocatable(name: str, allocatable) -> None:
             sidecar.call(

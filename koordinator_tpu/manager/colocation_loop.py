@@ -88,6 +88,28 @@ class ManagerSyncBinding:
             self.nodes.clear()
             self.records.clear()
 
+    def _merge_usage(self, view: _NodeView, entry: dict,
+                     arrs: dict) -> None:
+        """ONE copy of the usage-field merge for live node_usage deltas
+        AND the merged arrays a bootstrap snapshot replays inside
+        node_upsert — a field added to one path but not the other would
+        silently desynchronize replayed records from live ones (the
+        hp_request/hp_max_used_req lockstep edit that motivated this).
+
+        Dates the usage by the KOORDLET's report time when the doc
+        carries one: stamping apply-time would make a stale node look
+        fresh for a whole degrade window after a manager restart +
+        snapshot replay.  Explicit None check — a report_time of 0.0 is
+        a valid (infinitely stale) timestamp, not an absent one."""
+        view.usage = np.asarray(arrs["usage"], np.int32)
+        for field in ("sys_usage", "hp_usage", "hp_request",
+                      "hp_max_used_req"):
+            if field in arrs:
+                setattr(view, field, np.asarray(arrs[field], np.int32))
+        report_time = entry.get("usage_time")
+        view.usage_time = (float(report_time) if report_time is not None
+                           else self.clock())
+
     def node_upsert(self, entry: dict, arrs: dict) -> None:
         with self.lock:
             view = self.nodes.setdefault(entry["name"], _NodeView())
@@ -99,22 +121,7 @@ class ManagerSyncBinding:
             # HP.Used/System as 0 after a manager restart and
             # over-advertise batch capacity for a report interval
             if "usage" in arrs:
-                view.usage = np.asarray(arrs["usage"], np.int32)
-                # date the replayed usage by the KOORDLET's report time
-                # when the merged doc carries one (bootstrap replay after
-                # a manager restart): stamping apply-time would make a
-                # stale node look fresh for a whole degrade window.
-                # Explicit None check — a report_time of 0.0 is a valid
-                # (infinitely stale) timestamp, not an absent one
-                report_time = entry.get("usage_time")
-                view.usage_time = (float(report_time)
-                                   if report_time is not None
-                                   else self.clock())
-            for field in ("sys_usage", "hp_usage", "hp_request",
-                          "hp_max_used_req"):
-                if field in arrs:
-                    setattr(view, field,
-                            np.asarray(arrs[field], np.int32))
+                self._merge_usage(view, entry, arrs)
             # an upsert REPLACES the stored doc wholesale, wiping batch
             # dims from the scheduler's allocatable — the record's
             # diff-suppression state must not survive it, or the
@@ -127,20 +134,7 @@ class ManagerSyncBinding:
             view = self.nodes.get(entry["name"])
             if view is None:
                 return
-            view.usage = np.asarray(arrs["usage"], np.int32)
-            for field in ("sys_usage", "hp_usage", "hp_request",
-                          "hp_max_used_req"):
-                if field in arrs:
-                    setattr(view, field,
-                            np.asarray(arrs[field], np.int32))
-            # prefer the koordlet's report timestamp over apply time so
-            # the degrade clock measures collector silence, not delta
-            # latency (and survives replay after a manager restart);
-            # 0.0 is a valid (stale) timestamp, only None means absent
-            report_time = entry.get("usage_time")
-            view.usage_time = (float(report_time)
-                               if report_time is not None
-                               else self.clock())
+            self._merge_usage(view, entry, arrs)
 
     def node_alloc(self, entry: dict, arrs: dict) -> None:
         # our own patches echo back as deltas; base capacity dims

@@ -181,6 +181,7 @@ SCHEDULER = Registry("koord_scheduler")
 KOORDLET = Registry("koordlet")
 MANAGER = Registry("koord_manager")
 DESCHEDULER = Registry("koord_descheduler")
+TRANSPORT = Registry("koord_transport")
 
 # Canonical instruments (names mirror the reference's).
 scheduling_latency = SCHEDULER.histogram(
@@ -205,6 +206,25 @@ incremental_dirty_pods = SCHEDULER.gauge(
     "incremental_dirty_pods",
     "Pods fully rescored by the last incremental round (new/changed pods "
     "plus pods whose cached candidates touched a dirty node)")
+state_staleness_seconds = SCHEDULER.gauge(
+    "state_staleness_seconds",
+    "Age of the last applied sync event (delta or heartbeat) as of the "
+    "last scheduling round; drives the degraded-mode flip")
+degraded_mode = SCHEDULER.gauge(
+    "degraded_mode",
+    "1 while the scheduler is in stale-state degraded mode (BE admission "
+    "suspended, full-pass solves), else 0")
+degraded_transitions_total = SCHEDULER.counter(
+    "degraded_transitions_total",
+    "Degraded-mode flips (label: phase=enter|exit)")
+degraded_suspended_pods = SCHEDULER.gauge(
+    "degraded_suspended_pods",
+    "Pods held out of the last round because degraded mode suspends "
+    "BE/batch-dim admission")
+solve_deadline_shed_total = SCHEDULER.counter(
+    "solve_deadline_shed_total",
+    "SOLVE_REQUESTs shed because their deadline expired before the solve "
+    "could start (the caller already timed out; running it helps nobody)")
 
 be_suppress_cpu_cores = KOORDLET.gauge(
     "be_suppress_cpu_cores", "CPU cores currently allowed for BE")
@@ -228,6 +248,36 @@ colocation_push_failures_total = MANAGER.counter(
 colocation_connect_failures_total = MANAGER.counter(
     "colocation_connect_failures_total",
     "colocation-loop sidecar reconnect attempts that failed")
+
+rpc_deadline_shed_total = TRANSPORT.counter(
+    "rpc_deadline_shed_total",
+    "Requests shed at the channel layer because deadline_ms had already "
+    "expired at dispatch (label: type=frame type)")
+breaker_state = TRANSPORT.gauge(
+    "circuit_breaker_state",
+    "Dial circuit breaker state per target: 0=closed, 1=half-open, 2=open")
+breaker_transitions_total = TRANSPORT.counter(
+    "circuit_breaker_transitions_total",
+    "Breaker state transitions (labels: target, to)")
+dial_attempts_total = TRANSPORT.counter(
+    "dial_attempts_total",
+    "Reconnecting-client dial attempts (label: outcome=ok|refused|"
+    "bootstrap_failed|open — refused means the dial itself failed, "
+    "bootstrap_failed that the peer accepted but the on_connect "
+    "bootstrap did not, open that the circuit refused to dial at all)")
+faults_injected_total = TRANSPORT.counter(
+    "faults_injected_total",
+    "Injected transport faults by kind (chaos harness only; zero in "
+    "production)")
+sync_gap_resyncs_total = TRANSPORT.counter(
+    "sync_gap_resyncs_total",
+    "Watch-stream rv gaps detected by a sync client (a lost/reordered "
+    "delta): the client tears its connection down and re-HELLOs")
+sync_resyncs_total = TRANSPORT.counter(
+    "sync_resyncs_total",
+    "Server-requested resyncs honored by a reconnecting client (ERROR "
+    "frame with resync: true — e.g. a push for a node the restarted "
+    "service no longer knows)")
 
 descheduler_evictions_total = DESCHEDULER.counter(
     "pod_evictions_total", "Descheduler evictions by profile/reason")

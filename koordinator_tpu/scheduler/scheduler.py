@@ -144,6 +144,8 @@ class Scheduler:
         device_manager=None,
         elector=None,
         incremental_solve: bool = True,
+        staleness_threshold_sec: float | None = None,
+        staleness_exit_sec: float | None = None,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -305,6 +307,27 @@ class Scheduler:
         #: single-pod preemptors are chained in jitted scans of this size
         #: (one dispatch per chunk, not per pod); gangs use the host loop
         self.preempt_chunk = 256
+
+        # -- snapshot-staleness watchdog / degraded mode --
+        #: seconds the sync feed may be silent before rounds flip into
+        #: degraded mode; None disables the watchdog.  Constraint-based
+        #: packing only keeps its guarantees against fresh-or-conservative
+        #: state: a stalled delta feed means usage/allocatable (and the
+        #: manager-derived batch capacity riding them) are arbitrarily
+        #: stale, so degraded rounds (a) suspend BE/batch-dim admission —
+        #: the consumers of the stale-derived overcommit capacity — and
+        #: (b) drop the incremental candidate cache and solve full-pass
+        #: until the feed re-warms.
+        self.staleness_threshold_sec = staleness_threshold_sec
+        #: hysteresis: exit degraded only once the feed age is back under
+        #: this (default threshold/2) so a feed trickling right at the
+        #: threshold doesn't flap admission on and off
+        self.staleness_exit_sec = staleness_exit_sec
+        self.degraded = False
+        self.degraded_since: float | None = None
+        self.degraded_entries = 0
+        #: pods held out of the last round by degraded-mode suspension
+        self.last_suspended = 0
 
     # -- registration -------------------------------------------------------
 
@@ -642,17 +665,82 @@ class Scheduler:
                 self.nominations.pop(pod_name, None)
                 self._nomination_gen.pop(pod_name, None)
 
+    # -- snapshot-staleness watchdog ----------------------------------------
+
+    def note_sync_event(self) -> None:
+        """An informer/sync event was applied: the state feed is alive.
+        Called by the deltasync dispatch layer (remote watch client and
+        in-process binding drain alike)."""
+        self.snapshot.mark_sync(self.clock())
+
+    def _staleness_tick(self, now: float) -> None:
+        """Flip degraded mode on/off from the sync feed's age.  Runs at
+        round start under the round lock."""
+        threshold = self.staleness_threshold_sec
+        age = self.snapshot.staleness(now)
+        if threshold is None or age is None:
+            # watchdog disabled, or no feed has ever spoken (a scheduler
+            # warming up has nothing to be stale RELATIVE to)
+            return
+        metrics.state_staleness_seconds.set(age)
+        if not self.degraded and age > threshold:
+            self.degraded = True
+            self.degraded_since = now
+            self.degraded_entries += 1
+            # the candidate cache was built from now-untrusted deltas;
+            # degraded rounds solve full-pass and re-warm on exit
+            self._cand_cache = None
+            metrics.degraded_mode.set(1.0)
+            metrics.degraded_transitions_total.inc(
+                labels={"phase": "enter"})
+        elif self.degraded:
+            exit_thr = (self.staleness_exit_sec
+                        if self.staleness_exit_sec is not None
+                        else threshold / 2.0)
+            if age <= exit_thr:
+                self.degraded = False
+                self.degraded_since = None
+                self._cand_cache = None
+                metrics.degraded_mode.set(0.0)
+                metrics.degraded_transitions_total.inc(
+                    labels={"phase": "exit"})
+
+    def _suspended_while_degraded(self, pod: PodSpec) -> bool:
+        """Admission suspended for this pod while degraded?  BE pods and
+        any pod consuming batch/mid dims: those pools are DERIVED from
+        the (now stale) usage reports, so admitting against them is how
+        a stale scheduler overcommits real machines.  Prod pods keep
+        scheduling — their allocatable is configured, not derived.
+        Reserve-pods ride along normally (a Reservation's charge is
+        validated against allocatable at placement like any prod pod)."""
+        from koordinator_tpu.api.qos import QoSClass
+        from koordinator_tpu.api.resources import BATCH_DIMS, MID_DIMS
+
+        if pod.name.startswith(RSV_POD_PREFIX):
+            return False
+        if int(pod.qos) == int(QoSClass.BE):
+            return True
+        req = np.asarray(pod.requests)
+        return bool(any(int(req[d]) > 0 for d in (*BATCH_DIMS, *MID_DIMS)))
+
     # -- the scheduling round ----------------------------------------------
 
     def _active_pods(self) -> list[PodSpec]:
-        """PreEnqueue: skip pods of rejected gangs."""
+        """PreEnqueue: skip pods of rejected gangs; while degraded, hold
+        back BE/batch-dim pods (stale-state admission suspension)."""
         out = []
+        suspended = 0
         for pod in self.pending.values():
             if pod.gang is not None:
                 gang = self.gangs.get(pod.gang)
                 if gang is not None and gang.rejected:
                     continue
+            if self.degraded and self._suspended_while_degraded(pod):
+                suspended += 1
+                continue
             out.append(pod)
+        self.last_suspended = suspended
+        metrics.degraded_suspended_pods.set(float(suspended))
         out.sort(key=lambda p: (-p.priority, p.creation, p.name))
         return out
 
@@ -925,6 +1013,7 @@ class Scheduler:
             # replays past the barrier (sync_barrier.go semantics)
             return SchedulingResult({}, {}, 0)
         now = self.clock()
+        self._staleness_tick(now)
         result = SchedulingResult({}, {}, 0)
         self.last_result = result  # debug-API diagnosis surface
         if len(self.reservations):
@@ -991,9 +1080,11 @@ class Scheduler:
                 self.last_solver = solver
                 # incremental fast path: a gangless batch round re-scores only
                 # the delta against the persistent candidate cache; gang
-                # rounds, hinted (dense-mask) rounds and the exact greedy
-                # solver keep the one-call full path
+                # rounds, hinted (dense-mask) rounds, the exact greedy
+                # solver — and DEGRADED rounds, whose cache was built from
+                # a stalled feed — keep the one-call full path
                 use_inc = (solver == "batch" and self.incremental_solve
+                           and not self.degraded
                            and not gang_index
                            and batch.selector_mask is not None)
                 if use_inc:
@@ -1004,6 +1095,7 @@ class Scheduler:
                         self.last_solve_path = (
                             "full_gang" if gang_index
                             else "full_dense" if batch.selector_mask is None
+                            else "degraded" if self.degraded
                             else "disabled")
                         metrics.incremental_solve_total.inc(labels={
                             "path": self.last_solve_path})

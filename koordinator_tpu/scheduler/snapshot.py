@@ -96,6 +96,22 @@ class ClusterSnapshot:
         # (P, C) selector masks index them via ClusterState.node_class.
         self._class_index: dict[tuple, int] = {}
         self._class_sigs: list[tuple] = []
+        #: clock time of the last applied sync event (delta/heartbeat)
+        #: from whatever informer feeds this snapshot; None until the
+        #: feed first speaks.  The scheduler's staleness watchdog reads
+        #: the AGE of this stamp — a stalled feed means every usage- and
+        #: batch-allocatable-derived row here is untrustworthy.
+        self.last_sync_time: float | None = None
+
+    def mark_sync(self, now: float) -> None:
+        """Stamp feed liveness (monotonic under the writer's clock)."""
+        self.last_sync_time = now
+
+    def staleness(self, now: float) -> float | None:
+        """Seconds since the feed last spoke; None before first contact."""
+        if self.last_sync_time is None:
+            return None
+        return max(0.0, now - self.last_sync_time)
 
     @property
     def class_capacity(self) -> int:

@@ -18,8 +18,11 @@ from koordinator_tpu.transport.wire import (  # noqa: F401
     encode_payload,
 )
 from koordinator_tpu.transport.channel import (  # noqa: F401
+    DeadlineExpired,
     RpcClient,
+    RpcDeadlineError,
     RpcError,
+    RpcRemoteError,
     RpcServer,
 )
 from koordinator_tpu.transport.deltasync import (  # noqa: F401
@@ -27,4 +30,14 @@ from koordinator_tpu.transport.deltasync import (  # noqa: F401
     ResyncRequired,
     StateSyncClient,
     StateSyncService,
+    UnknownNodeError,
+)
+from koordinator_tpu.transport.faults import (  # noqa: F401
+    FaultConfig,
+    FaultInjector,
+)
+from koordinator_tpu.transport.retry import (  # noqa: F401
+    CircuitBreaker,
+    RetryPolicy,
+    RetrySchedule,
 )

@@ -50,6 +50,17 @@ Handler = Callable[[dict, dict[str, np.ndarray]],
 #: unavoidable anyway.
 SEND_QUEUE_DEPTH = 4096
 
+#: Inbound frames buffered per connection between the read loop and the
+#: dispatch worker.  The reader stays eager so every frame is stamped
+#: with its TRUE arrival time — a request queued behind a slow handler
+#: (a 6s solve on the same connection) must burn its deadline budget
+#: while it waits, not get a fresh one when the handler finally returns.
+#: Bounded so a fast pusher cannot balloon memory: a full inbox blocks
+#: the reader and backpressure falls back to the socket, exactly the
+#: pre-split behavior (frames past the window get stamped late, which
+#: only makes deadlines LENIENT, never shed-happy).
+RECV_QUEUE_DEPTH = 64
+
 
 class RpcError(RuntimeError):
     pass
@@ -60,13 +71,37 @@ class RpcRemoteError(RpcError):
     (schema error, unknown node, ...) but the CONNECTION is healthy.
     Callers that manage connection lifecycle must not tear down a
     shared client on it — closing would kill other threads' in-flight
-    calls on the same socket."""
+    calls on the same socket.
+
+    ``doc`` is the ERROR frame's decoded document; ``resync`` is True
+    when the server asks the client to re-HELLO (e.g. a state push for
+    a node a restarted service no longer knows — the client's watch
+    view is stale, not just this one request)."""
+
+    def __init__(self, message: str, doc: dict | None = None):
+        super().__init__(message)
+        self.doc = doc or {}
+        self.resync = bool(self.doc.get("resync", False))
 
 
-def _recv_exact(sock: socket.socket):
+class RpcDeadlineError(RpcRemoteError):
+    """The server shed the request because its ``deadline_ms`` expired
+    before the handler could run (ERROR frame with ``expired: true``)."""
+
+
+class DeadlineExpired(RuntimeError):
+    """Raised by a handler that found its request's deadline already
+    passed (``doc['__expires_at__']``) — the channel layer answers with
+    an ERROR frame carrying ``expired: true`` instead of a generic
+    handler failure."""
+
+
+def _recv_exact(sock: socket.socket, faults=None):
     def recv(n: int) -> bytes:
         buf = bytearray()
         while len(buf) < n:
+            if faults is not None:
+                faults.on_read()   # slow-drip read injection
             chunk = sock.recv(n - len(buf))
             if not chunk:
                 raise ConnectionError("peer closed")
@@ -78,12 +113,16 @@ def _recv_exact(sock: socket.socket):
 class _Conn:
     """One server-side connection: bounded outbound queue + sender thread."""
 
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket, faults=None):
         self.sock = sock
+        self.faults = faults
         self.queue: "queue.Queue[Optional[Frame]]" = queue.Queue(
             SEND_QUEUE_DEPTH)
         self.alive = True
         self.dropped = 0
+        #: reorder-fault hold slot: a push pulled out of order, emitted
+        #: after the next outbound frame (or on poison)
+        self._held: Optional[bytes] = None
         self._sender = threading.Thread(target=self._drain, daemon=True)
         self._sender.start()
 
@@ -139,23 +178,75 @@ class _Conn:
                 # connections stay half-open and `connected` never
                 # flips (r5 manager-reconnect test caught this)
                 try:
+                    if self._held is not None:
+                        self.sock.sendall(self._held)
+                        self._held = None
                     self.sock.shutdown(socket.SHUT_RDWR)
                 except OSError:
                     pass
                 return
             try:
-                self.sock.sendall(frame.encode())
+                if not self._send_one(frame):
+                    self.alive = False
+                    try:
+                        self.sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    return
             except OSError:
                 self.alive = False
                 return
 
+    def _send_one(self, frame: Frame) -> bool:
+        """Write one frame, applying any scheduled fault.  Returns False
+        when the fault severed the connection (caller shuts down)."""
+        data = frame.encode()
+        inj = self.faults
+        if inj is not None:
+            action = inj.outbound_action(is_push=frame.request_id == 0)
+            if action == "sever":
+                return False
+            if action == "truncate":
+                self.sock.sendall(data[: inj.truncate_at(len(data))])
+                return False
+            if action == "drop":
+                return True
+            if action == "delay":
+                inj.delay()
+            elif action == "duplicate":
+                self.sock.sendall(data)
+            elif action == "reorder":
+                if self._held is None:
+                    self._held = data      # emit after the NEXT frame
+                    return True
+        self.sock.sendall(data)
+        if self._held is not None:
+            held, self._held = self._held, None
+            self.sock.sendall(held)
+        return True
+
 
 class _ConnHandler(socketserver.BaseRequestHandler):
+    """Per-connection: an EAGER read loop (this thread) feeding a
+    bounded inbox consumed by one dispatch worker.  The split exists for
+    deadline honesty: handlers are sequential per connection, so a
+    request read lazily after a 6s solve would be stamped 6s late and
+    granted a fresh budget its caller already burned.  The eager reader
+    stamps true arrival; the worker keeps the sequential handler
+    semantics."""
+
     def handle(self):
+        import time as _time
+
         server: RpcServer = self.server.rpc  # type: ignore[attr-defined]
-        recv = _recv_exact(self.request)
-        conn = _Conn(self.request)
+        recv = _recv_exact(self.request, faults=server.faults)
+        conn = _Conn(self.request, faults=server.faults)
         server._on_connect(conn)
+        inbox: "queue.Queue[Optional[tuple[Frame, float]]]" = queue.Queue(
+            RECV_QUEUE_DEPTH)
+        worker = threading.Thread(
+            target=_dispatch_loop, args=(server, conn, inbox), daemon=True)
+        worker.start()
         try:
             while True:
                 try:
@@ -163,38 +254,93 @@ class _ConnHandler(socketserver.BaseRequestHandler):
                 except (ConnectionError, OSError):
                     return
                 if frame.type is FrameType.PING:
+                    # liveness probes answer at read time — a heartbeat
+                    # must not queue behind a long solve
                     conn.send(Frame(FrameType.ACK, frame.request_id,
                                     encode_payload({})))
                     continue
-                handler = server.handlers.get(frame.type)
-                if handler is None:
-                    conn.send(Frame(FrameType.ERROR, frame.request_id,
-                                    encode_payload(
-                                        {"message":
-                                         f"no handler for {frame.type}"})))
-                    continue
-                try:
-                    doc, arrays = decode_payload(frame.payload)
-                    # typed request schemas: version/shape skew between
-                    # peers fails loud here, not deep inside a handler
-                    validate_doc(frame.type, doc)
-                    out_doc, out_arrays = handler(doc, arrays)
-                    rtype = FrameType(out_doc.pop(
-                        "__type__", int(_RESPONSE_TYPE.get(
-                            frame.type, FrameType.ACK))))
-                    conn.send(Frame(rtype, frame.request_id,
-                                    encode_payload(out_doc, out_arrays)))
-                except WireSchemaError as e:
-                    conn.send(Frame(FrameType.ERROR, frame.request_id,
-                                    encode_payload(
-                                        {"message": str(e),
-                                         "schema": True})))
-                except Exception as e:  # handler bug: fail the call, not conn
-                    conn.send(Frame(FrameType.ERROR, frame.request_id,
-                                    encode_payload({"message": repr(e)})))
+                inbox.put((frame, _time.monotonic()))
         finally:
+            # poison AFTER the backlog (blocking put: the worker is
+            # draining); already-read frames still run their handlers —
+            # their side effects (state pushes) are the peer's committed
+            # intent — but responses to a gone peer drop in conn.send
+            inbox.put(None)
+            # bounded join: the worker may sit in a long handler; the
+            # connection teardown must not wait it out (the worker exits
+            # on the poison right after, sends going to a dead conn)
+            worker.join(timeout=5.0)
             server._on_disconnect(conn)
             conn.close()
+
+
+def _dispatch_loop(server: "RpcServer", conn: _Conn, inbox) -> None:
+    while True:
+        item = inbox.get()
+        if item is None:
+            return
+        frame, recv_time = item
+        _dispatch_one(server, conn, frame, recv_time)
+
+
+def _dispatch_one(server: "RpcServer", conn: _Conn, frame: Frame,
+                  recv_time: float) -> None:
+    import time as _time
+
+    from koordinator_tpu import metrics
+
+    handler = server.handlers.get(frame.type)
+    if handler is None:
+        conn.send(Frame(FrameType.ERROR, frame.request_id,
+                        encode_payload(
+                            {"message": f"no handler for {frame.type}"})))
+        return
+    try:
+        doc, arrays = decode_payload(frame.payload)
+        # typed request schemas: version/shape skew between
+        # peers fails loud here, not deep inside a handler
+        validate_doc(frame.type, doc)
+        # deadline propagation: the caller's remaining budget rides the
+        # doc; the budget clock starts at frame ARRIVAL (the eager read
+        # loop's stamp — no cross-host clock sync needed).  Expired
+        # already -> shed without dispatching; otherwise the absolute
+        # expiry is handed to the handler so long waits INSIDE it (the
+        # scheduler round lock) can shed late too (DeadlineExpired).
+        deadline_ms = doc.pop("deadline_ms", None)
+        if deadline_ms is not None:
+            expires = recv_time + float(deadline_ms) / 1000.0
+            if _time.monotonic() >= expires:
+                metrics.rpc_deadline_shed_total.inc(
+                    labels={"type": frame.type.name})
+                conn.send(Frame(
+                    FrameType.ERROR, frame.request_id,
+                    encode_payload(
+                        {"message": "deadline expired before "
+                         "dispatch", "expired": True})))
+                return
+            doc["__expires_at__"] = expires
+        out_doc, out_arrays = handler(doc, arrays)
+        rtype = FrameType(out_doc.pop(
+            "__type__", int(_RESPONSE_TYPE.get(
+                frame.type, FrameType.ACK))))
+        conn.send(Frame(rtype, frame.request_id,
+                        encode_payload(out_doc, out_arrays)))
+    except DeadlineExpired as e:
+        conn.send(Frame(FrameType.ERROR, frame.request_id,
+                        encode_payload(
+                            {"message": str(e), "expired": True})))
+    except WireSchemaError as e:
+        err_doc = {"message": str(e), "schema": True}
+        if getattr(e, "resync", False):
+            # the client's whole watch view is stale (e.g. a push for a
+            # node this service incarnation never learned) — tell it to
+            # re-HELLO, not just fail the one call
+            err_doc["resync"] = True
+        conn.send(Frame(FrameType.ERROR, frame.request_id,
+                        encode_payload(err_doc)))
+    except Exception as e:  # handler bug: fail the call, not conn
+        conn.send(Frame(FrameType.ERROR, frame.request_id,
+                        encode_payload({"message": repr(e)})))
 
 
 _RESPONSE_TYPE = {
@@ -230,17 +376,22 @@ def _parse_addr(addr: str):
 
 
 class RpcServer:
-    """Framed RPC server; one receive thread + one send thread per
-    connection.  ``path`` is a unix-socket path (same-host peers) or
-    ``tcp://host:port`` (cross-host control plane — the reference's
-    gRPC boundary listens on TCP the same way)."""
+    """Framed RPC server; per connection, one eager receive thread, one
+    sequential dispatch worker, and one send thread.  ``path`` is a
+    unix-socket path (same-host peers) or ``tcp://host:port``
+    (cross-host control plane — the reference's gRPC boundary listens
+    on TCP the same way)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, faults=None):
         self.path = path
+        #: optional faults.FaultInjector — chaos harness only; None in
+        #: production (one attribute check per frame)
+        self.faults = faults
         self.kind, target = _parse_addr(path)
         self.handlers: dict[FrameType, Handler] = {}
         self._conns: list[_Conn] = []
         self._conn_lock = threading.Lock()
+        self._stopped = False
         if self.kind == "unix":
             if os.path.exists(target):
                 os.unlink(target)
@@ -262,11 +413,22 @@ class RpcServer:
         self.handlers[ftype] = handler
 
     def start(self) -> None:
+        # tight poll interval: shutdown() blocks until serve_forever's
+        # select loop notices, and the 0.5s stdlib default turns every
+        # stop() — a restart, a failover, a test teardown — into a
+        # half-second stall
         self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True)
+            target=lambda: self._server.serve_forever(poll_interval=0.05),
+            daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
+        # flag first: a connection whose handler thread registers AFTER
+        # the conns snapshot below would otherwise never be closed and
+        # its peer would hang on a half-dead socket (race exposed by the
+        # tight poll interval — stop() used to be slow enough to lose it)
+        with self._conn_lock:
+            self._stopped = True
         self._server.shutdown()
         self._server.server_close()
         with self._conn_lock:
@@ -280,6 +442,11 @@ class RpcServer:
 
     def _on_connect(self, conn: _Conn) -> None:
         with self._conn_lock:
+            if self._stopped:
+                # lost the race with stop(): sever immediately so the
+                # peer sees EOF instead of a silently dead server
+                conn.close()
+                return
             self._conns.append(conn)
 
     def _on_disconnect(self, conn: _Conn) -> None:
@@ -307,10 +474,12 @@ class RpcClient:
     """Blocking request/response client. Unsolicited (request_id 0) frames
     are delivered to ``on_push`` — the watch stream."""
 
-    def __init__(self, path: str, on_push=None, timeout: float = 10.0):
+    def __init__(self, path: str, on_push=None, timeout: float = 10.0,
+                 faults=None):
         self.path = path
         self.on_push = on_push
         self.timeout = timeout
+        self.faults = faults
         self._sock: Optional[socket.socket] = None
         self._send_lock = threading.Lock()
         self._pending: dict[int, "_Waiter"] = {}
@@ -321,6 +490,8 @@ class RpcClient:
         self.push_errors = 0
 
     def connect(self) -> None:
+        if self.faults is not None:
+            self.faults.on_connect()
         kind, target = _parse_addr(self.path)
         if kind == "unix":
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -347,10 +518,19 @@ class RpcClient:
                 pass
             self._sock.close()
             self._sock = None
+        # join the reader (bounded) so long soaks with repeated
+        # reconnects don't accumulate daemon threads; skip when close()
+        # runs ON the reader (a push handler tearing the stream down)
+        reader = self._reader
+        if reader is not None and reader is not threading.current_thread():
+            reader.join(timeout=2.0)
+            if not reader.is_alive():
+                self._reader = None
 
     def _read_loop(self) -> None:
-        assert self._sock is not None
-        recv = _recv_exact(self._sock)
+        sock = self._sock
+        assert sock is not None
+        recv = _recv_exact(sock, faults=self.faults)
         try:
             while True:
                 frame = read_frame(recv)
@@ -379,10 +559,22 @@ class RpcClient:
                 w.event.set()  # fail fast with frame=None
 
     def call(self, ftype: FrameType, doc: dict,
-             arrays: dict[str, np.ndarray] | None = None
+             arrays: dict[str, np.ndarray] | None = None,
+             deadline_ms: float | None = None,
              ) -> tuple[FrameType, dict, dict[str, np.ndarray]]:
-        if self._sock is None:
+        sock = self._sock
+        if sock is None:
             raise RpcError("not connected")
+        if not self.connected:
+            # the reader thread died (peer EOF / transport error): fail
+            # fast instead of sending into a half-closed socket and
+            # burning the full timeout waiting for a response that can
+            # never correlate
+            raise RpcError("not connected (stream closed)")
+        if deadline_ms is not None:
+            # per-call deadline rides the frame doc so the server can
+            # shed the request once nobody is waiting for it
+            doc = dict(doc, deadline_ms=float(deadline_ms))
         waiter = _Waiter()
         with self._pending_lock:
             req_id = self._next_id
@@ -391,21 +583,49 @@ class RpcClient:
         frame = Frame(ftype, req_id, encode_payload(doc, arrays))
         try:
             with self._send_lock:
-                self._sock.sendall(frame.encode())
+                data = frame.encode()
+                cut = (self.faults.outbound_cut(len(data))
+                       if self.faults is not None else None)
+                if cut is not None:
+                    # injected mid-write truncation: the peer's framing
+                    # is desynced — sever so both sides fail loud
+                    sock.sendall(data[:cut])
+                    raise OSError("fault injection: truncated write")
+                sock.sendall(data)
         except OSError as e:
             self.connected = False
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             with self._pending_lock:
                 self._pending.pop(req_id, None)
             raise RpcError(f"connection lost: {e}") from e
-        if not waiter.event.wait(self.timeout):
+        wait = self.timeout
+        if deadline_ms is not None:
+            wait = min(wait, float(deadline_ms) / 1000.0)
+        if not waiter.event.wait(wait):
             with self._pending_lock:
                 self._pending.pop(req_id, None)
+            if deadline_ms is not None and wait < self.timeout:
+                # the CALLER'S budget ran out, not the transport: the
+                # connection is healthy and the server may still answer
+                # (the stale response is dropped by the waiter map).
+                # RpcDeadlineError subclasses RpcRemoteError so shared-
+                # connection owners (ReconnectingSidecarClient) pass it
+                # through instead of tearing the client down and killing
+                # other threads' in-flight calls.
+                raise RpcDeadlineError(
+                    f"deadline ({deadline_ms:g}ms) expired awaiting "
+                    f"response")
             raise RpcError("rpc timeout")
         if waiter.frame is None:
             raise RpcError("connection lost")
         rdoc, rarrays = decode_payload(waiter.frame.payload)
         if waiter.frame.type is FrameType.ERROR:
-            raise RpcRemoteError(rdoc.get("message", "remote error"))
+            cls = (RpcDeadlineError if rdoc.get("expired")
+                   else RpcRemoteError)
+            raise cls(rdoc.get("message", "remote error"), doc=rdoc)
         return waiter.frame.type, rdoc, rarrays
 
 

@@ -47,6 +47,17 @@ class ResyncRequired(Exception):
     """Client fell behind the retained window (HTTP 410 Gone analog)."""
 
 
+class UnknownNodeError(wire.WireSchemaError):
+    """A merge-style event (node_usage / node_allocatable / node_devices)
+    named a node this service doesn't know.  For an in-process caller
+    that is a peer bug (plain schema error); for a WIRE client it
+    usually means the client's watch view predates a service restart
+    that lost the node — the ERROR frame carries ``resync: true`` so the
+    client re-HELLOs instead of failing the same push forever."""
+
+    resync = True
+
+
 class DeltaLog:
     """Bounded ordered log of (rv, event, arrays)."""
 
@@ -304,7 +315,7 @@ class StateSyncService:
         with self._lock:
             entry = self.nodes.get(name)
             if entry is None:
-                raise wire.WireSchemaError(
+                raise UnknownNodeError(
                     f"node_usage for unknown node {name!r}")
             entry["arrays"] = dict(entry["arrays"], **arrays)
             if report_time is not None:
@@ -333,7 +344,7 @@ class StateSyncService:
         with self._lock:
             entry = self.nodes.get(name)
             if entry is None:
-                raise wire.WireSchemaError(
+                raise UnknownNodeError(
                     f"node_allocatable for unknown node {name!r}")
             entry["arrays"] = dict(entry["arrays"], **arrays)
             rv = self._commit_locked(
@@ -352,7 +363,7 @@ class StateSyncService:
         with self._lock:
             entry = self.nodes.get(name)
             if entry is None:
-                raise wire.WireSchemaError(
+                raise UnknownNodeError(
                     f"node_devices for unknown node {name!r}")
             if entry["doc"].get("devices") == devices:
                 # unchanged heartbeat (the koordlet sink re-pushes every
@@ -656,6 +667,23 @@ class StateSyncClient:
         self._buffer: list[tuple[dict, dict]] = []
         self.applied = 0
         self.skipped = 0
+        #: rv-gap accounting: a DELTA push arriving with rv > self.rv + 1
+        #: means an event was LOST on the wire (dropped/reordered frame).
+        #: The rv guard makes replays idempotent but cannot conjure a
+        #: missing event back — the only repair is a re-HELLO.
+        self.gaps = 0
+        self.needs_resync = False
+        #: optional back-reference to the RpcClient this sync rides
+        #: (bind_client): a detected gap severs it so the owner's
+        #: reconnect machinery (ReconnectingSidecarClient.ensure ->
+        #: on_connect=bootstrap) performs the re-HELLO
+        self._client = None
+
+    def bind_client(self, client) -> None:
+        """Give the sync a handle to its transport so gap detection can
+        self-heal by severing the stream (close() is reader-thread safe;
+        the owner's next ensure() re-dials and re-bootstraps)."""
+        self._client = client
 
     def bootstrap(self, client) -> int:
         """HELLO + apply. Pushes that race the HELLO response on the wire
@@ -675,13 +703,19 @@ class StateSyncClient:
                     self.instance = doc["instance"]
                 n = 0
                 if ftype is not FrameType.ACK:
-                    n = self._apply(doc, arrays)
+                    n = self._apply(doc, arrays, from_bootstrap=True)
                 # drain and exit buffering atomically — a push landing
                 # after this block goes straight to _apply
                 for bdoc, barrays in self._buffer:
-                    n += self._apply(bdoc, barrays)
+                    n += self._apply(bdoc, barrays, from_bootstrap=True)
                 self._bootstrapping = False
                 self._buffer = []
+                self.needs_resync = False
+                # even a bare ACK is evidence the feed is alive and we
+                # are caught up — the staleness watchdog counts it
+                mark = getattr(self.binding, "note_sync_event", None)
+                if mark is not None:
+                    mark()
                 return n
         finally:
             with self._lock:  # exception path (call failed): stop buffering
@@ -700,8 +734,10 @@ class StateSyncClient:
                 return
         self._apply(doc, arrays)
 
-    def _apply(self, doc: dict, arrays: dict[str, np.ndarray]) -> int:
+    def _apply(self, doc: dict, arrays: dict[str, np.ndarray],
+               from_bootstrap: bool = False) -> int:
         n = 0
+        gap = False
         with self._lock:
             if doc.get("snapshot"):
                 self.binding.reset()
@@ -712,11 +748,33 @@ class StateSyncClient:
                 if not doc.get("snapshot") and rv <= self.rv:
                     self.skipped += 1  # replay overlap: idempotent skip
                     continue
+                if (not doc.get("snapshot") and not from_bootstrap
+                        and self.rv >= 0 and rv > high + 1):
+                    # a WATCH push skipped ahead: every committed rv is
+                    # broadcast in order, so a hole means an event was
+                    # lost on the wire (drop/reorder).  Apply what we
+                    # have (fresher than nothing) but flag the stream
+                    # for resync — the rv guard would otherwise silently
+                    # drop the missing event forever.  Bootstrap applies
+                    # are exempt (the HELLO reply + buffered-push replay
+                    # is the server's own contiguous answer).
+                    gap = True
                 self._dispatch(entry, _unpack_event_arrays(entry, arrays))
                 high = max(high, rv)
                 n += 1
             self.rv = max(high, int(doc.get("rv", high)))
             self.applied += n
+            if gap:
+                from koordinator_tpu import metrics
+
+                self.gaps += 1
+                self.needs_resync = True
+                metrics.sync_gap_resyncs_total.inc()
+        if gap and self._client is not None:
+            # sever the stream (outside our lock; close is idempotent
+            # and safe on the reader thread): the owner's reconnect path
+            # re-dials and re-HELLOs from last_rv, replaying the hole
+            self._client.close()
         return n
 
     def _dispatch(self, entry: dict, arrs: dict[str, np.ndarray]) -> None:
@@ -746,6 +804,11 @@ def _dispatch_event(binding, entry: dict,
         binding.reservation_upsert(entry, arrs)
     elif kind == RSV_REMOVE:
         binding.reservation_remove(entry["name"])
+    # staleness watchdog feed: every applied event — remote watch OR
+    # in-process drain — is evidence the state feed is alive
+    mark = getattr(binding, "note_sync_event", None)
+    if mark is not None:
+        mark()
 
 
 class SchedulerBinding:
@@ -758,6 +821,12 @@ class SchedulerBinding:
 
     def __init__(self, scheduler):
         self.scheduler = scheduler
+
+    def note_sync_event(self) -> None:
+        """Feed the scheduler's snapshot-staleness watchdog: called by
+        the dispatch layer for every applied sync event (delta or
+        bootstrap heartbeat)."""
+        self.scheduler.note_sync_event()
 
     def reset(self) -> None:
         """Snapshot resync = restart semantics: release EVERYTHING (bound

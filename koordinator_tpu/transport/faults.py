@@ -1,0 +1,154 @@
+"""Deterministic, seeded fault injection at the frame/socket seams.
+
+The transport's failure modes in production — sidecars crashing mid-
+write, sockets black-holing, watch feeds stalling — are all reproduced
+here as *scheduled* faults: a :class:`FaultInjector` owns one seeded
+``random.Random`` and every fault decision is a draw from it, so a
+failing chaos run replays exactly from its seed (tools/soak.sh prints
+the seed on failure).
+
+Injection points (all off by default — a ``None`` injector costs one
+attribute check):
+
+- ``RpcClient.connect``      -> :meth:`FaultInjector.on_connect`
+  (connect refusal)
+- ``RpcClient.call`` send    -> :meth:`FaultInjector.outbound_cut`
+  (mid-write truncation; the socket is severed after the partial write)
+- reader ``recv`` loops      -> :meth:`FaultInjector.on_read`
+  (slow-drip reads)
+- server ``_Conn`` sends     -> :meth:`FaultInjector.outbound_action`
+  (connection sever, mid-write truncation on any frame; drop / delay /
+  duplication / reordering on PUSH frames only — responses stay
+  correlated, matching the issue's "frame delay/duplication/reordering
+  on pushes")
+
+``heal()`` flips the injector off atomically — the chaos soak's
+"faults heal, system reconverges" phase.  ``injected`` counts every
+fault actually fired, by kind, so tests can assert the schedule was
+exercised at all.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import random
+import threading
+import time
+
+
+@dataclasses.dataclass
+class FaultConfig:
+    """Per-decision probabilities.  All default 0.0 (= never)."""
+
+    #: client connect() raises ConnectionRefusedError
+    connect_refuse_p: float = 0.0
+    #: outbound frame (any): sever the connection before writing
+    send_sever_p: float = 0.0
+    #: outbound frame (any): write a partial prefix, then sever —
+    #: the peer's framing desyncs and its read loop dies
+    send_truncate_p: float = 0.0
+    #: push frame: silently drop (the black-holed watch event — the
+    #: client's rv-gap detection is what recovers from this)
+    push_drop_p: float = 0.0
+    #: push frame: delay before writing
+    push_delay_p: float = 0.0
+    push_delay_ms: float = 10.0
+    #: push frame: write twice (the client's rv guard must dedup)
+    push_duplicate_p: float = 0.0
+    #: push frame: hold, and emit after the NEXT outbound frame
+    #: (rv-order inversion on the wire)
+    push_reorder_p: float = 0.0
+    #: each recv() chunk: sleep first (slow-drip read)
+    read_drip_p: float = 0.0
+    read_drip_ms: float = 2.0
+
+
+class FaultInjector:
+    """Seeded fault scheduler shared by any number of connections.
+
+    Thread-safe: the rng is guarded so concurrent sender/reader threads
+    draw a single deterministic sequence (the *schedule* is reproducible
+    per seed; which thread consumes which draw still depends on timing,
+    which is exactly the nondeterminism chaos testing wants to shake)."""
+
+    def __init__(self, seed: int = 0, config: FaultConfig | None = None,
+                 sleep=time.sleep):
+        self.seed = seed
+        self.config = config or FaultConfig()
+        self.enabled = True
+        self.injected: collections.Counter = collections.Counter()
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._sleep = sleep
+
+    def heal(self) -> None:
+        """Stop injecting (the soak's recovery phase).  Already-held
+        reordered frames still flush through their connections."""
+        self.enabled = False
+
+    def _hit(self, p: float) -> bool:
+        if not self.enabled or p <= 0.0:
+            return False
+        with self._lock:
+            return self._rng.random() < p
+
+    def _count(self, kind: str) -> None:
+        from koordinator_tpu import metrics
+
+        self.injected[kind] += 1
+        metrics.faults_injected_total.inc(labels={"kind": kind})
+
+    # -- client seams --------------------------------------------------------
+
+    def on_connect(self) -> None:
+        if self._hit(self.config.connect_refuse_p):
+            self._count("connect_refuse")
+            raise ConnectionRefusedError("fault injection: connect refused")
+
+    def outbound_cut(self, nbytes: int) -> int | None:
+        """Byte count to truncate a client write at, or None (no fault)."""
+        if self._hit(self.config.send_truncate_p):
+            self._count("client_truncate")
+            with self._lock:
+                return self._rng.randrange(1, max(nbytes, 2))
+        return None
+
+    def on_read(self) -> None:
+        if self._hit(self.config.read_drip_p):
+            self._count("read_drip")
+            self._sleep(self.config.read_drip_ms / 1000.0)
+
+    # -- server _Conn seam ---------------------------------------------------
+
+    def outbound_action(self, is_push: bool) -> str | None:
+        """One of None / "sever" / "truncate" / "drop" / "delay" /
+        "duplicate" / "reorder" for a server-side outbound frame.
+        Evaluated in severity order; at most one fault per frame."""
+        if self._hit(self.config.send_sever_p):
+            self._count("sever")
+            return "sever"
+        if self._hit(self.config.send_truncate_p):
+            self._count("truncate")
+            return "truncate"
+        if is_push:
+            if self._hit(self.config.push_drop_p):
+                self._count("push_drop")
+                return "drop"
+            if self._hit(self.config.push_delay_p):
+                self._count("push_delay")
+                return "delay"
+            if self._hit(self.config.push_duplicate_p):
+                self._count("push_duplicate")
+                return "duplicate"
+            if self._hit(self.config.push_reorder_p):
+                self._count("push_reorder")
+                return "reorder"
+        return None
+
+    def truncate_at(self, nbytes: int) -> int:
+        with self._lock:
+            return self._rng.randrange(1, max(nbytes, 2))
+
+    def delay(self) -> None:
+        self._sleep(self.config.push_delay_ms / 1000.0)

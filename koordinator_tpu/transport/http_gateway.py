@@ -116,8 +116,12 @@ class HttpGateway:
         return self._server.server_address[1]
 
     def start(self) -> None:
+        # tight poll interval, matching channel.RpcServer: shutdown()
+        # blocks until serve_forever's select loop notices, and the 0.5s
+        # stdlib default stalls every gateway stop/restart
         self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True)
+            target=lambda: self._server.serve_forever(poll_interval=0.05),
+            daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
@@ -207,7 +211,13 @@ class HttpGateway:
             # the handler owns schema validation (incl. kind/name)
             out, _ = self.state_sync._handle_state_push(doc, arrays)
         except WireSchemaError as e:
-            return req._reply(400, {"error": str(e)})
+            body = {"error": str(e)}
+            if getattr(e, "resync", False):
+                # same resync hint the framed ERROR carries: the
+                # pusher's view of this service is stale, not just this
+                # one request (docs/robustness.md)
+                body["resync"] = True
+            return req._reply(400, body)
         req._reply(200, out)
 
     def _solve(self, req) -> None:

@@ -21,16 +21,37 @@ from koordinator_tpu.transport.wire import FrameType
 
 
 class SolveService:
-    """Server side: schedule_round over the wire."""
+    """Server side: schedule_round over the wire.
+
+    Honors per-call deadlines: solve rounds serialize on the scheduler
+    lock, so a request can spend its whole budget just WAITING — once it
+    has, the caller's RpcClient has already timed out and running the
+    solve computes assignments nobody will read (worse: it burns the
+    round lock the NEXT caller is queued behind).  The expiry check runs
+    after the lock is acquired, which is exactly where the time went."""
 
     def __init__(self, scheduler):
         self.scheduler = scheduler
+        self.sheds = 0
 
     def attach(self, server) -> None:
         server.register(FrameType.SOLVE_REQUEST, self._handle)
 
     def _handle(self, doc: dict, arrays):
-        result = self.scheduler.schedule_round()
+        import time
+
+        from koordinator_tpu import metrics
+        from koordinator_tpu.transport.channel import DeadlineExpired
+
+        expires = doc.get("__expires_at__")
+        with self.scheduler.lock:
+            if expires is not None and time.monotonic() >= expires:
+                self.sheds += 1
+                metrics.solve_deadline_shed_total.inc()
+                raise DeadlineExpired(
+                    "solve deadline expired while waiting for the round "
+                    "lock; request shed without solving")
+            result = self.scheduler.schedule_round()
         return {
             "assignments": dict(result.assignments),
             "failures": {name: diag.message()
@@ -41,9 +62,12 @@ class SolveService:
         }, None
 
 
-def solve_remote(client) -> dict:
-    """Client side: one scheduling round on the remote solver."""
-    _, doc, _ = client.call(FrameType.SOLVE_REQUEST, {})
+def solve_remote(client, deadline_ms: float | None = None) -> dict:
+    """Client side: one scheduling round on the remote solver.
+    ``deadline_ms`` bounds the wait AND lets the server shed the round
+    if it cannot start before the budget is gone."""
+    _, doc, _ = client.call(FrameType.SOLVE_REQUEST, {},
+                            deadline_ms=deadline_ms)
     return doc
 
 

@@ -1084,6 +1084,8 @@ def test_conn_close_with_full_queue_does_not_leak_sender_thread():
 
     conn = _Conn.__new__(_Conn)
     conn.sock = FakeSock()
+    conn.faults = None
+    conn._held = None
     conn.queue = _queue.Queue(4)
     conn.alive = True
     conn.dropped = 0

@@ -15,6 +15,15 @@
 #         SOAK_BASE0   (default 1000) first window's seed base
 #         SOAK_STRIDE  (default 1000) distance between window bases
 #         SOAK_OUT     (default soak_results) output directory
+#         SOAK_CHAOS   (default 0)    1 = also sweep the chaos
+#                                     fault-injection suite (tests/
+#                                     test_chaos.py, `chaos` marker)
+#                                     across the same seed windows via
+#                                     KOORD_CHAOS_SEED_BASE/_COUNT; a
+#                                     failing window prints its seed
+#                                     base so the exact fault schedule
+#                                     replays with
+#                                     KOORD_CHAOS_SEED_BASE=<base>
 set -u
 cd "$(dirname "$0")/.."
 
@@ -23,6 +32,7 @@ COUNT=${SOAK_COUNT:-0}
 BASE0=${SOAK_BASE0:-1000}
 STRIDE=${SOAK_STRIDE:-1000}
 OUT=${SOAK_OUT:-soak_results}
+CHAOS=${SOAK_CHAOS:-0}
 mkdir -p "$OUT"
 ts=$(date +%Y%m%d_%H%M%S)
 log="$OUT/soak_$ts.log"
@@ -64,6 +74,31 @@ for ((w = 0; w < WINDOWS; w++)); do
     if [ "${f:-0}" -gt 0 ]; then
         failures="$failures;$(grep "^FAILED" "$log" | sort -u \
             | tr '\n' ';')"
+    fi
+
+    if [ "$CHAOS" = "1" ]; then
+        echo "== chaos window $((w + 1))/$WINDOWS seed base $base" \
+            | tee -a "$log"
+        KOORD_CHAOS_SEED_BASE=$base KOORD_CHAOS_SEED_COUNT=$COUNT \
+            python -m pytest tests/test_chaos.py -m chaos -q --tb=line \
+            >> "$log" 2>&1
+        crc=$?
+        cp=$(tail -40 "$log" | grep -oE "[0-9]+ passed" | tail -1 \
+            | grep -oE "[0-9]+")
+        cf=$(tail -40 "$log" | grep -oE "[0-9]+ failed" | tail -1 \
+            | grep -oE "[0-9]+")
+        total_passed=$((total_passed + ${cp:-0}))
+        if [ "$crc" -ne 0 ]; then
+            total_failed=$((total_failed + ${cf:-1}))
+            # the seed base IS the replay handle: rerun the exact fault
+            # schedule with KOORD_CHAOS_SEED_BASE=<base>
+            echo "CHAOS FAILURE at seed base $base — replay with" \
+                "KOORD_CHAOS_SEED_BASE=$base python -m pytest" \
+                "tests/test_chaos.py -m chaos" | tee -a "$log"
+            failures="$failures;chaos seed base=$base rc=$crc:"
+            failures="$failures $(grep '^FAILED' "$log" | sort -u \
+                | tr '\n' ';')"
+        fi
     fi
 done
 
