@@ -493,7 +493,8 @@ def main_koordlet(argv: list[str], device_report_fn=None,
         hook_dispatcher = Dispatcher()
         hook_dispatcher.register(
             RegistryHookServer(daemon.hook_registry), list(HookType))
-        daemon.hook_server = RpcServer(args.runtime_hook_server_addr)
+        daemon.hook_server = RpcServer(args.runtime_hook_server_addr,
+                                       service="koordlet")
         HookService(hook_dispatcher).attach(daemon.hook_server)
         daemon.hook_server.start()
     return Assembled(name="koordlet", args=args, component=daemon)
@@ -535,6 +536,12 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
              "(LoadAwareScheduling, NodeResourcesFitPlus, "
              "ScarceResourceAvoidance, Coscheduling) — the reference's "
              "versioned component config; defaults apply where unset")
+    parser.add_argument(
+        "--trace-pods", action="store_true",
+        help="open a root trace span for EVERY enqueued pod (pods whose "
+             "submitter propagated a trace context are always traced); "
+             "spans land in the in-process ring (/debug/trace/<pod>) "
+             "and any KOORD_TRACE_JSONL exporter")
     return parser
 
 
@@ -596,6 +603,7 @@ def main_koord_scheduler(argv: list[str],
         staleness_threshold_sec=(args.staleness_threshold_seconds
                                  if args.staleness_threshold_seconds > 0
                                  else None),
+        trace_pods=args.trace_pods,
     )
     server = None
     sync_service = None
@@ -627,7 +635,7 @@ def main_koord_scheduler(argv: list[str],
         from koordinator_tpu.transport import RpcServer
         from koordinator_tpu.transport.services import SolveService
 
-        server = RpcServer(args.listen_socket)
+        server = RpcServer(args.listen_socket, service="scheduler")
         SolveService(scheduler).attach(server)
         sync_service.attach(server)
         LeaseService(store=shared_lease_store).attach(server)
@@ -668,6 +676,11 @@ def build_manager_parser() -> argparse.ArgumentParser:
              "noderesource reconcile's batch/mid allocatable back as "
              "node_allocatable events (the §3.2 colocation loop's "
              "manager leg in wire form)")
+    parser.add_argument(
+        "--http-port", type=int, default=None,
+        help="serve the HTTP/JSON gateway (/healthz, /metrics over all "
+             "component registries) — the manager's scrape surface; "
+             "omit to disable")
     return parser
 
 
@@ -796,8 +809,15 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
 
         component.stop = stop
 
+    gateway = None
+    if args.http_port is not None:
+        from koordinator_tpu.transport.http_gateway import HttpGateway
+
+        gateway = HttpGateway(port=args.http_port)
+        gateway.start()
     return Assembled(name="koord-manager", args=args, component=component,
-                     elector=build_elector(args, lease_store))
+                     elector=build_elector(args, lease_store),
+                     gateway=gateway)
 
 
 # ---- koord-descheduler -----------------------------------------------------
@@ -987,7 +1007,8 @@ def main_koord_runtime_proxy(argv: list[str],
         from koordinator_tpu.transport import RpcServer
         from koordinator_tpu.transport.services import HookService
 
-        server = RpcServer(args.hook_server_socket)
+        server = RpcServer(args.hook_server_socket,
+                           service="runtime-proxy")
         HookService(dispatcher).attach(server)
         server.start()
     return Assembled(name="koord-runtime-proxy", args=args, component=proxy,

@@ -36,24 +36,51 @@ class Reconciler:
         #: last applied (schemata, pids) per pod — keeps quiet passes
         #: write-free for resctrl too (the executor cache analog)
         self._resctrl_applied: dict[str, tuple] = {}
+        #: pod uid -> trace annotation already joined: the reconcile
+        #: span marks the pod's FIRST reconcile under a given trace
+        #: (the enqueue-to-cgroup endpoint), not every periodic tick of
+        #: the pod's lifetime — unbounded re-spans would churn the
+        #: debug ring and grow a JSONL export forever
+        self._trace_joined: dict[str, str] = {}
 
     def reconcile_once(self) -> int:
         """Re-apply pod + container rules from current state; returns the
-        number of kernel writes actually performed."""
+        number of kernel writes actually performed.
+
+        A pod carrying a trace-context annotation (stamped by the
+        scheduler at bind and carried onto the pod object by the
+        deployment shell) reconciles inside a ``koordlet.reconcile_pod``
+        span joined to that trace — the last hop of the pod's
+        enqueue-to-cgroup timeline."""
+        from koordinator_tpu import tracing
+
         writes = 0
         live: set[str] = set()
+        seen_uids: set[str] = set()
         for pod in self.states.get_all_pods():
             if not pod.is_running:
                 continue
-            pod_ctx = PodContext.from_pod(pod, self.cfg)
-            self.registry.run(Stage.PRE_RUN_POD_SANDBOX, pod_ctx)
-            self.registry.run(Stage.PRE_UPDATE_CONTAINER, pod_ctx)
-            writes += pod_ctx.apply(self.executor)
-            self._reconcile_resctrl(pod, pod_ctx, live)
-            for container in pod.containers:
-                ctx = ContainerContext.from_container(pod, container, self.cfg)
-                self.registry.run(Stage.PRE_CREATE_CONTAINER, ctx)
-                writes += ctx.apply(self.executor)
+            seen_uids.add(pod.uid)
+            annotation = (pod.annotations or {}).get(
+                tracing.TRACE_ANNOTATION)
+            trace_ctx = tracing.TraceContext.from_annotation(annotation)
+            if (trace_ctx is None
+                    or self._trace_joined.get(pod.uid) == annotation):
+                writes += self._reconcile_pod(pod, live)
+                continue
+            self._trace_joined[pod.uid] = annotation
+            with tracing.TRACER.span(
+                    "koordlet.reconcile_pod", service="koordlet",
+                    parent=trace_ctx,
+                    attributes={"pod": pod.name, "uid": pod.uid}) as sp:
+                pod_writes = self._reconcile_pod(pod, live)
+                sp.set_attribute("writes", pod_writes)
+            writes += pod_writes
+        # joined-trace registry follows pod lifetime (a reused uid with
+        # a NEW trace annotation re-joins)
+        for uid in list(self._trace_joined):
+            if uid not in seen_uids:
+                del self._trace_joined[uid]
         if self.resctrl_updater is not None and getattr(
                 self.states, "pods_synced", True):
             # RemovePodResctrlResources: enumerate on-disk koord-pod-*
@@ -73,6 +100,21 @@ class Reconciler:
                 if uid not in live:
                     self.resctrl_updater.remove_group(uid)
                     self._resctrl_applied.pop(uid, None)
+        return writes
+
+    def _reconcile_pod(self, pod, live: set[str]) -> int:
+        """One pod's hook re-application (the loop body of
+        reconcile_once); returns this pod's kernel writes."""
+        writes = 0
+        pod_ctx = PodContext.from_pod(pod, self.cfg)
+        self.registry.run(Stage.PRE_RUN_POD_SANDBOX, pod_ctx)
+        self.registry.run(Stage.PRE_UPDATE_CONTAINER, pod_ctx)
+        writes += pod_ctx.apply(self.executor)
+        self._reconcile_resctrl(pod, pod_ctx, live)
+        for container in pod.containers:
+            ctx = ContainerContext.from_container(pod, container, self.cfg)
+            self.registry.run(Stage.PRE_CREATE_CONTAINER, ctx)
+            writes += ctx.apply(self.executor)
         return writes
 
     def _reconcile_resctrl(self, pod, pod_ctx, live: set[str]) -> None:

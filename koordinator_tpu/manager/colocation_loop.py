@@ -75,6 +75,11 @@ class ManagerSyncBinding:
     caller's — one lock, same discipline as SchedulerBinding.
     """
 
+    #: service attribution for sync-apply spans (deltasync
+    #: _dispatch_event): a traced pod/node event applying here shows up
+    #: as the MANAGER's hop in the pod's end-to-end trace
+    service_name = "manager"
+
     def __init__(self, clock=time.time):
         self.clock = clock
         self.lock = threading.Lock()
@@ -246,10 +251,24 @@ class ColocationLoop:
         return records
 
     def tick(self) -> int:
-        """One reconcile round; returns the number of patches pushed."""
-        from koordinator_tpu import metrics
+        """One reconcile round; returns the number of patches pushed.
+
+        Runs inside a ``manager.colocation_tick`` trace span; every
+        pushed patch gets a ``manager.colocation_push`` child whose
+        context rides the STATE_PUSH frame to the sidecar (the RPC
+        client injects the active context), so a scheduler can see WHICH
+        manager tick changed a node's batch allocatable."""
+        from koordinator_tpu import metrics, tracing
 
         self.ticks += 1
+        with tracing.TRACER.span(
+                "manager.colocation_tick", service="manager",
+                attributes={"tick": self.ticks}) as tick_span:
+            pushed = self._tick_traced(metrics, tracing)
+            tick_span.set_attribute("pushed", pushed)
+        return pushed
+
+    def _tick_traced(self, metrics, tracing) -> int:
         if self.ensure_fn is not None:
             try:
                 self.ensure_fn()
@@ -271,7 +290,10 @@ class ColocationLoop:
             allocatable[ResourceDim.MID_CPU] = patch.mid_cpu_milli
             allocatable[ResourceDim.MID_MEMORY] = patch.mid_mem_mib
             try:
-                self.push_fn(patch.name, allocatable)
+                with tracing.TRACER.span(
+                        "manager.colocation_push", service="manager",
+                        attributes={"node": patch.name}):
+                    self.push_fn(patch.name, allocatable)
                 pushed += 1
                 metrics.colocation_patches_total.inc()
             except Exception:  # noqa: BLE001 — a wedged sidecar costs

@@ -11,6 +11,7 @@ same scrape endpoints and metric names exist for dashboards
 from __future__ import annotations
 
 import threading
+import time
 from typing import Mapping, Optional, Sequence
 
 
@@ -18,11 +19,35 @@ def _label_key(labels: Mapping[str, str] | None) -> tuple:
     return tuple(sorted((labels or {}).items()))
 
 
+def _escape_label_value(value: str) -> str:
+    """Text-exposition label escaping (the spec's three escapes, in
+    this order so the backslash pass can't double-escape the others):
+    backslash, double-quote, line feed."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """# HELP line escaping per the text format: backslash and line
+    feed (quotes are legal in HELP text)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _render_labels(key: tuple) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"
+
+
+def _render_exemplar(ex: dict | None) -> str:
+    """OpenMetrics exemplar suffix (`` # {labels} value timestamp``);
+    empty for classic-format exposition (ex is None)."""
+    if not ex:
+        return ""
+    labels = ",".join(f'{k}="{_escape_label_value(v)}"'
+                      for k, v in sorted(ex["labels"].items()))
+    return f" # {{{labels}}} {ex['value']:g} {ex['time']:.3f}"
 
 
 class _Metric:
@@ -33,7 +58,15 @@ class _Metric:
         self.help = help_text
         self._lock = threading.Lock()
 
+    def _header(self) -> list[str]:
+        return [f"# HELP {self.name} {_escape_help(self.help)}",
+                f"# TYPE {self.name} {self.kind}"]
+
     def expose(self) -> str:
+        raise NotImplementedError
+
+    def reset_for_tests(self) -> None:
+        """Zero the recorded values (keep the registration + help)."""
         raise NotImplementedError
 
 
@@ -55,12 +88,15 @@ class Counter(_Metric):
             return self._values.get(_label_key(labels), 0.0)
 
     def expose(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} {self.kind}"]
+        lines = self._header()
         with self._lock:
             for key, value in sorted(self._values.items()):
                 lines.append(f"{self.name}{_render_labels(key)} {value:g}")
         return "\n".join(lines)
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._values.clear()
 
 
 class Gauge(Counter):
@@ -85,17 +121,41 @@ class Histogram(_Metric):
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
+        #: latest exemplar per (label key, bucket le): an observation
+        #: annotated with e.g. {"trace_id": ...} lands on its SMALLEST
+        #: containing bucket, so an outlier's exemplar survives on the
+        #: tail bucket instead of being overwritten by every fast round
+        #: (the OpenMetrics attachment rule)
+        self._exemplars: dict[tuple, dict] = {}
 
     def observe(self, value: float,
-                labels: Mapping[str, str] | None = None) -> None:
+                labels: Mapping[str, str] | None = None,
+                exemplar: Mapping[str, str] | None = None) -> None:
         key = _label_key(labels)
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            bucket_le = "+Inf"
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     counts[i] += 1
+                    if bucket_le == "+Inf":
+                        bucket_le = f"{bound:g}"
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+            if exemplar:
+                self._exemplars[(key, bucket_le)] = {
+                    "labels": dict(exemplar), "value": float(value),
+                    "time": time.time(),
+                }
+
+    def exemplars(self, labels: Mapping[str, str] | None = None
+                  ) -> dict[str, dict]:
+        """{bucket le -> {labels, value, time}} for one label set (the
+        /debug linkage from latency outliers to trace ids)."""
+        key = _label_key(labels)
+        with self._lock:
+            return {le: dict(ex) for (k, le), ex in self._exemplars.items()
+                    if k == key}
 
     def quantile(self, q: float,
                  labels: Mapping[str, str] | None = None) -> float:
@@ -112,21 +172,29 @@ class Histogram(_Metric):
                     return self.buckets[i]
             return self.buckets[-1]
 
-    def expose(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} {self.kind}"]
+    def expose(self, openmetrics: bool = False) -> str:
+        """Classic text format by default; ``openmetrics=True`` appends
+        exemplar suffixes on bucket lines (classic Prometheus parsers
+        reject the `` # {...}`` syntax, so it is strictly opt-in)."""
+        lines = self._header()
         with self._lock:
             for key in sorted(self._counts):
                 counts = self._counts[key]
                 for bound, count in zip(self.buckets, counts):
-                    bucket_key = key + (("le", f"{bound:g}"),)
+                    le = f"{bound:g}"
+                    bucket_key = key + (("le", le),)
+                    ex = (_render_exemplar(self._exemplars.get((key, le)))
+                          if openmetrics else "")
                     lines.append(
-                        f"{self.name}_bucket{_render_labels(bucket_key)} {count}"
+                        f"{self.name}_bucket{_render_labels(bucket_key)} "
+                        f"{count}{ex}"
                     )
                 inf_key = key + (("le", "+Inf"),)
+                ex = (_render_exemplar(self._exemplars.get((key, "+Inf")))
+                      if openmetrics else "")
                 lines.append(
                     f"{self.name}_bucket{_render_labels(inf_key)} "
-                    f"{self._totals[key]}"
+                    f"{self._totals[key]}{ex}"
                 )
                 lines.append(
                     f"{self.name}_sum{_render_labels(key)} {self._sums[key]:g}"
@@ -135,6 +203,13 @@ class Histogram(_Metric):
                     f"{self.name}_count{_render_labels(key)} {self._totals[key]}"
                 )
         return "\n".join(lines)
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
+            self._exemplars.clear()
 
 
 class Registry:
@@ -169,11 +244,23 @@ class Registry:
                                  f"{type(metric).__name__}")
             return metric
 
-    def expose(self) -> str:
+    def expose(self, openmetrics: bool = False) -> str:
         """The /metrics scrape body."""
         with self._lock:
             metrics = list(self._metrics.values())
-        return "\n".join(m.expose() for m in metrics) + "\n"
+        return "\n".join(
+            m.expose(openmetrics) if isinstance(m, Histogram) else m.expose()
+            for m in metrics) + "\n"
+
+    def reset_for_tests(self) -> None:
+        """Zero every metric's recorded values WITHOUT dropping the
+        registrations (module-level instrument handles stay valid) —
+        the per-test isolation hook ``tests/conftest.py`` applies so
+        counters stop bleeding across tests within one process."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.reset_for_tests()
 
 
 # Component registries (the reference's per-component metric packages).
@@ -183,6 +270,36 @@ MANAGER = Registry("koord_manager")
 DESCHEDULER = Registry("koord_descheduler")
 TRANSPORT = Registry("koord_transport")
 
+ALL_REGISTRIES = (SCHEDULER, KOORDLET, MANAGER, DESCHEDULER, TRANSPORT)
+
+
+def expose_all(openmetrics: bool = False) -> str:
+    """One scrape body over every component registry — the aggregate
+    /metrics surface each binary's HTTP gateway serves (a koordlet
+    process still exposes its transport metrics, a scheduler its
+    koordlet-registry zeros, and so on: scrape configs stay uniform).
+
+    The OpenMetrics body ends with the mandatory ``# EOF`` terminator —
+    a scraper negotiating openmetrics via Accept would otherwise reject
+    the whole exposition as truncated."""
+    body = "".join(r.expose(openmetrics) for r in ALL_REGISTRIES)
+    if openmetrics:
+        body += "# EOF\n"
+    return body
+
+
+def parse_openmetrics_flag(value) -> bool:
+    """One parser for the ``openmetrics`` query/param flag across the
+    debug surfaces: only explicit truthy spellings enable it (JSON
+    ``false`` and the string "false" must NOT — an exemplar-suffixed
+    body breaks classic Prometheus parsers)."""
+    return str(value).strip().lower() in ("1", "true", "yes", "on")
+
+
+def reset_all_for_tests() -> None:
+    for registry in ALL_REGISTRIES:
+        registry.reset_for_tests()
+
 # Canonical instruments (names mirror the reference's).
 scheduling_latency = SCHEDULER.histogram(
     "scheduling_duration_seconds",
@@ -190,6 +307,15 @@ scheduling_latency = SCHEDULER.histogram(
     "phase)")
 solver_batch_latency = SCHEDULER.histogram(
     "solver_batch_duration_seconds", "Batched filter/score/assign solve latency")
+solver_device_latency = SCHEDULER.histogram(
+    "solver_device_duration_seconds",
+    "Device-side share of the batch solve: time spent blocking on the "
+    "jitted solves' results (label: path=incremental|full_*) — wall "
+    "minus this is host batch-build/dispatch/bookkeeping overhead")
+round_flight_dumps = SCHEDULER.counter(
+    "round_flight_dumps_total",
+    "Round flight records dumped by the recorder (label: "
+    "reason=slow|degraded)")
 pending_pods = SCHEDULER.gauge("pending_pods", "Pods waiting to be scheduled")
 incremental_dirty_fraction = SCHEDULER.gauge(
     "incremental_dirty_fraction",

@@ -1,0 +1,105 @@
+"""Round flight recorder: a ring buffer of per-round telemetry.
+
+Every scheduling round leaves one :class:`RoundRecord` — solve path,
+dirty fractions, per-phase timings, the wall-vs-device solve split,
+degraded/staleness state, shed/suspension counts, and the round's
+trace_id — so "why was round 48213 slow" is answered from one artifact
+instead of five binaries' logs.  Slow or degraded rounds are dumped to
+the scheduler log automatically (bounded: one line per offending round)
+and counted in ``round_flight_dumps_total``; the whole ring is
+queryable at ``GET /debug/rounds`` on the scheduler's HTTP gateway and
+debug service, and ``tools/trace_dump.py --slowest-round`` prints the
+same fields from a JSONL trace export (the round span carries them as
+attributes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from collections import deque
+from typing import Optional
+
+from koordinator_tpu import metrics
+
+logger = logging.getLogger("koordinator_tpu.scheduler")
+
+
+@dataclasses.dataclass
+class RoundRecord:
+    """One round's flight data (all host-side scalars; JSON-able)."""
+
+    round: int
+    trace_id: str
+    start_time: float            # wall clock, cross-process comparable
+    duration_s: float
+    solver: str                  # greedy | batch
+    solve_path: str              # incremental | full_* | degraded | none
+    pods: int                    # pods the round solved over
+    placed: int
+    failed: int
+    suspended: int               # held out by degraded-mode admission
+    degraded: bool
+    staleness_s: Optional[float]  # sync-feed age at round start
+    dirty_node_frac: float
+    dirty_pod_frac: float
+    solve_wall_s: float          # the Solve phase's wall time
+    solve_device_s: float        # time blocked on jitted solve results
+    phase_s: dict[str, float] = dataclasses.field(default_factory=dict)
+    #: cumulative solve-shed counter at round end (deltas between
+    #: records localize WHICH round the sheds landed in)
+    sheds_total: float = 0.0
+    dump_reason: Optional[str] = None   # slow | degraded when dumped
+
+    def to_doc(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FlightRecorder:
+    """Bounded ring of RoundRecords with automatic slow/degraded dumps.
+
+    Single-writer (records are appended under the scheduler's round
+    lock); readers take list() snapshots, which is safe against a
+    concurrent append on CPython deques.
+    """
+
+    def __init__(self, capacity: int = 256,
+                 slow_threshold_s: float = 1.0):
+        self.capacity = capacity
+        #: rounds slower than this dump their record (mirrors the
+        #: monitor's slow-round warning threshold by default)
+        self.slow_threshold_s = slow_threshold_s
+        self.records: deque[RoundRecord] = deque(maxlen=capacity)
+        self.dumps = 0
+
+    def record(self, rec: RoundRecord) -> None:
+        reason = None
+        if rec.duration_s > self.slow_threshold_s:
+            reason = "slow"
+        elif rec.degraded:
+            reason = "degraded"
+        if reason is not None:
+            rec.dump_reason = reason
+            self.dumps += 1
+            metrics.round_flight_dumps.inc(labels={"reason": reason})
+            logger.warning("round flight record (%s): %s", reason,
+                           json.dumps(rec.to_doc(), default=str))
+        self.records.append(rec)
+
+    def snapshot(self, limit: Optional[int] = None) -> list[dict]:
+        """Newest-first record docs (the /debug/rounds body)."""
+        records = list(self.records)[::-1]
+        if limit is not None and limit >= 0:
+            records = records[:limit]
+        return [r.to_doc() for r in records]
+
+    def slowest(self) -> Optional[dict]:
+        records = list(self.records)
+        if not records:
+            return None
+        return max(records, key=lambda r: r.duration_s).to_doc()
+
+    def last(self) -> Optional[RoundRecord]:
+        records = list(self.records)
+        return records[-1] if records else None

@@ -3,6 +3,13 @@
 Equivalent of ``frameworkext/scheduler_monitor.go:44-100`` — records how long
 each scheduling phase takes, keeps a rolling history, and flags rounds that
 exceed the configured timeout (the reference logs pods stuck in a phase).
+
+Observability duties (PR 3): each phase is also a trace span (child of
+the round span the scheduler opens, when one is active) and feeds the
+``scheduling_duration_seconds`` histogram WITH a trace-id exemplar, so a
+latency outlier on the dashboard links straight to the round trace that
+produced it.  ``start_round()``/``round_timings`` expose the CURRENT
+round's per-phase wall times for the flight recorder.
 """
 
 from __future__ import annotations
@@ -12,7 +19,7 @@ import logging
 import time
 from collections import defaultdict, deque
 
-from koordinator_tpu import metrics
+from koordinator_tpu import metrics, tracing
 
 logger = logging.getLogger("koordinator_tpu.scheduler")
 
@@ -26,21 +33,42 @@ class SchedulerMonitor:
             lambda: deque(maxlen=history)
         )
         self.slow_rounds = 0
+        #: per-phase wall times of the round in flight (reset by
+        #: start_round; the flight recorder snapshots it at round end)
+        self.round_timings: dict[str, float] = {}
+
+    def start_round(self) -> None:
+        """Reset the per-round phase accumulator (called by the
+        scheduler at round start, under the round lock)."""
+        self.round_timings = {}
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        # phase spans only under an active trace (the scheduler's round
+        # span): standalone monitor users pay nothing, traced rounds get
+        # one child span per phase
+        ctx = tracing.current_context()
+        span_cm = (tracing.TRACER.span(f"phase.{name}") if ctx is not None
+                   else contextlib.nullcontext())
         start = self.clock()
         try:
-            yield
+            with span_cm:
+                yield
         finally:
             elapsed = self.clock() - start
             self.phase_history[name].append(elapsed)
+            self.round_timings[name] = (
+                self.round_timings.get(name, 0.0) + elapsed)
             # feed the prometheus surface too (the reference exports
-            # scheduling-cycle latency per phase from the same hook)
+            # scheduling-cycle latency per phase from the same hook);
+            # the exemplar links this observation to the round's trace
+            exemplar = ({"trace_id": ctx.trace_id} if ctx is not None
+                        else None)
             metrics.scheduling_latency.observe(
-                elapsed, labels={"phase": name})
+                elapsed, labels={"phase": name}, exemplar=exemplar)
             if name == "Solve":
-                metrics.solver_batch_latency.observe(elapsed)
+                metrics.solver_batch_latency.observe(
+                    elapsed, exemplar=exemplar)
             if elapsed > self.timeout_sec:
                 self.slow_rounds += 1
                 logger.warning(

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from koordinator_tpu import metrics
+from koordinator_tpu import metrics, tracing
 from koordinator_tpu.ops.assignment import ScoringConfig
 from koordinator_tpu.ops.gang import GangInfo, gang_assign
 from koordinator_tpu.ops.network_topology import (
@@ -146,6 +146,7 @@ class Scheduler:
         incremental_solve: bool = True,
         staleness_threshold_sec: float | None = None,
         staleness_exit_sec: float | None = None,
+        trace_pods: bool = False,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -328,6 +329,32 @@ class Scheduler:
         self.degraded_entries = 0
         #: pods held out of the last round by degraded-mode suspension
         self.last_suspended = 0
+
+        # -- tracing + round flight recorder --
+        from koordinator_tpu.scheduler.flight_recorder import FlightRecorder
+
+        #: trace EVERY enqueued pod (a root span per pod) even without a
+        #: propagated context.  Off by default: per-pod spans are O(P)
+        #: host work per round, and untraced operation should pay one
+        #: round span, not 50k — a caller-propagated TraceContext (the
+        #: wire path) always traces its pod regardless of this flag.
+        self.trace_pods = trace_pods
+        #: live trace context per pending pod (the enqueue span); popped
+        #: when the pod binds (the bind span parents to it) or leaves
+        self.pod_traces: dict[str, tracing.TraceContext] = {}
+        #: bounded pod-name -> trace_id registry surviving bind, for
+        #: /debug/trace/<pod> lookups
+        self._pod_trace_ids: dict[str, str] = {}
+        self.round_seq = 0
+        self.flight_recorder = FlightRecorder(
+            slow_threshold_s=self.monitor.timeout_sec)
+        #: device-side share of the round's solve (time blocked on
+        #: jitted results), accumulated across solve dispatches
+        self._solve_device_s = 0.0
+        self._last_dirty_node_frac = 0.0
+        self._last_dirty_pod_frac = 0.0
+        self._last_staleness_s: float | None = None
+        self._round_recordable = False
 
     # -- registration -------------------------------------------------------
 
@@ -651,12 +678,43 @@ class Scheduler:
         with self.lock:
             self.pending[pod.name] = pod
             self._pending_rev += 1
+            # the pod's trace starts (or joins) here: a propagated
+            # context (wire push applying under tracing.activate) always
+            # traces; trace_pods opts untraced pods into root spans.
+            # Synthetic reserve-pods are placement vehicles, not user
+            # workloads — they stay untraced like they stay unaudited.
+            ctx = tracing.current_context()
+            if ((ctx is not None or self.trace_pods)
+                    and not pod.name.startswith(RSV_POD_PREFIX)):
+                sp = tracing.TRACER.start_span(
+                    "scheduler.enqueue", service="scheduler", parent=ctx,
+                    attributes={"pod": pod.name,
+                                "priority": int(pod.priority)})
+                sp.end()
+                self.pod_traces[pod.name] = sp.context()
+                self._register_pod_trace(pod.name, sp.trace_id)
+
+    def _register_pod_trace(self, name: str, trace_id: str) -> None:
+        """Bounded name -> trace_id map for /debug/trace/<pod>: survives
+        bind (the interesting queries are about bound pods), trimmed
+        oldest-first so a years-long scheduler doesn't leak."""
+        ids = self._pod_trace_ids
+        ids.pop(name, None)          # re-enqueue refreshes recency
+        ids[name] = trace_id
+        if len(ids) > 8192:
+            for key in list(ids)[: len(ids) // 2]:
+                del ids[key]
+
+    def pod_trace_id(self, name: str) -> str | None:
+        """Most recent trace_id recorded for a pod (debug surface)."""
+        return self._pod_trace_ids.get(name)
 
     def dequeue(self, pod_name: str) -> None:
         # a deleted nominated preemptor must release its assumed reservation
         # and quota charge, and must not pin a future same-named pod
         with self.lock:
             pod = self.pending.pop(pod_name, None)
+            self.pod_traces.pop(pod_name, None)
             if pod is not None:
                 self._pending_rev += 1
             if pod_name in self.nominations and pod is not None:
@@ -678,6 +736,7 @@ class Scheduler:
         round start under the round lock."""
         threshold = self.staleness_threshold_sec
         age = self.snapshot.staleness(now)
+        self._last_staleness_s = age   # flight-recorder surface
         if threshold is None or age is None:
             # watchdog disabled, or no feed has ever spoken (a scheduler
             # warming up has nothing to be stale RELATIVE to)
@@ -990,9 +1049,76 @@ class Scheduler:
         )
 
     def schedule_round(self) -> SchedulingResult:
-        """Solve the current pending queue; reserve, bind, diagnose."""
+        """Solve the current pending queue; reserve, bind, diagnose.
+
+        Every round runs inside a ``scheduler.round`` span (joined to
+        the caller's trace when one rode the solve request) whose
+        attributes double as the round's flight record; rounds that got
+        past the elector/barrier gates land in the flight recorder ring
+        (``/debug/rounds``), slow/degraded ones dump automatically."""
+        from koordinator_tpu.scheduler.flight_recorder import RoundRecord
+
         with self.lock:
-            return self._schedule_round()
+            self.round_seq += 1
+            self.monitor.start_round()
+            self._solve_device_s = 0.0
+            self._last_dirty_node_frac = 0.0
+            self._last_dirty_pod_frac = 0.0
+            self._round_recordable = False
+            start_wall = time.time()
+            t0 = time.perf_counter()
+            with tracing.TRACER.span(
+                    "scheduler.round", service="scheduler",
+                    attributes={"round": self.round_seq}) as span:
+                result = self._schedule_round()
+                duration = time.perf_counter() - t0
+                path = (self.last_solve_path
+                        if self.last_solver == "batch" else "greedy")
+                if not self._round_recordable:
+                    # elector-standby / barrier-gated: last_solver and
+                    # last_solve_path are STALE leftovers of the last
+                    # deciding round — stamping them here would claim a
+                    # solve that never ran
+                    span.set_attributes({"gated": True})
+                else:
+                    span.set_attributes({
+                        "solver": self.last_solver,
+                        "solve_path": path,
+                        "pods": result.round_pods,
+                        "placed": len(result.assignments),
+                        "failed": len(result.failures),
+                        "suspended": self.last_suspended,
+                        "degraded": self.degraded,
+                        "staleness_s": self._last_staleness_s,
+                        "dirty_node_frac": self._last_dirty_node_frac,
+                        "dirty_pod_frac": self._last_dirty_pod_frac,
+                        "solve_wall_s": self.monitor.round_timings.get(
+                            "Solve", 0.0),
+                        "solve_device_s": self._solve_device_s,
+                    })
+            if self._round_recordable:
+                self.flight_recorder.record(RoundRecord(
+                    round=self.round_seq,
+                    trace_id=span.trace_id,
+                    start_time=start_wall,
+                    duration_s=duration,
+                    solver=self.last_solver,
+                    solve_path=path,
+                    pods=result.round_pods,
+                    placed=len(result.assignments),
+                    failed=len(result.failures),
+                    suspended=self.last_suspended,
+                    degraded=self.degraded,
+                    staleness_s=self._last_staleness_s,
+                    dirty_node_frac=self._last_dirty_node_frac,
+                    dirty_pod_frac=self._last_dirty_pod_frac,
+                    solve_wall_s=self.monitor.round_timings.get(
+                        "Solve", 0.0),
+                    solve_device_s=self._solve_device_s,
+                    phase_s=dict(self.monitor.round_timings),
+                    sheds_total=metrics.solve_deadline_shed_total.value(),
+                ))
+            return result
 
     def _schedule_round(self) -> SchedulingResult:
         # set at round START — before any early return, including the
@@ -1013,6 +1139,10 @@ class Scheduler:
             # replays past the barrier (sync_barrier.go semantics)
             return SchedulingResult({}, {}, 0)
         now = self.clock()
+        # a round that got this far decided (or legitimately found
+        # nothing to decide): it belongs in the flight recorder —
+        # standby/barrier-gated rounds above do not
+        self._round_recordable = True
         self._staleness_tick(now)
         result = SchedulingResult({}, {}, 0)
         self.last_result = result  # debug-API diagnosis surface
@@ -1106,7 +1236,7 @@ class Scheduler:
                     # the jitted solve donated the old state buffers; keep the
                     # snapshot on live ones until Reserve's bookkeeping adopt
                     self.snapshot.state = new_state
-                a = np.asarray(assignments)
+                a = np.asarray(self._block_timed(assignments))
                 leftover = np.asarray(batch.valid) & (a < 0)
                 if solver == "batch" and bool(leftover[: len(pods)].any()):
                     # exact rescue pass over the leftovers: the batch engine's
@@ -1140,7 +1270,8 @@ class Scheduler:
                     )
                     self.snapshot.state = new_state
                     r_full = np.full(batch.capacity, -1, np.int32)
-                    r_full[idx] = np.asarray(r_small)[: len(idx)]
+                    r_full[idx] = np.asarray(
+                        self._block_timed(r_small))[: len(idx)]
                     assignments = jnp.where(
                         assignments >= 0, assignments, jnp.asarray(r_full))
                     a = np.asarray(assignments)
@@ -1160,6 +1291,14 @@ class Scheduler:
             self._cand_cache = None
             raise
         result.round_pods = len(pods)
+        # wall vs. device: the Solve phase's wall time is in the monitor;
+        # this is the share spent blocked on jitted solve results
+        metrics.solver_device_latency.observe(
+            self._solve_device_s,
+            labels={"path": (self.last_solve_path if solver == "batch"
+                             else "greedy")},
+            exemplar=({"trace_id": tracing.current_trace_id()}
+                      if tracing.current_context() is not None else None))
         with self.monitor.phase("Reserve"):
             self.snapshot.adopt_state(new_state,
                                       changed_rows=np.unique(a[a >= 0]))
@@ -1253,6 +1392,17 @@ class Scheduler:
 
     # -- incremental delta-driven solve -------------------------------------
 
+    def _block_timed(self, value):
+        """Block on a jitted solve's result, accumulating the wait into
+        the round's device-time share (``_solve_device_s``).  The
+        dispatch itself returns immediately (async execution), so time
+        spent HERE is device compute + transfer — the wall-vs-device
+        split the flight recorder and round span report."""
+        t0 = time.perf_counter()
+        value = jax.block_until_ready(value)
+        self._solve_device_s += time.perf_counter() - t0
+        return value
+
     def _solve_batch_incremental(self, pods, batch: PodBatch, quota):
         """The no-gang batch solve with the persistent device-resident
         candidate cache (ops/batch_assign incremental section).
@@ -1327,6 +1477,8 @@ class Scheduler:
             metrics.incremental_dirty_fraction.set(
                 pod_frac, labels={"kind": "pods"})
             metrics.incremental_dirty_pods.set(float(dirty_pods.sum()))
+            self._last_dirty_node_frac = node_frac
+            self._last_dirty_pod_frac = pod_frac
             if max(node_frac, pod_frac) <= self.incremental_dirty_threshold:
                 path = "incremental"
                 cand_key, cache = self._refresh_cands(
@@ -1382,7 +1534,7 @@ class Scheduler:
                 snap.state, batch, quota, cache.cand_key, cache.cand_node,
                 self.config, rounds=self.solve_rounds)
             snap.state = state
-            a_np = np.asarray(a)
+            a_np = np.asarray(self._block_timed(a))
             for _ in range(1, self.gang_passes):
                 leftover = np.asarray(batch.valid) & (a_np < 0)
                 if not leftover.any():
@@ -1393,7 +1545,7 @@ class Scheduler:
                     rounds=self.solve_rounds, spread_bits=self.cand_spread,
                     method=method)
                 snap.state = state
-                a2_np = np.asarray(a2)[: len(idx)]
+                a2_np = np.asarray(self._block_timed(a2))[: len(idx)]
                 placed = a2_np >= 0
                 if not placed.any():
                     break
@@ -1431,6 +1583,23 @@ class Scheduler:
         if charge_quota:
             self._charge_quota_used(pod, sign=1)
         self._allocate_fine_grained(pod, node)
+        # bind marker in the POD's trace (parented to its enqueue span,
+        # linked to the round's trace by attribute), and the trace
+        # annotation the deployment shell carries onto the bound pod
+        # object — the koordlet's reconciler joins the trace from it,
+        # the way the reference propagates through patched annotations.
+        # AFTER _allocate_fine_grained: that call replaces the pod's
+        # resource_status entry wholesale.
+        ctx = self.pod_traces.pop(pod.name, None)
+        if ctx is not None:
+            sp = tracing.TRACER.start_span(
+                "scheduler.bind", service="scheduler", parent=ctx,
+                attributes={"pod": pod.name, "node": node,
+                            "round": self.round_seq,
+                            "round_trace_id": tracing.current_trace_id()})
+            sp.end()
+            self.resource_status.setdefault(pod.name, {})[
+                tracing.TRACE_ANNOTATION] = sp.context().to_annotation()
         if self.bind_fn is not None:
             self.bind_fn(pod.name, node)
         # success side of ScheduleExplanation/auditor lifecycle lives here so

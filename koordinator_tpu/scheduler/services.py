@@ -19,10 +19,34 @@ from typing import Callable, Optional
 import numpy as np
 
 
+def debug_rounds_body(scheduler, size: int) -> dict:
+    """The /debug/rounds payload — ONE builder shared by DebugService
+    and the HTTP gateway so the two surfaces cannot drift."""
+    return {"rounds": scheduler.flight_recorder.snapshot(size)}
+
+
+def debug_trace_body(scheduler, pod: str) -> Optional[dict]:
+    """The /debug/trace/<pod> payload (None = pod never traced); shared
+    by DebugService and the HTTP gateway.  ``pod`` may arrive
+    percent-encoded from either HTTP surface."""
+    from urllib.parse import unquote
+
+    from koordinator_tpu import tracing
+
+    pod = unquote(pod)
+    trace_id = scheduler.pod_trace_id(pod)
+    if trace_id is None:
+        return None
+    return {"pod": pod, "trace_id": trace_id,
+            "spans": [s.to_doc() for s in
+                      tracing.TRACER.spans_for_trace(trace_id)]}
+
+
 class DebugService:
     def __init__(self, scheduler=None):
         self.scheduler = scheduler
         self._routes: dict[str, Callable[[dict], object]] = {}
+        self._prefix_routes: dict[str, Callable[[str, dict], object]] = {}
         self._lock = threading.Lock()
         #: debug.go: runtime-togglable top-N score dumping (0 = off)
         self.dump_top_n_scores = 0
@@ -41,11 +65,29 @@ class DebugService:
         self.register(f"/apis/v1/plugins/{plugin_name}/{sub_path.lstrip('/')}",
                       handler)
 
+    def register_prefix(self, prefix: str,
+                        handler: Callable[[str, dict], object]) -> None:
+        """Parameterized route: ``handler(rest, params)`` receives the
+        path remainder after ``prefix`` (e.g. the pod name under
+        ``/debug/trace/``)."""
+        with self._lock:
+            self._prefix_routes[prefix] = handler
+
     def handle(self, path: str, params: dict | None = None) -> tuple[int, object]:
         """(status, body) — the transport-agnostic request entry."""
         with self._lock:
             handler = self._routes.get(path.rstrip("/"))
+            prefix_routes = dict(self._prefix_routes)
         if handler is None:
+            for prefix, ph in prefix_routes.items():
+                if path.startswith(prefix) and len(path) > len(prefix):
+                    rest = path[len(prefix):]
+                    try:
+                        return 200, ph(rest, params or {})
+                    except KeyError as e:
+                        return 404, {"error": str(e)}
+                    except Exception as e:  # noqa: BLE001
+                        return 500, {"error": str(e)}
             return 404, {"error": f"no route {path}"}
         try:
             return 200, handler(params or {})
@@ -65,6 +107,8 @@ class DebugService:
         self.register("/apis/v1/__debug/scores", self._scores)
         self.register("/apis/v1/__debug/set-top-n", self._set_top_n)
         self.register("/metrics", self._metrics)
+        self.register("/debug/rounds", self._rounds)
+        self.register_prefix("/debug/trace/", self._trace)
 
     def _nodes(self, params: dict) -> object:
         snapshot = self.scheduler.snapshot
@@ -142,9 +186,25 @@ class DebugService:
         return {"dump_top_n_scores": self.dump_top_n_scores}
 
     def _metrics(self, params: dict) -> object:
-        from koordinator_tpu.metrics import SCHEDULER
+        from koordinator_tpu import metrics
 
-        return SCHEDULER.expose()
+        # aggregate exposition (all component registries): the same
+        # scrape body the HTTP gateway serves, so both debug surfaces
+        # agree; ?openmetrics=1 adds histogram exemplars
+        return metrics.expose_all(openmetrics=metrics.parse_openmetrics_flag(
+            params.get("openmetrics", "0")))
+
+    def _rounds(self, params: dict) -> object:
+        """The round flight recorder, newest first (?size=N)."""
+        return debug_rounds_body(self.scheduler,
+                                 int(params.get("size", 32)))
+
+    def _trace(self, pod: str, params: dict) -> object:
+        """Recent spans of one pod's trace (/debug/trace/<pod>)."""
+        body = debug_trace_body(self.scheduler, pod)
+        if body is None:
+            raise KeyError(f"no trace recorded for pod {pod!r}")
+        return body
 
     def record_scores(self, pods: list, scores: np.ndarray,
                       node_names: list[str]) -> None:

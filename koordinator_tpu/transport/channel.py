@@ -26,6 +26,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from koordinator_tpu import tracing
 from koordinator_tpu.transport.wire import (
     Frame,
     FrameType,
@@ -319,7 +320,18 @@ def _dispatch_one(server: "RpcServer", conn: _Conn, frame: Frame,
                          "dispatch", "expired": True})))
                 return
             doc["__expires_at__"] = expires
-        out_doc, out_arrays = handler(doc, arrays)
+        # trace propagation: a caller's TraceContext rides the doc like
+        # deadline_ms; a traced request gets a server-side dispatch span
+        # (joined to the caller's trace), untraced requests pay one dict
+        # lookup and no span
+        tctx = tracing.extract(doc)
+        if tctx is not None:
+            with tracing.TRACER.span(
+                    f"rpc.{frame.type.name}",
+                    service=server.service or None, parent=tctx):
+                out_doc, out_arrays = handler(doc, arrays)
+        else:
+            out_doc, out_arrays = handler(doc, arrays)
         rtype = FrameType(out_doc.pop(
             "__type__", int(_RESPONSE_TYPE.get(
                 frame.type, FrameType.ACK))))
@@ -382,11 +394,15 @@ class RpcServer:
     (cross-host control plane — the reference's gRPC boundary listens
     on TCP the same way)."""
 
-    def __init__(self, path: str, faults=None):
+    def __init__(self, path: str, faults=None, service: str = ""):
         self.path = path
         #: optional faults.FaultInjector — chaos harness only; None in
         #: production (one attribute check per frame)
         self.faults = faults
+        #: service name stamped on traced-request dispatch spans so a
+        #: multi-binary test process still attributes spans to the right
+        #: component; empty falls back to the process tracer's service
+        self.service = service
         self.kind, target = _parse_addr(path)
         self.handlers: dict[FrameType, Handler] = {}
         self._conns: list[_Conn] = []
@@ -575,6 +591,9 @@ class RpcClient:
             # per-call deadline rides the frame doc so the server can
             # shed the request once nobody is waiting for it
             doc = dict(doc, deadline_ms=float(deadline_ms))
+        # active trace context rides the doc the same way (copy-on-write
+        # no-op when nothing is traced)
+        doc = tracing.inject(doc)
         waiter = _Waiter()
         with self._pending_lock:
             req_id = self._next_id

@@ -29,6 +29,7 @@ from typing import Optional
 
 import numpy as np
 
+from koordinator_tpu import tracing
 from koordinator_tpu.transport import wire
 from koordinator_tpu.transport.wire import FrameType
 
@@ -220,6 +221,15 @@ class StateSyncService:
         update_node_devices: a racing pair must not leave the stored doc
         and the delta-log tail disagreeing).  Caller holds _lock and
         must call _drain_bindings() after releasing it."""
+        # trace propagation: the mutation's originating context (a
+        # traced STATE_PUSH dispatch, an instrumented in-process caller)
+        # is stamped onto the event itself, so DELTA watchers, the
+        # bounded replay log, AND bootstrap snapshots (the stored doc is
+        # this same dict for upsert-style events) all carry it — a pod's
+        # trace survives a client resync the same way its spec does
+        ctx = tracing.current_context()
+        if ctx is not None and tracing.TRACE_DOC_KEY not in event:
+            event[tracing.TRACE_DOC_KEY] = ctx.to_doc()
         self.rv += 1
         rv = self.rv
         self.log.append(rv, event, arrays)
@@ -454,6 +464,14 @@ class StateSyncService:
         # here too so a missing kind/name is always a schema error, never
         # a KeyError
         wire.validate_doc(FrameType.STATE_PUSH, doc)
+        # same duality for the trace context: the channel already popped
+        # and activated it for framed requests (extract returns None and
+        # activate passes the ambient context through); the HTTP gateway
+        # and direct embedders land here with it still in the doc
+        with tracing.activate(tracing.extract(doc)):
+            return self._handle_state_push_traced(doc, arrays)
+
+    def _handle_state_push_traced(self, doc: dict, arrays):
         kind = doc.get("kind")
         name = doc["name"]
 
@@ -784,7 +802,30 @@ class StateSyncClient:
 def _dispatch_event(binding, entry: dict,
                     arrs: dict[str, np.ndarray]) -> None:
     """Route one sync event to a binding (shared by the remote client's
-    watch stream and the service's in-process subscribers)."""
+    watch stream and the service's in-process subscribers).
+
+    An event stamped with a trace context applies inside a
+    ``sync.<kind>`` span joined to that trace (service from the
+    binding's ``service_name``), and the binding's handler runs with the
+    context active — a pod_add reaching Scheduler.enqueue parents the
+    pod's trace to the original submitter's span.  The entry is read,
+    never mutated: the same dict may live in the service's stored state
+    and replay log."""
+    ctx = tracing.TraceContext.from_doc(entry.get(tracing.TRACE_DOC_KEY))
+    if ctx is None:
+        _route_event(binding, entry, arrs)
+        return
+    with tracing.TRACER.span(
+            f"sync.{entry['kind']}",
+            service=getattr(binding, "service_name", None),
+            parent=ctx,
+            attributes={"name": entry.get("name"),
+                        "rv": entry.get("rv")}):
+        _route_event(binding, entry, arrs)
+
+
+def _route_event(binding, entry: dict,
+                 arrs: dict[str, np.ndarray]) -> None:
     kind = entry["kind"]
     if kind == NODE_UPSERT:
         binding.node_upsert(entry, arrs)
@@ -818,6 +859,9 @@ class SchedulerBinding:
     RpcClient reader thread while SolveService runs rounds on server
     connection threads; the lock is the single-scheduling-goroutine
     equivalent."""
+
+    #: service attribution for sync-apply spans (_dispatch_event)
+    service_name = "scheduler"
 
     def __init__(self, scheduler):
         self.scheduler = scheduler

@@ -9,10 +9,21 @@ callable from Go's net/http (or curl) with no codegen and no client
 library, the interop role gRPC's JSON transcoding plays for the
 reference's api.proto surface.
 
-Routes (all JSON bodies/responses):
+Routes (all JSON bodies/responses unless noted):
 
     GET  /healthz                      -> {"ok": true}
     GET  /version                      -> {"protocol": N}
+    GET  /metrics                      -> text exposition over ALL
+                                          component registries
+                                          (metrics.expose_all) so every
+                                          binary scrapes uniformly;
+                                          ?openmetrics=1 (or Accept:
+                                          application/openmetrics-text)
+                                          adds histogram exemplars
+    GET  /debug/rounds?size=N          -> the scheduler's round flight
+                                          recorder, newest first
+    GET  /debug/trace/<pod>            -> recent spans of the pod's
+                                          trace (scheduler binaries)
     POST /v1/state                     -> one state event (the STATE_PUSH
                                           frame's JSON form: {"kind",
                                           "name", resource vectors as
@@ -83,6 +94,16 @@ class HttpGateway:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_text(self, code: int, text: str,
+                            content_type: str = "text/plain; "
+                            "version=0.0.4; charset=utf-8") -> None:
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _body(self) -> dict:
                 length = int(self.headers.get("Content-Length") or 0)
                 if not length:
@@ -132,6 +153,7 @@ class HttpGateway:
 
     _LEASE = re.compile(r"^/v1/leases/([A-Za-z0-9._-]+)$")
     _HOOK = re.compile(r"^/v1/hooks/([A-Za-z0-9._-]+)$")
+    _TRACE = re.compile(r"^/debug/trace/(.+)$")
 
     def _route(self, req, method: str) -> None:
         path = req.path.split("?", 1)[0]
@@ -139,6 +161,13 @@ class HttpGateway:
             return req._reply(200, {"ok": True})
         if method == "GET" and path == "/version":
             return req._reply(200, {"protocol": PROTOCOL_VERSION})
+        if method == "GET" and path == "/metrics":
+            return self._metrics(req)
+        if method == "GET" and path == "/debug/rounds":
+            return self._debug_rounds(req)
+        m = self._TRACE.match(path)
+        if m and method == "GET":
+            return self._debug_trace(req, m.group(1))
         if method == "POST" and path == "/v1/state":
             return self._state_push(req)
         if method == "POST" and path == "/v1/solve":
@@ -220,10 +249,67 @@ class HttpGateway:
             return req._reply(400, body)
         req._reply(200, out)
 
+    def _metrics(self, req) -> None:
+        """Aggregate scrape surface: every component registry, so the
+        same scrape config works against any of the five binaries."""
+        from urllib.parse import parse_qs
+
+        from koordinator_tpu import metrics
+
+        query = parse_qs(req.path.partition("?")[2])
+        openmetrics = (metrics.parse_openmetrics_flag(
+            query.get("openmetrics", ["0"])[0])
+            or "application/openmetrics-text"
+            in (req.headers.get("Accept") or ""))
+        content_type = ("application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8" if openmetrics
+                        else "text/plain; version=0.0.4; charset=utf-8")
+        req._reply_text(200, metrics.expose_all(openmetrics=openmetrics),
+                        content_type=content_type)
+
+    def _debug_rounds(self, req) -> None:
+        if getattr(self.scheduler, "flight_recorder", None) is None:
+            return req._reply(501, {"error": "no flight recorder "
+                                    "(scheduler binaries only)"})
+        from urllib.parse import parse_qs
+
+        from koordinator_tpu.scheduler.services import debug_rounds_body
+
+        query = parse_qs(req.path.partition("?")[2])
+        try:
+            size = int(query.get("size", ["32"])[0])
+        except ValueError:
+            return req._reply(400, {"error": "size must be an int"})
+        return req._reply(200, debug_rounds_body(self.scheduler, size))
+
+    def _debug_trace(self, req, pod: str) -> None:
+        if self.scheduler is None:
+            return req._reply(501, {"error": "no scheduler attached"})
+        from koordinator_tpu.scheduler.services import debug_trace_body
+
+        body = debug_trace_body(self.scheduler, pod)
+        if body is None:
+            return req._reply(404, {"error": f"no trace recorded for "
+                                    f"pod {pod!r}"})
+        return req._reply(200, body)
+
     def _solve(self, req) -> None:
         if self.scheduler is None:
             return req._reply(501, {"error": "no scheduler attached"})
-        result = self.scheduler.schedule_round()
+        from koordinator_tpu import tracing
+
+        # a trace context in the body joins the round to the caller's
+        # trace, same as the framed SOLVE_REQUEST path.  The body was
+        # IGNORED before tracing existed, so a non-JSON body (curl -d
+        # 'run-now') must keep triggering the round, not 500
+        try:
+            doc = req._body()
+        except ValueError:
+            doc = {}
+        ctx = (tracing.TraceContext.from_doc(doc.get("trace"))
+               if isinstance(doc, dict) else None)
+        with tracing.activate(ctx):
+            result = self.scheduler.schedule_round()
         req._reply(200, {
             "assignments": dict(result.assignments),
             "failures": {name: diag.message()

@@ -26,6 +26,21 @@ from koordinator_tpu import native as _native  # noqa: E402
 
 _native.ensure_built()
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate_metrics():
+    """Zero every metric registry after each test (values only — the
+    module-level instrument handles stay registered), so counters stop
+    bleeding across tests within one pytest process.  Tests that want
+    deltas mid-test still see them; tests that assert absolute values
+    start from a clean slate."""
+    yield
+    from koordinator_tpu import metrics
+
+    metrics.reset_all_for_tests()
+
 
 def prop_seeds(default_n: int) -> list[int]:
     """Seed list for the randomized property suites.

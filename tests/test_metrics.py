@@ -49,6 +49,76 @@ class TestInstruments:
             r.gauge("x")
 
 
+class TestExpositionConformance:
+    """Text-format spec conformance: label values escape backslash,
+    double-quote, and line feed; HELP escapes backslash and line feed
+    (the _render_labels bug ISSUE 3 names: raw specials corrupt the
+    scrape body — one newline-carrying label breaks every later line)."""
+
+    def test_label_value_escaping(self):
+        r = Registry("esc")
+        c = r.counter("hits", "hit count")
+        c.inc(1, {"path": 'a\\b"c\nd'})
+        text = r.expose()
+        assert 'esc_hits{path="a\\\\b\\"c\\nd"} 1' in text
+        # no raw newline may survive inside a sample line
+        for line in text.splitlines():
+            if line.startswith("esc_hits{"):
+                assert line.endswith(" 1")
+
+    def test_help_text_escaping(self):
+        r = Registry("esc2")
+        r.counter("c", 'backslash \\ and\nnewline and "quotes"')
+        text = r.expose()
+        assert ('# HELP esc2_c backslash \\\\ and\\nnewline and "quotes"'
+                in text)
+
+    def test_histogram_label_escaping_and_le_ordering(self):
+        r = Registry("esc3")
+        h = r.histogram("lat", buckets=(0.1, 1.0))
+        h.observe(0.05, labels={"phase": 'So"lve\n'})
+        text = r.expose()
+        assert 'phase="So\\"lve\\n",le="0.1"' in text
+
+    def test_reset_for_tests_keeps_registrations(self):
+        r = Registry("rst")
+        c = r.counter("hits")
+        g = r.gauge("level")
+        h = r.histogram("lat", buckets=(1.0,))
+        c.inc(3, {"a": "b"})
+        g.set(7.0)
+        h.observe(0.5, exemplar={"trace_id": "t1"})
+        r.reset_for_tests()
+        assert c.value({"a": "b"}) == 0
+        assert g.value() == 0
+        assert h.quantile(0.5) == 0.0
+        assert h.exemplars() == {}
+        # same objects, still registered (no duplicate-registration error)
+        assert r.counter("hits") is c
+        assert r.gauge("level") is g
+
+    def test_expose_all_covers_every_component_registry(self):
+        from koordinator_tpu import metrics as m
+
+        text = m.expose_all()
+        for reg in m.ALL_REGISTRIES:
+            assert f"{reg.prefix}_" in text
+        # classic format has no OpenMetrics terminator...
+        assert not text.endswith("# EOF\n")
+        # ...but the OpenMetrics body MUST end with one, or a scraper
+        # that negotiated openmetrics rejects the whole exposition
+        assert m.expose_all(openmetrics=True).endswith("# EOF\n")
+
+    def test_openmetrics_flag_parsing(self):
+        from koordinator_tpu.metrics import parse_openmetrics_flag
+
+        for truthy in ("1", "true", "TRUE", "yes", "on", True):
+            assert parse_openmetrics_flag(truthy) is True
+        for falsy in ("0", "", "false", "False", "no", "off", False,
+                      None, "2"):
+            assert parse_openmetrics_flag(falsy) is False
+
+
 class TestWiring:
     def test_qos_eviction_counts(self, tmp_path):
         from koordinator_tpu.koordlet.qosmanager.framework import Evictor
@@ -71,7 +141,8 @@ class TestDashboards:
         from koordinator_tpu import metrics as m
 
         names = set()
-        for reg in (m.SCHEDULER, m.KOORDLET, m.MANAGER, m.DESCHEDULER):
+        for reg in (m.SCHEDULER, m.KOORDLET, m.MANAGER, m.DESCHEDULER,
+                    m.TRANSPORT):
             for full, metric in reg._metrics.items():
                 names.add(full)
                 if isinstance(metric, m.Histogram):

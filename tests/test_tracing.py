@@ -1,0 +1,553 @@
+"""End-to-end tracing + round flight recorder (ISSUE 3).
+
+Covers the tracing core (spans, context, exporters), the scheduler's
+pod/round instrumentation (flight recorder, wall-vs-device solve split,
+debug endpoints), and the acceptance flow: one trace_id emitted at
+``Scheduler.enqueue`` observable in spans from the scheduler, manager,
+and koordlet services over real sockets — including across a
+fault-injected reconnect/resync.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from koordinator_tpu import metrics, tracing
+from koordinator_tpu.api.resources import resource_vector
+from koordinator_tpu.scheduler import ClusterSnapshot, Scheduler
+from koordinator_tpu.scheduler.snapshot import NodeSpec, PodSpec
+from koordinator_tpu.transport import (
+    RpcClient,
+    RpcServer,
+    StateSyncClient,
+    StateSyncService,
+)
+from koordinator_tpu.transport.deltasync import SchedulerBinding
+from koordinator_tpu.transport.services import SolveService, solve_remote
+from koordinator_tpu.transport.wire import FrameType
+
+
+@pytest.fixture
+def collector():
+    col = tracing.InMemoryExporter()
+    tracing.TRACER.add_exporter(col)
+    yield col
+    tracing.TRACER.remove_exporter(col)
+
+
+def wait_until(predicate, timeout=5.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def make_sched(capacity=8, **kw):
+    snap = ClusterSnapshot(capacity=capacity)
+    snap.upsert_node(NodeSpec(
+        name="n0", allocatable=resource_vector(cpu=16_000, memory=16_384)))
+    return Scheduler(snap, **kw)
+
+
+def pod_spec(name, cpu=1_000):
+    return PodSpec(name=name,
+                   requests=resource_vector(cpu=cpu, memory=1_024))
+
+
+# ---- tracing core ----------------------------------------------------------
+
+class TestTracingCore:
+    def test_span_nesting_and_context(self, collector):
+        with tracing.TRACER.span("outer", service="a") as outer:
+            assert tracing.current_context().span_id == outer.span_id
+            with tracing.TRACER.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+        assert tracing.current_context() is None
+        names = [s.name for s in collector.spans]
+        assert names == ["inner", "outer"]  # inner ends first
+        assert collector.spans[0].duration_s is not None
+
+    def test_activate_remote_parent_and_noop(self, collector):
+        ctx = tracing.TraceContext(trace_id="t" * 32, span_id="s" * 16)
+        with tracing.activate(ctx):
+            with tracing.TRACER.span("child") as sp:
+                assert sp.trace_id == "t" * 32
+                assert sp.parent_id == "s" * 16
+            # activate(None) must NOT clobber the ambient context
+            with tracing.activate(None):
+                assert tracing.current_context().trace_id == "t" * 32
+
+    def test_inject_extract_roundtrip(self):
+        with tracing.TRACER.span("op") as sp:
+            doc = tracing.inject({"kind": "pod_add"})
+            assert doc[tracing.TRACE_DOC_KEY]["trace_id"] == sp.trace_id
+            ctx = tracing.extract(doc)
+            assert ctx.span_id == sp.span_id
+            assert tracing.TRACE_DOC_KEY not in doc  # popped like deadline_ms
+        # no active trace: inject is a no-op passthrough (same object)
+        base = {"kind": "pod_add"}
+        assert tracing.inject(base) is base
+
+    def test_malformed_context_drops_silently(self):
+        for bad in (None, "x", 7, {}, {"trace_id": 1, "span_id": "s"},
+                    {"trace_id": "", "span_id": "s"}):
+            assert tracing.TraceContext.from_doc(bad) is None
+        assert tracing.TraceContext.from_annotation("{not json") is None
+
+    def test_annotation_roundtrip(self):
+        ctx = tracing.TraceContext(trace_id="ab" * 16, span_id="cd" * 8)
+        assert tracing.TraceContext.from_annotation(
+            ctx.to_annotation()) == ctx
+
+    def test_span_error_status(self, collector):
+        with pytest.raises(ValueError):
+            with tracing.TRACER.span("boom"):
+                raise ValueError("nope")
+        assert collector.spans[-1].status == "error"
+
+    def test_jsonl_exporter(self, tmp_path, collector):
+        path = tmp_path / "trace.jsonl"
+        exp = tracing.JsonlExporter(str(path))
+        tracing.TRACER.add_exporter(exp)
+        try:
+            with tracing.TRACER.span("written", service="svc"):
+                pass
+        finally:
+            tracing.TRACER.remove_exporter(exp)
+        lines = path.read_text().splitlines()
+        doc = json.loads(lines[-1])
+        assert doc["name"] == "written" and doc["service"] == "svc"
+        assert doc["duration_s"] >= 0
+
+    def test_exporter_failure_never_breaks_the_operation(self, collector):
+        class Broken:
+            def export(self, span):
+                raise RuntimeError("exporter bug")
+
+        broken = Broken()
+        tracing.TRACER.add_exporter(broken)
+        try:
+            with tracing.TRACER.span("survives"):
+                pass
+        finally:
+            tracing.TRACER.remove_exporter(broken)
+        assert collector.spans[-1].name == "survives"
+        assert tracing.TRACER.export_errors >= 1
+
+
+# ---- scheduler instrumentation ---------------------------------------------
+
+class TestSchedulerTracing:
+    def test_pod_trace_enqueue_to_bind(self, collector):
+        sched = make_sched(trace_pods=True)
+        sched.enqueue(pod_spec("p0"))
+        trace_id = sched.pod_trace_id("p0")
+        assert trace_id is not None
+        sched.schedule_round()
+        spans = tracing.TRACER.spans_for_trace(trace_id)
+        names = [s.name for s in spans]
+        assert names == ["scheduler.enqueue", "scheduler.bind"]
+        bind = spans[-1]
+        assert bind.parent_id == spans[0].span_id
+        assert bind.attributes["node"] == "n0"
+        # the bind annotation the shell carries onto the pod object
+        ann = sched.resource_status["p0"][tracing.TRACE_ANNOTATION]
+        assert tracing.TraceContext.from_annotation(
+            ann).trace_id == trace_id
+
+    def test_untraced_pods_pay_no_pod_spans(self, collector):
+        sched = make_sched()  # trace_pods off, no ambient context
+        sched.enqueue(pod_spec("p0"))
+        assert sched.pod_trace_id("p0") is None
+        sched.schedule_round()
+        assert not collector.find(name="scheduler.enqueue")
+        assert not collector.find(name="scheduler.bind")
+        # the round span still exists
+        assert collector.find(name="scheduler.round")
+
+    def test_propagated_context_always_traces(self, collector):
+        sched = make_sched()  # trace_pods off
+        with tracing.TRACER.span("submit") as sp:
+            sched.enqueue(pod_spec("p0"))
+        assert sched.pod_trace_id("p0") == sp.trace_id
+
+    def test_round_span_and_phase_children(self, collector):
+        sched = make_sched(trace_pods=True)
+        sched.enqueue(pod_spec("p0"))
+        sched.schedule_round()
+        rounds = collector.find(name="scheduler.round")
+        assert len(rounds) == 1
+        round_span = rounds[0]
+        phases = [s for s in collector.spans
+                  if s.name.startswith("phase.")
+                  and s.trace_id == round_span.trace_id]
+        assert {"phase.Solve", "phase.Bind"} <= {s.name for s in phases}
+        assert all(s.parent_id == round_span.span_id for s in phases)
+        # wall-vs-device split on the round span
+        attrs = round_span.attributes
+        assert attrs["solve_wall_s"] > 0
+        assert attrs["solve_device_s"] > 0
+        assert attrs["solve_wall_s"] >= attrs["solve_device_s"]
+
+    def test_flight_recorder_record_and_slow_dump(self, collector):
+        from koordinator_tpu.scheduler.monitor import SchedulerMonitor
+
+        # a tiny timeout makes every round "slow": the dump fires
+        sched = make_sched(monitor=SchedulerMonitor(timeout_sec=1e-9))
+        before = metrics.round_flight_dumps.value(labels={"reason": "slow"})
+        sched.enqueue(pod_spec("p0"))
+        sched.schedule_round()
+        rec = sched.flight_recorder.last()
+        assert rec is not None
+        assert rec.dump_reason == "slow"
+        assert rec.placed == 1 and rec.pods == 1
+        assert rec.solve_wall_s > 0 and rec.solve_device_s > 0
+        assert rec.phase_s["Solve"] == rec.solve_wall_s
+        assert metrics.round_flight_dumps.value(
+            labels={"reason": "slow"}) == before + 1
+        assert sched.flight_recorder.slowest()["round"] == rec.round
+
+    def test_solve_path_and_device_split_on_batch_rounds(self, collector):
+        # batch_solver_threshold=1 forces the batch engine (and, with no
+        # gangs and factored masks, the incremental driver)
+        sched = make_sched(batch_solver_threshold=1)
+        for i in range(4):
+            sched.enqueue(pod_spec(f"p{i}", cpu=100))
+        sched.schedule_round()
+        rec = sched.flight_recorder.last()
+        assert rec.solver == "batch"
+        assert rec.solve_path in ("full_cold", "incremental")
+        assert rec.solve_device_s > 0
+        # second round re-uses the cache: the path label updates
+        sched.enqueue(pod_spec("p9", cpu=100))
+        sched.schedule_round()
+        rec2 = sched.flight_recorder.last()
+        assert rec2.solve_path in ("incremental", "full_fallback")
+        assert rec2.dirty_pod_frac >= 0.0
+
+    def test_debug_rounds_and_trace_endpoints(self, collector):
+        from koordinator_tpu.scheduler.services import DebugService
+
+        sched = make_sched(trace_pods=True)
+        service = DebugService(sched)
+        sched.enqueue(pod_spec("p0"))
+        sched.schedule_round()
+        status, body = service.handle("/debug/rounds", {"size": 10})
+        assert status == 200
+        assert body["rounds"][0]["placed"] == 1
+        assert body["rounds"][0]["trace_id"]
+        status, body = service.handle("/debug/trace/p0")
+        assert status == 200
+        assert body["trace_id"] == sched.pod_trace_id("p0")
+        assert [s["name"] for s in body["spans"]] == [
+            "scheduler.enqueue", "scheduler.bind"]
+        status, _ = service.handle("/debug/trace/ghost")
+        assert status == 404
+
+    def test_gated_rounds_claim_no_stale_solve_path(self, collector):
+        class ClosedBarrier:
+            def check(self):
+                return False
+
+        sched = make_sched(trace_pods=True)
+        sched.enqueue(pod_spec("p0"))
+        sched.schedule_round()   # a real round sets last_solver/path
+        sched.barrier = ClosedBarrier()
+        sched.enqueue(pod_spec("p1"))
+        sched.schedule_round()   # gated: decides nothing
+        gated = collector.find(name="scheduler.round")[-1]
+        assert gated.attributes.get("gated") is True
+        assert "solver" not in gated.attributes  # no stale solve claim
+        # gated rounds stay out of the flight recorder too
+        assert len(sched.flight_recorder.records) == 1
+
+    def test_latency_exemplars_link_to_round_trace(self, collector):
+        sched = make_sched(trace_pods=True)
+        sched.enqueue(pod_spec("p0"))
+        sched.schedule_round()
+        round_span = collector.find(name="scheduler.round")[0]
+        exemplars = metrics.scheduling_latency.exemplars(
+            labels={"phase": "Solve"})
+        assert exemplars, "Solve phase observation carried no exemplar"
+        assert any(ex["labels"]["trace_id"] == round_span.trace_id
+                   for ex in exemplars.values())
+        # exemplars render only in the OpenMetrics exposition
+        classic = metrics.SCHEDULER.expose()
+        assert " # {" not in classic
+        om = metrics.SCHEDULER.expose(openmetrics=True)
+        assert f'# {{trace_id="{round_span.trace_id}"}}' in om
+
+
+# ---- HTTP gateway surfaces -------------------------------------------------
+
+class TestGatewaySurfaces:
+    def test_metrics_rounds_and_trace_over_http(self, collector):
+        from koordinator_tpu.transport.http_gateway import HttpGateway
+
+        sched = make_sched(trace_pods=True)
+        sched.enqueue(pod_spec("p0"))
+        sched.schedule_round()
+        gw = HttpGateway(scheduler=sched)
+        gw.start()
+        try:
+            base = f"http://127.0.0.1:{gw.port}"
+
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=5) as r:
+                    return r.status, r.read().decode(), r.headers
+
+            status, text, headers = get("/metrics")
+            assert status == 200
+            assert "text/plain" in headers["Content-Type"]
+            # aggregated: all five component registries in one scrape
+            for prefix in ("koord_scheduler_", "koordlet_",
+                           "koord_manager_", "koord_descheduler_",
+                           "koord_transport_"):
+                assert prefix in text, prefix
+            assert " # {" not in text
+            status, om, headers = get("/metrics?openmetrics=1")
+            assert "openmetrics" in headers["Content-Type"]
+            assert " # {" in om  # exemplars present
+
+            status, body, _ = get("/debug/rounds?size=1")
+            rounds = json.loads(body)["rounds"]
+            assert len(rounds) == 1 and rounds[0]["placed"] == 1
+
+            status, body, _ = get("/debug/trace/p0")
+            doc = json.loads(body)
+            assert doc["trace_id"] == sched.pod_trace_id("p0")
+            assert doc["spans"]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                get("/debug/trace/ghost")
+            assert ei.value.code == 404
+
+            # POST /v1/solve ignored its body before tracing existed; a
+            # non-JSON body must keep triggering the round, not 500
+            req = urllib.request.Request(
+                base + "/v1/solve", data=b"run-now", method="POST")
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert r.status == 200
+                assert "assignments" in json.loads(r.read())
+        finally:
+            gw.stop()
+
+
+# ---- koordlet reconcile ----------------------------------------------------
+
+class TestKoordletReconcileTracing:
+    def test_reconcile_joins_annotated_pod_trace(self, tmp_path, collector):
+        from koordinator_tpu.api.qos import QoSClass
+        from koordinator_tpu.koordlet.resourceexecutor import (
+            ResourceUpdateExecutor,
+        )
+        from koordinator_tpu.koordlet.runtimehooks.hooks import HookRegistry
+        from koordinator_tpu.koordlet.runtimehooks.plugins import (
+            register_default_hooks,
+        )
+        from koordinator_tpu.koordlet.runtimehooks.reconciler import (
+            Reconciler,
+        )
+        from koordinator_tpu.koordlet.statesinformer import (
+            PodMeta,
+            StatesInformer,
+        )
+        from koordinator_tpu.koordlet.system.config import make_test_config
+        from koordinator_tpu.api import crds
+
+        cfg = make_test_config(tmp_path)
+        ctx = tracing.TraceContext(trace_id="ee" * 16, span_id="ff" * 8)
+        pod = PodMeta(
+            uid="u1", name="traced-pod", namespace="default",
+            qos_class=QoSClass.BE, kube_qos="besteffort",
+            annotations={tracing.TRACE_ANNOTATION: ctx.to_annotation()})
+        states = StatesInformer()
+        states.set_pods([pod])
+        registry = HookRegistry()
+        register_default_hooks(registry, node_slo=lambda: crds.NodeSLO())
+        reconciler = Reconciler(states, registry,
+                                ResourceUpdateExecutor(cfg), cfg)
+        reconciler.reconcile_once()
+        spans = collector.find(name="koordlet.reconcile_pod",
+                               service="koordlet")
+        assert len(spans) == 1
+        assert spans[0].trace_id == ctx.trace_id
+        assert spans[0].parent_id == ctx.span_id
+        assert spans[0].attributes["pod"] == "traced-pod"
+        assert "writes" in spans[0].attributes
+        # periodic re-reconciles must NOT re-join the same trace every
+        # tick (a pod lives for weeks; its annotation doesn't change)
+        reconciler.reconcile_once()
+        reconciler.reconcile_once()
+        assert len(collector.find(name="koordlet.reconcile_pod")) == 1
+        # ...but a NEW trace annotation (pod re-bound) joins again
+        ctx2 = tracing.TraceContext(trace_id="aa" * 16, span_id="bb" * 8)
+        pod2 = PodMeta(
+            uid="u1", name="traced-pod", namespace="default",
+            qos_class=QoSClass.BE, kube_qos="besteffort",
+            annotations={tracing.TRACE_ANNOTATION: ctx2.to_annotation()})
+        states.set_pods([pod2])
+        reconciler.reconcile_once()
+        spans = collector.find(name="koordlet.reconcile_pod")
+        assert len(spans) == 2 and spans[-1].trace_id == ctx2.trace_id
+
+
+# ---- the acceptance flow: scheduler -> manager -> koordlet -----------------
+
+class TestEndToEndPropagation:
+    def test_one_trace_across_three_services_and_a_faulted_resync(
+            self, tmp_path, collector):
+        """One trace_id emitted at Scheduler.enqueue shows up in spans
+        from the scheduler, manager, and koordlet services, all hops
+        over real sockets; a fault-injected write truncation then severs
+        the manager's watch connection and the post-reconnect resync
+        replay still attributes the missed pod event to its trace."""
+        from koordinator_tpu.cmd.binaries import ReconnectingSidecarClient
+        from koordinator_tpu.manager.colocation_loop import (
+            ManagerSyncBinding,
+        )
+        from koordinator_tpu.runtimeproxy import Dispatcher
+        from koordinator_tpu.transport.faults import (
+            FaultConfig,
+            FaultInjector,
+        )
+        from koordinator_tpu.transport.services import HookService
+
+        # -- scheduler "process": sync service + solver over one socket
+        server = RpcServer(str(tmp_path / "sched.sock"),
+                           service="scheduler")
+        sync_service = StateSyncService()
+        sync_service.attach(server)
+        sched = make_sched()
+        sync_service.attach_binding(SchedulerBinding(sched))
+        SolveService(sched).attach(server)
+        server.start()
+
+        # -- koordlet "process": runtime-hook server on its own socket
+        hook_server = RpcServer(str(tmp_path / "hooks.sock"),
+                                service="koordlet")
+        HookService(Dispatcher()).attach(hook_server)
+        hook_server.start()
+
+        # -- manager "process": watch client over a fault-injectable
+        #    socket (probabilities start at zero; flipped below)
+        inj = FaultInjector(seed=7, config=FaultConfig())
+        binding = ManagerSyncBinding()
+        sync = StateSyncClient(binding)
+
+        def bootstrap_watch(client):
+            sync.bind_client(client)
+            sync.bootstrap(client)
+
+        manager = ReconnectingSidecarClient(
+            server.path, on_push=sync.on_push,
+            on_connect=bootstrap_watch, breaker=False, faults=inj)
+
+        feeder = RpcClient(server.path)
+        hook_client = RpcClient(hook_server.path)
+        try:
+            manager.ensure()
+            feeder.connect()
+            hook_client.connect()
+            sync_service.upsert_node(
+                "n0", resource_vector(cpu=16_000, memory=16_384))
+
+            # 1) submit the pod under a root span; the context rides the
+            #    STATE_PUSH frame doc (like deadline_ms)
+            with tracing.TRACER.span("submit-pod",
+                                     service="submitter") as sp:
+                trace_id = sp.trace_id
+                feeder.call(
+                    FrameType.STATE_PUSH,
+                    {"kind": "pod_add", "name": "pod-e2e", "priority": 3},
+                    {"requests": resource_vector(cpu=1_000, memory=512)})
+
+            # scheduler hop: the enqueue span joined the submitter trace
+            assert sched.pod_trace_id("pod-e2e") == trace_id
+            # and the server-side dispatch span carries it too
+            rpc_spans = collector.find(name="rpc.STATE_PUSH",
+                                       service="scheduler")
+            assert any(s.trace_id == trace_id for s in rpc_spans)
+
+            # manager hop: the DELTA applied on the watch stream under
+            # the same trace
+            wait_until(
+                lambda: any(s.trace_id == trace_id for s in
+                            collector.find(name="sync.pod_add",
+                                           service="manager")),
+                what="manager sync span for the pod trace")
+
+            # 2) solve remotely — the round joins the pod's... no: the
+            #    round joins the CALLER's trace; drive it under the pod
+            #    trace to keep one timeline
+            with tracing.activate(tracing.TraceContext(
+                    trace_id=trace_id, span_id=sp.span_id)):
+                out = solve_remote(feeder)
+            assert out["assignments"] == {"pod-e2e": "n0"}
+            round_spans = collector.find(name="scheduler.round",
+                                         service="scheduler")
+            assert any(s.trace_id == trace_id for s in round_spans)
+            bind_spans = collector.find(name="scheduler.bind",
+                                        service="scheduler")
+            assert any(s.trace_id == trace_id for s in bind_spans)
+
+            # 3) koordlet hop: the bind annotation's context rides the
+            #    HOOK_REQUEST frame to the koordlet's hook server
+            ann = sched.resource_status["pod-e2e"][
+                tracing.TRACE_ANNOTATION]
+            bind_ctx = tracing.TraceContext.from_annotation(ann)
+            assert bind_ctx.trace_id == trace_id
+            with tracing.activate(bind_ctx):
+                hook_client.call(FrameType.HOOK_REQUEST,
+                                 {"hook": "PreCreateContainer",
+                                  "pod_meta": {"name": "pod-e2e"}})
+            wait_until(
+                lambda: any(s.trace_id == trace_id for s in
+                            collector.find(name="rpc.HOOK_REQUEST",
+                                           service="koordlet")),
+                what="koordlet hook dispatch span")
+
+            # acceptance: one trace_id, spans from all three services
+            services = {s.service for s in collector.spans
+                        if s.trace_id == trace_id}
+            assert {"scheduler", "manager", "koordlet"} <= services
+
+            # 4) fault-injected reconnect/resync: truncate the manager's
+            #    next write mid-frame (the connection severs), heal, and
+            #    prove a pod event missed during the outage still joins
+            #    its trace after the re-HELLO replay
+            inj.config.send_truncate_p = 1.0
+            from koordinator_tpu.transport.channel import RpcError
+
+            with pytest.raises(RpcError):
+                manager.call(FrameType.STATE_PUSH,
+                             {"kind": "node_allocatable", "name": "n0"},
+                             {"allocatable": resource_vector(
+                                 cpu=16_000, memory=16_384)})
+            assert inj.injected["client_truncate"] >= 1
+            inj.config.send_truncate_p = 0.0
+
+            # traced pod pushed while the manager watch is down
+            with tracing.TRACER.span("submit-pod-2",
+                                     service="submitter") as sp2:
+                feeder.call(
+                    FrameType.STATE_PUSH,
+                    {"kind": "pod_add", "name": "pod-after-fault"},
+                    {"requests": resource_vector(cpu=500, memory=256)})
+            manager.ensure()  # re-dial + re-HELLO from last_rv
+            wait_until(
+                lambda: any(s.trace_id == sp2.trace_id for s in
+                            collector.find(name="sync.pod_add",
+                                           service="manager")),
+                what="post-resync manager span for the missed pod event")
+        finally:
+            feeder.close()
+            hook_client.close()
+            manager.close()
+            hook_server.stop()
+            server.stop()

@@ -15,6 +15,12 @@
 #         SOAK_BASE0   (default 1000) first window's seed base
 #         SOAK_STRIDE  (default 1000) distance between window bases
 #         SOAK_OUT     (default soak_results) output directory
+#         SOAK_TRACE   (default 0)    1 = enable the JSONL trace
+#                                     exporter (KOORD_TRACE_JSONL) for
+#                                     every window and print the slowest
+#                                     round's flight record at the end
+#                                     (tools/trace_dump.py
+#                                     --slowest-round)
 #         SOAK_CHAOS   (default 0)    1 = also sweep the chaos
 #                                     fault-injection suite (tests/
 #                                     test_chaos.py, `chaos` marker)
@@ -33,9 +39,16 @@ BASE0=${SOAK_BASE0:-1000}
 STRIDE=${SOAK_STRIDE:-1000}
 OUT=${SOAK_OUT:-soak_results}
 CHAOS=${SOAK_CHAOS:-0}
+TRACE=${SOAK_TRACE:-0}
 mkdir -p "$OUT"
 ts=$(date +%Y%m%d_%H%M%S)
 log="$OUT/soak_$ts.log"
+trace_jsonl=""
+if [ "$TRACE" = "1" ]; then
+    trace_jsonl="$OUT/trace_$ts.jsonl"
+    export KOORD_TRACE_JSONL="$trace_jsonl"
+    echo "== tracing to $trace_jsonl" | tee -a "$log"
+fi
 
 SUITES="tests/test_deviceshare_properties.py \
 tests/test_gang_properties.py \
@@ -122,4 +135,10 @@ print(json.dumps({
     "log": log,
 }))
 PYEOF
+
+if [ "$TRACE" = "1" ] && [ -s "$trace_jsonl" ]; then
+    echo "== slowest round ($trace_jsonl)" | tee -a "$log"
+    python tools/trace_dump.py "$trace_jsonl" --slowest-round \
+        | tee -a "$log"
+fi
 [ "$total_failed" -eq 0 ]

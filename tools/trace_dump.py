@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Pretty-print a JSONL trace export as per-trace timelines.
+
+The JSONL comes from the tracing module's JsonlExporter — one span per
+line — typically enabled with ``KOORD_TRACE_JSONL=<path>`` on any
+binary (or ``SOAK_TRACE=1 tools/soak.sh``).
+
+Usage:
+    tools/trace_dump.py trace.jsonl                  # every trace
+    tools/trace_dump.py trace.jsonl --pod p0         # traces whose
+                                                     # spans mention pod
+    tools/trace_dump.py trace.jsonl --trace <id>     # one trace
+    tools/trace_dump.py trace.jsonl --slowest-round  # the slowest
+                                                     # scheduler.round
+                                                     # span's flight
+                                                     # record fields
+
+Output per trace: spans sorted by start time, indented by parentage,
+with offset-from-trace-start and duration, e.g.
+
+    trace 9ac4... (pod-e2e)
+      +0.000ms   1.2ms scheduler  scheduler.enqueue  pod=pod-e2e
+      +4.1ms    80.0ms scheduler  scheduler.round    path=incremental
+      ...
+
+Dependency-free stdlib; malformed lines are skipped with a count.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_spans(path: str) -> tuple[list[dict], int]:
+    spans, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if isinstance(doc, dict) and doc.get("trace_id"):
+                spans.append(doc)
+            else:
+                bad += 1
+    return spans, bad
+
+
+def group_traces(spans: list[dict]) -> dict[str, list[dict]]:
+    traces: dict[str, list[dict]] = defaultdict(list)
+    for span in spans:
+        traces[span["trace_id"]].append(span)
+    for trace in traces.values():
+        trace.sort(key=lambda s: (s.get("start_time") or 0.0))
+    return traces
+
+
+def _depth(span: dict, by_id: dict[str, dict]) -> int:
+    depth, seen = 0, set()
+    cur = span
+    while cur.get("parent_id") and cur["parent_id"] in by_id:
+        if cur["span_id"] in seen:   # defensive: cyclic/garbage input
+            break
+        seen.add(cur["span_id"])
+        cur = by_id[cur["parent_id"]]
+        depth += 1
+    return depth
+
+
+def _fmt_attrs(attrs: dict, limit: int = 5) -> str:
+    items = [f"{k}={v}" for k, v in list(attrs.items())[:limit]
+             if v is not None]
+    return " ".join(items)
+
+
+def pod_of(trace: list[dict]) -> str | None:
+    for span in trace:
+        pod = (span.get("attributes") or {}).get("pod")
+        if pod:
+            return pod
+    return None
+
+
+def print_trace(trace_id: str, trace: list[dict], out=sys.stdout) -> None:
+    by_id = {s["span_id"]: s for s in trace}
+    t0 = min(s.get("start_time") or 0.0 for s in trace)
+    pod = pod_of(trace)
+    header = f"trace {trace_id}" + (f" (pod {pod})" if pod else "")
+    print(header, file=out)
+    for span in trace:
+        offset_ms = ((span.get("start_time") or t0) - t0) * 1000.0
+        dur_ms = (span.get("duration_s") or 0.0) * 1000.0
+        indent = "  " * (_depth(span, by_id) + 1)
+        status = "" if span.get("status") == "ok" else " [ERROR]"
+        print(f"{indent}+{offset_ms:9.3f}ms {dur_ms:9.3f}ms "
+              f"{span.get('service') or '-':<12} {span['name']}{status}  "
+              f"{_fmt_attrs(span.get('attributes') or {})}", file=out)
+
+
+def print_slowest_round(spans: list[dict], out=sys.stdout) -> int:
+    rounds = [s for s in spans if s.get("name") == "scheduler.round"]
+    if not rounds:
+        print("no scheduler.round spans in the export", file=out)
+        return 1
+    slowest = max(rounds, key=lambda s: s.get("duration_s") or 0.0)
+    attrs = slowest.get("attributes") or {}
+    print(f"slowest round: trace {slowest['trace_id']} "
+          f"({(slowest.get('duration_s') or 0) * 1000:.3f}ms)", file=out)
+    for key in ("round", "solver", "solve_path", "pods", "placed",
+                "failed", "suspended", "degraded", "staleness_s",
+                "dirty_node_frac", "dirty_pod_frac", "solve_wall_s",
+                "solve_device_s"):
+        if key in attrs:
+            print(f"  {key:>16}: {attrs[key]}", file=out)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="pretty-print a JSONL trace export")
+    parser.add_argument("path", help="JSONL file from the JsonlExporter")
+    parser.add_argument("--pod", help="only traces mentioning this pod")
+    parser.add_argument("--trace", help="only this trace id")
+    parser.add_argument("--slowest-round", action="store_true",
+                        help="print the slowest scheduler.round span's "
+                             "flight-record fields and exit")
+    args = parser.parse_args(argv)
+    spans, bad = load_spans(args.path)
+    if bad:
+        print(f"({bad} malformed lines skipped)", file=sys.stderr)
+    if args.slowest_round:
+        return print_slowest_round(spans)
+    traces = group_traces(spans)
+    shown = 0
+    for trace_id, trace in sorted(
+            traces.items(),
+            key=lambda kv: min(s.get("start_time") or 0.0
+                               for s in kv[1])):
+        if args.trace and trace_id != args.trace:
+            continue
+        if args.pod and pod_of(trace) != args.pod:
+            continue
+        print_trace(trace_id, trace)
+        shown += 1
+    if not shown:
+        print("no matching traces", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
