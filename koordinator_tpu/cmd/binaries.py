@@ -542,6 +542,28 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
              "submitter propagated a trace context are always traced); "
              "spans land in the in-process ring (/debug/trace/<pod>) "
              "and any KOORD_TRACE_JSONL exporter")
+    parser.add_argument(
+        "--slo-sample-interval-seconds", type=float, default=0.0,
+        help="background SLO burn-rate sampling cadence: every interval "
+             "the registry metrics are sampled into the in-process "
+             "time-series and the SLO specs' fast/slow burn windows are "
+             "evaluated (breach -> alert counter + flight-recorder "
+             "dump).  0 (default) = on-demand only: each GET /debug/slo "
+             "request samples + evaluates; production sidecars should "
+             "set e.g. 5")
+    parser.add_argument(
+        "--slo-latency-threshold-seconds", type=float, default=0.2,
+        help="the scheduling-latency SLO's per-observation bound (the "
+             "paper's p99 target: 0.2)")
+    parser.add_argument(
+        "--enable-profile-endpoint", action="store_true",
+        help="arm /debug/profile?seconds=N (on-demand jax.profiler "
+             "capture); OFF by default — the endpoint answers 403 "
+             "until an operator enables it here")
+    parser.add_argument(
+        "--profile-dir", default="",
+        help="directory for /debug/profile trace captures (default: a "
+             "fresh temp dir per capture)")
     return parser
 
 
@@ -605,6 +627,29 @@ def main_koord_scheduler(argv: list[str],
                                  else None),
         trace_pods=args.trace_pods,
     )
+    # -- self-observability: SLO burn-rate engine + solver introspection
+    from koordinator_tpu.ops.introspection import ProfilerCapture
+    from koordinator_tpu.slo_monitor import SloMonitor, default_specs
+
+    slo_monitor = SloMonitor(
+        specs=default_specs(
+            latency_threshold_s=args.slo_latency_threshold_seconds,
+            staleness_threshold_s=(args.staleness_threshold_seconds
+                                   if args.staleness_threshold_seconds > 0
+                                   else 30.0)),
+        sample_interval_s=(args.slo_sample_interval_seconds
+                           if args.slo_sample_interval_seconds > 0 else 5.0),
+        # a fast-burn breach dumps the latest round's flight record with
+        # the offending SLO named — the "why" artifact next to the alert
+        on_breach=lambda spec, doc: scheduler.flight_recorder.dump_now(
+            f"slo:{spec.name}"),
+    )
+    scheduler.slo_monitor = slo_monitor
+    if args.slo_sample_interval_seconds > 0:
+        slo_monitor.start()   # stopped via Assembled.stop -> Scheduler.stop
+    if args.enable_profile_endpoint:
+        scheduler.profile_capture = ProfilerCapture(
+            enabled=True, out_dir=args.profile_dir or None)
     server = None
     sync_service = None
     if args.listen_socket or args.http_port is not None:

@@ -125,12 +125,41 @@ class AggregateResult:
     def duration_seconds(self) -> float:
         return float(self.ts.max() - self.ts.min()) if self.count > 1 else 0.0
 
+    def first(self) -> float:
+        """Value at the earliest timestamp in the window (the other end
+        of a cumulative counter's windowed delta); 0.0 sentinel when
+        empty."""
+        return float(self.values[np.argmin(self.ts)]) if self.count else 0.0
+
+    def downsample(self, resolution_s: float) -> "AggregateResult":
+        """Mean-per-bin downsampling to one sample per ``resolution_s``
+        (bin timestamp = mean of member timestamps).  Bounds the cost of
+        long-window aggregation (the SLO engine's 1h slow window over a
+        1s sample cadence) without a second storage tier.  Meant for
+        gauges — averaging COUNTER samples inside a bin slightly skews
+        windowed deltas, so counter paths query raw."""
+        if resolution_s <= 0 or self.count == 0:
+            return self
+        bins = np.floor(self.ts / resolution_s).astype(np.int64)
+        _, inverse = np.unique(bins, return_inverse=True)
+        counts = np.bincount(inverse)
+        ts = np.bincount(inverse, weights=self.ts) / counts
+        values = np.bincount(inverse, weights=self.values) / counts
+        order = np.argsort(ts)
+        return AggregateResult(ts[order], values[order])
+
 
 class MetricCache:
     """Thread-safe store of ring-buffered series + an immutable KV side table."""
 
-    def __init__(self, capacity_per_series: int = 4096, clock=time.time):
+    def __init__(self, capacity_per_series: int = 4096, clock=time.time,
+                 retention_sec: float | None = None):
         self.capacity = capacity_per_series
+        #: query-time retention horizon: samples strictly older than
+        #: ``now - retention_sec`` are never served (the ring already
+        #: bounds memory; retention bounds what a WINDOW may claim to
+        #: cover).  A sample exactly AT the horizon is still served.
+        self.retention_sec = retention_sec
         self._series: dict[tuple, _Ring] = {}
         self._kv: dict[str, object] = {}
         self._lock = threading.Lock()
@@ -158,6 +187,8 @@ class MetricCache:
               start: float = 0.0, end: Optional[float] = None) -> AggregateResult:
         key = _series_key(metric, labels)
         end = self._clock() if end is None else end
+        if self.retention_sec is not None:
+            start = max(start, self._clock() - self.retention_sec)
         with self._lock:
             ring = self._series.get(key)
             if ring is None:

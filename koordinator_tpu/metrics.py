@@ -87,6 +87,13 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(_label_key(labels), 0.0)
 
+    def items(self) -> list[tuple[dict, float]]:
+        """Snapshot of (labels, value) per label set (the SLO sampler's
+        read surface; also handy for per-label-set test assertions)."""
+        with self._lock:
+            return [(dict(key), value)
+                    for key, value in self._values.items()]
+
     def expose(self) -> str:
         lines = self._header()
         with self._lock:
@@ -159,18 +166,32 @@ class Histogram(_Metric):
 
     def quantile(self, q: float,
                  labels: Mapping[str, str] | None = None) -> float:
-        """Bucket-upper-bound quantile estimate (for monitor thresholds)."""
+        """Quantile estimate from exposition state with Prometheus-style
+        linear interpolation inside the containing bucket (the SLO
+        engine's p99 and tests compute from the same math —
+        :func:`quantile_from_buckets`).  Observations in the +Inf bucket
+        clamp to the highest finite bound; no data returns 0.0."""
         key = _label_key(labels)
         with self._lock:
             counts = self._counts.get(key)
+            # copy under the lock: a concurrent observe() mutates the
+            # cumulative list bucket by bucket, and a torn read could
+            # momentarily look non-cumulative
+            counts = list(counts) if counts else None
             total = self._totals.get(key, 0)
-            if not counts or total == 0:
-                return 0.0
-            target = q * total
-            for i, c in enumerate(counts):
-                if c >= target:
-                    return self.buckets[i]
-            return self.buckets[-1]
+        if not counts or total == 0:
+            return 0.0
+        return quantile_from_buckets(self.buckets, counts, total, q)
+
+    def state(self) -> list[tuple[dict, list[int], int, float]]:
+        """Snapshot per label set: (labels, cumulative finite-bucket
+        counts, total incl. +Inf, sum) — the public read surface the
+        SLO sampler uses instead of reaching into the lock-guarded
+        internals."""
+        with self._lock:
+            return [(dict(key), list(self._counts[key]),
+                     self._totals.get(key, 0), self._sums.get(key, 0.0))
+                    for key in self._counts]
 
     def expose(self, openmetrics: bool = False) -> str:
         """Classic text format by default; ``openmetrics=True`` appends
@@ -244,6 +265,14 @@ class Registry:
                                  f"{type(metric).__name__}")
             return metric
 
+    def items(self) -> list[tuple[str, _Metric]]:
+        """Snapshot of (full name, instrument) registrations — the
+        public read surface for registry walkers (the SLO sampler, the
+        dashboard drift checker) so they stay off the lock-guarded
+        internals, mirroring Counter.items/Histogram.state."""
+        with self._lock:
+            return list(self._metrics.items())
+
     def expose(self, openmetrics: bool = False) -> str:
         """The /metrics scrape body."""
         with self._lock:
@@ -286,6 +315,61 @@ def expose_all(openmetrics: bool = False) -> str:
     if openmetrics:
         body += "# EOF\n"
     return body
+
+
+def quantile_from_buckets(bounds: Sequence[float],
+                          cum_counts: Sequence[float],
+                          total: float, q: float) -> float:
+    """Prometheus ``histogram_quantile`` bucket interpolation over
+    cumulative finite-bucket counts.
+
+    ``cum_counts[i]`` is the number of observations <= ``bounds[i]``;
+    ``total`` includes the +Inf bucket.  Observations landing past the
+    last finite bound (the +Inf bucket) clamp to the highest finite
+    bound — the quantile of data the buckets cannot resolve is the best
+    bound they CAN name, exactly Prometheus's behavior.  Empty data
+    returns the 0.0 sentinel."""
+    if total <= 0 or not bounds:
+        return 0.0
+    q = min(max(q, 0.0), 1.0)
+    rank = q * total
+    for i, bound in enumerate(bounds):
+        if cum_counts[i] >= rank:
+            lower = bounds[i - 1] if i > 0 else 0.0
+            below = cum_counts[i - 1] if i > 0 else 0.0
+            in_bucket = cum_counts[i] - below
+            if in_bucket <= 0:
+                return bound
+            return lower + (bound - lower) * (rank - below) / in_bucket
+    return bounds[-1]   # rank falls in the +Inf bucket
+
+
+def count_at_or_below(bounds: Sequence[float],
+                      cum_counts: Sequence[float],
+                      total: float, x: float) -> float:
+    """Estimated observations <= ``x`` by linear interpolation within
+    the containing bucket (the burn-rate engine's "good events" count
+    for thresholds that are not exact bucket bounds).
+
+    Observations in the +Inf bucket are NEVER counted at-or-below a
+    finite ``x`` — the buckets cannot prove anything about them, and a
+    threshold at/above the last finite bound must not silently bless a
+    60s solve as meeting a 10s SLO (they count as bad, the conservative
+    direction for an error budget)."""
+    if total <= 0 or not bounds:
+        return 0.0
+    if x >= bounds[-1]:
+        return float(cum_counts[-1])
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in zip(bounds, cum_counts):
+        if x < bound:
+            width = bound - prev_bound
+            if width <= 0:
+                return float(cum)
+            frac = max(0.0, (x - prev_bound)) / width
+            return prev_cum + (cum - prev_cum) * frac
+        prev_bound, prev_cum = bound, cum
+    return float(cum_counts[-1])
 
 
 def parse_openmetrics_flag(value) -> bool:
@@ -351,6 +435,46 @@ solve_deadline_shed_total = SCHEDULER.counter(
     "solve_deadline_shed_total",
     "SOLVE_REQUESTs shed because their deadline expired before the solve "
     "could start (the caller already timed out; running it helps nobody)")
+round_flight_overwritten = SCHEDULER.counter(
+    "round_flight_overwritten_total",
+    "Flight records evicted by ring overwrite (dump reasons are "
+    "counted; silent eviction was not, ISSUE 5).  A full ring evicts "
+    "one record per round: size the ring so this rate times your "
+    "/debug/rounds polling interval stays well under the ring capacity, "
+    "or evicted rounds were never observable")
+
+# -- SLO burn-rate engine (slo_monitor.py) --
+slo_burn_rate = SCHEDULER.gauge(
+    "slo_burn_rate",
+    "Error-budget burn rate per SLO and window (labels: slo, "
+    "window=fast|slow); 1.0 = burning exactly the budget, >>1 = paging")
+slo_breached = SCHEDULER.gauge(
+    "slo_breached",
+    "1 while the SLO's fast-burn alert is firing (label: slo); cleared "
+    "with hysteresis once the fast window cools")
+slo_alerts_total = SCHEDULER.counter(
+    "slo_alerts_total",
+    "SLO alert transitions (labels: slo, phase=fire|clear)")
+
+# -- JAX solver introspection (ops/introspection.py) --
+solver_recompiles = SCHEDULER.counter(
+    "solver_recompiles_total",
+    "Jit-cache misses (trace+compile) of the solver's jitted entry "
+    "points per shape bucket (labels: fn, shape) — a steady-state "
+    "scheduler should sit at zero rate; increments mean shape churn")
+solver_jit_cache_size = SCHEDULER.gauge(
+    "solver_jit_cache_size",
+    "Live jit-cache entries per instrumented solver entry point "
+    "(label: fn); bounded by the power-of-two shape bucketing")
+solver_device_bytes = SCHEDULER.gauge(
+    "solver_device_bytes",
+    "Device-resident bytes of the solver's persistent tensors (label: "
+    "kind=cluster_state|candidate_cache)")
+solver_batch_padding_waste = SCHEDULER.gauge(
+    "solver_batch_padding_waste",
+    "Padding-waste fraction of the last PodBatch: (capacity - live "
+    "pods) / capacity — the device memory and FLOPs spent on rows the "
+    "power-of-two bucketing padded in")
 
 be_suppress_cpu_cores = KOORDLET.gauge(
     "be_suppress_cpu_cores", "CPU cores currently allowed for BE")

@@ -1,0 +1,179 @@
+"""JAX solver introspection: recompiles, device bytes, profiler capture.
+
+Three answers a self-observing scheduler needs when the latency SLO
+burns (slo_monitor.py) — is it recompiles, device memory pressure, or
+something else:
+
+- :func:`instrument` wraps a jitted entry point and counts jit-cache
+  misses (a trace+compile happened) per shape bucket into
+  ``solver_recompiles_total{fn, shape}`` plus a live
+  ``solver_jit_cache_size{fn}`` gauge.  The power-of-two bucketing in
+  state/cluster_state bounds compiles to O(log N) over cluster life; a
+  nonzero steady-state recompile RATE is exactly the regression the
+  incremental-solve design must catch, not assume away.
+- :func:`device_bytes` sums the device-resident footprint of any pytree
+  (``ClusterState``, ``CandidateCache``) from array metadata — no
+  transfer, no sync.
+- :class:`ProfilerCapture` exposes ``jax.profiler`` start/stop as an
+  on-demand, **gated-off-by-default** capture for the
+  ``/debug/profile?seconds=N`` endpoint (a production scheduler must
+  not let any caller start a device trace unless the operator enabled
+  the gate at assembly).
+"""
+
+from __future__ import annotations
+
+import math
+import tempfile
+import threading
+import time
+
+from koordinator_tpu import metrics
+
+
+def default_shape_of(args, kwargs) -> str:
+    """Fallback shape-bucket label: the distinct leaf shapes of the
+    positional args, largest first, capped for label sanity."""
+    import jax
+
+    shapes = set()
+    for leaf in jax.tree.leaves(args):
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            shapes.add(tuple(int(d) for d in shape))
+    top = sorted(shapes,
+                 key=lambda s: (-int(math.prod(s or (1,))), s))[:3]
+    return "/".join("x".join(map(str, s)) if s else "scalar" for s in top)
+
+
+class InstrumentedJit:
+    """Callable wrapper over a jitted function that observes its jit
+    cache: a call that grows the cache was a miss (trace+compile), and
+    the miss is attributed to the caller-derived shape bucket.
+
+    The wrapper is pass-through — donation, static args, and outputs
+    behave exactly as on the wrapped function.  When the runtime does
+    not expose a cache-size probe the wrapper degrades to a plain
+    forward (counting nothing, costing one attribute check).
+    """
+
+    def __init__(self, fn, name: str, shape_of=None):
+        self.fn = fn
+        self.name = name
+        self.shape_of = shape_of or default_shape_of
+        self._probe = getattr(fn, "_cache_size", None)
+        self.misses = 0
+
+    def _cache_size(self) -> int | None:
+        if self._probe is None:
+            return None
+        try:
+            return int(self._probe())
+        except Exception:  # noqa: BLE001 — probe is best-effort
+            return None
+
+    def __call__(self, *args, **kwargs):
+        before = self._cache_size()
+        out = self.fn(*args, **kwargs)
+        after = self._cache_size()
+        if before is not None and after is not None and after > before:
+            try:
+                shape = self.shape_of(args, kwargs)
+            except Exception:  # noqa: BLE001 — labeling must not fail a solve
+                shape = "unknown"
+            self.misses += after - before
+            metrics.solver_recompiles.inc(
+                after - before, labels={"fn": self.name, "shape": shape})
+            metrics.solver_jit_cache_size.set(
+                float(after), labels={"fn": self.name})
+        return out
+
+
+def instrument(fn, name: str, shape_of=None) -> InstrumentedJit:
+    """Wrap a jitted entry point for recompile accounting.
+
+    ``shape_of(args, kwargs) -> str`` names the shape bucket; callers
+    with a known signature should pass one (e.g. ``P{batch}xN{nodes}``)
+    — the default derives a generic label from leaf shapes."""
+    return InstrumentedJit(fn, name, shape_of=shape_of)
+
+
+def device_bytes(tree) -> int:
+    """Total ``nbytes`` of the array leaves of a pytree (0 for None).
+    Metadata-only: never blocks on or transfers device buffers."""
+    if tree is None:
+        return 0
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+class ProfileDisabled(Exception):
+    """The profiling endpoint gate is off (the default)."""
+
+
+class ProfileBusy(Exception):
+    """A capture is already in flight (jax allows one trace at a time)."""
+
+
+class ProfilerCapture:
+    """On-demand ``jax.profiler`` trace capture behind an explicit gate.
+
+    ``enabled=False`` (the default) refuses every capture with
+    :class:`ProfileDisabled` — the endpoint ships dark and an operator
+    turns it on at assembly (``--enable-profile-endpoint``).  Captures
+    serialize on a lock and are clamped to ``max_seconds``.
+    ``profiler``/``sleep`` are injectable for tests.
+    """
+
+    def __init__(self, enabled: bool = False, out_dir: str | None = None,
+                 max_seconds: float = 30.0, profiler=None, sleep=time.sleep):
+        self.enabled = enabled
+        self.out_dir = out_dir
+        self.max_seconds = max_seconds
+        self._profiler = profiler
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self.captures = 0
+
+    def _jax_profiler(self):
+        if self._profiler is not None:
+            return self._profiler
+        import jax.profiler
+
+        return jax.profiler
+
+    def capture(self, seconds: float) -> dict:
+        """Run one trace for ``seconds`` (clamped to (0, max_seconds]);
+        returns ``{"dir", "seconds"}`` where ``dir`` holds the
+        TensorBoard-loadable trace."""
+        if not self.enabled:
+            raise ProfileDisabled(
+                "profiling endpoint disabled (enable at assembly with "
+                "--enable-profile-endpoint)")
+        seconds = float(seconds)
+        if not math.isfinite(seconds):
+            # nan survives min/max clamping and would start a trace
+            # only to die inside sleep()
+            raise ValueError("seconds must be finite")
+        seconds = min(max(seconds, 0.001), self.max_seconds)
+        if not self._lock.acquire(blocking=False):
+            raise ProfileBusy("a profiler capture is already running")
+        try:
+            out_dir = self.out_dir or tempfile.mkdtemp(
+                prefix="koord-jax-profile-")
+            profiler = self._jax_profiler()
+            profiler.start_trace(out_dir)
+            try:
+                self._sleep(seconds)
+            finally:
+                profiler.stop_trace()
+            self.captures += 1
+            return {"dir": out_dir, "seconds": seconds}
+        finally:
+            self._lock.release()

@@ -72,6 +72,18 @@ class FlightRecorder:
         self.slow_threshold_s = slow_threshold_s
         self.records: deque[RoundRecord] = deque(maxlen=capacity)
         self.dumps = 0
+        self.overwrites = 0
+
+    def _dump(self, rec: RoundRecord, reason: str) -> None:
+        """The dump side effects — ONE home for the counter label, the
+        bookkeeping, and the log line, shared by the automatic
+        slow/degraded path and external triggers."""
+        if rec.dump_reason is None:
+            rec.dump_reason = reason
+        self.dumps += 1
+        metrics.round_flight_dumps.inc(labels={"reason": reason})
+        logger.warning("round flight record (%s): %s", reason,
+                       json.dumps(rec.to_doc(), default=str))
 
     def record(self, rec: RoundRecord) -> None:
         reason = None
@@ -80,12 +92,25 @@ class FlightRecorder:
         elif rec.degraded:
             reason = "degraded"
         if reason is not None:
-            rec.dump_reason = reason
-            self.dumps += 1
-            metrics.round_flight_dumps.inc(labels={"reason": reason})
-            logger.warning("round flight record (%s): %s", reason,
-                           json.dumps(rec.to_doc(), default=str))
+            self._dump(rec, reason)
+        if len(self.records) == self.capacity:
+            # the ring is about to evict its oldest record — dump
+            # reasons are counted above, but silent eviction was
+            # invisible until this counter (ISSUE 5 satellite)
+            self.overwrites += 1
+            metrics.round_flight_overwritten.inc()
         self.records.append(rec)
+
+    def dump_now(self, reason: str) -> bool:
+        """Dump the most recent record on an external trigger (the SLO
+        burn-rate engine's fast-burn breach) with the trigger's reason
+        (e.g. ``slo:scheduling_latency_p99``).  False when no round has
+        been recorded yet."""
+        rec = self.last()
+        if rec is None:
+            return False
+        self._dump(rec, reason)
+        return True
 
     def snapshot(self, limit: Optional[int] = None) -> list[dict]:
         """Newest-first record docs (the /debug/rounds body)."""

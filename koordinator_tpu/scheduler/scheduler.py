@@ -147,6 +147,7 @@ class Scheduler:
         staleness_threshold_sec: float | None = None,
         staleness_exit_sec: float | None = None,
         trace_pods: bool = False,
+        faults=None,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -198,10 +199,21 @@ class Scheduler:
         self._batch_host: dict | None = None
         # solve-state donation: the caller's self.snapshot.state is dead
         # the moment the call starts (XLA updates the (N, R) accounting
-        # in place) and is replaced wholesale by adopt_state right after
-        self._solve = jax.jit(gang_assign,
-                              static_argnames=("passes", "solver"),
-                              donate_argnums=(0,))
+        # in place) and is replaced wholesale by adopt_state right after.
+        # Every jitted entry point is wrapped for recompile accounting
+        # (ops/introspection): a cache miss lands in
+        # solver_recompiles_total{fn, shape} so a shape-churn regression
+        # is a dashboard line, not a mystery latency spike.
+        from koordinator_tpu.ops import introspection as insp
+
+        def _pn(args, kwargs):
+            return f"P{args[1].capacity}xN{args[0].capacity}"
+
+        self._solve = insp.instrument(
+            jax.jit(gang_assign,
+                    static_argnames=("passes", "solver"),
+                    donate_argnums=(0,)),
+            "gang_assign", shape_of=_pn)
 
         # -- incremental delta-driven solve (no-gang batch rounds) --
         from koordinator_tpu.ops import batch_assign as _ba
@@ -228,22 +240,39 @@ class Scheduler:
         #: its candidate tie-break rotation when the queue shifts around it
         self._rot_ids: dict[str, int] = {}
         self._rot_counter = 0
-        self._select_scored = jax.jit(
-            _ba.select_candidates,
-            static_argnames=("k", "spread_bits", "method", "with_scores"))
-        self._align_cands = jax.jit(_ba.align_candidate_cache)
-        self._refresh_cands = jax.jit(
-            _ba.refresh_candidates, static_argnames=("k", "spread_bits"),
-            donate_argnums=(3,))
-        self._scatter_cands = jax.jit(_ba.scatter_candidate_rows,
-                                      donate_argnums=(0,))
-        self._pass1 = jax.jit(_ba.assign_round_pass,
-                              static_argnames=("rounds",),
-                              donate_argnums=(0,))
-        self._pass2 = jax.jit(
-            _ba.assign_followup_pass,
-            static_argnames=("k", "rounds", "spread_bits", "method"),
-            donate_argnums=(0, 1))
+        self._select_scored = insp.instrument(
+            jax.jit(_ba.select_candidates,
+                    static_argnames=("k", "spread_bits", "method",
+                                     "with_scores")),
+            "select_candidates", shape_of=_pn)
+        self._align_cands = insp.instrument(
+            jax.jit(_ba.align_candidate_cache),
+            "align_candidate_cache",
+            shape_of=lambda a, k: (f"P{a[1].shape[0]}xN{a[3].shape[0]}"))
+        self._refresh_cands = insp.instrument(
+            jax.jit(_ba.refresh_candidates,
+                    static_argnames=("k", "spread_bits"),
+                    donate_argnums=(3,)),
+            "refresh_candidates",
+            shape_of=lambda a, k: (f"P{a[1].capacity}xN{a[0].capacity}"
+                                   f"xD{a[4].shape[0]}"))
+        self._scatter_cands = insp.instrument(
+            jax.jit(_ba.scatter_candidate_rows, donate_argnums=(0,)),
+            "scatter_candidate_rows",
+            shape_of=lambda a, k: (f"P{a[0].cand_key.shape[0]}"
+                                   f"xS{a[1].shape[0]}"))
+        self._pass1 = insp.instrument(
+            jax.jit(_ba.assign_round_pass,
+                    static_argnames=("rounds",),
+                    donate_argnums=(0,)),
+            "assign_round_pass", shape_of=_pn)
+        self._pass2 = insp.instrument(
+            jax.jit(_ba.assign_followup_pass,
+                    static_argnames=("k", "rounds", "spread_bits",
+                                     "method"),
+                    donate_argnums=(0, 1)),
+            "assign_followup_pass",
+            shape_of=lambda a, k: f"P{a[2].capacity}xN{a[0].capacity}")
         #: reservation lifecycle (plugins/reservation parity): reserve-pods
         #: schedule through the normal rounds, Available sets get a
         #: reservation-first exact solve pre-pass
@@ -251,8 +280,9 @@ class Scheduler:
         from koordinator_tpu.scheduler.reservations import ReservationCache
 
         self.reservations = ReservationCache()
-        self._rsv_solve = jax.jit(reservation_greedy_assign,
-                                  donate_argnums=(0,))
+        self._rsv_solve = insp.instrument(
+            jax.jit(reservation_greedy_assign, donate_argnums=(0,)),
+            "reservation_greedy_assign", shape_of=_pn)
         #: fine-grained allocators (nodenumaresource / deviceshare Reserve):
         #: LSR/LSE pods take exclusive cpusets, device requests take minors
         #: at bind; annotation payloads surface in resource_status
@@ -355,6 +385,24 @@ class Scheduler:
         self._last_dirty_pod_frac = 0.0
         self._last_staleness_s: float | None = None
         self._round_recordable = False
+
+        # -- self-observability (ISSUE 5) --
+        #: chaos-harness fault injector (transport.faults.FaultInjector);
+        #: the Solve phase consults on_solve() when attached — None (the
+        #: default) costs one attribute check per round
+        self.faults = faults
+        #: SloMonitor attached by the binary assembly (serves /debug/slo
+        #: and fires flight-recorder dumps on fast-burn breaches)
+        self.slo_monitor = None
+        #: introspection.ProfilerCapture behind /debug/profile; None =
+        #: the endpoint answers 403 (gated off by default)
+        self.profile_capture = None
+
+    def stop(self) -> None:
+        """Assembly-level teardown (Assembled.stop): stops the attached
+        SLO sampler thread when one is running."""
+        if self.slo_monitor is not None:
+            self.slo_monitor.stop()
 
     # -- registration -------------------------------------------------------
 
@@ -1118,6 +1166,20 @@ class Scheduler:
                     phase_s=dict(self.monitor.round_timings),
                     sheds_total=metrics.solve_deadline_shed_total.value(),
                 ))
+            if self._round_recordable:
+                # device-resident footprint of the persistent solver
+                # tensors, from array metadata only (no sync): the
+                # live-bytes half of the introspection surface
+                from koordinator_tpu.ops import introspection as insp
+
+                metrics.solver_device_bytes.set(
+                    float(insp.device_bytes(self.snapshot.state)),
+                    labels={"kind": "cluster_state"})
+                cand = self._cand_cache
+                metrics.solver_device_bytes.set(
+                    float(insp.device_bytes(
+                        cand["cache"] if cand else None)),
+                    labels={"kind": "candidate_cache"})
             return result
 
     def _schedule_round(self) -> SchedulingResult:
@@ -1181,6 +1243,10 @@ class Scheduler:
             quota, quota_index = self._build_quota()
             batch = self._build_batch(pods, gang_index, quota_index)
             batch = self._apply_topology_plans(batch, gang_index)
+            # padding-waste fraction of the power-of-two pod bucketing:
+            # device memory/FLOPs spent on rows no pod occupies
+            metrics.solver_batch_padding_waste.set(
+                1.0 - len(pods) / max(batch.capacity, 1))
 
         if (self.debug_service is not None
                 and self.debug_service.dump_top_n_scores > 0):
@@ -1202,6 +1268,12 @@ class Scheduler:
 
         try:
             with self.monitor.phase("Solve"):
+                if self.faults is not None:
+                    # chaos seam: an injected solve delay lands in this
+                    # phase's scheduling_duration observation — the
+                    # synthetic latency regression the SLO engine's
+                    # burn windows must catch (tests/test_slo_monitor)
+                    self.faults.on_solve()
                 if len(self.reservations):
                     batch, quota = self._reservation_prepass(
                         pods, batch, quota, result)

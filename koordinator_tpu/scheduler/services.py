@@ -19,10 +19,58 @@ from typing import Callable, Optional
 import numpy as np
 
 
+class DebugApiError(Exception):
+    """A debug route failing with a SPECIFIC status (gate closed, busy)
+    instead of the blanket 500 — both HTTP surfaces map it verbatim."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
 def debug_rounds_body(scheduler, size: int) -> dict:
     """The /debug/rounds payload — ONE builder shared by DebugService
     and the HTTP gateway so the two surfaces cannot drift."""
     return {"rounds": scheduler.flight_recorder.snapshot(size)}
+
+
+def debug_slo_body(scheduler) -> dict:
+    """The /debug/slo payload (shared by DebugService and the HTTP
+    gateway): the SLO burn-rate engine's latest evaluation."""
+    monitor = getattr(scheduler, "slo_monitor", None)
+    if monitor is None:
+        raise DebugApiError(501, "no SLO monitor attached "
+                                 "(scheduler binaries only)")
+    return monitor.report()
+
+
+def debug_profile_body(scheduler, seconds) -> dict:
+    """The /debug/profile?seconds=N payload: an on-demand jax.profiler
+    capture.  403 while the gate is off (the default), 409 while a
+    capture is in flight — shared by both HTTP surfaces."""
+    from koordinator_tpu.ops.introspection import ProfileBusy, ProfileDisabled
+
+    capture = getattr(scheduler, "profile_capture", None)
+    if capture is None:
+        raise DebugApiError(403, "profiling endpoint disabled (enable at "
+                                 "assembly with --enable-profile-endpoint)")
+    import math
+
+    try:
+        seconds_f = float(seconds)
+    except (TypeError, ValueError):
+        raise DebugApiError(400, "seconds must be a number") from None
+    if not math.isfinite(seconds_f):
+        # nan survives float() and min/max clamping — it would start a
+        # trace and then die in sleep() as a blanket 500
+        raise DebugApiError(400, "seconds must be finite")
+    try:
+        return capture.capture(seconds_f)
+    except ProfileDisabled as e:
+        raise DebugApiError(403, str(e)) from None
+    except ProfileBusy as e:
+        raise DebugApiError(409, str(e)) from None
 
 
 def debug_trace_body(scheduler, pod: str) -> Optional[dict]:
@@ -84,6 +132,8 @@ class DebugService:
                     rest = path[len(prefix):]
                     try:
                         return 200, ph(rest, params or {})
+                    except DebugApiError as e:
+                        return e.status, {"error": e.message}
                     except KeyError as e:
                         return 404, {"error": str(e)}
                     except Exception as e:  # noqa: BLE001
@@ -91,6 +141,8 @@ class DebugService:
             return 404, {"error": f"no route {path}"}
         try:
             return 200, handler(params or {})
+        except DebugApiError as e:
+            return e.status, {"error": e.message}
         except Exception as e:  # noqa: BLE001 — debug API must not crash
             return 500, {"error": str(e)}
 
@@ -108,6 +160,8 @@ class DebugService:
         self.register("/apis/v1/__debug/set-top-n", self._set_top_n)
         self.register("/metrics", self._metrics)
         self.register("/debug/rounds", self._rounds)
+        self.register("/debug/slo", self._slo)
+        self.register("/debug/profile", self._profile)
         self.register_prefix("/debug/trace/", self._trace)
 
     def _nodes(self, params: dict) -> object:
@@ -198,6 +252,16 @@ class DebugService:
         """The round flight recorder, newest first (?size=N)."""
         return debug_rounds_body(self.scheduler,
                                  int(params.get("size", 32)))
+
+    def _slo(self, params: dict) -> object:
+        """The SLO burn-rate engine's evaluation (/debug/slo)."""
+        return debug_slo_body(self.scheduler)
+
+    def _profile(self, params: dict) -> object:
+        """On-demand jax.profiler capture (/debug/profile?seconds=N);
+        403 unless the gate was enabled at assembly."""
+        return debug_profile_body(self.scheduler,
+                                  params.get("seconds", 1.0))
 
     def _trace(self, pod: str, params: dict) -> object:
         """Recent spans of one pod's trace (/debug/trace/<pod>)."""

@@ -62,6 +62,13 @@ class FaultConfig:
     #: each recv() chunk: sleep first (slow-drip read)
     read_drip_p: float = 0.0
     read_drip_ms: float = 2.0
+    #: scheduler Solve phase: sleep before dispatching the solve (the
+    #: synthetic latency regression the SLO burn-rate engine must
+    #: detect — a real one would be a recompile storm or device
+    #: contention; the injected delay is indistinguishable to the
+    #: scheduling_duration_seconds observer)
+    solve_delay_p: float = 0.0
+    solve_delay_ms: float = 0.0
 
 
 class FaultInjector:
@@ -118,6 +125,17 @@ class FaultInjector:
         if self._hit(self.config.read_drip_p):
             self._count("read_drip")
             self._sleep(self.config.read_drip_ms / 1000.0)
+
+    # -- scheduler seam ------------------------------------------------------
+
+    def on_solve(self) -> None:
+        """Called at the top of the scheduler's Solve phase when an
+        injector is attached (``Scheduler(faults=...)``): a hit sleeps
+        ``solve_delay_ms``, landing squarely in the round's
+        ``scheduling_duration_seconds{phase="Solve"}`` observation."""
+        if self._hit(self.config.solve_delay_p):
+            self._count("solve_delay")
+            self._sleep(self.config.solve_delay_ms / 1000.0)
 
     # -- server _Conn seam ---------------------------------------------------
 

@@ -24,6 +24,13 @@ Routes (all JSON bodies/responses unless noted):
                                           recorder, newest first
     GET  /debug/trace/<pod>            -> recent spans of the pod's
                                           trace (scheduler binaries)
+    GET  /debug/slo                    -> the SLO burn-rate engine's
+                                          evaluation (specs, windows,
+                                          burn rates, breach state)
+    GET  /debug/profile?seconds=N      -> on-demand jax.profiler
+                                          capture; 403 unless enabled
+                                          at assembly (gated off by
+                                          default)
     POST /v1/state                     -> one state event (the STATE_PUSH
                                           frame's JSON form: {"kind",
                                           "name", resource vectors as
@@ -165,6 +172,10 @@ class HttpGateway:
             return self._metrics(req)
         if method == "GET" and path == "/debug/rounds":
             return self._debug_rounds(req)
+        if method == "GET" and path == "/debug/slo":
+            return self._debug_slo(req)
+        if method == "GET" and path == "/debug/profile":
+            return self._debug_profile(req)
         m = self._TRACE.match(path)
         if m and method == "GET":
             return self._debug_trace(req, m.group(1))
@@ -281,6 +292,41 @@ class HttpGateway:
         except ValueError:
             return req._reply(400, {"error": "size must be an int"})
         return req._reply(200, debug_rounds_body(self.scheduler, size))
+
+    def _debug_slo(self, req) -> None:
+        """The SLO burn-rate engine's evaluation — same body the
+        DebugService serves (shared builder)."""
+        if self.scheduler is None:
+            return req._reply(501, {"error": "no scheduler attached"})
+        from koordinator_tpu.scheduler.services import (
+            DebugApiError,
+            debug_slo_body,
+        )
+
+        try:
+            return req._reply(200, debug_slo_body(self.scheduler))
+        except DebugApiError as e:
+            return req._reply(e.status, {"error": e.message})
+
+    def _debug_profile(self, req) -> None:
+        """On-demand jax.profiler capture (?seconds=N), 403 while the
+        assembly-time gate is off — the default."""
+        if self.scheduler is None:
+            return req._reply(501, {"error": "no scheduler attached"})
+        from urllib.parse import parse_qs
+
+        from koordinator_tpu.scheduler.services import (
+            DebugApiError,
+            debug_profile_body,
+        )
+
+        query = parse_qs(req.path.partition("?")[2])
+        seconds = query.get("seconds", ["1.0"])[0]
+        try:
+            return req._reply(200,
+                              debug_profile_body(self.scheduler, seconds))
+        except DebugApiError as e:
+            return req._reply(e.status, {"error": e.message})
 
     def _debug_trace(self, req, pod: str) -> None:
         if self.scheduler is None:

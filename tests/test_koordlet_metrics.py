@@ -81,6 +81,74 @@ class TestMetricCache:
         assert cache.get_kv("numa") == {"nodes": 2}
 
 
+class TestMetricCacheRetentionAndDownsampling:
+    """Retention/downsampling boundaries (ISSUE 5 satellite): only the
+    happy path was covered before."""
+
+    def test_exact_horizon_sample_kept_one_older_evicted(self, clock):
+        cache = mc.MetricCache(clock=clock, retention_sec=60.0)
+        clock.t = 1060.0
+        cache.append(mc.NODE_CPU_USAGE, 1.0, ts=999.9)    # one older
+        cache.append(mc.NODE_CPU_USAGE, 2.0, ts=1000.0)   # exactly at horizon
+        cache.append(mc.NODE_CPU_USAGE, 3.0, ts=1030.0)
+        res = cache.query(mc.NODE_CPU_USAGE, start=0, end=2000)
+        # the sample AT now - retention is served; the one strictly
+        # older is not, even though the ring still physically holds it
+        assert res.count == 2
+        assert sorted(res.values.tolist()) == [2.0, 3.0]
+
+    def test_retention_moves_with_the_clock(self, clock):
+        cache = mc.MetricCache(clock=clock, retention_sec=60.0)
+        clock.t = 1000.0
+        cache.append(mc.NODE_CPU_USAGE, 1.0, ts=1000.0)
+        assert cache.query(mc.NODE_CPU_USAGE, start=0).count == 1
+        clock.tick(61.0)
+        assert cache.query(mc.NODE_CPU_USAGE, start=0).count == 0
+
+    def test_no_retention_serves_everything(self, clock):
+        cache = mc.MetricCache(clock=clock)   # retention_sec=None
+        cache.append(mc.NODE_CPU_USAGE, 1.0, ts=1.0)
+        clock.t = 10_000.0
+        assert cache.query(mc.NODE_CPU_USAGE, start=0).count == 1
+
+    def test_empty_window_aggregates_are_sentinels_not_nan(self, clock):
+        import math
+
+        cache = mc.MetricCache(clock=clock)
+        cache.append(mc.NODE_CPU_USAGE, 5.0, ts=1000.0)
+        res = cache.query(mc.NODE_CPU_USAGE, start=2000, end=3000)
+        assert res.empty and res.count == 0
+        for value in (res.avg(), res.latest(), res.first(), res.max(),
+                      res.percentile(0.99), res.duration_seconds()):
+            assert value == 0.0
+            assert not math.isnan(value)
+        # a never-written series behaves identically
+        ghost = cache.query("never_written")
+        assert ghost.empty and not math.isnan(ghost.avg())
+
+    def test_downsample_mean_per_bin(self, clock):
+        cache = mc.MetricCache(clock=clock)
+        for i in range(10):   # ts 1000..1009, values 0..9
+            cache.append(mc.NODE_CPU_USAGE, float(i), ts=1000.0 + i)
+        res = cache.query(mc.NODE_CPU_USAGE, start=0, end=2000)
+        down = res.downsample(5.0)
+        assert down.count == 2
+        assert down.values.tolist() == [
+            pytest.approx(2.0), pytest.approx(7.0)]
+        assert down.ts.tolist() == [
+            pytest.approx(1002.0), pytest.approx(1007.0)]
+        # aggregates keep working on the downsampled view
+        assert down.avg() == pytest.approx(4.5)
+
+    def test_downsample_noop_cases(self, clock):
+        cache = mc.MetricCache(clock=clock)
+        empty = cache.query(mc.NODE_CPU_USAGE)
+        assert empty.downsample(5.0) is empty
+        cache.append(mc.NODE_CPU_USAGE, 1.0, ts=1000.0)
+        res = cache.query(mc.NODE_CPU_USAGE, start=0, end=2000)
+        assert res.downsample(0.0) is res
+
+
 def write_proc(cfg, used_jiffies, mem_used_kb=400, mem_total_kb=1000):
     os.makedirs(cfg.proc_root, exist_ok=True)
     with open(cfg.proc_path("stat"), "w") as f:

@@ -26,8 +26,11 @@ class TestInstruments:
         h = Histogram("lat", buckets=(0.1, 0.5, 1.0))
         for v in (0.05, 0.05, 0.2, 0.8):
             h.observe(v)
-        assert h.quantile(0.5) == 0.1
-        assert h.quantile(0.99) == 1.0
+        # interpolated within the containing bucket (Prometheus
+        # histogram_quantile): rank 2 tops out bucket [0, 0.1]
+        assert h.quantile(0.5) == pytest.approx(0.1)
+        # rank 3.96 -> bucket (0.5, 1.0]: 0.5 + 0.5 * 0.96
+        assert h.quantile(0.99) == pytest.approx(0.98)
 
     def test_exposition_format(self):
         r = Registry("test")
@@ -47,6 +50,61 @@ class TestInstruments:
         r.counter("x")
         with pytest.raises(ValueError):
             r.gauge("x")
+
+
+class TestHistogramQuantileInterpolation:
+    """Bucket-interpolated quantiles from exposition state (ISSUE 5
+    satellite): the SLO engine and tests compute p99 from the same
+    math, including the +Inf-bucket edge cases."""
+
+    def test_uniform_within_one_bucket(self):
+        h = Histogram("lat", buckets=(1.0, 2.0, 4.0))
+        for v in (1.2, 1.4, 1.6, 1.8):   # all in (1.0, 2.0]
+            h.observe(v)
+        # rank q*4 interpolates linearly across the (1.0, 2.0] bucket
+        assert h.quantile(0.5) == pytest.approx(1.5)
+        assert h.quantile(0.25) == pytest.approx(1.25)
+        assert h.quantile(1.0) == pytest.approx(2.0)
+
+    def test_first_bucket_interpolates_from_zero(self):
+        h = Histogram("lat", buckets=(0.4, 1.0))
+        h.observe(0.1)
+        h.observe(0.3)
+        assert h.quantile(0.5) == pytest.approx(0.2)   # 0 + 0.4 * (1/2)
+
+    def test_inf_bucket_clamps_to_highest_finite_bound(self):
+        h = Histogram("lat", buckets=(0.1, 0.5))
+        for v in (7.0, 9.0, 11.0):   # every observation beyond 0.5
+            h.observe(v)
+        # the quantile of data the buckets cannot resolve is the best
+        # bound they CAN name (Prometheus behavior), never inf/NaN
+        assert h.quantile(0.5) == 0.5
+        assert h.quantile(0.99) == 0.5
+
+    def test_mixed_finite_and_inf_observations(self):
+        h = Histogram("lat", buckets=(0.1, 0.5))
+        for v in (0.05, 0.05, 0.05, 9.0):
+            h.observe(v)
+        assert h.quantile(0.5) <= 0.1
+        assert h.quantile(0.99) == 0.5   # rank lands in +Inf -> clamp
+
+    def test_empty_histogram_sentinel(self):
+        h = Histogram("lat", buckets=(0.1,))
+        assert h.quantile(0.99) == 0.0
+
+    def test_exact_bucket_boundary_counts(self):
+        from koordinator_tpu.metrics import count_at_or_below
+
+        bounds, cum = [0.1, 0.5, 1.0], [2.0, 6.0, 8.0]
+        assert count_at_or_below(bounds, cum, 8, 0.5) == pytest.approx(6.0)
+        # halfway through the (0.1, 0.5] bucket: 2 + 4 * 0.5
+        assert count_at_or_below(bounds, cum, 8, 0.3) == pytest.approx(4.0)
+        # at/above the last finite bound: only what the buckets PROVE
+        # is below — the 2 +Inf residents stay bad (a threshold >= the
+        # last bound must not bless observations the buckets can't see)
+        assert count_at_or_below(bounds, cum, 10, 1.0) == 8.0
+        assert count_at_or_below(bounds, cum, 10, 2.0) == 8.0
+        assert count_at_or_below(bounds, cum, 0, 0.5) == 0.0
 
 
 class TestExpositionConformance:
@@ -132,45 +190,68 @@ class TestWiring:
         assert pod_eviction_total.value({"reason": "test-reason"}) == before + 1
 
 
+def _load_check_dashboards():
+    """Import tools/check_dashboards.py (tools/ is not a package)."""
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "check_dashboards.py")
+    spec = importlib.util.spec_from_file_location("check_dashboards", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 class TestDashboards:
     """Shipped Grafana dashboards (dashboards/*.json) must reference only
-    metric series that the registries actually register (reference ships
-    dashboards/scheduling.json + descheduling.json)."""
+    metric series that the registries actually register — enforced by
+    the standalone drift tool (tools/check_dashboards.py), which
+    tools/soak.sh also runs at the head of every soak."""
 
-    def _series_names(self):
-        from koordinator_tpu import metrics as m
-
-        names = set()
-        for reg in (m.SCHEDULER, m.KOORDLET, m.MANAGER, m.DESCHEDULER,
-                    m.TRANSPORT):
-            for full, metric in reg._metrics.items():
-                names.add(full)
-                if isinstance(metric, m.Histogram):
-                    names.update({f"{full}_bucket", f"{full}_sum",
-                                  f"{full}_count"})
-        return names
-
-    def test_dashboard_exprs_use_registered_metrics(self):
-        import glob
-        import json
-        import os
-        import re
-
-        root = os.path.join(os.path.dirname(__file__), "..", "dashboards")
-        files = sorted(glob.glob(os.path.join(root, "*.json")))
-        assert len(files) >= 2, "scheduling + descheduling dashboards"
-        known = self._series_names()
-        checked = 0
-        for path in files:
-            doc = json.load(open(path))
-            for panel in doc.get("panels", []):
-                for target in panel.get("targets", []):
-                    for name in re.findall(
-                            r"(koord_[a-z0-9_]+|koordlet_[a-z0-9_]+)",
-                            target["expr"]):
-                        assert name in known, (path, name)
-                        checked += 1
+    def test_shipped_dashboards_pass_the_drift_check(self):
+        tool = _load_check_dashboards()
+        errors, checked = tool.check_dashboards()
+        assert errors == []
+        # the extractor actually extracted something — a regex/schema
+        # rot must not degrade the check into a rubber stamp
         assert checked > 10
+
+    def test_bogus_metric_fails_the_drift_check(self, tmp_path):
+        import json
+
+        tool = _load_check_dashboards()
+        dash = tmp_path / "bogus.json"
+        dash.write_text(json.dumps({"panels": [{
+            "title": "drifted",
+            "targets": [
+                {"expr": "sum(rate(koord_scheduler_totally_bogus_total"
+                         "[5m]))"},
+                {"expr": "max(koord_scheduler_pending_pods)"},
+            ]}]}))
+        errors, checked = tool.check_dashboards([str(dash)])
+        assert checked == 2
+        assert len(errors) == 1
+        assert "koord_scheduler_totally_bogus_total" in errors[0]
+        assert "drifted" in errors[0]
+
+    def test_tool_exits_nonzero_on_drift(self, tmp_path):
+        import json
+
+        tool = _load_check_dashboards()
+        dash = tmp_path / "bogus.json"
+        dash.write_text(json.dumps({"panels": [{
+            "title": "p", "targets": [
+                {"expr": "koordlet_metric_nobody_registered"}]}]}))
+        assert tool.main([str(dash)]) == 1
+        assert tool.main([]) == 0   # the CLI path over the shipped set
+
+    def test_unreadable_dashboard_is_an_error_not_a_crash(self, tmp_path):
+        tool = _load_check_dashboards()
+        bad = tmp_path / "broken.json"
+        bad.write_text("{not json")
+        errors, _ = tool.check_dashboards([str(bad)])
+        assert len(errors) == 1 and "unreadable" in errors[0]
 
     def test_monitor_feeds_prometheus_histograms(self):
         from koordinator_tpu import metrics as m

@@ -1,0 +1,474 @@
+"""SLO burn-rate engine (ISSUE 5): windows, alerts, /debug/slo, e2e.
+
+Covers the spec kinds' window math against hand-fed registries, the
+fire/clear hysteresis state machine, both /debug/slo surfaces, and the
+acceptance flow: a fault-injected slow solve (transport/faults.py
+``solve_delay``) drives the scheduler past the latency SLO — the fast
+burn fires within its window bound, /debug/slo names the offending SLO,
+a flight-recorder dump triggers, and the alert clears after recovery.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from koordinator_tpu import metrics
+from koordinator_tpu.api.resources import resource_vector
+from koordinator_tpu.scheduler import ClusterSnapshot, Scheduler
+from koordinator_tpu.scheduler.snapshot import NodeSpec, PodSpec
+from koordinator_tpu.slo_monitor import (
+    KIND_GAUGE,
+    KIND_LATENCY,
+    KIND_RATIO,
+    BurnWindow,
+    SloMonitor,
+    SloSpec,
+    default_specs,
+)
+
+
+class FakeClock:
+    def __init__(self, t=10_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def make_monitor(specs, registry, clock, **kw):
+    return SloMonitor(specs=specs, registries=(registry,), clock=clock,
+                      **kw)
+
+
+def latency_spec(**kw):
+    defaults = dict(
+        name="lat", description="p99 latency", kind=KIND_LATENCY,
+        metric="t_lat_seconds", threshold=0.2, objective=0.01,
+        fast=BurnWindow(window_s=60.0, fire_burn=14.4),
+        slow=BurnWindow(window_s=600.0, fire_burn=1.0))
+    defaults.update(kw)
+    return SloSpec(**defaults)
+
+
+class TestWindowMath:
+    def test_latency_bad_fraction_from_bucket_deltas(self):
+        reg = metrics.Registry("t")
+        h = reg.histogram("lat_seconds", buckets=(0.1, 0.25, 1.0))
+        clock = FakeClock()
+        mon = make_monitor([latency_spec()], reg, clock)
+        for _ in range(100):
+            h.observe(0.01)        # pre-window history
+        mon.sample_once()          # baseline cumulative counts
+        for _ in range(90):
+            h.observe(0.05)        # good
+        for _ in range(10):
+            h.observe(0.9)         # bad (> 0.2)
+        clock.tick(10.0)
+        report = mon.tick()
+        fast = report["slos"][0]["windows"]["fast"]
+        assert not fast["no_data"]
+        # windowed DELTA: only the 100 observations between the two
+        # samples count, not the pre-baseline history
+        assert fast["events"] == 100.0
+        # 10 observations above the 0.2 threshold, plus the interpolated
+        # 0.1-0.25-bucket share above 0.2 (zero here: that bucket is empty)
+        assert fast["bad_fraction"] == pytest.approx(0.10)
+        assert fast["burn_rate"] == pytest.approx(10.0)
+        assert fast["p99_s"] > 0.2
+
+    def test_latency_threshold_interpolates_inside_a_bucket(self):
+        reg = metrics.Registry("t")
+        h = reg.histogram("lat_seconds", buckets=(0.1, 0.3, 1.0))
+        clock = FakeClock()
+        mon = make_monitor([latency_spec()], reg, clock)
+        h.observe(0.15)       # seed the series, then baseline
+        mon.sample_once()
+        for _ in range(100):
+            h.observe(0.15)   # all land in the (0.1, 0.3] bucket
+        clock.tick(10.0)
+        fast = mon.tick()["slos"][0]["windows"]["fast"]
+        # threshold 0.2 bisects the bucket: half the mass counts bad
+        assert fast["bad_fraction"] == pytest.approx(0.5)
+
+    def test_latency_aggregates_across_label_sets(self):
+        reg = metrics.Registry("t")
+        # the threshold (0.2) is an exact bucket bound, so the bad
+        # fraction needs no interpolation: exactly the Bind observation
+        h = reg.histogram("lat_seconds", buckets=(0.1, 0.2, 1.0))
+        clock = FakeClock()
+        mon = make_monitor([latency_spec()], reg, clock)
+        h.observe(0.05, labels={"phase": "Solve"})   # seed both series
+        h.observe(0.9, labels={"phase": "Bind"})
+        mon.sample_once()
+        h.observe(0.05, labels={"phase": "Solve"})
+        h.observe(0.9, labels={"phase": "Bind"})
+        clock.tick(5.0)
+        fast = mon.tick()["slos"][0]["windows"]["fast"]
+        assert fast["events"] == 2.0
+        assert fast["bad_fraction"] == pytest.approx(0.5)
+
+    def test_threshold_at_last_bound_still_counts_inf_observations_bad(self):
+        """A threshold at/above the last finite bucket bound must not
+        bless +Inf-bucket observations: a 5s solve cannot satisfy a 1s
+        SLO just because the buckets stop at 1s (review finding)."""
+        reg = metrics.Registry("t")
+        h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+        clock = FakeClock()
+        mon = make_monitor([latency_spec(threshold=1.0)], reg, clock)
+        h.observe(0.05)
+        mon.sample_once()
+        h.observe(0.05)    # provably good
+        h.observe(5.0)     # +Inf bucket: unprovable -> bad
+        clock.tick(5.0)
+        fast = mon.tick()["slos"][0]["windows"]["fast"]
+        assert fast["events"] == 2.0
+        assert fast["bad_fraction"] == pytest.approx(0.5)
+
+    def test_single_sample_is_no_data_not_zero_burn_confidence(self):
+        reg = metrics.Registry("t")
+        h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+        h.observe(0.9)
+        clock = FakeClock()
+        mon = make_monitor([latency_spec()], reg, clock)
+        report = mon.tick()   # exactly one sample: no delta computable
+        fast = report["slos"][0]["windows"]["fast"]
+        assert fast["no_data"] is True
+        assert fast["burn_rate"] == 0.0
+        assert report["breached"] == []
+
+    def test_gauge_time_above_threshold(self):
+        reg = metrics.Registry("t")
+        g = reg.gauge("staleness_seconds")
+        clock = FakeClock()
+        spec = SloSpec(
+            name="stale", description="d", kind=KIND_GAUGE,
+            metric="t_staleness_seconds", threshold=30.0, objective=0.05,
+            fast=BurnWindow(window_s=100.0, fire_burn=14.4),
+            slow=BurnWindow(window_s=1000.0, fire_burn=1.0))
+        mon = make_monitor([spec], reg, clock)
+        for value in (1.0, 1.0, 45.0, 50.0):   # 2 of 4 samples above
+            g.set(value)
+            mon.sample_once()
+            clock.tick(10.0)
+        fast = mon.evaluate()["slos"][0]["windows"]["fast"]
+        assert fast["bad_fraction"] == pytest.approx(0.5)
+        assert fast["burn_rate"] == pytest.approx(10.0)
+
+    def test_ratio_counter_over_denominator(self):
+        reg = metrics.Registry("t")
+        shed = reg.counter("sheds_total")
+        rounds = reg.counter("rounds_total")
+        clock = FakeClock()
+        spec = SloSpec(
+            name="shed", description="d", kind=KIND_RATIO,
+            metric="t_sheds_total", denominator="t_rounds_total",
+            objective=0.01,
+            fast=BurnWindow(window_s=100.0, fire_burn=14.4),
+            slow=BurnWindow(window_s=1000.0, fire_burn=1.0))
+        mon = make_monitor([spec], reg, clock)
+        shed.inc(0)
+        rounds.inc(0)
+        mon.sample_once()
+        rounds.inc(50)
+        shed.inc(2)
+        clock.tick(10.0)
+        fast = mon.tick()["slos"][0]["windows"]["fast"]
+        assert fast["bad_fraction"] == pytest.approx(0.04)
+        assert fast["burn_rate"] == pytest.approx(4.0)
+        assert fast["denominator"] == 50.0
+
+    def test_ratio_zero_denominator_is_no_data(self):
+        reg = metrics.Registry("t")
+        reg.counter("sheds_total").inc(0)
+        reg.counter("rounds_total").inc(0)
+        clock = FakeClock()
+        spec = SloSpec(
+            name="shed", description="d", kind=KIND_RATIO,
+            metric="t_sheds_total", denominator="t_rounds_total",
+            objective=0.01)
+        mon = make_monitor([spec], reg, clock)
+        mon.sample_once()
+        clock.tick(5.0)
+        fast = mon.tick()["slos"][0]["windows"]["fast"]
+        assert fast["no_data"] is True
+
+
+class TestAlertStateMachine:
+    def _burning_monitor(self, clock):
+        """A latency monitor plus the knob to make it burn: observing
+        bad values then ticking.  The series is seeded before the
+        baseline sample (windowed deltas need two samples)."""
+        reg = metrics.Registry("t")
+        h = reg.histogram("lat_seconds", buckets=(0.1, 0.25, 1.0))
+        mon = make_monitor([latency_spec()], reg, clock)
+        h.observe(0.01)
+        return mon, h
+
+    def test_fire_clear_hysteresis(self):
+        clock = FakeClock()
+        fired = []
+        mon, h = self._burning_monitor(clock)
+        mon.on_breach = lambda spec, doc: fired.append(spec.name)
+        mon.sample_once()
+        for _ in range(10):
+            h.observe(0.9)
+        clock.tick(5.0)
+        report = mon.tick()
+        assert report["breached"] == ["lat"]
+        assert fired == ["lat"]
+        assert metrics.slo_breached.value({"slo": "lat"}) == 1.0
+        assert metrics.slo_alerts_total.value(
+            {"slo": "lat", "phase": "fire"}) == 1.0
+        # still burning next tick: no re-fire (one alert per breach)
+        clock.tick(5.0)
+        mon.tick()
+        assert metrics.slo_alerts_total.value(
+            {"slo": "lat", "phase": "fire"}) == 1.0
+        assert fired == ["lat"]
+        # recovery: good observations, the window slides past the bad
+        for _ in range(30):
+            h.observe(0.01)
+            clock.tick(5.0)
+            report = mon.tick()
+        assert report["breached"] == []
+        assert metrics.slo_breached.value({"slo": "lat"}) == 0.0
+        assert metrics.slo_alerts_total.value(
+            {"slo": "lat", "phase": "clear"}) == 1.0
+        state = report["slos"][0]
+        assert state["breaches_total"] == 1
+        assert state["peak_burn"]["fast"] >= 14.4
+
+    def test_burn_below_fire_threshold_never_alerts(self):
+        clock = FakeClock()
+        mon, h = self._burning_monitor(clock)
+        mon.sample_once()
+        # 5% bad of a 1% budget = burn 5 — over budget but under the
+        # 14.4 page threshold
+        for _ in range(95):
+            h.observe(0.01)
+        for _ in range(5):
+            h.observe(0.9)
+        clock.tick(5.0)
+        report = mon.tick()
+        fast = report["slos"][0]["windows"]["fast"]
+        assert fast["burn_rate"] == pytest.approx(5.0)
+        assert report["breached"] == []
+
+    def test_on_breach_exception_never_kills_evaluation(self):
+        clock = FakeClock()
+        mon, h = self._burning_monitor(clock)
+
+        def boom(spec, doc):
+            raise RuntimeError("observer bug")
+
+        mon.on_breach = boom
+        mon.sample_once()
+        for _ in range(10):
+            h.observe(0.9)
+        clock.tick(5.0)
+        report = mon.tick()   # must not raise
+        assert report["breached"] == ["lat"]
+
+    def test_peak_burn_and_gauges_per_window(self):
+        clock = FakeClock()
+        mon, h = self._burning_monitor(clock)
+        mon.sample_once()
+        for _ in range(10):
+            h.observe(0.9)
+        clock.tick(5.0)
+        mon.tick()
+        assert metrics.slo_burn_rate.value(
+            {"slo": "lat", "window": "fast"}) == pytest.approx(100.0)
+        assert metrics.slo_burn_rate.value(
+            {"slo": "lat", "window": "slow"}) == pytest.approx(100.0)
+
+
+class TestDefaultSpecsAndSampling:
+    def test_default_specs_reference_registered_metrics(self):
+        known = set()
+        for reg in metrics.ALL_REGISTRIES:
+            for full, m in reg.items():
+                known.add(full)
+                if isinstance(m, metrics.Histogram):
+                    known.add(f"{full}_count")
+        for spec in default_specs():
+            base = (spec.metric[: -len("_count")]
+                    if spec.metric.endswith("_count") else spec.metric)
+            assert spec.metric in known or base in known, spec.metric
+            if spec.denominator:
+                assert spec.denominator in known, spec.denominator
+
+    def test_sample_once_covers_counters_gauges_histograms(self):
+        reg = metrics.Registry("s")
+        reg.counter("c_total").inc(3, {"a": "b"})
+        reg.gauge("g").set(7.0)
+        reg.histogram("h_seconds", buckets=(0.1, 1.0)).observe(0.05)
+        clock = FakeClock()
+        mon = make_monitor([], reg, clock)
+        appended = mon.sample_once()
+        assert appended >= 1 + 1 + (2 + 2)
+        assert mon.cache.query("s_c_total", {"a": "b"}).latest() == 3.0
+        assert mon.cache.query("s_g").latest() == 7.0
+        assert mon.cache.query(
+            "s_h_seconds_bucket", {"le": "0.1"}).latest() == 1.0
+        assert mon.cache.query("s_h_seconds_count").latest() == 1.0
+
+    def test_background_sampler_start_stop(self):
+        reg = metrics.Registry("bg")
+        reg.gauge("g").set(1.0)
+        mon = SloMonitor(specs=[], registries=(reg,),
+                         sample_interval_s=0.01)
+        mon.start()
+        try:
+            import time
+
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if not mon.cache.query("bg_g").empty:
+                    break
+                time.sleep(0.01)
+            assert not mon.cache.query("bg_g").empty
+        finally:
+            mon.stop()
+        assert mon._thread is None
+
+
+# ---- the acceptance flow ---------------------------------------------------
+
+
+def make_sched(**kw):
+    snap = ClusterSnapshot(capacity=8)
+    snap.upsert_node(NodeSpec(
+        name="n0",
+        allocatable=resource_vector(cpu=1_000_000, memory=1_000_000)))
+    return Scheduler(snap, **kw)
+
+
+class TestEndToEndBreach:
+    def test_slow_solve_breach_fires_dumps_and_recovers(self):
+        """Acceptance: a fault-injected slow solve drives the scheduler
+        past the latency SLO; the fast-burn window fires within its
+        bound, /debug/slo names the offending SLO, the flight recorder
+        dumps, and the alert clears after recovery (hysteresis)."""
+        from koordinator_tpu.scheduler.services import DebugService
+        from koordinator_tpu.transport.faults import (
+            FaultConfig,
+            FaultInjector,
+        )
+
+        inj = FaultInjector(seed=3, config=FaultConfig(
+            solve_delay_p=1.0, solve_delay_ms=60.0))
+        sched = make_sched(faults=inj)
+        clock = FakeClock()
+        spec = latency_spec(
+            metric="koord_scheduler_scheduling_duration_seconds",
+            threshold=0.05,
+            fast=BurnWindow(window_s=30.0, fire_burn=14.4),
+            slow=BurnWindow(window_s=300.0, fire_burn=1.0))
+        mon = SloMonitor(
+            specs=[spec], clock=clock,
+            on_breach=lambda s, d: sched.flight_recorder.dump_now(
+                f"slo:{s.name}"))
+        sched.slo_monitor = mon
+        service = DebugService(sched)
+
+        dumps_before = metrics.round_flight_dumps.value(
+            labels={"reason": "slo:lat"})
+        mon.sample_once()
+        first_bad_at = clock.t
+        seq = 0
+        fired_at = None
+        for _ in range(4):
+            sched.enqueue(PodSpec(
+                name=f"p{seq}",
+                requests=resource_vector(cpu=100, memory=64)))
+            seq += 1
+            sched.schedule_round()
+            assert inj.injected["solve_delay"] >= 1
+            clock.tick(2.0)
+            report = mon.tick()
+            if report["breached"]:
+                fired_at = clock.t
+                break
+        # the fast-burn alert fired, and within the fast window bound
+        assert fired_at is not None, "fast burn never fired"
+        assert fired_at - first_bad_at <= spec.fast.window_s
+
+        # /debug/slo (DebugService surface) reports the breach by name
+        status, body = service.handle("/debug/slo")
+        assert status == 200
+        assert body["breached"] == ["lat"]
+        [slo] = body["slos"]
+        assert slo["name"] == "lat" and slo["breached"]
+        assert slo["windows"]["fast"]["burn_rate"] >= 14.4
+
+        # the breach dumped the latest round's flight record
+        assert metrics.round_flight_dumps.value(
+            labels={"reason": "slo:lat"}) == dumps_before + 1
+        assert metrics.slo_alerts_total.value(
+            {"slo": "lat", "phase": "fire"}) == 1.0
+
+        # recovery: heal the injector, run fast rounds until the fast
+        # window slides past the slow ones — hysteresis clears
+        inj.heal()
+        cleared = False
+        for _ in range(30):
+            sched.enqueue(PodSpec(
+                name=f"p{seq}",
+                requests=resource_vector(cpu=100, memory=64)))
+            seq += 1
+            sched.schedule_round()
+            clock.tick(2.0)
+            report = mon.tick()
+            if not report["breached"]:
+                cleared = True
+                break
+        assert cleared, "alert never cleared after recovery"
+        assert metrics.slo_alerts_total.value(
+            {"slo": "lat", "phase": "clear"}) == 1.0
+        status, body = service.handle("/debug/slo")
+        assert body["breached"] == []
+        # the breach history survives the clear
+        assert body["slos"][0]["breaches_total"] == 1
+        assert body["slos"][0]["peak_burn"]["fast"] >= 14.4
+
+    def test_debug_slo_over_http_gateway(self):
+        from koordinator_tpu.transport.http_gateway import HttpGateway
+
+        sched = make_sched()
+        clock = FakeClock()
+        sched.slo_monitor = SloMonitor(specs=default_specs(), clock=clock)
+        gw = HttpGateway(scheduler=sched)
+        gw.start()
+        try:
+            base = f"http://127.0.0.1:{gw.port}"
+            sched.enqueue(PodSpec(
+                name="p0", requests=resource_vector(cpu=100, memory=64)))
+            sched.schedule_round()
+            clock.tick(5.0)
+            with urllib.request.urlopen(base + "/debug/slo",
+                                        timeout=5) as r:
+                assert r.status == 200
+                body = json.loads(r.read())
+            names = {s["name"] for s in body["slos"]}
+            assert "scheduling_latency_p99" in names
+            assert body["breached"] == []
+            # the profiler endpoint ships dark: 403 until armed
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    base + "/debug/profile?seconds=0.01", timeout=5)
+            assert ei.value.code == 403
+        finally:
+            gw.stop()
+
+    def test_debug_slo_without_monitor_is_501(self):
+        from koordinator_tpu.scheduler.services import DebugService
+
+        sched = make_sched()
+        status, body = DebugService(sched).handle("/debug/slo")
+        assert status == 501
+        assert "SLO" in body["error"] or "slo" in body["error"].lower()

@@ -213,6 +213,46 @@ class TestSchedulerTracing:
             labels={"reason": "slow"}) == before + 1
         assert sched.flight_recorder.slowest()["round"] == rec.round
 
+    def test_flight_ring_overwrite_is_counted(self, collector):
+        """Records evicted by ring overwrite were silent before the
+        counter (ISSUE 5 satellite): dump reasons were counted, drops
+        were not."""
+        from koordinator_tpu.scheduler.flight_recorder import (
+            FlightRecorder,
+            RoundRecord,
+        )
+
+        def rec(i):
+            return RoundRecord(
+                round=i, trace_id="t", start_time=0.0, duration_s=0.01,
+                solver="greedy", solve_path="greedy", pods=0, placed=0,
+                failed=0, suspended=0, degraded=False, staleness_s=None,
+                dirty_node_frac=0.0, dirty_pod_frac=0.0,
+                solve_wall_s=0.0, solve_device_s=0.0)
+
+        before = metrics.round_flight_overwritten.value()
+        fr = FlightRecorder(capacity=2, slow_threshold_s=1.0)
+        fr.record(rec(1))
+        fr.record(rec(2))
+        assert metrics.round_flight_overwritten.value() == before
+        assert fr.overwrites == 0
+        fr.record(rec(3))          # evicts record 1 unread
+        fr.record(rec(4))          # evicts record 2 unread
+        assert fr.overwrites == 2
+        assert metrics.round_flight_overwritten.value() == before + 2
+        assert [r["round"] for r in fr.snapshot()] == [4, 3]
+
+        # dump_now: the SLO breach trigger dumps the latest record with
+        # the trigger's reason, without waiting for a slow round
+        dumps_before = metrics.round_flight_dumps.value(
+            labels={"reason": "slo:lat"})
+        assert fr.dump_now("slo:lat") is True
+        assert metrics.round_flight_dumps.value(
+            labels={"reason": "slo:lat"}) == dumps_before + 1
+        assert fr.last().dump_reason == "slo:lat"
+        empty = FlightRecorder(capacity=2)
+        assert empty.dump_now("slo:lat") is False
+
     def test_solve_path_and_device_split_on_batch_rounds(self, collector):
         # batch_solver_threshold=1 forces the batch engine (and, with no
         # gangs and factored masks, the incremental driver)

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""SLO-surface smoke: drive a fresh scheduler, scrape /debug/slo live.
+
+Assembles the scheduler binary (HTTP gateway + SLO burn-rate engine),
+runs a short synthetic workload — optionally with fault-injected slow
+solves so the breach machinery demonstrably fires — then fetches
+``GET /debug/slo`` over the gateway exactly as an operator would and
+prints one line per SLO: worst burn rate per window and breach count.
+
+The numbers describe THIS driver's synthetic run, not any other
+process: the soak's pytest windows run in their own interpreters, so
+this is the end-of-soak check that the whole SLO surface (sampling,
+burn windows, gateway serving) is alive and readable, printed by
+tools/soak.sh alongside the slowest-round flight record (SOAK_SLO=0
+disables).  Also useful standalone:
+
+    python tools/slo_summary.py --rounds 40
+    python tools/slo_summary.py --slow-solves   # force a breach
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="slo_summary")
+    parser.add_argument("--rounds", type=int, default=30)
+    parser.add_argument("--pods-per-round", type=int, default=4)
+    parser.add_argument(
+        "--slow-solves", action="store_true",
+        help="inject 50ms solve delays against a 20ms latency SLO so "
+             "the fast-burn breach path demonstrably fires")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the raw /debug/slo body instead of "
+                             "the per-SLO summary lines")
+    args = parser.parse_args(argv)
+
+    from koordinator_tpu.api.resources import resource_vector
+    from koordinator_tpu.cmd.binaries import main_koord_scheduler
+    from koordinator_tpu.scheduler.snapshot import NodeSpec, PodSpec
+    from koordinator_tpu.transport.faults import FaultConfig, FaultInjector
+
+    flags = ["--disable-leader-election", "--http-port", "0",
+             "--slo-sample-interval-seconds", "0"]
+    if args.slow_solves:
+        flags += ["--slo-latency-threshold-seconds", "0.02"]
+    asm = main_koord_scheduler(flags)
+    sched = asm.component
+    try:
+        if args.slow_solves:
+            sched.faults = FaultInjector(seed=1, config=FaultConfig(
+                solve_delay_p=1.0, solve_delay_ms=50.0))
+            # fire on the first hot evaluation instead of 14.4x budget
+            # (the summary run is seconds, not minutes)
+            import dataclasses
+
+            from koordinator_tpu.slo_monitor import BurnWindow
+
+            sched.slo_monitor.specs = [
+                dataclasses.replace(s, fast=BurnWindow(
+                    window_s=s.fast.window_s, fire_burn=1.0))
+                for s in sched.slo_monitor.specs]
+        sched.snapshot.upsert_node(NodeSpec(
+            name="slo-n0",
+            allocatable=resource_vector(cpu=1_000_000, memory=1_000_000)))
+        seq = 0
+        for _ in range(args.rounds):
+            for _ in range(args.pods_per_round):
+                sched.enqueue(PodSpec(
+                    name=f"slo-p{seq}",
+                    requests=resource_vector(cpu=100, memory=64)))
+                seq += 1
+            sched.schedule_round()
+            sched.slo_monitor.tick()
+
+        url = f"http://127.0.0.1:{asm.gateway.port}/debug/slo"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            body = json.loads(resp.read())
+        if args.json:
+            print(json.dumps(body, indent=2, default=str))
+            return 0
+        print("== SLO summary (/debug/slo, fresh synthetic drive — "
+              "not a readback of the soak windows)")
+        worst_breaches = 0
+        for slo in body["slos"]:
+            peak = slo["peak_burn"]
+            state = "BREACHED" if slo["breached"] else "ok"
+            print(f"  {slo['name']:<28} {state:<9} "
+                  f"worst burn fast={peak['fast']:.2f} "
+                  f"slow={peak['slow']:.2f} "
+                  f"breaches={slo['breaches_total']}")
+            worst_breaches += slo["breaches_total"]
+        if args.slow_solves and worst_breaches == 0:
+            print("ERROR: slow solves injected but no SLO breach fired",
+                  file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        asm.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,6 +21,16 @@
 #                                     round's flight record at the end
 #                                     (tools/trace_dump.py
 #                                     --slowest-round)
+#         SOAK_SLO     (default 1)    1 = end the run with the SLO
+#                                     surface smoke: tools/slo_summary
+#                                     drives a fresh scheduler+gateway
+#                                     and prints per-SLO worst burn +
+#                                     breach count from its live
+#                                     /debug/slo (proves the SLO
+#                                     machinery end to end; the pytest
+#                                     windows run in their own
+#                                     interpreters, so this is not a
+#                                     readback of the soak itself)
 #         SOAK_CHAOS   (default 0)    1 = also sweep the chaos
 #                                     fault-injection suite (tests/
 #                                     test_chaos.py, `chaos` marker)
@@ -40,9 +50,24 @@ STRIDE=${SOAK_STRIDE:-1000}
 OUT=${SOAK_OUT:-soak_results}
 CHAOS=${SOAK_CHAOS:-0}
 TRACE=${SOAK_TRACE:-0}
+SLO=${SOAK_SLO:-1}
 mkdir -p "$OUT"
 ts=$(date +%Y%m%d_%H%M%S)
 log="$OUT/soak_$ts.log"
+
+# dashboard drift gate first: a soak whose dashboards reference
+# unregistered metrics produces evidence nobody can read back
+total_passed=0
+total_failed=0
+failures=""
+echo "== dashboard drift check (tools/check_dashboards.py)" | tee -a "$log"
+if python tools/check_dashboards.py >> "$log" 2>&1; then
+    total_passed=$((total_passed + 1))
+else
+    total_failed=$((total_failed + 1))
+    failures="$failures;dashboard drift: tools/check_dashboards.py failed"
+    failures="$failures (see log)"
+fi
 trace_jsonl=""
 if [ "$TRACE" = "1" ]; then
     trace_jsonl="$OUT/trace_$ts.jsonl"
@@ -62,9 +87,6 @@ tests/test_replay_parity.py \
 tests/test_reservation_properties.py \
 tests/test_scheduler_accounting.py"
 
-total_passed=0
-total_failed=0
-failures=""
 for ((w = 0; w < WINDOWS; w++)); do
     base=$((BASE0 + w * STRIDE))
     echo "== window $((w + 1))/$WINDOWS seed base $base" | tee -a "$log"
@@ -140,5 +162,12 @@ if [ "$TRACE" = "1" ] && [ -s "$trace_jsonl" ]; then
     echo "== slowest round ($trace_jsonl)" | tee -a "$log"
     python tools/trace_dump.py "$trace_jsonl" --slowest-round \
         | tee -a "$log"
+fi
+if [ "$SLO" = "1" ]; then
+    # SLO surface smoke from a live /debug/slo (fresh synthetic drive
+    # over the gateway — not a readback of the pytest windows above):
+    # per-SLO worst burn rate + breach count
+    python tools/slo_summary.py | tee -a "$log" \
+        || echo "WARNING: slo_summary failed (see log)" | tee -a "$log"
 fi
 [ "$total_failed" -eq 0 ]
