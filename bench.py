@@ -440,36 +440,77 @@ def _run_child(argv: list[str], timeout: float,
     return None, f"rc={proc.returncode}: {tail}"
 
 
-def _device_alive(timeout_s: float = 180.0) -> tuple[bool, str]:
-    """(ok, error) — probe the backend with a tiny kernel under a thread
-    timeout.  Through the axon tunnel a dead link HANGS readbacks rather
-    than erroring, which would wedge the whole bench run; a probe that
-    doesn't come back in time means 'record device-unreachable and exit'.
-    A fast backend ERROR (e.g. Connection refused once the tunnel process
-    dies, observed 2026-07-31) counts as unreachable too — crashing with
-    rc!=0 would cost the round its record, since the driver keeps stdout
-    only on rc==0."""
+#: structured error_kind values of :func:`_device_alive` — recorded in
+#: zero records and tools/tpu_probe.sh probe.log so four rounds of
+#: "unreachable" (BENCH_r02-r05) become a DIAGNOSIS, not one verdict:
+#:   no_devices_enumerated  jax.devices() empty or raised/hung fast
+#:   probe_kernel_hung      devices enumerated; the kernel never finished
+#:   transfer_stall         kernel completed; the host readback hung
+#:   probe_error            the backend errored instead of hanging
+DEVICE_ERROR_KINDS = ("no_devices_enumerated", "probe_kernel_hung",
+                      "transfer_stall", "probe_error")
+
+
+def _device_alive(timeout_s: float = 180.0) -> tuple[bool, str, str]:
+    """(ok, error_kind, error) — probe the backend with a tiny kernel
+    under a thread timeout, recording HOW FAR the probe got.  Through
+    the axon tunnel a dead link HANGS readbacks rather than erroring,
+    which would wedge the whole bench run; a probe that doesn't come
+    back in time means 'record device-unreachable and exit'.  A fast
+    backend ERROR (e.g. Connection refused once the tunnel process
+    dies, observed 2026-07-31) counts as unreachable too — crashing
+    with rc!=0 would cost the round its record, since the driver keeps
+    stdout only on rc==0.
+
+    The progress markers split ROADMAP item 1's single "tunnel down"
+    verdict into distinguishable failure modes (``error_kind``): a
+    tunnel that can't even enumerate devices needs a reconnect, a hung
+    kernel points at the remote executor, a transfer stall at the
+    readback path.  Tunnel caveat: ``block_until_ready`` can return
+    before remote execution completes, so "kernel completed" is as seen
+    from the host — a stall after it is classified as transfer_stall.
+    """
     import threading
 
-    ok: list[bool] = []
-    err: list[BaseException] = []
+    progress: list[str] = []
+    err: list[tuple[str, str]] = []
 
     def probe():
         try:
+            if not jax.devices():
+                err.append(("no_devices_enumerated",
+                            "jax.devices() returned []"))
+                return
+            progress.append("devices")
             x = jnp.ones((8, 8))
-            float((x @ x).sum())
-            ok.append(True)
+            y = x @ x
+            y.block_until_ready()          # kernel done (as host sees it)
+            progress.append("kernel")
+            value = float(np.asarray(y).sum())   # device->host readback
+            assert value == 8.0 * 8 * 8
+            progress.append("readback")
         except Exception as e:     # errored, as opposed to hung
-            err.append(e)
+            kind = ("no_devices_enumerated" if "devices" not in progress
+                    else "probe_error")
+            err.append((kind, repr(e)[:300]))
 
     t = threading.Thread(target=probe, daemon=True)
     t.start()
     t.join(timeout_s)
     if err:
-        return False, repr(err[0])[:300]
-    if not ok:
-        return False, f"probe kernel hung past {timeout_s:.0f}s"
-    return True, ""
+        return False, err[0][0], err[0][1]
+    if "readback" in progress:
+        return True, "", ""
+    if "kernel" in progress:
+        return False, "transfer_stall", (
+            f"probe kernel completed but the readback hung past "
+            f"{timeout_s:.0f}s")
+    if "devices" in progress:
+        return False, "probe_kernel_hung", (
+            f"devices enumerated but the probe kernel hung past "
+            f"{timeout_s:.0f}s")
+    return False, "no_devices_enumerated", (
+        f"jax.devices() did not return within {timeout_s:.0f}s")
 
 
 def _emit_zero_record(extra: dict,
@@ -494,7 +535,11 @@ def _emit_zero_record(extra: dict,
     if device_down is None:
         # caller hit an error that MIGHT be the tunnel dying mid-run —
         # a fresh probe decides (60s: enough for a healthy tunnel)
-        device_down = not _device_alive(60.0)[0]
+        probe_ok, probe_kind, probe_msg = _device_alive(60.0)
+        device_down = not probe_ok
+        if not probe_ok:
+            extra.setdefault("error_kind", probe_kind)
+            extra.setdefault("reprobe_error", probe_msg)
     # the prober's own bench runs want a FRESH measurement or a zero
     # that keeps the hunt alive — never a promoted old capture (which
     # would also make the prober mark the round as captured)
@@ -523,6 +568,13 @@ def _emit_zero_record(extra: dict,
         os._exit(0)
     if skip_notes:
         extra["probe_capture_refused"] = skip_notes[:4]
+    # staged capture with provenance instead of all-or-nothing (ROADMAP
+    # item 1): if the prober's bench_stages.py run completed while the
+    # full headline could not, its per-stage device walls ride the zero
+    # record's extra rather than being discarded
+    stage_walls = _latest_probe_stages()
+    if stage_walls is not None:
+        extra["probe_stage_walls"] = stage_walls
     # Budget: the driver's own wall-clock limit is unknown but was
     # ~3600s historically; probes may already have burned ~660s, so
     # cap the sweep at 1500s — losing the sweep to the cap still
@@ -557,6 +609,58 @@ def _emit_zero_record(extra: dict,
 
 
 MAX_PROBE_CAPTURE_AGE_S = 12 * 3600.0
+
+
+def _latest_probe_stages(root: str | None = None) -> dict | None:
+    """Newest RECENT ``bench_stages.py`` capture the prober banked
+    (probe_results/stages_*.jsonl), as ``{"source", "age_s",
+    "capture_commit", "stages": {stage -> record}}``; None when none is
+    recent.  Unlike the headline promotion (:func:`_latest_probe_capture`,
+    which must refuse anything unverifiable), stage walls promote WITH a
+    ``caveat`` string when their commit cannot be tied to HEAD — they
+    land in ``extra`` as explicitly-provenanced partial evidence, never
+    as the headline value."""
+    import glob
+
+    if root is None:
+        root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "probe_results")
+    head = _git_head()["commit"]
+    now = time.time()
+    for path in sorted(glob.glob(os.path.join(root, "stages_*.jsonl")),
+                       reverse=True):
+        name = os.path.basename(path)
+        try:
+            age = now - os.path.getmtime(path)
+            if age > MAX_PROBE_CAPTURE_AGE_S:
+                continue
+            with open(path) as f:
+                lines = [json.loads(line) for line in
+                         f.read().strip().splitlines() if line.strip()]
+        except (OSError, json.JSONDecodeError):
+            continue
+        stages = {d["stage"]: d for d in lines
+                  if isinstance(d, dict) and "stage" in d}
+        prov = stages.pop("provenance", {})
+        if not stages:
+            continue
+        cap_commit = prov.get("commit", "")
+        record: dict = {"source": name, "age_s": round(age, 1),
+                        "capture_commit": cap_commit, "stages": stages}
+        changed = _solver_diff(cap_commit, head)
+        if prov.get("dirty"):
+            record["caveat"] = (
+                f"captured on a dirty tree at {cap_commit[:12]}; "
+                "uncommitted solver edits are unverifiable")
+        elif changed is None:
+            record["caveat"] = (
+                f"capture commit {cap_commit[:12] or '(unstamped)'} "
+                f"unverifiable vs HEAD {head[:12]}")
+        elif changed:
+            record["caveat"] = ("solver files changed since capture: "
+                                + ", ".join(sorted(changed)[:5]))
+        return record
+    return None
 
 
 def _latest_probe_capture(
@@ -645,9 +749,9 @@ def main() -> None:
     # recording a zero.  KOORD_BENCH_PROBE_TRIES overrides (1 = old
     # single-probe behavior); total worst-case wait = tries * 180s + waits.
     tries = int(os.environ.get("KOORD_BENCH_PROBE_TRIES", "3"))
-    alive, probe_err = False, ""
+    alive, probe_kind, probe_err = False, "", ""
     for attempt in range(max(tries, 1)):
-        alive, probe_err = _device_alive()
+        alive, probe_kind, probe_err = _device_alive()
         if alive:
             break
         if attempt + 1 < tries:
@@ -656,7 +760,8 @@ def main() -> None:
         _emit_zero_record({
             "error": "device unreachable: probe did not complete in "
                      f"{max(tries, 1)} attempts (tunnel down?): "
-                     f"{probe_err}"}, device_down=True)
+                     f"{probe_err}",
+            "error_kind": probe_kind}, device_down=True)
 
     state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
 

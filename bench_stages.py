@@ -31,7 +31,7 @@ import sys
 import jax
 import jax.numpy as jnp
 
-from bench import K_ITERS, _median_readback_seconds
+from bench import K_ITERS, _git_head, _median_readback_seconds
 
 N_NODES = 10_240
 N_PODS = 50_000
@@ -72,12 +72,18 @@ def main() -> None:
 
     state, pods, cfg = _build_problem(n_nodes, n_pods, seed=42)
 
+    # code provenance first: a stage capture promoted into a later zero
+    # record (bench._latest_probe_stages) must be tied to the commit it
+    # measured, like the headline captures are
+    print(json.dumps({"stage": "provenance", **_git_head()}), flush=True)
+
     def rtt_fn(st, p):
         return st.node_allocatable.sum() + p.requests.sum()
 
     rtt, _ = _median_readback_seconds(jax.jit(rtt_fn), (state, pods))
     _emit("rtt_floor", rtt, {"backend": jax.default_backend(),
                              "shape": f"{n_pods}p_{n_nodes}n", "k": K})
+    stage_secs: dict[str, float] = {}
 
     # -- score: keep the full (P, N) tensor live through the chain
     def score_loop(st0, p):
@@ -92,6 +98,7 @@ def main() -> None:
         return acc
 
     sec, _ = _time_chained(score_loop, (state, pods), rtt, iters)
+    stage_secs["score"] = sec
     _emit("score", sec)
 
     # -- select per method: scoring + top-k reduction to (P, k)
@@ -115,6 +122,7 @@ def main() -> None:
         try:
             sec, _ = _time_chained(select_loop(method), (state, pods), rtt,
                                    iters)
+            stage_secs[f"select_{method}"] = sec
             _emit(f"select_{method}", sec)
         except Exception as e:  # a broken variant must not cost the run
             print(json.dumps({"stage": f"select_{method}",
@@ -143,6 +151,7 @@ def main() -> None:
 
     sec, value = _time_chained(rounds_loop, (state, pods, cand_key,
                                              cand_node), rtt, iters)
+    stage_secs["rounds"] = sec
     _emit("rounds", sec, {"assigned_per_iter": round(value / iters, 1)})
 
     # -- incremental refresh: the steady-state replacement for select_* —
@@ -180,10 +189,77 @@ def main() -> None:
             refresh_loop,
             (state, pods, cache, jnp.asarray(drows), jnp.asarray(dvalid)),
             rtt, iters)
+        stage_secs["refresh_incremental_1pct"] = sec
         _emit("refresh_incremental_1pct", sec, {"dirty_nodes": n_dirty})
     except Exception as e:
         print(json.dumps({"stage": "refresh_incremental_1pct",
                           "error": repr(e)[:200]}), flush=True)
+
+    # -- explain: device-side reject-reason accounting (ISSUE 6 overhead
+    # guard).  The solve itself is UNCHANGED by explain — the scheduler
+    # runs ops/explain.explain_counts once per round over only the
+    # COMPACTED failed rows — so the production overhead is the compact
+    # kernel's wall at a representative 1% failure rate, priced against
+    # the solve (select + rounds).  The full-batch number (every pod
+    # unplaced: the 50k-pending pathology explainability exists FOR) is
+    # emitted alongside as the worst case.
+    from koordinator_tpu.ops.explain import explain_counts
+
+    # two denominators: the cold-path solve (select + rounds) and the
+    # cheaper steady-state solve (incremental refresh + rounds) — an
+    # explain cost hiding inside the cold path's margin must not pass
+    # the guard while steady-state rounds pay >5%
+    solve_sec = (stage_secs.get("select_chunked")
+                 or next((stage_secs[k] for k in stage_secs
+                          if k.startswith("select_")), 0.0)
+                 ) + stage_secs.get("rounds", 0.0)
+    steady_sec = (stage_secs.get("refresh_incremental_1pct", 0.0)
+                  + stage_secs.get("rounds", 0.0)
+                  if "refresh_incremental_1pct" in stage_secs else 0.0)
+
+    def explain_loop(p_batch):
+        def fn(st0, p):
+            def body(i, carry):
+                acc, usage = carry
+                counts, feas = explain_counts(
+                    st0.replace(node_usage=usage), p, cfg)
+                return (acc + counts.sum() + feas.sum(),
+                        usage + (feas.sum() & 1))
+            acc, _ = jax.lax.fori_loop(0, iters, body,
+                                       (jnp.int32(0), st0.node_usage))
+            return acc
+        return fn
+
+    n_failed = max(n_pods // 100, 1)
+    fail_mask = np.zeros(pods.capacity, bool)
+    fail_mask[:n_failed] = True
+    small, _ = pods.compact(fail_mask)
+    for label, batch_arg, extra in (
+        ("explain_compact_1pct", small,
+         {"failed_rows": n_failed, "compact_capacity": small.capacity}),
+        ("explain_full_batch", pods,
+         {"note": "worst case: every pod unplaced"}),
+    ):
+        try:
+            sec, _ = _time_chained(explain_loop(batch_arg),
+                                   (state, batch_arg), rtt, iters)
+            pct = round(100.0 * sec / solve_sec, 2) if solve_sec else None
+            steady_pct = (round(100.0 * sec / steady_sec, 2)
+                          if steady_sec else None)
+            worst = max(p for p in (pct, steady_pct, 0.0)
+                        if p is not None)
+            _emit(label, sec, {
+                **extra,
+                "solve_ms": round(solve_sec * 1e3, 2),
+                "steady_solve_ms": round(steady_sec * 1e3, 2),
+                "pct_of_solve": pct,
+                "pct_of_steady_solve": steady_pct,
+                # the guard verdict takes the LESS flattering denominator
+                "within_5pct": (pct is not None and worst <= 5.0),
+            })
+        except Exception as e:
+            print(json.dumps({"stage": label, "error": repr(e)[:200]}),
+                  flush=True)
 
 
 if __name__ == "__main__":

@@ -537,6 +537,14 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
              "ScarceResourceAvoidance, Coscheduling) — the reference's "
              "versioned component config; defaults apply where unset")
     parser.add_argument(
+        "--no-explain", action="store_true",
+        help="disable placement explainability: the device-side "
+             "reject-reason accounting (ops/explain.py), the "
+             "/debug/explain/<pod> explanations, and the "
+             "unschedulable_pods/filter_reject_fraction/capacity_slack "
+             "rollups all go dark; Diagnose falls back to the per-pod "
+             "host recompute")
+    parser.add_argument(
         "--trace-pods", action="store_true",
         help="open a root trace span for EVERY enqueued pod (pods whose "
              "submitter propagated a trace context are always traced); "
@@ -626,6 +634,7 @@ def main_koord_scheduler(argv: list[str],
                                  if args.staleness_threshold_seconds > 0
                                  else None),
         trace_pods=args.trace_pods,
+        explain=not args.no_explain,
     )
     # -- self-observability: SLO burn-rate engine + solver introspection
     from koordinator_tpu.ops.introspection import ProfilerCapture

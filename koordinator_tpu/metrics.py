@@ -476,6 +476,27 @@ solver_batch_padding_waste = SCHEDULER.gauge(
     "pods) / capacity — the device memory and FLOPs spent on rows the "
     "power-of-two bucketing padded in")
 
+# -- placement explainability (ops/explain.py, ISSUE 6) --
+unschedulable_pods = SCHEDULER.gauge(
+    "unschedulable_pods",
+    "Pods the last round left unplaced (or suspended/gang-parked), by "
+    "attributed top reject reason (label: reason — ops/explain."
+    "REASON_NAMES: per-dim fit, usage_threshold, affinity, plus the "
+    "pod-level gates quota/gang_barrier/degraded_suspended); every "
+    "reason label is republished each round so cleared reasons read 0")
+filter_reject_fraction = SCHEDULER.histogram(
+    "filter_reject_fraction",
+    "Fraction of cluster nodes each filter stage rejected, averaged "
+    "over a round's unplaced pods (label: reason) — which constraint "
+    "is actually binding when pods go unschedulable",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0))
+capacity_slack = SCHEDULER.gauge(
+    "capacity_slack_fraction",
+    "Request-free capacity fraction per resource dimension over valid "
+    "nodes: sum(allocatable - requested) / sum(allocatable) (label: "
+    "dim) — the per-dim headroom left before fit_<dim> rejections "
+    "dominate")
+
 be_suppress_cpu_cores = KOORDLET.gauge(
     "be_suppress_cpu_cores", "CPU cores currently allowed for BE")
 pod_eviction_total = KOORDLET.counter(

@@ -37,6 +37,10 @@ class PodDiagnosis:
     #: (schedule_diagnosis.go records the same on the explanation)
     preempt_node: str | None = None
     preempt_victims: list[str] = dataclasses.field(default_factory=list)
+    #: fine-grained reject-reason counts keyed by ops/explain.REASON_NAMES
+    #: (per-dim fit, threshold, affinity, plus host-filled pod-level
+    #: gates); None when the explain accounting was disabled
+    reason_counts: dict[str, int] | None = None
 
     def message(self) -> str:
         msg = self._base_message()
@@ -94,6 +98,24 @@ def explain_pod(
     fail_thr = valid & fit & ~thr
     fail_aff = valid & fit & thr & ~aff
 
+    # per-dim first-fail fit counts: the NumPy oracle the device kernel
+    # (ops/explain.explain_counts) is tested against
+    from koordinator_tpu.ops import explain as ex
+
+    free = np.asarray(state.free)
+    r = np.asarray(req)[0]
+    dim_ok = (r[None, :] <= free) | (r[None, :] == 0)        # (N, R)
+    fails = ~dim_ok
+    prior = np.cumsum(fails, axis=-1) - fails
+    ff = fails & (prior == 0)                                # (N, R)
+    counts = {name: 0 for name in ex.REASON_NAMES}
+    counts["node_invalid"] = int((~valid).sum())
+    for d in range(ff.shape[1]):
+        counts[ex.REASON_NAMES[ex.REASON_FIT_FIRST + d]] = int(
+            (fail_fit & ff[:, d]).sum())
+    counts["usage_threshold"] = int(fail_thr.sum())
+    counts["affinity"] = int(fail_aff.sum())
+
     return PodDiagnosis(
         total_nodes=total,
         feasible_nodes=int(feasible.sum()) if quota_admitted else 0,
@@ -102,4 +124,34 @@ def explain_pod(
         affinity_mismatch=int(fail_aff.sum()),
         quota_rejected=not quota_admitted,
         invalid=int((~valid).sum()),
+        reason_counts=counts,
+    )
+
+
+def diagnosis_from_counts(
+    counts: np.ndarray,      # (NUM_REASONS,) int — one pod's kernel row
+    feasible: int,
+    total_nodes: int,
+    quota_admitted: bool = True,
+) -> PodDiagnosis:
+    """Build a :class:`PodDiagnosis` from one row of the device kernel's
+    reduction (``ops/explain.explain_counts``) — the batched replacement
+    for recomputing :func:`explain_pod` per failed pod on host."""
+    from koordinator_tpu.ops import explain as ex
+
+    counts = np.asarray(counts)
+    reason_counts = {
+        name: int(counts[i]) for i, name in enumerate(ex.REASON_NAMES)
+    }
+    fit_total = int(
+        counts[ex.REASON_FIT_FIRST:ex.REASON_USAGE_THRESHOLD].sum())
+    return PodDiagnosis(
+        total_nodes=total_nodes,
+        feasible_nodes=int(feasible) if quota_admitted else 0,
+        insufficient_resources=fit_total,
+        usage_over_threshold=int(counts[ex.REASON_USAGE_THRESHOLD]),
+        affinity_mismatch=int(counts[ex.REASON_AFFINITY]),
+        quota_rejected=not quota_admitted,
+        invalid=int(counts[ex.REASON_NODE_INVALID]),
+        reason_counts=reason_counts,
     )

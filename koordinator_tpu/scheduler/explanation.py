@@ -22,6 +22,94 @@ from koordinator_tpu.api.crds import ScheduleExplanation
 from koordinator_tpu.scheduler.diagnosis import PodDiagnosis
 
 
+# ---- placement explanations (device-side reject-reason accounting) --------
+
+
+@dataclasses.dataclass
+class PlacementExplanation:
+    """One pod's reject-reason breakdown from a scheduling round.
+
+    Counts come from the device-side reduction
+    (``ops/explain.explain_counts``) plus the host-attributed pod-level
+    gates (quota, gang barrier, degraded suspension); ``trace_id`` joins
+    the explanation to the pod's trace and ``round`` to its flight
+    record (``/debug/rounds``)."""
+
+    pod: str
+    round: int
+    total_nodes: int
+    feasible_nodes: int
+    #: reason name -> node count, keyed by ops/explain.REASON_NAMES;
+    #: only nonzero reasons are retained
+    reasons: dict[str, int]
+    trace_id: Optional[str] = None
+    quota: Optional[str] = None
+    gang: Optional[str] = None
+    update_time: float = 0.0
+
+    #: pod-level gates outrank node-count reasons in top_reason(): when
+    #: quota admission (or the gang barrier / degraded suspension) held a
+    #: pod back, it IS the attributed cause — the node-level counts are
+    #: context, not the verdict
+    _GATE_REASONS = ("quota", "gang_barrier", "degraded_suspended")
+
+    def top_reason(self) -> Optional[str]:
+        """The attributed cause: a pod-level gate when one fired, else
+        the reason that eliminated the most nodes (None if none)."""
+        if not self.reasons:
+            return None
+        for gate in self._GATE_REASONS:
+            if self.reasons.get(gate, 0) > 0:
+                return gate
+        return max(self.reasons.items(), key=lambda kv: (kv[1], kv[0]))[0]
+
+    def summary(self) -> str:
+        """"0/10240 nodes feasible: 9812 fit_gpu, 401 quota, 27 ..."."""
+        head = f"{self.feasible_nodes}/{self.total_nodes} nodes feasible"
+        parts = [f"{count} {name}" for name, count in
+                 sorted(self.reasons.items(), key=lambda kv: (-kv[1], kv[0]))
+                 if count > 0]
+        return head + (": " + ", ".join(parts) if parts else "")
+
+    def to_doc(self) -> dict:
+        doc = dataclasses.asdict(self)
+        doc["summary"] = self.summary()
+        doc["top_reason"] = self.top_reason()
+        return doc
+
+
+class ExplanationRing:
+    """Bounded pod-keyed ring of the latest :class:`PlacementExplanation`
+    per pod — the retention layer behind ``/debug/explain/<pod>``.
+
+    Re-recording a pod refreshes its recency; the oldest pods fall off
+    once ``capacity`` distinct pods are held (a years-long scheduler must
+    not leak one entry per pod name ever seen)."""
+
+    def __init__(self, capacity: int = 4096, clock=time.time):
+        self.capacity = capacity
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._ring: OrderedDict[str, PlacementExplanation] = OrderedDict()
+
+    def record(self, explanation: PlacementExplanation) -> None:
+        if not explanation.update_time:
+            explanation.update_time = self.clock()
+        with self._lock:
+            self._ring.pop(explanation.pod, None)
+            self._ring[explanation.pod] = explanation
+            while len(self._ring) > self.capacity:
+                self._ring.popitem(last=False)
+
+    def get(self, pod: str) -> Optional[PlacementExplanation]:
+        with self._lock:
+            return self._ring.get(pod)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
 class ExplanationStore:
     """Persists diagnosis results as ScheduleExplanation objects.
 

@@ -50,6 +50,12 @@ class RoundRecord:
     #: cumulative solve-shed counter at round end (deltas between
     #: records localize WHICH round the sheds landed in)
     sheds_total: float = 0.0
+    #: {top reject reason -> unplaced pod count} from the round's
+    #: placement-explanation rollup (ops/explain taxonomy); empty when
+    #: nothing failed or explain accounting is off — a slow/degraded
+    #: dump then answers "slow doing WHAT" and "failing WHY" in one line
+    top_unschedulable: dict[str, int] = dataclasses.field(
+        default_factory=dict)
     dump_reason: Optional[str] = None   # slow | degraded when dumped
 
     def to_doc(self) -> dict:

@@ -148,6 +148,7 @@ class Scheduler:
         staleness_exit_sec: float | None = None,
         trace_pods: bool = False,
         faults=None,
+        explain: bool = True,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -385,6 +386,44 @@ class Scheduler:
         self._last_dirty_pod_frac = 0.0
         self._last_staleness_s: float | None = None
         self._round_recordable = False
+
+        # -- placement explainability (ISSUE 6) --
+        from koordinator_tpu.ops import explain as _ex
+        from koordinator_tpu.scheduler.explanation import ExplanationRing
+
+        #: kill switch (--no-explain): when False the Diagnose phase
+        #: falls back to the per-pod host recompute, no explanations are
+        #: retained, and the unschedulability rollups stay silent
+        self.explain = explain
+        #: device-side reject-reason reduction over the round's COMPACTED
+        #: failed rows — O(F·NUM_REASONS) host transfer, never (P, N)
+        self._explain_counts = insp.instrument(
+            jax.jit(_ex.explain_counts), "explain_counts", shape_of=_pn)
+        #: per-dim capacity-slack reduction ((N, R) -> two (R,) sums);
+        #: float32 accumulation — a 10k-node cluster's summed int32
+        #: quantities overflow int32, and a ratio gauge doesn't need
+        #: integer exactness
+        self._slack_sums = insp.instrument(
+            jax.jit(lambda st: (
+                jnp.sum(jnp.where(
+                    st.node_valid[:, None],
+                    st.node_allocatable - st.node_requested, 0
+                ).astype(jnp.float32), axis=0),
+                jnp.sum(jnp.where(
+                    st.node_valid[:, None], st.node_allocatable, 0
+                ).astype(jnp.float32), axis=0))),
+            "capacity_slack",
+            shape_of=lambda a, k: f"N{a[0].capacity}")
+        #: bounded pod-keyed retention behind /debug/explain/<pod>
+        self.explain_ring = ExplanationRing()
+        #: {top reason -> pod count} rollup of the last round (flight
+        #: recorder + unschedulable_pods gauge source)
+        self._last_unschedulable_top: dict[str, int] = {}
+        #: pods _active_pods held out this round, for explanation
+        #: recording (suspension / rejected gangs happen before Diagnose
+        #: ever sees the pod)
+        self._last_suspended_names: list[str] = []
+        self._last_gang_rejected_names: list[str] = []
 
         # -- self-observability (ISSUE 5) --
         #: chaos-harness fault injector (transport.faults.FaultInjector);
@@ -837,13 +876,19 @@ class Scheduler:
         back BE/batch-dim pods (stale-state admission suspension)."""
         out = []
         suspended = 0
+        self._last_suspended_names = []
+        self._last_gang_rejected_names = []
         for pod in self.pending.values():
             if pod.gang is not None:
                 gang = self.gangs.get(pod.gang)
                 if gang is not None and gang.rejected:
+                    if not pod.name.startswith(RSV_POD_PREFIX):
+                        self._last_gang_rejected_names.append(pod.name)
                     continue
             if self.degraded and self._suspended_while_degraded(pod):
                 suspended += 1
+                if not pod.name.startswith(RSV_POD_PREFIX):
+                    self._last_suspended_names.append(pod.name)
                 continue
             out.append(pod)
         self.last_suspended = suspended
@@ -1112,6 +1157,7 @@ class Scheduler:
             self._solve_device_s = 0.0
             self._last_dirty_node_frac = 0.0
             self._last_dirty_pod_frac = 0.0
+            self._last_unschedulable_top = {}
             self._round_recordable = False
             start_wall = time.time()
             t0 = time.perf_counter()
@@ -1165,6 +1211,7 @@ class Scheduler:
                     solve_device_s=self._solve_device_s,
                     phase_s=dict(self.monitor.round_timings),
                     sheds_total=metrics.solve_deadline_shed_total.value(),
+                    top_unschedulable=dict(self._last_unschedulable_top),
                 ))
             if self._round_recordable:
                 # device-resident footprint of the persistent solver
@@ -1180,6 +1227,21 @@ class Scheduler:
                     float(insp.device_bytes(
                         cand["cache"] if cand else None)),
                     labels={"kind": "candidate_cache"})
+                if self.explain:
+                    # per-dim capacity slack: the headroom context for
+                    # the round's fit_<dim> rejection counts
+                    from koordinator_tpu.api.resources import ResourceDim
+
+                    free_sum, alloc_sum = self._slack_sums(
+                        self.snapshot.state)
+                    free_sum = np.asarray(free_sum)
+                    alloc_sum = np.asarray(alloc_sum)
+                    for dim in ResourceDim:
+                        total = float(alloc_sum[dim])
+                        metrics.capacity_slack.set(
+                            (float(free_sum[dim]) / total) if total > 0
+                            else 1.0,
+                            labels={"dim": dim.name.lower()})
             return result
 
     def _schedule_round(self) -> SchedulingResult:
@@ -1228,6 +1290,12 @@ class Scheduler:
         with self.monitor.phase("PreEnqueue"):
             pods = self._active_pods()
         if not pods:
+            # an all-suspended / all-parked queue still explains itself:
+            # the held-out pods' explanations and the unschedulability
+            # rollups must not depend on anything having SOLVED
+            if self.explain:
+                self._record_round_explanations(
+                    [], result, [], set(), len(self.snapshot.node_index))
             return result
         if self.auditor is not None:
             # one attempt per workload key per round — a gang is one
@@ -1404,22 +1472,58 @@ class Scheduler:
                     diag_quota, batch.requests, batch.quota_id,
                     batch.non_preemptible
                 ))
-            failed_gangs: set[str] = set()
-            for i, pod in enumerate(pods):
-                if int(a[i]) >= 0:
-                    continue
-                if pod.name in result.assignments:
-                    # bound by the reservation pre-pass (batch row was
-                    # invalidated before the main solve)
-                    continue
-                diag = explain_pod(
-                    self.snapshot.state, batch, self.config, i,
-                    quota_admitted=True,
+            fail_rows = [
+                i for i, pod in enumerate(pods)
+                if int(a[i]) < 0
+                # a pod in assignments was bound by the reservation
+                # pre-pass (batch row invalidated before the main solve)
+                and pod.name not in result.assignments
+            ]
+            counts = feas = None
+            row_pos: dict[int, int] = {}
+            if self.explain and fail_rows:
+                # ONE device reduction over the compacted failed rows
+                # (ops/explain.explain_counts) instead of a host numpy
+                # mask recompute per failed pod — O(F·NUM_REASONS) comes
+                # back, the (F, N) masks never leave the device
+                from koordinator_tpu.scheduler.diagnosis import (
+                    diagnosis_from_counts,
                 )
+
+                fmask = np.zeros(batch.capacity, bool)
+                fmask[fail_rows] = True
+                small, idx = batch.compact(fmask)
+                c_dev, f_dev = self._explain_counts(
+                    self.snapshot.state, small, self.config)
+                # plain block, NOT _block_timed: _solve_device_s feeds
+                # the flight record's Solve-phase wall-vs-device split
+                # (already observed by solver_device_latency), and
+                # Diagnose-phase device time would skew both
+                counts = np.asarray(jax.block_until_ready(c_dev))
+                feas = np.asarray(f_dev)
+                row_pos = {int(r): j for j, r in enumerate(idx)}
+            total_nodes = len(self.snapshot.node_index)
+            failed_gangs: set[str] = set()
+            for i in fail_rows:
+                pod = pods[i]
+                if counts is not None:
+                    # diagnosis_from_counts was imported when the kernel
+                    # ran (counts is only non-None on that path)
+                    j = row_pos[i]
+                    diag = diagnosis_from_counts(
+                        counts[j], int(feas[j]), total_nodes,
+                        quota_admitted=True)
+                else:
+                    diag = explain_pod(
+                        self.snapshot.state, batch, self.config, i,
+                        quota_admitted=True,
+                    )
                 if (admitted is not None and not admitted[i]
                         and diag.feasible_nodes > 0):
                     # nodes were available but the quota (as of this
                     # round's placements) says no: quota is the cause
+                    if diag.reason_counts is not None:
+                        diag.reason_counts["quota"] = diag.feasible_nodes
                     diag = dataclasses.replace(
                         diag, quota_rejected=True, feasible_nodes=0)
                 result.failures[pod.name] = diag
@@ -1439,6 +1543,9 @@ class Scheduler:
                 gang = self.gangs.get(name)
                 if gang is not None:
                     gang.first_failure = None
+            if self.explain:
+                self._record_round_explanations(
+                    pods, result, fail_rows, failed_gangs, total_nodes)
 
         if self.enable_preemption and result.failures:
             with self.monitor.phase("PostFilter"):
@@ -1626,6 +1733,147 @@ class Scheduler:
             self._cand_cache = None
             raise
         return jnp.asarray(a_np), state, quota
+
+    # -- placement explainability (ISSUE 6) ---------------------------------
+
+    def _record_round_explanations(
+        self, pods, result: SchedulingResult, fail_rows: list[int],
+        failed_gangs: set[str], total_nodes: int,
+    ) -> None:
+        """Assemble :class:`PlacementExplanation` records for every pod
+        the round left unplaced — solve failures (from the device
+        kernel's counts now on the diagnoses), degraded-suspended pods,
+        and rejected-gang parkees — then publish the cluster rollups:
+        ``unschedulable_pods{reason}`` (top reason per pod),
+        ``filter_reject_fraction{reason}``, and the flight recorder's
+        ``top_unschedulable`` summary."""
+        from koordinator_tpu.ops import explain as ex
+        from koordinator_tpu.scheduler.explanation import PlacementExplanation
+
+        explanations: list[PlacementExplanation] = []
+        for i in fail_rows:
+            pod = pods[i]
+            if pod.name.startswith(RSV_POD_PREFIX):
+                # reservation vehicles retry next round; they are not
+                # user workloads (mirrors the auditor/tracing exclusion)
+                continue
+            diag = result.failures.get(pod.name)
+            if diag is None:
+                continue
+            # node_invalid counts PADDED state rows too (padding and
+            # removed nodes are the same validity bit) — it would swamp
+            # the real reasons, so the served explanation partitions
+            # only the LIVE nodes: feasible + sum(reasons) == total
+            reasons = {name: count
+                       for name, count in (diag.reason_counts or {}).items()
+                       if count > 0 and name != "node_invalid"}
+            feasible = diag.feasible_nodes
+            if (pod.gang is not None and pod.gang in failed_gangs
+                    and feasible > 0):
+                # nodes were individually feasible; the gang barrier
+                # (minMember/rollback) held the placement back
+                reasons["gang_barrier"] = feasible
+                feasible = 0
+            explanations.append(PlacementExplanation(
+                pod=pod.name, round=self.round_seq,
+                total_nodes=total_nodes, feasible_nodes=feasible,
+                reasons=reasons, trace_id=self.pod_trace_id(pod.name),
+                quota=pod.quota if diag.quota_rejected else None,
+                gang=pod.gang))
+        for name in self._last_suspended_names:
+            explanations.append(PlacementExplanation(
+                pod=name, round=self.round_seq, total_nodes=total_nodes,
+                feasible_nodes=0,
+                reasons={"degraded_suspended": total_nodes},
+                trace_id=self.pod_trace_id(name),
+                gang=getattr(self.pending.get(name), "gang", None)))
+        for name in self._last_gang_rejected_names:
+            explanations.append(PlacementExplanation(
+                pod=name, round=self.round_seq, total_nodes=total_nodes,
+                feasible_nodes=0, reasons={"gang_barrier": total_nodes},
+                trace_id=self.pod_trace_id(name),
+                gang=getattr(self.pending.get(name), "gang", None)))
+
+        top: dict[str, int] = {}
+        reason_sums: dict[str, int] = {}
+        for exp in explanations:
+            self.explain_ring.record(exp)
+            reason = exp.top_reason()
+            if reason is not None:
+                top[reason] = top.get(reason, 0) + 1
+            for name, count in exp.reasons.items():
+                reason_sums[name] = reason_sums.get(name, 0) + count
+        self._last_unschedulable_top = dict(
+            sorted(top.items(), key=lambda kv: (-kv[1], kv[0])))
+        # republish EVERY reason each round so a cleared reason reads 0
+        # instead of its last nonzero value lingering on the dashboard
+        for name in ex.REASON_NAMES:
+            metrics.unschedulable_pods.set(
+                float(top.get(name, 0)), labels={"reason": name})
+        if explanations and total_nodes:
+            denom = len(explanations) * total_nodes
+            for name, total in reason_sums.items():
+                metrics.filter_reject_fraction.observe(
+                    total / denom, labels={"reason": name})
+
+    def pod_explanation(self, name: str):
+        """Latest retained :class:`PlacementExplanation` for a pod."""
+        return self.explain_ring.get(name)
+
+    def explain_candidates(self, name: str, k: int = 5) -> list[dict] | None:
+        """Per-term score decomposition (ops/explain.decompose_scores) of
+        a pod's top-k candidate nodes — or, for a bound pod, its winning
+        node — against CURRENT state.  On-demand debug surface: one
+        small (1, N) score pass, no hot-path cost.  None = unknown pod.
+        """
+        from koordinator_tpu.ops import explain as ex
+        from koordinator_tpu.ops.assignment import score_pods
+
+        with self.lock:
+            pod = self.pending.get(name)
+            bound = self.bound.get(name)
+            if pod is None and bound is None:
+                return None
+            self.snapshot.flush()
+            state = self.snapshot.state
+
+            def decompose(batch, node_rows: np.ndarray) -> list[dict]:
+                cand = jnp.asarray(node_rows[None, :].astype(np.int32))
+                terms = {t: np.asarray(v)[0]
+                         for t, v in ex.decompose_scores(
+                             state, batch, self.config, cand).items()}
+                return [
+                    {"node": self.snapshot.node_name(int(r)) or str(int(r)),
+                     "score": int(terms["total"][j]),
+                     "terms": {t: int(v[j]) for t, v in terms.items()
+                               if t != "total"}}
+                    for j, r in enumerate(node_rows)
+                ]
+
+            if pod is not None:
+                batch = PodBatch.build(
+                    pod.requests[None].astype(np.int32),
+                    priority=np.array([pod.priority], np.int32),
+                    feasible=self.snapshot.feasibility_row(pod)[None],
+                    node_capacity=self.snapshot.capacity, capacity=16,
+                )
+                scores, feasible = score_pods(state, batch, self.config)
+                row = np.asarray(scores[0])
+                masked = np.where(np.asarray(feasible[0]), row, -1)
+                order = np.argsort(-masked, kind="stable")[:max(k, 1)]
+                order = order[masked[order] >= 0]
+                if order.size == 0:
+                    return []
+                return decompose(batch, order)
+            row_idx = self.snapshot.node_index.get(bound.node)
+            if row_idx is None:
+                return []
+            batch = PodBatch.build(
+                bound.requests[None].astype(np.int32),
+                node_capacity=self.snapshot.capacity, capacity=16)
+            out = decompose(batch, np.array([row_idx], np.int32))
+            out[0]["winner"] = True
+            return out
 
     def _commit_bind(
         self, pod: PodSpec, node: str, result: SchedulingResult,

@@ -73,10 +73,12 @@ def debug_profile_body(scheduler, seconds) -> dict:
         raise DebugApiError(409, str(e)) from None
 
 
-def debug_trace_body(scheduler, pod: str) -> Optional[dict]:
-    """The /debug/trace/<pod> payload (None = pod never traced); shared
-    by DebugService and the HTTP gateway.  ``pod`` may arrive
-    percent-encoded from either HTTP surface."""
+def debug_trace_body(scheduler, pod: str) -> dict:
+    """The /debug/trace/<pod> payload; shared by DebugService and the
+    HTTP gateway.  ``pod`` may arrive percent-encoded from either HTTP
+    surface.  Unknown pods raise a TYPED 404 :class:`DebugApiError` so
+    both surfaces serve the same status + body (previously the gateway
+    and DebugService each hand-rolled the mapping)."""
     from urllib.parse import unquote
 
     from koordinator_tpu import tracing
@@ -84,10 +86,63 @@ def debug_trace_body(scheduler, pod: str) -> Optional[dict]:
     pod = unquote(pod)
     trace_id = scheduler.pod_trace_id(pod)
     if trace_id is None:
-        return None
+        raise DebugApiError(404, f"no trace recorded for pod {pod!r}")
     return {"pod": pod, "trace_id": trace_id,
             "spans": [s.to_doc() for s in
                       tracing.TRACER.spans_for_trace(trace_id)]}
+
+
+def debug_explain_body(scheduler, pod: str,
+                       params: dict | None = None) -> dict:
+    """The /debug/explain/<pod> payload (shared by DebugService and the
+    HTTP gateway): the pod's retained :class:`~koordinator_tpu.scheduler.
+    explanation.PlacementExplanation` (reject-reason node counts joined
+    to its trace_id and round) plus an on-demand per-term score
+    decomposition of its current winning/top-k candidate nodes.
+
+    ``?candidates=0`` skips the decomposition: it runs a (1, N) score
+    pass under the scheduler's round lock, which a single operator query
+    wants inline but a many-pod polling loop (tools/explain_summary.py)
+    must not serialize rounds behind.
+
+    Typed statuses: 404 for a pod the scheduler has never seen (no
+    explanation retained, not pending, not bound) and for reserve-pods
+    (``rsv::`` placement vehicles are not user workloads — query the
+    reservation via /apis/v1/reservations instead)."""
+    from urllib.parse import unquote
+
+    from koordinator_tpu.scheduler.scheduler import RSV_POD_PREFIX
+
+    want_candidates = str((params or {}).get("candidates", "1")
+                          ).strip().lower() not in ("0", "false", "no",
+                                                    "off")
+    pod = unquote(pod)
+    if pod.startswith(RSV_POD_PREFIX):
+        raise DebugApiError(
+            404, f"reserve-pod {pod!r} is a placement vehicle, not a "
+                 "workload; its reservation is served at "
+                 "/apis/v1/reservations")
+    explanation = scheduler.pod_explanation(pod)
+    pending = pod in scheduler.pending
+    bound = scheduler.bound.get(pod)
+    if explanation is None and not pending and bound is None:
+        raise DebugApiError(
+            404, f"no explanation recorded for pod {pod!r}")
+    body = {
+        "pod": pod,
+        "status": ("bound" if bound is not None
+                   else "pending" if pending else "gone"),
+        "trace_id": scheduler.pod_trace_id(pod),
+        "explanation": explanation.to_doc() if explanation else None,
+        "explain_enabled": scheduler.explain,
+    }
+    if bound is not None:
+        body["node"] = bound.node
+    if want_candidates:
+        candidates = scheduler.explain_candidates(pod)
+        if candidates is not None:
+            body["candidates"] = candidates
+    return body
 
 
 class DebugService:
@@ -163,6 +218,7 @@ class DebugService:
         self.register("/debug/slo", self._slo)
         self.register("/debug/profile", self._profile)
         self.register_prefix("/debug/trace/", self._trace)
+        self.register_prefix("/debug/explain/", self._explain)
 
     def _nodes(self, params: dict) -> object:
         snapshot = self.scheduler.snapshot
@@ -264,11 +320,15 @@ class DebugService:
                                   params.get("seconds", 1.0))
 
     def _trace(self, pod: str, params: dict) -> object:
-        """Recent spans of one pod's trace (/debug/trace/<pod>)."""
-        body = debug_trace_body(self.scheduler, pod)
-        if body is None:
-            raise KeyError(f"no trace recorded for pod {pod!r}")
-        return body
+        """Recent spans of one pod's trace (/debug/trace/<pod>);
+        unknown pods surface the builder's typed 404."""
+        return debug_trace_body(self.scheduler, pod)
+
+    def _explain(self, pod: str, params: dict) -> object:
+        """One pod's placement explanation (/debug/explain/<pod>):
+        reject-reason node counts + candidate score decomposition
+        (?candidates=0 skips the decomposition for polling loops)."""
+        return debug_explain_body(self.scheduler, pod, params)
 
     def record_scores(self, pods: list, scores: np.ndarray,
                       node_names: list[str]) -> None:

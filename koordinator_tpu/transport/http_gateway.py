@@ -23,7 +23,16 @@ Routes (all JSON bodies/responses unless noted):
     GET  /debug/rounds?size=N          -> the scheduler's round flight
                                           recorder, newest first
     GET  /debug/trace/<pod>            -> recent spans of the pod's
-                                          trace (scheduler binaries)
+                                          trace (scheduler binaries);
+                                          typed 404 for unknown pods
+    GET  /debug/explain/<pod>          -> the pod's placement
+                                          explanation: reject-reason
+                                          node counts joined to its
+                                          trace_id/round, plus per-term
+                                          score decomposition of its
+                                          winning/top-k candidates;
+                                          typed 404 for unknown pods
+                                          and rsv:: reserve-pods
     GET  /debug/slo                    -> the SLO burn-rate engine's
                                           evaluation (specs, windows,
                                           burn rates, breach state)
@@ -161,6 +170,7 @@ class HttpGateway:
     _LEASE = re.compile(r"^/v1/leases/([A-Za-z0-9._-]+)$")
     _HOOK = re.compile(r"^/v1/hooks/([A-Za-z0-9._-]+)$")
     _TRACE = re.compile(r"^/debug/trace/(.+)$")
+    _EXPLAIN = re.compile(r"^/debug/explain/(.+)$")
 
     def _route(self, req, method: str) -> None:
         path = req.path.split("?", 1)[0]
@@ -179,6 +189,9 @@ class HttpGateway:
         m = self._TRACE.match(path)
         if m and method == "GET":
             return self._debug_trace(req, m.group(1))
+        m = self._EXPLAIN.match(path)
+        if m and method == "GET":
+            return self._debug_explain(req, m.group(1))
         if method == "POST" and path == "/v1/state":
             return self._state_push(req)
         if method == "POST" and path == "/v1/solve":
@@ -329,15 +342,41 @@ class HttpGateway:
             return req._reply(e.status, {"error": e.message})
 
     def _debug_trace(self, req, pod: str) -> None:
+        """Typed statuses ride the shared builder's DebugApiError (404
+        for unknown pods) — the same mapping the DebugService applies,
+        so the two surfaces cannot drift."""
         if self.scheduler is None:
             return req._reply(501, {"error": "no scheduler attached"})
-        from koordinator_tpu.scheduler.services import debug_trace_body
+        from koordinator_tpu.scheduler.services import (
+            DebugApiError,
+            debug_trace_body,
+        )
 
-        body = debug_trace_body(self.scheduler, pod)
-        if body is None:
-            return req._reply(404, {"error": f"no trace recorded for "
-                                    f"pod {pod!r}"})
-        return req._reply(200, body)
+        try:
+            return req._reply(200, debug_trace_body(self.scheduler, pod))
+        except DebugApiError as e:
+            return req._reply(e.status, {"error": e.message})
+
+    def _debug_explain(self, req, pod: str) -> None:
+        """One pod's placement explanation (reject-reason counts +
+        candidate score decomposition; ?candidates=0 skips the
+        decomposition for polling loops); 404s are typed via the shared
+        builder for unknown pods and rsv:: reserve-pods."""
+        if self.scheduler is None:
+            return req._reply(501, {"error": "no scheduler attached"})
+        from urllib.parse import parse_qsl
+
+        from koordinator_tpu.scheduler.services import (
+            DebugApiError,
+            debug_explain_body,
+        )
+
+        params = dict(parse_qsl(req.path.partition("?")[2]))
+        try:
+            return req._reply(200, debug_explain_body(self.scheduler, pod,
+                                                      params))
+        except DebugApiError as e:
+            return req._reply(e.status, {"error": e.message})
 
     def _solve(self, req) -> None:
         if self.scheduler is None:

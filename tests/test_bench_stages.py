@@ -21,14 +21,21 @@ def test_stage_profiler_smoke():
     assert proc.returncode == 0, proc.stderr[-2000:]
     records = [json.loads(line) for line in proc.stdout.splitlines()]
     stages = {r["stage"] for r in records}
-    assert stages == {"rtt_floor", "score", "select_approx",
+    assert stages == {"provenance", "rtt_floor", "score", "select_approx",
                       "select_chunked", "rounds",
-                      "refresh_incremental_1pct"}, stages
+                      "refresh_incremental_1pct",
+                      "explain_compact_1pct", "explain_full_batch"}, stages
     by_stage = {r["stage"]: r for r in records}
     # every timed stage produced a positive per-iteration time
     for name in ("score", "select_approx", "select_chunked", "rounds",
-                 "refresh_incremental_1pct"):
+                 "refresh_incremental_1pct", "explain_compact_1pct",
+                 "explain_full_batch"):
         assert by_stage[name]["ms_per_iter"] > 0, by_stage[name]
+    # the stage capture stamps code provenance for later promotion
+    assert "commit" in by_stage["provenance"]
+    # the explain overhead stages price themselves against the solve
+    assert "pct_of_solve" in by_stage["explain_compact_1pct"]
+    assert "within_5pct" in by_stage["explain_compact_1pct"]
     # the rounds stage really assigned pods (256 pods, ample capacity)
     assert by_stage["rounds"]["assigned_per_iter"] > 0
 
@@ -158,3 +165,47 @@ def test_bench_recall_smoke():
     assert rec["candidate_recall_mean_256p_128n"] >= 0.8
     assert rec["assigned_frac_exact_256p_128n"] >= 0.9
     assert rec["assigned_frac_approx_256p_128n"] >= 0.9
+
+
+def test_latest_probe_stages_promotion(tmp_path):
+    """A recent bench_stages capture promotes into a zero record's extra
+    (staged capture with provenance instead of all-or-nothing); captures
+    whose commit cannot be tied to HEAD promote WITH a caveat — they are
+    marked partial evidence, never refused like the headline."""
+    sys.path.insert(0, REPO)
+    from bench import _git_head, _latest_probe_stages
+
+    head = _git_head()["commit"]
+    d = tmp_path / "probe_results"
+    d.mkdir()
+    assert _latest_probe_stages(str(d)) is None
+    (d / "stages_1.jsonl").write_text("\n".join([
+        json.dumps({"stage": "provenance", "commit": head, "dirty": False}),
+        json.dumps({"stage": "score", "ms_per_iter": 12.5}),
+        json.dumps({"stage": "rounds", "ms_per_iter": 3.2}),
+    ]))
+    rec = _latest_probe_stages(str(d))
+    assert rec["source"] == "stages_1.jsonl"
+    assert rec["stages"]["score"]["ms_per_iter"] == 12.5
+    assert rec["capture_commit"] == head
+    assert "caveat" not in rec
+    # a NEWER unstamped capture wins but carries a caveat
+    (d / "stages_2.jsonl").write_text(
+        json.dumps({"stage": "score", "ms_per_iter": 1.0}))
+    rec = _latest_probe_stages(str(d))
+    assert rec["source"] == "stages_2.jsonl"
+    assert "caveat" in rec
+
+
+def test_device_alive_kinds():
+    """_device_alive classifies failures into structured error kinds
+    (ROADMAP item 1's diagnosis split); on the CPU test backend the
+    probe must come back clean."""
+    sys.path.insert(0, REPO)
+    from bench import DEVICE_ERROR_KINDS, _device_alive
+
+    assert set(DEVICE_ERROR_KINDS) == {
+        "no_devices_enumerated", "probe_kernel_hung", "transfer_stall",
+        "probe_error"}
+    ok, kind, err = _device_alive(120.0)
+    assert ok and kind == "" and err == ""

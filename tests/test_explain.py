@@ -1,0 +1,454 @@
+"""Placement explainability (ISSUE 6): device-side reject-reason
+accounting, /debug/explain on both surfaces, unschedulability rollups.
+
+Covers the acceptance criteria:
+- reason-count EXACTNESS: a hand-built fixture where every reason code
+  fires, device counts vs a NumPy oracle (both the hand-computed
+  expectations and ``diagnosis.explain_pod``);
+- jit-cache flatness: toggling explain on/off adds no per-round
+  recompiles after warmup (``ops/introspection`` counters);
+- end-to-end: a pod infeasible for a known mix of reasons stays pending
+  and ``/debug/explain/<pod>`` on BOTH surfaces reports exact per-reason
+  node counts carrying the pod's trace_id, with
+  ``unschedulable_pods{reason}`` matching;
+- typed 404s for unknown pods, reserve-pods, and the trace route, on
+  both surfaces; degraded-mode suspension explanations.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu import metrics
+from koordinator_tpu.api.qos import QoSClass
+from koordinator_tpu.api.resources import (
+    NUM_RESOURCE_DIMS,
+    ResourceDim,
+    resource_vector,
+)
+from koordinator_tpu.ops import explain as ex
+from koordinator_tpu.ops.assignment import ScoringConfig, score_pods
+from koordinator_tpu.quota.tree import QuotaTree
+from koordinator_tpu.scheduler import NodeSpec, PodSpec
+from koordinator_tpu.scheduler.diagnosis import (
+    diagnosis_from_counts,
+    explain_pod,
+)
+from koordinator_tpu.scheduler.scheduler import GangRecord, RSV_POD_PREFIX
+from koordinator_tpu.scheduler.services import DebugService
+from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
+from koordinator_tpu.transport.http_gateway import HttpGateway
+
+from tests.test_scheduler import mk_scheduler, node, pod
+
+R = NUM_RESOURCE_DIMS
+CPU, MEM = ResourceDim.CPU, ResourceDim.MEMORY
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+class TestReasonCountExactness:
+    """The 3-pod x 4-node fixture where every node-level reason fires,
+    asserted against hand-built expectations AND the explain_pod
+    NumPy oracle."""
+
+    def _fixture(self):
+        alloc = np.zeros((4, R), np.int32)
+        alloc[:, CPU] = [10_000, 100, 10_000, 10_000]
+        alloc[:, MEM] = [10_000, 10_000, 100, 10_000]
+        usage = np.zeros((4, R), np.int32)
+        usage[3, CPU] = 9_900        # node 3: over the 65% cpu threshold
+        state = ClusterState.from_arrays(alloc, usage=usage, capacity=4)
+        cfg = ScoringConfig.default()
+
+        reqs = np.zeros((3, R), np.int32)
+        reqs[:, CPU] = 1_000
+        reqs[:, MEM] = 1_000
+        reqs[2, MEM] = 0             # pod 2 requests no memory
+        feasible = np.ones((3, 4), bool)
+        feasible[1, 0] = False       # pod 1: affinity excludes node 0
+        batch = PodBatch.build(reqs, feasible=feasible, node_capacity=4,
+                               capacity=4)
+        return state, batch, cfg
+
+    def test_device_counts_equal_numpy_oracle(self):
+        state, batch, cfg = self._fixture()
+        counts, feas = jax.jit(ex.explain_counts)(state, batch, cfg)
+        counts, feas = np.asarray(counts), np.asarray(feas)
+
+        expected = {
+            # pod 0: n0 feasible; n1 fit_cpu; n2 fit_memory; n3 threshold
+            0: ({"fit_cpu": 1, "fit_memory": 1, "usage_threshold": 1}, 1),
+            # pod 1: same but n0 lost to affinity -> 0 feasible
+            1: ({"fit_cpu": 1, "fit_memory": 1, "usage_threshold": 1,
+                 "affinity": 1}, 0),
+            # pod 2: no memory request -> n2's tiny memory never excludes
+            # it from FIT, but the estimator's default memory estimate
+            # (200 MiB vs 100 allocatable) pushes n2 over the memory
+            # usage threshold; n3 is over on cpu as before
+            2: ({"fit_cpu": 1, "usage_threshold": 2}, 1),
+        }
+        for i, (reasons, n_feasible) in expected.items():
+            got = {name: int(counts[i, j])
+                   for j, name in enumerate(ex.REASON_NAMES)
+                   if counts[i, j]}
+            assert got == reasons, (i, got)
+            assert int(feas[i]) == n_feasible
+            # partition invariant: every valid node counted exactly once
+            assert int(feas[i]) + int(counts[i].sum()) == 4
+            # the host oracle agrees bit for bit
+            oracle = explain_pod(state, batch, cfg, i)
+            derived = diagnosis_from_counts(counts[i], feas[i],
+                                            oracle.total_nodes)
+            assert oracle.reason_counts == derived.reason_counts
+            assert oracle.feasible_nodes == derived.feasible_nodes
+            assert oracle.insufficient_resources == \
+                derived.insufficient_resources
+            assert oracle.usage_over_threshold == \
+                derived.usage_over_threshold
+            assert oracle.affinity_mismatch == derived.affinity_mismatch
+
+    def test_invalid_pod_rows_count_nothing(self):
+        state, batch, cfg = self._fixture()
+        counts, feas = ex.explain_counts(state, batch, cfg)
+        # row 3 is padding (valid=False): all zero
+        assert int(np.asarray(counts)[3].sum()) == 0
+        assert int(np.asarray(feas)[3]) == 0
+
+    def test_decomposition_sums_to_composite_score(self):
+        state, batch, cfg = self._fixture()
+        scores, _ = score_pods(state, batch, cfg)
+        cand = jnp.asarray(
+            np.tile(np.arange(4, dtype=np.int32), (batch.capacity, 1)))
+        terms = ex.decompose_scores(state, batch, cfg, cand)
+        la, fp, sc = (np.asarray(terms[t])
+                      for t in ("loadaware", "fitplus", "scarce"))
+        weighted = (
+            la * int(cfg.loadaware_plugin_weight)
+            + fp * int(cfg.fitplus_plugin_weight)
+            + sc * int(cfg.scarce_plugin_weight))
+        assert (np.asarray(terms["total"]) == weighted).all()
+        assert (np.asarray(terms["total"])[:3] ==
+                np.asarray(scores)[:3, :4]).all()
+
+
+class TestSchedulerExplainEndToEnd:
+    def _mixed_reason_scheduler(self):
+        """A pod infeasible for a known mix: fit_cpu on two nodes,
+        fit_memory on one, usage_threshold on one, affinity on one, and
+        elastic quota blocking the single otherwise-feasible node."""
+        nodes = [
+            node("n-ok", cpu=64_000, mem=65_536),
+            node("n-cpu1", cpu=500, mem=65_536),
+            node("n-cpu2", cpu=500, mem=65_536),
+            NodeSpec(name="n-mem",
+                     allocatable=resource_vector(cpu=64_000, memory=100)),
+            node("n-hot", cpu=10_000, mem=65_536, usage_cpu=9_500),
+            NodeSpec(name="n-taint",
+                     allocatable=resource_vector(cpu=64_000, memory=65_536),
+                     taints={"reserved": "special"}),
+        ]
+        total = np.asarray(resource_vector(cpu=1, memory=1), np.int64)
+        tree = QuotaTree(total_resource=total)
+        tree.add("starved", min=np.zeros_like(total),
+                 max=np.asarray(resource_vector(cpu=1, memory=1), np.int64))
+        tree.refresh_runtime()
+        sched, _ = mk_scheduler(
+            nodes, config=ScoringConfig.default(), quota_tree=tree,
+            trace_pods=True)
+        sched.enqueue(pod("stuck", cpu=1_000, mem=500, quota="starved"))
+        return sched
+
+    EXPECTED = {"fit_cpu": 2, "fit_memory": 1, "usage_threshold": 1,
+                "affinity": 1, "quota": 1}
+
+    def test_exact_counts_on_both_surfaces_with_trace_id(self):
+        sched = self._mixed_reason_scheduler()
+        res = sched.schedule_round()
+        assert "stuck" in res.failures
+        assert res.failures["stuck"].quota_rejected
+
+        svc = DebugService(sched)
+        status, body = svc.handle("/debug/explain/stuck")
+        assert status == 200
+        exp = body["explanation"]
+        assert exp["reasons"] == self.EXPECTED
+        assert exp["feasible_nodes"] == 0
+        assert exp["total_nodes"] == 6
+        assert exp["top_reason"] == "quota"
+        assert exp["quota"] == "starved"
+        assert body["trace_id"] == sched.pod_trace_id("stuck")
+        assert exp["trace_id"] == sched.pod_trace_id("stuck")
+        assert exp["round"] == sched.round_seq
+
+        gw = HttpGateway(scheduler=sched)
+        gw.start()
+        try:
+            status, doc = _get(gw.port, "/debug/explain/stuck")
+            assert status == 200
+            assert doc == body   # shared builder: surfaces cannot drift
+        finally:
+            gw.stop()
+
+        # cluster rollup: the gauge matches, every other reason reads 0
+        assert metrics.unschedulable_pods.value(
+            labels={"reason": "quota"}) == 1.0
+        for reason in ex.REASON_NAMES:
+            if reason != "quota":
+                assert metrics.unschedulable_pods.value(
+                    labels={"reason": reason}) == 0.0
+        # flight record carries the round's rollup
+        assert sched.flight_recorder.last().top_unschedulable == \
+            {"quota": 1}
+        # rejection-fraction histogram observed each firing reason
+        observed = {labels.get("reason"): total for labels, _, total, _
+                    in metrics.filter_reject_fraction.state()}
+        for reason in self.EXPECTED:
+            assert observed.get(reason, 0) >= 1, (reason, observed)
+        # capacity slack published per dim
+        assert 0.0 <= metrics.capacity_slack.value(
+            labels={"dim": "cpu"}) <= 1.0
+
+    def test_counts_match_host_oracle_after_round(self):
+        """The served counts equal explain_pod recomputed against the
+        post-round state (nothing placed, so state is unchanged)."""
+        sched = self._mixed_reason_scheduler()
+        sched.schedule_round()
+        spec = sched.pending["stuck"]
+        batch = PodBatch.build(
+            spec.requests[None].astype(np.int32),
+            feasible=sched.snapshot.feasibility_row(spec)[None],
+            node_capacity=sched.snapshot.capacity, capacity=16)
+        oracle = explain_pod(sched.snapshot.state, batch, sched.config, 0)
+        exp = sched.pod_explanation("stuck")
+        oracle_reasons = {k: v for k, v in oracle.reason_counts.items()
+                          if v > 0 and k != "node_invalid"}
+        served = dict(exp.reasons)
+        served.pop("quota")          # host-attributed gate
+        assert served == oracle_reasons
+        assert oracle.feasible_nodes == self.EXPECTED["quota"]
+
+    def test_bound_pod_explanation_has_winner_decomposition(self):
+        sched, _ = mk_scheduler([node("n1"), node("n2")])
+        sched.enqueue(pod("p1", cpu=4_000))
+        res = sched.schedule_round()
+        assert "p1" in res.assignments
+        svc = DebugService(sched)
+        status, body = svc.handle("/debug/explain/p1")
+        assert status == 200
+        assert body["status"] == "bound"
+        assert body["node"] == res.assignments["p1"]
+        assert body["candidates"][0]["winner"]
+        assert set(body["candidates"][0]["terms"]) == \
+            {"loadaware", "fitplus", "scarce"}
+
+    def test_pending_pod_candidates_decompose(self):
+        sched, _ = mk_scheduler([node("n1")])      # one 16k-cpu node
+        sched.enqueue(pod("first", cpu=9_000))
+        sched.enqueue(pod("second", cpu=9_000))
+        sched.schedule_round()   # one placed, one stuck on capacity
+        stuck = [n for n in ("first", "second") if n in sched.pending]
+        assert len(stuck) == 1
+        svc = DebugService(sched)
+        status, body = svc.handle(f"/debug/explain/{stuck[0]}")
+        assert status == 200
+        assert body["status"] == "pending"
+        # no node fits right now -> no candidates, but the explanation
+        # names why
+        assert body["candidates"] == []
+        assert body["explanation"]["reasons"] == {"fit_cpu": 1}
+
+    def test_candidates_opt_out_param_on_both_surfaces(self):
+        """?candidates=0 skips the (1, N) decomposition pass — the
+        polling-loop mode tools/explain_summary.py uses."""
+        sched, _ = mk_scheduler([node("n1", cpu=1_000)])
+        sched.enqueue(pod("big", cpu=50_000))
+        sched.schedule_round()
+        svc = DebugService(sched)
+        status, body = svc.handle("/debug/explain/big",
+                                  {"candidates": "0"})
+        assert status == 200
+        assert "candidates" not in body
+        assert body["explanation"]["reasons"] == {"fit_cpu": 1}
+        gw = HttpGateway(scheduler=sched)
+        gw.start()
+        try:
+            status, doc = _get(gw.port,
+                               "/debug/explain/big?candidates=0")
+            assert status == 200
+            assert "candidates" not in doc
+        finally:
+            gw.stop()
+
+    def test_degraded_suspension_explained(self):
+        sched, _ = mk_scheduler([node("n1")])
+        sched.degraded = True    # watchdog disabled; state set directly
+        sched.enqueue(pod("be-pod", qos=int(QoSClass.BE)))
+        res = sched.schedule_round()
+        assert res.round_pods == 0 or "be-pod" not in res.assignments
+        exp = sched.pod_explanation("be-pod")
+        assert exp.reasons == {"degraded_suspended": 1}
+        assert exp.top_reason() == "degraded_suspended"
+        assert metrics.unschedulable_pods.value(
+            labels={"reason": "degraded_suspended"}) == 1.0
+        svc = DebugService(sched)
+        status, body = svc.handle("/debug/explain/be-pod")
+        assert status == 200
+        assert body["explanation"]["top_reason"] == "degraded_suspended"
+
+    def test_rejected_gang_parkees_explained(self):
+        sched, _ = mk_scheduler([node("n1")])
+        sched.register_gang(GangRecord(name="g", min_member=2))
+        sched.gangs["g"].rejected = True
+        sched.enqueue(pod("g-member", gang="g"))
+        sched.schedule_round()
+        exp = sched.pod_explanation("g-member")
+        assert exp.reasons == {"gang_barrier": 1}
+        assert exp.gang == "g"
+
+    def test_kill_switch_disables_accounting(self):
+        sched, _ = mk_scheduler([node("n1", cpu=1_000)], explain=False)
+        sched.enqueue(pod("big", cpu=50_000))
+        res = sched.schedule_round()
+        # diagnosis still works (host fallback path)...
+        assert res.failures["big"].insufficient_resources == 1
+        assert res.failures["big"].reason_counts is not None
+        # ...but nothing is retained or rolled up
+        assert sched.pod_explanation("big") is None
+        assert metrics.unschedulable_pods.value(
+            labels={"reason": "fit_cpu"}) == 0.0
+        svc = DebugService(sched)
+        status, body = svc.handle("/debug/explain/big")
+        assert status == 200            # pod known (pending)
+        assert body["explanation"] is None
+        assert body["explain_enabled"] is False
+
+
+class TestTypedDebugErrors:
+    def test_unknown_pod_404_on_both_surfaces(self):
+        sched, _ = mk_scheduler([node("n1")])
+        svc = DebugService(sched)
+        for path in ("/debug/explain/ghost", "/debug/trace/ghost"):
+            status, body = svc.handle(path)
+            assert status == 404, path
+            assert "ghost" in body["error"]
+        gw = HttpGateway(scheduler=sched)
+        gw.start()
+        try:
+            for path in ("/debug/explain/ghost", "/debug/trace/ghost"):
+                status, body = _get(gw.port, path)
+                assert status == 404, path
+                assert "ghost" in body["error"]
+        finally:
+            gw.stop()
+
+    def test_reserve_pod_404_names_the_reservation_surface(self):
+        sched, _ = mk_scheduler([node("n1")])
+        name = RSV_POD_PREFIX + "cache-warm"
+        svc = DebugService(sched)
+        status, body = svc.handle(f"/debug/explain/{name}")
+        assert status == 404
+        assert "reservations" in body["error"]
+        gw = HttpGateway(scheduler=sched)
+        gw.start()
+        try:
+            status, body = _get(
+                gw.port, "/debug/explain/rsv%3A%3Acache-warm")
+            assert status == 404
+            assert "reservations" in body["error"]
+        finally:
+            gw.stop()
+
+    def test_degraded_mode_explain_still_serves(self):
+        """Degraded mode must not break the debug surface: a suspended
+        pod's explanation serves 200 on both surfaces while degraded."""
+        sched, _ = mk_scheduler([node("n1")])
+        sched.degraded = True
+        sched.enqueue(pod("be-held", qos=int(QoSClass.BE)))
+        sched.schedule_round()
+        gw = HttpGateway(scheduler=sched)
+        gw.start()
+        try:
+            status, body = _get(gw.port, "/debug/explain/be-held")
+            assert status == 200
+            assert body["explanation"]["reasons"] == \
+                {"degraded_suspended": 1}
+        finally:
+            gw.stop()
+
+
+class TestJitCacheFlatAcrossToggles:
+    def test_explain_toggle_adds_no_per_round_recompiles(self):
+        """After warmup, toggling explain on/off/on adds no recompiles:
+        the explain kernel keeps its own shape-bucketed cache entry and
+        the solve's shapes are untouched by the flag."""
+        from koordinator_tpu.ops.assignment import ScoringConfig
+        from koordinator_tpu.scheduler import ClusterSnapshot, Scheduler
+
+        # a UNIQUE node capacity (N64) so the explain kernel's compile
+        # demonstrably happens in THIS test: jax shares compiled
+        # executables for the same function+shape across Scheduler
+        # instances, and another test's N16 warmup would otherwise
+        # satisfy this scheduler's first call cache-hot
+        snap = ClusterSnapshot(capacity=64)
+        snap.upsert_node(node("n1", cpu=2_000))
+        sched = Scheduler(snap, config=ScoringConfig.default())
+        sched.enqueue(pod("fits", cpu=500))
+        sched.enqueue(pod("stuck", cpu=50_000))
+
+        def total_recompiles():
+            return sum(v for _, v in metrics.solver_recompiles.items())
+
+        sched.schedule_round()             # warmup: compiles everything
+        sched.schedule_round()             # second round: caches warm
+        warm = total_recompiles()
+        explain_misses = sched._explain_counts.misses
+        for flag in (False, True, False, True):
+            sched.explain = flag
+            sched.schedule_round()
+        assert total_recompiles() == warm
+        assert sched._explain_counts.misses == explain_misses
+        # the kernel is instrumented like every solver entry point
+        assert metrics.solver_recompiles.value(
+            labels={"fn": "explain_counts",
+                    "shape": "P32xN64"}) >= 1.0
+
+
+class TestBenchStageSmoke:
+    def test_explain_overhead_stage_runs_on_cpu(self, tmp_path):
+        """The bench_stages explain stages are smoke-runnable on CPU and
+        emit the pct_of_solve verdict (acceptance: the overhead guard is
+        a measured stage)."""
+        import subprocess
+        import sys
+
+        env = dict(__import__("os").environ, JAX_PLATFORMS="cpu",
+                   KOORD_STAGES_NODES="64", KOORD_STAGES_PODS="128",
+                   KOORD_STAGES_METHODS="exact")
+        proc = subprocess.run(
+            [sys.executable, "bench_stages.py", "--smoke"],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=__import__("os").path.join(
+                __import__("os").path.dirname(
+                    __import__("os").path.abspath(__file__)), ".."))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        stages = {}
+        for line in proc.stdout.strip().splitlines():
+            doc = json.loads(line)
+            stages[doc["stage"]] = doc
+        assert "provenance" in stages          # stage-promotion stamp
+        assert "explain_compact_1pct" in stages
+        assert "explain_full_batch" in stages
+        assert "pct_of_solve" in stages["explain_compact_1pct"]
+        assert "within_5pct" in stages["explain_compact_1pct"]

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Pretty-print a pod's placement explanation from a live endpoint.
+
+Fetches ``GET /debug/explain/<pod>`` from a running scheduler binary's
+HTTP gateway (or any DebugService-backed server) and renders the
+reject-reason breakdown, the candidate score decomposition, and the
+trace linkage as an operator-readable block:
+
+    python tools/explain_dump.py --url http://127.0.0.1:10251 --pod my-pod
+    python tools/explain_dump.py --url ... --pod my-pod --json   # raw body
+
+Exit codes: 0 = explanation printed, 3 = typed 404 (unknown pod /
+reserve-pod), 1 = transport or server error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+
+def render(body: dict) -> str:
+    lines = []
+    pod = body.get("pod", "?")
+    status = body.get("status", "?")
+    head = f"pod {pod!r} [{status}"
+    if body.get("node"):
+        head += f" on {body['node']}"
+    head += "]"
+    if body.get("trace_id"):
+        head += f"  trace={body['trace_id']}"
+    lines.append(head)
+    exp = body.get("explanation")
+    if exp:
+        lines.append(f"  round {exp['round']}: {exp['summary']}")
+        reasons = sorted(exp.get("reasons", {}).items(),
+                         key=lambda kv: (-kv[1], kv[0]))
+        total = max(exp.get("total_nodes", 0), 1)
+        for name, count in reasons:
+            pct = 100.0 * count / total
+            lines.append(f"    {name:<22} {count:>8} nodes  ({pct:5.1f}%)")
+        if exp.get("quota"):
+            lines.append(f"    quota: {exp['quota']}")
+        if exp.get("gang"):
+            lines.append(f"    gang:  {exp['gang']}")
+    elif body.get("explain_enabled") is False:
+        lines.append("  (explain accounting disabled: --no-explain)")
+    else:
+        lines.append("  (no failure explanation recorded)")
+    candidates = body.get("candidates")
+    if candidates:
+        lines.append("  candidates (per-term score decomposition, "
+                     "current state):")
+        for c in candidates:
+            terms = " ".join(f"{t}={v}" for t, v in
+                             sorted(c.get("terms", {}).items()))
+            winner = " <- winner" if c.get("winner") else ""
+            lines.append(f"    {c['node']:<20} total={c['score']:<5} "
+                         f"{terms}{winner}")
+    elif candidates == []:
+        lines.append("  candidates: none feasible right now")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="explain_dump")
+    parser.add_argument("--url", required=True,
+                        help="base URL of the scheduler's HTTP gateway, "
+                             "e.g. http://127.0.0.1:10251")
+    parser.add_argument("--pod", required=True)
+    parser.add_argument("--json", action="store_true",
+                        help="dump the raw endpoint body")
+    # the candidate decomposition runs an on-demand (1, N) score pass on
+    # a possibly-busy scheduler: leave headroom before declaring it dead
+    parser.add_argument("--timeout", type=float, default=30.0)
+    args = parser.parse_args(argv)
+
+    url = (args.url.rstrip("/") + "/debug/explain/"
+           + urllib.parse.quote(args.pod, safe=""))
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            body = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            doc = json.loads(e.read())
+        except (ValueError, OSError):
+            doc = {"error": str(e)}
+        print(f"{e.code}: {doc.get('error', doc)}", file=sys.stderr)
+        return 3 if e.code == 404 else 1
+    except (urllib.error.URLError, OSError) as e:
+        print(f"unreachable: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(body, indent=2, default=str))
+    else:
+        print(render(body))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

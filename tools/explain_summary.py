@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Explainability-surface smoke: drive a fresh scheduler, read back
+``/debug/explain`` live, and tally top unschedulable reasons.
+
+Assembles the scheduler binary (HTTP gateway + explain accounting),
+runs a synthetic workload engineered so pods fail for a KNOWN mix of
+reasons (resource fit, usage threshold, affinity, elastic quota), then
+queries the gateway exactly as an operator would and prints an
+end-of-run top-unschedulable-reasons summary.
+
+FAILS (exit 1) if any pod ends the run pending with zero recorded
+reasons — an unexplained pending pod means the reject-reason accounting
+lost a pod, which is the regression this smoke exists to catch.
+``tools/soak.sh`` runs it at the end of every soak (SOAK_EXPLAIN=0
+disables); the numbers describe THIS driver's synthetic run, not the
+soak's pytest windows (those run in their own interpreters).
+
+    python tools/explain_summary.py --rounds 3
+    python tools/explain_summary.py --json      # raw per-pod bodies
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.parse
+import urllib.request
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="explain_summary")
+    parser.add_argument("--rounds", type=int, default=3)
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+
+    import numpy as np
+
+    from koordinator_tpu.api.resources import resource_vector
+    from koordinator_tpu.cmd.binaries import main_koord_scheduler
+    from koordinator_tpu.quota.tree import QuotaTree
+    from koordinator_tpu.scheduler.snapshot import NodeSpec, PodSpec
+
+    asm = main_koord_scheduler(
+        ["--disable-leader-election", "--http-port", "0"])
+    sched = asm.component
+    try:
+        # a small cluster where every reject reason has a home: n0 fits
+        # everything, n1 is CPU-starved, n2 memory-starved, n3 sits over
+        # the LoadAware usage threshold, n4 carries a label no pod
+        # tolerates by default
+        sched.snapshot.upsert_node(NodeSpec(
+            name="n0", allocatable=resource_vector(cpu=64_000,
+                                                   memory=65_536)))
+        sched.snapshot.upsert_node(NodeSpec(
+            name="n1", allocatable=resource_vector(cpu=500,
+                                                   memory=65_536)))
+        sched.snapshot.upsert_node(NodeSpec(
+            name="n2", allocatable=resource_vector(cpu=64_000,
+                                                   memory=128)))
+        sched.snapshot.upsert_node(NodeSpec(
+            name="n3", allocatable=resource_vector(cpu=10_000,
+                                                   memory=65_536),
+            usage=resource_vector(cpu=9_500)))
+        sched.snapshot.upsert_node(NodeSpec(
+            name="n4", allocatable=resource_vector(cpu=64_000,
+                                                   memory=65_536),
+            taints={"reserved": "special"}))
+        # elastic quota with no headroom: quota-blocked pods
+        total = np.asarray(resource_vector(cpu=1, memory=1), np.int64)
+        tree = QuotaTree(total_resource=total)
+        tree.add("starved", min=np.zeros_like(total),
+                 max=np.asarray(resource_vector(cpu=1, memory=1),
+                                np.int64))
+        tree.refresh_runtime()
+        sched.quota_tree = tree
+
+        # fits nowhere but n0... which the giant pod then saturates
+        sched.enqueue(PodSpec(name="giant",
+                              requests=resource_vector(cpu=60_000,
+                                                       memory=60_000)))
+        sched.enqueue(PodSpec(name="crowded-out",
+                              requests=resource_vector(cpu=8_000,
+                                                       memory=8_000)))
+        sched.enqueue(PodSpec(name="quota-blocked", quota="starved",
+                              requests=resource_vector(cpu=1_000,
+                                                       memory=512)))
+        for _ in range(max(args.rounds, 1)):
+            sched.schedule_round()
+
+        port = asm.gateway.port
+        pending = [name for name in sched.pending]
+        unexplained: list[str] = []
+        tally: dict[str, int] = {}
+        bodies: dict[str, dict] = {}
+        for name in pending:
+            # candidates=0: this loop polls every pending pod and only
+            # needs the retained reason counts, not the per-pod score
+            # decomposition (which runs a score pass under the round
+            # lock)
+            url = (f"http://127.0.0.1:{port}/debug/explain/"
+                   + urllib.parse.quote(name, safe="") + "?candidates=0")
+            body = None
+            # generous timeout + one retry: the first request pays the
+            # on-demand candidate decomposition's cold jit compile, and
+            # a transport timeout must not masquerade as the
+            # zero-recorded-reasons regression this smoke exists to
+            # catch (tools/explain_dump.py documents the same hazard)
+            for attempt in range(2):
+                try:
+                    with urllib.request.urlopen(url, timeout=60) as resp:
+                        body = json.loads(resp.read())
+                    break
+                except urllib.error.HTTPError as e:
+                    unexplained.append(f"{name}: HTTP {e.code}")
+                    break
+                except Exception as e:  # noqa: BLE001 — transport
+                    if attempt == 1:
+                        unexplained.append(f"{name}: unreachable: {e}")
+            if body is None:
+                continue
+            bodies[name] = body
+            exp = body.get("explanation") or {}
+            reasons = {k: v for k, v in (exp.get("reasons") or {}).items()
+                       if v > 0}
+            if not reasons:
+                unexplained.append(
+                    f"{name}: pending with zero recorded reasons")
+                continue
+            top = exp.get("top_reason") or max(
+                reasons.items(), key=lambda kv: (kv[1], kv[0]))[0]
+            tally[top] = tally.get(top, 0) + 1
+
+        if args.json:
+            print(json.dumps(bodies, indent=2, default=str))
+        print("== top unschedulable reasons (/debug/explain, fresh "
+              "synthetic drive — not a readback of the soak windows)")
+        for reason, count in sorted(tally.items(),
+                                    key=lambda kv: (-kv[1], kv[0])):
+            print(f"  {reason:<22} {count} pod(s)")
+        if not pending:
+            print("  (no pods pending)")
+        if unexplained:
+            for line in unexplained:
+                print(f"ERROR: {line}", file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        asm.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -31,6 +31,16 @@
 #                                     windows run in their own
 #                                     interpreters, so this is not a
 #                                     readback of the soak itself)
+#         SOAK_EXPLAIN (default 1)    1 = end the run with the
+#                                     explainability smoke:
+#                                     tools/explain_summary.py drives a
+#                                     fresh scheduler+gateway with pods
+#                                     failing for a known reason mix,
+#                                     prints the top-unschedulable-
+#                                     reasons tally from live
+#                                     /debug/explain, and FAILS the
+#                                     soak if any pod ends pending with
+#                                     zero recorded reasons
 #         SOAK_CHAOS   (default 0)    1 = also sweep the chaos
 #                                     fault-injection suite (tests/
 #                                     test_chaos.py, `chaos` marker)
@@ -51,6 +61,7 @@ OUT=${SOAK_OUT:-soak_results}
 CHAOS=${SOAK_CHAOS:-0}
 TRACE=${SOAK_TRACE:-0}
 SLO=${SOAK_SLO:-1}
+EXPLAIN=${SOAK_EXPLAIN:-1}
 mkdir -p "$OUT"
 ts=$(date +%Y%m%d_%H%M%S)
 log="$OUT/soak_$ts.log"
@@ -136,6 +147,24 @@ for ((w = 0; w < WINDOWS; w++)); do
         fi
     fi
 done
+
+if [ "$EXPLAIN" = "1" ]; then
+    # explainability smoke BEFORE the tally so its verdict counts in the
+    # JSON: top-unschedulable-reasons summary from a live
+    # /debug/explain, failing if any pod ends pending with zero
+    # recorded reasons (an unexplained pending pod = the reject-reason
+    # accounting lost a pod)
+    echo "== explainability smoke (tools/explain_summary.py)" | tee -a "$log"
+    if python tools/explain_summary.py >> "$log" 2>&1; then
+        tail -8 "$log"
+        total_passed=$((total_passed + 1))
+    else
+        tail -8 "$log"
+        total_failed=$((total_failed + 1))
+        failures="$failures;explain smoke: pending pod with zero recorded"
+        failures="$failures reasons or surface failure (see log)"
+    fi
+fi
 
 # the tally is built by python so failure text (quotes, backslashes in
 # assert messages) can never produce invalid JSON

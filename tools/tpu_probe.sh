@@ -30,10 +30,17 @@ if [ -f "$OUT/CAPTURED" ]; then
 fi
 
 while true; do
-    if timeout 150 python -c 'import jax, jax.numpy as jnp
-x = jnp.ones((256, 256), jnp.bfloat16)
-assert float(jax.jit(lambda a: (a @ a).sum())(x)) == 256.0 * 256 * 256' \
-            >/dev/null 2>&1; then
+    # bench._device_alive classifies HOW the probe failed
+    # (no_devices_enumerated / probe_kernel_hung / transfer_stall /
+    # probe_error) so probe.log records a diagnosis per ROADMAP item 1,
+    # not four rounds of undifferentiated "tunnel down"
+    kind=$(timeout 200 python -c 'import sys
+sys.path.insert(0, "/root/repo")
+from bench import _device_alive
+ok, kind, err = _device_alive(150.0)
+print("ok" if ok else kind)' 2>/dev/null | tail -1)
+    [ -z "$kind" ] && kind=probe_process_hung
+    if [ "$kind" = "ok" ]; then
         ts=$(date +%Y%m%d_%H%M%S)
         echo "$(date -Is) tunnel up, capturing" >> "$OUT/probe.log"
         # NO_PROBE_PROMOTION: this run must produce a FRESH measurement
@@ -61,7 +68,7 @@ assert float(jax.jit(lambda a: (a @ a).sum())(x)) == 256.0 * 256 * 256' \
             exit 0
         fi
     else
-        echo "$(date -Is) tunnel down" >> "$OUT/probe.log"
+        echo "$(date -Is) tunnel down ($kind)" >> "$OUT/probe.log"
     fi
     sleep 240
 done
