@@ -190,6 +190,7 @@ class ReconnectingSidecarClient:
                     self._close_locked()
             raise
 
+    # koordlint: guarded-by(self._lock)
     def _close_locked(self) -> None:
         if self._client is not None:
             self._client.close()

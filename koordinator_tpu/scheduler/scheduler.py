@@ -618,7 +618,7 @@ class Scheduler:
             if self.pending.pop(RSV_POD_PREFIX + name, None) is not None:
                 self._pending_rev += 1
 
-    def _reservation_tick(self, now: float) -> None:
+    def _reservation_tick(self, now: float) -> None:  # koordlint: guarded-by(self.lock)
         """Expire reservations; move Pending ones toward Available (pinned
         node: direct, with a fit check; else enqueue a reserve-pod)."""
         for name in self.reservations.fail_stale_instances(self.snapshot):
@@ -659,7 +659,7 @@ class Scheduler:
                     tolerations=dict(spec.tolerations))
                 self._pending_rev += 1
 
-    def _reservation_prepass(self, pods, batch, quota, result):
+    def _reservation_prepass(self, pods, batch, quota, result):  # koordlint: guarded-by(self.lock)
         """Reservation-first exact solve over owner-matched pods (plugin.go
         Reserve + nominator semantics): matched pods allocate from their
         reservation's remainder before the general solve sees them.  Returns
@@ -735,6 +735,7 @@ class Scheduler:
             batch = batch.replace(valid=batch.valid & ~jnp.asarray(mask))
         return batch, (new_quota if new_quota is not None else quota)
 
+    # koordlint: guarded-by(self.lock)
     def _commit_reserve_pod(self, pod: PodSpec, node: str,
                             result: SchedulingResult, now: float) -> None:
         """The reserve-pod 'bound': its Reservation becomes Available.  The
@@ -818,7 +819,7 @@ class Scheduler:
         in-process binding drain alike)."""
         self.snapshot.mark_sync(self.clock())
 
-    def _staleness_tick(self, now: float) -> None:
+    def _staleness_tick(self, now: float) -> None:  # koordlint: guarded-by(self.lock)
         """Flip degraded mode on/off from the sync feed's age.  Runs at
         round start under the round lock."""
         threshold = self.staleness_threshold_sec
@@ -1244,7 +1245,7 @@ class Scheduler:
                             labels={"dim": dim.name.lower()})
             return result
 
-    def _schedule_round(self) -> SchedulingResult:
+    def _schedule_round(self) -> SchedulingResult:  # koordlint: guarded-by(self.lock)
         # set at round START — before any early return, including the
         # barrier gate, so a backlog building behind the barrier is visible.
         # Synthetic rsv:: reserve-pods are excluded (they are placement
@@ -1571,7 +1572,7 @@ class Scheduler:
 
     # -- incremental delta-driven solve -------------------------------------
 
-    def _block_timed(self, value):
+    def _block_timed(self, value):  # koordlint: guarded-by(self.lock)
         """Block on a jitted solve's result, accumulating the wait into
         the round's device-time share (``_solve_device_s``).  The
         dispatch itself returns immediately (async execution), so time
@@ -1582,7 +1583,7 @@ class Scheduler:
         self._solve_device_s += time.perf_counter() - t0
         return value
 
-    def _solve_batch_incremental(self, pods, batch: PodBatch, quota):
+    def _solve_batch_incremental(self, pods, batch: PodBatch, quota):  # koordlint: guarded-by(self.lock)
         """The no-gang batch solve with the persistent device-resident
         candidate cache (ops/batch_assign incremental section).
 
@@ -1736,6 +1737,7 @@ class Scheduler:
 
     # -- placement explainability (ISSUE 6) ---------------------------------
 
+    # koordlint: guarded-by(self.lock)
     def _record_round_explanations(
         self, pods, result: SchedulingResult, fail_rows: list[int],
         failed_gangs: set[str], total_nodes: int,
@@ -1875,6 +1877,7 @@ class Scheduler:
             out[0]["winner"] = True
             return out
 
+    # koordlint: guarded-by(self.lock)
     def _commit_bind(
         self, pod: PodSpec, node: str, result: SchedulingResult,
         charge_quota: bool = True,

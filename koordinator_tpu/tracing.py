@@ -222,6 +222,7 @@ class JsonlExporter:
                 self.errors += 1
                 self._close_locked()
 
+    # koordlint: guarded-by(self._lock)
     def _close_locked(self) -> None:
         if self._file is not None:
             try:

@@ -331,3 +331,110 @@ class TestSidecarPushSolveStress:
             for c in clients:
                 c.close()
             asm.stop()
+
+
+# -- koordlint debug-mode lock instrumentation -------------------------------
+
+
+@pytest.fixture
+def lock_recorder():
+    """Debug-mode instrumented-lock fixture (tools/koordlint/runtime):
+    wraps lock attributes in recording proxies so a test can assert the
+    acquisition order real threads take against the STATIC lock-order
+    graph the lock-discipline analyzer builds."""
+    from tools.koordlint.runtime import LockOrderRecorder
+
+    return LockOrderRecorder()
+
+
+class _CountingBinding:
+    """In-process sync subscriber with its own lock — the scheduler-
+    binding shape: applies block on a private lock, never the service's."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.applied = 0
+
+    def _bump(self):
+        with self.lock:
+            self.applied += 1
+
+    def node_upsert(self, entry, arrs):
+        self._bump()
+
+    def node_usage(self, entry, arrs):
+        self._bump()
+
+    def node_remove(self, name):
+        self._bump()
+
+    def pod_add(self, entry, arrs):
+        self._bump()
+
+    def pod_remove(self, name):
+        self._bump()
+
+
+def test_lock_order_runtime_validates_static_graph(lock_recorder):
+    """The static lock-order graph survives contact with real threads.
+
+    Drives a StateSyncService (two locks: the RLock service lock and the
+    binding-drain lock) plus an attached binding from N writer threads,
+    with every lock wrapped in a recording proxy, then asserts:
+
+    - the commit path's documented invariant holds at runtime: the
+      service lock is NEVER held while the binding queue drains
+      (deltasync._store_and_commit releases before _drain_bindings);
+    - every observed acquisition edge merged with the lock-discipline
+      analyzer's static edges still forms an acyclic graph — a dynamic
+      order the analyzer could not see must not invert a static edge.
+    """
+    import os
+
+    import koordinator_tpu
+    from koordinator_tpu.api.resources import resource_vector
+    from koordinator_tpu.transport.deltasync import StateSyncService
+    from tools.koordlint.runtime import (
+        find_cycle,
+        instrument_locks,
+        static_lock_edges,
+    )
+
+    service = StateSyncService()
+    binding = _CountingBinding()
+    service.attach_binding(binding)
+    names = instrument_locks(service, lock_recorder)
+    names += instrument_locks(binding, lock_recorder)
+    SVC = "koordinator_tpu.transport.deltasync.StateSyncService"
+    assert f"{SVC}._lock" in names
+    assert f"{SVC}._binding_lock" in names
+
+    alloc = np.asarray(resource_vector(cpu=8_000, memory=16_384), np.int32)
+    req = np.asarray(resource_vector(cpu=500, memory=512), np.int32)
+
+    def writer(w):
+        for i in range(40):
+            service.upsert_node(f"w{w}-n{i}", alloc)
+            service.add_pod(f"w{w}-p{i}", req)
+            if i % 4 == 0:
+                service.remove_pod(f"w{w}-p{i}")
+
+    hammer(writer)
+    events = N_THREADS * (40 * 2 + 10)
+    assert binding.applied == events            # no lost drains
+    assert lock_recorder.acquisitions > events  # proxies really recorded
+
+    observed = lock_recorder.edge_pairs()
+    # the drain runs OUTSIDE the service lock — the deadlock-avoidance
+    # invariant deltasync documents, proven against real interleaving
+    assert (f"{SVC}._lock", f"{SVC}._binding_lock") not in observed
+    assert any(src == f"{SVC}._binding_lock"
+               and dst.endswith("_CountingBinding.lock")
+               for src, dst in observed), observed
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(koordinator_tpu.__file__)))
+    static = static_lock_edges(root)
+    assert static, "static lock graph unexpectedly empty"
+    cycle = find_cycle(static | observed)
+    assert cycle is None, f"static+observed lock graph has a cycle: {cycle}"

@@ -1,0 +1,288 @@
+"""koordlint self-tests: the analyzer corpus contract + the whole-tree
+gate (ISSUE 7).
+
+Pure AST — this file never imports jax (which is also the marker-audit
+rule it helps enforce).  Three layers:
+
+- **corpus**: every rule flags its seeded known-bad fixture (including
+  the reconstruction of the PR-1 ``ClusterState.zeros``
+  donation-aliasing bug) and stays silent on the known-good twin;
+- **tree**: ``python -m tools.koordlint`` semantics over THIS repo —
+  zero unsuppressed findings, every suppression carries a reason, no
+  stale baseline entries;
+- **machinery**: inline ignores need reasons, reasonless baseline
+  entries are findings, CLI exit codes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools import koordlint
+from tools.koordlint.analyzers.donation_safety import DonationSafetyAnalyzer
+from tools.koordlint.analyzers.jit_host_sync import JitHostSyncAnalyzer
+from tools.koordlint.analyzers.lock_discipline import LockDisciplineAnalyzer
+from tools.koordlint.analyzers.marker_audit import MarkerAuditAnalyzer
+from tools.koordlint.analyzers.surface_parity import SurfaceParityAnalyzer
+from tools.koordlint.analyzers import dashboard_drift
+from tools.koordlint.core import Project, apply_suppressions, load_baseline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tools", "koordlint", "fixtures")
+
+
+def corpus(rule: str, kind: str, targets) -> Project:
+    return Project(os.path.join(FIXTURES, rule, kind), targets=targets)
+
+
+class TestJitHostSyncCorpus:
+    def analyzer(self):
+        return JitHostSyncAnalyzer(package="pkg",
+                                   root_paths=["pkg/solver.py"])
+
+    def test_bad_corpus_flags_every_seeded_sync(self):
+        findings = self.analyzer().run(
+            corpus("jit_host_sync", "bad", ("pkg",)))
+        messages = "\n".join(f.message for f in findings)
+        for needle in ("host cast float()", "host cast int()",
+                       "host cast bool()", "numpy.asarray()",
+                       ".item() on a traced value",
+                       "data-dependent branch",
+                       "host iteration over a traced value"):
+            assert needle in messages, f"missing: {needle}\n{messages}"
+        # the interprocedural edge: the helper's branch is flagged too
+        assert any("_helper" in f.message for f in findings)
+
+    def test_good_corpus_is_clean(self):
+        assert self.analyzer().run(
+            corpus("jit_host_sync", "good", ("pkg",))) == []
+
+
+class TestDonationSafetyCorpus:
+    def test_bad_corpus_flags_the_pr1_bug_class(self):
+        findings = DonationSafetyAnalyzer(package="pkg").run(
+            corpus("donation_safety", "bad", ("pkg",)))
+        messages = "\n".join(f.message for f in findings)
+        # the PR-1 ClusterState.zeros reconstruction: one buffer,
+        # several pytree fields
+        assert "aliased across pytree fields" in messages
+        assert "ClusterState.zeros" in messages   # names the bug class
+        assert "read after being donated" in messages
+        assert "also passed at position" in messages
+        assert len(findings) == 3
+
+    def test_good_corpus_is_clean(self):
+        assert DonationSafetyAnalyzer(package="pkg").run(
+            corpus("donation_safety", "good", ("pkg",))) == []
+
+
+class TestLockDisciplineCorpus:
+    def test_bad_corpus_flags_cycle_and_bare_write(self):
+        findings = LockDisciplineAnalyzer(package="pkg").run(
+            corpus("lock_discipline", "bad", ("pkg",)))
+        messages = "\n".join(f.message for f in findings)
+        assert "lock-order cycle" in messages
+        assert "Informer._lock" in messages and "Store._lock" in messages
+        assert "race candidate" in messages
+        assert "bare in reset()" in messages
+        # multi-item `with a, b:` vs nested `with b: with a:` is a
+        # cycle too (the combined form acquires in sequence)
+        assert any("Combined._a" in f.message and "Combined._b"
+                   in f.message for f in findings), messages
+
+    def test_good_corpus_is_clean(self):
+        # guarded-by annotation honored, RLock reentrancy not a cycle,
+        # one-directional nesting not a cycle
+        assert LockDisciplineAnalyzer(package="pkg").run(
+            corpus("lock_discipline", "good", ("pkg",))) == []
+
+
+class TestSurfaceParityCorpus:
+    def analyzer(self):
+        return SurfaceParityAnalyzer(services_path="services.py",
+                                     gateway_path="gateway.py")
+
+    def test_bad_corpus_flags_drift_and_typed_error_gap(self):
+        findings = self.analyzer().run(
+            corpus("surface_parity", "bad",
+                   ("services.py", "gateway.py")))
+        messages = "\n".join(f.message for f in findings)
+        assert "no matching dispatch" in messages        # route drift
+        assert "never registers it" in messages          # reverse drift
+        assert "without calling the shared builder" in messages
+        assert "does not map it" in messages             # DebugApiError
+
+    def test_good_corpus_is_clean(self):
+        assert self.analyzer().run(
+            corpus("surface_parity", "good",
+                   ("services.py", "gateway.py"))) == []
+
+
+class TestDashboardDriftCorpus:
+    KNOWN = {"koord_registered_fixture_total",
+             "koord_registered_fixture_seconds_bucket"}
+
+    def test_bad_dashboard_flags_unregistered_metric(self):
+        errors, checked = dashboard_drift.check_file(
+            os.path.join(FIXTURES, "dashboard_drift", "bad_dash.json"),
+            self.KNOWN)
+        assert checked == 2
+        assert len(errors) == 1
+        assert "koord_metric_that_does_not_exist_total" in errors[0]
+
+    def test_good_dashboard_is_clean(self):
+        errors, checked = dashboard_drift.check_file(
+            os.path.join(FIXTURES, "dashboard_drift", "good_dash.json"),
+            self.KNOWN)
+        assert (errors, checked) == ([], 2)
+
+
+class TestMarkerAuditCorpus:
+    def test_bad_corpus_flags_marker_and_import(self):
+        findings = MarkerAuditAnalyzer().run(
+            corpus("marker_audit", "bad", ("tests",)))
+        messages = "\n".join(f.message for f in findings)
+        assert "marked chaos but not slow" in messages
+        assert "module-scope jax import" in messages
+        assert len(findings) == 2   # the properly-marked test is silent
+
+    def test_good_corpus_is_clean(self):
+        assert MarkerAuditAnalyzer().run(
+            corpus("marker_audit", "good", ("tests",))) == []
+
+
+class TestWholeTree:
+    """The gate tier-1 actually enforces: the shipped tree is clean."""
+
+    def test_tree_is_clean_and_baseline_is_live(self):
+        result = koordlint.run(REPO)
+        assert result.findings == [], "\n".join(
+            f.render() for f in result.findings)
+        # the baseline is doing real work (grandfathered jax imports)
+        # and every suppression carries a reason by construction
+        assert result.suppressed
+        assert all(reason.strip() for _, reason in result.suppressed)
+        # no dead weight: every baseline entry still matches something
+        assert result.stale_baseline == []
+
+    def test_every_shipped_analyzer_has_a_corpus(self):
+        for cls in koordlint.ALL_ANALYZERS:
+            rule_dir = cls.name.replace("-", "_")
+            assert os.path.isdir(os.path.join(FIXTURES, rule_dir)), (
+                f"analyzer {cls.name} ships no fixture corpus")
+
+
+class TestSuppressionMachinery:
+    def _tmp_repo(self, tmp_path, body: str):
+        tests = tmp_path / "tests"
+        tests.mkdir()
+        (tests / "test_seeded.py").write_text(body)
+        return Project(str(tmp_path), targets=("tests",))
+
+    def test_inline_ignore_with_reason_suppresses(self, tmp_path):
+        project = self._tmp_repo(
+            tmp_path,
+            "import jax  "
+            "# koordlint: ignore[marker-audit] -- perf fixture needs "
+            "module-scope jax\n")
+        findings = MarkerAuditAnalyzer().run(project)
+        assert len(findings) == 1
+        result = apply_suppressions(project, findings, [])
+        assert result.findings == []
+        assert len(result.suppressed) == 1
+        assert "perf fixture" in result.suppressed[0][1]
+
+    def test_inline_ignore_without_reason_is_a_finding(self, tmp_path):
+        project = self._tmp_repo(
+            tmp_path, "import jax  # koordlint: ignore[marker-audit]\n")
+        findings = MarkerAuditAnalyzer().run(project)
+        result = apply_suppressions(project, findings, [])
+        rules = [f.rule for f in result.findings]
+        assert "marker-audit" in rules       # NOT suppressed
+        assert "lint-hygiene" in rules       # and the bad ignore flagged
+
+    def test_baseline_entry_without_reason_is_a_finding(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        path.write_text(json.dumps({"suppressions": [
+            {"rule": "marker-audit", "path": "tests/test_x.py"}]}))
+        entries, problems = load_baseline(str(path))
+        assert entries == []
+        assert len(problems) == 1
+        assert problems[0].rule == "lint-hygiene"
+
+    def test_shipped_baseline_reasons_are_mandatory_and_present(self):
+        entries, problems = load_baseline(koordlint.BASELINE_PATH)
+        assert problems == []
+        assert entries
+        assert all(e.reason.strip() for e in entries)
+
+
+class TestCli:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.koordlint", *args],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+
+    def test_clean_tree_exits_zero(self):
+        # one rule keeps the subprocess cheap; the FULL suite's
+        # whole-tree gate runs in-process in TestWholeTree above
+        proc = self._run("--rule", "marker-audit")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "koordlint OK" in proc.stdout
+        assert "suppressed-with-reason" in proc.stdout
+
+    def test_new_finding_exits_nonzero(self, tmp_path):
+        bad = tmp_path / "tests"
+        bad.mkdir()
+        (bad / "test_fresh.py").write_text("import jax\n")
+        (tmp_path / "koordinator_tpu").mkdir()
+        (tmp_path / "tools").mkdir()
+        proc = self._run("--root", str(tmp_path))
+        assert proc.returncode == 1
+        assert "module-scope jax import" in proc.stdout
+
+    def test_unknown_rule_exits_two(self):
+        assert self._run("--rule", "no-such-rule").returncode == 2
+
+    def test_list_rules_names_all_six(self):
+        proc = self._run("--list-rules")
+        assert proc.returncode == 0
+        for rule in ("jit-host-sync", "donation-safety", "lock-discipline",
+                     "surface-parity", "dashboard-drift", "marker-audit"):
+            assert rule in proc.stdout
+
+
+class TestRuntimeHelpers:
+    def test_find_cycle(self):
+        from tools.koordlint.runtime import find_cycle
+
+        assert find_cycle({("a", "b"), ("b", "c")}) is None
+        cycle = find_cycle({("a", "b"), ("b", "c"), ("c", "a")})
+        assert cycle is not None and set(cycle) >= {"a", "b", "c"}
+
+    def test_instrumented_lock_records_edges(self):
+        import threading
+
+        from tools.koordlint.runtime import (
+            LockOrderRecorder,
+            instrument_locks,
+        )
+
+        class Box:
+            def __init__(self):
+                self._outer = threading.Lock()
+                self._inner = threading.Lock()
+
+        box = Box()
+        rec = LockOrderRecorder()
+        # explicit cls_name overrides the module.Class default
+        assert set(instrument_locks(box, rec, cls_name="Box")) == {
+            "Box._outer", "Box._inner"}
+        with box._outer:
+            with box._inner:
+                pass
+        assert ("Box._outer", "Box._inner") in rec.edge_pairs()
+        assert ("Box._inner", "Box._outer") not in rec.edge_pairs()

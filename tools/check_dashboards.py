@@ -1,107 +1,48 @@
 #!/usr/bin/env python
-"""Static dashboard drift check (ISSUE 5 satellite).
+"""Static dashboard drift check — thin CLI shim.
 
-Every metric name referenced by a PromQL ``expr`` in
-``dashboards/*.json`` must be a series the registries in
-``koordinator_tpu/metrics.py`` actually register (histograms expand to
-their ``_bucket``/``_sum``/``_count`` series).  A renamed or deleted
-instrument otherwise leaves a silently-empty dashboard panel — drift an
-operator only notices during an incident.
+The implementation moved into the koordlint framework
+(``tools/koordlint/analyzers/dashboard_drift.py``, the fifth analyzer);
+this entry point stays so existing wiring keeps working unchanged:
 
-Usage:
     python tools/check_dashboards.py                  # shipped dashboards
     python tools/check_dashboards.py path/to/dash.json ...
 
-Exit 0 = clean; exit 1 lists every unregistered reference.  Also
-invoked by tools/soak.sh (a soak against drifted dashboards is wasted
-evidence) and by tests/test_metrics.py (positive + negative).
+Exit 0 = clean; exit 1 lists every unregistered reference.  Also invoked
+by tools/soak.sh (which now ALSO runs the full ``python -m
+tools.koordlint`` suite first) and by tests/test_metrics.py
+(positive + negative).
 """
 
 from __future__ import annotations
 
-import glob
-import json
 import os
-import re
 import sys
 
-#: metric-name shapes our registries can produce (see metrics.Registry
-#: prefixes); anything else inside an expr is PromQL syntax, not a metric
-METRIC_RE = re.compile(r"\b(koord_[a-z0-9_]+|koordlet_[a-z0-9_]+)\b")
+# runnable from anywhere AND importable via spec_from_file_location:
+# the repo root (tools/' parent) must be on sys.path before the
+# koordlint import below
+_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+if os.path.abspath(_ROOT) not in (os.path.abspath(p) for p in sys.path):
+    sys.path.insert(0, _ROOT)
 
-#: floor on total references checked across the shipped dashboards: a
-#: regex or schema rot that silently matched nothing would otherwise
-#: turn the check into a rubber stamp
-MIN_REFERENCES = 10
+from tools.koordlint.analyzers.dashboard_drift import (  # noqa: E402
+    METRIC_RE,
+    MIN_REFERENCES,
+    check_dashboards,
+    check_file,
+    known_series,
+)
 
-
-def known_series() -> set[str]:
-    """Every series name the component registries expose (histogram
-    sub-series included)."""
-    from koordinator_tpu import metrics as m
-
-    names: set[str] = set()
-    for reg in m.ALL_REGISTRIES:
-        for full, metric in reg.items():
-            names.add(full)
-            if isinstance(metric, m.Histogram):
-                names.update({f"{full}_bucket", f"{full}_sum",
-                              f"{full}_count"})
-    return names
-
-
-def check_file(path: str, known: set[str]) -> tuple[list[str], int]:
-    """(errors, references_checked) for one dashboard JSON."""
-    errors: list[str] = []
-    checked = 0
-    try:
-        with open(path) as f:
-            doc = json.load(f)
-    except (OSError, ValueError) as e:
-        return [f"{path}: unreadable dashboard JSON: {e}"], 0
-    for panel in doc.get("panels", []):
-        title = panel.get("title", "?")
-        for target in panel.get("targets", []):
-            expr = target.get("expr", "")
-            for name in METRIC_RE.findall(expr):
-                checked += 1
-                if name not in known:
-                    errors.append(
-                        f"{path}: panel {title!r} references "
-                        f"unregistered metric {name!r}")
-    return errors, checked
-
-
-def check_dashboards(paths: list[str] | None = None,
-                     known: set[str] | None = None) -> tuple[list[str], int]:
-    """(errors, total references checked) over the given dashboards
-    (default: the repo's dashboards/*.json)."""
-    if paths is None:
-        root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "..", "dashboards")
-        paths = sorted(glob.glob(os.path.join(root, "*.json")))
-        if not paths:
-            return ["no dashboards found under dashboards/"], 0
-    known = known if known is not None else known_series()
-    errors: list[str] = []
-    checked = 0
-    for path in paths:
-        errs, n = check_file(path, known)
-        errors.extend(errs)
-        checked += n
-    return errors, checked
+__all__ = ["METRIC_RE", "MIN_REFERENCES", "check_dashboards", "check_file",
+           "known_series", "main"]
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     paths = argv or None
-    errors, checked = check_dashboards(paths)
-    if paths is None and checked < MIN_REFERENCES:
-        errors.append(
-            f"only {checked} metric references found across the shipped "
-            f"dashboards (< {MIN_REFERENCES}): the extractor regex or "
-            "dashboard schema drifted and the check is no longer "
-            "checking anything")
+    errors, checked = check_dashboards(paths,
+                                       root=os.path.abspath(_ROOT))
     if errors:
         for err in errors:
             print(err, file=sys.stderr)
@@ -111,8 +52,4 @@ def main(argv: list[str] | None = None) -> int:
 
 
 if __name__ == "__main__":
-    # runnable from anywhere: the repo root (koordinator_tpu's parent)
-    # must be importable
-    sys.path.insert(0, os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), ".."))
     raise SystemExit(main())

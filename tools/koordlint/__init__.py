@@ -1,0 +1,58 @@
+"""koordlint: repo-native static analysis for the invariants generic
+linters cannot see — jit purity, buffer-donation safety, lock
+discipline, debug-surface parity, dashboard drift, and test-marker
+conventions.  ``python -m tools.koordlint`` runs the whole suite; see
+docs/static_analysis.md for the rule catalog and suppression policy.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .analyzers import ALL_ANALYZERS, make_all
+from .core import (
+    Analyzer,
+    Finding,
+    Project,
+    RunResult,
+    apply_suppressions,
+    load_baseline,
+)
+
+#: the shipped baseline (suppressions with reasons)
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+
+def run(root: str, rules: list[str] | None = None,
+        baseline_path: str | None = BASELINE_PATH) -> RunResult:
+    """Run the suite over a repo root and apply suppressions.
+
+    ``rules`` filters analyzers by name; ``baseline_path=None`` skips
+    the baseline (raw findings — what ``--no-baseline`` shows).
+    """
+    project = Project(root)
+    analyzers = [a for a in make_all()
+                 if rules is None or a.name in rules]
+    findings: list[Finding] = []
+    for analyzer in analyzers:
+        findings.extend(analyzer.run(project))
+    for path, sf in sorted(project.files.items()):
+        if sf.parse_error:
+            findings.append(Finding("lint-hygiene", path, 1,
+                                    f"file does not parse: "
+                                    f"{sf.parse_error}", ""))
+    baseline, hygiene = ([], []) if baseline_path is None else (
+        load_baseline(baseline_path))
+    if rules is not None:
+        # a filtered run only consults (and staleness-checks) the
+        # entries of the rules that actually ran
+        baseline = [e for e in baseline if e.rule in rules]
+    result = apply_suppressions(project, findings, baseline)
+    result.findings.extend(hygiene)
+    return result
+
+
+__all__ = ["run", "Project", "Finding", "Analyzer", "RunResult",
+           "ALL_ANALYZERS", "make_all", "apply_suppressions",
+           "load_baseline", "BASELINE_PATH"]

@@ -1,0 +1,82 @@
+"""CLI: ``python -m tools.koordlint`` from the repo root.
+
+Exit codes: 0 = clean (suppressed findings allowed), 1 = unsuppressed
+findings (the CI/soak gate), 2 = bad usage.  Runs at the head of
+tools/soak.sh and inside tier-1 via tests/test_koordlint.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from . import BASELINE_PATH, make_all, run
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.koordlint",
+        description="repo-native static analysis (jit purity, donation "
+                    "safety, lock discipline, surface parity, dashboard "
+                    "drift, marker audit)")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: this package's repo)")
+    parser.add_argument("--rule", action="append", dest="rules",
+                        metavar="NAME",
+                        help="run only the named rule (repeatable)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore baseline.json (show every finding)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable findings on stdout")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for a in make_all():
+            print(f"{a.name:18s} {a.description}")
+        return 0
+
+    root = args.root or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "..")
+    root = os.path.abspath(root)
+    known = {a.name for a in make_all()} | {"lint-hygiene"}
+    for r in args.rules or []:
+        if r not in known:
+            print(f"unknown rule {r!r}; try --list-rules", file=sys.stderr)
+            return 2
+
+    t0 = time.perf_counter()
+    result = run(root, rules=args.rules,
+                 baseline_path=None if args.no_baseline else BASELINE_PATH)
+    elapsed = time.perf_counter() - t0
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_doc() for f in result.findings],
+            "suppressed": [{"finding": f.to_doc(), "reason": r}
+                           for f, r in result.suppressed],
+            "stale_baseline": [e.rule + ":" + e.path
+                               for e in result.stale_baseline],
+            "elapsed_s": round(elapsed, 3),
+        }, indent=2))
+        return 0 if result.ok else 1
+
+    for f in result.findings:
+        print(f.render())
+    for entry in result.stale_baseline:
+        print(f"note: stale baseline entry matched nothing: "
+              f"[{entry.rule}] {entry.path!r} ({entry.reason})",
+              file=sys.stderr)
+    status = "FAIL" if result.findings else "OK"
+    print(f"koordlint {status}: {len(result.findings)} finding(s), "
+          f"{len(result.suppressed)} suppressed-with-reason, "
+          f"{elapsed:.2f}s")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

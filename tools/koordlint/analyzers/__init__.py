@@ -1,0 +1,24 @@
+"""Analyzer registry: the rule catalog ``python -m tools.koordlint``
+runs (docs/static_analysis.md documents each rule + how to add one)."""
+
+from __future__ import annotations
+
+from .dashboard_drift import DashboardDriftAnalyzer
+from .donation_safety import DonationSafetyAnalyzer
+from .jit_host_sync import JitHostSyncAnalyzer
+from .lock_discipline import LockDisciplineAnalyzer
+from .marker_audit import MarkerAuditAnalyzer
+from .surface_parity import SurfaceParityAnalyzer
+
+ALL_ANALYZERS = (
+    JitHostSyncAnalyzer,
+    DonationSafetyAnalyzer,
+    LockDisciplineAnalyzer,
+    SurfaceParityAnalyzer,
+    DashboardDriftAnalyzer,
+    MarkerAuditAnalyzer,
+)
+
+
+def make_all() -> list:
+    return [cls() for cls in ALL_ANALYZERS]

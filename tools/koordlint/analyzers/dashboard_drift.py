@@ -1,0 +1,145 @@
+"""dashboard-drift: dashboard PromQL vs the metrics registries.
+
+Folded in from ``tools/check_dashboards.py`` (PR 5 satellite; that
+script remains as a thin CLI shim over this analyzer so its entry point
+and soak.sh wiring stay byte-compatible).  Every metric name referenced
+by a PromQL ``expr`` in ``dashboards/*.json`` must be a series the
+registries in ``koordinator_tpu/metrics.py`` actually register
+(histograms expand to ``_bucket``/``_sum``/``_count``) — a renamed or
+deleted instrument otherwise leaves a silently-empty panel an operator
+only notices mid-incident.
+
+This is the one analyzer that imports repo code (``koordinator_tpu.
+metrics`` — dependency-free, no JAX) instead of parsing it: the registry
+is built by module-level instrument constructors, so importing IS the
+static ground truth.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+from ..core import Analyzer, Finding, Project
+
+#: metric-name shapes our registries can produce (see metrics.Registry
+#: prefixes); anything else inside an expr is PromQL syntax, not a metric
+METRIC_RE = re.compile(r"\b(koord_[a-z0-9_]+|koordlet_[a-z0-9_]+)\b")
+
+#: floor on total references checked across the shipped dashboards: a
+#: regex or schema rot that silently matched nothing would otherwise
+#: turn the check into a rubber stamp
+MIN_REFERENCES = 10
+
+
+def known_series(root: str | None = None) -> set[str]:
+    """Every series name the component registries expose (histogram
+    sub-series included).
+
+    Validates against the IMPORTED ``koordinator_tpu.metrics`` — when
+    the package is already loaded in this process, ``root`` cannot
+    redirect the import (Python module caching); ``root`` only helps a
+    cold process find the package.  The inserted path is removed again
+    so the probe never leaks into ``sys.path``.
+    """
+    inserted = None
+    if root and not any(os.path.abspath(p) == os.path.abspath(root)
+                        for p in sys.path):
+        inserted = root
+        sys.path.insert(0, root)
+    try:
+        from koordinator_tpu import metrics as m
+    finally:
+        if inserted is not None:
+            try:
+                sys.path.remove(inserted)
+            except ValueError:
+                pass
+
+    names: set[str] = set()
+    for reg in m.ALL_REGISTRIES:
+        for full, metric in reg.items():
+            names.add(full)
+            if isinstance(metric, m.Histogram):
+                names.update({f"{full}_bucket", f"{full}_sum",
+                              f"{full}_count"})
+    return names
+
+
+def check_file(path: str, known: set[str]) -> tuple[list[str], int]:
+    """(errors, references_checked) for one dashboard JSON."""
+    errors: list[str] = []
+    checked = 0
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable dashboard JSON: {e}"], 0
+    for panel in doc.get("panels", []):
+        title = panel.get("title", "?")
+        for target in panel.get("targets", []):
+            expr = target.get("expr", "")
+            for name in METRIC_RE.findall(expr):
+                checked += 1
+                if name not in known:
+                    errors.append(
+                        f"{path}: panel {title!r} references "
+                        f"unregistered metric {name!r}")
+    return errors, checked
+
+
+def check_dashboards(paths: list[str] | None = None,
+                     known: set[str] | None = None,
+                     root: str | None = None) -> tuple[list[str], int]:
+    """(errors, total references checked) over the given dashboards
+    (default: the repo's dashboards/*.json)."""
+    default_set = paths is None
+    if paths is None:
+        base = root or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", "..", "..")
+        paths = sorted(glob.glob(os.path.join(base, "dashboards", "*.json")))
+        if not paths:
+            return ["no dashboards found under dashboards/"], 0
+    known = known if known is not None else known_series(root)
+    errors: list[str] = []
+    checked = 0
+    for path in paths:
+        errs, n = check_file(path, known)
+        errors.extend(errs)
+        checked += n
+    if default_set and checked < MIN_REFERENCES:
+        errors.append(
+            f"only {checked} metric references found across the shipped "
+            f"dashboards (< {MIN_REFERENCES}): the extractor regex or "
+            "dashboard schema drifted and the check is no longer "
+            "checking anything")
+    return errors, checked
+
+
+class DashboardDriftAnalyzer(Analyzer):
+    name = "dashboard-drift"
+    description = ("dashboard PromQL exprs must reference registered "
+                   "metric series")
+
+    def run(self, project: Project) -> list[Finding]:
+        errors, _ = check_dashboards(root=project.root)
+        findings = []
+        for err in errors:
+            # per-dashboard errors are "<path>: message"; suite-level
+            # errors (no dashboards found, MIN_REFERENCES floor) carry
+            # no path and anchor on the dashboards/ dir as a whole
+            head, sep, rest = err.partition(": ")
+            if sep and head.endswith(".json"):
+                rel = (os.path.relpath(head, project.root)
+                       if os.path.isabs(head) else head)
+                path, message = rel.replace(os.sep, "/"), rest
+            else:
+                path, message = "dashboards", err
+            findings.append(Finding(
+                "dashboard-drift", path, 1, message,
+                "rename the panel expr to a registered series, or "
+                "register the instrument in koordinator_tpu/metrics.py"))
+        return findings

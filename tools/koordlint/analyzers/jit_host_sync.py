@@ -1,0 +1,511 @@
+"""jit-host-sync: no silent device syncs inside the traced closure.
+
+The <200ms-p99 solve target dies quietly when host-sync creeps into a
+jitted function: ``.item()`` / ``float()`` / ``int()`` / ``bool()`` /
+``np.asarray`` on a traced value forces a device round-trip per call (or
+a ConcretizationTypeError at the first real trace), and a data-dependent
+``if`` on a traced value recompiles per branch value.  This analyzer
+finds every ``jax.jit`` site, walks the project call graph to the whole
+traced closure, and taint-tracks traced values through it:
+
+- a jitted entry's parameters are traced except ``static_argnames``,
+- any ``jax.*`` / ``jax.numpy`` call result is traced,
+- taint propagates through assignment, arithmetic, and project-internal
+  calls (callee parameters inherit the caller's argument taint),
+- ``.shape`` / ``.ndim`` / ``.dtype`` / ``.size`` / ``.capacity`` reads,
+  ``len()``, and ``x is None`` tests are host-static and NOT tainted
+  (shape-driven branches are how bucketed jit is supposed to work).
+
+Flagged on tainted values: host-cast calls (``int/float/bool/np.asarray/
+np.array``), sync methods (``.item()/.tolist()``), data-dependent
+``if``/``while`` tests, host iteration (``for _ in traced``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from ..callgraph import (
+    FunctionInfo,
+    ModuleIndex,
+    extract_jit_sites,
+    get_index,
+)
+from ..core import Analyzer, Finding, Project
+
+#: attribute reads that are static under tracing (shape-bucketing reads)
+HOST_SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "capacity"}
+#: builtins whose call on a traced value is a host sync
+HOST_CAST_BUILTINS = {"int", "float", "bool", "complex"}
+#: method calls on a traced value that force a device round-trip
+SYNC_METHODS = {"item", "tolist", "to_py", "__array__"}
+#: resolved dotted callees that materialize on host
+HOST_CAST_FUNCS = {"numpy.asarray", "numpy.array", "numpy.float64",
+                   "numpy.float32", "numpy.int32", "numpy.int64"}
+
+#: ``None`` = every ``jax.jit`` site in the package seeds the analysis
+#: (the scheduler's solve entry points in scheduler.py / batch_assign /
+#: explain per ISSUE 7, plus the deviceshare/numa decorator kernels,
+#: quota overuse-revoke and manager noderesource jits); everything
+#: reachable through the call graph is checked.  A list of
+#: repo-relative paths narrows the seeding (fixture corpora use this).
+DEFAULT_ROOT_PATHS = None
+
+
+@dataclasses.dataclass
+class _Ctx:
+    fn: FunctionInfo
+    tainted_params: frozenset[str]
+
+
+class JitHostSyncAnalyzer(Analyzer):
+    name = "jit-host-sync"
+    description = ("host-sync calls and data-dependent branches on traced "
+                   "values reachable from jax.jit entry points")
+
+    def __init__(self, root_paths: Optional[list[str]] = None,
+                 package: str = "koordinator_tpu"):
+        self.root_paths = root_paths if root_paths is not None else (
+            DEFAULT_ROOT_PATHS)
+        self.package = package
+
+    def run(self, project: Project) -> list[Finding]:
+        index = get_index(project, self.package)
+        paths = (None if self.root_paths is None else
+                 [p for p in self.root_paths
+                  if project.get(p) is not None])
+        sites = extract_jit_sites(index, paths=paths)
+        findings: dict[tuple, Finding] = {}
+        #: fn.fq -> taint set already analyzed (worklist merges upward)
+        analyzed: dict[str, frozenset[str]] = {}
+        work: list[_Ctx] = []
+
+        for site in sites:
+            if site.func_node is not None and site.func_fq is None:
+                # inline lambda: analyze directly, every param traced.
+                # The line disambiguates multiple lambdas per module in
+                # the worklist key (they'd otherwise dedupe as one).
+                fn = FunctionInfo(module_of(index, site),
+                                  f"<lambda@{site.line}>",
+                                  site.func_node, site.sf)
+                params = _param_names(site.func_node)
+                work.append(_Ctx(fn, frozenset(params)))
+                continue
+            fn = index.find_function(site.func_fq)
+            if fn is None:
+                continue
+            host = set(site.static_argnames) | _host_static_params(
+                index, site, fn)
+            params = [p for p in _param_names(fn.node)
+                      if p not in host and p != "self"]
+            work.append(_Ctx(fn, frozenset(params)))
+
+        while work:
+            ctx = work.pop()
+            prev = analyzed.get(ctx.fn.fq, frozenset())
+            taint = prev | ctx.tainted_params
+            if ctx.fn.fq in analyzed and taint == prev:
+                continue
+            analyzed[ctx.fn.fq] = taint
+            visitor = _TaintVisitor(index, ctx.fn, taint, findings)
+            visitor.run()
+            for callee, call, callee_taint in visitor.calls_out:
+                work.append(_Ctx(callee, frozenset(callee_taint)))
+        return sorted(findings.values(), key=lambda f: (f.path, f.line))
+
+
+def module_of(index: ModuleIndex, site) -> str:
+    for mod, sf in index.modules.items():
+        if sf is site.sf:
+            return mod
+    return "?"
+
+
+def _defaults_by_param(node: ast.AST) -> dict[str, ast.AST]:
+    a = node.args
+    out: dict[str, ast.AST] = {}
+    pos = a.posonlyargs + a.args
+    for param, default in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        out[param.arg] = default
+    for param, default in zip(a.kwonlyargs, a.kw_defaults):
+        if default is not None:
+            out[param.arg] = default
+    return out
+
+
+def _host_static_params(index: ModuleIndex, site,
+                        fn: FunctionInfo) -> set[str]:
+    """Defaulted parameters that are static in practice.
+
+    Two cases keep a non-``static_argnames`` parameter on the host side:
+
+    - a **string default** (``method="auto"``): strings are not valid
+      JAX types, so passing one at trace time errors LOUDLY — the value
+      only ever exists as a baked-in Python constant;
+    - a defaulted parameter **never supplied at any call site of the
+      jit binding** (``spread_bits=(5, 15)``): the default is closed
+      over at trace time, never traced.  Only applies when at least one
+      call site of the binding is visible — with zero observed callers
+      the conservative all-traced seeding stands.
+    """
+    defaults = _defaults_by_param(fn.node)
+    host = {p for p, d in defaults.items()
+            if isinstance(d, ast.Constant) and isinstance(d.value, str)}
+    if not site.binding:
+        return host
+    params = [p for p in _param_names(fn.node) if p != "self"]
+    supplied: set[str] = set()
+    seen_call = False
+    attr_calls, fq_calls = _call_site_index(index)
+    if site.binding_class is not None:
+        calls = attr_calls.get(
+            (f"{site.module}.{site.binding_class}", site.binding), [])
+    else:
+        # call sites are indexed by RESOLVED fully-qualified callee, so
+        # from-import aliases count and a same-named function in another
+        # module does not
+        binding_fq = f"{site.module}.{site.binding}"
+        seen_ids: set[int] = set()
+        calls = []
+        for fq in {binding_fq, site.func_fq} - {None}:
+            for c, m in fq_calls.get(fq, []):
+                if id(c) not in seen_ids:
+                    seen_ids.add(id(c))
+                    calls.append((c, m))
+    for call, _mod in calls:
+        seen_call = True
+        if any(isinstance(a, ast.Starred) for a in call.args) or any(
+                k.arg is None for k in call.keywords):
+            return host  # *args/**kwargs caller: anything may flow
+        supplied |= set(params[: len(call.args)])
+        supplied |= {k.arg for k in call.keywords if k.arg}
+    if seen_call:
+        host |= {p for p in defaults if p not in supplied}
+    return host
+
+
+def _call_site_index(index: ModuleIndex):
+    """One pass over every indexed function: ``self.<attr>`` calls
+    grouped by (module.Class, attr); every other call grouped by its
+    RESOLVED fully-qualified callee (import aliases included, bare
+    locals qualified with the caller's module).  Cached on the index."""
+    cached = getattr(index, "_jit_call_sites", None)
+    if cached is not None:
+        return cached
+    attr_calls: dict[tuple[str, str], list] = {}
+    fq_calls: dict[str, list] = {}
+    for caller in index.functions.values():
+        cls = (caller.qualname.rsplit(".", 1)[0]
+               if "." in caller.qualname else None)
+        for call in ast.walk(caller.node):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self" and cls):
+                attr_calls.setdefault(
+                    (f"{caller.module}.{cls}", f.attr), []).append(
+                    (call, caller.module))
+                continue
+            resolved = index.resolve(caller.module, f)
+            if not resolved:
+                continue
+            if "." not in resolved:
+                resolved = f"{caller.module}.{resolved}"
+            fq_calls.setdefault(resolved, []).append(
+                (call, caller.module))
+    index._jit_call_sites = (attr_calls, fq_calls)
+    return index._jit_call_sites
+
+
+def _param_names(node: ast.AST) -> list[str]:
+    a = node.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+class _TaintVisitor:
+    """One pass over one function body with a fixed entry taint set.
+
+    Statement order is respected (assignments untaint / taint names as
+    they execute); two passes run so names bound later in the body (rare
+    helper-closure style) still settle.
+    """
+
+    def __init__(self, index: ModuleIndex, fn: FunctionInfo,
+                 tainted_params: frozenset[str], findings: dict):
+        self.index = index
+        self.fn = fn
+        self.mod = fn.module
+        self.findings = findings
+        self.tainted_params = tainted_params
+        self.tainted: set[str] = set(tainted_params)
+        #: *args / **kwargs names: PYTHON containers of traced leaves —
+        #: iterating them unrolls statically (fine); their ELEMENTS are
+        #: traced (subscripts stay tainted via the tainted set)
+        a = getattr(fn.node, "args", None)
+        self.containers: set[str] = {
+            n.arg for n in (a.vararg, a.kwarg) if n is not None
+        } if a is not None else set()
+        #: (callee, call node, tainted callee params) discovered
+        self.calls_out: list[tuple[FunctionInfo, ast.Call, set[str]]] = []
+
+    def run(self) -> None:
+        body = (self.fn.node.body if isinstance(self.fn.node.body, list)
+                else [ast.Expr(value=self.fn.node.body)])  # Lambda
+        for _ in range(2):
+            self.calls_out.clear()
+            self._block(body)
+
+    def _flag(self, node: ast.AST, what: str, hint: str) -> None:
+        key = (self.fn.fq, node.lineno, what)
+        if key not in self.findings:
+            self.findings[key] = Finding(
+                "jit-host-sync", self.fn.sf.path, node.lineno,
+                f"{what} in {self.fn.qualname!r} (reachable from a "
+                f"jax.jit entry point)", hint)
+
+    # -- taint evaluation -----------------------------------------------------
+
+    def _is_none_check(self, node: ast.Compare) -> bool:
+        return (all(isinstance(c, ast.Constant) and c.value is None
+                    for c in node.comparators)
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.ops))
+
+    def _is_str_check(self, node: ast.Compare) -> bool:
+        """Comparisons against string constants are host-static: strings
+        are not valid JAX types, so the left side cannot be traced (a
+        traced value there would already have errored at trace time)."""
+
+        def is_str(n: ast.AST) -> bool:
+            if isinstance(n, ast.Constant):
+                return isinstance(n.value, str)
+            if isinstance(n, (ast.Tuple, ast.List, ast.Set)):
+                return bool(n.elts) and all(is_str(e) for e in n.elts)
+            return False
+
+        return is_str(node.left) or any(is_str(c) for c in node.comparators)
+
+    def tainted_expr(self, node: ast.AST) -> bool:  # noqa: C901
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in HOST_SAFE_ATTRS:
+                return False
+            return self.tainted_expr(node.value)
+        if isinstance(node, ast.Subscript):
+            # shape[i] and friends stay host-static
+            if (isinstance(node.value, ast.Attribute)
+                    and node.value.attr in HOST_SAFE_ATTRS):
+                return False
+            return (self.tainted_expr(node.value)
+                    or self.tainted_expr(node.slice))
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.Compare):
+            if self._is_none_check(node) or self._is_str_check(node):
+                return False
+            return (self.tainted_expr(node.left)
+                    or any(self.tainted_expr(c) for c in node.comparators))
+        if isinstance(node, (ast.BinOp,)):
+            return self.tainted_expr(node.left) or self.tainted_expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.tainted_expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.tainted_expr(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            if self.tainted_expr(node.test):
+                self._flag(node, "data-dependent conditional expression "
+                                 "on a traced value",
+                           "use jnp.where / lax.select instead of a "
+                           "Python conditional")
+            return (self.tainted_expr(node.body)
+                    or self.tainted_expr(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.tainted_expr(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.tainted_expr(v) for v in node.values if v)
+        if isinstance(node, ast.Starred):
+            return self.tainted_expr(node.value)
+        if isinstance(node, ast.Slice):
+            return any(self.tainted_expr(p) for p in
+                       (node.lower, node.upper, node.step) if p)
+        if isinstance(node, ast.JoinedStr):
+            return any(self.tainted_expr(v) for v in node.values)
+        if isinstance(node, ast.FormattedValue):
+            return self.tainted_expr(node.value)
+        return False
+
+    def _call(self, node: ast.Call) -> bool:
+        func = node.func
+        args_tainted = (any(self.tainted_expr(a) for a in node.args)
+                        or any(self.tainted_expr(k.value)
+                               for k in node.keywords))
+        # builtins that force a concrete host value
+        if isinstance(func, ast.Name):
+            if func.id in HOST_CAST_BUILTINS and args_tainted:
+                self._flag(node, f"host cast {func.id}() of a traced value",
+                           "keep device dtype (jnp.asarray / .astype) or "
+                           "hoist the cast outside the jit")
+                return False
+            if func.id == "len":
+                return False  # static under tracing
+        # sync methods on a traced value
+        if (isinstance(func, ast.Attribute) and func.attr in SYNC_METHODS
+                and self.tainted_expr(func.value)):
+            self._flag(node, f".{func.attr}() on a traced value",
+                       "return the array and read it on host after the "
+                       "jit boundary")
+            return False
+        resolved = self.index.resolve(self.mod, func)
+        if resolved in HOST_CAST_FUNCS and args_tainted:
+            self._flag(node, f"{resolved}() materializes a traced value "
+                             "on host",
+                       "use jnp inside the jit; np belongs outside")
+            return False
+        if resolved and (resolved.startswith("jax.") or resolved == "jax"):
+            return True  # device-land result
+        # project-internal call: propagate taint into the callee
+        target = self._target(func)
+        if target is not None:
+            callee_taint = self._map_args(target, node)
+            self.calls_out.append((target, node, callee_taint))
+            return args_tainted or self.tainted_expr(func)
+        # method on a traced value (.at[..].set, .replace, .astype, ...)
+        if isinstance(func, ast.Attribute) and self.tainted_expr(func.value):
+            return True
+        return args_tainted
+
+    def _iter_info(self, node: ast.AST) -> tuple[bool, bool]:
+        """(static_unroll, elements_tainted) for an iteration source.
+
+        ``*args``/``**kwargs`` containers (sliced or not) are PYTHON
+        tuples — iterating them unrolls at trace time even when their
+        ELEMENTS are traced; zip/enumerate/reversed over such containers
+        (or over host values) likewise.  A tainted array iterated
+        directly is the real host-sync hazard and returns (False, _).
+        """
+        if isinstance(node, ast.Name) and node.id in self.containers:
+            return True, True
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.containers):
+            return True, True
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in ("zip", "enumerate", "reversed")):
+            elems = False
+            for a in node.args:
+                st, et = self._iter_info(a)
+                if st:
+                    elems = elems or et
+                elif self.tainted_expr(a):
+                    return False, True
+            return True, elems
+        return False, False
+
+    def _target(self, func: ast.AST) -> Optional[FunctionInfo]:
+        cls = (self.fn.qualname.rsplit(".", 1)[0]
+               if "." in self.fn.qualname else None)
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("self", "cls") and cls):
+            return self.index.find_function(f"{self.mod}.{cls}.{func.attr}")
+        return self.index.find_function(self.index.resolve(self.mod, func))
+
+    def _map_args(self, target: FunctionInfo, call: ast.Call) -> set[str]:
+        params = _param_names(target.node)
+        if params and params[0] == "self":
+            params = params[1:]
+        out: set[str] = set()
+        for i, a in enumerate(call.args):
+            if self.tainted_expr(a) and i < len(params):
+                out.add(params[i])
+        for k in call.keywords:
+            if k.arg and self.tainted_expr(k.value) and k.arg in params:
+                out.add(k.arg)
+        return out
+
+    # -- statements -----------------------------------------------------------
+
+    def _block(self, stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _assign_target(self, target: ast.AST, value_tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if value_tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign_target(e, value_tainted)
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, value_tainted)
+        # attribute/subscript stores keep their base's taint
+
+    def _stmt(self, stmt: ast.stmt) -> None:  # noqa: C901
+        if isinstance(stmt, ast.Assign):
+            t = self.tainted_expr(stmt.value)
+            for target in stmt.targets:
+                self._assign_target(target, t)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign_target(stmt.target,
+                                    self.tainted_expr(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            if self.tainted_expr(stmt.value):
+                self._assign_target(stmt.target, True)
+            else:
+                self.tainted_expr(stmt.target)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            if self.tainted_expr(stmt.test):
+                self._flag(stmt, "data-dependent branch on a traced value",
+                           "branch on static args / shapes, or use "
+                           "jnp.where / lax.cond")
+            self._block(stmt.body)
+            self._block(stmt.orelse)
+        elif isinstance(stmt, ast.For):
+            static_unroll, elems_tainted = self._iter_info(stmt.iter)
+            if static_unroll:
+                self._assign_target(stmt.target, elems_tainted)
+            elif self.tainted_expr(stmt.iter):
+                self._flag(stmt, "host iteration over a traced value",
+                           "use lax.scan / lax.fori_loop, or hoist the "
+                           "loop outside the jit")
+                self._assign_target(stmt.target, True)
+            else:
+                self._assign_target(stmt.target, False)
+            self._block(stmt.body)
+            self._block(stmt.orelse)
+        elif isinstance(stmt, ast.Assert):
+            if self.tainted_expr(stmt.test):
+                self._flag(stmt, "assert on a traced value",
+                           "asserts are host control flow; use "
+                           "checkify or assert on shapes only")
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self.tainted_expr(stmt.value)
+        elif isinstance(stmt, (ast.With,)):
+            for item in stmt.items:
+                self.tainted_expr(item.context_expr)
+            self._block(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._block(stmt.body)
+            for h in stmt.handlers:
+                self._block(h.body)
+            self._block(stmt.orelse)
+            self._block(stmt.finalbody)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            pass  # nested defs analyzed only if called (via call graph)
+        elif isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self.tainted_expr(stmt.exc)

@@ -1,0 +1,506 @@
+"""lock-discipline: lock-order cycles and half-guarded attribute writes.
+
+The tree has ~40 ``with self._lock:`` sites across transport/, scheduler/
+and koordlet/ threading seams.  The invariants that keep them honest
+lived in reviewers' heads; this analyzer makes two of them mechanical:
+
+- **lock-order graph**: every ``with self.<lock>:`` scope is extracted;
+  acquiring a second lock inside one (directly, or through a method call
+  this analyzer can resolve — same-class ``self.m()`` and typed
+  attributes ``self.informer.push()`` where ``__init__`` pins the type)
+  adds an edge.  A cycle in the graph is a deadlock candidate.  Locks
+  are identified per module.Class.attribute (instances are conflated — a
+  self-edge on a non-reentrant ``Lock`` is flagged, on an ``RLock`` it
+  is the reentrancy it was bought for and ignored).
+- **guard consistency**: an attribute written under a lock at some sites
+  and bare at others is a race candidate — the bare sites are flagged.
+  ``__init__`` writes are construction (happens-before publication) and
+  exempt.
+
+Intent annotations close the gap static scoping cannot see:
+
+- ``def _solve_locked(self):  # koordlint: guarded-by(self.lock)``
+  declares the CALLER holds the lock — the body counts as guarded (the
+  Clang thread-safety ``REQUIRES()`` idea).
+- ``self.pending = {}  # koordlint: guarded-by(self.lock)`` on the
+  ``__init__`` line declares the attribute's guard, so even a class with
+  no currently-guarded writes gets bare writes flagged.
+
+Manual ``.acquire()/.release()`` pairs are not scoped (non-lexical);
+those sites are skipped — keep them rare.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Optional
+
+from ..callgraph import ModuleIndex, get_index
+from ..core import Analyzer, Finding, Project
+from .donation_safety import dotted_path
+
+LOCK_TYPES = {"threading.Lock": "Lock", "threading.RLock": "RLock",
+              "threading.Condition": "Condition"}
+
+_GUARD_RE = re.compile(r"guarded-by\(\s*self\.(\w+)\s*\)")
+
+
+@dataclasses.dataclass
+class LockWrite:
+    attr: str
+    method: str
+    line: int
+    held: frozenset[str]    # lock ids held at the write
+
+
+@dataclasses.dataclass
+class Edge:
+    src: str
+    dst: str
+    path: str
+    line: int
+    via: str                # human-readable evidence
+
+
+@dataclasses.dataclass
+class ClassModel:
+    module: str
+    name: str
+    node: ast.ClassDef
+    sf: object
+    locks: dict[str, str] = dataclasses.field(default_factory=dict)
+    attr_types: dict[str, str] = dataclasses.field(default_factory=dict)
+    methods: dict[str, ast.FunctionDef] = dataclasses.field(
+        default_factory=dict)
+    writes: list[LockWrite] = dataclasses.field(default_factory=list)
+    declared: dict[str, tuple[str, int]] = dataclasses.field(
+        default_factory=dict)  # attr -> (lock id, decl line)
+
+    def lock_id(self, attr: str) -> str:
+        # module-qualified: two same-named classes in different modules
+        # must not merge into one node (false shared-lock cycles)
+        return f"{self.module}.{self.name}.{attr}"
+
+
+class LockGraph:
+    """The cross-class lock-acquisition-order graph."""
+
+    def __init__(self):
+        self.edges: list[Edge] = []
+        self.lock_kinds: dict[str, str] = {}
+        self._seen: set[tuple[str, str, str, int]] = set()
+
+    def add_edge(self, edge: Edge) -> None:
+        key = (edge.src, edge.dst, edge.path, edge.line)
+        if key not in self._seen:
+            self._seen.add(key)
+            self.edges.append(edge)
+
+    def adjacency(self) -> dict[str, set[str]]:
+        adj: dict[str, set[str]] = {}
+        for e in self.edges:
+            adj.setdefault(e.src, set()).add(e.dst)
+            adj.setdefault(e.dst, set())
+        return adj
+
+    def cycles(self) -> list[list[str]]:
+        """Elementary cycles via SCC: every SCC with >1 node, plus
+        self-edges on non-reentrant locks."""
+        adj = self.adjacency()
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on: set[str] = set()
+        stack: list[str] = []
+        sccs: list[list[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            for w in sorted(adj.get(v, ())):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        out = [sorted(c) for c in sccs if len(c) > 1]
+        for e in self.edges:
+            if (e.src == e.dst
+                    and self.lock_kinds.get(e.src, "Lock") != "RLock"):
+                out.append([e.src])
+        return out
+
+
+class LockDisciplineAnalyzer(Analyzer):
+    name = "lock-discipline"
+    description = ("lock-order cycles (deadlock candidates) and attribute "
+                   "writes guarded at some sites but bare at others")
+
+    def __init__(self, package: str = "koordinator_tpu"):
+        self.package = package
+
+    def run(self, project: Project) -> list[Finding]:
+        index = get_index(project, self.package)
+        models = self.build_models(index)
+        graph = self.build_graph(index, models)
+        findings: list[Finding] = []
+        findings += self._cycle_findings(graph)
+        for model in models.values():
+            findings += self._guard_findings(model)
+        return sorted(findings, key=lambda f: (f.path, f.line))
+
+    # -- model construction ---------------------------------------------------
+
+    def build_models(self, index: ModuleIndex) -> dict[str, ClassModel]:
+        models: dict[str, ClassModel] = {}
+        for fq, node in sorted(index.classes.items()):
+            mod = fq[: -len(node.name) - 1]
+            if mod not in index.modules:
+                continue  # nested classes: keyed by owner module anyway
+            model = ClassModel(module=mod, name=node.name, node=node,
+                               sf=index.modules[mod])
+            for child in node.body:
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    model.methods[child.name] = child
+            init = model.methods.get("__init__")
+            if init is not None:
+                self._scan_init(index, mod, model, init)
+            for name, m in model.methods.items():
+                self._scan_method(index, model, name, m)
+            models[fq] = model
+        return models
+
+    def _scan_init(self, index, mod, model: ClassModel,
+                   init: ast.FunctionDef) -> None:
+        ann: dict[str, str] = {}
+        for arg in init.args.args + init.args.kwonlyargs:
+            if arg.annotation is not None:
+                r = index.resolve(mod, _strip_optional(arg.annotation))
+                if r and index.find_function(r) is None:
+                    ann[arg.arg] = r
+        for stmt in ast.walk(init):
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1):
+                continue
+            t = stmt.targets[0]
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            if isinstance(stmt.value, ast.Call):
+                r = index.resolve(mod, stmt.value.func)
+                if r in LOCK_TYPES:
+                    model.locks[t.attr] = LOCK_TYPES[r]
+                elif r in index.classes:
+                    model.attr_types[t.attr] = r
+            elif (isinstance(stmt.value, ast.Name)
+                  and stmt.value.id in ann
+                  and ann[stmt.value.id] in index.classes):
+                model.attr_types[t.attr] = ann[stmt.value.id]
+
+    def _method_guard(self, model: ClassModel,
+                      m: ast.FunctionDef) -> frozenset[str]:
+        """Locks declared held by the caller via a guarded-by directive
+        on (or right above) the def line — or above the FIRST decorator
+        when the def is decorated (the comment sits on top)."""
+        d = model.sf.directive_at(m.lineno, "guarded-by")
+        if d is None and m.decorator_list:
+            first = min(dec.lineno for dec in m.decorator_list)
+            d = model.sf.directive_at(first, "guarded-by")
+        if d is None:
+            return frozenset()
+        g = _GUARD_RE.search(f"guarded-by({d.body})")
+        return frozenset({model.lock_id(g.group(1))}) if g else frozenset()
+
+    def _scan_method(self, index, model: ClassModel, name: str,
+                     m: ast.FunctionDef) -> None:
+        base = self._method_guard(model, m)
+
+        def walk(stmts, held: frozenset[str]) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, ast.With):
+                    inner = held
+                    for item in stmt.items:
+                        p = dotted_path(item.context_expr)
+                        if (p and p.startswith("self.")
+                                and p[5:] in model.locks):
+                            inner = inner | {model.lock_id(p[5:])}
+                    walk(stmt.body, inner)
+                    continue
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        self._record_write(model, name, t, held)
+                elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                    if getattr(stmt, "value", True) is not None:
+                        self._record_write(model, name, stmt.target, held)
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if isinstance(sub, list) and not isinstance(
+                            stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        walk(sub, held)
+                if isinstance(stmt, ast.Try):
+                    for h in stmt.handlers:
+                        walk(h.body, held)
+
+        walk(m.body, base)
+
+    def _record_write(self, model: ClassModel, method: str, target: ast.AST,
+                      held: frozenset[str]) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._record_write(model, method, e, held)
+            return
+        if not (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            return
+        attr = target.attr
+        if attr in model.locks:
+            return
+        d = model.sf.directive_at(target.lineno, "guarded-by")
+        if d is not None:
+            g = _GUARD_RE.search(f"guarded-by({d.body})")
+            if g and attr not in model.declared:
+                model.declared[attr] = (model.lock_id(g.group(1)),
+                                        target.lineno)
+        model.writes.append(LockWrite(attr=attr, method=method,
+                                      line=target.lineno, held=held))
+
+    # -- lock-order graph -----------------------------------------------------
+
+    def build_graph(self, index: ModuleIndex,
+                    models: dict[str, ClassModel]) -> LockGraph:
+        graph = LockGraph()
+        for model in models.values():
+            for attr, kind in model.locks.items():
+                graph.lock_kinds[model.lock_id(attr)] = kind
+
+        # (class fq, method) -> locks running it may acquire, computed
+        # as a global FIXPOINT over direct acquisitions + call edges —
+        # a recursive memo would cache truncated sets at call-graph
+        # cycles (mutually recursive methods) and silently drop edges
+        direct: dict[tuple[str, str], set[str]] = {}
+        calls: dict[tuple[str, str], set[tuple[str, str]]] = {}
+        for cls_fq, model in models.items():
+            for mname, m in model.methods.items():
+                key = (cls_fq, mname)
+                direct[key] = set()
+                calls[key] = set()
+                for node in ast.walk(m):
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            p = dotted_path(item.context_expr)
+                            if (p and p.startswith("self.")
+                                    and p[5:] in model.locks):
+                                direct[key].add(model.lock_id(p[5:]))
+                    elif isinstance(node, ast.Call):
+                        tgt = self._callee(index, model, node)
+                        if tgt is not None:
+                            calls[key].add(tgt)
+        closure = {k: set(v) for k, v in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for key, callees in calls.items():
+                for tgt in callees:
+                    add = closure.get(tgt, set()) - closure[key]
+                    if add:
+                        closure[key] |= add
+                        changed = True
+
+        def acquired(cls_fq: str, method: str) -> frozenset[str]:
+            return frozenset(closure.get((cls_fq, method), ()))
+
+        for cls_fq, model in models.items():
+            for mname, m in model.methods.items():
+                base = self._method_guard(model, m)
+                self._edge_walk(index, models, model, cls_fq, mname,
+                                m.body, base, graph, acquired)
+        return graph
+
+    def _callee(self, index, model: ClassModel,
+                call: ast.Call) -> Optional[tuple[str, str]]:
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        if isinstance(f.value, ast.Name) and f.value.id == "self":
+            if f.attr in model.methods:
+                return (f"{model.module}.{model.name}", f.attr)
+            return None
+        # self.<attr>.<method>() on a typed attribute
+        if (isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "self"
+                and f.value.attr in model.attr_types):
+            return (model.attr_types[f.value.attr], f.attr)
+        return None
+
+    def _edge_walk(self, index, models, model: ClassModel, cls_fq: str,
+                   method: str, stmts, held: frozenset[str],
+                   graph: LockGraph, acquired) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                inner = held
+                for item in stmt.items:
+                    p = dotted_path(item.context_expr)
+                    if p and p.startswith("self.") and p[5:] in model.locks:
+                        new = model.lock_id(p[5:])
+                        # edges come from INNER, not held: items of one
+                        # `with a, b:` acquire in sequence, so b's edge
+                        # set must include a
+                        for h in inner:
+                            if h == new and graph.lock_kinds.get(
+                                    new) == "RLock":
+                                continue
+                            graph.add_edge(Edge(
+                                h, new, model.sf.path, stmt.lineno,
+                                f"{model.name}.{method} acquires "
+                                f"{new} while holding {h}"))
+                        inner = inner | {new}
+                self._edge_walk(index, models, model, cls_fq, method,
+                                stmt.body, inner, graph, acquired)
+                continue
+            if held:
+                # only THIS statement's own expressions: nested blocks
+                # are covered by the recursion below (scanning the full
+                # subtree here would re-visit each call once per level)
+                for node in _own_expr_nodes(stmt):
+                    if isinstance(node, ast.Call):
+                        tgt = self._callee(index, model, node)
+                        if tgt is None:
+                            continue
+                        for lock in sorted(acquired(tgt[0], tgt[1])):
+                            for h in held:
+                                if h == lock and graph.lock_kinds.get(
+                                        lock) == "RLock":
+                                    continue
+                                graph.add_edge(Edge(
+                                    h, lock, model.sf.path, node.lineno,
+                                    f"{model.name}.{method} holds {h} and "
+                                    f"calls {tgt[0].rsplit('.', 1)[-1]}."
+                                    f"{tgt[1]} which acquires {lock}"))
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list) and not isinstance(
+                        stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._edge_walk(index, models, model, cls_fq, method,
+                                    sub, held, graph, acquired)
+            if isinstance(stmt, ast.Try):
+                for h in stmt.handlers:
+                    self._edge_walk(index, models, model, cls_fq, method,
+                                    h.body, held, graph, acquired)
+
+    # -- findings -------------------------------------------------------------
+
+    def _cycle_findings(self, graph: LockGraph) -> list[Finding]:
+        findings = []
+        for cycle in graph.cycles():
+            members = set(cycle)
+            evidence = [e for e in graph.edges
+                        if e.src in members and e.dst in members]
+            if not evidence:
+                continue
+            first = min(evidence, key=lambda e: (e.path, e.line))
+            chain = " -> ".join(cycle + [cycle[0]])
+            detail = "; ".join(
+                f"{e.via} ({e.path}:{e.line})"
+                for e in sorted(evidence, key=lambda e: (e.path, e.line))[:4])
+            findings.append(Finding(
+                "lock-discipline", first.path, first.line,
+                f"lock-order cycle (deadlock candidate): {chain}. {detail}",
+                "pick one global acquisition order and release the outer "
+                "lock before taking the inner one on the reverse path"))
+        return findings
+
+    def _guard_findings(self, model: ClassModel) -> list[Finding]:
+        findings = []
+        by_attr: dict[str, list[LockWrite]] = {}
+        for w in model.writes:
+            if w.method != "__init__":
+                by_attr.setdefault(w.attr, []).append(w)
+        for attr, writes in sorted(by_attr.items()):
+            declared = model.declared.get(attr)
+            if declared is not None:
+                lock = declared[0]
+                for w in writes:
+                    if lock not in w.held:
+                        findings.append(Finding(
+                            "lock-discipline", model.sf.path, w.line,
+                            f"{model.name}.{attr} is declared guarded-by"
+                            f"({lock}) but written without it in "
+                            f"{w.method}()",
+                            f"wrap the write in `with {_self(lock)}:` or "
+                            "mark the method "
+                            f"`# koordlint: guarded-by({_self(lock)})`"))
+                continue
+            guarded = [w for w in writes if w.held]
+            bare = [w for w in writes if not w.held]
+            if guarded and bare:
+                locks = sorted({lk for w in guarded for lk in w.held})
+                for w in bare:
+                    findings.append(Finding(
+                        "lock-discipline", model.sf.path, w.line,
+                        f"{model.name}.{attr} is written under "
+                        f"{'/'.join(locks)} in "
+                        f"{sorted({g.method for g in guarded})} but bare "
+                        f"in {w.method}() — race candidate",
+                        f"hold {locks[0]} here, or declare intent with "
+                        f"`# koordlint: guarded-by({_self(locks[0])})` / "
+                        "an ignore with reason"))
+        return findings
+
+
+def _own_expr_nodes(stmt: ast.stmt):
+    """The expression nodes belonging to one statement, NOT descending
+    into nested statement blocks (body/orelse/finalbody/handlers) — the
+    edge walker recurses into those itself."""
+    stack: list[ast.AST] = []
+    for field, value in ast.iter_fields(stmt):
+        if field in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        stack.extend(v for v in (value if isinstance(value, list)
+                                 else [value])
+                     if isinstance(v, ast.AST))
+    while stack:
+        node = stack.pop()
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _self(lock_id: str) -> str:
+    return f"self.{lock_id.rsplit('.', 1)[1]}"
+
+
+def _strip_optional(node: ast.AST) -> ast.AST:
+    """``Foo | None`` / ``Optional[Foo]`` -> ``Foo`` for type inference."""
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        for side in (node.left, node.right):
+            if not (isinstance(side, ast.Constant) and side.value is None):
+                return side
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "Optional":
+            return node.slice
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            return ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return node
+    return node

@@ -1,0 +1,128 @@
+"""marker-audit: test-suite conventions that protect tier-1 become lints.
+
+Two conventions from pytest.ini / the chaos-soak discipline:
+
+- **chaos implies slow**: every ``chaos``-marked test must ALSO carry
+  ``slow`` (module ``pytestmark``, class mark, or decorator), because
+  tier-1 deselects with ``-m "not slow"`` — a chaos test without
+  ``slow`` would drag a multi-second seeded socket soak into CI.
+- **no module-scope jax import in test files**: ``import jax`` at
+  module scope runs at pytest COLLECTION, before any deselect marker
+  applies.  conftest.py deliberately imports jax first (it must pin the
+  platform before anyone else touches it) and is exempt by scope; every
+  other ``tests/test_*.py`` should defer jax to test/fixture bodies so
+  collection of a deselected file stays free.  The pre-koordlint suites
+  that predate this rule are grandfathered in the baseline — the rule
+  holds the line for NEW files.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Analyzer, Finding, Project
+
+
+def _is_type_checking(test: ast.expr) -> bool:
+    """``if TYPE_CHECKING:`` / ``if typing.TYPE_CHECKING:`` guards."""
+    return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") or (
+        isinstance(test, ast.Attribute) and test.attr == "TYPE_CHECKING")
+
+
+def _marks(decorators: list[ast.expr]) -> set[str]:
+    """Mark names from @pytest.mark.<x> / @pytest.mark.<x>(...)."""
+    out: set[str] = set()
+    for deco in decorators:
+        node = deco.func if isinstance(deco, ast.Call) else deco
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "mark"):
+            out.add(node.attr)
+    return out
+
+
+def _pytestmark_marks(stmts: list[ast.stmt]) -> set[str]:
+    out: set[str] = set()
+    for stmt in stmts:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        for t in stmt.targets:
+            if isinstance(t, ast.Name) and t.id == "pytestmark":
+                values = (stmt.value.elts
+                          if isinstance(stmt.value, (ast.List, ast.Tuple))
+                          else [stmt.value])
+                out |= _marks(values)
+    return out
+
+
+class MarkerAuditAnalyzer(Analyzer):
+    name = "marker-audit"
+    description = ("chaos tests must also be slow; no module-scope jax "
+                   "import in test files")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in project.glob("tests/test_*.py"):
+            if sf.tree is None:
+                continue
+            module_marks = _pytestmark_marks(sf.tree.body)
+            self._walk(sf, sf.tree.body, module_marks, findings)
+            findings += self._jax_imports(sf)
+        return sorted(findings, key=lambda f: (f.path, f.line))
+
+    def _walk(self, sf, stmts, inherited: set[str],
+              findings: list[Finding]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.ClassDef):
+                marks = (inherited | _marks(stmt.decorator_list)
+                         | _pytestmark_marks(stmt.body))
+                self._walk(sf, stmt.body, marks, findings)
+            elif (isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and stmt.name.startswith("test")):
+                marks = inherited | _marks(stmt.decorator_list)
+                if "chaos" in marks and "slow" not in marks:
+                    findings.append(Finding(
+                        "marker-audit", sf.path, stmt.lineno,
+                        f"{stmt.name} is marked chaos but not slow: "
+                        "tier-1 (-m 'not slow') would run this seeded "
+                        "socket soak in CI",
+                        "add pytest.mark.slow next to the chaos mark "
+                        "(see pytest.ini)"))
+
+    def _jax_imports(self, sf) -> list[Finding]:
+        findings: list[Finding] = []
+
+        def scan(stmts: list[ast.stmt]) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, ast.Import):
+                    for a in stmt.names:
+                        if a.name == "jax" or a.name.startswith("jax."):
+                            findings.append(self._jax_finding(sf, stmt))
+                elif isinstance(stmt, ast.ImportFrom):
+                    mod = stmt.module or ""
+                    if stmt.level == 0 and (
+                            mod == "jax" or mod.startswith("jax.")):
+                        findings.append(self._jax_finding(sf, stmt))
+                elif isinstance(stmt, (ast.If, ast.Try, ast.With)):
+                    # still executes at import time — except the
+                    # annotation-only `if TYPE_CHECKING:` body, which
+                    # never runs and costs collection nothing
+                    if (isinstance(stmt, ast.If)
+                            and _is_type_checking(stmt.test)):
+                        scan(stmt.orelse)
+                        continue
+                    for field in ("body", "orelse", "finalbody"):
+                        scan(getattr(stmt, field, []) or [])
+                    for h in getattr(stmt, "handlers", []):
+                        scan(h.body)
+
+        scan(sf.tree.body)
+        return findings
+
+    def _jax_finding(self, sf, stmt) -> Finding:
+        return Finding(
+            "marker-audit", sf.path, stmt.lineno,
+            "module-scope jax import in a test file: pytest collection "
+            "pays it even when every test here is deselected",
+            "import jax inside the test/fixture body (conftest.py "
+            "already pinned the platform)")

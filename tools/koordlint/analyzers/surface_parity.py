@@ -1,0 +1,217 @@
+"""surface-parity: the two debug surfaces must expose the same /debug API.
+
+The scheduler serves its debug endpoints twice — on the transport-
+agnostic :class:`DebugService` (``scheduler/services.py``) and on the
+HTTP gateway (``transport/http_gateway.py``).  PR 6 had to hand-audit
+the two after they drifted; this analyzer turns the audit into a lint:
+
+- every exact ``/debug/<x>`` route registered on the DebugService
+  (``self.register("/debug/x", ...)``) must appear as a ``path ==
+  "/debug/x"`` dispatch in the gateway's ``_route``, and vice versa;
+- every prefix route (``self.register_prefix("/debug/x/", ...)``) must
+  have a matching gateway regex (``re.compile(r"^/debug/x/(.+)$")``),
+  and vice versa;
+- each ``/debug/<x>`` route must be served through the ONE shared
+  body builder ``debug_<x>_body`` on BOTH surfaces (the convention that
+  makes drift structurally impossible) — a surface that hand-rolls its
+  own body is flagged;
+- a builder that raises :class:`DebugApiError` (typed statuses) must be
+  called under an ``except DebugApiError`` mapping on the gateway side,
+  and the DebugService ``handle`` dispatcher must map it too — so both
+  surfaces serve the same status + body for the same failure.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from ..core import Analyzer, Finding, Project
+
+SERVICES_PATH = "koordinator_tpu/scheduler/services.py"
+GATEWAY_PATH = "koordinator_tpu/transport/http_gateway.py"
+
+_PREFIX_RX = re.compile(r"\^(/debug/[\w/-]+/)\(")
+
+
+class SurfaceParityAnalyzer(Analyzer):
+    name = "surface-parity"
+    description = ("DebugService vs HTTP-gateway /debug route and "
+                   "typed-error parity")
+
+    def __init__(self, services_path: str = SERVICES_PATH,
+                 gateway_path: str = GATEWAY_PATH):
+        self.services_path = services_path
+        self.gateway_path = gateway_path
+
+    def run(self, project: Project) -> list[Finding]:
+        svc = project.get(self.services_path)
+        gw = project.get(self.gateway_path)
+        if svc is None or gw is None or svc.tree is None or gw.tree is None:
+            return []
+        findings: list[Finding] = []
+
+        s_exact, s_prefix, s_line = self._service_routes(svc.tree)
+        g_exact, g_prefix, g_line = self._gateway_routes(gw.tree)
+        builders = self._builders(svc.tree)
+
+        for route in sorted(s_exact - g_exact):
+            findings.append(Finding(
+                "surface-parity", gw.path, 1,
+                f"DebugService serves {route!r} but the HTTP gateway has "
+                "no matching dispatch",
+                f"add `if method == \"GET\" and path == \"{route}\":` to "
+                "HttpGateway._route"))
+        for route in sorted(g_exact - s_exact):
+            findings.append(Finding(
+                "surface-parity", svc.path, 1,
+                f"HTTP gateway serves {route!r} but DebugService never "
+                "registers it",
+                f"register(\"{route}\", ...) in _register_builtin"))
+        for route in sorted(s_prefix - g_prefix):
+            findings.append(Finding(
+                "surface-parity", gw.path, 1,
+                f"DebugService serves prefix {route!r} but the gateway "
+                "has no matching regex route",
+                f"add re.compile(r\"^{route}(.+)$\") dispatch"))
+        for route in sorted(g_prefix - s_prefix):
+            findings.append(Finding(
+                "surface-parity", svc.path, 1,
+                f"HTTP gateway serves prefix {route!r} but DebugService "
+                "never registers it",
+                f"register_prefix(\"{route}\", ...) in _register_builtin"))
+
+        # shared-builder + typed-error parity per route on BOTH surfaces
+        svc_refs = self._builder_refs_by_method(svc.tree)
+        gw_refs = self._builder_refs_by_method(gw.tree)
+        for route in sorted((s_exact | g_exact | s_prefix | g_prefix)):
+            expected = "debug_{}_body".format(
+                route[len("/debug/"):].strip("/").replace("/", "_"))
+            if expected not in builders:
+                findings.append(Finding(
+                    "surface-parity", svc.path,
+                    s_line.get(route) or g_line.get(route, 1),
+                    f"route {route!r} has no shared builder "
+                    f"{expected}() in scheduler/services.py",
+                    "both surfaces must serve one body builder so they "
+                    "cannot drift"))
+                continue
+            raises = builders[expected]
+            for side, refs, sf, line_map in (
+                    ("DebugService", svc_refs, svc, s_line),
+                    ("HTTP gateway", gw_refs, gw, g_line)):
+                using = [m for m, names in refs.items() if expected in names]
+                if (route in (s_exact | s_prefix
+                              if side == "DebugService"
+                              else g_exact | g_prefix) and not using):
+                    findings.append(Finding(
+                        "surface-parity", sf.path, line_map.get(route, 1),
+                        f"{side} serves {route!r} without calling the "
+                        f"shared builder {expected}()",
+                        "hand-rolled bodies drift; call the builder"))
+            if raises:
+                for m in [m for m, names in gw_refs.items()
+                          if expected in names]:
+                    if not self._catches_debug_api_error(gw.tree, m):
+                        findings.append(Finding(
+                            "surface-parity", gw.path,
+                            g_line.get(route, 1),
+                            f"{expected}() raises DebugApiError but "
+                            f"gateway handler {m}() does not map it "
+                            "(typed status would become a blanket 500)",
+                            "wrap the call in try/except DebugApiError "
+                            "and reply e.status"))
+        if not self._catches_debug_api_error(svc.tree, "handle"):
+            findings.append(Finding(
+                "surface-parity", svc.path, 1,
+                "DebugService.handle does not map DebugApiError to a "
+                "typed status",
+                "except DebugApiError as e: return e.status, ..."))
+        return findings
+
+    # -- extraction -----------------------------------------------------------
+
+    def _service_routes(self, tree) -> tuple[set, set, dict]:
+        exact: set[str] = set()
+        prefix: set[str] = set()
+        lines: dict[str, int] = {}
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("register", "register_prefix")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            route = node.args[0].value
+            if not route.startswith("/debug/"):
+                continue
+            (prefix if node.func.attr == "register_prefix"
+             else exact).add(route)
+            lines[route] = node.lineno
+        return exact, prefix, lines
+
+    def _gateway_routes(self, tree) -> tuple[set, set, dict]:
+        exact: set[str] = set()
+        prefix: set[str] = set()
+        lines: dict[str, int] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Compare):
+                for side in [node.left] + node.comparators:
+                    if (isinstance(side, ast.Constant)
+                            and isinstance(side.value, str)
+                            and side.value.startswith("/debug/")):
+                        exact.add(side.value)
+                        lines[side.value] = node.lineno
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "compile" and node.args
+                  and isinstance(node.args[0], ast.Constant)
+                  and isinstance(node.args[0].value, str)):
+                m = _PREFIX_RX.search(node.args[0].value)
+                if m:
+                    prefix.add(m.group(1))
+                    lines[m.group(1)] = node.lineno
+        return exact, prefix, lines
+
+    def _builders(self, tree) -> dict[str, bool]:
+        """Module-level ``debug_*_body`` builders -> raises DebugApiError?"""
+        out: dict[str, bool] = {}
+        for node in tree.body:
+            if (isinstance(node, ast.FunctionDef)
+                    and re.fullmatch(r"debug_\w+_body", node.name)):
+                raises = any(
+                    isinstance(n, ast.Raise) and n.exc is not None
+                    and "DebugApiError" in ast.dump(n.exc)
+                    for n in ast.walk(node))
+                out[node.name] = raises
+        return out
+
+    def _builder_refs_by_method(self, tree) -> dict[str, set[str]]:
+        """method name -> set of debug_*_body names it references."""
+        out: dict[str, set[str]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            names = {n.id for n in ast.walk(node)
+                     if isinstance(n, ast.Name)
+                     and re.fullmatch(r"debug_\w+_body", n.id)}
+            if names:
+                out[node.name] = names
+        return out
+
+    def _catches_debug_api_error(self, tree, method: str) -> bool:
+        fn: Optional[ast.FunctionDef] = None
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.FunctionDef)
+                    and node.name == method):
+                fn = node
+                break
+        if fn is None:
+            return False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.ExceptHandler) and node.type is not None:
+                if "DebugApiError" in ast.dump(node.type):
+                    return True
+        return False

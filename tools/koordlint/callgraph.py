@@ -1,0 +1,369 @@
+"""Project module index + call graph over ``koordinator_tpu/``.
+
+Shared by the jit-centric analyzers (jit_host_sync, donation_safety):
+
+- :class:`ModuleIndex` maps every module under the package to its parsed
+  source, records every function/method with a qualified name, and
+  resolves names through each module's import aliases (``import jax``,
+  ``from koordinator_tpu.ops import batch_assign as _ba``, relative
+  imports, function-local imports included).
+- :func:`extract_jit_sites` finds every ``jax.jit`` call site — the
+  plain-call form (``jax.jit(fn, donate_argnums=...)``, possibly nested
+  inside a wrapper like ``insp.instrument(jax.jit(...), ...)``), the
+  ``@jax.jit`` decorator, and the ``@functools.partial(jax.jit,
+  static_argnames=...)`` decorator — with its static argnames, donated
+  positions, and the binding it is assigned to (``Scheduler._pass1``).
+- :func:`reachable_functions` walks call edges from the jitted entry
+  functions so device-purity rules apply to the whole traced closure,
+  not just the entry point.
+
+Everything is best-effort static resolution: a name that cannot be
+resolved simply produces no edge.  The self-test corpora pin what the
+resolution MUST handle.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from .core import Project, SourceFile
+
+
+def get_index(project: Project, package: str) -> "ModuleIndex":
+    """One shared ModuleIndex per (project, package): building it is the
+    dominant per-analyzer cost, and every analyzer wants the same one."""
+    cache = getattr(project, "_koordlint_index_cache", None)
+    if cache is None:
+        cache = project._koordlint_index_cache = {}
+    if package not in cache:
+        cache[package] = ModuleIndex(project, package=package)
+    return cache[package]
+
+
+def module_name(path: str) -> Optional[str]:
+    """repo-relative path -> dotted module name (None for non-package
+    files like tools/ scripts)."""
+    if not path.endswith(".py"):
+        return None
+    parts = path[:-3].split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    module: str
+    qualname: str          # "gang_assign" or "Scheduler.__init__"
+    node: ast.AST          # FunctionDef / AsyncFunctionDef / Lambda
+    sf: SourceFile
+
+    @property
+    def fq(self) -> str:
+        return f"{self.module}.{self.qualname}"
+
+
+@dataclasses.dataclass
+class JitSite:
+    sf: SourceFile
+    module: str                     # module containing the jit site
+    line: int
+    func_fq: Optional[str]          # resolved jitted callable, if named
+    func_node: Optional[ast.AST]    # Lambda / decorated def, if inline
+    static_argnames: frozenset[str]
+    donate_argnums: tuple[int, ...]
+    binding: Optional[str]          # "Scheduler._pass1" / "_row_set_donating"
+    binding_class: Optional[str]    # class owning the binding, if a method
+
+
+class ModuleIndex:
+    """Parsed view of the package: modules, functions, import aliases."""
+
+    def __init__(self, project: Project, package: str = "koordinator_tpu"):
+        self.project = project
+        self.package = package
+        self.modules: dict[str, SourceFile] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ast.ClassDef] = {}
+        #: module -> local name -> fully-qualified dotted target
+        self.aliases: dict[str, dict[str, str]] = {}
+        for path, sf in sorted(project.files.items()):
+            if not path.startswith(package + "/") or sf.tree is None:
+                continue
+            mod = module_name(path)
+            self.modules[mod] = sf
+            self.aliases[mod] = self._collect_aliases(mod, sf.tree)
+            self._collect_defs(mod, sf, sf.tree, prefix="")
+
+    # -- indexing -------------------------------------------------------------
+
+    def _collect_aliases(self, mod: str, tree: ast.Module) -> dict[str, str]:
+        out: dict[str, str] = {}
+        for node in ast.walk(tree):  # function-local imports included
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:  # relative: resolve against this module
+                    parts = mod.split(".")
+                    parts = parts[: len(parts) - node.level]
+                    base = ".".join(parts + ([node.module]
+                                             if node.module else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    out[a.asname or a.name] = f"{base}.{a.name}"
+        return out
+
+    def _collect_defs(self, mod: str, sf: SourceFile, node: ast.AST,
+                      prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                self.functions[f"{mod}.{qual}"] = FunctionInfo(
+                    mod, qual, child, sf)
+                self._collect_defs(mod, sf, child, prefix=f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                self.classes[f"{mod}.{prefix}{child.name}"] = child
+                self._collect_defs(mod, sf, child,
+                                   prefix=f"{prefix}{child.name}.")
+
+    # -- resolution -----------------------------------------------------------
+
+    def resolve(self, mod: str, node: ast.AST) -> Optional[str]:
+        """Best-effort fully-qualified dotted name for an expression."""
+        if isinstance(node, ast.Name):
+            alias = self.aliases.get(mod, {})
+            if node.id in alias:
+                return alias[node.id]
+            local = f"{mod}.{node.id}"
+            if local in self.functions or local in self.classes:
+                return local
+            return node.id  # builtins / unresolved globals keep bare names
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(mod, node.value)
+            return f"{base}.{node.attr}" if base else None
+        return None
+
+    def find_function(self, fq: Optional[str]) -> Optional[FunctionInfo]:
+        """FunctionInfo for a dotted name, seeing through re-exports and
+        method qualnames (``pkg.mod.Class.method``)."""
+        if not fq:
+            return None
+        if fq in self.functions:
+            return self.functions[fq]
+        # "pkg.mod.symbol" where the alias chain crossed modules: try
+        # splitting at every known module prefix
+        parts = fq.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:cut])
+            if mod in self.modules:
+                cand = f"{mod}.{'.'.join(parts[cut:])}"
+                if cand in self.functions:
+                    return self.functions[cand]
+                # from-import alias one more hop deep
+                alias = self.aliases.get(mod, {})
+                head = parts[cut]
+                if head in alias:
+                    return self.find_function(
+                        ".".join([alias[head]] + parts[cut + 1:]))
+                return None
+        return None
+
+    # -- call graph -----------------------------------------------------------
+
+    def callees(self, fn: FunctionInfo) -> list[tuple[FunctionInfo, ast.Call]]:
+        """Project-internal callees of a function, with the call node
+        (argument-level detail for taint propagation)."""
+        out: list[tuple[FunctionInfo, ast.Call]] = []
+        cls = fn.qualname.rsplit(".", 1)[0] if "." in fn.qualname else None
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            target: Optional[FunctionInfo] = None
+            f = node.func
+            if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                    and f.value.id in ("self", "cls") and cls):
+                target = self.find_function(f"{fn.module}.{cls}.{f.attr}")
+            else:
+                target = self.find_function(self.resolve(fn.module, f))
+            if target is not None and target.fq != fn.fq:
+                out.append((target, node))
+        return out
+
+
+# -- jit-site extraction ------------------------------------------------------
+
+
+def _const_strs(node: Optional[ast.AST]) -> frozenset[str]:
+    if node is None:
+        return frozenset()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return frozenset(e.value for e in node.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str))
+    return frozenset()
+
+
+def _const_ints(node: Optional[ast.AST]) -> tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int))
+    return ()
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def extract_jit_sites(index: ModuleIndex,
+                      paths: Optional[list[str]] = None) -> list[JitSite]:
+    """Every ``jax.jit`` site in the given repo-relative files (default:
+    all indexed modules), with donated positions and assignment binding.
+    Cached per index + path set (several analyzers ask for the same).
+    """
+    cache = getattr(index, "_site_cache", None)
+    if cache is None:
+        cache = index._site_cache = {}
+    key = tuple(sorted(paths)) if paths is not None else None
+    if key in cache:
+        return cache[key]
+    sites: list[JitSite] = []
+    for mod, sf in sorted(index.modules.items()):
+        if paths is not None and sf.path not in paths:
+            continue
+        parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(sf.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and (
+                    index.resolve(mod, node.func) == "jax.jit"):
+                sites.append(_site_from_call(index, mod, sf, node, parents))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    site = _site_from_decorator(index, mod, sf, node, deco,
+                                                parents)
+                    if site is not None:
+                        sites.append(site)
+    cache[key] = sites
+    return sites
+
+
+def _binding_of(index: ModuleIndex, mod: str, call: ast.Call,
+                parents: dict) -> tuple[Optional[str], Optional[str]]:
+    """(binding, owning class) for the assignment a jit call lands in:
+    ``self._pass1 = insp.instrument(jax.jit(...), ...)`` ->
+    ("_pass1", "Scheduler"); module-level ``_x = jax.jit(...)`` ->
+    ("_x", None)."""
+    node: ast.AST = call
+    while node in parents:
+        node = parents[node]
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            owner: Optional[str] = None
+            up = node
+            while up in parents:
+                up = parents[up]
+                if isinstance(up, ast.ClassDef):
+                    owner = up.name
+                    break
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                return target.attr, owner
+            if isinstance(target, ast.Name):
+                return target.id, None
+            return None, None
+        if isinstance(node, (ast.FunctionDef, ast.ClassDef, ast.Module)):
+            break
+    return None, None
+
+
+def _site_from_call(index: ModuleIndex, mod: str, sf: SourceFile,
+                    call: ast.Call, parents: dict) -> JitSite:
+    fn = call.args[0] if call.args else None
+    func_fq, func_node = None, None
+    if isinstance(fn, ast.Lambda):
+        func_node = fn
+    elif fn is not None:
+        if (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
+                and fn.value.id == "self"):
+            # jax.jit(self._method): owner class found via the binding walk
+            _, owner = _binding_of(index, mod, call, parents)
+            if owner:
+                func_fq = f"{mod}.{owner}.{fn.attr}"
+        else:
+            func_fq = index.resolve(mod, fn)
+    binding, binding_class = _binding_of(index, mod, call, parents)
+    return JitSite(
+        sf=sf, module=mod, line=call.lineno, func_fq=func_fq,
+        func_node=func_node,
+        static_argnames=_const_strs(_kw(call, "static_argnames")),
+        donate_argnums=_const_ints(_kw(call, "donate_argnums")),
+        binding=binding, binding_class=binding_class)
+
+
+def _site_from_decorator(index: ModuleIndex, mod: str, sf: SourceFile,
+                         fn: ast.AST, deco: ast.AST,
+                         parents: dict) -> Optional[JitSite]:
+    """``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` decorators."""
+    static, donate = frozenset(), ()
+    if index.resolve(mod, deco) == "jax.jit":
+        pass
+    elif (isinstance(deco, ast.Call)
+          and index.resolve(mod, deco.func) in ("functools.partial",
+                                                "partial")
+          and deco.args
+          and index.resolve(mod, deco.args[0]) == "jax.jit"):
+        static = _const_strs(_kw(deco, "static_argnames"))
+        donate = _const_ints(_kw(deco, "donate_argnums"))
+    else:
+        return None
+    # qualify through enclosing classes/functions so a decorated METHOD
+    # resolves to its real index key (pkg.mod.Class.method)
+    qual: list[str] = [fn.name]
+    owner = None
+    node: ast.AST = fn
+    while node in parents:
+        node = parents[node]
+        if isinstance(node, (ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            if owner is None and isinstance(node, ast.ClassDef):
+                owner = node.name
+            qual.insert(0, node.name)
+    return JitSite(sf=sf, module=mod, line=fn.lineno,
+                   func_fq=f"{mod}.{'.'.join(qual)}",
+                   func_node=fn, static_argnames=static,
+                   donate_argnums=donate, binding=fn.name,
+                   binding_class=owner)
+
+
+def reachable_functions(index: ModuleIndex,
+                        roots: list[FunctionInfo]) -> dict[str, FunctionInfo]:
+    """Transitive project-internal closure of the given entry points."""
+    seen: dict[str, FunctionInfo] = {}
+    stack = list(roots)
+    while stack:
+        fn = stack.pop()
+        if fn.fq in seen:
+            continue
+        seen[fn.fq] = fn
+        for callee, _ in index.callees(fn):
+            if callee.fq not in seen:
+                stack.append(callee)
+    return seen

@@ -1,0 +1,262 @@
+"""koordlint core: the shared analyzer API.
+
+The tree's worst historical bugs were *invariant* violations no generic
+linter sees — the ``ClusterState.zeros`` donation-aliasing bug (PR 1),
+the Auditor exists-then-open race (PR 1), the DebugService/HTTP-gateway
+route drift PR 6 had to audit by hand.  koordlint makes those invariants
+mechanical: a dependency-free, stdlib-``ast`` framework with
+
+- a :class:`Project` file walker + parse cache over the repo,
+- a :class:`Finding` model (file:line + rule id + fix hint),
+- inline suppressions (``# koordlint: ignore[rule] -- reason``) and a
+  baseline file (``tools/koordlint/baseline.json``) where EVERY
+  suppression carries a written reason — a reasonless suppression is
+  itself a finding,
+- intent annotations (``# koordlint: guarded-by(self._lock)``) analyzers
+  consume (see analyzers/lock_discipline.py).
+
+Analyzers subclass :class:`Analyzer` and register in
+``analyzers/__init__.py``; ``python -m tools.koordlint`` runs them all
+and exits non-zero on any unsuppressed finding (wired at the head of
+tools/soak.sh and into tier-1 via tests/test_koordlint.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import json
+import os
+import re
+from typing import Iterable, Optional
+
+#: inline directives.  ``ignore`` silences named rules on that line (the
+#: reason after ``--`` is mandatory); ``guarded-by`` declares locking
+#: intent (an attribute write, or a whole function when placed on its
+#: ``def`` line, is protected by the named lock).
+_DIRECTIVE_RE = re.compile(r"#\s*koordlint:\s*(?P<kind>ignore|guarded-by)"
+                           r"\s*[\[(](?P<body>[^\])]*)[\])]"
+                           r"(?:\s*--\s*(?P<reason>.*\S))?")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer hit: a rule violation at file:line with a fix hint."""
+
+    rule: str
+    path: str      # repo-relative, forward slashes
+    line: int
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_doc(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Directive:
+    """A parsed ``# koordlint:`` comment on one source line."""
+
+    kind: str      # "ignore" | "guarded-by"
+    body: str      # rule list / lock expression
+    reason: str    # text after " -- " (ignore only; may be empty = bad)
+    line: int
+
+    @property
+    def rules(self) -> set[str]:
+        return {r.strip() for r in self.body.split(",") if r.strip()}
+
+
+class SourceFile:
+    """One parsed source file: text, AST, and inline directives."""
+
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.path = relpath.replace(os.sep, "/")
+        with open(abspath, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(self.text, filename=relpath)
+        except SyntaxError as e:  # surfaced as a finding by Runner
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        #: line -> Directive (one koordlint directive per line)
+        self.directives: dict[int, Directive] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _DIRECTIVE_RE.search(line)
+            if m:
+                self.directives[i] = Directive(
+                    kind=m.group("kind"), body=m.group("body").strip(),
+                    reason=(m.group("reason") or "").strip(), line=i)
+
+    def directive_at(self, line: int, kind: str) -> Optional[Directive]:
+        """The directive covering ``line``: on the line itself, or a
+        standalone directive comment on the line directly above."""
+        d = self.directives.get(line)
+        if d is not None and d.kind == kind:
+            return d
+        prev = self.directives.get(line - 1)
+        if (prev is not None and prev.kind == kind
+                and 1 <= prev.line <= len(self.lines)
+                and self.lines[prev.line - 1].lstrip().startswith("#")):
+            return prev
+        return None
+
+
+class Project:
+    """The repo as a set of parsed files (walked once, shared by every
+    analyzer so the whole suite stays one parse pass over the tree)."""
+
+    #: directories never walked (caches, VCS, the seeded-bad corpora)
+    EXCLUDE_DIRS = {"__pycache__", ".git", "fixtures", "soak_results",
+                    "node_modules", ".claude"}
+    #: the file sets analyzers care about, relative to the repo root
+    DEFAULT_TARGETS = ("koordinator_tpu", "tests", "tools")
+
+    def __init__(self, root: str, targets: Iterable[str] | None = None):
+        self.root = os.path.abspath(root)
+        self.files: dict[str, SourceFile] = {}
+        for target in targets if targets is not None else self.DEFAULT_TARGETS:
+            top = os.path.join(self.root, target)
+            if os.path.isfile(top) and top.endswith(".py"):
+                self._add(top)
+                continue
+            for dirpath, dirnames, filenames in os.walk(top):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in self.EXCLUDE_DIRS)
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        self._add(os.path.join(dirpath, name))
+
+    def _add(self, abspath: str) -> None:
+        rel = os.path.relpath(abspath, self.root)
+        self.files[rel.replace(os.sep, "/")] = SourceFile(abspath, rel)
+
+    def glob(self, pattern: str) -> list[SourceFile]:
+        return [sf for path, sf in sorted(self.files.items())
+                if fnmatch.fnmatch(path, pattern)]
+
+    def get(self, path: str) -> Optional[SourceFile]:
+        return self.files.get(path)
+
+
+class Analyzer:
+    """Base analyzer: subclasses set ``name``/``hint_url`` and implement
+    :meth:`run` returning findings (pre-suppression; the Runner applies
+    inline ignores and the baseline uniformly)."""
+
+    name = "base"
+    description = ""
+
+    def run(self, project: Project) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+# -- suppression machinery ----------------------------------------------------
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    """One baseline suppression: rule + path glob (+ optional message
+    substring) + a MANDATORY reason.  Line numbers are deliberately not
+    part of the match — they drift with every edit and a stale baseline
+    that silently stops matching is worse than a slightly wide one."""
+
+    rule: str
+    path: str
+    reason: str
+    contains: str = ""
+    matched: int = 0
+
+    def matches(self, f: Finding) -> bool:
+        return (f.rule == self.rule
+                and fnmatch.fnmatch(f.path, self.path)
+                and (self.contains in f.message if self.contains else True))
+
+
+def load_baseline(path: str) -> tuple[list[BaselineEntry], list[Finding]]:
+    """(entries, hygiene-findings).  Every entry must carry a non-empty
+    reason; a reasonless entry is a lint-hygiene finding against the
+    baseline file itself, so the policy enforces itself."""
+    entries: list[BaselineEntry] = []
+    problems: list[Finding] = []
+    if not os.path.exists(path):
+        return entries, problems
+    rel = os.path.basename(path)
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except ValueError as e:
+        return entries, [Finding("lint-hygiene", rel, 1,
+                                 f"baseline is not valid JSON: {e}",
+                                 "fix tools/koordlint/baseline.json")]
+    for i, raw in enumerate(doc.get("suppressions", [])):
+        reason = str(raw.get("reason", "")).strip()
+        if not reason:
+            problems.append(Finding(
+                "lint-hygiene", rel, 1,
+                f"baseline suppression #{i} ({raw.get('rule')!r} on "
+                f"{raw.get('path')!r}) has no reason",
+                "every suppression must say WHY it is safe"))
+            continue
+        entries.append(BaselineEntry(
+            rule=str(raw.get("rule", "")), path=str(raw.get("path", "")),
+            reason=reason, contains=str(raw.get("contains", ""))))
+    return entries, problems
+
+
+@dataclasses.dataclass
+class RunResult:
+    findings: list[Finding]            # unsuppressed — these fail the run
+    suppressed: list[tuple[Finding, str]]  # (finding, reason)
+    stale_baseline: list[BaselineEntry]    # entries that matched nothing
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def apply_suppressions(project: Project, findings: list[Finding],
+                       baseline: list[BaselineEntry]) -> RunResult:
+    """Partition findings into live vs suppressed.
+
+    Inline ``# koordlint: ignore[rule] -- reason`` wins on the flagged
+    line (or a standalone comment directly above it); a reasonless
+    inline ignore does NOT suppress and instead raises a lint-hygiene
+    finding of its own.  The baseline catches the rest.
+    """
+    live: list[Finding] = []
+    suppressed: list[tuple[Finding, str]] = []
+    hygiene: list[Finding] = []
+    seen_bad_ignores: set[tuple[str, int]] = set()
+    for f in findings:
+        sf = project.get(f.path)
+        d = sf.directive_at(f.line, "ignore") if sf else None
+        if d is not None and (f.rule in d.rules or "all" in d.rules):
+            if d.reason:
+                suppressed.append((f, d.reason))
+                continue
+            if (f.path, d.line) not in seen_bad_ignores:
+                seen_bad_ignores.add((f.path, d.line))
+                hygiene.append(Finding(
+                    "lint-hygiene", f.path, d.line,
+                    "inline ignore without a reason",
+                    "write `# koordlint: ignore[rule] -- why it is safe`"))
+        for entry in baseline:
+            if entry.matches(f):
+                entry.matched += 1
+                suppressed.append((f, entry.reason))
+                break
+        else:
+            live.append(f)
+    stale = [e for e in baseline if e.matched == 0]
+    return RunResult(live + hygiene, suppressed, stale)

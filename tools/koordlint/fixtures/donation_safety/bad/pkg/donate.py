@@ -1,0 +1,45 @@
+"""Seeded known-BAD corpus for donation-safety.
+
+``State.zeros`` reconstructs the PR-1 ``ClusterState.zeros`` bug
+verbatim in miniature: one ``jnp.zeros`` buffer aliased across three
+pytree fields, so the donating solve consumes them together.  The
+caller below adds the two call-side hazards: reading a donated buffer
+after the call, and passing the donated expression twice.
+"""
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class State:
+    alloc: jax.Array
+    used: jax.Array
+    usage: jax.Array
+
+    @classmethod
+    def zeros(cls, n):
+        z = jnp.zeros((n, 4), jnp.int32)
+        return cls(alloc=z, used=z, usage=z)   # BAD: one buffer, 3 fields
+
+
+def _solve(state, batch):
+    return state
+
+
+solve = jax.jit(_solve, donate_argnums=(0,))
+
+
+class Scheduler:
+    def __init__(self, state, batch):
+        self.state = state
+        self.batch = batch
+
+    def round(self):
+        new = solve(self.state, self.batch)
+        stale = self.state + 1            # BAD: read after donation
+        self.state = new
+        return stale
+
+    def aliased(self):
+        return solve(self.state, self.state)  # BAD: donated arg aliased

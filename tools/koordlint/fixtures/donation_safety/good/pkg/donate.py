@@ -1,0 +1,45 @@
+"""Seeded known-GOOD corpus for donation-safety: the intended idioms —
+one fresh buffer per pytree field, immediate rebind of the donated
+name, metadata reads after donation, reads before the call."""
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class State:
+    alloc: jax.Array
+    used: jax.Array
+    usage: jax.Array
+
+    @classmethod
+    def zeros(cls, n):
+        def z():
+            return jnp.zeros((n, 4), jnp.int32)
+
+        return cls(alloc=z(), used=z(), usage=z())  # one buffer per field
+
+
+def _solve(state, batch):
+    return state
+
+
+solve = jax.jit(_solve, donate_argnums=(0,))
+
+
+class Scheduler:
+    def __init__(self, state, batch):
+        self.state = state
+        self.batch = batch
+
+    def round(self):
+        before = self.state + 0           # ok: read BEFORE the donation
+        self.state = solve(self.state, self.batch)  # ok: rebind idiom
+        n = self.state.shape[0]           # ok: reads the NEW buffer
+        return before, n
+
+    def rebind_local(self):
+        state = self.state
+        cap = state.shape                 # ok: metadata before
+        state = solve(state, self.batch)  # ok: tuple-free rebind
+        return state, cap

@@ -1,0 +1,34 @@
+"""Seeded known-BAD corpus for jit-host-sync: every construct here is a
+silent device sync (or trace-time crash) inside a jitted closure.  The
+self-test (tests/test_koordlint.py) asserts each marked line is flagged.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _helper(scores, limit):
+    # reachable from the jit root below: taint flows interprocedurally
+    if scores.sum() > limit:          # BAD: data-dependent branch
+        return scores * 2
+    return scores
+
+
+def solve(state, pods, k=8):
+    total = jnp.sum(state)
+    best = float(total)               # BAD: host cast of a traced value
+    n = int(jnp.argmax(state))        # BAD: host cast of a traced value
+    flag = bool(total > 0)            # BAD: host cast of a traced value
+    host = np.asarray(pods)           # BAD: np materialization
+    scalar = total.item()             # BAD: .item() device round-trip
+    scores = _helper(state * pods, k)
+    if total > 0:                     # BAD: data-dependent branch
+        scores = scores + 1
+    while jnp.any(scores > 0):        # BAD: data-dependent loop
+        scores = scores - 1
+    for row in scores:                # BAD: host iteration over traced
+        pods = pods + row
+    return scores, best, n, flag, host, scalar
+
+
+solve_jit = jax.jit(solve, static_argnames=("k",))

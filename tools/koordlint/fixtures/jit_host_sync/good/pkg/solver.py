@@ -1,0 +1,41 @@
+"""Seeded known-GOOD corpus for jit-host-sync: host-static idioms the
+analyzer must NOT flag (shape branches, static argnames, string-default
+params, None checks, vararg unrolling, post-jit host reads)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def combine(*masks):
+    out = masks[0]
+    for m in masks[1:]:               # ok: *args tuple unrolls statically
+        out = out & m
+    return out
+
+
+def solve(state, pods, quota=None, k=8, method="auto", spread=(5, 15)):
+    if method == "auto":              # ok: string compare is host-static
+        method = "exact"
+    if state.shape[0] > 64:           # ok: shape branch (bucketed jit)
+        k = min(k, state.shape[0])
+    if quota is None:                 # ok: pytree-None check is static
+        quota = jnp.zeros_like(pods)
+    splits = [k // 2, k - k // 2]
+    parts = []
+    for sb, k_i in zip(spread, splits):   # ok: host tuples
+        if k_i == 0:                  # ok: host int branch
+            continue
+        parts.append(jnp.clip(state * sb, 0, k_i))
+    mask = combine(pods > 0, state > 0)
+    scores = jnp.where(mask, sum(parts), -1)
+    return scores, quota
+
+
+solve_jit = jax.jit(solve, static_argnames=("k",))
+
+
+def caller(state, pods):
+    # never passes method/spread: their defaults stay Python constants
+    scores, quota = solve_jit(state, pods, k=4)
+    total = float(np.asarray(scores).sum())  # ok: OUTSIDE the jit
+    return total

@@ -1,0 +1,59 @@
+"""Seeded known-BAD corpus for lock-discipline: an A->B / B->A
+lock-order cycle across two classes (deadlock candidate), and an
+attribute written guarded in one method but bare in another (race
+candidate)."""
+import threading
+
+
+class Informer:
+    def __init__(self, store: "Store"):
+        self._lock = threading.Lock()
+        self.store = store
+
+    def push(self, item):
+        with self._lock:
+            # BAD half of the cycle: Informer._lock -> Store._lock
+            self.store.commit(item)
+
+    def peek(self):
+        with self._lock:
+            return self.store
+
+
+class Store:
+    def __init__(self, informer: Informer):
+        self._lock = threading.Lock()
+        self.informer = informer
+        self.items = []
+        self.count = 0
+
+    def commit(self, item):
+        with self._lock:
+            self.items.append(item)
+            self.count = len(self.items)   # guarded write
+
+    def rebuild(self):
+        with self._lock:
+            # BAD other half: Store._lock -> Informer._lock
+            self.informer.push(None)
+
+    def reset(self):
+        self.count = 0                     # BAD: bare write (race)
+
+
+class Combined:
+    """Multi-item `with a, b:` acquires in sequence — its order edge
+    must reverse-check against the nested acquisition in flip()."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def both(self, items):
+        with self._a, self._b:             # BAD: a->b ...
+            items.append(1)
+
+    def flip(self, items):
+        with self._b:
+            with self._a:                  # BAD: ... while flip does b->a
+                items.append(2)

@@ -1,0 +1,44 @@
+"""Seeded known-GOOD corpus for lock-discipline: one-directional lock
+nesting (no cycle), consistently-guarded writes, a caller-holds-the-lock
+helper declared with guarded-by, and an RLock reentrancy self-call."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self.count = 0
+
+    def commit(self, item):
+        with self._lock:
+            self.items.append(item)
+            self._bump_locked()
+
+    # koordlint: guarded-by(self._lock)
+    def _bump_locked(self):
+        self.count = len(self.items)   # ok: caller holds the lock
+
+    def reset(self):
+        with self._lock:
+            self.items = []
+            self.count = 0
+
+
+class Informer:
+    """Acquisition order is one-directional: Informer -> Store only."""
+
+    def __init__(self, store: Store):
+        self.lock = threading.RLock()
+        self.store = store
+        self.rev = 0
+
+    def push(self, item):
+        with self.lock:
+            self.rev += 1
+            self.store.commit(item)    # ok: consistent outer->inner order
+
+    def flush(self, items):
+        with self.lock:
+            for item in items:
+                self.push(item)        # ok: RLock reentrancy, no self-edge

@@ -1,0 +1,16 @@
+"""Seeded known-BAD corpus for marker-audit: a chaos test without the
+slow marker (tier-1 would run the soak) and a module-scope jax import
+(pytest collection pays it even with every test deselected)."""
+import jax.numpy as jnp  # BAD: module-scope jax import in a test file
+import pytest
+
+
+@pytest.mark.chaos
+def test_chaos_soak_without_slow():  # BAD: chaos without slow
+    assert jnp.zeros(1).shape == (1,)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_soak_properly_marked():
+    assert True

@@ -1,0 +1,36 @@
+"""Seeded known-BAD corpus for surface-parity (miniature gateway):
+misses /debug/rounds, serves a /debug/trace/ prefix the DebugService
+never registers, and calls the DebugApiError-raising trace builder
+without mapping the typed status."""
+import re
+
+
+class HttpGateway:
+    _TRACE = re.compile(r"^/debug/trace/(.+)$")
+
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+
+    def _route(self, req, method):
+        path = req.path
+        if method == "GET" and path == "/debug/slo":
+            return self._debug_slo(req)
+        m = self._TRACE.match(path)
+        if m and method == "GET":
+            return self._debug_trace(req, m.group(1))
+        req._reply(404, {"error": "no route"})
+
+    def _debug_slo(self, req):
+        from .services import DebugApiError, debug_slo_body
+
+        try:
+            return req._reply(200, debug_slo_body(self.scheduler))
+        except DebugApiError as e:
+            return req._reply(e.status, {"error": e.message})
+
+    def _debug_trace(self, req, pod):
+        from .services import debug_trace_body
+
+        # BAD: debug_trace_body raises DebugApiError (typed 404) but this
+        # handler never maps it -> blanket 500
+        return req._reply(200, debug_trace_body(self.scheduler, pod))

@@ -1,0 +1,66 @@
+"""Seeded known-BAD corpus for surface-parity (miniature services.py):
+/debug/rounds is registered here but missing from the gateway;
+/debug/slo is served WITHOUT the shared builder; the gateway's
+/debug/trace/ prefix route is never registered here."""
+import threading
+
+
+class DebugApiError(Exception):
+    def __init__(self, status, message):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def debug_rounds_body(scheduler, size):
+    return {"rounds": scheduler.rounds[:size]}
+
+
+def debug_slo_body(scheduler):
+    monitor = scheduler.slo_monitor
+    if monitor is None:
+        raise DebugApiError(501, "no SLO monitor attached")
+    return monitor.report()
+
+
+def debug_trace_body(scheduler, pod):
+    trace = scheduler.traces.get(pod)
+    if trace is None:
+        raise DebugApiError(404, f"no trace for {pod!r}")
+    return trace
+
+
+class DebugService:
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+        self._routes = {}
+        self._lock = threading.Lock()
+        self._register_builtin()
+
+    def register(self, path, handler):
+        with self._lock:
+            self._routes[path] = handler
+
+    def register_prefix(self, prefix, handler):
+        with self._lock:
+            self._routes[prefix] = handler
+
+    def handle(self, path, params=None):
+        handler = self._routes.get(path)
+        if handler is None:
+            return 404, {"error": "no route"}
+        try:
+            return 200, handler(params or {})
+        except DebugApiError as e:
+            return e.status, {"error": e.message}
+
+    def _register_builtin(self):
+        self.register("/debug/rounds", self._rounds)
+        self.register("/debug/slo", self._slo)
+
+    def _rounds(self, params):
+        return debug_rounds_body(self.scheduler, int(params.get("size", 32)))
+
+    def _slo(self, params):
+        # BAD: hand-rolled body instead of debug_slo_body
+        return self.scheduler.slo_monitor.report()

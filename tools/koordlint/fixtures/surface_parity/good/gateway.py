@@ -1,0 +1,32 @@
+"""Seeded known-GOOD corpus for surface-parity: route set, shared
+builders, and DebugApiError mapping all mirror services.py."""
+import re
+
+
+class HttpGateway:
+    _TRACE = re.compile(r"^/debug/trace/(.+)$")
+
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+
+    def _route(self, req, method):
+        path = req.path
+        if method == "GET" and path == "/debug/rounds":
+            return self._debug_rounds(req)
+        m = self._TRACE.match(path)
+        if m and method == "GET":
+            return self._debug_trace(req, m.group(1))
+        req._reply(404, {"error": "no route"})
+
+    def _debug_rounds(self, req):
+        from .services import debug_rounds_body
+
+        return req._reply(200, debug_rounds_body(self.scheduler, 32))
+
+    def _debug_trace(self, req, pod):
+        from .services import DebugApiError, debug_trace_body
+
+        try:
+            return req._reply(200, debug_trace_body(self.scheduler, pod))
+        except DebugApiError as e:
+            return req._reply(e.status, {"error": e.message})

@@ -1,0 +1,56 @@
+"""Seeded known-GOOD corpus for surface-parity: both surfaces serve the
+same /debug routes through shared builders with typed errors mapped."""
+import threading
+
+
+class DebugApiError(Exception):
+    def __init__(self, status, message):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def debug_rounds_body(scheduler, size):
+    return {"rounds": scheduler.rounds[:size]}
+
+
+def debug_trace_body(scheduler, pod):
+    trace = scheduler.traces.get(pod)
+    if trace is None:
+        raise DebugApiError(404, f"no trace for {pod!r}")
+    return trace
+
+
+class DebugService:
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+        self._routes = {}
+        self._lock = threading.Lock()
+        self._register_builtin()
+
+    def register(self, path, handler):
+        with self._lock:
+            self._routes[path] = handler
+
+    def register_prefix(self, prefix, handler):
+        with self._lock:
+            self._routes[prefix] = handler
+
+    def handle(self, path, params=None):
+        handler = self._routes.get(path)
+        if handler is None:
+            return 404, {"error": "no route"}
+        try:
+            return 200, handler(params or {})
+        except DebugApiError as e:
+            return e.status, {"error": e.message}
+
+    def _register_builtin(self):
+        self.register("/debug/rounds", self._rounds)
+        self.register_prefix("/debug/trace/", self._trace)
+
+    def _rounds(self, params):
+        return debug_rounds_body(self.scheduler, int(params.get("size", 32)))
+
+    def _trace(self, pod, params):
+        return debug_trace_body(self.scheduler, pod)

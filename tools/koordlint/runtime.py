@@ -1,0 +1,162 @@
+"""Runtime validation of the static lock-order graph (debug-mode).
+
+The lock-discipline analyzer builds its acquisition-order graph from
+``with`` scopes it can resolve statically; this module closes the loop
+at RUNTIME: :func:`instrument_locks` swaps an object's lock attributes
+for recording wrappers that log every cross-lock acquisition edge a
+real thread actually takes, and the concurrency-stress suite
+(tests/test_concurrency_stress.py) asserts the OBSERVED edges merged
+with the STATIC graph stay acyclic — so a lock order the analyzer
+missed (dynamic dispatch, callbacks) still cannot silently invert an
+edge the analyzer recorded.
+
+Dependency-free, stdlib-only, and cheap enough to wrap hot locks inside
+a test; never imported by production code paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+
+@dataclasses.dataclass(frozen=True)
+class ObservedEdge:
+    src: str
+    dst: str
+    thread: str
+
+
+class LockOrderRecorder:
+    """Per-thread held-lock stacks + the cross-lock edges taken."""
+
+    def __init__(self):
+        self._local = threading.local()
+        self._mu = threading.Lock()
+        self.edges: set[ObservedEdge] = set()
+        self.acquisitions = 0
+
+    def _held(self) -> list[str]:
+        held = getattr(self._local, "held", None)
+        if held is None:
+            held = self._local.held = []
+        return held
+
+    def on_acquire(self, name: str) -> None:
+        held = self._held()
+        new_edges = {ObservedEdge(h, name, threading.current_thread().name)
+                     for h in held if h != name}
+        held.append(name)
+        with self._mu:
+            self.acquisitions += 1
+            self.edges |= new_edges
+
+    def on_release(self, name: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    def edge_pairs(self) -> set[tuple[str, str]]:
+        return {(e.src, e.dst) for e in self.edges}
+
+
+class InstrumentedLock:
+    """A Lock/RLock/Condition wrapper that records acquisition order.
+    Context-manager and acquire/release protocols both delegate."""
+
+    def __init__(self, inner, name: str, recorder: LockOrderRecorder):
+        self._inner = inner
+        self._name = name
+        self._recorder = recorder
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._recorder.on_acquire(self._name)
+        return got
+
+    def release(self):
+        self._recorder.on_release(self._name)
+        return self._inner.release()
+
+    def __enter__(self):
+        self._inner.__enter__()
+        self._recorder.on_acquire(self._name)
+        return self
+
+    def __exit__(self, *exc):
+        self._recorder.on_release(self._name)
+        return self._inner.__exit__(*exc)
+
+    def __getattr__(self, item):  # Condition.wait/notify etc.
+        return getattr(self._inner, item)
+
+
+def instrument_locks(obj, recorder: LockOrderRecorder,
+                     cls_name: str | None = None) -> list[str]:
+    """Swap every lock-like attribute of ``obj`` (has acquire+release
+    and a context-manager protocol) for an :class:`InstrumentedLock`
+    named ``module.Class.attr`` — the SAME node ids the static analyzer
+    uses, so observed and static graphs merge directly.  Returns the
+    names instrumented."""
+    cls = cls_name or f"{type(obj).__module__}.{type(obj).__name__}"
+    names = []
+    for attr, value in list(vars(obj).items()):
+        if isinstance(value, InstrumentedLock):
+            continue
+        if (callable(getattr(value, "acquire", None))
+                and callable(getattr(value, "release", None))
+                and hasattr(value, "__enter__")):
+            name = f"{cls}.{attr}"
+            setattr(obj, attr, InstrumentedLock(value, name, recorder))
+            names.append(name)
+    return names
+
+
+def find_cycle(edges: set[tuple[str, str]]) -> list[str] | None:
+    """One cycle in the directed graph (as a node list), or None."""
+    adj: dict[str, list[str]] = {}
+    for src, dst in sorted(edges):
+        adj.setdefault(src, []).append(dst)
+        adj.setdefault(dst, [])
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {v: WHITE for v in adj}
+    stack: list[str] = []
+
+    def dfs(v: str) -> list[str] | None:
+        color[v] = GREY
+        stack.append(v)
+        for w in adj[v]:
+            if color[w] == GREY:
+                return stack[stack.index(w):] + [w]
+            if color[w] == WHITE:
+                cyc = dfs(w)
+                if cyc is not None:
+                    return cyc
+        stack.pop()
+        color[v] = BLACK
+        return None
+
+    for v in sorted(adj):
+        if color[v] == WHITE:
+            cyc = dfs(v)
+            if cyc is not None:
+                return cyc
+    return None
+
+
+def static_lock_edges(root: str) -> set[tuple[str, str]]:
+    """(src, dst) pairs of the lock-discipline analyzer's static graph
+    over the real tree — RLock self-edges excluded, same as the
+    analyzer's cycle check."""
+    from .analyzers.lock_discipline import LockDisciplineAnalyzer
+    from .callgraph import ModuleIndex
+    from .core import Project
+
+    analyzer = LockDisciplineAnalyzer()
+    index = ModuleIndex(Project(root), package=analyzer.package)
+    models = analyzer.build_models(index)
+    graph = analyzer.build_graph(index, models)
+    return {(e.src, e.dst) for e in graph.edges if e.src != e.dst}

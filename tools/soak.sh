@@ -66,11 +66,25 @@ mkdir -p "$OUT"
 ts=$(date +%Y%m%d_%H%M%S)
 log="$OUT/soak_$ts.log"
 
-# dashboard drift gate first: a soak whose dashboards reference
-# unregistered metrics produces evidence nobody can read back
+# static-analysis gate first: a soak over a tree with known invariant
+# violations (jit host syncs, donation hazards, lock races, drifted
+# debug surfaces) produces evidence nobody should trust.  Exits the
+# soak's tally as a failure, never silently.
 total_passed=0
 total_failed=0
 failures=""
+echo "== koordlint static-analysis suite (python -m tools.koordlint)" \
+    | tee -a "$log"
+if python -m tools.koordlint >> "$log" 2>&1; then
+    total_passed=$((total_passed + 1))
+else
+    total_failed=$((total_failed + 1))
+    failures="$failures;koordlint: unsuppressed findings (see log -"
+    failures="$failures run python -m tools.koordlint)"
+fi
+
+# dashboard drift gate (also a koordlint analyzer; the standalone shim
+# stays for precise per-dashboard CLI output in the log)
 echo "== dashboard drift check (tools/check_dashboards.py)" | tee -a "$log"
 if python tools/check_dashboards.py >> "$log" 2>&1; then
     total_passed=$((total_passed + 1))
