@@ -608,6 +608,58 @@ def _emit_zero_record(extra: dict,
     os._exit(0)
 
 
+def metrics_probe_hung_value() -> float:
+    """The bench_probe_hung gauge's value, for the zero record's extra
+    (1.0 = the last probe WEDGED rather than failing fast — points the
+    diagnosis at the remote executor/readback path)."""
+    from koordinator_tpu import metrics
+
+    return metrics.bench_probe_hung.value()
+
+
+def _publish_staged_main() -> int:
+    """``bench.py --publish-staged``: publish the newest banked staged
+    capture IMMEDIATELY, with provenance (ISSUE 9 satellite / ROADMAP
+    item 1 "publish the moment a window opens").
+
+    tools/tpu_probe.sh calls this right after its bench_stages.py run
+    completes, so the first successful staged capture becomes a
+    publishable artifact (``probe_results/published_<ts>.json`` + one
+    JSON line on stdout) the moment it exists — instead of sitting in
+    probe_results/ until the NEXT official bench round happens to
+    promote it.  Host-side only: no device touch, safe while the tunnel
+    is down.  Exit 1 when there is nothing recent to publish."""
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "probe_results")
+    doc: dict = {"published_at": time.time(),
+                 "publisher_provenance": _git_head()}
+    stages = _latest_probe_stages(root)
+    if stages is not None:
+        doc["staged"] = stages
+    notes: list = []
+    captured = _latest_probe_capture(root, notes=notes)
+    if captured is not None:
+        headline, source = captured
+        doc["headline"] = {"record": headline, "source": source}
+    if notes:
+        doc["headline_refused"] = notes[:4]
+    if stages is None and captured is None:
+        print(json.dumps({"error": "no recent staged capture to "
+                                   "publish", "root": root}))
+        return 1
+    os.makedirs(root, exist_ok=True)
+    ts = time.strftime("%Y%m%d_%H%M%S")
+    out = os.path.join(root, f"published_{ts}.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps({"published": out,
+                      "staged_stages": sorted((stages or {}).get(
+                          "stages", {})),
+                      "staged_caveat": (stages or {}).get("caveat"),
+                      "headline": bool(captured)}))
+    return 0
+
+
 MAX_PROBE_CAPTURE_AGE_S = 12 * 3600.0
 
 
@@ -749,9 +801,24 @@ def main() -> None:
     # recording a zero.  KOORD_BENCH_PROBE_TRIES overrides (1 = old
     # single-probe behavior); total worst-case wait = tries * 180s + waits.
     tries = int(os.environ.get("KOORD_BENCH_PROBE_TRIES", "3"))
-    alive, probe_kind, probe_err = False, "", ""
+    # probes run through the armed prober (koordinator_tpu.bench_prober):
+    # every attempt lands in the metrics registry by outcome/duration,
+    # and a hung probe burns the bench_probe_hang SLO instead of being a
+    # silent retry — the observability the four BENCH_r02-r05 zeros
+    # never had
+    from koordinator_tpu.bench_prober import ProbeArmer
+
+    probe_state: dict = {"kind": "", "err": ""}
+
+    def probe() -> tuple[bool, str, str]:
+        ok, kind, err = _device_alive()
+        probe_state.update(kind=kind, err=err)
+        return ok, kind, err
+
+    armer = ProbeArmer(probe, interval_s=60.0, deadline_s=180.0)
+    alive = False
     for attempt in range(max(tries, 1)):
-        alive, probe_kind, probe_err = _device_alive()
+        alive = armer.tick()
         if alive:
             break
         if attempt + 1 < tries:
@@ -760,8 +827,9 @@ def main() -> None:
         _emit_zero_record({
             "error": "device unreachable: probe did not complete in "
                      f"{max(tries, 1)} attempts (tunnel down?): "
-                     f"{probe_err}",
-            "error_kind": probe_kind}, device_down=True)
+                     f"{probe_state['err']}",
+            "error_kind": probe_state["kind"],
+            "probe_hung": metrics_probe_hung_value()}, device_down=True)
 
     state, pods, cfg = _build_problem(N_NODES, N_PODS, seed=42)
 
@@ -1073,6 +1141,8 @@ if __name__ == "__main__":
         _extra_main(sys.argv[2])
     elif len(sys.argv) == 2 and sys.argv[1] == "--cpu-quality":
         _cpu_quality_main()
+    elif len(sys.argv) == 2 and sys.argv[1] == "--publish-staged":
+        sys.exit(_publish_staged_main())
     else:
         try:
             main()

@@ -1,0 +1,181 @@
+"""Armed bench prober: background device probing with SLO teeth.
+
+ROADMAP item 1's diagnosis work (bench.py's ``_device_alive`` error
+kinds, the tools/tpu_probe.sh capture loop) still had two silent modes:
+
+1. a probe that HANGS past its deadline just looped — no alert, no
+   artifact, four rounds of undifferentiated zeros (``BENCH_r02-r05``);
+2. a successful staged capture sat in ``probe_results/`` until the NEXT
+   official bench round promoted it — hours of "we have the number but
+   nobody published it".
+
+:class:`ProbeArmer` closes both: every probe attempt lands in the
+metrics registry (attempts by outcome, wall-time histogram, a
+``bench_probe_hung`` gauge held while the latest probe overran its
+deadline), a :class:`~koordinator_tpu.slo_monitor.SloMonitor` evaluates
+the ``bench_probe_hang`` burn-rate SLO over those samples — so a wedged
+tunnel FIRES an alert with a flight-record dump, exactly like a
+scheduling-latency breach — and the FIRST success runs ``publish_fn``
+immediately (tools/tpu_probe.sh wires ``bench.py --publish-staged``
+there, which stamps the staged capture with provenance and writes it to
+``probe_results/published_*.json`` the moment the window opens).
+
+Everything is injectable (probe_fn, clock, monitor, recorder), so the
+hang->breach->flight-dump path is proven by a deterministic fake-clock
+test (tests/test_bench_prober.py) with no hardware and no sleeps.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from koordinator_tpu import metrics
+from koordinator_tpu.slo_monitor import BurnWindow, SloMonitor, SloSpec
+
+logger = logging.getLogger("koordinator_tpu.bench_prober")
+
+#: outcomes _device_alive can report where the probe HUNG (as opposed
+#: to erroring fast): the backend wedged mid-flight
+HANG_KINDS = ("probe_kernel_hung", "transfer_stall")
+
+
+def probe_hang_spec(objective: float = 0.05,
+                    fast_window_s: float = 1800.0,
+                    fire_burn: float = 4.0) -> SloSpec:
+    """The bench-probe SLO: probes may hang at most ``objective`` of the
+    time.  Windows are probe-cadence scale (minutes between attempts),
+    not request scale, hence the longer fast window and gentler fire
+    threshold than the scheduler SLOs."""
+    return SloSpec(
+        name="bench_probe_hang",
+        description="device probes must not hang past their deadline "
+                    "(a wedged tunnel is an incident, not a retry loop)",
+        kind="gauge",
+        metric="koord_scheduler_bench_probe_hung",
+        threshold=0.5,
+        objective=objective,
+        fast=BurnWindow(window_s=fast_window_s, fire_burn=fire_burn),
+        slow=BurnWindow(window_s=fast_window_s * 4, fire_burn=1.0),
+    )
+
+
+class ProbeArmer:
+    """Retries device probes on a cadence; publishes the first success
+    immediately; surfaces hangs as an SLO burn-rate breach.
+
+    ``probe_fn() -> (ok, error_kind, message)`` is bench.py's
+    ``_device_alive`` signature.  ``publish_fn()`` runs ONCE, on the
+    first successful probe (exceptions are logged, never fatal — the
+    window being open matters more than the publisher's health).
+    """
+
+    def __init__(
+        self,
+        probe_fn: Callable[[], tuple[bool, str, str]],
+        publish_fn: Optional[Callable[[], None]] = None,
+        interval_s: float = 240.0,
+        deadline_s: float = 180.0,
+        clock=time.monotonic,
+        monitor: SloMonitor | None = None,
+        flight_recorder=None,
+        on_hang: Optional[Callable[[dict], None]] = None,
+    ):
+        self.probe_fn = probe_fn
+        self.publish_fn = publish_fn
+        self.interval_s = interval_s
+        self.deadline_s = deadline_s
+        self.clock = clock
+        #: dump target for breach evidence; anything with ``dump_now``
+        #: (the scheduler's FlightRecorder) works
+        self.flight_recorder = flight_recorder
+        self.on_hang = on_hang
+        self.monitor = monitor if monitor is not None else SloMonitor(
+            specs=[probe_hang_spec()], clock=time.time,
+            on_breach=self._breach)
+        self.attempts = 0
+        self.successes = 0
+        self.published = False
+        self.last_outcome: str | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- one probe attempt ---------------------------------------------------
+
+    def tick(self) -> bool:
+        """One probe attempt + SLO evaluation; returns probe success."""
+        t0 = self.clock()
+        try:
+            ok, kind, msg = self.probe_fn()
+        except Exception as e:  # noqa: BLE001 — a crashing probe is
+            # just another outcome, never the armer's death
+            ok, kind, msg = False, "probe_error", repr(e)[:300]
+        elapsed = self.clock() - t0
+        self.attempts += 1
+        outcome = "ok" if ok else (kind or "probe_error")
+        self.last_outcome = outcome
+        metrics.bench_probe_attempts.inc(labels={"outcome": outcome})
+        metrics.bench_probe_duration.observe(elapsed)
+        hung = (not ok) and (elapsed >= self.deadline_s
+                             or kind in HANG_KINDS)
+        metrics.bench_probe_hung.set(1.0 if hung else 0.0)
+        if ok:
+            self.successes += 1
+            metrics.bench_probe_window_open.set(1.0)
+            if not self.published and self.publish_fn is not None:
+                # publish the FIRST capture the moment the window opens
+                # — not at the next bench round
+                self.published = True
+                try:
+                    self.publish_fn()
+                except Exception:  # noqa: BLE001 — counted, not fatal
+                    logger.exception("probe publish_fn failed")
+        elif hung:
+            logger.warning("device probe hung (%s after %.0fs): %s",
+                           kind, elapsed, msg)
+        # the burn-rate evaluation rides every attempt: a run of hung
+        # probes burns the budget and fires _breach with flight evidence
+        self.monitor.tick()
+        return ok
+
+    def _breach(self, spec, doc) -> None:
+        logger.warning("bench probe SLO breached: %s", doc.get("name"))
+        if self.flight_recorder is not None:
+            try:
+                self.flight_recorder.dump_now(f"slo:{spec.name}")
+            except Exception:  # noqa: BLE001
+                logger.exception("flight dump on probe breach failed")
+        if self.on_hang is not None:
+            try:
+                self.on_hang(doc)
+            except Exception:  # noqa: BLE001
+                logger.exception("on_hang callback failed")
+
+    # -- background cadence --------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — observer thread
+                    logger.exception("probe tick failed")
+                if self._stop.wait(self.interval_s):
+                    return
+
+        self._thread = threading.Thread(target=loop, name="bench-prober",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        self._thread = None
+        if thread is not None:
+            thread.join(timeout=5.0)

@@ -32,6 +32,26 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
         help="enable the profiling endpoint")
     parser.add_argument(
         "--v", type=int, default=2, help="log verbosity (klog -v)")
+    parser.add_argument(
+        "--self-telemetry-interval-seconds", type=float, default=0.0,
+        help="background cadence for the process self-telemetry gauges "
+             "(RSS, fds, threads, alloc blocks, gc — the trend engine's "
+             "leak-watch inputs, labeled binary=<name>); 0 disables the "
+             "thread (the scheduler still refreshes them on every SLO "
+             "sample sweep)")
+
+
+def build_self_telemetry(args: argparse.Namespace, binary: str):
+    """A started SelfTelemetry when the cadence flag asks for one, else
+    an unstarted instance (callers may still hook .sample) — ONE wiring
+    shared by every binary main."""
+    from koordinator_tpu.selftelemetry import SelfTelemetry
+
+    telemetry = SelfTelemetry(binary)
+    interval = getattr(args, "self_telemetry_interval_seconds", 0.0)
+    if interval and interval > 0:
+        telemetry.start(interval)
+    return telemetry
 
 
 def add_leader_election_flags(parser: argparse.ArgumentParser,

@@ -24,6 +24,7 @@ from koordinator_tpu.cmd import (
     add_leader_election_flags,
     apply_feature_gates,
     build_elector,
+    build_self_telemetry,
 )
 
 
@@ -41,11 +42,17 @@ class Assembled:
     #: parsed component config (Scheduler/DeschedulerComponentConfig) so
     #: the embedding shell wires data-dependent plugins with file args
     component_config: Optional[Any] = None
+    #: process self-telemetry sampler (selftelemetry.SelfTelemetry) —
+    #: every binary registers the same leak-watch gauges under its own
+    #: binary label
+    telemetry: Optional[Any] = None
 
     def stop(self) -> None:
         """Tear down whatever this binary opened (sockets, gateway, the
         component's own lifecycle); a leading elector releases its lease
         so a follower acquires without waiting out the duration."""
+        if self.telemetry is not None:
+            self.telemetry.stop()
         if self.elector is not None:
             self.elector.release()
         if self.gateway is not None:
@@ -498,7 +505,8 @@ def main_koordlet(argv: list[str], device_report_fn=None,
                                        service="koordlet")
         HookService(hook_dispatcher).attach(daemon.hook_server)
         daemon.hook_server.start()
-    return Assembled(name="koordlet", args=args, component=daemon)
+    return Assembled(name="koordlet", args=args, component=daemon,
+                     telemetry=build_self_telemetry(args, "koordlet"))
 
 
 # ---- koord-scheduler -------------------------------------------------------
@@ -564,6 +572,18 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
         "--slo-latency-threshold-seconds", type=float, default=0.2,
         help="the scheduling-latency SLO's per-observation bound (the "
              "paper's p99 target: 0.2)")
+    parser.add_argument(
+        "--flight-ring-size", type=int, default=256,
+        help="round flight-recorder ring capacity: a long soak's report "
+             "joins trend verdicts to rounds, so size this to cover the "
+             "report window (round_flight_overwritten_total counts the "
+             "records a too-small ring silently evicts)")
+    parser.add_argument(
+        "--trend-window-seconds", type=float, default=1800.0,
+        help="the /debug/steady trend engine's default evaluation "
+             "window: slopes over the self-telemetry/queue-depth series "
+             "are fitted over this much history and classified "
+             "steady/drifting/leaking (?window=N overrides per request)")
     parser.add_argument(
         "--enable-profile-endpoint", action="store_true",
         help="arm /debug/profile?seconds=N (on-demand jax.profiler "
@@ -636,11 +656,17 @@ def main_koord_scheduler(argv: list[str],
                                  else None),
         trace_pods=args.trace_pods,
         explain=not args.no_explain,
+        flight_ring_size=args.flight_ring_size,
     )
     # -- self-observability: SLO burn-rate engine + solver introspection
     from koordinator_tpu.ops.introspection import ProfilerCapture
     from koordinator_tpu.slo_monitor import SloMonitor, default_specs
+    from koordinator_tpu.trend import TrendEngine
 
+    # self-telemetry rides the SLO sampler (every sweep — background OR
+    # on-demand /debug/slo//debug/steady — refreshes RSS/fds/threads
+    # first), so the scheduler needs no second sampling thread
+    telemetry = build_self_telemetry(args, "koord-scheduler")
     slo_monitor = SloMonitor(
         specs=default_specs(
             latency_threshold_s=args.slo_latency_threshold_seconds,
@@ -653,8 +679,13 @@ def main_koord_scheduler(argv: list[str],
         # the offending SLO named — the "why" artifact next to the alert
         on_breach=lambda spec, doc: scheduler.flight_recorder.dump_now(
             f"slo:{spec.name}"),
+        pre_sample=[telemetry.sample],
     )
     scheduler.slo_monitor = slo_monitor
+    # the trend engine shares the SLO monitor's sample cache: one
+    # sampling pass feeds burn rates AND the long-horizon leak watch
+    scheduler.trend_engine = TrendEngine(
+        slo_monitor.cache, window_s=args.trend_window_seconds)
     if args.slo_sample_interval_seconds > 0:
         slo_monitor.start()   # stopped via Assembled.stop -> Scheduler.stop
     if args.enable_profile_endpoint:
@@ -706,7 +737,8 @@ def main_koord_scheduler(argv: list[str],
     return Assembled(name="koord-scheduler", args=args,
                      component=scheduler, elector=elector, server=server,
                      gateway=gateway, state_sync=sync_service,
-                     component_config=component_config)
+                     component_config=component_config,
+                     telemetry=telemetry)
 
 
 # ---- koord-manager ---------------------------------------------------------
@@ -872,7 +904,8 @@ def main_koord_manager(argv: list[str], lease_store=None) -> Assembled:
         gateway.start()
     return Assembled(name="koord-manager", args=args, component=component,
                      elector=build_elector(args, lease_store),
-                     gateway=gateway)
+                     gateway=gateway,
+                     telemetry=build_self_telemetry(args, "koord-manager"))
 
 
 # ---- koord-descheduler -----------------------------------------------------
@@ -1032,7 +1065,9 @@ def main_koord_descheduler(argv: list[str], pods_fn=None,
     )
     return Assembled(name="koord-descheduler", args=args,
                      component=descheduler, elector=elector,
-                     component_config=component)
+                     component_config=component,
+                     telemetry=build_self_telemetry(
+                         args, "koord-descheduler"))
 
 
 # ---- koord-runtime-proxy ---------------------------------------------------
@@ -1067,7 +1102,9 @@ def main_koord_runtime_proxy(argv: list[str],
         HookService(dispatcher).attach(server)
         server.start()
     return Assembled(name="koord-runtime-proxy", args=args, component=proxy,
-                     server=server)
+                     server=server,
+                     telemetry=build_self_telemetry(
+                         args, "koord-runtime-proxy"))
 
 
 # ---- koord-device-daemon ---------------------------------------------------
@@ -1087,7 +1124,9 @@ def main_koord_device_daemon(argv: list[str]) -> Assembled:
     args = build_device_daemon_parser().parse_args(argv)
     daemon = DeviceDaemon(node_name=args.node_name,
                           sys_root=args.sys_root_dir)
-    return Assembled(name="koord-device-daemon", args=args, component=daemon)
+    return Assembled(name="koord-device-daemon", args=args, component=daemon,
+                     telemetry=build_self_telemetry(
+                         args, "koord-device-daemon"))
 
 
 MAINS = {

@@ -77,16 +77,102 @@ class _Ring:
         self.count = min(self.count + 1, cap)
 
     def window(self, start: float, end: float) -> tuple[np.ndarray, np.ndarray]:
-        cap = len(self.ts)
-        if self.count < cap:
-            ts = self.ts[: self.count]
-            vals = self.values[: self.count]
-        else:
-            idx = np.arange(self.head, self.head + cap) % cap
-            ts = self.ts[idx]
-            vals = self.values[idx]
+        ts, vals = self.chronological()
         mask = (ts >= start) & (ts <= end)
         return ts[mask], vals[mask]
+
+    def oldest_ts(self) -> float | None:
+        """O(1) timestamp of the oldest live sample; None when empty."""
+        if not self.count:
+            return None
+        cap = len(self.ts)
+        return float(self.ts[self.head] if self.count == cap
+                     else self.ts[0])
+
+    def chronological(self) -> tuple[np.ndarray, np.ndarray]:
+        """Oldest-first views of the live samples."""
+        cap = len(self.ts)
+        if self.count < cap:
+            return self.ts[: self.count], self.values[: self.count]
+        idx = np.arange(self.head, self.head + cap) % cap
+        return self.ts[idx], self.values[idx]
+
+    def drain_older(self, cutoff: float) -> tuple[np.ndarray, np.ndarray]:
+        """Remove and return every sample STRICTLY older than ``cutoff``
+        (a sample exactly at the horizon is kept, matching the
+        query-time retention boundary); the ring is repacked in place."""
+        ts, vals = self.chronological()
+        keep = ts >= cutoff
+        if keep.all():
+            return np.empty(0), np.empty(0)
+        drained = ts[~keep].copy(), vals[~keep].copy()
+        kept_ts, kept_vals = ts[keep].copy(), vals[keep].copy()
+        n = len(kept_ts)
+        self.ts[:n] = kept_ts
+        self.values[:n] = kept_vals
+        self.count = n
+        self.head = n % len(self.ts)
+        return drained
+
+
+class _ColdTier:
+    """Downsampled history past the hot ring's resolution horizon.
+
+    Samples drained out of a hot ring land here as one mean-per-bin
+    sample per ``downsample_resolution_sec`` — the newest (possibly
+    still-filling) bin accumulates in the pending slot until a later
+    bin's samples arrive, so a bin is finalized exactly once.  Memory
+    stays bounded: one extra ring per series, never more.
+    """
+
+    __slots__ = ("ring", "pending_bin", "pending_sum", "pending_tsum",
+                 "pending_cnt")
+
+    def __init__(self, capacity: int):
+        self.ring = _Ring(capacity)
+        self.pending_bin: int | None = None
+        self.pending_sum = 0.0
+        self.pending_tsum = 0.0
+        self.pending_cnt = 0
+
+    def flush_pending(self) -> None:
+        if self.pending_cnt:
+            self.ring.append(self.pending_tsum / self.pending_cnt,
+                             self.pending_sum / self.pending_cnt)
+        self.pending_bin = None
+        self.pending_sum = self.pending_tsum = 0.0
+        self.pending_cnt = 0
+
+    def absorb(self, ts: np.ndarray, values: np.ndarray,
+               resolution_s: float) -> None:
+        if len(ts) == 0:
+            return
+        bins = np.floor(ts / resolution_s).astype(np.int64)
+        for b in np.unique(bins):          # ascending
+            mask = bins == b
+            if self.pending_bin is not None and b < self.pending_bin:
+                # out-of-order stragglers: finalize directly rather
+                # than reopening a flushed bin
+                self.ring.append(float(ts[mask].mean()),
+                                 float(values[mask].mean()))
+                continue
+            if self.pending_bin is not None and b > self.pending_bin:
+                self.flush_pending()
+            self.pending_bin = int(b)
+            self.pending_sum += float(values[mask].sum())
+            self.pending_tsum += float(ts[mask].sum())
+            self.pending_cnt += int(mask.sum())
+
+    def window(self, start: float, end: float
+               ) -> tuple[np.ndarray, np.ndarray]:
+        ts, vals = self.ring.window(start, end)
+        if self.pending_cnt:
+            pt = self.pending_tsum / self.pending_cnt
+            if start <= pt <= end:
+                ts = np.append(ts, pt)
+                vals = np.append(vals,
+                                 self.pending_sum / self.pending_cnt)
+        return ts, vals
 
 
 class AggregateResult:
@@ -153,14 +239,28 @@ class MetricCache:
     """Thread-safe store of ring-buffered series + an immutable KV side table."""
 
     def __init__(self, capacity_per_series: int = 4096, clock=time.time,
-                 retention_sec: float | None = None):
+                 retention_sec: float | None = None,
+                 downsample_after_sec: float | None = None,
+                 downsample_resolution_sec: float = 10.0):
         self.capacity = capacity_per_series
         #: query-time retention horizon: samples strictly older than
         #: ``now - retention_sec`` are never served (the ring already
         #: bounds memory; retention bounds what a WINDOW may claim to
         #: cover).  A sample exactly AT the horizon is still served.
         self.retention_sec = retention_sec
+        #: long-horizon tier (ISSUE 9): samples aging past this horizon
+        #: move out of the hot ring into a per-series cold ring at
+        #: mean-per-``downsample_resolution_sec``-bin resolution, so an
+        #: hours-long soak keeps a bounded TWO rings per series (full
+        #: resolution recent, downsampled history) instead of either
+        #: unbounded memory or silent eviction of the history the trend
+        #: engine needs.  A sample exactly AT the horizon stays hot;
+        #: one strictly older is downsampled.  None disables the tier
+        #: (hot-ring wraparound evicts, the pre-existing behavior).
+        self.downsample_after_sec = downsample_after_sec
+        self.downsample_resolution_sec = downsample_resolution_sec
         self._series: dict[tuple, _Ring] = {}
+        self._cold: dict[tuple, _ColdTier] = {}
         self._kv: dict[str, object] = {}
         self._lock = threading.Lock()
         self._clock = clock
@@ -171,17 +271,68 @@ class MetricCache:
                labels: Mapping[str, str] | None = None,
                ts: Optional[float] = None) -> None:
         key = _series_key(metric, labels)
+        now = self._clock() if ts is None else ts
         with self._lock:
             ring = self._series.get(key)
             if ring is None:
                 ring = self._series[key] = _Ring(self.capacity)
-            ring.append(self._clock() if ts is None else ts, value)
+            if (self.downsample_after_sec is not None
+                    and ring.count == len(ring.ts)):
+                # the hot ring is full: this append overwrites the
+                # oldest sample.  With the long-horizon tier on, a
+                # wrap-evicted sample is CAPTURED (downsampled) instead
+                # of silently lost — a hot ring smaller than the horizon
+                # must not punch holes in the history
+                evict_ts = float(ring.ts[ring.head])
+                evict_val = float(ring.values[ring.head])
+                tier = self._cold.get(key)
+                if tier is None:
+                    tier = self._cold[key] = _ColdTier(self.capacity)
+                tier.absorb(np.asarray([evict_ts]),
+                            np.asarray([evict_val]),
+                            self.downsample_resolution_sec)
+            ring.append(now, value)
+            if self.downsample_after_sec is not None:
+                # amortized: compact this series only once a full
+                # downsample bin's worth has aged past the horizon
+                # (compact() does the exact-cutoff sweep on demand)
+                oldest = ring.oldest_ts()
+                if oldest is not None and (
+                        oldest < now - self.downsample_after_sec
+                        - self.downsample_resolution_sec):
+                    self._compact_series_locked(key, now)
 
     def append_many(self, samples: list[tuple[str, float, Mapping[str, str] | None]],
                     ts: Optional[float] = None) -> None:
         now = self._clock() if ts is None else ts
         for metric, value, labels in samples:
             self.append(metric, value, labels, ts=now)
+
+    # koordlint: guarded-by(self._lock)
+    def _compact_series_locked(self, key: tuple, now: float) -> None:
+        ring = self._series.get(key)
+        if ring is None or self.downsample_after_sec is None:
+            return
+        drained_ts, drained_vals = ring.drain_older(
+            now - self.downsample_after_sec)
+        if len(drained_ts) == 0:
+            return
+        tier = self._cold.get(key)
+        if tier is None:
+            tier = self._cold[key] = _ColdTier(self.capacity)
+        tier.absorb(drained_ts, drained_vals,
+                    self.downsample_resolution_sec)
+
+    def compact(self, now: Optional[float] = None) -> None:
+        """Move every sample older than ``downsample_after_sec`` into
+        its series' downsampled cold tier right now (appends do this
+        lazily per series); no-op when the tier is disabled."""
+        if self.downsample_after_sec is None:
+            return
+        now = self._clock() if now is None else now
+        with self._lock:
+            for key in list(self._series):
+                self._compact_series_locked(key, now)
 
     def query(self, metric: str, labels: Mapping[str, str] | None = None,
               start: float = 0.0, end: Optional[float] = None) -> AggregateResult:
@@ -194,6 +345,15 @@ class MetricCache:
             if ring is None:
                 return AggregateResult(np.empty(0), np.empty(0))
             ts, vals = ring.window(start, end)
+            tier = self._cold.get(key)
+            if tier is not None:
+                # downsampled history first (older), hot samples after —
+                # aggregators don't require sorted input, but keeping
+                # rough chronological order costs nothing
+                cts, cvals = tier.window(start, end)
+                if len(cts):
+                    ts = np.concatenate([cts, ts])
+                    vals = np.concatenate([cvals, vals])
         return AggregateResult(ts, vals)
 
     def series_labels(self, metric: str) -> list[dict[str, str]]:
@@ -205,7 +365,9 @@ class MetricCache:
 
     def delete_series(self, metric: str, labels: Mapping[str, str]) -> None:
         with self._lock:
-            self._series.pop(_series_key(metric, labels), None)
+            key = _series_key(metric, labels)
+            self._series.pop(key, None)
+            self._cold.pop(key, None)
 
     def gc(self, keep_pod_uids: set[str]) -> int:
         """Drop series of pods that no longer exist; returns dropped count."""
@@ -216,6 +378,7 @@ class MetricCache:
             ]
             for key in stale:
                 del self._series[key]
+                self._cold.pop(key, None)
         return len(stale)
 
     # -- persistence (tsdb_storage.go:29 role) --

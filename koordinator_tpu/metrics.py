@@ -298,8 +298,12 @@ KOORDLET = Registry("koordlet")
 MANAGER = Registry("koord_manager")
 DESCHEDULER = Registry("koord_descheduler")
 TRANSPORT = Registry("koord_transport")
+#: process self-telemetry (selftelemetry.py): the same gauges in every
+#: binary, labeled {binary=...} — the trend engine's leak-watch inputs
+PROCESS = Registry("koord_process")
 
-ALL_REGISTRIES = (SCHEDULER, KOORDLET, MANAGER, DESCHEDULER, TRANSPORT)
+ALL_REGISTRIES = (SCHEDULER, KOORDLET, MANAGER, DESCHEDULER, TRANSPORT,
+                  PROCESS)
 
 
 def expose_all(openmetrics: bool = False) -> str:
@@ -456,6 +460,63 @@ slo_alerts_total = SCHEDULER.counter(
     "slo_alerts_total",
     "SLO alert transitions (labels: slo, phase=fire|clear)")
 
+# -- steady-state observatory (trend.py / selftelemetry.py, ISSUE 9) --
+pods_enqueued_total = SCHEDULER.counter(
+    "pods_enqueued_total",
+    "Pods admitted into the scheduling queue (rsv:: reserve-pods "
+    "included) — rate() of this is the arrival rate the churn load "
+    "generator drives and the steady-state dashboards plot")
+trend_verdict = SCHEDULER.gauge(
+    "trend_verdict",
+    "Long-horizon trend verdict per watched series (labels: series "
+    "plus the series' own labels): -1 no_data, 0 steady, 1 drifting, "
+    "2 leaking — set by each TrendEngine.evaluate and served at "
+    "/debug/steady")
+trend_slope_per_hour = SCHEDULER.gauge(
+    "trend_slope_per_hour",
+    "Fitted windowed slope per watched series, scaled to units/hour "
+    "(labels: series plus the series' own labels)")
+
+# -- bench probe arming (bench_prober.py, ROADMAP item 1) --
+bench_probe_attempts = SCHEDULER.counter(
+    "bench_probe_attempts_total",
+    "Device-probe attempts by outcome (label: outcome=ok|"
+    "no_devices_enumerated|probe_kernel_hung|transfer_stall|"
+    "probe_error) — the background prober's retry cadence")
+bench_probe_duration = SCHEDULER.histogram(
+    "bench_probe_duration_seconds",
+    "Wall time of each device-probe attempt; a probe pinned at its "
+    "deadline means the backend hangs rather than errors",
+    buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 180.0, 300.0))
+bench_probe_hung = SCHEDULER.gauge(
+    "bench_probe_hung",
+    "1 while the latest device probe overran its deadline (hung "
+    "kernel/transfer) rather than failing fast; the bench_probe_hang "
+    "SLO burns against this, so a wedged tunnel pages with a flight "
+    "record instead of silently retrying")
+bench_probe_window_open = SCHEDULER.gauge(
+    "bench_probe_window_open",
+    "1 once a probe has succeeded this armer's run (the tunnel-up "
+    "window the staged capture publishes into)")
+
+# -- process self-telemetry (selftelemetry.py) --
+process_rss_bytes = PROCESS.gauge(
+    "rss_bytes", "Resident set size (proc statm; label: binary)")
+process_open_fds = PROCESS.gauge(
+    "open_fds", "Open file descriptors (label: binary)")
+process_threads = PROCESS.gauge(
+    "threads", "Live Python threads (label: binary)")
+process_alloc_blocks = PROCESS.gauge(
+    "alloc_blocks",
+    "Interpreter-allocated memory blocks (sys.getallocatedblocks; "
+    "label: binary) — a cheap, monotone-under-leak heap signal")
+process_gc_objects = PROCESS.gauge(
+    "gc_objects",
+    "Generation-0 gc-tracked objects (label: binary)")
+process_gc_collections = PROCESS.gauge(
+    "gc_collections",
+    "Cumulative gc collections across generations (label: binary)")
+
 # -- JAX solver introspection (ops/introspection.py) --
 solver_recompiles = SCHEDULER.counter(
     "solver_recompiles_total",
@@ -544,6 +605,17 @@ sync_gap_resyncs_total = TRANSPORT.counter(
     "sync_gap_resyncs_total",
     "Watch-stream rv gaps detected by a sync client (a lost/reordered "
     "delta): the client tears its connection down and re-HELLOs")
+sync_binding_backlog = TRANSPORT.gauge(
+    "sync_binding_backlog",
+    "Committed deltasync events queued for local-binding apply right "
+    "now (StateSyncService._binding_queue depth) — bindings drain it "
+    "behind the scheduler lock, so sustained growth means solve rounds "
+    "can no longer keep up with the arrival process")
+sync_binding_backlog_peak = TRANSPORT.gauge(
+    "sync_binding_backlog_peak",
+    "High-water mark of the local-binding backlog since process start "
+    "(the watermark the steady-state soak bounds and the trend engine "
+    "watches)")
 sync_resyncs_total = TRANSPORT.counter(
     "sync_resyncs_total",
     "Server-requested resyncs honored by a reconnecting client (ERROR "

@@ -149,6 +149,7 @@ class Scheduler:
         trace_pods: bool = False,
         faults=None,
         explain: bool = True,
+        flight_ring_size: int = 256,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -377,7 +378,12 @@ class Scheduler:
         #: /debug/trace/<pod> lookups
         self._pod_trace_ids: dict[str, str] = {}
         self.round_seq = 0
+        #: ring capacity is a knob (--flight-ring-size): a long soak's
+        #: report joins verdicts to rounds, so the ring must hold enough
+        #: rounds to cover the report's window — size it so
+        #: round_flight_overwritten_total stays near zero over the run
         self.flight_recorder = FlightRecorder(
+            capacity=flight_ring_size,
             slow_threshold_s=self.monitor.timeout_sec)
         #: device-side share of the round's solve (time blocked on
         #: jitted results), accumulated across solve dispatches
@@ -433,6 +439,10 @@ class Scheduler:
         #: SloMonitor attached by the binary assembly (serves /debug/slo
         #: and fires flight-recorder dumps on fast-burn breaches)
         self.slo_monitor = None
+        #: trend.TrendEngine attached by the binary assembly (serves
+        #: /debug/steady: steady/drifting/leaking verdicts over the
+        #: self-telemetry and queue-depth series); None => typed 501
+        self.trend_engine = None
         #: introspection.ProfilerCapture behind /debug/profile; None =
         #: the endpoint answers 403 (gated off by default)
         self.profile_capture = None
@@ -764,6 +774,13 @@ class Scheduler:
 
     def enqueue(self, pod: PodSpec) -> None:
         with self.lock:
+            # arrival-process accounting (ISSUE 9): rate() of this is
+            # the admission rate the churn load generator drives.  Only
+            # NEW names count — a resync bootstrap replays pod_add for
+            # every still-pending pod, and re-counting the whole queue
+            # would paint a phantom arrival spike on the dashboards
+            if pod.name not in self.pending:
+                metrics.pods_enqueued_total.inc()
             self.pending[pod.name] = pod
             self._pending_rev += 1
             # the pod's trace starts (or joins) here: a propagated

@@ -45,6 +45,41 @@ def debug_slo_body(scheduler) -> dict:
     return monitor.report()
 
 
+def debug_steady_body(scheduler, params: dict | None = None) -> dict:
+    """The /debug/steady payload (shared by DebugService and the HTTP
+    gateway): the long-horizon trend engine's per-series
+    steady/drifting/leaking verdicts, joined to the SLO engine's breach
+    state — "is this thing leaking or drifting under churn" as one
+    document.
+
+    ``?window=N`` overrides the evaluation window (seconds).  When an
+    SLO monitor is attached its sampler runs first, so an on-demand
+    request (no background cadence) still evaluates current telemetry;
+    repeated scrapes build the window organically like /debug/slo."""
+    engine = getattr(scheduler, "trend_engine", None)
+    if engine is None:
+        raise DebugApiError(501, "no trend engine attached "
+                                 "(scheduler binaries only)")
+    window = (params or {}).get("window")
+    if window is not None:
+        try:
+            window = float(window)
+        except (TypeError, ValueError):
+            raise DebugApiError(400, "window must be a number") from None
+        if not (window > 0):   # also rejects NaN
+            raise DebugApiError(400, "window must be positive")
+    monitor = getattr(scheduler, "slo_monitor", None)
+    if monitor is not None:
+        monitor.sample_once()
+    body = engine.evaluate(window_s=window)
+    if monitor is not None:
+        slo = monitor.report()
+        body["slo_breached"] = slo.get("breached", [])
+        body["slo_breaches_total"] = {
+            d["name"]: d["breaches_total"] for d in slo.get("slos", [])}
+    return body
+
+
 def debug_profile_body(scheduler, seconds) -> dict:
     """The /debug/profile?seconds=N payload: an on-demand jax.profiler
     capture.  403 while the gate is off (the default), 409 while a
@@ -216,6 +251,7 @@ class DebugService:
         self.register("/metrics", self._metrics)
         self.register("/debug/rounds", self._rounds)
         self.register("/debug/slo", self._slo)
+        self.register("/debug/steady", self._steady)
         self.register("/debug/profile", self._profile)
         self.register_prefix("/debug/trace/", self._trace)
         self.register_prefix("/debug/explain/", self._explain)
@@ -312,6 +348,11 @@ class DebugService:
     def _slo(self, params: dict) -> object:
         """The SLO burn-rate engine's evaluation (/debug/slo)."""
         return debug_slo_body(self.scheduler)
+
+    def _steady(self, params: dict) -> object:
+        """The trend engine's steady-state verdicts (/debug/steady,
+        ?window=N overrides the evaluation window)."""
+        return debug_steady_body(self.scheduler, params)
 
     def _profile(self, params: dict) -> object:
         """On-demand jax.profiler capture (/debug/profile?seconds=N);

@@ -169,6 +169,7 @@ class SloMonitor:
         on_breach: Optional[Callable[[SloSpec, dict], None]] = None,
         cache: MetricCache | None = None,
         capacity_per_series: int = 4096,
+        pre_sample: Iterable[Callable[[], None]] = (),
     ):
         self.specs = list(specs) if specs is not None else default_specs()
         self.registries = tuple(registries)
@@ -182,6 +183,12 @@ class SloMonitor:
         self.cache = cache if cache is not None else MetricCache(
             capacity_per_series=capacity_per_series, clock=clock,
             retention_sec=slow_max * 1.25)
+        #: hooks run at the top of every sample sweep, BEFORE the
+        #: registries are read — the self-telemetry gauges (RSS, fds,
+        #: threads) refresh here so even on-demand /debug/slo and
+        #: /debug/steady requests sample current process state.  A hook
+        #: exception must never kill the sweep.
+        self.pre_sample = list(pre_sample)
         self._state = {spec.name: _SloState() for spec in self.specs}
         self._last_report: dict | None = None
         self._lock = threading.Lock()
@@ -200,6 +207,11 @@ class SloMonitor:
         """One sweep over every registry instrument into the ring
         cache; returns samples appended."""
         now = self.clock() if now is None else now
+        for hook in self.pre_sample:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — observer, never fatal
+                logger.exception("SLO pre-sample hook failed")
         appended = 0
         for reg in self.registries:
             for _, m in reg.items():

@@ -29,7 +29,7 @@ from typing import Optional
 
 import numpy as np
 
-from koordinator_tpu import tracing
+from koordinator_tpu import metrics, tracing
 from koordinator_tpu.transport import wire
 from koordinator_tpu.transport.wire import FrameType
 
@@ -176,6 +176,9 @@ class StateSyncService:
         #: hold the service lock while they do
         self._binding_queue: deque = deque()
         self._binding_lock = threading.Lock()
+        #: high-water mark of the binding backlog (gauge shadow; only
+        #: ever written under _lock alongside the append)
+        self._backlog_peak = 0
 
     # -- mutations (informer event handlers) --------------------------------
 
@@ -238,6 +241,15 @@ class StateSyncService:
             self._server.broadcast(FrameType.DELTA, doc, stacked)
         if self._local_bindings:
             self._binding_queue.append((event, arrays))
+            # backlog watermark (ISSUE 9): depth sampled at append (the
+            # only place it grows) plus a monotone high-water gauge —
+            # the steady-state soak bounds the peak, the trend engine
+            # watches it for leak-shaped growth
+            depth = len(self._binding_queue)
+            metrics.sync_binding_backlog.set(float(depth))
+            if depth > self._backlog_peak:
+                self._backlog_peak = depth
+                metrics.sync_binding_backlog_peak.set(float(depth))
         return rv
 
     def _drain_bindings(self) -> None:
@@ -246,6 +258,7 @@ class StateSyncService:
                 try:
                     event, arrays = self._binding_queue.popleft()
                 except IndexError:
+                    metrics.sync_binding_backlog.set(0.0)
                     return
                 for binding in self._local_bindings:
                     _dispatch_event(binding, event, arrays)

@@ -36,6 +36,11 @@ Routes (all JSON bodies/responses unless noted):
     GET  /debug/slo                    -> the SLO burn-rate engine's
                                           evaluation (specs, windows,
                                           burn rates, breach state)
+    GET  /debug/steady?window=N        -> the trend engine's long-
+                                          horizon steady/drifting/
+                                          leaking verdicts per watched
+                                          series, joined to SLO breach
+                                          state (scheduler binaries)
     GET  /debug/profile?seconds=N      -> on-demand jax.profiler
                                           capture; 403 unless enabled
                                           at assembly (gated off by
@@ -184,6 +189,8 @@ class HttpGateway:
             return self._debug_rounds(req)
         if method == "GET" and path == "/debug/slo":
             return self._debug_slo(req)
+        if method == "GET" and path == "/debug/steady":
+            return self._debug_steady(req)
         if method == "GET" and path == "/debug/profile":
             return self._debug_profile(req)
         m = self._TRACE.match(path)
@@ -318,6 +325,26 @@ class HttpGateway:
 
         try:
             return req._reply(200, debug_slo_body(self.scheduler))
+        except DebugApiError as e:
+            return req._reply(e.status, {"error": e.message})
+
+    def _debug_steady(self, req) -> None:
+        """The trend engine's steady/drifting/leaking verdicts — same
+        body the DebugService serves (shared builder; ?window=N
+        overrides the evaluation window)."""
+        if self.scheduler is None:
+            return req._reply(501, {"error": "no scheduler attached"})
+        from urllib.parse import parse_qsl
+
+        from koordinator_tpu.scheduler.services import (
+            DebugApiError,
+            debug_steady_body,
+        )
+
+        params = dict(parse_qsl(req.path.partition("?")[2]))
+        try:
+            return req._reply(200, debug_steady_body(self.scheduler,
+                                                     params))
         except DebugApiError as e:
             return req._reply(e.status, {"error": e.message})
 

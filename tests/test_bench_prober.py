@@ -1,0 +1,139 @@
+"""ProbeArmer (koordinator_tpu/bench_prober.py): probe outcomes land in
+metrics, the first success publishes immediately, and a hung probe
+burns the bench_probe_hang SLO into an alert WITH a flight-record dump
+— all deterministic (fake clocks, fake probes, no hardware, no sleeps).
+"""
+
+import pytest
+
+from koordinator_tpu import metrics
+from koordinator_tpu.bench_prober import ProbeArmer, probe_hang_spec
+from koordinator_tpu.scheduler.flight_recorder import (
+    FlightRecorder,
+    RoundRecord,
+)
+from koordinator_tpu.slo_monitor import SloMonitor
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_record(n=1) -> RoundRecord:
+    return RoundRecord(
+        round=n, trace_id=f"t{n}", start_time=0.0, duration_s=0.01,
+        solver="batch", solve_path="incremental", pods=1, placed=1,
+        failed=0, suspended=0, degraded=False, staleness_s=0.0,
+        dirty_node_frac=0.0, dirty_pod_frac=0.0, solve_wall_s=0.01,
+        solve_device_s=0.005)
+
+
+class TestProbeArmer:
+    def _armer(self, probe_fn, clock=None, monitor_clock=None, **kw):
+        clock = clock or FakeClock()
+        monitor = SloMonitor(
+            specs=[probe_hang_spec(objective=0.05, fast_window_s=600.0,
+                                   fire_burn=4.0)],
+            clock=monitor_clock or clock)
+        armer = ProbeArmer(probe_fn, clock=clock, monitor=monitor, **kw)
+        monitor.on_breach = armer._breach
+        return armer, clock, monitor
+
+    def test_success_publishes_once_immediately(self):
+        published = []
+        armer, clock, _ = self._armer(
+            lambda: (True, "", ""), publish_fn=lambda: published.append(1))
+        assert armer.tick() is True
+        assert published == [1]          # the FIRST success publishes
+        assert armer.tick() is True
+        assert published == [1]          # ... exactly once
+        assert metrics.bench_probe_window_open.value() == 1.0
+        assert metrics.bench_probe_attempts.value(
+            labels={"outcome": "ok"}) == 2.0
+
+    def test_outcomes_and_durations_are_recorded(self):
+        outcomes = iter([
+            (False, "no_devices_enumerated", "empty"),
+            (False, "probe_kernel_hung", "wedged"),
+            (True, "", ""),
+        ])
+        armer, clock, _ = self._armer(lambda: next(outcomes))
+        for _ in range(3):
+            armer.tick()
+            clock.t += 10.0
+        assert metrics.bench_probe_attempts.value(
+            labels={"outcome": "no_devices_enumerated"}) == 1.0
+        assert metrics.bench_probe_attempts.value(
+            labels={"outcome": "probe_kernel_hung"}) == 1.0
+        assert metrics.bench_probe_attempts.value(
+            labels={"outcome": "ok"}) == 1.0
+        assert armer.attempts == 3 and armer.successes == 1
+        # the success cleared the hung gauge
+        assert metrics.bench_probe_hung.value() == 0.0
+
+    def test_crashing_probe_is_an_outcome_not_a_crash(self):
+        def boom():
+            raise RuntimeError("backend exploded")
+
+        armer, _, _ = self._armer(boom)
+        assert armer.tick() is False
+        assert metrics.bench_probe_attempts.value(
+            labels={"outcome": "probe_error"}) == 1.0
+
+    def test_hung_probe_fires_slo_breach_with_flight_dump(self):
+        """The ROADMAP item 1 acceptance: a probe hung past its deadline
+        is a burn-rate breach WITH a flight record, not a silent retry
+        loop."""
+        recorder = FlightRecorder(capacity=8)
+        recorder.record(make_record())
+        clock = FakeClock()
+
+        def hung_probe():
+            clock.t += 200.0             # each probe wedges for 200s
+            return (False, "probe_kernel_hung", "kernel never returned")
+
+        armer, clock, monitor = self._armer(
+            hung_probe, clock=clock, deadline_s=180.0,
+            flight_recorder=recorder)
+        hang_events = []
+        armer.on_hang = hang_events.append
+        fired = False
+        for _ in range(12):              # a run of hung probes
+            armer.tick()
+            clock.t += 60.0
+            if metrics.slo_alerts_total.value(
+                    labels={"slo": "bench_probe_hang",
+                            "phase": "fire"}) >= 1.0:
+                fired = True
+                break
+        assert fired, "hung probes never fired the bench_probe_hang SLO"
+        assert metrics.bench_probe_hung.value() == 1.0
+        # the breach dumped the flight record with the SLO named
+        assert metrics.round_flight_dumps.value(
+            labels={"reason": "slo:bench_probe_hang"}) >= 1.0
+        assert recorder.dumps >= 1
+        assert hang_events and hang_events[0]["name"] == "bench_probe_hang"
+
+    def test_fast_failures_do_not_count_as_hangs(self):
+        armer, clock, _ = self._armer(
+            lambda: (False, "no_devices_enumerated", "refused"))
+        armer.tick()
+        assert metrics.bench_probe_hung.value() == 0.0
+
+    def test_background_cadence_stops_cleanly(self):
+        armer = ProbeArmer(lambda: (True, "", ""), interval_s=30.0)
+        armer.start()
+        armer.stop()
+        assert armer._thread is None
+
+
+class TestProbeHangSpec:
+    def test_spec_targets_the_hung_gauge(self):
+        spec = probe_hang_spec()
+        assert spec.metric == "koord_scheduler_bench_probe_hung"
+        assert spec.kind == "gauge"
+        assert spec.threshold == pytest.approx(0.5)

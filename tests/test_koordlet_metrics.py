@@ -126,6 +126,94 @@ class TestMetricCacheRetentionAndDownsampling:
         ghost = cache.query("never_written")
         assert ghost.empty and not math.isnan(ghost.avg())
 
+
+class TestMetricCacheLongHorizonTier:
+    """Two-tier downsampling horizon (ISSUE 9 satellite): samples aging
+    past ``downsample_after_sec`` move into a bounded cold ring at
+    mean-per-bin resolution instead of being silently evicted by hot
+    wraparound — hours-long soaks stay memory-bounded AND keep history.
+    """
+
+    def _cache(self, clock, **kw):
+        kw.setdefault("downsample_after_sec", 60.0)
+        kw.setdefault("downsample_resolution_sec", 10.0)
+        return mc.MetricCache(clock=clock, **kw)
+
+    def test_exact_horizon_kept_hot_one_older_downsampled(self, clock):
+        cache = self._cache(clock)
+        cache.append(mc.NODE_CPU_USAGE, 1.0, ts=999.9)    # one older
+        cache.append(mc.NODE_CPU_USAGE, 2.0, ts=1000.0)   # exactly AT
+        cache.append(mc.NODE_CPU_USAGE, 3.0, ts=1030.0)
+        clock.t = 1060.0
+        cache.compact()
+        # the horizon sample and newer stay in the hot ring at full
+        # resolution; the strictly-older one moved to the cold tier
+        key = mc._series_key(mc.NODE_CPU_USAGE, None)
+        hot_ts, hot_vals = cache._series[key].chronological()
+        assert hot_vals.tolist() == [2.0, 3.0]
+        # ... but the QUERY still serves all three (cold merged in)
+        res = cache.query(mc.NODE_CPU_USAGE, start=0, end=2000)
+        assert sorted(res.values.tolist()) == [1.0, 2.0, 3.0]
+
+    def test_drained_samples_downsample_to_bin_means(self, clock):
+        cache = self._cache(clock)
+        # bin [1000, 1010): three samples -> ONE cold sample at their mean
+        for ts, v in ((1001.0, 1.0), (1004.0, 2.0), (1007.0, 9.0)):
+            cache.append(mc.NODE_CPU_USAGE, v, ts=ts)
+        # a later bin's sample finalizes the pending one
+        cache.append(mc.NODE_CPU_USAGE, 5.0, ts=1015.0)
+        clock.t = 1200.0
+        cache.compact()
+        res = cache.query(mc.NODE_CPU_USAGE, start=0, end=2000)
+        assert res.count == 2          # two bins, one sample each
+        assert sorted(res.values.tolist()) == [4.0, 5.0]   # mean(1,2,9)=4
+        assert res.avg() == pytest.approx(4.5)
+
+    def test_memory_stays_bounded_over_a_long_run(self, clock):
+        cache = self._cache(clock, capacity_per_series=64)
+        # simulate hours: 10x the hot capacity at 1s cadence
+        for i in range(640):
+            clock.t = 1000.0 + i
+            cache.append(mc.NODE_CPU_USAGE, float(i))
+        key = mc._series_key(mc.NODE_CPU_USAGE, None)
+        assert cache._series[key].count <= 64
+        tier = cache._cold[key]
+        assert tier.ring.count <= 64
+        # history survived in downsampled form: the window covers far
+        # more than the hot ring alone could (64 raw + cold bins)
+        res = cache.query(mc.NODE_CPU_USAGE, start=0, end=5000)
+        assert res.count > 64
+        assert res.duration_seconds() > 500.0
+
+    def test_append_triggers_compaction_lazily(self, clock):
+        cache = self._cache(clock)
+        cache.append(mc.NODE_CPU_USAGE, 1.0, ts=1000.0)
+        # an append a full bin past the horizon compacts without an
+        # explicit compact() call
+        clock.t = 1075.0
+        cache.append(mc.NODE_CPU_USAGE, 2.0, ts=1075.0)
+        key = mc._series_key(mc.NODE_CPU_USAGE, None)
+        hot_ts, hot_vals = cache._series[key].chronological()
+        assert hot_vals.tolist() == [2.0]
+        assert key in cache._cold
+
+    def test_disabled_tier_keeps_old_behavior(self, clock):
+        cache = mc.MetricCache(clock=clock)   # no downsample horizon
+        cache.append(mc.NODE_CPU_USAGE, 1.0, ts=1.0)
+        clock.t = 100_000.0
+        cache.compact()                        # no-op
+        assert cache.query(mc.NODE_CPU_USAGE, start=0).count == 1
+        assert not cache._cold
+
+    def test_delete_series_drops_cold_tier_too(self, clock):
+        cache = self._cache(clock)
+        cache.append(mc.POD_CPU_USAGE, 1.0, {"pod_uid": "a"}, ts=1000.0)
+        clock.t = 1200.0
+        cache.compact()
+        cache.delete_series(mc.POD_CPU_USAGE, {"pod_uid": "a"})
+        assert not cache._cold
+        assert cache.query(mc.POD_CPU_USAGE, {"pod_uid": "a"}).empty
+
     def test_downsample_mean_per_bin(self, clock):
         cache = mc.MetricCache(clock=clock)
         for i in range(10):   # ts 1000..1009, values 0..9

@@ -1,0 +1,301 @@
+"""Trend engine (koordinator_tpu/trend.py): slope math on known shapes,
+verdict classification, and the leak classifier catching a deliberately
+leaked fixture — ISSUE 9's "the instrument must be proven against a
+planted leak before any soak verdict means anything".
+
+Pure host math: no JAX anywhere near these tests.
+"""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from koordinator_tpu import metrics, trend
+from koordinator_tpu.koordlet.metriccache import MetricCache
+from koordinator_tpu.selftelemetry import SelfTelemetry
+
+
+def _fit(ts, values):
+    return trend.fit_slope(np.asarray(ts, float), np.asarray(values, float))
+
+
+class TestFitSlope:
+    def test_constant_series(self):
+        fit = _fit(range(100), [7.0] * 100)
+        assert fit.slope == pytest.approx(0.0)
+        assert fit.r2 == 1.0              # a flat line fits perfectly
+        assert fit.growth == pytest.approx(0.0)
+
+    def test_linear_series_exact(self):
+        ts = np.arange(60.0)
+        fit = _fit(ts, 5.0 + 2.5 * ts)
+        assert fit.slope == pytest.approx(2.5)
+        assert fit.r2 == pytest.approx(1.0)
+        assert fit.growth == pytest.approx(2.5 * 59.0)
+        assert fit.first == pytest.approx(5.0)
+        assert fit.last == pytest.approx(5.0 + 2.5 * 59.0)
+
+    def test_noisy_linear_series(self):
+        rng = np.random.default_rng(42)
+        ts = np.arange(200.0)
+        values = 10.0 + 0.8 * ts + rng.normal(0, 3.0, 200)
+        fit = _fit(ts, values)
+        assert fit.slope == pytest.approx(0.8, rel=0.1)
+        assert fit.r2 > 0.9               # trend dominates the noise
+
+    def test_step_series(self):
+        # flat, one step up, flat again: positive full-window slope
+        # but each half is (near) flat
+        ts = np.arange(100.0)
+        values = np.where(ts < 50, 1.0, 101.0)
+        fit = _fit(ts, values)
+        assert fit.slope > 0
+        lo = ts < 50
+        first = _fit(ts[lo], values[lo])
+        second = _fit(ts[~lo], values[~lo])
+        assert first.slope == pytest.approx(0.0)
+        assert second.slope == pytest.approx(0.0)
+
+    def test_sawtooth_series_has_no_net_slope(self):
+        ts = np.arange(400.0)
+        values = ts % 40                   # ramps that always reset
+        fit = _fit(ts, values)
+        assert abs(fit.slope) < 0.02       # no net trend
+        assert not math.isnan(fit.r2)
+
+    def test_empty_and_single_sample_return_sentinel_not_nan(self):
+        assert trend.fit_slope(np.empty(0), np.empty(0)) is None
+        assert _fit([5.0], [1.0]) is None
+
+    def test_zero_time_span_returns_sentinel(self):
+        assert _fit([7.0, 7.0, 7.0], [1.0, 2.0, 3.0]) is None
+
+    def test_unsorted_input_is_sorted_before_fitting(self):
+        fit = _fit([3.0, 1.0, 2.0], [6.0, 2.0, 4.0])
+        assert fit.slope == pytest.approx(2.0)
+        assert fit.first == 2.0 and fit.last == 6.0
+
+    def test_no_nan_ever(self):
+        for ts, values in (
+                ([0, 1], [0.0, 0.0]),
+                ([0, 1, 2], [1e300, -1e300, 1e300]),
+                (np.arange(5), np.zeros(5))):
+            fit = _fit(ts, values)
+            if fit is not None:
+                for v in (fit.slope, fit.intercept, fit.r2, fit.growth):
+                    assert not math.isnan(v)
+
+
+class TestClassify:
+    SPEC = trend.TrendSpec("s", abs_floor=10.0, max_rate_per_hour=100.0,
+                           min_samples=4)
+
+    def _verdict(self, ts, values, spec=None):
+        ts = np.asarray(ts, float)
+        values = np.asarray(values, float)
+        fit = trend.fit_slope(ts, values)
+        mid = ts.min() + (ts.max() - ts.min()) / 2
+        lo = ts <= mid
+        halves = (trend.fit_slope(ts[lo], values[lo]),
+                  trend.fit_slope(ts[~lo], values[~lo]))
+        return trend.classify(spec or self.SPEC, fit, halves)
+
+    def test_constant_is_steady(self):
+        assert self._verdict(range(100), [5.0] * 100)["verdict"] == "steady"
+
+    def test_small_growth_under_floor_is_steady(self):
+        # fast rate but total growth below abs_floor: noise immunity
+        ts = np.arange(0, 10.0, 0.1)
+        doc = self._verdict(ts, 0.05 * ts)     # grows 0.5 << floor 10
+        assert doc["verdict"] == "steady"
+
+    def test_slow_rate_under_threshold_is_steady(self):
+        # large absolute growth but a rate under max_rate_per_hour
+        ts = np.arange(0, 36000.0, 600.0)      # 10 hours
+        doc = self._verdict(ts, ts * (50.0 / 3600.0))   # 50/h < 100/h
+        assert doc["verdict"] == "steady"
+
+    def test_sustained_growth_is_leaking(self):
+        ts = np.arange(0, 600.0, 10.0)
+        doc = self._verdict(ts, ts * 1.0)      # 3600/h, growth 590
+        assert doc["verdict"] == "leaking"
+
+    def test_step_is_drifting_not_leaking(self):
+        ts = np.arange(0, 600.0, 10.0)
+        values = np.where(ts < 300, 0.0, 500.0)
+        doc = self._verdict(ts, values)
+        assert doc["verdict"] == "drifting"    # one-shot, not persistent
+
+    def test_downward_trend_is_drifting_when_leaks_grow_up(self):
+        ts = np.arange(0, 600.0, 10.0)
+        doc = self._verdict(ts, 1000.0 - ts)
+        assert doc["verdict"] == "drifting"
+
+    def test_sawtooth_is_steady(self):
+        ts = np.arange(0, 600.0, 1.0)
+        doc = self._verdict(ts, ts % 60)
+        assert doc["verdict"] == "steady"      # churn, no net growth
+
+    def test_big_sawtooth_never_classifies_as_leak(self):
+        # 10x the amplitude: the phase remainder's fitted slope DOES
+        # cross the thresholds, but a ramp-and-reset shape must fail
+        # the persistence/r2 gate — drifting at worst, never leaking
+        ts = np.arange(0, 600.0, 1.0)
+        doc = self._verdict(ts, (ts % 60) * 10)
+        assert doc["verdict"] in ("steady", "drifting")
+
+    def test_too_few_samples_is_no_data(self):
+        doc = self._verdict([0.0, 10.0, 20.0], [0.0, 5.0, 10.0])
+        assert doc["verdict"] == "no_data"
+        assert "reason" in doc
+
+    def test_none_fit_is_no_data_never_nan(self):
+        doc = trend.classify(self.SPEC, None)
+        assert doc["verdict"] == "no_data"
+        assert not any(isinstance(v, float) and math.isnan(v)
+                       for v in doc.values())
+
+    def test_uncorrelated_noise_never_leaks(self):
+        # a slope through pure noise that happens to cross thresholds
+        # must fail the r2 gate and downgrade to drifting at worst
+        rng = np.random.default_rng(7)
+        ts = np.arange(0, 60.0, 1.0)
+        values = rng.normal(0, 500.0, len(ts))
+        doc = self._verdict(ts, values)
+        assert doc["verdict"] in ("steady", "drifting")
+
+
+class TestTrendEngine:
+    def _engine(self, spec, t0=1000.0):
+        clock = lambda: self.now  # noqa: E731
+        self.now = t0
+        cache = MetricCache(clock=clock)
+        return trend.TrendEngine(cache, specs=[spec], window_s=600.0,
+                                 clock=clock), cache
+
+    def test_leaky_series_is_flagged_and_gauged(self):
+        spec = trend.TrendSpec("q_depth", abs_floor=10.0,
+                               max_rate_per_hour=100.0, min_samples=4)
+        engine, cache = self._engine(spec)
+        for i in range(60):
+            cache.append("q_depth", float(i * 5), ts=1000.0 + i * 10)
+        self.now = 1000.0 + 59 * 10
+        report = engine.evaluate()
+        assert report["leaking"] == ["q_depth"]
+        assert report["verdicts"]["leaking"] == 1
+        assert metrics.trend_verdict.value(
+            labels={"series": "q_depth"}) == trend.VERDICT_CODES["leaking"]
+        assert metrics.trend_slope_per_hour.value(
+            labels={"series": "q_depth"}) == pytest.approx(0.5 * 3600)
+
+    def test_per_label_set_verdicts(self):
+        spec = trend.TrendSpec("rss", abs_floor=10.0,
+                               max_rate_per_hour=100.0, min_samples=4)
+        engine, cache = self._engine(spec)
+        for i in range(30):
+            ts = 1000.0 + i * 10
+            cache.append("rss", 5.0, labels={"binary": "a"}, ts=ts)
+            cache.append("rss", float(i * 10), labels={"binary": "b"},
+                         ts=ts)
+        self.now = 1000.0 + 29 * 10
+        report = engine.evaluate()
+        by_labels = {tuple(sorted(d["labels"].items())): d["verdict"]
+                     for d in report["series"]}
+        assert by_labels[(("binary", "a"),)] == "steady"
+        assert by_labels[(("binary", "b"),)] == "leaking"
+
+    def test_report_caches_last_evaluation(self):
+        spec = trend.TrendSpec("x", abs_floor=1.0, max_rate_per_hour=1.0)
+        engine, cache = self._engine(spec)
+        first = engine.report()          # evaluates on demand
+        assert engine.report() is first  # retained
+
+    def test_thread_leak_fixture_is_caught(self):
+        """The deliberately-leaked fixture: a toy service that spawns a
+        parked worker per 'request' and never reaps them.  The leak
+        classifier over the sampled self-telemetry must flag
+        koord_process_threads as leaking."""
+        release = threading.Event()
+        leaked = []
+        try:
+            telemetry = SelfTelemetry("toy-service")
+            spec = trend.TrendSpec("koord_process_threads",
+                                   abs_floor=8.0, max_rate_per_hour=32.0,
+                                   min_samples=8)
+            cache = MetricCache()
+            engine = trend.TrendEngine(cache, specs=[spec], window_s=600.0)
+            for i in range(24):
+                # one "request" = one forgotten worker
+                t = threading.Thread(target=release.wait, daemon=True)
+                t.start()
+                leaked.append(t)
+                telemetry.sample()
+                cache.append(
+                    "koord_process_threads",
+                    metrics.process_threads.value(
+                        labels={"binary": "toy-service"}),
+                    labels={"binary": "toy-service"},
+                    ts=1000.0 + i * 30.0)
+            report = engine.evaluate(now=1000.0 + 23 * 30.0)
+            assert report["leaking"], report["series"]
+            (doc,) = [d for d in report["series"]
+                      if d["verdict"] == "leaking"]
+            assert doc["series"] == "koord_process_threads"
+            assert doc["rate_per_hour"] > 32.0
+        finally:
+            release.set()
+            for t in leaked:
+                t.join(timeout=5.0)
+
+    def test_steady_service_stays_green(self):
+        """Same toy service, workers reaped: threads stay flat."""
+        telemetry = SelfTelemetry("tidy-service")
+        spec = trend.TrendSpec("koord_process_threads",
+                               abs_floor=8.0, max_rate_per_hour=32.0,
+                               min_samples=8)
+        cache = MetricCache()
+        engine = trend.TrendEngine(cache, specs=[spec], window_s=600.0)
+        for i in range(24):
+            t = threading.Thread(target=lambda: None)
+            t.start()
+            t.join()                     # the worker is reaped
+            telemetry.sample()
+            cache.append(
+                "koord_process_threads",
+                metrics.process_threads.value(
+                    labels={"binary": "tidy-service"}),
+                labels={"binary": "tidy-service"},
+                ts=1000.0 + i * 30.0)
+        report = engine.evaluate(now=1000.0 + 23 * 30.0)
+        assert not report["leaking"]
+
+
+class TestSelfTelemetry:
+    def test_sample_publishes_all_gauges(self):
+        telemetry = SelfTelemetry("test-bin")
+        telemetry.sample()
+        labels = {"binary": "test-bin"}
+        assert metrics.process_threads.value(labels=labels) >= 1.0
+        assert metrics.process_alloc_blocks.value(labels=labels) > 0
+        assert metrics.process_rss_bytes.value(labels=labels) > 0
+        assert metrics.process_open_fds.value(labels=labels) > 0
+        assert telemetry.samples == 1
+
+    def test_background_sampler_stops_cleanly(self):
+        telemetry = SelfTelemetry("bg-bin")
+        telemetry.start(interval_s=0.05)
+        time.sleep(0.15)
+        telemetry.stop()
+        assert telemetry.samples >= 1
+        assert telemetry._thread is None
+
+    def test_default_specs_cover_the_telemetry_series(self):
+        series = {s.series for s in trend.default_trend_specs()}
+        for name in ("koord_process_rss_bytes", "koord_process_open_fds",
+                     "koord_process_threads",
+                     "koord_scheduler_pending_pods",
+                     "koord_transport_sync_binding_backlog_peak"):
+            assert name in series

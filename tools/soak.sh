@@ -41,6 +41,18 @@
 #                                     /debug/explain, and FAILS the
 #                                     soak if any pod ends pending with
 #                                     zero recorded reasons
+#         SOAK_LOADGEN (default 0)    1 = end the run with the steady-
+#                                     state smoke: tools/soak_report.py
+#                                     replays a seeded churn trace
+#                                     (loadgen) against a live
+#                                     scheduler+manager+feeder over
+#                                     real sockets, prints the
+#                                     per-series trend verdict table
+#                                     joined to flight records + SLO
+#                                     breaches, and FAILS the soak on a
+#                                     leak/drift (red) verdict; the
+#                                     injected-thread-leak self-test
+#                                     runs too (must come back red)
 #         SOAK_CHAOS   (default 0)    1 = also sweep the chaos
 #                                     fault-injection suite (tests/
 #                                     test_chaos.py, `chaos` marker)
@@ -59,6 +71,7 @@ BASE0=${SOAK_BASE0:-1000}
 STRIDE=${SOAK_STRIDE:-1000}
 OUT=${SOAK_OUT:-soak_results}
 CHAOS=${SOAK_CHAOS:-0}
+LOADGEN=${SOAK_LOADGEN:-0}
 TRACE=${SOAK_TRACE:-0}
 SLO=${SOAK_SLO:-1}
 EXPLAIN=${SOAK_EXPLAIN:-1}
@@ -177,6 +190,35 @@ if [ "$EXPLAIN" = "1" ]; then
         total_failed=$((total_failed + 1))
         failures="$failures;explain smoke: pending pod with zero recorded"
         failures="$failures reasons or surface failure (see log)"
+    fi
+fi
+
+if [ "$LOADGEN" = "1" ]; then
+    # steady-state smoke BEFORE the tally so its verdict counts in the
+    # JSON: a seeded churn soak must come back GREEN (no leak/drift, no
+    # live SLO breach, bounded backlog), and the deliberate thread-leak
+    # self-test must come back RED (a leak detector that can't catch a
+    # planted leak proves nothing)
+    echo "== steady-state smoke (tools/soak_report.py)" | tee -a "$log"
+    if python tools/soak_report.py >> "$log" 2>&1; then
+        grep -E "^(== steady|VERDICT|-- )" "$log" | tail -8
+        total_passed=$((total_passed + 1))
+    else
+        tail -12 "$log"
+        total_failed=$((total_failed + 1))
+        failures="$failures;steady-state smoke: red verdict or harness"
+        failures="$failures failure (see log)"
+    fi
+    echo "== injected-leak self-test (soak_report --inject-leak thread)" \
+        | tee -a "$log"
+    if python tools/soak_report.py --inject-leak thread >> "$log" 2>&1; then
+        tail -2 "$log"
+        total_passed=$((total_passed + 1))
+    else
+        tail -6 "$log"
+        total_failed=$((total_failed + 1))
+        failures="$failures;leak self-test: injected thread leak was NOT"
+        failures="$failures caught (see log)"
     fi
 fi
 

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Soak verdict: drive a seeded churn soak, print the per-series trend
+table joined to flight records and SLO breaches, fail on a leak.
+
+The steady-state observatory's operator surface (ISSUE 9): replay a
+deterministic :mod:`loadgen` trace against the assembled control plane
+(scheduler sidecar + manager + feeder over real sockets), sample the
+whole run through the shared SLO/trend MetricCache, and turn the run
+into ONE verdict document:
+
+- a per-series table — fitted slope, growth, r2, verdict
+  (steady/drifting/leaking) for every watched series (RSS, fds,
+  threads, alloc blocks, gc, queue depth, deltasync backlog, device
+  bytes);
+- the SLO join — per-SLO breach counts and peak burn from the same run;
+- the flight-record join — for every non-steady series, the slowest
+  and any dumped rounds inside the soak window, so "threads are
+  leaking" arrives WITH "and round 4812 was the slow degraded one";
+- hard bounds — deltasync backlog peak and degraded-mode state.
+
+Exit status: 0 only when the verdict is green (no leaking, no
+drifting, no live SLO breach, not degraded, backlog bounded).
+``tools/soak.sh`` runs this under ``SOAK_LOADGEN=1`` and fails the
+soak tally on a red verdict.
+
+Self-test: ``--inject-leak thread`` (a toy service leaking a parked
+thread per cycle) and ``--inject-leak queue`` (completions dropped,
+rounds starved) must BOTH turn the verdict red — a leak detector that
+never fires on a real leak is a rubber stamp.
+
+    python tools/soak_report.py                       # smoke scale
+    python tools/soak_report.py --nodes 10000 --duration 1800 \
+        --time-scale 1                                # the real soak
+    python tools/soak_report.py --inject-leak thread  # must go red
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import loadgen  # noqa: E402
+
+
+def _fmt_rate(doc: dict) -> str:
+    rate = doc.get("rate_per_hour")
+    if rate is None:
+        return "-"
+    for unit, div in (("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if abs(rate) >= div:
+            return f"{rate / div:+.2f}{unit}/h"
+    return f"{rate:+.2f}/h"
+
+
+def print_report(verdict: dict, harness) -> None:
+    trend = verdict["trend"]
+    print("== steady-state verdict "
+          f"(window {trend['window_s']:.0f}s, "
+          f"{verdict['rounds']} rounds, "
+          f"{verdict['events_applied']} events, "
+          f"{verdict['push_errors']} push errors)")
+    print(f"{'series':<44} {'verdict':<9} {'slope':>11} "
+          f"{'growth':>12} {'r2':>5} {'n':>5}")
+    for doc in trend["series"]:
+        labels = ",".join(f"{k}={v}" for k, v in doc["labels"].items())
+        name = doc["series"] + (f"{{{labels}}}" if labels else "")
+        growth = doc.get("growth")
+        print(f"{name:<44} {doc['verdict']:<9} {_fmt_rate(doc):>11} "
+              f"{(f'{growth:+.3g}' if growth is not None else '-'):>12} "
+              f"{doc.get('r2', 0.0):>5.2f} "
+              f"{doc.get('samples', 0):>5}")
+    print(f"-- SLO: breached now={verdict['slo_breached'] or 'none'}")
+    for name, s in verdict["slo"].items():
+        print(f"   {name:<28} breaches={s['breaches_total']} "
+              f"peak burn fast={s['peak_burn']['fast']:.2f} "
+              f"slow={s['peak_burn']['slow']:.2f}")
+    fl = verdict["flight"]
+    print(f"-- flight recorder: {fl['records']} records, "
+          f"{fl['dumps']} dumps, {fl['overwrites']} overwritten "
+          f"(ring {harness.scheduler.flight_recorder.capacity})")
+    # the join: every non-steady series arrives WITH the rounds that
+    # overlapped it — dumped (slow/degraded/slo) rounds first, else the
+    # slowest — so the leak verdict and its "what was happening" flight
+    # evidence are one artifact
+    flagged = trend["leaking"] + trend["drifting"]
+    if flagged:
+        rec = harness.scheduler.flight_recorder
+        dumped = [r for r in rec.snapshot(8) if r.get("dump_reason")]
+        join = dumped or ([rec.slowest()] if rec.slowest() else [])
+        print(f"-- flagged series: {flagged}")
+        for r in join[:4]:
+            print(f"   round {r['round']} trace={r['trace_id'][:12]} "
+                  f"dur={r['duration_s']:.3f}s path={r['solve_path']} "
+                  f"reason={r.get('dump_reason')} "
+                  f"degraded={r['degraded']}")
+    print(f"-- backlog peak={verdict['backlog_peak']:.0f} "
+          f"degraded={verdict['degraded']} "
+          f"pending={verdict['pending']} bound={verdict['bound']}")
+    print(f"VERDICT: {'GREEN' if verdict['green'] else 'RED'}")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="soak_report")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--duration", type=float, default=None,
+                        help="virtual seconds of churn (default: the "
+                             "smoke config's 120)")
+    parser.add_argument("--nodes", type=int, default=None)
+    parser.add_argument("--arrival-rate", type=float, default=None)
+    parser.add_argument("--time-scale", type=float, default=12.0,
+                        help="virtual:wall compression (1 = real time)")
+    parser.add_argument("--trace", default="",
+                        help="replay this JSONL trace instead of "
+                             "generating one from the seed")
+    parser.add_argument("--inject-leak", choices=("thread", "queue"),
+                        default=None,
+                        help="self-test: inject a deliberate leak; the "
+                             "verdict MUST come back red (exit flips: 0 "
+                             "iff the leak was caught)")
+    parser.add_argument("--slo-latency", type=float, default=2.5,
+                        help="latency SLO threshold for the run "
+                             "(CPU smoke rounds pay jit compilation; "
+                             "the paper's bar is 0.2)")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the raw verdict document too")
+    args = parser.parse_args(argv)
+
+    cfg = loadgen.smoke_config(seed=args.seed)
+    overrides = {}
+    if args.duration is not None:
+        overrides["duration_s"] = args.duration
+    if args.nodes is not None:
+        overrides["nodes"] = args.nodes
+    if args.arrival_rate is not None:
+        overrides["arrival_rate"] = args.arrival_rate
+    if overrides:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, **overrides)
+    events = (loadgen.read_trace(args.trace) if args.trace
+              else loadgen.generate_trace(cfg))
+    print(f"== churn soak: seed={cfg.seed} nodes={cfg.nodes} "
+          f"duration={cfg.duration_s:.0f}s (virtual) "
+          f"x{args.time_scale:g} compression — "
+          f"{json.dumps(loadgen.trace_stats(events))}")
+    with tempfile.TemporaryDirectory(prefix="koord-soak-") as workdir:
+        harness = loadgen.SteadyStateHarness(
+            cfg, workdir, time_scale=args.time_scale,
+            slo_latency_threshold_s=args.slo_latency,
+            inject_thread_leak=(args.inject_leak == "thread"),
+            inject_queue_leak=(args.inject_leak == "queue"))
+        harness.start()
+        try:
+            verdict = harness.run(events)
+            print_report(verdict, harness)
+            if args.json:
+                print(json.dumps(verdict, indent=2, default=str))
+        finally:
+            harness.close()
+    if args.inject_leak:
+        if verdict["trend"]["leaking"]:
+            print(f"injected {args.inject_leak} leak CAUGHT: "
+                  f"{verdict['trend']['leaking']}")
+            return 0
+        print(f"ERROR: injected {args.inject_leak} leak NOT caught",
+              file=sys.stderr)
+        return 1
+    return 0 if verdict["green"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -52,6 +52,12 @@ print("ok" if ok else kind)' 2>/dev/null | tail -1)
             > "$OUT/bench_$ts.json" 2> "$OUT/bench_$ts.err"
         timeout 1800 python /root/repo/bench_stages.py \
             > "$OUT/stages_$ts.jsonl" 2> "$OUT/stages_$ts.err"
+        # publish the staged capture IMMEDIATELY (ISSUE 9 satellite):
+        # the per-stage device walls become a provenance-stamped
+        # published_*.json the moment they exist, instead of waiting
+        # for the next official bench round to promote them
+        timeout 120 python /root/repo/bench.py --publish-staged \
+            >> "$OUT/probe.log" 2>&1 || true
         timeout 1200 python /root/repo/bench_micro.py \
             > "$OUT/micro_$ts.json" 2> "$OUT/micro_$ts.err"
         # approx_max_k recall on the backend where it is actually
