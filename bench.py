@@ -532,6 +532,10 @@ def _emit_zero_record(extra: dict,
     still leave machine-readable evidence of the solver's quality at
     the north-star shape (VERDICT r3 item 5) instead of only a zero."""
     extra.setdefault("provenance", _git_head())
+    # n_devices is unknowable here without touching the (possibly hung)
+    # backend — null marks "no device evidence", vs a real count on
+    # nonzero records
+    extra.setdefault("n_devices", None)
     if device_down is None:
         # caller hit an error that MIGHT be the tunnel dying mid-run —
         # a fresh probe decides (60s: enough for a healthy tunnel)
@@ -636,6 +640,10 @@ def _publish_staged_main() -> int:
     stages = _latest_probe_stages(root)
     if stages is not None:
         doc["staged"] = stages
+        # surface the capture's device count at the top level so the
+        # perf trajectory distinguishes single-chip from sharded runs
+        # without digging into the stage records
+        doc["n_devices"] = stages.get("n_devices")
     notes: list = []
     captured = _latest_probe_capture(root, notes=notes)
     if captured is not None:
@@ -698,7 +706,11 @@ def _latest_probe_stages(root: str | None = None) -> dict | None:
             continue
         cap_commit = prov.get("commit", "")
         record: dict = {"source": name, "age_s": round(age, 1),
-                        "capture_commit": cap_commit, "stages": stages}
+                        "capture_commit": cap_commit, "stages": stages,
+                        # mesh-shape provenance (ISSUE 10): which device
+                        # count / axis split produced these stage walls
+                        "n_devices": prov.get("n_devices"),
+                        "mesh_axes": prov.get("mesh_axes")}
         changed = _solver_diff(cap_commit, head)
         if prov.get("dirty"):
             record["caveat"] = (
@@ -905,6 +917,10 @@ def main() -> None:
 
     extra = {
         "provenance": _git_head(),
+        # the perf trajectory must distinguish single-chip from sharded
+        # captures (ISSUE 10): a device count next to every nonzero
+        # record, stamped while the backend is provably alive
+        "n_devices": len(jax.devices()),
         f"filter_score_pods_per_sec_{N_PODS}p_{N_NODES}n": round(
             score_pods_per_sec, 1
         ),

@@ -74,8 +74,19 @@ def main() -> None:
 
     # code provenance first: a stage capture promoted into a later zero
     # record (bench._latest_probe_stages) must be tied to the commit it
-    # measured, like the headline captures are
-    print(json.dumps({"stage": "provenance", **_git_head()}), flush=True)
+    # measured, like the headline captures are.  Mesh-shape provenance
+    # rides the same line (ISSUE 10): a sharded-path win is meaningless
+    # without the device count and axis sizes it was measured on.
+    from koordinator_tpu.parallel import mesh as pmesh
+
+    mesh = pmesh.solver_mesh()
+    n_shards = pmesh.nodes_shard_count(mesh)
+    print(json.dumps({
+        "stage": "provenance", **_git_head(),
+        "n_devices": len(jax.devices()),
+        "mesh_axes": {"pods": int(mesh.shape[pmesh.PODS_AXIS]),
+                      "nodes": n_shards},
+    }), flush=True)
 
     def rtt_fn(st, p):
         return st.node_allocatable.sum() + p.requests.sum()
@@ -194,6 +205,106 @@ def main() -> None:
     except Exception as e:
         print(json.dumps({"stage": "refresh_incremental_1pct",
                           "error": repr(e)[:200]}), flush=True)
+
+    # -- sharded stages (ISSUE 10): the shard_map node-axis path, so a
+    # staged capture attributes sharded-path wins per stage.  Runs on
+    # the all-devices mesh (1-way on a single chip: same program, no
+    # collectives) and reports each program's collective-op counts so
+    # the communication profile lands in the record next to the wall.
+    from koordinator_tpu.ops import introspection as insp
+    from koordinator_tpu.ops import batch_assign as _ba_mod
+    from koordinator_tpu.parallel import sharded as psh
+
+    if n_nodes % n_shards == 0:
+        def score_sharded_loop(st0, p):
+            def body(i, carry):
+                acc, usage = carry
+                key, node = psh.sharded_select_candidates(
+                    mesh, st0.replace(node_usage=usage), p, cfg, k=K,
+                    spread_bits=SPREAD)
+                return (acc + key.sum() + node.sum(),
+                        usage + (node.sum() & 1))
+            acc, _ = jax.lax.fori_loop(0, iters, body,
+                                       (jnp.int32(0), st0.node_usage))
+            return acc
+
+        def rounds_sharded_loop(st0, p, ckey, cnode):
+            def body(i, carry):
+                acc, usage = carry
+                assignments, new_state, _ = psh.sharded_assign_rounds(
+                    mesh, st0.replace(node_usage=usage), p, None, ckey,
+                    cnode, rounds=12)
+                return (acc + (assignments >= 0).sum().astype(jnp.int32),
+                        usage + (new_state.node_requested & 1))
+            acc, _ = jax.lax.fori_loop(0, iters, body,
+                                       (jnp.int32(0), st0.node_usage))
+            return acc
+
+        for label, fn, args in (
+            ("score_sharded", score_sharded_loop, (state, pods)),
+            ("rounds_sharded", rounds_sharded_loop,
+             (state, pods, cand_key, cand_node)),
+        ):
+            try:
+                # collective counts cost one extra AOT compile — opt-in
+                # (KOORD_STAGES_COLLECTIVES=1): the wall-clock stage is
+                # the scarce evidence at the big capture, and the CI
+                # smoke must stay cheap
+                coll = (insp.compiled_collectives(jax.jit(fn), *args)
+                        if os.environ.get("KOORD_STAGES_COLLECTIVES")
+                        else None)
+                sec, _ = _time_chained(fn, args, rtt, iters)
+                stage_secs[label] = sec
+                extra = {"n_devices": n_shards}
+                if coll is not None:
+                    extra["collectives"] = coll
+                _emit(label, sec, extra)
+            except Exception as e:
+                print(json.dumps({"stage": label,
+                                  "error": repr(e)[:200]}), flush=True)
+
+        # merge_topk: the cross-shard segmented top-k merge alone —
+        # (P, ndev*k) gathered shard winners re-ranked to (P, k) on the
+        # global key scale (the kernel sharded selection adds on top of
+        # the per-shard local work)
+        import numpy as _np
+
+        gn = _np.concatenate(
+            [(_np.asarray(cand_node) + 17 * j) % n_nodes
+             for j in range(max(n_shards, 2))], axis=1).astype(_np.int32)
+        gs = _np.concatenate(
+            [_np.asarray(jnp.where(cand_key >= 0, cand_key & 0x7fff, -1))
+             for _ in range(max(n_shards, 2))], axis=1).astype(_np.int32)
+
+        def merge_topk_loop(g_node, g_score, p):
+            def body(i, carry):
+                acc, gs_c = carry
+                key = _ba_mod._candidate_keys(
+                    gs_c, g_node, p.rot_id, SPREAD[0], n_nodes)
+                _, midx = _ba_mod._topk_by_rank(
+                    key, _ba_mod._candidate_tb(g_node, p.rot_id, n_nodes),
+                    K, n_nodes)
+                sel = jnp.take_along_axis(g_node, midx, axis=1)
+                return (acc + sel.sum(), gs_c + (sel.sum() & 1))
+            acc, _ = jax.lax.fori_loop(
+                0, iters, body, (jnp.int32(0), g_score))
+            return acc
+
+        try:
+            sec, _ = _time_chained(
+                merge_topk_loop,
+                (jnp.asarray(gn), jnp.asarray(gs), pods), rtt, iters)
+            stage_secs["merge_topk"] = sec
+            _emit("merge_topk", sec,
+                  {"merge_width": int(gn.shape[1]), "k": K})
+        except Exception as e:
+            print(json.dumps({"stage": "merge_topk",
+                              "error": repr(e)[:200]}), flush=True)
+    else:
+        print(json.dumps({
+            "stage": "score_sharded",
+            "error": (f"n_nodes {n_nodes} not divisible by "
+                      f"{n_shards}-way mesh")}), flush=True)
 
     # -- explain: device-side reject-reason accounting (ISSUE 6 overhead
     # guard).  The solve itself is UNCHANGED by explain — the scheduler

@@ -530,7 +530,12 @@ solver_jit_cache_size = SCHEDULER.gauge(
 solver_device_bytes = SCHEDULER.gauge(
     "solver_device_bytes",
     "Device-resident bytes of the solver's persistent tensors (label: "
-    "kind=cluster_state|candidate_cache)")
+    "kind=cluster_state|candidate_cache; per-device rows additionally "
+    "carry shard=<device id> when the solve mesh is active)")
+solver_shard_count = SCHEDULER.gauge(
+    "solver_shard_count",
+    "Nodes-axis size of the active solver mesh (1 = single-device "
+    "solve; parallel/sharded.py shard_map path engaged when > 1)")
 solver_batch_padding_waste = SCHEDULER.gauge(
     "solver_batch_padding_waste",
     "Padding-waste fraction of the last PodBatch: (capacity - live "

@@ -47,15 +47,27 @@ from koordinator_tpu.quota.admission import (
 )
 from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
 
-#: tie-break field width: node index occupies the low bits of the ranking key
-_TB_BITS = 15  # supports node capacities up to 32768
+#: tie-break field width of the PACKED ranking key: node index occupies the
+#: low bits, the quantized score the high bits, of one int32
+_TB_BITS = 15
 _SCORE_CLIP = (1 << 30 - _TB_BITS) - 1
 
-#: hard node-capacity ceiling of the int32 ranking key: the rotated node
-#: index must fit _TB_BITS low bits or it aliases into the score field and
-#: candidates silently mis-rank.  Shapes are static under jit, so this is
-#: enforced at trace time — a 40k-node problem fails loudly instead.
-MAX_NODE_CAPACITY = 1 << _TB_BITS
+#: node capacities up to this fit the packed single-int32 key regime
+#: (score and rotated tie-break in one word, one ``lax.top_k``).  Larger
+#: capacities switch to the WIDE regime: the ranking key carries the
+#: quantized score alone and the rotated tie-break rides a second int32,
+#: compared lexicographically (a two-operand ``lax.sort`` at selection,
+#: a two-stage argmax in the rounds).  The packed regime is bit-identical
+#: to the historical behavior; the wide regime never aliases because
+#: nothing is packed.
+PACKED_NODE_CAPACITY = 1 << _TB_BITS
+
+#: hard node-capacity ceiling of the solver: node rows index as
+#: nonnegative int32 and the tie-break rotation arithmetic
+#: (``rot_id * 7919`` against a node id) must stay inside int32.  The
+#: old 2**15 packing wall is gone — past it the wide two-key regime
+#: ranks exactly — so this guard is about integer width, not packing.
+MAX_NODE_CAPACITY = 1 << 30
 
 
 def check_node_capacity(n: int) -> None:
@@ -63,12 +75,17 @@ def check_node_capacity(n: int) -> None:
     if n > MAX_NODE_CAPACITY:
         raise ValueError(
             f"node capacity {n} exceeds the batched solver's ranking-key "
-            f"ceiling of {MAX_NODE_CAPACITY} (= 2**{_TB_BITS}): the rotated "
-            "node index would alias into the score bits and mis-rank "
-            "candidates.  Mesh sharding does not help — shapes stay global "
-            "under GSPMD.  Partition the cluster into <=32768-node node "
-            "pools solved independently, or widen the packing to a 64-bit "
-            "key (_TB_BITS) off-TPU.")
+            f"ceiling of {MAX_NODE_CAPACITY} (= 2**30): node rows must "
+            "index as nonnegative int32 and the rotated tie-break "
+            "arithmetic must not overflow.  Node-axis mesh sharding "
+            "(parallel/sharded.py) spreads the per-device footprint but "
+            "keys stay global-int32; a cluster past 2**30 nodes needs a "
+            "64-bit key carrier.")
+
+
+def _packed_regime(n_total: int) -> bool:
+    """True when ``n_total`` node rows fit the packed int32 key."""
+    return n_total <= PACKED_NODE_CAPACITY
 
 
 def _ranked_scores(
@@ -101,6 +118,25 @@ def _ranked_scores(
     the nodes' GLOBAL ids modulo the full capacity, so a subset column's
     key equals the same node's key in a full (P, N) pass.
     """
+    return _rank_parts(scores, feasible, spread_bits, rot_id,
+                       node_ids, n_total)[0]
+
+
+def _rank_parts(
+    scores: jnp.ndarray, feasible: jnp.ndarray, spread_bits: int = 0,
+    rot_id: jnp.ndarray | None = None,
+    node_ids: jnp.ndarray | None = None,
+    n_total: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(key, tb) pair behind :func:`_ranked_scores`.
+
+    Packed regime (``n_total <= PACKED_NODE_CAPACITY``): ``key`` is the
+    historical single int32 ``(q << _TB_BITS) | tb`` and already encodes
+    the tie-break.  Wide regime: ``key`` is the quantized score alone and
+    callers break ties lexicographically with ``tb`` (``_topk_by_rank``,
+    the rounds' two-stage argmax).  ``tb`` is returned in both regimes so
+    shard-local selections can always merge on the same (key, tb) scale.
+    """
     p, n = scores.shape
     n_total = n if n_total is None else n_total
     check_node_capacity(n_total)
@@ -113,8 +149,17 @@ def _ranked_scores(
     # invert so the SMALLEST rotated distance ranks highest among ties
     tb = (n_total - 1) - tb
     q = jnp.clip(scores, 0, _SCORE_CLIP) >> spread_bits
-    key = (q << _TB_BITS) | tb
-    return jnp.where(feasible, key, -1)
+    key = ((q << _TB_BITS) | tb) if _packed_regime(n_total) else q
+    return jnp.where(feasible, key, -1), tb
+
+
+def _candidate_tb(node: jnp.ndarray, rot_id: jnp.ndarray,
+                  n_total: int) -> jnp.ndarray:
+    """The (P, k) rotated tie-break of cached candidate node rows — the
+    same pure function of (rot_id, node) that :func:`_rank_parts` packs
+    (packed regime) or returns alongside (wide regime)."""
+    rot = (rot_id.astype(jnp.int32) * 7919)[:, None]
+    return (n_total - 1) - ((node - rot) % n_total)
 
 
 def _candidate_keys(score: jnp.ndarray, node: jnp.ndarray,
@@ -124,10 +169,30 @@ def _candidate_keys(score: jnp.ndarray, node: jnp.ndarray,
     and node row — bit-identical to the :func:`_ranked_scores` key of the
     same (pod, node) pair, so merged and freshly-selected candidates rank
     on one scale.  ``score < 0`` marks an invalid slot."""
-    rot = (rot_id.astype(jnp.int32) * 7919)[:, None]
-    tb = (n_total - 1) - ((node - rot) % n_total)
-    key = ((score >> spread_bits) << _TB_BITS) | tb
+    q = score >> spread_bits
+    if _packed_regime(n_total):
+        key = (q << _TB_BITS) | _candidate_tb(node, rot_id, n_total)
+    else:
+        key = q
     return jnp.where(score >= 0, key, -1)
+
+
+def _topk_by_rank(key: jnp.ndarray, tb: jnp.ndarray, k: int,
+                  n_total: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact per-row top-k columns by (key, tb) rank, descending —
+    ``lax.top_k`` when the packed key already encodes the tie-break, a
+    two-operand lexicographic ``lax.sort`` in the wide regime.  Returns
+    (key_sel, col_idx) like ``lax.top_k``.  Rank pairs of feasible
+    columns are unique per row (tb is a permutation of node ids), so the
+    result is order-deterministic in both regimes."""
+    if _packed_regime(n_total):
+        return jax.lax.top_k(key, k)
+    n = key.shape[-1]
+    cols = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), key.shape)
+    key_s, _, idx_s = jax.lax.sort((key, tb, cols), num_keys=2)
+    sl = slice(n - k, None)
+    return (jnp.flip(key_s[..., sl], -1).astype(key.dtype),
+            jnp.flip(idx_s[..., sl], -1))
 
 
 def _prefix_accept(
@@ -151,33 +216,64 @@ def _prefix_accept(
     for the detection; the sorted path below remains the general case and
     the single source of truth for contended rounds.
     """
-    p, r = requests.shape
     s = free.shape[0]
+    choice_free = jnp.where(
+        active[:, None], free[jnp.clip(choice, 0, s - 1)], 0)
+    return _prefix_accept_choice(choice, requests, choice_free, s,
+                                 order, active)
+
+
+def _prefix_accept_choice(
+    choice: jnp.ndarray,       # (P,) int32 proposed segment
+    requests: jnp.ndarray,     # (P, R)
+    choice_free: jnp.ndarray,  # (P, R) headroom of each pod's OWN segment
+    num_segments: int,
+    order: jnp.ndarray,
+    active: jnp.ndarray,
+) -> jnp.ndarray:
+    """The choice-indexed core of :func:`_prefix_accept`: the segment
+    headroom arrives pre-gathered per pod instead of as an (S, R) table.
+    This is the form the node-sharded rounds reuse — each shard psums
+    the headroom of the candidates it owns into ``choice_free``, then
+    every shard runs this replicated decision identically (see
+    parallel/sharded.py for the exactness argument)."""
+    s = num_segments
     seg = jnp.where(active, choice, s)            # inactive -> overflow row
     req_act = jnp.where(active[:, None], requests, 0)
-    totals = jax.ops.segment_sum(req_act, seg, num_segments=s + 1)[:s]
-    has_prop = (
-        jax.ops.segment_sum(active.astype(jnp.int32), seg,
-                            num_segments=s + 1)[:s] > 0
-    )
-    contended = jnp.any(has_prop[:, None] & (totals > free))
+    totals = jax.ops.segment_sum(req_act, seg, num_segments=s + 1)
+    # a segment is oversubscribed iff one of its own proposers sees its
+    # total exceed the (shared) headroom — same predicate as scanning
+    # the (S, R) table, evaluated through the pods that propose there
+    contended = jnp.any(active[:, None] & (totals[seg] > choice_free))
 
     def fast(_):
         # total per segment fits => every within-segment prefix fits
         return active
 
     def slow(_):
-        return _prefix_accept_sorted(seg, requests, free, order, active)
+        return _prefix_accept_sorted_choice(seg, requests, choice_free,
+                                            order, active)
 
     return jax.lax.cond(contended, slow, fast, None)
 
 
 def _prefix_accept_sorted(seg, requests, free, order, active):
-    """The general contended-round path: stable sort groups segments in
-    priority order, a segmented prefix-sum checks cumulative fit."""
+    """The general contended-round path over an (S, R) headroom table:
+    kept as the spec/test surface; delegates to the choice-indexed core."""
+    r = requests.shape[1]
+    free_pad = jnp.concatenate([free, jnp.zeros((1, r), free.dtype)])
+    return _prefix_accept_sorted_choice(seg, requests, free_pad[seg],
+                                        order, active)
+
+
+def _prefix_accept_sorted_choice(seg, requests, choice_free, order, active):
+    """Contended-round acceptance: stable sort groups segments in
+    priority order, a segmented prefix-sum checks cumulative fit against
+    each pod's own-segment headroom."""
     p, r = requests.shape
     seg_o = seg[order]
     req_o = jnp.where(active[order][:, None], requests[order], 0)
+    free_o = choice_free[order]
     pos = jnp.argsort(seg_o, stable=True)         # group segments, keep order
     seg_s = seg_o[pos]
     req_s = req_o[pos]
@@ -193,8 +289,7 @@ def _prefix_accept_sorted(seg, requests, free, order, active):
         jnp.maximum, jnp.where(is_start[:, None], excl, -1), axis=0
     )
     prefix = cum - base                           # within-segment incl. self
-    free_pad = jnp.concatenate([free, jnp.zeros((1, r), free.dtype)])
-    fits = jnp.all((prefix <= free_pad[seg_s]) | (req_s == 0), axis=-1)
+    fits = jnp.all((prefix <= free_o[pos]) | (req_s == 0), axis=-1)
     out = jnp.zeros(p, bool).at[order[pos]].set(fits)
     return out & active
 
@@ -352,17 +447,23 @@ def select_candidates(
 
 
 def _reduce_candidates(scores, feasible, strata, k: int, method: str,
-                       rot_id=None, with_scores: bool = False):
+                       rot_id=None, with_scores: bool = False,
+                       node_ids=None, n_total: int | None = None):
     """The (scores, feasible) -> (cand_key, cand_node) reduction shared by
-    the whole-batch and chunked paths."""
-    order_key = _ranked_scores(scores, feasible, strata[0], rot_id)
+    the whole-batch, chunked and shard-local paths.  ``node_ids``/
+    ``n_total`` score a gathered COLUMN SUBSET (a shard's local columns):
+    keys use global node ids and ``cand_node`` returns global rows."""
+    n_total = scores.shape[1] if n_total is None else n_total
+    order_key, order_tb = _rank_parts(scores, feasible, strata[0], rot_id,
+                                      node_ids, n_total)
     splits = _stratum_splits(k, len(strata))
     nodes = []
     for sb, k_i in zip(strata, splits):
         if k_i == 0:
             continue
-        key = (order_key if sb == strata[0]
-               else _ranked_scores(scores, feasible, sb, rot_id))
+        key, tb = ((order_key, order_tb) if sb == strata[0]
+                   else _rank_parts(scores, feasible, sb, rot_id,
+                                    node_ids, n_total))
         if method in ("approx", "chunked") and k_i < key.shape[1]:
             # TPU-optimized partial reduction. approx_max_k needs a float
             # key exact within float32's 24-bit mantissa, so candidates
@@ -376,27 +477,41 @@ def _reduce_candidates(scores, feasible, strata, k: int, method: str,
             # the float-key quantization).  Acceptance still enforces fit
             # and quota exactly.
             score_bits = (30 - _TB_BITS) - sb   # quantized field width
-            shift = min(_TB_BITS, max(24 - score_bits, 0))
-            fkey = jnp.where(
-                key >= 0,
-                ((key >> _TB_BITS) << shift
-                 | (key & ((1 << _TB_BITS) - 1)) >> (_TB_BITS - shift)
-                 ).astype(jnp.float32),
-                -1.0)
+            if _packed_regime(n_total):
+                shift = min(_TB_BITS, max(24 - score_bits, 0))
+                fkey = jnp.where(
+                    key >= 0,
+                    ((key >> _TB_BITS) << shift
+                     | (key & ((1 << _TB_BITS) - 1)) >> (_TB_BITS - shift)
+                     ).astype(jnp.float32),
+                    -1.0)
+            else:
+                # wide regime: q rides the float key's high integer bits,
+                # the top tie-break bits fill the rest of the 24-bit
+                # mantissa (q < 2**score_bits keeps the sum exact)
+                tb_bits = max((n_total - 1).bit_length(), 1)
+                shift = max(24 - score_bits, 0)
+                fkey = jnp.where(
+                    key >= 0,
+                    key.astype(jnp.float32) * float(1 << shift)
+                    + (tb >> max(tb_bits - shift, 0)).astype(jnp.float32),
+                    -1.0)
             _, idx = jax.lax.approx_max_k(
                 fkey, k_i, recall_target=0.95, aggregate_to_topk=True)
             nodes.append(idx.astype(jnp.int32))
         else:
-            _, idx = jax.lax.top_k(key, k_i)
+            _, idx = _topk_by_rank(key, tb, k_i, n_total)
             nodes.append(idx)
-    cand_node = jnp.concatenate(nodes, axis=1) if len(nodes) > 1 else nodes[0]
+    cand_cols = jnp.concatenate(nodes, axis=1) if len(nodes) > 1 else nodes[0]
     # the first stratum's key orders every candidate in the rounds, so a
     # coverage-stratum node competes on the same score scale (gathering
     # also yields -1 for infeasible slots of short candidate lists)
-    cand_key = jnp.take_along_axis(order_key, cand_node, axis=1)
+    cand_key = jnp.take_along_axis(order_key, cand_cols, axis=1)
+    cand_node = (cand_cols if node_ids is None
+                 else node_ids.astype(jnp.int32)[cand_cols])
     if with_scores:
         raw = jnp.take_along_axis(
-            jnp.clip(scores, 0, _SCORE_CLIP), cand_node, axis=1)
+            jnp.clip(scores, 0, _SCORE_CLIP), cand_cols, axis=1)
         return cand_key, cand_node, jnp.where(cand_key >= 0, raw, -1)
     return cand_key, cand_node
 
@@ -455,9 +570,25 @@ def _stratum_splits(k: int, n: int) -> list[int]:
     return [base + (1 if i < rem else 0) for i in range(n)]
 
 
+def _choose_candidate(cand_key, cand_tb, fits):
+    """(P,) column of each pod's best FITTING candidate by (key, tb)
+    rank.  The packed key encodes the tie-break (``cand_tb`` is None);
+    the wide regime runs a two-stage argmax — max key, then max tb among
+    the key ties — which equals the lexicographic rank because rank
+    pairs of distinct nodes are unique per pod."""
+    masked = jnp.where(fits, cand_key, -1)
+    if cand_tb is None:
+        return jnp.argmax(masked, axis=1)
+    best_key = jnp.max(masked, axis=1, keepdims=True)
+    return jnp.argmax(
+        jnp.where(fits & (masked == best_key), cand_tb, -1), axis=1)
+
+
 def _assign_rounds(state, pods, quota, cand_key, cand_node, rounds):
     """The shared propose/accept stage over (P, k) candidates."""
     cand_valid = cand_key >= 0
+    cand_tb = (None if _packed_regime(state.capacity)
+               else _candidate_tb(cand_node, pods.rot_id, state.capacity))
 
     order = jnp.lexsort((jnp.arange(pods.capacity), -pods.priority))
     active0 = pods.valid & jnp.any(cand_valid, axis=1)
@@ -480,7 +611,7 @@ def _assign_rounds(state, pods, quota, cand_key, cand_node, rounds):
             | (pods.requests[:, None, :] == 0),
             axis=-1,
         ) & cand_valid
-        best = jnp.argmax(jnp.where(fits, cand_key, -1), axis=1)
+        best = _choose_candidate(cand_key, cand_tb, fits)
         has = jnp.take_along_axis(fits, best[:, None], axis=1)[:, 0]
         choice = jnp.take_along_axis(cand_node, best[:, None], axis=1)[:, 0]
 
@@ -643,10 +774,10 @@ def refresh_candidates(
         seg_node = cache.cand_node[:, off:off + k_i]
         seg_score = stale_score[:, off:off + k_i]
         off += k_i
-        dkey = _ranked_scores(scores, feasible, sb, rot,
-                              node_ids=dirty_rows, n_total=n)
+        dkey, dtb = _rank_parts(scores, feasible, sb, rot,
+                                node_ids=dirty_rows, n_total=n)
         if k_i < d:
-            dval, idx = jax.lax.top_k(dkey, k_i)
+            dval, idx = _topk_by_rank(dkey, dtb, k_i, n)
             d_node = dirty_rows[idx]
             d_score = jnp.where(
                 dval >= 0, jnp.take_along_axis(clipped, idx, axis=1), -1)
@@ -658,7 +789,8 @@ def refresh_candidates(
         m_key = jnp.concatenate([c_key, dval], axis=1)
         m_node = jnp.concatenate([seg_node, d_node], axis=1)
         m_score = jnp.concatenate([seg_score, d_score], axis=1)
-        mval, midx = jax.lax.top_k(m_key, k_i)
+        mval, midx = _topk_by_rank(
+            m_key, _candidate_tb(m_node, rot, n), k_i, n)
         nodes_out.append(jnp.take_along_axis(m_node, midx, axis=1))
         scores_out.append(jnp.where(
             mval >= 0, jnp.take_along_axis(m_score, midx, axis=1), -1))

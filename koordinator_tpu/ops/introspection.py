@@ -113,6 +113,64 @@ def device_bytes(tree) -> int:
     return total
 
 
+def device_bytes_by_shard(tree) -> dict[int, int]:
+    """Per-device footprint of a pytree's arrays: {device_id: bytes}.
+
+    Sums each leaf's addressable shards by the device they live on —
+    node-axis-sharded solver tensors report one slice per device, while
+    replicated leaves honestly charge EVERY device a full copy (that is
+    what replication costs in HBM).  Metadata-only like
+    :func:`device_bytes`; single-device arrays land on their device's id.
+    """
+    if tree is None:
+        return {}
+    import jax
+
+    out: dict[int, int] = {}
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            for sh in shards:
+                nbytes = getattr(sh.data, "nbytes", None)
+                if nbytes is not None:
+                    did = int(sh.device.id)
+                    out[did] = out.get(did, 0) + int(nbytes)
+        else:
+            nbytes = getattr(leaf, "nbytes", None)
+            if nbytes is not None:
+                out[0] = out.get(0, 0) + int(nbytes)
+    return out
+
+
+#: HLO collective op mnemonics counted by :func:`collective_counts`
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                   "collective-permute", "all-to-all")
+
+
+def collective_counts(compiled_text: str) -> dict[str, int]:
+    """Count collective ops in compiled HLO text — the communication
+    profile of a sharded solve (``jit(fn).lower(*args).compile()
+    .as_text()``).  Returns {op: count} for the ops that appear."""
+    out: dict[str, int] = {}
+    for line in compiled_text.splitlines():
+        stripped = line.lstrip()
+        # HLO spells an op as "%name = type op-name(...)" (with -start/
+        # -done pairs for async forms; count the starts only)
+        for op in _COLLECTIVE_OPS:
+            if (f" {op}(" in stripped or f" {op}-start(" in stripped
+                    or stripped.startswith((f"{op}(", f"{op}-start("))):
+                out[op] = out.get(op, 0) + 1
+    return out
+
+
+def compiled_collectives(jitted, *args, **kwargs) -> dict[str, int]:
+    """Lower+compile a jitted callable against example args and report
+    its collective-op counts (one AOT compile; the result is cached by
+    the jit, so a subsequent real call does not recompile)."""
+    compiled = jitted.lower(*args, **kwargs).compile()
+    return collective_counts(compiled.as_text())
+
+
 class ProfileDisabled(Exception):
     """The profiling endpoint gate is off (the default)."""
 

@@ -7,6 +7,14 @@ ICI collectives inserted by GSPMD. See SURVEY.md section 2.11 / 5 for the mappin
 from the reference's parallelize/informer model.
 """
 
-from koordinator_tpu.parallel.mesh import solver_mesh, shard_cluster_state, NODES_AXIS, PODS_AXIS
+from koordinator_tpu.parallel.mesh import (
+    NODES_AXIS,
+    PODS_AXIS,
+    nodes_shard_count,
+    resolve_solver_mesh,
+    shard_cluster_state,
+    solver_mesh,
+)
 
-__all__ = ["solver_mesh", "shard_cluster_state", "NODES_AXIS", "PODS_AXIS"]
+__all__ = ["solver_mesh", "shard_cluster_state", "NODES_AXIS", "PODS_AXIS",
+           "nodes_shard_count", "resolve_solver_mesh"]

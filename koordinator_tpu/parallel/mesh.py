@@ -17,12 +17,49 @@ ICI within a slice.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NODES_AXIS = "nodes"
 PODS_AXIS = "pods"
+
+
+def nodes_shard_count(mesh: Mesh | None) -> int:
+    """Size of a mesh's nodes axis (1 for no mesh)."""
+    return 1 if mesh is None else int(mesh.shape[NODES_AXIS])
+
+
+def resolve_solver_mesh(spec="auto", devices=None) -> Mesh | None:
+    """Resolve the scheduler's solve mesh (sharded-by-default policy).
+
+    - a :class:`Mesh` passes through unchanged;
+    - ``None`` / ``"off"`` disables sharding;
+    - ``"auto"`` (the default) builds the all-devices nodes-axis mesh
+      whenever more than one device is visible.
+
+    The ``KOORD_SOLVER_MESH`` env var overrides ``"auto"`` without code
+    changes: ``off`` forces single-device, an integer caps the device
+    count (e.g. ``KOORD_SOLVER_MESH=4`` on an 8-chip host).
+    """
+    if isinstance(spec, Mesh):
+        return spec
+    if spec in (None, "off"):
+        return None
+    if spec != "auto":
+        raise ValueError(f"unknown solver mesh spec {spec!r} "
+                         "(Mesh | 'auto' | 'off' | None)")
+    env = os.environ.get("KOORD_SOLVER_MESH", "").strip().lower()
+    if env in ("off", "0", "none", "single"):
+        return None
+    devs = list(devices if devices is not None else jax.devices())
+    if env.isdigit():
+        devs = devs[:max(int(env), 1)]
+    if len(devs) < 2:
+        return None
+    return solver_mesh(devs, pods_axis=1)
 
 
 def solver_mesh(devices=None, pods_axis: int = 1) -> Mesh:

@@ -1,0 +1,440 @@
+"""Node-axis ``shard_map`` solve: the sharded-by-default batch path.
+
+The batched solver's three stages — fused Filter+Score candidate
+selection, the propose/accept rounds, and the incremental dirty-node
+candidate refresh — run here as explicit SPMD programs over the
+``solver_mesh``'s ``NODES_AXIS``.  Every shard owns a contiguous block
+of node rows (``jax.sharding`` splits the leading axis into contiguous
+blocks, so global row ``g`` lives on shard ``g // (N / ndev)`` at local
+row ``g % (N / ndev)``); pod tensors, quota tensors and the (P, k)
+candidate cache are replicated over the axis (the default mesh puts
+every device on "nodes").
+
+Exactness argument — sharded acceptance decisions are BIT-IDENTICAL to
+the single-device solve:
+
+- **Selection** is a per-shard local top-k followed by a cross-shard
+  segmented merge: each shard reduces its local columns to the per-pod
+  per-stratum top-``min(k_i, n_local)`` by the GLOBAL ranking key
+  (``ops/batch_assign._rank_parts`` with global node ids), the (P, m)
+  shard winners ride one ``all_gather``, and every shard re-ranks the
+  gathered union with the same ``_topk_by_rank``.  The global top-k of
+  a union of per-shard top-k's equals the global top-k of all columns
+  (an element outside its shard's top-k is dominated by k_i better
+  local elements, so it can never be in the global top-k), and rank
+  pairs are unique per pod (the tie-break is a permutation of node
+  ids), so the merged sequence — values AND order — equals the
+  single-device ``lax.top_k``/two-key-sort output exactly.
+- **Rounds**: every per-round decision (best fitting candidate, the
+  priority prefix acceptance, quota admission) is computed REPLICATED
+  on all shards from replicated inputs; the only node-sharded data —
+  per-candidate free capacity — is gathered by the owning shard and
+  combined with an int32 ``psum`` (exact: exactly one shard contributes
+  a nonzero term per candidate).  The replicated acceptance then equals
+  ``ops/batch_assign._assign_rounds`` term for term, and each shard
+  scatters accepted requests only into the node rows it owns.
+- **Refresh**: a dirty node rescores only on its owning shard (unowned
+  rows enter the (P, D) sub-problem as invalid), the per-shard dirty
+  winners are all-gathered, and the merge re-ranks cached ∪ fresh
+  globally on the same key scale — the same union-of-top-k argument as
+  selection.
+
+Candidate selection here is always recall-EXACT (the per-shard problem
+is a factor of ``ndev`` smaller, so exact ``top_k`` is affordable where
+the single-device path reaches for ``approx_max_k``).
+
+Capacity: the node capacity must divide by the mesh's nodes-axis size —
+power-of-two capacity bucketing (state/cluster_state) guarantees this
+for power-of-two device counts.  The packed-vs-wide ranking-key regime
+(``ops/batch_assign``) is orthogonal: keys are global in both regimes,
+which is why sharding composes with the >32,768-node wide regime.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from koordinator_tpu.ops import batch_assign as ba
+from koordinator_tpu.ops.assignment import pod_estimates, score_pods
+from koordinator_tpu.parallel.mesh import NODES_AXIS, nodes_shard_count
+from koordinator_tpu.quota.admission import (
+    charge_quota_batch,
+    quota_admission_mask,
+)
+
+_NODES = P(NODES_AXIS)   # leading (node) axis sharded
+_REP = P()               # replicated over the mesh
+
+
+def check_shardable(n_total: int, mesh) -> None:
+    """Loud trace-time guard: the node capacity must split evenly over
+    the mesh's nodes axis."""
+    d = nodes_shard_count(mesh)
+    if n_total % d:
+        raise ValueError(
+            f"node capacity {n_total} does not divide over the mesh's "
+            f"{d}-way nodes axis; power-of-two capacity bucketing "
+            "(state/cluster_state._bucket) guarantees divisibility for "
+            "power-of-two device counts")
+
+
+def _shard_offset(n_local: int) -> jnp.ndarray:
+    """Global row of this shard's local row 0."""
+    return jax.lax.axis_index(NODES_AXIS).astype(jnp.int32) * n_local
+
+
+# ---------------------------------------------------------------------------
+# Selection: per-shard local top-k + cross-shard segmented merge
+# ---------------------------------------------------------------------------
+
+
+def _local_select_body(st_local, pods, cfg, *, k, strata, n_total):
+    """Shard-local fused Filter+Score + per-stratum local top-k, then the
+    cross-shard merge.  Returns replicated (cand_key, cand_node,
+    cand_score) — the ``with_scores=True`` shape of
+    ``ops/batch_assign.select_candidates``."""
+    n_loc = st_local.capacity
+    off = _shard_offset(n_loc)
+    scores, feasible = score_pods(st_local, pods, cfg)      # (P, n_loc)
+    node_ids = off + jnp.arange(n_loc, dtype=jnp.int32)
+    clipped = jnp.clip(scores, 0, ba._SCORE_CLIP)
+    rot = pods.rot_id
+
+    splits = ba._stratum_splits(k, len(strata))
+    nodes_out, scores_out = [], []
+    for sb, k_i in zip(strata, splits):
+        if k_i == 0:
+            continue
+        key, tb = ba._rank_parts(scores, feasible, sb, rot,
+                                 node_ids=node_ids, n_total=n_total)
+        m_i = min(k_i, n_loc)
+        val, idx = ba._topk_by_rank(key, tb, m_i, n_total)
+        sel_node = node_ids[idx]
+        sel_score = jnp.where(
+            val >= 0, jnp.take_along_axis(clipped, idx, axis=1), -1)
+        # cross-shard segmented top-k merge: (P, m) shard winners ride
+        # one all_gather, every shard re-ranks the union globally
+        g_node = jax.lax.all_gather(sel_node, NODES_AXIS, axis=1,
+                                    tiled=True)
+        g_score = jax.lax.all_gather(sel_score, NODES_AXIS, axis=1,
+                                     tiled=True)
+        g_key = ba._candidate_keys(g_score, g_node, rot, sb, n_total)
+        mval, midx = ba._topk_by_rank(
+            g_key, ba._candidate_tb(g_node, rot, n_total), k_i, n_total)
+        nodes_out.append(jnp.take_along_axis(g_node, midx, axis=1))
+        scores_out.append(jnp.where(
+            mval >= 0, jnp.take_along_axis(g_score, midx, axis=1), -1))
+
+    cand_node = (jnp.concatenate(nodes_out, axis=1)
+                 if len(nodes_out) > 1 else nodes_out[0])
+    cand_score = (jnp.concatenate(scores_out, axis=1)
+                  if len(scores_out) > 1 else scores_out[0])
+    cand_key = ba._candidate_keys(cand_score, cand_node, rot,
+                                  strata[0], n_total)
+    return cand_key, cand_node, cand_score
+
+
+def sharded_select_candidates(mesh, state, pods, cfg, k: int = 32,
+                              spread_bits=(5, 15),
+                              with_scores: bool = False):
+    """``select_candidates`` over the mesh's nodes axis (recall-exact).
+
+    Bit-identical to the single-device ``method="exact"`` selection on
+    valid slots (see module docstring)."""
+    strata = (tuple(spread_bits) if isinstance(spread_bits, (tuple, list))
+              else (spread_bits,))
+    n_total = state.capacity
+    check_shardable(n_total, mesh)
+    k = min(k, n_total)
+    fn = shard_map(
+        partial(_local_select_body, k=k, strata=strata, n_total=n_total),
+        mesh=mesh, in_specs=(_NODES, _REP, _REP),
+        out_specs=(_REP, _REP, _REP), check_rep=False)
+    cand_key, cand_node, cand_score = fn(state, pods, cfg)
+    if with_scores:
+        return cand_key, cand_node, cand_score
+    return cand_key, cand_node
+
+
+# ---------------------------------------------------------------------------
+# Rounds: replicated acceptance, owner-gathered capacity, sharded scatter
+# ---------------------------------------------------------------------------
+
+
+def _rounds_local(st_local, pods, quota, cand_key, cand_node, *,
+                  rounds, n_total):
+    """The propose/accept loop with node tensors shard-local.  Mirrors
+    ``ops/batch_assign._assign_rounds`` decision for decision; returns
+    (assignments, requested_local, quota)."""
+    n_loc = st_local.capacity
+    off = _shard_offset(n_loc)
+    cand_valid = cand_key >= 0
+    cand_tb = (None if ba._packed_regime(n_total)
+               else ba._candidate_tb(cand_node, pods.rot_id, n_total))
+    order = jnp.lexsort((jnp.arange(pods.capacity), -pods.priority))
+    active0 = pods.valid & jnp.any(cand_valid, axis=1)
+
+    local = cand_node - off
+    own = (local >= 0) & (local < n_loc)           # (P, k) owner mask
+    local_c = jnp.clip(local, 0, n_loc - 1)
+
+    def round_body(c):
+        requested, assignments, active, qstate = c
+        free_loc = jnp.where(
+            st_local.node_valid[:, None],
+            st_local.node_allocatable - requested, 0)
+        # per-candidate free capacity: the owning shard contributes, the
+        # int32 psum reassembles the exact global gather free[cand_node]
+        cand_free = jax.lax.psum(
+            jnp.where(own[:, :, None], free_loc[local_c], 0), NODES_AXIS)
+        fits = jnp.all(
+            (pods.requests[:, None, :] <= cand_free)
+            | (pods.requests[:, None, :] == 0),
+            axis=-1,
+        ) & cand_valid
+        best = ba._choose_candidate(cand_key, cand_tb, fits)
+        has = jnp.take_along_axis(fits, best[:, None], axis=1)[:, 0]
+        choice = jnp.take_along_axis(cand_node, best[:, None], axis=1)[:, 0]
+
+        act = active & has
+        if qstate is not None:
+            act = act & quota_admission_mask(
+                qstate, pods.requests, pods.quota_id, pods.non_preemptible)
+
+        loc_choice = choice - off
+        own_c = (loc_choice >= 0) & (loc_choice < n_loc)
+        loc_choice_c = jnp.clip(loc_choice, 0, n_loc - 1)
+        choice_free = jax.lax.psum(
+            jnp.where((own_c & act)[:, None], free_loc[loc_choice_c], 0),
+            NODES_AXIS)
+        accept = ba._prefix_accept_choice(
+            choice, pods.requests, choice_free, n_total, order, act)
+        if qstate is not None:
+            accept = accept & ba._quota_prefix_accept(
+                qstate, pods.requests, pods, order, act)
+
+        add = jnp.where((accept & own_c)[:, None], pods.requests, 0)
+        requested = requested.at[loc_choice_c].add(add)
+        new_quota = qstate
+        if new_quota is not None:
+            new_quota = charge_quota_batch(
+                new_quota, pods.requests, pods.quota_id, accept,
+                pods.non_preemptible)
+        return (requested,
+                jnp.where(accept, choice, assignments),
+                act & ~accept,
+                new_quota)
+
+    def cond(loop_carry):
+        i, c = loop_carry
+        return (i < rounds) & jnp.any(c[2])
+
+    def body(loop_carry):
+        i, c = loop_carry
+        return i + 1, round_body(c)
+
+    carry = (st_local.node_requested,
+             jnp.full(pods.capacity, -1, jnp.int32),
+             active0, quota)
+    _, carry = jax.lax.while_loop(cond, body, (jnp.int32(0), carry))
+    return carry[1], carry[0], carry[3]
+
+
+def _rounds_body(st_local, pods, quota, cand_key, cand_node, *,
+                 rounds, n_total):
+    a, requested, new_quota = _rounds_local(
+        st_local, pods, quota, cand_key, cand_node,
+        rounds=rounds, n_total=n_total)
+    return a, st_local.replace(node_requested=requested), new_quota
+
+
+def sharded_assign_rounds(mesh, state, pods, quota, cand_key, cand_node,
+                          rounds: int = 12):
+    """``_assign_rounds`` over the mesh: (assignments, new_state, quota)."""
+    n_total = state.capacity
+    check_shardable(n_total, mesh)
+    fn = shard_map(
+        partial(_rounds_body, rounds=rounds, n_total=n_total),
+        mesh=mesh, in_specs=(_NODES, _REP, _REP, _REP, _REP),
+        out_specs=(_REP, _NODES, _REP), check_rep=False)
+    return fn(state, pods, quota, cand_key, cand_node)
+
+
+def _round_pass_body(st_local, pods, quota, cand_key, cand_node, cfg, *,
+                     rounds, n_total):
+    a, requested, _ = _rounds_local(
+        st_local, pods, quota, cand_key, cand_node,
+        rounds=rounds, n_total=n_total)
+    n_loc = st_local.capacity
+    off = _shard_offset(n_loc)
+    keep = a >= 0
+    est = pod_estimates(pods, cfg)
+    loc = a - off
+    own = keep & (loc >= 0) & (loc < n_loc)
+    est_accum = jnp.zeros_like(st_local.node_usage).at[
+        jnp.clip(loc, 0, n_loc - 1)
+    ].add(jnp.where(own[:, None], est, 0))
+    new_quota = quota
+    if quota is not None:
+        # in-rounds quota feedback is discarded and recharged whole,
+        # exactly as the single-device assign_round_pass does
+        new_quota = charge_quota_batch(
+            quota, pods.requests, pods.quota_id, keep,
+            pods.non_preemptible)
+    return (a, st_local.replace(node_requested=requested), new_quota,
+            est_accum)
+
+
+def sharded_assign_round_pass(mesh, state, pods, quota, cand_key,
+                              cand_node, cfg, rounds: int = 12):
+    """``assign_round_pass`` over the mesh: first solve pass over
+    precomputed candidates with est-usage accumulation and whole-batch
+    quota recharge.  Returns (assignments, new_state, new_quota,
+    est_accum); ``est_accum`` is node-sharded like the state."""
+    n_total = state.capacity
+    check_shardable(n_total, mesh)
+    fn = shard_map(
+        partial(_round_pass_body, rounds=rounds, n_total=n_total),
+        mesh=mesh, in_specs=(_NODES, _REP, _REP, _REP, _REP, _REP),
+        out_specs=(_REP, _NODES, _REP, _NODES), check_rep=False)
+    return fn(state, pods, quota, cand_key, cand_node, cfg)
+
+
+def _followup_body(st_local, est_local, pods, quota, cfg, *,
+                   k, strata, rounds, n_total):
+    # candidates re-selected against the est-augmented state; rounds and
+    # the commit run against the UN-augmented accounting (the
+    # assign_followup_pass rollback-rebuild semantics)
+    aug = st_local.replace(
+        node_usage=st_local.node_usage + est_local,
+        node_agg_usage=st_local.node_agg_usage + est_local)
+    cand_key, cand_node, _ = _local_select_body(
+        aug, pods, cfg, k=k, strata=strata, n_total=n_total)
+    a, requested, _ = _rounds_local(
+        aug, pods, quota, cand_key, cand_node,
+        rounds=rounds, n_total=n_total)
+    n_loc = st_local.capacity
+    off = _shard_offset(n_loc)
+    keep = (a >= 0) & pods.valid
+    est = pod_estimates(pods, cfg)
+    loc = a - off
+    own = keep & (loc >= 0) & (loc < n_loc)
+    loc_c = jnp.clip(loc, 0, n_loc - 1)
+    est_accum = est_local.at[loc_c].add(jnp.where(own[:, None], est, 0))
+    new_quota = quota
+    if quota is not None:
+        new_quota = charge_quota_batch(
+            quota, pods.requests, pods.quota_id, keep,
+            pods.non_preemptible)
+    # aug and st_local share node_requested, so the rounds' requested IS
+    # the committed accounting (original + accepted requests)
+    return (a, st_local.replace(node_requested=requested), new_quota,
+            est_accum)
+
+
+def sharded_assign_followup_pass(mesh, state, est_accum, pods, quota, cfg,
+                                 k: int = 32, rounds: int = 12,
+                                 spread_bits=(5, 15)):
+    """``assign_followup_pass`` over the mesh (selection is always
+    recall-exact here).  Returns (assignments, new_state, new_quota,
+    est_accum')."""
+    strata = (tuple(spread_bits) if isinstance(spread_bits, (tuple, list))
+              else (spread_bits,))
+    n_total = state.capacity
+    check_shardable(n_total, mesh)
+    fn = shard_map(
+        partial(_followup_body, k=min(k, n_total), strata=strata,
+                rounds=rounds, n_total=n_total),
+        mesh=mesh, in_specs=(_NODES, _NODES, _REP, _REP, _REP),
+        out_specs=(_REP, _NODES, _REP, _NODES), check_rep=False)
+    return fn(state, est_accum, pods, quota, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Incremental refresh: owner-local dirty rescore + global merge
+# ---------------------------------------------------------------------------
+
+
+def _refresh_body(st_local, pods, cfg, cache, dirty_rows, dirty_valid, *,
+                  k, strata, n_total):
+    n_loc = st_local.capacity
+    off = _shard_offset(n_loc)
+    rot = pods.rot_id
+    d = dirty_rows.shape[0]
+
+    # a dirty node rescores only on its owning shard: unowned rows enter
+    # the (P, D) sub-problem as invalid and rank -1
+    loc = dirty_rows - off
+    own = (loc >= 0) & (loc < n_loc) & dirty_valid
+    sub = st_local.gather_rows(jnp.clip(loc, 0, n_loc - 1), own)
+    scores, feasible = score_pods(sub, pods, cfg)           # (P, D)
+    clipped = jnp.clip(scores, 0, ba._SCORE_CLIP)
+
+    # global dirty mask (replicated): cached slots pointing at ANY dirty
+    # node are stale regardless of which shard owns it
+    dirty_mask = jnp.zeros(n_total, bool).at[dirty_rows].max(dirty_valid)
+    stale_score = jnp.where(dirty_mask[cache.cand_node], -1,
+                            cache.cand_score)
+
+    splits = ba._stratum_splits(k, len(strata))
+    nodes_out, scores_out = [], []
+    offset = 0
+    for sb, k_i in zip(strata, splits):
+        if k_i == 0:
+            continue
+        seg_node = cache.cand_node[:, offset:offset + k_i]
+        seg_score = stale_score[:, offset:offset + k_i]
+        offset += k_i
+        dkey, dtb = ba._rank_parts(scores, feasible, sb, rot,
+                                   node_ids=dirty_rows, n_total=n_total)
+        m_i = min(k_i, d)
+        dval, idx = ba._topk_by_rank(dkey, dtb, m_i, n_total)
+        d_node = dirty_rows[idx]
+        d_score = jnp.where(
+            dval >= 0, jnp.take_along_axis(clipped, idx, axis=1), -1)
+        g_node = jax.lax.all_gather(d_node, NODES_AXIS, axis=1, tiled=True)
+        g_score = jax.lax.all_gather(d_score, NODES_AXIS, axis=1,
+                                     tiled=True)
+        # merge re-ranks globally: cached ∪ per-shard fresh winners on
+        # one key scale
+        c_key = ba._candidate_keys(seg_score, seg_node, rot, sb, n_total)
+        g_key = ba._candidate_keys(g_score, g_node, rot, sb, n_total)
+        m_key = jnp.concatenate([c_key, g_key], axis=1)
+        m_node = jnp.concatenate([seg_node, g_node], axis=1)
+        m_score = jnp.concatenate([seg_score, g_score], axis=1)
+        mval, midx = ba._topk_by_rank(
+            m_key, ba._candidate_tb(m_node, rot, n_total), k_i, n_total)
+        nodes_out.append(jnp.take_along_axis(m_node, midx, axis=1))
+        scores_out.append(jnp.where(
+            mval >= 0, jnp.take_along_axis(m_score, midx, axis=1), -1))
+
+    cand_node = (jnp.concatenate(nodes_out, axis=1)
+                 if len(nodes_out) > 1 else nodes_out[0])
+    cand_score = (jnp.concatenate(scores_out, axis=1)
+                  if len(scores_out) > 1 else scores_out[0])
+    cand_key = ba._candidate_keys(cand_score, cand_node, rot,
+                                  strata[0], n_total)
+    return cand_key, ba.CandidateCache(cand_key, cand_node, cand_score)
+
+
+def sharded_refresh_candidates(mesh, state, pods, cfg, cache, dirty_rows,
+                               dirty_valid, k: int = 32,
+                               spread_bits=(5, 15)):
+    """``refresh_candidates`` over the mesh: dirty columns rescore on
+    their owning shard, the merge re-ranks globally.  Returns
+    (cand_key, new_cache) like the single-device refresh."""
+    strata = (tuple(spread_bits) if isinstance(spread_bits, (tuple, list))
+              else (spread_bits,))
+    n_total = state.capacity
+    check_shardable(n_total, mesh)
+    fn = shard_map(
+        partial(_refresh_body, k=min(k, n_total), strata=strata,
+                n_total=n_total),
+        mesh=mesh, in_specs=(_NODES, _REP, _REP, _REP, _REP, _REP),
+        out_specs=(_REP, _REP), check_rep=False)
+    return fn(state, pods, cfg, cache, dirty_rows, dirty_valid)

@@ -150,6 +150,8 @@ class Scheduler:
         faults=None,
         explain: bool = True,
         flight_ring_size: int = 256,
+        mesh="auto",
+        shard_min_nodes: int = 1024,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -208,8 +210,36 @@ class Scheduler:
         # is a dashboard line, not a mystery latency spike.
         from koordinator_tpu.ops import introspection as insp
 
+        # -- sharded-by-default solve mesh (ISSUE 10) --
+        # the node axis of the batch solve shards over every visible
+        # device (parallel/sharded.py shard_map kernels); tiny clusters
+        # stay single-device — sharding a 64-node problem is pure
+        # collective overhead — via the min-nodes floor
+        # (KOORD_SOLVER_MESH_MIN_NODES / shard_min_nodes).
+        import os as _os
+
+        from koordinator_tpu.parallel import mesh as pmesh
+        from koordinator_tpu.parallel import sharded as psharded
+
+        self.mesh = pmesh.resolve_solver_mesh(mesh)
+        self.shard_min_nodes = int(_os.environ.get(
+            "KOORD_SOLVER_MESH_MIN_NODES", shard_min_nodes))
+        self.solver_shard_count = pmesh.nodes_shard_count(self.mesh)
+        if self.mesh is not None:
+            self.snapshot.set_solver_sharding(
+                pmesh.node_sharding(self.mesh), self.solver_shard_count,
+                min_nodes=self.shard_min_nodes)
+        #: recompile accounting buckets carry the mesh shape so a
+        #: per-mesh-shape compile regression is its own dashboard line;
+        #: evaluated per call — below the min-nodes floor the solve runs
+        #: single-device and the bucket stays unsuffixed
+        def _sfx():
+            return (f"@{self.solver_shard_count}shard"
+                    if (self.mesh is not None
+                        and self.snapshot.solver_sharding_active) else "")
+
         def _pn(args, kwargs):
-            return f"P{args[1].capacity}xN{args[0].capacity}"
+            return f"P{args[1].capacity}xN{args[0].capacity}{_sfx()}"
 
         self._solve = insp.instrument(
             jax.jit(gang_assign,
@@ -275,6 +305,44 @@ class Scheduler:
                     donate_argnums=(0, 1)),
             "assign_followup_pass",
             shape_of=lambda a, k: f"P{a[2].capacity}xN{a[0].capacity}")
+        # sharded twins of the batch-solve entries (selection is
+        # recall-exact on the mesh; acceptance is bit-identical to the
+        # single-device entries above — parallel/sharded.py).  Donation
+        # mirrors the unsharded bindings: the state (and the refresh's
+        # cache) updates in place under its NamedSharding placement.
+        self._select_scored_sh = self._refresh_cands_sh = None
+        self._pass1_sh = self._pass2_sh = None
+        if self.mesh is not None:
+            from functools import partial as _partial
+
+            self._select_scored_sh = insp.instrument(
+                jax.jit(_partial(psharded.sharded_select_candidates,
+                                 self.mesh),
+                        static_argnames=("k", "spread_bits",
+                                         "with_scores")),
+                "select_candidates", shape_of=_pn)
+            self._refresh_cands_sh = insp.instrument(
+                jax.jit(_partial(psharded.sharded_refresh_candidates,
+                                 self.mesh),
+                        static_argnames=("k", "spread_bits"),
+                        donate_argnums=(3,)),
+                "refresh_candidates",
+                shape_of=lambda a, k: (f"P{a[1].capacity}xN{a[0].capacity}"
+                                       f"xD{a[4].shape[0]}{_sfx()}"))
+            self._pass1_sh = insp.instrument(
+                jax.jit(_partial(psharded.sharded_assign_round_pass,
+                                 self.mesh),
+                        static_argnames=("rounds",),
+                        donate_argnums=(0,)),
+                "assign_round_pass", shape_of=_pn)
+            self._pass2_sh = insp.instrument(
+                jax.jit(_partial(psharded.sharded_assign_followup_pass,
+                                 self.mesh),
+                        static_argnames=("k", "rounds", "spread_bits"),
+                        donate_argnums=(0, 1)),
+                "assign_followup_pass",
+                shape_of=lambda a, k: (f"P{a[2].capacity}"
+                                       f"xN{a[0].capacity}{_sfx()}"))
         #: reservation lifecycle (plugins/reservation parity): reserve-pods
         #: schedule through the normal rounds, Available sets get a
         #: reservation-first exact solve pre-pass
@@ -1245,6 +1313,26 @@ class Scheduler:
                     float(insp.device_bytes(
                         cand["cache"] if cand else None)),
                     labels={"kind": "candidate_cache"})
+                # sharded-solve introspection: the active nodes-axis
+                # width plus the per-device slice of each persistent
+                # tensor (a lopsided shard is a placement bug)
+                active_shards = (self.solver_shard_count
+                                 if (self.mesh is not None
+                                     and self.snapshot
+                                     .solver_sharding_active) else 1)
+                metrics.solver_shard_count.set(float(active_shards))
+                if active_shards > 1:
+                    for kind, tree in (
+                        ("cluster_state", self.snapshot.state),
+                        ("candidate_cache",
+                         cand["cache"] if cand else None),
+                    ):
+                        for did, nbytes in insp.device_bytes_by_shard(
+                                tree).items():
+                            metrics.solver_device_bytes.set(
+                                float(nbytes),
+                                labels={"kind": kind,
+                                        "shard": str(did)})
                 if self.explain:
                     # per-dim capacity slack: the headroom context for
                     # the round's fit_<dim> rejection counts
@@ -1600,6 +1688,39 @@ class Scheduler:
         self._solve_device_s += time.perf_counter() - t0
         return value
 
+    def sharding_report(self) -> dict:
+        """The /debug/slo "sharded solve" section: active mesh shape,
+        per-device bytes of the persistent solver tensors, and the
+        recompile counters per (fn, shape) bucket — shape buckets carry
+        an ``@<n>shard`` suffix while the mesh is active, so a
+        per-mesh-shape compile regression reads straight off this
+        document (and off ``solver_recompiles_total{shape}``)."""
+        from koordinator_tpu.ops import introspection as insp
+        from koordinator_tpu.parallel.mesh import NODES_AXIS, PODS_AXIS
+
+        cand = self._cand_cache
+        return {
+            "solver_shard_count": (self.solver_shard_count
+                                   if self.mesh is not None else 1),
+            "active": bool(self.mesh is not None
+                           and self.snapshot.solver_sharding_active),
+            "mesh": ({"pods": int(self.mesh.shape[PODS_AXIS]),
+                      "nodes": int(self.mesh.shape[NODES_AXIS])}
+                     if self.mesh is not None else None),
+            "shard_min_nodes": self.shard_min_nodes,
+            "device_bytes_by_shard": {
+                "cluster_state": {
+                    str(d): b for d, b in insp.device_bytes_by_shard(
+                        self.snapshot.state).items()},
+                "candidate_cache": {
+                    str(d): b for d, b in insp.device_bytes_by_shard(
+                        cand["cache"] if cand else None).items()},
+            },
+            "recompiles_by_shape": {
+                f"{lbl.get('fn', '?')}[{lbl.get('shape', '?')}]": int(v)
+                for lbl, v in metrics.solver_recompiles.items()},
+        }
+
     def _solve_batch_incremental(self, pods, batch: PodBatch, quota):  # koordlint: guarded-by(self.lock)
         """The no-gang batch solve with the persistent device-resident
         candidate cache (ops/batch_assign incremental section).
@@ -1627,6 +1748,26 @@ class Scheduler:
         method = self.cand_method
         if method == "auto":
             method = "approx" if jax.default_backend() == "tpu" else "exact"
+        # sharded-by-default: when the solver mesh is active for this
+        # capacity, selection/refresh/passes run the shard_map entries
+        # (recall-exact selection; bit-identical acceptance) and the
+        # state donates in place under its node-axis NamedSharding
+        use_mesh = self.mesh is not None and snap.solver_sharding_active
+        if use_mesh:
+            method = "sharded"
+
+            def _select(st, b):
+                return self._select_scored_sh(
+                    st, b, self.config, k=k, spread_bits=self.cand_spread,
+                    with_scores=True)
+        else:
+            def _select(st, b):
+                return self._select_scored(
+                    st, b, self.config, k=k, spread_bits=self.cand_spread,
+                    method=method, with_scores=True)
+        refresh_fn = (self._refresh_cands_sh if use_mesh
+                      else self._refresh_cands)
+        pass1_fn = self._pass1_sh if use_mesh else self._pass1
         meta = self._cand_cache
         cache_ok = (
             meta is not None
@@ -1678,16 +1819,13 @@ class Scheduler:
             self._last_dirty_pod_frac = pod_frac
             if max(node_frac, pod_frac) <= self.incremental_dirty_threshold:
                 path = "incremental"
-                cand_key, cache = self._refresh_cands(
+                cand_key, cache = refresh_fn(
                     snap.state, batch, self.config, aligned,
                     jnp.asarray(drows), jnp.asarray(dvalid),
                     k=k, spread_bits=self.cand_spread)
                 if dirty_pods.any():
                     small, idx = batch.compact(dirty_pods)
-                    sk, sn, ss = self._select_scored(
-                        snap.state, small, self.config, k=k,
-                        spread_bits=self.cand_spread, method=method,
-                        with_scores=True)
+                    sk, sn, ss = _select(snap.state, small)
                     rows_pad = np.full(small.capacity, batch.capacity,
                                        np.int32)
                     rows_pad[: len(idx)] = idx
@@ -1696,10 +1834,7 @@ class Scheduler:
             else:
                 path = "full_fallback"
         if cache is None:
-            ck, cn, cs = self._select_scored(
-                snap.state, batch, self.config, k=k,
-                spread_bits=self.cand_spread, method=method,
-                with_scores=True)
+            ck, cn, cs = _select(snap.state, batch)
             cache = ba.CandidateCache(ck, cn, cs)
         metrics.incremental_solve_total.inc(labels={"path": path})
         # the batch build already computed this round's name→row / spec
@@ -1727,7 +1862,7 @@ class Scheduler:
         # either way).  On any failure the cache is dropped so the next
         # round re-warms instead of trusting un-bookkept state.
         try:
-            a, state, quota, est_accum = self._pass1(
+            a, state, quota, est_accum = pass1_fn(
                 snap.state, batch, quota, cache.cand_key, cache.cand_node,
                 self.config, rounds=self.solve_rounds)
             snap.state = state
@@ -1737,10 +1872,16 @@ class Scheduler:
                 if not leftover.any():
                     break
                 small, idx = batch.compact(leftover)
-                a2, state, quota, est_accum = self._pass2(
-                    state, est_accum, small, quota, self.config, k=k,
-                    rounds=self.solve_rounds, spread_bits=self.cand_spread,
-                    method=method)
+                if use_mesh:
+                    a2, state, quota, est_accum = self._pass2_sh(
+                        state, est_accum, small, quota, self.config, k=k,
+                        rounds=self.solve_rounds,
+                        spread_bits=self.cand_spread)
+                else:
+                    a2, state, quota, est_accum = self._pass2(
+                        state, est_accum, small, quota, self.config, k=k,
+                        rounds=self.solve_rounds,
+                        spread_bits=self.cand_spread, method=method)
                 snap.state = state
                 a2_np = np.asarray(self._block_timed(a2))[: len(idx)]
                 placed = a2_np >= 0

@@ -42,7 +42,16 @@ def debug_slo_body(scheduler) -> dict:
     if monitor is None:
         raise DebugApiError(501, "no SLO monitor attached "
                                  "(scheduler binaries only)")
-    return monitor.report()
+    # copy: report() may return the monitor's shared internal dict (the
+    # background sampler's _last_report); inserting into it would race
+    # concurrent scrapes and pollute the stored report
+    body = dict(monitor.report())
+    # sharded-solve introspection rides the SLO document: shard count,
+    # per-device bytes, recompiles per (fn, shape@mesh) bucket
+    report = getattr(scheduler, "sharding_report", None)
+    if report is not None:
+        body["sharding"] = report()
+    return body
 
 
 def debug_steady_body(scheduler, params: dict | None = None) -> dict:

@@ -17,6 +17,7 @@ import numpy as np
 from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
 from koordinator_tpu.state.cluster_state import ClusterState, _bucket
 
+import jax
 import jax.numpy as jnp
 
 
@@ -102,6 +103,36 @@ class ClusterSnapshot:
         #: the AGE of this stamp — a stalled feed means every usage- and
         #: batch-allocatable-derived row here is untrustworthy.
         self.last_sync_time: float | None = None
+        #: solver-mesh placement (scheduler-owned): when set, the state's
+        #: node tensors live node-axis-sharded over the mesh so the
+        #: sharded solve entries donate them IN PLACE instead of
+        #: resharding per call.  Applied lazily — only once the capacity
+        #: both divides over the shard count and reaches the min-nodes
+        #: floor (sharding a tiny cluster is pure collective overhead).
+        self._solver_sharding = None
+        self._solver_shards = 1
+        self._solver_shard_min_nodes = 0
+
+    def set_solver_sharding(self, sharding, shards: int,
+                            min_nodes: int = 0) -> None:
+        """Install the solver mesh's node-axis placement (see above)."""
+        self._solver_sharding = sharding
+        self._solver_shards = max(int(shards), 1)
+        self._solver_shard_min_nodes = int(min_nodes)
+        self._apply_solver_sharding()
+
+    @property
+    def solver_sharding_active(self) -> bool:
+        """True when the CURRENT capacity solves on the sharded path."""
+        return (self._solver_sharding is not None
+                and self.capacity % self._solver_shards == 0
+                and self.capacity >= self._solver_shard_min_nodes)
+
+    def _apply_solver_sharding(self) -> None:
+        if self.solver_sharding_active:
+            ns = self._solver_sharding
+            self.state = jax.tree.map(
+                lambda x: jax.device_put(x, ns), self.state)
 
     def mark_sync(self, now: float) -> None:
         """Stamp feed liveness (monotonic under the writer's clock)."""
@@ -214,6 +245,7 @@ class ClusterSnapshot:
             node_class=pad(old.node_class),
         )
         self._free_rows = list(range(new_cap - 1, old_cap - 1, -1)) + self._free_rows
+        self._apply_solver_sharding()
 
     # -- delta flush ---------------------------------------------------------
 
@@ -323,6 +355,7 @@ class ClusterSnapshot:
         are always <= allocatable, so subtracting a released pod keeps
         the conservative row >= the true remaining bookings."""
         self.state = ClusterState.zeros(self.capacity, self.dims)
+        self._apply_solver_sharding()
         self._reset_requested.clear()
         self._dirty.update(self.node_index.values())
         self._cand_dirty.update(self.node_index.values())

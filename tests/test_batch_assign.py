@@ -384,36 +384,54 @@ def test_batch_quality_tracks_greedy_chunked():
     _batch_quality_tracks_greedy("chunked")
 
 
-def test_node_capacity_ceiling_raises_loud():
-    """>2**15 nodes must fail at trace time, not silently mis-rank: the
-    ranking key packs the rotated node index into _TB_BITS low bits
-    (batch_assign.py) and a 40k-node problem would alias into the score
-    field."""
+def test_node_capacity_ceiling_moved_past_the_packed_wall():
+    """ISSUE 10: the 32,768 packing wall is GONE — a 40,960-node problem
+    (the shape the old guard refused) selects and solves in the wide
+    lexicographic key regime — and the loud guard moved to 2**30 (int32
+    row-index / rotation-arithmetic width, not packing)."""
     import pytest
 
     from koordinator_tpu.ops.batch_assign import (
         MAX_NODE_CAPACITY,
+        PACKED_NODE_CAPACITY,
+        _packed_regime,
         check_node_capacity,
         select_candidates,
     )
 
-    check_node_capacity(MAX_NODE_CAPACITY)  # boundary is allowed
+    assert MAX_NODE_CAPACITY == 1 << 30
+    check_node_capacity(PACKED_NODE_CAPACITY + 1)   # old wall: allowed
+    check_node_capacity(MAX_NODE_CAPACITY)          # boundary allowed
     with pytest.raises(ValueError, match="ranking-key ceiling"):
         check_node_capacity(MAX_NODE_CAPACITY + 1)
 
-    state = mk_state([16_000] * 40_960)
-    pods = mk_pods([500] * 4, node_capacity=state.capacity)
-    for method in ("exact", "approx", "chunked"):
-        with pytest.raises(ValueError, match="ranking-key ceiling"):
-            select_candidates(state, pods, cfg(), k=8, method=method)
+    # explicit capacity: the default power-of-two bucket would balloon
+    # this to 65,536 columns (that shape's full solve lives in
+    # tests/test_sharded_solve.py) — the point HERE is only that the
+    # old guard's exact failure shape now selects
+    alloc = np.zeros((40_960, R), np.int32)
+    alloc[:, CPU] = 16_000
+    alloc[:, MEM] = 65_536
+    state = ClusterState.from_arrays(alloc, capacity=40_960)
+    assert not _packed_regime(state.capacity)
+    pods = mk_pods([500] * 4, node_capacity=state.capacity, capacity=4)
+    key, node = select_candidates(state, pods, cfg(), k=8,
+                                  method="exact")
+    assert (np.asarray(key)[:4] >= 0).all()
+    assert int(np.asarray(node).max()) < 40_960
 
 
 def test_node_capacity_at_boundary_solves():
-    """Exactly 2**15 nodes still solves correctly (the assert is not
-    off-by-one): a small pod batch assigns with no overcommit."""
-    from koordinator_tpu.ops.batch_assign import MAX_NODE_CAPACITY
+    """Exactly 2**15 nodes — the PACKED key regime's boundary — still
+    solves correctly (the regime switch is not off-by-one): a small pod
+    batch assigns with no overcommit on the packed path."""
+    from koordinator_tpu.ops.batch_assign import (
+        PACKED_NODE_CAPACITY,
+        _packed_regime,
+    )
 
-    state = mk_state([16_000] * MAX_NODE_CAPACITY)
+    state = mk_state([16_000] * PACKED_NODE_CAPACITY)
+    assert _packed_regime(state.capacity)
     pods = mk_pods([500] * 8, node_capacity=state.capacity)
     asn, st, _ = batch_assign(state, pods, cfg(), k=8, method="exact")
     assert int((np.asarray(asn) >= 0).sum()) == 8

@@ -24,15 +24,22 @@ def test_stage_profiler_smoke():
     assert stages == {"provenance", "rtt_floor", "score", "select_approx",
                       "select_chunked", "rounds",
                       "refresh_incremental_1pct",
+                      "score_sharded", "rounds_sharded", "merge_topk",
                       "explain_compact_1pct", "explain_full_batch"}, stages
     by_stage = {r["stage"]: r for r in records}
     # every timed stage produced a positive per-iteration time
     for name in ("score", "select_approx", "select_chunked", "rounds",
-                 "refresh_incremental_1pct", "explain_compact_1pct",
+                 "refresh_incremental_1pct", "score_sharded",
+                 "rounds_sharded", "merge_topk", "explain_compact_1pct",
                  "explain_full_batch"):
         assert by_stage[name]["ms_per_iter"] > 0, by_stage[name]
     # the stage capture stamps code provenance for later promotion
     assert "commit" in by_stage["provenance"]
+    # ... and mesh-shape provenance (ISSUE 10): the record names the
+    # device count and axis split the sharded stages ran on
+    assert by_stage["provenance"]["n_devices"] >= 1
+    assert by_stage["provenance"]["mesh_axes"]["nodes"] >= 1
+    assert by_stage["score_sharded"]["n_devices"] >= 1
     # the explain overhead stages price themselves against the solve
     assert "pct_of_solve" in by_stage["explain_compact_1pct"]
     assert "within_5pct" in by_stage["explain_compact_1pct"]
@@ -180,7 +187,9 @@ def test_latest_probe_stages_promotion(tmp_path):
     d.mkdir()
     assert _latest_probe_stages(str(d)) is None
     (d / "stages_1.jsonl").write_text("\n".join([
-        json.dumps({"stage": "provenance", "commit": head, "dirty": False}),
+        json.dumps({"stage": "provenance", "commit": head, "dirty": False,
+                    "n_devices": 8,
+                    "mesh_axes": {"pods": 1, "nodes": 8}}),
         json.dumps({"stage": "score", "ms_per_iter": 12.5}),
         json.dumps({"stage": "rounds", "ms_per_iter": 3.2}),
     ]))
@@ -188,6 +197,8 @@ def test_latest_probe_stages_promotion(tmp_path):
     assert rec["source"] == "stages_1.jsonl"
     assert rec["stages"]["score"]["ms_per_iter"] == 12.5
     assert rec["capture_commit"] == head
+    # mesh-shape provenance rides the promotion (ISSUE 10)
+    assert rec["n_devices"] == 8 and rec["mesh_axes"]["nodes"] == 8
     assert "caveat" not in rec
     # a NEWER unstamped capture wins but carries a caveat
     (d / "stages_2.jsonl").write_text(

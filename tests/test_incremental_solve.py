@@ -153,11 +153,16 @@ def test_refresh_matches_full_selection_random_deltas(seed):
                 ).all(axis=-1)[np.asarray(ist.node_valid)].all()
 
 
-def _mk_sched(incremental: bool, quota_tree=None):
+def _mk_sched(incremental: bool, quota_tree=None, **kw):
+    # mesh="off" keeps this module's parity pairs on the single-device
+    # path; tests/test_sharded_solve.py overrides with mesh="auto" +
+    # shard_min_nodes=0 to run the same drivers over the 8-way mesh
+    kw.setdefault("mesh", "off")
     sched = Scheduler(ClusterSnapshot(capacity=32),
                       quota_tree=quota_tree,
                       batch_solver_threshold=1,   # force the batch engine
-                      incremental_solve=incremental)
+                      incremental_solve=incremental,
+                      **kw)
     return sched
 
 

@@ -252,3 +252,95 @@ class TestProfilerCapture:
         status, body = service.handle("/debug/profile",
                                       {"seconds": "nan"})
         assert status == 400
+
+class TestShardedIntrospection:
+    """ISSUE 10 satellite: per-shard device bytes, collective counts,
+    the solver_shard_count gauge, and the /debug/slo sharding section."""
+
+    def test_device_bytes_by_shard_single_device(self):
+        a = jnp.zeros((16, 4), jnp.int32)
+        by = insp.device_bytes_by_shard(a)
+        assert sum(by.values()) == a.nbytes and len(by) == 1
+        assert insp.device_bytes_by_shard(None) == {}
+
+    def test_device_bytes_by_shard_sharded_and_replicated(self):
+        from koordinator_tpu.parallel import mesh as pmesh
+
+        mesh = pmesh.solver_mesh()
+        sharded = jax.device_put(jnp.zeros((64, 4), jnp.int32),
+                                 pmesh.node_sharding(mesh))
+        by = insp.device_bytes_by_shard(sharded)
+        # node-sharded: the slices sum to the global footprint, spread
+        # over every device of the mesh
+        assert sum(by.values()) == sharded.nbytes
+        assert len(by) == len(jax.devices())
+        rep = jax.device_put(
+            jnp.zeros((8,), jnp.int32),
+            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+        by_rep = insp.device_bytes_by_shard(rep)
+        # replicated: every device honestly pays a full copy
+        assert all(v == rep.nbytes for v in by_rep.values())
+
+    def test_collective_counts_parses_hlo(self):
+        txt = """
+  %ag = s32[4,8]{1,0} all-gather(s32[4,1]{1,0} %x), replica_groups={}
+  %ar.1 = s32[4]{0} all-reduce(s32[4]{0} %y), to_apply=%sum
+  %ars = s32[2]{0} reduce-scatter(s32[4]{0} %z), to_apply=%sum
+  %not_a_match = s32[] add(s32[] %a, s32[] %b)
+"""
+        got = insp.collective_counts(txt)
+        assert got == {"all-gather": 1, "all-reduce": 1,
+                       "reduce-scatter": 1}
+
+    def test_compiled_collectives_counts_sharded_psum(self):
+        from koordinator_tpu.parallel import mesh as pmesh
+        from koordinator_tpu.parallel import sharded as ps
+
+        mesh = pmesh.solver_mesh()
+        from functools import partial
+
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        fn = jax.jit(shard_map(
+            lambda x: jax.lax.psum(x.sum(), ps.NODES_AXIS),
+            mesh=mesh, in_specs=(P("nodes"),), out_specs=P(),
+            check_rep=False))
+        got = insp.compiled_collectives(fn, jnp.zeros((64,), jnp.int32))
+        assert got.get("all-reduce", 0) >= 1, got
+
+    def test_sharding_report_and_debug_slo_section(self):
+        from types import SimpleNamespace
+
+        from koordinator_tpu.scheduler.services import debug_slo_body
+
+        snap = ClusterSnapshot(capacity=64)
+        sched = Scheduler(snap, shard_min_nodes=0)
+        assert sched.solver_shard_count == len(jax.devices())
+        report = sched.sharding_report()
+        assert report["active"] and report["mesh"]["nodes"] == 8
+        assert "cluster_state" in report["device_bytes_by_shard"]
+        assert len(report["device_bytes_by_shard"]["cluster_state"]) == 8
+        sched.slo_monitor = SimpleNamespace(report=lambda: {"slos": []})
+        body = debug_slo_body(sched)
+        assert body["sharding"]["solver_shard_count"] == 8
+        # mesh off => the report says so and the gauge path reads 1
+        single = Scheduler(ClusterSnapshot(capacity=64), mesh="off")
+        rep = single.sharding_report()
+        assert rep["solver_shard_count"] == 1 and rep["mesh"] is None
+
+    def test_solver_shard_count_gauge_set_per_round(self):
+        snap = ClusterSnapshot(capacity=64)
+        snap.upsert_node(NodeSpec(
+            name="n0", allocatable=resource_vector(cpu=10_000,
+                                                   memory=10_000)))
+        sched = Scheduler(snap, batch_solver_threshold=1,
+                          shard_min_nodes=0)
+        sched.enqueue(PodSpec(
+            name="p0", requests=resource_vector(cpu=100, memory=64)))
+        sched.schedule_round()
+        assert metrics.solver_shard_count.value() == float(
+            len(jax.devices()))
+        # per-shard byte rows carry the shard label
+        assert any("shard" in labels
+                   for labels, _ in metrics.solver_device_bytes.items())

@@ -26,6 +26,7 @@ from tools.koordlint.analyzers.donation_safety import DonationSafetyAnalyzer
 from tools.koordlint.analyzers.jit_host_sync import JitHostSyncAnalyzer
 from tools.koordlint.analyzers.lock_discipline import LockDisciplineAnalyzer
 from tools.koordlint.analyzers.marker_audit import MarkerAuditAnalyzer
+from tools.koordlint.analyzers.mesh_discipline import MeshDisciplineAnalyzer
 from tools.koordlint.analyzers.surface_parity import SurfaceParityAnalyzer
 from tools.koordlint.analyzers import dashboard_drift
 from tools.koordlint.core import Project, apply_suppressions, load_baseline
@@ -98,6 +99,28 @@ class TestLockDisciplineCorpus:
         # one-directional nesting not a cycle
         assert LockDisciplineAnalyzer(package="pkg").run(
             corpus("lock_discipline", "good", ("pkg",))) == []
+
+
+class TestMeshDisciplineCorpus:
+    def analyzer(self):
+        return MeshDisciplineAnalyzer(package="pkg",
+                                      capacity_home=("pkg/ops.py",))
+
+    def test_bad_corpus_flags_every_seeded_violation(self):
+        findings = self.analyzer().run(
+            corpus("mesh_discipline", "bad", ("pkg",)))
+        messages = "\n".join(f.message for f in findings)
+        assert "omits in_specs and out_specs" in messages
+        # BOTH donated-position gaps: missing entry and explicit None
+        assert messages.count("has no explicit in_spec") == 2
+        assert "raw check_node_capacity call outside" in messages
+        assert len(findings) == 4
+
+    def test_good_corpus_is_clean(self):
+        # explicit specs everywhere, donated positions covered, the
+        # capacity guard only inside its owning module
+        assert self.analyzer().run(
+            corpus("mesh_discipline", "good", ("pkg",))) == []
 
 
 class TestSurfaceParityCorpus:

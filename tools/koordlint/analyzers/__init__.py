@@ -8,6 +8,7 @@ from .donation_safety import DonationSafetyAnalyzer
 from .jit_host_sync import JitHostSyncAnalyzer
 from .lock_discipline import LockDisciplineAnalyzer
 from .marker_audit import MarkerAuditAnalyzer
+from .mesh_discipline import MeshDisciplineAnalyzer
 from .surface_parity import SurfaceParityAnalyzer
 
 ALL_ANALYZERS = (
@@ -17,6 +18,7 @@ ALL_ANALYZERS = (
     SurfaceParityAnalyzer,
     DashboardDriftAnalyzer,
     MarkerAuditAnalyzer,
+    MeshDisciplineAnalyzer,
 )
 
 

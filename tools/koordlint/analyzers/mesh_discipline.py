@@ -1,0 +1,157 @@
+"""mesh-discipline: shard_map/pjit spec hygiene + capacity-guard locality.
+
+Two invariants of the sharded solve path (ISSUE 10):
+
+- **explicit specs at every shard_map/pjit site.**  A ``shard_map``
+  without explicit ``in_specs``/``out_specs`` (or a ``pjit`` without
+  ``in_shardings``/``out_shardings``) leaves placement to inference —
+  exactly the ambiguity that silently turns an in-place donated update
+  into a cross-device reshard-and-copy.  Additionally, when such a site
+  is wrapped DIRECTLY in a donating ``jax.jit(..., donate_argnums=...)``,
+  every donated position must have an explicit, non-``None`` entry in a
+  literal ``in_specs`` tuple: a donated buffer whose spec is inferred
+  can legally come back with a different layout, and the aliasing
+  quietly degrades to a copy.
+- **the node-capacity guard lives in one place.**  A raw
+  ``check_node_capacity`` call outside ``ops/batch_assign.py`` is a
+  finding: the ranking-key ceiling is enforced inside the key
+  computation itself (``_rank_parts``), and scattered re-guards drift
+  when the ceiling moves (the 32,768 wall removed by ISSUE 10 was
+  exactly such a constant).  The rule scopes to the package — tests
+  asserting the guard's behavior are exempt by path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import Analyzer, Finding, Project
+
+#: callables treated as SPMD entry sites, with their spec kwarg names
+_SPMD_SITES = {
+    "shard_map": ("in_specs", "out_specs"),
+    "pjit": ("in_shardings", "out_shardings"),
+}
+
+
+def _tail_name(node: ast.expr) -> Optional[str]:
+    """'shard_map' for both ``shard_map(...)`` and ``x.y.shard_map(...)``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _literal_ints(node: ast.expr) -> Optional[list[int]]:
+    """[0, 1] from a literal int tuple/list/constant, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
+class MeshDisciplineAnalyzer(Analyzer):
+    name = "mesh-discipline"
+    description = ("shard_map/pjit sites must declare in/out specs "
+                   "(explicit per donated argument); the node-capacity "
+                   "guard stays in ops/batch_assign")
+
+    #: module that OWNS check_node_capacity (calls there are the guard
+    #: itself, not a re-guard)
+    def __init__(self, package: str = "koordinator_tpu",
+                 capacity_home: tuple[str, ...] = (
+                     "koordinator_tpu/ops/batch_assign.py",)):
+        self.package = package
+        self.capacity_home = capacity_home
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for path, sf in sorted(project.files.items()):
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _tail_name(node.func)
+                if callee in _SPMD_SITES:
+                    findings.extend(self._check_specs(path, node, callee))
+                elif callee == "jit":
+                    findings.extend(self._check_donated(path, node))
+                elif (callee == "check_node_capacity"
+                      and path.startswith(self.package + "/")
+                      and path not in self.capacity_home):
+                    findings.append(Finding(
+                        self.name, path, node.lineno,
+                        "raw check_node_capacity call outside "
+                        "ops/batch_assign: the ranking-key ceiling is "
+                        "enforced inside the key computation "
+                        "(_rank_parts) and scattered re-guards drift "
+                        "when the ceiling moves",
+                        hint="call the select/refresh entry points and "
+                             "let batch_assign own the guard"))
+        return findings
+
+    def _check_specs(self, path: str, call: ast.Call,
+                     callee: str) -> list[Finding]:
+        in_name, out_name = _SPMD_SITES[callee]
+        missing = [name for name in (in_name, out_name)
+                   if _kw(call, name) is None]
+        if not missing:
+            return []
+        return [Finding(
+            self.name, path, call.lineno,
+            f"{callee} site omits {' and '.join(missing)}: placement "
+            "left to inference can silently reshard (and break donation "
+            "aliasing) instead of running the declared layout",
+            hint=f"declare {in_name}= and {out_name}= explicitly at "
+                 "every SPMD entry")]
+
+    def _check_donated(self, path: str, call: ast.Call) -> list[Finding]:
+        """jax.jit(shard_map(...), donate_argnums=...) sites: every
+        donated position needs an explicit non-None in_specs entry."""
+        donate = _kw(call, "donate_argnums")
+        if donate is None or not call.args:
+            return []
+        inner = call.args[0]
+        if not (isinstance(inner, ast.Call)
+                and _tail_name(inner.func) in _SPMD_SITES):
+            return []
+        in_name = _SPMD_SITES[_tail_name(inner.func)][0]
+        specs = _kw(inner, in_name)
+        donated = _literal_ints(donate)
+        if donated is None:
+            return []
+        if not isinstance(specs, (ast.Tuple, ast.List)):
+            # absent in_specs is already a finding from _check_specs; a
+            # non-literal spec expression is unverifiable here
+            return []
+        findings = []
+        for pos in donated:
+            spec = (specs.elts[pos] if 0 <= pos < len(specs.elts)
+                    else None)
+            if spec is None or (isinstance(spec, ast.Constant)
+                                and spec.value is None):
+                findings.append(Finding(
+                    self.name, path, call.lineno,
+                    f"donated argument {pos} has no explicit in_spec: "
+                    "an inferred layout can come back different and "
+                    "silently degrade the in-place donation to a copy",
+                    hint=f"give {in_name} a literal entry (e.g. "
+                         "P('nodes')) for every donated position"))
+        return findings

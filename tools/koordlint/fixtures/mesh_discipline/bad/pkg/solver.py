@@ -1,0 +1,35 @@
+"""Seeded mesh-discipline violations (must-flag corpus)."""
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from pkg.ops import check_node_capacity
+
+
+def no_specs(mesh, f, x):
+    # BAD: placement left to inference — no in_specs/out_specs
+    return shard_map(f, mesh=mesh)(x)
+
+
+def donated_without_spec(mesh, f, state, pods):
+    # BAD: position 1 is donated but in_specs has no entry for it
+    fn = jax.jit(
+        shard_map(f, mesh=mesh, in_specs=(P("nodes"),),
+                  out_specs=P("nodes")),
+        donate_argnums=(1,))
+    return fn(state, pods)
+
+
+def donated_none_spec(mesh, f, state):
+    # BAD: the donated position's spec is an explicit None (inferred)
+    fn = jax.jit(
+        shard_map(f, mesh=mesh, in_specs=(None,), out_specs=P("nodes")),
+        donate_argnums=(0,))
+    return fn(state)
+
+
+def reguarded_capacity(n):
+    # BAD: the ceiling guard belongs to ops/batch_assign, not callers
+    check_node_capacity(n)
+    return n

@@ -1,0 +1,11 @@
+"""The module that OWNS the capacity guard (exempt by path config)."""
+
+
+def check_node_capacity(n):
+    if n > 1 << 30:
+        raise ValueError("ceiling")
+
+
+def select_candidates(state, pods, cfg):
+    check_node_capacity(state.capacity)
+    return state, pods

@@ -1,0 +1,28 @@
+"""Mesh-disciplined twins of the bad corpus (must-pass)."""
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from pkg.ops import select_candidates
+
+
+def full_specs(mesh, f, x):
+    # explicit placement for every argument and output
+    return shard_map(f, mesh=mesh, in_specs=(P("nodes"),),
+                     out_specs=P("nodes"))(x)
+
+
+def donated_with_specs(mesh, f, state, pods):
+    # every donated position carries a literal spec entry
+    fn = jax.jit(
+        shard_map(f, mesh=mesh, in_specs=(P("nodes"), P()),
+                  out_specs=(P(), P("nodes"))),
+        donate_argnums=(0,))
+    return fn(state, pods)
+
+
+def guarded_by_the_owner(state, pods, cfg):
+    # capacity enforcement rides inside the selection entry point —
+    # callers never re-guard
+    return select_candidates(state, pods, cfg)

@@ -34,15 +34,27 @@ while true; do
     # (no_devices_enumerated / probe_kernel_hung / transfer_stall /
     # probe_error) so probe.log records a diagnosis per ROADMAP item 1,
     # not four rounds of undifferentiated "tunnel down"
+    # the probe also reports the device count (ISSUE 10): a sharded
+    # capture on a multichip window must be distinguishable from the
+    # single-chip tunnel in the published perf trajectory — printed by
+    # the SAME process (jax is already initialized there; a second
+    # python would burn up to 2 min of the capture window re-acquiring
+    # the runtime)
     kind=$(timeout 200 python -c 'import sys
 sys.path.insert(0, "/root/repo")
 from bench import _device_alive
 ok, kind, err = _device_alive(150.0)
-print("ok" if ok else kind)' 2>/dev/null | tail -1)
+if ok:
+    import jax
+    print(f"ok {len(jax.devices())}")
+else:
+    print(kind)' 2>/dev/null | tail -1)
     [ -z "$kind" ] && kind=probe_process_hung
+    case "$kind" in ok\ *) ndev=${kind#ok }; kind=ok;; *) ndev=unknown;; esac
     if [ "$kind" = "ok" ]; then
         ts=$(date +%Y%m%d_%H%M%S)
-        echo "$(date -Is) tunnel up, capturing" >> "$OUT/probe.log"
+        echo "$(date -Is) tunnel up (n_devices=${ndev}), capturing" \
+            >> "$OUT/probe.log"
         # NO_PROBE_PROMOTION: this run must produce a FRESH measurement
         # or a zero that keeps the hunt alive — a promoted old capture
         # here would satisfy the nonzero grep below and end the hunt
