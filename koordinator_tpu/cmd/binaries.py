@@ -585,6 +585,22 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
              "are fitted over this much history and classified "
              "steady/drifting/leaking (?window=N overrides per request)")
     parser.add_argument(
+        "--tenants", type=int, default=1,
+        help="multiplex N clusters onto this scheduler's mesh "
+             "(scheduler/tenancy.py): each tenant gets its own "
+             "snapshot/quota/degraded state and sync binding (extra "
+             "tenants listen at <listen-socket>.<tenant>), all sharing "
+             "ONE compiled solver; rounds run as pipelined (or "
+             "tenant-axis batched) cycles with weighted-fair admission")
+    parser.add_argument(
+        "--tenant-weights", default="",
+        help="comma-separated weighted-fair admission weights, one per "
+             "tenant (short lists pad with 1.0)")
+    parser.add_argument(
+        "--tenant-cycle-pod-budget", type=int, default=4096,
+        help="pods admitted per multi-tenant cycle across all tenants "
+             "(the weighted deficit-round-robin quantum)")
+    parser.add_argument(
         "--enable-profile-endpoint", action="store_true",
         help="arm /debug/profile?seconds=N (on-demand jax.profiler "
              "capture); OFF by default — the endpoint answers 403 "
@@ -638,18 +654,13 @@ def main_koord_scheduler(argv: list[str],
             "preemption enabled (flag or config) but no eviction "
             "transport wired: pass preempt_fn to main_koord_scheduler — "
             "nominating victims without evicting them double-books nodes")
-    scheduler = Scheduler(
-        snapshot,
+    sched_kwargs = dict(
         config=component_config.scoring,
         gang_passes=args.gang_passes,
         gang_default_timeout_sec=component_config.gang_default_timeout_sec,
         batch_solver_threshold=args.batch_solver_threshold,
         enable_preemption=enable_preemption,
         preempt_fn=preempt_fn,
-        explanations=ExplanationStore(),
-        auditor=WorkloadAuditor(),
-        cpu_manager=CPUManager(),
-        device_manager=DeviceManager(),
         elector=elector,
         staleness_threshold_sec=(args.staleness_threshold_seconds
                                  if args.staleness_threshold_seconds > 0
@@ -658,21 +669,82 @@ def main_koord_scheduler(argv: list[str],
         explain=not args.no_explain,
         flight_ring_size=args.flight_ring_size,
     )
+    tenant_front = None
+    if args.tenants > 1:
+        # multi-tenant assembly (ISSUE 11): one TenantScheduler front
+        # multiplexes N per-tenant Schedulers — each with its OWN
+        # explanation store / auditor / fine-grained managers — onto one
+        # shared SolverKit.  Leadership gates the WHOLE cycle at the
+        # front (a standby must not decide for any tenant), so the
+        # per-tenant schedulers run ungated.
+        from koordinator_tpu.scheduler.tenancy import (
+            TenantScheduler,
+            TenantSpec,
+        )
+
+        # positions matter: an empty item (trailing/doubled comma) must
+        # fail LOUDLY, not silently shift later tenants' weights; short
+        # lists pad with 1.0, longer-than---tenants lists are an error
+        weights = ([float(w) for w in args.tenant_weights.split(",")]
+                   if args.tenant_weights.strip() else [])
+        if len(weights) > args.tenants:
+            raise SystemExit(
+                f"--tenant-weights names {len(weights)} weights for "
+                f"--tenants {args.tenants}")
+        tenant_front = TenantScheduler(
+            cycle_pod_budget=args.tenant_cycle_pod_budget)
+        tenant_front.elector = elector
+        for i in range(args.tenants):
+            kw = dict(sched_kwargs)
+            kw.update(elector=None,
+                      explanations=ExplanationStore(),
+                      auditor=WorkloadAuditor(),
+                      cpu_manager=CPUManager(),
+                      device_manager=DeviceManager())
+            if i == 0:
+                kw["snapshot"] = snapshot
+            tenant_front.add_tenant(
+                TenantSpec(name=f"t{i}",
+                           weight=(weights[i] if i < len(weights)
+                                   else 1.0),
+                           node_capacity=args.node_capacity), **kw)
+        scheduler = tenant_front.primary
+    else:
+        scheduler = Scheduler(
+            snapshot,
+            explanations=ExplanationStore(),
+            auditor=WorkloadAuditor(),
+            cpu_manager=CPUManager(),
+            device_manager=DeviceManager(),
+            **sched_kwargs,
+        )
     # -- self-observability: SLO burn-rate engine + solver introspection
     from koordinator_tpu.ops.introspection import ProfilerCapture
-    from koordinator_tpu.slo_monitor import SloMonitor, default_specs
+    from koordinator_tpu.slo_monitor import (
+        SloMonitor,
+        default_specs,
+        tenant_slo_specs,
+    )
     from koordinator_tpu.trend import TrendEngine
 
     # self-telemetry rides the SLO sampler (every sweep — background OR
     # on-demand /debug/slo//debug/steady — refreshes RSS/fds/threads
     # first), so the scheduler needs no second sampling thread
     telemetry = build_self_telemetry(args, "koord-scheduler")
+    slo_specs = default_specs(
+        latency_threshold_s=args.slo_latency_threshold_seconds,
+        staleness_threshold_s=(args.staleness_threshold_seconds
+                               if args.staleness_threshold_seconds > 0
+                               else 30.0))
+    if tenant_front is not None:
+        # per-tenant p99 specs slice the shared latency histogram by
+        # its {tenant=...} label, so one tenant's breach pages AS that
+        # tenant instead of diluting into the global p99
+        slo_specs += tenant_slo_specs(
+            [t.name for t in tenant_front.tenants()],
+            latency_threshold_s=args.slo_latency_threshold_seconds)
     slo_monitor = SloMonitor(
-        specs=default_specs(
-            latency_threshold_s=args.slo_latency_threshold_seconds,
-            staleness_threshold_s=(args.staleness_threshold_seconds
-                                   if args.staleness_threshold_seconds > 0
-                                   else 30.0)),
+        specs=slo_specs,
         sample_interval_s=(args.slo_sample_interval_seconds
                            if args.slo_sample_interval_seconds > 0 else 5.0),
         # a fast-burn breach dumps the latest round's flight record with
@@ -686,6 +758,9 @@ def main_koord_scheduler(argv: list[str],
     # sampling pass feeds burn rates AND the long-horizon leak watch
     scheduler.trend_engine = TrendEngine(
         slo_monitor.cache, window_s=args.trend_window_seconds)
+    if tenant_front is not None:
+        tenant_front.slo_monitor = slo_monitor
+        tenant_front.trend_engine = scheduler.trend_engine
     if args.slo_sample_interval_seconds > 0:
         slo_monitor.start()   # stopped via Assembled.stop -> Scheduler.stop
     if args.enable_profile_endpoint:
@@ -707,6 +782,16 @@ def main_koord_scheduler(argv: list[str],
 
         sync_service = StateSyncService()
         sync_service.attach_binding(SchedulerBinding(scheduler))
+        if tenant_front is not None:
+            # per-tenant sync bindings: every EXTRA tenant gets its own
+            # StateSyncService (its informer feed, its staleness clock —
+            # isolation is per feed) served on its own socket below;
+            # the primary tenant rides the main socket/gateway
+            tenant_front.tenant_syncs = {}
+            for t in tenant_front.tenants()[1:]:
+                svc = StateSyncService()
+                svc.attach_binding(SchedulerBinding(t.scheduler))
+                tenant_front.tenant_syncs[t.name] = svc
     # the lease surface (frames + HTTP) must share the elector's store:
     # a private store would let a remote contender "acquire" a lease the
     # local elector also holds in the real one — split-brain
@@ -722,10 +807,21 @@ def main_koord_scheduler(argv: list[str],
         from koordinator_tpu.transport.services import SolveService
 
         server = RpcServer(args.listen_socket, service="scheduler")
-        SolveService(scheduler).attach(server)
+        # a multi-tenant assembly solves CYCLES: the solve frame drives
+        # the front-end (weighted admission + pipelined/batched rounds
+        # across every tenant), not one tenant's round
+        SolveService(tenant_front if tenant_front is not None
+                     else scheduler).attach(server)
         sync_service.attach(server)
         LeaseService(store=shared_lease_store).attach(server)
         server.start()
+        if tenant_front is not None:
+            for name, svc in tenant_front.tenant_syncs.items():
+                extra = RpcServer(f"{args.listen_socket}.{name}",
+                                  service="scheduler")
+                svc.attach(extra)
+                extra.start()
+                tenant_front.closers.append(extra.stop)
     gateway = None
     if args.http_port is not None:
         from koordinator_tpu.transport.http_gateway import HttpGateway
@@ -735,7 +831,9 @@ def main_koord_scheduler(argv: list[str],
                               lease_store=shared_lease_store)
         gateway.start()
     return Assembled(name="koord-scheduler", args=args,
-                     component=scheduler, elector=elector, server=server,
+                     component=(tenant_front if tenant_front is not None
+                                else scheduler),
+                     elector=elector, server=server,
                      gateway=gateway, state_sync=sync_service,
                      component_config=component_config,
                      telemetry=telemetry)

@@ -477,6 +477,39 @@ trend_slope_per_hour = SCHEDULER.gauge(
     "Fitted windowed slope per watched series, scaled to units/hour "
     "(labels: series plus the series' own labels)")
 
+# -- multi-tenant round pipeline (scheduler/tenancy.py, ISSUE 11) --
+tenant_count = SCHEDULER.gauge(
+    "tenant_count",
+    "Clusters multiplexed onto this scheduler's mesh by the tenancy "
+    "front-end (0 = single-tenant scheduler, no front-end attached)")
+tenant_admission_share = SCHEDULER.gauge(
+    "tenant_admission_share",
+    "Observed share of the last cycle's admitted pods per tenant "
+    "(label: tenant) — under sustained overload this converges to the "
+    "tenant's weight fraction (weighted deficit-round-robin admission)")
+tenant_admitted = SCHEDULER.counter(
+    "tenant_pods_admitted_total",
+    "Pods admitted into solve rounds by the weighted-fair admission "
+    "gate, per tenant (label: tenant); rate ratios between tenants are "
+    "the fairness observable")
+tenant_cycles = SCHEDULER.counter(
+    "tenant_cycles_total",
+    "Multi-tenant scheduling cycles by dispatch mode (label: "
+    "mode=pipelined|batched|serial) — batched means one tenant-axis "
+    "vmapped program solved every tenant, pipelined that per-tenant "
+    "device solves overlapped host commits, serial the fallback")
+tenant_cycle_latency = SCHEDULER.histogram(
+    "tenant_cycle_duration_seconds",
+    "Wall time of one multi-tenant scheduling cycle (every tenant's "
+    "round, device and host halves)")
+pipeline_host_wait_fraction = SCHEDULER.gauge(
+    "pipeline_host_wait_fraction",
+    "Share of the last cycle's wall the host spent BLOCKED on device "
+    "solve results (sum of block waits / cycle wall).  Serial "
+    "single-tenant-at-a-time operation pins this near the device's "
+    "share of the round; the pipelined overlap drives it toward zero "
+    "because solves execute while other tenants' commits run")
+
 # -- bench probe arming (bench_prober.py, ROADMAP item 1) --
 bench_probe_attempts = SCHEDULER.counter(
     "bench_probe_attempts_total",

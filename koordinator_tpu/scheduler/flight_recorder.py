@@ -56,6 +56,13 @@ class RoundRecord:
     #: dump then answers "slow doing WHAT" and "failing WHY" in one line
     top_unschedulable: dict[str, int] = dataclasses.field(
         default_factory=dict)
+    #: tenancy attribution (ISSUE 11): which tenant's round this record
+    #: covers ("" = untenanted scheduler), and which pipeline half —
+    #: "round" for a serial round, "solve"/"commit" for the two records
+    #: a pipelined round leaves, so /debug/rounds and soak_report
+    #: attribute a slow half to a tenant
+    tenant: str = ""
+    half: str = "round"
     dump_reason: Optional[str] = None   # slow | degraded when dumped
 
     def to_doc(self) -> dict:

@@ -36,6 +36,10 @@ class SchedulerMonitor:
         #: per-phase wall times of the round in flight (reset by
         #: start_round; the flight recorder snapshots it at round end)
         self.round_timings: dict[str, float] = {}
+        #: tenancy identity (ISSUE 11): when set, every phase
+        #: observation additionally carries a {tenant=...} label so the
+        #: per-tenant p99 SLO and dashboards can slice one histogram
+        self.tenant = ""
 
     def start_round(self) -> None:
         """Reset the per-round phase accumulator (called by the
@@ -43,7 +47,13 @@ class SchedulerMonitor:
         self.round_timings = {}
 
     @contextlib.contextmanager
-    def phase(self, name: str):
+    def phase(self, name: str, carry_s: float = 0.0):
+        """``carry_s`` folds wall time measured OUTSIDE this context
+        into the phase's one observation — the pipelined round split
+        times the solve dispatch in the device half and carries it into
+        the host half's "Solve" phase, so a round still produces exactly
+        one Solve observation (the SLO engine's per-observation bad
+        fractions must not dilute)."""
         # phase spans only under an active trace (the scheduler's round
         # span): standalone monitor users pay nothing, traced rounds get
         # one child span per phase
@@ -55,7 +65,7 @@ class SchedulerMonitor:
             with span_cm:
                 yield
         finally:
-            elapsed = self.clock() - start
+            elapsed = self.clock() - start + carry_s
             self.phase_history[name].append(elapsed)
             self.round_timings[name] = (
                 self.round_timings.get(name, 0.0) + elapsed)
@@ -64,8 +74,11 @@ class SchedulerMonitor:
             # the exemplar links this observation to the round's trace
             exemplar = ({"trace_id": ctx.trace_id} if ctx is not None
                         else None)
+            labels = {"phase": name}
+            if self.tenant:
+                labels["tenant"] = self.tenant
             metrics.scheduling_latency.observe(
-                elapsed, labels={"phase": name}, exemplar=exemplar)
+                elapsed, labels=labels, exemplar=exemplar)
             if name == "Solve":
                 metrics.solver_batch_latency.observe(
                     elapsed, exemplar=exemplar)

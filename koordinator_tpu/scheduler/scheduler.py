@@ -30,7 +30,7 @@ import numpy as np
 
 from koordinator_tpu import metrics, tracing
 from koordinator_tpu.ops.assignment import ScoringConfig
-from koordinator_tpu.ops.gang import GangInfo, gang_assign
+from koordinator_tpu.ops.gang import GangInfo
 from koordinator_tpu.ops.network_topology import (
     TopologyArrays,
     TopologyRequirements,
@@ -118,6 +118,39 @@ class SchedulingResult:
     )
 
 
+@dataclasses.dataclass
+class RoundHandle:
+    """An in-flight round between its device and host halves (ISSUE 11).
+
+    ``round_device`` returns one after DISPATCHING the solve; nothing in
+    it has been blocked on.  ``assignments``/``new_state``/``new_quota``
+    are in-flight device arrays — the dispatched solve DONATED the
+    previous ``snapshot.state`` buffers and the snapshot was re-pointed
+    at ``new_state`` before dispatch returned (the blessed swap), so the
+    pre-dispatch buffers are dead and must never be stashed on a handle.
+    The handle is only valid under the same ``scheduler.lock`` hold that
+    produced it."""
+
+    result: SchedulingResult
+    #: the round finished entirely in the device half (elector/barrier
+    #: gated, or an empty active queue) — round_host returns immediately
+    done: bool = False
+    now: float = 0.0
+    pods: list = dataclasses.field(default_factory=list)
+    batch: PodBatch | None = None
+    gangs: GangInfo | None = None
+    gang_index: dict = dataclasses.field(default_factory=dict)
+    quota: object = None                 # post-prepass device quota
+    solver: str = "greedy"
+    assignments: object = None           # in-flight device array
+    new_state: object = None             # in-flight donated-swap state
+    new_quota: object = None
+    #: incremental-path finish context (None = full/greedy path)
+    inc: dict | None = None
+    start_wall: float = 0.0
+    t0: float = 0.0
+
+
 class Scheduler:
     """Batched scheduler over a ClusterSnapshot."""
 
@@ -152,12 +185,24 @@ class Scheduler:
         flight_ring_size: int = 256,
         mesh="auto",
         shard_min_nodes: int = 1024,
+        tenant: str = "",
+        solver_kit=None,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
         self.quota_tree = quota_tree
         self.bind_fn = bind_fn
+        #: tenancy identity (ISSUE 11): when set, this scheduler is one
+        #: tenant of a TenantScheduler — per-tenant labels ride every
+        #: scheduler metric, flight records stamp the tenant, and the
+        #: front-end back-reference serves /debug/tenants
+        self.tenant = tenant
+        #: TenantScheduler back-reference (set by tenancy.add_tenant) so
+        #: a per-tenant debug surface can serve the shared rollup
+        self.tenant_front = None
         self.monitor = monitor or SchedulerMonitor()
+        if tenant:
+            self.monitor.tenant = tenant
         self.gang_passes = gang_passes
         #: CoschedulingArgs.DefaultTimeout: WaitTime for gangs that don't
         #: set their own
@@ -201,55 +246,27 @@ class Scheduler:
         #: host-side arrays of the last batch build, for row-level reuse
         #: when the queue changes incrementally (see _build_batch)
         self._batch_host: dict | None = None
-        # solve-state donation: the caller's self.snapshot.state is dead
-        # the moment the call starts (XLA updates the (N, R) accounting
-        # in place) and is replaced wholesale by adopt_state right after.
-        # Every jitted entry point is wrapped for recompile accounting
-        # (ops/introspection): a cache miss lands in
-        # solver_recompiles_total{fn, shape} so a shape-churn regression
-        # is a dashboard line, not a mystery latency spike.
-        from koordinator_tpu.ops import introspection as insp
+        # -- the shared solver kit (ISSUE 11) --
+        # every jitted entry point lives in a SolverKit (solve mesh
+        # included): a standalone scheduler builds its own, a tenant of
+        # a TenantScheduler is handed the front-end's shared kit so T
+        # tenants multiplex onto ONE compiled solver (one jit cache, one
+        # recompile ledger) instead of compiling T copies.
+        from koordinator_tpu.scheduler.solver_kit import SolverKit
 
-        # -- sharded-by-default solve mesh (ISSUE 10) --
-        # the node axis of the batch solve shards over every visible
-        # device (parallel/sharded.py shard_map kernels); tiny clusters
-        # stay single-device — sharding a 64-node problem is pure
-        # collective overhead — via the min-nodes floor
-        # (KOORD_SOLVER_MESH_MIN_NODES / shard_min_nodes).
-        import os as _os
-
-        from koordinator_tpu.parallel import mesh as pmesh
-        from koordinator_tpu.parallel import sharded as psharded
-
-        self.mesh = pmesh.resolve_solver_mesh(mesh)
-        self.shard_min_nodes = int(_os.environ.get(
-            "KOORD_SOLVER_MESH_MIN_NODES", shard_min_nodes))
-        self.solver_shard_count = pmesh.nodes_shard_count(self.mesh)
+        self.kit = (solver_kit if solver_kit is not None
+                    else SolverKit(mesh=mesh,
+                                   shard_min_nodes=shard_min_nodes))
+        self.mesh = self.kit.mesh
+        self.shard_min_nodes = self.kit.shard_min_nodes
+        self.solver_shard_count = self.kit.shards
         if self.mesh is not None:
             self.snapshot.set_solver_sharding(
-                pmesh.node_sharding(self.mesh), self.solver_shard_count,
+                self.kit.node_sharding, self.solver_shard_count,
                 min_nodes=self.shard_min_nodes)
-        #: recompile accounting buckets carry the mesh shape so a
-        #: per-mesh-shape compile regression is its own dashboard line;
-        #: evaluated per call — below the min-nodes floor the solve runs
-        #: single-device and the bucket stays unsuffixed
-        def _sfx():
-            return (f"@{self.solver_shard_count}shard"
-                    if (self.mesh is not None
-                        and self.snapshot.solver_sharding_active) else "")
-
-        def _pn(args, kwargs):
-            return f"P{args[1].capacity}xN{args[0].capacity}{_sfx()}"
-
-        self._solve = insp.instrument(
-            jax.jit(gang_assign,
-                    static_argnames=("passes", "solver"),
-                    donate_argnums=(0,)),
-            "gang_assign", shape_of=_pn)
+        self._solve = self.kit.solve
 
         # -- incremental delta-driven solve (no-gang batch rounds) --
-        from koordinator_tpu.ops import batch_assign as _ba
-
         #: steady-state rounds refresh a device-resident (P, k) candidate
         #: cache against the dirty-node/pod delta instead of re-selecting
         #: over the whole (P, N) problem; falls back to the full pass when
@@ -272,87 +289,34 @@ class Scheduler:
         #: its candidate tie-break rotation when the queue shifts around it
         self._rot_ids: dict[str, int] = {}
         self._rot_counter = 0
-        self._select_scored = insp.instrument(
-            jax.jit(_ba.select_candidates,
-                    static_argnames=("k", "spread_bits", "method",
-                                     "with_scores")),
-            "select_candidates", shape_of=_pn)
-        self._align_cands = insp.instrument(
-            jax.jit(_ba.align_candidate_cache),
-            "align_candidate_cache",
-            shape_of=lambda a, k: (f"P{a[1].shape[0]}xN{a[3].shape[0]}"))
-        self._refresh_cands = insp.instrument(
-            jax.jit(_ba.refresh_candidates,
-                    static_argnames=("k", "spread_bits"),
-                    donate_argnums=(3,)),
-            "refresh_candidates",
-            shape_of=lambda a, k: (f"P{a[1].capacity}xN{a[0].capacity}"
-                                   f"xD{a[4].shape[0]}"))
-        self._scatter_cands = insp.instrument(
-            jax.jit(_ba.scatter_candidate_rows, donate_argnums=(0,)),
-            "scatter_candidate_rows",
-            shape_of=lambda a, k: (f"P{a[0].cand_key.shape[0]}"
-                                   f"xS{a[1].shape[0]}"))
-        self._pass1 = insp.instrument(
-            jax.jit(_ba.assign_round_pass,
-                    static_argnames=("rounds",),
-                    donate_argnums=(0,)),
-            "assign_round_pass", shape_of=_pn)
-        self._pass2 = insp.instrument(
-            jax.jit(_ba.assign_followup_pass,
-                    static_argnames=("k", "rounds", "spread_bits",
-                                     "method"),
-                    donate_argnums=(0, 1)),
-            "assign_followup_pass",
-            shape_of=lambda a, k: f"P{a[2].capacity}xN{a[0].capacity}")
-        # sharded twins of the batch-solve entries (selection is
-        # recall-exact on the mesh; acceptance is bit-identical to the
-        # single-device entries above — parallel/sharded.py).  Donation
-        # mirrors the unsharded bindings: the state (and the refresh's
-        # cache) updates in place under its NamedSharding placement.
-        self._select_scored_sh = self._refresh_cands_sh = None
-        self._pass1_sh = self._pass2_sh = None
-        if self.mesh is not None:
-            from functools import partial as _partial
-
-            self._select_scored_sh = insp.instrument(
-                jax.jit(_partial(psharded.sharded_select_candidates,
-                                 self.mesh),
-                        static_argnames=("k", "spread_bits",
-                                         "with_scores")),
-                "select_candidates", shape_of=_pn)
-            self._refresh_cands_sh = insp.instrument(
-                jax.jit(_partial(psharded.sharded_refresh_candidates,
-                                 self.mesh),
-                        static_argnames=("k", "spread_bits"),
-                        donate_argnums=(3,)),
-                "refresh_candidates",
-                shape_of=lambda a, k: (f"P{a[1].capacity}xN{a[0].capacity}"
-                                       f"xD{a[4].shape[0]}{_sfx()}"))
-            self._pass1_sh = insp.instrument(
-                jax.jit(_partial(psharded.sharded_assign_round_pass,
-                                 self.mesh),
-                        static_argnames=("rounds",),
-                        donate_argnums=(0,)),
-                "assign_round_pass", shape_of=_pn)
-            self._pass2_sh = insp.instrument(
-                jax.jit(_partial(psharded.sharded_assign_followup_pass,
-                                 self.mesh),
-                        static_argnames=("k", "rounds", "spread_bits"),
-                        donate_argnums=(0, 1)),
-                "assign_followup_pass",
-                shape_of=lambda a, k: (f"P{a[2].capacity}"
-                                       f"xN{a[0].capacity}{_sfx()}"))
+        self._select_scored = self.kit.select_scored
+        self._align_cands = self.kit.align_cands
+        self._refresh_cands = self.kit.refresh_cands
+        self._scatter_cands = self.kit.scatter_cands
+        self._pass1 = self.kit.pass1
+        self._pass2 = self.kit.pass2
+        self._select_scored_sh = self.kit.select_scored_sh
+        self._refresh_cands_sh = self.kit.refresh_cands_sh
+        self._pass1_sh = self.kit.pass1_sh
+        self._pass2_sh = self.kit.pass2_sh
+        #: per-round admission cap (tenancy weighted-fair admission sets
+        #: it per cycle; None = admit the whole active queue).  Applied
+        #: in priority order AFTER the PreEnqueue gates, so a capped
+        #: round still schedules the most important pods first.
+        self.round_pod_limit: int | None = None
+        #: pods held back by the cap in the last round (fairness surface)
+        self.last_overflow = 0
+        #: PodBatch capacity floor: the tenant-axis batched solve stacks
+        #: several tenants' batches on a leading axis, which needs every
+        #: tenant padded to the SAME pod bucket
+        self.batch_capacity_floor = 0
         #: reservation lifecycle (plugins/reservation parity): reserve-pods
         #: schedule through the normal rounds, Available sets get a
         #: reservation-first exact solve pre-pass
-        from koordinator_tpu.ops.reservation import reservation_greedy_assign
         from koordinator_tpu.scheduler.reservations import ReservationCache
 
         self.reservations = ReservationCache()
-        self._rsv_solve = insp.instrument(
-            jax.jit(reservation_greedy_assign, donate_argnums=(0,)),
-            "reservation_greedy_assign", shape_of=_pn)
+        self._rsv_solve = self.kit.rsv_solve
         #: fine-grained allocators (nodenumaresource / deviceshare Reserve):
         #: LSR/LSE pods take exclusive cpusets, device requests take minors
         #: at bind; annotation payloads surface in resource_status
@@ -392,12 +356,8 @@ class Scheduler:
         #: node INSTANCE each nomination's charge was assumed against
         #: (snapshot.node_generation at assume time)
         self._nomination_gen: dict[str, int] = {}
-        from koordinator_tpu.ops.preemption import preempt_chain, preempt_one
-
-        self._preempt = jax.jit(
-            preempt_one, static_argnames=("same_quota_only", "nominate")
-        )
-        self._preempt_chain = jax.jit(preempt_chain)
+        self._preempt = self.kit.preempt
+        self._preempt_chain = self.kit.preempt_chain
         #: bound on PostFilter work per round (mirror of rsv_prepass_cap):
         #: at most this many failed pods attempt preemption in one round —
         #: a quota-starved 50k queue must not turn PostFilter into 50k
@@ -456,38 +416,23 @@ class Scheduler:
         #: device-side share of the round's solve (time blocked on
         #: jitted results), accumulated across solve dispatches
         self._solve_device_s = 0.0
+        #: dispatch-half wall carried into the host half's single
+        #: "Solve" phase observation (pipelined round split, ISSUE 11)
+        self._solve_carry_s = 0.0
         self._last_dirty_node_frac = 0.0
         self._last_dirty_pod_frac = 0.0
         self._last_staleness_s: float | None = None
         self._round_recordable = False
 
         # -- placement explainability (ISSUE 6) --
-        from koordinator_tpu.ops import explain as _ex
         from koordinator_tpu.scheduler.explanation import ExplanationRing
 
         #: kill switch (--no-explain): when False the Diagnose phase
         #: falls back to the per-pod host recompute, no explanations are
         #: retained, and the unschedulability rollups stay silent
         self.explain = explain
-        #: device-side reject-reason reduction over the round's COMPACTED
-        #: failed rows — O(F·NUM_REASONS) host transfer, never (P, N)
-        self._explain_counts = insp.instrument(
-            jax.jit(_ex.explain_counts), "explain_counts", shape_of=_pn)
-        #: per-dim capacity-slack reduction ((N, R) -> two (R,) sums);
-        #: float32 accumulation — a 10k-node cluster's summed int32
-        #: quantities overflow int32, and a ratio gauge doesn't need
-        #: integer exactness
-        self._slack_sums = insp.instrument(
-            jax.jit(lambda st: (
-                jnp.sum(jnp.where(
-                    st.node_valid[:, None],
-                    st.node_allocatable - st.node_requested, 0
-                ).astype(jnp.float32), axis=0),
-                jnp.sum(jnp.where(
-                    st.node_valid[:, None], st.node_allocatable, 0
-                ).astype(jnp.float32), axis=0))),
-            "capacity_slack",
-            shape_of=lambda a, k: f"N{a[0].capacity}")
+        self._explain_counts = self.kit.explain_counts
+        self._slack_sums = self.kit.slack_sums
         #: bounded pod-keyed retention behind /debug/explain/<pod>
         self.explain_ring = ExplanationRing()
         #: {top reason -> pod count} rollup of the last round (flight
@@ -848,7 +793,7 @@ class Scheduler:
             # every still-pending pod, and re-counting the whole queue
             # would paint a phantom arrival spike on the dashboards
             if pod.name not in self.pending:
-                metrics.pods_enqueued_total.inc()
+                metrics.pods_enqueued_total.inc(labels=self._tl())
             self.pending[pod.name] = pod
             self._pending_rev += 1
             # the pod's trace starts (or joins) here: a propagated
@@ -914,7 +859,7 @@ class Scheduler:
             # watchdog disabled, or no feed has ever spoken (a scheduler
             # warming up has nothing to be stale RELATIVE to)
             return
-        metrics.state_staleness_seconds.set(age)
+        metrics.state_staleness_seconds.set(age, labels=self._tl())
         if not self.degraded and age > threshold:
             self.degraded = True
             self.degraded_since = now
@@ -922,9 +867,9 @@ class Scheduler:
             # the candidate cache was built from now-untrusted deltas;
             # degraded rounds solve full-pass and re-warm on exit
             self._cand_cache = None
-            metrics.degraded_mode.set(1.0)
+            metrics.degraded_mode.set(1.0, labels=self._tl())
             metrics.degraded_transitions_total.inc(
-                labels={"phase": "enter"})
+                labels={"phase": "enter", **(self._tl() or {})})
         elif self.degraded:
             exit_thr = (self.staleness_exit_sec
                         if self.staleness_exit_sec is not None
@@ -933,9 +878,9 @@ class Scheduler:
                 self.degraded = False
                 self.degraded_since = None
                 self._cand_cache = None
-                metrics.degraded_mode.set(0.0)
+                metrics.degraded_mode.set(0.0, labels=self._tl())
                 metrics.degraded_transitions_total.inc(
-                    labels={"phase": "exit"})
+                    labels={"phase": "exit", **(self._tl() or {})})
 
     def _suspended_while_degraded(self, pod: PodSpec) -> bool:
         """Admission suspended for this pod while degraded?  BE pods and
@@ -978,8 +923,19 @@ class Scheduler:
                 continue
             out.append(pod)
         self.last_suspended = suspended
-        metrics.degraded_suspended_pods.set(float(suspended))
+        metrics.degraded_suspended_pods.set(float(suspended),
+                                            labels=self._tl())
         out.sort(key=lambda p: (-p.priority, p.creation, p.name))
+        # weighted-fair admission cap (tenancy, ISSUE 11): a capped
+        # round admits only its share of the cycle's pod budget —
+        # highest-priority first, the overflow stays pending and is
+        # charged to nobody (it retries next cycle with fresh credits)
+        limit = self.round_pod_limit
+        if limit is not None and len(out) > max(limit, 0):
+            self.last_overflow = len(out) - max(limit, 0)
+            out = out[: max(limit, 0)]
+        else:
+            self.last_overflow = 0
         return out
 
     def _build_batch(self, pods: list[PodSpec], gang_index: dict[str, int],
@@ -1000,12 +956,16 @@ class Scheduler:
             tuple(sorted(quota_index.items())),
             self.snapshot.capacity,
             self.snapshot.class_count,
+            self.batch_capacity_floor,
         )
         if (not hinted and self._batch_cache is not None
                 and self._batch_cache[0] == key):
             return self._batch_cache[1]
         p = len(pods)
-        cap = _bucket(max(p, 1), minimum=16)
+        # the tenant-axis batched solve stacks several tenants' batches
+        # on a leading axis, so every tenant pads to the SAME bucket
+        # (batch_capacity_floor; 0 for a standalone scheduler)
+        cap = _bucket(max(p, self.batch_capacity_floor, 1), minimum=16)
         n_cap = self.snapshot.capacity
         requests = np.zeros((p, self.snapshot.dims), np.int32)
         priority = np.zeros(p, np.int32)
@@ -1227,6 +1187,58 @@ class Scheduler:
             selector_mask=None,
         )
 
+    def _tl(self) -> dict | None:
+        """Per-tenant metric labels; None for an untenanted scheduler so
+        its series (and every existing dashboard/test) are unchanged."""
+        return {"tenant": self.tenant} if self.tenant else None
+
+    def _round_begin(self) -> None:  # koordlint: guarded-by(self.lock)
+        """Reset the per-round accumulators (shared by the serial
+        schedule_round wrapper and the pipelined round_device entry)."""
+        self.round_seq += 1
+        self.monitor.start_round()
+        self._solve_device_s = 0.0
+        self._solve_carry_s = 0.0
+        self._last_dirty_node_frac = 0.0
+        self._last_dirty_pod_frac = 0.0
+        self._last_unschedulable_top = {}
+        self._round_recordable = False
+
+    def _current_path(self) -> str:
+        return (self.last_solve_path
+                if self.last_solver == "batch" else "greedy")
+
+    # koordlint: guarded-by(self.lock)
+    def _round_flight_record(self, result: SchedulingResult, trace_id: str,
+                             start_wall: float, duration: float,
+                             path: str, half: str) -> None:
+        from koordinator_tpu.scheduler.flight_recorder import RoundRecord
+
+        self.flight_recorder.record(RoundRecord(
+            round=self.round_seq,
+            trace_id=trace_id,
+            start_time=start_wall,
+            duration_s=duration,
+            solver=self.last_solver,
+            solve_path=path,
+            pods=result.round_pods,
+            placed=len(result.assignments),
+            failed=len(result.failures),
+            suspended=self.last_suspended,
+            degraded=self.degraded,
+            staleness_s=self._last_staleness_s,
+            dirty_node_frac=self._last_dirty_node_frac,
+            dirty_pod_frac=self._last_dirty_pod_frac,
+            solve_wall_s=self.monitor.round_timings.get(
+                "Solve", 0.0),
+            solve_device_s=self._solve_device_s,
+            phase_s=dict(self.monitor.round_timings),
+            sheds_total=metrics.solve_deadline_shed_total.value(),
+            top_unschedulable=dict(self._last_unschedulable_top),
+            tenant=self.tenant,
+            half=half,
+        ))
+
     def schedule_round(self) -> SchedulingResult:
         """Solve the current pending queue; reserve, bind, diagnose.
 
@@ -1234,26 +1246,25 @@ class Scheduler:
         the caller's trace when one rode the solve request) whose
         attributes double as the round's flight record; rounds that got
         past the elector/barrier gates land in the flight recorder ring
-        (``/debug/rounds``), slow/degraded ones dump automatically."""
-        from koordinator_tpu.scheduler.flight_recorder import RoundRecord
+        (``/debug/rounds``), slow/degraded ones dump automatically.
 
+        The round is internally split into an explicit DEVICE half
+        (:meth:`_round_device`: prelude, batch build, solve dispatch)
+        and HOST half (:meth:`_round_host`: block, rescue, commit,
+        diagnose); this serial wrapper runs them back to back under one
+        lock hold, while the tenancy front-end drives
+        :meth:`round_device`/:meth:`round_host` directly so round N+1's
+        device solve overlaps round N's host commit."""
         with self.lock:
-            self.round_seq += 1
-            self.monitor.start_round()
-            self._solve_device_s = 0.0
-            self._last_dirty_node_frac = 0.0
-            self._last_dirty_pod_frac = 0.0
-            self._last_unschedulable_top = {}
-            self._round_recordable = False
+            self._round_begin()
             start_wall = time.time()
             t0 = time.perf_counter()
             with tracing.TRACER.span(
                     "scheduler.round", service="scheduler",
                     attributes={"round": self.round_seq}) as span:
-                result = self._schedule_round()
+                result = self._round_host(self._round_device())
                 duration = time.perf_counter() - t0
-                path = (self.last_solve_path
-                        if self.last_solver == "batch" else "greedy")
+                path = self._current_path()
                 if not self._round_recordable:
                     # elector-standby / barrier-gated: last_solver and
                     # last_solve_path are STALE leftovers of the last
@@ -1277,104 +1288,171 @@ class Scheduler:
                         "solve_device_s": self._solve_device_s,
                     })
             if self._round_recordable:
-                self.flight_recorder.record(RoundRecord(
-                    round=self.round_seq,
-                    trace_id=span.trace_id,
-                    start_time=start_wall,
-                    duration_s=duration,
-                    solver=self.last_solver,
-                    solve_path=path,
-                    pods=result.round_pods,
-                    placed=len(result.assignments),
-                    failed=len(result.failures),
-                    suspended=self.last_suspended,
-                    degraded=self.degraded,
-                    staleness_s=self._last_staleness_s,
-                    dirty_node_frac=self._last_dirty_node_frac,
-                    dirty_pod_frac=self._last_dirty_pod_frac,
-                    solve_wall_s=self.monitor.round_timings.get(
-                        "Solve", 0.0),
-                    solve_device_s=self._solve_device_s,
-                    phase_s=dict(self.monitor.round_timings),
-                    sheds_total=metrics.solve_deadline_shed_total.value(),
-                    top_unschedulable=dict(self._last_unschedulable_top),
-                ))
+                self._round_flight_record(result, span.trace_id,
+                                          start_wall, duration, path,
+                                          half="round")
             if self._round_recordable:
-                # device-resident footprint of the persistent solver
-                # tensors, from array metadata only (no sync): the
-                # live-bytes half of the introspection surface
-                from koordinator_tpu.ops import introspection as insp
-
-                metrics.solver_device_bytes.set(
-                    float(insp.device_bytes(self.snapshot.state)),
-                    labels={"kind": "cluster_state"})
-                cand = self._cand_cache
-                metrics.solver_device_bytes.set(
-                    float(insp.device_bytes(
-                        cand["cache"] if cand else None)),
-                    labels={"kind": "candidate_cache"})
-                # sharded-solve introspection: the active nodes-axis
-                # width plus the per-device slice of each persistent
-                # tensor (a lopsided shard is a placement bug)
-                active_shards = (self.solver_shard_count
-                                 if (self.mesh is not None
-                                     and self.snapshot
-                                     .solver_sharding_active) else 1)
-                metrics.solver_shard_count.set(float(active_shards))
-                if active_shards > 1:
-                    for kind, tree in (
-                        ("cluster_state", self.snapshot.state),
-                        ("candidate_cache",
-                         cand["cache"] if cand else None),
-                    ):
-                        for did, nbytes in insp.device_bytes_by_shard(
-                                tree).items():
-                            metrics.solver_device_bytes.set(
-                                float(nbytes),
-                                labels={"kind": kind,
-                                        "shard": str(did)})
-                if self.explain:
-                    # per-dim capacity slack: the headroom context for
-                    # the round's fit_<dim> rejection counts
-                    from koordinator_tpu.api.resources import ResourceDim
-
-                    free_sum, alloc_sum = self._slack_sums(
-                        self.snapshot.state)
-                    free_sum = np.asarray(free_sum)
-                    alloc_sum = np.asarray(alloc_sum)
-                    for dim in ResourceDim:
-                        total = float(alloc_sum[dim])
-                        metrics.capacity_slack.set(
-                            (float(free_sum[dim]) / total) if total > 0
-                            else 1.0,
-                            labels={"dim": dim.name.lower()})
+                self._publish_round_introspection()
             return result
 
-    def _schedule_round(self) -> SchedulingResult:  # koordlint: guarded-by(self.lock)
+    def round_device(self) -> "RoundHandle":
+        """Public DEVICE-half entry for pipelined operation (the tenancy
+        front-end).  The caller MUST hold ``self.lock`` across the
+        ``round_device`` -> ``round_host`` pair — the handle references
+        in-flight donated state, and an informer mutation between the
+        halves would solve one queue and commit another.  Each half
+        leaves its own flight record (``half="solve"``/``"commit"``) so
+        ``/debug/rounds`` attributes slow halves to a tenant."""
+        self._round_begin()
+        start_wall = time.time()
+        t0 = time.perf_counter()
+        with tracing.TRACER.span(
+                "scheduler.round.solve", service="scheduler",
+                attributes={"round": self.round_seq,
+                            "tenant": self.tenant}) as span:
+            handle = self._round_device()
+        handle.start_wall = start_wall
+        handle.t0 = t0
+        if self._round_recordable and not handle.done:
+            self._round_flight_record(
+                handle.result, span.trace_id, start_wall,
+                time.perf_counter() - t0, self._current_path(),
+                half="solve")
+        return handle
+
+    def round_host(self, handle: "RoundHandle") -> SchedulingResult:
+        """Public HOST-half entry: block on the dispatched solve and
+        commit.  Pairs with :meth:`round_device` under one lock hold."""
+        with tracing.TRACER.span(
+                "scheduler.round.commit", service="scheduler",
+                attributes={"round": self.round_seq,
+                            "tenant": self.tenant}) as span:
+            result = self._round_host(handle)
+        if self._round_recordable:
+            self._round_flight_record(
+                result, span.trace_id, handle.start_wall,
+                time.perf_counter() - handle.t0, self._current_path(),
+                half="commit")
+            self._publish_round_introspection()
+        return result
+
+    # koordlint: guarded-by(self.lock)
+    def _publish_round_introspection(self) -> None:
+        # device-resident footprint of the persistent solver
+        # tensors, from array metadata only (no sync): the
+        # live-bytes half of the introspection surface
+        from koordinator_tpu.ops import introspection as insp
+
+        metrics.solver_device_bytes.set(
+            float(insp.device_bytes(self.snapshot.state)),
+            labels={"kind": "cluster_state"})
+        cand = self._cand_cache
+        metrics.solver_device_bytes.set(
+            float(insp.device_bytes(
+                cand["cache"] if cand else None)),
+            labels={"kind": "candidate_cache"})
+        # sharded-solve introspection: the active nodes-axis
+        # width plus the per-device slice of each persistent
+        # tensor (a lopsided shard is a placement bug)
+        active_shards = (self.solver_shard_count
+                         if (self.mesh is not None
+                             and self.snapshot
+                             .solver_sharding_active) else 1)
+        metrics.solver_shard_count.set(float(active_shards))
+        if active_shards > 1:
+            for kind, tree in (
+                ("cluster_state", self.snapshot.state),
+                ("candidate_cache",
+                 cand["cache"] if cand else None),
+            ):
+                for did, nbytes in insp.device_bytes_by_shard(
+                        tree).items():
+                    metrics.solver_device_bytes.set(
+                        float(nbytes),
+                        labels={"kind": kind,
+                                "shard": str(did)})
+        if self.explain:
+            # per-dim capacity slack: the headroom context for
+            # the round's fit_<dim> rejection counts
+            from koordinator_tpu.api.resources import ResourceDim
+
+            free_sum, alloc_sum = self._slack_sums(
+                self.snapshot.state)
+            free_sum = np.asarray(free_sum)
+            alloc_sum = np.asarray(alloc_sum)
+            for dim in ResourceDim:
+                total = float(alloc_sum[dim])
+                metrics.capacity_slack.set(
+                    (float(free_sum[dim]) / total) if total > 0
+                    else 1.0,
+                    labels={"dim": dim.name.lower()})
+
+    def _recover_solve_failure(self) -> None:  # koordlint: guarded-by(self.lock)
+        """The jitted solves DONATE the state buffers: an execution-time
+        failure mid-round has already consumed them, and without
+        recovery every later round would die on "Array has been
+        deleted".  (Trace/compile errors — the common failure class —
+        raise before any donation executes, so the buffers are still
+        live and nothing is rebuilt.)  The conservative rebuild keeps
+        the scheduler alive and never-overcommitting; a sync resync
+        restores exact accounting."""
+        if any(getattr(leaf, "is_deleted", lambda: False)()
+               for leaf in jax.tree.leaves(self.snapshot.state)):
+            self.snapshot.rebuild_conservative()
+        self._cand_cache = None
+
+    def _round_device(self) -> RoundHandle:  # koordlint: guarded-by(self.lock)
+        """The DEVICE half of a round: gates, host prelude (reservation
+        tick, nominations, quota revoke), PreEnqueue, BatchBuild, and
+        the solve DISPATCH — no blocking on device results.  JAX's
+        async dispatch returns immediately, so when the host half (or
+        another tenant's) commit work runs next, this round's solve is
+        already executing on the device.
+
+        Donation contract (the double-buffered hand-off): the
+        dispatched solve donates ``snapshot.state``'s buffers and the
+        snapshot is re-pointed at the returned in-flight arrays before
+        this method returns — the blessed swap.  The PRE-dispatch state
+        must never be stashed (koordlint's donation-safety corpus seeds
+        both sides of this idiom); reads of ``snapshot.state`` between
+        the halves are safe and simply block until the solve lands.
+
+        Internally ``prepare`` (through BatchBuild) and ``dispatch``
+        are separate steps so the tenancy front-end can gather every
+        tenant's prepared batch and dispatch ONE tenant-axis batched
+        program instead (tenancy._batched_dispatch)."""
+        return self._round_dispatch(self._round_prepare())
+
+    def _round_prepare(self) -> RoundHandle:  # koordlint: guarded-by(self.lock)
+        """Gates + host prelude + PreEnqueue + BatchBuild (no solve)."""
         # set at round START — before any early return, including the
         # barrier gate, so a backlog building behind the barrier is visible.
         # Synthetic rsv:: reserve-pods are excluded (they are placement
         # vehicles, not user backlog — the auditor filters them the same way)
         metrics.pending_pods.set(float(sum(
             1 for name in self.pending
-            if not name.startswith(RSV_POD_PREFIX))))
+            if not name.startswith(RSV_POD_PREFIX))), labels=self._tl())
+        handle = RoundHandle(result=SchedulingResult({}, {}, 0))
         if self.elector is not None and not self.elector.tick():
             # standby replica: keep syncing state, decide nothing — and
             # surface the standby (empty) result on the debug API instead
             # of a stale leader-era diagnosis
-            self.last_result = SchedulingResult({}, {}, 0)
-            return self.last_result
+            self.last_result = handle.result
+            handle.done = True
+            return handle
         if self.barrier is not None and not self.barrier.check():
             # stale cache after restart: refuse to decide until the informer
             # replays past the barrier (sync_barrier.go semantics)
-            return SchedulingResult({}, {}, 0)
+            handle.done = True
+            return handle
         now = self.clock()
+        handle.now = now
         # a round that got this far decided (or legitimately found
         # nothing to decide): it belongs in the flight recorder —
         # standby/barrier-gated rounds above do not
         self._round_recordable = True
         self._staleness_tick(now)
-        result = SchedulingResult({}, {}, 0)
+        result = handle.result
         self.last_result = result  # debug-API diagnosis surface
         if len(self.reservations):
             with self.monitor.phase("Reservations"):
@@ -1402,7 +1480,8 @@ class Scheduler:
             if self.explain:
                 self._record_round_explanations(
                     [], result, [], set(), len(self.snapshot.node_index))
-            return result
+            handle.done = True
+            return handle
         if self.auditor is not None:
             # one attempt per workload key per round — a gang is one
             # scheduling attempt, not len(members) attempts; synthetic
@@ -1439,49 +1518,145 @@ class Scheduler:
                 [self.snapshot.node_name(r) or str(r)
                  for r in range(self.snapshot.state.capacity)],
             )
+        handle.pods, handle.batch = pods, batch
+        handle.gangs, handle.gang_index = gangs, gang_index
+        handle.quota = quota
+        return handle
 
+    def _round_dispatch(self, handle: RoundHandle) -> RoundHandle:  # koordlint: guarded-by(self.lock)
+        """Dispatch the prepared round's solve (async); see
+        :meth:`_round_device` for the donation contract."""
+        if handle.done:
+            return handle
+        pods, batch, result = handle.pods, handle.batch, handle.result
+        gangs, gang_index = handle.gangs, handle.gang_index
+        quota = handle.quota
+        # dispatch wall is carried into the host half's single "Solve"
+        # phase observation (monitor.phase carry_s) so the round still
+        # produces exactly ONE Solve latency observation — the SLO
+        # engine's per-observation bad fractions must not dilute
+        dispatch_t0 = time.perf_counter()
         try:
-            with self.monitor.phase("Solve"):
-                if self.faults is not None:
-                    # chaos seam: an injected solve delay lands in this
-                    # phase's scheduling_duration observation — the
-                    # synthetic latency regression the SLO engine's
-                    # burn windows must catch (tests/test_slo_monitor)
-                    self.faults.on_solve()
-                if len(self.reservations):
-                    batch, quota = self._reservation_prepass(
-                        pods, batch, quota, result)
-                solver = ("batch" if len(pods) >= self.batch_solver_threshold
-                          else "greedy")
-                self.last_solver = solver
-                # incremental fast path: a gangless batch round re-scores only
-                # the delta against the persistent candidate cache; gang
-                # rounds, hinted (dense-mask) rounds, the exact greedy
-                # solver — and DEGRADED rounds, whose cache was built from
-                # a stalled feed — keep the one-call full path
-                use_inc = (solver == "batch" and self.incremental_solve
-                           and not self.degraded
-                           and not gang_index
-                           and batch.selector_mask is not None)
-                if use_inc:
+            if self.faults is not None:
+                # chaos seam: an injected solve delay lands in the
+                # round's Solve scheduling_duration observation (via
+                # carry_s) — the synthetic latency regression the SLO
+                # engine's burn windows must catch (tests/test_slo_monitor)
+                self.faults.on_solve()
+            if len(self.reservations):
+                batch, quota = self._reservation_prepass(
+                    pods, batch, quota, result)
+            solver = ("batch" if len(pods) >= self.batch_solver_threshold
+                      else "greedy")
+            self.last_solver = solver
+            # incremental fast path: a gangless batch round re-scores only
+            # the delta against the persistent candidate cache; gang
+            # rounds, hinted (dense-mask) rounds, the exact greedy
+            # solver — and DEGRADED rounds, whose cache was built from
+            # a stalled feed — keep the one-call full path
+            use_inc = (solver == "batch" and self.incremental_solve
+                       and not self.degraded
+                       and not gang_index
+                       and batch.selector_mask is not None)
+            if use_inc:
+                handle.inc = self._dispatch_batch_incremental(
+                    pods, batch, quota)
+                handle.assignments = handle.inc["a"]
+                handle.new_state = handle.inc["state"]
+                handle.new_quota = handle.inc["quota"]
+            else:
+                if solver == "batch":
+                    self.last_solve_path = (
+                        "full_gang" if gang_index
+                        else "full_dense" if batch.selector_mask is None
+                        else "degraded" if self.degraded
+                        else "disabled")
+                    metrics.incremental_solve_total.inc(labels={
+                        "path": self.last_solve_path})
+                assignments, new_state, new_quota = self._solve(
+                    self.snapshot.state, batch, self.config, gangs, quota,
+                    passes=self.gang_passes, solver=solver,
+                )
+                # the blessed swap: the jitted solve donated the old
+                # state buffers; the snapshot re-points at the in-flight
+                # result immediately so nothing can read the dead ones
+                self.snapshot.state = new_state
+                handle.assignments = assignments
+                handle.new_state = new_state
+                handle.new_quota = new_quota
+        except Exception:
+            self._recover_solve_failure()
+            raise
+        finally:
+            self._solve_carry_s += time.perf_counter() - dispatch_t0
+        # the prepass may have shrunk the batch and charged the quota
+        handle.batch, handle.quota, handle.solver = batch, quota, solver
+        # stamped here so the pipelined solve-half flight record carries
+        # the admitted count (the host half re-stamps the same value)
+        result.round_pods = len(pods)
+        return handle
+
+    # koordlint: guarded-by(self.lock)
+    def round_adopt_batched(self, handle: RoundHandle, a, new_state,
+                            new_quota, est_accum, cache, k: int,
+                            method: str) -> RoundHandle:
+        """Adopt one tenant's slice of a TENANT-AXIS batched solve as
+        this round's dispatched pass 1 (tenancy front-end;
+        ``tenancy._batched_dispatch`` ran one ``vmap``-batched
+        select+pass1 program over every tenant's stacked state).
+        Mirrors the serial ``full_cold`` branch bookkeeping: the dirty
+        set is consumed, the candidate cache re-warms from the batched
+        selection (so the NEXT round goes incremental), and the finish
+        context hands the pass-2 loop to :meth:`_round_host`."""
+        snap = self.snapshot
+        # the batched program re-selected every candidate: consume the
+        # dirty set exactly like the serial full-selection path does
+        snap.consume_candidate_dirty()
+        self.last_solver = "batch"
+        self.last_solve_path = "tenant_batched"
+        metrics.incremental_solve_total.inc(
+            labels={"path": "tenant_batched"})
+        host = self._batch_host
+        self._cand_cache = {
+            "cache": cache,
+            "row_of": host["row_of"],
+            "specs": host["specs"],
+            "n": snap.capacity, "k": k, "spread": self.cand_spread,
+            "method": method, "cfg": self.config,
+        }
+        # the blessed swap, batched form: the stacked program consumed a
+        # COPY of the per-tenant states (stacking copies), so the old
+        # buffers stay live until this re-point drops them
+        snap.state = new_state
+        handle.solver = "batch"
+        handle.assignments = a
+        handle.new_state = new_state
+        handle.new_quota = new_quota
+        handle.inc = {"a": a, "state": new_state, "quota": new_quota,
+                      "est_accum": est_accum, "batch": handle.batch,
+                      "k": k, "method": method, "use_mesh": False}
+        handle.result.round_pods = len(handle.pods)
+        return handle
+
+    def _round_host(self, handle: RoundHandle) -> SchedulingResult:  # koordlint: guarded-by(self.lock)
+        """The HOST half: block on the dispatched solve, run the exact
+        rescue pass, then Reserve/Bind/Diagnose/PostFilter — the commit
+        work round N+1's device solve overlaps under pipelined
+        operation (tenancy front-end)."""
+        if handle.done:
+            return handle.result
+        pods, batch, result = handle.pods, handle.batch, handle.result
+        gangs, quota, solver = handle.gangs, handle.quota, handle.solver
+        now = handle.now
+        assignments = handle.assignments
+        new_state, new_quota = handle.new_state, handle.new_quota
+        try:
+            with self.monitor.phase("Solve",
+                                    carry_s=self._solve_carry_s):
+                self._solve_carry_s = 0.0
+                if handle.inc is not None:
                     assignments, new_state, new_quota = (
-                        self._solve_batch_incremental(pods, batch, quota))
-                else:
-                    if solver == "batch":
-                        self.last_solve_path = (
-                            "full_gang" if gang_index
-                            else "full_dense" if batch.selector_mask is None
-                            else "degraded" if self.degraded
-                            else "disabled")
-                        metrics.incremental_solve_total.inc(labels={
-                            "path": self.last_solve_path})
-                    assignments, new_state, new_quota = self._solve(
-                        self.snapshot.state, batch, self.config, gangs, quota,
-                        passes=self.gang_passes, solver=solver,
-                    )
-                    # the jitted solve donated the old state buffers; keep the
-                    # snapshot on live ones until Reserve's bookkeeping adopt
-                    self.snapshot.state = new_state
+                        self._finish_batch_incremental(handle.inc))
                 a = np.asarray(self._block_timed(assignments))
                 leftover = np.asarray(batch.valid) & (a < 0)
                 if solver == "batch" and bool(leftover[: len(pods)].any()):
@@ -1522,19 +1697,10 @@ class Scheduler:
                         assignments >= 0, assignments, jnp.asarray(r_full))
                     a = np.asarray(assignments)
         except Exception:
-            # the jitted solves DONATE the state buffers: an
-            # execution-time failure mid-round has already consumed
-            # them, and without recovery every later round would die
-            # on "Array has been deleted".  (Trace/compile errors —
-            # the common failure class — raise before any donation
-            # executes, so the buffers are still live and nothing is
-            # rebuilt.)  The conservative rebuild keeps the scheduler
-            # alive and never-overcommitting; a sync resync restores
-            # exact accounting.
-            if any(getattr(leaf, "is_deleted", lambda: False)()
-                   for leaf in jax.tree.leaves(self.snapshot.state)):
-                self.snapshot.rebuild_conservative()
-            self._cand_cache = None
+            # execution-time donation failure: the block above is where
+            # a dispatched-then-failed solve actually SURFACES, so the
+            # conservative-rebuild recovery runs in both halves
+            self._recover_solve_failure()
             raise
         result.round_pods = len(pods)
         # wall vs. device: the Solve phase's wall time is in the monitor;
@@ -1672,7 +1838,8 @@ class Scheduler:
                         self.auditor.record(pod.gang or pod.name,
                                             "ScheduleFailed", diag.message())
 
-        metrics.pending_pods.set(float(len(self.pending)))  # post-bind queue
+        metrics.pending_pods.set(float(len(self.pending)),
+                                 labels=self._tl())  # post-bind queue
         return result
 
     # -- incremental delta-driven solve -------------------------------------
@@ -1722,8 +1889,20 @@ class Scheduler:
         }
 
     def _solve_batch_incremental(self, pods, batch: PodBatch, quota):  # koordlint: guarded-by(self.lock)
+        """One-call form of the incremental solve (dispatch + finish):
+        kept for callers outside the round pipeline.  Returns
+        (assignments, new_state, new_quota) like gang_assign."""
+        return self._finish_batch_incremental(
+            self._dispatch_batch_incremental(pods, batch, quota))
+
+    def _dispatch_batch_incremental(self, pods, batch: PodBatch, quota) -> dict:  # koordlint: guarded-by(self.lock)
         """The no-gang batch solve with the persistent device-resident
-        candidate cache (ops/batch_assign incremental section).
+        candidate cache (ops/batch_assign incremental section) — the
+        DEVICE half: candidate refresh/selection and the pass-1 solve
+        are dispatched (async) and returned as a finish context for
+        :meth:`_finish_batch_incremental`; nothing heavy is blocked on
+        here (the (P,) ``touch`` readback for dirty-pod mapping is the
+        one small sync).
 
         Steady state: the round re-scores only dirty rows — pods newly
         arrived/re-specced or whose cached candidates touch a dirty node —
@@ -1737,8 +1916,6 @@ class Scheduler:
         never changes acceptance decisions — staleness in the cache can
         only cost candidate recall, and acceptance re-checks fit and
         quota exactly.
-
-        Returns (assignments, new_state, new_quota) like gang_assign.
         """
         from koordinator_tpu.ops import batch_assign as ba
 
@@ -1851,22 +2028,38 @@ class Scheduler:
         }
         self.last_solve_path = path
 
-        # gangless gang_assign pass loop: pass 1 over the cached/refreshed
-        # candidates, later passes full-select over the COMPACTED leftovers
-        # (small × N, not P × N) against the est-usage-augmented state.
-        # The passes donate the state they consume; re-pointing
-        # snapshot.state at each returned state keeps the snapshot on
-        # LIVE buffers (trace/compile errors — the realistic failure
-        # class — raise before any donation executes; an execution-time
-        # failure mid-chain is unrecoverable without a sync resync
-        # either way).  On any failure the cache is dropped so the next
-        # round re-warms instead of trusting un-bookkept state.
+        # gangless gang_assign pass loop, pass 1: over the
+        # cached/refreshed candidates.  The pass donates the state it
+        # consumes; re-pointing snapshot.state at the returned state
+        # keeps the snapshot on LIVE buffers (trace/compile errors —
+        # the realistic failure class — raise before any donation
+        # executes; an execution-time failure mid-chain is
+        # unrecoverable without a sync resync either way).  On any
+        # failure the cache is dropped so the next round re-warms
+        # instead of trusting un-bookkept state.
         try:
             a, state, quota, est_accum = pass1_fn(
                 snap.state, batch, quota, cache.cand_key, cache.cand_node,
                 self.config, rounds=self.solve_rounds)
             snap.state = state
-            a_np = np.asarray(self._block_timed(a))
+        except Exception:
+            self._cand_cache = None
+            raise
+        return {"a": a, "state": state, "quota": quota,
+                "est_accum": est_accum, "batch": batch, "k": k,
+                "method": method, "use_mesh": use_mesh}
+
+    def _finish_batch_incremental(self, ctx: dict):  # koordlint: guarded-by(self.lock)
+        """HOST half of the incremental solve: block on pass 1, then
+        run the later passes full-selecting over the COMPACTED leftovers
+        (small × N, not P × N) against the est-usage-augmented state —
+        identical decisions to the one-call form, dispatch point aside."""
+        snap = self.snapshot
+        batch = ctx["batch"]
+        state, quota, est_accum = ctx["state"], ctx["quota"], ctx["est_accum"]
+        k, method, use_mesh = ctx["k"], ctx["method"], ctx["use_mesh"]
+        try:
+            a_np = np.asarray(self._block_timed(ctx["a"]))
             for _ in range(1, self.gang_passes):
                 leftover = np.asarray(batch.valid) & (a_np < 0)
                 if not leftover.any():

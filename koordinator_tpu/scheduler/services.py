@@ -89,6 +89,24 @@ def debug_steady_body(scheduler, params: dict | None = None) -> dict:
     return body
 
 
+def debug_tenants_body(scheduler) -> dict:
+    """The /debug/tenants payload (shared by DebugService and the HTTP
+    gateway): the multi-tenant front-end's rollup — per-tenant
+    weight/share/credit, queue depth, degraded/suspension state, last
+    solve path, plus the cycle's dispatch mode and host-wait fraction.
+
+    Served through ANY tenant's scheduler (each per-tenant Scheduler
+    carries a ``tenant_front`` back-reference) or directly through a
+    :class:`~koordinator_tpu.scheduler.tenancy.TenantScheduler`; a
+    single-tenant scheduler answers a typed 501."""
+    front = (scheduler if hasattr(scheduler, "tenants_report")
+             else getattr(scheduler, "tenant_front", None))
+    if front is None:
+        raise DebugApiError(501, "no tenancy front-end attached "
+                                 "(multi-tenant schedulers only)")
+    return front.tenants_report()
+
+
 def debug_profile_body(scheduler, seconds) -> dict:
     """The /debug/profile?seconds=N payload: an on-demand jax.profiler
     capture.  403 while the gate is off (the default), 409 while a
@@ -261,6 +279,7 @@ class DebugService:
         self.register("/debug/rounds", self._rounds)
         self.register("/debug/slo", self._slo)
         self.register("/debug/steady", self._steady)
+        self.register("/debug/tenants", self._tenants)
         self.register("/debug/profile", self._profile)
         self.register_prefix("/debug/trace/", self._trace)
         self.register_prefix("/debug/explain/", self._explain)
@@ -362,6 +381,12 @@ class DebugService:
         """The trend engine's steady-state verdicts (/debug/steady,
         ?window=N overrides the evaluation window)."""
         return debug_steady_body(self.scheduler, params)
+
+    def _tenants(self, params: dict) -> object:
+        """The multi-tenant rollup (/debug/tenants): per-tenant
+        shares/queues/degraded state + cycle dispatch mode; typed 501
+        without a tenancy front-end."""
+        return debug_tenants_body(self.scheduler)
 
     def _profile(self, params: dict) -> object:
         """On-demand jax.profiler capture (/debug/profile?seconds=N);

@@ -1,0 +1,194 @@
+"""Shared jitted solver entries: ONE compiled solver for N tenants.
+
+Before the tenancy subsystem (ISSUE 11) every :class:`Scheduler` built
+its own ``jax.jit`` wrappers in ``__init__``, so two schedulers in one
+process compiled two identical copies of every solve program.  A
+multi-tenant front-end (``scheduler/tenancy.py``) runs one ``Scheduler``
+per cluster — T tenants must multiplex onto ONE solver, sharing the jit
+caches, the recompile accounting, and the mesh, exactly the way the
+paper's shared-capacity argument sizes one pool to aggregate demand.
+
+The kit owns:
+
+- the solve mesh (``parallel/mesh.resolve_solver_mesh`` — sharded by
+  default, ``KOORD_SOLVER_MESH``/``KOORD_SOLVER_MESH_MIN_NODES``
+  overrides);
+- every instrumented jitted entry point of the batch solver (full
+  gang_assign, candidate selection/refresh/scatter, the propose/accept
+  passes and their sharded twins, the reservation pre-pass solve, the
+  preemption kernels, explain/slack reductions).
+
+Shape buckets in the recompile accounting derive the ``@Nshard`` suffix
+from the ARGUMENTS (state capacity vs the mesh floor), not from any one
+scheduler's snapshot, so a shared kit labels each tenant's compiles
+correctly even when tenants straddle the sharding floor.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+class SolverKit:
+    """The device half's toolbox, shareable across Scheduler instances.
+
+    Construction is cheap (wrapping, not compiling); compilation happens
+    per (entry, shape bucket) on first use and is shared by every
+    scheduler holding the kit.
+    """
+
+    def __init__(self, mesh="auto", shard_min_nodes: int = 1024):
+        from koordinator_tpu.ops import batch_assign as _ba
+        from koordinator_tpu.ops import explain as _ex
+        from koordinator_tpu.ops import introspection as insp
+        from koordinator_tpu.ops.gang import gang_assign
+        from koordinator_tpu.ops.preemption import preempt_chain, preempt_one
+        from koordinator_tpu.ops.reservation import reservation_greedy_assign
+        from koordinator_tpu.parallel import mesh as pmesh
+        from koordinator_tpu.parallel import sharded as psharded
+
+        # -- sharded-by-default solve mesh (ISSUE 10) --
+        # the node axis of the batch solve shards over every visible
+        # device; tiny clusters stay single-device — sharding a 64-node
+        # problem is pure collective overhead — via the min-nodes floor.
+        self.mesh = pmesh.resolve_solver_mesh(mesh)
+        self.shard_min_nodes = int(os.environ.get(
+            "KOORD_SOLVER_MESH_MIN_NODES", shard_min_nodes))
+        self.shards = pmesh.nodes_shard_count(self.mesh)
+        self.node_sharding = (pmesh.node_sharding(self.mesh)
+                              if self.mesh is not None else None)
+
+        def _active(n_cap: int) -> bool:
+            """Does THIS capacity solve on the sharded path?  The same
+            predicate ``ClusterSnapshot.solver_sharding_active`` applies
+            to its own capacity — derived from the args so a shared kit
+            labels each tenant correctly."""
+            return (self.mesh is not None
+                    and n_cap % self.shards == 0
+                    and n_cap >= self.shard_min_nodes)
+
+        self.sharding_active_for = _active
+
+        def _sfx(n_cap: int) -> str:
+            return f"@{self.shards}shard" if _active(n_cap) else ""
+
+        def _pn(args, kwargs):
+            return (f"P{args[1].capacity}xN{args[0].capacity}"
+                    f"{_sfx(args[0].capacity)}")
+
+        # solve-state donation: the caller's snapshot.state is dead the
+        # moment the call starts (XLA updates the (N, R) accounting in
+        # place) and must be replaced wholesale by the returned state.
+        # Every jitted entry point is wrapped for recompile accounting
+        # (ops/introspection): a cache miss lands in
+        # solver_recompiles_total{fn, shape}.
+        self.solve = insp.instrument(
+            jax.jit(gang_assign,
+                    static_argnames=("passes", "solver"),
+                    donate_argnums=(0,)),
+            "gang_assign", shape_of=_pn)
+
+        self.select_scored = insp.instrument(
+            jax.jit(_ba.select_candidates,
+                    static_argnames=("k", "spread_bits", "method",
+                                     "with_scores")),
+            "select_candidates", shape_of=_pn)
+        self.align_cands = insp.instrument(
+            jax.jit(_ba.align_candidate_cache),
+            "align_candidate_cache",
+            shape_of=lambda a, k: (f"P{a[1].shape[0]}xN{a[3].shape[0]}"))
+        self.refresh_cands = insp.instrument(
+            jax.jit(_ba.refresh_candidates,
+                    static_argnames=("k", "spread_bits"),
+                    donate_argnums=(3,)),
+            "refresh_candidates",
+            shape_of=lambda a, k: (f"P{a[1].capacity}xN{a[0].capacity}"
+                                   f"xD{a[4].shape[0]}"))
+        self.scatter_cands = insp.instrument(
+            jax.jit(_ba.scatter_candidate_rows, donate_argnums=(0,)),
+            "scatter_candidate_rows",
+            shape_of=lambda a, k: (f"P{a[0].cand_key.shape[0]}"
+                                   f"xS{a[1].shape[0]}"))
+        self.pass1 = insp.instrument(
+            jax.jit(_ba.assign_round_pass,
+                    static_argnames=("rounds",),
+                    donate_argnums=(0,)),
+            "assign_round_pass", shape_of=_pn)
+        self.pass2 = insp.instrument(
+            jax.jit(_ba.assign_followup_pass,
+                    static_argnames=("k", "rounds", "spread_bits",
+                                     "method"),
+                    donate_argnums=(0, 1)),
+            "assign_followup_pass",
+            shape_of=lambda a, k: f"P{a[2].capacity}xN{a[0].capacity}")
+
+        # sharded twins (selection recall-exact on the mesh; acceptance
+        # bit-identical — parallel/sharded.py).  Donation mirrors the
+        # unsharded bindings: the state (and the refresh's cache)
+        # updates in place under its NamedSharding placement.
+        self.select_scored_sh = self.refresh_cands_sh = None
+        self.pass1_sh = self.pass2_sh = None
+        if self.mesh is not None:
+            from functools import partial as _partial
+
+            self.select_scored_sh = insp.instrument(
+                jax.jit(_partial(psharded.sharded_select_candidates,
+                                 self.mesh),
+                        static_argnames=("k", "spread_bits",
+                                         "with_scores")),
+                "select_candidates", shape_of=_pn)
+            self.refresh_cands_sh = insp.instrument(
+                jax.jit(_partial(psharded.sharded_refresh_candidates,
+                                 self.mesh),
+                        static_argnames=("k", "spread_bits"),
+                        donate_argnums=(3,)),
+                "refresh_candidates",
+                shape_of=lambda a, k: (
+                    f"P{a[1].capacity}xN{a[0].capacity}"
+                    f"xD{a[4].shape[0]}{_sfx(a[0].capacity)}"))
+            self.pass1_sh = insp.instrument(
+                jax.jit(_partial(psharded.sharded_assign_round_pass,
+                                 self.mesh),
+                        static_argnames=("rounds",),
+                        donate_argnums=(0,)),
+                "assign_round_pass", shape_of=_pn)
+            self.pass2_sh = insp.instrument(
+                jax.jit(_partial(psharded.sharded_assign_followup_pass,
+                                 self.mesh),
+                        static_argnames=("k", "rounds", "spread_bits"),
+                        donate_argnums=(0, 1)),
+                "assign_followup_pass",
+                shape_of=lambda a, k: (
+                    f"P{a[2].capacity}"
+                    f"xN{a[0].capacity}{_sfx(a[0].capacity)}"))
+
+        self.rsv_solve = insp.instrument(
+            jax.jit(reservation_greedy_assign, donate_argnums=(0,)),
+            "reservation_greedy_assign", shape_of=_pn)
+
+        self.preempt = jax.jit(
+            preempt_one, static_argnames=("same_quota_only", "nominate"))
+        self.preempt_chain = jax.jit(preempt_chain)
+
+        #: device-side reject-reason reduction over a round's COMPACTED
+        #: failed rows — O(F·NUM_REASONS) host transfer, never (P, N)
+        self.explain_counts = insp.instrument(
+            jax.jit(_ex.explain_counts), "explain_counts", shape_of=_pn)
+        #: per-dim capacity-slack reduction ((N, R) -> two (R,) sums);
+        #: float32 accumulation — a 10k-node cluster's summed int32
+        #: quantities overflow int32, and a ratio gauge doesn't need
+        #: integer exactness
+        self.slack_sums = insp.instrument(
+            jax.jit(lambda st: (
+                jnp.sum(jnp.where(
+                    st.node_valid[:, None],
+                    st.node_allocatable - st.node_requested, 0
+                ).astype(jnp.float32), axis=0),
+                jnp.sum(jnp.where(
+                    st.node_valid[:, None], st.node_allocatable, 0
+                ).astype(jnp.float32), axis=0))),
+            "capacity_slack",
+            shape_of=lambda a, k: f"N{a[0].capacity}")

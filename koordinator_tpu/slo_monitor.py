@@ -91,6 +91,18 @@ class SloSpec:
     #: mean-per-bin resolution for slow-window gauge aggregation
     #: (0 = raw samples)
     slow_resolution_s: float = 10.0
+    #: label filter as sorted (key, value) pairs: only series carrying
+    #: ALL of these labels count toward the spec (the per-tenant p99
+    #: SLO slices one shared histogram by {tenant=...}); empty = every
+    #: label set aggregates, the pre-tenancy behavior
+    label_filter: tuple = ()
+
+    def matches_labels(self, labels: dict | None) -> bool:
+        if not self.label_filter:
+            return True
+        if not labels:
+            return False
+        return all(labels.get(k) == v for k, v in self.label_filter)
 
 
 def default_specs(latency_threshold_s: float = 0.2,
@@ -135,6 +147,28 @@ def default_specs(latency_threshold_s: float = 0.2,
                         "seconds_count",
             objective=0.01,
         ),
+    ]
+
+
+def tenant_slo_specs(tenant_names, latency_threshold_s: float = 0.2
+                     ) -> list[SloSpec]:
+    """Per-tenant p99 latency SLOs (ISSUE 11): one spec per tenant,
+    slicing the SHARED ``scheduling_duration_seconds`` histogram by its
+    ``{tenant=...}`` label — so one cluster blowing its budget pages as
+    that tenant, not as a mushed global p99."""
+    return [
+        SloSpec(
+            name=f"tenant_{name}_latency_p99",
+            description=(f"tenant {name}: 99% of scheduling-phase "
+                         f"observations under "
+                         f"{latency_threshold_s * 1000:g}ms"),
+            kind=KIND_LATENCY,
+            metric="koord_scheduler_scheduling_duration_seconds",
+            threshold=latency_threshold_s,
+            objective=0.01,
+            label_filter=(("tenant", str(name)),),
+        )
+        for name in tenant_names
     ]
 
 
@@ -256,7 +290,7 @@ class SloMonitor:
         per_le: dict[float, float] = {}
         for labels in self.cache.series_labels(bucket_metric):
             le = labels.get("le")
-            if le is None:
+            if le is None or not spec.matches_labels(labels):
                 continue
             delta = self._window_delta(bucket_metric, labels, start, end)
             if delta is None:
@@ -265,6 +299,8 @@ class SloMonitor:
         total = 0.0
         saw_count = False
         for labels in self.cache.series_labels(f"{spec.metric}_count"):
+            if not spec.matches_labels(labels):
+                continue
             delta = self._window_delta(f"{spec.metric}_count", labels,
                                        start, end)
             if delta is not None:
@@ -289,6 +325,8 @@ class SloMonitor:
         total = 0.0
         label_sets = self.cache.series_labels(spec.metric) or [None]
         for labels in label_sets:
+            if not spec.matches_labels(labels):
+                continue
             res = self.cache.query(spec.metric, labels, start=start, end=end)
             if resolution_s > 0:
                 res = res.downsample(resolution_s)
@@ -304,6 +342,8 @@ class SloMonitor:
         num = 0.0
         saw_num = False
         for labels in self.cache.series_labels(spec.metric) or [None]:
+            if not spec.matches_labels(labels):
+                continue
             delta = self._window_delta(spec.metric, labels, start, end)
             if delta is not None:
                 num += delta

@@ -41,6 +41,11 @@ Routes (all JSON bodies/responses unless noted):
                                           leaking verdicts per watched
                                           series, joined to SLO breach
                                           state (scheduler binaries)
+    GET  /debug/tenants                -> multi-tenant rollup: per-
+                                          tenant weight/share/credit,
+                                          queue depth, degraded state,
+                                          cycle dispatch mode (501
+                                          without a tenancy front-end)
     GET  /debug/profile?seconds=N      -> on-demand jax.profiler
                                           capture; 403 unless enabled
                                           at assembly (gated off by
@@ -191,6 +196,8 @@ class HttpGateway:
             return self._debug_slo(req)
         if method == "GET" and path == "/debug/steady":
             return self._debug_steady(req)
+        if method == "GET" and path == "/debug/tenants":
+            return self._debug_tenants(req)
         if method == "GET" and path == "/debug/profile":
             return self._debug_profile(req)
         m = self._TRACE.match(path)
@@ -345,6 +352,21 @@ class HttpGateway:
         try:
             return req._reply(200, debug_steady_body(self.scheduler,
                                                      params))
+        except DebugApiError as e:
+            return req._reply(e.status, {"error": e.message})
+
+    def _debug_tenants(self, req) -> None:
+        """The multi-tenant rollup — same body the DebugService serves
+        (shared builder; typed 501 without a tenancy front-end)."""
+        if self.scheduler is None:
+            return req._reply(501, {"error": "no scheduler attached"})
+        from koordinator_tpu.scheduler.services import (
+            DebugApiError,
+            debug_tenants_body,
+        )
+
+        try:
+            return req._reply(200, debug_tenants_body(self.scheduler))
         except DebugApiError as e:
             return req._reply(e.status, {"error": e.message})
 

@@ -25,14 +25,23 @@ def test_stage_profiler_smoke():
                       "select_chunked", "rounds",
                       "refresh_incremental_1pct",
                       "score_sharded", "rounds_sharded", "merge_topk",
-                      "explain_compact_1pct", "explain_full_batch"}, stages
+                      "explain_compact_1pct", "explain_full_batch",
+                      "tenancy_serial", "tenancy_pipelined",
+                      "tenancy_batched"}, stages
     by_stage = {r["stage"]: r for r in records}
     # every timed stage produced a positive per-iteration time
     for name in ("score", "select_approx", "select_chunked", "rounds",
                  "refresh_incremental_1pct", "score_sharded",
                  "rounds_sharded", "merge_topk", "explain_compact_1pct",
-                 "explain_full_batch"):
+                 "explain_full_batch", "tenancy_serial",
+                 "tenancy_pipelined", "tenancy_batched"):
         assert by_stage[name]["ms_per_iter"] > 0, by_stage[name]
+    # the multi-tenant stage reports the acceptance observables: the
+    # aggregate-rate ratio vs the serial baseline and the device-idle
+    # fraction before/after pipelining (ISSUE 11)
+    assert by_stage["tenancy_serial"]["device_idle_fraction"] is not None
+    assert by_stage["tenancy_pipelined"]["speedup_vs_serial"] is not None
+    assert by_stage["tenancy_pipelined"]["device_idle_fraction"] is not None
     # the stage capture stamps code provenance for later promotion
     assert "commit" in by_stage["provenance"]
     # ... and mesh-shape provenance (ISSUE 10): the record names the

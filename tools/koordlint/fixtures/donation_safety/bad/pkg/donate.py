@@ -43,3 +43,22 @@ class Scheduler:
 
     def aliased(self):
         return solve(self.state, self.state)  # BAD: donated arg aliased
+
+
+class Pipeline:
+    """Double-buffered round pipeline (ISSUE 11), done WRONG: the
+    device half donates ``self.state`` at dispatch, then stashes the
+    donated in-flight buffer on the handle "for the host half" — the
+    buffer is dead the moment the call starts, and the host half will
+    read garbage (or RuntimeError) when it commits."""
+
+    def __init__(self, state, batch):
+        self.state = state
+        self.batch = batch
+        self.inflight = None
+
+    def dispatch(self):
+        new = solve(self.state, self.batch)
+        self.inflight = self.state   # BAD: stashes the donated buffer
+        self.state = new
+        return new

@@ -43,3 +43,26 @@ class Scheduler:
         cap = state.shape                 # ok: metadata before
         state = solve(state, self.batch)  # ok: tuple-free rebind
         return state, cap
+
+
+class Pipeline:
+    """Double-buffered round pipeline (ISSUE 11), the blessed swap:
+    the dispatch rebinds ``self.state`` to the donating call's result
+    IN the call statement, so between the halves every reader sees the
+    in-flight (live) buffer and the dead one is unreachable; the host
+    half blocks on the handle's arrays, never the pre-dispatch state."""
+
+    def __init__(self, state, batch):
+        self.state = state
+        self.batch = batch
+        self.inflight = None
+
+    def dispatch(self):
+        self.state = solve(self.state, self.batch)  # the blessed swap
+        self.inflight = self.state    # ok: references the NEW buffer
+        return self.inflight
+
+    def commit(self):
+        done = self.inflight          # ok: the live in-flight result
+        self.inflight = None
+        return done

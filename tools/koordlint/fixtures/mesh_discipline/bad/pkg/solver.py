@@ -33,3 +33,15 @@ def reguarded_capacity(n):
     # BAD: the ceiling guard belongs to ops/batch_assign, not callers
     check_node_capacity(n)
     return n
+
+
+def pipelined_handoff_inferred(mesh, f, state, batch):
+    # BAD (double-buffer hand-off idiom): the pipelined dispatch
+    # donates the stacked state at position 0 but leaves its placement
+    # to inference (None spec) — a resharding copy would silently
+    # defeat the in-place hand-off
+    fn = jax.jit(
+        shard_map(f, mesh=mesh, in_specs=(None, P()),
+                  out_specs=P("nodes")),
+        donate_argnums=(0,))
+    return fn(state, batch)

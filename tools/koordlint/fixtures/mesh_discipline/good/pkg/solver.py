@@ -26,3 +26,14 @@ def guarded_by_the_owner(state, pods, cfg):
     # capacity enforcement rides inside the selection entry point —
     # callers never re-guard
     return select_candidates(state, pods, cfg)
+
+
+def pipelined_handoff_explicit(mesh, f, state, batch):
+    # the double-buffer hand-off, disciplined: the donated stacked
+    # state carries an explicit literal spec, so the in-flight buffers
+    # stay in place across the device/host halves
+    fn = jax.jit(
+        shard_map(f, mesh=mesh, in_specs=(P("nodes"), P()),
+                  out_specs=P("nodes")),
+        donate_argnums=(0,))
+    return fn(state, batch)

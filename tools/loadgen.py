@@ -116,9 +116,36 @@ class LoadGenConfig:
     quota_scale_range: tuple[float, float] = (0.4, 1.5)
     pod_cpu_milli: tuple[int, int] = (250, 2_000)
     pod_memory_mib: tuple[int, int] = (128, 2_048)
+    #: multi-tenant traces (ISSUE 11): >1 emits one INDEPENDENT churn
+    #: process per tenant — per-tenant seeds derive from the master
+    #: seed (tenant_seed), every event carries a ``tenant`` field, and
+    #: the harness replays each tenant's stream against its own cluster
+    #: on a shared TenantScheduler mesh
+    tenants: int = 1
+    #: weighted-fair admission weights, one per tenant (short tuples
+    #: pad with 1.0) — drives the TenantScheduler's DRR shares
+    tenant_weights: tuple = ()
 
     def quota_names(self) -> list[str]:
         return [f"lg-quota-{i}" for i in range(self.quotas)]
+
+    def tenant_names(self) -> list[str]:
+        return [f"t{i}" for i in range(max(self.tenants, 1))]
+
+    def tenant_weight(self, i: int) -> float:
+        if i < len(self.tenant_weights):
+            return float(self.tenant_weights[i])
+        return 1.0
+
+
+def tenant_seed(master_seed: int, tenant_index: int) -> int:
+    """Per-tenant seed derived deterministically from the master seed:
+    the SAME (master seed, tenant) pair always yields the same
+    sub-trace, and tenant t's sub-trace is byte-identical to a
+    single-tenant trace generated directly from this seed (asserted in
+    tests/test_loadgen.py)."""
+    return (master_seed * 1_000_003 + 7_919 * (tenant_index + 1)) \
+        & 0x7FFFFFFF
 
 
 def generate_trace(cfg: LoadGenConfig) -> list[Event]:
@@ -129,6 +156,8 @@ def generate_trace(cfg: LoadGenConfig) -> list[Event]:
     yields the same byte-identical trace — the replay-seed discipline
     the chaos soak established.
     """
+    if cfg.tenants > 1:
+        return _generate_multi_tenant(cfg)
     rng = random.Random(cfg.seed)
     events: list[Event] = []
     pod_seq = 0
@@ -211,6 +240,26 @@ def generate_trace(cfg: LoadGenConfig) -> list[Event]:
     return events
 
 
+def _generate_multi_tenant(cfg: LoadGenConfig) -> list[Event]:
+    """One independent churn process per tenant, stamped and merged.
+
+    Each tenant's sub-trace is ``generate_trace`` of the SAME knobs
+    under its derived seed (so single-tenant determinism tests transfer
+    verbatim); the merged stream sorts by (t, tenant, kind, name) for a
+    stable, reproducible interleaving."""
+    import dataclasses as _dc
+
+    merged: list[Event] = []
+    for i, name in enumerate(cfg.tenant_names()):
+        sub = _dc.replace(cfg, seed=tenant_seed(cfg.seed, i), tenants=1)
+        for e in generate_trace(sub):
+            merged.append(Event(e.t, e.kind, e.name,
+                                {**e.payload, "tenant": name}))
+    merged.sort(key=lambda e: (e.t, e.payload.get("tenant", ""),
+                               e.kind, e.name))
+    return merged
+
+
 def write_trace(events: Iterable[Event], path: str) -> None:
     with open(path, "w") as f:
         for e in events:
@@ -229,13 +278,20 @@ def read_trace(path: str) -> list[Event]:
 
 def trace_stats(events: list[Event]) -> dict:
     counts: dict[str, int] = {}
+    tenants: dict[str, int] = {}
     for e in events:
         counts[e.kind] = counts.get(e.kind, 0) + 1
+        tenant = e.payload.get("tenant")
+        if tenant is not None:
+            tenants[tenant] = tenants.get(tenant, 0) + 1
     span = events[-1].t - events[0].t if len(events) > 1 else 0.0
-    return {"events": len(events), "span_s": round(span, 3),
-            "counts": counts,
-            "arrival_rate": (round(counts.get(POD_ADD, 0) / span, 3)
-                             if span > 0 else 0.0)}
+    stats = {"events": len(events), "span_s": round(span, 3),
+             "counts": counts,
+             "arrival_rate": (round(counts.get(POD_ADD, 0) / span, 3)
+                              if span > 0 else 0.0)}
+    if tenants:
+        stats["tenants"] = dict(sorted(tenants.items()))
+    return stats
 
 
 # ---------------------------------------------------------------------------
@@ -305,18 +361,48 @@ class SteadyStateHarness:
         self.monitor = None
         self.trend = None
         self.telemetry = None
+        #: multi-tenant assembly (cfg.tenants > 1): the TenantScheduler
+        #: front-end; per-tenant cluster stacks live in the maps below
+        self.front = None
+        self._feeders: dict = {}          # tenant -> feeder client
+        self._tenant_sched: dict = {}     # tenant -> Scheduler
+        self._quota_base: dict = {}       # (tenant, quota) -> base max
+        self._colocations: list = []      # one ColocationLoop per cluster
 
     # -- assembly ------------------------------------------------------------
 
-    def start(self) -> None:
+    def _build_quota_tree(self, tenant: str):
         import numpy as np
 
         from koordinator_tpu.api.resources import (
             NUM_RESOURCE_DIMS,
             resource_vector,
         )
+        from koordinator_tpu.quota.tree import QuotaTree
+
+        cfg = self.cfg
+        total = resource_vector(
+            cpu=cfg.node_cpu_milli * max(cfg.nodes, 1),
+            memory=cfg.node_memory_mib * max(cfg.nodes, 1))
+        quota_tree = QuotaTree(np.asarray(total, np.int64))
+        for name in cfg.quota_names():
+            qmax = (np.asarray(total, np.int64) * 2)
+            quota_tree.add(name, min=np.zeros(NUM_RESOURCE_DIMS, np.int64),
+                           max=qmax)
+            self._quota_base[(tenant, name)] = qmax.copy()
+        return quota_tree
+
+    def _start_cluster(self, tenant: str, scheduler, index: int):
+        """One cluster's socket stack: an RpcServer hosting a
+        StateSyncService bound to THIS tenant's scheduler (the
+        per-tenant sync binding — tenant isolation is structural: only
+        this feed can make this tenant stale), a feeder client, and a
+        manager-side watch + colocation loop.  Returns the server so
+        the caller can mount the (shared) SolveService on cluster 0."""
+        import numpy as np
+
+        from koordinator_tpu.api.resources import resource_vector
         from koordinator_tpu.cmd.binaries import ReconnectingSidecarClient
-        from koordinator_tpu.koordlet.metriccache import MetricCache
         from koordinator_tpu.manager.colocation_loop import (
             ColocationLoop,
             ManagerSyncBinding,
@@ -324,10 +410,6 @@ class SteadyStateHarness:
         from koordinator_tpu.manager.noderesource_controller import (
             NodeResourceController,
         )
-        from koordinator_tpu.quota.tree import QuotaTree
-        from koordinator_tpu.scheduler import ClusterSnapshot, Scheduler
-        from koordinator_tpu.selftelemetry import SelfTelemetry
-        from koordinator_tpu.slo_monitor import SloMonitor, default_specs
         from koordinator_tpu.transport import (
             RpcServer,
             StateSyncClient,
@@ -335,48 +417,23 @@ class SteadyStateHarness:
         )
         from koordinator_tpu.transport.deltasync import SchedulerBinding
         from koordinator_tpu.transport.retry import RetryPolicy
-        from koordinator_tpu.transport.services import SolveService
-        from koordinator_tpu.transport.wire import FrameType
-        from koordinator_tpu.trend import TrendEngine, default_trend_specs
-
-        self._np = np
-        self._resource_vector = resource_vector
-        self._FrameType = FrameType
-        R = NUM_RESOURCE_DIMS
 
         cfg = self.cfg
-        total = resource_vector(
-            cpu=cfg.node_cpu_milli * max(cfg.nodes, 1),
-            memory=cfg.node_memory_mib * max(cfg.nodes, 1))
-        quota_tree = QuotaTree(np.asarray(total, np.int64))
-        self._quota_base: dict[str, np.ndarray] = {}
-        for name in cfg.quota_names():
-            qmax = (np.asarray(total, np.int64) * 2)
-            quota_tree.add(name, min=np.zeros(R, np.int64), max=qmax)
-            self._quota_base[name] = qmax.copy()
-
-        snapshot = ClusterSnapshot(
-            capacity=max(16, 1 << (cfg.nodes - 1).bit_length()))
-        # staleness is wall-clock: at time_scale compression the sync
-        # feed beats every solve_interval/time_scale wall seconds, so
-        # 8 beats of silence is a real stall, not compression artifact
-        self.scheduler = Scheduler(
-            snapshot, quota_tree=quota_tree,
-            staleness_threshold_sec=max(
-                30.0, 8 * self.solve_interval_s / self.time_scale))
-        sock = f"{self.workdir}/loadgen.sock"
-        self._server = RpcServer(sock, service="scheduler")
-        self._sync = StateSyncService(retention=8192)
-        self._sync.attach(self._server)
-        self._sync.attach_binding(SchedulerBinding(self.scheduler))
-        SolveService(self.scheduler).attach(self._server)
-        self._server.start()
-        self._closers.append(self._server.stop)
+        FrameType = self._FrameType
+        sock = f"{self.workdir}/loadgen-{tenant}.sock"
+        server = RpcServer(sock, service="scheduler")
+        sync = StateSyncService(retention=8192)
+        sync.attach(server)
+        sync.attach_binding(SchedulerBinding(scheduler))
+        server.start()
+        self._closers.append(server.stop)
 
         retry = RetryPolicy(initial_backoff_s=0.05, max_backoff_s=0.5)
-        self.feeder = ReconnectingSidecarClient(sock, retry_policy=retry,
-                                                timeout=30.0)
-        self._closers.append(self.feeder.close)
+        feeder = ReconnectingSidecarClient(sock, retry_policy=retry,
+                                           timeout=30.0)
+        self._closers.append(feeder.close)
+        self._feeders[tenant] = feeder
+        self._tenant_sched[tenant] = scheduler
 
         binding = ManagerSyncBinding()
         mgr_sync = StateSyncClient(binding)
@@ -385,22 +442,95 @@ class SteadyStateHarness:
             mgr_sync.bind_client(client)
             mgr_sync.bootstrap(client)
 
-        self.mgr_client = ReconnectingSidecarClient(
+        mgr_client = ReconnectingSidecarClient(
             sock, on_push=mgr_sync.on_push, on_connect=bootstrap_watch,
             retry_policy=retry, timeout=30.0)
-        self._closers.append(self.mgr_client.close)
-        self.mgr_sync = mgr_sync
+        self._closers.append(mgr_client.close)
 
-        def push_allocatable(name, allocatable):
-            self.mgr_client.call(
+        def push_allocatable(name, allocatable,
+                             _client=mgr_client):
+            _client.call(
                 FrameType.STATE_PUSH,
                 {"kind": "node_allocatable", "name": name},
                 {"allocatable": np.asarray(allocatable, np.int32)})
 
-        self.colocation = ColocationLoop(NodeResourceController(), binding,
-                                         push_allocatable,
-                                         ensure_fn=self.mgr_client.ensure)
-        self.solver = ReconnectingSidecarClient(sock, retry_policy=retry,
+        self._colocations.append(ColocationLoop(
+            NodeResourceController(), binding, push_allocatable,
+            ensure_fn=mgr_client.ensure))
+
+        # register the fleet directly on the sync service (the
+        # informer-replay path the real binaries take at startup)
+        alloc = np.asarray(resource_vector(
+            cpu=cfg.node_cpu_milli, memory=cfg.node_memory_mib), np.int32)
+        for i in range(cfg.nodes):
+            sync.upsert_node(f"lg-n{i}", alloc)
+        self._node_alloc = alloc
+        if index == 0:
+            self._server = server
+            self._sync = sync
+            self.feeder = feeder
+            self.mgr_client = mgr_client
+            self.mgr_sync = mgr_sync
+        return server, sock
+
+    def start(self) -> None:
+        import numpy as np
+
+        from koordinator_tpu.api.resources import resource_vector
+        from koordinator_tpu.cmd.binaries import ReconnectingSidecarClient
+        from koordinator_tpu.koordlet.metriccache import MetricCache
+        from koordinator_tpu.scheduler import ClusterSnapshot, Scheduler
+        from koordinator_tpu.selftelemetry import SelfTelemetry
+        from koordinator_tpu.slo_monitor import (
+            SloMonitor,
+            default_specs,
+            tenant_slo_specs,
+        )
+        from koordinator_tpu.transport.retry import RetryPolicy
+        from koordinator_tpu.transport.services import SolveService
+        from koordinator_tpu.transport.wire import FrameType
+        from koordinator_tpu.trend import TrendEngine, default_trend_specs
+
+        self._np = np
+        self._resource_vector = resource_vector
+        self._FrameType = FrameType
+
+        cfg = self.cfg
+        names = cfg.tenant_names()
+        capacity = max(16, 1 << (cfg.nodes - 1).bit_length())
+        # staleness is wall-clock: at time_scale compression the sync
+        # feed beats every solve_interval/time_scale wall seconds, so
+        # 8 beats of silence is a real stall, not compression artifact
+        staleness = max(30.0, 8 * self.solve_interval_s / self.time_scale)
+        if cfg.tenants > 1:
+            from koordinator_tpu.scheduler.tenancy import (
+                TenantScheduler,
+                TenantSpec,
+            )
+
+            # the soak's budget is deliberately generous: the soak
+            # proves steady state, the fairness tests prove sharing
+            self.front = TenantScheduler(cycle_pod_budget=65_536)
+            solve_target = self.front
+            for i, name in enumerate(names):
+                tenant = self.front.add_tenant(
+                    TenantSpec(name=name, weight=cfg.tenant_weight(i),
+                               node_capacity=capacity),
+                    quota_tree=self._build_quota_tree(name),
+                    staleness_threshold_sec=staleness)
+                self._start_cluster(name, tenant.scheduler, i)
+            self.scheduler = self.front.primary
+        else:
+            quota_tree = self._build_quota_tree(names[0])
+            self.scheduler = Scheduler(
+                ClusterSnapshot(capacity=capacity), quota_tree=quota_tree,
+                staleness_threshold_sec=staleness)
+            solve_target = self.scheduler
+            self._start_cluster(names[0], self.scheduler, 0)
+        sock0 = f"{self.workdir}/loadgen-{names[0]}.sock"
+        SolveService(solve_target).attach(self._server)
+        retry = RetryPolicy(initial_backoff_s=0.05, max_backoff_s=0.5)
+        self.solver = ReconnectingSidecarClient(sock0, retry_policy=retry,
                                                 timeout=240.0)
         self._closers.append(self.solver.close)
 
@@ -412,9 +542,15 @@ class SteadyStateHarness:
             downsample_after_sec=600.0,
             downsample_resolution_sec=10.0)
         self.telemetry = SelfTelemetry("loadgen-harness")
+        specs = default_specs(
+            latency_threshold_s=self.slo_latency_threshold_s)
+        if cfg.tenants > 1:
+            # per-tenant p99 specs slice the shared latency histogram
+            # by its {tenant=...} label (slo_monitor.tenant_slo_specs)
+            specs = specs + tenant_slo_specs(
+                names, latency_threshold_s=self.slo_latency_threshold_s)
         self.monitor = SloMonitor(
-            specs=default_specs(
-                latency_threshold_s=self.slo_latency_threshold_s),
+            specs=specs,
             cache=cache,
             sample_interval_s=self.sample_interval_s,
             on_breach=lambda spec, doc:
@@ -426,23 +562,28 @@ class SteadyStateHarness:
                                      scale=self.trend_scale),
                                  window_s=max(cfg.duration_s, 600.0))
         self.scheduler.trend_engine = self.trend
+        if self.front is not None:
+            self.front.slo_monitor = self.monitor
+            self.front.trend_engine = self.trend
 
-        # -- register the fleet + warm the solve path before the trend
-        # window opens (jit compilation is one-time cost, not a trend)
-        alloc = np.asarray(resource_vector(
-            cpu=cfg.node_cpu_milli, memory=cfg.node_memory_mib), np.int32)
-        for i in range(cfg.nodes):
-            self._sync.upsert_node(f"lg-n{i}", alloc)
-        self._node_alloc = alloc
-        self.feeder.call(FrameType.STATE_PUSH,
-                         {"kind": "pod_add", "name": "lg-warm",
-                          "priority": 1000},
-                         {"requests": np.asarray(resource_vector(
-                             cpu=100, memory=64), np.int32)})
+        # -- warm the solve path before the trend window opens (jit
+        # compilation is one-time cost, not a trend): one warm pod per
+        # tenant, one cycle, removal
+        for name in names:
+            self._feeders[name].call(
+                FrameType.STATE_PUSH,
+                {"kind": "pod_add", "name": "lg-warm",
+                 "priority": 1000},
+                {"requests": np.asarray(resource_vector(
+                    cpu=100, memory=64), np.int32)})
         self.solver.call(FrameType.SOLVE_REQUEST, {}, deadline_ms=240_000)
-        self.feeder.call(FrameType.STATE_PUSH,
-                         {"kind": "pod_remove", "name": "lg-warm"})
-        self.colocation.tick()
+        for name in names:
+            self._feeders[name].call(
+                FrameType.STATE_PUSH,
+                {"kind": "pod_remove", "name": "lg-warm"})
+        for colocation in self._colocations:
+            colocation.tick()
+        self.colocation = self._colocations[0]
 
     # -- event application ---------------------------------------------------
 
@@ -451,6 +592,12 @@ class SteadyStateHarness:
         rv = self._resource_vector
         FrameType = self._FrameType
         p = event.payload
+        # tenant routing: every event lands on ITS tenant's feeder /
+        # scheduler / quota tree (single-tenant traces carry no tenant
+        # field and route to the only cluster)
+        tenant = p.get("tenant", self.cfg.tenant_names()[0])
+        feeder = self._feeders.get(tenant, self.feeder)
+        scheduler = self._tenant_sched.get(tenant, self.scheduler)
         try:
             if event.kind == POD_ADD:
                 if p.get("be"):
@@ -464,20 +611,20 @@ class SteadyStateHarness:
                     doc["gang"] = p["gang"]
                 if p.get("quota"):
                     doc["quota"] = p["quota"]
-                self.feeder.call(FrameType.STATE_PUSH, doc,
-                                 {"requests": np.asarray(req, np.int32)})
+                feeder.call(FrameType.STATE_PUSH, doc,
+                            {"requests": np.asarray(req, np.int32)})
             elif event.kind == POD_DEL:
                 if self.inject_queue_leak:
                     return          # the leak: completions never arrive
-                self.feeder.call(FrameType.STATE_PUSH,
-                                 {"kind": "pod_remove",
-                                  "name": event.name})
+                feeder.call(FrameType.STATE_PUSH,
+                            {"kind": "pod_remove",
+                             "name": event.name})
             elif event.kind == NODE_DOWN:
-                self.feeder.call(FrameType.STATE_PUSH,
-                                 {"kind": "node_remove",
-                                  "name": event.name})
+                feeder.call(FrameType.STATE_PUSH,
+                            {"kind": "node_remove",
+                             "name": event.name})
             elif event.kind == NODE_UP:
-                self.feeder.call(
+                feeder.call(
                     FrameType.STATE_PUSH,
                     {"kind": "node_upsert", "name": event.name},
                     {"allocatable": self._node_alloc})
@@ -487,16 +634,16 @@ class SteadyStateHarness:
                 # (events sort gang_burst < pod_add at equal t)
                 from koordinator_tpu.scheduler.scheduler import GangRecord
 
-                self.scheduler.register_gang(GangRecord(
+                scheduler.register_gang(GangRecord(
                     name=event.name, min_member=int(p["size"])))
             elif event.kind == QUOTA_UPDATE:
                 # quota specs don't ride the wire (they are CRs, not
                 # node state): churn them in-process under the round
                 # lock, the webhook-update path's equivalent
-                tree = self.scheduler.quota_tree
-                base = self._quota_base.get(event.name)
+                tree = scheduler.quota_tree
+                base = self._quota_base.get((tenant, event.name))
                 if tree is not None and base is not None:
-                    with self.scheduler.lock:
+                    with scheduler.lock:
                         node = tree.nodes.get(event.name)
                         if node is not None:
                             node.max = (base.astype(np.float64)
@@ -515,10 +662,11 @@ class SteadyStateHarness:
             self.rounds += 1
         except Exception:  # noqa: BLE001
             self.push_errors += 1
-        try:
-            self.colocation.tick()
-        except Exception:  # noqa: BLE001
-            self.push_errors += 1
+        for colocation in self._colocations:
+            try:
+                colocation.tick()
+            except Exception:  # noqa: BLE001
+                self.push_errors += 1
         self._maybe_leak_thread()
 
     def _maybe_leak_thread(self) -> None:
@@ -580,9 +728,14 @@ class SteadyStateHarness:
         normally publishes it — so read the queue depth directly."""
         from koordinator_tpu import metrics
 
-        with self.scheduler.lock:
-            depth = len(self.scheduler.pending)
-        metrics.pending_pods.set(float(depth))
+        for scheduler in (self._tenant_sched.values()
+                          if self._tenant_sched else [self.scheduler]):
+            with scheduler.lock:
+                depth = len(scheduler.pending)
+            metrics.pending_pods.set(
+                float(depth),
+                labels=({"tenant": scheduler.tenant}
+                        if scheduler.tenant else None))
         self._maybe_leak_thread()
 
     # -- verdict -------------------------------------------------------------
@@ -601,10 +754,46 @@ class SteadyStateHarness:
         report = self.trend.evaluate(window_s=window_s)
         slo = self.monitor.report()
         rec = self.scheduler.flight_recorder
-        with self.scheduler.lock:
-            pending = len(self.scheduler.pending)
-            bound = len(self.scheduler.bound)
-            degraded = self.scheduler.degraded
+        tenants_doc = None
+        if self.front is not None:
+            tenants_doc = {}
+            pending = bound = 0
+            degraded = False
+            records = dumps = overwrites = 0
+            for tenant in self.front.tenants():
+                sched = tenant.scheduler
+                with sched.lock:
+                    t_pending = len(sched.pending)
+                    t_bound = len(sched.bound)
+                    t_degraded = sched.degraded
+                fr = sched.flight_recorder
+                tenants_doc[tenant.name] = {
+                    "weight": tenant.spec.weight,
+                    "pending": t_pending,
+                    "bound": t_bound,
+                    "degraded": t_degraded,
+                    "rounds": tenant.rounds,
+                    "admitted_total": tenant.admitted_total,
+                    "flight_dumps": fr.dumps,
+                }
+                pending += t_pending
+                bound += t_bound
+                degraded = degraded or t_degraded
+                records += len(fr.records)
+                dumps += fr.dumps
+                overwrites += fr.overwrites
+            flight = {"records": records, "dumps": dumps,
+                      "overwrites": overwrites}
+        else:
+            with self.scheduler.lock:
+                pending = len(self.scheduler.pending)
+                bound = len(self.scheduler.bound)
+                degraded = self.scheduler.degraded
+            flight = {
+                "records": len(rec.records),
+                "dumps": rec.dumps,
+                "overwrites": rec.overwrites,
+            }
         doc = {
             "trend": report,
             "slo_breached": slo.get("breached", []),
@@ -618,14 +807,16 @@ class SteadyStateHarness:
             "bound": bound,
             "degraded": degraded,
             "backlog_peak": metrics.sync_binding_backlog_peak.value(),
-            "flight": {
-                "records": len(rec.records),
-                "dumps": rec.dumps,
-                "overwrites": rec.overwrites,
-            },
+            "flight": flight,
             "green": (not report["leaking"] and not report["drifting"]
                       and not slo.get("breached") and not degraded),
         }
+        if tenants_doc is not None:
+            doc["tenants"] = tenants_doc
+            doc["cycle"] = {
+                "mode": self.front.last_mode,
+                "host_wait_fraction": self.front.last_host_wait_fraction,
+            }
         return doc
 
     def close(self) -> None:
@@ -645,12 +836,13 @@ class SteadyStateHarness:
         self._closers.clear()
 
 
-def smoke_config(seed: int = 0) -> LoadGenConfig:
+def smoke_config(seed: int = 0, tenants: int = 1) -> LoadGenConfig:
     """The small, fast, fixed shape the tier-1 smoke and the
     SOAK_LOADGEN=1 hook share: seconds of wall clock, every event kind
     exercised."""
     return LoadGenConfig(
         seed=seed,
+        tenants=tenants,
         duration_s=120.0,
         nodes=24,
         node_cpu_milli=32_000,
@@ -678,13 +870,18 @@ def main(argv: list[str] | None = None) -> int:
                         help="virtual seconds of churn")
     parser.add_argument("--nodes", type=int, default=10_000)
     parser.add_argument("--arrival-rate", type=float, default=8.0)
+    parser.add_argument("--tenants", type=int, default=1,
+                        help="emit one independent churn process per "
+                             "tenant (tenant id on every event; "
+                             "per-tenant seeds derive from --seed)")
     parser.add_argument("--out", default="",
                         help="write the trace as JSONL here")
     parser.add_argument("--stats", action="store_true",
                         help="print event-kind tallies for the trace")
     args = parser.parse_args(argv)
     cfg = LoadGenConfig(seed=args.seed, duration_s=args.duration,
-                        nodes=args.nodes, arrival_rate=args.arrival_rate)
+                        nodes=args.nodes, arrival_rate=args.arrival_rate,
+                        tenants=args.tenants)
     events = generate_trace(cfg)
     if args.out:
         write_trace(events, args.out)

@@ -52,7 +52,10 @@
 #                                     breaches, and FAILS the soak on a
 #                                     leak/drift (red) verdict; the
 #                                     injected-thread-leak self-test
-#                                     runs too (must come back red)
+#                                     runs too (must come back red),
+#                                     plus a 4-tenant multi-cluster
+#                                     smoke whose per-tenant verdict
+#                                     section must come back green
 #         SOAK_CHAOS   (default 0)    1 = also sweep the chaos
 #                                     fault-injection suite (tests/
 #                                     test_chaos.py, `chaos` marker)
@@ -219,6 +222,22 @@ if [ "$LOADGEN" = "1" ]; then
         total_failed=$((total_failed + 1))
         failures="$failures;leak self-test: injected thread leak was NOT"
         failures="$failures caught (see log)"
+    fi
+    # multi-tenant smoke (ISSUE 11): four simulated clusters on one
+    # TenantScheduler mesh — one churn process + socket stack + sync
+    # binding per tenant; the verdict's per-tenant section must be
+    # populated and GREEN (no tenant degraded)
+    echo "== multi-tenant steady-state smoke (soak_report --tenants 4)" \
+        | tee -a "$log"
+    if python tools/soak_report.py --tenants 4 --duration 60 --nodes 16 \
+            >> "$log" 2>&1; then
+        grep -E "^(-- tenants|   t[0-9]|VERDICT)" "$log" | tail -7
+        total_passed=$((total_passed + 1))
+    else
+        tail -12 "$log"
+        total_failed=$((total_failed + 1))
+        failures="$failures;multi-tenant smoke: red verdict or harness"
+        failures="$failures failure (see log)"
     fi
 fi
 

@@ -85,6 +85,20 @@ def print_report(verdict: dict, harness) -> None:
     print(f"-- flight recorder: {fl['records']} records, "
           f"{fl['dumps']} dumps, {fl['overwrites']} overwritten "
           f"(ring {harness.scheduler.flight_recorder.capacity})")
+    tenants = verdict.get("tenants")
+    if tenants:
+        cycle = verdict.get("cycle", {})
+        print(f"-- tenants ({len(tenants)}; cycle mode="
+              f"{cycle.get('mode', '?')} host-wait="
+              f"{cycle.get('host_wait_fraction', 0.0):.3f})")
+        print(f"   {'tenant':<8} {'w':>4} {'pending':>8} {'bound':>7} "
+              f"{'rounds':>7} {'admitted':>9} {'degraded':>9} "
+              f"{'dumps':>6}")
+        for name, t in sorted(tenants.items()):
+            print(f"   {name:<8} {t['weight']:>4.1f} "
+                  f"{t['pending']:>8} {t['bound']:>7} "
+                  f"{t['rounds']:>7} {t['admitted_total']:>9} "
+                  f"{str(t['degraded']):>9} {t['flight_dumps']:>6}")
     # the join: every non-steady series arrives WITH the rounds that
     # overlapped it — dumped (slow/degraded/slo) rounds first, else the
     # slowest — so the leak verdict and its "what was happening" flight
@@ -116,6 +130,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--arrival-rate", type=float, default=None)
     parser.add_argument("--time-scale", type=float, default=12.0,
                         help="virtual:wall compression (1 = real time)")
+    parser.add_argument("--tenants", type=int, default=1,
+                        help="simulate N clusters on one TenantScheduler "
+                             "mesh (one churn process + socket stack per "
+                             "tenant; the verdict gains a per-tenant "
+                             "section)")
     parser.add_argument("--trace", default="",
                         help="replay this JSONL trace instead of "
                              "generating one from the seed")
@@ -132,7 +151,7 @@ def main(argv: list[str] | None = None) -> int:
                         help="dump the raw verdict document too")
     args = parser.parse_args(argv)
 
-    cfg = loadgen.smoke_config(seed=args.seed)
+    cfg = loadgen.smoke_config(seed=args.seed, tenants=args.tenants)
     overrides = {}
     if args.duration is not None:
         overrides["duration_s"] = args.duration
