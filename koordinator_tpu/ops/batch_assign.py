@@ -122,6 +122,7 @@ def _ranked_scores(
                        node_ids, n_total)[0]
 
 
+# koordlint: shape[ret0: PxN i32 -1..1073741823, ret1: PxN i32 0..1073741823]
 def _rank_parts(
     scores: jnp.ndarray, feasible: jnp.ndarray, spread_bits: int = 0,
     rot_id: jnp.ndarray | None = None,
@@ -162,6 +163,7 @@ def _candidate_tb(node: jnp.ndarray, rot_id: jnp.ndarray,
     return (n_total - 1) - ((node - rot) % n_total)
 
 
+# koordlint: shape[score: Pxk i32 -1..32767]
 def _candidate_keys(score: jnp.ndarray, node: jnp.ndarray,
                     rot_id: jnp.ndarray, spread_bits: int,
                     n_total: int) -> jnp.ndarray:
@@ -493,6 +495,7 @@ def _reduce_candidates(scores, feasible, strata, k: int, method: str,
                 shift = max(24 - score_bits, 0)
                 fkey = jnp.where(
                     key >= 0,
+                    # koordlint: ignore[dtype-regime] -- trace-time Python int shift (arbitrary precision) feeding a float32 scale, never int32 array math
                     key.astype(jnp.float32) * float(1 << shift)
                     + (tb >> max(tb_bits - shift, 0)).astype(jnp.float32),
                     -1.0)

@@ -93,6 +93,7 @@ def _shard_offset(n_local: int) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
+# koordlint: shape[st_local: NxR i32 nodes]
 def _local_select_body(st_local, pods, cfg, *, k, strata, n_total):
     """Shard-local fused Filter+Score + per-stratum local top-k, then the
     cross-shard merge.  Returns replicated (cand_key, cand_node,
@@ -166,6 +167,7 @@ def sharded_select_candidates(mesh, state, pods, cfg, k: int = 32,
 # ---------------------------------------------------------------------------
 
 
+# koordlint: shape[st_local: NxR i32 nodes, cand_key: Pxk i32 rep, cand_node: Pxk i32 rep]
 def _rounds_local(st_local, pods, quota, cand_key, cand_node, *,
                   rounds, n_total):
     """The propose/accept loop with node tensors shard-local.  Mirrors

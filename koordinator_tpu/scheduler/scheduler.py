@@ -1597,6 +1597,7 @@ class Scheduler:
         return handle
 
     # koordlint: guarded-by(self.lock)
+    # koordlint: shape[a: P i32 rep, new_state: NxR i32 nodes]
     def round_adopt_batched(self, handle: RoundHandle, a, new_state,
                             new_quota, est_accum, cache, k: int,
                             method: str) -> RoundHandle:

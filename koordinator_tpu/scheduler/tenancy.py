@@ -436,6 +436,7 @@ class TenantScheduler:
                 state, batch, quota, ck, cn, cfg, rounds=rounds)
             return a, st, q, est, ck, cn, cs
 
+        # koordlint: shape[state: TxNxR i32, batch: TxP i32, quota: TxQ i32]
         def program(state, batch, quota, cfg):
             # cfg broadcasts over the tenant axis (in_axes=None) — one
             # shared ScoringConfig, exactly the serial entries' shape

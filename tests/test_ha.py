@@ -7,7 +7,6 @@ story: new leader rebuilds state through the startup sync barrier
 import threading
 import time
 
-import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, resource_vector
@@ -153,8 +152,8 @@ def test_failover_scheduler_restart_through_sync_barrier():
         usage=np.zeros(R, np.int32)))
     binds = []
     cfg = ScoringConfig.default().replace(
-        usage_thresholds=jnp.zeros(R, jnp.int32),
-        estimator_defaults=jnp.zeros(R, jnp.int32))
+        usage_thresholds=np.zeros(R, np.int32),
+        estimator_defaults=np.zeros(R, np.int32))
     barrier = SyncBarrier(mark=mark,
                           observed_version=lambda: informer["version"])
     barrier.start()

@@ -18,18 +18,30 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 
 import pytest
 
 from tools import koordlint
+from tools.koordlint.analyzers.donation_flow import DonationFlowAnalyzer
 from tools.koordlint.analyzers.donation_safety import DonationSafetyAnalyzer
+from tools.koordlint.analyzers.dtype_regime import DtypeRegimeAnalyzer
 from tools.koordlint.analyzers.jit_host_sync import JitHostSyncAnalyzer
 from tools.koordlint.analyzers.lock_discipline import LockDisciplineAnalyzer
 from tools.koordlint.analyzers.marker_audit import MarkerAuditAnalyzer
 from tools.koordlint.analyzers.mesh_discipline import MeshDisciplineAnalyzer
+from tools.koordlint.analyzers.spec_consistency import (
+    SpecConsistencyAnalyzer,
+)
 from tools.koordlint.analyzers.surface_parity import SurfaceParityAnalyzer
+from tools.koordlint.analyzers.tenant_axis import TenantAxisAnalyzer
 from tools.koordlint.analyzers import dashboard_drift
-from tools.koordlint.core import Project, apply_suppressions, load_baseline
+from tools.koordlint.core import (
+    Project,
+    SourceFile,
+    apply_suppressions,
+    load_baseline,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tools", "koordlint", "fixtures")
@@ -183,19 +195,221 @@ class TestMarkerAuditCorpus:
             corpus("marker_audit", "good", ("tests",))) == []
 
 
+class TestDtypeRegimeCorpus:
+    def analyzer(self):
+        return DtypeRegimeAnalyzer(package="pkg", targets=("pkg/ops.py",))
+
+    def test_bad_corpus_flags_the_packed_regime_wall(self):
+        findings = self.analyzer().run(
+            corpus("dtype_regime", "bad", ("pkg",)))
+        messages = "\n".join(f.message for f in findings)
+        # the reconstructed 2**15 ranking-key overflow: a 2**20-wide
+        # clip pushes `q << 15` past int32
+        assert "packed ranking-key arithmetic overflows" in messages
+        # the unguarded packed composition: no _packed_regime gate, so
+        # the tie-break field has no provable 15-bit bound
+        assert "no provable bound" in messages
+        assert "2**15" in messages
+        # unseeded shift operand + the lying retN contract
+        assert "cannot be proven to fit int32" in messages
+        assert "shape annotation declares" in messages
+        assert len(findings) == 5
+
+    def test_good_corpus_is_clean(self):
+        # guard + clip + rotation idiom + annotation seeds all prove
+        assert self.analyzer().run(
+            corpus("dtype_regime", "good", ("pkg",))) == []
+
+
+class TestSpecConsistencyCorpus:
+    def analyzer(self):
+        return SpecConsistencyAnalyzer(package="pkg")
+
+    def test_bad_corpus_flags_every_seeded_violation(self):
+        findings = self.analyzer().run(
+            corpus("spec_consistency", "bad", ("pkg",)))
+        messages = "\n".join(f.message for f in findings)
+        assert "names an axis not live" in messages       # psum("pods")
+        assert "in_specs declares 3 entries" in messages  # arity drift
+        assert "out_specs declares 2 entries" in messages
+        assert "replicas" in messages and "diverge" in messages
+        assert "propagated layout contradicts" in messages
+        assert len(findings) == 5
+
+    def test_good_corpus_is_clean(self):
+        # right axis, aligned arities, sharded-base scatter (with the
+        # shape-annotation layout seed), matched chained layouts
+        assert self.analyzer().run(
+            corpus("spec_consistency", "good", ("pkg",))) == []
+
+
+class TestDonationFlowCorpus:
+    def analyzer(self):
+        return DonationFlowAnalyzer(package="pkg")
+
+    def test_bad_corpus_flags_missing_swap_and_stash(self):
+        findings = self.analyzer().run(
+            corpus("donation_flow", "bad", ("pkg",)))
+        messages = "\n".join(f.message for f in findings)
+        # the interprocedural kill: dispatch_without_swap leaves the
+        # state dead, round()'s commit() call reads it two hops later
+        assert "left dead" in messages
+        assert "commit" in messages
+        # the stash-the-donated-buffer tenancy anti-idiom
+        assert "stash" in messages
+        # a store through a REBOUND alias must not count as the swap
+        assert messages.count("read after its buffers were donated") == 1
+        assert len(findings) == 3
+
+    def test_good_corpus_is_clean(self):
+        # blessed swap, metadata reads, swap-through-method (the
+        # adopt_state idiom), and the rebind idiom all pass
+        assert self.analyzer().run(
+            corpus("donation_flow", "good", ("pkg",))) == []
+
+
+class TestTenantAxisCorpus:
+    def analyzer(self):
+        return TenantAxisAnalyzer(package="pkg",
+                                  targets=("pkg/front.py",))
+
+    def test_bad_corpus_flags_unreduced_tenant_axis(self):
+        findings = self.analyzer().run(
+            corpus("tenant_axis", "bad", ("pkg",)))
+        messages = "\n".join(f.message for f in findings)
+        assert "still carries the leading tenant axis" in messages
+        # the kit-entry contract from the binding's shape annotation
+        assert "per-tenant contract" in messages
+        assert len(findings) == 5
+
+    def test_good_corpus_is_clean(self):
+        # every slice _unstack'd (or [i]-indexed) before the sink
+        assert self.analyzer().run(
+            corpus("tenant_axis", "good", ("pkg",))) == []
+
+
+@pytest.fixture(scope="module")
+def real_tree():
+    """One whole-tree parse shared by every real-code specflow test
+    (the parse dominates; SourceFiles are immutable so clones are
+    cheap)."""
+    return Project(REPO)
+
+
+def clone_project(base: Project) -> Project:
+    clone = object.__new__(Project)
+    clone.root = base.root
+    clone.files = dict(base.files)
+    return clone
+
+
+class TestSpecflowOnRealCode:
+    """The acceptance demos: the proofs hold on the SHIPPED solver, and
+    deliberately breaking a previously-unchecked invariant fails the
+    build — not just on fixtures."""
+
+    def _mutated(self, base, path, old, new):
+        project = clone_project(base)
+        src = project.files[path].text
+        assert old in src, f"mutation anchor missing from {path}"
+        fd, tmp = tempfile.mkstemp(suffix=".py")
+        with os.fdopen(fd, "w") as f:
+            f.write(src.replace(old, new, 1))
+        project.files[path] = SourceFile(tmp, path)
+        os.unlink(tmp)
+        return project
+
+    def test_real_batch_assign_proves_clean(self):
+        # through the runner so the one reasoned inline ignore (the
+        # trace-time float-scale shift) applies, as in the gate
+        result = koordlint.run(REPO, rules=["dtype-regime"])
+        assert result.findings == [], "\n".join(
+            f.render() for f in result.findings)
+        assert result.suppressed, "the reasoned inline ignore is live"
+
+    def test_widened_clip_overflows_the_packed_key(self, real_tree):
+        # the 2**15-wall class of bug, planted in the REAL solver: a
+        # 2**20-wide score clip pushes `q << _TB_BITS` past int32
+        project = self._mutated(
+            real_tree,
+            "koordinator_tpu/ops/batch_assign.py",
+            "_SCORE_CLIP = (1 << 30 - _TB_BITS) - 1",
+            "_SCORE_CLIP = (1 << 20) - 1")
+        messages = "\n".join(
+            f.message for f in DtypeRegimeAnalyzer().run(project))
+        assert "packed ranking-key arithmetic overflows" in messages
+
+    def test_removed_regime_guard_fails_the_field_proof(self, real_tree):
+        # delete the packed/wide split: the tie-break field can reach
+        # n_total - 1 > 2**15 and the rule must refuse the proof
+        project = self._mutated(
+            real_tree,
+            "koordinator_tpu/ops/batch_assign.py",
+            "key = ((q << _TB_BITS) | tb) if _packed_regime(n_total) "
+            "else q",
+            "key = (q << _TB_BITS) | tb")
+        messages = "\n".join(
+            f.message for f in DtypeRegimeAnalyzer().run(project))
+        assert "reserves only 15 bits" in messages
+
+    def test_real_scheduler_double_buffer_proves_clean(self, real_tree):
+        findings = DonationFlowAnalyzer().run(clone_project(real_tree))
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_dropped_unstack_in_real_tenancy_is_rank_drift(
+            self, real_tree):
+        # hand one tenant the still-stacked assignments instead of its
+        # _unstack'd slice: the tenant-axis taint must reach the sink
+        project = self._mutated(
+            real_tree,
+            "koordinator_tpu/scheduler/tenancy.py",
+            "                self._unstack(a, i), "
+            "self._unstack(st, i),",
+            "                a, self._unstack(st, i),")
+        messages = "\n".join(
+            f.message for f in TenantAxisAnalyzer().run(project))
+        assert "still carries the leading tenant axis" in messages
+
+    def test_removed_blessed_swap_is_caught_interprocedurally(
+            self, real_tree):
+        # delete the dispatch half's re-point of snapshot.state: the
+        # read surfaces FUNCTIONS AWAY (schedule_round's host-half
+        # introspection) — the class donation-safety cannot see
+        project = self._mutated(
+            real_tree,
+            "koordinator_tpu/scheduler/scheduler.py",
+            "                self.snapshot.state = new_state\n",
+            "")
+        findings = DonationFlowAnalyzer().run(project)
+        assert findings, "missing-swap mutation produced no findings"
+        messages = "\n".join(f.message for f in findings)
+        assert "self.snapshot.state" in messages
+        assert "left dead" in messages
+
+
+@pytest.fixture(scope="module")
+def full_tree_run():
+    """ONE full-suite CLI run shared by the whole-tree gate and the
+    wall-clock guard (each whole-tree pass costs ~5s of tier-1)."""
+    return subprocess.run(
+        [sys.executable, "-m", "tools.koordlint", "--format", "json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+
+
 class TestWholeTree:
     """The gate tier-1 actually enforces: the shipped tree is clean."""
 
-    def test_tree_is_clean_and_baseline_is_live(self):
-        result = koordlint.run(REPO)
-        assert result.findings == [], "\n".join(
-            f.render() for f in result.findings)
+    def test_tree_is_clean_and_baseline_is_live(self, full_tree_run):
+        assert full_tree_run.returncode == 0, (
+            full_tree_run.stdout[-2000:] + full_tree_run.stderr)
+        doc = json.loads(full_tree_run.stdout)
+        assert doc["findings"] == []
         # the baseline is doing real work (grandfathered jax imports)
         # and every suppression carries a reason by construction
-        assert result.suppressed
-        assert all(reason.strip() for _, reason in result.suppressed)
+        assert doc["suppressed"]
+        assert all(e["reason"].strip() for e in doc["suppressed"])
         # no dead weight: every baseline entry still matches something
-        assert result.stale_baseline == []
+        assert doc["stale_baseline"] == []
 
     def test_every_shipped_analyzer_has_a_corpus(self):
         for cls in koordlint.ALL_ANALYZERS:
@@ -276,12 +490,63 @@ class TestCli:
     def test_unknown_rule_exits_two(self):
         assert self._run("--rule", "no-such-rule").returncode == 2
 
-    def test_list_rules_names_all_six(self):
+    def test_list_rules_names_every_shipped_rule(self):
         proc = self._run("--list-rules")
         assert proc.returncode == 0
         for rule in ("jit-host-sync", "donation-safety", "lock-discipline",
-                     "surface-parity", "dashboard-drift", "marker-audit"):
+                     "surface-parity", "dashboard-drift", "marker-audit",
+                     "mesh-discipline", "spec-consistency", "dtype-regime",
+                     "donation-flow", "tenant-axis"):
             assert rule in proc.stdout
+
+    def test_format_json_is_machine_readable(self):
+        proc = self._run("--rule", "marker-audit", "--format", "json")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["findings"] == []
+        assert doc["suppressed"], "baseline suppressions should appear"
+        entry = doc["suppressed"][0]["finding"]
+        # the pre-commit contract: file/line/rule/message/fix-hint
+        assert set(entry) >= {"rule", "path", "line", "message", "hint"}
+        assert doc["elapsed_s"] > 0
+
+    def test_changed_only_filters_to_touched_files(self, tmp_path):
+        repo = tmp_path / "repo"
+        (repo / "tests").mkdir(parents=True)
+        (repo / "koordinator_tpu").mkdir()
+        (repo / "tools").mkdir()
+        (repo / "tests" / "test_old.py").write_text("import jax\n")
+
+        def git(*args):
+            subprocess.run(["git", *args], cwd=repo, check=True,
+                           capture_output=True, timeout=30)
+
+        git("init", "-q")
+        git("config", "user.email", "t@t")
+        git("config", "user.name", "t")
+        git("add", "-A")
+        git("commit", "-qm", "seed")
+        # a NEW bad file after the ref: only it may be reported
+        (repo / "tests" / "test_new.py").write_text("import jax\n")
+        proc = self._run("--root", str(repo), "--no-baseline",
+                         "--changed-only", "HEAD", "--format", "json")
+        doc = json.loads(proc.stdout)
+        paths = {f["path"] for f in doc["findings"]}
+        assert paths == {"tests/test_new.py"}, doc["findings"]
+        assert proc.returncode == 1
+        assert doc["changed_only"] == ["tests/test_new.py"]
+
+    def test_full_tree_stays_inside_the_tier1_budget(self, full_tree_run):
+        # the wall-clock guard the issue demands: the dataflow engine
+        # must not silently eat the tier-1 budget.  elapsed_s is the
+        # tool's own timing (interpreter startup excluded); the run is
+        # shared with TestWholeTree's gate
+        assert full_tree_run.returncode == 0, (
+            full_tree_run.stdout[-2000:] + full_tree_run.stderr)
+        doc = json.loads(full_tree_run.stdout)
+        assert doc["elapsed_s"] < 20.0, (
+            f"full-tree koordlint took {doc['elapsed_s']}s — the "
+            "static-analysis suite is eating the tier-1 budget")
 
 
 class TestRuntimeHelpers:

@@ -6,7 +6,6 @@ solve bridge (SURVEY.md §7 step 4)."""
 import threading
 import time
 
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -108,8 +107,8 @@ def test_rpc_call_and_error(rpc):
 def mk_scheduler():
     snap = ClusterSnapshot(capacity=16)
     cfg = ScoringConfig.default().replace(
-        usage_thresholds=jnp.zeros(R, jnp.int32),
-        estimator_defaults=jnp.zeros(R, jnp.int32))
+        usage_thresholds=np.zeros(R, np.int32),
+        estimator_defaults=np.zeros(R, np.int32))
     return Scheduler(snap, config=cfg)
 
 
@@ -418,8 +417,8 @@ def test_bound_pod_delete_releases_reservation_and_quota(rpc):
     tree.add("team", min=resource_vector(cpu=1_000).astype("int64"),
              max=np.full(R, UNBOUNDED, "int64"))
     cfg = ScoringConfig.default().replace(
-        usage_thresholds=jnp.zeros(R, jnp.int32),
-        estimator_defaults=jnp.zeros(R, jnp.int32))
+        usage_thresholds=np.zeros(R, np.int32),
+        estimator_defaults=np.zeros(R, np.int32))
     sched = Scheduler(snap, config=cfg, quota_tree=tree)
     SolveService(sched).attach(server)
 
@@ -558,8 +557,8 @@ def test_fine_grained_registries_ride_node_sync(rpc):
 
     snap = ClusterSnapshot(capacity=16)
     cfg = ScoringConfig.default().replace(
-        usage_thresholds=jnp.zeros(R, jnp.int32),
-        estimator_defaults=jnp.zeros(R, jnp.int32))
+        usage_thresholds=np.zeros(R, np.int32),
+        estimator_defaults=np.zeros(R, np.int32))
     sched = Scheduler(snap, config=cfg, cpu_manager=CPUManager(),
                       device_manager=DeviceManager())
     SolveService(sched).attach(server)
@@ -653,8 +652,8 @@ def test_koordlet_device_report_feeds_scheduler_over_wire(rpc, tmp_path):
 
     snap = ClusterSnapshot(capacity=16)
     scoring = ScoringConfig.default().replace(
-        usage_thresholds=jnp.zeros(R, jnp.int32),
-        estimator_defaults=jnp.zeros(R, jnp.int32))
+        usage_thresholds=np.zeros(R, np.int32),
+        estimator_defaults=np.zeros(R, np.int32))
     sched = Scheduler(snap, config=scoring, cpu_manager=CPUManager(),
                       device_manager=DeviceManager())
     SolveService(sched).attach(server)

@@ -25,11 +25,19 @@ BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def run(root: str, rules: list[str] | None = None,
-        baseline_path: str | None = BASELINE_PATH) -> RunResult:
+        baseline_path: str | None = BASELINE_PATH,
+        only_paths: set[str] | None = None) -> RunResult:
     """Run the suite over a repo root and apply suppressions.
 
     ``rules`` filters analyzers by name; ``baseline_path=None`` skips
     the baseline (raw findings — what ``--no-baseline`` shows).
+
+    ``only_paths`` (repo-relative, forward slashes) restricts the
+    REPORTED findings to the given files — the ``--changed-only`` fast
+    path.  Analysis (and the call graph the interprocedural rules seed
+    from) still runs whole-tree, so a change in one file that breaks an
+    invariant in another is attributed to whichever file holds the
+    finding; baseline staleness stays computed against the full set.
     """
     project = Project(root)
     analyzers = [a for a in make_all()
@@ -50,6 +58,9 @@ def run(root: str, rules: list[str] | None = None,
         baseline = [e for e in baseline if e.rule in rules]
     result = apply_suppressions(project, findings, baseline)
     result.findings.extend(hygiene)
+    if only_paths is not None:
+        result.findings = [f for f in result.findings
+                           if f.path in only_paths]
     return result
 
 

@@ -2,7 +2,14 @@
 
 Exit codes: 0 = clean (suppressed findings allowed), 1 = unsuppressed
 findings (the CI/soak gate), 2 = bad usage.  Runs at the head of
-tools/soak.sh and inside tier-1 via tests/test_koordlint.py.
+tools/soak.sh (``--format json``) and inside tier-1 via
+tests/test_koordlint.py.
+
+``--format json`` emits machine-readable findings (file/line/rule/
+message/fix-hint) for pre-commit hooks and the soak head;
+``--changed-only <git-ref>`` reports only findings in files touched
+since the ref (the call graph is still built whole-tree, so
+interprocedural rules keep their seeds).
 """
 
 from __future__ import annotations
@@ -10,10 +17,30 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
 from . import BASELINE_PATH, make_all, run
+
+
+def changed_paths(root: str, ref: str) -> set[str] | None:
+    """Repo-relative .py files touched since ``ref`` (committed or
+    not), or None when git cannot answer."""
+    out: set[str] = set()
+    for cmd in (["git", "diff", "--name-only", ref, "--", "*.py"],
+                ["git", "ls-files", "--others", "--exclude-standard",
+                 "--", "*.py"]):
+        try:
+            proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.update(line.strip().replace(os.sep, "/")
+                   for line in proc.stdout.splitlines() if line.strip())
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -21,7 +48,8 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m tools.koordlint",
         description="repo-native static analysis (jit purity, donation "
                     "safety, lock discipline, surface parity, dashboard "
-                    "drift, marker audit)")
+                    "drift, marker audit, specflow mesh/dtype/donation/"
+                    "tenancy dataflow rules)")
     parser.add_argument("--root", default=None,
                         help="repo root (default: this package's repo)")
     parser.add_argument("--rule", action="append", dest="rules",
@@ -29,8 +57,17 @@ def main(argv: list[str] | None = None) -> int:
                         help="run only the named rule (repeatable)")
     parser.add_argument("--no-baseline", action="store_true",
                         help="ignore baseline.json (show every finding)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text", dest="fmt",
+                        help="output format (json = machine-readable "
+                             "findings with file/line/rule/fix-hint)")
     parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="machine-readable findings on stdout")
+                        help="deprecated alias for --format json")
+    parser.add_argument("--changed-only", metavar="GIT_REF",
+                        dest="changed_only",
+                        help="report only findings in files touched "
+                             "since GIT_REF (callgraph still built "
+                             "whole-tree)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
     args = parser.parse_args(argv)
@@ -49,18 +86,29 @@ def main(argv: list[str] | None = None) -> int:
             print(f"unknown rule {r!r}; try --list-rules", file=sys.stderr)
             return 2
 
+    only: set[str] | None = None
+    if args.changed_only:
+        only = changed_paths(root, args.changed_only)
+        if only is None:
+            print(f"--changed-only: git diff against "
+                  f"{args.changed_only!r} failed in {root}",
+                  file=sys.stderr)
+            return 2
+
     t0 = time.perf_counter()
     result = run(root, rules=args.rules,
-                 baseline_path=None if args.no_baseline else BASELINE_PATH)
+                 baseline_path=None if args.no_baseline else BASELINE_PATH,
+                 only_paths=only)
     elapsed = time.perf_counter() - t0
 
-    if args.as_json:
+    if args.as_json or args.fmt == "json":
         print(json.dumps({
             "findings": [f.to_doc() for f in result.findings],
             "suppressed": [{"finding": f.to_doc(), "reason": r}
                            for f, r in result.suppressed],
             "stale_baseline": [e.rule + ":" + e.path
                                for e in result.stale_baseline],
+            "changed_only": sorted(only) if only is not None else None,
             "elapsed_s": round(elapsed, 3),
         }, indent=2))
         return 0 if result.ok else 1
@@ -72,7 +120,9 @@ def main(argv: list[str] | None = None) -> int:
               f"[{entry.rule}] {entry.path!r} ({entry.reason})",
               file=sys.stderr)
     status = "FAIL" if result.findings else "OK"
-    print(f"koordlint {status}: {len(result.findings)} finding(s), "
+    scope = (f" ({len(only)} changed file(s))"
+             if only is not None else "")
+    print(f"koordlint {status}: {len(result.findings)} finding(s){scope}, "
           f"{len(result.suppressed)} suppressed-with-reason, "
           f"{elapsed:.2f}s")
     return 0 if result.ok else 1

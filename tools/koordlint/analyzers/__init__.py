@@ -4,12 +4,16 @@ runs (docs/static_analysis.md documents each rule + how to add one)."""
 from __future__ import annotations
 
 from .dashboard_drift import DashboardDriftAnalyzer
+from .donation_flow import DonationFlowAnalyzer
 from .donation_safety import DonationSafetyAnalyzer
+from .dtype_regime import DtypeRegimeAnalyzer
 from .jit_host_sync import JitHostSyncAnalyzer
 from .lock_discipline import LockDisciplineAnalyzer
 from .marker_audit import MarkerAuditAnalyzer
 from .mesh_discipline import MeshDisciplineAnalyzer
+from .spec_consistency import SpecConsistencyAnalyzer
 from .surface_parity import SurfaceParityAnalyzer
+from .tenant_axis import TenantAxisAnalyzer
 
 ALL_ANALYZERS = (
     JitHostSyncAnalyzer,
@@ -19,6 +23,11 @@ ALL_ANALYZERS = (
     DashboardDriftAnalyzer,
     MarkerAuditAnalyzer,
     MeshDisciplineAnalyzer,
+    # specflow dataflow rules (ISSUE 12)
+    SpecConsistencyAnalyzer,
+    DtypeRegimeAnalyzer,
+    DonationFlowAnalyzer,
+    TenantAxisAnalyzer,
 )
 
 

@@ -1,0 +1,172 @@
+"""dtype-regime: an interval proof over the packed int32 ranking key.
+
+The batched solver packs (quantized score, rotated tie-break) into ONE
+int32 — ``(q << _TB_BITS) | tb`` — and PR 10 split the key into a
+packed regime (node capacity ≤ 2**15) and a wide two-operand regime
+precisely because the tie-break field silently overflows its 15-bit
+width past that wall.  Today that split is only guarded by runtime
+convention; this rule makes it a CHECKED invariant, proved by the
+specflow interval interpreter on every analysis run:
+
+- **shift-overflow** — every ``a << s`` in the target modules must have
+  a provable result within int32.  ``jnp.clip``/``%``/``min``/``max``
+  bounds, module constants (``_SCORE_CLIP``), ``# koordlint: shape``
+  parameter seeds and depth-limited helper inlining feed the proof; an
+  UNPROVABLE shift is a finding, because an unbounded operand is
+  exactly how the next 2**15-class wall ships.
+- **field-collision** — every packed composition ``(a << C) | b`` must
+  prove ``b ∈ [0, 2**C)``: the tie-break may not bleed into the score
+  bits.  The proof typically goes through a ``_packed_regime(n_total)``
+  guard: the engine refines ``n_total ≤ PACKED_NODE_CAPACITY`` in the
+  guarded branch and the ``% n_total`` provenance carries the bound to
+  the or-site — remove the guard and the rule fails the build (the
+  demonstration test in tests/test_koordlint.py does exactly that to
+  the real ops/batch_assign.py).
+- **contract check** — a function annotated with ``retN`` ranges must
+  provably stay inside them (callers consume the annotation as a seed,
+  so a violated contract would poison downstream proofs silently).
+
+Multiplication/addition overflow is out of scope (the ranking keys are
+built from shifts and ors; ``*``/``+`` bounds over unknown pod counts
+would drown the rule in noise).  Scoped to the ranking-key modules.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import get_index
+from ..core import Analyzer, Finding, Project
+from ..specflow.domain import INT32_MAX, INT32_MIN, Interval
+from ..specflow.engine import (
+    FlowInterpreter,
+    module_consts,
+    shape_seeds_for,
+)
+
+
+class DtypeRegimeAnalyzer(Analyzer):
+    name = "dtype-regime"
+    description = ("interval proof that packed int32 ranking-key "
+                   "arithmetic cannot overflow and tie-break fields "
+                   "stay below the 2**15 regime wall")
+
+    def __init__(self, package: str = "koordinator_tpu",
+                 targets: tuple[str, ...] = (
+                     "koordinator_tpu/ops/batch_assign.py",)):
+        self.package = package
+        self.targets = targets
+
+    def run(self, project: Project) -> list[Finding]:
+        index = get_index(project, self.package)
+        findings: list[Finding] = []
+        seen: set[tuple[str, int, str]] = set()
+
+        def emit(f: Finding) -> None:
+            k = (f.path, f.line, f.message[:60])
+            if k not in seen:
+                seen.add(k)
+                findings.append(f)
+
+        for mod, sf in sorted(index.modules.items()):
+            if sf.path not in self.targets or sf.tree is None:
+                continue
+            consts = module_consts(index, mod)
+
+            def on_lshift(node, a, s, refin, _sf=sf):
+                # magnitude bound: |a| << s_max must stay inside int32
+                # (negative operands overflow toward INT32_MIN)
+                if a.hi is None or a.lo is None or s.hi is None:
+                    hi = lo = None
+                else:
+                    hi = max(a.hi, 0) << s.hi
+                    lo = -((-min(a.lo, 0)) << s.hi)
+                if hi is None or lo is None:
+                    emit(Finding(
+                        self.name, _sf.path, node.lineno,
+                        "left-shift operand has no provable bound: the "
+                        "packed ranking key cannot be proven to fit "
+                        "int32",
+                        hint="bound the operand (jnp.clip / % / guard) "
+                             "or seed it with a `# koordlint: "
+                             "shape[x: ... lo..hi]` annotation"))
+                elif hi > INT32_MAX or lo < INT32_MIN:
+                    emit(Finding(
+                        self.name, _sf.path, node.lineno,
+                        f"left-shift can reach {max(hi, -lo)} "
+                        f"(> int32 max {INT32_MAX}): packed ranking-key "
+                        "arithmetic overflows",
+                        hint="tighten the clip / quantization so the "
+                             "shifted field fits below bit 31"))
+
+            def on_packed_or(node, width, field, refin, _sf=sf):
+                f_hi = field.hi_under(refin)
+                f_lo = field.lo_under(refin)
+                if f_hi is None or f_lo is None:
+                    emit(Finding(
+                        self.name, _sf.path, node.lineno,
+                        f"tie-break field of a packed `(x << {width}) | "
+                        "field` key has no provable bound: past the "
+                        f"2**{width} regime wall it silently corrupts "
+                        "the score bits",
+                        hint="gate the packed composition behind "
+                             "_packed_regime(n_total) (the wide regime "
+                             "carries the tie-break separately)"))
+                elif f_hi >= (1 << width) or f_lo < 0:
+                    emit(Finding(
+                        self.name, _sf.path, node.lineno,
+                        f"tie-break field can reach {f_hi} but the "
+                        f"packed key reserves only {width} bits "
+                        f"(< {1 << width}): the field bleeds into the "
+                        "score and ranking aliases",
+                        hint="bound the field below the regime wall or "
+                             "route these shapes to the wide regime"))
+
+            # module-level constant expressions get the shift check too
+            top = FlowInterpreter(index, mod, consts,
+                                  on_lshift=on_lshift,
+                                  on_packed_or=on_packed_or)
+            for stmt in sf.tree.body:
+                if isinstance(stmt, ast.Assign):
+                    top.eval(stmt.value, {}, {})
+
+            for fq, fn in sorted(index.functions.items()):
+                if fn.sf is not sf:
+                    continue
+                interp = FlowInterpreter(index, mod, consts,
+                                         on_lshift=on_lshift,
+                                         on_packed_or=on_packed_or)
+                interp.run(fn)
+                findings.extend(self._check_contracts(fn, interp, emit))
+        return sorted(findings, key=lambda f: (f.path, f.line))
+
+    def _check_contracts(self, fn, interp: FlowInterpreter, emit) -> list:
+        """Declared retN ranges are promises callers consume as seeds:
+        a provable violation is a finding (unprovable stays silent —
+        the annotation remains a trusted hint, as documented)."""
+        seeds = shape_seeds_for(fn.sf, fn.node)
+        declared = {int(k[3:]): s.interval for k, s in seeds.items()
+                    if k.startswith("ret") and k[3:].isdigit()
+                    and s.interval is not None}
+        if not declared:
+            return []
+        for node, val, refin in interp.returns:
+            vals = val if isinstance(val, tuple) else (val,)
+            for i, d in declared.items():
+                if i >= len(vals) or not isinstance(vals[i], Interval):
+                    continue
+                hi = vals[i].hi_under(refin)
+                lo = vals[i].lo_under(refin)
+                if (hi is not None and d.hi is not None and hi > d.hi) \
+                        or (lo is not None and d.lo is not None
+                            and lo < d.lo):
+                    emit(Finding(
+                        self.name, fn.sf.path, node.lineno,
+                        f"{fn.qualname} returns ret{i} in "
+                        f"[{lo}, {hi}] but its shape annotation "
+                        f"declares [{d.lo}, {d.hi}]: callers seed "
+                        "their proofs from the annotation",
+                        hint="fix the annotation or the computation — "
+                             "a stale contract poisons downstream "
+                             "interval proofs"))
+        return []

@@ -1,0 +1,331 @@
+"""spec-consistency: the declared shard_map contract must match the code.
+
+mesh-discipline (PR 10) checks that specs are PRESENT and literal; this
+rule — the specflow upgrade — checks that they are RIGHT.  Four
+obligations, all driven by the parsed :class:`~..specflow.engine.
+SpmdSite` model (layouts resolved through module spec constants like
+``_NODES = P(NODES_AXIS)`` and cross-module string constants):
+
+- **axis liveness** — a collective inside a shard_map body's transitive
+  closure (``psum``/``all_gather``/``axis_index``/…) may only name a
+  mesh axis the site's specs declare live.  A typo'd or stale axis name
+  raises at trace time on a mesh but silently "works" (as a no-op axis)
+  under some single-device test configurations — exactly the class of
+  bug an 8-way parity soak finds and tier-1 does not.
+- **in_specs arity** — the literal ``in_specs`` tuple must have one
+  entry per unbound positional parameter of the body (``partial``-bound
+  leading args subtract).  A miscounted tuple shifts EVERY layout one
+  position over.
+- **out_specs arity** — the literal ``out_specs`` tuple must match the
+  body's returned tuple length (checked when every return agrees).
+- **propagated layout** — intra-function, a value produced by a
+  shard_map call carries its declared out layout; passing it to another
+  shard_map position whose in_spec provably disagrees
+  (sharded-over-axes vs replicated) is a finding, as is owner-local
+  scatter divergence: inside a body, scattering via an
+  ``axis_index``-derived index into a REPLICATED/fresh-built value that
+  flows out replicated means the shards' replicas silently diverge —
+  gather first, or declare the output sharded.
+
+Parameter layouts seed from in_specs; ``# koordlint: shape[...]``
+annotations seed helpers the closure walk cannot see through.  Checks
+fire only on PROVABLE mismatches — unknown layouts stay silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..callgraph import FunctionInfo, get_index, reachable_functions
+from ..core import Analyzer, Finding, Project
+from ..specflow.domain import FRESH, Layout, UNKNOWN
+from ..specflow.engine import (
+    COLLECTIVES,
+    SpmdSite,
+    call_tail as _tail,
+    extract_spmd_sites,
+    resolve_axis_name,
+    shape_seeds_for,
+)
+
+
+class SpecConsistencyAnalyzer(Analyzer):
+    name = "spec-consistency"
+    description = ("shard_map/pjit declared specs checked against the "
+                   "body: collective axis liveness, in/out arity, "
+                   "propagated layouts, replicated-scatter divergence")
+
+    def __init__(self, package: str = "koordinator_tpu"):
+        self.package = package
+
+    def run(self, project: Project) -> list[Finding]:
+        index = get_index(project, self.package)
+        sites = extract_spmd_sites(index)
+        findings: list[Finding] = []
+        seen: set[tuple] = set()
+
+        def emit(f: Finding) -> None:
+            k = (f.path, f.line, f.message[:80])
+            if k not in seen:
+                seen.add(k)
+                findings.append(f)
+
+        for site in sites:
+            self._check_arity(index, site, emit)
+            if site.body_fn is not None and site.axes:
+                self._check_axes(index, site, emit)
+            if site.body_fn is not None:
+                self._check_replicated_scatter(index, site, emit)
+        self._check_layout_flow(index, sites, emit)
+        return sorted(findings, key=lambda f: (f.path, f.line))
+
+    # -- arity ----------------------------------------------------------------
+
+    def _positional_params(self, fn: FunctionInfo) -> int:
+        args = fn.node.args
+        n = len(getattr(args, "posonlyargs", [])) + len(args.args)
+        names = [a.arg for a in
+                 list(getattr(args, "posonlyargs", [])) + list(args.args)]
+        if names and names[0] in ("self", "cls"):
+            n -= 1
+        return n
+
+    def _return_arity(self, fn: FunctionInfo) -> Optional[int]:
+        arities: set[int] = set()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                arities.add(len(node.value.elts)
+                            if isinstance(node.value, ast.Tuple) else 1)
+        return arities.pop() if len(arities) == 1 else None
+
+    def _check_arity(self, index, site: SpmdSite, emit) -> None:
+        fn = site.body_fn
+        if fn is None:
+            return
+        if site.in_layouts is not None:
+            want = self._positional_params(fn) - site.bound_positional
+            if want >= 0 and len(site.in_layouts) != want:
+                emit(Finding(
+                    self.name, site.sf.path, site.line,
+                    f"in_specs declares {len(site.in_layouts)} "
+                    f"entries but shard_map body {fn.qualname} takes "
+                    f"{want} positional arguments: every layout lands "
+                    "one position off",
+                    hint="one in_specs entry per unbound positional "
+                         "parameter of the body"))
+        if site.out_layouts is not None:
+            ret = self._return_arity(fn)
+            if ret is not None and len(site.out_layouts) != ret:
+                emit(Finding(
+                    self.name, site.sf.path, site.line,
+                    f"out_specs declares {len(site.out_layouts)} "
+                    f"entries but body {fn.qualname} returns {ret} "
+                    "value(s)",
+                    hint="match out_specs to the body's returned tuple"))
+
+    # -- collective axis liveness ---------------------------------------------
+
+    def _check_axes(self, index, site: SpmdSite, emit) -> None:
+        closure = reachable_functions(index, [site.body_fn])
+        for fn in closure.values():
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = _tail(node.func)
+                pos = COLLECTIVES.get(tail)
+                if pos is None:
+                    continue
+                axis_node = None
+                if len(node.args) > pos:
+                    axis_node = node.args[pos]
+                else:
+                    for kw in node.keywords:
+                        if kw.arg in ("axis_name", "axis"):
+                            axis_node = kw.value
+                axis = (resolve_axis_name(index, fn.module, axis_node)
+                        if axis_node is not None else None)
+                if axis is not None and axis not in site.axes:
+                    emit(Finding(
+                        self.name, fn.sf.path, node.lineno,
+                        f"collective {tail}(..., {axis!r}) names an "
+                        "axis not live in the enclosing shard_map mesh "
+                        f"(specs at {site.sf.path}:{site.line} declare "
+                        f"axes {sorted(site.axes)})",
+                        hint="use the mesh axis the site's specs "
+                             "declare, or fix the specs"))
+
+    # -- replicated owner-local scatter ---------------------------------------
+
+    def _body_param_layouts(self, index, site: SpmdSite) -> dict[str, Layout]:
+        fn = site.body_fn
+        layouts: dict[str, Layout] = {}
+        args = fn.node.args
+        names = [a.arg for a in
+                 list(getattr(args, "posonlyargs", [])) + list(args.args)]
+        if names and names[0] in ("self", "cls"):
+            names = names[1:]
+        names = names[site.bound_positional:]
+        if site.in_layouts is not None:
+            for name, lay in zip(names, site.in_layouts):
+                layouts[name] = lay
+        for name, seed in shape_seeds_for(fn.sf, fn.node).items():
+            if seed.layout is not None:
+                layouts[name] = seed.layout
+        return layouts
+
+    def _check_replicated_scatter(self, index, site: SpmdSite,
+                                  emit) -> None:
+        """Inside a body: ``base.at[idx].add(...)`` where ``base`` is
+        provably replicated and ``idx`` derives from ``axis_index``
+        makes the replicas diverge — each shard scatters only its own
+        rows into what the out_specs still call one value."""
+        fn = site.body_fn
+        layouts = dict(self._body_param_layouts(index, site))
+        tainted: set[str] = set()
+
+        def expr_tainted(node: ast.expr) -> bool:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    t = _tail(sub.func)
+                    if t == "axis_index":
+                        return True
+                    target = index.find_function(
+                        index.resolve(fn.module, sub.func))
+                    if target is not None and any(
+                            isinstance(c, ast.Call)
+                            and _tail(c.func) == "axis_index"
+                            for c in ast.walk(target.node)):
+                        return True
+                if isinstance(sub, ast.Name) and sub.id in tainted:
+                    return True
+            return False
+
+        def layout_of(node: ast.expr) -> Layout:
+            if isinstance(node, ast.Name):
+                return layouts.get(node.id, UNKNOWN)
+            if isinstance(node, ast.Attribute):
+                return layout_of(node.value)
+            if isinstance(node, ast.Call):
+                t = _tail(node.func)
+                if t in ("zeros", "ones", "full", "arange"):
+                    return FRESH
+                if t in ("zeros_like", "ones_like", "full_like") \
+                        and node.args:
+                    return layout_of(node.args[0])
+                if t in ("where", "clip") and node.args:
+                    return UNKNOWN
+            return UNKNOWN
+
+        for stmt in ast.walk(fn.node):
+            if isinstance(stmt, ast.Assign) \
+                    and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                if expr_tainted(stmt.value):
+                    tainted.add(name)
+                lay = layout_of(stmt.value)
+                if lay.kind != "unknown":
+                    layouts[name] = lay
+        for node in ast.walk(fn.node):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("add", "set", "max", "min",
+                                           "mul")
+                    and isinstance(node.func.value, ast.Subscript)
+                    and isinstance(node.func.value.value, ast.Attribute)
+                    and node.func.value.value.attr == "at"):
+                continue
+            base = node.func.value.value.value
+            idx = node.func.value.slice
+            if layout_of(base).is_replicated and expr_tainted(idx):
+                emit(Finding(
+                    self.name, fn.sf.path, node.lineno,
+                    "owner-local scatter (index derives from "
+                    "axis_index) into a replicated value: each shard "
+                    "writes only its own rows, so the replicas of "
+                    f"{ast.unparse(base) if hasattr(ast, 'unparse') else 'the value'} "
+                    "silently diverge",
+                    hint="scatter into the node-sharded buffer, or "
+                         "all_gather/psum the contributions before "
+                         "treating the result as replicated"))
+
+    # -- propagated layout across chained sites -------------------------------
+
+    def _check_layout_flow(self, index, sites: list[SpmdSite],
+                           emit) -> None:
+        by_call = {id(s.call): s for s in sites}
+        for fq, fn in sorted(index.functions.items()):
+            contracts: dict[str, SpmdSite] = {}
+            value_layouts: dict[str, Layout] = {}
+
+            def handle_wrapped_call(call: ast.Call,
+                                    site: SpmdSite) -> list[Layout]:
+                if site.in_layouts is not None:
+                    for i, arg in enumerate(call.args):
+                        if i >= len(site.in_layouts) \
+                                or not isinstance(arg, ast.Name):
+                            continue
+                        got = value_layouts.get(arg.id, UNKNOWN)
+                        want = site.in_layouts[i]
+                        if got.kind == "unknown" \
+                                or want.kind == "unknown":
+                            continue
+                        if got.is_sharded != want.is_sharded or (
+                                got.is_sharded
+                                and got.axes != want.axes):
+                            emit(Finding(
+                                self.name, fn.sf.path, call.lineno,
+                                f"argument {i} ({arg.id!r}) carries "
+                                f"layout {got.kind}{got.axes or ''} "
+                                "from a previous shard_map out_spec "
+                                f"but this site declares "
+                                f"{want.kind}{want.axes or ''}: the "
+                                "propagated layout contradicts the "
+                                "declared contract",
+                                hint="reshard explicitly (all_gather / "
+                                     "device_put) or fix the spec"))
+                return site.out_layouts or []
+
+            def site_of(call: ast.Call) -> Optional[SpmdSite]:
+                if isinstance(call.func, ast.Name) \
+                        and call.func.id in contracts:
+                    return contracts[call.func.id]
+                if id(call.func) in by_call:
+                    return by_call[id(call.func)]
+                return None
+
+            handled: set[int] = set()
+            nodes = sorted(
+                (n for n in ast.walk(fn.node)
+                 if isinstance(n, (ast.Assign, ast.Call))),
+                key=lambda n: (n.lineno, n.col_offset))
+            for stmt in nodes:
+                if isinstance(stmt, ast.Assign):
+                    if len(stmt.targets) != 1:
+                        continue
+                    target, value = stmt.targets[0], stmt.value
+                    if isinstance(value, ast.Call) \
+                            and id(value) in by_call \
+                            and isinstance(target, ast.Name):
+                        contracts[target.id] = by_call[id(value)]
+                        handled.add(id(value))
+                        continue
+                    if isinstance(value, ast.Call):
+                        site = site_of(value)
+                        if site is not None:
+                            handled.add(id(value))
+                            outs = handle_wrapped_call(value, site)
+                            targets = (target.elts if isinstance(
+                                target, (ast.Tuple, ast.List))
+                                else [target])
+                            for t, lay in zip(targets, outs):
+                                if isinstance(t, ast.Name):
+                                    value_layouts[t.id] = lay
+                    continue
+                if id(stmt) in handled:
+                    continue
+                site = site_of(stmt)
+                if site is not None:
+                    handled.add(id(stmt))
+                    handle_wrapped_call(stmt, site)

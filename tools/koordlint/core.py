@@ -34,8 +34,12 @@ from typing import Iterable, Optional
 #: inline directives.  ``ignore`` silences named rules on that line (the
 #: reason after ``--`` is mandatory); ``guarded-by`` declares locking
 #: intent (an attribute write, or a whole function when placed on its
-#: ``def`` line, is protected by the named lock).
-_DIRECTIVE_RE = re.compile(r"#\s*koordlint:\s*(?P<kind>ignore|guarded-by)"
+#: ``def`` line, is protected by the named lock); ``shape`` seeds the
+#: specflow abstract interpreter with a parameter/return contract
+#: (``# koordlint: shape[score: Pxk i32 -1..32767]`` — see
+#: tools/koordlint/specflow/engine.py and docs/static_analysis.md).
+_DIRECTIVE_RE = re.compile(r"#\s*koordlint:\s*"
+                           r"(?P<kind>ignore|guarded-by|shape)"
                            r"\s*[\[(](?P<body>[^\])]*)[\])]"
                            r"(?:\s*--\s*(?P<reason>.*\S))?")
 
@@ -99,16 +103,20 @@ class SourceFile:
                     reason=(m.group("reason") or "").strip(), line=i)
 
     def directive_at(self, line: int, kind: str) -> Optional[Directive]:
-        """The directive covering ``line``: on the line itself, or a
-        standalone directive comment on the line directly above."""
+        """The directive covering ``line``: on the line itself, or in
+        the contiguous block of standalone comment lines directly above
+        (so a ``guarded-by`` and a ``shape`` directive can stack on one
+        ``def``)."""
         d = self.directives.get(line)
         if d is not None and d.kind == kind:
             return d
-        prev = self.directives.get(line - 1)
-        if (prev is not None and prev.kind == kind
-                and 1 <= prev.line <= len(self.lines)
-                and self.lines[prev.line - 1].lstrip().startswith("#")):
-            return prev
+        prev = line - 1
+        while (1 <= prev <= len(self.lines)
+               and self.lines[prev - 1].lstrip().startswith("#")):
+            d = self.directives.get(prev)
+            if d is not None and d.kind == kind:
+                return d
+            prev -= 1
         return None
 
 

@@ -1,0 +1,42 @@
+"""Seeded dtype-regime violations (must-flag corpus).
+
+``overflowing_key`` and ``unguarded_packed_key`` reconstruct the 2**15
+ranking-key wall PR 10 deleted: a packed int32 key whose score field is
+too wide (the shift overflows int32) and a packed composition with no
+``_packed_regime`` guard (the tie-break bleeds into the score bits the
+moment a capacity crosses 2**15).
+"""
+
+import jax.numpy as jnp
+
+_TB_BITS = 15
+# BAD: the clip admits 2**20 score buckets, so `q << 15` reaches 2**35
+_SCORE_CLIP = (1 << 20) - 1
+
+
+def overflowing_key(scores, feasible, n_total):
+    q = jnp.clip(scores, 0, _SCORE_CLIP)
+    tb = jnp.arange(n_total) % n_total
+    key = (q << _TB_BITS) | tb
+    return jnp.where(feasible, key, -1)
+
+
+def unguarded_packed_key(scores, ids, rot, n_total):
+    # BAD (the pre-PR-10 wall): nothing bounds n_total below 2**15, so
+    # the rotated tie-break can exceed its 15-bit field
+    q = jnp.clip(scores, 0, (1 << 15) - 1)
+    tb = (n_total - 1) - ((ids - rot) % n_total)
+    return (q << _TB_BITS) | tb
+
+
+def unprovable_shift(score, spread_bits):
+    # BAD: `score` has no clip, guard, or shape annotation — the packed
+    # key cannot be proven to fit int32
+    return (score >> spread_bits) << _TB_BITS
+
+
+# koordlint: shape[ret0: P i32 0..100]
+def lying_contract(x):
+    # BAD: the declared return contract says <= 100 but the clip
+    # admits 1000 — callers seed their proofs from the annotation
+    return jnp.clip(x, 0, 1000)

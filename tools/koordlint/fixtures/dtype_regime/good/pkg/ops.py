@@ -1,0 +1,53 @@
+"""Regime-disciplined twins of the bad corpus (must-pass)."""
+
+import jax.numpy as jnp
+
+_TB_BITS = 15
+_SCORE_CLIP = (1 << 30 - _TB_BITS) - 1
+PACKED_NODE_CAPACITY = 1 << _TB_BITS
+MAX_NODE_CAPACITY = 1 << 30
+
+
+def check_node_capacity(n):
+    if n > MAX_NODE_CAPACITY:
+        raise ValueError("past the ranking-key ceiling")
+
+
+def _packed_regime(n_total):
+    return n_total <= PACKED_NODE_CAPACITY
+
+
+def guarded_key(scores, feasible, ids, rot, n_total):
+    # the real _rank_parts shape: capacity guard, clipped score,
+    # rotation-idiom tie-break, packed/wide split behind the regime gate
+    check_node_capacity(n_total)
+    q = jnp.clip(scores, 0, _SCORE_CLIP)
+    tb = (n_total - 1) - ((ids - rot) % n_total)
+    key = ((q << _TB_BITS) | tb) if _packed_regime(n_total) else q
+    return jnp.where(feasible, key, -1)
+
+
+# koordlint: shape[score: Pxk i32 -1..32767]
+def seeded_key(score, node, rot, n_total):
+    # an annotation-seeded parameter proves where inference cannot see
+    if _packed_regime(n_total):
+        return (score << _TB_BITS) | ((node - rot) % n_total)
+    return score
+
+
+# koordlint: shape[ret0: P i32 0..100]
+def honest_contract(x):
+    return jnp.clip(x, 0, 100)
+
+
+def literal_comparison_guard(scores, ids, rot, n_total):
+    # a literal `<=` comparison at exactly the regime wall is as good a
+    # guard as _packed_regime(): tb's true max is 2**15 - 1, which
+    # just fits the 15-bit field (refinements store the INCLUSIVE
+    # bound of the guarded name)
+    check_node_capacity(n_total)
+    q = jnp.clip(scores, 0, _SCORE_CLIP)
+    tb = (n_total - 1) - ((ids - rot) % n_total)
+    if n_total <= PACKED_NODE_CAPACITY:
+        return (q << _TB_BITS) | tb
+    return q

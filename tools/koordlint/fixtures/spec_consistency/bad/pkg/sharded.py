@@ -1,0 +1,74 @@
+"""Seeded spec-consistency violations (must-flag corpus)."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+NODES_AXIS = "nodes"
+
+
+def _wrong_axis_body(x):
+    # BAD: the enclosing site's specs only declare "nodes" live
+    return jax.lax.psum(x, "pods")
+
+
+def wrong_axis(mesh, x):
+    fn = shard_map(_wrong_axis_body, mesh=mesh,
+                   in_specs=(P(NODES_AXIS),), out_specs=P())
+    return fn(x)
+
+
+def _two_arg_body(a, b):
+    return a, b
+
+
+def arity_drift(mesh, a, b):
+    # BAD: two positional body args, three in_specs entries — every
+    # layout lands one position off
+    fn = shard_map(_two_arg_body, mesh=mesh,
+                   in_specs=(P(NODES_AXIS), P(), P()),
+                   out_specs=(P(NODES_AXIS), P()))
+    return fn(a, b)
+
+
+def _three_out_body(x):
+    return x, x, x
+
+
+def out_arity_drift(mesh, x):
+    # BAD: the body returns three values, out_specs declares two
+    fn = shard_map(_three_out_body, mesh=mesh, in_specs=(P(NODES_AXIS),),
+                   out_specs=(P(NODES_AXIS), P()))
+    return fn(x)
+
+
+def _diverging_body(rows, vals, *, n):
+    # BAD: owner-local scatter into a replicated fresh buffer — each
+    # shard writes only its own rows, the replicas silently diverge
+    off = jax.lax.axis_index(NODES_AXIS) * rows.shape[0]
+    return jnp.zeros(n).at[rows + off].add(vals)
+
+
+def replicated_scatter(mesh, rows, vals, n):
+    fn = shard_map(partial(_diverging_body, n=n), mesh=mesh,
+                   in_specs=(P(), P()), out_specs=P())
+    return fn(rows, vals)
+
+
+def _identity_body(x):
+    return x
+
+
+def layout_mismatch(mesh, x):
+    produce = shard_map(_identity_body, mesh=mesh,
+                        in_specs=(P(NODES_AXIS),),
+                        out_specs=(P(NODES_AXIS),))
+    consume = shard_map(_identity_body, mesh=mesh,
+                        in_specs=(P(),), out_specs=(P(),))
+    part = produce(x)
+    # BAD: part carries the node-sharded out layout but the next site
+    # declares its position replicated
+    return consume(part)

@@ -1,0 +1,62 @@
+"""Spec-consistent twins of the bad corpus (must-pass)."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+NODES_AXIS = "nodes"
+
+
+def _nodes_body(x):
+    off = jax.lax.axis_index(NODES_AXIS)
+    return jax.lax.psum(x + off, NODES_AXIS)
+
+
+def right_axis(mesh, x):
+    fn = shard_map(_nodes_body, mesh=mesh,
+                   in_specs=(P(NODES_AXIS),), out_specs=P())
+    return fn(x)
+
+
+def _two_arg_body(a, b):
+    return a, b
+
+
+def aligned_arity(mesh, a, b):
+    fn = shard_map(_two_arg_body, mesh=mesh,
+                   in_specs=(P(NODES_AXIS), P()),
+                   out_specs=(P(NODES_AXIS), P()))
+    return fn(a, b)
+
+
+# koordlint: shape[st_local: NxR i32 nodes]
+def _owner_scatter_body(st_local, rows, vals, *, n):
+    # owner-local scatter into the SHARDED accounting: the legal idiom
+    # (the annotation documents the layout the in_specs also declare)
+    off = jax.lax.axis_index(NODES_AXIS) * rows.shape[0]
+    return jnp.zeros_like(st_local).at[rows + off].add(vals)
+
+
+def owner_scatter(mesh, st, rows, vals, n):
+    fn = shard_map(partial(_owner_scatter_body, n=n), mesh=mesh,
+                   in_specs=(P(NODES_AXIS), P(), P()),
+                   out_specs=P(NODES_AXIS))
+    return fn(st, rows, vals)
+
+
+def _identity_body(x):
+    return x
+
+
+def matched_layouts(mesh, x):
+    produce = shard_map(_identity_body, mesh=mesh,
+                        in_specs=(P(NODES_AXIS),),
+                        out_specs=(P(NODES_AXIS),))
+    consume = shard_map(_identity_body, mesh=mesh,
+                        in_specs=(P(NODES_AXIS),),
+                        out_specs=(P(NODES_AXIS),))
+    part = produce(x)
+    return consume(part)

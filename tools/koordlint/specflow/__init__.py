@@ -1,0 +1,42 @@
+"""specflow: an abstract shape/dtype/sharding interpreter for koordlint.
+
+PR 7 gave the repo pattern-matching analyzers; PR 10's mesh-discipline
+rule is purely syntactic ("specs must be literal") and cannot see whether
+the specs are *right*.  specflow upgrades koordlint to a small dataflow
+engine: it propagates an abstract value per binding — integer intervals
+(with symbolic ``value < N`` provenance so a ``% n_total`` bound survives
+a later ``_packed_regime(n_total)`` guard), dtype tags, and a sharding
+layout (axis→mesh-axis, replicated, fresh, donated/⊥) — through function
+bodies, seeded interprocedurally from ``callgraph.ModuleIndex``'s jit
+sites and from lightweight ``# koordlint: shape[...]`` annotations where
+inference needs a seed (see docs/static_analysis.md for the syntax).
+
+Four analyzers ride on it (analyzers/{spec_consistency,dtype_regime,
+donation_flow,tenant_axis}.py); this package holds the shared engine:
+
+- :mod:`domain` — the interval lattice and layout tags;
+- :mod:`engine` — module-constant evaluation, the expression/flow
+  interpreter with guard refinement and depth-limited helper inlining,
+  shape-annotation parsing, and SPMD (shard_map/pjit) site modelling.
+"""
+
+from __future__ import annotations
+
+from .domain import INT32_MAX, Interval, Layout
+from .engine import (
+    FlowInterpreter,
+    ShapeSeed,
+    SpmdSite,
+    extract_spmd_sites,
+    module_consts,
+    parse_shape_body,
+    resolve_axis_name,
+    shape_seeds_for,
+)
+
+__all__ = [
+    "INT32_MAX", "Interval", "Layout",
+    "FlowInterpreter", "ShapeSeed", "SpmdSite",
+    "extract_spmd_sites", "module_consts", "parse_shape_body",
+    "resolve_axis_name", "shape_seeds_for",
+]

@@ -1,0 +1,213 @@
+"""The specflow abstract domain: integer intervals and sharding layouts.
+
+Intervals are the workhorse of the dtype-regime proof.  Two design
+points matter more than the arithmetic:
+
+- **Unbounded ends are ``None``** (not a sentinel int), and every
+  operation is written to be SOUND under unknowns: when a bound cannot
+  be computed the result end is ``None``, never a guess.  Bitwise
+  ``|``/``&`` on fixed-width integers can never overflow, so the
+  overflow rule only fires on ``<<`` (and the analyzer documents that
+  ``*``/``+`` are out of scope — the tree's ranking keys are built from
+  shifts and ors).
+- **``bounded_by`` provenance.**  ``x % n`` is in ``[0, n-1]`` — but the
+  interesting ``n`` (``n_total``) is often refined LATER, by a
+  ``_packed_regime(n_total)`` ternary guarding the packed-key branch.  A
+  plain interval computed before the guard would keep the unrefined
+  ``2**30`` bound and the packed proof would fail on exactly the code it
+  must verify.  ``bounded_by`` records "this value is in
+  ``[0, key(n)-1]``"; at check time the analyzer re-evaluates the bound
+  under the branch's refinements (see :meth:`Interval.hi_under`).  The
+  rotation idiom ``(n - 1) - (e % n)`` keeps the provenance — the engine
+  recognizes the pattern structurally (engine._eval_sub).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+INT32_MAX = 2**31 - 1
+INT32_MIN = -(2**31)
+
+
+def _min(*vals):
+    known = [v for v in vals if v is not None]
+    return min(known) if len(known) == len(vals) else None
+
+
+def _max(*vals):
+    known = [v for v in vals if v is not None]
+    return max(known) if len(known) == len(vals) else None
+
+
+@dataclasses.dataclass(frozen=True)
+class Interval:
+    """A sound integer range; ``None`` ends are unbounded."""
+
+    lo: Optional[int] = None
+    hi: Optional[int] = None
+    #: refinement key (ast.dump of an expression E) meaning the value is
+    #: additionally known to lie in [0, E-1]; consumed by hi_under()
+    bounded_by: Optional[str] = None
+
+    # -- queries --------------------------------------------------------------
+
+    @property
+    def nonneg(self) -> bool:
+        return self.lo is not None and self.lo >= 0
+
+    def hi_under(self, refinements: dict[str, "Interval"]) -> Optional[int]:
+        """The upper bound after substituting refinements: the tighter of
+        the stored ``hi`` and ``refinement[bounded_by].hi - 1``."""
+        hi = self.hi
+        if self.bounded_by is not None:
+            r = refinements.get(self.bounded_by)
+            if r is not None and r.hi is not None:
+                hi = _min(hi, r.hi - 1) if hi is not None else r.hi - 1
+        return hi
+
+    def lo_under(self, refinements: dict[str, "Interval"]) -> Optional[int]:
+        """The lower bound; a ``bounded_by`` value is known nonnegative."""
+        if self.bounded_by is not None:
+            return 0 if self.lo is None else max(self.lo, 0)
+        return self.lo
+
+    # -- arithmetic (sound, drops provenance unless stated) -------------------
+
+    def join(self, other: "Interval") -> "Interval":
+        return Interval(_min(self.lo, other.lo), _max(self.hi, other.hi),
+                        self.bounded_by if self.bounded_by ==
+                        other.bounded_by else None)
+
+    def add(self, other: "Interval") -> "Interval":
+        lo = None if None in (self.lo, other.lo) else self.lo + other.lo
+        hi = None if None in (self.hi, other.hi) else self.hi + other.hi
+        return Interval(lo, hi)
+
+    def sub(self, other: "Interval") -> "Interval":
+        lo = None if None in (self.lo, other.hi) else self.lo - other.hi
+        hi = None if None in (self.hi, other.lo) else self.hi - other.lo
+        return Interval(lo, hi)
+
+    def neg(self) -> "Interval":
+        return Interval(None if self.hi is None else -self.hi,
+                        None if self.lo is None else -self.lo)
+
+    def mul(self, other: "Interval") -> "Interval":
+        ends = [a * b for a in (self.lo, self.hi)
+                for b in (other.lo, other.hi)
+                if a is not None and b is not None]
+        if len(ends) < 4:
+            return Interval()
+        return Interval(min(ends), max(ends))
+
+    def lshift(self, other: "Interval") -> "Interval":
+        """``a << s``: shift amounts are assumed nonnegative (jnp shifts
+        by negative amounts are already UB); an unknown shift amount
+        yields an unbounded result — which is the point of the rule."""
+        s_lo = 0 if other.lo is None else max(other.lo, 0)
+        if other.hi is None:
+            return Interval()
+        lo = None if self.lo is None else (
+            self.lo << (other.hi if self.lo < 0 else s_lo))
+        hi = None if self.hi is None else (
+            self.hi << (other.hi if self.hi > 0 else s_lo))
+        return Interval(lo, hi)
+
+    def rshift(self, other: "Interval") -> "Interval":
+        """``a >> s`` with s >= 0: magnitudes never grow (arithmetic
+        shift keeps sign, so lo >= min(lo, lo>>s) = lo for lo<0)."""
+        s_lo = 0 if other.lo is None else max(other.lo, 0)
+        lo = None if self.lo is None else (
+            self.lo >> s_lo if self.lo < 0 else 0 if other.hi is None
+            else self.lo >> min(other.hi, 63))
+        # for nonneg hi the largest result is hi >> s_lo; negative hi
+        # shifts toward -1
+        hi = None if self.hi is None else (
+            self.hi >> s_lo if self.hi >= 0 else -1)
+        return Interval(lo, hi)
+
+    def or_(self, other: "Interval") -> "Interval":
+        """``a | b``: never overflows a fixed width.  For nonneg
+        operands ``a | b <= a + b``; any negative operand makes the
+        result's sign unknown but still magnitude-bounded, which the
+        overflow rule does not care about."""
+        if self.nonneg and other.nonneg:
+            hi = (None if None in (self.hi, other.hi)
+                  else self.hi + other.hi)
+            return Interval(max(self.lo, other.lo), hi)
+        return Interval(INT32_MIN, INT32_MAX)
+
+    def and_(self, other: "Interval") -> "Interval":
+        """``a & b``: bounded by a nonnegative operand's hi."""
+        if self.nonneg:
+            return Interval(0, self.hi)
+        if other.nonneg:
+            return Interval(0, other.hi)
+        return Interval(INT32_MIN, INT32_MAX)
+
+    def mod(self, other: "Interval",
+            bounded_by: Optional[str] = None) -> "Interval":
+        """``e % n`` for positive n (Python/jnp semantics: result in
+        [0, n-1])."""
+        if other.lo is not None and other.lo > 0:
+            hi = None if other.hi is None else other.hi - 1
+            return Interval(0, hi, bounded_by=bounded_by)
+        return Interval()
+
+    def clamp_min(self, lo: int) -> "Interval":
+        return Interval(lo if self.lo is None else max(self.lo, lo),
+                        self.hi, self.bounded_by)
+
+    def clamp_max(self, hi: int) -> "Interval":
+        return Interval(self.lo,
+                        hi if self.hi is None else min(self.hi, hi),
+                        self.bounded_by)
+
+
+TOP = Interval()
+
+
+def const(v: int) -> Interval:
+    return Interval(v, v)
+
+
+# -- sharding layouts ---------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Layout:
+    """The sharding half of an abstract value.
+
+    ``kind``:
+      - ``"sharded"``  — carries ``axes``, the mesh-axis names the value
+        is split over (from a ``PartitionSpec`` literal or a ``shape``
+        annotation);
+      - ``"rep"``      — replicated over the mesh (``P()``);
+      - ``"fresh"``    — built replicated inside the body
+        (``jnp.zeros(n)``): identical on every shard *until* someone
+        scatters owner-local data into it;
+      - ``"unknown"``  — no information (the conservative default: rules
+        only fire on provably-wrong layouts).
+    """
+
+    kind: str = "unknown"
+    axes: tuple[str, ...] = ()
+
+    @property
+    def is_replicated(self) -> bool:
+        return self.kind in ("rep", "fresh")
+
+    @property
+    def is_sharded(self) -> bool:
+        return self.kind == "sharded"
+
+
+UNKNOWN = Layout()
+REPLICATED = Layout("rep")
+FRESH = Layout("fresh")
+
+
+def sharded(axes: tuple[str, ...]) -> Layout:
+    return Layout("sharded", tuple(axes))

@@ -1,0 +1,790 @@
+"""The specflow interpreter: expression/flow evaluation over stdlib ast.
+
+Three layers, each consumed by one or more analyzers:
+
+- **Module constants + annotations.**  :func:`module_consts` evaluates
+  simple module-level integer assignments in order (``_TB_BITS = 15``,
+  ``_SCORE_CLIP = (1 << 30 - _TB_BITS) - 1``) so downstream intervals
+  are exact.  :func:`parse_shape_body` parses the ``# koordlint:
+  shape[...]`` annotation — the seed contract for parameters and
+  returns where inference cannot see a bound (annotation syntax in
+  docs/static_analysis.md):
+
+      # koordlint: shape[score: Pxk i32 -1..32767, ret0: PxN i32 0..100]
+
+  Entries are comma-separated ``name: dims dtype lo..hi layout``; every
+  field after the name is optional.  ``retN`` names the N-th returned
+  value.  A layout token is ``rep`` or a mesh-axis name.
+
+- **The interval interpreter.**  :class:`FlowInterpreter` executes one
+  function body abstractly, in source order: assignments update an
+  environment of :class:`~.domain.Interval`s, ``if``/ternary guards
+  refine (``_packed_regime(n)`` ⇒ ``n ∈ [1, 2**15]``;
+  ``check_node_capacity(n)`` ⇒ ``n ∈ [1, 2**30]``; integer comparisons
+  clamp), loops run once with their targets widened to ⊤, and small
+  same-package helpers are inlined depth-limited so ``_candidate_tb``'s
+  ``% n_total`` bound is visible to its caller.  Analyzer hooks fire at
+  every ``<<`` (overflow obligation) and every ``(a << C) | b`` (field-
+  width obligation); returns are checked against declared ``retN``
+  contracts.
+
+- **SPMD site modelling.**  :func:`extract_spmd_sites` parses every
+  ``shard_map``/``pjit`` call into a :class:`SpmdSite` with resolved
+  per-position layouts (``P()``/``P("nodes")`` literals, seen through
+  module-level spec constants like ``_NODES = P(NODES_AXIS)`` and
+  cross-module string constants), the resolved body function (through
+  ``functools.partial``), and the live mesh-axis universe.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Optional
+
+from ..callgraph import FunctionInfo, ModuleIndex
+from ..core import SourceFile
+from .domain import (
+    REPLICATED,
+    TOP,
+    UNKNOWN,
+    Interval,
+    Layout,
+    const,
+    sharded,
+)
+
+#: statements above this are never inlined (keeps inlining a tool for
+#: leaf helpers like _candidate_tb, not a general interpreter)
+MAX_INLINE_STMTS = 8
+MAX_INLINE_DEPTH = 2
+
+_DTYPES = {"i8", "i16", "i32", "i64", "u8", "u16", "u32", "u64",
+           "f16", "bf16", "f32", "f64", "bool", "int", "float"}
+
+#: guard functions the interpreter understands: calling one (as a
+#: statement or a branch test) bounds its first argument by the named
+#: module constant (with a fallback when the constant is not in scope)
+DEFAULT_GUARDS = {
+    "_packed_regime": ("PACKED_NODE_CAPACITY", 1 << 15),
+    "check_node_capacity": ("MAX_NODE_CAPACITY", 1 << 30),
+    "check_shardable": ("MAX_NODE_CAPACITY", 1 << 30),
+}
+
+
+def key_of(node: ast.AST) -> str:
+    """Stable structural key for refinement bookkeeping."""
+    return ast.dump(node, annotate_fields=False)
+
+
+# -- shape annotations --------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ShapeSeed:
+    """One annotated binding: any subset of dims / dtype / range / layout."""
+
+    dims: Optional[tuple[str, ...]] = None
+    dtype: Optional[str] = None
+    interval: Optional[Interval] = None
+    layout: Optional[Layout] = None
+
+
+def _parse_range(tok: str) -> Optional[Interval]:
+    lo_s, _, hi_s = tok.partition("..")
+    try:
+        return Interval(int(lo_s), int(hi_s))
+    except ValueError:
+        return None
+
+
+def parse_shape_body(body: str) -> dict[str, ShapeSeed]:
+    """``score: Pxk i32 -1..32767, ret0: PxN i32 0..100 nodes`` ->
+    seeds.  Unparseable entries are skipped (annotations are best-effort
+    hints, never load-bearing for soundness)."""
+    out: dict[str, ShapeSeed] = {}
+    for entry in body.split(","):
+        name, colon, rest = entry.partition(":")
+        name = name.strip()
+        if not colon or not name:
+            continue
+        seed = ShapeSeed()
+        for i, tok in enumerate(rest.split()):
+            if ".." in tok and seed.interval is None:
+                seed.interval = _parse_range(tok)
+            elif tok in _DTYPES and seed.dtype is None:
+                seed.dtype = tok
+            elif tok == "rep" and seed.layout is None:
+                seed.layout = REPLICATED
+            elif i == 0 and seed.dims is None:
+                # dims are positional (first token only), so an entry
+                # that omits them ("x: i32 nodes") still seeds a layout
+                seed.dims = tuple(tok.split("x"))
+            elif seed.layout is None:
+                seed.layout = sharded((tok,))
+        out[name] = seed
+    return out
+
+
+def shape_seeds_for(sf: SourceFile, node: ast.AST) -> dict[str, ShapeSeed]:
+    """Seeds from the ``shape`` directive on (or directly above) a
+    ``def`` line — or any other anchored line, e.g. a jit binding."""
+    d = sf.directive_at(getattr(node, "lineno", 0), "shape")
+    return parse_shape_body(d.body) if d is not None else {}
+
+
+# -- module constants ---------------------------------------------------------
+
+
+def module_consts(index: ModuleIndex, mod: str) -> dict[str, Interval]:
+    """Exact intervals for simple module-level integer assignments,
+    evaluated in order so constants may reference earlier ones."""
+    sf = index.modules.get(mod)
+    if sf is None or sf.tree is None:
+        return {}
+    cache = getattr(index, "_specflow_consts", None)
+    if cache is None:
+        cache = index._specflow_consts = {}
+    if mod in cache:
+        return cache[mod]
+    consts: dict[str, Interval] = {}
+    interp = FlowInterpreter(index, mod, consts)
+    for node in sf.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            iv = interp.eval(node.value, {}, {})
+            if isinstance(iv, Interval) and iv.lo is not None \
+                    and iv.lo == iv.hi:
+                consts[node.targets[0].id] = iv
+    cache[mod] = consts
+    return consts
+
+
+def module_str_consts(index: ModuleIndex) -> dict[str, str]:
+    """``fq name -> str value`` for module-level string assignments
+    across the whole package (``NODES_AXIS = "nodes"``)."""
+    cache = getattr(index, "_specflow_strs", None)
+    if cache is not None:
+        return cache
+    out: dict[str, str] = {}
+    for mod, sf in index.modules.items():
+        if sf.tree is None:
+            continue
+        for node in sf.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                out[f"{mod}.{node.targets[0].id}"] = node.value.value
+    index._specflow_strs = out
+    return out
+
+
+# -- the interval interpreter -------------------------------------------------
+
+
+class FlowInterpreter:
+    """Abstract execution of one function body over the interval domain.
+
+    ``on_lshift(node, operand, shift, refinements)`` and
+    ``on_packed_or(node, width, field, refinements)`` are the analyzer
+    hooks; ``returns`` collects (Return node, value, refinements) for
+    contract checking.  The interpreter is flow-sensitive but
+    path-insensitive beyond one level of branch refinement — exactly
+    enough for the guarded packed/wide regime split.
+    """
+
+    def __init__(self, index: ModuleIndex, mod: str,
+                 consts: dict[str, Interval],
+                 guards: dict | None = None,
+                 on_lshift: Optional[Callable] = None,
+                 on_packed_or: Optional[Callable] = None,
+                 depth: int = 0):
+        self.index = index
+        self.mod = mod
+        self.consts = consts
+        self.guards = DEFAULT_GUARDS if guards is None else guards
+        self.on_lshift = on_lshift
+        self.on_packed_or = on_packed_or
+        self.depth = depth
+        self.returns: list[tuple[ast.Return, object, dict]] = []
+
+    # -- function entry -------------------------------------------------------
+
+    def run(self, fn: FunctionInfo,
+            seeds: dict[str, ShapeSeed] | None = None,
+            arg_ivs: dict[str, Interval] | None = None) -> None:
+        """Execute ``fn``'s body with parameters seeded from annotations
+        (and, when inlining, from caller argument intervals)."""
+        env: dict[str, object] = {}
+        seeds = seeds if seeds is not None else shape_seeds_for(fn.sf,
+                                                                fn.node)
+        args = fn.node.args
+        for a in list(getattr(args, "posonlyargs", [])) + list(args.args) \
+                + list(args.kwonlyargs):
+            iv = TOP
+            seed = seeds.get(a.arg)
+            if seed is not None and seed.interval is not None:
+                iv = seed.interval
+            if arg_ivs and a.arg in arg_ivs:
+                got = arg_ivs[a.arg]
+                if got.lo is not None or got.hi is not None:
+                    iv = got
+            env[a.arg] = iv
+        self._block(fn.node.body, env, {})
+
+    # -- statements -----------------------------------------------------------
+
+    def _block(self, stmts: list[ast.stmt], env: dict,
+               refin: dict) -> None:
+        for node in stmts:
+            self._stmt(node, env, refin)
+
+    def _stmt(self, node: ast.stmt, env: dict, refin: dict) -> None:
+        if isinstance(node, ast.Assign):
+            val = self.eval(node.value, env, refin)
+            for t in node.targets:
+                self._bind(t, val, env)
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name):
+                env[node.target.id] = TOP
+            self.eval(node.value, env, refin)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None and isinstance(node.target, ast.Name):
+                self._bind(node.target,
+                           self.eval(node.value, env, refin), env)
+        elif isinstance(node, ast.Return):
+            val = (self.eval(node.value, env, refin)
+                   if node.value is not None else None)
+            self.returns.append((node, val, dict(refin)))
+        elif isinstance(node, ast.Expr):
+            # a bare guard call refines from here on (check_node_capacity)
+            self._refine_from_call(node.value, env, refin)
+            self.eval(node.value, env, refin)
+        elif isinstance(node, ast.If):
+            r_true = dict(refin)
+            env_true = dict(env)
+            self._refine_test(node.test, env_true, r_true)
+            self._block(node.body, env_true, r_true)
+            env_false = dict(env)
+            self._block(node.orelse, env_false, dict(refin))
+            self._merge(env, env_true, env_false)
+        elif isinstance(node, (ast.For, ast.While)):
+            # loop bodies run once with their targets widened: enough to
+            # fire the hooks inside, sound because nothing narrows
+            if isinstance(node, ast.For):
+                self._bind(node.target, TOP, env)
+                self.eval(node.iter, env, refin)
+            for name in self._assigned_names(node.body):
+                env[name] = TOP
+            self._block(node.body, env, dict(refin))
+            self._block(node.orelse, env, dict(refin))
+        elif isinstance(node, (ast.With,)):
+            self._block(node.body, env, refin)
+        elif isinstance(node, ast.Try):
+            self._block(node.body, env, dict(refin))
+            for h in node.handlers:
+                self._block(h.body, dict(env), dict(refin))
+            self._block(node.orelse, env, dict(refin))
+            self._block(node.finalbody, env, dict(refin))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs execute with an unknown environment of their
+            # own — walked so their shift sites still meet the hooks
+            sub_env: dict[str, object] = {}
+            self._block(node.body, sub_env, {})
+        # everything else (pass, raise, import, global, ...) is inert
+
+    def _bind(self, target: ast.expr, val: object, env: dict) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = val if isinstance(val, Interval) else (
+                val if isinstance(val, tuple) else TOP)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = target.elts
+            if isinstance(val, tuple) and len(val) == len(elts):
+                for t, v in zip(elts, val):
+                    self._bind(t, v, env)
+            else:
+                for t in elts:
+                    self._bind(t, TOP, env)
+        # attribute/subscript stores don't feed the interval env
+
+    def _assigned_names(self, stmts: list[ast.stmt]) -> set[str]:
+        out: set[str] = set()
+        for s in stmts:
+            for node in ast.walk(s):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Store):
+                    out.add(node.id)
+        return out
+
+    def _merge(self, env: dict, a: dict, b: dict) -> None:
+        for name in set(a) | set(b):
+            va = a.get(name, env.get(name, TOP))
+            vb = b.get(name, env.get(name, TOP))
+            if isinstance(va, Interval) and isinstance(vb, Interval):
+                env[name] = va.join(vb)
+            elif (isinstance(va, tuple) and isinstance(vb, tuple)
+                    and len(va) == len(vb)):
+                env[name] = tuple(
+                    x.join(y) if isinstance(x, Interval)
+                    and isinstance(y, Interval) else TOP
+                    for x, y in zip(va, vb))
+            else:
+                env[name] = TOP
+
+    # -- guard refinement -----------------------------------------------------
+
+    def _guard_bound(self, name: str) -> Optional[int]:
+        spec = self.guards.get(name)
+        if spec is None:
+            return None
+        const_name, fallback = spec
+        iv = self.consts.get(const_name)
+        return iv.hi if iv is not None and iv.hi is not None else fallback
+
+    def _refine_from_call(self, node: ast.expr, env: dict,
+                          refin: dict) -> None:
+        if not isinstance(node, ast.Call) or not node.args:
+            return
+        tail = _tail(node.func)
+        bound = self._guard_bound(tail) if tail else None
+        if bound is None:
+            return
+        arg = node.args[0]
+        refin[key_of(arg)] = Interval(1, bound)
+        if isinstance(arg, ast.Name):
+            cur = env.get(arg.id, TOP)
+            if isinstance(cur, Interval):
+                env[arg.id] = cur.clamp_min(1).clamp_max(bound)
+
+    def _refine_test(self, test: ast.expr, env: dict,
+                     refin: dict) -> None:
+        """True-branch refinement only (the else branch keeps the base
+        facts — sound, just less precise)."""
+        self._refine_from_call(test, env, refin)
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            for v in test.values:
+                self._refine_test(v, env, refin)
+        if (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.left, ast.Name)):
+            rhs = self.eval(test.comparators[0], env, refin)
+            if not isinstance(rhs, Interval):
+                return
+            cur = env.get(test.left.id, TOP)
+            if not isinstance(cur, Interval):
+                return
+            op = test.ops[0]
+            # refinements store the interval OF the named expression;
+            # hi_under() derives a bounded_by value's bound as hi - 1
+            if isinstance(op, ast.LtE) and rhs.hi is not None:
+                cur = cur.clamp_max(rhs.hi)
+                refin[key_of(test.left)] = Interval(None, rhs.hi)
+            elif isinstance(op, ast.Lt) and rhs.hi is not None:
+                cur = cur.clamp_max(rhs.hi - 1)
+                refin[key_of(test.left)] = Interval(None, rhs.hi - 1)
+            elif isinstance(op, ast.GtE) and rhs.lo is not None:
+                cur = cur.clamp_min(rhs.lo)
+            elif isinstance(op, ast.Gt) and rhs.lo is not None:
+                cur = cur.clamp_min(rhs.lo + 1)
+            env[test.left.id] = cur
+
+    # -- expressions ----------------------------------------------------------
+
+    def _eff(self, iv: Interval, refin: dict) -> Interval:
+        """The interval with bounded_by provenance resolved under the
+        current refinements — what arithmetic that cannot carry the
+        provenance should consume."""
+        return Interval(iv.lo_under(refin), iv.hi_under(refin))
+
+    def eval(self, node: ast.expr, env: dict, refin: dict) -> object:
+        """Interval (or tuple of) for an expression; TOP when unknown."""
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return Interval(0, 1)
+            if isinstance(node.value, int):
+                return const(node.value)
+            return TOP
+        if isinstance(node, ast.Name):
+            got = env.get(node.id)
+            if got is not None:
+                return got
+            return self.consts.get(node.id, TOP)
+        if isinstance(node, ast.Tuple):
+            return tuple(self.eval(e, env, refin) for e in node.elts)
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval(node.operand, env, refin)
+            if isinstance(v, Interval) and isinstance(node.op, ast.USub):
+                return v.neg()
+            return TOP
+        if isinstance(node, ast.IfExp):
+            r_true = dict(refin)
+            env_true = dict(env)
+            self._refine_test(node.test, env_true, r_true)
+            a = self.eval(node.body, env_true, r_true)
+            b = self.eval(node.orelse, env, refin)
+            if isinstance(a, Interval) and isinstance(b, Interval):
+                return self._eff(a, r_true).join(self._eff(b, refin))
+            if (isinstance(a, tuple) and isinstance(b, tuple)
+                    and len(a) == len(b)):
+                return tuple(
+                    x.join(y) if isinstance(x, Interval)
+                    and isinstance(y, Interval) else TOP
+                    for x, y in zip(a, b))
+            return TOP
+        if isinstance(node, ast.BinOp):
+            return self._eval_binop(node, env, refin)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env, refin)
+        if isinstance(node, ast.Subscript):
+            base = self.eval(node.value, env, refin)
+            if isinstance(base, tuple):
+                if (isinstance(node.slice, ast.Constant)
+                        and isinstance(node.slice.value, int)
+                        and -len(base) <= node.slice.value < len(base)):
+                    return base[node.slice.value]
+                return TOP
+            # indexing/slicing an array keeps its element range
+            return base if isinstance(base, Interval) else TOP
+        if isinstance(node, ast.Compare):
+            return Interval(0, 1)
+        if isinstance(node, ast.Attribute):
+            return TOP
+        return TOP
+
+    def _eval_binop(self, node: ast.BinOp, env: dict,
+                    refin: dict) -> Interval:
+        a = self.eval(node.left, env, refin)
+        b = self.eval(node.right, env, refin)
+        a = a if isinstance(a, Interval) else TOP
+        b = b if isinstance(b, Interval) else TOP
+        op = node.op
+        if isinstance(op, ast.Add):
+            return a.add(b)
+        if isinstance(op, ast.Sub):
+            # the rotation idiom `(n - 1) - (e % n)` stays in [0, n-1]
+            # and KEEPS the `% n` provenance for later guard refinement
+            left = node.left
+            if (isinstance(left, ast.BinOp) and isinstance(left.op, ast.Sub)
+                    and isinstance(left.right, ast.Constant)
+                    and left.right.value == 1
+                    and b.bounded_by == key_of(left.left)):
+                n_iv = self.eval(left.left, env, refin)
+                hi = (n_iv.hi - 1 if isinstance(n_iv, Interval)
+                      and n_iv.hi is not None else None)
+                return Interval(0, hi, bounded_by=b.bounded_by)
+            return a.sub(b)
+        if isinstance(op, ast.Mult):
+            return a.mul(b)
+        if isinstance(op, ast.Mod):
+            return a.mod(b if b.lo is not None else
+                         self._eff(b, refin),
+                         bounded_by=key_of(node.right))
+        if isinstance(op, ast.LShift):
+            a_eff, b_eff = self._eff(a, refin), self._eff(b, refin)
+            if self.on_lshift is not None and self.depth == 0:
+                self.on_lshift(node, a_eff, b_eff, refin)
+            return a_eff.lshift(b_eff)
+        if isinstance(op, ast.RShift):
+            return self._eff(a, refin).rshift(self._eff(b, refin))
+        if isinstance(op, ast.BitOr):
+            # packed-key obligation: `(x << C) | field` must keep the
+            # field inside its C-bit width or it bleeds into the score
+            if (isinstance(node.left, ast.BinOp)
+                    and isinstance(node.left.op, ast.LShift)
+                    and self.on_packed_or is not None and self.depth == 0):
+                width = self.eval(node.left.right, env, refin)
+                if (isinstance(width, Interval) and width.lo is not None
+                        and width.lo == width.hi):
+                    self.on_packed_or(node, width.lo, b, refin)
+            return self._eff(a, refin).or_(self._eff(b, refin))
+        if isinstance(op, ast.BitAnd):
+            return self._eff(a, refin).and_(self._eff(b, refin))
+        return TOP
+
+    def _eval_call(self, node: ast.Call, env: dict,
+                   refin: dict) -> object:
+        tail = _tail(node.func)
+        args = node.args
+        if tail in ("clip",) and len(args) >= 3:
+            lo = self.eval(args[1], env, refin)
+            hi = self.eval(args[2], env, refin)
+            if isinstance(lo, Interval) and isinstance(hi, Interval):
+                return Interval(lo.lo, hi.hi)
+            return TOP
+        if tail in ("min", "max") and len(args) >= 2 \
+                and isinstance(node.func, ast.Name):
+            ivs = [self.eval(a, env, refin) for a in args]
+            ivs = [self._eff(v, refin) for v in ivs
+                   if isinstance(v, Interval)]
+            if len(ivs) != len(args):
+                return TOP
+            if tail == "min":
+                his = [v.hi for v in ivs if v.hi is not None]
+                los = [v.lo for v in ivs]
+                return Interval(
+                    min(los) if None not in los else None,
+                    min(his) if his else None)
+            los = [v.lo for v in ivs if v.lo is not None]
+            his = [v.hi for v in ivs]
+            return Interval(max(los) if los else None,
+                            max(his) if None not in his else None)
+        if tail == "where" and len(args) == 3:
+            a = self.eval(args[1], env, refin)
+            b = self.eval(args[2], env, refin)
+            if isinstance(a, Interval) and isinstance(b, Interval):
+                return self._eff(a, refin).join(self._eff(b, refin))
+            return TOP
+        if tail == "arange":
+            n = self.eval(args[0], env, refin) if args else TOP
+            if isinstance(n, Interval) and n.hi is not None:
+                return Interval(0, n.hi - 1, bounded_by=key_of(args[0]))
+            return Interval(0, None,
+                            bounded_by=key_of(args[0]) if args else None)
+        if tail in ("zeros", "zeros_like"):
+            return const(0)
+        if tail in ("ones", "ones_like"):
+            return const(1)
+        if tail in ("full", "full_like") and len(args) >= 2:
+            v = self.eval(args[1], env, refin)
+            return v if isinstance(v, Interval) else TOP
+        if tail == "astype" and isinstance(node.func, ast.Attribute):
+            return self.eval(node.func.value, env, refin)
+        if tail == "axis_index":
+            return Interval(0, None)
+        if tail in ("abs", "float", "int") and len(args) == 1 \
+                and isinstance(node.func, ast.Name):
+            v = self.eval(args[0], env, refin)
+            if isinstance(v, Interval):
+                return v if tail != "abs" else Interval(
+                    0, None if v.hi is None or v.lo is None
+                    else max(abs(v.lo), abs(v.hi)))
+            return TOP
+        if tail in self.guards:
+            return Interval(0, 1)
+        # same-package helper: inline depth-limited, else fall back to
+        # its retN annotations (the interprocedural contract seed)
+        target = self.index.find_function(
+            self.index.resolve(self.mod, node.func))
+        if target is not None:
+            return self._eval_helper(target, node, env, refin)
+        for a in args:
+            self.eval(a, env, refin)
+        return TOP
+
+    def _eval_helper(self, target: FunctionInfo, node: ast.Call,
+                     env: dict, refin: dict) -> object:
+        seeds = shape_seeds_for(target.sf, target.node)
+        body = [s for s in target.node.body
+                if not (isinstance(s, ast.Expr)
+                        and isinstance(s.value, ast.Constant))]
+        if (self.depth < MAX_INLINE_DEPTH
+                and len(body) <= MAX_INLINE_STMTS
+                and not any(isinstance(s, (ast.For, ast.While))
+                            for s in body)):
+            params = [a.arg for a in target.node.args.args]
+            if params and params[0] in ("self", "cls"):
+                params = params[1:]
+            arg_ivs: dict[str, Interval] = {}
+            for name, arg in zip(params, node.args):
+                v = self.eval(arg, env, refin)
+                if isinstance(v, Interval):
+                    arg_ivs[name] = self._eff(v, refin)
+            for kw in node.keywords:
+                if kw.arg:
+                    v = self.eval(kw.value, env, refin)
+                    if isinstance(v, Interval):
+                        arg_ivs[kw.arg] = self._eff(v, refin)
+            sub = FlowInterpreter(self.index, target.module, self.consts,
+                                  self.guards, depth=self.depth + 1)
+            try:
+                sub.run(target, seeds=seeds, arg_ivs=arg_ivs)
+            except RecursionError:   # pathological self-recursion
+                return TOP
+            out: object = None
+            for _, val, r in sub.returns:
+                cur = (sub._eff(val, r) if isinstance(val, Interval)
+                       else val)
+                if out is None:
+                    out = cur
+                elif isinstance(out, Interval) and isinstance(cur,
+                                                              Interval):
+                    out = out.join(cur)
+                elif (isinstance(out, tuple) and isinstance(cur, tuple)
+                        and len(out) == len(cur)):
+                    out = tuple(
+                        x.join(y) if isinstance(x, Interval)
+                        and isinstance(y, Interval) else TOP
+                        for x, y in zip(out, cur))
+                else:
+                    out = TOP
+            if out is not None:
+                return out
+        # contract fallback: declared retN seeds
+        rets = [(int(k[3:]), s.interval) for k, s in seeds.items()
+                if k.startswith("ret") and k[3:].isdigit()
+                and s.interval is not None]
+        if rets:
+            n = max(i for i, _ in rets) + 1
+            out_t = [TOP] * n
+            for i, iv in rets:
+                out_t[i] = iv
+            return out_t[0] if n == 1 else tuple(out_t)
+        return TOP
+
+
+def call_tail(node: ast.expr) -> Optional[str]:
+    """The trailing name of a callee expression (``jnp.stack`` ->
+    ``stack``); shared by the engine and every specflow analyzer."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+_tail = call_tail
+
+
+# -- SPMD (shard_map / pjit) site modelling -----------------------------------
+
+
+_SPMD_KW = {
+    "shard_map": ("in_specs", "out_specs"),
+    "pjit": ("in_shardings", "out_shardings"),
+}
+
+#: collectives and the position of their axis-name argument
+COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "axis_index": 0,
+}
+
+
+@dataclasses.dataclass
+class SpmdSite:
+    """One parsed shard_map/pjit call: resolved layouts + body."""
+
+    sf: SourceFile
+    module: str
+    line: int
+    call: ast.Call
+    body_fn: Optional[FunctionInfo]
+    bound_positional: int            # positionally partial-bound params
+    in_layouts: Optional[list[Layout]]   # None = not a literal tuple
+    out_layouts: Optional[list[Layout]]
+    axes: frozenset[str]             # mesh axes the specs name (live set)
+
+
+def _module_value_env(sf: SourceFile) -> dict[str, ast.expr]:
+    """Module-level ``NAME = <expr>`` map (resolves spec constants like
+    ``_NODES = P(NODES_AXIS)``)."""
+    out: dict[str, ast.expr] = {}
+    if sf.tree is None:
+        return out
+    for node in sf.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            out[node.targets[0].id] = node.value
+    return out
+
+
+def resolve_axis_name(index: ModuleIndex, mod: str,
+                      node: ast.expr) -> Optional[str]:
+    """A mesh-axis operand -> its string, through cross-module string
+    constants (``NODES_AXIS`` -> ``"nodes"``)."""
+    if isinstance(node, ast.Constant):
+        return node.value if isinstance(node.value, str) else None
+    fq = index.resolve(mod, node)
+    if fq is None:
+        return None
+    strs = module_str_consts(index)
+    if fq in strs:
+        return strs[fq]
+    # bare unresolved globals keep their name: try the site's own module
+    return strs.get(f"{mod}.{fq}")
+
+
+def parse_spec(index: ModuleIndex, mod: str, node: ast.expr,
+               value_env: dict[str, ast.expr]) -> Layout:
+    """One spec operand -> Layout.  ``P()`` is replicated; ``P("nodes")``
+    is sharded; ``None`` and anything unresolvable stay unknown."""
+    if isinstance(node, ast.Name) and node.id in value_env:
+        node = value_env[node.id]
+    if isinstance(node, ast.Constant) and node.value is None:
+        return UNKNOWN
+    if isinstance(node, ast.Call) and _tail(node.func) in (
+            "P", "PartitionSpec"):
+        axes = []
+        for a in node.args:
+            if isinstance(a, ast.Constant) and a.value is None:
+                continue
+            name = resolve_axis_name(index, mod, a)
+            if name is None:
+                return UNKNOWN
+            axes.append(name)
+        return sharded(tuple(axes)) if axes else REPLICATED
+    return UNKNOWN
+
+
+def _parse_specs(index: ModuleIndex, mod: str, node: Optional[ast.expr],
+                 value_env: dict) -> Optional[list[Layout]]:
+    if node is None:
+        return None
+    if isinstance(node, ast.Name) and node.id in value_env:
+        resolved = value_env[node.id]
+        if isinstance(resolved, (ast.Tuple, ast.List)):
+            node = resolved
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [parse_spec(index, mod, e, value_env) for e in node.elts]
+    # a single spec broadcasts: model as None (arity unknown) but keep
+    # the axis universe via parse_spec at the call site
+    return None
+
+
+def extract_spmd_sites(index: ModuleIndex) -> list[SpmdSite]:
+    """Every shard_map/pjit call in the package, with layouts resolved
+    through module spec constants and the body seen through partial."""
+    cache = getattr(index, "_specflow_sites", None)
+    if cache is not None:
+        return cache
+    sites: list[SpmdSite] = []
+    for mod, sf in sorted(index.modules.items()):
+        if sf.tree is None or not (
+                "shard_map" in sf.text or "pjit" in sf.text):
+            continue
+        value_env = _module_value_env(sf)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _tail(node.func)
+            if tail not in _SPMD_KW:
+                continue
+            in_kw, out_kw = _SPMD_KW[tail]
+            kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+            in_l = _parse_specs(index, mod, kwargs.get(in_kw), value_env)
+            out_l = _parse_specs(index, mod, kwargs.get(out_kw), value_env)
+            axes: set[str] = set()
+            for kw_node in (kwargs.get(in_kw), kwargs.get(out_kw)):
+                if kw_node is None:
+                    continue
+                elts = ([kw_node] if not isinstance(
+                    kw_node, (ast.Tuple, ast.List)) else kw_node.elts)
+                for e in elts:
+                    lay = parse_spec(index, mod, e, value_env)
+                    axes.update(lay.axes)
+            body_fn, bound = None, 0
+            if node.args:
+                f = node.args[0]
+                if isinstance(f, ast.Call) and _tail(f.func) in (
+                        "partial", "_partial"):
+                    bound = len(f.args) - 1
+                    f = f.args[0] if f.args else None
+                if f is not None:
+                    body_fn = index.find_function(index.resolve(mod, f))
+            sites.append(SpmdSite(
+                sf=sf, module=mod, line=node.lineno, call=node,
+                body_fn=body_fn, bound_positional=max(bound, 0),
+                in_layouts=in_l, out_layouts=out_l,
+                axes=frozenset(axes)))
+    index._specflow_sites = sites
+    return sites

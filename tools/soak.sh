@@ -89,9 +89,9 @@ log="$OUT/soak_$ts.log"
 total_passed=0
 total_failed=0
 failures=""
-echo "== koordlint static-analysis suite (python -m tools.koordlint)" \
-    | tee -a "$log"
-if python -m tools.koordlint >> "$log" 2>&1; then
+echo "== koordlint static-analysis suite" \
+    "(python -m tools.koordlint --format json)" | tee -a "$log"
+if python -m tools.koordlint --format json >> "$log" 2>&1; then
     total_passed=$((total_passed + 1))
 else
     total_failed=$((total_failed + 1))
