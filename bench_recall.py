@@ -88,6 +88,88 @@ def _recall_leg(n_nodes: int, n_pods: int, out: dict) -> None:
             _chosen_scores(state, pods, cfg, asn), 1)
 
 
+def _quality_leg(n_nodes: int, n_pods: int, out: dict) -> None:
+    """quality_lp vs greedy (ISSUE 13): assigned fraction, per-dim
+    capacity slack after the solve, and wall time for both engines at
+    one shape — the comparison that used to live in the root-level
+    scratch_quality.py / scratch_score_quality.py experiments, promoted
+    here with slack and provenance attached.  Plus the topo-gang leg:
+    realized plan diameter of the baseline vs the quality planner on a
+    seeded 2x2x2 topology."""
+    import numpy as np
+
+    from __graft_entry__ import _build_problem
+    from koordinator_tpu.api.resources import ResourceDim
+    from koordinator_tpu.ops.batch_assign import batch_assign
+    from koordinator_tpu.quality.lp_pack import lp_pack_assign
+
+    state, pods, cfg = _build_problem(n_nodes, n_pods, seed=42)
+    valid = float(np.asarray(pods.valid).sum())
+
+    def slack(st):
+        free = np.asarray(st.node_allocatable - st.node_requested)
+        alloc = np.asarray(st.node_allocatable)
+        node_valid = np.asarray(st.node_valid)
+        return {
+            dim.name.lower(): round(
+                float(free[node_valid, dim].sum())
+                / max(float(alloc[node_valid, dim].sum()), 1.0), 4)
+            for dim in ResourceDim
+            if float(alloc[node_valid, dim].sum()) > 0
+        }
+
+    shape = f"{n_pods}p_{n_nodes}n"
+    for name, solve in (
+        ("greedy", jax.jit(lambda s: batch_assign(s, pods, cfg)[:2])),
+        ("quality_lp", jax.jit(lambda s: lp_pack_assign(s, pods, cfg)[:2])),
+    ):
+        t0 = time.perf_counter()
+        asn, st = solve(state)
+        frac = float((np.asarray(asn) >= 0).sum()) / max(valid, 1.0)
+        out[f"assigned_frac_{name}_{shape}"] = round(frac, 4)
+        out[f"capacity_slack_{name}_{shape}"] = slack(st)
+        out[f"wall_s_{name}_{shape}"] = round(
+            time.perf_counter() - t0, 2)
+
+    # topo-gang diameter: baseline vs quality planner on a seeded tree
+    from koordinator_tpu.ops.network_topology import (
+        TopologyRequirements,
+        TopologyTree,
+        plan_gang_placement,
+    )
+    from koordinator_tpu.quality.topo_gang import (
+        plan_diameter,
+        plan_gang_placement_quality,
+    )
+    from koordinator_tpu.state.cluster_state import ClusterState, PodBatch
+
+    rng = np.random.default_rng(42)
+    tree = TopologyTree(["spine", "block", "node"])
+    t_nodes = 8
+    for i in range(t_nodes):
+        tree.add_node([f"s{i // 4}", f"b{i // 2}", f"n{i}"])
+    topo = tree.build()
+    alloc = np.zeros((t_nodes, jnp.asarray(pods.requests).shape[1]),
+                     np.int32)
+    alloc[:, 0] = rng.integers(2_000, 9_000, t_nodes)
+    alloc[:, 1] = 65_536
+    t_state = ClusterState.from_arrays(alloc)
+    members = 3
+    req = np.zeros((members, alloc.shape[1]), np.int32)
+    req[:, 0] = 2_000
+    req[:, 1] = 1_024
+    g_pods = PodBatch.build(req, node_capacity=t_nodes)
+    mask = np.zeros(g_pods.capacity, bool)
+    mask[:members] = True
+    existing = jnp.asarray(rng.integers(0, 2, t_nodes).astype(np.int32))
+    treq = TopologyRequirements(desired_slots=members)
+    for name, plan_fn in (("baseline", plan_gang_placement),
+                          ("quality", plan_gang_placement_quality)):
+        plan = plan_fn(t_state, g_pods, mask, topo, treq,
+                       node_existing=existing)
+        out[f"gang_topo_diameter_{name}"] = plan_diameter(plan, topo)
+
+
 def _at_shape_leg(n_nodes: int, n_pods: int, out: dict) -> None:
     from __graft_entry__ import _build_problem
     from koordinator_tpu.ops.batch_assign import batch_assign
@@ -128,6 +210,11 @@ def main() -> None:
                          "< 0.99 on tpu",
     }
     _recall_leg(n_nodes, n_pods, out)
+    # quality leg at the recall shape (KOORD_RECALL_QUALITY=0 skips):
+    # the solve-quality comparison ROADMAP item 4 benches against —
+    # assigned fraction + capacity slack per dim + gang topo diameter
+    if int(os.environ.get("KOORD_RECALL_QUALITY", "1")):
+        _quality_leg(n_nodes, n_pods, out)
     if shape_pods:
         _at_shape_leg(n_nodes, shape_pods, out)
     print(json.dumps(out))

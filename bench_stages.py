@@ -206,6 +206,75 @@ def main() -> None:
         print(json.dumps({"stage": "refresh_incremental_1pct",
                           "error": repr(e)[:200]}), flush=True)
 
+    # -- quality stages (ISSUE 13): the LP-relaxation packing solve and
+    # the topo-gang ranking kernel, so an escalated quality round's
+    # per-iteration cost lands in the record next to the greedy stages
+    # it replaces (provenance line above covers these captures too)
+    from koordinator_tpu.quality.lp_pack import lp_pack_assign
+
+    def lp_pack_loop(st0, p):
+        def body(i, carry):
+            acc, usage = carry
+            a, new_state, _, q_iters = lp_pack_assign(
+                st0.replace(node_usage=usage), p, cfg)
+            return (acc + (a >= 0).sum().astype(jnp.int32) + q_iters,
+                    usage + (new_state.node_requested & 1))
+        acc, _ = jax.lax.fori_loop(0, iters, body,
+                                   (jnp.int32(0), st0.node_usage))
+        return acc
+
+    try:
+        sec, value = _time_chained(lp_pack_loop, (state, pods), rtt, iters)
+        stage_secs["lp_pack_smoke"] = sec
+        _emit("lp_pack_smoke", sec,
+              {"vs_rounds_x": round(sec / max(stage_secs["rounds"], 1e-9),
+                                    1)})
+    except Exception as e:
+        print(json.dumps({"stage": "lp_pack_smoke",
+                          "error": repr(e)[:200]}), flush=True)
+
+    from koordinator_tpu.ops.network_topology import TopologyTree
+    from koordinator_tpu.quality.topo_gang import (
+        gang_topo_diameter,
+        rank_candidates_quality,
+    )
+
+    gang_tree = TopologyTree(["spine", "block", "node"])
+    t_leaves = min(n_nodes, 256)
+    for i in range(t_leaves):
+        gang_tree.add_node([f"s{i // 64}", f"b{i // 8}", f"n{i}"])
+    topo = gang_tree.build()
+    t = topo.num_topo
+    t_cand = jnp.asarray((np.arange(t) % 3) == 0)
+    t_slots = jnp.asarray((np.arange(t) % 7).astype(np.int32))
+    t_scores = jnp.asarray((np.arange(t) % 11).astype(np.int32))
+    t_exist = jnp.asarray((np.arange(t) % 2).astype(np.int32))
+    g_rows = jnp.asarray(np.arange(min(t_leaves, 32), dtype=np.int32))
+    g_valid = jnp.ones(g_rows.shape[0], bool)
+
+    def topo_rank_loop(cand, slots, scores, exist, rows, rows_valid):
+        def body(i, carry):
+            acc, perturb = carry
+            ranked = rank_candidates_quality(
+                topo, cand, slots, scores + perturb, exist)
+            dia = gang_topo_diameter(rows, rows_valid, topo)
+            return (acc + ranked.sum().astype(jnp.int32) + dia,
+                    perturb + (dia & 1))
+        acc, _ = jax.lax.fori_loop(0, iters, body,
+                                   (jnp.int32(0), jnp.int32(0)))
+        return acc
+
+    try:
+        sec, _ = _time_chained(
+            topo_rank_loop,
+            (t_cand, t_slots, t_scores, t_exist, g_rows, g_valid),
+            rtt, iters)
+        stage_secs["topo_gang_rank"] = sec
+        _emit("topo_gang_rank", sec, {"topo_nodes": t})
+    except Exception as e:
+        print(json.dumps({"stage": "topo_gang_rank",
+                          "error": repr(e)[:200]}), flush=True)
+
     # -- sharded stages (ISSUE 10): the shard_map node-axis path, so a
     # staged capture attributes sharded-path wins per stage.  Runs on
     # the all-devices mesh (1-way on a single chip: same program, no

@@ -601,6 +601,25 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
         help="pods admitted per multi-tenant cycle across all tenants "
              "(the weighted deficit-round-robin quantum)")
     parser.add_argument(
+        "--quality-mode", choices=("off", "lp", "auto"), default="off",
+        help="solve-quality mode (quality/lp_pack): off = the greedy "
+             "top-k path exactly; lp = every eligible round solves "
+             "with the LP-relaxation packing engine (dual-price "
+             "ascent + iterative masked rounding, feasibility-checked "
+             "by the greedy path's own capacity/quota kernels); auto "
+             "= escalate only rounds whose result leaves min-over-dims "
+             "capacity_slack_fraction above --quality-slack-threshold. "
+             "Gangs with topology requirements additionally plan "
+             "through the rank-aware minimal-diameter planner "
+             "(quality/topo_gang) whenever the mode is not off")
+    parser.add_argument(
+        "--quality-slack-threshold", type=float, default=0.3,
+        help="auto-mode escalation bar: when the MINIMUM "
+             "capacity_slack_fraction over provisioned dims left by a "
+             "round exceeds this, the next round solves on the "
+             "quality path (every dimension must have headroom worth "
+             "winning back)")
+    parser.add_argument(
         "--enable-profile-endpoint", action="store_true",
         help="arm /debug/profile?seconds=N (on-demand jax.profiler "
              "capture); OFF by default — the endpoint answers 403 "
@@ -668,6 +687,8 @@ def main_koord_scheduler(argv: list[str],
         trace_pods=args.trace_pods,
         explain=not args.no_explain,
         flight_ring_size=args.flight_ring_size,
+        quality_mode=args.quality_mode,
+        quality_slack_threshold=args.quality_slack_threshold,
     )
     tenant_front = None
     if args.tenants > 1:

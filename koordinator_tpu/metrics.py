@@ -596,6 +596,32 @@ capacity_slack = SCHEDULER.gauge(
     "dim) — the per-dim headroom left before fit_<dim> rejections "
     "dominate")
 
+# -- solve-quality mode (quality/lp_pack + quality/topo_gang, ISSUE 13) --
+solver_quality_mode = SCHEDULER.gauge(
+    "solver_quality_mode",
+    "Configured solve-quality mode: 0=off (greedy only), 1=lp (every "
+    "eligible round solves with the LP-relaxation packing engine), "
+    "2=auto (escalate only rounds whose result leaves "
+    "capacity_slack_fraction above the threshold)")
+quality_rounds = SCHEDULER.counter(
+    "quality_rounds_total",
+    "Rounds solved on the LP-relaxation quality path (labels: "
+    "mode=lp|auto, outcome=complete|partial — partial means the round "
+    "still diagnosed failures after the quality solve and the exact "
+    "rescue pass)")
+quality_iterations = SCHEDULER.histogram(
+    "quality_iterations",
+    "Rounding phases the LP quality solve executed per round (bounded "
+    "by the engine's rounding_iters — a round pinned at the bound "
+    "means contention never cleared and the final prefix resolution "
+    "did the placing)",
+    buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0))
+quality_slack_recovered = SCHEDULER.gauge(
+    "quality_slack_recovered_fraction",
+    "Fraction of total allocatable capacity the last quality round "
+    "turned from free slack into placements, per resource dimension "
+    "(label: dim): (free_before - free_after) / allocatable")
+
 be_suppress_cpu_cores = KOORDLET.gauge(
     "be_suppress_cpu_cores", "CPU cores currently allowed for BE")
 pod_eviction_total = KOORDLET.counter(

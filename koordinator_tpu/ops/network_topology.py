@@ -330,7 +330,7 @@ def _distribute_host(
     return (nodes, counts) if got >= desired else ([], [])
 
 
-def plan_gang_placement(
+def gang_candidate_prep(
     state: ClusterState,
     pods: PodBatch,
     gang_mask: np.ndarray,
@@ -339,11 +339,19 @@ def plan_gang_placement(
     node_scores: jnp.ndarray | None = None,
     node_existing: jnp.ndarray | None = None,
     cfg=None,
-) -> np.ndarray:
-    """Full placement plan for one gang: (P,) int32 planned node per gang pod
-    (-1 for non-members / infeasible). Mirrors PlacePods
-    (``network_topology_solver.go:53``): the plan is then fed to the solver
-    one node at a time (the reference's FindOneNode path).
+):
+    """Candidate-prep pipeline shared by BOTH gang planners (the
+    baseline :func:`plan_gang_placement` and
+    quality/topo_gang.plan_gang_placement_quality): whole-gang node
+    feasibility intersection, desired-slots default, member-request
+    front-packing, layer-multiple padding, then the offer-slots ->
+    tree-aggregation -> multiples -> eligibility kernel chain.  One
+    implementation, so a feasibility or multiples fix can never land
+    in one planner and silently diverge the other.
+
+    Returns ``(member_idx, desired, mults, t_slots, t_scores,
+    t_existing, cand)``; only candidate ORDER and the commit rule
+    differ between planners downstream.
     """
     n = state.capacity
     node_valid = state.node_valid
@@ -377,11 +385,33 @@ def plan_gang_placement(
     )
 
     slots = gang_offer_slots(state, gang_requests, node_valid, cfg)
-    t_slots, t_scores, t_existing = aggregate_tree(topo, slots, node_scores, node_existing)
+    t_slots, t_scores, t_existing = aggregate_tree(
+        topo, slots, node_scores, node_existing)
     t_slots = constrain_multiples(topo, t_slots, mults)
     cand, _ = eligible_candidates(
         topo, t_slots, jnp.int32(desired), jnp.int32(req.must_gather_layer)
     )
+    return member_idx, desired, mults, t_slots, t_scores, t_existing, cand
+
+
+def plan_gang_placement(
+    state: ClusterState,
+    pods: PodBatch,
+    gang_mask: np.ndarray,
+    topo: TopologyArrays,
+    req: TopologyRequirements,
+    node_scores: jnp.ndarray | None = None,
+    node_existing: jnp.ndarray | None = None,
+    cfg=None,
+) -> np.ndarray:
+    """Full placement plan for one gang: (P,) int32 planned node per gang pod
+    (-1 for non-members / infeasible). Mirrors PlacePods
+    (``network_topology_solver.go:53``): the plan is then fed to the solver
+    one node at a time (the reference's FindOneNode path).
+    """
+    member_idx, desired, mults, t_slots, t_scores, t_existing, cand = (
+        gang_candidate_prep(state, pods, gang_mask, topo, req,
+                            node_scores, node_existing, cfg))
     ranked = rank_candidates(topo, cand, t_slots, t_scores, t_existing)
 
     # Host-side: walk ranked candidates until one distributes fully.

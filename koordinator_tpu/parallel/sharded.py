@@ -52,7 +52,7 @@ which is why sharding composes with the >32,768-node wide regime.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -140,6 +140,24 @@ def _local_select_body(st_local, pods, cfg, *, k, strata, n_total):
     return cand_key, cand_node, cand_score
 
 
+@lru_cache(maxsize=None)
+def _select_program(mesh, n_total, k, strata):
+    """Jitted shard_map selection program, memoized on its statics.
+
+    Every sharded entry point memoizes its jitted program this way:
+    shard_map traced eagerly re-dispatches op by op on EVERY call (and
+    re-traces per fresh ``partial`` closure), which made repeated
+    direct calls — the 1/2/4/8 mesh-invariance sweeps, the dirty-node
+    refresh loops, bench stages — pay trace + per-op dispatch each
+    time.  ``Mesh`` hashes by (devices, axis names), so equal meshes
+    share the entry, and the kit's outer jit composes (nested jit
+    inlines)."""
+    return jax.jit(shard_map(
+        partial(_local_select_body, k=k, strata=strata, n_total=n_total),
+        mesh=mesh, in_specs=(_NODES, _REP, _REP),
+        out_specs=(_REP, _REP, _REP), check_rep=False))
+
+
 def sharded_select_candidates(mesh, state, pods, cfg, k: int = 32,
                               spread_bits=(5, 15),
                               with_scores: bool = False):
@@ -152,10 +170,7 @@ def sharded_select_candidates(mesh, state, pods, cfg, k: int = 32,
     n_total = state.capacity
     check_shardable(n_total, mesh)
     k = min(k, n_total)
-    fn = shard_map(
-        partial(_local_select_body, k=k, strata=strata, n_total=n_total),
-        mesh=mesh, in_specs=(_NODES, _REP, _REP),
-        out_specs=(_REP, _REP, _REP), check_rep=False)
+    fn = _select_program(mesh, n_total, k, strata)
     cand_key, cand_node, cand_score = fn(state, pods, cfg)
     if with_scores:
         return cand_key, cand_node, cand_score
@@ -255,16 +270,22 @@ def _rounds_body(st_local, pods, quota, cand_key, cand_node, *,
     return a, st_local.replace(node_requested=requested), new_quota
 
 
+@lru_cache(maxsize=None)
+def _rounds_program(mesh, n_total, rounds):
+    """Jitted shard_map rounds program (see :func:`_select_program`)."""
+    return jax.jit(shard_map(
+        partial(_rounds_body, rounds=rounds, n_total=n_total),
+        mesh=mesh, in_specs=(_NODES, _REP, _REP, _REP, _REP),
+        out_specs=(_REP, _NODES, _REP), check_rep=False))
+
+
 def sharded_assign_rounds(mesh, state, pods, quota, cand_key, cand_node,
                           rounds: int = 12):
     """``_assign_rounds`` over the mesh: (assignments, new_state, quota)."""
     n_total = state.capacity
     check_shardable(n_total, mesh)
-    fn = shard_map(
-        partial(_rounds_body, rounds=rounds, n_total=n_total),
-        mesh=mesh, in_specs=(_NODES, _REP, _REP, _REP, _REP),
-        out_specs=(_REP, _NODES, _REP), check_rep=False)
-    return fn(state, pods, quota, cand_key, cand_node)
+    return _rounds_program(mesh, n_total, rounds)(
+        state, pods, quota, cand_key, cand_node)
 
 
 def _round_pass_body(st_local, pods, quota, cand_key, cand_node, cfg, *,
@@ -292,6 +313,15 @@ def _round_pass_body(st_local, pods, quota, cand_key, cand_node, cfg, *,
             est_accum)
 
 
+@lru_cache(maxsize=None)
+def _round_pass_program(mesh, n_total, rounds):
+    """Jitted shard_map pass-1 program (see :func:`_select_program`)."""
+    return jax.jit(shard_map(
+        partial(_round_pass_body, rounds=rounds, n_total=n_total),
+        mesh=mesh, in_specs=(_NODES, _REP, _REP, _REP, _REP, _REP),
+        out_specs=(_REP, _NODES, _REP, _NODES), check_rep=False))
+
+
 def sharded_assign_round_pass(mesh, state, pods, quota, cand_key,
                               cand_node, cfg, rounds: int = 12):
     """``assign_round_pass`` over the mesh: first solve pass over
@@ -300,11 +330,8 @@ def sharded_assign_round_pass(mesh, state, pods, quota, cand_key,
     est_accum); ``est_accum`` is node-sharded like the state."""
     n_total = state.capacity
     check_shardable(n_total, mesh)
-    fn = shard_map(
-        partial(_round_pass_body, rounds=rounds, n_total=n_total),
-        mesh=mesh, in_specs=(_NODES, _REP, _REP, _REP, _REP, _REP),
-        out_specs=(_REP, _NODES, _REP, _NODES), check_rep=False)
-    return fn(state, pods, quota, cand_key, cand_node, cfg)
+    return _round_pass_program(mesh, n_total, rounds)(
+        state, pods, quota, cand_key, cand_node, cfg)
 
 
 def _followup_body(st_local, est_local, pods, quota, cfg, *,
@@ -339,6 +366,16 @@ def _followup_body(st_local, est_local, pods, quota, cfg, *,
             est_accum)
 
 
+@lru_cache(maxsize=None)
+def _followup_program(mesh, n_total, k, strata, rounds):
+    """Jitted shard_map follow-up program (see :func:`_select_program`)."""
+    return jax.jit(shard_map(
+        partial(_followup_body, k=k, strata=strata,
+                rounds=rounds, n_total=n_total),
+        mesh=mesh, in_specs=(_NODES, _NODES, _REP, _REP, _REP),
+        out_specs=(_REP, _NODES, _REP, _NODES), check_rep=False))
+
+
 def sharded_assign_followup_pass(mesh, state, est_accum, pods, quota, cfg,
                                  k: int = 32, rounds: int = 12,
                                  spread_bits=(5, 15)):
@@ -349,12 +386,8 @@ def sharded_assign_followup_pass(mesh, state, est_accum, pods, quota, cfg,
               else (spread_bits,))
     n_total = state.capacity
     check_shardable(n_total, mesh)
-    fn = shard_map(
-        partial(_followup_body, k=min(k, n_total), strata=strata,
-                rounds=rounds, n_total=n_total),
-        mesh=mesh, in_specs=(_NODES, _NODES, _REP, _REP, _REP),
-        out_specs=(_REP, _NODES, _REP, _NODES), check_rep=False)
-    return fn(state, est_accum, pods, quota, cfg)
+    return _followup_program(mesh, n_total, min(k, n_total), strata,
+                             rounds)(state, est_accum, pods, quota, cfg)
 
 
 # ---------------------------------------------------------------------------
@@ -424,6 +457,15 @@ def _refresh_body(st_local, pods, cfg, cache, dirty_rows, dirty_valid, *,
     return cand_key, ba.CandidateCache(cand_key, cand_node, cand_score)
 
 
+@lru_cache(maxsize=None)
+def _refresh_program(mesh, n_total, k, strata):
+    """Jitted shard_map refresh program (see :func:`_select_program`)."""
+    return jax.jit(shard_map(
+        partial(_refresh_body, k=k, strata=strata, n_total=n_total),
+        mesh=mesh, in_specs=(_NODES, _REP, _REP, _REP, _REP, _REP),
+        out_specs=(_REP, _REP), check_rep=False))
+
+
 def sharded_refresh_candidates(mesh, state, pods, cfg, cache, dirty_rows,
                                dirty_valid, k: int = 32,
                                spread_bits=(5, 15)):
@@ -434,9 +476,69 @@ def sharded_refresh_candidates(mesh, state, pods, cfg, cache, dirty_rows,
               else (spread_bits,))
     n_total = state.capacity
     check_shardable(n_total, mesh)
-    fn = shard_map(
-        partial(_refresh_body, k=min(k, n_total), strata=strata,
-                n_total=n_total),
-        mesh=mesh, in_specs=(_NODES, _REP, _REP, _REP, _REP, _REP),
-        out_specs=(_REP, _REP), check_rep=False)
-    return fn(state, pods, cfg, cache, dirty_rows, dirty_valid)
+    return _refresh_program(mesh, n_total, min(k, n_total), strata)(
+        state, pods, cfg, cache, dirty_rows, dirty_valid)
+
+
+# ---------------------------------------------------------------------------
+# Quality mode: the LP-relaxation packing solve over the nodes axis
+# ---------------------------------------------------------------------------
+
+
+# koordlint: shape[st_local: NxR i32 nodes]
+def _lp_pack_body(st_local, pods, quota, cfg, *, n_total, ascent_iters,
+                  rounding_iters):
+    """Shard-local LP-pack body: the SAME ``quality/lp_pack._lp_core``
+    the single-device entry runs, with the collectives live.  Scores
+    and prices are shard-local columns; the per-pod argmax merges
+    per-shard winners on the global integer (key, tb) scale and every
+    acceptance decision is replicated — the union-of-bests and
+    owner-psum exactness arguments of the greedy rounds apply term for
+    term, and all arithmetic is integer, so shard counts can't perturb
+    a single bit."""
+    from koordinator_tpu.quality.lp_pack import _lp_core
+
+    a, requested, new_quota, iters = _lp_core(
+        st_local, pods, quota, cfg, n_total=n_total,
+        ascent_iters=ascent_iters, rounding_iters=rounding_iters,
+        axis=NODES_AXIS)
+    return a, st_local.replace(node_requested=requested), new_quota, iters
+
+
+@lru_cache(maxsize=None)
+def _lp_pack_program(mesh, n_total, ascent_iters, rounding_iters):
+    """Jitted shard_map LP program, memoized on (mesh, shape, bounds).
+
+    The LP solve is a while-loop program an order of magnitude pricier
+    to trace than the greedy passes; without the memo every direct call
+    (the 1/2/4/8 mesh-invariance sweeps, bench stages) re-traces it even
+    at identical shapes.  ``Mesh`` hashes by (devices, axis names), so
+    equal meshes built by different ``solver_mesh`` calls share the
+    entry; the kit's own jit wrapper composes fine on top (nested jit
+    inlines)."""
+    return jax.jit(shard_map(
+        partial(_lp_pack_body, n_total=n_total,
+                ascent_iters=ascent_iters,
+                rounding_iters=rounding_iters),
+        mesh=mesh, in_specs=(_NODES, _REP, _REP, _REP),
+        out_specs=(_REP, _NODES, _REP, _REP), check_rep=False))
+
+
+def sharded_lp_pack_assign(mesh, state, pods, cfg, quota=None,
+                           ascent_iters: int | None = None,
+                           rounding_iters: int | None = None):
+    """``quality/lp_pack.lp_pack_assign`` over the mesh's nodes axis.
+
+    Bit-identical to the single-device LP solve at every shard count
+    (tests/test_quality.py sweeps 1/2/4/8): returns (assignments,
+    new_state, new_quota, iters) with the state node-sharded like the
+    greedy sharded passes."""
+    from koordinator_tpu.quality import lp_pack as lp
+
+    n_total = state.capacity
+    check_shardable(n_total, mesh)
+    fn = _lp_pack_program(
+        mesh, n_total,
+        lp.ASCENT_ITERS if ascent_iters is None else ascent_iters,
+        lp.ROUNDING_ITERS if rounding_iters is None else rounding_iters)
+    return fn(state, pods, quota, cfg)

@@ -1,0 +1,29 @@
+"""Solve-quality subsystem: the opt-in high-quality packing mode.
+
+Two engines behind ``Scheduler(quality_mode=...)`` (ROADMAP item 4):
+
+- :mod:`koordinator_tpu.quality.lp_pack` — an LP-relaxation of the
+  pods x nodes x resource-dims packing problem (integer dual-price
+  ascent + iterative masked rounding) that replaces the greedy top-k
+  batch solve for escalated rounds, never admitting an assignment the
+  greedy path's capacity/quota oracles would reject;
+- :mod:`koordinator_tpu.quality.topo_gang` — rank-aware gang placement
+  that scores candidate slot sets by network-topology distance so
+  MPI-style gangs land on minimal-diameter subtrees.
+
+See docs/solve_quality.md for the formulation and the feasibility
+argument.
+"""
+
+from koordinator_tpu.quality.lp_pack import (  # noqa: F401
+    ASCENT_ITERS,
+    ROUNDING_ITERS,
+    lp_pack_assign,
+)
+from koordinator_tpu.quality.topo_gang import (  # noqa: F401
+    gang_topo_diameter,
+    plan_gang_placement_quality,
+    rank_candidates_quality,
+)
+
+QUALITY_MODES = ("off", "lp", "auto")

@@ -1,0 +1,309 @@
+"""LP-relaxation packing: dual-price ascent + iterative masked rounding.
+
+The greedy/top-k batch solve (ops/batch_assign.py) is the throughput
+path; this module is the QUALITY path ("Priority Matters: Optimising
+Kubernetes Clusters Usage with Constraint-Based Pod Packing",
+PAPERS.md).  It solves the LP relaxation of the packing problem
+
+    max  sum_{p,n} x_{pn} * score_{pn}
+    s.t. sum_p x_{pn} * req_{pr} <= free_{nr}     (capacity, per dim)
+         sum_n x_{pn} <= 1,  x >= 0               (one node per pod)
+
+by projected subgradient ascent on the DUAL of the capacity
+constraints: each node carries an integer price, each pod's reduced
+utility is its score minus the node's price, pods sit on their
+argmax-utility feasible node, and prices rise on oversubscribed nodes
+until contention clears (the tensor form of an auction/price-ascent
+LP solver — every step is a masked integer tensor op, so results are
+bit-identical across mesh shapes by construction).
+
+Iterative masked rounding then fixes the HIGHEST-CONFIDENCE rows: a
+pod whose chosen node is uncontended (the full active demand on that
+node fits its headroom) is accepted and charged; contended pods stay
+relaxed and keep ascending prices against the shrunk residual.  The
+final iteration forces a priority-prefix resolution so bounded
+iteration count is a hard guarantee, and EVERY acceptance — early or
+final — goes through the exact same kernels the greedy path uses
+(``ops/batch_assign._prefix_accept_choice`` for capacity,
+``quota_admission_mask``/``_quota_prefix_accept``/``charge_quota_batch``
+for quota), so this mode can never admit an assignment greedy's
+oracle would reject.
+
+Why it packs better than greedy at tight shapes: greedy fixes every
+pod in one priority sweep against static scores, so a high-priority
+pod happily takes the last node a lower-priority pod NEEDED (score
+order is blind to who else fits where).  Price ascent makes contended
+capacity expensive first, so pods WITH alternatives drain away from
+nodes that are some pod's only option before anything is fixed.
+
+The whole module is integer arithmetic end to end (int32 scores,
+prices, demands): integer max/sum reductions are associative, which is
+what makes the sharded twin (``parallel/sharded.sharded_lp_pack_assign``)
+bit-identical to the single-device solve at every mesh width.  The
+``axis`` parameter threads the two executions through ONE body: with
+``axis=None`` the collectives degenerate to identities; under
+``shard_map`` they are the same owner-psum / all-gather-merge patterns
+the greedy sharded path proved exact (parallel/sharded.py docstring).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.ops import batch_assign as ba
+from koordinator_tpu.ops.assignment import score_pods
+from koordinator_tpu.quota.admission import (
+    charge_quota_batch,
+    quota_admission_mask,
+)
+
+#: price-ascent iterations per rounding phase — each is one O(P·N)
+#: argmax + one integer demand reduction
+ASCENT_ITERS = 8
+#: rounding phases (the bounded-iteration guarantee): phase i fixes the
+#: uncontended rows, the LAST phase forces priority-prefix resolution
+ROUNDING_ITERS = 6
+#: price bump per overloaded ascent step, scaled by the node's overload
+#: fraction: bump = ceil(overload * PRICE_GAIN / allocatable)
+PRICE_GAIN = 512
+#: floor on the bump of any overloaded node — prices must move even when
+#: the overload fraction rounds to zero
+PRICE_MIN_STEP = 16
+#: price ceiling: past 2*_SCORE_CLIP the node's utility is already
+#: saturated at 0 for every pod, so higher prices change nothing; the
+#: cap keeps price + clip arithmetic far from int32 limits
+PRICE_CAP = 4 * ba._SCORE_CLIP
+#: overload clamp before the PRICE_GAIN multiply (int32 headroom: a 50k
+#: pod stampede's demand sum times the gain must not overflow)
+_OVERLOAD_CLIP = 1 << 20
+
+
+# koordlint: shape[ret0: PxN i32 -1..1073741823, ret1: PxN i32 0..1073741823]
+def _priced_keys(base, fits, prices, rot_id, pos, n_valid, n_total):
+    """(key, tb) ranking of price-adjusted utilities on the SAME packed /
+    wide integer key scale as the greedy solver (``ba._rank_parts``:
+    ``ba._packed_regime``/``ba._TB_BITS`` gate the packing identically).
+
+    ``u = clip(base - prices, -CLIP, CLIP) + CLIP`` keeps the full
+    ordering of priced-out columns (a plain clip at 0 would collapse
+    them); ``u >> 1`` fits the packed key's quantized-score field.  One
+    utility bit of precision is the entire cost.
+
+    The tie-break rotates over COMPACTED valid-node positions (``pos``,
+    modulus ``n_valid``) rather than raw padded row ids: with the greedy
+    path's ``(ids - rot) % n_total`` form, heavy row padding parks most
+    pods' preferred offsets in invalid-id space, which all wrap to the
+    same low valid node — identical pods then herd onto one copy of an
+    identical node and the price ascent limit-cycles between copies
+    instead of splitting them.  With zero padding ``pos == ids`` and
+    ``n_valid == n_total``, so this tb is bit-identical to
+    ``_rank_parts``'s; greedy is immune either way (one sweep, no
+    re-bidding), so its key stays untouched.
+    """
+    u = jnp.clip(base - prices[None, :], -ba._SCORE_CLIP,
+                 ba._SCORE_CLIP) + ba._SCORE_CLIP
+    rot = (rot_id.astype(jnp.int32) * 7919)[:, None]
+    tb = (n_total - 1) - ((pos[None, :] - rot) % n_valid)
+    q = u >> 1
+    key = ((q << ba._TB_BITS) | tb) if ba._packed_regime(n_total) else q
+    return jnp.where(fits, key, -1), tb
+
+
+def _local_best(key, tb, node_ids):
+    """Per-pod best LOCAL column by (key, tb) rank — the two-stage
+    argmax of ``ba._choose_candidate``, returning the winning (key, tb,
+    global node id) triple so winners can merge across shards on one
+    scale.  Rank pairs are unique per pod (tb is a permutation of node
+    ids), so the winner is order-deterministic in both key regimes."""
+    bkey = jnp.max(key, axis=1)
+    col = jnp.argmax(jnp.where(key == bkey[:, None], tb, -1), axis=1)
+    return (bkey, jnp.take_along_axis(tb, col[:, None], axis=1)[:, 0],
+            node_ids[col])
+
+
+def _merge_best(bkey, btb, bnode, axis):
+    """Cross-shard merge of per-shard winners: gather the (P,) triples
+    to (P, D) and re-run the two-stage argmax.  The global best of a
+    union of per-shard bests equals the global best of all columns, and
+    (key, tb) pairs of distinct nodes are unique per pod, so the merged
+    winner is bit-identical to a full-width argmax.  ``axis=None`` is
+    the degenerate single-device merge (D = 1)."""
+    if axis is None:
+        g_key, g_tb, g_node = (bkey[:, None], btb[:, None], bnode[:, None])
+    else:
+        g_key = jax.lax.all_gather(bkey, axis, axis=1)
+        g_tb = jax.lax.all_gather(btb, axis, axis=1)
+        g_node = jax.lax.all_gather(bnode, axis, axis=1)
+    wkey = jnp.max(g_key, axis=1)
+    d = jnp.argmax(jnp.where(g_key == wkey[:, None], g_tb, -1), axis=1)
+    node = jnp.take_along_axis(g_node, d[:, None], axis=1)[:, 0]
+    return jnp.where(wkey >= 0, node, -1), wkey >= 0
+
+
+# koordlint: shape[st_local: NxR i32 nodes]
+def _lp_core(st_local, pods, quota, cfg, *, n_total, ascent_iters,
+             rounding_iters, axis=None):
+    """The shared single-device / shard-local LP solve body.
+
+    ``st_local`` is the full state (``axis=None``) or one shard's node
+    rows (under ``shard_map`` over the nodes axis); pods/quota are
+    replicated.  Returns (assignments, requested_local, quota, iters):
+    assignments/quota/iters replicated, requested node-sharded like the
+    input state.
+    """
+    n_loc = st_local.capacity
+    off = (jnp.int32(0) if axis is None
+           else jax.lax.axis_index(axis).astype(jnp.int32) * n_loc)
+    node_ids = off + jnp.arange(n_loc, dtype=jnp.int32)
+    p = pods.capacity
+    rot = pods.rot_id
+
+    def psum(x):
+        return x if axis is None else jax.lax.psum(x, axis)
+
+    # compacted global valid-node positions for the tie-break rotation
+    # (see _priced_keys): exclusive local cumsum + this shard's global
+    # offset.  All-integer and globally consistent, so mesh invariance
+    # holds; capacity is static so the gather shape is too.
+    valid_i = st_local.node_valid.astype(jnp.int32)
+    loc_cnt = jnp.sum(valid_i)
+    if axis is None:
+        shard_off = jnp.int32(0)
+        n_valid = loc_cnt
+    else:
+        counts = jax.lax.all_gather(loc_cnt, axis)          # (D,)
+        d = jax.lax.axis_index(axis)
+        shard_off = jnp.sum(jnp.where(
+            jnp.arange(counts.shape[0]) < d, counts, 0)).astype(jnp.int32)
+        n_valid = jnp.sum(counts).astype(jnp.int32)
+    pos = shard_off + jnp.cumsum(valid_i) - valid_i
+    n_valid = jnp.maximum(n_valid, 1)
+
+    scores, feasible = score_pods(st_local, pods, cfg)     # (P, n_loc)
+    base = jnp.clip(scores, 0, ba._SCORE_CLIP)
+    order = jnp.lexsort((jnp.arange(p), -pods.priority))
+    req = pods.requests
+    alloc_den = jnp.maximum(st_local.node_allocatable, 1)
+
+    def seg_demand(choice_loc, own_act):
+        """(n_loc, R) active demand on this shard's nodes — exact
+        integer segment sum (unowned/inactive rows hit the overflow
+        bucket)."""
+        seg = jnp.where(own_act, choice_loc, n_loc)
+        req_act = jnp.where(own_act[:, None], req, 0)
+        return jax.ops.segment_sum(req_act, seg,
+                                   num_segments=n_loc + 1)[:n_loc]
+
+    def outer_body(carry):
+        i, prices, requested, assignments, active, qstate = carry
+        free_loc = jnp.where(
+            st_local.node_valid[:, None],
+            st_local.node_allocatable - requested, 0)
+        # the residual problem's feasible-fit mask: capacity only ever
+        # shrinks within a solve, so a pod with no fitting column now
+        # can never gain one — drop it so the loop converges early
+        fits = feasible & jnp.all(
+            (req[:, None, :] <= free_loc[None, :, :])
+            | (req[:, None, :] == 0), axis=-1)
+        active = active & (psum(jnp.any(fits, axis=1).astype(jnp.int32))
+                           > 0)
+
+        qmask = (jnp.ones(p, bool) if qstate is None
+                 else quota_admission_mask(qstate, req, pods.quota_id,
+                                           pods.non_preemptible))
+
+        def choose(prices_now):
+            key, tb = _priced_keys(base, fits, prices_now, rot,
+                                   pos, n_valid, n_total)
+            choice, has = _merge_best(*_local_best(key, tb, node_ids),
+                                      axis)
+            loc = choice - off
+            own = (loc >= 0) & (loc < n_loc)
+            return choice, has, jnp.clip(loc, 0, n_loc - 1), own
+
+        def ascent_body(_, prices_now):
+            choice, has, loc_c, own = choose(prices_now)
+            act = active & has & qmask
+            demand = seg_demand(loc_c, own & act)
+            over = jnp.clip(demand - free_loc, 0, _OVERLOAD_CLIP)
+            bump_r = (over * PRICE_GAIN + alloc_den - 1) // alloc_den
+            bump = jnp.max(bump_r, axis=-1)
+            bump = jnp.where(jnp.any(over > 0, axis=-1),
+                             jnp.maximum(bump, PRICE_MIN_STEP), 0)
+            return jnp.clip(prices_now + bump, 0, PRICE_CAP)
+
+        prices = jax.lax.fori_loop(0, ascent_iters, ascent_body, prices)
+
+        # -- masked rounding: fix the high-confidence (uncontended)
+        # rows; the last phase forces priority-prefix resolution so the
+        # iteration bound is hard
+        choice, has, loc_c, own = choose(prices)
+        act = active & has & qmask
+        demand = seg_demand(loc_c, own & act)
+        tot_choice = psum(jnp.where((own & act)[:, None],
+                                    demand[loc_c], 0))       # (P, R)
+        choice_free = psum(jnp.where((own & act)[:, None],
+                                     free_loc[loc_c], 0))
+        confident = ~jnp.any(tot_choice > choice_free, axis=-1)
+        last = (i + 1) >= rounding_iters
+        act_round = act & (confident | last)
+
+        # the SAME acceptance oracle as the greedy rounds: priority
+        # prefix fit against the owner-psum'd headroom, then the quota
+        # chain's prefix admission
+        round_free = psum(jnp.where((own & act_round)[:, None],
+                                    free_loc[loc_c], 0))
+        accept = ba._prefix_accept_choice(choice, req, round_free,
+                                          n_total, order, act_round)
+        if qstate is not None:
+            accept = accept & ba._quota_prefix_accept(
+                qstate, req, pods, order, act_round)
+
+        add = jnp.where((accept & own)[:, None], req, 0)
+        requested = requested.at[loc_c].add(add)
+        new_quota = qstate
+        if new_quota is not None:
+            new_quota = charge_quota_batch(
+                new_quota, req, pods.quota_id, accept,
+                pods.non_preemptible)
+        return (i + 1, prices,
+                requested,
+                jnp.where(accept, choice, assignments),
+                active & ~accept,
+                new_quota)
+
+    def cond(carry):
+        i, _, _, _, active, _ = carry
+        return (i < rounding_iters) & jnp.any(active)
+
+    active0 = pods.valid & (psum(jnp.any(feasible, axis=1)
+                                 .astype(jnp.int32)) > 0)
+    carry = (jnp.int32(0),
+             jnp.zeros(n_loc, jnp.int32),
+             st_local.node_requested,
+             jnp.full(p, -1, jnp.int32),
+             active0,
+             quota)
+    iters, _, requested, assignments, _, new_quota = jax.lax.while_loop(
+        cond, outer_body, carry)
+    return assignments, requested, new_quota, iters
+
+
+def lp_pack_assign(state, pods, cfg, quota=None, *,
+                   ascent_iters: int = ASCENT_ITERS,
+                   rounding_iters: int = ROUNDING_ITERS):
+    """High-quality batch assignment by LP-relaxation packing.
+
+    Same contract as ``ops/batch_assign.batch_assign`` — returns
+    (assignments, new_state, new_quota) plus the rounding-iteration
+    count actually executed (the ``quality_iterations`` observable).
+    ``assignments`` is (P,) int32 with -1 for unplaced pods; node and
+    quota accounting are charged through the greedy path's own kernels,
+    so feasibility is exact by construction.
+    """
+    a, requested, new_quota, iters = _lp_core(
+        state, pods, quota, cfg, n_total=state.capacity,
+        ascent_iters=ascent_iters, rounding_iters=rounding_iters,
+        axis=None)
+    return a, state.replace(node_requested=requested), new_quota, iters

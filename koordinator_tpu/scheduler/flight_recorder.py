@@ -63,6 +63,12 @@ class RoundRecord:
     #: attribute a slow half to a tenant
     tenant: str = ""
     half: str = "round"
+    #: solve-quality mode of the scheduler (ISSUE 13): off | lp | auto —
+    #: and, when the round solved on the LP path, the rounding-iteration
+    #: count it used (0 on greedy rounds), so a slow quality round's
+    #: dump answers "how many LP phases did that cost" in place
+    quality_mode: str = "off"
+    quality_iterations: int = 0
     dump_reason: Optional[str] = None   # slow | degraded when dumped
 
     def to_doc(self) -> dict:

@@ -147,6 +147,10 @@ class RoundHandle:
     new_quota: object = None
     #: incremental-path finish context (None = full/greedy path)
     inc: dict | None = None
+    #: quality-path finish context (ISSUE 13): the LP solve's in-flight
+    #: iteration count and pre-solve slack sums (None = not a quality
+    #: round)
+    quality: dict | None = None
     start_wall: float = 0.0
     t0: float = 0.0
 
@@ -187,6 +191,8 @@ class Scheduler:
         shard_min_nodes: int = 1024,
         tenant: str = "",
         solver_kit=None,
+        quality_mode: str = "off",
+        quality_slack_threshold: float = 0.3,
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -299,6 +305,32 @@ class Scheduler:
         self._refresh_cands_sh = self.kit.refresh_cands_sh
         self._pass1_sh = self.kit.pass1_sh
         self._pass2_sh = self.kit.pass2_sh
+
+        # -- solve-quality mode (ISSUE 13) --
+        #: "off" = today's greedy path exactly; "lp" = every eligible
+        #: round solves with the LP-relaxation packing engine
+        #: (quality/lp_pack); "auto" = escalate only rounds whose
+        #: preceding result leaves capacity_slack_fraction above the
+        #: threshold (free capacity is the win-back opportunity
+        #: constraint-based packing exists for)
+        from koordinator_tpu.quality import QUALITY_MODES
+
+        if quality_mode not in QUALITY_MODES:
+            raise ValueError(f"unknown quality_mode {quality_mode!r}; "
+                             f"one of {QUALITY_MODES}")
+        self.quality_mode = quality_mode
+        self.quality_slack_threshold = quality_slack_threshold
+        self._quality_solve = self.kit.quality_solve
+        self._quality_solve_sh = self.kit.quality_solve_sh
+        #: auto-mode escalation latch, recomputed from every round's
+        #: resulting per-dim slack (MIN over provisioned dims vs the
+        #: threshold: every dimension must have headroom worth winning
+        #: back — see _quality_round_finish)
+        self._quality_escalate = False
+        self._last_quality_iters = 0
+        metrics.solver_quality_mode.set(
+            float(QUALITY_MODES.index(quality_mode)),
+            labels=self._tl())
         #: per-round admission cap (tenancy weighted-fair admission sets
         #: it per cycle; None = admit the whole active queue).  Applied
         #: in priority order AFTER the PreEnqueue gates, so a capped
@@ -1164,7 +1196,18 @@ class Scheduler:
             mask = (gang_ids == gi) & valid
             if not mask.any():
                 continue
-            plan = plan_gang_placement(
+            # quality mode swaps in the rank-aware, topology-distance
+            # planner (quality/topo_gang): same feasibility kernels,
+            # minimal-diameter commit rule
+            if self.quality_mode != "off":
+                from koordinator_tpu.quality.topo_gang import (
+                    plan_gang_placement_quality,
+                )
+
+                plan_fn = plan_gang_placement_quality
+            else:
+                plan_fn = plan_gang_placement
+            plan = plan_fn(
                 self.snapshot.state, batch, mask, self.topology_tree,
                 gang.topology, cfg=self.config,
             )
@@ -1237,6 +1280,8 @@ class Scheduler:
             top_unschedulable=dict(self._last_unschedulable_top),
             tenant=self.tenant,
             half=half,
+            quality_mode=self.quality_mode,
+            quality_iterations=self._last_quality_iters,
         ))
 
     def schedule_round(self) -> SchedulingResult:
@@ -1549,16 +1594,56 @@ class Scheduler:
             solver = ("batch" if len(pods) >= self.batch_solver_threshold
                       else "greedy")
             self.last_solver = solver
+            # quality path (ISSUE 13): an escalated gangless round
+            # solves with the LP-relaxation packing engine instead of
+            # the greedy propose/accept rounds.  Gang rounds keep the
+            # gang_assign path (all-or-nothing semantics live there;
+            # quality mode reaches them through the topology planner
+            # in _apply_topology_plans instead).
+            use_quality = (
+                not gang_index
+                and (self.quality_mode == "lp"
+                     or (self.quality_mode == "auto"
+                         and self._quality_escalate)))
             # incremental fast path: a gangless batch round re-scores only
             # the delta against the persistent candidate cache; gang
             # rounds, hinted (dense-mask) rounds, the exact greedy
             # solver — and DEGRADED rounds, whose cache was built from
             # a stalled feed — keep the one-call full path
-            use_inc = (solver == "batch" and self.incremental_solve
+            use_inc = (not use_quality
+                       and solver == "batch" and self.incremental_solve
                        and not self.degraded
                        and not gang_index
                        and batch.selector_mask is not None)
-            if use_inc:
+            if use_quality:
+                solver = "batch"   # the host half's rescue/commit path
+                self.last_solver = solver
+                self.last_solve_path = "quality_lp"
+                metrics.incremental_solve_total.inc(
+                    labels={"path": "quality_lp"})
+                use_mesh = (self.mesh is not None
+                            and self.snapshot.solver_sharding_active
+                            and self._quality_solve_sh is not None)
+                qfn = (self._quality_solve_sh if use_mesh
+                       else self._quality_solve)
+                # pre-solve slack (async device sums, blocked on in the
+                # host half): the quality_slack_recovered baseline.
+                # Dispatched BEFORE the donating solve consumes the
+                # state buffers.
+                slack_before = self._slack_sums(self.snapshot.state)
+                assignments, new_state, new_quota, qiters = qfn(
+                    self.snapshot.state, batch, self.config, quota)
+                # the blessed swap (see the full-path branch below)
+                self.snapshot.state = new_state
+                # the LP solve re-packed everything: the candidate
+                # cache's top-k is stale against the new accounting
+                self._cand_cache = None
+                handle.assignments = assignments
+                handle.new_state = new_state
+                handle.new_quota = new_quota
+                handle.quality = {"iters": qiters,
+                                  "slack_before": slack_before}
+            elif use_inc:
                 handle.inc = self._dispatch_batch_incremental(
                     pods, batch, quota)
                 handle.assignments = handle.inc["a"]
@@ -1839,9 +1924,73 @@ class Scheduler:
                         self.auditor.record(pod.gang or pod.name,
                                             "ScheduleFailed", diag.message())
 
+        # every round in an ON mode runs the finish hook: "lp" gang
+        # rounds must reset _last_quality_iters to 0 or their flight
+        # records would carry the previous LP round's iteration count
+        if handle.quality is not None or self.quality_mode != "off":
+            self._quality_round_finish(handle, result)
+
         metrics.pending_pods.set(float(len(self.pending)),
                                  labels=self._tl())  # post-bind queue
         return result
+
+    # -- solve-quality mode (ISSUE 13) --------------------------------------
+
+    def arm_quality_escalation(self) -> None:
+        """Arm the auto-mode escalation latch by hand — a warmup aid.
+
+        A harness (tools/loadgen) can force its warm round onto the LP
+        path so the quality program's one-time jit compile lands BEFORE
+        any latency-SLO or trend window opens; without this, auto mode
+        pays the compile on the first round that escalates mid-run.
+        No-op when ``quality_mode == "off"``; the latch re-evaluates
+        from real slack at the end of every round, so arming never
+        sticks past the next round.
+        """
+        if self.quality_mode != "off":
+            with self.lock:
+                self._quality_escalate = True
+
+    def _quality_round_finish(self, handle: RoundHandle, result) -> None:  # koordlint: guarded-by(self.lock)
+        """Quality-round accounting + the auto-mode escalation latch.
+
+        Runs at the END of the host half so the slack sums see the
+        round's final accounting (rescue pass included) and the outcome
+        label sees the diagnosed failures.  One cheap jitted (R,)
+        reduction per round — the same kernel the explain rollup uses.
+        """
+        from koordinator_tpu.api.resources import ResourceDim
+
+        free_sum, alloc_sum = self._slack_sums(self.snapshot.state)
+        free_sum = np.asarray(free_sum)
+        alloc_sum = np.asarray(alloc_sum)
+        # min over provisioned dims: escalation means EVERY dimension
+        # still has headroom worth winning back (a cluster out of CPU
+        # but swimming in memory has nothing a better packing recovers)
+        slack_min = min(
+            (float(free_sum[d]) / float(alloc_sum[d])
+             for d in ResourceDim if float(alloc_sum[d]) > 0),
+            default=0.0)
+        self._quality_escalate = slack_min > self.quality_slack_threshold
+        if handle.quality is None:
+            self._last_quality_iters = 0
+            return
+        iters = int(np.asarray(self._block_timed(
+            handle.quality["iters"])))
+        self._last_quality_iters = iters
+        metrics.quality_iterations.observe(float(iters),
+                                           labels=self._tl())
+        free_b, alloc_b = (np.asarray(x)
+                           for x in handle.quality["slack_before"])
+        for dim in ResourceDim:
+            total = float(alloc_b[dim])
+            recovered = ((float(free_b[dim]) - float(free_sum[dim]))
+                         / total if total > 0 else 0.0)
+            metrics.quality_slack_recovered.set(
+                max(recovered, 0.0), labels={"dim": dim.name.lower()})
+        outcome = "partial" if result.failures else "complete"
+        metrics.quality_rounds.inc(
+            labels={"mode": self.quality_mode, "outcome": outcome})
 
     # -- incremental delta-driven solve -------------------------------------
 

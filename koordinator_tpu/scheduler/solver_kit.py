@@ -49,6 +49,8 @@ class SolverKit:
         from koordinator_tpu.ops.reservation import reservation_greedy_assign
         from koordinator_tpu.parallel import mesh as pmesh
         from koordinator_tpu.parallel import sharded as psharded
+        from koordinator_tpu.quality.lp_pack import lp_pack_assign
+        from koordinator_tpu.quality.topo_gang import gang_topo_diameter
 
         # -- sharded-by-default solve mesh (ISSUE 10) --
         # the node axis of the batch solve shards over every visible
@@ -172,6 +174,33 @@ class SolverKit:
                 shape_of=lambda a, k: (
                     f"P{a[2].capacity}"
                     f"xN{a[0].capacity}{_sfx(a[0].capacity)}"))
+
+        # -- quality mode (ISSUE 13): the LP-relaxation packing solve,
+        # the second solver backend behind the kit.  Same donation
+        # contract as the greedy entries: arg0 (the snapshot state) is
+        # consumed and must be replaced by the blessed swap.
+        # koordlint: shape[arg0: NxR i32 nodes]
+        self.quality_solve = insp.instrument(
+            jax.jit(lp_pack_assign,
+                    static_argnames=("ascent_iters", "rounding_iters"),
+                    donate_argnums=(0,)),
+            "lp_pack_assign", shape_of=_pn)
+        self.quality_solve_sh = None
+        if self.mesh is not None:
+            from functools import partial as _qpartial
+
+            # koordlint: shape[arg0: NxR i32 nodes]
+            self.quality_solve_sh = insp.instrument(
+                jax.jit(_qpartial(psharded.sharded_lp_pack_assign,
+                                  self.mesh),
+                        static_argnames=("ascent_iters",
+                                         "rounding_iters"),
+                        donate_argnums=(0,)),
+                "lp_pack_assign", shape_of=_pn)
+        #: topology diameter of a placed slot set (quality/topo_gang) —
+        #: the rank-aware gang observable bench_recall and the quality
+        #: planner report
+        self.topo_diameter = jax.jit(gang_topo_diameter)
 
         self.rsv_solve = insp.instrument(
             jax.jit(reservation_greedy_assign, donate_argnums=(0,)),

@@ -486,6 +486,12 @@ class TenantScheduler:
                     # batched program bypasses — a fault-injected tenant
                     # must keep the per-tenant dispatch path
                     or sched.faults is not None
+                    # a quality-mode tenant's rounds may escalate to the
+                    # LP packing engine, which the select+pass1 batched
+                    # program cannot express — its cycle stays on the
+                    # pipelined per-tenant dispatch (bit-identical to
+                    # standalone execution; tests/test_quality.py)
+                    or sched.quality_mode != "off"
                     or (sched.mesh is not None
                         and sched.snapshot.solver_sharding_active)):
                 return False

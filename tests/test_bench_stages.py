@@ -24,6 +24,7 @@ def test_stage_profiler_smoke():
     assert stages == {"provenance", "rtt_floor", "score", "select_approx",
                       "select_chunked", "rounds",
                       "refresh_incremental_1pct",
+                      "lp_pack_smoke", "topo_gang_rank",
                       "score_sharded", "rounds_sharded", "merge_topk",
                       "explain_compact_1pct", "explain_full_batch",
                       "tenancy_serial", "tenancy_pipelined",
@@ -31,11 +32,15 @@ def test_stage_profiler_smoke():
     by_stage = {r["stage"]: r for r in records}
     # every timed stage produced a positive per-iteration time
     for name in ("score", "select_approx", "select_chunked", "rounds",
-                 "refresh_incremental_1pct", "score_sharded",
+                 "refresh_incremental_1pct", "lp_pack_smoke",
+                 "topo_gang_rank", "score_sharded",
                  "rounds_sharded", "merge_topk", "explain_compact_1pct",
                  "explain_full_batch", "tenancy_serial",
                  "tenancy_pipelined", "tenancy_batched"):
         assert by_stage[name]["ms_per_iter"] > 0, by_stage[name]
+    # the quality stage reports its cost relative to the greedy rounds
+    # it replaces on escalated rounds
+    assert by_stage["lp_pack_smoke"]["vs_rounds_x"] > 0
     # the multi-tenant stage reports the acceptance observables: the
     # aggregate-rate ratio vs the serial baseline and the device-idle
     # fraction before/after pipelining (ISSUE 11)

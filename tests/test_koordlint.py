@@ -255,15 +255,25 @@ class TestDonationFlowCorpus:
         # state dead, round()'s commit() call reads it two hops later
         assert "left dead" in messages
         assert "commit" in messages
-        # the stash-the-donated-buffer tenancy anti-idiom
+        # the stash-the-donated-buffer tenancy anti-idiom — seeded in
+        # pipeline.py AND in the quality rounding loop's pre-re-solve
+        # stash (quality_rounding.py, ISSUE 13)
         assert "stash" in messages
-        # a store through a REBOUND alias must not count as the swap
-        assert messages.count("read after its buffers were donated") == 1
-        assert len(findings) == 3
+        # direct dead reads: the rebound-alias non-swap (pipeline.py),
+        # the rounding loop's missing SECOND swap after the residual
+        # re-solve, and the residual re-solve's donated ASSIGNMENT
+        # buffer read back afterwards (quality_rounding.py)
+        assert messages.count("read after its buffers were donated") == 3
+        assert "self.last_assignments" in messages
+        by_file = {f.path for f in findings}
+        assert by_file == {"pkg/pipeline.py", "pkg/quality_rounding.py"}
+        assert len(findings) == 6
 
     def test_good_corpus_is_clean(self):
         # blessed swap, metadata reads, swap-through-method (the
-        # adopt_state idiom), and the rebind idiom all pass
+        # adopt_state idiom), the rebind idiom, and the quality
+        # rounding loop's swap-between-passes / merge-before-donating
+        # twins all pass
         assert self.analyzer().run(
             corpus("donation_flow", "good", ("pkg",))) == []
 

@@ -1,0 +1,66 @@
+"""Seeded donation-flow violations: the quality rounding loop's
+residual re-solve, done wrong (must-flag corpus, ISSUE 13).
+
+The LP quality round is a two-dispatch pattern: the packing solve
+donates the snapshot state, the blessed swap re-points it, and the
+residual (rescue) re-solve donates it AGAIN — plus the first pass's
+assignment buffer.  Three ways to read a consumed buffer doing this:
+the re-solve against a never-swapped state, a pre-re-solve stash of
+the state, and a residual re-solve that donates the pass-1 assignment
+buffer and then reads it.
+"""
+
+import jax
+
+
+def _lp_impl(state, batch):
+    return batch, state
+
+
+def _rescue_impl(state, assignments, batch):
+    return assignments, state
+
+
+class QualityKit:
+    def __init__(self):
+        self.lp_pack = jax.jit(_lp_impl, donate_argnums=(0,))
+        self.rescue = jax.jit(_rescue_impl, donate_argnums=(0, 1))
+
+
+class QualityRounds:
+    def __init__(self, snapshot):
+        self.kit = QualityKit()
+        self.solve = self.kit.lp_pack
+        self.rescue = self.kit.rescue
+        self.snapshot = snapshot
+        self.last_assignments = None
+
+    def residual_without_swap(self, batch):
+        # BAD: the merge after the residual re-solve reads the state
+        # the re-solve consumed — the SECOND blessed swap is missing
+        a, new_state = self.solve(self.snapshot.state, batch)
+        self.snapshot.state = new_state
+        r, newer = self.rescue(self.snapshot.state, a, batch)
+        return self.snapshot.state.sum(), r
+
+    def stash_across_residual(self, batch):
+        # BAD: the pre-re-solve stash keeps pointing at the buffer the
+        # residual re-solve consumed, even though the swap happened
+        a, new_state = self.solve(self.snapshot.state, batch)
+        self.snapshot.state = new_state
+        stash = self.snapshot.state
+        r, newer = self.rescue(self.snapshot.state, a, batch)
+        self.snapshot.state = newer
+        return stash.sum(), r
+
+    def residual_reads_donated_assignments(self, batch):
+        # BAD: the residual re-solve donates the pass-1 assignment
+        # buffer (rescue's arg 1); merging from it afterwards reads a
+        # consumed buffer
+        a, new_state = self.solve(self.snapshot.state, batch)
+        self.snapshot.state = new_state
+        self.last_assignments = a
+        r, newer = self.rescue(self.snapshot.state,
+                               self.last_assignments, batch)
+        self.snapshot.state = newer
+        return self.last_assignments.sum(), r

@@ -1,0 +1,53 @@
+"""Donation-disciplined twins of the quality rounding-loop corpus
+(must-pass, ISSUE 13): swap between the passes, merge BEFORE donating
+the assignment buffer, rebind the residual's output."""
+
+import jax
+
+
+def _lp_impl(state, batch):
+    return batch, state
+
+
+def _rescue_impl(state, assignments, batch):
+    return assignments, state
+
+
+class QualityKit:
+    def __init__(self):
+        self.lp_pack = jax.jit(_lp_impl, donate_argnums=(0,))
+        self.rescue = jax.jit(_rescue_impl, donate_argnums=(0, 1))
+
+
+class QualityRounds:
+    def __init__(self, snapshot):
+        self.kit = QualityKit()
+        self.solve = self.kit.lp_pack
+        self.rescue = self.kit.rescue
+        self.snapshot = snapshot
+        self.last_assignments = None
+
+    def residual_with_swaps(self, batch):
+        # the blessed swap lands between the two donating dispatches,
+        # and the pass-1 assignments are REBOUND to the residual's
+        # merged output (the x = f(x) idiom) — nothing reads a consumed
+        # buffer
+        a, new_state = self.solve(self.snapshot.state, batch)
+        self.snapshot.state = new_state
+        a, newer = self.rescue(self.snapshot.state, a, batch)
+        self.snapshot.state = newer
+        return a
+
+    def merge_before_donating(self, batch):
+        # reads of the assignment buffer all happen BEFORE the residual
+        # re-solve consumes it; the stored path is re-pointed at the
+        # merged result before any later read
+        a, new_state = self.solve(self.snapshot.state, batch)
+        self.snapshot.state = new_state
+        placed = a.sum()
+        self.last_assignments = a
+        merged, newer = self.rescue(self.snapshot.state,
+                                    self.last_assignments, batch)
+        self.snapshot.state = newer
+        self.last_assignments = merged
+        return placed, self.last_assignments

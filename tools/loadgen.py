@@ -329,7 +329,9 @@ class SteadyStateHarness:
                  slo_latency_threshold_s: float = 0.2,
                  warmup_fraction: float = 0.3,
                  inject_thread_leak: bool = False,
-                 inject_queue_leak: bool = False):
+                 inject_queue_leak: bool = False,
+                 quality_mode: str = "off",
+                 quality_slack_threshold: float = 0.3):
         self.cfg = cfg
         self.workdir = workdir
         self.time_scale = time_scale
@@ -350,6 +352,10 @@ class SteadyStateHarness:
         self.steady_started_at: float | None = None
         self.inject_thread_leak = inject_thread_leak
         self.inject_queue_leak = inject_queue_leak
+        #: solve-quality mode threaded into every scheduler the harness
+        #: assembles (SOAK_QUALITY soaks run with "auto")
+        self.quality_mode = quality_mode
+        self.quality_slack_threshold = quality_slack_threshold
         self._leak_release = threading.Event()
         self._leaked_threads: list[threading.Thread] = []
         self._closers: list = []
@@ -517,14 +523,18 @@ class SteadyStateHarness:
                     TenantSpec(name=name, weight=cfg.tenant_weight(i),
                                node_capacity=capacity),
                     quota_tree=self._build_quota_tree(name),
-                    staleness_threshold_sec=staleness)
+                    staleness_threshold_sec=staleness,
+                    quality_mode=self.quality_mode,
+                    quality_slack_threshold=self.quality_slack_threshold)
                 self._start_cluster(name, tenant.scheduler, i)
             self.scheduler = self.front.primary
         else:
             quota_tree = self._build_quota_tree(names[0])
             self.scheduler = Scheduler(
                 ClusterSnapshot(capacity=capacity), quota_tree=quota_tree,
-                staleness_threshold_sec=staleness)
+                staleness_threshold_sec=staleness,
+                quality_mode=self.quality_mode,
+                quality_slack_threshold=self.quality_slack_threshold)
             solve_target = self.scheduler
             self._start_cluster(names[0], self.scheduler, 0)
         sock0 = f"{self.workdir}/loadgen-{names[0]}.sock"
@@ -568,7 +578,15 @@ class SteadyStateHarness:
 
         # -- warm the solve path before the trend window opens (jit
         # compilation is one-time cost, not a trend): one warm pod per
-        # tenant, one cycle, removal
+        # tenant, one cycle, removal.  In quality mode the warm round
+        # is forced onto the LP path too — auto's latch would otherwise
+        # leave the quality program to compile mid-run, where its
+        # (much larger) one-time cost reads as a latency breach and an
+        # RSS step to the trend engine
+        if self.quality_mode != "off":
+            for sched in (self._tenant_sched.values()
+                          if self._tenant_sched else [self.scheduler]):
+                sched.arm_quality_escalation()
         for name in names:
             self._feeders[name].call(
                 FrameType.STATE_PUSH,

@@ -56,6 +56,15 @@
 #                                     plus a 4-tenant multi-cluster
 #                                     smoke whose per-tenant verdict
 #                                     section must come back green
+#         SOAK_QUALITY (default 0)    1 = end the run with the solve-
+#                                     quality smoke: one loadgen soak
+#                                     with --quality-mode auto (the
+#                                     LP-relaxation packing engine
+#                                     escalating on capacity slack);
+#                                     the verdict must stay GREEN and
+#                                     quality_rounds_total must be
+#                                     nonzero — both enforced by
+#                                     soak_report's exit status
 #         SOAK_CHAOS   (default 0)    1 = also sweep the chaos
 #                                     fault-injection suite (tests/
 #                                     test_chaos.py, `chaos` marker)
@@ -75,6 +84,7 @@ STRIDE=${SOAK_STRIDE:-1000}
 OUT=${SOAK_OUT:-soak_results}
 CHAOS=${SOAK_CHAOS:-0}
 LOADGEN=${SOAK_LOADGEN:-0}
+QUALITY=${SOAK_QUALITY:-0}
 TRACE=${SOAK_TRACE:-0}
 SLO=${SOAK_SLO:-1}
 EXPLAIN=${SOAK_EXPLAIN:-1}
@@ -238,6 +248,24 @@ if [ "$LOADGEN" = "1" ]; then
         total_failed=$((total_failed + 1))
         failures="$failures;multi-tenant smoke: red verdict or harness"
         failures="$failures failure (see log)"
+    fi
+fi
+
+if [ "$QUALITY" = "1" ]; then
+    # solve-quality smoke BEFORE the tally so its verdict counts in the
+    # JSON: a churn soak with --quality-mode auto must come back GREEN
+    # AND must have escalated at least one round onto the LP packing
+    # path (soak_report exits nonzero on quality_rounds_total == 0)
+    echo "== solve-quality smoke (soak_report --quality-mode auto)" \
+        | tee -a "$log"
+    if python tools/soak_report.py --quality-mode auto >> "$log" 2>&1; then
+        grep -E "^(-- quality|VERDICT)" "$log" | tail -2
+        total_passed=$((total_passed + 1))
+    else
+        tail -12 "$log"
+        total_failed=$((total_failed + 1))
+        failures="$failures;quality smoke: red verdict or zero quality"
+        failures="$failures rounds (see log)"
     fi
 fi
 

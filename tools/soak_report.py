@@ -147,6 +147,19 @@ def main(argv: list[str] | None = None) -> int:
                         help="latency SLO threshold for the run "
                              "(CPU smoke rounds pay jit compilation; "
                              "the paper's bar is 0.2)")
+    parser.add_argument("--quality-mode", choices=("off", "lp", "auto"),
+                        default="off",
+                        help="solve-quality mode for the soaked "
+                             "scheduler(s); with a mode other than off "
+                             "the report FAILS unless at least one "
+                             "round actually solved on the quality "
+                             "path (quality_rounds_total > 0) — a "
+                             "quality soak that never exercised the "
+                             "quality engine proves nothing")
+    parser.add_argument("--quality-slack-threshold", type=float,
+                        default=0.3,
+                        help="auto-mode escalation bar (see the "
+                             "scheduler's --quality-slack-threshold)")
     parser.add_argument("--json", action="store_true",
                         help="dump the raw verdict document too")
     args = parser.parse_args(argv)
@@ -174,7 +187,9 @@ def main(argv: list[str] | None = None) -> int:
             cfg, workdir, time_scale=args.time_scale,
             slo_latency_threshold_s=args.slo_latency,
             inject_thread_leak=(args.inject_leak == "thread"),
-            inject_queue_leak=(args.inject_leak == "queue"))
+            inject_queue_leak=(args.inject_leak == "queue"),
+            quality_mode=args.quality_mode,
+            quality_slack_threshold=args.quality_slack_threshold)
         harness.start()
         try:
             verdict = harness.run(events)
@@ -183,6 +198,16 @@ def main(argv: list[str] | None = None) -> int:
                 print(json.dumps(verdict, indent=2, default=str))
         finally:
             harness.close()
+    if args.quality_mode != "off":
+        from koordinator_tpu import metrics as _m
+
+        quality_rounds = sum(v for _, v in _m.quality_rounds.items())
+        print(f"-- quality: mode={args.quality_mode} "
+              f"rounds={quality_rounds:g}")
+        if quality_rounds <= 0:
+            print("ERROR: quality soak ran zero quality rounds "
+                  "(quality_rounds_total == 0)", file=sys.stderr)
+            return 1
     if args.inject_leak:
         if verdict["trend"]["leaking"]:
             print(f"injected {args.inject_leak} leak CAUGHT: "
