@@ -532,10 +532,11 @@ def _emit_zero_record(extra: dict,
     still leave machine-readable evidence of the solver's quality at
     the north-star shape (VERDICT r3 item 5) instead of only a zero."""
     extra.setdefault("provenance", _git_head())
-    # n_devices is unknowable here without touching the (possibly hung)
-    # backend — null marks "no device evidence", vs a real count on
-    # nonzero records
+    # n_devices / the mesh split are unknowable here without touching
+    # the (possibly hung) backend — null marks "no device evidence",
+    # vs a real count + PxN shape on nonzero records
     extra.setdefault("n_devices", None)
+    extra.setdefault("mesh_axes", None)
     if device_down is None:
         # caller hit an error that MIGHT be the tunnel dying mid-run —
         # a fresh probe decides (60s: enough for a healthy tunnel)
@@ -640,10 +641,12 @@ def _publish_staged_main() -> int:
     stages = _latest_probe_stages(root)
     if stages is not None:
         doc["staged"] = stages
-        # surface the capture's device count at the top level so the
-        # perf trajectory distinguishes single-chip from sharded runs
-        # without digging into the stage records
+        # surface the capture's device count AND mesh split at the top
+        # level so the perf trajectory distinguishes single-chip from
+        # sharded (and 1x8 from 2x4) runs without digging into the
+        # stage records
         doc["n_devices"] = stages.get("n_devices")
+        doc["mesh_axes"] = stages.get("mesh_axes")
     notes: list = []
     captured = _latest_probe_capture(root, notes=notes)
     if captured is not None:
@@ -915,12 +918,17 @@ def main() -> None:
     # not an improvement
     assigned_frac = solve_count / float(pods.valid.sum())
 
+    from koordinator_tpu.parallel import mesh as _pmesh
+
     extra = {
         "provenance": _git_head(),
         # the perf trajectory must distinguish single-chip from sharded
         # captures (ISSUE 10): a device count next to every nonzero
-        # record, stamped while the backend is provably alive
+        # record, stamped while the backend is provably alive — plus
+        # the FULL 2-D axis split it would solve on (ISSUE 14; None =
+        # single-device, no mesh)
         "n_devices": len(jax.devices()),
+        "mesh_axes": _pmesh.mesh_axes(_pmesh.resolve_solver_mesh("auto")),
         f"filter_score_pods_per_sec_{N_PODS}p_{N_NODES}n": round(
             score_pods_per_sec, 1
         ),

@@ -79,13 +79,19 @@ def main() -> None:
     # without the device count and axis sizes it was measured on.
     from koordinator_tpu.parallel import mesh as pmesh
 
-    mesh = pmesh.solver_mesh()
+    # honor the 2-D env overrides (KOORD_SOLVER_MESH=PxN /
+    # KOORD_SOLVER_MESH_PODS) so a staged capture measures the same
+    # axis split the scheduler would solve on; fall back to the 1-way
+    # all-nodes mesh on a single device (resolve returns None there)
+    mesh = pmesh.resolve_solver_mesh("auto") or pmesh.solver_mesh()
     n_shards = pmesh.nodes_shard_count(mesh)
+    p_shards = pmesh.pods_shard_count(mesh)
     print(json.dumps({
         "stage": "provenance", **_git_head(),
         "n_devices": len(jax.devices()),
-        "mesh_axes": {"pods": int(mesh.shape[pmesh.PODS_AXIS]),
-                      "nodes": n_shards},
+        "mesh_axes": pmesh.mesh_axes(mesh),
+        "mesh_axis_names": list(mesh.axis_names),
+        "mesh_shape": f"{p_shards}x{n_shards}",
     }), flush=True)
 
     def rtt_fn(st, p):
@@ -284,7 +290,7 @@ def main() -> None:
     from koordinator_tpu.ops import batch_assign as _ba_mod
     from koordinator_tpu.parallel import sharded as psh
 
-    if n_nodes % n_shards == 0:
+    if n_nodes % n_shards == 0 and pods.capacity % p_shards == 0:
         def score_sharded_loop(st0, p):
             def body(i, carry):
                 acc, usage = carry
@@ -319,14 +325,19 @@ def main() -> None:
                 # (KOORD_STAGES_COLLECTIVES=1): the wall-clock stage is
                 # the scarce evidence at the big capture, and the CI
                 # smoke must stay cheap
-                coll = (insp.compiled_collectives(jax.jit(fn), *args)
-                        if os.environ.get("KOORD_STAGES_COLLECTIVES")
-                        else None)
+                hlo = (jax.jit(fn).lower(*args).compile().as_text()
+                       if os.environ.get("KOORD_STAGES_COLLECTIVES")
+                       else None)
                 sec, _ = _time_chained(fn, args, rtt, iters)
                 stage_secs[label] = sec
-                extra = {"n_devices": n_shards}
-                if coll is not None:
-                    extra["collectives"] = coll
+                extra = {"n_devices": n_shards,
+                         "mesh_axes": pmesh.mesh_axes(mesh)}
+                if hlo is not None:
+                    extra["collectives"] = insp.collective_counts(hlo)
+                    # per-axis split of the communication profile
+                    # (ISSUE 14): which mesh axis the ICI time rides
+                    extra["collectives_by_axis"] = (
+                        insp.collective_axis_counts(hlo, mesh))
                 _emit(label, sec, extra)
             except Exception as e:
                 print(json.dumps({"stage": label,
@@ -374,6 +385,108 @@ def main() -> None:
             "stage": "score_sharded",
             "error": (f"n_nodes {n_nodes} not divisible by "
                       f"{n_shards}-way mesh")}), flush=True)
+
+    # -- 2-D pods x nodes stages (ISSUE 14): the SAME kernels on a
+    # pods-split mesh vs the all-nodes mesh over the same devices, at
+    # this run's pod-heavy shape (50k pods x 10,240 nodes at the real
+    # capture).  Two acceptance observables land in the record:
+    # per-device candidate-tensor bytes scaling ~1/pods_axis, and the
+    # 2xD/2-vs-1xD aggregate-throughput ratio for the score and rounds
+    # stages.  (On virtual CPU devices the devices share one socket, so
+    # the throughput ratio reflects per-device WORK — the top-k row
+    # count and merge width the pods split removes — not ICI.)
+    devs = jax.devices()
+    half = len(devs) // 2
+    if (len(devs) >= 2 and len(devs) % 2 == 0
+            and n_nodes % max(half, 1) == 0
+            and pods.capacity % 2 == 0):
+        mesh_1d = pmesh.solver_mesh(devs)              # 1 x D
+        mesh_2d = pmesh.solver_mesh(devs, pods_axis=2)  # 2 x D/2
+
+        def sharded_loops(m):
+            def score_loop2(st0, p):
+                def body(i, carry):
+                    acc, usage = carry
+                    key, node = psh.sharded_select_candidates(
+                        m, st0.replace(node_usage=usage), p, cfg, k=K,
+                        spread_bits=SPREAD)
+                    return (acc + key.sum() + node.sum(),
+                            usage + (node.sum() & 1))
+                acc, _ = jax.lax.fori_loop(0, iters, body,
+                                           (jnp.int32(0), st0.node_usage))
+                return acc
+
+            def rounds_loop2(st0, p, ckey, cnode):
+                def body(i, carry):
+                    acc, usage = carry
+                    assignments, new_state, _ = psh.sharded_assign_rounds(
+                        m, st0.replace(node_usage=usage), p, None, ckey,
+                        cnode, rounds=12)
+                    return (acc + (assignments >= 0).sum()
+                            .astype(jnp.int32),
+                            usage + (new_state.node_requested & 1))
+                acc, _ = jax.lax.fori_loop(0, iters, body,
+                                           (jnp.int32(0), st0.node_usage))
+                return acc
+
+            return score_loop2, rounds_loop2
+
+        base_secs: dict[str, float] = {}
+        for mlabel, m in (("1d", mesh_1d), ("2d", mesh_2d)):
+            score_fn, rounds_fn = sharded_loops(m)
+            axes = pmesh.mesh_axes(m)
+            shape_s = f"{axes['pods']}x{axes['nodes']}"
+            for kind, fn, args in (
+                ("score", score_fn, (state, pods)),
+                ("rounds", rounds_fn, (state, pods, cand_key, cand_node)),
+            ):
+                label = f"{kind}_sharded_{mlabel}"
+                try:
+                    sec, _ = _time_chained(fn, args, rtt, iters)
+                    extra = {"mesh_axes": axes, "mesh_shape": shape_s}
+                    if mlabel == "1d":
+                        base_secs[kind] = sec
+                    elif base_secs.get(kind):
+                        # aggregate throughput ratio: the acceptance
+                        # asks >= 1.5x for score/rounds at the
+                        # pod-heavy shape on real chips
+                        extra["speedup_vs_1d"] = round(
+                            base_secs[kind] / sec, 3)
+                    _emit(label, sec, extra)
+                except Exception as e:
+                    print(json.dumps({"stage": label,
+                                      "error": repr(e)[:200]}),
+                          flush=True)
+
+        # per-device footprint of the persistent (P, k) candidate
+        # tensors: replicated on the 1xD mesh (every device pays the
+        # full copy), pod-sharded on the 2xD/2 mesh (~1/pods_axis)
+        try:
+            cache = _ba_mod.CandidateCache(cand_key, cand_node,
+                                           cand_score)
+            per_dev = {}
+            for mlabel, m in (("1d", mesh_1d), ("2d", mesh_2d)):
+                placed = jax.device_put(cache, pmesh.pod_sharding(m))
+                jax.block_until_ready(jax.tree.leaves(placed))
+                by = insp.device_bytes_by_mesh_shard(placed, m)
+                per_dev[mlabel] = max(by.values())
+                del placed
+            print(json.dumps({
+                "stage": "sharded_2d_footprint",
+                "cand_bytes_per_device_1d": per_dev["1d"],
+                "cand_bytes_per_device_2d": per_dev["2d"],
+                # the acceptance observable: ~1/pods_axis at pods_axis=2
+                "ratio": round(per_dev["2d"] / max(per_dev["1d"], 1), 4),
+                "mesh_axes_2d": pmesh.mesh_axes(mesh_2d),
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({"stage": "sharded_2d_footprint",
+                              "error": repr(e)[:200]}), flush=True)
+    else:
+        print(json.dumps({
+            "stage": "score_sharded_2d",
+            "error": (f"{len(devs)} device(s) cannot split 2x"
+                      f"{max(half, 1)}")}), flush=True)
 
     # -- explain: device-side reject-reason accounting (ISSUE 6 overhead
     # guard).  The solve itself is UNCHANGED by explain — the scheduler

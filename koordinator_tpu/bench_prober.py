@@ -98,6 +98,7 @@ class ProbeArmer:
         self.attempts = 0
         self.successes = 0
         self.published = False
+        self.publish_outcome: str | None = None
         self.last_outcome: str | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -126,11 +127,17 @@ class ProbeArmer:
             metrics.bench_probe_window_open.set(1.0)
             if not self.published and self.publish_fn is not None:
                 # publish the FIRST capture the moment the window opens
-                # — not at the next bench round
+                # — not at the next bench round.  The publisher
+                # (bench.py --publish-staged) stamps the staged
+                # capture's full 2-D mesh provenance (n_devices +
+                # pods x nodes axis split, ISSUE 14) so the published
+                # artifact is attributable without the stage file.
                 self.published = True
                 try:
                     self.publish_fn()
+                    self.publish_outcome = "ok"
                 except Exception:  # noqa: BLE001 — counted, not fatal
+                    self.publish_outcome = "error"
                     logger.exception("probe publish_fn failed")
         elif hung:
             logger.warning("device probe hung (%s after %.0fs): %s",

@@ -569,6 +569,11 @@ solver_shard_count = SCHEDULER.gauge(
     "solver_shard_count",
     "Nodes-axis size of the active solver mesh (1 = single-device "
     "solve; parallel/sharded.py shard_map path engaged when > 1)")
+solver_axis_shard_count = SCHEDULER.gauge(
+    "solver_axis_shard_count",
+    "Per-axis size of the active 2-D solver mesh (label: "
+    "axis=pods|nodes; both 1 for a single-device solve) — the split "
+    "solver_shard_count can't express once the pods axis is > 1")
 solver_batch_padding_waste = SCHEDULER.gauge(
     "solver_batch_padding_waste",
     "Padding-waste fraction of the last PodBatch: (capacity - live "

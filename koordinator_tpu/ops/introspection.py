@@ -24,6 +24,7 @@ something else:
 from __future__ import annotations
 
 import math
+import re
 import tempfile
 import threading
 import time
@@ -142,6 +143,34 @@ def device_bytes_by_shard(tree) -> dict[int, int]:
     return out
 
 
+def device_bytes_by_mesh_shard(tree, mesh) -> dict[tuple[int, int], int]:
+    """Per-(pod_shard, node_shard) footprint of a pytree's arrays over a
+    2-D solver mesh: {(pi, ni): bytes}.
+
+    The same metadata-only walk as :func:`device_bytes_by_shard`, with
+    device ids mapped to their mesh coordinates so a lopsided tile —
+    the placement bug class of the 2-D layout — reads directly off the
+    (pods, nodes) grid instead of a flat device list.  Devices outside
+    the mesh (host-resident spill) land under ``(-1, -1)``."""
+    if tree is None or mesh is None:
+        return {}
+    from koordinator_tpu.parallel.mesh import NODES_AXIS, PODS_AXIS
+
+    import numpy as np
+
+    coord_of: dict[int, tuple[int, int]] = {}
+    grid = np.asarray(mesh.devices)
+    axes = list(mesh.axis_names)
+    pi_ax, ni_ax = axes.index(PODS_AXIS), axes.index(NODES_AXIS)
+    for idx, dev in np.ndenumerate(grid):
+        coord_of[int(dev.id)] = (int(idx[pi_ax]), int(idx[ni_ax]))
+    out: dict[tuple[int, int], int] = {}
+    for did, nbytes in device_bytes_by_shard(tree).items():
+        key = coord_of.get(int(did), (-1, -1))
+        out[key] = out.get(key, 0) + int(nbytes)
+    return out
+
+
 #: HLO collective op mnemonics counted by :func:`collective_counts`
 _COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
                    "collective-permute", "all-to-all")
@@ -169,6 +198,52 @@ def compiled_collectives(jitted, *args, **kwargs) -> dict[str, int]:
     the jit, so a subsequent real call does not recompile)."""
     compiled = jitted.lower(*args, **kwargs).compile()
     return collective_counts(compiled.as_text())
+
+
+_REPLICA_GROUP_RE = re.compile(r"replica_groups=\{\{([0-9, ]+)\}")
+
+
+def collective_axis_counts(compiled_text: str, mesh) -> dict[str, dict]:
+    """Collective-op counts PER MESH AXIS: {axis: {op: count}}.
+
+    Classifies each collective in the compiled HLO by its first replica
+    group's size against the 2-D mesh's axis sizes — a nodes-axis psum
+    groups ``dn`` devices, a pods-axis gather ``dp``, a whole-mesh
+    reduction ``dp*dn`` (reported as ``"global"``).  Sizes matching
+    neither (or an op with no parsable groups) land under ``"other"``;
+    when the two axes are the same size the split is ambiguous and both
+    axes' ops land under ``"pods_or_nodes"``.  A text-level heuristic —
+    the only stable surface without a compiler API — good enough to put
+    the communication profile of a sharded solve next to its wall time
+    in the bench record."""
+    if mesh is None:
+        return {}
+    from koordinator_tpu.parallel.mesh import (
+        nodes_shard_count,
+        pods_shard_count,
+    )
+
+    dp, dn = pods_shard_count(mesh), nodes_shard_count(mesh)
+    by_size = {dp * dn: "global"}
+    if dp == dn:
+        by_size[dn] = "pods_or_nodes"
+    else:
+        by_size.update({dn: "nodes", dp: "pods"})
+    out: dict[str, dict] = {}
+    for line in compiled_text.splitlines():
+        stripped = line.lstrip()
+        for op in _COLLECTIVE_OPS:
+            if not (f" {op}(" in stripped or f" {op}-start(" in stripped
+                    or stripped.startswith((f"{op}(", f"{op}-start("))):
+                continue
+            m = _REPLICA_GROUP_RE.search(stripped)
+            axis = "other"
+            if m is not None:
+                size = len([t for t in m.group(1).split(",") if t.strip()])
+                axis = by_size.get(size, "other")
+            out.setdefault(axis, {})
+            out[axis][op] = out[axis].get(op, 0) + 1
+    return out
 
 
 class ProfileDisabled(Exception):

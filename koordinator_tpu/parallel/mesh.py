@@ -32,17 +32,42 @@ def nodes_shard_count(mesh: Mesh | None) -> int:
     return 1 if mesh is None else int(mesh.shape[NODES_AXIS])
 
 
+def pods_shard_count(mesh: Mesh | None) -> int:
+    """Size of a mesh's pods axis (1 for no mesh)."""
+    return 1 if mesh is None else int(mesh.shape[PODS_AXIS])
+
+
+def mesh_axes(mesh: Mesh | None) -> dict | None:
+    """{"pods": p, "nodes": n} provenance of a mesh (None for no mesh).
+
+    Every bench record / provenance line stamps this shape (ISSUE 14):
+    a sharded-path win is unattributable without the axis split it was
+    measured on."""
+    if mesh is None:
+        return None
+    return {PODS_AXIS: pods_shard_count(mesh),
+            NODES_AXIS: nodes_shard_count(mesh)}
+
+
 def resolve_solver_mesh(spec="auto", devices=None) -> Mesh | None:
     """Resolve the scheduler's solve mesh (sharded-by-default policy).
 
     - a :class:`Mesh` passes through unchanged;
     - ``None`` / ``"off"`` disables sharding;
-    - ``"auto"`` (the default) builds the all-devices nodes-axis mesh
-      whenever more than one device is visible.
+    - ``"auto"`` (the default) builds the all-devices mesh whenever more
+      than one device is visible — every device on the nodes axis unless
+      a pods split is requested (below).
 
-    The ``KOORD_SOLVER_MESH`` env var overrides ``"auto"`` without code
-    changes: ``off`` forces single-device, an integer caps the device
-    count (e.g. ``KOORD_SOLVER_MESH=4`` on an 8-chip host).
+    Env overrides of ``"auto"`` (no code changes):
+
+    - ``KOORD_SOLVER_MESH=off`` forces single-device; an integer caps
+      the device count (``KOORD_SOLVER_MESH=4`` on an 8-chip host); a
+      ``PxN`` shape (``KOORD_SOLVER_MESH=2x4``) builds the explicit 2-D
+      pods x nodes mesh over the first ``P*N`` devices.
+    - ``KOORD_SOLVER_MESH_PODS=<int>`` sets the pods-axis size while the
+      nodes axis takes the rest (the shorthand when the device count
+      varies across hosts).  Default 1 — today's all-nodes layout,
+      bit-for-bit.
     """
     if isinstance(spec, Mesh):
         return spec
@@ -55,11 +80,28 @@ def resolve_solver_mesh(spec="auto", devices=None) -> Mesh | None:
     if env in ("off", "0", "none", "single"):
         return None
     devs = list(devices if devices is not None else jax.devices())
-    if env.isdigit():
+    pods_axis = max(int(os.environ.get("KOORD_SOLVER_MESH_PODS", "1")), 1)
+    if "x" in env:
+        p_s, _, n_s = env.partition("x")
+        if not (p_s.isdigit() and n_s.isdigit()):
+            raise ValueError(
+                f"KOORD_SOLVER_MESH={env!r}: a 2-D shape spells PxN "
+                "with integer axis sizes (e.g. 2x4)")
+        pods_axis, nodes_axis = max(int(p_s), 1), max(int(n_s), 1)
+        if pods_axis * nodes_axis > len(devs):
+            raise ValueError(
+                f"KOORD_SOLVER_MESH={env} needs {pods_axis * nodes_axis} "
+                f"devices, have {len(devs)}")
+        devs = devs[: pods_axis * nodes_axis]
+    elif env.isdigit():
         devs = devs[:max(int(env), 1)]
     if len(devs) < 2:
         return None
-    return solver_mesh(devs, pods_axis=1)
+    if len(devs) % pods_axis:
+        raise ValueError(
+            f"pods_axis={pods_axis} does not divide the "
+            f"{len(devs)}-device mesh (KOORD_SOLVER_MESH_PODS)")
+    return solver_mesh(devs, pods_axis=pods_axis)
 
 
 def solver_mesh(devices=None, pods_axis: int = 1) -> Mesh:

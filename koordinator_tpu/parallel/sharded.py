@@ -1,53 +1,77 @@
-"""Node-axis ``shard_map`` solve: the sharded-by-default batch path.
+"""2-D (pods x nodes) ``shard_map`` solve: the sharded-by-default path.
 
-The batched solver's three stages — fused Filter+Score candidate
-selection, the propose/accept rounds, and the incremental dirty-node
-candidate refresh — run here as explicit SPMD programs over the
-``solver_mesh``'s ``NODES_AXIS``.  Every shard owns a contiguous block
-of node rows (``jax.sharding`` splits the leading axis into contiguous
-blocks, so global row ``g`` lives on shard ``g // (N / ndev)`` at local
-row ``g % (N / ndev)``); pod tensors, quota tensors and the (P, k)
-candidate cache are replicated over the axis (the default mesh puts
-every device on "nodes").
+The batched solver's stages — fused Filter+Score candidate selection,
+the propose/accept rounds, the incremental dirty refresh, the gang
+all-or-nothing passes and the exact greedy scan — run here as explicit
+SPMD programs over the full 2-D ``solver_mesh``:
+
+- **node tensors** (``ClusterState``, ``est_accum``) shard their leading
+  axis over ``NODES_AXIS`` and replicate over ``PODS_AXIS``; shard ``s``
+  owns global rows ``[s*N/dn, (s+1)*N/dn)``.
+- **pod tensors** (``PodBatch``, the (P, k) candidate cache) shard their
+  leading axis over ``PODS_AXIS`` and replicate over ``NODES_AXIS``.
+  With ``pods_axis == 1`` (the default mesh) this is exactly the PR-10
+  replicated layout, bit for bit and program for program.
+- the (P, N) score/rank work — the dominant footprint at the 50k-pod
+  north-star shape — therefore lands as (P/dp, N/dn) tiles: per-device
+  candidate/score bytes scale 1/pods_axis at fixed total devices.
 
 Exactness argument — sharded acceptance decisions are BIT-IDENTICAL to
-the single-device solve:
+the single-device solve at every mesh shape:
 
-- **Selection** is a per-shard local top-k followed by a cross-shard
-  segmented merge: each shard reduces its local columns to the per-pod
-  per-stratum top-``min(k_i, n_local)`` by the GLOBAL ranking key
-  (``ops/batch_assign._rank_parts`` with global node ids), the (P, m)
-  shard winners ride one ``all_gather``, and every shard re-ranks the
-  gathered union with the same ``_topk_by_rank``.  The global top-k of
-  a union of per-shard top-k's equals the global top-k of all columns
-  (an element outside its shard's top-k is dominated by k_i better
-  local elements, so it can never be in the global top-k), and rank
-  pairs are unique per pod (the tie-break is a permutation of node
-  ids), so the merged sequence — values AND order — equals the
-  single-device ``lax.top_k``/two-key-sort output exactly.
-- **Rounds**: every per-round decision (best fitting candidate, the
-  priority prefix acceptance, quota admission) is computed REPLICATED
-  on all shards from replicated inputs; the only node-sharded data —
-  per-candidate free capacity — is gathered by the owning shard and
-  combined with an int32 ``psum`` (exact: exactly one shard contributes
-  a nonzero term per candidate).  The replicated acceptance then equals
-  ``ops/batch_assign._assign_rounds`` term for term, and each shard
-  scatters accepted requests only into the node rows it owns.
-- **Refresh**: a dirty node rescores only on its owning shard (unowned
-  rows enter the (P, D) sub-problem as invalid), the per-shard dirty
-  winners are all-gathered, and the merge re-ranks cached ∪ fresh
-  globally on the same key scale — the same union-of-top-k argument as
-  selection.
+- **Selection** is per-(pod-shard, node-shard)-tile local top-k with a
+  two-stage cross-axis merge.  Stage 1 (within a pod-shard row): each
+  tile reduces its local columns to the per-pod per-stratum
+  top-``min(k_i, n_local)`` by the GLOBAL ranking key
+  (``ops/batch_assign._rank_parts`` with global node ids), the
+  (P_loc, m) tile winners ride one ``all_gather`` over ``NODES_AXIS``,
+  and every tile re-ranks the gathered union with the same
+  ``_topk_by_rank``.  The top-k of a union of per-shard top-k's equals
+  the top-k of all columns (an element outside its shard's top-k is
+  dominated by k_i better local elements), and rank pairs are unique
+  per pod, so each pod row's merged sequence — values AND order —
+  equals the single-device output exactly.  Stage 2 (across the pod
+  axis): pod rows are INDEPENDENT, so the pod-sharded (P_loc, k)
+  results simply reassemble as the (P, k) global array — no cross-pod
+  merge exists to be wrong.
+- **Rounds**: the (P, k) candidates and per-pod tensors are gathered
+  over ``PODS_AXIS`` ONCE, before the round loop (gathering per round
+  is the regression koordlint's pod-axis corpus pins); every per-round
+  decision (best fitting candidate, priority-prefix acceptance, quota
+  admission) is then computed REPLICATED over the pod axis from the
+  gathered inputs, exactly as PR 10 computed it replicated over the
+  node axis.  The only node-sharded data — per-candidate free capacity
+  — is owned along ``NODES_AXIS`` and combined with an int32 ``psum``
+  (exact: exactly one shard contributes a nonzero term per candidate).
+  The replicated acceptance equals ``ops/batch_assign._assign_rounds``
+  term for term; each node shard scatters accepted requests only into
+  rows it owns.
+- **Refresh**: a dirty node rescores only on the owning
+  (pod-shard, node-shard) TILE — pods enter as local rows, unowned
+  dirty nodes enter the (P_loc, D) sub-problem as invalid — the
+  per-tile dirty winners are all-gathered over ``NODES_AXIS``, and the
+  merge re-ranks cached ∪ fresh per pod row on one key scale: the same
+  union-of-top-k argument as selection, pod rows independent.
+- **Gang / greedy**: the gang pass loop (select + rounds + rollback +
+  est accumulation) runs the kernels above per pass with the rollback
+  decisions replicated from gathered (P,) flags and the rebuilt
+  ``node_requested`` owner-scattered; the greedy scan keeps its
+  sequential pod order with each step's argmax merged over
+  ``NODES_AXIS`` as (max score, then min global node id among the
+  ties) — exactly ``jnp.argmax``'s first-occurrence rule — so neither
+  path all-gathers the (P, N) problem the way GSPMD placement did.
 
-Candidate selection here is always recall-EXACT (the per-shard problem
-is a factor of ``ndev`` smaller, so exact ``top_k`` is affordable where
-the single-device path reaches for ``approx_max_k``).
+Candidate selection here is always recall-EXACT (the per-tile problem
+is a factor of ``dp*dn`` smaller, so exact ``top_k`` is affordable
+where the single-device path reaches for ``approx_max_k``).
 
-Capacity: the node capacity must divide by the mesh's nodes-axis size —
-power-of-two capacity bucketing (state/cluster_state) guarantees this
-for power-of-two device counts.  The packed-vs-wide ranking-key regime
-(``ops/batch_assign``) is orthogonal: keys are global in both regimes,
-which is why sharding composes with the >32,768-node wide regime.
+Capacity: the node capacity must divide by the mesh's nodes axis and
+the pod-batch capacity by the pods axis — power-of-two capacity
+bucketing (state/cluster_state, ``PodBatch.build``/``compact``)
+guarantees both for power-of-two axis sizes.  The packed-vs-wide
+ranking-key regime (``ops/batch_assign``) is orthogonal: keys are
+global in both regimes, which is why sharding composes with the
+>32,768-node wide regime.
 """
 
 from __future__ import annotations
@@ -61,14 +85,21 @@ from jax.sharding import PartitionSpec as P
 
 from koordinator_tpu.ops import batch_assign as ba
 from koordinator_tpu.ops.assignment import pod_estimates, score_pods
-from koordinator_tpu.parallel.mesh import NODES_AXIS, nodes_shard_count
+from koordinator_tpu.parallel.mesh import (
+    NODES_AXIS,
+    PODS_AXIS,
+    nodes_shard_count,
+    pods_shard_count,
+)
 from koordinator_tpu.quota.admission import (
+    charge_quota,
     charge_quota_batch,
     quota_admission_mask,
 )
 
-_NODES = P(NODES_AXIS)   # leading (node) axis sharded
-_REP = P()               # replicated over the mesh
+_NODES = P(NODES_AXIS)   # leading (node) axis sharded, pods-replicated
+_PODS = P(PODS_AXIS)     # leading (pod) axis sharded, nodes-replicated
+_REP = P()               # replicated over the whole mesh
 
 
 def check_shardable(n_total: int, mesh) -> None:
@@ -83,25 +114,53 @@ def check_shardable(n_total: int, mesh) -> None:
             "power-of-two device counts")
 
 
+def check_pod_shardable(p_total: int, mesh) -> None:
+    """Loud trace-time guard: the pod-batch capacity must split evenly
+    over the mesh's pods axis."""
+    d = pods_shard_count(mesh)
+    if p_total % d:
+        raise ValueError(
+            f"pod-batch capacity {p_total} does not divide over the "
+            f"mesh's {d}-way pods axis; PodBatch's power-of-two "
+            "bucketing (build/compact) guarantees divisibility for "
+            "power-of-two pods_axis sizes")
+
+
 def _shard_offset(n_local: int) -> jnp.ndarray:
-    """Global row of this shard's local row 0."""
+    """Global node row of this tile's local node row 0."""
     return jax.lax.axis_index(NODES_AXIS).astype(jnp.int32) * n_local
 
 
+def _pod_offset(p_local: int) -> jnp.ndarray:
+    """Global pod row of this tile's local pod row 0."""
+    return jax.lax.axis_index(PODS_AXIS).astype(jnp.int32) * p_local
+
+
+def _gather_pods(tree):
+    """All-gather a pod-sharded pytree over the pods axis — ONCE, before
+    any round loop (a per-round pod-axis gather is the regression the
+    koordlint spec-consistency corpus pins).  Identity on a 1-way pods
+    axis, so the default mesh compiles the PR-10 program unchanged."""
+    return jax.tree.map(
+        lambda x: jax.lax.all_gather(x, PODS_AXIS, axis=0, tiled=True),
+        tree)
+
+
 # ---------------------------------------------------------------------------
-# Selection: per-shard local top-k + cross-shard segmented merge
+# Selection: per-tile local top-k + cross-axis segmented merge
 # ---------------------------------------------------------------------------
 
 
 # koordlint: shape[st_local: NxR i32 nodes]
 def _local_select_body(st_local, pods, cfg, *, k, strata, n_total):
-    """Shard-local fused Filter+Score + per-stratum local top-k, then the
-    cross-shard merge.  Returns replicated (cand_key, cand_node,
-    cand_score) — the ``with_scores=True`` shape of
-    ``ops/batch_assign.select_candidates``."""
+    """Tile-local fused Filter+Score + per-stratum local top-k, then the
+    cross-node-shard merge.  ``pods`` holds this tile's LOCAL pod rows;
+    returns the pod-sharded (cand_key, cand_node, cand_score) — the
+    ``with_scores=True`` shape of ``ops/batch_assign.select_candidates``
+    for those rows."""
     n_loc = st_local.capacity
     off = _shard_offset(n_loc)
-    scores, feasible = score_pods(st_local, pods, cfg)      # (P, n_loc)
+    scores, feasible = score_pods(st_local, pods, cfg)    # (P_loc, n_loc)
     node_ids = off + jnp.arange(n_loc, dtype=jnp.int32)
     clipped = jnp.clip(scores, 0, ba._SCORE_CLIP)
     rot = pods.rot_id
@@ -118,8 +177,10 @@ def _local_select_body(st_local, pods, cfg, *, k, strata, n_total):
         sel_node = node_ids[idx]
         sel_score = jnp.where(
             val >= 0, jnp.take_along_axis(clipped, idx, axis=1), -1)
-        # cross-shard segmented top-k merge: (P, m) shard winners ride
-        # one all_gather, every shard re-ranks the union globally
+        # cross-shard segmented top-k merge: (P_loc, m) tile winners
+        # ride one all_gather over the nodes axis, every tile re-ranks
+        # the union globally; pod rows are independent, so no pod-axis
+        # merge exists
         g_node = jax.lax.all_gather(sel_node, NODES_AXIS, axis=1,
                                     tiled=True)
         g_score = jax.lax.all_gather(sel_score, NODES_AXIS, axis=1,
@@ -147,28 +208,31 @@ def _select_program(mesh, n_total, k, strata):
     Every sharded entry point memoizes its jitted program this way:
     shard_map traced eagerly re-dispatches op by op on EVERY call (and
     re-traces per fresh ``partial`` closure), which made repeated
-    direct calls — the 1/2/4/8 mesh-invariance sweeps, the dirty-node
-    refresh loops, bench stages — pay trace + per-op dispatch each
-    time.  ``Mesh`` hashes by (devices, axis names), so equal meshes
-    share the entry, and the kit's outer jit composes (nested jit
+    direct calls — the mesh-invariance sweeps, the dirty-node refresh
+    loops, bench stages — pay trace + per-op dispatch each time.
+    ``Mesh`` hashes by (devices, axis names), so equal meshes share the
+    entry (2-D shapes hash by their device GRID, so 2x4 and 1x8 are
+    distinct entries), and the kit's outer jit composes (nested jit
     inlines)."""
     return jax.jit(shard_map(
         partial(_local_select_body, k=k, strata=strata, n_total=n_total),
-        mesh=mesh, in_specs=(_NODES, _REP, _REP),
-        out_specs=(_REP, _REP, _REP), check_rep=False))
+        mesh=mesh, in_specs=(_NODES, _PODS, _REP),
+        out_specs=(_PODS, _PODS, _PODS), check_rep=False))
 
 
 def sharded_select_candidates(mesh, state, pods, cfg, k: int = 32,
                               spread_bits=(5, 15),
                               with_scores: bool = False):
-    """``select_candidates`` over the mesh's nodes axis (recall-exact).
+    """``select_candidates`` over the 2-D mesh (recall-exact).
 
     Bit-identical to the single-device ``method="exact"`` selection on
-    valid slots (see module docstring)."""
+    valid slots (see module docstring); the returned (P, k) tensors are
+    pod-axis-sharded."""
     strata = (tuple(spread_bits) if isinstance(spread_bits, (tuple, list))
               else (spread_bits,))
     n_total = state.capacity
     check_shardable(n_total, mesh)
+    check_pod_shardable(pods.capacity, mesh)
     k = min(k, n_total)
     fn = _select_program(mesh, n_total, k, strata)
     cand_key, cand_node, cand_score = fn(state, pods, cfg)
@@ -178,14 +242,14 @@ def sharded_select_candidates(mesh, state, pods, cfg, k: int = 32,
 
 
 # ---------------------------------------------------------------------------
-# Rounds: replicated acceptance, owner-gathered capacity, sharded scatter
+# Rounds: pod-axis gather ONCE, replicated acceptance, owner-psum capacity
 # ---------------------------------------------------------------------------
 
 
-# koordlint: shape[st_local: NxR i32 nodes, cand_key: Pxk i32 rep, cand_node: Pxk i32 rep]
 def _rounds_local(st_local, pods, quota, cand_key, cand_node, *,
                   rounds, n_total):
-    """The propose/accept loop with node tensors shard-local.  Mirrors
+    """The propose/accept loop over GATHERED (full-P) pod tensors with
+    node tensors shard-local.  Mirrors
     ``ops/batch_assign._assign_rounds`` decision for decision; returns
     (assignments, requested_local, quota)."""
     n_loc = st_local.capacity
@@ -262,8 +326,12 @@ def _rounds_local(st_local, pods, quota, cand_key, cand_node, *,
     return carry[1], carry[0], carry[3]
 
 
+# koordlint: shape[st_local: NxR i32 nodes, cand_key: Pxk i32 pods, cand_node: Pxk i32 pods]
 def _rounds_body(st_local, pods, quota, cand_key, cand_node, *,
                  rounds, n_total):
+    # ONE pod-axis gather, before the round loop: the acceptance oracle
+    # (priority prefix over ALL pods) is global by definition
+    pods, cand_key, cand_node = _gather_pods((pods, cand_key, cand_node))
     a, requested, new_quota = _rounds_local(
         st_local, pods, quota, cand_key, cand_node,
         rounds=rounds, n_total=n_total)
@@ -275,7 +343,7 @@ def _rounds_program(mesh, n_total, rounds):
     """Jitted shard_map rounds program (see :func:`_select_program`)."""
     return jax.jit(shard_map(
         partial(_rounds_body, rounds=rounds, n_total=n_total),
-        mesh=mesh, in_specs=(_NODES, _REP, _REP, _REP, _REP),
+        mesh=mesh, in_specs=(_NODES, _PODS, _REP, _PODS, _PODS),
         out_specs=(_REP, _NODES, _REP), check_rep=False))
 
 
@@ -284,12 +352,15 @@ def sharded_assign_rounds(mesh, state, pods, quota, cand_key, cand_node,
     """``_assign_rounds`` over the mesh: (assignments, new_state, quota)."""
     n_total = state.capacity
     check_shardable(n_total, mesh)
+    check_pod_shardable(pods.capacity, mesh)
     return _rounds_program(mesh, n_total, rounds)(
         state, pods, quota, cand_key, cand_node)
 
 
+# koordlint: shape[st_local: NxR i32 nodes, cand_key: Pxk i32 pods, cand_node: Pxk i32 pods]
 def _round_pass_body(st_local, pods, quota, cand_key, cand_node, cfg, *,
                      rounds, n_total):
+    pods, cand_key, cand_node = _gather_pods((pods, cand_key, cand_node))
     a, requested, _ = _rounds_local(
         st_local, pods, quota, cand_key, cand_node,
         rounds=rounds, n_total=n_total)
@@ -318,7 +389,7 @@ def _round_pass_program(mesh, n_total, rounds):
     """Jitted shard_map pass-1 program (see :func:`_select_program`)."""
     return jax.jit(shard_map(
         partial(_round_pass_body, rounds=rounds, n_total=n_total),
-        mesh=mesh, in_specs=(_NODES, _REP, _REP, _REP, _REP, _REP),
+        mesh=mesh, in_specs=(_NODES, _PODS, _REP, _PODS, _PODS, _REP),
         out_specs=(_REP, _NODES, _REP, _NODES), check_rep=False))
 
 
@@ -330,6 +401,7 @@ def sharded_assign_round_pass(mesh, state, pods, quota, cand_key,
     est_accum); ``est_accum`` is node-sharded like the state."""
     n_total = state.capacity
     check_shardable(n_total, mesh)
+    check_pod_shardable(pods.capacity, mesh)
     return _round_pass_program(mesh, n_total, rounds)(
         state, pods, quota, cand_key, cand_node, cfg)
 
@@ -338,12 +410,15 @@ def _followup_body(st_local, est_local, pods, quota, cfg, *,
                    k, strata, rounds, n_total):
     # candidates re-selected against the est-augmented state; rounds and
     # the commit run against the UN-augmented accounting (the
-    # assign_followup_pass rollback-rebuild semantics)
+    # assign_followup_pass rollback-rebuild semantics).  Selection runs
+    # on this tile's LOCAL pod rows; the (P_loc, k) winners then ride
+    # the one pod-axis gather into the replicated rounds.
     aug = st_local.replace(
         node_usage=st_local.node_usage + est_local,
         node_agg_usage=st_local.node_agg_usage + est_local)
-    cand_key, cand_node, _ = _local_select_body(
+    ck_loc, cn_loc, _ = _local_select_body(
         aug, pods, cfg, k=k, strata=strata, n_total=n_total)
+    pods, cand_key, cand_node = _gather_pods((pods, ck_loc, cn_loc))
     a, requested, _ = _rounds_local(
         aug, pods, quota, cand_key, cand_node,
         rounds=rounds, n_total=n_total)
@@ -372,7 +447,7 @@ def _followup_program(mesh, n_total, k, strata, rounds):
     return jax.jit(shard_map(
         partial(_followup_body, k=k, strata=strata,
                 rounds=rounds, n_total=n_total),
-        mesh=mesh, in_specs=(_NODES, _NODES, _REP, _REP, _REP),
+        mesh=mesh, in_specs=(_NODES, _NODES, _PODS, _REP, _REP),
         out_specs=(_REP, _NODES, _REP, _NODES), check_rep=False))
 
 
@@ -386,15 +461,17 @@ def sharded_assign_followup_pass(mesh, state, est_accum, pods, quota, cfg,
               else (spread_bits,))
     n_total = state.capacity
     check_shardable(n_total, mesh)
+    check_pod_shardable(pods.capacity, mesh)
     return _followup_program(mesh, n_total, min(k, n_total), strata,
                              rounds)(state, est_accum, pods, quota, cfg)
 
 
 # ---------------------------------------------------------------------------
-# Incremental refresh: owner-local dirty rescore + global merge
+# Incremental refresh: owning-tile dirty rescore + nodes-axis merge
 # ---------------------------------------------------------------------------
 
 
+# koordlint: shape[st_local: NxR i32 nodes]
 def _refresh_body(st_local, pods, cfg, cache, dirty_rows, dirty_valid, *,
                   k, strata, n_total):
     n_loc = st_local.capacity
@@ -402,16 +479,17 @@ def _refresh_body(st_local, pods, cfg, cache, dirty_rows, dirty_valid, *,
     rot = pods.rot_id
     d = dirty_rows.shape[0]
 
-    # a dirty node rescores only on its owning shard: unowned rows enter
-    # the (P, D) sub-problem as invalid and rank -1
+    # a dirty node rescores only on its owning TILE: pods enter as this
+    # tile's local rows, unowned dirty nodes enter the (P_loc, D)
+    # sub-problem as invalid and rank -1
     loc = dirty_rows - off
     own = (loc >= 0) & (loc < n_loc) & dirty_valid
     sub = st_local.gather_rows(jnp.clip(loc, 0, n_loc - 1), own)
-    scores, feasible = score_pods(sub, pods, cfg)           # (P, D)
+    scores, feasible = score_pods(sub, pods, cfg)           # (P_loc, D)
     clipped = jnp.clip(scores, 0, ba._SCORE_CLIP)
 
-    # global dirty mask (replicated): cached slots pointing at ANY dirty
-    # node are stale regardless of which shard owns it
+    # global dirty mask (nodes-replicated): cached slots pointing at ANY
+    # dirty node are stale regardless of which shard owns it
     dirty_mask = jnp.zeros(n_total, bool).at[dirty_rows].max(dirty_valid)
     stale_score = jnp.where(dirty_mask[cache.cand_node], -1,
                             cache.cand_score)
@@ -435,8 +513,8 @@ def _refresh_body(st_local, pods, cfg, cache, dirty_rows, dirty_valid, *,
         g_node = jax.lax.all_gather(d_node, NODES_AXIS, axis=1, tiled=True)
         g_score = jax.lax.all_gather(d_score, NODES_AXIS, axis=1,
                                      tiled=True)
-        # merge re-ranks globally: cached ∪ per-shard fresh winners on
-        # one key scale
+        # merge re-ranks per pod row: cached ∪ per-shard fresh winners
+        # on one key scale (pod rows independent — no pod-axis merge)
         c_key = ba._candidate_keys(seg_score, seg_node, rot, sb, n_total)
         g_key = ba._candidate_keys(g_score, g_node, rot, sb, n_total)
         m_key = jnp.concatenate([c_key, g_key], axis=1)
@@ -462,22 +540,279 @@ def _refresh_program(mesh, n_total, k, strata):
     """Jitted shard_map refresh program (see :func:`_select_program`)."""
     return jax.jit(shard_map(
         partial(_refresh_body, k=k, strata=strata, n_total=n_total),
-        mesh=mesh, in_specs=(_NODES, _REP, _REP, _REP, _REP, _REP),
-        out_specs=(_REP, _REP), check_rep=False))
+        mesh=mesh, in_specs=(_NODES, _PODS, _REP, _PODS, _REP, _REP),
+        out_specs=(_PODS, _PODS), check_rep=False))
 
 
 def sharded_refresh_candidates(mesh, state, pods, cfg, cache, dirty_rows,
                                dirty_valid, k: int = 32,
                                spread_bits=(5, 15)):
     """``refresh_candidates`` over the mesh: dirty columns rescore on
-    their owning shard, the merge re-ranks globally.  Returns
-    (cand_key, new_cache) like the single-device refresh."""
+    their owning (pod, node) tile, the merge re-ranks per pod row.
+    Returns (cand_key, new_cache) like the single-device refresh, both
+    pod-axis-sharded."""
     strata = (tuple(spread_bits) if isinstance(spread_bits, (tuple, list))
               else (spread_bits,))
     n_total = state.capacity
     check_shardable(n_total, mesh)
+    check_pod_shardable(pods.capacity, mesh)
     return _refresh_program(mesh, n_total, min(k, n_total), strata)(
         state, pods, cfg, cache, dirty_rows, dirty_valid)
+
+
+# ---------------------------------------------------------------------------
+# Gang all-or-nothing + exact greedy: the explicit shard_map twins of the
+# GSPMD-placed ops/gang.gang_assign and ops/assignment.greedy_assign paths
+# ---------------------------------------------------------------------------
+
+
+def _greedy_local(st_local, pods, cfg, quota):
+    """Shard-local exact greedy scan over GATHERED (full-P) pods:
+    mirrors ``ops/assignment._greedy_scan`` (no reservations) step for
+    step, with the per-step argmax merged over the nodes axis as
+    (max score, then MIN global node id among the ties) — equal to the
+    single-device ``jnp.argmax`` first-occurrence rule, because the
+    local argmax already picks the lowest local index and global ids
+    order identically to local ones within a shard."""
+    from koordinator_tpu.ops.assignment import (
+        _composite_score,
+        _threshold_mask,
+    )
+
+    n_loc = st_local.capacity
+    off = _shard_offset(n_loc)
+    node_ids = off + jnp.arange(n_loc, dtype=jnp.int32)
+    order = jnp.lexsort((jnp.arange(pods.capacity), -pods.priority))
+    pod_est_all = pod_estimates(pods, cfg)
+
+    def step(carry, idx):
+        requested, est_added, qstate = carry
+        req = pods.requests[idx]
+        pod_est = pod_est_all[idx]
+        valid = pods.valid[idx]
+        free = jnp.where(
+            st_local.node_valid[:, None],
+            st_local.node_allocatable - requested, 0)
+        fits = jnp.all((req[None, :] <= free) | (req[None, :] == 0),
+                       axis=-1)
+        feasible = (
+            fits
+            & _threshold_mask(
+                cfg,
+                st_local.node_usage + est_added,
+                st_local.node_agg_usage + est_added,
+                st_local.node_allocatable,
+                pod_est[None, :],
+            )[0]
+            & pods.feasible_row(st_local, idx)
+            & st_local.node_valid
+            & valid)
+        if qstate is not None:
+            admitted = quota_admission_mask(
+                qstate, req[None, :], pods.quota_id[idx][None],
+                pods.non_preemptible[idx][None])[0]
+            feasible = feasible & admitted
+        scores = _composite_score(
+            cfg, st_local.node_allocatable, requested,
+            st_local.node_usage + est_added,
+            req[None, :], pod_est[None, :])[0]
+        masked = jnp.where(feasible, scores, -1)
+        lbest = jnp.argmax(masked)
+        lscore = masked[lbest]
+        gscore = jax.lax.pmax(lscore, NODES_AXIS)
+        cand = jnp.where(lscore == gscore, node_ids[lbest],
+                         jnp.int32(2**30))
+        gnode = jax.lax.pmin(cand, NODES_AXIS)
+        assigned = gscore >= 0
+        node = jnp.where(assigned, gnode, -1)
+        loc = gnode - off
+        own = assigned & (loc >= 0) & (loc < n_loc)
+        loc_c = jnp.clip(loc, 0, n_loc - 1)
+        requested = requested.at[loc_c].add(jnp.where(own, req, 0))
+        est_added = est_added.at[loc_c].add(jnp.where(own, pod_est, 0))
+        if qstate is not None:
+            qstate = charge_quota(
+                qstate, jnp.where(assigned, req, 0),
+                jnp.where(assigned, pods.quota_id[idx], -1),
+                non_preemptible=pods.non_preemptible[idx])
+        return (requested, est_added, qstate), node
+
+    carry0 = (st_local.node_requested,
+              jnp.zeros_like(st_local.node_usage), quota)
+    (requested, _, new_quota), nodes_in_order = jax.lax.scan(
+        step, carry0, order)
+    assignments = jnp.full(pods.capacity, -1, jnp.int32).at[order].set(
+        nodes_in_order)
+    return assignments, requested, new_quota
+
+
+# koordlint: shape[st_local: NxR i32 nodes]
+def _gang_body(st_local, pods, cfg, gangs, quota, *, passes, solver,
+               k, strata, rounds, n_total, p_total):
+    """The gang all-or-nothing pass loop as one SPMD program: per pass,
+    solve (batch select+rounds or the greedy scan), count per-gang
+    placements from replicated flags, roll failed groups back by
+    REBUILDING the owner-local ``node_requested`` from the pre-pass
+    accounting plus only the kept pods (ops/gang.rollback_failed_gangs'
+    exact-rollback rule), accumulate kept pods' estimated usage into the
+    owner shard, and recharge quota whole.  Mirrors
+    ``ops/gang.gang_assign`` decision for decision."""
+    from koordinator_tpu.ops.gang import (
+        _group_ok,
+        _per_gang_counts,
+        pre_enqueue_mask,
+    )
+
+    n_loc = st_local.capacity
+    off = _shard_offset(n_loc)
+    p_loc = pods.capacity
+    poff = _pod_offset(p_loc)
+
+    # ONE pod-axis gather for the whole pass loop: gang counting, the
+    # acceptance oracle and rollback flags are global over pods
+    pods_f = _gather_pods(pods)
+    g = gangs.capacity
+    pre_ok = pre_enqueue_mask(pods_f, gangs)
+    active = pods_f.valid & pre_ok                 # (P,)
+
+    total = jnp.full(p_total, -1, jnp.int32)
+    kept_so_far = jnp.zeros(p_total, bool)
+    requested = st_local.node_requested            # (n_loc, R)
+    cur_quota = quota
+    pod_est_all = pod_estimates(pods_f, cfg)       # (P, R)
+    est_local = jnp.zeros_like(st_local.node_usage)
+
+    for _ in range(passes):
+        solve_st = st_local.replace(
+            node_requested=requested,
+            node_usage=st_local.node_usage + est_local,
+            node_agg_usage=st_local.node_agg_usage + est_local)
+        act_pods = pods_f.replace(valid=active)
+        if solver == "batch":
+            # selection runs on this tile's LOCAL pod rows against the
+            # est-augmented local node tile; the winners ride the one
+            # nodes-axis merge inside and a pod-axis gather after
+            loc_active = jax.lax.dynamic_slice(active, (poff,), (p_loc,))
+            pods_loc = pods.replace(valid=pods.valid & loc_active)
+            ck_loc, cn_loc, _ = _local_select_body(
+                solve_st, pods_loc, cfg, k=k, strata=strata,
+                n_total=n_total)
+            ck, cn = _gather_pods((ck_loc, cn_loc))
+            a, _, _ = _rounds_local(
+                solve_st, act_pods, cur_quota, ck, cn,
+                rounds=rounds, n_total=n_total)
+        else:
+            a, _, _ = _greedy_local(solve_st, act_pods, cfg, cur_quota)
+
+        # rollback_failed_gangs, replicated flags + owner-local rebuild
+        assigned = (a >= 0) & act_pods.valid
+        counted = assigned | kept_so_far
+        counts = _per_gang_counts(counted, pods_f.gang_id, g)
+        gang_ok = (counts >= gangs.min_member) & gangs.valid
+        ok = _group_ok(gang_ok, gangs)
+        pod_gang = jnp.maximum(pods_f.gang_id, 0)
+        keep = assigned & ((pods_f.gang_id < 0) | ok[pod_gang])
+        failed = (pods_f.gang_id >= 0) & ~ok[pod_gang] & act_pods.valid
+        final = jnp.where(keep, a, -1)
+
+        loc = final - off
+        own = keep & (loc >= 0) & (loc < n_loc)
+        loc_c = jnp.clip(loc, 0, n_loc - 1)
+        requested = requested.at[loc_c].add(
+            jnp.where(own[:, None], pods_f.requests, 0))
+        est_local = est_local.at[loc_c].add(
+            jnp.where(own[:, None], pod_est_all, 0))
+        if cur_quota is not None:
+            cur_quota = charge_quota_batch(
+                cur_quota, pods_f.requests, pods_f.quota_id, keep,
+                pods_f.non_preemptible)
+        total = jnp.where(keep, final, total)
+        kept_so_far = kept_so_far | keep
+        # next pass: still-unassigned pods stay in play, but rolled-back
+        # gangs back off for the rest of the batch
+        active = active & ~keep & ~failed
+
+    return total, st_local.replace(node_requested=requested), cur_quota
+
+
+@lru_cache(maxsize=None)
+def _gang_program(mesh, n_total, p_total, passes, solver, k, strata,
+                  rounds):
+    """Jitted shard_map gang program (see :func:`_select_program`)."""
+    return jax.jit(shard_map(
+        partial(_gang_body, passes=passes, solver=solver, k=k,
+                strata=strata, rounds=rounds, n_total=n_total,
+                p_total=p_total),
+        mesh=mesh, in_specs=(_NODES, _PODS, _REP, _REP, _REP),
+        out_specs=(_REP, _NODES, _REP), check_rep=False))
+
+
+def sharded_gang_assign(mesh, state, pods, cfg, gangs, quota=None,
+                        passes: int = 2, solver: str = "greedy",
+                        k: int = 32, rounds: int = 12,
+                        spread_bits=(5, 15)):
+    """``ops/gang.gang_assign`` over the 2-D mesh — the explicit
+    shard_map twin of the GSPMD-placed gang path, for both per-pass
+    engines (``solver="batch"`` propose/accept rounds and
+    ``solver="greedy"``'s exact sequential scan).  Every default —
+    including ``solver="greedy"`` — matches ``gang_assign``'s, and the
+    candidate knobs match ``batch_assign``'s, so a drop-in swap of the
+    entry point keeps acceptance decisions bit-identical to the
+    single-device ``gang_assign`` (selection is recall-exact here, like
+    every sharded entry).
+
+    Returns (assignments, new_state, new_quota) with the state
+    node-sharded; requires the factored (selector-mask) feasibility
+    form — a dense (P, N) ``pods.feasible`` cannot tile."""
+    if solver not in ("greedy", "batch"):
+        raise ValueError(f"unknown solver {solver!r}")
+    if pods.feasible is not None:
+        raise ValueError(
+            "sharded_gang_assign requires the factored selector-mask "
+            "feasibility form; a dense (P, N) feasible matrix does not "
+            "tile over the 2-D mesh (build the batch with "
+            "selector_mask, or keep the GSPMD gang path)")
+    strata = (tuple(spread_bits) if isinstance(spread_bits, (tuple, list))
+              else (spread_bits,))
+    n_total = state.capacity
+    check_shardable(n_total, mesh)
+    check_pod_shardable(pods.capacity, mesh)
+    fn = _gang_program(mesh, n_total, pods.capacity, passes, solver,
+                       min(k, n_total), strata, rounds)
+    return fn(state, pods, cfg, gangs, quota)
+
+
+def sharded_greedy_assign(mesh, state, pods, cfg, quota=None):
+    """``ops/assignment.greedy_assign`` over the mesh as one explicit
+    shard_map kernel: the sequential scan keeps its exact pod order
+    (there is no pod parallelism in a priority scan), node tensors are
+    sharded, and each step's argmax merges over the nodes axis — no
+    all-gather of the (P, N) problem.  Returns (assignments, new_state,
+    new_quota) like the single-device entry."""
+    if pods.feasible is not None:
+        raise ValueError(
+            "sharded_greedy_assign requires the factored selector-mask "
+            "feasibility form (see sharded_gang_assign)")
+    n_total = state.capacity
+    check_shardable(n_total, mesh)
+    check_pod_shardable(pods.capacity, mesh)
+    return _greedy_program(mesh, n_total)(state, pods, cfg, quota)
+
+
+# koordlint: shape[st_local: NxR i32 nodes]
+def _greedy_body(st_local, pods, cfg, quota):
+    pods_f = _gather_pods(pods)
+    a, requested, new_quota = _greedy_local(st_local, pods_f, cfg, quota)
+    return a, st_local.replace(node_requested=requested), new_quota
+
+
+@lru_cache(maxsize=None)
+def _greedy_program(mesh, n_total):
+    """Jitted shard_map greedy program (see :func:`_select_program`)."""
+    return jax.jit(shard_map(
+        _greedy_body,
+        mesh=mesh, in_specs=(_NODES, _PODS, _REP, _REP),
+        out_specs=(_REP, _NODES, _REP), check_rep=False))
 
 
 # ---------------------------------------------------------------------------
@@ -495,7 +830,15 @@ def _lp_pack_body(st_local, pods, quota, cfg, *, n_total, ascent_iters,
     acceptance decision is replicated — the union-of-bests and
     owner-psum exactness arguments of the greedy rounds apply term for
     term, and all arithmetic is integer, so shard counts can't perturb
-    a single bit."""
+    a single bit.
+
+    On a 2-D mesh the LP twin COMPOSES by replicating the pod batch
+    over the pods axis (in_spec ``P()``; the price-ascent re-bidding
+    loop re-chooses every pod every iteration, so a pod split would put
+    a pod-axis all-gather INSIDE the ascent loop — the exact pattern
+    the koordlint corpus forbids).  Node work still shards 1/dn;
+    docs/sharding.md's axis-sizing guidance says to spend devices on
+    the nodes axis when quality mode dominates."""
     from koordinator_tpu.quality.lp_pack import _lp_core
 
     a, requested, new_quota, iters = _lp_core(
@@ -511,8 +854,8 @@ def _lp_pack_program(mesh, n_total, ascent_iters, rounding_iters):
 
     The LP solve is a while-loop program an order of magnitude pricier
     to trace than the greedy passes; without the memo every direct call
-    (the 1/2/4/8 mesh-invariance sweeps, bench stages) re-traces it even
-    at identical shapes.  ``Mesh`` hashes by (devices, axis names), so
+    (the mesh-invariance sweeps, bench stages) re-traces it even at
+    identical shapes.  ``Mesh`` hashes by (devices, axis names), so
     equal meshes built by different ``solver_mesh`` calls share the
     entry; the kit's own jit wrapper composes fine on top (nested jit
     inlines)."""
@@ -529,10 +872,12 @@ def sharded_lp_pack_assign(mesh, state, pods, cfg, quota=None,
                            rounding_iters: int | None = None):
     """``quality/lp_pack.lp_pack_assign`` over the mesh's nodes axis.
 
-    Bit-identical to the single-device LP solve at every shard count
-    (tests/test_quality.py sweeps 1/2/4/8): returns (assignments,
-    new_state, new_quota, iters) with the state node-sharded like the
-    greedy sharded passes."""
+    Bit-identical to the single-device LP solve at every mesh shape
+    (tests/test_quality.py sweeps shard counts; the 2-D sweep rides
+    tests/test_sharded_solve.py): returns (assignments, new_state,
+    new_quota, iters) with the state node-sharded like the greedy
+    sharded passes.  Pod tensors replicate over the pods axis — see
+    :func:`_lp_pack_body` for why that is the composition rule here."""
     from koordinator_tpu.quality import lp_pack as lp
 
     n_total = state.capacity

@@ -271,6 +271,11 @@ class Scheduler:
                 self.kit.node_sharding, self.solver_shard_count,
                 min_nodes=self.shard_min_nodes)
         self._solve = self.kit.solve
+        #: explicit shard_map gang/greedy twin (ISSUE 14): engaged for
+        #: factored-feasibility batches whenever the mesh is active and
+        #: both capacities divide over their axes; hinted (dense-mask)
+        #: rounds keep the GSPMD-placed entry
+        self._solve_sh = self.kit.solve_sh
 
         # -- incremental delta-driven solve (no-gang batch rounds) --
         #: steady-state rounds refresh a device-resident (P, k) candidate
@@ -1107,6 +1112,23 @@ class Scheduler:
             quota_id=quota_id, non_preemptible=non_preempt,
             node_capacity=n_cap, capacity=cap, rot_id=rot, **mask_kw,
         )
+        if (not hinted and self.mesh is not None
+                and self.kit.pod_shards > 1
+                and self.snapshot.solver_sharding_active
+                and self.kit.pods_shardable(batch.capacity)):
+            # pin the batch under the 2-D mesh's pod-axis NamedSharding:
+            # the cached batch is reused across steady-state rounds, so
+            # the sharded entries consume it in place instead of paying
+            # a host->device reshard per call.  Gated on the SAME
+            # solver_sharding_active predicate as the solves — a mesh
+            # present but inactive (capacity below the min-nodes floor)
+            # runs single-device entries, which must not receive a
+            # mesh-committed batch.  Donation-safe: no solve entry
+            # donates the batch (only the state and the refresh's cache
+            # donate — koordlint's donation-flow rule polices it).
+            from koordinator_tpu.parallel import mesh as pmesh
+
+            batch = pmesh.shard_pod_batch(batch, self.mesh)
         if not hinted:
             self._batch_cache = (key, batch)
             self._batch_host = {
@@ -1399,23 +1421,30 @@ class Scheduler:
         # sharded-solve introspection: the active nodes-axis
         # width plus the per-device slice of each persistent
         # tensor (a lopsided shard is a placement bug)
-        active_shards = (self.solver_shard_count
-                         if (self.mesh is not None
-                             and self.snapshot
-                             .solver_sharding_active) else 1)
+        active = (self.mesh is not None
+                  and self.snapshot.solver_sharding_active)
+        active_shards = self.solver_shard_count if active else 1
+        pod_shards = self.kit.pod_shards if active else 1
         metrics.solver_shard_count.set(float(active_shards))
-        if active_shards > 1:
+        # per-axis split of the 2-D mesh (ISSUE 14): the flat
+        # shard count can't distinguish 2x4 from 1x8
+        metrics.solver_axis_shard_count.set(
+            float(active_shards), labels={"axis": "nodes"})
+        metrics.solver_axis_shard_count.set(
+            float(pod_shards), labels={"axis": "pods"})
+        if active_shards > 1 or pod_shards > 1:
             for kind, tree in (
                 ("cluster_state", self.snapshot.state),
                 ("candidate_cache",
                  cand["cache"] if cand else None),
             ):
-                for did, nbytes in insp.device_bytes_by_shard(
-                        tree).items():
+                for (pi, ni), nbytes in (
+                        insp.device_bytes_by_mesh_shard(
+                            tree, self.mesh).items()):
                     metrics.solver_device_bytes.set(
                         float(nbytes),
                         labels={"kind": kind,
-                                "shard": str(did)})
+                                "shard": f"p{pi}n{ni}"})
         if self.explain:
             # per-dim capacity slack: the headroom context for
             # the round's fit_<dim> rejection counts
@@ -1658,7 +1687,10 @@ class Scheduler:
                         else "disabled")
                     metrics.incremental_solve_total.inc(labels={
                         "path": self.last_solve_path})
-                assignments, new_state, new_quota = self._solve(
+                solve_fn = (self._solve_sh
+                            if self._use_sharded_solve(batch)
+                            else self._solve)
+                assignments, new_state, new_quota = solve_fn(
                     self.snapshot.state, batch, self.config, gangs, quota,
                     passes=self.gang_passes, solver=solver,
                 )
@@ -1771,7 +1803,10 @@ class Scheduler:
                     # the padded capacity invalid).
                     small, idx = batch.replace(gang_id=rescue_gid).compact(
                         leftover)
-                    r_small, new_state, new_quota = self._solve(
+                    rescue_fn = (self._solve_sh
+                                 if self._use_sharded_solve(small)
+                                 else self._solve)
+                    r_small, new_state, new_quota = rescue_fn(
                         new_state, small, self.config, gangs, new_quota,
                         passes=self.gang_passes, solver="greedy",
                     )
@@ -2016,6 +2051,17 @@ class Scheduler:
         from koordinator_tpu.parallel.mesh import NODES_AXIS, PODS_AXIS
 
         cand = self._cand_cache
+
+        def _by_shard(tree):
+            # keyed by (pod_shard, node_shard) mesh coordinate when the
+            # mesh exists (ISSUE 14), flat device id otherwise
+            if self.mesh is not None:
+                return {f"p{pi}n{ni}": b for (pi, ni), b in
+                        insp.device_bytes_by_mesh_shard(
+                            tree, self.mesh).items()}
+            return {str(d): b for d, b in
+                    insp.device_bytes_by_shard(tree).items()}
+
         return {
             "solver_shard_count": (self.solver_shard_count
                                    if self.mesh is not None else 1),
@@ -2024,19 +2070,29 @@ class Scheduler:
             "mesh": ({"pods": int(self.mesh.shape[PODS_AXIS]),
                       "nodes": int(self.mesh.shape[NODES_AXIS])}
                      if self.mesh is not None else None),
+            "pod_shard_count": (self.kit.pod_shards
+                                if self.mesh is not None else 1),
             "shard_min_nodes": self.shard_min_nodes,
             "device_bytes_by_shard": {
-                "cluster_state": {
-                    str(d): b for d, b in insp.device_bytes_by_shard(
-                        self.snapshot.state).items()},
-                "candidate_cache": {
-                    str(d): b for d, b in insp.device_bytes_by_shard(
-                        cand["cache"] if cand else None).items()},
+                "cluster_state": _by_shard(self.snapshot.state),
+                "candidate_cache": _by_shard(
+                    cand["cache"] if cand else None),
             },
             "recompiles_by_shape": {
                 f"{lbl.get('fn', '?')}[{lbl.get('shape', '?')}]": int(v)
                 for lbl, v in metrics.solver_recompiles.items()},
         }
+
+    def _use_sharded_solve(self, batch: PodBatch) -> bool:  # koordlint: guarded-by(self.lock)
+        """Should this batch run the explicit shard_map gang/greedy twin
+        (``kit.solve_sh``)?  Yes when the mesh is active for the current
+        node capacity, the batch carries the factored selector-mask
+        feasibility form (a dense (P, N) mask cannot tile over the 2-D
+        mesh), and the batch capacity divides over the pods axis."""
+        return (self._solve_sh is not None
+                and self.snapshot.solver_sharding_active
+                and batch.selector_mask is not None
+                and self.kit.pods_shardable(batch.capacity))
 
     def _solve_batch_incremental(self, pods, batch: PodBatch, quota):  # koordlint: guarded-by(self.lock)
         """One-call form of the incremental solve (dispatch + finish):
@@ -2078,8 +2134,11 @@ class Scheduler:
         # sharded-by-default: when the solver mesh is active for this
         # capacity, selection/refresh/passes run the shard_map entries
         # (recall-exact selection; bit-identical acceptance) and the
-        # state donates in place under its node-axis NamedSharding
-        use_mesh = self.mesh is not None and snap.solver_sharding_active
+        # state donates in place under its node-axis NamedSharding; the
+        # batch capacity must additionally divide over the pods axis
+        # (always true for power-of-two axis sizes)
+        use_mesh = (self.mesh is not None and snap.solver_sharding_active
+                    and self.kit.pods_shardable(batch.capacity))
         if use_mesh:
             method = "sharded"
 

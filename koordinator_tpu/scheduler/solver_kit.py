@@ -52,16 +52,21 @@ class SolverKit:
         from koordinator_tpu.quality.lp_pack import lp_pack_assign
         from koordinator_tpu.quality.topo_gang import gang_topo_diameter
 
-        # -- sharded-by-default solve mesh (ISSUE 10) --
+        # -- sharded-by-default solve mesh (ISSUE 10, 2-D since ISSUE 14) --
         # the node axis of the batch solve shards over every visible
-        # device; tiny clusters stay single-device — sharding a 64-node
-        # problem is pure collective overhead — via the min-nodes floor.
+        # device (a pods axis splits off via KOORD_SOLVER_MESH=PxN /
+        # KOORD_SOLVER_MESH_PODS); tiny clusters stay single-device —
+        # sharding a 64-node problem is pure collective overhead — via
+        # the min-nodes floor.
         self.mesh = pmesh.resolve_solver_mesh(mesh)
         self.shard_min_nodes = int(os.environ.get(
             "KOORD_SOLVER_MESH_MIN_NODES", shard_min_nodes))
         self.shards = pmesh.nodes_shard_count(self.mesh)
+        self.pod_shards = pmesh.pods_shard_count(self.mesh)
         self.node_sharding = (pmesh.node_sharding(self.mesh)
                               if self.mesh is not None else None)
+        self.pod_sharding = (pmesh.pod_sharding(self.mesh)
+                             if self.mesh is not None else None)
 
         def _active(n_cap: int) -> bool:
             """Does THIS capacity solve on the sharded path?  The same
@@ -74,8 +79,22 @@ class SolverKit:
 
         self.sharding_active_for = _active
 
+        def _pods_shardable(p_cap: int) -> bool:
+            """Does THIS pod-batch capacity split over the pods axis?
+            Power-of-two batch bucketing guarantees it for power-of-two
+            pods_axis sizes; an odd env-forced axis just falls back."""
+            return self.mesh is not None and p_cap % self.pod_shards == 0
+
+        self.pods_shardable = _pods_shardable
+
         def _sfx(n_cap: int) -> str:
-            return f"@{self.shards}shard" if _active(n_cap) else ""
+            if not _active(n_cap):
+                return ""
+            # the pods=1 form keeps the historical label so recompile
+            # dashboards don't fork a new shape bucket on upgrade
+            if self.pod_shards > 1:
+                return f"@{self.pod_shards}x{self.shards}shard"
+            return f"@{self.shards}shard"
 
         def _pn(args, kwargs):
             return (f"P{args[1].capacity}xN{args[0].capacity}"
@@ -92,6 +111,22 @@ class SolverKit:
                     static_argnames=("passes", "solver"),
                     donate_argnums=(0,)),
             "gang_assign", shape_of=_pn)
+        # explicit shard_map twin of the gang/greedy solve (ISSUE 14):
+        # same signature prefix as gang_assign, so the scheduler swaps
+        # entries without re-plumbing; the GSPMD-placed self.solve stays
+        # the fallback for dense-feasibility (hinted) batches and
+        # capacities the mesh doesn't divide
+        self.solve_sh = None
+        if self.mesh is not None:
+            from functools import partial as _gpartial
+
+            # koordlint: shape[arg0: NxR i32 nodes]
+            self.solve_sh = insp.instrument(
+                jax.jit(_gpartial(psharded.sharded_gang_assign, self.mesh),
+                        static_argnames=("passes", "solver", "k",
+                                         "rounds", "spread_bits"),
+                        donate_argnums=(0,)),
+                "gang_assign", shape_of=_pn)
 
         self.select_scored = insp.instrument(
             jax.jit(_ba.select_candidates,

@@ -26,6 +26,9 @@ def test_stage_profiler_smoke():
                       "refresh_incremental_1pct",
                       "lp_pack_smoke", "topo_gang_rank",
                       "score_sharded", "rounds_sharded", "merge_topk",
+                      "score_sharded_1d", "rounds_sharded_1d",
+                      "score_sharded_2d", "rounds_sharded_2d",
+                      "sharded_2d_footprint",
                       "explain_compact_1pct", "explain_full_batch",
                       "tenancy_serial", "tenancy_pipelined",
                       "tenancy_batched"}, stages
@@ -34,7 +37,10 @@ def test_stage_profiler_smoke():
     for name in ("score", "select_approx", "select_chunked", "rounds",
                  "refresh_incremental_1pct", "lp_pack_smoke",
                  "topo_gang_rank", "score_sharded",
-                 "rounds_sharded", "merge_topk", "explain_compact_1pct",
+                 "rounds_sharded", "merge_topk",
+                 "score_sharded_1d", "rounds_sharded_1d",
+                 "score_sharded_2d", "rounds_sharded_2d",
+                 "explain_compact_1pct",
                  "explain_full_batch", "tenancy_serial",
                  "tenancy_pipelined", "tenancy_batched"):
         assert by_stage[name]["ms_per_iter"] > 0, by_stage[name]
@@ -49,11 +55,25 @@ def test_stage_profiler_smoke():
     assert by_stage["tenancy_pipelined"]["device_idle_fraction"] is not None
     # the stage capture stamps code provenance for later promotion
     assert "commit" in by_stage["provenance"]
-    # ... and mesh-shape provenance (ISSUE 10): the record names the
-    # device count and axis split the sharded stages ran on
+    # ... and FULL 2-D mesh provenance (ISSUE 14): device count, per-axis
+    # split, axis names and the PxN shape string, on the provenance line
+    # and on every sharded stage record
     assert by_stage["provenance"]["n_devices"] >= 1
     assert by_stage["provenance"]["mesh_axes"]["nodes"] >= 1
+    assert by_stage["provenance"]["mesh_axes"]["pods"] >= 1
+    assert by_stage["provenance"]["mesh_axis_names"] == ["pods", "nodes"]
+    assert "x" in by_stage["provenance"]["mesh_shape"]
     assert by_stage["score_sharded"]["n_devices"] >= 1
+    assert by_stage["score_sharded"]["mesh_axes"]["nodes"] >= 1
+    # the 2-D comparison stages (ISSUE 14 acceptance observables): the
+    # pods-split mesh reports its throughput ratio vs the all-nodes
+    # mesh, and the per-device candidate-tensor footprint scales
+    # ~1/pods_axis (exactly 1/2 at pods_axis=2)
+    assert by_stage["score_sharded_2d"]["mesh_axes"]["pods"] == 2
+    assert by_stage["score_sharded_2d"]["speedup_vs_1d"] > 0
+    assert by_stage["rounds_sharded_2d"]["speedup_vs_1d"] > 0
+    fp = by_stage["sharded_2d_footprint"]
+    assert fp["ratio"] <= 0.51, fp
     # the explain overhead stages price themselves against the solve
     assert "pct_of_solve" in by_stage["explain_compact_1pct"]
     assert "within_5pct" in by_stage["explain_compact_1pct"]

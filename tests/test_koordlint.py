@@ -234,11 +234,15 @@ class TestSpecConsistencyCorpus:
         assert "out_specs declares 2 entries" in messages
         assert "replicas" in messages and "diverge" in messages
         assert "propagated layout contradicts" in messages
-        assert len(findings) == 5
+        # the 2-D regression seed (ISSUE 14): pod batch re-gathered
+        # inside the round loop
+        assert "inside a device loop body" in messages
+        assert len(findings) == 6
 
     def test_good_corpus_is_clean(self):
         # right axis, aligned arities, sharded-base scatter (with the
-        # shape-annotation layout seed), matched chained layouts
+        # shape-annotation layout seed), matched chained layouts, and
+        # the 2-D gather-once-above-the-loop twin
         assert self.analyzer().run(
             corpus("spec_consistency", "good", ("pkg",))) == []
 

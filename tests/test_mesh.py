@@ -31,6 +31,37 @@ def test_eight_virtual_devices():
     assert len(jax.devices()) == 8
 
 
+def test_resolve_solver_mesh_2d_env_overrides(monkeypatch):
+    """ISSUE 14: KOORD_SOLVER_MESH=PxN builds the explicit 2-D mesh;
+    KOORD_SOLVER_MESH_PODS splits the pods axis off "auto"; the default
+    (pods_axis=1) reproduces today's all-nodes layout exactly."""
+    monkeypatch.delenv("KOORD_SOLVER_MESH", raising=False)
+    monkeypatch.delenv("KOORD_SOLVER_MESH_PODS", raising=False)
+    default = pmesh.resolve_solver_mesh("auto")
+    assert default == pmesh.solver_mesh(pods_axis=1)
+    assert pmesh.mesh_axes(default) == {"pods": 1, "nodes": 8}
+
+    monkeypatch.setenv("KOORD_SOLVER_MESH", "2x4")
+    m = pmesh.resolve_solver_mesh("auto")
+    assert pmesh.mesh_axes(m) == {"pods": 2, "nodes": 4}
+    assert pmesh.pods_shard_count(m) == 2
+    assert pmesh.nodes_shard_count(m) == 4
+
+    monkeypatch.setenv("KOORD_SOLVER_MESH", "4x4")
+    import pytest
+
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        pmesh.resolve_solver_mesh("auto")
+
+    monkeypatch.delenv("KOORD_SOLVER_MESH")
+    monkeypatch.setenv("KOORD_SOLVER_MESH_PODS", "4")
+    m = pmesh.resolve_solver_mesh("auto")
+    assert pmesh.mesh_axes(m) == {"pods": 4, "nodes": 2}
+
+    assert pmesh.mesh_axes(None) is None
+    assert pmesh.pods_shard_count(None) == 1
+
+
 def test_sharded_score_matches_unsharded():
     state, pods = build_problem()
     cfg = ScoringConfig.default()

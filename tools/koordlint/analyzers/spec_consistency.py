@@ -26,6 +26,13 @@ SpmdSite` model (layouts resolved through module spec constants like
   ``axis_index``-derived index into a REPLICATED/fresh-built value that
   flows out replicated means the shards' replicas silently diverge —
   gather first, or declare the output sharded.
+- **pod-axis gather inside the round loop** (ISSUE 14) — inside a body,
+  an ``all_gather`` over the POD axis within a ``while_loop`` /
+  ``fori_loop`` / ``scan`` body re-gathers the pod batch EVERY round.
+  The 2-D solve's contract is one pod-axis gather per program, before
+  the loop (``parallel/sharded._gather_pods``): the per-round form is
+  correct-but-quadratic — the exact regression a 2-D refactor most
+  easily introduces, invisible to parity tests and murder on ICI.
 
 Parameter layouts seed from in_specs; ``# koordlint: shape[...]``
 annotations seed helpers the closure walk cannot see through.  Checks
@@ -56,8 +63,13 @@ class SpecConsistencyAnalyzer(Analyzer):
                    "body: collective axis liveness, in/out arity, "
                    "propagated layouts, replicated-scatter divergence")
 
-    def __init__(self, package: str = "koordinator_tpu"):
+    #: lax loop entry -> positional index of its body function
+    _LOOP_BODY_ARG = {"while_loop": 1, "fori_loop": 2, "scan": 0}
+
+    def __init__(self, package: str = "koordinator_tpu",
+                 pod_axis: str = "pods"):
         self.package = package
+        self.pod_axis = pod_axis
 
     def run(self, project: Project) -> list[Finding]:
         index = get_index(project, self.package)
@@ -77,6 +89,7 @@ class SpecConsistencyAnalyzer(Analyzer):
                 self._check_axes(index, site, emit)
             if site.body_fn is not None:
                 self._check_replicated_scatter(index, site, emit)
+                self._check_loop_pod_gather(index, site, emit)
         self._check_layout_flow(index, sites, emit)
         return sorted(findings, key=lambda f: (f.path, f.line))
 
@@ -154,6 +167,93 @@ class SpecConsistencyAnalyzer(Analyzer):
                         f"axes {sorted(site.axes)})",
                         hint="use the mesh axis the site's specs "
                              "declare, or fix the specs"))
+
+    # -- pod-axis gather inside the round loop (ISSUE 14) ---------------------
+
+    def _check_loop_pod_gather(self, index, site: SpmdSite, emit) -> None:
+        """Flag ``all_gather(..., <pod axis>)`` reachable from a
+        ``while_loop``/``fori_loop``/``scan`` BODY inside the site's
+        closure: the pod batch must gather once, before the loop."""
+        closure = reachable_functions(index, [site.body_fn])
+        for fn in closure.values():
+            nested = {n.name: n for n in ast.walk(fn.node)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                pos = self._LOOP_BODY_ARG.get(_tail(node.func))
+                if pos is None or len(node.args) <= pos:
+                    continue
+                body = self._resolve_loop_body(index, fn, nested,
+                                               node.args[pos])
+                if body is None:
+                    continue
+                for mod, gather in self._pod_gathers_in(
+                        index, fn.module, nested, body, depth=4):
+                    emit(Finding(
+                        self.name, fn.sf.path, gather.lineno,
+                        f"all_gather over the {self.pod_axis!r} axis "
+                        "inside a device loop body: the pod batch is "
+                        "re-gathered EVERY round instead of once "
+                        "before the loop",
+                        hint="hoist the pod-axis gather above the "
+                             "while_loop/fori_loop/scan (see "
+                             "parallel/sharded._gather_pods) — the "
+                             "round loop should only psum node-owned "
+                             "contributions"))
+
+    def _resolve_loop_body(self, index, fn: FunctionInfo, nested,
+                           arg: ast.expr):
+        """A loop's body-function argument -> its AST (lambda, nested
+        def, or module-level function), or None."""
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name):
+            if arg.id in nested:
+                return nested[arg.id]
+            target = index.find_function(index.resolve(fn.module, arg))
+            if target is not None:
+                return target.node
+        return None
+
+    def _pod_gathers_in(self, index, module: str, nested, body,
+                        depth: int):
+        """Yield (module, call) for every pod-axis all_gather reachable
+        from ``body`` through nested defs / module-level helpers,
+        depth-limited (the closure is tiny: loop body -> round helper ->
+        gather helper)."""
+        seen_fns: set[int] = set()
+        stack = [(module, body, depth)]
+        while stack:
+            mod, node, d = stack.pop()
+            if id(node) in seen_fns:
+                continue
+            seen_fns.add(id(node))
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                tail = _tail(sub.func)
+                if tail == "all_gather":
+                    axis_node = (sub.args[1] if len(sub.args) > 1
+                                 else None)
+                    if axis_node is None:
+                        for kw in sub.keywords:
+                            if kw.arg == "axis_name":
+                                axis_node = kw.value
+                    axis = (resolve_axis_name(index, mod, axis_node)
+                            if axis_node is not None else None)
+                    if axis == self.pod_axis:
+                        yield mod, sub
+                elif d > 0 and isinstance(sub.func, ast.Name):
+                    if sub.func.id in nested:
+                        stack.append((mod, nested[sub.func.id], d - 1))
+                    else:
+                        target = index.find_function(
+                            index.resolve(mod, sub.func))
+                        if target is not None:
+                            stack.append((target.module, target.node,
+                                          d - 1))
 
     # -- replicated owner-local scatter ---------------------------------------
 

@@ -1,0 +1,35 @@
+"""Seeded ISSUE-14 violation: pod-axis all-gather INSIDE the round loop
+of a 2-D (pods x nodes) shard_map body — the pod batch re-gathers every
+round instead of once before the loop."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+NODES_AXIS = "nodes"
+PODS_AXIS = "pods"
+
+
+def _rounds2d_body(state, batch, *, rounds):
+    def round_body(carry):
+        i, acc = carry
+        # BAD: the pod batch re-gathers over the pods axis EVERY round
+        full = jax.lax.all_gather(batch, PODS_AXIS, axis=0, tiled=True)
+        contrib = jax.lax.psum(state.sum() + full.sum(), NODES_AXIS)
+        return i + 1, acc + contrib
+
+    def cond(carry):
+        return carry[0] < rounds
+
+    _, acc = jax.lax.while_loop(cond, round_body, (0, jnp.int32(0)))
+    return acc
+
+
+def rounds2d(mesh, state, batch):
+    fn = shard_map(partial(_rounds2d_body, rounds=4), mesh=mesh,
+                   in_specs=(P(NODES_AXIS), P(PODS_AXIS)),
+                   out_specs=P())
+    return fn(state, batch)

@@ -1,0 +1,37 @@
+"""The spec-consistent 2-D twin (must-pass): the pod batch gathers over
+the pods axis ONCE, above the round loop; the loop itself only psums
+node-owned contributions.  Exercises two-axis in/out-spec arity and
+pod-axis collective liveness on a pods x nodes site."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+NODES_AXIS = "nodes"
+PODS_AXIS = "pods"
+
+
+def _rounds2d_body(state, batch, *, rounds):
+    # ONE pod-axis gather, before the loop (the _gather_pods idiom)
+    full = jax.lax.all_gather(batch, PODS_AXIS, axis=0, tiled=True)
+
+    def round_body(carry):
+        i, acc = carry
+        contrib = jax.lax.psum(state.sum() + full.sum(), NODES_AXIS)
+        return i + 1, acc + contrib
+
+    def cond(carry):
+        return carry[0] < rounds
+
+    _, acc = jax.lax.while_loop(cond, round_body, (0, jnp.int32(0)))
+    return acc, full.sum()
+
+
+def rounds2d(mesh, state, batch):
+    fn = shard_map(partial(_rounds2d_body, rounds=4), mesh=mesh,
+                   in_specs=(P(NODES_AXIS), P(PODS_AXIS)),
+                   out_specs=(P(), P()))
+    return fn(state, batch)

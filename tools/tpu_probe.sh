@@ -46,15 +46,27 @@ from bench import _device_alive
 ok, kind, err = _device_alive(150.0)
 if ok:
     import jax
-    print(f"ok {len(jax.devices())}")
+    # full 2-D mesh provenance in the capture window (ISSUE 14): the
+    # axis split this window would solve on, printed by the SAME
+    # process (jax is already warm here)
+    from koordinator_tpu.parallel import mesh as pmesh
+    m = pmesh.resolve_solver_mesh("auto")
+    ax = pmesh.mesh_axes(m) or {"pods": 1, "nodes": 1}
+    print(f"ok {len(jax.devices())} {ax['pods']}x{ax['nodes']}")
 else:
     print(kind)' 2>/dev/null | tail -1)
     [ -z "$kind" ] && kind=probe_process_hung
-    case "$kind" in ok\ *) ndev=${kind#ok }; kind=ok;; *) ndev=unknown;; esac
+    mesh_shape=unknown
+    case "$kind" in
+        ok\ *) rest=${kind#ok }; ndev=${rest%% *}
+               case "$rest" in *\ *) mesh_shape=${rest#* };; esac
+               kind=ok;;
+        *) ndev=unknown;;
+    esac
     if [ "$kind" = "ok" ]; then
         ts=$(date +%Y%m%d_%H%M%S)
-        echo "$(date -Is) tunnel up (n_devices=${ndev}), capturing" \
-            >> "$OUT/probe.log"
+        echo "$(date -Is) tunnel up (n_devices=${ndev}," \
+            "mesh=${mesh_shape}), capturing" >> "$OUT/probe.log"
         # NO_PROBE_PROMOTION: this run must produce a FRESH measurement
         # or a zero that keeps the hunt alive — a promoted old capture
         # here would satisfy the nonzero grep below and end the hunt
