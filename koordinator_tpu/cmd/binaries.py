@@ -620,6 +620,23 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
              "quality path (every dimension must have headroom worth "
              "winning back)")
     parser.add_argument(
+        "--forecast-mode", choices=("off", "admit", "full"), default="off",
+        help="forecast plane (forecast/): off = today's solve exactly "
+             "(bit-identical acceptance decisions and quota charges); "
+             "admit = the forecast-headroom reserve — the predicted LS "
+             "peak growth not yet visible in observed usage — charges "
+             "into every round's filter/score accounting; full = "
+             "admission plus the predictive-colocation and "
+             "proactive-rebalance drivers where the deployment shell "
+             "wires them.  Any mode other than off attaches a "
+             "ForecastPlane fed from the round prelude and serves "
+             "/debug/forecast")
+    parser.add_argument(
+        "--forecast-horizon-seconds", type=float, default=120.0,
+        help="the forecast plane's base prediction horizon; stretches "
+             "with the diurnal trend slope (plane.horizon_for) up to "
+             "4x")
+    parser.add_argument(
         "--enable-profile-endpoint", action="store_true",
         help="arm /debug/profile?seconds=N (on-demand jax.profiler "
              "capture); OFF by default — the endpoint answers 403 "
@@ -689,6 +706,7 @@ def main_koord_scheduler(argv: list[str],
         flight_ring_size=args.flight_ring_size,
         quality_mode=args.quality_mode,
         quality_slack_threshold=args.quality_slack_threshold,
+        forecast_mode=args.forecast_mode,
     )
     tenant_front = None
     if args.tenants > 1:
@@ -787,6 +805,24 @@ def main_koord_scheduler(argv: list[str],
     if args.enable_profile_endpoint:
         scheduler.profile_capture = ProfilerCapture(
             enabled=True, out_dir=args.profile_dir or None)
+    if args.forecast_mode != "off":
+        # the forecast plane (ISSUE 15): fed from the round prelude,
+        # pinned under the solver mesh's node sharding when active, and
+        # served at /debug/forecast on both surfaces.  Multi-tenant
+        # assemblies attach one plane per tenant — each tenant's usage
+        # history is its own signal.
+        from koordinator_tpu.forecast.plane import ForecastPlane
+
+        planes = (
+            [(t.scheduler, t.scheduler.snapshot)
+             for t in tenant_front.tenants()]
+            if tenant_front is not None else [(scheduler, snapshot)])
+        for sched, snap in planes:
+            sched.attach_forecast_plane(ForecastPlane(
+                snap.capacity,
+                base_horizon_s=args.forecast_horizon_seconds,
+                mesh=(sched.mesh if snap.solver_sharding_active
+                      else None)))
     server = None
     sync_service = None
     if args.listen_socket or args.http_port is not None:

@@ -1,0 +1,42 @@
+"""Forecast plane: device-resident cluster forecasting (ISSUE 15).
+
+The predictive loop ROADMAP item 3 names, closed: the koordlet's
+decaying-histogram peak predictors (``prediction/``) lift into ONE
+cluster-wide ``(N, R)`` predicted-peak tensor (a batched percentile over
+a node-sharded histogram bank, pinned under the same NamedSharding as
+the cluster state), and three consumers act on the forecast instead of
+the observation:
+
+- **predictive colocation** — the manager's batch/mid allocatable solve
+  takes predicted instead of observed HP peaks, so BE capacity shrinks
+  *before* the forecast LS demand arrives
+  (:mod:`~koordinator_tpu.forecast.colocation`, wired through
+  ``ColocationLoop``'s existing node_allocatable push path);
+- **predictive admission** — a forecast-headroom reserve charged into
+  the solve's filter/score accounting for the round
+  (``Scheduler(forecast_mode=...)`` + the ``forecast_gang_assign``
+  SolverKit entry and its sharded twin; ``off`` is bit-identical to
+  today);
+- **proactive rebalance** — LowNodeLoad classification over the
+  *forecast* usage tensor pre-stages reservation-first migrations off
+  nodes predicted to cross the high threshold, each move gated on a
+  migration-cost evaluation over the resident cluster-state tensors
+  (:mod:`~koordinator_tpu.forecast.rebalance`).
+
+Proof is the reactive-vs-predictive A/B harness
+(:mod:`~koordinator_tpu.forecast.ab`): two stacks replay the same
+seeded diurnal trace and a scorer reports evictions avoided, SLO-breach
+minutes, and forecast error per arm (``tools/soak_report.py
+--forecast``).  See docs/forecast.md.
+"""
+
+from __future__ import annotations
+
+#: Scheduler(forecast_mode=...) values — gauge-encoded in order, like
+#: QUALITY_MODES: off = no forecast anywhere (bit-identical to a
+#: scheduler without the plane); admit = the admission reserve only;
+#: full = admission + the colocation/rebalance drivers armed at
+#: assembly.
+FORECAST_MODES = ("off", "admit", "full")
+
+from koordinator_tpu.forecast.plane import ForecastPlane  # noqa: E402,F401

@@ -1,0 +1,71 @@
+"""Predictive colocation: predicted peaks into the batch/mid solve.
+
+The manager's colocation loop computes Batch/Mid allocatable from
+OBSERVED HP usage (manager/noderesource.py byUsage policy): BE capacity
+only shrinks after the LS spike already happened — the reactive lag the
+whole forecast plane exists to close.  This driver swaps the observed
+peak for the forecast plane's predicted peak (never below the
+observation — a forecast must not hallucinate capacity back), so the
+very next ``node_allocatable`` push down the EXISTING transport path
+advertises the shrunk BE capacity before the forecast LS demand
+arrives.
+
+``ColocationLoop`` takes the driver as an optional ``forecast`` seam:
+``None`` (the default) is byte-identical to today's reconcile.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from koordinator_tpu.api.resources import ResourceDim
+
+
+class PredictiveColocation:
+    """NodeRecord overrides from the forecast plane.
+
+    ``row_fn(name) -> row | None`` maps a node name into the plane's
+    row space (the scheduler snapshot's rows when the plane is fed from
+    it; a harness's own index otherwise).  Rows the plane does not know
+    keep their observed values — prediction is an override, never a
+    gate on participating in colocation at all.
+    """
+
+    def __init__(self, plane, row_fn: Callable[[str], Optional[int]]):
+        self.plane = plane
+        self.row_fn = row_fn
+        self.overridden = 0
+
+    def apply(self, record) -> None:
+        """Raise one NodeRecord's HP peak to the predicted peak and
+        re-derive the prod-reclaimable input from it — the two fields
+        the batch and mid formulas consume
+        (CalculateBatchResourceByPolicy / CalculateMidResourceByPolicy).
+        Called per record inside ``ColocationLoop._build_records``
+        while the binding lock is NOT held (the record is host-local).
+        """
+        peaks = self.plane.predicted_host()
+        if peaks is None:
+            return
+        row = self.row_fn(record.name)
+        if row is None or not (0 <= row < peaks.shape[0]):
+            return
+        pred_cpu = int(peaks[row, ResourceDim.CPU])
+        pred_mem = int(peaks[row, ResourceDim.MEMORY])
+        if pred_cpu <= 0 and pred_mem <= 0:
+            return
+        # predicted-vs-observed HP peak: the batch formula's hpUsed term
+        record.hp_used_cpu_milli = max(record.hp_used_cpu_milli or 0,
+                                       pred_cpu)
+        record.hp_used_mem_mib = max(record.hp_used_mem_mib or 0,
+                                     pred_mem)
+        # prod reclaimable re-derives from the SAME (maxed) peak
+        # (peak_predictor semantics: reclaimable = request - peak,
+        # clamped) — NOT from the raw prediction: a prediction running
+        # below a fresh usage spike must not hand the mid tier capacity
+        # the node is actively using
+        record.prod_reclaimable_cpu_milli = max(
+            record.hp_request_cpu_milli - record.hp_used_cpu_milli, 0)
+        record.prod_reclaimable_mem_mib = max(
+            record.hp_request_mem_mib - record.hp_used_mem_mib, 0)
+        self.overridden += 1

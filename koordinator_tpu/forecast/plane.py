@@ -1,0 +1,427 @@
+"""The cluster-wide forecast plane: one device-resident predictor bank.
+
+Where the koordlet's ``prediction/`` models one NODE's pods in
+isolation, the :class:`ForecastPlane` holds EVERY node's decaying usage
+histogram as one ``(N, B)`` bank per prod dimension — the same
+fixed-capacity, power-of-two-bucketed, validity-masked layout as the
+cluster state, pinned under the same NamedSharding when the solver
+meshes — and answers all N predictions in one batched percentile pass
+(:func:`~koordinator_tpu.forecast.kernels.predicted_peaks`).
+
+Cadence contract:
+
+- :meth:`observe` scatters one usage sample per node into the banks
+  (called from the scheduler's round prelude under the round lock, or
+  a harness tick) and keeps the running realized peak;
+- :meth:`refresh` recomputes the ``(N, R)`` predicted-peak tensor at
+  the current horizon, scores the PREVIOUS prediction against the
+  realized peak (``forecast_error_fraction{dim}``), and resets the
+  realized window.  The horizon stretches with the diurnal trend
+  slope (:meth:`horizon_for`): a cluster trending up looks further
+  ahead — "A Predictive Autoscaler for Elastic Batch Jobs" (PAPERS.md)
+  is the template.
+
+Thread-safety mirrors the SLO monitor: host fields swap under one lock
+(``/debug/forecast`` reads arrive on gateway threads); device arrays
+are immutable values, so readers see a consistent (predicted, horizon)
+pair or the previous one, never a torn mix.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu import metrics
+from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS, ResourceDim
+from koordinator_tpu.forecast import kernels
+from koordinator_tpu.prediction.histogram import (
+    HistogramBank,
+    add_samples,
+    default_cpu_buckets,
+    default_memory_buckets,
+)
+
+
+class ForecastPlane:
+    """N node-usage predictors as one device-resident bank."""
+
+    def __init__(self, capacity: int, *,
+                 half_life_s: float = 300.0,
+                 base_horizon_s: float = 120.0,
+                 max_horizon_scale: float = 4.0,
+                 horizon_gain: float = 2.0,
+                 safety_margin_pct: float = 10.0,
+                 refresh_interval_s: float = 30.0,
+                 mesh=None,
+                 clock=time.time):
+        self.capacity = int(capacity)
+        self.half_life_s = float(half_life_s)
+        self.base_horizon_s = float(base_horizon_s)
+        self.max_horizon_scale = float(max_horizon_scale)
+        self.horizon_gain = float(horizon_gain)
+        self.safety_margin_pct = float(safety_margin_pct)
+        self.refresh_interval_s = float(refresh_interval_s)
+        self.clock = clock
+        self.mesh = mesh
+        self._sharding = None
+        self._lock = threading.Lock()
+        self._cpu_buckets = default_cpu_buckets()
+        self._mem_buckets = default_memory_buckets()
+        self._t0: float | None = None
+        self.cpu_bank = HistogramBank.zeros(
+            self.capacity, self._cpu_buckets, self.half_life_s)
+        self.mem_bank = HistogramBank.zeros(
+            self.capacity, self._mem_buckets, self.half_life_s)
+        #: (N, R) int32 predicted peaks at the current horizon; None
+        #: until the first refresh (``ready`` gates every consumer)
+        self.predicted = None
+        self._predicted_host: np.ndarray | None = None
+        self._realized = jnp.zeros((self.capacity, NUM_RESOURCE_DIMS),
+                                   jnp.int32)
+        self._valid = jnp.zeros((self.capacity,), bool)
+        self.horizon_s = self.base_horizon_s
+        self.growth_per_hour = 0.0
+        self.refreshed_at: float | None = None
+        self.observations = 0
+        self.refreshes = 0
+        #: last refresh's |predicted - realized| / realized per dim
+        #: (None before two refreshes bracket a realized window)
+        self.error_fraction: dict[str, float] = {}
+        #: extra labels on every gauge this plane publishes (the
+        #: scheduler stamps its tenant here at attach, so per-tenant
+        #: planes never overwrite each other's telemetry)
+        self.metric_labels: dict[str, str] = {}
+        #: the scheduler's last published admission-reserve fraction
+        #: (reserve_fraction stores it so /debug/forecast reads THIS
+        #: plane's number, not a shared global gauge)
+        self.last_admission_reserved_fraction: float = 0.0
+        #: (refresh time, mean realized CPU) window the auto horizon
+        #: policy fits trend.fit_slope over when the caller supplies no
+        #: growth rate
+        self._growth_window: list[tuple[float, float]] = []
+
+        # -- jitted entries (buckets are hashable static args) --
+        self._observe_fn = jax.jit(partial(
+            self._observe_kernel,
+            cpu_buckets=self._cpu_buckets, mem_buckets=self._mem_buckets))
+        self._peaks_fn = jax.jit(partial(
+            kernels.predicted_peaks,
+            cpu_buckets=self._cpu_buckets, mem_buckets=self._mem_buckets,
+            safety_margin_pct=self.safety_margin_pct))
+        self._peaks_fn_sh = None
+        if mesh is not None:
+            self._peaks_fn_sh = jax.jit(partial(
+                kernels.sharded_predicted_peaks, mesh,
+                cpu_buckets=self._cpu_buckets,
+                mem_buckets=self._mem_buckets,
+                safety_margin_pct=self.safety_margin_pct))
+        self._reserve_fn = jax.jit(kernels.admission_reserve)
+        self._error_fn = jax.jit(kernels.forecast_error_sums)
+        self._reserve_sums_fn = jax.jit(kernels.reserve_fraction_sums)
+        self._realized_mean_fn = jax.jit(
+            lambda realized, valid: (
+                jnp.sum(jnp.where(
+                    valid, realized[:, ResourceDim.CPU], 0
+                ).astype(jnp.float32)),
+                jnp.sum(valid.astype(jnp.float32))))
+
+    @staticmethod
+    def _observe_kernel(cpu_bank, mem_bank, realized, usage, valid, t,
+                        *, cpu_buckets, mem_buckets):
+        uids = jnp.arange(usage.shape[0], dtype=jnp.int32)
+        cpu_bank = add_samples(
+            cpu_bank, cpu_buckets, uids,
+            usage[:, ResourceDim.CPU].astype(jnp.float32), t, mask=valid)
+        mem_bank = add_samples(
+            mem_bank, mem_buckets, uids,
+            usage[:, ResourceDim.MEMORY].astype(jnp.float32), t, mask=valid)
+        realized = kernels.realized_peak_update(realized, usage, valid)
+        # the retained valid mask must be a FRESH buffer, never the
+        # caller's: the scheduler feeds this from snapshot state whose
+        # buffers the round's donating solve consumes minutes later —
+        # holding the input would leave refresh()/report() reading a
+        # deleted array (computing it inside the jit guarantees a new
+        # executable output buffer)
+        valid_copy = jnp.where(valid, True, False)
+        return cpu_bank, mem_bank, realized, valid_copy
+
+    # -- placement -----------------------------------------------------------
+
+    def set_sharding(self, sharding) -> None:
+        """Pin the bank (and any predictions) node-axis-sharded — the
+        same placement the snapshot pins its state under, so the
+        admission reserve and the charged solve never reshard."""
+        self._sharding = sharding
+        if sharding is None:
+            return
+        put = lambda x: jax.device_put(x, sharding)  # noqa: E731
+        with self._lock:
+            self.cpu_bank = self.cpu_bank.replace(
+                weights=put(self.cpu_bank.weights),
+                total=put(self.cpu_bank.total))
+            self.mem_bank = self.mem_bank.replace(
+                weights=put(self.mem_bank.weights),
+                total=put(self.mem_bank.total))
+            self._realized = put(self._realized)
+            self._valid = put(self._valid)
+            if self.predicted is not None:
+                self.predicted = put(self.predicted)
+
+    def grow(self, capacity: int) -> None:
+        """Re-bucket to a larger node capacity (snapshot growth): pad
+        every per-node tensor; existing rows keep their history."""
+        if capacity <= self.capacity:
+            return
+        old = self.capacity
+        self.capacity = int(capacity)
+
+        def pad(a):
+            out = np.zeros((capacity,) + a.shape[1:], np.asarray(a).dtype)
+            out[:old] = np.asarray(a)
+            return jnp.asarray(out)
+
+        with self._lock:
+            self.cpu_bank = self.cpu_bank.replace(
+                weights=pad(self.cpu_bank.weights),
+                total=pad(self.cpu_bank.total))
+            self.mem_bank = self.mem_bank.replace(
+                weights=pad(self.mem_bank.weights),
+                total=pad(self.mem_bank.total))
+            self._realized = pad(self._realized)
+            self._valid = pad(self._valid)
+            self.predicted = (pad(self.predicted)
+                              if self.predicted is not None else None)
+            self._predicted_host = None
+        if self._sharding is not None:
+            self.set_sharding(self._sharding)
+
+    # -- ingestion -----------------------------------------------------------
+
+    def observe(self, usage, valid, now: float | None = None) -> None:
+        """Scatter one usage sample per node into the banks.
+
+        ``usage`` is (N, R) int32, ``valid`` (N,) bool — numpy or
+        device arrays; the whole batch lands in ONE jitted scatter.
+        Timestamps are plane-relative so decay stays within float32.
+        """
+        now = self.clock() if now is None else now
+        if self._t0 is None:
+            self._t0 = now
+        t = jnp.float32(max(now - self._t0, 0.0))
+        usage = jnp.asarray(usage)
+        valid = jnp.asarray(valid)
+        if usage.shape[0] > self.capacity:
+            self.grow(usage.shape[0])
+        elif usage.shape[0] < self.capacity:
+            # a plane sized ahead of its snapshot: pad the sample up to
+            # the bank (missing rows are invalid, contributing nothing)
+            pad = self.capacity - usage.shape[0]
+            usage = jnp.pad(usage, ((0, pad), (0, 0)))
+            valid = jnp.pad(valid, (0, pad))
+        with self._lock:
+            (self.cpu_bank, self.mem_bank, self._realized,
+             self._valid) = self._observe_fn(
+                self.cpu_bank, self.mem_bank, self._realized, usage, valid,
+                t)
+            self.observations += 1
+
+    def observe_state(self, state, now: float | None = None) -> None:
+        """Observe a ClusterState's usage tensor (the scheduler's round
+        prelude path — called under the round lock, pre-dispatch, so
+        the state buffers are live)."""
+        self.observe(state.node_usage, state.node_valid, now)
+
+    # -- prediction ----------------------------------------------------------
+
+    def horizon_for(self, growth_per_hour: float | None) -> float:
+        """Horizon policy: stretch the base horizon with the diurnal
+        trend slope — a cluster whose usage is ramping deserves a
+        longer look-ahead; a flat or falling trend keeps the base.
+        ``growth_per_hour`` is a RELATIVE rate (fraction of current
+        level per hour), e.g. a trend.py slope over a usage series
+        divided by its mean."""
+        g = max(float(growth_per_hour or 0.0), 0.0)
+        return self.base_horizon_s * min(1.0 + g * self.horizon_gain,
+                                         self.max_horizon_scale)
+
+    def _auto_growth(self, now: float) -> float:
+        """Relative realized-CPU growth per hour, fitted with
+        ``trend.fit_slope`` over the recent refresh window — the
+        horizon policy's default input when the caller wires no
+        external trend signal.  One tiny device reduction per refresh.
+        """
+        from koordinator_tpu.trend import fit_slope
+
+        total, count = self._realized_mean_fn(self._realized, self._valid)
+        count = float(count)
+        mean = float(total) / count if count > 0 else 0.0
+        self._growth_window.append((now, mean))
+        self._growth_window = self._growth_window[-8:]
+        fit = fit_slope([s[0] for s in self._growth_window],
+                        [s[1] for s in self._growth_window])
+        if fit is None or fit.mean <= 0:
+            return 0.0
+        return fit.slope * 3600.0 / fit.mean
+
+    def refresh(self, now: float | None = None,
+                growth_per_hour: float | None = None) -> None:
+        """Recompute the (N, R) predicted-peak tensor, score the
+        previous prediction against the realized window, publish the
+        forecast gauges, and reset the realized window.
+
+        ``growth_per_hour`` None means self-derived: the plane fits the
+        trend slope over its own realized-usage window
+        (:meth:`_auto_growth`), so the documented horizon stretch works
+        without any external wiring."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            if growth_per_hour is None:
+                growth_per_hour = self._auto_growth(now)
+            self.growth_per_hour = float(growth_per_hour)
+            self.horizon_s = self.horizon_for(growth_per_hour)
+            if self.predicted is not None:
+                err, base = self._error_fn(self.predicted, self._realized,
+                                           self._valid)
+                err, base = np.asarray(err), np.asarray(base)
+                for dim in (ResourceDim.CPU, ResourceDim.MEMORY):
+                    if base[dim] > 0:
+                        frac = float(err[dim]) / float(base[dim])
+                        self.error_fraction[dim.name.lower()] = frac
+                        metrics.forecast_error_fraction.set(
+                            frac, labels={"dim": dim.name.lower(),
+                                          **self.metric_labels})
+            fn = self._peaks_fn_sh or self._peaks_fn
+            self.predicted = fn(
+                self.cpu_bank.weights, self.cpu_bank.total,
+                self.mem_bank.weights, self.mem_bank.total,
+                jnp.float32(self.horizon_s),
+                jnp.float32(self.growth_per_hour))
+            if self._sharding is not None:
+                self.predicted = jax.device_put(self.predicted,
+                                                self._sharding)
+            self._predicted_host = None
+            self._realized = jnp.zeros_like(self._realized)
+            if self._sharding is not None:
+                self._realized = jax.device_put(self._realized,
+                                                self._sharding)
+            self.refreshed_at = now
+            self.refreshes += 1
+        metrics.forecast_horizon_seconds.set(
+            self.horizon_s, labels=self.metric_labels or None)
+
+    def maybe_refresh(self, now: float | None = None,
+                      growth_per_hour: float | None = None) -> bool:
+        """Refresh on the configured cadence; True when one ran."""
+        now = self.clock() if now is None else now
+        if (self.refreshed_at is not None
+                and now - self.refreshed_at < self.refresh_interval_s):
+            return False
+        self.refresh(now, growth_per_hour)
+        return True
+
+    @property
+    def ready(self) -> bool:
+        """Consumers may act on the forecast (first refresh landed)."""
+        return self.predicted is not None
+
+    # -- consumers -----------------------------------------------------------
+
+    def admission_reserve(self, state):
+        """(N, R) int32 forecast-headroom reserve against this state,
+        or None while the plane is not ready / capacities diverge (a
+        snapshot that grew past the plane waits for the next observe
+        to re-bucket)."""
+        if self.predicted is None:
+            return None
+        if state.capacity != self.capacity:
+            return None
+        return self._reserve_fn(self.predicted, state.node_usage,
+                                state.node_valid)
+
+    def reserve_fraction(self, reserve, state) -> float:
+        """Cluster-wide reserved fraction of allocatable (the
+        ``forecast_admission_reserved_fraction`` value) — one small
+        (R,) device reduction, host-read by the caller's cadence."""
+        res, alloc = self._reserve_sums_fn(reserve, state)
+        res, alloc = np.asarray(res), np.asarray(alloc)
+        total = float(alloc.sum())
+        frac = float(res.sum()) / total if total > 0 else 0.0
+        self.last_admission_reserved_fraction = frac
+        return frac
+
+    def predicted_host(self) -> np.ndarray | None:
+        """Host copy of the predicted-peak tensor (cached per refresh)
+        — the predictive-colocation driver's read path."""
+        with self._lock:
+            if self.predicted is None:
+                return None
+            if self._predicted_host is None:
+                self._predicted_host = np.asarray(self.predicted)
+            return self._predicted_host
+
+    def forecast_usage(self, node_usage):
+        """(N, R) int32 max(observed, predicted) — the forecast usage
+        tensor proactive rebalance classifies over (a forecast must
+        never make a node look EMPTIER than it observably is)."""
+        if self.predicted is None:
+            return jnp.asarray(node_usage)
+        return jnp.maximum(jnp.asarray(node_usage), self.predicted)
+
+    # -- surfaces ------------------------------------------------------------
+
+    def report(self, max_nodes: int = 64,
+               row_names: dict[int, str] | None = None) -> dict:
+        """The /debug/forecast body fragment: horizon policy, error
+        stats, and the top ``max_nodes`` nodes by predicted CPU peak."""
+        with self._lock:
+            horizon = self.horizon_s
+            growth = self.growth_per_hour
+            refreshed = self.refreshed_at
+            refreshes = self.refreshes
+            observations = self.observations
+            error = dict(self.error_fraction)
+            # peaks and the valid mask must come from ONE lock scope: a
+            # concurrent grow() between the two reads would hand back a
+            # valid mask longer than the peaks array
+            if self.predicted is not None and self._predicted_host is None:
+                self._predicted_host = np.asarray(self.predicted)
+            peaks = self._predicted_host
+            valid = (np.asarray(self._valid)[: peaks.shape[0]]
+                     if peaks is not None else None)
+        doc = {
+            "ready": self.ready,
+            "capacity": self.capacity,
+            "horizon_s": horizon,
+            "growth_per_hour": growth,
+            "base_horizon_s": self.base_horizon_s,
+            "refreshed_at": refreshed,
+            "refreshes": refreshes,
+            "observations": observations,
+            "error_fraction": error,
+            "admission_reserved_fraction":
+                self.last_admission_reserved_fraction,
+            "sharded": self._sharding is not None,
+            "nodes": [],
+        }
+        if peaks is None:
+            return doc
+        rows = np.flatnonzero(valid)
+        order = rows[np.argsort(peaks[rows, ResourceDim.CPU])[::-1]]
+        for row in order[:max(int(max_nodes), 0)]:
+            entry = {
+                "row": int(row),
+                "predicted_cpu_milli": int(peaks[row, ResourceDim.CPU]),
+                "predicted_memory_mib": int(peaks[row, ResourceDim.MEMORY]),
+            }
+            if row_names:
+                name = row_names.get(int(row))
+                if name is not None:
+                    entry["node"] = name
+            doc["nodes"].append(entry)
+        return doc

@@ -185,7 +185,8 @@ class ColocationLoop:
     def __init__(self, controller: NodeResourceController,
                  binding: ManagerSyncBinding,
                  push_fn: Callable[[str, np.ndarray], None],
-                 ensure_fn: Optional[Callable[[], object]] = None):
+                 ensure_fn: Optional[Callable[[], object]] = None,
+                 forecast=None):
         self.controller = controller
         self.binding = binding
         self.push_fn = push_fn
@@ -193,6 +194,13 @@ class ColocationLoop:
         #: connection heals even on ticks that push nothing (the push
         #: path alone would only reconnect when a patch fires)
         self.ensure_fn = ensure_fn
+        #: predictive-colocation seam (ISSUE 15): a
+        #: forecast.colocation.PredictiveColocation that raises each
+        #: record's HP peak to the plane's prediction before the
+        #: reconcile, so the pushed batch/mid allocatable shrinks ahead
+        #: of the forecast LS ramp.  None (the default) reconciles
+        #: byte-identically to the reactive loop.
+        self.forecast = forecast
         self.ticks = 0
         self.push_failures = 0
         self.connect_failures = 0
@@ -248,6 +256,11 @@ class ColocationLoop:
                 record.hp_max_used_req_mem_mib = (
                     0 if hp_max is None else int(hp_max[mem]))
                 records.append(record)
+        if self.forecast is not None:
+            # outside the binding lock: the records are host-local by
+            # now, and the plane holds its own lock for the host copy
+            for record in records:
+                self.forecast.apply(record)
         return records
 
     def tick(self) -> int:

@@ -627,6 +627,29 @@ quality_slack_recovered = SCHEDULER.gauge(
     "turned from free slack into placements, per resource dimension "
     "(label: dim): (free_before - free_after) / allocatable")
 
+# -- forecast plane (forecast/, ISSUE 15) --
+forecast_horizon_seconds = SCHEDULER.gauge(
+    "forecast_horizon_seconds",
+    "The forecast plane's current prediction horizon: the base horizon "
+    "stretched by the diurnal trend slope (plane.horizon_for) — a "
+    "ramping cluster looks further ahead")
+forecast_error_fraction = SCHEDULER.gauge(
+    "forecast_error_fraction",
+    "Forecast error of the previous prediction window, per resource "
+    "dimension (label: dim): sum|predicted - realized peak| / "
+    "sum(realized peak) over nodes that saw usage")
+forecast_admission_reserved_fraction = SCHEDULER.gauge(
+    "forecast_admission_reserved_fraction",
+    "Fraction of cluster allocatable the predictive-admission reserve "
+    "charged into the last forecast round's filter/score accounting "
+    "(forecast growth not yet visible in observed usage)")
+forecast_evictions_prestaged = SCHEDULER.counter(
+    "forecast_evictions_prestaged_total",
+    "Reservation-first migrations pre-staged off nodes FORECAST to "
+    "cross the LowNodeLoad high threshold (proactive rebalance) — "
+    "each one is a reactive emergency eviction that never had to "
+    "happen")
+
 be_suppress_cpu_cores = KOORDLET.gauge(
     "be_suppress_cpu_cores", "CPU cores currently allowed for BE")
 pod_eviction_total = KOORDLET.counter(

@@ -782,6 +782,28 @@ def sharded_gang_assign(mesh, state, pods, cfg, gangs, quota=None,
     return fn(state, pods, cfg, gangs, quota)
 
 
+# koordlint: shape[state: NxR i32 nodes, reserve: NxR i32 nodes]
+def sharded_forecast_gang_assign(mesh, state, reserve, pods, cfg, gangs,
+                                 quota=None, passes: int = 2,
+                                 solver: str = "greedy", k: int = 32,
+                                 rounds: int = 12, spread_bits=(5, 15)):
+    """:func:`sharded_gang_assign` with the forecast-headroom reserve
+    charged for the duration of the solve — the sharded twin of
+    ``forecast/kernels.forecast_gang_assign``.
+
+    The charge and release are elementwise over the node axis, so both
+    stay on each shard's slice under the state's NamedSharding (the
+    plane pins its reserve under the same placement); the inner solve
+    is the unchanged shard_map program, so acceptance decisions are
+    bit-identical to the single-device forecast entry."""
+    charged = state.replace(node_requested=state.node_requested + reserve)
+    a, new_state, new_quota = sharded_gang_assign(
+        mesh, charged, pods, cfg, gangs, quota, passes=passes,
+        solver=solver, k=k, rounds=rounds, spread_bits=spread_bits)
+    return a, new_state.replace(
+        node_requested=new_state.node_requested - reserve), new_quota
+
+
 def sharded_greedy_assign(mesh, state, pods, cfg, quota=None):
     """``ops/assignment.greedy_assign`` over the mesh as one explicit
     shard_map kernel: the sequential scan keeps its exact pod order

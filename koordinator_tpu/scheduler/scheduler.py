@@ -151,6 +151,10 @@ class RoundHandle:
     #: iteration count and pre-solve slack sums (None = not a quality
     #: round)
     quality: dict | None = None
+    #: the forecast-headroom reserve charged into this round's solve
+    #: (ISSUE 15; None = not a forecast round).  NOT donated — the host
+    #: half's rescue pass re-charges the same tensor.
+    forecast_reserve: object = None
     start_wall: float = 0.0
     t0: float = 0.0
 
@@ -193,6 +197,7 @@ class Scheduler:
         solver_kit=None,
         quality_mode: str = "off",
         quality_slack_threshold: float = 0.3,
+        forecast_mode: str = "off",
     ):
         self.snapshot = snapshot
         self.config = config if config is not None else ScoringConfig.default()
@@ -336,6 +341,24 @@ class Scheduler:
         metrics.solver_quality_mode.set(
             float(QUALITY_MODES.index(quality_mode)),
             labels=self._tl())
+
+        # -- forecast plane (ISSUE 15) --
+        #: "off" = today's solve exactly (the forecast entries are never
+        #: called — bit-identical acceptance decisions and quota
+        #: charges); "admit" = the forecast-headroom reserve charges
+        #: into every eligible round's filter/score accounting; "full" =
+        #: admission plus the colocation/rebalance drivers armed at
+        #: assembly.  The plane itself attaches separately
+        #: (attach_forecast_plane) — a mode without a plane is inert.
+        from koordinator_tpu.forecast import FORECAST_MODES
+
+        if forecast_mode not in FORECAST_MODES:
+            raise ValueError(f"unknown forecast_mode {forecast_mode!r}; "
+                             f"one of {FORECAST_MODES}")
+        self.forecast_mode = forecast_mode
+        self.forecast_plane = None
+        self._forecast_solve = self.kit.forecast_solve
+        self._forecast_solve_sh = self.kit.forecast_solve_sh
         #: per-round admission cap (tenancy weighted-fair admission sets
         #: it per cycle; None = admit the whole active queue).  Applied
         #: in priority order AFTER the PreEnqueue gates, so a capped
@@ -502,6 +525,30 @@ class Scheduler:
         SLO sampler thread when one is running."""
         if self.slo_monitor is not None:
             self.slo_monitor.stop()
+
+    def attach_forecast_plane(self, plane) -> None:
+        """Install the forecast plane (forecast/plane.ForecastPlane):
+        grown to the snapshot's capacity and pinned under the solver
+        mesh's node sharding when one is active, so the admission
+        reserve and the charged solve never reshard.  The round prelude
+        feeds it (observe + cadenced refresh) whenever
+        ``forecast_mode != "off"``."""
+        with self.lock:
+            if plane.capacity < self.snapshot.capacity:
+                plane.grow(self.snapshot.capacity)
+            if self.mesh is not None and self.snapshot.solver_sharding_active:
+                plane.set_sharding(self.kit.node_sharding)
+            plane.metric_labels = dict(self._tl() or {})
+            self.forecast_plane = plane
+
+    def _forecast_reserve(self):  # koordlint: guarded-by(self.lock)
+        """The round's (N, R) forecast-headroom reserve, or None when
+        forecasting is off / the plane is absent or not yet refreshed —
+        the predicate every forecast branch keys on, so ``off`` never
+        touches a forecast entry."""
+        if self.forecast_mode == "off" or self.forecast_plane is None:
+            return None
+        return self.forecast_plane.admission_reserve(self.snapshot.state)
 
     # -- registration -------------------------------------------------------
 
@@ -1526,6 +1573,13 @@ class Scheduler:
         # standby/barrier-gated rounds above do not
         self._round_recordable = True
         self._staleness_tick(now)
+        if self.forecast_mode != "off" and self.forecast_plane is not None:
+            # feed the forecast plane from the freshly-flushed usage
+            # tensor (pre-dispatch: the state buffers are live) and
+            # refresh predictions on the plane's own cadence
+            self.snapshot.flush()
+            self.forecast_plane.observe_state(self.snapshot.state)
+            self.forecast_plane.maybe_refresh()
         result = handle.result
         self.last_result = result  # debug-API diagnosis surface
         if len(self.reservations):
@@ -1623,6 +1677,15 @@ class Scheduler:
             solver = ("batch" if len(pods) >= self.batch_solver_threshold
                       else "greedy")
             self.last_solver = solver
+            # forecast path (ISSUE 15): an active forecast round solves
+            # with the headroom reserve charged into the accounting for
+            # the duration of the solve.  The reserve re-shapes every
+            # node's visible free capacity, so the incremental candidate
+            # cache (scored against UNcharged state) and the quality
+            # escalation latch (slack measured without the reserve) both
+            # stand down — forecast rounds take the full charged path.
+            forecast_reserve = self._forecast_reserve()
+            handle.forecast_reserve = forecast_reserve
             # quality path (ISSUE 13): an escalated gangless round
             # solves with the LP-relaxation packing engine instead of
             # the greedy propose/accept rounds.  Gang rounds keep the
@@ -1630,7 +1693,8 @@ class Scheduler:
             # quality mode reaches them through the topology planner
             # in _apply_topology_plans instead).
             use_quality = (
-                not gang_index
+                forecast_reserve is None
+                and not gang_index
                 and (self.quality_mode == "lp"
                      or (self.quality_mode == "auto"
                          and self._quality_escalate)))
@@ -1640,6 +1704,7 @@ class Scheduler:
             # solver — and DEGRADED rounds, whose cache was built from
             # a stalled feed — keep the one-call full path
             use_inc = (not use_quality
+                       and forecast_reserve is None
                        and solver == "batch" and self.incremental_solve
                        and not self.degraded
                        and not gang_index
@@ -1681,19 +1746,30 @@ class Scheduler:
             else:
                 if solver == "batch":
                     self.last_solve_path = (
-                        "full_gang" if gang_index
+                        "forecast_full" if forecast_reserve is not None
+                        else "full_gang" if gang_index
                         else "full_dense" if batch.selector_mask is None
                         else "degraded" if self.degraded
                         else "disabled")
                     metrics.incremental_solve_total.inc(labels={
                         "path": self.last_solve_path})
-                solve_fn = (self._solve_sh
-                            if self._use_sharded_solve(batch)
-                            else self._solve)
-                assignments, new_state, new_quota = solve_fn(
-                    self.snapshot.state, batch, self.config, gangs, quota,
-                    passes=self.gang_passes, solver=solver,
-                )
+                if forecast_reserve is not None:
+                    solve_fn = (self._forecast_solve_sh
+                                if self._use_sharded_solve(batch)
+                                else self._forecast_solve)
+                    assignments, new_state, new_quota = solve_fn(
+                        self.snapshot.state, forecast_reserve, batch,
+                        self.config, gangs, quota,
+                        passes=self.gang_passes, solver=solver,
+                    )
+                else:
+                    solve_fn = (self._solve_sh
+                                if self._use_sharded_solve(batch)
+                                else self._solve)
+                    assignments, new_state, new_quota = solve_fn(
+                        self.snapshot.state, batch, self.config, gangs,
+                        quota, passes=self.gang_passes, solver=solver,
+                    )
                 # the blessed swap: the jitted solve donated the old
                 # state buffers; the snapshot re-points at the in-flight
                 # result immediately so nothing can read the dead ones
@@ -1803,13 +1879,28 @@ class Scheduler:
                     # the padded capacity invalid).
                     small, idx = batch.replace(gang_id=rescue_gid).compact(
                         leftover)
-                    rescue_fn = (self._solve_sh
-                                 if self._use_sharded_solve(small)
-                                 else self._solve)
-                    r_small, new_state, new_quota = rescue_fn(
-                        new_state, small, self.config, gangs, new_quota,
-                        passes=self.gang_passes, solver="greedy",
-                    )
+                    if handle.forecast_reserve is not None:
+                        # a forecast round's rescue must see the SAME
+                        # charged accounting as its main solve — an
+                        # uncharged rescue would re-admit exactly the
+                        # pods the reserve just filtered
+                        rescue_fn = (self._forecast_solve_sh
+                                     if self._use_sharded_solve(small)
+                                     else self._forecast_solve)
+                        r_small, new_state, new_quota = rescue_fn(
+                            new_state, handle.forecast_reserve, small,
+                            self.config, gangs, new_quota,
+                            passes=self.gang_passes, solver="greedy",
+                        )
+                    else:
+                        rescue_fn = (self._solve_sh
+                                     if self._use_sharded_solve(small)
+                                     else self._solve)
+                        r_small, new_state, new_quota = rescue_fn(
+                            new_state, small, self.config, gangs,
+                            new_quota,
+                            passes=self.gang_passes, solver="greedy",
+                        )
                     self.snapshot.state = new_state
                     r_full = np.full(batch.capacity, -1, np.int32)
                     r_full[idx] = np.asarray(
@@ -1835,6 +1926,17 @@ class Scheduler:
         with self.monitor.phase("Reserve"):
             self.snapshot.adopt_state(new_state,
                                       changed_rows=np.unique(a[a >= 0]))
+        if (handle.forecast_reserve is not None
+                and self.forecast_plane is not None):
+            # one small (R,) device reduction per FORECAST round (the
+            # off mode never pays it): how much of the cluster the
+            # admission reserve held back this round.  Tenant-labelled
+            # like every scheduler gauge — per-tenant planes must not
+            # overwrite each other's telemetry.
+            metrics.forecast_admission_reserved_fraction.set(
+                self.forecast_plane.reserve_fraction(
+                    handle.forecast_reserve, self.snapshot.state),
+                labels=self._tl())
 
         with self.monitor.phase("Bind"):
             placed_gangs: set[str] = set()

@@ -89,6 +89,42 @@ def debug_steady_body(scheduler, params: dict | None = None) -> dict:
     return body
 
 
+def debug_forecast_body(scheduler, params: dict | None = None) -> dict:
+    """The /debug/forecast payload (shared by DebugService and the HTTP
+    gateway): the forecast plane's horizon policy, prediction-error
+    stats, and per-node predicted peaks — plus the scheduler's mode and
+    the last admission-reserve fraction.
+
+    ``?nodes=N`` bounds the per-node section (default 64, ordered by
+    predicted CPU peak — the nodes the plane is about to act on).
+    Typed 501 without a plane (forecast mode off / non-scheduler
+    binaries), 400 on a malformed bound."""
+    plane = getattr(scheduler, "forecast_plane", None)
+    if plane is None:
+        raise DebugApiError(501, "no forecast plane attached "
+                                 "(--forecast-mode off or non-scheduler "
+                                 "binary)")
+    nodes = (params or {}).get("nodes", 64)
+    try:
+        nodes = int(nodes)
+    except (TypeError, ValueError):
+        raise DebugApiError(400, "nodes must be an integer") from None
+    if nodes < 0:
+        raise DebugApiError(400, "nodes must be >= 0")
+    snapshot = getattr(scheduler, "snapshot", None)
+    row_names = ({row: name for name, row in snapshot.node_index.items()}
+                 if snapshot is not None else None)
+    # the reserve fraction rides the plane's report (per-plane state —
+    # a shared global gauge would cross tenants' planes)
+    body = plane.report(max_nodes=nodes, row_names=row_names)
+    body["mode"] = getattr(scheduler, "forecast_mode", "off")
+    from koordinator_tpu import metrics
+
+    body["evictions_prestaged_total"] = sum(
+        v for _, v in metrics.forecast_evictions_prestaged.items())
+    return body
+
+
 def debug_tenants_body(scheduler) -> dict:
     """The /debug/tenants payload (shared by DebugService and the HTTP
     gateway): the multi-tenant front-end's rollup — per-tenant
@@ -279,6 +315,7 @@ class DebugService:
         self.register("/debug/rounds", self._rounds)
         self.register("/debug/slo", self._slo)
         self.register("/debug/steady", self._steady)
+        self.register("/debug/forecast", self._forecast)
         self.register("/debug/tenants", self._tenants)
         self.register("/debug/profile", self._profile)
         self.register_prefix("/debug/trace/", self._trace)
@@ -381,6 +418,12 @@ class DebugService:
         """The trend engine's steady-state verdicts (/debug/steady,
         ?window=N overrides the evaluation window)."""
         return debug_steady_body(self.scheduler, params)
+
+    def _forecast(self, params: dict) -> object:
+        """The forecast plane's horizon/error/per-node-peak document
+        (/debug/forecast, ?nodes=N bounds the node section); typed 501
+        without a plane."""
+        return debug_forecast_body(self.scheduler, params)
 
     def _tenants(self, params: dict) -> object:
         """The multi-tenant rollup (/debug/tenants): per-tenant

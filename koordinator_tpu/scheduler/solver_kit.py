@@ -237,6 +237,38 @@ class SolverKit:
         #: planner report
         self.topo_diameter = jax.jit(gang_topo_diameter)
 
+        # -- forecast plane (ISSUE 15): predictive admission — the
+        # gang/greedy solve with the forecast-headroom reserve charged
+        # for the round (charge -> solve -> release inside ONE jitted
+        # program; forecast/kernels).  Donation mirrors gang_assign:
+        # arg0 (the snapshot state) is consumed and replaced by the
+        # blessed swap; the (N, R) reserve at arg1 stays live for the
+        # host half's rescue pass.
+        from koordinator_tpu.forecast.kernels import forecast_gang_assign
+
+        def _fpn(args, kwargs):
+            return (f"P{args[2].capacity}xN{args[0].capacity}"
+                    f"{_sfx(args[0].capacity)}")
+
+        # koordlint: shape[arg0: NxR i32 nodes, arg1: NxR i32 nodes]
+        self.forecast_solve = insp.instrument(
+            jax.jit(forecast_gang_assign,
+                    static_argnames=("passes", "solver"),
+                    donate_argnums=(0,)),
+            "forecast_gang_assign", shape_of=_fpn)
+        self.forecast_solve_sh = None
+        if self.mesh is not None:
+            from functools import partial as _fpartial
+
+            # koordlint: shape[arg0: NxR i32 nodes, arg1: NxR i32 nodes]
+            self.forecast_solve_sh = insp.instrument(
+                jax.jit(_fpartial(psharded.sharded_forecast_gang_assign,
+                                  self.mesh),
+                        static_argnames=("passes", "solver", "k",
+                                         "rounds", "spread_bits"),
+                        donate_argnums=(0,)),
+                "forecast_gang_assign", shape_of=_fpn)
+
         self.rsv_solve = insp.instrument(
             jax.jit(reservation_greedy_assign, donate_argnums=(0,)),
             "reservation_greedy_assign", shape_of=_pn)

@@ -492,6 +492,13 @@ class TenantScheduler:
                     # pipelined per-tenant dispatch (bit-identical to
                     # standalone execution; tests/test_quality.py)
                     or sched.quality_mode != "off"
+                    # a forecast-mode tenant charges its admission
+                    # reserve in _round_dispatch, which the batched
+                    # select+pass1 program bypasses — its cycle keeps
+                    # the per-tenant dispatch path (same reasoning as
+                    # quality mode)
+                    or (sched.forecast_mode != "off"
+                        and sched.forecast_plane is not None)
                     or (sched.mesh is not None
                         and sched.snapshot.solver_sharding_active)):
                 return False

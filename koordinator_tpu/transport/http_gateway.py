@@ -41,6 +41,11 @@ Routes (all JSON bodies/responses unless noted):
                                           leaking verdicts per watched
                                           series, joined to SLO breach
                                           state (scheduler binaries)
+    GET  /debug/forecast?nodes=N       -> the forecast plane's horizon
+                                          policy, prediction-error
+                                          stats, and per-node predicted
+                                          peaks (501 without a plane —
+                                          forecast mode off)
     GET  /debug/tenants                -> multi-tenant rollup: per-
                                           tenant weight/share/credit,
                                           queue depth, degraded state,
@@ -196,6 +201,8 @@ class HttpGateway:
             return self._debug_slo(req)
         if method == "GET" and path == "/debug/steady":
             return self._debug_steady(req)
+        if method == "GET" and path == "/debug/forecast":
+            return self._debug_forecast(req)
         if method == "GET" and path == "/debug/tenants":
             return self._debug_tenants(req)
         if method == "GET" and path == "/debug/profile":
@@ -352,6 +359,26 @@ class HttpGateway:
         try:
             return req._reply(200, debug_steady_body(self.scheduler,
                                                      params))
+        except DebugApiError as e:
+            return req._reply(e.status, {"error": e.message})
+
+    def _debug_forecast(self, req) -> None:
+        """The forecast plane's horizon/error/per-node-peak document —
+        same body the DebugService serves (shared builder; ?nodes=N
+        bounds the node section, typed 501 without a plane)."""
+        if self.scheduler is None:
+            return req._reply(501, {"error": "no scheduler attached"})
+        from urllib.parse import parse_qsl
+
+        from koordinator_tpu.scheduler.services import (
+            DebugApiError,
+            debug_forecast_body,
+        )
+
+        params = dict(parse_qsl(req.path.partition("?")[2]))
+        try:
+            return req._reply(200, debug_forecast_body(self.scheduler,
+                                                       params))
         except DebugApiError as e:
             return req._reply(e.status, {"error": e.message})
 

@@ -221,6 +221,41 @@ class TestDtypeRegimeCorpus:
             corpus("dtype_regime", "good", ("pkg",))) == []
 
 
+class TestForecastCorpus:
+    """The forecast kernels' seeded corpus (ISSUE 15): jit-host-sync on
+    the horizon scalar, mesh-discipline on the sharded percentile —
+    the two regressions forecast/kernels.py must never grow."""
+
+    def sync_analyzer(self):
+        return JitHostSyncAnalyzer(package="pkg",
+                                   root_paths=["pkg/kernels.py"])
+
+    def mesh_analyzer(self):
+        return MeshDisciplineAnalyzer(package="pkg")
+
+    def test_bad_corpus_flags_horizon_host_syncs(self):
+        findings = self.sync_analyzer().run(
+            corpus("forecast", "bad", ("pkg",)))
+        messages = "\n".join(f.message for f in findings)
+        assert "host cast float()" in messages       # float(horizon)
+        assert "host cast int()" in messages         # int(horizon // 60)
+        assert "data-dependent branch" in messages   # if growth > 0
+        assert len(findings) == 3
+
+    def test_bad_corpus_flags_sharded_percentile_specs(self):
+        findings = self.mesh_analyzer().run(
+            corpus("forecast", "bad", ("pkg",)))
+        messages = "\n".join(f.message for f in findings)
+        assert "omits in_specs and out_specs" in messages
+        assert "has no explicit in_spec" in messages  # donated bank
+        assert len(findings) == 2
+
+    def test_good_corpus_is_clean(self):
+        project = corpus("forecast", "good", ("pkg",))
+        assert self.sync_analyzer().run(project) == []
+        assert self.mesh_analyzer().run(project) == []
+
+
 class TestSpecConsistencyCorpus:
     def analyzer(self):
         return SpecConsistencyAnalyzer(package="pkg")

@@ -1,0 +1,45 @@
+"""Seeded known-BAD corpus for the forecast kernels (ISSUE 15).
+
+Two bug classes the real ``forecast/kernels.py`` must never regress
+into:
+
+- **jit-host-sync on the horizon scalar**: the prediction horizon and
+  the trend growth rate ride as device scalars end to end; a host cast
+  (``float(horizon)``), a step count (``int(horizon // 60)``) or a
+  data-dependent branch on the slope inside the jitted flow is a
+  silent device sync per refresh.
+- **mesh-discipline on the sharded percentile**: the bank's shard_map
+  must carry explicit in/out specs, and the donated bank position must
+  have a literal spec entry — inferred placement turns the in-place
+  bank update into a reshard-and-copy.
+"""
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def predicted_peaks(weights, total, horizon, growth):
+    h = float(horizon)                    # BAD: host cast of the horizon
+    steps = int(horizon // 60)            # BAD: host cast of the horizon
+    peak = jnp.max(weights, axis=1) * (total + steps)
+    if growth > 0:                        # BAD: data-dependent branch
+        peak = peak * (1.0 + growth * h / 3600.0)
+    return peak
+
+
+predicted_peaks_jit = jax.jit(predicted_peaks)
+
+
+def sharded_percentile_no_specs(mesh, f, weights):
+    # BAD: the sharded percentile's placement left to inference
+    return shard_map(f, mesh=mesh)(weights)
+
+
+def sharded_bank_update_donated_unspecced(mesh, f, weights, samples):
+    # BAD: the donated bank position has no explicit in_spec entry
+    fn = jax.jit(
+        shard_map(f, mesh=mesh, in_specs=(None, P()),
+                  out_specs=P("nodes")),
+        donate_argnums=(0,))
+    return fn(weights, samples)

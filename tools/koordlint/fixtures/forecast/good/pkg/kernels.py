@@ -1,0 +1,39 @@
+"""Disciplined twins of the forecast bad corpus (must-pass).
+
+The horizon/growth scalars stay device-side through the whole jitted
+flow (``jnp.where`` instead of a host branch, multiplicative math
+instead of host step counts), and the sharded percentile carries
+explicit specs with the donated bank position covered.
+"""
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def predicted_peaks(weights, total, horizon, growth):
+    # the horizon stays a traced scalar: extrapolation is pure device
+    # math, and the falling-trend clamp is a where, not a branch
+    peak = jnp.max(weights, axis=1) * total
+    stretch = 1.0 + jnp.maximum(growth, 0.0) * (horizon / 3600.0)
+    return peak * stretch
+
+
+predicted_peaks_jit = jax.jit(predicted_peaks)
+
+
+def sharded_percentile(mesh, f, weights):
+    # explicit placement: the bank shards its node axis, the result
+    # comes back node-sharded
+    return shard_map(f, mesh=mesh, in_specs=(P("nodes"),),
+                     out_specs=P("nodes"))(weights)
+
+
+def sharded_bank_update(mesh, f, weights, samples):
+    # the donated bank position carries a literal spec entry, so the
+    # in-place update survives placement
+    fn = jax.jit(
+        shard_map(f, mesh=mesh, in_specs=(P("nodes"), P()),
+                  out_specs=P("nodes")),
+        donate_argnums=(0,))
+    return fn(weights, samples)

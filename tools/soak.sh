@@ -65,6 +65,20 @@
 #                                     quality_rounds_total must be
 #                                     nonzero — both enforced by
 #                                     soak_report's exit status
+#         SOAK_FORECAST (default 0)   1 = end the run with the
+#                                     reactive-vs-predictive A/B smoke
+#                                     (tools/soak_report.py --forecast):
+#                                     both arms replay ONE seeded
+#                                     diurnal trace (forecast/ab.py),
+#                                     the per-arm scorecard prints
+#                                     (SLO-breach minutes, reactive
+#                                     evictions, pre-staged
+#                                     migrations, forecast error), and
+#                                     the soak FAILS unless the
+#                                     predictive arm is no worse on
+#                                     breaches and evictions and
+#                                     pre-staged at least one
+#                                     migration
 #         SOAK_CHAOS   (default 0)    1 = also sweep the chaos
 #                                     fault-injection suite (tests/
 #                                     test_chaos.py, `chaos` marker)
@@ -85,6 +99,7 @@ OUT=${SOAK_OUT:-soak_results}
 CHAOS=${SOAK_CHAOS:-0}
 LOADGEN=${SOAK_LOADGEN:-0}
 QUALITY=${SOAK_QUALITY:-0}
+FORECAST=${SOAK_FORECAST:-0}
 TRACE=${SOAK_TRACE:-0}
 SLO=${SOAK_SLO:-1}
 EXPLAIN=${SOAK_EXPLAIN:-1}
@@ -266,6 +281,24 @@ if [ "$QUALITY" = "1" ]; then
         total_failed=$((total_failed + 1))
         failures="$failures;quality smoke: red verdict or zero quality"
         failures="$failures rounds (see log)"
+    fi
+fi
+
+if [ "$FORECAST" = "1" ]; then
+    # forecast A/B smoke BEFORE the tally so its verdict counts in the
+    # JSON: the reactive and predictive arms replay one seeded diurnal
+    # trace; the predictive arm must be no worse on SLO-breach minutes
+    # and reactive evictions AND must have pre-staged at least one
+    # reservation-first migration (both enforced by soak_report's exit)
+    echo "== forecast A/B smoke (soak_report --forecast)" | tee -a "$log"
+    if python tools/soak_report.py --forecast >> "$log" 2>&1; then
+        grep -E "^(== forecast|-- forecast|   |VERDICT)" "$log" | tail -9
+        total_passed=$((total_passed + 1))
+    else
+        tail -12 "$log"
+        total_failed=$((total_failed + 1))
+        failures="$failures;forecast A/B: predictive arm worse than"
+        failures="$failures reactive or zero prestaged migrations (see log)"
     fi
 fi
 

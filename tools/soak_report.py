@@ -120,6 +120,45 @@ def print_report(verdict: dict, harness) -> None:
     print(f"VERDICT: {'GREEN' if verdict['green'] else 'RED'}")
 
 
+def forecast_ab_report(args) -> int:
+    """The reactive-vs-predictive A/B scorecard (SOAK_FORECAST=1 /
+    --forecast): one seeded diurnal trace through both arms, GREEN only
+    when the predictive arm is no worse on breaches AND evictions and
+    the proactive path actually ran (a predictive soak that never
+    pre-staged a migration proves nothing about rebalance)."""
+    from koordinator_tpu.forecast.ab import ABConfig, run_ab
+
+    cfg = ABConfig(seed=args.seed)
+    if args.nodes is not None:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, nodes=args.nodes)
+    doc = run_ab(cfg)
+    print(f"== forecast A/B: seed={doc['seed']} nodes={doc['nodes']} "
+          f"ticks={doc['ticks']} period={doc['period_s']:.0f}s "
+          f"(one trace, two arms)")
+    print(f"-- forecast {'metric':<26} {'reactive':>10} {'predictive':>11}")
+    r, p = doc["reactive"], doc["predictive"]
+    for key in ("slo_breach_minutes", "reactive_evictions",
+                "be_pod_ticks", "prestaged_migrations",
+                "migrations_completed"):
+        print(f"   {key:<34} {r[key]:>10} {p[key]:>11}")
+    err = ", ".join(f"{k}={v}" for k, v in
+                    p.get("forecast_error_fraction", {}).items()) or "-"
+    print(f"   {'forecast_error_fraction':<34} {'-':>10} {err:>11}")
+    print(f"   {'horizon_s':<34} {'-':>10} "
+          f"{p.get('horizon_s', 0.0):>11}")
+    if args.json:
+        print(json.dumps(doc, indent=2, default=str))
+    green = doc["predictive_no_worse"] and p["prestaged_migrations"] > 0
+    print(f"VERDICT: {'GREEN' if green else 'RED'}"
+          + ("" if doc["predictive_no_worse"] else
+             " (predictive arm WORSE than reactive)")
+          + ("" if p["prestaged_migrations"] > 0 else
+             " (zero pre-staged migrations — rebalance never ran)"))
+    return 0 if green else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="soak_report")
     parser.add_argument("--seed", type=int, default=0)
@@ -160,9 +199,22 @@ def main(argv: list[str] | None = None) -> int:
                         default=0.3,
                         help="auto-mode escalation bar (see the "
                              "scheduler's --quality-slack-threshold)")
+    parser.add_argument("--forecast", action="store_true",
+                        help="run the reactive-vs-predictive A/B smoke "
+                             "instead of the churn soak: both arms "
+                             "replay ONE seeded diurnal trace "
+                             "(forecast/ab.py), the per-arm scorecard "
+                             "prints, and the exit is GREEN only if "
+                             "the predictive arm is no worse on "
+                             "SLO-breach minutes and reactive "
+                             "evictions — and actually pre-staged "
+                             "at least one migration")
     parser.add_argument("--json", action="store_true",
                         help="dump the raw verdict document too")
     args = parser.parse_args(argv)
+
+    if args.forecast:
+        return forecast_ab_report(args)
 
     cfg = loadgen.smoke_config(seed=args.seed, tenants=args.tenants)
     overrides = {}
