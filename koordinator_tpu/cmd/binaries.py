@@ -46,11 +46,16 @@ class Assembled:
     #: every binary registers the same leak-watch gauges under its own
     #: binary label
     telemetry: Optional[Any] = None
+    #: warm-restart checkpoint writer (drills.checkpoint.CheckpointWriter)
+    #: when --checkpoint-path is set; stop() writes a final cut
+    checkpointer: Optional[Any] = None
 
     def stop(self) -> None:
         """Tear down whatever this binary opened (sockets, gateway, the
         component's own lifecycle); a leading elector releases its lease
         so a follower acquires without waiting out the duration."""
+        if self.checkpointer is not None:
+            self.checkpointer.stop()
         if self.telemetry is not None:
             self.telemetry.stop()
         if self.elector is not None:
@@ -95,7 +100,7 @@ class ReconnectingSidecarClient:
 
     def __init__(self, addr: str, on_push=None, on_connect=None,
                  timeout: float = 10.0, breaker=None, retry_policy=None,
-                 faults=None):
+                 faults=None, fault_domain: str = ""):
         import threading
 
         from koordinator_tpu.transport.retry import CircuitBreaker
@@ -105,12 +110,20 @@ class ReconnectingSidecarClient:
         self.on_connect = on_connect
         self.timeout = timeout
         self.faults = faults
+        self.fault_domain = fault_domain
         #: pass breaker=False to disable pacing entirely (tests that
         #: want a dial per call); None builds the shared default
         self.breaker = (None if breaker is False
                         else breaker if breaker is not None
                         else CircuitBreaker(target=addr,
                                             policy=retry_policy))
+        if self.faults is not None and self.breaker is not None:
+            # heal seam: FaultInjector.heal() resets the breaker so the
+            # healed sidecar is probed immediately, not after the
+            # remaining (chaos-grown) open window
+            register = getattr(self.faults, "register_breaker", None)
+            if register is not None:
+                register(self.breaker)
         self.resyncs = 0
         self._client = None
         self._lock = threading.Lock()
@@ -131,7 +144,8 @@ class ReconnectingSidecarClient:
                 self._close_locked()
                 client = RpcClient(self.addr, on_push=self.on_push,
                                    timeout=self.timeout,
-                                   faults=self.faults)
+                                   faults=self.faults,
+                                   fault_domain=self.fault_domain)
                 try:
                     client.connect()
                 except OSError as e:
@@ -645,6 +659,17 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
         "--profile-dir", default="",
         help="directory for /debug/profile trace captures (default: a "
              "fresh temp dir per capture)")
+    parser.add_argument(
+        "--checkpoint-path", default="",
+        help="warm-restart checkpoint file (docs/robustness.md): "
+             "restored on boot when present, rewritten every "
+             "--checkpoint-interval-seconds and once on stop; empty "
+             "disables checkpointing (behavior is bit-identical either "
+             "way — the checkpoint is host state + the replay cursor, "
+             "never solver state)")
+    parser.add_argument(
+        "--checkpoint-interval-seconds", type=float, default=30.0,
+        help="cadence of the background checkpoint writer")
     return parser
 
 
@@ -887,13 +912,27 @@ def main_koord_scheduler(argv: list[str],
                               state_sync=sync_service,
                               lease_store=shared_lease_store)
         gateway.start()
+    checkpointer = None
+    if args.checkpoint_path:
+        import os as _os
+
+        from koordinator_tpu.drills import checkpoint as _ckpt
+
+        if _os.path.exists(args.checkpoint_path):
+            # warm restart: restore the host-side cut before any state
+            # arrives, so informer replay / remote deltas land on the
+            # restored generations instead of re-placing the world
+            _ckpt.restore(args.checkpoint_path, scheduler)
+        checkpointer = _ckpt.CheckpointWriter(
+            args.checkpoint_path, scheduler,
+            interval_s=args.checkpoint_interval_seconds).start()
     return Assembled(name="koord-scheduler", args=args,
                      component=(tenant_front if tenant_front is not None
                                 else scheduler),
                      elector=elector, server=server,
                      gateway=gateway, state_sync=sync_service,
                      component_config=component_config,
-                     telemetry=telemetry)
+                     telemetry=telemetry, checkpointer=checkpointer)
 
 
 # ---- koord-manager ---------------------------------------------------------

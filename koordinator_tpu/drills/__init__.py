@@ -1,0 +1,48 @@
+"""Adversarial failure drills: deterministic, seeded, multi-phase
+scenarios against the full socket stack with machine-checkable
+verdicts (docs/robustness.md).
+
+- :mod:`scenarios` — the drill catalog as declarative data;
+- :mod:`engine` — the orchestrator (replicas, feeders, manager, churn,
+  virtual clock, fixpoint + RTO measurement);
+- :mod:`verdict` — the per-drill check taxonomy;
+- :mod:`checkpoint` — the scheduler's warm-restart snapshot (save /
+  restore / delta catch-up).
+"""
+
+from koordinator_tpu.drills.checkpoint import (
+    CHECKPOINT_VERSION,
+    CheckpointWriter,
+    capture,
+    restore,
+    restore_into,
+    save,
+)
+from koordinator_tpu.drills.engine import DrillHarness, run_all, run_drill
+from koordinator_tpu.drills.scenarios import (
+    SCENARIOS,
+    DrillEvent,
+    Phase,
+    Scenario,
+    churn_trace,
+)
+from koordinator_tpu.drills.verdict import Check, DrillVerdict
+
+__all__ = [
+    "CHECKPOINT_VERSION",
+    "Check",
+    "CheckpointWriter",
+    "DrillEvent",
+    "DrillHarness",
+    "DrillVerdict",
+    "Phase",
+    "SCENARIOS",
+    "Scenario",
+    "capture",
+    "churn_trace",
+    "restore",
+    "restore_into",
+    "run_all",
+    "run_drill",
+    "save",
+]

@@ -1,0 +1,242 @@
+"""Drill scenarios as data: each drill is a declarative phase list
+(warmup → inject → hold → heal → verify) whose actions the engine
+interprets — replayable from one seed, diffable in review, and
+composable without touching engine code.
+
+Phase taxonomy (docs/robustness.md "Drill catalog"):
+
+- ``warmup``  — fault-free: nodes register, the jit cache warms, the
+  thread/fd baseline is taken at the end;
+- ``inject``  — the adversarial event fires (storm/kill/restart/reorg);
+- ``hold``    — the system runs *with* the failure: churn continues,
+  probabilistic chaos stays on, invariants are live-checked;
+- ``heal``    — faults end (``FaultInjector.heal()``), dead components
+  restart;
+- ``verify``  — fault-free reconvergence window; the verdict engine's
+  fixpoint clock runs here.
+
+Durations are VIRTUAL seconds: the engine compresses them by its
+``time_scale``, and the churn trace + storm schedules are evaluated on
+the same virtual clock, so one seed replays identically at any
+compression.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+from koordinator_tpu.transport.faults import PARTITION
+
+#: loadgen-compatible event kinds (tools/loadgen.py uses the same
+#: strings; DrillHarness accepts either generator's events duck-typed)
+POD_ADD = "pod_add"
+POD_DEL = "pod_del"
+GANG_BURST = "gang_burst"
+QUOTA_UPDATE = "quota_update"
+
+
+@dataclasses.dataclass(frozen=True)
+class DrillEvent:
+    t: float
+    kind: str
+    name: str
+    payload: dict
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase:
+    """One drill phase; ``actions`` fire at phase START, ``chaos``
+    keeps the probabilistic injector enabled for the phase's span."""
+
+    name: str
+    duration_s: float
+    actions: tuple = ()
+    chaos: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    name: str
+    description: str
+    phases: tuple
+    replicas: int = 2
+    racks: int = 2
+    tenants: tuple = ("t-a",)
+    with_manager: bool = True
+    #: verdict budgets (wall seconds / counts)
+    rto_budget_s: float = 60.0
+    degraded_budget_s: float = 30.0
+    slo_breach_budget: int = 10
+    expected_failovers: int = 0
+    #: churn_trace overrides (rate, del_fraction, gang_every_s, ...)
+    churn: dict = dataclasses.field(default_factory=dict)
+
+    def phase(self, name: str) -> Phase:
+        for p in self.phases:
+            if p.name == name:
+                return p
+        raise KeyError(name)
+
+
+def churn_trace(seed: int, duration_s: float, tenants=("t-a",),
+                rate: float = 1.2, del_fraction: float = 0.25,
+                gang_every_s: float = 6.0, gang_size: int = 3,
+                cpu: int = 1_000, memory: int = 1_024
+                ) -> list[DrillEvent]:
+    """Seeded churn load in the loadgen trace shape: Poisson pod
+    arrivals with exponential lifetimes, periodic gang bursts, tenants
+    round-robined.  Small by construction — every live pod must fit the
+    drill cluster so the reconvergence fixpoint is reachable."""
+    rng = random.Random(seed)
+    events: list[DrillEvent] = []
+    seq = 0
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate)
+        if t >= duration_s:
+            break
+        name = f"dp-{seed}-{seq}"
+        tenant = tenants[seq % len(tenants)]
+        seq += 1
+        events.append(DrillEvent(t, POD_ADD, name, {
+            "cpu": cpu, "memory": memory, "priority": 1000,
+            "quota": tenant, "tenant": tenant, "gang": None}))
+        if rng.random() < del_fraction:
+            events.append(DrillEvent(
+                t + rng.expovariate(1.0 / (duration_s / 3.0)),
+                POD_DEL, name, {"tenant": tenant}))
+    g = 0
+    tg = gang_every_s
+    while tg < duration_s and gang_every_s > 0:
+        tenant = tenants[g % len(tenants)]
+        events.append(DrillEvent(tg, GANG_BURST, f"dg-{seed}-{g}", {
+            "size": gang_size, "cpu": cpu, "memory": memory,
+            "priority": 1000, "quota": tenant, "tenant": tenant}))
+        g += 1
+        tg += gang_every_s
+    events.sort(key=lambda e: (e.t, e.kind, e.name))
+    return events
+
+
+def _storm(domains, mode=PARTITION):
+    return {"op": "storm", "domains": tuple(domains), "mode": mode}
+
+
+#: the drill catalog — every ISSUE-17 scenario, one seed replays each
+SCENARIOS: dict[str, Scenario] = {}
+
+
+def _register(s: Scenario) -> Scenario:
+    SCENARIOS[s.name] = s
+    return s
+
+
+LEADER_FAILOVER = _register(Scenario(
+    name="leader_failover",
+    description="Kill the lease-holding scheduler mid-trace: the warm "
+                "standby (shared jit cache) takes the lease and resumes "
+                "rounds; the dead replica restarts as the new standby.",
+    phases=(
+        Phase("warmup", 5.0),
+        Phase("inject", 0.5, actions=({"op": "kill_leader"},)),
+        # hold must outlast lease expiry + standby acquisition
+        # (LEASE_VS + RETRY_VS virtual seconds) with margin, so the
+        # failover happens while the dead leader is still dead
+        Phase("hold", 12.0, chaos=True),
+        Phase("heal", 0.5, actions=({"op": "heal"},
+                                    {"op": "restart_dead",
+                                     "restore": "snapshot"})),
+        Phase("verify", 8.0),
+    ),
+    replicas=2, expected_failovers=1))
+
+MANAGER_RESTART = _register(Scenario(
+    name="manager_restart",
+    description="Restart the manager mid-trace: its watch view "
+                "re-bootstraps over deltasync and the colocation loop "
+                "resumes pushing batch allocatable.",
+    phases=(
+        Phase("warmup", 5.0),
+        Phase("inject", 0.5, actions=({"op": "restart_manager"},)),
+        Phase("hold", 6.0, chaos=True),
+        Phase("heal", 0.5, actions=({"op": "heal"},)),
+        Phase("verify", 8.0),
+    ),
+    replicas=1))
+
+RACK_STORM = _register(Scenario(
+    name="rack_storm",
+    description="Correlated rack flap train: every connection in "
+                "rack:r0 is partitioned together, repeatedly — breaker "
+                "pacing and rv-gap resync both get exercised; the heal "
+                "seam must close breakers promptly.",
+    phases=(
+        Phase("warmup", 5.0),
+        Phase("inject", 0.5, actions=(
+            {"op": "flaps", "domains": ("rack:r0",),
+             "up_s": 1.0, "down_s": 1.0, "flaps": 3},)),
+        Phase("hold", 8.0, chaos=True),
+        Phase("heal", 0.5, actions=({"op": "heal"},)),
+        Phase("verify", 8.0),
+    ),
+    replicas=1))
+
+QUOTA_REORG = _register(Scenario(
+    name="quota_reorg",
+    description="Quota-tree reorg mid-flight: tenant maxes rescale "
+                "sharply down then restore — admission must follow the "
+                "live tree and no bound pod may double-free on the way "
+                "back.",
+    phases=(
+        Phase("warmup", 5.0),
+        Phase("inject", 0.5, actions=(
+            {"op": "quota_reorg", "scale": 0.25},)),
+        Phase("hold", 6.0, chaos=True),
+        Phase("heal", 0.5, actions=({"op": "heal"},
+                                    {"op": "quota_restore"},)),
+        Phase("verify", 8.0),
+    ),
+    replicas=1, tenants=("t-a", "t-b")))
+
+TENANT_SEVER = _register(Scenario(
+    name="tenant_sever",
+    description="Per-tenant socket sever: tenant t-b's control feeder "
+                "is partitioned (its pods stop arriving); tenant t-a "
+                "must keep scheduling unimpaired, and t-b's backlog "
+                "drains after heal.",
+    phases=(
+        Phase("warmup", 5.0),
+        Phase("inject", 0.5, actions=(_storm(("tenant:t-b",)),)),
+        Phase("hold", 6.0, chaos=True),
+        Phase("heal", 0.5, actions=({"op": "heal"},)),
+        Phase("verify", 8.0),
+    ),
+    replicas=1, tenants=("t-a", "t-b")))
+
+WARM_RESTART = _register(Scenario(
+    name="warm_restart",
+    description="Kill the (only) scheduler, restore from its warm-"
+                "restart checkpoint, and catch up via deltasync deltas "
+                "— the measured RTO must beat a full-snapshot "
+                "re-bootstrap of the same trace.",
+    phases=(
+        # long dense warmup, short hold: the checkpoint's value is the
+        # bound set it carries, so the regime must be
+        # |state at checkpoint| >> |churn after it| — the same regime
+        # that makes warm restart worth having in production.  Deletes
+        # are off (the other five drills churn them): a post-checkpoint
+        # delete costs the delta replay a per-event unreserve while the
+        # snapshot compacts it to nothing, which at drill scale is
+        # noise-of-the-harness, not the regime under test.
+        Phase("warmup", 10.0),
+        Phase("inject", 0.5, actions=({"op": "checkpoint"},
+                                      {"op": "kill_leader"},)),
+        Phase("hold", 1.5, chaos=True),
+        Phase("heal", 0.5, actions=({"op": "heal"},
+                                    {"op": "restart_dead",
+                                     "restore": "checkpoint"},)),
+        Phase("verify", 8.0),
+    ),
+    replicas=1, expected_failovers=0,
+    churn={"rate": 6.0, "del_fraction": 0.0}))

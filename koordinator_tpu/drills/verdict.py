@@ -1,0 +1,99 @@
+"""The drill verdict engine: machine-checkable pass/fail per drill.
+
+Every drill ends in the same cross-cutting assertions, whatever was
+injected (the failure mode changes; the invariants must not):
+
+- ``no_overcommit``   — the bind oracle recorded zero violations at any
+  point, including mid-storm and mid-failover;
+- ``faults_fired``    — the scenario actually injected something (a
+  drill whose schedule never fired proved nothing);
+- ``reconverged``     — post-heal fixpoint: every live pod from the
+  churn trace is bound on the current leader, the scheduler left
+  degraded mode, and every watch view caught up to the service rv;
+- ``gang_atomicity``  — no partially-bound gang survives: for every
+  registered gang, the leader's bound member count is 0 or
+  ≥ min_member (the all-or-nothing contract held across the failover);
+- ``bounded_recovery``— the measured RTO (inject → fixpoint) is inside
+  the scenario's budget;
+- ``no_leak``         — thread and fd counts settle back to the
+  post-warmup baseline;
+- ``slo_burn``        — SLO breaches observed during the drill stay
+  within the scenario's budget.
+
+A verdict is GREEN iff every check passed.  ``flight`` joins the
+verdict to the leader's flight-recorder tail and pod trace ids so a RED
+drill replays with full context (the seed alone reproduces the run;
+the flight records say where it went wrong).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class Check:
+    name: str
+    ok: bool
+    detail: str = ""
+
+    def render(self) -> str:
+        return f"[{'PASS' if self.ok else 'FAIL'}] {self.name}" + (
+            f" — {self.detail}" if self.detail else "")
+
+
+@dataclasses.dataclass
+class DrillVerdict:
+    """One drill's outcome: scenario + seed identify the exact replay;
+    checks carry the evidence."""
+
+    scenario: str
+    seed: int
+    checks: list[Check] = dataclasses.field(default_factory=list)
+    #: inject → reconvergence fixpoint, wall seconds (None: no
+    #: injection phase measured, e.g. a pure-churn control run)
+    rto_s: float | None = None
+    #: total wall seconds the leader spent in degraded mode
+    degraded_s: float = 0.0
+    #: flight-recorder tail + pod trace ids from the leader at verdict
+    #: time (diagnosis context for a RED drill)
+    flight: list = dataclasses.field(default_factory=list)
+    trace_ids: dict = dataclasses.field(default_factory=dict)
+    #: free-form measurements (checkpoint vs full-bootstrap RTO, storm
+    #: counts, failover count, ...)
+    measurements: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def green(self) -> bool:
+        return all(c.ok for c in self.checks)
+
+    def check(self, name: str, ok: bool, detail: str = "") -> Check:
+        c = Check(name, bool(ok), detail)
+        self.checks.append(c)
+        return c
+
+    def failed(self) -> list[Check]:
+        return [c for c in self.checks if not c.ok]
+
+    def to_doc(self) -> dict:
+        return {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "green": self.green,
+            "rto_s": self.rto_s,
+            "degraded_s": self.degraded_s,
+            "checks": [{"name": c.name, "ok": c.ok, "detail": c.detail}
+                       for c in self.checks],
+            "measurements": dict(self.measurements),
+        }
+
+    def render(self) -> str:
+        head = (f"drill {self.scenario} seed={self.seed}: "
+                f"{'GREEN' if self.green else 'RED'}"
+                + (f" rto={self.rto_s:.2f}s" if self.rto_s is not None
+                   else ""))
+        lines = [head] + ["  " + c.render() for c in self.checks]
+        if not self.green and self.flight:
+            lines.append("  flight tail:")
+            lines.extend(f"    {r}" for r in self.flight[-5:])
+        return "\n".join(lines)

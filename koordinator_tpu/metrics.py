@@ -650,6 +650,30 @@ forecast_evictions_prestaged = SCHEDULER.counter(
     "each one is a reactive emergency eviction that never had to "
     "happen")
 
+# -- failure drills (drills/, ISSUE 17) --
+drill_active = SCHEDULER.gauge(
+    "drill_active",
+    "1 while a failure drill scenario is running against this control "
+    "plane (label: scenario) — correlates every other panel's wobble "
+    "with the drill that injected it; zero in production")
+drill_recovery_duration_seconds = SCHEDULER.histogram(
+    "drill_recovery_duration_seconds",
+    "Measured RTO per drill: inject (kill/storm/restart) to the verdict "
+    "engine's reconvergence fixpoint (all live pods bound, degraded "
+    "mode exited, watch views caught up to the service rv)",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0))
+leader_failovers_total = SCHEDULER.counter(
+    "leader_failovers_total",
+    "Observed scheduler leadership hand-offs (a different identity "
+    "holds the lease than the previous observation) — drills assert "
+    "exactly the scripted number happened")
+checkpoint_restore_duration_seconds = SCHEDULER.histogram(
+    "checkpoint_restore_duration_seconds",
+    "Warm-restart checkpoint restore time (drills/checkpoint.restore): "
+    "load + apply of the host snapshot and replay cursor, EXCLUDING "
+    "the deltasync catch-up that follows",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
+
 be_suppress_cpu_cores = KOORDLET.gauge(
     "be_suppress_cpu_cores", "CPU cores currently allowed for BE")
 pod_eviction_total = KOORDLET.counter(

@@ -305,6 +305,23 @@ class ClusterSnapshot:
             jnp.asarray(np.int32(row)), jnp.asarray(requests.astype(np.int32))
         )
 
+    def reserve_batch(self, requests_by_node) -> None:
+        """Account many bindings in ONE device op (startup informer
+        replay, warm-restart checkpoint restore).  Bit-identical to
+        sequential :meth:`reserve` — integer adds commute — but the
+        scatter cost is paid once instead of per pod, which is what
+        makes a checkpoint restore cheaper than re-placing the same
+        pods through rounds."""
+        if not requests_by_node:
+            return
+        add = np.zeros(self.state.node_requested.shape, dtype=np.int32)
+        for node, requests in requests_by_node.items():
+            row = self.node_index[node]
+            self._cand_dirty.add(row)
+            add[row] += requests.astype(np.int32)
+        self.state = self.state.replace(
+            node_requested=self.state.node_requested + jnp.asarray(add))
+
     def unreserve(self, node: str, requests: np.ndarray) -> None:
         row = self.node_index[node]
         self._cand_dirty.add(row)

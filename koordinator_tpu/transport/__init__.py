@@ -33,8 +33,14 @@ from koordinator_tpu.transport.deltasync import (  # noqa: F401
     UnknownNodeError,
 )
 from koordinator_tpu.transport.faults import (  # noqa: F401
+    ASYM_SEND,
+    PARTITION,
+    REFUSE,
     FaultConfig,
     FaultInjector,
+    FaultSchedule,
+    StormWindow,
+    domains_from_labels,
 )
 from koordinator_tpu.transport.retry import (  # noqa: F401
     CircuitBreaker,

@@ -491,11 +491,16 @@ class RpcClient:
     are delivered to ``on_push`` — the watch stream."""
 
     def __init__(self, path: str, on_push=None, timeout: float = 10.0,
-                 faults=None):
+                 faults=None, fault_domain: str = ""):
         self.path = path
         self.on_push = on_push
         self.timeout = timeout
         self.faults = faults
+        #: correlated-fault domain tag (e.g. "rack:r1") — a storm over
+        #: the domain refuses this client's connects, severs or blocks
+        #: its calls (faults.FaultInjector storm modes); empty = the
+        #: connection sits outside the modeled topology
+        self.fault_domain = fault_domain
         self._sock: Optional[socket.socket] = None
         self._send_lock = threading.Lock()
         self._pending: dict[int, "_Waiter"] = {}
@@ -507,7 +512,10 @@ class RpcClient:
 
     def connect(self) -> None:
         if self.faults is not None:
-            self.faults.on_connect()
+            if self.fault_domain:
+                self.faults.on_connect(self.fault_domain)
+            else:
+                self.faults.on_connect()
         kind, target = _parse_addr(self.path)
         if kind == "unix":
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -524,8 +532,28 @@ class RpcClient:
         self.connected = True
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
+        if self.faults is not None and self.fault_domain:
+            register = getattr(self.faults, "register_conn", None)
+            if register is not None:
+                register(self.fault_domain, self._sever_for_fault)
+
+    def _sever_for_fault(self) -> None:
+        """Storm sever: shut the socket down so the reader sees EOF and
+        in-flight calls fail fast; the fd itself is released by the
+        owner's close() (reconnect machinery)."""
+        self.connected = False
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     def close(self) -> None:
+        if self.faults is not None and self.fault_domain:
+            unregister = getattr(self.faults, "unregister_conn", None)
+            if unregister is not None:
+                unregister(self.fault_domain, self._sever_for_fault)
         self.connected = False
         if self._sock is not None:
             try:
@@ -587,6 +615,19 @@ class RpcClient:
             # burning the full timeout waiting for a response that can
             # never correlate
             raise RpcError("not connected (stream closed)")
+        if self.faults is not None and self.fault_domain:
+            action = self.faults.outbound_domain(self.fault_domain)
+            if action == "block":
+                # asym_send storm: the call fails but the stream stays —
+                # inbound pushes keep arriving (asymmetric partition)
+                raise RpcError(
+                    f"fault injection: domain {self.fault_domain!r} "
+                    f"outbound blocked")
+            if action == "sever":
+                self._sever_for_fault()
+                raise RpcError(
+                    f"connection lost: domain {self.fault_domain!r} "
+                    f"partitioned")
         if deadline_ms is not None:
             # per-call deadline rides the frame doc so the server can
             # shed the request once nobody is waiting for it

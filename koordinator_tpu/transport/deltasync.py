@@ -725,7 +725,13 @@ class StateSyncClient:
             self._bootstrapping = True
             self._buffer = []
         try:
-            hello = {"last_rv": self.rv, "proto": wire.PROTOCOL_VERSION}
+            # a detected rv gap advanced self.rv PAST the hole (the
+            # fresher events were applied), so a delta re-HELLO from
+            # last_rv would replay nothing and the lost event would
+            # stay lost forever with the rv counters agreeing — the
+            # only honest repair is the full snapshot
+            last_rv = -1 if self.needs_resync else self.rv
+            hello = {"last_rv": last_rv, "proto": wire.PROTOCOL_VERSION}
             if self.instance is not None:
                 hello["instance"] = self.instance
             ftype, doc, arrays = client.call(FrameType.HELLO, hello)
@@ -804,7 +810,8 @@ class StateSyncClient:
         if gap and self._client is not None:
             # sever the stream (outside our lock; close is idempotent
             # and safe on the reader thread): the owner's reconnect path
-            # re-dials and re-HELLOs from last_rv, replaying the hole
+            # re-dials and re-bootstraps; needs_resync makes that HELLO
+            # ask for the full snapshot (last_rv=-1), repairing the hole
             self._client.close()
         return n
 

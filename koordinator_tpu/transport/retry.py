@@ -159,6 +159,18 @@ class CircuitBreaker:
             self.opens = 0
             self._transition(CLOSED)
 
+    def reset(self) -> None:
+        """Force-close, zeroing the backoff history.  The heal seam: a
+        drill's ``FaultInjector.heal()`` resets registered breakers so
+        callers probe the healed peer immediately instead of waiting out
+        the remaining open window (which chaos backoff growth can have
+        pushed far past the heal)."""
+        with self._lock:
+            self._consecutive = 0
+            self.opens = 0
+            self._open_until = 0.0
+            self._transition(CLOSED)
+
     def record_failure(self) -> None:
         with self._lock:
             self._consecutive += 1

@@ -1,0 +1,263 @@
+"""Fast deterministic units for the drill substrate (ISSUE 17):
+
+- fault domains / storm windows / seeded schedules replay EXACT storm
+  membership and timing from one seed under a fake clock (no wall-clock
+  reads anywhere in the schedule path);
+- the warm-restart checkpoint save→restore roundtrip is bit-identical
+  on the scheduler's host state and the snapshot arrays;
+- the churn trace and the drill catalog are well-formed data.
+
+The multi-second socket drills themselves live in test_drills_e2e.py
+(``chaos`` + ``slow``); everything here is tier-1 fast.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.drills import checkpoint as ckpt
+from koordinator_tpu.drills.scenarios import (
+    GANG_BURST,
+    POD_ADD,
+    POD_DEL,
+    SCENARIOS,
+    churn_trace,
+)
+from koordinator_tpu.transport.faults import (
+    PARTITION,
+    REFUSE,
+    FaultConfig,
+    FaultInjector,
+    FaultSchedule,
+    StormWindow,
+    domains_from_labels,
+)
+
+# ---- fault domains and schedules -------------------------------------------
+
+
+def test_domains_from_labels_groups_and_skips_unlabeled():
+    doms = domains_from_labels({
+        "n1": {"rack": "r1"}, "n0": {"rack": "r1"},
+        "n2": {"rack": "r2"}, "n3": {}}, key="rack")
+    assert doms == {"rack:r1": ["n0", "n1"], "rack:r2": ["n2"]}
+
+
+def test_storm_window_validates_and_is_half_open():
+    with pytest.raises(ValueError):
+        StormWindow(0.0, 0.0, {"d"})        # empty window
+    with pytest.raises(ValueError):
+        StormWindow(0.0, 1.0, {"d"}, "bogus-mode")
+    w = StormWindow(1.0, 2.0, {"d"})
+    assert w.active_at(1.0) and w.active_at(1.999)
+    assert not w.active_at(0.999) and not w.active_at(2.0)
+
+
+def test_flap_train_boundaries_are_exact():
+    wins = FaultSchedule.flap_train(("rack:r0",), start=0.0,
+                                    up_s=0.5, down_s=0.5, flaps=3)
+    sched = FaultSchedule(wins)
+    assert sched.boundaries() == [0.0, 0.5, 1.0, 1.5, 2.0, 2.5]
+    assert sched.horizon() == 2.5
+    assert sched.blocked(0.25) == {"rack:r0": PARTITION}
+    assert sched.blocked(0.5) == {}      # end exclusive: the down gap
+    assert sched.blocked(2.0) == {"rack:r0": PARTITION}  # start inclusive
+    assert sched.blocked(2.5) == {}
+
+
+def test_overlapping_windows_keep_the_severest_mode():
+    sched = FaultSchedule([
+        StormWindow(0.0, 2.0, {"rack:r0"}, REFUSE),
+        StormWindow(1.0, 3.0, {"rack:r0"}, PARTITION),
+    ])
+    assert sched.blocked(0.5) == {"rack:r0": REFUSE}
+    assert sched.blocked(1.5) == {"rack:r0": PARTITION}
+    assert sched.blocked(2.5) == {"rack:r0": PARTITION}
+
+
+def test_generate_replays_exact_membership_and_timing_from_seed():
+    doms = ["rack:r0", "rack:r1", "zone:z0"]
+    kw = dict(horizon_s=30.0, storms=4, max_width=2,
+              modes=(PARTITION, REFUSE))
+    a = FaultSchedule.generate(5, doms, **kw)
+    b = FaultSchedule.generate(5, doms, **kw)
+    assert a.windows == b.windows
+    assert a.windows, "seeded schedule never fired"
+    for w in a.windows:
+        assert 0.0 <= w.start < w.end <= 30.0
+        assert w.domains <= set(doms)
+        assert 1 <= len(w.domains) <= 2
+    c = FaultSchedule.generate(6, doms, **kw)
+    assert a.windows != c.windows
+
+
+def test_injector_advances_through_exact_boundaries():
+    """Fake-clock drive of the schedule seam: domain modes toggle at
+    window boundaries and PARTITION starts sever live connections."""
+    inj = FaultInjector(seed=3, config=FaultConfig())
+    severed = []
+    inj.register_conn("rack:r0", lambda: severed.append(1))
+    inj.schedule = FaultSchedule(FaultSchedule.flap_train(
+        ("rack:r0",), start=1.0, up_s=0.5, down_s=0.5, flaps=2))
+    assert inj.domain_mode("rack:r0") is None
+    inj.advance_to(1.0)
+    assert inj.domain_mode("rack:r0") == PARTITION
+    assert len(severed) == 1
+    inj.advance_to(1.5)
+    assert inj.domain_mode("rack:r0") is None
+    inj.advance_to(2.0)
+    assert inj.domain_mode("rack:r0") == PARTITION
+    assert len(severed) == 2
+    inj.advance_to(2.5)
+    assert inj.domain_mode("rack:r0") is None
+    assert inj.injected["storm_partition"] == 2
+    inj.heal()
+    assert inj.schedule is None
+
+
+# ---- churn trace ------------------------------------------------------------
+
+
+def test_churn_trace_replays_from_seed():
+    a = churn_trace(7, 30.0, tenants=("t-a", "t-b"))
+    b = churn_trace(7, 30.0, tenants=("t-a", "t-b"))
+    assert a == b
+    c = churn_trace(8, 30.0, tenants=("t-a", "t-b"))
+    assert a != c
+    assert all(x.t <= y.t for x, y in zip(a, a[1:]))
+    adds = {e.name for e in a if e.kind == POD_ADD}
+    dels = {e.name for e in a if e.kind == POD_DEL}
+    assert dels <= adds, "every delete references an added pod"
+    assert any(e.kind == GANG_BURST for e in a)
+
+
+def test_scenario_catalog_is_well_formed():
+    assert set(SCENARIOS) == {
+        "leader_failover", "manager_restart", "rack_storm",
+        "quota_reorg", "tenant_sever", "warm_restart"}
+    for s in SCENARIOS.values():
+        assert [p.name for p in s.phases] == [
+            "warmup", "inject", "hold", "heal", "verify"]
+        assert all(p.duration_s > 0 for p in s.phases)
+        assert s.phase("inject").actions, s.name
+        assert s.phase("hold").chaos, s.name
+        assert s.replicas >= 1 and s.rto_budget_s > 0
+
+
+# ---- warm-restart checkpoint -----------------------------------------------
+
+
+def _plain_cfg():
+    import jax.numpy as jnp
+
+    from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS
+    from koordinator_tpu.ops.assignment import ScoringConfig
+
+    return ScoringConfig.default().replace(
+        usage_thresholds=jnp.zeros(NUM_RESOURCE_DIMS, jnp.int32),
+        estimator_defaults=jnp.zeros(NUM_RESOURCE_DIMS, jnp.int32))
+
+
+def _mk_scheduler(nodes=3, with_quota=True):
+    from koordinator_tpu.api.resources import resource_vector
+    from koordinator_tpu.quota.tree import QuotaTree
+    from koordinator_tpu.scheduler import ClusterSnapshot, NodeSpec, Scheduler
+
+    snap = ClusterSnapshot(capacity=8)
+    for i in range(nodes):
+        snap.upsert_node(NodeSpec(
+            name=f"ck{i}",
+            allocatable=resource_vector(cpu=16_000, memory=16_384),
+            labels={"rack": f"r{i % 2}"}))
+    tree = None
+    if with_quota:
+        total = resource_vector(cpu=16_000 * 3, memory=16_384 * 3)
+        tree = QuotaTree(total)
+        tree.add("t-a", min=resource_vector(cpu=8_000, memory=8_192),
+                 max=total)
+    return Scheduler(snap, config=_plain_cfg(),
+                     bind_fn=lambda p, n: None, quota_tree=tree)
+
+
+def test_checkpoint_roundtrip_is_bit_identical(tmp_path):
+    from koordinator_tpu.api.resources import resource_vector
+    from koordinator_tpu.scheduler import PodSpec
+    from koordinator_tpu.scheduler.scheduler import GangRecord
+
+    a = _mk_scheduler()
+    a.register_gang(GangRecord(name="g1", min_member=2))
+    for i in range(4):
+        a.enqueue(PodSpec(
+            name=f"p{i}", requests=resource_vector(cpu=2_000, memory=1_024),
+            priority=1000, quota="t-a", gang="g1" if i < 2 else None))
+    res = a.schedule_round()
+    assert len(res.assignments) == 4
+    a.enqueue(PodSpec(name="pend",
+                      requests=resource_vector(cpu=2_000, memory=1_024),
+                      quota="t-a"))
+
+    path = str(tmp_path / "ckpt.bin")
+    stats = ckpt.save(path, a)
+    assert stats["bound"] == 4 and stats["pending"] == 1
+
+    # restore onto a FRESH, EMPTY scheduler (the caller owns its
+    # construction): nodes, quota tree, gangs, queues all come back
+    b = _mk_scheduler(nodes=0, with_quota=False)
+    rstats = ckpt.restore(path, b)
+    assert rstats["nodes"] == 3 and rstats["bound"] == 4
+    assert rstats["pending"] == 1 and rstats["gangs"] == 1
+    assert rstats["cursor_rv"] == -1    # no sync attached
+
+    doc_a, arrays_a = ckpt.capture(a)
+    doc_b, arrays_b = ckpt.capture(b)
+    assert doc_a == doc_b
+    assert sorted(arrays_a) == sorted(arrays_b)
+    for key in arrays_a:
+        assert arrays_a[key].dtype == arrays_b[key].dtype, key
+        assert np.array_equal(arrays_a[key], arrays_b[key]), key
+    # and the device accounting itself is bit-identical: the batched
+    # restore reserve commutes with the sequential bind-time reserves
+    a.snapshot.flush()
+    b.snapshot.flush()
+    assert np.array_equal(np.asarray(a.snapshot.state.node_requested),
+                          np.asarray(b.snapshot.state.node_requested))
+    assert set(b.bound) == set(a.bound)
+    assert set(b.gangs) == {"g1"}
+    a.stop()
+    b.stop()
+
+
+def test_checkpoint_primes_the_replay_cursor(tmp_path):
+    class _Cursor:
+        rv = 41
+        instance = "epoch-1"
+
+    a = _mk_scheduler()
+    path = str(tmp_path / "cur.bin")
+    ckpt.save(path, a, sync=_Cursor())
+    b = _mk_scheduler(nodes=0, with_quota=False)
+    fresh = _Cursor()
+    fresh.rv, fresh.instance = -1, None
+    stats = ckpt.restore(path, b, sync=fresh)
+    assert stats["cursor_rv"] == 41
+    assert fresh.rv == 41 and fresh.instance == "epoch-1"
+    a.stop()
+    b.stop()
+
+
+def test_checkpoint_writer_stop_writes_a_final_cut(tmp_path):
+    a = _mk_scheduler()
+    path = str(tmp_path / "w.bin")
+    w = ckpt.CheckpointWriter(path, a, interval_s=600.0).start()
+    w.stop()                       # planned restart: freshest cut
+    assert w.saves == 1 and w.errors == 0
+    assert os.path.exists(path)
+    doc, _ = ckpt.load(path)
+    assert doc["version"] == ckpt.CHECKPOINT_VERSION
+    # a failed save never raises — checkpointing is an optimization
+    bad = ckpt.CheckpointWriter(
+        str(tmp_path / "no-such-dir" / "w.bin"), a, interval_s=600.0)
+    assert bad.save_now() is None
+    assert bad.errors == 1
+    a.stop()

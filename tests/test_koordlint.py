@@ -87,9 +87,13 @@ class TestDonationSafetyCorpus:
         assert "also passed at position" in messages
         # the ISSUE-11 double-buffer anti-idiom: stashing the donated
         # in-flight buffer on a handle after dispatch is a second
-        # read-after-donate seed (Pipeline.dispatch in the corpus)
-        assert messages.count("read after being donated") == 2
-        assert len(findings) == 4
+        # read-after-donate seed (Pipeline.dispatch in the corpus); the
+        # ISSUE-17 checkpoint path seeds a third (serialising the
+        # pre-donation reference in Restorer.catch_up) plus a second
+        # aliased construction (RestoredState.restore)
+        assert messages.count("read after being donated") == 3
+        assert messages.count("aliased across pytree fields") == 2
+        assert len(findings) == 6
 
     def test_good_corpus_is_clean(self):
         assert DonationSafetyAnalyzer(package="pkg").run(
@@ -109,6 +113,12 @@ class TestLockDisciplineCorpus:
         # cycle too (the combined form acquires in sequence)
         assert any("Combined._a" in f.message and "Combined._b"
                    in f.message for f in findings), messages
+        # the ISSUE-17 checkpoint seeds: writer-lock / round-lock order
+        # cycle, and the restore path's bare replay-cursor write
+        assert any("RoundScheduler.lock" in f.message
+                   and "CheckpointWriter._lock" in f.message
+                   for f in findings), messages
+        assert "bare in restore()" in messages
 
     def test_good_corpus_is_clean(self):
         # guarded-by annotation honored, RLock reentrancy not a cycle,

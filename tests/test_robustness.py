@@ -173,6 +173,31 @@ def test_fault_injector_heal_stops_injection():
     assert inj.outbound_action(is_push=False) is None
 
 
+def test_fault_injector_heal_resets_registered_breakers():
+    """The heal seam (ISSUE 17): a drill's heal() must force-close every
+    registered breaker so callers probe the healed peer NOW, instead of
+    waiting out an open window that chaos backoff growth pushed far past
+    the heal."""
+    t = [0.0]
+    b = CircuitBreaker(target="healed", failure_threshold=1,
+                       clock=lambda: t[0],
+                       policy=RetryPolicy(initial_backoff_s=600.0,
+                                          multiplier=2.0, jitter="none"))
+    inj = FaultInjector(seed=7, config=FaultConfig(send_sever_p=1.0))
+    inj.register_breaker(b)
+    b.record_failure()
+    assert b.state == OPEN
+    assert not b.allow()            # 600s window: dead until the heal
+    inj.heal()
+    assert b.state == CLOSED
+    assert b.allow()                # probed immediately, no half-open
+    assert b.opens == 0             # backoff history zeroed
+    # the reset breaker starts its schedule over, not where chaos left it
+    b.record_failure()
+    t[0] += 600.0
+    assert b.allow()
+
+
 def test_injected_connect_refusal_surfaces_as_rpc_error(tmp_path):
     server = RpcServer(str(tmp_path / "s.sock"))
     server.start()
@@ -611,6 +636,32 @@ def test_rv_gap_flags_resync_and_severs_the_stream():
     # duplicates/overlaps stay idempotent, not gaps
     sync.on_push(_delta_frame(4))
     assert sync.gaps == 1 and sync.skipped == 1
+
+
+def test_rv_gap_repair_rides_the_full_snapshot():
+    """The gap handler APPLIES the fresher events, so self.rv has
+    already advanced past the hole — a delta re-HELLO from last_rv
+    would replay nothing and the lost event would stay lost forever
+    with both rv counters agreeing.  The reconnect bootstrap must ask
+    for the full snapshot instead."""
+    sync = StateSyncClient(_NullBinding())
+    sync.rv = 2
+    sync.on_push(_delta_frame(4))          # rv 3 lost; rv now 4
+    assert sync.needs_resync and sync.rv == 4
+
+    hellos = []
+
+    class _FakeClient:
+        def call(self, ftype, doc, arrays=None):
+            hellos.append(doc)
+            return FrameType.ACK, {}, {}
+
+    sync.bootstrap(_FakeClient())
+    assert hellos[0]["last_rv"] == -1      # full snapshot, not a delta
+    assert not sync.needs_resync           # repaired: flag cleared
+    # healthy reconnects keep the cheap delta path
+    sync.bootstrap(_FakeClient())
+    assert hellos[1]["last_rv"] == sync.rv
 
 
 # ---- stale-state degraded mode ---------------------------------------------

@@ -1,0 +1,45 @@
+"""Seeded known-BAD corpus for donation-safety on the warm-restart
+checkpoint path (ISSUE 17): the restore rebuilds the accounting pytree
+from host rows, hands it to the donating repack solve — and then
+serialises the SAME reference into the next checkpoint, a read of a
+buffer that died when the call started.  ``RestoredState.restore`` adds
+the construction-side hazard: one ``asarray`` buffer aliased across two
+fields of the restored pytree.
+"""
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class RestoredState:
+    requested: jax.Array
+    allocatable: jax.Array
+
+    @classmethod
+    def restore(cls, rows):
+        buf = jnp.asarray(rows)
+        return cls(requested=buf, allocatable=buf)  # BAD: one buffer, 2 fields
+
+
+def _repack(state, batch):
+    return state
+
+
+repack = jax.jit(_repack, donate_argnums=(0,))
+
+
+class Restorer:
+    """Warm-restart catch-up done WRONG: the delta replay donates the
+    restored state into the repack solve, then the checkpoint writer
+    reads the pre-call reference to build the next snapshot doc."""
+
+    def __init__(self, state, batch):
+        self.state = state
+        self.batch = batch
+
+    def catch_up(self):
+        new = repack(self.state, self.batch)
+        doc = {"requested": self.state.requested}  # BAD: read after donation
+        self.state = new
+        return doc

@@ -1,0 +1,41 @@
+"""Seeded known-BAD corpus for lock-discipline on the checkpoint path
+(ISSUE 17): the checkpoint writer and the round loop each take their own
+lock and then call into the other — a writer-lock / round-lock order
+cycle (deadlock candidate) — and the restore path writes the replay
+cursor bare while the round loop writes it guarded (race candidate)."""
+import threading
+
+
+class RoundScheduler:
+    def __init__(self, writer: "CheckpointWriter"):
+        self.lock = threading.Lock()
+        self.writer = writer
+        self.rv = 0
+
+    def round(self):
+        with self.lock:
+            self.rv += 1                   # guarded write
+            # BAD half of the cycle: RoundScheduler.lock ->
+            # CheckpointWriter._lock
+            self.writer.flush({"rv": self.rv})
+
+    def restore(self, doc):
+        self.rv = doc["rv"]                # BAD: bare write (race)
+
+
+class CheckpointWriter:
+    def __init__(self, scheduler: RoundScheduler):
+        self._lock = threading.Lock()
+        self.scheduler = scheduler
+        self.saves = 0
+
+    def flush(self, doc):
+        with self._lock:
+            self.saves += 1
+
+    def save_now(self):
+        with self._lock:
+            # BAD other half: CheckpointWriter._lock ->
+            # RoundScheduler.lock (capture under the round lock while
+            # still holding the writer lock)
+            self.scheduler.round()

@@ -1,0 +1,42 @@
+"""Seeded known-GOOD corpus for lock-discipline on the checkpoint path:
+the blessed one-way order — capture under the round lock, encode and
+write OUTSIDE every lock — plus guarded-by declarations on the replay
+cursor and the writer's counters."""
+import threading
+
+
+class RoundScheduler:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.rv = 0   # koordlint: guarded-by(self.lock)
+
+    def round(self):
+        with self.lock:
+            self.rv += 1
+
+    def restore(self, doc):
+        with self.lock:
+            self.rv = doc["rv"]            # guarded, as declared
+
+    def capture(self):
+        with self.lock:
+            return {"rv": self.rv}
+
+
+class CheckpointWriter:
+    """Capture borrows the scheduler's round lock, the file write
+    happens lock-free: one global acquisition order, no reverse path."""
+
+    def __init__(self, scheduler: RoundScheduler):
+        self._lock = threading.Lock()
+        self.scheduler = scheduler
+        self.saves = 0
+
+    def _record_locked(self):  # koordlint: guarded-by(self._lock)
+        self.saves += 1
+
+    def save_now(self):
+        doc = self.scheduler.capture()     # round lock, then released
+        with self._lock:
+            self._record_locked()
+        return doc

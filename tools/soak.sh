@@ -88,6 +88,19 @@
 #                                     base so the exact fault schedule
 #                                     replays with
 #                                     KOORD_CHAOS_SEED_BASE=<base>
+#         SOAK_DRILLS  (default 0)    1 = also sweep the adversarial
+#                                     failure drills (tests/
+#                                     test_drills_e2e.py, every catalog
+#                                     scenario x the window's seeds) via
+#                                     KOORD_DRILL_SEED_BASE/_COUNT; a
+#                                     failing window prints its seed
+#                                     base so the exact drill replays
+#                                     with KOORD_DRILL_SEED_BASE=<base>,
+#                                     and the run ends with the drill
+#                                     verdict table (tools/
+#                                     soak_report.py --drills: per-
+#                                     scenario checks + measured RTO,
+#                                     exit 0 iff all GREEN)
 set -u
 cd "$(dirname "$0")/.."
 
@@ -97,6 +110,7 @@ BASE0=${SOAK_BASE0:-1000}
 STRIDE=${SOAK_STRIDE:-1000}
 OUT=${SOAK_OUT:-soak_results}
 CHAOS=${SOAK_CHAOS:-0}
+DRILLS=${SOAK_DRILLS:-0}
 LOADGEN=${SOAK_LOADGEN:-0}
 QUALITY=${SOAK_QUALITY:-0}
 FORECAST=${SOAK_FORECAST:-0}
@@ -201,7 +215,49 @@ for ((w = 0; w < WINDOWS; w++)); do
                 | tr '\n' ';')"
         fi
     fi
+
+    if [ "$DRILLS" = "1" ]; then
+        echo "== drill window $((w + 1))/$WINDOWS seed base $base" \
+            | tee -a "$log"
+        KOORD_DRILL_SEED_BASE=$base KOORD_DRILL_SEED_COUNT=$COUNT \
+            python -m pytest tests/test_drills_e2e.py -m chaos -q \
+            --tb=line >> "$log" 2>&1
+        drc=$?
+        dp=$(tail -40 "$log" | grep -oE "[0-9]+ passed" | tail -1 \
+            | grep -oE "[0-9]+")
+        df=$(tail -40 "$log" | grep -oE "[0-9]+ failed" | tail -1 \
+            | grep -oE "[0-9]+")
+        total_passed=$((total_passed + ${dp:-0}))
+        if [ "$drc" -ne 0 ]; then
+            total_failed=$((total_failed + ${df:-1}))
+            # the seed base IS the replay handle: rerun the exact drill
+            # (churn trace + storm schedule) with
+            # KOORD_DRILL_SEED_BASE=<base>
+            echo "DRILL FAILURE at seed base $base — replay with" \
+                "KOORD_DRILL_SEED_BASE=$base python -m pytest" \
+                "tests/test_drills_e2e.py -m chaos" | tee -a "$log"
+            failures="$failures;drill seed base=$base rc=$drc:"
+            failures="$failures $(grep '^FAILED' "$log" | sort -u \
+                | tr '\n' ';')"
+        fi
+    fi
 done
+
+if [ "$DRILLS" = "1" ]; then
+    # drill verdict table BEFORE the tally so its verdict counts in the
+    # JSON: every catalog scenario runs once at the report seed and the
+    # per-scenario check + RTO table prints; exit 0 iff all GREEN
+    echo "== drill verdict table (soak_report --drills)" | tee -a "$log"
+    if python tools/soak_report.py --drills >> "$log" 2>&1; then
+        grep -E "^(== drills|-- |   |VERDICT)" "$log" | tail -12
+        total_passed=$((total_passed + 1))
+    else
+        tail -16 "$log"
+        total_failed=$((total_failed + 1))
+        failures="$failures;drills: RED scenario verdict or harness"
+        failures="$failures failure (see log)"
+    fi
+fi
 
 if [ "$EXPLAIN" = "1" ]; then
     # explainability smoke BEFORE the tally so its verdict counts in the

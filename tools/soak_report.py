@@ -159,6 +159,49 @@ def forecast_ab_report(args) -> int:
     return 0 if green else 1
 
 
+def drills_report(args) -> int:
+    """The adversarial-drill verdict table (SOAK_DRILLS=1 / --drills):
+    every catalog scenario (koordinator_tpu/drills/scenarios.py) runs
+    once at the report seed — leader failover, manager restart, rack
+    flap storm, quota reorg, tenant sever, warm restart — and the
+    per-scenario check + RTO table prints.  GREEN only when every
+    scenario's full verdict passed; a RED scenario prints its check
+    breakdown and the exact replay handle."""
+    import tempfile
+
+    from koordinator_tpu.drills import run_all
+
+    # drills validate at 6x compression (tests/test_drills_e2e.py uses
+    # the same); the loadgen --time-scale default is tuned for churn
+    # soaks, not for lease/breaker timing, so it is not reused here
+    scale = 6.0
+    with tempfile.TemporaryDirectory(prefix="koord-drills-") as workdir:
+        verdicts = run_all(args.seed, workdir, time_scale=scale)
+    print(f"== drills: seed={args.seed} scenarios={len(verdicts)} "
+          f"time_scale={scale:g}x")
+    print(f"-- drill {'scenario':<21} {'verdict':>7} {'rto_s':>8} "
+          f"{'degraded_s':>11}  failed checks")
+    all_green = True
+    for name, v in verdicts.items():
+        all_green = all_green and v.green
+        failed = ", ".join(c.name for c in v.failed()) or "-"
+        rto = "-" if v.rto_s is None else f"{v.rto_s:.2f}"
+        print(f"   {name:<27} {'GREEN' if v.green else 'RED':>7} "
+              f"{rto:>8} {v.degraded_s:>11.2f}  {failed}")
+    if args.json:
+        print(json.dumps({k: v.to_doc() for k, v in verdicts.items()},
+                         indent=2, default=str))
+    print(f"VERDICT: {'GREEN' if all_green else 'RED'}")
+    for name, v in verdicts.items():
+        if not v.green:
+            print(f"-- {name} RED — replay: python -c \"from "
+                  f"koordinator_tpu.drills import run_drill; "
+                  f"print(run_drill({name!r}, {args.seed}, "
+                  f"'/tmp/drill').render())\"")
+            print(v.render())
+    return 0 if all_green else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="soak_report")
     parser.add_argument("--seed", type=int, default=0)
@@ -209,12 +252,22 @@ def main(argv: list[str] | None = None) -> int:
                              "SLO-breach minutes and reactive "
                              "evictions — and actually pre-staged "
                              "at least one migration")
+    parser.add_argument("--drills", action="store_true",
+                        help="run the adversarial failure-drill catalog "
+                             "instead of the churn soak: every scenario "
+                             "(leader failover, manager restart, rack "
+                             "storm, quota reorg, tenant sever, warm "
+                             "restart) runs once at --seed and the "
+                             "per-scenario verdict + RTO table prints; "
+                             "exit 0 iff every scenario is GREEN")
     parser.add_argument("--json", action="store_true",
                         help="dump the raw verdict document too")
     args = parser.parse_args(argv)
 
     if args.forecast:
         return forecast_ab_report(args)
+    if args.drills:
+        return drills_report(args)
 
     cfg = loadgen.smoke_config(seed=args.seed, tenants=args.tenants)
     overrides = {}
