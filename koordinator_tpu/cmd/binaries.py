@@ -568,6 +568,14 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
              "rollups all go dark; Diagnose falls back to the per-pod "
              "host recompute")
     parser.add_argument(
+        "--no-timeline", action="store_true",
+        help="disable the critical-path observatory (timeline.py): no "
+             "per-cycle segment recording, host-wait attribution, "
+             "critical-path solving, or /debug/timeline bodies — the "
+             "kill switch for suspected self-overhead (decisions are "
+             "bit-identical either way; KOORD_TIMELINE=0 is the env "
+             "equivalent)")
+    parser.add_argument(
         "--trace-pods", action="store_true",
         help="open a root trace span for EVERY enqueued pod (pods whose "
              "submitter propagated a trace context are always traced); "
@@ -691,6 +699,10 @@ def main_koord_scheduler(argv: list[str],
 
     args = build_scheduler_parser().parse_args(argv)
     apply_feature_gates(args.feature_gates, SCHEDULER_GATES)
+    if args.no_timeline:
+        from koordinator_tpu import timeline
+
+        timeline.RECORDER.set_enabled(False)
     from koordinator_tpu.cmd.component_config import (
         SchedulerComponentConfig,
         load_scheduler_config,

@@ -510,6 +510,29 @@ pipeline_host_wait_fraction = SCHEDULER.gauge(
     "share of the round; the pipelined overlap drives it toward zero "
     "because solves execute while other tenants' commits run")
 
+# -- critical-path observatory (timeline.py, ISSUE 18) --
+host_wait_attribution = SCHEDULER.gauge(
+    "host_wait_attribution",
+    "Decomposition of the last cycle's WHOLE wall into fractions that "
+    "sum to 1.0 (label: cause — timeline.ATTRIBUTION_CAUSES).  The "
+    "device_block bucket equals pipeline_host_wait_fraction by "
+    "construction (same block_until_ready intervals); the remaining "
+    "causes (dispatch, deltasync_apply, build_batch, bind_commit, "
+    "json_codec, lock_wait, host_other) decompose its complement, and "
+    "unattributed is the explicit residual the phase-accounting "
+    "invariant test pins under 5%")
+device_idle_fraction = SCHEDULER.gauge(
+    "device_idle_fraction",
+    "Share of the last cycle's wall with NO solve in flight on the "
+    "device, derived from the dispatch/block edges of every tenant's "
+    "round — the headroom the pipelined overlap has not yet claimed")
+critical_path_seconds = SCHEDULER.gauge(
+    "critical_path_seconds",
+    "Seconds of the last cycle's critical-path covering chain per "
+    "cause (label: cause); topk(1, ...) names the dominant cause the "
+    "ROADMAP item-5 perf attack should aim at.  Every cause is "
+    "republished each cycle so cleared ones read 0")
+
 # -- bench probe arming (bench_prober.py, ROADMAP item 1) --
 bench_probe_attempts = SCHEDULER.counter(
     "bench_probe_attempts_total",
@@ -737,6 +760,20 @@ sync_resyncs_total = TRANSPORT.counter(
     "Server-requested resyncs honored by a reconnecting client (ERROR "
     "frame with resync: true — e.g. a push for a node the restarted "
     "service no longer knows)")
+wire_codec_seconds = TRANSPORT.histogram(
+    "wire_codec_duration_seconds",
+    "JSON+array payload codec wall time per operation (label: "
+    "op=encode|decode) — the json_codec slice of the host-wait "
+    "attribution (ISSUE 18); rising encode p99 at flat payload bytes "
+    "means the control doc grew, not the tensors",
+    buckets=(0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+             0.01, 0.025, 0.05, 0.1, 0.25, 1.0))
+wire_payload_bytes = TRANSPORT.histogram(
+    "wire_payload_bytes",
+    "Encoded frame payload size in bytes per operation (label: "
+    "op=encode|decode): json section + raw array section together",
+    buckets=(256, 1024, 4096, 16384, 65536, 262144, 1048576, 4194304,
+             16777216, 67108864))
 
 descheduler_evictions_total = DESCHEDULER.counter(
     "pod_evictions_total", "Descheduler evictions by profile/reason")

@@ -69,6 +69,15 @@ class RoundRecord:
     #: dump answers "how many LP phases did that cost" in place
     quality_mode: str = "off"
     quality_iterations: int = 0
+    #: critical-path join (ISSUE 18): the timeline observatory's verdict
+    #: for the cycle this round ran in — which cause dominated the
+    #: cycle's covering chain and for how long — annotated after the
+    #: cycle reconstructs (cycle_seq = -1 until then / with the
+    #: recorder disabled), so a slow round's record names what the
+    #: WHOLE cycle was actually spending its wall on
+    cycle_seq: int = -1
+    cycle_critical_cause: str = ""
+    cycle_critical_seconds: float = 0.0
     dump_reason: Optional[str] = None   # slow | degraded when dumped
 
     def to_doc(self) -> dict:
@@ -130,6 +139,22 @@ class FlightRecorder:
             return False
         self._dump(rec, reason)
         return True
+
+    def annotate_round(self, round_seq: int, tenant: str,
+                       **fields) -> int:
+        """Back-annotate every in-ring record of one round (both halves
+        of a pipelined round) with cycle-level fields — the timeline
+        observatory's critical-path verdict lands here AFTER the cycle
+        reconstructs.  Records already dumped to the log carry
+        cycle_seq=-1; the ring (and any later dump) carries the join.
+        Returns the number of records annotated."""
+        n = 0
+        for rec in list(self.records):
+            if rec.round == round_seq and rec.tenant == tenant:
+                for key, value in fields.items():
+                    setattr(rec, key, value)
+                n += 1
+        return n
 
     def snapshot(self, limit: Optional[int] = None) -> list[dict]:
         """Newest-first record docs (the /debug/rounds body)."""

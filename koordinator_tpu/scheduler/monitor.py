@@ -19,7 +19,7 @@ import logging
 import time
 from collections import defaultdict, deque
 
-from koordinator_tpu import metrics, tracing
+from koordinator_tpu import metrics, timeline, tracing
 
 logger = logging.getLogger("koordinator_tpu.scheduler")
 
@@ -60,11 +60,21 @@ class SchedulerMonitor:
         ctx = tracing.current_context()
         span_cm = (tracing.TRACER.span(f"phase.{name}") if ctx is not None
                    else contextlib.nullcontext())
+        # the timeline segment is timed on perf_counter directly (not
+        # self.clock, which tests may fake): cycle windows clip by real
+        # monotonic time and a synthetic clock would mis-place segments
+        tl_start = (time.perf_counter() if timeline.RECORDER.enabled
+                    else 0.0)
         start = self.clock()
         try:
             with span_cm:
                 yield
         finally:
+            if timeline.RECORDER.enabled:
+                timeline.RECORDER.add(
+                    tl_start, time.perf_counter(),
+                    timeline.PHASE_CAUSES.get(name, "host_other"),
+                    f"phase.{name}", self.tenant)
             elapsed = self.clock() - start + carry_s
             self.phase_history[name].append(elapsed)
             self.round_timings[name] = (

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from koordinator_tpu import metrics, tracing
+from koordinator_tpu import metrics, timeline, tracing
 from koordinator_tpu.ops.assignment import ScoringConfig
 from koordinator_tpu.ops.gang import GangInfo
 from koordinator_tpu.ops.network_topology import (
@@ -1311,6 +1311,9 @@ class Scheduler:
         self.monitor.start_round()
         self._solve_device_s = 0.0
         self._solve_carry_s = 0.0
+        #: first dispatch edge of the round (timeline device-busy
+        #: derivation); consumed by the first block edge
+        self._tl_device_t0 = None
         self._last_dirty_node_frac = 0.0
         self._last_dirty_pod_frac = 0.0
         self._last_unschedulable_top = {}
@@ -1407,6 +1410,20 @@ class Scheduler:
                                           half="round")
             if self._round_recordable:
                 self._publish_round_introspection()
+            if (self._round_recordable and self.tenant_front is None
+                    and timeline.RECORDER.enabled):
+                # an untenanted scheduler's round IS its cycle: the
+                # timeline observatory reconstructs/attributes the same
+                # window the tenancy front-end would (ISSUE 18)
+                doc = timeline.RECORDER.finish_cycle(
+                    self.round_seq, t0, time.perf_counter(),
+                    mode="round")
+                if doc is not None:
+                    self.flight_recorder.annotate_round(
+                        self.round_seq, self.tenant,
+                        cycle_seq=doc["cycle"],
+                        cycle_critical_cause=doc["critical_cause"],
+                        cycle_critical_seconds=doc["critical_seconds"])
             return result
 
     def round_device(self) -> "RoundHandle":
@@ -1417,14 +1434,20 @@ class Scheduler:
         halves would solve one queue and commit another.  Each half
         leaves its own flight record (``half="solve"``/``"commit"``) so
         ``/debug/rounds`` attributes slow halves to a tenant."""
-        self._round_begin()
         start_wall = time.time()
         t0 = time.perf_counter()
-        with tracing.TRACER.span(
-                "scheduler.round.solve", service="scheduler",
-                attributes={"round": self.round_seq,
-                            "tenant": self.tenant}) as span:
-            handle = self._round_device()
+        # blanket the device half as lowest-priority host work: the
+        # typed segments inside (build_batch, dispatch, lock_wait) win
+        # the sweep; only the inter-phase glue lands here instead of in
+        # the unattributed residual
+        with timeline.RECORDER.section("host_other", "round.prepare",
+                                       self.tenant):
+            self._round_begin()
+            with tracing.TRACER.span(
+                    "scheduler.round.solve", service="scheduler",
+                    attributes={"round": self.round_seq,
+                                "tenant": self.tenant}) as span:
+                handle = self._round_device()
         handle.start_wall = start_wall
         handle.t0 = t0
         if self._round_recordable and not handle.done:
@@ -1437,17 +1460,22 @@ class Scheduler:
     def round_host(self, handle: "RoundHandle") -> SchedulingResult:
         """Public HOST-half entry: block on the dispatched solve and
         commit.  Pairs with :meth:`round_device` under one lock hold."""
-        with tracing.TRACER.span(
-                "scheduler.round.commit", service="scheduler",
-                attributes={"round": self.round_seq,
-                            "tenant": self.tenant}) as span:
-            result = self._round_host(handle)
-        if self._round_recordable:
-            self._round_flight_record(
-                result, span.trace_id, handle.start_wall,
-                time.perf_counter() - handle.t0, self._current_path(),
-                half="commit")
-            self._publish_round_introspection()
+        # blanket the host half like round_device does: block waits keep
+        # their device_block priority, commit glue stops leaking into
+        # the unattributed residual
+        with timeline.RECORDER.section("host_other", "round.commit",
+                                       self.tenant):
+            with tracing.TRACER.span(
+                    "scheduler.round.commit", service="scheduler",
+                    attributes={"round": self.round_seq,
+                                "tenant": self.tenant}) as span:
+                result = self._round_host(handle)
+            if self._round_recordable:
+                self._round_flight_record(
+                    result, span.trace_id, handle.start_wall,
+                    time.perf_counter() - handle.t0, self._current_path(),
+                    half="commit")
+                self._publish_round_introspection()
         return result
 
     # koordlint: guarded-by(self.lock)
@@ -1782,6 +1810,15 @@ class Scheduler:
             raise
         finally:
             self._solve_carry_s += time.perf_counter() - dispatch_t0
+            if timeline.RECORDER.enabled:
+                # the async solve starts executing during this window:
+                # its start doubles as the device-busy leading edge the
+                # idle derivation pairs with the block edge
+                timeline.RECORDER.add(
+                    dispatch_t0, time.perf_counter(), "dispatch",
+                    "round.dispatch", self.tenant)
+                if self._tl_device_t0 is None:
+                    self._tl_device_t0 = dispatch_t0
         # the prepass may have shrunk the batch and charged the quota
         handle.batch, handle.quota, handle.solver = batch, quota, solver
         # stamped here so the pipelined solve-half flight record carries
@@ -2139,7 +2176,20 @@ class Scheduler:
         split the flight recorder and round span report."""
         t0 = time.perf_counter()
         value = jax.block_until_ready(value)
-        self._solve_device_s += time.perf_counter() - t0
+        t1 = time.perf_counter()
+        self._solve_device_s += t1 - t0
+        if timeline.RECORDER.enabled:
+            timeline.RECORDER.add(t0, t1, "device_block",
+                                  "block_until_ready", self.tenant)
+            # device-busy span: the dispatch edge (when this round
+            # dispatched async work) to this block edge.  A block with
+            # no tracked dispatch (rescue pass) contributes just its
+            # own wait — an under-estimate of busy, never of idle.
+            busy_t0 = getattr(self, "_tl_device_t0", None)
+            timeline.RECORDER.add(busy_t0 if busy_t0 is not None else t0,
+                                  t1, timeline.DEVICE_BUSY,
+                                  "solve", self.tenant)
+            self._tl_device_t0 = None
         return value
 
     def sharding_report(self) -> dict:

@@ -143,6 +143,35 @@ def debug_tenants_body(scheduler) -> dict:
     return front.tenants_report()
 
 
+def debug_timeline_body(scheduler, params: dict | None = None) -> dict:
+    """The /debug/timeline?cycles=N payload (shared by DebugService and
+    the HTTP gateway): the critical-path observatory's reconstructed
+    cycle gantts, newest first — typed segments, the wall-time
+    attribution by cause (sums to 1.0 with an explicit unattributed
+    residual), device-idle intervals derived from the dispatch/block
+    edges, and the cycle's critical-path chain + dominant cause.
+
+    The recorder is process-wide (``timeline.RECORDER``): a
+    multi-tenant front's cycles and an untenanted scheduler's
+    one-round cycles land in the same ring.  400 on a malformed
+    bound; an empty ``cycles`` list (not an error) means no cycle has
+    run with the recorder armed (e.g. ``--no-timeline``)."""
+    from koordinator_tpu import timeline
+
+    cycles = (params or {}).get("cycles", 8)
+    try:
+        cycles = int(cycles)
+    except (TypeError, ValueError):
+        raise DebugApiError(400, "cycles must be an integer") from None
+    if cycles < 1:
+        raise DebugApiError(400, "cycles must be >= 1")
+    return {
+        "enabled": timeline.RECORDER.enabled,
+        "causes": list(timeline.ATTRIBUTION_CAUSES),
+        "cycles": timeline.RECORDER.cycles(cycles),
+    }
+
+
 def debug_profile_body(scheduler, seconds) -> dict:
     """The /debug/profile?seconds=N payload: an on-demand jax.profiler
     capture.  403 while the gate is off (the default), 409 while a
@@ -317,6 +346,7 @@ class DebugService:
         self.register("/debug/steady", self._steady)
         self.register("/debug/forecast", self._forecast)
         self.register("/debug/tenants", self._tenants)
+        self.register("/debug/timeline", self._timeline)
         self.register("/debug/profile", self._profile)
         self.register_prefix("/debug/trace/", self._trace)
         self.register_prefix("/debug/explain/", self._explain)
@@ -430,6 +460,12 @@ class DebugService:
         shares/queues/degraded state + cycle dispatch mode; typed 501
         without a tenancy front-end."""
         return debug_tenants_body(self.scheduler)
+
+    def _timeline(self, params: dict) -> object:
+        """The critical-path observatory's reconstructed cycle gantts
+        (/debug/timeline?cycles=N): segments, wall-time attribution,
+        device-idle intervals, critical path per cycle."""
+        return debug_timeline_body(self.scheduler, params)
 
     def _profile(self, params: dict) -> object:
         """On-demand jax.profiler capture (/debug/profile?seconds=N);

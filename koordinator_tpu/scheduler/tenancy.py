@@ -54,7 +54,7 @@ from functools import partial
 
 import numpy as np
 
-from koordinator_tpu import metrics
+from koordinator_tpu import metrics, timeline
 
 # JAX is imported lazily inside methods where possible, but the batched
 # path is core to this module; the scheduler stack already pulls JAX in.
@@ -131,6 +131,9 @@ class TenantScheduler:
         self.last_mode = "none"
         self.last_cycle_s = 0.0
         self.last_host_wait_fraction = 0.0
+        #: the last cycle's reconstructed timeline doc (ISSUE 18) —
+        #: None until a cycle ran with the recorder armed
+        self.last_timeline = None
         #: jit cache for the tenant-axis batched programs, keyed by the
         #: static solve knobs (shapes retrace inside jax.jit as usual)
         self._batched_fns: dict[tuple, object] = {}
@@ -292,7 +295,9 @@ class TenantScheduler:
                 return {}
             self.cycle_seq += 1
             t0 = time.perf_counter()
-            limits = self._admission_limits()
+            with timeline.RECORDER.section("host_other",
+                                           "tenancy.admission"):
+                limits = self._admission_limits()
             order = [t for t in self._tenants.values()]
             results: dict = {}
             if not order:
@@ -321,13 +326,37 @@ class TenantScheduler:
                 metrics.tenant_admission_share.set(
                     t.last_admitted / admitted_cycle,
                     labels={"tenant": t.name})
+            if timeline.RECORDER.enabled:
+                # timeline observatory (ISSUE 18): reconstruct the
+                # cycle's gantt, attribute its wall, publish the
+                # host_wait_attribution family, and back-annotate every
+                # tenant's flight records with the critical-path verdict
+                doc = timeline.RECORDER.finish_cycle(
+                    self.cycle_seq, t0, t0 + wall, mode=mode)
+                if doc is not None:
+                    self.last_timeline = doc
+                    for t in order:
+                        t.scheduler.flight_recorder.annotate_round(
+                            t.scheduler.round_seq, t.name,
+                            cycle_seq=doc["cycle"],
+                            cycle_critical_cause=doc["critical_cause"],
+                            cycle_critical_seconds=doc[
+                                "critical_seconds"])
             return results
 
     def _begin_round(self, tenant: Tenant, limits: dict[str, int]):
         """Acquire the tenant's round lock and apply its admission cap.
         Caller owns releasing via :meth:`_end_round`."""
         sched = tenant.scheduler
+        tl_armed = timeline.RECORDER.enabled
+        t0 = time.perf_counter() if tl_armed else 0.0
         sched.lock.acquire()
+        if tl_armed:
+            # contention with the sync reader threads (deltasync
+            # applies hold the same lock): the lock_wait slice of the
+            # host-wait attribution
+            timeline.RECORDER.add(t0, time.perf_counter(), "lock_wait",
+                                  "round_lock.acquire", tenant.name)
         sched.round_pod_limit = limits.get(tenant.name)
 
     def _end_round(self, tenant: Tenant) -> None:
@@ -543,21 +572,28 @@ class TenantScheduler:
         try:
             for t in order:
                 sched = t.scheduler
-                sched._round_begin()
-                handle = sched._round_prepare()
+                # same blanket round_device wears on the per-tenant
+                # path: typed segments inside win the sweep, the
+                # prepare glue stops reading as unattributed
+                with timeline.RECORDER.section(
+                        "host_other", "round.prepare", t.name):
+                    sched._round_begin()
+                    handle = sched._round_prepare()
                 handle.start_wall = time.time()
                 handle.t0 = time.perf_counter()
                 pairs.append((t, handle))
             if self._batched_eligible(pairs):
                 self._dispatch_tenant_axis(pairs)
-                for t, handle in pairs:
-                    self._account_round(t, handle)
-                    if (t.scheduler._round_recordable
-                            and not handle.done):
-                        t.scheduler._round_flight_record(
-                            handle.result, "", handle.start_wall,
-                            time.perf_counter() - handle.t0,
-                            t.scheduler._current_path(), half="solve")
+                with timeline.RECORDER.section("host_other",
+                                               "round.publish"):
+                    for t, handle in pairs:
+                        self._account_round(t, handle)
+                        if (t.scheduler._round_recordable
+                                and not handle.done):
+                            t.scheduler._round_flight_record(
+                                handle.result, "", handle.start_wall,
+                                time.perf_counter() - handle.t0,
+                                t.scheduler._current_path(), half="solve")
                 for t, handle in pairs:
                     commit(t, handle)
             else:
@@ -611,9 +647,27 @@ class TenantScheduler:
     def _dispatch_tenant_axis(self, pairs) -> None:
         """ONE vmapped select+pass1 dispatch over every live tenant's
         stacked state — the leading tenant axis the issue names."""
+        live = [(t, h) for t, h in pairs if not h.done]
+        # timeline observatory (ISSUE 18): the stack/trace/unstack walls
+        # of the one vmapped program are solver dispatch, exactly like
+        # the per-tenant _round_dispatch window, and the async solve
+        # starts executing inside it — its start is the device-busy
+        # leading edge each tenant's block pairs with
+        dispatch_t0 = time.perf_counter()
+        try:
+            self._dispatch_tenant_axis_inner(live)
+        finally:
+            if timeline.RECORDER.enabled:
+                timeline.RECORDER.add(
+                    dispatch_t0, time.perf_counter(), "dispatch",
+                    "tenant_axis.dispatch")
+                for t, _ in live:
+                    if t.scheduler._tl_device_t0 is None:
+                        t.scheduler._tl_device_t0 = dispatch_t0
+
+    def _dispatch_tenant_axis_inner(self, live) -> None:
         from koordinator_tpu.ops import batch_assign as ba
 
-        live = [(t, h) for t, h in pairs if not h.done]
         states = [t.scheduler.snapshot.state for t, _ in live]
         batches = [h.batch for _, h in live]
         quotas = [h.quota for _, h in live]

@@ -23,13 +23,14 @@ host->device path stays a scatter of K rows, never a rebuild
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from collections import deque
 from typing import Optional
 
 import numpy as np
 
-from koordinator_tpu import metrics, tracing
+from koordinator_tpu import metrics, timeline, tracing
 from koordinator_tpu.transport import wire
 from koordinator_tpu.transport.wire import FrameType
 
@@ -831,9 +832,16 @@ def _dispatch_event(binding, entry: dict,
     pod's trace to the original submitter's span.  The entry is read,
     never mutated: the same dict may live in the service's stored state
     and replay log."""
+    # timeline segment (ISSUE 18): one deltasync_apply span per routed
+    # event — the binding holds scheduler.lock while it applies, so
+    # this is exactly the host work that contends with solve rounds
+    tl = (timeline.RECORDER.section(
+              "deltasync_apply", f"sync.{entry['kind']}")
+          if timeline.RECORDER.enabled else contextlib.nullcontext())
     ctx = tracing.TraceContext.from_doc(entry.get(tracing.TRACE_DOC_KEY))
     if ctx is None:
-        _route_event(binding, entry, arrs)
+        with tl:
+            _route_event(binding, entry, arrs)
         return
     with tracing.TRACER.span(
             f"sync.{entry['kind']}",
@@ -841,7 +849,8 @@ def _dispatch_event(binding, entry: dict,
             parent=ctx,
             attributes={"name": entry.get("name"),
                         "rv": entry.get("rv")}):
-        _route_event(binding, entry, arrs)
+        with tl:
+            _route_event(binding, entry, arrs)
 
 
 def _route_event(binding, entry: dict,

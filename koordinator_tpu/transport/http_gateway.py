@@ -51,6 +51,13 @@ Routes (all JSON bodies/responses unless noted):
                                           queue depth, degraded state,
                                           cycle dispatch mode (501
                                           without a tenancy front-end)
+    GET  /debug/timeline?cycles=N      -> the critical-path
+                                          observatory's reconstructed
+                                          cycle gantts: typed segments,
+                                          host-wait attribution,
+                                          device-idle intervals, and
+                                          the critical-path chain +
+                                          dominant cause per cycle
     GET  /debug/profile?seconds=N      -> on-demand jax.profiler
                                           capture; 403 unless enabled
                                           at assembly (gated off by
@@ -205,6 +212,8 @@ class HttpGateway:
             return self._debug_forecast(req)
         if method == "GET" and path == "/debug/tenants":
             return self._debug_tenants(req)
+        if method == "GET" and path == "/debug/timeline":
+            return self._debug_timeline(req)
         if method == "GET" and path == "/debug/profile":
             return self._debug_profile(req)
         m = self._TRACE.match(path)
@@ -394,6 +403,26 @@ class HttpGateway:
 
         try:
             return req._reply(200, debug_tenants_body(self.scheduler))
+        except DebugApiError as e:
+            return req._reply(e.status, {"error": e.message})
+
+    def _debug_timeline(self, req) -> None:
+        """The critical-path observatory's cycle gantts — same body the
+        DebugService serves (shared builder; ?cycles=N bounds the ring
+        slice, 400 on a malformed bound)."""
+        if self.scheduler is None:
+            return req._reply(501, {"error": "no scheduler attached"})
+        from urllib.parse import parse_qsl
+
+        from koordinator_tpu.scheduler.services import (
+            DebugApiError,
+            debug_timeline_body,
+        )
+
+        params = dict(parse_qsl(req.path.partition("?")[2]))
+        try:
+            return req._reply(200, debug_timeline_body(self.scheduler,
+                                                       params))
         except DebugApiError as e:
             return req._reply(e.status, {"error": e.message})
 

@@ -44,6 +44,7 @@ import dataclasses
 import enum
 import json
 import struct
+import time
 
 import numpy as np
 
@@ -173,7 +174,14 @@ class Frame:
 
 
 def encode_payload(doc: dict, arrays: dict[str, np.ndarray] | None = None) -> bytes:
-    """Pack a json-able doc + named numpy arrays into one payload."""
+    """Pack a json-able doc + named numpy arrays into one payload.
+
+    Instrumented (ISSUE 18): codec wall + payload bytes feed the
+    ``wire_codec_duration_seconds`` / ``wire_payload_bytes``
+    histograms and, when the timeline recorder is armed, a
+    ``json_codec`` segment — the codec's slice of the host-wait
+    attribution."""
+    t0 = time.perf_counter()
     blobs = []
     manifest = []
     offset = 0
@@ -190,10 +198,13 @@ def encode_payload(doc: dict, arrays: dict[str, np.ndarray] | None = None) -> by
     if manifest:
         out["__arrays__"] = manifest
     j = json.dumps(out, separators=(",", ":")).encode()
-    return struct.pack("<I", len(j)) + j + b"".join(blobs)
+    payload = struct.pack("<I", len(j)) + j + b"".join(blobs)
+    _observe_codec("encode", t0, len(payload))
+    return payload
 
 
 def decode_payload(payload: bytes) -> tuple[dict, dict[str, np.ndarray]]:
+    t0 = time.perf_counter()
     (json_len,) = struct.unpack_from("<I", payload, 0)
     doc = json.loads(payload[4:4 + json_len].decode())
     arrays: dict[str, np.ndarray] = {}
@@ -206,7 +217,18 @@ def decode_payload(payload: bytes) -> tuple[dict, dict[str, np.ndarray]]:
             offset=start,
         ).reshape(entry["shape"])
         arrays[entry["key"]] = arr
+    _observe_codec("decode", t0, len(payload))
     return doc, arrays
+
+
+def _observe_codec(op: str, t0: float, nbytes: int) -> None:
+    from koordinator_tpu import metrics, timeline
+
+    t1 = time.perf_counter()
+    metrics.wire_codec_seconds.observe(t1 - t0, labels={"op": op})
+    metrics.wire_payload_bytes.observe(float(nbytes), labels={"op": op})
+    if timeline.RECORDER.enabled:
+        timeline.RECORDER.add(t0, t1, "json_codec", f"wire.{op}")
 
 
 def read_frame(recv_exact) -> Frame:

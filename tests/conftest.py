@@ -37,9 +37,13 @@ def _isolate_metrics():
     deltas mid-test still see them; tests that assert absolute values
     start from a clean slate."""
     yield
-    from koordinator_tpu import metrics
+    from koordinator_tpu import metrics, timeline
 
     metrics.reset_all_for_tests()
+    # the timeline recorder is process-wide like the registries: drop
+    # recorded segments/cycles so one test's rounds can't attribute
+    # into another's window
+    timeline.RECORDER.reset_for_tests()
 
 
 def prop_seeds(default_n: int) -> list[int]:

@@ -31,7 +31,7 @@ def test_stage_profiler_smoke():
                       "sharded_2d_footprint",
                       "explain_compact_1pct", "explain_full_batch",
                       "tenancy_serial", "tenancy_pipelined",
-                      "tenancy_batched"}, stages
+                      "tenancy_batched", "timeline_overhead"}, stages
     by_stage = {r["stage"]: r for r in records}
     # every timed stage produced a positive per-iteration time
     for name in ("score", "select_approx", "select_chunked", "rounds",
@@ -79,6 +79,12 @@ def test_stage_profiler_smoke():
     assert "within_5pct" in by_stage["explain_compact_1pct"]
     # the rounds stage really assigned pods (256 pods, ample capacity)
     assert by_stage["rounds"]["assigned_per_iter"] > 0
+    # the timeline self-overhead stage (ISSUE 18) reports the on/off
+    # wall comparison the perf sentinel gates; the fraction can dip
+    # negative on timing noise but must exist and the timed wall must
+    # be real
+    assert by_stage["timeline_overhead"]["ms_per_iter"] > 0
+    assert by_stage["timeline_overhead"]["overhead_fraction"] is not None
 
 
 def test_latest_probe_capture_selection(tmp_path):

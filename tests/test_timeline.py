@@ -1,0 +1,660 @@
+"""Critical-path observatory (ISSUE 18): the acceptance suite.
+
+The contracts under test:
+
+- **sweepline attribution**: over any window the per-cause seconds sum
+  to the window exactly, the highest-priority covering segment wins at
+  every instant (nesting puts a block wait above its containing
+  phase), uncovered wall lands in the explicit ``unattributed``
+  residual, and the covering chain merges same-cause neighbours;
+- **device idle**: idle intervals are the window minus the union of
+  the dispatch->block ``device_busy`` spans;
+- **phase accounting** on a REAL pipelined multi-tenant cycle: the
+  attribution fractions sum to 1.0, ``unattributed`` stays under 5%,
+  and the ``device_block`` bucket matches
+  ``pipeline_host_wait_fraction`` (same block_until_ready intervals —
+  compared with approx, never ``==``: the gauge sums per-tenant
+  accumulators, the sweep sums elementary intervals);
+- **/debug/timeline** parity across DebugService and the HTTP gateway
+  (shared ``debug_timeline_body``) with a typed 400 on a bad bound;
+- **kill switch**: ``--no-timeline`` / ``set_enabled(False)`` records
+  nothing and leaves scheduling decisions bit-identical, at under 3%
+  measured wall overhead;
+- **perfetto export**: ``tools/trace_dump.py --perfetto`` round-trips
+  recorded segments and device-idle intervals to microsecond
+  precision;
+- **training export**: ``soak_report.export_training_records`` joins
+  rounds to cycles by ``cycle_seq``, stamps the schema version, and is
+  byte-deterministic.
+
+Compile budget: every scheduler in this module shares ONE
+``SolverKit(mesh="off")`` module fixture and tiny shapes.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from koordinator_tpu import timeline
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _seg(start, end, cause, name="", tenant=""):
+    return {"start": start, "end": end, "cause": cause, "name": name,
+            "tenant": tenant}
+
+
+@pytest.fixture(scope="module")
+def kit_off():
+    from koordinator_tpu.scheduler.solver_kit import SolverKit
+
+    return SolverKit(mesh="off")
+
+
+def _feed_nodes(scheduler, n=8, seed=3):
+    from koordinator_tpu.api.resources import resource_vector
+    from koordinator_tpu.scheduler.snapshot import NodeSpec
+
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        scheduler.snapshot.upsert_node(NodeSpec(
+            name=f"n{i}",
+            allocatable=resource_vector(
+                cpu=int(rng.integers(8_000, 32_000)),
+                memory=int(rng.integers(16_384, 65_536))),
+            usage=resource_vector(cpu=int(rng.integers(0, 2_000)),
+                                  memory=int(rng.integers(0, 4_096)))))
+
+
+def _enqueue_pods(scheduler, n, seed=0):
+    from koordinator_tpu.api.resources import resource_vector
+    from koordinator_tpu.scheduler.snapshot import PodSpec
+
+    rng = np.random.default_rng(seed)
+    for j in range(n):
+        scheduler.enqueue(PodSpec(
+            name=f"p{seed}-{j}",
+            requests=resource_vector(cpu=int(rng.integers(200, 2_000)),
+                                     memory=int(rng.integers(256, 4_096))),
+            priority=int(rng.integers(3_000, 9_999))))
+
+
+def _lone_scheduler(kit, capacity=32, seed=3):
+    from koordinator_tpu.scheduler import ClusterSnapshot, Scheduler
+
+    sched = Scheduler(ClusterSnapshot(capacity=capacity), mesh="off",
+                      solver_kit=kit)
+    _feed_nodes(sched, seed=seed)
+    return sched
+
+
+def _make_front(kit, tenants=("a", "b")):
+    from koordinator_tpu.scheduler.tenancy import (
+        TenantScheduler,
+        TenantSpec,
+    )
+
+    front = TenantScheduler(solver_kit=kit, cycle_pod_budget=1 << 20)
+    for name in tenants:
+        front.add_tenant(TenantSpec(name=name, node_capacity=16),
+                         batch_solver_threshold=1)
+    for ti, tenant in enumerate(front.tenants()):
+        _feed_nodes(tenant.scheduler, seed=11 + ti)
+    return front
+
+
+# ---------------------------------------------------------------------------
+# sweepline attribution (pure host math, no JAX)
+# ---------------------------------------------------------------------------
+
+
+class TestSweepAttribution:
+    def test_totals_sum_to_window_exactly(self):
+        segs = [_seg(1.0, 3.0, "host_other"),
+                _seg(2.0, 4.0, "device_block"),
+                _seg(6.0, 7.5, "bind_commit")]
+        totals, chain = timeline.sweep_attribution(segs, 0.0, 10.0)
+        assert sum(totals.values()) == pytest.approx(10.0)
+        # the chain covers the window end to end, in order
+        assert chain[0]["start"] == 0.0 and chain[-1]["end"] == 10.0
+        for a, b in zip(chain, chain[1:]):
+            assert a["end"] == b["start"]
+
+    def test_highest_priority_covering_segment_wins(self):
+        # a block wait nested inside a phase attributes as device_block
+        segs = [_seg(0.0, 10.0, "host_other", "phase.Solve"),
+                _seg(2.0, 4.0, "device_block", "block_until_ready")]
+        totals, _ = timeline.sweep_attribution(segs, 0.0, 10.0)
+        assert totals["device_block"] == pytest.approx(2.0)
+        assert totals["host_other"] == pytest.approx(8.0)
+        assert totals[timeline.UNATTRIBUTED] == 0.0
+
+    def test_gaps_land_in_unattributed(self):
+        segs = [_seg(0.0, 2.0, "build_batch"), _seg(5.0, 8.0, "bind_commit")]
+        totals, chain = timeline.sweep_attribution(segs, 0.0, 10.0)
+        assert totals[timeline.UNATTRIBUTED] == pytest.approx(5.0)
+        causes = [c["cause"] for c in chain]
+        assert causes == ["build_batch", timeline.UNATTRIBUTED,
+                          "bind_commit", timeline.UNATTRIBUTED]
+
+    def test_chain_merges_adjacent_same_cause(self):
+        segs = [_seg(0.0, 2.0, "deltasync_apply"),
+                _seg(2.0, 5.0, "deltasync_apply")]
+        totals, chain = timeline.sweep_attribution(segs, 0.0, 5.0)
+        assert totals["deltasync_apply"] == pytest.approx(5.0)
+        assert len(chain) == 1
+        assert chain[0] == {"start": 0.0, "end": 5.0,
+                            "cause": "deltasync_apply", "name": ""}
+
+    def test_segments_clip_to_the_window(self):
+        segs = [_seg(-5.0, 2.0, "json_codec"), _seg(8.0, 20.0, "lock_wait")]
+        totals, _ = timeline.sweep_attribution(segs, 0.0, 10.0)
+        assert totals["json_codec"] == pytest.approx(2.0)
+        assert totals["lock_wait"] == pytest.approx(2.0)
+        assert totals[timeline.UNATTRIBUTED] == pytest.approx(6.0)
+
+    def test_degenerate_window(self):
+        totals, chain = timeline.sweep_attribution(
+            [_seg(0.0, 1.0, "dispatch")], 5.0, 5.0)
+        assert sum(totals.values()) == 0.0
+        assert chain == []
+
+    def test_device_busy_never_attributes(self):
+        segs = [_seg(0.0, 10.0, timeline.DEVICE_BUSY, "solve")]
+        totals, chain = timeline.sweep_attribution(segs, 0.0, 10.0)
+        assert totals[timeline.UNATTRIBUTED] == pytest.approx(10.0)
+        assert [c["cause"] for c in chain] == [timeline.UNATTRIBUTED]
+
+
+class TestDeviceIdle:
+    def test_idle_is_the_complement_of_merged_busy(self):
+        segs = [_seg(1.0, 3.0, timeline.DEVICE_BUSY),
+                _seg(2.0, 5.0, timeline.DEVICE_BUSY),   # overlaps -> merge
+                _seg(7.0, 8.0, timeline.DEVICE_BUSY),
+                _seg(0.0, 10.0, "host_other")]          # ignored
+        idle, busy_s = timeline.device_idle(segs, 0.0, 10.0)
+        assert busy_s == pytest.approx(5.0)
+        assert idle == [(0.0, 1.0), (5.0, 7.0), (8.0, 10.0)]
+
+    def test_no_busy_means_fully_idle(self):
+        idle, busy_s = timeline.device_idle([], 2.0, 6.0)
+        assert busy_s == 0.0
+        assert idle == [(2.0, 6.0)]
+
+
+# ---------------------------------------------------------------------------
+# the recorder
+# ---------------------------------------------------------------------------
+
+
+class TestRecorder:
+    def test_cycle_doc_shape_and_critical_path(self):
+        rec = timeline.TimelineRecorder()
+        rec.add(100.0, 103.0, "build_batch", "phase.BatchBuild", "a")
+        rec.add(103.0, 104.0, "device_block", "block_until_ready", "a")
+        rec.add(100.5, 104.0, timeline.DEVICE_BUSY, "solve", "a")
+        doc = rec.finish_cycle(7, 100.0, 110.0, mode="pipelined",
+                               publish=False)
+        assert doc["cycle"] == 7 and doc["mode"] == "pipelined"
+        assert doc["wall_s"] == pytest.approx(10.0)
+        # fractions sum to 1.0 with the residual included
+        assert sum(doc["attribution"].values()) == pytest.approx(1.0)
+        assert doc["unattributed_fraction"] == pytest.approx(0.6)
+        # segments re-based to the window start
+        assert doc["segments"][0]["start"] == pytest.approx(0.0)
+        # busy spans 100.5..104.0 -> idle 0..0.5 and 4..10
+        assert doc["device_busy_s"] == pytest.approx(3.5)
+        assert doc["device_idle_fraction"] == pytest.approx(0.65)
+        assert doc["device_idle"] == [
+            pytest.approx((0.0, 0.5)), pytest.approx((4.0, 10.0))]
+        # build_batch holds 3 of the 4 attributed seconds
+        assert doc["critical_cause"] == "build_batch"
+        assert doc["critical_seconds"] == pytest.approx(3.0)
+        assert doc["attribution_s"]["device_block"] == pytest.approx(1.0)
+
+    def test_cycles_are_newest_first_and_bounded(self):
+        rec = timeline.TimelineRecorder(max_cycles=4)
+        for i in range(6):
+            rec.add(float(i), i + 0.5, "host_other")
+            rec.finish_cycle(i, float(i), i + 1.0, publish=False)
+        got = [d["cycle"] for d in rec.cycles(limit=16)]
+        assert got == [5, 4, 3, 2]
+        assert [d["cycle"] for d in rec.cycles(limit=2)] == [5, 4]
+
+    def test_consumed_segments_never_reattribute(self):
+        rec = timeline.TimelineRecorder()
+        rec.add(0.0, 1.0, "bind_commit")
+        first = rec.finish_cycle(1, 0.0, 2.0, publish=False)
+        assert first["attribution_s"]["bind_commit"] == pytest.approx(1.0)
+        again = rec.finish_cycle(2, 0.0, 2.0, publish=False)
+        assert again["attribution_s"]["bind_commit"] == 0.0
+
+    def test_disabled_recorder_is_inert(self):
+        rec = timeline.TimelineRecorder(enabled=False)
+        rec.add(0.0, 1.0, "host_other")
+        with rec.section("json_codec"):
+            pass
+        assert rec.finish_cycle(1, 0.0, 2.0, publish=False) is None
+        assert rec.cycles() == []
+
+    def test_kill_switch_drops_pending_segments(self):
+        rec = timeline.TimelineRecorder()
+        rec.add(0.0, 1.0, "host_other")
+        rec.set_enabled(False)
+        rec.set_enabled(True)
+        doc = rec.finish_cycle(1, 0.0, 2.0, publish=False)
+        assert doc["attribution_s"]["host_other"] == 0.0
+
+    def test_backwards_and_empty_segments_ignored(self):
+        rec = timeline.TimelineRecorder()
+        rec.add(5.0, 5.0, "host_other")
+        rec.add(5.0, 4.0, "host_other")
+        doc = rec.finish_cycle(1, 0.0, 10.0, publish=False)
+        assert doc["segments"] == []
+
+
+# ---------------------------------------------------------------------------
+# real rounds / cycles
+# ---------------------------------------------------------------------------
+
+
+class TestRoundReconstruction:
+    """An untenanted scheduler's round is its own one-round cycle."""
+
+    def test_schedule_round_reconstructs_and_annotates(self, kit_off):
+        timeline.RECORDER.reset_for_tests()
+        sched = _lone_scheduler(kit_off)
+        _enqueue_pods(sched, 6, seed=1)
+        result = sched.schedule_round()
+        assert result.assignments
+        docs = timeline.RECORDER.cycles(1)
+        assert len(docs) == 1
+        doc = docs[0]
+        assert doc["mode"] == "round"
+        assert doc["cycle"] == sched.round_seq
+        assert sum(doc["attribution"].values()) == pytest.approx(1.0)
+        # the round recorded real segments: phases + the block wait
+        causes = {s["cause"] for s in doc["segments"]}
+        assert "device_block" in causes
+        assert "host_other" in causes
+        assert 0.0 <= doc["device_idle_fraction"] <= 1.0
+        # the flight record carries the critical-path join
+        rec = list(sched.flight_recorder.records)[-1]
+        assert rec.cycle_seq == doc["cycle"]
+        assert rec.cycle_critical_cause == doc["critical_cause"]
+        assert rec.cycle_critical_seconds == pytest.approx(
+            doc["critical_seconds"])
+
+    def test_published_gauges_cover_every_cause(self, kit_off):
+        from koordinator_tpu import metrics
+
+        timeline.RECORDER.reset_for_tests()
+        sched = _lone_scheduler(kit_off, seed=5)
+        _enqueue_pods(sched, 4, seed=2)
+        sched.schedule_round()
+        doc = timeline.RECORDER.cycles(1)[0]
+        got = {}
+        for (labels, value) in metrics.host_wait_attribution.items():
+            got[dict(labels)["cause"]] = value
+        assert set(got) == set(timeline.ATTRIBUTION_CAUSES)
+        assert sum(got.values()) == pytest.approx(1.0)
+        assert got["device_block"] == pytest.approx(
+            doc["attribution"]["device_block"])
+        assert metrics.device_idle_fraction.value() == pytest.approx(
+            doc["device_idle_fraction"])
+
+
+class TestPhaseAccountingInvariant:
+    """The named segments + attributed gaps must sum to the cycle wall
+    with the unattributed residual under 5% — silently untimed host
+    work can never reappear (ISSUE 18 satellite)."""
+
+    @pytest.fixture(scope="class")
+    def cycled_front(self, kit_off):
+        timeline.RECORDER.reset_for_tests()
+        front = _make_front(kit_off)
+        # cycle 1 pays the jit compiles (still attributed: compile wall
+        # lands inside the dispatch/Solve segments); measure after
+        docs = []
+        for i in range(4):
+            for ti, tenant in enumerate(front.tenants()):
+                _enqueue_pods(tenant.scheduler, 6, seed=100 + 10 * i + ti)
+            front.schedule_cycle()
+            docs.append((front.last_timeline,
+                         front.last_host_wait_fraction))
+        return front, docs
+
+    def test_attribution_sums_to_the_wall(self, cycled_front):
+        _, docs = cycled_front
+        for doc, _ in docs:
+            assert doc is not None
+            assert sum(doc["attribution"].values()) == pytest.approx(1.0)
+            assert sum(doc["attribution_s"].values()) == pytest.approx(
+                doc["wall_s"])
+
+    def test_unattributed_residual_under_5pct(self, cycled_front):
+        _, docs = cycled_front
+        # min over warm cycles: one descheduled hiccup must not flake
+        # the invariant, but SOME cycle has to meet the bar squarely
+        best = min(doc["unattributed_fraction"] for doc, _ in docs[1:])
+        assert best < 0.05, [d["unattributed_fraction"] for d, _ in docs]
+
+    def test_device_block_matches_pipeline_host_wait_fraction(
+            self, cycled_front):
+        _, docs = cycled_front
+        for doc, gauge in docs[1:]:
+            # same intervals, different summation order -> approx
+            assert doc["attribution"]["device_block"] == pytest.approx(
+                gauge, abs=0.02)
+
+    def test_cycle_mode_and_tenant_tags(self, cycled_front):
+        front, docs = cycled_front
+        doc, _ = docs[-1]
+        assert doc["mode"] == front.last_mode
+        tenants = {s["tenant"] for s in doc["segments"]} - {""}
+        assert tenants == {"a", "b"}
+
+
+# ---------------------------------------------------------------------------
+# debug surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestDebugTimelineSurfaces:
+    def test_parity_across_both_surfaces(self, kit_off):
+        import urllib.request
+
+        from koordinator_tpu.scheduler.services import DebugService
+        from koordinator_tpu.transport.http_gateway import HttpGateway
+
+        timeline.RECORDER.reset_for_tests()
+        sched = _lone_scheduler(kit_off, seed=7)
+        _enqueue_pods(sched, 4, seed=3)
+        sched.schedule_round()
+        service = DebugService(sched)
+        status, body = service.handle("/debug/timeline", {"cycles": "4"})
+        assert status == 200
+        assert body["enabled"] is True
+        assert body["causes"] == list(timeline.ATTRIBUTION_CAUSES)
+        assert len(body["cycles"]) == 1
+        assert body["cycles"][0]["critical_cause"]
+
+        gateway = HttpGateway(scheduler=sched)
+        gateway.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{gateway.port}"
+                    f"/debug/timeline?cycles=4") as resp:
+                gw_body = json.loads(resp.read())
+        finally:
+            gateway.stop()
+        # the gateway body is the same builder's output json-roundtripped
+        assert gw_body == json.loads(json.dumps(body))
+
+    def test_bad_bound_is_a_typed_400_on_both_surfaces(self, kit_off):
+        import urllib.error
+        import urllib.request
+
+        from koordinator_tpu.scheduler.services import DebugService
+        from koordinator_tpu.transport.http_gateway import HttpGateway
+
+        sched = _lone_scheduler(kit_off, seed=9)
+        service = DebugService(sched)
+        assert service.handle("/debug/timeline", {"cycles": "bogus"})[0] == 400
+        assert service.handle("/debug/timeline", {"cycles": "0"})[0] == 400
+        assert service.handle("/debug/timeline", {"cycles": "-3"})[0] == 400
+
+        gateway = HttpGateway(scheduler=sched)
+        gateway.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{gateway.port}"
+                    f"/debug/timeline?cycles=bogus")
+            assert err.value.code == 400
+        finally:
+            gateway.stop()
+
+
+# ---------------------------------------------------------------------------
+# kill switch: bit-identity + overhead
+# ---------------------------------------------------------------------------
+
+
+class TestKillSwitch:
+    def test_no_timeline_flag_parses(self):
+        from koordinator_tpu.cmd.binaries import build_scheduler_parser
+
+        args = build_scheduler_parser().parse_args(["--no-timeline"])
+        assert args.no_timeline is True
+        assert build_scheduler_parser().parse_args([]).no_timeline is False
+
+    def test_decisions_bit_identical_with_recorder_off(self, kit_off):
+        def run(enabled):
+            timeline.RECORDER.reset_for_tests()
+            was = timeline.RECORDER.enabled
+            timeline.RECORDER.set_enabled(enabled)
+            try:
+                sched = _lone_scheduler(kit_off, seed=13)
+                _enqueue_pods(sched, 8, seed=4)
+                result = sched.schedule_round()
+                return (dict(result.assignments),
+                        sorted(result.failures),
+                        len(timeline.RECORDER.cycles()))
+            finally:
+                timeline.RECORDER.set_enabled(was)
+
+        on_assign, on_fail, on_cycles = run(True)
+        off_assign, off_fail, off_cycles = run(False)
+        assert on_assign == off_assign
+        assert on_fail == off_fail
+        assert on_cycles == 1 and off_cycles == 0
+
+    def test_recording_overhead_under_3pct(self, kit_off):
+        """The recorder's whole per-cycle cost — every segment add plus
+        the finish_cycle sweep/publish — must stay under 3% of a real
+        cycle's wall.  Measured by REPLAYING an actual recorded cycle's
+        segments through a fresh recorder: an end-to-end on/off wall
+        diff at unit-test scale drowns in scheduler jitter (the
+        bench_stages ``timeline_overhead`` stage measures that form at
+        soak scale, ~1%), while the replay bounds the same cost
+        deterministically against the same cycle's measured wall."""
+        import itertools
+        import time as _time
+
+        front = _make_front(kit_off)
+        seeds = itertools.count(3000)
+        walls = []
+        for _ in range(5):
+            for tenant in front.tenants():
+                _enqueue_pods(tenant.scheduler, 8, seed=next(seeds))
+            t0 = _time.perf_counter()
+            front.schedule_cycle()
+            walls.append(_time.perf_counter() - t0)
+        wall = min(walls[1:])       # post-compile cycle-wall floor
+        doc = front.last_timeline
+        segs = doc["segments"]
+        assert len(segs) >= 10      # a genuinely instrumented cycle
+
+        rec = timeline.TimelineRecorder()
+        reps, costs = 50, []
+        for _ in range(5):
+            t0 = _time.perf_counter()
+            for i in range(reps):
+                for s in segs:
+                    rec.add(s["start"], s["end"], s["cause"],
+                            s["name"], s["tenant"])
+                rec.finish_cycle(i, 0.0, doc["wall_s"], mode="replay")
+            costs.append((_time.perf_counter() - t0) / reps)
+        cost = min(costs)           # the defensible cost floor
+        overhead = cost / wall
+        assert overhead < 0.03, (
+            f"recorder cost {cost*1e6:.0f}us on a {wall*1e3:.2f}ms "
+            f"cycle = {overhead:.1%}")
+
+
+# ---------------------------------------------------------------------------
+# perfetto export round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestPerfettoExport:
+    def _recorded_cycle(self, kit_off):
+        """A REAL recorded cycle doc + the round's spans, like a soak
+        trace capture would hold."""
+        from koordinator_tpu import tracing
+
+        timeline.RECORDER.reset_for_tests()
+        exporter = tracing.InMemoryExporter()
+        tracing.TRACER.add_exporter(exporter)
+        try:
+            sched = _lone_scheduler(kit_off, seed=21)
+            _enqueue_pods(sched, 4, seed=6)
+            sched.schedule_round()
+        finally:
+            tracing.TRACER.remove_exporter(exporter)
+        cycle = timeline.RECORDER.cycles(1)[0]
+        spans = [s.to_doc() for s in exporter.spans]
+        assert spans, "round must have produced spans"
+        return cycle, spans
+
+    def test_round_trip_on_a_recorded_trace(self, kit_off, tmp_path):
+        import trace_dump
+
+        cycle, spans = self._recorded_cycle(kit_off)
+        src = tmp_path / "soak_trace.jsonl"
+        with open(src, "w") as f:
+            for doc in spans + [cycle]:
+                f.write(json.dumps(doc, default=str) + "\n")
+        out = tmp_path / "perfetto.json"
+        assert trace_dump.main([str(src), "--perfetto", str(out)]) == 0
+        body = json.loads(out.read_text())
+        events = body["traceEvents"]
+
+        # track metadata: every service + the timeline process named
+        names = {e["args"]["name"] for e in events
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert "timeline" in names
+        assert "scheduler" in names
+
+        # every recorded segment round-trips to its X event (us clock)
+        t0 = cycle["start"]
+        xs = [e for e in events
+              if e["ph"] == "X" and e.get("cat") in timeline.CAUSES
+              + (timeline.DEVICE_BUSY,)]
+        assert len(xs) == len(cycle["segments"])
+        got = sorted((e["ts"], e["args"]["cause"]) for e in xs)
+        want = sorted(((t0 + s["start"]) * 1e6, s["cause"])
+                      for s in cycle["segments"])
+        for (gts, gcause), (wts, wcause) in zip(got, want):
+            assert gts == pytest.approx(wts, abs=1.0)   # 1 us
+            assert gcause == wcause
+
+        # device-idle intervals become balanced async begin/end pairs
+        begins = [e for e in events if e["ph"] == "b"]
+        ends = [e for e in events if e["ph"] == "e"]
+        assert len(begins) == len(ends) == len(cycle["device_idle"])
+        for b, (i0, _) in zip(sorted(begins, key=lambda e: e["ts"]),
+                              cycle["device_idle"]):
+            assert b["ts"] == pytest.approx((t0 + i0) * 1e6, abs=1.0)
+
+        # span docs kept their ids for the cross-reference
+        span_events = [e for e in events
+                       if e["ph"] == "X" and "trace_id" in e["args"]]
+        assert {e["args"]["trace_id"] for e in span_events} == {
+            s["trace_id"] for s in spans}
+
+    def test_export_without_input_fails(self, tmp_path):
+        import trace_dump
+
+        src = tmp_path / "empty.jsonl"
+        src.write_text("not json\n")
+        assert trace_dump.main(
+            [str(src), "--perfetto", str(tmp_path / "o.json")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# training-record export
+# ---------------------------------------------------------------------------
+
+
+class TestTrainingExport:
+    def _inputs(self):
+        rounds = [
+            {"round": 3, "tenant": "a", "cycle_seq": 9, "placed": 4,
+             "solve_path": "incremental"},
+            {"round": 3, "tenant": "b", "cycle_seq": 9, "placed": 2,
+             "solve_path": "full_cold"},
+            {"round": 2, "tenant": "a", "cycle_seq": -1, "placed": 1,
+             "solve_path": "full_cold"},
+        ]
+        cycles = [{"cycle": 9, "mode": "pipelined", "wall_s": 0.25,
+                   "attribution": {"device_block": 0.5,
+                                   "unattributed": 0.5},
+                   "unattributed_fraction": 0.5,
+                   "device_idle_fraction": 0.4,
+                   "critical_cause": "device_block",
+                   "critical_seconds": 0.125}]
+        slo = {"scheduling_latency_p99": {
+            "breaches_total": 1,
+            "peak_burn": {"fast": 20.0, "slow": 2.0}}}
+        return rounds, cycles, slo
+
+    def test_join_schema_and_determinism(self, tmp_path):
+        from soak_report import (
+            TRAINING_SCHEMA_VERSION,
+            export_training_records,
+        )
+
+        rounds, cycles, slo = self._inputs()
+        p1, p2 = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        assert export_training_records(rounds, cycles, slo, str(p1)) == 3
+        assert export_training_records(rounds, cycles, slo, str(p2)) == 3
+        # byte determinism: same inputs, byte-identical output
+        assert p1.read_bytes() == p2.read_bytes()
+
+        lines = [json.loads(l) for l in p1.read_text().splitlines()]
+        for line in lines:
+            assert line["schema_version"] == TRAINING_SCHEMA_VERSION
+            assert line["slo"]["scheduling_latency_p99"][
+                "peak_burn_fast"] == 20.0
+        # rounds of cycle 9 joined their timeline features; the
+        # unannotated round carries the null sentinel
+        assert lines[0]["timeline"]["critical_cause"] == "device_block"
+        assert lines[1]["timeline"]["device_idle_fraction"] == 0.4
+        assert lines[2]["timeline"] is None
+
+    def test_gather_from_a_live_scheduler(self, kit_off, tmp_path):
+        from types import SimpleNamespace
+
+        from soak_report import (
+            export_training_records,
+            gather_training_inputs,
+        )
+
+        timeline.RECORDER.reset_for_tests()
+        sched = _lone_scheduler(kit_off, seed=23)
+        _enqueue_pods(sched, 5, seed=8)
+        sched.schedule_round()
+        harness = SimpleNamespace(front=None, scheduler=sched)
+        rounds, cycles = gather_training_inputs(harness)
+        assert rounds and cycles
+        out = tmp_path / "train.jsonl"
+        n = export_training_records(rounds, cycles, {}, str(out))
+        assert n == len(rounds)
+        lines = [json.loads(l) for l in out.read_text().splitlines()]
+        # the live round joined its reconstructed cycle
+        joined = [l for l in lines if l["timeline"] is not None]
+        assert joined
+        assert joined[-1]["round"]["cycle_seq"] == cycles[0]["cycle"]
+        assert joined[-1]["timeline"]["critical_cause"] == (
+            cycles[0]["critical_cause"])

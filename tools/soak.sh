@@ -79,6 +79,23 @@
 #                                     breaches and evictions and
 #                                     pre-staged at least one
 #                                     migration
+#         SOAK_BENCH_DIFF (default 0) 1 = end the run with the perf
+#                                     regression sentinel: a fresh
+#                                     bench_stages --smoke capture is
+#                                     diffed per-stage against the
+#                                     committed baseline
+#                                     (tools/baselines/
+#                                     bench_stages_smoke.jsonl) via
+#                                     tools/bench_diff.py and the soak
+#                                     FAILS on any stage regressing
+#                                     beyond SOAK_BENCH_DIFF_TOLERANCE
+#                                     (default 1.0 = 100%: the
+#                                     committed baseline was captured
+#                                     on different hardware, so the
+#                                     default only catches
+#                                     order-of-magnitude rot; tighten
+#                                     it when soaking on the baseline
+#                                     machine)
 #         SOAK_CHAOS   (default 0)    1 = also sweep the chaos
 #                                     fault-injection suite (tests/
 #                                     test_chaos.py, `chaos` marker)
@@ -117,6 +134,9 @@ FORECAST=${SOAK_FORECAST:-0}
 TRACE=${SOAK_TRACE:-0}
 SLO=${SOAK_SLO:-1}
 EXPLAIN=${SOAK_EXPLAIN:-1}
+BENCH_DIFF=${SOAK_BENCH_DIFF:-0}
+BENCH_DIFF_TOLERANCE=${SOAK_BENCH_DIFF_TOLERANCE:-1.0}
+BENCH_BASELINE=${SOAK_BENCH_BASELINE:-tools/baselines/bench_stages_smoke.jsonl}
 mkdir -p "$OUT"
 ts=$(date +%Y%m%d_%H%M%S)
 log="$OUT/soak_$ts.log"
@@ -355,6 +375,29 @@ if [ "$FORECAST" = "1" ]; then
         total_failed=$((total_failed + 1))
         failures="$failures;forecast A/B: predictive arm worse than"
         failures="$failures reactive or zero prestaged migrations (see log)"
+    fi
+fi
+
+if [ "$BENCH_DIFF" = "1" ]; then
+    # perf regression sentinel BEFORE the tally so its verdict counts
+    # in the JSON: capture bench_stages --smoke fresh and diff every
+    # stage against the committed baseline; any stage beyond the
+    # tolerance (or missing/errored) fails the soak
+    bench_capture="$OUT/bench_stages_$ts.jsonl"
+    echo "== perf regression sentinel (bench_stages --smoke vs" \
+        "$BENCH_BASELINE, tolerance $BENCH_DIFF_TOLERANCE)" | tee -a "$log"
+    if python bench_stages.py --smoke > "$bench_capture" 2>> "$log" \
+            && python tools/bench_diff.py "$BENCH_BASELINE" \
+                "$bench_capture" --tolerance "$BENCH_DIFF_TOLERANCE" \
+                >> "$log" 2>&1; then
+        grep -E "bench_diff:" "$log" | tail -1
+        total_passed=$((total_passed + 1))
+    else
+        grep -E "\"verdict\": \"(regressed|missing|errored)\"|bench_diff:" \
+            "$log" | tail -6
+        total_failed=$((total_failed + 1))
+        failures="$failures;bench_diff: stage regression vs committed"
+        failures="$failures baseline (see log and $bench_capture)"
     fi
 fi
 

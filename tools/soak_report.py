@@ -120,6 +120,91 @@ def print_report(verdict: dict, harness) -> None:
     print(f"VERDICT: {'GREEN' if verdict['green'] else 'RED'}")
 
 
+#: training-record export schema (ISSUE 18 satellite).  Bump when a
+#: field changes MEANING; adding optional fields is compatible.  One
+#: JSONL line per flight round record:
+#:
+#:   schema_version  int    — this constant
+#:   round           dict   — the RoundRecord doc verbatim (see
+#:                            flight_recorder.RoundRecord: solve path,
+#:                            phase timings, wall/device split, tenant,
+#:                            cycle_seq + the critical-path join)
+#:   timeline        dict?  — per-cycle observatory features for the
+#:                            cycle the round ran in (null when the
+#:                            recorder was off or the cycle aged out of
+#:                            the ring): mode, wall_s, attribution
+#:                            fractions, unattributed_fraction,
+#:                            device_idle_fraction, critical_cause,
+#:                            critical_seconds
+#:   slo             dict   — the run's SLO burn snapshot keyed by SLO
+#:                            name: breaches_total, peak_burn_fast,
+#:                            peak_burn_slow (run-level, repeated per
+#:                            line so each record is self-contained)
+TRAINING_SCHEMA_VERSION = 1
+
+
+def export_training_records(round_docs: list[dict],
+                            cycle_docs: list[dict],
+                            slo: dict, path: str) -> int:
+    """Join flight records, timeline cycles, and the SLO snapshot into
+    the versioned training JSONL (schema above).  Deterministic: same
+    inputs yield byte-identical output (sorted keys, stable record
+    order is the caller's contract).  Returns lines written."""
+    by_cycle = {int(c["cycle"]): c for c in cycle_docs
+                if c.get("cycle") is not None}
+    slo_snapshot = {
+        name: {"breaches_total": s.get("breaches_total", 0),
+               "peak_burn_fast": (s.get("peak_burn") or {}).get("fast"),
+               "peak_burn_slow": (s.get("peak_burn") or {}).get("slow")}
+        for name, s in sorted((slo or {}).items())}
+    n = 0
+    with open(path, "w", encoding="utf-8") as fh:
+        for rec in round_docs:
+            cyc = by_cycle.get(rec.get("cycle_seq", -1))
+            features = None
+            if cyc is not None:
+                features = {
+                    "mode": cyc.get("mode"),
+                    "wall_s": cyc.get("wall_s"),
+                    "attribution": cyc.get("attribution"),
+                    "unattributed_fraction":
+                        cyc.get("unattributed_fraction"),
+                    "device_idle_fraction":
+                        cyc.get("device_idle_fraction"),
+                    "critical_cause": cyc.get("critical_cause"),
+                    "critical_seconds": cyc.get("critical_seconds"),
+                }
+            fh.write(json.dumps(
+                {"schema_version": TRAINING_SCHEMA_VERSION,
+                 "round": rec, "timeline": features,
+                 "slo": slo_snapshot},
+                sort_keys=True, default=str) + "\n")
+            n += 1
+    return n
+
+
+def gather_training_inputs(harness) -> tuple[list[dict], list[dict]]:
+    """Collect (round_docs, cycle_docs) from a finished harness in a
+    deterministic order: tenants sorted by name (the untenanted
+    scheduler as ""), each ring oldest-first; cycles newest-first from
+    the observatory ring."""
+    from koordinator_tpu import timeline
+
+    front = getattr(harness, "front", None)
+    if front is not None:
+        schedulers = sorted(((t.name, t.scheduler)
+                             for t in front.tenants()),
+                            key=lambda pair: pair[0])
+    else:
+        schedulers = [("", harness.scheduler)]
+    round_docs = []
+    for _, sched in schedulers:
+        round_docs.extend(
+            rec.to_doc() for rec in list(sched.flight_recorder.records))
+    cycle_docs = timeline.RECORDER.cycles(limit=1 << 20)
+    return round_docs, cycle_docs
+
+
 def forecast_ab_report(args) -> int:
     """The reactive-vs-predictive A/B scorecard (SOAK_FORECAST=1 /
     --forecast): one seeded diurnal trace through both arms, GREEN only
@@ -262,6 +347,14 @@ def main(argv: list[str] | None = None) -> int:
                              "exit 0 iff every scenario is GREEN")
     parser.add_argument("--json", action="store_true",
                         help="dump the raw verdict document too")
+    parser.add_argument("--export-training-records", metavar="OUT",
+                        default="",
+                        help="also write the run's per-round training "
+                             "records (flight record + per-cycle "
+                             "timeline/critical-path features + SLO "
+                             "burn snapshot, one JSONL line each; "
+                             "schema_version "
+                             f"{TRAINING_SCHEMA_VERSION}) to OUT")
     args = parser.parse_args(argv)
 
     if args.forecast:
@@ -301,6 +394,14 @@ def main(argv: list[str] | None = None) -> int:
             print_report(verdict, harness)
             if args.json:
                 print(json.dumps(verdict, indent=2, default=str))
+            if args.export_training_records:
+                rounds, cycles = gather_training_inputs(harness)
+                n = export_training_records(
+                    rounds, cycles, verdict.get("slo") or {},
+                    args.export_training_records)
+                print(f"-- training records: {n} written to "
+                      f"{args.export_training_records} "
+                      f"(schema v{TRAINING_SCHEMA_VERSION})")
         finally:
             harness.close()
     if args.quality_mode != "off":

@@ -14,6 +14,18 @@ Usage:
                                                      # scheduler.round
                                                      # span's flight
                                                      # record fields
+    tools/trace_dump.py trace.jsonl --perfetto out.json
+                                                     # Chrome trace-event
+                                                     # export (open in
+                                                     # ui.perfetto.dev)
+
+The ``--perfetto`` export also understands per-cycle timeline docs
+(the ``/debug/timeline`` cycle bodies, one JSON object per line mixed
+into or instead of the span lines): each timeline segment becomes a
+complete event on a per-tenant track under a "timeline" process, and
+the cycle's device-idle intervals become an async track so the idle
+gaps the critical-path solver attributed are visible as spans, not
+inferred from whitespace.
 
 Output per trace: spans sorted by start time, indented by parentage,
 with offset-from-trace-start and duration, e.g.
@@ -34,8 +46,14 @@ import sys
 from collections import defaultdict
 
 
-def load_spans(path: str) -> tuple[list[dict], int]:
-    spans, bad = [], 0
+def load_docs(path: str) -> tuple[list[dict], list[dict], int]:
+    """Split a JSONL export into (spans, timeline cycle docs, bad).
+
+    Span docs carry ``trace_id`` (the JsonlExporter's shape); timeline
+    cycle docs carry ``segments`` (the ``/debug/timeline`` body's
+    per-cycle shape) — both can ride the same file.
+    """
+    spans, cycles, bad = [], [], 0
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -48,9 +66,17 @@ def load_spans(path: str) -> tuple[list[dict], int]:
                 continue
             if isinstance(doc, dict) and doc.get("trace_id"):
                 spans.append(doc)
+            elif isinstance(doc, dict) and isinstance(
+                    doc.get("segments"), list):
+                cycles.append(doc)
             else:
                 bad += 1
-    return spans, bad
+    return spans, cycles, bad
+
+
+def load_spans(path: str) -> tuple[list[dict], int]:
+    spans, cycles, bad = load_docs(path)
+    return spans, bad + len(cycles)
 
 
 def group_traces(spans: list[dict]) -> dict[str, list[dict]]:
@@ -104,6 +130,92 @@ def print_trace(trace_id: str, trace: list[dict], out=sys.stdout) -> None:
               f"{_fmt_attrs(span.get('attributes') or {})}", file=out)
 
 
+def perfetto_events(spans: list[dict],
+                    cycles: list[dict]) -> list[dict]:
+    """Build Chrome trace-event objects (the JSON Array Format that
+    Perfetto/chrome://tracing load) from span and timeline-cycle docs.
+
+    Track layout: one process (pid) per emitting service, one thread
+    (tid) per tenant within it ("" renders as "main"); timeline cycle
+    docs get their own "timeline" process with the same per-tenant
+    thread split, plus an async device-idle track per cycle so the
+    attributed idle gaps show as spans.  Timestamps are the source
+    docs' own clocks in microseconds — spans use wall time, timeline
+    docs the monotonic perf counter — which Perfetto renders fine
+    because tracks are only compared within a process.
+    """
+    events: list[dict] = []
+    pids: dict[str, int] = {}
+    tids: dict[tuple[str, str], int] = {}
+
+    def pid_of(service: str) -> int:
+        if service not in pids:
+            pids[service] = len(pids) + 1
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pids[service], "tid": 0,
+                           "args": {"name": service}})
+        return pids[service]
+
+    def tid_of(service: str, tenant: str) -> int:
+        key = (service, tenant)
+        if key not in tids:
+            tids[key] = len(tids) + 1
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid_of(service), "tid": tids[key],
+                           "args": {"name": tenant or "main"}})
+        return tids[key]
+
+    for span in spans:
+        attrs = span.get("attributes") or {}
+        service = span.get("service") or "unknown"
+        tenant = str(attrs.get("tenant") or "")
+        events.append({
+            "ph": "X", "name": span.get("name") or "span",
+            "cat": service,
+            "pid": pid_of(service), "tid": tid_of(service, tenant),
+            "ts": (span.get("start_time") or 0.0) * 1e6,
+            "dur": max((span.get("duration_s") or 0.0) * 1e6, 1.0),
+            "args": {"trace_id": span.get("trace_id"),
+                     **{k: v for k, v in attrs.items()
+                        if v is not None}},
+        })
+    for doc in cycles:
+        t0 = float(doc.get("start") or 0.0)
+        cycle = doc.get("cycle")
+        for seg in doc.get("segments") or []:
+            tenant = str(seg.get("tenant") or "")
+            events.append({
+                "ph": "X",
+                "name": seg.get("name") or seg.get("cause") or "segment",
+                "cat": seg.get("cause") or "segment",
+                "pid": pid_of("timeline"),
+                "tid": tid_of("timeline", tenant),
+                "ts": (t0 + float(seg.get("start") or 0.0)) * 1e6,
+                "dur": max((float(seg.get("end") or 0.0)
+                            - float(seg.get("start") or 0.0)) * 1e6, 1.0),
+                "args": {"cycle": cycle, "cause": seg.get("cause")},
+            })
+        for i, (i0, i1) in enumerate(doc.get("device_idle") or []):
+            ident = f"idle-{cycle}-{i}"
+            common = {"cat": "device_idle", "name": "device_idle",
+                      "pid": pid_of("timeline"), "id": ident,
+                      "args": {"cycle": cycle}}
+            events.append({"ph": "b", "ts": (t0 + float(i0)) * 1e6,
+                           **common})
+            events.append({"ph": "e", "ts": (t0 + float(i1)) * 1e6,
+                           **common})
+    return events
+
+
+def export_perfetto(spans: list[dict], cycles: list[dict],
+                    out_path: str) -> int:
+    events = perfetto_events(spans, cycles)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return len(events)
+
+
 def print_slowest_round(spans: list[dict], out=sys.stdout) -> int:
     rounds = [s for s in spans if s.get("name") == "scheduler.round"]
     if not rounds:
@@ -131,10 +243,26 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--slowest-round", action="store_true",
                         help="print the slowest scheduler.round span's "
                              "flight-record fields and exit")
+    parser.add_argument("--perfetto", metavar="OUT",
+                        help="write a Chrome trace-event JSON file "
+                             "(open in ui.perfetto.dev) instead of "
+                             "pretty-printing; timeline cycle docs in "
+                             "the input become per-tenant tracks with "
+                             "an async device-idle track")
     args = parser.parse_args(argv)
-    spans, bad = load_spans(args.path)
+    spans, cycles, bad = load_docs(args.path)
     if bad:
         print(f"({bad} malformed lines skipped)", file=sys.stderr)
+    if args.perfetto:
+        if not spans and not cycles:
+            print("no spans or timeline cycles to export",
+                  file=sys.stderr)
+            return 1
+        n = export_perfetto(spans, cycles, args.perfetto)
+        print(f"wrote {n} trace events ({len(spans)} spans, "
+              f"{len(cycles)} timeline cycles) to {args.perfetto}",
+              file=sys.stderr)
+        return 0
     if args.slowest_round:
         return print_slowest_round(spans)
     traces = group_traces(spans)
