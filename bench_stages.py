@@ -554,6 +554,129 @@ def main() -> None:
             print(json.dumps({"stage": label, "error": repr(e)[:200]}),
                   flush=True)
 
+    # -- host-plane turbo stages (ISSUE 19): the wire codec, the
+    # deltasync apply loop, and the bind commit loop.  These are HOST
+    # costs — pure perf_counter timing, no device chaining — because
+    # the tentpole they instrument is host-wait attribution, not device
+    # wall.  Each stage times the batched path and records the legacy
+    # per-item path beside it so bench_diff guards the ratio's inputs.
+    import time as _htime
+
+    from koordinator_tpu.api.resources import resource_vector as _res
+    from koordinator_tpu.transport import deltasync as _ds
+    from koordinator_tpu.transport import wire as _wire
+
+    def _host_time(fn, reps: int, trials: int = 3) -> float:
+        best = float("inf")
+        for _ in range(trials):
+            t0 = _htime.perf_counter()
+            for _ in range(reps):
+                fn()
+            best = min(best, (_htime.perf_counter() - t0) / reps)
+        return best
+
+    host_reps = 10 if smoke else 50
+    ev_count = 64 if smoke else 512
+    host_events = []
+    for i in range(ev_count):
+        host_events.append(
+            (i + 1, {"kind": _ds.NODE_USAGE, "name": f"hn{i % 64}"},
+             {"usage": _res(cpu=100 + i, memory=64 + i),
+              "agg_usage": _res(cpu=90 + i, memory=60 + i)}))
+
+    def _codec(pack):
+        packed = pack(host_events)
+        payload = _wire.encode_payload(dict(packed[0]), packed[1])
+        d, a = _wire.decode_payload(payload)
+        return [_ds._unpack_event_arrays(e, a)
+                for e in _ds._decode_events(d, a)]
+
+    try:
+        v1_s = _host_time(lambda: _codec(_ds._pack_events), host_reps)
+        v2_s = _host_time(lambda: _codec(_ds._pack_events_v2), host_reps)
+        _emit("wire_codec_v1_vs_v2", v2_s, {
+            "events": ev_count, "v1_ms": round(v1_s * 1e3, 3),
+            "speedup_vs_v1": round(v1_s / max(v2_s, 1e-12), 2)})
+    except Exception as e:
+        print(json.dumps({"stage": "wire_codec_v1_vs_v2",
+                          "error": repr(e)[:200]}), flush=True)
+
+    from koordinator_tpu.scheduler import ClusterSnapshot, Scheduler
+    from koordinator_tpu.scheduler.scheduler import SchedulingResult
+    from koordinator_tpu.scheduler.snapshot import NodeSpec as _NSpec
+    from koordinator_tpu.scheduler.snapshot import PodSpec as _PSpec
+
+    try:
+        hsched = Scheduler(ClusterSnapshot(capacity=128))
+        for j in range(64):
+            hsched.snapshot.upsert_node(_NSpec(
+                name=f"hn{j}",
+                allocatable=_res(cpu=256_000, memory=1_048_576)))
+        hbind = _ds.SchedulerBinding(hsched)
+        apply_items = [(e, a) for _rv_, e, a in host_events]
+
+        def _apply_serial():
+            for e, a in apply_items:
+                _ds._dispatch_event(hbind, e, a)
+
+        serial_s = _host_time(_apply_serial, host_reps)
+        batched_s = _host_time(
+            lambda: _ds._dispatch_events(hbind, apply_items), host_reps)
+        _emit("deltasync_apply_batched", batched_s, {
+            "events": ev_count,
+            "per_event_ms": round(serial_s * 1e3, 3),
+            "speedup_vs_per_event": round(
+                serial_s / max(batched_s, 1e-12), 2)})
+    except Exception as e:
+        print(json.dumps({"stage": "deltasync_apply_batched",
+                          "error": repr(e)[:200]}), flush=True)
+
+    try:
+        n_binds = 32 if smoke else 256
+        bind_trials = 3 if smoke else 10
+
+        def _bind_setup():
+            s = Scheduler(ClusterSnapshot(capacity=max(n_binds * 2, 64)))
+            for j in range(32):
+                s.snapshot.upsert_node(_NSpec(
+                    name=f"bn{j}",
+                    allocatable=_res(cpu=256_000, memory=1_048_576)))
+            binds = []
+            for j in range(n_binds):
+                p = _PSpec(name=f"bp{j}",
+                           requests=_res(cpu=100, memory=64),
+                           priority=j)
+                s.enqueue(p)
+                binds.append((p, f"bn{j % 32}"))
+            return s, binds
+
+        def _bind_cost(batched: bool) -> float:
+            # commits consume pending state, so setup is rebuilt per
+            # trial and excluded from the timed window
+            best = float("inf")
+            for _ in range(bind_trials):
+                s, binds = _bind_setup()
+                res = SchedulingResult(assignments={}, failures={})
+                t0 = _htime.perf_counter()
+                if batched:
+                    s._commit_bind_batch(binds, res)
+                else:
+                    for p, node in binds:
+                        s._commit_bind(p, node, res)
+                best = min(best, _htime.perf_counter() - t0)
+            return best
+
+        loop_s = _bind_cost(batched=False)
+        batch_s = _bind_cost(batched=True)
+        _emit("bind_commit_batched", batch_s, {
+            "binds": n_binds,
+            "per_pod_ms": round(loop_s * 1e3, 3),
+            "speedup_vs_per_pod": round(
+                loop_s / max(batch_s, 1e-12), 2)})
+    except Exception as e:
+        print(json.dumps({"stage": "bind_commit_batched",
+                          "error": repr(e)[:200]}), flush=True)
+
     # -- multi-tenant round pipeline (ISSUE 11): sustained aggregate
     # pods/s with T simulated clusters on one mesh, serial
     # single-tenant-at-a-time vs the pipelined cycle (round N+1's

@@ -168,6 +168,7 @@ class Scheduler:
         config: ScoringConfig | None = None,
         quota_tree: QuotaTree | None = None,
         bind_fn=None,
+        bind_batch_fn=None,
         monitor: SchedulerMonitor | None = None,
         gang_passes: int = 2,
         gang_default_timeout_sec: float = 600.0,
@@ -203,6 +204,12 @@ class Scheduler:
         self.config = config if config is not None else ScoringConfig.default()
         self.quota_tree = quota_tree
         self.bind_fn = bind_fn
+        #: batched bind sink (ISSUE 19): when set, each round's whole
+        #: bind set arrives as ONE call ([(pod, node), ...]) — the seam
+        #: for a single deltasync emission per round instead of one
+        #: frame per pod.  bind_fn (per-pod) still fires when only it is
+        #: set; a round with both set calls bind_batch_fn only.
+        self.bind_batch_fn = bind_batch_fn
         #: tenancy identity (ISSUE 11): when set, this scheduler is one
         #: tenant of a TenantScheduler — per-tenant labels ride every
         #: scheduler metric, flight records stamp the tenant, and the
@@ -871,30 +878,45 @@ class Scheduler:
 
     def enqueue(self, pod: PodSpec) -> None:
         with self.lock:
-            # arrival-process accounting (ISSUE 9): rate() of this is
-            # the admission rate the churn load generator drives.  Only
-            # NEW names count — a resync bootstrap replays pod_add for
-            # every still-pending pod, and re-counting the whole queue
-            # would paint a phantom arrival spike on the dashboards
-            if pod.name not in self.pending:
-                metrics.pods_enqueued_total.inc(labels=self._tl())
-            self.pending[pod.name] = pod
-            self._pending_rev += 1
-            # the pod's trace starts (or joins) here: a propagated
-            # context (wire push applying under tracing.activate) always
-            # traces; trace_pods opts untraced pods into root spans.
-            # Synthetic reserve-pods are placement vehicles, not user
-            # workloads — they stay untraced like they stay unaudited.
-            ctx = tracing.current_context()
-            if ((ctx is not None or self.trace_pods)
-                    and not pod.name.startswith(RSV_POD_PREFIX)):
-                sp = tracing.TRACER.start_span(
-                    "scheduler.enqueue", service="scheduler", parent=ctx,
-                    attributes={"pod": pod.name,
-                                "priority": int(pod.priority)})
-                sp.end()
-                self.pod_traces[pod.name] = sp.context()
-                self._register_pod_trace(pod.name, sp.trace_id)
+            self._enqueue_locked(pod)
+
+    def enqueue_many(self, pods: list[PodSpec]) -> None:
+        """Admit a batch under ONE lock acquisition (ISSUE 19): the
+        deltasync binding routes contiguous pod_add runs here so a
+        loadgen burst costs one lock round-trip, not one per pod.
+        Per-pod semantics (arrival accounting, trace roots, pending
+        revision bumps) are exactly the sequential loop's."""
+        if not pods:
+            return
+        with self.lock:
+            for pod in pods:
+                self._enqueue_locked(pod)
+
+    def _enqueue_locked(self, pod: PodSpec) -> None:  # koordlint: guarded-by(self.lock)
+        # arrival-process accounting (ISSUE 9): rate() of this is
+        # the admission rate the churn load generator drives.  Only
+        # NEW names count — a resync bootstrap replays pod_add for
+        # every still-pending pod, and re-counting the whole queue
+        # would paint a phantom arrival spike on the dashboards
+        if pod.name not in self.pending:
+            metrics.pods_enqueued_total.inc(labels=self._tl())
+        self.pending[pod.name] = pod
+        self._pending_rev += 1
+        # the pod's trace starts (or joins) here: a propagated
+        # context (wire push applying under tracing.activate) always
+        # traces; trace_pods opts untraced pods into root spans.
+        # Synthetic reserve-pods are placement vehicles, not user
+        # workloads — they stay untraced like they stay unaudited.
+        ctx = tracing.current_context()
+        if ((ctx is not None or self.trace_pods)
+                and not pod.name.startswith(RSV_POD_PREFIX)):
+            sp = tracing.TRACER.start_span(
+                "scheduler.enqueue", service="scheduler", parent=ctx,
+                attributes={"pod": pod.name,
+                            "priority": int(pod.priority)})
+            sp.end()
+            self.pod_traces[pod.name] = sp.context()
+            self._register_pod_trace(pod.name, sp.trace_id)
 
     def _register_pod_trace(self, name: str, trace_id: str) -> None:
         """Bounded name -> trace_id map for /debug/trace/<pod>: survives
@@ -1869,6 +1891,34 @@ class Scheduler:
         handle.result.round_pods = len(handle.pods)
         return handle
 
+    # koordlint: guarded-by(self.lock)
+    # koordlint: shape[a: P i32 rep, new_state: NxR i32 nodes]
+    def round_adopt_quality_batched(self, handle: RoundHandle, a,
+                                    new_state, new_quota, qiters,
+                                    slack_before) -> RoundHandle:
+        """Adopt one tenant's slice of the QUALITY tenant-axis solve
+        (tenancy._dispatch_quality_axis_inner ran one vmapped
+        lp_pack_assign over every escalated tenant's stacked state).
+        Mirrors the standalone use_quality branch of _round_dispatch
+        exactly: blessed swap, candidate-cache invalidation (the LP
+        solve re-packed everything), and the handle.quality context
+        _quality_round_finish consumes."""
+        self.last_solver = "batch"
+        self.last_solve_path = "quality_lp_batched"
+        metrics.incremental_solve_total.inc(
+            labels={"path": "quality_lp_batched"})
+        # the blessed swap, batched form (see round_adopt_batched)
+        self.snapshot.state = new_state
+        self._cand_cache = None
+        handle.solver = "batch"
+        handle.assignments = a
+        handle.new_state = new_state
+        handle.new_quota = new_quota
+        handle.quality = {"iters": qiters,
+                          "slack_before": slack_before}
+        handle.result.round_pods = len(handle.pods)
+        return handle
+
     def _round_host(self, handle: RoundHandle) -> SchedulingResult:  # koordlint: guarded-by(self.lock)
         """The HOST half: block on the dispatched solve, run the exact
         rescue pass, then Reserve/Bind/Diagnose/PostFilter — the commit
@@ -1977,6 +2027,7 @@ class Scheduler:
 
         with self.monitor.phase("Bind"):
             placed_gangs: set[str] = set()
+            binds: list[tuple[PodSpec, str]] = []
             for i, pod in enumerate(pods):
                 node_row = int(a[i])
                 if node_row >= 0:
@@ -1984,9 +2035,10 @@ class Scheduler:
                     if pod.name.startswith(RSV_POD_PREFIX):
                         self._commit_reserve_pod(pod, node, result, now)
                         continue
-                    self._commit_bind(pod, node, result)
+                    binds.append((pod, node))
                     if pod.gang:
                         placed_gangs.add(pod.gang)
+            self._commit_bind_batch(binds, result)
 
         with self.monitor.phase("Diagnose"):
             admitted = None
@@ -2644,6 +2696,87 @@ class Scheduler:
             self.explanations.delete(pod.name)
         if self.auditor is not None:
             self.auditor.record(pod.gang or pod.name, "ScheduleSuccess", node)
+
+    # koordlint: guarded-by(self.lock)
+    def _commit_bind_batch(self, binds: list[tuple[PodSpec, str]],
+                           result: SchedulingResult) -> None:
+        """One batched commit for a round's whole bind set (ISSUE 19).
+
+        Sequential ``_commit_bind`` re-walks the quota tree and bumps
+        ``q.used`` once per pod — at 1k binds/round that is 1k int64
+        adds plus 1k dict probes of pure host time inside the round's
+        critical section.  Here the per-pod registry bookkeeping stays a
+        (cheap) loop, but quota recharge is grouped: one
+        ``np.sum(stack)`` per (quota, non_preemptible) group and ONE
+        ``q.used`` update per touched quota node.  Integer adds commute
+        and int64 never rounds, so the grouped totals are bit-identical
+        to the sequential charges (the reserve_batch precedent).  Per-
+        pod surfaces — ``resource_status``, trace stamping, fine-grained
+        allocation, explanations, auditor records — are preserved
+        exactly, in bind order.  ``bind_batch_fn`` (when set) receives
+        the whole set once: the seam for one deltasync emission per
+        round instead of one frame per pod."""
+        if not binds:
+            return
+        # phase 1: registry bookkeeping (assignments / pending /
+        # nominations / bound), in order — later same-name entries win
+        # exactly as they would sequentially
+        for pod, node in binds:
+            result.assignments[pod.name] = node
+            if self.pending.pop(pod.name, None) is not None:
+                self._pending_rev += 1
+            self.nominations.pop(pod.name, None)
+            self._nomination_gen.pop(pod.name, None)
+            self.bound[pod.name] = BoundPod(
+                name=pod.name, node=node, requests=pod.requests,
+                priority=pod.priority, quota=pod.quota,
+                non_preemptible=pod.non_preemptible,
+                labels=pod.labels, gang=pod.gang,
+                node_generation=self.snapshot.node_generation.get(node, 0),
+            )
+        # phase 2: grouped quota recharge — one used-vector update per
+        # touched quota node instead of one per pod
+        if self.quota_tree is not None:
+            groups: dict[tuple[str, bool], list[np.ndarray]] = {}
+            for pod, _node in binds:
+                if pod.quota and pod.quota in self.quota_tree.nodes:
+                    groups.setdefault(
+                        (pod.quota, bool(pod.non_preemptible)), []
+                    ).append(pod.requests)
+            for (quota, non_preemptible), reqs in groups.items():
+                q = self.quota_tree.nodes[quota]
+                total = np.sum(np.stack(reqs).astype(np.int64), axis=0)
+                q.used = q.used + total
+                if non_preemptible:
+                    q.non_preemptible_used = (
+                        q.non_preemptible_used + total)
+        # phase 3: per-pod surfaces, in bind order (fine-grained state
+        # mutates per node+pod; trace stamping must follow it because
+        # _allocate_fine_grained replaces resource_status wholesale)
+        for pod, node in binds:
+            self._allocate_fine_grained(pod, node)
+            ctx = self.pod_traces.pop(pod.name, None)
+            if ctx is not None:
+                sp = tracing.TRACER.start_span(
+                    "scheduler.bind", service="scheduler", parent=ctx,
+                    attributes={"pod": pod.name, "node": node,
+                                "round": self.round_seq,
+                                "round_trace_id":
+                                    tracing.current_trace_id()})
+                sp.end()
+                self.resource_status.setdefault(pod.name, {})[
+                    tracing.TRACE_ANNOTATION] = (
+                        sp.context().to_annotation())
+            if self.explanations is not None:
+                self.explanations.delete(pod.name)
+            if self.auditor is not None:
+                self.auditor.record(pod.gang or pod.name,
+                                    "ScheduleSuccess", node)
+        if self.bind_batch_fn is not None:
+            self.bind_batch_fn([(pod.name, node) for pod, node in binds])
+        elif self.bind_fn is not None:
+            for pod, node in binds:
+                self.bind_fn(pod.name, node)
 
     def _allocate_fine_grained(self, pod: PodSpec, node: str) -> None:
         """Reserve-phase fine-grained allocation (nodenumaresource Reserve:

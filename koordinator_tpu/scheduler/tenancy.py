@@ -137,6 +137,9 @@ class TenantScheduler:
         #: jit cache for the tenant-axis batched programs, keyed by the
         #: static solve knobs (shapes retrace inside jax.jit as usual)
         self._batched_fns: dict[tuple, object] = {}
+        #: jit cache for the QUALITY tenant-axis program (ISSUE 19):
+        #: vmap of lp_pack_assign, keyed by has_quota
+        self._quality_fns: dict[bool, object] = {}
         #: ONE shared ScoringConfig handed to tenants that don't bring
         #: their own: the batched program broadcasts a single config
         #: over the tenant axis, and _batched_eligible requires config
@@ -515,12 +518,11 @@ class TenantScheduler:
                     # batched program bypasses — a fault-injected tenant
                     # must keep the per-tenant dispatch path
                     or sched.faults is not None
-                    # a quality-mode tenant's rounds may escalate to the
-                    # LP packing engine, which the select+pass1 batched
-                    # program cannot express — its cycle stays on the
-                    # pipelined per-tenant dispatch (bit-identical to
-                    # standalone execution; tests/test_quality.py)
-                    or sched.quality_mode != "off"
+                    # quality-mode tenants are eligible too (ISSUE 19
+                    # closed the PR 13 gap): escalated tenants solve in
+                    # their OWN vmapped lp_pack_assign program, the rest
+                    # in the select+pass1 program — see
+                    # _dispatch_tenant_axis's partition
                     # a forecast-mode tenant charges its admission
                     # reserve in _round_dispatch, which the batched
                     # select+pass1 program bypasses — its cycle keeps
@@ -644,10 +646,26 @@ class TenantScheduler:
                             pass
         return mode
 
+    @staticmethod
+    def _wants_quality(sched) -> bool:
+        """Mirror of _round_dispatch's use_quality predicate for the
+        tenant-axis partition (gang rounds and forecast tenants never
+        reach here — _batched_eligible already falls back on them)."""
+        return (sched.quality_mode == "lp"
+                or (sched.quality_mode == "auto"
+                    and sched._quality_escalate))
+
     def _dispatch_tenant_axis(self, pairs) -> None:
         """ONE vmapped select+pass1 dispatch over every live tenant's
-        stacked state — the leading tenant axis the issue names."""
+        stacked state — the leading tenant axis the issue names.
+        Quality-escalated tenants (ISSUE 19) dispatch through their own
+        vmapped lp_pack_assign program in the same window, so a mixed-
+        quality fleet no longer serializes its host halves."""
         live = [(t, h) for t, h in pairs if not h.done]
+        plain = [(t, h) for t, h in live
+                 if not self._wants_quality(t.scheduler)]
+        quality = [(t, h) for t, h in live
+                   if self._wants_quality(t.scheduler)]
         # timeline observatory (ISSUE 18): the stack/trace/unstack walls
         # of the one vmapped program are solver dispatch, exactly like
         # the per-tenant _round_dispatch window, and the async solve
@@ -655,7 +673,10 @@ class TenantScheduler:
         # leading edge each tenant's block pairs with
         dispatch_t0 = time.perf_counter()
         try:
-            self._dispatch_tenant_axis_inner(live)
+            if plain:
+                self._dispatch_tenant_axis_inner(plain)
+            if quality:
+                self._dispatch_quality_axis_inner(quality)
         finally:
             if timeline.RECORDER.enabled:
                 timeline.RECORDER.add(
@@ -697,6 +718,53 @@ class TenantScheduler:
                 self._unstack(a, i), self._unstack(st, i),
                 self._unstack(q, i) if has_quota else None,
                 self._unstack(est, i), cache, k, method)
+
+    def _quality_batched_fn(self, has_quota: bool):
+        """The jitted quality tenant-axis program: vmap of the full
+        lp_pack_assign solve (default static iteration knobs, exactly
+        the standalone quality branch's call).  The stacked state is
+        donated — a stacking COPY, same contract as _batched_fn."""
+        fn = self._quality_fns.get(has_quota)
+        if fn is not None:
+            return fn
+        from koordinator_tpu.quality.lp_pack import lp_pack_assign
+
+        def one_tenant(state, batch, quota, cfg):
+            return lp_pack_assign(state, batch, cfg, quota)
+
+        # koordlint: shape[state: TxNxR i32, batch: TxP i32, quota: TxQ i32]
+        def program(state, batch, quota, cfg):
+            return jax.vmap(
+                one_tenant,
+                in_axes=(0, 0, 0 if has_quota else None, None))(
+                    state, batch, quota, cfg)
+
+        fn = jax.jit(program, donate_argnums=(0,))
+        self._quality_fns[has_quota] = fn
+        return fn
+
+    def _dispatch_quality_axis_inner(self, live) -> None:
+        states = [t.scheduler.snapshot.state for t, _ in live]
+        batches = [h.batch for _, h in live]
+        quotas = [h.quota for _, h in live]
+        has_quota = quotas[0] is not None
+        cfg = live[0][0].scheduler.config
+        # pre-solve slack per tenant (the quality_slack_recovered
+        # baseline), dispatched against the ORIGINAL state buffers
+        # before the donating program consumes the stacking copy —
+        # the standalone quality branch's ordering
+        slacks = [t.scheduler._slack_sums(state)
+                  for (t, _), state in zip(live, states)]
+        fn = self._quality_batched_fn(has_quota)
+        a, st, q, qiters = fn(
+            self._stack(states), self._stack(batches),
+            self._stack(quotas) if has_quota else None, cfg)
+        for i, (t, handle) in enumerate(live):
+            t.scheduler.round_adopt_quality_batched(
+                handle,
+                self._unstack(a, i), self._unstack(st, i),
+                self._unstack(q, i) if has_quota else None,
+                self._unstack(qiters, i), slacks[i])
 
     # -- surfaces ------------------------------------------------------------
 

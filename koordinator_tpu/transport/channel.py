@@ -40,6 +40,23 @@ from koordinator_tpu.transport.wire import (
 Handler = Callable[[dict, dict[str, np.ndarray]],
                    tuple[dict, dict[str, np.ndarray] | None]]
 
+#: the connection whose frame is currently being dispatched on THIS
+#: thread — handlers are (doc, arrays) -> (doc, arrays) with no
+#: connection parameter, but protocol negotiation (HELLO) must stamp
+#: the NEGOTIATED message protocol onto the connection so later
+#: broadcasts pick the right event encoding per peer.  Dispatch workers
+#: are per-connection threads, so a threadlocal is race-free.
+_DISPATCH = threading.local()
+
+
+def set_conn_proto(proto: int) -> None:
+    """Stamp the negotiated message protocol on the connection whose
+    request is currently being dispatched (no-op outside dispatch —
+    e.g. a handler invoked directly in tests)."""
+    conn = getattr(_DISPATCH, "conn", None)
+    if conn is not None:
+        conn.proto = int(proto)
+
 #: Outbound frames buffered per connection before the peer is declared
 #: stalled (poison + forced resync).  Sized to the DeltaLog retention
 #: window (deltasync.DeltaLog, 4096): a burst the delta log could replay
@@ -121,6 +138,10 @@ class _Conn:
             SEND_QUEUE_DEPTH)
         self.alive = True
         self.dropped = 0
+        #: negotiated message protocol for this peer (stamped by the
+        #: HELLO handler via set_conn_proto); 0 = never negotiated —
+        #: broadcasts treat it as a legacy peer (JSON event lists)
+        self.proto = 0
         #: reorder-fault hold slot: a push pulled out of order, emitted
         #: after the next outbound frame (or on poison)
         self._held: Optional[bytes] = None
@@ -325,13 +346,17 @@ def _dispatch_one(server: "RpcServer", conn: _Conn, frame: Frame,
         # (joined to the caller's trace), untraced requests pay one dict
         # lookup and no span
         tctx = tracing.extract(doc)
-        if tctx is not None:
-            with tracing.TRACER.span(
-                    f"rpc.{frame.type.name}",
-                    service=server.service or None, parent=tctx):
+        _DISPATCH.conn = conn
+        try:
+            if tctx is not None:
+                with tracing.TRACER.span(
+                        f"rpc.{frame.type.name}",
+                        service=server.service or None, parent=tctx):
+                    out_doc, out_arrays = handler(doc, arrays)
+            else:
                 out_doc, out_arrays = handler(doc, arrays)
-        else:
-            out_doc, out_arrays = handler(doc, arrays)
+        finally:
+            _DISPATCH.conn = None
         rtype = FrameType(out_doc.pop(
             "__type__", int(_RESPONSE_TYPE.get(
                 frame.type, FrameType.ACK))))
@@ -471,18 +496,40 @@ class RpcServer:
                 self._conns.remove(conn)
 
     def broadcast(self, ftype: FrameType, doc: dict,
-                  arrays: dict[str, np.ndarray] | None = None) -> int:
+                  arrays: dict[str, np.ndarray] | None = None,
+                  min_proto: int = 0, legacy=None) -> int:
         """Push a frame (request_id 0 = unsolicited) to all live
         connections — the informer watch-event fan-out. Never blocks:
-        frames go through each connection's bounded queue."""
-        frame = Frame(ftype, 0, encode_payload(doc, arrays))
+        frames go through each connection's bounded queue.
+
+        Mixed-version fan-out: when ``min_proto`` > 0, only peers that
+        negotiated at least that message protocol get the primary
+        payload; older peers (including never-HELLO'd ones at proto 0)
+        get the ``legacy`` payload instead — a zero-arg callable
+        returning ``(doc, arrays)``, encoded LAZILY so an all-v2 fleet
+        never pays the v1 encode.  ``legacy=None`` with ``min_proto``
+        set skips old peers entirely (their resync machinery recovers)."""
+        frame: Optional[Frame] = None
+        legacy_frame: Optional[Frame] = None
         with self._conn_lock:
             conns = list(self._conns)
         sent = 0
         for conn in conns:
-            if conn.alive:
+            if not conn.alive:
+                continue
+            if min_proto and conn.proto < min_proto:
+                if legacy is None:
+                    continue
+                if legacy_frame is None:
+                    ldoc, larrays = legacy()
+                    legacy_frame = Frame(
+                        ftype, 0, encode_payload(ldoc, larrays))
+                conn.send(legacy_frame)
+            else:
+                if frame is None:
+                    frame = Frame(ftype, 0, encode_payload(doc, arrays))
                 conn.send(frame)
-                sent += 1
+            sent += 1
         return sent
 
 

@@ -31,7 +31,7 @@ from typing import Optional
 import numpy as np
 
 from koordinator_tpu import metrics, timeline, tracing
-from koordinator_tpu.transport import wire
+from koordinator_tpu.transport import channel, wire
 from koordinator_tpu.transport.wire import FrameType
 
 NODE_UPSERT = "node_upsert"
@@ -102,13 +102,182 @@ def _pack_events(
             {k: np.stack(v) for k, v in stacked.items()})
 
 
+# -- columnar event codec (wire protocol v4, ISSUE 19) ----------------------
+#
+# The v1 packing above serializes one JSON document PER EVENT (name,
+# kind, rv, and a __row_*__ manifest each) — at snapshot scale that is
+# tens of thousands of json.dumps/loads round trips, the largest
+# ``json_codec`` contributor in the PR 18 host-wait attribution.  The v2
+# packing moves the per-event constants into columnar numpy arrays that
+# ride the raw array section: kind codes (uint8), rvs (int64), names
+# (length + utf-8 blob columns), and one int32 row-index column per
+# stacked array key.  Event fields beyond the columns — labels, trace
+# contexts, reservation owners — ride a SPARSE ``extras`` list holding
+# only non-default fields, so the steady-state hot kinds (node_usage,
+# pod_remove) carry zero JSON per event.  Decoding reconstructs the
+# exact v1 entry list, so everything downstream of the codec (rv
+# guards, bindings, replay) is byte-for-byte unchanged.
+
+_KIND_CODES = {NODE_UPSERT: 0, NODE_USAGE: 1, NODE_ALLOC: 2,
+               NODE_DEVICES: 3, NODE_REMOVE: 4, POD_ADD: 5,
+               POD_REMOVE: 6, RSV_UPSERT: 7, RSV_REMOVE: 8}
+_CODE_KINDS = {v: k for k, v in _KIND_CODES.items()}
+
+#: per-kind default fields elided from the wire and reconstructed at
+#: decode — MUST mirror the event docs the mutation methods build
+#: (upsert_node / add_pod / upsert_reservation), or round-tripped
+#: entries stop being equal to the originals
+_V2_DEFAULTS: dict[str, dict] = {
+    NODE_UPSERT: {"labels": {}, "taints": {}, "annotations": {},
+                  "devices": {}},
+    POD_ADD: {"priority": 0, "quota": None, "gang": None,
+              "node_selector": {}, "labels": {}, "owner": None, "qos": 0},
+    RSV_UPSERT: {"owners": [], "allocate_once": False, "ttl_sec": None,
+                 "node": None, "node_selector": {}, "tolerations": {},
+                 "restricted": False},
+}
+
+
+def _pack_events_v2(
+    events: list[tuple[int, dict, dict[str, np.ndarray]]]
+) -> tuple[dict, dict[str, np.ndarray]] | None:
+    """Columnar packing (see above).  Returns None when any event's kind
+    has no code — the caller falls back to the v1 packing so a new event
+    kind degrades to JSON instead of breaking the stream."""
+    # hot loop: list appends + one vectorized column fill per key beat
+    # per-event numpy scalar stores by ~2x at snapshot scale
+    k = len(events)
+    kinds: list[int] = []
+    rvs: list[int] = []
+    names: list[str] = []
+    extras: list[list] = []
+    stacked: dict[str, list[np.ndarray]] = {}
+    positions: dict[str, list[int]] = {}
+    kind_codes = _KIND_CODES
+    v2_defaults = _V2_DEFAULTS
+    for i, (rv, event, arrays) in enumerate(events):
+        kind = event.get("kind")
+        code = kind_codes.get(kind)
+        if code is None:
+            return None
+        kinds.append(code)
+        rvs.append(rv)
+        names.append(event["name"])
+        if len(event) > 2:  # anything beyond kind+name rides extras
+            defaults = v2_defaults.get(kind)
+            if defaults is None:
+                extra = {key: val for key, val in event.items()
+                         if key != "kind" and key != "name"}
+            else:
+                extra = {key: val for key, val in event.items()
+                         if key != "kind" and key != "name"
+                         and not (key in defaults
+                                  and val == defaults[key])}
+            if extra:
+                extras.append([i, extra])
+        if arrays:
+            for key, arr in arrays.items():
+                rows = stacked.get(key)
+                if rows is None:
+                    rows = stacked[key] = []
+                    positions[key] = []
+                positions[key].append(i)
+                rows.append(np.asarray(arr))
+    out_arrays: dict[str, np.ndarray] = {
+        "__kinds__": np.asarray(kinds, np.uint8),
+        "__rvs__": np.asarray(rvs, np.int64)}
+    name_lens, name_blob = wire.pack_str_column(names)
+    out_arrays["__name_lens__"] = name_lens
+    out_arrays["__name_blob__"] = name_blob
+    for key, blocks in stacked.items():
+        col = np.full(k, -1, np.int32)
+        col[positions[key]] = np.arange(len(blocks), dtype=np.int32)
+        out_arrays[f"__rows_{key}__"] = col
+        out_arrays[key] = np.stack(blocks)
+    doc: dict = {"events_v2": k}
+    if extras:
+        doc["extras"] = extras
+    return doc, out_arrays
+
+
+def _unpack_events_v2(doc: dict,
+                      arrays: dict[str, np.ndarray]) -> list[dict]:
+    """Inverse of :func:`_pack_events_v2`: reconstruct the ordered v1
+    entry list (``__row_*__`` indices included, so
+    :func:`_unpack_event_arrays` works unchanged on the result)."""
+    k = int(doc["events_v2"])
+    try:
+        kinds = arrays["__kinds__"]
+        rvs = arrays["__rvs__"]
+        names = wire.unpack_str_column(arrays["__name_lens__"],
+                                       arrays["__name_blob__"])
+    except KeyError as e:
+        raise wire.WireSchemaError(
+            f"events_v2 frame missing column {e}") from e
+    if len(kinds) != k or len(rvs) != k or len(names) != k:
+        raise wire.WireSchemaError(
+            f"events_v2 column lengths disagree with count {k}")
+    extras = {int(i): e for i, e in doc.get("extras", [])}
+    # numpy scalar indexing costs ~100ns a pop; one tolist() per column
+    # up front makes the reconstruction loop pure-Python cheap
+    kinds_l = kinds.tolist()
+    rvs_l = rvs.tolist()
+    row_cols: list[tuple[str, list]] = []
+    for key in arrays:
+        if key.startswith("__rows_") and key.endswith("__"):
+            col = arrays[key].tolist()
+            if len(col) != k:
+                raise wire.WireSchemaError(
+                    f"events_v2 row column {key} has {len(col)} rows, "
+                    f"expected {k}")
+            row_cols.append((f"__row_{key[len('__rows_'):-2]}__", col))
+    entries: list[dict] = []
+    code_kinds = _CODE_KINDS
+    v2_defaults = _V2_DEFAULTS
+    for i in range(k):
+        kind = code_kinds.get(kinds_l[i])
+        if kind is None:
+            raise wire.WireSchemaError(
+                f"events_v2 frame carries unknown kind code "
+                f"{kinds_l[i]}")
+        entry: dict = {"kind": kind, "name": names[i]}
+        defaults = v2_defaults.get(kind)
+        if defaults is not None:
+            for key, val in defaults.items():
+                # fresh containers per entry: binding handlers treat
+                # entry values as read-only, but shared mutables across
+                # entries would make any future slip a cross-event
+                # corruption
+                entry[key] = (dict(val) if isinstance(val, dict)
+                              else list(val) if isinstance(val, list)
+                              else val)
+        ex = extras.get(i)
+        if ex is not None:
+            entry.update(ex)
+        entry["rv"] = rvs_l[i]
+        for row_key, col in row_cols:
+            row = col[i]
+            if row >= 0:
+                entry[row_key] = row
+        entries.append(entry)
+    return entries
+
+
+def _decode_events(doc: dict, arrays: dict[str, np.ndarray]) -> list[dict]:
+    """Normalize a DELTA/SNAPSHOT payload to the v1 entry list,
+    whichever codec produced it."""
+    if "events_v2" in doc:
+        return _unpack_events_v2(doc, arrays)
+    return doc.get("events", [])
+
+
 def _unpack_event_arrays(entry: dict,
                          arrays: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
     out = {}
-    for key in list(entry):
+    for key, row in entry.items():
         if key.startswith("__row_") and key.endswith("__"):
-            name = key[len("__row_"):-len("__")]
-            out[name] = arrays[name][entry[key]]
+            name = key[6:-2]
+            out[name] = arrays[name][row]
     return out
 
 
@@ -238,8 +407,20 @@ class StateSyncService:
         rv = self.rv
         self.log.append(rv, event, arrays)
         if self._server is not None:
-            doc, stacked = _pack_events([(rv, event, arrays)])
-            self._server.broadcast(FrameType.DELTA, doc, stacked)
+            batch = [(rv, event, arrays)]
+            packed = _pack_events_v2(batch)
+            if packed is None:
+                # unknown kind: everyone gets the v1 JSON form
+                doc, stacked = _pack_events(batch)
+                self._server.broadcast(FrameType.DELTA, doc, stacked)
+            else:
+                # columnar frame to v4+ peers; legacy encodes the v1
+                # frame lazily, ONLY if some negotiated-down peer is
+                # actually connected (a pure-v4 fleet never pays it)
+                doc, stacked = packed
+                self._server.broadcast(
+                    FrameType.DELTA, doc, stacked, min_proto=4,
+                    legacy=lambda: _pack_events(batch))
         if self._local_bindings:
             self._binding_queue.append((event, arrays))
             # backlog watermark (ISSUE 9): depth sampled at append (the
@@ -254,15 +435,23 @@ class StateSyncService:
         return rv
 
     def _drain_bindings(self) -> None:
+        # drain the WHOLE backlog, then route it as one ordered batch so
+        # contiguous same-kind runs (a koordlet heartbeat sweep, a
+        # loadgen pod burst) hit the binding's vectorized run apply —
+        # one scheduler.lock round-trip per run, not per event
         with self._binding_lock:
             while True:
-                try:
-                    event, arrays = self._binding_queue.popleft()
-                except IndexError:
+                items: list[tuple[dict, dict]] = []
+                while True:
+                    try:
+                        items.append(self._binding_queue.popleft())
+                    except IndexError:
+                        break
+                if not items:
                     metrics.sync_binding_backlog.set(0.0)
                     return
                 for binding in self._local_bindings:
-                    _dispatch_event(binding, event, arrays)
+                    _dispatch_events(binding, items)
 
     def upsert_node(self, name: str, allocatable: np.ndarray,
                     usage: np.ndarray | None = None,
@@ -621,7 +810,8 @@ class StateSyncService:
             raise wire.WireSchemaError(f"unknown state-push kind {kind!r}")
         return {"rv": rv}, None
 
-    def _snapshot(self) -> tuple[dict, dict[str, np.ndarray]]:
+    def _snapshot(self, pack=_pack_events
+                  ) -> tuple[dict, dict[str, np.ndarray]]:
         events = []
         # replay order matters: nodes before reservations (placement needs
         # rows) before pods (owners need Available reservations)
@@ -629,19 +819,37 @@ class StateSyncService:
                       + list(self.reservations.values())
                       + list(self.pods.values())):
             events.append((self.rv, entry["doc"], entry["arrays"]))
-        doc, arrays = _pack_events(events)
+        doc, arrays = pack(events)
         doc["rv"] = self.rv
         doc["snapshot"] = True
         return doc, arrays
 
     def _handle_hello(self, doc: dict, arrays):
-        # protocol negotiation: reject message-protocol skew loud instead
-        # of mis-decoding frames later (api.proto's versioned-contract role)
+        # protocol negotiation (ISSUE 19): speak min(peer, local) within
+        # the supported window so one release of skew keeps working (a
+        # v3 peer gets v1 JSON event lists, a v4 peer gets the columnar
+        # codec); anything OUTSIDE the window is rejected loud instead
+        # of mis-decoding frames later (api.proto's versioned-contract
+        # role)
         peer_proto = int(doc.get("proto", 1))
-        if peer_proto != wire.PROTOCOL_VERSION:
+        if not (wire.MIN_PROTOCOL_VERSION <= peer_proto
+                <= wire.PROTOCOL_VERSION):
             raise wire.WireSchemaError(
                 f"incompatible message protocol: peer {peer_proto}, "
-                f"local {wire.PROTOCOL_VERSION}")
+                f"local {wire.PROTOCOL_VERSION} (supported "
+                f"{wire.MIN_PROTOCOL_VERSION}..{wire.PROTOCOL_VERSION})")
+        proto = min(peer_proto, wire.PROTOCOL_VERSION)
+        # stamp the negotiated version on the live connection: broadcast
+        # uses it to pick the columnar vs legacy frame per peer
+        channel.set_conn_proto(proto)
+
+        def pack(events):
+            if proto >= 4:
+                packed = _pack_events_v2(events)
+                if packed is not None:
+                    return packed
+            return _pack_events(events)
+
         last_rv = int(doc.get("last_rv", -1))
         # instance-aware resync: a peer that last synced a DIFFERENT
         # service incarnation must take the full snapshot even when the
@@ -654,22 +862,24 @@ class StateSyncService:
         with self._lock:
             if last_rv == self.rv and same_instance:
                 return {"__type__": int(FrameType.ACK), "rv": self.rv,
-                        "instance": self.instance}, None
+                        "proto": proto, "instance": self.instance}, None
             if 0 <= last_rv < self.rv and same_instance:
                 try:
                     events = self.log.since(last_rv)
                 except ResyncRequired:
                     events = None
                 if events is not None:
-                    out, stacked = _pack_events(events)
+                    out, stacked = pack(events)
                     out["__type__"] = int(FrameType.DELTA)
                     out["rv"] = self.rv
+                    out["proto"] = proto
                     out["instance"] = self.instance
                     return out, stacked
             # last_rv < 0 (fresh client), a different service incarnation,
             # ahead of us (rv counter reset), or behind the retained
             # window: full snapshot, client resets
-            out, stacked = self._snapshot()
+            out, stacked = self._snapshot(pack)
+            out["proto"] = proto
             out["instance"] = self.instance
             return out, stacked
 
@@ -690,6 +900,9 @@ class StateSyncClient:
     def __init__(self, binding):
         self.binding = binding
         self.rv = -1
+        #: message-protocol version negotiated at the last HELLO (0 =
+        #: never bootstrapped); informational + test surface
+        self.proto = 0
         #: service boot-epoch last synced from (HELLO echoes it); sent on
         #: reconnect so a restarted service with a colliding rv counter
         #: still forces the full snapshot
@@ -735,8 +948,18 @@ class StateSyncClient:
             hello = {"last_rv": last_rv, "proto": wire.PROTOCOL_VERSION}
             if self.instance is not None:
                 hello["instance"] = self.instance
-            ftype, doc, arrays = client.call(FrameType.HELLO, hello)
+            try:
+                ftype, doc, arrays = client.call(FrameType.HELLO, hello)
+            except channel.RpcRemoteError as e:
+                # pre-negotiation server (its window tops out below
+                # ours): re-HELLO once at our floor — min(peer, local)
+                # on a negotiating server would land there anyway
+                if "incompatible" not in str(e):
+                    raise
+                hello["proto"] = wire.MIN_PROTOCOL_VERSION
+                ftype, doc, arrays = client.call(FrameType.HELLO, hello)
             with self._lock:
+                self.proto = int(doc.get("proto", hello["proto"]))
                 if doc.get("instance"):
                     self.instance = doc["instance"]
                 n = 0
@@ -781,7 +1004,12 @@ class StateSyncClient:
                 self.binding.reset()
                 self.rv = -1  # snapshot events all carry the snapshot rv
             high = self.rv
-            for entry in doc.get("events", []):
+            # rv-guard pass first, dispatch second: the survivors route
+            # as ONE ordered batch so contiguous same-kind runs hit the
+            # binding's vectorized apply.  Replay (HELLO DELTA) and
+            # bootstrap snapshots decode through the same path.
+            to_apply: list[tuple[dict, dict]] = []
+            for entry in _decode_events(doc, arrays):
                 rv = int(entry.get("rv", doc.get("rv", 0)))
                 if not doc.get("snapshot") and rv <= self.rv:
                     self.skipped += 1  # replay overlap: idempotent skip
@@ -797,9 +1025,11 @@ class StateSyncClient:
                     # are exempt (the HELLO reply + buffered-push replay
                     # is the server's own contiguous answer).
                     gap = True
-                self._dispatch(entry, _unpack_event_arrays(entry, arrays))
+                to_apply.append((entry, _unpack_event_arrays(entry,
+                                                             arrays)))
                 high = max(high, rv)
                 n += 1
+            self._dispatch_run(to_apply)
             self.rv = max(high, int(doc.get("rv", high)))
             self.applied += n
             if gap:
@@ -818,6 +1048,58 @@ class StateSyncClient:
 
     def _dispatch(self, entry: dict, arrs: dict[str, np.ndarray]) -> None:
         _dispatch_event(self.binding, entry, arrs)
+
+    def _dispatch_run(self, items: list[tuple[dict, dict]]) -> None:
+        _dispatch_events(self.binding, items)
+
+
+#: event kinds whose contiguous runs have a vectorized binding apply
+#: (value = the batched method name; a binding without it falls back to
+#: the per-event route)
+_RUN_METHODS = {NODE_USAGE: "node_usage_run", POD_ADD: "pod_add_run"}
+
+
+def _dispatch_events(binding, items: list[tuple[dict, dict]]) -> None:
+    """Route an ORDERED event list, batching contiguous same-kind runs
+    into one vectorized binding apply (ISSUE 19).
+
+    Only untraced events coalesce: a trace-stamped event keeps its
+    per-event ``sync.<kind>`` span (and its position relative to its
+    neighbors — runs never cross it, so apply order is exactly the
+    per-event order).  A run of K events costs one scheduler-lock
+    round-trip and one ``deltasync_apply`` timeline segment instead of
+    K of each; the batched appliers perform the same per-event mutation
+    in the same order, so the resulting state is bit-identical."""
+    i, n = 0, len(items)
+    while i < n:
+        entry, arrs = items[i]
+        method = _RUN_METHODS.get(entry.get("kind"))
+        run_fn = getattr(binding, method, None) if method else None
+        if run_fn is None or entry.get(tracing.TRACE_DOC_KEY) is not None:
+            _dispatch_event(binding, entry, arrs)
+            i += 1
+            continue
+        j = i + 1
+        while (j < n and items[j][0].get("kind") == entry["kind"]
+               and items[j][0].get(tracing.TRACE_DOC_KEY) is None):
+            j += 1
+        if j - i == 1:
+            _dispatch_event(binding, entry, arrs)
+        else:
+            run = items[i:j]
+            tl = (timeline.RECORDER.section(
+                      "deltasync_apply",
+                      f"sync.{entry['kind']}_run[{j - i}]")
+                  if timeline.RECORDER.enabled
+                  else contextlib.nullcontext())
+            with tl:
+                run_fn(run)
+            # staleness watchdog feed: one mark covers the run — the
+            # watchdog reads only the latest timestamp
+            mark = getattr(binding, "note_sync_event", None)
+            if mark is not None:
+                mark()
+        i = j
 
 
 def _dispatch_event(binding, entry: dict,
@@ -1016,6 +1298,33 @@ class SchedulerBinding:
                             if "prod_usage" in arrs else usage),
             ))
 
+    def node_usage_run(self,
+                       items: list[tuple[dict, dict[str, np.ndarray]]]
+                       ) -> None:
+        """Vectorized NODE_USAGE run (ISSUE 19): ONE scheduler-lock
+        round-trip for K usage refreshes.  Per-event semantics are
+        unchanged — same replace, same order, so a later event for the
+        same node wins exactly as it would serially — and the snapshot's
+        dirty-row set coalesces the K row writes into the next flush's
+        single device scatter."""
+        import dataclasses as _dc
+
+        with self.scheduler.lock:
+            snap = self.scheduler.snapshot
+            for entry, arrs in items:
+                spec = snap.node_specs.get(entry["name"])
+                if spec is None:
+                    continue
+                usage = np.asarray(arrs["usage"], np.int32)
+                snap.upsert_node(_dc.replace(
+                    spec,
+                    usage=usage,
+                    agg_usage=(np.asarray(arrs["agg_usage"], np.int32)
+                               if "agg_usage" in arrs else usage),
+                    prod_usage=(np.asarray(arrs["prod_usage"], np.int32)
+                                if "prod_usage" in arrs else usage),
+                ))
+
     def node_alloc(self, entry: dict, arrs: dict[str, np.ndarray]) -> None:
         """Allocatable-only refresh (the manager's noderesource patch):
         keep the node's usage/labels/devices, swap its allocatable row.
@@ -1055,6 +1364,28 @@ class SchedulerBinding:
             owner=entry.get("owner"),
             qos=int(entry.get("qos", 0)),
         ))
+
+    def pod_add_run(self,
+                    items: list[tuple[dict, dict[str, np.ndarray]]]
+                    ) -> None:
+        """Vectorized POD_ADD run (ISSUE 19): build the specs outside
+        the scheduler lock, enqueue them under ONE acquisition."""
+        from koordinator_tpu.scheduler.snapshot import PodSpec
+
+        self.scheduler.enqueue_many([
+            PodSpec(
+                name=entry["name"],
+                requests=np.asarray(arrs["requests"], np.int32),
+                priority=int(entry.get("priority", 0)),
+                quota=entry.get("quota"),
+                gang=entry.get("gang"),
+                node_selector=dict(entry.get("node_selector", {})),
+                labels=dict(entry.get("labels", {})),
+                owner=entry.get("owner"),
+                qos=int(entry.get("qos", 0)),
+            )
+            for entry, arrs in items
+        ])
 
     def pod_remove(self, name: str) -> None:
         # pending, nominated, or bound — a bound delete releases its node

@@ -27,11 +27,17 @@ Two version numbers govern the wire:
 - ``PROTOCOL_VERSION`` is the MESSAGE protocol — the set of frame types
   and their document schemas (the role ``apis/runtime/v1alpha1/api.proto``
   plays for the reference).  It is negotiated in HELLO: a client
-  advertises its protocol and the server rejects skew with an ERROR
-  instead of silently mis-decoding (history: v1 ad-hoc docs; v2 adds
-  typed REQUEST_SCHEMAS, the ``proto`` field in HELLO, and lease frames;
-  v3 adds STATE_PUSH — client-originated state events, the direction a
-  non-Python scheduler plugin feeds its informer view into the sidecar).
+  advertises its protocol and the server replies with
+  ``min(peer, local)`` when the peer is inside
+  ``[MIN_PROTOCOL_VERSION, PROTOCOL_VERSION]``, rejecting anything
+  outside the window with an ERROR instead of silently mis-decoding
+  (history: v1 ad-hoc docs; v2 adds typed REQUEST_SCHEMAS, the
+  ``proto`` field in HELLO, and lease frames; v3 adds STATE_PUSH —
+  client-originated state events, the direction a non-Python scheduler
+  plugin feeds its informer view into the sidecar; v4 adds the columnar
+  event codec for the hot frame types — deltasync DELTA/SNAPSHOT event
+  lists ride as columnar numpy blocks instead of per-event JSON docs,
+  see docs/wire_protocol.md).
 
 ``REQUEST_SCHEMAS`` types each schema'd frame's json document;
 ``validate_doc`` is enforced server-side on every request frame, so a
@@ -50,9 +56,26 @@ import numpy as np
 
 MAGIC = 0x4B54
 VERSION = 1
-PROTOCOL_VERSION = 3
+PROTOCOL_VERSION = 4
+#: oldest message protocol this build still speaks.  HELLO negotiates
+#: the session protocol to ``min(peer, PROTOCOL_VERSION)`` as long as
+#: the peer advertises at least this; below it (or above
+#: PROTOCOL_VERSION) the server rejects with "incompatible".  v3 peers
+#: keep the per-event JSON event lists; v4 peers get the columnar
+#: event codec on DELTA/SNAPSHOT.
+MIN_PROTOCOL_VERSION = 3
 _HEADER = struct.Struct("<HBBII")
 MAX_PAYLOAD = 256 << 20  # 256 MiB guard against corrupt length words
+
+#: zero-copy decode policy (ISSUE 19 satellite): a decoded array may
+#: alias the frame payload (np.frombuffer view) ONLY when it is both
+#: big enough that the copy would cost real time AND a large share of
+#: the payload — otherwise the view pins the whole payload buffer for
+#: the lifetime of a tiny array (a 4-byte rv field keeping a multi-MB
+#: snapshot alive).  Small or minority arrays are copied; the payload
+#: buffer is then released as soon as decode returns.
+ZERO_COPY_MIN_BYTES = 64 << 10
+ZERO_COPY_MIN_SHARE = 0.5
 
 
 class FrameType(enum.IntEnum):
@@ -209,13 +232,36 @@ def decode_payload(payload: bytes) -> tuple[dict, dict[str, np.ndarray]]:
     doc = json.loads(payload[4:4 + json_len].decode())
     arrays: dict[str, np.ndarray] = {}
     base = 4 + json_len
-    for entry in doc.pop("__arrays__", []):
-        start = base + entry["offset"]
-        arr = np.frombuffer(
-            payload, dtype=np.dtype(entry["dtype"]),
-            count=int(np.prod(entry["shape"], dtype=np.int64)) if entry["shape"] else 1,
-            offset=start,
-        ).reshape(entry["shape"])
+    manifest = doc.pop("__arrays__", [])
+    if not isinstance(manifest, list):
+        raise WireSchemaError(
+            f"__arrays__ manifest must be a list, got "
+            f"{type(manifest).__name__}")
+    for entry in manifest:
+        try:
+            start = base + int(entry["offset"])
+            nbytes = int(entry["nbytes"])
+            dtype = np.dtype(entry["dtype"])
+            shape = entry["shape"]
+            count = (int(np.prod(shape, dtype=np.int64)) if shape else 1)
+            if (start < 4 + json_len or start + nbytes > len(payload)
+                    or count * dtype.itemsize != nbytes):
+                raise WireSchemaError(
+                    f"array manifest entry {entry.get('key')!r} points "
+                    f"outside the payload (offset={entry['offset']}, "
+                    f"nbytes={nbytes}, payload={len(payload)})")
+            arr = np.frombuffer(payload, dtype=dtype, count=count,
+                                offset=start).reshape(shape)
+        except WireSchemaError:
+            raise
+        except (KeyError, TypeError, ValueError, OverflowError) as e:
+            raise WireSchemaError(
+                f"corrupt array manifest entry {entry!r}: {e}") from e
+        if (nbytes < ZERO_COPY_MIN_BYTES
+                or nbytes < ZERO_COPY_MIN_SHARE * len(payload)):
+            # copy-above-threshold: don't let a small view pin the
+            # whole payload buffer (see ZERO_COPY_MIN_BYTES)
+            arr = arr.copy()
         arrays[entry["key"]] = arr
     _observe_codec("decode", t0, len(payload))
     return doc, arrays
@@ -229,6 +275,34 @@ def _observe_codec(op: str, t0: float, nbytes: int) -> None:
     metrics.wire_payload_bytes.observe(float(nbytes), labels={"op": op})
     if timeline.RECORDER.enabled:
         timeline.RECORDER.add(t0, t1, "json_codec", f"wire.{op}")
+
+
+def pack_str_column(values: list[str]) -> tuple[np.ndarray, np.ndarray]:
+    """Columnar string packing for the v2 event codec: a list of
+    strings becomes ``(lengths int32, utf-8 blob uint8)`` — two numpy
+    arrays that ride the raw array section instead of N JSON string
+    fields.  The inverse is :func:`unpack_str_column`."""
+    encoded = [v.encode() for v in values]
+    lens = np.asarray([len(b) for b in encoded], dtype=np.int32)
+    blob = (np.frombuffer(b"".join(encoded), dtype=np.uint8)
+            if encoded else np.zeros(0, dtype=np.uint8))
+    return lens, blob
+
+
+def unpack_str_column(lens: np.ndarray, blob: np.ndarray) -> list[str]:
+    """Inverse of :func:`pack_str_column`."""
+    raw = blob.tobytes()
+    ends = np.cumsum(lens.astype(np.int64)) if len(lens) else lens
+    if len(lens) and int(ends[-1]) != len(raw):
+        raise WireSchemaError(
+            f"string column blob is {len(raw)} bytes but lengths sum "
+            f"to {int(ends[-1])}")
+    out: list[str] = []
+    pos = 0
+    for end in ends.tolist():
+        out.append(raw[pos:end].decode())
+        pos = end
+    return out
 
 
 def read_frame(recv_exact) -> Frame:

@@ -215,6 +215,32 @@ class TestCommittedBaseline:
     def test_baseline_self_diff_passes(self):
         assert bench_diff.main([self.BASELINE, self.BASELINE]) == 0
 
+    def test_baseline_covers_the_host_turbo_stages(self):
+        """The ISSUE 19 host-plane stages are part of the gated set."""
+        stages = bench_diff.load_stages(self.BASELINE)
+        for name in ("wire_codec_v1_vs_v2", "deltasync_apply_batched",
+                     "bind_commit_batched"):
+            rec = stages.get(name)
+            assert rec is not None and "error" not in rec, name
+            assert rec["ms_per_iter"] > 0, rec
+
+    def test_planted_codec_regression_flagged(self, tmp_path, capsys):
+        """THE ISSUE 19 acceptance: a candidate where the wire codec
+        stage got 10x slower against the COMMITTED baseline must exit
+        1 naming the stage — the sentinel really guards the codec."""
+        slowed = []
+        with open(self.BASELINE) as fh:
+            for line in fh:
+                rec = json.loads(line)
+                if rec.get("stage") == "wire_codec_v1_vs_v2":
+                    rec["ms_per_iter"] = round(
+                        rec["ms_per_iter"] * 10 + 1.0, 2)
+                slowed.append(rec)
+        c = _write(tmp_path / "cand.jsonl", slowed)
+        assert bench_diff.main([self.BASELINE, c]) == 1
+        err = capsys.readouterr().err
+        assert "wire_codec_v1_vs_v2" in err and "FAIL" in err
+
     def test_baseline_covers_the_timeline_overhead_stage(self):
         """The ISSUE's self-overhead stage must be part of the gated
         set, with its measured fraction under the 3% bar."""

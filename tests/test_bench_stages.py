@@ -30,6 +30,8 @@ def test_stage_profiler_smoke():
                       "score_sharded_2d", "rounds_sharded_2d",
                       "sharded_2d_footprint",
                       "explain_compact_1pct", "explain_full_batch",
+                      "wire_codec_v1_vs_v2", "deltasync_apply_batched",
+                      "bind_commit_batched",
                       "tenancy_serial", "tenancy_pipelined",
                       "tenancy_batched", "timeline_overhead"}, stages
     by_stage = {r["stage"]: r for r in records}
@@ -41,9 +43,20 @@ def test_stage_profiler_smoke():
                  "score_sharded_1d", "rounds_sharded_1d",
                  "score_sharded_2d", "rounds_sharded_2d",
                  "explain_compact_1pct",
-                 "explain_full_batch", "tenancy_serial",
+                 "explain_full_batch", "wire_codec_v1_vs_v2",
+                 "deltasync_apply_batched", "bind_commit_batched",
+                 "tenancy_serial",
                  "tenancy_pipelined", "tenancy_batched"):
         assert by_stage[name]["ms_per_iter"] > 0, by_stage[name]
+    # the host-plane turbo stages (ISSUE 19) record the legacy path
+    # beside the batched one so bench_diff guards both inputs of the
+    # speedup ratio
+    assert by_stage["wire_codec_v1_vs_v2"]["v1_ms"] > 0
+    assert by_stage["wire_codec_v1_vs_v2"]["speedup_vs_v1"] > 0
+    assert by_stage["deltasync_apply_batched"]["per_event_ms"] > 0
+    assert by_stage["deltasync_apply_batched"]["speedup_vs_per_event"] > 0
+    assert by_stage["bind_commit_batched"]["per_pod_ms"] > 0
+    assert by_stage["bind_commit_batched"]["speedup_vs_per_pod"] > 0
     # the quality stage reports its cost relative to the greedy rounds
     # it replaces on escalated rounds
     assert by_stage["lp_pack_smoke"]["vs_rounds_x"] > 0

@@ -35,6 +35,7 @@ from tools.koordlint.analyzers.spec_consistency import (
 )
 from tools.koordlint.analyzers.surface_parity import SurfaceParityAnalyzer
 from tools.koordlint.analyzers.tenant_axis import TenantAxisAnalyzer
+from tools.koordlint.analyzers.wire_codec import WireCodecAnalyzer
 from tools.koordlint.analyzers import dashboard_drift
 from tools.koordlint.core import (
     Project,
@@ -345,6 +346,52 @@ class TestTenantAxisCorpus:
         # every slice _unstack'd (or [i]-indexed) before the sink
         assert self.analyzer().run(
             corpus("tenant_axis", "good", ("pkg",))) == []
+
+
+class TestWireCodecCorpus:
+    """ISSUE 19: per-event json.dumps on a frame type that has a v2
+    columnar encoding is a finding — the rule that keeps the codec
+    tentpole from quietly regressing to per-event JSON."""
+
+    def analyzer(self):
+        return WireCodecAnalyzer(package="pkg",
+                                 codec_home=("pkg/wire.py",))
+
+    def test_bad_corpus_flags_each_columnar_frame(self):
+        findings = self.analyzer().run(
+            corpus("wire_codec", "bad", ("pkg",)))
+        messages = "\n".join(f.message for f in findings)
+        # one seeded regression per columnar frame type: the per-event
+        # STATE_PUSH send loop, the DELTA payload built from a
+        # comprehension of dumps, the while-loop SNAPSHOT chunker
+        for frame in ("STATE_PUSH", "DELTA", "SNAPSHOT"):
+            assert f"FrameType.{frame}" in messages, messages
+        assert len(findings) == 3
+        assert all("events_v2" in f.message for f in findings)
+        assert all("wire_protocol" in f.hint for f in findings)
+
+    def test_good_corpus_is_clean(self):
+        # per-frame dumps on columnar frames, a dumps loop with no
+        # columnar frame in scope, and the exempted codec home's v1
+        # fallback all pass
+        assert self.analyzer().run(
+            corpus("wire_codec", "good", ("pkg",))) == []
+
+    def test_codec_home_exemption_is_load_bearing(self):
+        # the same good corpus WITHOUT the exemption flags the v1
+        # fallback packer — proof the default exemption for
+        # transport/wire.py + deltasync.py is what keeps the real
+        # tree's legacy path legal
+        findings = WireCodecAnalyzer(package="pkg", codec_home=()).run(
+            corpus("wire_codec", "good", ("pkg",)))
+        assert [f.path for f in findings] == ["pkg/wire.py"]
+        assert "pack_events_v1" in findings[0].message
+
+    def test_real_transport_tree_is_clean(self, real_tree):
+        # the shipped tree ships no per-event JSON on columnar frames
+        # (the v1 paths live inside the exempt codec home; real_tree
+        # reuses the shared whole-tree parse — the parse dominates)
+        assert WireCodecAnalyzer().run(real_tree) == []
 
 
 @pytest.fixture(scope="module")

@@ -658,3 +658,93 @@ class TestTrainingExport:
         assert joined[-1]["round"]["cycle_seq"] == cycles[0]["cycle"]
         assert joined[-1]["timeline"]["critical_cause"] == (
             cycles[0]["critical_cause"])
+
+
+# ---------------------------------------------------------------------------
+# soak_report host-wait attribution verdict (ISSUE 19 satellite)
+# ---------------------------------------------------------------------------
+
+class TestHostWaitVerdict:
+    """soak_report folds the /debug/timeline attribution into the soak
+    verdict: per-tenant top causes, and a RED flip when the mean
+    unattributed residual exceeds the 5% bar."""
+
+    @staticmethod
+    def _cycles(residual):
+        return [{
+            "cycle": 9, "mode": "pipelined", "wall_s": 1.0,
+            "unattributed_fraction": residual,
+            "segments": [
+                {"start": 0.0, "end": 0.30, "cause": "json_codec",
+                 "name": "encode", "tenant": "a"},
+                {"start": 0.30, "end": 0.35, "cause": "bind_commit",
+                 "name": "bind", "tenant": "a"},
+                {"start": 0.35, "end": 0.55, "cause": "deltasync_apply",
+                 "name": "sync.run", "tenant": "b"},
+                {"start": 0.55, "end": 0.60, "cause": "dispatch",
+                 "name": "solve", "tenant": ""},
+            ],
+        }]
+
+    def test_table_ranks_causes_per_tenant(self):
+        from soak_report import host_wait_attribution
+
+        hw = host_wait_attribution(self._cycles(0.01))
+        assert hw["cycles"] == 1
+        # tenant a: json_codec (0.30s) ahead of bind_commit (0.05s)
+        assert [c for c, _ in hw["tenants"]["a"]] == [
+            "json_codec", "bind_commit"]
+        assert hw["tenants"]["b"][0][0] == "deltasync_apply"
+        # untenanted segments land under "-"
+        assert hw["tenants"]["-"][0][0] == "dispatch"
+        assert hw["unattributed_ok"]
+
+    def test_residual_over_bar_flips_red(self):
+        from soak_report import UNATTRIBUTED_RED_FRACTION, attach_host_wait
+
+        verdict = {"green": True}
+        hw = attach_host_wait(
+            verdict, {"enabled": True, "cycles": self._cycles(0.20)})
+        assert verdict["green"] is False
+        assert str(UNATTRIBUTED_RED_FRACTION) in hw["red_reason"] or \
+            "0.05" in hw["red_reason"]
+        # ... and the bar itself: residual AT the bar stays green
+        verdict = {"green": True}
+        attach_host_wait(
+            verdict, {"enabled": True, "cycles": self._cycles(0.05)})
+        assert verdict["green"] is True
+
+    def test_disarmed_recorder_or_no_cycles_never_judges(self):
+        from soak_report import attach_host_wait
+
+        # kill switch thrown: cycles exist in the body but enabled is
+        # False — attach the table, do not flip
+        verdict = {"green": True}
+        attach_host_wait(
+            verdict, {"enabled": False, "cycles": self._cycles(0.9)})
+        assert verdict["green"] is True
+        # armed but nothing reconstructed: nothing to judge
+        verdict = {"green": True}
+        hw = attach_host_wait(verdict, {"enabled": True, "cycles": []})
+        assert verdict["green"] is True and hw["cycles"] == 0
+
+    def test_live_cycle_attribution_is_accountable(self, kit_off):
+        """The real pipeline keeps itself under the bar: a live
+        multi-tenant cycle's reconstruction attaches green, with the
+        turbo causes present in the cause vocabulary."""
+        from koordinator_tpu.scheduler import services
+        from soak_report import attach_host_wait
+
+        timeline.RECORDER.reset_for_tests()
+        front = _make_front(kit_off)
+        for t in front.tenants():
+            _enqueue_pods(t.scheduler, 6, seed=17)
+        front.schedule_cycle()
+        body = services.debug_timeline_body(
+            front.tenants()[0].scheduler, {"cycles": 8})
+        for cause in ("json_codec", "deltasync_apply", "bind_commit"):
+            assert cause in body["causes"]
+        verdict = {"green": True}
+        hw = attach_host_wait(verdict, body)
+        assert hw["cycles"] >= 1
+        assert verdict["green"] is True, hw

@@ -270,6 +270,7 @@ class TestStatePushNoPartialCommit:
 
     def test_random_pushes_atomic(self):
         import numpy as np
+        pytest.importorskip("hypothesis")
         from hypothesis import given, settings, strategies as st
 
         from koordinator_tpu.api.resources import NUM_RESOURCE_DIMS

@@ -14,6 +14,7 @@ from .mesh_discipline import MeshDisciplineAnalyzer
 from .spec_consistency import SpecConsistencyAnalyzer
 from .surface_parity import SurfaceParityAnalyzer
 from .tenant_axis import TenantAxisAnalyzer
+from .wire_codec import WireCodecAnalyzer
 
 ALL_ANALYZERS = (
     JitHostSyncAnalyzer,
@@ -28,6 +29,8 @@ ALL_ANALYZERS = (
     DtypeRegimeAnalyzer,
     DonationFlowAnalyzer,
     TenantAxisAnalyzer,
+    # protocol v4 columnar codec (ISSUE 19)
+    WireCodecAnalyzer,
 )
 
 

@@ -1,0 +1,122 @@
+"""wire-codec: no per-event JSON on frames with a columnar encoding.
+
+Protocol v4 gave DELTA / SNAPSHOT / STATE_PUSH a columnar ``events_v2``
+payload (tools ran ~25x faster on the encode/decode half of
+``json_codec`` — see docs/wire_protocol.md).  The regression this rule
+guards against is the one the tentpole removed: a caller that loops
+``json.dumps`` per event and ships K tiny documents (or one document
+built from K per-event dumps) instead of packing ONE columnar frame.
+That pattern re-inflates ``pipeline_host_wait_fraction`` quietly — the
+frames still validate, the peers still converge, only the soak timeline
+shows ``json_codec`` creeping back up.
+
+The rule is lexical and deliberately narrow:
+
+- a function counts as *handling a columnar frame* when it references
+  ``FrameType.DELTA`` / ``FrameType.SNAPSHOT`` / ``FrameType.STATE_PUSH``
+  (any dotted spelling — ``wire.FrameType.DELTA`` included);
+- inside such a function, a ``json.dumps`` call lexically inside a loop
+  (``for`` / ``while`` / any comprehension) is a finding — per-frame
+  encoding is one dumps per FRAME, never one per event;
+- the codec home itself (transport/wire.py, transport/deltasync.py) is
+  exempt: the v1 fallback paths there legitimately serialize per event
+  for pre-v4 peers, and that is where the one-dumps-per-frame invariant
+  is implemented rather than consumed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import get_index
+from ..core import Analyzer, Finding, Project
+from .donation_safety import dotted_path
+
+#: frame types that carry a columnar (events_v2) payload in protocol v4
+COLUMNAR_FRAMES = ("DELTA", "SNAPSHOT", "STATE_PUSH")
+
+#: where the codec lives — per-event JSON is the v1 compatibility path
+#: there, not a regression
+DEFAULT_CODEC_HOME = (
+    "koordinator_tpu/transport/wire.py",
+    "koordinator_tpu/transport/deltasync.py",
+)
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While,
+          ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+class WireCodecAnalyzer(Analyzer):
+    name = "wire-codec"
+    description = ("per-event json.dumps in a loop while handling a "
+                   "frame type that has a columnar events_v2 encoding "
+                   "(DELTA/SNAPSHOT/STATE_PUSH)")
+
+    def __init__(self, package: str = "koordinator_tpu",
+                 codec_home: tuple[str, ...] = DEFAULT_CODEC_HOME):
+        self.package = package
+        self.codec_home = set(codec_home)
+
+    def run(self, project: Project) -> list[Finding]:
+        index = get_index(project, self.package)
+        findings: list[Finding] = []
+        for fq, fn in sorted(index.functions.items()):
+            if fn.sf.path in self.codec_home:
+                continue
+            frames = _columnar_frames_referenced(fn.node)
+            if not frames:
+                continue
+            for dumps in _loop_dumps_calls(index, fn):
+                findings.append(Finding(
+                    self.name, fn.sf.path, dumps.lineno,
+                    f"per-event json.dumps in a loop in {fn.qualname!r} "
+                    f"while handling FrameType.{'/'.join(frames)} — "
+                    "these frames have a columnar events_v2 encoding; "
+                    "per-event JSON regresses json_codec host-wait",
+                    hint="pack the whole batch once (columnar "
+                         "events_v2 via the deltasync codec, raw "
+                         "arrays via wire.encode_payload) and ship "
+                         "ONE frame; see docs/wire_protocol.md"))
+        dedup: dict[tuple, Finding] = {}
+        for f in findings:
+            dedup.setdefault((f.path, f.line), f)
+        return sorted(dedup.values(), key=lambda f: (f.path, f.line))
+
+
+def _columnar_frames_referenced(node: ast.AST) -> list[str]:
+    """Columnar FrameType members this function mentions, in enum order."""
+    seen: set[str] = set()
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Attribute):
+            continue
+        if sub.attr not in COLUMNAR_FRAMES:
+            continue
+        dotted = dotted_path(sub)
+        if dotted and dotted.split(".")[-2:-1] == ["FrameType"]:
+            seen.add(sub.attr)
+    return [f for f in COLUMNAR_FRAMES if f in seen]
+
+
+def _is_json_dumps(index, mod: str, func: ast.AST) -> bool:
+    if (isinstance(func, ast.Attribute) and func.attr == "dumps"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "json"):
+        return True
+    return index.resolve(mod, func) == "json.dumps"
+
+
+def _loop_dumps_calls(index, fn) -> list[ast.Call]:
+    """json.dumps calls lexically inside a loop of this function (the
+    loop bodies of nested defs included — a helper closure looping
+    dumps inside the handler is the same hot path)."""
+    out: list[ast.Call] = []
+    seen: set[int] = set()
+    for loop in ast.walk(fn.node):
+        if not isinstance(loop, _LOOPS):
+            continue
+        for call in ast.walk(loop):
+            if (isinstance(call, ast.Call) and id(call) not in seen
+                    and _is_json_dumps(index, fn.module, call.func)):
+                seen.add(id(call))
+                out.append(call)
+    return out

@@ -1,0 +1,32 @@
+"""Seeded-bad corpus: per-event JSON on columnar frames.
+
+Three regressions to the pre-v4 shape, one per columnar frame type:
+a per-event STATE_PUSH send loop, a DELTA payload built from a
+comprehension of per-event dumps, and a SNAPSHOT chunker that
+serializes inside a while loop.
+"""
+
+import json
+
+from . import wire
+from .wire import FrameType
+
+
+def push_one_per_event(conn, events):
+    # BAD: K tiny frames, K dumps — the exact pre-v4 hot path
+    for ev in events:
+        conn.send(wire.FrameType.STATE_PUSH, {"event": json.dumps(ev)})
+
+
+def delta_from_per_event_docs(conn, batch, rv):
+    # BAD: one frame, but its payload is K per-event dumps
+    rows = [json.dumps(e, sort_keys=True) for e in batch]
+    conn.send(FrameType.DELTA, {"rv": rv, "events": rows})
+
+
+def snapshot_in_chunks(conn, state):
+    # BAD: while-loop per-chunk serialization on the SNAPSHOT frame
+    pending = list(state.items())
+    while pending:
+        chunk, pending = pending[:64], pending[64:]
+        conn.send(FrameType.SNAPSHOT, {"chunk": json.dumps(chunk)})

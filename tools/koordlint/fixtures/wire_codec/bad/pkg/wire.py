@@ -1,0 +1,11 @@
+"""Fixture wire module: just enough FrameType for the pusher to name."""
+
+import enum
+
+
+class FrameType(enum.IntEnum):
+    HELLO = 1
+    SNAPSHOT = 2
+    DELTA = 3
+    ACK = 4
+    STATE_PUSH = 13

@@ -1,0 +1,38 @@
+"""Seeded-good corpus: the columnar shapes the rule should accept.
+
+One dumps per FRAME on a columnar frame type, a dumps loop in a
+function that handles no columnar frame at all, and a batch path that
+defers encoding to the codec home.
+"""
+
+import json
+
+from . import wire
+from .wire import FrameType
+
+
+def push_batch(conn, events, rv):
+    # GOOD: one frame, one dumps — per-frame encoding
+    doc = {"rv": rv, "events_v2": len(events)}
+    conn.send(wire.FrameType.STATE_PUSH, json.dumps(doc))
+
+
+def snapshot_once(conn, state, rv):
+    # GOOD: the loop builds rows; serialization happens once, outside it
+    rows = []
+    for name, rec in sorted(state.items()):
+        rows.append((name, rec))
+    conn.send(FrameType.SNAPSHOT, json.dumps({"rv": rv, "rows": rows}))
+
+
+def audit_log(path, records):
+    # GOOD: dumps in a loop, but no columnar frame in sight — the audit
+    # trail is a different subsystem with different constraints
+    with open(path, "a") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def delta_via_codec(conn, batch, rv):
+    # GOOD: per-event work delegated to the codec home's packer
+    conn.send(FrameType.DELTA, wire.pack_events_v1(batch))

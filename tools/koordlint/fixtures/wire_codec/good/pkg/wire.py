@@ -1,0 +1,21 @@
+"""Fixture codec home: the v1 compatibility path loops dumps per event
+by design (pre-v4 peers need one JSON doc per event) — the analyzer is
+constructed with this file as ``codec_home`` and must stay silent."""
+
+import enum
+import json
+
+
+class FrameType(enum.IntEnum):
+    HELLO = 1
+    SNAPSHOT = 2
+    DELTA = 3
+    ACK = 4
+    STATE_PUSH = 13
+
+
+def pack_events_v1(batch):
+    # legacy per-event encoding for pre-v4 peers: exempt here, and
+    # ONLY here
+    rows = [json.dumps(e, sort_keys=True) for e in batch]
+    return {"frame": int(FrameType.DELTA), "events": rows}

@@ -59,6 +59,65 @@ def _fmt_rate(doc: dict) -> str:
     return f"{rate:+.2f}/h"
 
 
+#: attribution-quality bar (ISSUE 19): a soak whose timeline cannot
+#: name this fraction of host wall time is flying on a rotten
+#: instrument — the host-wait numbers the turbo work is judged by
+#: would be unfalsifiable, so the verdict goes RED
+UNATTRIBUTED_RED_FRACTION = 0.05
+
+
+def host_wait_attribution(cycle_docs: list[dict], top: int = 4) -> dict:
+    """Aggregate ``/debug/timeline`` cycle docs into the verdict's
+    host-wait section: per-tenant top causes by attributed seconds
+    (tenant-tagged segments; the untenanted scheduler's segments land
+    under ``-``) and the WALL-WEIGHTED unattributed residual across
+    cycles.  Wall-weighted, not a plain mean of per-cycle fractions:
+    a degenerate sub-millisecond cycle (an empty round) is ~all
+    residual by construction and would swamp a plain mean while
+    representing no wall time anyone waits on."""
+    per_tenant: dict[str, dict[str, float]] = {}
+    resid_s = 0.0
+    wall_s = 0.0
+    for cyc in cycle_docs:
+        wall = float(cyc.get("wall_s", 0.0))
+        wall_s += wall
+        resid_s += float(cyc.get("unattributed_fraction", 0.0)) * wall
+        for seg in cyc.get("segments", []):
+            tenant = seg.get("tenant") or "-"
+            causes = per_tenant.setdefault(tenant, {})
+            dur = float(seg["end"]) - float(seg["start"])
+            causes[seg["cause"]] = causes.get(seg["cause"], 0.0) + dur
+    mean_resid = (resid_s / wall_s) if wall_s > 0 else 0.0
+    return {
+        "cycles": len(cycle_docs),
+        "tenants": {
+            t: [[c, round(s, 6)] for c, s in
+                sorted(causes.items(), key=lambda kv: -kv[1])[:top]]
+            for t, causes in sorted(per_tenant.items())},
+        "unattributed_wall_fraction": round(mean_resid, 6),
+        "unattributed_ok": mean_resid <= UNATTRIBUTED_RED_FRACTION,
+    }
+
+
+def attach_host_wait(verdict: dict, timeline_body: dict) -> dict:
+    """Fold the host-wait attribution table into the verdict.  An
+    armed recorder whose cycles carry a mean unattributed residual
+    above the bar flips the verdict RED — the attribution the perf
+    work steers by must stay accountable.  A disarmed recorder (kill
+    switch) or a run with no reconstructed cycles attaches the empty
+    table without judging it."""
+    hw = host_wait_attribution(timeline_body.get("cycles", []))
+    verdict["host_wait"] = hw
+    if (timeline_body.get("enabled") and hw["cycles"]
+            and not hw["unattributed_ok"]):
+        verdict["green"] = False
+        hw["red_reason"] = (
+            f"mean unattributed host-wait residual "
+            f"{hw['unattributed_wall_fraction']:.3f} > "
+            f"{UNATTRIBUTED_RED_FRACTION:.2f}")
+    return hw
+
+
 def print_report(verdict: dict, harness) -> None:
     trend = verdict["trend"]
     print("== steady-state verdict "
@@ -99,6 +158,16 @@ def print_report(verdict: dict, harness) -> None:
                   f"{t['pending']:>8} {t['bound']:>7} "
                   f"{t['rounds']:>7} {t['admitted_total']:>9} "
                   f"{str(t['degraded']):>9} {t['flight_dumps']:>6}")
+    hw = verdict.get("host_wait")
+    if hw and hw["cycles"]:
+        print(f"-- host-wait attribution ({hw['cycles']} cycles; "
+              f"unattributed wall="
+              f"{hw['unattributed_wall_fraction']:.3f} "
+              f"bar={UNATTRIBUTED_RED_FRACTION:.2f} "
+              f"{'ok' if hw['unattributed_ok'] else 'RED'})")
+        for tenant, causes in hw["tenants"].items():
+            row = "  ".join(f"{c}={s:.3f}s" for c, s in causes)
+            print(f"   {tenant:<8} {row}")
     # the join: every non-steady series arrives WITH the rounds that
     # overlapped it — dumped (slow/degraded/slo) rounds first, else the
     # slowest — so the leak verdict and its "what was happening" flight
@@ -391,6 +460,10 @@ def main(argv: list[str] | None = None) -> int:
         harness.start()
         try:
             verdict = harness.run(events)
+            from koordinator_tpu.scheduler import services as _services
+
+            attach_host_wait(verdict, _services.debug_timeline_body(
+                harness.scheduler, {"cycles": 512}))
             print_report(verdict, harness)
             if args.json:
                 print(json.dumps(verdict, indent=2, default=str))
