@@ -796,20 +796,26 @@ def main() -> None:
             from koordinator_tpu import timeline as _tl
 
             was_enabled = _tl.RECORDER.enabled
-            reps = 3 if smoke else 2
+            reps = 10 if smoke else 3
 
-            def best_wall(enabled: bool) -> float:
-                # min-of-reps: host scheduling jitter at smoke scale
-                # (one-digit-ms cycles) dwarfs the instrumentation;
-                # the MINIMUM wall is the defensible cost floor
+            def one_wall(enabled: bool) -> float:
                 _tl.RECORDER.set_enabled(enabled)
-                return min(run_mode(build_front(pipeline=True,
-                                                batched=False))[0]
-                           for _ in range(reps))
+                return run_mode(build_front(pipeline=True,
+                                            batched=False))[0]
 
             try:
-                wall_on = best_wall(True)
-                wall_off = best_wall(False)
+                # interleaved on/off pairs + min-of-reps: host
+                # scheduling jitter at smoke scale (one-digit-ms
+                # cycles) dwarfs the instrumentation, and alternating
+                # modes keeps slow drift (thermal, page cache) from
+                # landing entirely on one side; the MINIMUM wall per
+                # mode is the defensible cost floor
+                walls_on = []
+                walls_off = []
+                for _ in range(reps):
+                    walls_on.append(one_wall(True))
+                    walls_off.append(one_wall(False))
+                wall_on, wall_off = min(walls_on), min(walls_off)
             finally:
                 _tl.RECORDER.set_enabled(was_enabled)
             overhead = ((wall_on - wall_off) / wall_off
@@ -821,6 +827,95 @@ def main() -> None:
                                       if overhead is not None else None)})
         except Exception as e:
             print(json.dumps({"stage": "timeline_overhead",
+                              "error": repr(e)[:200]}), flush=True)
+
+        # -- journey-ledger self-overhead (ISSUE 20): the SAME pipelined
+        # cycle with the always-on pod-journey ledger recording vs with
+        # the kill switch thrown.  The ledger is O(1) host bookkeeping
+        # per pod (enqueue stamp + one staged sketch append per
+        # committed round; decisions are bit-identical either way —
+        # tests/test_journey.py proves it), so its ONLY possible cost is
+        # the wall time spent inside its calls.  overhead_fraction is
+        # therefore measured directly: the ON reps run with the ledger's
+        # hot-path entry points (note_enqueue / forget /
+        # record_bind_batch) wrapped in perf_counter accounting, and the
+        # fraction is ledger-seconds over cycle wall.  Differencing the
+        # on/off walls instead (reported as wall_delta_fraction for the
+        # curious) CANNOT resolve a sub-1% effect at smoke scale: host
+        # jitter on one-digit-ms cycles is +/-5% even with interleaved
+        # min-of-10 reps, so that number is noise.  The timing shims
+        # themselves cost more than the ledger calls they wrap and are
+        # counted against the ledger, so the reported fraction is a
+        # strict upper bound — which is why the shims go on AFTER the
+        # warm-up cycle: they must only see the timed window.
+        # The guard test asserts overhead_fraction < 1%.
+        try:
+            from koordinator_tpu import journey as _jn
+
+            journey_was = _jn.LEDGER.enabled
+            reps = 10 if smoke else 3
+            _HOT = ("note_enqueue", "forget", "record_bind_batch")
+
+            def one_wall_journey(enabled: bool) -> tuple:
+                _jn.LEDGER.set_enabled(enabled)
+                front = build_front(pipeline=True, batched=False)
+                fill(front, 0)
+                front.schedule_cycle()      # warm, outside the shims
+                spent = [0.0]
+                if enabled:
+                    def _shim(fn):
+                        def w(*a, **kw):
+                            t0 = _time.perf_counter()
+                            r = fn(*a, **kw)
+                            spent[0] += _time.perf_counter() - t0
+                            return r
+                        return w
+                    for n in _HOT:
+                        # instance attribute shadows the class method;
+                        # delattr below restores the original
+                        setattr(_jn.LEDGER, n, _shim(getattr(_jn.LEDGER, n)))
+                try:
+                    t0 = _time.perf_counter()
+                    for c in range(1, cycles + 1):
+                        fill(front, c)
+                        front.schedule_cycle()
+                    wall = _time.perf_counter() - t0
+                finally:
+                    if enabled:
+                        for n in _HOT:
+                            delattr(_jn.LEDGER, n)
+                return wall, spent[0]
+
+            try:
+                # interleaved on/off pairs + min-of-reps for the wall
+                # numbers, same rationale as timeline_overhead
+                jwalls_on = []
+                jledger_s = []
+                jwalls_off = []
+                for _ in range(reps):
+                    w, spent_s = one_wall_journey(True)
+                    jwalls_on.append(w)
+                    jledger_s.append(spent_s)
+                    jwalls_off.append(one_wall_journey(False)[0])
+                jwall_on = min(jwalls_on)
+                jwall_off = min(jwalls_off)
+            finally:
+                _jn.LEDGER.set_enabled(journey_was)
+            joverhead = (sum(jledger_s) / sum(jwalls_on)
+                         if sum(jwalls_on) > 0 else None)
+            jdelta = ((jwall_on - jwall_off) / jwall_off
+                      if jwall_off > 0 else None)
+            _emit("journey_ledger_overhead", jwall_on / cycles, {
+                "tenants": T,
+                "off_ms_per_iter": round(jwall_off / cycles * 1e3, 2),
+                "ledger_ms_per_iter": round(
+                    sum(jledger_s) / len(jledger_s) / cycles * 1e3, 4),
+                "overhead_fraction": (round(joverhead, 4)
+                                      if joverhead is not None else None),
+                "wall_delta_fraction": (round(jdelta, 4)
+                                        if jdelta is not None else None)})
+        except Exception as e:
+            print(json.dumps({"stage": "journey_ledger_overhead",
                               "error": repr(e)[:200]}), flush=True)
 
 

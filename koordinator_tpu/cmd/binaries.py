@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 from typing import Any, Optional
 
 from koordinator_tpu.cmd import (
@@ -56,6 +57,19 @@ class Assembled:
         so a follower acquires without waiting out the duration."""
         if self.checkpointer is not None:
             self.checkpointer.stop()
+        # journey-ledger fleet snapshot (ISSUE 20): every binary flushes
+        # its sketch table on teardown when KOORD_JOURNEY_JSONL names a
+        # path — tools/latency_report.py merges the per-process files
+        # into one fleet-wide journey table (merge = bucket-wise add)
+        journey_path = os.environ.get("KOORD_JOURNEY_JSONL")
+        if journey_path:
+            try:
+                from koordinator_tpu import journey
+
+                if journey.LEDGER.enabled:
+                    journey.LEDGER.write_jsonl(journey_path)
+            except Exception:
+                pass
         if self.telemetry is not None:
             self.telemetry.stop()
         if self.elector is not None:
@@ -576,6 +590,14 @@ def build_scheduler_parser() -> argparse.ArgumentParser:
              "bit-identical either way; KOORD_TIMELINE=0 is the env "
              "equivalent)")
     parser.add_argument(
+        "--no-journey", action="store_true",
+        help="disable the pod-journey ledger (journey.py): no per-pod "
+             "arrival/enqueue/bind latency sketches, /debug/latency "
+             "answers 501, and the pod_journey_latency_seconds gauges "
+             "go dark — the kill switch for suspected self-overhead "
+             "(scheduling decisions and quota charges are bit-identical "
+             "either way; KOORD_JOURNEY=0 is the env equivalent)")
+    parser.add_argument(
         "--trace-pods", action="store_true",
         help="open a root trace span for EVERY enqueued pod (pods whose "
              "submitter propagated a trace context are always traced); "
@@ -703,6 +725,10 @@ def main_koord_scheduler(argv: list[str],
         from koordinator_tpu import timeline
 
         timeline.RECORDER.set_enabled(False)
+    if args.no_journey:
+        from koordinator_tpu import journey
+
+        journey.LEDGER.set_enabled(False)
     from koordinator_tpu.cmd.component_config import (
         SchedulerComponentConfig,
         load_scheduler_config,
@@ -795,6 +821,7 @@ def main_koord_scheduler(argv: list[str],
             **sched_kwargs,
         )
     # -- self-observability: SLO burn-rate engine + solver introspection
+    from koordinator_tpu import journey as _journey
     from koordinator_tpu.ops.introspection import ProfilerCapture
     from koordinator_tpu.slo_monitor import (
         SloMonitor,
@@ -827,7 +854,10 @@ def main_koord_scheduler(argv: list[str],
         # the offending SLO named — the "why" artifact next to the alert
         on_breach=lambda spec, doc: scheduler.flight_recorder.dump_now(
             f"slo:{spec.name}"),
-        pre_sample=[telemetry.sample],
+        # the journey ledger's quantile gauges refresh in the SAME sweep
+        # that evaluates the SLO windows, so burn rates compute from true
+        # per-pod e2e quantiles instead of round-bucket interpolation
+        pre_sample=[telemetry.sample, _journey.LEDGER.publish_gauges],
     )
     scheduler.slo_monitor = slo_monitor
     # the trend engine shares the SLO monitor's sample cache: one

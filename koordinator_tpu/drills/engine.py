@@ -460,7 +460,11 @@ class DrillHarness:
             doc = {"kind": "pod_add", "name": ev.name,
                    "priority": int(ev.payload.get("priority", 1000)),
                    "quota": ev.payload.get("quota"),
-                   "gang": ev.payload.get("gang")}
+                   "gang": ev.payload.get("gang"),
+                   # journey-ledger ingest stamp (ISSUE 20): the drill
+                   # harness is the manager-leg analog, so e2e latency
+                   # under churn includes the deltasync hop
+                   "arrival_ts": time.time()}
             doc = {k: v for k, v in doc.items() if v is not None}
             if self._push(feeder, FrameType.STATE_PUSH, doc,
                           {"requests": req}):

@@ -533,6 +533,16 @@ critical_path_seconds = SCHEDULER.gauge(
     "ROADMAP item-5 perf attack should aim at.  Every cause is "
     "republished each cycle so cleared ones read 0")
 
+# -- pod-journey ledger (journey.py, ISSUE 20) --
+pod_journey_latency_seconds = SCHEDULER.gauge(
+    "pod_journey_latency_seconds",
+    "Per-pod scheduling-journey latency quantiles from the always-on "
+    "journey ledger's mergeable log-bucketed sketches (labels: tenant, "
+    "qos, stage=e2e|ingest|queue_wait|solve|commit, q=0.5|0.99).  "
+    "Unlike the round-scoped scheduling_duration histogram these are "
+    "TRUE per-pod arrival->bind quantiles with <=1% relative error, "
+    "published by the SloMonitor pre-sample hook each sweep")
+
 # -- bench probe arming (bench_prober.py, ROADMAP item 1) --
 bench_probe_attempts = SCHEDULER.counter(
     "bench_probe_attempts_total",

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from koordinator_tpu import metrics, timeline, tracing
+from koordinator_tpu import journey, metrics, timeline, tracing
 from koordinator_tpu.ops.assignment import ScoringConfig
 from koordinator_tpu.ops.gang import GangInfo
 from koordinator_tpu.ops.network_topology import (
@@ -490,6 +490,9 @@ class Scheduler:
         self._last_dirty_pod_frac = 0.0
         self._last_staleness_s: float | None = None
         self._round_recordable = False
+        #: journey-ledger solve-dispatch edge; None outside a round so
+        #: out-of-round binds fall back to their own commit stamp
+        self._journey_round_t0: float | None = None
 
         # -- placement explainability (ISSUE 6) --
         from koordinator_tpu.scheduler.explanation import ExplanationRing
@@ -900,6 +903,11 @@ class Scheduler:
         # would paint a phantom arrival spike on the dashboards
         if pod.name not in self.pending:
             metrics.pods_enqueued_total.inc(labels=self._tl())
+            # journey-ledger enqueue stamp (ISSUE 20): first enqueue only
+            # — a resync replay must not reset the pod's queue-wait clock
+            if journey.LEDGER.enabled:
+                journey.LEDGER.note_enqueue(
+                    pod.name, getattr(pod, "arrival_ts", 0.0))
         self.pending[pod.name] = pod
         self._pending_rev += 1
         # the pod's trace starts (or joins) here: a propagated
@@ -941,6 +949,7 @@ class Scheduler:
             self.pod_traces.pop(pod_name, None)
             if pod is not None:
                 self._pending_rev += 1
+                journey.LEDGER.forget(pod_name)
             if pod_name in self.nominations and pod is not None:
                 self._nomination_release(pod)
             else:
@@ -1340,6 +1349,11 @@ class Scheduler:
         self._last_dirty_pod_frac = 0.0
         self._last_unschedulable_top = {}
         self._round_recordable = False
+        #: solve-dispatch edge for the journey ledger's queue_wait/solve
+        #: stage split — round-scoped: set here, read by the bind-commit
+        #: paths, cleared again when the host half returns so an
+        #: out-of-round bind never inherits a previous round's edge
+        self._journey_round_t0 = time.perf_counter()
 
     def _current_path(self) -> str:
         return (self.last_solve_path
@@ -1925,6 +1939,7 @@ class Scheduler:
         work round N+1's device solve overlaps under pipelined
         operation (tenancy front-end)."""
         if handle.done:
+            self._journey_round_t0 = None   # gated round: no solve edge
             return handle.result
         pods, batch, result = handle.pods, handle.batch, handle.result
         gangs, quota, solver = handle.gangs, handle.quota, handle.solver
@@ -2158,6 +2173,10 @@ class Scheduler:
 
         metrics.pending_pods.set(float(len(self.pending)),
                                  labels=self._tl())  # post-bind queue
+        # round over: binds landed after this point (nomination
+        # conversions, reservation draws outside a round) stamp their
+        # own commit edge instead of inheriting this round's
+        self._journey_round_t0 = None
         return result
 
     # -- solve-quality mode (ISSUE 13) --------------------------------------
@@ -2653,6 +2672,7 @@ class Scheduler:
 
         ``charge_quota=False`` converts a nomination whose quota charge is
         already on the tree (``_nomination_assume``)."""
+        commit_t0 = time.perf_counter()
         result.assignments[pod.name] = node
         if self.pending.pop(pod.name, None) is not None:
             self._pending_rev += 1
@@ -2696,6 +2716,13 @@ class Scheduler:
             self.explanations.delete(pod.name)
         if self.auditor is not None:
             self.auditor.record(pod.gang or pod.name, "ScheduleSuccess", node)
+        if journey.LEDGER.enabled:
+            round_t0 = self._journey_round_t0
+            journey.LEDGER.record_bind_batch(
+                self.tenant, (pod,),
+                round_start_perf=(round_t0 if round_t0 is not None
+                                  else commit_t0),
+                commit_perf=commit_t0)
 
     # koordlint: guarded-by(self.lock)
     def _commit_bind_batch(self, binds: list[tuple[PodSpec, str]],
@@ -2718,6 +2745,7 @@ class Scheduler:
         round instead of one frame per pod."""
         if not binds:
             return
+        commit_t0 = time.perf_counter()
         # phase 1: registry bookkeeping (assignments / pending /
         # nominations / bound), in order — later same-name entries win
         # exactly as they would sequentially
@@ -2777,6 +2805,17 @@ class Scheduler:
         elif self.bind_fn is not None:
             for pod, node in binds:
                 self.bind_fn(pod.name, node)
+        # journey ledger (ISSUE 20): one vectorized pass records the whole
+        # round's e2e + stage latencies.  Pure observation — runs after
+        # every decision and quota charge above is already committed, so
+        # KOORD_JOURNEY=0 is bit-identical on scheduling outcomes.
+        if journey.LEDGER.enabled:
+            round_t0 = self._journey_round_t0
+            journey.LEDGER.record_bind_batch(
+                self.tenant, [pod for pod, _node in binds],
+                round_start_perf=(round_t0 if round_t0 is not None
+                                  else commit_t0),
+                commit_perf=commit_t0)
 
     def _allocate_fine_grained(self, pod: PodSpec, node: str) -> None:
         """Reserve-phase fine-grained allocation (nodenumaresource Reserve:

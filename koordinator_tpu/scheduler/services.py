@@ -172,6 +172,33 @@ def debug_timeline_body(scheduler, params: dict | None = None) -> dict:
     }
 
 
+def debug_latency_body(scheduler, params: dict | None = None) -> dict:
+    """The /debug/latency?tenant= payload (shared by DebugService and the
+    HTTP gateway): the pod-journey ledger's per-(tenant, qos, stage)
+    latency quantile table — TRUE per-pod arrival->bind e2e quantiles
+    plus the stage decomposition (ingest, queue_wait, solve, commit),
+    each from a mergeable log-bucketed sketch with <=1% relative error.
+
+    501 when the ledger is off (``KOORD_JOURNEY=0`` / ``--no-journey``);
+    400 (typed) on a tenant filter that matches no recorded series."""
+    from koordinator_tpu import journey
+
+    if not journey.LEDGER.enabled:
+        raise DebugApiError(501, "journey ledger disabled "
+                                 "(KOORD_JOURNEY=0 / --no-journey)")
+    tenant = (params or {}).get("tenant")
+    if tenant is not None:
+        known = journey.LEDGER.tenants()
+        if tenant not in known:
+            raise DebugApiError(
+                400, f"unknown tenant {tenant!r} "
+                     f"(recorded: {', '.join(known) or 'none yet'})")
+    doc = journey.LEDGER.report(tenant=tenant)
+    doc["stages"] = list(journey.STAGES)
+    doc["pending"] = journey.LEDGER.pending_count()
+    return doc
+
+
 def debug_profile_body(scheduler, seconds) -> dict:
     """The /debug/profile?seconds=N payload: an on-demand jax.profiler
     capture.  403 while the gate is off (the default), 409 while a
@@ -347,6 +374,7 @@ class DebugService:
         self.register("/debug/forecast", self._forecast)
         self.register("/debug/tenants", self._tenants)
         self.register("/debug/timeline", self._timeline)
+        self.register("/debug/latency", self._latency)
         self.register("/debug/profile", self._profile)
         self.register_prefix("/debug/trace/", self._trace)
         self.register_prefix("/debug/explain/", self._explain)
@@ -466,6 +494,12 @@ class DebugService:
         (/debug/timeline?cycles=N): segments, wall-time attribution,
         device-idle intervals, critical path per cycle."""
         return debug_timeline_body(self.scheduler, params)
+
+    def _latency(self, params: dict) -> object:
+        """Pod-journey latency quantile table (/debug/latency?tenant=):
+        per-(tenant, qos, stage) e2e + stage sketches; 501 when the
+        ledger is off, typed 400 on an unknown tenant filter."""
+        return debug_latency_body(self.scheduler, params)
 
     def _profile(self, params: dict) -> object:
         """On-demand jax.profiler capture (/debug/profile?seconds=N);

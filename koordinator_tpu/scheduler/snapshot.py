@@ -63,6 +63,10 @@ class PodSpec:
     #: pod.spec.preemptionPolicy — "Never" opts out of preempting others
     #: (PodEligibleToPreemptOthers, elasticquota/preempt.go:62)
     preemption_policy: str = "PreemptLowerPriority"
+    #: manager-side ingest wall-clock (journey ledger, ISSUE 20); 0.0 when
+    #: no stamp rode deltasync in.  Never read by solve or the pending
+    #: sort key — that is `creation` — so it cannot perturb decisions.
+    arrival_ts: float = 0.0
 
 
 class ClusterSnapshot:

@@ -139,6 +139,21 @@ def default_specs(latency_threshold_s: float = 0.2,
             objective=0.01,
         ),
         SloSpec(
+            name="pod_e2e_p99",
+            description=(f"per-pod journey e2e p99 stays under "
+                         f"{latency_threshold_s * 1000:g}ms at least 99% "
+                         "of the time (journey-ledger sketch quantiles — "
+                         "true arrival-to-ack per-pod latency, not "
+                         "round-bucket interpolation; the gauge refreshes "
+                         "from the ledger each monitor sweep and the "
+                         "budget burns only while it sits over the bar)"),
+            kind=KIND_GAUGE,
+            metric="koord_scheduler_pod_journey_latency_seconds",
+            threshold=latency_threshold_s,
+            objective=0.01,
+            label_filter=(("q", "0.99"), ("stage", "e2e")),
+        ),
+        SloSpec(
             name="solve_shed_rate",
             description="under 1% of solve rounds shed on deadline",
             kind=KIND_RATIO,

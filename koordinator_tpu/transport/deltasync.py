@@ -604,12 +604,19 @@ class StateSyncService:
                 node_selector: dict | None = None,
                 labels: dict | None = None,
                 owner: str | None = None,
-                qos: int = 0) -> int:
+                qos: int = 0,
+                arrival_ts: float | None = None) -> int:
         arrays = {"requests": np.asarray(requests, np.int32)}
         doc = {"kind": POD_ADD, "name": name, "priority": priority,
                "quota": quota, "gang": gang,
                "node_selector": node_selector or {},
                "labels": labels or {}, "owner": owner, "qos": qos}
+        if arrival_ts is not None:
+            # journey-ledger ingest stamp (ISSUE 20): absent from
+            # _V2_DEFAULTS on purpose so it rides v2 frames as a sparse
+            # extras column only when present — v3 peers see a plain doc
+            # key, and stamp-less producers ship zero extra bytes
+            doc["arrival_ts"] = float(arrival_ts)
         def store():
             self.pods[name] = {"doc": doc, "arrays": arrays}
 
@@ -747,6 +754,7 @@ class StateSyncService:
             require_doc(int_field, int, "an integer")
         require_doc("ttl_sec", (int, float), "a number")
         require_doc("usage_time", (int, float), "a number")
+        require_doc("arrival_ts", (int, float), "a number")
         for bool_field in ("allocate_once", "restricted"):
             require_doc(bool_field, bool, "a boolean")
 
@@ -792,7 +800,8 @@ class StateSyncService:
                 quota=doc.get("quota"), gang=doc.get("gang"),
                 node_selector=doc.get("node_selector"),
                 labels=doc.get("labels"), owner=doc.get("owner"),
-                qos=int(doc.get("qos") or 0))
+                qos=int(doc.get("qos") or 0),
+                arrival_ts=doc.get("arrival_ts"))
         elif kind == POD_REMOVE:
             rv = self.remove_pod(name)
         elif kind == RSV_UPSERT:
@@ -1363,6 +1372,7 @@ class SchedulerBinding:
             labels=dict(entry.get("labels", {})),
             owner=entry.get("owner"),
             qos=int(entry.get("qos", 0)),
+            arrival_ts=float(entry.get("arrival_ts") or 0.0),
         ))
 
     def pod_add_run(self,
@@ -1383,6 +1393,7 @@ class SchedulerBinding:
                 labels=dict(entry.get("labels", {})),
                 owner=entry.get("owner"),
                 qos=int(entry.get("qos", 0)),
+                arrival_ts=float(entry.get("arrival_ts") or 0.0),
             )
             for entry, arrs in items
         ])

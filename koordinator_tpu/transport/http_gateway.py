@@ -58,6 +58,12 @@ Routes (all JSON bodies/responses unless noted):
                                           device-idle intervals, and
                                           the critical-path chain +
                                           dominant cause per cycle
+    GET  /debug/latency?tenant=        -> the pod-journey ledger's
+                                          per-(tenant, qos, stage)
+                                          e2e latency quantile table
+                                          from mergeable sketches (501
+                                          when the ledger is off; typed
+                                          400 on an unknown tenant)
     GET  /debug/profile?seconds=N      -> on-demand jax.profiler
                                           capture; 403 unless enabled
                                           at assembly (gated off by
@@ -214,6 +220,8 @@ class HttpGateway:
             return self._debug_tenants(req)
         if method == "GET" and path == "/debug/timeline":
             return self._debug_timeline(req)
+        if method == "GET" and path == "/debug/latency":
+            return self._debug_latency(req)
         if method == "GET" and path == "/debug/profile":
             return self._debug_profile(req)
         m = self._TRACE.match(path)
@@ -423,6 +431,26 @@ class HttpGateway:
         try:
             return req._reply(200, debug_timeline_body(self.scheduler,
                                                        params))
+        except DebugApiError as e:
+            return req._reply(e.status, {"error": e.message})
+
+    def _debug_latency(self, req) -> None:
+        """The pod-journey ledger's latency quantile table — same body
+        the DebugService serves (shared builder; ?tenant= filters, typed
+        400 on an unknown tenant, 501 while the ledger is off)."""
+        if self.scheduler is None:
+            return req._reply(501, {"error": "no scheduler attached"})
+        from urllib.parse import parse_qsl
+
+        from koordinator_tpu.scheduler.services import (
+            DebugApiError,
+            debug_latency_body,
+        )
+
+        params = dict(parse_qsl(req.path.partition("?")[2]))
+        try:
+            return req._reply(200, debug_latency_body(self.scheduler,
+                                                      params))
         except DebugApiError as e:
             return req._reply(e.status, {"error": e.message})
 

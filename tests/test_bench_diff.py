@@ -248,3 +248,31 @@ class TestCommittedBaseline:
         rec = stages.get("timeline_overhead")
         assert rec is not None and "error" not in rec
         assert rec["overhead_fraction"] < 0.03
+
+    def test_baseline_covers_the_journey_ledger_overhead_stage(self):
+        """ISSUE 20 acceptance: the always-on pod-journey ledger costs
+        under 1% of the pipelined cycle (its scheduling-path work is
+        stamps + staged appends; sketch digestion amortizes onto the
+        telemetry sampler)."""
+        stages = bench_diff.load_stages(self.BASELINE)
+        rec = stages.get("journey_ledger_overhead")
+        assert rec is not None and "error" not in rec, rec
+        assert rec["ms_per_iter"] > 0
+        assert rec["overhead_fraction"] < 0.01, rec
+
+    def test_planted_journey_regression_flagged(self, tmp_path, capsys):
+        """A candidate where the journey-ledger stage got 10x slower
+        against the COMMITTED baseline must exit 1 naming the stage —
+        the sentinel really guards the ledger's hot path."""
+        slowed = []
+        with open(self.BASELINE) as fh:
+            for line in fh:
+                rec = json.loads(line)
+                if rec.get("stage") == "journey_ledger_overhead":
+                    rec["ms_per_iter"] = round(
+                        rec["ms_per_iter"] * 10 + 1.0, 2)
+                slowed.append(rec)
+        c = _write(tmp_path / "cand.jsonl", slowed)
+        assert bench_diff.main([self.BASELINE, c]) == 1
+        err = capsys.readouterr().err
+        assert "journey_ledger_overhead" in err and "FAIL" in err

@@ -33,7 +33,8 @@ def test_stage_profiler_smoke():
                       "wire_codec_v1_vs_v2", "deltasync_apply_batched",
                       "bind_commit_batched",
                       "tenancy_serial", "tenancy_pipelined",
-                      "tenancy_batched", "timeline_overhead"}, stages
+                      "tenancy_batched", "timeline_overhead",
+                      "journey_ledger_overhead"}, stages
     by_stage = {r["stage"]: r for r in records}
     # every timed stage produced a positive per-iteration time
     for name in ("score", "select_approx", "select_chunked", "rounds",
@@ -98,6 +99,12 @@ def test_stage_profiler_smoke():
     # be real
     assert by_stage["timeline_overhead"]["ms_per_iter"] > 0
     assert by_stage["timeline_overhead"]["overhead_fraction"] is not None
+    # the journey-ledger self-overhead stage (ISSUE 20) measures the
+    # ledger's hot-path seconds directly (shim accounting), so unlike
+    # the wall-differenced delta its fraction is a real upper bound
+    assert by_stage["journey_ledger_overhead"]["ms_per_iter"] > 0
+    assert by_stage["journey_ledger_overhead"]["ledger_ms_per_iter"] >= 0
+    assert by_stage["journey_ledger_overhead"]["overhead_fraction"] is not None
 
 
 def test_latest_probe_capture_selection(tmp_path):

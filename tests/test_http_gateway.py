@@ -282,3 +282,49 @@ def _req_raw_body(port, path, body: bytes, method: str = "POST"):
             return resp.status, {}
     finally:
         conn.close()
+
+
+class TestDebugLatencyRoute:
+    """Surface parity for /debug/latency (ISSUE 20): the gateway route
+    serves the same shared builder as DebugService, including its typed
+    400/501 errors."""
+
+    def test_latency_table_then_typed_errors(self):
+        from koordinator_tpu import journey
+
+        ledger_was = journey.LEDGER.enabled
+        journey.LEDGER.set_enabled(True)
+        journey.LEDGER.reset_for_tests()
+        sched, _binds = mk_scheduler([node("n1")])
+        sched.enqueue(pod("p1", cpu=2_000))
+        sched.schedule_round()
+        gw = HttpGateway(scheduler=sched)
+        gw.start()
+        try:
+            status, doc = _req(gw.port, "/debug/latency")
+            assert status == 200
+            assert doc["enabled"] is True
+            assert doc["stages"][0] == "e2e"
+            assert any(r["stage"] == "e2e" and r["count"] >= 1
+                       for r in doc["series"])
+
+            # unknown tenant filter: typed 400 with the recorded set
+            try:
+                _req(gw.port, "/debug/latency?tenant=absent")
+                raise AssertionError("unknown tenant did not 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                assert "unknown tenant" in json.loads(
+                    e.read().decode())["error"]
+
+            # kill switch thrown: typed 501, not an empty 200
+            journey.LEDGER.set_enabled(False)
+            try:
+                _req(gw.port, "/debug/latency")
+                raise AssertionError("disabled ledger did not 501")
+            except urllib.error.HTTPError as e:
+                assert e.code == 501
+        finally:
+            journey.LEDGER.set_enabled(ledger_was)
+            journey.LEDGER.reset_for_tests()
+            gw.stop()

@@ -27,6 +27,7 @@ from tools.koordlint.analyzers.donation_flow import DonationFlowAnalyzer
 from tools.koordlint.analyzers.donation_safety import DonationSafetyAnalyzer
 from tools.koordlint.analyzers.dtype_regime import DtypeRegimeAnalyzer
 from tools.koordlint.analyzers.jit_host_sync import JitHostSyncAnalyzer
+from tools.koordlint.analyzers.latency_home import LatencyHomeAnalyzer
 from tools.koordlint.analyzers.lock_discipline import LockDisciplineAnalyzer
 from tools.koordlint.analyzers.marker_audit import MarkerAuditAnalyzer
 from tools.koordlint.analyzers.mesh_discipline import MeshDisciplineAnalyzer
@@ -392,6 +393,35 @@ class TestWireCodecCorpus:
         # (the v1 paths live inside the exempt codec home; real_tree
         # reuses the shared whole-tree parse — the parse dominates)
         assert WireCodecAnalyzer().run(real_tree) == []
+
+
+class TestLatencyHomeCorpus:
+    def test_bad_corpus_flags_every_seeded_site(self):
+        findings = LatencyHomeAnalyzer().run(
+            corpus("latency_home", "bad", ("pkg",)))
+        messages = "\n".join(f"{f.line}: {f.message}" for f in findings)
+        assert len(findings) == 3, messages
+        # one delta inside the bind loop, one against a stashed stamp
+        # in the pending loop, one stored keyed by pod name
+        for needle in ("inside `for (pod, node) in binds`",
+                       "inside `for name in pending`",
+                       "stored per pod under [pod.name]"):
+            assert needle in messages, f"missing: {needle}\n{messages}"
+        assert all("journey.LEDGER" in f.hint for f in findings)
+
+    def test_good_corpus_round_scoped_deltas_stay_silent(self):
+        assert LatencyHomeAnalyzer().run(
+            corpus("latency_home", "good", ("pkg",))) == []
+
+    def test_measurement_homes_are_exempt(self, real_tree):
+        # journey.py itself subtracts clocks per pod BY DESIGN; the
+        # rule must skip the sanctioned homes or it flags its own cure
+        assert all(f.path not in ("koordinator_tpu/journey.py",
+                                  "koordinator_tpu/timeline.py")
+                   for f in LatencyHomeAnalyzer().run(real_tree))
+
+    def test_real_tree_is_clean(self, real_tree):
+        assert LatencyHomeAnalyzer().run(real_tree) == []
 
 
 @pytest.fixture(scope="module")

@@ -8,6 +8,7 @@ from .donation_flow import DonationFlowAnalyzer
 from .donation_safety import DonationSafetyAnalyzer
 from .dtype_regime import DtypeRegimeAnalyzer
 from .jit_host_sync import JitHostSyncAnalyzer
+from .latency_home import LatencyHomeAnalyzer
 from .lock_discipline import LockDisciplineAnalyzer
 from .marker_audit import MarkerAuditAnalyzer
 from .mesh_discipline import MeshDisciplineAnalyzer
@@ -31,6 +32,8 @@ ALL_ANALYZERS = (
     TenantAxisAnalyzer,
     # protocol v4 columnar codec (ISSUE 19)
     WireCodecAnalyzer,
+    # pod-journey ledger (ISSUE 20)
+    LatencyHomeAnalyzer,
 )
 
 

@@ -1,0 +1,146 @@
+"""latency-home: per-pod latency deltas belong in journey/timeline.
+
+The pod-journey ledger (koordinator_tpu/journey.py, ISSUE 20) is the
+ONE home for per-pod scheduling-latency measurement: O(1) mergeable
+sketches with a bounded relative error, a kill switch, fleet
+aggregation, and a bit-identity guarantee.  The timeline observatory
+(timeline.py) is the one home for per-cycle wall attribution.  An
+ad-hoc ``time.time()`` / ``time.perf_counter()`` delta computed on a
+per-pod path re-invents both badly: it costs a syscall per pod with no
+kill switch, its samples are process-local and unmergeable, and — the
+review-burn that seeded this rule — it tends to grow into a dict of
+per-pod floats that never ages out.
+
+A finding fires when a clock-delta expression (``now - t0`` where
+either side traces to ``time.time()``/``time.perf_counter()``/
+``time.monotonic()``) is computed
+
+- inside a loop whose target or iterable is pod-shaped (``for pod in
+  pods``, ``for name in self.pending``, ``for pod, node in binds``), or
+- stored into a container subscripted by a pod identity
+  (``lat[pod.name] = now - t0``).
+
+Round-/cycle-scoped deltas (one measurement per round, however many
+pods it carried) are fine and stay silent.  The allowed homes —
+journey.py, timeline.py — are skipped entirely.  Route new per-pod
+measurements through ``journey.LEDGER`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Analyzer, Finding, Project
+
+#: attribute/name tails that read a clock
+_CLOCK_TAILS = {"time", "perf_counter", "monotonic"}
+#: loop targets / iterables that mean "one iteration per pod"
+_POD_TOKENS = ("pod", "pending", "binds")
+#: the sanctioned measurement homes (never scanned)
+_ALLOWED = (
+    "koordinator_tpu/journey.py",
+    "koordinator_tpu/timeline.py",
+)
+
+
+def _call_tail(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_clock_call(node: ast.expr) -> bool:
+    return (isinstance(node, ast.Call)
+            and _call_tail(node.func) in _CLOCK_TAILS)
+
+
+def _mentions_pod(text: str) -> bool:
+    low = text.lower()
+    return any(tok in low for tok in _POD_TOKENS)
+
+
+class LatencyHomeAnalyzer(Analyzer):
+    name = "latency-home"
+    description = ("ad-hoc time.time()/perf_counter() latency deltas on "
+                   "per-pod paths belong in the journey ledger "
+                   "(journey.LEDGER) or the timeline observatory, not "
+                   "inline")
+
+    def __init__(self, allowed: tuple[str, ...] = _ALLOWED):
+        self.allowed = set(allowed)
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for path, sf in sorted(project.files.items()):
+            if sf.tree is None or path in self.allowed:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    findings.extend(self._scan_function(sf, node))
+        dedup: dict[tuple, Finding] = {}
+        for f in findings:
+            dedup.setdefault((f.path, f.line), f)
+        return sorted(dedup.values(), key=lambda f: (f.path, f.line))
+
+    # -- one function ---------------------------------------------------------
+
+    def _scan_function(self, sf, fn) -> list[Finding]:
+        # names assigned from a clock read anywhere in the function —
+        # per-pod code re-reading a stashed stamp is the same smell
+        tainted: set[str] = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and _is_clock_call(node.value)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+
+        def is_clockish(node: ast.expr) -> bool:
+            if _is_clock_call(node):
+                return True
+            return isinstance(node, ast.Name) and node.id in tainted
+
+        def is_delta(node: ast.expr) -> bool:
+            return (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)
+                    and (is_clockish(node.left)
+                         or is_clockish(node.right)))
+
+        findings: list[Finding] = []
+
+        def flag(node: ast.AST, where: str) -> None:
+            findings.append(Finding(
+                self.name, sf.path, node.lineno,
+                f"per-pod latency delta computed inline ({where}): "
+                "a clock subtraction on a per-pod path is an ad-hoc "
+                "latency ledger — unmergeable, unkillable, and a "
+                "syscall per pod",
+                hint="record through journey.LEDGER (note_enqueue / "
+                     "record_bind_batch) or a timeline section instead"))
+
+        # (a) clock deltas inside pod-shaped loops
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.AsyncFor)):
+                continue
+            context = (ast.unparse(loop.target) + " "
+                       + ast.unparse(loop.iter))
+            if not _mentions_pod(context):
+                continue
+            for sub in ast.walk(loop):
+                if sub is not loop.iter and is_delta(sub):
+                    flag(sub, f"inside `for {ast.unparse(loop.target)} "
+                              f"in {ast.unparse(loop.iter)}`")
+
+        # (b) clock deltas stored keyed by a pod identity
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Subscript)
+                    and is_delta(node.value)):
+                continue
+            key = ast.unparse(node.targets[0].slice)
+            if _mentions_pod(key):
+                flag(node, f"stored per pod under [{key}]")
+        return findings

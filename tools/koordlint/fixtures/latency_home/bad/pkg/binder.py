@@ -1,0 +1,28 @@
+"""Seeded-bad corpus: ad-hoc per-pod latency deltas outside the homes."""
+
+import time
+
+
+class Binder:
+    def __init__(self):
+        self.enqueue_ts = {}
+        self.latency = {}
+
+    def commit(self, binds):
+        # BAD: clock delta inside a per-pod loop — an inline latency
+        # ledger with no merge, no kill switch, a syscall per pod
+        for pod, node in binds:
+            waited = time.perf_counter() - self.enqueue_ts[pod.name]
+            self.latency[pod.name] = waited
+
+    def sweep(self, pending):
+        t0 = time.time()
+        for name in pending:
+            # BAD: tainted stamp subtracted per pod
+            age = t0 - self.enqueue_ts[name]
+            if age > 30.0:
+                print(name, age)
+
+    def stamp(self, pod, started):
+        # BAD: per-pod keyed store of a clock delta (no loop needed)
+        self.latency[pod.name] = time.time() - started

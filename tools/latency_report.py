@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Fleet-wide pod-journey latency table from per-process sketch snapshots.
+
+Every binary flushes its journey ledger to JSONL on teardown when
+``KOORD_JOURNEY_JSONL`` names a path (one line per (tenant, qos, stage)
+series, carrying the full log-bucketed sketch — see
+koordinator_tpu/journey.py).  This tool merges any number of those
+files into ONE journey table: merge is bucket-wise addition, so the
+fleet-merged quantiles carry the same <=1% relative-error bound as each
+process's own sketches — no raw samples ship, no accuracy is lost to
+re-aggregation (the federation-ready primitive, ROADMAP item 4).
+
+    python tools/latency_report.py /var/run/koord/*.journey.jsonl
+    python tools/latency_report.py --tenant a --json sched.jsonl mgr.jsonl
+
+Exit status: 0 when at least one series merged, 2 when the inputs held
+no journey rows (empty files are a configuration smell, not silence).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from koordinator_tpu.journey import (  # noqa: E402
+    RELATIVE_ACCURACY,
+    STAGES,
+    merge_snapshot_rows,
+)
+
+QUANTILES = (0.5, 0.9, 0.99)
+
+
+def read_rows(paths: list[str]) -> list[dict]:
+    """All journey JSONL rows across the input files (blank lines and
+    non-journey records are skipped, not fatal — soak artifacts mix
+    record kinds in one directory)."""
+    rows = []
+    for path in paths:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if {"tenant", "qos", "stage", "sketch"} <= set(doc):
+                    rows.append(doc)
+    return rows
+
+
+def journey_table(rows: list[dict], tenant: str | None = None) -> dict:
+    """Merge snapshot rows into the fleet journey table doc."""
+    merged = merge_snapshot_rows(
+        r for r in rows if tenant is None or r["tenant"] == tenant)
+    series = []
+    for (t, qos, stage) in sorted(merged):
+        sk = merged[(t, qos, stage)]
+        row = {"tenant": t, "qos": qos, "stage": stage,
+               "count": sk.count, "mean_s": sk.mean(),
+               "max_s": sk.max_value}
+        for q in QUANTILES:
+            row[f"p{int(q * 100)}_s"] = sk.quantile(q)
+        series.append(row)
+    return {"alpha": RELATIVE_ACCURACY, "stages": list(STAGES),
+            "series": series}
+
+
+def _fmt_s(v: float | None) -> str:
+    if v is None:
+        return "-"
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    return f"{v * 1e3:.2f}ms"
+
+
+def print_table(table: dict, out=None) -> None:
+    # resolve stdout at CALL time — a def-time default pins whatever
+    # sys.stdout was at import and breaks under redirection
+    out = out if out is not None else sys.stdout
+    print(f"== pod journey (fleet-merged, "
+          f"alpha={table['alpha']:.0%} relative error)", file=out)
+    print(f"{'tenant':<10} {'qos':>3} {'stage':<10} {'count':>8} "
+          f"{'p50':>10} {'p90':>10} {'p99':>10} {'max':>10}", file=out)
+    for row in table["series"]:
+        print(f"{row['tenant'] or '-':<10} {row['qos']:>3} "
+              f"{row['stage']:<10} {row['count']:>8} "
+              f"{_fmt_s(row['p50_s']):>10} {_fmt_s(row['p90_s']):>10} "
+              f"{_fmt_s(row['p99_s']):>10} {_fmt_s(row['max_s']):>10}",
+              file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="latency_report",
+        description="merge journey-ledger JSONL snapshots into one "
+                    "fleet-wide latency quantile table")
+    parser.add_argument("paths", nargs="+",
+                        help="journey JSONL snapshot files "
+                             "(KOORD_JOURNEY_JSONL outputs)")
+    parser.add_argument("--tenant", default=None,
+                        help="only this tenant's series")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the merged table as JSON instead of "
+                             "the aligned text table")
+    args = parser.parse_args(argv)
+    table = journey_table(read_rows(args.paths), tenant=args.tenant)
+    if args.json:
+        print(json.dumps(table, indent=2, sort_keys=True))
+    else:
+        print_table(table)
+    if not table["series"]:
+        print("no journey series in the inputs (was the ledger off, or "
+              "KOORD_JOURNEY_JSONL unset?)", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -624,7 +624,11 @@ class SteadyStateHarness:
                     req = rv(cpu=p["cpu"], memory=p["memory"])
                 doc = {"kind": "pod_add", "name": event.name,
                        "qos": int(p.get("qos", 0)),
-                       "priority": int(p.get("priority", 0))}
+                       "priority": int(p.get("priority", 0)),
+                       # journey-ledger ingest stamp: rides the push as a
+                       # sparse extras column so /debug/latency can split
+                       # the feeder->enqueue hop out of e2e (ISSUE 20)
+                       "arrival_ts": time.time()}
                 if p.get("gang"):
                     doc["gang"] = p["gang"]
                 if p.get("quota"):

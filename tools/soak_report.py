@@ -118,6 +118,24 @@ def attach_host_wait(verdict: dict, timeline_body: dict) -> dict:
     return hw
 
 
+def attach_journey(verdict: dict) -> dict:
+    """Fold the pod-journey ledger's latency table into the verdict —
+    the same merge primitive tools/latency_report.py applies to fleet
+    JSONL snapshots, run over this process's own sketch rows (ISSUE 20).
+    A disabled ledger (kill switch) attaches the empty table without
+    judging it; the journey table is evidence, not a gate."""
+    import latency_report
+
+    from koordinator_tpu import journey
+
+    rows = (journey.LEDGER.snapshot_doc()["series"]
+            if journey.LEDGER.enabled else [])
+    table = latency_report.journey_table(rows)
+    table["enabled"] = journey.LEDGER.enabled
+    verdict["journey"] = table
+    return table
+
+
 def print_report(verdict: dict, harness) -> None:
     trend = verdict["trend"]
     print("== steady-state verdict "
@@ -158,6 +176,14 @@ def print_report(verdict: dict, harness) -> None:
                   f"{t['pending']:>8} {t['bound']:>7} "
                   f"{t['rounds']:>7} {t['admitted_total']:>9} "
                   f"{str(t['degraded']):>9} {t['flight_dumps']:>6}")
+    jt = verdict.get("journey")
+    if jt and jt["series"]:
+        import latency_report
+
+        e2e = [r for r in jt["series"] if r["stage"] == "e2e"]
+        print(f"-- pod journey ({len(e2e)} tenant x qos series, "
+              f"alpha={jt['alpha']:.0%}; e2e p99 then stage split)")
+        latency_report.print_table(jt)
     hw = verdict.get("host_wait")
     if hw and hw["cycles"]:
         print(f"-- host-wait attribution ({hw['cycles']} cycles; "
@@ -464,6 +490,7 @@ def main(argv: list[str] | None = None) -> int:
 
             attach_host_wait(verdict, _services.debug_timeline_body(
                 harness.scheduler, {"cycles": 512}))
+            attach_journey(verdict)
             print_report(verdict, harness)
             if args.json:
                 print(json.dumps(verdict, indent=2, default=str))
